@@ -1,0 +1,78 @@
+"""Protobuf + service-table sanity tests."""
+
+from tritonclient_tpu.protocol import (
+    FULL_SERVICE_NAME,
+    RPC_METHODS,
+    pb,
+)
+
+
+def test_infer_request_roundtrip():
+    req = pb.ModelInferRequest(model_name="simple", model_version="1", id="42")
+    t = req.inputs.add()
+    t.name = "INPUT0"
+    t.datatype = "INT32"
+    t.shape.extend([1, 16])
+    req.raw_input_contents.append(b"\x00" * 64)
+    req.parameters["sequence_id"].int64_param = 7
+    out = req.outputs.add()
+    out.name = "OUTPUT0"
+    out.parameters["binary_data"].bool_param = True
+
+    blob = req.SerializeToString()
+    back = pb.ModelInferRequest.FromString(blob)
+    assert back.model_name == "simple"
+    assert back.inputs[0].shape == [1, 16]
+    assert back.parameters["sequence_id"].int64_param == 7
+    assert back.outputs[0].parameters["binary_data"].bool_param is True
+
+
+def test_stream_response_error_oneof():
+    resp = pb.ModelStreamInferResponse(error_message="bad")
+    assert pb.ModelStreamInferResponse.FromString(resp.SerializeToString()).error_message == "bad"
+
+
+def test_service_table_covers_v2_surface():
+    assert FULL_SERVICE_NAME == "inference.GRPCInferenceService"
+    for rpc in [
+        "ServerLive",
+        "ServerReady",
+        "ModelReady",
+        "ServerMetadata",
+        "ModelMetadata",
+        "ModelInfer",
+        "ModelStreamInfer",
+        "ModelConfig",
+        "ModelStatistics",
+        "RepositoryIndex",
+        "RepositoryModelLoad",
+        "RepositoryModelUnload",
+        "SystemSharedMemoryStatus",
+        "SystemSharedMemoryRegister",
+        "SystemSharedMemoryUnregister",
+        "CudaSharedMemoryStatus",
+        "CudaSharedMemoryRegister",
+        "CudaSharedMemoryUnregister",
+        "TpuSharedMemoryStatus",
+        "TpuSharedMemoryRegister",
+        "TpuSharedMemoryUnregister",
+        "TraceSetting",
+        "LogSettings",
+    ]:
+        assert rpc in RPC_METHODS
+    assert RPC_METHODS["ModelStreamInfer"][0] == "stream"
+
+
+def test_plugin_and_auth():
+    from tritonclient_tpu._auth import BasicAuth
+    from tritonclient_tpu._client import InferenceServerClientBase
+    from tritonclient_tpu._request import Request
+
+    c = InferenceServerClientBase()
+    c.register_plugin(BasicAuth("user", "pass"))
+    r = Request({})
+    c._call_plugin(r)
+    assert r.headers["authorization"].startswith("Basic ")
+    assert c.plugin() is not None
+    c.unregister_plugin()
+    assert c.plugin() is None

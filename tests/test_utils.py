@@ -1,0 +1,138 @@
+"""Unit tests for the protocol core (dtype maps, wire serialization, errors).
+
+Modeled on the reference's wire-format contracts (utils/__init__.py:193-348).
+"""
+
+import numpy as np
+import pytest
+
+import ml_dtypes
+
+from tritonclient_tpu.utils import (
+    InferenceServerException,
+    deserialize_bf16_tensor,
+    deserialize_bytes_tensor,
+    np_to_triton_dtype,
+    raise_error,
+    serialize_bf16_tensor,
+    serialize_byte_tensor,
+    serialized_byte_size,
+    triton_dtype_size,
+    triton_to_np_dtype,
+)
+
+
+class TestDtypeMaps:
+    @pytest.mark.parametrize(
+        "np_dtype,triton",
+        [
+            (np.bool_, "BOOL"),
+            (np.int8, "INT8"),
+            (np.int16, "INT16"),
+            (np.int32, "INT32"),
+            (np.int64, "INT64"),
+            (np.uint8, "UINT8"),
+            (np.uint16, "UINT16"),
+            (np.uint32, "UINT32"),
+            (np.uint64, "UINT64"),
+            (np.float16, "FP16"),
+            (np.float32, "FP32"),
+            (np.float64, "FP64"),
+            (np.object_, "BYTES"),
+            (np.bytes_, "BYTES"),
+            (ml_dtypes.bfloat16, "BF16"),
+        ],
+    )
+    def test_np_to_triton(self, np_dtype, triton):
+        assert np_to_triton_dtype(np_dtype) == triton
+
+    def test_bf16_is_real_dtype(self):
+        # TPU-first delta: BF16 maps to a true 2-byte dtype, not float32.
+        dt = triton_to_np_dtype("BF16")
+        assert np.dtype(dt).itemsize == 2
+        assert triton_dtype_size("BF16") == 2
+
+    def test_roundtrip(self):
+        for name in ["BOOL", "INT32", "INT64", "UINT8", "FP16", "FP32", "FP64"]:
+            dt = triton_to_np_dtype(name)
+            assert np_to_triton_dtype(dt) == name
+
+    def test_bytes_maps_to_object(self):
+        assert triton_to_np_dtype("BYTES") == np.dtype(np.object_)
+        assert triton_dtype_size("BYTES") is None
+
+
+class TestBytesWireFormat:
+    def test_serialize_roundtrip(self):
+        arr = np.array([b"hello", b"", b"worlds!"], dtype=np.object_)
+        wire = serialize_byte_tensor(arr)[0]
+        # 4-byte LE length prefix per element.
+        assert wire[:4] == (5).to_bytes(4, "little")
+        back = deserialize_bytes_tensor(wire)
+        assert list(back) == [b"hello", b"", b"worlds!"]
+
+    def test_serialize_strings(self):
+        arr = np.array(["a", "bc"], dtype=np.object_)
+        wire = serialize_byte_tensor(arr)[0]
+        assert deserialize_bytes_tensor(wire).tolist() == [b"a", b"bc"]
+
+    def test_serialize_2d_row_major(self):
+        arr = np.array([[b"a", b"bb"], [b"ccc", b"dddd"]], dtype=np.object_)
+        wire = serialize_byte_tensor(arr)[0]
+        assert deserialize_bytes_tensor(wire).tolist() == [
+            b"a",
+            b"bb",
+            b"ccc",
+            b"dddd",
+        ]
+
+    def test_empty(self):
+        arr = np.array([], dtype=np.object_)
+        assert serialize_byte_tensor(arr).size == 0
+
+    def test_bad_dtype_raises(self):
+        with pytest.raises(InferenceServerException):
+            serialize_byte_tensor(np.array([1, 2, 3], dtype=np.int32))
+
+    def test_serialized_byte_size(self):
+        arr = np.array([b"abc", b"de"], dtype=np.object_)
+        assert serialized_byte_size(arr) == (4 + 3) + (4 + 2)
+        dense = np.zeros((2, 3), dtype=np.float32)
+        assert serialized_byte_size(dense) == 24
+
+
+class TestBF16WireFormat:
+    def test_from_float32(self):
+        x = np.array([1.5, -2.0, 3.25], dtype=np.float32)
+        wire = serialize_bf16_tensor(x)[0]
+        assert len(wire) == 6
+        back = deserialize_bf16_tensor(wire)
+        np.testing.assert_allclose(back, x, rtol=1e-2)
+
+    def test_from_native_bfloat16(self):
+        x = np.array([1.5, -2.0], dtype=ml_dtypes.bfloat16)
+        wire = serialize_bf16_tensor(x)[0]
+        assert wire == x.tobytes()
+
+    def test_native_and_f32_paths_agree(self):
+        x32 = np.array([0.1, 7.0, -3.5], dtype=np.float32)
+        via_f32 = serialize_bf16_tensor(x32)[0]
+        via_bf16 = serialize_bf16_tensor(x32.astype(ml_dtypes.bfloat16))[0]
+        assert via_f32 == via_bf16
+
+    def test_bad_dtype_raises(self):
+        with pytest.raises(InferenceServerException):
+            serialize_bf16_tensor(np.zeros(3, dtype=np.float64))
+
+
+class TestException:
+    def test_fields(self):
+        e = InferenceServerException("boom", status="StatusCode.INTERNAL", debug_details="d")
+        assert e.message() == "boom"
+        assert e.status() == "StatusCode.INTERNAL"
+        assert e.debug_details() == "d"
+        assert "[StatusCode.INTERNAL] boom" == str(e)
+
+    def test_raise_error(self):
+        with pytest.raises(InferenceServerException, match="x"):
+            raise_error("x")

@@ -1,0 +1,32 @@
+"""tritonclient_tpu — a TPU-native client/server framework speaking the KServe v2
+inference protocol.
+
+This package provides the same capabilities as the Triton Inference Server client
+libraries (reference: ``tritonclient``), re-designed TPU-first:
+
+- ``tritonclient_tpu.http`` / ``tritonclient_tpu.grpc`` — sync, async and asyncio
+  clients for the KServe v2 protocol (REST + gRPC), mirroring the reference's
+  ``InferenceServerClient`` / ``InferInput`` / ``InferRequestedOutput`` /
+  ``InferResult`` quartet (reference: src/python/library/tritonclient/{http,grpc}/).
+- ``tritonclient_tpu.utils`` — dtype mapping (with *real* bfloat16 via ml_dtypes,
+  improving on the reference's float32 shim at utils/__init__.py:184), BYTES/BF16
+  wire serialization, DLPack interop.
+- ``tritonclient_tpu.utils.shared_memory`` — POSIX system shared memory transport
+  (ctypes over a native C++ core, reference: utils/shared_memory + libcshm).
+- ``tritonclient_tpu.utils.tpu_shared_memory`` — the TPU-native zero-copy plane:
+  XLA/PjRt device buffers registered via DLPack so jax.Arrays move in and out of a
+  co-located JAX-backend server without host staging (reference analog:
+  utils/cuda_shared_memory backed by cudaIpc).
+- ``tritonclient_tpu.server`` — an in-process JAX-backed KServe v2 server (HTTP +
+  gRPC) used both as the hermetic test fixture and as a real co-located backend.
+- ``tritonclient_tpu.models`` — the JAX/Flax model zoo backing the benchmarks
+  (simple add/sub, ResNet50, BERT-base).
+- ``tritonclient_tpu.parallel`` — device-mesh sharding (dp/tp/sp) for multi-chip
+  serving and training via jax.sharding + XLA collectives.
+- ``tritonclient_tpu.perf`` — perf_analyzer-equivalent load generator.
+"""
+
+from tritonclient_tpu._version import __version__  # noqa: F401
+from tritonclient_tpu._client import InferenceServerClientBase  # noqa: F401
+from tritonclient_tpu._plugin import InferenceServerClientPlugin  # noqa: F401
+from tritonclient_tpu._request import Request  # noqa: F401

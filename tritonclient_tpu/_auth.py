@@ -1,0 +1,20 @@
+"""Built-in auth plugins.
+
+Reference parity: tritonclient/_auth.py:33-45 (BasicAuth).
+"""
+
+import base64
+
+from tritonclient_tpu._plugin import InferenceServerClientPlugin
+from tritonclient_tpu._request import Request
+
+
+class BasicAuth(InferenceServerClientPlugin):
+    """Injects an ``authorization: Basic <b64(user:pass)>`` header."""
+
+    def __init__(self, username: str, password: str):
+        token = base64.b64encode(f"{username}:{password}".encode()).decode()
+        self._auth_header = f"Basic {token}"
+
+    def __call__(self, request: Request) -> None:
+        request.headers["authorization"] = self._auth_header

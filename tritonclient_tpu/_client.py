@@ -1,0 +1,35 @@
+"""Base class shared by all client flavors (http/grpc × sync/aio).
+
+Reference parity: tritonclient/_client.py:31-85 — a single registered plugin is
+invoked on every outgoing request to mutate its headers.
+"""
+
+from tritonclient_tpu._plugin import InferenceServerClientPlugin
+from tritonclient_tpu._request import Request
+
+
+class InferenceServerClientBase:
+    def __init__(self):
+        self._plugin = None
+
+    def _call_plugin(self, request: Request) -> None:
+        """Called by subclasses immediately before a request is sent."""
+        if self._plugin is not None:
+            self._plugin(request)
+
+    def register_plugin(self, plugin: InferenceServerClientPlugin) -> None:
+        """Register a plugin; at most one may be active at a time."""
+        if not isinstance(plugin, InferenceServerClientPlugin):
+            raise ValueError("plugin must be an InferenceServerClientPlugin")
+        if self._plugin is not None:
+            raise RuntimeError("A plugin is already registered; unregister it first.")
+        self._plugin = plugin
+
+    def plugin(self):
+        """Return the registered plugin (or None)."""
+        return self._plugin
+
+    def unregister_plugin(self) -> None:
+        if self._plugin is None:
+            raise RuntimeError("No plugin is registered.")
+        self._plugin = None

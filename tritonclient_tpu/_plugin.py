@@ -1,0 +1,20 @@
+"""Client plugin ABC.
+
+Reference parity: tritonclient/_plugin.py:31-48.
+"""
+
+import abc
+
+from tritonclient_tpu._request import Request
+
+
+class InferenceServerClientPlugin(abc.ABC):
+    """Every outgoing request is passed through ``__call__`` before being sent.
+
+    Implementations mutate ``request.headers`` in place (e.g. to inject
+    authorization headers for a gateway in front of the server).
+    """
+
+    @abc.abstractmethod
+    def __call__(self, request: Request) -> None:
+        ...

@@ -1,0 +1,15 @@
+"""Request view handed to client plugins.
+
+Reference parity: tritonclient/_request.py:29-39.
+"""
+
+
+class Request:
+    """A shallow, mutable view of an outgoing request exposed to plugins.
+
+    Plugins (e.g. auth gateways) receive this object and may mutate
+    ``headers`` in place before the request hits the wire.
+    """
+
+    def __init__(self, headers):
+        self.headers = headers

@@ -1,0 +1,19 @@
+"""KServe v2 wire protocol: generated protobuf messages + hand-written gRPC
+service plumbing.
+
+The reference fetches its protos from triton-inference-server/common at build
+time and generates stubs with grpc_tools (src/python/CMakeLists.txt:44-50). Here
+the proto is authored from the public spec (kserve.proto), messages are
+generated with protoc (kserve_pb2.py, committed; regenerate with regen.sh), and
+the service stub/handler layer is hand-written over grpcio's generic API since
+the service codegen plugin is not part of this environment — functionally
+identical to generated service_pb2_grpc code.
+"""
+
+from tritonclient_tpu.protocol import kserve_pb2 as pb  # noqa: F401
+from tritonclient_tpu.protocol._service import (  # noqa: F401
+    FULL_SERVICE_NAME,
+    RPC_METHODS,
+    GRPCInferenceServiceStub,
+    make_service_handler,
+)

@@ -1,0 +1,140 @@
+"""Hand-written gRPC service plumbing for GRPCInferenceService.
+
+Equivalent to what ``grpc_tools.protoc`` would emit as ``kserve_pb2_grpc.py``:
+a client ``Stub`` binding each RPC to a multicallable on a channel, and a
+server-side handler factory. Method table is the single source of truth for
+both sides.
+"""
+
+import grpc
+
+from tritonclient_tpu.protocol import kserve_pb2 as pb
+
+FULL_SERVICE_NAME = "inference.GRPCInferenceService"
+
+# name -> (kind, request type, response type); kind in {"unary", "stream"}
+RPC_METHODS = {
+    "ServerLive": ("unary", pb.ServerLiveRequest, pb.ServerLiveResponse),
+    "ServerReady": ("unary", pb.ServerReadyRequest, pb.ServerReadyResponse),
+    "ModelReady": ("unary", pb.ModelReadyRequest, pb.ModelReadyResponse),
+    "ServerMetadata": ("unary", pb.ServerMetadataRequest, pb.ServerMetadataResponse),
+    "ModelMetadata": ("unary", pb.ModelMetadataRequest, pb.ModelMetadataResponse),
+    "ModelInfer": ("unary", pb.ModelInferRequest, pb.ModelInferResponse),
+    "ModelStreamInfer": ("stream", pb.ModelInferRequest, pb.ModelStreamInferResponse),
+    "ModelConfig": ("unary", pb.ModelConfigRequest, pb.ModelConfigResponse),
+    "ModelStatistics": (
+        "unary",
+        pb.ModelStatisticsRequest,
+        pb.ModelStatisticsResponse,
+    ),
+    "RepositoryIndex": (
+        "unary",
+        pb.RepositoryIndexRequest,
+        pb.RepositoryIndexResponse,
+    ),
+    "RepositoryModelLoad": (
+        "unary",
+        pb.RepositoryModelLoadRequest,
+        pb.RepositoryModelLoadResponse,
+    ),
+    "RepositoryModelUnload": (
+        "unary",
+        pb.RepositoryModelUnloadRequest,
+        pb.RepositoryModelUnloadResponse,
+    ),
+    "SystemSharedMemoryStatus": (
+        "unary",
+        pb.SystemSharedMemoryStatusRequest,
+        pb.SystemSharedMemoryStatusResponse,
+    ),
+    "SystemSharedMemoryRegister": (
+        "unary",
+        pb.SystemSharedMemoryRegisterRequest,
+        pb.SystemSharedMemoryRegisterResponse,
+    ),
+    "SystemSharedMemoryUnregister": (
+        "unary",
+        pb.SystemSharedMemoryUnregisterRequest,
+        pb.SystemSharedMemoryUnregisterResponse,
+    ),
+    "CudaSharedMemoryStatus": (
+        "unary",
+        pb.CudaSharedMemoryStatusRequest,
+        pb.CudaSharedMemoryStatusResponse,
+    ),
+    "CudaSharedMemoryRegister": (
+        "unary",
+        pb.CudaSharedMemoryRegisterRequest,
+        pb.CudaSharedMemoryRegisterResponse,
+    ),
+    "CudaSharedMemoryUnregister": (
+        "unary",
+        pb.CudaSharedMemoryUnregisterRequest,
+        pb.CudaSharedMemoryUnregisterResponse,
+    ),
+    "TpuSharedMemoryStatus": (
+        "unary",
+        pb.TpuSharedMemoryStatusRequest,
+        pb.TpuSharedMemoryStatusResponse,
+    ),
+    "TpuSharedMemoryRegister": (
+        "unary",
+        pb.TpuSharedMemoryRegisterRequest,
+        pb.TpuSharedMemoryRegisterResponse,
+    ),
+    "TpuSharedMemoryUnregister": (
+        "unary",
+        pb.TpuSharedMemoryUnregisterRequest,
+        pb.TpuSharedMemoryUnregisterResponse,
+    ),
+    "TraceSetting": ("unary", pb.TraceSettingRequest, pb.TraceSettingResponse),
+    "LogSettings": ("unary", pb.LogSettingsRequest, pb.LogSettingsResponse),
+}
+
+
+class GRPCInferenceServiceStub:
+    """Client-side stub; works on both ``grpc.Channel`` and ``grpc.aio.Channel``."""
+
+    def __init__(self, channel):
+        for name, (kind, req_t, resp_t) in RPC_METHODS.items():
+            path = f"/{FULL_SERVICE_NAME}/{name}"
+            if kind == "unary":
+                call = channel.unary_unary(
+                    path,
+                    request_serializer=req_t.SerializeToString,
+                    response_deserializer=resp_t.FromString,
+                )
+            else:
+                call = channel.stream_stream(
+                    path,
+                    request_serializer=req_t.SerializeToString,
+                    response_deserializer=resp_t.FromString,
+                )
+            setattr(self, name, call)
+
+
+def make_service_handler(servicer) -> grpc.GenericRpcHandler:
+    """Build a generic handler from an object with methods named after RPCs.
+
+    Unary methods have signature ``f(request, context) -> response``; the
+    streaming method ``ModelStreamInfer(request_iterator, context)`` yields
+    responses.
+    """
+    handlers = {}
+    for name, (kind, req_t, resp_t) in RPC_METHODS.items():
+        fn = getattr(servicer, name, None)
+        if fn is None:
+            continue
+        if kind == "unary":
+            handlers[name] = grpc.unary_unary_rpc_method_handler(
+                fn,
+                request_deserializer=req_t.FromString,
+                response_serializer=resp_t.SerializeToString,
+            )
+        else:
+            handlers[name] = grpc.stream_stream_rpc_method_handler(
+                fn,
+                request_deserializer=req_t.FromString,
+                response_serializer=resp_t.SerializeToString,
+            )
+    return grpc.method_handlers_generic_handler(FULL_SERVICE_NAME, handlers)

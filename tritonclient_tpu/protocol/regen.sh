@@ -1,0 +1,4 @@
+#!/bin/sh
+# Regenerate kserve_pb2.py from kserve.proto (messages only; the service layer
+# is hand-written in _service.py).
+cd "$(dirname "$0")" && protoc --python_out=. kserve.proto

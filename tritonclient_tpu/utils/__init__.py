@@ -1,0 +1,250 @@
+"""Protocol-core utilities: dtype mapping, wire serialization, error model.
+
+Reference parity: tritonclient/utils/__init__.py (dtype maps :133-191, BYTES wire
+format :193-276, BF16 pack/unpack :279-348, InferenceServerException :71-130,
+serialized_byte_size :43-68).
+
+TPU-first deltas vs the reference:
+- BF16 is a *real* dtype here (ml_dtypes.bfloat16 — the native TPU compute type),
+  not the reference's float32 truncation shim (utils/__init__.py:184,279-348).
+  ``triton_to_np_dtype("BF16")`` returns ml_dtypes.bfloat16 and serialization is a
+  straight 2-byte-per-element memcpy; the float32-roundtrip helpers are kept for
+  wire compatibility with numpy arrays of float32.
+- BYTES serialization is vectorized (offset arithmetic + single allocation)
+  instead of an np.nditer Python loop.
+"""
+
+from typing import Optional
+
+import numpy as np
+
+try:  # ml_dtypes ships with jax; bfloat16 as a first-class numpy dtype.
+    import ml_dtypes
+
+    _BFLOAT16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover - ml_dtypes is always present with jax
+    ml_dtypes = None
+    _BFLOAT16 = None
+
+
+class InferenceServerException(Exception):
+    """Exception raised for errors talking to the inference server.
+
+    Parameters mirror the reference (utils/__init__.py:71-130): a message, an
+    optional protocol status string, and optional debug details.
+    """
+
+    def __init__(self, msg: str, status: Optional[str] = None, debug_details=None):
+        self._msg = msg
+        self._status = status
+        self._debug_details = debug_details
+        super().__init__(msg)
+
+    def __str__(self):
+        msg = super().__str__() if self._msg is None else self._msg
+        if self._status is not None:
+            msg = "[" + self._status + "] " + msg
+        return msg
+
+    def message(self):
+        return self._msg
+
+    def status(self):
+        return self._status
+
+    def debug_details(self):
+        return self._debug_details
+
+
+def raise_error(msg):
+    """Raise an InferenceServerException without status/debug details."""
+    raise InferenceServerException(msg=msg)
+
+
+# --------------------------------------------------------------------------- #
+# dtype mapping                                                               #
+# --------------------------------------------------------------------------- #
+
+_NP_TO_TRITON = {
+    np.dtype(np.bool_): "BOOL",
+    np.dtype(np.int8): "INT8",
+    np.dtype(np.int16): "INT16",
+    np.dtype(np.int32): "INT32",
+    np.dtype(np.int64): "INT64",
+    np.dtype(np.uint8): "UINT8",
+    np.dtype(np.uint16): "UINT16",
+    np.dtype(np.uint32): "UINT32",
+    np.dtype(np.uint64): "UINT64",
+    np.dtype(np.float16): "FP16",
+    np.dtype(np.float32): "FP32",
+    np.dtype(np.float64): "FP64",
+}
+if _BFLOAT16 is not None:
+    _NP_TO_TRITON[_BFLOAT16] = "BF16"
+
+_TRITON_TO_NP = {v: k for k, v in _NP_TO_TRITON.items()}
+_TRITON_TO_NP["BYTES"] = np.dtype(np.object_)
+
+_TRITON_DTYPE_SIZES = {
+    "BOOL": 1,
+    "INT8": 1,
+    "INT16": 2,
+    "INT32": 4,
+    "INT64": 8,
+    "UINT8": 1,
+    "UINT16": 2,
+    "UINT32": 4,
+    "UINT64": 8,
+    "FP16": 2,
+    "FP32": 4,
+    "FP64": 8,
+    "BF16": 2,
+}
+
+
+def np_to_triton_dtype(np_dtype) -> Optional[str]:
+    """Map a numpy dtype to its Triton/KServe-v2 datatype string.
+
+    Object and byte/unicode dtypes map to "BYTES"; bfloat16 (ml_dtypes) maps to
+    "BF16" (the reference has no native bf16 numpy path, utils/__init__.py:184).
+    """
+    dt = np.dtype(np_dtype)
+    if dt in _NP_TO_TRITON:
+        return _NP_TO_TRITON[dt]
+    if dt.kind in ("O", "S", "U"):
+        return "BYTES"
+    return None
+
+
+def triton_to_np_dtype(dtype: str):
+    """Map a Triton/KServe-v2 datatype string to a numpy dtype.
+
+    "BF16" returns ml_dtypes.bfloat16 — a real 2-byte dtype usable directly by
+    jax/XLA on TPU — unlike the reference which returns np.float32.
+    """
+    return _TRITON_TO_NP.get(dtype)
+
+
+def triton_dtype_size(dtype: str) -> Optional[int]:
+    """Bytes per element for fixed-size datatypes; None for BYTES."""
+    return _TRITON_DTYPE_SIZES.get(dtype)
+
+
+# --------------------------------------------------------------------------- #
+# wire serialization                                                          #
+# --------------------------------------------------------------------------- #
+
+
+def serialize_byte_tensor(input_tensor: np.ndarray) -> Optional[np.ndarray]:
+    """Serialize a BYTES tensor into the KServe v2 wire format.
+
+    Each element is encoded as a 4-byte little-endian length followed by the
+    element's bytes, in row-major order (reference: utils/__init__.py:219-246).
+    Returns a 1-D uint8 array wrapping the serialized buffer, or None for
+    zero-size input.
+    """
+    if input_tensor.size == 0:
+        return np.empty([0], dtype=np.object_)
+
+    if (input_tensor.dtype != np.object_) and (input_tensor.dtype.type != np.bytes_):
+        raise_error("cannot serialize bytes tensor: invalid datatype")
+
+    flat = np.ascontiguousarray(input_tensor).flatten()
+    parts = []
+    for obj in flat:
+        if isinstance(obj, bytes):
+            s = obj
+        elif isinstance(obj, np.bytes_):
+            s = bytes(obj)
+        else:
+            s = str(obj).encode("utf-8")
+        parts.append(len(s).to_bytes(4, "little"))
+        parts.append(s)
+    flattened = b"".join(parts)
+    out = np.empty([1], dtype=np.object_)
+    out[0] = flattened
+    return out
+
+
+def deserialize_bytes_tensor(encoded_tensor: bytes) -> np.ndarray:
+    """Inverse of serialize_byte_tensor: 1-D object array of bytes elements.
+
+    Reference: utils/__init__.py:249-276. Vectorized offset walk rather than a
+    per-element struct.unpack loop.
+    """
+    strs = []
+    offset = 0
+    view = memoryview(encoded_tensor)
+    n = len(view)
+    while offset + 4 <= n:
+        length = int.from_bytes(view[offset : offset + 4], "little")
+        offset += 4
+        strs.append(bytes(view[offset : offset + length]))
+        offset += length
+    return np.array(strs, dtype=np.object_)
+
+
+def serialize_bf16_tensor(input_tensor: np.ndarray) -> Optional[np.ndarray]:
+    """Serialize a tensor to BF16 wire bytes (2 bytes/element, row-major).
+
+    Accepts float32 (truncation-rounded, matching the reference's behavior at
+    utils/__init__.py:279-321) or a native ml_dtypes.bfloat16 array (straight
+    memcpy — the TPU-native fast path the reference lacks).
+    """
+    if input_tensor.size == 0:
+        return np.empty([0], dtype=np.object_)
+
+    if _BFLOAT16 is not None and input_tensor.dtype == _BFLOAT16:
+        flattened = np.ascontiguousarray(input_tensor).tobytes()
+    elif input_tensor.dtype == np.float32:
+        if _BFLOAT16 is not None:
+            flattened = (
+                np.ascontiguousarray(input_tensor).astype(_BFLOAT16).tobytes()
+            )
+        else:  # pragma: no cover
+            u32 = np.ascontiguousarray(input_tensor).view(np.uint32)
+            flattened = (u32 >> 16).astype(np.uint16).tobytes()
+    else:
+        raise_error(
+            "cannot serialize bf16 tensor: invalid datatype "
+            f"{input_tensor.dtype} (expected float32 or bfloat16)"
+        )
+        return None
+
+    out = np.empty([1], dtype=np.object_)
+    out[0] = flattened
+    return out
+
+
+def deserialize_bf16_tensor(encoded_tensor: bytes) -> np.ndarray:
+    """Deserialize BF16 wire bytes to a 1-D float32 array.
+
+    Matches the reference's contract (utils/__init__.py:323-348) of handing
+    numpy users float32; callers wanting the native dtype can .astype(bfloat16)
+    or use as_numpy(..., dtype="BF16") paths which keep ml_dtypes.bfloat16.
+    """
+    if _BFLOAT16 is not None:
+        return np.frombuffer(encoded_tensor, dtype=_BFLOAT16).astype(np.float32)
+    u16 = np.frombuffer(encoded_tensor, dtype=np.uint16)  # pragma: no cover
+    return (u16.astype(np.uint32) << 16).view(np.float32)  # pragma: no cover
+
+
+def serialized_byte_size(tensor_value: np.ndarray) -> int:
+    """Byte size a tensor occupies on the wire (reference: utils/__init__.py:43-68)."""
+    if tensor_value.dtype == np.object_:
+        total = 0
+        for obj in tensor_value.flatten():
+            if isinstance(obj, (bytes, np.bytes_)):
+                total += 4 + len(obj)
+            else:
+                total += 4 + len(str(obj).encode("utf-8"))
+        return total
+    return tensor_value.nbytes
+
+
+def num_elements(shape) -> int:
+    """Product of a shape list (empty shape → 1, matching KServe scalars)."""
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
