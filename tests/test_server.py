@@ -1,0 +1,311 @@
+"""End-to-end tests of the in-process JAX server over real sockets.
+
+This is the hermetic tier the reference lacks (SURVEY.md §4): both transports
+are driven through loopback exactly as a remote client would.
+"""
+
+import gzip
+import json
+
+import grpc
+import numpy as np
+import pytest
+import requests
+
+from tritonclient_tpu.protocol import GRPCInferenceServiceStub, pb
+from tritonclient_tpu.server import InferenceServer
+from tritonclient_tpu.utils import deserialize_bytes_tensor, serialize_byte_tensor
+
+
+@pytest.fixture(scope="module")
+def server():
+    with InferenceServer() as s:
+        yield s
+
+
+@pytest.fixture(scope="module")
+def base(server):
+    return f"http://{server.http_address}"
+
+
+@pytest.fixture(scope="module")
+def stub(server):
+    channel = grpc.insecure_channel(server.grpc_address)
+    yield GRPCInferenceServiceStub(channel)
+    channel.close()
+
+
+class TestHTTPSurface:
+    def test_health(self, base):
+        assert requests.get(base + "/v2/health/live").status_code == 200
+        assert requests.get(base + "/v2/health/ready").status_code == 200
+        assert requests.get(base + "/v2/models/simple/ready").status_code == 200
+
+    def test_metadata(self, base):
+        md = requests.get(base + "/v2").json()
+        assert md["name"] == "triton-tpu"
+        assert "tpu_shared_memory" in md["extensions"]
+        mmd = requests.get(base + "/v2/models/simple").json()
+        assert [t["name"] for t in mmd["inputs"]] == ["INPUT0", "INPUT1"]
+
+    def test_config(self, base):
+        cfg = requests.get(base + "/v2/models/simple/config").json()
+        assert cfg["backend"] == "jax"
+        assert cfg["input"][0]["data_type"] == "TYPE_INT32"
+
+    def test_json_infer(self, base):
+        req = {
+            "inputs": [
+                {"name": "INPUT0", "datatype": "INT32", "shape": [1, 16], "data": list(range(16))},
+                {"name": "INPUT1", "datatype": "INT32", "shape": [1, 16], "data": [1] * 16},
+            ],
+        }
+        r = requests.post(base + "/v2/models/simple/infer", json=req)
+        assert r.status_code == 200
+        outs = {o["name"]: o for o in r.json()["outputs"]}
+        assert outs["OUTPUT0"]["data"] == [i + 1 for i in range(16)]
+        assert outs["OUTPUT1"]["data"] == [i - 1 for i in range(16)]
+
+    def test_binary_infer(self, base):
+        header = {
+            "inputs": [
+                {"name": "INPUT0", "datatype": "INT32", "shape": [1, 16], "parameters": {"binary_data_size": 64}},
+                {"name": "INPUT1", "datatype": "INT32", "shape": [1, 16], "parameters": {"binary_data_size": 64}},
+            ],
+            "outputs": [{"name": "OUTPUT0", "parameters": {"binary_data": True}}],
+        }
+        hj = json.dumps(header).encode()
+        body = hj + np.arange(16, dtype=np.int32).tobytes() + np.ones(16, np.int32).tobytes()
+        r = requests.post(
+            base + "/v2/models/simple/infer",
+            data=body,
+            headers={"Inference-Header-Content-Length": str(len(hj))},
+        )
+        assert r.status_code == 200
+        hl = int(r.headers["Inference-Header-Content-Length"])
+        rh = json.loads(r.content[:hl])
+        assert rh["outputs"][0]["parameters"]["binary_data_size"] == 64
+        out = np.frombuffer(r.content[hl : hl + 64], dtype=np.int32)
+        np.testing.assert_array_equal(out, np.arange(16, dtype=np.int32) + 1)
+
+    def test_gzip_roundtrip(self, base):
+        req = {
+            "inputs": [
+                {"name": "INPUT0", "datatype": "INT32", "shape": [1, 16], "data": list(range(16))},
+                {"name": "INPUT1", "datatype": "INT32", "shape": [1, 16], "data": [2] * 16},
+            ]
+        }
+        body = gzip.compress(json.dumps(req).encode())
+        r = requests.post(
+            base + "/v2/models/simple/infer",
+            data=body,
+            headers={"Content-Encoding": "gzip", "Accept-Encoding": "gzip"},
+        )
+        assert r.status_code == 200
+        assert r.json()["outputs"][0]["data"][:3] == [2, 3, 4]
+
+    def test_classification(self, base):
+        req = {
+            "inputs": [
+                {"name": "INPUT0", "datatype": "INT32", "shape": [1, 16], "data": list(range(16))},
+                {"name": "INPUT1", "datatype": "INT32", "shape": [1, 16], "data": [0] * 16},
+            ],
+            "outputs": [{"name": "OUTPUT0", "parameters": {"classification": 2}}],
+        }
+        r = requests.post(base + "/v2/models/simple/infer", json=req)
+        data = r.json()["outputs"][0]["data"]
+        assert data[0].startswith("15.000000:15")
+        assert r.json()["outputs"][0]["datatype"] == "BYTES"
+
+    def test_sequence_accumulates(self, base):
+        last = None
+        for i, (start, end) in enumerate([(True, False), (False, False), (False, True)]):
+            r = requests.post(
+                base + "/v2/models/simple_sequence/infer",
+                json={
+                    "inputs": [{"name": "INPUT", "datatype": "INT32", "shape": [1, 1], "data": [i + 1]}],
+                    "parameters": {"sequence_id": 42, "sequence_start": start, "sequence_end": end},
+                },
+            )
+            last = r.json()
+        assert last["outputs"][0]["data"] == [6]
+
+    def test_statistics(self, base):
+        stats = requests.get(base + "/v2/models/simple/stats").json()["model_stats"][0]
+        assert stats["inference_count"] >= 1
+        assert stats["inference_stats"]["success"]["count"] >= 1
+
+    def test_repository_lifecycle(self, base):
+        idx = requests.post(base + "/v2/repository/index", json={}).json()
+        assert {"simple", "simple_string", "simple_sequence", "repeat_int32"} <= {
+            m["name"] for m in idx
+        }
+        assert requests.post(base + "/v2/repository/models/simple/unload", json={}).status_code == 200
+        assert requests.get(base + "/v2/models/simple/ready").status_code == 400
+        r = requests.post(
+            base + "/v2/models/simple/infer",
+            json={"inputs": []},
+        )
+        assert r.status_code == 400 and "not ready" in r.json()["error"]
+        assert requests.post(base + "/v2/repository/models/simple/load", json={}).status_code == 200
+        assert requests.get(base + "/v2/models/simple/ready").status_code == 200
+
+    def test_load_with_config_override(self, base):
+        override = json.dumps({"max_batch_size": 8})
+        r = requests.post(
+            base + "/v2/repository/models/simple/load",
+            json={"parameters": {"config": override}},
+        )
+        assert r.status_code == 200
+        cfg = requests.get(base + "/v2/models/simple/config").json()
+        assert cfg["max_batch_size"] == 8
+
+    def test_trace_settings(self, base):
+        r = requests.post(base + "/v2/trace/setting", json={"trace_level": ["TIMESTAMPS"]})
+        assert r.json()["trace_level"] == ["TIMESTAMPS"]
+        # Per-model inherits global, then clears back to it.
+        r = requests.post(base + "/v2/models/simple/trace/setting", json={"trace_rate": "5"})
+        assert r.json()["trace_rate"] == ["5"]
+        r = requests.post(base + "/v2/models/simple/trace/setting", json={"trace_rate": None})
+        assert r.json()["trace_rate"] == ["1000"]
+        # reset global
+        requests.post(base + "/v2/trace/setting", json={"trace_level": None})
+
+    def test_log_settings(self, base):
+        r = requests.get(base + "/v2/logging")
+        assert r.json()["log_info"] is True
+        r = requests.post(base + "/v2/logging", json={"log_verbose_level": 1})
+        assert r.json()["log_verbose_level"] == 1
+        requests.post(base + "/v2/logging", json={"log_verbose_level": 0})
+
+    def test_errors(self, base):
+        assert requests.get(base + "/v2/models/nope").status_code == 404
+        r = requests.post(base + "/v2/models/simple/infer", data=b"{not json")
+        assert r.status_code == 400
+        hdr = {
+            "inputs": [
+                {"name": "INPUT0", "datatype": "INT32", "shape": [1, 16], "parameters": {"binary_data_size": 8}},
+                {"name": "INPUT1", "datatype": "INT32", "shape": [1, 16], "parameters": {"binary_data_size": 64}},
+            ]
+        }
+        hj = json.dumps(hdr).encode()
+        r = requests.post(
+            base + "/v2/models/simple/infer",
+            data=hj + b"\0" * 72,
+            headers={"Inference-Header-Content-Length": str(len(hj))},
+        )
+        assert r.status_code == 400
+        assert "unexpected total byte size" in r.json()["error"]
+
+
+class TestGRPCSurface:
+    def test_health(self, stub):
+        assert stub.ServerLive(pb.ServerLiveRequest()).live
+        assert stub.ServerReady(pb.ServerReadyRequest()).ready
+        assert stub.ModelReady(pb.ModelReadyRequest(name="simple")).ready
+
+    def test_metadata_config(self, stub):
+        md = stub.ServerMetadata(pb.ServerMetadataRequest())
+        assert md.name == "triton-tpu"
+        mmd = stub.ModelMetadata(pb.ModelMetadataRequest(name="simple"))
+        assert mmd.inputs[0].name == "INPUT0"
+        cfg = stub.ModelConfig(pb.ModelConfigRequest(name="simple")).config
+        assert cfg.input[0].data_type == pb.TYPE_INT32
+
+    def test_infer_raw(self, stub):
+        req = pb.ModelInferRequest(model_name="simple", id="abc")
+        for name in ("INPUT0", "INPUT1"):
+            t = req.inputs.add()
+            t.name = name
+            t.datatype = "INT32"
+            t.shape.extend([1, 16])
+        req.raw_input_contents.append(np.arange(16, dtype=np.int32).tobytes())
+        req.raw_input_contents.append(np.ones(16, dtype=np.int32).tobytes())
+        resp = stub.ModelInfer(req)
+        assert resp.id == "abc"
+        np.testing.assert_array_equal(
+            np.frombuffer(resp.raw_output_contents[0], np.int32),
+            np.arange(16, dtype=np.int32) + 1,
+        )
+
+    def test_infer_typed_contents(self, stub):
+        req = pb.ModelInferRequest(model_name="simple")
+        for name, vals in (("INPUT0", range(16)), ("INPUT1", [3] * 16)):
+            t = req.inputs.add()
+            t.name = name
+            t.datatype = "INT32"
+            t.shape.extend([1, 16])
+            t.contents.int_contents.extend(vals)
+        resp = stub.ModelInfer(req)
+        assert np.frombuffer(resp.raw_output_contents[0], np.int32)[0] == 3
+
+    def test_string_model(self, stub):
+        req = pb.ModelInferRequest(model_name="simple_string")
+        a = np.array([str(i).encode() for i in range(16)], dtype=np.object_).reshape(1, 16)
+        b = np.array([b"1"] * 16, dtype=np.object_).reshape(1, 16)
+        for name, arr in (("INPUT0", a), ("INPUT1", b)):
+            t = req.inputs.add()
+            t.name = name
+            t.datatype = "BYTES"
+            t.shape.extend([1, 16])
+            req.raw_input_contents.append(serialize_byte_tensor(arr)[0])
+        resp = stub.ModelInfer(req)
+        out = deserialize_bytes_tensor(resp.raw_output_contents[0])
+        assert out[:3].tolist() == [b"1", b"2", b"3"]
+
+    def test_stream_decoupled_with_final(self, stub):
+        def reqs():
+            r = pb.ModelInferRequest(model_name="repeat_int32", id="s1")
+            t = r.inputs.add()
+            t.name = "IN"
+            t.datatype = "INT32"
+            t.shape.extend([3])
+            r.raw_input_contents.append(np.array([7, 8, 9], np.int32).tobytes())
+            r.parameters["triton_enable_empty_final_response"].bool_param = True
+            yield r
+
+        results = list(stub.ModelStreamInfer(reqs()))
+        assert len(results) == 4
+        values = [
+            np.frombuffer(x.infer_response.raw_output_contents[0], np.int32)[0]
+            for x in results[:3]
+        ]
+        assert values == [7, 8, 9]
+        final = results[3].infer_response
+        assert final.parameters["triton_final_response"].bool_param is True
+        assert len(final.outputs) == 0
+
+    def test_stream_error_surface(self, stub):
+        def reqs():
+            yield pb.ModelInferRequest(model_name="nope")
+
+        results = list(stub.ModelStreamInfer(reqs()))
+        assert "unknown model" in results[0].error_message
+
+    def test_errors(self, stub):
+        with pytest.raises(grpc.RpcError) as e:
+            stub.ModelMetadata(pb.ModelMetadataRequest(name="nope"))
+        assert e.value.code() == grpc.StatusCode.NOT_FOUND
+        with pytest.raises(grpc.RpcError) as e:
+            stub.CudaSharedMemoryStatus(pb.CudaSharedMemoryStatusRequest())
+        assert e.value.code() == grpc.StatusCode.UNIMPLEMENTED
+
+    def test_statistics_and_repository(self, stub):
+        stats = stub.ModelStatistics(pb.ModelStatisticsRequest(name="simple"))
+        assert stats.model_stats[0].inference_count >= 1
+        idx = stub.RepositoryIndex(pb.RepositoryIndexRequest())
+        assert any(m.name == "simple" for m in idx.models)
+
+    def test_trace_and_log(self, stub):
+        req = pb.TraceSettingRequest()
+        req.settings["trace_rate"].value.append("7")
+        resp = stub.TraceSetting(req)
+        assert list(resp.settings["trace_rate"].value) == ["7"]
+        clear = pb.TraceSettingRequest()
+        clear.settings["trace_rate"].SetInParent()
+        resp = stub.TraceSetting(clear)
+        assert list(resp.settings["trace_rate"].value) == ["1000"]
+        lreq = pb.LogSettingsRequest()
+        lreq.settings["log_verbose_level"].uint32_param = 2
+        lresp = stub.LogSettings(lreq)
+        assert lresp.settings["log_verbose_level"].uint32_param == 2

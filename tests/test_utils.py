@@ -88,7 +88,15 @@ class TestBytesWireFormat:
 
     def test_empty(self):
         arr = np.array([], dtype=np.object_)
-        assert serialize_byte_tensor(arr).size == 0
+        assert serialize_byte_tensor(arr)[0] == b""
+        assert deserialize_bytes_tensor(b"").size == 0
+
+    def test_truncated_wire_raises(self):
+        good = serialize_byte_tensor(np.array([b"hello"], dtype=np.object_))[0]
+        with pytest.raises(InferenceServerException):
+            deserialize_bytes_tensor(good[:-2])  # element truncated
+        with pytest.raises(InferenceServerException):
+            deserialize_bytes_tensor(good + b"\x01\x02")  # stray trailing bytes
 
     def test_bad_dtype_raises(self):
         with pytest.raises(InferenceServerException):

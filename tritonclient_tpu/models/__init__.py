@@ -1,0 +1,16 @@
+"""JAX/Flax model zoo served by the in-process backend.
+
+- ``simple`` family: behavioral parity with the Triton qa models the
+  reference examples drive (add/sub, string, stateful sequence, decoupled
+  repeat).
+- ``resnet`` / ``bert``: the benchmark models (BASELINE.md targets), built
+  TPU-first in Flax with mesh-sharded variants in tritonclient_tpu.parallel.
+"""
+
+from tritonclient_tpu.models._base import Model, TensorSpec  # noqa: F401
+from tritonclient_tpu.models.simple import (  # noqa: F401
+    RepeatModel,
+    SimpleModel,
+    SimpleSequenceModel,
+    SimpleStringModel,
+)

@@ -1,0 +1,97 @@
+"""Model abstraction served by the JAX backend.
+
+The reference has no server-side model code (it is a client SDK tested against a
+live Triton server, SURVEY.md §4); this base class defines the contract our
+in-process JAX backend executes: jit-compiled functional inference over numpy /
+jax arrays, with optional stateful-sequence and decoupled (multi-response)
+semantics matching the server behaviors the reference clients exercise
+(sequence examples: simple_grpc_sequence_stream_infer_client.py; decoupled:
+simple_grpc_custom_repeat.py).
+"""
+
+from typing import Dict, Iterator, List, Optional, Union
+
+import numpy as np
+
+TensorDict = Dict[str, np.ndarray]
+
+
+class TensorSpec:
+    """Metadata for one model input/output."""
+
+    def __init__(self, name: str, datatype: str, shape: List[int], optional: bool = False):
+        self.name = name
+        self.datatype = datatype
+        self.shape = list(shape)
+        self.optional = optional
+
+    def as_metadata(self) -> dict:
+        return {"name": self.name, "datatype": self.datatype, "shape": self.shape}
+
+    def as_config_io(self) -> dict:
+        return {
+            "name": self.name,
+            "data_type": "TYPE_" + ("STRING" if self.datatype == "BYTES" else self.datatype),
+            "dims": self.shape,
+        }
+
+
+class Model:
+    """Base class for models served by the JAX backend.
+
+    Subclasses set ``name``, ``inputs``, ``outputs`` and implement ``infer``.
+    ``infer`` returns an output dict; decoupled models instead return an
+    iterator of output dicts (each becomes one streamed response).
+    """
+
+    name: str = ""
+    platform: str = "jax"
+    max_batch_size: int = 0  # 0 = no server-side batching dimension
+    decoupled: bool = False
+    stateful: bool = False
+    version: str = "1"
+    labels: Optional[List[str]] = None  # classification label file equivalent
+
+    def __init__(self):
+        self.inputs: List[TensorSpec] = []
+        self.outputs: List[TensorSpec] = []
+        # Merged over config() output by load-with-config-override
+        # (reference: load_model(config=...) grpc/_client.py:651-758).
+        self._config_override: dict = {}
+
+    # -- metadata / config ---------------------------------------------------
+
+    def metadata(self) -> dict:
+        return {
+            "name": self.name,
+            "versions": [self.version],
+            "platform": self.platform,
+            "inputs": [t.as_metadata() for t in self.inputs],
+            "outputs": [t.as_metadata() for t in self.outputs],
+        }
+
+    def config(self) -> dict:
+        cfg = {
+            "name": self.name,
+            "platform": self.platform,
+            "backend": "jax",
+            "max_batch_size": self.max_batch_size,
+            "input": [t.as_config_io() for t in self.inputs],
+            "output": [t.as_config_io() for t in self.outputs],
+        }
+        if self.decoupled:
+            cfg["model_transaction_policy"] = {"decoupled": True}
+        if self.stateful:
+            cfg["sequence_batching"] = {"max_sequence_idle_microseconds": 60000000}
+        cfg.update(self._config_override)
+        return cfg
+
+    # -- execution -----------------------------------------------------------
+
+    def infer(
+        self, inputs: TensorDict, parameters: Optional[dict] = None
+    ) -> Union[TensorDict, Iterator[TensorDict]]:
+        raise NotImplementedError
+
+    def warmup(self) -> None:
+        """Trigger jit compilation ahead of serving (optional)."""

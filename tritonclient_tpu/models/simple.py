@@ -1,0 +1,150 @@
+"""The `simple` model family used throughout the example/test matrix.
+
+Behavioral parity with the Triton qa models the reference clients are written
+against (see reference examples: simple_grpc_infer_client.py — INPUT0+INPUT1 →
+OUTPUT0=sum, OUTPUT1=diff on int32 [1,16]; simple_grpc_string_infer_client.py;
+simple_grpc_sequence_stream_infer_client.py — accumulator keyed by sequence id;
+simple_grpc_custom_repeat.py — decoupled repeat). Compute is jit-compiled JAX.
+"""
+
+import threading
+from typing import Dict, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tritonclient_tpu.models._base import Model, TensorSpec
+
+
+@jax.jit
+def _add_sub(x, y):
+    return x + y, x - y
+
+
+class SimpleModel(Model):
+    """int32 [1,16] add/sub — OUTPUT0 = INPUT0+INPUT1, OUTPUT1 = INPUT0-INPUT1."""
+
+    name = "simple"
+    platform = "jax"
+
+    def __init__(self):
+        super().__init__()
+        self.inputs = [
+            TensorSpec("INPUT0", "INT32", [-1, 16]),
+            TensorSpec("INPUT1", "INT32", [-1, 16]),
+        ]
+        self.outputs = [
+            TensorSpec("OUTPUT0", "INT32", [-1, 16]),
+            TensorSpec("OUTPUT1", "INT32", [-1, 16]),
+        ]
+
+    def infer(self, inputs, parameters=None):
+        s, d = _add_sub(jnp.asarray(inputs["INPUT0"]), jnp.asarray(inputs["INPUT1"]))
+        return {"OUTPUT0": np.asarray(s), "OUTPUT1": np.asarray(d)}
+
+    def warmup(self):
+        z = jnp.zeros((1, 16), jnp.int32)
+        jax.block_until_ready(_add_sub(z, z))
+
+
+class SimpleStringModel(Model):
+    """BYTES [1,16] add/sub: elements are decimal strings; outputs are strings."""
+
+    name = "simple_string"
+
+    def __init__(self):
+        super().__init__()
+        self.inputs = [
+            TensorSpec("INPUT0", "BYTES", [-1, 16]),
+            TensorSpec("INPUT1", "BYTES", [-1, 16]),
+        ]
+        self.outputs = [
+            TensorSpec("OUTPUT0", "BYTES", [-1, 16]),
+            TensorSpec("OUTPUT1", "BYTES", [-1, 16]),
+        ]
+
+    def infer(self, inputs, parameters=None):
+        def to_i32(arr):
+            return np.array(
+                [int(x if not isinstance(x, bytes) else x.decode()) for x in arr.flatten()],
+                dtype=np.int32,
+            ).reshape(arr.shape)
+
+        x = to_i32(inputs["INPUT0"])
+        y = to_i32(inputs["INPUT1"])
+        s, d = _add_sub(jnp.asarray(x), jnp.asarray(y))
+
+        def to_str(a):
+            return np.array([str(int(v)).encode() for v in np.asarray(a).flatten()], dtype=np.object_).reshape(a.shape)
+
+        return {"OUTPUT0": to_str(s), "OUTPUT1": to_str(d)}
+
+
+class SimpleSequenceModel(Model):
+    """Stateful accumulator: per sequence id, OUTPUT accumulates INPUT values.
+
+    Matches the qa sequence model contract the reference's streaming examples
+    exercise (simple_grpc_sequence_stream_infer_client.py:58-80): sequence_start
+    resets the accumulator, each request adds its INPUT, sequence_end releases
+    the slot. int32 [1,1].
+    """
+
+    name = "simple_sequence"
+    stateful = True
+
+    def __init__(self):
+        super().__init__()
+        self.inputs = [TensorSpec("INPUT", "INT32", [-1, 1])]
+        self.outputs = [TensorSpec("OUTPUT", "INT32", [-1, 1])]
+        self._state: Dict[object, np.ndarray] = {}
+        self._lock = threading.Lock()
+
+    def infer(self, inputs, parameters=None):
+        parameters = parameters or {}
+        seq_id = parameters.get("sequence_id", 0)
+        start = bool(parameters.get("sequence_start", False))
+        end = bool(parameters.get("sequence_end", False))
+        value = np.asarray(inputs["INPUT"], dtype=np.int32)
+        with self._lock:
+            if start or seq_id not in self._state:
+                acc = np.zeros_like(value)
+            else:
+                acc = self._state[seq_id]
+            acc = acc + value
+            if end:
+                self._state.pop(seq_id, None)
+            else:
+                self._state[seq_id] = acc
+        return {"OUTPUT": acc}
+
+
+class RepeatModel(Model):
+    """Decoupled model: streams each element of IN as its own response.
+
+    Parity with the repeat_int32 model driven by simple_grpc_custom_repeat.py:
+    inputs IN (values), DELAY (ignored per-response delay), WAIT; produces one
+    response per element, then (under gRPC streaming) a final empty response
+    when `triton_enable_empty_final_response` is requested.
+    """
+
+    name = "repeat_int32"
+    decoupled = True
+
+    def __init__(self):
+        super().__init__()
+        self.inputs = [
+            TensorSpec("IN", "INT32", [-1]),
+            TensorSpec("DELAY", "UINT32", [-1], optional=True),
+            TensorSpec("WAIT", "UINT32", [1], optional=True),
+        ]
+        self.outputs = [TensorSpec("OUT", "INT32", [1])]
+
+    def infer(self, inputs, parameters=None) -> Iterator[dict]:
+        values = np.asarray(inputs["IN"], dtype=np.int32).flatten()
+
+        def gen():
+            for v in values:
+                yield {"OUT": np.array([v], dtype=np.int32)}
+
+        return gen()

@@ -1,0 +1,89 @@
+"""In-process JAX-backed KServe v2 server (hermetic fixture + co-located backend).
+
+The reference repo ships no server and tests against a live Triton
+(SURVEY.md §4); this package is the missing hermetic backend: an
+``InferenceServer`` hosting jit-compiled JAX models behind both HTTP and gRPC
+front-ends, with system and TPU shared-memory planes.
+
+Usage::
+
+    from tritonclient_tpu.server import InferenceServer
+    with InferenceServer() as server:
+        client = tritonclient_tpu.grpc.InferenceServerClient(server.grpc_address)
+        ...
+"""
+
+from typing import Optional, Sequence
+
+from tritonclient_tpu.server._core import (  # noqa: F401
+    CoreError,
+    CoreRequest,
+    CoreRequestedOutput,
+    CoreResponse,
+    CoreTensor,
+    InferenceCore,
+)
+from tritonclient_tpu.server._grpc import GRPCFrontend
+from tritonclient_tpu.server._http import HTTPFrontend
+
+
+def default_models():
+    """The model set matching the reference's example/test matrix."""
+    from tritonclient_tpu.models.simple import (
+        RepeatModel,
+        SimpleModel,
+        SimpleSequenceModel,
+        SimpleStringModel,
+    )
+
+    return [SimpleModel(), SimpleStringModel(), SimpleSequenceModel(), RepeatModel()]
+
+
+class InferenceServer:
+    """Hosts an InferenceCore behind HTTP and/or gRPC on loopback.
+
+    Ports default to 0 (ephemeral); addresses are available after ``start()``.
+    """
+
+    def __init__(
+        self,
+        models: Optional[Sequence] = None,
+        http: bool = True,
+        grpc: bool = True,
+        http_port: int = 0,
+        grpc_port: int = 0,
+        host: str = "127.0.0.1",
+        verbose: bool = False,
+    ):
+        self.core = InferenceCore(models if models is not None else default_models())
+        self._http = (
+            HTTPFrontend(self.core, host, http_port, verbose=verbose) if http else None
+        )
+        self._grpc = GRPCFrontend(self.core, host, grpc_port) if grpc else None
+
+    @property
+    def http_address(self) -> Optional[str]:
+        return self._http.address if self._http else None
+
+    @property
+    def grpc_address(self) -> Optional[str]:
+        return self._grpc.address if self._grpc else None
+
+    def start(self):
+        if self._http:
+            self._http.start()
+        if self._grpc:
+            self._grpc.start()
+        return self
+
+    def stop(self):
+        if self._http:
+            self._http.stop()
+        if self._grpc:
+            self._grpc.stop()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -1,0 +1,718 @@
+"""Transport-neutral inference core for the in-process JAX server.
+
+The reference repo is client-only and relies on a live Triton server for
+integration tests (SURVEY.md §4); this core is the hermetic, JAX-backed
+equivalent of that server's request plane. Both the HTTP and gRPC front-ends
+(tritonclient_tpu.server._http / ._grpc) translate wire requests into
+``CoreRequest`` and back, so protocol behavior (classification extension,
+shared-memory I/O routing, sequence parameters, decoupled responses,
+statistics) lives here exactly once.
+"""
+
+import json
+import mmap
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Union
+
+import numpy as np
+
+from tritonclient_tpu.utils import (
+    deserialize_bytes_tensor,
+    num_elements,
+    serialize_byte_tensor,
+    triton_dtype_size,
+    triton_to_np_dtype,
+)
+
+SERVER_NAME = "triton-tpu"
+SERVER_VERSION = "2.0.0-tpu"
+SERVER_EXTENSIONS = [
+    "classification",
+    "sequence",
+    "model_repository",
+    "model_configuration",
+    "system_shared_memory",
+    "cuda_shared_memory",
+    "tpu_shared_memory",
+    "binary_tensor_data",
+    "parameters",
+    "statistics",
+    "trace",
+    "logging",
+]
+
+
+class CoreError(Exception):
+    """Server-side error with an HTTP-ish status code hint."""
+
+    def __init__(self, msg: str, status: int = 400):
+        super().__init__(msg)
+        self.status = status
+
+
+@dataclass
+class CoreTensor:
+    """One input tensor, either inline data or a shared-memory reference."""
+
+    name: str
+    datatype: str
+    shape: List[int]
+    data: Optional[np.ndarray] = None
+    shm_kind: Optional[str] = None  # "system" | "cuda" | "tpu"
+    shm_region: Optional[str] = None
+    shm_offset: int = 0
+    shm_byte_size: int = 0
+
+
+@dataclass
+class CoreRequestedOutput:
+    name: str
+    binary: bool = True
+    class_count: int = 0
+    shm_kind: Optional[str] = None
+    shm_region: Optional[str] = None
+    shm_offset: int = 0
+    shm_byte_size: int = 0
+
+
+@dataclass
+class CoreRequest:
+    model_name: str
+    model_version: str = ""
+    id: str = ""
+    parameters: dict = field(default_factory=dict)
+    inputs: List[CoreTensor] = field(default_factory=list)
+    outputs: List[CoreRequestedOutput] = field(default_factory=list)
+
+
+@dataclass
+class CoreOutput:
+    name: str
+    datatype: str
+    shape: List[int]
+    data: Optional[np.ndarray] = None  # None when routed to shared memory
+    shm_kind: Optional[str] = None
+    shm_region: Optional[str] = None
+    shm_offset: int = 0
+    shm_byte_size: int = 0
+
+
+@dataclass
+class CoreResponse:
+    model_name: str
+    model_version: str = "1"
+    id: str = ""
+    parameters: dict = field(default_factory=dict)
+    outputs: List[CoreOutput] = field(default_factory=list)
+
+
+# --------------------------------------------------------------------------- #
+# shared-memory registries (server side)                                      #
+# --------------------------------------------------------------------------- #
+
+
+class SystemShmRegistry:
+    """Server-side registry of POSIX shared-memory regions.
+
+    The client creates regions via shm_open (utils/shared_memory); the server
+    maps the same key through /dev/shm. Only registration metadata ever crosses
+    the wire — tensor bytes move through the mapping (reference architecture:
+    SURVEY.md §5.8).
+    """
+
+    def __init__(self):
+        self._regions: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    def register(self, name: str, key: str, offset: int, byte_size: int):
+        path = "/dev/shm/" + key.lstrip("/")
+        try:
+            fd = os.open(path, os.O_RDWR)
+        except OSError as e:
+            raise CoreError(
+                f"Unable to open shared memory region: '{name}' ({e})", 400
+            )
+        try:
+            mm = mmap.mmap(fd, 0)
+        finally:
+            os.close(fd)
+        with self._lock:
+            if name in self._regions:
+                old = self._regions.pop(name)
+                old["mmap"].close()
+            self._regions[name] = {
+                "name": name,
+                "key": key,
+                "offset": int(offset),
+                "byte_size": int(byte_size),
+                "mmap": mm,
+            }
+
+    def unregister(self, name: Optional[str]):
+        with self._lock:
+            names = [name] if name else list(self._regions)
+            for n in names:
+                region = self._regions.pop(n, None)
+                if region is not None:
+                    region["mmap"].close()
+
+    def status(self, name: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            regions = (
+                [self._regions[name]] if name and name in self._regions
+                else ([] if name else list(self._regions.values()))
+            )
+            return [
+                {k: r[k] for k in ("name", "key", "offset", "byte_size")}
+                for r in regions
+            ]
+
+    def read(self, name: str, offset: int, nbytes: int) -> bytes:
+        with self._lock:
+            region = self._regions.get(name)
+        if region is None:
+            raise CoreError(f"Unable to find shared memory region: '{name}'", 400)
+        base = region["offset"] + offset
+        if base + nbytes > len(region["mmap"]):
+            raise CoreError(
+                f"Invalid offset + byte size for shared memory region: '{name}'", 400
+            )
+        return bytes(region["mmap"][base : base + nbytes])
+
+    def write(self, name: str, offset: int, data: bytes):
+        with self._lock:
+            region = self._regions.get(name)
+        if region is None:
+            raise CoreError(f"Unable to find shared memory region: '{name}'", 400)
+        base = region["offset"] + offset
+        if base + len(data) > len(region["mmap"]):
+            raise CoreError(
+                f"Shared memory region '{name}' is too small for output", 400
+            )
+        region["mmap"][base : base + len(data)] = data
+
+
+class TpuShmRegistry:
+    """Server-side registry for the TPU zero-copy plane.
+
+    Regions live in a process-global table owned by
+    ``tritonclient_tpu.utils.tpu_shared_memory`` (the PjRt analog of cudaIpc:
+    co-location means the same process/PjRt client — SURVEY.md §7 hard part 1).
+    Registration resolves the client's raw handle against that table; reads and
+    writes then move jax.Array data without host staging when possible.
+    """
+
+    def __init__(self):
+        self._regions: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    def register(self, name: str, raw_handle: bytes, device_id: int, byte_size: int):
+        try:
+            from tritonclient_tpu.utils import tpu_shared_memory as tpushm
+        except ImportError as e:  # pragma: no cover
+            raise CoreError(f"TPU shared memory support unavailable: {e}", 400)
+
+        region = tpushm._resolve_raw_handle(raw_handle)
+        if region is None:
+            raise CoreError(
+                f"Unable to resolve TPU shared memory handle for region: '{name}'", 400
+            )
+        with self._lock:
+            self._regions[name] = {
+                "name": name,
+                "device_id": int(device_id),
+                "byte_size": int(byte_size),
+                "region": region,
+            }
+
+    def unregister(self, name: Optional[str]):
+        with self._lock:
+            if name:
+                self._regions.pop(name, None)
+            else:
+                self._regions.clear()
+
+    def status(self, name: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            regions = (
+                [self._regions[name]] if name and name in self._regions
+                else ([] if name else list(self._regions.values()))
+            )
+            return [
+                {k: r[k] for k in ("name", "device_id", "byte_size")} for r in regions
+            ]
+
+    def get_region(self, name: str):
+        with self._lock:
+            entry = self._regions.get(name)
+        if entry is None:
+            raise CoreError(f"Unable to find shared memory region: '{name}'", 400)
+        return entry["region"]
+
+    def read(self, name: str, offset: int, nbytes: int) -> bytes:
+        return self.get_region(name).read_bytes(offset, nbytes)
+
+    def write(self, name: str, offset: int, data: bytes):
+        self.get_region(name).write_bytes(offset, data)
+
+    def read_array(self, name: str, datatype: str, shape: List[int], offset: int):
+        """Zero-copy typed read: a jax.Array view over the region."""
+        return self.get_region(name).as_array(datatype, shape, offset)
+
+    def write_array(self, name: str, array, offset: int):
+        """Zero-copy typed write: park a jax.Array in the region."""
+        self.get_region(name).set_array(array, offset)
+
+
+# --------------------------------------------------------------------------- #
+# statistics                                                                  #
+# --------------------------------------------------------------------------- #
+
+
+class _ModelStats:
+    def __init__(self):
+        self.inference_count = 0
+        self.execution_count = 0
+        self.last_inference = 0
+        self.success_count = 0
+        self.success_ns = 0
+        self.fail_count = 0
+        self.fail_ns = 0
+        self.queue_ns = 0
+        self.compute_input_ns = 0
+        self.compute_infer_ns = 0
+        self.compute_output_ns = 0
+
+    def as_dict(self, name: str, version: str) -> dict:
+        return {
+            "name": name,
+            "version": version,
+            "last_inference": self.last_inference,
+            "inference_count": self.inference_count,
+            "execution_count": self.execution_count,
+            "inference_stats": {
+                "success": {"count": self.success_count, "ns": self.success_ns},
+                "fail": {"count": self.fail_count, "ns": self.fail_ns},
+                "queue": {"count": self.success_count, "ns": self.queue_ns},
+                "compute_input": {
+                    "count": self.success_count,
+                    "ns": self.compute_input_ns,
+                },
+                "compute_infer": {
+                    "count": self.success_count,
+                    "ns": self.compute_infer_ns,
+                },
+                "compute_output": {
+                    "count": self.success_count,
+                    "ns": self.compute_output_ns,
+                },
+                "cache_hit": {"count": 0, "ns": 0},
+                "cache_miss": {"count": 0, "ns": 0},
+            },
+            "batch_stats": [],
+        }
+
+
+_DEFAULT_TRACE_SETTINGS = {
+    "trace_level": ["OFF"],
+    "trace_rate": ["1000"],
+    "trace_count": ["-1"],
+    "log_frequency": ["0"],
+    "trace_file": [""],
+    "trace_mode": ["triton"],
+}
+
+_DEFAULT_LOG_SETTINGS = {
+    "log_file": "",
+    "log_info": True,
+    "log_warning": True,
+    "log_error": True,
+    "log_verbose_level": 0,
+    "log_format": "default",
+}
+
+
+# --------------------------------------------------------------------------- #
+# the core                                                                    #
+# --------------------------------------------------------------------------- #
+
+
+class InferenceCore:
+    """Model repository + executor + admin surface, shared by both transports."""
+
+    def __init__(self, models=None, server_name: str = SERVER_NAME):
+        self.server_name = server_name
+        self.server_version = SERVER_VERSION
+        self.extensions = list(SERVER_EXTENSIONS)
+        self._repository: Dict[str, object] = {}
+        self._loaded: Dict[str, bool] = {}
+        self._stats: Dict[str, _ModelStats] = {}
+        self._lock = threading.Lock()
+        self.system_shm = SystemShmRegistry()
+        self.tpu_shm = TpuShmRegistry()
+        self._trace_settings: Dict[str, dict] = {"": dict(_DEFAULT_TRACE_SETTINGS)}
+        self._log_settings = dict(_DEFAULT_LOG_SETTINGS)
+        for model in models or []:
+            self.add_model(model)
+
+    # -- repository ----------------------------------------------------------
+
+    def add_model(self, model, loaded: bool = True):
+        self._repository[model.name] = model
+        self._loaded[model.name] = loaded
+        self._stats.setdefault(model.name, _ModelStats())
+
+    def _get_model(self, name: str, version: str = ""):
+        model = self._repository.get(name)
+        if model is None:
+            raise CoreError(f"Request for unknown model: '{name}'", 404)
+        if not self._loaded.get(name, False):
+            raise CoreError(
+                f"Request for unknown model: '{name}' is not ready", 400
+            )
+        if version not in ("", model.version):
+            raise CoreError(
+                f"Request for unknown model version: '{name}' version {version}", 400
+            )
+        return model
+
+    def is_server_live(self) -> bool:
+        return True
+
+    def is_server_ready(self) -> bool:
+        return True
+
+    def is_model_ready(self, name: str, version: str = "") -> bool:
+        model = self._repository.get(name)
+        if model is None:
+            raise CoreError(f"Request for unknown model: '{name}'", 400)
+        return bool(self._loaded.get(name, False))
+
+    def server_metadata(self) -> dict:
+        return {
+            "name": self.server_name,
+            "version": self.server_version,
+            "extensions": self.extensions,
+        }
+
+    def model_metadata(self, name: str, version: str = "") -> dict:
+        return self._get_model(name, version).metadata()
+
+    def model_config(self, name: str, version: str = "") -> dict:
+        return self._get_model(name, version).config()
+
+    def repository_index(self, ready: bool = False) -> List[dict]:
+        out = []
+        for name, model in sorted(self._repository.items()):
+            is_ready = self._loaded.get(name, False)
+            if ready and not is_ready:
+                continue
+            out.append(
+                {
+                    "name": name,
+                    "version": model.version,
+                    "state": "READY" if is_ready else "UNAVAILABLE",
+                    "reason": "",
+                }
+            )
+        return out
+
+    def load_model(self, name: str, parameters: Optional[dict] = None):
+        model = self._repository.get(name)
+        if model is None:
+            raise CoreError(f"failed to load '{name}', no such model", 400)
+        parameters = parameters or {}
+        config_override = parameters.get("config")
+        if config_override:
+            try:
+                override = json.loads(config_override)
+            except (TypeError, ValueError):
+                raise CoreError(f"failed to load '{name}': invalid config override", 400)
+            model._config_override = override
+        # File-override parameters ("file:<path>" keys) are accepted for API
+        # parity; the JAX backend has no on-disk model files to replace.
+        self._loaded[name] = True
+        if hasattr(model, "warmup"):
+            model.warmup()
+
+    def unload_model(self, name: str, parameters: Optional[dict] = None):
+        if name not in self._repository:
+            raise CoreError(f"failed to unload '{name}', no such model", 400)
+        self._loaded[name] = False
+
+    def model_statistics(self, name: str = "", version: str = "") -> List[dict]:
+        if name:
+            model = self._get_model(name, version)
+            return [self._stats[name].as_dict(name, model.version)]
+        return [
+            self._stats[n].as_dict(n, m.version)
+            for n, m in sorted(self._repository.items())
+            if self._loaded.get(n, False)
+        ]
+
+    # -- trace / log settings ------------------------------------------------
+
+    def update_trace_settings(self, model_name: str = "", settings: Optional[dict] = None) -> dict:
+        current = self._trace_settings.setdefault(
+            model_name, dict(self._trace_settings[""])
+        )
+        for key, value in (settings or {}).items():
+            if key in ("trace_level", "trace_rate", "trace_count", "log_frequency", "trace_file", "trace_mode"):
+                if value is None:
+                    # Clear: fall back to global (or default for the global scope).
+                    current[key] = (
+                        list(_DEFAULT_TRACE_SETTINGS[key])
+                        if model_name == ""
+                        else list(self._trace_settings[""][key])
+                    )
+                else:
+                    current[key] = [str(v) for v in value] if isinstance(value, (list, tuple)) else [str(value)]
+            else:
+                raise CoreError(f"Unknown trace setting: '{key}'", 400)
+        return dict(current)
+
+    def get_trace_settings(self, model_name: str = "") -> dict:
+        return dict(self._trace_settings.get(model_name, self._trace_settings[""]))
+
+    def update_log_settings(self, settings: Optional[dict] = None) -> dict:
+        for key, value in (settings or {}).items():
+            if key not in self._log_settings:
+                raise CoreError(f"Unknown log setting: '{key}'", 400)
+            if value is not None:
+                self._log_settings[key] = value
+        return dict(self._log_settings)
+
+    def get_log_settings(self) -> dict:
+        return dict(self._log_settings)
+
+    # -- shared memory admin -------------------------------------------------
+
+    def shm_registry(self, kind: str):
+        if kind == "system":
+            return self.system_shm
+        if kind == "tpu":
+            return self.tpu_shm
+        raise CoreError(f"Unsupported shared memory kind: '{kind}'", 400)
+
+    def find_shm_kind(self, region: str) -> str:
+        """Which registry holds a region name (system first, then tpu)."""
+        if self.system_shm.status(region):
+            return "system"
+        if self.tpu_shm.status(region):
+            return "tpu"
+        return "system"
+
+    # -- inference -----------------------------------------------------------
+
+    def infer(
+        self, request: CoreRequest
+    ) -> Union[CoreResponse, Iterator[CoreResponse]]:
+        model = self._get_model(request.model_name, request.model_version)
+        stats = self._stats[request.model_name]
+        t_start = time.monotonic_ns()
+
+        # Resolve inputs (shm reads / typed views happen here).
+        inputs: Dict[str, np.ndarray] = {}
+        for tensor in request.inputs:
+            inputs[tensor.name] = self._resolve_input(tensor)
+        t_input = time.monotonic_ns()
+
+        declared = {spec.name: spec for spec in model.inputs}
+        for spec in model.inputs:
+            if not spec.optional and spec.name not in inputs:
+                raise CoreError(
+                    f"expected {len(model.inputs)} inputs but got "
+                    f"{len(inputs)} inputs for model '{model.name}'",
+                    400,
+                )
+        for name in inputs:
+            if declared and name not in declared:
+                raise CoreError(
+                    f"unexpected inference input '{name}' for model '{model.name}'",
+                    400,
+                )
+
+        try:
+            result = model.infer(inputs, dict(request.parameters))
+        except CoreError:
+            self._record_failure(stats, t_start)
+            raise
+        except Exception as e:  # surface model errors as protocol errors
+            self._record_failure(stats, t_start)
+            raise CoreError(f"inference failed for model '{model.name}': {e}", 500)
+        t_infer = time.monotonic_ns()
+
+        if model.decoupled and not isinstance(result, dict):
+            return self._decoupled_responses(model, request, result, stats, t_start)
+
+        if not isinstance(result, dict):
+            result = dict(result)
+        response = self._build_response(model, request, result)
+        t_end = time.monotonic_ns()
+        with self._lock:
+            stats.inference_count += 1
+            stats.execution_count += 1
+            stats.last_inference = int(time.time() * 1000)
+            stats.success_count += 1
+            stats.success_ns += t_end - t_start
+            stats.compute_input_ns += t_input - t_start
+            stats.compute_infer_ns += t_infer - t_input
+            stats.compute_output_ns += t_end - t_infer
+        return response
+
+    def _record_failure(self, stats, t_start):
+        with self._lock:
+            stats.fail_count += 1
+            stats.fail_ns += time.monotonic_ns() - t_start
+
+    def _decoupled_responses(self, model, request, result_iter, stats, t_start):
+        def gen():
+            count = 0
+            for result in result_iter:
+                count += 1
+                yield self._build_response(model, request, result)
+            t_end = time.monotonic_ns()
+            with self._lock:
+                stats.inference_count += 1
+                stats.execution_count += count
+                stats.last_inference = int(time.time() * 1000)
+                stats.success_count += 1
+                stats.success_ns += t_end - t_start
+
+        return gen()
+
+    def _resolve_input(self, tensor: CoreTensor) -> np.ndarray:
+        if tensor.shm_region is not None:
+            registry = self.shm_registry(tensor.shm_kind or "system")
+            if tensor.shm_kind == "tpu" and tensor.datatype != "BYTES":
+                # Zero-copy typed view straight off the device buffer.
+                return registry.read_array(
+                    tensor.shm_region, tensor.datatype, tensor.shape, tensor.shm_offset
+                )
+            raw = registry.read(
+                tensor.shm_region, tensor.shm_offset, tensor.shm_byte_size
+            )
+            return self._decode_raw(tensor.datatype, tensor.shape, raw)
+        if tensor.data is None:
+            raise CoreError(f"no data provided for input '{tensor.name}'", 400)
+        return tensor.data
+
+    @staticmethod
+    def _decode_raw(datatype: str, shape: List[int], raw: bytes) -> np.ndarray:
+        if datatype == "BYTES":
+            arr = deserialize_bytes_tensor(raw)
+            return arr.reshape(shape)
+        np_dtype = triton_to_np_dtype(datatype)
+        if np_dtype is None:
+            raise CoreError(f"unsupported datatype '{datatype}'", 400)
+        expected = num_elements(shape) * triton_dtype_size(datatype)
+        if len(raw) != expected:
+            raise CoreError(
+                f"unexpected total byte size {len(raw)} for input "
+                f"(expected {expected})",
+                400,
+            )
+        return np.frombuffer(raw, dtype=np_dtype).reshape(shape)
+
+    def _build_response(self, model, request: CoreRequest, result: dict) -> CoreResponse:
+        requested = {r.name: r for r in request.outputs}
+        out_specs = {spec.name: spec for spec in model.outputs}
+        names = list(requested) if requested else list(result)
+        outputs = []
+        for name in names:
+            if name not in result:
+                raise CoreError(
+                    f"unexpected inference output '{name}' for model '{model.name}'",
+                    400,
+                )
+            array = result[name]
+            req = requested.get(name)
+            spec = out_specs.get(name)
+            datatype = spec.datatype if spec is not None else None
+
+            if req is not None and req.class_count > 0:
+                array, datatype = self._classify(array, req.class_count, model.labels)
+            else:
+                array = np.asarray(array) if not hasattr(array, "dtype") else array
+                if datatype is None or datatype == "BYTES":
+                    from tritonclient_tpu.utils import np_to_triton_dtype
+
+                    datatype = np_to_triton_dtype(np.asarray(array).dtype)
+
+            shape = list(np.asarray(array).shape)
+            if req is not None and req.shm_region is not None:
+                registry = self.shm_registry(req.shm_kind or "system")
+                if req.shm_kind == "tpu" and datatype != "BYTES":
+                    registry.write_array(req.shm_region, array, req.shm_offset)
+                    nbytes = np.asarray(array).nbytes
+                else:
+                    raw = self._encode_raw(datatype, np.asarray(array))
+                    nbytes = len(raw)
+                    if req.shm_byte_size and nbytes > req.shm_byte_size:
+                        raise CoreError(
+                            f"shared memory region '{req.shm_region}' is too small "
+                            f"for output '{name}' ({nbytes} > {req.shm_byte_size})",
+                            400,
+                        )
+                    registry.write(req.shm_region, req.shm_offset, raw)
+                outputs.append(
+                    CoreOutput(
+                        name=name,
+                        datatype=datatype,
+                        shape=shape,
+                        data=None,
+                        shm_kind=req.shm_kind,
+                        shm_region=req.shm_region,
+                        shm_offset=req.shm_offset,
+                        shm_byte_size=nbytes,
+                    )
+                )
+            else:
+                outputs.append(
+                    CoreOutput(
+                        name=name,
+                        datatype=datatype,
+                        shape=shape,
+                        data=np.asarray(array),
+                    )
+                )
+        return CoreResponse(
+            model_name=model.name,
+            model_version=model.version,
+            id=request.id,
+            outputs=outputs,
+        )
+
+    @staticmethod
+    def _encode_raw(datatype: str, array: np.ndarray) -> bytes:
+        if datatype == "BYTES":
+            return serialize_byte_tensor(array)[0]
+        np_dtype = triton_to_np_dtype(datatype)
+        return np.ascontiguousarray(array.astype(np_dtype, copy=False)).tobytes()
+
+    @staticmethod
+    def _classify(array, class_count: int, labels) -> tuple:
+        """Classification extension: top-k as BYTES "value:index[:label]".
+
+        Matches the Triton classification output format the reference's
+        image_client.py postprocesses (image_client.py:60-217).
+        """
+        array = np.asarray(array)
+        if array.ndim == 1:
+            array = array[None, :]
+        lead_shape = array.shape[:-1]
+        flat = array.reshape(-1, array.shape[-1])
+        k = min(class_count, flat.shape[1])
+        rows = []
+        for row in flat:
+            top = np.argsort(-row)[:k]
+            for idx in top:
+                entry = f"{row[idx]:f}:{idx}"
+                if labels and idx < len(labels):
+                    entry += f":{labels[idx]}"
+                rows.append(entry.encode())
+        out = np.array(rows, dtype=np.object_).reshape(*lead_shape, k)
+        return out, "BYTES"

@@ -1,0 +1,472 @@
+"""gRPC front-end for the in-process JAX server.
+
+Implements inference.GRPCInferenceService over the InferenceCore, including
+bidirectional ModelStreamInfer with decoupled-model fan-out and the
+``triton_enable_empty_final_response`` / ``triton_final_response`` parameter
+contract the reference's streaming clients rely on (grpc/_client.py:1921-1923).
+"""
+
+from concurrent import futures
+from typing import Optional
+
+import grpc
+import numpy as np
+
+from tritonclient_tpu.protocol import make_service_handler, pb
+from tritonclient_tpu.server._core import (
+    CoreError,
+    CoreRequest,
+    CoreRequestedOutput,
+    CoreResponse,
+    CoreTensor,
+    InferenceCore,
+)
+from tritonclient_tpu.utils import serialize_byte_tensor
+
+_MAX_MESSAGE_LENGTH = 2**31 - 1  # INT32_MAX parity (grpc/_client.py:50-55)
+
+
+def _param_value(p: pb.InferParameter):
+    which = p.WhichOneof("parameter_choice")
+    return getattr(p, which) if which else None
+
+
+def _set_param(params, key, value):
+    if isinstance(value, bool):
+        params[key].bool_param = value
+    elif isinstance(value, int):
+        params[key].int64_param = value
+    elif isinstance(value, float):
+        params[key].double_param = value
+    else:
+        params[key].string_param = str(value)
+
+
+def _status_for(e: CoreError) -> grpc.StatusCode:
+    return {
+        404: grpc.StatusCode.NOT_FOUND,
+        400: grpc.StatusCode.INVALID_ARGUMENT,
+        500: grpc.StatusCode.INTERNAL,
+    }.get(e.status, grpc.StatusCode.UNKNOWN)
+
+
+def request_to_core(request: pb.ModelInferRequest, core: InferenceCore) -> CoreRequest:
+    creq = CoreRequest(
+        model_name=request.model_name,
+        model_version=request.model_version,
+        id=request.id,
+        parameters={k: _param_value(v) for k, v in request.parameters.items()},
+    )
+    raw = list(request.raw_input_contents)
+    use_raw = len(raw) > 0
+    for i, tensor in enumerate(request.inputs):
+        ct = CoreTensor(
+            name=tensor.name,
+            datatype=tensor.datatype,
+            shape=list(tensor.shape),
+        )
+        params = {k: _param_value(v) for k, v in tensor.parameters.items()}
+        if "shared_memory_region" in params:
+            ct.shm_region = params["shared_memory_region"]
+            ct.shm_offset = int(params.get("shared_memory_offset", 0))
+            ct.shm_byte_size = int(params.get("shared_memory_byte_size", 0))
+            ct.shm_kind = core.find_shm_kind(ct.shm_region)
+        elif use_raw:
+            if i < len(raw):
+                ct.data = InferenceCore._decode_raw(ct.datatype, ct.shape, raw[i])
+        else:
+            ct.data = _contents_to_array(tensor)
+        creq.inputs.append(ct)
+    for out in request.outputs:
+        params = {k: _param_value(v) for k, v in out.parameters.items()}
+        co = CoreRequestedOutput(
+            name=out.name,
+            class_count=int(params.get("classification", 0)),
+        )
+        if "shared_memory_region" in params:
+            co.shm_region = params["shared_memory_region"]
+            co.shm_offset = int(params.get("shared_memory_offset", 0))
+            co.shm_byte_size = int(params.get("shared_memory_byte_size", 0))
+            co.shm_kind = core.find_shm_kind(co.shm_region)
+        creq.outputs.append(co)
+    return creq
+
+
+def _contents_to_array(tensor: pb.ModelInferRequest.InferInputTensor) -> np.ndarray:
+    """Decode the typed `contents` fields (non-raw path)."""
+    c = tensor.contents
+    dt = tensor.datatype
+    shape = list(tensor.shape)
+    if dt == "BOOL":
+        return np.array(c.bool_contents, dtype=np.bool_).reshape(shape)
+    if dt in ("INT8", "INT16", "INT32"):
+        from tritonclient_tpu.utils import triton_to_np_dtype
+
+        return np.array(c.int_contents, dtype=triton_to_np_dtype(dt)).reshape(shape)
+    if dt == "INT64":
+        return np.array(c.int64_contents, dtype=np.int64).reshape(shape)
+    if dt in ("UINT8", "UINT16", "UINT32"):
+        from tritonclient_tpu.utils import triton_to_np_dtype
+
+        return np.array(c.uint_contents, dtype=triton_to_np_dtype(dt)).reshape(shape)
+    if dt == "UINT64":
+        return np.array(c.uint64_contents, dtype=np.uint64).reshape(shape)
+    if dt in ("FP32", "FP16", "BF16"):
+        from tritonclient_tpu.utils import triton_to_np_dtype
+
+        return np.array(c.fp32_contents, dtype=np.float32).astype(triton_to_np_dtype(dt)).reshape(shape)
+    if dt == "FP64":
+        return np.array(c.fp64_contents, dtype=np.float64).reshape(shape)
+    if dt == "BYTES":
+        return np.array(list(c.bytes_contents), dtype=np.object_).reshape(shape)
+    raise CoreError(f"unsupported datatype '{dt}'", 400)
+
+
+def core_to_response(cresp: CoreResponse) -> pb.ModelInferResponse:
+    resp = pb.ModelInferResponse(
+        model_name=cresp.model_name,
+        model_version=cresp.model_version,
+        id=cresp.id,
+    )
+    for key, value in cresp.parameters.items():
+        _set_param(resp.parameters, key, value)
+    for out in cresp.outputs:
+        t = resp.outputs.add()
+        t.name = out.name
+        t.datatype = out.datatype
+        t.shape.extend(out.shape)
+        if out.shm_region is not None:
+            t.parameters["shared_memory_region"].string_param = out.shm_region
+            t.parameters["shared_memory_offset"].int64_param = out.shm_offset
+            t.parameters["shared_memory_byte_size"].int64_param = out.shm_byte_size
+            resp.raw_output_contents.append(b"")
+        else:
+            if out.datatype == "BYTES":
+                raw = serialize_byte_tensor(out.data)[0]
+            else:
+                raw = InferenceCore._encode_raw(out.datatype, out.data)
+            resp.raw_output_contents.append(raw)
+    return resp
+
+
+class _Servicer:
+    def __init__(self, core: InferenceCore):
+        self.core = core
+
+    # -- health / metadata ---------------------------------------------------
+
+    def ServerLive(self, request, context):
+        return pb.ServerLiveResponse(live=self.core.is_server_live())
+
+    def ServerReady(self, request, context):
+        return pb.ServerReadyResponse(ready=self.core.is_server_ready())
+
+    def ModelReady(self, request, context):
+        try:
+            return pb.ModelReadyResponse(
+                ready=self.core.is_model_ready(request.name, request.version)
+            )
+        except CoreError as e:
+            context.abort(_status_for(e), str(e))
+
+    def ServerMetadata(self, request, context):
+        md = self.core.server_metadata()
+        return pb.ServerMetadataResponse(
+            name=md["name"], version=md["version"], extensions=md["extensions"]
+        )
+
+    def ModelMetadata(self, request, context):
+        try:
+            md = self.core.model_metadata(request.name, request.version)
+        except CoreError as e:
+            context.abort(_status_for(e), str(e))
+        resp = pb.ModelMetadataResponse(
+            name=md["name"], versions=md["versions"], platform=md["platform"]
+        )
+        for io_key, target in (("inputs", resp.inputs), ("outputs", resp.outputs)):
+            for t in md[io_key]:
+                entry = target.add()
+                entry.name = t["name"]
+                entry.datatype = t["datatype"]
+                entry.shape.extend(t["shape"])
+        return resp
+
+    def ModelConfig(self, request, context):
+        try:
+            cfg = self.core.model_config(request.name, request.version)
+        except CoreError as e:
+            context.abort(_status_for(e), str(e))
+        resp = pb.ModelConfigResponse()
+        c = resp.config
+        c.name = cfg["name"]
+        c.platform = cfg.get("platform", "")
+        c.backend = cfg.get("backend", "")
+        c.max_batch_size = cfg.get("max_batch_size", 0)
+        for io_key, target in (("input", c.input), ("output", c.output)):
+            for t in cfg.get(io_key, []):
+                entry = target.add()
+                entry.name = t["name"]
+                entry.data_type = pb.DataType.Value(t["data_type"])
+                entry.dims.extend(t["dims"])
+        if cfg.get("model_transaction_policy", {}).get("decoupled"):
+            c.model_transaction_policy.decoupled = True
+        if "sequence_batching" in cfg:
+            c.sequence_batching.max_sequence_idle_microseconds = cfg[
+                "sequence_batching"
+            ].get("max_sequence_idle_microseconds", 0)
+        return resp
+
+    # -- statistics / repository ---------------------------------------------
+
+    def ModelStatistics(self, request, context):
+        try:
+            stats = self.core.model_statistics(request.name, request.version)
+        except CoreError as e:
+            context.abort(_status_for(e), str(e))
+        resp = pb.ModelStatisticsResponse()
+        for s in stats:
+            entry = resp.model_stats.add()
+            entry.name = s["name"]
+            entry.version = s["version"]
+            entry.last_inference = s["last_inference"]
+            entry.inference_count = s["inference_count"]
+            entry.execution_count = s["execution_count"]
+            inf = s["inference_stats"]
+            for key in (
+                "success",
+                "fail",
+                "queue",
+                "compute_input",
+                "compute_infer",
+                "compute_output",
+                "cache_hit",
+                "cache_miss",
+            ):
+                d = getattr(entry.inference_stats, key)
+                d.count = inf[key]["count"]
+                d.ns = inf[key]["ns"]
+        return resp
+
+    def RepositoryIndex(self, request, context):
+        resp = pb.RepositoryIndexResponse()
+        for m in self.core.repository_index(request.ready):
+            entry = resp.models.add()
+            entry.name = m["name"]
+            entry.version = m["version"]
+            entry.state = m["state"]
+            entry.reason = m["reason"]
+        return resp
+
+    def RepositoryModelLoad(self, request, context):
+        params = {}
+        for k, v in request.parameters.items():
+            which = v.WhichOneof("parameter_choice")
+            params[k] = getattr(v, which) if which else None
+        try:
+            self.core.load_model(request.model_name, params)
+        except CoreError as e:
+            context.abort(_status_for(e), str(e))
+        return pb.RepositoryModelLoadResponse()
+
+    def RepositoryModelUnload(self, request, context):
+        try:
+            self.core.unload_model(request.model_name)
+        except CoreError as e:
+            context.abort(_status_for(e), str(e))
+        return pb.RepositoryModelUnloadResponse()
+
+    # -- shared memory admin -------------------------------------------------
+
+    def SystemSharedMemoryStatus(self, request, context):
+        resp = pb.SystemSharedMemoryStatusResponse()
+        regions = self.core.system_shm.status(request.name or None)
+        if request.name and not regions:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"Unable to find system shared memory region: '{request.name}'",
+            )
+        for r in regions:
+            status = resp.regions[r["name"]]
+            status.name = r["name"]
+            status.key = r["key"]
+            status.offset = r["offset"]
+            status.byte_size = r["byte_size"]
+        return resp
+
+    def SystemSharedMemoryRegister(self, request, context):
+        try:
+            self.core.system_shm.register(
+                request.name, request.key, request.offset, request.byte_size
+            )
+        except CoreError as e:
+            context.abort(_status_for(e), str(e))
+        return pb.SystemSharedMemoryRegisterResponse()
+
+    def SystemSharedMemoryUnregister(self, request, context):
+        self.core.system_shm.unregister(request.name or None)
+        return pb.SystemSharedMemoryUnregisterResponse()
+
+    def CudaSharedMemoryStatus(self, request, context):
+        context.abort(
+            grpc.StatusCode.UNIMPLEMENTED,
+            "CUDA shared memory is not supported by the TPU backend; "
+            "use TpuSharedMemory*",
+        )
+
+    def CudaSharedMemoryRegister(self, request, context):
+        context.abort(
+            grpc.StatusCode.UNIMPLEMENTED,
+            "CUDA shared memory is not supported by the TPU backend; "
+            "use TpuSharedMemory*",
+        )
+
+    def CudaSharedMemoryUnregister(self, request, context):
+        context.abort(
+            grpc.StatusCode.UNIMPLEMENTED,
+            "CUDA shared memory is not supported by the TPU backend; "
+            "use TpuSharedMemory*",
+        )
+
+    def TpuSharedMemoryStatus(self, request, context):
+        resp = pb.TpuSharedMemoryStatusResponse()
+        regions = self.core.tpu_shm.status(request.name or None)
+        if request.name and not regions:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"Unable to find TPU shared memory region: '{request.name}'",
+            )
+        for r in regions:
+            status = resp.regions[r["name"]]
+            status.name = r["name"]
+            status.device_id = r["device_id"]
+            status.byte_size = r["byte_size"]
+        return resp
+
+    def TpuSharedMemoryRegister(self, request, context):
+        try:
+            self.core.tpu_shm.register(
+                request.name, request.raw_handle, request.device_id, request.byte_size
+            )
+        except CoreError as e:
+            context.abort(_status_for(e), str(e))
+        return pb.TpuSharedMemoryRegisterResponse()
+
+    def TpuSharedMemoryUnregister(self, request, context):
+        self.core.tpu_shm.unregister(request.name or None)
+        return pb.TpuSharedMemoryUnregisterResponse()
+
+    # -- trace / log settings ------------------------------------------------
+
+    def TraceSetting(self, request, context):
+        settings = {}
+        for k, v in request.settings.items():
+            settings[k] = list(v.value) if len(v.value) else None
+        try:
+            result = self.core.update_trace_settings(request.model_name, settings)
+        except CoreError as e:
+            context.abort(_status_for(e), str(e))
+        resp = pb.TraceSettingResponse()
+        for k, values in result.items():
+            resp.settings[k].value.extend([str(x) for x in values])
+        return resp
+
+    def LogSettings(self, request, context):
+        settings = {}
+        for k, v in request.settings.items():
+            which = v.WhichOneof("parameter_choice")
+            settings[k] = getattr(v, which) if which else None
+        try:
+            result = self.core.update_log_settings(settings)
+        except CoreError as e:
+            context.abort(_status_for(e), str(e))
+        resp = pb.LogSettingsResponse()
+        for k, v in result.items():
+            if isinstance(v, bool):
+                resp.settings[k].bool_param = v
+            elif isinstance(v, int):
+                resp.settings[k].uint32_param = v
+            else:
+                resp.settings[k].string_param = str(v)
+        return resp
+
+    # -- inference -----------------------------------------------------------
+
+    def ModelInfer(self, request, context):
+        try:
+            creq = request_to_core(request, self.core)
+            cresp = self.core.infer(creq)
+            if not isinstance(cresp, CoreResponse):
+                responses = list(cresp)
+                if len(responses) != 1:
+                    raise CoreError(
+                        "ModelInfer on a decoupled model must produce exactly "
+                        f"one response (got {len(responses)}); use ModelStreamInfer",
+                        400,
+                    )
+                cresp = responses[0]
+            return core_to_response(cresp)
+        except CoreError as e:
+            context.abort(_status_for(e), str(e))
+
+    def ModelStreamInfer(self, request_iterator, context):
+        for request in request_iterator:
+            want_final = False
+            p = request.parameters.get("triton_enable_empty_final_response")
+            if p is not None and p.WhichOneof("parameter_choice"):
+                want_final = bool(_param_value(p))
+            try:
+                creq = request_to_core(request, self.core)
+                cresp = self.core.infer(creq)
+                if isinstance(cresp, CoreResponse):
+                    resp = core_to_response(cresp)
+                    if want_final:
+                        resp.parameters["triton_final_response"].bool_param = True
+                    yield pb.ModelStreamInferResponse(infer_response=resp)
+                else:
+                    for item in cresp:
+                        resp = core_to_response(item)
+                        if want_final:
+                            resp.parameters["triton_final_response"].bool_param = False
+                        yield pb.ModelStreamInferResponse(infer_response=resp)
+                    if want_final:
+                        final = pb.ModelInferResponse(
+                            model_name=request.model_name, id=request.id
+                        )
+                        final.parameters["triton_final_response"].bool_param = True
+                        yield pb.ModelStreamInferResponse(infer_response=final)
+            except CoreError as e:
+                err = pb.ModelStreamInferResponse(error_message=str(e))
+                yield err
+
+
+class GRPCFrontend:
+    """grpc.server hosting an InferenceCore."""
+
+    def __init__(
+        self,
+        core: InferenceCore,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_workers: int = 16,
+    ):
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=[
+                ("grpc.max_send_message_length", _MAX_MESSAGE_LENGTH),
+                ("grpc.max_receive_message_length", _MAX_MESSAGE_LENGTH),
+            ],
+        )
+        self._server.add_generic_rpc_handlers([make_service_handler(_Servicer(core))])
+        self._port = self._server.add_insecure_port(f"{host}:{port}")
+        self._host = host
+
+    @property
+    def address(self) -> str:
+        return f"{self._host}:{self._port}"
+
+    def start(self):
+        self._server.start()
+        return self
+
+    def stop(self, grace: Optional[float] = 0.5):
+        self._server.stop(grace)

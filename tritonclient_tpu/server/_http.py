@@ -1,0 +1,421 @@
+"""HTTP/REST front-end for the in-process JAX server.
+
+Implements the KServe v2 REST surface the reference client drives
+(http/_client.py:364-893): health, metadata, config, repository control,
+statistics, shared-memory admin (system/cuda/tpu), trace/log settings, and
+infer with the JSON + appended-binary framing governed by the
+``Inference-Header-Content-Length`` header (http/_utils.py:137-150).
+"""
+
+import base64
+import gzip
+import json
+import re
+import socket
+import threading
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+import numpy as np
+
+from tritonclient_tpu.server._core import (
+    CoreError,
+    CoreRequest,
+    CoreRequestedOutput,
+    CoreTensor,
+    InferenceCore,
+)
+from tritonclient_tpu.utils import (
+    deserialize_bytes_tensor,
+    serialize_byte_tensor,
+    triton_to_np_dtype,
+)
+
+_SHM_KINDS = {"systemsharedmemory": "system", "cudasharedmemory": "cuda", "tpusharedmemory": "tpu"}
+
+
+def _json_default(obj):
+    if isinstance(obj, bytes):
+        return obj.decode("utf-8", errors="replace")
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    raise TypeError(f"not serializable: {type(obj)}")
+
+
+def _array_to_json_data(datatype: str, array: np.ndarray) -> list:
+    if datatype == "BYTES":
+        return [
+            x.decode("utf-8", errors="replace") if isinstance(x, (bytes, np.bytes_)) else str(x)
+            for x in array.flatten()
+        ]
+    if datatype == "BF16":
+        return [float(x) for x in array.astype(np.float32).flatten()]
+    if datatype in ("FP16", "FP32", "FP64"):
+        return [float(x) for x in array.flatten()]
+    if datatype == "BOOL":
+        return [bool(x) for x in array.flatten()]
+    return [int(x) for x in array.flatten()]
+
+
+def _json_data_to_array(datatype: str, shape: List[int], data) -> np.ndarray:
+    flat = np.array(data).reshape(shape) if not isinstance(data, np.ndarray) else data
+    if datatype == "BYTES":
+        out = np.array(
+            [x.encode() if isinstance(x, str) else bytes(x) for x in np.asarray(flat, dtype=object).flatten()],
+            dtype=np.object_,
+        )
+        return out.reshape(shape)
+    np_dtype = triton_to_np_dtype(datatype)
+    return np.asarray(flat).astype(np_dtype).reshape(shape)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "triton-tpu-http"
+
+    # quiet by default; the server object may set verbose=True
+    def log_message(self, fmt, *args):
+        if getattr(self.server, "verbose", False):
+            super().log_message(fmt, *args)
+
+    @property
+    def core(self) -> InferenceCore:
+        return self.server.core
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length) if length else b""
+        encoding = self.headers.get("Content-Encoding", "")
+        if encoding == "gzip":
+            body = gzip.decompress(body)
+        elif encoding == "deflate":
+            body = zlib.decompress(body)
+        return body
+
+    def _send(self, status: int, body: bytes, content_type="application/json", extra=None):
+        accept = self.headers.get("Accept-Encoding", "")
+        headers = dict(extra or {})
+        if body and status == 200:
+            if "gzip" in accept and "Inference-Header-Content-Length" not in headers:
+                body = gzip.compress(body)
+                headers["Content-Encoding"] = "gzip"
+            elif "deflate" in accept and "Inference-Header-Content-Length" not in headers:
+                body = zlib.compress(body)
+                headers["Content-Encoding"] = "deflate"
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers.items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _send_json(self, obj, status=200, extra=None):
+        body = json.dumps(obj, default=_json_default).encode() if obj is not None else b""
+        self._send(status, body, extra=extra)
+
+    def _send_error_json(self, e: Exception):
+        status = e.status if isinstance(e, CoreError) else 500
+        self._send(status, json.dumps({"error": str(e)}).encode())
+
+    # -- routing -------------------------------------------------------------
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def _dispatch(self, method: str):
+        try:
+            self._route(method)
+        except CoreError as e:
+            self._send_error_json(e)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except (json.JSONDecodeError, KeyError, ValueError, TypeError) as e:
+            # Malformed request bodies are client errors, not server faults.
+            self._send_error_json(CoreError(f"failed to parse request: {e}", 400))
+        except Exception as e:  # noqa: BLE001
+            self._send_error_json(e)
+
+    def _route(self, method: str):
+        path = self.path.split("?", 1)[0].strip("/")
+        parts = path.split("/")
+        core = self.core
+
+        if parts[0] != "v2":
+            self._send_json({"error": "not found"}, 404)
+            self._read_body()
+            return
+
+        # v2/health/live, v2/health/ready
+        if path == "v2/health/live":
+            return self._send(200 if core.is_server_live() else 400, b"")
+        if path == "v2/health/ready":
+            return self._send(200 if core.is_server_ready() else 400, b"")
+        if path == "v2":
+            return self._send_json(core.server_metadata())
+
+        # v2/models/{m}[/versions/{v}]/...
+        m = re.match(
+            r"^v2/models/(?P<model>[^/]+)(?:/versions/(?P<version>[^/]+))?"
+            r"(?:/(?P<action>ready|config|stats|infer|trace/setting))?$",
+            path,
+        )
+        if m:
+            model, version = m.group("model"), m.group("version") or ""
+            action = m.group("action")
+            if action == "ready":
+                ready = core.is_model_ready(model, version)
+                return self._send(200 if ready else 400, b"")
+            if action is None and method == "GET":
+                return self._send_json(core.model_metadata(model, version))
+            if action == "config":
+                return self._send_json(core.model_config(model, version))
+            if action == "stats":
+                return self._send_json(
+                    {"model_stats": core.model_statistics(model, version)}
+                )
+            if action == "infer":
+                return self._infer(model, version)
+            if action == "trace/setting":
+                return self._trace_setting(model_name=model, method=method)
+
+        if path == "v2/trace/setting":
+            return self._trace_setting(model_name="", method=method)
+        if path == "v2/logging":
+            return self._logging(method)
+
+        if path == "v2/repository/index":
+            body = self._read_body()
+            ready = False
+            if body:
+                ready = bool(json.loads(body).get("ready", False))
+            return self._send_json(core.repository_index(ready))
+
+        m = re.match(r"^v2/repository/models/(?P<model>[^/]+)/(?P<action>load|unload)$", path)
+        if m:
+            body = self._read_body()
+            params = json.loads(body).get("parameters", {}) if body else {}
+            # File-override params arrive base64-encoded (http/_client.py:1046-1056).
+            params = {
+                k: (base64.b64decode(v) if k.startswith("file:") else v)
+                for k, v in params.items()
+            }
+            if m.group("action") == "load":
+                core.load_model(m.group("model"), params)
+            else:
+                core.unload_model(m.group("model"), params)
+            return self._send_json(None, 200)
+
+        # shared memory admin
+        m = re.match(
+            r"^v2/(?P<kind>systemsharedmemory|cudasharedmemory|tpusharedmemory)"
+            r"(?:/region/(?P<region>[^/]+))?/(?P<action>status|register|unregister)$",
+            path,
+        )
+        if m:
+            return self._shm(m.group("kind"), m.group("region"), m.group("action"))
+
+        self._read_body()
+        self._send_json({"error": f"unknown path {self.path}"}, 404)
+
+    # -- endpoint impls ------------------------------------------------------
+
+    def _trace_setting(self, model_name: str, method: str):
+        if method == "GET":
+            return self._send_json(self.core.get_trace_settings(model_name))
+        body = self._read_body()
+        settings = json.loads(body) if body else {}
+        result = self.core.update_trace_settings(model_name, settings)
+        return self._send_json(result)
+
+    def _logging(self, method: str):
+        if method == "GET":
+            return self._send_json(self.core.get_log_settings())
+        body = self._read_body()
+        settings = json.loads(body) if body else {}
+        return self._send_json(self.core.update_log_settings(settings))
+
+    def _shm(self, kind_path: str, region: Optional[str], action: str):
+        kind = _SHM_KINDS[kind_path]
+        registry = self.core.shm_registry(kind)
+        if action == "status":
+            self._read_body()
+            regions = registry.status(region)
+            if region and not regions:
+                raise CoreError(
+                    f"Unable to find system shared memory region: '{region}'"
+                    if kind == "system"
+                    else f"Unable to find {kind} shared memory region: '{region}'",
+                    400,
+                )
+            return self._send_json(regions)
+        if action == "register":
+            body = json.loads(self._read_body() or b"{}")
+            if kind == "system":
+                registry.register(
+                    region,
+                    body.get("key", ""),
+                    int(body.get("offset", 0)),
+                    int(body.get("byte_size", 0)),
+                )
+            else:
+                raw = base64.b64decode(body.get("raw_handle", {}).get("b64", ""))
+                registry.register(
+                    region,
+                    raw,
+                    int(body.get("device_id", 0)),
+                    int(body.get("byte_size", 0)),
+                )
+            return self._send_json(None, 200)
+        if action == "unregister":
+            self._read_body()
+            registry.unregister(region)
+            return self._send_json(None, 200)
+
+    def _infer(self, model: str, version: str):
+        body = self._read_body()
+        header_len = self.headers.get("Inference-Header-Content-Length")
+        if header_len is not None:
+            json_size = int(header_len)
+            header = json.loads(body[:json_size])
+            binary_blob = body[json_size:]
+        else:
+            header = json.loads(body)
+            binary_blob = b""
+
+        request = CoreRequest(
+            model_name=model,
+            model_version=version,
+            id=header.get("id", ""),
+            parameters=dict(header.get("parameters", {})),
+        )
+
+        offset = 0
+        for js in header.get("inputs", []):
+            params = js.get("parameters", {})
+            name, datatype, shape = js["name"], js["datatype"], list(js["shape"])
+            tensor = CoreTensor(name=name, datatype=datatype, shape=shape)
+            if "shared_memory_region" in params:
+                tensor.shm_region = params["shared_memory_region"]
+                tensor.shm_offset = int(params.get("shared_memory_offset", 0))
+                tensor.shm_byte_size = int(params.get("shared_memory_byte_size", 0))
+                tensor.shm_kind = self.core.find_shm_kind(tensor.shm_region)
+            elif "binary_data_size" in params:
+                size = int(params["binary_data_size"])
+                raw = binary_blob[offset : offset + size]
+                offset += size
+                tensor.data = InferenceCore._decode_raw(datatype, shape, raw)
+            else:
+                tensor.data = _json_data_to_array(datatype, shape, js.get("data"))
+            request.inputs.append(tensor)
+
+        binary_default = bool(request.parameters.pop("binary_data_output", False))
+        for js in header.get("outputs", []):
+            params = js.get("parameters", {})
+            out = CoreRequestedOutput(
+                name=js["name"],
+                binary=bool(params.get("binary_data", binary_default)),
+                class_count=int(params.get("classification", 0)),
+            )
+            if "shared_memory_region" in params:
+                out.shm_region = params["shared_memory_region"]
+                out.shm_offset = int(params.get("shared_memory_offset", 0))
+                out.shm_byte_size = int(params.get("shared_memory_byte_size", 0))
+                out.shm_kind = self.core.find_shm_kind(out.shm_region)
+            request.outputs.append(out)
+
+        response = self.core.infer(request)
+        if not isinstance(response, (list, tuple)) and not hasattr(response, "outputs"):
+            # Decoupled over HTTP: drain the generator; only single-response
+            # decoupled interactions are representable (matching Triton).
+            responses = list(response)
+            if len(responses) != 1:
+                raise CoreError(
+                    "HTTP does not support decoupled models returning "
+                    f"{len(responses)} responses",
+                    400,
+                )
+            response = responses[0]
+
+        # Build response body: JSON header + binary blobs.
+        requested_binary = {
+            o.name: o.binary for o in request.outputs
+        }
+        out_json = {
+            "model_name": response.model_name,
+            "model_version": response.model_version,
+            "id": response.id,
+            "outputs": [],
+        }
+        blobs = []
+        for out in response.outputs:
+            entry = {
+                "name": out.name,
+                "datatype": out.datatype,
+                "shape": out.shape,
+            }
+            if out.shm_region is not None:
+                entry["parameters"] = {
+                    "shared_memory_region": out.shm_region,
+                    "shared_memory_offset": out.shm_offset,
+                    "shared_memory_byte_size": out.shm_byte_size,
+                }
+            elif requested_binary.get(out.name, binary_default):
+                if out.datatype == "BYTES":
+                    raw = serialize_byte_tensor(out.data)[0]
+                else:
+                    raw = InferenceCore._encode_raw(out.datatype, out.data)
+                entry["parameters"] = {"binary_data_size": len(raw)}
+                blobs.append(raw)
+            else:
+                entry["data"] = _array_to_json_data(out.datatype, out.data)
+            out_json["outputs"].append(entry)
+
+        header_bytes = json.dumps(out_json, default=_json_default).encode()
+        extra = {}
+        if blobs:
+            extra["Inference-Header-Content-Length"] = len(header_bytes)
+            payload = header_bytes + b"".join(blobs)
+            ctype = "application/octet-stream"
+        else:
+            payload = header_bytes
+            ctype = "application/json"
+        self._send(200, payload, content_type=ctype, extra=extra)
+
+
+class HTTPFrontend:
+    """Threaded HTTP server hosting an InferenceCore."""
+
+    def __init__(self, core: InferenceCore, host: str = "127.0.0.1", port: int = 0, verbose=False):
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.core = core
+        self._server.verbose = verbose
+        self._server.daemon_threads = True
+        # Disable Nagle for latency.
+        self._server.socket.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"{host}:{port}"
+
+    def start(self):
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

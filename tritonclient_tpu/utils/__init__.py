@@ -140,11 +140,13 @@ def serialize_byte_tensor(input_tensor: np.ndarray) -> Optional[np.ndarray]:
 
     Each element is encoded as a 4-byte little-endian length followed by the
     element's bytes, in row-major order (reference: utils/__init__.py:219-246).
-    Returns a 1-D uint8 array wrapping the serialized buffer, or None for
-    zero-size input.
+    Returns a 1-element object array whose [0] is the serialized buffer
+    (b"" for zero-size input).
     """
     if input_tensor.size == 0:
-        return np.empty([0], dtype=np.object_)
+        out = np.empty([1], dtype=np.object_)
+        out[0] = b""
+        return out
 
     if (input_tensor.dtype != np.object_) and (input_tensor.dtype.type != np.bytes_):
         raise_error("cannot serialize bytes tensor: invalid datatype")
@@ -176,9 +178,18 @@ def deserialize_bytes_tensor(encoded_tensor: bytes) -> np.ndarray:
     offset = 0
     view = memoryview(encoded_tensor)
     n = len(view)
-    while offset + 4 <= n:
+    while offset < n:
+        if offset + 4 > n:
+            raise_error(
+                "unexpected number of trailing bytes in serialized BYTES tensor"
+            )
         length = int.from_bytes(view[offset : offset + 4], "little")
         offset += 4
+        if offset + length > n:
+            raise_error(
+                "unexpected end of serialized BYTES tensor: element length "
+                f"{length} exceeds remaining {n - offset} bytes"
+            )
         strs.append(bytes(view[offset : offset + length]))
         offset += length
     return np.array(strs, dtype=np.object_)
@@ -192,7 +203,9 @@ def serialize_bf16_tensor(input_tensor: np.ndarray) -> Optional[np.ndarray]:
     memcpy — the TPU-native fast path the reference lacks).
     """
     if input_tensor.size == 0:
-        return np.empty([0], dtype=np.object_)
+        out = np.empty([1], dtype=np.object_)
+        out[0] = b""
+        return out
 
     if _BFLOAT16 is not None and input_tensor.dtype == _BFLOAT16:
         flattened = np.ascontiguousarray(input_tensor).tobytes()
