@@ -1,16 +1,25 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh.
 
-Multi-chip hardware is not available in CI; sharding correctness is validated on
-8 virtual CPU devices (the driver separately dry-runs the multi-chip path via
-__graft_entry__.dryrun_multichip).
+Multi-chip hardware is not available in CI; sharding correctness is validated
+on 8 virtual CPU devices (the driver separately dry-runs the multi-chip path
+via __graft_entry__.dryrun_multichip).
+
+Note: on axon-tunnel TPU images, sitecustomize registers the axon PJRT plugin
+and overrides the ``jax_platforms`` config, so the JAX_PLATFORMS env var alone
+is NOT enough — the config must be updated after import, before first backend
+use.
 """
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before the backend initializes.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,163 @@
+"""asyncio clients (grpc.aio + http.aio) against the hermetic server."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import tritonclient_tpu.grpc.aio as grpcaio
+import tritonclient_tpu.http.aio as httpaio
+from tritonclient_tpu.server import InferenceServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    with InferenceServer() as s:
+        yield s
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _grpc_inputs():
+    i0 = grpcaio.InferInput("INPUT0", [1, 16], "INT32").set_data_from_numpy(
+        np.arange(16, dtype=np.int32).reshape(1, 16)
+    )
+    i1 = grpcaio.InferInput("INPUT1", [1, 16], "INT32").set_data_from_numpy(
+        np.ones((1, 16), np.int32)
+    )
+    return [i0, i1]
+
+
+class TestGrpcAio:
+    def test_health_and_infer(self, server):
+        async def go():
+            async with grpcaio.InferenceServerClient(server.grpc_address) as c:
+                assert await c.is_server_live()
+                assert await c.is_server_ready()
+                assert await c.is_model_ready("simple")
+                res = await c.infer("simple", _grpc_inputs())
+                return res.as_numpy("OUTPUT0")
+
+        out = run(go())
+        assert out[0, 0] == 1
+
+    def test_admin(self, server):
+        async def go():
+            async with grpcaio.InferenceServerClient(server.grpc_address) as c:
+                md = await c.get_server_metadata(as_json=True)
+                idx = await c.get_model_repository_index(as_json=True)
+                stats = await c.get_inference_statistics("simple", as_json=True)
+                trace = await c.get_trace_settings(as_json=True)
+                logs = await c.get_log_settings(as_json=True)
+                return md, idx, stats, trace, logs
+
+        md, idx, stats, trace, logs = run(go())
+        assert md["name"] == "triton-tpu"
+        assert any(m["name"] == "simple" for m in idx["models"])
+        assert stats["model_stats"][0]["name"] == "simple"
+        assert "trace_rate" in trace["settings"]
+        assert "log_info" in logs["settings"]
+
+    def test_stream_infer(self, server):
+        async def go():
+            async with grpcaio.InferenceServerClient(server.grpc_address) as c:
+                async def gen():
+                    inp = grpcaio.InferInput("IN", [3], "INT32").set_data_from_numpy(
+                        np.array([1, 2, 3], np.int32)
+                    )
+                    yield {
+                        "model_name": "repeat_int32",
+                        "inputs": [inp],
+                        "enable_empty_final_response": True,
+                    }
+
+                got = []
+                async for result, error in c.stream_infer(gen()):
+                    assert error is None
+                    resp = result.get_response()
+                    if resp.parameters["triton_final_response"].bool_param:
+                        got.append("final")
+                        break
+                    got.append(int(result.as_numpy("OUT")[0]))
+                return got
+
+        assert run(go()) == [1, 2, 3, "final"]
+
+    def test_stream_error(self, server):
+        async def go():
+            async with grpcaio.InferenceServerClient(server.grpc_address) as c:
+                async def gen():
+                    inp = grpcaio.InferInput("IN", [1], "INT32").set_data_from_numpy(
+                        np.array([1], np.int32)
+                    )
+                    yield {"model_name": "nope", "inputs": [inp]}
+
+                async for result, error in c.stream_infer(gen()):
+                    return result, error
+
+        result, error = run(go())
+        assert result is None
+        assert "unknown model" in error.message()
+
+    def test_error_translation(self, server):
+        async def go():
+            async with grpcaio.InferenceServerClient(server.grpc_address) as c:
+                await c.get_model_metadata("nope")
+
+        with pytest.raises(grpcaio.InferenceServerException) as e:
+            run(go())
+        assert "NOT_FOUND" in e.value.status()
+
+
+class TestHttpAio:
+    def test_health_and_infer(self, server):
+        async def go():
+            async with httpaio.InferenceServerClient(server.http_address) as c:
+                assert await c.is_server_live()
+                h0 = httpaio.InferInput("INPUT0", [1, 16], "INT32").set_data_from_numpy(
+                    np.arange(16, dtype=np.int32).reshape(1, 16)
+                )
+                h1 = httpaio.InferInput("INPUT1", [1, 16], "INT32").set_data_from_numpy(
+                    np.ones((1, 16), np.int32)
+                )
+                res = await c.infer("simple", [h0, h1])
+                gathered = await asyncio.gather(
+                    *[c.infer("simple", [h0, h1]) for _ in range(5)]
+                )
+                compressed = await c.infer(
+                    "simple",
+                    [h0, h1],
+                    response_compression_algorithm="gzip",
+                    outputs=[httpaio.InferRequestedOutput("OUTPUT0", binary_data=False)],
+                )
+                return res, gathered, compressed
+
+        res, gathered, compressed = run(go())
+        assert res.as_numpy("OUTPUT0")[0, 0] == 1
+        assert len(gathered) == 5
+        assert compressed.as_numpy("OUTPUT0")[0, 0] == 1
+
+    def test_admin(self, server):
+        async def go():
+            async with httpaio.InferenceServerClient(server.http_address) as c:
+                md = await c.get_server_metadata()
+                idx = await c.get_model_repository_index()
+                settings = await c.update_trace_settings(settings={"trace_rate": "4"})
+                cleared = await c.update_trace_settings(settings={"trace_rate": None})
+                return md, idx, settings, cleared
+
+        md, idx, settings, cleared = run(go())
+        assert md["name"] == "triton-tpu"
+        assert any(m["name"] == "simple" for m in idx)
+        assert settings["trace_rate"] == ["4"]
+        assert cleared["trace_rate"] == ["1000"]
+
+    def test_error(self, server):
+        async def go():
+            async with httpaio.InferenceServerClient(server.http_address) as c:
+                await c.get_model_metadata("nope")
+
+        with pytest.raises(httpaio.InferenceServerException):
+            run(go())

@@ -1,0 +1,198 @@
+"""gRPC client (sync + streaming) against the hermetic server."""
+
+import queue
+
+import grpc as grpc_lib
+import numpy as np
+import pytest
+
+import tritonclient_tpu.grpc as grpcclient
+from tritonclient_tpu.server import InferenceServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    with InferenceServer(http=False) as s:
+        yield s
+
+
+@pytest.fixture()
+def client(server):
+    with grpcclient.InferenceServerClient(server.grpc_address) as c:
+        yield c
+
+
+def _inputs():
+    i0 = grpcclient.InferInput("INPUT0", [1, 16], "INT32").set_data_from_numpy(
+        np.arange(16, dtype=np.int32).reshape(1, 16)
+    )
+    i1 = grpcclient.InferInput("INPUT1", [1, 16], "INT32").set_data_from_numpy(
+        np.ones((1, 16), np.int32)
+    )
+    return [i0, i1]
+
+
+class TestSyncClient:
+    def test_health(self, client):
+        assert client.is_server_live()
+        assert client.is_server_ready()
+        assert client.is_model_ready("simple")
+
+    def test_metadata(self, client):
+        md = client.get_server_metadata()
+        assert md.name == "triton-tpu"
+        md_json = client.get_server_metadata(as_json=True)
+        assert md_json["name"] == "triton-tpu"
+        mmd = client.get_model_metadata("simple", as_json=True)
+        assert mmd["inputs"][0]["name"] == "INPUT0"
+        cfg = client.get_model_config("simple")
+        assert cfg.config.backend == "jax"
+
+    def test_infer(self, client):
+        res = client.infer("simple", _inputs(), request_id="42")
+        np.testing.assert_array_equal(
+            res.as_numpy("OUTPUT0")[0], np.arange(16, dtype=np.int32) + 1
+        )
+        assert res.get_response().id == "42"
+        assert res.get_output("OUTPUT0").datatype == "INT32"
+        assert res.get_output("OUTPUT0", as_json=True)["name"] == "OUTPUT0"
+        assert res.as_numpy("MISSING") is None
+
+    def test_infer_with_outputs_and_params(self, client):
+        outputs = [
+            grpcclient.InferRequestedOutput("OUTPUT0"),
+            grpcclient.InferRequestedOutput("OUTPUT1"),
+        ]
+        res = client.infer(
+            "simple", _inputs(), outputs=outputs, parameters={"custom_key": "v"}
+        )
+        assert set(res.output_names()) == {"OUTPUT0", "OUTPUT1"}
+
+    def test_reserved_parameter_rejected(self, client):
+        with pytest.raises(grpcclient.InferenceServerException, match="reserved"):
+            client.infer("simple", _inputs(), parameters={"sequence_id": 1})
+
+    def test_classification(self, client):
+        outputs = [grpcclient.InferRequestedOutput("OUTPUT0", class_count=3)]
+        res = client.infer("simple", _inputs(), outputs=outputs)
+        top = res.as_numpy("OUTPUT0")
+        assert top.shape == (1, 3)
+        assert top[0, 0].startswith(b"16.000000:15")
+
+    def test_async_infer(self, client):
+        done = queue.Queue()
+        ctx = client.async_infer(
+            "simple", _inputs(), callback=lambda result, error: done.put((result, error))
+        )
+        result, error = done.get(timeout=10)
+        assert error is None
+        assert result.as_numpy("OUTPUT1")[0, 0] == -1
+        assert ctx is not None
+
+    def test_input_validation(self):
+        i = grpcclient.InferInput("INPUT0", [1, 16], "INT32")
+        with pytest.raises(grpcclient.InferenceServerException, match="unexpected datatype"):
+            i.set_data_from_numpy(np.zeros((1, 16), np.float32))
+        with pytest.raises(grpcclient.InferenceServerException, match="unexpected numpy array shape"):
+            i.set_data_from_numpy(np.zeros((2, 16), np.int32))
+
+    def test_error_translation(self, client):
+        with pytest.raises(grpcclient.InferenceServerException) as e:
+            client.get_model_metadata("nope")
+        assert e.value.status() == "StatusCode.NOT_FOUND"
+        assert isinstance(e.value.debug_details(), grpc_lib.RpcError)
+
+    def test_repository(self, client):
+        idx = client.get_model_repository_index(as_json=True)
+        assert any(m["name"] == "simple" for m in idx["models"])
+        client.unload_model("simple")
+        assert not client.is_model_ready("simple")
+        client.load_model("simple")
+        assert client.is_model_ready("simple")
+
+    def test_statistics(self, client):
+        stats = client.get_inference_statistics("simple", as_json=True)
+        assert stats["model_stats"][0]["name"] == "simple"
+
+    def test_trace_log_settings(self, client):
+        resp = client.update_trace_settings(settings={"trace_rate": "9"}, as_json=True)
+        assert resp["settings"]["trace_rate"]["value"] == ["9"]
+        resp = client.update_trace_settings(settings={"trace_rate": None}, as_json=True)
+        assert resp["settings"]["trace_rate"]["value"] == ["1000"]
+        resp = client.update_log_settings({"log_verbose_level": 3}, as_json=True)
+        assert resp["settings"]["log_verbose_level"]["uint32_param"] == 3
+        client.update_log_settings({"log_verbose_level": 0})
+
+    def test_cuda_shm_unimplemented(self, client):
+        with pytest.raises(grpcclient.InferenceServerException) as e:
+            client.get_cuda_shared_memory_status()
+        assert "UNIMPLEMENTED" in e.value.status()
+
+    def test_plugin(self, server):
+        from tritonclient_tpu.grpc.auth import BasicAuth
+
+        with grpcclient.InferenceServerClient(server.grpc_address) as c:
+            c.register_plugin(BasicAuth("u", "p"))
+            assert c.is_server_live()
+            c.unregister_plugin()
+
+
+class TestStreaming:
+    def test_sequence_stream(self, client):
+        results = queue.Queue()
+        client.start_stream(callback=lambda result, error: results.put((result, error)))
+        for i, (start, end) in enumerate([(True, False), (False, False), (False, True)]):
+            inp = grpcclient.InferInput("INPUT", [1, 1], "INT32").set_data_from_numpy(
+                np.array([[i + 1]], np.int32)
+            )
+            client.async_stream_infer(
+                "simple_sequence", [inp], sequence_id=77, sequence_start=start, sequence_end=end
+            )
+        acc = []
+        for _ in range(3):
+            result, error = results.get(timeout=10)
+            assert error is None
+            acc.append(int(result.as_numpy("OUTPUT")[0, 0]))
+        assert acc == [1, 3, 6]
+        client.stop_stream()
+
+    def test_decoupled_stream_empty_final(self, client):
+        results = queue.Queue()
+        client.start_stream(callback=lambda result, error: results.put((result, error)))
+        inp = grpcclient.InferInput("IN", [3], "INT32").set_data_from_numpy(
+            np.array([4, 5, 6], np.int32)
+        )
+        client.async_stream_infer("repeat_int32", [inp], enable_empty_final_response=True)
+        got = []
+        while True:
+            result, error = results.get(timeout=10)
+            assert error is None
+            resp = result.get_response()
+            if resp.parameters["triton_final_response"].bool_param:
+                got.append("final")
+                break
+            got.append(int(result.as_numpy("OUT")[0]))
+        assert got == [4, 5, 6, "final"]
+        client.stop_stream()
+
+    def test_stream_error_via_callback(self, client):
+        results = queue.Queue()
+        client.start_stream(callback=lambda result, error: results.put((result, error)))
+        inp = grpcclient.InferInput("INPUT0", [1, 16], "INT32").set_data_from_numpy(
+            np.zeros((1, 16), np.int32)
+        )
+        client.async_stream_infer("nonexistent_model", [inp])
+        result, error = results.get(timeout=10)
+        assert result is None
+        assert "unknown model" in error.message()
+        client.stop_stream()
+
+    def test_double_start_rejected(self, client):
+        client.start_stream(callback=lambda result, error: None)
+        with pytest.raises(grpcclient.InferenceServerException, match="already active"):
+            client.start_stream(callback=lambda result, error: None)
+        client.stop_stream()
+
+    def test_stream_without_start_rejected(self, client):
+        with pytest.raises(grpcclient.InferenceServerException, match="stream not available"):
+            client.async_stream_infer("simple", _inputs())

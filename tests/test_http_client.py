@@ -1,0 +1,145 @@
+"""HTTP client (sync) against the hermetic server."""
+
+import numpy as np
+import pytest
+
+import tritonclient_tpu.http as httpclient
+from tritonclient_tpu.server import InferenceServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    with InferenceServer(grpc=False) as s:
+        yield s
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    with httpclient.InferenceServerClient(server.http_address, concurrency=4) as c:
+        yield c
+
+
+def _inputs(binary=True):
+    i0 = httpclient.InferInput("INPUT0", [1, 16], "INT32").set_data_from_numpy(
+        np.arange(16, dtype=np.int32).reshape(1, 16), binary_data=binary
+    )
+    i1 = httpclient.InferInput("INPUT1", [1, 16], "INT32").set_data_from_numpy(
+        np.ones((1, 16), np.int32), binary_data=binary
+    )
+    return [i0, i1]
+
+
+class TestHTTPClient:
+    def test_health(self, client):
+        assert client.is_server_live()
+        assert client.is_server_ready()
+        assert client.is_model_ready("simple")
+        assert not client.is_model_ready("nope")
+
+    def test_scheme_rejected(self):
+        with pytest.raises(httpclient.InferenceServerException, match="scheme"):
+            httpclient.InferenceServerClient("http://localhost:8000")
+
+    def test_metadata(self, client):
+        assert client.get_server_metadata()["name"] == "triton-tpu"
+        assert client.get_model_metadata("simple")["inputs"][0]["name"] == "INPUT0"
+        assert client.get_model_config("simple")["backend"] == "jax"
+
+    def test_binary_infer(self, client):
+        res = client.infer("simple", _inputs())
+        np.testing.assert_array_equal(
+            res.as_numpy("OUTPUT0")[0], np.arange(16, dtype=np.int32) + 1
+        )
+
+    def test_json_infer_mixed_outputs(self, client):
+        outputs = [
+            httpclient.InferRequestedOutput("OUTPUT0"),
+            httpclient.InferRequestedOutput("OUTPUT1", binary_data=False),
+        ]
+        res = client.infer("simple", _inputs(binary=False), outputs=outputs)
+        assert res.as_numpy("OUTPUT0")[0, 0] == 1
+        assert res.as_numpy("OUTPUT1")[0, 0] == -1
+        assert res.get_output("OUTPUT1")["data"][0] == -1
+
+    def test_compression_both_ways(self, client):
+        for algo in ("gzip", "deflate"):
+            res = client.infer(
+                "simple",
+                _inputs(),
+                request_compression_algorithm=algo,
+                response_compression_algorithm=algo,
+                outputs=[httpclient.InferRequestedOutput("OUTPUT0", binary_data=False)],
+            )
+            assert res.as_numpy("OUTPUT0")[0, 0] == 1
+
+    def test_string_model(self, client):
+        a = np.array([str(i).encode() for i in range(16)], dtype=np.object_).reshape(1, 16)
+        b = np.array([b"2"] * 16, dtype=np.object_).reshape(1, 16)
+        s0 = httpclient.InferInput("INPUT0", [1, 16], "BYTES").set_data_from_numpy(a)
+        s1 = httpclient.InferInput("INPUT1", [1, 16], "BYTES").set_data_from_numpy(
+            b, binary_data=False
+        )
+        res = client.infer("simple_string", [s0, s1])
+        assert res.as_numpy("OUTPUT0")[0, :3].tolist() == [b"2", b"3", b"4"]
+
+    def test_classification(self, client):
+        res = client.infer(
+            "simple",
+            _inputs(),
+            outputs=[httpclient.InferRequestedOutput("OUTPUT0", binary_data=False, class_count=2)],
+        )
+        assert res.as_numpy("OUTPUT0")[0, 0].startswith(b"16.000000:15")
+
+    def test_async_infer_exceeding_concurrency(self, client):
+        reqs = [client.async_infer("simple", _inputs()) for _ in range(10)]
+        outs = [r.get_result(timeout=30).as_numpy("OUTPUT0")[0, 0] for r in reqs]
+        assert outs == [1] * 10
+
+    def test_sequence(self, client):
+        last = None
+        for i, (start, end) in enumerate([(True, False), (False, False), (False, True)]):
+            inp = httpclient.InferInput("INPUT", [1, 1], "INT32").set_data_from_numpy(
+                np.array([[i + 1]], np.int32)
+            )
+            last = client.infer(
+                "simple_sequence",
+                [inp],
+                sequence_id=31,
+                sequence_start=start,
+                sequence_end=end,
+            )
+        assert last.as_numpy("OUTPUT")[0, 0] == 6
+
+    def test_generate_and_parse_body(self, client):
+        body, json_size = httpclient.InferenceServerClient.generate_request_body(_inputs())
+        assert json_size is not None and json_size < len(body)
+        res = client.infer("simple", _inputs())
+        # parse_response_body round-trip on a fabricated response is covered by
+        # from_response_body in the infer path itself.
+        assert res.output_names()
+
+    def test_errors(self, client):
+        with pytest.raises(httpclient.InferenceServerException) as e:
+            client.get_model_metadata("nope")
+        assert e.value.status() == "404"
+        with pytest.raises(httpclient.InferenceServerException, match="reserved"):
+            client.infer("simple", _inputs(), parameters={"priority": 3})
+
+    def test_admin_surface(self, client):
+        assert any(m["name"] == "simple" for m in client.get_model_repository_index())
+        client.unload_model("simple")
+        assert not client.is_model_ready("simple")
+        client.load_model("simple")
+        assert client.is_model_ready("simple")
+        stats = client.get_inference_statistics("simple")
+        assert stats["model_stats"][0]["inference_count"] >= 1
+        assert client.update_trace_settings(settings={"trace_rate": "3"})["trace_rate"] == ["3"]
+        assert client.update_trace_settings(settings={"trace_rate": None})["trace_rate"] == ["1000"]
+        assert client.get_log_settings()["log_info"] is True
+
+    def test_plugin(self, server):
+        from tritonclient_tpu.http.auth import BasicAuth
+
+        with httpclient.InferenceServerClient(server.http_address) as c:
+            c.register_plugin(BasicAuth("u", "p"))
+            assert c.is_server_live()

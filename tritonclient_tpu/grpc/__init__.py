@@ -1,0 +1,13 @@
+"""gRPC client package (reference parity: tritonclient/grpc/__init__.py)."""
+
+from tritonclient_tpu.grpc._client import (  # noqa: F401
+    MAX_GRPC_MESSAGE_SIZE,
+    CallContext,
+    InferenceServerClient,
+    KeepAliveOptions,
+)
+from tritonclient_tpu.grpc._infer_input import InferInput  # noqa: F401
+from tritonclient_tpu.grpc._infer_result import InferResult  # noqa: F401
+from tritonclient_tpu.grpc._requested_output import InferRequestedOutput  # noqa: F401
+from tritonclient_tpu.protocol import pb as service_pb2  # noqa: F401
+from tritonclient_tpu.utils import InferenceServerException  # noqa: F401

@@ -1,0 +1,637 @@
+"""Synchronous gRPC client for the KServe v2 protocol.
+
+Full method-surface parity with the reference client
+(tritonclient/grpc/_client.py:119-1936): health, metadata, configuration,
+repository control, statistics, trace/log settings, shared-memory admin
+(system + TPU; CUDA methods exist and surface the server's UNIMPLEMENTED),
+infer, async_infer with cancellable CallContext, and bidirectional streaming.
+"""
+
+from typing import Any, Dict, List, Optional
+
+import grpc
+
+from google.protobuf import json_format
+
+from tritonclient_tpu._client import InferenceServerClientBase
+from tritonclient_tpu._request import Request
+from tritonclient_tpu.grpc._infer_result import InferResult
+from tritonclient_tpu.grpc._infer_stream import _InferStream, _RequestIterator
+from tritonclient_tpu.grpc._utils import (
+    _get_inference_request,
+    get_error_grpc,
+    grpc_compression_type,
+    raise_error_grpc,
+)
+from tritonclient_tpu.protocol import GRPCInferenceServiceStub, pb
+from tritonclient_tpu.utils import raise_error
+
+# INT32_MAX parity with the reference (grpc/_client.py:50-55).
+MAX_GRPC_MESSAGE_SIZE = 2**31 - 1
+
+
+class KeepAliveOptions:
+    """gRPC keepalive knobs (reference: grpc/_client.py:57-98)."""
+
+    def __init__(
+        self,
+        keepalive_time_ms: int = 2**31 - 1,
+        keepalive_timeout_ms: int = 20000,
+        keepalive_permit_without_calls: bool = False,
+        http2_max_pings_without_data: int = 2,
+    ):
+        self.keepalive_time_ms = keepalive_time_ms
+        self.keepalive_timeout_ms = keepalive_timeout_ms
+        self.keepalive_permit_without_calls = keepalive_permit_without_calls
+        self.http2_max_pings_without_data = http2_max_pings_without_data
+
+
+class CallContext:
+    """Cancellation handle returned by async_infer (reference: grpc/_client.py:101-116)."""
+
+    def __init__(self, grpc_future):
+        self.__grpc_future = grpc_future
+
+    def cancel(self):
+        self.__grpc_future.cancel()
+
+
+class InferenceServerClient(InferenceServerClientBase):
+    """Talks to the server over gRPC.
+
+    Thread-safe for concurrent unary calls; a stream is owned by one thread
+    (same contract as the reference, grpc/_client.py:120-123).
+    """
+
+    def __init__(
+        self,
+        url: str,
+        verbose: bool = False,
+        ssl: bool = False,
+        root_certificates: Optional[str] = None,
+        private_key: Optional[str] = None,
+        certificate_chain: Optional[str] = None,
+        creds: Optional[grpc.ChannelCredentials] = None,
+        keepalive_options: Optional[KeepAliveOptions] = None,
+        channel_args: Optional[List] = None,
+    ):
+        super().__init__()
+        if keepalive_options is None:
+            keepalive_options = KeepAliveOptions()
+
+        if channel_args is not None:
+            channel_opt = list(channel_args)
+        else:
+            channel_opt = [
+                ("grpc.max_send_message_length", MAX_GRPC_MESSAGE_SIZE),
+                ("grpc.max_receive_message_length", MAX_GRPC_MESSAGE_SIZE),
+                ("grpc.keepalive_time_ms", keepalive_options.keepalive_time_ms),
+                ("grpc.keepalive_timeout_ms", keepalive_options.keepalive_timeout_ms),
+                (
+                    "grpc.keepalive_permit_without_calls",
+                    keepalive_options.keepalive_permit_without_calls,
+                ),
+                (
+                    "grpc.http2.max_pings_without_data",
+                    keepalive_options.http2_max_pings_without_data,
+                ),
+            ]
+
+        if creds is not None:
+            self._channel = grpc.secure_channel(url, creds, options=channel_opt)
+        elif ssl:
+            rc = self._read_file(root_certificates)
+            pk = self._read_file(private_key)
+            cc = self._read_file(certificate_chain)
+            credentials = grpc.ssl_channel_credentials(
+                root_certificates=rc, private_key=pk, certificate_chain=cc
+            )
+            self._channel = grpc.secure_channel(url, credentials, options=channel_opt)
+        else:
+            self._channel = grpc.insecure_channel(url, options=channel_opt)
+        self._client_stub = GRPCInferenceServiceStub(self._channel)
+        self._verbose = verbose
+        self._stream: Optional[_InferStream] = None
+
+    @staticmethod
+    def _read_file(path: Optional[str]) -> Optional[bytes]:
+        if path is None:
+            return None
+        with open(path, "rb") as f:
+            return f.read()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
+
+    def close(self):
+        """Close the client: stops any active stream and closes the channel."""
+        self.stop_stream()
+        self._channel.close()
+
+    # -- internals -----------------------------------------------------------
+
+    def _get_metadata(self, headers: Optional[Dict[str, str]]):
+        headers = dict(headers) if headers else {}
+        request = Request(headers)
+        self._call_plugin(request)
+        return tuple(request.headers.items())
+
+    def _log(self, *args):
+        if self._verbose:
+            print(*args)
+
+    # -- health --------------------------------------------------------------
+
+    def is_server_live(self, headers=None, client_timeout=None) -> bool:
+        try:
+            request = pb.ServerLiveRequest()
+            response = self._client_stub.ServerLive(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+            self._log("is_server_live:", response)
+            return response.live
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    def is_server_ready(self, headers=None, client_timeout=None) -> bool:
+        try:
+            response = self._client_stub.ServerReady(
+                pb.ServerReadyRequest(),
+                metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+            )
+            return response.ready
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    def is_model_ready(
+        self, model_name, model_version="", headers=None, client_timeout=None
+    ) -> bool:
+        try:
+            request = pb.ModelReadyRequest(name=model_name, version=model_version)
+            response = self._client_stub.ModelReady(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+            return response.ready
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    # -- metadata / config ---------------------------------------------------
+
+    def get_server_metadata(self, headers=None, as_json=False, client_timeout=None):
+        try:
+            response = self._client_stub.ServerMetadata(
+                pb.ServerMetadataRequest(),
+                metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+            )
+            return self._return(response, as_json)
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    def get_model_metadata(
+        self, model_name, model_version="", headers=None, as_json=False, client_timeout=None
+    ):
+        try:
+            request = pb.ModelMetadataRequest(name=model_name, version=model_version)
+            response = self._client_stub.ModelMetadata(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+            return self._return(response, as_json)
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    def get_model_config(
+        self, model_name, model_version="", headers=None, as_json=False, client_timeout=None
+    ):
+        try:
+            request = pb.ModelConfigRequest(name=model_name, version=model_version)
+            response = self._client_stub.ModelConfig(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+            return self._return(response, as_json)
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    # -- repository ----------------------------------------------------------
+
+    def get_model_repository_index(self, headers=None, as_json=False, client_timeout=None):
+        try:
+            response = self._client_stub.RepositoryIndex(
+                pb.RepositoryIndexRequest(),
+                metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+            )
+            return self._return(response, as_json)
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    def load_model(
+        self,
+        model_name,
+        headers=None,
+        config: Optional[str] = None,
+        files: Optional[Dict[str, bytes]] = None,
+        client_timeout=None,
+    ):
+        """Load/reload a model, optionally overriding config (JSON string) or
+        files (path → bytes), mirroring grpc/_client.py:651-758."""
+        try:
+            request = pb.RepositoryModelLoadRequest(model_name=model_name)
+            if config is not None:
+                request.parameters["config"].string_param = config
+            if files is not None:
+                for path, content in files.items():
+                    request.parameters[path].bytes_param = content
+            self._client_stub.RepositoryModelLoad(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+            self._log(f"Loaded model '{model_name}'")
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    def unload_model(
+        self, model_name, headers=None, unload_dependents=False, client_timeout=None
+    ):
+        try:
+            request = pb.RepositoryModelUnloadRequest(model_name=model_name)
+            request.parameters["unload_dependents"].bool_param = unload_dependents
+            self._client_stub.RepositoryModelUnload(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+            self._log(f"Unloaded model '{model_name}'")
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    # -- statistics ----------------------------------------------------------
+
+    def get_inference_statistics(
+        self, model_name="", model_version="", headers=None, as_json=False, client_timeout=None
+    ):
+        try:
+            request = pb.ModelStatisticsRequest(name=model_name, version=model_version)
+            response = self._client_stub.ModelStatistics(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+            return self._return(response, as_json)
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    # -- trace / log settings ------------------------------------------------
+
+    def update_trace_settings(
+        self, model_name="", settings: Optional[dict] = None, headers=None, as_json=False, client_timeout=None
+    ):
+        try:
+            request = pb.TraceSettingRequest(model_name=model_name)
+            for key, value in (settings or {}).items():
+                if value is None:
+                    request.settings[key].SetInParent()  # present-but-empty = clear
+                else:
+                    values = value if isinstance(value, (list, tuple)) else [value]
+                    request.settings[key].value.extend([str(v) for v in values])
+            response = self._client_stub.TraceSetting(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+            return self._return(response, as_json)
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    def get_trace_settings(self, model_name="", headers=None, as_json=False, client_timeout=None):
+        return self.update_trace_settings(
+            model_name=model_name, settings={}, headers=headers, as_json=as_json,
+            client_timeout=client_timeout,
+        )
+
+    def update_log_settings(self, settings: dict, headers=None, as_json=False, client_timeout=None):
+        try:
+            request = pb.LogSettingsRequest()
+            for key, value in (settings or {}).items():
+                if value is None:
+                    request.settings[key].SetInParent()
+                elif isinstance(value, bool):
+                    request.settings[key].bool_param = value
+                elif isinstance(value, int):
+                    request.settings[key].uint32_param = value
+                else:
+                    request.settings[key].string_param = str(value)
+            response = self._client_stub.LogSettings(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+            return self._return(response, as_json)
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    def get_log_settings(self, headers=None, as_json=False, client_timeout=None):
+        return self.update_log_settings({}, headers=headers, as_json=as_json, client_timeout=client_timeout)
+
+    # -- shared memory admin -------------------------------------------------
+
+    def get_system_shared_memory_status(
+        self, region_name="", headers=None, as_json=False, client_timeout=None
+    ):
+        try:
+            request = pb.SystemSharedMemoryStatusRequest(name=region_name)
+            response = self._client_stub.SystemSharedMemoryStatus(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+            return self._return(response, as_json)
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    def register_system_shared_memory(
+        self, name, key, byte_size, offset=0, headers=None, client_timeout=None
+    ):
+        try:
+            request = pb.SystemSharedMemoryRegisterRequest(
+                name=name, key=key, offset=offset, byte_size=byte_size
+            )
+            self._client_stub.SystemSharedMemoryRegister(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+            self._log(f"Registered system shared memory with name '{name}'")
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    def unregister_system_shared_memory(self, name="", headers=None, client_timeout=None):
+        try:
+            request = pb.SystemSharedMemoryUnregisterRequest(name=name)
+            self._client_stub.SystemSharedMemoryUnregister(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+            if name:
+                self._log(f"Unregistered system shared memory with name '{name}'")
+            else:
+                self._log("Unregistered all system shared memory regions")
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    def get_cuda_shared_memory_status(
+        self, region_name="", headers=None, as_json=False, client_timeout=None
+    ):
+        try:
+            request = pb.CudaSharedMemoryStatusRequest(name=region_name)
+            response = self._client_stub.CudaSharedMemoryStatus(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+            return self._return(response, as_json)
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    def register_cuda_shared_memory(
+        self, name, raw_handle, device_id, byte_size, headers=None, client_timeout=None
+    ):
+        try:
+            request = pb.CudaSharedMemoryRegisterRequest(
+                name=name, raw_handle=raw_handle, device_id=device_id, byte_size=byte_size
+            )
+            self._client_stub.CudaSharedMemoryRegister(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    def unregister_cuda_shared_memory(self, name="", headers=None, client_timeout=None):
+        try:
+            request = pb.CudaSharedMemoryUnregisterRequest(name=name)
+            self._client_stub.CudaSharedMemoryUnregister(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    def get_tpu_shared_memory_status(
+        self, region_name="", headers=None, as_json=False, client_timeout=None
+    ):
+        """Status of registered TPU device-buffer regions (this framework's
+        analog of get_cuda_shared_memory_status)."""
+        try:
+            request = pb.TpuSharedMemoryStatusRequest(name=region_name)
+            response = self._client_stub.TpuSharedMemoryStatus(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+            return self._return(response, as_json)
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    def register_tpu_shared_memory(
+        self, name, raw_handle, device_id, byte_size, headers=None, client_timeout=None
+    ):
+        """Register a TPU shared-memory region by its raw co-location handle
+        (from tritonclient_tpu.utils.tpu_shared_memory.get_raw_handle)."""
+        try:
+            request = pb.TpuSharedMemoryRegisterRequest(
+                name=name, raw_handle=raw_handle, device_id=device_id, byte_size=byte_size
+            )
+            self._client_stub.TpuSharedMemoryRegister(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+            self._log(f"Registered TPU shared memory with name '{name}'")
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    def unregister_tpu_shared_memory(self, name="", headers=None, client_timeout=None):
+        try:
+            request = pb.TpuSharedMemoryUnregisterRequest(name=name)
+            self._client_stub.TpuSharedMemoryUnregister(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    # -- inference -----------------------------------------------------------
+
+    def infer(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        client_timeout=None,
+        headers=None,
+        compression_algorithm=None,
+        parameters=None,
+    ) -> InferResult:
+        """Synchronous inference (reference: grpc/_client.py:1445-1572)."""
+        request = _get_inference_request(
+            infer_inputs=inputs,
+            model_name=model_name,
+            model_version=model_version,
+            request_id=request_id,
+            outputs=outputs,
+            sequence_id=sequence_id,
+            sequence_start=sequence_start,
+            sequence_end=sequence_end,
+            priority=priority,
+            timeout=timeout,
+            parameters=parameters,
+        )
+        try:
+            response = self._client_stub.ModelInfer(
+                request,
+                metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+                compression=grpc_compression_type(compression_algorithm),
+            )
+            return InferResult(response)
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    def async_infer(
+        self,
+        model_name,
+        inputs,
+        callback,
+        model_version="",
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        client_timeout=None,
+        headers=None,
+        compression_algorithm=None,
+        parameters=None,
+    ) -> CallContext:
+        """Fire-and-callback inference; returns a cancellable CallContext.
+
+        callback(result, error) runs on a grpc worker thread
+        (reference: grpc/_client.py:1574-1741).
+        """
+        def wrapped_callback(future):
+            error = None
+            result = None
+            try:
+                result = InferResult(future.result())
+            except grpc.RpcError as rpc_error:
+                error = get_error_grpc(rpc_error)
+            except grpc.FutureCancelledError:
+                from tritonclient_tpu.grpc._utils import get_cancelled_error
+
+                error = get_cancelled_error()
+            callback(result=result, error=error)
+
+        request = _get_inference_request(
+            infer_inputs=inputs,
+            model_name=model_name,
+            model_version=model_version,
+            request_id=request_id,
+            outputs=outputs,
+            sequence_id=sequence_id,
+            sequence_start=sequence_start,
+            sequence_end=sequence_end,
+            priority=priority,
+            timeout=timeout,
+            parameters=parameters,
+        )
+        try:
+            future = self._client_stub.ModelInfer.future(
+                request,
+                metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+                compression=grpc_compression_type(compression_algorithm),
+            )
+            future.add_done_callback(wrapped_callback)
+            return CallContext(future)
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    # -- streaming -----------------------------------------------------------
+
+    def start_stream(
+        self,
+        callback,
+        stream_timeout=None,
+        headers=None,
+        compression_algorithm=None,
+    ):
+        """Open the bidi stream; callback(result, error) is driven by a reader
+        thread (reference: grpc/_client.py:1743-1798)."""
+        if self._stream is not None:
+            raise_error(
+                "cannot start another stream with one already active. "
+                "Please use different InferenceServerClient objects to start "
+                "multiple streams"
+            )
+        self._stream = _InferStream(callback, self._verbose)
+        try:
+            response_iterator = self._client_stub.ModelStreamInfer(
+                _RequestIterator(self._stream),
+                metadata=self._get_metadata(headers),
+                timeout=stream_timeout,
+                compression=grpc_compression_type(compression_algorithm),
+            )
+            self._stream.init_handler(response_iterator)
+            self._log("stream started...")
+        except grpc.RpcError as rpc_error:
+            self._stream = None
+            raise_error_grpc(rpc_error)
+
+    def stop_stream(self, cancel_requests: bool = False):
+        """Close the active stream (reference: grpc/_client.py:1800-1813)."""
+        if self._stream is not None:
+            self._stream.close(cancel_requests)
+        self._stream = None
+
+    def async_stream_infer(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        enable_empty_final_response=False,
+        priority=0,
+        timeout=None,
+        parameters=None,
+    ):
+        """Enqueue a request on the active stream (reference: grpc/_client.py:1815-1936)."""
+        if self._stream is None:
+            raise_error("stream not available, use start_stream() to make one available.")
+        request = _get_inference_request(
+            infer_inputs=inputs,
+            model_name=model_name,
+            model_version=model_version,
+            request_id=request_id,
+            outputs=outputs,
+            sequence_id=sequence_id,
+            sequence_start=sequence_start,
+            sequence_end=sequence_end,
+            priority=priority,
+            timeout=timeout,
+            parameters=parameters,
+        )
+        if enable_empty_final_response:
+            request.parameters["triton_enable_empty_final_response"].bool_param = True
+        self._stream._enqueue_request(request)
+        self._log("enqueued request to stream...")
+
+    # -- helpers -------------------------------------------------------------
+
+    @staticmethod
+    def _return(response, as_json: bool):
+        if as_json:
+            return json_format.MessageToDict(response, preserving_proto_field_name=True)
+        return response

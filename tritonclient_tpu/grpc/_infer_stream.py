@@ -1,0 +1,101 @@
+"""Bidirectional-stream plumbing: request queue + response reader thread.
+
+Reference parity: tritonclient/grpc/_infer_stream.py:39-191 — user requests are
+enqueued, a _RequestIterator feeds them to the grpc bidi call, and a reader
+thread drives the user callback with (result, error) pairs.
+"""
+
+import queue
+import threading
+
+import grpc
+
+from tritonclient_tpu.grpc._infer_result import InferResult
+from tritonclient_tpu.grpc._utils import get_cancelled_error, get_error_grpc
+from tritonclient_tpu.utils import InferenceServerException
+
+
+class _InferStream:
+    """Manages one bidi stream; not thread-safe for concurrent senders."""
+
+    def __init__(self, callback, verbose: bool):
+        self._callback = callback
+        self._verbose = verbose
+        self._request_queue = queue.Queue()
+        self._handler = None
+        self._response_iterator = None
+        self._active = True
+
+    def __del__(self):
+        self.close(cancel_requests=True)
+
+    def init_handler(self, response_iterator):
+        """Attach the grpc call object and spawn the reader thread."""
+        self._response_iterator = response_iterator
+        self._handler = threading.Thread(target=self._process_response, daemon=True)
+        self._handler.start()
+
+    def close(self, cancel_requests: bool = False):
+        """Drain and shut down. With cancel_requests, cancels the RPC (pending
+        requests surface CANCELLED errors through the callback)."""
+        if cancel_requests and self._response_iterator is not None:
+            self._response_iterator.cancel()
+        if self._handler is not None:
+            if not cancel_requests:
+                self._request_queue.put(None)  # sentinel: WritesDone
+            if self._handler.is_alive():
+                self._handler.join()
+            if self._verbose:
+                print("stream stopped...")
+            self._handler = None
+
+    def _enqueue_request(self, request):
+        if not self._active:
+            raise InferenceServerException(
+                msg="The stream is no longer in valid state, the error detail "
+                "is reported through provided callback. A new stream should "
+                "be started after stopping the current stream."
+            )
+        self._request_queue.put(request)
+
+    def _get_request(self):
+        return self._request_queue.get()
+
+    def _process_response(self):
+        """Reader loop: pairs responses with the user callback."""
+        try:
+            for response in self._response_iterator:
+                if response.error_message:
+                    error = InferenceServerException(msg=response.error_message)
+                    self._callback(result=None, error=error)
+                else:
+                    result = InferResult(response.infer_response)
+                    self._callback(result=result, error=error_or_none(response))
+        except grpc.RpcError as rpc_error:
+            # Stream died: mark inactive and surface the error once.
+            self._active = False
+            if rpc_error.code() == grpc.StatusCode.CANCELLED:
+                error = get_cancelled_error()
+            else:
+                error = get_error_grpc(rpc_error)
+            self._callback(result=None, error=error)
+
+
+def error_or_none(response):
+    return None
+
+
+class _RequestIterator:
+    """Iterator over the request queue handed to the grpc bidi call."""
+
+    def __init__(self, stream: _InferStream):
+        self._stream = stream
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        request = self._stream._get_request()
+        if request is None:
+            raise StopIteration
+        return request

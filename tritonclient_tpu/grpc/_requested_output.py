@@ -1,0 +1,40 @@
+"""InferRequestedOutput for the gRPC client.
+
+Reference parity: tritonclient/grpc/_requested_output.py:33-99.
+"""
+
+from tritonclient_tpu.protocol import pb
+
+
+class InferRequestedOutput:
+    """Describes one requested output of an inference request."""
+
+    def __init__(self, name: str, class_count: int = 0):
+        self._output = pb.ModelInferRequest.InferRequestedOutputTensor()
+        self._output.name = name
+        if class_count != 0:
+            self._output.parameters["classification"].int64_param = class_count
+
+    def name(self) -> str:
+        return self._output.name
+
+    def set_shared_memory(self, region_name: str, byte_size: int, offset: int = 0):
+        """Route this output into a registered shared-memory region."""
+        if "classification" in self._output.parameters:
+            raise ValueError(
+                "shared memory can't be set on a classification output"
+            )
+        self._output.parameters["shared_memory_region"].string_param = region_name
+        self._output.parameters["shared_memory_byte_size"].int64_param = byte_size
+        if offset != 0:
+            self._output.parameters["shared_memory_offset"].int64_param = offset
+        return self
+
+    def unset_shared_memory(self):
+        self._output.parameters.pop("shared_memory_region", None)
+        self._output.parameters.pop("shared_memory_byte_size", None)
+        self._output.parameters.pop("shared_memory_offset", None)
+        return self
+
+    def _get_tensor(self) -> pb.ModelInferRequest.InferRequestedOutputTensor:
+        return self._output

@@ -1,0 +1,108 @@
+"""gRPC request building + error translation helpers.
+
+Reference parity: tritonclient/grpc/_utils.py (request builder :80-143, error
+translation :34-77, compression map :146-158).
+"""
+
+from typing import Optional
+
+import grpc
+
+from tritonclient_tpu.protocol import pb
+from tritonclient_tpu.utils import InferenceServerException
+
+_RESERVED_PARAMS = ("sequence_id", "sequence_start", "sequence_end", "priority", "binary_data_output")
+
+
+def get_error_grpc(rpc_error: grpc.RpcError) -> InferenceServerException:
+    """Translate an RpcError into the protocol exception type."""
+    return InferenceServerException(
+        msg=rpc_error.details(),
+        status=str(rpc_error.code()),
+        debug_details=rpc_error,
+    )
+
+
+def get_cancelled_error(msg: Optional[str] = None) -> InferenceServerException:
+    return InferenceServerException(
+        msg=msg or "Locally cancelled by application!",
+        status="StatusCode.CANCELLED",
+    )
+
+
+def raise_error_grpc(rpc_error):
+    raise get_error_grpc(rpc_error) from None
+
+
+def grpc_compression_type(algorithm: Optional[str]) -> grpc.Compression:
+    if algorithm is None:
+        return grpc.Compression.NoCompression
+    if algorithm == "deflate":
+        return grpc.Compression.Deflate
+    if algorithm == "gzip":
+        return grpc.Compression.Gzip
+    print(
+        f"The provided client-side compression algorithm is not supported: {algorithm}"
+    )
+    return grpc.Compression.NoCompression
+
+
+def _get_inference_request(
+    infer_inputs,
+    model_name,
+    model_version,
+    request_id,
+    outputs,
+    sequence_id,
+    sequence_start,
+    sequence_end,
+    priority,
+    timeout,
+    parameters,
+) -> pb.ModelInferRequest:
+    """Build a ModelInferRequest (reference: grpc/_utils.py:80-143)."""
+    request = pb.ModelInferRequest()
+    request.model_name = model_name
+    request.model_version = model_version
+    if request_id:
+        request.id = request_id
+    if sequence_id:
+        if isinstance(sequence_id, str):
+            request.parameters["sequence_id"].string_param = sequence_id
+        else:
+            request.parameters["sequence_id"].int64_param = sequence_id
+        request.parameters["sequence_start"].bool_param = sequence_start
+        request.parameters["sequence_end"].bool_param = sequence_end
+    if priority:
+        request.parameters["priority"].uint64_param = priority
+    if timeout:
+        request.parameters["timeout"].int64_param = timeout
+
+    for infer_input in infer_inputs:
+        request.inputs.extend([infer_input._get_tensor()])
+        raw = infer_input._get_content()
+        if raw is not None:
+            request.raw_input_contents.extend([raw])
+    if outputs:
+        for infer_output in outputs:
+            request.outputs.extend([infer_output._get_tensor()])
+
+    if parameters:
+        for key, value in parameters.items():
+            if key in _RESERVED_PARAMS:
+                raise InferenceServerException(
+                    f"Parameter {key} is a reserved parameter and cannot be specified."
+                )
+            if isinstance(value, bool):
+                request.parameters[key].bool_param = value
+            elif isinstance(value, int):
+                request.parameters[key].int64_param = value
+            elif isinstance(value, float):
+                request.parameters[key].double_param = value
+            elif isinstance(value, str):
+                request.parameters[key].string_param = value
+            else:
+                raise InferenceServerException(
+                    f"Unsupported parameter type for {key}: {type(value)}"
+                )
+    return request

@@ -1,0 +1,432 @@
+"""asyncio gRPC client over grpc.aio.
+
+Reference parity: tritonclient/grpc/aio/__init__.py:50-810 — async mirror of
+the sync client reusing the same request builders and InferResult, plus
+``stream_infer`` returning an async response iterator with ``.cancel()``.
+"""
+
+from typing import AsyncIterator, Dict, Optional
+
+import grpc
+
+from google.protobuf import json_format
+
+from tritonclient_tpu._client import InferenceServerClientBase
+from tritonclient_tpu._request import Request
+from tritonclient_tpu.grpc._client import MAX_GRPC_MESSAGE_SIZE, KeepAliveOptions
+from tritonclient_tpu.grpc._infer_input import InferInput  # noqa: F401
+from tritonclient_tpu.grpc._infer_result import InferResult
+from tritonclient_tpu.grpc._requested_output import InferRequestedOutput  # noqa: F401
+from tritonclient_tpu.grpc._utils import (
+    _get_inference_request,
+    get_error_grpc,
+    grpc_compression_type,
+    raise_error_grpc,
+)
+from tritonclient_tpu.protocol import GRPCInferenceServiceStub, pb
+from tritonclient_tpu.utils import InferenceServerException, raise_error
+
+
+class InferenceServerClient(InferenceServerClientBase):
+    """asyncio client; all methods are coroutines."""
+
+    def __init__(
+        self,
+        url: str,
+        verbose: bool = False,
+        ssl: bool = False,
+        root_certificates: Optional[str] = None,
+        private_key: Optional[str] = None,
+        certificate_chain: Optional[str] = None,
+        creds: Optional[grpc.ChannelCredentials] = None,
+        keepalive_options: Optional[KeepAliveOptions] = None,
+        channel_args=None,
+    ):
+        super().__init__()
+        if keepalive_options is None:
+            keepalive_options = KeepAliveOptions()
+        if channel_args is not None:
+            channel_opt = list(channel_args)
+        else:
+            channel_opt = [
+                ("grpc.max_send_message_length", MAX_GRPC_MESSAGE_SIZE),
+                ("grpc.max_receive_message_length", MAX_GRPC_MESSAGE_SIZE),
+                ("grpc.keepalive_time_ms", keepalive_options.keepalive_time_ms),
+                ("grpc.keepalive_timeout_ms", keepalive_options.keepalive_timeout_ms),
+                (
+                    "grpc.keepalive_permit_without_calls",
+                    keepalive_options.keepalive_permit_without_calls,
+                ),
+                (
+                    "grpc.http2.max_pings_without_data",
+                    keepalive_options.http2_max_pings_without_data,
+                ),
+            ]
+        if creds is not None:
+            self._channel = grpc.aio.secure_channel(url, creds, options=channel_opt)
+        elif ssl:
+            def read(path):
+                if path is None:
+                    return None
+                with open(path, "rb") as f:
+                    return f.read()
+
+            credentials = grpc.ssl_channel_credentials(
+                root_certificates=read(root_certificates),
+                private_key=read(private_key),
+                certificate_chain=read(certificate_chain),
+            )
+            self._channel = grpc.aio.secure_channel(url, credentials, options=channel_opt)
+        else:
+            self._channel = grpc.aio.insecure_channel(url, options=channel_opt)
+        self._client_stub = GRPCInferenceServiceStub(self._channel)
+        self._verbose = verbose
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.close()
+
+    async def close(self):
+        await self._channel.close()
+
+    def _get_metadata(self, headers: Optional[Dict[str, str]]):
+        headers = dict(headers) if headers else {}
+        request = Request(headers)
+        self._call_plugin(request)
+        return tuple(request.headers.items())
+
+    @staticmethod
+    def _return(response, as_json: bool):
+        if as_json:
+            return json_format.MessageToDict(response, preserving_proto_field_name=True)
+        return response
+
+    # -- health --------------------------------------------------------------
+
+    async def is_server_live(self, headers=None, client_timeout=None) -> bool:
+        try:
+            response = await self._client_stub.ServerLive(
+                pb.ServerLiveRequest(), metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+            return response.live
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    async def is_server_ready(self, headers=None, client_timeout=None) -> bool:
+        try:
+            response = await self._client_stub.ServerReady(
+                pb.ServerReadyRequest(), metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+            return response.ready
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    async def is_model_ready(self, model_name, model_version="", headers=None, client_timeout=None) -> bool:
+        try:
+            response = await self._client_stub.ModelReady(
+                pb.ModelReadyRequest(name=model_name, version=model_version),
+                metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+            )
+            return response.ready
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    # -- metadata / admin ----------------------------------------------------
+
+    async def get_server_metadata(self, headers=None, as_json=False, client_timeout=None):
+        try:
+            response = await self._client_stub.ServerMetadata(
+                pb.ServerMetadataRequest(), metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+            return self._return(response, as_json)
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    async def get_model_metadata(self, model_name, model_version="", headers=None, as_json=False, client_timeout=None):
+        try:
+            response = await self._client_stub.ModelMetadata(
+                pb.ModelMetadataRequest(name=model_name, version=model_version),
+                metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+            )
+            return self._return(response, as_json)
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    async def get_model_config(self, model_name, model_version="", headers=None, as_json=False, client_timeout=None):
+        try:
+            response = await self._client_stub.ModelConfig(
+                pb.ModelConfigRequest(name=model_name, version=model_version),
+                metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+            )
+            return self._return(response, as_json)
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    async def get_model_repository_index(self, headers=None, as_json=False, client_timeout=None):
+        try:
+            response = await self._client_stub.RepositoryIndex(
+                pb.RepositoryIndexRequest(), metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+            return self._return(response, as_json)
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    async def load_model(self, model_name, headers=None, config=None, files=None, client_timeout=None):
+        try:
+            request = pb.RepositoryModelLoadRequest(model_name=model_name)
+            if config is not None:
+                request.parameters["config"].string_param = config
+            if files is not None:
+                for path, content in files.items():
+                    request.parameters[path].bytes_param = content
+            await self._client_stub.RepositoryModelLoad(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    async def unload_model(self, model_name, headers=None, unload_dependents=False, client_timeout=None):
+        try:
+            request = pb.RepositoryModelUnloadRequest(model_name=model_name)
+            request.parameters["unload_dependents"].bool_param = unload_dependents
+            await self._client_stub.RepositoryModelUnload(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    async def get_inference_statistics(self, model_name="", model_version="", headers=None, as_json=False, client_timeout=None):
+        try:
+            response = await self._client_stub.ModelStatistics(
+                pb.ModelStatisticsRequest(name=model_name, version=model_version),
+                metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+            )
+            return self._return(response, as_json)
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    async def update_trace_settings(self, model_name="", settings=None, headers=None, as_json=False, client_timeout=None):
+        try:
+            request = pb.TraceSettingRequest(model_name=model_name)
+            for key, value in (settings or {}).items():
+                if value is None:
+                    request.settings[key].SetInParent()
+                else:
+                    values = value if isinstance(value, (list, tuple)) else [value]
+                    request.settings[key].value.extend([str(v) for v in values])
+            response = await self._client_stub.TraceSetting(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+            return self._return(response, as_json)
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    async def get_trace_settings(self, model_name="", headers=None, as_json=False, client_timeout=None):
+        return await self.update_trace_settings(model_name, {}, headers, as_json, client_timeout)
+
+    async def update_log_settings(self, settings, headers=None, as_json=False, client_timeout=None):
+        try:
+            request = pb.LogSettingsRequest()
+            for key, value in (settings or {}).items():
+                if value is None:
+                    request.settings[key].SetInParent()
+                elif isinstance(value, bool):
+                    request.settings[key].bool_param = value
+                elif isinstance(value, int):
+                    request.settings[key].uint32_param = value
+                else:
+                    request.settings[key].string_param = str(value)
+            response = await self._client_stub.LogSettings(
+                request, metadata=self._get_metadata(headers), timeout=client_timeout
+            )
+            return self._return(response, as_json)
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    async def get_log_settings(self, headers=None, as_json=False, client_timeout=None):
+        return await self.update_log_settings({}, headers, as_json, client_timeout)
+
+    # -- shared memory admin -------------------------------------------------
+
+    async def get_system_shared_memory_status(self, region_name="", headers=None, as_json=False, client_timeout=None):
+        try:
+            response = await self._client_stub.SystemSharedMemoryStatus(
+                pb.SystemSharedMemoryStatusRequest(name=region_name),
+                metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+            )
+            return self._return(response, as_json)
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    async def register_system_shared_memory(self, name, key, byte_size, offset=0, headers=None, client_timeout=None):
+        try:
+            await self._client_stub.SystemSharedMemoryRegister(
+                pb.SystemSharedMemoryRegisterRequest(
+                    name=name, key=key, offset=offset, byte_size=byte_size
+                ),
+                metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+            )
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    async def unregister_system_shared_memory(self, name="", headers=None, client_timeout=None):
+        try:
+            await self._client_stub.SystemSharedMemoryUnregister(
+                pb.SystemSharedMemoryUnregisterRequest(name=name),
+                metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+            )
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    async def get_tpu_shared_memory_status(self, region_name="", headers=None, as_json=False, client_timeout=None):
+        try:
+            response = await self._client_stub.TpuSharedMemoryStatus(
+                pb.TpuSharedMemoryStatusRequest(name=region_name),
+                metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+            )
+            return self._return(response, as_json)
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    async def register_tpu_shared_memory(self, name, raw_handle, device_id, byte_size, headers=None, client_timeout=None):
+        try:
+            await self._client_stub.TpuSharedMemoryRegister(
+                pb.TpuSharedMemoryRegisterRequest(
+                    name=name, raw_handle=raw_handle, device_id=device_id, byte_size=byte_size
+                ),
+                metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+            )
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    async def unregister_tpu_shared_memory(self, name="", headers=None, client_timeout=None):
+        try:
+            await self._client_stub.TpuSharedMemoryUnregister(
+                pb.TpuSharedMemoryUnregisterRequest(name=name),
+                metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+            )
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    # -- inference -----------------------------------------------------------
+
+    async def infer(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        client_timeout=None,
+        headers=None,
+        compression_algorithm=None,
+        parameters=None,
+    ) -> InferResult:
+        request = _get_inference_request(
+            infer_inputs=inputs,
+            model_name=model_name,
+            model_version=model_version,
+            request_id=request_id,
+            outputs=outputs,
+            sequence_id=sequence_id,
+            sequence_start=sequence_start,
+            sequence_end=sequence_end,
+            priority=priority,
+            timeout=timeout,
+            parameters=parameters,
+        )
+        try:
+            response = await self._client_stub.ModelInfer(
+                request,
+                metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+                compression=grpc_compression_type(compression_algorithm),
+            )
+            return InferResult(response)
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    def stream_infer(
+        self,
+        inputs_iterator,
+        stream_timeout=None,
+        headers=None,
+        compression_algorithm=None,
+    ):
+        """Bidi streaming: feed an async iterator of request dicts, get back an
+        async iterator of (InferResult, error) tuples with ``.cancel()``
+        (reference: grpc/aio/__init__.py:688-799).
+
+        Each request dict takes the kwargs of ``infer`` (model_name, inputs,
+        outputs, request_id, sequence_id, ..., enable_empty_final_response).
+        """
+        async def _request_iterator():
+            async for request_kwargs in inputs_iterator:
+                enable_final = request_kwargs.pop("enable_empty_final_response", False)
+                request = _get_inference_request(
+                    infer_inputs=request_kwargs["inputs"],
+                    model_name=request_kwargs["model_name"],
+                    model_version=request_kwargs.get("model_version", ""),
+                    request_id=request_kwargs.get("request_id", ""),
+                    outputs=request_kwargs.get("outputs"),
+                    sequence_id=request_kwargs.get("sequence_id", 0),
+                    sequence_start=request_kwargs.get("sequence_start", False),
+                    sequence_end=request_kwargs.get("sequence_end", False),
+                    priority=request_kwargs.get("priority", 0),
+                    timeout=request_kwargs.get("timeout"),
+                    parameters=request_kwargs.get("parameters"),
+                )
+                if enable_final:
+                    request.parameters[
+                        "triton_enable_empty_final_response"
+                    ].bool_param = True
+                yield request
+
+        call = self._client_stub.ModelStreamInfer(
+            _request_iterator(),
+            metadata=self._get_metadata(headers),
+            timeout=stream_timeout,
+            compression=grpc_compression_type(compression_algorithm),
+        )
+        return _ResponseIterator(call)
+
+
+class _ResponseIterator:
+    """Async iterator of (InferResult, error) with cancellation."""
+
+    def __init__(self, call):
+        self._call = call
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        try:
+            response = await self._call.read()
+        except grpc.RpcError as rpc_error:
+            raise get_error_grpc(rpc_error) from None
+        if response is grpc.aio.EOF:
+            raise StopAsyncIteration
+        if response.error_message:
+            return None, InferenceServerException(msg=response.error_message)
+        return InferResult(response.infer_response), None
+
+    def cancel(self):
+        self._call.cancel()

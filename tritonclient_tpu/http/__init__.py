@@ -1,0 +1,10 @@
+"""HTTP client package (reference parity: tritonclient/http/__init__.py)."""
+
+from tritonclient_tpu.http._client import (  # noqa: F401
+    InferAsyncRequest,
+    InferenceServerClient,
+)
+from tritonclient_tpu.http._infer_input import InferInput  # noqa: F401
+from tritonclient_tpu.http._infer_result import InferResult  # noqa: F401
+from tritonclient_tpu.http._requested_output import InferRequestedOutput  # noqa: F401
+from tritonclient_tpu.utils import InferenceServerException  # noqa: F401

@@ -1,0 +1,627 @@
+"""Synchronous HTTP/REST client for the KServe v2 protocol.
+
+Full method-surface parity with the reference client
+(tritonclient/http/_client.py:102-1659). The reference rides geventhttpclient
+with a greenlet pool; neither exists in a TPU image, so this build uses a
+plain http.client connection pool with a bounded thread pool for async_infer —
+preserving the behavioral contract that at most ``concurrency`` requests are
+in flight and exceeding it blocks (http/_client.py:1489-1493).
+"""
+
+import gzip
+import http.client
+import json
+import queue
+import socket
+import ssl as ssl_module
+import threading
+import zlib
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, Optional
+from urllib.parse import urlparse
+
+from tritonclient_tpu._client import InferenceServerClientBase
+from tritonclient_tpu._request import Request
+from tritonclient_tpu.http._infer_result import InferResult
+from tritonclient_tpu.http._utils import (
+    _get_inference_request,
+    _get_query_string,
+    _raise_if_error,
+)
+from tritonclient_tpu.utils import InferenceServerException, raise_error
+
+
+class InferAsyncRequest:
+    """Handle for an in-flight async_infer (reference: http/_client.py:46-99)."""
+
+    def __init__(self, future: Future, verbose: bool = False):
+        self._future = future
+        self._verbose = verbose
+
+    def get_result(self, block: bool = True, timeout: Optional[float] = None) -> InferResult:
+        """Wait for and return the InferResult (raises on server error)."""
+        try:
+            return self._future.result(timeout=timeout if block else 0)
+        except TimeoutError:
+            raise InferenceServerException(
+                msg="failed to obtain inference response"
+            ) from None
+
+    def cancel(self) -> bool:
+        return self._future.cancel()
+
+
+class _ConnectionPool:
+    """Bounded pool of persistent HTTP/1.1 connections to one host."""
+
+    def __init__(self, scheme, host, port, size, connection_timeout, network_timeout, ssl_context):
+        self._scheme = scheme
+        self._host = host
+        self._port = port
+        self._size = size
+        self._connection_timeout = connection_timeout
+        self._network_timeout = network_timeout
+        self._ssl_context = ssl_context
+        self._idle = queue.LifoQueue()
+        self._created = 0
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def _new_connection(self):
+        if self._scheme == "https":
+            return http.client.HTTPSConnection(
+                self._host,
+                self._port,
+                timeout=self._network_timeout,
+                context=self._ssl_context,
+            )
+        return http.client.HTTPConnection(
+            self._host, self._port, timeout=self._network_timeout
+        )
+
+    def acquire(self):
+        try:
+            return self._idle.get_nowait()
+        except queue.Empty:
+            pass
+        with self._lock:
+            if self._created < self._size:
+                self._created += 1
+                return self._new_connection()
+        return self._idle.get()  # block until a connection frees up
+
+    def release(self, conn):
+        if self._closed:
+            conn.close()
+        else:
+            self._idle.put(conn)
+
+    def discard(self, conn):
+        conn.close()
+        with self._lock:
+            self._created -= 1
+
+    def close(self):
+        self._closed = True
+        while True:
+            try:
+                self._idle.get_nowait().close()
+            except queue.Empty:
+                break
+
+
+class InferenceServerClient(InferenceServerClientBase):
+    """Talks to the server over HTTP/REST.
+
+    One client maps to one connection pool; use the ``concurrency`` parameter
+    to bound in-flight requests (reference: http/_client.py:119-152).
+    """
+
+    def __init__(
+        self,
+        url: str,
+        verbose: bool = False,
+        concurrency: int = 1,
+        connection_timeout: float = 60.0,
+        network_timeout: float = 60.0,
+        max_greenlets=None,  # accepted for API parity; thread pool sizing == concurrency
+        ssl: bool = False,
+        ssl_options: Optional[dict] = None,
+        ssl_context_factory=None,
+        insecure: bool = False,
+    ):
+        super().__init__()
+        if url.startswith("http://") or url.startswith("https://"):
+            raise_error("url should not include the scheme")
+        scheme = "https" if ssl else "http"
+        parsed = urlparse(f"{scheme}://{url}")
+        self._host = parsed.hostname
+        self._port = parsed.port or (443 if ssl else 80)
+        self._base_path = parsed.path.rstrip("/")
+        self._verbose = verbose
+
+        ssl_context = None
+        if ssl:
+            if ssl_context_factory is not None:
+                ssl_context = ssl_context_factory()
+            else:
+                ssl_context = ssl_module.create_default_context()
+                options = ssl_options or {}
+                if "ca_certs" in options:
+                    ssl_context.load_verify_locations(options["ca_certs"])
+                if "keyfile" in options and "certfile" in options:
+                    ssl_context.load_cert_chain(
+                        options["certfile"], options["keyfile"]
+                    )
+                if insecure:
+                    ssl_context.check_hostname = False
+                    ssl_context.verify_mode = ssl_module.CERT_NONE
+
+        self._pool = _ConnectionPool(
+            scheme,
+            self._host,
+            self._port,
+            max(concurrency, 1),
+            connection_timeout,
+            network_timeout,
+            ssl_context,
+        )
+        self._executor = ThreadPoolExecutor(max_workers=max(concurrency, 1))
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def close(self):
+        """Close the client and all pooled connections."""
+        self._executor.shutdown(wait=True)
+        self._pool.close()
+
+    # -- low-level request ---------------------------------------------------
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[bytes] = None,
+        headers: Optional[Dict[str, str]] = None,
+        query_params: Optional[dict] = None,
+    ):
+        headers = dict(headers) if headers else {}
+        for key in headers:
+            if key.lower() == "transfer-encoding":
+                raise_error(
+                    "Unsupported Transfer-Encoding header; the client always "
+                    "sends Content-Length"
+                )
+        request_obj = Request(headers)
+        self._call_plugin(request_obj)
+        headers = request_obj.headers
+
+        uri = f"{self._base_path}/{path}{_get_query_string(query_params)}"
+        if self._verbose:
+            print(f"{method} {uri}, headers {headers}")
+
+        conn = self._pool.acquire()
+        try:
+            conn.request(method, uri, body=body, headers=headers)
+            response = conn.getresponse()
+            payload = response.read()
+        except TimeoutError:
+            # A timed-out request must NOT be retried (infer is not
+            # idempotent and the retry would double the effective timeout).
+            self._pool.discard(conn)
+            raise InferenceServerException(msg="timed out") from None
+        except (http.client.HTTPException, OSError):
+            # Stale keep-alive connection: retry once on a fresh one.
+            self._pool.discard(conn)
+            conn = self._pool.acquire()
+            try:
+                conn.request(method, uri, body=body, headers=headers)
+                response = conn.getresponse()
+                payload = response.read()
+            except (http.client.HTTPException, OSError) as e:
+                self._pool.discard(conn)
+                raise InferenceServerException(msg=str(e)) from None
+        self._pool.release(conn)
+        if self._verbose:
+            print(response.status, response.headers)
+        return response.status, response.headers, payload
+
+    def _get(self, path, headers=None, query_params=None):
+        return self._request("GET", path, headers=headers, query_params=query_params)
+
+    def _post(self, path, body=b"", headers=None, query_params=None):
+        return self._request("POST", path, body=body, headers=headers, query_params=query_params)
+
+    # -- health --------------------------------------------------------------
+
+    def is_server_live(self, headers=None, query_params=None) -> bool:
+        status, _, _ = self._get("v2/health/live", headers, query_params)
+        return status == 200
+
+    def is_server_ready(self, headers=None, query_params=None) -> bool:
+        status, _, _ = self._get("v2/health/ready", headers, query_params)
+        return status == 200
+
+    def is_model_ready(self, model_name, model_version="", headers=None, query_params=None) -> bool:
+        path = f"v2/models/{model_name}"
+        if model_version:
+            path += f"/versions/{model_version}"
+        status, _, _ = self._get(path + "/ready", headers, query_params)
+        return status == 200
+
+    # -- metadata / config ---------------------------------------------------
+
+    def get_server_metadata(self, headers=None, query_params=None) -> dict:
+        status, _, body = self._get("v2", headers, query_params)
+        _raise_if_error(status, body)
+        return json.loads(body)
+
+    def get_model_metadata(self, model_name, model_version="", headers=None, query_params=None) -> dict:
+        path = f"v2/models/{model_name}"
+        if model_version:
+            path += f"/versions/{model_version}"
+        status, _, body = self._get(path, headers, query_params)
+        _raise_if_error(status, body)
+        return json.loads(body)
+
+    def get_model_config(self, model_name, model_version="", headers=None, query_params=None) -> dict:
+        path = f"v2/models/{model_name}"
+        if model_version:
+            path += f"/versions/{model_version}"
+        status, _, body = self._get(path + "/config", headers, query_params)
+        _raise_if_error(status, body)
+        return json.loads(body)
+
+    # -- repository ----------------------------------------------------------
+
+    def get_model_repository_index(self, headers=None, query_params=None) -> list:
+        status, _, body = self._post("v2/repository/index", b"{}", headers, query_params)
+        _raise_if_error(status, body)
+        return json.loads(body)
+
+    def load_model(self, model_name, headers=None, query_params=None, config=None, files=None):
+        payload = {}
+        if config is not None or files is not None:
+            parameters = {}
+            if config is not None:
+                parameters["config"] = config
+            if files is not None:
+                import base64 as b64
+
+                for path, content in files.items():
+                    parameters[path] = b64.b64encode(content).decode()
+            payload["parameters"] = parameters
+        status, _, body = self._post(
+            f"v2/repository/models/{model_name}/load",
+            json.dumps(payload).encode(),
+            headers,
+            query_params,
+        )
+        _raise_if_error(status, body)
+        if self._verbose:
+            print(f"Loaded model '{model_name}'")
+
+    def unload_model(self, model_name, headers=None, query_params=None, unload_dependents=False):
+        payload = {"parameters": {"unload_dependents": unload_dependents}}
+        status, _, body = self._post(
+            f"v2/repository/models/{model_name}/unload",
+            json.dumps(payload).encode(),
+            headers,
+            query_params,
+        )
+        _raise_if_error(status, body)
+        if self._verbose:
+            print(f"Unloaded model '{model_name}'")
+
+    # -- statistics ----------------------------------------------------------
+
+    def get_inference_statistics(self, model_name="", model_version="", headers=None, query_params=None) -> dict:
+        if model_name:
+            path = f"v2/models/{model_name}"
+            if model_version:
+                path += f"/versions/{model_version}"
+            path += "/stats"
+        else:
+            path = "v2/models/stats"
+        status, _, body = self._get(path, headers, query_params)
+        _raise_if_error(status, body)
+        return json.loads(body)
+
+    # -- trace / log settings ------------------------------------------------
+
+    def update_trace_settings(self, model_name="", settings=None, headers=None, query_params=None) -> dict:
+        path = f"v2/models/{model_name}/trace/setting" if model_name else "v2/trace/setting"
+        status, _, body = self._post(
+            path, json.dumps(settings or {}).encode(), headers, query_params
+        )
+        _raise_if_error(status, body)
+        return json.loads(body)
+
+    def get_trace_settings(self, model_name="", headers=None, query_params=None) -> dict:
+        path = f"v2/models/{model_name}/trace/setting" if model_name else "v2/trace/setting"
+        status, _, body = self._get(path, headers, query_params)
+        _raise_if_error(status, body)
+        return json.loads(body)
+
+    def update_log_settings(self, settings: dict, headers=None, query_params=None) -> dict:
+        status, _, body = self._post(
+            "v2/logging", json.dumps(settings or {}).encode(), headers, query_params
+        )
+        _raise_if_error(status, body)
+        return json.loads(body)
+
+    def get_log_settings(self, headers=None, query_params=None) -> dict:
+        status, _, body = self._get("v2/logging", headers, query_params)
+        _raise_if_error(status, body)
+        return json.loads(body)
+
+    # -- shared memory admin -------------------------------------------------
+
+    def get_system_shared_memory_status(self, region_name="", headers=None, query_params=None) -> list:
+        path = "v2/systemsharedmemory"
+        if region_name:
+            path += f"/region/{region_name}"
+        status, _, body = self._get(path + "/status", headers, query_params)
+        _raise_if_error(status, body)
+        return json.loads(body)
+
+    def register_system_shared_memory(self, name, key, byte_size, offset=0, headers=None, query_params=None):
+        payload = {"key": key, "offset": offset, "byte_size": byte_size}
+        status, _, body = self._post(
+            f"v2/systemsharedmemory/region/{name}/register",
+            json.dumps(payload).encode(),
+            headers,
+            query_params,
+        )
+        _raise_if_error(status, body)
+        if self._verbose:
+            print(f"Registered system shared memory with name '{name}'")
+
+    def unregister_system_shared_memory(self, name="", headers=None, query_params=None):
+        path = (
+            f"v2/systemsharedmemory/region/{name}/unregister"
+            if name
+            else "v2/systemsharedmemory/unregister"
+        )
+        status, _, body = self._post(path, b"", headers, query_params)
+        _raise_if_error(status, body)
+
+    def get_cuda_shared_memory_status(self, region_name="", headers=None, query_params=None) -> list:
+        path = "v2/cudasharedmemory"
+        if region_name:
+            path += f"/region/{region_name}"
+        status, _, body = self._get(path + "/status", headers, query_params)
+        _raise_if_error(status, body)
+        return json.loads(body)
+
+    def register_cuda_shared_memory(self, name, raw_handle, device_id, byte_size, headers=None, query_params=None):
+        import base64 as b64
+
+        payload = {
+            "raw_handle": {"b64": b64.b64encode(raw_handle).decode()},
+            "device_id": device_id,
+            "byte_size": byte_size,
+        }
+        status, _, body = self._post(
+            f"v2/cudasharedmemory/region/{name}/register",
+            json.dumps(payload).encode(),
+            headers,
+            query_params,
+        )
+        _raise_if_error(status, body)
+
+    def unregister_cuda_shared_memory(self, name="", headers=None, query_params=None):
+        path = (
+            f"v2/cudasharedmemory/region/{name}/unregister"
+            if name
+            else "v2/cudasharedmemory/unregister"
+        )
+        status, _, body = self._post(path, b"", headers, query_params)
+        _raise_if_error(status, body)
+
+    def get_tpu_shared_memory_status(self, region_name="", headers=None, query_params=None) -> list:
+        """Status of registered TPU device-buffer regions."""
+        path = "v2/tpusharedmemory"
+        if region_name:
+            path += f"/region/{region_name}"
+        status, _, body = self._get(path + "/status", headers, query_params)
+        _raise_if_error(status, body)
+        return json.loads(body)
+
+    def register_tpu_shared_memory(self, name, raw_handle, device_id, byte_size, headers=None, query_params=None):
+        """Register a TPU region by raw co-location handle (base64 on the wire,
+        mirroring the CUDA register path http/_client.py:1129-1175)."""
+        import base64 as b64
+
+        payload = {
+            "raw_handle": {"b64": b64.b64encode(raw_handle).decode()},
+            "device_id": device_id,
+            "byte_size": byte_size,
+        }
+        status, _, body = self._post(
+            f"v2/tpusharedmemory/region/{name}/register",
+            json.dumps(payload).encode(),
+            headers,
+            query_params,
+        )
+        _raise_if_error(status, body)
+
+    def unregister_tpu_shared_memory(self, name="", headers=None, query_params=None):
+        path = (
+            f"v2/tpusharedmemory/region/{name}/unregister"
+            if name
+            else "v2/tpusharedmemory/unregister"
+        )
+        status, _, body = self._post(path, b"", headers, query_params)
+        _raise_if_error(status, body)
+
+    # -- inference -----------------------------------------------------------
+
+    @staticmethod
+    def generate_request_body(
+        inputs,
+        request_id="",
+        outputs=None,
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        parameters=None,
+    ):
+        """Build an infer POST body without sending it
+        (reference: http/_client.py:1219-1302). Returns (body, json_size)."""
+        return _get_inference_request(
+            inputs=inputs,
+            request_id=request_id,
+            outputs=outputs,
+            sequence_id=sequence_id,
+            sequence_start=sequence_start,
+            sequence_end=sequence_end,
+            priority=priority,
+            timeout=timeout,
+            custom_parameters=parameters,
+        )
+
+    @staticmethod
+    def parse_response_body(response_body, verbose=False, header_length=None, content_encoding=None):
+        """Inverse of generate_request_body for responses
+        (reference: http/_client.py:1304-1329)."""
+        return InferResult.from_response_body(
+            response_body, verbose, header_length, content_encoding
+        )
+
+    def _build_infer(
+        self,
+        model_name,
+        inputs,
+        model_version,
+        outputs,
+        request_id,
+        sequence_id,
+        sequence_start,
+        sequence_end,
+        priority,
+        timeout,
+        request_compression_algorithm,
+        response_compression_algorithm,
+        parameters,
+    ):
+        request_body, json_size = _get_inference_request(
+            inputs=inputs,
+            request_id=request_id,
+            outputs=outputs,
+            sequence_id=sequence_id,
+            sequence_start=sequence_start,
+            sequence_end=sequence_end,
+            priority=priority,
+            timeout=timeout,
+            custom_parameters=parameters,
+        )
+        headers = {}
+        if request_compression_algorithm == "gzip":
+            headers["Content-Encoding"] = "gzip"
+            request_body = gzip.compress(request_body)
+        elif request_compression_algorithm == "deflate":
+            headers["Content-Encoding"] = "deflate"
+            request_body = zlib.compress(request_body)
+        if response_compression_algorithm == "gzip":
+            headers["Accept-Encoding"] = "gzip"
+        elif response_compression_algorithm == "deflate":
+            headers["Accept-Encoding"] = "deflate"
+        if json_size is not None:
+            headers["Inference-Header-Content-Length"] = str(json_size)
+
+        path = f"v2/models/{model_name}"
+        if model_version:
+            path += f"/versions/{model_version}"
+        path += "/infer"
+        return path, request_body, headers
+
+    def infer(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        headers=None,
+        query_params=None,
+        request_compression_algorithm=None,
+        response_compression_algorithm=None,
+        parameters=None,
+    ) -> InferResult:
+        """Synchronous inference (reference: http/_client.py:1331-1484)."""
+        path, request_body, extra_headers = self._build_infer(
+            model_name, inputs, model_version, outputs, request_id,
+            sequence_id, sequence_start, sequence_end, priority, timeout,
+            request_compression_algorithm, response_compression_algorithm,
+            parameters,
+        )
+        all_headers = dict(headers) if headers else {}
+        all_headers.update(extra_headers)
+        status, resp_headers, body = self._post(path, request_body, all_headers, query_params)
+        _raise_if_error(status, body)
+        header_length = resp_headers.get("Inference-Header-Content-Length")
+        return InferResult(
+            body,
+            int(header_length) if header_length is not None else None,
+            resp_headers.get("Content-Encoding"),
+        )
+
+    def async_infer(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        headers=None,
+        query_params=None,
+        request_compression_algorithm=None,
+        response_compression_algorithm=None,
+        parameters=None,
+    ) -> InferAsyncRequest:
+        """Submit inference on the bounded pool; returns an InferAsyncRequest
+        whose get_result() blocks (reference: http/_client.py:1486-1659)."""
+        future = self._executor.submit(
+            self.infer,
+            model_name,
+            inputs,
+            model_version,
+            outputs,
+            request_id,
+            sequence_id,
+            sequence_start,
+            sequence_end,
+            priority,
+            timeout,
+            headers,
+            query_params,
+            request_compression_algorithm,
+            response_compression_algorithm,
+            parameters,
+        )
+        return InferAsyncRequest(future, self._verbose)

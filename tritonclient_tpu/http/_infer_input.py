@@ -1,0 +1,126 @@
+"""InferInput for the HTTP client (JSON-dict tensor descriptor).
+
+Reference parity: tritonclient/http/_infer_input.py:38-272 — per-input
+``binary_data`` toggle selects JSON inline data vs an appended binary blob with
+a ``binary_data_size`` parameter.
+"""
+
+from typing import List
+
+import numpy as np
+
+from tritonclient_tpu.utils import (
+    np_to_triton_dtype,
+    raise_error,
+    serialize_bf16_tensor,
+    serialize_byte_tensor,
+)
+
+
+class InferInput:
+    def __init__(self, name: str, shape: List[int], datatype: str):
+        self._name = name
+        self._shape = list(shape)
+        self._datatype = datatype
+        self._parameters = {}
+        self._data = None
+        self._raw_data = None
+
+    def name(self) -> str:
+        return self._name
+
+    def datatype(self) -> str:
+        return self._datatype
+
+    def shape(self) -> List[int]:
+        return list(self._shape)
+
+    def set_shape(self, shape: List[int]):
+        self._shape = list(shape)
+        return self
+
+    def set_data_from_numpy(self, input_tensor, binary_data: bool = True):
+        """Attach tensor data, as an appended binary blob (default) or inline
+        JSON (binary_data=False)."""
+        if not isinstance(input_tensor, np.ndarray):
+            input_tensor = np.asarray(input_tensor)
+        dtype = np_to_triton_dtype(input_tensor.dtype)
+        if self._datatype == "BF16" and dtype == "FP32":
+            pass
+        elif dtype != self._datatype:
+            raise_error(
+                f"got unexpected datatype {dtype} from numpy array, "
+                f"expected {self._datatype}"
+            )
+        valid_shape = len(self._shape) == input_tensor.ndim and all(
+            int(a) == b for a, b in zip(self._shape, input_tensor.shape)
+        )
+        if not valid_shape:
+            raise_error(
+                f"got unexpected numpy array shape [{', '.join(str(s) for s in input_tensor.shape)}], "
+                f"expected [{', '.join(str(s) for s in self._shape)}]"
+            )
+
+        self._parameters.pop("shared_memory_region", None)
+        self._parameters.pop("shared_memory_byte_size", None)
+        self._parameters.pop("shared_memory_offset", None)
+
+        if not binary_data:
+            if self._datatype == "BF16":
+                raise_error("BF16 inputs must use binary_data=True (no JSON encoding)")
+            self._parameters.pop("binary_data_size", None)
+            self._raw_data = None
+            if self._datatype == "BYTES":
+                self._data = []
+                try:
+                    for obj in np.nditer(input_tensor, flags=["refs_ok"], order="C"):
+                        item = obj.item()
+                        if isinstance(item, bytes):
+                            self._data.append(item.decode("utf-8"))
+                        else:
+                            self._data.append(str(item))
+                except UnicodeDecodeError:
+                    raise_error(
+                        f'Failed to encode "{item}". Please use binary_data=True '
+                        "for BYTES inputs that are not valid UTF-8."
+                    )
+            else:
+                self._data = [i.item() for i in input_tensor.flatten()]
+        else:
+            self._data = None
+            if self._datatype == "BYTES":
+                serialized = serialize_byte_tensor(input_tensor)
+                self._raw_data = serialized.item() if serialized.size > 0 else b""
+            elif self._datatype == "BF16":
+                serialized = serialize_bf16_tensor(input_tensor)
+                self._raw_data = serialized.item() if serialized.size > 0 else b""
+            else:
+                self._raw_data = np.ascontiguousarray(input_tensor).tobytes()
+            self._parameters["binary_data_size"] = len(self._raw_data)
+        return self
+
+    def set_shared_memory(self, region_name: str, byte_size: int, offset: int = 0):
+        """Point this input at a registered shared-memory region."""
+        self._data = None
+        self._raw_data = None
+        self._parameters.pop("binary_data_size", None)
+        self._parameters["shared_memory_region"] = region_name
+        self._parameters["shared_memory_byte_size"] = byte_size
+        if offset != 0:
+            self._parameters["shared_memory_offset"] = offset
+        return self
+
+    def _get_tensor(self) -> dict:
+        tensor = {
+            "name": self._name,
+            "shape": self._shape,
+            "datatype": self._datatype,
+        }
+        if self._parameters:
+            tensor["parameters"] = self._parameters
+        if self._data is not None:
+            tensor["data"] = self._data
+        return tensor
+
+    def _get_binary_data(self):
+        return self._raw_data

@@ -1,0 +1,105 @@
+"""InferResult for the HTTP client: splits JSON header from binary buffers.
+
+Reference parity: tritonclient/http/_infer_result.py:41-242 — the response body
+is JSON up to ``Inference-Header-Content-Length``; outputs carrying
+``binary_data_size`` map name → offset in the trailing binary buffer.
+"""
+
+import gzip
+import json
+import zlib
+from typing import List, Optional
+
+import numpy as np
+
+from tritonclient_tpu.utils import (
+    deserialize_bf16_tensor,
+    deserialize_bytes_tensor,
+    raise_error,
+    triton_to_np_dtype,
+)
+
+
+class InferResult:
+    def __init__(self, response_body: bytes, header_length: Optional[int], content_encoding: Optional[str] = None):
+        if content_encoding == "gzip":
+            response_body = gzip.decompress(response_body)
+        elif content_encoding == "deflate":
+            response_body = zlib.decompress(response_body)
+
+        if header_length is None:
+            content = response_body
+            self._buffer = b""
+        else:
+            content = response_body[:header_length]
+            self._buffer = response_body[header_length:]
+        self._result = json.loads(content)
+
+        # Map output name → (offset, size) in the binary buffer.
+        self._output_name_to_buffer_map = {}
+        offset = 0
+        for output in self._result.get("outputs", []):
+            params = output.get("parameters", {})
+            if "binary_data_size" in params:
+                size = int(params["binary_data_size"])
+                self._output_name_to_buffer_map[output["name"]] = (offset, size)
+                offset += size
+
+    @classmethod
+    def from_response_body(
+        cls,
+        response_body: bytes,
+        verbose: bool = False,
+        header_length: Optional[int] = None,
+        content_encoding: Optional[str] = None,
+    ) -> "InferResult":
+        """Build an InferResult directly from a response body (for use with
+        generate_request_body/parse_response_body round-trips)."""
+        return cls(response_body, header_length, content_encoding)
+
+    def _get_output(self, name: str) -> Optional[dict]:
+        for output in self._result.get("outputs", []):
+            if output["name"] == name:
+                return output
+        return None
+
+    def as_numpy(self, name: str, bf16_native: bool = False) -> Optional[np.ndarray]:
+        output = self._get_output(name)
+        if output is None:
+            return None
+        datatype = output["datatype"]
+        shape = list(output["shape"])
+        if name in self._output_name_to_buffer_map:
+            offset, size = self._output_name_to_buffer_map[name]
+            raw = self._buffer[offset : offset + size]
+            if datatype == "BYTES":
+                return deserialize_bytes_tensor(raw).reshape(shape)
+            if datatype == "BF16":
+                if bf16_native:
+                    import ml_dtypes
+
+                    return np.frombuffer(raw, dtype=ml_dtypes.bfloat16).reshape(shape)
+                return deserialize_bf16_tensor(raw).reshape(shape)
+            return np.frombuffer(raw, dtype=triton_to_np_dtype(datatype)).reshape(shape)
+        data = output.get("data")
+        if data is None:
+            return None
+        if datatype == "BYTES":
+            arr = np.array(
+                [x.encode() if isinstance(x, str) else bytes(x) for x in data],
+                dtype=np.object_,
+            )
+            return arr.reshape(shape)
+        if datatype == "BF16":
+            raise_error("BF16 outputs are only supported as binary data")
+        return np.array(data, dtype=triton_to_np_dtype(datatype)).reshape(shape)
+
+    def get_output(self, name: str):
+        """The JSON dict of the named output (None if absent)."""
+        return self._get_output(name)
+
+    def get_response(self) -> dict:
+        return self._result
+
+    def output_names(self) -> List[str]:
+        return [o["name"] for o in self._result.get("outputs", [])]

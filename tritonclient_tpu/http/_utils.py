@@ -1,0 +1,94 @@
+"""HTTP request-body builder + error translation.
+
+Reference parity: tritonclient/http/_utils.py — JSON header with appended
+binary blobs; returns (body, json_size) where json_size None means pure JSON
+(:85-150); requesting no outputs sets binary_data_output=true (:114-117).
+"""
+
+import json
+from typing import Optional, Tuple
+from urllib.parse import quote_plus
+
+from tritonclient_tpu.utils import InferenceServerException, raise_error
+
+
+def _get_error(status: int, body: bytes) -> Optional[InferenceServerException]:
+    """Build an exception from a non-2xx response (JSON or plain-text body)."""
+    if status >= 400:
+        try:
+            msg = json.loads(body.decode("utf-8", errors="replace")).get("error", "")
+        except (ValueError, AttributeError):
+            msg = body.decode("utf-8", errors="replace")
+        return InferenceServerException(msg=msg or f"HTTP {status}", status=str(status))
+    return None
+
+
+def _raise_if_error(status: int, body: bytes):
+    error = _get_error(status, body)
+    if error is not None:
+        raise error
+
+
+def _get_query_string(query_params: Optional[dict]) -> str:
+    if not query_params:
+        return ""
+    parts = []
+    for key, value in query_params.items():
+        if isinstance(value, (list, tuple)):
+            parts.extend(f"{quote_plus(str(key))}={quote_plus(str(v))}" for v in value)
+        else:
+            parts.append(f"{quote_plus(str(key))}={quote_plus(str(value))}")
+    return "?" + "&".join(parts)
+
+
+def _get_inference_request(
+    inputs,
+    request_id,
+    outputs,
+    sequence_id,
+    sequence_start,
+    sequence_end,
+    priority,
+    timeout,
+    custom_parameters=None,
+) -> Tuple[bytes, Optional[int]]:
+    """Build the infer POST body; (body, json_size) with json_size=None when
+    the body is pure JSON (no appended binary blobs)."""
+    infer_request = {}
+    parameters = {}
+    if request_id:
+        infer_request["id"] = request_id
+    if sequence_id:
+        parameters["sequence_id"] = sequence_id
+        parameters["sequence_start"] = sequence_start
+        parameters["sequence_end"] = sequence_end
+    if priority:
+        parameters["priority"] = priority
+    if timeout is not None:
+        parameters["timeout"] = timeout
+
+    infer_request["inputs"] = [i._get_tensor() for i in inputs]
+    if outputs:
+        infer_request["outputs"] = [o._get_tensor() for o in outputs]
+    else:
+        # Default to binary data for all outputs when none are requested.
+        parameters["binary_data_output"] = True
+
+    for key, value in (custom_parameters or {}).items():
+        if key in ("sequence_id", "sequence_start", "sequence_end", "priority", "binary_data_output"):
+            raise_error(
+                f"Parameter {key} is a reserved parameter and cannot be specified."
+            )
+        parameters[key] = value
+    if parameters:
+        infer_request["parameters"] = parameters
+
+    request_json = json.dumps(infer_request).encode()
+    binary_blobs = []
+    for infer_input in inputs:
+        raw = infer_input._get_binary_data()
+        if raw is not None:
+            binary_blobs.append(raw)
+    if not binary_blobs:
+        return request_json, None
+    return request_json + b"".join(binary_blobs), len(request_json)

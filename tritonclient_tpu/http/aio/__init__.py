@@ -1,0 +1,303 @@
+"""asyncio HTTP client over aiohttp.
+
+Reference parity: tritonclient/http/aio/__init__.py:92-775 — asyncio mirror of
+the sync REST client (auto_decompress disabled so compressed responses flow to
+InferResult intact, TCPConnector connection limit = ``conn_limit``). HTTP has
+no streaming in the v2 protocol.
+"""
+
+import base64
+import gzip
+import json
+import zlib
+from typing import Optional
+
+import aiohttp
+
+from tritonclient_tpu._client import InferenceServerClientBase
+from tritonclient_tpu._request import Request
+from tritonclient_tpu.http._infer_input import InferInput  # noqa: F401
+from tritonclient_tpu.http._infer_result import InferResult
+from tritonclient_tpu.http._requested_output import InferRequestedOutput  # noqa: F401
+from tritonclient_tpu.http._utils import (
+    _get_inference_request,
+    _get_query_string,
+    _raise_if_error,
+)
+from tritonclient_tpu.utils import InferenceServerException, raise_error  # noqa: F401
+
+
+class InferenceServerClient(InferenceServerClientBase):
+    """asyncio REST client; all methods are coroutines."""
+
+    def __init__(
+        self,
+        url: str,
+        verbose: bool = False,
+        conn_limit: int = 100,
+        conn_timeout: float = 60.0,
+        ssl: bool = False,
+        ssl_context=None,
+    ):
+        super().__init__()
+        if url.startswith("http://") or url.startswith("https://"):
+            raise_error("url should not include the scheme")
+        scheme = "https" if ssl else "http"
+        self._url = f"{scheme}://{url}"
+        self._verbose = verbose
+        self._session = aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(
+                limit=conn_limit, ssl=ssl_context if ssl else False
+            ),
+            timeout=aiohttp.ClientTimeout(total=conn_timeout),
+            auto_decompress=False,
+        )
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.close()
+
+    async def close(self):
+        await self._session.close()
+
+    # -- low level -----------------------------------------------------------
+
+    def _prep_headers(self, headers):
+        headers = dict(headers) if headers else {}
+        request = Request(headers)
+        self._call_plugin(request)
+        return request.headers
+
+    async def _get(self, path, headers=None, query_params=None):
+        url = f"{self._url}/{path}{_get_query_string(query_params)}"
+        if self._verbose:
+            print("GET", url)
+        async with self._session.get(url, headers=self._prep_headers(headers)) as resp:
+            return resp.status, resp.headers, await resp.read()
+
+    async def _post(self, path, body=b"", headers=None, query_params=None):
+        url = f"{self._url}/{path}{_get_query_string(query_params)}"
+        if self._verbose:
+            print("POST", url)
+        async with self._session.post(
+            url, data=body, headers=self._prep_headers(headers)
+        ) as resp:
+            return resp.status, resp.headers, await resp.read()
+
+    @staticmethod
+    def _maybe_decompress(headers, body: bytes) -> bytes:
+        encoding = headers.get("Content-Encoding", "")
+        if encoding == "gzip":
+            return gzip.decompress(body)
+        if encoding == "deflate":
+            return zlib.decompress(body)
+        return body
+
+    # -- health --------------------------------------------------------------
+
+    async def is_server_live(self, headers=None, query_params=None) -> bool:
+        status, _, _ = await self._get("v2/health/live", headers, query_params)
+        return status == 200
+
+    async def is_server_ready(self, headers=None, query_params=None) -> bool:
+        status, _, _ = await self._get("v2/health/ready", headers, query_params)
+        return status == 200
+
+    async def is_model_ready(self, model_name, model_version="", headers=None, query_params=None) -> bool:
+        path = f"v2/models/{model_name}"
+        if model_version:
+            path += f"/versions/{model_version}"
+        status, _, _ = await self._get(path + "/ready", headers, query_params)
+        return status == 200
+
+    # -- metadata / admin ----------------------------------------------------
+
+    async def _get_json(self, path, headers, query_params):
+        status, resp_headers, body = await self._get(path, headers, query_params)
+        body = self._maybe_decompress(resp_headers, body)
+        _raise_if_error(status, body)
+        return json.loads(body)
+
+    async def _post_json(self, path, payload, headers, query_params):
+        status, resp_headers, body = await self._post(
+            path, json.dumps(payload).encode(), headers, query_params
+        )
+        body = self._maybe_decompress(resp_headers, body)
+        _raise_if_error(status, body)
+        return json.loads(body) if body else None
+
+    async def get_server_metadata(self, headers=None, query_params=None) -> dict:
+        return await self._get_json("v2", headers, query_params)
+
+    async def get_model_metadata(self, model_name, model_version="", headers=None, query_params=None) -> dict:
+        path = f"v2/models/{model_name}"
+        if model_version:
+            path += f"/versions/{model_version}"
+        return await self._get_json(path, headers, query_params)
+
+    async def get_model_config(self, model_name, model_version="", headers=None, query_params=None) -> dict:
+        path = f"v2/models/{model_name}"
+        if model_version:
+            path += f"/versions/{model_version}"
+        return await self._get_json(path + "/config", headers, query_params)
+
+    async def get_model_repository_index(self, headers=None, query_params=None) -> list:
+        return await self._post_json("v2/repository/index", {}, headers, query_params)
+
+    async def load_model(self, model_name, headers=None, query_params=None, config=None, files=None):
+        payload = {}
+        if config is not None or files is not None:
+            parameters = {}
+            if config is not None:
+                parameters["config"] = config
+            if files is not None:
+                for path, content in files.items():
+                    parameters[path] = base64.b64encode(content).decode()
+            payload["parameters"] = parameters
+        await self._post_json(
+            f"v2/repository/models/{model_name}/load", payload, headers, query_params
+        )
+
+    async def unload_model(self, model_name, headers=None, query_params=None, unload_dependents=False):
+        await self._post_json(
+            f"v2/repository/models/{model_name}/unload",
+            {"parameters": {"unload_dependents": unload_dependents}},
+            headers,
+            query_params,
+        )
+
+    async def get_inference_statistics(self, model_name="", model_version="", headers=None, query_params=None) -> dict:
+        if model_name:
+            path = f"v2/models/{model_name}"
+            if model_version:
+                path += f"/versions/{model_version}"
+            path += "/stats"
+        else:
+            path = "v2/models/stats"
+        return await self._get_json(path, headers, query_params)
+
+    async def update_trace_settings(self, model_name="", settings=None, headers=None, query_params=None) -> dict:
+        path = f"v2/models/{model_name}/trace/setting" if model_name else "v2/trace/setting"
+        return await self._post_json(path, settings or {}, headers, query_params)
+
+    async def get_trace_settings(self, model_name="", headers=None, query_params=None) -> dict:
+        path = f"v2/models/{model_name}/trace/setting" if model_name else "v2/trace/setting"
+        return await self._get_json(path, headers, query_params)
+
+    async def update_log_settings(self, settings, headers=None, query_params=None) -> dict:
+        return await self._post_json("v2/logging", settings or {}, headers, query_params)
+
+    async def get_log_settings(self, headers=None, query_params=None) -> dict:
+        return await self._get_json("v2/logging", headers, query_params)
+
+    # -- shared memory admin -------------------------------------------------
+
+    async def get_system_shared_memory_status(self, region_name="", headers=None, query_params=None) -> list:
+        path = "v2/systemsharedmemory"
+        if region_name:
+            path += f"/region/{region_name}"
+        return await self._get_json(path + "/status", headers, query_params)
+
+    async def register_system_shared_memory(self, name, key, byte_size, offset=0, headers=None, query_params=None):
+        await self._post_json(
+            f"v2/systemsharedmemory/region/{name}/register",
+            {"key": key, "offset": offset, "byte_size": byte_size},
+            headers,
+            query_params,
+        )
+
+    async def unregister_system_shared_memory(self, name="", headers=None, query_params=None):
+        path = (
+            f"v2/systemsharedmemory/region/{name}/unregister"
+            if name
+            else "v2/systemsharedmemory/unregister"
+        )
+        await self._post_json(path, {}, headers, query_params)
+
+    async def get_tpu_shared_memory_status(self, region_name="", headers=None, query_params=None) -> list:
+        path = "v2/tpusharedmemory"
+        if region_name:
+            path += f"/region/{region_name}"
+        return await self._get_json(path + "/status", headers, query_params)
+
+    async def register_tpu_shared_memory(self, name, raw_handle, device_id, byte_size, headers=None, query_params=None):
+        await self._post_json(
+            f"v2/tpusharedmemory/region/{name}/register",
+            {
+                "raw_handle": {"b64": base64.b64encode(raw_handle).decode()},
+                "device_id": device_id,
+                "byte_size": byte_size,
+            },
+            headers,
+            query_params,
+        )
+
+    async def unregister_tpu_shared_memory(self, name="", headers=None, query_params=None):
+        path = (
+            f"v2/tpusharedmemory/region/{name}/unregister"
+            if name
+            else "v2/tpusharedmemory/unregister"
+        )
+        await self._post_json(path, {}, headers, query_params)
+
+    # -- inference -----------------------------------------------------------
+
+    async def infer(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        headers=None,
+        query_params=None,
+        request_compression_algorithm=None,
+        response_compression_algorithm=None,
+        parameters=None,
+    ) -> InferResult:
+        request_body, json_size = _get_inference_request(
+            inputs=inputs,
+            request_id=request_id,
+            outputs=outputs,
+            sequence_id=sequence_id,
+            sequence_start=sequence_start,
+            sequence_end=sequence_end,
+            priority=priority,
+            timeout=timeout,
+            custom_parameters=parameters,
+        )
+        all_headers = dict(headers) if headers else {}
+        if request_compression_algorithm == "gzip":
+            all_headers["Content-Encoding"] = "gzip"
+            request_body = gzip.compress(request_body)
+        elif request_compression_algorithm == "deflate":
+            all_headers["Content-Encoding"] = "deflate"
+            request_body = zlib.compress(request_body)
+        if response_compression_algorithm == "gzip":
+            all_headers["Accept-Encoding"] = "gzip"
+        elif response_compression_algorithm == "deflate":
+            all_headers["Accept-Encoding"] = "deflate"
+        if json_size is not None:
+            all_headers["Inference-Header-Content-Length"] = str(json_size)
+
+        path = f"v2/models/{model_name}"
+        if model_version:
+            path += f"/versions/{model_version}"
+        path += "/infer"
+        status, resp_headers, body = await self._post(
+            path, request_body, all_headers, query_params
+        )
+        _raise_if_error(status, body)
+        header_length = resp_headers.get("Inference-Header-Content-Length")
+        return InferResult(
+            body,
+            int(header_length) if header_length is not None else None,
+            resp_headers.get("Content-Encoding"),
+        )

@@ -1,0 +1,3 @@
+"""Auth plugin re-exports for the HTTP flavor (reference: http/auth/__init__.py)."""
+
+from tritonclient_tpu._auth import BasicAuth  # noqa: F401

@@ -432,6 +432,10 @@ class InferenceCore:
             except (TypeError, ValueError):
                 raise CoreError(f"failed to load '{name}': invalid config override", 400)
             model._config_override = override
+        else:
+            # A plain reload reverts to the model's own config (Triton
+            # semantics: no config parameter means repository config).
+            model._config_override = {}
         # File-override parameters ("file:<path>" keys) are accepted for API
         # parity; the JAX backend has no on-disk model files to replace.
         self._loaded[name] = True
@@ -604,6 +608,13 @@ class InferenceCore:
     def _decode_raw(datatype: str, shape: List[int], raw: bytes) -> np.ndarray:
         if datatype == "BYTES":
             arr = deserialize_bytes_tensor(raw)
+            expected = num_elements(shape)
+            if arr.size != expected:
+                raise CoreError(
+                    f"unexpected number of string elements {arr.size} for input "
+                    f"(expected {expected})",
+                    400,
+                )
             return arr.reshape(shape)
         np_dtype = triton_to_np_dtype(datatype)
         if np_dtype is None:

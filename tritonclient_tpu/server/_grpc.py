@@ -21,8 +21,6 @@ from tritonclient_tpu.server._core import (
     CoreTensor,
     InferenceCore,
 )
-from tritonclient_tpu.utils import serialize_byte_tensor
-
 _MAX_MESSAGE_LENGTH = 2**31 - 1  # INT32_MAX parity (grpc/_client.py:50-55)
 
 
@@ -59,7 +57,8 @@ def request_to_core(request: pb.ModelInferRequest, core: InferenceCore) -> CoreR
     )
     raw = list(request.raw_input_contents)
     use_raw = len(raw) > 0
-    for i, tensor in enumerate(request.inputs):
+    raw_index = 0  # raw entries exist only for non-shared-memory inputs
+    for tensor in request.inputs:
         ct = CoreTensor(
             name=tensor.name,
             datatype=tensor.datatype,
@@ -72,8 +71,11 @@ def request_to_core(request: pb.ModelInferRequest, core: InferenceCore) -> CoreR
             ct.shm_byte_size = int(params.get("shared_memory_byte_size", 0))
             ct.shm_kind = core.find_shm_kind(ct.shm_region)
         elif use_raw:
-            if i < len(raw):
-                ct.data = InferenceCore._decode_raw(ct.datatype, ct.shape, raw[i])
+            if raw_index < len(raw):
+                ct.data = InferenceCore._decode_raw(
+                    ct.datatype, ct.shape, raw[raw_index]
+                )
+                raw_index += 1
         else:
             ct.data = _contents_to_array(tensor)
         creq.inputs.append(ct)
@@ -141,11 +143,9 @@ def core_to_response(cresp: CoreResponse) -> pb.ModelInferResponse:
             t.parameters["shared_memory_byte_size"].int64_param = out.shm_byte_size
             resp.raw_output_contents.append(b"")
         else:
-            if out.datatype == "BYTES":
-                raw = serialize_byte_tensor(out.data)[0]
-            else:
-                raw = InferenceCore._encode_raw(out.datatype, out.data)
-            resp.raw_output_contents.append(raw)
+            resp.raw_output_contents.append(
+                InferenceCore._encode_raw(out.datatype, out.data)
+            )
     return resp
 
 

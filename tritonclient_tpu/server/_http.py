@@ -26,11 +26,7 @@ from tritonclient_tpu.server._core import (
     CoreTensor,
     InferenceCore,
 )
-from tritonclient_tpu.utils import (
-    deserialize_bytes_tensor,
-    serialize_byte_tensor,
-    triton_to_np_dtype,
-)
+from tritonclient_tpu.utils import triton_to_np_dtype
 
 _SHM_KINDS = {"systemsharedmemory": "system", "cudasharedmemory": "cuda", "tpusharedmemory": "tpu"}
 
@@ -370,10 +366,7 @@ class _Handler(BaseHTTPRequestHandler):
                     "shared_memory_byte_size": out.shm_byte_size,
                 }
             elif requested_binary.get(out.name, binary_default):
-                if out.datatype == "BYTES":
-                    raw = serialize_byte_tensor(out.data)[0]
-                else:
-                    raw = InferenceCore._encode_raw(out.datatype, out.data)
+                raw = InferenceCore._encode_raw(out.datatype, out.data)
                 entry["parameters"] = {"binary_data_size": len(raw)}
                 blobs.append(raw)
             else:
