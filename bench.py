@@ -1,0 +1,190 @@
+"""Headline benchmark: serving throughput vs in-process JAX throughput.
+
+Mirrors the north-star metric in BASELINE.json: a perf_analyzer-style
+client-side measurement of infer/sec through the full KServe v2 gRPC stack,
+compared against the raw in-process jit-compiled forward on the same model
+("≥90% of in-process JAX throughput"). Prints exactly one JSON line:
+
+    {"metric": ..., "value": <client infer/s>, "unit": "infer/s",
+     "vs_baseline": <(client/in-process) / 0.90>}
+
+vs_baseline >= 1.0 means the serving stack meets the 90%-of-in-process
+target (the reference publishes no absolute numbers — SURVEY.md §6).
+
+Methodology notes (matters on the axon-tunneled single chip, where every
+device RPC has ~100ms latency): both paths are measured pipelined at the
+same concurrency with *distinct* payloads per request (identical buffers
+can be served from tunnel-level caches), and both include host<->device
+transfer plus full result readback.
+
+Environment knobs: BENCH_MODEL (bert_base|simple), BENCH_BATCH, BENCH_SEQ,
+BENCH_SECONDS (time budget per timed section), BENCH_CONCURRENCY.
+"""
+
+import json
+import os
+import queue
+import sys
+import time
+
+import numpy as np
+
+
+def _pipelined_inprocess(dispatch, readback, payloads, seconds, depth):
+    """`depth` threads each running full request loops (h2d+exec+d2h).
+
+    Symmetric with the serving measurement: device RPCs overlap across
+    threads exactly the way the server's handler pool overlaps them.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    readback(dispatch(payloads[0]))  # warmup/compile
+    stop = [False]
+    counts = [0] * depth
+
+    def worker(wid):
+        i = wid
+        while not stop[0]:
+            readback(dispatch(payloads[i % len(payloads)]))
+            counts[wid] += 1
+            i += depth
+
+    start = time.perf_counter()
+    with ThreadPoolExecutor(max_workers=depth) as pool:
+        futs = [pool.submit(worker, w) for w in range(depth)]
+        time.sleep(seconds)
+        stop[0] = True
+        for f in futs:
+            f.result()
+    return sum(counts) / (time.perf_counter() - start)
+
+
+def _pipelined_client(submit, seconds, depth):
+    """Sliding-window async client loop via callback queue."""
+    done_q: "queue.Queue" = queue.Queue()
+
+    def cb(result, error):
+        done_q.put(error)
+
+    # warmup one
+    submit(0, cb)
+    err = done_q.get(timeout=120)
+    if err is not None:
+        raise err
+
+    inflight = 0
+    done = 0
+    i = 0
+    start = time.perf_counter()
+    while True:
+        while inflight < depth:
+            submit(i, cb)
+            i += 1
+            inflight += 1
+        err = done_q.get(timeout=120)
+        if err is not None:
+            raise err
+        inflight -= 1
+        done += 1
+        elapsed = time.perf_counter() - start
+        if elapsed >= seconds and done >= depth:
+            break
+    while inflight:
+        err = done_q.get(timeout=120)
+        if err is not None:
+            raise err
+        inflight -= 1
+        done += 1
+    return done / (time.perf_counter() - start)
+
+
+def main():
+    model_name = os.environ.get("BENCH_MODEL", "bert_base")
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    seq = int(os.environ.get("BENCH_SEQ", "128"))
+    seconds = float(os.environ.get("BENCH_SECONDS", "10"))
+    concurrency = int(os.environ.get("BENCH_CONCURRENCY", "16"))
+
+    import jax
+
+    from tritonclient_tpu.grpc import (
+        InferenceServerClient,
+        InferInput,
+        InferRequestedOutput,
+    )
+    from tritonclient_tpu.server import InferenceServer
+
+    n_payloads = 32
+    if model_name == "bert_base":
+        from tritonclient_tpu.models.bert import BertBaseModel
+
+        model = BertBaseModel()
+        payloads = [
+            np.random.randint(0, 30000, (batch, seq)).astype(np.int32)
+            for _ in range(n_payloads)
+        ]
+        input_names, in_dtype, out_name = ["INPUT_IDS"], "INT32", "POOLED_OUTPUT"
+        dispatch = lambda p: model._fwd(model._params, p)  # noqa: E731
+    else:
+        from tritonclient_tpu.models.simple import SimpleModel, _add_sub
+
+        model = SimpleModel()
+        payloads = [
+            np.random.randint(0, 100, (batch, 16)).astype(np.int32)
+            for _ in range(n_payloads)
+        ]
+        input_names, in_dtype, out_name = ["INPUT0", "INPUT1"], "INT32", "OUTPUT0"
+        dispatch = lambda p: _add_sub(p, p)  # noqa: E731
+
+    model.warmup()
+    inprocess_ips = _pipelined_inprocess(
+        dispatch, jax.device_get, payloads, seconds, concurrency
+    )
+
+    with InferenceServer(models=[model], http=False) as server:
+        client = InferenceServerClient(server.grpc_address)
+        outputs = [InferRequestedOutput(out_name)]
+
+        prebuilt = []
+        for p in payloads:
+            inputs = []
+            for name in input_names:
+                inp = InferInput(name, list(p.shape), in_dtype)
+                inp.set_data_from_numpy(p)
+                inputs.append(inp)
+            prebuilt.append(inputs)
+
+        def submit(i, cb):
+            client.async_infer(
+                model.name, prebuilt[i % n_payloads], cb, outputs=outputs
+            )
+
+        client_ips = _pipelined_client(submit, seconds, concurrency)
+
+        # Single-request latency (sync closed loop, a few iters).
+        lat = []
+        for i in range(5):
+            t0 = time.perf_counter()
+            client.infer(model.name, prebuilt[i % n_payloads], outputs=outputs)
+            lat.append(time.perf_counter() - t0)
+        client.close()
+
+    ratio = client_ips / inprocess_ips if inprocess_ips else 0.0
+    result = {
+        "metric": f"{model_name}_b{batch}_grpc_infer_per_sec",
+        "value": round(client_ips, 2),
+        "unit": "infer/s",
+        "vs_baseline": round(ratio / 0.90, 4),
+        "detail": {
+            "inprocess_infer_per_sec": round(inprocess_ips, 2),
+            "serving_vs_inprocess_ratio": round(ratio, 4),
+            "concurrency": concurrency,
+            "sync_p50_latency_ms": round(sorted(lat)[len(lat) // 2] * 1e3, 2),
+            "platform": jax.devices()[0].platform,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,7 +70,7 @@ class _InferStream:
                     self._callback(result=None, error=error)
                 else:
                     result = InferResult(response.infer_response)
-                    self._callback(result=result, error=error_or_none(response))
+                    self._callback(result=result, error=None)
         except grpc.RpcError as rpc_error:
             # Stream died: mark inactive and surface the error once.
             self._active = False
@@ -79,10 +79,6 @@ class _InferStream:
             else:
                 error = get_error_grpc(rpc_error)
             self._callback(result=None, error=error)
-
-
-def error_or_none(response):
-    return None
 
 
 class _RequestIterator:

@@ -13,7 +13,11 @@ from google.protobuf import json_format
 
 from tritonclient_tpu._client import InferenceServerClientBase
 from tritonclient_tpu._request import Request
-from tritonclient_tpu.grpc._client import MAX_GRPC_MESSAGE_SIZE, KeepAliveOptions
+from tritonclient_tpu.grpc._client import (
+    MAX_GRPC_MESSAGE_SIZE,
+    KeepAliveOptions,
+    InferenceServerClient as _SyncClient,
+)
 from tritonclient_tpu.grpc._infer_input import InferInput  # noqa: F401
 from tritonclient_tpu.grpc._infer_result import InferResult
 from tritonclient_tpu.grpc._requested_output import InferRequestedOutput  # noqa: F401
@@ -65,16 +69,10 @@ class InferenceServerClient(InferenceServerClientBase):
         if creds is not None:
             self._channel = grpc.aio.secure_channel(url, creds, options=channel_opt)
         elif ssl:
-            def read(path):
-                if path is None:
-                    return None
-                with open(path, "rb") as f:
-                    return f.read()
-
             credentials = grpc.ssl_channel_credentials(
-                root_certificates=read(root_certificates),
-                private_key=read(private_key),
-                certificate_chain=read(certificate_chain),
+                root_certificates=_SyncClient._read_file(root_certificates),
+                private_key=_SyncClient._read_file(private_key),
+                certificate_chain=_SyncClient._read_file(certificate_chain),
             )
             self._channel = grpc.aio.secure_channel(url, credentials, options=channel_opt)
         else:
@@ -379,7 +377,9 @@ class InferenceServerClient(InferenceServerClientBase):
         """
         async def _request_iterator():
             async for request_kwargs in inputs_iterator:
-                enable_final = request_kwargs.pop("enable_empty_final_response", False)
+                # get (not pop): the caller may reuse one template dict
+                # across requests of a sequence.
+                enable_final = request_kwargs.get("enable_empty_final_response", False)
                 request = _get_inference_request(
                     infer_inputs=request_kwargs["inputs"],
                     model_name=request_kwargs["model_name"],

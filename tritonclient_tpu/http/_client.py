@@ -16,6 +16,7 @@ import socket
 import ssl as ssl_module
 import threading
 import zlib
+import concurrent.futures as futures_module
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, Optional
 from urllib.parse import urlparse
@@ -42,7 +43,9 @@ class InferAsyncRequest:
         """Wait for and return the InferResult (raises on server error)."""
         try:
             return self._future.result(timeout=timeout if block else 0)
-        except TimeoutError:
+        except futures_module.TimeoutError:
+            # On 3.10 concurrent.futures.TimeoutError is NOT the builtin
+            # TimeoutError; catching the futures one covers both (3.11+ alias).
             raise InferenceServerException(
                 msg="failed to obtain inference response"
             ) from None
@@ -63,43 +66,50 @@ class _ConnectionPool:
         self._network_timeout = network_timeout
         self._ssl_context = ssl_context
         self._idle = queue.LifoQueue()
-        self._created = 0
-        self._lock = threading.Lock()
+        self._capacity = threading.Semaphore(size)
         self._closed = False
 
     def _new_connection(self):
+        # connection_timeout governs the connect (incl. TLS) phase only;
+        # after that the socket switches to network_timeout for I/O.
         if self._scheme == "https":
-            return http.client.HTTPSConnection(
+            conn = http.client.HTTPSConnection(
                 self._host,
                 self._port,
-                timeout=self._network_timeout,
+                timeout=self._connection_timeout,
                 context=self._ssl_context,
             )
-        return http.client.HTTPConnection(
-            self._host, self._port, timeout=self._network_timeout
-        )
+        else:
+            conn = http.client.HTTPConnection(
+                self._host, self._port, timeout=self._connection_timeout
+            )
+        conn.connect()
+        conn.sock.settimeout(self._network_timeout)
+        return conn
 
     def acquire(self):
+        """Returns (connection, reused). Blocks while the pool is exhausted."""
+        self._capacity.acquire()
         try:
-            return self._idle.get_nowait()
+            return self._idle.get_nowait(), True
         except queue.Empty:
             pass
-        with self._lock:
-            if self._created < self._size:
-                self._created += 1
-                return self._new_connection()
-        return self._idle.get()  # block until a connection frees up
+        try:
+            return self._new_connection(), False
+        except BaseException:
+            self._capacity.release()
+            raise
 
     def release(self, conn):
         if self._closed:
             conn.close()
         else:
             self._idle.put(conn)
+        self._capacity.release()
 
     def discard(self, conn):
         conn.close()
-        with self._lock:
-            self._created -= 1
+        self._capacity.release()
 
     def close(self):
         self._closed = True
@@ -212,26 +222,32 @@ class InferenceServerClient(InferenceServerClientBase):
         if self._verbose:
             print(f"{method} {uri}, headers {headers}")
 
-        conn = self._pool.acquire()
-        try:
-            conn.request(method, uri, body=body, headers=headers)
-            response = conn.getresponse()
-            payload = response.read()
-        except TimeoutError:
-            # A timed-out request must NOT be retried (infer is not
-            # idempotent and the retry would double the effective timeout).
-            self._pool.discard(conn)
-            raise InferenceServerException(msg="timed out") from None
-        except (http.client.HTTPException, OSError):
-            # Stale keep-alive connection: retry once on a fresh one.
-            self._pool.discard(conn)
-            conn = self._pool.acquire()
+        retried = False
+        while True:
+            try:
+                conn, reused = self._pool.acquire()
+            except OSError as e:
+                raise InferenceServerException(msg=str(e)) from None
             try:
                 conn.request(method, uri, body=body, headers=headers)
                 response = conn.getresponse()
                 payload = response.read()
+                break
+            except TimeoutError:
+                # A timed-out request must NOT be retried (infer is not
+                # idempotent and the retry would double the effective timeout).
+                self._pool.discard(conn)
+                raise InferenceServerException(msg="timed out") from None
             except (http.client.HTTPException, OSError) as e:
                 self._pool.discard(conn)
+                # Retry once, and only when the failed connection was a reused
+                # keep-alive one (likely closed while idle). A failure on a
+                # fresh connection is a real error — and infer is not
+                # idempotent, so resending after the server may have executed
+                # the request risks double execution.
+                if reused and not retried:
+                    retried = True
+                    continue
                 raise InferenceServerException(msg=str(e)) from None
         self._pool.release(conn)
         if self._verbose:

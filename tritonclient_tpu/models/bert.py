@@ -1,0 +1,255 @@
+"""BERT encoder, TPU-first: functional pure-JAX, scan-stacked layers.
+
+This is the flagship compute model behind the BASELINE.json BERT-base
+benchmark configs ("perf_analyzer concurrency sweep — BERT-base"). Design
+choices for the MXU/XLA:
+
+  * layers stored stacked along a leading [n_layers, ...] axis and executed
+    with `lax.scan` — one compiled layer body, no Python unrolling;
+  * bfloat16 params/activations, float32 softmax/LayerNorm accumulation;
+  * Megatron-style tensor-parallel partition rules (qkv/ffn-in column,
+    proj/ffn-out row) — GSPMD inserts the psums;
+  * sequence axis shardable on 'sp' with ring attention
+    (tritonclient_tpu.parallel.ring_attention) for long context.
+
+Serving-side, `BertBaseModel` exposes it through the same Model contract the
+KServe v2 front-ends execute (reference client drives it like any Triton
+model, e.g. via perf-analyzer configs in BASELINE.json).
+"""
+
+import functools
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tritonclient_tpu.models._base import Model, TensorSpec
+from tritonclient_tpu.ops.attention import dot_product_attention
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_len: int = 512
+    type_vocab: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def bert_base() -> BertConfig:
+    return BertConfig()
+
+
+def bert_tiny(seq_len: int = 64) -> BertConfig:
+    """Small config for tests and multi-chip dry-runs."""
+    return BertConfig(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+        max_len=seq_len, dtype=jnp.float32,
+    )
+
+
+# --------------------------------------------------------------------------- #
+# params                                                                      #
+# --------------------------------------------------------------------------- #
+
+
+def init_params(key: jax.Array, cfg: BertConfig) -> Dict:
+    d, f, n = cfg.d_model, cfg.d_ff, cfg.n_layers
+    keys = iter(jax.random.split(key, 16))
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32)
+                / np.sqrt(fan_in)).astype(cfg.dtype)
+
+    params = {
+        "embed": {
+            "tok": dense(next(keys), (cfg.vocab_size, d), d),
+            "pos": dense(next(keys), (cfg.max_len, d), d),
+            "typ": dense(next(keys), (cfg.type_vocab, d), d),
+            "ln_scale": jnp.ones((d,), cfg.dtype),
+            "ln_bias": jnp.zeros((d,), cfg.dtype),
+        },
+        "layers": {
+            "wqkv": dense(next(keys), (n, d, 3 * d), d),
+            "bqkv": jnp.zeros((n, 3 * d), cfg.dtype),
+            "wo": dense(next(keys), (n, d, d), d),
+            "bo": jnp.zeros((n, d), cfg.dtype),
+            "ln1_scale": jnp.ones((n, d), cfg.dtype),
+            "ln1_bias": jnp.zeros((n, d), cfg.dtype),
+            "w_in": dense(next(keys), (n, d, f), d),
+            "b_in": jnp.zeros((n, f), cfg.dtype),
+            "w_out": dense(next(keys), (n, f, d), f),
+            "b_out": jnp.zeros((n, d), cfg.dtype),
+            "ln2_scale": jnp.ones((n, d), cfg.dtype),
+            "ln2_bias": jnp.zeros((n, d), cfg.dtype),
+        },
+        "pooler": {
+            "w": dense(next(keys), (d, d), d),
+            "b": jnp.zeros((d,), cfg.dtype),
+        },
+        "mlm": {
+            "w": dense(next(keys), (d, d), d),
+            "b": jnp.zeros((d,), cfg.dtype),
+            "ln_scale": jnp.ones((d,), cfg.dtype),
+            "ln_bias": jnp.zeros((d,), cfg.dtype),
+            "decoder_bias": jnp.zeros((cfg.vocab_size,), cfg.dtype),
+        },
+    }
+    return params
+
+
+# Megatron-style TP: qkv/ffn-in sharded on output dim (column), proj/ffn-out
+# on input dim (row) — GSPMD inserts the all-reduces. fsdp (when present)
+# shards the remaining large dim.
+PARTITION_RULES = (
+    (r"layers/wqkv", P(None, "fsdp", "tp")),
+    (r"layers/bqkv", P(None, "tp")),
+    (r"layers/wo", P(None, "tp", "fsdp")),
+    (r"layers/w_in", P(None, "fsdp", "tp")),
+    (r"layers/b_in", P(None, "tp")),
+    (r"layers/w_out", P(None, "tp", "fsdp")),
+    (r"embed/(tok|pos|typ)", P(None, None)),
+    (r"mlm/w|pooler/w", P(None, "tp")),
+    (r"mlm/decoder_bias", P()),
+)
+
+
+# --------------------------------------------------------------------------- #
+# forward                                                                     #
+# --------------------------------------------------------------------------- #
+
+
+def _layer_norm(x, scale, bias, eps):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    out = (xf - mu) * lax.rsqrt(var + eps)
+    return (out * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(
+        x.dtype
+    )
+
+
+def encode(
+    params: Dict,
+    tokens: jax.Array,
+    cfg: BertConfig,
+    *,
+    type_ids: Optional[jax.Array] = None,
+    attention_fn: Optional[Callable] = None,
+    activation_spec: Optional[P] = None,
+) -> jax.Array:
+    """tokens [B, L] int32 → sequence output [B, L, d_model].
+
+    ``attention_fn(q, k, v)`` defaults to single-device attention; pass a
+    ring_attention closure for sp-sharded long sequences. ``activation_spec``
+    (e.g. P('dp', 'sp', None)) pins the hidden-state layout on the mesh.
+    """
+    atn = attention_fn or functools.partial(dot_product_attention, causal=False)
+    emb = params["embed"]
+    b, l = tokens.shape
+    x = emb["tok"][tokens]
+    x = x + emb["pos"][:l][None, :, :]
+    type_ids = jnp.zeros_like(tokens) if type_ids is None else type_ids
+    x = x + emb["typ"][type_ids]
+    x = _layer_norm(x, emb["ln_scale"], emb["ln_bias"], cfg.layer_norm_eps)
+
+    def constrain(h):
+        if activation_spec is not None:
+            return lax.with_sharding_constraint(h, activation_spec)
+        return h
+
+    x = constrain(x)
+
+    def layer(h, lp):
+        qkv = h @ lp["wqkv"] + lp["bqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (b, l, cfg.n_heads, cfg.head_dim)
+        out = atn(q.reshape(shape), k.reshape(shape), v.reshape(shape))
+        out = out.reshape(b, l, cfg.d_model) @ lp["wo"] + lp["bo"]
+        h = _layer_norm(h + out, lp["ln1_scale"], lp["ln1_bias"],
+                        cfg.layer_norm_eps)
+        ff = jax.nn.gelu(h @ lp["w_in"] + lp["b_in"])
+        ff = ff @ lp["w_out"] + lp["b_out"]
+        h = _layer_norm(h + ff, lp["ln2_scale"], lp["ln2_bias"],
+                        cfg.layer_norm_eps)
+        return constrain(h), None
+
+    x, _ = lax.scan(layer, x, params["layers"])
+    return x
+
+
+def pooled_output(params: Dict, seq_out: jax.Array) -> jax.Array:
+    """[CLS] (position 0) through the tanh pooler → [B, d_model]."""
+    cls = seq_out[:, 0, :]
+    return jnp.tanh(cls @ params["pooler"]["w"] + params["pooler"]["b"])
+
+
+def mlm_logits(params: Dict, seq_out: jax.Array, cfg: BertConfig) -> jax.Array:
+    """Masked-LM head, decoder tied to the token embedding: [B, L, vocab]."""
+    h = jax.nn.gelu(seq_out @ params["mlm"]["w"] + params["mlm"]["b"])
+    h = _layer_norm(h, params["mlm"]["ln_scale"], params["mlm"]["ln_bias"],
+                    cfg.layer_norm_eps)
+    return h @ params["embed"]["tok"].T + params["mlm"]["decoder_bias"]
+
+
+def mlm_loss(params: Dict, batch: Dict, cfg: BertConfig, **encode_kw) -> jax.Array:
+    """Mean cross-entropy over all positions of batch['labels']."""
+    seq = encode(params, batch["tokens"], cfg, **encode_kw)
+    logits = mlm_logits(params, seq, cfg).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, batch["labels"][..., None], axis=-1)
+    return -ll.mean()
+
+
+# --------------------------------------------------------------------------- #
+# serving model                                                               #
+# --------------------------------------------------------------------------- #
+
+
+class BertBaseModel(Model):
+    """Serves BERT-base: INPUT_IDS int32 [-1, L] → POOLED_OUTPUT fp32 [-1, 768].
+
+    The wire contract keeps responses small (pooled vector, not the [B, L, V]
+    logits) so benchmarks measure model compute + transport, matching how the
+    reference's perf_analyzer drives BERT (BASELINE.json configs).
+    """
+
+    name = "bert_base"
+    platform = "jax"
+
+    def __init__(self, cfg: Optional[BertConfig] = None, seed: int = 0):
+        super().__init__()
+        self.cfg = cfg or bert_base()
+        self.inputs = [TensorSpec("INPUT_IDS", "INT32", [-1, -1])]
+        self.outputs = [
+            TensorSpec("POOLED_OUTPUT", "FP32", [-1, self.cfg.d_model])
+        ]
+        self._params = init_params(jax.random.PRNGKey(seed), self.cfg)
+
+        @jax.jit
+        def fwd(params, tokens):
+            seq = encode(params, tokens, self.cfg)
+            return pooled_output(params, seq).astype(jnp.float32)
+
+        self._fwd = fwd
+
+    def infer(self, inputs, parameters=None):
+        tokens = jnp.asarray(np.asarray(inputs["INPUT_IDS"], dtype=np.int32))
+        out = self._fwd(self._params, tokens)
+        return {"POOLED_OUTPUT": np.asarray(out)}
+
+    def warmup(self):
+        z = jnp.zeros((1, 128), jnp.int32)
+        jax.block_until_ready(self._fwd(self._params, z))

@@ -1,0 +1,9 @@
+"""Compute ops: attention and friends, written MXU-first.
+
+Plain jnp implementations here; the ring (sequence-parallel) variant lives
+in tritonclient_tpu.parallel.ring_attention.
+"""
+
+from tritonclient_tpu.ops.attention import dot_product_attention
+
+__all__ = ["dot_product_attention"]

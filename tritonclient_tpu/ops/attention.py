@@ -1,0 +1,37 @@
+"""Single-device attention (the ring attention's sp=1 degenerate case).
+
+Kept as one big einsum pair so XLA tiles it onto the MXU and fuses the
+softmax; accumulation in float32 regardless of input dtype.
+"""
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """q/k/v: [B, L, H, D] → [B, L, H, D]."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale, k.astype(jnp.float32)
+    )
+    if causal:
+        lq, lk = q.shape[1], k.shape[1]
+        keep = jnp.arange(lq)[:, None] >= jnp.arange(lk)[None, :]
+        s = jnp.where(keep[None, None], s, jnp.finfo(jnp.float32).min)
+    if mask is not None:
+        s = jnp.where(mask, s, jnp.finfo(jnp.float32).min)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)

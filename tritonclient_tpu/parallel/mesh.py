@@ -1,0 +1,83 @@
+"""Device-mesh construction.
+
+Axis conventions (subset used as needed):
+  dp    data parallel (batch)
+  fsdp  fully-sharded data parallel (params sharded over the batch axis)
+  pp    pipeline parallel (stages)
+  sp    sequence/context parallel (ring attention over this axis)
+  tp    tensor parallel (Megatron-style within layers)
+  ep    expert parallel (MoE experts)
+
+Shardings are laid out so the fast-moving axes (tp, sp) map to adjacent
+devices — on real TPU slices those collectives then ride ICI, with dp/pp
+outermost (DCN-friendly), per the scaling-book recipe.
+"""
+
+import math
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_ORDER = ("dp", "fsdp", "pp", "ep", "sp", "tp")
+
+
+def build_mesh(
+    axes: Dict[str, int],
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a Mesh from ``{axis_name: size}``; one size may be -1 (inferred).
+
+    Axes are ordered by AXIS_ORDER (unknown names keep insertion order after
+    the known ones) so tp/sp are innermost over adjacent devices.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = {k: int(v) for k, v in axes.items()}
+    wildcards = [k for k, v in sizes.items() if v == -1]
+    if len(wildcards) > 1:
+        raise ValueError(f"at most one axis may be -1, got {wildcards}")
+    known = math.prod(v for v in sizes.values() if v != -1)
+    if wildcards:
+        if known == 0 or len(devices) % known:
+            raise ValueError(
+                f"cannot infer axis '{wildcards[0]}': {len(devices)} devices "
+                f"not divisible by {known}"
+            )
+        sizes[wildcards[0]] = len(devices) // known
+    total = math.prod(sizes.values())
+    if total != len(devices):
+        raise ValueError(
+            f"mesh axes {sizes} require {total} devices, have {len(devices)}"
+        )
+    names = sorted(
+        sizes,
+        key=lambda n: AXIS_ORDER.index(n) if n in AXIS_ORDER else len(AXIS_ORDER),
+    )
+    grid = np.asarray(devices, dtype=object).reshape([sizes[n] for n in names])
+    return Mesh(grid, tuple(names))
+
+
+def auto_mesh(
+    devices: Optional[Sequence] = None,
+    prefer: Sequence[str] = ("dp", "tp"),
+) -> Mesh:
+    """Balanced factorization of the device count over ``prefer`` axes.
+
+    The last axis in ``prefer`` gets the largest factor (innermost ⇒ ICI).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if len(prefer) == 1:
+        return build_mesh({prefer[0]: n}, devices)
+    # Split n = outer * inner with inner the largest divisor <= sqrt-balanced.
+    inner = 1
+    for d in range(int(math.isqrt(n)), 0, -1):
+        if n % d == 0:
+            inner = max(inner, n // d if n // d <= n else d)
+            break
+    outer = n // inner
+    axes = {prefer[0]: outer, prefer[-1]: inner}
+    for name in prefer[1:-1]:
+        axes[name] = 1
+    return build_mesh(axes, devices)

@@ -1,0 +1,107 @@
+"""Ring attention: sequence-parallel attention over a mesh axis.
+
+Each device holds a sequence chunk of Q/K/V; K/V blocks rotate around the
+ring via `lax.ppermute` while a flash-style online softmax accumulates the
+output, so attention over the full sequence never materializes on one chip
+and the sp-axis collectives ride ICI. Runs inside a partial-manual
+`jax.shard_map` (only the sp axis is manual; dp/tp stay under GSPMD).
+
+The reference has no analog (client SDK, SURVEY.md §2.5); this is the
+long-context plane the TPU framework needs for sequence lengths beyond one
+chip's HBM.
+"""
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_BIG = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _ring_body(q, k, v, *, axis_name: str, axis_size: int, causal: bool,
+               scale: float):
+    """Manual-mode body: q/k/v are the local [B, Lc, H, D] chunks."""
+    my_idx = lax.axis_index(axis_name)
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    qf = q.astype(jnp.float32) * scale
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, i):
+        o, m, l, k_cur, v_cur = carry
+        # After i hops each device holds the chunk that started (my_idx - i).
+        j = (my_idx - i) % axis_size
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32))
+        if causal:
+            q_pos = my_idx * lq + jnp.arange(lq)
+            k_pos = j * lk + jnp.arange(lk)
+            keep = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(keep[None, None], s, _NEG_BIG)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        if causal:
+            p = jnp.where(keep[None, None], p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32)
+        )
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (o_new, m_new, l_new, k_next, v_next), None
+
+    o0 = jnp.zeros((b, h, lq, d), jnp.float32)
+    m0 = jnp.full((b, h, lq), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((b, h, lq), jnp.float32)
+    (o, m, l, _, _), _ = lax.scan(
+        step, (o0, m0, l0, k, v), jnp.arange(axis_size)
+    )
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = (o / l[..., None]).astype(q.dtype)
+    return jnp.transpose(out, (0, 2, 1, 3))  # [B, Lq, H, D]
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh,
+    sp_axis: str = "sp",
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Attention over [B, L, H, D] tensors whose L dim is sharded on sp_axis.
+
+    Other mesh axes (dp on B, tp on H) stay automatic — GSPMD shards them as
+    annotated by the caller. With sp size 1 this degrades to plain attention.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    sp_size = mesh.shape.get(sp_axis, 1)
+    if sp_size == 1:
+        from tritonclient_tpu.ops.attention import dot_product_attention
+
+        return dot_product_attention(q, k, v, causal=causal, scale=scale)
+    body = functools.partial(
+        _ring_body,
+        axis_name=sp_axis,
+        axis_size=sp_size,
+        causal=causal,
+        scale=scale,
+    )
+    spec = P(None, sp_axis, None, None)
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={sp_axis},
+        check_vma=False,
+    )(q, k, v)

@@ -1,0 +1,69 @@
+"""Rule-based parameter sharding.
+
+Models publish partition rules as ``[(path_regex, PartitionSpec), ...]``;
+`tree_shardings` resolves them against a parameter pytree so the train/infer
+steps can `jax.device_put` / annotate with `NamedSharding`s and let GSPMD
+insert the collectives (the scaling-book recipe: pick a mesh, annotate,
+let XLA do the rest).
+"""
+
+import re
+from typing import List, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Rules = Sequence[Tuple[str, P]]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for entry in path:
+        if hasattr(entry, "key"):
+            parts.append(str(entry.key))
+        elif hasattr(entry, "idx"):
+            parts.append(str(entry.idx))
+        else:  # pragma: no cover
+            parts.append(str(entry))
+    return "/".join(parts)
+
+
+def _filter_spec(spec: P, mesh: Mesh) -> P:
+    """Drop mesh axes of size 1 or absent from the mesh (no-op shardings)."""
+
+    def keep(axis):
+        if axis is None:
+            return None
+        if isinstance(axis, (tuple, list)):
+            kept = tuple(a for a in axis if mesh.shape.get(a, 1) > 1)
+            return kept if kept else None
+        return axis if mesh.shape.get(axis, 1) > 1 else None
+
+    return P(*(keep(a) for a in spec))
+
+
+def spec_for_path(path: str, rules: Rules, default: P = P()) -> P:
+    """First rule whose regex matches (re.search) the '/'-joined path wins."""
+    for pattern, spec in rules:
+        if re.search(pattern, path):
+            return spec
+    return default
+
+
+def tree_shardings(mesh: Mesh, tree, rules: Rules, default: P = P()):
+    """A pytree of NamedShardings matching ``tree``'s structure."""
+
+    def resolve(path, leaf):
+        spec = spec_for_path(_path_str(path), rules, default)
+        return NamedSharding(mesh, _filter_spec(spec, mesh))
+
+    return jax.tree_util.tree_map_with_path(resolve, tree)
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, _filter_spec(P(*spec), mesh))
+
+
+def shard_tree(mesh: Mesh, tree, rules: Rules, default: P = P()):
+    """device_put every leaf according to its matched rule."""
+    return jax.device_put(tree, tree_shardings(mesh, tree, rules, default))
