@@ -1,0 +1,139 @@
+// POSIX shared-memory core for the system shm transport plane.
+//
+// C ABI consumed via ctypes by tritonclient_tpu/utils/shared_memory.
+// Equivalent in capability to the reference's libcshm
+// (src/python/library/tritonclient/utils/shared_memory/shared_memory.cc:
+// shm_open+ftruncate+mmap create, memcpy set, introspection, munmap+
+// shm_unlink destroy) but an independent implementation: handles are
+// refcount-free PODs owned by the Python side, writes are bounds-checked
+// here rather than trusted, and a read entry point exists so get-paths
+// need no extra mmap from Python.
+
+#include <cerrno>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct ShmRegion {
+  char* base = nullptr;
+  size_t byte_size = 0;
+  int fd = -1;
+  char key[256] = {0};
+  bool owner = false;  // created (vs attached) — owner unlinks on destroy
+};
+
+}  // namespace
+
+extern "C" {
+
+// Error codes surfaced to the Python error map.
+enum TpuShmError {
+  kSuccess = 0,
+  kOpenFailed = -1,
+  kSizeFailed = -2,
+  kMapFailed = -3,
+  kOutOfRange = -4,
+  kUnlinkFailed = -5,
+  kUnmapFailed = -6,
+  kBadHandle = -7,
+};
+
+// Create (or attach to) the POSIX shm object `key` of `byte_size` bytes and
+// map it. `create` == 1 => O_CREAT and ftruncate (the handle becomes the
+// unlink owner); `create` == 2 additionally sets O_EXCL so an existing
+// object of the same key fails instead of being silently truncated.
+int TpuShmRegionCreate(const char* key, size_t byte_size, int create,
+                       void** out_handle) {
+  if (out_handle == nullptr || key == nullptr || key[0] == '\0') {
+    return kBadHandle;
+  }
+  int flags = create ? (O_RDWR | O_CREAT) : O_RDWR;
+  if (create == 2) flags |= O_EXCL;
+  int fd = shm_open(key, flags, S_IRUSR | S_IWUSR);
+  if (fd < 0) {
+    return kOpenFailed;
+  }
+  if (create) {
+    if (ftruncate(fd, static_cast<off_t>(byte_size)) != 0) {
+      close(fd);
+      shm_unlink(key);
+      return kSizeFailed;
+    }
+  } else if (byte_size == 0) {
+    struct stat st;
+    if (fstat(fd, &st) != 0) {
+      close(fd);
+      return kSizeFailed;
+    }
+    byte_size = static_cast<size_t>(st.st_size);
+  }
+  void* base =
+      mmap(nullptr, byte_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    if (create) shm_unlink(key);
+    return kMapFailed;
+  }
+  ShmRegion* region = new ShmRegion();
+  region->base = static_cast<char*>(base);
+  region->byte_size = byte_size;
+  region->fd = fd;
+  region->owner = create != 0;
+  strncpy(region->key, key, sizeof(region->key) - 1);
+  *out_handle = region;
+  return kSuccess;
+}
+
+int TpuShmRegionSet(void* handle, size_t offset, size_t byte_size,
+                    const void* data) {
+  ShmRegion* region = static_cast<ShmRegion*>(handle);
+  if (region == nullptr || region->base == nullptr) return kBadHandle;
+  if (offset + byte_size > region->byte_size) return kOutOfRange;
+  memcpy(region->base + offset, data, byte_size);
+  return kSuccess;
+}
+
+int TpuShmRegionGet(void* handle, size_t offset, size_t byte_size,
+                    void* dst) {
+  ShmRegion* region = static_cast<ShmRegion*>(handle);
+  if (region == nullptr || region->base == nullptr) return kBadHandle;
+  if (offset + byte_size > region->byte_size) return kOutOfRange;
+  memcpy(dst, region->base + offset, byte_size);
+  return kSuccess;
+}
+
+int TpuShmRegionInfo(void* handle, void** base, size_t* byte_size,
+                     const char** key, int* fd) {
+  ShmRegion* region = static_cast<ShmRegion*>(handle);
+  if (region == nullptr) return kBadHandle;
+  if (base != nullptr) *base = region->base;
+  if (byte_size != nullptr) *byte_size = region->byte_size;
+  if (key != nullptr) *key = region->key;
+  if (fd != nullptr) *fd = region->fd;
+  return kSuccess;
+}
+
+// Unmap; the creating handle also unlinks the shm object.
+int TpuShmRegionDestroy(void* handle) {
+  ShmRegion* region = static_cast<ShmRegion*>(handle);
+  if (region == nullptr) return kBadHandle;
+  int rc = kSuccess;
+  if (region->base != nullptr &&
+      munmap(region->base, region->byte_size) != 0) {
+    rc = kUnmapFailed;
+  }
+  if (region->fd >= 0) close(region->fd);
+  if (rc == kSuccess && region->owner && shm_unlink(region->key) != 0 &&
+      errno != ENOENT) {
+    rc = kUnlinkFailed;
+  }
+  delete region;
+  return rc;
+}
+
+}  // extern "C"

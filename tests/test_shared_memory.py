@@ -1,0 +1,286 @@
+"""Shared-memory plane tests: native system shm + TPU zero-copy regions.
+
+Mirrors the reference's test_cuda_shared_memory.py structure (DLPack
+round-trips, numpy round-trips incl. serialized BYTES) with jax in place of
+torch/CUDA, plus the client<->server registration lifecycle the reference
+only exercises against a live Triton (simple_grpc_cudashm_client.py flow:
+create -> register -> set -> infer-with-set_shared_memory -> get -> cleanup).
+"""
+
+import numpy as np
+import pytest
+
+import tritonclient_tpu.utils.shared_memory as shm
+import tritonclient_tpu.utils.tpu_shared_memory as tpushm
+from tritonclient_tpu.grpc import (
+    InferenceServerClient,
+    InferInput,
+    InferRequestedOutput,
+)
+from tritonclient_tpu.server import InferenceServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    with InferenceServer() as s:
+        yield s
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    c = InferenceServerClient(server.grpc_address)
+    yield c
+    c.close()
+
+
+# --------------------------------------------------------------------------- #
+# system shm                                                                  #
+# --------------------------------------------------------------------------- #
+
+
+class TestSystemShm:
+    def test_create_set_get_destroy(self):
+        region = shm.create_shared_memory_region("reg0", "/tpu_test_reg0", 256)
+        try:
+            data = np.arange(16, dtype=np.int32)
+            shm.set_shared_memory_region(region, [data])
+            out = shm.get_contents_as_numpy(region, np.int32, [16])
+            np.testing.assert_array_equal(out, data)
+            assert "reg0" in shm.mapped_shared_memory_regions()
+        finally:
+            shm.destroy_shared_memory_region(region)
+        assert "reg0" not in shm.mapped_shared_memory_regions()
+
+    def test_bytes_roundtrip(self):
+        region = shm.create_shared_memory_region("regb", "/tpu_test_regb", 256)
+        try:
+            data = np.array([b"hello", b"shared", b"memory"], dtype=np.object_)
+            shm.set_shared_memory_region(region, [data])
+            out = shm.get_contents_as_numpy(region, "BYTES", [3])
+            np.testing.assert_array_equal(out, data)
+        finally:
+            shm.destroy_shared_memory_region(region)
+
+    def test_str_array_and_scalar_shape(self):
+        region = shm.create_shared_memory_region("regu", "/tpu_test_regu", 64)
+        try:
+            shm.set_shared_memory_region(region, [np.array(["héllo"])])
+            out = shm.get_contents_as_numpy(region, "BYTES", [1])
+            assert out[0] == "héllo".encode()
+            shm.set_shared_memory_region(region, [np.int64(7)])
+            assert shm.get_contents_as_numpy(region, np.int64, []) == 7
+        finally:
+            shm.destroy_shared_memory_region(region)
+
+    def test_create_only_rejects_existing_key(self):
+        region = shm.create_shared_memory_region("rege", "/tpu_test_rege", 64)
+        try:
+            with pytest.raises(shm.SharedMemoryException, match="already exists"):
+                shm.create_shared_memory_region(
+                    "rege2", "/tpu_test_rege", 64, create_only=True
+                )
+        finally:
+            shm.destroy_shared_memory_region(region)
+
+    def test_out_of_range_set_raises(self):
+        region = shm.create_shared_memory_region("regs", "/tpu_test_regs", 8)
+        try:
+            with pytest.raises(shm.SharedMemoryException):
+                shm.set_shared_memory_region(
+                    region, [np.arange(16, dtype=np.int32)]
+                )
+        finally:
+            shm.destroy_shared_memory_region(region)
+
+    def test_infer_via_system_shm(self, server, client):
+        x = np.arange(16, dtype=np.int32).reshape(1, 16)
+        y = np.full((1, 16), 3, dtype=np.int32)
+        in_bytes = x.nbytes + y.nbytes
+        out_bytes = x.nbytes
+        in_region = shm.create_shared_memory_region("in", "/tpu_shm_in", in_bytes)
+        out_region = shm.create_shared_memory_region("out", "/tpu_shm_out", 2 * out_bytes)
+        try:
+            shm.set_shared_memory_region(in_region, [x, y])
+            client.register_system_shared_memory("in", "/tpu_shm_in", in_bytes)
+            client.register_system_shared_memory("out", "/tpu_shm_out", 2 * out_bytes)
+
+            status = client.get_system_shared_memory_status(as_json=True)
+            assert {"in", "out"} <= set(status["regions"])
+
+            i0 = InferInput("INPUT0", [1, 16], "INT32")
+            i0.set_shared_memory("in", x.nbytes, 0)
+            i1 = InferInput("INPUT1", [1, 16], "INT32")
+            i1.set_shared_memory("in", y.nbytes, x.nbytes)
+            o0 = InferRequestedOutput("OUTPUT0")
+            o0.set_shared_memory("out", out_bytes, 0)
+            o1 = InferRequestedOutput("OUTPUT1")
+            o1.set_shared_memory("out", out_bytes, out_bytes)
+            result = client.infer("simple", [i0, i1], outputs=[o0, o1])
+
+            # Outputs landed in shm, not in the response body.
+            out0 = shm.get_contents_as_numpy(out_region, np.int32, [1, 16])
+            out1 = shm.get_contents_as_numpy(
+                out_region, np.int32, [1, 16], offset=out_bytes
+            )
+            np.testing.assert_array_equal(out0, x + y)
+            np.testing.assert_array_equal(out1, x - y)
+            assert result.as_numpy("OUTPUT0") is None  # shm-routed
+        finally:
+            client.unregister_system_shared_memory()
+            shm.destroy_shared_memory_region(in_region)
+            shm.destroy_shared_memory_region(out_region)
+
+    def test_shared_memory_tensor_dlpack_export(self):
+        from tritonclient_tpu.utils._shared_memory_tensor import SharedMemoryTensor
+
+        region = shm.create_shared_memory_region("regd", "/tpu_test_regd", 64)
+        try:
+            data = np.arange(16, dtype=np.float32)
+            shm.set_shared_memory_region(region, [data])
+            import ctypes
+
+            base = ctypes.c_void_p()
+            size = ctypes.c_size_t()
+            shm._get_lib().TpuShmRegionInfo(
+                region._c_handle, ctypes.byref(base), ctypes.byref(size),
+                None, None,
+            )
+            tensor = SharedMemoryTensor(base.value, "FP32", (16,), owner=region)
+            out = np.from_dlpack(tensor)
+            np.testing.assert_array_equal(out, data)
+            # zero-copy: writing through shm is visible in the consumer view
+            shm.set_shared_memory_region(region, [data * 2])
+            np.testing.assert_array_equal(out, data * 2)
+        finally:
+            shm.destroy_shared_memory_region(region)
+
+
+# --------------------------------------------------------------------------- #
+# tpu shm                                                                     #
+# --------------------------------------------------------------------------- #
+
+
+class TestTpuShm:
+    def test_numpy_roundtrip(self):
+        region = tpushm.create_shared_memory_region("treg", 256, 0)
+        data = np.arange(32, dtype=np.float32)
+        tpushm.set_shared_memory_region(region, [data])
+        out = tpushm.get_contents_as_numpy(region, "FP32", [32])
+        np.testing.assert_array_equal(out, data)
+        tpushm.destroy_shared_memory_region(region)
+
+    def test_dlpack_ingest_and_export(self):
+        import jax.numpy as jnp
+
+        region = tpushm.create_shared_memory_region("tregd", 1024, 0)
+        src = jnp.linspace(0.0, 1.0, 64, dtype=jnp.float32)
+        tpushm.set_shared_memory_region_from_dlpack(region, [src])
+        view = tpushm.as_shared_memory_tensor(region, "FP32", [64])
+        # Zero-copy: the parked array IS the ingested one.
+        np.testing.assert_allclose(np.asarray(view), np.asarray(src))
+        # The view itself is a DLPack producer (jax.Array __dlpack__).
+        out = np.from_dlpack(view)
+        assert out.shape == (64,)
+        tpushm.destroy_shared_memory_region(region)
+
+    def test_bf16_roundtrip(self):
+        import jax.numpy as jnp
+
+        region = tpushm.create_shared_memory_region("tregbf", 64, 0)
+        src = jnp.arange(8, dtype=jnp.bfloat16)
+        tpushm.set_shared_memory_region_from_dlpack(region, [src])
+        out = tpushm.get_contents_as_numpy(region, "BF16", [8])
+        assert out.dtype == np.float32
+        np.testing.assert_array_equal(out, np.arange(8, dtype=np.float32))
+        tpushm.destroy_shared_memory_region(region)
+
+    def test_partial_overlap_flushes_to_mirror(self):
+        region = tpushm.create_shared_memory_region("tpart", 256, 0)
+        data = np.arange(16, dtype=np.float32)  # 64 bytes at offset 0
+        tpushm.set_shared_memory_region(region, [data])
+        # Overwrite only the first 8 bytes; the rest must stay readable.
+        region.write_bytes(0, b"\x00" * 8)
+        out = np.frombuffer(region.read_bytes(8, 56), dtype=np.float32)
+        np.testing.assert_array_equal(out, data[2:])
+        tpushm.destroy_shared_memory_region(region)
+
+    def test_bytes_tensor_roundtrip(self):
+        from tritonclient_tpu.utils import serialize_byte_tensor
+
+        region = tpushm.create_shared_memory_region("tbytes", 128, 0)
+        data = np.array([b"tpu", b"shared", b"bytes"], dtype=np.object_)
+        region.write_bytes(0, serialize_byte_tensor(data)[0])
+        out = tpushm.get_contents_as_numpy(region, "BYTES", [3])
+        np.testing.assert_array_equal(out, data)
+        tpushm.destroy_shared_memory_region(region)
+
+    def test_unconsumed_capsule_released(self):
+        from tritonclient_tpu.utils import _dlpack
+        from tritonclient_tpu.utils._shared_memory_tensor import SharedMemoryTensor
+
+        buf = np.arange(4, dtype=np.float32)
+        tensor = SharedMemoryTensor(
+            buf.ctypes.data, "FP32", (4,), owner=buf
+        )
+        before = len(_dlpack._live_exports)
+        capsule = tensor.__dlpack__()
+        assert len(_dlpack._live_exports) == before + 1
+        del capsule  # never consumed -> capsule destructor must clean up
+        assert len(_dlpack._live_exports) == before
+
+    def test_raw_handle_resolution(self):
+        region = tpushm.create_shared_memory_region("tregh", 128, 0)
+        handle = tpushm.get_raw_handle(region)
+        assert tpushm._resolve_raw_handle(handle) is region
+        assert tpushm._resolve_raw_handle(b"garbage") is None
+        tpushm.destroy_shared_memory_region(region)
+        assert tpushm._resolve_raw_handle(handle) is None
+
+    def test_infer_via_tpu_shm(self, server, client):
+        x = np.arange(16, dtype=np.int32).reshape(1, 16)
+        y = np.full((1, 16), 5, dtype=np.int32)
+        in_region = tpushm.create_shared_memory_region("tin", x.nbytes + y.nbytes, 0)
+        out_region = tpushm.create_shared_memory_region("tout", 2 * x.nbytes, 0)
+        try:
+            tpushm.set_shared_memory_region(in_region, [x, y])
+            client.register_tpu_shared_memory(
+                "tin", tpushm.get_raw_handle(in_region), 0, x.nbytes + y.nbytes
+            )
+            client.register_tpu_shared_memory(
+                "tout", tpushm.get_raw_handle(out_region), 0, 2 * x.nbytes
+            )
+            status = client.get_tpu_shared_memory_status(as_json=True)
+            assert set(status["regions"]) >= {"tin", "tout"}
+
+            i0 = InferInput("INPUT0", [1, 16], "INT32")
+            i0.set_shared_memory("tin", x.nbytes, 0)
+            i1 = InferInput("INPUT1", [1, 16], "INT32")
+            i1.set_shared_memory("tin", y.nbytes, x.nbytes)
+            o0 = InferRequestedOutput("OUTPUT0")
+            o0.set_shared_memory("tout", x.nbytes, 0)
+            o1 = InferRequestedOutput("OUTPUT1")
+            o1.set_shared_memory("tout", x.nbytes, x.nbytes)
+            client.infer("simple", [i0, i1], outputs=[o0, o1])
+
+            out0 = tpushm.get_contents_as_numpy(out_region, "INT32", [1, 16], 0)
+            out1 = tpushm.get_contents_as_numpy(
+                out_region, "INT32", [1, 16], x.nbytes
+            )
+            np.testing.assert_array_equal(out0, x + y)
+            np.testing.assert_array_equal(out1, x - y)
+        finally:
+            client.unregister_tpu_shared_memory()
+            tpushm.destroy_shared_memory_region(in_region)
+            tpushm.destroy_shared_memory_region(out_region)
+
+    def test_remote_handle_rejected(self, server, client):
+        # A handle minted by "another process" must fail registration.
+        import base64, json as js
+
+        fake = base64.b64encode(js.dumps(
+            {"uuid": "nope", "pid": 1, "byte_size": 64, "device_id": 0}
+        ).encode())
+        from tritonclient_tpu.utils import InferenceServerException
+
+        with pytest.raises(InferenceServerException):
+            client.register_tpu_shared_memory("bad", fake, 0, 64)

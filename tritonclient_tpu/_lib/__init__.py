@@ -1,0 +1,52 @@
+"""Native library loading with an on-demand local build.
+
+The wheel ships prebuilt .so files here (packaging parity with the
+reference, whose platform wheel embeds libcshm.so — setup.py:38-40). In a
+source checkout the library is built on first use with cmake (or a direct
+g++ fallback) from native/.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_LIB_DIR = os.path.dirname(os.path.abspath(__file__))
+_NATIVE_DIR = os.path.normpath(os.path.join(_LIB_DIR, "..", "..", "native"))
+_BUILD_LOCK = threading.Lock()
+
+
+def _try_build() -> Optional[str]:
+    target = os.path.join(_LIB_DIR, "libtpushm.so")
+    src = os.path.join(_NATIVE_DIR, "cshm.cc")
+    if not os.path.exists(src):  # installed wheel without sources
+        return None
+    with _BUILD_LOCK:
+        if os.path.exists(target) and os.path.getmtime(target) >= os.path.getmtime(src):
+            return target
+        cmd = [
+            "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+            src, "-o", target, "-lrt",
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except (OSError, subprocess.SubprocessError):
+            return None
+    return target
+
+
+def load_tpushm() -> Optional[ctypes.CDLL]:
+    """The native shm library, (re)building from source when stale.
+
+    In a source checkout _try_build runs every time (it no-ops when the .so
+    is newer than the source); an installed wheel has no sources and just
+    loads the shipped binary.
+    """
+    path = _try_build() or os.path.join(_LIB_DIR, "libtpushm.so")
+    if not os.path.exists(path):
+        return None
+    try:
+        return ctypes.CDLL(path)
+    except OSError:
+        return None

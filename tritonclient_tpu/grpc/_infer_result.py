@@ -34,6 +34,10 @@ class InferResult:
         if i is None:
             return None
         output = self._result.outputs[i]
+        if "shared_memory_region" in output.parameters:
+            # Tensor bytes live in the registered region, not the response;
+            # the caller reads them via shared_memory.get_contents_as_numpy.
+            return None
         shape = list(output.shape)
         if i >= len(self._result.raw_output_contents):
             return None

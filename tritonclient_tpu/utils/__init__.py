@@ -195,6 +195,30 @@ def deserialize_bytes_tensor(encoded_tensor: bytes) -> np.ndarray:
     return np.array(strs, dtype=np.object_)
 
 
+def decode_bytes_elements(raw: bytes, count: int) -> np.ndarray:
+    """Decode exactly ``count`` length-prefixed BYTES elements from ``raw``.
+
+    Unlike deserialize_bytes_tensor this tolerates trailing slack — needed
+    when reading BYTES out of a fixed-size shared-memory region (the
+    reference's shm decode loop stops at the element count the same way,
+    shared_memory/__init__.py:242-257).
+    """
+    view = memoryview(raw)
+    n = len(view)
+    elements = []
+    offset = 0
+    for _ in range(count):
+        if offset + 4 > n:
+            raise_error("region too small for requested BYTES element count")
+        length = int.from_bytes(view[offset : offset + 4], "little")
+        offset += 4
+        if offset + length > n:
+            raise_error("region too small for requested BYTES element count")
+        elements.append(bytes(view[offset : offset + length]))
+        offset += length
+    return np.array(elements, dtype=np.object_)
+
+
 def serialize_bf16_tensor(input_tensor: np.ndarray) -> Optional[np.ndarray]:
     """Serialize a tensor to BF16 wire bytes (2 bytes/element, row-major).
 

@@ -1,0 +1,187 @@
+"""Minimal DLPack v0.x implementation over ctypes.
+
+Capability parity with the reference's pure-ctypes _dlpack.py (struct
+definitions, capsule create/consume, dtype maps — utils/_dlpack.py:57-272):
+enough to export host shared-memory regions as zero-copy tensors consumable
+by ``np.from_dlpack`` / ``torch.from_dlpack``, and to ingest capsules from
+any producer. Device (TPU) arrays use jax's own __dlpack__ protocol instead
+— see utils/tpu_shared_memory.
+"""
+
+import ctypes
+from typing import Tuple
+
+_c_str_dltensor = b"dltensor"
+_c_str_used_dltensor = b"used_dltensor"
+
+
+class DLDevice(ctypes.Structure):
+    _fields_ = [("device_type", ctypes.c_int), ("device_id", ctypes.c_int)]
+
+
+kDLCPU = 1
+kDLCUDA = 2
+
+
+class DLDataType(ctypes.Structure):
+    _fields_ = [
+        ("type_code", ctypes.c_uint8),
+        ("bits", ctypes.c_uint8),
+        ("lanes", ctypes.c_uint16),
+    ]
+
+
+kDLInt = 0
+kDLUInt = 1
+kDLFloat = 2
+kDLBfloat = 4
+kDLBool = 6
+
+
+class DLTensor(ctypes.Structure):
+    _fields_ = [
+        ("data", ctypes.c_void_p),
+        ("device", DLDevice),
+        ("ndim", ctypes.c_int),
+        ("dtype", DLDataType),
+        ("shape", ctypes.POINTER(ctypes.c_int64)),
+        ("strides", ctypes.POINTER(ctypes.c_int64)),
+        ("byte_offset", ctypes.c_uint64),
+    ]
+
+
+class DLManagedTensor(ctypes.Structure):
+    pass
+
+
+_DELETER_FN = ctypes.CFUNCTYPE(None, ctypes.POINTER(DLManagedTensor))
+
+DLManagedTensor._fields_ = [
+    ("dl_tensor", DLTensor),
+    ("manager_ctx", ctypes.c_void_p),
+    ("deleter", _DELETER_FN),
+]
+
+# Triton datatype -> (type_code, bits)
+TRITON_TO_DLPACK_DTYPE = {
+    "BOOL": (kDLBool, 8),
+    "INT8": (kDLInt, 8),
+    "INT16": (kDLInt, 16),
+    "INT32": (kDLInt, 32),
+    "INT64": (kDLInt, 64),
+    "UINT8": (kDLUInt, 8),
+    "UINT16": (kDLUInt, 16),
+    "UINT32": (kDLUInt, 32),
+    "UINT64": (kDLUInt, 64),
+    "FP16": (kDLFloat, 16),
+    "FP32": (kDLFloat, 32),
+    "FP64": (kDLFloat, 64),
+    "BF16": (kDLBfloat, 16),
+}
+
+_pycapi = ctypes.pythonapi
+_CAPSULE_DESTRUCTOR_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+_pycapi.PyCapsule_New.restype = ctypes.py_object
+_pycapi.PyCapsule_New.argtypes = [
+    ctypes.c_void_p, ctypes.c_char_p, _CAPSULE_DESTRUCTOR_FN,
+]
+_pycapi.PyCapsule_GetPointer.restype = ctypes.c_void_p
+_pycapi.PyCapsule_GetPointer.argtypes = [ctypes.py_object, ctypes.c_char_p]
+_pycapi.PyCapsule_IsValid.restype = ctypes.c_int
+_pycapi.PyCapsule_IsValid.argtypes = [ctypes.py_object, ctypes.c_char_p]
+_pycapi.PyCapsule_SetName.restype = ctypes.c_int
+_pycapi.PyCapsule_SetName.argtypes = [ctypes.py_object, ctypes.c_char_p]
+
+# Keeps the C structs (and the memory owner) alive until the consumer's
+# deleter runs; keyed by the DLManagedTensor address.
+_live_exports = {}
+
+
+@_DELETER_FN
+def _managed_deleter(mt_ptr):
+    _live_exports.pop(ctypes.addressof(mt_ptr.contents), None)
+
+
+@_CAPSULE_DESTRUCTOR_FN
+def _capsule_destructor(capsule_ptr):
+    """Runs when a capsule is garbage-collected.
+
+    The DLPack contract: if the capsule still carries the 'dltensor' name,
+    no consumer took ownership and the producer must free the managed
+    tensor here; a consumed ('used_dltensor') capsule is the consumer's
+    responsibility.
+    """
+    capsule = ctypes.cast(capsule_ptr, ctypes.py_object)
+    if _pycapi.PyCapsule_IsValid(capsule, _c_str_dltensor):
+        ptr = _pycapi.PyCapsule_GetPointer(capsule, _c_str_dltensor)
+        _live_exports.pop(ptr, None)
+
+
+def make_capsule(
+    data_ptr: int,
+    triton_dtype: str,
+    shape: Tuple[int, ...],
+    owner=None,
+):
+    """A 'dltensor' PyCapsule over contiguous host memory at ``data_ptr``.
+
+    ``owner`` is any object kept alive until the consumer releases the
+    capsule (e.g. the shm region holding the bytes).
+    """
+    if triton_dtype not in TRITON_TO_DLPACK_DTYPE:
+        raise ValueError(f"datatype '{triton_dtype}' has no DLPack encoding")
+    code, bits = TRITON_TO_DLPACK_DTYPE[triton_dtype]
+    ndim = len(shape)
+    shape_arr = (ctypes.c_int64 * ndim)(*shape)
+    mt = DLManagedTensor()
+    mt.dl_tensor.data = ctypes.c_void_p(data_ptr)
+    mt.dl_tensor.device = DLDevice(kDLCPU, 0)
+    mt.dl_tensor.ndim = ndim
+    mt.dl_tensor.dtype = DLDataType(code, bits, 1)
+    mt.dl_tensor.shape = shape_arr
+    mt.dl_tensor.strides = None  # NULL => compact row-major
+    mt.dl_tensor.byte_offset = 0
+    mt.manager_ctx = None
+    mt.deleter = _managed_deleter
+    _live_exports[ctypes.addressof(mt)] = (mt, shape_arr, owner)
+    return _pycapi.PyCapsule_New(
+        ctypes.addressof(mt), _c_str_dltensor, _capsule_destructor
+    )
+
+
+def consume_capsule(capsule) -> DLManagedTensor:
+    """Take ownership of a 'dltensor' capsule; returns the managed tensor.
+
+    The caller must invoke ``release_managed_tensor`` when done with the
+    memory (DLPack contract: consumer renames the capsule and later calls
+    the producer's deleter).
+    """
+    if not _pycapi.PyCapsule_IsValid(capsule, _c_str_dltensor):
+        raise ValueError("capsule is not a valid 'dltensor' capsule")
+    ptr = _pycapi.PyCapsule_GetPointer(capsule, _c_str_dltensor)
+    _pycapi.PyCapsule_SetName(capsule, _c_str_used_dltensor)
+    return ctypes.cast(ptr, ctypes.POINTER(DLManagedTensor)).contents
+
+
+def release_managed_tensor(mt: DLManagedTensor):
+    if mt.deleter:
+        mt.deleter(ctypes.pointer(mt))
+
+
+def managed_tensor_nbytes(mt: DLManagedTensor) -> int:
+    n = 1
+    for i in range(mt.dl_tensor.ndim):
+        n *= mt.dl_tensor.shape[i]
+    return n * mt.dl_tensor.dtype.bits // 8
+
+
+def is_contiguous(mt: DLManagedTensor) -> bool:
+    t = mt.dl_tensor
+    if not t.strides:
+        return True
+    expected = 1
+    for i in range(t.ndim - 1, -1, -1):
+        if t.shape[i] != 1 and t.strides[i] != expected:
+            return False
+        expected *= t.shape[i]
+    return True

@@ -1,0 +1,39 @@
+"""Zero-copy DLPack producer view over a host shared-memory region.
+
+Parity with the reference's SharedMemoryTensor (__dlpack__/__dlpack_device__
+producer consumable by torch/jax/numpy from_dlpack —
+utils/_shared_memory_tensor.py:34-87).
+"""
+
+from typing import Sequence
+
+from tritonclient_tpu.utils import _dlpack
+
+
+class SharedMemoryTensor:
+    """Presents region bytes at ``data_ptr`` as a tensor via the DLPack
+    protocol. The region handle is kept alive for as long as any consumer
+    holds the exported memory."""
+
+    def __init__(self, data_ptr: int, triton_dtype: str,
+                 shape: Sequence[int], owner=None):
+        self._data_ptr = data_ptr
+        self._dtype = triton_dtype
+        self._shape = tuple(int(s) for s in shape)
+        self._owner = owner
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def triton_dtype(self):
+        return self._dtype
+
+    def __dlpack__(self, stream=None):
+        return _dlpack.make_capsule(
+            self._data_ptr, self._dtype, self._shape, owner=self._owner
+        )
+
+    def __dlpack_device__(self):
+        return (_dlpack.kDLCPU, 0)

@@ -1,0 +1,196 @@
+"""System (POSIX) shared-memory utils over the native libtpushm core.
+
+API parity with the reference's ``tritonclient.utils.shared_memory``
+(ctypes over libcshm — shared_memory/__init__.py:48-340): create/set/
+get_contents_as_numpy/destroy plus the module-level mapped-regions registry.
+The native core is native/cshm.cc (built on demand, shipped in wheels).
+
+Tensor bytes placed here never travel over the wire: the client registers
+the region (register_system_shared_memory) and the server maps the same
+/dev/shm key (server/_core.py SystemShmRegistry).
+"""
+
+import ctypes
+from typing import List, Optional
+
+import numpy as np
+
+from tritonclient_tpu._lib import load_tpushm
+from tritonclient_tpu.utils import (
+    decode_bytes_elements,
+    serialize_byte_tensor,
+    triton_to_np_dtype,
+)
+
+_lib = None
+
+_ERROR_MAP = {
+    -1: "unable to open/create the shared memory object",
+    -2: "unable to size the shared memory object",
+    -3: "unable to map the shared memory object",
+    -4: "offset + byte size exceeds the region size",
+    -5: "unable to unlink the shared memory object",
+    -6: "unable to unmap the shared memory object",
+    -7: "invalid shared memory handle",
+}
+
+
+class SharedMemoryException(Exception):
+    """Error from the native shared-memory core (reference: :314-340)."""
+
+    def __init__(self, code_or_msg):
+        if isinstance(code_or_msg, int):
+            self._msg = _ERROR_MAP.get(code_or_msg, f"unknown error {code_or_msg}")
+        else:
+            self._msg = str(code_or_msg)
+        super().__init__(self._msg)
+
+    def __str__(self):
+        return self._msg
+
+
+def _get_lib():
+    global _lib
+    if _lib is None:
+        lib = load_tpushm()
+        if lib is None:
+            raise SharedMemoryException(
+                "native shared memory library unavailable (build native/ "
+                "with cmake or ensure g++ is installed)"
+            )
+        lib.TpuShmRegionCreate.restype = ctypes.c_int
+        lib.TpuShmRegionCreate.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_void_p),
+        ]
+        lib.TpuShmRegionSet.restype = ctypes.c_int
+        lib.TpuShmRegionSet.argtypes = [
+            ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t, ctypes.c_void_p,
+        ]
+        lib.TpuShmRegionGet.restype = ctypes.c_int
+        lib.TpuShmRegionGet.argtypes = [
+            ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t, ctypes.c_void_p,
+        ]
+        lib.TpuShmRegionInfo.restype = ctypes.c_int
+        lib.TpuShmRegionInfo.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_size_t), ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.TpuShmRegionDestroy.restype = ctypes.c_int
+        lib.TpuShmRegionDestroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+def _check(code: int):
+    if code != 0:
+        raise SharedMemoryException(code)
+
+
+class SharedMemoryRegion:
+    """Handle for one mapped region (name is the server-side region name,
+    key the /dev/shm object name)."""
+
+    def __init__(self, triton_shm_name: str, shm_key: str, byte_size: int,
+                 c_handle):
+        self.triton_shm_name = triton_shm_name
+        self.shm_key = shm_key
+        self.byte_size = byte_size
+        self._c_handle = c_handle
+
+    def __repr__(self):
+        return (
+            f"SharedMemoryRegion(name={self.triton_shm_name!r}, "
+            f"key={self.shm_key!r}, byte_size={self.byte_size})"
+        )
+
+
+# name -> key registry, mirroring the reference's mapped_shm_regions (:74).
+_mapped_regions = {}
+
+
+def create_shared_memory_region(
+    triton_shm_name: str, shm_key: str, byte_size: int, create_only: bool = False
+) -> SharedMemoryRegion:
+    """Create (or attach to) a POSIX shm region and map it into this process."""
+    handle = ctypes.c_void_p()
+    # create_only maps to O_CREAT|O_EXCL in the native core, so a live
+    # object with the same key (this process or another) fails instead of
+    # being truncated.
+    code = _get_lib().TpuShmRegionCreate(
+        shm_key.encode(), byte_size, 2 if create_only else 1,
+        ctypes.byref(handle),
+    )
+    if code == -1 and create_only:
+        raise SharedMemoryException(
+            f"unable to create the shared memory region, already exists: '{shm_key}'"
+        )
+    _check(code)
+    region = SharedMemoryRegion(triton_shm_name, shm_key, byte_size, handle)
+    _mapped_regions[triton_shm_name] = shm_key
+    return region
+
+
+def set_shared_memory_region(
+    shm_handle: SharedMemoryRegion, input_values, offset: int = 0
+):
+    """Copy each numpy array in ``input_values`` into the region in order.
+
+    BYTES (object/str dtype) arrays are serialized with the 4-byte-length
+    wire format first, exactly as the wire path would.
+    """
+    if not isinstance(input_values, (list, tuple)):
+        raise SharedMemoryException("input_values must be a list of numpy arrays")
+    lib = _get_lib()
+    cursor = offset
+    for arr in input_values:
+        arr = np.asarray(arr)
+        if arr.dtype.type == np.str_:
+            arr = np.char.encode(arr, "utf-8")
+        if arr.dtype == np.object_ or arr.dtype.type == np.bytes_:
+            data = serialize_byte_tensor(arr)[0]
+        else:
+            data = np.ascontiguousarray(arr).tobytes()
+        buf = (ctypes.c_char * len(data)).from_buffer_copy(data)
+        _check(lib.TpuShmRegionSet(shm_handle._c_handle, cursor, len(data), buf))
+        cursor += len(data)
+
+
+def get_contents_as_numpy(
+    shm_handle: SharedMemoryRegion, datatype, shape: List[int], offset: int = 0
+) -> np.ndarray:
+    """Read the region back as a numpy array of the given dtype/shape."""
+    lib = _get_lib()
+    if isinstance(datatype, str):
+        np_dtype = triton_to_np_dtype(datatype)
+        is_bytes = datatype == "BYTES"
+    else:
+        np_dtype = np.dtype(datatype)
+        is_bytes = np_dtype == np.object_
+    if is_bytes:
+        nbytes = shm_handle.byte_size - offset
+        out = (ctypes.c_char * nbytes)()
+        _check(lib.TpuShmRegionGet(shm_handle._c_handle, offset, nbytes, out))
+        raw = bytes(out)
+        # np.prod([]) == 1: scalar (shape []) tensors read one element.
+        count = int(np.prod(shape))
+        return decode_bytes_elements(raw, count).reshape(shape)
+    count = int(np.prod(shape))
+    nbytes = count * np.dtype(np_dtype).itemsize
+    out = (ctypes.c_char * max(nbytes, 1))()
+    _check(lib.TpuShmRegionGet(shm_handle._c_handle, offset, nbytes, out))
+    return np.frombuffer(bytes(out[:nbytes]), dtype=np_dtype).reshape(shape)
+
+
+def mapped_shared_memory_regions() -> List[str]:
+    """Names of regions currently mapped by this process (reference :262-271)."""
+    return list(_mapped_regions)
+
+
+def destroy_shared_memory_region(shm_handle: SharedMemoryRegion):
+    """Unmap and unlink the region."""
+    _mapped_regions.pop(shm_handle.triton_shm_name, None)
+    handle, shm_handle._c_handle = shm_handle._c_handle, None
+    if handle is not None:
+        _check(_get_lib().TpuShmRegionDestroy(handle))

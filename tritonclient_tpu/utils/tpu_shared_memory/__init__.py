@@ -1,0 +1,292 @@
+"""TPU shared memory: the zero-copy tensor plane (the BASELINE north star).
+
+Mirrors the reference's ``tritonclient.utils.cuda_shared_memory`` API
+one-for-one (create/get_raw_handle/set_shared_memory_region[_from_dlpack]/
+get_contents_as_numpy/as_shared_memory_tensor/destroy —
+cuda_shared_memory/__init__.py:107-429) with XLA PjRt device buffers in
+place of cudaMalloc/cudaIpc:
+
+  * a region is a named, sized reservation on one TPU device;
+  * tensors "in" the region are parked jax.Arrays on that device — setting
+    from DLPack ingests any producer's capsule without host staging;
+  * the raw handle is a process-scoped token (cudaIpc has no cross-process
+    analog in PjRt — SURVEY.md §7 hard part 1): a co-located server
+    (same process / same PjRt client) resolves it via the module-global
+    registry and reads/writes jax.Arrays zero-copy; a remote server
+    rejects it with a clear error.
+  * stream ordering: every set_* blocks until the transfer is committed
+    (the JAX analog of the reference's per-device CUDA stream sync,
+    cuda_shared_memory/__init__.py:62-70 — SURVEY.md §7 hard part 3).
+
+A host byte-mirror backs the raw read/write paths (BYTES tensors, partial
+offsets); parked device arrays always take precedence over the mirror for
+the ranges they cover.
+"""
+
+import base64
+import json
+import os
+import threading
+import uuid as _uuid_mod
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from tritonclient_tpu.utils import np_to_triton_dtype, triton_to_np_dtype
+
+
+class TpuSharedMemoryException(Exception):
+    pass
+
+
+_registry: Dict[str, "TpuSharedMemoryRegion"] = {}
+_registry_lock = threading.Lock()
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def _np_dtype_for(datatype: str) -> np.dtype:
+    if datatype == "BF16":
+        import jax.numpy as jnp
+
+        return np.dtype(jnp.bfloat16)
+    np_dtype = triton_to_np_dtype(datatype)
+    if np_dtype is None:
+        raise TpuSharedMemoryException(f"unsupported datatype '{datatype}'")
+    return np.dtype(np_dtype)
+
+
+def _triton_dtype_for(arr) -> str:
+    import jax.numpy as jnp
+
+    if arr.dtype == jnp.bfloat16:
+        return "BF16"
+    return np_to_triton_dtype(np.dtype(arr.dtype))
+
+
+class TpuSharedMemoryRegion:
+    """One named reservation on a TPU device holding parked jax.Arrays."""
+
+    def __init__(self, triton_shm_name: str, byte_size: int, device_id: int):
+        jax = _jax()
+        devices = jax.devices()
+        if device_id >= len(devices):
+            raise TpuSharedMemoryException(
+                f"device_id {device_id} out of range ({len(devices)} devices)"
+            )
+        self.triton_shm_name = triton_shm_name
+        self.byte_size = int(byte_size)
+        self.device_id = int(device_id)
+        self.device = devices[device_id]
+        self.uuid = _uuid_mod.uuid4().hex
+        self._lock = threading.Lock()
+        self._parked: Dict[int, object] = {}  # offset -> jax.Array
+        self._mirror = bytearray(self.byte_size)
+        self._destroyed = False
+
+    # -- internal helpers ----------------------------------------------------
+
+    def _check_range(self, offset: int, nbytes: int):
+        if offset < 0 or offset + nbytes > self.byte_size:
+            raise TpuSharedMemoryException(
+                f"offset {offset} + byte size {nbytes} exceeds region size "
+                f"{self.byte_size} for region '{self.triton_shm_name}'"
+            )
+
+    def _drop_overlapping(self, offset: int, nbytes: int):
+        """Evict parked arrays overlapping [offset, offset+nbytes).
+
+        Partially-overlapped arrays are flushed to the byte mirror first so
+        their non-overlapped bytes stay readable.
+        """
+        for off in list(self._parked):
+            arr = self._parked[off]
+            if off < offset + nbytes and offset < off + arr.nbytes:
+                if off < offset or off + arr.nbytes > offset + nbytes:
+                    self._mirror[off : off + arr.nbytes] = np.asarray(arr).tobytes()
+                del self._parked[off]
+
+    # -- typed (zero-copy) plane --------------------------------------------
+
+    def set_array(self, array, offset: int = 0):
+        """Park a device array at ``offset`` (the zero-copy set path)."""
+        jax = _jax()
+        arr = jax.device_put(array, self.device)
+        jax.block_until_ready(arr)  # region-set boundary == stream sync
+        self._check_range(offset, arr.nbytes)
+        with self._lock:
+            self._drop_overlapping(offset, arr.nbytes)
+            self._parked[offset] = arr
+
+    def as_array(self, datatype: str, shape: Sequence[int], offset: int = 0):
+        """A jax.Array view of the region contents at ``offset``.
+
+        Zero-copy when a parked array matches dtype/shape; otherwise
+        materializes from the byte mirror.
+        """
+        jax = _jax()
+        shape = tuple(int(s) for s in shape)
+        np_dtype = _np_dtype_for(datatype)
+        nbytes = int(np.prod(shape)) * np_dtype.itemsize
+        self._check_range(offset, nbytes)
+        with self._lock:
+            parked = self._parked.get(offset)
+            if parked is not None and parked.nbytes == nbytes:
+                if parked.dtype == np_dtype and parked.shape == shape:
+                    return parked
+                return parked.view(np_dtype).reshape(shape)
+        host = np.frombuffer(
+            self.read_bytes(offset, nbytes), dtype=np_dtype
+        ).reshape(shape)
+        return jax.device_put(host, self.device)
+
+    # -- raw byte plane ------------------------------------------------------
+
+    def write_bytes(self, offset: int, data: bytes):
+        self._check_range(offset, len(data))
+        with self._lock:
+            self._drop_overlapping(offset, len(data))
+            self._mirror[offset : offset + len(data)] = data
+
+    def read_bytes(self, offset: int, nbytes: int) -> bytes:
+        self._check_range(offset, nbytes)
+        with self._lock:
+            parked = sorted(self._parked.items())
+            # Flush parked ranges overlapping the request into the mirror
+            # (device -> host copy only when a raw-byte reader asks).
+            for off, arr in parked:
+                if off < offset + nbytes and offset < off + arr.nbytes:
+                    self._mirror[off : off + arr.nbytes] = np.asarray(arr).tobytes()
+            return bytes(self._mirror[offset : offset + nbytes])
+
+    def __repr__(self):
+        return (
+            f"TpuSharedMemoryRegion(name={self.triton_shm_name!r}, "
+            f"byte_size={self.byte_size}, device={self.device})"
+        )
+
+
+# --------------------------------------------------------------------------- #
+# module API (cuda_shared_memory parity)                                      #
+# --------------------------------------------------------------------------- #
+
+
+def create_shared_memory_region(
+    triton_shm_name: str, byte_size: int, device_id: int = 0
+) -> TpuSharedMemoryRegion:
+    region = TpuSharedMemoryRegion(triton_shm_name, byte_size, device_id)
+    with _registry_lock:
+        _registry[region.uuid] = region
+    return region
+
+
+def get_raw_handle(shm_handle: TpuSharedMemoryRegion) -> bytes:
+    """Serialized handle passed to register_tpu_shared_memory.
+
+    Process-scoped: resolvable only by a server sharing this process's PjRt
+    client (the TPU analog of cudaIpc's same-machine scope).
+    """
+    token = {
+        "uuid": shm_handle.uuid,
+        "pid": os.getpid(),
+        "byte_size": shm_handle.byte_size,
+        "device_id": shm_handle.device_id,
+    }
+    return base64.b64encode(json.dumps(token).encode())
+
+
+def _resolve_raw_handle(raw_handle) -> Optional[TpuSharedMemoryRegion]:
+    """Server-side: raw handle -> live region, or None if not co-located."""
+    try:
+        if isinstance(raw_handle, str):
+            raw_handle = raw_handle.encode()
+        token = json.loads(base64.b64decode(raw_handle))
+    except (ValueError, TypeError):
+        return None
+    if token.get("pid") != os.getpid():
+        return None
+    with _registry_lock:
+        return _registry.get(token.get("uuid"))
+
+
+def set_shared_memory_region(
+    shm_handle: TpuSharedMemoryRegion, input_values, offset: int = 0
+):
+    """Copy numpy arrays into the region (host -> device transfer)."""
+    if not isinstance(input_values, (list, tuple)):
+        raise TpuSharedMemoryException(
+            "input_values must be a list of arrays"
+        )
+    cursor = offset
+    for arr in input_values:
+        arr = np.ascontiguousarray(arr)
+        shm_handle.set_array(arr, cursor)
+        cursor += arr.nbytes
+
+
+def set_shared_memory_region_from_dlpack(
+    shm_handle: TpuSharedMemoryRegion, input_values, offset: int = 0
+):
+    """Ingest DLPack-capable tensors (jax.Array, torch, numpy, ...) without
+    host staging when the producer is already on the target device."""
+    import jax
+
+    if not isinstance(input_values, (list, tuple)):
+        raise TpuSharedMemoryException("input_values must be a list of tensors")
+    cursor = offset
+    for value in input_values:
+        arr = jax.dlpack.from_dlpack(value) if hasattr(value, "__dlpack__") else value
+        shm_handle.set_array(arr, cursor)
+        cursor += arr.nbytes
+
+
+def get_contents_as_numpy(
+    shm_handle: TpuSharedMemoryRegion,
+    datatype,
+    shape: Sequence[int],
+    offset: int = 0,
+) -> np.ndarray:
+    """Device -> host readback of the region contents."""
+    if not isinstance(datatype, str):
+        datatype = np_to_triton_dtype(np.dtype(datatype))
+    if datatype == "BYTES":
+        # BYTES tensors live in the byte mirror (length-prefixed wire
+        # format); there is no typed device view for them.
+        from tritonclient_tpu.utils import decode_bytes_elements
+
+        raw = shm_handle.read_bytes(offset, shm_handle.byte_size - offset)
+        count = int(np.prod(shape))
+        return decode_bytes_elements(raw, count).reshape(shape)
+    arr = shm_handle.as_array(datatype, shape, offset)
+    out = np.asarray(arr)
+    if datatype == "BF16":
+        # numpy has no bf16; hand back float32 like the reference's
+        # triton_to_np_dtype BF16 shim (utils/__init__.py:184).
+        out = out.astype(np.float32)
+    return out
+
+
+def as_shared_memory_tensor(
+    shm_handle: TpuSharedMemoryRegion, datatype: str, shape: Sequence[int],
+    offset: int = 0
+):
+    """Zero-copy consumer view: a jax.Array exposing __dlpack__ for
+    torch/cupy/np from_dlpack interop."""
+    return shm_handle.as_array(datatype, shape, offset)
+
+
+def allocated_shared_memory_regions() -> List[str]:
+    with _registry_lock:
+        return [r.triton_shm_name for r in _registry.values()]
+
+
+def destroy_shared_memory_region(shm_handle: TpuSharedMemoryRegion):
+    shm_handle._destroyed = True
+    with shm_handle._lock:
+        shm_handle._parked.clear()
+    with _registry_lock:
+        _registry.pop(shm_handle.uuid, None)
