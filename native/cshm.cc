@@ -89,11 +89,17 @@ int TpuShmRegionCreate(const char* key, size_t byte_size, int create,
   return kSuccess;
 }
 
+// Overflow-safe range check: offset + byte_size could wrap size_t.
+static bool InRange(const ShmRegion* region, size_t offset, size_t byte_size) {
+  return offset <= region->byte_size &&
+         byte_size <= region->byte_size - offset;
+}
+
 int TpuShmRegionSet(void* handle, size_t offset, size_t byte_size,
                     const void* data) {
   ShmRegion* region = static_cast<ShmRegion*>(handle);
   if (region == nullptr || region->base == nullptr) return kBadHandle;
-  if (offset + byte_size > region->byte_size) return kOutOfRange;
+  if (!InRange(region, offset, byte_size)) return kOutOfRange;
   memcpy(region->base + offset, data, byte_size);
   return kSuccess;
 }
@@ -102,7 +108,7 @@ int TpuShmRegionGet(void* handle, size_t offset, size_t byte_size,
                     void* dst) {
   ShmRegion* region = static_cast<ShmRegion*>(handle);
   if (region == nullptr || region->base == nullptr) return kBadHandle;
-  if (offset + byte_size > region->byte_size) return kOutOfRange;
+  if (!InRange(region, offset, byte_size)) return kOutOfRange;
   memcpy(dst, region->base + offset, byte_size);
   return kSuccess;
 }

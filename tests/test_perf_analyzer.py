@@ -1,0 +1,88 @@
+"""perf_analyzer tests against the hermetic CPU fixture (tiny windows)."""
+
+import numpy as np
+import pytest
+
+from tritonclient_tpu.perf_analyzer import PerfAnalyzer
+from tritonclient_tpu.perf_analyzer._stats import MeasurementWindow, percentile
+from tritonclient_tpu.server import InferenceServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    with InferenceServer() as s:
+        yield s
+
+
+def _make(server, **kw):
+    kw.setdefault("measurement_interval_s", 0.5)
+    kw.setdefault("warmup_s", 0.1)
+    return PerfAnalyzer(server.grpc_address, "simple", batch_size=2, **kw)
+
+
+def test_percentile_edges():
+    assert percentile([], 99) == 0
+    assert percentile([5], 50) == 5
+    vals = list(range(1, 101))
+    assert percentile(vals, 50) == 50
+    assert percentile(vals, 99) == 99
+
+
+@pytest.mark.parametrize(
+    "mode", ["none", "system", "tpu"]
+)
+def test_measure_modes(server, mode):
+    analyzer = _make(server, shared_memory=mode)
+    window = analyzer.measure(2)
+    summary = window.summary()
+    assert summary["errors"] == 0
+    assert summary["count"] > 0
+    assert summary["throughput_infer_per_sec"] > 0
+    assert summary["latency_p99_us"] >= summary["latency_p50_us"] > 0
+
+
+def test_streaming_mode(server):
+    analyzer = _make(server, streaming=True)
+    window = analyzer.measure(2)
+    assert window.summary()["errors"] == 0
+    assert window.summary()["count"] > 0
+
+
+def test_http_protocol(server):
+    analyzer = PerfAnalyzer(
+        server.http_address, "simple", protocol="http", batch_size=2,
+        measurement_interval_s=0.5, warmup_s=0.1,
+    )
+    summary = analyzer.measure(2).summary()
+    assert summary["errors"] == 0 and summary["count"] > 0
+
+
+def test_sweep_levels(server):
+    analyzer = _make(server)
+    results = analyzer.sweep(1, 2, 1)
+    assert [r["concurrency"] for r in results] == [1, 2]
+
+
+def test_resolve_shape_rules():
+    from tritonclient_tpu.perf_analyzer._analyzer import _resolve_shape
+
+    # First dynamic dim is the batch; later dynamic dims need an override.
+    assert _resolve_shape([-1, 16], 4, {}, "X") == [4, 16]
+    assert _resolve_shape([-1, -1], 4, {"X": 128}, "X") == [4, 128]
+    with pytest.raises(ValueError, match="--shape"):
+        _resolve_shape([-1, -1], 4, {}, "X")
+
+
+def test_cli_json_output(server, capsys):
+    import json as js
+
+    from tritonclient_tpu.perf_analyzer.__main__ import main
+
+    rc = main([
+        "-m", "simple", "-u", server.grpc_address, "-b", "2",
+        "--concurrency-range", "1", "-p", "300", "--warmup-interval", "100",
+        "--json",
+    ])
+    assert rc == 0
+    out = js.loads(capsys.readouterr().out)
+    assert out[0]["concurrency"] == 1 and out[0]["count"] > 0

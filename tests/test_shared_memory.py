@@ -72,6 +72,24 @@ class TestSystemShm:
         finally:
             shm.destroy_shared_memory_region(region)
 
+    def test_negative_offset_rejected(self):
+        region = shm.create_shared_memory_region("regn", "/tpu_test_regn", 64)
+        try:
+            with pytest.raises(shm.SharedMemoryException):
+                shm.get_contents_as_numpy(region, np.int32, [4], offset=-100)
+        finally:
+            shm.destroy_shared_memory_region(region)
+
+    def test_set_region_from_dlpack(self):
+        region = shm.create_shared_memory_region("regdl", "/tpu_test_regdl", 64)
+        try:
+            src = np.arange(8, dtype=np.float32)
+            shm.set_shared_memory_region_from_dlpack(region, [src])
+            out = shm.get_contents_as_numpy(region, np.float32, [8])
+            np.testing.assert_array_equal(out, src)
+        finally:
+            shm.destroy_shared_memory_region(region)
+
     def test_create_only_rejects_existing_key(self):
         region = shm.create_shared_memory_region("rege", "/tpu_test_rege", 64)
         try:
@@ -227,6 +245,20 @@ class TestTpuShm:
         assert len(_dlpack._live_exports) == before + 1
         del capsule  # never consumed -> capsule destructor must clean up
         assert len(_dlpack._live_exports) == before
+
+    def test_bytes_set_shared_memory_region(self):
+        data = np.array([b"a", b"bc", b"def"], dtype=np.object_)
+        region = tpushm.create_shared_memory_region("tsetb", 128, 0)
+        tpushm.set_shared_memory_region(region, [data])
+        out = tpushm.get_contents_as_numpy(region, "BYTES", [3])
+        np.testing.assert_array_equal(out, data)
+        tpushm.destroy_shared_memory_region(region)
+
+    def test_destroyed_region_raises(self):
+        region = tpushm.create_shared_memory_region("tdead", 64, 0)
+        tpushm.destroy_shared_memory_region(region)
+        with pytest.raises(tpushm.TpuSharedMemoryException, match="destroyed"):
+            region.read_bytes(0, 8)
 
     def test_raw_handle_resolution(self):
         region = tpushm.create_shared_memory_region("tregh", 128, 0)

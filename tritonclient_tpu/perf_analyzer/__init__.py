@@ -1,0 +1,15 @@
+"""perf-analyzer equivalent for the TPU stack.
+
+CLI: ``python -m tritonclient_tpu.perf_analyzer -m <model> ...`` (flag
+surface modeled on the reference's relocated perf_analyzer tool, including
+``--shared-memory={none,system,tpu}`` per the BASELINE.json north star).
+"""
+
+from tritonclient_tpu.perf_analyzer._analyzer import PerfAnalyzer
+from tritonclient_tpu.perf_analyzer._stats import (
+    InferStat,
+    MeasurementWindow,
+    RequestTimers,
+)
+
+__all__ = ["PerfAnalyzer", "InferStat", "MeasurementWindow", "RequestTimers"]

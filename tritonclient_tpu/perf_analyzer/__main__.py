@@ -1,0 +1,113 @@
+"""perf_analyzer CLI entry point."""
+
+import argparse
+import csv
+import json
+import sys
+
+from tritonclient_tpu.perf_analyzer import PerfAnalyzer
+
+
+def _parse_concurrency_range(value: str):
+    parts = [int(p) for p in value.split(":")]
+    if len(parts) == 1:
+        return parts[0], parts[0], 1
+    if len(parts) == 2:
+        return parts[0], parts[1], 1
+    if len(parts) == 3:
+        return tuple(parts)
+    raise argparse.ArgumentTypeError("use start[:end[:step]]")
+
+
+def _parse_shapes(values):
+    overrides = {}
+    for v in values or []:
+        name, _, dim = v.rpartition(":")
+        overrides[name] = int(dim)
+    return overrides
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="perf_analyzer",
+        description="Concurrency-sweep load generator for KServe v2 servers",
+    )
+    parser.add_argument("-m", "--model-name", required=True)
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("-i", "--protocol", choices=["grpc", "http"], default="grpc")
+    parser.add_argument("-b", "--batch-size", type=int, default=1)
+    parser.add_argument(
+        "--concurrency-range", type=_parse_concurrency_range, default=(1, 1, 1),
+        metavar="start[:end[:step]]",
+    )
+    parser.add_argument(
+        "--shared-memory", choices=["none", "system", "tpu"], default="none"
+    )
+    parser.add_argument("--streaming", action="store_true")
+    parser.add_argument(
+        "-p", "--measurement-interval", type=int, default=5000,
+        help="measurement window in ms",
+    )
+    parser.add_argument("--warmup-interval", type=int, default=1000, help="ms")
+    parser.add_argument(
+        "--shape", action="append", metavar="name:dim",
+        help="value for a dynamic (non-batch) dim, repeatable",
+    )
+    parser.add_argument("--read-outputs", action="store_true",
+                        help="include output deserialization in the loop")
+    parser.add_argument("--device-id", type=int, default=0)
+    parser.add_argument("-f", "--filename", help="write per-level CSV here")
+    parser.add_argument("--json", dest="json_out", action="store_true",
+                        help="print JSON summaries instead of a table")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args(argv)
+
+    analyzer = PerfAnalyzer(
+        url=args.url,
+        model_name=args.model_name,
+        protocol=args.protocol,
+        batch_size=args.batch_size,
+        shared_memory=args.shared_memory,
+        streaming=args.streaming,
+        measurement_interval_s=args.measurement_interval / 1000.0,
+        warmup_s=args.warmup_interval / 1000.0,
+        shape_overrides=_parse_shapes(args.shape),
+        read_outputs=args.read_outputs,
+        device_id=args.device_id,
+        verbose=args.verbose,
+    )
+    start, end, step = args.concurrency_range
+    results = analyzer.sweep(start, end, step)
+
+    if args.json_out:
+        print(json.dumps(results, indent=2))
+    else:
+        print(
+            f"*** Measurement Settings ***\n  Batch size: {args.batch_size}\n"
+            f"  Measurement window: {args.measurement_interval} ms\n"
+            f"  Protocol: {args.protocol}"
+            + (", streaming" if args.streaming else "")
+            + f"\n  Shared memory: {args.shared_memory}\n"
+        )
+        for r in results:
+            print(
+                f"Concurrency: {r['concurrency']}, throughput: "
+                f"{r['throughput_infer_per_sec']} infer/sec, latency avg: "
+                f"{r['latency_avg_us']} usec, p50: {r['latency_p50_us']}, "
+                f"p90: {r['latency_p90_us']}, p95: {r['latency_p95_us']}, "
+                f"p99: {r['latency_p99_us']} usec"
+                + (f", errors: {r['errors']}" if r["errors"] else "")
+            )
+    if not results:
+        print("no measurement levels in --concurrency-range", file=sys.stderr)
+        return 1
+    if args.filename:
+        with open(args.filename, "w", newline="") as f:
+            writer = csv.DictWriter(f, fieldnames=list(results[0]))
+            writer.writeheader()
+            writer.writerows(results)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,459 @@
+"""perf-analyzer equivalent: concurrency-sweep load generator.
+
+The reference repo ships only perf_analyzer packaging hooks (sources
+relocated — src/c++/perf_analyzer/README.md:29-31); this is a full
+reimplementation of its core loop for the TPU stack: a LoadManager that
+holds N closed-loop workers at each concurrency level, RequestTimers
+around every request, and p50/p90/p95/p99 summaries per window. The
+``--shared-memory=tpu`` mode is the BASELINE.json north-star instrument:
+per-worker device-buffer regions so the sweep drives the server with
+on-HBM inputs/outputs over gRPC while only metadata crosses the wire.
+"""
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from tritonclient_tpu.perf_analyzer._stats import (
+    InferStat,
+    MeasurementWindow,
+    RequestTimers,
+)
+from tritonclient_tpu.utils import (
+    serialize_byte_tensor,
+    triton_to_np_dtype,
+)
+
+_RANDOM_POOL = 8  # distinct payloads cycled per worker (defeats caching)
+
+
+def _resolve_shape(spec_shape: List[int], batch: int, overrides: Dict[str, int],
+                   name: str) -> List[int]:
+    shape = list(spec_shape)
+    for i, dim in enumerate(shape):
+        if dim < 0:
+            if i == 0:
+                shape[i] = batch
+            elif name in overrides:
+                shape[i] = overrides[name]
+            else:
+                raise ValueError(
+                    f"input '{name}' has dynamic dim {i}; pass --shape {name}:N"
+                )
+    return shape
+
+
+def _make_payload(rng, datatype: str, shape: List[int]) -> np.ndarray:
+    if datatype == "BYTES":
+        flat = [str(rng.integers(0, 100)).encode() for _ in range(int(np.prod(shape)))]
+        return np.array(flat, dtype=np.object_).reshape(shape)
+    np_dtype = triton_to_np_dtype(datatype)
+    if np_dtype is None:
+        raise ValueError(f"unsupported datatype {datatype}")
+    if np.issubdtype(np_dtype, np.floating):
+        return rng.random(shape, dtype=np.float32).astype(np_dtype)
+    if np_dtype == np.bool_:
+        return rng.integers(0, 2, shape).astype(np.bool_)
+    return rng.integers(0, 64, shape).astype(np_dtype)
+
+
+class _Worker:
+    """One closed-loop requester; owns its client(s) and shm regions."""
+
+    def __init__(self, analyzer: "PerfAnalyzer", wid: int):
+        self.analyzer = analyzer
+        self.wid = wid
+        self.stat = InferStat()
+        self.latencies: List[int] = []
+        self.errors = 0
+        self._stop = threading.Event()
+        self._client = None
+        self._regions = []
+        rng = np.random.default_rng(1234 + wid)
+        self.payload_sets = [
+            {
+                name: _make_payload(rng, dt, shape)
+                for name, (dt, shape) in analyzer.input_specs.items()
+            }
+            for _ in range(_RANDOM_POOL)
+        ]
+
+    # -- setup ---------------------------------------------------------------
+
+    def setup(self):
+        a = self.analyzer
+        self._client = a.make_client()
+        self._inputs = {}
+        mode = a.shared_memory
+        if mode == "none":
+            return
+        total_in = sum(
+            self._region_nbytes(name) for name in a.input_specs
+        )
+        out_sizes = a.output_sizes or {}
+        total_out = sum(out_sizes.values())
+        if mode == "system":
+            import tritonclient_tpu.utils.shared_memory as shm
+
+            key = f"/pa_{a.run_id}_{self.wid}"
+            self._shm = shm
+            self._in_region = shm.create_shared_memory_region(
+                f"pa_in_{self.wid}", key + "_in", total_in
+            )
+            if total_out:
+                self._out_region = shm.create_shared_memory_region(
+                    f"pa_out_{self.wid}", key + "_out", total_out
+                )
+            self._client.register_system_shared_memory(
+                f"pa_in_{self.wid}", key + "_in", total_in
+            )
+            if total_out:
+                self._client.register_system_shared_memory(
+                    f"pa_out_{self.wid}", key + "_out", total_out
+                )
+        elif mode == "tpu":
+            import tritonclient_tpu.utils.tpu_shared_memory as tpushm
+
+            self._tpushm = tpushm
+            self._in_region = tpushm.create_shared_memory_region(
+                f"pa_in_{self.wid}", total_in, a.device_id
+            )
+            self._client.register_tpu_shared_memory(
+                f"pa_in_{self.wid}", tpushm.get_raw_handle(self._in_region),
+                a.device_id, total_in,
+            )
+            if total_out:
+                self._out_region = tpushm.create_shared_memory_region(
+                    f"pa_out_{self.wid}", total_out, a.device_id
+                )
+                self._client.register_tpu_shared_memory(
+                    f"pa_out_{self.wid}", tpushm.get_raw_handle(self._out_region),
+                    a.device_id, total_out,
+                )
+
+    def _region_nbytes(self, name: str) -> int:
+        dt, shape = self.analyzer.input_specs[name]
+        if dt == "BYTES":
+            sample = self.payload_sets[0][name]
+            return len(serialize_byte_tensor(sample)[0]) + 64
+        return int(np.prod(shape)) * np.dtype(triton_to_np_dtype(dt)).itemsize
+
+    def teardown(self):
+        a = self.analyzer
+        try:
+            if a.shared_memory == "system":
+                self._client.unregister_system_shared_memory(f"pa_in_{self.wid}")
+                if hasattr(self, "_out_region"):
+                    self._client.unregister_system_shared_memory(f"pa_out_{self.wid}")
+                self._shm.destroy_shared_memory_region(self._in_region)
+                if hasattr(self, "_out_region"):
+                    self._shm.destroy_shared_memory_region(self._out_region)
+            elif a.shared_memory == "tpu":
+                self._client.unregister_tpu_shared_memory(f"pa_in_{self.wid}")
+                if hasattr(self, "_out_region"):
+                    self._client.unregister_tpu_shared_memory(f"pa_out_{self.wid}")
+                self._tpushm.destroy_shared_memory_region(self._in_region)
+                if hasattr(self, "_out_region"):
+                    self._tpushm.destroy_shared_memory_region(self._out_region)
+        finally:
+            a.close_client(self._client)
+
+    # -- request construction ------------------------------------------------
+
+    def _build_inputs(self, payloads):
+        a = self.analyzer
+        InferInput = a.infer_input_cls
+        inputs = []
+        if a.shared_memory == "none":
+            for name, (dt, shape) in a.input_specs.items():
+                inp = InferInput(name, shape, dt)
+                inp.set_data_from_numpy(payloads[name])
+                inputs.append(inp)
+            return inputs
+        # shm: write payload bytes into this worker's input region, then
+        # reference (region, size, offset) per input.
+        offset = 0
+        arrays, offsets, sizes = [], {}, {}
+        for name, (dt, shape) in a.input_specs.items():
+            arr = payloads[name]
+            if dt == "BYTES":
+                nbytes = len(serialize_byte_tensor(arr)[0])
+            else:
+                nbytes = arr.nbytes
+            offsets[name], sizes[name] = offset, nbytes
+            arrays.append(arr)
+            offset += nbytes
+        if a.shared_memory == "system":
+            self._shm.set_shared_memory_region(self._in_region, arrays)
+        else:
+            self._tpushm.set_shared_memory_region(self._in_region, arrays)
+        for name, (dt, shape) in a.input_specs.items():
+            inp = InferInput(name, shape, dt)
+            inp.set_shared_memory(
+                f"pa_in_{self.wid}", sizes[name], offsets[name]
+            )
+            inputs.append(inp)
+        return inputs
+
+    def _build_outputs(self):
+        a = self.analyzer
+        if not a.output_names:
+            return None
+        outs = []
+        offset = 0
+        for name in a.output_names:
+            out = a.requested_output_cls(name)
+            if a.shared_memory != "none" and a.output_sizes:
+                size = a.output_sizes[name]
+                out.set_shared_memory(f"pa_out_{self.wid}", size, offset)
+                offset += size
+            outs.append(out)
+        return outs
+
+    # -- loops ---------------------------------------------------------------
+
+    def run(self, end_time: float):
+        if self.analyzer.streaming:
+            self._run_streaming(end_time)
+        else:
+            self._run_sync(end_time)
+
+    def _run_sync(self, end_time: float):
+        a = self.analyzer
+        i = 0
+        outputs = self._build_outputs()
+        while time.perf_counter() < end_time and not self._stop.is_set():
+            payloads = self.payload_sets[i % _RANDOM_POOL]
+            i += 1
+            timers = RequestTimers()
+            timers.capture("request_start")
+            try:
+                timers.capture("send_start")
+                inputs = self._build_inputs(payloads)
+                timers.capture("send_end")
+                result = self._client.infer(
+                    a.model_name, inputs, outputs=outputs
+                )
+                timers.capture("recv_start")
+                if a.read_outputs and a.output_names:
+                    for name in a.output_names:
+                        result.as_numpy(name)
+                timers.capture("recv_end")
+            except Exception:
+                self.errors += 1
+                continue
+            timers.capture("request_end")
+            self.stat.update(timers)
+            self.latencies.append(timers.total_ns)
+
+    def _run_streaming(self, end_time: float):
+        """Closed loop over a long-lived gRPC bidi stream."""
+        import queue
+
+        a = self.analyzer
+        done: "queue.Queue" = queue.Queue()
+        self._client.start_stream(
+            callback=lambda result, error: done.put((result, error))
+        )
+        outputs = self._build_outputs()
+        i = 0
+        try:
+            while time.perf_counter() < end_time and not self._stop.is_set():
+                payloads = self.payload_sets[i % _RANDOM_POOL]
+                i += 1
+                timers = RequestTimers()
+                timers.capture("request_start")
+                try:
+                    timers.capture("send_start")
+                    inputs = self._build_inputs(payloads)
+                    timers.capture("send_end")
+                    self._client.async_stream_infer(
+                        a.model_name, inputs, outputs=outputs
+                    )
+                    timers.capture("recv_start")
+                    result, error = done.get(timeout=120)
+                    timers.capture("recv_end")
+                    if error is not None:
+                        self.errors += 1
+                        continue
+                except Exception:
+                    self.errors += 1
+                    continue
+                timers.capture("request_end")
+                self.stat.update(timers)
+                self.latencies.append(timers.total_ns)
+        finally:
+            self._client.stop_stream()
+
+
+class PerfAnalyzer:
+    """Concurrency-sweep load generator against a KServe v2 server."""
+
+    def __init__(
+        self,
+        url: str,
+        model_name: str,
+        protocol: str = "grpc",
+        batch_size: int = 1,
+        shared_memory: str = "none",
+        streaming: bool = False,
+        measurement_interval_s: float = 5.0,
+        warmup_s: float = 1.0,
+        shape_overrides: Optional[Dict[str, int]] = None,
+        output_names: Optional[List[str]] = None,
+        output_sizes: Optional[Dict[str, int]] = None,
+        read_outputs: bool = False,
+        device_id: int = 0,
+        verbose: bool = False,
+    ):
+        if protocol not in ("grpc", "http"):
+            raise ValueError("protocol must be grpc or http")
+        if streaming and protocol != "grpc":
+            raise ValueError("--streaming requires grpc")
+        if shared_memory not in ("none", "system", "tpu"):
+            raise ValueError("shared_memory must be none|system|tpu")
+        self.url = url
+        self.model_name = model_name
+        self.protocol = protocol
+        self.batch_size = batch_size
+        self.shared_memory = shared_memory
+        self.streaming = streaming
+        self.measurement_interval_s = measurement_interval_s
+        self.warmup_s = warmup_s
+        self.read_outputs = read_outputs
+        self.device_id = device_id
+        self.verbose = verbose
+        self.run_id = int(time.time() * 1000) % 100000
+
+        if protocol == "grpc":
+            from tritonclient_tpu.grpc import (
+                InferenceServerClient,
+                InferInput,
+                InferRequestedOutput,
+            )
+        else:
+            from tritonclient_tpu.http import (
+                InferenceServerClient,
+                InferInput,
+                InferRequestedOutput,
+            )
+        self._client_cls = InferenceServerClient
+        self.infer_input_cls = InferInput
+        self.requested_output_cls = InferRequestedOutput
+
+        meta_client = self.make_client()
+        try:
+            if protocol == "grpc":
+                meta = meta_client.get_model_metadata(model_name, as_json=True)
+            else:
+                meta = meta_client.get_model_metadata(model_name)
+        finally:
+            self.close_client(meta_client)
+        overrides = shape_overrides or {}
+        self.input_specs = {
+            t["name"]: (
+                t["datatype"],
+                _resolve_shape(
+                    [int(s) for s in t["shape"]], batch_size, overrides, t["name"]
+                ),
+            )
+            for t in meta["inputs"]
+        }
+        meta_outputs = [t["name"] for t in meta.get("outputs", [])]
+        self.output_names = output_names if output_names is not None else meta_outputs
+        self.output_sizes = output_sizes
+        if shared_memory != "none" and self.output_names and not output_sizes:
+            # Infer fixed output sizes from metadata when static.
+            sizes = {}
+            for t in meta.get("outputs", []):
+                if t["name"] not in self.output_names:
+                    continue
+                shape = [int(s) for s in t["shape"]]
+                shape = [batch_size if s < 0 else s for s in shape[:1]] + [
+                    s for s in shape[1:]
+                ]
+                if any(s < 0 for s in shape) or t["datatype"] == "BYTES":
+                    sizes = None
+                    break
+                sizes[t["name"]] = int(np.prod(shape)) * np.dtype(
+                    triton_to_np_dtype(t["datatype"])
+                ).itemsize
+            self.output_sizes = sizes
+            if sizes is None:
+                # Dynamic outputs: fall back to wire-returned outputs.
+                self.output_sizes = None
+
+    def make_client(self):
+        if self.protocol == "grpc":
+            return self._client_cls(self.url)
+        return self._client_cls(self.url, concurrency=4)
+
+    def close_client(self, client):
+        try:
+            client.close()
+        except Exception:
+            pass
+
+    # -- measurement ---------------------------------------------------------
+
+    def measure(self, concurrency: int) -> MeasurementWindow:
+        workers = [_Worker(self, w) for w in range(concurrency)]
+        ready = []
+        try:
+            for w in workers:
+                w.setup()
+                ready.append(w)
+            end = time.perf_counter() + self.warmup_s + self.measurement_interval_s
+            threads = [
+                threading.Thread(target=w.run, args=(end,), daemon=True)
+                for w in workers
+            ]
+            window_start = time.perf_counter() + self.warmup_s
+            for t in threads:
+                t.start()
+            # Discard warmup-period results by timestamping the cut.
+            time.sleep(self.warmup_s)
+            for w in workers:
+                w.latencies.clear()
+                w.stat = InferStat()
+                w.errors = 0
+            for t in threads:
+                t.join()
+            duration = time.perf_counter() - window_start
+            window = MeasurementWindow(concurrency=concurrency, duration_s=duration)
+            for w in workers:
+                window.latencies_ns.extend(w.latencies)
+                window.errors += w.errors
+                window.stat.completed_request_count += w.stat.completed_request_count
+                window.stat.cumulative_total_request_time_ns += (
+                    w.stat.cumulative_total_request_time_ns
+                )
+                window.stat.cumulative_send_time_ns += w.stat.cumulative_send_time_ns
+                window.stat.cumulative_receive_time_ns += (
+                    w.stat.cumulative_receive_time_ns
+                )
+            return window
+        finally:
+            for w in ready:
+                try:
+                    w.teardown()
+                except Exception:  # cleanup must reach every worker
+                    pass
+
+    def sweep(self, start: int, end: int, step: int = 1) -> List[Dict]:
+        results = []
+        level = start
+        while level <= end:
+            window = self.measure(level)
+            summary = window.summary()
+            results.append(summary)
+            if self.verbose:
+                print(
+                    f"Concurrency: {level}, throughput: "
+                    f"{summary['throughput_infer_per_sec']} infer/sec, latency "
+                    f"p99: {summary['latency_p99_us']} usec"
+                )
+            level += step
+        return results

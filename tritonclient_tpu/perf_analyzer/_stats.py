@@ -1,0 +1,104 @@
+"""Measurement primitives for the perf-analyzer equivalent.
+
+RequestTimers/InferStat follow the reference C++ client's instrumentation
+model (common.h:568-652 six-point ns timestamps; common.cc:56-106
+cumulative InferStat) so latency composition (send/service/receive) is
+reported the way perf_analyzer users expect.
+"""
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+
+@dataclass
+class RequestTimers:
+    """ns timestamps around one request."""
+
+    request_start: int = 0
+    send_start: int = 0
+    send_end: int = 0
+    recv_start: int = 0
+    recv_end: int = 0
+    request_end: int = 0
+
+    def capture(self, name: str):
+        setattr(self, name, time.monotonic_ns())
+
+    @property
+    def total_ns(self) -> int:
+        return self.request_end - self.request_start
+
+    @property
+    def send_ns(self) -> int:
+        return self.send_end - self.send_start
+
+    @property
+    def recv_ns(self) -> int:
+        return self.recv_end - self.recv_start
+
+
+@dataclass
+class InferStat:
+    """Cumulative client-side counters (reference common.h:93-117)."""
+
+    completed_request_count: int = 0
+    cumulative_total_request_time_ns: int = 0
+    cumulative_send_time_ns: int = 0
+    cumulative_receive_time_ns: int = 0
+
+    def update(self, timers: RequestTimers):
+        self.completed_request_count += 1
+        self.cumulative_total_request_time_ns += timers.total_ns
+        self.cumulative_send_time_ns += timers.send_ns
+        self.cumulative_receive_time_ns += timers.recv_ns
+
+
+def percentile(sorted_values: List[int], pct: float) -> int:
+    """Nearest-rank percentile: value at ceil(p/100 * n)."""
+    if not sorted_values:
+        return 0
+    import math
+
+    idx = min(len(sorted_values) - 1, math.ceil(pct / 100.0 * len(sorted_values)) - 1)
+    return sorted_values[max(idx, 0)]
+
+
+@dataclass
+class MeasurementWindow:
+    """One concurrency level's results."""
+
+    concurrency: int
+    duration_s: float
+    latencies_ns: List[int] = field(default_factory=list)
+    errors: int = 0
+    stat: InferStat = field(default_factory=InferStat)
+
+    @property
+    def throughput(self) -> float:
+        return len(self.latencies_ns) / self.duration_s if self.duration_s else 0.0
+
+    def summary(self, percentiles=(50, 90, 95, 99)) -> Dict:
+        lat = sorted(self.latencies_ns)
+        avg = sum(lat) / len(lat) if lat else 0
+        return {
+            "concurrency": self.concurrency,
+            "count": len(lat),
+            "errors": self.errors,
+            "throughput_infer_per_sec": round(self.throughput, 2),
+            "latency_avg_us": int(avg / 1000),
+            **{
+                f"latency_p{p}_us": int(percentile(lat, p) / 1000)
+                for p in percentiles
+            },
+            "send_us": int(
+                self.stat.cumulative_send_time_ns
+                / max(self.stat.completed_request_count, 1)
+                / 1000
+            ),
+            "receive_us": int(
+                self.stat.cumulative_receive_time_ns
+                / max(self.stat.completed_request_count, 1)
+                / 1000
+            ),
+        }

@@ -149,39 +149,6 @@ def make_capsule(
     )
 
 
-def consume_capsule(capsule) -> DLManagedTensor:
-    """Take ownership of a 'dltensor' capsule; returns the managed tensor.
-
-    The caller must invoke ``release_managed_tensor`` when done with the
-    memory (DLPack contract: consumer renames the capsule and later calls
-    the producer's deleter).
-    """
-    if not _pycapi.PyCapsule_IsValid(capsule, _c_str_dltensor):
-        raise ValueError("capsule is not a valid 'dltensor' capsule")
-    ptr = _pycapi.PyCapsule_GetPointer(capsule, _c_str_dltensor)
-    _pycapi.PyCapsule_SetName(capsule, _c_str_used_dltensor)
-    return ctypes.cast(ptr, ctypes.POINTER(DLManagedTensor)).contents
-
-
-def release_managed_tensor(mt: DLManagedTensor):
-    if mt.deleter:
-        mt.deleter(ctypes.pointer(mt))
-
-
-def managed_tensor_nbytes(mt: DLManagedTensor) -> int:
-    n = 1
-    for i in range(mt.dl_tensor.ndim):
-        n *= mt.dl_tensor.shape[i]
-    return n * mt.dl_tensor.dtype.bits // 8
-
-
-def is_contiguous(mt: DLManagedTensor) -> bool:
-    t = mt.dl_tensor
-    if not t.strides:
-        return True
-    expected = 1
-    for i in range(t.ndim - 1, -1, -1):
-        if t.shape[i] != 1 and t.strides[i] != expected:
-            return False
-        expected *= t.shape[i]
-    return True
+# Ingestion of foreign capsules intentionally has no hand-rolled consumer
+# here: numpy (host) and jax (device) already implement the consumer side of
+# the protocol, and tpu_shared_memory/shared_memory route through them.

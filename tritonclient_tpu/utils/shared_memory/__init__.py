@@ -142,6 +142,8 @@ def set_shared_memory_region(
     """
     if not isinstance(input_values, (list, tuple)):
         raise SharedMemoryException("input_values must be a list of numpy arrays")
+    if offset < 0:
+        raise SharedMemoryException(-4)
     lib = _get_lib()
     cursor = offset
     for arr in input_values:
@@ -157,10 +159,24 @@ def set_shared_memory_region(
         cursor += len(data)
 
 
+def set_shared_memory_region_from_dlpack(
+    shm_handle: SharedMemoryRegion, input_values, offset: int = 0
+):
+    """Copy DLPack-capable host tensors into the region (API parity with the
+    reference's cuda_shared_memory ingest, :328-388; numpy is the consumer)."""
+    arrays = [
+        np.from_dlpack(v) if hasattr(v, "__dlpack__") else np.asarray(v)
+        for v in (input_values if isinstance(input_values, (list, tuple)) else [input_values])
+    ]
+    set_shared_memory_region(shm_handle, arrays, offset=offset)
+
+
 def get_contents_as_numpy(
     shm_handle: SharedMemoryRegion, datatype, shape: List[int], offset: int = 0
 ) -> np.ndarray:
     """Read the region back as a numpy array of the given dtype/shape."""
+    if offset < 0:
+        raise SharedMemoryException(-4)
     lib = _get_lib()
     if isinstance(datatype, str):
         np_dtype = triton_to_np_dtype(datatype)

@@ -91,6 +91,10 @@ class TpuSharedMemoryRegion:
     # -- internal helpers ----------------------------------------------------
 
     def _check_range(self, offset: int, nbytes: int):
+        if self._destroyed:
+            raise TpuSharedMemoryException(
+                f"shared memory region '{self.triton_shm_name}' has been destroyed"
+            )
         if offset < 0 or offset + nbytes > self.byte_size:
             raise TpuSharedMemoryException(
                 f"offset {offset} + byte size {nbytes} exceeds region size "
@@ -221,11 +225,23 @@ def set_shared_memory_region(
         raise TpuSharedMemoryException(
             "input_values must be a list of arrays"
         )
+    from tritonclient_tpu.utils import serialize_byte_tensor
+
     cursor = offset
     for arr in input_values:
-        arr = np.ascontiguousarray(arr)
-        shm_handle.set_array(arr, cursor)
-        cursor += arr.nbytes
+        arr = np.asarray(arr)
+        if arr.dtype.type == np.str_:
+            arr = np.char.encode(arr, "utf-8")
+        if arr.dtype == np.object_ or arr.dtype.type == np.bytes_:
+            # BYTES tensors have no device representation; the serialized
+            # wire bytes land in the region's host mirror.
+            data = serialize_byte_tensor(arr)[0]
+            shm_handle.write_bytes(cursor, data)
+            cursor += len(data)
+        else:
+            arr = np.ascontiguousarray(arr)
+            shm_handle.set_array(arr, cursor)
+            cursor += arr.nbytes
 
 
 def set_shared_memory_region_from_dlpack(
@@ -288,5 +304,6 @@ def destroy_shared_memory_region(shm_handle: TpuSharedMemoryRegion):
     shm_handle._destroyed = True
     with shm_handle._lock:
         shm_handle._parked.clear()
+        shm_handle._mirror = bytearray(0)
     with _registry_lock:
         _registry.pop(shm_handle.uuid, None)
