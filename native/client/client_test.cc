@@ -1,0 +1,185 @@
+// Self-checking C++ client test binary, driven by tests/test_cpp_client.py
+// against the in-process JAX server (the role cc_client_test.cc plays in the
+// reference against a live Triton, tests/cc_client_test.cc:42-71).
+//
+//   client_test <host:port>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <iostream>
+#include <mutex>
+
+#include "http_client.h"
+
+using namespace tputriton;  // NOLINT
+
+static int failures = 0;
+
+#define EXPECT(cond, msg)                              \
+  do {                                                 \
+    if (!(cond)) {                                     \
+      std::cerr << "FAIL: " << msg << "\n";            \
+      failures++;                                      \
+    }                                                  \
+  } while (0)
+
+#define EXPECT_OK(err, msg)                                               \
+  do {                                                                    \
+    Error e = (err);                                                      \
+    if (!e.IsOk()) {                                                      \
+      std::cerr << "FAIL: " << msg << ": " << e.Message() << "\n";        \
+      failures++;                                                         \
+    }                                                                     \
+  } while (0)
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::cerr << "usage: client_test <host:port>\n";
+    return 2;
+  }
+  std::unique_ptr<InferenceServerHttpClient> client;
+  EXPECT_OK(InferenceServerHttpClient::Create(&client, argv[1]), "create");
+
+  // health + metadata
+  bool live = false, ready = false;
+  EXPECT_OK(client->IsServerLive(&live), "live");
+  EXPECT(live, "server live");
+  EXPECT_OK(client->IsServerReady(&ready), "ready");
+  EXPECT(ready, "server ready");
+  json::ValuePtr meta;
+  EXPECT_OK(client->ServerMetadata(&meta), "server metadata");
+  EXPECT(meta->Get("name") != nullptr, "metadata has name");
+  EXPECT_OK(client->ModelMetadata(&meta, "simple"), "model metadata");
+  EXPECT(meta->Get("inputs")->Size() == 2, "simple has 2 inputs");
+  EXPECT_OK(client->ModelConfig(&meta, "simple"), "model config");
+  json::ValuePtr index;
+  EXPECT_OK(client->ModelRepositoryIndex(&index), "repository index");
+  EXPECT(index->Size() >= 1, "repository has models");
+
+  // infer (binary framing)
+  int32_t input0[16], input1[16];
+  for (int i = 0; i < 16; i++) {
+    input0[i] = i * 2;
+    input1[i] = i;
+  }
+  InferInput in0("INPUT0", {1, 16}, "INT32");
+  InferInput in1("INPUT1", {1, 16}, "INT32");
+  in0.AppendRaw(reinterpret_cast<uint8_t*>(input0), 64);
+  in1.AppendRaw(reinterpret_cast<uint8_t*>(input1), 64);
+  InferOptions options("simple");
+  options.request_id_ = "cpp-1";
+  std::shared_ptr<InferResult> result;
+  EXPECT_OK(client->Infer(&result, options, {&in0, &in1}), "infer");
+  EXPECT(result->Id() == "cpp-1", "request id echo");
+  const uint8_t* buf;
+  size_t nbytes;
+  EXPECT_OK(result->RawData("OUTPUT0", &buf, &nbytes), "OUTPUT0 raw");
+  EXPECT(nbytes == 64, "OUTPUT0 size");
+  const int32_t* sums = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; i++) {
+    EXPECT(sums[i] == input0[i] + input1[i], "sum value");
+  }
+  std::vector<int64_t> shape;
+  EXPECT_OK(result->Shape("OUTPUT0", &shape), "shape");
+  EXPECT(shape.size() == 2 && shape[1] == 16, "shape value");
+  std::string datatype;
+  EXPECT_OK(result->Datatype("OUTPUT0", &datatype), "datatype");
+  EXPECT(datatype == "INT32", "datatype value");
+
+  // BYTES model round trip
+  InferInput sin0("INPUT0", {1, 16}, "BYTES");
+  InferInput sin1("INPUT1", {1, 16}, "BYTES");
+  std::vector<std::string> svals0, svals1;
+  for (int i = 0; i < 16; i++) {
+    svals0.push_back(std::to_string(i));
+    svals1.push_back(std::to_string(100 + i));
+  }
+  sin0.AppendFromString(svals0);
+  sin1.AppendFromString(svals1);
+  InferOptions sopt("simple_string");
+  EXPECT_OK(client->Infer(&result, sopt, {&sin0, &sin1}), "string infer");
+  std::vector<std::string> sums_str;
+  EXPECT_OK(result->StringData("OUTPUT0", &sums_str), "string data");
+  EXPECT(sums_str.size() == 16, "string count");
+  if (sums_str.size() == 16) {
+    EXPECT(sums_str[3] == "106", "string sum value");
+  }
+
+  // JSON-data input mode (SetBinaryData(false)) must round-trip too
+  InferInput jin0("INPUT0", {1, 16}, "INT32");
+  InferInput jin1("INPUT1", {1, 16}, "INT32");
+  jin0.AppendRaw(reinterpret_cast<uint8_t*>(input0), 64);
+  jin1.AppendRaw(reinterpret_cast<uint8_t*>(input1), 64);
+  jin0.SetBinaryData(false);
+  jin1.SetBinaryData(false);
+  EXPECT_OK(client->Infer(&result, options, {&jin0, &jin1}), "json-data infer");
+  EXPECT_OK(result->RawData("OUTPUT0", &buf, &nbytes), "json-data OUTPUT0");
+  EXPECT(nbytes == 64 &&
+             reinterpret_cast<const int32_t*>(buf)[5] == input0[5] + input1[5],
+         "json-data sum value");
+
+  // error path: unknown model
+  InferOptions bad("no_such_model");
+  Error err = client->Infer(&result, bad, {&in0, &in1});
+  EXPECT(!err.IsOk(), "unknown model fails");
+  EXPECT(err.Message().find("no_such_model") != std::string::npos,
+         "error names the model");
+
+  // async infer
+  std::mutex mu;
+  std::condition_variable cv;
+  std::atomic<int> done{0};
+  Error async_err;
+  std::shared_ptr<InferResult> async_result;
+  for (int r = 0; r < 4; r++) {
+    EXPECT_OK(client->AsyncInfer(
+                  [&](std::shared_ptr<InferResult> res, Error e) {
+                    std::lock_guard<std::mutex> lk(mu);
+                    async_result = std::move(res);
+                    async_err = e;
+                    done++;
+                    cv.notify_all();
+                  },
+                  options, {&in0, &in1}),
+              "async infer submit");
+  }
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait_for(lk, std::chrono::seconds(30), [&] { return done == 4; });
+  }
+  EXPECT(done == 4, "async completions");
+  EXPECT_OK(async_err, "async result ok");
+
+  // statistics + client stats
+  json::ValuePtr stats;
+  EXPECT_OK(client->ModelInferenceStatistics(&stats, "simple"), "server stats");
+  InferStat cstat;
+  EXPECT_OK(client->ClientInferStat(&cstat), "client stats");
+  EXPECT(cstat.completed_request_count >= 5, "client stat count");
+
+  // model control
+  EXPECT_OK(client->UnloadModel("simple_string"), "unload");
+  bool sready = true;
+  EXPECT_OK(client->IsModelReady("simple_string", &sready), "ready query");
+  EXPECT(!sready, "unloaded not ready");
+  EXPECT_OK(client->LoadModel("simple_string"), "load");
+  EXPECT_OK(client->IsModelReady("simple_string", &sready), "ready query 2");
+  EXPECT(sready, "loaded ready");
+
+  // trace/log settings
+  json::ValuePtr settings;
+  EXPECT_OK(client->GetTraceSettings(&settings), "get trace");
+  EXPECT_OK(client->UpdateTraceSettings(&settings, "",
+                                        "{\"trace_level\":[\"TIMESTAMPS\"]}"),
+            "update trace");
+  EXPECT(settings->Get("trace_level") != nullptr, "trace level present");
+  EXPECT_OK(client->GetLogSettings(&settings), "get log");
+
+  if (failures == 0) {
+    std::cout << "ALL PASS\n";
+    return 0;
+  }
+  std::cerr << failures << " failures\n";
+  return 1;
+}

@@ -1,0 +1,69 @@
+#include "common.h"
+
+namespace tputriton {
+
+const Error Error::Success = Error();
+
+Error InferResult::Shape(const std::string& name,
+                         std::vector<int64_t>* shape) const {
+  auto it = outputs_.find(name);
+  if (it == outputs_.end()) {
+    return Error("output '" + name + "' not found in result");
+  }
+  *shape = it->second.shape;
+  return Error::Success;
+}
+
+Error InferResult::Datatype(const std::string& name,
+                            std::string* datatype) const {
+  auto it = outputs_.find(name);
+  if (it == outputs_.end()) {
+    return Error("output '" + name + "' not found in result");
+  }
+  *datatype = it->second.datatype;
+  return Error::Success;
+}
+
+Error InferResult::RawData(const std::string& name, const uint8_t** buf,
+                           size_t* nbytes) const {
+  auto it = outputs_.find(name);
+  if (it == outputs_.end()) {
+    return Error("output '" + name + "' not found in result");
+  }
+  if (it->second.in_shared_memory) {
+    return Error("output '" + name +
+                 "' is in shared memory; read it from the region");
+  }
+  *buf = it->second.data.data();
+  *nbytes = it->second.data.size();
+  return Error::Success;
+}
+
+Error InferResult::StringData(const std::string& name,
+                              std::vector<std::string>* out) const {
+  const uint8_t* buf;
+  size_t nbytes;
+  Error err = RawData(name, &buf, &nbytes);
+  if (!err.IsOk()) return err;
+  out->clear();
+  size_t pos = 0;
+  while (pos + 4 <= nbytes) {
+    uint32_t len;
+    std::memcpy(&len, buf + pos, 4);
+    pos += 4;
+    if (pos + len > nbytes) {
+      return Error("malformed BYTES tensor in output '" + name + "'");
+    }
+    out->emplace_back(reinterpret_cast<const char*>(buf + pos), len);
+    pos += len;
+  }
+  return Error::Success;
+}
+
+std::vector<std::string> InferResult::OutputNames() const {
+  std::vector<std::string> names;
+  for (const auto& kv : outputs_) names.push_back(kv.first);
+  return names;
+}
+
+}  // namespace tputriton

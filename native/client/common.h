@@ -1,0 +1,233 @@
+// C++ client object model: Error, options, tensors, results, timers.
+//
+// Capability parity with the reference's src/c++/library/common.h (Error
+// :61, InferOptions :164-230, InferInput :237-366, InferRequestedOutput
+// :400-455, InferResult :488-564, RequestTimers :568-652, InferStat :93)
+// in an independent, simpler design: tensors own contiguous byte buffers,
+// BYTES elements use the 4-byte-LE length-prefix wire format, and the
+// result object is concrete (HTTP-backed) rather than an abstract family.
+
+#pragma once
+
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace tputriton {
+
+class Error {
+ public:
+  Error() : ok_(true) {}
+  explicit Error(const std::string& msg) : ok_(false), msg_(msg) {}
+  static const Error Success;
+  bool IsOk() const { return ok_; }
+  const std::string& Message() const { return msg_; }
+
+ private:
+  bool ok_;
+  std::string msg_;
+};
+
+struct InferOptions {
+  explicit InferOptions(const std::string& model_name)
+      : model_name_(model_name) {}
+  std::string model_name_;
+  std::string model_version_;
+  std::string request_id_;
+  uint64_t sequence_id_ = 0;
+  std::string sequence_id_str_;  // string correlation id (wins if set)
+  bool sequence_start_ = false;
+  bool sequence_end_ = false;
+  uint64_t priority_ = 0;
+  uint64_t server_timeout_us_ = 0;
+  uint64_t client_timeout_us_ = 0;
+  std::map<std::string, std::string> request_parameters_;
+};
+
+// One input tensor: name + datatype + shape + owned raw bytes (or an shm
+// region reference, in which case no bytes travel in the request body).
+class InferInput {
+ public:
+  InferInput(const std::string& name, const std::vector<int64_t>& shape,
+             const std::string& datatype)
+      : name_(name), shape_(shape), datatype_(datatype) {}
+
+  const std::string& Name() const { return name_; }
+  const std::string& Datatype() const { return datatype_; }
+  const std::vector<int64_t>& Shape() const { return shape_; }
+
+  Error SetShape(const std::vector<int64_t>& shape) {
+    shape_ = shape;
+    return Error::Success;
+  }
+
+  // Append a raw chunk (repeatable; chunks concatenate).
+  Error AppendRaw(const uint8_t* data, size_t nbytes) {
+    data_.insert(data_.end(), data, data + nbytes);
+    return Error::Success;
+  }
+  Error AppendRaw(const std::vector<uint8_t>& bytes) {
+    return AppendRaw(bytes.data(), bytes.size());
+  }
+
+  // Append BYTES elements (length-prefixed on the wire).
+  Error AppendFromString(const std::vector<std::string>& strings) {
+    for (const auto& s : strings) {
+      uint32_t len = static_cast<uint32_t>(s.size());
+      const uint8_t* lp = reinterpret_cast<const uint8_t*>(&len);
+      data_.insert(data_.end(), lp, lp + 4);
+      data_.insert(data_.end(), s.begin(), s.end());
+    }
+    return Error::Success;
+  }
+
+  Error SetSharedMemory(const std::string& region_name, size_t byte_size,
+                        size_t offset = 0) {
+    shm_name_ = region_name;
+    shm_byte_size_ = byte_size;
+    shm_offset_ = offset;
+    data_.clear();
+    return Error::Success;
+  }
+
+  // When false, the tensor is emitted as a JSON "data" array instead of a
+  // binary blob (reference SetBinaryData, common.h:323).
+  Error SetBinaryData(bool binary) {
+    binary_data_ = binary;
+    return Error::Success;
+  }
+
+  Error Reset() {
+    data_.clear();
+    shm_name_.clear();
+    return Error::Success;
+  }
+
+  const std::vector<uint8_t>& RawData() const { return data_; }
+  bool BinaryData() const { return binary_data_; }
+  bool UsesSharedMemory() const { return !shm_name_.empty(); }
+  const std::string& SharedMemoryName() const { return shm_name_; }
+  size_t SharedMemoryByteSize() const { return shm_byte_size_; }
+  size_t SharedMemoryOffset() const { return shm_offset_; }
+
+ private:
+  std::string name_;
+  std::vector<int64_t> shape_;
+  std::string datatype_;
+  std::vector<uint8_t> data_;
+  bool binary_data_ = true;
+  std::string shm_name_;
+  size_t shm_byte_size_ = 0;
+  size_t shm_offset_ = 0;
+};
+
+class InferRequestedOutput {
+ public:
+  explicit InferRequestedOutput(const std::string& name,
+                                size_t class_count = 0)
+      : name_(name), class_count_(class_count) {}
+
+  const std::string& Name() const { return name_; }
+  size_t ClassCount() const { return class_count_; }
+
+  Error SetSharedMemory(const std::string& region_name, size_t byte_size,
+                        size_t offset = 0) {
+    shm_name_ = region_name;
+    shm_byte_size_ = byte_size;
+    shm_offset_ = offset;
+    return Error::Success;
+  }
+  Error SetBinaryData(bool binary) {
+    binary_data_ = binary;
+    return Error::Success;
+  }
+
+  bool BinaryData() const { return binary_data_; }
+  bool UsesSharedMemory() const { return !shm_name_.empty(); }
+  const std::string& SharedMemoryName() const { return shm_name_; }
+  size_t SharedMemoryByteSize() const { return shm_byte_size_; }
+  size_t SharedMemoryOffset() const { return shm_offset_; }
+
+ private:
+  std::string name_;
+  size_t class_count_;
+  bool binary_data_ = true;
+  std::string shm_name_;
+  size_t shm_byte_size_ = 0;
+  size_t shm_offset_ = 0;
+};
+
+// Concrete result: header JSON fields + per-output byte buffers.
+class InferResult {
+ public:
+  const std::string& ModelName() const { return model_name_; }
+  const std::string& ModelVersion() const { return model_version_; }
+  const std::string& Id() const { return id_; }
+
+  Error Shape(const std::string& name, std::vector<int64_t>* shape) const;
+  Error Datatype(const std::string& name, std::string* datatype) const;
+  Error RawData(const std::string& name, const uint8_t** buf,
+                size_t* nbytes) const;
+  // Decode a BYTES output into its elements.
+  Error StringData(const std::string& name,
+                   std::vector<std::string>* out) const;
+  bool HasOutput(const std::string& name) const {
+    return outputs_.count(name) > 0;
+  }
+  std::vector<std::string> OutputNames() const;
+
+  struct Output {
+    std::string datatype;
+    std::vector<int64_t> shape;
+    std::vector<uint8_t> data;
+    bool in_shared_memory = false;
+  };
+
+  std::map<std::string, Output> outputs_;
+  std::string model_name_;
+  std::string model_version_;
+  std::string id_;
+};
+
+// Six-point ns timestamps around one request (reference common.h:568-652).
+class RequestTimers {
+ public:
+  enum class Kind {
+    REQUEST_START, SEND_START, SEND_END, RECV_START, RECV_END, REQUEST_END,
+  };
+  void Capture(Kind kind) {
+    auto now = std::chrono::duration_cast<std::chrono::nanoseconds>(
+                   std::chrono::steady_clock::now().time_since_epoch())
+                   .count();
+    ts_[static_cast<int>(kind)] = now;
+  }
+  uint64_t Duration(Kind a, Kind b) const {
+    return ts_[static_cast<int>(b)] - ts_[static_cast<int>(a)];
+  }
+
+ private:
+  uint64_t ts_[6] = {0, 0, 0, 0, 0, 0};
+};
+
+struct InferStat {
+  size_t completed_request_count = 0;
+  uint64_t cumulative_total_request_time_ns = 0;
+  uint64_t cumulative_send_time_ns = 0;
+  uint64_t cumulative_receive_time_ns = 0;
+
+  void Update(const RequestTimers& t) {
+    completed_request_count++;
+    cumulative_total_request_time_ns += t.Duration(
+        RequestTimers::Kind::REQUEST_START, RequestTimers::Kind::REQUEST_END);
+    cumulative_send_time_ns += t.Duration(RequestTimers::Kind::SEND_START,
+                                          RequestTimers::Kind::SEND_END);
+    cumulative_receive_time_ns += t.Duration(RequestTimers::Kind::RECV_START,
+                                             RequestTimers::Kind::RECV_END);
+  }
+};
+
+}  // namespace tputriton

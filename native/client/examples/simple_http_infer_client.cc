@@ -1,0 +1,80 @@
+// Minimal C++ client example: health check + add/sub infer on `simple`.
+//
+// Parity with the reference example src/c++/examples/simple_http_infer_client.cc
+// against this repo's JAX server:
+//   simple_http_infer_client [-u host:port] [-v]
+
+#include <cstdint>
+#include <cstring>
+#include <iostream>
+#include <vector>
+
+#include "../http_client.h"
+
+using tputriton::Error;
+using tputriton::InferInput;
+using tputriton::InferOptions;
+using tputriton::InferRequestedOutput;
+using tputriton::InferResult;
+using tputriton::InferenceServerHttpClient;
+
+#define CHECK(err)                                    \
+  do {                                                \
+    Error e = (err);                                  \
+    if (!e.IsOk()) {                                  \
+      std::cerr << "error: " << e.Message() << "\n";  \
+      return 1;                                       \
+    }                                                 \
+  } while (0)
+
+int main(int argc, char** argv) {
+  std::string url = "localhost:8000";
+  bool verbose = false;
+  for (int i = 1; i < argc; i++) {
+    if (std::string(argv[i]) == "-u" && i + 1 < argc) url = argv[++i];
+    if (std::string(argv[i]) == "-v") verbose = true;
+  }
+
+  std::unique_ptr<InferenceServerHttpClient> client;
+  CHECK(InferenceServerHttpClient::Create(&client, url, verbose));
+
+  bool live = false;
+  CHECK(client->IsServerLive(&live));
+  if (!live) {
+    std::cerr << "server not live\n";
+    return 1;
+  }
+
+  std::vector<int32_t> input0(16), input1(16);
+  for (int i = 0; i < 16; i++) {
+    input0[i] = i;
+    input1[i] = 1;
+  }
+  InferInput in0("INPUT0", {1, 16}, "INT32");
+  InferInput in1("INPUT1", {1, 16}, "INT32");
+  in0.AppendRaw(reinterpret_cast<uint8_t*>(input0.data()), 64);
+  in1.AppendRaw(reinterpret_cast<uint8_t*>(input1.data()), 64);
+  InferRequestedOutput out0("OUTPUT0");
+  InferRequestedOutput out1("OUTPUT1");
+
+  InferOptions options("simple");
+  std::shared_ptr<InferResult> result;
+  CHECK(client->Infer(&result, options, {&in0, &in1}, {&out0, &out1}));
+
+  const uint8_t* buf;
+  size_t nbytes;
+  CHECK(result->RawData("OUTPUT0", &buf, &nbytes));
+  const int32_t* sums = reinterpret_cast<const int32_t*>(buf);
+  CHECK(result->RawData("OUTPUT1", &buf, &nbytes));
+  const int32_t* diffs = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; i++) {
+    std::cout << input0[i] << " + " << input1[i] << " = " << sums[i] << ", "
+              << input0[i] << " - " << input1[i] << " = " << diffs[i] << "\n";
+    if (sums[i] != input0[i] + input1[i] || diffs[i] != input0[i] - input1[i]) {
+      std::cerr << "result mismatch\n";
+      return 1;
+    }
+  }
+  std::cout << "PASS\n";
+  return 0;
+}

@@ -1,0 +1,853 @@
+#include "http_client.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <cstring>
+#include <sstream>
+
+namespace tputriton {
+
+// --------------------------------------------------------------------------
+// connection
+// --------------------------------------------------------------------------
+
+class HttpConnection {
+ public:
+  HttpConnection(const std::string& host, int port)
+      : host_(host), port_(port) {}
+  ~HttpConnection() { Close(); }
+
+  Error Connect() {
+    Close();
+    struct addrinfo hints = {};
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    std::string port_str = std::to_string(port_);
+    int rc = getaddrinfo(host_.c_str(), port_str.c_str(), &hints, &res);
+    if (rc != 0) {
+      return Error("failed to resolve " + host_ + ": " + gai_strerror(rc));
+    }
+    Error err("failed to connect to " + host_ + ":" + port_str);
+    for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+      fd_ = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+      if (fd_ < 0) continue;
+      if (connect(fd_, ai->ai_addr, ai->ai_addrlen) == 0) {
+        int one = 1;
+        setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        err = Error::Success;
+        break;
+      }
+      close(fd_);
+      fd_ = -1;
+    }
+    freeaddrinfo(res);
+    return err;
+  }
+
+  bool Connected() const { return fd_ >= 0; }
+
+  void Close() {
+    if (fd_ >= 0) {
+      close(fd_);
+      fd_ = -1;
+    }
+  }
+
+  Error WriteAll(const void* data, size_t nbytes) {
+    const char* p = static_cast<const char*>(data);
+    while (nbytes > 0) {
+      ssize_t n = send(fd_, p, nbytes, MSG_NOSIGNAL);
+      if (n <= 0) return Error("socket write failed");
+      p += n;
+      nbytes -= static_cast<size_t>(n);
+    }
+    return Error::Success;
+  }
+
+  Error ReadResponse(HttpResponse* response) {
+    // Read headers.
+    std::string head;
+    while (head.find("\r\n\r\n") == std::string::npos) {
+      char buf[4096];
+      ssize_t n = recv(fd_, buf, sizeof(buf), 0);
+      if (n <= 0) return Error("socket read failed");
+      head.append(buf, static_cast<size_t>(n));
+      if (head.size() > (1 << 20)) return Error("oversized response header");
+    }
+    size_t header_end = head.find("\r\n\r\n");
+    std::string body_prefix = head.substr(header_end + 4);
+    head.resize(header_end);
+
+    std::istringstream lines(head);
+    std::string status_line;
+    std::getline(lines, status_line);
+    if (status_line.size() < 12 || status_line.compare(0, 5, "HTTP/") != 0) {
+      return Error("malformed HTTP status line");
+    }
+    response->status = std::atoi(status_line.c_str() + 9);
+    response->headers.clear();
+    std::string line;
+    while (std::getline(lines, line)) {
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      size_t colon = line.find(':');
+      if (colon == std::string::npos) continue;
+      std::string key = line.substr(0, colon);
+      std::transform(key.begin(), key.end(), key.begin(), ::tolower);
+      size_t vstart = line.find_first_not_of(' ', colon + 1);
+      response->headers[key] =
+          vstart == std::string::npos ? "" : line.substr(vstart);
+    }
+
+    size_t content_length = 0;
+    auto it = response->headers.find("content-length");
+    if (it != response->headers.end()) {
+      content_length = static_cast<size_t>(std::stoull(it->second));
+    }
+    response->body.assign(body_prefix.begin(), body_prefix.end());
+    while (response->body.size() < content_length) {
+      char buf[65536];
+      size_t want = std::min(sizeof(buf), content_length - response->body.size());
+      ssize_t n = recv(fd_, buf, want, 0);
+      if (n <= 0) return Error("socket read failed mid-body");
+      response->body.insert(response->body.end(), buf, buf + n);
+    }
+    auto conn_it = response->headers.find("connection");
+    if (conn_it != response->headers.end() && conn_it->second == "close") {
+      Close();
+    }
+    return Error::Success;
+  }
+
+ private:
+  std::string host_;
+  int port_;
+  int fd_ = -1;
+};
+
+// --------------------------------------------------------------------------
+// client
+// --------------------------------------------------------------------------
+
+struct InferenceServerHttpClient::AsyncTask {
+  OnCompleteFn callback;
+  std::string path;  // full infer path incl. model version
+  std::vector<uint8_t> body;
+  size_t json_size = 0;
+};
+
+static std::string InferPath(const InferOptions& options) {
+  std::string path = "v2/models/" + options.model_name_;
+  if (!options.model_version_.empty()) {
+    path += "/versions/" + options.model_version_;
+  }
+  return path + "/infer";
+}
+
+Error InferenceServerHttpClient::Create(
+    std::unique_ptr<InferenceServerHttpClient>* client, const std::string& url,
+    bool verbose) {
+  if (url.find("://") != std::string::npos) {
+    return Error("url should not include the scheme (got '" + url + "')");
+  }
+  client->reset(new InferenceServerHttpClient(url, verbose));
+  return Error::Success;
+}
+
+InferenceServerHttpClient::InferenceServerHttpClient(const std::string& url,
+                                                     bool verbose)
+    : verbose_(verbose) {
+  size_t colon = url.rfind(':');
+  host_ = colon == std::string::npos ? url : url.substr(0, colon);
+  port_ = colon == std::string::npos ? 80 : std::atoi(url.c_str() + colon + 1);
+  conn_.reset(new HttpConnection(host_, port_));
+  worker_ = std::thread(&InferenceServerHttpClient::AsyncWorker, this);
+}
+
+InferenceServerHttpClient::~InferenceServerHttpClient() {
+  exiting_ = true;
+  queue_cv_.notify_all();
+  if (worker_.joinable()) worker_.join();
+}
+
+Error InferenceServerHttpClient::Request(
+    const std::string& method, const std::string& path,
+    const std::vector<uint8_t>& body,
+    const std::map<std::string, std::string>& extra_headers,
+    HttpResponse* response) {
+  std::lock_guard<std::mutex> lk(conn_mu_);
+  for (int attempt = 0; attempt < 2; attempt++) {
+    bool fresh = false;
+    if (!conn_->Connected()) {
+      Error err = conn_->Connect();
+      if (!err.IsOk()) return err;
+      fresh = true;
+    }
+    std::ostringstream req;
+    req << method << " /" << path << " HTTP/1.1\r\n"
+        << "Host: " << host_ << ":" << port_ << "\r\n"
+        << "Connection: keep-alive\r\n"
+        << "Content-Length: " << body.size() << "\r\n";
+    for (const auto& kv : extra_headers) {
+      req << kv.first << ": " << kv.second << "\r\n";
+    }
+    req << "\r\n";
+    std::string header = req.str();
+    if (verbose_) fprintf(stderr, "%s /%s\n", method.c_str(), path.c_str());
+
+    Error err = conn_->WriteAll(header.data(), header.size());
+    if (err.IsOk() && !body.empty()) {
+      err = conn_->WriteAll(body.data(), body.size());
+    }
+    if (err.IsOk()) err = conn_->ReadResponse(response);
+    if (err.IsOk()) return Error::Success;
+    conn_->Close();
+    // Retry once, only when the failure hit a reused keep-alive socket
+    // (likely closed while idle); a fresh-connection failure is real.
+    if (fresh || attempt == 1) return err;
+  }
+  return Error("unreachable");
+}
+
+Error InferenceServerHttpClient::Get(const std::string& path,
+                                     HttpResponse* response) {
+  return Request("GET", path, {}, {}, response);
+}
+
+Error InferenceServerHttpClient::Post(const std::string& path,
+                                      const std::string& body,
+                                      HttpResponse* response) {
+  std::vector<uint8_t> b(body.begin(), body.end());
+  return Request("POST", path, b,
+                 {{"Content-Type", "application/json"}}, response);
+}
+
+static Error CheckStatus(const HttpResponse& response) {
+  if (response.status >= 200 && response.status < 300) return Error::Success;
+  std::string body(response.body.begin(), response.body.end());
+  std::string err;
+  auto parsed = json::Parse(body, &err);
+  if (parsed && parsed->Get("error")) {
+    return Error(parsed->Get("error")->AsString());
+  }
+  return Error("HTTP " + std::to_string(response.status) + ": " + body);
+}
+
+Error InferenceServerHttpClient::JsonGet(const std::string& path,
+                                         json::ValuePtr* out) {
+  HttpResponse response;
+  Error err = Get(path, &response);
+  if (!err.IsOk()) return err;
+  err = CheckStatus(response);
+  if (!err.IsOk()) return err;
+  std::string body(response.body.begin(), response.body.end());
+  std::string perr;
+  *out = json::Parse(body.empty() ? "{}" : body, &perr);
+  if (*out == nullptr) return Error("invalid JSON response: " + perr);
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::JsonPost(const std::string& path,
+                                          const std::string& body,
+                                          json::ValuePtr* out) {
+  HttpResponse response;
+  Error err = Post(path, body, &response);
+  if (!err.IsOk()) return err;
+  err = CheckStatus(response);
+  if (!err.IsOk()) return err;
+  std::string rbody(response.body.begin(), response.body.end());
+  std::string perr;
+  *out = json::Parse(rbody.empty() ? "{}" : rbody, &perr);
+  if (*out == nullptr) return Error("invalid JSON response: " + perr);
+  return Error::Success;
+}
+
+// -- health / metadata ------------------------------------------------------
+
+Error InferenceServerHttpClient::IsServerLive(bool* live) {
+  HttpResponse response;
+  Error err = Get("v2/health/live", &response);
+  *live = err.IsOk() && response.status == 200;
+  return err;
+}
+
+Error InferenceServerHttpClient::IsServerReady(bool* ready) {
+  HttpResponse response;
+  Error err = Get("v2/health/ready", &response);
+  *ready = err.IsOk() && response.status == 200;
+  return err;
+}
+
+Error InferenceServerHttpClient::IsModelReady(const std::string& model_name,
+                                              bool* ready,
+                                              const std::string& model_version) {
+  std::string path = "v2/models/" + model_name;
+  if (!model_version.empty()) path += "/versions/" + model_version;
+  path += "/ready";
+  HttpResponse response;
+  Error err = Get(path, &response);
+  *ready = err.IsOk() && response.status == 200;
+  return err;
+}
+
+Error InferenceServerHttpClient::ServerMetadata(json::ValuePtr* metadata) {
+  return JsonGet("v2", metadata);
+}
+
+Error InferenceServerHttpClient::ModelMetadata(json::ValuePtr* metadata,
+                                               const std::string& model_name,
+                                               const std::string& model_version) {
+  std::string path = "v2/models/" + model_name;
+  if (!model_version.empty()) path += "/versions/" + model_version;
+  return JsonGet(path, metadata);
+}
+
+Error InferenceServerHttpClient::ModelConfig(json::ValuePtr* config,
+                                             const std::string& model_name,
+                                             const std::string& model_version) {
+  std::string path = "v2/models/" + model_name;
+  if (!model_version.empty()) path += "/versions/" + model_version;
+  return JsonGet(path + "/config", config);
+}
+
+Error InferenceServerHttpClient::ModelRepositoryIndex(json::ValuePtr* index) {
+  return JsonPost("v2/repository/index", "{}", index);
+}
+
+Error InferenceServerHttpClient::LoadModel(const std::string& model_name,
+                                           const std::string& config_json) {
+  std::string body = "{}";
+  if (!config_json.empty()) {
+    auto root = json::Value::MakeObject();
+    auto params = json::Value::MakeObject();
+    params->Set("config", config_json);
+    root->Set("parameters", params);
+    body = root->Serialize();
+  }
+  json::ValuePtr out;
+  return JsonPost("v2/repository/models/" + model_name + "/load", body, &out);
+}
+
+Error InferenceServerHttpClient::UnloadModel(const std::string& model_name) {
+  json::ValuePtr out;
+  return JsonPost("v2/repository/models/" + model_name + "/unload", "{}", &out);
+}
+
+Error InferenceServerHttpClient::ModelInferenceStatistics(
+    json::ValuePtr* stats, const std::string& model_name) {
+  std::string path = model_name.empty() ? "v2/models/stats"
+                                        : "v2/models/" + model_name + "/stats";
+  return JsonGet(path, stats);
+}
+
+// -- shared memory admin ----------------------------------------------------
+
+Error InferenceServerHttpClient::RegisterSystemSharedMemory(
+    const std::string& name, const std::string& key, size_t byte_size,
+    size_t offset) {
+  auto body = json::Value::MakeObject();
+  body->Set("key", key);
+  body->Set("offset", static_cast<int64_t>(offset));
+  body->Set("byte_size", static_cast<int64_t>(byte_size));
+  json::ValuePtr out;
+  return JsonPost("v2/systemsharedmemory/region/" + name + "/register",
+                  body->Serialize(), &out);
+}
+
+Error InferenceServerHttpClient::UnregisterSystemSharedMemory(
+    const std::string& name) {
+  json::ValuePtr out;
+  std::string path = name.empty()
+                         ? "v2/systemsharedmemory/unregister"
+                         : "v2/systemsharedmemory/region/" + name + "/unregister";
+  return JsonPost(path, "{}", &out);
+}
+
+Error InferenceServerHttpClient::SystemSharedMemoryStatus(
+    json::ValuePtr* status) {
+  return JsonGet("v2/systemsharedmemory/status", status);
+}
+
+Error InferenceServerHttpClient::RegisterTpuSharedMemory(
+    const std::string& name, const std::string& raw_handle_b64,
+    int64_t device_id, size_t byte_size) {
+  auto body = json::Value::MakeObject();
+  auto handle = json::Value::MakeObject();
+  handle->Set("b64", raw_handle_b64);
+  body->Set("raw_handle", handle);
+  body->Set("device_id", device_id);
+  body->Set("byte_size", static_cast<int64_t>(byte_size));
+  json::ValuePtr out;
+  return JsonPost("v2/tpusharedmemory/region/" + name + "/register",
+                  body->Serialize(), &out);
+}
+
+Error InferenceServerHttpClient::UnregisterTpuSharedMemory(
+    const std::string& name) {
+  json::ValuePtr out;
+  std::string path = name.empty()
+                         ? "v2/tpusharedmemory/unregister"
+                         : "v2/tpusharedmemory/region/" + name + "/unregister";
+  return JsonPost(path, "{}", &out);
+}
+
+Error InferenceServerHttpClient::TpuSharedMemoryStatus(json::ValuePtr* status) {
+  return JsonGet("v2/tpusharedmemory/status", status);
+}
+
+// -- trace / log ------------------------------------------------------------
+
+Error InferenceServerHttpClient::GetTraceSettings(json::ValuePtr* settings,
+                                                  const std::string& model_name) {
+  std::string path = model_name.empty()
+                         ? "v2/trace/setting"
+                         : "v2/models/" + model_name + "/trace/setting";
+  return JsonGet(path, settings);
+}
+
+Error InferenceServerHttpClient::UpdateTraceSettings(
+    json::ValuePtr* response, const std::string& model_name,
+    const std::string& settings_json) {
+  std::string path = model_name.empty()
+                         ? "v2/trace/setting"
+                         : "v2/models/" + model_name + "/trace/setting";
+  return JsonPost(path, settings_json.empty() ? "{}" : settings_json, response);
+}
+
+Error InferenceServerHttpClient::GetLogSettings(json::ValuePtr* settings) {
+  return JsonGet("v2/logging", settings);
+}
+
+Error InferenceServerHttpClient::UpdateLogSettings(
+    json::ValuePtr* response, const std::string& settings_json) {
+  return JsonPost("v2/logging", settings_json.empty() ? "{}" : settings_json,
+                  response);
+}
+
+// -- infer ------------------------------------------------------------------
+
+static Error BytesToJsonData(const std::vector<uint8_t>& raw,
+                             const std::string& datatype,
+                             json::ValuePtr data);
+
+Error InferenceServerHttpClient::BuildInferRequest(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    std::vector<uint8_t>* body, size_t* json_size) {
+  auto root = json::Value::MakeObject();
+  if (!options.request_id_.empty()) root->Set("id", options.request_id_);
+
+  auto params = json::Value::MakeObject();
+  if (!options.sequence_id_str_.empty()) {
+    params->Set("sequence_id", options.sequence_id_str_);
+  } else if (options.sequence_id_ != 0) {
+    params->Set("sequence_id", static_cast<int64_t>(options.sequence_id_));
+  }
+  if (options.sequence_id_ != 0 || !options.sequence_id_str_.empty()) {
+    params->Set("sequence_start", options.sequence_start_);
+    params->Set("sequence_end", options.sequence_end_);
+  }
+  if (options.priority_ != 0) {
+    params->Set("priority", static_cast<int64_t>(options.priority_));
+  }
+  if (options.server_timeout_us_ != 0) {
+    params->Set("timeout", static_cast<int64_t>(options.server_timeout_us_));
+  }
+  for (const auto& kv : options.request_parameters_) {
+    params->Set(kv.first, kv.second);
+  }
+  if (!params->object().empty()) root->Set("parameters", params);
+
+  std::vector<const std::vector<uint8_t>*> blobs;
+  auto inputs_json = json::Value::MakeArray();
+  for (InferInput* input : inputs) {
+    auto tensor = json::Value::MakeObject();
+    tensor->Set("name", input->Name());
+    tensor->Set("datatype", input->Datatype());
+    auto shape = json::Value::MakeArray();
+    for (int64_t d : input->Shape()) shape->Append(d);
+    tensor->Set("shape", shape);
+    auto tparams = json::Value::MakeObject();
+    if (input->UsesSharedMemory()) {
+      tparams->Set("shared_memory_region", input->SharedMemoryName());
+      tparams->Set("shared_memory_byte_size",
+                   static_cast<int64_t>(input->SharedMemoryByteSize()));
+      if (input->SharedMemoryOffset() != 0) {
+        tparams->Set("shared_memory_offset",
+                     static_cast<int64_t>(input->SharedMemoryOffset()));
+      }
+    } else if (!input->BinaryData()) {
+      // SetBinaryData(false): emit the tensor as a JSON "data" array
+      // (reference ConvertBinaryInputToJSON path, http_client.cc:607).
+      auto data = json::Value::MakeArray();
+      Error err = BytesToJsonData(input->RawData(), input->Datatype(), data);
+      if (!err.IsOk()) return err;
+      tensor->Set("data", data);
+    } else {
+      tparams->Set("binary_data_size",
+                   static_cast<int64_t>(input->RawData().size()));
+      blobs.push_back(&input->RawData());
+    }
+    if (!tparams->object().empty()) tensor->Set("parameters", tparams);
+    inputs_json->Append(tensor);
+  }
+  root->Set("inputs", inputs_json);
+
+  if (!outputs.empty()) {
+    auto outputs_json = json::Value::MakeArray();
+    for (const InferRequestedOutput* output : outputs) {
+      auto tensor = json::Value::MakeObject();
+      tensor->Set("name", output->Name());
+      auto tparams = json::Value::MakeObject();
+      if (output->UsesSharedMemory()) {
+        tparams->Set("shared_memory_region", output->SharedMemoryName());
+        tparams->Set("shared_memory_byte_size",
+                     static_cast<int64_t>(output->SharedMemoryByteSize()));
+        if (output->SharedMemoryOffset() != 0) {
+          tparams->Set("shared_memory_offset",
+                       static_cast<int64_t>(output->SharedMemoryOffset()));
+        }
+      } else {
+        if (output->BinaryData()) tparams->Set("binary_data", true);
+        if (output->ClassCount() > 0) {
+          tparams->Set("classification",
+                       static_cast<int64_t>(output->ClassCount()));
+        }
+      }
+      if (!tparams->object().empty()) tensor->Set("parameters", tparams);
+      outputs_json->Append(tensor);
+    }
+    root->Set("outputs", outputs_json);
+  }
+
+  std::string header = root->Serialize();
+  *json_size = header.size();
+  body->assign(header.begin(), header.end());
+  for (const auto* blob : blobs) {
+    body->insert(body->end(), blob->begin(), blob->end());
+  }
+  return Error::Success;
+}
+
+static size_t DtypeSize(const std::string& datatype) {
+  if (datatype == "BOOL" || datatype == "INT8" || datatype == "UINT8") return 1;
+  if (datatype == "INT16" || datatype == "UINT16" || datatype == "FP16" ||
+      datatype == "BF16") {
+    return 2;
+  }
+  if (datatype == "INT32" || datatype == "UINT32" || datatype == "FP32") return 4;
+  if (datatype == "INT64" || datatype == "UINT64" || datatype == "FP64") return 8;
+  return 0;
+}
+
+// float -> IEEE half bits (round-to-nearest-even via the float32 route).
+static uint16_t FloatToHalf(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint32_t sign = (bits >> 16) & 0x8000;
+  int32_t exp = static_cast<int32_t>((bits >> 23) & 0xFF) - 127 + 15;
+  uint32_t mant = bits & 0x7FFFFF;
+  if (exp >= 31) return static_cast<uint16_t>(sign | 0x7C00);  // inf/overflow
+  if (exp <= 0) return static_cast<uint16_t>(sign);            // flush to zero
+  uint16_t half_mant = static_cast<uint16_t>(mant >> 13);
+  if (mant & 0x1000) half_mant++;  // round
+  return static_cast<uint16_t>(sign | (exp << 10) | half_mant);
+}
+
+static uint16_t FloatToBf16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  // round-to-nearest-even on the dropped 16 bits
+  uint32_t rounded = bits + 0x7FFF + ((bits >> 16) & 1);
+  return static_cast<uint16_t>(rounded >> 16);
+}
+
+// Encode a JSON "data" array back into raw little-endian bytes.
+static Error JsonDataToBytes(const json::Value& data,
+                             const std::string& datatype,
+                             std::vector<uint8_t>* out) {
+  auto append = [out](const void* p, size_t n) {
+    const uint8_t* b = static_cast<const uint8_t*>(p);
+    out->insert(out->end(), b, b + n);
+  };
+  for (const auto& e : data.array()) {
+    if (e->type() == json::Type::kArray) {
+      Error err = JsonDataToBytes(*e, datatype, out);
+      if (!err.IsOk()) return err;
+      continue;
+    }
+    if (datatype == "BYTES") {
+      const std::string& s = e->AsString();
+      uint32_t len = static_cast<uint32_t>(s.size());
+      append(&len, 4);
+      append(s.data(), s.size());
+    } else if (datatype == "FP32") {
+      float v = static_cast<float>(e->AsDouble());
+      append(&v, 4);
+    } else if (datatype == "FP64") {
+      double v = e->AsDouble();
+      append(&v, 8);
+    } else if (datatype == "FP16") {
+      uint16_t v = FloatToHalf(static_cast<float>(e->AsDouble()));
+      append(&v, 2);
+    } else if (datatype == "BF16") {
+      uint16_t v = FloatToBf16(static_cast<float>(e->AsDouble()));
+      append(&v, 2);
+    } else if (datatype == "BOOL") {
+      uint8_t v = e->AsBool() ? 1 : 0;
+      append(&v, 1);
+    } else {
+      int64_t v = e->AsInt();
+      size_t size = DtypeSize(datatype);
+      if (size == 0) return Error("unsupported JSON datatype " + datatype);
+      append(&v, size);  // little-endian truncation
+    }
+  }
+  return Error::Success;
+}
+
+static float HalfToFloat(uint16_t h) {
+  uint32_t sign = (h & 0x8000) << 16;
+  uint32_t exp = (h >> 10) & 0x1F;
+  uint32_t mant = h & 0x3FF;
+  uint32_t bits;
+  if (exp == 0) {
+    bits = sign;  // zero/denormal -> zero
+  } else if (exp == 31) {
+    bits = sign | 0x7F800000 | (mant << 13);
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+// Decode raw little-endian bytes into a JSON "data" array (flat, row-major
+// — the KServe JSON representation the server accepts).
+static Error BytesToJsonData(const std::vector<uint8_t>& raw,
+                             const std::string& datatype,
+                             json::ValuePtr data) {
+  size_t size = DtypeSize(datatype);
+  if (datatype == "BYTES") {
+    size_t pos = 0;
+    while (pos + 4 <= raw.size()) {
+      uint32_t len;
+      std::memcpy(&len, raw.data() + pos, 4);
+      pos += 4;
+      if (pos + len > raw.size()) return Error("malformed BYTES tensor");
+      data->Append(std::string(reinterpret_cast<const char*>(raw.data() + pos),
+                               len));
+      pos += len;
+    }
+    return Error::Success;
+  }
+  if (size == 0 || raw.size() % size != 0) {
+    return Error("cannot encode datatype " + datatype + " as JSON data");
+  }
+  for (size_t pos = 0; pos < raw.size(); pos += size) {
+    const uint8_t* p = raw.data() + pos;
+    if (datatype == "FP32") {
+      float v;
+      std::memcpy(&v, p, 4);
+      data->Append(std::make_shared<json::Value>(static_cast<double>(v)));
+    } else if (datatype == "FP64") {
+      double v;
+      std::memcpy(&v, p, 8);
+      data->Append(std::make_shared<json::Value>(v));
+    } else if (datatype == "FP16" || datatype == "BF16") {
+      uint16_t v;
+      std::memcpy(&v, p, 2);
+      float f = datatype == "FP16"
+                    ? HalfToFloat(v)
+                    : [v] {
+                        uint32_t bits = static_cast<uint32_t>(v) << 16;
+                        float out;
+                        std::memcpy(&out, &bits, 4);
+                        return out;
+                      }();
+      data->Append(std::make_shared<json::Value>(static_cast<double>(f)));
+    } else if (datatype == "BOOL") {
+      data->Append(std::make_shared<json::Value>(*p != 0));
+    } else {
+      // integer family: sign-extend signed types, zero-extend unsigned
+      int64_t v = 0;
+      bool is_signed = datatype[0] == 'I';
+      std::memcpy(&v, p, size);
+      if (is_signed && size < 8) {
+        int shift = static_cast<int>(8 * (8 - size));
+        v = (v << shift) >> shift;
+      }
+      data->Append(std::make_shared<json::Value>(v));
+    }
+  }
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::ParseInferResponse(
+    const HttpResponse& response, std::shared_ptr<InferResult>* result) {
+  size_t json_size = response.body.size();
+  auto it = response.headers.find("inference-header-content-length");
+  if (it != response.headers.end()) {
+    char* end = nullptr;
+    unsigned long long parsed = strtoull(it->second.c_str(), &end, 10);
+    if (end == it->second.c_str() || *end != '\0' ||
+        parsed > response.body.size()) {
+      return Error("invalid Inference-Header-Content-Length '" + it->second +
+                   "' for body of " + std::to_string(response.body.size()) +
+                   " bytes");
+    }
+    json_size = static_cast<size_t>(parsed);
+  }
+  std::string header(response.body.begin(), response.body.begin() + json_size);
+  std::string perr;
+  auto root = json::Parse(header, &perr);
+  if (root == nullptr) return Error("invalid inference response: " + perr);
+
+  auto res = std::make_shared<InferResult>();
+  if (root->Get("model_name")) res->model_name_ = root->Get("model_name")->AsString();
+  if (root->Get("model_version")) {
+    res->model_version_ = root->Get("model_version")->AsString();
+  }
+  if (root->Get("id")) res->id_ = root->Get("id")->AsString();
+
+  size_t binary_offset = json_size;
+  auto outputs = root->Get("outputs");
+  if (outputs) {
+    for (const auto& out_json : outputs->array()) {
+      InferResult::Output output;
+      std::string name = out_json->Get("name")->AsString();
+      if (out_json->Get("datatype")) {
+        output.datatype = out_json->Get("datatype")->AsString();
+      }
+      if (out_json->Get("shape")) {
+        for (const auto& d : out_json->Get("shape")->array()) {
+          output.shape.push_back(d->AsInt());
+        }
+      }
+      auto params = out_json->Get("parameters");
+      json::ValuePtr bin_size =
+          params ? params->Get("binary_data_size") : nullptr;
+      if (params && params->Get("shared_memory_region")) {
+        output.in_shared_memory = true;
+      } else if (bin_size) {
+        size_t nbytes = static_cast<size_t>(bin_size->AsInt());
+        if (binary_offset + nbytes > response.body.size()) {
+          return Error("binary_data_size overruns response body");
+        }
+        output.data.assign(response.body.begin() + binary_offset,
+                           response.body.begin() + binary_offset + nbytes);
+        binary_offset += nbytes;
+      } else if (out_json->Get("data")) {
+        Error err = JsonDataToBytes(*out_json->Get("data"), output.datatype,
+                                    &output.data);
+        if (!err.IsOk()) return err;
+      }
+      res->outputs_[name] = std::move(output);
+    }
+  }
+  *result = std::move(res);
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::Infer(
+    std::shared_ptr<InferResult>* result, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs) {
+  RequestTimers timers;
+  timers.Capture(RequestTimers::Kind::REQUEST_START);
+  timers.Capture(RequestTimers::Kind::SEND_START);
+  std::vector<uint8_t> body;
+  size_t json_size;
+  Error err = BuildInferRequest(options, inputs, outputs, &body, &json_size);
+  if (!err.IsOk()) return err;
+  timers.Capture(RequestTimers::Kind::SEND_END);
+
+  std::map<std::string, std::string> headers = {
+      {"Content-Type", "application/octet-stream"},
+      {"Inference-Header-Content-Length", std::to_string(json_size)},
+  };
+  HttpResponse response;
+  err = Request("POST", InferPath(options), body, headers, &response);
+  if (!err.IsOk()) return err;
+  err = CheckStatus(response);
+  if (!err.IsOk()) return err;
+
+  timers.Capture(RequestTimers::Kind::RECV_START);
+  err = ParseInferResponse(response, result);
+  timers.Capture(RequestTimers::Kind::RECV_END);
+  if (!err.IsOk()) return err;
+  timers.Capture(RequestTimers::Kind::REQUEST_END);
+  {
+    std::lock_guard<std::mutex> lk(stat_mu_);
+    infer_stat_.Update(timers);
+  }
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::AsyncInfer(
+    OnCompleteFn callback, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs) {
+  auto task = std::make_unique<AsyncTask>();
+  task->callback = std::move(callback);
+  task->path = InferPath(options);
+  Error err = BuildInferRequest(options, inputs, outputs, &task->body,
+                                &task->json_size);
+  if (!err.IsOk()) return err;
+  {
+    std::lock_guard<std::mutex> lk(queue_mu_);
+    queue_.push_back(std::move(task));
+  }
+  queue_cv_.notify_one();
+  return Error::Success;
+}
+
+void InferenceServerHttpClient::AsyncWorker() {
+  while (true) {
+    std::unique_ptr<AsyncTask> task;
+    {
+      std::unique_lock<std::mutex> lk(queue_mu_);
+      queue_cv_.wait(lk, [this] { return exiting_ || !queue_.empty(); });
+      if (exiting_ && queue_.empty()) return;
+      task = std::move(queue_.front());
+      queue_.pop_front();
+    }
+    std::map<std::string, std::string> headers = {
+        {"Content-Type", "application/octet-stream"},
+        {"Inference-Header-Content-Length", std::to_string(task->json_size)},
+    };
+    HttpResponse response;
+    RequestTimers timers;
+    timers.Capture(RequestTimers::Kind::REQUEST_START);
+    Error err = Request("POST", task->path, task->body, headers, &response);
+    if (err.IsOk()) err = CheckStatus(response);
+    std::shared_ptr<InferResult> result;
+    if (err.IsOk()) {
+      timers.Capture(RequestTimers::Kind::RECV_START);
+      err = ParseInferResponse(response, &result);
+      timers.Capture(RequestTimers::Kind::RECV_END);
+    }
+    timers.Capture(RequestTimers::Kind::REQUEST_END);
+    if (err.IsOk()) {
+      std::lock_guard<std::mutex> lk(stat_mu_);
+      infer_stat_.Update(timers);
+    }
+    task->callback(std::move(result), err);
+  }
+}
+
+Error InferenceServerHttpClient::ClientInferStat(InferStat* stat) const {
+  std::lock_guard<std::mutex> lk(stat_mu_);
+  *stat = infer_stat_;
+  return Error::Success;
+}
+
+}  // namespace tputriton

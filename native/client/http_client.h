@@ -1,0 +1,132 @@
+// KServe v2 HTTP/REST client over POSIX sockets.
+//
+// Capability parity with the reference's libcurl client
+// (src/c++/library/http_client.h:105 InferenceServerHttpClient: health/
+// metadata/config/repository/statistics/shm-admin/trace/log surface,
+// Infer + AsyncInfer, binary tensor framing with
+// Inference-Header-Content-Length — http_client.cc:2099-2235), built on a
+// persistent HTTP/1.1 connection with keep-alive and one retry on stale
+// sockets. No TLS in this build (the image lacks an SSL dev stack); the
+// API accepts http URLs only.
+
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <thread>
+
+#include "common.h"
+#include "json.h"
+
+namespace tputriton {
+
+class HttpConnection;
+
+struct HttpResponse {
+  int status = 0;
+  std::map<std::string, std::string> headers;  // lower-cased keys
+  std::vector<uint8_t> body;
+};
+
+class InferenceServerHttpClient {
+ public:
+  using OnCompleteFn = std::function<void(std::shared_ptr<InferResult>, Error)>;
+
+  // url: "host:port" (no scheme).
+  static Error Create(std::unique_ptr<InferenceServerHttpClient>* client,
+                      const std::string& url, bool verbose = false);
+  ~InferenceServerHttpClient();
+
+  Error IsServerLive(bool* live);
+  Error IsServerReady(bool* ready);
+  Error IsModelReady(const std::string& model_name, bool* ready,
+                     const std::string& model_version = "");
+  Error ServerMetadata(json::ValuePtr* metadata);
+  Error ModelMetadata(json::ValuePtr* metadata, const std::string& model_name,
+                      const std::string& model_version = "");
+  Error ModelConfig(json::ValuePtr* config, const std::string& model_name,
+                    const std::string& model_version = "");
+  Error ModelRepositoryIndex(json::ValuePtr* index);
+  Error LoadModel(const std::string& model_name,
+                  const std::string& config_json = "");
+  Error UnloadModel(const std::string& model_name);
+  Error ModelInferenceStatistics(json::ValuePtr* stats,
+                                 const std::string& model_name = "");
+
+  Error RegisterSystemSharedMemory(const std::string& name,
+                                   const std::string& key, size_t byte_size,
+                                   size_t offset = 0);
+  Error UnregisterSystemSharedMemory(const std::string& name = "");
+  Error SystemSharedMemoryStatus(json::ValuePtr* status);
+  Error RegisterTpuSharedMemory(const std::string& name,
+                                const std::string& raw_handle_b64,
+                                int64_t device_id, size_t byte_size);
+  Error UnregisterTpuSharedMemory(const std::string& name = "");
+  Error TpuSharedMemoryStatus(json::ValuePtr* status);
+
+  Error GetTraceSettings(json::ValuePtr* settings,
+                         const std::string& model_name = "");
+  Error UpdateTraceSettings(json::ValuePtr* response,
+                            const std::string& model_name,
+                            const std::string& settings_json);
+  Error GetLogSettings(json::ValuePtr* settings);
+  Error UpdateLogSettings(json::ValuePtr* response,
+                          const std::string& settings_json);
+
+  Error Infer(std::shared_ptr<InferResult>* result, const InferOptions& options,
+              const std::vector<InferInput*>& inputs,
+              const std::vector<const InferRequestedOutput*>& outputs = {});
+
+  // Queued on a single worker thread (callback runs there).
+  Error AsyncInfer(OnCompleteFn callback, const InferOptions& options,
+                   const std::vector<InferInput*>& inputs,
+                   const std::vector<const InferRequestedOutput*>& outputs = {});
+
+  Error ClientInferStat(InferStat* stat) const;
+
+  // Low-level escape hatch (reference Get/Post passthrough, http_client.h:618).
+  Error Get(const std::string& path, HttpResponse* response);
+  Error Post(const std::string& path, const std::string& body,
+             HttpResponse* response);
+
+ private:
+  InferenceServerHttpClient(const std::string& url, bool verbose);
+
+  Error BuildInferRequest(const InferOptions& options,
+                          const std::vector<InferInput*>& inputs,
+                          const std::vector<const InferRequestedOutput*>& outputs,
+                          std::vector<uint8_t>* body, size_t* json_size);
+  Error ParseInferResponse(const HttpResponse& response,
+                           std::shared_ptr<InferResult>* result);
+  Error Request(const std::string& method, const std::string& path,
+                const std::vector<uint8_t>& body,
+                const std::map<std::string, std::string>& extra_headers,
+                HttpResponse* response);
+  Error JsonGet(const std::string& path, json::ValuePtr* out);
+  Error JsonPost(const std::string& path, const std::string& body,
+                 json::ValuePtr* out);
+
+  std::string host_;
+  int port_;
+  bool verbose_;
+  std::unique_ptr<HttpConnection> conn_;
+  std::mutex conn_mu_;
+
+  InferStat infer_stat_;
+  mutable std::mutex stat_mu_;
+
+  // async worker
+  struct AsyncTask;
+  void AsyncWorker();
+  std::thread worker_;
+  std::deque<std::unique_ptr<AsyncTask>> queue_;
+  std::mutex queue_mu_;
+  std::condition_variable queue_cv_;
+  std::atomic<bool> exiting_{false};
+};
+
+}  // namespace tputriton

@@ -1,0 +1,289 @@
+#include "json.h"
+
+#include <cctype>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <sstream>
+
+namespace tputriton {
+namespace json {
+
+namespace {
+
+void EscapeTo(const std::string& s, std::string* out) {
+  out->push_back('"');
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\b': *out += "\\b"; break;
+      case '\f': *out += "\\f"; break;
+      case '\n': *out += "\\n"; break;
+      case '\r': *out += "\\r"; break;
+      case '\t': *out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          *out += buf;
+        } else {
+          out->push_back(static_cast<char>(c));
+        }
+    }
+  }
+  out->push_back('"');
+}
+
+void SerializeTo(const Value& v, std::string* out) {
+  switch (v.type()) {
+    case Type::kNull:
+      *out += "null";
+      break;
+    case Type::kBool:
+      *out += v.AsBool() ? "true" : "false";
+      break;
+    case Type::kNumber: {
+      double d = v.AsDouble();
+      if (d == std::floor(d) && std::abs(d) < 1e15) {
+        *out += std::to_string(v.AsInt());
+      } else {
+        char buf[32];
+        snprintf(buf, sizeof(buf), "%.17g", d);
+        *out += buf;
+      }
+      break;
+    }
+    case Type::kString:
+      EscapeTo(v.AsString(), out);
+      break;
+    case Type::kArray: {
+      out->push_back('[');
+      bool first = true;
+      for (const auto& e : v.array()) {
+        if (!first) out->push_back(',');
+        first = false;
+        SerializeTo(*e, out);
+      }
+      out->push_back(']');
+      break;
+    }
+    case Type::kObject: {
+      out->push_back('{');
+      bool first = true;
+      for (const auto& kv : v.object()) {
+        if (!first) out->push_back(',');
+        first = false;
+        EscapeTo(kv.first, out);
+        out->push_back(':');
+        SerializeTo(*kv.second, out);
+      }
+      out->push_back('}');
+      break;
+    }
+  }
+}
+
+}  // namespace
+
+std::string Value::Serialize() const {
+  std::string out;
+  SerializeTo(*this, &out);
+  return out;
+}
+
+class Parser {
+ public:
+  Parser(const std::string& text) : s_(text), pos_(0) {}
+
+  ValuePtr Parse(std::string* err) {
+    ValuePtr v = ParseValue(err);
+    if (v == nullptr) return nullptr;
+    SkipWs();
+    if (pos_ != s_.size()) {
+      *err = "trailing characters at offset " + std::to_string(pos_);
+      return nullptr;
+    }
+    return v;
+  }
+
+ private:
+  void SkipWs() {
+    while (pos_ < s_.size() && std::isspace(static_cast<unsigned char>(s_[pos_]))) {
+      pos_++;
+    }
+  }
+
+  bool Consume(char c) {
+    SkipWs();
+    if (pos_ < s_.size() && s_[pos_] == c) {
+      pos_++;
+      return true;
+    }
+    return false;
+  }
+
+  ValuePtr Fail(std::string* err, const std::string& msg) {
+    *err = msg + " at offset " + std::to_string(pos_);
+    return nullptr;
+  }
+
+  ValuePtr ParseValue(std::string* err) {
+    SkipWs();
+    if (pos_ >= s_.size()) return Fail(err, "unexpected end of input");
+    char c = s_[pos_];
+    switch (c) {
+      case '{': return ParseObject(err);
+      case '[': return ParseArray(err);
+      case '"': return ParseString(err);
+      case 't':
+        if (s_.compare(pos_, 4, "true") == 0) {
+          pos_ += 4;
+          return std::make_shared<Value>(true);
+        }
+        return Fail(err, "invalid literal");
+      case 'f':
+        if (s_.compare(pos_, 5, "false") == 0) {
+          pos_ += 5;
+          return std::make_shared<Value>(false);
+        }
+        return Fail(err, "invalid literal");
+      case 'n':
+        if (s_.compare(pos_, 4, "null") == 0) {
+          pos_ += 4;
+          return std::make_shared<Value>();
+        }
+        return Fail(err, "invalid literal");
+      default:
+        return ParseNumber(err);
+    }
+  }
+
+  ValuePtr ParseObject(std::string* err) {
+    pos_++;  // '{'
+    auto obj = Value::MakeObject();
+    SkipWs();
+    if (Consume('}')) return obj;
+    while (true) {
+      SkipWs();
+      if (pos_ >= s_.size() || s_[pos_] != '"') {
+        return Fail(err, "expected object key");
+      }
+      ValuePtr key = ParseString(err);
+      if (key == nullptr) return nullptr;
+      if (!Consume(':')) return Fail(err, "expected ':'");
+      ValuePtr val = ParseValue(err);
+      if (val == nullptr) return nullptr;
+      obj->Set(key->AsString(), val);
+      if (Consume(',')) continue;
+      if (Consume('}')) return obj;
+      return Fail(err, "expected ',' or '}'");
+    }
+  }
+
+  ValuePtr ParseArray(std::string* err) {
+    pos_++;  // '['
+    auto arr = Value::MakeArray();
+    SkipWs();
+    if (Consume(']')) return arr;
+    while (true) {
+      ValuePtr val = ParseValue(err);
+      if (val == nullptr) return nullptr;
+      arr->Append(val);
+      if (Consume(',')) continue;
+      if (Consume(']')) return arr;
+      return Fail(err, "expected ',' or ']'");
+    }
+  }
+
+  ValuePtr ParseString(std::string* err) {
+    pos_++;  // '"'
+    std::string out;
+    while (pos_ < s_.size()) {
+      char c = s_[pos_++];
+      if (c == '"') return std::make_shared<Value>(out);
+      if (c == '\\') {
+        if (pos_ >= s_.size()) break;
+        char e = s_[pos_++];
+        switch (e) {
+          case '"': out.push_back('"'); break;
+          case '\\': out.push_back('\\'); break;
+          case '/': out.push_back('/'); break;
+          case 'b': out.push_back('\b'); break;
+          case 'f': out.push_back('\f'); break;
+          case 'n': out.push_back('\n'); break;
+          case 'r': out.push_back('\r'); break;
+          case 't': out.push_back('\t'); break;
+          case 'u': {
+            if (pos_ + 4 > s_.size()) return Fail(err, "bad \\u escape");
+            unsigned int cp = 0;
+            for (int i = 0; i < 4; i++) {
+              char h = s_[pos_++];
+              cp <<= 4;
+              if (h >= '0' && h <= '9') cp |= h - '0';
+              else if (h >= 'a' && h <= 'f') cp |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') cp |= h - 'A' + 10;
+              else return Fail(err, "bad \\u escape");
+            }
+            // UTF-8 encode (BMP only; surrogate pairs are passed through
+            // as two 3-byte sequences, fine for KServe payloads).
+            if (cp < 0x80) {
+              out.push_back(static_cast<char>(cp));
+            } else if (cp < 0x800) {
+              out.push_back(static_cast<char>(0xC0 | (cp >> 6)));
+              out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+            } else {
+              out.push_back(static_cast<char>(0xE0 | (cp >> 12)));
+              out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+              out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+            }
+            break;
+          }
+          default:
+            return Fail(err, "bad escape");
+        }
+      } else {
+        out.push_back(c);
+      }
+    }
+    return Fail(err, "unterminated string");
+  }
+
+  ValuePtr ParseNumber(std::string* err) {
+    size_t start = pos_;
+    if (pos_ < s_.size() && (s_[pos_] == '-' || s_[pos_] == '+')) pos_++;
+    bool is_int = true;
+    while (pos_ < s_.size()) {
+      char c = s_[pos_];
+      if (std::isdigit(static_cast<unsigned char>(c))) {
+        pos_++;
+      } else if (c == '.' || c == 'e' || c == 'E' || c == '-' || c == '+') {
+        if (c == '.' || c == 'e' || c == 'E') is_int = false;
+        pos_++;
+      } else {
+        break;
+      }
+    }
+    if (pos_ == start) return Fail(err, "invalid number");
+    std::string tok = s_.substr(start, pos_ - start);
+    try {
+      if (is_int) {
+        return std::make_shared<Value>(static_cast<int64_t>(std::stoll(tok)));
+      }
+      return std::make_shared<Value>(std::stod(tok));
+    } catch (...) {
+      return Fail(err, "invalid number");
+    }
+  }
+
+  const std::string& s_;
+  size_t pos_;
+};
+
+ValuePtr Parse(const std::string& text, std::string* err) {
+  Parser p(text);
+  return p.Parse(err);
+}
+
+}  // namespace json
+}  // namespace tputriton

@@ -1,0 +1,98 @@
+// Minimal JSON value tree + parser + writer for the C++ client.
+//
+// The reference's C++ client leans on triton-common's TritonJson
+// (http_client.cc includes it for request/response bodies); this image has
+// no JSON library, so the client carries its own ~small implementation
+// covering the KServe v2 surface: objects, arrays, strings (with escapes),
+// numbers, bools, null.
+
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace tputriton {
+namespace json {
+
+class Value;
+using ValuePtr = std::shared_ptr<Value>;
+
+enum class Type { kNull, kBool, kNumber, kString, kArray, kObject };
+
+class Value {
+ public:
+  Value() : type_(Type::kNull) {}
+  explicit Value(bool b) : type_(Type::kBool), bool_(b) {}
+  explicit Value(double d) : type_(Type::kNumber), num_(d) {}
+  explicit Value(int64_t i) : type_(Type::kNumber), num_(static_cast<double>(i)), is_int_(true), int_(i) {}
+  explicit Value(const std::string& s) : type_(Type::kString), str_(s) {}
+  explicit Value(const char* s) : type_(Type::kString), str_(s) {}
+
+  static ValuePtr MakeObject() {
+    auto v = std::make_shared<Value>();
+    v->type_ = Type::kObject;
+    return v;
+  }
+  static ValuePtr MakeArray() {
+    auto v = std::make_shared<Value>();
+    v->type_ = Type::kArray;
+    return v;
+  }
+
+  Type type() const { return type_; }
+  bool IsNull() const { return type_ == Type::kNull; }
+  bool AsBool() const { return bool_; }
+  double AsDouble() const { return num_; }
+  int64_t AsInt() const { return is_int_ ? int_ : static_cast<int64_t>(num_); }
+  const std::string& AsString() const { return str_; }
+
+  // Object access
+  ValuePtr Get(const std::string& key) const {
+    auto it = object_.find(key);
+    return it == object_.end() ? nullptr : it->second;
+  }
+  void Set(const std::string& key, ValuePtr v) { object_[key] = std::move(v); }
+  void Set(const std::string& key, const std::string& s) {
+    Set(key, std::make_shared<Value>(s));
+  }
+  void Set(const std::string& key, const char* s) {
+    Set(key, std::make_shared<Value>(s));
+  }
+  void Set(const std::string& key, int64_t i) {
+    Set(key, std::make_shared<Value>(i));
+  }
+  void Set(const std::string& key, bool b) {
+    Set(key, std::make_shared<Value>(b));
+  }
+  const std::map<std::string, ValuePtr>& object() const { return object_; }
+
+  // Array access
+  void Append(ValuePtr v) { array_.push_back(std::move(v)); }
+  void Append(int64_t i) { array_.push_back(std::make_shared<Value>(i)); }
+  void Append(const std::string& s) { array_.push_back(std::make_shared<Value>(s)); }
+  const std::vector<ValuePtr>& array() const { return array_; }
+  size_t Size() const { return array_.size(); }
+  ValuePtr At(size_t i) const { return i < array_.size() ? array_[i] : nullptr; }
+
+  std::string Serialize() const;
+
+ private:
+  friend class Parser;
+  Type type_;
+  bool bool_ = false;
+  double num_ = 0;
+  bool is_int_ = false;
+  int64_t int_ = 0;
+  std::string str_;
+  std::vector<ValuePtr> array_;
+  std::map<std::string, ValuePtr> object_;  // sorted keys => stable output
+};
+
+// Parse `text`; returns nullptr and fills `err` on failure.
+ValuePtr Parse(const std::string& text, std::string* err);
+
+}  // namespace json
+}  // namespace tputriton

@@ -1,0 +1,60 @@
+"""Build and run the C++ client binaries against the live server.
+
+The reference's C++ suite (cc_client_test.cc) runs against a live Triton;
+here the fixture server plays that role and the C++ binaries self-check.
+Skipped when no native toolchain is available.
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+from tritonclient_tpu.server import InferenceServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUILD = os.path.join(REPO, "build")
+
+
+@pytest.fixture(scope="module")
+def cpp_binaries():
+    if shutil.which("cmake") is None or shutil.which("g++") is None:
+        pytest.skip("no native toolchain")
+    gen = ["-G", "Ninja"] if shutil.which("ninja") else []
+    subprocess.run(
+        ["cmake", "-S", os.path.join(REPO, "native"), "-B", BUILD, *gen],
+        check=True, capture_output=True,
+    )
+    subprocess.run(
+        ["cmake", "--build", BUILD], check=True, capture_output=True,
+        timeout=300,
+    )
+    return BUILD
+
+
+@pytest.fixture(scope="module")
+def server():
+    with InferenceServer(grpc=False) as s:
+        yield s
+
+
+def test_cpp_client_suite(cpp_binaries, server):
+    proc = subprocess.run(
+        [os.path.join(cpp_binaries, "client_test"), server.http_address],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr}"
+    assert "ALL PASS" in proc.stdout
+
+
+def test_cpp_simple_example(cpp_binaries, server):
+    proc = subprocess.run(
+        [
+            os.path.join(cpp_binaries, "simple_http_infer_client"),
+            "-u", server.http_address,
+        ],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr}"
+    assert "PASS" in proc.stdout

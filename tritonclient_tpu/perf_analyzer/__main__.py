@@ -11,12 +11,16 @@ from tritonclient_tpu.perf_analyzer import PerfAnalyzer
 def _parse_concurrency_range(value: str):
     parts = [int(p) for p in value.split(":")]
     if len(parts) == 1:
-        return parts[0], parts[0], 1
-    if len(parts) == 2:
-        return parts[0], parts[1], 1
-    if len(parts) == 3:
-        return tuple(parts)
-    raise argparse.ArgumentTypeError("use start[:end[:step]]")
+        parts = [parts[0], parts[0], 1]
+    elif len(parts) == 2:
+        parts = [parts[0], parts[1], 1]
+    elif len(parts) != 3:
+        raise argparse.ArgumentTypeError("use start[:end[:step]]")
+    if parts[0] < 1 or parts[2] < 1:
+        raise argparse.ArgumentTypeError(
+            "concurrency start and step must be >= 1"
+        )
+    return tuple(parts)
 
 
 def _parse_shapes(values):

@@ -136,29 +136,46 @@ class _Worker:
     def _region_nbytes(self, name: str) -> int:
         dt, shape = self.analyzer.input_specs[name]
         if dt == "BYTES":
-            sample = self.payload_sets[0][name]
-            return len(serialize_byte_tensor(sample)[0]) + 64
+            # Serialized size varies per payload set; size for the largest.
+            return max(
+                len(serialize_byte_tensor(ps[name])[0])
+                for ps in self.payload_sets
+            )
         return int(np.prod(shape)) * np.dtype(triton_to_np_dtype(dt)).itemsize
 
     def teardown(self):
         a = self.analyzer
+
+        def attempt(fn, *args):
+            try:
+                fn(*args)
+            except Exception:
+                pass  # every cleanup step runs regardless of the others
+
         try:
-            if a.shared_memory == "system":
-                self._client.unregister_system_shared_memory(f"pa_in_{self.wid}")
+            if a.shared_memory == "system" and self._client is not None:
+                attempt(self._client.unregister_system_shared_memory,
+                        f"pa_in_{self.wid}")
+                attempt(self._client.unregister_system_shared_memory,
+                        f"pa_out_{self.wid}")
+                if hasattr(self, "_in_region"):
+                    attempt(self._shm.destroy_shared_memory_region, self._in_region)
                 if hasattr(self, "_out_region"):
-                    self._client.unregister_system_shared_memory(f"pa_out_{self.wid}")
-                self._shm.destroy_shared_memory_region(self._in_region)
+                    attempt(self._shm.destroy_shared_memory_region, self._out_region)
+            elif a.shared_memory == "tpu" and self._client is not None:
+                attempt(self._client.unregister_tpu_shared_memory,
+                        f"pa_in_{self.wid}")
+                attempt(self._client.unregister_tpu_shared_memory,
+                        f"pa_out_{self.wid}")
+                if hasattr(self, "_in_region"):
+                    attempt(self._tpushm.destroy_shared_memory_region,
+                            self._in_region)
                 if hasattr(self, "_out_region"):
-                    self._shm.destroy_shared_memory_region(self._out_region)
-            elif a.shared_memory == "tpu":
-                self._client.unregister_tpu_shared_memory(f"pa_in_{self.wid}")
-                if hasattr(self, "_out_region"):
-                    self._client.unregister_tpu_shared_memory(f"pa_out_{self.wid}")
-                self._tpushm.destroy_shared_memory_region(self._in_region)
-                if hasattr(self, "_out_region"):
-                    self._tpushm.destroy_shared_memory_region(self._out_region)
+                    attempt(self._tpushm.destroy_shared_memory_region,
+                            self._out_region)
         finally:
-            a.close_client(self._client)
+            if self._client is not None:
+                a.close_client(self._client)
 
     # -- request construction ------------------------------------------------
 
@@ -400,11 +417,13 @@ class PerfAnalyzer:
 
     def measure(self, concurrency: int) -> MeasurementWindow:
         workers = [_Worker(self, w) for w in range(concurrency)]
-        ready = []
+        started = []
         try:
             for w in workers:
+                # Track before setup so a mid-setup failure still tears down
+                # whatever this worker managed to create/register.
+                started.append(w)
                 w.setup()
-                ready.append(w)
             end = time.perf_counter() + self.warmup_s + self.measurement_interval_s
             threads = [
                 threading.Thread(target=w.run, args=(end,), daemon=True)
@@ -436,13 +455,15 @@ class PerfAnalyzer:
                 )
             return window
         finally:
-            for w in ready:
+            for w in started:
                 try:
                     w.teardown()
                 except Exception:  # cleanup must reach every worker
                     pass
 
     def sweep(self, start: int, end: int, step: int = 1) -> List[Dict]:
+        if step < 1:
+            raise ValueError(f"concurrency step must be >= 1, got {step}")
         results = []
         level = start
         while level <= end:
