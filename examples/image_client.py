@@ -1,0 +1,82 @@
+#!/usr/bin/env python3
+"""Image classification via model-metadata-driven preprocessing.
+
+Parity with the reference image_client.py (:60-217): query the model's
+metadata/config to derive input name/shape/datatype, preprocess the image
+to NHWC float32, request the classification extension (class_count), and
+print "value:index:label" rows. Without --image a synthetic image is used
+so the example runs hermetically.
+"""
+
+import sys
+
+import numpy as np
+
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.grpc import (
+    InferenceServerClient,
+    InferInput,
+    InferRequestedOutput,
+)
+
+
+def _load_image(path, height, width):
+    if path is None:
+        rng = np.random.default_rng(0)
+        return rng.random((height, width, 3), dtype=np.float32)
+    try:
+        from PIL import Image  # optional dependency
+
+        img = Image.open(path).convert("RGB").resize((width, height))
+        return np.asarray(img, dtype=np.float32) / 255.0
+    except ImportError:
+        print("Pillow not installed; using synthetic image")
+        rng = np.random.default_rng(0)
+        return rng.random((height, width, 3), dtype=np.float32)
+
+
+def main():
+    parser = example_parser(__doc__)
+    parser.add_argument("-m", "--model-name", default="resnet50")
+    parser.add_argument("-c", "--classes", type=int, default=3)
+    parser.add_argument("--image", default=None)
+    args = parser.parse_args()
+
+    models = None
+    if args.fixture:
+        from tritonclient_tpu.models.resnet import ResNet50Model
+        from tritonclient_tpu.server import default_models
+
+        models = default_models() + [ResNet50Model(num_classes=10)]
+
+    with maybe_fixture_server(args, models=models) as url:
+        with InferenceServerClient(url, verbose=args.verbose) as client:
+            meta = client.get_model_metadata(args.model_name, as_json=True)
+            input_meta = meta["inputs"][0]
+            output_meta = meta["outputs"][0]
+            shape = [int(s) for s in input_meta["shape"]]
+            height, width = shape[1], shape[2]
+
+            image = _load_image(args.image, height, width)
+            batch = image[None, ...].astype(np.float32)
+
+            inp = InferInput(input_meta["name"], list(batch.shape),
+                             input_meta["datatype"])
+            inp.set_data_from_numpy(batch)
+            out = InferRequestedOutput(
+                output_meta["name"], class_count=args.classes
+            )
+            result = client.infer(args.model_name, [inp], outputs=[out])
+            rows = result.as_numpy(output_meta["name"])
+            if rows.size != args.classes:
+                print("error: wrong classification row count")
+                sys.exit(1)
+            print(f"top-{args.classes}:")
+            for row in rows.reshape(-1, args.classes)[0]:
+                value, idx, *label = row.decode().split(":")
+                print(f"  {float(value):8.4f} (#{idx}) {label[0] if label else ''}")
+            print("PASS: image classification")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+#!/usr/bin/env python3
+"""Leak soak: repeated infer cycles with RSS growth check.
+
+Parity with the reference examples/memory_growth_test.py (-r repetitions).
+"""
+
+import resource
+import sys
+
+import numpy as np
+
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.grpc import InferenceServerClient, InferInput
+
+
+def rss_mb():
+    rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    # ru_maxrss is kilobytes on Linux, bytes on macOS.
+    return rss / (1024.0 * 1024.0) if sys.platform == "darwin" else rss / 1024.0
+
+
+def main():
+    parser = example_parser(__doc__)
+    parser.add_argument("-r", "--repetitions", type=int, default=200)
+    parser.add_argument("--max-growth-mb", type=float, default=64.0)
+    args = parser.parse_args()
+    with maybe_fixture_server(args) as url:
+        with InferenceServerClient(url, verbose=args.verbose) as client:
+            x = np.arange(16, dtype=np.int32).reshape(1, 16)
+            inputs = [
+                InferInput("INPUT0", [1, 16], "INT32"),
+                InferInput("INPUT1", [1, 16], "INT32"),
+            ]
+            # Warm everything (jit, pools) before baselining.
+            for _ in range(10):
+                inputs[0].set_data_from_numpy(x)
+                inputs[1].set_data_from_numpy(x)
+                client.infer("simple", inputs)
+            baseline = rss_mb()
+            for i in range(args.repetitions):
+                inputs[0].set_data_from_numpy(x)
+                inputs[1].set_data_from_numpy(x)
+                result = client.infer("simple", inputs)
+                assert result.as_numpy("OUTPUT0") is not None
+            growth = rss_mb() - baseline
+            print(f"RSS growth after {args.repetitions} reps: {growth:.1f} MB")
+            if growth > args.max_growth_mb:
+                print("error: memory growth exceeds threshold")
+                sys.exit(1)
+            print("PASS: memory growth within bounds")
+
+
+if __name__ == "__main__":
+    main()

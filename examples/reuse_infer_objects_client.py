@@ -1,0 +1,66 @@
+#!/usr/bin/env python3
+"""Reuse InferInput/InferRequestedOutput objects across requests.
+
+Parity with the reference reuse_infer_objects_client.py: the same tensor
+objects are reused with set_data_from_numpy between calls, and switched
+between wire data and shared memory.
+"""
+
+import sys
+
+import numpy as np
+
+import tritonclient_tpu.utils.shared_memory as shm
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.grpc import (
+    InferenceServerClient,
+    InferInput,
+    InferRequestedOutput,
+)
+
+
+def main():
+    args = example_parser(__doc__).parse_args()
+    with maybe_fixture_server(args) as url:
+        with InferenceServerClient(url, verbose=args.verbose) as client:
+            inputs = [
+                InferInput("INPUT0", [1, 16], "INT32"),
+                InferInput("INPUT1", [1, 16], "INT32"),
+            ]
+            outputs = [
+                InferRequestedOutput("OUTPUT0"),
+                InferRequestedOutput("OUTPUT1"),
+            ]
+            for round_idx in range(3):
+                input0 = np.full((1, 16), round_idx, dtype=np.int32)
+                input1 = np.arange(16, dtype=np.int32).reshape(1, 16)
+                inputs[0].set_data_from_numpy(input0)
+                inputs[1].set_data_from_numpy(input1)
+                result = client.infer("simple", inputs, outputs=outputs)
+                if not np.array_equal(
+                    result.as_numpy("OUTPUT0"), input0 + input1
+                ):
+                    print(f"error: round {round_idx} mismatch")
+                    sys.exit(1)
+
+            # Same objects, now routed through shared memory.
+            region = shm.create_shared_memory_region("reuse", "/reuse_ex", 128)
+            try:
+                x = np.full((1, 16), 9, dtype=np.int32)
+                shm.set_shared_memory_region(region, [x, x])
+                client.register_system_shared_memory("reuse", "/reuse_ex", 128)
+                inputs[0].set_shared_memory("reuse", 64)
+                inputs[1].set_shared_memory("reuse", 64, offset=64)
+                result = client.infer("simple", inputs, outputs=outputs)
+                out0 = result.as_numpy("OUTPUT0")  # wire output, shm inputs
+                if not np.array_equal(out0, x + x):
+                    print("error: shm round mismatch")
+                    sys.exit(1)
+            finally:
+                client.unregister_system_shared_memory()
+                shm.destroy_shared_memory_region(region)
+            print("PASS: object reuse across wire and shm rounds")
+
+
+if __name__ == "__main__":
+    main()

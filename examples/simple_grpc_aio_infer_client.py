@@ -1,0 +1,42 @@
+#!/usr/bin/env python3
+"""asyncio gRPC client (reference simple_grpc_aio_infer_client.py)."""
+
+import asyncio
+import sys
+
+import numpy as np
+
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.grpc.aio import InferenceServerClient
+from tritonclient_tpu.grpc import InferInput, InferRequestedOutput
+
+
+async def run(url, verbose):
+    async with InferenceServerClient(url, verbose=verbose) as client:
+        assert await client.is_server_live()
+        input0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+        input1 = np.ones((1, 16), dtype=np.int32)
+        inputs = [
+            InferInput("INPUT0", [1, 16], "INT32"),
+            InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(input0)
+        inputs[1].set_data_from_numpy(input1)
+        result = await client.infer(
+            "simple", inputs, outputs=[InferRequestedOutput("OUTPUT0")]
+        )
+        out0 = result.as_numpy("OUTPUT0")
+        if not np.array_equal(out0, input0 + input1):
+            print("error: incorrect results")
+            sys.exit(1)
+        print("PASS: aio infer")
+
+
+def main():
+    args = example_parser(__doc__).parse_args()
+    with maybe_fixture_server(args) as url:
+        asyncio.run(run(url, args.verbose))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+#!/usr/bin/env python3
+"""asyncio bidi streaming with a stateful sequence.
+
+Parity with the reference simple_grpc_aio_sequence_stream_infer_client.py:
+stream_infer over an async request iterator, responses as an async iterator.
+"""
+
+import asyncio
+import sys
+
+import numpy as np
+
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.grpc.aio import InferenceServerClient
+from tritonclient_tpu.grpc import InferInput
+
+
+async def run(url, verbose):
+    values = [4, 2, 7]
+    async with InferenceServerClient(url, verbose=verbose) as client:
+        async def requests():
+            for i, value in enumerate(values):
+                inp = InferInput("INPUT", [1, 1], "INT32")
+                inp.set_data_from_numpy(np.array([[value]], dtype=np.int32))
+                yield {
+                    "model_name": "simple_sequence",
+                    "inputs": [inp],
+                    "sequence_id": 77,
+                    "sequence_start": i == 0,
+                    "sequence_end": i == len(values) - 1,
+                }
+
+        totals = []
+        response_iterator = client.stream_infer(requests())
+        async for result, error in response_iterator:
+            if error is not None:
+                print(f"error: {error}")
+                sys.exit(1)
+            totals.append(int(result.as_numpy("OUTPUT")[0][0]))
+            if len(totals) == len(values):
+                break
+        if totals[-1] != sum(values):
+            print(f"error: {totals[-1]} != {sum(values)}")
+            sys.exit(1)
+        print("PASS: aio sequence streaming")
+
+
+def main():
+    args = example_parser(__doc__).parse_args()
+    with maybe_fixture_server(args) as url:
+        asyncio.run(run(url, args.verbose))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+#!/usr/bin/env python3
+"""Callback-based async_infer over gRPC (reference simple_grpc_async_infer_client.py)."""
+
+import queue
+import sys
+
+import numpy as np
+
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.grpc import InferenceServerClient, InferInput
+
+
+def main():
+    args = example_parser(__doc__).parse_args()
+    with maybe_fixture_server(args) as url:
+        with InferenceServerClient(url, verbose=args.verbose) as client:
+            input0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+            input1 = np.ones((1, 16), dtype=np.int32)
+            inputs = [
+                InferInput("INPUT0", [1, 16], "INT32"),
+                InferInput("INPUT1", [1, 16], "INT32"),
+            ]
+            inputs[0].set_data_from_numpy(input0)
+            inputs[1].set_data_from_numpy(input1)
+
+            done = queue.Queue()
+            n = 4
+            for _ in range(n):
+                client.async_infer(
+                    "simple", inputs,
+                    callback=lambda result, error: done.put((result, error)),
+                )
+            for _ in range(n):
+                result, error = done.get(timeout=30)
+                if error is not None:
+                    print(f"error: {error}")
+                    sys.exit(1)
+                out0 = result.as_numpy("OUTPUT0")
+                assert np.array_equal(out0, input0 + input1)
+            print(f"PASS: {n} async infers")
+
+
+if __name__ == "__main__":
+    main()

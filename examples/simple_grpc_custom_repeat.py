@@ -1,0 +1,61 @@
+#!/usr/bin/env python3
+"""Decoupled model streaming: one request, N responses plus empty final.
+
+Parity with the reference simple_grpc_custom_repeat.py against the
+repeat_int32 model (enable_empty_final_response / triton_final_response).
+"""
+
+import queue
+import sys
+from functools import partial
+
+import numpy as np
+
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.grpc import InferenceServerClient, InferInput
+
+
+def main():
+    parser = example_parser(__doc__)
+    parser.add_argument("--repeat-count", type=int, default=6)
+    args = parser.parse_args()
+    values = list(range(args.repeat_count))
+    with maybe_fixture_server(args) as url:
+        with InferenceServerClient(url, verbose=args.verbose) as client:
+            results: "queue.Queue" = queue.Queue()
+            client.start_stream(
+                callback=partial(
+                    lambda q, result, error: q.put((result, error)), results
+                )
+            )
+            inp = InferInput("IN", [len(values)], "INT32")
+            inp.set_data_from_numpy(np.array(values, dtype=np.int32))
+            client.async_stream_infer(
+                "repeat_int32", [inp], enable_empty_final_response=True
+            )
+
+            received = []
+            while True:
+                result, error = results.get(timeout=30)
+                if error is not None:
+                    print(f"error: {error}")
+                    sys.exit(1)
+                response = result.get_response()
+                final = (
+                    response.parameters.get("triton_final_response")
+                    and response.parameters["triton_final_response"].bool_param
+                )
+                out = result.as_numpy("OUT")
+                if out is not None and out.size:
+                    received.append(int(out[0]))
+                if final:
+                    break
+            client.stop_stream()
+            if received != values:
+                print(f"error: {received} != {values}")
+                sys.exit(1)
+            print(f"PASS: decoupled stream ({len(values)} responses + final)")
+
+
+if __name__ == "__main__":
+    main()

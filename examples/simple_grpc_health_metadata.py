@@ -1,0 +1,42 @@
+#!/usr/bin/env python3
+"""Health, metadata, statistics, trace and log settings over gRPC.
+
+Covers the control-plane surface of the reference's health/metadata
+examples plus trace/log settings (grpc/_client.py:832-1051 parity).
+"""
+
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.grpc import InferenceServerClient
+
+
+def main():
+    args = example_parser(__doc__).parse_args()
+    with maybe_fixture_server(args) as url:
+        with InferenceServerClient(url, verbose=args.verbose) as client:
+            assert client.is_server_live()
+            assert client.is_server_ready()
+            assert client.is_model_ready("simple")
+
+            meta = client.get_server_metadata(as_json=True)
+            print(f"server: {meta['name']} {meta['version']}")
+            print(f"extensions: {', '.join(meta['extensions'])}")
+
+            model_meta = client.get_model_metadata("simple", as_json=True)
+            print(f"model inputs: {[t['name'] for t in model_meta['inputs']]}")
+
+            stats = client.get_inference_statistics("simple", as_json=True)
+            print(f"stats entries: {len(stats['model_stats'])}")
+
+            trace = client.update_trace_settings(
+                settings={"trace_level": ["TIMESTAMPS"]}, as_json=True
+            )
+            assert trace["settings"]["trace_level"]["value"] == ["TIMESTAMPS"]
+            log = client.update_log_settings(
+                settings={"log_verbose_level": 1}, as_json=True
+            )
+            assert client.get_log_settings(as_json=True) is not None
+            print("PASS: health/metadata/statistics/trace/log")
+
+
+if __name__ == "__main__":
+    main()

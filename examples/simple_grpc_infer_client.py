@@ -1,0 +1,52 @@
+#!/usr/bin/env python3
+"""Plain gRPC infer against the `simple` add/sub model.
+
+Parity with the reference example simple_grpc_infer_client.py: build two
+int32 [1,16] inputs, request both outputs, check OUTPUT0=sum, OUTPUT1=diff.
+"""
+
+import sys
+
+import numpy as np
+
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.grpc import (
+    InferenceServerClient,
+    InferInput,
+    InferRequestedOutput,
+)
+
+
+def main():
+    args = example_parser(__doc__).parse_args()
+    with maybe_fixture_server(args) as url:
+        with InferenceServerClient(url, verbose=args.verbose) as client:
+            input0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+            input1 = np.ones((1, 16), dtype=np.int32)
+
+            inputs = [
+                InferInput("INPUT0", [1, 16], "INT32"),
+                InferInput("INPUT1", [1, 16], "INT32"),
+            ]
+            inputs[0].set_data_from_numpy(input0)
+            inputs[1].set_data_from_numpy(input1)
+            outputs = [
+                InferRequestedOutput("OUTPUT0"),
+                InferRequestedOutput("OUTPUT1"),
+            ]
+
+            result = client.infer("simple", inputs, outputs=outputs)
+            out0 = result.as_numpy("OUTPUT0")
+            out1 = result.as_numpy("OUTPUT1")
+            for i in range(16):
+                print(f"{input0[0][i]} + {input1[0][i]} = {out0[0][i]}, "
+                      f"{input0[0][i]} - {input1[0][i]} = {out1[0][i]}")
+            if not (np.array_equal(out0, input0 + input1)
+                    and np.array_equal(out1, input0 - input1)):
+                print("error: incorrect results")
+                sys.exit(1)
+            print("PASS: infer")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+#!/usr/bin/env python3
+"""Stateful sequences over a gRPC bidi stream.
+
+Parity with the reference simple_grpc_sequence_stream_infer_client.py:
+two interleaved sequences accumulate values server-side, correlated by
+sequence_id with start/end flags.
+"""
+
+import queue
+import sys
+from functools import partial
+
+import numpy as np
+
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.grpc import InferenceServerClient, InferInput
+
+
+def callback(results, result, error):
+    results.put((result, error))
+
+
+def main():
+    args = example_parser(__doc__).parse_args()
+    values = [11, 7, 5, 3, 2, 0, 1]
+    with maybe_fixture_server(args) as url:
+        with InferenceServerClient(url, verbose=args.verbose) as client:
+            results: "queue.Queue" = queue.Queue()
+            client.start_stream(callback=partial(callback, results))
+            for seq_id in (1001, 1002):
+                for i, value in enumerate(values):
+                    inp = InferInput("INPUT", [1, 1], "INT32")
+                    sign = 1 if seq_id == 1001 else -1
+                    inp.set_data_from_numpy(
+                        np.array([[value * sign]], dtype=np.int32)
+                    )
+                    client.async_stream_infer(
+                        "simple_sequence",
+                        [inp],
+                        sequence_id=seq_id,
+                        sequence_start=(i == 0),
+                        sequence_end=(i == len(values) - 1),
+                    )
+            client.stop_stream()
+
+            totals = {1001: 0, 1002: 0}
+            expected = {1001: sum(values), 1002: -sum(values)}
+            seen = 0
+            while seen < 2 * len(values):
+                result, error = results.get(timeout=30)
+                if error is not None:
+                    print(f"error: {error}")
+                    sys.exit(1)
+                seen += 1
+                out = int(result.as_numpy("OUTPUT")[0][0])
+                # The final response of each sequence carries its total.
+                if abs(out) == sum(values):
+                    totals[1001 if out > 0 else 1002] = out
+            if totals != expected:
+                print(f"error: {totals} != {expected}")
+                sys.exit(1)
+            print("PASS: sequence streaming (two interleaved sequences)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+#!/usr/bin/env python3
+"""Stateful sequences with synchronous infer (no stream).
+
+Parity with the reference simple_grpc_sequence_sync_infer_client.py:
+sequence_id/start/end threaded through plain infer calls.
+"""
+
+import sys
+
+import numpy as np
+
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.grpc import InferenceServerClient, InferInput
+
+
+def main():
+    args = example_parser(__doc__).parse_args()
+    values = [10, 20, 30]
+    with maybe_fixture_server(args) as url:
+        with InferenceServerClient(url, verbose=args.verbose) as client:
+            last = None
+            for i, value in enumerate(values):
+                inp = InferInput("INPUT", [1, 1], "INT32")
+                inp.set_data_from_numpy(np.array([[value]], dtype=np.int32))
+                result = client.infer(
+                    "simple_sequence",
+                    [inp],
+                    sequence_id=42,
+                    sequence_start=(i == 0),
+                    sequence_end=(i == len(values) - 1),
+                )
+                last = int(result.as_numpy("OUTPUT")[0][0])
+                print(f"step {i}: accumulator = {last}")
+            if last != sum(values):
+                print(f"error: {last} != {sum(values)}")
+                sys.exit(1)
+            print("PASS: sequence sync infer")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+#!/usr/bin/env python3
+"""BYTES tensor infer: decimal strings in, sum/diff strings out.
+
+Parity with the reference simple_grpc_string_infer_client.py against the
+simple_string model.
+"""
+
+import sys
+
+import numpy as np
+
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.grpc import InferenceServerClient, InferInput
+
+
+def main():
+    args = example_parser(__doc__).parse_args()
+    with maybe_fixture_server(args) as url:
+        with InferenceServerClient(url, verbose=args.verbose) as client:
+            in0 = np.array([[str(i) for i in range(16)]], dtype=np.object_)
+            in1 = np.array([[str(1) for _ in range(16)]], dtype=np.object_)
+            inputs = [
+                InferInput("INPUT0", [1, 16], "BYTES"),
+                InferInput("INPUT1", [1, 16], "BYTES"),
+            ]
+            inputs[0].set_data_from_numpy(in0)
+            inputs[1].set_data_from_numpy(in1)
+            result = client.infer("simple_string", inputs)
+            out0 = result.as_numpy("OUTPUT0")
+            for i in range(16):
+                expected = i + 1
+                if int(out0[0][i]) != expected:
+                    print(f"error: {out0[0][i]} != {expected}")
+                    sys.exit(1)
+            print("PASS: string infer")
+
+
+if __name__ == "__main__":
+    main()

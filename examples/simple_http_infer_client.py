@@ -1,0 +1,46 @@
+#!/usr/bin/env python3
+"""Plain HTTP/REST infer against the `simple` model (binary tensor framing).
+
+Parity with the reference simple_http_infer_client.py.
+"""
+
+import sys
+
+import numpy as np
+
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.http import (
+    InferenceServerClient,
+    InferInput,
+    InferRequestedOutput,
+)
+
+
+def main():
+    args = example_parser(__doc__, default_port=8000).parse_args()
+    with maybe_fixture_server(args, grpc=False) as url:
+        with InferenceServerClient(url, verbose=args.verbose) as client:
+            input0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+            input1 = np.full((1, 16), 2, dtype=np.int32)
+            inputs = [
+                InferInput("INPUT0", [1, 16], "INT32"),
+                InferInput("INPUT1", [1, 16], "INT32"),
+            ]
+            inputs[0].set_data_from_numpy(input0, binary_data=True)
+            inputs[1].set_data_from_numpy(input1, binary_data=False)  # JSON leg
+            outputs = [
+                InferRequestedOutput("OUTPUT0", binary_data=True),
+                InferRequestedOutput("OUTPUT1", binary_data=False),
+            ]
+            result = client.infer("simple", inputs, outputs=outputs)
+            out0 = result.as_numpy("OUTPUT0")
+            out1 = result.as_numpy("OUTPUT1")
+            if not (np.array_equal(out0, input0 + input1)
+                    and np.array_equal(out1, input0 - input1)):
+                print("error: incorrect results")
+                sys.exit(1)
+            print("PASS: http infer (mixed binary/JSON framing)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""Run every example under --fixture as a subprocess (hermetic tier).
+
+The reference's examples are its de-facto integration suite (SURVEY.md
+§2.4); here each one self-checks and exits nonzero on failure.
+"""
+
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"
+)
+EXAMPLES = sorted(
+    os.path.basename(p)
+    for p in glob.glob(os.path.join(EXAMPLES_DIR, "*.py"))
+    if not os.path.basename(p).startswith("_")
+)
+
+SLOW_ARGS = {
+    "memory_growth_test.py": ["-r", "30"],
+    "image_client.py": ["-c", "3"],
+}
+
+
+@pytest.mark.parametrize("example", EXAMPLES)
+def test_example(example):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    repo_root = os.path.dirname(EXAMPLES_DIR)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, example, "--fixture", *SLOW_ARGS.get(example, [])],
+        cwd=EXAMPLES_DIR, env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, (
+        f"{example} failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    assert "PASS" in proc.stdout, proc.stdout
+
+
+def test_example_inventory_covers_reference_families():
+    """The §2.4 example families all have a representative."""
+    families = {
+        "plain": "simple_grpc_infer_client.py",
+        "http": "simple_http_infer_client.py",
+        "async": "simple_grpc_async_infer_client.py",
+        "aio": "simple_grpc_aio_infer_client.py",
+        "http_aio": "simple_http_aio_infer_client.py",
+        "string": "simple_grpc_string_infer_client.py",
+        "system_shm": "simple_grpc_shm_client.py",
+        "tpu_shm": "simple_grpc_tpushm_client.py",
+        "sequence_sync": "simple_grpc_sequence_sync_infer_client.py",
+        "sequence_stream": "simple_grpc_sequence_stream_infer_client.py",
+        "aio_sequence_stream": "simple_grpc_aio_sequence_stream_infer_client.py",
+        "decoupled": "simple_grpc_custom_repeat.py",
+        "health_metadata": "simple_grpc_health_metadata.py",
+        "model_control": "simple_grpc_model_control.py",
+        "classification": "image_client.py",
+        "reuse": "reuse_infer_objects_client.py",
+        "leak_soak": "memory_growth_test.py",
+    }
+    for family, filename in families.items():
+        assert filename in EXAMPLES, f"missing {family} example: {filename}"

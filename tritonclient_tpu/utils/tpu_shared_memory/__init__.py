@@ -250,12 +250,24 @@ def set_shared_memory_region_from_dlpack(
     """Ingest DLPack-capable tensors (jax.Array, torch, numpy, ...) without
     host staging when the producer is already on the target device."""
     import jax
+    import numpy as _np
 
     if not isinstance(input_values, (list, tuple)):
         raise TpuSharedMemoryException("input_values must be a list of tensors")
     cursor = offset
     for value in input_values:
-        arr = jax.dlpack.from_dlpack(value) if hasattr(value, "__dlpack__") else value
+        if isinstance(value, jax.Array):
+            # Already a device array in this process: park it directly —
+            # no capsule round-trip needed (and some PjRt plugins don't
+            # export DLPack).
+            arr = value
+        elif hasattr(value, "__dlpack__"):
+            try:
+                arr = jax.dlpack.from_dlpack(value)
+            except (BufferError, TypeError, RuntimeError):
+                arr = _np.from_dlpack(value)
+        else:
+            arr = _np.asarray(value)
         shm_handle.set_array(arr, cursor)
         cursor += arr.nbytes
 
