@@ -1,0 +1,13 @@
+#!/bin/bash
+# Generate Go stubs for the KServe v2 service (reference: gen_go_stubs.sh).
+set -euo pipefail
+PROTO_DIR="$(dirname "$0")/../../tritonclient_tpu/protocol"
+mkdir -p kserve
+protoc \
+  -I "${PROTO_DIR}" \
+  --go_out=kserve --go_opt=paths=source_relative \
+  --go-grpc_out=kserve --go-grpc_opt=paths=source_relative \
+  --go_opt=Mkserve.proto=example.com/kserve \
+  --go-grpc_opt=Mkserve.proto=example.com/kserve \
+  kserve.proto
+echo "stubs written to kserve/"

@@ -1,0 +1,8 @@
+module example.com/tpu-triton-go-client
+
+go 1.21
+
+require (
+	google.golang.org/grpc v1.60.0
+	google.golang.org/protobuf v1.32.0
+)

@@ -1,0 +1,67 @@
+// Minimal Go client against the `simple` model over gRPC.
+//
+// Parity with the reference's grpc_simple_client.go: health check, model
+// metadata, one ModelInfer with two int32 [1,16] inputs, decode raw
+// little-endian outputs. Run ./gen_go_stubs.sh first.
+package main
+
+import (
+	"context"
+	"encoding/binary"
+	"flag"
+	"fmt"
+	"log"
+	"time"
+
+	"google.golang.org/grpc"
+	"google.golang.org/grpc/credentials/insecure"
+
+	kserve "example.com/kserve"
+)
+
+func main() {
+	url := flag.String("u", "localhost:8001", "server address")
+	flag.Parse()
+
+	conn, err := grpc.NewClient(*url,
+		grpc.WithTransportCredentials(insecure.NewCredentials()))
+	if err != nil {
+		log.Fatalf("connect: %v", err)
+	}
+	defer conn.Close()
+	client := kserve.NewGRPCInferenceServiceClient(conn)
+	ctx, cancel := context.WithTimeout(context.Background(), 30*time.Second)
+	defer cancel()
+
+	live, err := client.ServerLive(ctx, &kserve.ServerLiveRequest{})
+	if err != nil || !live.Live {
+		log.Fatalf("server not live: %v", err)
+	}
+
+	input0 := make([]byte, 64)
+	input1 := make([]byte, 64)
+	for i := 0; i < 16; i++ {
+		binary.LittleEndian.PutUint32(input0[i*4:], uint32(i))
+		binary.LittleEndian.PutUint32(input1[i*4:], 1)
+	}
+	request := &kserve.ModelInferRequest{
+		ModelName: "simple",
+		Inputs: []*kserve.ModelInferRequest_InferInputTensor{
+			{Name: "INPUT0", Datatype: "INT32", Shape: []int64{1, 16}},
+			{Name: "INPUT1", Datatype: "INT32", Shape: []int64{1, 16}},
+		},
+		RawInputContents: [][]byte{input0, input1},
+	}
+	response, err := client.ModelInfer(ctx, request)
+	if err != nil {
+		log.Fatalf("infer: %v", err)
+	}
+	sums := response.RawOutputContents[0]
+	for i := 0; i < 16; i++ {
+		v := int32(binary.LittleEndian.Uint32(sums[i*4:]))
+		if v != int32(i)+1 {
+			log.Fatalf("mismatch at %d: %d", i, v)
+		}
+	}
+	fmt.Println("PASS: go grpc client")
+}

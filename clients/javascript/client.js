@@ -1,0 +1,63 @@
+// Minimal Node.js client using dynamic proto loading.
+//
+// Parity with the reference's grpc_generated/javascript/client.js
+// (@grpc/proto-loader dynamic stubs, client.js:43-60).
+//
+//   npm install @grpc/grpc-js @grpc/proto-loader
+//   node client.js [url]
+
+const grpc = require("@grpc/grpc-js");
+const protoLoader = require("@grpc/proto-loader");
+const path = require("path");
+
+const PROTO = path.join(
+  __dirname, "..", "..", "tritonclient_tpu", "protocol", "kserve.proto"
+);
+
+const url = process.argv[2] || "localhost:8001";
+const definition = protoLoader.loadSync(PROTO, {
+  keepCase: true,
+  longs: Number,
+  defaults: true,
+});
+const inference = grpc.loadPackageDefinition(definition).inference;
+const client = new inference.GRPCInferenceService(
+  url, grpc.credentials.createInsecure()
+);
+
+function int32Bytes(values) {
+  const buf = Buffer.alloc(values.length * 4);
+  values.forEach((v, i) => buf.writeInt32LE(v, i * 4));
+  return buf;
+}
+
+client.ServerLive({}, (err, response) => {
+  if (err || !response.live) {
+    console.error("server not live", err);
+    process.exit(1);
+  }
+  const input0 = Array.from({ length: 16 }, (_, i) => i);
+  const input1 = Array.from({ length: 16 }, () => 1);
+  const request = {
+    model_name: "simple",
+    inputs: [
+      { name: "INPUT0", datatype: "INT32", shape: [1, 16] },
+      { name: "INPUT1", datatype: "INT32", shape: [1, 16] },
+    ],
+    raw_input_contents: [int32Bytes(input0), int32Bytes(input1)],
+  };
+  client.ModelInfer(request, (err, response) => {
+    if (err) {
+      console.error("infer failed", err);
+      process.exit(1);
+    }
+    const sums = response.raw_output_contents[0];
+    for (let i = 0; i < 16; i++) {
+      if (sums.readInt32LE(i * 4) !== input0[i] + input1[i]) {
+        console.error("mismatch at", i);
+        process.exit(1);
+      }
+    }
+    console.log("PASS: javascript grpc client");
+  });
+});

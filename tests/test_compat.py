@@ -1,0 +1,65 @@
+"""compat aliasing: reference-style imports run against the TPU stack."""
+
+import sys
+
+import numpy as np
+import pytest
+
+import tritonclient_tpu.compat as compat
+
+
+@pytest.fixture()
+def aliases():
+    compat.install(force=True)
+    yield
+    compat.uninstall()
+
+
+def test_reference_style_imports_and_infer(aliases):
+    import tritonclient.grpc as grpcclient
+    from tritonclient.utils import InferenceServerException  # noqa: F401
+
+    from tritonclient_tpu.server import InferenceServer
+
+    with InferenceServer(http=False) as server:
+        client = grpcclient.InferenceServerClient(server.grpc_address)
+        x = np.arange(16, dtype=np.int32).reshape(1, 16)
+        inputs = [
+            grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+            grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(x)
+        inputs[1].set_data_from_numpy(x)
+        result = client.infer("simple", inputs)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), x + x)
+        client.close()
+
+
+def test_cudashm_alias_warns_and_maps(aliases):
+    with pytest.warns(UserWarning, match="tpu_shared_memory"):
+        compat.install(force=True)
+    import tritonclient.utils.cuda_shared_memory as cudashm
+
+    assert cudashm.__name__ == "tritonclient_tpu.utils.tpu_shared_memory"
+    region = cudashm.create_shared_memory_region("compat", 64, 0)
+    cudashm.set_shared_memory_region(region, [np.arange(8, dtype=np.int32)])
+    out = cudashm.get_contents_as_numpy(region, "INT32", [8])
+    np.testing.assert_array_equal(out, np.arange(8))
+    cudashm.destroy_shared_memory_region(region)
+
+
+def test_old_shim_names(aliases):
+    import tritongrpcclient
+    import tritonhttpclient
+    import tritonclientutils
+
+    assert tritongrpcclient.InferenceServerClient
+    assert tritonhttpclient.InferenceServerClient
+    assert tritonclientutils.np_to_triton_dtype
+
+
+def test_uninstall_removes_aliases():
+    compat.install(force=True)
+    assert "tritonclient.grpc" in sys.modules
+    compat.uninstall()
+    assert "tritonclient.grpc" not in sys.modules

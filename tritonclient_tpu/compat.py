@@ -1,0 +1,79 @@
+"""Drop-in aliasing for code written against ``tritonclient``.
+
+The reference ships deprecation shims for its own old package names
+(tritonhttpclient/tritongrpcclient/tritonclientutils/tritonshmutils —
+e.g. tritonhttpclient/__init__.py:31-42); this module goes one step
+further for migrating users: ``install()`` registers this framework's
+modules under the ``tritonclient`` names so existing applications run
+unchanged against the TPU stack.
+
+    import tritonclient_tpu.compat as compat
+    compat.install()
+    import tritonclient.grpc as grpcclient   # -> tritonclient_tpu.grpc
+
+``tritonclient.utils.cuda_shared_memory`` maps to ``tpu_shared_memory``
+(same API shape, device buffers instead of cudaIpc) with a warning.
+Aliases are refused when a real tritonclient is already importable,
+unless force=True.
+"""
+
+import importlib
+import importlib.util
+import sys
+import warnings
+
+_ALIASES = {
+    "tritonclient": "tritonclient_tpu",
+    "tritonclient.grpc": "tritonclient_tpu.grpc",
+    "tritonclient.grpc.aio": "tritonclient_tpu.grpc.aio",
+    "tritonclient.grpc.auth": "tritonclient_tpu.grpc.auth",
+    "tritonclient.http": "tritonclient_tpu.http",
+    "tritonclient.http.aio": "tritonclient_tpu.http.aio",
+    "tritonclient.http.auth": "tritonclient_tpu.http.auth",
+    "tritonclient.utils": "tritonclient_tpu.utils",
+    "tritonclient.utils.shared_memory": "tritonclient_tpu.utils.shared_memory",
+    "tritonclient.utils.cuda_shared_memory": "tritonclient_tpu.utils.tpu_shared_memory",
+    "tritonclient.utils.tpu_shared_memory": "tritonclient_tpu.utils.tpu_shared_memory",
+    # Reference's own deprecated names, one hop further back.
+    "tritongrpcclient": "tritonclient_tpu.grpc",
+    "tritonhttpclient": "tritonclient_tpu.http",
+    "tritonclientutils": "tritonclient_tpu.utils",
+    "tritonshmutils": "tritonclient_tpu.utils",
+    "tritonshmutils.shared_memory": "tritonclient_tpu.utils.shared_memory",
+    "tritonshmutils.cuda_shared_memory": "tritonclient_tpu.utils.tpu_shared_memory",
+}
+
+
+def install(force: bool = False) -> None:
+    """Register the tritonclient.* aliases in sys.modules."""
+    if not force and "tritonclient" not in sys.modules:
+        try:
+            spec = importlib.util.find_spec("tritonclient")
+        except (ImportError, ValueError):
+            spec = None
+        if spec is not None:
+            raise RuntimeError(
+                "a real tritonclient package is installed; pass force=True "
+                "to shadow it with tritonclient_tpu"
+            )
+    for alias, target in _ALIASES.items():
+        if "cuda_shared_memory" in alias:
+            warnings.warn(
+                f"{alias} is served by tpu_shared_memory (PjRt device "
+                "buffers instead of cudaIpc)",
+                stacklevel=2,
+            )
+        module = importlib.import_module(target)
+        sys.modules[alias] = module
+        # `import a.b.c as x` resolves c as an attribute of a.b, so bind
+        # the child on the (aliased) parent module as well.
+        if "." in alias:
+            parent_alias, _, child = alias.rpartition(".")
+            parent = sys.modules.get(parent_alias)
+            if parent is not None:
+                setattr(parent, child, module)
+
+
+def uninstall() -> None:
+    for alias in _ALIASES:
+        sys.modules.pop(alias, None)
