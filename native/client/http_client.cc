@@ -8,6 +8,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <cerrno>
 #include <cstdio>
 #include <cstring>
 #include <sstream>
@@ -106,18 +107,36 @@ class HttpConnection {
           vstart == std::string::npos ? "" : line.substr(vstart);
     }
 
-    size_t content_length = 0;
-    auto it = response->headers.find("content-length");
-    if (it != response->headers.end()) {
-      content_length = static_cast<size_t>(std::stoull(it->second));
-    }
     response->body.assign(body_prefix.begin(), body_prefix.end());
-    while (response->body.size() < content_length) {
-      char buf[65536];
-      size_t want = std::min(sizeof(buf), content_length - response->body.size());
-      ssize_t n = recv(fd_, buf, want, 0);
-      if (n <= 0) return Error("socket read failed mid-body");
-      response->body.insert(response->body.end(), buf, buf + n);
+    auto te_it = response->headers.find("transfer-encoding");
+    std::string te_value =
+        te_it == response->headers.end() ? "" : te_it->second;
+    std::transform(te_value.begin(), te_value.end(), te_value.begin(),
+                   ::tolower);
+    if (te_value.find("chunked") != std::string::npos) {
+      Error err = ReadChunkedBody(&response->body);
+      if (!err.IsOk()) return err;
+    } else {
+      size_t content_length = 0;
+      auto it = response->headers.find("content-length");
+      if (it != response->headers.end()) {
+        char* end = nullptr;
+        errno = 0;
+        unsigned long long parsed = strtoull(it->second.c_str(), &end, 10);
+        if (end == it->second.c_str() || *end != '\0' || errno == ERANGE ||
+            it->second[0] == '-' || parsed > (1ULL << 40)) {
+          return Error("invalid Content-Length '" + it->second + "'");
+        }
+        content_length = static_cast<size_t>(parsed);
+      }
+      while (response->body.size() < content_length) {
+        char buf[65536];
+        size_t want =
+            std::min(sizeof(buf), content_length - response->body.size());
+        ssize_t n = recv(fd_, buf, want, 0);
+        if (n <= 0) return Error("socket read failed mid-body");
+        response->body.insert(response->body.end(), buf, buf + n);
+      }
     }
     auto conn_it = response->headers.find("connection");
     if (conn_it != response->headers.end() && conn_it->second == "close") {
@@ -127,6 +146,68 @@ class HttpConnection {
   }
 
  private:
+  // Decode a Transfer-Encoding: chunked body. On entry *body holds the raw
+  // (still-encoded) bytes already read past the headers; on success it holds
+  // the decoded payload.
+  Error ReadChunkedBody(std::vector<uint8_t>* body) {
+    std::string raw(body->begin(), body->end());
+    body->clear();
+    size_t pos = 0;
+    auto fill = [&](size_t want_total) -> Error {
+      while (raw.size() < want_total) {
+        char buf[65536];
+        ssize_t n = recv(fd_, buf, sizeof(buf), 0);
+        if (n <= 0) return Error("socket read failed mid-chunk");
+        raw.append(buf, static_cast<size_t>(n));
+      }
+      return Error::Success;
+    };
+    auto read_line = [&](std::string* line) -> Error {
+      size_t eol;
+      while ((eol = raw.find("\r\n", pos)) == std::string::npos) {
+        if (raw.size() - pos > (1 << 20)) return Error("oversized chunk line");
+        Error err = fill(raw.size() + 1);
+        if (!err.IsOk()) return err;
+      }
+      *line = raw.substr(pos, eol - pos);
+      pos = eol + 2;
+      return Error::Success;
+    };
+    // Sanity cap per chunk; a hostile/buggy size line must not drive
+    // overflowing pointer arithmetic or an unbounded recv loop.
+    constexpr unsigned long long kMaxChunk = 1ULL << 31;  // 2 GiB
+    while (true) {
+      std::string size_line;
+      Error err = read_line(&size_line);
+      if (!err.IsOk()) return err;
+      char* end = nullptr;
+      errno = 0;
+      unsigned long long chunk_len = strtoull(size_line.c_str(), &end, 16);
+      if (end == size_line.c_str() || errno == ERANGE ||
+          chunk_len > kMaxChunk || size_line[0] == '-') {
+        return Error("malformed chunk size '" + size_line + "'");
+      }
+      if (chunk_len == 0) break;
+      err = fill(pos + chunk_len + 2);
+      if (!err.IsOk()) return err;
+      body->insert(body->end(), raw.begin() + pos,
+                   raw.begin() + pos + chunk_len);
+      pos += chunk_len + 2;  // skip payload + trailing CRLF
+      // Drop the consumed prefix so peak memory stays ~one encoded chunk,
+      // not the whole encoded response alongside the decoded one.
+      raw.erase(0, pos);
+      pos = 0;
+    }
+    // Consume optional trailers up to the blank line.
+    while (true) {
+      std::string trailer;
+      Error err = read_line(&trailer);
+      if (!err.IsOk()) return err;
+      if (trailer.empty()) break;
+    }
+    return Error::Success;
+  }
+
   std::string host_;
   int port_;
   int fd_ = -1;
@@ -172,7 +253,12 @@ InferenceServerHttpClient::InferenceServerHttpClient(const std::string& url,
 }
 
 InferenceServerHttpClient::~InferenceServerHttpClient() {
-  exiting_ = true;
+  {
+    // exiting_ must flip under queue_mu_: otherwise the worker can evaluate
+    // the wait predicate (false), miss the notify, and sleep forever.
+    std::lock_guard<std::mutex> lk(queue_mu_);
+    exiting_ = true;
+  }
   queue_cv_.notify_all();
   if (worker_.joinable()) worker_.join();
 }
@@ -723,7 +809,11 @@ Error InferenceServerHttpClient::ParseInferResponse(
   if (outputs) {
     for (const auto& out_json : outputs->array()) {
       InferResult::Output output;
-      std::string name = out_json->Get("name")->AsString();
+      auto name_json = out_json->Get("name");
+      if (name_json == nullptr) {
+        return Error("malformed inference response: output missing 'name'");
+      }
+      std::string name = name_json->AsString();
       if (out_json->Get("datatype")) {
         output.datatype = out_json->Get("datatype")->AsString();
       }

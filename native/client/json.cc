@@ -44,9 +44,16 @@ void SerializeTo(const Value& v, std::string* out) {
       *out += v.AsBool() ? "true" : "false";
       break;
     case Type::kNumber: {
+      // Int-constructed values serialize via the exact int64 path — the
+      // double route would lose precision above 2^53 (large sequence_ids,
+      // INT64/UINT64 tensor data in JSON mode).
+      if (v.IsInt()) {
+        *out += std::to_string(v.AsInt());
+        break;
+      }
       double d = v.AsDouble();
       if (d == std::floor(d) && std::abs(d) < 1e15) {
-        *out += std::to_string(v.AsInt());
+        *out += std::to_string(static_cast<int64_t>(d));
       } else {
         char buf[32];
         snprintf(buf, sizeof(buf), "%.17g", d);

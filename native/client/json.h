@@ -44,6 +44,7 @@ class Value {
 
   Type type() const { return type_; }
   bool IsNull() const { return type_ == Type::kNull; }
+  bool IsInt() const { return is_int_; }
   bool AsBool() const { return bool_; }
   double AsDouble() const { return num_; }
   int64_t AsInt() const { return is_int_ ? int_ : static_cast<int64_t>(num_); }

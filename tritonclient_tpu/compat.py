@@ -46,16 +46,25 @@ _ALIASES = {
 
 def install(force: bool = False) -> None:
     """Register the tritonclient.* aliases in sys.modules."""
-    if not force and "tritonclient" not in sys.modules:
-        try:
-            spec = importlib.util.find_spec("tritonclient")
-        except (ImportError, ValueError):
-            spec = None
-        if spec is not None:
-            raise RuntimeError(
-                "a real tritonclient package is installed; pass force=True "
-                "to shadow it with tritonclient_tpu"
-            )
+    if not force:
+        existing = sys.modules.get("tritonclient")
+        if existing is not None:
+            # Already imported: refuse unless it is (an alias of) ourselves.
+            if getattr(existing, "__name__", "") != "tritonclient_tpu":
+                raise RuntimeError(
+                    "a real tritonclient package is already imported; pass "
+                    "force=True to shadow it with tritonclient_tpu"
+                )
+        else:
+            try:
+                spec = importlib.util.find_spec("tritonclient")
+            except (ImportError, ValueError):
+                spec = None
+            if spec is not None:
+                raise RuntimeError(
+                    "a real tritonclient package is installed; pass force=True "
+                    "to shadow it with tritonclient_tpu"
+                )
     for alias, target in _ALIASES.items():
         if "cuda_shared_memory" in alias:
             warnings.warn(
