@@ -86,3 +86,73 @@ def test_cli_json_output(server, capsys):
     assert rc == 0
     out = js.loads(capsys.readouterr().out)
     assert out[0]["concurrency"] == 1 and out[0]["count"] > 0
+
+
+def test_async_window_mode(server):
+    """--async equivalent: one client, sliding in-flight window, tpu shm."""
+    analyzer = _make(
+        server, shared_memory="tpu", streaming=True, async_window=True,
+        read_outputs=True,
+    )
+    summary = analyzer.measure(3).summary()
+    assert summary["errors"] == 0
+    assert summary["count"] > 0
+    assert summary["throughput_infer_per_sec"] > 0
+
+
+def test_async_window_requires_tpu_shm(server):
+    analyzer = _make(server, shared_memory="none", async_window=True)
+    with pytest.raises(ValueError, match="async window"):
+        analyzer.measure(2)
+
+
+def test_shm_read_outputs(server):
+    """read_outputs=True consumes outputs from the worker's region."""
+    analyzer = _make(server, shared_memory="tpu", read_outputs=True)
+    summary = analyzer.measure(2).summary()
+    assert summary["errors"] == 0 and summary["count"] > 0
+
+
+def test_prepared_request_reuse(server):
+    """prepare_request + async_stream_infer(prepared_request=...) round-trips."""
+    import queue
+
+    import tritonclient_tpu.grpc as grpcclient
+    import tritonclient_tpu.utils.tpu_shared_memory as tpushm
+
+    payload = np.arange(32, dtype=np.int32).reshape(2, 16)
+    client = grpcclient.InferenceServerClient(server.grpc_address)
+    in_region = tpushm.create_shared_memory_region("prep_in", 2 * payload.nbytes, 0)
+    out_region = tpushm.create_shared_memory_region("prep_out", payload.nbytes, 0)
+    try:
+        client.register_tpu_shared_memory(
+            "prep_in", tpushm.get_raw_handle(in_region), 0, 2 * payload.nbytes
+        )
+        client.register_tpu_shared_memory(
+            "prep_out", tpushm.get_raw_handle(out_region), 0, payload.nbytes
+        )
+        inputs = []
+        for idx, name in enumerate(("INPUT0", "INPUT1")):
+            inp = grpcclient.InferInput(name, [2, 16], "INT32")
+            inp.set_shared_memory("prep_in", payload.nbytes, idx * payload.nbytes)
+            inputs.append(inp)
+        out = grpcclient.InferRequestedOutput("OUTPUT0")
+        out.set_shared_memory("prep_out", payload.nbytes)
+        prepared = client.prepare_request("simple", inputs, outputs=[out])
+        done: "queue.Queue" = queue.Queue()
+        client.start_stream(callback=lambda result, error: done.put(error))
+        for i in range(3):
+            tpushm.set_shared_memory_region(in_region, [payload + i, payload])
+            client.async_stream_infer(prepared_request=prepared)
+            assert done.get(timeout=30) is None
+            got = tpushm.get_contents_as_numpy(out_region, "INT32", [2, 16])
+            np.testing.assert_array_equal(got, 2 * payload + i)
+        client.stop_stream()
+    finally:
+        try:
+            client.unregister_tpu_shared_memory("")
+        except Exception:
+            pass
+        tpushm.destroy_shared_memory_region(in_region)
+        tpushm.destroy_shared_memory_region(out_region)
+        client.close()

@@ -592,7 +592,7 @@ class InferenceServerClient(InferenceServerClientBase):
             self._stream.close(cancel_requests)
         self._stream = None
 
-    def async_stream_infer(
+    def prepare_request(
         self,
         model_name,
         inputs,
@@ -602,15 +602,20 @@ class InferenceServerClient(InferenceServerClientBase):
         sequence_id=0,
         sequence_start=False,
         sequence_end=False,
-        enable_empty_final_response=False,
         priority=0,
         timeout=None,
         parameters=None,
     ):
-        """Enqueue a request on the active stream (reference: grpc/_client.py:1815-1936)."""
-        if self._stream is None:
-            raise_error("stream not available, use start_stream() to make one available.")
-        request = _get_inference_request(
+        """Build a reusable ModelInferRequest proto.
+
+        The TPU-path analog of the reference C++ client's submessage reuse
+        (grpc_client.cc:1419 PreRunProcessing): with shared-memory inputs
+        the request metadata never changes between calls, so callers on a
+        hot loop can build once and pass the result to
+        ``async_stream_infer(prepared_request=...)``. Do not mutate the
+        referenced InferInput objects between uses.
+        """
+        return _get_inference_request(
             infer_inputs=inputs,
             model_name=model_name,
             model_version=model_version,
@@ -623,8 +628,50 @@ class InferenceServerClient(InferenceServerClientBase):
             timeout=timeout,
             parameters=parameters,
         )
-        if enable_empty_final_response:
-            request.parameters["triton_enable_empty_final_response"].bool_param = True
+
+    def async_stream_infer(
+        self,
+        model_name=None,
+        inputs=None,
+        model_version="",
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        enable_empty_final_response=False,
+        priority=0,
+        timeout=None,
+        parameters=None,
+        prepared_request=None,
+    ):
+        """Enqueue a request on the active stream (reference: grpc/_client.py:1815-1936).
+
+        ``prepared_request`` short-circuits proto construction with a request
+        built by :meth:`prepare_request` (hot-loop reuse).
+        """
+        if self._stream is None:
+            raise_error("stream not available, use start_stream() to make one available.")
+        if prepared_request is not None:
+            request = prepared_request
+        else:
+            if model_name is None or inputs is None:
+                raise_error("model_name and inputs are required without prepared_request")
+            request = _get_inference_request(
+                infer_inputs=inputs,
+                model_name=model_name,
+                model_version=model_version,
+                request_id=request_id,
+                outputs=outputs,
+                sequence_id=sequence_id,
+                sequence_start=sequence_start,
+                sequence_end=sequence_end,
+                priority=priority,
+                timeout=timeout,
+                parameters=parameters,
+            )
+            if enable_empty_final_response:
+                request.parameters["triton_enable_empty_final_response"].bool_param = True
         self._stream._enqueue_request(request)
         self._log("enqueued request to stream...")
 

@@ -246,9 +246,17 @@ class BertBaseModel(Model):
         self._fwd = fwd
 
     def infer(self, inputs, parameters=None):
-        tokens = jnp.asarray(np.asarray(inputs["INPUT_IDS"], dtype=np.int32))
+        x = inputs["INPUT_IDS"]
+        if isinstance(x, jax.Array):
+            # Zero-copy path (tpu shm): the tokens are already on device —
+            # a host round-trip here would cost two tunnel RPCs per request.
+            tokens = x if x.dtype == jnp.int32 else x.astype(jnp.int32)
+        else:
+            tokens = jnp.asarray(np.asarray(x, dtype=np.int32))
         out = self._fwd(self._params, tokens)
-        return {"POOLED_OUTPUT": np.asarray(out)}
+        # Return the device array un-materialized; the response path parks it
+        # in a tpu shm region zero-copy or serializes it for the wire.
+        return {"POOLED_OUTPUT": out}
 
     def warmup(self):
         z = jnp.zeros((1, 128), jnp.int32)

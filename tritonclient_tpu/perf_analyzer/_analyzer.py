@@ -86,6 +86,7 @@ class _Worker:
         a = self.analyzer
         self._client = a.make_client()
         self._inputs = {}
+        self._static_inputs = None
         mode = a.shared_memory
         if mode == "none":
             return
@@ -132,6 +133,41 @@ class _Worker:
                     f"pa_out_{self.wid}", tpushm.get_raw_handle(self._out_region),
                     a.device_id, total_out,
                 )
+        self._finish_setup()
+
+    def _finish_setup(self):
+        """Prebuild static shm-referencing inputs when sizes are fixed.
+
+        With non-BYTES inputs the (region, size, offset) triple never changes
+        between requests, so the InferInput objects — and in streaming mode
+        the whole request proto — are built once per worker.
+        """
+        a = self.analyzer
+        if a.shared_memory == "none" or any(
+            dt == "BYTES" for dt, _ in a.input_specs.values()
+        ):
+            return
+        offset = 0
+        inputs = []
+        for name, (dt, shape) in a.input_specs.items():
+            nbytes = int(np.prod(shape)) * np.dtype(
+                triton_to_np_dtype(dt)
+            ).itemsize
+            inp = a.infer_input_cls(name, shape, dt)
+            inp.set_shared_memory(f"pa_in_{self.wid}", nbytes, offset)
+            offset += nbytes
+            inputs.append(inp)
+        self._static_inputs = inputs
+
+    def _write_region(self, payloads):
+        a = self.analyzer
+        arrays = [payloads[name] for name in a.input_specs]
+        if a.shared_memory == "system":
+            self._shm.set_shared_memory_region(self._in_region, arrays)
+        else:
+            self._tpushm.set_shared_memory_region(
+                self._in_region, arrays, block=False
+            )
 
     def _region_nbytes(self, name: str) -> int:
         dt, shape = self.analyzer.input_specs[name]
@@ -181,6 +217,9 @@ class _Worker:
 
     def _build_inputs(self, payloads):
         a = self.analyzer
+        if self._static_inputs is not None:
+            self._write_region(payloads)
+            return self._static_inputs
         InferInput = a.infer_input_cls
         inputs = []
         if a.shared_memory == "none":
@@ -205,7 +244,13 @@ class _Worker:
         if a.shared_memory == "system":
             self._shm.set_shared_memory_region(self._in_region, arrays)
         else:
-            self._tpushm.set_shared_memory_region(self._in_region, arrays)
+            # Non-blocking upload: the co-located server's consumers are
+            # ordered after the dispatched h2d by the PjRt runtime, so the
+            # worker pays exactly one blocking device wait per request (the
+            # output readback) — symmetric with the in-process baseline.
+            self._tpushm.set_shared_memory_region(
+                self._in_region, arrays, block=False
+            )
         for name, (dt, shape) in a.input_specs.items():
             inp = InferInput(name, shape, dt)
             inp.set_shared_memory(
@@ -228,6 +273,33 @@ class _Worker:
                 offset += size
             outs.append(out)
         return outs
+
+    def _consume_outputs(self, result):
+        """Materialize outputs the way a real consumer would.
+
+        Wire mode decodes the returned tensors; shm mode reads this worker's
+        output region (for tpu regions this is the device->host readback that
+        waits on the possibly-still-computing parked result).
+        """
+        a = self.analyzer
+        if not a.output_names:
+            return
+        if a.shared_memory != "none" and a.output_sizes and a.output_specs:
+            offset = 0
+            for name in a.output_names:
+                datatype, shape = a.output_specs[name]
+                if a.shared_memory == "system":
+                    self._shm.get_contents_as_numpy(
+                        self._out_region, datatype, shape, offset
+                    )
+                else:
+                    self._tpushm.get_contents_as_numpy(
+                        self._out_region, datatype, shape, offset
+                    )
+                offset += a.output_sizes[name]
+        elif result is not None:
+            for name in a.output_names:
+                result.as_numpy(name)
 
     # -- loops ---------------------------------------------------------------
 
@@ -254,9 +326,8 @@ class _Worker:
                     a.model_name, inputs, outputs=outputs
                 )
                 timers.capture("recv_start")
-                if a.read_outputs and a.output_names:
-                    for name in a.output_names:
-                        result.as_numpy(name)
+                if a.read_outputs:
+                    self._consume_outputs(result)
                 timers.capture("recv_end")
             except Exception:
                 self.errors += 1
@@ -275,6 +346,13 @@ class _Worker:
             callback=lambda result, error: done.put((result, error))
         )
         outputs = self._build_outputs()
+        prepared = None
+        if self._static_inputs is not None:
+            # Proto built once; only the region contents change per request
+            # (C++ submessage-reuse parity, grpc_client.cc:1419).
+            prepared = self._client.prepare_request(
+                a.model_name, self._static_inputs, outputs=outputs
+            )
         i = 0
         try:
             while time.perf_counter() < end_time and not self._stop.is_set():
@@ -284,17 +362,25 @@ class _Worker:
                 timers.capture("request_start")
                 try:
                     timers.capture("send_start")
-                    inputs = self._build_inputs(payloads)
-                    timers.capture("send_end")
-                    self._client.async_stream_infer(
-                        a.model_name, inputs, outputs=outputs
-                    )
+                    if prepared is not None:
+                        self._write_region(payloads)
+                        timers.capture("send_end")
+                        self._client.async_stream_infer(prepared_request=prepared)
+                    else:
+                        inputs = self._build_inputs(payloads)
+                        timers.capture("send_end")
+                        self._client.async_stream_infer(
+                            a.model_name, inputs, outputs=outputs
+                        )
                     timers.capture("recv_start")
                     result, error = done.get(timeout=120)
-                    timers.capture("recv_end")
                     if error is not None:
+                        timers.capture("recv_end")
                         self.errors += 1
                         continue
+                    if a.read_outputs:
+                        self._consume_outputs(result)
+                    timers.capture("recv_end")
                 except Exception:
                     self.errors += 1
                     continue
@@ -303,6 +389,236 @@ class _Worker:
                 self.latencies.append(timers.total_ns)
         finally:
             self._client.stop_stream()
+
+
+class _WindowWorker:
+    """Async request mode (reference perf_analyzer ``--async``): ONE client
+    holds ``concurrency`` requests in flight over a sliding window.
+
+    Each in-flight slot owns a fixed offset range inside a single pair of
+    shm regions, and its request objects are prebuilt once — per-request
+    work is set-slot, stream-write, readback. Compared to N closed-loop
+    worker threads this runs ~6 threads instead of ~3N, which matters when
+    the host has few cores and the device is latency-bound.
+    """
+
+    def __init__(self, analyzer: "PerfAnalyzer", slots: int):
+        self.analyzer = analyzer
+        self.slots = slots
+        self.stat = InferStat()
+        self.latencies: List[int] = []
+        self.errors = 0
+        # Completions run on a pool; stat/latency/error updates need a lock
+        # (unlike the closed-loop _Worker, which owns its counters).
+        self._record_lock = threading.Lock()
+        self._client = None
+        rng = np.random.default_rng(1234)
+        self.payload_sets = [
+            {
+                name: _make_payload(rng, dt, shape)
+                for name, (dt, shape) in analyzer.input_specs.items()
+            }
+            for _ in range(max(_RANDOM_POOL, slots))
+        ]
+
+    def setup(self):
+        a = self.analyzer
+        if a.shared_memory != "tpu" or not a.output_sizes:
+            raise ValueError(
+                "async window mode requires --shared-memory=tpu with "
+                "static output shapes"
+            )
+        for dt, _ in a.input_specs.values():
+            if dt == "BYTES":
+                raise ValueError("async window mode does not support BYTES inputs")
+        import tritonclient_tpu.utils.tpu_shared_memory as tpushm
+
+        self._tpushm = tpushm
+        self._client = a.make_client()
+        self._in_slot = sum(
+            int(np.prod(shape)) * np.dtype(triton_to_np_dtype(dt)).itemsize
+            for dt, shape in a.input_specs.values()
+        )
+        self._out_slot = sum(a.output_sizes.values())
+        self._in_region = tpushm.create_shared_memory_region(
+            f"pa_win_in_{a.run_id}", self._in_slot * self.slots, a.device_id
+        )
+        self._out_region = tpushm.create_shared_memory_region(
+            f"pa_win_out_{a.run_id}", self._out_slot * self.slots, a.device_id
+        )
+        self._client.register_tpu_shared_memory(
+            f"pa_win_in_{a.run_id}", tpushm.get_raw_handle(self._in_region),
+            a.device_id, self._in_slot * self.slots,
+        )
+        self._client.register_tpu_shared_memory(
+            f"pa_win_out_{a.run_id}", tpushm.get_raw_handle(self._out_region),
+            a.device_id, self._out_slot * self.slots,
+        )
+        # Prebuild per-slot inputs/outputs: in shm mode the request metadata
+        # never changes between requests (the reference's C++ client reuses
+        # proto submessages the same way, grpc_client.cc:1419).
+        self._slot_inputs, self._slot_outputs = [], []
+        for s in range(self.slots):
+            base = s * self._in_slot
+            inputs = []
+            for name, (dt, shape) in a.input_specs.items():
+                nbytes = int(np.prod(shape)) * np.dtype(
+                    triton_to_np_dtype(dt)
+                ).itemsize
+                inp = a.infer_input_cls(name, shape, dt)
+                inp.set_shared_memory(f"pa_win_in_{a.run_id}", nbytes, base)
+                base += nbytes
+                inputs.append(inp)
+            self._slot_inputs.append(inputs)
+            obase = s * self._out_slot
+            outs = []
+            for name in a.output_names:
+                out = a.requested_output_cls(name)
+                out.set_shared_memory(
+                    f"pa_win_out_{a.run_id}", a.output_sizes[name], obase
+                )
+                obase += a.output_sizes[name]
+                outs.append(out)
+            self._slot_outputs.append(outs)
+
+    def teardown(self):
+        a = self.analyzer
+
+        def attempt(fn, *args):
+            try:
+                fn(*args)
+            except Exception:
+                pass
+
+        if self._client is not None:
+            attempt(self._client.unregister_tpu_shared_memory,
+                    f"pa_win_in_{a.run_id}")
+            attempt(self._client.unregister_tpu_shared_memory,
+                    f"pa_win_out_{a.run_id}")
+        if hasattr(self, "_in_region"):
+            attempt(self._tpushm.destroy_shared_memory_region, self._in_region)
+        if hasattr(self, "_out_region"):
+            attempt(self._tpushm.destroy_shared_memory_region, self._out_region)
+        if self._client is not None:
+            a.close_client(self._client)
+
+    def _set_slot(self, slot: int, payloads):
+        a = self.analyzer
+        offset = slot * self._in_slot
+        arrays = [payloads[name] for name in a.input_specs]
+        self._tpushm.set_shared_memory_region(
+            self._in_region, arrays, offset, block=False
+        )
+
+    def _read_slot(self, slot: int):
+        a = self.analyzer
+        offset = slot * self._out_slot
+        for name in a.output_names:
+            dt, shape = a.output_specs[name]
+            self._tpushm.get_contents_as_numpy(self._out_region, dt, shape, offset)
+            offset += a.output_sizes[name]
+
+    def run(self, end_time: float):
+        import collections
+        import queue
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        a = self.analyzer
+        done: "queue.Queue" = queue.Queue()
+        inflight_order: "collections.deque" = collections.deque()
+        lock = threading.Lock()
+        timers_by_slot: Dict[int, RequestTimers] = {}
+        outstanding = [0]
+        finished = threading.Event()
+        seq = [0]
+
+        def submit(slot: int):
+            # Raises on failure; the caller owns the `outstanding` count.
+            timers = RequestTimers()
+            timers.capture("request_start")
+            timers.capture("send_start")
+            self._set_slot(slot, self.payload_sets[seq[0] % len(self.payload_sets)])
+            seq[0] += 1
+            timers.capture("send_end")
+            timers_by_slot[slot] = timers
+            if a.streaming:
+                # Slot-order bookkeeping and the stream write must be one
+                # atomic step: bidi responses arrive in write order, and the
+                # reader pairs them by popping this deque.
+                with lock:
+                    inflight_order.append(slot)
+                    try:
+                        self._client.async_stream_infer(
+                            a.model_name,
+                            self._slot_inputs[slot],
+                            outputs=self._slot_outputs[slot],
+                        )
+                    except Exception:
+                        inflight_order.pop()
+                        raise
+            else:
+                self._client.async_infer(
+                    a.model_name,
+                    self._slot_inputs[slot],
+                    lambda result, error, s=slot: done.put((s, error)),
+                    outputs=self._slot_outputs[slot],
+                )
+
+        def retire():
+            # Exactly one call per in-flight request that will not resubmit.
+            with lock:
+                outstanding[0] -= 1
+                if outstanding[0] == 0:
+                    finished.set()
+
+        def on_stream(result, error):
+            with lock:
+                slot = inflight_order.popleft()
+            done.put((slot, error))
+
+        def finish(slot: int, error):
+            timers = timers_by_slot.pop(slot)
+            if error is not None:
+                with self._record_lock:
+                    self.errors += 1
+            else:
+                timers.capture("recv_start")
+                if a.read_outputs:
+                    self._read_slot(slot)
+                timers.capture("recv_end")
+                timers.capture("request_end")
+                with self._record_lock:
+                    self.stat.update(timers)
+                    self.latencies.append(timers.total_ns)
+            if time.perf_counter() < end_time:
+                try:
+                    submit(slot)
+                    return  # still in flight; outstanding unchanged
+                except Exception:
+                    with self._record_lock:
+                        self.errors += 1
+            retire()
+
+        if a.streaming:
+            self._client.start_stream(callback=on_stream)
+        try:
+            for s in range(self.slots):
+                submit(s)
+                with lock:
+                    outstanding[0] += 1
+            if outstanding[0] == 0:
+                return
+            with ThreadPoolExecutor(max_workers=min(self.slots, 16)) as pool:
+                while not finished.is_set():
+                    try:
+                        slot, error = done.get(timeout=1.0)
+                    except queue.Empty:
+                        continue
+                    pool.submit(finish, slot, error)
+        finally:
+            if a.streaming:
+                self._client.stop_stream()
 
 
 class PerfAnalyzer:
@@ -316,6 +632,7 @@ class PerfAnalyzer:
         batch_size: int = 1,
         shared_memory: str = "none",
         streaming: bool = False,
+        async_window: bool = False,
         measurement_interval_s: float = 5.0,
         warmup_s: float = 1.0,
         shape_overrides: Optional[Dict[str, int]] = None,
@@ -329,8 +646,11 @@ class PerfAnalyzer:
             raise ValueError("protocol must be grpc or http")
         if streaming and protocol != "grpc":
             raise ValueError("--streaming requires grpc")
+        if async_window and protocol != "grpc":
+            raise ValueError("--async (window mode) requires grpc")
         if shared_memory not in ("none", "system", "tpu"):
             raise ValueError("shared_memory must be none|system|tpu")
+        self.async_window = async_window
         self.url = url
         self.model_name = model_name
         self.protocol = protocol
@@ -380,27 +700,35 @@ class PerfAnalyzer:
         }
         meta_outputs = [t["name"] for t in meta.get("outputs", [])]
         self.output_names = output_names if output_names is not None else meta_outputs
+        # Output shapes from metadata, when static (None otherwise). Kept
+        # independent of output_sizes so region readback works with
+        # explicitly-passed sizes too.
+        specs: Optional[Dict[str, tuple]] = {}
+        for t in meta.get("outputs", []):
+            if t["name"] not in self.output_names:
+                continue
+            shape = [int(s) for s in t["shape"]]
+            shape = [batch_size if s < 0 else s for s in shape[:1]] + [
+                s for s in shape[1:]
+            ]
+            if any(s < 0 for s in shape) or t["datatype"] == "BYTES":
+                specs = None
+                break
+            specs[t["name"]] = (t["datatype"], shape)
+        self.output_specs = specs
         self.output_sizes = output_sizes
         if shared_memory != "none" and self.output_names and not output_sizes:
-            # Infer fixed output sizes from metadata when static.
-            sizes = {}
-            for t in meta.get("outputs", []):
-                if t["name"] not in self.output_names:
-                    continue
-                shape = [int(s) for s in t["shape"]]
-                shape = [batch_size if s < 0 else s for s in shape[:1]] + [
-                    s for s in shape[1:]
-                ]
-                if any(s < 0 for s in shape) or t["datatype"] == "BYTES":
-                    sizes = None
-                    break
-                sizes[t["name"]] = int(np.prod(shape)) * np.dtype(
-                    triton_to_np_dtype(t["datatype"])
-                ).itemsize
-            self.output_sizes = sizes
-            if sizes is None:
-                # Dynamic outputs: fall back to wire-returned outputs.
-                self.output_sizes = None
+            # Infer fixed output sizes from the static shapes; dynamic
+            # outputs fall back to wire-returned outputs (None).
+            self.output_sizes = (
+                {
+                    name: int(np.prod(shape))
+                    * np.dtype(triton_to_np_dtype(dt)).itemsize
+                    for name, (dt, shape) in specs.items()
+                }
+                if specs
+                else None
+            )
 
     def make_client(self):
         if self.protocol == "grpc":
@@ -416,6 +744,8 @@ class PerfAnalyzer:
     # -- measurement ---------------------------------------------------------
 
     def measure(self, concurrency: int) -> MeasurementWindow:
+        if self.async_window:
+            return self._measure_window(concurrency)
         workers = [_Worker(self, w) for w in range(concurrency)]
         started = []
         try:
@@ -460,6 +790,39 @@ class PerfAnalyzer:
                     w.teardown()
                 except Exception:  # cleanup must reach every worker
                     pass
+
+    def _measure_window(self, concurrency: int) -> MeasurementWindow:
+        worker = _WindowWorker(self, concurrency)
+        try:
+            worker.setup()
+            end = time.perf_counter() + self.warmup_s + self.measurement_interval_s
+            thread = threading.Thread(target=worker.run, args=(end,), daemon=True)
+            window_start = time.perf_counter() + self.warmup_s
+            thread.start()
+            time.sleep(self.warmup_s)
+            with worker._record_lock:
+                worker.latencies.clear()
+                worker.stat = InferStat()
+                worker.errors = 0
+            thread.join()
+            duration = time.perf_counter() - window_start
+            window = MeasurementWindow(concurrency=concurrency, duration_s=duration)
+            window.latencies_ns.extend(worker.latencies)
+            window.errors += worker.errors
+            window.stat.completed_request_count += worker.stat.completed_request_count
+            window.stat.cumulative_total_request_time_ns += (
+                worker.stat.cumulative_total_request_time_ns
+            )
+            window.stat.cumulative_send_time_ns += worker.stat.cumulative_send_time_ns
+            window.stat.cumulative_receive_time_ns += (
+                worker.stat.cumulative_receive_time_ns
+            )
+            return window
+        finally:
+            try:
+                worker.teardown()
+            except Exception:
+                pass
 
     def sweep(self, start: int, end: int, step: int = 1) -> List[Dict]:
         if step < 1:

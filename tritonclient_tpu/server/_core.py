@@ -151,6 +151,10 @@ class SystemShmRegistry:
                 "mmap": mm,
             }
 
+    def __contains__(self, name: str) -> bool:
+        # GIL-atomic dict membership; safe without the lock on the hot path.
+        return name in self._regions
+
     def unregister(self, name: Optional[str]):
         with self._lock:
             names = [name] if name else list(self._regions)
@@ -228,6 +232,10 @@ class TpuShmRegistry:
                 "region": region,
             }
 
+    def __contains__(self, name: str) -> bool:
+        # GIL-atomic dict membership; safe without the lock on the hot path.
+        return name in self._regions
+
     def unregister(self, name: Optional[str]):
         with self._lock:
             if name:
@@ -263,8 +271,16 @@ class TpuShmRegistry:
         return self.get_region(name).as_array(datatype, shape, offset)
 
     def write_array(self, name: str, array, offset: int):
-        """Zero-copy typed write: park a jax.Array in the region."""
-        self.get_region(name).set_array(array, offset)
+        """Zero-copy typed write: park a jax.Array in the region.
+
+        Non-blocking (``block=False``): the parked array may still be
+        computing when the response goes out — readers block only when they
+        materialize it, so request handling never serializes on the device.
+        This is the XLA-async equivalent of the reference's output-donation
+        goal (SURVEY.md §7 hard part 2): the region table repoints at the
+        result buffer, no copy and no sync on the response path.
+        """
+        self.get_region(name).set_array(array, offset, block=False)
 
 
 # --------------------------------------------------------------------------- #
@@ -502,10 +518,13 @@ class InferenceCore:
         raise CoreError(f"Unsupported shared memory kind: '{kind}'", 400)
 
     def find_shm_kind(self, region: str) -> str:
-        """Which registry holds a region name (system first, then tpu)."""
-        if self.system_shm.status(region):
+        """Which registry holds a region name (system first, then tpu).
+
+        Hot path (runs per shm-routed tensor): lock-free membership checks.
+        """
+        if region in self.system_shm:
             return "system"
-        if self.tpu_shm.status(region):
+        if region in self.tpu_shm:
             return "tpu"
         return "system"
 
@@ -651,14 +670,18 @@ class InferenceCore:
                 if datatype is None or datatype == "BYTES":
                     from tritonclient_tpu.utils import np_to_triton_dtype
 
-                    datatype = np_to_triton_dtype(np.asarray(array).dtype)
+                    # .dtype is metadata — np.asarray here would force a
+                    # device->host transfer for jax outputs.
+                    datatype = np_to_triton_dtype(np.dtype(array.dtype))
 
-            shape = list(np.asarray(array).shape)
+            # shape/nbytes come from the array's metadata — np.asarray on a
+            # jax.Array would force a device->host transfer per response.
+            shape = list(array.shape)
             if req is not None and req.shm_region is not None:
                 registry = self.shm_registry(req.shm_kind or "system")
                 if req.shm_kind == "tpu" and datatype != "BYTES":
                     registry.write_array(req.shm_region, array, req.shm_offset)
-                    nbytes = np.asarray(array).nbytes
+                    nbytes = array.nbytes
                 else:
                     raw = self._encode_raw(datatype, np.asarray(array))
                     nbytes = len(raw)

@@ -447,8 +447,11 @@ class GRPCFrontend:
         core: InferenceCore,
         host: str = "127.0.0.1",
         port: int = 0,
-        max_workers: int = 16,
+        max_workers: int = 80,
     ):
+        # Each long-lived bidi stream pins one pool thread for its whole
+        # lifetime, so the pool must exceed the expected stream count or
+        # every other RPC (and further streams) starves behind them.
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
             options=[

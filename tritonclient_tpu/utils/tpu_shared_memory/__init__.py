@@ -116,11 +116,23 @@ class TpuSharedMemoryRegion:
 
     # -- typed (zero-copy) plane --------------------------------------------
 
-    def set_array(self, array, offset: int = 0):
-        """Park a device array at ``offset`` (the zero-copy set path)."""
+    def set_array(self, array, offset: int = 0, block: bool = True):
+        """Park a device array at ``offset`` (the zero-copy set path).
+
+        ``block=True`` (the client-facing default) commits the transfer
+        before returning — the JAX analog of the reference's per-device
+        stream sync at region-set boundaries. The server's output path
+        passes ``block=False``: parking only repoints the region table at
+        the (possibly still-computing) result buffer, and readers block
+        when they materialize it.
+        """
         jax = _jax()
-        arr = jax.device_put(array, self.device)
-        jax.block_until_ready(arr)  # region-set boundary == stream sync
+        if isinstance(array, jax.Array) and array.devices() == {self.device}:
+            arr = array  # already resident — parking is pure bookkeeping
+        else:
+            arr = jax.device_put(array, self.device)
+        if block:
+            jax.block_until_ready(arr)
         self._check_range(offset, arr.nbytes)
         with self._lock:
             self._drop_overlapping(offset, arr.nbytes)
@@ -218,9 +230,17 @@ def _resolve_raw_handle(raw_handle) -> Optional[TpuSharedMemoryRegion]:
 
 
 def set_shared_memory_region(
-    shm_handle: TpuSharedMemoryRegion, input_values, offset: int = 0
+    shm_handle: TpuSharedMemoryRegion, input_values, offset: int = 0,
+    block: bool = True,
 ):
-    """Copy numpy arrays into the region (host -> device transfer)."""
+    """Copy numpy arrays into the region (host -> device transfer).
+
+    ``block=False`` returns once the upload is *dispatched* rather than
+    committed. Within one process the PjRt runtime orders consumers after
+    the upload automatically, so a co-located server sees the data; the
+    blocking default matches the reference's stream-sync-at-set contract
+    for callers that share the region out-of-band.
+    """
     if not isinstance(input_values, (list, tuple)):
         raise TpuSharedMemoryException(
             "input_values must be a list of arrays"
@@ -240,7 +260,7 @@ def set_shared_memory_region(
             cursor += len(data)
         else:
             arr = np.ascontiguousarray(arr)
-            shm_handle.set_array(arr, cursor)
+            shm_handle.set_array(arr, cursor, block=block)
             cursor += arr.nbytes
 
 
