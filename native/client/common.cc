@@ -1,5 +1,7 @@
 #include "common.h"
 
+#include <cstdlib>
+
 namespace tputriton {
 
 const Error Error::Success = Error();
@@ -64,6 +66,23 @@ std::vector<std::string> InferResult::OutputNames() const {
   std::vector<std::string> names;
   for (const auto& kv : outputs_) names.push_back(kv.first);
   return names;
+}
+
+Error ParseHostPort(const std::string& url, int default_port,
+                    std::string* host, int* port) {
+  if (url.find("://") != std::string::npos) {
+    return Error("url should not include the scheme (got '" + url + "')");
+  }
+  size_t colon = url.rfind(':');
+  if (colon == std::string::npos) {
+    *host = url;
+    *port = default_port;
+  } else {
+    *host = url.substr(0, colon);
+    *port = std::atoi(url.c_str() + colon + 1);
+  }
+  if (host->empty()) return Error("empty host in url '" + url + "'");
+  return Error::Success;
 }
 
 }  // namespace tputriton

@@ -32,6 +32,11 @@ class Error {
   std::string msg_;
 };
 
+// Split "host:port" (no scheme) into parts; shared by the HTTP and gRPC
+// transports so the parse stays consistent.
+Error ParseHostPort(const std::string& url, int default_port,
+                    std::string* host, int* port);
+
 struct InferOptions {
   explicit InferOptions(const std::string& model_name)
       : model_name_(model_name) {}
@@ -179,6 +184,9 @@ class InferResult {
     return outputs_.count(name) > 0;
   }
   std::vector<std::string> OutputNames() const;
+  // Decoupled streaming: true on the empty final response marker
+  // (reference IsFinalResponse, common.h:539).
+  bool IsFinalResponse() const { return final_response_; }
 
   struct Output {
     std::string datatype;
@@ -191,6 +199,7 @@ class InferResult {
   std::string model_name_;
   std::string model_version_;
   std::string id_;
+  bool final_response_ = false;
 };
 
 // Six-point ns timestamps around one request (reference common.h:568-652).

@@ -1,0 +1,874 @@
+#include "grpc_client.h"
+
+#include <cstdlib>
+#include <cstring>
+
+namespace tputriton {
+
+namespace {
+
+constexpr const char* kServicePrefix = "/inference.GRPCInferenceService/";
+
+// ---------------------------------------------------------------------------
+// channel (connection) cache with share-count sharding — same contract as
+// the reference's GetStub (grpc_client.cc:81-140): up to N clients share one
+// connection per URL, N from TRITON_CLIENT_GRPC_CHANNEL_MAX_SHARE_COUNT
+// (default 6); the N+1-th client starts a fresh connection.
+// ---------------------------------------------------------------------------
+
+struct ChannelEntry {
+  std::shared_ptr<h2::Connection> conn;
+  int share_count = 0;
+};
+
+std::mutex& ChannelMapMu() {
+  static std::mutex mu;
+  return mu;
+}
+
+std::map<std::string, ChannelEntry>& ChannelMap() {
+  static std::map<std::string, ChannelEntry> m;
+  return m;
+}
+
+int MaxShareCount() {
+  const char* env = std::getenv("TRITON_CLIENT_GRPC_CHANNEL_MAX_SHARE_COUNT");
+  if (env != nullptr) {
+    int v = std::atoi(env);
+    return v >= 1 ? v : 1;
+  }
+  return 6;
+}
+
+Error GetConnection(const std::string& url,
+                    std::shared_ptr<h2::Connection>* conn) {
+  std::string host;
+  int port;
+  Error parse_err = ParseHostPort(url, 8001, &host, &port);
+  if (!parse_err.IsOk()) return parse_err;
+
+  std::lock_guard<std::mutex> lk(ChannelMapMu());
+  auto& entry = ChannelMap()[url];
+  if (entry.conn != nullptr && entry.conn->Connected() &&
+      entry.share_count < MaxShareCount()) {
+    entry.share_count++;
+    *conn = entry.conn;
+    return Error::Success;
+  }
+  auto fresh = std::make_shared<h2::Connection>();
+  Error err = fresh->Connect(host, port);
+  if (!err.IsOk()) return err;
+  entry.conn = fresh;
+  entry.share_count = 1;
+  *conn = fresh;
+  return Error::Success;
+}
+
+h2::Headers GrpcRequestHeaders() {
+  return {
+      {"te", "trailers"},
+      {"content-type", "application/grpc"},
+      {"grpc-accept-encoding", "identity"},
+      {"user-agent", "tritonclient-tpu-c++/2.0"},
+  };
+}
+
+void FrameMessage(const google::protobuf::MessageLite& msg, std::string* out) {
+  std::string body;
+  msg.SerializeToString(&body);
+  out->clear();
+  out->reserve(body.size() + 5);
+  out->push_back(0);  // not compressed
+  uint32_t len = static_cast<uint32_t>(body.size());
+  out->push_back(static_cast<char>((len >> 24) & 0xFF));
+  out->push_back(static_cast<char>((len >> 16) & 0xFF));
+  out->push_back(static_cast<char>((len >> 8) & 0xFF));
+  out->push_back(static_cast<char>(len & 0xFF));
+  out->append(body);
+}
+
+// gRPC spec limits grpc-timeout to 8 digits; downshift units to fit.
+std::string GrpcTimeoutValue(uint64_t us) {
+  if (us < 100000000ULL) return std::to_string(us) + "u";
+  uint64_t ms = us / 1000;
+  if (ms < 100000000ULL) return std::to_string(ms) + "m";
+  uint64_t s = us / 1000000;
+  if (s >= 100000000ULL) s = 99999999ULL;
+  return std::to_string(s) + "S";
+}
+
+std::string PercentDecode(const std::string& s) {
+  std::string out;
+  for (size_t i = 0; i < s.size(); i++) {
+    if (s[i] == '%' && i + 2 < s.size()) {
+      char hex[3] = {s[i + 1], s[i + 2], 0};
+      out.push_back(static_cast<char>(strtol(hex, nullptr, 16)));
+      i += 2;
+    } else {
+      out.push_back(s[i]);
+    }
+  }
+  return out;
+}
+
+// grpc-status / grpc-message live in the trailers (or in the headers for a
+// trailers-only response).
+Error GrpcStatus(const h2::Headers& headers, const h2::Headers& trailers) {
+  std::string status, message;
+  for (const auto* hs : {&trailers, &headers}) {
+    for (const auto& kv : *hs) {
+      if (kv.first == "grpc-status" && status.empty()) status = kv.second;
+      if (kv.first == "grpc-message" && message.empty()) message = kv.second;
+    }
+    if (!status.empty()) break;
+  }
+  if (status.empty()) return Error("no grpc-status in response");
+  if (status == "0") return Error::Success;
+  return Error(message.empty() ? "grpc error status " + status
+                               : PercentDecode(message));
+}
+
+// Pull one length-prefixed gRPC message off a stream. Returns false on
+// timeout or closure-without-message (err distinguishes).
+bool ReadMessage(h2::Connection* conn, int32_t stream_id, int64_t timeout_ms,
+                 std::string* msg, Error* err) {
+  std::string prefix;
+  if (!conn->WaitData(stream_id, 5, timeout_ms, &prefix)) {
+    *err = Error::Success;  // no message (closed or timeout)
+    return false;
+  }
+  if (prefix.size() < 5) {
+    *err = Error::Success;
+    return false;
+  }
+  if (prefix[0] != 0) {
+    *err = Error("compressed gRPC messages are not supported");
+    return false;
+  }
+  uint32_t len = (static_cast<uint8_t>(prefix[1]) << 24) |
+                 (static_cast<uint8_t>(prefix[2]) << 16) |
+                 (static_cast<uint8_t>(prefix[3]) << 8) |
+                 static_cast<uint8_t>(prefix[4]);
+  if (!conn->WaitData(stream_id, len, timeout_ms, msg) ||
+      msg->size() < len) {
+    *err = Error("truncated gRPC message");
+    return false;
+  }
+  *err = Error::Success;
+  return true;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// lifecycle
+// ---------------------------------------------------------------------------
+
+Error InferenceServerGrpcClient::Create(
+    std::unique_ptr<InferenceServerGrpcClient>* client, const std::string& url,
+    bool verbose) {
+  std::shared_ptr<h2::Connection> conn;
+  Error err = GetConnection(url, &conn);
+  if (!err.IsOk()) return err;
+  client->reset(new InferenceServerGrpcClient(conn, verbose));
+  return Error::Success;
+}
+
+InferenceServerGrpcClient::InferenceServerGrpcClient(
+    std::shared_ptr<h2::Connection> conn, bool verbose)
+    : conn_(std::move(conn)), verbose_(verbose) {
+  cq_worker_ = std::thread(&InferenceServerGrpcClient::CompletionWorker, this);
+}
+
+InferenceServerGrpcClient::~InferenceServerGrpcClient() {
+  StopStream();
+  {
+    std::lock_guard<std::mutex> lk(cq_mu_);
+    exiting_ = true;
+  }
+  cq_cv_.notify_all();
+  if (cq_worker_.joinable()) cq_worker_.join();
+}
+
+// ---------------------------------------------------------------------------
+// unary calls
+// ---------------------------------------------------------------------------
+
+Error InferenceServerGrpcClient::Call(
+    const std::string& method, const google::protobuf::MessageLite& request,
+    google::protobuf::MessageLite* response, uint64_t timeout_us) {
+  int64_t timeout_ms =
+      timeout_us == 0 ? 60000 : static_cast<int64_t>(timeout_us / 1000);
+  std::string framed;
+  FrameMessage(request, &framed);
+  int32_t stream_id;
+  h2::Headers headers = GrpcRequestHeaders();
+  if (timeout_us != 0) {
+    headers.emplace_back("grpc-timeout", GrpcTimeoutValue(timeout_us));
+  }
+  Error err = conn_->OpenStream(kServicePrefix + method, headers, &stream_id);
+  if (!err.IsOk()) return err;
+  err = conn_->SendData(stream_id, framed.data(), framed.size(), true);
+  if (!err.IsOk()) {
+    conn_->ReleaseStream(stream_id);
+    return err;
+  }
+  if (verbose_) fprintf(stderr, "grpc call %s\n", method.c_str());
+
+  std::string msg;
+  Error read_err;
+  bool have_msg =
+      ReadMessage(conn_.get(), stream_id, timeout_ms, &msg, &read_err);
+  if (!read_err.IsOk()) {
+    conn_->ReleaseStream(stream_id);
+    return read_err;
+  }
+  if (!conn_->WaitClosed(stream_id, timeout_ms)) {
+    conn_->Reset(stream_id, 8 /* CANCEL */);
+    conn_->ReleaseStream(stream_id);
+    return Error("Deadline Exceeded");
+  }
+  uint32_t rst_code;
+  if (conn_->StreamReset(stream_id, &rst_code)) {
+    conn_->ReleaseStream(stream_id);
+    return Error("stream reset by server (h2 error " +
+                 std::to_string(rst_code) + ")");
+  }
+  Error status = GrpcStatus(conn_->ResponseHeaders(stream_id),
+                            conn_->Trailers(stream_id));
+  conn_->ReleaseStream(stream_id);
+  if (!status.IsOk()) return status;
+  if (!have_msg) return Error("missing response message for " + method);
+  if (!response->ParseFromString(msg)) {
+    return Error("failed to parse " + method + " response");
+  }
+  return Error::Success;
+}
+
+// ---------------------------------------------------------------------------
+// health / metadata / admin
+// ---------------------------------------------------------------------------
+
+Error InferenceServerGrpcClient::IsServerLive(bool* live) {
+  inference::ServerLiveRequest req;
+  inference::ServerLiveResponse resp;
+  Error err = Call("ServerLive", req, &resp);
+  *live = err.IsOk() && resp.live();
+  return err;
+}
+
+Error InferenceServerGrpcClient::IsServerReady(bool* ready) {
+  inference::ServerReadyRequest req;
+  inference::ServerReadyResponse resp;
+  Error err = Call("ServerReady", req, &resp);
+  *ready = err.IsOk() && resp.ready();
+  return err;
+}
+
+Error InferenceServerGrpcClient::IsModelReady(const std::string& model_name,
+                                              bool* ready,
+                                              const std::string& model_version) {
+  inference::ModelReadyRequest req;
+  req.set_name(model_name);
+  req.set_version(model_version);
+  inference::ModelReadyResponse resp;
+  Error err = Call("ModelReady", req, &resp);
+  *ready = err.IsOk() && resp.ready();
+  return err;
+}
+
+Error InferenceServerGrpcClient::ServerMetadata(
+    inference::ServerMetadataResponse* metadata) {
+  inference::ServerMetadataRequest req;
+  return Call("ServerMetadata", req, metadata);
+}
+
+Error InferenceServerGrpcClient::ModelMetadata(
+    inference::ModelMetadataResponse* metadata, const std::string& model_name,
+    const std::string& model_version) {
+  inference::ModelMetadataRequest req;
+  req.set_name(model_name);
+  req.set_version(model_version);
+  return Call("ModelMetadata", req, metadata);
+}
+
+Error InferenceServerGrpcClient::ModelConfig(
+    inference::ModelConfigResponse* config, const std::string& model_name,
+    const std::string& model_version) {
+  inference::ModelConfigRequest req;
+  req.set_name(model_name);
+  req.set_version(model_version);
+  return Call("ModelConfig", req, config);
+}
+
+Error InferenceServerGrpcClient::ModelRepositoryIndex(
+    inference::RepositoryIndexResponse* index) {
+  inference::RepositoryIndexRequest req;
+  return Call("RepositoryIndex", req, index);
+}
+
+Error InferenceServerGrpcClient::LoadModel(const std::string& model_name,
+                                           const std::string& config_json) {
+  inference::RepositoryModelLoadRequest req;
+  req.set_model_name(model_name);
+  if (!config_json.empty()) {
+    (*req.mutable_parameters())["config"].set_string_param(config_json);
+  }
+  inference::RepositoryModelLoadResponse resp;
+  return Call("RepositoryModelLoad", req, &resp);
+}
+
+Error InferenceServerGrpcClient::UnloadModel(const std::string& model_name) {
+  inference::RepositoryModelUnloadRequest req;
+  req.set_model_name(model_name);
+  inference::RepositoryModelUnloadResponse resp;
+  return Call("RepositoryModelUnload", req, &resp);
+}
+
+Error InferenceServerGrpcClient::ModelInferenceStatistics(
+    inference::ModelStatisticsResponse* stats, const std::string& model_name,
+    const std::string& model_version) {
+  inference::ModelStatisticsRequest req;
+  req.set_name(model_name);
+  req.set_version(model_version);
+  return Call("ModelStatistics", req, stats);
+}
+
+Error InferenceServerGrpcClient::RegisterSystemSharedMemory(
+    const std::string& name, const std::string& key, size_t byte_size,
+    size_t offset) {
+  inference::SystemSharedMemoryRegisterRequest req;
+  req.set_name(name);
+  req.set_key(key);
+  req.set_offset(offset);
+  req.set_byte_size(byte_size);
+  inference::SystemSharedMemoryRegisterResponse resp;
+  return Call("SystemSharedMemoryRegister", req, &resp);
+}
+
+Error InferenceServerGrpcClient::UnregisterSystemSharedMemory(
+    const std::string& name) {
+  inference::SystemSharedMemoryUnregisterRequest req;
+  req.set_name(name);
+  inference::SystemSharedMemoryUnregisterResponse resp;
+  return Call("SystemSharedMemoryUnregister", req, &resp);
+}
+
+Error InferenceServerGrpcClient::SystemSharedMemoryStatus(
+    inference::SystemSharedMemoryStatusResponse* status) {
+  inference::SystemSharedMemoryStatusRequest req;
+  return Call("SystemSharedMemoryStatus", req, status);
+}
+
+Error InferenceServerGrpcClient::RegisterTpuSharedMemory(
+    const std::string& name, const std::string& raw_handle, int64_t device_id,
+    size_t byte_size) {
+  inference::TpuSharedMemoryRegisterRequest req;
+  req.set_name(name);
+  req.set_raw_handle(raw_handle);
+  req.set_device_id(device_id);
+  req.set_byte_size(byte_size);
+  inference::TpuSharedMemoryRegisterResponse resp;
+  return Call("TpuSharedMemoryRegister", req, &resp);
+}
+
+Error InferenceServerGrpcClient::UnregisterTpuSharedMemory(
+    const std::string& name) {
+  inference::TpuSharedMemoryUnregisterRequest req;
+  req.set_name(name);
+  inference::TpuSharedMemoryUnregisterResponse resp;
+  return Call("TpuSharedMemoryUnregister", req, &resp);
+}
+
+Error InferenceServerGrpcClient::TpuSharedMemoryStatus(
+    inference::TpuSharedMemoryStatusResponse* status) {
+  inference::TpuSharedMemoryStatusRequest req;
+  return Call("TpuSharedMemoryStatus", req, status);
+}
+
+Error InferenceServerGrpcClient::GetTraceSettings(
+    inference::TraceSettingResponse* settings, const std::string& model_name) {
+  inference::TraceSettingRequest req;
+  req.set_model_name(model_name);
+  return Call("TraceSetting", req, settings);
+}
+
+Error InferenceServerGrpcClient::UpdateTraceSettings(
+    inference::TraceSettingResponse* response, const std::string& model_name,
+    const std::map<std::string, std::vector<std::string>>& settings) {
+  inference::TraceSettingRequest req;
+  req.set_model_name(model_name);
+  for (const auto& kv : settings) {
+    auto& value = (*req.mutable_settings())[kv.first];
+    for (const auto& v : kv.second) value.add_value(v);
+  }
+  return Call("TraceSetting", req, response);
+}
+
+Error InferenceServerGrpcClient::GetLogSettings(
+    inference::LogSettingsResponse* settings) {
+  inference::LogSettingsRequest req;
+  return Call("LogSettings", req, settings);
+}
+
+Error InferenceServerGrpcClient::UpdateLogSettings(
+    inference::LogSettingsResponse* response,
+    const std::map<std::string, std::string>& settings) {
+  inference::LogSettingsRequest req;
+  for (const auto& kv : settings) {
+    (*req.mutable_settings())[kv.first].set_string_param(kv.second);
+  }
+  return Call("LogSettings", req, response);
+}
+
+// ---------------------------------------------------------------------------
+// inference
+// ---------------------------------------------------------------------------
+
+Error InferenceServerGrpcClient::BuildInferRequest(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    inference::ModelInferRequest* request) {
+  request->set_model_name(options.model_name_);
+  request->set_model_version(options.model_version_);
+  request->set_id(options.request_id_);
+  auto& params = *request->mutable_parameters();
+  if (!options.sequence_id_str_.empty()) {
+    params["sequence_id"].set_string_param(options.sequence_id_str_);
+  } else if (options.sequence_id_ != 0) {
+    params["sequence_id"].set_int64_param(options.sequence_id_);
+  }
+  if (options.sequence_id_ != 0 || !options.sequence_id_str_.empty()) {
+    params["sequence_start"].set_bool_param(options.sequence_start_);
+    params["sequence_end"].set_bool_param(options.sequence_end_);
+  }
+  if (options.priority_ != 0) {
+    params["priority"].set_uint64_param(options.priority_);
+  }
+  if (options.server_timeout_us_ != 0) {
+    params["timeout"].set_int64_param(options.server_timeout_us_);
+  }
+  for (const auto& kv : options.request_parameters_) {
+    if (kv.first == "sequence_id" || kv.first == "sequence_start" ||
+        kv.first == "sequence_end" || kv.first == "priority" ||
+        kv.first == "binary_data_output") {
+      return Error("parameter '" + kv.first + "' is reserved");
+    }
+    params[kv.first].set_string_param(kv.second);
+  }
+  for (InferInput* input : inputs) {
+    auto* tensor = request->add_inputs();
+    tensor->set_name(input->Name());
+    tensor->set_datatype(input->Datatype());
+    for (int64_t d : input->Shape()) tensor->add_shape(d);
+    if (input->UsesSharedMemory()) {
+      auto& tp = *tensor->mutable_parameters();
+      tp["shared_memory_region"].set_string_param(input->SharedMemoryName());
+      tp["shared_memory_byte_size"].set_int64_param(
+          input->SharedMemoryByteSize());
+      if (input->SharedMemoryOffset() != 0) {
+        tp["shared_memory_offset"].set_int64_param(input->SharedMemoryOffset());
+      }
+    } else {
+      request->add_raw_input_contents(
+          std::string(reinterpret_cast<const char*>(input->RawData().data()),
+                      input->RawData().size()));
+    }
+  }
+  for (const InferRequestedOutput* output : outputs) {
+    auto* tensor = request->add_outputs();
+    tensor->set_name(output->Name());
+    auto& tp = *tensor->mutable_parameters();
+    if (output->UsesSharedMemory()) {
+      tp["shared_memory_region"].set_string_param(output->SharedMemoryName());
+      tp["shared_memory_byte_size"].set_int64_param(
+          output->SharedMemoryByteSize());
+      if (output->SharedMemoryOffset() != 0) {
+        tp["shared_memory_offset"].set_int64_param(
+            output->SharedMemoryOffset());
+      }
+    } else if (output->ClassCount() > 0) {
+      tp["classification"].set_int64_param(output->ClassCount());
+    }
+  }
+  return Error::Success;
+}
+
+std::shared_ptr<InferResult> InferenceServerGrpcClient::ResultFromResponse(
+    const inference::ModelInferResponse& response) {
+  auto result = std::make_shared<InferResult>();
+  result->model_name_ = response.model_name();
+  result->model_version_ = response.model_version();
+  result->id_ = response.id();
+  for (int i = 0; i < response.outputs_size(); i++) {
+    const auto& out = response.outputs(i);
+    InferResult::Output output;
+    output.datatype = out.datatype();
+    for (int64_t d : out.shape()) output.shape.push_back(d);
+    if (out.parameters().count("shared_memory_region")) {
+      output.in_shared_memory = true;
+    } else if (i < response.raw_output_contents_size()) {
+      const std::string& raw = response.raw_output_contents(i);
+      output.data.assign(raw.begin(), raw.end());
+    }
+    result->outputs_[out.name()] = std::move(output);
+  }
+  return result;
+}
+
+Error InferenceServerGrpcClient::Infer(
+    std::shared_ptr<InferResult>* result, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs) {
+  RequestTimers timers;
+  timers.Capture(RequestTimers::Kind::REQUEST_START);
+  timers.Capture(RequestTimers::Kind::SEND_START);
+  inference::ModelInferRequest request;
+  Error err = BuildInferRequest(options, inputs, outputs, &request);
+  if (!err.IsOk()) return err;
+  timers.Capture(RequestTimers::Kind::SEND_END);
+  inference::ModelInferResponse response;
+  err = Call("ModelInfer", request, &response, options.client_timeout_us_);
+  if (!err.IsOk()) return err;
+  timers.Capture(RequestTimers::Kind::RECV_START);
+  *result = ResultFromResponse(response);
+  timers.Capture(RequestTimers::Kind::RECV_END);
+  timers.Capture(RequestTimers::Kind::REQUEST_END);
+  {
+    std::lock_guard<std::mutex> lk(stat_mu_);
+    infer_stat_.Update(timers);
+  }
+  return Error::Success;
+}
+
+Error InferenceServerGrpcClient::AsyncInfer(
+    OnCompleteFn callback, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs) {
+  RequestTimers timers;
+  timers.Capture(RequestTimers::Kind::REQUEST_START);
+  timers.Capture(RequestTimers::Kind::SEND_START);
+  inference::ModelInferRequest request;
+  Error err = BuildInferRequest(options, inputs, outputs, &request);
+  if (!err.IsOk()) return err;
+  std::string framed;
+  FrameMessage(request, &framed);
+  int32_t stream_id;
+  h2::Headers headers = GrpcRequestHeaders();
+  if (options.client_timeout_us_ != 0) {
+    headers.emplace_back("grpc-timeout",
+                         GrpcTimeoutValue(options.client_timeout_us_));
+  }
+  err = conn_->OpenStream(std::string(kServicePrefix) + "ModelInfer", headers,
+                          &stream_id);
+  if (!err.IsOk()) return err;
+  err = conn_->SendData(stream_id, framed.data(), framed.size(), true);
+  if (!err.IsOk()) {
+    conn_->ReleaseStream(stream_id);
+    return err;
+  }
+  timers.Capture(RequestTimers::Kind::SEND_END);
+  {
+    std::lock_guard<std::mutex> lk(cq_mu_);
+    cq_.push_back(AsyncRequest{stream_id, std::move(callback), timers,
+                               options.client_timeout_us_});
+  }
+  cq_cv_.notify_one();
+  return Error::Success;
+}
+
+void InferenceServerGrpcClient::CompletionWorker() {
+  // Drains the completion queue in FIFO order (reference AsyncTransfer,
+  // grpc_client.cc:1582): waits on each stream, parses, dispatches the
+  // user callback. Head-of-line waits are bounded by each request's own
+  // deadline (client_timeout_us_, default 120s): a stuck request is reset
+  // and surfaced as Deadline Exceeded rather than stalling the queue
+  // forever.
+  while (true) {
+    AsyncRequest req;
+    {
+      std::unique_lock<std::mutex> lk(cq_mu_);
+      cq_cv_.wait(lk, [this] { return exiting_ || !cq_.empty(); });
+      if (exiting_ && cq_.empty()) return;
+      req = std::move(cq_.front());
+      cq_.pop_front();
+    }
+    int64_t timeout_ms =
+        req.timeout_us == 0 ? 120000
+                            : static_cast<int64_t>(req.timeout_us / 1000);
+    std::string msg;
+    Error read_err;
+    bool have_msg =
+        ReadMessage(conn_.get(), req.stream_id, timeout_ms, &msg, &read_err);
+    bool closed = conn_->WaitClosed(req.stream_id, timeout_ms);
+    Error status = read_err;
+    if (status.IsOk() && !closed) {
+      conn_->Reset(req.stream_id, 8 /* CANCEL */);
+      status = Error("Deadline Exceeded");
+    }
+    if (status.IsOk() && conn_->Dead()) {
+      status = Error("connection failed: " + conn_->LastError());
+    }
+    if (status.IsOk()) {
+      status = GrpcStatus(conn_->ResponseHeaders(req.stream_id),
+                          conn_->Trailers(req.stream_id));
+    }
+    conn_->ReleaseStream(req.stream_id);
+    std::shared_ptr<InferResult> result;
+    if (status.IsOk() && !have_msg) {
+      status = Error("missing response message");
+    }
+    if (status.IsOk()) {
+      inference::ModelInferResponse response;
+      if (!response.ParseFromString(msg)) {
+        status = Error("failed to parse ModelInfer response");
+      } else {
+        req.timers.Capture(RequestTimers::Kind::RECV_START);
+        result = ResultFromResponse(response);
+        req.timers.Capture(RequestTimers::Kind::RECV_END);
+      }
+    }
+    req.timers.Capture(RequestTimers::Kind::REQUEST_END);
+    if (status.IsOk()) {
+      std::lock_guard<std::mutex> lk(stat_mu_);
+      infer_stat_.Update(req.timers);
+    }
+    req.callback(std::move(result), status);
+  }
+}
+
+Error InferenceServerGrpcClient::InferMulti(
+    std::vector<std::shared_ptr<InferResult>>* results,
+    const std::vector<InferOptions>& options,
+    const std::vector<std::vector<InferInput*>>& inputs,
+    const std::vector<std::vector<const InferRequestedOutput*>>& outputs) {
+  // One option set may fan across all requests (reference grpc_client.cc:1213).
+  if (options.size() != 1 && options.size() != inputs.size()) {
+    return Error("'options' must be 1 or match the number of requests");
+  }
+  if (!outputs.empty() && outputs.size() != inputs.size()) {
+    return Error("'outputs' must be empty or match the number of requests");
+  }
+  results->clear();
+  for (size_t i = 0; i < inputs.size(); i++) {
+    const InferOptions& opt = options.size() == 1 ? options[0] : options[i];
+    static const std::vector<const InferRequestedOutput*> kNoOutputs;
+    const auto& outs = outputs.empty() ? kNoOutputs : outputs[i];
+    std::shared_ptr<InferResult> result;
+    Error err = Infer(&result, opt, inputs[i], outs);
+    if (!err.IsOk()) return err;
+    results->push_back(std::move(result));
+  }
+  return Error::Success;
+}
+
+Error InferenceServerGrpcClient::AsyncInferMulti(
+    OnMultiCompleteFn callback, const std::vector<InferOptions>& options,
+    const std::vector<std::vector<InferInput*>>& inputs,
+    const std::vector<std::vector<const InferRequestedOutput*>>& outputs) {
+  if (options.size() != 1 && options.size() != inputs.size()) {
+    return Error("'options' must be 1 or match the number of requests");
+  }
+  if (!outputs.empty() && outputs.size() != inputs.size()) {
+    return Error("'outputs' must be empty or match the number of requests");
+  }
+  if (inputs.empty()) {
+    // Nothing to fan out; still deliver the completion.
+    callback({}, Error::Success);
+    return Error::Success;
+  }
+  // Atomic fan-in (reference grpc_client.cc:1283-1302): the last completion
+  // delivers the ordered result vector.
+  struct MultiState {
+    std::mutex mu;
+    std::vector<std::shared_ptr<InferResult>> results;
+    Error first_error;
+    size_t remaining;
+    OnMultiCompleteFn callback;
+  };
+  auto state = std::make_shared<MultiState>();
+  state->results.resize(inputs.size());
+  state->remaining = inputs.size();
+  state->callback = std::move(callback);
+  for (size_t i = 0; i < inputs.size(); i++) {
+    const InferOptions& opt = options.size() == 1 ? options[0] : options[i];
+    static const std::vector<const InferRequestedOutput*> kNoOutputs;
+    const auto& outs = outputs.empty() ? kNoOutputs : outputs[i];
+    Error err = AsyncInfer(
+        [state, i](std::shared_ptr<InferResult> result, Error e) {
+          bool last = false;
+          {
+            std::lock_guard<std::mutex> lk(state->mu);
+            state->results[i] = std::move(result);
+            if (!e.IsOk() && state->first_error.IsOk()) state->first_error = e;
+            last = (--state->remaining == 0);
+          }
+          if (last) {
+            state->callback(std::move(state->results), state->first_error);
+          }
+        },
+        opt, inputs[i], outs);
+    if (!err.IsOk()) {
+      // Account for the request that never launched.
+      bool last = false;
+      {
+        std::lock_guard<std::mutex> lk(state->mu);
+        if (state->first_error.IsOk()) state->first_error = err;
+        last = (--state->remaining == 0);
+      }
+      if (last) {
+        state->callback(std::move(state->results), state->first_error);
+      }
+    }
+  }
+  return Error::Success;
+}
+
+// ---------------------------------------------------------------------------
+// streaming
+// ---------------------------------------------------------------------------
+
+Error InferenceServerGrpcClient::StartStream(OnCompleteFn stream_callback,
+                                             bool enable_stats) {
+  std::lock_guard<std::mutex> lk(stream_mu_);
+  if (stream_id_ >= 0) {
+    return Error("cannot start another stream: one is already active");
+  }
+  int32_t stream_id;
+  Error err =
+      conn_->OpenStream(std::string(kServicePrefix) + "ModelStreamInfer",
+                        GrpcRequestHeaders(), &stream_id);
+  if (!err.IsOk()) return err;
+  stream_id_ = stream_id;
+  stream_callback_ = std::move(stream_callback);
+  stream_stats_ = enable_stats;
+  stream_timers_.clear();
+  stream_reader_ = std::thread(&InferenceServerGrpcClient::StreamReader, this);
+  return Error::Success;
+}
+
+Error InferenceServerGrpcClient::AsyncStreamInfer(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    bool enable_empty_final_response) {
+  RequestTimers timers;
+  timers.Capture(RequestTimers::Kind::REQUEST_START);
+  timers.Capture(RequestTimers::Kind::SEND_START);
+  inference::ModelInferRequest request;
+  Error err = BuildInferRequest(options, inputs, outputs, &request);
+  if (!err.IsOk()) return err;
+  if (enable_empty_final_response) {
+    (*request.mutable_parameters())["triton_enable_empty_final_response"]
+        .set_bool_param(true);
+  }
+  std::string framed;
+  FrameMessage(request, &framed);
+  std::lock_guard<std::mutex> lk(stream_mu_);
+  if (stream_id_ < 0) {
+    return Error("stream not available, use StartStream()");
+  }
+  err = conn_->SendData(stream_id_, framed.data(), framed.size(), false);
+  if (!err.IsOk()) return err;
+  timers.Capture(RequestTimers::Kind::SEND_END);
+  if (stream_stats_) stream_timers_.push_back(timers);
+  return Error::Success;
+}
+
+void InferenceServerGrpcClient::StreamReader() {
+  // Blocking read loop pairing responses with queued send timers
+  // (reference AsyncStreamTransfer, grpc_client.cc:1629-1670; same
+  // decoupled-model stats caveat — multiple responses per request pair
+  // with at most one timer).
+  int32_t sid;
+  {
+    std::lock_guard<std::mutex> lk(stream_mu_);
+    sid = stream_id_;
+  }
+  while (true) {
+    std::string msg;
+    Error err;
+    bool have = ReadMessage(conn_.get(), sid, 0, &msg, &err);
+    if (!have) {
+      // Distinguish a clean half-close (StopStream) from the connection or
+      // stream dying with requests possibly still in flight — the latter
+      // must reach the callback or the application waits forever.
+      uint32_t rst_code;
+      if (err.IsOk() && conn_->Dead()) {
+        err = Error("stream connection failed: " + conn_->LastError());
+      } else if (err.IsOk() && conn_->StreamReset(sid, &rst_code)) {
+        err = Error("stream reset by server (h2 error " +
+                    std::to_string(rst_code) + ")");
+      }
+      if (!err.IsOk()) {
+        OnCompleteFn cb;
+        {
+          std::lock_guard<std::mutex> lk(stream_mu_);
+          cb = stream_callback_;
+        }
+        if (cb) cb(nullptr, err);
+      }
+      return;  // stream closed
+    }
+    inference::ModelStreamInferResponse response;
+    Error status;
+    std::shared_ptr<InferResult> result;
+    if (!response.ParseFromString(msg)) {
+      status = Error("failed to parse stream response");
+    } else if (!response.error_message().empty()) {
+      status = Error(response.error_message());
+    } else {
+      result = ResultFromResponse(response.infer_response());
+      // Surface triton_final_response to the callback via the result id
+      // convention used across this client; parameters live on the proto.
+      const auto& params = response.infer_response().parameters();
+      auto it = params.find("triton_final_response");
+      if (it != params.end() && it->second.bool_param()) {
+        result->final_response_ = true;
+      }
+    }
+    OnCompleteFn cb;
+    {
+      std::lock_guard<std::mutex> lk(stream_mu_);
+      cb = stream_callback_;
+      if (stream_stats_ && !stream_timers_.empty()) {
+        RequestTimers timers = stream_timers_.front();
+        stream_timers_.pop_front();
+        timers.Capture(RequestTimers::Kind::RECV_START);
+        timers.Capture(RequestTimers::Kind::RECV_END);
+        timers.Capture(RequestTimers::Kind::REQUEST_END);
+        std::lock_guard<std::mutex> slk(stat_mu_);
+        infer_stat_.Update(timers);
+      }
+    }
+    if (cb) cb(std::move(result), status);
+  }
+}
+
+Error InferenceServerGrpcClient::StopStream() {
+  int32_t sid;
+  {
+    std::lock_guard<std::mutex> lk(stream_mu_);
+    if (stream_id_ < 0) return Error::Success;
+    sid = stream_id_;
+  }
+  conn_->CloseSend(sid);
+  conn_->WaitClosed(sid, 30000);
+  if (stream_reader_.joinable()) stream_reader_.join();
+  Error status =
+      GrpcStatus(conn_->ResponseHeaders(sid), conn_->Trailers(sid));
+  conn_->ReleaseStream(sid);
+  {
+    std::lock_guard<std::mutex> lk(stream_mu_);
+    stream_id_ = -1;
+    stream_callback_ = nullptr;
+  }
+  return status;
+}
+
+Error InferenceServerGrpcClient::ClientInferStat(InferStat* stat) const {
+  std::lock_guard<std::mutex> lk(stat_mu_);
+  *stat = infer_stat_;
+  return Error::Success;
+}
+
+}  // namespace tputriton

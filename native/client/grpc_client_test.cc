@@ -1,0 +1,305 @@
+// Self-checking native gRPC client test binary, driven by
+// tests/test_cpp_client.py against the in-process JAX server (the gRPC half
+// of the role cc_client_test.cc plays in the reference,
+// tests/cc_client_test.cc:2183-2184 GRPC instantiation).
+//
+//   grpc_client_test <host:port>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <iostream>
+#include <mutex>
+#include <vector>
+
+#include "grpc_client.h"
+
+using namespace tputriton;  // NOLINT
+
+static int failures = 0;
+
+#define EXPECT(cond, msg)                              \
+  do {                                                 \
+    if (!(cond)) {                                     \
+      std::cerr << "FAIL: " << msg << "\n";            \
+      failures++;                                      \
+    }                                                  \
+  } while (0)
+
+#define EXPECT_OK(err, msg)                                               \
+  do {                                                                    \
+    Error e = (err);                                                      \
+    if (!e.IsOk()) {                                                      \
+      std::cerr << "FAIL: " << msg << ": " << e.Message() << "\n";        \
+      failures++;                                                         \
+    }                                                                     \
+  } while (0)
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::cerr << "usage: grpc_client_test <host:port>\n";
+    return 2;
+  }
+  std::unique_ptr<InferenceServerGrpcClient> client;
+  EXPECT_OK(InferenceServerGrpcClient::Create(&client, argv[1]), "create");
+
+  // Channel sharing: a second client on the same URL reuses the connection
+  // (reference share-count contract, grpc_client.cc:92-96).
+  std::unique_ptr<InferenceServerGrpcClient> client2;
+  EXPECT_OK(InferenceServerGrpcClient::Create(&client2, argv[1]),
+            "create shared");
+
+  // health + metadata
+  bool live = false, ready = false;
+  EXPECT_OK(client->IsServerLive(&live), "live");
+  EXPECT(live, "server live");
+  EXPECT_OK(client->IsServerReady(&ready), "ready");
+  EXPECT(ready, "server ready");
+  inference::ServerMetadataResponse smeta;
+  EXPECT_OK(client->ServerMetadata(&smeta), "server metadata");
+  EXPECT(!smeta.name().empty(), "metadata has name");
+  inference::ModelMetadataResponse mmeta;
+  EXPECT_OK(client->ModelMetadata(&mmeta, "simple"), "model metadata");
+  EXPECT(mmeta.inputs_size() == 2, "simple has 2 inputs");
+  inference::ModelConfigResponse mconfig;
+  EXPECT_OK(client->ModelConfig(&mconfig, "simple"), "model config");
+  EXPECT(mconfig.config().name() == "simple", "config name");
+  inference::RepositoryIndexResponse index;
+  EXPECT_OK(client->ModelRepositoryIndex(&index), "repository index");
+  EXPECT(index.models_size() >= 1, "repository has models");
+
+  // infer
+  int32_t input0[16], input1[16];
+  for (int i = 0; i < 16; i++) {
+    input0[i] = i * 3;
+    input1[i] = i;
+  }
+  InferInput in0("INPUT0", {1, 16}, "INT32");
+  InferInput in1("INPUT1", {1, 16}, "INT32");
+  in0.AppendRaw(reinterpret_cast<uint8_t*>(input0), 64);
+  in1.AppendRaw(reinterpret_cast<uint8_t*>(input1), 64);
+  InferOptions options("simple");
+  options.request_id_ = "cpp-grpc-1";
+  std::shared_ptr<InferResult> result;
+  EXPECT_OK(client->Infer(&result, options, {&in0, &in1}), "infer");
+  EXPECT(result->Id() == "cpp-grpc-1", "request id echo");
+  const uint8_t* buf;
+  size_t nbytes;
+  EXPECT_OK(result->RawData("OUTPUT0", &buf, &nbytes), "OUTPUT0 raw");
+  EXPECT(nbytes == 64, "OUTPUT0 size");
+  const int32_t* sums = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; i++) {
+    EXPECT(sums[i] == input0[i] + input1[i], "sum value");
+  }
+  EXPECT_OK(result->RawData("OUTPUT1", &buf, &nbytes), "OUTPUT1 raw");
+  const int32_t* diffs = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; i++) {
+    EXPECT(diffs[i] == input0[i] - input1[i], "diff value");
+  }
+  std::vector<int64_t> shape;
+  EXPECT_OK(result->Shape("OUTPUT0", &shape), "shape");
+  EXPECT(shape.size() == 2 && shape[1] == 16, "shape value");
+
+  // second client shares the connection and works concurrently
+  EXPECT_OK(client2->Infer(&result, options, {&in0, &in1}), "shared infer");
+
+  // BYTES model round trip
+  InferInput sin0("INPUT0", {1, 16}, "BYTES");
+  InferInput sin1("INPUT1", {1, 16}, "BYTES");
+  std::vector<std::string> svals0, svals1;
+  for (int i = 0; i < 16; i++) {
+    svals0.push_back(std::to_string(i));
+    svals1.push_back(std::to_string(200 + i));
+  }
+  sin0.AppendFromString(svals0);
+  sin1.AppendFromString(svals1);
+  InferOptions sopt("simple_string");
+  EXPECT_OK(client->Infer(&result, sopt, {&sin0, &sin1}), "string infer");
+  std::vector<std::string> sums_str;
+  EXPECT_OK(result->StringData("OUTPUT0", &sums_str), "string data");
+  EXPECT(sums_str.size() == 16, "string count");
+  if (sums_str.size() == 16) {
+    EXPECT(sums_str[4] == "208", "string sum value");
+  }
+
+  // error path: unknown model carries the server message
+  InferOptions bad("no_such_model");
+  Error err = client->Infer(&result, bad, {&in0, &in1});
+  EXPECT(!err.IsOk(), "unknown model fails");
+  EXPECT(err.Message().find("no_such_model") != std::string::npos,
+         "error names the model");
+
+  // async infer via the completion-queue worker
+  std::mutex mu;
+  std::condition_variable cv;
+  std::atomic<int> done{0};
+  Error async_err;
+  for (int r = 0; r < 4; r++) {
+    EXPECT_OK(client->AsyncInfer(
+                  [&](std::shared_ptr<InferResult> res, Error e) {
+                    std::lock_guard<std::mutex> lk(mu);
+                    if (!e.IsOk()) async_err = e;
+                    done++;
+                    cv.notify_all();
+                  },
+                  options, {&in0, &in1}),
+              "async infer submit");
+  }
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait_for(lk, std::chrono::seconds(30), [&] { return done == 4; });
+  }
+  EXPECT(done == 4, "async completions");
+  EXPECT_OK(async_err, "async result ok");
+
+  // InferMulti / AsyncInferMulti (reference grpc_client.h:522,554)
+  std::vector<std::shared_ptr<InferResult>> results;
+  std::vector<InferOptions> multi_options{options};
+  std::vector<std::vector<InferInput*>> multi_inputs{{&in0, &in1},
+                                                     {&in0, &in1},
+                                                     {&in0, &in1}};
+  EXPECT_OK(client->InferMulti(&results, multi_options, multi_inputs),
+            "infer multi");
+  EXPECT(results.size() == 3, "multi count");
+  for (const auto& r : results) {
+    EXPECT(r != nullptr && r->HasOutput("OUTPUT0"), "multi result output");
+  }
+  std::atomic<bool> multi_done{false};
+  Error multi_err;
+  size_t multi_count = 0;
+  EXPECT_OK(client->AsyncInferMulti(
+                [&](std::vector<std::shared_ptr<InferResult>> rs, Error e) {
+                  std::lock_guard<std::mutex> lk(mu);
+                  multi_err = e;
+                  multi_count = rs.size();
+                  multi_done = true;
+                  cv.notify_all();
+                },
+                multi_options, multi_inputs),
+            "async infer multi");
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait_for(lk, std::chrono::seconds(30), [&] { return multi_done.load(); });
+  }
+  EXPECT(multi_done, "async multi completion");
+  EXPECT_OK(multi_err, "async multi ok");
+  EXPECT(multi_count == 3, "async multi count");
+
+  // streaming: decoupled repeat model, per-element responses + empty final
+  std::vector<int32_t> streamed;
+  std::atomic<int> finals{0};
+  std::atomic<int> stream_errors{0};
+  EXPECT_OK(client->StartStream([&](std::shared_ptr<InferResult> res, Error e) {
+              if (!e.IsOk()) {
+                stream_errors++;
+                return;
+              }
+              std::lock_guard<std::mutex> lk(mu);
+              if (res->IsFinalResponse() && !res->HasOutput("OUT")) {
+                finals++;
+                cv.notify_all();
+                return;
+              }
+              const uint8_t* b;
+              size_t n;
+              if (res->RawData("OUT", &b, &n).IsOk() && n >= 4) {
+                streamed.push_back(*reinterpret_cast<const int32_t*>(b));
+              }
+              cv.notify_all();
+            }),
+            "start stream");
+  int32_t repeat_vals[4] = {7, 8, 9, 10};
+  InferInput rin("IN", {4}, "INT32");
+  rin.AppendRaw(reinterpret_cast<uint8_t*>(repeat_vals), 16);
+  InferOptions ropt("repeat_int32");
+  EXPECT_OK(client->AsyncStreamInfer(ropt, {&rin}, {}, true), "stream infer");
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait_for(lk, std::chrono::seconds(30), [&] { return finals >= 1; });
+  }
+  EXPECT(finals == 1, "stream final response");
+  EXPECT(streamed.size() == 4, "stream response count");
+  if (streamed.size() == 4) {
+    for (int i = 0; i < 4; i++) {
+      EXPECT(streamed[i] == repeat_vals[i], "stream value order");
+    }
+  }
+  EXPECT(stream_errors == 0, "stream errors");
+  EXPECT_OK(client->StopStream(), "stop stream");
+
+  // streaming sequence: accumulator keyed by sequence id
+  std::vector<int32_t> seq_out;
+  EXPECT_OK(client->StartStream([&](std::shared_ptr<InferResult> res, Error e) {
+              std::lock_guard<std::mutex> lk(mu);
+              const uint8_t* b;
+              size_t n;
+              if (e.IsOk() && res->RawData("OUTPUT", &b, &n).IsOk() && n >= 4) {
+                seq_out.push_back(*reinterpret_cast<const int32_t*>(b));
+              }
+              cv.notify_all();
+            }),
+            "start seq stream");
+  for (int step = 0; step < 3; step++) {
+    int32_t v = step + 1;
+    InferInput qin("INPUT", {1, 1}, "INT32");
+    qin.AppendRaw(reinterpret_cast<uint8_t*>(&v), 4);
+    InferOptions qopt("simple_sequence");
+    qopt.sequence_id_ = 42;
+    qopt.sequence_start_ = (step == 0);
+    qopt.sequence_end_ = (step == 2);
+    EXPECT_OK(client->AsyncStreamInfer(qopt, {&qin}), "seq stream infer");
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait_for(lk, std::chrono::seconds(30),
+                [&] { return seq_out.size() >= static_cast<size_t>(step + 1); });
+  }
+  EXPECT(seq_out.size() == 3, "sequence responses");
+  if (seq_out.size() == 3) {
+    EXPECT(seq_out[0] == 1 && seq_out[1] == 3 && seq_out[2] == 6,
+           "sequence accumulation");
+  }
+  EXPECT_OK(client->StopStream(), "stop seq stream");
+
+  // statistics + client stats
+  inference::ModelStatisticsResponse stats;
+  EXPECT_OK(client->ModelInferenceStatistics(&stats, "simple"), "server stats");
+  EXPECT(stats.model_stats_size() == 1, "stats entry");
+  InferStat cstat;
+  EXPECT_OK(client->ClientInferStat(&cstat), "client stats");
+  EXPECT(cstat.completed_request_count >= 5, "client stat count");
+
+  // model control
+  EXPECT_OK(client->UnloadModel("simple_string"), "unload");
+  bool sready = true;
+  EXPECT_OK(client->IsModelReady("simple_string", &sready), "ready query");
+  EXPECT(!sready, "unloaded not ready");
+  EXPECT_OK(client->LoadModel("simple_string"), "load");
+  EXPECT_OK(client->IsModelReady("simple_string", &sready), "ready query 2");
+  EXPECT(sready, "loaded ready");
+
+  // shm admin (status empty is fine; register of a bogus key must fail)
+  inference::SystemSharedMemoryStatusResponse shm_status;
+  EXPECT_OK(client->SystemSharedMemoryStatus(&shm_status), "shm status");
+  Error shm_err =
+      client->RegisterSystemSharedMemory("bogus", "/nonexistent_key_xyz", 64);
+  EXPECT(!shm_err.IsOk(), "bogus shm register fails");
+  inference::TpuSharedMemoryStatusResponse tpu_status;
+  EXPECT_OK(client->TpuSharedMemoryStatus(&tpu_status), "tpu shm status");
+
+  // trace/log settings
+  inference::TraceSettingResponse trace;
+  EXPECT_OK(client->GetTraceSettings(&trace), "get trace");
+  EXPECT_OK(client->UpdateTraceSettings(&trace, "",
+                                        {{"trace_level", {"TIMESTAMPS"}}}),
+            "update trace");
+  EXPECT(trace.settings().count("trace_level") == 1, "trace level present");
+  inference::LogSettingsResponse log;
+  EXPECT_OK(client->GetLogSettings(&log), "get log");
+
+  if (failures == 0) {
+    std::cout << "ALL PASS\n";
+    return 0;
+  }
+  std::cerr << failures << " failures\n";
+  return 1;
+}

@@ -1,0 +1,795 @@
+#include "h2.h"
+
+#include <arpa/inet.h>
+#include <dlfcn.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstring>
+
+namespace tputriton {
+namespace h2 {
+
+namespace {
+
+constexpr uint8_t kFrameData = 0x0;
+constexpr uint8_t kFrameHeaders = 0x1;
+constexpr uint8_t kFrameRstStream = 0x3;
+constexpr uint8_t kFrameSettings = 0x4;
+constexpr uint8_t kFramePing = 0x6;
+constexpr uint8_t kFrameGoaway = 0x7;
+constexpr uint8_t kFrameWindowUpdate = 0x8;
+constexpr uint8_t kFrameContinuation = 0x9;
+
+constexpr uint8_t kFlagEndStream = 0x1;
+constexpr uint8_t kFlagEndHeaders = 0x4;
+constexpr uint8_t kFlagAck = 0x1;
+constexpr uint8_t kFlagPadded = 0x8;
+constexpr uint8_t kFlagPriority = 0x20;
+
+const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+
+// ---------------------------------------------------------------------------
+// HPACK encoding (requests): literal header field never indexed, no Huffman.
+// Always legal, stateless, and what a minimal client should emit.
+// ---------------------------------------------------------------------------
+
+void EncodeInt(uint64_t value, uint8_t prefix_bits, uint8_t first_byte_flags,
+               std::string* out) {
+  uint64_t max_prefix = (1u << prefix_bits) - 1;
+  if (value < max_prefix) {
+    out->push_back(static_cast<char>(first_byte_flags | value));
+    return;
+  }
+  out->push_back(static_cast<char>(first_byte_flags | max_prefix));
+  value -= max_prefix;
+  while (value >= 128) {
+    out->push_back(static_cast<char>((value & 0x7F) | 0x80));
+    value >>= 7;
+  }
+  out->push_back(static_cast<char>(value));
+}
+
+void EncodeString(const std::string& s, std::string* out) {
+  EncodeInt(s.size(), 7, 0x00, out);  // H bit clear
+  out->append(s);
+}
+
+void EncodeHeader(const std::string& name, const std::string& value,
+                  std::string* out) {
+  out->push_back(0x10);  // literal never indexed, new name
+  EncodeString(name, out);
+  EncodeString(value, out);
+}
+
+// ---------------------------------------------------------------------------
+// nghttp2 HPACK inflater via dlopen (public, stable ABI; see
+// nghttp2/nghttp2.h docs). Used only for *decoding* response headers, where
+// servers may Huffman-encode and exercise the dynamic table.
+// ---------------------------------------------------------------------------
+
+struct Nghttp2Nv {
+  uint8_t* name;
+  uint8_t* value;
+  size_t namelen;
+  size_t valuelen;
+  uint8_t flags;
+};
+
+constexpr int kInflateEmit = 0x02;
+
+using InflateNewFn = int (*)(void**);
+using InflateDelFn = void (*)(void*);
+using InflateHd2Fn = ssize_t (*)(void*, Nghttp2Nv*, int*, const uint8_t*,
+                                 size_t, int);
+using InflateEndFn = int (*)(void*);
+
+struct Nghttp2Api {
+  void* handle = nullptr;
+  InflateNewFn inflate_new = nullptr;
+  InflateDelFn inflate_del = nullptr;
+  InflateHd2Fn inflate_hd2 = nullptr;
+  InflateEndFn inflate_end = nullptr;
+  bool ok = false;
+};
+
+const Nghttp2Api& GetNghttp2() {
+  static Nghttp2Api api = [] {
+    Nghttp2Api a;
+    for (const char* name :
+         {"libnghttp2.so.14", "libnghttp2.so", "libnghttp2.so.13"}) {
+      a.handle = dlopen(name, RTLD_NOW | RTLD_LOCAL);
+      if (a.handle != nullptr) break;
+    }
+    if (a.handle == nullptr) return a;
+    a.inflate_new =
+        reinterpret_cast<InflateNewFn>(dlsym(a.handle, "nghttp2_hd_inflate_new"));
+    a.inflate_del =
+        reinterpret_cast<InflateDelFn>(dlsym(a.handle, "nghttp2_hd_inflate_del"));
+    a.inflate_hd2 =
+        reinterpret_cast<InflateHd2Fn>(dlsym(a.handle, "nghttp2_hd_inflate_hd2"));
+    a.inflate_end = reinterpret_cast<InflateEndFn>(
+        dlsym(a.handle, "nghttp2_hd_inflate_end_headers"));
+    a.ok = a.inflate_new && a.inflate_del && a.inflate_hd2 && a.inflate_end;
+    return a;
+  }();
+  return api;
+}
+
+// RFC 7541 Appendix A static table (fallback decoder).
+const std::pair<const char*, const char*> kStaticTable[61] = {
+    {":authority", ""}, {":method", "GET"}, {":method", "POST"},
+    {":path", "/"}, {":path", "/index.html"}, {":scheme", "http"},
+    {":scheme", "https"}, {":status", "200"}, {":status", "204"},
+    {":status", "206"}, {":status", "304"}, {":status", "400"},
+    {":status", "404"}, {":status", "500"}, {"accept-charset", ""},
+    {"accept-encoding", "gzip, deflate"}, {"accept-language", ""},
+    {"accept-ranges", ""}, {"accept", ""},
+    {"access-control-allow-origin", ""}, {"age", ""}, {"allow", ""},
+    {"authorization", ""}, {"cache-control", ""}, {"content-disposition", ""},
+    {"content-encoding", ""}, {"content-language", ""}, {"content-length", ""},
+    {"content-location", ""}, {"content-range", ""}, {"content-type", ""},
+    {"cookie", ""}, {"date", ""}, {"etag", ""}, {"expect", ""},
+    {"expires", ""}, {"from", ""}, {"host", ""}, {"if-match", ""},
+    {"if-modified-since", ""}, {"if-none-match", ""}, {"if-range", ""},
+    {"if-unmodified-since", ""}, {"last-modified", ""}, {"link", ""},
+    {"location", ""}, {"max-forwards", ""}, {"proxy-authenticate", ""},
+    {"proxy-authorization", ""}, {"range", ""}, {"referer", ""},
+    {"refresh", ""}, {"retry-after", ""}, {"server", ""}, {"set-cookie", ""},
+    {"strict-transport-security", ""}, {"transfer-encoding", ""},
+    {"user-agent", ""}, {"vary", ""}, {"via", ""}, {"www-authenticate", ""},
+};
+
+bool DecodeIntAt(const std::string& b, size_t* pos, uint8_t prefix_bits,
+                 uint64_t* value) {
+  if (*pos >= b.size()) return false;
+  uint64_t max_prefix = (1u << prefix_bits) - 1;
+  uint64_t v = static_cast<uint8_t>(b[*pos]) & max_prefix;
+  (*pos)++;
+  if (v < max_prefix) {
+    *value = v;
+    return true;
+  }
+  uint64_t shift = 0;
+  while (*pos < b.size()) {
+    uint8_t byte = static_cast<uint8_t>(b[*pos]);
+    (*pos)++;
+    v += static_cast<uint64_t>(byte & 0x7F) << shift;
+    shift += 7;
+    if ((byte & 0x80) == 0) {
+      *value = v;
+      return true;
+    }
+    if (shift > 56) return false;
+  }
+  return false;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// connection lifecycle
+// ---------------------------------------------------------------------------
+
+Connection::~Connection() { Close(); }
+
+Error Connection::Connect(const std::string& host, int port) {
+  Close();
+  struct addrinfo hints = {};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  std::string port_str = std::to_string(port);
+  int rc = getaddrinfo(host.c_str(), port_str.c_str(), &hints, &res);
+  if (rc != 0) {
+    return Error("failed to resolve " + host + ": " + gai_strerror(rc));
+  }
+  Error err("failed to connect to " + host + ":" + port_str);
+  for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd_ = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd_ < 0) continue;
+    if (connect(fd_, ai->ai_addr, ai->ai_addrlen) == 0) {
+      int one = 1;
+      setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      err = Error::Success;
+      break;
+    }
+    close(fd_);
+    fd_ = -1;
+  }
+  freeaddrinfo(res);
+  if (!err.IsOk()) return err;
+  authority_ = host + ":" + port_str;
+  dead_ = false;
+  reader_exit_ = false;
+  err = Handshake();
+  if (!err.IsOk()) {
+    close(fd_);
+    fd_ = -1;
+    return err;
+  }
+  if (GetNghttp2().ok) {
+    GetNghttp2().inflate_new(&inflater_);
+  }
+  reader_ = std::thread(&Connection::ReaderLoop, this);
+  return Error::Success;
+}
+
+bool Connection::Connected() {
+  std::lock_guard<std::mutex> lk(mu_);
+  return fd_ >= 0 && !dead_;
+}
+
+void Connection::Close() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    reader_exit_ = true;
+    if (fd_ >= 0) {
+      shutdown(fd_, SHUT_RDWR);
+    }
+  }
+  if (reader_.joinable()) reader_.join();
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (fd_ >= 0) {
+      close(fd_);
+      fd_ = -1;
+    }
+  }
+  if (inflater_ != nullptr && GetNghttp2().ok) {
+    GetNghttp2().inflate_del(inflater_);
+    inflater_ = nullptr;
+  }
+}
+
+Error Connection::Handshake() {
+  // Client preface + empty SETTINGS; the server's SETTINGS is handled by
+  // the reader loop (we send the ACK there).
+  std::string out(kPreface, sizeof(kPreface) - 1);
+  // SETTINGS: no entries (defaults are fine for a client).
+  uint8_t hdr[9] = {0, 0, 0, kFrameSettings, 0, 0, 0, 0, 0};
+  out.append(reinterpret_cast<char*>(hdr), 9);
+  // Bump connection receive window so large responses don't stall before
+  // the reader starts issuing WINDOW_UPDATEs (2 GiB - 1 - default).
+  uint8_t wu[13] = {0, 0, 4, kFrameWindowUpdate, 0, 0, 0, 0, 0, 0, 0, 0, 0};
+  uint32_t inc = 0x7FFFFFFF - 65535;
+  wu[9] = (inc >> 24) & 0xFF;
+  wu[10] = (inc >> 16) & 0xFF;
+  wu[11] = (inc >> 8) & 0xFF;
+  wu[12] = inc & 0xFF;
+  out.append(reinterpret_cast<char*>(wu), 13);
+  const char* p = out.data();
+  size_t n = out.size();
+  while (n > 0) {
+    ssize_t w = send(fd_, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return Error("h2 handshake write failed");
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return Error::Success;
+}
+
+Error Connection::WriteFrame(uint8_t type, uint8_t flags, int32_t stream_id,
+                             const void* payload, size_t nbytes) {
+  uint8_t hdr[9];
+  hdr[0] = (nbytes >> 16) & 0xFF;
+  hdr[1] = (nbytes >> 8) & 0xFF;
+  hdr[2] = nbytes & 0xFF;
+  hdr[3] = type;
+  hdr[4] = flags;
+  hdr[5] = (stream_id >> 24) & 0x7F;
+  hdr[6] = (stream_id >> 16) & 0xFF;
+  hdr[7] = (stream_id >> 8) & 0xFF;
+  hdr[8] = stream_id & 0xFF;
+  std::lock_guard<std::mutex> lk(write_mu_);
+  if (fd_ < 0) return Error("h2 connection closed");
+  struct Part {
+    const char* p;
+    size_t n;
+  } parts[2] = {{reinterpret_cast<char*>(hdr), 9},
+                {static_cast<const char*>(payload), nbytes}};
+  for (const auto& part : parts) {
+    const char* p = part.p;
+    size_t n = part.n;
+    while (n > 0) {
+      ssize_t w = send(fd_, p, n, MSG_NOSIGNAL);
+      if (w <= 0) return Error("h2 write failed");
+      p += w;
+      n -= static_cast<size_t>(w);
+    }
+  }
+  return Error::Success;
+}
+
+// ---------------------------------------------------------------------------
+// stream API
+// ---------------------------------------------------------------------------
+
+std::shared_ptr<StreamState> Connection::GetStream(int32_t id) {
+  auto it = streams_.find(id);
+  return it == streams_.end() ? nullptr : it->second;
+}
+
+Error Connection::OpenStream(const std::string& path,
+                             const Headers& extra_headers,
+                             int32_t* stream_id) {
+  std::string block;
+  EncodeHeader(":method", "POST", &block);
+  EncodeHeader(":scheme", "http", &block);
+  EncodeHeader(":path", path, &block);
+  EncodeHeader(":authority", authority_, &block);
+  for (const auto& kv : extra_headers) {
+    EncodeHeader(kv.first, kv.second, &block);
+  }
+  int32_t id;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (dead_) return Error("h2 connection is dead: " + last_error_);
+    id = next_stream_id_;
+    next_stream_id_ += 2;
+    auto state = std::make_shared<StreamState>();
+    state->send_window = initial_send_window_;
+    streams_[id] = state;
+  }
+  Error err = WriteFrame(kFrameHeaders, kFlagEndHeaders, id, block.data(),
+                         block.size());
+  if (!err.IsOk()) return err;
+  *stream_id = id;
+  return Error::Success;
+}
+
+Error Connection::SendData(int32_t stream_id, const void* data, size_t nbytes,
+                           bool end_stream) {
+  const char* p = static_cast<const char*>(data);
+  size_t remaining = nbytes;
+  do {
+    size_t chunk;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      auto state = GetStream(stream_id);
+      if (state == nullptr) return Error("unknown h2 stream");
+      // Wait for send window on both levels.
+      while (!dead_ && remaining > 0 &&
+             (conn_send_window_ <= 0 || state->send_window <= 0)) {
+        window_cv_.wait_for(lk, std::chrono::seconds(30));
+      }
+      if (dead_) return Error("h2 connection is dead: " + last_error_);
+      chunk = remaining;
+      if (chunk > max_frame_size_) chunk = max_frame_size_;
+      if (remaining > 0) {
+        if (static_cast<int64_t>(chunk) > conn_send_window_) {
+          chunk = static_cast<size_t>(conn_send_window_);
+        }
+        if (static_cast<int64_t>(chunk) > state->send_window) {
+          chunk = static_cast<size_t>(state->send_window);
+        }
+        conn_send_window_ -= chunk;
+        state->send_window -= chunk;
+      }
+    }
+    bool last = (chunk == remaining);
+    Error err = WriteFrame(kFrameData, (last && end_stream) ? kFlagEndStream : 0,
+                           stream_id, p, chunk);
+    if (!err.IsOk()) return err;
+    p += chunk;
+    remaining -= chunk;
+  } while (remaining > 0);
+  return Error::Success;
+}
+
+Error Connection::CloseSend(int32_t stream_id) {
+  return WriteFrame(kFrameData, kFlagEndStream, stream_id, nullptr, 0);
+}
+
+Error Connection::Reset(int32_t stream_id, uint32_t error_code) {
+  uint8_t payload[4] = {
+      static_cast<uint8_t>((error_code >> 24) & 0xFF),
+      static_cast<uint8_t>((error_code >> 16) & 0xFF),
+      static_cast<uint8_t>((error_code >> 8) & 0xFF),
+      static_cast<uint8_t>(error_code & 0xFF),
+  };
+  return WriteFrame(kFrameRstStream, 0, stream_id, payload, 4);
+}
+
+bool Connection::WaitData(int32_t stream_id, size_t nbytes, int64_t timeout_ms,
+                          std::string* out) {
+  std::unique_lock<std::mutex> lk(mu_);
+  auto state = GetStream(stream_id);
+  if (state == nullptr) return false;
+  auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+  while (!dead_ && !state->closed &&
+         (nbytes == 0 || state->data.size() < nbytes)) {
+    if (timeout_ms <= 0) {
+      state->cv.wait(lk);
+    } else if (state->cv.wait_until(lk, deadline) ==
+               std::cv_status::timeout) {
+      return false;
+    }
+  }
+  size_t take = nbytes == 0 ? state->data.size()
+                            : std::min(nbytes, state->data.size());
+  out->assign(state->data, 0, take);
+  state->data.erase(0, take);
+  return nbytes == 0 || take == nbytes;
+}
+
+bool Connection::WaitClosed(int32_t stream_id, int64_t timeout_ms) {
+  std::unique_lock<std::mutex> lk(mu_);
+  auto state = GetStream(stream_id);
+  if (state == nullptr) return true;
+  auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+  while (!dead_ && !state->closed) {
+    if (timeout_ms <= 0) {
+      state->cv.wait(lk);
+    } else if (state->cv.wait_until(lk, deadline) ==
+               std::cv_status::timeout) {
+      return false;
+    }
+  }
+  return state->closed || dead_;
+}
+
+Headers Connection::ResponseHeaders(int32_t stream_id) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto state = GetStream(stream_id);
+  return state == nullptr ? Headers{} : state->headers;
+}
+
+Headers Connection::Trailers(int32_t stream_id) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto state = GetStream(stream_id);
+  return state == nullptr ? Headers{} : state->trailers;
+}
+
+bool Connection::StreamReset(int32_t stream_id, uint32_t* error_code) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto state = GetStream(stream_id);
+  if (state == nullptr || !state->rst) return false;
+  *error_code = state->rst_error;
+  return true;
+}
+
+void Connection::ReleaseStream(int32_t stream_id) {
+  std::lock_guard<std::mutex> lk(mu_);
+  streams_.erase(stream_id);
+}
+
+const std::string& Connection::LastError() {
+  std::lock_guard<std::mutex> lk(mu_);
+  return last_error_;
+}
+
+bool Connection::Dead() {
+  std::lock_guard<std::mutex> lk(mu_);
+  return dead_;
+}
+
+// ---------------------------------------------------------------------------
+// reader
+// ---------------------------------------------------------------------------
+
+void Connection::ReaderLoop() {
+  std::string buf;
+  char chunk[65536];
+  while (true) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (reader_exit_ || fd_ < 0) return;
+    }
+    // Parse all complete frames in buf.
+    while (buf.size() >= 9) {
+      size_t len = (static_cast<uint8_t>(buf[0]) << 16) |
+                   (static_cast<uint8_t>(buf[1]) << 8) |
+                   static_cast<uint8_t>(buf[2]);
+      if (buf.size() < 9 + len) break;
+      uint8_t type = static_cast<uint8_t>(buf[3]);
+      uint8_t flags = static_cast<uint8_t>(buf[4]);
+      int32_t sid = ((static_cast<uint8_t>(buf[5]) & 0x7F) << 24) |
+                    (static_cast<uint8_t>(buf[6]) << 16) |
+                    (static_cast<uint8_t>(buf[7]) << 8) |
+                    static_cast<uint8_t>(buf[8]);
+      std::string payload = buf.substr(9, len);
+      buf.erase(0, 9 + len);
+      HandleFrame(type, flags, sid, payload);
+    }
+    ssize_t n = recv(fd_, chunk, sizeof(chunk), 0);
+    if (n <= 0) {
+      FailAll(n == 0 ? "h2 connection closed by peer" : "h2 read error");
+      return;
+    }
+    buf.append(chunk, static_cast<size_t>(n));
+  }
+}
+
+void Connection::HandleFrame(uint8_t type, uint8_t flags, int32_t sid,
+                             const std::string& payload) {
+  switch (type) {
+    case kFrameSettings: {
+      if (flags & kFlagAck) return;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        for (size_t i = 0; i + 6 <= payload.size(); i += 6) {
+          uint16_t id = (static_cast<uint8_t>(payload[i]) << 8) |
+                        static_cast<uint8_t>(payload[i + 1]);
+          uint32_t value = (static_cast<uint8_t>(payload[i + 2]) << 24) |
+                           (static_cast<uint8_t>(payload[i + 3]) << 16) |
+                           (static_cast<uint8_t>(payload[i + 4]) << 8) |
+                           static_cast<uint8_t>(payload[i + 5]);
+          if (id == 0x4) {  // INITIAL_WINDOW_SIZE
+            int64_t delta =
+                static_cast<int64_t>(value) - initial_send_window_;
+            initial_send_window_ = value;
+            for (auto& kv : streams_) kv.second->send_window += delta;
+          } else if (id == 0x5) {  // MAX_FRAME_SIZE
+            max_frame_size_ = value;
+          }
+        }
+        window_cv_.notify_all();
+      }
+      WriteFrame(kFrameSettings, kFlagAck, 0, nullptr, 0);
+      return;
+    }
+    case kFramePing: {
+      if (!(flags & kFlagAck)) {
+        WriteFrame(kFramePing, kFlagAck, 0, payload.data(), payload.size());
+      }
+      return;
+    }
+    case kFrameWindowUpdate: {
+      if (payload.size() < 4) return;
+      uint32_t inc = ((static_cast<uint8_t>(payload[0]) & 0x7F) << 24) |
+                     (static_cast<uint8_t>(payload[1]) << 16) |
+                     (static_cast<uint8_t>(payload[2]) << 8) |
+                     static_cast<uint8_t>(payload[3]);
+      std::lock_guard<std::mutex> lk(mu_);
+      if (sid == 0) {
+        conn_send_window_ += inc;
+      } else {
+        auto state = GetStream(sid);
+        if (state != nullptr) state->send_window += inc;
+      }
+      window_cv_.notify_all();
+      return;
+    }
+    case kFrameGoaway: {
+      std::string reason = "h2 GOAWAY";
+      if (payload.size() > 8) reason += ": " + payload.substr(8);
+      FailAll(reason);
+      return;
+    }
+    case kFrameRstStream: {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto state = GetStream(sid);
+      if (state != nullptr) {
+        state->rst = true;
+        if (payload.size() >= 4) {
+          state->rst_error = (static_cast<uint8_t>(payload[0]) << 24) |
+                             (static_cast<uint8_t>(payload[1]) << 16) |
+                             (static_cast<uint8_t>(payload[2]) << 8) |
+                             static_cast<uint8_t>(payload[3]);
+        }
+        state->closed = true;
+        state->cv.notify_all();
+      }
+      return;
+    }
+    case kFrameHeaders: {
+      size_t pos = 0;
+      size_t len = payload.size();
+      if (flags & kFlagPadded) {
+        if (len < 1) return;
+        uint8_t pad = static_cast<uint8_t>(payload[0]);
+        pos += 1;
+        if (len < pos + pad) return;
+        len -= pad;
+      }
+      if (flags & kFlagPriority) pos += 5;
+      header_block_.assign(payload, pos, len - pos);
+      header_stream_ = sid;
+      header_end_stream_ = (flags & kFlagEndStream) != 0;
+      if (!(flags & kFlagEndHeaders)) return;  // CONTINUATION follows
+      break;  // fall through to decode below
+    }
+    case kFrameContinuation: {
+      header_block_.append(payload);
+      if (!(flags & kFlagEndHeaders)) return;
+      flags |= header_end_stream_ ? kFlagEndStream : 0;
+      sid = header_stream_;
+      break;
+    }
+    case kFrameData: {
+      size_t pos = 0;
+      size_t len = payload.size();
+      if (flags & kFlagPadded) {
+        if (len < 1) return;
+        uint8_t pad = static_cast<uint8_t>(payload[0]);
+        pos += 1;
+        if (len < pos + pad) return;
+        len -= pad;
+      }
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        auto state = GetStream(sid);
+        if (state != nullptr) {
+          state->data.append(payload, pos, len - pos);
+          if (flags & kFlagEndStream) state->closed = true;
+          state->cv.notify_all();
+        }
+      }
+      // Replenish BOTH receive windows: the stream's and the connection's
+      // (stream 0). The connection window is finite too — without this, a
+      // long-lived cached connection stalls for every stream once the
+      // cumulative response bytes exhaust it.
+      if (payload.size() > 0) {
+        uint8_t wu[4];
+        uint32_t inc = static_cast<uint32_t>(payload.size());
+        wu[0] = (inc >> 24) & 0x7F;
+        wu[1] = (inc >> 16) & 0xFF;
+        wu[2] = (inc >> 8) & 0xFF;
+        wu[3] = inc & 0xFF;
+        WriteFrame(kFrameWindowUpdate, 0, sid, wu, 4);
+        WriteFrame(kFrameWindowUpdate, 0, 0, wu, 4);
+      }
+      return;
+    }
+    default:
+      return;  // ignore PUSH_PROMISE (disabled), PRIORITY, unknown
+  }
+
+  // Decode accumulated header block (HEADERS or final CONTINUATION).
+  Headers decoded;
+  bool ok = DecodeHeaderBlock(header_block_, &decoded);
+  header_block_.clear();
+  std::lock_guard<std::mutex> lk(mu_);
+  auto state = GetStream(sid);
+  if (state == nullptr) return;
+  if (!ok) {
+    state->rst = true;
+    state->rst_error = 9;  // COMPRESSION_ERROR
+    state->closed = true;
+    state->cv.notify_all();
+    return;
+  }
+  if (!state->headers_done) {
+    state->headers = std::move(decoded);
+    state->headers_done = true;
+  } else {
+    state->trailers = std::move(decoded);
+  }
+  if (flags & kFlagEndStream) state->closed = true;
+  state->cv.notify_all();
+}
+
+bool Connection::DecodeHeaderBlock(const std::string& block, Headers* out) {
+  const auto& api = GetNghttp2();
+  if (api.ok && inflater_ != nullptr) {
+    const uint8_t* in = reinterpret_cast<const uint8_t*>(block.data());
+    size_t inlen = block.size();
+    while (true) {
+      Nghttp2Nv nv;
+      int inflate_flags = 0;
+      ssize_t rv =
+          api.inflate_hd2(inflater_, &nv, &inflate_flags, in, inlen, 1);
+      if (rv < 0) return false;
+      in += rv;
+      inlen -= static_cast<size_t>(rv);
+      if (inflate_flags & kInflateEmit) {
+        out->emplace_back(
+            std::string(reinterpret_cast<char*>(nv.name), nv.namelen),
+            std::string(reinterpret_cast<char*>(nv.value), nv.valuelen));
+      }
+      if (inflate_flags & 0x01 /* FINAL */) {
+        api.inflate_end(inflater_);
+        return true;
+      }
+      if (rv == 0 && !(inflate_flags & kInflateEmit)) return false;
+    }
+  }
+  return DecodeFallback(block, out);
+}
+
+// Fallback HPACK decoder: static + dynamic tables, no Huffman (fails with
+// a clear error if the peer Huffman-encodes and nghttp2 is unavailable).
+void Connection::DynInsert(const std::string& name, const std::string& value) {
+  size_t entry = name.size() + value.size() + 32;
+  dyn_table_.emplace_front(name, value);
+  dyn_table_size_ += entry;
+  while (dyn_table_size_ > dyn_table_max_ && !dyn_table_.empty()) {
+    const auto& back = dyn_table_.back();
+    dyn_table_size_ -= back.first.size() + back.second.size() + 32;
+    dyn_table_.pop_back();
+  }
+}
+
+bool Connection::DecodeFallback(const std::string& block, Headers* out) {
+  auto lookup = [this](uint64_t index, std::string* name,
+                       std::string* value) -> bool {
+    if (index == 0) return false;
+    if (index <= 61) {
+      *name = kStaticTable[index - 1].first;
+      *value = kStaticTable[index - 1].second;
+      return true;
+    }
+    size_t di = index - 62;
+    if (di >= dyn_table_.size()) return false;
+    *name = dyn_table_[di].first;
+    *value = dyn_table_[di].second;
+    return true;
+  };
+  auto read_string = [&block](size_t* pos, std::string* s) -> bool {
+    if (*pos >= block.size()) return false;
+    bool huffman = (static_cast<uint8_t>(block[*pos]) & 0x80) != 0;
+    uint64_t len;
+    if (!DecodeIntAt(block, pos, 7, &len)) return false;
+    if (*pos + len > block.size()) return false;
+    if (huffman) return false;  // no Huffman support in fallback
+    s->assign(block, *pos, len);
+    *pos += len;
+    return true;
+  };
+
+  size_t pos = 0;
+  while (pos < block.size()) {
+    uint8_t b = static_cast<uint8_t>(block[pos]);
+    std::string name, value;
+    if (b & 0x80) {  // indexed
+      uint64_t index;
+      if (!DecodeIntAt(block, &pos, 7, &index)) return false;
+      if (!lookup(index, &name, &value)) return false;
+      out->emplace_back(name, value);
+    } else if (b & 0x40) {  // literal with incremental indexing
+      uint64_t index;
+      if (!DecodeIntAt(block, &pos, 6, &index)) return false;
+      if (index != 0) {
+        std::string ignored;
+        if (!lookup(index, &name, &ignored)) return false;
+      } else if (!read_string(&pos, &name)) {
+        return false;
+      }
+      if (!read_string(&pos, &value)) return false;
+      DynInsert(name, value);
+      out->emplace_back(name, value);
+    } else if ((b & 0xE0) == 0x20) {  // dynamic table size update
+      uint64_t size;
+      if (!DecodeIntAt(block, &pos, 5, &size)) return false;
+      dyn_table_max_ = size;
+      while (dyn_table_size_ > dyn_table_max_ && !dyn_table_.empty()) {
+        const auto& back = dyn_table_.back();
+        dyn_table_size_ -= back.first.size() + back.second.size() + 32;
+        dyn_table_.pop_back();
+      }
+    } else {  // literal without indexing / never indexed (4-bit prefix)
+      uint64_t index;
+      if (!DecodeIntAt(block, &pos, 4, &index)) return false;
+      if (index != 0) {
+        std::string ignored;
+        if (!lookup(index, &name, &ignored)) return false;
+      } else if (!read_string(&pos, &name)) {
+        return false;
+      }
+      if (!read_string(&pos, &value)) return false;
+      out->emplace_back(name, value);
+    }
+  }
+  return true;
+}
+
+void Connection::FailAll(const std::string& reason) {
+  std::lock_guard<std::mutex> lk(mu_);
+  dead_ = true;
+  last_error_ = reason;
+  for (auto& kv : streams_) {
+    kv.second->closed = true;
+    kv.second->cv.notify_all();
+  }
+  window_cv_.notify_all();
+}
+
+}  // namespace h2
+}  // namespace tputriton

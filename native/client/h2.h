@@ -1,0 +1,125 @@
+// Minimal HTTP/2 client transport for the native gRPC client.
+//
+// The reference's C++ client rides grpc++ (grpc_client.cc); this image has
+// no grpc++ headers, so the gRPC wire protocol (HTTP/2 + HPACK + 5-byte
+// length-prefixed messages) is implemented natively: own framing and HPACK
+// encoder, response-header decoding via the system libnghttp2 inflater
+// (dlopen'd, stable public ABI) with a non-Huffman fallback decoder.
+//
+// Threading model: one reader thread per connection demultiplexes frames
+// into per-stream states; writers serialize on a write mutex; waiters block
+// on per-stream condition variables. Flow control (connection + stream
+// windows, both directions) is handled here.
+#ifndef TPUTRITON_H2_H_
+#define TPUTRITON_H2_H_
+
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <utility>
+#include <vector>
+
+#include "common.h"
+
+namespace tputriton {
+namespace h2 {
+
+using Headers = std::vector<std::pair<std::string, std::string>>;
+
+struct StreamState {
+  Headers headers;            // response HEADERS (initial)
+  Headers trailers;           // trailing HEADERS
+  bool headers_done = false;
+  bool closed = false;        // END_STREAM seen or RST
+  uint32_t rst_error = 0;
+  bool rst = false;
+  std::string data;           // received DATA bytes (consumer drains)
+  int64_t send_window = 65535;
+  std::condition_variable cv;
+};
+
+class Connection {
+ public:
+  Connection() = default;
+  ~Connection();
+
+  Error Connect(const std::string& host, int port);
+  bool Connected();
+  void Close();
+
+  // Open a gRPC request stream: writes HEADERS (no END_STREAM).
+  Error OpenStream(const std::string& path, const Headers& extra_headers,
+                   int32_t* stream_id);
+  // Send DATA (chunked to max frame size, honoring flow control).
+  Error SendData(int32_t stream_id, const void* data, size_t nbytes,
+                 bool end_stream);
+  // Half-close our side without payload.
+  Error CloseSend(int32_t stream_id);
+  Error Reset(int32_t stream_id, uint32_t error_code);
+
+  // Block until the stream has >= nbytes of DATA, is closed, or timed out.
+  // Drains up to nbytes into *out (all available if nbytes == 0 and closed).
+  // Returns false on timeout.
+  bool WaitData(int32_t stream_id, size_t nbytes, int64_t timeout_ms,
+                std::string* out);
+  // Block until END_STREAM (trailers available) or timeout.
+  bool WaitClosed(int32_t stream_id, int64_t timeout_ms);
+
+  Headers ResponseHeaders(int32_t stream_id);
+  Headers Trailers(int32_t stream_id);
+  bool StreamReset(int32_t stream_id, uint32_t* error_code);
+  void ReleaseStream(int32_t stream_id);
+
+  const std::string& LastError();
+  bool Dead();
+  const std::string& Authority() const { return authority_; }
+
+ private:
+  Error Handshake();
+  Error WriteFrame(uint8_t type, uint8_t flags, int32_t stream_id,
+                   const void* payload, size_t nbytes);
+  void ReaderLoop();
+  void HandleFrame(uint8_t type, uint8_t flags, int32_t stream_id,
+                   const std::string& payload);
+  bool DecodeHeaderBlock(const std::string& block, Headers* out);
+  void FailAll(const std::string& reason);
+
+  std::shared_ptr<StreamState> GetStream(int32_t id);
+
+  int fd_ = -1;
+  std::string authority_;
+  std::mutex write_mu_;
+  std::mutex mu_;  // guards streams_, windows, last_error_
+  std::map<int32_t, std::shared_ptr<StreamState>> streams_;
+  int32_t next_stream_id_ = 1;
+  int64_t conn_send_window_ = 65535;
+  int64_t initial_send_window_ = 65535;
+  uint32_t max_frame_size_ = 16384;
+  std::condition_variable window_cv_;
+  std::thread reader_;
+  bool reader_exit_ = false;
+  bool dead_ = false;
+  std::string last_error_;
+
+  // HPACK decode state (reader thread only).
+  void* inflater_ = nullptr;      // nghttp2_hd_inflater* when available
+  std::string header_block_;      // accumulating HEADERS+CONTINUATION
+  int32_t header_stream_ = 0;
+  bool header_end_stream_ = false;
+  // Fallback decoder dynamic table (name, value), newest first.
+  std::deque<std::pair<std::string, std::string>> dyn_table_;
+  size_t dyn_table_size_ = 0;
+  size_t dyn_table_max_ = 4096;
+  bool DecodeFallback(const std::string& block, Headers* out);
+  void DynInsert(const std::string& name, const std::string& value);
+};
+
+}  // namespace h2
+}  // namespace tputriton
+
+#endif  // TPUTRITON_H2_H_

@@ -245,9 +245,7 @@ Error InferenceServerHttpClient::Create(
 InferenceServerHttpClient::InferenceServerHttpClient(const std::string& url,
                                                      bool verbose)
     : verbose_(verbose) {
-  size_t colon = url.rfind(':');
-  host_ = colon == std::string::npos ? url : url.substr(0, colon);
-  port_ = colon == std::string::npos ? 80 : std::atoi(url.c_str() + colon + 1);
+  ParseHostPort(url, 80, &host_, &port_);  // scheme pre-checked in Create
   conn_.reset(new HttpConnection(host_, port_));
   worker_ = std::thread(&InferenceServerHttpClient::AsyncWorker, this);
 }

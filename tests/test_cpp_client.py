@@ -35,13 +35,23 @@ def cpp_binaries():
 
 @pytest.fixture(scope="module")
 def server():
-    with InferenceServer(grpc=False) as s:
+    with InferenceServer() as s:
         yield s
 
 
 def test_cpp_client_suite(cpp_binaries, server):
     proc = subprocess.run(
         [os.path.join(cpp_binaries, "client_test"), server.http_address],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr}"
+    assert "ALL PASS" in proc.stdout
+
+
+def test_cpp_grpc_client_suite(cpp_binaries, server):
+    """Native gRPC client (own HTTP/2 + HPACK transport) full surface."""
+    proc = subprocess.run(
+        [os.path.join(cpp_binaries, "grpc_client_test"), server.grpc_address],
         capture_output=True, text=True, timeout=120,
     )
     assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr}"
