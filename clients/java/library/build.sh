@@ -1,0 +1,9 @@
+#!/bin/sh
+# Compile the dependency-free Java client library + examples with plain javac
+# (no Maven required; a pom.xml is provided for IDE/Maven users).
+set -e
+cd "$(dirname "$0")"
+mkdir -p target/classes
+find src/main/java -name '*.java' > target/sources.txt
+javac -d target/classes @target/sources.txt
+echo "compiled $(wc -l < target/sources.txt) files -> target/classes"

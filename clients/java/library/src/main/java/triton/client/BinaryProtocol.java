@@ -1,0 +1,199 @@
+// KServe v2 binary tensor codec: little-endian packed elements, BYTES as
+// 4-byte-LE length-prefixed entries (reference: BinaryProtocol.java:49-119
+// toBytes overloads + the fromBytes decoders in InferResult).
+package triton.client;
+
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.nio.charset.StandardCharsets;
+import java.util.ArrayList;
+import java.util.List;
+
+import triton.client.pojo.DataType;
+
+public final class BinaryProtocol {
+
+  private BinaryProtocol() {}
+
+  private static ByteBuffer alloc(int nbytes) {
+    return ByteBuffer.allocate(nbytes).order(ByteOrder.LITTLE_ENDIAN);
+  }
+
+  public static byte[] toBytes(boolean[] values) {
+    ByteBuffer b = alloc(values.length);
+    for (boolean v : values) b.put((byte) (v ? 1 : 0));
+    return b.array();
+  }
+
+  public static byte[] toBytes(byte[] values) { return values.clone(); }
+
+  public static byte[] toBytes(short[] values) {
+    ByteBuffer b = alloc(values.length * 2);
+    for (short v : values) b.putShort(v);
+    return b.array();
+  }
+
+  public static byte[] toBytes(int[] values) {
+    ByteBuffer b = alloc(values.length * 4);
+    for (int v : values) b.putInt(v);
+    return b.array();
+  }
+
+  public static byte[] toBytes(long[] values) {
+    ByteBuffer b = alloc(values.length * 8);
+    for (long v : values) b.putLong(v);
+    return b.array();
+  }
+
+  public static byte[] toBytes(float[] values) {
+    ByteBuffer b = alloc(values.length * 4);
+    for (float v : values) b.putFloat(v);
+    return b.array();
+  }
+
+  public static byte[] toBytes(double[] values) {
+    ByteBuffer b = alloc(values.length * 8);
+    for (double v : values) b.putDouble(v);
+    return b.array();
+  }
+
+  /** FP16 from float (round-to-nearest-even via the float32 route). */
+  public static byte[] toFp16Bytes(float[] values) {
+    ByteBuffer b = alloc(values.length * 2);
+    for (float v : values) b.putShort(floatToHalf(v));
+    return b.array();
+  }
+
+  /** BF16 from float (round-to-nearest-even truncation). */
+  public static byte[] toBf16Bytes(float[] values) {
+    ByteBuffer b = alloc(values.length * 2);
+    for (float v : values) {
+      if (Float.isNaN(v)) {
+        // Rounding a small-mantissa NaN would collapse it to Infinity.
+        b.putShort((short) 0x7FC0);
+        continue;
+      }
+      int bits = Float.floatToIntBits(v);
+      int rounded = bits + 0x7FFF + ((bits >>> 16) & 1);
+      b.putShort((short) (rounded >>> 16));
+    }
+    return b.array();
+  }
+
+  /** BYTES elements: 4-byte LE length prefix per element. */
+  public static byte[] toBytes(String[] values) {
+    int total = 0;
+    byte[][] encoded = new byte[values.length][];
+    for (int i = 0; i < values.length; i++) {
+      encoded[i] = values[i].getBytes(StandardCharsets.UTF_8);
+      total += 4 + encoded[i].length;
+    }
+    ByteBuffer b = alloc(total);
+    for (byte[] e : encoded) {
+      b.putInt(e.length);
+      b.put(e);
+    }
+    return b.array();
+  }
+
+  // -- decoders --------------------------------------------------------------
+
+  public static boolean[] toBoolArray(byte[] data) {
+    boolean[] out = new boolean[data.length];
+    for (int i = 0; i < data.length; i++) out[i] = data[i] != 0;
+    return out;
+  }
+
+  public static int[] toIntArray(byte[] data) {
+    ByteBuffer b = ByteBuffer.wrap(data).order(ByteOrder.LITTLE_ENDIAN);
+    int[] out = new int[data.length / 4];
+    for (int i = 0; i < out.length; i++) out[i] = b.getInt();
+    return out;
+  }
+
+  public static long[] toLongArray(byte[] data) {
+    ByteBuffer b = ByteBuffer.wrap(data).order(ByteOrder.LITTLE_ENDIAN);
+    long[] out = new long[data.length / 8];
+    for (int i = 0; i < out.length; i++) out[i] = b.getLong();
+    return out;
+  }
+
+  public static short[] toShortArray(byte[] data) {
+    ByteBuffer b = ByteBuffer.wrap(data).order(ByteOrder.LITTLE_ENDIAN);
+    short[] out = new short[data.length / 2];
+    for (int i = 0; i < out.length; i++) out[i] = b.getShort();
+    return out;
+  }
+
+  public static float[] toFloatArray(byte[] data) {
+    ByteBuffer b = ByteBuffer.wrap(data).order(ByteOrder.LITTLE_ENDIAN);
+    float[] out = new float[data.length / 4];
+    for (int i = 0; i < out.length; i++) out[i] = b.getFloat();
+    return out;
+  }
+
+  public static double[] toDoubleArray(byte[] data) {
+    ByteBuffer b = ByteBuffer.wrap(data).order(ByteOrder.LITTLE_ENDIAN);
+    double[] out = new double[data.length / 8];
+    for (int i = 0; i < out.length; i++) out[i] = b.getDouble();
+    return out;
+  }
+
+  /** FP16/BF16 payloads decoded up to float. */
+  public static float[] halfToFloatArray(byte[] data, DataType dtype) {
+    ByteBuffer b = ByteBuffer.wrap(data).order(ByteOrder.LITTLE_ENDIAN);
+    float[] out = new float[data.length / 2];
+    for (int i = 0; i < out.length; i++) {
+      short v = b.getShort();
+      if (dtype == DataType.BF16) {
+        out[i] = Float.intBitsToFloat((v & 0xFFFF) << 16);
+      } else {
+        out[i] = halfToFloat(v);
+      }
+    }
+    return out;
+  }
+
+  public static String[] toStringArray(byte[] data) {
+    ByteBuffer b = ByteBuffer.wrap(data).order(ByteOrder.LITTLE_ENDIAN);
+    List<String> out = new ArrayList<>();
+    while (b.remaining() >= 4) {
+      int len = b.getInt();
+      if (len < 0 || len > b.remaining()) {
+        throw new IllegalArgumentException("malformed BYTES tensor");
+      }
+      byte[] e = new byte[len];
+      b.get(e);
+      out.add(new String(e, StandardCharsets.UTF_8));
+    }
+    return out.toArray(new String[0]);
+  }
+
+  static short floatToHalf(float f) {
+    int bits = Float.floatToIntBits(f);
+    int sign = (bits >>> 16) & 0x8000;
+    if (Float.isNaN(f)) return (short) (sign | 0x7E00);  // quiet NaN, not Inf
+    int exp = ((bits >>> 23) & 0xFF) - 127 + 15;
+    int mant = bits & 0x7FFFFF;
+    if (exp >= 31) return (short) (sign | 0x7C00);
+    if (exp <= 0) return (short) sign;
+    int halfMant = mant >>> 13;
+    if ((mant & 0x1000) != 0) halfMant++;
+    return (short) (sign | (exp << 10) | halfMant);
+  }
+
+  static float halfToFloat(short h) {
+    int sign = (h & 0x8000) << 16;
+    int exp = (h >>> 10) & 0x1F;
+    int mant = h & 0x3FF;
+    int bits;
+    if (exp == 0) {
+      bits = sign;
+    } else if (exp == 31) {
+      bits = sign | 0x7F800000 | (mant << 13);
+    } else {
+      bits = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+    }
+    return Float.intBitsToFloat(bits);
+  }
+}

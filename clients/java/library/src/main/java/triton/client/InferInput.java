@@ -1,0 +1,164 @@
+// Input tensor builder (reference: src/java/.../InferInput.java, 377 LoC):
+// typed setData overloads fill the binary payload; setSharedMemory swaps the
+// payload for region parameters.
+package triton.client;
+
+import triton.client.pojo.DataType;
+import triton.client.pojo.IOTensor;
+
+public class InferInput {
+  private final String name;
+  private final long[] shape;
+  private final DataType datatype;
+  private byte[] data;
+  private boolean binaryData = true;
+  private String shmName;
+  private long shmByteSize;
+  private long shmOffset;
+
+  public InferInput(String name, long[] shape, DataType datatype) {
+    this.name = name;
+    this.shape = shape.clone();
+    this.datatype = datatype;
+  }
+
+  public String getName() { return name; }
+  public DataType getDatatype() { return datatype; }
+  public long[] getShape() { return shape.clone(); }
+
+  public void setData(boolean[] values, boolean binary) {
+    setRaw(BinaryProtocol.toBytes(values), binary);
+  }
+
+  public void setData(byte[] values, boolean binary) {
+    setRaw(BinaryProtocol.toBytes(values), binary);
+  }
+
+  public void setData(short[] values, boolean binary) {
+    setRaw(BinaryProtocol.toBytes(values), binary);
+  }
+
+  public void setData(int[] values, boolean binary) {
+    setRaw(BinaryProtocol.toBytes(values), binary);
+  }
+
+  public void setData(long[] values, boolean binary) {
+    setRaw(BinaryProtocol.toBytes(values), binary);
+  }
+
+  public void setData(float[] values, boolean binary) {
+    if (datatype == DataType.FP16) {
+      setRaw(BinaryProtocol.toFp16Bytes(values), binary);
+    } else if (datatype == DataType.BF16) {
+      setRaw(BinaryProtocol.toBf16Bytes(values), binary);
+    } else {
+      setRaw(BinaryProtocol.toBytes(values), binary);
+    }
+  }
+
+  public void setData(double[] values, boolean binary) {
+    setRaw(BinaryProtocol.toBytes(values), binary);
+  }
+
+  public void setData(String[] values, boolean binary) {
+    setRaw(BinaryProtocol.toBytes(values), binary);
+  }
+
+  private void setRaw(byte[] encoded, boolean binary) {
+    this.data = encoded;
+    this.binaryData = binary;
+    this.shmName = null;
+  }
+
+  public void setSharedMemory(String regionName, long byteSize, long offset) {
+    this.shmName = regionName;
+    this.shmByteSize = byteSize;
+    this.shmOffset = offset;
+    this.data = null;
+  }
+
+  public boolean isBinaryData() { return binaryData && shmName == null; }
+  public boolean usesSharedMemory() { return shmName != null; }
+  public byte[] getData() { return data; }
+
+  /** Wire descriptor; binary payload (if any) travels after the JSON. */
+  public IOTensor toTensor() {
+    IOTensor t = new IOTensor();
+    t.setName(name);
+    t.setDatatype(datatype.name());
+    t.setShape(shape);
+    if (shmName != null) {
+      t.getParameters().put("shared_memory_region", shmName);
+      t.getParameters().put("shared_memory_byte_size", shmByteSize);
+      if (shmOffset != 0) {
+        t.getParameters().put("shared_memory_offset", shmOffset);
+      }
+    } else if (binaryData) {
+      t.getParameters().put("binary_data_size", (long) data.length);
+    } else {
+      t.setData(jsonData());
+    }
+    return t;
+  }
+
+  /** JSON "data" array for SetBinaryData(false) mode (flat row-major). */
+  private Json jsonData() {
+    Json arr = Json.array();
+    switch (datatype) {
+      case BOOL: {
+        for (boolean v : BinaryProtocol.toBoolArray(data)) {
+          arr.add(Json.of(v));
+        }
+        break;
+      }
+      case INT8:
+      case UINT8: {
+        for (byte v : data) arr.add(Json.of((long) v));
+        break;
+      }
+      case INT16:
+      case UINT16: {
+        for (short v : BinaryProtocol.toShortArray(data)) {
+          arr.add(Json.of((long) v));
+        }
+        break;
+      }
+      case INT32:
+      case UINT32: {
+        for (int v : BinaryProtocol.toIntArray(data)) arr.add(Json.of((long) v));
+        break;
+      }
+      case INT64:
+      case UINT64: {
+        for (long v : BinaryProtocol.toLongArray(data)) arr.add(Json.of(v));
+        break;
+      }
+      case FP16:
+      case BF16: {
+        for (float v : BinaryProtocol.halfToFloatArray(data, datatype)) {
+          arr.add(Json.of((double) v));
+        }
+        break;
+      }
+      case FP32: {
+        for (float v : BinaryProtocol.toFloatArray(data)) {
+          arr.add(Json.of((double) v));
+        }
+        break;
+      }
+      case FP64: {
+        for (double v : BinaryProtocol.toDoubleArray(data)) {
+          arr.add(Json.of(v));
+        }
+        break;
+      }
+      case BYTES: {
+        for (String v : BinaryProtocol.toStringArray(data)) {
+          arr.add(Json.of(v));
+        }
+        break;
+      }
+    }
+    return arr;
+  }
+}

@@ -1,0 +1,53 @@
+// Requested-output descriptor (reference: src/java/.../InferRequestedOutput.java).
+package triton.client;
+
+import triton.client.pojo.IOTensor;
+
+public class InferRequestedOutput {
+  private final String name;
+  private final boolean binaryData;
+  private final int classCount;
+  private String shmName;
+  private long shmByteSize;
+  private long shmOffset;
+
+  public InferRequestedOutput(String name) {
+    this(name, true, 0);
+  }
+
+  public InferRequestedOutput(String name, boolean binaryData) {
+    this(name, binaryData, 0);
+  }
+
+  public InferRequestedOutput(String name, boolean binaryData, int classCount) {
+    this.name = name;
+    this.binaryData = binaryData;
+    this.classCount = classCount;
+  }
+
+  public String getName() { return name; }
+
+  public void setSharedMemory(String regionName, long byteSize, long offset) {
+    this.shmName = regionName;
+    this.shmByteSize = byteSize;
+    this.shmOffset = offset;
+  }
+
+  public IOTensor toTensor() {
+    IOTensor t = new IOTensor();
+    t.setName(name);
+    if (shmName != null) {
+      t.getParameters().put("shared_memory_region", shmName);
+      t.getParameters().put("shared_memory_byte_size", shmByteSize);
+      if (shmOffset != 0) {
+        t.getParameters().put("shared_memory_offset", shmOffset);
+      }
+    } else {
+      if (binaryData) t.getParameters().put("binary_data", true);
+      if (classCount > 0) {
+        t.getParameters().put("classification", (long) classCount);
+      }
+    }
+    return t;
+  }
+}

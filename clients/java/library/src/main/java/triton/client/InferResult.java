@@ -1,0 +1,195 @@
+// Inference result: JSON header split at Inference-Header-Content-Length,
+// per-output views into the trailing binary buffer (reference:
+// src/java/.../InferResult.java, 333 LoC).
+package triton.client;
+
+import java.nio.charset.StandardCharsets;
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+import triton.client.pojo.DataType;
+import triton.client.pojo.IOTensor;
+import triton.client.pojo.InferenceResponse;
+
+public class InferResult {
+  private final InferenceResponse response;
+  private final Map<String, byte[]> binaryOutputs = new LinkedHashMap<>();
+
+  /**
+   * @param body full response body
+   * @param jsonSize value of Inference-Header-Content-Length (body length if
+   *     the response is pure JSON)
+   */
+  public InferResult(byte[] body, int jsonSize) throws InferenceException {
+    String header = new String(body, 0, jsonSize, StandardCharsets.UTF_8);
+    Json parsed;
+    try {
+      parsed = Json.parse(header);
+    } catch (IllegalArgumentException e) {
+      throw new InferenceException("malformed inference response: " + e, e);
+    }
+    this.response = InferenceResponse.fromJson(parsed);
+    int offset = jsonSize;
+    for (IOTensor out : response.getOutputs()) {
+      Object binSize = out.getParameters().get("binary_data_size");
+      if (binSize instanceof Long) {
+        int nbytes = ((Long) binSize).intValue();
+        if (offset + nbytes > body.length) {
+          throw new InferenceException("binary_data_size overruns body");
+        }
+        byte[] data = new byte[nbytes];
+        System.arraycopy(body, offset, data, 0, nbytes);
+        binaryOutputs.put(out.getName(), data);
+        offset += nbytes;
+      }
+    }
+  }
+
+  public String getModelName() { return response.getModelName(); }
+  public String getModelVersion() { return response.getModelVersion(); }
+  public String getId() { return response.getId(); }
+
+  public List<String> getOutputs() {
+    List<String> names = new ArrayList<>();
+    for (IOTensor out : response.getOutputs()) names.add(out.getName());
+    return names;
+  }
+
+  public IOTensor getOutput(String name) {
+    for (IOTensor out : response.getOutputs()) {
+      if (out.getName().equals(name)) return out;
+    }
+    return null;
+  }
+
+  public long[] getShape(String name) {
+    IOTensor out = getOutput(name);
+    return out == null ? null : out.getShape();
+  }
+
+  /** Raw little-endian bytes of an output (binary mode), or null. */
+  public byte[] getOutputAsBytes(String name) throws InferenceException {
+    byte[] binary = binaryOutputs.get(name);
+    if (binary != null) return binary;
+    IOTensor out = getOutput(name);
+    if (out == null) {
+      throw new InferenceException("no output named '" + name + "'");
+    }
+    if (out.getData() == null) return null;  // e.g. routed to shared memory
+    return jsonDataToBytes(out);
+  }
+
+  public int[] getOutputAsInt(String name) throws InferenceException {
+    return BinaryProtocol.toIntArray(requireBytes(name));
+  }
+
+  public long[] getOutputAsLong(String name) throws InferenceException {
+    return BinaryProtocol.toLongArray(requireBytes(name));
+  }
+
+  public float[] getOutputAsFloat(String name) throws InferenceException {
+    IOTensor out = getOutput(name);
+    byte[] raw = requireBytes(name);
+    if (out != null
+        && (DataType.FP16.name().equals(out.getDatatype())
+            || DataType.BF16.name().equals(out.getDatatype()))) {
+      return BinaryProtocol.halfToFloatArray(raw, out.getDataTypeEnum());
+    }
+    return BinaryProtocol.toFloatArray(raw);
+  }
+
+  public double[] getOutputAsDouble(String name) throws InferenceException {
+    return BinaryProtocol.toDoubleArray(requireBytes(name));
+  }
+
+  public boolean[] getOutputAsBool(String name) throws InferenceException {
+    return BinaryProtocol.toBoolArray(requireBytes(name));
+  }
+
+  public String[] getOutputAsString(String name) throws InferenceException {
+    return BinaryProtocol.toStringArray(requireBytes(name));
+  }
+
+  private byte[] requireBytes(String name) throws InferenceException {
+    byte[] raw = getOutputAsBytes(name);
+    if (raw == null) {
+      throw new InferenceException(
+          "output '" + name + "' has no inline data (shared memory?)");
+    }
+    return raw;
+  }
+
+  private static byte[] jsonDataToBytes(IOTensor out) throws InferenceException {
+    DataType dtype = out.getDataTypeEnum();
+    List<Json> flat = new ArrayList<>();
+    flatten(out.getData(), flat);
+    switch (dtype) {
+      case BOOL: {
+        boolean[] v = new boolean[flat.size()];
+        for (int i = 0; i < v.length; i++) v[i] = flat.get(i).asBool();
+        return BinaryProtocol.toBytes(v);
+      }
+      case INT8:
+      case UINT8: {
+        byte[] v = new byte[flat.size()];
+        for (int i = 0; i < v.length; i++) v[i] = (byte) flat.get(i).asLong();
+        return v;
+      }
+      case INT16:
+      case UINT16: {
+        short[] v = new short[flat.size()];
+        for (int i = 0; i < v.length; i++) v[i] = (short) flat.get(i).asLong();
+        return BinaryProtocol.toBytes(v);
+      }
+      case INT32:
+      case UINT32: {
+        int[] v = new int[flat.size()];
+        for (int i = 0; i < v.length; i++) v[i] = flat.get(i).asInt();
+        return BinaryProtocol.toBytes(v);
+      }
+      case INT64:
+      case UINT64: {
+        long[] v = new long[flat.size()];
+        for (int i = 0; i < v.length; i++) v[i] = flat.get(i).asLong();
+        return BinaryProtocol.toBytes(v);
+      }
+      case FP16: {
+        float[] v = new float[flat.size()];
+        for (int i = 0; i < v.length; i++) v[i] = (float) flat.get(i).asDouble();
+        return BinaryProtocol.toFp16Bytes(v);
+      }
+      case BF16: {
+        float[] v = new float[flat.size()];
+        for (int i = 0; i < v.length; i++) v[i] = (float) flat.get(i).asDouble();
+        return BinaryProtocol.toBf16Bytes(v);
+      }
+      case FP32: {
+        float[] v = new float[flat.size()];
+        for (int i = 0; i < v.length; i++) v[i] = (float) flat.get(i).asDouble();
+        return BinaryProtocol.toBytes(v);
+      }
+      case FP64: {
+        double[] v = new double[flat.size()];
+        for (int i = 0; i < v.length; i++) v[i] = flat.get(i).asDouble();
+        return BinaryProtocol.toBytes(v);
+      }
+      case BYTES: {
+        String[] v = new String[flat.size()];
+        for (int i = 0; i < v.length; i++) v[i] = flat.get(i).asString();
+        return BinaryProtocol.toBytes(v);
+      }
+      default:
+        throw new InferenceException("unsupported datatype " + dtype);
+    }
+  }
+
+  private static void flatten(Json value, List<Json> out) {
+    if (value.type() == Json.Type.ARRAY) {
+      for (Json v : value.asArray()) flatten(v, out);
+    } else {
+      out.add(value);
+    }
+  }
+}

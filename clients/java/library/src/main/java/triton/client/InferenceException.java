@@ -1,0 +1,23 @@
+// Client exception type (reference: src/java/.../InferenceException.java).
+package triton.client;
+
+public class InferenceException extends Exception {
+  private final int statusCode;
+
+  public InferenceException(String message) {
+    this(message, -1);
+  }
+
+  public InferenceException(String message, int statusCode) {
+    super(message);
+    this.statusCode = statusCode;
+  }
+
+  public InferenceException(String message, Throwable cause) {
+    super(message, cause);
+    this.statusCode = -1;
+  }
+
+  /** HTTP status code when the server rejected the request; -1 otherwise. */
+  public int getStatusCode() { return statusCode; }
+}

@@ -1,0 +1,347 @@
+// KServe v2 HTTP client (reference: src/java/.../InferenceServerClient.java:
+// 73-368 — pooled async IO + retry + infer with the binary protocol). This
+// implementation rides the JDK's java.net.http HttpClient (pooled, async)
+// instead of Apache HttpAsyncClient so the library has zero dependencies.
+package triton.client;
+
+import java.io.ByteArrayOutputStream;
+import java.io.IOException;
+import java.net.URI;
+import java.net.http.HttpClient;
+import java.net.http.HttpRequest;
+import java.net.http.HttpResponse;
+import java.nio.charset.StandardCharsets;
+import java.time.Duration;
+import java.util.List;
+import java.util.concurrent.CompletableFuture;
+
+import triton.client.endpoint.AbstractEndpoint;
+import triton.client.endpoint.FixedEndpoint;
+import triton.client.pojo.IOTensor;
+
+public class InferenceServerClient implements AutoCloseable {
+
+  private final AbstractEndpoint endpoint;
+  private final HttpClient http;
+  private final Duration requestTimeout;
+  private int maxRetryCount = 0;
+
+  public InferenceServerClient(String url, long connectTimeoutMs,
+                               long networkTimeoutMs) {
+    this(new FixedEndpoint(url), connectTimeoutMs, networkTimeoutMs);
+  }
+
+  public InferenceServerClient(AbstractEndpoint endpoint, long connectTimeoutMs,
+                               long networkTimeoutMs) {
+    this.endpoint = endpoint;
+    this.http = HttpClient.newBuilder()
+        .version(HttpClient.Version.HTTP_1_1)
+        .connectTimeout(Duration.ofMillis(connectTimeoutMs))
+        .build();
+    this.requestTimeout = Duration.ofMillis(networkTimeoutMs);
+  }
+
+  /** Retries for idempotent requests on IO errors (reference :245). */
+  public void setMaxRetryCount(int maxRetryCount) {
+    this.maxRetryCount = Math.max(0, maxRetryCount);
+  }
+
+  @Override
+  public void close() {}
+
+  // -- plumbing --------------------------------------------------------------
+
+  private String baseUrl() throws InferenceException {
+    try {
+      return "http://" + endpoint.getUrl();
+    } catch (Exception e) {
+      throw new InferenceException("endpoint resolution failed: " + e, e);
+    }
+  }
+
+  private HttpResponse<byte[]> send(HttpRequest request)
+      throws InferenceException {
+    return send(request, true);
+  }
+
+  /**
+   * {@code retriable=false} for non-idempotent requests (inference): a
+   * timeout is an IOException too, and re-sending a timed-out infer would
+   * re-execute it (e.g. double-stepping a sequence model).
+   */
+  private HttpResponse<byte[]> send(HttpRequest request, boolean retriable)
+      throws InferenceException {
+    IOException last = null;
+    int attempts = retriable ? maxRetryCount + 1 : 1;
+    for (int attempt = 0; attempt < attempts; attempt++) {
+      try {
+        return http.send(request, HttpResponse.BodyHandlers.ofByteArray());
+      } catch (IOException e) {
+        last = e;
+      } catch (InterruptedException e) {
+        Thread.currentThread().interrupt();
+        throw new InferenceException("interrupted", e);
+      }
+    }
+    throw new InferenceException("request failed: " + last, last);
+  }
+
+  private static void raiseIfError(HttpResponse<byte[]> response)
+      throws InferenceException {
+    if (response.statusCode() >= 200 && response.statusCode() < 300) return;
+    String body = new String(response.body(), StandardCharsets.UTF_8);
+    String message = body;
+    try {
+      Json parsed = Json.parse(body);
+      if (parsed.get("error") != null) message = parsed.get("error").asString();
+    } catch (IllegalArgumentException ignored) {
+      // non-JSON error body; use it verbatim
+    }
+    throw new InferenceException(message, response.statusCode());
+  }
+
+  private Json getJson(String path) throws InferenceException {
+    HttpRequest request = HttpRequest.newBuilder()
+        .uri(URI.create(baseUrl() + "/" + path))
+        .timeout(requestTimeout)
+        .GET()
+        .build();
+    HttpResponse<byte[]> response = send(request);
+    raiseIfError(response);
+    String body = new String(response.body(), StandardCharsets.UTF_8);
+    return Json.parse(body.isEmpty() ? "{}" : body);
+  }
+
+  private Json postJson(String path, String body) throws InferenceException {
+    HttpRequest request = HttpRequest.newBuilder()
+        .uri(URI.create(baseUrl() + "/" + path))
+        .timeout(requestTimeout)
+        .header("Content-Type", "application/json")
+        .POST(HttpRequest.BodyPublishers.ofString(body))
+        .build();
+    HttpResponse<byte[]> response = send(request);
+    raiseIfError(response);
+    String rbody = new String(response.body(), StandardCharsets.UTF_8);
+    return Json.parse(rbody.isEmpty() ? "{}" : rbody);
+  }
+
+  private int statusOf(String path) throws InferenceException {
+    HttpRequest request = HttpRequest.newBuilder()
+        .uri(URI.create(baseUrl() + "/" + path))
+        .timeout(requestTimeout)
+        .GET()
+        .build();
+    return send(request).statusCode();
+  }
+
+  // -- health / metadata -----------------------------------------------------
+
+  public boolean isServerLive() throws InferenceException {
+    return statusOf("v2/health/live") == 200;
+  }
+
+  public boolean isServerReady() throws InferenceException {
+    return statusOf("v2/health/ready") == 200;
+  }
+
+  public boolean isModelReady(String modelName) throws InferenceException {
+    return statusOf("v2/models/" + modelName + "/ready") == 200;
+  }
+
+  public Json getServerMetadata() throws InferenceException {
+    return getJson("v2");
+  }
+
+  public Json getModelMetadata(String modelName) throws InferenceException {
+    return getJson("v2/models/" + modelName);
+  }
+
+  public Json getModelConfig(String modelName) throws InferenceException {
+    return getJson("v2/models/" + modelName + "/config");
+  }
+
+  public Json getModelRepositoryIndex() throws InferenceException {
+    return postJson("v2/repository/index", "{}");
+  }
+
+  public void loadModel(String modelName) throws InferenceException {
+    postJson("v2/repository/models/" + modelName + "/load", "{}");
+  }
+
+  public void unloadModel(String modelName) throws InferenceException {
+    postJson("v2/repository/models/" + modelName + "/unload", "{}");
+  }
+
+  public Json getInferenceStatistics(String modelName)
+      throws InferenceException {
+    return getJson("v2/models/" + modelName + "/stats");
+  }
+
+  // -- shared memory admin ---------------------------------------------------
+
+  public void registerSystemSharedMemory(String name, String key, long byteSize,
+                                         long offset)
+      throws InferenceException {
+    Json body = Json.object()
+        .put("key", key)
+        .put("offset", offset)
+        .put("byte_size", byteSize);
+    postJson("v2/systemsharedmemory/region/" + name + "/register",
+             body.serialize());
+  }
+
+  public void unregisterSystemSharedMemory(String name)
+      throws InferenceException {
+    String path = name == null || name.isEmpty()
+        ? "v2/systemsharedmemory/unregister"
+        : "v2/systemsharedmemory/region/" + name + "/unregister";
+    postJson(path, "{}");
+  }
+
+  public Json getSystemSharedMemoryStatus() throws InferenceException {
+    return getJson("v2/systemsharedmemory/status");
+  }
+
+  // -- inference -------------------------------------------------------------
+
+  public InferResult infer(String modelName, List<InferInput> inputs,
+                           List<InferRequestedOutput> outputs)
+      throws InferenceException {
+    return infer(new InferArguments(modelName, inputs, outputs));
+  }
+
+  public InferResult infer(InferArguments args) throws InferenceException {
+    HttpRequest request = buildInferRequest(args);
+    HttpResponse<byte[]> response = send(request, false);
+    raiseIfError(response);
+    return parseInferResponse(response);
+  }
+
+  /** Async inference over the pooled JDK client (reference :368). */
+  public CompletableFuture<InferResult> inferAsync(InferArguments args)
+      throws InferenceException {
+    HttpRequest request = buildInferRequest(args);
+    return http.sendAsync(request, HttpResponse.BodyHandlers.ofByteArray())
+        .thenApply(response -> {
+          try {
+            raiseIfError(response);
+            return parseInferResponse(response);
+          } catch (InferenceException e) {
+            throw new java.util.concurrent.CompletionException(e);
+          }
+        });
+  }
+
+  private HttpRequest buildInferRequest(InferArguments args)
+      throws InferenceException {
+    Json header = Json.object();
+    if (args.requestId != null && !args.requestId.isEmpty()) {
+      header.put("id", args.requestId);
+    }
+    Json params = Json.object();
+    if (args.sequenceId != 0) {
+      params.put("sequence_id", args.sequenceId);
+      params.put("sequence_start", args.sequenceStart);
+      params.put("sequence_end", args.sequenceEnd);
+    }
+    if (args.priority != 0) params.put("priority", args.priority);
+    if (args.timeoutMicros != 0) params.put("timeout", args.timeoutMicros);
+    if (params.size() > 0) header.put("parameters", params);
+
+    Json inputsJson = Json.array();
+    ByteArrayOutputStream blobs = new ByteArrayOutputStream();
+    for (InferInput input : args.inputs) {
+      inputsJson.add(input.toTensor().toJson());
+      if (input.isBinaryData() && input.getData() != null) {
+        blobs.writeBytes(input.getData());
+      }
+    }
+    header.put("inputs", inputsJson);
+    if (args.outputs != null && !args.outputs.isEmpty()) {
+      Json outputsJson = Json.array();
+      for (InferRequestedOutput out : args.outputs) {
+        outputsJson.add(out.toTensor().toJson());
+      }
+      header.put("outputs", outputsJson);
+    }
+
+    byte[] headerBytes = header.serialize().getBytes(StandardCharsets.UTF_8);
+    ByteArrayOutputStream body = new ByteArrayOutputStream();
+    body.writeBytes(headerBytes);
+    body.writeBytes(blobs.toByteArray());
+
+    String path = "v2/models/" + args.modelName;
+    if (args.modelVersion != null && !args.modelVersion.isEmpty()) {
+      path += "/versions/" + args.modelVersion;
+    }
+    path += "/infer";
+    return HttpRequest.newBuilder()
+        .uri(URI.create(baseUrl() + "/" + path))
+        .timeout(requestTimeout)
+        .header("Content-Type", "application/octet-stream")
+        .header("Inference-Header-Content-Length",
+                String.valueOf(headerBytes.length))
+        .POST(HttpRequest.BodyPublishers.ofByteArray(body.toByteArray()))
+        .build();
+  }
+
+  private static InferResult parseInferResponse(HttpResponse<byte[]> response)
+      throws InferenceException {
+    byte[] body = response.body();
+    int jsonSize = body.length;
+    var headerValue =
+        response.headers().firstValue("inference-header-content-length");
+    if (headerValue.isPresent()) {
+      try {
+        jsonSize = Integer.parseInt(headerValue.get());
+      } catch (NumberFormatException e) {
+        throw new InferenceException(
+            "invalid Inference-Header-Content-Length: " + headerValue.get());
+      }
+      if (jsonSize < 0 || jsonSize > body.length) {
+        throw new InferenceException(
+            "Inference-Header-Content-Length out of range");
+      }
+    }
+    return new InferResult(body, jsonSize);
+  }
+
+  /** Bundled infer parameters (reference passes these as call arguments). */
+  public static class InferArguments {
+    public final String modelName;
+    public final List<InferInput> inputs;
+    public final List<InferRequestedOutput> outputs;
+    public String modelVersion = "";
+    public String requestId = "";
+    public long sequenceId = 0;
+    public boolean sequenceStart = false;
+    public boolean sequenceEnd = false;
+    public long priority = 0;
+    public long timeoutMicros = 0;
+
+    public InferArguments(String modelName, List<InferInput> inputs,
+                          List<InferRequestedOutput> outputs) {
+      this.modelName = modelName;
+      this.inputs = inputs;
+      this.outputs = outputs;
+    }
+
+    public InferArguments sequence(long id, boolean start, boolean end) {
+      this.sequenceId = id;
+      this.sequenceStart = start;
+      this.sequenceEnd = end;
+      return this;
+    }
+  }
+
+  /** Helper mirroring the reference Util class. */
+  public static final class Util {
+    private Util() {}
+
+    public static long elementCount(long[] shape) {
+      long n = 1;
+      for (long d : shape) n *= d;
+      return n;
+    }
+  }
+}

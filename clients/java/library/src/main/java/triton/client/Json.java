@@ -1,0 +1,365 @@
+// Minimal JSON value/parser/writer used by the client library.
+//
+// The reference's Java client (src/java/.../InferenceServerClient.java) pulls
+// in Alibaba fastjson; this library is dependency-free on purpose so it
+// builds offline with nothing but a JDK.
+package triton.client;
+
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+public final class Json {
+
+  public enum Type { NULL, BOOL, NUMBER, STRING, ARRAY, OBJECT }
+
+  private final Type type;
+  private boolean boolValue;
+  private double numValue;
+  private long intValue;
+  private boolean isInt;
+  private String strValue;
+  private List<Json> arrayValue;
+  private Map<String, Json> objectValue;
+
+  private Json(Type type) { this.type = type; }
+
+  public static Json ofNull() { return new Json(Type.NULL); }
+
+  public static Json of(boolean b) {
+    Json v = new Json(Type.BOOL);
+    v.boolValue = b;
+    return v;
+  }
+
+  public static Json of(long i) {
+    Json v = new Json(Type.NUMBER);
+    v.intValue = i;
+    v.numValue = i;
+    v.isInt = true;
+    return v;
+  }
+
+  public static Json of(double d) {
+    Json v = new Json(Type.NUMBER);
+    v.numValue = d;
+    v.intValue = (long) d;
+    return v;
+  }
+
+  public static Json of(String s) {
+    Json v = new Json(Type.STRING);
+    v.strValue = s;
+    return v;
+  }
+
+  public static Json array() {
+    Json v = new Json(Type.ARRAY);
+    v.arrayValue = new ArrayList<>();
+    return v;
+  }
+
+  public static Json object() {
+    Json v = new Json(Type.OBJECT);
+    v.objectValue = new LinkedHashMap<>();
+    return v;
+  }
+
+  public Type type() { return type; }
+  public boolean isNull() { return type == Type.NULL; }
+  public boolean asBool() { return boolValue; }
+  public double asDouble() { return numValue; }
+  public long asLong() { return isInt ? intValue : (long) numValue; }
+  public int asInt() { return (int) asLong(); }
+  public String asString() { return strValue; }
+  public List<Json> asArray() { return arrayValue; }
+  public Map<String, Json> asObject() { return objectValue; }
+
+  public Json get(String key) {
+    return objectValue == null ? null : objectValue.get(key);
+  }
+
+  public Json get(int index) {
+    return arrayValue == null ? null : arrayValue.get(index);
+  }
+
+  public int size() {
+    if (arrayValue != null) return arrayValue.size();
+    if (objectValue != null) return objectValue.size();
+    return 0;
+  }
+
+  public Json put(String key, Json value) {
+    objectValue.put(key, value);
+    return this;
+  }
+
+  public Json put(String key, String value) { return put(key, of(value)); }
+  public Json put(String key, long value) { return put(key, of(value)); }
+  public Json put(String key, boolean value) { return put(key, of(value)); }
+
+  public Json add(Json value) {
+    arrayValue.add(value);
+    return this;
+  }
+
+  public Json add(long value) { return add(of(value)); }
+  public Json add(String value) { return add(of(value)); }
+
+  // -- serialization ---------------------------------------------------------
+
+  public String serialize() {
+    StringBuilder sb = new StringBuilder();
+    writeTo(sb);
+    return sb.toString();
+  }
+
+  private void writeTo(StringBuilder sb) {
+    switch (type) {
+      case NULL:
+        sb.append("null");
+        break;
+      case BOOL:
+        sb.append(boolValue ? "true" : "false");
+        break;
+      case NUMBER:
+        if (isInt) {
+          sb.append(intValue);
+        } else if (numValue == Math.floor(numValue)
+            && !Double.isInfinite(numValue)
+            && Math.abs(numValue) < 1e15) {
+          sb.append((long) numValue);
+        } else {
+          sb.append(numValue);
+        }
+        break;
+      case STRING:
+        escapeTo(strValue, sb);
+        break;
+      case ARRAY: {
+        sb.append('[');
+        boolean first = true;
+        for (Json v : arrayValue) {
+          if (!first) sb.append(',');
+          first = false;
+          v.writeTo(sb);
+        }
+        sb.append(']');
+        break;
+      }
+      case OBJECT: {
+        sb.append('{');
+        boolean first = true;
+        for (Map.Entry<String, Json> e : objectValue.entrySet()) {
+          if (!first) sb.append(',');
+          first = false;
+          escapeTo(e.getKey(), sb);
+          sb.append(':');
+          e.getValue().writeTo(sb);
+        }
+        sb.append('}');
+        break;
+      }
+    }
+  }
+
+  private static void escapeTo(String s, StringBuilder sb) {
+    sb.append('"');
+    for (int i = 0; i < s.length(); i++) {
+      char c = s.charAt(i);
+      switch (c) {
+        case '"': sb.append("\\\""); break;
+        case '\\': sb.append("\\\\"); break;
+        case '\b': sb.append("\\b"); break;
+        case '\f': sb.append("\\f"); break;
+        case '\n': sb.append("\\n"); break;
+        case '\r': sb.append("\\r"); break;
+        case '\t': sb.append("\\t"); break;
+        default:
+          if (c < 0x20) {
+            sb.append(String.format("\\u%04x", (int) c));
+          } else {
+            sb.append(c);
+          }
+      }
+    }
+    sb.append('"');
+  }
+
+  // -- parsing ---------------------------------------------------------------
+
+  public static Json parse(String text) {
+    Parser p = new Parser(text);
+    Json v = p.parseValue();
+    p.skipWs();
+    if (!p.atEnd()) {
+      throw new IllegalArgumentException("trailing JSON content at " + p.pos);
+    }
+    return v;
+  }
+
+  private static final class Parser {
+    private final String s;
+    private int pos = 0;
+
+    Parser(String s) { this.s = s; }
+
+    boolean atEnd() { return pos >= s.length(); }
+
+    void skipWs() {
+      while (pos < s.length() && Character.isWhitespace(s.charAt(pos))) pos++;
+    }
+
+    char peek() {
+      if (atEnd()) throw new IllegalArgumentException("unexpected end of JSON");
+      return s.charAt(pos);
+    }
+
+    void expect(char c) {
+      if (atEnd() || s.charAt(pos) != c) {
+        throw new IllegalArgumentException(
+            "expected '" + c + "' at position " + pos);
+      }
+      pos++;
+    }
+
+    Json parseValue() {
+      skipWs();
+      char c = peek();
+      switch (c) {
+        case '{': return parseObject();
+        case '[': return parseArray();
+        case '"': return Json.of(parseString());
+        case 't':
+          expectWord("true");
+          return Json.of(true);
+        case 'f':
+          expectWord("false");
+          return Json.of(false);
+        case 'n':
+          expectWord("null");
+          return Json.ofNull();
+        default:
+          return parseNumber();
+      }
+    }
+
+    void expectWord(String word) {
+      if (!s.startsWith(word, pos)) {
+        throw new IllegalArgumentException(
+            "invalid JSON literal at position " + pos);
+      }
+      pos += word.length();
+    }
+
+    Json parseObject() {
+      expect('{');
+      Json obj = Json.object();
+      skipWs();
+      if (peek() == '}') {
+        pos++;
+        return obj;
+      }
+      while (true) {
+        skipWs();
+        String key = parseString();
+        skipWs();
+        expect(':');
+        obj.put(key, parseValue());
+        skipWs();
+        char c = peek();
+        pos++;
+        if (c == '}') return obj;
+        if (c != ',') {
+          throw new IllegalArgumentException(
+              "expected ',' or '}' at position " + (pos - 1));
+        }
+      }
+    }
+
+    Json parseArray() {
+      expect('[');
+      Json arr = Json.array();
+      skipWs();
+      if (peek() == ']') {
+        pos++;
+        return arr;
+      }
+      while (true) {
+        arr.add(parseValue());
+        skipWs();
+        char c = peek();
+        pos++;
+        if (c == ']') return arr;
+        if (c != ',') {
+          throw new IllegalArgumentException(
+              "expected ',' or ']' at position " + (pos - 1));
+        }
+      }
+    }
+
+    String parseString() {
+      expect('"');
+      StringBuilder sb = new StringBuilder();
+      while (true) {
+        if (atEnd()) throw new IllegalArgumentException("unterminated string");
+        char c = s.charAt(pos++);
+        if (c == '"') return sb.toString();
+        if (c != '\\') {
+          sb.append(c);
+          continue;
+        }
+        if (atEnd()) throw new IllegalArgumentException("unterminated escape");
+        char e = s.charAt(pos++);
+        switch (e) {
+          case '"': sb.append('"'); break;
+          case '\\': sb.append('\\'); break;
+          case '/': sb.append('/'); break;
+          case 'b': sb.append('\b'); break;
+          case 'f': sb.append('\f'); break;
+          case 'n': sb.append('\n'); break;
+          case 'r': sb.append('\r'); break;
+          case 't': sb.append('\t'); break;
+          case 'u': {
+            if (pos + 4 > s.length()) {
+              throw new IllegalArgumentException("bad \\u escape");
+            }
+            sb.append((char) Integer.parseInt(s.substring(pos, pos + 4), 16));
+            pos += 4;
+            break;
+          }
+          default:
+            throw new IllegalArgumentException("bad escape '\\" + e + "'");
+        }
+      }
+    }
+
+    Json parseNumber() {
+      int start = pos;
+      boolean isDouble = false;
+      if (peek() == '-') pos++;
+      while (!atEnd()) {
+        char c = s.charAt(pos);
+        if (Character.isDigit(c)) {
+          pos++;
+        } else if (c == '.' || c == 'e' || c == 'E' || c == '+' || c == '-') {
+          isDouble = c == '.' || c == 'e' || c == 'E' ? true : isDouble;
+          pos++;
+        } else {
+          break;
+        }
+      }
+      String num = s.substring(start, pos);
+      if (num.isEmpty() || num.equals("-")) {
+        throw new IllegalArgumentException("invalid number at " + start);
+      }
+      if (isDouble) return Json.of(Double.parseDouble(num));
+      try {
+        return Json.of(Long.parseLong(num));
+      } catch (NumberFormatException e) {
+        return Json.of(Double.parseDouble(num));
+      }
+    }
+  }
+}

@@ -1,0 +1,12 @@
+// Endpoint abstraction: where the next request goes (reference:
+// src/java/.../endpoint/AbstractEndpoint.java — supports fixed and
+// rotating server sets without touching client code).
+package triton.client.endpoint;
+
+public abstract class AbstractEndpoint {
+  /** Base url (host:port, no scheme) for the next request. */
+  public abstract String getUrl() throws Exception;
+
+  /** Number of distinct servers behind this endpoint. */
+  public abstract int size();
+}

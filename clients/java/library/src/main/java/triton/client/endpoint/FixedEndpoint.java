@@ -1,0 +1,20 @@
+// Single fixed server endpoint (reference: endpoint/FixedEndpoint.java).
+package triton.client.endpoint;
+
+public class FixedEndpoint extends AbstractEndpoint {
+  private final String url;
+
+  public FixedEndpoint(String url) {
+    if (url.contains("://")) {
+      throw new IllegalArgumentException(
+          "url should not include the scheme: " + url);
+    }
+    this.url = url;
+  }
+
+  @Override
+  public String getUrl() { return url; }
+
+  @Override
+  public int size() { return 1; }
+}

@@ -1,0 +1,32 @@
+// Round-robin over a fixed server list.
+package triton.client.endpoint;
+
+import java.util.ArrayList;
+import java.util.List;
+import java.util.concurrent.atomic.AtomicInteger;
+
+public class RoundRobinEndpoint extends AbstractEndpoint {
+  private final List<String> urls;
+  private final AtomicInteger next = new AtomicInteger();
+
+  public RoundRobinEndpoint(List<String> urls) {
+    if (urls.isEmpty()) {
+      throw new IllegalArgumentException("need at least one url");
+    }
+    for (String url : urls) {
+      if (url.contains("://")) {
+        throw new IllegalArgumentException(
+            "url should not include the scheme: " + url);
+      }
+    }
+    this.urls = new ArrayList<>(urls);
+  }
+
+  @Override
+  public String getUrl() {
+    return urls.get(Math.floorMod(next.getAndIncrement(), urls.size()));
+  }
+
+  @Override
+  public int size() { return urls.size(); }
+}

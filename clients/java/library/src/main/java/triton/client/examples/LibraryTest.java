@@ -1,0 +1,149 @@
+// Self-checking library test: exercises the full client surface against a
+// live server (the Java analog of the C++ client_test binary). Prints
+// "ALL PASS" and exits 0 on success.
+package triton.client.examples;
+
+import java.util.Arrays;
+import java.util.List;
+import java.util.concurrent.CompletableFuture;
+
+import triton.client.InferInput;
+import triton.client.InferRequestedOutput;
+import triton.client.InferResult;
+import triton.client.InferenceException;
+import triton.client.InferenceServerClient;
+import triton.client.InferenceServerClient.InferArguments;
+import triton.client.Json;
+import triton.client.pojo.DataType;
+
+public class LibraryTest {
+  static int failures = 0;
+
+  static void expect(boolean cond, String msg) {
+    if (!cond) {
+      System.err.println("FAIL: " + msg);
+      failures++;
+    }
+  }
+
+  public static void main(String[] args) throws Exception {
+    String url = args.length > 0 ? args[0] : "localhost:8000";
+    try (InferenceServerClient client =
+             new InferenceServerClient(url, 5000, 10000)) {
+      client.setMaxRetryCount(1);
+
+      // health + metadata
+      expect(client.isServerLive(), "server live");
+      expect(client.isServerReady(), "server ready");
+      Json meta = client.getServerMetadata();
+      expect(meta.get("name") != null, "metadata has name");
+      Json modelMeta = client.getModelMetadata("simple");
+      expect(modelMeta.get("inputs").size() == 2, "simple has 2 inputs");
+      client.getModelConfig("simple");
+      Json index = client.getModelRepositoryIndex();
+      expect(index.size() >= 1, "repository has models");
+
+      // infer: int32 binary protocol
+      int[] input0 = new int[16];
+      int[] input1 = new int[16];
+      for (int i = 0; i < 16; i++) {
+        input0[i] = i * 5;
+        input1[i] = i;
+      }
+      InferInput in0 = new InferInput("INPUT0", new long[] {1, 16}, DataType.INT32);
+      in0.setData(input0, true);
+      InferInput in1 = new InferInput("INPUT1", new long[] {1, 16}, DataType.INT32);
+      in1.setData(input1, true);
+      List<InferRequestedOutput> outputs = Arrays.asList(
+          new InferRequestedOutput("OUTPUT0"),
+          new InferRequestedOutput("OUTPUT1"));
+      InferArguments infArgs =
+          new InferArguments("simple", Arrays.asList(in0, in1), outputs);
+      infArgs.requestId = "java-1";
+      InferResult result = client.infer(infArgs);
+      expect("java-1".equals(result.getId()), "request id echo");
+      int[] sums = result.getOutputAsInt("OUTPUT0");
+      int[] diffs = result.getOutputAsInt("OUTPUT1");
+      for (int i = 0; i < 16; i++) {
+        expect(sums[i] == input0[i] + input1[i], "sum value");
+        expect(diffs[i] == input0[i] - input1[i], "diff value");
+      }
+      long[] shape = result.getShape("OUTPUT0");
+      expect(shape.length == 2 && shape[1] == 16, "shape value");
+
+      // JSON-mode input (binary=false)
+      in0.setData(input0, false);
+      in1.setData(input1, false);
+      result = client.infer("simple", Arrays.asList(in0, in1), outputs);
+      expect(result.getOutputAsInt("OUTPUT0")[7] == input0[7] + input1[7],
+             "json-mode sum");
+
+      // BYTES model
+      String[] s0 = new String[16];
+      String[] s1 = new String[16];
+      for (int i = 0; i < 16; i++) {
+        s0[i] = String.valueOf(i);
+        s1[i] = String.valueOf(300 + i);
+      }
+      InferInput b0 = new InferInput("INPUT0", new long[] {1, 16}, DataType.BYTES);
+      b0.setData(s0, true);
+      InferInput b1 = new InferInput("INPUT1", new long[] {1, 16}, DataType.BYTES);
+      b1.setData(s1, true);
+      result = client.infer("simple_string", Arrays.asList(b0, b1),
+                            Arrays.asList(new InferRequestedOutput("OUTPUT0")));
+      String[] strSums = result.getOutputAsString("OUTPUT0");
+      expect(strSums.length == 16, "string count");
+      expect("305".equals(strSums[5]), "string sum value");
+
+      // sequence (stateful accumulator)
+      int acc = 0;
+      for (int step = 0; step < 3; step++) {
+        InferInput qin = new InferInput("INPUT", new long[] {1, 1}, DataType.INT32);
+        qin.setData(new int[] {step + 1}, true);
+        InferArguments qargs = new InferArguments(
+            "simple_sequence", Arrays.asList(qin),
+            Arrays.asList(new InferRequestedOutput("OUTPUT")));
+        qargs.sequence(77, step == 0, step == 2);
+        result = client.infer(qargs);
+        acc += step + 1;
+        expect(result.getOutputAsInt("OUTPUT")[0] == acc, "sequence acc");
+      }
+
+      // async infer
+      infArgs.requestId = "java-async";
+      CompletableFuture<InferResult> future = client.inferAsync(infArgs);
+      InferResult asyncResult = future.get();
+      expect("java-async".equals(asyncResult.getId()), "async id echo");
+
+      // error path
+      try {
+        client.infer("no_such_model", Arrays.asList(in0, in1), outputs);
+        expect(false, "unknown model should fail");
+      } catch (InferenceException e) {
+        expect(e.getMessage().contains("no_such_model"),
+               "error names the model");
+      }
+
+      // model control + statistics + shm admin
+      client.unloadModel("simple_string");
+      expect(!client.isModelReady("simple_string"), "unloaded not ready");
+      client.loadModel("simple_string");
+      expect(client.isModelReady("simple_string"), "loaded ready");
+      client.getInferenceStatistics("simple");
+      client.getSystemSharedMemoryStatus();
+      try {
+        client.registerSystemSharedMemory("bogus", "/no_such_key_java", 64, 0);
+        expect(false, "bogus shm register should fail");
+      } catch (InferenceException expected) {
+        // expected
+      }
+    }
+
+    if (failures == 0) {
+      System.out.println("ALL PASS");
+      System.exit(0);
+    }
+    System.err.println(failures + " failures");
+    System.exit(1);
+  }
+}

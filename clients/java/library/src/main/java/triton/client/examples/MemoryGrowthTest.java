@@ -1,0 +1,45 @@
+// Soak loop mirroring the reference's MemoryGrowthTest: repeated infers,
+// heap reported before/after (reference: examples/MemoryGrowthTest.java).
+package triton.client.examples;
+
+import java.util.Arrays;
+import java.util.List;
+
+import triton.client.InferInput;
+import triton.client.InferRequestedOutput;
+import triton.client.InferResult;
+import triton.client.InferenceServerClient;
+import triton.client.pojo.DataType;
+
+public class MemoryGrowthTest {
+  public static void main(String[] args) throws Exception {
+    String url = args.length > 0 ? args[0] : "localhost:8000";
+    int iterations = args.length > 1 ? Integer.parseInt(args[1]) : 100;
+    try (InferenceServerClient client =
+             new InferenceServerClient(url, 5000, 5000)) {
+      int[] input = new int[16];
+      for (int i = 0; i < 16; i++) input[i] = i;
+      Runtime rt = Runtime.getRuntime();
+      System.gc();
+      long before = rt.totalMemory() - rt.freeMemory();
+      for (int iter = 0; iter < iterations; iter++) {
+        InferInput in0 = new InferInput("INPUT0", new long[] {1, 16}, DataType.INT32);
+        in0.setData(input, true);
+        InferInput in1 = new InferInput("INPUT1", new long[] {1, 16}, DataType.INT32);
+        in1.setData(input, true);
+        List<InferRequestedOutput> outputs =
+            Arrays.asList(new InferRequestedOutput("OUTPUT0"));
+        InferResult result =
+            client.infer("simple", Arrays.asList(in0, in1), outputs);
+        if (result.getOutputAsInt("OUTPUT0")[3] != 6) {
+          System.err.println("FAIL: wrong output");
+          System.exit(1);
+        }
+      }
+      System.gc();
+      long after = rt.totalMemory() - rt.freeMemory();
+      System.out.println("PASS: " + iterations + " iterations, heap "
+          + before / 1024 + "KiB -> " + after / 1024 + "KiB");
+    }
+  }
+}

@@ -1,0 +1,46 @@
+// Library-based simple infer example (reference:
+// src/java/.../examples/SimpleInferClient.java): INPUT0+INPUT1 int32 [1,16]
+// against the `simple` model, checks sum/difference outputs.
+package triton.client.examples;
+
+import java.util.Arrays;
+import java.util.List;
+
+import triton.client.InferInput;
+import triton.client.InferRequestedOutput;
+import triton.client.InferResult;
+import triton.client.InferenceServerClient;
+import triton.client.pojo.DataType;
+
+public class SimpleInferClient {
+  public static void main(String[] args) throws Exception {
+    String url = args.length > 0 ? args[0] : "localhost:8000";
+    try (InferenceServerClient client =
+             new InferenceServerClient(url, 5000, 5000)) {
+      int[] input0 = new int[16];
+      int[] input1 = new int[16];
+      for (int i = 0; i < 16; i++) {
+        input0[i] = i * 2;
+        input1[i] = i;
+      }
+      InferInput in0 = new InferInput("INPUT0", new long[] {1, 16}, DataType.INT32);
+      in0.setData(input0, true);
+      InferInput in1 = new InferInput("INPUT1", new long[] {1, 16}, DataType.INT32);
+      in1.setData(input1, true);
+      List<InferRequestedOutput> outputs = Arrays.asList(
+          new InferRequestedOutput("OUTPUT0"), new InferRequestedOutput("OUTPUT1"));
+      InferResult result =
+          client.infer("simple", Arrays.asList(in0, in1), outputs);
+      int[] sums = result.getOutputAsInt("OUTPUT0");
+      int[] diffs = result.getOutputAsInt("OUTPUT1");
+      for (int i = 0; i < 16; i++) {
+        if (sums[i] != input0[i] + input1[i]
+            || diffs[i] != input0[i] - input1[i]) {
+          System.err.println("FAIL: wrong output at " + i);
+          System.exit(1);
+        }
+      }
+      System.out.println("PASS: simple");
+    }
+  }
+}

@@ -1,0 +1,84 @@
+// Wire-level tensor descriptor (reference: src/java/.../pojo/IOTensor.java).
+package triton.client.pojo;
+
+import java.util.LinkedHashMap;
+import java.util.Map;
+
+import triton.client.Json;
+
+public class IOTensor {
+  private String name;
+  private String datatype;
+  private long[] shape;
+  private Map<String, Object> parameters = new LinkedHashMap<>();
+  private Json data;  // JSON-mode tensor data (null in binary mode)
+
+  public String getName() { return name; }
+  public void setName(String name) { this.name = name; }
+
+  public String getDatatype() { return datatype; }
+  public void setDatatype(String datatype) { this.datatype = datatype; }
+
+  public DataType getDataTypeEnum() { return DataType.valueOf(datatype); }
+
+  public long[] getShape() { return shape; }
+  public void setShape(long[] shape) { this.shape = shape; }
+
+  public Map<String, Object> getParameters() { return parameters; }
+
+  public Json getData() { return data; }
+  public void setData(Json data) { this.data = data; }
+
+  public Json toJson() {
+    Json obj = Json.object();
+    obj.put("name", name);
+    if (datatype != null) obj.put("datatype", datatype);
+    if (shape != null) {
+      Json shapeArr = Json.array();
+      for (long d : shape) shapeArr.add(d);
+      obj.put("shape", shapeArr);
+    }
+    if (!parameters.isEmpty()) {
+      Json params = Json.object();
+      for (Map.Entry<String, Object> e : parameters.entrySet()) {
+        Object v = e.getValue();
+        if (v instanceof Boolean) {
+          params.put(e.getKey(), (Boolean) v);
+        } else if (v instanceof Number) {
+          params.put(e.getKey(), ((Number) v).longValue());
+        } else {
+          params.put(e.getKey(), String.valueOf(v));
+        }
+      }
+      obj.put("parameters", params);
+    }
+    if (data != null) obj.put("data", data);
+    return obj;
+  }
+
+  public static IOTensor fromJson(Json obj) {
+    IOTensor t = new IOTensor();
+    if (obj.get("name") != null) t.name = obj.get("name").asString();
+    if (obj.get("datatype") != null) t.datatype = obj.get("datatype").asString();
+    Json shapeArr = obj.get("shape");
+    if (shapeArr != null) {
+      t.shape = new long[shapeArr.size()];
+      for (int i = 0; i < shapeArr.size(); i++) {
+        t.shape[i] = shapeArr.get(i).asLong();
+      }
+    }
+    Json params = obj.get("parameters");
+    if (params != null) {
+      for (Map.Entry<String, Json> e : params.asObject().entrySet()) {
+        Json v = e.getValue();
+        switch (v.type()) {
+          case BOOL: t.parameters.put(e.getKey(), v.asBool()); break;
+          case NUMBER: t.parameters.put(e.getKey(), v.asLong()); break;
+          default: t.parameters.put(e.getKey(), v.asString());
+        }
+      }
+    }
+    t.data = obj.get("data");
+    return t;
+  }
+}

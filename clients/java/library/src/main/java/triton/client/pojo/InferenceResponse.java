@@ -1,0 +1,35 @@
+// Parsed inference response header (reference: pojo/InferenceResponse.java).
+package triton.client.pojo;
+
+import java.util.ArrayList;
+import java.util.List;
+
+import triton.client.Json;
+
+public class InferenceResponse {
+  private String modelName;
+  private String modelVersion;
+  private String id;
+  private List<IOTensor> outputs = new ArrayList<>();
+
+  public String getModelName() { return modelName; }
+  public String getModelVersion() { return modelVersion; }
+  public String getId() { return id; }
+  public List<IOTensor> getOutputs() { return outputs; }
+
+  public static InferenceResponse fromJson(Json obj) {
+    InferenceResponse r = new InferenceResponse();
+    if (obj.get("model_name") != null) {
+      r.modelName = obj.get("model_name").asString();
+    }
+    if (obj.get("model_version") != null) {
+      r.modelVersion = obj.get("model_version").asString();
+    }
+    if (obj.get("id") != null) r.id = obj.get("id").asString();
+    Json outs = obj.get("outputs");
+    if (outs != null) {
+      for (Json out : outs.asArray()) r.outputs.add(IOTensor.fromJson(out));
+    }
+    return r;
+  }
+}

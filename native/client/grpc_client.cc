@@ -47,21 +47,47 @@ Error GetConnection(const std::string& url,
   Error parse_err = ParseHostPort(url, 8001, &host, &port);
   if (!parse_err.IsOk()) return parse_err;
 
+  {
+    std::lock_guard<std::mutex> lk(ChannelMapMu());
+    auto it = ChannelMap().find(url);
+    if (it != ChannelMap().end() && it->second.conn != nullptr &&
+        it->second.conn->Connected() &&
+        it->second.share_count < MaxShareCount()) {
+      it->second.share_count++;
+      *conn = it->second.conn;
+      return Error::Success;
+    }
+  }
+  // Dial OUTSIDE the map lock: a slow/blackholed host must not stall every
+  // other Create() in the process.
+  auto fresh = std::make_shared<h2::Connection>();
+  Error err = fresh->Connect(host, port);
+  if (!err.IsOk()) return err;
   std::lock_guard<std::mutex> lk(ChannelMapMu());
   auto& entry = ChannelMap()[url];
   if (entry.conn != nullptr && entry.conn->Connected() &&
       entry.share_count < MaxShareCount()) {
+    // Lost the race to another dialer; share theirs.
     entry.share_count++;
     *conn = entry.conn;
     return Error::Success;
   }
-  auto fresh = std::make_shared<h2::Connection>();
-  Error err = fresh->Connect(host, port);
-  if (!err.IsOk()) return err;
   entry.conn = fresh;
   entry.share_count = 1;
   *conn = fresh;
   return Error::Success;
+}
+
+// Client destruction returns its share; the last user of a cached
+// connection removes the map entry so the socket + reader thread die with
+// the final shared_ptr instead of living until process exit.
+void ReleaseConnection(const std::string& url,
+                       const std::shared_ptr<h2::Connection>& conn) {
+  std::lock_guard<std::mutex> lk(ChannelMapMu());
+  auto it = ChannelMap().find(url);
+  if (it != ChannelMap().end() && it->second.conn == conn) {
+    if (--it->second.share_count <= 0) ChannelMap().erase(it);
+  }
 }
 
 h2::Headers GrpcRequestHeaders() {
@@ -149,6 +175,13 @@ bool ReadMessage(h2::Connection* conn, int32_t stream_id, int64_t timeout_ms,
                  (static_cast<uint8_t>(prefix[2]) << 16) |
                  (static_cast<uint8_t>(prefix[3]) << 8) |
                  static_cast<uint8_t>(prefix[4]);
+  if (len == 0) {
+    // Legal empty message (all-default proto3). WaitData's nbytes==0 mode
+    // means "drain until close", so short-circuit instead.
+    msg->clear();
+    *err = Error::Success;
+    return true;
+  }
   if (!conn->WaitData(stream_id, len, timeout_ms, msg) ||
       msg->size() < len) {
     *err = Error("truncated gRPC message");
@@ -171,6 +204,7 @@ Error InferenceServerGrpcClient::Create(
   Error err = GetConnection(url, &conn);
   if (!err.IsOk()) return err;
   client->reset(new InferenceServerGrpcClient(conn, verbose));
+  (*client)->url_ = url;
   return Error::Success;
 }
 
@@ -188,6 +222,7 @@ InferenceServerGrpcClient::~InferenceServerGrpcClient() {
   }
   cq_cv_.notify_all();
   if (cq_worker_.joinable()) cq_worker_.join();
+  ReleaseConnection(url_, conn_);
 }
 
 // ---------------------------------------------------------------------------
@@ -197,8 +232,10 @@ InferenceServerGrpcClient::~InferenceServerGrpcClient() {
 Error InferenceServerGrpcClient::Call(
     const std::string& method, const google::protobuf::MessageLite& request,
     google::protobuf::MessageLite* response, uint64_t timeout_us) {
+  // No caller timeout means no deadline (gRPC semantics); a dead connection
+  // still unblocks every waiter via the reader thread's FailAll.
   int64_t timeout_ms =
-      timeout_us == 0 ? 60000 : static_cast<int64_t>(timeout_us / 1000);
+      timeout_us == 0 ? 0 : static_cast<int64_t>(timeout_us / 1000);
   std::string framed;
   FrameMessage(request, &framed);
   int32_t stream_id;
@@ -236,6 +273,13 @@ Error InferenceServerGrpcClient::Call(
   }
   Error status = GrpcStatus(conn_->ResponseHeaders(stream_id),
                             conn_->Trailers(stream_id));
+  // A completed exchange (grpc-status present) stands even if the
+  // connection died right after it; blame the connection only when the
+  // stream never finished properly.
+  if (!status.IsOk() && conn_->Dead() &&
+      status.Message() == "no grpc-status in response") {
+    status = Error("connection failed: " + conn_->LastError());
+  }
   conn_->ReleaseStream(stream_id);
   if (!status.IsOk()) return status;
   if (!have_msg) return Error("missing response message for " + method);
@@ -249,10 +293,15 @@ Error InferenceServerGrpcClient::Call(
 // health / metadata / admin
 // ---------------------------------------------------------------------------
 
+// Health probes carry a bounded deadline: they exist to detect wedged
+// servers, so hanging forever on one defeats their purpose. Other RPCs
+// follow gRPC semantics (no default deadline; pass a timeout to bound).
+constexpr uint64_t kHealthTimeoutUs = 60ULL * 1000 * 1000;
+
 Error InferenceServerGrpcClient::IsServerLive(bool* live) {
   inference::ServerLiveRequest req;
   inference::ServerLiveResponse resp;
-  Error err = Call("ServerLive", req, &resp);
+  Error err = Call("ServerLive", req, &resp, kHealthTimeoutUs);
   *live = err.IsOk() && resp.live();
   return err;
 }
@@ -260,7 +309,7 @@ Error InferenceServerGrpcClient::IsServerLive(bool* live) {
 Error InferenceServerGrpcClient::IsServerReady(bool* ready) {
   inference::ServerReadyRequest req;
   inference::ServerReadyResponse resp;
-  Error err = Call("ServerReady", req, &resp);
+  Error err = Call("ServerReady", req, &resp, kHealthTimeoutUs);
   *ready = err.IsOk() && resp.ready();
   return err;
 }
@@ -272,7 +321,7 @@ Error InferenceServerGrpcClient::IsModelReady(const std::string& model_name,
   req.set_name(model_name);
   req.set_version(model_version);
   inference::ModelReadyResponse resp;
-  Error err = Call("ModelReady", req, &resp);
+  Error err = Call("ModelReady", req, &resp, kHealthTimeoutUs);
   *ready = err.IsOk() && resp.ready();
   return err;
 }
@@ -606,12 +655,14 @@ void InferenceServerGrpcClient::CompletionWorker() {
       conn_->Reset(req.stream_id, 8 /* CANCEL */);
       status = Error("Deadline Exceeded");
     }
-    if (status.IsOk() && conn_->Dead()) {
-      status = Error("connection failed: " + conn_->LastError());
-    }
     if (status.IsOk()) {
       status = GrpcStatus(conn_->ResponseHeaders(req.stream_id),
                           conn_->Trailers(req.stream_id));
+      // Completed exchanges stand even if the connection died just after.
+      if (!status.IsOk() && conn_->Dead() &&
+          status.Message() == "no grpc-status in response") {
+        status = Error("connection failed: " + conn_->LastError());
+      }
     }
     conn_->ReleaseStream(req.stream_id);
     std::shared_ptr<InferResult> result;

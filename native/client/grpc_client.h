@@ -136,6 +136,7 @@ class InferenceServerGrpcClient {
   void StreamReader();
 
   std::shared_ptr<h2::Connection> conn_;
+  std::string url_;  // channel-cache key, returned on destruction
   bool verbose_;
 
   // Async completion queue (reference AsyncTransfer, grpc_client.cc:1582).

@@ -2,12 +2,15 @@
 
 #include <arpa/inet.h>
 #include <dlfcn.h>
+#include <fcntl.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <cerrno>
 #include <chrono>
 #include <cstring>
 
@@ -192,7 +195,23 @@ Error Connection::Connect(const std::string& host, int port) {
   for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
     fd_ = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
     if (fd_ < 0) continue;
-    if (connect(fd_, ai->ai_addr, ai->ai_addrlen) == 0) {
+    // Non-blocking connect with a bounded wait: a blackholed host must fail
+    // in ~30s, not after the kernel's multi-minute SYN retry budget.
+    int flags = fcntl(fd_, F_GETFL, 0);
+    fcntl(fd_, F_SETFL, flags | O_NONBLOCK);
+    int rc2 = connect(fd_, ai->ai_addr, ai->ai_addrlen);
+    bool connected = (rc2 == 0);
+    if (!connected && errno == EINPROGRESS) {
+      struct pollfd pfd = {fd_, POLLOUT, 0};
+      if (poll(&pfd, 1, 30000) == 1 && (pfd.revents & POLLOUT)) {
+        int so_error = 0;
+        socklen_t len = sizeof(so_error);
+        getsockopt(fd_, SOL_SOCKET, SO_ERROR, &so_error, &len);
+        connected = (so_error == 0);
+      }
+    }
+    if (connected) {
+      fcntl(fd_, F_SETFL, flags);  // back to blocking for reader/writer
       int one = 1;
       setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       err = Error::Success;
@@ -275,6 +294,13 @@ Error Connection::Handshake() {
 
 Error Connection::WriteFrame(uint8_t type, uint8_t flags, int32_t stream_id,
                              const void* payload, size_t nbytes) {
+  std::lock_guard<std::mutex> lk(write_mu_);
+  return WriteFrameLocked(type, flags, stream_id, payload, nbytes);
+}
+
+Error Connection::WriteFrameLocked(uint8_t type, uint8_t flags,
+                                   int32_t stream_id, const void* payload,
+                                   size_t nbytes) {
   uint8_t hdr[9];
   hdr[0] = (nbytes >> 16) & 0xFF;
   hdr[1] = (nbytes >> 8) & 0xFF;
@@ -285,7 +311,6 @@ Error Connection::WriteFrame(uint8_t type, uint8_t flags, int32_t stream_id,
   hdr[6] = (stream_id >> 16) & 0xFF;
   hdr[7] = (stream_id >> 8) & 0xFF;
   hdr[8] = stream_id & 0xFF;
-  std::lock_guard<std::mutex> lk(write_mu_);
   if (fd_ < 0) return Error("h2 connection closed");
   struct Part {
     const char* p;
@@ -325,6 +350,11 @@ Error Connection::OpenStream(const std::string& path,
   for (const auto& kv : extra_headers) {
     EncodeHeader(kv.first, kv.second, &block);
   }
+  // ID allocation and the HEADERS write must be one atomic step: stream IDs
+  // must hit the wire in increasing order (RFC 7540 §5.1.1 — a higher ID
+  // implicitly closes lower idle ones). Lock order write_mu_ -> mu_ is safe:
+  // no path takes write_mu_ while holding mu_.
+  std::lock_guard<std::mutex> wlk(write_mu_);
   int32_t id;
   {
     std::lock_guard<std::mutex> lk(mu_);
@@ -335,8 +365,8 @@ Error Connection::OpenStream(const std::string& path,
     state->send_window = initial_send_window_;
     streams_[id] = state;
   }
-  Error err = WriteFrame(kFrameHeaders, kFlagEndHeaders, id, block.data(),
-                         block.size());
+  Error err = WriteFrameLocked(kFrameHeaders, kFlagEndHeaders, id,
+                               block.data(), block.size());
   if (!err.IsOk()) return err;
   *stream_id = id;
   return Error::Success;
@@ -352,12 +382,19 @@ Error Connection::SendData(int32_t stream_id, const void* data, size_t nbytes,
       std::unique_lock<std::mutex> lk(mu_);
       auto state = GetStream(stream_id);
       if (state == nullptr) return Error("unknown h2 stream");
-      // Wait for send window on both levels.
-      while (!dead_ && remaining > 0 &&
+      // Wait for send window on both levels; a closed/reset stream must
+      // break the wait (window_cv_ is notified on those transitions).
+      while (!dead_ && !state->closed && remaining > 0 &&
              (conn_send_window_ <= 0 || state->send_window <= 0)) {
         window_cv_.wait_for(lk, std::chrono::seconds(30));
       }
       if (dead_) return Error("h2 connection is dead: " + last_error_);
+      if (state->closed && remaining > 0) {
+        return Error(state->rst
+                         ? "stream reset by server (h2 error " +
+                               std::to_string(state->rst_error) + ")"
+                         : "stream closed before send completed");
+      }
       chunk = remaining;
       if (chunk > max_frame_size_) chunk = max_frame_size_;
       if (remaining > 0) {
@@ -576,6 +613,7 @@ void Connection::HandleFrame(uint8_t type, uint8_t flags, int32_t sid,
         }
         state->closed = true;
         state->cv.notify_all();
+        window_cv_.notify_all();  // wake senders blocked on flow control
       }
       return;
     }
@@ -618,7 +656,10 @@ void Connection::HandleFrame(uint8_t type, uint8_t flags, int32_t sid,
         auto state = GetStream(sid);
         if (state != nullptr) {
           state->data.append(payload, pos, len - pos);
-          if (flags & kFlagEndStream) state->closed = true;
+          if (flags & kFlagEndStream) {
+            state->closed = true;
+            window_cv_.notify_all();  // wake senders blocked on flow control
+          }
           state->cv.notify_all();
         }
       }
@@ -662,7 +703,10 @@ void Connection::HandleFrame(uint8_t type, uint8_t flags, int32_t sid,
   } else {
     state->trailers = std::move(decoded);
   }
-  if (flags & kFlagEndStream) state->closed = true;
+  if (flags & kFlagEndStream) {
+    state->closed = true;
+    window_cv_.notify_all();  // wake senders blocked on flow control
+  }
   state->cv.notify_all();
 }
 

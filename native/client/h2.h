@@ -83,6 +83,8 @@ class Connection {
   Error Handshake();
   Error WriteFrame(uint8_t type, uint8_t flags, int32_t stream_id,
                    const void* payload, size_t nbytes);
+  Error WriteFrameLocked(uint8_t type, uint8_t flags, int32_t stream_id,
+                         const void* payload, size_t nbytes);
   void ReaderLoop();
   void HandleFrame(uint8_t type, uint8_t flags, int32_t stream_id,
                    const std::string& payload);

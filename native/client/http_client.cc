@@ -636,6 +636,7 @@ static uint16_t FloatToHalf(float f) {
   uint32_t bits;
   std::memcpy(&bits, &f, 4);
   uint32_t sign = (bits >> 16) & 0x8000;
+  if (f != f) return static_cast<uint16_t>(sign | 0x7E00);  // NaN, not Inf
   int32_t exp = static_cast<int32_t>((bits >> 23) & 0xFF) - 127 + 15;
   uint32_t mant = bits & 0x7FFFFF;
   if (exp >= 31) return static_cast<uint16_t>(sign | 0x7C00);  // inf/overflow
@@ -646,6 +647,7 @@ static uint16_t FloatToHalf(float f) {
 }
 
 static uint16_t FloatToBf16(float f) {
+  if (f != f) return 0x7FC0;  // rounding a NaN can collapse it to Inf
   uint32_t bits;
   std::memcpy(&bits, &f, 4);
   // round-to-nearest-even on the dropped 16 bits

@@ -1,0 +1,65 @@
+"""Build and run the Java client library against the live server.
+
+The Java analog of tests/test_cpp_client.py: compiles the dependency-free
+library with javac and drives the self-checking LibraryTest main. Skipped
+when no JDK is available (this CI image has none; the library uses only
+java.net.http + java.base so any JDK 11+ works).
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+from tritonclient_tpu.server import InferenceServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "clients", "java", "library")
+
+
+@pytest.fixture(scope="module")
+def java_classes():
+    if shutil.which("javac") is None or shutil.which("java") is None:
+        pytest.skip("no JDK available")
+    subprocess.run(
+        ["sh", os.path.join(LIB, "build.sh")],
+        check=True, capture_output=True, timeout=300,
+    )
+    return os.path.join(LIB, "target", "classes")
+
+
+@pytest.fixture(scope="module")
+def server():
+    with InferenceServer(grpc=False) as s:
+        yield s
+
+
+def test_java_library_suite(java_classes, server):
+    proc = subprocess.run(
+        ["java", "-cp", java_classes, "triton.client.examples.LibraryTest",
+         server.http_address],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr}"
+    assert "ALL PASS" in proc.stdout
+
+
+def test_java_simple_example(java_classes, server):
+    proc = subprocess.run(
+        ["java", "-cp", java_classes,
+         "triton.client.examples.SimpleInferClient", server.http_address],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr}"
+    assert "PASS" in proc.stdout
+
+
+def test_java_memory_growth(java_classes, server):
+    proc = subprocess.run(
+        ["java", "-cp", java_classes,
+         "triton.client.examples.MemoryGrowthTest", server.http_address, "50"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr}"
+    assert "PASS" in proc.stdout
