@@ -1,0 +1,146 @@
+// Per-API client-timeout matrix (behavioral parity with the reference's
+// tests/client_timeout_test.cc:60-362: tiny deadlines must fail fast with
+// Deadline Exceeded, generous ones must succeed, on both protocols and on
+// streaming).
+//
+//   client_timeout_test -g <grpc host:port> -h <http host:port>
+#include <condition_variable>
+#include <cstring>
+#include <iostream>
+#include <mutex>
+
+#include "../grpc_client.h"
+#include "../http_client.h"
+#include "example_utils.h"
+
+using namespace tputriton;  // NOLINT
+
+static std::string ParseFlag(int argc, char** argv, const char* flag,
+                             const char* def) {
+  for (int i = 1; i + 1 < argc; i++) {
+    if (std::strcmp(argv[i], flag) == 0) return argv[i + 1];
+  }
+  return def;
+}
+
+static int failures = 0;
+
+#define EXPECT(cond, msg)                    \
+  do {                                       \
+    if (!(cond)) {                           \
+      std::cerr << "FAIL: " << msg << "\n";  \
+      failures++;                            \
+    }                                        \
+  } while (0)
+
+int main(int argc, char** argv) {
+  std::string grpc_url = ParseFlag(argc, argv, "-g", "localhost:8001");
+  std::string http_url = ParseFlag(argc, argv, "-h", "localhost:8000");
+
+  std::unique_ptr<InferenceServerGrpcClient> grpc_client;
+  EXPECT(InferenceServerGrpcClient::Create(&grpc_client, grpc_url).IsOk(),
+         "grpc create");
+  std::unique_ptr<InferenceServerHttpClient> http_client;
+  EXPECT(InferenceServerHttpClient::Create(&http_client, http_url).IsOk(),
+         "http create");
+
+  int32_t input[16];
+  for (int i = 0; i < 16; i++) input[i] = i;
+  auto make_input = [&]() {
+    InferInput in("INPUT", {1, 16}, "INT32");
+    in.AppendRaw(reinterpret_cast<uint8_t*>(input), sizeof(input));
+    return in;
+  };
+  // The slow_identity model sleeps delay_ms (here 400ms) server-side.
+  auto make_options = [](uint64_t timeout_us) {
+    InferOptions options("slow_identity");
+    options.client_timeout_us_ = timeout_us;
+    options.request_parameters_["delay_ms"] = "400";
+    return options;
+  };
+
+  // gRPC sync: tiny deadline -> Deadline Exceeded.
+  {
+    InferInput in = make_input();
+    std::shared_ptr<InferResult> result;
+    Error err = grpc_client->Infer(&result, make_options(20000), {&in});
+    EXPECT(!err.IsOk(), "grpc tiny deadline should fail");
+    EXPECT(err.Message().find("Deadline") != std::string::npos ||
+               err.Message().find("deadline") != std::string::npos,
+           "grpc error names the deadline (got '" + err.Message() + "')");
+  }
+  // gRPC sync: generous deadline -> success.
+  {
+    InferInput in = make_input();
+    std::shared_ptr<InferResult> result;
+    Error err = grpc_client->Infer(&result, make_options(10000000), {&in});
+    EXPECT(err.IsOk(), "grpc generous deadline should pass");
+  }
+  // gRPC async: tiny deadline -> error surfaces in the callback.
+  {
+    InferInput in = make_input();
+    std::mutex mu;
+    std::condition_variable cv;
+    bool done = false;
+    Error async_err;
+    Error submit = grpc_client->AsyncInfer(
+        [&](std::shared_ptr<InferResult> result, Error e) {
+          std::lock_guard<std::mutex> lk(mu);
+          async_err = e;
+          done = true;
+          cv.notify_all();
+        },
+        make_options(20000), {&in});
+    EXPECT(submit.IsOk(), "grpc async submit");
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait_for(lk, std::chrono::seconds(30), [&] { return done; });
+    EXPECT(done, "grpc async completion");
+    EXPECT(!async_err.IsOk(), "grpc async tiny deadline should fail");
+  }
+  // HTTP sync: tiny deadline -> Deadline Exceeded; generous -> success.
+  {
+    InferInput in = make_input();
+    std::shared_ptr<InferResult> result;
+    Error err = http_client->Infer(&result, make_options(20000), {&in});
+    EXPECT(!err.IsOk(), "http tiny deadline should fail");
+    EXPECT(err.Message().find("Deadline") != std::string::npos,
+           "http error names the deadline");
+  }
+  {
+    InferInput in = make_input();
+    std::shared_ptr<InferResult> result;
+    Error err = http_client->Infer(&result, make_options(10000000), {&in});
+    EXPECT(err.IsOk(), "http generous deadline should pass");
+  }
+  // Streaming on a fresh connection still works after the timeouts above.
+  {
+    std::mutex mu;
+    std::condition_variable cv;
+    int got = 0;
+    EXPECT(grpc_client
+               ->StartStream([&](std::shared_ptr<InferResult> r, Error e) {
+                 std::lock_guard<std::mutex> lk(mu);
+                 if (e.IsOk()) got++;
+                 cv.notify_all();
+               })
+               .IsOk(),
+           "start stream");
+    InferInput in = make_input();
+    InferOptions options("slow_identity");
+    options.request_parameters_["delay_ms"] = "10";
+    EXPECT(grpc_client->AsyncStreamInfer(options, {&in}).IsOk(),
+           "stream infer");
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait_for(lk, std::chrono::seconds(30), [&] { return got >= 1; });
+    lk.unlock();
+    EXPECT(got == 1, "stream response after timeouts");
+    EXPECT(grpc_client->StopStream().IsOk(), "stop stream");
+  }
+
+  if (failures == 0) {
+    std::cout << "ALL PASS\n";
+    return 0;
+  }
+  std::cerr << failures << " failures\n";
+  return 1;
+}

@@ -1,0 +1,32 @@
+// Shared helpers for the self-checking C++ example apps.
+#pragma once
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+inline std::string ParseUrl(int argc, char** argv, const char* def) {
+  for (int i = 1; i + 1 < argc; i++) {
+    if (std::strcmp(argv[i], "-u") == 0) return argv[i + 1];
+  }
+  return def;
+}
+
+#define FAIL_IF(cond, msg)                    \
+  do {                                        \
+    if (cond) {                               \
+      std::fprintf(stderr, "error: %s\n", msg); \
+      return 1;                               \
+    }                                         \
+  } while (0)
+
+#define FAIL_IF_ERR(call, msg)                                         \
+  do {                                                                 \
+    tputriton::Error err__ = (call);                                   \
+    if (!err__.IsOk()) {                                               \
+      std::fprintf(stderr, "error: %s: %s\n", msg,                     \
+                   err__.Message().c_str());                           \
+      return 1;                                                        \
+    }                                                                  \
+  } while (0)

@@ -1,0 +1,94 @@
+// Soak test: repeated inference over both clients with RSS growth check
+// (behavioral parity with the reference's tests/memory_leak_test.cc —
+// RunSyncInfer loop over both client types, :160,:311-315).
+//
+//   memory_leak_test -g <grpc host:port> -h <http host:port> [-r iterations]
+#include <cstdio>
+#include <cstring>
+#include <iostream>
+
+#include "../grpc_client.h"
+#include "../http_client.h"
+#include "example_utils.h"
+
+using namespace tputriton;  // NOLINT
+
+static std::string ParseFlag(int argc, char** argv, const char* flag,
+                             const char* def) {
+  for (int i = 1; i + 1 < argc; i++) {
+    if (std::strcmp(argv[i], flag) == 0) return argv[i + 1];
+  }
+  return def;
+}
+
+static long RssKb() {
+  FILE* f = fopen("/proc/self/status", "r");
+  if (f == nullptr) return -1;
+  char line[256];
+  long rss = -1;
+  while (fgets(line, sizeof(line), f)) {
+    if (strncmp(line, "VmRSS:", 6) == 0) {
+      rss = atol(line + 6);
+      break;
+    }
+  }
+  fclose(f);
+  return rss;
+}
+
+int main(int argc, char** argv) {
+  std::string grpc_url = ParseFlag(argc, argv, "-g", "localhost:8001");
+  std::string http_url = ParseFlag(argc, argv, "-h", "localhost:8000");
+  int iterations = atoi(ParseFlag(argc, argv, "-r", "200").c_str());
+
+  std::unique_ptr<InferenceServerGrpcClient> grpc_client;
+  FAIL_IF_ERR(InferenceServerGrpcClient::Create(&grpc_client, grpc_url),
+              "grpc create");
+  std::unique_ptr<InferenceServerHttpClient> http_client;
+  FAIL_IF_ERR(InferenceServerHttpClient::Create(&http_client, http_url),
+              "http create");
+
+  int32_t input0[16], input1[16];
+  for (int i = 0; i < 16; i++) {
+    input0[i] = i;
+    input1[i] = 2 * i;
+  }
+  InferOptions options("simple");
+
+  auto one_round = [&](int round) -> Error {
+    InferInput in0("INPUT0", {1, 16}, "INT32");
+    InferInput in1("INPUT1", {1, 16}, "INT32");
+    in0.AppendRaw(reinterpret_cast<uint8_t*>(input0), sizeof(input0));
+    in1.AppendRaw(reinterpret_cast<uint8_t*>(input1), sizeof(input1));
+    std::shared_ptr<InferResult> result;
+    Error err = (round % 2 == 0)
+                    ? grpc_client->Infer(&result, options, {&in0, &in1})
+                    : http_client->Infer(&result, options, {&in0, &in1});
+    if (!err.IsOk()) return err;
+    const uint8_t* buf;
+    size_t nbytes;
+    err = result->RawData("OUTPUT0", &buf, &nbytes);
+    if (!err.IsOk()) return err;
+    if (reinterpret_cast<const int32_t*>(buf)[5] != input0[5] + input1[5]) {
+      return Error("wrong output value");
+    }
+    return Error::Success;
+  };
+
+  // Warm both paths, then measure growth over the soak window.
+  for (int r = 0; r < 20; r++) {
+    FAIL_IF_ERR(one_round(r), "warmup round");
+  }
+  long before = RssKb();
+  for (int r = 0; r < iterations; r++) {
+    FAIL_IF_ERR(one_round(r), "soak round");
+  }
+  long after = RssKb();
+  long growth = after - before;
+  std::cout << "rss " << before << "KiB -> " << after << "KiB (+" << growth
+            << "KiB over " << iterations << " rounds)\n";
+  // Allow allocator noise; a real per-request leak of even 1KiB would trip.
+  FAIL_IF(growth > iterations / 2 + 2048, "rss growth suggests a leak");
+  std::cout << "PASS: no leak detected\n";
+  return 0;
+}

@@ -1,0 +1,62 @@
+// Async gRPC inference via the completion-queue worker (reference:
+// src/c++/examples/simple_grpc_async_infer_client.cc).
+#include <condition_variable>
+#include <iostream>
+#include <mutex>
+
+#include "../grpc_client.h"
+#include "example_utils.h"
+
+using namespace tputriton;  // NOLINT
+
+int main(int argc, char** argv) {
+  std::string url = ParseUrl(argc, argv, "localhost:8001");
+  std::unique_ptr<InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(InferenceServerGrpcClient::Create(&client, url), "create");
+
+  int32_t input0[16], input1[16];
+  for (int i = 0; i < 16; i++) {
+    input0[i] = i * 7;
+    input1[i] = i;
+  }
+  InferInput in0("INPUT0", {1, 16}, "INT32");
+  InferInput in1("INPUT1", {1, 16}, "INT32");
+  in0.AppendRaw(reinterpret_cast<uint8_t*>(input0), sizeof(input0));
+  in1.AppendRaw(reinterpret_cast<uint8_t*>(input1), sizeof(input1));
+
+  std::mutex mu;
+  std::condition_variable cv;
+  bool done = false;
+  int exit_code = 1;
+  InferOptions options("simple");
+  FAIL_IF_ERR(
+      client->AsyncInfer(
+          [&](std::shared_ptr<InferResult> result, Error err) {
+            std::lock_guard<std::mutex> lk(mu);
+            if (err.IsOk()) {
+              const uint8_t* buf;
+              size_t nbytes;
+              if (result->RawData("OUTPUT0", &buf, &nbytes).IsOk()) {
+                const int32_t* sums = reinterpret_cast<const int32_t*>(buf);
+                bool ok = true;
+                for (int i = 0; i < 16; i++) {
+                  ok = ok && sums[i] == input0[i] + input1[i];
+                }
+                exit_code = ok ? 0 : 1;
+              }
+            } else {
+              std::cerr << "error: " << err.Message() << "\n";
+            }
+            done = true;
+            cv.notify_all();
+          },
+          options, {&in0, &in1}),
+      "async infer");
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait_for(lk, std::chrono::seconds(30), [&] { return done; });
+  }
+  FAIL_IF(!done, "no completion");
+  if (exit_code == 0) std::cout << "PASS: grpc async infer\n";
+  return exit_code;
+}

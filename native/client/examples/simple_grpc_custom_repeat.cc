@@ -1,0 +1,61 @@
+// Decoupled model streaming: one request fans out to N responses plus an
+// empty final marker (reference: src/c++/examples/simple_grpc_custom_repeat.cc).
+#include <condition_variable>
+#include <iostream>
+#include <mutex>
+#include <vector>
+
+#include "../grpc_client.h"
+#include "example_utils.h"
+
+using namespace tputriton;  // NOLINT
+
+int main(int argc, char** argv) {
+  std::string url = ParseUrl(argc, argv, "localhost:8001");
+  std::unique_ptr<InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(InferenceServerGrpcClient::Create(&client, url), "create");
+
+  std::mutex mu;
+  std::condition_variable cv;
+  std::vector<int32_t> received;
+  bool final_seen = false;
+  FAIL_IF_ERR(
+      client->StartStream([&](std::shared_ptr<InferResult> result, Error err) {
+        std::lock_guard<std::mutex> lk(mu);
+        if (!err.IsOk()) {
+          std::cerr << "stream error: " << err.Message() << "\n";
+          cv.notify_all();
+          return;
+        }
+        if (result->IsFinalResponse() && !result->HasOutput("OUT")) {
+          final_seen = true;
+        } else {
+          const uint8_t* buf;
+          size_t nbytes;
+          if (result->RawData("OUT", &buf, &nbytes).IsOk() && nbytes >= 4) {
+            received.push_back(*reinterpret_cast<const int32_t*>(buf));
+          }
+        }
+        cv.notify_all();
+      }),
+      "start stream");
+
+  int32_t values[5] = {11, 22, 33, 44, 55};
+  InferInput in("IN", {5}, "INT32");
+  in.AppendRaw(reinterpret_cast<uint8_t*>(values), sizeof(values));
+  InferOptions options("repeat_int32");
+  FAIL_IF_ERR(client->AsyncStreamInfer(options, {&in}, {}, true),
+              "stream infer");
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait_for(lk, std::chrono::seconds(30), [&] { return final_seen; });
+  }
+  FAIL_IF_ERR(client->StopStream(), "stop stream");
+  FAIL_IF(!final_seen, "no final response marker");
+  FAIL_IF(received.size() != 5, "wrong response count");
+  for (int i = 0; i < 5; i++) {
+    FAIL_IF(received[i] != values[i], "wrong streamed value");
+  }
+  std::cout << "PASS: grpc decoupled repeat (5 responses + final)\n";
+  return 0;
+}

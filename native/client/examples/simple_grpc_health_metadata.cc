@@ -1,0 +1,38 @@
+// Health + metadata round-trip (reference:
+// src/c++/examples/simple_grpc_health_metadata.cc).
+#include <iostream>
+
+#include "../grpc_client.h"
+#include "example_utils.h"
+
+using namespace tputriton;  // NOLINT
+
+int main(int argc, char** argv) {
+  std::string url = ParseUrl(argc, argv, "localhost:8001");
+  std::unique_ptr<InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(InferenceServerGrpcClient::Create(&client, url), "create");
+
+  bool live = false, ready = false, model_ready = false;
+  FAIL_IF_ERR(client->IsServerLive(&live), "live");
+  FAIL_IF(!live, "server not live");
+  FAIL_IF_ERR(client->IsServerReady(&ready), "ready");
+  FAIL_IF(!ready, "server not ready");
+  FAIL_IF_ERR(client->IsModelReady("simple", &model_ready), "model ready");
+  FAIL_IF(!model_ready, "model not ready");
+
+  inference::ServerMetadataResponse server_meta;
+  FAIL_IF_ERR(client->ServerMetadata(&server_meta), "server metadata");
+  FAIL_IF(server_meta.name().empty(), "empty server name");
+
+  inference::ModelMetadataResponse model_meta;
+  FAIL_IF_ERR(client->ModelMetadata(&model_meta, "simple"), "model metadata");
+  FAIL_IF(model_meta.inputs_size() != 2, "wrong input count");
+  FAIL_IF(model_meta.outputs_size() != 2, "wrong output count");
+
+  inference::ModelStatisticsResponse stats;
+  FAIL_IF_ERR(client->ModelInferenceStatistics(&stats, "simple"), "stats");
+
+  std::cout << "PASS: health + metadata (" << server_meta.name() << " "
+            << server_meta.version() << ")\n";
+  return 0;
+}

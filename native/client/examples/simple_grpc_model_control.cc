@@ -1,0 +1,43 @@
+// Model repository load/unload control (reference:
+// src/c++/examples/simple_grpc_model_control.cc).
+#include <iostream>
+
+#include "../grpc_client.h"
+#include "example_utils.h"
+
+using namespace tputriton;  // NOLINT
+
+int main(int argc, char** argv) {
+  std::string url = ParseUrl(argc, argv, "localhost:8001");
+  std::unique_ptr<InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(InferenceServerGrpcClient::Create(&client, url), "create");
+
+  bool ready = false;
+  FAIL_IF_ERR(client->UnloadModel("simple_string"), "unload");
+  FAIL_IF_ERR(client->IsModelReady("simple_string", &ready), "ready query");
+  FAIL_IF(ready, "still ready after unload");
+
+  // Inference against the unloaded model must fail.
+  InferInput in0("INPUT0", {1, 16}, "BYTES");
+  InferInput in1("INPUT1", {1, 16}, "BYTES");
+  std::vector<std::string> vals(16, "1");
+  in0.AppendFromString(vals);
+  in1.AppendFromString(vals);
+  std::shared_ptr<InferResult> result;
+  InferOptions options("simple_string");
+  Error err = client->Infer(&result, options, {&in0, &in1});
+  FAIL_IF(err.IsOk(), "infer on unloaded model unexpectedly succeeded");
+
+  FAIL_IF_ERR(client->LoadModel("simple_string"), "load");
+  FAIL_IF_ERR(client->IsModelReady("simple_string", &ready), "ready query 2");
+  FAIL_IF(!ready, "not ready after load");
+  FAIL_IF_ERR(client->Infer(&result, options, {&in0, &in1}),
+              "infer after reload");
+
+  inference::RepositoryIndexResponse index;
+  FAIL_IF_ERR(client->ModelRepositoryIndex(&index), "repository index");
+  FAIL_IF(index.models_size() < 1, "empty repository index");
+
+  std::cout << "PASS: model control\n";
+  return 0;
+}

@@ -1,0 +1,60 @@
+// Stateful sequences over a bidi stream (reference:
+// src/c++/examples/simple_grpc_sequence_stream_infer_client.cc — start/end
+// flags thread a correlation id through the stream).
+#include <condition_variable>
+#include <iostream>
+#include <mutex>
+#include <vector>
+
+#include "../grpc_client.h"
+#include "example_utils.h"
+
+using namespace tputriton;  // NOLINT
+
+int main(int argc, char** argv) {
+  std::string url = ParseUrl(argc, argv, "localhost:8001");
+  std::unique_ptr<InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(InferenceServerGrpcClient::Create(&client, url), "create");
+
+  std::mutex mu;
+  std::condition_variable cv;
+  std::vector<int32_t> received;
+  FAIL_IF_ERR(
+      client->StartStream([&](std::shared_ptr<InferResult> result, Error err) {
+        std::lock_guard<std::mutex> lk(mu);
+        const uint8_t* buf;
+        size_t nbytes;
+        if (err.IsOk() && result->RawData("OUTPUT", &buf, &nbytes).IsOk() &&
+            nbytes >= 4) {
+          received.push_back(*reinterpret_cast<const int32_t*>(buf));
+        }
+        cv.notify_all();
+      }),
+      "start stream");
+
+  const int steps = 4;
+  for (int step = 0; step < steps; step++) {
+    int32_t value = step + 1;
+    InferInput in("INPUT", {1, 1}, "INT32");
+    in.AppendRaw(reinterpret_cast<uint8_t*>(&value), 4);
+    InferOptions options("simple_sequence");
+    options.sequence_id_ = 1001;
+    options.sequence_start_ = (step == 0);
+    options.sequence_end_ = (step == steps - 1);
+    FAIL_IF_ERR(client->AsyncStreamInfer(options, {&in}), "stream infer");
+  }
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait_for(lk, std::chrono::seconds(30),
+                [&] { return received.size() >= steps; });
+  }
+  FAIL_IF_ERR(client->StopStream(), "stop stream");
+  FAIL_IF(received.size() != steps, "missing responses");
+  int expected = 0;
+  for (int step = 0; step < steps; step++) {
+    expected += step + 1;  // accumulator semantics
+    FAIL_IF(received[step] != expected, "wrong accumulated value");
+  }
+  std::cout << "PASS: grpc sequence stream\n";
+  return 0;
+}

@@ -1,0 +1,42 @@
+// TPU shared-memory contract demo (the cudashm example analog, reference:
+// src/c++/examples/simple_grpc_cudashm_client.cc).
+//
+// Unlike cudaIpc, PjRt device buffers have no cross-process export:
+// tpu_shared_memory handles are process-scoped by design (SURVEY.md §7 hard
+// part 1) and resolvable only by a co-located (same-process) server — the
+// Python in-process stack exercises that zero-copy path. From a separate
+// process, the register RPC must fail with a clear not-co-located error;
+// this example self-checks exactly that contract, plus the admin surface.
+#include <iostream>
+
+#include "../grpc_client.h"
+#include "example_utils.h"
+
+using namespace tputriton;  // NOLINT
+
+int main(int argc, char** argv) {
+  std::string url = ParseUrl(argc, argv, "localhost:8001");
+  std::unique_ptr<InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(InferenceServerGrpcClient::Create(&client, url), "create");
+
+  // Status works from anywhere.
+  inference::TpuSharedMemoryStatusResponse status;
+  FAIL_IF_ERR(client->TpuSharedMemoryStatus(&status), "tpu shm status");
+
+  // A handle minted by another process (fabricated here) must be rejected
+  // with the documented resolution error, not accepted silently.
+  std::string bogus_handle =
+      "eyJ1dWlkIjogImRlYWRiZWVmIiwgInBpZCI6IDF9";  // {"uuid":...,"pid":1}
+  Error err =
+      client->RegisterTpuSharedMemory("cpp_tpu_region", bogus_handle, 0, 64);
+  FAIL_IF(err.IsOk(), "non-co-located register unexpectedly succeeded");
+  FAIL_IF(err.Message().find("resolve") == std::string::npos &&
+              err.Message().find("region") == std::string::npos,
+          "error does not explain handle resolution");
+
+  // Unregister-all is idempotent and safe.
+  FAIL_IF_ERR(client->UnregisterTpuSharedMemory(""), "unregister all");
+
+  std::cout << "PASS: tpu shm co-location contract\n";
+  return 0;
+}

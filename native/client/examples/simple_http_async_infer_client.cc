@@ -1,0 +1,59 @@
+// Async HTTP inference via the worker thread (reference:
+// src/c++/examples/simple_http_async_infer_client.cc).
+#include <condition_variable>
+#include <iostream>
+#include <mutex>
+
+#include "../http_client.h"
+#include "example_utils.h"
+
+using namespace tputriton;  // NOLINT
+
+int main(int argc, char** argv) {
+  std::string url = ParseUrl(argc, argv, "localhost:8000");
+  std::unique_ptr<InferenceServerHttpClient> client;
+  FAIL_IF_ERR(InferenceServerHttpClient::Create(&client, url), "create");
+
+  int32_t input0[16], input1[16];
+  for (int i = 0; i < 16; i++) {
+    input0[i] = 100 + i;
+    input1[i] = i;
+  }
+  InferInput in0("INPUT0", {1, 16}, "INT32");
+  InferInput in1("INPUT1", {1, 16}, "INT32");
+  in0.AppendRaw(reinterpret_cast<uint8_t*>(input0), sizeof(input0));
+  in1.AppendRaw(reinterpret_cast<uint8_t*>(input1), sizeof(input1));
+
+  std::mutex mu;
+  std::condition_variable cv;
+  int remaining = 3;
+  bool all_ok = true;
+  InferOptions options("simple");
+  for (int r = 0; r < 3; r++) {
+    FAIL_IF_ERR(
+        client->AsyncInfer(
+            [&](std::shared_ptr<InferResult> result, Error err) {
+              std::lock_guard<std::mutex> lk(mu);
+              const uint8_t* buf;
+              size_t nbytes;
+              if (!err.IsOk() ||
+                  !result->RawData("OUTPUT0", &buf, &nbytes).IsOk() ||
+                  reinterpret_cast<const int32_t*>(buf)[3] !=
+                      input0[3] + input1[3]) {
+                all_ok = false;
+              }
+              remaining--;
+              cv.notify_all();
+            },
+            options, {&in0, &in1}),
+        "async infer");
+  }
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait_for(lk, std::chrono::seconds(30), [&] { return remaining == 0; });
+  }
+  FAIL_IF(remaining != 0, "missing completions");
+  FAIL_IF(!all_ok, "wrong async results");
+  std::cout << "PASS: http async infer\n";
+  return 0;
+}

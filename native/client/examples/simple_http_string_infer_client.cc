@@ -1,0 +1,36 @@
+// BYTES tensors over HTTP binary framing (reference:
+// src/c++/examples/simple_http_string_infer_client.cc).
+#include <iostream>
+
+#include "../http_client.h"
+#include "example_utils.h"
+
+using namespace tputriton;  // NOLINT
+
+int main(int argc, char** argv) {
+  std::string url = ParseUrl(argc, argv, "localhost:8000");
+  std::unique_ptr<InferenceServerHttpClient> client;
+  FAIL_IF_ERR(InferenceServerHttpClient::Create(&client, url), "create");
+
+  std::vector<std::string> vals0, vals1;
+  for (int i = 0; i < 16; i++) {
+    vals0.push_back(std::to_string(i * 10));
+    vals1.push_back(std::to_string(i));
+  }
+  InferInput in0("INPUT0", {1, 16}, "BYTES");
+  InferInput in1("INPUT1", {1, 16}, "BYTES");
+  in0.AppendFromString(vals0);
+  in1.AppendFromString(vals1);
+
+  InferOptions options("simple_string");
+  std::shared_ptr<InferResult> result;
+  FAIL_IF_ERR(client->Infer(&result, options, {&in0, &in1}), "infer");
+  std::vector<std::string> sums;
+  FAIL_IF_ERR(result->StringData("OUTPUT0", &sums), "string data");
+  FAIL_IF(sums.size() != 16, "wrong element count");
+  for (int i = 0; i < 16; i++) {
+    FAIL_IF(sums[i] != std::to_string(i * 11), "wrong string sum");
+  }
+  std::cout << "PASS: http string infer\n";
+  return 0;
+}

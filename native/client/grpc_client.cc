@@ -233,9 +233,11 @@ Error InferenceServerGrpcClient::Call(
     const std::string& method, const google::protobuf::MessageLite& request,
     google::protobuf::MessageLite* response, uint64_t timeout_us) {
   // No caller timeout means no deadline (gRPC semantics); a dead connection
-  // still unblocks every waiter via the reader thread's FailAll.
+  // still unblocks every waiter via the reader thread's FailAll. Sub-ms
+  // timeouts round up — truncating to 0 would mean "infinite".
   int64_t timeout_ms =
-      timeout_us == 0 ? 0 : static_cast<int64_t>(timeout_us / 1000);
+      timeout_us == 0 ? 0
+                      : std::max<int64_t>(1, static_cast<int64_t>(timeout_us / 1000));
   std::string framed;
   FrameMessage(request, &framed);
   int32_t stream_id;
@@ -265,20 +267,23 @@ Error InferenceServerGrpcClient::Call(
     conn_->ReleaseStream(stream_id);
     return Error("Deadline Exceeded");
   }
-  uint32_t rst_code;
-  if (conn_->StreamReset(stream_id, &rst_code)) {
-    conn_->ReleaseStream(stream_id);
-    return Error("stream reset by server (h2 error " +
-                 std::to_string(rst_code) + ")");
-  }
+  // grpc-status (headers or trailers) is authoritative when present — some
+  // servers follow the trailers with an RST (e.g. NO_ERROR after enforcing
+  // grpc-timeout), which must not mask the real status.
   Error status = GrpcStatus(conn_->ResponseHeaders(stream_id),
                             conn_->Trailers(stream_id));
-  // A completed exchange (grpc-status present) stands even if the
-  // connection died right after it; blame the connection only when the
-  // stream never finished properly.
-  if (!status.IsOk() && conn_->Dead() &&
-      status.Message() == "no grpc-status in response") {
-    status = Error("connection failed: " + conn_->LastError());
+  if (!status.IsOk() && status.Message() == "no grpc-status in response") {
+    uint32_t rst_code;
+    if (conn_->StreamReset(stream_id, &rst_code)) {
+      // A deadline propagated via grpc-timeout can come back as a bare RST
+      // CANCEL when the server enforces it before we do.
+      status = (timeout_us != 0 && rst_code == 8)
+                   ? Error("Deadline Exceeded")
+                   : Error("stream reset by server (h2 error " +
+                           std::to_string(rst_code) + ")");
+    } else if (conn_->Dead()) {
+      status = Error("connection failed: " + conn_->LastError());
+    }
   }
   conn_->ReleaseStream(stream_id);
   if (!status.IsOk()) return status;
@@ -643,8 +648,9 @@ void InferenceServerGrpcClient::CompletionWorker() {
       cq_.pop_front();
     }
     int64_t timeout_ms =
-        req.timeout_us == 0 ? 120000
-                            : static_cast<int64_t>(req.timeout_us / 1000);
+        req.timeout_us == 0
+            ? 120000
+            : std::max<int64_t>(1, static_cast<int64_t>(req.timeout_us / 1000));
     std::string msg;
     Error read_err;
     bool have_msg =
@@ -658,10 +664,18 @@ void InferenceServerGrpcClient::CompletionWorker() {
     if (status.IsOk()) {
       status = GrpcStatus(conn_->ResponseHeaders(req.stream_id),
                           conn_->Trailers(req.stream_id));
-      // Completed exchanges stand even if the connection died just after.
-      if (!status.IsOk() && conn_->Dead() &&
-          status.Message() == "no grpc-status in response") {
-        status = Error("connection failed: " + conn_->LastError());
+      // grpc-status is authoritative; fall back to reset/connection state
+      // only when the stream never produced one.
+      if (!status.IsOk() && status.Message() == "no grpc-status in response") {
+        uint32_t rst_code;
+        if (conn_->StreamReset(req.stream_id, &rst_code)) {
+          status = (req.timeout_us != 0 && rst_code == 8)
+                       ? Error("Deadline Exceeded")
+                       : Error("stream reset by server (h2 error " +
+                               std::to_string(rst_code) + ")");
+        } else if (conn_->Dead()) {
+          status = Error("connection failed: " + conn_->LastError());
+        }
       }
     }
     conn_->ReleaseStream(req.stream_id);

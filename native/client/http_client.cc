@@ -8,6 +8,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <chrono>
 #include <cerrno>
 #include <cstdio>
 #include <cstring>
@@ -55,6 +56,53 @@ class HttpConnection {
 
   bool Connected() const { return fd_ >= 0; }
 
+  // Per-request TOTAL deadline (0 clears). Each recv/send is armed with the
+  // remaining budget, so a server dripping bytes cannot extend the deadline
+  // indefinitely; expiry surfaces as "timed out" which Request() maps to
+  // "Deadline Exceeded".
+  void SetRecvTimeout(uint64_t timeout_us) {
+    has_deadline_ = timeout_us != 0;
+    if (has_deadline_) {
+      deadline_ = std::chrono::steady_clock::now() +
+                  std::chrono::microseconds(timeout_us);
+    }
+  }
+
+  // Arm SO_RCVTIMEO/SO_SNDTIMEO with the remaining budget; fails once the
+  // total deadline has passed.
+  bool ArmDeadline() {
+    if (fd_ < 0) return true;
+    struct timeval tv = {0, 0};
+    if (has_deadline_) {
+      auto remaining = std::chrono::duration_cast<std::chrono::microseconds>(
+                           deadline_ - std::chrono::steady_clock::now())
+                           .count();
+      if (remaining <= 0) return false;
+      tv.tv_sec = static_cast<time_t>(remaining / 1000000);
+      tv.tv_usec = static_cast<suseconds_t>(remaining % 1000000);
+    }
+    setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd_, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    return true;
+  }
+
+  static Error RecvError(ssize_t n, const char* where) {
+    if (n == 0) {
+      return Error(std::string("connection closed by peer ") + where);
+    }
+    if (errno == EAGAIN || errno == EWOULDBLOCK) {
+      return Error(std::string("socket read timed out ") + where);
+    }
+    return Error(std::string("socket read failed ") + where);
+  }
+
+  Error RecvSome(char* buf, size_t cap, ssize_t* n, const char* where) {
+    if (!ArmDeadline()) return Error(std::string("socket read timed out ") + where);
+    *n = recv(fd_, buf, cap, 0);
+    if (*n <= 0) return RecvError(*n, where);
+    return Error::Success;
+  }
+
   void Close() {
     if (fd_ >= 0) {
       close(fd_);
@@ -78,8 +126,9 @@ class HttpConnection {
     std::string head;
     while (head.find("\r\n\r\n") == std::string::npos) {
       char buf[4096];
-      ssize_t n = recv(fd_, buf, sizeof(buf), 0);
-      if (n <= 0) return Error("socket read failed");
+      ssize_t n;
+      Error err = RecvSome(buf, sizeof(buf), &n, "reading headers");
+      if (!err.IsOk()) return err;
       head.append(buf, static_cast<size_t>(n));
       if (head.size() > (1 << 20)) return Error("oversized response header");
     }
@@ -133,8 +182,9 @@ class HttpConnection {
         char buf[65536];
         size_t want =
             std::min(sizeof(buf), content_length - response->body.size());
-        ssize_t n = recv(fd_, buf, want, 0);
-        if (n <= 0) return Error("socket read failed mid-body");
+        ssize_t n;
+        Error err = RecvSome(buf, want, &n, "mid-body");
+        if (!err.IsOk()) return err;
         response->body.insert(response->body.end(), buf, buf + n);
       }
     }
@@ -146,6 +196,9 @@ class HttpConnection {
   }
 
  private:
+  std::chrono::steady_clock::time_point deadline_{};
+  bool has_deadline_ = false;
+
   // Decode a Transfer-Encoding: chunked body. On entry *body holds the raw
   // (still-encoded) bytes already read past the headers; on success it holds
   // the decoded payload.
@@ -156,8 +209,9 @@ class HttpConnection {
     auto fill = [&](size_t want_total) -> Error {
       while (raw.size() < want_total) {
         char buf[65536];
-        ssize_t n = recv(fd_, buf, sizeof(buf), 0);
-        if (n <= 0) return Error("socket read failed mid-chunk");
+        ssize_t n;
+        Error err = RecvSome(buf, sizeof(buf), &n, "mid-chunk");
+        if (!err.IsOk()) return err;
         raw.append(buf, static_cast<size_t>(n));
       }
       return Error::Success;
@@ -222,6 +276,7 @@ struct InferenceServerHttpClient::AsyncTask {
   std::string path;  // full infer path incl. model version
   std::vector<uint8_t> body;
   size_t json_size = 0;
+  uint64_t timeout_us = 0;
 };
 
 static std::string InferPath(const InferOptions& options) {
@@ -265,7 +320,7 @@ Error InferenceServerHttpClient::Request(
     const std::string& method, const std::string& path,
     const std::vector<uint8_t>& body,
     const std::map<std::string, std::string>& extra_headers,
-    HttpResponse* response) {
+    HttpResponse* response, uint64_t timeout_us) {
   std::lock_guard<std::mutex> lk(conn_mu_);
   for (int attempt = 0; attempt < 2; attempt++) {
     bool fresh = false;
@@ -274,6 +329,7 @@ Error InferenceServerHttpClient::Request(
       if (!err.IsOk()) return err;
       fresh = true;
     }
+    conn_->SetRecvTimeout(timeout_us);
     std::ostringstream req;
     req << method << " /" << path << " HTTP/1.1\r\n"
         << "Host: " << host_ << ":" << port_ << "\r\n"
@@ -291,8 +347,15 @@ Error InferenceServerHttpClient::Request(
       err = conn_->WriteAll(body.data(), body.size());
     }
     if (err.IsOk()) err = conn_->ReadResponse(response);
-    if (err.IsOk()) return Error::Success;
+    if (err.IsOk()) {
+      conn_->SetRecvTimeout(0);
+      return Error::Success;
+    }
     conn_->Close();
+    if (timeout_us != 0 &&
+        err.Message().find("timed out") != std::string::npos) {
+      return Error("Deadline Exceeded");
+    }
     // Retry once, only when the failure hit a reused keep-alive socket
     // (likely closed while idle); a fresh-connection failure is real.
     if (fresh || attempt == 1) return err;
@@ -865,7 +928,8 @@ Error InferenceServerHttpClient::Infer(
       {"Inference-Header-Content-Length", std::to_string(json_size)},
   };
   HttpResponse response;
-  err = Request("POST", InferPath(options), body, headers, &response);
+  err = Request("POST", InferPath(options), body, headers, &response,
+                options.client_timeout_us_);
   if (!err.IsOk()) return err;
   err = CheckStatus(response);
   if (!err.IsOk()) return err;
@@ -889,6 +953,7 @@ Error InferenceServerHttpClient::AsyncInfer(
   auto task = std::make_unique<AsyncTask>();
   task->callback = std::move(callback);
   task->path = InferPath(options);
+  task->timeout_us = options.client_timeout_us_;
   Error err = BuildInferRequest(options, inputs, outputs, &task->body,
                                 &task->json_size);
   if (!err.IsOk()) return err;
@@ -917,7 +982,8 @@ void InferenceServerHttpClient::AsyncWorker() {
     HttpResponse response;
     RequestTimers timers;
     timers.Capture(RequestTimers::Kind::REQUEST_START);
-    Error err = Request("POST", task->path, task->body, headers, &response);
+    Error err = Request("POST", task->path, task->body, headers, &response,
+                        task->timeout_us);
     if (err.IsOk()) err = CheckStatus(response);
     std::shared_ptr<InferResult> result;
     if (err.IsOk()) {

@@ -105,7 +105,7 @@ class InferenceServerHttpClient {
   Error Request(const std::string& method, const std::string& path,
                 const std::vector<uint8_t>& body,
                 const std::map<std::string, std::string>& extra_headers,
-                HttpResponse* response);
+                HttpResponse* response, uint64_t timeout_us = 0);
   Error JsonGet(const std::string& path, json::ValuePtr* out);
   Error JsonPost(const std::string& path, const std::string& body,
                  json::ValuePtr* out);

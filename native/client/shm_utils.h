@@ -1,0 +1,27 @@
+// Example-facing POSIX shared-memory helpers (capability parity with the
+// reference's src/c++/library/shm_utils.h:38-64 — create/map/close/unlink
+// used by the shm example apps).
+#pragma once
+
+#include <cstddef>
+#include <string>
+
+#include "common.h"
+
+namespace tputriton {
+
+// shm_open(O_CREAT) + ftruncate; returns the fd.
+Error CreateSharedMemoryRegion(const std::string& shm_key, size_t byte_size,
+                               int* shm_fd);
+
+// mmap a window of the region.
+Error MapSharedMemory(int shm_fd, size_t offset, size_t byte_size,
+                      void** shm_addr);
+
+Error CloseSharedMemory(int shm_fd);
+
+Error UnlinkSharedMemoryRegion(const std::string& shm_key);
+
+Error UnmapSharedMemory(void* shm_addr, size_t byte_size);
+
+}  // namespace tputriton

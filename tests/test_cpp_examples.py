@@ -1,0 +1,103 @@
+"""Drive every self-checking C++ example/diagnostic binary (the cc half of
+the reference's example matrix, src/c++/examples + tests)."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+from tritonclient_tpu.server import InferenceServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUILD = os.path.join(REPO, "build")
+
+GRPC_EXAMPLES = [
+    "simple_grpc_infer_client",
+    "simple_grpc_async_infer_client",
+    "simple_grpc_string_infer_client",
+    "simple_grpc_sequence_stream_infer_client",
+    "simple_grpc_custom_repeat",
+    "simple_grpc_shm_client",
+    "simple_grpc_tpushm_client",
+    "simple_grpc_health_metadata",
+    "simple_grpc_model_control",
+]
+HTTP_EXAMPLES = [
+    "simple_http_infer_client",
+    "simple_http_async_infer_client",
+    "simple_http_string_infer_client",
+    "simple_http_shm_client",
+]
+
+
+@pytest.fixture(scope="module")
+def cpp_binaries():
+    if shutil.which("cmake") is None or shutil.which("g++") is None:
+        pytest.skip("no native toolchain")
+    gen = ["-G", "Ninja"] if shutil.which("ninja") else []
+    subprocess.run(
+        ["cmake", "-S", os.path.join(REPO, "native"), "-B", BUILD, *gen],
+        check=True, capture_output=True,
+    )
+    subprocess.run(
+        ["cmake", "--build", BUILD], check=True, capture_output=True,
+        timeout=600,
+    )
+    return BUILD
+
+
+@pytest.fixture(scope="module")
+def server():
+    with InferenceServer() as s:
+        yield s
+
+
+@pytest.mark.parametrize("example", GRPC_EXAMPLES)
+def test_grpc_example(cpp_binaries, server, example):
+    proc = subprocess.run(
+        [os.path.join(cpp_binaries, example), "-u", server.grpc_address],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr}"
+    assert "PASS" in proc.stdout
+
+
+@pytest.mark.parametrize("example", HTTP_EXAMPLES)
+def test_http_example(cpp_binaries, server, example):
+    proc = subprocess.run(
+        [os.path.join(cpp_binaries, example), "-u", server.http_address],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr}"
+    assert "PASS" in proc.stdout
+
+
+def test_reuse_infer_objects(cpp_binaries, server):
+    proc = subprocess.run(
+        [os.path.join(cpp_binaries, "reuse_infer_objects_client"),
+         "-g", server.grpc_address, "-h", server.http_address],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr}"
+    assert "PASS" in proc.stdout
+
+
+def test_memory_leak(cpp_binaries, server):
+    proc = subprocess.run(
+        [os.path.join(cpp_binaries, "memory_leak_test"),
+         "-g", server.grpc_address, "-h", server.http_address, "-r", "100"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr}"
+    assert "PASS" in proc.stdout
+
+
+def test_client_timeout(cpp_binaries, server):
+    proc = subprocess.run(
+        [os.path.join(cpp_binaries, "client_timeout_test"),
+         "-g", server.grpc_address, "-h", server.http_address],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr}"
+    assert "ALL PASS" in proc.stdout

@@ -149,3 +149,26 @@ class RepeatModel(Model):
                 yield {"OUT": np.array([v], dtype=np.int32)}
 
         return gen()
+
+
+class SlowIdentityModel(Model):
+    """Identity model with a configurable server-side delay.
+
+    The timeout-test target: the reference's client_timeout_test runs against
+    a delayed custom model (client_timeout_test.cc:60-362); this plays that
+    role. Delay comes from the ``delay_ms`` request parameter (default 300).
+    """
+
+    name = "slow_identity"
+
+    def __init__(self):
+        super().__init__()
+        self.inputs = [TensorSpec("INPUT", "INT32", [-1, 16])]
+        self.outputs = [TensorSpec("OUTPUT", "INT32", [-1, 16])]
+
+    def infer(self, inputs, parameters=None):
+        import time as _time
+
+        delay_ms = int((parameters or {}).get("delay_ms", 300))
+        _time.sleep(delay_ms / 1000.0)
+        return {"OUTPUT": np.asarray(inputs["INPUT"], dtype=np.int32)}

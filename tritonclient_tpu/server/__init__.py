@@ -34,9 +34,16 @@ def default_models():
         SimpleModel,
         SimpleSequenceModel,
         SimpleStringModel,
+        SlowIdentityModel,
     )
 
-    return [SimpleModel(), SimpleStringModel(), SimpleSequenceModel(), RepeatModel()]
+    return [
+        SimpleModel(),
+        SimpleStringModel(),
+        SimpleSequenceModel(),
+        RepeatModel(),
+        SlowIdentityModel(),
+    ]
 
 
 class InferenceServer:
