@@ -1,0 +1,67 @@
+#!/usr/bin/env python3
+"""Ensemble inference: raw image bytes in, classification out.
+
+Parity with the reference ensemble_image_client.py — the client sends the
+encoded image as a BYTES tensor to an ensemble model
+(preprocess_resnet50_ensemble, the TPU-native analog of
+preprocess_inception_ensemble) and never sees the intermediate
+preprocessed tensor; the server chains preprocess → classifier.
+"""
+
+import numpy as np
+
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.grpc import (
+    InferenceServerClient,
+    InferInput,
+    InferRequestedOutput,
+)
+
+
+def _image_blobs(paths, height=224, width=224):
+    if paths:
+        return [open(p, "rb").read() for p in paths]
+    # Hermetic path: raw float32 pixel dumps (see ImagePreprocessModel).
+    rng = np.random.default_rng(0)
+    return [
+        rng.random((height, width, 3), dtype=np.float32).tobytes()
+        for _ in range(2)
+    ]
+
+
+def main():
+    parser = example_parser(__doc__)
+    parser.add_argument("-m", "--model-name", default="preprocess_resnet50_ensemble")
+    parser.add_argument("-c", "--classes", type=int, default=1)
+    parser.add_argument("images", nargs="*", help="image files (optional)")
+    args = parser.parse_args()
+
+    models = None
+    if args.fixture:
+        from tritonclient_tpu.models.ensemble import make_image_ensemble
+        from tritonclient_tpu.server import default_models
+
+        ensemble, members = make_image_ensemble(num_classes=10)
+        models = default_models() + members + [ensemble]
+
+    with maybe_fixture_server(args, models=models) as url:
+        with InferenceServerClient(url, verbose=args.verbose) as client:
+            blobs = _image_blobs(args.images)
+            batch = np.array(blobs, dtype=np.object_)
+
+            inp = InferInput("INPUT", [len(blobs)], "BYTES")
+            inp.set_data_from_numpy(batch)
+            out = InferRequestedOutput("OUTPUT", class_count=args.classes)
+            result = client.infer(args.model_name, [inp], outputs=[out])
+
+            rows = result.as_numpy("OUTPUT").reshape(len(blobs), args.classes)
+            for i, image_rows in enumerate(rows):
+                print(f"image {i}:")
+                for row in image_rows:
+                    value, idx, *label = row.decode().split(":")
+                    print(f"  {float(value):8.4f} (#{idx}) {label[0] if label else ''}")
+            print("PASS: ensemble image classification")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+#!/usr/bin/env python3
+"""Explicit typed-contents infer for BYTES tensors via contents.bytes_contents.
+
+Parity with the reference grpc_explicit_byte_content_client.py — string
+elements are appended one-by-one to bytes_contents (no 4-byte length
+framing on this path; that framing applies only to raw/serialized BYTES).
+"""
+
+import sys
+
+import grpc
+
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.protocol import GRPCInferenceServiceStub, pb
+from tritonclient_tpu.utils import deserialize_bytes_tensor
+
+
+def main():
+    args = example_parser(__doc__).parse_args()
+    with maybe_fixture_server(args) as url:
+        with grpc.insecure_channel(url) as channel:
+            stub = GRPCInferenceServiceStub(channel)
+            request = pb.ModelInferRequest(model_name="simple_string")
+
+            t0 = request.inputs.add()
+            t0.name = "INPUT0"
+            t0.datatype = "BYTES"
+            t0.shape.extend([1, 16])
+            for i in range(16):
+                t0.contents.bytes_contents.append(str(i).encode())
+            t1 = request.inputs.add()
+            t1.name = "INPUT1"
+            t1.datatype = "BYTES"
+            t1.shape.extend([1, 16])
+            for _ in range(16):
+                t1.contents.bytes_contents.append(b"1")
+            for name in ("OUTPUT0", "OUTPUT1"):
+                request.outputs.add().name = name
+
+            response = stub.ModelInfer(request)
+            out0 = deserialize_bytes_tensor(response.raw_output_contents[0])
+            out1 = deserialize_bytes_tensor(response.raw_output_contents[1])
+            for i in range(16):
+                if int(out0[i]) != i + 1 or int(out1[i]) != i - 1:
+                    print(f"error: wrong result at {i}")
+                    sys.exit(1)
+            print("PASS: explicit byte contents")
+
+
+if __name__ == "__main__":
+    main()

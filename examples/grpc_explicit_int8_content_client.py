@@ -1,0 +1,46 @@
+#!/usr/bin/env python3
+"""Explicit typed-contents infer for INT8 tensors (simple_int8 model).
+
+Parity with the reference grpc_explicit_int8_content_client.py — INT8
+values travel in contents.int_contents (there is no int8-specific field
+in the KServe proto) and come back as raw int8 bytes.
+"""
+
+import sys
+
+import grpc
+import numpy as np
+
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.protocol import GRPCInferenceServiceStub, pb
+
+
+def main():
+    args = example_parser(__doc__).parse_args()
+    input0 = list(range(16))
+    input1 = [2] * 16
+    with maybe_fixture_server(args) as url:
+        with grpc.insecure_channel(url) as channel:
+            stub = GRPCInferenceServiceStub(channel)
+            request = pb.ModelInferRequest(model_name="simple_int8")
+            for name, data in (("INPUT0", input0), ("INPUT1", input1)):
+                tensor = request.inputs.add()
+                tensor.name = name
+                tensor.datatype = "INT8"
+                tensor.shape.extend([1, 16])
+                tensor.contents.int_contents[:] = data
+            for name in ("OUTPUT0", "OUTPUT1"):
+                request.outputs.add().name = name
+
+            response = stub.ModelInfer(request)
+            out0 = np.frombuffer(response.raw_output_contents[0], dtype=np.int8)
+            out1 = np.frombuffer(response.raw_output_contents[1], dtype=np.int8)
+            for i in range(16):
+                if out0[i] != input0[i] + input1[i] or out1[i] != input0[i] - input1[i]:
+                    print(f"error: wrong result at {i}")
+                    sys.exit(1)
+            print("PASS: explicit int8 contents")
+
+
+if __name__ == "__main__":
+    main()

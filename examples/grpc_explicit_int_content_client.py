@@ -1,0 +1,74 @@
+#!/usr/bin/env python3
+"""Explicit typed-contents infer: INT32 via contents.int_contents.
+
+Parity with the reference grpc_explicit_int_content_client.py — populate
+the per-tensor `contents` oneof instead of raw_input_contents, and verify
+the server rejects requests that mix the two content planes.
+"""
+
+import sys
+
+import grpc
+import numpy as np
+
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.protocol import GRPCInferenceServiceStub, pb
+
+
+def _base_request():
+    request = pb.ModelInferRequest(model_name="simple")
+    for name in ("OUTPUT0", "OUTPUT1"):
+        request.outputs.add().name = name
+    return request
+
+
+def main():
+    args = example_parser(__doc__).parse_args()
+    input0 = list(range(16))
+    input1 = [1] * 16
+    with maybe_fixture_server(args) as url:
+        with grpc.insecure_channel(url) as channel:
+            stub = GRPCInferenceServiceStub(channel)
+
+            request = _base_request()
+            for name, data in (("INPUT0", input0), ("INPUT1", input1)):
+                tensor = request.inputs.add()
+                tensor.name = name
+                tensor.datatype = "INT32"
+                tensor.shape.extend([1, 16])
+                tensor.contents.int_contents[:] = data
+            response = stub.ModelInfer(request)
+            out0 = np.frombuffer(response.raw_output_contents[0], dtype=np.int32)
+            out1 = np.frombuffer(response.raw_output_contents[1], dtype=np.int32)
+            for i in range(16):
+                if out0[i] != input0[i] + input1[i] or out1[i] != input0[i] - input1[i]:
+                    print(f"error: wrong result at {i}")
+                    sys.exit(1)
+
+            # Mixing raw_input_contents with typed contents must be rejected.
+            bad = _base_request()
+            t0 = bad.inputs.add()
+            t0.name = "INPUT0"
+            t0.datatype = "INT32"
+            t0.shape.extend([1, 16])
+            t0.contents.int_contents[:] = input0
+            t1 = bad.inputs.add()
+            t1.name = "INPUT1"
+            t1.datatype = "INT32"
+            t1.shape.extend([1, 16])
+            bad.raw_input_contents.append(
+                np.array(input1, dtype=np.int32).tobytes()
+            )
+            try:
+                stub.ModelInfer(bad)
+                print("error: mixed content planes were accepted")
+                sys.exit(1)
+            except grpc.RpcError as e:
+                if "contents field must not be specified" not in e.details():
+                    print(f"error: unexpected error: {e.details()}")
+                    sys.exit(1)
+            print("PASS: explicit int contents (+ mixed-plane rejection)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+#!/usr/bin/env python3
+"""Image classification over raw generated gRPC stubs (no client library).
+
+Parity with the reference grpc_image_client.py — metadata-driven
+preprocessing like image_client.py, but every message is built by hand:
+ModelMetadata/ModelConfig for shape discovery, ModelInferRequest with
+raw_input_contents, and the classification extension requested through
+the output tensor's `classification` parameter.
+"""
+
+import sys
+
+import grpc
+import numpy as np
+
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.protocol import GRPCInferenceServiceStub, pb
+from tritonclient_tpu.utils import deserialize_bytes_tensor
+
+
+def main():
+    parser = example_parser(__doc__)
+    parser.add_argument("-m", "--model-name", default="resnet50")
+    parser.add_argument("-c", "--classes", type=int, default=3)
+    args = parser.parse_args()
+
+    models = None
+    if args.fixture:
+        from tritonclient_tpu.models.resnet import ResNet50Model
+        from tritonclient_tpu.server import default_models
+
+        models = default_models() + [ResNet50Model(num_classes=10)]
+
+    with maybe_fixture_server(args, models=models) as url:
+        with grpc.insecure_channel(url) as channel:
+            stub = GRPCInferenceServiceStub(channel)
+            meta = stub.ModelMetadata(
+                pb.ModelMetadataRequest(name=args.model_name)
+            )
+            config = stub.ModelConfig(
+                pb.ModelConfigRequest(name=args.model_name)
+            ).config
+            input_meta, output_meta = meta.inputs[0], meta.outputs[0]
+            if len(config.input) != 1:
+                print("error: expected single-input model")
+                sys.exit(1)
+            height, width = int(input_meta.shape[1]), int(input_meta.shape[2])
+
+            rng = np.random.default_rng(0)
+            batch = rng.random((1, height, width, 3), dtype=np.float32)
+
+            request = pb.ModelInferRequest(model_name=args.model_name)
+            tensor = request.inputs.add()
+            tensor.name = input_meta.name
+            tensor.datatype = input_meta.datatype
+            tensor.shape.extend(batch.shape)
+            request.raw_input_contents.append(batch.tobytes())
+            out = request.outputs.add()
+            out.name = output_meta.name
+            out.parameters["classification"].int64_param = args.classes
+
+            response = stub.ModelInfer(request)
+            rows = deserialize_bytes_tensor(response.raw_output_contents[0])
+            if rows.size != args.classes:
+                print("error: wrong classification row count")
+                sys.exit(1)
+            for row in rows.reshape(-1):
+                value, idx, *label = row.decode().split(":")
+                print(f"  {float(value):8.4f} (#{idx}) {label[0] if label else ''}")
+            print("PASS: raw-stub image classification")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+#!/usr/bin/env python3
+"""Infer over a channel with explicit gRPC keepalive settings.
+
+Parity with the reference simple_grpc_keepalive_client.py: construct
+KeepAliveOptions (time/timeout/permit-without-calls/pings-without-data)
+and run the simple add/sub round-trip over the tuned channel.
+"""
+
+import sys
+
+import numpy as np
+
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.grpc import (
+    InferenceServerClient,
+    InferInput,
+    KeepAliveOptions,
+)
+
+
+def main():
+    args = example_parser(__doc__).parse_args()
+    keepalive = KeepAliveOptions(
+        keepalive_time_ms=2**31 - 1,
+        keepalive_timeout_ms=20000,
+        keepalive_permit_without_calls=False,
+        http2_max_pings_without_data=2,
+    )
+    with maybe_fixture_server(args) as url:
+        with InferenceServerClient(
+            url, verbose=args.verbose, keepalive_options=keepalive
+        ) as client:
+            input0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+            input1 = np.ones((1, 16), dtype=np.int32)
+            inputs = [
+                InferInput("INPUT0", [1, 16], "INT32"),
+                InferInput("INPUT1", [1, 16], "INT32"),
+            ]
+            inputs[0].set_data_from_numpy(input0)
+            inputs[1].set_data_from_numpy(input1)
+            result = client.infer("simple", inputs)
+            if not (
+                np.array_equal(result.as_numpy("OUTPUT0"), input0 + input1)
+                and np.array_equal(result.as_numpy("OUTPUT1"), input0 - input1)
+            ):
+                print("error: incorrect results")
+                sys.exit(1)
+            print("PASS: keepalive infer")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+#!/usr/bin/env python3
+"""BYTES tensors through system shared memory.
+
+Parity with the reference simple_grpc_shm_string_client.py: serialize
+string tensors with the 4-byte-length wire format, place them in /dev/shm
+regions, and size the output regions from the expected serialized results.
+"""
+
+import sys
+
+import numpy as np
+
+import tritonclient_tpu.utils.shared_memory as shm
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.grpc import (
+    InferenceServerClient,
+    InferInput,
+    InferRequestedOutput,
+)
+from tritonclient_tpu.utils import serialize_byte_tensor, serialized_byte_size
+
+
+def main():
+    args = example_parser(__doc__).parse_args()
+    with maybe_fixture_server(args) as url:
+        with InferenceServerClient(url, verbose=args.verbose) as client:
+            client.unregister_system_shared_memory()
+
+            in0 = np.array([[str(i) for i in range(16)]], dtype=np.object_)
+            in1 = np.array([["1"] * 16], dtype=np.object_)
+            expected_sum = np.array(
+                [[str(i + 1) for i in range(16)]], dtype=np.object_
+            )
+            expected_diff = np.array(
+                [[str(i - 1) for i in range(16)]], dtype=np.object_
+            )
+
+            in0_ser = serialize_byte_tensor(in0)
+            in1_ser = serialize_byte_tensor(in1)
+            in0_size = serialized_byte_size(in0_ser)
+            in1_size = serialized_byte_size(in1_ser)
+            out0_size = serialized_byte_size(serialize_byte_tensor(expected_sum))
+            out1_size = serialized_byte_size(serialize_byte_tensor(expected_diff))
+
+            ip0 = shm.create_shared_memory_region("input0_data", "/input0_str", in0_size)
+            ip1 = shm.create_shared_memory_region("input1_data", "/input1_str", in1_size)
+            op0 = shm.create_shared_memory_region("output0_data", "/output0_str", out0_size)
+            op1 = shm.create_shared_memory_region("output1_data", "/output1_str", out1_size)
+            try:
+                shm.set_shared_memory_region(ip0, [in0_ser])
+                shm.set_shared_memory_region(ip1, [in1_ser])
+                client.register_system_shared_memory("input0_data", "/input0_str", in0_size)
+                client.register_system_shared_memory("input1_data", "/input1_str", in1_size)
+                client.register_system_shared_memory("output0_data", "/output0_str", out0_size)
+                client.register_system_shared_memory("output1_data", "/output1_str", out1_size)
+
+                inputs = [
+                    InferInput("INPUT0", [1, 16], "BYTES"),
+                    InferInput("INPUT1", [1, 16], "BYTES"),
+                ]
+                inputs[0].set_shared_memory("input0_data", in0_size)
+                inputs[1].set_shared_memory("input1_data", in1_size)
+                outputs = [
+                    InferRequestedOutput("OUTPUT0"),
+                    InferRequestedOutput("OUTPUT1"),
+                ]
+                outputs[0].set_shared_memory("output0_data", out0_size)
+                outputs[1].set_shared_memory("output1_data", out1_size)
+
+                client.infer("simple_string", inputs, outputs=outputs)
+
+                out0 = shm.get_contents_as_numpy(op0, np.object_, [1, 16])
+                out1 = shm.get_contents_as_numpy(op1, np.object_, [1, 16])
+                for i in range(16):
+                    if int(out0[0][i]) != i + 1 or int(out1[0][i]) != i - 1:
+                        print(f"error: wrong result at {i}")
+                        sys.exit(1)
+                print("PASS: system shared memory string infer")
+            finally:
+                client.unregister_system_shared_memory()
+                for h in (ip0, ip1, op0, op1):
+                    shm.destroy_shared_memory_region(h)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+#!/usr/bin/env python3
+"""Future-based async_infer over HTTP (reference simple_http_async_infer_client.py).
+
+HTTP async_infer returns an InferAsyncRequest handle; results are
+collected with get_result(), bounded by the client's connection pool.
+"""
+
+import sys
+
+import numpy as np
+
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.http import InferenceServerClient, InferInput
+
+
+def main():
+    args = example_parser(__doc__, default_port=8000).parse_args()
+    with maybe_fixture_server(args, grpc=False) as url:
+        with InferenceServerClient(url, verbose=args.verbose, concurrency=4) as client:
+            input0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+            input1 = np.ones((1, 16), dtype=np.int32)
+            inputs = [
+                InferInput("INPUT0", [1, 16], "INT32"),
+                InferInput("INPUT1", [1, 16], "INT32"),
+            ]
+            inputs[0].set_data_from_numpy(input0)
+            inputs[1].set_data_from_numpy(input1)
+
+            n = 4
+            handles = [client.async_infer("simple", inputs) for _ in range(n)]
+            for handle in handles:
+                result = handle.get_result(timeout=30)
+                out0 = result.as_numpy("OUTPUT0")
+                out1 = result.as_numpy("OUTPUT1")
+                if not (np.array_equal(out0, input0 + input1)
+                        and np.array_equal(out1, input0 - input1)):
+                    print("error: incorrect results")
+                    sys.exit(1)
+            print(f"PASS: {n} http async infers")
+
+
+if __name__ == "__main__":
+    main()

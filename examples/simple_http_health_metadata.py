@@ -1,0 +1,40 @@
+#!/usr/bin/env python3
+"""Health, metadata, statistics, trace and log settings over HTTP/REST.
+
+Parity with the reference simple_http_health_metadata.py plus the
+v2/trace/setting and v2/logging control paths.
+"""
+
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.http import InferenceServerClient
+
+
+def main():
+    args = example_parser(__doc__, default_port=8000).parse_args()
+    with maybe_fixture_server(args, grpc=False) as url:
+        with InferenceServerClient(url, verbose=args.verbose) as client:
+            assert client.is_server_live()
+            assert client.is_server_ready()
+            assert client.is_model_ready("simple")
+
+            meta = client.get_server_metadata()
+            print(f"server: {meta['name']} {meta['version']}")
+            print(f"extensions: {', '.join(meta['extensions'])}")
+
+            model_meta = client.get_model_metadata("simple")
+            print(f"model inputs: {[t['name'] for t in model_meta['inputs']]}")
+
+            stats = client.get_inference_statistics("simple")
+            print(f"stats entries: {len(stats['model_stats'])}")
+
+            trace = client.update_trace_settings(
+                settings={"trace_level": ["TIMESTAMPS"]}
+            )
+            assert trace["trace_level"] == ["TIMESTAMPS"]
+            client.update_log_settings({"log_verbose_level": 1})
+            assert client.get_log_settings() is not None
+            print("PASS: http health/metadata/statistics/trace/log")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+#!/usr/bin/env python3
+"""Model repository control over HTTP/REST: index, unload, load with override.
+
+Parity with the reference simple_http_model_control.py via the
+v2/repository REST paths.
+"""
+
+import json
+import sys
+
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.http import InferenceServerClient
+
+
+def main():
+    args = example_parser(__doc__, default_port=8000).parse_args()
+    with maybe_fixture_server(args, grpc=False) as url:
+        with InferenceServerClient(url, verbose=args.verbose) as client:
+            index = client.get_model_repository_index()
+            names = [m["name"] for m in index]
+            print("repository:", names)
+            assert "simple" in names
+
+            client.unload_model("simple")
+            if client.is_model_ready("simple"):
+                print("error: simple still ready after unload")
+                sys.exit(1)
+
+            override = json.dumps({"max_batch_size": 8})
+            client.load_model("simple", config=override)
+            if not client.is_model_ready("simple"):
+                print("error: simple not ready after load")
+                sys.exit(1)
+            config = client.get_model_config("simple")
+            if config["max_batch_size"] != 8:
+                print("error: config override not applied")
+                sys.exit(1)
+
+            client.load_model("simple")
+            config = client.get_model_config("simple")
+            assert config.get("max_batch_size", 0) == 0
+            print("PASS: http model control (index/unload/load/override)")
+
+
+if __name__ == "__main__":
+    main()

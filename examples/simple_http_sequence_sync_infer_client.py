@@ -1,0 +1,45 @@
+#!/usr/bin/env python3
+"""Stateful sequences with synchronous infer over HTTP/REST.
+
+Parity with the reference simple_http_sequence_sync_infer_client.py:
+sequence_id/start/end ride the request JSON's parameters object.
+"""
+
+import sys
+
+import numpy as np
+
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.http import InferenceServerClient, InferInput
+
+
+def main():
+    args = example_parser(__doc__, default_port=8000).parse_args()
+    values = [10, 20, 30]
+    with maybe_fixture_server(args, grpc=False) as url:
+        with InferenceServerClient(url, verbose=args.verbose) as client:
+            # Two interleaved sequences (the reference drives sequences in
+            # pairs to prove per-correlation-id isolation).
+            acc = {}
+            for i, value in enumerate(values):
+                for seq_id, sign in ((1001, 1), (1002, -1)):
+                    inp = InferInput("INPUT", [1, 1], "INT32")
+                    inp.set_data_from_numpy(
+                        np.array([[sign * value]], dtype=np.int32)
+                    )
+                    result = client.infer(
+                        "simple_sequence",
+                        [inp],
+                        sequence_id=seq_id,
+                        sequence_start=(i == 0),
+                        sequence_end=(i == len(values) - 1),
+                    )
+                    acc[seq_id] = int(result.as_numpy("OUTPUT")[0][0])
+            if acc[1001] != sum(values) or acc[1002] != -sum(values):
+                print(f"error: accumulators wrong: {acc}")
+                sys.exit(1)
+            print("PASS: http sequence sync infer (interleaved pair)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+#!/usr/bin/env python3
+"""System shared-memory infer over HTTP/REST.
+
+Parity with the reference simple_http_shm_client.py: registration goes
+through the v2/systemsharedmemory REST paths; tensor bytes move via
+/dev/shm.
+"""
+
+import sys
+
+import numpy as np
+
+import tritonclient_tpu.utils.shared_memory as shm
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.http import (
+    InferenceServerClient,
+    InferInput,
+    InferRequestedOutput,
+)
+
+
+def main():
+    args = example_parser(__doc__, default_port=8000).parse_args()
+    with maybe_fixture_server(args, grpc=False) as url:
+        with InferenceServerClient(url, verbose=args.verbose) as client:
+            client.unregister_system_shared_memory()
+
+            input0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+            input1 = np.ones((1, 16), dtype=np.int32)
+            in_bytes = input0.nbytes + input1.nbytes
+            out_bytes = input0.nbytes * 2
+
+            in_handle = shm.create_shared_memory_region(
+                "input_data", "/input_http_simple", in_bytes
+            )
+            out_handle = shm.create_shared_memory_region(
+                "output_data", "/output_http_simple", out_bytes
+            )
+            try:
+                shm.set_shared_memory_region(in_handle, [input0, input1])
+                client.register_system_shared_memory(
+                    "input_data", "/input_http_simple", in_bytes
+                )
+                client.register_system_shared_memory(
+                    "output_data", "/output_http_simple", out_bytes
+                )
+                status = client.get_system_shared_memory_status()
+                assert {r["name"] for r in status} >= {"input_data", "output_data"}
+
+                inputs = [
+                    InferInput("INPUT0", [1, 16], "INT32"),
+                    InferInput("INPUT1", [1, 16], "INT32"),
+                ]
+                inputs[0].set_shared_memory("input_data", input0.nbytes)
+                inputs[1].set_shared_memory(
+                    "input_data", input1.nbytes, offset=input0.nbytes
+                )
+                outputs = [
+                    InferRequestedOutput("OUTPUT0"),
+                    InferRequestedOutput("OUTPUT1"),
+                ]
+                outputs[0].set_shared_memory("output_data", input0.nbytes)
+                outputs[1].set_shared_memory(
+                    "output_data", input0.nbytes, offset=input0.nbytes
+                )
+
+                client.infer("simple", inputs, outputs=outputs)
+                out0 = shm.get_contents_as_numpy(out_handle, np.int32, [1, 16])
+                out1 = shm.get_contents_as_numpy(
+                    out_handle, np.int32, [1, 16], offset=input0.nbytes
+                )
+                if not (np.array_equal(out0, input0 + input1)
+                        and np.array_equal(out1, input0 - input1)):
+                    print("error: incorrect results")
+                    sys.exit(1)
+                print("PASS: http system shared memory infer")
+            finally:
+                client.unregister_system_shared_memory()
+                shm.destroy_shared_memory_region(in_handle)
+                shm.destroy_shared_memory_region(out_handle)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+#!/usr/bin/env python3
+"""BYTES tensor infer over HTTP: binary framing and JSON data legs.
+
+Parity with the reference simple_http_string_infer_client.py against the
+simple_string model — one input rides the binary blob, the other the
+JSON `data` field, exercising both HTTP string encodings.
+"""
+
+import sys
+
+import numpy as np
+
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.http import InferenceServerClient, InferInput
+
+
+def main():
+    args = example_parser(__doc__, default_port=8000).parse_args()
+    with maybe_fixture_server(args, grpc=False) as url:
+        with InferenceServerClient(url, verbose=args.verbose) as client:
+            in0 = np.array([[str(i) for i in range(16)]], dtype=np.object_)
+            in1 = np.array([["1"] * 16], dtype=np.object_)
+            inputs = [
+                InferInput("INPUT0", [1, 16], "BYTES"),
+                InferInput("INPUT1", [1, 16], "BYTES"),
+            ]
+            inputs[0].set_data_from_numpy(in0, binary_data=True)
+            inputs[1].set_data_from_numpy(in1, binary_data=False)  # JSON leg
+            result = client.infer("simple_string", inputs)
+            out0 = result.as_numpy("OUTPUT0")
+            out1 = result.as_numpy("OUTPUT1")
+            for i in range(16):
+                if int(out0[0][i]) != i + 1 or int(out1[0][i]) != i - 1:
+                    print(f"error: wrong result at {i}")
+                    sys.exit(1)
+            print("PASS: http string infer (binary + JSON legs)")
+
+
+if __name__ == "__main__":
+    main()

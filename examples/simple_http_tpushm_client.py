@@ -1,0 +1,85 @@
+#!/usr/bin/env python3
+"""TPU shared-memory infer over HTTP/REST: device arrays, zero host copies.
+
+Replaces the reference's simple_http_cudashm_client.py — registration
+rides the v2/tpusharedmemory REST extension paths; tensor bytes stay on
+device via parked jax.Arrays. Requires a co-located server (--fixture).
+"""
+
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+
+import tritonclient_tpu.utils.tpu_shared_memory as tpushm
+from _fixture import example_parser, maybe_fixture_server
+from tritonclient_tpu.http import (
+    InferenceServerClient,
+    InferInput,
+    InferRequestedOutput,
+)
+
+
+def main():
+    args = example_parser(__doc__, default_port=8000).parse_args()
+    with maybe_fixture_server(args, grpc=False) as url:
+        with InferenceServerClient(url, verbose=args.verbose) as client:
+            client.unregister_tpu_shared_memory()
+
+            input0 = jnp.arange(16, dtype=jnp.int32).reshape(1, 16)
+            input1 = jnp.ones((1, 16), jnp.int32)
+            nbytes = 16 * 4
+
+            in_handle = tpushm.create_shared_memory_region(
+                "input_data", 2 * nbytes, device_id=0
+            )
+            out_handle = tpushm.create_shared_memory_region(
+                "output_data", 2 * nbytes, device_id=0
+            )
+            try:
+                tpushm.set_shared_memory_region_from_dlpack(
+                    in_handle, [input0, input1]
+                )
+                client.register_tpu_shared_memory(
+                    "input_data", tpushm.get_raw_handle(in_handle), 0, 2 * nbytes
+                )
+                client.register_tpu_shared_memory(
+                    "output_data", tpushm.get_raw_handle(out_handle), 0, 2 * nbytes
+                )
+                status = client.get_tpu_shared_memory_status()
+                assert {r["name"] for r in status} >= {"input_data", "output_data"}
+
+                inputs = [
+                    InferInput("INPUT0", [1, 16], "INT32"),
+                    InferInput("INPUT1", [1, 16], "INT32"),
+                ]
+                inputs[0].set_shared_memory("input_data", nbytes)
+                inputs[1].set_shared_memory("input_data", nbytes, offset=nbytes)
+                outputs = [
+                    InferRequestedOutput("OUTPUT0"),
+                    InferRequestedOutput("OUTPUT1"),
+                ]
+                outputs[0].set_shared_memory("output_data", nbytes)
+                outputs[1].set_shared_memory("output_data", nbytes, offset=nbytes)
+
+                client.infer("simple", inputs, outputs=outputs)
+
+                sums = tpushm.as_shared_memory_tensor(out_handle, "INT32", [1, 16])
+                diffs = tpushm.as_shared_memory_tensor(
+                    out_handle, "INT32", [1, 16], offset=nbytes
+                )
+                expected0 = np.asarray(input0) + np.asarray(input1)
+                expected1 = np.asarray(input0) - np.asarray(input1)
+                if not (np.array_equal(np.asarray(sums), expected0)
+                        and np.array_equal(np.asarray(diffs), expected1)):
+                    print("error: incorrect results")
+                    sys.exit(1)
+                print("PASS: http tpu shared memory infer (zero-copy)")
+            finally:
+                client.unregister_tpu_shared_memory()
+                tpushm.destroy_shared_memory_region(in_handle)
+                tpushm.destroy_shared_memory_region(out_handle)
+
+
+if __name__ == "__main__":
+    main()

@@ -65,3 +65,53 @@ def test_example_inventory_covers_reference_families():
     }
     for family, filename in families.items():
         assert filename in EXAMPLES, f"missing {family} example: {filename}"
+
+
+def test_every_reference_example_filename_is_mapped():
+    """All 35 reference src/python/examples files have a repo counterpart.
+
+    cudashm names map to tpushm (the TPU-native zero-copy plane); everything
+    else maps one-to-one.
+    """
+    reference_to_repo = {
+        "ensemble_image_client.py": "ensemble_image_client.py",
+        "grpc_client.py": "grpc_client.py",
+        "grpc_explicit_byte_content_client.py": "grpc_explicit_byte_content_client.py",
+        "grpc_explicit_int8_content_client.py": "grpc_explicit_int8_content_client.py",
+        "grpc_explicit_int_content_client.py": "grpc_explicit_int_content_client.py",
+        "grpc_image_client.py": "grpc_image_client.py",
+        "image_client.py": "image_client.py",
+        "memory_growth_test.py": "memory_growth_test.py",
+        "reuse_infer_objects_client.py": "reuse_infer_objects_client.py",
+        "simple_grpc_aio_infer_client.py": "simple_grpc_aio_infer_client.py",
+        "simple_grpc_aio_sequence_stream_infer_client.py":
+            "simple_grpc_aio_sequence_stream_infer_client.py",
+        "simple_grpc_async_infer_client.py": "simple_grpc_async_infer_client.py",
+        "simple_grpc_cudashm_client.py": "simple_grpc_tpushm_client.py",
+        "simple_grpc_custom_args_client.py": "simple_grpc_custom_args_client.py",
+        "simple_grpc_custom_repeat.py": "simple_grpc_custom_repeat.py",
+        "simple_grpc_health_metadata.py": "simple_grpc_health_metadata.py",
+        "simple_grpc_infer_client.py": "simple_grpc_infer_client.py",
+        "simple_grpc_keepalive_client.py": "simple_grpc_keepalive_client.py",
+        "simple_grpc_model_control.py": "simple_grpc_model_control.py",
+        "simple_grpc_sequence_stream_infer_client.py":
+            "simple_grpc_sequence_stream_infer_client.py",
+        "simple_grpc_sequence_sync_infer_client.py":
+            "simple_grpc_sequence_sync_infer_client.py",
+        "simple_grpc_shm_client.py": "simple_grpc_shm_client.py",
+        "simple_grpc_shm_string_client.py": "simple_grpc_shm_string_client.py",
+        "simple_grpc_string_infer_client.py": "simple_grpc_string_infer_client.py",
+        "simple_http_aio_infer_client.py": "simple_http_aio_infer_client.py",
+        "simple_http_async_infer_client.py": "simple_http_async_infer_client.py",
+        "simple_http_cudashm_client.py": "simple_http_tpushm_client.py",
+        "simple_http_health_metadata.py": "simple_http_health_metadata.py",
+        "simple_http_infer_client.py": "simple_http_infer_client.py",
+        "simple_http_model_control.py": "simple_http_model_control.py",
+        "simple_http_sequence_sync_infer_client.py":
+            "simple_http_sequence_sync_infer_client.py",
+        "simple_http_shm_client.py": "simple_http_shm_client.py",
+        "simple_http_shm_string_client.py": "simple_http_shm_string_client.py",
+        "simple_http_string_infer_client.py": "simple_http_string_infer_client.py",
+    }
+    for ref_name, repo_name in reference_to_repo.items():
+        assert repo_name in EXAMPLES, f"{ref_name} not mapped ({repo_name} missing)"

@@ -61,6 +61,21 @@ class TestSystemShm:
         finally:
             shm.destroy_shared_memory_region(region)
 
+    def test_single_element_bytes_contract(self):
+        # Reference contract: 1-element object arrays are written verbatim
+        # (pre-serialized buffers); genuine single-element BYTES tensors go
+        # through serialize_byte_tensor first.
+        from tritonclient_tpu.utils import serialize_byte_tensor
+
+        region = shm.create_shared_memory_region("regs1", "/tpu_test_regs1", 64)
+        try:
+            single = np.array([b"hello"], dtype=np.object_)
+            shm.set_shared_memory_region(region, [serialize_byte_tensor(single)])
+            out = shm.get_contents_as_numpy(region, "BYTES", [1])
+            assert out[0] == b"hello"
+        finally:
+            shm.destroy_shared_memory_region(region)
+
     def test_str_array_and_scalar_shape(self):
         region = shm.create_shared_memory_region("regu", "/tpu_test_regu", 64)
         try:

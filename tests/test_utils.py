@@ -103,10 +103,16 @@ class TestBytesWireFormat:
             serialize_byte_tensor(np.array([1, 2, 3], dtype=np.int32))
 
     def test_serialized_byte_size(self):
+        # Called on serialize_byte_tensor output it returns the exact
+        # serialized stream size (the framing is inside the element).
         arr = np.array([b"abc", b"de"], dtype=np.object_)
-        assert serialized_byte_size(arr) == (4 + 3) + (4 + 2)
-        dense = np.zeros((2, 3), dtype=np.float32)
-        assert serialized_byte_size(dense) == 24
+        serialized = serialize_byte_tensor(arr)
+        assert serialized_byte_size(serialized) == (4 + 3) + (4 + 2)
+        # Raw object arrays sum element lengths without framing, and dense
+        # arrays are rejected — reference contract (utils/__init__.py:43-68).
+        assert serialized_byte_size(arr) == 5
+        with pytest.raises(InferenceServerException):
+            serialized_byte_size(np.zeros((2, 3), dtype=np.float32))
 
 
 class TestBF16WireFormat:

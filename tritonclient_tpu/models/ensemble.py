@@ -1,0 +1,158 @@
+"""Ensemble (model-composition) support for the JAX backend.
+
+The reference's ensemble_image_client.py drives a server-side ensemble
+("preprocess_inception_ensemble"): raw encoded image bytes go in, the server
+chains a preprocessing model into a classifier, and classification rows come
+out — the client never sees the intermediate tensor. Triton expresses this as
+an ensemble scheduling DAG in model config; here the same contract is a
+composition Model whose steps run in-process, each step's outputs wired to the
+next step's inputs by name maps (mirroring ensemble_scheduling.step[].
+input_map/output_map in Triton model config).
+"""
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tritonclient_tpu.models._base import Model, TensorSpec
+
+
+class EnsembleStep:
+    """One step of an ensemble DAG.
+
+    ``input_map`` maps the member model's input names to ensemble-graph tensor
+    names; ``output_map`` maps the member's output names to graph names.
+    """
+
+    def __init__(self, model: Model, input_map: Dict[str, str],
+                 output_map: Dict[str, str]):
+        self.model = model
+        self.input_map = dict(input_map)
+        self.output_map = dict(output_map)
+
+
+class EnsembleModel(Model):
+    """Runs member models in sequence over a named-tensor graph.
+
+    The ensemble's own ``inputs``/``outputs`` specs name graph tensors; each
+    step pulls its inputs from the graph and publishes its outputs back.
+    """
+
+    platform = "ensemble"
+
+    def __init__(self, name: str, inputs: List[TensorSpec],
+                 outputs: List[TensorSpec], steps: List[EnsembleStep],
+                 labels: Optional[List[str]] = None):
+        super().__init__()
+        self.name = name
+        self.inputs = inputs
+        self.outputs = outputs
+        self.steps = steps
+        self.labels = labels
+
+    def config(self) -> dict:
+        cfg = super().config()
+        cfg["backend"] = ""
+        cfg["ensemble_scheduling"] = {
+            "step": [
+                {
+                    "model_name": s.model.name,
+                    "model_version": -1,
+                    "input_map": s.input_map,
+                    "output_map": s.output_map,
+                }
+                for s in self.steps
+            ]
+        }
+        return cfg
+
+    def infer(self, inputs, parameters=None):
+        graph: Dict[str, np.ndarray] = dict(inputs)
+        for step in self.steps:
+            member_in = {
+                model_name: graph[graph_name]
+                for model_name, graph_name in step.input_map.items()
+            }
+            member_out = step.model.infer(member_in, parameters)
+            for model_name, graph_name in step.output_map.items():
+                graph[graph_name] = member_out[model_name]
+        return {spec.name: graph[spec.name] for spec in self.outputs}
+
+    def warmup(self):
+        for step in self.steps:
+            step.model.warmup()
+
+
+class ImagePreprocessModel(Model):
+    """Decodes encoded image BYTES into fp32 NHWC [batch, H, W, 3] in [0,1].
+
+    The DALI/inception-preprocess stand-in for the ensemble example: accepts
+    PNG/JPEG bytes when Pillow is importable, else raw little-endian float32
+    pixel dumps of exactly H*W*3 values (the hermetic path the tests use).
+    """
+
+    name = "image_preprocess"
+
+    def __init__(self, height: int = 224, width: int = 224):
+        super().__init__()
+        self.height, self.width = height, width
+        self.inputs = [TensorSpec("RAW_IMAGE", "BYTES", [-1])]
+        self.outputs = [
+            TensorSpec("PREPROCESSED", "FP32", [-1, height, width, 3])
+        ]
+
+    def _decode_one(self, blob: bytes) -> np.ndarray:
+        expected = self.height * self.width * 3
+        if len(blob) == expected * 4:
+            return np.frombuffer(blob, dtype="<f4").reshape(
+                self.height, self.width, 3
+            )
+        try:
+            import io
+
+            from PIL import Image
+
+            img = Image.open(io.BytesIO(blob)).convert("RGB").resize(
+                (self.width, self.height)
+            )
+            return np.asarray(img, dtype=np.float32) / 255.0
+        except ImportError as exc:
+            raise ValueError(
+                "RAW_IMAGE element is not a raw float32 dump and Pillow is "
+                "unavailable to decode encoded images"
+            ) from exc
+
+    def infer(self, inputs, parameters=None):
+        blobs = np.asarray(inputs["RAW_IMAGE"], dtype=np.object_).reshape(-1)
+        batch = np.stack([
+            self._decode_one(b if isinstance(b, bytes) else bytes(b))
+            for b in blobs
+        ])
+        return {"PREPROCESSED": batch.astype(np.float32)}
+
+
+def make_image_ensemble(num_classes: int = 10, seed: int = 0) -> Tuple[EnsembleModel, list]:
+    """Builds `preprocess_resnet50_ensemble` (+ its member models).
+
+    The TPU-native analog of the reference's preprocess_inception_ensemble:
+    RAW_IMAGE bytes → preprocess → resnet50 logits → OUTPUT. Returns the
+    ensemble and the member list (members must also be loaded so the
+    repository index matches Triton's behavior of listing ensemble members).
+    """
+    from tritonclient_tpu.models.resnet import ResNet50Model
+
+    preprocess = ImagePreprocessModel()
+    resnet = ResNet50Model(num_classes=num_classes, seed=seed)
+    ensemble = EnsembleModel(
+        name="preprocess_resnet50_ensemble",
+        inputs=[TensorSpec("INPUT", "BYTES", [-1])],
+        outputs=[TensorSpec("OUTPUT", "FP32", [-1, num_classes])],
+        steps=[
+            EnsembleStep(preprocess, {"RAW_IMAGE": "INPUT"},
+                         {"PREPROCESSED": "preprocessed_image"}),
+            EnsembleStep(resnet, {"INPUT": "preprocessed_image"},
+                         {"OUTPUT": "OUTPUT"}),
+        ],
+        labels=resnet.labels,
+    )
+    return ensemble, [preprocess, resnet]

@@ -49,6 +49,39 @@ class SimpleModel(Model):
         jax.block_until_ready(_add_sub(z, z))
 
 
+class SimpleInt8Model(Model):
+    """int8 [1,16] add/sub with wraparound — the `simple_int8` qa model.
+
+    Exercised by the reference's grpc_explicit_int8_content_client.py
+    (explicit `contents.int_contents` population for INT8 tensors).
+    """
+
+    name = "simple_int8"
+    platform = "jax"
+
+    def __init__(self):
+        super().__init__()
+        self.inputs = [
+            TensorSpec("INPUT0", "INT8", [-1, 16]),
+            TensorSpec("INPUT1", "INT8", [-1, 16]),
+        ]
+        self.outputs = [
+            TensorSpec("OUTPUT0", "INT8", [-1, 16]),
+            TensorSpec("OUTPUT1", "INT8", [-1, 16]),
+        ]
+
+    def infer(self, inputs, parameters=None):
+        s, d = _add_sub(
+            jnp.asarray(inputs["INPUT0"], jnp.int8),
+            jnp.asarray(inputs["INPUT1"], jnp.int8),
+        )
+        return {"OUTPUT0": s, "OUTPUT1": d}
+
+    def warmup(self):
+        z = jnp.zeros((1, 16), jnp.int8)
+        jax.block_until_ready(_add_sub(z, z))
+
+
 class SimpleStringModel(Model):
     """BYTES [1,16] add/sub: elements are decimal strings; outputs are strings."""
 

@@ -31,6 +31,7 @@ def default_models():
     """The model set matching the reference's example/test matrix."""
     from tritonclient_tpu.models.simple import (
         RepeatModel,
+        SimpleInt8Model,
         SimpleModel,
         SimpleSequenceModel,
         SimpleStringModel,
@@ -39,6 +40,7 @@ def default_models():
 
     return [
         SimpleModel(),
+        SimpleInt8Model(),
         SimpleStringModel(),
         SimpleSequenceModel(),
         RepeatModel(),

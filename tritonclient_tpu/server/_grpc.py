@@ -71,6 +71,15 @@ def request_to_core(request: pb.ModelInferRequest, core: InferenceCore) -> CoreR
             ct.shm_byte_size = int(params.get("shared_memory_byte_size", 0))
             ct.shm_kind = core.find_shm_kind(ct.shm_region)
         elif use_raw:
+            # Triton rejects mixing the two content planes (the reference's
+            # grpc_explicit_int_content_client.py asserts this exact error).
+            if tensor.HasField("contents"):
+                raise CoreError(
+                    "contents field must not be specified when using "
+                    f"raw_input_contents for '{tensor.name}' for model "
+                    f"'{request.model_name}'",
+                    400,
+                )
             if raw_index < len(raw):
                 ct.data = InferenceCore._decode_raw(
                     ct.datatype, ct.shape, raw[raw_index]

@@ -267,16 +267,22 @@ def deserialize_bf16_tensor(encoded_tensor: bytes) -> np.ndarray:
 
 
 def serialized_byte_size(tensor_value: np.ndarray) -> int:
-    """Byte size a tensor occupies on the wire (reference: utils/__init__.py:43-68)."""
-    if tensor_value.dtype == np.object_:
-        total = 0
-        for obj in tensor_value.flatten():
-            if isinstance(obj, (bytes, np.bytes_)):
-                total += 4 + len(obj)
-            else:
-                total += 4 + len(str(obj).encode("utf-8"))
-        return total
-    return tensor_value.nbytes
+    """Underlying byte count of an object-dtype tensor.
+
+    Intended for serialize_byte_tensor output (whose single element already
+    contains the 4-byte length prefixes), returning the exact region/wire
+    size. Matches the reference contract (utils/__init__.py:43-68): object
+    dtype required, sum of each element's byte length, no added framing.
+    """
+    if tensor_value.dtype != np.object_:
+        raise_error("The tensor_value dtype must be np.object_")
+    total = 0
+    for obj in tensor_value.flatten():
+        if isinstance(obj, (bytes, np.bytes_)):
+            total += len(obj)
+        else:
+            total += len(str(obj).encode("utf-8"))
+    return total
 
 
 def num_elements(shape) -> int:

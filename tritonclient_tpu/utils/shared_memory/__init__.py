@@ -137,8 +137,14 @@ def set_shared_memory_region(
 ):
     """Copy each numpy array in ``input_values`` into the region in order.
 
+    A 1-element object array holding bytes is written verbatim — that is the
+    reference contract (shared_memory/__init__.py:155-157: object arrays are
+    ``.item()``-ed, so callers pass serialize_byte_tensor output). A genuine
+    single-element BYTES tensor must therefore go through
+    serialize_byte_tensor first, exactly as with the reference. Multi-element
     BYTES (object/str dtype) arrays are serialized with the 4-byte-length
-    wire format first, exactly as the wire path would.
+    wire format automatically — a convenience the reference lacks (it would
+    raise on ``.item()`` there).
     """
     if not isinstance(input_values, (list, tuple)):
         raise SharedMemoryException("input_values must be a list of numpy arrays")
@@ -150,7 +156,9 @@ def set_shared_memory_region(
         arr = np.asarray(arr)
         if arr.dtype.type == np.str_:
             arr = np.char.encode(arr, "utf-8")
-        if arr.dtype == np.object_ or arr.dtype.type == np.bytes_:
+        if arr.dtype == np.object_ and arr.size == 1 and isinstance(arr.item(), bytes):
+            data = arr.item()  # pre-serialized buffer (reference semantics)
+        elif arr.dtype == np.object_ or arr.dtype.type == np.bytes_:
             data = serialize_byte_tensor(arr)[0]
         else:
             data = np.ascontiguousarray(arr).tobytes()

@@ -252,7 +252,15 @@ def set_shared_memory_region(
         arr = np.asarray(arr)
         if arr.dtype.type == np.str_:
             arr = np.char.encode(arr, "utf-8")
-        if arr.dtype == np.object_ or arr.dtype.type == np.bytes_:
+        if arr.dtype == np.object_ and arr.size == 1 and isinstance(arr.item(), bytes):
+            # Pre-serialized buffer (reference semantics: object arrays are
+            # .item()-ed, shared_memory/__init__.py:155-157). Genuine
+            # single-element BYTES tensors must be serialize_byte_tensor-ed
+            # by the caller, as with the reference.
+            data = arr.item()
+            shm_handle.write_bytes(cursor, data)
+            cursor += len(data)
+        elif arr.dtype == np.object_ or arr.dtype.type == np.bytes_:
             # BYTES tensors have no device representation; the serialized
             # wire bytes land in the region's host mirror.
             data = serialize_byte_tensor(arr)[0]
