@@ -167,6 +167,94 @@ int main(int argc, char** argv) {
   EXPECT_OK(client->IsModelReady("simple_string", &sready), "ready query 2");
   EXPECT(sready, "loaded ready");
 
+  // chunked upload: a tensor larger than one GetNext window (16 MiB) must
+  // stream to the server intact (reference chunked-upload contract,
+  // common.h:340-353 + 16 MiB buffers http_client.cc:2172-2175)
+  {
+    const size_t rows = 300000;  // 300000*16*4 B = ~18.3 MiB > one window
+    std::vector<int32_t> big(rows * 16);
+    for (size_t i = 0; i < big.size(); i++) big[i] = static_cast<int32_t>(i);
+    InferInput bin("INPUT", {static_cast<int64_t>(rows), 16}, "INT32");
+    bin.AppendRaw(reinterpret_cast<uint8_t*>(big.data()), big.size() * 4);
+    // Exercise the cursor directly: expect two windows then end-of-input.
+    bin.PrepareForRequest();
+    const uint8_t* cbuf = nullptr;
+    size_t cbytes = 0;
+    bool cend = false;
+    EXPECT_OK(bin.GetNext(&cbuf, &cbytes, &cend), "GetNext 1");
+    EXPECT(cbytes == InferInput::kUploadChunkBytes && !cend,
+           "first window full and not final");
+    EXPECT_OK(bin.GetNext(&cbuf, &cbytes, &cend), "GetNext 2");
+    EXPECT(cend && cbytes == big.size() * 4 - InferInput::kUploadChunkBytes,
+           "second window is the remainder");
+
+    InferOptions big_opt("slow_identity");
+    big_opt.request_parameters_["delay_ms"] = "0";
+    EXPECT_OK(client->Infer(&result, big_opt, {&bin}), "large infer");
+    EXPECT_OK(result->RawData("OUTPUT", &buf, &nbytes), "large OUTPUT raw");
+    EXPECT(nbytes == big.size() * 4, "large OUTPUT size");
+    if (nbytes == big.size() * 4) {
+      const int32_t* out = reinterpret_cast<const int32_t*>(buf);
+      bool match = out[0] == big[0] &&
+                   out[big.size() / 2] == big[big.size() / 2] &&
+                   out[big.size() - 1] == big[big.size() - 1];
+      EXPECT(match, "large roundtrip values");
+    }
+  }
+
+  // zlib request compression: gzip and deflate bodies must round-trip
+  // (reference zlib request compression, http_client.cc:2138-2151)
+  for (CompressionType ctype :
+       {CompressionType::GZIP, CompressionType::DEFLATE}) {
+    InferInput cin0("INPUT0", {1, 16}, "INT32");
+    InferInput cin1("INPUT1", {1, 16}, "INT32");
+    cin0.AppendRaw(reinterpret_cast<uint8_t*>(input0), 64);
+    cin1.AppendRaw(reinterpret_cast<uint8_t*>(input1), 64);
+    EXPECT_OK(client->Infer(&result, options, {&cin0, &cin1}, {}, ctype,
+                            CompressionType::NONE),
+              "compressed infer");
+    EXPECT_OK(result->RawData("OUTPUT0", &buf, &nbytes), "compressed OUTPUT0");
+    EXPECT(nbytes == 64 &&
+               reinterpret_cast<const int32_t*>(buf)[7] ==
+                   input0[7] + input1[7],
+           "compressed sum value");
+  }
+
+  // response compression negotiation on a JSON (non-binary-framed) response
+  {
+    InferInput cin0("INPUT0", {1, 16}, "INT32");
+    InferInput cin1("INPUT1", {1, 16}, "INT32");
+    cin0.AppendRaw(reinterpret_cast<uint8_t*>(input0), 64);
+    cin1.AppendRaw(reinterpret_cast<uint8_t*>(input1), 64);
+    InferRequestedOutput jout0("OUTPUT0");
+    jout0.SetBinaryData(false);
+    EXPECT_OK(client->Infer(&result, options, {&cin0, &cin1}, {&jout0},
+                            CompressionType::NONE, CompressionType::GZIP),
+              "accept-gzip infer");
+    EXPECT_OK(result->RawData("OUTPUT0", &buf, &nbytes), "gzip-resp OUTPUT0");
+    EXPECT(nbytes == 64 &&
+               reinterpret_cast<const int32_t*>(buf)[2] ==
+                   input0[2] + input1[2],
+           "gzip-resp sum value");
+  }
+
+  // TLS is a build option: without TPU_CLIENT_ENABLE_TLS, https must fail
+  // with a clear error, never silently downgrade
+  {
+    std::unique_ptr<InferenceServerHttpClient> tls_client;
+    Error terr = InferenceServerHttpClient::Create(
+        &tls_client, std::string("https://") + argv[1]);
+    EXPECT(!terr.IsOk() &&
+               terr.Message().find("without TLS support") != std::string::npos,
+           "https refused without TLS build");
+    HttpSslOptions ssl;
+    ssl.ca_info = "/nonexistent/ca.pem";
+    terr = InferenceServerHttpClient::Create(&tls_client, argv[1], ssl);
+    EXPECT(!terr.IsOk() &&
+               terr.Message().find("without TLS support") != std::string::npos,
+           "ssl options refused without TLS build");
+  }
+
   // trace/log settings
   json::ValuePtr settings;
   EXPECT_OK(client->GetTraceSettings(&settings), "get trace");

@@ -109,6 +109,34 @@ class InferInput {
   Error Reset() {
     data_.clear();
     shm_name_.clear();
+    next_offset_ = 0;
+    return Error::Success;
+  }
+
+  // Chunked-upload cursor (reference InferInput::PrepareForRequest/GetNext,
+  // common.h:340-353): the transport calls PrepareForRequest once per send
+  // attempt, then drains the tensor in bounded windows so arbitrarily large
+  // inputs stream to the socket without a monolithic body copy.
+  static constexpr size_t kUploadChunkBytes = 16 * 1024 * 1024;
+
+  Error PrepareForRequest() {
+    next_offset_ = 0;
+    return Error::Success;
+  }
+
+  Error GetNext(const uint8_t** buf, size_t* input_bytes, bool* end_of_input) {
+    if (next_offset_ >= data_.size()) {
+      *buf = nullptr;
+      *input_bytes = 0;
+      *end_of_input = true;
+      return Error::Success;
+    }
+    size_t n = data_.size() - next_offset_;
+    if (n > kUploadChunkBytes) n = kUploadChunkBytes;
+    *buf = data_.data() + next_offset_;
+    *input_bytes = n;
+    next_offset_ += n;
+    *end_of_input = next_offset_ >= data_.size();
     return Error::Success;
   }
 
@@ -128,6 +156,7 @@ class InferInput {
   std::string shm_name_;
   size_t shm_byte_size_ = 0;
   size_t shm_offset_ = 0;
+  size_t next_offset_ = 0;
 };
 
 class InferRequestedOutput {

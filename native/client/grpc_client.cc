@@ -208,6 +208,22 @@ Error InferenceServerGrpcClient::Create(
   return Error::Success;
 }
 
+Error InferenceServerGrpcClient::Create(
+    std::unique_ptr<InferenceServerGrpcClient>* client, const std::string& url,
+    bool use_ssl, const SslOptions& ssl_options, bool verbose) {
+  if (!use_ssl) return Create(client, url, verbose);
+#ifdef TPU_CLIENT_ENABLE_TLS
+  (void)ssl_options;
+  return Error("TLS channel setup not implemented for this transport yet");
+#else
+  (void)ssl_options;
+  (void)client;
+  return Error(
+      "client built without TLS support; rebuild with TPU_CLIENT_ENABLE_TLS "
+      "and an OpenSSL dev stack to use SslOptions");
+#endif
+}
+
 InferenceServerGrpcClient::InferenceServerGrpcClient(
     std::shared_ptr<h2::Connection> conn, bool verbose)
     : conn_(std::move(conn)), verbose_(verbose) {

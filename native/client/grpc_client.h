@@ -29,6 +29,16 @@
 
 namespace tputriton {
 
+// TLS configuration (field parity with the reference's SslOptions,
+// grpc_client.h:43-60: PEM-encoded root certs / private key / cert chain).
+// Honored only in TPU_CLIENT_ENABLE_TLS builds; otherwise the ssl Create
+// overload fails fast instead of silently downgrading to plaintext.
+struct SslOptions {
+  std::string root_certificates;
+  std::string private_key;
+  std::string certificate_chain;
+};
+
 class InferenceServerGrpcClient {
  public:
   using OnCompleteFn = std::function<void(std::shared_ptr<InferResult>, Error)>;
@@ -37,6 +47,9 @@ class InferenceServerGrpcClient {
 
   static Error Create(std::unique_ptr<InferenceServerGrpcClient>* client,
                       const std::string& url, bool verbose = false);
+  static Error Create(std::unique_ptr<InferenceServerGrpcClient>* client,
+                      const std::string& url, bool use_ssl,
+                      const SslOptions& ssl_options, bool verbose = false);
   ~InferenceServerGrpcClient();
 
   // -- health / metadata ----------------------------------------------------

@@ -50,6 +50,22 @@ int main(int argc, char** argv) {
   EXPECT_OK(InferenceServerGrpcClient::Create(&client2, argv[1]),
             "create shared");
 
+  // TLS is a build option: use_ssl must fail fast without it, and the
+  // use_ssl=false overload must behave exactly like plain Create.
+  {
+    std::unique_ptr<InferenceServerGrpcClient> tls_client;
+    SslOptions ssl;
+    ssl.root_certificates = "/nonexistent/ca.pem";
+    Error terr = InferenceServerGrpcClient::Create(&tls_client, argv[1], true,
+                                                   ssl);
+    EXPECT(!terr.IsOk() &&
+               terr.Message().find("TLS") != std::string::npos,
+           "ssl create refused without TLS build");
+    EXPECT_OK(
+        InferenceServerGrpcClient::Create(&tls_client, argv[1], false, ssl),
+        "use_ssl=false passthrough");
+  }
+
   // health + metadata
   bool live = false, ready = false;
   EXPECT_OK(client->IsServerLive(&live), "live");

@@ -7,6 +7,8 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <zlib.h>
+
 #include <algorithm>
 #include <chrono>
 #include <cerrno>
@@ -15,6 +17,111 @@
 #include <sstream>
 
 namespace tputriton {
+
+// --------------------------------------------------------------------------
+// zlib body compression (reference http_client.cc:2138-2151)
+// --------------------------------------------------------------------------
+
+static const char* EncodingName(CompressionType t) {
+  switch (t) {
+    case CompressionType::GZIP:
+      return "gzip";
+    case CompressionType::DEFLATE:
+      return "deflate";
+    default:
+      return "";
+  }
+}
+
+// Bounded zlib windows: avail_in/avail_out are 32-bit, so bodies are fed
+// through in chunks — bodies >= 4 GiB would otherwise silently truncate at
+// the uInt cast.
+static constexpr size_t kZlibWindowBytes = 16 * 1024 * 1024;
+
+static Error ZCompress(CompressionType type, const uint8_t* data, size_t nbytes,
+                       std::vector<uint8_t>* out) {
+  z_stream zs = {};
+  // windowBits 15 emits zlib framing ("deflate" per RFC 9110); +16 gzip.
+  int window_bits = 15 + (type == CompressionType::GZIP ? 16 : 0);
+  if (deflateInit2(&zs, Z_DEFAULT_COMPRESSION, Z_DEFLATED, window_bits, 8,
+                   Z_DEFAULT_STRATEGY) != Z_OK) {
+    return Error("failed to initialize zlib compression");
+  }
+  out->clear();
+  std::vector<uint8_t> buf(1 << 20);
+  size_t consumed = 0;
+  int rc = Z_OK;
+  do {
+    size_t take = std::min(kZlibWindowBytes, nbytes - consumed);
+    zs.next_in = const_cast<Bytef*>(data + consumed);
+    zs.avail_in = static_cast<uInt>(take);
+    consumed += take;
+    int flush = (consumed == nbytes) ? Z_FINISH : Z_NO_FLUSH;
+    do {
+      zs.next_out = buf.data();
+      zs.avail_out = static_cast<uInt>(buf.size());
+      rc = deflate(&zs, flush);
+      if (rc == Z_STREAM_ERROR) {
+        deflateEnd(&zs);
+        return Error("zlib compression failed");
+      }
+      out->insert(out->end(), buf.data(),
+                  buf.data() + (buf.size() - zs.avail_out));
+    } while (zs.avail_out == 0);
+  } while (consumed < nbytes);
+  deflateEnd(&zs);
+  if (rc != Z_STREAM_END) {
+    return Error("zlib compression did not complete (rc=" +
+                 std::to_string(rc) + ")");
+  }
+  return Error::Success;
+}
+
+static Error ZDecompressResponse(HttpResponse* response) {
+  auto it = response->headers.find("content-encoding");
+  if (it == response->headers.end() || it->second.empty()) {
+    return Error::Success;
+  }
+  if (it->second != "gzip" && it->second != "deflate") {
+    return Error("unsupported response Content-Encoding '" + it->second + "'");
+  }
+  z_stream zs = {};
+  // 15+32: auto-detect zlib vs gzip framing.
+  if (inflateInit2(&zs, 15 + 32) != Z_OK) {
+    return Error("failed to initialize zlib decompression");
+  }
+  const std::vector<uint8_t>& body = response->body;
+  std::vector<uint8_t> out;
+  std::vector<uint8_t> buf(1 << 20);
+  size_t consumed = 0;
+  int rc = Z_OK;
+  do {
+    size_t take = std::min(kZlibWindowBytes, body.size() - consumed);
+    zs.next_in = const_cast<Bytef*>(body.data() + consumed);
+    zs.avail_in = static_cast<uInt>(take);
+    consumed += take;
+    do {
+      zs.next_out = buf.data();
+      zs.avail_out = static_cast<uInt>(buf.size());
+      rc = inflate(&zs, Z_NO_FLUSH);
+      if (rc != Z_OK && rc != Z_STREAM_END && rc != Z_BUF_ERROR) {
+        inflateEnd(&zs);
+        return Error("zlib decompression failed (rc=" + std::to_string(rc) +
+                     ")");
+      }
+      out.insert(out.end(), buf.data(),
+                 buf.data() + (buf.size() - zs.avail_out));
+      if (rc == Z_STREAM_END) break;
+    } while (zs.avail_out == 0);
+  } while (rc != Z_STREAM_END && consumed < body.size());
+  inflateEnd(&zs);
+  if (rc != Z_STREAM_END) {
+    return Error("truncated compressed response body");
+  }
+  response->body.swap(out);
+  response->headers.erase("content-encoding");
+  return Error::Success;
+}
 
 // --------------------------------------------------------------------------
 // connection
@@ -277,6 +384,8 @@ struct InferenceServerHttpClient::AsyncTask {
   std::vector<uint8_t> body;
   size_t json_size = 0;
   uint64_t timeout_us = 0;
+  CompressionType request_compression = CompressionType::NONE;
+  CompressionType response_compression = CompressionType::NONE;
 };
 
 static std::string InferPath(const InferOptions& options) {
@@ -290,11 +399,42 @@ static std::string InferPath(const InferOptions& options) {
 Error InferenceServerHttpClient::Create(
     std::unique_ptr<InferenceServerHttpClient>* client, const std::string& url,
     bool verbose) {
+  if (url.rfind("https://", 0) == 0) {
+#ifdef TPU_CLIENT_ENABLE_TLS
+    // Never hand back a plaintext client for an https URL.
+    return Error("TLS connection setup not implemented for this transport yet");
+#else
+    return Error(
+        "client built without TLS support; rebuild with "
+        "TPU_CLIENT_ENABLE_TLS and an OpenSSL dev stack to use https URLs");
+#endif
+  }
   if (url.find("://") != std::string::npos) {
     return Error("url should not include the scheme (got '" + url + "')");
   }
   client->reset(new InferenceServerHttpClient(url, verbose));
   return Error::Success;
+}
+
+Error InferenceServerHttpClient::Create(
+    std::unique_ptr<InferenceServerHttpClient>* client, const std::string& url,
+    const HttpSslOptions& ssl_options, bool verbose) {
+#ifdef TPU_CLIENT_ENABLE_TLS
+  (void)ssl_options;
+  (void)url;
+  (void)verbose;
+  (void)client;
+  // Never hand back a plaintext client when TLS options were requested.
+  return Error("TLS connection setup not implemented for this transport yet");
+#else
+  (void)ssl_options;
+  (void)url;
+  (void)verbose;
+  (void)client;
+  return Error(
+      "client built without TLS support; rebuild with TPU_CLIENT_ENABLE_TLS "
+      "and an OpenSSL dev stack to use HttpSslOptions");
+#endif
 }
 
 InferenceServerHttpClient::InferenceServerHttpClient(const std::string& url,
@@ -316,9 +456,9 @@ InferenceServerHttpClient::~InferenceServerHttpClient() {
   if (worker_.joinable()) worker_.join();
 }
 
-Error InferenceServerHttpClient::Request(
-    const std::string& method, const std::string& path,
-    const std::vector<uint8_t>& body,
+Error InferenceServerHttpClient::RequestImpl(
+    const std::string& method, const std::string& path, size_t content_length,
+    const std::function<Error()>& write_body,
     const std::map<std::string, std::string>& extra_headers,
     HttpResponse* response, uint64_t timeout_us) {
   std::lock_guard<std::mutex> lk(conn_mu_);
@@ -334,7 +474,7 @@ Error InferenceServerHttpClient::Request(
     req << method << " /" << path << " HTTP/1.1\r\n"
         << "Host: " << host_ << ":" << port_ << "\r\n"
         << "Connection: keep-alive\r\n"
-        << "Content-Length: " << body.size() << "\r\n";
+        << "Content-Length: " << content_length << "\r\n";
     for (const auto& kv : extra_headers) {
       req << kv.first << ": " << kv.second << "\r\n";
     }
@@ -343,9 +483,7 @@ Error InferenceServerHttpClient::Request(
     if (verbose_) fprintf(stderr, "%s /%s\n", method.c_str(), path.c_str());
 
     Error err = conn_->WriteAll(header.data(), header.size());
-    if (err.IsOk() && !body.empty()) {
-      err = conn_->WriteAll(body.data(), body.size());
-    }
+    if (err.IsOk()) err = write_body();
     if (err.IsOk()) err = conn_->ReadResponse(response);
     if (err.IsOk()) {
       conn_->SetRecvTimeout(0);
@@ -361,6 +499,20 @@ Error InferenceServerHttpClient::Request(
     if (fresh || attempt == 1) return err;
   }
   return Error("unreachable");
+}
+
+Error InferenceServerHttpClient::Request(
+    const std::string& method, const std::string& path,
+    const std::vector<uint8_t>& body,
+    const std::map<std::string, std::string>& extra_headers,
+    HttpResponse* response, uint64_t timeout_us) {
+  return RequestImpl(
+      method, path, body.size(),
+      [&]() -> Error {
+        if (body.empty()) return Error::Success;
+        return conn_->WriteAll(body.data(), body.size());
+      },
+      extra_headers, response, timeout_us);
 }
 
 Error InferenceServerHttpClient::Get(const std::string& path,
@@ -584,10 +736,10 @@ static Error BytesToJsonData(const std::vector<uint8_t>& raw,
                              const std::string& datatype,
                              json::ValuePtr data);
 
-Error InferenceServerHttpClient::BuildInferRequest(
+Error InferenceServerHttpClient::BuildInferJson(
     const InferOptions& options, const std::vector<InferInput*>& inputs,
     const std::vector<const InferRequestedOutput*>& outputs,
-    std::vector<uint8_t>* body, size_t* json_size) {
+    std::string* json_header, std::vector<InferInput*>* binary_inputs) {
   auto root = json::Value::MakeObject();
   if (!options.request_id_.empty()) root->Set("id", options.request_id_);
 
@@ -612,7 +764,6 @@ Error InferenceServerHttpClient::BuildInferRequest(
   }
   if (!params->object().empty()) root->Set("parameters", params);
 
-  std::vector<const std::vector<uint8_t>*> blobs;
   auto inputs_json = json::Value::MakeArray();
   for (InferInput* input : inputs) {
     auto tensor = json::Value::MakeObject();
@@ -640,7 +791,7 @@ Error InferenceServerHttpClient::BuildInferRequest(
     } else {
       tparams->Set("binary_data_size",
                    static_cast<int64_t>(input->RawData().size()));
-      blobs.push_back(&input->RawData());
+      binary_inputs->push_back(input);
     }
     if (!tparams->object().empty()) tensor->Set("parameters", tparams);
     inputs_json->Append(tensor);
@@ -674,13 +825,77 @@ Error InferenceServerHttpClient::BuildInferRequest(
     root->Set("outputs", outputs_json);
   }
 
-  std::string header = root->Serialize();
-  *json_size = header.size();
-  body->assign(header.begin(), header.end());
-  for (const auto* blob : blobs) {
-    body->insert(body->end(), blob->begin(), blob->end());
+  *json_header = root->Serialize();
+  return Error::Success;
+}
+
+// Drains one input through its GetNext cursor into `sink` (16 MiB windows).
+static Error DrainInput(InferInput* input,
+                        const std::function<Error(const uint8_t*, size_t)>& sink) {
+  input->PrepareForRequest();
+  const uint8_t* buf = nullptr;
+  size_t nbytes = 0;
+  bool end = false;
+  while (!end) {
+    Error err = input->GetNext(&buf, &nbytes, &end);
+    if (!err.IsOk()) return err;
+    if (buf == nullptr) break;
+    err = sink(buf, nbytes);
+    if (!err.IsOk()) return err;
   }
   return Error::Success;
+}
+
+Error InferenceServerHttpClient::BuildInferRequest(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    std::vector<uint8_t>* body, size_t* json_size) {
+  // Monolithic-body variant used by AsyncInfer, where the request must
+  // outlive the caller's inputs; the sync path streams via GetNext instead.
+  std::string header;
+  std::vector<InferInput*> binary_inputs;
+  Error err = BuildInferJson(options, inputs, outputs, &header, &binary_inputs);
+  if (!err.IsOk()) return err;
+  *json_size = header.size();
+  body->assign(header.begin(), header.end());
+  for (InferInput* input : binary_inputs) {
+    err = DrainInput(input, [&](const uint8_t* buf, size_t nbytes) {
+      body->insert(body->end(), buf, buf + nbytes);
+      return Error::Success;
+    });
+    if (!err.IsOk()) return err;
+  }
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::RequestChunkedInfer(
+    const std::string& path, const std::string& json_header,
+    const std::vector<InferInput*>& binary_inputs,
+    const std::map<std::string, std::string>& extra_headers,
+    HttpResponse* response, uint64_t timeout_us) {
+  // Streaming upload: tensor bytes go to the socket straight from each
+  // input's buffer in GetNext windows (16 MiB), never assembled into one
+  // body (reference 16 MiB curl buffers, http_client.cc:2172-2175).
+  size_t content_length = json_header.size();
+  for (const InferInput* input : binary_inputs) {
+    content_length += input->RawData().size();
+  }
+  return RequestImpl(
+      "POST", path, content_length,
+      [&]() -> Error {
+        Error err = Error::Success;
+        if (!json_header.empty()) {
+          err = conn_->WriteAll(json_header.data(), json_header.size());
+        }
+        for (InferInput* input : binary_inputs) {
+          if (!err.IsOk()) break;
+          err = DrainInput(input, [&](const uint8_t* buf, size_t nbytes) {
+            return conn_->WriteAll(buf, nbytes);
+          });
+        }
+        return err;
+      },
+      extra_headers, response, timeout_us);
 }
 
 static size_t DtypeSize(const std::string& datatype) {
@@ -913,25 +1128,52 @@ Error InferenceServerHttpClient::ParseInferResponse(
 Error InferenceServerHttpClient::Infer(
     std::shared_ptr<InferResult>* result, const InferOptions& options,
     const std::vector<InferInput*>& inputs,
-    const std::vector<const InferRequestedOutput*>& outputs) {
+    const std::vector<const InferRequestedOutput*>& outputs,
+    CompressionType request_compression, CompressionType response_compression) {
   RequestTimers timers;
   timers.Capture(RequestTimers::Kind::REQUEST_START);
   timers.Capture(RequestTimers::Kind::SEND_START);
-  std::vector<uint8_t> body;
-  size_t json_size;
-  Error err = BuildInferRequest(options, inputs, outputs, &body, &json_size);
+  std::string json_header;
+  std::vector<InferInput*> binary_inputs;
+  Error err =
+      BuildInferJson(options, inputs, outputs, &json_header, &binary_inputs);
   if (!err.IsOk()) return err;
   timers.Capture(RequestTimers::Kind::SEND_END);
 
   std::map<std::string, std::string> headers = {
       {"Content-Type", "application/octet-stream"},
-      {"Inference-Header-Content-Length", std::to_string(json_size)},
+      {"Inference-Header-Content-Length", std::to_string(json_header.size())},
   };
+  if (response_compression != CompressionType::NONE) {
+    headers["Accept-Encoding"] = EncodingName(response_compression);
+  }
   HttpResponse response;
-  err = Request("POST", InferPath(options), body, headers, &response,
-                options.client_timeout_us_);
+  if (request_compression != CompressionType::NONE) {
+    // Compression requires the assembled body (reference compresses the
+    // whole request too, http_client.cc:2138-2151); the chunked path is for
+    // the uncompressed common case.
+    std::vector<uint8_t> body(json_header.begin(), json_header.end());
+    for (InferInput* input : binary_inputs) {
+      err = DrainInput(input, [&](const uint8_t* buf, size_t nbytes) {
+        body.insert(body.end(), buf, buf + nbytes);
+        return Error::Success;
+      });
+      if (!err.IsOk()) return err;
+    }
+    std::vector<uint8_t> compressed;
+    err = ZCompress(request_compression, body.data(), body.size(), &compressed);
+    if (!err.IsOk()) return err;
+    headers["Content-Encoding"] = EncodingName(request_compression);
+    err = Request("POST", InferPath(options), compressed, headers, &response,
+                  options.client_timeout_us_);
+  } else {
+    err = RequestChunkedInfer(InferPath(options), json_header, binary_inputs,
+                              headers, &response, options.client_timeout_us_);
+  }
   if (!err.IsOk()) return err;
   err = CheckStatus(response);
+  if (!err.IsOk()) return err;
+  err = ZDecompressResponse(&response);
   if (!err.IsOk()) return err;
 
   timers.Capture(RequestTimers::Kind::RECV_START);
@@ -949,14 +1191,24 @@ Error InferenceServerHttpClient::Infer(
 Error InferenceServerHttpClient::AsyncInfer(
     OnCompleteFn callback, const InferOptions& options,
     const std::vector<InferInput*>& inputs,
-    const std::vector<const InferRequestedOutput*>& outputs) {
+    const std::vector<const InferRequestedOutput*>& outputs,
+    CompressionType request_compression, CompressionType response_compression) {
   auto task = std::make_unique<AsyncTask>();
   task->callback = std::move(callback);
   task->path = InferPath(options);
   task->timeout_us = options.client_timeout_us_;
+  task->request_compression = request_compression;
+  task->response_compression = response_compression;
   Error err = BuildInferRequest(options, inputs, outputs, &task->body,
                                 &task->json_size);
   if (!err.IsOk()) return err;
+  if (request_compression != CompressionType::NONE) {
+    std::vector<uint8_t> compressed;
+    err = ZCompress(request_compression, task->body.data(), task->body.size(),
+                    &compressed);
+    if (!err.IsOk()) return err;
+    task->body.swap(compressed);
+  }
   {
     std::lock_guard<std::mutex> lk(queue_mu_);
     queue_.push_back(std::move(task));
@@ -979,12 +1231,19 @@ void InferenceServerHttpClient::AsyncWorker() {
         {"Content-Type", "application/octet-stream"},
         {"Inference-Header-Content-Length", std::to_string(task->json_size)},
     };
+    if (task->request_compression != CompressionType::NONE) {
+      headers["Content-Encoding"] = EncodingName(task->request_compression);
+    }
+    if (task->response_compression != CompressionType::NONE) {
+      headers["Accept-Encoding"] = EncodingName(task->response_compression);
+    }
     HttpResponse response;
     RequestTimers timers;
     timers.Capture(RequestTimers::Kind::REQUEST_START);
     Error err = Request("POST", task->path, task->body, headers, &response,
                         task->timeout_us);
     if (err.IsOk()) err = CheckStatus(response);
+    if (err.IsOk()) err = ZDecompressResponse(&response);
     std::shared_ptr<InferResult> result;
     if (err.IsOk()) {
       timers.Capture(RequestTimers::Kind::RECV_START);

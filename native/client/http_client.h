@@ -6,8 +6,11 @@
 // Infer + AsyncInfer, binary tensor framing with
 // Inference-Header-Content-Length — http_client.cc:2099-2235), built on a
 // persistent HTTP/1.1 connection with keep-alive and one retry on stale
-// sockets. No TLS in this build (the image lacks an SSL dev stack); the
-// API accepts http URLs only.
+// sockets. Request/response bodies compress with zlib (gzip/deflate,
+// reference http_client.cc:2138-2151). TLS is a build-time option
+// (-DTPU_CLIENT_ENABLE_TLS with an OpenSSL dev stack): HttpSslOptions is
+// always part of the API, but in a TLS-less build Create refuses https
+// with a clear error instead of silently downgrading.
 
 #pragma once
 
@@ -26,6 +29,24 @@ namespace tputriton {
 
 class HttpConnection;
 
+// TLS configuration (field parity with the reference's HttpSslOptions,
+// http_client.h:45-103). Honored only when the library is compiled with
+// TPU_CLIENT_ENABLE_TLS; otherwise any https use fails fast at Create.
+struct HttpSslOptions {
+  enum class CERTTYPE { CERT_PEM, CERT_DER };
+  enum class KEYTYPE { KEY_PEM, KEY_DER };
+  bool verify_peer = true;
+  bool verify_host = true;
+  std::string ca_info;
+  CERTTYPE cert_type = CERTTYPE::CERT_PEM;
+  std::string cert;
+  KEYTYPE key_type = KEYTYPE::KEY_PEM;
+  std::string key;
+};
+
+// Body compression algorithms (reference CompressionType, http_client.h:107).
+enum class CompressionType { NONE, DEFLATE, GZIP };
+
 struct HttpResponse {
   int status = 0;
   std::map<std::string, std::string> headers;  // lower-cased keys
@@ -36,9 +57,12 @@ class InferenceServerHttpClient {
  public:
   using OnCompleteFn = std::function<void(std::shared_ptr<InferResult>, Error)>;
 
-  // url: "host:port" (no scheme).
+  // url: "host:port" (no scheme), or "https://host:port" in TLS builds.
   static Error Create(std::unique_ptr<InferenceServerHttpClient>* client,
                       const std::string& url, bool verbose = false);
+  static Error Create(std::unique_ptr<InferenceServerHttpClient>* client,
+                      const std::string& url, const HttpSslOptions& ssl_options,
+                      bool verbose = false);
   ~InferenceServerHttpClient();
 
   Error IsServerLive(bool* live);
@@ -79,12 +103,16 @@ class InferenceServerHttpClient {
 
   Error Infer(std::shared_ptr<InferResult>* result, const InferOptions& options,
               const std::vector<InferInput*>& inputs,
-              const std::vector<const InferRequestedOutput*>& outputs = {});
+              const std::vector<const InferRequestedOutput*>& outputs = {},
+              CompressionType request_compression = CompressionType::NONE,
+              CompressionType response_compression = CompressionType::NONE);
 
   // Queued on a single worker thread (callback runs there).
   Error AsyncInfer(OnCompleteFn callback, const InferOptions& options,
                    const std::vector<InferInput*>& inputs,
-                   const std::vector<const InferRequestedOutput*>& outputs = {});
+                   const std::vector<const InferRequestedOutput*>& outputs = {},
+                   CompressionType request_compression = CompressionType::NONE,
+                   CompressionType response_compression = CompressionType::NONE);
 
   Error ClientInferStat(InferStat* stat) const;
 
@@ -96,12 +124,30 @@ class InferenceServerHttpClient {
  private:
   InferenceServerHttpClient(const std::string& url, bool verbose);
 
+  Error BuildInferJson(const InferOptions& options,
+                       const std::vector<InferInput*>& inputs,
+                       const std::vector<const InferRequestedOutput*>& outputs,
+                       std::string* json_header,
+                       std::vector<InferInput*>* binary_inputs);
   Error BuildInferRequest(const InferOptions& options,
                           const std::vector<InferInput*>& inputs,
                           const std::vector<const InferRequestedOutput*>& outputs,
                           std::vector<uint8_t>* body, size_t* json_size);
+  Error RequestChunkedInfer(
+      const std::string& path, const std::string& json_header,
+      const std::vector<InferInput*>& binary_inputs,
+      const std::map<std::string, std::string>& extra_headers,
+      HttpResponse* response, uint64_t timeout_us = 0);
   Error ParseInferResponse(const HttpResponse& response,
                            std::shared_ptr<InferResult>* result);
+  // Shared connect/send/retry state machine; `write_body` streams the body
+  // onto the (locked, connected) connection and must be re-invokable for the
+  // single stale-socket retry.
+  Error RequestImpl(const std::string& method, const std::string& path,
+                    size_t content_length,
+                    const std::function<Error()>& write_body,
+                    const std::map<std::string, std::string>& extra_headers,
+                    HttpResponse* response, uint64_t timeout_us);
   Error Request(const std::string& method, const std::string& path,
                 const std::vector<uint8_t>& body,
                 const std::map<std::string, std::string>& extra_headers,

@@ -110,6 +110,38 @@ class TestHTTPClient:
             )
         assert last.as_numpy("OUTPUT")[0, 0] == 6
 
+    def test_chunked_large_tensor_upload(self, client):
+        # A tensor spanning multiple 16 MiB upload windows must stream to the
+        # server intact (reference chunked-upload contract, common.h:340-353).
+        from tritonclient_tpu.http._utils import (
+            MAX_UPLOAD_CHUNK_BYTES,
+            _get_inference_request_chunks,
+        )
+
+        rows = 300_000  # 300000*16*4 B ≈ 18.3 MiB > one window
+        data = np.arange(rows * 16, dtype=np.int32).reshape(rows, 16)
+        inp = httpclient.InferInput("INPUT", [rows, 16], "INT32")
+        inp.set_data_from_numpy(data)
+
+        chunks, json_size, total = _get_inference_request_chunks(
+            inputs=[inp], request_id="", outputs=None, sequence_id=0,
+            sequence_start=False, sequence_end=False, priority=0, timeout=None,
+        )
+        assert json_size == len(chunks[0])
+        assert total == json_size + data.nbytes
+        binary = chunks[1:]
+        assert len(binary) == 2  # full window + remainder
+        assert len(binary[0]) == MAX_UPLOAD_CHUNK_BYTES
+        assert len(binary[1]) == data.nbytes - MAX_UPLOAD_CHUNK_BYTES
+
+        result = client.infer(
+            "slow_identity", [inp], parameters={"delay_ms": 0}
+        )
+        out = result.as_numpy("OUTPUT")
+        assert out.shape == (rows, 16)
+        np.testing.assert_array_equal(out[0], data[0])
+        np.testing.assert_array_equal(out[-1], data[-1])
+
     def test_generate_and_parse_body(self, client):
         body, json_size = httpclient.InferenceServerClient.generate_request_body(_inputs())
         assert json_size is not None and json_size < len(body)

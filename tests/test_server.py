@@ -309,3 +309,105 @@ class TestGRPCSurface:
         lreq.settings["log_verbose_level"].uint32_param = 2
         lresp = stub.LogSettings(lreq)
         assert lresp.settings["log_verbose_level"].uint32_param == 2
+
+
+class TestLoadWithFileOverride:
+    """Repository file-override semantics (ref cc_client_test.cc:1202-1350),
+    exercised over both protocols through the real client libraries."""
+
+    @pytest.fixture()
+    def grpc_client(self, server):
+        from tritonclient_tpu.grpc import InferenceServerClient
+
+        c = InferenceServerClient(server.grpc_address)
+        yield c
+        c.close()
+
+    @pytest.fixture()
+    def http_client(self, server):
+        from tritonclient_tpu.http import InferenceServerClient
+
+        c = InferenceServerClient(server.http_address)
+        yield c
+        c.close()
+
+    def _run_flow(self, client):
+        from tritonclient_tpu.utils import InferenceServerException
+
+        content = b"\x08\x01fake-model-binary" * 64
+        config = '{"backend": "onnxruntime"}'
+
+        # Baseline: repository `simple` is ready at its own version only.
+        assert client.is_model_ready("simple")
+
+        # File override without config must fail and leave the model as-is.
+        with pytest.raises(InferenceServerException, match="config"):
+            client.load_model("simple", files={"file:1/model.onnx": content})
+        assert client.is_model_ready("simple")
+
+        # Override under a NEW name: serves exactly version 1, and the
+        # original stays untouched.
+        client.load_model(
+            "override_model", config=config,
+            files={"file:1/model.onnx": content},
+        )
+        assert client.is_model_ready("override_model", "1")
+        assert not client.is_model_ready("override_model", "3")
+        assert client.is_model_ready("simple")
+
+        # Override under the ORIGINAL name: version readiness now follows
+        # the override directory, not the repository model.
+        client.load_model(
+            "simple", config=config, files={"file:1/model.onnx": content}
+        )
+        assert client.is_model_ready("simple", "1")
+        assert not client.is_model_ready("simple", "3")
+
+        # Inference against a file-override entry is a clear error (the JAX
+        # backend cannot execute foreign binaries).
+        import numpy as np
+
+        from tritonclient_tpu import grpc as grpcmod
+        from tritonclient_tpu import http as httpmod
+
+        mod = grpcmod if "grpc" in type(client).__module__ else httpmod
+        inp = mod.InferInput("INPUT0", [1, 16], "INT32")
+        inp.set_data_from_numpy(np.zeros((1, 16), np.int32))
+        with pytest.raises(InferenceServerException, match="file override"):
+            client.infer("simple", [inp])
+
+        # Multi-version override: every provided version is addressable for
+        # metadata/config, not just the latest (readiness and _get_model
+        # must agree on the version set).
+        client.load_model(
+            "multi_ver", config=config,
+            files={"file:1/model.onnx": content, "file:3/model.onnx": content},
+        )
+        assert client.is_model_ready("multi_ver", "1")
+        assert client.is_model_ready("multi_ver", "3")
+        assert not client.is_model_ready("multi_ver", "2")
+        client.get_model_metadata("multi_ver", "1")  # must not raise
+        client.get_model_metadata("multi_ver", "3")
+        client.unload_model("multi_ver")
+
+        # Plain load restores the repository model.
+        client.load_model("simple")
+        assert client.is_model_ready("simple")
+        i0 = mod.InferInput("INPUT0", [1, 16], "INT32")
+        i0.set_data_from_numpy(np.arange(16, dtype=np.int32).reshape(1, 16))
+        i1 = mod.InferInput("INPUT1", [1, 16], "INT32")
+        i1.set_data_from_numpy(np.ones((1, 16), np.int32))
+        result = client.infer("simple", [i0, i1])
+        assert result.as_numpy("OUTPUT0")[0, 1] == 2
+
+        # A pure-override name has no repository entry to revert to.
+        with pytest.raises(InferenceServerException, match="no such model"):
+            client.load_model("override_model")
+        client.unload_model("override_model")
+        assert not client.is_model_ready("override_model")
+
+    def test_grpc_file_override_flow(self, grpc_client):
+        self._run_flow(grpc_client)
+
+    def test_http_file_override_flow(self, http_client):
+        self._run_flow(http_client)

@@ -331,3 +331,92 @@ class TestTpuShm:
 
         with pytest.raises(InferenceServerException):
             client.register_tpu_shared_memory("bad", fake, 0, 64)
+
+
+# --------------------------------------------------------------------------- #
+# mesh-spanning (sharded) tpu shm — SURVEY §5.7/§5.8 sequence-length scaling #
+# --------------------------------------------------------------------------- #
+
+
+class TestShardedTpuShm:
+    @pytest.fixture()
+    def mesh(self):
+        import jax
+        from jax.sharding import Mesh
+
+        devices = np.array(jax.devices()[:8])
+        if devices.size < 8:
+            pytest.skip("needs the 8-virtual-device CPU mesh")
+        return Mesh(devices.reshape(8), ("sp",))
+
+    def test_sharded_roundtrip_and_layout(self, mesh):
+        region = tpushm.create_sharded_memory_region("sreg", 16 * 128 * 4, mesh)
+        try:
+            data = np.arange(16 * 128, dtype=np.int32).reshape(16, 128)
+            region.set_array(data)
+            arr = region.as_array("INT32", [16, 128])
+            # One shard per mesh device, sharded on dim 0.
+            assert len(arr.sharding.device_set) == 8
+            assert arr.sharding.shard_shape((16, 128)) == (2, 128)
+            np.testing.assert_array_equal(np.asarray(arr), data)
+            # Parked-array zero copy: same buffer back on exact match.
+            assert region.as_array("INT32", [16, 128]) is arr
+            # Raw-byte plane gathers through the host mirror.
+            raw = region.read_bytes(0, 16 * 128 * 4)
+            np.testing.assert_array_equal(
+                np.frombuffer(raw, np.int32).reshape(16, 128), data
+            )
+        finally:
+            tpushm.destroy_shared_memory_region(region)
+
+    def test_sharded_handle_token(self, mesh):
+        import base64, json as js
+
+        region = tpushm.create_sharded_memory_region("sreg2", 1024, mesh)
+        try:
+            token = js.loads(base64.b64decode(tpushm.get_raw_handle(region)))
+            assert token["device_ids"] == [d.id for d in mesh.devices.flatten()]
+        finally:
+            tpushm.destroy_shared_memory_region(region)
+
+    def test_sharded_region_serves_infer(self, mesh, server, client):
+        # Full lifecycle: register a mesh-spanning region, feed `simple`
+        # from it, and route outputs back into a second sharded region.
+        client.unregister_tpu_shared_memory()
+        x = np.arange(8 * 16, dtype=np.int32).reshape(8, 16)
+        y = np.ones((8, 16), np.int32)
+        in_region = tpushm.create_sharded_memory_region(
+            "sin", x.nbytes + y.nbytes, mesh
+        )
+        out_region = tpushm.create_sharded_memory_region(
+            "sout", 2 * x.nbytes, mesh
+        )
+        try:
+            tpushm.set_shared_memory_region(in_region, [x, y])
+            client.register_tpu_shared_memory(
+                "sin", tpushm.get_raw_handle(in_region), 0, x.nbytes + y.nbytes
+            )
+            client.register_tpu_shared_memory(
+                "sout", tpushm.get_raw_handle(out_region), 0, 2 * x.nbytes
+            )
+
+            i0 = InferInput("INPUT0", [8, 16], "INT32")
+            i0.set_shared_memory("sin", x.nbytes, 0)
+            i1 = InferInput("INPUT1", [8, 16], "INT32")
+            i1.set_shared_memory("sin", y.nbytes, x.nbytes)
+            o0 = InferRequestedOutput("OUTPUT0")
+            o0.set_shared_memory("sout", x.nbytes, 0)
+            o1 = InferRequestedOutput("OUTPUT1")
+            o1.set_shared_memory("sout", x.nbytes, x.nbytes)
+            client.infer("simple", [i0, i1], outputs=[o0, o1])
+
+            out0 = tpushm.get_contents_as_numpy(out_region, "INT32", [8, 16], 0)
+            out1 = tpushm.get_contents_as_numpy(
+                out_region, "INT32", [8, 16], x.nbytes
+            )
+            np.testing.assert_array_equal(out0, x + y)
+            np.testing.assert_array_equal(out1, x - y)
+        finally:
+            client.unregister_tpu_shared_memory()
+            tpushm.destroy_shared_memory_region(in_region)
+            tpushm.destroy_shared_memory_region(out_region)

@@ -26,6 +26,7 @@ from tritonclient_tpu._request import Request
 from tritonclient_tpu.http._infer_result import InferResult
 from tritonclient_tpu.http._utils import (
     _get_inference_request,
+    _get_inference_request_chunks,
     _get_query_string,
     _raise_if_error,
 )
@@ -217,6 +218,13 @@ class InferenceServerClient(InferenceServerClientBase):
         request_obj = Request(headers)
         self._call_plugin(request_obj)
         headers = request_obj.headers
+
+        if isinstance(body, list):
+            # Chunked upload: http.client iterates the list, so each tensor
+            # streams to the socket in its own (<= 16 MiB) write with no
+            # monolithic join. Content-Length must be explicit or
+            # http.client would fall back to Transfer-Encoding: chunked.
+            headers["Content-Length"] = str(sum(len(c) for c in body))
 
         uri = f"{self._base_path}/{path}{_get_query_string(query_params)}"
         if self._verbose:
@@ -535,7 +543,7 @@ class InferenceServerClient(InferenceServerClientBase):
         response_compression_algorithm,
         parameters,
     ):
-        request_body, json_size = _get_inference_request(
+        request_body, json_size, _total = _get_inference_request_chunks(
             inputs=inputs,
             request_id=request_id,
             outputs=outputs,
@@ -549,10 +557,10 @@ class InferenceServerClient(InferenceServerClientBase):
         headers = {}
         if request_compression_algorithm == "gzip":
             headers["Content-Encoding"] = "gzip"
-            request_body = gzip.compress(request_body)
+            request_body = gzip.compress(b"".join(request_body))
         elif request_compression_algorithm == "deflate":
             headers["Content-Encoding"] = "deflate"
-            request_body = zlib.compress(request_body)
+            request_body = zlib.compress(b"".join(request_body))
         if response_compression_algorithm == "gzip":
             headers["Accept-Encoding"] = "gzip"
         elif response_compression_algorithm == "deflate":

@@ -6,10 +6,14 @@ binary blobs; returns (body, json_size) where json_size None means pure JSON
 """
 
 import json
-from typing import Optional, Tuple
+from typing import List, Optional, Tuple
 from urllib.parse import quote_plus
 
 from tritonclient_tpu.utils import InferenceServerException, raise_error
+
+# Upload buffer granularity for chunked request bodies — reference parity
+# with the C++ client's 16 MiB curl buffers (http_client.cc:2172-2175).
+MAX_UPLOAD_CHUNK_BYTES = 16 * 1024 * 1024
 
 
 def _get_error(status: int, body: bytes) -> Optional[InferenceServerException]:
@@ -54,6 +58,40 @@ def _get_inference_request(
 ) -> Tuple[bytes, Optional[int]]:
     """Build the infer POST body; (body, json_size) with json_size=None when
     the body is pure JSON (no appended binary blobs)."""
+    chunks, json_size, _total = _get_inference_request_chunks(
+        inputs=inputs,
+        request_id=request_id,
+        outputs=outputs,
+        sequence_id=sequence_id,
+        sequence_start=sequence_start,
+        sequence_end=sequence_end,
+        priority=priority,
+        timeout=timeout,
+        custom_parameters=custom_parameters,
+    )
+    return b"".join(chunks), json_size
+
+
+def _get_inference_request_chunks(
+    inputs,
+    request_id,
+    outputs,
+    sequence_id,
+    sequence_start,
+    sequence_end,
+    priority,
+    timeout,
+    custom_parameters=None,
+) -> Tuple[List[bytes], Optional[int], int]:
+    """Chunked variant of _get_inference_request: no monolithic body copy.
+
+    Returns (chunks, json_size, total_bytes) where chunks is the JSON header
+    followed by each input's binary blob, each chunk no larger than
+    MAX_UPLOAD_CHUNK_BYTES — the GetNext/16 MiB upload pattern of the
+    reference's C++ client (common.h:340-353, http_client.cc:2172-2175)
+    applied to the Python path: large tensors stream to the socket in
+    bounded writes instead of being joined into one giant buffer.
+    """
     infer_request = {}
     parameters = {}
     if request_id:
@@ -71,7 +109,6 @@ def _get_inference_request(
     if outputs:
         infer_request["outputs"] = [o._get_tensor() for o in outputs]
     else:
-        # Default to binary data for all outputs when none are requested.
         parameters["binary_data_output"] = True
 
     for key, value in (custom_parameters or {}).items():
@@ -84,11 +121,18 @@ def _get_inference_request(
         infer_request["parameters"] = parameters
 
     request_json = json.dumps(infer_request).encode()
-    binary_blobs = []
+    chunks: List[bytes] = [request_json]
+    total = len(request_json)
+    has_binary = False
     for infer_input in inputs:
         raw = infer_input._get_binary_data()
-        if raw is not None:
-            binary_blobs.append(raw)
-    if not binary_blobs:
-        return request_json, None
-    return request_json + b"".join(binary_blobs), len(request_json)
+        if raw is None:
+            continue
+        has_binary = True
+        total += len(raw)
+        view = memoryview(raw)
+        for off in range(0, len(view), MAX_UPLOAD_CHUNK_BYTES):
+            chunks.append(view[off : off + MAX_UPLOAD_CHUNK_BYTES])
+    if not has_binary:
+        return chunks, None, total
+    return chunks, len(request_json), total

@@ -351,6 +351,72 @@ _DEFAULT_LOG_SETTINGS = {
 }
 
 
+class _FileOverrideModel:
+    """Repository entry created by ``load_model(files=...)``.
+
+    The JAX backend cannot execute foreign model binaries (the reference
+    test loads an ONNX blob, cc_client_test.cc:1202-1350); what the
+    file-override feature contractually provides is repository semantics:
+    the entry serves the version set named by the ``file:<version>/<path>``
+    keys, reports the override config, and shadows any same-named
+    repository model until a plain load restores it. Inference against it
+    is a clear 400.
+    """
+
+    def __init__(self, name: str, config_override: dict, files: Dict[str, object]):
+        import base64 as _b64
+
+        self.name = name
+        self.platform = config_override.get("backend", "")
+        self._config_override = dict(config_override)
+        self.files: Dict[str, bytes] = {}
+        for path, content in files.items():
+            if isinstance(content, str):
+                # HTTP carries file contents base64-encoded in JSON params.
+                try:
+                    content = _b64.b64decode(content)
+                except (ValueError, TypeError):
+                    raise CoreError(
+                        f"failed to load '{name}': invalid base64 file "
+                        f"content for '{path}'",
+                        400,
+                    )
+            self.files[path] = bytes(content)
+        versions = sorted({p.split("/", 1)[0] for p in self.files if "/" in p})
+        self.versions = versions or ["1"]
+        self.version = self.versions[-1]
+        self.inputs: List = []
+        self.outputs: List = []
+
+    def metadata(self) -> dict:
+        return {
+            "name": self.name,
+            "versions": self.versions,
+            "platform": self.platform,
+            "inputs": [],
+            "outputs": [],
+        }
+
+    def config(self) -> dict:
+        cfg = {
+            "name": self.name,
+            "platform": self.platform,
+            "backend": self.platform,
+            "max_batch_size": 0,
+            "input": [],
+            "output": [],
+        }
+        cfg.update(self._config_override)
+        return cfg
+
+    def infer(self, inputs, parameters=None):
+        raise CoreError(
+            f"model '{self.name}' was loaded with a file override; the JAX "
+            "backend cannot execute foreign model binaries",
+            400,
+        )
+
+
 # --------------------------------------------------------------------------- #
 # the core                                                                    #
 # --------------------------------------------------------------------------- #
@@ -366,6 +432,9 @@ class InferenceCore:
         self._repository: Dict[str, object] = {}
         self._loaded: Dict[str, bool] = {}
         self._stats: Dict[str, _ModelStats] = {}
+        # name -> the repository model shadowed by a file-override load
+        # (restored on the next plain/config-only load, Triton semantics).
+        self._overridden: Dict[str, object] = {}
         self._lock = threading.Lock()
         self.system_shm = SystemShmRegistry()
         self.tpu_shm = TpuShmRegistry()
@@ -389,7 +458,8 @@ class InferenceCore:
             raise CoreError(
                 f"Request for unknown model: '{name}' is not ready", 400
             )
-        if version not in ("", model.version):
+        versions = getattr(model, "versions", None) or [model.version]
+        if version and str(version) not in [str(v) for v in versions]:
             raise CoreError(
                 f"Request for unknown model version: '{name}' version {version}", 400
             )
@@ -405,7 +475,14 @@ class InferenceCore:
         model = self._repository.get(name)
         if model is None:
             raise CoreError(f"Request for unknown model: '{name}'", 400)
-        return bool(self._loaded.get(name, False))
+        if not self._loaded.get(name, False):
+            return False
+        if version:
+            # Per-version readiness: file-override models expose the version
+            # set their override directory provides (cc_client_test.cc:1202+).
+            versions = getattr(model, "versions", None) or [model.version]
+            return str(version) in [str(v) for v in versions]
+        return True
 
     def server_metadata(self) -> dict:
         return {
@@ -437,11 +514,51 @@ class InferenceCore:
         return out
 
     def load_model(self, name: str, parameters: Optional[dict] = None):
-        model = self._repository.get(name)
-        if model is None:
-            raise CoreError(f"failed to load '{name}', no such model", 400)
         parameters = parameters or {}
         config_override = parameters.get("config")
+        files = {
+            k[len("file:"):]: v
+            for k, v in parameters.items()
+            if k.startswith("file:")
+        }
+
+        if files:
+            # File-override load (reference semantics, cc_client_test.cc:
+            # 1202-1350): a config override is mandatory — the requirement
+            # is Triton's reminder that the existing model directory will
+            # not be used — and the loaded entry serves exactly the versions
+            # the override directory provides, shadowing any repository
+            # model of the same name until a plain load restores it.
+            if not config_override:
+                raise CoreError(
+                    f"failed to load '{name}', file override requires a "
+                    "config override parameter",
+                    400,
+                )
+            try:
+                override = json.loads(config_override)
+            except (TypeError, ValueError):
+                raise CoreError(
+                    f"failed to load '{name}': invalid config override", 400
+                )
+            original = self._repository.get(name)
+            if original is not None and name not in self._overridden:
+                if isinstance(original, _FileOverrideModel):
+                    pass  # re-override: nothing repository-owned to preserve
+                else:
+                    self._overridden[name] = original
+            self._repository[name] = _FileOverrideModel(name, override, files)
+            self._loaded[name] = True
+            self._stats.setdefault(name, _ModelStats())
+            return
+
+        # Plain / config-only load: revert any file override first (Triton
+        # polls the repository directory again on such loads).
+        if name in self._overridden:
+            self._repository[name] = self._overridden.pop(name)
+        model = self._repository.get(name)
+        if model is None or isinstance(model, _FileOverrideModel):
+            raise CoreError(f"failed to load '{name}', no such model", 400)
         if config_override:
             try:
                 override = json.loads(config_override)
@@ -452,8 +569,6 @@ class InferenceCore:
             # A plain reload reverts to the model's own config (Triton
             # semantics: no config parameter means repository config).
             model._config_override = {}
-        # File-override parameters ("file:<path>" keys) are accepted for API
-        # parity; the JAX backend has no on-disk model files to replace.
         self._loaded[name] = True
         if hasattr(model, "warmup"):
             model.warmup()

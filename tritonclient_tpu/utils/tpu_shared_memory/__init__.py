@@ -186,6 +186,89 @@ class TpuSharedMemoryRegion:
         )
 
 
+class TpuShardedMemoryRegion(TpuSharedMemoryRegion):
+    """A region spanning every device of a ``jax.sharding.Mesh``.
+
+    The §5.7/§5.8 sequence-length-scaling story (SURVEY.md): where the
+    single-device region parks one jax.Array per tensor, this region parks
+    *sharded* jax.Arrays laid out by a NamedSharding — one buffer shard per
+    mesh device, so a registered input/output region holds tensors whose
+    bytes never congregate on a single chip and sequence length scales
+    across the slice. The raw handle stays process-scoped; a co-located
+    server reads/writes the sharded arrays zero-copy through the same
+    registry calls as the single-device plane.
+
+    ``partition_spec`` defaults to sharding dimension 0 across all mesh
+    axes (the sequence/batch dimension); arrays parked via ``set_array``
+    must be divisible accordingly.
+    """
+
+    def __init__(self, triton_shm_name: str, byte_size: int, mesh,
+                 partition_spec=None):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        devices = list(mesh.devices.flatten())
+        if not devices:
+            raise TpuSharedMemoryException("mesh has no devices")
+        if partition_spec is None:
+            partition_spec = PartitionSpec(tuple(mesh.axis_names))
+        self.triton_shm_name = triton_shm_name
+        self.byte_size = int(byte_size)
+        self.mesh = mesh
+        self.sharding = NamedSharding(mesh, partition_spec)
+        self.devices = devices
+        self.device_ids = [d.id for d in devices]
+        # Single-device API compatibility: the region's nominal placement is
+        # the first mesh device (status reports, handle tokens).
+        self.device = devices[0]
+        self.device_id = int(self.device.id)
+        self.uuid = _uuid_mod.uuid4().hex
+        self._lock = threading.Lock()
+        self._parked: Dict[int, object] = {}
+        self._mirror = bytearray(self.byte_size)
+        self._destroyed = False
+
+    def set_array(self, array, offset: int = 0, block: bool = True):
+        """Park an array sharded over the mesh (host or device producer)."""
+        jax = _jax()
+        if isinstance(array, jax.Array) and array.sharding == self.sharding:
+            arr = array  # already laid out — parking is pure bookkeeping
+        else:
+            arr = jax.device_put(array, self.sharding)
+        if block:
+            jax.block_until_ready(arr)
+        self._check_range(offset, arr.nbytes)
+        with self._lock:
+            self._drop_overlapping(offset, arr.nbytes)
+            self._parked[offset] = arr
+
+    def as_array(self, datatype: str, shape: Sequence[int], offset: int = 0):
+        """A sharded jax.Array view of the region contents at ``offset``."""
+        jax = _jax()
+        shape = tuple(int(s) for s in shape)
+        np_dtype = _np_dtype_for(datatype)
+        nbytes = int(np.prod(shape)) * np_dtype.itemsize
+        self._check_range(offset, nbytes)
+        with self._lock:
+            parked = self._parked.get(offset)
+            if parked is not None and parked.nbytes == nbytes:
+                if parked.dtype == np_dtype and parked.shape == shape:
+                    return parked
+                # A dtype/shape reinterpretation cannot stay sharded in
+                # general; gather through the host mirror below instead.
+        host = np.frombuffer(
+            self.read_bytes(offset, nbytes), dtype=np_dtype
+        ).reshape(shape)
+        return jax.device_put(host, self.sharding)
+
+    def __repr__(self):
+        return (
+            f"TpuShardedMemoryRegion(name={self.triton_shm_name!r}, "
+            f"byte_size={self.byte_size}, devices={len(self.devices)}, "
+            f"sharding={self.sharding})"
+        )
+
+
 # --------------------------------------------------------------------------- #
 # module API (cuda_shared_memory parity)                                      #
 # --------------------------------------------------------------------------- #
@@ -195,6 +278,24 @@ def create_shared_memory_region(
     triton_shm_name: str, byte_size: int, device_id: int = 0
 ) -> TpuSharedMemoryRegion:
     region = TpuSharedMemoryRegion(triton_shm_name, byte_size, device_id)
+    with _registry_lock:
+        _registry[region.uuid] = region
+    return region
+
+
+def create_sharded_memory_region(
+    triton_shm_name: str, byte_size: int, mesh, partition_spec=None
+) -> TpuShardedMemoryRegion:
+    """A region whose parked tensors are sharded across all mesh devices.
+
+    The multi-device extension of create_shared_memory_region: registered
+    through the same register_tpu_shared_memory lifecycle, readable and
+    writable by a co-located server with per-device buffers (no single-chip
+    staging). See TpuShardedMemoryRegion.
+    """
+    region = TpuShardedMemoryRegion(
+        triton_shm_name, byte_size, mesh, partition_spec
+    )
     with _registry_lock:
         _registry[region.uuid] = region
     return region
@@ -212,6 +313,9 @@ def get_raw_handle(shm_handle: TpuSharedMemoryRegion) -> bytes:
         "byte_size": shm_handle.byte_size,
         "device_id": shm_handle.device_id,
     }
+    device_ids = getattr(shm_handle, "device_ids", None)
+    if device_ids is not None:
+        token["device_ids"] = device_ids  # mesh-spanning (sharded) region
     return base64.b64encode(json.dumps(token).encode())
 
 
