@@ -1,0 +1,117 @@
+#!/usr/bin/env python3
+"""Assemble the tritonclient_tpu wheel.
+
+Reference parity: src/python/library/build_wheel.py stages the package
+tree (embedding libcshm.so and optionally perf_analyzer binaries) and
+invokes bdist_wheel (:75-223). Here the native core is built with cmake,
+dropped into tritonclient_tpu/_lib, and the wheel is produced with the
+standard `build` frontend (perf_analyzer ships as console scripts declared
+in pyproject.toml, so no binary staging step is needed).
+
+Usage:
+    python build_wheel.py [--dest-dir dist] [--no-native] [--linux]
+"""
+
+import argparse
+import pathlib
+import shutil
+import subprocess
+import sys
+import sysconfig
+import zipfile
+
+REPO = pathlib.Path(__file__).resolve().parent
+
+
+def build_native(build_dir: pathlib.Path) -> None:
+    """cmake-build the native tree; libtpushm.so lands in _lib by cmake rule."""
+    gen = ["-G", "Ninja"] if shutil.which("ninja") else []
+    subprocess.run(
+        ["cmake", "-S", str(REPO / "native"), "-B", str(build_dir), *gen],
+        check=True,
+    )
+    subprocess.run(["cmake", "--build", str(build_dir)], check=True)
+    built = REPO / "tritonclient_tpu" / "_lib" / "libtpushm.so"
+    if not built.exists():
+        raise SystemExit(f"native build did not produce {built}")
+
+
+def build_wheel(dest_dir: pathlib.Path) -> pathlib.Path:
+    # --no-isolation: the build env (setuptools/wheel) is baked into the
+    # image; isolated builds would try to fetch them from the network.
+    subprocess.run(
+        [sys.executable, "-m", "build", "--wheel", "--no-isolation",
+         "--outdir", str(dest_dir), str(REPO)],
+        check=True,
+    )
+    wheels = sorted(dest_dir.glob("tritonclient_tpu-*.whl"))
+    if not wheels:
+        raise SystemExit("no wheel produced")
+    return wheels[-1]
+
+
+def retag_platform(wheel_path: pathlib.Path) -> pathlib.Path:
+    """Retag py3-none-any -> platform wheel when a native lib is embedded.
+
+    setuptools has no ext_modules here (the .so is package data), so the
+    default tag claims portability the embedded Linux .so does not have —
+    the reference passes --plat-name for the same reason (build_wheel.py
+    --linux flag).
+    """
+    plat = sysconfig.get_platform().replace("-", "_").replace(".", "_")
+    out = subprocess.run(
+        [sys.executable, "-m", "wheel", "tags", "--remove",
+         f"--platform-tag={plat}", str(wheel_path)],
+        check=True, capture_output=True, text=True,
+    ).stdout.strip().splitlines()
+    return wheel_path.parent / out[-1]
+
+
+def check_wheel(wheel_path: pathlib.Path, expect_native: bool) -> None:
+    with zipfile.ZipFile(wheel_path) as zf:
+        names = zf.namelist()
+    required = [
+        "tritonclient_tpu/__init__.py",
+        "tritonclient_tpu/grpc/_client.py",
+        "tritonclient_tpu/http/_client.py",
+        "tritonclient_tpu/utils/tpu_shared_memory/__init__.py",
+        "tritonclient_tpu/perf_analyzer/__main__.py",
+    ]
+    if expect_native:
+        required.append("tritonclient_tpu/_lib/libtpushm.so")
+    missing = [n for n in required if n not in names]
+    if missing:
+        raise SystemExit(f"wheel {wheel_path.name} is missing: {missing}")
+    if not any("entry_points.txt" in n for n in names):
+        raise SystemExit("wheel lacks entry_points.txt (perf_analyzer script)")
+    print(f"OK: {wheel_path.name} ({len(names)} files)")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--dest-dir", default="dist")
+    parser.add_argument(
+        "--no-native", action="store_true",
+        help="skip the cmake build (use the committed libtpushm.so)",
+    )
+    parser.add_argument(
+        "--linux", action="store_true",
+        help="accepted for reference CLI parity; wheels are platform-neutral "
+             "except for the embedded native lib",
+    )
+    args = parser.parse_args(argv)
+
+    dest = pathlib.Path(args.dest_dir)
+    dest.mkdir(parents=True, exist_ok=True)
+    if not args.no_native:
+        build_native(REPO / "build")
+    wheel_path = build_wheel(dest)
+    has_native = (REPO / "tritonclient_tpu" / "_lib" / "libtpushm.so").exists()
+    if has_native:
+        wheel_path = retag_platform(wheel_path)
+    check_wheel(wheel_path, expect_native=has_native)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

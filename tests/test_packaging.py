@@ -1,0 +1,111 @@
+"""Packaging hygiene: shared-lib symbol exports, cmake config package, wheel.
+
+The reference ships ldscript-versioned shared client libs + cmake config
+packages (library/CMakeLists.txt, libgrpcclient.ldscript:26-32) and a
+build_wheel.py that assembles a wheel embedding the native shm core
+(setup.py:38-40, build_wheel.py:75-223); these tests hold the repo to the
+same contract.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import zipfile
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUILD = os.path.join(REPO, "build")
+
+
+@pytest.fixture(scope="module")
+def native_build():
+    if shutil.which("cmake") is None or shutil.which("g++") is None:
+        pytest.skip("no native toolchain")
+    gen = ["-G", "Ninja"] if shutil.which("ninja") else []
+    subprocess.run(
+        ["cmake", "-S", os.path.join(REPO, "native"), "-B", BUILD, *gen],
+        check=True, capture_output=True,
+    )
+    subprocess.run(
+        ["cmake", "--build", BUILD], check=True, capture_output=True,
+        timeout=600,
+    )
+    return BUILD
+
+
+class TestSharedLibs:
+    @pytest.mark.parametrize("lib", ["libtpuhttpclient.so", "libtpugrpcclient.so"])
+    def test_shared_lib_built(self, native_build, lib):
+        assert os.path.exists(os.path.join(native_build, lib))
+
+    @pytest.mark.parametrize("lib", ["libtpuhttpclient.so", "libtpugrpcclient.so"])
+    def test_exports_restricted_to_client_namespace(self, native_build, lib):
+        """The ldscript must hide everything but tputriton::* (reference
+        libgrpcclient.ldscript contract)."""
+        if shutil.which("nm") is None:
+            pytest.skip("nm unavailable")
+        out = subprocess.run(
+            ["nm", "-DC", os.path.join(native_build, lib)],
+            check=True, capture_output=True, text=True,
+        ).stdout
+        exported = []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) >= 3 and parts[1] in ("T", "B", "D", "W", "V"):
+                exported.append(line)
+        leaked = [l for l in exported if "tputriton::" not in l]
+        assert not leaked, f"{lib} leaks symbols: {leaked[:5]}"
+        assert any("tputriton::" in l for l in exported), "no client symbols exported"
+
+
+class TestCMakeConfigPackage:
+    def test_install_produces_config_package(self, native_build, tmp_path):
+        destdir = tmp_path / "prefix"
+        subprocess.run(
+            ["cmake", "--install", native_build, "--prefix", "/usr"],
+            check=True, capture_output=True,
+            env={**os.environ, "DESTDIR": str(destdir)},
+        )
+        root = destdir / "usr"
+        config = root / "lib/cmake/TpuClient/TpuClientConfig.cmake"
+        assert config.exists()
+        # The Config must resolve imported-target deps before the targets
+        # file, or find_package(TpuClient) fails in consumer scope.
+        text = config.read_text()
+        assert "find_dependency(ZLIB)" in text
+        assert "find_dependency(Threads)" in text
+        assert (root / "lib/cmake/TpuClient/TpuClientTargets.cmake").exists()
+        assert (root / "include/tpuclient/http_client.h").exists()
+        assert (root / "include/tpuclient/grpc_client.h").exists()
+        assert (root / "include/tpuclient/kserve.pb.h").exists()
+        assert (root / "lib/libtpuhttpclient.so").exists()
+        assert (root / "lib/libtpuclient.a").exists()
+
+
+class TestWheel:
+    def test_build_wheel_embeds_native_lib_and_scripts(self, tmp_path):
+        pytest.importorskip("build")
+        if not os.path.exists(
+            os.path.join(REPO, "tritonclient_tpu", "_lib", "libtpushm.so")
+        ):
+            pytest.skip("native shm lib not built")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "build_wheel.py"),
+             "--no-native", "--dest-dir", str(tmp_path)],
+            capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        wheels = list(tmp_path.glob("tritonclient_tpu-*.whl"))
+        assert wheels
+        # A wheel embedding a native .so must carry a platform tag, never
+        # py3-none-any (reference --plat-name contract).
+        assert not wheels[0].name.endswith("-any.whl"), wheels[0].name
+        with zipfile.ZipFile(wheels[0]) as zf:
+            names = zf.namelist()
+            assert "tritonclient_tpu/_lib/libtpushm.so" in names
+            entry_points = next(n for n in names if n.endswith("entry_points.txt"))
+            eps = zf.read(entry_points).decode()
+        # Console-script parity with the reference wheel's bin/perf_analyzer.
+        assert "perf_analyzer" in eps and "perf_client" in eps
