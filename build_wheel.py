@@ -37,6 +37,10 @@ def build_native(build_dir: pathlib.Path) -> None:
 
 
 def build_wheel(dest_dir: pathlib.Path) -> pathlib.Path:
+    # Identify the artifact of THIS build by diffing the (accumulating)
+    # dest dir — a lexicographic glob could pick up a stale wheel from an
+    # earlier run.
+    before = set(dest_dir.glob("tritonclient_tpu-*.whl"))
     # --no-isolation: the build env (setuptools/wheel) is baked into the
     # image; isolated builds would try to fetch them from the network.
     subprocess.run(
@@ -44,10 +48,15 @@ def build_wheel(dest_dir: pathlib.Path) -> pathlib.Path:
          "--outdir", str(dest_dir), str(REPO)],
         check=True,
     )
-    wheels = sorted(dest_dir.glob("tritonclient_tpu-*.whl"))
-    if not wheels:
-        raise SystemExit("no wheel produced")
-    return wheels[-1]
+    new = set(dest_dir.glob("tritonclient_tpu-*.whl")) - before
+    if not new:
+        raise SystemExit(
+            "no new wheel produced (an identical wheel may already exist in "
+            f"{dest_dir}; remove it and rerun)"
+        )
+    if len(new) > 1:
+        raise SystemExit(f"ambiguous build output: {sorted(new)}")
+    return new.pop()
 
 
 def retag_platform(wheel_path: pathlib.Path) -> pathlib.Path:

@@ -1,0 +1,51 @@
+// Ensemble inference: raw image bytes in, classification out (reference:
+// src/c++/examples/ensemble_image_client.cc). The client sends the encoded
+// image as a BYTES element to preprocess_resnet50_ensemble and never sees
+// the intermediate preprocessed tensor; hermetic mode ships raw float32
+// pixel dumps (see ImagePreprocessModel).
+#include <iostream>
+#include <vector>
+
+#include "../grpc_client.h"
+#include "example_utils.h"
+
+using namespace tputriton;  // NOLINT
+
+int main(int argc, char** argv) {
+  std::string url = ParseUrl(argc, argv, "localhost:8001");
+  const std::string model_name = "preprocess_resnet50_ensemble";
+  const size_t classes = 2;
+  const int64_t height = 224, width = 224;
+
+  std::unique_ptr<InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(InferenceServerGrpcClient::Create(&client, url), "create");
+
+  // One raw float32 [H, W, 3] pixel dump as the single BYTES element.
+  std::vector<float> image(height * width * 3);
+  unsigned seed = 11;
+  for (auto& px : image) {
+    seed = seed * 1664525u + 1013904223u;
+    px = static_cast<float>(seed >> 8) / static_cast<float>(1u << 24);
+  }
+  std::string blob(reinterpret_cast<const char*>(image.data()),
+                   image.size() * sizeof(float));
+
+  InferInput input("INPUT", {1}, "BYTES");
+  input.AppendFromString({blob});
+  InferRequestedOutput output("OUTPUT", classes);
+
+  InferOptions options(model_name);
+  std::shared_ptr<InferResult> result;
+  FAIL_IF_ERR(client->Infer(&result, options, {&input}, {&output}),
+              "ensemble infer");
+
+  std::vector<std::string> rows;
+  FAIL_IF_ERR(result->StringData("OUTPUT", &rows), "classification rows");
+  FAIL_IF(rows.size() != classes, "wrong classification row count");
+  for (const auto& row : rows) {
+    FAIL_IF(row.find(':') == std::string::npos, "malformed row");
+    std::cout << "  " << row << "\n";
+  }
+  std::cout << "PASS: ensemble image classification\n";
+  return 0;
+}

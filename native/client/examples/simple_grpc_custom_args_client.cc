@@ -1,0 +1,44 @@
+// Custom transport arguments (reference: simple_grpc_custom_args_client.cc,
+// which passes raw grpc::ChannelArguments). This transport's tunable is the
+// channel-sharing knob TRITON_CLIENT_GRPC_CHANNEL_MAX_SHARE_COUNT (env, the
+// same name and default-6 contract as the reference, grpc_client.cc:92-96):
+// with the knob forced to 1, every client gets a private connection.
+#include <cstdlib>
+#include <iostream>
+
+#include "../grpc_client.h"
+#include "example_utils.h"
+
+using namespace tputriton;  // NOLINT
+
+int main(int argc, char** argv) {
+  std::string url = ParseUrl(argc, argv, "localhost:8001");
+  setenv("TRITON_CLIENT_GRPC_CHANNEL_MAX_SHARE_COUNT", "1", 1);
+
+  // Two clients, each on its own (unshared) connection.
+  std::unique_ptr<InferenceServerGrpcClient> client_a, client_b;
+  FAIL_IF_ERR(InferenceServerGrpcClient::Create(&client_a, url), "create a");
+  FAIL_IF_ERR(InferenceServerGrpcClient::Create(&client_b, url), "create b");
+
+  int32_t input0[16], input1[16];
+  for (int i = 0; i < 16; i++) {
+    input0[i] = i;
+    input1[i] = 3;
+  }
+  for (auto* client : {client_a.get(), client_b.get()}) {
+    InferInput in0("INPUT0", {1, 16}, "INT32");
+    InferInput in1("INPUT1", {1, 16}, "INT32");
+    in0.AppendRaw(reinterpret_cast<uint8_t*>(input0), sizeof(input0));
+    in1.AppendRaw(reinterpret_cast<uint8_t*>(input1), sizeof(input1));
+    InferOptions options("simple");
+    std::shared_ptr<InferResult> result;
+    FAIL_IF_ERR(client->Infer(&result, options, {&in0, &in1}), "infer");
+    const uint8_t* buf;
+    size_t nbytes;
+    FAIL_IF_ERR(result->RawData("OUTPUT0", &buf, &nbytes), "OUTPUT0");
+    FAIL_IF(reinterpret_cast<const int32_t*>(buf)[5] != input0[5] + input1[5],
+            "wrong sum");
+  }
+  std::cout << "PASS: custom transport args infer\n";
+  return 0;
+}

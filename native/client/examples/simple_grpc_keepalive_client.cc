@@ -1,0 +1,45 @@
+// Infer over a connection with explicit keepalive settings (reference:
+// src/c++/examples/simple_grpc_keepalive_client.cc).
+#include <iostream>
+
+#include "../grpc_client.h"
+#include "example_utils.h"
+
+using namespace tputriton;  // NOLINT
+
+int main(int argc, char** argv) {
+  std::string url = ParseUrl(argc, argv, "localhost:8001");
+  KeepAliveOptions keepalive;
+  keepalive.keepalive_time_ms = 10000;
+  keepalive.keepalive_timeout_ms = 5000;
+  keepalive.keepalive_permit_without_calls = false;
+  keepalive.http2_max_pings_without_data = 2;
+
+  std::unique_ptr<InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(InferenceServerGrpcClient::Create(&client, url, keepalive),
+              "create with keepalive");
+
+  int32_t input0[16], input1[16];
+  for (int i = 0; i < 16; i++) {
+    input0[i] = i;
+    input1[i] = 2;
+  }
+  InferInput in0("INPUT0", {1, 16}, "INT32");
+  InferInput in1("INPUT1", {1, 16}, "INT32");
+  in0.AppendRaw(reinterpret_cast<uint8_t*>(input0), sizeof(input0));
+  in1.AppendRaw(reinterpret_cast<uint8_t*>(input1), sizeof(input1));
+
+  InferOptions options("simple");
+  std::shared_ptr<InferResult> result;
+  FAIL_IF_ERR(client->Infer(&result, options, {&in0, &in1}), "infer");
+
+  const uint8_t* buf;
+  size_t nbytes;
+  FAIL_IF_ERR(result->RawData("OUTPUT0", &buf, &nbytes), "OUTPUT0");
+  const int32_t* sums = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; i++) {
+    FAIL_IF(sums[i] != input0[i] + input1[i], "wrong sum");
+  }
+  std::cout << "PASS: keepalive infer\n";
+  return 0;
+}

@@ -1,0 +1,48 @@
+// Stateful sequences with synchronous infer (reference:
+// simple_grpc_sequence_sync_infer_client.cc): two interleaved correlation
+// ids accumulate independently on the simple_sequence model.
+#include <iostream>
+
+#include "../grpc_client.h"
+#include "example_utils.h"
+
+using namespace tputriton;  // NOLINT
+
+static int SequenceStep(InferenceServerGrpcClient* client, uint64_t seq_id,
+                        int32_t value, bool start, bool end, int32_t* out) {
+  InferInput in("INPUT", {1, 1}, "INT32");
+  in.AppendRaw(reinterpret_cast<uint8_t*>(&value), sizeof(value));
+  InferOptions options("simple_sequence");
+  options.sequence_id_ = seq_id;
+  options.sequence_start_ = start;
+  options.sequence_end_ = end;
+  std::shared_ptr<InferResult> result;
+  FAIL_IF_ERR(client->Infer(&result, options, {&in}), "sequence infer");
+  const uint8_t* buf;
+  size_t nbytes;
+  FAIL_IF_ERR(result->RawData("OUTPUT", &buf, &nbytes), "OUTPUT");
+  FAIL_IF(nbytes != 4, "wrong OUTPUT size");
+  *out = *reinterpret_cast<const int32_t*>(buf);
+  return 0;
+}
+
+int main(int argc, char** argv) {
+  std::string url = ParseUrl(argc, argv, "localhost:8001");
+  std::unique_ptr<InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(InferenceServerGrpcClient::Create(&client, url), "create");
+
+  const int32_t values[] = {11, 7, 5};
+  int32_t acc_pos = 0, acc_neg = 0;
+  for (int i = 0; i < 3; i++) {
+    bool start = (i == 0), end = (i == 2);
+    if (SequenceStep(client.get(), 1007, values[i], start, end, &acc_pos)) {
+      return 1;
+    }
+    if (SequenceStep(client.get(), 1008, -values[i], start, end, &acc_neg)) {
+      return 1;
+    }
+  }
+  FAIL_IF(acc_pos != 23 || acc_neg != -23, "wrong accumulator values");
+  std::cout << "PASS: sequence sync infer (interleaved pair)\n";
+  return 0;
+}

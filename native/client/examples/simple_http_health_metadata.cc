@@ -1,0 +1,45 @@
+// Health, metadata, statistics, trace and log settings over HTTP/REST
+// (reference: simple_http_health_metadata.cc plus the trace/log paths).
+#include <iostream>
+
+#include "../http_client.h"
+#include "example_utils.h"
+
+using namespace tputriton;  // NOLINT
+
+int main(int argc, char** argv) {
+  std::string url = ParseUrl(argc, argv, "localhost:8000");
+  std::unique_ptr<InferenceServerHttpClient> client;
+  FAIL_IF_ERR(InferenceServerHttpClient::Create(&client, url), "create");
+
+  bool live = false, ready = false, model_ready = false;
+  FAIL_IF_ERR(client->IsServerLive(&live), "live");
+  FAIL_IF(!live, "server not live");
+  FAIL_IF_ERR(client->IsServerReady(&ready), "ready");
+  FAIL_IF(!ready, "server not ready");
+  FAIL_IF_ERR(client->IsModelReady("simple", &model_ready), "model ready");
+  FAIL_IF(!model_ready, "simple not ready");
+
+  json::ValuePtr meta;
+  FAIL_IF_ERR(client->ServerMetadata(&meta), "server metadata");
+  FAIL_IF(meta->Get("name") == nullptr, "metadata lacks name");
+  std::cout << "server: " << meta->Get("name")->AsString() << "\n";
+
+  FAIL_IF_ERR(client->ModelMetadata(&meta, "simple"), "model metadata");
+  FAIL_IF(meta->Get("inputs") == nullptr || meta->Get("inputs")->Size() != 2,
+          "simple should have 2 inputs");
+
+  json::ValuePtr stats;
+  FAIL_IF_ERR(client->ModelInferenceStatistics(&stats, "simple"), "stats");
+  FAIL_IF(stats->Get("model_stats") == nullptr, "stats lack model_stats");
+
+  json::ValuePtr settings;
+  FAIL_IF_ERR(client->UpdateTraceSettings(&settings, "",
+                                          "{\"trace_level\":[\"TIMESTAMPS\"]}"),
+              "update trace");
+  FAIL_IF(settings->Get("trace_level") == nullptr, "trace level missing");
+  FAIL_IF_ERR(client->GetLogSettings(&settings), "get log");
+
+  std::cout << "PASS: http health/metadata/statistics/trace/log\n";
+  return 0;
+}

@@ -1,0 +1,46 @@
+// Model repository control over HTTP/REST: index, unload, load with config
+// override, restore (reference: simple_http_model_control.cc).
+#include <iostream>
+
+#include "../http_client.h"
+#include "example_utils.h"
+
+using namespace tputriton;  // NOLINT
+
+int main(int argc, char** argv) {
+  std::string url = ParseUrl(argc, argv, "localhost:8000");
+  std::unique_ptr<InferenceServerHttpClient> client;
+  FAIL_IF_ERR(InferenceServerHttpClient::Create(&client, url), "create");
+
+  json::ValuePtr index;
+  FAIL_IF_ERR(client->ModelRepositoryIndex(&index), "repository index");
+  bool found = false;
+  for (size_t i = 0; i < index->Size(); i++) {
+    json::ValuePtr name = index->At(i)->Get("name");
+    if (name != nullptr && name->AsString() == "simple") found = true;
+  }
+  FAIL_IF(!found, "simple not in repository index");
+
+  FAIL_IF_ERR(client->UnloadModel("simple"), "unload");
+  bool ready = true;
+  FAIL_IF_ERR(client->IsModelReady("simple", &ready), "ready query");
+  FAIL_IF(ready, "simple still ready after unload");
+
+  FAIL_IF_ERR(client->LoadModel("simple", "{\"max_batch_size\": 8}"),
+              "load with override");
+  FAIL_IF_ERR(client->IsModelReady("simple", &ready), "ready query 2");
+  FAIL_IF(!ready, "simple not ready after load");
+  json::ValuePtr config;
+  FAIL_IF_ERR(client->ModelConfig(&config, "simple"), "config");
+  json::ValuePtr mbs = config->Get("max_batch_size");
+  FAIL_IF(mbs == nullptr || mbs->AsInt() != 8, "override not applied");
+
+  // Plain reload reverts to the repository config.
+  FAIL_IF_ERR(client->LoadModel("simple"), "plain reload");
+  FAIL_IF_ERR(client->ModelConfig(&config, "simple"), "config 2");
+  mbs = config->Get("max_batch_size");
+  FAIL_IF(mbs != nullptr && mbs->AsInt() == 8, "override survived plain load");
+
+  std::cout << "PASS: http model control (index/unload/load/override)\n";
+  return 0;
+}

@@ -1,0 +1,40 @@
+// TPU shared-memory contract over HTTP/REST (the cudashm example analog,
+// reference: src/c++/examples/simple_http_cudashm_client.cc).
+//
+// PjRt device buffers have no cross-process export, so tpu_shared_memory
+// handles are process-scoped (SURVEY.md §7 hard part 1): the zero-copy path
+// is exercised by co-located (same-process) clients, while a separate
+// process — this binary — must get a clean resolution error from the
+// v2/tpusharedmemory register path, never silent acceptance.
+#include <iostream>
+
+#include "../http_client.h"
+#include "example_utils.h"
+
+using namespace tputriton;  // NOLINT
+
+int main(int argc, char** argv) {
+  std::string url = ParseUrl(argc, argv, "localhost:8000");
+  std::unique_ptr<InferenceServerHttpClient> client;
+  FAIL_IF_ERR(InferenceServerHttpClient::Create(&client, url), "create");
+
+  // Status works from anywhere.
+  json::ValuePtr status;
+  FAIL_IF_ERR(client->TpuSharedMemoryStatus(&status), "tpu shm status");
+
+  // A handle minted by another process (fabricated here) must be rejected.
+  std::string bogus_handle =
+      "eyJ1dWlkIjogImRlYWRiZWVmIiwgInBpZCI6IDF9";  // {"uuid":...,"pid":1}
+  Error err =
+      client->RegisterTpuSharedMemory("cpp_http_tpu", bogus_handle, 0, 64);
+  FAIL_IF(err.IsOk(), "non-co-located register unexpectedly succeeded");
+  FAIL_IF(err.Message().find("resolve") == std::string::npos &&
+              err.Message().find("region") == std::string::npos,
+          "error does not explain handle resolution");
+
+  // Unregister-all is idempotent and safe.
+  FAIL_IF_ERR(client->UnregisterTpuSharedMemory(""), "unregister all");
+
+  std::cout << "PASS: http tpu shm co-location contract\n";
+  return 0;
+}

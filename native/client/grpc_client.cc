@@ -210,6 +210,20 @@ Error InferenceServerGrpcClient::Create(
 
 Error InferenceServerGrpcClient::Create(
     std::unique_ptr<InferenceServerGrpcClient>* client, const std::string& url,
+    const KeepAliveOptions& keepalive_options, bool verbose) {
+  Error err = Create(client, url, verbose);
+  if (!err.IsOk()) return err;
+  // Keepalive applies to the (possibly shared) connection — same scope as
+  // the reference, where shared channels share their channel args.
+  err = (*client)->conn_->SetTcpKeepAlive(
+      keepalive_options.keepalive_time_ms / 1000,
+      keepalive_options.keepalive_timeout_ms / 1000);
+  if (!err.IsOk()) client->reset();  // never hand back a half-configured client
+  return err;
+}
+
+Error InferenceServerGrpcClient::Create(
+    std::unique_ptr<InferenceServerGrpcClient>* client, const std::string& url,
     bool use_ssl, const SslOptions& ssl_options, bool verbose) {
   if (!use_ssl) return Create(client, url, verbose);
 #ifdef TPU_CLIENT_ENABLE_TLS

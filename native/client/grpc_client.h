@@ -39,6 +39,18 @@ struct SslOptions {
   std::string certificate_chain;
 };
 
+// Keepalive configuration (field parity with the reference's
+// KeepAliveOptions, grpc_client.h:62-77). This transport maps the gRPC
+// keepalive-ping contract onto TCP keepalive probes on the shared
+// connection (the h2 layer already ACKs peer HTTP/2 PINGs);
+// http2_max_pings_without_data is accepted for API parity.
+struct KeepAliveOptions {
+  int keepalive_time_ms = INT32_MAX;
+  int keepalive_timeout_ms = 20000;
+  bool keepalive_permit_without_calls = false;
+  int http2_max_pings_without_data = 2;
+};
+
 class InferenceServerGrpcClient {
  public:
   using OnCompleteFn = std::function<void(std::shared_ptr<InferResult>, Error)>;
@@ -50,6 +62,10 @@ class InferenceServerGrpcClient {
   static Error Create(std::unique_ptr<InferenceServerGrpcClient>* client,
                       const std::string& url, bool use_ssl,
                       const SslOptions& ssl_options, bool verbose = false);
+  static Error Create(std::unique_ptr<InferenceServerGrpcClient>* client,
+                      const std::string& url,
+                      const KeepAliveOptions& keepalive_options,
+                      bool verbose = false);
   ~InferenceServerGrpcClient();
 
   // -- health / metadata ----------------------------------------------------

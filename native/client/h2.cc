@@ -1,5 +1,7 @@
 #include "h2.h"
 
+#include <algorithm>
+
 #include <arpa/inet.h>
 #include <dlfcn.h>
 #include <fcntl.h>
@@ -179,6 +181,24 @@ bool DecodeIntAt(const std::string& b, size_t* pos, uint8_t prefix_bits,
 // ---------------------------------------------------------------------------
 
 Connection::~Connection() { Close(); }
+
+Error Connection::SetTcpKeepAlive(int idle_sec, int interval_sec) {
+  if (fd_ < 0) return Error("not connected");
+  // Linux bounds TCP_KEEPIDLE/TCP_KEEPINTVL to [1, 32767] seconds; gRPC's
+  // "effectively off" default (INT32_MAX ms) must clamp, not EINVAL.
+  idle_sec = std::max(1, std::min(idle_sec, 32767));
+  interval_sec = std::max(1, std::min(interval_sec, 32767));
+  int one = 1;
+  if (setsockopt(fd_, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one)) != 0 ||
+      setsockopt(fd_, IPPROTO_TCP, TCP_KEEPIDLE, &idle_sec,
+                 sizeof(idle_sec)) != 0 ||
+      setsockopt(fd_, IPPROTO_TCP, TCP_KEEPINTVL, &interval_sec,
+                 sizeof(interval_sec)) != 0) {
+    return Error(std::string("failed to arm TCP keepalive: ") +
+                 strerror(errno));
+  }
+  return Error::Success;
+}
 
 Error Connection::Connect(const std::string& host, int port) {
   Close();

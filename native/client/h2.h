@@ -52,6 +52,11 @@ class Connection {
   bool Connected();
   void Close();
 
+  // TCP-level keepalive probing on the underlying socket (the transport
+  // mapping of gRPC's keepalive pings; the h2 layer already ACKs peer
+  // HTTP/2 PINGs). idle/interval in seconds, clamped to >= 1.
+  Error SetTcpKeepAlive(int idle_sec, int interval_sec);
+
   // Open a gRPC request stream: writes HEADERS (no END_STREAM).
   Error OpenStream(const std::string& path, const Headers& extra_headers,
                    int32_t* stream_id);

@@ -16,18 +16,27 @@ GRPC_EXAMPLES = [
     "simple_grpc_infer_client",
     "simple_grpc_async_infer_client",
     "simple_grpc_string_infer_client",
+    "simple_grpc_sequence_sync_infer_client",
     "simple_grpc_sequence_stream_infer_client",
     "simple_grpc_custom_repeat",
     "simple_grpc_shm_client",
     "simple_grpc_tpushm_client",
     "simple_grpc_health_metadata",
     "simple_grpc_model_control",
+    "simple_grpc_keepalive_client",
+    "simple_grpc_custom_args_client",
+    "image_client",
+    "ensemble_image_client",
 ]
 HTTP_EXAMPLES = [
     "simple_http_infer_client",
     "simple_http_async_infer_client",
     "simple_http_string_infer_client",
     "simple_http_shm_client",
+    "simple_http_tpushm_client",
+    "simple_http_sequence_sync_infer_client",
+    "simple_http_health_metadata",
+    "simple_http_model_control",
 ]
 
 
@@ -49,7 +58,12 @@ def cpp_binaries():
 
 @pytest.fixture(scope="module")
 def server():
-    with InferenceServer() as s:
+    from tritonclient_tpu.models.ensemble import make_image_ensemble
+    from tritonclient_tpu.server import default_models
+
+    # image_client / ensemble_image_client need the classification models.
+    ensemble, members = make_image_ensemble(num_classes=10)
+    with InferenceServer(models=default_models() + members + [ensemble]) as s:
         yield s
 
 

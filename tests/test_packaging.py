@@ -24,8 +24,11 @@ def native_build():
     if shutil.which("cmake") is None or shutil.which("g++") is None:
         pytest.skip("no native toolchain")
     gen = ["-G", "Ninja"] if shutil.which("ninja") else []
+    # Pin the libdir: GNUInstallDirs picks lib64 on RHEL-family hosts, which
+    # would move the config package out from under the assertions below.
     subprocess.run(
-        ["cmake", "-S", os.path.join(REPO, "native"), "-B", BUILD, *gen],
+        ["cmake", "-S", os.path.join(REPO, "native"), "-B", BUILD,
+         "-DCMAKE_INSTALL_LIBDIR=lib", *gen],
         check=True, capture_output=True,
     )
     subprocess.run(
@@ -42,8 +45,9 @@ class TestSharedLibs:
 
     @pytest.mark.parametrize("lib", ["libtpuhttpclient.so", "libtpugrpcclient.so"])
     def test_exports_restricted_to_client_namespace(self, native_build, lib):
-        """The ldscript must hide everything but tputriton::* (reference
-        libgrpcclient.ldscript contract)."""
+        """The ldscript must hide everything but the public-header
+        namespaces — tputriton::* and the generated inference::* messages
+        (reference libgrpcclient.ldscript contract)."""
         if shutil.which("nm") is None:
             pytest.skip("nm unavailable")
         out = subprocess.run(
@@ -55,9 +59,13 @@ class TestSharedLibs:
             parts = line.split()
             if len(parts) >= 3 and parts[1] in ("T", "B", "D", "W", "V"):
                 exported.append(line)
-        leaked = [l for l in exported if "tputriton::" not in l]
+        leaked = [
+            l for l in exported
+            if "tputriton::" not in l and "inference::" not in l
+        ]
         assert not leaked, f"{lib} leaks symbols: {leaked[:5]}"
         assert any("tputriton::" in l for l in exported), "no client symbols exported"
+        assert any("inference::" in l for l in exported), "proto symbols hidden"
 
 
 class TestCMakeConfigPackage:
