@@ -24,7 +24,8 @@ parks the un-materialized result in the output region) -> region readback
 
 Environment knobs: BENCH_MODEL (bert_base|simple), BENCH_BATCH, BENCH_SEQ,
 BENCH_SECONDS (time budget per timed section), BENCH_CONCURRENCY,
-BENCH_SHM (tpu|system|none), BENCH_STREAMING (1|0).
+BENCH_SHM (tpu|system|none), BENCH_STREAMING (1|0), BENCH_ASYNC_WINDOW
+(1|0 — sliding-window single-client mode instead of N closed-loop workers).
 """
 
 import json
@@ -78,6 +79,7 @@ def main():
     concurrency = int(os.environ.get("BENCH_CONCURRENCY", "8"))
     n_windows = int(os.environ.get("BENCH_WINDOWS", "4"))
     shm_mode = os.environ.get("BENCH_SHM", "tpu")
+    async_window = os.environ.get("BENCH_ASYNC_WINDOW", "0") == "1"
     streaming = os.environ.get("BENCH_STREAMING", "1") == "1"
 
     import jax
@@ -117,6 +119,7 @@ def main():
             batch_size=batch,
             shared_memory=shm_mode,
             streaming=streaming,
+            async_window=async_window,
             read_outputs=True,
             measurement_interval_s=seconds / n_windows,
             warmup_s=1.0,

@@ -88,3 +88,73 @@ def test_sharded_train_step_runs_and_decreases_loss():
         losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]  # same batch -> loss must drop
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_reference(causal):
+    from tritonclient_tpu.parallel import ulysses_attention
+
+    mesh = build_mesh({"dp": 2, "sp": 4})
+    b, l, h, d = 2, 32, 4, 8  # h == sp size: one head per device in compute
+    key = jax.random.PRNGKey(3)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, l, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, l, h, d), jnp.float32)
+    v = jax.random.normal(kv, (b, l, h, d), jnp.float32)
+
+    expected = dot_product_attention(q, k, v, causal=causal)
+
+    spec = jax.sharding.NamedSharding(mesh, P("dp", "sp", None, None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    got = jax.jit(
+        lambda a, b_, c: ulysses_attention(a, b_, c, mesh=mesh, causal=causal)
+    )(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_and_ring_agree():
+    from tritonclient_tpu.parallel import ulysses_attention
+
+    mesh = build_mesh({"sp": 8})
+    q = jax.random.normal(jax.random.PRNGKey(5), (1, 64, 8, 4), jnp.float32)
+    spec = jax.sharding.NamedSharding(mesh, P(None, "sp", None, None))
+    qs = jax.device_put(q, spec)
+    ring = ring_attention(qs, qs, qs, mesh=mesh, causal=True)
+    uly = ulysses_attention(qs, qs, qs, mesh=mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(uly),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_sp1_degrades_and_head_divisibility_enforced():
+    from tritonclient_tpu.parallel import ulysses_attention
+
+    mesh = build_mesh({"dp": 8, "sp": 1})
+    q = jax.random.normal(jax.random.PRNGKey(6), (1, 8, 2, 4))
+    out = ulysses_attention(q, q, q, mesh=mesh)
+    expected = dot_product_attention(q, q, q)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=1e-6)
+
+    mesh8 = build_mesh({"sp": 8})
+    q3 = jax.random.normal(jax.random.PRNGKey(7), (1, 16, 3, 4))
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention(q3, q3, q3, mesh=mesh8)
+
+
+def test_sharded_train_step_with_ulysses():
+    from tritonclient_tpu.models import bert
+    from tritonclient_tpu.parallel.train import make_mlm_train_step
+
+    mesh = build_mesh({"dp": 2, "sp": 2, "tp": 2})
+    cfg = bert.bert_tiny(seq_len=32)
+    init_state, train_step, make_batch = make_mlm_train_step(
+        cfg, mesh, learning_rate=1e-2, sequence_parallel_impl="ulysses"
+    )
+    params, opt_state = init_state(jax.random.PRNGKey(0))
+    batch = make_batch(jax.random.PRNGKey(1), batch=4, seq=32)
+    losses = []
+    for _ in range(3):
+        params, opt_state, loss = train_step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]

@@ -23,21 +23,32 @@ from tritonclient_tpu.parallel.sharding import (
 )
 
 
-def make_mlm_train_step(cfg: bert.BertConfig, mesh, learning_rate: float = 1e-4):
+def make_mlm_train_step(cfg: bert.BertConfig, mesh, learning_rate: float = 1e-4,
+                        sequence_parallel_impl: str = "ring"):
     """Returns (init_state, train_step).
 
     init_state(key) -> (params, opt_state), sharded over ``mesh``.
     train_step(params, opt_state, batch) -> (params, opt_state, loss); batch
     is {'tokens': [B, L] i32, 'labels': [B, L] i32} with B divisible by dp
-    and L by sp.
+    and L by sp. ``sequence_parallel_impl`` picks the sp-axis attention:
+    'ring' (ppermute pipeline, any head count) or 'ulysses' (two
+    all-to-alls, heads divisible by sp — see parallel/ulysses.py for the
+    trade-off).
     """
+    if sequence_parallel_impl not in ("ring", "ulysses"):
+        raise ValueError("sequence_parallel_impl must be 'ring' or 'ulysses'")
     optimizer = optax.adamw(learning_rate)
     rules = bert.PARTITION_RULES
     act_sharding = named_sharding(mesh, ("dp", "fsdp"), "sp", None)
 
     attention_fn = None
     if mesh.shape.get("sp", 1) > 1:
-        attention_fn = functools.partial(ring_attention, mesh=mesh)
+        if sequence_parallel_impl == "ring":
+            attention_fn = functools.partial(ring_attention, mesh=mesh)
+        else:
+            from tritonclient_tpu.parallel.ulysses import ulysses_attention
+
+            attention_fn = functools.partial(ulysses_attention, mesh=mesh)
 
     def loss_fn(params, batch):
         return bert.mlm_loss(

@@ -1,0 +1,77 @@
+"""Ulysses (all-to-all) sequence parallelism: the ring-attention alternative.
+
+Where ring attention rotates K/V chunks around the sp axis (sp_size
+ppermute hops, each overlappable with compute), Ulysses re-lays the
+problem out with two all-to-alls: heads scatter across the sp axis while
+the sequence gathers, every device runs *full-sequence* attention over its
+head subset, and the inverse all-to-all restores sequence sharding. Two
+collectives total, both riding ICI, independent of sequence length — the
+better trade when num_heads >= sp_size and the sequence fits one chip's
+HBM after the head split; ring attention wins when it does not.
+
+The reference has no analog (client SDK, SURVEY.md §2.5); this is the
+second leg of the long-context plane, with the same signature and
+sharding contract as ring_attention so callers can switch per workload.
+"""
+
+import math
+from typing import Optional
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tritonclient_tpu.ops.attention import dot_product_attention
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh,
+    sp_axis: str = "sp",
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Attention over [B, L, H, D] tensors whose L dim is sharded on sp_axis.
+
+    Requires H divisible by the sp axis size (each device owns H/sp heads
+    during the compute phase). Other mesh axes (dp on B) stay automatic
+    under GSPMD. With sp size 1 this degrades to plain attention.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    sp_size = mesh.shape.get(sp_axis, 1)
+    if sp_size == 1:
+        return dot_product_attention(q, k, v, causal=causal, scale=scale)
+    num_heads = q.shape[2]
+    if num_heads % sp_size != 0:
+        raise ValueError(
+            f"ulysses_attention needs heads ({num_heads}) divisible by the "
+            f"'{sp_axis}' axis size ({sp_size}); use ring_attention otherwise"
+        )
+
+    def body(q_loc, k_loc, v_loc):
+        # [B, L/sp, H, D] -> [B, L, H/sp, D]: scatter heads, gather sequence.
+        def to_heads(x):
+            return lax.all_to_all(
+                x, sp_axis, split_axis=2, concat_axis=1, tiled=True
+            )
+
+        qh, kh, vh = to_heads(q_loc), to_heads(k_loc), to_heads(v_loc)
+        out = dot_product_attention(qh, kh, vh, causal=causal, scale=scale)
+        # [B, L, H/sp, D] -> [B, L/sp, H, D]: gather heads, scatter sequence.
+        return lax.all_to_all(
+            out, sp_axis, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    spec = P(None, sp_axis, None, None)
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={sp_axis},
+        check_vma=False,
+    )(q, k, v)
