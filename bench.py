@@ -80,6 +80,11 @@ def main():
     n_windows = int(os.environ.get("BENCH_WINDOWS", "4"))
     shm_mode = os.environ.get("BENCH_SHM", "tpu")
     async_window = os.environ.get("BENCH_ASYNC_WINDOW", "0") == "1"
+    if async_window and shm_mode != "tpu":
+        # Fail before minutes of model build/warmup; the window runner only
+        # supports the zero-copy plane.
+        print("BENCH_ASYNC_WINDOW=1 requires BENCH_SHM=tpu", file=sys.stderr)
+        sys.exit(2)
     streaming = os.environ.get("BENCH_STREAMING", "1") == "1"
 
     import jax

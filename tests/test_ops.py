@@ -1,0 +1,91 @@
+"""Compute-op tests: the Pallas flash attention kernel vs the reference.
+
+Runs in Pallas interpreter mode on CPU (the kernel auto-selects interpret
+off-TPU); the same kernel compiles for real TPU (validated in CI bench
+sessions).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tritonclient_tpu.ops import dot_product_attention, flash_attention
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("shape", [(2, 256, 4, 64), (1, 128, 2, 32)])
+def test_flash_matches_reference(causal, shape):
+    b, l, h, d = shape
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, shape, jnp.float32)
+    k = jax.random.normal(kk, shape, jnp.float32)
+    v = jax.random.normal(kv, shape, jnp.float32)
+    got = flash_attention(q, k, v, causal=causal)
+    ref = dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_multi_tile_accumulation():
+    # More K tiles than Q tiles: the online-softmax carry across the
+    # innermost grid dimension is what this exercises.
+    q = jax.random.normal(jax.random.PRNGKey(1), (1, 128, 2, 32), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(2), (1, 512, 2, 32), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(3), (1, 512, 2, 32), jnp.float32)
+    got = flash_attention(q, k, v, block_q=64, block_k=128)
+    ref = dot_product_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_bf16_dtype_preserved():
+    q = jax.random.normal(jax.random.PRNGKey(4), (1, 256, 2, 64), jnp.bfloat16)
+    got = flash_attention(q, q, q, causal=True)
+    assert got.dtype == jnp.bfloat16
+    ref = dot_product_attention(q, q, q, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_flash_untileable_shapes_fall_back(monkeypatch):
+    # Odd lengths cannot tile onto TPU-aligned blocks; the wrapper must take
+    # the reference path (asserted, not assumed) and still be correct.
+    import importlib
+
+    # The function re-exported from ops/__init__ shadows the submodule
+    # attribute; importlib resolves the real module.
+    fa_mod = importlib.import_module("tritonclient_tpu.ops.flash_attention")
+
+    def boom(*args, **kwargs):
+        raise AssertionError("kernel path taken for untileable shape")
+
+    monkeypatch.setattr(fa_mod, "_flash", boom)
+    q = jax.random.normal(jax.random.PRNGKey(5), (1, 100, 2, 16), jnp.float32)
+    got = fa_mod.flash_attention(q, q, q, causal=True)
+    ref = dot_product_attention(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_under_jit_and_grad():
+    q = jax.random.normal(jax.random.PRNGKey(6), (1, 128, 2, 32), jnp.float32)
+
+    @jax.jit
+    def f(x):
+        return flash_attention(x, x, x, causal=True).sum()
+
+    assert np.isfinite(float(f(q)))
+
+    # The custom VJP must match the reference gradient exactly (the
+    # backward recomputes through dot_product_attention).
+    grad_flash = jax.grad(
+        lambda x: flash_attention(x, x, x, causal=True).sum()
+    )(q)
+    grad_ref = jax.grad(
+        lambda x: dot_product_attention(x, x, x, causal=True).sum()
+    )(q)
+    np.testing.assert_allclose(np.asarray(grad_flash), np.asarray(grad_ref),
+                               rtol=2e-5, atol=2e-5)

@@ -1,9 +1,12 @@
 """Compute ops: attention and friends, written MXU-first.
 
-Plain jnp implementations here; the ring (sequence-parallel) variant lives
-in tritonclient_tpu.parallel.ring_attention.
+`dot_product_attention` is the plain jnp implementation;
+`flash_attention` is the Pallas-fused TPU kernel (tile-streamed online
+softmax, interpreter-backed off-TPU). The sequence-parallel variants live
+in tritonclient_tpu.parallel (ring_attention, ulysses_attention).
 """
 
 from tritonclient_tpu.ops.attention import dot_product_attention
+from tritonclient_tpu.ops.flash_attention import flash_attention
 
-__all__ = ["dot_product_attention"]
+__all__ = ["dot_product_attention", "flash_attention"]

@@ -23,6 +23,23 @@ from jax.sharding import Mesh, PartitionSpec as P
 _NEG_BIG = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
+def sequence_shard_map(body, mesh: Mesh, sp_axis: str):
+    """Partial-manual shard_map over the sp axis for [B, L, H, D] q/k/v.
+
+    Shared scaffolding of the sequence-parallel attention variants: only
+    the sp axis is manual; dp/tp stay under GSPMD.
+    """
+    spec = P(None, sp_axis, None, None)
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={sp_axis},
+        check_vma=False,
+    )
+
+
 def _ring_body(q, k, v, *, axis_name: str, axis_size: int, causal: bool,
                scale: float):
     """Manual-mode body: q/k/v are the local [B, Lc, H, D] chunks."""
@@ -96,12 +113,4 @@ def ring_attention(
         causal=causal,
         scale=scale,
     )
-    spec = P(None, sp_axis, None, None)
-    return jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        axis_names={sp_axis},
-        check_vma=False,
-    )(q, k, v)
+    return sequence_shard_map(body, mesh, sp_axis)(q, k, v)

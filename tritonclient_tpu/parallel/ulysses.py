@@ -19,9 +19,10 @@ from typing import Optional
 
 import jax
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from tritonclient_tpu.ops.attention import dot_product_attention
+from tritonclient_tpu.parallel.ring_attention import sequence_shard_map
 
 
 def ulysses_attention(
@@ -66,12 +67,4 @@ def ulysses_attention(
             out, sp_axis, split_axis=1, concat_axis=2, tiled=True
         )
 
-    spec = P(None, sp_axis, None, None)
-    return jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        axis_names={sp_axis},
-        check_vma=False,
-    )(q, k, v)
+    return sequence_shard_map(body, mesh, sp_axis)(q, k, v)
