@@ -158,3 +158,68 @@ def test_sharded_train_step_with_ulysses():
         losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+class TestMultihost:
+    def test_hybrid_mesh_layout(self):
+        from tritonclient_tpu.parallel.multihost import hybrid_mesh
+
+        mesh = hybrid_mesh(dcn={"dp": 2}, ici={"sp": 2, "tp": 2})
+        assert mesh.axis_names == ("dp", "sp", "tp")
+        assert dict(mesh.shape) == {"dp": 2, "sp": 2, "tp": 2}
+        # dcn axis outermost: the 4 devices of one dp group are contiguous
+        # (same host/slice), i.e. the fast-varying axes are ici.
+        grid = mesh.devices
+        first_group = {d.id for d in grid[0].flatten()}
+        assert first_group == {0, 1, 2, 3}
+
+    def test_hybrid_mesh_rejects_latency_sensitive_dcn_axes(self):
+        from tritonclient_tpu.parallel.multihost import hybrid_mesh
+
+        with pytest.raises(ValueError, match="must not cross DCN"):
+            hybrid_mesh(dcn={"tp": 2}, ici={"dp": 4})
+        with pytest.raises(ValueError, match="both dcn and ici"):
+            hybrid_mesh(dcn={"dp": 2}, ici={"dp": 4})
+        with pytest.raises(ValueError, match="devices"):
+            hybrid_mesh(dcn={"dp": 4}, ici={"tp": 4})
+
+    def test_initialize_is_noop_without_coordinator(self, monkeypatch):
+        from tritonclient_tpu.parallel.multihost import initialize
+
+        monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+        assert initialize() is False
+
+    def test_process_local_batch_single_process(self):
+        from tritonclient_tpu.parallel.multihost import (
+            hybrid_mesh,
+            process_local_batch,
+        )
+
+        mesh = hybrid_mesh(dcn={"dp": 2}, ici={"sp": 4})
+        data = np.arange(8 * 16, dtype=np.int32).reshape(8, 16)
+        arr = process_local_batch(mesh, (8, 16), data, P("dp", None))
+        assert len(arr.sharding.device_set) == 8
+        np.testing.assert_array_equal(np.asarray(arr), data)
+        # A list of per-device shards concatenates on the leading axis.
+        arr2 = process_local_batch(
+            mesh, (8, 16), [data[:4], data[4:]], P("dp", None)
+        )
+        np.testing.assert_array_equal(np.asarray(arr2), data)
+        # Shape mismatch must be loud.
+        with pytest.raises(ValueError, match="global"):
+            process_local_batch(mesh, (4, 16), data, P("dp", None))
+
+    def test_hybrid_mesh_drives_train_step(self):
+        from tritonclient_tpu.models import bert
+        from tritonclient_tpu.parallel.multihost import hybrid_mesh
+        from tritonclient_tpu.parallel.train import make_mlm_train_step
+
+        mesh = hybrid_mesh(dcn={"dp": 2}, ici={"sp": 2, "tp": 2})
+        cfg = bert.bert_tiny(seq_len=32)
+        init_state, train_step, make_batch = make_mlm_train_step(
+            cfg, mesh, learning_rate=1e-2
+        )
+        params, opt = init_state(jax.random.PRNGKey(0))
+        batch = make_batch(jax.random.PRNGKey(1), batch=4, seq=32)
+        _, _, loss = train_step(params, opt, batch)
+        assert np.isfinite(float(loss))

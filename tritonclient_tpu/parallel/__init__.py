@@ -10,6 +10,11 @@ both inside a partial-manual `jax.shard_map`.
 """
 
 from tritonclient_tpu.parallel.mesh import AXIS_ORDER, auto_mesh, build_mesh
+from tritonclient_tpu.parallel.multihost import (
+    hybrid_mesh,
+    initialize,
+    process_local_batch,
+)
 from tritonclient_tpu.parallel.ring_attention import ring_attention
 from tritonclient_tpu.parallel.sharding import (
     named_sharding,
@@ -23,7 +28,10 @@ __all__ = [
     "AXIS_ORDER",
     "auto_mesh",
     "build_mesh",
+    "hybrid_mesh",
+    "initialize",
     "named_sharding",
+    "process_local_batch",
     "ring_attention",
     "shard_tree",
     "spec_for_path",

@@ -23,6 +23,15 @@ from jax.sharding import Mesh
 AXIS_ORDER = ("dp", "fsdp", "pp", "ep", "sp", "tp")
 
 
+def order_axes(axes) -> list:
+    """Axis names sorted by AXIS_ORDER (unknown names keep insertion order
+    after the known ones) — the one place the ordering policy lives."""
+    return sorted(
+        axes,
+        key=lambda n: AXIS_ORDER.index(n) if n in AXIS_ORDER else len(AXIS_ORDER),
+    )
+
+
 def build_mesh(
     axes: Dict[str, int],
     devices: Optional[Sequence] = None,
@@ -50,10 +59,7 @@ def build_mesh(
         raise ValueError(
             f"mesh axes {sizes} require {total} devices, have {len(devices)}"
         )
-    names = sorted(
-        sizes,
-        key=lambda n: AXIS_ORDER.index(n) if n in AXIS_ORDER else len(AXIS_ORDER),
-    )
+    names = order_axes(sizes)
     grid = np.asarray(devices, dtype=object).reshape([sizes[n] for n in names])
     return Mesh(grid, tuple(names))
 

@@ -1,0 +1,157 @@
+"""Multi-host (DCN-spanning) meshes and distributed runtime setup.
+
+The reference's multi-node story is NCCL/MPI wired by the launcher; the
+TPU-native equivalent is JAX's distributed runtime plus a hybrid mesh:
+axes that cross hosts (dp, pp) ride DCN, axes within a slice (fsdp, sp,
+tp) ride ICI. The scaling-book recipe made concrete:
+
+  initialize()                          # once per process, from env or args
+  mesh = hybrid_mesh(dcn={"dp": 2}, ici={"fsdp": 2, "tp": 4})
+  batch = process_local_batch(mesh, global_shape, local_arrays, spec)
+
+Everything degrades to single-process: initialize() is a no-op when no
+coordinator is configured, and hybrid_mesh with dcn product 1 is a plain
+build_mesh.
+"""
+
+import math
+import os
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from tritonclient_tpu.parallel.mesh import order_axes
+
+# Axes whose collectives tolerate DCN latency (gradient syncs, pipeline
+# hops); everything else belongs on ICI within a slice.
+DCN_FRIENDLY_AXES = ("dp", "pp")
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Bring up the JAX distributed runtime (idempotent, env-aware).
+
+    Arguments default from JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+    JAX_PROCESS_ID (the knobs a launcher sets, playing the role of the
+    reference's MPI environment); unset count/id stay None so JAX's
+    cluster auto-detection (Cloud TPU, Slurm) still works. Returns True
+    when the multi-process runtime is (or already was) initialized, False
+    for the single-process no-op.
+    """
+    if jax.distributed.is_initialized():
+        return True
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if coordinator_address is None:
+        return False
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def hybrid_mesh(
+    dcn: Dict[str, int],
+    ici: Dict[str, int],
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """A mesh whose ``dcn`` axes span hosts and ``ici`` axes stay in-slice.
+
+    ``dcn`` axes are laid out outermost and must be DCN-friendly; ``ici``
+    axes are innermost. On real multi-process TPU the device grid comes
+    from ``mesh_utils.create_hybrid_device_mesh`` (DCN-outermost AND
+    ICI-torus-adjacent); single-process (including the 8-virtual-device
+    CPU tests) an id-ordered reshape gives the same logical layout.
+    """
+    for name in dcn:
+        if name not in DCN_FRIENDLY_AXES:
+            raise ValueError(
+                f"axis '{name}' must not cross DCN (latency-sensitive "
+                f"collectives); DCN axes are {DCN_FRIENDLY_AXES}"
+            )
+    overlap = set(dcn) & set(ici)
+    if overlap:
+        raise ValueError(f"axes {sorted(overlap)} appear in both dcn and ici")
+    devices = list(devices if devices is not None else jax.devices())
+    dcn_total = math.prod(dcn.values()) if dcn else 1
+    ici_total = math.prod(ici.values()) if ici else 1
+    if dcn_total * ici_total != len(devices):
+        raise ValueError(
+            f"dcn {dict(dcn)} x ici {dict(ici)} needs "
+            f"{dcn_total * ici_total} devices, have {len(devices)}"
+        )
+    multiprocess = jax.process_count() > 1
+    if multiprocess:
+        # The whole point of the split: ici axes must fit inside one
+        # process's devices, dcn axes must match the process count.
+        if ici_total != jax.local_device_count():
+            raise ValueError(
+                f"ici axes {dict(ici)} (product {ici_total}) must equal the "
+                f"per-process device count {jax.local_device_count()}; a "
+                "larger product would put latency-sensitive collectives on "
+                "DCN"
+            )
+        if dcn_total != jax.process_count():
+            raise ValueError(
+                f"dcn axes {dict(dcn)} (product {dcn_total}) must equal the "
+                f"process count {jax.process_count()}"
+            )
+
+    dcn_names = order_axes(dcn)
+    ici_names = order_axes(ici)
+    names = [*dcn_names, *ici_names]
+    shape = [dcn[n] for n in dcn_names] + [ici[n] for n in ici_names]
+    if multiprocess:
+        from jax.experimental import mesh_utils
+
+        # Physical-topology-aware layout: DCN axes map to process granules,
+        # ICI axes to torus-adjacent devices within each granule.
+        grid = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=[ici[n] for n in ici_names] or [1],
+            dcn_mesh_shape=[dcn[n] for n in dcn_names] or [1],
+            devices=devices,
+        ).reshape(shape)
+    else:
+        grid = np.asarray(devices, dtype=object).reshape(shape)
+    return Mesh(grid, tuple(names))
+
+
+def process_local_batch(
+    mesh: Mesh,
+    global_shape: Sequence[int],
+    local_arrays,
+    spec: PartitionSpec,
+) -> jax.Array:
+    """Assemble a global jax.Array from this process's local shard(s).
+
+    The multi-host data-loading contract: every process feeds only the
+    rows its own devices hold (one array, or a list of per-device shards
+    concatenated on the leading axis), and the result behaves as one
+    global array under ``spec``. Single-process this is just device_put
+    with the sharding (which is also how the CPU tests cover it).
+    """
+    sharding = NamedSharding(mesh, spec)
+    if isinstance(local_arrays, (list, tuple)):
+        local = np.concatenate([np.asarray(a) for a in local_arrays], axis=0)
+    else:
+        local = np.asarray(local_arrays)
+    if jax.process_count() == 1:
+        if tuple(local.shape) != tuple(global_shape):
+            raise ValueError(
+                f"single-process local data shape {local.shape} != global "
+                f"shape {tuple(global_shape)}"
+            )
+        return jax.device_put(local, sharding)
+    return jax.make_array_from_process_local_data(sharding, local, global_shape)
