@@ -75,9 +75,12 @@ def main():
     model_name = os.environ.get("BENCH_MODEL", "bert_base")
     batch = int(os.environ.get("BENCH_BATCH", "8"))
     seq = int(os.environ.get("BENCH_SEQ", "128"))
-    seconds = float(os.environ.get("BENCH_SECONDS", "12"))
+    # 6 alternating window pairs: tunnel throughput drifts on ~minute
+    # scales, and the ratio's run-to-run spread shrinks with the number of
+    # serving/in-process alternations, not with window length.
+    seconds = float(os.environ.get("BENCH_SECONDS", "18"))
     concurrency = int(os.environ.get("BENCH_CONCURRENCY", "8"))
-    n_windows = int(os.environ.get("BENCH_WINDOWS", "4"))
+    n_windows = int(os.environ.get("BENCH_WINDOWS", "6"))
     shm_mode = os.environ.get("BENCH_SHM", "tpu")
     async_window = os.environ.get("BENCH_ASYNC_WINDOW", "0") == "1"
     if async_window and shm_mode != "tpu":

@@ -1,0 +1,68 @@
+#!/bin/bash
+# Build the Java API bindings for the native TPU client library.
+#
+# Reference parity: src/java-api-bindings/scripts/
+# install_dependencies_and_build.sh builds JavaCPP bindings over the
+# in-process Triton C API. This framework's bindable surface is the client
+# library's flat C ABI (native/client/capi.h); the Java side uses the JDK's
+# own java.lang.foreign (FFM, JDK 22+), so there are no binding-generator
+# dependencies to install — the script builds the shared lib and compiles
+# the FFM class.
+set -euo pipefail
+
+USAGE="
+usage: install_dependencies_and_build.sh [options]
+
+Builds libtpuhttpclient.so and the Java FFM bindings over its C ABI.
+-h|--help          Shows usage
+-b|--build-home    cmake build directory, default: <repo>/build
+-j|--jar-install-path  Where to copy the compiled classes (optional)
+"
+
+SCRIPT_DIR="$(cd "$(dirname "$0")" && pwd)"
+REPO="$(cd "${SCRIPT_DIR}/../.." && pwd)"
+BUILD_HOME="${REPO}/build"
+JAR_INSTALL_PATH=""
+
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    -h|--help) echo "$USAGE"; exit 0 ;;
+    -b|--build-home) BUILD_HOME="$2"; shift 2 ;;
+    -j|--jar-install-path) JAR_INSTALL_PATH="$2"; shift 2 ;;
+    *) echo "unknown option: $1"; echo "$USAGE"; exit 2 ;;
+  esac
+done
+
+echo "== building native client library"
+# Match the test fixtures' generator choice: a mixed-generator build dir
+# makes every later cmake configure fail.
+GEN=()
+if command -v ninja >/dev/null; then GEN=(-G Ninja); fi
+cmake -S "${REPO}/native" -B "${BUILD_HOME}" "${GEN[@]}" >/dev/null
+cmake --build "${BUILD_HOME}" --target tpuhttpclient
+
+if ! command -v javac >/dev/null; then
+  echo "== no JDK found; native library built, Java compile skipped"
+  echo "   (install JDK 22+ and rerun to compile the FFM bindings)"
+  exit 0
+fi
+
+JAVA_MAJOR=$(javac -version 2>&1 | sed -E 's/javac ([0-9]+).*/\1/')
+if [[ "${JAVA_MAJOR}" -lt 22 ]]; then
+  echo "== JDK ${JAVA_MAJOR} < 22 (java.lang.foreign is final in 22);"
+  echo "   native library built, Java compile skipped"
+  exit 0
+fi
+
+echo "== compiling FFM bindings"
+OUT="${SCRIPT_DIR}/classes"
+mkdir -p "${OUT}"
+javac -d "${OUT}" "${SCRIPT_DIR}/src/main/java/TpuClientBindings.java"
+if [[ -n "${JAR_INSTALL_PATH}" ]]; then
+  mkdir -p "${JAR_INSTALL_PATH}"
+  cp -r "${OUT}/." "${JAR_INSTALL_PATH}/"
+fi
+echo "== done; run with:"
+echo "   java --enable-native-access=ALL-UNNAMED \\"
+echo "        -Djava.library.path=${BUILD_HOME} \\"
+echo "        -cp ${OUT} TpuClientBindings <host:port>"

@@ -1,0 +1,84 @@
+// Java FFM (Panama) bindings over the native client's flat C ABI
+// (native/client/capi.h), plus a self-checking main.
+//
+// The reference's java-api-bindings wraps the in-process Triton C API via
+// JavaCPP (src/java-api-bindings/scripts/install_dependencies_and_build.sh);
+// this framework has no C server core, so the bindings target the client
+// library: java.lang.foreign downcalls into libtpuhttpclient.so — no
+// generated glue, no extra dependencies, JDK 22+.
+//
+//   java --enable-native-access=ALL-UNNAMED \
+//        -Djava.library.path=<build dir> TpuClientBindings.java <host:port>
+
+import java.lang.foreign.Arena;
+import java.lang.foreign.FunctionDescriptor;
+import java.lang.foreign.Linker;
+import java.lang.foreign.MemorySegment;
+import java.lang.foreign.SymbolLookup;
+import java.lang.foreign.ValueLayout;
+import java.lang.invoke.MethodHandle;
+
+public final class TpuClientBindings {
+    private final MethodHandle create;
+    private final MethodHandle destroy;
+    private final MethodHandle isServerLive;
+    private final MethodHandle lastError;
+
+    public TpuClientBindings() {
+        Linker linker = Linker.nativeLinker();
+        // loadLibrary honors -Djava.library.path (libraryLookup would go
+        // through dlopen, which only consults LD_LIBRARY_PATH).
+        System.loadLibrary("tpuhttpclient");
+        SymbolLookup lib = SymbolLookup.loaderLookup();
+        create = linker.downcallHandle(
+                lib.find("tpuclient_http_create").orElseThrow(),
+                FunctionDescriptor.of(ValueLayout.JAVA_INT,
+                        ValueLayout.ADDRESS, ValueLayout.ADDRESS));
+        destroy = linker.downcallHandle(
+                lib.find("tpuclient_http_destroy").orElseThrow(),
+                FunctionDescriptor.ofVoid(ValueLayout.ADDRESS));
+        isServerLive = linker.downcallHandle(
+                lib.find("tpuclient_http_is_server_live").orElseThrow(),
+                FunctionDescriptor.of(ValueLayout.JAVA_INT,
+                        ValueLayout.ADDRESS, ValueLayout.ADDRESS));
+        lastError = linker.downcallHandle(
+                lib.find("tpuclient_last_error").orElseThrow(),
+                FunctionDescriptor.of(ValueLayout.ADDRESS));
+    }
+
+    public boolean serverLive(String url) throws Throwable {
+        try (Arena arena = Arena.ofConfined()) {
+            MemorySegment handleOut = arena.allocate(ValueLayout.ADDRESS);
+            int rc = (int) create.invoke(arena.allocateFrom(url), handleOut);
+            if (rc != 0) {
+                throw new RuntimeException("create failed: " + error());
+            }
+            MemorySegment handle = handleOut.get(ValueLayout.ADDRESS, 0);
+            try {
+                MemorySegment live = arena.allocate(ValueLayout.JAVA_INT);
+                rc = (int) isServerLive.invoke(handle, live);
+                if (rc != 0) {
+                    throw new RuntimeException("live check failed: " + error());
+                }
+                return live.get(ValueLayout.JAVA_INT, 0) == 1;
+            } finally {
+                destroy.invoke(handle);
+            }
+        }
+    }
+
+    private String error() throws Throwable {
+        MemorySegment msg = (MemorySegment) lastError.invoke();
+        return msg.reinterpret(4096).getString(0);
+    }
+
+    public static void main(String[] args) throws Throwable {
+        String url = args.length > 0 ? args[0] : "localhost:8000";
+        boolean live = new TpuClientBindings().serverLive(url);
+        if (!live) {
+            System.err.println("error: server not live");
+            System.exit(1);
+        }
+        System.out.println("PASS: server live via FFM bindings");
+    }
+}

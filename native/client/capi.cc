@@ -1,0 +1,135 @@
+#include "capi.h"
+
+#include <cstdlib>
+#include <cstring>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "http_client.h"
+
+namespace {
+
+thread_local std::string g_last_error;
+
+int Fail(const tputriton::Error& err) {
+  g_last_error = err.Message();
+  return 1;
+}
+
+int FailMsg(const char* msg) {
+  g_last_error = msg;
+  return 1;
+}
+
+}  // namespace
+
+struct tpuclient_http {
+  std::unique_ptr<tputriton::InferenceServerHttpClient> impl;
+};
+
+extern "C" {
+
+int tpuclient_http_create(const char* url, tpuclient_http** out) {
+  if (url == nullptr || out == nullptr) return FailMsg("null argument");
+  auto wrapper = std::make_unique<tpuclient_http>();
+  tputriton::Error err =
+      tputriton::InferenceServerHttpClient::Create(&wrapper->impl, url);
+  if (!err.IsOk()) return Fail(err);
+  *out = wrapper.release();
+  g_last_error.clear();
+  return 0;
+}
+
+void tpuclient_http_destroy(tpuclient_http* client) { delete client; }
+
+int tpuclient_http_is_server_live(tpuclient_http* client, int* live) {
+  if (client == nullptr || live == nullptr) return FailMsg("null argument");
+  bool b = false;
+  tputriton::Error err = client->impl->IsServerLive(&b);
+  if (!err.IsOk()) return Fail(err);
+  *live = b ? 1 : 0;
+  g_last_error.clear();
+  return 0;
+}
+
+int tpuclient_http_is_model_ready(tpuclient_http* client, const char* model,
+                                  int* ready) {
+  if (client == nullptr || model == nullptr || ready == nullptr) {
+    return FailMsg("null argument");
+  }
+  bool b = false;
+  tputriton::Error err = client->impl->IsModelReady(model, &b);
+  if (!err.IsOk()) return Fail(err);
+  *ready = b ? 1 : 0;
+  g_last_error.clear();
+  return 0;
+}
+
+int tpuclient_http_infer(
+    tpuclient_http* client, const char* model_name,
+    const char* const* input_names, const char* const* input_datatypes,
+    const int64_t* const* input_shapes, const int32_t* input_ranks,
+    const uint8_t* const* input_data, const size_t* input_nbytes,
+    int32_t n_inputs,
+    const char* const* output_names, int32_t n_outputs,
+    uint8_t** out_data, size_t* out_nbytes) {
+  if (client == nullptr || model_name == nullptr || n_inputs <= 0 ||
+      input_names == nullptr || input_datatypes == nullptr ||
+      input_shapes == nullptr || input_ranks == nullptr ||
+      input_data == nullptr || input_nbytes == nullptr ||
+      (n_outputs > 0 &&
+       (output_names == nullptr || out_data == nullptr ||
+        out_nbytes == nullptr))) {
+    return FailMsg("null/empty argument");
+  }
+  std::vector<std::unique_ptr<tputriton::InferInput>> inputs;
+  std::vector<tputriton::InferInput*> input_ptrs;
+  for (int32_t i = 0; i < n_inputs; i++) {
+    std::vector<int64_t> shape(input_shapes[i],
+                               input_shapes[i] + input_ranks[i]);
+    auto input = std::make_unique<tputriton::InferInput>(
+        input_names[i], shape, input_datatypes[i]);
+    input->AppendRaw(input_data[i], input_nbytes[i]);
+    input_ptrs.push_back(input.get());
+    inputs.push_back(std::move(input));
+  }
+  std::vector<std::unique_ptr<tputriton::InferRequestedOutput>> outputs;
+  std::vector<const tputriton::InferRequestedOutput*> output_ptrs;
+  for (int32_t i = 0; i < n_outputs; i++) {
+    outputs.push_back(
+        std::make_unique<tputriton::InferRequestedOutput>(output_names[i]));
+    output_ptrs.push_back(outputs.back().get());
+  }
+
+  tputriton::InferOptions options(model_name);
+  std::shared_ptr<tputriton::InferResult> result;
+  tputriton::Error err =
+      client->impl->Infer(&result, options, input_ptrs, output_ptrs);
+  if (!err.IsOk()) return Fail(err);
+
+  for (int32_t i = 0; i < n_outputs; i++) {
+    const uint8_t* buf = nullptr;
+    size_t nbytes = 0;
+    err = result->RawData(output_names[i], &buf, &nbytes);
+    if (!err.IsOk()) {
+      for (int32_t j = 0; j < i; j++) std::free(out_data[j]);
+      return Fail(err);
+    }
+    out_data[i] = static_cast<uint8_t*>(std::malloc(nbytes ? nbytes : 1));
+    if (out_data[i] == nullptr) {
+      for (int32_t j = 0; j < i; j++) std::free(out_data[j]);
+      return FailMsg("out of memory for output buffer");
+    }
+    std::memcpy(out_data[i], buf, nbytes);
+    out_nbytes[i] = nbytes;
+  }
+  g_last_error.clear();
+  return 0;
+}
+
+void tpuclient_free(void* p) { std::free(p); }
+
+const char* tpuclient_last_error(void) { return g_last_error.c_str(); }
+
+}  // extern "C"

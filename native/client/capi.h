@@ -1,0 +1,55 @@
+// C ABI over the native HTTP client — the language-bindings plane.
+//
+// The reference ships java-api-bindings: a script generating JavaCPP
+// bindings over the in-process Triton C API (src/java-api-bindings/
+// scripts/install_dependencies_and_build.sh). The TPU-native analog binds
+// the client library instead (there is no C server core here): this flat
+// C ABI is consumable from Java FFM/JNI, Python ctypes, Go cgo, or any
+// FFI without C++ name mangling. clients/java-api-bindings/ holds the
+// Java side; tests drive it through ctypes.
+//
+// Conventions: functions return 0 on success, nonzero on error;
+// tpuclient_last_error() returns a thread-local message for the calling
+// thread's most recent failure. Output buffers are malloc'd and owned by
+// the caller (free with tpuclient_free).
+#pragma once
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct tpuclient_http tpuclient_http;
+
+// url: "host:port". Returns 0 and sets *out on success.
+int tpuclient_http_create(const char* url, tpuclient_http** out);
+void tpuclient_http_destroy(tpuclient_http* client);
+
+int tpuclient_http_is_server_live(tpuclient_http* client, int* live);
+int tpuclient_http_is_model_ready(tpuclient_http* client, const char* model,
+                                  int* ready);
+
+// Raw-tensor inference. Inputs: parallel arrays of length n_inputs
+// (names, Triton datatype strings, shapes flattened per-input with ranks,
+// raw data pointers and byte sizes). Outputs: for each of the n_outputs
+// requested names, *out_data[i] receives a malloc'd buffer of
+// *out_nbytes[i] raw bytes (caller frees each with tpuclient_free).
+int tpuclient_http_infer(
+    tpuclient_http* client, const char* model_name,
+    const char* const* input_names, const char* const* input_datatypes,
+    const int64_t* const* input_shapes, const int32_t* input_ranks,
+    const uint8_t* const* input_data, const size_t* input_nbytes,
+    int32_t n_inputs,
+    const char* const* output_names, int32_t n_outputs,
+    uint8_t** out_data, size_t* out_nbytes);
+
+void tpuclient_free(void* p);
+
+// Thread-local message for this thread's most recent failure ("" if none).
+const char* tpuclient_last_error(void);
+
+#ifdef __cplusplus
+}  // extern "C"
+#endif

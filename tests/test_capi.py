@@ -1,0 +1,137 @@
+"""The flat C ABI (native/client/capi.h) driven through ctypes.
+
+This is the binding surface Java FFM / JNI / cgo consumers use (the
+java-api-bindings analog, clients/java-api-bindings/); ctypes plays the
+foreign-language role hermetically.
+"""
+
+import ctypes
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+from tritonclient_tpu.server import InferenceServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUILD = os.path.join(REPO, "build")
+
+
+@pytest.fixture(scope="module")
+def capi():
+    if shutil.which("cmake") is None or shutil.which("g++") is None:
+        pytest.skip("no native toolchain")
+    gen = ["-G", "Ninja"] if shutil.which("ninja") else []
+    subprocess.run(
+        ["cmake", "-S", os.path.join(REPO, "native"), "-B", BUILD, *gen],
+        check=True, capture_output=True,
+    )
+    subprocess.run(["cmake", "--build", BUILD], check=True,
+                   capture_output=True, timeout=600)
+    lib = ctypes.CDLL(os.path.join(BUILD, "libtpuhttpclient.so"))
+    lib.tpuclient_http_create.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
+    lib.tpuclient_http_destroy.argtypes = [ctypes.c_void_p]
+    lib.tpuclient_http_is_server_live.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int)]
+    lib.tpuclient_http_is_model_ready.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int)]
+    lib.tpuclient_last_error.restype = ctypes.c_char_p
+    lib.tpuclient_free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+@pytest.fixture(scope="module")
+def server():
+    with InferenceServer(grpc=False) as s:
+        yield s
+
+
+def _create(capi, url: str):
+    handle = ctypes.c_void_p()
+    rc = capi.tpuclient_http_create(url.encode(), ctypes.byref(handle))
+    assert rc == 0, capi.tpuclient_last_error()
+    return handle
+
+
+def test_capi_health_and_errors(capi, server):
+    handle = _create(capi, server.http_address)
+    try:
+        live = ctypes.c_int(0)
+        assert capi.tpuclient_http_is_server_live(handle, ctypes.byref(live)) == 0
+        assert live.value == 1
+        ready = ctypes.c_int(0)
+        assert capi.tpuclient_http_is_model_ready(
+            handle, b"simple", ctypes.byref(ready)) == 0
+        assert ready.value == 1
+        # Unknown model: "not ready", no error (reference IsModelReady
+        # semantics — a 404 ready check is an answer, not a failure).
+        ready = ctypes.c_int(1)
+        assert capi.tpuclient_http_is_model_ready(
+            handle, b"nope", ctypes.byref(ready)) == 0
+        assert ready.value == 0
+        # A real failure (infer on unknown model) sets the thread-local
+        # message and returns nonzero.
+        x = np.zeros((1, 16), np.int32)
+        names = (ctypes.c_char_p * 1)(b"INPUT0")
+        dtypes = (ctypes.c_char_p * 1)(b"INT32")
+        shape = (ctypes.c_int64 * 2)(1, 16)
+        shapes = (ctypes.POINTER(ctypes.c_int64) * 1)(shape)
+        ranks = (ctypes.c_int32 * 1)(2)
+        data = (ctypes.POINTER(ctypes.c_uint8) * 1)(
+            x.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+        nbytes = (ctypes.c_size_t * 1)(x.nbytes)
+        out_names = (ctypes.c_char_p * 1)(b"OUTPUT0")
+        out_data = (ctypes.POINTER(ctypes.c_uint8) * 1)()
+        out_nbytes = (ctypes.c_size_t * 1)()
+        rc = capi.tpuclient_http_infer(
+            handle, b"nope", names, dtypes, shapes, ranks, data, nbytes, 1,
+            out_names, 1, out_data, out_nbytes,
+        )
+        assert rc != 0
+        assert b"nope" in capi.tpuclient_last_error()
+    finally:
+        capi.tpuclient_http_destroy(handle)
+
+
+def test_capi_infer_roundtrip(capi, server):
+    handle = _create(capi, server.http_address)
+    try:
+        x = np.arange(16, dtype=np.int32).reshape(1, 16)
+        y = np.full((1, 16), 5, dtype=np.int32)
+
+        names = (ctypes.c_char_p * 2)(b"INPUT0", b"INPUT1")
+        dtypes = (ctypes.c_char_p * 2)(b"INT32", b"INT32")
+        shape = (ctypes.c_int64 * 2)(1, 16)
+        shapes = (ctypes.POINTER(ctypes.c_int64) * 2)(shape, shape)
+        ranks = (ctypes.c_int32 * 2)(2, 2)
+        data = (ctypes.POINTER(ctypes.c_uint8) * 2)(
+            x.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            y.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        nbytes = (ctypes.c_size_t * 2)(x.nbytes, y.nbytes)
+        out_names = (ctypes.c_char_p * 2)(b"OUTPUT0", b"OUTPUT1")
+        out_data = (ctypes.POINTER(ctypes.c_uint8) * 2)()
+        out_nbytes = (ctypes.c_size_t * 2)()
+
+        rc = capi.tpuclient_http_infer(
+            handle, b"simple", names, dtypes, shapes, ranks, data, nbytes, 2,
+            out_names, 2, out_data, out_nbytes,
+        )
+        assert rc == 0, capi.tpuclient_last_error()
+        try:
+            sums = np.ctypeslib.as_array(out_data[0], (out_nbytes[0],)).view(
+                np.int32
+            )
+            diffs = np.ctypeslib.as_array(out_data[1], (out_nbytes[1],)).view(
+                np.int32
+            )
+            np.testing.assert_array_equal(sums.reshape(1, 16), x + y)
+            np.testing.assert_array_equal(diffs.reshape(1, 16), x - y)
+        finally:
+            capi.tpuclient_free(out_data[0])
+            capi.tpuclient_free(out_data[1])
+    finally:
+        capi.tpuclient_http_destroy(handle)

@@ -53,3 +53,15 @@ def test_graft_entry_contract():
     params, tokens = args
     assert tokens.dtype == jnp.int32
     assert "layers" in params
+
+
+def test_bert_serving_model_flash_attention_matches_default():
+    from tritonclient_tpu.models.bert import BertBaseModel, bert_tiny
+
+    cfg = bert_tiny(seq_len=128)
+    plain = BertBaseModel(cfg=cfg, seed=0)
+    flash = BertBaseModel(cfg=cfg, seed=0, use_flash_attention=True)
+    tokens = np.arange(2 * 128, dtype=np.int32).reshape(2, 128) % cfg.vocab_size
+    out_plain = np.asarray(plain.infer({"INPUT_IDS": tokens})["POOLED_OUTPUT"])
+    out_flash = np.asarray(flash.infer({"INPUT_IDS": tokens})["POOLED_OUTPUT"])
+    np.testing.assert_allclose(out_flash, out_plain, rtol=2e-4, atol=2e-4)

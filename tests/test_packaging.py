@@ -62,10 +62,12 @@ class TestSharedLibs:
         leaked = [
             l for l in exported
             if "tputriton::" not in l and "inference::" not in l
+            and " tpuclient_" not in l
         ]
         assert not leaked, f"{lib} leaks symbols: {leaked[:5]}"
         assert any("tputriton::" in l for l in exported), "no client symbols exported"
         assert any("inference::" in l for l in exported), "proto symbols hidden"
+        assert any(" tpuclient_" in l for l in exported), "C ABI hidden"
 
 
 class TestCMakeConfigPackage:

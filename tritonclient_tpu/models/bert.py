@@ -229,7 +229,8 @@ class BertBaseModel(Model):
     name = "bert_base"
     platform = "jax"
 
-    def __init__(self, cfg: Optional[BertConfig] = None, seed: int = 0):
+    def __init__(self, cfg: Optional[BertConfig] = None, seed: int = 0,
+                 use_flash_attention: bool = False):
         super().__init__()
         self.cfg = cfg or bert_base()
         self.inputs = [TensorSpec("INPUT_IDS", "INT32", [-1, -1])]
@@ -238,9 +239,18 @@ class BertBaseModel(Model):
         ]
         self._params = init_params(jax.random.PRNGKey(seed), self.cfg)
 
+        attention_fn = None
+        if use_flash_attention:
+            # Tile-streamed Pallas kernel (ops/flash_attention.py): pays off
+            # at long sequence where the [L, L] scores stop fitting HBM;
+            # shapes that don't tile fall back automatically.
+            from tritonclient_tpu.ops.flash_attention import flash_attention
+
+            attention_fn = functools.partial(flash_attention, causal=False)
+
         @jax.jit
         def fwd(params, tokens):
-            seq = encode(params, tokens, self.cfg)
+            seq = encode(params, tokens, self.cfg, attention_fn=attention_fn)
             return pooled_output(params, seq).astype(jnp.float32)
 
         self._fwd = fwd
