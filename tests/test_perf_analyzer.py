@@ -156,3 +156,47 @@ def test_prepared_request_reuse(server):
         tpushm.destroy_shared_memory_region(in_region)
         tpushm.destroy_shared_memory_region(out_region)
         client.close()
+
+
+def test_mesh_sharded_tpu_shm_mode(server):
+    """Regions spanning an 8-device mesh behind the same sweep — the
+    multi-chip serving instrument (SURVEY §5.7/§5.8)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs the 8-virtual-device CPU mesh")
+    mesh = Mesh(np.array(devices[:8]), ("sp",))
+    analyzer = PerfAnalyzer(
+        server.grpc_address, "simple", batch_size=8, shared_memory="tpu",
+        shm_mesh=mesh, read_outputs=True,
+        measurement_interval_s=0.4, warmup_s=0.1,
+    )
+    window = analyzer.measure(2)
+    summary = window.summary()
+    assert summary["errors"] == 0
+    assert summary["throughput_infer_per_sec"] > 0
+
+    # Window (async) mode over sharded regions too.
+    analyzer2 = PerfAnalyzer(
+        server.grpc_address, "simple", batch_size=8, shared_memory="tpu",
+        shm_mesh=mesh, streaming=True, async_window=True, read_outputs=True,
+        measurement_interval_s=0.4, warmup_s=0.1,
+    )
+    window2 = analyzer2.measure(4)
+    assert window2.summary()["errors"] == 0
+
+    with pytest.raises(ValueError, match="shm_mesh requires"):
+        PerfAnalyzer(
+            server.grpc_address, "simple", shared_memory="system",
+            shm_mesh=mesh,
+        )
+    # A batch that cannot shard evenly must fail fast at construction,
+    # not as N per-request errors mid-sweep.
+    with pytest.raises(ValueError, match="shards evenly"):
+        PerfAnalyzer(
+            server.grpc_address, "simple", batch_size=3,
+            shared_memory="tpu", shm_mesh=mesh,
+        )

@@ -60,11 +60,34 @@ def main(argv=None):
     parser.add_argument("--read-outputs", action="store_true",
                         help="include output deserialization in the loop")
     parser.add_argument("--device-id", type=int, default=0)
+    parser.add_argument(
+        "--shm-mesh-devices", type=int, default=0, metavar="N",
+        help="with --shared-memory=tpu: span regions over the first N "
+             "devices as a 1-axis mesh (per-device buffer shards)",
+    )
     parser.add_argument("-f", "--filename", help="write per-level CSV here")
     parser.add_argument("--json", dest="json_out", action="store_true",
                         help="print JSON summaries instead of a table")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
+
+    shm_mesh = None
+    if args.shm_mesh_devices:
+        if args.shm_mesh_devices < 1:
+            parser.error("--shm-mesh-devices must be a positive device count")
+        if args.shared_memory != "tpu":
+            parser.error("--shm-mesh-devices requires --shared-memory=tpu")
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        available = jax.devices()
+        if len(available) < args.shm_mesh_devices:
+            parser.error(
+                f"--shm-mesh-devices {args.shm_mesh_devices}: only "
+                f"{len(available)} devices available"
+            )
+        shm_mesh = Mesh(np.array(available[: args.shm_mesh_devices]), ("sp",))
 
     analyzer = PerfAnalyzer(
         url=args.url,
@@ -78,6 +101,7 @@ def main(argv=None):
         shape_overrides=_parse_shapes(args.shape),
         read_outputs=args.read_outputs,
         device_id=args.device_id,
+        shm_mesh=shm_mesh,
         verbose=args.verbose,
     )
     start, end, step = args.concurrency_range

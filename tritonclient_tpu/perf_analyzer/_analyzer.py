@@ -118,16 +118,14 @@ class _Worker:
             import tritonclient_tpu.utils.tpu_shared_memory as tpushm
 
             self._tpushm = tpushm
-            self._in_region = tpushm.create_shared_memory_region(
-                f"pa_in_{self.wid}", total_in, a.device_id
-            )
+            self._in_region = a.make_tpu_region(f"pa_in_{self.wid}", total_in)
             self._client.register_tpu_shared_memory(
                 f"pa_in_{self.wid}", tpushm.get_raw_handle(self._in_region),
                 a.device_id, total_in,
             )
             if total_out:
-                self._out_region = tpushm.create_shared_memory_region(
-                    f"pa_out_{self.wid}", total_out, a.device_id
+                self._out_region = a.make_tpu_region(
+                    f"pa_out_{self.wid}", total_out
                 )
                 self._client.register_tpu_shared_memory(
                     f"pa_out_{self.wid}", tpushm.get_raw_handle(self._out_region),
@@ -440,11 +438,11 @@ class _WindowWorker:
             for dt, shape in a.input_specs.values()
         )
         self._out_slot = sum(a.output_sizes.values())
-        self._in_region = tpushm.create_shared_memory_region(
-            f"pa_win_in_{a.run_id}", self._in_slot * self.slots, a.device_id
+        self._in_region = a.make_tpu_region(
+            f"pa_win_in_{a.run_id}", self._in_slot * self.slots
         )
-        self._out_region = tpushm.create_shared_memory_region(
-            f"pa_win_out_{a.run_id}", self._out_slot * self.slots, a.device_id
+        self._out_region = a.make_tpu_region(
+            f"pa_win_out_{a.run_id}", self._out_slot * self.slots
         )
         self._client.register_tpu_shared_memory(
             f"pa_win_in_{a.run_id}", tpushm.get_raw_handle(self._in_region),
@@ -604,7 +602,15 @@ class _WindowWorker:
             self._client.start_stream(callback=on_stream)
         try:
             for s in range(self.slots):
-                submit(s)
+                # A failed initial submit must count as an error, not
+                # escape the run thread (the window would then report a
+                # clean errors == 0 for a run that did nothing).
+                try:
+                    submit(s)
+                except Exception:
+                    with self._record_lock:
+                        self.errors += 1
+                    continue
                 with lock:
                     outstanding[0] += 1
             if outstanding[0] == 0:
@@ -640,6 +646,7 @@ class PerfAnalyzer:
         output_sizes: Optional[Dict[str, int]] = None,
         read_outputs: bool = False,
         device_id: int = 0,
+        shm_mesh=None,
         verbose: bool = False,
     ):
         if protocol not in ("grpc", "http"):
@@ -661,6 +668,13 @@ class PerfAnalyzer:
         self.warmup_s = warmup_s
         self.read_outputs = read_outputs
         self.device_id = device_id
+        # Optional jax.sharding.Mesh: tpu regions then span every mesh
+        # device (one buffer shard each) instead of a single device — the
+        # instrument for the §5.7/§5.8 multi-chip serving story. Payload
+        # leading dims must divide the mesh size.
+        self.shm_mesh = shm_mesh
+        if shm_mesh is not None and shared_memory != "tpu":
+            raise ValueError("shm_mesh requires shared_memory='tpu'")
         self.verbose = verbose
         self.run_id = int(time.time() * 1000) % 100000
 
@@ -698,6 +712,15 @@ class PerfAnalyzer:
             )
             for t in meta["inputs"]
         }
+        if self.shm_mesh is not None:
+            mesh_size = self.shm_mesh.devices.size
+            for name, (_, shape) in self.input_specs.items():
+                if not shape or shape[0] % mesh_size:
+                    raise ValueError(
+                        f"input '{name}' leading dim {shape[:1]} does not "
+                        f"divide the shm mesh size {mesh_size}; pick a batch "
+                        "size that shards evenly"
+                    )
         meta_outputs = [t["name"] for t in meta.get("outputs", [])]
         self.output_names = output_names if output_names is not None else meta_outputs
         # Output shapes from metadata, when static (None otherwise). Kept
@@ -734,6 +757,19 @@ class PerfAnalyzer:
         if self.protocol == "grpc":
             return self._client_cls(self.url)
         return self._client_cls(self.url, concurrency=4)
+
+    def make_tpu_region(self, name: str, byte_size: int):
+        """A tpu shm region: single-device, or mesh-sharded when shm_mesh
+        is set (per-device buffer shards, same registration lifecycle)."""
+        import tritonclient_tpu.utils.tpu_shared_memory as tpushm
+
+        if self.shm_mesh is not None:
+            return tpushm.create_sharded_memory_region(
+                name, byte_size, self.shm_mesh
+            )
+        return tpushm.create_shared_memory_region(
+            name, byte_size, self.device_id
+        )
 
     def close_client(self, client):
         try:
