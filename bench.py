@@ -140,32 +140,39 @@ def main():
         analyzer.measurement_interval_s = seconds / n_windows
 
         # Interleave in-process and serving windows: the tunneled chip's
-        # throughput drifts over time, so alternating short windows and
-        # summing per path keeps the ratio honest under drift.
-        inproc_counts, inproc_time, inprocess_lat = 0.0, 0.0, []
-        serve_counts, serve_time = 0.0, 0.0
-        serve_lat_us = []
+        # throughput drifts over time, so each serving window is ratioed
+        # against its adjacent (drift-correlated) in-process window and the
+        # MEDIAN pair ratio is reported — robust to a single stalled window
+        # (GC pause, tunnel hiccup), where a global sum/sum quotient swings
+        # ±10% run-to-run.
+        pair_ratios = []
+        inproc_ips_list, serve_ips_list = [], []
+        inprocess_lat, serve_lat_us = [], []
         errors = 0
         for _ in range(n_windows):
             ips, lat = _pipelined_inprocess(
                 dispatch, jax.device_get, payloads, seconds / n_windows, concurrency
             )
-            inproc_counts += ips * (seconds / n_windows)
-            inproc_time += seconds / n_windows
+            inproc_ips_list.append(ips)
             inprocess_lat.extend(lat)
             window = analyzer.measure(concurrency)
             summary = window.summary()
-            serve_counts += summary["throughput_infer_per_sec"] * window.duration_s
-            serve_time += window.duration_s
+            serve_ips = summary["throughput_infer_per_sec"]
+            serve_ips_list.append(serve_ips)
+            if ips:
+                pair_ratios.append(serve_ips / ips)
             serve_lat_us.extend([ns / 1000 for ns in window.latencies_ns])
             errors += summary["errors"]
         inprocess_lat.sort()
         serve_lat_us.sort()
-        inprocess_ips = inproc_counts / inproc_time
-        client_ips = serve_counts / serve_time
+
+        from statistics import median
+
+        inprocess_ips = median(inproc_ips_list)
+        client_ips = median(serve_ips_list)
+        ratio = median(pair_ratios) if pair_ratios else 0.0
 
     from tritonclient_tpu.perf_analyzer._stats import percentile
-    ratio = client_ips / inprocess_ips if inprocess_ips else 0.0
     result = {
         "metric": f"{model_name}_b{batch}_grpc_stream_tpushm_infer_per_sec",
         "value": round(client_ips, 2),

@@ -739,6 +739,15 @@ class PerfAnalyzer:
                 break
             specs[t["name"]] = (t["datatype"], shape)
         self.output_specs = specs
+        if self.shm_mesh is not None and specs:
+            mesh_size = self.shm_mesh.devices.size
+            for name, (_, shape) in specs.items():
+                if not shape or shape[0] % mesh_size:
+                    raise ValueError(
+                        f"output '{name}' leading dim {shape[:1]} does not "
+                        f"divide the shm mesh size {mesh_size}; pick a batch "
+                        "size that shards evenly"
+                    )
         self.output_sizes = output_sizes
         if shared_memory != "none" and self.output_names and not output_sizes:
             # Infer fixed output sizes from the static shapes; dynamic
