@@ -1,0 +1,45 @@
+"""On-hardware smoke checks that CI's CPU mesh cannot cover.
+
+Run on a real TPU (no conftest): compiles the Pallas flash-attention
+kernel (non-interpret Mosaic path) for the bert_base head shape (d=64,
+lane-padded) and for a 128-lane head, and checks numerics against the
+materializing reference. Exits non-zero on any failure.
+"""
+
+import os
+import sys
+
+# Repo-root import without PYTHONPATH (which breaks the axon PJRT plugin
+# discovery on tunnel images — it must not precede site-packages).
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tritonclient_tpu.ops import dot_product_attention, flash_attention
+
+
+def main() -> int:
+    backend = jax.default_backend()
+    print(f"backend: {backend}, devices: {jax.devices()}")
+    if backend != "tpu":
+        print("SKIP: not a TPU backend")
+        return 1
+    shapes = [
+        ((2, 128, 12, 64), False),   # bert_base: d=64 lane-padded
+        ((1, 256, 4, 128), True),    # full-lane head, causal
+    ]
+    for shape, causal in shapes:
+        q = jax.random.normal(jax.random.PRNGKey(0), shape, jnp.float32)
+        got = flash_attention(q, q, q, causal=causal, interpret=False)
+        ref = dot_product_attention(q, q, q, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2e-2, atol=2e-2
+        )
+        print(f"OK flash {shape} causal={causal}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -183,6 +183,44 @@ class TestMultihost:
         with pytest.raises(ValueError, match="devices"):
             hybrid_mesh(dcn={"dp": 4}, ici={"tp": 4})
 
+    def test_hybrid_mesh_multiprocess_axis_contract(self, monkeypatch):
+        """The multiprocess branch must hand create_hybrid_device_mesh
+        full-length per-axis shapes (one entry per logical axis, same
+        order on both arguments) with process granules, and must not
+        reshape the result (which would interleave slice granules)."""
+        from jax.experimental import mesh_utils
+
+        from tritonclient_tpu.parallel import multihost
+
+        devices = jax.devices()
+        seen = {}
+
+        def fake_hybrid(mesh_shape, dcn_mesh_shape, devices, **kw):
+            seen["mesh_shape"] = list(mesh_shape)
+            seen["dcn_mesh_shape"] = list(dcn_mesh_shape)
+            seen["kw"] = kw
+            shape = [d * i for d, i in zip(dcn_mesh_shape, mesh_shape)]
+            return np.asarray(devices, dtype=object).reshape(shape)
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "local_device_count", lambda: 4)
+        monkeypatch.setattr(
+            mesh_utils, "create_hybrid_device_mesh", fake_hybrid
+        )
+        mesh = multihost.hybrid_mesh(
+            dcn={"dp": 2}, ici={"sp": 2, "tp": 2}, devices=devices
+        )
+        # One entry per logical axis, dcn axes leading, in the same order
+        # on both shape arguments (JAX's contract).
+        assert seen["mesh_shape"] == [1, 2, 2]
+        assert seen["dcn_mesh_shape"] == [2, 1, 1]
+        assert seen["kw"].get("process_is_granule") is True
+        assert mesh.axis_names == ("dp", "sp", "tp")
+        assert dict(mesh.shape) == {"dp": 2, "sp": 2, "tp": 2}
+        # Untouched granule layout: first dp group is the first 4 devices.
+        first_group = [d.id for d in mesh.devices[0].flatten()]
+        assert first_group == [0, 1, 2, 3]
+
     def test_initialize_is_noop_without_coordinator(self, monkeypatch):
         from tritonclient_tpu.parallel.multihost import initialize
 

@@ -390,6 +390,19 @@ class TestLoadWithFileOverride:
         client.get_model_metadata("multi_ver", "3")
         client.unload_model("multi_ver")
 
+        # Latest-version selection is numeric, not lexicographic:
+        # versions {2, 10} must pick 10 (Triton semantics).
+        client.load_model(
+            "num_ver", config=config,
+            files={"file:2/model.onnx": content, "file:10/model.onnx": content},
+        )
+        meta = client.get_model_metadata("num_ver")
+        versions = meta["versions"] if isinstance(meta, dict) else list(
+            meta.versions
+        )
+        assert versions == ["2", "10"]
+        client.unload_model("num_ver")
+
         # Plain load restores the repository model.
         client.load_model("simple")
         assert client.is_model_ready("simple")

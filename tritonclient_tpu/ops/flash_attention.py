@@ -176,6 +176,11 @@ def flash_attention(
         # is a sublane dim, block_k becomes the lane dim of the score tile.
         or block_q % 8
         or block_k % 128
+        # Head dim is the lane dim of the q/k/v/acc tiles: Mosaic pads
+        # lanes to 128, which we rely on for d in {8,16,...,120}; sub-8
+        # or ragged head dims would need sublane-level padding too, so
+        # fall back there instead of gambling on lowering.
+        or q.shape[-1] % 8
         or (causal and block_q != block_k)
     ):
         return dot_product_attention(q, k, v, causal=causal, scale=scale)

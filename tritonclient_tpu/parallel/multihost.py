@@ -117,12 +117,19 @@ def hybrid_mesh(
         from jax.experimental import mesh_utils
 
         # Physical-topology-aware layout: DCN axes map to process granules,
-        # ICI axes to torus-adjacent devices within each granule.
+        # ICI axes to torus-adjacent devices within each granule. Both shape
+        # arguments must carry one entry per logical axis, in the same order
+        # (dcn axes first, size 1 on the ICI side, and vice versa) — the
+        # result then already has the logical shape, so no reshape that
+        # would interleave granules. Granules are processes (we validated
+        # dcn_total against process_count above), which also keeps
+        # single-slice multi-host topologies working.
         grid = mesh_utils.create_hybrid_device_mesh(
-            mesh_shape=[ici[n] for n in ici_names] or [1],
-            dcn_mesh_shape=[dcn[n] for n in dcn_names] or [1],
+            mesh_shape=[1] * len(dcn_names) + [ici[n] for n in ici_names],
+            dcn_mesh_shape=[dcn[n] for n in dcn_names] + [1] * len(ici_names),
             devices=devices,
-        ).reshape(shape)
+            process_is_granule=True,
+        )
     else:
         grid = np.asarray(devices, dtype=object).reshape(shape)
     return Mesh(grid, tuple(names))

@@ -382,7 +382,16 @@ class _FileOverrideModel:
                         400,
                     )
             self.files[path] = bytes(content)
-        versions = sorted({p.split("/", 1)[0] for p in self.files if "/" in p})
+        # Numeric latest-version semantics: ['2', '10'] must pick '10'
+        # (lexicographic sort would pick '2'); non-numeric names sort after.
+        versions = sorted(
+            {p.split("/", 1)[0] for p in self.files if "/" in p},
+            key=lambda v: (
+                not v.isdecimal(),
+                int(v) if v.isdecimal() else 0,
+                v,
+            ),
+        )
         self.versions = versions or ["1"]
         self.version = self.versions[-1]
         self.inputs: List = []
