@@ -23,7 +23,8 @@ parks the un-materialized result in the output region) -> region readback
 (d2h, waiting on the compute).
 
 Environment knobs: BENCH_MODEL (bert_base|simple), BENCH_BATCH, BENCH_SEQ,
-BENCH_SECONDS (time budget per timed section), BENCH_CONCURRENCY,
+BENCH_SECONDS (time budget per depth), BENCH_CONCURRENCY (comma list;
+default "8,16,32" — vs_baseline gates on the WORST depth's ratio),
 BENCH_SHM (tpu|system|none), BENCH_STREAMING (1|0), BENCH_ASYNC_WINDOW
 (1|0 — sliding-window single-client mode instead of N closed-loop workers).
 """
@@ -34,6 +35,13 @@ import sys
 import time
 
 import numpy as np
+
+# Both measured paths run tens of threads in one interpreter; CPython's
+# default 5 ms GIL switch interval starves whichever thread must dispatch
+# next (measured: server-side jit dispatch wall 3.6 ms -> 0.37 ms at
+# depth 16 with a 0.2 ms interval). Applies to serving AND in-process
+# sides alike, so the ratio stays honest.
+sys.setswitchinterval(float(os.environ.get("BENCH_GIL_SWITCH_S", "0.0002")))
 
 
 def _pipelined_inprocess(dispatch, readback, payloads, seconds, depth):
@@ -75,12 +83,20 @@ def main():
     model_name = os.environ.get("BENCH_MODEL", "bert_base")
     batch = int(os.environ.get("BENCH_BATCH", "8"))
     seq = int(os.environ.get("BENCH_SEQ", "128"))
-    # 6 alternating window pairs: tunnel throughput drifts on ~minute
+    # Alternating window pairs: tunnel throughput drifts on ~minute
     # scales, and the ratio's run-to-run spread shrinks with the number of
     # serving/in-process alternations, not with window length.
     seconds = float(os.environ.get("BENCH_SECONDS", "18"))
-    concurrency = int(os.environ.get("BENCH_CONCURRENCY", "8"))
-    n_windows = int(os.environ.get("BENCH_WINDOWS", "6"))
+    # The gate must hold across a concurrency sweep, not just at the
+    # latency-bound depth (VERDICT r2): default sweeps 8/16/32 and the
+    # reported vs_baseline reflects the WORST depth's paired ratio.
+    depths = [
+        int(x)
+        for x in os.environ.get(
+            "BENCH_CONCURRENCY", os.environ.get("BENCH_SWEEP", "8,16,32")
+        ).split(",")
+    ]
+    n_windows = int(os.environ.get("BENCH_WINDOWS", "4"))
     shm_mode = os.environ.get("BENCH_SHM", "tpu")
     async_window = os.environ.get("BENCH_ASYNC_WINDOW", "0") == "1"
     if async_window and shm_mode != "tpu":
@@ -119,6 +135,11 @@ def main():
 
     model.warmup()
 
+    from statistics import median
+
+    from tritonclient_tpu.perf_analyzer._stats import percentile
+
+    per_depth = {}
     with InferenceServer(models=[model], http=False) as server:
         analyzer = PerfAnalyzer(
             server.grpc_address,
@@ -133,62 +154,97 @@ def main():
             warmup_s=1.0,
             shape_overrides=shape_overrides,
         )
-        # Discard window: absorbs thread-pool spin-up, stream setup, and
-        # first-transfer effects so no real window pays them.
-        analyzer.measurement_interval_s = 2.0
-        analyzer.measure(concurrency)
-        analyzer.measurement_interval_s = seconds / n_windows
+        for concurrency in depths:
+            # Interleave in-process and serving windows: the tunneled chip's
+            # throughput drifts over time, so each serving window is ratioed
+            # against its adjacent (drift-correlated) in-process window and
+            # the MEDIAN pair ratio is reported — robust to a single stalled
+            # window (GC pause, tunnel hiccup), where a global sum/sum
+            # quotient swings ±10% run-to-run. Workers/regions/streams are
+            # set up once per depth (session) so short windows measure
+            # steady state, not per-window setup.
+            pair_ratios = []
+            inproc_ips_list, serve_ips_list = [], []
+            inprocess_lat, serve_lat_us = [], []
+            errors = 0
 
-        # Interleave in-process and serving windows: the tunneled chip's
-        # throughput drifts over time, so each serving window is ratioed
-        # against its adjacent (drift-correlated) in-process window and the
-        # MEDIAN pair ratio is reported — robust to a single stalled window
-        # (GC pause, tunnel hiccup), where a global sum/sum quotient swings
-        # ±10% run-to-run.
-        pair_ratios = []
-        inproc_ips_list, serve_ips_list = [], []
-        inprocess_lat, serve_lat_us = [], []
-        errors = 0
-        for _ in range(n_windows):
-            ips, lat = _pipelined_inprocess(
-                dispatch, jax.device_get, payloads, seconds / n_windows, concurrency
-            )
-            inproc_ips_list.append(ips)
-            inprocess_lat.extend(lat)
-            window = analyzer.measure(concurrency)
-            summary = window.summary()
-            serve_ips = summary["throughput_infer_per_sec"]
-            serve_ips_list.append(serve_ips)
-            if ips:
-                pair_ratios.append(serve_ips / ips)
-            serve_lat_us.extend([ns / 1000 for ns in window.latencies_ns])
-            errors += summary["errors"]
-        inprocess_lat.sort()
-        serve_lat_us.sort()
+            import contextlib
 
-        from statistics import median
+            # async_window mode has no persistent session (single client,
+            # per-window measure() is its one-shot path).
+            session = None
+            ctx = contextlib.nullcontext()
+            if not async_window:
+                session = analyzer.session(concurrency)
+                ctx = session
 
-        inprocess_ips = median(inproc_ips_list)
-        client_ips = median(serve_ips_list)
-        ratio = median(pair_ratios) if pair_ratios else 0.0
+            def serving_window(interval_s):
+                if session is not None:
+                    return session.measure(interval_s=interval_s)
+                analyzer.measurement_interval_s = interval_s
+                return analyzer.measure(concurrency)
 
-    from tritonclient_tpu.perf_analyzer._stats import percentile
+            with ctx:
+                # Discard window: absorbs thread spin-up, stream setup, and
+                # first-transfer effects so no real window pays them.
+                serving_window(2.0)
+                for _ in range(n_windows):
+                    ips, lat = _pipelined_inprocess(
+                        dispatch, jax.device_get, payloads,
+                        seconds / n_windows, concurrency,
+                    )
+                    inproc_ips_list.append(ips)
+                    inprocess_lat.extend(lat)
+                    window = serving_window(seconds / n_windows)
+                    summary = window.summary()
+                    serve_ips = summary["throughput_infer_per_sec"]
+                    serve_ips_list.append(serve_ips)
+                    if ips:
+                        pair_ratios.append(serve_ips / ips)
+                    serve_lat_us.extend(
+                        [ns / 1000 for ns in window.latencies_ns]
+                    )
+                    errors += summary["errors"]
+            inprocess_lat.sort()
+            serve_lat_us.sort()
+            per_depth[concurrency] = {
+                "serving_infer_per_sec": round(median(serve_ips_list), 2),
+                "inprocess_infer_per_sec": round(median(inproc_ips_list), 2),
+                "ratio": round(
+                    median(pair_ratios) if pair_ratios else 0.0, 4
+                ),
+                "errors": errors,
+                "serving_p50_latency_ms": round(
+                    percentile(serve_lat_us, 50) / 1000, 2
+                ),
+                "serving_p99_latency_ms": round(
+                    percentile(serve_lat_us, 99) / 1000, 2
+                ),
+                "inprocess_p50_latency_ms": round(
+                    percentile(inprocess_lat, 50) * 1e3, 2
+                ),
+                "inprocess_p99_latency_ms": round(
+                    percentile(inprocess_lat, 99) * 1e3, 2
+                ),
+            }
+
+    # The gate is the WORST depth: every swept concurrency must clear the
+    # 0.90 serving/in-process target, not just the friendliest one.
+    worst_depth = min(per_depth, key=lambda d: per_depth[d]["ratio"])
+    worst = per_depth[worst_depth]
+    headline = per_depth[max(per_depth)]
     result = {
         "metric": f"{model_name}_b{batch}_grpc_stream_tpushm_infer_per_sec",
-        "value": round(client_ips, 2),
+        "value": headline["serving_infer_per_sec"],
         "unit": "infer/s",
-        "vs_baseline": round(ratio / 0.90, 4),
+        "vs_baseline": round(worst["ratio"] / 0.90, 4),
         "detail": {
-            "inprocess_infer_per_sec": round(inprocess_ips, 2),
-            "serving_vs_inprocess_ratio": round(ratio, 4),
-            "concurrency": concurrency,
+            "sweep": {str(d): per_depth[d] for d in per_depth},
+            "worst_depth": worst_depth,
+            "worst_ratio": worst["ratio"],
+            "headline_concurrency": max(per_depth),
             "shared_memory": shm_mode,
             "streaming": streaming,
-            "errors": errors,
-            "serving_p50_latency_ms": round(percentile(serve_lat_us, 50) / 1000, 2),
-            "serving_p99_latency_ms": round(percentile(serve_lat_us, 99) / 1000, 2),
-            "inprocess_p50_latency_ms": round(percentile(inprocess_lat, 50) * 1e3, 2),
-            "inprocess_p99_latency_ms": round(percentile(inprocess_lat, 99) * 1e3, 2),
             "platform": jax.devices()[0].platform,
         },
     }

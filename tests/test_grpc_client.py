@@ -10,10 +10,22 @@ import tritonclient_tpu.grpc as grpcclient
 from tritonclient_tpu.server import InferenceServer
 
 
-@pytest.fixture(scope="module")
-def server():
-    with InferenceServer(http=False) as s:
-        yield s
+@pytest.fixture(scope="module", params=["sync", "aio"])
+def server(request):
+    """Whole module runs against BOTH gRPC front-ends (thread-pool and
+    event-driven aio) — identical wire behavior is part of the contract."""
+    import os
+
+    old = os.environ.get("TPU_SERVER_GRPC_AIO")
+    os.environ["TPU_SERVER_GRPC_AIO"] = "1" if request.param == "aio" else "0"
+    try:
+        with InferenceServer(http=False) as s:
+            yield s
+    finally:
+        if old is None:
+            os.environ.pop("TPU_SERVER_GRPC_AIO", None)
+        else:
+            os.environ["TPU_SERVER_GRPC_AIO"] = old
 
 
 @pytest.fixture()

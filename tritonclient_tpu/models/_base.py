@@ -49,6 +49,10 @@ class Model:
     max_batch_size: int = 0  # 0 = no server-side batching dimension
     decoupled: bool = False
     stateful: bool = False
+    # True for models whose infer() blocks the calling thread (sleeps, IO).
+    # The event-driven gRPC front-end offloads these to an executor so they
+    # cannot stall unrelated streams; jit-dispatching models stay inline.
+    blocking: bool = False
     version: str = "1"
     labels: Optional[List[str]] = None  # classification label file equivalent
 

@@ -193,6 +193,7 @@ class SlowIdentityModel(Model):
     """
 
     name = "slow_identity"
+    blocking = True  # sleeps in infer(); must not stall the aio event loop
 
     def __init__(self):
         super().__init__()

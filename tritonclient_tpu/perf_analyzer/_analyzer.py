@@ -10,6 +10,7 @@ per-worker device-buffer regions so the sweep drives the server with
 on-HBM inputs/outputs over gRPC while only metadata crosses the wire.
 """
 
+import os
 import threading
 import time
 from typing import Dict, List, Optional
@@ -59,17 +60,108 @@ def _make_payload(rng, datatype: str, shape: List[int]) -> np.ndarray:
     return rng.integers(0, 64, shape).astype(np_dtype)
 
 
+class _StreamMux:
+    """One gRPC channel + bidi stream shared by every closed-loop worker.
+
+    Per-worker clients cost ~3 threads each (reader, channel spin, worker);
+    at depth 32 that is ~100 threads fighting for the GIL against the
+    in-process server. The mux keeps thread count O(1) in concurrency:
+    responses route back to workers by request id, and error responses
+    (which carry no id) attribute to the oldest in-flight request — the
+    server answers a stream strictly in request order.
+    """
+
+    def __init__(self, analyzer: "PerfAnalyzer"):
+        self.client = analyzer.make_client()
+        self._queues: Dict[str, object] = {}
+        self._inflight = []  # request ids in submission order
+        self._lock = threading.Lock()
+        self._started = False
+
+    def register(self, wid: int):
+        import queue
+
+        q = queue.Queue()
+        with self._lock:
+            self._queues[f"w{wid}"] = q
+        return q
+
+    def ensure_stream(self):
+        with self._lock:
+            if not self._started:
+                self.client.start_stream(callback=self._on_response)
+                self._started = True
+
+    def submit(self, rid: str, send):
+        """Atomically record the id and write to the stream (FIFO contract)."""
+        with self._lock:
+            self._inflight.append(rid)
+            try:
+                send()
+            except Exception:
+                self._inflight.pop()
+                raise
+
+    def _on_response(self, result, error):
+        if result is None and not self._stream_alive():
+            # Stream death is surfaced exactly once by the reader thread
+            # (_infer_stream.py); every blocked worker must hear about it
+            # or they stall out their 120 s timeouts inside the window.
+            with self._lock:
+                self._inflight.clear()
+                queues = list(self._queues.values())
+            for q in queues:
+                q.put((None, error))
+            return
+        with self._lock:
+            if result is not None:
+                rid = result.get_response().id
+                try:
+                    self._inflight.remove(rid)
+                except ValueError:
+                    pass
+            elif self._inflight:
+                # Per-request error responses carry no id: the stream
+                # answers in request order, so the oldest in-flight
+                # request is the one that failed.
+                rid = self._inflight.pop(0)
+            else:
+                return
+            q = self._queues.get(rid)
+        if q is not None:
+            q.put((result, error))
+
+    def _stream_alive(self) -> bool:
+        stream = getattr(self.client, "_stream", None)
+        return stream is not None and getattr(stream, "_active", True)
+
+    def close(self):
+        if self._started:
+            try:
+                self.client.stop_stream()
+            except Exception:
+                pass
+            self._started = False
+        try:
+            self.client.close()
+        except Exception:
+            pass
+
+
 class _Worker:
     """One closed-loop requester; owns its client(s) and shm regions."""
 
-    def __init__(self, analyzer: "PerfAnalyzer", wid: int):
+    def __init__(self, analyzer: "PerfAnalyzer", wid: int,
+                 mux: Optional[_StreamMux] = None):
         self.analyzer = analyzer
         self.wid = wid
+        self.mux = mux
         self.stat = InferStat()
         self.latencies: List[int] = []
         self.errors = 0
         self._stop = threading.Event()
         self._client = None
+        self._done = None  # streaming response queue (lives across windows)
         self._regions = []
         rng = np.random.default_rng(1234 + wid)
         self.payload_sets = [
@@ -84,7 +176,7 @@ class _Worker:
 
     def setup(self):
         a = self.analyzer
-        self._client = a.make_client()
+        self._client = self.mux.client if self.mux is not None else a.make_client()
         self._inputs = {}
         self._static_inputs = None
         mode = a.shared_memory
@@ -186,6 +278,10 @@ class _Worker:
             except Exception:
                 pass  # every cleanup step runs regardless of the others
 
+        if self._done is not None:
+            if self.mux is None:  # shared stream outlives workers (mux.close)
+                attempt(self._client.stop_stream)
+            self._done = None
         try:
             if a.shared_memory == "system" and self._client is not None:
                 attempt(self._client.unregister_system_shared_memory,
@@ -208,7 +304,7 @@ class _Worker:
                     attempt(self._tpushm.destroy_shared_memory_region,
                             self._out_region)
         finally:
-            if self._client is not None:
+            if self._client is not None and self.mux is None:
                 a.close_client(self._client)
 
     # -- request construction ------------------------------------------------
@@ -334,59 +430,85 @@ class _Worker:
             self.stat.update(timers)
             self.latencies.append(timers.total_ns)
 
-    def _run_streaming(self, end_time: float):
-        """Closed loop over a long-lived gRPC bidi stream."""
+    def _ensure_stream(self):
+        """Start the long-lived bidi stream once; survives across windows."""
         import queue
 
+        if self._done is None:
+            if self.mux is not None:
+                self._done = self.mux.register(self.wid)
+                self.mux.ensure_stream()
+            else:
+                self._done = queue.Queue()
+                self._client.start_stream(
+                    callback=lambda result, error: self._done.put((result, error))
+                )
+
+    def _run_streaming(self, end_time: float):
+        """Closed loop over a long-lived gRPC bidi stream."""
         a = self.analyzer
-        done: "queue.Queue" = queue.Queue()
-        self._client.start_stream(
-            callback=lambda result, error: done.put((result, error))
-        )
+        self._ensure_stream()
+        done = self._done
         outputs = self._build_outputs()
+        rid = f"w{self.wid}"
         prepared = None
         if self._static_inputs is not None:
             # Proto built once; only the region contents change per request
             # (C++ submessage-reuse parity, grpc_client.cc:1419).
             prepared = self._client.prepare_request(
-                a.model_name, self._static_inputs, outputs=outputs
+                a.model_name, self._static_inputs, outputs=outputs,
+                request_id=rid,
             )
         i = 0
-        try:
-            while time.perf_counter() < end_time and not self._stop.is_set():
-                payloads = self.payload_sets[i % _RANDOM_POOL]
-                i += 1
-                timers = RequestTimers()
-                timers.capture("request_start")
-                try:
-                    timers.capture("send_start")
-                    if prepared is not None:
-                        self._write_region(payloads)
-                        timers.capture("send_end")
-                        self._client.async_stream_infer(prepared_request=prepared)
+        while time.perf_counter() < end_time and not self._stop.is_set():
+            payloads = self.payload_sets[i % _RANDOM_POOL]
+            i += 1
+            timers = RequestTimers()
+            timers.capture("request_start")
+            try:
+                timers.capture("send_start")
+                if prepared is not None:
+                    self._write_region(payloads)
+                    timers.capture("send_end")
+                    if self.mux is not None:
+                        self.mux.submit(
+                            rid,
+                            lambda: self._client.async_stream_infer(
+                                prepared_request=prepared
+                            ),
+                        )
                     else:
-                        inputs = self._build_inputs(payloads)
-                        timers.capture("send_end")
+                        self._client.async_stream_infer(prepared_request=prepared)
+                else:
+                    inputs = self._build_inputs(payloads)
+                    timers.capture("send_end")
+                    if self.mux is not None:
+                        self.mux.submit(
+                            rid,
+                            lambda: self._client.async_stream_infer(
+                                a.model_name, inputs, outputs=outputs,
+                                request_id=rid,
+                            ),
+                        )
+                    else:
                         self._client.async_stream_infer(
                             a.model_name, inputs, outputs=outputs
                         )
-                    timers.capture("recv_start")
-                    result, error = done.get(timeout=120)
-                    if error is not None:
-                        timers.capture("recv_end")
-                        self.errors += 1
-                        continue
-                    if a.read_outputs:
-                        self._consume_outputs(result)
+                timers.capture("recv_start")
+                result, error = done.get(timeout=120)
+                if error is not None:
                     timers.capture("recv_end")
-                except Exception:
                     self.errors += 1
                     continue
-                timers.capture("request_end")
-                self.stat.update(timers)
-                self.latencies.append(timers.total_ns)
-        finally:
-            self._client.stop_stream()
+                if a.read_outputs:
+                    self._consume_outputs(result)
+                timers.capture("recv_end")
+            except Exception:
+                self.errors += 1
+                continue
+            timers.capture("request_end")
+            self.stat.update(timers)
+            self.latencies.append(timers.total_ns)
 
 
 class _WindowWorker:
@@ -627,6 +749,100 @@ class _WindowWorker:
                 self._client.stop_stream()
 
 
+class MeasurementSession:
+    """Closed-loop workers held ready across multiple measurement windows."""
+
+    def __init__(self, analyzer: "PerfAnalyzer", concurrency: int):
+        self.analyzer = analyzer
+        self.concurrency = concurrency
+        # Mux shards: one shared channel+stream per MUX_SHARD workers.
+        # A single stream serializes server-side handling and response
+        # order for every worker (head-of-line blocking at depth 32);
+        # per-worker channels burn ~3 threads each. ~8 workers/stream is
+        # the sweet spot (cf. the reference's channel share count of 6,
+        # grpc_client.cc:92-96).
+        self.muxes = []
+        if analyzer.streaming and analyzer.shared_stream:
+            shard = analyzer.mux_shard
+            self.muxes = [
+                _StreamMux(analyzer)
+                for _ in range((concurrency + shard - 1) // shard)
+            ]
+        self.workers = [
+            _Worker(
+                analyzer,
+                w,
+                mux=self.muxes[w // analyzer.mux_shard] if self.muxes else None,
+            )
+            for w in range(concurrency)
+        ]
+        self._started = []
+
+    def __enter__(self):
+        try:
+            for w in self.workers:
+                # Track before setup so a mid-setup failure still tears
+                # down whatever this worker managed to create/register.
+                self._started.append(w)
+                w.setup()
+        except Exception:
+            self.close()
+            raise
+        return self
+
+    def measure(self, interval_s: Optional[float] = None,
+                warmup_s: Optional[float] = None) -> MeasurementWindow:
+        a = self.analyzer
+        interval_s = a.measurement_interval_s if interval_s is None else interval_s
+        warmup_s = a.warmup_s if warmup_s is None else warmup_s
+        end = time.perf_counter() + warmup_s + interval_s
+        threads = [
+            threading.Thread(target=w.run, args=(end,), daemon=True)
+            for w in self.workers
+        ]
+        window_start = time.perf_counter() + warmup_s
+        for t in threads:
+            t.start()
+        # Discard warmup-period results by timestamping the cut.
+        time.sleep(warmup_s)
+        for w in self.workers:
+            w.latencies.clear()
+            w.stat = InferStat()
+            w.errors = 0
+        for t in threads:
+            t.join()
+        duration = time.perf_counter() - window_start
+        window = MeasurementWindow(
+            concurrency=self.concurrency, duration_s=duration
+        )
+        for w in self.workers:
+            window.latencies_ns.extend(w.latencies)
+            window.errors += w.errors
+            window.stat.completed_request_count += w.stat.completed_request_count
+            window.stat.cumulative_total_request_time_ns += (
+                w.stat.cumulative_total_request_time_ns
+            )
+            window.stat.cumulative_send_time_ns += w.stat.cumulative_send_time_ns
+            window.stat.cumulative_receive_time_ns += (
+                w.stat.cumulative_receive_time_ns
+            )
+        return window
+
+    def close(self):
+        for w in self._started:
+            try:
+                w.teardown()
+            except Exception:  # cleanup must reach every worker
+                pass
+        self._started = []
+        for mux in self.muxes:
+            mux.close()
+        self.muxes = []
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 class PerfAnalyzer:
     """Concurrency-sweep load generator against a KServe v2 server."""
 
@@ -647,6 +863,7 @@ class PerfAnalyzer:
         read_outputs: bool = False,
         device_id: int = 0,
         shm_mesh=None,
+        shared_stream: bool = True,
         verbose: bool = False,
     ):
         if protocol not in ("grpc", "http"):
@@ -666,6 +883,11 @@ class PerfAnalyzer:
         self.streaming = streaming
         self.measurement_interval_s = measurement_interval_s
         self.warmup_s = warmup_s
+        # Streaming workers share channels+streams by default (responses
+        # demuxed by request id, ~mux_shard workers per stream); per-worker
+        # channels are the reference client's model but cost ~3 threads each.
+        self.shared_stream = shared_stream
+        self.mux_shard = int(os.environ.get("PA_MUX_SHARD", "8"))
         self.read_outputs = read_outputs
         self.device_id = device_id
         # Optional jax.sharding.Mesh: tpu regions then span every mesh
@@ -788,53 +1010,22 @@ class PerfAnalyzer:
 
     # -- measurement ---------------------------------------------------------
 
+    def session(self, concurrency: int) -> "MeasurementSession":
+        """Persistent measurement session: workers, shm regions, and bidi
+        streams are set up ONCE and reused across measurement windows.
+
+        The per-window setup/teardown of ``measure()`` (N regions created,
+        registered, destroyed each call) is fine for one-shot sweeps but
+        dominates short windows at high concurrency; alternating-window
+        methodologies (bench.py) use a session per depth instead.
+        """
+        return MeasurementSession(self, concurrency)
+
     def measure(self, concurrency: int) -> MeasurementWindow:
         if self.async_window:
             return self._measure_window(concurrency)
-        workers = [_Worker(self, w) for w in range(concurrency)]
-        started = []
-        try:
-            for w in workers:
-                # Track before setup so a mid-setup failure still tears down
-                # whatever this worker managed to create/register.
-                started.append(w)
-                w.setup()
-            end = time.perf_counter() + self.warmup_s + self.measurement_interval_s
-            threads = [
-                threading.Thread(target=w.run, args=(end,), daemon=True)
-                for w in workers
-            ]
-            window_start = time.perf_counter() + self.warmup_s
-            for t in threads:
-                t.start()
-            # Discard warmup-period results by timestamping the cut.
-            time.sleep(self.warmup_s)
-            for w in workers:
-                w.latencies.clear()
-                w.stat = InferStat()
-                w.errors = 0
-            for t in threads:
-                t.join()
-            duration = time.perf_counter() - window_start
-            window = MeasurementWindow(concurrency=concurrency, duration_s=duration)
-            for w in workers:
-                window.latencies_ns.extend(w.latencies)
-                window.errors += w.errors
-                window.stat.completed_request_count += w.stat.completed_request_count
-                window.stat.cumulative_total_request_time_ns += (
-                    w.stat.cumulative_total_request_time_ns
-                )
-                window.stat.cumulative_send_time_ns += w.stat.cumulative_send_time_ns
-                window.stat.cumulative_receive_time_ns += (
-                    w.stat.cumulative_receive_time_ns
-                )
-            return window
-        finally:
-            for w in started:
-                try:
-                    w.teardown()
-                except Exception:  # cleanup must reach every worker
-                    pass
+        with self.session(concurrency) as session:
+            return session.measure()
 
     def _measure_window(self, concurrency: int) -> MeasurementWindow:
         worker = _WindowWorker(self, concurrency)

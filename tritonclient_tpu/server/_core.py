@@ -279,8 +279,20 @@ class TpuShmRegistry:
         This is the XLA-async equivalent of the reference's output-donation
         goal (SURVEY.md §7 hard part 2): the region table repoints at the
         result buffer, no copy and no sync on the response path.
+
+        The device->host copy is also *enqueued* here (async, non-blocking):
+        output regions exist to be read back, and enqueueing the transfer
+        back-to-back with the compute keeps the whole device chain in one
+        dispatch window — a reader's later materialization then waits on an
+        in-flight transfer instead of issuing a fresh one a network
+        round-trip later. Device-side consumers are unaffected (the parked
+        buffer stays on device; the async copy only warms the host path).
         """
         self.get_region(name).set_array(array, offset, block=False)
+        try:
+            array.copy_to_host_async()
+        except AttributeError:  # non-jax array (host data): nothing to warm
+            pass
 
 
 # --------------------------------------------------------------------------- #

@@ -403,53 +403,175 @@ class _Servicer:
     def ModelInfer(self, request, context):
         try:
             creq = request_to_core(request, self.core)
-            cresp = self.core.infer(creq)
-            if not isinstance(cresp, CoreResponse):
-                responses = list(cresp)
-                if len(responses) != 1:
-                    raise CoreError(
-                        "ModelInfer on a decoupled model must produce exactly "
-                        f"one response (got {len(responses)}); use ModelStreamInfer",
-                        400,
-                    )
-                cresp = responses[0]
-            return core_to_response(cresp)
+            return _finalize_unary(self.core.infer(creq))
         except CoreError as e:
             context.abort(_status_for(e), str(e))
 
     def ModelStreamInfer(self, request_iterator, context):
         for request in request_iterator:
-            want_final = False
-            p = request.parameters.get("triton_enable_empty_final_response")
-            if p is not None and p.WhichOneof("parameter_choice"):
-                want_final = bool(_param_value(p))
+            want_final = _want_final(request)
             try:
                 creq = request_to_core(request, self.core)
                 cresp = self.core.infer(creq)
-                if isinstance(cresp, CoreResponse):
-                    resp = core_to_response(cresp)
-                    if want_final:
-                        resp.parameters["triton_final_response"].bool_param = True
-                    yield pb.ModelStreamInferResponse(infer_response=resp)
-                else:
-                    for item in cresp:
-                        resp = core_to_response(item)
-                        if want_final:
-                            resp.parameters["triton_final_response"].bool_param = False
-                        yield pb.ModelStreamInferResponse(infer_response=resp)
-                    if want_final:
-                        final = pb.ModelInferResponse(
-                            model_name=request.model_name, id=request.id
-                        )
-                        final.parameters["triton_final_response"].bool_param = True
-                        yield pb.ModelStreamInferResponse(infer_response=final)
+                yield from _stream_responses(request, cresp, want_final)
             except CoreError as e:
-                err = pb.ModelStreamInferResponse(error_message=str(e))
-                yield err
+                yield pb.ModelStreamInferResponse(error_message=str(e))
+
+
+def _finalize_unary(cresp) -> pb.ModelInferResponse:
+    """Response shaping shared by the sync and aio unary handlers."""
+    if not isinstance(cresp, CoreResponse):
+        responses = list(cresp)
+        if len(responses) != 1:
+            raise CoreError(
+                "ModelInfer on a decoupled model must produce exactly "
+                f"one response (got {len(responses)}); use ModelStreamInfer",
+                400,
+            )
+        cresp = responses[0]
+    return core_to_response(cresp)
+
+
+def _want_final(request: pb.ModelInferRequest) -> bool:
+    p = request.parameters.get("triton_enable_empty_final_response")
+    if p is not None and p.WhichOneof("parameter_choice"):
+        return bool(_param_value(p))
+    return False
+
+
+def _stream_responses(request, cresp, want_final):
+    """Stream fan-out (decoupled + triton_final_response contract) shared
+    by the sync and aio stream handlers — one copy so the front-ends
+    cannot diverge."""
+    if isinstance(cresp, CoreResponse):
+        resp = core_to_response(cresp)
+        if want_final:
+            resp.parameters["triton_final_response"].bool_param = True
+        yield pb.ModelStreamInferResponse(infer_response=resp)
+    else:
+        for item in cresp:
+            resp = core_to_response(item)
+            if want_final:
+                resp.parameters["triton_final_response"].bool_param = False
+            yield pb.ModelStreamInferResponse(infer_response=resp)
+        if want_final:
+            final = pb.ModelInferResponse(
+                model_name=request.model_name, id=request.id
+            )
+            final.parameters["triton_final_response"].bool_param = True
+            yield pb.ModelStreamInferResponse(infer_response=final)
+
+
+class _AioAbort(Exception):
+    """Carries a sync servicer's context.abort out to the async wrapper."""
+
+    def __init__(self, code, details):
+        super().__init__(details)
+        self.code = code
+        self.details = details
+
+
+class _AbortShimContext:
+    """Duck-typed context for reusing the sync servicer under grpc.aio.
+
+    The sync servicer only ever calls ``context.abort``; in aio that is a
+    coroutine, so the shim raises instead and the async wrapper translates.
+    """
+
+    __slots__ = ()
+
+    def abort(self, code, details):
+        raise _AioAbort(code, details)
+
+
+_SHIM_CONTEXT = _AbortShimContext()
+
+
+class _AioServicer:
+    """Async adapter over ``_Servicer``: one event loop drives every RPC and
+    every bidi stream (the event-driven replacement for thread-per-stream).
+
+    Request handling never waits on the device — ``core.infer`` dispatches
+    the jit call asynchronously and shm outputs are parked un-materialized —
+    so multiplexing all streams onto one loop thread removes the per-stream
+    thread hand-offs and the sync server's condition-variable machinery
+    (the reference's analog is the gRPC completion-queue architecture,
+    grpc_client.cc:1582-1628, applied server-side). Models that *do* block
+    (``model.blocking``) are offloaded to a small executor so they cannot
+    stall unrelated streams.
+    """
+
+    def __init__(self, core: InferenceCore):
+        self.core = core
+        self._sync = _Servicer(core)
+        self._executor = futures.ThreadPoolExecutor(max_workers=8)
+        for name in (
+            "ServerLive", "ServerReady", "ModelReady", "ServerMetadata",
+            "ModelMetadata", "ModelConfig", "ModelStatistics",
+            "RepositoryIndex", "RepositoryModelLoad", "RepositoryModelUnload",
+            "SystemSharedMemoryStatus", "SystemSharedMemoryRegister",
+            "SystemSharedMemoryUnregister", "CudaSharedMemoryStatus",
+            "CudaSharedMemoryRegister", "CudaSharedMemoryUnregister",
+            "TpuSharedMemoryStatus", "TpuSharedMemoryRegister",
+            "TpuSharedMemoryUnregister", "TraceSetting", "LogSettings",
+        ):
+            setattr(self, name, self._wrap_unary(getattr(self._sync, name)))
+
+    @staticmethod
+    def _wrap_unary(fn):
+        async def handler(request, context):
+            try:
+                return fn(request, _SHIM_CONTEXT)
+            except _AioAbort as e:
+                await context.abort(e.code, e.details)
+
+        return handler
+
+    def _is_blocking(self, model_name: str) -> bool:
+        model = self.core._repository.get(model_name)
+        return bool(getattr(model, "blocking", False))
+
+    async def _infer(self, creq):
+        if self._is_blocking(creq.model_name):
+            import asyncio
+
+            return await asyncio.get_running_loop().run_in_executor(
+                self._executor, self.core.infer, creq
+            )
+        return self.core.infer(creq)
+
+    async def ModelInfer(self, request, context):
+        try:
+            creq = request_to_core(request, self.core)
+            return _finalize_unary(await self._infer(creq))
+        except CoreError as e:
+            await context.abort(_status_for(e), str(e))
+
+    async def ModelStreamInfer(self, request_iterator, context):
+        async for request in request_iterator:
+            want_final = _want_final(request)
+            try:
+                creq = request_to_core(request, self.core)
+                cresp = await self._infer(creq)
+                for resp in _stream_responses(request, cresp, want_final):
+                    yield resp
+            except CoreError as e:
+                yield pb.ModelStreamInferResponse(error_message=str(e))
+
+    def close(self):
+        self._executor.shutdown(wait=False)
 
 
 class GRPCFrontend:
-    """grpc.server hosting an InferenceCore."""
+    """gRPC front-end hosting an InferenceCore.
+
+    Two interchangeable transports with identical wire behavior (asserted
+    by the parametrized client tests): the default thread-pool server, and
+    the event-driven ``grpc.aio`` server (``aio=True`` or
+    ``TPU_SERVER_GRPC_AIO=1``) where every RPC and bidi stream multiplexes
+    onto one event-loop thread run in a daemon thread so the public
+    start/stop API stays synchronous.
+    """
 
     def __init__(
         self,
@@ -457,28 +579,95 @@ class GRPCFrontend:
         host: str = "127.0.0.1",
         port: int = 0,
         max_workers: int = 80,
+        aio: Optional[bool] = None,
     ):
-        # Each long-lived bidi stream pins one pool thread for its whole
-        # lifetime, so the pool must exceed the expected stream count or
-        # every other RPC (and further streams) starves behind them.
-        self._server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=max_workers),
-            options=[
-                ("grpc.max_send_message_length", _MAX_MESSAGE_LENGTH),
-                ("grpc.max_receive_message_length", _MAX_MESSAGE_LENGTH),
-            ],
-        )
-        self._server.add_generic_rpc_handlers([make_service_handler(_Servicer(core))])
-        self._port = self._server.add_insecure_port(f"{host}:{port}")
+        if aio is None:
+            # Thread-pool frontend by default: at high stream counts the
+            # single aio loop trades head-of-line latency for thread cost
+            # and A/Bs slightly behind on the depth-32 gate; the
+            # event-driven loop remains selectable (TPU_SERVER_GRPC_AIO=1).
+            import os
+
+            aio = os.environ.get("TPU_SERVER_GRPC_AIO", "0") == "1"
+        self._aio = aio
         self._host = host
+        if not aio:
+            # Each long-lived bidi stream pins one pool thread for its whole
+            # lifetime, so the pool must exceed the expected stream count or
+            # every other RPC (and further streams) starves behind them.
+            self._server = grpc.server(
+                futures.ThreadPoolExecutor(max_workers=max_workers),
+                options=[
+                    ("grpc.max_send_message_length", _MAX_MESSAGE_LENGTH),
+                    ("grpc.max_receive_message_length", _MAX_MESSAGE_LENGTH),
+                ],
+            )
+            self._server.add_generic_rpc_handlers(
+                [make_service_handler(_Servicer(core))]
+            )
+            self._port = self._server.add_insecure_port(f"{host}:{port}")
+            return
+
+        import asyncio
+        import threading
+
+        self._loop = asyncio.new_event_loop()
+        self._loop_thread = threading.Thread(
+            target=self._loop.run_forever, name="grpc-aio-frontend", daemon=True
+        )
+        self._loop_thread.start()
+        self._servicer = _AioServicer(core)
+
+        def _build():
+            server = grpc.aio.server(
+                options=[
+                    ("grpc.max_send_message_length", _MAX_MESSAGE_LENGTH),
+                    ("grpc.max_receive_message_length", _MAX_MESSAGE_LENGTH),
+                ]
+            )
+            server.add_generic_rpc_handlers(
+                [make_service_handler(self._servicer)]
+            )
+            port = server.add_insecure_port(f"{host}:{port_arg}")
+            return server, port
+
+        port_arg = port
+        # The aio server object must be created on its serving loop.
+        fut = asyncio.run_coroutine_threadsafe(_acall(_build), self._loop)
+        self._server, self._port = fut.result(timeout=30)
 
     @property
     def address(self) -> str:
         return f"{self._host}:{self._port}"
 
     def start(self):
-        self._server.start()
+        if not self._aio:
+            self._server.start()
+            return self
+        import asyncio
+
+        asyncio.run_coroutine_threadsafe(
+            self._server.start(), self._loop
+        ).result(timeout=30)
         return self
 
     def stop(self, grace: Optional[float] = 0.5):
-        self._server.stop(grace)
+        if not self._aio:
+            self._server.stop(grace)
+            return
+        import asyncio
+
+        try:
+            asyncio.run_coroutine_threadsafe(
+                self._server.stop(grace), self._loop
+            ).result(timeout=30)
+        finally:
+            self._servicer.close()
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._loop_thread.join(timeout=5)
+            if not self._loop_thread.is_alive():
+                self._loop.close()  # releases the selector/self-pipe fds
+
+
+async def _acall(fn):
+    return fn()

@@ -142,7 +142,11 @@ class TpuSharedMemoryRegion:
         """A jax.Array view of the region contents at ``offset``.
 
         Zero-copy when a parked array matches dtype/shape; otherwise
-        materializes from the byte mirror.
+        materializes from the byte mirror — on the CALLING thread, which
+        for a co-located server means the upload is enqueued back-to-back
+        with the compute that consumes it (one enqueuing thread per device
+        chain; see set_shared_memory_region). The materialized array is
+        parked so repeated consumers pay the upload once.
         """
         jax = _jax()
         shape = tuple(int(s) for s in shape)
@@ -158,7 +162,34 @@ class TpuSharedMemoryRegion:
         host = np.frombuffer(
             self.read_bytes(offset, nbytes), dtype=np_dtype
         ).reshape(shape)
-        return jax.device_put(host, self.device)
+        arr = jax.device_put(host, self.device)
+        with self._lock:
+            self._drop_overlapping(offset, nbytes)
+            self._parked[offset] = arr
+        return arr
+
+    def read_typed(self, datatype: str, shape: Sequence[int],
+                   offset: int = 0) -> np.ndarray:
+        """Host-side typed read: parked device data or mirror bytes.
+
+        Unlike ``as_array`` this never uploads — host readers of
+        host-staged data stay entirely on the host.
+        """
+        shape = tuple(int(s) for s in shape)
+        np_dtype = _np_dtype_for(datatype)
+        nbytes = int(np.prod(shape)) * np_dtype.itemsize
+        self._check_range(offset, nbytes)
+        with self._lock:
+            parked = self._parked.get(offset)
+            keep = parked is not None and parked.nbytes == nbytes
+        if keep:
+            host = np.asarray(parked)
+            if host.dtype != np_dtype or host.shape != shape:
+                host = host.view(np_dtype).reshape(shape)
+            return host
+        return np.frombuffer(
+            self.read_bytes(offset, nbytes), dtype=np_dtype
+        ).reshape(shape)
 
     # -- raw byte plane ------------------------------------------------------
 
@@ -259,7 +290,11 @@ class TpuShardedMemoryRegion(TpuSharedMemoryRegion):
         host = np.frombuffer(
             self.read_bytes(offset, nbytes), dtype=np_dtype
         ).reshape(shape)
-        return jax.device_put(host, self.sharding)
+        arr = jax.device_put(host, self.sharding)
+        with self._lock:
+            self._drop_overlapping(offset, nbytes)
+            self._parked[offset] = arr
+        return arr
 
     def __repr__(self):
         return (
@@ -337,13 +372,22 @@ def set_shared_memory_region(
     shm_handle: TpuSharedMemoryRegion, input_values, offset: int = 0,
     block: bool = True,
 ):
-    """Copy numpy arrays into the region (host -> device transfer).
+    """Stage host arrays into the region (upload happens at first consume).
 
-    ``block=False`` returns once the upload is *dispatched* rather than
-    committed. Within one process the PjRt runtime orders consumers after
-    the upload automatically, so a co-located server sees the data; the
-    blocking default matches the reference's stream-sync-at-set contract
-    for callers that share the region out-of-band.
+    Host producers write the region's host mirror (a memcpy); the device
+    upload is performed by the first device-side consumer (``as_array``),
+    which enqueues it back-to-back with whatever it dispatches next. On a
+    co-located server this keeps every device op of a request chain
+    (upload -> execute -> readback) on ONE enqueuing thread — the ordering
+    the device pipeline schedules best — instead of splitting the chain
+    between producer and consumer threads. Device-array producers that
+    want a true zero-copy park use ``set_shared_memory_region_from_dlpack``
+    (no host staging at all).
+
+    ``block`` is accepted for API compatibility with the reference's
+    stream-sync-at-set contract (cuda_shared_memory/__init__.py:62-70);
+    the mirror write is synchronous either way, so the data is always
+    visible to consumers when this returns.
     """
     if not isinstance(input_values, (list, tuple)):
         raise TpuSharedMemoryException(
@@ -372,7 +416,7 @@ def set_shared_memory_region(
             cursor += len(data)
         else:
             arr = np.ascontiguousarray(arr)
-            shm_handle.set_array(arr, cursor, block=block)
+            shm_handle.write_bytes(cursor, arr.tobytes())
             cursor += arr.nbytes
 
 
@@ -421,8 +465,7 @@ def get_contents_as_numpy(
         raw = shm_handle.read_bytes(offset, shm_handle.byte_size - offset)
         count = int(np.prod(shape))
         return decode_bytes_elements(raw, count).reshape(shape)
-    arr = shm_handle.as_array(datatype, shape, offset)
-    out = np.asarray(arr)
+    out = shm_handle.read_typed(datatype, shape, offset)
     if datatype == "BF16":
         # numpy has no bf16; hand back float32 like the reference's
         # triton_to_np_dtype BF16 shim (utils/__init__.py:184).
