@@ -238,21 +238,34 @@ int main(int argc, char** argv) {
            "gzip-resp sum value");
   }
 
-  // TLS is a build option: without TPU_CLIENT_ENABLE_TLS, https must fail
-  // with a clear error, never silently downgrade
+  // TLS must never silently downgrade: in TLS builds, https against this
+  // PLAINTEXT server must fail at the handshake (the positive round trip
+  // lives in tls_test.cc against a TLS server); in TLS-less builds the
+  // Create itself refuses with a clear error.
   {
     std::unique_ptr<InferenceServerHttpClient> tls_client;
     Error terr = InferenceServerHttpClient::Create(
         &tls_client, std::string("https://") + argv[1]);
-    EXPECT(!terr.IsOk() &&
-               terr.Message().find("without TLS support") != std::string::npos,
-           "https refused without TLS build");
+    if (terr.IsOk()) {
+      bool live = false;
+      Error lerr = tls_client->IsServerLive(&live);
+      EXPECT(!lerr.IsOk(), "https to plaintext server must fail");
+    } else {
+      EXPECT(terr.Message().find("without TLS support") != std::string::npos,
+             "https refused with a clear error in TLS-less build");
+    }
     HttpSslOptions ssl;
     ssl.ca_info = "/nonexistent/ca.pem";
     terr = InferenceServerHttpClient::Create(&tls_client, argv[1], ssl);
-    EXPECT(!terr.IsOk() &&
-               terr.Message().find("without TLS support") != std::string::npos,
-           "ssl options refused without TLS build");
+    if (terr.IsOk()) {
+      bool live = false;
+      Error lerr = tls_client->IsServerLive(&live);
+      EXPECT(!lerr.IsOk() && lerr.Message().find("CA") != std::string::npos,
+             "nonexistent CA bundle must fail to load");
+    } else {
+      EXPECT(terr.Message().find("without TLS support") != std::string::npos,
+             "ssl options refused with a clear error in TLS-less build");
+    }
   }
 
   // trace/log settings

@@ -40,16 +40,30 @@ int MaxShareCount() {
   return 6;
 }
 
+// TLS channels never share a cache slot with plaintext ones to the same
+// authority — nor with TLS channels holding DIFFERENT trust settings
+// (reusing a channel handshaked under another client's CA/identity would be
+// a silent security downgrade), so the full config is in the key.
+std::string ChannelCacheKey(const std::string& url, const TlsConfig* tls_cfg) {
+  if (tls_cfg == nullptr) return url;
+  return "tls://" + url + "|ca=" + tls_cfg->ca_path +
+         "|cert=" + tls_cfg->cert_path + "|key=" + tls_cfg->key_path +
+         "|vp=" + (tls_cfg->verify_peer ? "1" : "0") +
+         "|vh=" + (tls_cfg->verify_host ? "1" : "0");
+}
+
 Error GetConnection(const std::string& url,
-                    std::shared_ptr<h2::Connection>* conn) {
+                    std::shared_ptr<h2::Connection>* conn,
+                    const TlsConfig* tls_cfg = nullptr) {
   std::string host;
   int port;
   Error parse_err = ParseHostPort(url, 8001, &host, &port);
   if (!parse_err.IsOk()) return parse_err;
 
+  const std::string cache_key = ChannelCacheKey(url, tls_cfg);
   {
     std::lock_guard<std::mutex> lk(ChannelMapMu());
-    auto it = ChannelMap().find(url);
+    auto it = ChannelMap().find(cache_key);
     if (it != ChannelMap().end() && it->second.conn != nullptr &&
         it->second.conn->Connected() &&
         it->second.share_count < MaxShareCount()) {
@@ -61,10 +75,11 @@ Error GetConnection(const std::string& url,
   // Dial OUTSIDE the map lock: a slow/blackholed host must not stall every
   // other Create() in the process.
   auto fresh = std::make_shared<h2::Connection>();
+  if (tls_cfg != nullptr) fresh->EnableTls(*tls_cfg);
   Error err = fresh->Connect(host, port);
   if (!err.IsOk()) return err;
   std::lock_guard<std::mutex> lk(ChannelMapMu());
-  auto& entry = ChannelMap()[url];
+  auto& entry = ChannelMap()[cache_key];
   if (entry.conn != nullptr && entry.conn->Connected() &&
       entry.share_count < MaxShareCount()) {
     // Lost the race to another dialer; share theirs.
@@ -227,14 +242,27 @@ Error InferenceServerGrpcClient::Create(
     bool use_ssl, const SslOptions& ssl_options, bool verbose) {
   if (!use_ssl) return Create(client, url, verbose);
 #ifdef TPU_CLIENT_ENABLE_TLS
-  (void)ssl_options;
-  return Error("TLS channel setup not implemented for this transport yet");
+  std::string why;
+  if (!TlsSession::Available(&why)) return Error(why);
+  TlsConfig cfg;
+  cfg.verify_peer = true;  // gRPC SSL channels always verify (reference
+  cfg.verify_host = true;  // grpc_client.cc:65-77 SslCredentials semantics)
+  cfg.ca_path = ssl_options.root_certificates;
+  cfg.key_path = ssl_options.private_key;
+  cfg.cert_path = ssl_options.certificate_chain;
+  std::shared_ptr<h2::Connection> conn;
+  Error err = GetConnection(url, &conn, &cfg);
+  if (!err.IsOk()) return err;
+  client->reset(new InferenceServerGrpcClient(conn, verbose));
+  // Release must hit the exact TLS cache slot.
+  (*client)->url_ = ChannelCacheKey(url, &cfg);
+  return Error::Success;
 #else
   (void)ssl_options;
   (void)client;
   return Error(
       "client built without TLS support; rebuild with TPU_CLIENT_ENABLE_TLS "
-      "and an OpenSSL dev stack to use SslOptions");
+      "to use SslOptions");
 #endif
 }
 

@@ -200,6 +200,12 @@ Error Connection::SetTcpKeepAlive(int idle_sec, int interval_sec) {
   return Error::Success;
 }
 
+void Connection::EnableTls(const TlsConfig& cfg) {
+  use_tls_ = true;
+  tls_cfg_ = cfg;
+  tls_cfg_.alpn_h2 = true;
+}
+
 Error Connection::Connect(const std::string& host, int port) {
   Close();
   struct addrinfo hints = {};
@@ -242,6 +248,15 @@ Error Connection::Connect(const std::string& host, int port) {
   }
   freeaddrinfo(res);
   if (!err.IsOk()) return err;
+  if (use_tls_) {
+    if (tls_cfg_.server_name.empty()) tls_cfg_.server_name = host;
+    err = tls_.Handshake(fd_, tls_cfg_);
+    if (!err.IsOk()) {
+      close(fd_);
+      fd_ = -1;
+      return err;
+    }
+  }
   authority_ = host + ":" + port_str;
   dead_ = false;
   reader_exit_ = false;
@@ -272,6 +287,7 @@ void Connection::Close() {
     }
   }
   if (reader_.joinable()) reader_.join();
+  tls_.Close();  // after reader join: the reader thread reads via tls_
   {
     std::lock_guard<std::mutex> lk(mu_);
     if (fd_ >= 0) {
@@ -304,7 +320,7 @@ Error Connection::Handshake() {
   const char* p = out.data();
   size_t n = out.size();
   while (n > 0) {
-    ssize_t w = send(fd_, p, n, MSG_NOSIGNAL);
+    ssize_t w = tls_.Active() ? tls_.Send(p, n) : send(fd_, p, n, MSG_NOSIGNAL);
     if (w <= 0) return Error("h2 handshake write failed");
     p += w;
     n -= static_cast<size_t>(w);
@@ -341,7 +357,8 @@ Error Connection::WriteFrameLocked(uint8_t type, uint8_t flags,
     const char* p = part.p;
     size_t n = part.n;
     while (n > 0) {
-      ssize_t w = send(fd_, p, n, MSG_NOSIGNAL);
+      ssize_t w =
+          tls_.Active() ? tls_.Send(p, n) : send(fd_, p, n, MSG_NOSIGNAL);
       if (w <= 0) return Error("h2 write failed");
       p += w;
       n -= static_cast<size_t>(w);
@@ -555,7 +572,8 @@ void Connection::ReaderLoop() {
       buf.erase(0, 9 + len);
       HandleFrame(type, flags, sid, payload);
     }
-    ssize_t n = recv(fd_, chunk, sizeof(chunk), 0);
+    ssize_t n = tls_.Active() ? tls_.Recv(chunk, sizeof(chunk))
+                              : recv(fd_, chunk, sizeof(chunk), 0);
     if (n <= 0) {
       FailAll(n == 0 ? "h2 connection closed by peer" : "h2 read error");
       return;

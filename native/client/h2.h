@@ -25,6 +25,7 @@
 #include <vector>
 
 #include "common.h"
+#include "tls.h"
 
 namespace tputriton {
 namespace h2 {
@@ -47,6 +48,11 @@ class Connection {
  public:
   Connection() = default;
   ~Connection();
+
+  // Arm TLS for the NEXT Connect(): the handshake runs right after the TCP
+  // connect, before the h2 preface. cfg.server_name defaults to the host;
+  // ALPN "h2" is always offered (gRPC-over-TLS requires it).
+  void EnableTls(const TlsConfig& cfg);
 
   Error Connect(const std::string& host, int port);
   bool Connected();
@@ -99,6 +105,9 @@ class Connection {
   std::shared_ptr<StreamState> GetStream(int32_t id);
 
   int fd_ = -1;
+  bool use_tls_ = false;
+  TlsConfig tls_cfg_;
+  TlsSession tls_;
   std::string authority_;
   std::mutex write_mu_;
   std::mutex mu_;  // guards streams_, windows, last_error_

@@ -1,5 +1,7 @@
 #include "http_client.h"
 
+#include "tls.h"
+
 #include <arpa/inet.h>
 #include <netdb.h>
 #include <netinet/in.h>
@@ -131,6 +133,8 @@ class HttpConnection {
  public:
   HttpConnection(const std::string& host, int port)
       : host_(host), port_(port) {}
+  HttpConnection(const std::string& host, int port, const TlsConfig& tls_cfg)
+      : host_(host), port_(port), use_tls_(true), tls_cfg_(tls_cfg) {}
   ~HttpConnection() { Close(); }
 
   Error Connect() {
@@ -158,6 +162,10 @@ class HttpConnection {
       fd_ = -1;
     }
     freeaddrinfo(res);
+    if (err.IsOk() && use_tls_) {
+      err = tls_.Handshake(fd_, tls_cfg_);
+      if (!err.IsOk()) Close();
+    }
     return err;
   }
 
@@ -205,12 +213,13 @@ class HttpConnection {
 
   Error RecvSome(char* buf, size_t cap, ssize_t* n, const char* where) {
     if (!ArmDeadline()) return Error(std::string("socket read timed out ") + where);
-    *n = recv(fd_, buf, cap, 0);
+    *n = tls_.Active() ? tls_.Recv(buf, cap) : recv(fd_, buf, cap, 0);
     if (*n <= 0) return RecvError(*n, where);
     return Error::Success;
   }
 
   void Close() {
+    tls_.Close();
     if (fd_ >= 0) {
       close(fd_);
       fd_ = -1;
@@ -220,7 +229,8 @@ class HttpConnection {
   Error WriteAll(const void* data, size_t nbytes) {
     const char* p = static_cast<const char*>(data);
     while (nbytes > 0) {
-      ssize_t n = send(fd_, p, nbytes, MSG_NOSIGNAL);
+      ssize_t n = tls_.Active() ? tls_.Send(p, nbytes)
+                                : send(fd_, p, nbytes, MSG_NOSIGNAL);
       if (n <= 0) return Error("socket write failed");
       p += n;
       nbytes -= static_cast<size_t>(n);
@@ -372,6 +382,9 @@ class HttpConnection {
   std::string host_;
   int port_;
   int fd_ = -1;
+  bool use_tls_ = false;
+  TlsConfig tls_cfg_;
+  TlsSession tls_;
 };
 
 // --------------------------------------------------------------------------
@@ -400,14 +413,8 @@ Error InferenceServerHttpClient::Create(
     std::unique_ptr<InferenceServerHttpClient>* client, const std::string& url,
     bool verbose) {
   if (url.rfind("https://", 0) == 0) {
-#ifdef TPU_CLIENT_ENABLE_TLS
-    // Never hand back a plaintext client for an https URL.
-    return Error("TLS connection setup not implemented for this transport yet");
-#else
-    return Error(
-        "client built without TLS support; rebuild with "
-        "TPU_CLIENT_ENABLE_TLS and an OpenSSL dev stack to use https URLs");
-#endif
+    // Default-verifying TLS for bare https URLs (reference: curl defaults).
+    return Create(client, url, HttpSslOptions(), verbose);
   }
   if (url.find("://") != std::string::npos) {
     return Error("url should not include the scheme (got '" + url + "')");
@@ -420,12 +427,23 @@ Error InferenceServerHttpClient::Create(
     std::unique_ptr<InferenceServerHttpClient>* client, const std::string& url,
     const HttpSslOptions& ssl_options, bool verbose) {
 #ifdef TPU_CLIENT_ENABLE_TLS
-  (void)ssl_options;
-  (void)url;
-  (void)verbose;
-  (void)client;
-  // Never hand back a plaintext client when TLS options were requested.
-  return Error("TLS connection setup not implemented for this transport yet");
+  std::string why;
+  if (!TlsSession::Available(&why)) {
+    // Never hand back a plaintext client when TLS was requested.
+    return Error(why);
+  }
+  std::string bare = url;
+  if (bare.rfind("https://", 0) == 0) bare = bare.substr(8);
+  if (bare.find("://") != std::string::npos) {
+    return Error("TLS client URL must be https:// or bare host:port (got '" +
+                 url + "')");
+  }
+  std::string host;
+  int port;
+  Error parse_err = ParseHostPort(bare, 443, &host, &port);
+  if (!parse_err.IsOk()) return parse_err;
+  client->reset(new InferenceServerHttpClient(url, ssl_options, verbose));
+  return Error::Success;
 #else
   (void)ssl_options;
   (void)url;
@@ -433,7 +451,7 @@ Error InferenceServerHttpClient::Create(
   (void)client;
   return Error(
       "client built without TLS support; rebuild with TPU_CLIENT_ENABLE_TLS "
-      "and an OpenSSL dev stack to use HttpSslOptions");
+      "to use https URLs / HttpSslOptions");
 #endif
 }
 
@@ -442,6 +460,25 @@ InferenceServerHttpClient::InferenceServerHttpClient(const std::string& url,
     : verbose_(verbose) {
   ParseHostPort(url, 80, &host_, &port_);  // scheme pre-checked in Create
   conn_.reset(new HttpConnection(host_, port_));
+  worker_ = std::thread(&InferenceServerHttpClient::AsyncWorker, this);
+}
+
+InferenceServerHttpClient::InferenceServerHttpClient(
+    const std::string& url, const HttpSslOptions& ssl_options, bool verbose)
+    : verbose_(verbose) {
+  std::string bare = url;
+  if (bare.rfind("https://", 0) == 0) bare = bare.substr(8);
+  ParseHostPort(bare, 443, &host_, &port_);  // pre-validated in Create
+  TlsConfig cfg;
+  cfg.verify_peer = ssl_options.verify_peer;
+  cfg.verify_host = ssl_options.verify_host;
+  cfg.ca_path = ssl_options.ca_info;
+  cfg.cert_path = ssl_options.cert;
+  cfg.cert_pem = ssl_options.cert_type == HttpSslOptions::CERTTYPE::CERT_PEM;
+  cfg.key_path = ssl_options.key;
+  cfg.key_pem = ssl_options.key_type == HttpSslOptions::KEYTYPE::KEY_PEM;
+  cfg.server_name = host_;
+  conn_.reset(new HttpConnection(host_, port_, cfg));
   worker_ = std::thread(&InferenceServerHttpClient::AsyncWorker, this);
 }
 

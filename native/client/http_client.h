@@ -123,6 +123,8 @@ class InferenceServerHttpClient {
 
  private:
   InferenceServerHttpClient(const std::string& url, bool verbose);
+  InferenceServerHttpClient(const std::string& url,
+                            const HttpSslOptions& ssl_options, bool verbose);
 
   Error BuildInferJson(const InferOptions& options,
                        const std::vector<InferInput*>& inputs,

@@ -1,0 +1,353 @@
+// System-libssl-backed TLS sessions (see tls.h for the design rationale).
+//
+// The declarations below are the stable public OpenSSL 1.1/3.x C ABI for
+// exactly the entry points used; they are bound from the dlopen'd system
+// libraries, never from headers.
+
+#include "tls.h"
+
+#include <dlfcn.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <poll.h>
+#include <signal.h>
+#include <sys/socket.h>
+
+#include <mutex>
+#include <type_traits>
+
+namespace tputriton {
+
+namespace {
+
+// -- minimal OpenSSL ABI ----------------------------------------------------
+
+constexpr int kSslFiletypePem = 1;   // SSL_FILETYPE_PEM
+constexpr int kSslFiletypeDer = 2;   // SSL_FILETYPE_ASN1
+constexpr int kSslVerifyNone = 0;    // SSL_VERIFY_NONE
+constexpr int kSslVerifyPeer = 1;    // SSL_VERIFY_PEER
+constexpr int kSslErrorWantRead = 2;   // SSL_ERROR_WANT_READ
+constexpr int kSslErrorWantWrite = 3;  // SSL_ERROR_WANT_WRITE
+constexpr int kSslErrorZeroReturn = 6;
+constexpr int kSslErrorSyscall = 5;
+constexpr long kSslCtrlSetTlsextHostname = 55;  // SSL_CTRL_SET_TLSEXT_HOSTNAME
+constexpr long kTlsextNametypeHostName = 0;
+
+struct SslApi {
+  void* (*TLS_client_method)();
+  void* (*SSL_CTX_new)(void*);
+  void (*SSL_CTX_free)(void*);
+  void (*SSL_CTX_set_verify)(void*, int, void*);
+  int (*SSL_CTX_set_default_verify_paths)(void*);
+  int (*SSL_CTX_load_verify_locations)(void*, const char*, const char*);
+  int (*SSL_CTX_use_certificate_file)(void*, const char*, int);
+  int (*SSL_CTX_use_PrivateKey_file)(void*, const char*, int);
+  void* (*SSL_new)(void*);
+  void (*SSL_free)(void*);
+  int (*SSL_set_fd)(void*, int);
+  int (*SSL_connect)(void*);
+  int (*SSL_read)(void*, void*, int);
+  int (*SSL_write)(void*, const void*, int);
+  int (*SSL_shutdown)(void*);
+  int (*SSL_get_error)(const void*, int);
+  long (*SSL_ctrl)(void*, int, long, void*);
+  int (*SSL_set_alpn_protos)(void*, const unsigned char*, unsigned);
+  void* (*SSL_get0_param)(void*);
+  // libcrypto
+  int (*X509_VERIFY_PARAM_set1_host)(void*, const char*, size_t);
+  int (*X509_VERIFY_PARAM_set1_ip_asc)(void*, const char*);
+  unsigned long (*ERR_get_error)();
+  void (*ERR_error_string_n)(unsigned long, char*, size_t);
+
+  bool ok = false;
+  std::string why;
+};
+
+SslApi* LoadSslApi() {
+  static SslApi api;
+  static std::once_flag once;
+  std::call_once(once, [] {
+    const char* ssl_names[] = {"libssl.so.3", "libssl.so.1.1", "libssl.so"};
+    const char* crypto_names[] = {"libcrypto.so.3", "libcrypto.so.1.1",
+                                  "libcrypto.so"};
+    void* ssl = nullptr;
+    for (const char* name : ssl_names) {
+      ssl = dlopen(name, RTLD_NOW | RTLD_LOCAL);
+      if (ssl != nullptr) break;
+    }
+    void* crypto = nullptr;
+    for (const char* name : crypto_names) {
+      crypto = dlopen(name, RTLD_NOW | RTLD_LOCAL);
+      if (crypto != nullptr) break;
+    }
+    if (ssl == nullptr || crypto == nullptr) {
+      api.why =
+          "system libssl/libcrypto not found; install OpenSSL runtime "
+          "libraries to use TLS";
+      return;
+    }
+    bool all = true;
+    auto bind = [&](void* lib, const char* name, auto** slot,
+                    bool required = true) {
+      *slot = reinterpret_cast<std::remove_reference_t<decltype(*slot)>>(
+          dlsym(lib, name));
+      if (*slot == nullptr && required) {
+        all = false;
+        if (api.why.empty()) {
+          api.why = std::string("symbol '") + name + "' missing from libssl";
+        }
+      }
+    };
+    bind(ssl, "TLS_client_method", &api.TLS_client_method);
+    bind(ssl, "SSL_CTX_new", &api.SSL_CTX_new);
+    bind(ssl, "SSL_CTX_free", &api.SSL_CTX_free);
+    bind(ssl, "SSL_CTX_set_verify", &api.SSL_CTX_set_verify);
+    bind(ssl, "SSL_CTX_set_default_verify_paths",
+         &api.SSL_CTX_set_default_verify_paths);
+    bind(ssl, "SSL_CTX_load_verify_locations",
+         &api.SSL_CTX_load_verify_locations);
+    bind(ssl, "SSL_CTX_use_certificate_file",
+         &api.SSL_CTX_use_certificate_file);
+    bind(ssl, "SSL_CTX_use_PrivateKey_file",
+         &api.SSL_CTX_use_PrivateKey_file);
+    bind(ssl, "SSL_new", &api.SSL_new);
+    bind(ssl, "SSL_free", &api.SSL_free);
+    bind(ssl, "SSL_set_fd", &api.SSL_set_fd);
+    bind(ssl, "SSL_connect", &api.SSL_connect);
+    bind(ssl, "SSL_read", &api.SSL_read);
+    bind(ssl, "SSL_write", &api.SSL_write);
+    bind(ssl, "SSL_shutdown", &api.SSL_shutdown);
+    bind(ssl, "SSL_get_error", &api.SSL_get_error);
+    bind(ssl, "SSL_ctrl", &api.SSL_ctrl);
+    bind(ssl, "SSL_set_alpn_protos", &api.SSL_set_alpn_protos,
+         /*required=*/false);
+    bind(ssl, "SSL_get0_param", &api.SSL_get0_param);
+    bind(crypto, "X509_VERIFY_PARAM_set1_host",
+         &api.X509_VERIFY_PARAM_set1_host);
+    bind(crypto, "X509_VERIFY_PARAM_set1_ip_asc",
+         &api.X509_VERIFY_PARAM_set1_ip_asc);
+    bind(crypto, "ERR_get_error", &api.ERR_get_error);
+    bind(crypto, "ERR_error_string_n", &api.ERR_error_string_n);
+    // SSL_write has no MSG_NOSIGNAL: a peer-closed socket raises SIGPIPE
+    // and kills the process. Ignore it process-wide IF AND ONLY IF the
+    // application left the default disposition (never stomp a real
+    // handler) — the same stance libcurl takes for the reference client.
+    struct sigaction sa;
+    if (sigaction(SIGPIPE, nullptr, &sa) == 0 && sa.sa_handler == SIG_DFL) {
+      sa.sa_handler = SIG_IGN;
+      sigemptyset(&sa.sa_mask);
+      sa.sa_flags = 0;
+      sigaction(SIGPIPE, &sa, nullptr);
+    }
+    api.ok = all;
+  });
+  return &api;
+}
+
+std::string LastSslError(SslApi* api) {
+  unsigned long code = api->ERR_get_error != nullptr ? api->ERR_get_error() : 0;
+  if (code == 0) return "unknown TLS error";
+  char buf[256];
+  api->ERR_error_string_n(code, buf, sizeof(buf));
+  return std::string(buf);
+}
+
+}  // namespace
+
+bool TlsSession::Available(std::string* why) {
+  SslApi* api = LoadSslApi();
+  if (!api->ok && why != nullptr) *why = api->why;
+  return api->ok;
+}
+
+TlsSession::~TlsSession() { Close(); }
+
+Error TlsSession::Handshake(int fd, const TlsConfig& cfg) {
+  SslApi* api = LoadSslApi();
+  if (!api->ok) return Error("TLS unavailable: " + api->why);
+  Close();
+
+  ctx_ = api->SSL_CTX_new(api->TLS_client_method());
+  if (ctx_ == nullptr) return Error("SSL_CTX_new failed");
+
+  if (cfg.verify_peer) {
+    api->SSL_CTX_set_verify(ctx_, kSslVerifyPeer, nullptr);
+    int rc = cfg.ca_path.empty()
+                 ? api->SSL_CTX_set_default_verify_paths(ctx_)
+                 : api->SSL_CTX_load_verify_locations(ctx_,
+                                                      cfg.ca_path.c_str(),
+                                                      nullptr);
+    if (rc != 1) {
+      Error err("failed to load CA certificates" +
+                (cfg.ca_path.empty() ? std::string()
+                                     : " from '" + cfg.ca_path + "'") +
+                ": " + LastSslError(api));
+      Close();
+      return err;
+    }
+  } else {
+    api->SSL_CTX_set_verify(ctx_, kSslVerifyNone, nullptr);
+  }
+  if (!cfg.cert_path.empty()) {
+    if (api->SSL_CTX_use_certificate_file(
+            ctx_, cfg.cert_path.c_str(),
+            cfg.cert_pem ? kSslFiletypePem : kSslFiletypeDer) != 1) {
+      Error err("failed to load client certificate '" + cfg.cert_path +
+                "': " + LastSslError(api));
+      Close();
+      return err;
+    }
+  }
+  if (!cfg.key_path.empty()) {
+    if (api->SSL_CTX_use_PrivateKey_file(
+            ctx_, cfg.key_path.c_str(),
+            cfg.key_pem ? kSslFiletypePem : kSslFiletypeDer) != 1) {
+      Error err("failed to load client key '" + cfg.key_path +
+                "': " + LastSslError(api));
+      Close();
+      return err;
+    }
+  }
+
+  ssl_ = api->SSL_new(ctx_);
+  if (ssl_ == nullptr) {
+    Close();
+    return Error("SSL_new failed");
+  }
+  if (!cfg.server_name.empty()) {
+    // IP literals match SAN iPAddress entries, not dNSName — and SNI is
+    // defined for hostnames only (RFC 6066 §3).
+    const bool is_ip =
+        cfg.server_name.find_first_not_of("0123456789.") == std::string::npos ||
+        cfg.server_name.find(':') != std::string::npos;
+    if (!is_ip) {
+      api->SSL_ctrl(ssl_, kSslCtrlSetTlsextHostname, kTlsextNametypeHostName,
+                    const_cast<char*>(cfg.server_name.c_str()));
+    }
+    if (cfg.verify_peer && cfg.verify_host) {
+      void* param = api->SSL_get0_param(ssl_);
+      int rc = 0;
+      if (param != nullptr) {
+        rc = is_ip ? api->X509_VERIFY_PARAM_set1_ip_asc(
+                         param, cfg.server_name.c_str())
+                   : api->X509_VERIFY_PARAM_set1_host(
+                         param, cfg.server_name.c_str(), 0);
+      }
+      if (rc != 1) {
+        Close();
+        return Error("failed to arm hostname verification for '" +
+                     cfg.server_name + "'");
+      }
+    }
+  }
+  if (cfg.alpn_h2 && api->SSL_set_alpn_protos != nullptr) {
+    static const unsigned char kH2[] = {2, 'h', '2'};
+    api->SSL_set_alpn_protos(ssl_, kH2, sizeof(kH2));
+  }
+  if (api->SSL_set_fd(ssl_, fd) != 1) {
+    Close();
+    return Error("SSL_set_fd failed");
+  }
+  int rc = api->SSL_connect(ssl_);
+  if (rc != 1) {
+    int ssl_err = api->SSL_get_error(ssl_, rc);
+    Error err("TLS handshake with '" + cfg.server_name + "' failed (ssl error " +
+              std::to_string(ssl_err) + "): " + LastSslError(api));
+    Close();
+    return err;
+  }
+  // Non-blocking from here on: Recv/Send hold mu_ only while libssl makes
+  // progress and poll() outside it, so one SSL* serves a reader thread and
+  // writer threads without concurrent SSL_* calls (see tls.h).
+  fd_ = fd;
+  int flags = fcntl(fd_, F_GETFL, 0);
+  fcntl(fd_, F_SETFL, flags | O_NONBLOCK);
+  return Error::Success;
+}
+
+bool TlsSession::WaitReady(int ssl_err) {
+  // Read deadline: SO_RCVTIMEO still governs (tv 0 = wait forever).
+  int timeout_ms = -1;
+  if (ssl_err == kSslErrorWantRead) {
+    struct timeval tv = {0, 0};
+    socklen_t len = sizeof(tv);
+    if (getsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, &len) == 0 &&
+        (tv.tv_sec != 0 || tv.tv_usec != 0)) {
+      timeout_ms = static_cast<int>(tv.tv_sec * 1000 + tv.tv_usec / 1000);
+      if (timeout_ms <= 0) timeout_ms = 1;
+    }
+  }
+  struct pollfd pfd = {fd_, static_cast<short>(ssl_err == kSslErrorWantWrite
+                                                   ? POLLOUT
+                                                   : POLLIN),
+                       0};
+  int rc = poll(&pfd, 1, timeout_ms);
+  if (rc == 0) {
+    errno = EAGAIN;  // deadline expiry, same shape as blocking-recv timeout
+    return false;
+  }
+  return rc > 0;
+}
+
+ssize_t TlsSession::Recv(void* buf, size_t cap) {
+  SslApi* api = LoadSslApi();
+  while (true) {
+    int n, ssl_err;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (ssl_ == nullptr) return 0;  // closed under us: treat as EOF
+      n = api->SSL_read(ssl_, buf, static_cast<int>(cap));
+      if (n > 0) return n;
+      ssl_err = api->SSL_get_error(ssl_, n);
+    }
+    if (ssl_err == kSslErrorZeroReturn) return 0;  // clean close_notify
+    if (ssl_err == kSslErrorWantRead || ssl_err == kSslErrorWantWrite) {
+      if (!WaitReady(ssl_err)) return -1;
+      continue;
+    }
+    if (ssl_err != kSslErrorSyscall && errno == 0) errno = EIO;
+    return -1;
+  }
+}
+
+ssize_t TlsSession::Send(const void* buf, size_t len) {
+  SslApi* api = LoadSslApi();
+  size_t sent = 0;
+  const char* p = static_cast<const char*>(buf);
+  while (sent < len) {
+    int n, ssl_err;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (ssl_ == nullptr) return -1;
+      n = api->SSL_write(ssl_, p + sent, static_cast<int>(len - sent));
+      if (n > 0) {
+        sent += static_cast<size_t>(n);
+        continue;
+      }
+      ssl_err = api->SSL_get_error(ssl_, n);
+    }
+    if (ssl_err == kSslErrorWantRead || ssl_err == kSslErrorWantWrite) {
+      if (!WaitReady(ssl_err)) return -1;
+      continue;
+    }
+    return -1;
+  }
+  return static_cast<ssize_t>(sent);
+}
+
+void TlsSession::Close() {
+  SslApi* api = LoadSslApi();
+  std::lock_guard<std::mutex> lk(mu_);
+  if (ssl_ != nullptr) {
+    api->SSL_shutdown(ssl_);  // best-effort close_notify (no bidi wait)
+    api->SSL_free(ssl_);
+    ssl_ = nullptr;
+  }
+  if (ctx_ != nullptr) {
+    api->SSL_CTX_free(ctx_);
+    ctx_ = nullptr;
+  }
+  fd_ = -1;
+}
+
+}  // namespace tputriton

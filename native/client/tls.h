@@ -1,0 +1,90 @@
+// TLS client sessions for the native transports.
+//
+// The reference clients inherit TLS from libcurl / grpc++ (reference
+// http_client.h:45-103 HttpSslOptions wired into curl; grpc_client.cc:65-77
+// SSL channel credentials). This image's toolchain has the system libssl
+// RUNTIME (OpenSSL 3) but no OpenSSL headers, so the shared wrapper binds
+// the stable libssl/libcrypto C ABI at first use via dlopen — TLS-enabled
+// builds carry no compile-time OpenSSL dependency and fail with a clear
+// error on hosts without libssl.
+//
+// Both native transports share this session type: HttpConnection
+// (http_client.cc) and h2::Connection (h2.cc) swap their raw send/recv for
+// Send/Recv when a session is active.
+
+#ifndef TPUTRITON_TLS_H_
+#define TPUTRITON_TLS_H_
+
+#include <string>
+#include <sys/types.h>
+
+#include <mutex>
+
+#include "common.h"
+
+namespace tputriton {
+
+struct TlsConfig {
+  bool verify_peer = true;
+  bool verify_host = true;
+  std::string ca_path;      // CA bundle file (PEM); "" = system default paths
+  std::string cert_path;    // client certificate file ("" = none)
+  bool cert_pem = true;     // PEM (true) or DER
+  std::string key_path;     // client private key file ("" = none)
+  bool key_pem = true;
+  std::string server_name;  // SNI + hostname-verification target
+  bool alpn_h2 = false;     // offer "h2" via ALPN (gRPC requires it)
+};
+
+// One TLS client session over an already-connected TCP fd.
+//
+// Thread model: OpenSSL forbids concurrent SSL_read/SSL_write on one SSL*,
+// but the h2 transport reads from a dedicated reader thread while callers
+// write. The session therefore switches the fd non-blocking after the
+// handshake and serializes every SSL call on an internal mutex; a reader
+// that would block releases the mutex and poll()s the fd, so writers
+// interleave instead of deadlocking behind a blocked read.
+//
+// SO_RCVTIMEO armed on the fd keeps working as the read deadline (it
+// becomes the poll timeout): a timed-out read surfaces as Recv() == -1
+// with errno EAGAIN, same as plain recv() on a blocking socket.
+class TlsSession {
+ public:
+  TlsSession() = default;
+  ~TlsSession();
+  TlsSession(const TlsSession&) = delete;
+  TlsSession& operator=(const TlsSession&) = delete;
+
+  // Whether the system libssl could be loaded (reason in *why otherwise).
+  static bool Available(std::string* why);
+
+  // Performs the TLS handshake on fd. On failure the fd is left open (the
+  // caller owns it) and the session stays inactive.
+  Error Handshake(int fd, const TlsConfig& cfg);
+
+  bool Active() const { return ssl_ != nullptr; }
+
+  // recv()-like: >0 bytes read, 0 clean TLS close, -1 error (errno EAGAIN
+  // preserved for deadline expiry).
+  ssize_t Recv(void* buf, size_t cap);
+  // Writes the full buffer; returns len or -1.
+  ssize_t Send(const void* buf, size_t len);
+
+  // Best-effort close_notify + free; safe against concurrent Recv/Send
+  // (they re-check liveness under the session mutex). Does not close the
+  // fd — shut it down first to unblock pollers.
+  void Close();
+
+ private:
+  // Waits for fd readiness for the pending SSL want; false on timeout/err.
+  bool WaitReady(int ssl_err);
+
+  std::mutex mu_;        // serializes all SSL_* calls on ssl_
+  int fd_ = -1;
+  void* ctx_ = nullptr;  // SSL_CTX*
+  void* ssl_ = nullptr;  // SSL*
+};
+
+}  // namespace tputriton
+
+#endif  // TPUTRITON_TLS_H_

@@ -1,0 +1,162 @@
+// Self-checking TLS round-trip test for both native transports, driven by
+// tests/test_cpp_client.py against the in-process server running with a
+// self-signed certificate (the role the server repo's L0_https harness plays
+// for the reference, README.md:621; client config parity:
+// reference http_client.h:45-103 HttpSslOptions, grpc_client.cc:65-77
+// SslCredentials).
+//
+//   tls_test <host:port(https)> <host:port(grpc-tls)> <ca.pem>
+
+#include <cstring>
+#include <iostream>
+
+#include "grpc_client.h"
+#include "http_client.h"
+
+using namespace tputriton;  // NOLINT
+
+static int failures = 0;
+
+#define EXPECT(cond, msg)                              \
+  do {                                                 \
+    if (!(cond)) {                                     \
+      std::cerr << "FAIL: " << msg << "\n";            \
+      failures++;                                      \
+    }                                                  \
+  } while (0)
+
+#define EXPECT_OK(err, msg)                                               \
+  do {                                                                    \
+    Error e = (err);                                                      \
+    if (!e.IsOk()) {                                                      \
+      std::cerr << "FAIL: " << msg << ": " << e.Message() << "\n";        \
+      failures++;                                                         \
+    }                                                                     \
+  } while (0)
+
+static void HttpInferRoundTrip(InferenceServerHttpClient* client,
+                               const char* tag) {
+  int32_t input0[16], input1[16];
+  for (int i = 0; i < 16; i++) {
+    input0[i] = i;
+    input1[i] = 2 * i;
+  }
+  InferInput in0("INPUT0", {1, 16}, "INT32");
+  InferInput in1("INPUT1", {1, 16}, "INT32");
+  in0.AppendRaw(reinterpret_cast<uint8_t*>(input0), sizeof(input0));
+  in1.AppendRaw(reinterpret_cast<uint8_t*>(input1), sizeof(input1));
+  InferOptions options("simple");
+  std::shared_ptr<InferResult> result;
+  EXPECT_OK(client->Infer(&result, options, {&in0, &in1}),
+            std::string(tag) + " infer");
+  const uint8_t* buf = nullptr;
+  size_t nbytes = 0;
+  if (result != nullptr) {
+    EXPECT_OK(result->RawData("OUTPUT0", &buf, &nbytes),
+              std::string(tag) + " OUTPUT0");
+    EXPECT(nbytes == sizeof(input0) &&
+               reinterpret_cast<const int32_t*>(buf)[5] ==
+                   input0[5] + input1[5],
+           std::string(tag) + " sum");
+  }
+}
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::cerr << "usage: tls_test <https host:port> <grpc-tls host:port> "
+                 "<ca.pem>\n";
+    return 2;
+  }
+  const std::string https_addr = argv[1];
+  const std::string grpc_addr = argv[2];
+  const std::string ca_path = argv[3];
+
+  // -- HTTPS with CA verification -------------------------------------------
+  {
+    std::unique_ptr<InferenceServerHttpClient> client;
+    HttpSslOptions ssl;
+    ssl.ca_info = ca_path;
+    EXPECT_OK(InferenceServerHttpClient::Create(&client, https_addr, ssl),
+              "https create (verified)");
+    bool live = false;
+    EXPECT_OK(client->IsServerLive(&live), "https live (verified)");
+    EXPECT(live, "https server live");
+    HttpInferRoundTrip(client.get(), "https-verified");
+  }
+
+  // -- HTTPS with verification disabled (no CA) -----------------------------
+  {
+    std::unique_ptr<InferenceServerHttpClient> client;
+    HttpSslOptions ssl;
+    ssl.verify_peer = false;
+    ssl.verify_host = false;
+    EXPECT_OK(InferenceServerHttpClient::Create(&client, https_addr, ssl),
+              "https create (insecure)");
+    bool live = false;
+    EXPECT_OK(client->IsServerLive(&live), "https live (insecure)");
+    EXPECT(live, "https server live (insecure)");
+  }
+
+  // -- HTTPS trust failure: self-signed cert w/o its CA must be rejected ----
+  {
+    std::unique_ptr<InferenceServerHttpClient> client;
+    HttpSslOptions ssl;  // verify against system roots only
+    Error cerr = InferenceServerHttpClient::Create(&client, https_addr, ssl);
+    if (cerr.IsOk()) {
+      bool live = false;
+      Error lerr = client->IsServerLive(&live);
+      EXPECT(!lerr.IsOk(), "self-signed cert must fail system-root verify");
+    }
+  }
+
+  // -- gRPC over TLS --------------------------------------------------------
+  {
+    std::unique_ptr<InferenceServerGrpcClient> client;
+    SslOptions ssl;
+    ssl.root_certificates = ca_path;
+    EXPECT_OK(
+        InferenceServerGrpcClient::Create(&client, grpc_addr, true, ssl),
+        "grpc tls create");
+    bool live = false;
+    EXPECT_OK(client->IsServerLive(&live), "grpc tls live");
+    EXPECT(live, "grpc tls server live");
+
+    int32_t input0[16], input1[16];
+    for (int i = 0; i < 16; i++) {
+      input0[i] = i;
+      input1[i] = 100 - i;
+    }
+    InferInput in0("INPUT0", {1, 16}, "INT32");
+    InferInput in1("INPUT1", {1, 16}, "INT32");
+    in0.AppendRaw(reinterpret_cast<uint8_t*>(input0), sizeof(input0));
+    in1.AppendRaw(reinterpret_cast<uint8_t*>(input1), sizeof(input1));
+    InferOptions options("simple");
+    std::shared_ptr<InferResult> result;
+    EXPECT_OK(client->Infer(&result, options, {&in0, &in1}), "grpc tls infer");
+    const uint8_t* buf = nullptr;
+    size_t nbytes = 0;
+    if (result != nullptr) {
+      EXPECT_OK(result->RawData("OUTPUT0", &buf, &nbytes), "grpc tls OUTPUT0");
+      EXPECT(nbytes == sizeof(input0) &&
+                 reinterpret_cast<const int32_t*>(buf)[7] ==
+                     input0[7] + input1[7],
+             "grpc tls sum");
+    }
+  }
+
+  // -- gRPC TLS trust failure ----------------------------------------------
+  {
+    std::unique_ptr<InferenceServerGrpcClient> client;
+    SslOptions ssl;  // system roots: must reject the self-signed server
+    Error cerr =
+        InferenceServerGrpcClient::Create(&client, grpc_addr, true, ssl);
+    EXPECT(!cerr.IsOk(), "grpc self-signed cert must fail system-root verify");
+  }
+
+  if (failures == 0) {
+    std::cout << "ALL PASS\n";
+    return 0;
+  }
+  std::cerr << failures << " failures\n";
+  return 1;
+}

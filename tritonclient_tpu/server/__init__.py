@@ -63,12 +63,26 @@ class InferenceServer:
         grpc_port: int = 0,
         host: str = "127.0.0.1",
         verbose: bool = False,
+        ssl_certfile: Optional[str] = None,
+        ssl_keyfile: Optional[str] = None,
     ):
         self.core = InferenceCore(models if models is not None else default_models())
         self._http = (
-            HTTPFrontend(self.core, host, http_port, verbose=verbose) if http else None
+            HTTPFrontend(
+                self.core, host, http_port, verbose=verbose,
+                ssl_certfile=ssl_certfile, ssl_keyfile=ssl_keyfile,
+            )
+            if http
+            else None
         )
-        self._grpc = GRPCFrontend(self.core, host, grpc_port) if grpc else None
+        self._grpc = (
+            GRPCFrontend(
+                self.core, host, grpc_port,
+                ssl_certfile=ssl_certfile, ssl_keyfile=ssl_keyfile,
+            )
+            if grpc
+            else None
+        )
 
     @property
     def http_address(self) -> Optional[str]:

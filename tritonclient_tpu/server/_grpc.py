@@ -580,6 +580,8 @@ class GRPCFrontend:
         port: int = 0,
         max_workers: int = 80,
         aio: Optional[bool] = None,
+        ssl_certfile: Optional[str] = None,
+        ssl_keyfile: Optional[str] = None,
     ):
         if aio is None:
             # Thread-pool frontend by default: at high stream counts the
@@ -591,6 +593,26 @@ class GRPCFrontend:
             aio = os.environ.get("TPU_SERVER_GRPC_AIO", "0") == "1"
         self._aio = aio
         self._host = host
+        creds = None
+        if ssl_certfile:
+            # TLS termination (client counterpart: SslOptions / ssl=True).
+            if not ssl_keyfile:
+                raise ValueError(
+                    "ssl_keyfile is required with ssl_certfile for the gRPC "
+                    "front-end (gRPC server credentials take the key and "
+                    "certificate chain separately)"
+                )
+            with open(ssl_certfile, "rb") as f:
+                cert = f.read()
+            with open(ssl_keyfile, "rb") as f:
+                key = f.read()
+            creds = grpc.ssl_server_credentials([(key, cert)])
+
+        def _bind(server, addr):
+            if creds is not None:
+                return server.add_secure_port(addr, creds)
+            return server.add_insecure_port(addr)
+
         if not aio:
             # Each long-lived bidi stream pins one pool thread for its whole
             # lifetime, so the pool must exceed the expected stream count or
@@ -605,7 +627,7 @@ class GRPCFrontend:
             self._server.add_generic_rpc_handlers(
                 [make_service_handler(_Servicer(core))]
             )
-            self._port = self._server.add_insecure_port(f"{host}:{port}")
+            self._port = _bind(self._server, f"{host}:{port}")
             return
 
         import asyncio
@@ -628,7 +650,7 @@ class GRPCFrontend:
             server.add_generic_rpc_handlers(
                 [make_service_handler(self._servicer)]
             )
-            port = server.add_insecure_port(f"{host}:{port_arg}")
+            port = _bind(server, f"{host}:{port_arg}")
             return server, port
 
         port_arg = port

@@ -385,16 +385,51 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(200, payload, content_type=ctype, extra=extra)
 
 
+class _TlsCapableHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer whose TLS handshake runs on the WORKER thread.
+
+    Wrapping the listening socket would handshake synchronously inside the
+    single accept loop, letting one stalled client freeze the whole
+    front-end; wrapping per-connection in process_request_thread keeps the
+    accept loop non-blocking and bounds each handshake with a timeout.
+    """
+
+    ssl_context = None
+    handshake_timeout_s = 10.0
+
+    def process_request_thread(self, request, client_address):
+        if self.ssl_context is not None:
+            try:
+                request.settimeout(self.handshake_timeout_s)
+                request = self.ssl_context.wrap_socket(request, server_side=True)
+                request.settimeout(None)
+            except Exception:
+                self.shutdown_request(request)
+                return
+        super().process_request_thread(request, client_address)
+
+
 class HTTPFrontend:
     """Threaded HTTP server hosting an InferenceCore."""
 
-    def __init__(self, core: InferenceCore, host: str = "127.0.0.1", port: int = 0, verbose=False):
-        self._server = ThreadingHTTPServer((host, port), _Handler)
+    def __init__(self, core: InferenceCore, host: str = "127.0.0.1", port: int = 0,
+                 verbose=False, ssl_certfile: Optional[str] = None,
+                 ssl_keyfile: Optional[str] = None):
+        self._server = _TlsCapableHTTPServer((host, port), _Handler)
         self._server.core = core
         self._server.verbose = verbose
         self._server.daemon_threads = True
         # Disable Nagle for latency.
         self._server.socket.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if ssl_certfile:
+            # TLS termination for the REST plane (client-side counterpart:
+            # HttpSslOptions / ssl=True; reference tests this via the server
+            # repo's L0_https harness, README.md:621).
+            import ssl as _ssl
+
+            ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(ssl_certfile, ssl_keyfile)
+            self._server.ssl_context = ctx
         self._thread: Optional[threading.Thread] = None
 
     @property
