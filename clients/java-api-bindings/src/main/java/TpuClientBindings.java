@@ -7,8 +7,14 @@
 // library: java.lang.foreign downcalls into libtpuhttpclient.so — no
 // generated glue, no extra dependencies, JDK 22+.
 //
+// Surface (mirrors capi.h): HTTP + gRPC clients, request builders with raw
+// or shared-memory tensors, gRPC bidi streaming with an upcall-stub
+// callback, system/tpu shared-memory registration, model control, and
+// metadata/config/statistics/repository-index JSON.
+//
 //   java --enable-native-access=ALL-UNNAMED \
-//        -Djava.library.path=<build dir> TpuClientBindings.java <host:port>
+//        -Djava.library.path=<build dir> TpuClientBindings.java \
+//        <http host:port> <grpc host:port>
 
 import java.lang.foreign.Arena;
 import java.lang.foreign.FunctionDescriptor;
@@ -17,68 +23,565 @@ import java.lang.foreign.MemorySegment;
 import java.lang.foreign.SymbolLookup;
 import java.lang.foreign.ValueLayout;
 import java.lang.invoke.MethodHandle;
+import java.lang.invoke.MethodHandles;
+import java.lang.invoke.MethodType;
+import java.util.concurrent.CountDownLatch;
+import java.util.concurrent.TimeUnit;
+import java.util.concurrent.atomic.AtomicInteger;
 
 public final class TpuClientBindings {
-    private final MethodHandle create;
-    private final MethodHandle destroy;
-    private final MethodHandle isServerLive;
-    private final MethodHandle lastError;
-
-    public TpuClientBindings() {
-        Linker linker = Linker.nativeLinker();
+    private static final Linker LINKER = Linker.nativeLinker();
+    private static final SymbolLookup LIB;
+    static {
         // loadLibrary honors -Djava.library.path (libraryLookup would go
         // through dlopen, which only consults LD_LIBRARY_PATH).
         System.loadLibrary("tpuhttpclient");
-        SymbolLookup lib = SymbolLookup.loaderLookup();
-        create = linker.downcallHandle(
-                lib.find("tpuclient_http_create").orElseThrow(),
-                FunctionDescriptor.of(ValueLayout.JAVA_INT,
-                        ValueLayout.ADDRESS, ValueLayout.ADDRESS));
-        destroy = linker.downcallHandle(
-                lib.find("tpuclient_http_destroy").orElseThrow(),
-                FunctionDescriptor.ofVoid(ValueLayout.ADDRESS));
-        isServerLive = linker.downcallHandle(
-                lib.find("tpuclient_http_is_server_live").orElseThrow(),
-                FunctionDescriptor.of(ValueLayout.JAVA_INT,
-                        ValueLayout.ADDRESS, ValueLayout.ADDRESS));
-        lastError = linker.downcallHandle(
-                lib.find("tpuclient_last_error").orElseThrow(),
-                FunctionDescriptor.of(ValueLayout.ADDRESS));
+        LIB = SymbolLookup.loaderLookup();
     }
 
-    public boolean serverLive(String url) throws Throwable {
-        try (Arena arena = Arena.ofConfined()) {
-            MemorySegment handleOut = arena.allocate(ValueLayout.ADDRESS);
-            int rc = (int) create.invoke(arena.allocateFrom(url), handleOut);
-            if (rc != 0) {
-                throw new RuntimeException("create failed: " + error());
-            }
-            MemorySegment handle = handleOut.get(ValueLayout.ADDRESS, 0);
-            try {
-                MemorySegment live = arena.allocate(ValueLayout.JAVA_INT);
-                rc = (int) isServerLive.invoke(handle, live);
-                if (rc != 0) {
-                    throw new RuntimeException("live check failed: " + error());
-                }
-                return live.get(ValueLayout.JAVA_INT, 0) == 1;
-            } finally {
-                destroy.invoke(handle);
-            }
+    private static MethodHandle down(String name, FunctionDescriptor desc) {
+        return LINKER.downcallHandle(LIB.find(name).orElseThrow(
+                () -> new IllegalStateException("missing symbol " + name)), desc);
+    }
+
+    private static final ValueLayout.OfInt I32 = ValueLayout.JAVA_INT;
+    private static final ValueLayout.OfLong I64 = ValueLayout.JAVA_LONG;
+    private static final java.lang.foreign.AddressLayout PTR = ValueLayout.ADDRESS;
+
+    // ---- shared --------------------------------------------------------------
+    private static final MethodHandle LAST_ERROR =
+            down("tpuclient_last_error", FunctionDescriptor.of(PTR));
+    private static final MethodHandle FREE =
+            down("tpuclient_free", FunctionDescriptor.ofVoid(PTR));
+
+    static String lastError() {
+        try {
+            MemorySegment msg = (MemorySegment) LAST_ERROR.invoke();
+            return msg.reinterpret(4096).getString(0);
+        } catch (Throwable t) {
+            return "(unavailable: " + t + ")";
         }
     }
 
-    private String error() throws Throwable {
-        MemorySegment msg = (MemorySegment) lastError.invoke();
-        return msg.reinterpret(4096).getString(0);
+    static void check(int rc, String what) {
+        if (rc != 0) throw new RuntimeException(what + ": " + lastError());
     }
+
+    static String takeJson(MemorySegment out) throws Throwable {
+        MemorySegment p = out.get(PTR, 0);
+        try {
+            // NUL-terminated malloc'd buffer of unknown length: unbound the
+            // segment so getString scans to the terminator.
+            return p.reinterpret(Long.MAX_VALUE).getString(0);
+        } finally {
+            FREE.invoke(p);
+        }
+    }
+
+    // ---- request builders ----------------------------------------------------
+
+    private static final MethodHandle INPUT_CREATE = down("tpuclient_input_create",
+            FunctionDescriptor.of(I32, PTR, PTR, PTR, I32, PTR));
+    private static final MethodHandle INPUT_APPEND = down("tpuclient_input_append_raw",
+            FunctionDescriptor.of(I32, PTR, PTR, I64));
+    private static final MethodHandle INPUT_SET_SHM = down("tpuclient_input_set_shared_memory",
+            FunctionDescriptor.of(I32, PTR, PTR, I64, I64));
+    private static final MethodHandle INPUT_DESTROY = down("tpuclient_input_destroy",
+            FunctionDescriptor.ofVoid(PTR));
+    private static final MethodHandle OUTPUT_CREATE = down("tpuclient_output_create",
+            FunctionDescriptor.of(I32, PTR, PTR));
+    private static final MethodHandle OUTPUT_SET_SHM = down("tpuclient_output_set_shared_memory",
+            FunctionDescriptor.of(I32, PTR, PTR, I64, I64));
+    private static final MethodHandle OUTPUT_DESTROY = down("tpuclient_output_destroy",
+            FunctionDescriptor.ofVoid(PTR));
+
+    /** One inference input; wraps tpuclient_input. */
+    public static final class Input implements AutoCloseable {
+        final MemorySegment handle;
+
+        public Input(Arena arena, String name, String datatype, long[] shape) throws Throwable {
+            MemorySegment dims = arena.allocateFrom(I64, shape);
+            MemorySegment out = arena.allocate(PTR);
+            check((int) INPUT_CREATE.invoke(arena.allocateFrom(name),
+                    arena.allocateFrom(datatype), dims, shape.length, out), "input_create");
+            handle = out.get(PTR, 0);
+        }
+
+        public Input appendRaw(MemorySegment data, long nbytes) throws Throwable {
+            check((int) INPUT_APPEND.invoke(handle, data, nbytes), "input_append_raw");
+            return this;
+        }
+
+        public Input setSharedMemory(Arena arena, String region, long nbytes, long offset)
+                throws Throwable {
+            check((int) INPUT_SET_SHM.invoke(handle, arena.allocateFrom(region), nbytes,
+                    offset), "input_set_shared_memory");
+            return this;
+        }
+
+        @Override public void close() throws RuntimeException {
+            try { INPUT_DESTROY.invoke(handle); } catch (Throwable t) { throw new RuntimeException(t); }
+        }
+    }
+
+    /** One requested output; wraps tpuclient_output. */
+    public static final class Output implements AutoCloseable {
+        final MemorySegment handle;
+
+        public Output(Arena arena, String name) throws Throwable {
+            MemorySegment out = arena.allocate(PTR);
+            check((int) OUTPUT_CREATE.invoke(arena.allocateFrom(name), out), "output_create");
+            handle = out.get(PTR, 0);
+        }
+
+        public Output setSharedMemory(Arena arena, String region, long nbytes, long offset)
+                throws Throwable {
+            check((int) OUTPUT_SET_SHM.invoke(handle, arena.allocateFrom(region), nbytes,
+                    offset), "output_set_shared_memory");
+            return this;
+        }
+
+        @Override public void close() throws RuntimeException {
+            try { OUTPUT_DESTROY.invoke(handle); } catch (Throwable t) { throw new RuntimeException(t); }
+        }
+    }
+
+    // ---- results -------------------------------------------------------------
+
+    private static final MethodHandle RESULT_ERROR = down("tpuclient_result_error",
+            FunctionDescriptor.of(PTR, PTR));
+    private static final MethodHandle RESULT_ID = down("tpuclient_result_id",
+            FunctionDescriptor.of(PTR, PTR));
+    private static final MethodHandle RESULT_OUTPUT = down("tpuclient_result_output",
+            FunctionDescriptor.of(I32, PTR, PTR, PTR, PTR));
+    private static final MethodHandle RESULT_DESTROY = down("tpuclient_result_destroy",
+            FunctionDescriptor.ofVoid(PTR));
+
+    /** Owned inference result; wraps tpuclient_result. */
+    public static final class Result implements AutoCloseable {
+        final MemorySegment handle;
+
+        Result(MemorySegment handle) { this.handle = handle; }
+
+        public String error() throws Throwable {
+            MemorySegment msg = (MemorySegment) RESULT_ERROR.invoke(handle);
+            return msg.equals(MemorySegment.NULL) ? null : msg.reinterpret(4096).getString(0);
+        }
+
+        public String id() throws Throwable {
+            return ((MemorySegment) RESULT_ID.invoke(handle)).reinterpret(4096).getString(0);
+        }
+
+        /** Borrowed view of a raw output tensor (valid until close()). */
+        public MemorySegment output(Arena arena, String name) throws Throwable {
+            MemorySegment dataOut = arena.allocate(PTR);
+            MemorySegment nbytesOut = arena.allocate(I64);
+            check((int) RESULT_OUTPUT.invoke(handle, arena.allocateFrom(name), dataOut,
+                    nbytesOut), "result_output " + name);
+            long nbytes = nbytesOut.get(I64, 0);
+            return dataOut.get(PTR, 0).reinterpret(nbytes);
+        }
+
+        @Override public void close() throws RuntimeException {
+            try { RESULT_DESTROY.invoke(handle); } catch (Throwable t) { throw new RuntimeException(t); }
+        }
+    }
+
+    // ---- gRPC client ---------------------------------------------------------
+
+    private static final MethodHandle GRPC_CREATE = down("tpuclient_grpc_create",
+            FunctionDescriptor.of(I32, PTR, PTR));
+    private static final MethodHandle GRPC_DESTROY = down("tpuclient_grpc_destroy",
+            FunctionDescriptor.ofVoid(PTR));
+    private static final MethodHandle GRPC_LIVE = down("tpuclient_grpc_is_server_live",
+            FunctionDescriptor.of(I32, PTR, PTR));
+    private static final MethodHandle GRPC_READY = down("tpuclient_grpc_is_model_ready",
+            FunctionDescriptor.of(I32, PTR, PTR, PTR));
+    private static final MethodHandle GRPC_INFER = down("tpuclient_grpc_infer",
+            FunctionDescriptor.of(I32, PTR, PTR, PTR, I32, PTR, I32, PTR));
+    private static final MethodHandle GRPC_START_STREAM = down("tpuclient_grpc_start_stream",
+            FunctionDescriptor.of(I32, PTR, PTR, PTR));
+    private static final MethodHandle GRPC_STREAM_INFER = down("tpuclient_grpc_async_stream_infer",
+            FunctionDescriptor.of(I32, PTR, PTR, PTR, PTR, I32, PTR, I32));
+    private static final MethodHandle GRPC_STOP_STREAM = down("tpuclient_grpc_stop_stream",
+            FunctionDescriptor.of(I32, PTR));
+    private static final MethodHandle GRPC_LOAD = down("tpuclient_grpc_load_model",
+            FunctionDescriptor.of(I32, PTR, PTR, PTR));
+    private static final MethodHandle GRPC_UNLOAD = down("tpuclient_grpc_unload_model",
+            FunctionDescriptor.of(I32, PTR, PTR));
+    private static final MethodHandle GRPC_SERVER_META = down("tpuclient_grpc_server_metadata",
+            FunctionDescriptor.of(I32, PTR, PTR));
+    private static final MethodHandle GRPC_MODEL_META = down("tpuclient_grpc_model_metadata",
+            FunctionDescriptor.of(I32, PTR, PTR, PTR));
+    private static final MethodHandle GRPC_MODEL_CONFIG = down("tpuclient_grpc_model_config",
+            FunctionDescriptor.of(I32, PTR, PTR, PTR));
+    private static final MethodHandle GRPC_MODEL_STATS = down("tpuclient_grpc_model_statistics",
+            FunctionDescriptor.of(I32, PTR, PTR, PTR));
+    private static final MethodHandle GRPC_REPO_INDEX = down("tpuclient_grpc_repository_index",
+            FunctionDescriptor.of(I32, PTR, PTR));
+    private static final MethodHandle GRPC_REG_SYSTEM_SHM =
+            down("tpuclient_grpc_register_system_shared_memory",
+                    FunctionDescriptor.of(I32, PTR, PTR, PTR, I64, I64));
+    private static final MethodHandle GRPC_UNREG_SYSTEM_SHM =
+            down("tpuclient_grpc_unregister_system_shared_memory",
+                    FunctionDescriptor.of(I32, PTR, PTR));
+    private static final MethodHandle GRPC_REG_TPU_SHM =
+            down("tpuclient_grpc_register_tpu_shared_memory",
+                    FunctionDescriptor.of(I32, PTR, PTR, PTR, I64, I64, I64));
+    private static final MethodHandle GRPC_UNREG_TPU_SHM =
+            down("tpuclient_grpc_unregister_tpu_shared_memory",
+                    FunctionDescriptor.of(I32, PTR, PTR));
+
+    /** Stream results are handed to this observer on the reader thread. */
+    public interface StreamObserver {
+        void onResult(Result result);
+    }
+
+    public static final class GrpcClient implements AutoCloseable {
+        private final Arena arena = Arena.ofShared();
+        private final MemorySegment handle;
+        private MemorySegment callbackStub;  // kept reachable while streaming
+
+        public GrpcClient(String url) throws Throwable {
+            MemorySegment out = arena.allocate(PTR);
+            check((int) GRPC_CREATE.invoke(arena.allocateFrom(url), out), "grpc_create");
+            handle = out.get(PTR, 0);
+        }
+
+        public boolean serverLive() throws Throwable {
+            try (Arena a = Arena.ofConfined()) {
+                MemorySegment live = a.allocate(I32);
+                check((int) GRPC_LIVE.invoke(handle, live), "grpc_is_server_live");
+                return live.get(I32, 0) == 1;
+            }
+        }
+
+        public boolean modelReady(String model) throws Throwable {
+            try (Arena a = Arena.ofConfined()) {
+                MemorySegment ready = a.allocate(I32);
+                check((int) GRPC_READY.invoke(handle, a.allocateFrom(model), ready),
+                        "grpc_is_model_ready");
+                return ready.get(I32, 0) == 1;
+            }
+        }
+
+        public Result infer(Arena a, String model, Input[] inputs, Output[] outputs)
+                throws Throwable {
+            MemorySegment in = a.allocate(PTR, inputs.length);
+            for (int i = 0; i < inputs.length; i++) in.setAtIndex(PTR, i, inputs[i].handle);
+            MemorySegment out = MemorySegment.NULL;
+            int nOut = outputs == null ? 0 : outputs.length;
+            if (nOut > 0) {
+                out = a.allocate(PTR, nOut);
+                for (int i = 0; i < nOut; i++) out.setAtIndex(PTR, i, outputs[i].handle);
+            }
+            MemorySegment resultOut = a.allocate(PTR);
+            check((int) GRPC_INFER.invoke(handle, a.allocateFrom(model), in, inputs.length,
+                    out, nOut, resultOut), "grpc_infer");
+            return new Result(resultOut.get(PTR, 0));
+        }
+
+        public void startStream(StreamObserver observer) throws Throwable {
+            MethodHandle target = MethodHandles.lookup().findStatic(
+                    TpuClientBindings.class, "dispatchStream",
+                    MethodType.methodType(void.class, StreamObserver.class,
+                            MemorySegment.class, MemorySegment.class))
+                    .bindTo(observer);
+            callbackStub = LINKER.upcallStub(target,
+                    FunctionDescriptor.ofVoid(PTR, PTR), arena);
+            check((int) GRPC_START_STREAM.invoke(handle, callbackStub,
+                    MemorySegment.NULL), "grpc_start_stream");
+        }
+
+        public void asyncStreamInfer(Arena a, String model, String requestId, Input[] inputs)
+                throws Throwable {
+            MemorySegment in = a.allocate(PTR, inputs.length);
+            for (int i = 0; i < inputs.length; i++) in.setAtIndex(PTR, i, inputs[i].handle);
+            MemorySegment rid = requestId == null ? MemorySegment.NULL
+                    : a.allocateFrom(requestId);
+            check((int) GRPC_STREAM_INFER.invoke(handle, a.allocateFrom(model), rid, in,
+                    inputs.length, MemorySegment.NULL, 0), "grpc_async_stream_infer");
+        }
+
+        public void stopStream() throws Throwable {
+            check((int) GRPC_STOP_STREAM.invoke(handle), "grpc_stop_stream");
+        }
+
+        public void loadModel(String model, String configJson) throws Throwable {
+            try (Arena a = Arena.ofConfined()) {
+                MemorySegment cfg = configJson == null ? MemorySegment.NULL
+                        : a.allocateFrom(configJson);
+                check((int) GRPC_LOAD.invoke(handle, a.allocateFrom(model), cfg),
+                        "grpc_load_model");
+            }
+        }
+
+        public void unloadModel(String model) throws Throwable {
+            try (Arena a = Arena.ofConfined()) {
+                check((int) GRPC_UNLOAD.invoke(handle, a.allocateFrom(model)),
+                        "grpc_unload_model");
+            }
+        }
+
+        public String serverMetadata() throws Throwable {
+            try (Arena a = Arena.ofConfined()) {
+                MemorySegment out = a.allocate(PTR);
+                check((int) GRPC_SERVER_META.invoke(handle, out), "grpc_server_metadata");
+                return takeJson(out);
+            }
+        }
+
+        public String modelMetadata(String model) throws Throwable {
+            try (Arena a = Arena.ofConfined()) {
+                MemorySegment out = a.allocate(PTR);
+                check((int) GRPC_MODEL_META.invoke(handle, a.allocateFrom(model), out),
+                        "grpc_model_metadata");
+                return takeJson(out);
+            }
+        }
+
+        public String modelConfig(String model) throws Throwable {
+            try (Arena a = Arena.ofConfined()) {
+                MemorySegment out = a.allocate(PTR);
+                check((int) GRPC_MODEL_CONFIG.invoke(handle, a.allocateFrom(model), out),
+                        "grpc_model_config");
+                return takeJson(out);
+            }
+        }
+
+        public String modelStatistics(String model) throws Throwable {
+            try (Arena a = Arena.ofConfined()) {
+                MemorySegment out = a.allocate(PTR);
+                MemorySegment m = model == null ? MemorySegment.NULL : a.allocateFrom(model);
+                check((int) GRPC_MODEL_STATS.invoke(handle, m, out), "grpc_model_statistics");
+                return takeJson(out);
+            }
+        }
+
+        public String repositoryIndex() throws Throwable {
+            try (Arena a = Arena.ofConfined()) {
+                MemorySegment out = a.allocate(PTR);
+                check((int) GRPC_REPO_INDEX.invoke(handle, out), "grpc_repository_index");
+                return takeJson(out);
+            }
+        }
+
+        public void registerSystemSharedMemory(String name, String key, long byteSize,
+                long offset) throws Throwable {
+            try (Arena a = Arena.ofConfined()) {
+                check((int) GRPC_REG_SYSTEM_SHM.invoke(handle, a.allocateFrom(name),
+                        a.allocateFrom(key), byteSize, offset),
+                        "grpc_register_system_shared_memory");
+            }
+        }
+
+        public void unregisterSystemSharedMemory(String name) throws Throwable {
+            try (Arena a = Arena.ofConfined()) {
+                MemorySegment n = name == null ? MemorySegment.NULL : a.allocateFrom(name);
+                check((int) GRPC_UNREG_SYSTEM_SHM.invoke(handle, n),
+                        "grpc_unregister_system_shared_memory");
+            }
+        }
+
+        public void registerTpuSharedMemory(String name, byte[] rawHandle, long deviceId,
+                long byteSize) throws Throwable {
+            try (Arena a = Arena.ofConfined()) {
+                MemorySegment raw = a.allocate(rawHandle.length);
+                MemorySegment.copy(rawHandle, 0, raw, ValueLayout.JAVA_BYTE, 0,
+                        rawHandle.length);
+                check((int) GRPC_REG_TPU_SHM.invoke(handle, a.allocateFrom(name), raw,
+                        (long) rawHandle.length, deviceId, byteSize),
+                        "grpc_register_tpu_shared_memory");
+            }
+        }
+
+        public void unregisterTpuSharedMemory(String name) throws Throwable {
+            try (Arena a = Arena.ofConfined()) {
+                MemorySegment n = name == null ? MemorySegment.NULL : a.allocateFrom(name);
+                check((int) GRPC_UNREG_TPU_SHM.invoke(handle, n),
+                        "grpc_unregister_tpu_shared_memory");
+            }
+        }
+
+        @Override public void close() {
+            try { GRPC_DESTROY.invoke(handle); } catch (Throwable ignored) { }
+            arena.close();
+        }
+    }
+
+    // Static upcall trampoline: bound to the observer, owns result cleanup.
+    static void dispatchStream(StreamObserver observer, MemorySegment user,
+            MemorySegment result) {
+        observer.onResult(new Result(result));
+    }
+
+    // ---- HTTP client ---------------------------------------------------------
+
+    private static final MethodHandle HTTP_CREATE = down("tpuclient_http_create",
+            FunctionDescriptor.of(I32, PTR, PTR));
+    private static final MethodHandle HTTP_DESTROY = down("tpuclient_http_destroy",
+            FunctionDescriptor.ofVoid(PTR));
+    private static final MethodHandle HTTP_LIVE = down("tpuclient_http_is_server_live",
+            FunctionDescriptor.of(I32, PTR, PTR));
+    private static final MethodHandle HTTP_INFER2 = down("tpuclient_http_infer2",
+            FunctionDescriptor.of(I32, PTR, PTR, PTR, I32, PTR, I32, PTR));
+    private static final MethodHandle HTTP_SERVER_META = down("tpuclient_http_server_metadata",
+            FunctionDescriptor.of(I32, PTR, PTR));
+    private static final MethodHandle HTTP_LOAD = down("tpuclient_http_load_model",
+            FunctionDescriptor.of(I32, PTR, PTR, PTR));
+
+    public static final class HttpClient implements AutoCloseable {
+        private final Arena arena = Arena.ofShared();
+        private final MemorySegment handle;
+
+        public HttpClient(String url) throws Throwable {
+            MemorySegment out = arena.allocate(PTR);
+            check((int) HTTP_CREATE.invoke(arena.allocateFrom(url), out), "http_create");
+            handle = out.get(PTR, 0);
+        }
+
+        public boolean serverLive() throws Throwable {
+            try (Arena a = Arena.ofConfined()) {
+                MemorySegment live = a.allocate(I32);
+                check((int) HTTP_LIVE.invoke(handle, live), "http_is_server_live");
+                return live.get(I32, 0) == 1;
+            }
+        }
+
+        public Result infer(Arena a, String model, Input[] inputs, Output[] outputs)
+                throws Throwable {
+            MemorySegment in = a.allocate(PTR, inputs.length);
+            for (int i = 0; i < inputs.length; i++) in.setAtIndex(PTR, i, inputs[i].handle);
+            MemorySegment out = MemorySegment.NULL;
+            int nOut = outputs == null ? 0 : outputs.length;
+            if (nOut > 0) {
+                out = a.allocate(PTR, nOut);
+                for (int i = 0; i < nOut; i++) out.setAtIndex(PTR, i, outputs[i].handle);
+            }
+            MemorySegment resultOut = a.allocate(PTR);
+            check((int) HTTP_INFER2.invoke(handle, a.allocateFrom(model), in, inputs.length,
+                    out, nOut, resultOut), "http_infer2");
+            return new Result(resultOut.get(PTR, 0));
+        }
+
+        public String serverMetadata() throws Throwable {
+            try (Arena a = Arena.ofConfined()) {
+                MemorySegment out = a.allocate(PTR);
+                check((int) HTTP_SERVER_META.invoke(handle, out), "http_server_metadata");
+                return takeJson(out);
+            }
+        }
+
+        public void loadModel(String model, String configJson) throws Throwable {
+            try (Arena a = Arena.ofConfined()) {
+                MemorySegment cfg = configJson == null ? MemorySegment.NULL
+                        : a.allocateFrom(configJson);
+                check((int) HTTP_LOAD.invoke(handle, a.allocateFrom(model), cfg),
+                        "http_load_model");
+            }
+        }
+
+        @Override public void close() {
+            try { HTTP_DESTROY.invoke(handle); } catch (Throwable ignored) { }
+            arena.close();
+        }
+    }
+
+    // ---- self-check ----------------------------------------------------------
 
     public static void main(String[] args) throws Throwable {
-        String url = args.length > 0 ? args[0] : "localhost:8000";
-        boolean live = new TpuClientBindings().serverLive(url);
-        if (!live) {
-            System.err.println("error: server not live");
+        String httpUrl = args.length > 0 ? args[0] : "localhost:8000";
+        String grpcUrl = args.length > 1 ? args[1] : "localhost:8001";
+        int failures = 0;
+
+        try (HttpClient http = new HttpClient(httpUrl);
+             GrpcClient grpc = new GrpcClient(grpcUrl);
+             Arena arena = Arena.ofShared()) {
+            if (!http.serverLive()) { System.err.println("FAIL: http live"); failures++; }
+            if (!grpc.serverLive()) { System.err.println("FAIL: grpc live"); failures++; }
+            if (!grpc.modelReady("simple")) { System.err.println("FAIL: ready"); failures++; }
+
+            // builder infer on both transports
+            int[] in0 = new int[16], in1 = new int[16];
+            for (int i = 0; i < 16; i++) { in0[i] = i; in1[i] = 2 * i; }
+            MemorySegment d0 = arena.allocateFrom(I32, in0);
+            MemorySegment d1 = arena.allocateFrom(I32, in1);
+            try (Input i0 = new Input(arena, "INPUT0", "INT32", new long[]{1, 16})
+                         .appendRaw(d0, 64);
+                 Input i1 = new Input(arena, "INPUT1", "INT32", new long[]{1, 16})
+                         .appendRaw(d1, 64);
+                 Output o0 = new Output(arena, "OUTPUT0");
+                 Output o1 = new Output(arena, "OUTPUT1")) {
+                Input[] inputs = {i0, i1};
+                Output[] outputs = {o0, o1};
+                try (Result r = grpc.infer(arena, "simple", inputs, outputs)) {
+                    MemorySegment sums = r.output(arena, "OUTPUT0");
+                    if (sums.getAtIndex(I32, 5) != in0[5] + in1[5]) {
+                        System.err.println("FAIL: grpc sum"); failures++;
+                    }
+                }
+                try (Result r = http.infer(arena, "simple", inputs, outputs)) {
+                    MemorySegment diffs = r.output(arena, "OUTPUT1");
+                    if (diffs.getAtIndex(I32, 5) != in0[5] - in1[5]) {
+                        System.err.println("FAIL: http diff"); failures++;
+                    }
+                }
+
+                // streaming with upcall callback
+                AtomicInteger errors = new AtomicInteger();
+                CountDownLatch done = new CountDownLatch(3);
+                grpc.startStream(result -> {
+                    try (Result r = result) {
+                        if (r.error() != null) errors.incrementAndGet();
+                    } catch (Throwable t) {
+                        errors.incrementAndGet();
+                    }
+                    done.countDown();
+                });
+                for (int n = 0; n < 3; n++) {
+                    grpc.asyncStreamInfer(arena, "simple", "req" + n, inputs);
+                }
+                if (!done.await(30, TimeUnit.SECONDS)) {
+                    System.err.println("FAIL: stream timeout"); failures++;
+                }
+                if (errors.get() != 0) { System.err.println("FAIL: stream errors"); failures++; }
+                grpc.stopStream();
+            }
+
+            // introspection + model control
+            if (!grpc.serverMetadata().contains("triton-tpu")) {
+                System.err.println("FAIL: server metadata"); failures++;
+            }
+            if (!grpc.modelMetadata("simple").contains("INPUT0")) {
+                System.err.println("FAIL: model metadata"); failures++;
+            }
+            if (!grpc.modelConfig("simple").contains("jax")) {
+                System.err.println("FAIL: model config"); failures++;
+            }
+            if (!grpc.modelStatistics("simple").contains("inference_count")) {
+                System.err.println("FAIL: model stats"); failures++;
+            }
+            if (!grpc.repositoryIndex().contains("simple")) {
+                System.err.println("FAIL: repo index"); failures++;
+            }
+            grpc.unloadModel("simple");
+            if (grpc.modelReady("simple")) {
+                System.err.println("FAIL: still ready after unload"); failures++;
+            }
+            http.loadModel("simple", null);
+            if (!grpc.modelReady("simple")) {
+                System.err.println("FAIL: not ready after load"); failures++;
+            }
+            if (!http.serverMetadata().contains("triton-tpu")) {
+                System.err.println("FAIL: http server metadata"); failures++;
+            }
+        }
+
+        if (failures == 0) {
+            System.out.println("ALL PASS: FFM bindings full surface");
+        } else {
+            System.err.println(failures + " failures");
             System.exit(1);
         }
-        System.out.println("PASS: server live via FFM bindings");
     }
 }

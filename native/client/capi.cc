@@ -2,11 +2,14 @@
 
 #include <cstdlib>
 #include <cstring>
+#include <functional>
 #include <memory>
 #include <string>
 #include <vector>
 
+#include "grpc_client.h"
 #include "http_client.h"
+#include "json.h"
 
 namespace {
 
@@ -22,13 +25,191 @@ int FailMsg(const char* msg) {
   return 1;
 }
 
+int Ok() {
+  g_last_error.clear();
+  return 0;
+}
+
+// malloc'd copy of a std::string (caller frees with tpuclient_free).
+int CopyOut(const std::string& s, char** out) {
+  *out = static_cast<char*>(std::malloc(s.size() + 1));
+  if (*out == nullptr) return FailMsg("out of memory");
+  std::memcpy(*out, s.data(), s.size());
+  (*out)[s.size()] = '\0';
+  return Ok();
+}
+
+// ---- proto -> JSON (gRPC introspection surface) ---------------------------
+
+tputriton::json::ValuePtr TensorMetaJson(
+    const inference::ModelMetadataResponse::TensorMetadata& t) {
+  auto v = tputriton::json::Value::MakeObject();
+  v->Set("name", t.name());
+  v->Set("datatype", t.datatype());
+  auto shape = tputriton::json::Value::MakeArray();
+  for (int64_t d : t.shape()) shape->Append(d);
+  v->Set("shape", shape);
+  return v;
+}
+
+tputriton::json::ValuePtr DurationJson(
+    const inference::StatisticDuration& d) {
+  auto v = tputriton::json::Value::MakeObject();
+  v->Set("count", static_cast<int64_t>(d.count()));
+  v->Set("ns", static_cast<int64_t>(d.ns()));
+  return v;
+}
+
 }  // namespace
 
 struct tpuclient_http {
   std::unique_ptr<tputriton::InferenceServerHttpClient> impl;
 };
 
+struct tpuclient_grpc {
+  std::unique_ptr<tputriton::InferenceServerGrpcClient> impl;
+};
+
+struct tpuclient_input {
+  std::unique_ptr<tputriton::InferInput> impl;
+};
+
+struct tpuclient_output {
+  std::unique_ptr<tputriton::InferRequestedOutput> impl;
+};
+
+struct tpuclient_result {
+  std::shared_ptr<tputriton::InferResult> impl;
+  std::string error;  // non-empty = failed request
+};
+
+namespace {
+
+tpuclient_result* MakeResult(std::shared_ptr<tputriton::InferResult> r,
+                             const tputriton::Error& err) {
+  auto* result = new tpuclient_result();
+  result->impl = std::move(r);
+  if (!err.IsOk()) result->error = err.Message();
+  return result;
+}
+
+int CollectRequest(tpuclient_input* const* inputs, int32_t n_inputs,
+                   tpuclient_output* const* outputs, int32_t n_outputs,
+                   std::vector<tputriton::InferInput*>* input_ptrs,
+                   std::vector<const tputriton::InferRequestedOutput*>*
+                       output_ptrs) {
+  if (n_inputs <= 0 || inputs == nullptr ||
+      (n_outputs > 0 && outputs == nullptr)) {
+    return FailMsg("null/empty argument");
+  }
+  for (int32_t i = 0; i < n_inputs; i++) {
+    if (inputs[i] == nullptr) return FailMsg("null input");
+    input_ptrs->push_back(inputs[i]->impl.get());
+  }
+  for (int32_t i = 0; i < n_outputs; i++) {
+    if (outputs[i] == nullptr) return FailMsg("null output");
+    output_ptrs->push_back(outputs[i]->impl.get());
+  }
+  return 0;
+}
+
+}  // namespace
+
 extern "C" {
+
+void tpuclient_free(void* p) { std::free(p); }
+
+const char* tpuclient_last_error(void) { return g_last_error.c_str(); }
+
+// ---- builders --------------------------------------------------------------
+
+int tpuclient_input_create(const char* name, const char* datatype,
+                           const int64_t* shape, int32_t rank,
+                           tpuclient_input** out) {
+  if (name == nullptr || datatype == nullptr || out == nullptr ||
+      (rank > 0 && shape == nullptr) || rank < 0) {
+    return FailMsg("null argument");
+  }
+  auto* input = new tpuclient_input();
+  input->impl = std::make_unique<tputriton::InferInput>(
+      name, std::vector<int64_t>(shape, shape + rank), datatype);
+  *out = input;
+  return Ok();
+}
+
+int tpuclient_input_append_raw(tpuclient_input* input, const uint8_t* data,
+                               size_t nbytes) {
+  if (input == nullptr || (nbytes > 0 && data == nullptr)) {
+    return FailMsg("null argument");
+  }
+  // AppendRaw copies into the input's own buffer (common.h data_.insert),
+  // so the caller's pointer need not outlive this call.
+  tputriton::Error err = input->impl->AppendRaw(data, nbytes);
+  if (!err.IsOk()) return Fail(err);
+  return Ok();
+}
+
+int tpuclient_input_set_shared_memory(tpuclient_input* input,
+                                      const char* region_name, size_t nbytes,
+                                      size_t offset) {
+  if (input == nullptr || region_name == nullptr) return FailMsg("null argument");
+  tputriton::Error err =
+      input->impl->SetSharedMemory(region_name, nbytes, offset);
+  if (!err.IsOk()) return Fail(err);
+  return Ok();
+}
+
+void tpuclient_input_destroy(tpuclient_input* input) { delete input; }
+
+int tpuclient_output_create(const char* name, tpuclient_output** out) {
+  if (name == nullptr || out == nullptr) return FailMsg("null argument");
+  auto* output = new tpuclient_output();
+  output->impl = std::make_unique<tputriton::InferRequestedOutput>(name);
+  *out = output;
+  return Ok();
+}
+
+int tpuclient_output_set_shared_memory(tpuclient_output* output,
+                                       const char* region_name, size_t nbytes,
+                                       size_t offset) {
+  if (output == nullptr || region_name == nullptr) {
+    return FailMsg("null argument");
+  }
+  tputriton::Error err =
+      output->impl->SetSharedMemory(region_name, nbytes, offset);
+  if (!err.IsOk()) return Fail(err);
+  return Ok();
+}
+
+void tpuclient_output_destroy(tpuclient_output* output) { delete output; }
+
+// ---- results ---------------------------------------------------------------
+
+const char* tpuclient_result_error(tpuclient_result* result) {
+  if (result == nullptr) return "null result";
+  return result->error.empty() ? nullptr : result->error.c_str();
+}
+
+const char* tpuclient_result_id(tpuclient_result* result) {
+  if (result == nullptr || result->impl == nullptr) return "";
+  return result->impl->Id().c_str();
+}
+
+int tpuclient_result_output(tpuclient_result* result, const char* name,
+                            const uint8_t** data, size_t* nbytes) {
+  if (result == nullptr || name == nullptr || data == nullptr ||
+      nbytes == nullptr) {
+    return FailMsg("null argument");
+  }
+  if (result->impl == nullptr) return FailMsg("errored result has no outputs");
+  tputriton::Error err = result->impl->RawData(name, data, nbytes);
+  if (!err.IsOk()) return Fail(err);
+  return Ok();
+}
+
+void tpuclient_result_destroy(tpuclient_result* result) { delete result; }
+
+// ---- HTTP ------------------------------------------------------------------
 
 int tpuclient_http_create(const char* url, tpuclient_http** out) {
   if (url == nullptr || out == nullptr) return FailMsg("null argument");
@@ -37,8 +218,7 @@ int tpuclient_http_create(const char* url, tpuclient_http** out) {
       tputriton::InferenceServerHttpClient::Create(&wrapper->impl, url);
   if (!err.IsOk()) return Fail(err);
   *out = wrapper.release();
-  g_last_error.clear();
-  return 0;
+  return Ok();
 }
 
 void tpuclient_http_destroy(tpuclient_http* client) { delete client; }
@@ -49,8 +229,7 @@ int tpuclient_http_is_server_live(tpuclient_http* client, int* live) {
   tputriton::Error err = client->impl->IsServerLive(&b);
   if (!err.IsOk()) return Fail(err);
   *live = b ? 1 : 0;
-  g_last_error.clear();
-  return 0;
+  return Ok();
 }
 
 int tpuclient_http_is_model_ready(tpuclient_http* client, const char* model,
@@ -62,8 +241,141 @@ int tpuclient_http_is_model_ready(tpuclient_http* client, const char* model,
   tputriton::Error err = client->impl->IsModelReady(model, &b);
   if (!err.IsOk()) return Fail(err);
   *ready = b ? 1 : 0;
-  g_last_error.clear();
-  return 0;
+  return Ok();
+}
+
+int tpuclient_http_infer2(tpuclient_http* client, const char* model_name,
+                          tpuclient_input* const* inputs, int32_t n_inputs,
+                          tpuclient_output* const* outputs, int32_t n_outputs,
+                          tpuclient_result** result) {
+  if (client == nullptr || model_name == nullptr || result == nullptr) {
+    return FailMsg("null argument");
+  }
+  std::vector<tputriton::InferInput*> input_ptrs;
+  std::vector<const tputriton::InferRequestedOutput*> output_ptrs;
+  if (CollectRequest(inputs, n_inputs, outputs, n_outputs, &input_ptrs,
+                     &output_ptrs) != 0) {
+    return 1;
+  }
+  tputriton::InferOptions options(model_name);
+  std::shared_ptr<tputriton::InferResult> r;
+  tputriton::Error err =
+      client->impl->Infer(&r, options, input_ptrs, output_ptrs);
+  if (!err.IsOk()) return Fail(err);
+  *result = MakeResult(std::move(r), tputriton::Error::Success);
+  return Ok();
+}
+
+int tpuclient_http_load_model(tpuclient_http* client, const char* model,
+                              const char* config_json) {
+  if (client == nullptr || model == nullptr) return FailMsg("null argument");
+  tputriton::Error err = client->impl->LoadModel(
+      model, config_json == nullptr ? "" : config_json);
+  if (!err.IsOk()) return Fail(err);
+  return Ok();
+}
+
+int tpuclient_http_unload_model(tpuclient_http* client, const char* model) {
+  if (client == nullptr || model == nullptr) return FailMsg("null argument");
+  tputriton::Error err = client->impl->UnloadModel(model);
+  if (!err.IsOk()) return Fail(err);
+  return Ok();
+}
+
+namespace {
+
+int HttpJsonOut(tpuclient_http* client, char** json,
+                const std::function<tputriton::Error(
+                    tputriton::json::ValuePtr*)>& fetch) {
+  if (client == nullptr || json == nullptr) return FailMsg("null argument");
+  tputriton::json::ValuePtr value;
+  tputriton::Error err = fetch(&value);
+  if (!err.IsOk()) return Fail(err);
+  return CopyOut(value == nullptr ? "null" : value->Serialize(), json);
+}
+
+}  // namespace
+
+int tpuclient_http_server_metadata(tpuclient_http* client, char** json) {
+  return HttpJsonOut(client, json, [&](tputriton::json::ValuePtr* v) {
+    return client->impl->ServerMetadata(v);
+  });
+}
+
+int tpuclient_http_model_metadata(tpuclient_http* client, const char* model,
+                                  char** json) {
+  if (model == nullptr) return FailMsg("null argument");
+  return HttpJsonOut(client, json, [&](tputriton::json::ValuePtr* v) {
+    return client->impl->ModelMetadata(v, model);
+  });
+}
+
+int tpuclient_http_model_config(tpuclient_http* client, const char* model,
+                                char** json) {
+  if (model == nullptr) return FailMsg("null argument");
+  return HttpJsonOut(client, json, [&](tputriton::json::ValuePtr* v) {
+    return client->impl->ModelConfig(v, model);
+  });
+}
+
+int tpuclient_http_model_statistics(tpuclient_http* client, const char* model,
+                                    char** json) {
+  return HttpJsonOut(client, json, [&](tputriton::json::ValuePtr* v) {
+    return client->impl->ModelInferenceStatistics(
+        v, model == nullptr ? "" : model);
+  });
+}
+
+int tpuclient_http_repository_index(tpuclient_http* client, char** json) {
+  return HttpJsonOut(client, json, [&](tputriton::json::ValuePtr* v) {
+    return client->impl->ModelRepositoryIndex(v);
+  });
+}
+
+int tpuclient_http_register_system_shared_memory(tpuclient_http* client,
+                                                 const char* name,
+                                                 const char* key,
+                                                 size_t byte_size,
+                                                 size_t offset) {
+  if (client == nullptr || name == nullptr || key == nullptr) {
+    return FailMsg("null argument");
+  }
+  tputriton::Error err =
+      client->impl->RegisterSystemSharedMemory(name, key, byte_size, offset);
+  if (!err.IsOk()) return Fail(err);
+  return Ok();
+}
+
+int tpuclient_http_unregister_system_shared_memory(tpuclient_http* client,
+                                                   const char* name) {
+  if (client == nullptr) return FailMsg("null argument");
+  tputriton::Error err = client->impl->UnregisterSystemSharedMemory(
+      name == nullptr ? "" : name);
+  if (!err.IsOk()) return Fail(err);
+  return Ok();
+}
+
+int tpuclient_http_register_tpu_shared_memory(tpuclient_http* client,
+                                              const char* name,
+                                              const char* raw_handle_b64,
+                                              int64_t device_id,
+                                              size_t byte_size) {
+  if (client == nullptr || name == nullptr || raw_handle_b64 == nullptr) {
+    return FailMsg("null argument");
+  }
+  tputriton::Error err = client->impl->RegisterTpuSharedMemory(
+      name, raw_handle_b64, device_id, byte_size);
+  if (!err.IsOk()) return Fail(err);
+  return Ok();
+}
+
+int tpuclient_http_unregister_tpu_shared_memory(tpuclient_http* client,
+                                                const char* name) {
+  if (client == nullptr) return FailMsg("null argument");
+  tputriton::Error err =
+      client->impl->UnregisterTpuSharedMemory(name == nullptr ? "" : name);
+  if (!err.IsOk()) return Fail(err);
+  return Ok();
 }
 
 int tpuclient_http_infer(
@@ -124,12 +436,296 @@ int tpuclient_http_infer(
     std::memcpy(out_data[i], buf, nbytes);
     out_nbytes[i] = nbytes;
   }
-  g_last_error.clear();
-  return 0;
+  return Ok();
 }
 
-void tpuclient_free(void* p) { std::free(p); }
+// ---- gRPC ------------------------------------------------------------------
 
-const char* tpuclient_last_error(void) { return g_last_error.c_str(); }
+int tpuclient_grpc_create(const char* url, tpuclient_grpc** out) {
+  if (url == nullptr || out == nullptr) return FailMsg("null argument");
+  auto wrapper = std::make_unique<tpuclient_grpc>();
+  tputriton::Error err =
+      tputriton::InferenceServerGrpcClient::Create(&wrapper->impl, url);
+  if (!err.IsOk()) return Fail(err);
+  *out = wrapper.release();
+  return Ok();
+}
+
+void tpuclient_grpc_destroy(tpuclient_grpc* client) { delete client; }
+
+int tpuclient_grpc_is_server_live(tpuclient_grpc* client, int* live) {
+  if (client == nullptr || live == nullptr) return FailMsg("null argument");
+  bool b = false;
+  tputriton::Error err = client->impl->IsServerLive(&b);
+  if (!err.IsOk()) return Fail(err);
+  *live = b ? 1 : 0;
+  return Ok();
+}
+
+int tpuclient_grpc_is_model_ready(tpuclient_grpc* client, const char* model,
+                                  int* ready) {
+  if (client == nullptr || model == nullptr || ready == nullptr) {
+    return FailMsg("null argument");
+  }
+  bool b = false;
+  tputriton::Error err = client->impl->IsModelReady(model, &b);
+  if (!err.IsOk()) return Fail(err);
+  *ready = b ? 1 : 0;
+  return Ok();
+}
+
+int tpuclient_grpc_infer(tpuclient_grpc* client, const char* model_name,
+                         tpuclient_input* const* inputs, int32_t n_inputs,
+                         tpuclient_output* const* outputs, int32_t n_outputs,
+                         tpuclient_result** result) {
+  if (client == nullptr || model_name == nullptr || result == nullptr) {
+    return FailMsg("null argument");
+  }
+  std::vector<tputriton::InferInput*> input_ptrs;
+  std::vector<const tputriton::InferRequestedOutput*> output_ptrs;
+  if (CollectRequest(inputs, n_inputs, outputs, n_outputs, &input_ptrs,
+                     &output_ptrs) != 0) {
+    return 1;
+  }
+  tputriton::InferOptions options(model_name);
+  std::shared_ptr<tputriton::InferResult> r;
+  tputriton::Error err =
+      client->impl->Infer(&r, options, input_ptrs, output_ptrs);
+  if (!err.IsOk()) return Fail(err);
+  *result = MakeResult(std::move(r), tputriton::Error::Success);
+  return Ok();
+}
+
+int tpuclient_grpc_start_stream(tpuclient_grpc* client,
+                                tpuclient_stream_callback callback,
+                                void* user_data) {
+  if (client == nullptr || callback == nullptr) return FailMsg("null argument");
+  tputriton::Error err = client->impl->StartStream(
+      [callback, user_data](std::shared_ptr<tputriton::InferResult> r,
+                            tputriton::Error e) {
+        callback(user_data, MakeResult(std::move(r), e));
+      });
+  if (!err.IsOk()) return Fail(err);
+  return Ok();
+}
+
+int tpuclient_grpc_async_stream_infer(tpuclient_grpc* client,
+                                      const char* model_name,
+                                      const char* request_id,
+                                      tpuclient_input* const* inputs,
+                                      int32_t n_inputs,
+                                      tpuclient_output* const* outputs,
+                                      int32_t n_outputs) {
+  if (client == nullptr || model_name == nullptr) return FailMsg("null argument");
+  std::vector<tputriton::InferInput*> input_ptrs;
+  std::vector<const tputriton::InferRequestedOutput*> output_ptrs;
+  if (CollectRequest(inputs, n_inputs, outputs, n_outputs, &input_ptrs,
+                     &output_ptrs) != 0) {
+    return 1;
+  }
+  tputriton::InferOptions options(model_name);
+  if (request_id != nullptr) options.request_id_ = request_id;
+  tputriton::Error err =
+      client->impl->AsyncStreamInfer(options, input_ptrs, output_ptrs);
+  if (!err.IsOk()) return Fail(err);
+  return Ok();
+}
+
+int tpuclient_grpc_stop_stream(tpuclient_grpc* client) {
+  if (client == nullptr) return FailMsg("null argument");
+  tputriton::Error err = client->impl->StopStream();
+  if (!err.IsOk()) return Fail(err);
+  return Ok();
+}
+
+int tpuclient_grpc_load_model(tpuclient_grpc* client, const char* model,
+                              const char* config_json) {
+  if (client == nullptr || model == nullptr) return FailMsg("null argument");
+  tputriton::Error err = client->impl->LoadModel(
+      model, config_json == nullptr ? "" : config_json);
+  if (!err.IsOk()) return Fail(err);
+  return Ok();
+}
+
+int tpuclient_grpc_unload_model(tpuclient_grpc* client, const char* model) {
+  if (client == nullptr || model == nullptr) return FailMsg("null argument");
+  tputriton::Error err = client->impl->UnloadModel(model);
+  if (!err.IsOk()) return Fail(err);
+  return Ok();
+}
+
+int tpuclient_grpc_server_metadata(tpuclient_grpc* client, char** json) {
+  if (client == nullptr || json == nullptr) return FailMsg("null argument");
+  inference::ServerMetadataResponse md;
+  tputriton::Error err = client->impl->ServerMetadata(&md);
+  if (!err.IsOk()) return Fail(err);
+  auto v = tputriton::json::Value::MakeObject();
+  v->Set("name", md.name());
+  v->Set("version", md.version());
+  auto ext = tputriton::json::Value::MakeArray();
+  for (const auto& e : md.extensions()) ext->Append(e);
+  v->Set("extensions", ext);
+  return CopyOut(v->Serialize(), json);
+}
+
+int tpuclient_grpc_model_metadata(tpuclient_grpc* client, const char* model,
+                                  char** json) {
+  if (client == nullptr || model == nullptr || json == nullptr) {
+    return FailMsg("null argument");
+  }
+  inference::ModelMetadataResponse md;
+  tputriton::Error err = client->impl->ModelMetadata(&md, model);
+  if (!err.IsOk()) return Fail(err);
+  auto v = tputriton::json::Value::MakeObject();
+  v->Set("name", md.name());
+  v->Set("platform", md.platform());
+  auto versions = tputriton::json::Value::MakeArray();
+  for (const auto& ver : md.versions()) versions->Append(ver);
+  v->Set("versions", versions);
+  auto inputs = tputriton::json::Value::MakeArray();
+  for (const auto& t : md.inputs()) inputs->Append(TensorMetaJson(t));
+  v->Set("inputs", inputs);
+  auto outputs = tputriton::json::Value::MakeArray();
+  for (const auto& t : md.outputs()) outputs->Append(TensorMetaJson(t));
+  v->Set("outputs", outputs);
+  return CopyOut(v->Serialize(), json);
+}
+
+int tpuclient_grpc_model_config(tpuclient_grpc* client, const char* model,
+                                char** json) {
+  if (client == nullptr || model == nullptr || json == nullptr) {
+    return FailMsg("null argument");
+  }
+  inference::ModelConfigResponse resp;
+  tputriton::Error err = client->impl->ModelConfig(&resp, model);
+  if (!err.IsOk()) return Fail(err);
+  const auto& c = resp.config();
+  auto v = tputriton::json::Value::MakeObject();
+  v->Set("name", c.name());
+  v->Set("platform", c.platform());
+  v->Set("backend", c.backend());
+  v->Set("max_batch_size", static_cast<int64_t>(c.max_batch_size()));
+  auto io_json = [](auto& field) {
+    auto arr = tputriton::json::Value::MakeArray();
+    for (const auto& t : field) {
+      auto e = tputriton::json::Value::MakeObject();
+      e->Set("name", t.name());
+      e->Set("data_type",
+             inference::DataType_Name(t.data_type()));
+      auto dims = tputriton::json::Value::MakeArray();
+      for (int64_t d : t.dims()) dims->Append(d);
+      e->Set("dims", dims);
+      arr->Append(e);
+    }
+    return arr;
+  };
+  v->Set("input", io_json(c.input()));
+  v->Set("output", io_json(c.output()));
+  if (c.model_transaction_policy().decoupled()) {
+    auto policy = tputriton::json::Value::MakeObject();
+    policy->Set("decoupled", true);
+    v->Set("model_transaction_policy", policy);
+  }
+  return CopyOut(v->Serialize(), json);
+}
+
+int tpuclient_grpc_model_statistics(tpuclient_grpc* client, const char* model,
+                                    char** json) {
+  if (client == nullptr || json == nullptr) return FailMsg("null argument");
+  inference::ModelStatisticsResponse resp;
+  tputriton::Error err = client->impl->ModelInferenceStatistics(
+      &resp, model == nullptr ? "" : model);
+  if (!err.IsOk()) return Fail(err);
+  auto v = tputriton::json::Value::MakeObject();
+  auto stats = tputriton::json::Value::MakeArray();
+  for (const auto& s : resp.model_stats()) {
+    auto e = tputriton::json::Value::MakeObject();
+    e->Set("name", s.name());
+    e->Set("version", s.version());
+    e->Set("last_inference", static_cast<int64_t>(s.last_inference()));
+    e->Set("inference_count", static_cast<int64_t>(s.inference_count()));
+    e->Set("execution_count", static_cast<int64_t>(s.execution_count()));
+    auto inf = tputriton::json::Value::MakeObject();
+    inf->Set("success", DurationJson(s.inference_stats().success()));
+    inf->Set("fail", DurationJson(s.inference_stats().fail()));
+    inf->Set("queue", DurationJson(s.inference_stats().queue()));
+    inf->Set("compute_input",
+             DurationJson(s.inference_stats().compute_input()));
+    inf->Set("compute_infer",
+             DurationJson(s.inference_stats().compute_infer()));
+    inf->Set("compute_output",
+             DurationJson(s.inference_stats().compute_output()));
+    e->Set("inference_stats", inf);
+    stats->Append(e);
+  }
+  v->Set("model_stats", stats);
+  return CopyOut(v->Serialize(), json);
+}
+
+int tpuclient_grpc_repository_index(tpuclient_grpc* client, char** json) {
+  if (client == nullptr || json == nullptr) return FailMsg("null argument");
+  inference::RepositoryIndexResponse resp;
+  tputriton::Error err = client->impl->ModelRepositoryIndex(&resp);
+  if (!err.IsOk()) return Fail(err);
+  auto arr = tputriton::json::Value::MakeArray();
+  for (const auto& m : resp.models()) {
+    auto e = tputriton::json::Value::MakeObject();
+    e->Set("name", m.name());
+    e->Set("version", m.version());
+    e->Set("state", m.state());
+    e->Set("reason", m.reason());
+    arr->Append(e);
+  }
+  return CopyOut(arr->Serialize(), json);
+}
+
+int tpuclient_grpc_register_system_shared_memory(tpuclient_grpc* client,
+                                                 const char* name,
+                                                 const char* key,
+                                                 size_t byte_size,
+                                                 size_t offset) {
+  if (client == nullptr || name == nullptr || key == nullptr) {
+    return FailMsg("null argument");
+  }
+  tputriton::Error err =
+      client->impl->RegisterSystemSharedMemory(name, key, byte_size, offset);
+  if (!err.IsOk()) return Fail(err);
+  return Ok();
+}
+
+int tpuclient_grpc_unregister_system_shared_memory(tpuclient_grpc* client,
+                                                   const char* name) {
+  if (client == nullptr) return FailMsg("null argument");
+  tputriton::Error err = client->impl->UnregisterSystemSharedMemory(
+      name == nullptr ? "" : name);
+  if (!err.IsOk()) return Fail(err);
+  return Ok();
+}
+
+int tpuclient_grpc_register_tpu_shared_memory(tpuclient_grpc* client,
+                                              const char* name,
+                                              const uint8_t* raw_handle,
+                                              size_t raw_handle_len,
+                                              int64_t device_id,
+                                              size_t byte_size) {
+  if (client == nullptr || name == nullptr || raw_handle == nullptr) {
+    return FailMsg("null argument");
+  }
+  tputriton::Error err = client->impl->RegisterTpuSharedMemory(
+      name,
+      std::string(reinterpret_cast<const char*>(raw_handle), raw_handle_len),
+      device_id, byte_size);
+  if (!err.IsOk()) return Fail(err);
+  return Ok();
+}
+
+int tpuclient_grpc_unregister_tpu_shared_memory(tpuclient_grpc* client,
+                                                const char* name) {
+  if (client == nullptr) return FailMsg("null argument");
+  tputriton::Error err =
+      client->impl->UnregisterTpuSharedMemory(name == nullptr ? "" : name);
+  if (!err.IsOk()) return Fail(err);
+  return Ok();
+}
 
 }  // extern "C"

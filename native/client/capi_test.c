@@ -1,0 +1,340 @@
+/* Pure-C consumer of the flat ABI (capi.h): proves C linkage and drives
+ * the full binding surface against the live in-process server —
+ * health, builder-based inference on both transports, system shared
+ * memory (create + register + shm-routed infer + readback), gRPC bidi
+ * streaming with callbacks, model control, and the JSON introspection
+ * calls. Driven by tests/test_capi.py:
+ *
+ *   capi_test <http host:port> <grpc host:port>
+ */
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include "capi.h"
+
+static int failures = 0;
+
+#define EXPECT(cond, msg)                        \
+  do {                                           \
+    if (!(cond)) {                               \
+      fprintf(stderr, "FAIL: %s\n", msg);        \
+      failures++;                                \
+    }                                            \
+  } while (0)
+
+#define EXPECT_RC(call, msg)                                            \
+  do {                                                                  \
+    if ((call) != 0) {                                                  \
+      fprintf(stderr, "FAIL: %s: %s\n", msg, tpuclient_last_error());   \
+      failures++;                                                       \
+    }                                                                   \
+  } while (0)
+
+/* ---- streaming callback state ------------------------------------------- */
+
+typedef struct {
+  pthread_mutex_t mu;
+  pthread_cond_t cv;
+  int done;
+  int errors;
+  int32_t last_sum3; /* element [3] of OUTPUT0 from the last result */
+} stream_state;
+
+static void on_stream_result(void* user_data, tpuclient_result* result) {
+  stream_state* st = (stream_state*)user_data;
+  pthread_mutex_lock(&st->mu);
+  const char* err = tpuclient_result_error(result);
+  if (err != NULL) {
+    st->errors++;
+  } else {
+    const uint8_t* data = NULL;
+    size_t nbytes = 0;
+    if (tpuclient_result_output(result, "OUTPUT0", &data, &nbytes) == 0 &&
+        nbytes >= 4 * sizeof(int32_t)) {
+      st->last_sum3 = ((const int32_t*)data)[3];
+    } else {
+      st->errors++;
+    }
+  }
+  st->done++;
+  pthread_cond_signal(&st->cv);
+  pthread_mutex_unlock(&st->mu);
+  tpuclient_result_destroy(result);
+}
+
+/* ---- helpers -------------------------------------------------------------- */
+
+static tpuclient_input* make_int32_input(const char* name,
+                                         const int32_t* values, int64_t rows,
+                                         int64_t cols) {
+  int64_t shape[2];
+  tpuclient_input* input = NULL;
+  shape[0] = rows;
+  shape[1] = cols;
+  if (tpuclient_input_create(name, "INT32", shape, 2, &input) != 0) return NULL;
+  if (values != NULL &&
+      tpuclient_input_append_raw(input, (const uint8_t*)values,
+                                 (size_t)(rows * cols) * sizeof(int32_t)) != 0) {
+    tpuclient_input_destroy(input);
+    return NULL;
+  }
+  return input;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: capi_test <http host:port> <grpc host:port>\n");
+    return 2;
+  }
+
+  tpuclient_http* http = NULL;
+  tpuclient_grpc* grpc = NULL;
+  EXPECT_RC(tpuclient_http_create(argv[1], &http), "http create");
+  EXPECT_RC(tpuclient_grpc_create(argv[2], &grpc), "grpc create");
+  if (http == NULL || grpc == NULL) return 1;
+
+  int live = 0, ready = 0;
+  EXPECT_RC(tpuclient_http_is_server_live(http, &live), "http live");
+  EXPECT(live == 1, "http server live");
+  EXPECT_RC(tpuclient_grpc_is_server_live(grpc, &live), "grpc live");
+  EXPECT(live == 1, "grpc server live");
+  EXPECT_RC(tpuclient_grpc_is_model_ready(grpc, "simple", &ready),
+            "grpc model ready");
+  EXPECT(ready == 1, "simple ready");
+
+  /* ---- builder-based inference on both transports ---- */
+  {
+    int32_t in0[16], in1[16];
+    int i;
+    for (i = 0; i < 16; i++) {
+      in0[i] = i;
+      in1[i] = 3 * i;
+    }
+    tpuclient_input* inputs[2];
+    tpuclient_output* outputs[2];
+    inputs[0] = make_int32_input("INPUT0", in0, 1, 16);
+    inputs[1] = make_int32_input("INPUT1", in1, 1, 16);
+    tpuclient_output_create("OUTPUT0", &outputs[0]);
+    tpuclient_output_create("OUTPUT1", &outputs[1]);
+    EXPECT(inputs[0] && inputs[1] && outputs[0] && outputs[1],
+           "builder allocation");
+
+    tpuclient_result* result = NULL;
+    EXPECT_RC(tpuclient_grpc_infer(grpc, "simple", inputs, 2, outputs, 2,
+                                   &result),
+              "grpc infer");
+    if (result != NULL) {
+      const uint8_t* data = NULL;
+      size_t nbytes = 0;
+      EXPECT(tpuclient_result_error(result) == NULL, "grpc result ok");
+      EXPECT_RC(tpuclient_result_output(result, "OUTPUT0", &data, &nbytes),
+                "grpc OUTPUT0");
+      EXPECT(nbytes == sizeof(in0) && ((const int32_t*)data)[5] == in0[5] + in1[5],
+             "grpc sum value");
+      tpuclient_result_destroy(result);
+    }
+
+    result = NULL;
+    EXPECT_RC(tpuclient_http_infer2(http, "simple", inputs, 2, outputs, 2,
+                                    &result),
+              "http infer2");
+    if (result != NULL) {
+      const uint8_t* data = NULL;
+      size_t nbytes = 0;
+      EXPECT_RC(tpuclient_result_output(result, "OUTPUT1", &data, &nbytes),
+                "http OUTPUT1");
+      EXPECT(nbytes == sizeof(in0) && ((const int32_t*)data)[5] == in0[5] - in1[5],
+             "http diff value");
+      tpuclient_result_destroy(result);
+    }
+
+    tpuclient_input_destroy(inputs[0]);
+    tpuclient_input_destroy(inputs[1]);
+    tpuclient_output_destroy(outputs[0]);
+    tpuclient_output_destroy(outputs[1]);
+  }
+
+  /* ---- system shared memory: create, register, shm-routed infer ---- */
+  {
+    const char* key = "/capi_test_shm";
+    const size_t in_bytes = 2 * 16 * sizeof(int32_t);
+    const size_t out_bytes = 2 * 16 * sizeof(int32_t);
+    shm_unlink(key);
+    int fd = shm_open(key, O_CREAT | O_RDWR, 0600);
+    EXPECT(fd >= 0, "shm_open");
+    EXPECT(ftruncate(fd, (off_t)(in_bytes + out_bytes)) == 0, "ftruncate");
+    int32_t* base = (int32_t*)mmap(NULL, in_bytes + out_bytes,
+                                   PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    EXPECT(base != MAP_FAILED, "mmap");
+    int i;
+    for (i = 0; i < 16; i++) {
+      base[i] = 10 + i;       /* INPUT0 */
+      base[16 + i] = 2;       /* INPUT1 */
+    }
+
+    EXPECT_RC(tpuclient_grpc_register_system_shared_memory(
+                  grpc, "capi_region", key, in_bytes + out_bytes, 0),
+              "register system shm");
+
+    tpuclient_input* inputs[2];
+    tpuclient_output* outputs[2];
+    inputs[0] = make_int32_input("INPUT0", NULL, 1, 16);
+    inputs[1] = make_int32_input("INPUT1", NULL, 1, 16);
+    tpuclient_input_set_shared_memory(inputs[0], "capi_region",
+                                      16 * sizeof(int32_t), 0);
+    tpuclient_input_set_shared_memory(inputs[1], "capi_region",
+                                      16 * sizeof(int32_t),
+                                      16 * sizeof(int32_t));
+    tpuclient_output_create("OUTPUT0", &outputs[0]);
+    tpuclient_output_create("OUTPUT1", &outputs[1]);
+    tpuclient_output_set_shared_memory(outputs[0], "capi_region",
+                                       16 * sizeof(int32_t), in_bytes);
+    tpuclient_output_set_shared_memory(outputs[1], "capi_region",
+                                       16 * sizeof(int32_t),
+                                       in_bytes + 16 * sizeof(int32_t));
+
+    tpuclient_result* result = NULL;
+    EXPECT_RC(tpuclient_grpc_infer(grpc, "simple", inputs, 2, outputs, 2,
+                                   &result),
+              "shm infer");
+    if (result != NULL) tpuclient_result_destroy(result);
+    /* outputs landed in the region, not the wire */
+    EXPECT(base[32 + 4] == (10 + 4) + 2, "shm OUTPUT0 value");
+    EXPECT(base[48 + 4] == (10 + 4) - 2, "shm OUTPUT1 value");
+
+    EXPECT_RC(tpuclient_grpc_unregister_system_shared_memory(grpc,
+                                                             "capi_region"),
+              "unregister system shm");
+    tpuclient_input_destroy(inputs[0]);
+    tpuclient_input_destroy(inputs[1]);
+    tpuclient_output_destroy(outputs[0]);
+    tpuclient_output_destroy(outputs[1]);
+    munmap(base, in_bytes + out_bytes);
+    close(fd);
+    shm_unlink(key);
+  }
+
+  /* ---- gRPC streaming with callbacks ---- */
+  {
+    stream_state st;
+    memset(&st, 0, sizeof(st));
+    pthread_mutex_init(&st.mu, NULL);
+    pthread_cond_init(&st.cv, NULL);
+
+    EXPECT_RC(tpuclient_grpc_start_stream(grpc, on_stream_result, &st),
+              "start stream");
+    int32_t in0[16], in1[16];
+    int i, n;
+    for (i = 0; i < 16; i++) {
+      in0[i] = i;
+      in1[i] = 1;
+    }
+    const int kRequests = 5;
+    int submitted = 0;  /* wait only for requests that actually went out */
+    for (n = 0; n < kRequests; n++) {
+      tpuclient_input* inputs[2];
+      char rid[16];
+      inputs[0] = make_int32_input("INPUT0", in0, 1, 16);
+      inputs[1] = make_int32_input("INPUT1", in1, 1, 16);
+      snprintf(rid, sizeof(rid), "req%d", n);
+      if (tpuclient_grpc_async_stream_infer(grpc, "simple", rid, inputs, 2,
+                                            NULL, 0) == 0) {
+        submitted++;
+      } else {
+        fprintf(stderr, "FAIL: stream infer: %s\n", tpuclient_last_error());
+        failures++;
+      }
+      tpuclient_input_destroy(inputs[0]);
+      tpuclient_input_destroy(inputs[1]);
+    }
+    EXPECT(submitted == kRequests, "all stream requests submitted");
+    pthread_mutex_lock(&st.mu);
+    while (st.done < submitted) {
+      pthread_cond_wait(&st.cv, &st.mu);
+    }
+    pthread_mutex_unlock(&st.mu);
+    EXPECT(st.errors == 0, "stream errors");
+    EXPECT(st.last_sum3 == in0[3] + in1[3], "stream sum value");
+    EXPECT_RC(tpuclient_grpc_stop_stream(grpc), "stop stream");
+    pthread_mutex_destroy(&st.mu);
+    pthread_cond_destroy(&st.cv);
+  }
+
+  /* ---- model control + JSON introspection ---- */
+  {
+    char* json = NULL;
+    EXPECT_RC(tpuclient_grpc_server_metadata(grpc, &json), "grpc server meta");
+    EXPECT(json != NULL && strstr(json, "triton-tpu") != NULL,
+           "server metadata name");
+    tpuclient_free(json);
+
+    json = NULL;
+    EXPECT_RC(tpuclient_grpc_model_metadata(grpc, "simple", &json),
+              "grpc model meta");
+    EXPECT(json != NULL && strstr(json, "INPUT0") != NULL, "model meta inputs");
+    tpuclient_free(json);
+
+    json = NULL;
+    EXPECT_RC(tpuclient_grpc_model_config(grpc, "simple", &json),
+              "grpc model config");
+    EXPECT(json != NULL && strstr(json, "jax") != NULL, "model config backend");
+    tpuclient_free(json);
+
+    json = NULL;
+    EXPECT_RC(tpuclient_grpc_model_statistics(grpc, "simple", &json),
+              "grpc model stats");
+    EXPECT(json != NULL && strstr(json, "inference_count") != NULL,
+           "model stats fields");
+    tpuclient_free(json);
+
+    json = NULL;
+    EXPECT_RC(tpuclient_grpc_repository_index(grpc, &json), "grpc repo index");
+    EXPECT(json != NULL && strstr(json, "simple") != NULL, "repo index models");
+    tpuclient_free(json);
+
+    json = NULL;
+    EXPECT_RC(tpuclient_http_server_metadata(http, &json), "http server meta");
+    EXPECT(json != NULL && strstr(json, "triton-tpu") != NULL,
+           "http server metadata name");
+    tpuclient_free(json);
+
+    json = NULL;
+    EXPECT_RC(tpuclient_http_model_statistics(http, "simple", &json),
+              "http model stats");
+    EXPECT(json != NULL && strstr(json, "inference_count") != NULL,
+           "http model stats fields");
+    tpuclient_free(json);
+
+    /* unload -> not ready -> load -> ready (both transports drive control) */
+    EXPECT_RC(tpuclient_grpc_unload_model(grpc, "simple"), "unload");
+    EXPECT_RC(tpuclient_grpc_is_model_ready(grpc, "simple", &ready),
+              "ready after unload");
+    EXPECT(ready == 0, "not ready after unload");
+    EXPECT_RC(tpuclient_http_load_model(http, "simple", NULL), "http load");
+    EXPECT_RC(tpuclient_grpc_is_model_ready(grpc, "simple", &ready),
+              "ready after load");
+    EXPECT(ready == 1, "ready after load");
+
+    /* errors carry messages */
+    EXPECT(tpuclient_grpc_unload_model(grpc, "no_such_model") != 0,
+           "unload unknown fails");
+    EXPECT(strlen(tpuclient_last_error()) > 0, "error message populated");
+  }
+
+  tpuclient_grpc_destroy(grpc);
+  tpuclient_http_destroy(http);
+
+  if (failures == 0) {
+    printf("ALL PASS\n");
+    return 0;
+  }
+  fprintf(stderr, "%d failures\n", failures);
+  return 1;
+}

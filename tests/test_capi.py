@@ -96,6 +96,81 @@ def test_capi_health_and_errors(capi, server):
         capi.tpuclient_http_destroy(handle)
 
 
+def test_capi_full_surface_from_c(capi):
+    """The pure-C consumer binary (capi_test.c): C linkage + builders,
+    both transports, system shm routing, streaming callbacks, model
+    control, and JSON introspection (round-2 verdict item 4 scope)."""
+    with InferenceServer() as s:
+        proc = subprocess.run(
+            [os.path.join(BUILD, "capi_test"), s.http_address, s.grpc_address],
+            capture_output=True, text=True, timeout=120,
+        )
+    assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr}"
+    assert "ALL PASS" in proc.stdout
+
+
+def test_capi_tpu_shared_memory_coloc(capi):
+    """TPU shm registration through the C ABI: regions are process-scoped,
+    so the gRPC server and the ctypes consumer share this process."""
+    import tritonclient_tpu.utils.tpu_shared_memory as tpushm
+
+    lib = capi
+    lib.tpuclient_grpc_create.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
+    lib.tpuclient_grpc_destroy.argtypes = [ctypes.c_void_p]
+    lib.tpuclient_grpc_register_tpu_shared_memory.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.c_int64, ctypes.c_size_t]
+    lib.tpuclient_grpc_unregister_tpu_shared_memory.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p]
+
+    nbytes = 16 * 4
+    with InferenceServer(http=False) as s:
+        handle = ctypes.c_void_p()
+        rc = lib.tpuclient_grpc_create(
+            s.grpc_address.encode(), ctypes.byref(handle))
+        assert rc == 0, lib.tpuclient_last_error()
+        region = tpushm.create_shared_memory_region("capi_tpu", nbytes, 0)
+        try:
+            raw = tpushm.get_raw_handle(region)
+            rc = lib.tpuclient_grpc_register_tpu_shared_memory(
+                handle, b"capi_tpu", raw, len(raw), 0, nbytes)
+            assert rc == 0, lib.tpuclient_last_error()
+            assert "capi_tpu" in s.core.tpu_shm
+            rc = lib.tpuclient_grpc_unregister_tpu_shared_memory(
+                handle, b"capi_tpu")
+            assert rc == 0, lib.tpuclient_last_error()
+            assert "capi_tpu" not in s.core.tpu_shm
+        finally:
+            tpushm.destroy_shared_memory_region(region)
+            lib.tpuclient_grpc_destroy(handle)
+
+
+def test_java_ffm_bindings_symbols_exist(capi):
+    """Every symbol the Java FFM bindings downcall must be exported by the
+    shared library — the strongest drift check available without a JDK
+    (the bindings' own self-check main needs one to run)."""
+    import re
+
+    java = os.path.join(
+        REPO, "clients", "java-api-bindings", "src", "main", "java",
+        "TpuClientBindings.java",
+    )
+    with open(java) as f:
+        src = f.read()
+    wanted = set(re.findall(r'down\("([a-z0-9_]+)"', src))
+    assert wanted, "no downcalls found — parse drift?"
+    nm = subprocess.run(
+        ["nm", "-D", os.path.join(BUILD, "libtpuhttpclient.so")],
+        capture_output=True, text=True, check=True,
+    )
+    exported = {
+        line.split()[-1] for line in nm.stdout.splitlines() if " T " in line
+    }
+    missing = wanted - exported
+    assert not missing, f"bindings reference unexported symbols: {missing}"
+
+
 def test_capi_infer_roundtrip(capi, server):
     handle = _create(capi, server.http_address)
     try:
