@@ -1,0 +1,376 @@
+// The cc_client_test matrix, typed over BOTH native clients — the port of
+// the reference's typed gtest suite (cc_client_test.cc:298-2184,
+// INSTANTIATE_TYPED_TEST_SUITE_P GRPC/HTTP :2183-2184): InferMulti /
+// AsyncInferMulti incl. option-count and output-count mismatch errors,
+// LoadWithFileOverride / LoadWithConfigOverride, and trace-setting
+// update/clear semantics. No gtest in this image, so the "typed suite" is
+// a template over thin client adapters.
+//
+//   cc_matrix_test <http host:port> <grpc host:port>
+
+#include <condition_variable>
+#include <cstring>
+#include <iostream>
+#include <map>
+#include <mutex>
+#include <vector>
+
+#include "grpc_client.h"
+#include "http_client.h"
+
+using namespace tputriton;  // NOLINT
+
+static int failures = 0;
+
+#define EXPECT(cond, msg)                              \
+  do {                                                 \
+    if (!(cond)) {                                     \
+      std::cerr << "FAIL: " << msg << "\n";            \
+      failures++;                                      \
+    }                                                  \
+  } while (0)
+
+#define EXPECT_OK(err, msg)                                               \
+  do {                                                                    \
+    Error e = (err);                                                      \
+    if (!e.IsOk()) {                                                      \
+      std::cerr << "FAIL: " << msg << ": " << e.Message() << "\n";        \
+      failures++;                                                         \
+    }                                                                     \
+  } while (0)
+
+#define EXPECT_ERR(err, needle, msg)                                       \
+  do {                                                                     \
+    Error e = (err);                                                       \
+    if (e.IsOk() || e.Message().find(needle) == std::string::npos) {       \
+      std::cerr << "FAIL: " << msg << " (got '"                            \
+                << (e.IsOk() ? std::string("OK") : e.Message()) << "')\n"; \
+      failures++;                                                          \
+    }                                                                      \
+  } while (0)
+
+// ---------------------------------------------------------------------------
+// client adapters: the common operations the matrix drives, with JSON/proto
+// differences flattened to plain C++ values.
+// ---------------------------------------------------------------------------
+
+struct HttpAdapter {
+  static const char* Name() { return "http"; }
+  std::unique_ptr<InferenceServerHttpClient> client;
+
+  Error Connect(const std::string& url) {
+    return InferenceServerHttpClient::Create(&client, url);
+  }
+  Error InferMulti(std::vector<std::shared_ptr<InferResult>>* results,
+                   const std::vector<InferOptions>& options,
+                   const std::vector<std::vector<InferInput*>>& inputs,
+                   const std::vector<std::vector<const InferRequestedOutput*>>&
+                       outputs) {
+    return client->InferMulti(results, options, inputs, outputs);
+  }
+  Error AsyncInferMulti(
+      InferenceServerHttpClient::OnMultiCompleteFn callback,
+      const std::vector<InferOptions>& options,
+      const std::vector<std::vector<InferInput*>>& inputs) {
+    return client->AsyncInferMulti(callback, options, inputs);
+  }
+  Error Load(const std::string& model, const std::string& config,
+             const std::map<std::string, std::string>& files) {
+    return client->LoadModel(model, config, files);
+  }
+  Error Unload(const std::string& model) { return client->UnloadModel(model); }
+  Error Ready(const std::string& model, const std::string& version,
+              bool* ready) {
+    return client->IsModelReady(model, ready, version);
+  }
+  Error MaxBatchSize(const std::string& model, int64_t* out) {
+    json::ValuePtr cfg;
+    Error err = client->ModelConfig(&cfg, model);
+    if (!err.IsOk()) return err;
+    auto v = cfg->Get("max_batch_size");
+    *out = v == nullptr ? 0 : v->AsInt();
+    return Error::Success;
+  }
+  Error TraceLevel(const std::string& model, std::string* level) {
+    json::ValuePtr settings;
+    Error err = client->GetTraceSettings(&settings, model);
+    if (!err.IsOk()) return err;
+    auto v = settings->Get("trace_level");
+    *level = (v != nullptr && v->Size() > 0) ? v->At(0)->AsString() : "";
+    return Error::Success;
+  }
+  Error SetTraceLevel(const std::string& model, const std::string& level) {
+    json::ValuePtr response;
+    return client->UpdateTraceSettings(
+        &response, model, "{\"trace_level\": [\"" + level + "\"]}");
+  }
+  Error ClearTraceLevel(const std::string& model) {
+    json::ValuePtr response;
+    return client->UpdateTraceSettings(&response, model,
+                                       "{\"trace_level\": null}");
+  }
+};
+
+struct GrpcAdapter {
+  static const char* Name() { return "grpc"; }
+  std::unique_ptr<InferenceServerGrpcClient> client;
+
+  Error Connect(const std::string& url) {
+    return InferenceServerGrpcClient::Create(&client, url);
+  }
+  Error InferMulti(std::vector<std::shared_ptr<InferResult>>* results,
+                   const std::vector<InferOptions>& options,
+                   const std::vector<std::vector<InferInput*>>& inputs,
+                   const std::vector<std::vector<const InferRequestedOutput*>>&
+                       outputs) {
+    return client->InferMulti(results, options, inputs, outputs);
+  }
+  Error AsyncInferMulti(
+      InferenceServerGrpcClient::OnMultiCompleteFn callback,
+      const std::vector<InferOptions>& options,
+      const std::vector<std::vector<InferInput*>>& inputs) {
+    return client->AsyncInferMulti(callback, options, inputs);
+  }
+  Error Load(const std::string& model, const std::string& config,
+             const std::map<std::string, std::string>& files) {
+    return client->LoadModel(model, config, files);
+  }
+  Error Unload(const std::string& model) { return client->UnloadModel(model); }
+  Error Ready(const std::string& model, const std::string& version,
+              bool* ready) {
+    return client->IsModelReady(model, ready, version);
+  }
+  Error MaxBatchSize(const std::string& model, int64_t* out) {
+    inference::ModelConfigResponse cfg;
+    Error err = client->ModelConfig(&cfg, model);
+    if (!err.IsOk()) return err;
+    *out = cfg.config().max_batch_size();
+    return Error::Success;
+  }
+  Error TraceLevel(const std::string& model, std::string* level) {
+    inference::TraceSettingResponse settings;
+    Error err = client->GetTraceSettings(&settings, model);
+    if (!err.IsOk()) return err;
+    auto it = settings.settings().find("trace_level");
+    *level = (it != settings.settings().end() && it->second.value_size() > 0)
+                 ? it->second.value(0)
+                 : "";
+    return Error::Success;
+  }
+  Error SetTraceLevel(const std::string& model, const std::string& level) {
+    inference::TraceSettingResponse response;
+    return client->UpdateTraceSettings(&response, model,
+                                       {{"trace_level", {level}}});
+  }
+  Error ClearTraceLevel(const std::string& model) {
+    inference::TraceSettingResponse response;
+    // Empty value list = clear (TraceSettingRequest.SettingValue contract).
+    return client->UpdateTraceSettings(&response, model, {{"trace_level", {}}});
+  }
+};
+
+// ---------------------------------------------------------------------------
+// the matrix
+// ---------------------------------------------------------------------------
+
+struct Request {
+  std::vector<int32_t> in0;
+  std::vector<int32_t> in1;
+  std::unique_ptr<InferInput> i0;
+  std::unique_ptr<InferInput> i1;
+  std::vector<InferInput*> inputs;
+};
+
+static void BuildRequest(Request* r, int32_t seed) {
+  r->in0.resize(16);
+  r->in1.resize(16);
+  for (int i = 0; i < 16; i++) {
+    r->in0[i] = seed + i;
+    r->in1[i] = 2 * seed;
+  }
+  r->i0 = std::make_unique<InferInput>("INPUT0", std::vector<int64_t>{1, 16},
+                                       "INT32");
+  r->i1 = std::make_unique<InferInput>("INPUT1", std::vector<int64_t>{1, 16},
+                                       "INT32");
+  r->i0->AppendRaw(reinterpret_cast<const uint8_t*>(r->in0.data()), 64);
+  r->i1->AppendRaw(reinterpret_cast<const uint8_t*>(r->in1.data()), 64);
+  r->inputs = {r->i0.get(), r->i1.get()};
+}
+
+static void CheckSum(const std::shared_ptr<InferResult>& result,
+                     const Request& r, const std::string& tag) {
+  const uint8_t* buf = nullptr;
+  size_t nbytes = 0;
+  EXPECT_OK(result->RawData("OUTPUT0", &buf, &nbytes), tag + " OUTPUT0");
+  EXPECT(nbytes == 64 && reinterpret_cast<const int32_t*>(buf)[4] ==
+                             r.in0[4] + r.in1[4],
+         tag + " sum value");
+}
+
+template <typename Adapter>
+void RunMatrix(Adapter& a) {
+  const std::string tag = Adapter::Name();
+
+  // ---- InferMulti: one option set broadcast over 3 requests ----
+  std::vector<Request> reqs(3);
+  std::vector<std::vector<InferInput*>> inputs;
+  for (int i = 0; i < 3; i++) {
+    BuildRequest(&reqs[i], 10 * (i + 1));
+    inputs.push_back(reqs[i].inputs);
+  }
+  {
+    std::vector<std::shared_ptr<InferResult>> results;
+    std::vector<InferOptions> options{InferOptions("simple")};
+    EXPECT_OK(a.InferMulti(&results, options, inputs, {}),
+              tag + " InferMulti broadcast");
+    EXPECT(results.size() == 3, tag + " InferMulti result count");
+    for (size_t i = 0; i < results.size(); i++) {
+      CheckSum(results[i], reqs[i], tag + " multi[" + std::to_string(i) + "]");
+    }
+  }
+
+  // ---- InferMulti: per-request options echo distinct request ids ----
+  {
+    std::vector<InferOptions> options;
+    for (int i = 0; i < 3; i++) {
+      InferOptions opt("simple");
+      opt.request_id_ = "multi-req-" + std::to_string(i);
+      options.push_back(opt);
+    }
+    std::vector<std::shared_ptr<InferResult>> results;
+    EXPECT_OK(a.InferMulti(&results, options, inputs, {}),
+              tag + " InferMulti per-request options");
+    EXPECT(results.size() == 3 && results[2]->Id() == "multi-req-2",
+           tag + " per-request id echo");
+  }
+
+  // ---- option-count mismatch: 2 options for 3 requests ----
+  {
+    std::vector<InferOptions> options{InferOptions("simple"),
+                                      InferOptions("simple")};
+    std::vector<std::shared_ptr<InferResult>> results;
+    EXPECT_ERR(a.InferMulti(&results, options, inputs, {}), "options",
+               tag + " option-count mismatch rejected");
+  }
+
+  // ---- output-count mismatch: 1 output set for 3 requests ----
+  {
+    InferRequestedOutput out0("OUTPUT0");
+    std::vector<std::vector<const InferRequestedOutput*>> outputs{{&out0}};
+    std::vector<InferOptions> options{InferOptions("simple")};
+    std::vector<std::shared_ptr<InferResult>> results;
+    EXPECT_ERR(a.InferMulti(&results, options, inputs, outputs), "outputs",
+               tag + " output-count mismatch rejected");
+  }
+
+  // ---- AsyncInferMulti: happy path + mismatch ----
+  {
+    std::mutex mu;
+    std::condition_variable cv;
+    bool done = false;
+    std::vector<std::shared_ptr<InferResult>> async_results;
+    Error async_error("unset");
+    std::vector<InferOptions> options{InferOptions("simple")};
+    EXPECT_OK(
+        a.AsyncInferMulti(
+            [&](std::vector<std::shared_ptr<InferResult>> results, Error err) {
+              std::lock_guard<std::mutex> lk(mu);
+              async_results = std::move(results);
+              async_error = err;
+              done = true;
+              cv.notify_one();
+            },
+            options, inputs),
+        tag + " AsyncInferMulti submit");
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      EXPECT(cv.wait_for(lk, std::chrono::seconds(30), [&] { return done; }),
+             tag + " AsyncInferMulti completion");
+    }
+    EXPECT(async_error.IsOk(), tag + " AsyncInferMulti error-free");
+    EXPECT(async_results.size() == 3, tag + " AsyncInferMulti count");
+    if (async_results.size() == 3) {
+      CheckSum(async_results[1], reqs[1], tag + " async multi[1]");
+    }
+
+    std::vector<InferOptions> bad{InferOptions("simple"),
+                                  InferOptions("simple")};
+    EXPECT_ERR(a.AsyncInferMulti(
+                   [](std::vector<std::shared_ptr<InferResult>>, Error) {},
+                   bad, inputs),
+               "options", tag + " async option-count mismatch rejected");
+  }
+
+  // ---- LoadWithConfigOverride (reference cc_client_test.cc:1306) ----
+  {
+    int64_t mbs = -1;
+    EXPECT_OK(a.MaxBatchSize("simple", &mbs), tag + " config before override");
+    EXPECT(mbs == 0, tag + " default max_batch_size");
+    EXPECT_OK(a.Load("simple", "{\"max_batch_size\": 7}", {}),
+              tag + " load with config override");
+    EXPECT_OK(a.MaxBatchSize("simple", &mbs), tag + " config after override");
+    EXPECT(mbs == 7, tag + " overridden max_batch_size");
+    EXPECT_OK(a.Load("simple", "", {}), tag + " plain reload");
+    EXPECT_OK(a.MaxBatchSize("simple", &mbs), tag + " config after reload");
+    EXPECT(mbs == 0, tag + " restored max_batch_size");
+  }
+
+  // ---- LoadWithFileOverride (reference cc_client_test.cc:1202) ----
+  {
+    const std::string name = std::string("matrix_override_") + tag;
+    const std::string blob = "not-a-real-onnx-blob";
+    // File override without a config override must be rejected.
+    EXPECT_ERR(a.Load(name, "", {{"1/model.onnx", blob}}), "config",
+               tag + " file override requires config");
+    EXPECT_OK(a.Load(name, "{\"backend\": \"onnx\"}",
+                     {{"1/model.onnx", blob}, {"3/model.onnx", blob}}),
+              tag + " load with file override");
+    bool ready = false;
+    EXPECT_OK(a.Ready(name, "1", &ready), tag + " v1 ready check");
+    EXPECT(ready, tag + " version 1 ready");
+    EXPECT_OK(a.Ready(name, "3", &ready), tag + " v3 ready check");
+    EXPECT(ready, tag + " version 3 ready");
+    EXPECT_OK(a.Ready(name, "2", &ready), tag + " v2 ready check");
+    EXPECT(!ready, tag + " version 2 absent");
+    EXPECT_OK(a.Unload(name), tag + " unload file override");
+  }
+
+  // ---- trace settings update / clear (reference cc_client_test.cc:1351) ----
+  {
+    std::string level;
+    EXPECT_OK(a.TraceLevel("", &level), tag + " global trace level");
+    EXPECT(level == "OFF", tag + " global default OFF");
+    EXPECT_OK(a.SetTraceLevel("simple", "TIMESTAMPS"),
+              tag + " set model trace level");
+    EXPECT_OK(a.TraceLevel("simple", &level), tag + " model trace level");
+    EXPECT(level == "TIMESTAMPS", tag + " model-scope TIMESTAMPS");
+    EXPECT_OK(a.TraceLevel("", &level), tag + " global unchanged check");
+    EXPECT(level == "OFF", tag + " global still OFF");
+    EXPECT_OK(a.ClearTraceLevel("simple"), tag + " clear model trace level");
+    EXPECT_OK(a.TraceLevel("simple", &level), tag + " model after clear");
+    EXPECT(level == "OFF", tag + " cleared back to global");
+  }
+}
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::cerr << "usage: cc_matrix_test <http host:port> <grpc host:port>\n";
+    return 2;
+  }
+  {
+    HttpAdapter http;
+    EXPECT_OK(http.Connect(argv[1]), "http connect");
+    RunMatrix(http);
+  }
+  {
+    GrpcAdapter grpc;
+    EXPECT_OK(grpc.Connect(argv[2]), "grpc connect");
+    RunMatrix(grpc);
+  }
+  if (failures == 0) {
+    std::cout << "ALL PASS\n";
+    return 0;
+  }
+  std::cerr << failures << " failures\n";
+  return 1;
+}

@@ -14,6 +14,7 @@
 #include <cstring>
 #include <map>
 #include <memory>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -267,5 +268,111 @@ struct InferStat {
                                              RequestTimers::Kind::RECV_END);
   }
 };
+
+// ---------------------------------------------------------------------------
+// Shared InferMulti/AsyncInferMulti fan-out (reference grpc_client.cc:1213,
+// 1283-1302): validation, broadcast rule, and the atomic fan-in used
+// identically by both transport clients — one copy so their semantics (and
+// error wording) cannot diverge.
+// ---------------------------------------------------------------------------
+
+namespace multi_detail {
+
+inline Error ValidateMulti(
+    size_t n_options, size_t n_inputs, size_t n_outputs) {
+  // One option set may fan across all requests.
+  if (n_options != 1 && n_options != n_inputs) {
+    return Error("'options' must be 1 or match the number of requests");
+  }
+  if (n_outputs != 0 && n_outputs != n_inputs) {
+    return Error("'outputs' must be empty or match the number of requests");
+  }
+  return Error::Success;
+}
+
+inline const std::vector<const InferRequestedOutput*>& NoOutputs() {
+  static const std::vector<const InferRequestedOutput*> kNone;
+  return kNone;
+}
+
+template <typename Client>
+Error InferMultiImpl(
+    Client* client, std::vector<std::shared_ptr<InferResult>>* results,
+    const std::vector<InferOptions>& options,
+    const std::vector<std::vector<InferInput*>>& inputs,
+    const std::vector<std::vector<const InferRequestedOutput*>>& outputs) {
+  Error err = ValidateMulti(options.size(), inputs.size(), outputs.size());
+  if (!err.IsOk()) return err;
+  results->clear();
+  for (size_t i = 0; i < inputs.size(); i++) {
+    const InferOptions& opt = options.size() == 1 ? options[0] : options[i];
+    const auto& outs = outputs.empty() ? NoOutputs() : outputs[i];
+    std::shared_ptr<InferResult> result;
+    err = client->Infer(&result, opt, inputs[i], outs);
+    if (!err.IsOk()) return err;
+    results->push_back(std::move(result));
+  }
+  return Error::Success;
+}
+
+template <typename Client, typename MultiFn>
+Error AsyncInferMultiImpl(
+    Client* client, MultiFn callback, const std::vector<InferOptions>& options,
+    const std::vector<std::vector<InferInput*>>& inputs,
+    const std::vector<std::vector<const InferRequestedOutput*>>& outputs) {
+  if (callback == nullptr) return Error("callback must not be null");
+  Error err = ValidateMulti(options.size(), inputs.size(), outputs.size());
+  if (!err.IsOk()) return err;
+  if (inputs.empty()) {
+    // Nothing to fan out; still deliver the completion.
+    callback({}, Error::Success);
+    return Error::Success;
+  }
+  // Atomic fan-in: the last completion delivers the ordered result vector.
+  struct MultiState {
+    std::mutex mu;
+    std::vector<std::shared_ptr<InferResult>> results;
+    Error first_error = Error::Success;
+    size_t remaining;
+    MultiFn callback;
+  };
+  auto state = std::make_shared<MultiState>();
+  state->results.resize(inputs.size());
+  state->remaining = inputs.size();
+  state->callback = callback;
+  for (size_t i = 0; i < inputs.size(); i++) {
+    const InferOptions& opt = options.size() == 1 ? options[0] : options[i];
+    const auto& outs = outputs.empty() ? NoOutputs() : outputs[i];
+    Error submit = client->AsyncInfer(
+        [state, i](std::shared_ptr<InferResult> result, Error e) {
+          bool deliver = false;
+          {
+            std::lock_guard<std::mutex> lk(state->mu);
+            state->results[i] = std::move(result);
+            if (!e.IsOk() && state->first_error.IsOk()) state->first_error = e;
+            deliver = --state->remaining == 0;
+          }
+          if (deliver) {
+            state->callback(std::move(state->results), state->first_error);
+          }
+        },
+        opt, inputs[i], outs);
+    if (!submit.IsOk()) {
+      // Submission failure counts as that request's completion.
+      bool deliver = false;
+      {
+        std::lock_guard<std::mutex> lk(state->mu);
+        if (state->first_error.IsOk()) state->first_error = submit;
+        deliver = --state->remaining == 0;
+      }
+      if (deliver) {
+        state->callback(std::move(state->results), state->first_error);
+      }
+    }
+  }
+  return Error::Success;
+}
+
+}  // namespace multi_detail
 
 }  // namespace tputriton

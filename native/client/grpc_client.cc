@@ -419,12 +419,16 @@ Error InferenceServerGrpcClient::ModelRepositoryIndex(
   return Call("RepositoryIndex", req, index);
 }
 
-Error InferenceServerGrpcClient::LoadModel(const std::string& model_name,
-                                           const std::string& config_json) {
+Error InferenceServerGrpcClient::LoadModel(
+    const std::string& model_name, const std::string& config_json,
+    const std::map<std::string, std::string>& files) {
   inference::RepositoryModelLoadRequest req;
   req.set_model_name(model_name);
   if (!config_json.empty()) {
     (*req.mutable_parameters())["config"].set_string_param(config_json);
+  }
+  for (const auto& kv : files) {
+    (*req.mutable_parameters())["file:" + kv.first].set_bytes_param(kv.second);
   }
   inference::RepositoryModelLoadResponse resp;
   return Call("RepositoryModelLoad", req, &resp);
@@ -765,86 +769,15 @@ Error InferenceServerGrpcClient::InferMulti(
     const std::vector<InferOptions>& options,
     const std::vector<std::vector<InferInput*>>& inputs,
     const std::vector<std::vector<const InferRequestedOutput*>>& outputs) {
-  // One option set may fan across all requests (reference grpc_client.cc:1213).
-  if (options.size() != 1 && options.size() != inputs.size()) {
-    return Error("'options' must be 1 or match the number of requests");
-  }
-  if (!outputs.empty() && outputs.size() != inputs.size()) {
-    return Error("'outputs' must be empty or match the number of requests");
-  }
-  results->clear();
-  for (size_t i = 0; i < inputs.size(); i++) {
-    const InferOptions& opt = options.size() == 1 ? options[0] : options[i];
-    static const std::vector<const InferRequestedOutput*> kNoOutputs;
-    const auto& outs = outputs.empty() ? kNoOutputs : outputs[i];
-    std::shared_ptr<InferResult> result;
-    Error err = Infer(&result, opt, inputs[i], outs);
-    if (!err.IsOk()) return err;
-    results->push_back(std::move(result));
-  }
-  return Error::Success;
+  return multi_detail::InferMultiImpl(this, results, options, inputs, outputs);
 }
 
 Error InferenceServerGrpcClient::AsyncInferMulti(
     OnMultiCompleteFn callback, const std::vector<InferOptions>& options,
     const std::vector<std::vector<InferInput*>>& inputs,
     const std::vector<std::vector<const InferRequestedOutput*>>& outputs) {
-  if (options.size() != 1 && options.size() != inputs.size()) {
-    return Error("'options' must be 1 or match the number of requests");
-  }
-  if (!outputs.empty() && outputs.size() != inputs.size()) {
-    return Error("'outputs' must be empty or match the number of requests");
-  }
-  if (inputs.empty()) {
-    // Nothing to fan out; still deliver the completion.
-    callback({}, Error::Success);
-    return Error::Success;
-  }
-  // Atomic fan-in (reference grpc_client.cc:1283-1302): the last completion
-  // delivers the ordered result vector.
-  struct MultiState {
-    std::mutex mu;
-    std::vector<std::shared_ptr<InferResult>> results;
-    Error first_error;
-    size_t remaining;
-    OnMultiCompleteFn callback;
-  };
-  auto state = std::make_shared<MultiState>();
-  state->results.resize(inputs.size());
-  state->remaining = inputs.size();
-  state->callback = std::move(callback);
-  for (size_t i = 0; i < inputs.size(); i++) {
-    const InferOptions& opt = options.size() == 1 ? options[0] : options[i];
-    static const std::vector<const InferRequestedOutput*> kNoOutputs;
-    const auto& outs = outputs.empty() ? kNoOutputs : outputs[i];
-    Error err = AsyncInfer(
-        [state, i](std::shared_ptr<InferResult> result, Error e) {
-          bool last = false;
-          {
-            std::lock_guard<std::mutex> lk(state->mu);
-            state->results[i] = std::move(result);
-            if (!e.IsOk() && state->first_error.IsOk()) state->first_error = e;
-            last = (--state->remaining == 0);
-          }
-          if (last) {
-            state->callback(std::move(state->results), state->first_error);
-          }
-        },
-        opt, inputs[i], outs);
-    if (!err.IsOk()) {
-      // Account for the request that never launched.
-      bool last = false;
-      {
-        std::lock_guard<std::mutex> lk(state->mu);
-        if (state->first_error.IsOk()) state->first_error = err;
-        last = (--state->remaining == 0);
-      }
-      if (last) {
-        state->callback(std::move(state->results), state->first_error);
-      }
-    }
-  }
-  return Error::Success;
+  return multi_detail::AsyncInferMultiImpl(this, std::move(callback), options,
+                                           inputs, outputs);
 }
 
 // ---------------------------------------------------------------------------

@@ -17,6 +17,7 @@
 #include <condition_variable>
 #include <deque>
 #include <functional>
+#include <map>
 #include <memory>
 #include <mutex>
 #include <string>
@@ -83,8 +84,12 @@ class InferenceServerGrpcClient {
 
   // -- repository / statistics ---------------------------------------------
   Error ModelRepositoryIndex(inference::RepositoryIndexResponse* index);
+  // files: override-directory contents keyed by "<version>/<path>"
+  // (reference LoadModel file_content, cc_client_test.cc:1202-1350);
+  // a config override is mandatory when files are given.
   Error LoadModel(const std::string& model_name,
-                  const std::string& config_json = "");
+                  const std::string& config_json = "",
+                  const std::map<std::string, std::string>& files = {});
   Error UnloadModel(const std::string& model_name);
   Error ModelInferenceStatistics(inference::ModelStatisticsResponse* stats,
                                  const std::string& model_name = "",

