@@ -265,32 +265,39 @@ void RunMatrix(Adapter& a) {
 
   // ---- AsyncInferMulti: happy path + mismatch ----
   {
-    std::mutex mu;
-    std::condition_variable cv;
-    bool done = false;
-    std::vector<std::shared_ptr<InferResult>> async_results;
-    Error async_error("unset");
+    // Shared state on the heap: if the 30s wait below ever times out, the
+    // client's worker thread may still fire the callback after this scope
+    // exits — stack captures would then be use-after-scope.
+    struct AsyncState {
+      std::mutex mu;
+      std::condition_variable cv;
+      bool done = false;
+      std::vector<std::shared_ptr<InferResult>> results;
+      Error error{"unset"};
+    };
+    auto st = std::make_shared<AsyncState>();
     std::vector<InferOptions> options{InferOptions("simple")};
     EXPECT_OK(
         a.AsyncInferMulti(
-            [&](std::vector<std::shared_ptr<InferResult>> results, Error err) {
-              std::lock_guard<std::mutex> lk(mu);
-              async_results = std::move(results);
-              async_error = err;
-              done = true;
-              cv.notify_one();
+            [st](std::vector<std::shared_ptr<InferResult>> results, Error err) {
+              std::lock_guard<std::mutex> lk(st->mu);
+              st->results = std::move(results);
+              st->error = err;
+              st->done = true;
+              st->cv.notify_one();
             },
             options, inputs),
         tag + " AsyncInferMulti submit");
     {
-      std::unique_lock<std::mutex> lk(mu);
-      EXPECT(cv.wait_for(lk, std::chrono::seconds(30), [&] { return done; }),
+      std::unique_lock<std::mutex> lk(st->mu);
+      EXPECT(st->cv.wait_for(lk, std::chrono::seconds(30),
+                             [&] { return st->done; }),
              tag + " AsyncInferMulti completion");
     }
-    EXPECT(async_error.IsOk(), tag + " AsyncInferMulti error-free");
-    EXPECT(async_results.size() == 3, tag + " AsyncInferMulti count");
-    if (async_results.size() == 3) {
-      CheckSum(async_results[1], reqs[1], tag + " async multi[1]");
+    EXPECT(st->error.IsOk(), tag + " AsyncInferMulti error-free");
+    EXPECT(st->results.size() == 3, tag + " AsyncInferMulti count");
+    if (st->results.size() == 3) {
+      CheckSum(st->results[1], reqs[1], tag + " async multi[1]");
     }
 
     std::vector<InferOptions> bad{InferOptions("simple"),

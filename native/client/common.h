@@ -339,7 +339,7 @@ Error AsyncInferMultiImpl(
   auto state = std::make_shared<MultiState>();
   state->results.resize(inputs.size());
   state->remaining = inputs.size();
-  state->callback = callback;
+  state->callback = std::move(callback);
   for (size_t i = 0; i < inputs.size(); i++) {
     const InferOptions& opt = options.size() == 1 ? options[0] : options[i];
     const auto& outs = outputs.empty() ? NoOutputs() : outputs[i];

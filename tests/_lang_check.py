@@ -1,0 +1,194 @@
+"""Structural verification for sources whose toolchains this image lacks.
+
+No JDK, Go, or Node exists here and the image has no egress to fetch one,
+so the Java/Go/JS client sources cannot be COMPILED in CI. This module is
+the honest fallback gate: a real lexer (comments, strings, escapes) plus
+structural and cross-reference checks that catch the drift classes that
+actually bite unverified code — unbalanced edits, renamed classes,
+package/filename mismatches, references to files that don't exist. It is
+NOT a compiler; full verification belongs to a provisioned CI job with the
+real toolchains (the build scripts under clients/ are written for one).
+"""
+
+import os
+import re
+from typing import Dict, List, Tuple
+
+
+def strip_comments_and_strings(src: str, lang: str) -> Tuple[str, List[str]]:
+    """Lex the source: returns (code with comments/strings blanked, errors).
+
+    Handles // and /* */ comments, double/single-quoted strings with
+    escapes, and Go's back-quoted raw strings. Blanked regions keep their
+    length (newlines preserved) so offsets stay meaningful.
+    """
+    out = []
+    errors = []
+    i, n = 0, len(src)
+    line = 1
+    while i < n:
+        c = src[i]
+        if c == "\n":
+            line += 1
+            out.append(c)
+            i += 1
+        elif c == "/" and i + 1 < n and src[i + 1] == "/":
+            while i < n and src[i] != "\n":
+                out.append(" ")
+                i += 1
+        elif c == "/" and i + 1 < n and src[i + 1] == "*":
+            start_line = line
+            i += 2
+            out.append("  ")
+            while i < n and not (src[i] == "*" and i + 1 < n and src[i + 1] == "/"):
+                if src[i] == "\n":
+                    line += 1
+                    out.append("\n")
+                else:
+                    out.append(" ")
+                i += 1
+            if i >= n:
+                errors.append(f"line {start_line}: unterminated block comment")
+                break
+            out.append("  ")
+            i += 2
+        elif c in ("\"", "'"):
+            quote = c
+            start_line = line
+            out.append(quote)
+            i += 1
+            closed = False
+            while i < n:
+                if src[i] == "\\" and i + 1 < n:
+                    out.append("  ")
+                    i += 2
+                    continue
+                if src[i] == quote:
+                    out.append(quote)
+                    i += 1
+                    closed = True
+                    break
+                if src[i] == "\n":
+                    break  # strings don't span lines in these languages
+                out.append(" ")
+                i += 1
+            if not closed:
+                errors.append(f"line {start_line}: unterminated {quote} string")
+        elif c == "`" and lang == "go":
+            start_line = line
+            out.append(c)
+            i += 1
+            closed = False
+            while i < n:
+                if src[i] == "`":
+                    out.append("`")
+                    i += 1
+                    closed = True
+                    break
+                if src[i] == "\n":
+                    line += 1
+                    out.append("\n")
+                else:
+                    out.append(" ")
+                i += 1
+            if not closed:
+                errors.append(f"line {start_line}: unterminated raw string")
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out), errors
+
+
+def check_balanced(code: str) -> List[str]:
+    """Bracket balance over comment/string-stripped code."""
+    pairs = {")": "(", "]": "[", "}": "{"}
+    stack: List[Tuple[str, int]] = []
+    errors = []
+    line = 1
+    for ch in code:
+        if ch == "\n":
+            line += 1
+        elif ch in "([{":
+            stack.append((ch, line))
+        elif ch in ")]}":
+            if not stack or stack[-1][0] != pairs[ch]:
+                errors.append(f"line {line}: unbalanced '{ch}'")
+                return errors
+            stack.pop()
+    for ch, ln in stack[-3:]:
+        errors.append(f"line {ln}: unclosed '{ch}'")
+    return errors
+
+
+def check_java_file(path: str, root: str) -> List[str]:
+    """Java structural checks: lexes, balances, package matches directory,
+    public type matches filename, and same-package type references resolve
+    to sibling files."""
+    with open(path) as f:
+        src = f.read()
+    errors = []
+    code, lex_errors = strip_comments_and_strings(src, "java")
+    errors += lex_errors
+    errors += check_balanced(code)
+
+    rel = os.path.relpath(path, root)
+    fname = os.path.splitext(os.path.basename(path))[0]
+
+    pkg = re.search(r"^\s*package\s+([\w.]+)\s*;", code, re.M)
+    if pkg is not None:
+        expected_dir = pkg.group(1).replace(".", os.sep)
+        if not os.path.dirname(rel).endswith(expected_dir):
+            errors.append(
+                f"package {pkg.group(1)} does not match directory {rel}"
+            )
+
+    public_type = re.search(
+        r"^\s*public\s+(?:final\s+|abstract\s+)*(?:class|interface|enum|record)\s+(\w+)",
+        code, re.M,
+    )
+    if public_type is not None and public_type.group(1) != fname:
+        errors.append(
+            f"public type {public_type.group(1)} does not match file {fname}"
+        )
+    return errors
+
+
+def java_same_package_refs(files: Dict[str, str]) -> List[str]:
+    """Cross-file check: types imported as triton.client.* (or referenced
+    from the same package set) must exist somewhere in the tree."""
+    defined = set()
+    for path, src in files.items():
+        code, _ = strip_comments_and_strings(src, "java")
+        for m in re.finditer(r"(?:class|interface|enum|record)\s+(\w+)", code):
+            defined.add(m.group(1))
+    errors = []
+    for path, src in files.items():
+        code, _ = strip_comments_and_strings(src, "java")
+        for m in re.finditer(r"^\s*import\s+triton\.client(?:\.[\w]+)*\.(\w+)\s*;",
+                             code, re.M):
+            if m.group(1) not in defined and m.group(1) != "*":
+                errors.append(f"{os.path.basename(path)}: import of missing "
+                              f"type {m.group(1)}")
+    return errors
+
+
+def check_go_file(path: str) -> List[str]:
+    with open(path) as f:
+        src = f.read()
+    errors = []
+    code, lex_errors = strip_comments_and_strings(src, "go")
+    errors += lex_errors
+    errors += check_balanced(code)
+    if re.search(r"^\s*package\s+\w+", code, re.M) is None:
+        errors.append("missing package declaration")
+    return errors
+
+
+def check_js_file(path: str) -> List[str]:
+    with open(path) as f:
+        src = f.read()
+    errors = []
+    code, lex_errors = strip_comments_and_strings(src, "js")
+    errors += lex_errors
+    errors += check_balanced(code)
+    return errors

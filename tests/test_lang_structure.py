@@ -1,0 +1,99 @@
+"""Structural gates for the Java/Go/JS client sources.
+
+This image has no JDK, Go, or Node and no egress to install one, so these
+sources cannot be compiled in CI (the round-2 verdict's preferred fix).
+These tests are the fallback gate: every file must lex cleanly, balance
+its brackets, keep packages/filenames/types consistent, and keep
+cross-file references resolvable — the drift classes that actually break
+unverified code. Full compile/run verification is what the build scripts
+under clients/ do on a provisioned machine (see test_stub_clients.py for
+the script-level checks)."""
+
+import glob
+import os
+
+import pytest
+
+from tests._lang_check import (
+    check_go_file,
+    check_java_file,
+    check_js_file,
+    java_same_package_refs,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _java_files():
+    roots = [
+        os.path.join(REPO, "clients", "java", "library", "src"),
+        os.path.join(REPO, "clients", "java", "examples"),
+        os.path.join(REPO, "clients", "java-api-bindings", "src"),
+    ]
+    out = []
+    for root in roots:
+        out += glob.glob(os.path.join(root, "**", "*.java"), recursive=True)
+    return sorted(out)
+
+
+def test_java_sources_exist():
+    files = _java_files()
+    # The Java client library is a 17-file rewrite + bindings; a collapsed
+    # count means the tree was moved without updating this gate.
+    assert len(files) >= 15, files
+
+
+@pytest.mark.parametrize("path", _java_files(), ids=os.path.basename)
+def test_java_file_structure(path):
+    errors = check_java_file(path, REPO)
+    assert not errors, errors
+
+
+def test_java_cross_file_references():
+    files = {}
+    for path in _java_files():
+        with open(path) as f:
+            files[path] = f.read()
+    errors = java_same_package_refs(files)
+    assert not errors, errors
+
+
+@pytest.mark.parametrize(
+    "path",
+    sorted(glob.glob(os.path.join(REPO, "clients", "go", "**", "*.go"),
+                     recursive=True)),
+    ids=os.path.basename,
+)
+def test_go_file_structure(path):
+    errors = check_go_file(path)
+    assert not errors, errors
+
+
+@pytest.mark.parametrize(
+    "path",
+    sorted(glob.glob(os.path.join(REPO, "clients", "javascript", "**", "*.js"),
+                     recursive=True)),
+    ids=os.path.basename,
+)
+def test_js_file_structure(path):
+    errors = check_js_file(path)
+    assert not errors, errors
+
+
+def test_js_proto_reference_resolves():
+    """client.js loads the proto dynamically; the path it names must exist."""
+    import re
+
+    path = os.path.join(REPO, "clients", "javascript", "client.js")
+    with open(path) as f:
+        src = f.read()
+    joins = re.findall(
+        r"path\.join\(\s*__dirname\s*,([^)]*\.proto['\"])\s*\)", src
+    )
+    assert joins, "client.js builds no __dirname-relative .proto path"
+    for args in joins:
+        parts = re.findall(r"['\"]([^'\"]+)['\"]", args)
+        resolved = os.path.normpath(
+            os.path.join(os.path.dirname(path), *parts)
+        )
+        assert os.path.exists(resolved), f"client.js references missing {resolved}"
