@@ -200,3 +200,53 @@ def test_mesh_sharded_tpu_shm_mode(server):
             server.grpc_address, "simple", batch_size=3,
             shared_memory="tpu", shm_mesh=mesh,
         )
+
+
+def test_native_driver_off_gil(server):
+    """The C++ load-generator core (round-2 verdict item 7): wire-mode
+    sweep through build/perf_driver with client-side request cost off the
+    GIL entirely. Done-criterion: client overhead < 1 ms/request at
+    concurrency 32 on the simple model."""
+    import os
+    import shutil
+
+    from tritonclient_tpu.perf_analyzer import run_native_driver
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    driver = os.path.join(repo, "build", "perf_driver")
+    if not os.path.exists(driver) or shutil.which("cmake") is None:
+        pytest.skip("native driver not built")
+    summary = run_native_driver(
+        url=server.grpc_address,
+        http_url=server.http_address,
+        model_name="simple",
+        concurrency=32,
+        protocol="grpc",
+        batch_size=8,
+        streaming=True,
+        measurement_interval_s=2.0,
+        warmup_s=0.3,
+        driver_path=driver,
+    )
+    assert summary["errors"] == 0
+    assert summary["requests"] > 0
+    assert summary["client_send_ms_per_request"] < 1.0, summary
+    # And via the CLI path (one small level, table output).
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tritonclient_tpu.perf_analyzer",
+            "-m", "simple", "-u", server.grpc_address,
+            "--http-url", server.http_address,
+            "--native-driver", "--concurrency-range", "2",
+            "-p", "500", "--warmup-interval", "100", "--json",
+        ],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    import json as _json
+
+    rows = _json.loads(proc.stdout)
+    assert rows and rows[0]["errors"] == 0

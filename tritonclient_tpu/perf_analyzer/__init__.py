@@ -5,11 +5,20 @@ surface modeled on the reference's relocated perf_analyzer tool, including
 ``--shared-memory={none,system,tpu}`` per the BASELINE.json north star).
 """
 
-from tritonclient_tpu.perf_analyzer._analyzer import PerfAnalyzer
+from tritonclient_tpu.perf_analyzer._analyzer import (
+    PerfAnalyzer,
+    run_native_driver,
+)
 from tritonclient_tpu.perf_analyzer._stats import (
     InferStat,
     MeasurementWindow,
     RequestTimers,
 )
 
-__all__ = ["PerfAnalyzer", "InferStat", "MeasurementWindow", "RequestTimers"]
+__all__ = [
+    "PerfAnalyzer",
+    "InferStat",
+    "MeasurementWindow",
+    "RequestTimers",
+    "run_native_driver",
+]

@@ -65,6 +65,18 @@ def main(argv=None):
         help="with --shared-memory=tpu: span regions over the first N "
              "devices as a 1-axis mesh (per-device buffer shards)",
     )
+    parser.add_argument(
+        "--native-driver", action="store_true",
+        help="run the sweep through the C++ load-generator core "
+             "(build/perf_driver): the request loop never touches the GIL, "
+             "so client-side Python cost stays out of the measurement. "
+             "Wire mode only (no --shared-memory)",
+    )
+    parser.add_argument(
+        "--http-url", default=None,
+        help="with --native-driver and -i grpc: the HTTP endpoint used for "
+             "model metadata",
+    )
     parser.add_argument("-f", "--filename", help="write per-level CSV here")
     parser.add_argument("--json", dest="json_out", action="store_true",
                         help="print JSON summaries instead of a table")
@@ -89,23 +101,49 @@ def main(argv=None):
             )
         shm_mesh = Mesh(np.array(available[: args.shm_mesh_devices]), ("sp",))
 
-    analyzer = PerfAnalyzer(
-        url=args.url,
-        model_name=args.model_name,
-        protocol=args.protocol,
-        batch_size=args.batch_size,
-        shared_memory=args.shared_memory,
-        streaming=args.streaming,
-        measurement_interval_s=args.measurement_interval / 1000.0,
-        warmup_s=args.warmup_interval / 1000.0,
-        shape_overrides=_parse_shapes(args.shape),
-        read_outputs=args.read_outputs,
-        device_id=args.device_id,
-        shm_mesh=shm_mesh,
-        verbose=args.verbose,
-    )
     start, end, step = args.concurrency_range
-    results = analyzer.sweep(start, end, step)
+    if args.native_driver:
+        if args.shared_memory != "none":
+            parser.error("--native-driver supports wire mode only "
+                         "(--shared-memory=none)")
+        if args.read_outputs:
+            parser.error("--native-driver does not support --read-outputs "
+                         "(the native loop never deserializes outputs)")
+        from tritonclient_tpu.perf_analyzer import run_native_driver
+        from tritonclient_tpu.perf_analyzer._analyzer import sweep_levels
+
+        results = sweep_levels(
+            lambda level: run_native_driver(
+                url=args.url,
+                http_url=args.http_url,
+                model_name=args.model_name,
+                concurrency=level,
+                protocol=args.protocol,
+                batch_size=args.batch_size,
+                streaming=args.streaming,
+                measurement_interval_s=args.measurement_interval / 1000.0,
+                warmup_s=args.warmup_interval / 1000.0,
+                shape_overrides=_parse_shapes(args.shape),
+            ),
+            start, end, step, verbose=args.verbose,
+        )
+    else:
+        analyzer = PerfAnalyzer(
+            url=args.url,
+            model_name=args.model_name,
+            protocol=args.protocol,
+            batch_size=args.batch_size,
+            shared_memory=args.shared_memory,
+            streaming=args.streaming,
+            measurement_interval_s=args.measurement_interval / 1000.0,
+            warmup_s=args.warmup_interval / 1000.0,
+            shape_overrides=_parse_shapes(args.shape),
+            read_outputs=args.read_outputs,
+            device_id=args.device_id,
+            shm_mesh=shm_mesh,
+            verbose=args.verbose,
+        )
+        results = analyzer.sweep(start, end, step)
 
     if args.json_out:
         print(json.dumps(results, indent=2))

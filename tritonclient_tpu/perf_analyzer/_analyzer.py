@@ -843,6 +843,69 @@ class MeasurementSession:
         self.close()
 
 
+def run_native_driver(
+    url: str,
+    model_name: str,
+    concurrency: int,
+    http_url: Optional[str] = None,
+    protocol: str = "grpc",
+    batch_size: int = 1,
+    streaming: bool = False,
+    measurement_interval_s: float = 5.0,
+    warmup_s: float = 1.0,
+    shape_overrides: Optional[Dict[str, int]] = None,
+    driver_path: Optional[str] = None,
+) -> Dict:
+    """One measurement window through the C++ load-generator core.
+
+    The reference's perf_analyzer is a native instrument so the load
+    generator's own overhead stays out of the measurement (SURVEY §7 step
+    7); this runs `perf_driver` (native/client/perf_driver.cc) as a
+    subprocess — the request loop never touches the GIL — and returns its
+    summary dict (same keys as MeasurementWindow.summary() plus
+    ``client_send_ms_per_request``). Wire mode only: the zero-copy tpu shm
+    plane is process-scoped and stays with the in-process analyzer.
+    """
+    import json as _json
+    import subprocess
+
+    if driver_path is None:
+        repo = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        driver_path = os.path.join(repo, "build", "perf_driver")
+    if not os.path.exists(driver_path):
+        raise FileNotFoundError(
+            f"native driver not built at {driver_path}; run "
+            "`cmake -S native -B build && cmake --build build`"
+        )
+    cmd = [
+        driver_path,
+        "--url", url,
+        "--protocol", protocol,
+        "--model", model_name,
+        "--batch", str(batch_size),
+        "--concurrency", str(concurrency),
+        "--seconds", str(measurement_interval_s),
+        "--warmup", str(warmup_s),
+    ]
+    if http_url is not None:
+        cmd += ["--http-url", http_url]
+    if streaming:
+        cmd.append("--streaming")
+    for name, dim in (shape_overrides or {}).items():
+        cmd += ["--dim", f"{name}:{dim}"]
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True,
+        timeout=measurement_interval_s + warmup_s + 120,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"perf_driver failed (rc={proc.returncode}): {proc.stderr.strip()}"
+        )
+    return _json.loads(proc.stdout)
+
+
 class PerfAnalyzer:
     """Concurrency-sweep load generator against a KServe v2 server."""
 
@@ -1061,19 +1124,32 @@ class PerfAnalyzer:
                 pass
 
     def sweep(self, start: int, end: int, step: int = 1) -> List[Dict]:
-        if step < 1:
-            raise ValueError(f"concurrency step must be >= 1, got {step}")
-        results = []
-        level = start
-        while level <= end:
-            window = self.measure(level)
-            summary = window.summary()
-            results.append(summary)
-            if self.verbose:
-                print(
-                    f"Concurrency: {level}, throughput: "
-                    f"{summary['throughput_infer_per_sec']} infer/sec, latency "
-                    f"p99: {summary['latency_p99_us']} usec"
-                )
-            level += step
-        return results
+        return sweep_levels(
+            lambda level: self.measure(level).summary(),
+            start, end, step, verbose=self.verbose,
+        )
+
+
+def sweep_levels(measure_one, start: int, end: int, step: int = 1,
+                 verbose: bool = False) -> List[Dict]:
+    """Level iteration shared by the in-process analyzer and the native
+    driver: ``measure_one(level)`` returns a summary dict per level."""
+    if step < 1:
+        raise ValueError(f"concurrency step must be >= 1, got {step}")
+    results = []
+    level = start
+    while level <= end:
+        summary = measure_one(level)
+        results.append(summary)
+        if verbose:
+            line = (
+                f"Concurrency: {level}, throughput: "
+                f"{summary['throughput_infer_per_sec']} infer/sec, latency "
+                f"p99: {summary['latency_p99_us']} usec"
+            )
+            if "client_send_ms_per_request" in summary:
+                line += (f", client send: "
+                         f"{summary['client_send_ms_per_request']} ms/req")
+            print(line)
+        level += step
+    return results

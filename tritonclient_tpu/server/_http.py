@@ -396,6 +396,9 @@ class _TlsCapableHTTPServer(ThreadingHTTPServer):
 
     ssl_context = None
     handshake_timeout_s = 10.0
+    # Default backlog (5) drops SYNs when tens of clients connect at once
+    # (perf_driver at depth 32 saw connection-refused errors).
+    request_queue_size = 128
 
     def process_request_thread(self, request, client_address):
         if self.ssl_context is not None:
