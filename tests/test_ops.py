@@ -94,8 +94,7 @@ def test_flash_under_jit_and_grad():
 
     assert np.isfinite(float(f(q)))
 
-    # The custom VJP must match the reference gradient exactly (the
-    # backward recomputes through dot_product_attention).
+    # The fused Pallas backward must match the reference gradient.
     grad_flash = jax.grad(
         lambda x: flash_attention(x, x, x, causal=True).sum()
     )(q)
@@ -104,3 +103,95 @@ def test_flash_under_jit_and_grad():
     )(q)
     np.testing.assert_allclose(np.asarray(grad_flash), np.asarray(grad_ref),
                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_fused_backward_per_input_grads(causal):
+    # Separate q/k/v cotangents through the fused dq and dk/dv kernels,
+    # weighted so per-row deltas differ (a uniform .sum() would mask
+    # delta-handling bugs).
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(8), 3)
+    shape = (2, 256, 4, 64)
+    q = jax.random.normal(kq, shape, jnp.float32)
+    k = jax.random.normal(kk, shape, jnp.float32)
+    v = jax.random.normal(kv, shape, jnp.float32)
+    w = jnp.arange(shape[-1], dtype=jnp.float32)
+
+    def loss(fn):
+        return lambda a, b, c: (fn(a, b, c) * w).sum()
+
+    got = jax.grad(
+        loss(lambda a, b, c: flash_attention(a, b, c, causal=causal)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    ref = jax.grad(
+        loss(lambda a, b, c: dot_product_attention(a, b, c, causal=causal)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for g, r, name in zip(got, ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=1e-3, atol=5e-4,
+                                   err_msg=f"d{name} causal={causal}")
+
+
+def test_flash_fused_backward_rectangular():
+    # Lq != Lk exercises the independent num_q/num_k grids of the two
+    # backward kernels.
+    q = jax.random.normal(jax.random.PRNGKey(9), (1, 256, 2, 32), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(10), (1, 128, 2, 32), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(11), (1, 128, 2, 32), jnp.float32)
+    got = jax.grad(
+        lambda a, b, c: (flash_attention(a, b, c) ** 2).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    ref = jax.grad(
+        lambda a, b, c: (dot_product_attention(a, b, c) ** 2).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for g, r, name in zip(got, ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=1e-3, atol=5e-4, err_msg=f"d{name}")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_return_lse_matches_logsumexp(causal):
+    import math
+
+    q = jax.random.normal(jax.random.PRNGKey(12), (2, 256, 2, 32), jnp.float32)
+    o, lse = flash_attention(q, q, q, causal=causal, return_lse=True)
+    assert lse.shape == (2, 256, 2) and lse.dtype == jnp.float32
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q * scale, q)
+    if causal:
+        keep = jnp.arange(256)[:, None] >= jnp.arange(256)[None, :]
+        s = jnp.where(keep[None, None], s, -1e30)
+    ref = jnp.transpose(jax.scipy.special.logsumexp(s, axis=-1), (0, 2, 1))
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_flash_lse_cotangent_exact():
+    # Ring attention differentiates through the returned LSE; the backward
+    # kernels fold that cotangent into the delta term. Compare against the
+    # materializing reference of the same (o, lse) function.
+    import importlib
+
+    fa_mod = importlib.import_module("tritonclient_tpu.ops.flash_attention")
+    q = jax.random.normal(jax.random.PRNGKey(13), (1, 256, 2, 32), jnp.float32)
+    wl = jnp.linspace(0.1, 1.0, 256)[None, :, None]
+
+    def loss(fn):
+        def f(x):
+            o, lse = fn(x)
+            return (o * 0.3).sum() + (lse * wl).sum()
+        return f
+
+    got = jax.grad(loss(
+        lambda x: flash_attention(x, x, x, causal=True, return_lse=True)
+    ))(q)
+    ref = jax.grad(loss(
+        lambda x: fa_mod._reference_with_lse(x, x, x, True,
+                                             1.0 / np.sqrt(32.0))
+    ))(q)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-3, atol=5e-4)

@@ -40,6 +40,63 @@ def sequence_shard_map(body, mesh: Mesh, sp_axis: str):
     )
 
 
+def _ring_body_flash(q, k, v, *, axis_name: str, axis_size: int,
+                     causal: bool, scale: float):
+    """Flash variant: each hop runs the fused Pallas kernel on the local
+    Q chunk against the visiting K/V chunk (``return_lse=True``), and the
+    per-hop partials combine with the standard two-way logsumexp merge.
+    Gradients flow through the kernel's LSE cotangent path, ppermute, and
+    the combine, so ring-flash is differentiable end to end.
+    """
+    from tritonclient_tpu.ops.flash_attention import flash_attention
+
+    my_idx = lax.axis_index(axis_name)
+    b, lc, h, d = q.shape
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def full_hop(k_cur, v_cur):
+        return flash_attention(q, k_cur, v_cur, causal=False, scale=scale,
+                               return_lse=True)
+
+    def diag_hop(k_cur, v_cur):
+        # j == my_idx: the visiting chunk is this device's own K/V, so the
+        # in-chunk causal mask is exactly the aligned q_pos >= k_pos mask.
+        return flash_attention(q, k_cur, v_cur, causal=True, scale=scale,
+                               return_lse=True)
+
+    def skip_hop(k_cur, v_cur):
+        # Entirely above the diagonal: weight exp(_NEG_BIG) == 0 in the merge.
+        return (jnp.zeros_like(q), jnp.full((b, lc, h), _NEG_BIG,
+                                            jnp.float32))
+
+    def step(carry, i):
+        o_acc, lse_acc, k_cur, v_cur = carry
+        # After i hops each device holds the chunk that started (my_idx - i).
+        j = (my_idx - i) % axis_size
+        if causal:
+            idx = jnp.where(j < my_idx, 0, jnp.where(j == my_idx, 1, 2))
+            o_j, lse_j = lax.switch(idx, [full_hop, diag_hop, skip_hop],
+                                    k_cur, v_cur)
+        else:
+            o_j, lse_j = full_hop(k_cur, v_cur)
+        m = jnp.maximum(lse_acc, lse_j)
+        w_acc = jnp.exp(lse_acc - m)
+        w_j = jnp.exp(lse_j - m)
+        denom = w_acc + w_j
+        o_acc = (o_acc * w_acc[..., None]
+                 + o_j.astype(jnp.float32) * w_j[..., None]) / denom[..., None]
+        lse_acc = m + jnp.log(denom)
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (o_acc, lse_acc, k_next, v_next), None
+
+    o0 = jnp.zeros((b, lc, h, d), jnp.float32)
+    lse0 = jnp.full((b, lc, h), _NEG_BIG, jnp.float32)
+    (o, _, _, _), _ = lax.scan(step, (o0, lse0, k, v),
+                               jnp.arange(axis_size))
+    return o.astype(q.dtype)
+
+
 def _ring_body(q, k, v, *, axis_name: str, axis_size: int, causal: bool,
                scale: float):
     """Manual-mode body: q/k/v are the local [B, Lc, H, D] chunks."""
@@ -93,21 +150,32 @@ def ring_attention(
     sp_axis: str = "sp",
     causal: bool = False,
     scale: Optional[float] = None,
+    impl: str = "reference",
 ) -> jax.Array:
     """Attention over [B, L, H, D] tensors whose L dim is sharded on sp_axis.
 
     Other mesh axes (dp on B, tp on H) stay automatic — GSPMD shards them as
     annotated by the caller. With sp size 1 this degrades to plain attention.
+    ``impl='flash'`` runs the fused Pallas kernel per hop (online softmax
+    inside the chunk, logsumexp merge across chunks) instead of the
+    materializing per-chunk einsum — the combination for long context, where
+    neither the full sequence nor a chunk's score matrix fits HBM.
     """
+    if impl not in ("reference", "flash"):
+        raise ValueError("impl must be 'reference' or 'flash'")
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     sp_size = mesh.shape.get(sp_axis, 1)
     if sp_size == 1:
+        if impl == "flash":
+            from tritonclient_tpu.ops.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=causal, scale=scale)
         from tritonclient_tpu.ops.attention import dot_product_attention
 
         return dot_product_attention(q, k, v, causal=causal, scale=scale)
     body = functools.partial(
-        _ring_body,
+        _ring_body_flash if impl == "flash" else _ring_body,
         axis_name=sp_axis,
         axis_size=sp_size,
         causal=causal,

@@ -24,7 +24,8 @@ from tritonclient_tpu.parallel.sharding import (
 
 
 def make_mlm_train_step(cfg: bert.BertConfig, mesh, learning_rate: float = 1e-4,
-                        sequence_parallel_impl: str = "ring"):
+                        sequence_parallel_impl: str = "ring",
+                        attention_impl: str = "reference"):
     """Returns (init_state, train_step).
 
     init_state(key) -> (params, opt_state), sharded over ``mesh``.
@@ -33,10 +34,14 @@ def make_mlm_train_step(cfg: bert.BertConfig, mesh, learning_rate: float = 1e-4,
     and L by sp. ``sequence_parallel_impl`` picks the sp-axis attention:
     'ring' (ppermute pipeline, any head count) or 'ulysses' (two
     all-to-alls, heads divisible by sp — see parallel/ulysses.py for the
-    trade-off).
+    trade-off). ``attention_impl='flash'`` routes the per-device attention
+    compute (inside ring hops / the Ulysses head phase, or single-device
+    when sp=1) through the fused Pallas kernel, forward and backward.
     """
     if sequence_parallel_impl not in ("ring", "ulysses"):
         raise ValueError("sequence_parallel_impl must be 'ring' or 'ulysses'")
+    if attention_impl not in ("reference", "flash"):
+        raise ValueError("attention_impl must be 'reference' or 'flash'")
     optimizer = optax.adamw(learning_rate)
     rules = bert.PARTITION_RULES
     act_sharding = named_sharding(mesh, ("dp", "fsdp"), "sp", None)
@@ -44,11 +49,17 @@ def make_mlm_train_step(cfg: bert.BertConfig, mesh, learning_rate: float = 1e-4,
     attention_fn = None
     if mesh.shape.get("sp", 1) > 1:
         if sequence_parallel_impl == "ring":
-            attention_fn = functools.partial(ring_attention, mesh=mesh)
+            attention_fn = functools.partial(ring_attention, mesh=mesh,
+                                             impl=attention_impl)
         else:
             from tritonclient_tpu.parallel.ulysses import ulysses_attention
 
-            attention_fn = functools.partial(ulysses_attention, mesh=mesh)
+            attention_fn = functools.partial(ulysses_attention, mesh=mesh,
+                                             impl=attention_impl)
+    elif attention_impl == "flash":
+        from tritonclient_tpu.ops.flash_attention import flash_attention
+
+        attention_fn = functools.partial(flash_attention, causal=False)
 
     def loss_fn(params, batch):
         return bert.mlm_loss(

@@ -34,18 +34,31 @@ def ulysses_attention(
     sp_axis: str = "sp",
     causal: bool = False,
     scale: Optional[float] = None,
+    impl: str = "reference",
 ) -> jax.Array:
     """Attention over [B, L, H, D] tensors whose L dim is sharded on sp_axis.
 
     Requires H divisible by the sp axis size (each device owns H/sp heads
     during the compute phase). Other mesh axes (dp on B) stay automatic
     under GSPMD. With sp size 1 this degrades to plain attention.
+    ``impl='flash'`` runs the full-sequence compute phase through the fused
+    Pallas kernel (forward and backward) instead of the materializing einsum.
     """
+    if impl not in ("reference", "flash"):
+        raise ValueError("impl must be 'reference' or 'flash'")
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    if impl == "flash":
+        from tritonclient_tpu.ops.flash_attention import flash_attention
+
+        attn = lambda a, b, c: flash_attention(a, b, c, causal=causal,
+                                               scale=scale)
+    else:
+        attn = lambda a, b, c: dot_product_attention(a, b, c, causal=causal,
+                                                     scale=scale)
     sp_size = mesh.shape.get(sp_axis, 1)
     if sp_size == 1:
-        return dot_product_attention(q, k, v, causal=causal, scale=scale)
+        return attn(q, k, v)
     num_heads = q.shape[2]
     if num_heads % sp_size != 0:
         raise ValueError(
@@ -61,7 +74,7 @@ def ulysses_attention(
             )
 
         qh, kh, vh = to_heads(q_loc), to_heads(k_loc), to_heads(v_loc)
-        out = dot_product_attention(qh, kh, vh, causal=causal, scale=scale)
+        out = attn(qh, kh, vh)
         # [B, L, H/sp, D] -> [B, L/sp, H, D]: gather heads, scatter sequence.
         return lax.all_to_all(
             out, sp_axis, split_axis=1, concat_axis=2, tiled=True
