@@ -61,6 +61,55 @@ size_t DtypeSize(const std::string& dt) {
   return 1;  // INT8/UINT8/BOOL
 }
 
+// Dtype-aware random tensor matching the in-process analyzer's generator
+// (perf_analyzer/_analyzer.py _make_payload): real floats in [0,1), small
+// integers, 0/1 bools — raw bit patterns would hand FP models subnormals
+// and integer index models out-of-range values, skewing the measurement.
+std::vector<uint8_t> MakeTensor(std::mt19937& rng, const std::string& dt,
+                                size_t count) {
+  std::vector<uint8_t> buf(count * DtypeSize(dt));
+  uint8_t* out = buf.data();
+  std::uniform_real_distribution<float> uni(0.0f, 1.0f);
+  auto f16_bits = [](float f, bool bfloat) -> uint16_t {
+    uint32_t bits;
+    std::memcpy(&bits, &f, 4);
+    if (bfloat) return static_cast<uint16_t>(bits >> 16);
+    // FP32 [0,1) -> IEEE half: rebias the exponent, truncate the mantissa.
+    // Values here are normal floats in [2^-32, 1), so no inf/nan/denormal
+    // edge cases survive the clamp below.
+    int exp = static_cast<int>((bits >> 23) & 0xff) - 127;
+    if (exp < -14) return 0;
+    uint32_t mant = (bits >> 13) & 0x3ff;
+    return static_cast<uint16_t>(((exp + 15) << 10) | mant);
+  };
+  for (size_t i = 0; i < count; i++) {
+    if (dt == "FP64") {
+      double v = uni(rng);
+      std::memcpy(out + i * 8, &v, 8);
+    } else if (dt == "FP32") {
+      float v = uni(rng);
+      std::memcpy(out + i * 4, &v, 4);
+    } else if (dt == "FP16" || dt == "BF16") {
+      uint16_t v = f16_bits(uni(rng), dt == "BF16");
+      std::memcpy(out + i * 2, &v, 2);
+    } else if (dt == "INT64" || dt == "UINT64") {
+      uint64_t v = rng() % 64;
+      std::memcpy(out + i * 8, &v, 8);
+    } else if (dt == "INT32" || dt == "UINT32") {
+      uint32_t v = rng() % 64;
+      std::memcpy(out + i * 4, &v, 4);
+    } else if (dt == "INT16" || dt == "UINT16") {
+      uint16_t v = static_cast<uint16_t>(rng() % 64);
+      std::memcpy(out + i * 2, &v, 2);
+    } else if (dt == "BOOL") {
+      out[i] = static_cast<uint8_t>(rng() % 2);
+    } else {  // INT8/UINT8
+      out[i] = static_cast<uint8_t>(rng() % 64);
+    }
+  }
+  return buf;
+}
+
 // Model metadata via the HTTP client regardless of bench protocol (one
 // call, JSON already shaped for this).
 Error FetchSpecs(const Options& opt, const std::string& http_url,
@@ -175,6 +224,7 @@ int main(int argc, char** argv) {
       }
       return argv[++i];
     };
+    try {
     if (arg == "--url") opt.url = next();
     else if (arg == "--protocol") opt.protocol = next();
     else if (arg == "--model") opt.model = next();
@@ -195,6 +245,11 @@ int main(int argc, char** argv) {
       http_url_arg = next();  // metadata endpoint when benching grpc
     } else {
       std::cerr << "unknown argument " << arg << "\n";
+      return 2;
+    }
+    } catch (const std::exception&) {
+      // stoll/stoi/stod on a malformed value: a usage error, not a crash.
+      std::cerr << "bad numeric value for " << arg << "\n";
       return 2;
     }
   }
@@ -231,13 +286,7 @@ int main(int argc, char** argv) {
       for (const auto& spec : specs) {
         size_t count = 1;
         for (int64_t d : spec.shape) count *= static_cast<size_t>(d);
-        std::vector<uint8_t> buf(count * DtypeSize(spec.datatype));
-        for (size_t b = 0; b < buf.size(); b += 4) {
-          uint32_t v = rng() % 100;
-          std::memcpy(buf.data() + b, &v,
-                      std::min<size_t>(4, buf.size() - b));
-        }
-        payload.tensors.push_back(std::move(buf));
+        payload.tensors.push_back(MakeTensor(rng, spec.datatype, count));
       }
       pools[w].push_back(std::move(payload));
     }

@@ -109,6 +109,9 @@ def main(argv=None):
         if args.read_outputs:
             parser.error("--native-driver does not support --read-outputs "
                          "(the native loop never deserializes outputs)")
+        if args.protocol == "grpc" and not args.http_url:
+            parser.error("--native-driver with -i grpc needs --http-url "
+                         "(the driver fetches model metadata over HTTP)")
         from tritonclient_tpu.perf_analyzer import run_native_driver
         from tritonclient_tpu.perf_analyzer._analyzer import sweep_levels
 

@@ -894,6 +894,12 @@ def run_native_driver(
     if streaming:
         cmd.append("--streaming")
     for name, dim in (shape_overrides or {}).items():
+        if not isinstance(dim, int):
+            raise ValueError(
+                f"shape_overrides[{name!r}] must be a single int (the fill "
+                "for dynamic non-batch dims; batch comes from batch_size), "
+                f"got {dim!r}"
+            )
         cmd += ["--dim", f"{name}:{dim}"]
     proc = subprocess.run(
         cmd, capture_output=True, text=True,
