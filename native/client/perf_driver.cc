@@ -82,30 +82,32 @@ std::vector<uint8_t> MakeTensor(std::mt19937& rng, const std::string& dt,
     uint32_t mant = (bits >> 13) & 0x3ff;
     return static_cast<uint16_t>(((exp + 15) << 10) | mant);
   };
-  for (size_t i = 0; i < count; i++) {
-    if (dt == "FP64") {
-      double v = uni(rng);
-      std::memcpy(out + i * 8, &v, 8);
-    } else if (dt == "FP32") {
-      float v = uni(rng);
-      std::memcpy(out + i * 4, &v, 4);
-    } else if (dt == "FP16" || dt == "BF16") {
-      uint16_t v = f16_bits(uni(rng), dt == "BF16");
-      std::memcpy(out + i * 2, &v, 2);
-    } else if (dt == "INT64" || dt == "UINT64") {
-      uint64_t v = rng() % 64;
-      std::memcpy(out + i * 8, &v, 8);
-    } else if (dt == "INT32" || dt == "UINT32") {
-      uint32_t v = rng() % 64;
-      std::memcpy(out + i * 4, &v, 4);
-    } else if (dt == "INT16" || dt == "UINT16") {
-      uint16_t v = static_cast<uint16_t>(rng() % 64);
-      std::memcpy(out + i * 2, &v, 2);
-    } else if (dt == "BOOL") {
-      out[i] = static_cast<uint8_t>(rng() % 2);
-    } else {  // INT8/UINT8
-      out[i] = static_cast<uint8_t>(rng() % 64);
+  // Dtype resolved once; per-element loops stay branch-free.
+  auto fill = [&](auto make) {
+    using T = decltype(make());
+    for (size_t i = 0; i < count; i++) {
+      T v = make();
+      std::memcpy(out + i * sizeof(T), &v, sizeof(T));
     }
+  };
+  if (dt == "FP64") {
+    fill([&]() -> double { return uni(rng); });
+  } else if (dt == "FP32") {
+    fill([&]() -> float { return uni(rng); });
+  } else if (dt == "FP16") {
+    fill([&]() -> uint16_t { return f16_bits(uni(rng), false); });
+  } else if (dt == "BF16") {
+    fill([&]() -> uint16_t { return f16_bits(uni(rng), true); });
+  } else if (dt == "INT64" || dt == "UINT64") {
+    fill([&]() -> uint64_t { return rng() % 64; });
+  } else if (dt == "INT32" || dt == "UINT32") {
+    fill([&]() -> uint32_t { return rng() % 64; });
+  } else if (dt == "INT16" || dt == "UINT16") {
+    fill([&]() -> uint16_t { return static_cast<uint16_t>(rng() % 64); });
+  } else if (dt == "BOOL") {
+    fill([&]() -> uint8_t { return static_cast<uint8_t>(rng() % 2); });
+  } else {  // INT8/UINT8
+    fill([&]() -> uint8_t { return static_cast<uint8_t>(rng() % 64); });
   }
   return buf;
 }

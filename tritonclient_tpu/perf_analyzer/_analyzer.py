@@ -10,6 +10,7 @@ per-worker device-buffer regions so the sweep drives the server with
 on-HBM inputs/outputs over gRPC while only metadata crosses the wire.
 """
 
+import operator
 import os
 import threading
 import time
@@ -894,12 +895,16 @@ def run_native_driver(
     if streaming:
         cmd.append("--streaming")
     for name, dim in (shape_overrides or {}).items():
-        if not isinstance(dim, int):
+        try:
+            if isinstance(dim, bool):
+                raise TypeError
+            dim = operator.index(dim)  # ints + numpy integers, not floats
+        except TypeError:
             raise ValueError(
                 f"shape_overrides[{name!r}] must be a single int (the fill "
                 "for dynamic non-batch dims; batch comes from batch_size), "
                 f"got {dim!r}"
-            )
+            ) from None
         cmd += ["--dim", f"{name}:{dim}"]
     proc = subprocess.run(
         cmd, capture_output=True, text=True,
