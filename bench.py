@@ -96,7 +96,9 @@ def main():
             "BENCH_CONCURRENCY", os.environ.get("BENCH_SWEEP", "8,16,32")
         ).split(",")
     ]
-    n_windows = int(os.environ.get("BENCH_WINDOWS", "4"))
+    # More alternating pairs -> tighter median against tunnel drift; window
+    # length shrinks to keep each depth's wall time at `seconds` per side.
+    n_windows = int(os.environ.get("BENCH_WINDOWS", "6"))
     shm_mode = os.environ.get("BENCH_SHM", "tpu")
     async_window = os.environ.get("BENCH_ASYNC_WINDOW", "0") == "1"
     if async_window and shm_mode != "tpu":

@@ -40,7 +40,7 @@ def main():
             # omits zero-valued fields, hence the .get default).
             client.load_model("simple")
             config = client.get_model_config("simple", as_json=True)
-            assert config["config"].get("max_batch_size", 0) == 0
+            assert config["config"].get("max_batch_size", 0) == 64  # model's declared batching dim
             print("PASS: model control (index/unload/load/config override)")
 
 

@@ -38,7 +38,7 @@ def main():
 
             client.load_model("simple")
             config = client.get_model_config("simple")
-            assert config.get("max_batch_size", 0) == 0
+            assert config.get("max_batch_size", 0) == 64  # model's declared batching dim
             print("PASS: http model control (index/unload/load/override)")
 
 

@@ -312,14 +312,15 @@ void RunMatrix(Adapter& a) {
   {
     int64_t mbs = -1;
     EXPECT_OK(a.MaxBatchSize("simple", &mbs), tag + " config before override");
-    EXPECT(mbs == 0, tag + " default max_batch_size");
+    // SimpleModel declares max_batch_size=64 (dynamic batching).
+    EXPECT(mbs == 64, tag + " default max_batch_size");
     EXPECT_OK(a.Load("simple", "{\"max_batch_size\": 7}", {}),
               tag + " load with config override");
     EXPECT_OK(a.MaxBatchSize("simple", &mbs), tag + " config after override");
     EXPECT(mbs == 7, tag + " overridden max_batch_size");
     EXPECT_OK(a.Load("simple", "", {}), tag + " plain reload");
     EXPECT_OK(a.MaxBatchSize("simple", &mbs), tag + " config after reload");
-    EXPECT(mbs == 0, tag + " restored max_batch_size");
+    EXPECT(mbs == 64, tag + " restored max_batch_size");
   }
 
   // ---- LoadWithFileOverride (reference cc_client_test.cc:1202) ----

@@ -420,3 +420,24 @@ class TestShardedTpuShm:
             client.unregister_tpu_shared_memory()
             tpushm.destroy_shared_memory_region(in_region)
             tpushm.destroy_shared_memory_region(out_region)
+
+
+def test_tpu_shm_bf16_staging_roundtrip():
+    """BF16 arrays refuse the buffer protocol (ml_dtypes); the mirror write
+    must fall back to a byte view rather than crash (round-3 regression)."""
+    import jax.numpy as jnp
+
+    import tritonclient_tpu.utils.tpu_shared_memory as tpushm
+    from tritonclient_tpu.utils import serialize_bf16_tensor
+
+    src = np.arange(16, dtype=np.float32).reshape(2, 8)
+    bf16 = np.asarray(jnp.asarray(src, jnp.bfloat16))
+    region = tpushm.create_shared_memory_region("bf16_region", bf16.nbytes)
+    try:
+        tpushm.set_shared_memory_region(region, [bf16])
+        got = tpushm.get_contents_as_numpy(region, "BF16", [2, 8])
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), src, rtol=1e-2
+        )
+    finally:
+        tpushm.destroy_shared_memory_region(region)

@@ -47,6 +47,11 @@ class Model:
     name: str = ""
     platform: str = "jax"
     max_batch_size: int = 0  # 0 = no server-side batching dimension
+    # Opt-in to the server's dynamic batcher (server/_core.py): concurrent
+    # requests whose shapes agree off the batch axis are coalesced into one
+    # device dispatch (Triton's dynamic_batching analog). infer() must
+    # treat dim 0 of every input/output as a free batch axis.
+    dynamic_batching: bool = False
     decoupled: bool = False
     stateful: bool = False
     # True for models whose infer() blocks the calling thread (sleeps, IO).

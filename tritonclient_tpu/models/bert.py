@@ -228,6 +228,8 @@ class BertBaseModel(Model):
 
     name = "bert_base"
     platform = "jax"
+    dynamic_batching = True
+    max_batch_size = 32
 
     def __init__(self, cfg: Optional[BertConfig] = None, seed: int = 0,
                  use_flash_attention: bool = False):

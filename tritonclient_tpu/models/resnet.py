@@ -122,6 +122,8 @@ class ResNet50Model(Model):
 
     name = "resnet50"
     platform = "jax"
+    dynamic_batching = True
+    max_batch_size = 16
 
     def __init__(self, num_classes: int = 1000, seed: int = 0,
                  labels: Optional[list] = None):

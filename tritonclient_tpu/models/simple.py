@@ -27,6 +27,8 @@ class SimpleModel(Model):
 
     name = "simple"
     platform = "jax"
+    dynamic_batching = True
+    max_batch_size = 64
 
     def __init__(self):
         super().__init__()

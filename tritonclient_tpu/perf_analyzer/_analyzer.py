@@ -10,6 +10,7 @@ per-worker device-buffer regions so the sweep drives the server with
 on-HBM inputs/outputs over gRPC while only metadata crosses the wire.
 """
 
+import math
 import operator
 import os
 import threading
@@ -49,7 +50,7 @@ def _resolve_shape(spec_shape: List[int], batch: int, overrides: Dict[str, int],
 
 def _make_payload(rng, datatype: str, shape: List[int]) -> np.ndarray:
     if datatype == "BYTES":
-        flat = [str(rng.integers(0, 100)).encode() for _ in range(int(np.prod(shape)))]
+        flat = [str(rng.integers(0, 100)).encode() for _ in range(math.prod(int(d) for d in shape))]
         return np.array(flat, dtype=np.object_).reshape(shape)
     np_dtype = triton_to_np_dtype(datatype)
     if np_dtype is None:
@@ -241,7 +242,7 @@ class _Worker:
         offset = 0
         inputs = []
         for name, (dt, shape) in a.input_specs.items():
-            nbytes = int(np.prod(shape)) * np.dtype(
+            nbytes = math.prod(int(d) for d in shape) * np.dtype(
                 triton_to_np_dtype(dt)
             ).itemsize
             inp = a.infer_input_cls(name, shape, dt)
@@ -268,7 +269,7 @@ class _Worker:
                 len(serialize_byte_tensor(ps[name])[0])
                 for ps in self.payload_sets
             )
-        return int(np.prod(shape)) * np.dtype(triton_to_np_dtype(dt)).itemsize
+        return math.prod(int(d) for d in shape) * np.dtype(triton_to_np_dtype(dt)).itemsize
 
     def teardown(self):
         a = self.analyzer
@@ -557,7 +558,7 @@ class _WindowWorker:
         self._tpushm = tpushm
         self._client = a.make_client()
         self._in_slot = sum(
-            int(np.prod(shape)) * np.dtype(triton_to_np_dtype(dt)).itemsize
+            math.prod(int(d) for d in shape) * np.dtype(triton_to_np_dtype(dt)).itemsize
             for dt, shape in a.input_specs.values()
         )
         self._out_slot = sum(a.output_sizes.values())
@@ -583,7 +584,7 @@ class _WindowWorker:
             base = s * self._in_slot
             inputs = []
             for name, (dt, shape) in a.input_specs.items():
-                nbytes = int(np.prod(shape)) * np.dtype(
+                nbytes = math.prod(int(d) for d in shape) * np.dtype(
                     triton_to_np_dtype(dt)
                 ).itemsize
                 inp = a.infer_input_cls(name, shape, dt)
@@ -1050,7 +1051,7 @@ class PerfAnalyzer:
             # outputs fall back to wire-returned outputs (None).
             self.output_sizes = (
                 {
-                    name: int(np.prod(shape))
+                    name: math.prod(int(d) for d in shape)
                     * np.dtype(triton_to_np_dtype(dt)).itemsize
                     for name, (dt, shape) in specs.items()
                 }

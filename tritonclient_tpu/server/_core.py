@@ -10,6 +10,7 @@ statistics) lives here exactly once.
 """
 
 import json
+import math
 import mmap
 import os
 import threading
@@ -126,6 +127,9 @@ class SystemShmRegistry:
     def __init__(self):
         self._regions: Dict[str, dict] = {}
         self._lock = threading.Lock()
+        # Bumped on every (un)register: lets per-stream request-parse caches
+        # (server/_grpc.py) invalidate when a region's identity could change.
+        self.generation = 0
 
     def register(self, name: str, key: str, offset: int, byte_size: int):
         path = "/dev/shm/" + key.lstrip("/")
@@ -150,6 +154,7 @@ class SystemShmRegistry:
                 "byte_size": int(byte_size),
                 "mmap": mm,
             }
+            self.generation += 1
 
     def __contains__(self, name: str) -> bool:
         # GIL-atomic dict membership; safe without the lock on the hot path.
@@ -162,6 +167,7 @@ class SystemShmRegistry:
                 region = self._regions.pop(n, None)
                 if region is not None:
                     region["mmap"].close()
+            self.generation += 1
 
     def status(self, name: Optional[str] = None) -> List[dict]:
         with self._lock:
@@ -212,6 +218,8 @@ class TpuShmRegistry:
     def __init__(self):
         self._regions: Dict[str, dict] = {}
         self._lock = threading.Lock()
+        # Same cache-invalidation contract as SystemShmRegistry.generation.
+        self.generation = 0
 
     def register(self, name: str, raw_handle: bytes, device_id: int, byte_size: int):
         try:
@@ -231,6 +239,7 @@ class TpuShmRegistry:
                 "byte_size": int(byte_size),
                 "region": region,
             }
+            self.generation += 1
 
     def __contains__(self, name: str) -> bool:
         # GIL-atomic dict membership; safe without the lock on the hot path.
@@ -242,6 +251,7 @@ class TpuShmRegistry:
                 self._regions.pop(name, None)
             else:
                 self._regions.clear()
+            self.generation += 1
 
     def status(self, name: Optional[str] = None) -> List[dict]:
         with self._lock:
@@ -266,9 +276,17 @@ class TpuShmRegistry:
     def write(self, name: str, offset: int, data: bytes):
         self.get_region(name).write_bytes(offset, data)
 
-    def read_array(self, name: str, datatype: str, shape: List[int], offset: int):
-        """Zero-copy typed read: a jax.Array view over the region."""
-        return self.get_region(name).as_array(datatype, shape, offset)
+    def read_array(self, name: str, datatype: str, shape: List[int],
+                   offset: int, prefer_host: bool = False):
+        """Zero-copy typed read: a jax.Array view over the region.
+
+        ``prefer_host=True`` returns mirror-staged bytes as a host array
+        instead of uploading (parked device arrays still return as-is) —
+        the dynamic batcher's path, which uploads once per batch.
+        """
+        return self.get_region(name).as_array(
+            datatype, shape, offset, prefer_host=prefer_host
+        )
 
     def write_array(self, name: str, array, offset: int):
         """Zero-copy typed write: park a jax.Array in the region.
@@ -443,6 +461,141 @@ class _FileOverrideModel:
 # --------------------------------------------------------------------------- #
 
 
+class _BatchSlot:
+    __slots__ = ("request", "signature", "rows", "response", "error",
+                 "leader", "done")
+
+    def __init__(self, request, signature, rows):
+        self.request = request
+        self.signature = signature
+        self.rows = rows
+        self.response = None
+        self.error = None
+        self.leader = False
+        self.done = False
+
+
+class _DynamicBatcher:
+    """Natural (zero-added-latency) dynamic batching for one model.
+
+    The first request to arrive while the executor is idle becomes the
+    leader and takes the whole compatible queue as one batch; requests
+    arriving while a batch is in flight accumulate for the next leader.
+    Batches therefore only form when the server is backed up — exactly when
+    amortizing per-request dispatch cost matters — and an unloaded server
+    pays nothing (batch of one takes the ordinary single-request path).
+    This is the in-process analog of Triton's dynamic_batching scheduler
+    (the reference repo is client-only; its servers batch the same way).
+    """
+
+    def __init__(self, core, max_batch: int):
+        self.core = core
+        self.max_batch = max_batch
+        self._cv = threading.Condition()
+        self._queue: List[_BatchSlot] = []
+        self._busy = False
+
+    def eligible(self, request: CoreRequest) -> bool:
+        # Sequence/priority parameters, BYTES tensors, rank-0 inputs, and
+        # single requests already exceeding the model's batch dimension
+        # bypass batching (dim 0 must be a free batch axis the model
+        # promised to handle up to max_batch rows of).
+        if request.parameters or not request.inputs:
+            return False
+        for t in request.inputs:
+            if t.datatype == "BYTES" or not t.shape:
+                return False
+        if int(request.inputs[0].shape[0]) > self.max_batch:
+            return False
+        return True
+
+    def infer(self, model, request: CoreRequest, stats) -> CoreResponse:
+        signature = tuple(
+            (t.name, t.datatype, tuple(t.shape[1:])) for t in request.inputs
+        )
+        slot = _BatchSlot(request, signature,
+                          int(request.inputs[0].shape[0]))
+        with self._cv:
+            self._queue.append(slot)
+            if not self._busy:
+                self._busy = True
+                slot.leader = True
+            else:
+                deadline = time.monotonic() + 60.0
+                while not slot.leader and not slot.done:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        # Re-checked under the lock: a promotion or a
+                        # completed batch racing the timeout wins.
+                        try:
+                            self._queue.remove(slot)
+                        except ValueError:
+                            pass
+                        raise CoreError(
+                            f"dynamic batch wait timed out for model "
+                            f"'{model.name}'",
+                            500,
+                        )
+                    self._cv.wait(timeout=remaining)
+        if slot.done:
+            if slot.error is not None:
+                raise slot.error
+            return slot.response
+        # Leader: take queued compatible slots up to max_batch ROWS (the
+        # model's declared batch-dimension contract), run the batch, then
+        # hand leadership to the next waiter if any.
+        try:
+            with self._cv:
+                self._queue.remove(slot)
+                batch = [slot]
+                rows = slot.rows
+                rest = []
+                for s in self._queue:
+                    if (
+                        rows + s.rows <= self.max_batch
+                        and s.signature == signature
+                    ):
+                        batch.append(s)
+                        rows += s.rows
+                    else:
+                        rest.append(s)
+                self._queue[:] = rest
+            try:
+                results = self.core._infer_batch(
+                    model, [s.request for s in batch], stats
+                )
+                for s, res in zip(batch, results):
+                    if isinstance(res, CoreError):
+                        s.error = res
+                    else:
+                        s.response = res
+            except CoreError as e:
+                for s in batch:
+                    s.error = e
+            except Exception as e:  # defensive: surface to every waiter
+                err = CoreError(
+                    f"inference failed for model '{model.name}': {e}", 500
+                )
+                for s in batch:
+                    s.error = err
+            for s in batch:
+                s.done = True
+        finally:
+            with self._cv:
+                promoted = False
+                for s in self._queue:
+                    if not s.done and not s.leader:
+                        s.leader = True
+                        promoted = True
+                        break
+                if not promoted:
+                    self._busy = False
+                self._cv.notify_all()
+        if slot.error is not None:
+            raise slot.error
+        return slot.response
+
+
 class InferenceCore:
     """Model repository + executor + admin surface, shared by both transports."""
 
@@ -461,6 +614,10 @@ class InferenceCore:
         self.tpu_shm = TpuShmRegistry()
         self._trace_settings: Dict[str, dict] = {"": dict(_DEFAULT_TRACE_SETTINGS)}
         self._log_settings = dict(_DEFAULT_LOG_SETTINGS)
+        self._batchers: Dict[str, _DynamicBatcher] = {}
+        self._dynamic_batching = (
+            os.environ.get("TPU_SERVER_DYNAMIC_BATCH", "1") != "0"
+        )
         for model in models or []:
             self.add_model(model)
 
@@ -470,6 +627,14 @@ class InferenceCore:
         self._repository[model.name] = model
         self._loaded[model.name] = loaded
         self._stats.setdefault(model.name, _ModelStats())
+        if (
+            self._dynamic_batching
+            and getattr(model, "dynamic_batching", False)
+            and not model.decoupled
+        ):
+            self._batchers[model.name] = _DynamicBatcher(
+                self, getattr(model, "max_batch_size", 0) or 64
+            )
 
     def _get_model(self, name: str, version: str = ""):
         model = self._repository.get(name)
@@ -671,6 +836,12 @@ class InferenceCore:
     ) -> Union[CoreResponse, Iterator[CoreResponse]]:
         model = self._get_model(request.model_name, request.model_version)
         stats = self._stats[request.model_name]
+        batcher = self._batchers.get(request.model_name)
+        if batcher is not None and batcher.eligible(request):
+            return batcher.infer(model, request, stats)
+        return self._infer_one(model, request, stats)
+
+    def _infer_one(self, model, request: CoreRequest, stats) -> CoreResponse:
         t_start = time.monotonic_ns()
 
         # Resolve inputs (shm reads / typed views happen here).
@@ -678,21 +849,7 @@ class InferenceCore:
         for tensor in request.inputs:
             inputs[tensor.name] = self._resolve_input(tensor)
         t_input = time.monotonic_ns()
-
-        declared = {spec.name: spec for spec in model.inputs}
-        for spec in model.inputs:
-            if not spec.optional and spec.name not in inputs:
-                raise CoreError(
-                    f"expected {len(model.inputs)} inputs but got "
-                    f"{len(inputs)} inputs for model '{model.name}'",
-                    400,
-                )
-        for name in inputs:
-            if declared and name not in declared:
-                raise CoreError(
-                    f"unexpected inference input '{name}' for model '{model.name}'",
-                    400,
-                )
+        self._validate_inputs(model, inputs)
 
         try:
             result = model.infer(inputs, dict(request.parameters))
@@ -727,6 +884,143 @@ class InferenceCore:
             stats.fail_count += 1
             stats.fail_ns += time.monotonic_ns() - t_start
 
+    def _validate_inputs(self, model, inputs: Dict[str, np.ndarray]):
+        """Declared-input checks shared by the single and batched paths."""
+        declared = {spec.name: spec for spec in model.inputs}
+        for spec in model.inputs:
+            if not spec.optional and spec.name not in inputs:
+                raise CoreError(
+                    f"expected {len(model.inputs)} inputs but got "
+                    f"{len(inputs)} inputs for model '{model.name}'",
+                    400,
+                )
+        for name in inputs:
+            if declared and name not in declared:
+                raise CoreError(
+                    f"unexpected inference input '{name}' for model "
+                    f"'{model.name}'",
+                    400,
+                )
+
+    def _infer_batch(self, model, requests: List[CoreRequest], stats):
+        """Execute a dynamic batch: one device dispatch for N requests.
+
+        Inputs resolve host-preferring (a region's staged mirror bytes stay
+        on the host; a parked device array stays on device), concatenate on
+        the batch axis, run once, and split back per request. Returns one
+        entry per request: a CoreResponse, or a CoreError for requests that
+        individually failed resolution/response-building (a bad request
+        must not poison its batchmates; only model-execution errors are
+        shared). Triton stats semantics: one execution, N inferences.
+        """
+        if len(requests) == 1:
+            try:
+                return [self._infer_one(model, requests[0], stats)]
+            except CoreError as e:
+                return [e]
+        t_start = time.monotonic_ns()
+        results: List[object] = [None] * len(requests)
+        resolved = []
+        live = []  # indices still in the batch
+        for i, request in enumerate(requests):
+            try:
+                inputs = {}
+                for tensor in request.inputs:
+                    inputs[tensor.name] = self._resolve_input(
+                        tensor, prefer_host=True
+                    )
+                self._validate_inputs(model, inputs)
+            except CoreError as e:
+                results[i] = e
+                self._record_failure(stats, t_start)
+                continue
+            resolved.append(inputs)
+            live.append(i)
+        if not resolved:
+            return results
+        try:
+            names = list(resolved[0])
+            sizes = [int(r[names[0]].shape[0]) for r in resolved]
+            total = sum(sizes)
+            # Pad the batch axis up to a power-of-two bucket: without it
+            # every distinct request mix compiles a fresh XLA executable
+            # (a multi-second stall each); with it the ladder is O(log)
+            # shapes. Padded rows replicate row 0 and their outputs are
+            # discarded below — rows are independent along the batch axis,
+            # which is what dynamic_batching=True asserts.
+            bucket = 1 << (total - 1).bit_length()
+            pad = bucket - total
+            cat = {}
+            for name in names:
+                parts = [r[name] for r in resolved]
+                if all(isinstance(p, np.ndarray) for p in parts):
+                    if pad:
+                        parts = parts + [
+                            np.broadcast_to(
+                                parts[0][:1], (pad,) + parts[0].shape[1:]
+                            )
+                        ]
+                    cat[name] = np.concatenate(parts, axis=0)
+                else:
+                    import jax.numpy as jnp
+
+                    if pad:
+                        parts = parts + [
+                            jnp.broadcast_to(
+                                parts[0][:1], (pad,) + tuple(parts[0].shape[1:])
+                            )
+                        ]
+                    cat[name] = jnp.concatenate(parts, axis=0)
+            t_input = time.monotonic_ns()
+            result = model.infer(cat, {})
+            if not isinstance(result, dict):
+                result = dict(result)
+            for name, array in result.items():
+                if array.shape[0] != bucket:
+                    raise CoreError(
+                        f"dynamic batch output '{name}' has batch dim "
+                        f"{array.shape[0]}, expected {bucket} for model "
+                        f"'{model.name}'",
+                        500,
+                    )
+            t_infer = time.monotonic_ns()
+            ok = 0
+            start = 0
+            for idx, n in zip(live, sizes):
+                sliced = {k: v[start : start + n] for k, v in result.items()}
+                start += n
+                try:
+                    results[idx] = self._build_response(
+                        model, requests[idx], sliced
+                    )
+                    ok += 1
+                except CoreError as e:  # e.g. this request's region too small
+                    results[idx] = e
+                    self._record_failure(stats, t_start)
+            t_end = time.monotonic_ns()
+        except CoreError:
+            with self._lock:
+                stats.fail_count += len(live)
+                stats.fail_ns += (time.monotonic_ns() - t_start) * len(live)
+            raise
+        except Exception as e:
+            with self._lock:
+                stats.fail_count += len(live)
+                stats.fail_ns += (time.monotonic_ns() - t_start) * len(live)
+            raise CoreError(
+                f"inference failed for model '{model.name}': {e}", 500
+            )
+        with self._lock:
+            stats.inference_count += ok
+            stats.execution_count += 1  # Triton: one batched execution
+            stats.last_inference = int(time.time() * 1000)
+            stats.success_count += ok
+            stats.success_ns += (t_end - t_start) * ok
+            stats.compute_input_ns += (t_input - t_start) * ok
+            stats.compute_infer_ns += (t_infer - t_input) * ok
+            stats.compute_output_ns += (t_end - t_infer) * ok
+        return results
+
     def _decoupled_responses(self, model, request, result_iter, stats, t_start):
         def gen():
             count = 0
@@ -743,13 +1037,21 @@ class InferenceCore:
 
         return gen()
 
-    def _resolve_input(self, tensor: CoreTensor) -> np.ndarray:
+    def _resolve_input(
+        self, tensor: CoreTensor, prefer_host: bool = False
+    ) -> np.ndarray:
         if tensor.shm_region is not None:
             registry = self.shm_registry(tensor.shm_kind or "system")
             if tensor.shm_kind == "tpu" and tensor.datatype != "BYTES":
-                # Zero-copy typed view straight off the device buffer.
+                # Default: zero-copy typed view (parked device array, or
+                # mirror bytes uploaded once and parked for repeat
+                # consumers). prefer_host (the dynamic batcher): mirror-
+                # staged bytes stay host-side so the whole batch pays ONE
+                # upload after concatenation; parked arrays still return
+                # as-is.
                 return registry.read_array(
-                    tensor.shm_region, tensor.datatype, tensor.shape, tensor.shm_offset
+                    tensor.shm_region, tensor.datatype, tensor.shape,
+                    tensor.shm_offset, prefer_host=prefer_host,
                 )
             raw = registry.read(
                 tensor.shm_region, tensor.shm_offset, tensor.shm_byte_size
@@ -817,7 +1119,9 @@ class InferenceCore:
                 registry = self.shm_registry(req.shm_kind or "system")
                 if req.shm_kind == "tpu" and datatype != "BYTES":
                     registry.write_array(req.shm_region, array, req.shm_offset)
-                    nbytes = array.nbytes
+                    # jax.Array.nbytes is a ~35us Python property (np.prod
+                    # over the shape); this runs per request.
+                    nbytes = math.prod(array.shape) * array.dtype.itemsize
                 else:
                     raw = self._encode_raw(datatype, np.asarray(array))
                     nbytes = len(raw)

@@ -408,12 +408,81 @@ class _Servicer:
             context.abort(_status_for(e), str(e))
 
     def ModelStreamInfer(self, request_iterator, context):
+        # Per-stream hot-path caches. Load generators (and the reference's
+        # C++ client, grpc_client.cc:1419 submessage reuse) send the SAME
+        # request proto repeatedly with only shm region *contents* changing;
+        # parsing is a pure function of the proto plus the shm registries,
+        # so an identical proto under an unchanged registry generation can
+        # reuse the previous parse. Same for the response: all-shm outputs
+        # carry metadata only, so an identical metadata key re-yields the
+        # previously built proto (gRPC serializes at send; no mutation).
+        core = self.core
+        # Keyed by request id: a mux'd stream interleaves several logical
+        # requesters (each reusing its own prepared proto), so a depth-1
+        # cache would never hit. Bounded; a stream cycling >128 distinct ids
+        # with identical bodies is not the pattern this serves.
+        cached_reqs = {}  # id -> (request proto, creq, registry generation)
+        cached_resps = {}  # id -> (key, ModelStreamInferResponse)
         for request in request_iterator:
             want_final = _want_final(request)
             try:
-                creq = request_to_core(request, self.core)
-                cresp = self.core.infer(creq)
-                yield from _stream_responses(request, cresp, want_final)
+                gen = core.system_shm.generation + core.tpu_shm.generation
+                hit = cached_reqs.get(request.id)
+                if hit is not None and hit[2] == gen and request == hit[0]:
+                    creq = hit[1]
+                else:
+                    creq = request_to_core(request, core)
+                    # Cache only all-shm-input requests: with no embedded
+                    # data plane the parse holds no arrays a model could
+                    # observe across requests.
+                    if (
+                        request.id
+                        and creq.inputs
+                        and all(t.shm_region is not None for t in creq.inputs)
+                    ):
+                        if len(cached_reqs) >= 128:
+                            cached_reqs.clear()
+                        cached_reqs[request.id] = (request, creq, gen)
+                    else:
+                        cached_reqs.pop(request.id, None)
+                cresp = core.infer(creq)
+                if isinstance(cresp, CoreResponse) and all(
+                    o.data is None and o.shm_region is not None
+                    for o in cresp.outputs
+                ):
+                    key = (
+                        want_final,
+                        cresp.id,
+                        cresp.model_name,
+                        cresp.model_version,
+                        tuple(sorted(cresp.parameters.items())),
+                        tuple(
+                            (
+                                o.name,
+                                o.datatype,
+                                tuple(o.shape),
+                                o.shm_kind,
+                                o.shm_region,
+                                o.shm_offset,
+                                o.shm_byte_size,
+                            )
+                            for o in cresp.outputs
+                        ),
+                    )
+                    hit = cached_resps.get(cresp.id)
+                    if hit is not None and hit[0] == key:
+                        yield hit[1]
+                    else:
+                        msg = next(
+                            _stream_responses(request, cresp, want_final)
+                        )
+                        if cresp.id:
+                            if len(cached_resps) >= 128:
+                                cached_resps.clear()
+                            cached_resps[cresp.id] = (key, msg)
+                        yield msg
+                else:
+                    yield from _stream_responses(request, cresp, want_final)
             except CoreError as e:
                 yield pb.ModelStreamInferResponse(error_message=str(e))
 

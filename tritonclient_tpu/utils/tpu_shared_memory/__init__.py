@@ -25,6 +25,7 @@ the ranges they cover.
 
 import base64
 import json
+import math
 import os
 import threading
 import uuid as _uuid_mod
@@ -68,6 +69,16 @@ def _triton_dtype_for(arr) -> str:
     return np_to_triton_dtype(np.dtype(arr.dtype))
 
 
+def _nbytes(arr) -> int:
+    """Byte size from shape/dtype metadata.
+
+    jax.Array.nbytes is a Python property that np.prod's the shape
+    (~35us); this runs at request rate on the region hot paths, so
+    compute it with math.prod instead (<1us). Works for numpy too.
+    """
+    return math.prod(arr.shape) * arr.dtype.itemsize
+
+
 class TpuSharedMemoryRegion:
     """One named reservation on a TPU device holding parked jax.Arrays."""
 
@@ -109,9 +120,10 @@ class TpuSharedMemoryRegion:
         """
         for off in list(self._parked):
             arr = self._parked[off]
-            if off < offset + nbytes and offset < off + arr.nbytes:
-                if off < offset or off + arr.nbytes > offset + nbytes:
-                    self._mirror[off : off + arr.nbytes] = np.asarray(arr).tobytes()
+            an = _nbytes(arr)
+            if off < offset + nbytes and offset < off + an:
+                if off < offset or off + an > offset + nbytes:
+                    self._mirror[off : off + an] = np.asarray(arr).tobytes()
                 del self._parked[off]
 
     # -- typed (zero-copy) plane --------------------------------------------
@@ -133,12 +145,14 @@ class TpuSharedMemoryRegion:
             arr = jax.device_put(array, self.device)
         if block:
             jax.block_until_ready(arr)
-        self._check_range(offset, arr.nbytes)
+        an = _nbytes(arr)
+        self._check_range(offset, an)
         with self._lock:
-            self._drop_overlapping(offset, arr.nbytes)
+            self._drop_overlapping(offset, an)
             self._parked[offset] = arr
 
-    def as_array(self, datatype: str, shape: Sequence[int], offset: int = 0):
+    def as_array(self, datatype: str, shape: Sequence[int], offset: int = 0,
+                 prefer_host: bool = False):
         """A jax.Array view of the region contents at ``offset``.
 
         Zero-copy when a parked array matches dtype/shape; otherwise
@@ -147,21 +161,28 @@ class TpuSharedMemoryRegion:
         with the compute that consumes it (one enqueuing thread per device
         chain; see set_shared_memory_region). The materialized array is
         parked so repeated consumers pay the upload once.
+
+        ``prefer_host=True``: mirror-staged bytes come back as a host numpy
+        array with no upload (a parked device array still returns as-is) —
+        for consumers that coalesce uploads themselves, e.g. the server's
+        dynamic batcher.
         """
         jax = _jax()
         shape = tuple(int(s) for s in shape)
         np_dtype = _np_dtype_for(datatype)
-        nbytes = int(np.prod(shape)) * np_dtype.itemsize
+        nbytes = math.prod(shape) * np_dtype.itemsize
         self._check_range(offset, nbytes)
         with self._lock:
             parked = self._parked.get(offset)
-            if parked is not None and parked.nbytes == nbytes:
+            if parked is not None and _nbytes(parked) == nbytes:
                 if parked.dtype == np_dtype and parked.shape == shape:
                     return parked
                 return parked.view(np_dtype).reshape(shape)
         host = np.frombuffer(
             self.read_bytes(offset, nbytes), dtype=np_dtype
         ).reshape(shape)
+        if prefer_host:
+            return host
         arr = jax.device_put(host, self.device)
         with self._lock:
             self._drop_overlapping(offset, nbytes)
@@ -177,11 +198,11 @@ class TpuSharedMemoryRegion:
         """
         shape = tuple(int(s) for s in shape)
         np_dtype = _np_dtype_for(datatype)
-        nbytes = int(np.prod(shape)) * np_dtype.itemsize
+        nbytes = math.prod(shape) * np_dtype.itemsize
         self._check_range(offset, nbytes)
         with self._lock:
             parked = self._parked.get(offset)
-            keep = parked is not None and parked.nbytes == nbytes
+            keep = parked is not None and _nbytes(parked) == nbytes
         if keep:
             host = np.asarray(parked)
             if host.dtype != np_dtype or host.shape != shape:
@@ -199,6 +220,25 @@ class TpuSharedMemoryRegion:
             self._drop_overlapping(offset, len(data))
             self._mirror[offset : offset + len(data)] = data
 
+    def write_host_array(self, arr: np.ndarray, offset: int):
+        """Mirror write straight from a C-contiguous array's buffer.
+
+        Same semantics as ``write_bytes(offset, arr.tobytes())`` without the
+        intermediate bytes allocation — this is the per-request host->mirror
+        hop of the staged set path, so it runs at request rate.
+        """
+        nbytes = arr.nbytes
+        self._check_range(offset, nbytes)
+        try:
+            view = memoryview(arr).cast("B")
+        except (ValueError, TypeError):
+            # Extension dtypes (ml_dtypes bfloat16 etc.) refuse the buffer
+            # protocol; reinterpret the same memory as raw bytes instead.
+            view = memoryview(arr.view(np.uint8).reshape(-1))
+        with self._lock:
+            self._drop_overlapping(offset, nbytes)
+            self._mirror[offset : offset + nbytes] = view
+
     def read_bytes(self, offset: int, nbytes: int) -> bytes:
         self._check_range(offset, nbytes)
         with self._lock:
@@ -206,8 +246,9 @@ class TpuSharedMemoryRegion:
             # Flush parked ranges overlapping the request into the mirror
             # (device -> host copy only when a raw-byte reader asks).
             for off, arr in parked:
-                if off < offset + nbytes and offset < off + arr.nbytes:
-                    self._mirror[off : off + arr.nbytes] = np.asarray(arr).tobytes()
+                an = _nbytes(arr)
+                if off < offset + nbytes and offset < off + an:
+                    self._mirror[off : off + an] = np.asarray(arr).tobytes()
             return bytes(self._mirror[offset : offset + nbytes])
 
     def __repr__(self):
@@ -268,25 +309,31 @@ class TpuShardedMemoryRegion(TpuSharedMemoryRegion):
             arr = jax.device_put(array, self.sharding)
         if block:
             jax.block_until_ready(arr)
-        self._check_range(offset, arr.nbytes)
+        an = _nbytes(arr)
+        self._check_range(offset, an)
         with self._lock:
-            self._drop_overlapping(offset, arr.nbytes)
+            self._drop_overlapping(offset, an)
             self._parked[offset] = arr
 
-    def as_array(self, datatype: str, shape: Sequence[int], offset: int = 0):
+    def as_array(self, datatype: str, shape: Sequence[int], offset: int = 0,
+                 prefer_host: bool = False):
         """A sharded jax.Array view of the region contents at ``offset``."""
         jax = _jax()
         shape = tuple(int(s) for s in shape)
         np_dtype = _np_dtype_for(datatype)
-        nbytes = int(np.prod(shape)) * np_dtype.itemsize
+        nbytes = math.prod(shape) * np_dtype.itemsize
         self._check_range(offset, nbytes)
         with self._lock:
             parked = self._parked.get(offset)
-            if parked is not None and parked.nbytes == nbytes:
+            if parked is not None and _nbytes(parked) == nbytes:
                 if parked.dtype == np_dtype and parked.shape == shape:
                     return parked
                 # A dtype/shape reinterpretation cannot stay sharded in
                 # general; gather through the host mirror below instead.
+        if prefer_host:
+            return np.frombuffer(
+                self.read_bytes(offset, nbytes), dtype=np_dtype
+            ).reshape(shape)
         host = np.frombuffer(
             self.read_bytes(offset, nbytes), dtype=np_dtype
         ).reshape(shape)
@@ -416,7 +463,7 @@ def set_shared_memory_region(
             cursor += len(data)
         else:
             arr = np.ascontiguousarray(arr)
-            shm_handle.write_bytes(cursor, arr.tobytes())
+            shm_handle.write_host_array(arr, cursor)
             cursor += arr.nbytes
 
 
@@ -463,7 +510,7 @@ def get_contents_as_numpy(
         from tritonclient_tpu.utils import decode_bytes_elements
 
         raw = shm_handle.read_bytes(offset, shm_handle.byte_size - offset)
-        count = int(np.prod(shape))
+        count = math.prod(shape)
         return decode_bytes_elements(raw, count).reshape(shape)
     out = shm_handle.read_typed(datatype, shape, offset)
     if datatype == "BF16":
