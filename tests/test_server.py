@@ -510,19 +510,43 @@ class TestDynamicBatching:
             inputs=[CoreTensor("INPUT0", "INT32", [1, 16], data=a),
                     CoreTensor("INPUT1", "INT32", [1, 16], data=a)],
         )
-        assert batcher.eligible(req)
+        assert batcher.eligible(req, 64)
         # Sequence/priority parameters bypass the batcher entirely.
         req_p = CoreRequest(
             model_name="simple", parameters={"sequence_id": 7},
             inputs=req.inputs,
         )
-        assert not batcher.eligible(req_p)
+        assert not batcher.eligible(req_p, 64)
         # BYTES tensors bypass (no batch axis on the wire encoding).
         req_b = CoreRequest(
             model_name="simple",
             inputs=[CoreTensor("INPUT0", "BYTES", [1], data=None)],
         )
-        assert not batcher.eligible(req_b)
+        assert not batcher.eligible(req_b, 64)
+        # Inconsistent per-input batch dims bypass (would misalign slices).
+        req_m = CoreRequest(
+            model_name="simple",
+            inputs=[CoreTensor("INPUT0", "INT32", [1, 16], data=a),
+                    CoreTensor("INPUT1", "INT32", [2, 16], data=a)],
+        )
+        assert not batcher.eligible(req_m, 64)
+        # Zero-row and over-cap requests bypass.
+        req_z = CoreRequest(
+            model_name="simple",
+            inputs=[CoreTensor("INPUT0", "INT32", [0, 16], data=a),
+                    CoreTensor("INPUT1", "INT32", [0, 16], data=a)],
+        )
+        assert not batcher.eligible(req_z, 64)
+        assert not batcher.eligible(req, 0)
+        # A live config override lowers the effective cap the core routes
+        # with (round-3 review: stale add_model-time limit).
+        model = core._repository["simple"]
+        model._config_override = {"max_batch_size": 7}
+        try:
+            assert core._effective_max_batch(model) == 7
+        finally:
+            model._config_override = {}
+        assert core._effective_max_batch(model) == 64
 
     def test_batch_padding_buckets_power_of_two(self):
         from tritonclient_tpu.models.simple import SimpleModel

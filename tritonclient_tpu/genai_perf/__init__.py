@@ -1,0 +1,223 @@
+"""genai-perf equivalent: LLM streaming metrics over decoupled gRPC.
+
+The reference ecosystem's genai-perf (sources relocated out of the
+snapshot — reference src/c++/perf_analyzer/genai-perf/README.md tail)
+measures token-streaming workloads; this is that instrument for the TPU
+stack. N closed-loop workers drive a decoupled model (one response per
+generated token, empty final response terminating each request) and
+record:
+
+  * TTFT  — time to first token (send → first streamed response),
+  * ITL   — inter-token latency (gaps between consecutive responses),
+  * request latency, output-token throughput, request throughput.
+
+Works against any decoupled model whose per-response output carries the
+generated token(s); the stock target is `models/gpt.GptModel`.
+"""
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from tritonclient_tpu.perf_analyzer._stats import percentile
+
+
+def _pctls(values_ns: List[int]) -> Dict[str, float]:
+    if not values_ns:
+        return {"avg_ms": 0.0, "p50_ms": 0.0, "p90_ms": 0.0, "p99_ms": 0.0}
+    us = sorted(v / 1000.0 for v in values_ns)
+    return {
+        "avg_ms": round(sum(us) / len(us) / 1000.0, 3),
+        "p50_ms": round(percentile(us, 50) / 1000.0, 3),
+        "p90_ms": round(percentile(us, 90) / 1000.0, 3),
+        "p99_ms": round(percentile(us, 99) / 1000.0, 3),
+    }
+
+
+class _Worker:
+    """One closed-loop streaming requester with per-response timestamps."""
+
+    def __init__(self, analyzer: "GenAIPerf", wid: int):
+        self.a = analyzer
+        self.wid = wid
+        self.ttft_ns: List[int] = []
+        self.itl_ns: List[int] = []
+        self.latency_ns: List[int] = []
+        self.tokens = 0
+        self.requests = 0
+        self.errors = 0
+        self._stop = threading.Event()
+        rng = np.random.default_rng(4321 + wid)
+        self.prompts = [
+            rng.integers(0, analyzer.vocab_size,
+                         (1, analyzer.input_tokens)).astype(np.int32)
+            for _ in range(8)
+        ]
+
+    def setup(self):
+        from tritonclient_tpu.grpc import InferenceServerClient, InferInput
+
+        self._client = InferenceServerClient(self.a.url)
+        self._responses: "queue.Queue" = queue.Queue()
+        self._client.start_stream(
+            callback=lambda result, error: self._responses.put(
+                (time.perf_counter_ns(), result, error)
+            )
+        )
+        self._InferInput = InferInput
+
+    def run(self, end_time: float):
+        a = self.a
+        i = 0
+        while time.perf_counter() < end_time and not self._stop.is_set():
+            prompt = self.prompts[i % len(self.prompts)]
+            i += 1
+            inp = self._InferInput(
+                "INPUT_IDS", list(prompt.shape), "INT32"
+            )
+            inp.set_data_from_numpy(prompt)
+            mt = self._InferInput("MAX_TOKENS", [1], "INT32")
+            mt.set_data_from_numpy(
+                np.array([a.output_tokens], np.int32)
+            )
+            t_send = time.perf_counter_ns()
+            try:
+                self._client.async_stream_infer(
+                    a.model_name, [inp, mt],
+                    enable_empty_final_response=True,
+                )
+            except Exception:
+                self.errors += 1
+                continue
+            n_tokens = 0
+            t_prev = None
+            failed = False
+            while True:
+                try:
+                    t_recv, result, error = self._responses.get(timeout=120)
+                except queue.Empty:
+                    failed = True
+                    break
+                if error is not None:
+                    failed = True
+                    break
+                response = result.get_response()
+                p = response.parameters.get("triton_final_response")
+                final = bool(p and p.bool_param)
+                if response.outputs:
+                    n_tokens += 1
+                    if t_prev is None:
+                        self.ttft_ns.append(t_recv - t_send)
+                    else:
+                        self.itl_ns.append(t_recv - t_prev)
+                    t_prev = t_recv
+                if final:
+                    break
+            if failed:
+                self.errors += 1
+                continue
+            self.latency_ns.append(time.perf_counter_ns() - t_send)
+            self.tokens += n_tokens
+            self.requests += 1
+
+    def teardown(self):
+        try:
+            self._client.stop_stream()
+        except Exception:
+            pass
+        try:
+            self._client.close()
+        except Exception:
+            pass
+
+
+class GenAIPerf:
+    """Concurrency-level LLM streaming benchmark (genai-perf analog)."""
+
+    def __init__(
+        self,
+        url: str,
+        model_name: str = "gpt",
+        input_tokens: int = 32,
+        output_tokens: int = 16,
+        vocab_size: int = 32000,
+        measurement_interval_s: float = 10.0,
+        warmup_s: float = 2.0,
+        verbose: bool = False,
+    ):
+        self.url = url
+        self.model_name = model_name
+        self.input_tokens = input_tokens
+        self.output_tokens = output_tokens
+        self.vocab_size = vocab_size
+        self.measurement_interval_s = measurement_interval_s
+        self.warmup_s = warmup_s
+        self.verbose = verbose
+
+    def measure(self, concurrency: int) -> Dict:
+        workers = [_Worker(self, w) for w in range(concurrency)]
+        for w in workers:
+            w.setup()
+        try:
+            end = (time.perf_counter() + self.warmup_s
+                   + self.measurement_interval_s)
+            threads = [
+                threading.Thread(target=w.run, args=(end,), daemon=True)
+                for w in workers
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            time.sleep(self.warmup_s)
+            # Discard warmup samples (first-compile, stream setup).
+            for w in workers:
+                w.ttft_ns.clear()
+                w.itl_ns.clear()
+                w.latency_ns.clear()
+                w.tokens = 0
+                w.requests = 0
+            window_start = time.perf_counter()
+            for t in threads:
+                t.join()
+            duration = time.perf_counter() - window_start
+            del t0
+        finally:
+            for w in workers:
+                w.teardown()
+        ttft = [v for w in workers for v in w.ttft_ns]
+        itl = [v for w in workers for v in w.itl_ns]
+        lat = [v for w in workers for v in w.latency_ns]
+        tokens = sum(w.tokens for w in workers)
+        requests = sum(w.requests for w in workers)
+        errors = sum(w.errors for w in workers)
+        return {
+            "concurrency": concurrency,
+            "requests": requests,
+            "errors": errors,
+            "output_tokens": tokens,
+            "duration_s": round(duration, 3),
+            "request_throughput_per_sec": round(requests / duration, 3),
+            "output_token_throughput_per_sec": round(tokens / duration, 2),
+            "time_to_first_token": _pctls(ttft),
+            "inter_token_latency": _pctls(itl),
+            "request_latency": _pctls(lat),
+        }
+
+    def sweep(self, start: int, end: int, step: int = 1) -> List[Dict]:
+        results = []
+        level = start
+        while level <= end:
+            summary = self.measure(level)
+            if self.verbose:
+                print(
+                    f"concurrency {level}: "
+                    f"{summary['output_token_throughput_per_sec']} tok/s, "
+                    f"ttft p50 {summary['time_to_first_token']['p50_ms']} ms, "
+                    f"itl p50 {summary['inter_token_latency']['p50_ms']} ms"
+                )
+            results.append(summary)
+            level += step
+        return results

@@ -1,0 +1,71 @@
+"""CLI: python -m tritonclient_tpu.genai_perf -m gpt -u host:8001 ...
+
+Mirrors the genai-perf flag surface subset that applies to a KServe v2
+decoupled token-streaming model.
+"""
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="genai_perf",
+        description="LLM streaming benchmark (TTFT / ITL / token throughput)",
+    )
+    parser.add_argument("-m", "--model-name", default="gpt")
+    parser.add_argument("-u", "--url", default="127.0.0.1:8001")
+    parser.add_argument("--concurrency-range", default="1:4:1",
+                        help="start:end[:step] closed-loop stream workers")
+    parser.add_argument("--input-tokens", type=int, default=32)
+    parser.add_argument("--output-tokens", type=int, default=16)
+    parser.add_argument("--vocab-size", type=int, default=32000)
+    parser.add_argument("--measurement-interval", type=float, default=8000.0,
+                        help="per-level window, milliseconds")
+    parser.add_argument("--warmup-interval", type=float, default=2000.0)
+    parser.add_argument("--json", action="store_true",
+                        help="emit one JSON document instead of the table")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args(argv)
+
+    parts = [int(x) for x in args.concurrency_range.split(":")]
+    start, end = parts[0], parts[1] if len(parts) > 1 else parts[0]
+    step = parts[2] if len(parts) > 2 else 1
+
+    from tritonclient_tpu.genai_perf import GenAIPerf
+
+    analyzer = GenAIPerf(
+        url=args.url,
+        model_name=args.model_name,
+        input_tokens=args.input_tokens,
+        output_tokens=args.output_tokens,
+        vocab_size=args.vocab_size,
+        measurement_interval_s=args.measurement_interval / 1000.0,
+        warmup_s=args.warmup_interval / 1000.0,
+        verbose=args.verbose,
+    )
+    results = analyzer.sweep(start, end, step)
+    if args.json:
+        print(json.dumps({"model": args.model_name, "results": results}))
+        return 0
+    print(f"Model: {args.model_name}  (input {args.input_tokens} tok, "
+          f"output {args.output_tokens} tok)")
+    header = (f"{'Conc':>4} {'Req/s':>8} {'Tok/s':>9} {'TTFT p50':>9} "
+              f"{'TTFT p99':>9} {'ITL p50':>8} {'ITL p99':>8} {'Err':>4}")
+    print(header)
+    for r in results:
+        print(
+            f"{r['concurrency']:>4} {r['request_throughput_per_sec']:>8.2f} "
+            f"{r['output_token_throughput_per_sec']:>9.1f} "
+            f"{r['time_to_first_token']['p50_ms']:>8.1f}m "
+            f"{r['time_to_first_token']['p99_ms']:>8.1f}m "
+            f"{r['inter_token_latency']['p50_ms']:>7.1f}m "
+            f"{r['inter_token_latency']['p99_ms']:>7.1f}m "
+            f"{r['errors']:>4}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

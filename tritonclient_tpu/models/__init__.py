@@ -5,6 +5,8 @@
   repeat).
 - ``resnet`` / ``bert``: the benchmark models (BASELINE.md targets), built
   TPU-first in Flax with mesh-sharded variants in tritonclient_tpu.parallel.
+- ``gpt``: causal decoder with KV-cache generation served as a decoupled
+  token stream — the genai-perf target (tritonclient_tpu.genai_perf).
 """
 
 from tritonclient_tpu.models._base import Model, TensorSpec  # noqa: F401

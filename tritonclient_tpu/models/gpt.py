@@ -1,0 +1,375 @@
+"""GPT-style causal decoder with KV-cache generation: the LLM serving path.
+
+The reference ecosystem's LLM instrument (genai-perf, relocated out of the
+snapshot — reference src/c++/perf_analyzer/genai-perf/README.md) measures
+time-to-first-token and inter-token latency against a server streaming one
+response per generated token. This model is that server side, TPU-first:
+
+  * pre-LN decoder, layers stacked and scanned (`lax.scan`) so XLA compiles
+    ONE layer body regardless of depth;
+  * prefill = full-sequence causal attention (flash kernel optional) that
+    also writes the KV cache in one pass;
+  * decode = jit-compiled single-token step with donated cache buffers
+    (in-place dynamic_update_slice, no reallocation per token) and a
+    length-masked attention over the static-shape cache — static shapes
+    and donation are what keep XLA from recompiling or copying per token;
+  * generation comes in two forms: `generate_tokens` (a Python loop
+    yielding one token at a time — the decoupled streaming server path)
+    and `generate_scan` (one jit of the whole loop via lax.scan — the
+    throughput/bench path and the cross-check for the cache math).
+
+Weights are randomly initialized (like BertBaseModel): the serving/bench
+surface measures transport + compute, not checkpoint quality.
+"""
+
+import functools
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tritonclient_tpu.models._base import Model, TensorSpec
+from tritonclient_tpu.models.bert import _layer_norm
+from tritonclient_tpu.ops.attention import dot_product_attention
+
+
+@dataclass(frozen=True)
+class GptConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 8
+    n_heads: int = 8
+    d_ff: int = 2048
+    max_len: int = 512
+    layer_norm_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def gpt_small() -> GptConfig:
+    return GptConfig()
+
+
+def gpt_tiny(max_len: int = 64) -> GptConfig:
+    """Small config for tests and CPU runs."""
+    return GptConfig(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+        max_len=max_len, dtype=jnp.float32,
+    )
+
+
+def init_params(key: jax.Array, cfg: GptConfig) -> Dict:
+    d, f, n = cfg.d_model, cfg.d_ff, cfg.n_layers
+    keys = iter(jax.random.split(key, 8))
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32)
+                / np.sqrt(fan_in)).astype(cfg.dtype)
+
+    return {
+        "embed": {
+            "tok": dense(next(keys), (cfg.vocab_size, d), d),
+            "pos": dense(next(keys), (cfg.max_len, d), d),
+        },
+        "layers": {
+            "wqkv": dense(next(keys), (n, d, 3 * d), d),
+            "bqkv": jnp.zeros((n, 3 * d), cfg.dtype),
+            "wo": dense(next(keys), (n, d, d), d),
+            "bo": jnp.zeros((n, d), cfg.dtype),
+            "ln1_scale": jnp.ones((n, d), cfg.dtype),
+            "ln1_bias": jnp.zeros((n, d), cfg.dtype),
+            "w_in": dense(next(keys), (n, d, f), d),
+            "b_in": jnp.zeros((n, f), cfg.dtype),
+            "w_out": dense(next(keys), (n, f, d), f),
+            "b_out": jnp.zeros((n, d), cfg.dtype),
+            "ln2_scale": jnp.ones((n, d), cfg.dtype),
+            "ln2_bias": jnp.zeros((n, d), cfg.dtype),
+        },
+        "final_ln": {
+            "scale": jnp.ones((d,), cfg.dtype),
+            "bias": jnp.zeros((d,), cfg.dtype),
+        },
+    }
+
+
+# Same Megatron TP layout as BERT (models/bert.py PARTITION_RULES): qkv and
+# ffn-in column-sharded, proj and ffn-out row-sharded; GSPMD inserts the
+# all-reduces.
+PARTITION_RULES = (
+    (r"layers/wqkv", P(None, "fsdp", "tp")),
+    (r"layers/bqkv", P(None, "tp")),
+    (r"layers/wo", P(None, "tp", "fsdp")),
+    (r"layers/w_in", P(None, "fsdp", "tp")),
+    (r"layers/b_in", P(None, "tp")),
+    (r"layers/w_out", P(None, "tp", "fsdp")),
+    (r"embed/(tok|pos)", P(None, None)),
+)
+
+
+# --------------------------------------------------------------------------- #
+# forward / prefill                                                           #
+# --------------------------------------------------------------------------- #
+
+
+def forward(
+    params: Dict,
+    tokens: jax.Array,
+    cfg: GptConfig,
+    *,
+    attention_fn: Optional[Callable] = None,
+) -> jax.Array:
+    """tokens [B, L] int32 → logits [B, L, vocab] (no cache)."""
+    atn = attention_fn or functools.partial(
+        dot_product_attention, causal=True
+    )
+    b, l = tokens.shape
+    x = params["embed"]["tok"][tokens] + params["embed"]["pos"][:l][None]
+
+    def layer(h, lp):
+        a = _layer_norm(h, lp["ln1_scale"], lp["ln1_bias"],
+                        cfg.layer_norm_eps)
+        qkv = a @ lp["wqkv"] + lp["bqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (b, l, cfg.n_heads, cfg.head_dim)
+        out = atn(q.reshape(shape), k.reshape(shape), v.reshape(shape))
+        h = h + (out.reshape(b, l, cfg.d_model) @ lp["wo"] + lp["bo"])
+        m = _layer_norm(h, lp["ln2_scale"], lp["ln2_bias"],
+                        cfg.layer_norm_eps)
+        h = h + (jax.nn.gelu(m @ lp["w_in"] + lp["b_in"]) @ lp["w_out"]
+                 + lp["b_out"])
+        return h, None
+
+    x, _ = lax.scan(layer, x, params["layers"])
+    x = _layer_norm(x, params["final_ln"]["scale"], params["final_ln"]["bias"],
+                    cfg.layer_norm_eps)
+    return (x.astype(jnp.float32)
+            @ params["embed"]["tok"].astype(jnp.float32).T)
+
+
+def init_cache(cfg: GptConfig, batch: int) -> Tuple[jax.Array, jax.Array]:
+    """(k, v) caches, each [n_layers, B, max_len, H, head_dim]."""
+    shape = (cfg.n_layers, batch, cfg.max_len, cfg.n_heads, cfg.head_dim)
+    return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+
+
+def prefill(params: Dict, tokens: jax.Array, cfg: GptConfig):
+    """Full causal pass over the prompt, filling the KV cache.
+
+    tokens [B, L] → (logits_last [B, vocab], (k_cache, v_cache)).
+    """
+    b, l = tokens.shape
+    x = params["embed"]["tok"][tokens] + params["embed"]["pos"][:l][None]
+
+    def layer(h, lp):
+        a = _layer_norm(h, lp["ln1_scale"], lp["ln1_bias"],
+                        cfg.layer_norm_eps)
+        qkv = a @ lp["wqkv"] + lp["bqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (b, l, cfg.n_heads, cfg.head_dim)
+        q, k, v = (t.reshape(shape) for t in (q, k, v))
+        out = dot_product_attention(q, k, v, causal=True)
+        h = h + (out.reshape(b, l, cfg.d_model) @ lp["wo"] + lp["bo"])
+        m = _layer_norm(h, lp["ln2_scale"], lp["ln2_bias"],
+                        cfg.layer_norm_eps)
+        h = h + (jax.nn.gelu(m @ lp["w_in"] + lp["b_in"]) @ lp["w_out"]
+                 + lp["b_out"])
+        return h, (k, v)
+
+    x, (ks, vs) = lax.scan(layer, x, params["layers"])
+    x = _layer_norm(x, params["final_ln"]["scale"], params["final_ln"]["bias"],
+                    cfg.layer_norm_eps)
+    logits = (x[:, -1].astype(jnp.float32)
+              @ params["embed"]["tok"].astype(jnp.float32).T)
+    k_cache, v_cache = init_cache(cfg, b)
+    # ks/vs: [n_layers, B, L, H, Dh] — place the prompt at positions [0, L).
+    k_cache = lax.dynamic_update_slice(k_cache, ks.astype(cfg.dtype),
+                                       (0, 0, 0, 0, 0))
+    v_cache = lax.dynamic_update_slice(v_cache, vs.astype(cfg.dtype),
+                                       (0, 0, 0, 0, 0))
+    return logits, (k_cache, v_cache)
+
+
+def decode_step(params: Dict, k_cache, v_cache, token: jax.Array,
+                pos: jax.Array, cfg: GptConfig):
+    """One generation step against the cache.
+
+    token [B] int32, pos scalar int32 (the position this token occupies) →
+    (logits [B, vocab], k_cache, v_cache). Cache buffers should be donated
+    by the jit wrapper so the update is in-place on device.
+    """
+    b = token.shape[0]
+    x = (params["embed"]["tok"][token]
+         + params["embed"]["pos"][pos][None])          # [B, d]
+
+    def layer(h, xs):
+        lp, kc, vc = xs                                 # kc/vc: [B, max_len, H, Dh]
+        a = _layer_norm(h, lp["ln1_scale"], lp["ln1_bias"],
+                        cfg.layer_norm_eps)
+        qkv = a @ lp["wqkv"] + lp["bqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (b, 1, cfg.n_heads, cfg.head_dim)
+        q = q.reshape(shape)
+        kc = lax.dynamic_update_slice(
+            kc, k.reshape(shape).astype(kc.dtype), (0, pos, 0, 0)
+        )
+        vc = lax.dynamic_update_slice(
+            vc, v.reshape(shape).astype(vc.dtype), (0, pos, 0, 0)
+        )
+        # Length-masked attention over the static cache: positions beyond
+        # `pos` contribute nothing. [B, H, 1, max_len] scores — decode is
+        # bandwidth-bound on the cache read, which is the MXU-free regime
+        # where a flash kernel buys nothing.
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk",
+            q.astype(jnp.float32) / np.sqrt(cfg.head_dim),
+            kc.astype(jnp.float32),
+        )
+        keep = (jnp.arange(cfg.max_len) <= pos)[None, None, None, :]
+        s = jnp.where(keep, s, jnp.finfo(jnp.float32).min)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, vc.astype(jnp.float32))
+        out = out.reshape(b, cfg.d_model).astype(h.dtype)
+        h = h + (out @ lp["wo"] + lp["bo"])
+        m = _layer_norm(h, lp["ln2_scale"], lp["ln2_bias"],
+                        cfg.layer_norm_eps)
+        h = h + (jax.nn.gelu(m @ lp["w_in"] + lp["b_in"]) @ lp["w_out"]
+                 + lp["b_out"])
+        return h, (kc, vc)
+
+    x, (k_cache, v_cache) = lax.scan(
+        layer, x, (params["layers"], k_cache, v_cache)
+    )
+    x = _layer_norm(x, params["final_ln"]["scale"], params["final_ln"]["bias"],
+                    cfg.layer_norm_eps)
+    logits = (x.astype(jnp.float32)
+              @ params["embed"]["tok"].astype(jnp.float32).T)
+    return logits, k_cache, v_cache
+
+
+def make_decode_fn(cfg: GptConfig):
+    """Jit-compiled decode step with donated caches."""
+    step = functools.partial(decode_step, cfg=cfg)
+    return jax.jit(step, donate_argnums=(1, 2))
+
+
+def generate_tokens(
+    params: Dict,
+    prompt: np.ndarray,
+    max_new: int,
+    cfg: GptConfig,
+    *,
+    prefill_fn=None,
+    decode_fn=None,
+) -> Iterator[np.ndarray]:
+    """Greedy generation, one token per yield — the streaming server path.
+
+    Each yield materializes one [B] int32 token on the host (that token is
+    about to go out on the wire anyway); the next step's dispatch overlaps
+    the consumer's handling of the previous token.
+    """
+    prefill_fn = prefill_fn or jax.jit(
+        functools.partial(prefill, cfg=cfg)
+    )
+    decode_fn = decode_fn or make_decode_fn(cfg)
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, l = prompt.shape
+    max_new = min(max_new, cfg.max_len - l)
+    logits, (k_cache, v_cache) = prefill_fn(params, prompt)
+    token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    for i in range(max_new):
+        out = np.asarray(token)
+        yield out
+        if i + 1 == max_new:
+            break
+        logits, k_cache, v_cache = decode_fn(
+            params, k_cache, v_cache, token, jnp.int32(l + i)
+        )
+        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def generate_scan(params: Dict, prompt: jax.Array, max_new: int,
+                  cfg: GptConfig) -> jax.Array:
+    """Whole greedy loop as one jit (lax.scan) → tokens [B, max_new].
+
+    The throughput path, and the reference the streaming path is tested
+    against (identical tokens ⇒ the cache math is right).
+    """
+    b, l = prompt.shape
+    logits, (k_cache, v_cache) = prefill(params, prompt, cfg)
+    token0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def step(carry, i):
+        token, kc, vc = carry
+        logits, kc, vc = decode_step(params, kc, vc, token, l + i, cfg)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (nxt, kc, vc), token
+
+    (_, _, _), toks = lax.scan(
+        step, (token0, k_cache, v_cache), jnp.arange(max_new)
+    )
+    return jnp.transpose(toks, (1, 0))  # [B, max_new]
+
+
+# --------------------------------------------------------------------------- #
+# serving model                                                               #
+# --------------------------------------------------------------------------- #
+
+
+class GptModel(Model):
+    """Decoupled LLM serving: one streamed response per generated token.
+
+    Inputs: INPUT_IDS [B, L] int32 prompt; MAX_TOKENS [1] int32 (optional,
+    default 16). Each response carries OUTPUT_IDS [B] — the next greedy
+    token for every batch row — so a genai-perf-style client measures
+    time-to-first-token on response 1 and inter-token latency on the gaps.
+    """
+
+    name = "gpt"
+    platform = "jax"
+    decoupled = True
+    # The generation loop issues many device round-trips; keep it off the
+    # aio event loop.
+    blocking = True
+
+    def __init__(self, cfg: Optional[GptConfig] = None, seed: int = 0):
+        super().__init__()
+        self.cfg = cfg or gpt_small()
+        self.inputs = [
+            TensorSpec("INPUT_IDS", "INT32", [-1, -1]),
+            TensorSpec("MAX_TOKENS", "INT32", [1], optional=True),
+        ]
+        self.outputs = [TensorSpec("OUTPUT_IDS", "INT32", [-1])]
+        self._params = init_params(jax.random.PRNGKey(seed), self.cfg)
+        self._prefill = jax.jit(functools.partial(prefill, cfg=self.cfg))
+        self._decode = make_decode_fn(self.cfg)
+
+    def infer(self, inputs, parameters=None) -> Iterator[dict]:
+        prompt = np.asarray(inputs["INPUT_IDS"], dtype=np.int32)
+        if prompt.ndim != 2:
+            prompt = prompt.reshape(1, -1)
+        max_new = 16
+        if "MAX_TOKENS" in inputs:
+            max_new = int(np.asarray(inputs["MAX_TOKENS"]).flatten()[0])
+        max_new = max(1, min(max_new, self.cfg.max_len - prompt.shape[1]))
+
+        def gen():
+            for token in generate_tokens(
+                self._params, prompt, max_new, self.cfg,
+                prefill_fn=self._prefill, decode_fn=self._decode,
+            ):
+                yield {"OUTPUT_IDS": token}
+
+        return gen()
+
+    def warmup(self):
+        list(generate_tokens(
+            self._params, np.zeros((1, 8), np.int32), 2, self.cfg,
+            prefill_fn=self._prefill, decode_fn=self._decode,
+        ))

@@ -488,28 +488,34 @@ class _DynamicBatcher:
     (the reference repo is client-only; its servers batch the same way).
     """
 
-    def __init__(self, core, max_batch: int):
+    def __init__(self, core):
         self.core = core
-        self.max_batch = max_batch
         self._cv = threading.Condition()
         self._queue: List[_BatchSlot] = []
         self._busy = False
 
-    def eligible(self, request: CoreRequest) -> bool:
-        # Sequence/priority parameters, BYTES tensors, rank-0 inputs, and
-        # single requests already exceeding the model's batch dimension
-        # bypass batching (dim 0 must be a free batch axis the model
-        # promised to handle up to max_batch rows of).
-        if request.parameters or not request.inputs:
+    def eligible(self, request: CoreRequest, cap: int) -> bool:
+        # Sequence/priority parameters, BYTES tensors, rank-0 or empty
+        # inputs, inconsistent per-input batch dims, and single requests
+        # already exceeding the model's batch dimension bypass batching
+        # (dim 0 must be one consistent free batch axis the model promised
+        # to handle up to `cap` rows of).
+        if cap <= 0 or request.parameters or not request.inputs:
             return False
+        rows = None
         for t in request.inputs:
             if t.datatype == "BYTES" or not t.shape:
                 return False
-        if int(request.inputs[0].shape[0]) > self.max_batch:
+            if rows is None:
+                rows = int(t.shape[0])
+            elif int(t.shape[0]) != rows:
+                return False
+        if rows < 1 or rows > cap:
             return False
         return True
 
-    def infer(self, model, request: CoreRequest, stats) -> CoreResponse:
+    def infer(self, model, request: CoreRequest, stats,
+              cap: int) -> CoreResponse:
         signature = tuple(
             (t.name, t.datatype, tuple(t.shape[1:])) for t in request.inputs
         )
@@ -526,11 +532,16 @@ class _DynamicBatcher:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         # Re-checked under the lock: a promotion or a
-                        # completed batch racing the timeout wins.
+                        # completed batch racing the timeout wins. A slot
+                        # no longer in the queue was captured into an
+                        # in-flight batch — it WILL complete; keep
+                        # waiting rather than answering 500 for work that
+                        # is executing.
                         try:
                             self._queue.remove(slot)
                         except ValueError:
-                            pass
+                            deadline = time.monotonic() + 60.0
+                            continue
                         raise CoreError(
                             f"dynamic batch wait timed out for model "
                             f"'{model.name}'",
@@ -551,10 +562,7 @@ class _DynamicBatcher:
                 rows = slot.rows
                 rest = []
                 for s in self._queue:
-                    if (
-                        rows + s.rows <= self.max_batch
-                        and s.signature == signature
-                    ):
+                    if rows + s.rows <= cap and s.signature == signature:
                         batch.append(s)
                         rows += s.rows
                     else:
@@ -632,9 +640,7 @@ class InferenceCore:
             and getattr(model, "dynamic_batching", False)
             and not model.decoupled
         ):
-            self._batchers[model.name] = _DynamicBatcher(
-                self, getattr(model, "max_batch_size", 0) or 64
-            )
+            self._batchers[model.name] = _DynamicBatcher(self)
 
     def _get_model(self, name: str, version: str = ""):
         model = self._repository.get(name)
@@ -831,14 +837,27 @@ class InferenceCore:
 
     # -- inference -----------------------------------------------------------
 
+    @staticmethod
+    def _effective_max_batch(model) -> int:
+        """The batch-dimension contract currently in force for `model`:
+        a live config override wins over the declared class attribute."""
+        override = getattr(model, "_config_override", None) or {}
+        return int(override.get("max_batch_size",
+                                getattr(model, "max_batch_size", 0)))
+
     def infer(
         self, request: CoreRequest
     ) -> Union[CoreResponse, Iterator[CoreResponse]]:
         model = self._get_model(request.model_name, request.model_version)
         stats = self._stats[request.model_name]
         batcher = self._batchers.get(request.model_name)
-        if batcher is not None and batcher.eligible(request):
-            return batcher.infer(model, request, stats)
+        # dynamic_batching re-checked on the CURRENT model: a file-override
+        # load shadows the opted-in model under the same name, and the
+        # effective cap follows live config overrides.
+        if batcher is not None and getattr(model, "dynamic_batching", False):
+            cap = self._effective_max_batch(model)
+            if batcher.eligible(request, cap):
+                return batcher.infer(model, request, stats, cap)
         return self._infer_one(model, request, stats)
 
     def _infer_one(self, model, request: CoreRequest, stats) -> CoreResponse:

@@ -330,13 +330,11 @@ class TpuShardedMemoryRegion(TpuSharedMemoryRegion):
                     return parked
                 # A dtype/shape reinterpretation cannot stay sharded in
                 # general; gather through the host mirror below instead.
-        if prefer_host:
-            return np.frombuffer(
-                self.read_bytes(offset, nbytes), dtype=np_dtype
-            ).reshape(shape)
         host = np.frombuffer(
             self.read_bytes(offset, nbytes), dtype=np_dtype
         ).reshape(shape)
+        if prefer_host:
+            return host
         arr = jax.device_put(host, self.sharding)
         with self._lock:
             self._drop_overlapping(offset, nbytes)
