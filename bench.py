@@ -36,6 +36,14 @@ import time
 
 import numpy as np
 
+# The natural dynamic batcher pays off when the server is compute- or
+# GIL-saturated (real co-located serving); through the axon tunnel the
+# system is d2h-latency-bound, batches barely form (measured avg ~1.6),
+# and each new power-of-two bucket shape costs a multi-second XLA compile
+# inside a measured window. Bench the non-batched path; the batcher has
+# its own tests (tests/test_server.py TestDynamicBatching).
+os.environ.setdefault("TPU_SERVER_DYNAMIC_BATCH", "0")
+
 # Both measured paths run tens of threads in one interpreter; CPython's
 # default 5 ms GIL switch interval starves whichever thread must dispatch
 # next (measured: server-side jit dispatch wall 3.6 ms -> 0.37 ms at

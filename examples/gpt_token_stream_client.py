@@ -1,0 +1,86 @@
+#!/usr/bin/env python3
+"""LLM token streaming over decoupled gRPC: the genai-perf target flow.
+
+Sends a prompt to the `gpt` model (models/gpt.py — KV-cache greedy
+generation, one streamed response per token) and reads the token stream,
+timing time-to-first-token and inter-token gaps the way
+tritonclient_tpu.genai_perf does at scale. No reference counterpart:
+the reference's example matrix predates its genai-perf instrument; this
+example is the decoupled-family pattern (simple_grpc_custom_repeat.py)
+applied to generation.
+"""
+
+import queue
+import sys
+import time
+
+import numpy as np
+
+from _fixture import example_parser, maybe_fixture_server
+
+from tritonclient_tpu.grpc import InferenceServerClient, InferInput
+
+
+def main():
+    parser = example_parser(__doc__)
+    parser.add_argument("--max-tokens", type=int, default=8)
+    args = parser.parse_args()
+
+    models = None
+    if args.fixture:
+        from tritonclient_tpu.models import gpt
+
+        model = gpt.GptModel(cfg=gpt.gpt_tiny(max_len=64))
+        model.warmup()
+        models = [model]
+
+    with maybe_fixture_server(args, models=models) as url:
+        with InferenceServerClient(url) as client:
+            responses: "queue.Queue" = queue.Queue()
+            client.start_stream(
+                callback=lambda result, error: responses.put(
+                    (time.perf_counter(), result, error)
+                )
+            )
+            prompt = np.array([[3, 1, 4, 1, 5, 9, 2, 6]], np.int32)
+            inp = InferInput("INPUT_IDS", list(prompt.shape), "INT32")
+            inp.set_data_from_numpy(prompt)
+            mt = InferInput("MAX_TOKENS", [1], "INT32")
+            mt.set_data_from_numpy(np.array([args.max_tokens], np.int32))
+            t_send = time.perf_counter()
+            client.async_stream_infer(
+                "gpt", [inp, mt], enable_empty_final_response=True
+            )
+            tokens, t_first, t_prev, gaps = [], None, None, []
+            while True:
+                t_recv, result, error = responses.get(timeout=120)
+                if error is not None:
+                    print(f"error: {error}")
+                    sys.exit(1)
+                response = result.get_response()
+                p = response.parameters.get("triton_final_response")
+                final = bool(p and p.bool_param)
+                out = result.as_numpy("OUTPUT_IDS")
+                if out is not None and out.size:
+                    tokens.append(int(out[0]))
+                    if t_first is None:
+                        t_first = t_recv
+                    else:
+                        gaps.append(t_recv - t_prev)
+                    t_prev = t_recv
+                if final:
+                    break
+            client.stop_stream()
+            if len(tokens) != args.max_tokens:
+                print(f"error: got {len(tokens)} tokens, "
+                      f"wanted {args.max_tokens}")
+                sys.exit(1)
+            ttft_ms = (t_first - t_send) * 1e3
+            itl_ms = (sum(gaps) / len(gaps) * 1e3) if gaps else 0.0
+            print(f"tokens: {tokens}")
+            print(f"PASS: streamed {len(tokens)} tokens "
+                  f"(ttft {ttft_ms:.1f} ms, mean itl {itl_ms:.2f} ms)")
+
+
+if __name__ == "__main__":
+    main()

@@ -134,3 +134,66 @@ def test_genai_perf_cli(gpt_server):
     assert doc["model"] == "gpt"
     assert doc["results"][0]["errors"] == 0
     assert doc["results"][0]["output_tokens"] > 0
+
+
+def test_gpt_flash_prefill_matches_reference():
+    # Flash-prefill GPT must stream identical tokens to the reference-
+    # attention model on the same weights (L=128 prompt: real kernel path
+    # in interpret mode, not the fallback).
+    cfg = gpt.gpt_tiny(max_len=192)
+    plain = gpt.GptModel(cfg=cfg, seed=3)
+    flash = gpt.GptModel(cfg=cfg, seed=3, use_flash_attention=True)
+    prompt = (np.arange(2 * 128, dtype=np.int32).reshape(2, 128)
+              % cfg.vocab_size)
+    out_plain = [t.copy() for t in gpt.generate_tokens(
+        plain._params, prompt, 4, cfg,
+        prefill_fn=plain._prefill, decode_fn=plain._decode)]
+    out_flash = [t.copy() for t in gpt.generate_tokens(
+        flash._params, prompt, 4, cfg,
+        prefill_fn=flash._prefill, decode_fn=flash._decode)]
+    np.testing.assert_array_equal(np.stack(out_plain), np.stack(out_flash))
+
+
+def test_gpt_overlong_prompt_fails_cleanly(gpt_server):
+    """A full-length prompt must produce a per-request error response, not
+    tear down the stream (round-3 review findings)."""
+    import queue
+
+    import tritonclient_tpu.grpc as grpcclient
+
+    client = grpcclient.InferenceServerClient(gpt_server.grpc_address)
+    try:
+        results: "queue.Queue" = queue.Queue()
+        client.start_stream(
+            callback=lambda result, error: results.put((result, error))
+        )
+        bad = np.zeros((1, 64), np.int32)  # == max_len of the fixture model
+        inp = grpcclient.InferInput("INPUT_IDS", [1, 64], "INT32")
+        inp.set_data_from_numpy(bad)
+        client.async_stream_infer("gpt", [inp])
+        result, error = results.get(timeout=60)
+        assert error is not None and "max_len" in str(error)
+        # The STREAM survives: a well-formed request right after succeeds.
+        good = np.array([[1, 2, 3, 4]], np.int32)
+        inp2 = grpcclient.InferInput("INPUT_IDS", [1, 4], "INT32")
+        inp2.set_data_from_numpy(good)
+        mt = grpcclient.InferInput("MAX_TOKENS", [1], "INT32")
+        mt.set_data_from_numpy(np.array([2], np.int32))
+        client.async_stream_infer(
+            "gpt", [inp2, mt], enable_empty_final_response=True
+        )
+        tokens = 0
+        while True:
+            result, error = results.get(timeout=60)
+            assert error is None, error
+            response = result.get_response()
+            p = response.parameters.get("triton_final_response")
+            out = result.as_numpy("OUTPUT_IDS")
+            if out is not None and out.size:
+                tokens += 1
+            if p and p.bool_param:
+                break
+        assert tokens == 2
+        client.stop_stream()
+    finally:
+        client.close()

@@ -69,6 +69,14 @@ class _Worker:
         )
         self._InferInput = InferInput
 
+    def _reset_stream(self):
+        """After an error/timeout the failed request's remaining responses
+        may still be in flight; a fresh stream + queue is the only way to
+        keep later samples attributable (one request in flight per worker,
+        so nothing else is lost)."""
+        self.teardown()
+        self.setup()
+
     def run(self, end_time: float):
         a = self.a
         i = 0
@@ -91,6 +99,7 @@ class _Worker:
                 )
             except Exception:
                 self.errors += 1
+                self._reset_stream()
                 continue
             n_tokens = 0
             t_prev = None
@@ -118,6 +127,7 @@ class _Worker:
                     break
             if failed:
                 self.errors += 1
+                self._reset_stream()
                 continue
             self.latency_ns.append(time.perf_counter_ns() - t_send)
             self.tokens += n_tokens
@@ -168,7 +178,6 @@ class GenAIPerf:
                 threading.Thread(target=w.run, args=(end,), daemon=True)
                 for w in workers
             ]
-            t0 = time.perf_counter()
             for t in threads:
                 t.start()
             time.sleep(self.warmup_s)
@@ -183,7 +192,6 @@ class GenAIPerf:
             for t in threads:
                 t.join()
             duration = time.perf_counter() - window_start
-            del t0
         finally:
             for w in workers:
                 w.teardown()

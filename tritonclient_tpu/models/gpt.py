@@ -118,6 +118,34 @@ PARTITION_RULES = (
 # --------------------------------------------------------------------------- #
 
 
+def _layer_fn(h, lp, cfg: GptConfig, atn: Callable):
+    """One pre-LN decoder layer; returns (h, (k, v)) for cache writers."""
+    b, l = h.shape[0], h.shape[1]
+    a = _layer_norm(h, lp["ln1_scale"], lp["ln1_bias"], cfg.layer_norm_eps)
+    qkv = a @ lp["wqkv"] + lp["bqkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    shape = (b, l, cfg.n_heads, cfg.head_dim)
+    q, k, v = (t.reshape(shape) for t in (q, k, v))
+    out = atn(q, k, v)
+    h = h + (out.reshape(b, l, cfg.d_model) @ lp["wo"] + lp["bo"])
+    m = _layer_norm(h, lp["ln2_scale"], lp["ln2_bias"], cfg.layer_norm_eps)
+    h = h + (jax.nn.gelu(m @ lp["w_in"] + lp["b_in"]) @ lp["w_out"]
+             + lp["b_out"])
+    return h, (k, v)
+
+
+def _embed(params: Dict, tokens: jax.Array) -> jax.Array:
+    l = tokens.shape[1]
+    return params["embed"]["tok"][tokens] + params["embed"]["pos"][:l][None]
+
+
+def _head(params: Dict, x: jax.Array, cfg: GptConfig) -> jax.Array:
+    x = _layer_norm(x, params["final_ln"]["scale"], params["final_ln"]["bias"],
+                    cfg.layer_norm_eps)
+    return (x.astype(jnp.float32)
+            @ params["embed"]["tok"].astype(jnp.float32).T)
+
+
 def forward(
     params: Dict,
     tokens: jax.Array,
@@ -129,28 +157,11 @@ def forward(
     atn = attention_fn or functools.partial(
         dot_product_attention, causal=True
     )
-    b, l = tokens.shape
-    x = params["embed"]["tok"][tokens] + params["embed"]["pos"][:l][None]
-
-    def layer(h, lp):
-        a = _layer_norm(h, lp["ln1_scale"], lp["ln1_bias"],
-                        cfg.layer_norm_eps)
-        qkv = a @ lp["wqkv"] + lp["bqkv"]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        shape = (b, l, cfg.n_heads, cfg.head_dim)
-        out = atn(q.reshape(shape), k.reshape(shape), v.reshape(shape))
-        h = h + (out.reshape(b, l, cfg.d_model) @ lp["wo"] + lp["bo"])
-        m = _layer_norm(h, lp["ln2_scale"], lp["ln2_bias"],
-                        cfg.layer_norm_eps)
-        h = h + (jax.nn.gelu(m @ lp["w_in"] + lp["b_in"]) @ lp["w_out"]
-                 + lp["b_out"])
-        return h, None
-
-    x, _ = lax.scan(layer, x, params["layers"])
-    x = _layer_norm(x, params["final_ln"]["scale"], params["final_ln"]["bias"],
-                    cfg.layer_norm_eps)
-    return (x.astype(jnp.float32)
-            @ params["embed"]["tok"].astype(jnp.float32).T)
+    x, _ = lax.scan(
+        lambda h, lp: (_layer_fn(h, lp, cfg, atn)[0], None),
+        _embed(params, tokens), params["layers"],
+    )
+    return _head(params, x, cfg)
 
 
 def init_cache(cfg: GptConfig, batch: int) -> Tuple[jax.Array, jax.Array]:
@@ -159,34 +170,24 @@ def init_cache(cfg: GptConfig, batch: int) -> Tuple[jax.Array, jax.Array]:
     return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
 
 
-def prefill(params: Dict, tokens: jax.Array, cfg: GptConfig):
+def prefill(params: Dict, tokens: jax.Array, cfg: GptConfig,
+            attention_fn: Optional[Callable] = None):
     """Full causal pass over the prompt, filling the KV cache.
 
     tokens [B, L] → (logits_last [B, vocab], (k_cache, v_cache)).
+    ``attention_fn(q, k, v)`` must be causal; pass a flash_attention
+    closure for long prompts (decode stays the masked-cache einsum —
+    single-query attention is cache-bandwidth-bound, not MXU-bound).
     """
-    b, l = tokens.shape
-    x = params["embed"]["tok"][tokens] + params["embed"]["pos"][:l][None]
-
-    def layer(h, lp):
-        a = _layer_norm(h, lp["ln1_scale"], lp["ln1_bias"],
-                        cfg.layer_norm_eps)
-        qkv = a @ lp["wqkv"] + lp["bqkv"]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        shape = (b, l, cfg.n_heads, cfg.head_dim)
-        q, k, v = (t.reshape(shape) for t in (q, k, v))
-        out = dot_product_attention(q, k, v, causal=True)
-        h = h + (out.reshape(b, l, cfg.d_model) @ lp["wo"] + lp["bo"])
-        m = _layer_norm(h, lp["ln2_scale"], lp["ln2_bias"],
-                        cfg.layer_norm_eps)
-        h = h + (jax.nn.gelu(m @ lp["w_in"] + lp["b_in"]) @ lp["w_out"]
-                 + lp["b_out"])
-        return h, (k, v)
-
-    x, (ks, vs) = lax.scan(layer, x, params["layers"])
-    x = _layer_norm(x, params["final_ln"]["scale"], params["final_ln"]["bias"],
-                    cfg.layer_norm_eps)
-    logits = (x[:, -1].astype(jnp.float32)
-              @ params["embed"]["tok"].astype(jnp.float32).T)
+    atn = attention_fn or functools.partial(
+        dot_product_attention, causal=True
+    )
+    b = tokens.shape[0]
+    x, (ks, vs) = lax.scan(
+        functools.partial(_layer_fn, cfg=cfg, atn=atn),
+        _embed(params, tokens), params["layers"],
+    )
+    logits = _head(params, x[:, -1:], cfg)[:, 0]
     k_cache, v_cache = init_cache(cfg, b)
     # ks/vs: [n_layers, B, L, H, Dh] — place the prompt at positions [0, L).
     k_cache = lax.dynamic_update_slice(k_cache, ks.astype(cfg.dtype),
@@ -246,11 +247,7 @@ def decode_step(params: Dict, k_cache, v_cache, token: jax.Array,
     x, (k_cache, v_cache) = lax.scan(
         layer, x, (params["layers"], k_cache, v_cache)
     )
-    x = _layer_norm(x, params["final_ln"]["scale"], params["final_ln"]["bias"],
-                    cfg.layer_norm_eps)
-    logits = (x.astype(jnp.float32)
-              @ params["embed"]["tok"].astype(jnp.float32).T)
-    return logits, k_cache, v_cache
+    return _head(params, x, cfg), k_cache, v_cache
 
 
 def make_decode_fn(cfg: GptConfig):
@@ -280,6 +277,11 @@ def generate_tokens(
     decode_fn = decode_fn or make_decode_fn(cfg)
     prompt = jnp.asarray(prompt, jnp.int32)
     b, l = prompt.shape
+    if l >= cfg.max_len:
+        raise ValueError(
+            f"prompt length {l} leaves no room to generate within "
+            f"max_len {cfg.max_len}"
+        )
     max_new = min(max_new, cfg.max_len - l)
     logits, (k_cache, v_cache) = prefill_fn(params, prompt)
     token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -338,7 +340,8 @@ class GptModel(Model):
     # aio event loop.
     blocking = True
 
-    def __init__(self, cfg: Optional[GptConfig] = None, seed: int = 0):
+    def __init__(self, cfg: Optional[GptConfig] = None, seed: int = 0,
+                 use_flash_attention: bool = False):
         super().__init__()
         self.cfg = cfg or gpt_small()
         self.inputs = [
@@ -347,13 +350,27 @@ class GptModel(Model):
         ]
         self.outputs = [TensorSpec("OUTPUT_IDS", "INT32", [-1])]
         self._params = init_params(jax.random.PRNGKey(seed), self.cfg)
-        self._prefill = jax.jit(functools.partial(prefill, cfg=self.cfg))
+        attention_fn = None
+        if use_flash_attention:
+            from tritonclient_tpu.ops.flash_attention import flash_attention
+
+            attention_fn = functools.partial(flash_attention, causal=True)
+        self._prefill = jax.jit(functools.partial(
+            prefill, cfg=self.cfg, attention_fn=attention_fn
+        ))
         self._decode = make_decode_fn(self.cfg)
 
     def infer(self, inputs, parameters=None) -> Iterator[dict]:
         prompt = np.asarray(inputs["INPUT_IDS"], dtype=np.int32)
         if prompt.ndim != 2:
             prompt = prompt.reshape(1, -1)
+        # Validated EAGERLY (not inside the lazy generator) so the caller
+        # gets a clean per-request error, not a mid-stream shape blowup.
+        if prompt.shape[1] >= self.cfg.max_len:
+            raise ValueError(
+                f"prompt length {prompt.shape[1]} must be < max_len "
+                f"{self.cfg.max_len} to generate at least one token"
+            )
         max_new = 16
         if "MAX_TOKENS" in inputs:
             max_new = int(np.asarray(inputs["MAX_TOKENS"]).flatten()[0])

@@ -528,20 +528,24 @@ class _DynamicBatcher:
                 slot.leader = True
             else:
                 deadline = time.monotonic() + 60.0
+                extensions = 0
                 while not slot.leader and not slot.done:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         # Re-checked under the lock: a promotion or a
                         # completed batch racing the timeout wins. A slot
                         # no longer in the queue was captured into an
-                        # in-flight batch — it WILL complete; keep
-                        # waiting rather than answering 500 for work that
-                        # is executing.
+                        # in-flight batch — it should complete; extend a
+                        # bounded number of times rather than answering
+                        # 500 for work that is executing, but a wedged
+                        # batch must not hang this thread forever.
                         try:
                             self._queue.remove(slot)
                         except ValueError:
-                            deadline = time.monotonic() + 60.0
-                            continue
+                            if extensions < 4:
+                                extensions += 1
+                                deadline = time.monotonic() + 60.0
+                                continue
                         raise CoreError(
                             f"dynamic batch wait timed out for model "
                             f"'{model.name}'",

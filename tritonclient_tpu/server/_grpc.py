@@ -490,6 +490,11 @@ class _Servicer:
             return _stream_responses(request, cresp, want_final)
         except CoreError as e:
             return [pb.ModelStreamInferResponse(error_message=str(e))]
+        except Exception as e:  # mirror _infer_one's model-error wrapping:
+            # a bug must fail THIS request, not tear down the stream.
+            return [pb.ModelStreamInferResponse(
+                error_message=f"inference failed: {e}"
+            )]
 
     def _needs_serial(self, request) -> bool:
         """Sequence/stateful traffic must EXECUTE in stream order, not just
@@ -561,7 +566,24 @@ class _Servicer:
                 if item is None:
                     break
                 msgs = item.result() if hasattr(item, "result") else item
-                yield from msgs
+                if isinstance(msgs, list):
+                    yield from msgs
+                else:
+                    # Lazy decoupled generator: a CoreError raised mid-
+                    # generation (e.g. a later response's shm region too
+                    # small) fails that request with an error response —
+                    # the stream, and every other in-flight request on it,
+                    # survives.
+                    try:
+                        yield from msgs
+                    except CoreError as e:
+                        yield pb.ModelStreamInferResponse(
+                            error_message=str(e)
+                        )
+                    except Exception as e:
+                        yield pb.ModelStreamInferResponse(
+                            error_message=f"inference failed: {e}"
+                        )
         finally:
             stop.set()
 
