@@ -197,6 +197,41 @@ def prefill(params: Dict, tokens: jax.Array, cfg: GptConfig,
     return logits, (k_cache, v_cache)
 
 
+def _decode_layer(h, lp, kc, vc, cfg: GptConfig, write_kv, mask):
+    """Single-token decoder layer, shared by the per-request decode path
+    (`decode_step`) and the continuous-batching slot bank
+    (models/gpt_engine.py) — one source of truth for the LN/QKV/masked-
+    cache-attention/MLP math, parameterized only by how the new token's
+    K/V enter the cache and how valid positions are masked.
+
+    h [N, d]; kc/vc [N, L, H, Dh]; ``write_kv(kc, vc, k, v)`` inserts the
+    [N, H, Dh] projections; ``mask`` broadcasts against [N, H, L] scores.
+    Decode is bandwidth-bound on the cache read — the MXU-free regime
+    where a flash kernel buys nothing — so a masked einsum is the kernel.
+    """
+    n = h.shape[0]
+    a = _layer_norm(h, lp["ln1_scale"], lp["ln1_bias"], cfg.layer_norm_eps)
+    qkv = a @ lp["wqkv"] + lp["bqkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    hd = (n, cfg.n_heads, cfg.head_dim)
+    q = q.reshape(hd)
+    kc, vc = write_kv(kc, vc, k.reshape(hd), v.reshape(hd))
+    s = jnp.einsum(
+        "nhd,nlhd->nhl",
+        q.astype(jnp.float32) / np.sqrt(cfg.head_dim),
+        kc.astype(jnp.float32),
+    )
+    s = jnp.where(mask, s, jnp.finfo(jnp.float32).min)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("nhl,nlhd->nhd", p, vc.astype(jnp.float32))
+    out = out.reshape(n, cfg.d_model).astype(h.dtype)
+    h = h + (out @ lp["wo"] + lp["bo"])
+    m = _layer_norm(h, lp["ln2_scale"], lp["ln2_bias"], cfg.layer_norm_eps)
+    h = h + (jax.nn.gelu(m @ lp["w_in"] + lp["b_in"]) @ lp["w_out"]
+             + lp["b_out"])
+    return h, (kc, vc)
+
+
 def decode_step(params: Dict, k_cache, v_cache, token: jax.Array,
                 pos: jax.Array, cfg: GptConfig):
     """One generation step against the cache.
@@ -205,44 +240,24 @@ def decode_step(params: Dict, k_cache, v_cache, token: jax.Array,
     (logits [B, vocab], k_cache, v_cache). Cache buffers should be donated
     by the jit wrapper so the update is in-place on device.
     """
-    b = token.shape[0]
     x = (params["embed"]["tok"][token]
          + params["embed"]["pos"][pos][None])          # [B, d]
 
-    def layer(h, xs):
-        lp, kc, vc = xs                                 # kc/vc: [B, max_len, H, Dh]
-        a = _layer_norm(h, lp["ln1_scale"], lp["ln1_bias"],
-                        cfg.layer_norm_eps)
-        qkv = a @ lp["wqkv"] + lp["bqkv"]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        shape = (b, 1, cfg.n_heads, cfg.head_dim)
-        q = q.reshape(shape)
+    def write_kv(kc, vc, k, v):
+        # Same scalar position for every batch row.
         kc = lax.dynamic_update_slice(
-            kc, k.reshape(shape).astype(kc.dtype), (0, pos, 0, 0)
+            kc, k[:, None].astype(kc.dtype), (0, pos, 0, 0)
         )
         vc = lax.dynamic_update_slice(
-            vc, v.reshape(shape).astype(vc.dtype), (0, pos, 0, 0)
+            vc, v[:, None].astype(vc.dtype), (0, pos, 0, 0)
         )
-        # Length-masked attention over the static cache: positions beyond
-        # `pos` contribute nothing. [B, H, 1, max_len] scores — decode is
-        # bandwidth-bound on the cache read, which is the MXU-free regime
-        # where a flash kernel buys nothing.
-        s = jnp.einsum(
-            "bqhd,bkhd->bhqk",
-            q.astype(jnp.float32) / np.sqrt(cfg.head_dim),
-            kc.astype(jnp.float32),
-        )
-        keep = (jnp.arange(cfg.max_len) <= pos)[None, None, None, :]
-        s = jnp.where(keep, s, jnp.finfo(jnp.float32).min)
-        p = jax.nn.softmax(s, axis=-1)
-        out = jnp.einsum("bhqk,bkhd->bqhd", p, vc.astype(jnp.float32))
-        out = out.reshape(b, cfg.d_model).astype(h.dtype)
-        h = h + (out @ lp["wo"] + lp["bo"])
-        m = _layer_norm(h, lp["ln2_scale"], lp["ln2_bias"],
-                        cfg.layer_norm_eps)
-        h = h + (jax.nn.gelu(m @ lp["w_in"] + lp["b_in"]) @ lp["w_out"]
-                 + lp["b_out"])
-        return h, (kc, vc)
+        return kc, vc
+
+    mask = (jnp.arange(cfg.max_len) <= pos)[None, None, :]
+
+    def layer(h, xs):
+        lp, kc, vc = xs
+        return _decode_layer(h, lp, kc, vc, cfg, write_kv, mask)
 
     x, (k_cache, v_cache) = lax.scan(
         layer, x, (params["layers"], k_cache, v_cache)
@@ -362,8 +377,13 @@ class GptModel(Model):
 
     def infer(self, inputs, parameters=None) -> Iterator[dict]:
         prompt = np.asarray(inputs["INPUT_IDS"], dtype=np.int32)
-        if prompt.ndim != 2:
+        if prompt.ndim == 1:
             prompt = prompt.reshape(1, -1)
+        if prompt.ndim != 2:
+            raise ValueError(
+                f"INPUT_IDS must be [B, L] (or [L]); got shape "
+                f"{list(prompt.shape)}"
+            )
         # Validated EAGERLY (not inside the lazy generator) so the caller
         # gets a clean per-request error, not a mid-stream shape blowup.
         if prompt.shape[1] >= self.cfg.max_len:

@@ -1,0 +1,400 @@
+"""Continuous batching for LLM serving: concurrent generations share steps.
+
+`GptModel` runs one generation loop per request; at concurrency N that is
+N separate single-token dispatches per token. This engine runs ONE
+jit-compiled decode step over a fixed bank of S slots — every active
+request advances one token per step, requests join at token boundaries
+(the continuous/in-flight batching scheduler of modern LLM servers) and
+leave when finished, and a freed slot is immediately refilled from the
+admission queue.
+
+TPU-first mechanics:
+  * static shapes everywhere: the slot bank (caches [n_layers, S,
+    max_len, H, Dh], tokens [S], pos [S]) never changes shape, so the
+    step compiles exactly once; inactive slots compute masked garbage —
+    the classic TPU trade of a little wasted FLOP for zero recompiles;
+  * per-slot cache writes are batched scatters (`.at[arange(S), pos]`),
+    per-slot causal masking is `arange(max_len) <= pos[:, None]`;
+  * prompts prefill into their slot through a power-of-two-bucketed
+    padded forward (O(log) compiled prefill shapes), writing K/V straight
+    into the bank with `dynamic_update_slice` at a traced slot index;
+  * caches are donated through both jits — the bank lives in HBM
+    in-place for the server's lifetime;
+  * one host readback per STEP ([S] int32) serves every active stream —
+    token egress cost is amortized across the batch.
+
+Greedy decoding matches `gpt.generate_tokens` token-for-token (tested),
+so continuous batching changes scheduling, never results.
+"""
+
+import functools
+import queue
+import threading
+from typing import Dict, Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tritonclient_tpu.models._base import Model, TensorSpec
+from tritonclient_tpu.models.gpt import (
+    GptConfig,
+    _decode_layer,
+    _embed,
+    _head,
+    _layer_fn,
+    gpt_small,
+    init_params,
+)
+from tritonclient_tpu.ops.attention import dot_product_attention
+
+
+def _slot_cache(cfg: GptConfig, slots: int):
+    shape = (cfg.n_layers, slots, cfg.max_len, cfg.n_heads, cfg.head_dim)
+    return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+
+
+def _decode_step_slots(params: Dict, k_cache, v_cache, tokens, pos,
+                       cfg: GptConfig):
+    """One step for the whole slot bank.
+
+    tokens/pos [S] int32 → (logits [S, vocab], caches). Every slot
+    advances; inactive slots produce garbage the scheduler ignores.
+    """
+    s_count = tokens.shape[0]
+    x = params["embed"]["tok"][tokens] + params["embed"]["pos"][pos]  # [S, d]
+    slot_ids = jnp.arange(s_count)
+    mask = (jnp.arange(cfg.max_len)[None, :] <= pos[:, None])[:, None, :]
+
+    def write_kv(kc, vc, k, v):
+        # Per-slot positions: a batched scatter along the length axis.
+        kc = kc.at[slot_ids, pos].set(k.astype(kc.dtype))
+        vc = vc.at[slot_ids, pos].set(v.astype(vc.dtype))
+        return kc, vc
+
+    def layer(h, xs):
+        lp, kc, vc = xs                       # kc/vc [S, max_len, H, Dh]
+        return _decode_layer(h, lp, kc, vc, cfg, write_kv, mask)
+
+    x, (k_cache, v_cache) = lax.scan(
+        layer, x, (params["layers"], k_cache, v_cache)
+    )
+    return _head(params, x, cfg), k_cache, v_cache
+
+
+def _prefill_into_slot(params: Dict, k_cache, v_cache, padded_prompt,
+                       true_len, slot, cfg: GptConfig):
+    """Causal pass over a padded prompt, K/V written into slot `slot`.
+
+    padded_prompt [1, bucket]; true_len/slot traced scalars. Causality
+    makes rows [0, true_len) independent of the pad tail, and rows beyond
+    the current position stay masked until overwritten by decode steps.
+    Returns (first greedy token [1] int32, caches).
+    """
+    atn = functools.partial(dot_product_attention, causal=True)
+    x, (ks, vs) = lax.scan(
+        functools.partial(_layer_fn, cfg=cfg, atn=atn),
+        _embed(params, padded_prompt), params["layers"],
+    )
+    last = lax.dynamic_slice(
+        x, (0, true_len - 1, 0), (1, 1, cfg.d_model)
+    )
+    logits = _head(params, last, cfg)[:, 0]                    # [1, vocab]
+    # ks/vs: [n_layers, 1, bucket, H, Dh] -> slot rows [0, bucket).
+    k_cache = lax.dynamic_update_slice(
+        k_cache, ks.astype(k_cache.dtype), (0, slot, 0, 0, 0)
+    )
+    v_cache = lax.dynamic_update_slice(
+        v_cache, vs.astype(v_cache.dtype), (0, slot, 0, 0, 0)
+    )
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_cache, v_cache
+
+
+class _Request:
+    __slots__ = ("prompt", "max_new", "out", "remaining")
+
+    def __init__(self, prompt: np.ndarray, max_new: int):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.remaining = max_new
+        self.out: "queue.Queue" = queue.Queue()
+
+
+class GenerationEngine:
+    """The continuous-batching scheduler around the slot bank."""
+
+    def __init__(self, cfg: GptConfig, params: Dict, max_slots: int = 8):
+        self.cfg = cfg
+        self.params = params
+        self.max_slots = max_slots
+        self._k, self._v = _slot_cache(cfg, max_slots)
+        self._tokens = jnp.zeros((max_slots,), jnp.int32)
+        self._pos = jnp.zeros((max_slots,), jnp.int32)
+        self._slot_req: List[Optional[_Request]] = [None] * max_slots
+        self._admit: "queue.Queue" = queue.Queue()
+        self._cv = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = False
+        self._broken: Optional[BaseException] = None
+        self._step = jax.jit(
+            functools.partial(_decode_step_slots, cfg=cfg),
+            donate_argnums=(1, 2),
+        )
+        self._prefill = jax.jit(
+            functools.partial(_prefill_into_slot, cfg=cfg),
+            donate_argnums=(1, 2),
+        )
+        # The daemon loop must not be frozen mid-XLA-call at interpreter
+        # exit (the runtime aborts on an unraisable C++ exception); stop
+        # and join it from atexit. Weakref so the hook never extends the
+        # engine's lifetime.
+        import atexit
+        import weakref
+
+        ref = weakref.ref(self)
+        atexit.register(lambda: (lambda e: e and e.shutdown())(ref()))
+
+    def shutdown(self, timeout: float = 10.0):
+        """Stop the engine loop (in-flight step finishes; queued and
+        active requests receive their terminator)."""
+        self._stopping = True
+        with self._cv:
+            self._cv.notify_all()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=timeout)
+        self._drain_terminated()
+
+    def _drain_terminated(self):
+        """Terminate every queued/active request (no thread will serve
+        them): admission-queue waiters too, not just slot occupants."""
+        while True:
+            try:
+                self._admit.get_nowait().out.put(None)
+            except queue.Empty:
+                break
+        for slot, req in enumerate(self._slot_req):
+            if req is not None:
+                req.out.put(None)
+                self._slot_req[slot] = None
+
+    # -- client side ---------------------------------------------------------
+
+    def submit(self, prompt: np.ndarray, max_new: int) -> "queue.Queue":
+        """Queue a generation; returns the token queue (np [1] per token,
+        then None)."""
+        if prompt.shape[1] >= self.cfg.max_len:
+            raise ValueError(
+                f"prompt length {prompt.shape[1]} must be < max_len "
+                f"{self.cfg.max_len}"
+            )
+        max_new = max(1, min(max_new,
+                             self.cfg.max_len - prompt.shape[1]))
+        req = _Request(prompt.astype(np.int32), max_new)
+        with self._cv:
+            if self._stopping:
+                raise RuntimeError("generation engine is shut down")
+            if self._broken is not None:
+                raise RuntimeError(
+                    f"generation engine failed: {self._broken}"
+                )
+            self._admit.put(req)
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="gpt-engine"
+                )
+                self._thread.start()
+            self._cv.notify_all()
+        return req.out
+
+    # -- engine loop ---------------------------------------------------------
+
+    def _bucket(self, length: int) -> int:
+        b = 8
+        while b < length:
+            b *= 2
+        return min(b, self.cfg.max_len)
+
+    def _admit_into_free_slots(self, deliveries):
+        for slot in range(self.max_slots):
+            if self._slot_req[slot] is not None:
+                continue
+            try:
+                req = self._admit.get_nowait()
+            except queue.Empty:
+                return
+            l = req.prompt.shape[1]
+            bucket = self._bucket(l)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[:, :l] = req.prompt
+            first, self._k, self._v = self._prefill(
+                self.params, self._k, self._v, jnp.asarray(padded),
+                jnp.int32(l), jnp.int32(slot),
+            )
+            try:
+                first.copy_to_host_async()
+            except AttributeError:
+                pass
+            self._slot_req[slot] = req
+            # Device-scalar write — admission never blocks on a readback;
+            # the first token is DELIVERED through the same deferred
+            # distribution pipeline as step tokens (order per request is
+            # preserved: this entry precedes any step including the slot).
+            self._tokens = self._tokens.at[slot].set(first[0])
+            self._pos = self._pos.at[slot].set(l)
+            deliveries.append((first, [(0, slot, req)]))
+
+    def _distribute(self, nxt_dev, pairs):
+        """Deliver one dispatch's tokens (one readback serves them all).
+
+        `pairs` (index-in-array, slot, request) binds each delivery to the
+        request that occupied the slot AT DISPATCH time: with the pipeline
+        a slot can be freed and re-admitted before its last computed token
+        is delivered, and a completed request's surplus step (computed
+        while its final token was still in flight) must be dropped, not
+        delivered to the slot's new occupant.
+        """
+        nxt_np = np.asarray(nxt_dev)
+        for idx, slot, req in pairs:
+            if req.remaining <= 0:
+                continue  # surplus step of an already-finished request
+            req.out.put(nxt_np[idx : idx + 1].copy())
+            req.remaining -= 1
+            if req.remaining == 0:
+                req.out.put(None)
+                if self._slot_req[slot] is req:
+                    self._slot_req[slot] = None
+
+    def _run(self):
+        try:
+            self._run_loop()
+        except BaseException as e:  # noqa: BLE001 — engine must not die silently
+            # The jits donate the cache bank: after a failed dispatch the
+            # engine cannot be restarted against possibly-deleted buffers.
+            # Mark broken (submit() refuses), surface the error to every
+            # waiting consumer (their generators re-raise it), and stop.
+            with self._cv:
+                self._broken = e
+            while True:
+                try:
+                    self._admit.get_nowait().out.put(e)
+                except queue.Empty:
+                    break
+            for slot, req in enumerate(self._slot_req):
+                if req is not None:
+                    req.out.put(e)
+                    self._slot_req[slot] = None
+
+    def _run_loop(self):
+        # One-step software pipeline: step k+1 (and admissions' prefills)
+        # dispatch with DEVICE tokens while earlier readbacks are still in
+        # flight — scheduling depends on token COUNTS, never values, so
+        # delivery may lag compute by one dispatch. Over a high-latency
+        # link the readbacks fully overlap the next step; per-request
+        # token order is preserved because deliveries drain FIFO and an
+        # admission's entry precedes any step that includes its slot.
+        from collections import deque
+
+        deliveries = deque()  # (device array, [(idx, slot, req), ...])
+        while True:
+            if self._stopping:
+                while deliveries:
+                    self._distribute(*deliveries.popleft())
+                self._drain_terminated()
+                return
+            self._admit_into_free_slots(deliveries)
+            active = [s for s, r in enumerate(self._slot_req)
+                      if r is not None]
+            if not active:
+                while deliveries:
+                    self._distribute(*deliveries.popleft())
+                with self._cv:
+                    if self._admit.empty():
+                        got = self._cv.wait(timeout=5.0)
+                        if not got and self._admit.empty():
+                            # Idle: park the engine; submit() restarts it.
+                            self._thread = None
+                            return
+                continue
+            logits, self._k, self._v = self._step(
+                self.params, self._k, self._v, self._tokens, self._pos
+            )
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            try:
+                nxt.copy_to_host_async()
+            except AttributeError:
+                pass
+            self._tokens = nxt
+            self._pos = self._pos + 1
+            deliveries.append(
+                (nxt, [(s, s, self._slot_req[s]) for s in active
+                       if self._slot_req[s] is not None])
+            )
+            # Drain all but the newest dispatch: exactly one readback
+            # stays in flight behind the compute.
+            while len(deliveries) > 1:
+                self._distribute(*deliveries.popleft())
+
+
+class GptEngineModel(Model):
+    """`gpt` served through the continuous-batching engine.
+
+    Same wire contract as GptModel (INPUT_IDS [1, L], optional MAX_TOKENS,
+    one OUTPUT_IDS response per token) — but concurrent requests share
+    batched decode steps instead of running private generation loops.
+    """
+
+    name = "gpt_engine"
+    platform = "jax"
+    decoupled = True
+    blocking = True
+
+    def __init__(self, cfg: Optional[GptConfig] = None, seed: int = 0,
+                 max_slots: int = 8):
+        super().__init__()
+        self.cfg = cfg or gpt_small()
+        self.inputs = [
+            TensorSpec("INPUT_IDS", "INT32", [-1, -1]),
+            TensorSpec("MAX_TOKENS", "INT32", [1], optional=True),
+        ]
+        self.outputs = [TensorSpec("OUTPUT_IDS", "INT32", [-1])]
+        params = init_params(jax.random.PRNGKey(seed), self.cfg)
+        self.engine = GenerationEngine(self.cfg, params,
+                                       max_slots=max_slots)
+
+    def infer(self, inputs, parameters=None) -> Iterator[dict]:
+        prompt = np.asarray(inputs["INPUT_IDS"], dtype=np.int32)
+        if prompt.ndim == 1:
+            prompt = prompt.reshape(1, -1)
+        if prompt.ndim != 2 or prompt.shape[0] != 1:
+            raise ValueError(
+                "gpt_engine serves one [1, L] (or [L]) sequence per "
+                "request (batching happens ACROSS requests in the "
+                f"engine); got shape {list(prompt.shape)}"
+            )
+        if prompt.shape[1] >= self.cfg.max_len:
+            raise ValueError(
+                f"prompt length {prompt.shape[1]} must be < max_len "
+                f"{self.cfg.max_len} to generate at least one token"
+            )
+        max_new = 16
+        if "MAX_TOKENS" in inputs:
+            max_new = int(np.asarray(inputs["MAX_TOKENS"]).flatten()[0])
+        out = self.engine.submit(prompt, max_new)
+
+        def gen():
+            while True:
+                token = out.get(timeout=300)
+                if token is None:
+                    return
+                if isinstance(token, BaseException):
+                    raise token
+                yield {"OUTPUT_IDS": token}
+
+        return gen()
+
+    def warmup(self):
+        q = self.engine.submit(np.zeros((1, 8), np.int32), 2)
+        while q.get(timeout=300) is not None:
+            pass
