@@ -271,6 +271,42 @@ def make_decode_fn(cfg: GptConfig):
     return jax.jit(step, donate_argnums=(1, 2))
 
 
+def sample_token(logits: jax.Array, key: jax.Array, temperature,
+                 top_k) -> jax.Array:
+    """logits [B, vocab] → token [B] int32.
+
+    temperature <= 0 means greedy (exact argmax); top_k <= 0 disables the
+    top-k filter. Both thresholds are traced values, so one compiled
+    sampler serves every request's settings (the top-k cutoff is a
+    dynamic gather into the sorted logits, not a static-k lax.top_k).
+    """
+    greedy = jnp.argmax(logits, axis=-1)
+    t = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
+    scaled = logits.astype(jnp.float32) / t
+    vocab = logits.shape[-1]
+    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+    k_idx = jnp.clip(jnp.asarray(top_k, jnp.int32) - 1, 0, vocab - 1)
+    kth = jnp.where(top_k > 0, sorted_desc[..., k_idx], -jnp.inf)
+    masked = jnp.where(scaled >= kth[..., None], scaled, -jnp.inf)
+    sampled = jax.random.categorical(key, masked, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+
+
+def sampling_key(seed, step) -> jax.Array:
+    """The key schedule shared by every generation path: token index
+    ``step`` (0 = the prefill-derived token) of a request seeded ``seed``
+    always samples with the same key, so the single-request loop, the
+    one-jit scan, and the continuous-batching engine produce identical
+    sampled streams for the same (seed, prompt, settings).
+
+    Seeds canonicalize to 31 bits here (works for Python ints and traced
+    int32 alike), so any int64 wire value — including negatives — maps to
+    the same key on every path and fits the engine's int32 slot vectors.
+    """
+    seed = seed & 0x7FFFFFFF
+    return jax.random.fold_in(jax.random.PRNGKey(seed), step)
+
+
 def generate_tokens(
     params: Dict,
     prompt: np.ndarray,
@@ -279,9 +315,14 @@ def generate_tokens(
     *,
     prefill_fn=None,
     decode_fn=None,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    seed: int = 0,
 ) -> Iterator[np.ndarray]:
-    """Greedy generation, one token per yield — the streaming server path.
+    """Generation, one token per yield — the streaming server path.
 
+    Greedy by default; ``temperature``/``top_k``/``seed`` select sampled
+    decoding on the shared (seed, step) key schedule (``sampling_key``).
     Each yield materializes one [B] int32 token on the host (that token is
     about to go out on the wire anyway); the next step's dispatch overlaps
     the consumer's handling of the previous token.
@@ -290,6 +331,7 @@ def generate_tokens(
         functools.partial(prefill, cfg=cfg)
     )
     decode_fn = decode_fn or make_decode_fn(cfg)
+    select = _select_fn()
     prompt = jnp.asarray(prompt, jnp.int32)
     b, l = prompt.shape
     if l >= cfg.max_len:
@@ -298,8 +340,16 @@ def generate_tokens(
             f"max_len {cfg.max_len}"
         )
     max_new = min(max_new, cfg.max_len - l)
+    sampled = temperature is not None and temperature > 0
+
+    def pick(logits, step):
+        if sampled:
+            return select(logits, sampling_key(seed, step), temperature,
+                          top_k)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
     logits, (k_cache, v_cache) = prefill_fn(params, prompt)
-    token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    token = pick(logits, 0)
     for i in range(max_new):
         out = np.asarray(token)
         yield out
@@ -308,25 +358,40 @@ def generate_tokens(
         logits, k_cache, v_cache = decode_fn(
             params, k_cache, v_cache, token, jnp.int32(l + i)
         )
-        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        token = pick(logits, i + 1)
+
+
+@functools.lru_cache(maxsize=1)
+def _select_fn():
+    """One compiled sampler shared by every request (thresholds traced)."""
+    return jax.jit(sample_token)
 
 
 def generate_scan(params: Dict, prompt: jax.Array, max_new: int,
-                  cfg: GptConfig) -> jax.Array:
-    """Whole greedy loop as one jit (lax.scan) → tokens [B, max_new].
+                  cfg: GptConfig, temperature=0.0, top_k=0,
+                  seed=0) -> jax.Array:
+    """Whole generation loop as one jit (lax.scan) → tokens [B, max_new].
 
     The throughput path, and the reference the streaming path is tested
-    against (identical tokens ⇒ the cache math is right).
+    against (identical tokens ⇒ the cache math is right). Defaults are
+    greedy; sampling follows the shared (seed, step) key schedule.
     """
     b, l = prompt.shape
+    sampled = temperature is not None and float(temperature) > 0
+
+    def pick(logits, step):
+        if sampled:
+            return sample_token(logits, sampling_key(seed, step),
+                                temperature, top_k)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
     logits, (k_cache, v_cache) = prefill(params, prompt, cfg)
-    token0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    token0 = pick(logits, 0)
 
     def step(carry, i):
         token, kc, vc = carry
         logits, kc, vc = decode_step(params, kc, vc, token, l + i, cfg)
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return (nxt, kc, vc), token
+        return (pick(logits, i + 1), kc, vc), token
 
     (_, _, _), toks = lax.scan(
         step, (token0, k_cache, v_cache), jnp.arange(max_new)
@@ -337,6 +402,31 @@ def generate_scan(params: Dict, prompt: jax.Array, max_new: int,
 # --------------------------------------------------------------------------- #
 # serving model                                                               #
 # --------------------------------------------------------------------------- #
+
+
+def sampling_inputs(inputs):
+    """(temperature, top_k, seed) from the optional request tensors.
+
+    When sampling is requested (TEMPERATURE > 0) without an explicit
+    SEED, a fresh random seed is drawn — otherwise every same-prompt
+    request would return the identical "random" stream; an explicit SEED
+    stays exactly reproducible.
+    """
+    temperature = 0.0
+    if "TEMPERATURE" in inputs:
+        temperature = float(np.asarray(inputs["TEMPERATURE"]).flatten()[0])
+    top_k = 0
+    if "TOP_K" in inputs:
+        top_k = int(np.asarray(inputs["TOP_K"]).flatten()[0])
+    if "SEED" in inputs:
+        seed = int(np.asarray(inputs["SEED"]).flatten()[0])
+    elif temperature > 0:
+        import os as _os
+
+        seed = int.from_bytes(_os.urandom(4), "little")
+    else:
+        seed = 0
+    return temperature, top_k, seed
 
 
 class GptModel(Model):
@@ -362,6 +452,9 @@ class GptModel(Model):
         self.inputs = [
             TensorSpec("INPUT_IDS", "INT32", [-1, -1]),
             TensorSpec("MAX_TOKENS", "INT32", [1], optional=True),
+            TensorSpec("TEMPERATURE", "FP32", [1], optional=True),
+            TensorSpec("TOP_K", "INT32", [1], optional=True),
+            TensorSpec("SEED", "INT64", [1], optional=True),
         ]
         self.outputs = [TensorSpec("OUTPUT_IDS", "INT32", [-1])]
         self._params = init_params(jax.random.PRNGKey(seed), self.cfg)
@@ -395,11 +488,13 @@ class GptModel(Model):
         if "MAX_TOKENS" in inputs:
             max_new = int(np.asarray(inputs["MAX_TOKENS"]).flatten()[0])
         max_new = max(1, min(max_new, self.cfg.max_len - prompt.shape[1]))
+        temperature, top_k, gen_seed = sampling_inputs(inputs)
 
         def gen():
             for token in generate_tokens(
                 self._params, prompt, max_new, self.cfg,
                 prefill_fn=self._prefill, decode_fn=self._decode,
+                temperature=temperature, top_k=top_k, seed=gen_seed,
             ):
                 yield {"OUTPUT_IDS": token}
 

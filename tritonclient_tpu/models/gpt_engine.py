@@ -46,6 +46,9 @@ from tritonclient_tpu.models.gpt import (
     _layer_fn,
     gpt_small,
     init_params,
+    sample_token,
+    sampling_inputs,
+    sampling_key,
 )
 from tritonclient_tpu.ops.attention import dot_product_attention
 
@@ -55,12 +58,25 @@ def _slot_cache(cfg: GptConfig, slots: int):
     return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
 
 
+def _sample_slots(logits, seeds, steps, temps, topks):
+    """Per-slot sampling on the shared (seed, step) key schedule —
+    vmapped so every slot keeps its own request's settings and key
+    stream, bit-identical to the single-request path's sampler."""
+
+    def one(lg, seed, step, temp, tk):
+        return sample_token(lg[None], sampling_key(seed, step), temp, tk)[0]
+
+    return jax.vmap(one)(logits, seeds, steps, temps, topks)
+
+
 def _decode_step_slots(params: Dict, k_cache, v_cache, tokens, pos,
-                       cfg: GptConfig):
+                       seeds, steps, temps, topks, cfg: GptConfig):
     """One step for the whole slot bank.
 
-    tokens/pos [S] int32 → (logits [S, vocab], caches). Every slot
-    advances; inactive slots produce garbage the scheduler ignores.
+    tokens/pos/seeds/steps/topks [S] int32, temps [S] f32 →
+    (next sampled tokens [S] int32, caches). Sampling happens on device —
+    logits never leave the chip. Every slot advances; inactive slots
+    produce garbage the scheduler ignores.
     """
     s_count = tokens.shape[0]
     x = params["embed"]["tok"][tokens] + params["embed"]["pos"][pos]  # [S, d]
@@ -80,17 +96,26 @@ def _decode_step_slots(params: Dict, k_cache, v_cache, tokens, pos,
     x, (k_cache, v_cache) = lax.scan(
         layer, x, (params["layers"], k_cache, v_cache)
     )
-    return _head(params, x, cfg), k_cache, v_cache
+    logits = _head(params, x, cfg)
+    # Greedy-only banks (the default) skip the sampler's full-vocab sort.
+    nxt = lax.cond(
+        jnp.any(temps > 0),
+        lambda: _sample_slots(logits, seeds, steps, temps, topks),
+        lambda: jnp.argmax(logits, axis=-1).astype(jnp.int32),
+    )
+    return nxt, k_cache, v_cache
 
 
 def _prefill_into_slot(params: Dict, k_cache, v_cache, padded_prompt,
-                       true_len, slot, cfg: GptConfig):
+                       true_len, slot, seed, temperature, top_k,
+                       cfg: GptConfig):
     """Causal pass over a padded prompt, K/V written into slot `slot`.
 
-    padded_prompt [1, bucket]; true_len/slot traced scalars. Causality
-    makes rows [0, true_len) independent of the pad tail, and rows beyond
-    the current position stay masked until overwritten by decode steps.
-    Returns (first greedy token [1] int32, caches).
+    padded_prompt [1, bucket]; true_len/slot/seed/temperature/top_k
+    traced scalars. Causality makes rows [0, true_len) independent of the
+    pad tail, and rows beyond the current position stay masked until
+    overwritten by decode steps. Returns (first token [1] int32 — sampled
+    with the request's settings at step 0 — and the caches).
     """
     atn = functools.partial(dot_product_attention, causal=True)
     x, (ks, vs) = lax.scan(
@@ -108,16 +133,27 @@ def _prefill_into_slot(params: Dict, k_cache, v_cache, padded_prompt,
     v_cache = lax.dynamic_update_slice(
         v_cache, vs.astype(v_cache.dtype), (0, slot, 0, 0, 0)
     )
-    return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_cache, v_cache
+    first = lax.cond(
+        temperature > 0,
+        lambda: sample_token(logits, sampling_key(seed, 0), temperature,
+                             top_k),
+        lambda: jnp.argmax(logits, axis=-1).astype(jnp.int32),
+    )
+    return first, k_cache, v_cache
 
 
 class _Request:
-    __slots__ = ("prompt", "max_new", "out", "remaining")
+    __slots__ = ("prompt", "max_new", "out", "remaining", "temperature",
+                 "top_k", "seed")
 
-    def __init__(self, prompt: np.ndarray, max_new: int):
+    def __init__(self, prompt: np.ndarray, max_new: int,
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0):
         self.prompt = prompt
         self.max_new = max_new
         self.remaining = max_new
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.seed = int(seed)
         self.out: "queue.Queue" = queue.Queue()
 
 
@@ -131,6 +167,12 @@ class GenerationEngine:
         self._k, self._v = _slot_cache(cfg, max_slots)
         self._tokens = jnp.zeros((max_slots,), jnp.int32)
         self._pos = jnp.zeros((max_slots,), jnp.int32)
+        # Per-slot sampling state (request settings + the (seed, step)
+        # key-schedule counters), all device-resident.
+        self._seeds = jnp.zeros((max_slots,), jnp.int32)
+        self._steps = jnp.zeros((max_slots,), jnp.int32)
+        self._temps = jnp.zeros((max_slots,), jnp.float32)
+        self._topks = jnp.zeros((max_slots,), jnp.int32)
         self._slot_req: List[Optional[_Request]] = [None] * max_slots
         self._admit: "queue.Queue" = queue.Queue()
         self._cv = threading.Condition()
@@ -181,9 +223,12 @@ class GenerationEngine:
 
     # -- client side ---------------------------------------------------------
 
-    def submit(self, prompt: np.ndarray, max_new: int) -> "queue.Queue":
+    def submit(self, prompt: np.ndarray, max_new: int,
+               temperature: float = 0.0, top_k: int = 0,
+               seed: int = 0) -> "queue.Queue":
         """Queue a generation; returns the token queue (np [1] per token,
-        then None)."""
+        then None). Greedy by default; temperature/top_k/seed follow the
+        shared sampling key schedule (gpt.sampling_key)."""
         if prompt.shape[1] >= self.cfg.max_len:
             raise ValueError(
                 f"prompt length {prompt.shape[1]} must be < max_len "
@@ -191,7 +236,10 @@ class GenerationEngine:
             )
         max_new = max(1, min(max_new,
                              self.cfg.max_len - prompt.shape[1]))
-        req = _Request(prompt.astype(np.int32), max_new)
+        # 31-bit canonical form (matches sampling_key) so the int32 slot
+        # vectors hold any int64 wire seed without overflow.
+        req = _Request(prompt.astype(np.int32), max_new, temperature,
+                       top_k, int(seed) & 0x7FFFFFFF)
         with self._cv:
             if self._stopping:
                 raise RuntimeError("generation engine is shut down")
@@ -230,7 +278,8 @@ class GenerationEngine:
             padded[:, :l] = req.prompt
             first, self._k, self._v = self._prefill(
                 self.params, self._k, self._v, jnp.asarray(padded),
-                jnp.int32(l), jnp.int32(slot),
+                jnp.int32(l), jnp.int32(slot), jnp.int32(req.seed),
+                jnp.float32(req.temperature), jnp.int32(req.top_k),
             )
             try:
                 first.copy_to_host_async()
@@ -243,6 +292,10 @@ class GenerationEngine:
             # preserved: this entry precedes any step including the slot).
             self._tokens = self._tokens.at[slot].set(first[0])
             self._pos = self._pos.at[slot].set(l)
+            self._seeds = self._seeds.at[slot].set(req.seed)
+            self._steps = self._steps.at[slot].set(1)
+            self._temps = self._temps.at[slot].set(req.temperature)
+            self._topks = self._topks.at[slot].set(req.top_k)
             deliveries.append((first, [(0, slot, req)]))
 
     def _distribute(self, nxt_dev, pairs):
@@ -317,16 +370,17 @@ class GenerationEngine:
                             self._thread = None
                             return
                 continue
-            logits, self._k, self._v = self._step(
-                self.params, self._k, self._v, self._tokens, self._pos
+            nxt, self._k, self._v = self._step(
+                self.params, self._k, self._v, self._tokens, self._pos,
+                self._seeds, self._steps, self._temps, self._topks,
             )
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             try:
                 nxt.copy_to_host_async()
             except AttributeError:
                 pass
             self._tokens = nxt
             self._pos = self._pos + 1
+            self._steps = self._steps + 1
             deliveries.append(
                 (nxt, [(s, s, self._slot_req[s]) for s in active
                        if self._slot_req[s] is not None])
@@ -357,6 +411,9 @@ class GptEngineModel(Model):
         self.inputs = [
             TensorSpec("INPUT_IDS", "INT32", [-1, -1]),
             TensorSpec("MAX_TOKENS", "INT32", [1], optional=True),
+            TensorSpec("TEMPERATURE", "FP32", [1], optional=True),
+            TensorSpec("TOP_K", "INT32", [1], optional=True),
+            TensorSpec("SEED", "INT64", [1], optional=True),
         ]
         self.outputs = [TensorSpec("OUTPUT_IDS", "INT32", [-1])]
         params = init_params(jax.random.PRNGKey(seed), self.cfg)
@@ -381,7 +438,9 @@ class GptEngineModel(Model):
         max_new = 16
         if "MAX_TOKENS" in inputs:
             max_new = int(np.asarray(inputs["MAX_TOKENS"]).flatten()[0])
-        out = self.engine.submit(prompt, max_new)
+        temperature, top_k, gen_seed = sampling_inputs(inputs)
+        out = self.engine.submit(prompt, max_new, temperature=temperature,
+                                 top_k=top_k, seed=gen_seed)
 
         def gen():
             while True:
