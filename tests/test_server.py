@@ -578,3 +578,32 @@ class TestDynamicBatching:
             assert np.all(got == (i + 1) + 10 * (i + 1))
         assert stats.execution_count == 1
         assert stats.inference_count == 3
+
+
+def test_prometheus_metrics_endpoint(base):
+    """Triton-compatible /metrics: nv_inference_* counter family in
+    Prometheus exposition format, labeled per model."""
+    # Generate at least one success and one failure first.
+    requests.post(
+        base + "/v2/models/simple/infer",
+        json={"inputs": [
+            {"name": "INPUT0", "datatype": "INT32", "shape": [1, 16],
+             "data": list(range(16))},
+            {"name": "INPUT1", "datatype": "INT32", "shape": [1, 16],
+             "data": [1] * 16},
+        ]},
+    )
+    r = requests.get(base + "/metrics")
+    assert r.status_code == 200
+    assert r.headers["Content-Type"].startswith("text/plain")
+    text = r.text
+    assert "# TYPE nv_inference_request_success counter" in text
+    assert "# TYPE nv_inference_exec_count counter" in text
+    import re as _re
+
+    m = _re.search(
+        r'nv_inference_request_success\{model="simple",version="1"\} (\d+)',
+        text,
+    )
+    assert m and int(m.group(1)) >= 1
+    assert 'nv_inference_count{model="simple_string"' in text

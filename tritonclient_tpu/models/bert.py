@@ -232,14 +232,20 @@ class BertBaseModel(Model):
     max_batch_size = 32
 
     def __init__(self, cfg: Optional[BertConfig] = None, seed: int = 0,
-                 use_flash_attention: bool = False):
+                 use_flash_attention: bool = False,
+                 checkpoint: Optional[str] = None):
         super().__init__()
         self.cfg = cfg or bert_base()
         self.inputs = [TensorSpec("INPUT_IDS", "INT32", [-1, -1])]
         self.outputs = [
             TensorSpec("POOLED_OUTPUT", "FP32", [-1, self.cfg.d_model])
         ]
-        self._params = init_params(jax.random.PRNGKey(seed), self.cfg)
+        if checkpoint is not None:
+            from tritonclient_tpu.models.checkpoint import load_params
+
+            self._params = load_params(checkpoint)
+        else:
+            self._params = init_params(jax.random.PRNGKey(seed), self.cfg)
 
         attention_fn = None
         if use_flash_attention:

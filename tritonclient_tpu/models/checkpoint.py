@@ -1,0 +1,56 @@
+"""Checkpoint save/load for the model zoo (orbax-backed).
+
+The reference is a stateless client (SURVEY.md §5.4: checkpoint/resume
+N/A); a complete serving framework, however, loads real weights. This is
+the thin, TPU-idiomatic layer: orbax writes the param pytree (per-leaf
+ocdbt storage, async-capable), and restore can target a sharded layout
+directly — each host/device materializes only its shard, so multi-chip
+serving never stages the full tree on one host.
+
+Usage:
+    save_params("/ckpt/gpt", params)
+    params = load_params("/ckpt/gpt")                       # single device
+    params = load_params("/ckpt/gpt", mesh=mesh,
+                         rules=gpt.PARTITION_RULES)         # sharded restore
+"""
+
+import os
+from typing import Optional
+
+import jax
+
+
+def save_params(path: str, params) -> None:
+    """Write the param pytree at ``path`` (directory, created/overwritten)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, params, force=True)
+
+
+def load_params(path: str, mesh=None, rules: Optional[tuple] = None,
+                target=None):
+    """Restore the param pytree from ``path``.
+
+    With ``mesh`` + ``rules`` (a PARTITION_RULES tuple, e.g.
+    ``models/gpt.py`` / ``models/bert.py``) the restored tree is laid out
+    over the mesh by the rules. Callers that must avoid the intermediate
+    host copy entirely (giant multi-host checkpoints) pass ``target``: a
+    pytree of sharded ``jax.ShapeDtypeStruct``s, which orbax restores
+    shard-by-shard onto the owning devices.
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        if target is not None:
+            return ckptr.restore(path, target)
+        params = ckptr.restore(path)
+    if mesh is not None:
+        from tritonclient_tpu.parallel.sharding import tree_shardings
+
+        params = jax.device_put(
+            params, tree_shardings(mesh, params, rules or ())
+        )
+    return params

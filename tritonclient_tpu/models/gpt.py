@@ -446,7 +446,8 @@ class GptModel(Model):
     blocking = True
 
     def __init__(self, cfg: Optional[GptConfig] = None, seed: int = 0,
-                 use_flash_attention: bool = False):
+                 use_flash_attention: bool = False,
+                 checkpoint: Optional[str] = None):
         super().__init__()
         self.cfg = cfg or gpt_small()
         self.inputs = [
@@ -457,7 +458,12 @@ class GptModel(Model):
             TensorSpec("SEED", "INT64", [1], optional=True),
         ]
         self.outputs = [TensorSpec("OUTPUT_IDS", "INT32", [-1])]
-        self._params = init_params(jax.random.PRNGKey(seed), self.cfg)
+        if checkpoint is not None:
+            from tritonclient_tpu.models.checkpoint import load_params
+
+            self._params = load_params(checkpoint)
+        else:
+            self._params = init_params(jax.random.PRNGKey(seed), self.cfg)
         attention_fn = None
         if use_flash_attention:
             from tritonclient_tpu.ops.flash_attention import flash_attention

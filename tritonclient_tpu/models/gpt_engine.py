@@ -318,6 +318,9 @@ class GenerationEngine:
                 req.out.put(None)
                 if self._slot_req[slot] is req:
                     self._slot_req[slot] = None
+                    # Reset the slot's temperature so an all-greedy bank
+                    # goes back to the cheap argmax branch of the step.
+                    self._temps = self._temps.at[slot].set(0.0)
 
     def _run(self):
         try:

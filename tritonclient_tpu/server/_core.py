@@ -774,6 +774,61 @@ class InferenceCore:
             raise CoreError(f"failed to unload '{name}', no such model", 400)
         self._loaded[name] = False
 
+    def prometheus_metrics(self) -> str:
+        """Triton-compatible Prometheus exposition (the server repo's
+        metrics endpoint; the reference client never scrapes it, but a
+        complete serving stack exposes it — same nv_inference_* family
+        and labels as Triton's /metrics on :8002)."""
+        counters = (
+            ("nv_inference_request_success",
+             "Number of successful inference requests",
+             lambda s: s.success_count),
+            ("nv_inference_request_failure",
+             "Number of failed inference requests",
+             lambda s: s.fail_count),
+            ("nv_inference_count", "Number of inferences performed",
+             lambda s: s.inference_count),
+            ("nv_inference_exec_count",
+             "Number of model executions performed (batched)",
+             lambda s: s.execution_count),
+            ("nv_inference_request_duration_us",
+             "Cumulative inference request duration in microseconds",
+             lambda s: s.success_ns // 1000),
+            ("nv_inference_queue_duration_us",
+             "Cumulative inference queuing duration in microseconds",
+             lambda s: s.queue_ns // 1000),
+            ("nv_inference_compute_input_duration_us",
+             "Cumulative compute input duration in microseconds",
+             lambda s: s.compute_input_ns // 1000),
+            ("nv_inference_compute_infer_duration_us",
+             "Cumulative compute inference duration in microseconds",
+             lambda s: s.compute_infer_ns // 1000),
+            ("nv_inference_compute_output_duration_us",
+             "Cumulative compute output duration in microseconds",
+             lambda s: s.compute_output_ns // 1000),
+        )
+        with self._lock:
+            rows = [
+                (name, self._repository[name].version, stats)
+                for name, stats in sorted(self._stats.items())
+                if name in self._repository
+            ]
+        def esc(v: str) -> str:
+            # Prometheus exposition label escaping: backslash, quote, LF.
+            return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                    .replace("\n", "\\n"))
+
+        lines = []
+        for metric, help_text, getter in counters:
+            lines.append(f"# HELP {metric} {help_text}")
+            lines.append(f"# TYPE {metric} counter")
+            for name, version, stats in rows:
+                lines.append(
+                    f'{metric}{{model="{esc(name)}",version="{esc(version)}"}} '
+                    f"{getter(stats)}"
+                )
+        return "\n".join(lines) + "\n"
+
     def model_statistics(self, name: str = "", version: str = "") -> List[dict]:
         if name:
             model = self._get_model(name, version)

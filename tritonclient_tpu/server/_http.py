@@ -146,6 +146,17 @@ class _Handler(BaseHTTPRequestHandler):
         parts = path.split("/")
         core = self.core
 
+        if path == "metrics" and method == "GET":
+            # Triton serves Prometheus metrics on a dedicated port; the
+            # in-process server exposes the same nv_inference_* family on
+            # its one HTTP port. GET-only (Triton parity); anything else
+            # falls through to the 404 path, which drains the body.
+            body = core.prometheus_metrics().encode()
+            return self._send(
+                200, body,
+                content_type="text/plain; version=0.0.4; charset=utf-8",
+            )
+
         if parts[0] != "v2":
             self._send_json({"error": "not found"}, 404)
             self._read_body()
