@@ -144,7 +144,7 @@ def _prefill_into_slot(params: Dict, k_cache, v_cache, padded_prompt,
 
 class _Request:
     __slots__ = ("prompt", "max_new", "out", "remaining", "temperature",
-                 "top_k", "seed")
+                 "top_k", "seed", "cancelled")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0):
@@ -154,6 +154,7 @@ class _Request:
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.seed = int(seed)
+        self.cancelled = False  # set by the consumer; engine frees the slot
         self.out: "queue.Queue" = queue.Queue()
 
 
@@ -264,6 +265,17 @@ class GenerationEngine:
             b *= 2
         return min(b, self.cfg.max_len)
 
+    def _release_cancelled(self):
+        """A consumer that went away (stream closed) marks its request
+        cancelled; its slot frees at the next loop top instead of
+        generating dead tokens until max_new."""
+        for slot, req in enumerate(self._slot_req):
+            if req is not None and req.cancelled:
+                req.remaining = 0
+                req.out.put(None)
+                self._slot_req[slot] = None
+                self._temps = self._temps.at[slot].set(0.0)
+
     def _admit_into_free_slots(self, deliveries):
         for slot in range(self.max_slots):
             if self._slot_req[slot] is not None:
@@ -272,6 +284,9 @@ class GenerationEngine:
                 req = self._admit.get_nowait()
             except queue.Empty:
                 return
+            if req.cancelled:
+                req.out.put(None)
+                continue
             l = req.prompt.shape[1]
             bucket = self._bucket(l)
             padded = np.zeros((1, bucket), np.int32)
@@ -359,6 +374,7 @@ class GenerationEngine:
                     self._distribute(*deliveries.popleft())
                 self._drain_terminated()
                 return
+            self._release_cancelled()
             self._admit_into_free_slots(deliveries)
             active = [s for s, r in enumerate(self._slot_req)
                       if r is not None]
