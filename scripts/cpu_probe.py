@@ -1,0 +1,115 @@
+"""CPU utilization during serving vs in-process windows (1-core box).
+
+Reads /proc/stat around each window: if serving pegs the core while
+in-process leaves headroom, the depth-32 gap is serving CPU cost, not
+transport latency.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+os.environ.setdefault("TPU_SERVER_DYNAMIC_BATCH", "0")
+sys.setswitchinterval(0.0002)
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def cpu_times():
+    with open("/proc/stat") as f:
+        parts = f.readline().split()
+    vals = [int(x) for x in parts[1:9]]
+    idle = vals[3] + vals[4]
+    return sum(vals), idle
+
+
+def proc_cpu():
+    with open(f"/proc/{os.getpid()}/stat") as f:
+        parts = f.read().split()
+    return (int(parts[13]) + int(parts[14])) / os.sysconf("SC_CLK_TCK")
+
+
+def relay_pid():
+    import subprocess
+
+    out = subprocess.run(
+        ["pgrep", "-f", "relay.py"], capture_output=True, text=True
+    ).stdout.split()
+    return int(out[0]) if out else None
+
+
+def pid_cpu(pid):
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            parts = f.read().split()
+        return (int(parts[13]) + int(parts[14])) / os.sysconf("SC_CLK_TCK")
+    except OSError:
+        return 0.0
+
+
+def main():
+    depth = int(os.environ.get("PROBE_DEPTH", "32"))
+    seconds = float(os.environ.get("PROBE_SECONDS", "6"))
+    batch, seq = 8, 128
+
+    import jax
+
+    import bench
+    from tritonclient_tpu.models.bert import BertBaseModel
+    from tritonclient_tpu.perf_analyzer import PerfAnalyzer
+    from tritonclient_tpu.server import InferenceServer
+
+    model = BertBaseModel()
+    payloads = [
+        np.random.randint(0, 30000, (batch, seq)).astype(np.int32)
+        for _ in range(16)
+    ]
+    dispatch = lambda p: model._fwd(model._params, p)  # noqa: E731
+    model.warmup()
+    relay = relay_pid()
+
+    def window(fn, label):
+        t0, i0 = cpu_times()
+        p0, r0 = proc_cpu(), pid_cpu(relay)
+        w0 = time.perf_counter()
+        ips = fn()
+        wall = time.perf_counter() - w0
+        t1, i1 = cpu_times()
+        p1, r1 = proc_cpu(), pid_cpu(relay)
+        busy_pct = 100 * (1 - (i1 - i0) / max(t1 - t0, 1))
+        self_pct = 100 * (p1 - p0) / wall
+        relay_pct = 100 * (r1 - r0) / wall
+        per_req_ms = (p1 - p0) / max(ips * wall, 1) * 1000
+        relay_per_req_ms = (r1 - r0) / max(ips * wall, 1) * 1000
+        print(f"{label}: {ips:.1f} infer/s | core busy {busy_pct:.0f}% | "
+              f"bench-proc {self_pct:.0f}% ({per_req_ms:.2f} ms/req) | "
+              f"relay {relay_pct:.0f}% ({relay_per_req_ms:.2f} ms/req)")
+        return ips
+
+    with InferenceServer(models=[model], http=False) as server:
+        analyzer = PerfAnalyzer(
+            server.grpc_address, model.name, protocol="grpc",
+            batch_size=batch, shared_memory="tpu", streaming=True,
+            read_outputs=True, measurement_interval_s=seconds,
+            warmup_s=0.0, shape_overrides={"INPUT_IDS": seq},
+        )
+        with analyzer.session(depth) as session:
+            session.measure(interval_s=1.5)  # discard
+            for r in range(2):
+                window(
+                    lambda: bench._pipelined_inprocess(
+                        dispatch, jax.device_get, payloads, seconds, depth
+                    )[0],
+                    "inprocess",
+                )
+                window(
+                    lambda: session.measure(interval_s=seconds).summary()[
+                        "throughput_infer_per_sec"
+                    ],
+                    "serving  ",
+                )
+
+
+if __name__ == "__main__":
+    main()

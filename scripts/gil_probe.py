@@ -1,0 +1,114 @@
+"""Where does Python time go during a depth-32 serving window?
+
+Samples sys._current_frames() at ~150 Hz from a sampler thread during a
+serving window and an in-process window, aggregating by thread-name
+bucket and top frame. Also measures GIL scheduling delay (sleep
+overshoot) percentiles in both regimes.
+"""
+
+import collections
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+os.environ.setdefault("TPU_SERVER_DYNAMIC_BATCH", "0")
+sys.setswitchinterval(0.0002)
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class Sampler(threading.Thread):
+    def __init__(self):
+        super().__init__(daemon=True)
+        self.samples = collections.Counter()
+        self.delays = []
+        self._stop = threading.Event()
+
+    def run(self):
+        names = {}
+        while not self._stop.is_set():
+            t0 = time.perf_counter()
+            time.sleep(0.0005)
+            self.delays.append(time.perf_counter() - t0 - 0.0005)
+            if len(self.delays) % 3:
+                continue  # sample stacks at 1/3 rate
+            for t in threading.enumerate():
+                names[t.ident] = t.name
+            me = threading.get_ident()
+            for ident, frame in sys._current_frames().items():
+                if ident == me:
+                    continue
+                name = names.get(ident, "?").split("-")[0].split("_")[0]
+                code = frame.f_code
+                self.samples[
+                    f"{name}:{os.path.basename(code.co_filename)}:"
+                    f"{code.co_name}"
+                ] += 1
+
+    def stop(self):
+        self._stop.set()
+
+    def report(self, label, top=18):
+        total = sum(self.samples.values())
+        d = sorted(self.delays)
+        import math
+
+        def pct(p):
+            return d[min(len(d) - 1, math.ceil(p / 100 * len(d)) - 1)] * 1000
+
+        print(f"== {label}: {total} stack samples, sched delay "
+              f"p50={pct(50):.2f}ms p90={pct(90):.2f}ms p99={pct(99):.2f}ms")
+        for key, n in self.samples.most_common(top):
+            print(f"  {n/total*100:5.1f}% {key}")
+
+
+def main():
+    depth = int(os.environ.get("PROBE_DEPTH", "32"))
+    seconds = float(os.environ.get("PROBE_SECONDS", "6"))
+    batch, seq = 8, 128
+
+    import jax
+
+    from tritonclient_tpu.models.bert import BertBaseModel
+    from tritonclient_tpu.perf_analyzer import PerfAnalyzer
+    from tritonclient_tpu.server import InferenceServer
+    import bench
+
+    model = BertBaseModel()
+    payloads = [
+        np.random.randint(0, 30000, (batch, seq)).astype(np.int32)
+        for _ in range(16)
+    ]
+    dispatch = lambda p: model._fwd(model._params, p)  # noqa: E731
+    model.warmup()
+
+    with InferenceServer(models=[model], http=False) as server:
+        analyzer = PerfAnalyzer(
+            server.grpc_address, model.name, protocol="grpc",
+            batch_size=batch, shared_memory="tpu", streaming=True,
+            read_outputs=True, measurement_interval_s=seconds,
+            warmup_s=0.0, shape_overrides={"INPUT_IDS": seq},
+        )
+        with analyzer.session(depth) as session:
+            session.measure(interval_s=1.5)  # discard
+            s1 = Sampler()
+            s1.start()
+            w = session.measure(interval_s=seconds)
+            s1.stop()
+            print("serving ips:", w.summary()["throughput_infer_per_sec"])
+            s1.report("serving window")
+
+            s2 = Sampler()
+            s2.start()
+            ips, _ = bench._pipelined_inprocess(
+                dispatch, jax.device_get, payloads, seconds, depth
+            )
+            s2.stop()
+            print("inprocess ips:", round(ips, 1))
+            s2.report("in-process window")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,188 @@
+"""Interleaved A/B of serving-stack configurations at one depth.
+
+Each config gets its own InferenceServer (sharing ONE model instance, so
+HBM and compile cost are paid once); windows run round-robin
+config1..configN + an in-process comparator window per round, so tunnel
+drift hits every variant equally (memory: axon-tunnel-measurement-pitfalls).
+
+Env: AB_DEPTH (32), AB_SECONDS per window (5), AB_ROUNDS (3),
+AB_CONFIGS comma list of pool sizes e.g. "32,4,1,0" (0 = inline feeder).
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+os.environ.setdefault("TPU_SERVER_DYNAMIC_BATCH", "0")
+sys.setswitchinterval(0.0002)
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    depth = int(os.environ.get("AB_DEPTH", "32"))
+    seconds = float(os.environ.get("AB_SECONDS", "5"))
+    rounds = int(os.environ.get("AB_ROUNDS", "3"))
+    # Config grammar: "<aio|sync>-<workers|window>[-poolN]"
+    configs = os.environ.get(
+        "AB_CONFIGS", "sync-workers,aio-workers,sync-window,aio-window"
+    ).split(",")
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    seq = int(os.environ.get("BENCH_SEQ", "128"))
+
+    import jax
+
+    from tritonclient_tpu.models.bert import BertBaseModel
+    from tritonclient_tpu.perf_analyzer import PerfAnalyzer
+    from tritonclient_tpu.server import InferenceServer
+
+    model = BertBaseModel()
+    payloads = [
+        np.random.randint(0, 30000, (batch, seq)).astype(np.int32)
+        for _ in range(16)
+    ]
+    dispatch = lambda p: model._fwd(model._params, p)  # noqa: E731
+    model.warmup()
+    # Pre-warm the dynamic batcher's power-of-two row buckets so no
+    # measured window pays a through-tunnel XLA compile.
+    for rows in (batch, 2 * batch, 4 * batch):
+        if rows <= 32:
+            jax.block_until_ready(
+                dispatch(np.zeros((rows, seq), np.int32))
+            )
+    from tritonclient_tpu.utils import tpu_shared_memory as tpushm
+
+    co = tpushm.transfer_coalescer()
+    if co is not None:
+        co.warm((batch, 768), np.float32)
+
+    from statistics import median
+
+    import importlib
+    bench = importlib.import_module("bench")
+
+    servers, sessions, names, measures = [], [], [], []
+    try:
+        for spec in configs:
+            parts = spec.split("-")
+            aio = parts[0] == "aio"
+            window = parts[1] == "window"
+            pool = 32
+            batch_delay = None
+            coalesce = False
+            for p in parts[2:]:
+                if p.startswith("pool"):
+                    pool = int(p[4:])
+                elif p.startswith("batch"):
+                    batch_delay = int(p[5:])
+                elif p == "coal":
+                    coalesce = True
+                elif p.startswith("shard"):
+                    os.environ["PA_MUX_SHARD"] = p[5:]
+            overlay = {"TPU_TRANSFER_COALESCE": "1" if coalesce else "0"}
+            os.environ["TPU_STREAM_POOL_WORKERS"] = str(pool)
+            os.environ["TPU_SERVER_GRPC_AIO"] = "1" if aio else "0"
+            if batch_delay is None:
+                os.environ["TPU_SERVER_DYNAMIC_BATCH"] = "0"
+            else:
+                os.environ["TPU_SERVER_DYNAMIC_BATCH"] = "1"
+                os.environ["TPU_SERVER_BATCH_DELAY_US"] = str(batch_delay)
+            server = InferenceServer(models=[model], http=False)
+            server.start()
+            analyzer = PerfAnalyzer(
+                server.grpc_address, model.name, protocol="grpc",
+                batch_size=batch, shared_memory="tpu", streaming=True,
+                async_window=window,
+                read_outputs=True, measurement_interval_s=seconds,
+                warmup_s=1.0 if window else 0.0,
+                shape_overrides={"INPUT_IDS": seq},
+            )
+            servers.append(server)
+            names.append(spec)
+            if window:
+                sessions.append(None)
+                analyzer.measure(depth)  # discard (one-shot mode)
+                measures.append(
+                    lambda a=analyzer, ov=overlay: (
+                        os.environ.update(ov),
+                        a.measure(depth).summary(),
+                    )[1]
+                )
+            else:
+                session = analyzer.session(depth)
+                session.__enter__()
+                os.environ.update(overlay)
+                session.measure(interval_s=1.5)  # discard
+                sessions.append(session)
+                measures.append(
+                    lambda s=session, ov=overlay: (
+                        os.environ.update(ov),
+                        s.measure(interval_s=seconds).summary(),
+                    )[1]
+                )
+
+        def proc_cpu():
+            with open(f"/proc/{os.getpid()}/stat") as f:
+                p = f.read().split()
+            return (int(p[13]) + int(p[14])) / os.sysconf("SC_CLK_TCK")
+
+        results = {n: [] for n in names}
+        results["inprocess"] = []
+        lat = {n: [] for n in names}
+        cpu_ms = {n: [] for n in names}
+        cpu_ms["inprocess"] = []
+        for r in range(rounds):
+            c0 = proc_cpu()
+            t0 = time.perf_counter()
+            ips, _ = bench._pipelined_inprocess(
+                dispatch, jax.device_get, payloads, seconds, depth
+            )
+            cpu_ms["inprocess"].append(
+                (proc_cpu() - c0) / max(ips * (time.perf_counter() - t0), 1) * 1e3
+            )
+            results["inprocess"].append(ips)
+            for name, measure in zip(names, measures):
+                c0 = proc_cpu()
+                t0 = time.perf_counter()
+                s = measure()
+                wall = time.perf_counter() - t0
+                results[name].append(s["throughput_infer_per_sec"])
+                cpu_ms[name].append(
+                    (proc_cpu() - c0)
+                    / max(s["throughput_infer_per_sec"] * wall, 1) * 1e3
+                )
+                lat[name].append((s["latency_p50_us"], s["latency_p99_us"]))
+        inproc = median(results["inprocess"])
+        print(f"inprocess: {[round(x,1) for x in results['inprocess']]} "
+              f"median {inproc:.1f} cpu/req {median(cpu_ms['inprocess']):.2f}ms")
+        for name, server in zip(names, servers):
+            med = median(results[name])
+            p50s = round(sum(x[0] for x in lat[name]) / rounds / 1000, 1)
+            p99s = round(max(x[1] for x in lat[name]) / 1000, 1)
+            st = server.core.model_statistics(model.name)[0]
+            avg_b = round(
+                st["inference_count"] / max(st["execution_count"], 1), 2
+            )
+            print(f"{name}: {[round(x,1) for x in results[name]]} "
+                  f"median {med:.1f} ratio {med/inproc:.3f} "
+                  f"p50~{p50s}ms p99max~{p99s}ms avg_batch~{avg_b} "
+                  f"cpu/req {median(cpu_ms[name]):.2f}ms")
+        if co is not None:
+            print("coalescer:", co.stats)
+    finally:
+        for s in sessions:
+            try:
+                if s is not None:
+                    s.__exit__(None, None, None)
+            except Exception:
+                pass
+        for server in servers:
+            try:
+                server.stop()
+            except Exception:
+                pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,227 @@
+"""Depth-32 serving-tail probe: splits the client recv phase into
+server-wait (submit -> stream response) and region readback (d2h), and
+reports p50/p90/p99 per phase alongside throughput, so ratio misses are
+attributable (VERDICT r3 weak #1/#6).
+
+Run alone on the chip (memory: axon-tunnel-measurement-pitfalls).
+
+Env: PROBE_DEPTH (default 32), PROBE_SECONDS per window (default 6),
+PROBE_WINDOWS (default 3), BENCH_MODEL / BENCH_BATCH / BENCH_SEQ as bench.py.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+os.environ.setdefault("TPU_SERVER_DYNAMIC_BATCH", "0")
+sys.setswitchinterval(0.0002)
+
+
+def pct(sorted_vals, p):
+    if not sorted_vals:
+        return 0.0
+    import math
+
+    idx = min(len(sorted_vals) - 1, math.ceil(p / 100.0 * len(sorted_vals)) - 1)
+    return sorted_vals[max(idx, 0)]
+
+
+def main():
+    depth = int(os.environ.get("PROBE_DEPTH", "32"))
+    seconds = float(os.environ.get("PROBE_SECONDS", "6"))
+    n_windows = int(os.environ.get("PROBE_WINDOWS", "3"))
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    seq = int(os.environ.get("BENCH_SEQ", "128"))
+
+    import jax
+
+    from tritonclient_tpu.models.bert import BertBaseModel
+    from tritonclient_tpu.perf_analyzer import PerfAnalyzer
+    from tritonclient_tpu.perf_analyzer._analyzer import (
+        MeasurementSession,
+        _Worker,
+    )
+    from tritonclient_tpu.perf_analyzer._stats import RequestTimers
+    from tritonclient_tpu.server import InferenceServer
+
+    model = BertBaseModel()
+    payloads = [
+        np.random.randint(0, 30000, (batch, seq)).astype(np.int32)
+        for _ in range(16)
+    ]
+    dispatch = lambda p: model._fwd(model._params, p)  # noqa: E731
+    model.warmup()
+
+    # Cross-boundary timing: client and server share this process, so one
+    # monotonic clock covers submit -> server-entry -> server-exit -> resp.
+    submit_ts = {}     # rid -> perf_counter at stream write
+    leg = {"req": [], "srv": [], "resp": []}
+    from tritonclient_tpu.server import _grpc as _sgrpc
+
+    _orig_process = _sgrpc._Servicer._process_stream_request
+
+    def _timed_process(self, request, cached_reqs, cached_resps):
+        t_in = time.perf_counter()
+        t_sub = submit_ts.get(request.id)
+        out = _orig_process(self, request, cached_reqs, cached_resps)
+        t_out = time.perf_counter()
+        if t_sub is not None:
+            leg["req"].append(t_in - t_sub)
+        leg["srv"].append(t_out - t_in)
+        # Response leg measured client-side: mux reader stamps arrival.
+        exit_ts[request.id] = t_out
+        return out
+
+    exit_ts = {}
+    _sgrpc._Servicer._process_stream_request = _timed_process
+
+    class ProbeWorker(_Worker):
+        """_run_streaming with the recv phase split into wait vs readback."""
+
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self.phase = {"send": [], "wait": [], "read": [], "gap": []}
+
+        def _run_streaming(self, end_time):
+            a = self.analyzer
+            self._ensure_stream()
+            done = self._done
+            outputs = self._build_outputs()
+            rid = f"w{self.wid}"
+            prepared = self._client.prepare_request(
+                a.model_name, self._static_inputs, outputs=outputs,
+                request_id=rid,
+            )
+            i = 0
+            t_prev_end = None
+            while time.perf_counter() < end_time and not self._stop.is_set():
+                payloads_ = self.payload_sets[i % len(self.payload_sets)]
+                i += 1
+                timers = RequestTimers()
+                timers.capture("request_start")
+                t0 = time.perf_counter()
+                if t_prev_end is not None:
+                    self.phase["gap"].append(t0 - t_prev_end)
+                try:
+                    timers.capture("send_start")
+                    self._write_region(payloads_)
+                    timers.capture("send_end")
+                    t1 = time.perf_counter()
+
+                    def _send():
+                        submit_ts[rid] = time.perf_counter()
+                        self._client.async_stream_infer(prepared_request=prepared)
+
+                    if self.mux is not None:
+                        self.mux.submit(rid, _send)
+                    else:
+                        _send()
+                    timers.capture("recv_start")
+                    result, error = done.get(timeout=120)
+                    t2 = time.perf_counter()
+                    t_exit = exit_ts.get(rid)
+                    if t_exit is not None:
+                        leg["resp"].append(t2 - t_exit)
+                    if error is not None:
+                        self.errors += 1
+                        continue
+                    if a.read_outputs:
+                        self._consume_outputs(result)
+                    timers.capture("recv_end")
+                    t3 = time.perf_counter()
+                except Exception:
+                    self.errors += 1
+                    continue
+                timers.capture("request_end")
+                t_prev_end = t3
+                self.stat.update(timers)
+                self.latencies.append(timers.total_ns)
+                self.phase["send"].append(t1 - t0)
+                self.phase["wait"].append(t2 - t1)
+                self.phase["read"].append(t3 - t2)
+
+    with InferenceServer(models=[model], http=False) as server:
+        analyzer = PerfAnalyzer(
+            server.grpc_address,
+            model.name,
+            protocol="grpc",
+            batch_size=batch,
+            shared_memory="tpu",
+            streaming=True,
+            read_outputs=True,
+            measurement_interval_s=seconds,
+            warmup_s=1.0,
+            shape_overrides={"INPUT_IDS": seq},
+        )
+        session = MeasurementSession(analyzer, depth)
+        session.workers = [
+            ProbeWorker(
+                analyzer, w,
+                mux=session.muxes[w // analyzer.mux_shard] if session.muxes else None,
+            )
+            for w in range(depth)
+        ]
+        from statistics import median
+
+        serve_ips, inproc_ips = [], []
+        with session:
+            session.measure(interval_s=2.0)  # discard
+            for w in session.workers:
+                w.phase = {"send": [], "wait": [], "read": [], "gap": []}
+            from bench import _pipelined_inprocess  # reuse comparator
+
+            for _ in range(n_windows):
+                ips, _lat = _pipelined_inprocess(
+                    dispatch, jax.device_get, payloads, seconds, depth
+                )
+                inproc_ips.append(ips)
+                window = session.measure(interval_s=seconds)
+                serve_ips.append(window.summary()["throughput_infer_per_sec"])
+
+            phases = {}
+            for key in ("send", "wait", "read", "gap"):
+                vals = sorted(
+                    v * 1000
+                    for w in session.workers
+                    for v in w.phase[key]
+                )
+                phases[key] = {
+                    "p50": round(pct(vals, 50), 2),
+                    "p90": round(pct(vals, 90), 2),
+                    "p99": round(pct(vals, 99), 2),
+                    "mean": round(sum(vals) / max(len(vals), 1), 2),
+                    "n": len(vals),
+                }
+        stats = server.core.model_statistics(model.name)[0]["inference_stats"]
+        n = max(stats["success"]["count"], 1)
+        server_us = {
+            k: int(stats[k]["ns"] / n / 1000)
+            for k in ("queue", "compute_input", "compute_infer", "compute_output")
+        }
+        print(json.dumps({
+            "depth": depth,
+            "serving_ips": [round(x, 1) for x in serve_ips],
+            "inprocess_ips": [round(x, 1) for x in inproc_ips],
+            "ratio_median": round(
+                median(s / i for s, i in zip(serve_ips, inproc_ips)), 4
+            ),
+            "client_phases_ms": phases,
+            "legs_ms": {
+                k: {
+                    "p50": round(pct(sorted(v), 50) * 1000, 2),
+                    "p90": round(pct(sorted(v), 90) * 1000, 2),
+                    "p99": round(pct(sorted(v), 99) * 1000, 2),
+                    "n": len(v),
+                }
+                for k, v in leg.items()
+            },
+            "server_mean_us": server_us,
+        }, indent=1))
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    main()

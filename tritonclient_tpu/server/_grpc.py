@@ -41,6 +41,17 @@ def _set_param(params, key, value):
         params[key].string_param = str(value)
 
 
+def _stream_error(msg: str, request_id: str = "") -> pb.ModelStreamInferResponse:
+    """Stream error response; echoes the failed request's id (when known)
+    in the otherwise-empty infer_response so multiplexed clients can
+    attribute it without relying on response ordering (Triton sets the id
+    on errored decoupled responses the same way)."""
+    resp = pb.ModelStreamInferResponse(error_message=msg)
+    if request_id:
+        resp.infer_response.id = request_id
+    return resp
+
+
 def _status_for(e: CoreError) -> grpc.StatusCode:
     return {
         404: grpc.StatusCode.NOT_FOUND,
@@ -161,11 +172,21 @@ def core_to_response(cresp: CoreResponse) -> pb.ModelInferResponse:
 
 class _Servicer:
     def __init__(self, core: InferenceCore):
+        import os
+
         self.core = core
         # Shared by every stream's pipelined request processing
-        # (ModelStreamInfer); sized past the bench's worst stream fan-in.
-        self._stream_pool = futures.ThreadPoolExecutor(
-            max_workers=32, thread_name_prefix="stream-exec"
+        # (ModelStreamInfer). Thread count is a latency/contention dial:
+        # more threads overlap slow per-request handling, but every extra
+        # runnable thread inflates GIL scheduling for the enqueue-only hot
+        # path. 0 = process inline on the stream's feeder thread.
+        workers = int(os.environ.get("TPU_STREAM_POOL_WORKERS", "32"))
+        self._stream_pool = (
+            futures.ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="stream-exec"
+            )
+            if workers > 0
+            else None
         )
 
     # -- health / metadata ---------------------------------------------------
@@ -486,15 +507,17 @@ class _Servicer:
                 return [msg]
             # Decoupled (or wire-data) path: return the lazy generator so
             # multi-response models stream token-by-token on the handler
-            # thread instead of being materialized in a pool worker.
-            return _stream_responses(request, cresp, want_final)
+            # thread instead of being materialized in a pool worker. Errors
+            # raised mid-generation fail THIS request (with its id echoed);
+            # the stream survives.
+            return _guard_stream(
+                _stream_responses(request, cresp, want_final), request.id
+            )
         except CoreError as e:
-            return [pb.ModelStreamInferResponse(error_message=str(e))]
+            return [_stream_error(str(e), request.id)]
         except Exception as e:  # mirror _infer_one's model-error wrapping:
             # a bug must fail THIS request, not tear down the stream.
-            return [pb.ModelStreamInferResponse(
-                error_message=f"inference failed: {e}"
-            )]
+            return [_stream_error(f"inference failed: {e}", request.id)]
 
     def _needs_serial(self, request) -> bool:
         """Sequence/stateful traffic must EXECUTE in stream order, not just
@@ -533,7 +556,7 @@ class _Servicer:
             inflight = []
             try:
                 for request in request_iterator:
-                    if self._needs_serial(request):
+                    if self._stream_pool is None or self._needs_serial(request):
                         for f in inflight:
                             f.exception()  # barrier: drain the pipeline
                         inflight = []
@@ -566,24 +589,9 @@ class _Servicer:
                 if item is None:
                     break
                 msgs = item.result() if hasattr(item, "result") else item
-                if isinstance(msgs, list):
-                    yield from msgs
-                else:
-                    # Lazy decoupled generator: a CoreError raised mid-
-                    # generation (e.g. a later response's shm region too
-                    # small) fails that request with an error response —
-                    # the stream, and every other in-flight request on it,
-                    # survives.
-                    try:
-                        yield from msgs
-                    except CoreError as e:
-                        yield pb.ModelStreamInferResponse(
-                            error_message=str(e)
-                        )
-                    except Exception as e:
-                        yield pb.ModelStreamInferResponse(
-                            error_message=f"inference failed: {e}"
-                        )
+                # Lists are prebuilt responses; generators arrive wrapped
+                # by _guard_stream, which converts mid-generation errors.
+                yield from msgs
         finally:
             stop.set()
 
@@ -600,6 +608,18 @@ def _finalize_unary(cresp) -> pb.ModelInferResponse:
             )
         cresp = responses[0]
     return core_to_response(cresp)
+
+
+def _guard_stream(gen, request_id: str):
+    """Convert mid-generation errors (e.g. a later response's shm region
+    too small) into per-request error responses — the stream, and every
+    other in-flight request on it, survives."""
+    try:
+        yield from gen
+    except CoreError as e:
+        yield _stream_error(str(e), request_id)
+    except Exception as e:
+        yield _stream_error(f"inference failed: {e}", request_id)
 
 
 def _want_final(request: pb.ModelInferRequest) -> bool:
@@ -718,15 +738,78 @@ class _AioServicer:
             await context.abort(_status_for(e), str(e))
 
     async def ModelStreamInfer(self, request_iterator, context):
+        import asyncio
+
+        # Per-stream hot-path caches, shared with the sync servicer's
+        # _process_stream_request so the two transports cannot diverge on
+        # the cached-parse/cached-response fast path.
+        cached_reqs: dict = {}
+        cached_resps: dict = {}
+        loop = asyncio.get_running_loop()
         async for request in request_iterator:
-            want_final = _want_final(request)
-            try:
-                creq = request_to_core(request, self.core)
-                cresp = await self._infer(creq)
-                for resp in _stream_responses(request, cresp, want_final):
-                    yield resp
-            except CoreError as e:
-                yield pb.ModelStreamInferResponse(error_message=str(e))
+            if self._is_blocking(request.model_name):
+                # Blocking decoupled models (gpt, gpt_engine) generate
+                # tokens with real waits (queue.get, device round-trips).
+                # Drain the generator in the executor and feed the loop
+                # through an asyncio.Queue — consuming it inline would
+                # stall every RPC on this transport for the whole
+                # generation (advisor r3).
+                q: "asyncio.Queue" = asyncio.Queue(maxsize=8)
+                _DONE = object()
+                dead = threading.Event()  # consumer gone; drain must bail
+
+                def _put(item) -> bool:
+                    try:
+                        fut = asyncio.run_coroutine_threadsafe(
+                            q.put(item), loop
+                        )
+                    except RuntimeError:  # loop closed
+                        return False
+                    while True:
+                        try:
+                            fut.result(timeout=1.0)
+                            return True
+                        except futures.TimeoutError:
+                            if dead.is_set():
+                                fut.cancel()
+                                return False
+                        except Exception:
+                            return False
+
+                def drain(req):
+                    try:
+                        msgs = self._sync._process_stream_request(
+                            req, cached_reqs, cached_resps
+                        )
+                        for msg in msgs:
+                            if not _put(msg):
+                                return  # closes msgs -> model sees cancel
+                    except Exception as e:
+                        _put(_stream_error(
+                            f"inference failed: {e}", req.id
+                        ))
+                    finally:
+                        _put(_DONE)
+
+                self._executor.submit(drain, request)
+                try:
+                    while True:
+                        item = await q.get()
+                        if item is _DONE:
+                            break
+                        yield item
+                finally:
+                    dead.set()
+                continue
+            # Non-blocking models: process inline on the loop. Handling is
+            # enqueue-only (core.infer dispatches async, shm outputs park
+            # un-materialized), so this is one thread hop fewer than the
+            # sync feeder/pool/yielder pipeline.
+            msgs = self._sync._process_stream_request(
+                request, cached_reqs, cached_resps
+            )
+            for msg in msgs:
+                yield msg  # _guard_stream converts generator errors
 
     def close(self):
         self._executor.shutdown(wait=False)

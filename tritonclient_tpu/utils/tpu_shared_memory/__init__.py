@@ -28,6 +28,7 @@ import json
 import math
 import os
 import threading
+import time
 import uuid as _uuid_mod
 from typing import Dict, List, Optional, Sequence
 
@@ -79,6 +80,251 @@ def _nbytes(arr) -> int:
     return math.prod(arr.shape) * arr.dtype.itemsize
 
 
+class BatchRowView:
+    """A row-slice view over a shared (dynamically batched) device array.
+
+    The server's dynamic batcher executes k requests as ONE device array;
+    parking per-member *views* instead of per-member device slices means
+    the whole batch is read back with a single device->host transfer (the
+    first reader materializes the base array — jax caches the host copy —
+    and every other member slices the cached numpy). On latency-bound
+    links a readback op costs ~0.8 ms host CPU regardless of size, so
+    this turns k transfers into one: the dominant serving-CPU term at
+    high concurrency (VERDICT r4 #3).
+    """
+
+    __slots__ = ("base", "start", "stop", "_shape", "_lock")
+
+    def __init__(self, base, start: int, stop: int, lock=None, shape=None):
+        self.base = base
+        self.start = int(start)
+        self.stop = int(stop)
+        # Explicit shape: the transfer coalescer bundles arbitrary same-
+        # dtype outputs as ONE flat base; each member view then reshapes
+        # its element range back to the original output shape.
+        self._shape = tuple(int(s) for s in shape) if shape is not None else None
+        # One lock per batch, shared by all members' views: concurrent
+        # first-readers would otherwise race the base materialization and
+        # pay the transfer twice.
+        self._lock = lock if lock is not None else threading.Lock()
+
+    @property
+    def shape(self):
+        if self._shape is not None:
+            return self._shape
+        return (self.stop - self.start,) + tuple(self.base.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.base.dtype
+
+    def materialize(self) -> np.ndarray:
+        """Host view of this member's rows; base transferred once."""
+        with self._lock:
+            host = np.asarray(self.base)
+        out = host[self.start : self.stop]
+        if self._shape is not None:
+            out = out.reshape(self._shape)
+        return out
+
+    def __array__(self, dtype=None, copy=None):
+        out = self.materialize()
+        if dtype is not None:
+            out = out.astype(dtype, copy=False)
+        return out
+
+    def device_slice(self):
+        """Lazy device-side slice for device consumers (no host hop)."""
+        out = self.base[self.start : self.stop]
+        if self._shape is not None:
+            out = out.reshape(self._shape)
+        return out
+
+    def copy_to_host_async(self):
+        try:
+            self.base.copy_to_host_async()
+        except AttributeError:
+            pass
+
+
+def _parked_host(arr) -> np.ndarray:
+    """Host bytes of a parked entry (array or BatchRowView)."""
+    if isinstance(arr, BatchRowView):
+        return arr.materialize()
+    return np.asarray(arr)
+
+
+class TransferCoalescer:
+    """Bundles freshly-parked output arrays into one device->host transfer.
+
+    On latency-bound links (the axon tunnel; any remote-PjRt setup) a
+    readback op costs ~0.8 ms host CPU *regardless of size*. A server
+    answering N concurrent requests pays that per response — the dominant
+    serving CPU term. This coalescer sits behind the server's output-park
+    path: each parked output is registered here; within ``max_wait`` (or
+    once ``max_bundle`` accumulate) same-dtype/shape outputs are raveled
+    and concatenated into ONE flat device array by a single jitted concat,
+    the bundle's d2h is warmed once, and every member's region entry is
+    atomically replaced by a ``BatchRowView`` over the bundle. Readers
+    then share one transfer (the first materializes; jax caches the host
+    copy).
+
+    Unlike the dynamic batcher this never delays dispatch or responses —
+    requests execute and answer individually; only the *transfer* is
+    bundled, after the fact. Singles just get their warm copy started.
+    """
+
+    def __init__(self, max_bundle: int = 8, max_wait_s: float = 0.002):
+        self.max_bundle = int(max_bundle)
+        self.max_wait_s = float(max_wait_s)
+        self._cv = threading.Condition()
+        self._pending: List[tuple] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        self._bundle_fn = None
+        # Effectiveness counters (observability; read by perf probes).
+        self.stats = {
+            "bundles": 0, "bundled_members": 0, "singles": 0,
+            "cas_ok": 0, "cas_miss": 0, "overflow": 0, "errors": 0,
+        }
+
+    def submit(self, region: "TpuSharedMemoryRegion", offset: int, arr):
+        with self._cv:
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="tpu-shm-coalescer"
+                )
+                self._thread.start()
+            if len(self._pending) >= 64:
+                # Backpressure (e.g. a first-use XLA compile stalling the
+                # flush thread): fall back to the direct warm copy.
+                self.stats["overflow"] += 1
+                try:
+                    arr.copy_to_host_async()
+                except AttributeError:
+                    pass
+                return
+            self._pending.append((region, offset, arr, time.monotonic()))
+            # Always wake the flush thread: it re-checks age/size and
+            # sleeps out the remainder of the bundling window itself.
+            self._cv.notify()
+
+    def _run(self):
+        while True:
+            with self._cv:
+                while not self._pending and not self._stop:
+                    self._cv.wait(timeout=0.5)
+                if self._stop:
+                    return
+                # Hold the bundle open until it fills or the oldest entry
+                # ages out of the window.
+                while self._pending and len(self._pending) < self.max_bundle:
+                    remaining = self.max_wait_s - (
+                        time.monotonic() - self._pending[0][3]
+                    )
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(timeout=remaining)
+                batch = self._pending[: self.max_bundle]
+                del self._pending[: len(batch)]
+            if batch:
+                self._flush(batch)
+
+    def _flush(self, batch):
+        groups: Dict[tuple, list] = {}
+        for item in batch:
+            arr = item[2]
+            groups.setdefault(
+                (str(arr.dtype), tuple(arr.shape)), []
+            ).append(item)
+        for (_, shp), items in groups.items():
+            if len(items) == 1:
+                self.stats["singles"] += 1
+                try:
+                    items[0][2].copy_to_host_async()
+                except AttributeError:
+                    pass
+                continue
+            k = len(items)
+            kb = 1 << (k - 1).bit_length()  # pow2 arity: O(log) compiles
+            arrs = [it[2] for it in items]
+            arrs += [arrs[-1]] * (kb - k)
+            try:
+                bundle = self._bundle(*arrs)
+                bundle.copy_to_host_async()
+            except Exception:
+                # Defensive: bundling is an optimization — on any failure
+                # the originals stay parked and get their own warm copies.
+                self.stats["errors"] += 1
+                for it in items:
+                    try:
+                        it[2].copy_to_host_async()
+                    except AttributeError:
+                        pass
+                continue
+            self.stats["bundles"] += 1
+            self.stats["bundled_members"] += k
+            n = math.prod(shp)
+            lock = threading.Lock()
+            for i, (region, offset, arr, _) in enumerate(items):
+                view = BatchRowView(
+                    bundle, i * n, (i + 1) * n, lock, shape=shp
+                )
+                if region._replace_parked(offset, arr, view):
+                    self.stats["cas_ok"] += 1
+                else:
+                    self.stats["cas_miss"] += 1
+
+    def _bundle(self, *arrs):
+        if self._bundle_fn is None:
+            import jax
+            import jax.numpy as jnp
+
+            self._bundle_fn = jax.jit(
+                lambda *xs: jnp.concatenate([x.ravel() for x in xs])
+            )
+        return self._bundle_fn(*arrs)
+
+    def warm(self, shape, dtype, device_id: int = 0, ks=(2, 4, 8)):
+        """Pre-compile the concat ladder for an output shape so no serving
+        window pays a first-use XLA compile (multi-second on remote-compile
+        links)."""
+        import jax
+        import jax.numpy as jnp
+
+        dev = _jax().devices()[device_id]
+        z = jax.device_put(jnp.zeros(shape, dtype), dev)
+        for k in ks:
+            if k <= self.max_bundle:
+                jax.block_until_ready(self._bundle(*([z] * k)))
+
+
+_coalescer: Optional[TransferCoalescer] = None
+
+
+def transfer_coalescer() -> Optional[TransferCoalescer]:
+    """Process-wide coalescer, or None when disabled (the default).
+
+    ``TPU_TRANSFER_COALESCE=1`` enables it; ``TPU_TRANSFER_COALESCE_US``
+    tunes the bundling window. Off by default: measured on the axon
+    tunnel, merging transfers saves ~0.6 ms host CPU per bundled response
+    but surrenders the link's internal transfer parallelism (many small
+    d2h ops overlap; one late bundle does not), which nets out slower
+    unless the host is CPU-saturated. Deployments whose serving host is
+    CPU-bound (many models, small outputs) can flip it on.
+    """
+    global _coalescer
+    if os.environ.get("TPU_TRANSFER_COALESCE", "0") != "1":
+        return None
+    if _coalescer is None:
+        _coalescer = TransferCoalescer(
+            max_wait_s=int(
+                os.environ.get("TPU_TRANSFER_COALESCE_US", "2000")
+            ) / 1e6
+        )
+    return _coalescer
+
+
 class TpuSharedMemoryRegion:
     """One named reservation on a TPU device holding parked jax.Arrays."""
 
@@ -123,10 +369,19 @@ class TpuSharedMemoryRegion:
             an = _nbytes(arr)
             if off < offset + nbytes and offset < off + an:
                 if off < offset or off + an > offset + nbytes:
-                    self._mirror[off : off + an] = np.asarray(arr).tobytes()
+                    self._mirror[off : off + an] = _parked_host(arr).tobytes()
                 del self._parked[off]
 
     # -- typed (zero-copy) plane --------------------------------------------
+
+    def _park_view(self, view: "BatchRowView", offset: int):
+        """Park a batched-output view: pure bookkeeping — the base array
+        stays shared with its batchmates' regions."""
+        an = _nbytes(view)
+        self._check_range(offset, an)
+        with self._lock:
+            self._drop_overlapping(offset, an)
+            self._parked[offset] = view
 
     def set_array(self, array, offset: int = 0, block: bool = True):
         """Park a device array at ``offset`` (the zero-copy set path).
@@ -138,6 +393,8 @@ class TpuSharedMemoryRegion:
         the (possibly still-computing) result buffer, and readers block
         when they materialize it.
         """
+        if isinstance(array, BatchRowView):
+            return self._park_view(array, offset)
         jax = _jax()
         if isinstance(array, jax.Array) and array.devices() == {self.device}:
             arr = array  # already resident — parking is pure bookkeeping
@@ -175,9 +432,14 @@ class TpuSharedMemoryRegion:
         with self._lock:
             parked = self._parked.get(offset)
             if parked is not None and _nbytes(parked) == nbytes:
-                if parked.dtype == np_dtype and parked.shape == shape:
+                if isinstance(parked, BatchRowView):
+                    if parked.dtype == np_dtype and parked.shape == shape:
+                        return parked.device_slice()
+                    # Reinterpretation: gather through the mirror below.
+                elif parked.dtype == np_dtype and parked.shape == shape:
                     return parked
-                return parked.view(np_dtype).reshape(shape)
+                else:
+                    return parked.view(np_dtype).reshape(shape)
         host = np.frombuffer(
             self.read_bytes(offset, nbytes), dtype=np_dtype
         ).reshape(shape)
@@ -188,6 +450,17 @@ class TpuSharedMemoryRegion:
             self._drop_overlapping(offset, nbytes)
             self._parked[offset] = arr
         return arr
+
+    def _replace_parked(self, offset: int, old, new):
+        """CAS a parked entry (transfer coalescer: original -> bundle view).
+
+        Only swaps when ``old`` is still the live entry — a racing writer
+        or reader-side repark wins and the bundle view is dropped."""
+        with self._lock:
+            if self._parked.get(offset) is old:
+                self._parked[offset] = new
+                return True
+        return False
 
     def read_typed(self, datatype: str, shape: Sequence[int],
                    offset: int = 0) -> np.ndarray:
@@ -302,6 +575,8 @@ class TpuShardedMemoryRegion(TpuSharedMemoryRegion):
 
     def set_array(self, array, offset: int = 0, block: bool = True):
         """Park an array sharded over the mesh (host or device producer)."""
+        if isinstance(array, BatchRowView):
+            return self._park_view(array, offset)
         jax = _jax()
         if isinstance(array, jax.Array) and array.sharding == self.sharding:
             arr = array  # already laid out — parking is pure bookkeeping
