@@ -1,0 +1,96 @@
+"""LLM serving-plane benchmark artifact (VERDICT r3 #6).
+
+Drives the continuous-batching engine (models/gpt_engine.py) through the
+full gRPC streaming stack with the genai_perf instrument and writes
+GENAI_r{N}.json at the repo root: TTFT/ITL percentiles and token
+throughput at concurrency {1, 4, 8}, plus the single-loop GptModel at
+c=8 as the non-batched comparator (the engine's ~Nx token-throughput
+claim, recorded instead of asserted).
+
+Run on the TPU:  python scripts/genai_bench.py [round_number]
+"""
+
+import json
+import os
+import sys
+
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.setswitchinterval(0.0002)
+
+
+def main():
+    rnd = sys.argv[1] if len(sys.argv) > 1 else os.environ.get("ROUND", "04")
+    interval = float(os.environ.get("GENAI_SECONDS", "10"))
+    out_tokens = int(os.environ.get("GENAI_OUTPUT_TOKENS", "16"))
+
+    import jax
+
+    from tritonclient_tpu.genai_perf import GenAIPerf
+    from tritonclient_tpu.models.gpt import GptModel
+    from tritonclient_tpu.models.gpt_engine import GptEngineModel
+    from tritonclient_tpu.server import InferenceServer
+
+    engine_model = GptEngineModel()
+    loop_model = GptModel()
+    engine_model.warmup()
+    loop_model.warmup()
+
+    result = {
+        "round": rnd,
+        "platform": jax.devices()[0].platform,
+        "output_tokens": out_tokens,
+        "engine": {},  # gpt_engine: continuous batching over the slot bank
+        "single_loop_c8": None,  # GptModel: one generation loop per request
+    }
+    with InferenceServer(models=[engine_model, loop_model], http=False) as server:
+        for model_name, levels, key in (
+            ("gpt_engine", (1, 4, 8), "engine"),
+            ("gpt", (8,), "single_loop_c8"),
+        ):
+            perf = GenAIPerf(
+                server.grpc_address,
+                model_name=model_name,
+                input_tokens=32,
+                output_tokens=out_tokens,
+                vocab_size=engine_model.cfg.vocab_size,
+                measurement_interval_s=interval,
+                warmup_s=2.0,
+            )
+            for c in levels:
+                summary = perf.measure(c)
+                keep = {
+                    "concurrency": c,
+                    "requests": summary["requests"],
+                    "errors": summary["errors"],
+                    "output_token_throughput_per_sec": summary[
+                        "output_token_throughput_per_sec"
+                    ],
+                    "ttft_ms": summary["time_to_first_token"],
+                    "itl_ms": summary["inter_token_latency"],
+                }
+                if key == "engine":
+                    result["engine"][f"c{c}"] = keep
+                else:
+                    result[key] = keep
+                print(f"{model_name} c{c}: "
+                      f"{keep['output_token_throughput_per_sec']} tok/s, "
+                      f"ttft p99 {keep['ttft_ms'].get('p99_ms')} ms",
+                      file=sys.stderr)
+    eng8 = result["engine"].get("c8", {})
+    single = result["single_loop_c8"] or {}
+    if single.get("output_token_throughput_per_sec"):
+        result["engine_speedup_c8"] = round(
+            eng8.get("output_token_throughput_per_sec", 0)
+            / single["output_token_throughput_per_sec"], 2
+        )
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        f"GENAI_r{rnd}.json",
+    )
+    with open(path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

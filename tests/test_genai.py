@@ -227,7 +227,7 @@ class TestContinuousBatching:
         results = [None] * len(prompts)
 
         def consume(i):
-            q = engine.submit(prompts[i], max_news[i])
+            q = engine.submit(prompts[i], max_news[i]).out
             toks = []
             while True:
                 t = q.get(timeout=120)
@@ -283,7 +283,7 @@ class TestContinuousBatching:
         cfg = gpt.gpt_tiny(max_len=32)
         params = gpt.init_params(jax.random.PRNGKey(0), cfg)
         engine = GenerationEngine(cfg, params, max_slots=1)
-        qs = [engine.submit(np.array([[1, 2]], np.int32), 4)
+        qs = [engine.submit(np.array([[1, 2]], np.int32), 4).out
               for _ in range(3)]
         engine.shutdown()
         # Every stream ends (tokens then None) within the join budget;
@@ -349,7 +349,7 @@ class TestSampling:
                 params, p, m, cfg, temperature=temp, top_k=tk, seed=sd)]
             for p, m, temp, tk, sd in jobs
         ]
-        qs = [engine.submit(p, m, temperature=temp, top_k=tk, seed=sd)
+        qs = [engine.submit(p, m, temperature=temp, top_k=tk, seed=sd).out
               for p, m, temp, tk, sd in jobs]
         got = []
         for q in qs:
@@ -421,7 +421,7 @@ def test_int64_and_negative_seeds_consistent_across_paths():
         ref = [int(t[0]) for t in gpt.generate_tokens(
             params, prompt, 5, cfg, temperature=1.0, top_k=8, seed=seed)]
         engine = GenerationEngine(cfg, params, max_slots=2)
-        q = engine.submit(prompt, 5, temperature=1.0, top_k=8, seed=seed)
+        q = engine.submit(prompt, 5, temperature=1.0, top_k=8, seed=seed).out
         got = []
         while True:
             t = q.get(timeout=60)
@@ -445,3 +445,47 @@ def test_sampled_requests_without_seed_vary():
     assert len(seen) > 1
     # greedy default keeps the stable seed 0
     assert sampling_inputs({})[2] == 0
+
+
+class TestEngineCancellation:
+    def test_consumer_close_releases_slot(self):
+        """Closing the decoupled generator mid-generation (client
+        disconnect) marks the request cancelled so the engine frees the
+        slot instead of generating dead tokens to max_new."""
+        from tritonclient_tpu.models.gpt_engine import GptEngineModel
+
+        model = GptEngineModel(cfg=gpt.gpt_tiny(max_len=64), max_slots=2)
+        gen = model.infer(
+            {"INPUT_IDS": np.array([[3, 1, 4]], np.int32),
+             "MAX_TOKENS": np.array([40], np.int32)}
+        )
+        first = next(gen)
+        assert first["OUTPUT_IDS"].shape == (1,)
+        req = model.engine._slot_req[
+            next(i for i, r in enumerate(model.engine._slot_req)
+                 if r is not None)
+        ]
+        gen.close()  # transport went away
+        assert req.cancelled
+        # The slot frees promptly (well before 40 tokens' worth of work):
+        # a fresh 2-slot engine admits two new requests immediately.
+        import time as _time
+
+        deadline = _time.time() + 30
+        while _time.time() < deadline:
+            if all(r is None or r.cancelled
+                   for r in model.engine._slot_req):
+                break
+            _time.sleep(0.05)
+        outs = [model.engine.submit(np.array([[7, 7]], np.int32), 2).out
+                for _ in range(2)]
+        for q in outs:
+            toks = []
+            while True:
+                t = q.get(timeout=60)
+                if t is None:
+                    break
+                assert not isinstance(t, BaseException)
+                toks.append(t)
+            assert len(toks) == 2
+        model.engine.shutdown()

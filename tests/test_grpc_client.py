@@ -193,10 +193,13 @@ class TestStreaming:
         inp = grpcclient.InferInput("INPUT0", [1, 16], "INT32").set_data_from_numpy(
             np.zeros((1, 16), np.int32)
         )
-        client.async_stream_infer("nonexistent_model", [inp])
+        client.async_stream_infer("nonexistent_model", [inp], request_id="req-7")
         result, error = results.get(timeout=10)
         assert result is None
         assert "unknown model" in error.message()
+        # The server echoes the failed request's id so multiplexed
+        # consumers can attribute the error without ordering assumptions.
+        assert error.request_id() == "req-7"
         client.stop_stream()
 
     def test_double_start_rejected(self, client):

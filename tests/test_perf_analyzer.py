@@ -249,3 +249,41 @@ def test_native_driver_off_gil(server):
 
     rows = _json.loads(proc.stdout)
     assert rows and rows[0]["errors"] == 0
+
+
+def test_stream_mux_error_attribution_by_id():
+    """Errors route to the request named by the echoed id — even out of
+    order — and id-less errors fall back to oldest-in-flight (the only
+    sound rule for strictly in-order backends)."""
+    import threading
+
+    from tritonclient_tpu.perf_analyzer._analyzer import _StreamMux
+    from tritonclient_tpu.utils import InferenceServerException
+
+    class _FakeStream:
+        _active = True
+
+    class _FakeClient:
+        _stream = _FakeStream()
+
+    mux = _StreamMux.__new__(_StreamMux)
+    mux.client = _FakeClient()
+    mux._queues = {}
+    mux._inflight = []
+    mux._lock = threading.Lock()
+    mux._started = True
+    q1, q2 = mux.register(1), mux.register(2)
+    mux.submit("w1", lambda: None)
+    mux.submit("w2", lambda: None)
+
+    # A decoupled backend answers w2's error FIRST (out of order).
+    err = InferenceServerException(msg="boom", request_id="w2")
+    mux._on_response(None, err)
+    assert q2.get_nowait()[1] is err
+    assert mux._inflight == ["w1"]
+
+    # Id-less error: oldest in flight.
+    err2 = InferenceServerException(msg="anon")
+    mux._on_response(None, err2)
+    assert q1.get_nowait()[1] is err2
+    assert mux._inflight == []

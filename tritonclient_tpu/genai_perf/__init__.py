@@ -49,6 +49,9 @@ class _Worker:
         self.tokens = 0
         self.requests = 0
         self.errors = 0
+        # Samples from requests sent before this cut are discarded
+        # (set to the window start at the warmup boundary).
+        self._window_start_ns = 0
         self._stop = threading.Event()
         rng = np.random.default_rng(4321 + wid)
         self.prompts = [
@@ -118,10 +121,14 @@ class _Worker:
                 final = bool(p and p.bool_param)
                 if response.outputs:
                     n_tokens += 1
-                    if t_prev is None:
-                        self.ttft_ns.append(t_recv - t_send)
-                    else:
-                        self.itl_ns.append(t_recv - t_prev)
+                    # Samples whose request was SENT before the warmup cut
+                    # are discarded (their ttft/latency include pre-window
+                    # time and would overcount requests/duration).
+                    if t_send >= self._window_start_ns:
+                        if t_prev is None:
+                            self.ttft_ns.append(t_recv - t_send)
+                        else:
+                            self.itl_ns.append(t_recv - t_prev)
                     t_prev = t_recv
                 if final:
                     break
@@ -129,9 +136,10 @@ class _Worker:
                 self.errors += 1
                 self._reset_stream()
                 continue
-            self.latency_ns.append(time.perf_counter_ns() - t_send)
-            self.tokens += n_tokens
-            self.requests += 1
+            if t_send >= self._window_start_ns:
+                self.latency_ns.append(time.perf_counter_ns() - t_send)
+                self.tokens += n_tokens
+                self.requests += 1
 
     def teardown(self):
         try:
@@ -181,7 +189,15 @@ class GenAIPerf:
             for t in threads:
                 t.start()
             time.sleep(self.warmup_s)
-            # Discard warmup samples (first-compile, stream setup).
+            # Discard warmup samples (first-compile, stream setup). The
+            # send-time cut also drops each worker's straddling request —
+            # its latency would include pre-window time.
+            cut = time.perf_counter_ns()
+            # Two passes: every worker must see the cut BEFORE any list is
+            # cleared, or a request completing in the gap records a valid
+            # in-window sample that the clear then discards.
+            for w in workers:
+                w._window_start_ns = cut
             for w in workers:
                 w.ttft_ns.clear()
                 w.itl_ns.clear()

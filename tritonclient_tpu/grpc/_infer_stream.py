@@ -66,7 +66,13 @@ class _InferStream:
         try:
             for response in self._response_iterator:
                 if response.error_message:
-                    error = InferenceServerException(msg=response.error_message)
+                    error = InferenceServerException(
+                        msg=response.error_message,
+                        # Servers that echo the failed request's id in the
+                        # (otherwise-empty) infer_response let consumers
+                        # attribute errors without ordering assumptions.
+                        request_id=response.infer_response.id,
+                    )
                     self._callback(result=None, error=error)
                 else:
                     result = InferResult(response.infer_response)

@@ -226,10 +226,12 @@ class GenerationEngine:
 
     def submit(self, prompt: np.ndarray, max_new: int,
                temperature: float = 0.0, top_k: int = 0,
-               seed: int = 0) -> "queue.Queue":
-        """Queue a generation; returns the token queue (np [1] per token,
-        then None). Greedy by default; temperature/top_k/seed follow the
-        shared sampling key schedule (gpt.sampling_key)."""
+               seed: int = 0) -> "_Request":
+        """Queue a generation; returns the _Request whose ``.out`` queue
+        yields np [1] per token, then None. Setting ``.cancelled`` frees
+        the slot at the engine's next loop top. Greedy by default;
+        temperature/top_k/seed follow the shared sampling key schedule
+        (gpt.sampling_key)."""
         if prompt.shape[1] >= self.cfg.max_len:
             raise ValueError(
                 f"prompt length {prompt.shape[1]} must be < max_len "
@@ -255,7 +257,7 @@ class GenerationEngine:
                 )
                 self._thread.start()
             self._cv.notify_all()
-        return req.out
+        return req
 
     # -- engine loop ---------------------------------------------------------
 
@@ -458,21 +460,31 @@ class GptEngineModel(Model):
         if "MAX_TOKENS" in inputs:
             max_new = int(np.asarray(inputs["MAX_TOKENS"]).flatten()[0])
         temperature, top_k, gen_seed = sampling_inputs(inputs)
-        out = self.engine.submit(prompt, max_new, temperature=temperature,
-                                 top_k=top_k, seed=gen_seed)
-
         def gen():
-            while True:
-                token = out.get(timeout=300)
-                if token is None:
-                    return
-                if isinstance(token, BaseException):
-                    raise token
-                yield {"OUTPUT_IDS": token}
+            # Admission happens on FIRST consumption (not at infer()):
+            # a transport that abandons the response generator before
+            # ever starting it (pipelined requests + client disconnect)
+            # then never occupies a slot at all. The finally hook covers
+            # the started case: GeneratorExit on the draining transport
+            # marks the request cancelled so the engine frees the slot
+            # instead of generating dead tokens to max_new (advisor r3).
+            req = self.engine.submit(prompt, max_new,
+                                     temperature=temperature,
+                                     top_k=top_k, seed=gen_seed)
+            try:
+                while True:
+                    token = req.out.get(timeout=300)
+                    if token is None:
+                        return
+                    if isinstance(token, BaseException):
+                        raise token
+                    yield {"OUTPUT_IDS": token}
+            finally:
+                req.cancelled = True
 
         return gen()
 
     def warmup(self):
-        q = self.engine.submit(np.zeros((1, 8), np.int32), 2)
+        q = self.engine.submit(np.zeros((1, 8), np.int32), 2).out
         while q.get(timeout=300) is not None:
             pass

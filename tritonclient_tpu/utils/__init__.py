@@ -34,10 +34,15 @@ class InferenceServerException(Exception):
     optional protocol status string, and optional debug details.
     """
 
-    def __init__(self, msg: str, status: Optional[str] = None, debug_details=None):
+    def __init__(self, msg: str, status: Optional[str] = None, debug_details=None,
+                 request_id: str = ""):
         self._msg = msg
         self._status = status
         self._debug_details = debug_details
+        # Stream error responses echo the failed request's id (when the
+        # server provides it) so multiplexed consumers can attribute the
+        # error without relying on response ordering.
+        self._request_id = request_id
         super().__init__(msg)
 
     def __str__(self):
@@ -54,6 +59,10 @@ class InferenceServerException(Exception):
 
     def debug_details(self):
         return self._debug_details
+
+    def request_id(self):
+        """Id of the request this error answers ('' when unknown)."""
+        return self._request_id
 
 
 def raise_error(msg):
