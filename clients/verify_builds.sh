@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# Build-verify every non-Python client tier with its native toolchain.
+#
+# The hermetic CI image ships no JDK/Go/Node, so tests/test_java_client.py,
+# tests/test_stub_clients.py and tests/test_lang_structure.py fall back to
+# structural checks there; THIS script is the executable counterpart for
+# any machine that has the toolchains (reference analog: the Maven build
+# of src/java + the grpc-codegen clients). Each step is the one-liner a
+# release pipeline would run; the script exits non-zero on the first
+# failure and prints a per-tier PASS/SKIP summary.
+#
+#   ./clients/verify_builds.sh          # verify whatever toolchains exist
+#   STRICT=1 ./clients/verify_builds.sh # missing toolchain = failure
+
+set -u
+cd "$(dirname "$0")"
+declare -a summary
+fail=0
+
+run_tier() { # name, tool, command...
+    local name="$1" tool="$2"
+    shift 2
+    if ! command -v "$tool" >/dev/null 2>&1; then
+        summary+=("SKIP $name (no $tool)")
+        if [ "${STRICT:-0}" = "1" ]; then fail=1; fi
+        return
+    fi
+    if "$@"; then
+        summary+=("PASS $name")
+    else
+        summary+=("FAIL $name")
+        fail=1
+    fi
+}
+
+# Java HTTP client library + examples (dependency-free; pure javac would
+# do, but the pom is the shipping artifact).
+run_tier "java/library (mvn package)" mvn \
+    mvn -q -f java/pom.xml -DskipTests package
+
+# Java FFM (Panama) bindings over the flat C ABI: compile-check; running
+# needs libtpuclient_capi.so on java.library.path (see its README).
+run_tier "java-api-bindings (javac --release 21)" javac \
+    bash -c 'javac --release 21 --enable-preview -d /tmp/tpu_ffm_build \
+        $(find java-api-bindings/src -name "*.java")'
+
+# Go gRPC client: stub generation is gen_go_stubs.sh (needs protoc-gen-go);
+# vet+build verifies the committed client against the committed stubs.
+run_tier "go client (go build)" go \
+    bash -c 'cd go && go vet ./... && go build ./...'
+
+# JavaScript client: syntax + module resolution.
+run_tier "javascript client (node --check)" node \
+    node --check javascript/client.js
+
+printf '%s\n' "${summary[@]}"
+exit "$fail"
