@@ -40,9 +40,15 @@ run_tier "java/library (mvn package)" mvn \
 
 # Java FFM (Panama) bindings over the flat C ABI: compile-check; running
 # needs libtpuclient_capi.so on java.library.path (see its README).
-run_tier "java-api-bindings (javac --release 21)" javac \
-    bash -c 'javac --release 21 --enable-preview -d /tmp/tpu_ffm_build \
-        $(find java-api-bindings/src -name "*.java")'
+# java.lang.foreign is preview in JDK 21 and final in 22+, and javac
+# rejects --enable-preview for any --release below the JDK's own feature
+# version — pick flags by the installed version.
+run_tier "java-api-bindings (javac)" javac \
+    bash -c 'ver=$(javac -version 2>&1 | sed "s/[^0-9]*\([0-9]*\).*/\1/");
+        if [ "${ver:-0}" -ge 22 ]; then flags=""; \
+        else flags="--release 21 --enable-preview"; fi;
+        javac $flags -d /tmp/tpu_ffm_build \
+            $(find java-api-bindings/src -name "*.java")'
 
 # Go gRPC client: stub generation is gen_go_stubs.sh (needs protoc-gen-go);
 # vet+build verifies the committed client against the committed stubs.

@@ -287,3 +287,23 @@ def test_stream_mux_error_attribution_by_id():
     mux._on_response(None, err2)
     assert q1.get_nowait()[1] is err2
     assert mux._inflight == []
+
+
+def test_write_once_mode(server):
+    """Reference --shared-memory semantics: regions written once at setup,
+    requests only reference them; sweep completes clean."""
+    analyzer = _make(server, shared_memory="tpu", streaming=True,
+                     read_outputs=True, write_once=True)
+    summary = analyzer.measure(3).summary()
+    assert summary["errors"] == 0 and summary["count"] > 0
+
+
+def test_device_direct_region_set(server, monkeypatch):
+    """PA_DEVICE_SET=1 parks device uploads at send time; results stay
+    correct through the zero-copy resolve path."""
+    monkeypatch.setenv("PA_DEVICE_SET", "1")
+    analyzer = _make(server, shared_memory="tpu", streaming=True,
+                     read_outputs=True)
+    assert analyzer.device_set
+    summary = analyzer.measure(2).summary()
+    assert summary["errors"] == 0 and summary["count"] > 0

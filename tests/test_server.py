@@ -607,3 +607,55 @@ def test_prometheus_metrics_endpoint(base):
     )
     assert m and int(m.group(1)) >= 1
     assert 'nv_inference_count{model="simple_string"' in text
+
+
+class TestBatchQueueDelay:
+    def test_pressure_gated_delay_fills_batches(self, monkeypatch):
+        """With max_queue_delay set and 3+ concurrent compatible requests,
+        the leader holds the batch open and the formed batches amortize
+        executions (execution_count well below inference_count)."""
+        import threading
+
+        monkeypatch.setenv("TPU_SERVER_DYNAMIC_BATCH", "1")
+        monkeypatch.setenv("TPU_SERVER_BATCH_DELAY_US", "30000")
+        from tritonclient_tpu.models.simple import SimpleModel
+        from tritonclient_tpu.server._core import (
+            CoreRequest,
+            CoreTensor,
+            InferenceCore,
+        )
+
+        core = InferenceCore(models=[SimpleModel()])
+
+        def req():
+            x = np.random.randint(0, 50, (1, 16)).astype(np.int32)
+            return CoreRequest(
+                model_name="simple",
+                inputs=[
+                    CoreTensor("INPUT0", "INT32", [1, 16], data=x),
+                    CoreTensor("INPUT1", "INT32", [1, 16], data=x),
+                ],
+            )
+
+        results = []
+        lock = threading.Lock()
+
+        def run_n(n):
+            for _ in range(n):
+                r = core.infer(req())
+                with lock:
+                    results.append(r)
+
+        threads = [threading.Thread(target=run_n, args=(4,)) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stats = core.model_statistics("simple")[0]
+        assert stats["inference_count"] == 24
+        # 6 concurrent closed loops with a 30 ms hold: batches must form.
+        assert stats["execution_count"] < 20, stats["execution_count"]
+        # Batcher wait is accounted as queue time (Triton semantics).
+        assert stats["inference_stats"]["queue"]["ns"] > 0
+        for r in results:
+            assert r.outputs

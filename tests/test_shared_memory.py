@@ -441,3 +441,124 @@ def test_tpu_shm_bf16_staging_roundtrip():
         )
     finally:
         tpushm.destroy_shared_memory_region(region)
+
+
+class TestBatchRowView:
+    def test_row_views_share_one_materialization(self):
+        import jax.numpy as jnp
+
+        import tritonclient_tpu.utils.tpu_shared_memory as tpushm
+
+        base = jnp.arange(24, dtype=jnp.float32).reshape(6, 4)
+        regions = [
+            tpushm.create_shared_memory_region(f"brv{i}", 2 * 4 * 4)
+            for i in range(3)
+        ]
+        try:
+            import threading
+
+            lock = threading.Lock()
+            for i, region in enumerate(regions):
+                view = tpushm.BatchRowView(base, 2 * i, 2 * i + 2, lock)
+                region.set_array(view, 0, block=False)
+            for i, region in enumerate(regions):
+                got = tpushm.get_contents_as_numpy(region, "FP32", (2, 4), 0)
+                np.testing.assert_array_equal(
+                    got, np.arange(24, dtype=np.float32).reshape(6, 4)[
+                        2 * i : 2 * i + 2]
+                )
+        finally:
+            for region in regions:
+                tpushm.destroy_shared_memory_region(region)
+
+    def test_flat_view_reshapes(self):
+        import jax.numpy as jnp
+
+        import tritonclient_tpu.utils.tpu_shared_memory as tpushm
+
+        flat = jnp.arange(12, dtype=jnp.int32)
+        region = tpushm.create_shared_memory_region("brvflat", 6 * 4)
+        try:
+            view = tpushm.BatchRowView(flat, 6, 12, shape=(2, 3))
+            region.set_array(view, 0, block=False)
+            got = tpushm.get_contents_as_numpy(region, "INT32", (2, 3), 0)
+            np.testing.assert_array_equal(
+                got, np.arange(6, 12, dtype=np.int32).reshape(2, 3)
+            )
+            # Raw byte reads flush the view through the mirror correctly.
+            raw = region.read_bytes(0, 6 * 4)
+            np.testing.assert_array_equal(
+                np.frombuffer(raw, np.int32),
+                np.arange(6, 12, dtype=np.int32),
+            )
+        finally:
+            tpushm.destroy_shared_memory_region(region)
+
+
+class TestTransferCoalescer:
+    def test_bundles_replace_parked_entries(self):
+        import jax.numpy as jnp
+
+        import tritonclient_tpu.utils.tpu_shared_memory as tpushm
+
+        co = tpushm.TransferCoalescer(max_bundle=4, max_wait_s=0.02)
+        regions = [
+            tpushm.create_shared_memory_region(f"co{i}", 4 * 4)
+            for i in range(4)
+        ]
+        try:
+            arrs = [
+                jnp.full((4,), i, dtype=jnp.float32) for i in range(4)
+            ]
+            for region, arr in zip(regions, arrs):
+                region.set_array(arr, 0, block=False)
+                co.submit(region, 0, arr)
+            import time
+
+            deadline = time.time() + 5
+            while time.time() < deadline and co.stats["bundles"] == 0:
+                time.sleep(0.01)
+            assert co.stats["bundles"] == 1, co.stats
+            assert co.stats["cas_ok"] == 4, co.stats
+            for i, region in enumerate(regions):
+                assert isinstance(
+                    region._parked[0], tpushm.BatchRowView
+                )
+                got = tpushm.get_contents_as_numpy(region, "FP32", (4,), 0)
+                np.testing.assert_array_equal(
+                    got, np.full((4,), i, np.float32)
+                )
+        finally:
+            for region in regions:
+                tpushm.destroy_shared_memory_region(region)
+
+    def test_cas_miss_on_overwritten_entry(self):
+        import jax.numpy as jnp
+
+        import tritonclient_tpu.utils.tpu_shared_memory as tpushm
+
+        co = tpushm.TransferCoalescer(max_bundle=2, max_wait_s=5.0)
+        r1 = tpushm.create_shared_memory_region("cas1", 4 * 4)
+        r2 = tpushm.create_shared_memory_region("cas2", 4 * 4)
+        try:
+            a1 = jnp.zeros((4,), jnp.float32)
+            a2 = jnp.ones((4,), jnp.float32)
+            r1.set_array(a1, 0, block=False)
+            r2.set_array(a2, 0, block=False)
+            co.submit(r1, 0, a1)
+            # r1 is overwritten before the bundle flushes: the CAS must
+            # leave the newer entry alone.
+            newer = jnp.full((4,), 7, jnp.float32)
+            r1.set_array(newer, 0, block=False)
+            co.submit(r2, 0, a2)  # fills the bundle -> flush
+            import time
+
+            deadline = time.time() + 5
+            while time.time() < deadline and co.stats["bundles"] == 0:
+                time.sleep(0.01)
+            assert co.stats["cas_miss"] == 1, co.stats
+            got = tpushm.get_contents_as_numpy(r1, "FP32", (4,), 0)
+            np.testing.assert_array_equal(got, np.full((4,), 7, np.float32))
+        finally:
+            tpushm.destroy_shared_memory_region(r1)
+            tpushm.destroy_shared_memory_region(r2)
