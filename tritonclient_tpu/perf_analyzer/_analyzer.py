@@ -161,10 +161,18 @@ class _Worker:
     """One closed-loop requester; owns its client(s) and shm regions."""
 
     def __init__(self, analyzer: "PerfAnalyzer", wid: int,
-                 mux: Optional[_StreamMux] = None):
+                 mux: Optional[_StreamMux] = None, tag: str = ""):
         self.analyzer = analyzer
         self.wid = wid
         self.mux = mux
+        # Region tag: multiple live sessions (e.g. an interleaved
+        # multi-depth sweep) share one server, whose shm registries are
+        # name-keyed — per-session tags keep names AND the system-shm
+        # POSIX keys disjoint (an untagged key would silently attach to
+        # the other session's OS object: O_CREAT without O_EXCL).
+        self._tag = tag
+        self._in_name = f"pa{tag}_in_{wid}"
+        self._out_name = f"pa{tag}_out_{wid}"
         self.stat = InferStat()
         self.latencies: List[int] = []
         self.errors = 0
@@ -199,37 +207,37 @@ class _Worker:
         if mode == "system":
             import tritonclient_tpu.utils.shared_memory as shm
 
-            key = f"/pa_{a.run_id}_{self.wid}"
+            key = f"/pa_{a.run_id}{self._tag}_{self.wid}"
             self._shm = shm
             self._in_region = shm.create_shared_memory_region(
-                f"pa_in_{self.wid}", key + "_in", total_in
+                self._in_name, key + "_in", total_in
             )
             if total_out:
                 self._out_region = shm.create_shared_memory_region(
-                    f"pa_out_{self.wid}", key + "_out", total_out
+                    self._out_name, key + "_out", total_out
                 )
             self._client.register_system_shared_memory(
-                f"pa_in_{self.wid}", key + "_in", total_in
+                self._in_name, key + "_in", total_in
             )
             if total_out:
                 self._client.register_system_shared_memory(
-                    f"pa_out_{self.wid}", key + "_out", total_out
+                    self._out_name, key + "_out", total_out
                 )
         elif mode == "tpu":
             import tritonclient_tpu.utils.tpu_shared_memory as tpushm
 
             self._tpushm = tpushm
-            self._in_region = a.make_tpu_region(f"pa_in_{self.wid}", total_in)
+            self._in_region = a.make_tpu_region(self._in_name, total_in)
             self._client.register_tpu_shared_memory(
-                f"pa_in_{self.wid}", tpushm.get_raw_handle(self._in_region),
+                self._in_name, tpushm.get_raw_handle(self._in_region),
                 a.device_id, total_in,
             )
             if total_out:
                 self._out_region = a.make_tpu_region(
-                    f"pa_out_{self.wid}", total_out
+                    self._out_name, total_out
                 )
                 self._client.register_tpu_shared_memory(
-                    f"pa_out_{self.wid}", tpushm.get_raw_handle(self._out_region),
+                    self._out_name, tpushm.get_raw_handle(self._out_region),
                     a.device_id, total_out,
                 )
         self._finish_setup()
@@ -257,7 +265,7 @@ class _Worker:
                 triton_to_np_dtype(dt)
             ).itemsize
             inp = a.infer_input_cls(name, shape, dt)
-            inp.set_shared_memory(f"pa_in_{self.wid}", nbytes, offset)
+            inp.set_shared_memory(self._in_name, nbytes, offset)
             offset += nbytes
             inputs.append(inp)
         self._static_inputs = inputs
@@ -310,18 +318,18 @@ class _Worker:
         try:
             if a.shared_memory == "system" and self._client is not None:
                 attempt(self._client.unregister_system_shared_memory,
-                        f"pa_in_{self.wid}")
+                        self._in_name)
                 attempt(self._client.unregister_system_shared_memory,
-                        f"pa_out_{self.wid}")
+                        self._out_name)
                 if hasattr(self, "_in_region"):
                     attempt(self._shm.destroy_shared_memory_region, self._in_region)
                 if hasattr(self, "_out_region"):
                     attempt(self._shm.destroy_shared_memory_region, self._out_region)
             elif a.shared_memory == "tpu" and self._client is not None:
                 attempt(self._client.unregister_tpu_shared_memory,
-                        f"pa_in_{self.wid}")
+                        self._in_name)
                 attempt(self._client.unregister_tpu_shared_memory,
-                        f"pa_out_{self.wid}")
+                        self._out_name)
                 if hasattr(self, "_in_region"):
                     attempt(self._tpushm.destroy_shared_memory_region,
                             self._in_region)
@@ -374,7 +382,7 @@ class _Worker:
         for name, (dt, shape) in a.input_specs.items():
             inp = InferInput(name, shape, dt)
             inp.set_shared_memory(
-                f"pa_in_{self.wid}", sizes[name], offsets[name]
+                self._in_name, sizes[name], offsets[name]
             )
             inputs.append(inp)
         return inputs
@@ -389,7 +397,7 @@ class _Worker:
             out = a.requested_output_cls(name)
             if a.shared_memory != "none" and a.output_sizes:
                 size = a.output_sizes[name]
-                out.set_shared_memory(f"pa_out_{self.wid}", size, offset)
+                out.set_shared_memory(self._out_name, size, offset)
                 offset += size
             outs.append(out)
         return outs
@@ -776,12 +784,16 @@ class _WindowWorker:
                 self._client.stop_stream()
 
 
+_SESSION_IDS = iter(range(1, 1 << 30))
+
+
 class MeasurementSession:
     """Closed-loop workers held ready across multiple measurement windows."""
 
     def __init__(self, analyzer: "PerfAnalyzer", concurrency: int):
         self.analyzer = analyzer
         self.concurrency = concurrency
+        tag = f"{analyzer.run_id}s{next(_SESSION_IDS)}"
         # Mux shards: one shared channel+stream per MUX_SHARD workers.
         # A single stream serializes server-side handling and response
         # order for every worker (head-of-line blocking at depth 32);
@@ -800,6 +812,7 @@ class MeasurementSession:
                 analyzer,
                 w,
                 mux=self.muxes[w // analyzer.mux_shard] if self.muxes else None,
+                tag=tag,
             )
             for w in range(concurrency)
         ]

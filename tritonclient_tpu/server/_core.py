@@ -517,6 +517,12 @@ class _DynamicBatcher:
         # links the delay converts per-request transport hops into
         # per-batch hops — the depth-32 throughput lever (VERDICT r4 #3).
         self.max_queue_delay_us = int(max_queue_delay_us)
+        # (timestamp, signature) of recent arrivals for the rate half of
+        # the pressure gate. Bounded deque + stale popleft keeps appends
+        # O(1); beyond the cap the rate is trivially "pressured" anyway.
+        import collections
+
+        self._arrivals = collections.deque(maxlen=512)
 
     def eligible(self, request: CoreRequest, cap: int) -> bool:
         # Sequence/priority parameters, BYTES tensors, rank-0 or empty
@@ -548,6 +554,10 @@ class _DynamicBatcher:
         with self._cv:
             self._queue.append(slot)
             if self.max_queue_delay_us:
+                now = time.monotonic()
+                self._arrivals.append((now, signature))
+                while self._arrivals and now - self._arrivals[0][0] > 0.1:
+                    self._arrivals.popleft()
                 # A delayed leader may be holding its batch open; arrivals
                 # must wake it so the row-cap early exit can fire.
                 self._cv.notify_all()
@@ -600,11 +610,25 @@ class _DynamicBatcher:
                         s for s in self._queue
                         if s is not slot and s.signature == signature
                     ]
-                    if len(others) < 2:
+                    now = time.monotonic()
+                    # Rate half of the gate: at high arrival rates a
+                    # leader usually sees exactly ONE waiter (the rest are
+                    # in flight), yet holding still pays because more
+                    # arrive within the hold. Engage when the measured
+                    # rate of THIS signature promises >= 2 arrivals inside
+                    # one delay window (rate * delay >= 2, over the last
+                    # 100 ms) — unrelated shapes' traffic cannot fill this
+                    # batch and must not hold it open.
+                    recent = sum(
+                        1 for t, sg in self._arrivals
+                        if sg == signature and now - t < 0.1
+                    )
+                    rate_pressured = recent >= max(2, int(0.2 / delay_s))
+                    if len(others) < 2 and not (others and rate_pressured):
                         break
                     if slot.rows + sum(s.rows for s in others) >= cap:
                         break
-                    remaining = deadline - time.monotonic()
+                    remaining = deadline - now
                     if remaining <= 0:
                         break
                     self._cv.wait(timeout=remaining)
