@@ -336,7 +336,10 @@ def _measure_depths(model, payload, dispatch, shape_overrides, batch,
     # phase-dependent (a fast link exposes fixed per-request overhead);
     # depth-major order hands each depth's ENTIRE median to one phase —
     # a lottery the worst-point gate then minimizes over. Round-robin
-    # gives every depth samples from every phase.
+    # gives every depth samples from every phase. Footprint note: peak
+    # region count is the SUM of all depths' workers (56 in+out regions
+    # for the default sweep) rather than the deepest depth — fine for
+    # these KB-scale regions; cap BENCH_CONCURRENCY for huge outputs.
     sessions = {}
     accs = {d: _Acc() for d in depths}
     with contextlib.ExitStack() as stack:

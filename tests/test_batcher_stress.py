@@ -1,0 +1,105 @@
+"""Dynamic-batcher stress: mixed eligible/ineligible/failing traffic under
+high concurrency must neither deadlock nor stall.
+
+Round-4 perf runs showed rare multi-second serving stalls with batching
+enabled; this hammers the scheduler's interleavings (leader promotion,
+delayed holds, error propagation, bypass traffic) and bounds per-request
+latency to catch a wedge as a failure instead of a mystery.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tritonclient_tpu.models._base import Model, TensorSpec
+from tritonclient_tpu.server._core import (
+    CoreError,
+    CoreRequest,
+    CoreTensor,
+    InferenceCore,
+)
+
+
+class _StressModel(Model):
+    """Batchable add-one that fails on demand (rows of -1)."""
+
+    name = "stress"
+    platform = "jax"
+    dynamic_batching = True
+    max_batch_size = 8
+
+    def __init__(self):
+        super().__init__()
+        self.inputs = [TensorSpec("X", "INT32", [-1, 4])]
+        self.outputs = [TensorSpec("Y", "INT32", [-1, 4])]
+
+    def infer(self, inputs, parameters=None):
+        x = np.asarray(inputs["X"])
+        if (x == -1).any():
+            raise ValueError("poisoned batch")
+        return {"Y": x + 1}
+
+    def warmup(self):
+        pass
+
+
+def _req(rows=1, poison=False, param=False):
+    x = np.full((rows, 4), -1 if poison else rows, np.int32)
+    r = CoreRequest(
+        model_name="stress",
+        inputs=[CoreTensor("X", "INT32", [rows, 4], data=x)],
+    )
+    if param:
+        # Parameters make the request batching-ineligible (bypass lane).
+        r.parameters = {"priority": 1}
+    return r
+
+
+@pytest.mark.parametrize("delay_us", [0, 5000])
+def test_batcher_survives_mixed_storm(monkeypatch, delay_us):
+    monkeypatch.setenv("TPU_SERVER_DYNAMIC_BATCH", "1")
+    monkeypatch.setenv("TPU_SERVER_BATCH_DELAY_US", str(delay_us))
+    core = InferenceCore(models=[_StressModel()])
+    stop = time.monotonic() + 4.0
+    max_lat = [0.0]
+    counts = {"ok": 0, "err": 0}
+    lock = threading.Lock()
+
+    def worker(wid):
+        rng = np.random.default_rng(wid)
+        while time.monotonic() < stop:
+            kind = rng.integers(0, 10)
+            rows = int(rng.choice([1, 2, 3, 8]))
+            t0 = time.monotonic()
+            try:
+                resp = core.infer(
+                    _req(rows=rows, poison=kind == 0, param=kind == 1)
+                )
+                ok = True
+                expect = np.full((rows, 4), rows + 1, np.int32)
+                np.testing.assert_array_equal(resp.outputs[0].data, expect)
+            except CoreError:
+                ok = False
+            lat = time.monotonic() - t0
+            with lock:
+                counts["ok" if ok else "err"] += 1
+                max_lat[0] = max(max_lat[0], lat)
+
+    threads = [
+        threading.Thread(target=worker, args=(w,), daemon=True)
+        for w in range(16)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "stress worker wedged (possible deadlock)"
+    assert counts["ok"] > 100, counts
+    assert counts["err"] > 0, "poison requests should have failed"
+    # A healthy scheduler answers every request promptly; a lost wakeup or
+    # stuck leader shows up as a multi-second straggler.
+    assert max_lat[0] < 5.0, f"request stalled {max_lat[0]:.1f}s"
+    stats = core.model_statistics("stress")[0]
+    assert stats["inference_count"] == counts["ok"]

@@ -207,7 +207,9 @@ class _Worker:
         if mode == "system":
             import tritonclient_tpu.utils.shared_memory as shm
 
-            key = f"/pa_{a.run_id}{self._tag}_{self.wid}"
+            # Tag already starts with run_id; bare-constructed workers
+            # (no session) fall back to run_id alone.
+            key = f"/pa_{self._tag or a.run_id}_{self.wid}"
             self._shm = shm
             self._in_region = shm.create_shared_memory_region(
                 self._in_name, key + "_in", total_in
