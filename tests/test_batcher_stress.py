@@ -65,27 +65,35 @@ def test_batcher_survives_mixed_storm(monkeypatch, delay_us):
     stop = time.monotonic() + 4.0
     max_lat = [0.0]
     counts = {"ok": 0, "err": 0}
+    crashes = []
     lock = threading.Lock()
 
     def worker(wid):
         rng = np.random.default_rng(wid)
-        while time.monotonic() < stop:
-            kind = rng.integers(0, 10)
-            rows = int(rng.choice([1, 2, 3, 8]))
-            t0 = time.monotonic()
-            try:
-                resp = core.infer(
-                    _req(rows=rows, poison=kind == 0, param=kind == 1)
-                )
-                ok = True
-                expect = np.full((rows, 4), rows + 1, np.int32)
-                np.testing.assert_array_equal(resp.outputs[0].data, expect)
-            except CoreError:
-                ok = False
-            lat = time.monotonic() - t0
+        try:
+            while time.monotonic() < stop:
+                kind = rng.integers(0, 10)
+                rows = int(rng.choice([1, 2, 3, 8]))
+                t0 = time.monotonic()
+                try:
+                    resp = core.infer(
+                        _req(rows=rows, poison=kind == 0, param=kind == 1)
+                    )
+                    ok = True
+                    expect = np.full((rows, 4), rows + 1, np.int32)
+                    np.testing.assert_array_equal(
+                        resp.outputs[0].data, expect
+                    )
+                except CoreError:
+                    ok = False
+                lat = time.monotonic() - t0
+                with lock:
+                    counts["ok" if ok else "err"] += 1
+                    max_lat[0] = max(max_lat[0], lat)
+        except BaseException as e:  # wrong outputs must FAIL the test,
+            # not die silently in a daemon thread
             with lock:
-                counts["ok" if ok else "err"] += 1
-                max_lat[0] = max(max_lat[0], lat)
+                crashes.append(e)
 
     threads = [
         threading.Thread(target=worker, args=(w,), daemon=True)
@@ -96,6 +104,7 @@ def test_batcher_survives_mixed_storm(monkeypatch, delay_us):
     for t in threads:
         t.join(timeout=60)
         assert not t.is_alive(), "stress worker wedged (possible deadlock)"
+    assert not crashes, crashes
     assert counts["ok"] > 100, counts
     assert counts["err"] > 0, "poison requests should have failed"
     # A healthy scheduler answers every request promptly; a lost wakeup or

@@ -360,3 +360,35 @@ def test_gpt_tp_sharded_generation_matches_single_device():
     gen = jax.jit(functools.partial(gpt.generate_scan, max_new=6, cfg=cfg))
     out = np.asarray(gen(sharded, prompt))
     np.testing.assert_array_equal(out, ref)
+
+
+def test_mesh_sharded_bert_serving_end_to_end():
+    """Long-context serving story (SURVEY §5.7/§5.8): a mesh-sharded BERT
+    (params by partition rules, ring attention on sp) served through the
+    full gRPC + mesh-spanning-shm-region stack must reproduce the
+    single-device model's numbers — tokens arrive sharded, the pooled
+    output parks back sharded, nothing congregates on one chip."""
+    from tritonclient_tpu.parallel import build_mesh
+    from tritonclient_tpu.parallel.validate import (
+        serve_sharded_bert_roundtrip,
+    )
+
+    mesh = build_mesh({"dp": 2, "sp": 2, "tp": 2}, jax.devices()[:8])
+    serve_sharded_bert_roundtrip(mesh, prefix="t_msv")
+
+
+def test_mesh_sharded_bert_rejects_misaligned_shapes():
+    """The mesh serving contract (batch % dp*fsdp, seq % sp) fails fast
+    with a clear message instead of a deep GSPMD error."""
+    import pytest as _pytest
+
+    from tritonclient_tpu.models import bert
+    from tritonclient_tpu.parallel import build_mesh
+
+    mesh = build_mesh({"dp": 2, "sp": 2, "tp": 2}, jax.devices()[:8])
+    model = bert.BertBaseModel(cfg=bert.bert_tiny(seq_len=64), mesh=mesh)
+    assert model.dynamic_batching is False  # pow2 padding can't align
+    with _pytest.raises(ValueError, match="divisible"):
+        model.infer({"INPUT_IDS": np.zeros((3, 32), np.int32)})
+    with _pytest.raises(ValueError, match="divisible"):
+        model.infer({"INPUT_IDS": np.zeros((4, 33), np.int32)})

@@ -233,13 +233,29 @@ class BertBaseModel(Model):
 
     def __init__(self, cfg: Optional[BertConfig] = None, seed: int = 0,
                  use_flash_attention: bool = False,
-                 checkpoint: Optional[str] = None):
+                 checkpoint: Optional[str] = None,
+                 mesh=None, sequence_parallel_impl: str = "ring"):
+        """``mesh``: serve mesh-sharded — params laid out by
+        PARTITION_RULES, activations constrained to (dp/fsdp, sp), and,
+        when the mesh has an sp axis > 1, ring or Ulysses sequence-
+        parallel attention so long sequences never congregate on one
+        chip. Pairs with mesh-spanning shm regions
+        (utils/tpu_shared_memory.create_sharded_memory_region): the
+        served tokens arrive as a sharded jax.Array and the pooled
+        output parks back sharded — SURVEY §5.7/§5.8 serving-side.
+        """
         super().__init__()
         self.cfg = cfg or bert_base()
         self.inputs = [TensorSpec("INPUT_IDS", "INT32", [-1, -1])]
         self.outputs = [
             TensorSpec("POOLED_OUTPUT", "FP32", [-1, self.cfg.d_model])
         ]
+        self.mesh = mesh
+        if mesh is not None:
+            # Mesh-sharded serving has shape-alignment contracts (batch %
+            # dp*fsdp, seq % sp); the dynamic batcher's pow2 row padding
+            # cannot honor them, so batching is disabled per instance.
+            self.dynamic_batching = False
         if checkpoint is not None:
             from tritonclient_tpu.models.checkpoint import load_params
 
@@ -248,7 +264,38 @@ class BertBaseModel(Model):
             self._params = init_params(jax.random.PRNGKey(seed), self.cfg)
 
         attention_fn = None
-        if use_flash_attention:
+        activation_spec = None
+        self._data_sharding = None
+        if mesh is not None:
+            from tritonclient_tpu.parallel.sharding import (
+                named_sharding,
+                shard_tree,
+            )
+
+            self._params = shard_tree(mesh, self._params, PARTITION_RULES)
+            activation_spec = named_sharding(
+                mesh, ("dp", "fsdp"), "sp", None
+            )
+            self._data_sharding = named_sharding(mesh, ("dp", "fsdp"), "sp")
+            if mesh.shape.get("sp", 1) > 1:
+                impl = "flash" if use_flash_attention else "reference"
+                if sequence_parallel_impl == "ulysses":
+                    from tritonclient_tpu.parallel.ulysses import (
+                        ulysses_attention,
+                    )
+
+                    attention_fn = functools.partial(
+                        ulysses_attention, mesh=mesh, impl=impl
+                    )
+                else:
+                    from tritonclient_tpu.parallel.ring_attention import (
+                        ring_attention,
+                    )
+
+                    attention_fn = functools.partial(
+                        ring_attention, mesh=mesh, impl=impl
+                    )
+        if attention_fn is None and use_flash_attention:
             # Tile-streamed Pallas kernel (ops/flash_attention.py): pays off
             # at long sequence where the [L, L] scores stop fitting HBM;
             # shapes that don't tile fall back automatically.
@@ -258,24 +305,58 @@ class BertBaseModel(Model):
 
         @jax.jit
         def fwd(params, tokens):
-            seq = encode(params, tokens, self.cfg, attention_fn=attention_fn)
+            seq = encode(params, tokens, self.cfg, attention_fn=attention_fn,
+                         activation_spec=activation_spec)
             return pooled_output(params, seq).astype(jnp.float32)
 
         self._fwd = fwd
 
     def infer(self, inputs, parameters=None):
         x = inputs["INPUT_IDS"]
+        if self.mesh is not None:
+            self._check_mesh_alignment(x.shape)
         if isinstance(x, jax.Array):
             # Zero-copy path (tpu shm): the tokens are already on device —
-            # a host round-trip here would cost two tunnel RPCs per request.
+            # a host round-trip here would cost two tunnel RPCs per
+            # request.
             tokens = x if x.dtype == jnp.int32 else x.astype(jnp.int32)
+            if self._data_sharding is not None and tokens.sharding.device_set != set(
+                self.mesh.devices.flat
+            ):
+                # e.g. a single-device region feeding a mesh model: the
+                # jit requires params and inputs on one device set.
+                tokens = jax.device_put(tokens, self._data_sharding)
         else:
             tokens = jnp.asarray(np.asarray(x, dtype=np.int32))
+            if self._data_sharding is not None:
+                tokens = jax.device_put(tokens, self._data_sharding)
         out = self._fwd(self._params, tokens)
         # Return the device array un-materialized; the response path parks it
         # in a tpu shm region zero-copy or serializes it for the wire.
         return {"POOLED_OUTPUT": out}
 
+    def _check_mesh_alignment(self, shape):
+        """Mesh-sharded serving contract: batch % (dp*fsdp), seq % sp."""
+        mshape = self.mesh.shape
+        brow = mshape.get("dp", 1) * mshape.get("fsdp", 1)
+        sp = mshape.get("sp", 1)
+        b, l = int(shape[0]), int(shape[1])
+        if b % brow or l % sp:
+            raise ValueError(
+                f"mesh-sharded {self.name} requires batch divisible by "
+                f"{brow} (dp*fsdp) and sequence length divisible by {sp} "
+                f"(sp); got [{b}, {l}]"
+            )
+
     def warmup(self):
-        z = jnp.zeros((1, 128), jnp.int32)
-        jax.block_until_ready(self._fwd(self._params, z))
+        b, l = 1, 128
+        if self.mesh is not None:
+            # Minimal shape whose dims divide the mesh's data axes (seq
+            # clamped to a multiple of sp within max_len).
+            shape = self.mesh.shape
+            sp = shape.get("sp", 1)
+            b = max(shape.get("dp", 1) * shape.get("fsdp", 1), 1)
+            l = min(16 * sp, self.cfg.max_len // sp * sp)
+            l = max(l, sp)
+        out = self.infer({"INPUT_IDS": np.zeros((b, l), np.int32)})
+        jax.block_until_ready(out["POOLED_OUTPUT"])
