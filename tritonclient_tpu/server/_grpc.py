@@ -770,8 +770,12 @@ class _AioServicer:
                             fut.result(timeout=1.0)
                             return True
                         except futures.TimeoutError:
-                            if dead.is_set():
-                                fut.cancel()
+                            if dead.is_set() or loop.is_closed():
+                                try:
+                                    fut.cancel()
+                                except Exception:
+                                    pass  # cancel-callback may race a
+                                    # closed loop at server shutdown
                                 return False
                         except Exception:
                             return False
