@@ -161,11 +161,47 @@ class _Request:
 class GenerationEngine:
     """The continuous-batching scheduler around the slot bank."""
 
-    def __init__(self, cfg: GptConfig, params: Dict, max_slots: int = 8):
+    def __init__(self, cfg: GptConfig, params: Dict, max_slots: int = 8,
+                 mesh=None):
+        """``mesh``: run the engine tensor-parallel — params laid out by
+        the Megatron rules (models/gpt.PARTITION_RULES) and the slot-bank
+        KV caches sharded on the heads axis over 'tp', so continuous
+        batching scales past one chip's HBM/FLOPs. Greedy decoding stays
+        token-identical to the single-device path (GSPMD inserts the
+        all-reduces through prefill, the batched decode step, and the
+        logits head; tested)."""
         self.cfg = cfg
+        self.mesh = mesh
+        if mesh is not None:
+            from tritonclient_tpu.models.gpt import PARTITION_RULES
+            from tritonclient_tpu.parallel.sharding import (
+                named_sharding,
+                shard_tree,
+            )
+
+            params = shard_tree(mesh, params, PARTITION_RULES)
+            # Cache layout [n_layers, S, max_len, H, Dh]: heads on tp.
+            # named_sharding drops absent/size-1 axes, so a tp-less mesh
+            # degrades to replication like shard_tree does for params.
+            self._cache_sharding = named_sharding(
+                mesh, None, None, None, "tp", None
+            )
+            self._vec_sharding = named_sharding(mesh)
+        else:
+            self._cache_sharding = None
+            self._vec_sharding = None
         self.params = params
         self.max_slots = max_slots
-        self._k, self._v = _slot_cache(cfg, max_slots)
+        if self._cache_sharding is not None:
+            # Allocate the bank directly sharded: staging the full
+            # unsharded [L, S, max_len, H, Dh] zeros on one device first
+            # would OOM exactly the configs the mesh exists for.
+            self._k, self._v = jax.jit(
+                lambda: _slot_cache(cfg, max_slots),
+                out_shardings=(self._cache_sharding, self._cache_sharding),
+            )()
+        else:
+            self._k, self._v = _slot_cache(cfg, max_slots)
         self._tokens = jnp.zeros((max_slots,), jnp.int32)
         self._pos = jnp.zeros((max_slots,), jnp.int32)
         # Per-slot sampling state (request settings + the (seed, step)
@@ -174,6 +210,15 @@ class GenerationEngine:
         self._steps = jnp.zeros((max_slots,), jnp.int32)
         self._temps = jnp.zeros((max_slots,), jnp.float32)
         self._topks = jnp.zeros((max_slots,), jnp.int32)
+        if self._vec_sharding is not None:
+            # Slot-state vectors replicate over the mesh so every jit sees
+            # one device set (params/caches are mesh-committed).
+            self._tokens, self._pos, self._seeds, self._steps, \
+                self._temps, self._topks = jax.device_put(
+                    (self._tokens, self._pos, self._seeds, self._steps,
+                     self._temps, self._topks),
+                    self._vec_sharding,
+                )
         self._slot_req: List[Optional[_Request]] = [None] * max_slots
         self._admit: "queue.Queue" = queue.Queue()
         self._cv = threading.Condition()
@@ -426,7 +471,7 @@ class GptEngineModel(Model):
     blocking = True
 
     def __init__(self, cfg: Optional[GptConfig] = None, seed: int = 0,
-                 max_slots: int = 8):
+                 max_slots: int = 8, mesh=None):
         super().__init__()
         self.cfg = cfg or gpt_small()
         self.inputs = [
@@ -438,8 +483,10 @@ class GptEngineModel(Model):
         ]
         self.outputs = [TensorSpec("OUTPUT_IDS", "INT32", [-1])]
         params = init_params(jax.random.PRNGKey(seed), self.cfg)
+        # mesh: tensor-parallel engine (params + KV slot bank sharded;
+        # see GenerationEngine).
         self.engine = GenerationEngine(self.cfg, params,
-                                       max_slots=max_slots)
+                                       max_slots=max_slots, mesh=mesh)
 
     def infer(self, inputs, parameters=None) -> Iterator[dict]:
         prompt = np.asarray(inputs["INPUT_IDS"], dtype=np.int32)
