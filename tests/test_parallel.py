@@ -420,18 +420,22 @@ def test_tp_sharded_engine_matches_single_device():
     engine = GenerationEngine(cfg, params, max_slots=2, mesh=mesh)
     try:
         results = [None] * len(prompts)
+        errors = []
 
         def consume(i):
-            q = engine.submit(prompts[i], max_news[i]).out
-            toks = []
-            while True:
-                t = q.get(timeout=120)
-                if t is None:
-                    break
-                if isinstance(t, BaseException):
-                    raise t
-                toks.append(int(t[0]))
-            results[i] = toks
+            try:
+                q = engine.submit(prompts[i], max_news[i]).out
+                toks = []
+                while True:
+                    t = q.get(timeout=120)
+                    if t is None:
+                        break
+                    if isinstance(t, BaseException):
+                        raise t
+                    toks.append(int(t[0]))
+                results[i] = toks
+            except BaseException as e:  # surface engine/device errors
+                errors.append(e)
 
         threads = [
             threading.Thread(target=consume, args=(i,))
@@ -441,6 +445,8 @@ def test_tp_sharded_engine_matches_single_device():
             t.start()
         for t in threads:
             t.join(timeout=120)
+            assert not t.is_alive(), "consumer wedged"
+        assert not errors, errors
         assert results == refs
     finally:
         engine.shutdown()

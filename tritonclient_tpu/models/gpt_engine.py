@@ -482,9 +482,25 @@ class GptEngineModel(Model):
             TensorSpec("SEED", "INT64", [1], optional=True),
         ]
         self.outputs = [TensorSpec("OUTPUT_IDS", "INT32", [-1])]
-        params = init_params(jax.random.PRNGKey(seed), self.cfg)
-        # mesh: tensor-parallel engine (params + KV slot bank sharded;
-        # see GenerationEngine).
+        key = jax.random.PRNGKey(seed)
+        if mesh is not None:
+            # Initialize DIRECTLY sharded (jit + out_shardings): staging
+            # the full unsharded params on one device first would OOM
+            # exactly the model sizes the mesh exists for.
+            from tritonclient_tpu.models.gpt import PARTITION_RULES
+            from tritonclient_tpu.parallel.sharding import tree_shardings
+
+            abstract = jax.eval_shape(lambda k: init_params(k, self.cfg), key)
+            params = jax.jit(
+                lambda k: init_params(k, self.cfg),
+                out_shardings=tree_shardings(
+                    mesh, abstract, PARTITION_RULES
+                ),
+            )(key)
+        else:
+            params = init_params(key, self.cfg)
+        # mesh: tensor-parallel engine (KV slot bank sharded; pre-sharded
+        # params pass through shard_tree as a no-op).
         self.engine = GenerationEngine(self.cfg, params,
                                        max_slots=max_slots, mesh=mesh)
 
