@@ -450,3 +450,33 @@ def test_tp_sharded_engine_matches_single_device():
         assert results == refs
     finally:
         engine.shutdown()
+
+
+def test_tp_sharded_engine_model_direct_init_matches():
+    """GptEngineModel(mesh=...) initializes params DIRECTLY sharded (jit +
+    out_shardings — no single-device staging); the deterministic PRNG
+    under jit must yield the same weights, so generation stays
+    token-identical to the eager single-device model."""
+    from tritonclient_tpu.models import gpt
+    from tritonclient_tpu.models.gpt_engine import GptEngineModel
+
+    cfg = gpt.gpt_tiny(max_len=64)
+    mesh = build_mesh({"tp": 2, "dp": 4})
+    model = GptEngineModel(cfg=cfg, max_slots=2, mesh=mesh)
+    try:
+        ref_params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+        prompt = np.array([[5, 9, 2]], np.int32)
+        ref = [
+            int(t[0]) for t in gpt.generate_tokens(ref_params, prompt, 6, cfg)
+        ]
+        q = model.engine.submit(prompt, 6).out
+        got = []
+        while True:
+            t = q.get(timeout=120)
+            if t is None:
+                break
+            assert not isinstance(t, BaseException), t
+            got.append(int(t[0]))
+        assert got == ref
+    finally:
+        model.engine.shutdown()

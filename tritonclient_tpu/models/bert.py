@@ -260,6 +260,15 @@ class BertBaseModel(Model):
             from tritonclient_tpu.models.checkpoint import load_params
 
             self._params = load_params(checkpoint)
+        elif mesh is not None:
+            # Initialize DIRECTLY sharded — no single-device staging copy
+            # (parallel/sharding.init_sharded).
+            from tritonclient_tpu.parallel.sharding import init_sharded
+
+            self._params = init_sharded(
+                mesh, lambda k: init_params(k, self.cfg),
+                PARTITION_RULES, jax.random.PRNGKey(seed),
+            )
         else:
             self._params = init_params(jax.random.PRNGKey(seed), self.cfg)
 
@@ -272,6 +281,7 @@ class BertBaseModel(Model):
                 shard_tree,
             )
 
+            # No-op for init_sharded params; lays out checkpoint restores.
             self._params = shard_tree(mesh, self._params, PARTITION_RULES)
             activation_spec = named_sharding(
                 mesh, ("dp", "fsdp"), "sp", None

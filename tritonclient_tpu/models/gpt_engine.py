@@ -484,19 +484,15 @@ class GptEngineModel(Model):
         self.outputs = [TensorSpec("OUTPUT_IDS", "INT32", [-1])]
         key = jax.random.PRNGKey(seed)
         if mesh is not None:
-            # Initialize DIRECTLY sharded (jit + out_shardings): staging
-            # the full unsharded params on one device first would OOM
-            # exactly the model sizes the mesh exists for.
+            # Initialize DIRECTLY sharded — no single-device staging copy
+            # (parallel/sharding.init_sharded).
             from tritonclient_tpu.models.gpt import PARTITION_RULES
-            from tritonclient_tpu.parallel.sharding import tree_shardings
+            from tritonclient_tpu.parallel.sharding import init_sharded
 
-            abstract = jax.eval_shape(lambda k: init_params(k, self.cfg), key)
-            params = jax.jit(
-                lambda k: init_params(k, self.cfg),
-                out_shardings=tree_shardings(
-                    mesh, abstract, PARTITION_RULES
-                ),
-            )(key)
+            params = init_sharded(
+                mesh, lambda k: init_params(k, self.cfg),
+                PARTITION_RULES, key,
+            )
         else:
             params = init_params(key, self.cfg)
         # mesh: tensor-parallel engine (KV slot bank sharded; pre-sharded

@@ -67,3 +67,21 @@ def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
 def shard_tree(mesh: Mesh, tree, rules: Rules, default: P = P()):
     """device_put every leaf according to its matched rule."""
     return jax.device_put(tree, tree_shardings(mesh, tree, rules, default))
+
+
+def init_sharded(mesh: Mesh, init_fn, rules: Rules, *args,
+                 default: P = P()):
+    """Materialize ``init_fn(*args)``'s tree DIRECTLY into its rule-
+    assigned shardings (jit + out_shardings).
+
+    Staging the full unsharded tree on one device and then device_put-ing
+    it (eager init + ``shard_tree``) OOMs exactly the model sizes a mesh
+    exists for; under jit the leaves are created sharded from the start.
+    JAX's PRNG is deterministic under jit, so results are value-identical
+    to the eager path (asserted by the engine/serving parity tests).
+    """
+    abstract = jax.eval_shape(init_fn, *args)
+    return jax.jit(
+        init_fn,
+        out_shardings=tree_shardings(mesh, abstract, rules, default=default),
+    )(*args)
