@@ -13,6 +13,13 @@ vs_baseline >= 1.0 means the serving stack meets BOTH north-star gates
 (BASELINE.md): every swept point >= 90% of in-process throughput, and
 serving p99 < 2x in-process p99 at the deepest level.
 
+The printed line is deliberately COMPACT (metric, value, unit,
+vs_baseline, worst point, runs summary) so the driver's tail capture
+parses it; the full per-point matrix is written to
+``BENCH_DETAIL.json`` beside this script (round 4's line carried the
+whole matrix and overflowed the capture — ``BENCH_r04.json``
+``parsed: null``).
+
 The measured configuration is the flagship serving path end-to-end:
 BERT-base with the Pallas flash-attention kernel (BENCH_FLASH=1 default)
 behind the server's dynamic batcher (pressure-gated
@@ -36,19 +43,27 @@ scripts/perf_probe.py for the phase/leg breakdown tooling):
 Coverage beyond the headline (BASELINE "batch 1-128" matrix):
   * BENCH_BATCH_SWEEP (default "1,32,128") re-measures BERT at those
     request batch sizes, one depth each, recorded in detail.batch_sweep;
-  * BENCH_RESNET=1 (default) measures a ResNet50 point
-    (detail.resnet50) through the same serving stack.
+  * BENCH_RESNET_SWEEP (default "1,4,16") measures ResNet50 at those
+    batch sizes (detail.resnet50) through the same serving stack,
+    write_once region semantics — every point gates.
+
+The WHOLE gate matrix repeats BENCH_RUNS times (default 3) and the
+reported vs_baseline is the MINIMUM over runs — "passes" means passes
+every time, not passed once (round 4 cleared the bar by 0.5% on a ±15%
+link; a robust pass needs a run history, VERDICT r4 #1).
 
 Per-depth breakdown (detail.sweep[d]): compute_infer_per_sec (in-process
 dispatch-only, no readback) and d2h_ms (single-stream readback latency)
 attribute any ratio miss to compute vs transfer vs dispatch.
 
 Env knobs: BENCH_MODEL (bert_base|simple), BENCH_BATCH (8), BENCH_SEQ
-(128), BENCH_SECONDS (24, per depth per side), BENCH_WINDOWS (8),
-BENCH_CONCURRENCY ("8,16,32"), BENCH_SHM (tpu|system|none),
-BENCH_STREAMING (1), BENCH_FLASH (1), BENCH_BATCHING (1),
-BENCH_BATCH_SWEEP ("1,32,128"; "" disables), BENCH_RESNET (1),
-BENCH_ASYNC_WINDOW (0 — sliding-window single-client mode).
+(128), BENCH_RUNS (3), BENCH_SECONDS (15 multi-run / 24 single, per
+depth per side), BENCH_WINDOWS (6 / 8), BENCH_CONCURRENCY ("8,16,32"),
+BENCH_SHM (tpu|system|none), BENCH_STREAMING (1), BENCH_FLASH (1),
+BENCH_BATCHING (1), BENCH_BATCH_SWEEP ("1,32,128"; "" disables),
+BENCH_RESNET_SWEEP ("1,4,16"; "" disables), BENCH_ASYNC_WINDOW (0 —
+sliding-window single-client mode), BENCH_DETAIL_PATH
+(BENCH_DETAIL.json).
 """
 
 import json
@@ -361,116 +376,67 @@ def _measure_depths(model, payload, dispatch, shape_overrides, batch,
     return per_depth
 
 
-def main():
-    model_name = os.environ.get("BENCH_MODEL", "bert_base")
-    batch = int(os.environ.get("BENCH_BATCH", "8"))
-    seq = int(os.environ.get("BENCH_SEQ", "128"))
-    seconds = float(os.environ.get("BENCH_SECONDS", "24"))
-    depths = [
-        int(x)
-        for x in os.environ.get(
-            "BENCH_CONCURRENCY", os.environ.get("BENCH_SWEEP", "8,16,32")
-        ).split(",")
-    ]
-    n_windows = int(os.environ.get("BENCH_WINDOWS", "8"))
-    shm_mode = os.environ.get("BENCH_SHM", "tpu")
-    async_window = os.environ.get("BENCH_ASYNC_WINDOW", "0") == "1"
-    if async_window and shm_mode != "tpu":
-        print("BENCH_ASYNC_WINDOW=1 requires BENCH_SHM=tpu", file=sys.stderr)
-        sys.exit(2)
-    streaming = os.environ.get("BENCH_STREAMING", "1") == "1"
-    batch_sweep = [
-        int(x)
-        for x in os.environ.get("BENCH_BATCH_SWEEP", "1,32,128").split(",")
-        if x
-    ]
-    with_resnet = os.environ.get("BENCH_RESNET", "1") == "1"
+def _shielded(point_fn):
+    """Tunnel-outage shield: short aux points have only a few window
+    pairs, so a ~30-40 s stall (observed ~hourly on the tunnel) can
+    corrupt the median. A ratio below any structurally possible value
+    (<0.6) is outage corruption, not signal — re-measure once and
+    record the retry verbatim."""
+    entry = point_fn()
+    if entry["ratio"] < 0.6:
+        entry = point_fn()
+        entry["outage_retry"] = True
+    return entry
 
-    import jax
 
-    from tritonclient_tpu.server import InferenceServer
+def _run_gate_matrix(run_idx, server, bert, rmodel, cfg):
+    """One full pass over the gate matrix; returns the run record."""
+    model, payload, dispatch, overrides = bert
+    per_depth = _measure_depths(
+        model, payload, dispatch, overrides, cfg["batch"], cfg["depths"],
+        cfg["seconds"], cfg["n_windows"], cfg["shm"], cfg["streaming"],
+        cfg["async_window"], server, record_aux=(run_idx == 0),
+    )
 
-    model, payload, dispatch, overrides = _make_model(model_name, batch, seq)
-    model.warmup()
-    _prewarm_buckets(model, dispatch, payload, batch)
+    # --- BERT batch matrix (BASELINE: "batch 1-128") ------------------------
+    batch_detail = {}
+    if cfg["batch_sweep"] and not cfg["async_window"]:
+        for b in cfg["batch_sweep"]:
+            if b == cfg["batch"]:
+                continue
+            payload_b = _payload_factory("bert_base", b, cfg["seq"])
+            batch_detail[str(b)] = _shielded(lambda pb=payload_b, bb=b: (
+                _measure_depths(
+                    model, pb, dispatch, overrides, bb,
+                    [cfg["sweep_depth"]], cfg["sweep_secs"], 4, cfg["shm"],
+                    cfg["streaming"], False, server, record_aux=False,
+                )[cfg["sweep_depth"]]
+            ))
 
-    with InferenceServer(models=[model], http=False) as server:
-        per_depth = _measure_depths(
-            model, payload, dispatch, overrides, batch, depths, seconds,
-            n_windows, shm_mode, streaming, async_window, server,
-        )
-
-        # --- batch matrix (BASELINE: "batch 1-128") --------------------------
-        batch_detail = {}
-        if model_name == "bert_base" and batch_sweep and not async_window:
-            sweep_depth = int(os.environ.get("BENCH_BATCH_SWEEP_DEPTH", "16"))
-            sweep_secs = float(
-                os.environ.get("BENCH_BATCH_SWEEP_SECONDS", "12")
-            )
-            for b in batch_sweep:
-                if b == batch:
-                    continue
-                payload_b = _payload_factory(model_name, b, seq)
-                # The request shape itself, then the batcher buckets —
-                # no measured window may pay a through-tunnel compile.
-                jax.block_until_ready(
-                    dispatch(np.zeros((b, seq), np.int32))
-                )
-                _prewarm_buckets(model, dispatch, payload_b, b)
-                def _point():
-                    return _measure_depths(
-                        model, payload_b, dispatch, overrides, b,
-                        [sweep_depth], sweep_secs, 4, shm_mode, streaming,
-                        False, server, record_aux=False,
-                    )[sweep_depth]
-
-                entry = _point()
-                if entry["ratio"] < 0.6:
-                    # Tunnel-outage shield: short aux points have only 4
-                    # window pairs, so a ~30-40 s stall (observed ~hourly
-                    # on the tunnel) can corrupt the median. A ratio this
-                    # far below every structural measurement is outage
-                    # corruption, not signal — re-measure once and record
-                    # the retry verbatim.
-                    entry = _point()
-                    entry["outage_retry"] = True
-                batch_detail[str(b)] = entry
-
-    # --- ResNet50 point (separate server: own repository entry) -------------
-    resnet_detail = None
-    if with_resnet and model_name == "bert_base" and not async_window:
-        rb = int(os.environ.get("BENCH_RESNET_BATCH", "4"))
-        rdepth = int(os.environ.get("BENCH_RESNET_DEPTH", "8"))
-        rsecs = float(os.environ.get("BENCH_RESNET_SECONDS", "18"))
-        rmodel, rpayload, rdispatch, roverrides = _make_model(
-            "resnet50", rb, seq
-        )
-        rmodel.warmup()
-        _prewarm_buckets(rmodel, rdispatch, rpayload, rb)
-        with InferenceServer(models=[rmodel], http=False) as rserver:
-            def _rpoint():
-                return _measure_depths(
-                    rmodel, rpayload, rdispatch, roverrides, rb, [rdepth],
-                    rsecs, 6, shm_mode, streaming, False, rserver,
-                    record_aux=False,
-                    write_once=os.environ.get(
-                        "BENCH_RESNET_WRITE_ONCE", "1") == "1",
+    # --- ResNet50 batch sweep (VERDICT r4 #3: batching as a first-class
+    # axis for the image path too) -------------------------------------------
+    resnet_detail = {}
+    if rmodel is not None:
+        rm, _, rdispatch, roverrides = rmodel
+        rdepth = cfg["resnet_depth"]
+        for rb in cfg["resnet_sweep"]:
+            rpayload = _payload_factory("resnet50", rb, cfg["seq"])
+            resnet_detail[str(rb)] = _shielded(lambda rp=rpayload, b=rb: (
+                _measure_depths(
+                    rm, rp, rdispatch, roverrides, b, [rdepth],
+                    cfg["resnet_secs"], 4, cfg["shm"], cfg["streaming"],
+                    False, server, record_aux=False,
+                    write_once=cfg["resnet_write_once"],
                 )[rdepth]
-
-            entry = _rpoint()
-            if entry["ratio"] < 0.6:
-                # Same outage shield as the batch sweep (see above).
-                entry = _rpoint()
-                entry["outage_retry"] = True
-        resnet_detail = {"batch": rb, "concurrency": rdepth, **entry}
+            ))
 
     # --- gates --------------------------------------------------------------
     # Gate 1 (throughput): EVERY measured point >= 0.90 of in-process.
     gate_points = {f"c{d}": per_depth[d]["ratio"] for d in per_depth}
     for b, entry in batch_detail.items():
         gate_points[f"b{b}"] = entry["ratio"]
-    if resnet_detail is not None:
-        gate_points["resnet50"] = resnet_detail["ratio"]
+    for b, entry in resnet_detail.items():
+        gate_points[f"resnet_b{b}"] = entry["ratio"]
     worst_point = min(gate_points, key=lambda k: gate_points[k])
     worst_ratio = gate_points[worst_point]
     # Gate 2 (tail): serving p99 < 2x in-process p99 at the deepest level.
@@ -480,28 +446,156 @@ def main():
         / max(deepest["serving_p99_latency_ms"], 1e-9)
     )
     headline = per_depth[max(per_depth)]
-    worst_depth = min(per_depth, key=lambda d: per_depth[d]["ratio"])
-    result = {
-        "metric": f"{model_name}_b{batch}_grpc_stream_tpushm_infer_per_sec",
-        "value": headline["serving_infer_per_sec"],
-        "unit": "infer/s",
+    errors = sum(per_depth[d]["errors"] for d in per_depth)
+    errors += sum(e["errors"] for e in batch_detail.values())
+    errors += sum(e["errors"] for e in resnet_detail.values())
+    return {
+        "run": run_idx + 1,
         "vs_baseline": round(min(worst_ratio / 0.90, p99_margin), 4),
-        "detail": {
-            "sweep": {str(d): per_depth[d] for d in per_depth},
-            "batch_sweep": batch_detail,
-            "resnet50": resnet_detail,
-            "worst_point": worst_point,
-            "worst_ratio": worst_ratio,
-            "worst_depth": worst_depth,
-            "p99_margin": round(p99_margin, 4),
-            "headline_concurrency": max(per_depth),
-            "shared_memory": shm_mode,
-            "streaming": streaming,
+        "value": headline["serving_infer_per_sec"],
+        "worst_point": worst_point,
+        "worst_ratio": worst_ratio,
+        "p99_margin": round(p99_margin, 4),
+        "errors": errors,
+        "sweep": {str(d): per_depth[d] for d in per_depth},
+        "batch_sweep": batch_detail,
+        "resnet50": resnet_detail,
+    }
+
+
+def main():
+    model_name = os.environ.get("BENCH_MODEL", "bert_base")
+    n_runs = int(os.environ.get("BENCH_RUNS", "3"))
+    multi = n_runs > 1
+    cfg = {
+        "batch": int(os.environ.get("BENCH_BATCH", "8")),
+        "seq": int(os.environ.get("BENCH_SEQ", "128")),
+        # Multi-run defaults trade per-run window count for run count:
+        # 3 x 15 s samples MORE tunnel phases than 1 x 24 s, and the
+        # min-over-runs gate is what robustness means.
+        "seconds": float(
+            os.environ.get("BENCH_SECONDS", "15" if multi else "24")
+        ),
+        "n_windows": int(
+            os.environ.get("BENCH_WINDOWS", "6" if multi else "8")
+        ),
+        "depths": [
+            int(x)
+            for x in os.environ.get(
+                "BENCH_CONCURRENCY", os.environ.get("BENCH_SWEEP", "8,16,32")
+            ).split(",")
+        ],
+        "shm": os.environ.get("BENCH_SHM", "tpu"),
+        "async_window": os.environ.get("BENCH_ASYNC_WINDOW", "0") == "1",
+        "streaming": os.environ.get("BENCH_STREAMING", "1") == "1",
+        "batch_sweep": [
+            int(x)
+            for x in os.environ.get("BENCH_BATCH_SWEEP", "1,32,128").split(",")
+            if x
+        ],
+        "sweep_depth": int(os.environ.get("BENCH_BATCH_SWEEP_DEPTH", "16")),
+        "sweep_secs": float(
+            os.environ.get("BENCH_BATCH_SWEEP_SECONDS", "10" if multi else "12")
+        ),
+        "resnet_sweep": [
+            int(x)
+            for x in os.environ.get("BENCH_RESNET_SWEEP", "1,4,16").split(",")
+            if x
+        ],
+        "resnet_depth": int(os.environ.get("BENCH_RESNET_DEPTH", "8")),
+        "resnet_secs": float(
+            os.environ.get("BENCH_RESNET_SECONDS", "10" if multi else "18")
+        ),
+        "resnet_write_once": os.environ.get(
+            "BENCH_RESNET_WRITE_ONCE", "1") == "1",
+    }
+    if cfg["async_window"] and cfg["shm"] != "tpu":
+        print("BENCH_ASYNC_WINDOW=1 requires BENCH_SHM=tpu", file=sys.stderr)
+        sys.exit(2)
+    if model_name != "bert_base":
+        cfg["batch_sweep"] = []
+        cfg["resnet_sweep"] = []
+
+    import jax
+
+    from tritonclient_tpu.server import InferenceServer
+
+    model, payload, dispatch, overrides = _make_model(
+        model_name, cfg["batch"], cfg["seq"]
+    )
+    model.warmup()
+    _prewarm_buckets(model, dispatch, payload, cfg["batch"])
+    # Pre-compile every swept request shape + its batcher buckets once —
+    # no measured window (in any run) may pay a through-tunnel compile.
+    if cfg["async_window"]:
+        cfg["batch_sweep"] = []  # not measured in one-shot mode; don't warm
+    for b in cfg["batch_sweep"]:
+        if b != cfg["batch"]:
+            jax.block_until_ready(dispatch(np.zeros((b, cfg["seq"]), np.int32)))
+            _prewarm_buckets(
+                model, dispatch, _payload_factory(model_name, b, cfg["seq"]), b
+            )
+    bert = (model, payload, dispatch, overrides)
+
+    rmodel = None
+    models = [model]
+    if cfg["resnet_sweep"] and not cfg["async_window"]:
+        rm, _, rdispatch, roverrides = _make_model("resnet50", 1, cfg["seq"])
+        rm.warmup()
+        for rb in cfg["resnet_sweep"]:
+            jax.block_until_ready(
+                rdispatch(np.zeros((rb, 224, 224, 3), np.float32))
+            )
+            _prewarm_buckets(
+                rm, rdispatch, _payload_factory("resnet50", rb, cfg["seq"]), rb
+            )
+        rmodel = (rm, None, rdispatch, roverrides)
+        models.append(rm)
+
+    runs = []
+    with InferenceServer(models=models, http=False) as server:
+        for run_idx in range(n_runs):
+            runs.append(_run_gate_matrix(run_idx, server, bert, rmodel, cfg))
+
+    from statistics import median
+
+    # "Passes" = passes every run: gate on the MINIMUM vs_baseline.
+    vs_baseline = min(r["vs_baseline"] for r in runs)
+    worst = min(runs, key=lambda r: r["vs_baseline"])
+    detail_path = os.environ.get(
+        "BENCH_DETAIL_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_DETAIL.json"),
+    )
+    detail = {
+        "runs": runs,
+        "config": {
+            "n_runs": n_runs,
+            "shared_memory": cfg["shm"],
+            "streaming": cfg["streaming"],
             "flash_attention": os.environ.get("BENCH_FLASH", "1") == "1",
             "dynamic_batching": os.environ.get(
                 "TPU_SERVER_DYNAMIC_BATCH", "0") == "1",
             "platform": jax.devices()[0].platform,
+            "seconds_per_window_pair": cfg["seconds"],
+            "depths": cfg["depths"],
         },
+    }
+    with open(detail_path, "w") as f:
+        json.dump(detail, f, indent=1)
+    # Compact driver-parseable line: the full matrix lives in the detail
+    # file, NOT here (round 4's fat line overflowed the tail capture).
+    result = {
+        "metric": f"{model_name}_b{cfg['batch']}_grpc_stream_tpushm_infer_per_sec",
+        "value": round(median(r["value"] for r in runs), 2),
+        "unit": "infer/s",
+        "vs_baseline": vs_baseline,
+        "runs": [r["vs_baseline"] for r in runs],
+        "worst_point": worst["worst_point"],
+        "worst_ratio": worst["worst_ratio"],
+        "p99_margin": min(r["p99_margin"] for r in runs),
+        "errors": sum(r["errors"] for r in runs),
+        "detail_file": os.path.basename(detail_path),
     }
     print(json.dumps(result))
 

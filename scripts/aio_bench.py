@@ -1,0 +1,191 @@
+"""aio client-plane perf artifact (VERDICT r4 #5).
+
+Measures the grpc.aio client at depth 16 against the live server —
+unary storm and concurrent-streams modes — alongside the threaded gRPC
+client at the same depth on the same server, and writes AIO_r{N}.json
+at the repo root. The point is a RECORDED throughput/error figure for
+the shipped asyncio API plane, not a gate: the aio client is an API
+surface, the serving north star is measured by bench.py.
+
+Run on the TPU:  python scripts/aio_bench.py [round_number]
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.setswitchinterval(0.0002)
+
+import numpy as np  # noqa: E402
+
+DEPTH = int(os.environ.get("AIO_DEPTH", "16"))
+SECONDS = float(os.environ.get("AIO_SECONDS", "8"))
+
+
+def _np_inputs(i):
+    a = np.full((1, 16), i % 100, np.int32)
+    b = np.arange(16, dtype=np.int32).reshape(1, 16)
+    return a, b
+
+
+async def _aio_unary(address):
+    import tritonclient_tpu.grpc.aio as grpcaio
+
+    counts = [0] * DEPTH
+    errors = [0]
+    stop = [False]
+
+    async def worker(c, wid):
+        i = wid
+        while not stop[0]:
+            a, b = _np_inputs(i)
+            i0 = grpcaio.InferInput(
+                "INPUT0", [1, 16], "INT32"
+            ).set_data_from_numpy(a)
+            i1 = grpcaio.InferInput(
+                "INPUT1", [1, 16], "INT32"
+            ).set_data_from_numpy(b)
+            try:
+                res = await c.infer("simple", [i0, i1])
+                if res.as_numpy("OUTPUT0")[0, 0] != a[0, 0] + b[0, 0]:
+                    errors[0] += 1
+                counts[wid] += 1
+            except Exception:
+                errors[0] += 1
+            i += DEPTH
+
+    async with grpcaio.InferenceServerClient(address) as c:
+        # Warmup pass absorbs channel + first-dispatch setup.
+        a, b = _np_inputs(0)
+        i0 = grpcaio.InferInput("INPUT0", [1, 16], "INT32").set_data_from_numpy(a)
+        i1 = grpcaio.InferInput("INPUT1", [1, 16], "INT32").set_data_from_numpy(b)
+        await c.infer("simple", [i0, i1])
+        t0 = time.perf_counter()
+        tasks = [asyncio.ensure_future(worker(c, w)) for w in range(DEPTH)]
+        await asyncio.sleep(SECONDS)
+        stop[0] = True
+        await asyncio.gather(*tasks)
+        elapsed = time.perf_counter() - t0
+    return {
+        "mode": "unary",
+        "concurrency": DEPTH,
+        "infer_per_sec": round(sum(counts) / elapsed, 2),
+        "errors": errors[0],
+    }
+
+
+async def _aio_streams(address):
+    """Concurrent decoupled streams: responses/sec across DEPTH streams."""
+    import tritonclient_tpu.grpc.aio as grpcaio
+
+    responses = [0]
+    errors = [0]
+    stop = [False]
+
+    async def one_stream(c, wid):
+        while not stop[0]:
+            async def gen():
+                inp = grpcaio.InferInput(
+                    "IN", [8], "INT32"
+                ).set_data_from_numpy(
+                    np.arange(wid, wid + 8, dtype=np.int32)
+                )
+                yield {
+                    "model_name": "repeat_int32",
+                    "inputs": [inp],
+                    "enable_empty_final_response": True,
+                }
+
+            try:
+                async for result, error in c.stream_infer(gen()):
+                    if error is not None:
+                        errors[0] += 1
+                        break
+                    resp = result.get_response()
+                    if resp.parameters[
+                        "triton_final_response"
+                    ].bool_param:
+                        break
+                    responses[0] += 1
+            except Exception:
+                errors[0] += 1
+
+    async with grpcaio.InferenceServerClient(address) as c:
+        t0 = time.perf_counter()
+        tasks = [
+            asyncio.ensure_future(one_stream(c, w)) for w in range(DEPTH)
+        ]
+        await asyncio.sleep(SECONDS)
+        stop[0] = True
+        await asyncio.gather(*tasks)
+        elapsed = time.perf_counter() - t0
+    return {
+        "mode": "streams",
+        "concurrency": DEPTH,
+        "responses_per_sec": round(responses[0] / elapsed, 2),
+        "errors": errors[0],
+    }
+
+
+def _threaded_ref(address):
+    """Threaded-client comparator at the same depth on the same server."""
+    from tritonclient_tpu.perf_analyzer import PerfAnalyzer
+
+    analyzer = PerfAnalyzer(
+        address,
+        "simple",
+        protocol="grpc",
+        batch_size=1,
+        shared_memory="none",
+        streaming=False,
+        read_outputs=True,
+        measurement_interval_s=SECONDS,
+        warmup_s=1.0,
+    )
+    s = analyzer.measure(DEPTH).summary()
+    return {
+        "mode": "threaded_ref",
+        "concurrency": DEPTH,
+        "infer_per_sec": s["throughput_infer_per_sec"],
+        "errors": s["errors"],
+    }
+
+
+def main():
+    rnd = sys.argv[1] if len(sys.argv) > 1 else os.environ.get("ROUND", "05")
+
+    import jax
+
+    from tritonclient_tpu.server import InferenceServer
+
+    with InferenceServer(http=False) as server:
+        unary = asyncio.run(_aio_unary(server.grpc_address))
+        streams = asyncio.run(_aio_streams(server.grpc_address))
+        threaded = _threaded_ref(server.grpc_address)
+
+    result = {
+        "round": rnd,
+        "platform": jax.devices()[0].platform,
+        "depth": DEPTH,
+        "grpc_aio_unary": unary,
+        "grpc_aio_streams": streams,
+        "grpc_threaded_ref": threaded,
+        "aio_vs_threaded": round(
+            unary["infer_per_sec"] / threaded["infer_per_sec"], 3
+        ) if threaded["infer_per_sec"] else None,
+        "errors": unary["errors"] + streams["errors"] + threaded["errors"],
+    }
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        f"AIO_r{rnd}.json",
+    )
+    with open(path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

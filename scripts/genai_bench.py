@@ -94,19 +94,48 @@ def main():
                       f"ttft p99 {keep['ttft_ms'].get('p99_ms')} ms",
                       file=sys.stderr)
     eng8 = result["engine"].get("c8", {})
+    eng1 = result["engine"].get("c1", {})
     single = result["single_loop_c8"] or {}
     if single.get("output_token_throughput_per_sec"):
         result["engine_speedup_c8"] = round(
             eng8.get("output_token_throughput_per_sec", 0)
             / single["output_token_throughput_per_sec"], 2
         )
+    # Gate (VERDICT r4 #4): the engine must buy throughput WITHOUT
+    # selling TTFT — >= 1.3x single-loop token throughput at c8 AND
+    # TTFT p99 at c8 <= 2.5x its own c1 value. genai_vs_baseline >= 1.0
+    # means both hold; the min names the binding constraint.
+    ttft8 = (eng8.get("ttft_ms") or {}).get("p99_ms", 0)
+    ttft1 = (eng1.get("ttft_ms") or {}).get("p99_ms", 0)
+    if ttft1 and ttft8 and result.get("engine_speedup_c8"):
+        result["ttft_p99_c8_over_c1"] = round(ttft8 / ttft1, 2)
+        result["genai_vs_baseline"] = round(
+            min(
+                result["engine_speedup_c8"] / 1.3,
+                2.5 / result["ttft_p99_c8_over_c1"],
+            ), 4
+        )
+    else:
+        # A degenerate run (empty window, failed comparator) must read
+        # as a FAILED gate, not an absent one.
+        result["genai_vs_baseline"] = 0.0
+        result["gate_inputs_missing"] = True
     path = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         f"GENAI_r{rnd}.json",
     )
     with open(path, "w") as f:
         json.dump(result, f, indent=1)
-    print(json.dumps(result))
+    # Compact driver/judge-parseable line; the full detail is in the file.
+    print(json.dumps({
+        "metric": "gpt_engine_c8_token_throughput",
+        "value": eng8.get("output_token_throughput_per_sec"),
+        "unit": "tok/s",
+        "engine_speedup_c8": result.get("engine_speedup_c8"),
+        "ttft_p99_c8_over_c1": result.get("ttft_p99_c8_over_c1"),
+        "genai_vs_baseline": result.get("genai_vs_baseline"),
+        "detail_file": os.path.basename(path),
+    }))
 
 
 if __name__ == "__main__":

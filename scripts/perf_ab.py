@@ -71,6 +71,11 @@ def main():
             pool = 32
             batch_delay = None
             coalesce = False
+            # Per-config knobs must reset between variants or a 'rateN'/
+            # 'shardN' token would leak into every later server/analyzer
+            # construction.
+            os.environ["TPU_SERVER_BATCH_RATE_FACTOR"] = "1.0"
+            os.environ.pop("PA_MUX_SHARD", None)
             for p in parts[2:]:
                 if p.startswith("pool"):
                     pool = int(p[4:])
@@ -78,6 +83,8 @@ def main():
                     batch_delay = int(p[5:])
                 elif p == "coal":
                     coalesce = True
+                elif p.startswith("rate"):
+                    os.environ["TPU_SERVER_BATCH_RATE_FACTOR"] = p[4:]
                 elif p.startswith("shard"):
                     os.environ["PA_MUX_SHARD"] = p[5:]
             overlay = {"TPU_TRANSFER_COALESCE": "1" if coalesce else "0"}

@@ -10,6 +10,7 @@ statistics) lives here exactly once.
 """
 
 import json
+import logging
 import math
 import mmap
 import os
@@ -343,6 +344,8 @@ class _ModelStats:
         self.success_ns = 0
         self.fail_count = 0
         self.fail_ns = 0
+        self.cancel_count = 0
+        self.cancel_ns = 0
         self.queue_ns = 0
         self.compute_input_ns = 0
         self.compute_infer_ns = 0
@@ -358,6 +361,7 @@ class _ModelStats:
             "inference_stats": {
                 "success": {"count": self.success_count, "ns": self.success_ns},
                 "fail": {"count": self.fail_count, "ns": self.fail_ns},
+                "cancel": {"count": self.cancel_count, "ns": self.cancel_ns},
                 "queue": {"count": self.success_count, "ns": self.queue_ns},
                 "compute_input": {
                     "count": self.success_count,
@@ -523,6 +527,17 @@ class _DynamicBatcher:
         import collections
 
         self._arrivals = collections.deque(maxlen=512)
+        # Arrivals the rate gate must promise within one delay window
+        # before a leader holds (rate * delay >= this). 2.0 = hold only
+        # when a 3+-batch is forming; 1.0 also holds for 2-batches, which
+        # already halves the fixed per-op readback cost — the moderate-
+        # depth (c16) regime where r4's worst gate point lived.
+        try:
+            self._rate_factor = float(
+                os.environ.get("TPU_SERVER_BATCH_RATE_FACTOR", "1.0")
+            )
+        except ValueError:
+            self._rate_factor = 1.0
 
     def eligible(self, request: CoreRequest, cap: int) -> bool:
         # Sequence/priority parameters, BYTES tensors, rank-0 or empty
@@ -615,15 +630,18 @@ class _DynamicBatcher:
                     # leader usually sees exactly ONE waiter (the rest are
                     # in flight), yet holding still pays because more
                     # arrive within the hold. Engage when the measured
-                    # rate of THIS signature promises >= 2 arrivals inside
-                    # one delay window (rate * delay >= 2, over the last
-                    # 100 ms) — unrelated shapes' traffic cannot fill this
-                    # batch and must not hold it open.
+                    # rate of THIS signature promises >= rate_factor
+                    # arrivals inside one delay window (rate * delay >=
+                    # factor, over the last 100 ms) — unrelated shapes'
+                    # traffic cannot fill this batch and must not hold it
+                    # open.
                     recent = sum(
                         1 for t, sg in self._arrivals
                         if sg == signature and now - t < 0.1
                     )
-                    rate_pressured = recent >= max(2, int(0.2 / delay_s))
+                    rate_pressured = recent >= max(
+                        2, int(self._rate_factor * 0.1 / delay_s)
+                    )
                     if len(others) < 2 and not (others and rate_pressured):
                         break
                     if slot.rows + sum(s.rows for s in others) >= cap:
@@ -726,12 +744,21 @@ class InferenceCore:
             and getattr(model, "dynamic_batching", False)
             and not model.decoupled
         ):
-            delay_us = int(
-                os.environ.get(
-                    "TPU_SERVER_BATCH_DELAY_US",
-                    getattr(model, "max_queue_delay_us", 0),
+            default_us = getattr(model, "max_queue_delay_us", 0)
+            try:
+                delay_us = int(
+                    os.environ.get("TPU_SERVER_BATCH_DELAY_US", default_us)
                 )
-            )
+            except ValueError:
+                # An empty/garbage env value must not take down model
+                # registration (ADVICE r4) — fall back to the model's own
+                # delay and say so.
+                logging.getLogger("tritonclient_tpu.server").warning(
+                    "ignoring non-numeric TPU_SERVER_BATCH_DELAY_US=%r; "
+                    "using model default %d us",
+                    os.environ.get("TPU_SERVER_BATCH_DELAY_US"), default_us,
+                )
+                delay_us = int(default_us)
             self._batchers[model.name] = _DynamicBatcher(self, delay_us)
 
     def _get_model(self, name: str, version: str = ""):
@@ -1158,20 +1185,27 @@ class InferenceCore:
             # transfers become one, which is the dominant serving-CPU term
             # on latency-bound links (a readback op costs ~0.8 ms host CPU
             # regardless of size).
-            from tritonclient_tpu.utils.tpu_shared_memory import BatchRowView
+            from tritonclient_tpu.utils.tpu_shared_memory import (
+                BatchRowView,
+                SharedBatch,
+            )
 
-            locks = {}
+            bases = {}
             for name, array in result.items():
                 if hasattr(array, "copy_to_host_async"):
                     array.copy_to_host_async()
-                    locks[name] = threading.Lock()
+                    # One SharedBatch per output, shared by every member's
+                    # view: the first reader materializes the host copy and
+                    # the padded device batch is released (not pinned until
+                    # every region offset is overwritten — ADVICE r4).
+                    bases[name] = SharedBatch(array)
             ok = 0
             start = 0
             for idx, n in zip(live, sizes):
                 sliced = {
                     k: (
-                        BatchRowView(v, start, start + n, locks[k])
-                        if k in locks
+                        BatchRowView(bases[k], start, start + n)
+                        if k in bases
                         else v[start : start + n]
                     )
                     for k, v in result.items()
@@ -1219,12 +1253,23 @@ class InferenceCore:
             except CoreError:
                 self._record_failure(stats, t_start)
                 raise
+            except GeneratorExit:
+                # Consumer abandoned the stream (cancel / disconnect):
+                # record a terminal cancel stat — duration up to the
+                # cancellation, responses generated so far — instead of
+                # silently omitting the request (ADVICE r4). Triton's
+                # inference_stats carries the same "cancel" bucket.
+                with self._lock:
+                    stats.inference_count += 1
+                    stats.execution_count += count
+                    stats.cancel_count += 1
+                    stats.cancel_ns += time.monotonic_ns() - t_start
+                raise
             except Exception as e:
                 # Mirror _infer_one's wrapping for errors raised during
                 # lazy generation (e.g. a deferred engine admission): the
                 # unary handler sees a CoreError, not a raw exception, and
-                # the failure is recorded. GeneratorExit (consumer gone)
-                # is BaseException and passes through untouched.
+                # the failure is recorded.
                 self._record_failure(stats, t_start)
                 raise CoreError(
                     f"inference failed for model '{model.name}': {e}", 500

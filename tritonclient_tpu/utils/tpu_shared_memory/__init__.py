@@ -80,48 +80,79 @@ def _nbytes(arr) -> int:
     return math.prod(arr.shape) * arr.dtype.itemsize
 
 
+class SharedBatch:
+    """Device base + one-shot host materialization shared by all row views
+    of one dynamically batched result.
+
+    Once the host copy lands, the device reference is DROPPED: each of the
+    k member regions previously pinned the entire pow2-padded batch array
+    in device memory (k x bucket rows) until every region offset was
+    overwritten, which grows parked HBM ~k-fold for long-lived output
+    regions (ADVICE r4). The shared lock also stops concurrent
+    first-readers racing the materialization and paying the transfer
+    twice.
+    """
+
+    __slots__ = ("array", "host", "lock")
+
+    def __init__(self, array, lock=None):
+        self.array = array
+        self.host = None
+        self.lock = lock if lock is not None else threading.Lock()
+
+    def materialize(self) -> np.ndarray:
+        with self.lock:
+            if self.host is None:
+                self.host = np.asarray(self.array)
+                self.array = None  # release the padded device batch
+            return self.host
+
+
 class BatchRowView:
     """A row-slice view over a shared (dynamically batched) device array.
 
     The server's dynamic batcher executes k requests as ONE device array;
     parking per-member *views* instead of per-member device slices means
     the whole batch is read back with a single device->host transfer (the
-    first reader materializes the base array — jax caches the host copy —
-    and every other member slices the cached numpy). On latency-bound
-    links a readback op costs ~0.8 ms host CPU regardless of size, so
-    this turns k transfers into one: the dominant serving-CPU term at
-    high concurrency (VERDICT r4 #3).
+    first reader materializes the base array into the shared
+    ``SharedBatch`` host cache and every other member slices that numpy).
+    On latency-bound links a readback op costs ~0.8 ms host CPU
+    regardless of size, so this turns k transfers into one: the dominant
+    serving-CPU term at high concurrency (VERDICT r4 #3).
+
+    ``base`` is normally a ``SharedBatch`` shared by all batchmates; a
+    raw array is wrapped in a private one (with ``lock`` if given).
     """
 
-    __slots__ = ("base", "start", "stop", "_shape", "_lock")
+    __slots__ = ("_sb", "start", "stop", "_shape", "_tail", "_dtype")
 
     def __init__(self, base, start: int, stop: int, lock=None, shape=None):
-        self.base = base
+        self._sb = (
+            base if isinstance(base, SharedBatch) else SharedBatch(base, lock)
+        )
         self.start = int(start)
         self.stop = int(stop)
         # Explicit shape: the transfer coalescer bundles arbitrary same-
         # dtype outputs as ONE flat base; each member view then reshapes
         # its element range back to the original output shape.
         self._shape = tuple(int(s) for s in shape) if shape is not None else None
-        # One lock per batch, shared by all members' views: concurrent
-        # first-readers would otherwise race the base materialization and
-        # pay the transfer twice.
-        self._lock = lock if lock is not None else threading.Lock()
+        src = self._sb.array if self._sb.array is not None else self._sb.host
+        self._tail = tuple(src.shape[1:])
+        self._dtype = src.dtype
 
     @property
     def shape(self):
         if self._shape is not None:
             return self._shape
-        return (self.stop - self.start,) + tuple(self.base.shape[1:])
+        return (self.stop - self.start,) + self._tail
 
     @property
     def dtype(self):
-        return self.base.dtype
+        return self._dtype
 
     def materialize(self) -> np.ndarray:
         """Host view of this member's rows; base transferred once."""
-        with self._lock:
-            host = np.asarray(self.base)
+        host = self._sb.materialize()
         out = host[self.start : self.stop]
         if self._shape is not None:
             out = out.reshape(self._shape)
@@ -134,15 +165,25 @@ class BatchRowView:
         return out
 
     def device_slice(self):
-        """Lazy device-side slice for device consumers (no host hop)."""
-        out = self.base[self.start : self.stop]
+        """Lazy device-side slice for device consumers (no host hop).
+
+        After the base has been released (host copy landed) this returns
+        the cached host slice instead — callers that require device
+        residency re-upload it themselves.
+        """
+        base = self._sb.array
+        if base is None:
+            return self.materialize()
+        out = base[self.start : self.stop]
         if self._shape is not None:
             out = out.reshape(self._shape)
         return out
 
     def copy_to_host_async(self):
         try:
-            self.base.copy_to_host_async()
+            base = self._sb.array
+            if base is not None:
+                base.copy_to_host_async()
         except AttributeError:
             pass
 
@@ -190,7 +231,9 @@ class TransferCoalescer:
 
     def submit(self, region: "TpuSharedMemoryRegion", offset: int, arr):
         with self._cv:
-            if self._thread is None:
+            if self._thread is None or not self._thread.is_alive():
+                # is_alive covers a daemon killed by an escaped error:
+                # coalescing must degrade, never latch off.
                 self._thread = threading.Thread(
                     target=self._run, daemon=True, name="tpu-shm-coalescer"
                 )
@@ -228,7 +271,23 @@ class TransferCoalescer:
                 batch = self._pending[: self.max_bundle]
                 del self._pending[: len(batch)]
             if batch:
-                self._flush(batch)
+                try:
+                    self._flush(batch)
+                except Exception:
+                    # The flush thread must survive anything: an escape
+                    # here would kill the daemon while self._thread stays
+                    # set, permanently disabling coalescing (ADVICE r4).
+                    # Readers still get correct data from the originally
+                    # parked arrays — just without the warm copy. The
+                    # fallback warm copies are themselves guarded: on a
+                    # broken runtime they raise the SAME error, which
+                    # must not escape either.
+                    self.stats["errors"] += 1
+                    for item in batch:
+                        try:
+                            item[2].copy_to_host_async()
+                        except Exception:
+                            pass
 
     def _flush(self, batch):
         groups: Dict[tuple, list] = {}
@@ -265,10 +324,10 @@ class TransferCoalescer:
             self.stats["bundles"] += 1
             self.stats["bundled_members"] += k
             n = math.prod(shp)
-            lock = threading.Lock()
+            sb = SharedBatch(bundle)
             for i, (region, offset, arr, _) in enumerate(items):
                 view = BatchRowView(
-                    bundle, i * n, (i + 1) * n, lock, shape=shp
+                    sb, i * n, (i + 1) * n, shape=shp
                 )
                 if region._replace_parked(offset, arr, view):
                     self.stats["cas_ok"] += 1
@@ -434,7 +493,16 @@ class TpuSharedMemoryRegion:
             if parked is not None and _nbytes(parked) == nbytes:
                 if isinstance(parked, BatchRowView):
                     if parked.dtype == np_dtype and parked.shape == shape:
-                        return parked.device_slice()
+                        out = parked.device_slice()
+                        if isinstance(out, np.ndarray) and not prefer_host:
+                            # Base already released to host (SharedBatch):
+                            # honor the jax.Array contract by re-uploading
+                            # — and re-park the uploaded array (same
+                            # offset/byte range) so repeat device readers
+                            # pay the upload once, as pre-release.
+                            out = jax.device_put(out, self.device)
+                            self._parked[offset] = out
+                        return out
                     # Reinterpretation: gather through the mirror below.
                 elif parked.dtype == np_dtype and parked.shape == shape:
                     return parked
