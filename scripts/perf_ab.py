@@ -71,11 +71,14 @@ def main():
             pool = 32
             batch_delay = None
             coalesce = False
+            sliced = False
             # Per-config knobs must reset between variants or a 'rateN'/
             # 'shardN' token would leak into every later server/analyzer
             # construction.
             os.environ["TPU_SERVER_BATCH_RATE_FACTOR"] = "1.0"
             os.environ.pop("PA_MUX_SHARD", None)
+            os.environ.pop("TPU_SERVER_BATCH_DISPATCHERS", None)
+            os.environ.pop("TPU_SERVER_BATCH_SERIAL_RATE", None)
             for p in parts[2:]:
                 if p.startswith("pool"):
                     pool = int(p[4:])
@@ -85,9 +88,16 @@ def main():
                     coalesce = True
                 elif p.startswith("rate"):
                     os.environ["TPU_SERVER_BATCH_RATE_FACTOR"] = p[4:]
+                elif p.startswith("disp"):
+                    os.environ["TPU_SERVER_BATCH_DISPATCHERS"] = p[4:]
+                elif p == "sliced":
+                    sliced = True
                 elif p.startswith("shard"):
                     os.environ["PA_MUX_SHARD"] = p[5:]
-            overlay = {"TPU_TRANSFER_COALESCE": "1" if coalesce else "0"}
+            overlay = {
+                "TPU_TRANSFER_COALESCE": "1" if coalesce else "0",
+                "TPU_SERVER_BATCH_ROWVIEW": "0" if sliced else "1",
+            }
             os.environ["TPU_STREAM_POOL_WORKERS"] = str(pool)
             os.environ["TPU_SERVER_GRPC_AIO"] = "1" if aio else "0"
             if batch_delay is None:

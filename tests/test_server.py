@@ -618,6 +618,13 @@ class TestBatchQueueDelay:
 
         monkeypatch.setenv("TPU_SERVER_DYNAMIC_BATCH", "1")
         monkeypatch.setenv("TPU_SERVER_BATCH_DELAY_US", "30000")
+        # Serial executor: this test exercises the HOLD mechanism; with
+        # the default 3 dispatchers, six fast CPU loops spread across
+        # free dispatchers and batches legitimately stay singletons.
+        monkeypatch.setenv("TPU_SERVER_BATCH_DISPATCHERS", "1")
+        # Force the serialize/accumulate regime regardless of measured
+        # arrival rate (the hold gate is what's under test).
+        monkeypatch.setenv("TPU_SERVER_BATCH_SERIAL_RATE", "1")
         from tritonclient_tpu.models.simple import SimpleModel
         from tritonclient_tpu.server._core import (
             CoreRequest,
@@ -639,8 +646,14 @@ class TestBatchQueueDelay:
 
         results = []
         lock = threading.Lock()
+        # All loops start together: overlapping arrivals are the premise
+        # being tested, and without the barrier a loaded 1-core CI host
+        # can stagger thread spin-up past the hold window (borderline
+        # execution counts — a scheduling flake, not a batching signal).
+        barrier = threading.Barrier(6)
 
         def run_n(n):
+            barrier.wait()
             for _ in range(n):
                 r = core.infer(req())
                 with lock:

@@ -450,73 +450,91 @@ class _Servicer:
         never hit); concurrent access from pool threads is benign under
         the GIL — a lost race just means one duplicate parse.
         """
-        core = self.core
-        want_final = _want_final(request)
         try:
-            gen = core.system_shm.generation + core.tpu_shm.generation
-            hit = cached_reqs.get(request.id)
-            if hit is not None and hit[2] == gen and request == hit[0]:
-                creq = hit[1]
-            else:
-                creq = request_to_core(request, core)
-                # Cache only all-shm-input requests: with no embedded
-                # data plane the parse holds no arrays a model could
-                # observe across requests.
-                if (
-                    request.id
-                    and creq.inputs
-                    and all(t.shm_region is not None for t in creq.inputs)
-                ):
-                    if len(cached_reqs) >= 128:
-                        cached_reqs.clear()
-                    cached_reqs[request.id] = (request, creq, gen)
-                else:
-                    cached_reqs.pop(request.id, None)
-            cresp = core.infer(creq)
-            if isinstance(cresp, CoreResponse) and all(
-                o.data is None and o.shm_region is not None
-                for o in cresp.outputs
-            ):
-                key = (
-                    want_final,
-                    cresp.id,
-                    cresp.model_name,
-                    cresp.model_version,
-                    tuple(sorted(cresp.parameters.items())),
-                    tuple(
-                        (
-                            o.name,
-                            o.datatype,
-                            tuple(o.shape),
-                            o.shm_kind,
-                            o.shm_region,
-                            o.shm_offset,
-                            o.shm_byte_size,
-                        )
-                        for o in cresp.outputs
-                    ),
-                )
-                hit = cached_resps.get(cresp.id)
-                if hit is not None and hit[0] == key:
-                    return [hit[1]]
-                msg = next(_stream_responses(request, cresp, want_final))
-                if cresp.id:
-                    if len(cached_resps) >= 128:
-                        cached_resps.clear()
-                    cached_resps[cresp.id] = (key, msg)
-                return [msg]
-            # Decoupled (or wire-data) path: return the lazy generator so
-            # multi-response models stream token-by-token on the handler
-            # thread instead of being materialized in a pool worker. Errors
-            # raised mid-generation fail THIS request (with its id echoed);
-            # the stream survives.
-            return _guard_stream(
-                _stream_responses(request, cresp, want_final), request.id
-            )
+            creq = self._parse_cached(request, cached_reqs)
+            cresp = self.core.infer(creq)
+            return self._respond_stream(request, cresp, cached_resps)
         except CoreError as e:
             return [_stream_error(str(e), request.id)]
         except Exception as e:  # mirror _infer_one's model-error wrapping:
             # a bug must fail THIS request, not tear down the stream.
+            return [_stream_error(f"inference failed: {e}", request.id)]
+
+    def _parse_cached(self, request, cached_reqs):
+        core = self.core
+        gen = core.system_shm.generation + core.tpu_shm.generation
+        hit = cached_reqs.get(request.id)
+        if hit is not None and hit[2] == gen and request == hit[0]:
+            return hit[1]
+        creq = request_to_core(request, core)
+        # Cache only all-shm-input requests: with no embedded
+        # data plane the parse holds no arrays a model could
+        # observe across requests.
+        if (
+            request.id
+            and creq.inputs
+            and all(t.shm_region is not None for t in creq.inputs)
+        ):
+            if len(cached_reqs) >= 128:
+                cached_reqs.clear()
+            cached_reqs[request.id] = (request, creq, gen)
+        else:
+            cached_reqs.pop(request.id, None)
+        return creq
+
+    def _respond_stream(self, request, cresp, cached_resps):
+        want_final = _want_final(request)
+        if isinstance(cresp, CoreResponse) and all(
+            o.data is None and o.shm_region is not None
+            for o in cresp.outputs
+        ):
+            key = (
+                want_final,
+                cresp.id,
+                cresp.model_name,
+                cresp.model_version,
+                tuple(sorted(cresp.parameters.items())),
+                tuple(
+                    (
+                        o.name,
+                        o.datatype,
+                        tuple(o.shape),
+                        o.shm_kind,
+                        o.shm_region,
+                        o.shm_offset,
+                        o.shm_byte_size,
+                    )
+                    for o in cresp.outputs
+                ),
+            )
+            hit = cached_resps.get(cresp.id)
+            if hit is not None and hit[0] == key:
+                return [hit[1]]
+            msg = next(_stream_responses(request, cresp, want_final))
+            if cresp.id:
+                if len(cached_resps) >= 128:
+                    cached_resps.clear()
+                cached_resps[cresp.id] = (key, msg)
+            return [msg]
+        # Decoupled (or wire-data) path: return the lazy generator so
+        # multi-response models stream token-by-token on the handler
+        # thread instead of being materialized in a pool worker. Errors
+        # raised mid-generation fail THIS request (with its id echoed);
+        # the stream survives.
+        return _guard_stream(
+            _stream_responses(request, cresp, want_final), request.id
+        )
+
+    def _infer_parsed(self, request, creq, cached_resps):
+        """Pool-path execution of an ALREADY-PARSED request (the feeder
+        parses exactly once; re-parsing wire-data tensors in the worker
+        would double the deserialization cost)."""
+        try:
+            cresp = self.core.infer(creq)
+            return self._respond_stream(request, cresp, cached_resps)
+        except CoreError as e:
+            return [_stream_error(str(e), request.id)]
+        except Exception as e:
             return [_stream_error(f"inference failed: {e}", request.id)]
 
     def _needs_serial(self, request) -> bool:
@@ -552,28 +570,63 @@ class _Servicer:
                     continue
             return False
 
+        def submit_one(request):
+            """Parse once, then route: batcher-eligible requests take the
+            two-phase path (the feeder submits WITHOUT waiting — no pool
+            hop, no worker wakeup — and the yielder finalizes in stream
+            order); everything else goes to the pool with the parse
+            already done. Returns (pending item, barrier callable|None);
+            the barrier callable blocks until the request has EXECUTED —
+            sequence/stateful traffic behind it must not reorder past
+            work still in the batcher or the pool."""
+            try:
+                creq = self._parse_cached(request, cached_reqs)
+            except CoreError as e:
+                return ("error", _stream_error(str(e), request.id)), None
+            except Exception as e:
+                return (
+                    ("error",
+                     _stream_error(f"inference failed: {e}", request.id)),
+                    None,
+                )
+            try:
+                fin = self.core.infer_submit(creq)
+            except CoreError as e:
+                return ("error", _stream_error(str(e), request.id)), None
+            if fin is not None:
+                def barrier(f=fin):
+                    try:
+                        f()  # wait() is idempotent; yielder re-calls it
+                    except Exception:
+                        pass  # the yielder reports the error in order
+                return ("deferred", request, fin), barrier
+            future = self._stream_pool.submit(
+                self._infer_parsed, request, creq, cached_resps
+            )
+            return future, future.exception
+
         def feeder():
             inflight = []
             try:
                 for request in request_iterator:
                     if self._stream_pool is None or self._needs_serial(request):
-                        for f in inflight:
-                            f.exception()  # barrier: drain the pipeline
+                        for barrier in inflight:
+                            barrier()  # drain batcher + pool pipeline
                         inflight = []
                         item = self._process_stream_request(
                             request, cached_reqs, cached_resps
                         )
                     else:
-                        item = self._stream_pool.submit(
-                            self._process_stream_request,
-                            request, cached_reqs, cached_resps,
-                        )
-                        inflight.append(item)
-                        if len(inflight) > 64:
-                            # Prune only finished futures: the serial
-                            # barrier must be able to drain every still-
-                            # running predecessor.
-                            inflight = [f for f in inflight if not f.done()]
+                        item, barrier = submit_one(request)
+                        if barrier is not None:
+                            inflight.append(barrier)
+                            if len(inflight) > 64:
+                                # Bound the barrier list; drain the
+                                # oldest half (completed ones return
+                                # instantly).
+                                for b in inflight[:32]:
+                                    b()
+                                inflight = inflight[32:]
                     if not safe_put(item):
                         return
             except Exception:
@@ -588,7 +641,22 @@ class _Servicer:
                 item = pending.get()
                 if item is None:
                     break
-                msgs = item.result() if hasattr(item, "result") else item
+                if isinstance(item, tuple) and item[0] == "deferred":
+                    _, request, fin = item
+                    try:
+                        msgs = self._respond_stream(
+                            request, fin(), cached_resps
+                        )
+                    except CoreError as e:
+                        msgs = [_stream_error(str(e), request.id)]
+                    except Exception as e:
+                        msgs = [_stream_error(
+                            f"inference failed: {e}", request.id
+                        )]
+                elif isinstance(item, tuple) and item[0] == "error":
+                    msgs = [item[1]]
+                else:
+                    msgs = item.result() if hasattr(item, "result") else item
                 # Lists are prebuilt responses; generators arrive wrapped
                 # by _guard_stream, which converts mid-generation errors.
                 yield from msgs
