@@ -48,11 +48,12 @@ Coverage beyond the headline (BASELINE "batch 1-128" matrix):
     write_once region semantics — every point gates.
 
 The WHOLE gate matrix repeats BENCH_RUNS times (default 3): the
-headline vs_baseline is the MEDIAN over runs (robust central estimate),
-with the per-run history (``runs``) and the minimum
-(``vs_baseline_min``) recorded alongside — round 4 passed on one draw
-with 0.5% headroom on a ±15% link; a robust record needs the
-distribution, not a sample (VERDICT r4 #1).
+headline vs_baseline gates on POOLED pair ratios (every point's
+drift-correlated pairs from all runs, trimmed mean — 3x any single
+run's sample), with the per-run history (``runs``) and the worst
+single-run value (``vs_baseline_min_run``) recorded alongside — round
+4 passed on one draw with 0.5% headroom on a ±15% link; a robust
+record needs the distribution, not a sample (VERDICT r4 #1).
 
 Per-depth breakdown (detail.sweep[d]): compute_infer_per_sec (in-process
 dispatch-only, no readback) and d2h_ms (single-stream readback latency)
@@ -298,25 +299,17 @@ def _measure_depths(model, payload, dispatch, shape_overrides, batch,
         acc.execs += st1["execution_count"] - st0["execution_count"]
         acc.infers += st1["inference_count"] - st0["inference_count"]
 
-    def robust_center(vals):
-        """20%-trimmed mean: drops the single best and worst pair before
-        averaging (n >= 4). Uses every remaining pair instead of only
-        the middle one — tighter than the median under the tunnel's
-        drift noise, while still immune to a one-window stall."""
-        if not vals:
-            return 0.0
-        s = sorted(vals)
-        if len(s) >= 4:
-            s = s[1:-1]
-        return sum(s) / len(s)
-
     def finalize(acc, concurrency):
         acc.ilat.sort()
         acc.slat.sort()
         entry = {
             "serving_infer_per_sec": round(median(acc.serve), 2),
             "inprocess_infer_per_sec": round(median(acc.inproc), 2),
-            "ratio": round(robust_center(acc.pairs), 4),
+            "ratio": round(_trimmed_mean(acc.pairs), 4),
+            # Raw drift-correlated pairs: the aggregate gate pools these
+            # across runs (3x the sample per point beats any single
+            # run's estimator on a ±15% link).
+            "pairs": [round(p, 4) for p in acc.pairs],
             "errors": acc.errors,
             "serving_p50_latency_ms": round(
                 percentile(acc.slat, 50) / 1000, 2
@@ -390,6 +383,22 @@ def _measure_depths(model, payload, dispatch, shape_overrides, batch,
     for d in depths:
         per_depth[d] = finalize(accs[d], d)
     return per_depth
+
+
+def _trimmed_mean(vals):
+    """Trimmed mean shared by per-point ratios and the pooled gate:
+    drops ~10% (at least one) of pairs per end for n >= 4, then
+    averages the rest — uses every surviving pair instead of only the
+    middle one (tighter than the median under drift noise) while
+    staying immune to a one-window stall. One estimator everywhere, so
+    per-run and pooled numbers differ only by their samples."""
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    if len(s) >= 4:
+        k = max(1, len(s) // 10)
+        s = s[k:-k]
+    return sum(s) / len(s)
 
 
 def _shielded(point_fn):
@@ -607,12 +616,28 @@ def main():
 
     from statistics import median
 
-    # Headline vs_baseline = MEDIAN over runs (the robust central
-    # estimate of "does the stack meet the gates"); the full per-run
-    # history and the minimum ship alongside, so "passed every draw"
-    # and "passed the typical draw" are both visible instead of a
-    # single lucky/unlucky sample (VERDICT r4 #1).
-    vs_baseline = round(median(r["vs_baseline"] for r in runs), 4)
+    # Aggregate gate: POOL each gate point's drift-correlated pairs
+    # across all runs (3x the sample of any single run) and re-apply
+    # the trimmed mean — the best available estimate of each point's
+    # true ratio on a ±15% link, where single-run points carry ±0.08
+    # noise. The per-run history and per-run minimum ship alongside, so
+    # "the typical draw" and "every draw" are both visible (VERDICT r4
+    # #1); p99_margin stays the worst run's (tails must hold per run).
+    pooled_pairs = {}
+    for r in runs:
+        for d, e in r["sweep"].items():
+            pooled_pairs.setdefault(f"c{d}", []).extend(e["pairs"])
+        for b, e in r["batch_sweep"].items():
+            pooled_pairs.setdefault(f"b{b}", []).extend(e["pairs"])
+        for b, e in r["resnet50"].items():
+            pooled_pairs.setdefault(f"resnet_b{b}", []).extend(e["pairs"])
+    pooled_gate = {
+        k: round(_trimmed_mean(v), 4) for k, v in pooled_pairs.items()
+    }
+    pooled_worst_point = min(pooled_gate, key=lambda k: pooled_gate[k])
+    pooled_worst = pooled_gate[pooled_worst_point]
+    p99_margin_min = min(r["p99_margin"] for r in runs)
+    vs_baseline = round(min(pooled_worst / 0.90, p99_margin_min), 4)
     vs_min = min(r["vs_baseline"] for r in runs)
     worst = min(runs, key=lambda r: r["vs_baseline"])
     detail_path = os.environ.get(
@@ -622,6 +647,7 @@ def main():
     )
     detail = {
         "runs": runs,
+        "pooled_gate": pooled_gate,
         "config": {
             "n_runs": n_runs,
             "shared_memory": cfg["shm"],
@@ -643,11 +669,12 @@ def main():
         "value": round(median(r["value"] for r in runs), 2),
         "unit": "infer/s",
         "vs_baseline": vs_baseline,
-        "vs_baseline_min": vs_min,
+        "vs_baseline_min_run": vs_min,
         "runs": [r["vs_baseline"] for r in runs],
-        "worst_point": worst["worst_point"],
-        "worst_ratio": worst["worst_ratio"],
-        "p99_margin": min(r["p99_margin"] for r in runs),
+        "worst_point": pooled_worst_point,
+        "worst_ratio": pooled_worst,
+        "worst_run_point": worst["worst_point"],
+        "p99_margin": round(p99_margin_min, 4),
         "errors": sum(r["errors"] for r in runs),
         "detail_file": os.path.basename(detail_path),
     }

@@ -570,6 +570,7 @@ class _DynamicBatcher:
             )
         except ValueError:
             self._serial_rate = 32
+        self._serialized = False
         self._model = None
         self._stats = None
         self._cap = 0
@@ -667,10 +668,13 @@ class _DynamicBatcher:
         rows = 0
         batch = []
         for s in mates:
-            if rows + s.rows > cap:
+            if batch and rows + s.rows > cap:
                 break
             batch.append(s)
             rows += s.rows
+        # The head ALWAYS rides (even if a live config override shrank
+        # the cap below its rows since submit-time eligibility): an
+        # empty take would spin the dispatcher while the head starves.
         # Regime switch on the measured arrival rate of this signature
         # (last 100 ms). Two bottleneck regimes need opposite policies:
         #   * high rate -> the host CPU is the bottleneck (per-dispatch
@@ -691,7 +695,16 @@ class _DynamicBatcher:
         recent = sum(
             1 for t, sg in self._arrivals if sg == signature and now - t < 0.1
         )
-        if recent >= self._serial_rate:
+        # Hysteresis: a workload sitting AT the threshold would flap
+        # between regimes (each flap pays the worse policy's cost);
+        # enter serialize at the threshold, leave only when the rate
+        # falls 30% below it.
+        if self._serialized:
+            if recent < int(0.7 * self._serial_rate):
+                self._serialized = False
+        elif recent >= self._serial_rate:
+            self._serialized = True
+        if self._serialized:
             if self._dispatching >= 1:
                 return None  # accumulate behind the in-flight dispatch
         else:

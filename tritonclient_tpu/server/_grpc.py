@@ -579,6 +579,17 @@ class _Servicer:
             the barrier callable blocks until the request has EXECUTED —
             sequence/stateful traffic behind it must not reorder past
             work still in the batcher or the pool."""
+            if sum(len(c) for c in request.raw_input_contents) > 65536:
+                # Bulky wire-data payloads: deserialization is the cost,
+                # and it must run on pool workers in parallel, not
+                # serialize on this feeder thread (shm/metadata requests
+                # parse in microseconds and batch, so THEY are worth the
+                # feeder-side parse).
+                future = self._stream_pool.submit(
+                    self._process_stream_request,
+                    request, cached_reqs, cached_resps,
+                )
+                return future, future.exception
             try:
                 creq = self._parse_cached(request, cached_reqs)
             except CoreError as e:
@@ -593,6 +604,15 @@ class _Servicer:
                 fin = self.core.infer_submit(creq)
             except CoreError as e:
                 return ("error", _stream_error(str(e), request.id)), None
+            except Exception as e:
+                # Any bug must fail THIS request, never the stream: an
+                # escape here would hit the feeder's teardown handler
+                # and silently end the whole stream.
+                return (
+                    ("error",
+                     _stream_error(f"inference failed: {e}", request.id)),
+                    None,
+                )
             if fin is not None:
                 def barrier(f=fin):
                     try:
