@@ -385,18 +385,20 @@ def _measure_depths(model, payload, dispatch, shape_overrides, batch,
     return per_depth
 
 
-def _trimmed_mean(vals):
+def _trimmed_mean(vals, min_trim=1):
     """Trimmed mean shared by per-point ratios and the pooled gate:
-    drops ~10% (at least one) of pairs per end for n >= 4, then
+    drops max(min_trim, ~10% of n) pairs per end for n >= 4, then
     averages the rest — uses every surviving pair instead of only the
     middle one (tighter than the median under drift noise) while
-    staying immune to a one-window stall. One estimator everywhere, so
-    per-run and pooled numbers differ only by their samples."""
+    staying immune to outlier windows. The pooled gate passes
+    min_trim = number of runs, preserving one-stall-PER-RUN immunity
+    (two ~hourly stalls landing in different runs at the same point
+    must both be trimmable)."""
     if not vals:
         return 0.0
     s = sorted(vals)
     if len(s) >= 4:
-        k = max(1, len(s) // 10)
+        k = min(max(min_trim, len(s) // 10), (len(s) - 1) // 2)
         s = s[k:-k]
     return sum(s) / len(s)
 
@@ -526,8 +528,8 @@ def main():
         "seq": int(os.environ.get("BENCH_SEQ", "128")),
         # Multi-run defaults trade per-run window count for run count:
         # 3 x 12 s samples MORE tunnel phases than 1 x 24 s; the
-        # headline is the median over runs with the min recorded beside
-        # it (vs_baseline_min).
+        # headline gates on POOLED pair ratios, with the per-run history
+        # and worst run (vs_baseline_min_run) recorded beside it.
         "seconds": float(
             os.environ.get("BENCH_SECONDS", "12" if multi else "24")
         ),
@@ -632,7 +634,8 @@ def main():
         for b, e in r["resnet50"].items():
             pooled_pairs.setdefault(f"resnet_b{b}", []).extend(e["pairs"])
     pooled_gate = {
-        k: round(_trimmed_mean(v), 4) for k, v in pooled_pairs.items()
+        k: round(_trimmed_mean(v, min_trim=len(runs)), 4)
+        for k, v in pooled_pairs.items()
     }
     pooled_worst_point = min(pooled_gate, key=lambda k: pooled_gate[k])
     pooled_worst = pooled_gate[pooled_worst_point]

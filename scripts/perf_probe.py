@@ -61,22 +61,36 @@ def main():
     leg = {"req": [], "srv": [], "resp": []}
     from tritonclient_tpu.server import _grpc as _sgrpc
 
-    _orig_process = _sgrpc._Servicer._process_stream_request
+    # The two-phase stream path splits parse (feeder) from response
+    # finalization (yielder): req leg stamps at parse entry, srv leg
+    # spans parse entry -> response built, which covers batcher queue +
+    # dispatch + finalize for deferred requests and the whole handler
+    # for pool/inline ones.
+    _orig_parse = _sgrpc._Servicer._parse_cached
+    _orig_respond = _sgrpc._Servicer._respond_stream
+    entry_ts = {}
+    exit_ts = {}
 
-    def _timed_process(self, request, cached_reqs, cached_resps):
+    def _timed_parse(self, request, cached_reqs):
         t_in = time.perf_counter()
         t_sub = submit_ts.get(request.id)
-        out = _orig_process(self, request, cached_reqs, cached_resps)
-        t_out = time.perf_counter()
         if t_sub is not None:
             leg["req"].append(t_in - t_sub)
-        leg["srv"].append(t_out - t_in)
+        entry_ts[request.id] = t_in
+        return _orig_parse(self, request, cached_reqs)
+
+    def _timed_respond(self, request, cresp, cached_resps):
+        out = _orig_respond(self, request, cresp, cached_resps)
+        t_out = time.perf_counter()
+        t_in = entry_ts.get(request.id)
+        if t_in is not None:
+            leg["srv"].append(t_out - t_in)
         # Response leg measured client-side: mux reader stamps arrival.
         exit_ts[request.id] = t_out
         return out
 
-    exit_ts = {}
-    _sgrpc._Servicer._process_stream_request = _timed_process
+    _sgrpc._Servicer._parse_cached = _timed_parse
+    _sgrpc._Servicer._respond_stream = _timed_respond
 
     class ProbeWorker(_Worker):
         """_run_streaming with the recv phase split into wait vs readback."""

@@ -247,6 +247,49 @@ class TestContinuousBatching:
             t.join()
         assert results == refs
 
+    def test_cancel_terminates_in_delivery_order(self):
+        """A cancelled request's None terminator is routed through the
+        delivery queue: it must arrive AFTER every token already in the
+        pipe, exactly once, and the freed slot must serve a new request
+        with correct tokens (no cross-talk from the cancelled one)."""
+        import time as _time
+
+        from tritonclient_tpu.models.gpt_engine import GenerationEngine
+
+        cfg = gpt.gpt_tiny(max_len=64)
+        params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+        engine = GenerationEngine(cfg, params, max_slots=2)
+        try:
+            prompt = np.array([[1, 5, 9, 2]], np.int32)
+            req = engine.submit(prompt, 40)
+            got = [req.out.get(timeout=120) for _ in range(3)]
+            assert all(t is not None for t in got)
+            req.cancelled = True
+            # Drain to the terminator; tokens may still flow first (the
+            # pipeline drains in order), then exactly one None.
+            tail = []
+            while True:
+                t = req.out.get(timeout=120)
+                if t is None:
+                    break
+                assert not isinstance(t, BaseException), t
+                tail.append(t)
+            _time.sleep(0.2)
+            assert req.out.empty(), "tokens delivered after the terminator"
+            # Freed capacity serves a fresh request token-identically.
+            p2 = np.array([[2, 4, 6]], np.int32)
+            ref = [int(t[0]) for t in gpt.generate_tokens(params, p2, 5, cfg)]
+            q2 = engine.submit(p2, 5).out
+            toks = []
+            while True:
+                t = q2.get(timeout=120)
+                if t is None:
+                    break
+                toks.append(int(t[0]))
+            assert toks == ref
+        finally:
+            engine.shutdown()
+
     def test_engine_served_over_grpc_with_genai_perf(self):
         from tritonclient_tpu.genai_perf import GenAIPerf
         from tritonclient_tpu.models.gpt_engine import GptEngineModel

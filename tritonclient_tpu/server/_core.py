@@ -570,7 +570,10 @@ class _DynamicBatcher:
             )
         except ValueError:
             self._serial_rate = 32
-        self._serialized = False
+        # Per-SIGNATURE regime state: the rate is measured per signature,
+        # so the hysteresis must be too — a shared flag would let a hot
+        # signature drag an unrelated one into the wrong regime.
+        self._serialized: Dict[tuple, bool] = {}
         self._model = None
         self._stats = None
         self._cap = 0
@@ -698,13 +701,18 @@ class _DynamicBatcher:
         # Hysteresis: a workload sitting AT the threshold would flap
         # between regimes (each flap pays the worse policy's cost);
         # enter serialize at the threshold, leave only when the rate
-        # falls 30% below it.
-        if self._serialized:
-            if recent < int(0.7 * self._serial_rate):
-                self._serialized = False
+        # falls 30% below it (at least 1 — a zero exit threshold could
+        # never be crossed and would latch serialize forever).
+        serialized = self._serialized.get(signature, False)
+        if serialized:
+            if recent < max(1, int(0.7 * self._serial_rate)):
+                serialized = False
         elif recent >= self._serial_rate:
-            self._serialized = True
-        if self._serialized:
+            serialized = True
+        if len(self._serialized) > 64 and signature not in self._serialized:
+            self._serialized.clear()  # bound churn from one-off shapes
+        self._serialized[signature] = serialized
+        if serialized:
             if self._dispatching >= 1:
                 return None  # accumulate behind the in-flight dispatch
         else:
