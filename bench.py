@@ -4,7 +4,10 @@ Measures the BASELINE.json north-star configuration — the perf_analyzer
 equivalent driving the full KServe v2 stack over **gRPC streaming with
 ``--shared-memory=tpu``** (device-buffer regions, only metadata on the
 wire) — against the raw in-process jit-compiled forward on the same model
-("≥90% of in-process JAX throughput"). Prints exactly one JSON line:
+("≥90% of in-process JAX throughput"). Prints one JSON line per
+completed run — the LAST line is the result (interim lines carry
+``partial_runs`` so a truncated invocation still records its finished
+runs) — of the form:
 
     {"metric": ..., "value": <client infer/s>, "unit": "infer/s",
      "vs_baseline": <min(worst_ratio/0.90, 2*inproc_p99/serve_p99)>}
@@ -22,13 +25,14 @@ whole matrix and overflowed the capture — ``BENCH_r04.json``
 
 The measured configuration is the flagship serving path end-to-end:
 BERT-base with the Pallas flash-attention kernel (BENCH_FLASH=1 default)
-behind the server's dynamic batcher (pressure-gated
-max_queue_delay = TPU_SERVER_BATCH_DELAY_US, default 8000), which
-executes concurrent requests as one device dispatch and parks row VIEWS
-of the shared output so the whole batch is read back with a single d2h
-transfer (utils/tpu_shared_memory.BatchRowView). The in-process
-comparator is the same jitted forward driven by N closed-loop threads
-with full h2d + readback per request.
+behind the server's dispatcher-threaded dynamic batcher (pressure-gated
+max_queue_delay = TPU_SERVER_BATCH_DELAY_US, default 2000 here; regime
+switch + hysteresis per PERF.md), which executes concurrent requests as
+batched device dispatches and parks row VIEWS of the shared output so a
+whole batch is read back with a single d2h transfer
+(utils/tpu_shared_memory.BatchRowView). The in-process comparator is
+the same jitted forward driven by N closed-loop threads with full h2d +
+readback per request.
 
 Methodology (axon-tunneled chip, ~100 ms/device-RPC; see
 scripts/perf_probe.py for the phase/leg breakdown tooling):
@@ -60,7 +64,7 @@ dispatch-only, no readback) and d2h_ms (single-stream readback latency)
 attribute any ratio miss to compute vs transfer vs dispatch.
 
 Env knobs: BENCH_MODEL (bert_base|simple), BENCH_BATCH (8), BENCH_SEQ
-(128), BENCH_RUNS (3), BENCH_SECONDS (15 multi-run / 24 single, per
+(128), BENCH_RUNS (3), BENCH_SECONDS (10 multi-run / 24 single, per
 depth per side), BENCH_WINDOWS (6 / 8), BENCH_CONCURRENCY ("8,16,32"),
 BENCH_SHM (tpu|system|none), BENCH_STREAMING (1), BENCH_FLASH (1),
 BENCH_BATCHING (1), BENCH_BATCH_SWEEP ("1,32,128"; "" disables),
@@ -433,8 +437,10 @@ def _shielded(point_fn):
 
 
 def _log(msg):
-    """Progress marker on stderr: the driver captures stdout's single
-    JSON line; a wedged or slow run must be attributable from stderr."""
+    """Progress marker on stderr: stdout carries only the result JSON
+    lines (one per completed run; the LAST line is the result — interim
+    lines are marked ``partial_runs``); a wedged or slow run must be
+    attributable from stderr."""
     print(f"[bench +{time.perf_counter() - _T0:.0f}s] {msg}",
           file=sys.stderr, flush=True)
 
@@ -527,11 +533,11 @@ def main():
         "batch": int(os.environ.get("BENCH_BATCH", "8")),
         "seq": int(os.environ.get("BENCH_SEQ", "128")),
         # Multi-run defaults trade per-run window count for run count:
-        # 3 x 12 s samples MORE tunnel phases than 1 x 24 s; the
+        # 3 x 10 s samples MORE tunnel phases than 1 x 24 s; the
         # headline gates on POOLED pair ratios, with the per-run history
         # and worst run (vs_baseline_min_run) recorded beside it.
         "seconds": float(
-            os.environ.get("BENCH_SECONDS", "12" if multi else "24")
+            os.environ.get("BENCH_SECONDS", "10" if multi else "24")
         ),
         "n_windows": int(
             os.environ.get("BENCH_WINDOWS", "6" if multi else "8")
@@ -552,7 +558,7 @@ def main():
         ],
         "sweep_depth": int(os.environ.get("BENCH_BATCH_SWEEP_DEPTH", "16")),
         "sweep_secs": float(
-            os.environ.get("BENCH_BATCH_SWEEP_SECONDS", "8" if multi else "12")
+            os.environ.get("BENCH_BATCH_SWEEP_SECONDS", "7" if multi else "12")
         ),
         "resnet_sweep": [
             int(x)
@@ -561,7 +567,7 @@ def main():
         ],
         "resnet_depth": int(os.environ.get("BENCH_RESNET_DEPTH", "8")),
         "resnet_secs": float(
-            os.environ.get("BENCH_RESNET_SECONDS", "8" if multi else "18")
+            os.environ.get("BENCH_RESNET_SECONDS", "7" if multi else "18")
         ),
         "resnet_write_once": os.environ.get(
             "BENCH_RESNET_WRITE_ONCE", "1") == "1",
@@ -612,10 +618,22 @@ def main():
         models.append(rm)
 
     runs = []
+    detail_path = os.environ.get(
+        "BENCH_DETAIL_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_DETAIL.json"),
+    )
     with InferenceServer(models=models, http=False) as server:
         for run_idx in range(n_runs):
             runs.append(_run_gate_matrix(run_idx, server, bert, rmodel, cfg))
+            # Emit after EVERY completed run (same schema, flushed): if
+            # an external timeout kills a later run, the last complete
+            # line still carries a parseable result for the runs that
+            # finished. The final line supersedes the interim ones.
+            _emit(runs, cfg, model_name, n_runs, detail_path, jax)
 
+
+def _emit(runs, cfg, model_name, n_runs, detail_path, jax):
     from statistics import median
 
     # Aggregate gate: POOL each gate point's drift-correlated pairs
@@ -643,11 +661,6 @@ def main():
     vs_baseline = round(min(pooled_worst / 0.90, p99_margin_min), 4)
     vs_min = min(r["vs_baseline"] for r in runs)
     worst = min(runs, key=lambda r: r["vs_baseline"])
-    detail_path = os.environ.get(
-        "BENCH_DETAIL_PATH",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     "BENCH_DETAIL.json"),
-    )
     detail = {
         "runs": runs,
         "pooled_gate": pooled_gate,
@@ -663,8 +676,12 @@ def main():
             "depths": cfg["depths"],
         },
     }
-    with open(detail_path, "w") as f:
+    # Atomic replace: an external timeout killing a LATER _emit mid-write
+    # must not truncate the previously valid detail file.
+    tmp_path = detail_path + ".tmp"
+    with open(tmp_path, "w") as f:
         json.dump(detail, f, indent=1)
+    os.replace(tmp_path, detail_path)
     # Compact driver-parseable line: the full matrix lives in the detail
     # file, NOT here (round 4's fat line overflowed the tail capture).
     result = {
@@ -681,7 +698,9 @@ def main():
         "errors": sum(r["errors"] for r in runs),
         "detail_file": os.path.basename(detail_path),
     }
-    print(json.dumps(result))
+    if len(runs) < n_runs:
+        result["partial_runs"] = len(runs)
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":
