@@ -175,10 +175,16 @@ class _Distributor:
     single-threaded.
     """
 
-    __slots__ = ("q", "free_q", "_sem", "_thread", "_engine")
+    __slots__ = ("q", "prio_q", "free_q", "_sem", "_thread", "_engine")
 
     def __init__(self, engine: "GenerationEngine", max_inflight: int = 3):
         self.q: "queue.Queue" = queue.Queue()
+        # First-token (prefill) deliveries jump the line: a prefill item
+        # is always its request's FIRST delivery, so overtaking OTHER
+        # requests' step deliveries cannot reorder anyone's stream — and
+        # it stops TTFT from queuing behind up to max_inflight step
+        # readbacks (~a readback RTT each on remote links).
+        self.prio_q: "queue.Queue" = queue.Queue()
         self.free_q: "queue.Queue" = queue.Queue()
         self._sem = threading.Semaphore(max_inflight)
         self._thread: Optional[threading.Thread] = None
@@ -188,9 +194,26 @@ class _Distributor:
         """Block until the in-flight window has room (engine loop side)."""
         self._sem.acquire()
 
-    def submit(self, nxt_dev, pairs):
+    def try_ticket(self, timeout: float) -> bool:
+        return self._sem.acquire(timeout=timeout)
+
+    def release_ticket(self):
+        """Return an acquired-but-unused ticket (no dispatch happened)."""
+        self._sem.release()
+
+    def submit(self, nxt_dev, pairs, first_token: bool = False):
+        """``first_token`` (prefill) items ride the priority lane AND
+        are exempt from the in-flight ticket window: admissions are
+        already bounded by the slot count, and making a new request's
+        prefill wait for a step-readback ticket (~a readback RTT) is
+        exactly the TTFT-under-load term. Step items take/release
+        tickets as usual."""
         self._start()
-        self.q.put(("deliver", nxt_dev, pairs))
+        if first_token:
+            self.prio_q.put(("deliver", nxt_dev, pairs))
+            self.q.put(("prio",))  # wake marker preserving queue blocking
+        else:
+            self.q.put(("deliver", nxt_dev, pairs))
 
     def submit_cancel(self, req):
         """Terminate a cancelled request IN DELIVERY ORDER: the None
@@ -216,9 +239,26 @@ class _Distributor:
 
     def _run(self):
         while True:
-            item = self.q.get()
-            if item is None:
-                return
+            # Priority lane first: pending first-token deliveries beat
+            # everything already queued. Prefill items never hold a
+            # dispatch ticket (see submit), so only q-sourced "deliver"
+            # items release the semaphore.
+            ticketed = False
+            try:
+                item = self.prio_q.get_nowait()
+            except queue.Empty:
+                item = self.q.get()
+                if item is None:
+                    return
+                if item[0] == "prio":
+                    # Wake marker: its payload lives in prio_q (it may
+                    # already have been drained by an earlier pass).
+                    try:
+                        item = self.prio_q.get_nowait()
+                    except queue.Empty:
+                        continue
+                else:
+                    ticketed = item[0] == "deliver"
             if item[0] == "cancel":
                 # Control item: no dispatch ticket to release.
                 req = item[1]
@@ -239,7 +279,8 @@ class _Distributor:
                         self._engine._broken = e
                     self._engine._cv.notify_all()
             finally:
-                self._sem.release()
+                if ticketed:
+                    self._sem.release()
 
     def _deliver(self, nxt_dev, pairs):
         """Deliver one dispatch's tokens (one readback serves them all).
@@ -457,13 +498,14 @@ class GenerationEngine:
                 self._temps = self._temps.at[slot].set(0.0)
 
     def _admit_into_free_slots(self):
+        admitted = []  # (slot, req, first_token_array, prompt_len)
         for slot in range(self.max_slots):
             if self._slot_req[slot] is not None:
                 continue
             try:
                 req = self._admit.get_nowait()
             except queue.Empty:
-                return
+                break
             if req.cancelled:
                 req.out.put(None)
                 continue
@@ -471,7 +513,9 @@ class GenerationEngine:
             bucket = self._bucket(l)
             padded = np.zeros((1, bucket), np.int32)
             padded[:, :l] = req.prompt
-            self._dist.dispatch_ticket()
+            # No dispatch ticket for prefills: admissions are bounded by
+            # the slot count, and blocking a NEW request's prefill on a
+            # step-readback ticket is the TTFT-under-load term.
             first, self._k, self._v = self._prefill(
                 self.params, self._k, self._v, jnp.asarray(padded),
                 jnp.int32(l), jnp.int32(slot), jnp.int32(req.seed),
@@ -482,18 +526,79 @@ class GenerationEngine:
             except AttributeError:
                 pass
             self._slot_req[slot] = req
-            # Device-scalar write — admission never blocks on a readback;
-            # the first token is DELIVERED through the delivery thread
-            # like step tokens (order per request is preserved: the
-            # distributor drains FIFO and this entry precedes any step
-            # including the slot).
-            self._tokens = self._tokens.at[slot].set(first[0])
-            self._pos = self._pos.at[slot].set(l)
-            self._seeds = self._seeds.at[slot].set(req.seed)
-            self._steps = self._steps.at[slot].set(1)
-            self._temps = self._temps.at[slot].set(req.temperature)
-            self._topks = self._topks.at[slot].set(req.top_k)
-            self._dist.submit(first, [(0, slot, req)])
+            admitted.append((slot, req, first, l))
+        if not admitted:
+            return
+        # Slot-state updates are device-op ENQUEUES (several per slot):
+        # a synchronized churn burst (batched steps finish batchmates
+        # together, their clients resubmit together) admits many slots
+        # at one loop top, and per-slot scalar writes would pay
+        # 6 x k enqueues on the burst tail — the TTFT p99 term on
+        # remote-dispatch links. One vectorized write per state vector
+        # (k=1 included: one code path, one warmable shape family), and
+        # ONE batched first-token delivery — k separate prio deliveries
+        # would re-pay the fixed per-readback cost k times on the
+        # delivery thread. Admission never blocks on a readback; order
+        # per request is preserved (the prio entry precedes any step
+        # including these slots).
+        firsts = jnp.concatenate([f for _, _, f, _ in admitted])
+        slots = jnp.array([s for s, _, _, _ in admitted], jnp.int32)
+        self._tokens = self._tokens.at[slots].set(firsts)
+        self._pos = self._pos.at[slots].set(
+            jnp.array([l for _, _, _, l in admitted], jnp.int32)
+        )
+        self._seeds = self._seeds.at[slots].set(
+            jnp.array([r.seed for _, r, _, _ in admitted], jnp.int32)
+        )
+        self._steps = self._steps.at[slots].set(1)
+        self._temps = self._temps.at[slots].set(
+            jnp.array(
+                [r.temperature for _, r, _, _ in admitted], jnp.float32
+            )
+        )
+        self._topks = self._topks.at[slots].set(
+            jnp.array([r.top_k for _, r, _, _ in admitted], jnp.int32)
+        )
+        try:
+            firsts.copy_to_host_async()
+        except AttributeError:
+            pass
+        self._dist.submit(
+            firsts,
+            [(i, slot, req) for i, (slot, req, _, _) in enumerate(admitted)],
+            first_token=True,
+        )
+
+    def warm_admission(self):
+        """Pre-execute the vectorized admission ops for every burst size
+        (each k compiles its own scatter/concat shapes on first use —
+        multi-second stalls on remote-compile links that must not land
+        inside a serving window). Safe on an idle engine: free slots'
+        state is rewritten with its current values."""
+        import jax
+
+        for k in range(1, self.max_slots + 1):
+            # Mirror the admission path's exact op shapes: host-array
+            # scatters for the request fields, device-concat for tokens.
+            slots = jnp.array(list(range(k)), jnp.int32)
+            firsts = jnp.concatenate(
+                [self._tokens[s : s + 1] for s in range(k)]
+            )
+            self._tokens = self._tokens.at[slots].set(firsts)
+            self._pos = self._pos.at[slots].set(
+                jnp.array([0] * k, jnp.int32)
+            )
+            self._seeds = self._seeds.at[slots].set(
+                jnp.array([0] * k, jnp.int32)
+            )
+            self._steps = self._steps.at[slots].set(1)
+            self._temps = self._temps.at[slots].set(
+                jnp.array([0.0] * k, jnp.float32)
+            )
+            self._topks = self._topks.at[slots].set(
+                jnp.array([0] * k, jnp.int32)
+            )
+        jax.block_until_ready(self._tokens)
 
     def _run(self):
         try:
@@ -556,7 +661,30 @@ class GenerationEngine:
                             self._thread = None
                             return
                 continue
-            self._dist.dispatch_ticket()
+            # Wait for a step ticket WITHOUT starving admissions: a new
+            # request's prefill is ticket-exempt and must dispatch while
+            # the step pipeline is full, or TTFT under load degrades to
+            # a step-readback wait.
+            got_ticket = self._dist.try_ticket(timeout=0.005)
+            while not got_ticket:
+                if self._stopping or self._broken is not None:
+                    break
+                self._process_frees()
+                self._release_cancelled()
+                self._admit_into_free_slots()
+                got_ticket = self._dist.try_ticket(timeout=0.005)
+            if not got_ticket:
+                continue  # stopping/broken handled at loop top
+            # Recompute: slots admitted during the ticket wait join this
+            # very step (their prefill already wrote KV + token state) —
+            # and every occupant may have finished/cancelled during the
+            # wait, in which case the ticket goes back unspent instead
+            # of dispatching a whole-bank step over garbage.
+            active = [s for s, r in enumerate(self._slot_req)
+                      if r is not None]
+            if not active:
+                self._dist.release_ticket()
+                continue
             nxt, self._k, self._v = self._step(
                 self.params, self._k, self._v, self._tokens, self._pos,
                 self._seeds, self._steps, self._temps, self._topks,
