@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""Validate Prometheus exposition output from the server's /metrics.
+
+Invoked from tier-1 tests (tests/test_observability.py) against the live
+endpoint, and usable standalone::
+
+    curl -s http://HOST:PORT/metrics | python scripts/check_metrics_exposition.py
+    python scripts/check_metrics_exposition.py metrics.txt
+
+Checks (exit 1 with one line per violation):
+  * every sample's metric family is preceded by ``# HELP`` and ``# TYPE``
+  * ``# TYPE`` names a valid Prometheus type
+  * sample lines parse, with correctly escaped label values
+    (backslash, quote, and newline must be escaped)
+  * histogram families: ``le`` bucket bounds strictly ascending, cumulative
+    bucket values non-decreasing, a ``+Inf`` bucket present, ``_count``
+    equal to the ``+Inf`` bucket, and ``_sum`` present
+"""
+
+import re
+import sys
+from typing import Dict, List, Tuple
+
+_VALID_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+_METRIC_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_HELP_RE = re.compile(rf"^# HELP ({_METRIC_NAME}) (.*)$")
+_TYPE_RE = re.compile(rf"^# TYPE ({_METRIC_NAME}) (\S+)$")
+_SAMPLE_RE = re.compile(
+    rf"^({_METRIC_NAME})(\{{.*\}})? ([^ ]+)( [0-9]+)?$"
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\[\\"n])*)"')
+
+
+def _parse_labels(raw: str, errors: List[str], lineno: int) -> Dict[str, str]:
+    """Parse {k="v",...}; any residue after consuming valid pairs means a
+    malformed pair or bad escaping."""
+    body = raw[1:-1]
+    labels: Dict[str, str] = {}
+    pos = 0
+    while pos < len(body):
+        m = _LABEL_RE.match(body, pos)
+        if m is None:
+            errors.append(
+                f"line {lineno}: bad label syntax or escaping near "
+                f"{body[pos:pos + 40]!r}"
+            )
+            return labels
+        labels[m.group(1)] = m.group(2)
+        pos = m.end()
+        if pos < len(body):
+            if body[pos] != ",":
+                errors.append(
+                    f"line {lineno}: expected ',' between labels, got "
+                    f"{body[pos]!r}"
+                )
+                return labels
+            pos += 1
+    return labels
+
+
+def _family_of(name: str, types: Dict[str, str]) -> str:
+    """Map a sample name back to its declared family (histogram/summary
+    series carry _bucket/_sum/_count suffixes)."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix) and name[: -len(suffix)] in types:
+            return name[: -len(suffix)]
+    return name
+
+
+def check_exposition(text: str) -> List[str]:
+    """Return a list of violations (empty = valid)."""
+    errors: List[str] = []
+    helps: Dict[str, str] = {}
+    types: Dict[str, str] = {}
+    # family -> list of (labels, float value, sample name, lineno)
+    samples: Dict[str, List[Tuple[Dict[str, str], float, str, int]]] = {}
+
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            m = _HELP_RE.match(line)
+            if m:
+                helps[m.group(1)] = m.group(2)
+                continue
+            m = _TYPE_RE.match(line)
+            if m:
+                if m.group(2) not in _VALID_TYPES:
+                    errors.append(
+                        f"line {lineno}: invalid TYPE '{m.group(2)}' for "
+                        f"{m.group(1)}"
+                    )
+                if m.group(1) in samples:
+                    errors.append(
+                        f"line {lineno}: # TYPE {m.group(1)} appears after "
+                        "its samples"
+                    )
+                types[m.group(1)] = m.group(2)
+                continue
+            continue  # other comments are legal
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            errors.append(f"line {lineno}: unparseable sample: {line!r}")
+            continue
+        name, raw_labels, value = m.group(1), m.group(2), m.group(3)
+        labels = (
+            _parse_labels(raw_labels, errors, lineno) if raw_labels else {}
+        )
+        try:
+            fvalue = float(value)
+        except ValueError:
+            errors.append(f"line {lineno}: non-numeric value {value!r}")
+            continue
+        family = _family_of(name, types)
+        samples.setdefault(family, []).append((labels, fvalue, name, lineno))
+
+    for family in samples:
+        if family not in helps:
+            errors.append(f"metric family {family} has no # HELP")
+        if family not in types:
+            errors.append(f"metric family {family} has no # TYPE")
+
+    for family, ftype in types.items():
+        if ftype != "histogram":
+            continue
+        # Group this family's series per label set (minus 'le').
+        series: Dict[tuple, dict] = {}
+        for labels, value, name, lineno in samples.get(family, []):
+            key = tuple(sorted(
+                (k, v) for k, v in labels.items() if k != "le"
+            ))
+            entry = series.setdefault(
+                key, {"buckets": [], "sum": None, "count": None}
+            )
+            if name == family + "_bucket":
+                if "le" not in labels:
+                    errors.append(
+                        f"line {lineno}: histogram bucket without 'le' label"
+                    )
+                    continue
+                le = labels["le"]
+                bound = float("inf") if le == "+Inf" else float(le)
+                entry["buckets"].append((bound, value, lineno))
+            elif name == family + "_sum":
+                entry["sum"] = value
+            elif name == family + "_count":
+                entry["count"] = value
+        for key, entry in series.items():
+            label_desc = "{%s}" % ",".join(f'{k}="{v}"' for k, v in key)
+            buckets = sorted(entry["buckets"])
+            if not buckets:
+                continue
+            bounds = [b for b, _, _ in buckets]
+            if len(set(bounds)) != len(bounds):
+                errors.append(
+                    f"{family}{label_desc}: duplicate bucket bounds"
+                )
+            if bounds[-1] != float("inf"):
+                errors.append(f"{family}{label_desc}: missing +Inf bucket")
+            prev = None
+            for bound, value, lineno in buckets:
+                if prev is not None and value < prev:
+                    errors.append(
+                        f"line {lineno}: {family}{label_desc} bucket "
+                        f'le="{bound}" value {value} < previous {prev} '
+                        "(non-monotonic histogram)"
+                    )
+                prev = value
+            if entry["sum"] is None:
+                errors.append(f"{family}{label_desc}: missing _sum")
+            if entry["count"] is None:
+                errors.append(f"{family}{label_desc}: missing _count")
+            elif bounds[-1] == float("inf") and entry["count"] != buckets[-1][1]:
+                errors.append(
+                    f"{family}{label_desc}: _count {entry['count']} != "
+                    f"+Inf bucket {buckets[-1][1]}"
+                )
+    return errors
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv:
+        with open(argv[0]) as f:
+            text = f.read()
+    else:
+        text = sys.stdin.read()
+    errors = check_exposition(text)
+    for error in errors:
+        print(error, file=sys.stderr)
+    if errors:
+        print(f"{len(errors)} exposition violation(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,529 @@
+"""Observability plane: request tracing, latency histograms, queue-depth
+gauge, structured logging, exposition validity, and the perf_analyzer
+server-stats report."""
+
+import importlib.util
+import json
+import os
+import re
+import urllib.request
+
+import numpy as np
+import pytest
+
+import tritonclient_tpu.grpc as grpcclient
+import tritonclient_tpu.http as httpclient
+from tritonclient_tpu.perf_analyzer import PerfAnalyzer
+from tritonclient_tpu.perf_analyzer._stats import RequestTimers
+from tritonclient_tpu.server import InferenceServer
+
+SPAN_ORDER = [
+    "REQUEST_RECV",
+    "QUEUE_START",
+    "COMPUTE_INPUT",
+    "COMPUTE_INFER",
+    "COMPUTE_OUTPUT",
+    "RESPONSE_SEND",
+]
+
+
+def _load_checker():
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", "check_metrics_exposition.py",
+    )
+    spec = importlib.util.spec_from_file_location("check_metrics", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture()
+def server():
+    # Function-scoped: each test gets pristine stats/trace/log state.
+    with InferenceServer() as s:
+        yield s
+
+
+def _http_inputs(shift=0):
+    inputs = []
+    for name in ("INPUT0", "INPUT1"):
+        inp = httpclient.InferInput(name, [2, 16], "INT32")
+        inp.set_data_from_numpy(
+            np.arange(32, dtype=np.int32).reshape(2, 16) + shift
+        )
+        inputs.append(inp)
+    return inputs
+
+
+def _grpc_inputs(shift=0):
+    inputs = []
+    for name in ("INPUT0", "INPUT1"):
+        inp = grpcclient.InferInput(name, [2, 16], "INT32")
+        inp.set_data_from_numpy(
+            np.arange(32, dtype=np.int32).reshape(2, 16) + shift
+        )
+        inputs.append(inp)
+    return inputs
+
+
+def _scrape(server) -> str:
+    with urllib.request.urlopen(
+        f"http://{server.http_address}/metrics"
+    ) as resp:
+        return resp.read().decode()
+
+
+# --------------------------------------------------------------------------- #
+# tracing                                                                     #
+# --------------------------------------------------------------------------- #
+
+
+def test_trace_lifecycle_all_spans_ordered(server, tmp_path):
+    """trace_level=TIMESTAMPS + trace_rate=1 set via the HTTP client traces
+    every request through both protocol front-ends: the trace JSON has all
+    six span timestamps in order and the compute spans agree with the
+    statistics endpoint's reported durations."""
+    trace_file = str(tmp_path / "trace.json")
+    client = httpclient.InferenceServerClient(server.http_address)
+    settings = client.update_trace_settings("", {
+        "trace_level": ["TIMESTAMPS"],
+        "trace_rate": ["1"],
+        "trace_file": [trace_file],
+        "log_frequency": ["1"],
+    })
+    assert settings["trace_level"] == ["TIMESTAMPS"]
+
+    for i in range(3):
+        client.infer("simple", _http_inputs(i), request_id=f"http-{i}")
+    gclient = grpcclient.InferenceServerClient(server.grpc_address)
+    for i in range(2):
+        gclient.infer("simple", _grpc_inputs(i), request_id=f"grpc-{i}")
+
+    stats = client.get_inference_statistics("simple")
+    inf = stats["model_stats"][0]["inference_stats"]
+    reported_ns = int(inf["success"]["ns"])
+
+    records = json.load(open(trace_file))
+    assert len(records) == 5
+    assert {r["request_id"] for r in records} == {
+        "http-0", "http-1", "http-2", "grpc-0", "grpc-1"
+    }
+    spanned_ns = 0
+    for record in records:
+        names = [t["name"] for t in record["timestamps"]]
+        assert names == SPAN_ORDER, names
+        ts = [t["ns"] for t in record["timestamps"]]
+        assert all(a <= b for a, b in zip(ts, ts[1:])), ts
+        assert record["model_name"] == "simple"
+        by = {t["name"]: t["ns"] for t in record["timestamps"]}
+        spanned_ns += by["COMPUTE_OUTPUT"] - by["COMPUTE_INPUT"]
+    # The traced compute spans cover input-resolve + model execution; the
+    # stats plane reports the same interval plus response build, so the
+    # trace total must be <= and within ~50 ms slack of the reported ns.
+    assert spanned_ns <= reported_ns
+    assert reported_ns - spanned_ns < 50_000_000
+    gclient.close()
+    client.close()
+
+
+def test_trace_rate_and_count(server, tmp_path):
+    trace_file = str(tmp_path / "sampled.json")
+    client = httpclient.InferenceServerClient(server.http_address)
+    client.update_trace_settings("", {
+        "trace_level": ["TIMESTAMPS"],
+        "trace_rate": ["2"],
+        "trace_file": [trace_file],
+        "log_frequency": ["1"],
+    })
+    for i in range(6):
+        client.infer("simple", _http_inputs(i))
+    assert len(json.load(open(trace_file))) == 3  # every 2nd request
+
+    # trace_count bounds the budget; resetting it opens a new budget.
+    count_file = str(tmp_path / "counted.json")
+    client.update_trace_settings("", {
+        "trace_rate": ["1"],
+        "trace_count": ["2"],
+        "trace_file": [count_file],
+    })
+    for i in range(5):
+        client.infer("simple", _http_inputs(i))
+    assert len(json.load(open(count_file))) == 2
+    client.close()
+
+
+def test_model_trace_override_tracks_global(server):
+    """Clearing a model-specific override (None value) reverts to TRACKING
+    the global setting — later global updates show through — instead of
+    snapshotting the global's current value (Triton semantics)."""
+    core = server.core
+    core.update_trace_settings("", {"trace_rate": "1000"})
+    core.update_trace_settings("simple", {"trace_rate": "5"})
+    assert core.get_trace_settings("simple")["trace_rate"] == ["5"]
+    # Clear the override; the model must now follow the global...
+    core.update_trace_settings("simple", {"trace_rate": None})
+    assert core.get_trace_settings("simple")["trace_rate"] == ["1000"]
+    # ...including global updates made AFTER the clear.
+    core.update_trace_settings("", {"trace_rate": "7"})
+    assert core.get_trace_settings("simple")["trace_rate"] == ["7"]
+    # Clearing a global setting restores the server default.
+    core.update_trace_settings("", {"trace_rate": None})
+    assert core.get_trace_settings("")["trace_rate"] == ["1000"]
+
+
+def test_trace_override_clear_via_clients(server):
+    """The None-clears contract over both wire protocols."""
+    hclient = httpclient.InferenceServerClient(server.http_address)
+    gclient = grpcclient.InferenceServerClient(server.grpc_address)
+    hclient.update_trace_settings("simple", {"trace_rate": "9"})
+    assert hclient.get_trace_settings("simple")["trace_rate"] == ["9"]
+    hclient.update_trace_settings("simple", {"trace_rate": None})
+    hclient.update_trace_settings("", {"trace_rate": "42"})
+    assert hclient.get_trace_settings("simple")["trace_rate"] == ["42"]
+
+    gclient.update_trace_settings("simple", {"trace_rate": "9"})
+    gclient.update_trace_settings("simple", {"trace_rate": None})
+    gclient.update_trace_settings("", {"trace_rate": "43"})
+    got = gclient.get_trace_settings("simple", as_json=True)
+    assert got["settings"]["trace_rate"]["value"] == ["43"]
+    gclient.close()
+    hclient.close()
+
+
+# --------------------------------------------------------------------------- #
+# metrics                                                                     #
+# --------------------------------------------------------------------------- #
+
+
+def test_duration_histogram_and_exposition_valid(server):
+    """/metrics exposes nv_inference_request_duration_us as a histogram:
+    buckets monotonic, +Inf count == success+fail, and the whole exposition
+    passes scripts/check_metrics_exposition.py."""
+    client = httpclient.InferenceServerClient(server.http_address)
+    for i in range(4):
+        client.infer("simple", _http_inputs(i))
+    # One recorded failure: mismatched batch dims defeat batching and make
+    # the jitted add raise inside model.infer.
+    bad0 = httpclient.InferInput("INPUT0", [2, 16], "INT32")
+    bad0.set_data_from_numpy(np.zeros((2, 16), np.int32))
+    bad1 = httpclient.InferInput("INPUT1", [3, 16], "INT32")
+    bad1.set_data_from_numpy(np.zeros((3, 16), np.int32))
+    from tritonclient_tpu.utils import InferenceServerException
+
+    with pytest.raises(InferenceServerException):
+        client.infer("simple", [bad0, bad1])
+
+    text = _scrape(server)
+    assert "# TYPE nv_inference_request_duration_us histogram" in text
+    buckets = re.findall(
+        r'nv_inference_request_duration_us_bucket\{model="simple",'
+        r'version="1",le="([^"]+)"\} (\d+)',
+        text,
+    )
+    assert buckets and buckets[-1][0] == "+Inf"
+    values = [int(v) for _, v in buckets]
+    assert values == sorted(values), "histogram buckets must be cumulative"
+    success = int(re.search(
+        r'nv_inference_request_success\{model="simple",version="1"\} (\d+)',
+        text).group(1))
+    failure = int(re.search(
+        r'nv_inference_request_failure\{model="simple",version="1"\} (\d+)',
+        text).group(1))
+    assert success == 4 and failure == 1
+    assert values[-1] == success + failure
+    count = int(re.search(
+        r'nv_inference_request_duration_us_count\{model="simple",'
+        r'version="1"\} (\d+)', text).group(1))
+    assert count == values[-1]
+    assert re.search(
+        r'nv_inference_request_duration_us_sum\{model="simple",'
+        r'version="1"\} (\d+)', text)
+
+    checker = _load_checker()
+    assert checker.check_exposition(text) == []
+    client.close()
+
+
+def test_queue_depth_gauge_returns_to_zero_when_idle(server):
+    client = httpclient.InferenceServerClient(server.http_address)
+    for i in range(3):
+        client.infer("simple", _http_inputs(i))
+    text = _scrape(server)
+    gauges = re.findall(
+        r"nv_inference_pending_request_count\{[^}]*\} (\d+)", text
+    )
+    assert gauges, "pending-request gauge missing"
+    assert all(int(g) == 0 for g in gauges), gauges
+    client.close()
+
+
+def test_metrics_exclude_unloaded_models(server):
+    """prometheus_metrics() honors readiness the way model_statistics()
+    does: unloading a model removes its rows from the scrape."""
+    client = httpclient.InferenceServerClient(server.http_address)
+    client.infer("simple", _http_inputs())
+    assert 'model="simple"' in _scrape(server)
+    client.unload_model("simple")
+    text = _scrape(server)
+    assert 'model="simple",' not in text
+    assert 'model="simple_string"' in text  # others still report
+    client.load_model("simple")
+    assert 'model="simple",' in _scrape(server)
+    client.close()
+
+
+def test_protocol_and_shm_metrics(server):
+    client = httpclient.InferenceServerClient(server.http_address)
+    gclient = grpcclient.InferenceServerClient(server.grpc_address)
+    client.infer("simple", _http_inputs())
+    gclient.infer("simple", _grpc_inputs())
+    text = _scrape(server)
+    assert re.search(
+        r'nv_inference_protocol_request_count\{protocol="http"\} [1-9]', text
+    )
+    assert re.search(
+        r'nv_inference_protocol_request_count\{protocol="grpc"\} [1-9]', text
+    )
+    assert re.search(
+        r'nv_shared_memory_region_count\{kind="system"\} \d+', text
+    )
+    assert re.search(
+        r'nv_shared_memory_region_count\{kind="tpu"\} \d+', text
+    )
+    gclient.close()
+    client.close()
+
+
+def test_exposition_checker_catches_violations():
+    checker = _load_checker()
+    # Missing TYPE.
+    bad = '# HELP m help\nm{a="b"} 1\n'
+    assert any("no # TYPE" in e for e in checker.check_exposition(bad))
+    # Bad label escaping (embedded unescaped quote).
+    bad = (
+        "# HELP m help\n# TYPE m counter\n"
+        'm{a="x"y"} 1\n'
+    )
+    assert any("escaping" in e or "label" in e
+               for e in checker.check_exposition(bad))
+    # Non-monotonic histogram buckets.
+    bad = (
+        "# HELP h help\n# TYPE h histogram\n"
+        'h_bucket{le="1"} 5\nh_bucket{le="2"} 3\nh_bucket{le="+Inf"} 5\n'
+        "h_sum 9\nh_count 5\n"
+    )
+    assert any("non-monotonic" in e for e in checker.check_exposition(bad))
+    # _count disagreeing with the +Inf bucket.
+    bad = (
+        "# HELP h help\n# TYPE h histogram\n"
+        'h_bucket{le="1"} 5\nh_bucket{le="+Inf"} 5\nh_sum 9\nh_count 7\n'
+    )
+    assert any("+Inf bucket" in e for e in checker.check_exposition(bad))
+    # Valid document passes.
+    good = (
+        "# HELP h help\n# TYPE h histogram\n"
+        'h_bucket{le="1"} 5\nh_bucket{le="+Inf"} 6\nh_sum 9\nh_count 6\n'
+    )
+    assert checker.check_exposition(good) == []
+
+
+# --------------------------------------------------------------------------- #
+# logging                                                                     #
+# --------------------------------------------------------------------------- #
+
+
+def test_log_settings_drive_structured_logger(server, tmp_path):
+    """v2/logging settings attach a real file sink; verbose level 1 emits a
+    per-request line."""
+    log_file = str(tmp_path / "server.log")
+    client = httpclient.InferenceServerClient(server.http_address)
+    try:
+        got = client.update_log_settings(
+            {"log_file": log_file, "log_verbose_level": 1}
+        )
+        assert got["log_file"] == log_file
+        client.infer("simple", _http_inputs(), request_id="logged-req")
+        contents = open(log_file).read()
+        assert "infer model=simple" in contents
+        assert "id=logged-req" in contents
+    finally:
+        # The logger is process-global: detach the file sink for later tests.
+        client.update_log_settings({"log_file": "", "log_verbose_level": 0})
+        client.close()
+
+
+# --------------------------------------------------------------------------- #
+# clients + perf_analyzer                                                     #
+# --------------------------------------------------------------------------- #
+
+
+def test_client_request_timers(server):
+    timers = RequestTimers()
+    client = httpclient.InferenceServerClient(server.http_address)
+    result = client.infer("simple", _http_inputs(), timers=timers)
+    assert result.timers is timers
+    assert timers.total_ns > 0
+    assert timers.send_ns >= 0 and timers.recv_ns >= 0
+    assert timers.request_start <= timers.send_start <= timers.send_end
+    client.close()
+
+    gtimers = RequestTimers()
+    gclient = grpcclient.InferenceServerClient(server.grpc_address)
+    gresult = gclient.infer("simple", _grpc_inputs(), timers=gtimers)
+    assert gresult.timers is gtimers and gtimers.total_ns > 0
+    gclient.close()
+
+
+def test_aio_client_request_timers(server):
+    import asyncio
+
+    import tritonclient_tpu.grpc.aio as agrpc
+    import tritonclient_tpu.http.aio as ahttp
+
+    async def run():
+        timers = RequestTimers()
+        async with ahttp.InferenceServerClient(server.http_address) as client:
+            result = await client.infer(
+                "simple", _http_inputs(), timers=timers
+            )
+            assert result.timers is timers and timers.total_ns > 0
+        gtimers = RequestTimers()
+        async with agrpc.InferenceServerClient(server.grpc_address) as client:
+            result = await client.infer(
+                "simple", _grpc_inputs(), timers=gtimers
+            )
+            assert result.timers is gtimers and gtimers.total_ns > 0
+
+    asyncio.run(run())
+
+
+def test_request_id_header_lands_in_trace(server, tmp_path):
+    """The triton-request-id header (no body id) tags the server trace."""
+    trace_file = str(tmp_path / "hdr.json")
+    client = httpclient.InferenceServerClient(server.http_address)
+    client.update_trace_settings("", {
+        "trace_level": ["TIMESTAMPS"], "trace_rate": ["1"],
+        "trace_file": [trace_file], "log_frequency": ["1"],
+    })
+    client.infer(
+        "simple", _http_inputs(),
+        headers={"triton-request-id": "from-header"},
+    )
+    records = json.load(open(trace_file))
+    assert records[-1]["request_id"] == "from-header"
+    client.close()
+
+
+def test_perf_analyzer_server_stats_breakdown(server):
+    """The sweep report includes the server-side queue/compute split, and
+    its totals reconcile with get_inference_statistics deltas."""
+    stats_client = grpcclient.InferenceServerClient(server.grpc_address)
+
+    def totals():
+        raw = stats_client.get_inference_statistics("simple", as_json=True)
+        inf = raw["model_stats"][0].get("inference_stats", {})
+
+        def num(section, field):
+            return int(inf.get(section, {}).get(field, 0))
+
+        return {
+            "success_count": num("success", "count"),
+            "queue_ns": num("queue", "ns"),
+            "compute_infer_ns": num("compute_infer", "ns"),
+        }
+
+    before = totals()
+    analyzer = PerfAnalyzer(
+        server.grpc_address, "simple", batch_size=2,
+        measurement_interval_s=0.5, warmup_s=0.1,
+    )
+    window = analyzer.measure(2)
+    after = totals()
+    summary = window.summary()
+    assert summary["errors"] == 0 and summary["count"] > 0
+
+    assert window.server_stats is not None
+    for key in ("server_request_count", "server_queue_us",
+                "server_compute_input_us", "server_compute_infer_us",
+                "server_compute_output_us"):
+        assert key in summary, key
+        assert summary[key] >= 0
+    # The window's delta must be bounded by the full before/after delta
+    # (the analyzer's snapshots sit inside ours).
+    full_delta = after["success_count"] - before["success_count"]
+    assert 0 < window.server_stats["success_count"] <= full_delta
+    assert (
+        window.server_stats["queue_ns"]
+        <= after["queue_ns"] - before["queue_ns"]
+    )
+    # Per-request client/server reconciliation: the server-side span cannot
+    # exceed what clients observed end-to-end.
+    server_avg_us = (
+        summary["server_queue_us"] + summary["server_compute_input_us"]
+        + summary["server_compute_infer_us"]
+        + summary["server_compute_output_us"]
+    )
+    assert server_avg_us <= summary["latency_avg_us"] * 2 + 1000
+    # Per-request timer percentiles surfaced next to the means.
+    for key in ("send_p50_us", "send_p99_us",
+                "receive_p50_us", "receive_p99_us"):
+        assert key in summary
+    stats_client.close()
+
+
+def test_perf_analyzer_run_traces_through_stream_and_batcher(server, tmp_path):
+    """Acceptance path: trace settings set via the HTTP client, then a
+    perf_analyzer run (gRPC streaming -> stream feeder -> dynamic batcher)
+    writes a trace JSON where every traced request carries all six span
+    timestamps in order."""
+    trace_file = str(tmp_path / "pa_trace.json")
+    client = httpclient.InferenceServerClient(server.http_address)
+    client.update_trace_settings("", {
+        "trace_level": ["TIMESTAMPS"],
+        "trace_rate": ["1"],
+        "trace_count": ["100"],  # bound file-rewrite work in the hot loop
+        "trace_file": [trace_file],
+        "log_frequency": ["10"],
+    })
+    analyzer = PerfAnalyzer(
+        server.grpc_address, "simple", batch_size=2, streaming=True,
+        measurement_interval_s=0.5, warmup_s=0.1,
+    )
+    summary = analyzer.measure(2).summary()
+    assert summary["errors"] == 0 and summary["count"] > 0
+    client.update_trace_settings("", {"trace_level": ["OFF"]})
+    server.core.trace_collector.flush()
+    records = json.load(open(trace_file))
+    assert records
+    for record in records:
+        names = [t["name"] for t in record["timestamps"]]
+        assert names == SPAN_ORDER, names
+        ts = [t["ns"] for t in record["timestamps"]]
+        assert all(a <= b for a, b in zip(ts, ts[1:])), ts
+    client.close()
+
+
+def test_perf_analyzer_cli_csv_has_percentiles_and_server_stats(
+    server, tmp_path, capsys
+):
+    import csv
+
+    from tritonclient_tpu.perf_analyzer.__main__ import main
+
+    csv_path = str(tmp_path / "sweep.csv")
+    rc = main([
+        "-m", "simple", "-u", server.grpc_address, "-b", "2",
+        "--concurrency-range", "1", "-p", "300", "--warmup-interval", "100",
+        "-f", csv_path,
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "client send p50/p90/p95/p99" in out
+    assert "server (" in out and "queue" in out
+    rows = list(csv.DictReader(open(csv_path)))
+    assert rows
+    for key in ("latency_p50_us", "latency_p99_us", "send_p99_us",
+                "receive_p99_us", "server_queue_us",
+                "server_compute_infer_us"):
+        assert key in rows[0], key

@@ -1,0 +1,310 @@
+"""Request tracing + structured logging for the in-process server.
+
+The L0 contract (SURVEY.md §4) includes ``v2/trace/setting`` and
+``v2/logging``; before this module the server only *stored* those settings.
+``TraceCollector`` makes them real: it samples requests per
+``trace_rate``/``trace_count`` when ``trace_level`` enables tracing, records
+Triton-shaped span timestamps for each sampled request
+
+    REQUEST_RECV -> QUEUE_START -> COMPUTE_INPUT -> COMPUTE_INFER
+        -> COMPUTE_OUTPUT -> RESPONSE_SEND
+
+and flushes Triton-compatible JSON trace records to ``trace_file`` every
+``log_frequency`` records. ``configure_logging`` turns the stored
+``v2/logging`` settings into an actual structured logger instead of dead
+state.
+
+All timestamps are ``time.monotonic_ns()`` — the same clock the statistics
+plane uses, so trace spans and ``get_inference_statistics`` durations are
+directly comparable.
+"""
+
+import json
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+# Canonical span-timestamp order for one traced request. The protocol
+# front-end records the first and last; the core records the middle four.
+SPAN_ORDER = (
+    "REQUEST_RECV",
+    "QUEUE_START",
+    "COMPUTE_INPUT",
+    "COMPUTE_INFER",
+    "COMPUTE_OUTPUT",
+    "RESPONSE_SEND",
+)
+
+# Keep at most this many finished records per trace file in memory (the
+# file is rewritten as a full JSON array on flush, so the cap bounds both
+# memory and rewrite cost for long-running servers).
+_MAX_RECORDS_PER_FILE = 100_000
+
+
+class TraceContext:
+    """One sampled request's trace: a dict of span-name -> monotonic ns.
+
+    ``record`` is first-write-wins so the batched and unbatched execution
+    paths can both name the same span without clobbering (e.g. QUEUE_START
+    is stamped by the dynamic batcher at enqueue when the request rides it,
+    and by the direct path otherwise).
+    """
+
+    __slots__ = (
+        "trace_id",
+        "model_name",
+        "model_version",
+        "request_id",
+        "timestamps",
+        "level",
+        "tensors",
+        "_collector",
+    )
+
+    def __init__(self, collector, trace_id, model_name, model_version,
+                 request_id, level):
+        self._collector = collector
+        self.trace_id = trace_id
+        self.model_name = model_name
+        self.model_version = model_version
+        self.request_id = request_id
+        self.level = tuple(level)
+        self.timestamps: Dict[str, int] = {}
+        self.tensors: Optional[List[dict]] = None
+
+    def record(self, name: str, ns: Optional[int] = None):
+        if name not in self.timestamps:
+            self.timestamps[name] = (
+                time.monotonic_ns() if ns is None else int(ns)
+            )
+
+    @property
+    def wants_tensors(self) -> bool:
+        return "TENSORS" in self.level
+
+    def set_tensors(self, tensors: List[dict]):
+        # Metadata only (name/datatype/shape): copying tensor payloads into
+        # trace records would turn tracing into a bandwidth tax.
+        self.tensors = tensors
+
+    def finish(self):
+        """Submit this trace to its collector. Idempotent — the stream
+        pipeline's ordering barrier and its yielder may both reach the
+        finalize step."""
+        collector, self._collector = self._collector, None
+        if collector is not None:
+            collector.submit(self)
+
+
+class TraceCollector:
+    """Samples requests per the stored trace settings and flushes
+    Triton-shaped JSON records.
+
+    One collector per ``InferenceCore``; both protocol front-ends and the
+    execution paths share it. Settings are passed per ``sample`` call (the
+    core resolves the per-model/global merge), so the collector itself holds
+    only sampling state: a per-model request counter for ``trace_rate`` and
+    the per-model remaining budget for ``trace_count``.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._rate_counters: Dict[str, int] = {}
+        self._remaining: Dict[str, int] = {}
+        self._count_origin: Dict[str, str] = {}
+        # trace_file -> list of finished record dicts (rewritten on flush).
+        self._records: Dict[str, List[dict]] = {}
+        self._unflushed: Dict[str, int] = {}
+        # trace_id -> (trace_file, log_frequency) captured at sample time:
+        # the settings in force when a trace STARTS govern where it lands.
+        self._policies: Dict[int, tuple] = {}
+
+    # -- sampling -------------------------------------------------------------
+
+    def sample(
+        self,
+        model_name: str,
+        settings: dict,
+        request_id: str = "",
+        model_version: str = "",
+        recv_ns: Optional[int] = None,
+    ) -> Optional[TraceContext]:
+        """Decide whether this request is traced; return its context or None.
+
+        Triton semantics: ``trace_rate`` N samples one request in every N;
+        ``trace_count`` is a remaining budget decremented per sampled trace
+        (-1 = unlimited, 0 = exhausted) that resets whenever the setting is
+        rewritten.
+        """
+        level = settings.get("trace_level") or ["OFF"]
+        if "OFF" in level or not (
+            "TIMESTAMPS" in level or "TENSORS" in level
+        ):
+            return None
+        try:
+            rate = int((settings.get("trace_rate") or ["1000"])[0])
+        except (ValueError, TypeError):
+            rate = 1000
+        rate = max(rate, 1)
+        raw_count = str((settings.get("trace_count") or ["-1"])[0])
+        with self._lock:
+            n = self._rate_counters.get(model_name, 0)
+            self._rate_counters[model_name] = n + 1
+            if n % rate != 0:
+                return None
+            if self._count_origin.get(model_name) != raw_count:
+                # trace_count was (re)set since the last sample: new budget.
+                self._count_origin[model_name] = raw_count
+                try:
+                    self._remaining[model_name] = int(raw_count)
+                except ValueError:
+                    self._remaining[model_name] = -1
+            remaining = self._remaining.get(model_name, -1)
+            if remaining == 0:
+                return None
+            if remaining > 0:
+                self._remaining[model_name] = remaining - 1
+            self._next_id += 1
+            trace_id = self._next_id
+        ctx = TraceContext(
+            self, trace_id, model_name, model_version, request_id, level
+        )
+        ctx_file = (settings.get("trace_file") or [""])[0]
+        try:
+            freq = int((settings.get("log_frequency") or ["0"])[0])
+        except (ValueError, TypeError):
+            freq = 0
+        with self._lock:
+            self._policies[ctx.trace_id] = (ctx_file, freq)
+        if recv_ns is not None:
+            ctx.record("REQUEST_RECV", recv_ns)
+        return ctx
+
+    # -- record assembly / flushing -------------------------------------------
+
+    def submit(self, ctx: TraceContext):
+        record = {
+            "id": ctx.trace_id,
+            "model_name": ctx.model_name,
+            "model_version": ctx.model_version or "1",
+            "request_id": ctx.request_id,
+            "timestamps": [
+                {"name": name, "ns": ctx.timestamps[name]}
+                for name in SPAN_ORDER
+                if name in ctx.timestamps
+            ]
+            + [
+                {"name": name, "ns": ns}
+                for name, ns in ctx.timestamps.items()
+                if name not in SPAN_ORDER
+            ],
+        }
+        if ctx.tensors is not None:
+            record["tensors"] = ctx.tensors
+        flush_file = None
+        with self._lock:
+            trace_file, freq = self._policies.pop(
+                ctx.trace_id, ("", 0)
+            )
+            records = self._records.setdefault(trace_file, [])
+            records.append(record)
+            if len(records) > _MAX_RECORDS_PER_FILE:
+                del records[: len(records) - _MAX_RECORDS_PER_FILE]
+            pending = self._unflushed.get(trace_file, 0) + 1
+            # log_frequency N flushes every N records; 0 (Triton: "write at
+            # trace end") flushes per record here — the in-process server
+            # has no end-of-trace moment, and an always-current file is what
+            # tests and perf tooling read.
+            if trace_file and pending >= max(freq, 1):
+                self._unflushed[trace_file] = 0
+                flush_file = trace_file
+                snapshot = list(records)
+            else:
+                self._unflushed[trace_file] = pending
+        if flush_file:
+            self._write(flush_file, snapshot)
+
+    def records(self, trace_file: str = "") -> List[dict]:
+        """Finished records for a trace file ('' = the in-memory sink)."""
+        with self._lock:
+            return list(self._records.get(trace_file, []))
+
+    def flush(self):
+        """Force every file sink to disk (e.g. at server stop)."""
+        with self._lock:
+            todo = [
+                (f, list(r)) for f, r in self._records.items() if f
+            ]
+            for f, _ in todo:
+                self._unflushed[f] = 0
+        for trace_file, snapshot in todo:
+            self._write(trace_file, snapshot)
+
+    @staticmethod
+    def _write(trace_file: str, records: List[dict]):
+        # Full-array rewrite keeps the file valid Triton-style JSON at every
+        # flush (readers never see a half-appended record).
+        try:
+            with open(trace_file, "w") as f:
+                json.dump(records, f)
+        except OSError:
+            logging.getLogger("tritonclient_tpu.server").warning(
+                "unable to write trace file %s", trace_file
+            )
+
+
+# --------------------------------------------------------------------------- #
+# structured logging                                                          #
+# --------------------------------------------------------------------------- #
+
+_LOG_FORMATS = {
+    "default": "%(asctime)s %(levelname).1s [%(name)s] %(message)s",
+    "ISO8601": "%(asctime)sZ %(levelname).1s [%(name)s] %(message)s",
+}
+_DATE_FORMATS = {
+    "default": "%m%d %H:%M:%S",
+    "ISO8601": "%Y-%m-%dT%H:%M:%S",
+}
+
+
+def configure_logging(settings: dict,
+                      logger_name: str = "tritonclient_tpu.server"):
+    """Apply ``v2/logging`` settings to a real logger.
+
+    ``log_file`` non-empty attaches a structured FileHandler (replacing any
+    handler this function previously attached — settings are idempotent);
+    empty detaches it. Level follows log_error/log_warning/log_info with
+    ``log_verbose_level`` >= 1 dropping to DEBUG, mirroring Triton's
+    --log-verbose.
+    """
+    logger = logging.getLogger(logger_name)
+    for handler in list(logger.handlers):
+        if getattr(handler, "_tpu_log_settings_owned", False):
+            logger.removeHandler(handler)
+            handler.close()
+    if int(settings.get("log_verbose_level", 0) or 0) >= 1:
+        level = logging.DEBUG
+    elif settings.get("log_info", True):
+        level = logging.INFO
+    elif settings.get("log_warning", True):
+        level = logging.WARNING
+    elif settings.get("log_error", True):
+        level = logging.ERROR
+    else:
+        level = logging.CRITICAL
+    logger.setLevel(level)
+    log_file = settings.get("log_file", "")
+    if log_file:
+        fmt = settings.get("log_format", "default")
+        handler = logging.FileHandler(log_file)
+        handler.setFormatter(
+            logging.Formatter(
+                _LOG_FORMATS.get(fmt, _LOG_FORMATS["default"]),
+                datefmt=_DATE_FORMATS.get(fmt, _DATE_FORMATS["default"]),
+            )
+        )
+        handler._tpu_log_settings_owned = True
+        logger.addHandler(handler)
+    return logger

@@ -468,8 +468,21 @@ class InferenceServerClient(InferenceServerClientBase):
         headers=None,
         compression_algorithm=None,
         parameters=None,
+        timers=None,
     ) -> InferResult:
-        """Synchronous inference (reference: grpc/_client.py:1445-1572)."""
+        """Synchronous inference (reference: grpc/_client.py:1445-1572).
+
+        ``timers``: optional ``perf_analyzer._stats.RequestTimers`` — when
+        given, the client stamps the request-phase timestamps into it
+        (send = proto marshalling, recv = result wrap) and attaches it to
+        the returned result as ``result.timers``. A non-empty
+        ``request_id`` is also propagated as ``triton-request-id``
+        metadata so server-side trace records can be joined to client
+        timing.
+        """
+        if timers is not None:
+            timers.capture("request_start")
+            timers.capture("send_start")
         request = _get_inference_request(
             infer_inputs=inputs,
             model_name=model_name,
@@ -483,14 +496,28 @@ class InferenceServerClient(InferenceServerClientBase):
             timeout=timeout,
             parameters=parameters,
         )
+        metadata = self._get_metadata(headers)
+        if request_id:
+            metadata = tuple(metadata or ()) + (
+                ("triton-request-id", request_id),
+            )
+        if timers is not None:
+            timers.capture("send_end")
         try:
             response = self._client_stub.ModelInfer(
                 request,
-                metadata=self._get_metadata(headers),
+                metadata=metadata,
                 timeout=client_timeout,
                 compression=grpc_compression_type(compression_algorithm),
             )
-            return InferResult(response)
+            if timers is not None:
+                timers.capture("recv_start")
+            result = InferResult(response)
+            if timers is not None:
+                timers.capture("recv_end")
+                timers.capture("request_end")
+                result.timers = timers
+            return result
         except grpc.RpcError as rpc_error:
             raise_error_grpc(rpc_error)
 

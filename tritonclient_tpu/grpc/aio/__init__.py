@@ -336,7 +336,15 @@ class InferenceServerClient(InferenceServerClientBase):
         headers=None,
         compression_algorithm=None,
         parameters=None,
+        timers=None,
     ) -> InferResult:
+        """``timers``: optional RequestTimers stamped around marshal /
+        RPC / result wrap, attached to the result as ``result.timers``;
+        ``request_id`` also rides as triton-request-id metadata (same
+        contract as the sync client)."""
+        if timers is not None:
+            timers.capture("request_start")
+            timers.capture("send_start")
         request = _get_inference_request(
             infer_inputs=inputs,
             model_name=model_name,
@@ -350,14 +358,28 @@ class InferenceServerClient(InferenceServerClientBase):
             timeout=timeout,
             parameters=parameters,
         )
+        metadata = self._get_metadata(headers)
+        if request_id:
+            metadata = tuple(metadata or ()) + (
+                ("triton-request-id", request_id),
+            )
+        if timers is not None:
+            timers.capture("send_end")
         try:
             response = await self._client_stub.ModelInfer(
                 request,
-                metadata=self._get_metadata(headers),
+                metadata=metadata,
                 timeout=client_timeout,
                 compression=grpc_compression_type(compression_algorithm),
             )
-            return InferResult(response)
+            if timers is not None:
+                timers.capture("recv_start")
+            result = InferResult(response)
+            if timers is not None:
+                timers.capture("recv_end")
+                timers.capture("request_end")
+                result.timers = timers
+            return result
         except grpc.RpcError as rpc_error:
             raise_error_grpc(rpc_error)
 

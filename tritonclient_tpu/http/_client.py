@@ -591,8 +591,20 @@ class InferenceServerClient(InferenceServerClientBase):
         request_compression_algorithm=None,
         response_compression_algorithm=None,
         parameters=None,
+        timers=None,
     ) -> InferResult:
-        """Synchronous inference (reference: http/_client.py:1331-1484)."""
+        """Synchronous inference (reference: http/_client.py:1331-1484).
+
+        ``timers``: optional ``perf_analyzer._stats.RequestTimers`` — when
+        given, the client stamps the six request-phase timestamps into it
+        (send = request marshalling, recv = response parse) and attaches it
+        to the returned result as ``result.timers``. A non-empty
+        ``request_id`` is also propagated as the ``triton-request-id``
+        header so server-side trace records can be joined to client timing.
+        """
+        if timers is not None:
+            timers.capture("request_start")
+            timers.capture("send_start")
         path, request_body, extra_headers = self._build_infer(
             model_name, inputs, model_version, outputs, request_id,
             sequence_id, sequence_start, sequence_end, priority, timeout,
@@ -601,14 +613,25 @@ class InferenceServerClient(InferenceServerClientBase):
         )
         all_headers = dict(headers) if headers else {}
         all_headers.update(extra_headers)
+        if request_id:
+            all_headers.setdefault("triton-request-id", request_id)
+        if timers is not None:
+            timers.capture("send_end")
         status, resp_headers, body = self._post(path, request_body, all_headers, query_params)
         _raise_if_error(status, body)
+        if timers is not None:
+            timers.capture("recv_start")
         header_length = resp_headers.get("Inference-Header-Content-Length")
-        return InferResult(
+        result = InferResult(
             body,
             int(header_length) if header_length is not None else None,
             resp_headers.get("Content-Encoding"),
         )
+        if timers is not None:
+            timers.capture("recv_end")
+            timers.capture("request_end")
+            result.timers = timers
+        return result
 
     def async_infer(
         self,

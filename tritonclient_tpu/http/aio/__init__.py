@@ -261,7 +261,15 @@ class InferenceServerClient(InferenceServerClientBase):
         request_compression_algorithm=None,
         response_compression_algorithm=None,
         parameters=None,
+        timers=None,
     ) -> InferResult:
+        """``timers``: optional RequestTimers stamped around marshal /
+        POST / result wrap, attached to the result as ``result.timers``;
+        ``request_id`` also rides as the triton-request-id header (same
+        contract as the sync client)."""
+        if timers is not None:
+            timers.capture("request_start")
+            timers.capture("send_start")
         request_body, json_size = _get_inference_request(
             inputs=inputs,
             request_id=request_id,
@@ -286,6 +294,10 @@ class InferenceServerClient(InferenceServerClientBase):
             all_headers["Accept-Encoding"] = "deflate"
         if json_size is not None:
             all_headers["Inference-Header-Content-Length"] = str(json_size)
+        if request_id:
+            all_headers.setdefault("triton-request-id", request_id)
+        if timers is not None:
+            timers.capture("send_end")
 
         path = f"v2/models/{model_name}"
         if model_version:
@@ -295,9 +307,16 @@ class InferenceServerClient(InferenceServerClientBase):
             path, request_body, all_headers, query_params
         )
         _raise_if_error(status, body)
+        if timers is not None:
+            timers.capture("recv_start")
         header_length = resp_headers.get("Inference-Header-Content-Length")
-        return InferResult(
+        result = InferResult(
             body,
             int(header_length) if header_length is not None else None,
             resp_headers.get("Content-Encoding"),
         )
+        if timers is not None:
+            timers.capture("recv_end")
+            timers.capture("request_end")
+            result.timers = timers
+        return result

@@ -167,12 +167,38 @@ def main(argv=None):
                 f"p99: {r['latency_p99_us']} usec"
                 + (f", errors: {r['errors']}" if r["errors"] else "")
             )
+            if "send_p50_us" in r:
+                print(
+                    f"  client send p50/p90/p95/p99: {r['send_p50_us']}/"
+                    f"{r['send_p90_us']}/{r['send_p95_us']}/"
+                    f"{r['send_p99_us']} usec, receive p50/p90/p95/p99: "
+                    f"{r['receive_p50_us']}/{r['receive_p90_us']}/"
+                    f"{r['receive_p95_us']}/{r['receive_p99_us']} usec"
+                )
+            if "server_queue_us" in r:
+                # Server-side split from the get_inference_statistics delta
+                # over this window (per request, microseconds).
+                print(
+                    f"  server ({r['server_request_count']} reqs, "
+                    f"{r['server_exec_count']} execs): queue "
+                    f"{r['server_queue_us']} usec, compute "
+                    f"input/infer/output {r['server_compute_input_us']}/"
+                    f"{r['server_compute_infer_us']}/"
+                    f"{r['server_compute_output_us']} usec"
+                )
     if not results:
         print("no measurement levels in --concurrency-range", file=sys.stderr)
         return 1
     if args.filename:
+        # Key union across levels: a per-window stats-snapshot failure must
+        # not make DictWriter reject the levels that did get server stats.
+        fieldnames = list(results[0])
+        for r in results[1:]:
+            for key in r:
+                if key not in fieldnames:
+                    fieldnames.append(key)
         with open(args.filename, "w", newline="") as f:
-            writer = csv.DictWriter(f, fieldnames=list(results[0]))
+            writer = csv.DictWriter(f, fieldnames=fieldnames, restval="")
             writer.writeheader()
             writer.writerows(results)
     return 0

@@ -20,6 +20,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from tritonclient_tpu.perf_analyzer._stats import (
+    SERVER_STAT_KEYS,
     InferStat,
     MeasurementWindow,
     RequestTimers,
@@ -175,6 +176,8 @@ class _Worker:
         self._out_name = f"pa{tag}_out_{wid}"
         self.stat = InferStat()
         self.latencies: List[int] = []
+        self.send_ns: List[int] = []
+        self.recv_ns: List[int] = []
         self.errors = 0
         self._stop = threading.Event()
         self._client = None
@@ -465,6 +468,8 @@ class _Worker:
             timers.capture("request_end")
             self.stat.update(timers)
             self.latencies.append(timers.total_ns)
+            self.send_ns.append(timers.send_ns)
+            self.recv_ns.append(timers.recv_ns)
 
     def _ensure_stream(self):
         """Start the long-lived bidi stream once; survives across windows."""
@@ -546,6 +551,8 @@ class _Worker:
             timers.capture("request_end")
             self.stat.update(timers)
             self.latencies.append(timers.total_ns)
+            self.send_ns.append(timers.send_ns)
+            self.recv_ns.append(timers.recv_ns)
 
 
 class _WindowWorker:
@@ -564,6 +571,8 @@ class _WindowWorker:
         self.slots = slots
         self.stat = InferStat()
         self.latencies: List[int] = []
+        self.send_ns: List[int] = []
+        self.recv_ns: List[int] = []
         self.errors = 0
         # Completions run on a pool; stat/latency/error updates need a lock
         # (unlike the closed-loop _Worker, which owns its counters).
@@ -748,6 +757,8 @@ class _WindowWorker:
                 with self._record_lock:
                     self.stat.update(timers)
                     self.latencies.append(timers.total_ns)
+                    self.send_ns.append(timers.send_ns)
+                    self.recv_ns.append(timers.recv_ns)
             if time.perf_counter() < end_time:
                 try:
                     submit(slot)
@@ -849,16 +860,29 @@ class MeasurementSession:
         time.sleep(warmup_s)
         for w in self.workers:
             w.latencies.clear()
+            w.send_ns.clear()
+            w.recv_ns.clear()
             w.stat = InferStat()
             w.errors = 0
+        # Server-side statistics snapshot at the warmup cut; the post-join
+        # snapshot closes the window and the delta becomes the server
+        # queue/compute breakdown in summary().
+        before = a._server_stats_snapshot()
         for t in threads:
             t.join()
         duration = time.perf_counter() - window_start
+        after = a._server_stats_snapshot() if before is not None else None
         window = MeasurementWindow(
             concurrency=self.concurrency, duration_s=duration
         )
+        if before is not None and after is not None:
+            window.server_stats = {
+                k: after[k] - before[k] for k in SERVER_STAT_KEYS
+            }
         for w in self.workers:
             window.latencies_ns.extend(w.latencies)
+            window.send_ns.extend(w.send_ns)
+            window.recv_ns.extend(w.recv_ns)
             window.errors += w.errors
             window.stat.completed_request_count += w.stat.completed_request_count
             window.stat.cumulative_total_request_time_ns += (
@@ -980,6 +1004,7 @@ class PerfAnalyzer:
         shm_mesh=None,
         shared_stream: bool = True,
         write_once: bool = False,
+        collect_server_stats: bool = True,
         verbose: bool = False,
     ):
         if protocol not in ("grpc", "http"):
@@ -1023,6 +1048,11 @@ class PerfAnalyzer:
         self.shm_mesh = shm_mesh
         if shm_mesh is not None and shared_memory != "tpu":
             raise ValueError("shm_mesh requires shared_memory='tpu'")
+        # Snapshot get_inference_statistics around each measurement window
+        # and report the server-side queue/compute split next to client
+        # latency (reference perf_analyzer composes its report the same
+        # way). Two extra RPCs per window; disable for adversarial servers.
+        self.collect_server_stats = collect_server_stats
         self.verbose = verbose
         self.run_id = int(time.time() * 1000) % 100000
 
@@ -1154,6 +1184,49 @@ class PerfAnalyzer:
         except Exception:
             pass
 
+    def _server_stats_snapshot(self):
+        """Cumulative get_inference_statistics totals for the target model,
+        normalized across protocols (SERVER_STAT_KEYS). None when disabled
+        or unavailable — a stats endpoint hiccup must not fail a sweep."""
+        if not self.collect_server_stats:
+            return None
+        try:
+            client = self.make_client()
+        except Exception:
+            return None
+        try:
+            if self.protocol == "grpc":
+                raw = client.get_inference_statistics(
+                    self.model_name, as_json=True
+                )
+            else:
+                raw = client.get_inference_statistics(self.model_name)
+            entry = (raw.get("model_stats") or [{}])[0]
+            inf = entry.get("inference_stats", {})
+
+            def num(section: str, field: str) -> int:
+                # MessageToDict renders uint64 as strings and omits zero
+                # fields entirely; tolerate both.
+                try:
+                    return int(inf.get(section, {}).get(field, 0))
+                except (TypeError, ValueError):
+                    return 0
+
+            return {
+                "success_count": num("success", "count"),
+                "fail_count": num("fail", "count"),
+                "inference_count": int(entry.get("inference_count", 0) or 0),
+                "execution_count": int(entry.get("execution_count", 0) or 0),
+                "queue_ns": num("queue", "ns"),
+                "compute_input_ns": num("compute_input", "ns"),
+                "compute_infer_ns": num("compute_infer", "ns"),
+                "compute_output_ns": num("compute_output", "ns"),
+            }
+        except Exception:
+            return None
+        finally:
+            self.close_client(client)
+
     # -- measurement ---------------------------------------------------------
 
     def session(self, concurrency: int) -> "MeasurementSession":
@@ -1184,12 +1257,22 @@ class PerfAnalyzer:
             time.sleep(self.warmup_s)
             with worker._record_lock:
                 worker.latencies.clear()
+                worker.send_ns.clear()
+                worker.recv_ns.clear()
                 worker.stat = InferStat()
                 worker.errors = 0
+            before = self._server_stats_snapshot()
             thread.join()
             duration = time.perf_counter() - window_start
+            after = self._server_stats_snapshot() if before is not None else None
             window = MeasurementWindow(concurrency=concurrency, duration_s=duration)
+            if before is not None and after is not None:
+                window.server_stats = {
+                    k: after[k] - before[k] for k in SERVER_STAT_KEYS
+                }
             window.latencies_ns.extend(worker.latencies)
+            window.send_ns.extend(worker.send_ns)
+            window.recv_ns.extend(worker.recv_ns)
             window.errors += worker.errors
             window.stat.completed_request_count += worker.stat.completed_request_count
             window.stat.cumulative_total_request_time_ns += (

@@ -8,7 +8,7 @@ reported the way perf_analyzer users expect.
 
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 
 @dataclass
@@ -64,6 +64,21 @@ def percentile(sorted_values: List[int], pct: float) -> int:
     return sorted_values[max(idx, 0)]
 
 
+# Keys of the server-side statistics delta captured around a measurement
+# window (see PerfAnalyzer._server_stats_snapshot): get_inference_statistics
+# totals before/after, subtracted.
+SERVER_STAT_KEYS = (
+    "success_count",
+    "fail_count",
+    "inference_count",
+    "execution_count",
+    "queue_ns",
+    "compute_input_ns",
+    "compute_infer_ns",
+    "compute_output_ns",
+)
+
+
 @dataclass
 class MeasurementWindow:
     """One concurrency level's results."""
@@ -73,6 +88,13 @@ class MeasurementWindow:
     latencies_ns: List[int] = field(default_factory=list)
     errors: int = 0
     stat: InferStat = field(default_factory=InferStat)
+    # Per-request send/receive samples (for percentile reporting, not just
+    # the cumulative means InferStat carries).
+    send_ns: List[int] = field(default_factory=list)
+    recv_ns: List[int] = field(default_factory=list)
+    # get_inference_statistics delta over this window (SERVER_STAT_KEYS),
+    # None when the snapshot was unavailable.
+    server_stats: Optional[Dict[str, int]] = None
 
     @property
     def throughput(self) -> float:
@@ -81,7 +103,9 @@ class MeasurementWindow:
     def summary(self, percentiles=(50, 90, 95, 99)) -> Dict:
         lat = sorted(self.latencies_ns)
         avg = sum(lat) / len(lat) if lat else 0
-        return {
+        send = sorted(self.send_ns)
+        recv = sorted(self.recv_ns)
+        out = {
             "concurrency": self.concurrency,
             "count": len(lat),
             "errors": self.errors,
@@ -101,4 +125,25 @@ class MeasurementWindow:
                 / max(self.stat.completed_request_count, 1)
                 / 1000
             ),
+            **{
+                f"send_p{p}_us": int(percentile(send, p) / 1000)
+                for p in percentiles
+            },
+            **{
+                f"receive_p{p}_us": int(percentile(recv, p) / 1000)
+                for p in percentiles
+            },
         }
+        if self.server_stats is not None:
+            s = self.server_stats
+            # Per-request server-side averages over the window's delta: the
+            # queue/compute split next to client-observed latency, the way
+            # reference perf_analyzer composes its report from the server's
+            # statistics endpoint.
+            n = max(s.get("success_count", 0), 1)
+            out["server_request_count"] = s.get("success_count", 0)
+            out["server_exec_count"] = s.get("execution_count", 0)
+            for key in ("queue", "compute_input", "compute_infer",
+                        "compute_output"):
+                out[f"server_{key}_us"] = int(s.get(f"{key}_ns", 0) / n / 1000)
+        return out

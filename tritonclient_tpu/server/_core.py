@@ -21,6 +21,7 @@ from typing import Dict, Iterator, List, Optional, Union
 
 import numpy as np
 
+from tritonclient_tpu._tracing import TraceCollector, configure_logging
 from tritonclient_tpu.utils import (
     deserialize_bytes_tensor,
     num_elements,
@@ -88,6 +89,11 @@ class CoreRequest:
     parameters: dict = field(default_factory=dict)
     inputs: List[CoreTensor] = field(default_factory=list)
     outputs: List[CoreRequestedOutput] = field(default_factory=list)
+    # Per-request TraceContext (tritonclient_tpu._tracing), attached by the
+    # protocol front-end when the request is sampled; the execution paths
+    # stamp the QUEUE_START/COMPUTE_* spans onto it. Excluded from equality
+    # so the gRPC stream's cached-parse comparison is unaffected.
+    trace: Optional[object] = field(default=None, compare=False)
 
 
 @dataclass
@@ -335,6 +341,15 @@ class TpuShmRegistry:
 # --------------------------------------------------------------------------- #
 
 
+# Histogram bucket upper bounds (microseconds) for per-request duration.
+# Spans 100us..5s: the knee-finding range for a serving sweep (BASELINE.md
+# p99 targets are single-digit ms; the tail buckets catch saturation).
+_DURATION_BUCKETS_US = (
+    100, 500, 1000, 5000, 10000, 25000, 50000,
+    100000, 250000, 500000, 1000000, 5000000,
+)
+
+
 class _ModelStats:
     def __init__(self):
         self.inference_count = 0
@@ -350,6 +365,21 @@ class _ModelStats:
         self.compute_input_ns = 0
         self.compute_infer_ns = 0
         self.compute_output_ns = 0
+        # Per-bucket (non-cumulative) request-duration counts; the +Inf
+        # bucket is the trailing slot. Every success AND failure observes
+        # exactly once, so +Inf cumulative == success_count + fail_count.
+        self.duration_buckets = [0] * (len(_DURATION_BUCKETS_US) + 1)
+        # Requests admitted (infer()/infer_submit()) but not yet answered:
+        # the queue-depth gauge. Returns to 0 when the server is idle.
+        self.pending = 0
+
+    def observe_duration(self, duration_ns: int):
+        us = duration_ns // 1000
+        for i, edge in enumerate(_DURATION_BUCKETS_US):
+            if us <= edge:
+                self.duration_buckets[i] += 1
+                return
+        self.duration_buckets[-1] += 1
 
     def as_dict(self, name: str, version: str) -> dict:
         return {
@@ -608,6 +638,8 @@ class _DynamicBatcher:
         )
         slot = _BatchSlot(request, signature,
                           int(request.inputs[0].shape[0]))
+        if request.trace is not None:
+            request.trace.record("QUEUE_START", slot.t_enqueue)
         with self._cv:
             # Per-model batcher: model/stats/cap are stable across calls.
             self._model, self._stats, self._cap = model, stats, cap
@@ -823,8 +855,18 @@ class InferenceCore:
         self._lock = threading.Lock()
         self.system_shm = SystemShmRegistry()
         self.tpu_shm = TpuShmRegistry()
+        # Trace settings: the "" entry is the complete global dict; model
+        # entries hold ONLY the keys explicitly overridden for that model,
+        # so un-overridden keys *track* later global updates (Triton
+        # semantics — get_trace_settings merges at read time).
         self._trace_settings: Dict[str, dict] = {"": dict(_DEFAULT_TRACE_SETTINGS)}
+        self.trace_collector = TraceCollector()
         self._log_settings = dict(_DEFAULT_LOG_SETTINGS)
+        self._log = logging.getLogger("tritonclient_tpu.server")
+        self._log_verbose = 0
+        # Per-protocol ingress counters ("http", "grpc"), fed by the
+        # front-ends via record_protocol_request.
+        self._protocol_requests: Dict[str, int] = {}
         self._batchers: Dict[str, _DynamicBatcher] = {}
         self._dynamic_batching = (
             os.environ.get("TPU_SERVER_DYNAMIC_BATCH", "1") != "0"
@@ -1005,10 +1047,6 @@ class InferenceCore:
             ("nv_inference_exec_count",
              "Number of model executions performed (batched)",
              lambda s: s.execution_count),
-            ("nv_inference_request_duration_us",
-             "Cumulative inference request duration in microseconds",
-             # Triton accumulates over ALL requests, failures included.
-             lambda s: (s.success_ns + s.fail_ns) // 1000),
             ("nv_inference_queue_duration_us",
              "Cumulative inference queuing duration in microseconds",
              lambda s: s.queue_ns // 1000),
@@ -1023,11 +1061,15 @@ class InferenceCore:
              lambda s: s.compute_output_ns // 1000),
         )
         with self._lock:
+            # Same readiness filter as model_statistics(): unloaded models
+            # must not report rows (their stats persist for a later reload,
+            # but a scrape only sees what is serving).
             rows = [
                 (name, self._repository[name].version, stats)
                 for name, stats in sorted(self._stats.items())
-                if name in self._repository
+                if name in self._repository and self._loaded.get(name, False)
             ]
+            proto_counts = sorted(self._protocol_requests.items())
         def esc(v: str) -> str:
             # Prometheus exposition label escaping: backslash, quote, LF.
             return (str(v).replace("\\", "\\\\").replace('"', '\\"')
@@ -1042,6 +1084,64 @@ class InferenceCore:
                     f'{metric}{{model="{esc(name)}",version="{esc(version)}"}} '
                     f"{getter(stats)}"
                 )
+        # Request-duration histogram (per-request latency distribution; the
+        # cumulative sum Triton reports as a counter is this family's _sum).
+        metric = "nv_inference_request_duration_us"
+        lines.append(
+            f"# HELP {metric} Inference request duration distribution "
+            "in microseconds"
+        )
+        lines.append(f"# TYPE {metric} histogram")
+        for name, version, stats in rows:
+            labels = f'model="{esc(name)}",version="{esc(version)}"'
+            cumulative = 0
+            for edge, count in zip(_DURATION_BUCKETS_US,
+                                   stats.duration_buckets):
+                cumulative += count
+                lines.append(
+                    f'{metric}_bucket{{{labels},le="{edge}"}} {cumulative}'
+                )
+            cumulative += stats.duration_buckets[-1]
+            lines.append(
+                f'{metric}_bucket{{{labels},le="+Inf"}} {cumulative}'
+            )
+            lines.append(
+                f"{metric}_sum{{{labels}}} "
+                f"{(stats.success_ns + stats.fail_ns) // 1000}"
+            )
+            lines.append(f"{metric}_count{{{labels}}} {cumulative}")
+        # Queue-depth gauge: requests admitted but not yet answered.
+        metric = "nv_inference_pending_request_count"
+        lines.append(
+            f"# HELP {metric} Number of inference requests awaiting "
+            "execution per model"
+        )
+        lines.append(f"# TYPE {metric} gauge")
+        for name, version, stats in rows:
+            lines.append(
+                f'{metric}{{model="{esc(name)}",version="{esc(version)}"}} '
+                f"{stats.pending}"
+            )
+        # Shared-memory registration gauges (system + tpu planes).
+        metric = "nv_shared_memory_region_count"
+        lines.append(
+            f"# HELP {metric} Number of registered shared memory regions"
+        )
+        lines.append(f"# TYPE {metric} gauge")
+        for kind, registry in (("system", self.system_shm),
+                               ("tpu", self.tpu_shm)):
+            lines.append(
+                f'{metric}{{kind="{kind}"}} {len(registry.status())}'
+            )
+        # Per-protocol ingress counters.
+        metric = "nv_inference_protocol_request_count"
+        lines.append(
+            f"# HELP {metric} Number of inference requests received per "
+            "protocol front-end"
+        )
+        lines.append(f"# TYPE {metric} counter")
+        for protocol, count in proto_counts:
+            lines.append(f'{metric}{{protocol="{esc(protocol)}"}} {count}')
         return "\n".join(lines) + "\n"
 
     def model_statistics(self, name: str = "", version: str = "") -> List[dict]:
@@ -1057,26 +1157,73 @@ class InferenceCore:
     # -- trace / log settings ------------------------------------------------
 
     def update_trace_settings(self, model_name: str = "", settings: Optional[dict] = None) -> dict:
-        current = self._trace_settings.setdefault(
-            model_name, dict(self._trace_settings[""])
-        )
-        for key, value in (settings or {}).items():
-            if key in ("trace_level", "trace_rate", "trace_count", "log_frequency", "trace_file", "trace_mode"):
-                if value is None:
-                    # Clear: fall back to global (or default for the global scope).
-                    current[key] = (
-                        list(_DEFAULT_TRACE_SETTINGS[key])
-                        if model_name == ""
-                        else list(self._trace_settings[""][key])
-                    )
-                else:
-                    current[key] = [str(v) for v in value] if isinstance(value, (list, tuple)) else [str(value)]
-            else:
+        for key in settings or {}:
+            if key not in _DEFAULT_TRACE_SETTINGS:
                 raise CoreError(f"Unknown trace setting: '{key}'", 400)
-        return dict(current)
+
+        def norm(value):
+            return (
+                [str(v) for v in value]
+                if isinstance(value, (list, tuple))
+                else [str(value)]
+            )
+
+        if model_name == "":
+            current = self._trace_settings[""]
+            for key, value in (settings or {}).items():
+                # Clearing a global setting restores the server default.
+                current[key] = (
+                    list(_DEFAULT_TRACE_SETTINGS[key])
+                    if value is None
+                    else norm(value)
+                )
+        else:
+            overrides = self._trace_settings.setdefault(model_name, {})
+            for key, value in (settings or {}).items():
+                if value is None:
+                    # Triton semantics: clearing a model override makes the
+                    # model TRACK the global setting again (later global
+                    # updates apply), not snapshot its current value.
+                    overrides.pop(key, None)
+                else:
+                    overrides[key] = norm(value)
+        return self.get_trace_settings(model_name)
 
     def get_trace_settings(self, model_name: str = "") -> dict:
-        return dict(self._trace_settings.get(model_name, self._trace_settings[""]))
+        merged = dict(self._trace_settings[""])
+        if model_name:
+            merged.update(self._trace_settings.get(model_name, {}))
+        return merged
+
+    def start_trace(
+        self,
+        model_name: str,
+        model_version: str = "",
+        request_id: str = "",
+        recv_ns: Optional[int] = None,
+    ):
+        """Sample one request against the effective trace settings.
+
+        Returns a TraceContext (attach it to the CoreRequest) or None.
+        Called by the protocol front-ends at ingress, before parse cost is
+        known — hence the fast OFF path.
+        """
+        ts = self._trace_settings
+        if len(ts) == 1 and ts[""]["trace_level"] == ["OFF"]:
+            return None  # hot path: tracing never enabled anywhere
+        return self.trace_collector.sample(
+            model_name,
+            self.get_trace_settings(model_name),
+            request_id=request_id,
+            model_version=model_version,
+            recv_ns=recv_ns,
+        )
+
+    def record_protocol_request(self, protocol: str):
+        with self._lock:
+            self._protocol_requests[protocol] = (
+                self._protocol_requests.get(protocol, 0) + 1
+            )
 
     def update_log_settings(self, settings: Optional[dict] = None) -> dict:
         for key, value in (settings or {}).items():
@@ -1084,6 +1231,14 @@ class InferenceCore:
                 raise CoreError(f"Unknown log setting: '{key}'", 400)
             if value is not None:
                 self._log_settings[key] = value
+        # Apply, not just store: the settings drive a real structured
+        # logger (file sink + level), and the verbose flag gates the
+        # per-request log line on the infer path.
+        configure_logging(self._log_settings)
+        try:
+            self._log_verbose = int(self._log_settings["log_verbose_level"])
+        except (TypeError, ValueError):
+            self._log_verbose = 0
         return dict(self._log_settings)
 
     def get_log_settings(self) -> dict:
@@ -1124,15 +1279,27 @@ class InferenceCore:
     ) -> Union[CoreResponse, Iterator[CoreResponse]]:
         model = self._get_model(request.model_name, request.model_version)
         stats = self._stats[request.model_name]
+        if self._log_verbose >= 1:
+            self._log.debug(
+                "infer model=%s version=%s id=%s inputs=%d",
+                request.model_name, request.model_version or "latest",
+                request.id, len(request.inputs),
+            )
         batcher = self._batchers.get(request.model_name)
-        # dynamic_batching re-checked on the CURRENT model: a file-override
-        # load shadows the opted-in model under the same name, and the
-        # effective cap follows live config overrides.
-        if batcher is not None and getattr(model, "dynamic_batching", False):
-            cap = self._effective_max_batch(model)
-            if batcher.eligible(request, cap):
-                return batcher.infer(model, request, stats, cap)
-        return self._infer_one(model, request, stats)
+        with self._lock:
+            stats.pending += 1
+        try:
+            # dynamic_batching re-checked on the CURRENT model: a file-override
+            # load shadows the opted-in model under the same name, and the
+            # effective cap follows live config overrides.
+            if batcher is not None and getattr(model, "dynamic_batching", False):
+                cap = self._effective_max_batch(model)
+                if batcher.eligible(request, cap):
+                    return batcher.infer(model, request, stats, cap)
+            return self._infer_one(model, request, stats)
+        finally:
+            with self._lock:
+                stats.pending -= 1
 
     def infer_submit(self, request: CoreRequest):
         """Two-phase inference for pipelined transports.
@@ -1151,11 +1318,32 @@ class InferenceCore:
             cap = self._effective_max_batch(model)
             if batcher.eligible(request, cap):
                 slot = batcher.submit(model, request, stats, cap)
-                return lambda: batcher.wait(slot, model)
+                with self._lock:
+                    stats.pending += 1
+                retired = [False]
+
+                def finalize():
+                    try:
+                        return batcher.wait(slot, model)
+                    finally:
+                        # finalize may run twice (ordering barrier + stream
+                        # yielder); the gauge must decrement exactly once.
+                        with self._lock:
+                            if not retired[0]:
+                                retired[0] = True
+                                stats.pending -= 1
+
+                return finalize
         return None
 
     def _infer_one(self, model, request: CoreRequest, stats) -> CoreResponse:
         t_start = time.monotonic_ns()
+        trace = request.trace
+        if trace is not None:
+            # Direct (unbatched) path: zero-length queue span. record() is
+            # first-write-wins, so a batcher-stamped QUEUE_START survives.
+            trace.record("QUEUE_START", t_start)
+            trace.record("COMPUTE_INPUT", t_start)
 
         # Resolve inputs (shm reads / typed views happen here).
         inputs: Dict[str, np.ndarray] = {}
@@ -1163,6 +1351,14 @@ class InferenceCore:
             inputs[tensor.name] = self._resolve_input(tensor)
         t_input = time.monotonic_ns()
         self._validate_inputs(model, inputs)
+        if trace is not None:
+            trace.record("COMPUTE_INFER", t_input)
+            if trace.wants_tensors:
+                trace.set_tensors([
+                    {"name": t.name, "datatype": t.datatype,
+                     "shape": list(t.shape)}
+                    for t in request.inputs
+                ])
 
         try:
             result = model.infer(inputs, dict(request.parameters))
@@ -1173,6 +1369,8 @@ class InferenceCore:
             self._record_failure(stats, t_start)
             raise CoreError(f"inference failed for model '{model.name}': {e}", 500)
         t_infer = time.monotonic_ns()
+        if trace is not None:
+            trace.record("COMPUTE_OUTPUT", t_infer)
 
         if model.decoupled and not isinstance(result, dict):
             return self._decoupled_responses(model, request, result, stats, t_start)
@@ -1190,12 +1388,21 @@ class InferenceCore:
             stats.compute_input_ns += t_input - t_start
             stats.compute_infer_ns += t_infer - t_input
             stats.compute_output_ns += t_end - t_infer
+            stats.observe_duration(t_end - t_start)
         return response
 
     def _record_failure(self, stats, t_start):
+        duration = time.monotonic_ns() - t_start
         with self._lock:
             stats.fail_count += 1
-            stats.fail_ns += time.monotonic_ns() - t_start
+            stats.fail_ns += duration
+            stats.observe_duration(duration)
+        if self._log_settings.get("log_error", True) and (
+            self._log_settings.get("log_file") or self._log_verbose >= 1
+        ):
+            # Gated on an active sink: an unconfigured logger would spray
+            # every expected-failure test through logging.lastResort.
+            self._log.error("inference request failed after %d ns", duration)
 
     def _validate_inputs(self, model, inputs: Dict[str, np.ndarray]):
         """Declared-input checks shared by the single and batched paths."""
@@ -1358,15 +1565,30 @@ class InferenceCore:
                     results[idx] = e
                     self._record_failure(stats, t_start)
             t_end = time.monotonic_ns()
+            for idx in live:
+                trace = requests[idx].trace
+                if trace is not None:
+                    # Shared batch timeline: every member's compute spans
+                    # are the batch's (Triton reports batched requests the
+                    # same way); QUEUE_START was stamped at slot enqueue.
+                    trace.record("COMPUTE_INPUT", t_start)
+                    trace.record("COMPUTE_INFER", t_input)
+                    trace.record("COMPUTE_OUTPUT", t_infer)
         except CoreError:
+            duration = time.monotonic_ns() - t_start
             with self._lock:
                 stats.fail_count += len(live)
-                stats.fail_ns += (time.monotonic_ns() - t_start) * len(live)
+                stats.fail_ns += duration * len(live)
+                for _ in live:
+                    stats.observe_duration(duration)
             raise
         except Exception as e:
+            duration = time.monotonic_ns() - t_start
             with self._lock:
                 stats.fail_count += len(live)
-                stats.fail_ns += (time.monotonic_ns() - t_start) * len(live)
+                stats.fail_ns += duration * len(live)
+                for _ in live:
+                    stats.observe_duration(duration)
             raise CoreError(
                 f"inference failed for model '{model.name}': {e}", 500
             )
@@ -1379,6 +1601,8 @@ class InferenceCore:
             stats.compute_input_ns += (t_input - t_start) * ok
             stats.compute_infer_ns += (t_infer - t_input) * ok
             stats.compute_output_ns += (t_end - t_infer) * ok
+            for _ in range(ok):
+                stats.observe_duration(t_end - t_start)
         return results
 
     def _decoupled_responses(self, model, request, result_iter, stats, t_start):
@@ -1419,6 +1643,7 @@ class InferenceCore:
                 stats.last_inference = int(time.time() * 1000)
                 stats.success_count += 1
                 stats.success_ns += t_end - t_start
+                stats.observe_duration(t_end - t_start)
 
         return gen()
 

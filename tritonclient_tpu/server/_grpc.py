@@ -7,6 +7,7 @@ contract the reference's streaming clients rely on (grpc/_client.py:1921-1923).
 """
 
 import threading
+import time
 from concurrent import futures
 from typing import Optional
 
@@ -50,6 +51,32 @@ def _stream_error(msg: str, request_id: str = "") -> pb.ModelStreamInferResponse
     if request_id:
         resp.infer_response.id = request_id
     return resp
+
+
+def _metadata_request_id(context) -> str:
+    """The triton-request-id invocation-metadata header, when the transport
+    exposes metadata (the aio shim context does not)."""
+    md = getattr(context, "invocation_metadata", None)
+    if md is None:
+        return ""
+    try:
+        pairs = md()
+    except Exception:
+        return ""
+    for key, value in pairs or ():
+        if key == "triton-request-id":
+            return value
+    return ""
+
+
+def _finish_trace(creq):
+    """Close a request's trace at protocol egress (response built/handed to
+    gRPC for serialization). Safe on None and idempotent — the stream
+    pipeline's ordering barrier may reach the finalize step first."""
+    trace = getattr(creq, "trace", None) if creq is not None else None
+    if trace is not None:
+        trace.record("RESPONSE_SEND")
+        trace.finish()
 
 
 def _status_for(e: CoreError) -> grpc.StatusCode:
@@ -428,10 +455,21 @@ class _Servicer:
     # -- inference -----------------------------------------------------------
 
     def ModelInfer(self, request, context):
+        t_recv = time.monotonic_ns()
+        self.core.record_protocol_request("grpc")
+        creq = None
         try:
             creq = request_to_core(request, self.core)
-            return _finalize_unary(self.core.infer(creq))
+            creq.trace = self.core.start_trace(
+                request.model_name, request.model_version,
+                request.id or _metadata_request_id(context),
+                recv_ns=t_recv,
+            )
+            resp = _finalize_unary(self.core.infer(creq))
+            _finish_trace(creq)
+            return resp
         except CoreError as e:
+            _finish_trace(creq)
             context.abort(_status_for(e), str(e))
 
     def _process_stream_request(self, request, cached_reqs, cached_resps):
@@ -450,14 +488,25 @@ class _Servicer:
         never hit); concurrent access from pool threads is benign under
         the GIL — a lost race just means one duplicate parse.
         """
+        t_recv = time.monotonic_ns()
+        creq = None
         try:
             creq = self._parse_cached(request, cached_reqs)
+            # Always (re)assigned — the cached-parse fast path reuses the
+            # CoreRequest object, so a stale trace must never survive.
+            creq.trace = self.core.start_trace(
+                request.model_name, request.model_version, request.id,
+                recv_ns=t_recv,
+            )
             cresp = self.core.infer(creq)
+            _finish_trace(creq)
             return self._respond_stream(request, cresp, cached_resps)
         except CoreError as e:
+            _finish_trace(creq)
             return [_stream_error(str(e), request.id)]
         except Exception as e:  # mirror _infer_one's model-error wrapping:
             # a bug must fail THIS request, not tear down the stream.
+            _finish_trace(creq)
             return [_stream_error(f"inference failed: {e}", request.id)]
 
     def _parse_cached(self, request, cached_reqs):
@@ -531,10 +580,13 @@ class _Servicer:
         would double the deserialization cost)."""
         try:
             cresp = self.core.infer(creq)
+            _finish_trace(creq)
             return self._respond_stream(request, cresp, cached_resps)
         except CoreError as e:
+            _finish_trace(creq)
             return [_stream_error(str(e), request.id)]
         except Exception as e:
+            _finish_trace(creq)
             return [_stream_error(f"inference failed: {e}", request.id)]
 
     def _needs_serial(self, request) -> bool:
@@ -579,6 +631,7 @@ class _Servicer:
             the barrier callable blocks until the request has EXECUTED —
             sequence/stateful traffic behind it must not reorder past
             work still in the batcher or the pool."""
+            t_recv = time.monotonic_ns()
             if sum(len(c) for c in request.raw_input_contents) > 65536:
                 # Bulky wire-data payloads: deserialization is the cost,
                 # and it must run on pool workers in parallel, not
@@ -600,6 +653,10 @@ class _Servicer:
                      _stream_error(f"inference failed: {e}", request.id)),
                     None,
                 )
+            creq.trace = self.core.start_trace(
+                request.model_name, request.model_version, request.id,
+                recv_ns=t_recv,
+            )
             try:
                 fin = self.core.infer_submit(creq)
             except CoreError as e:
@@ -614,12 +671,18 @@ class _Servicer:
                     None,
                 )
             if fin is not None:
-                def barrier(f=fin):
+                def fin_traced(f=fin, c=creq):
+                    try:
+                        return f()
+                    finally:
+                        _finish_trace(c)  # idempotent across barrier+yielder
+
+                def barrier(f=fin_traced):
                     try:
                         f()  # wait() is idempotent; yielder re-calls it
                     except Exception:
                         pass  # the yielder reports the error in order
-                return ("deferred", request, fin), barrier
+                return ("deferred", request, fin_traced), barrier
             future = self._stream_pool.submit(
                 self._infer_parsed, request, creq, cached_resps
             )
@@ -629,6 +692,7 @@ class _Servicer:
             inflight = []
             try:
                 for request in request_iterator:
+                    self.core.record_protocol_request("grpc")
                     if self._stream_pool is None or self._needs_serial(request):
                         for barrier in inflight:
                             barrier()  # drain batcher + pool pipeline
@@ -819,10 +883,21 @@ class _AioServicer:
         return self.core.infer(creq)
 
     async def ModelInfer(self, request, context):
+        t_recv = time.monotonic_ns()
+        self.core.record_protocol_request("grpc")
+        creq = None
         try:
             creq = request_to_core(request, self.core)
-            return _finalize_unary(await self._infer(creq))
+            creq.trace = self.core.start_trace(
+                request.model_name, request.model_version,
+                request.id or _metadata_request_id(context),
+                recv_ns=t_recv,
+            )
+            resp = _finalize_unary(await self._infer(creq))
+            _finish_trace(creq)
+            return resp
         except CoreError as e:
+            _finish_trace(creq)
             await context.abort(_status_for(e), str(e))
 
     async def ModelStreamInfer(self, request_iterator, context):
@@ -835,6 +910,7 @@ class _AioServicer:
         cached_resps: dict = {}
         loop = asyncio.get_running_loop()
         async for request in request_iterator:
+            self.core.record_protocol_request("grpc")
             if self._is_blocking(request.model_name):
                 # Blocking decoupled models (gpt, gpt_engine) generate
                 # tokens with real waits (queue.get, device round-trips).

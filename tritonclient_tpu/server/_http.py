@@ -13,6 +13,7 @@ import json
 import re
 import socket
 import threading
+import time
 import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
@@ -289,6 +290,12 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_json(None, 200)
 
     def _infer(self, model: str, version: str):
+        # Protocol-ingress timestamp: captured before the body is read so a
+        # trace's REQUEST_RECV covers wire parse time, matching Triton's
+        # HTTP_RECV span placement.
+        t_recv = time.monotonic_ns()
+        core = self.core
+        core.record_protocol_request("http")
         body = self._read_body()
         header_len = self.headers.get("Inference-Header-Content-Length")
         if header_len is not None:
@@ -305,6 +312,14 @@ class _Handler(BaseHTTPRequestHandler):
             id=header.get("id", ""),
             parameters=dict(header.get("parameters", {})),
         )
+        # Request-id propagation: the body id wins; the triton-request-id
+        # header lets clients tag trace records without touching the body.
+        trace = core.start_trace(
+            model, version,
+            request.id or self.headers.get("triton-request-id", ""),
+            recv_ns=t_recv,
+        )
+        request.trace = trace
 
         offset = 0
         for js in header.get("inputs", []):
@@ -340,7 +355,14 @@ class _Handler(BaseHTTPRequestHandler):
                 out.shm_kind = self.core.find_shm_kind(out.shm_region)
             request.outputs.append(out)
 
-        response = self.core.infer(request)
+        try:
+            response = self.core.infer(request)
+        except BaseException:
+            if trace is not None:
+                # Failed requests still produce a (partial) trace record.
+                trace.record("RESPONSE_SEND")
+                trace.finish()
+            raise
         if not isinstance(response, (list, tuple)) and not hasattr(response, "outputs"):
             # Decoupled over HTTP: drain the generator; only single-response
             # decoupled interactions are representable (matching Triton).
@@ -394,6 +416,11 @@ class _Handler(BaseHTTPRequestHandler):
             payload = header_bytes
             ctype = "application/json"
         self._send(200, payload, content_type=ctype, extra=extra)
+        if trace is not None:
+            # Protocol-egress timestamp: after the response bytes are on
+            # the socket, closing the trace's six-span timeline.
+            trace.record("RESPONSE_SEND")
+            trace.finish()
 
 
 class _TlsCapableHTTPServer(ThreadingHTTPServer):
