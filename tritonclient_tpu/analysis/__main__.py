@@ -1,0 +1,8 @@
+"""``python -m tritonclient_tpu.analysis`` — run tpulint."""
+
+import sys
+
+from tritonclient_tpu.analysis import main
+
+if __name__ == "__main__":
+    sys.exit(main())

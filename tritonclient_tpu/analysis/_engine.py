@@ -1,0 +1,274 @@
+"""tpulint core: file model, suppression handling, rule runner, reporters.
+
+The engine is deliberately small: a rule gets a parsed ``FileContext`` (or
+the whole list for project-level rules) and returns ``Finding`` objects;
+the engine owns file discovery, ``# tpulint: disable=RULE`` suppression,
+ordering, and output. Rules never print.
+"""
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+#: Files never worth analyzing: generated protobuf, caches, build output.
+_SKIP_PARTS = {"__pycache__", ".git", "build", ".eggs"}
+_SKIP_NAMES = {"kserve_pb2.py"}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpulint:\s*disable(?P<scope>-file)?\s*=\s*"
+    r"(?P<rules>[A-Z]+[0-9]+(?:\s*,\s*[A-Z]+[0-9]+)*)"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def text(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+
+
+class FileContext:
+    """One parsed source file plus the derived maps rules need."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path.replace(os.sep, "/")
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.aliases = _collect_aliases(self.tree)
+        self.file_suppressions: Set[str] = set()
+        self.line_suppressions: Dict[int, Set[str]] = {}
+        self._collect_suppressions()
+
+    # -- suppressions --------------------------------------------------------
+
+    def _collect_suppressions(self):
+        comment_lines: Dict[int, Set[str]] = {}
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if not m:
+                    continue
+                rules = {r.strip() for r in m.group("rules").split(",")}
+                if m.group("scope"):
+                    self.file_suppressions |= rules
+                else:
+                    comment_lines.setdefault(tok.start[0], set()).update(rules)
+        except tokenize.TokenError:
+            pass
+        for line, rules in comment_lines.items():
+            self.line_suppressions.setdefault(line, set()).update(rules)
+        # A suppression on (or immediately above) a def/class line covers the
+        # whole body — the idiom for "caller holds the lock" methods.
+        for node in ast.walk(self.tree):
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            first = min(
+                [node.lineno] + [d.lineno for d in node.decorator_list]
+            )
+            rules = set()
+            for line in (first - 1, first, node.lineno):
+                rules |= comment_lines.get(line, set())
+            if rules:
+                for line in range(node.lineno, (node.end_lineno or node.lineno) + 1):
+                    self.line_suppressions.setdefault(line, set()).update(rules)
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_suppressions:
+            return True
+        return rule in self.line_suppressions.get(line, ())
+
+    # -- shared AST helpers --------------------------------------------------
+
+    def canonical_call_name(self, func: ast.AST) -> Optional[str]:
+        """Dotted name of a call target with import aliases resolved.
+
+        ``_time.sleep`` -> ``time.sleep`` when the file did ``import time as
+        _time``; returns None for dynamic targets (``self.x()``, calls on
+        call results, subscripts).
+        """
+        parts: List[str] = []
+        node = func
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        parts.reverse()
+        head = self.aliases.get(parts[0])
+        if head is not None:
+            parts[0:1] = head.split(".")
+        return ".".join(parts)
+
+    def is_docstring(self, node: ast.Constant) -> bool:
+        parent = self.parents.get(node)
+        if not isinstance(parent, ast.Expr):
+            return False
+        grand = self.parents.get(parent)
+        return isinstance(
+            grand, (ast.Module, ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef)
+        )
+
+    def enclosing_function(self, node: ast.AST):
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def enclosing_class(self, node: ast.AST):
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+
+def _collect_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Flat import-alias map for the whole file (locals included: a
+    project linter does not need per-scope namespaces)."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                bound = alias.asname or alias.name.split(".")[0]
+                target = alias.name if alias.asname else alias.name.split(".")[0]
+                aliases[bound] = target
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                bound = alias.asname or alias.name
+                aliases[bound] = f"{node.module}.{alias.name}"
+    return aliases
+
+
+class Rule:
+    """Base rule. Subclasses set ``id``/``name``/``description`` and
+    implement ``check_file`` and/or ``check_project``."""
+
+    id = "TPU000"
+    name = "base"
+    description = ""
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        return []
+
+    def check_project(self, ctxs: Sequence[FileContext]) -> List[Finding]:
+        return []
+
+
+def default_rules() -> List[Rule]:
+    from tritonclient_tpu.analysis._tpu001_async_blocking import AsyncBlockingRule
+    from tritonclient_tpu.analysis._tpu002_lock_discipline import LockDisciplineRule
+    from tritonclient_tpu.analysis._tpu003_literals import ProtocolLiteralRule
+    from tritonclient_tpu.analysis._tpu004_dtype_map import DtypeMapRule
+    from tritonclient_tpu.analysis._tpu005_resource_leak import ResourceLeakRule
+
+    return [
+        AsyncBlockingRule(),
+        LockDisciplineRule(),
+        ProtocolLiteralRule(),
+        DtypeMapRule(),
+        ResourceLeakRule(),
+    ]
+
+
+def discover_files(paths: Iterable[str]) -> List[str]:
+    files: List[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            files.append(path)
+            continue
+        for root, dirs, names in os.walk(path):
+            dirs[:] = sorted(d for d in dirs if d not in _SKIP_PARTS)
+            for name in sorted(names):
+                if name.endswith(".py") and name not in _SKIP_NAMES:
+                    files.append(os.path.join(root, name))
+    return files
+
+
+def run_analysis(
+    paths: Sequence[str], select: Optional[Set[str]] = None
+):
+    """Lint ``paths`` (files or directories).
+
+    Returns ``(findings, files_checked)``; findings are sorted and already
+    filtered through suppressions.
+    """
+    rules = [r for r in default_rules() if select is None or r.id in select]
+    ctxs: List[FileContext] = []
+    findings: List[Finding] = []
+    files = discover_files(paths)
+    for path in files:
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            findings.append(Finding("PARSE", path, 1, 0, f"unreadable: {e}"))
+            continue
+        try:
+            ctxs.append(FileContext(path, source))
+        except SyntaxError as e:
+            findings.append(
+                Finding("PARSE", path, e.lineno or 1, 0, f"syntax error: {e.msg}")
+            )
+    for rule in rules:
+        for ctx in ctxs:
+            for finding in rule.check_file(ctx):
+                if not ctx.is_suppressed(finding.rule, finding.line):
+                    findings.append(finding)
+        for finding in rule.check_project(ctxs):
+            ctx = next((c for c in ctxs if c.path == finding.path), None)
+            if ctx is None or not ctx.is_suppressed(finding.rule, finding.line):
+                findings.append(finding)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, len(files)
+
+
+def render_text(findings: Sequence[Finding], files_checked: int) -> str:
+    lines = [f.text() for f in findings]
+    noun = "finding" if len(findings) == 1 else "findings"
+    lines.append(f"tpulint: {len(findings)} {noun} in {files_checked} files")
+    return "\n".join(lines)
+
+
+def render_json(findings: Sequence[Finding], files_checked: int) -> str:
+    return json.dumps(
+        {
+            "tool": "tpulint",
+            "files_checked": files_checked,
+            "findings": [f.to_dict() for f in findings],
+        },
+        indent=2,
+    )

@@ -1,0 +1,104 @@
+"""TPU001: blocking calls on async paths.
+
+Two legs:
+
+* Inside an ``async def`` body (stopping at nested sync ``def``s, which run
+  on executor threads): calls that block the event loop — ``time.sleep``,
+  sync socket / ``http.client`` / ``urllib`` / ``subprocess`` work, file
+  I/O via ``open``, and sync gRPC channel construction.
+* Anywhere: ``time.sleep``. An in-process serving stack runs event loops in
+  the same interpreter, so a sleep in sync code is one refactor away from
+  stalling an aio transport; deliberately-sync call sites (perf_analyzer
+  warmup windows, delay-simulation models) carry
+  ``# tpulint: disable=TPU001`` with a justification.
+"""
+
+import ast
+from typing import List
+
+from tritonclient_tpu.analysis._engine import FileContext, Finding, Rule
+
+_BLOCKING_EXACT = {
+    "time.sleep",
+    "open",
+    "io.open",
+    "os.system",
+    "os.popen",
+    "os.wait",
+    "os.waitpid",
+    "grpc.insecure_channel",
+    "grpc.secure_channel",
+    "socket.create_connection",
+    "socket.getaddrinfo",
+    "socket.gethostbyname",
+    "socket.socket",
+}
+_BLOCKING_PREFIXES = (
+    "http.client.",
+    "urllib.request.",
+    "requests.",
+    "subprocess.",
+)
+
+
+class AsyncBlockingRule(Rule):
+    id = "TPU001"
+    name = "async-blocking"
+    description = (
+        "blocking call (time.sleep, sync socket/HTTP/subprocess, file I/O, "
+        "sync gRPC) inside an async def, or time.sleep anywhere"
+    )
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        findings: List[Finding] = []
+        self._visit(ctx, ctx.tree, in_async=False, findings=findings)
+        return findings
+
+    def _visit(self, ctx, node, in_async, findings):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.AsyncFunctionDef):
+                self._visit(ctx, child, True, findings)
+            elif isinstance(child, ast.FunctionDef):
+                # Sync defs nested in async functions run off-loop
+                # (executors, callbacks): the async context does not extend
+                # into them.
+                self._visit(ctx, child, False, findings)
+            else:
+                if isinstance(child, ast.Call):
+                    self._check_call(ctx, child, in_async, findings)
+                self._visit(ctx, child, in_async, findings)
+
+    def _check_call(self, ctx, call, in_async, findings):
+        name = ctx.canonical_call_name(call.func)
+        if name is None:
+            return
+        if name == "time.sleep":
+            if in_async:
+                msg = (
+                    "time.sleep inside an async def blocks the event loop; "
+                    "use `await asyncio.sleep(...)`"
+                )
+            else:
+                msg = (
+                    "time.sleep stalls any event loop sharing this "
+                    "interpreter when reached from aio paths; use "
+                    "`await asyncio.sleep` on async paths or suppress "
+                    "deliberately-sync call sites"
+                )
+            findings.append(
+                Finding(self.id, ctx.path, call.lineno, call.col_offset, msg)
+            )
+            return
+        if not in_async:
+            return
+        if name in _BLOCKING_EXACT or name.startswith(_BLOCKING_PREFIXES):
+            findings.append(
+                Finding(
+                    self.id,
+                    ctx.path,
+                    call.lineno,
+                    call.col_offset,
+                    f"blocking call `{name}` inside an async def; route it "
+                    "through an executor or an aio equivalent",
+                )
+            )

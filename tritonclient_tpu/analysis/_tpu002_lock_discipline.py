@@ -1,0 +1,190 @@
+"""TPU002: lock discipline for classes that own a lock.
+
+For each class that creates a ``threading.Lock``/``RLock``/``Condition`` or
+``asyncio.Lock``/``Condition`` instance attribute, compute the set of
+*guarded* attributes — instance attributes accessed inside a ``with
+self.<lock>:`` block anywhere in the class — then flag every read or write
+of a guarded attribute performed outside such a block (``__init__`` and
+``__del__`` excepted: construction and teardown run before/after sharing).
+
+Deliberate lock-free accesses (GIL-atomic dict membership on a hot path,
+helpers whose caller holds the lock) are documented in place with
+``# tpulint: disable=TPU002`` — on the offending line, or on a ``def`` line
+to cover a whole caller-holds-the-lock method.
+"""
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from tritonclient_tpu.analysis._engine import FileContext, Finding, Rule
+
+_LOCK_FACTORIES = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "asyncio.Lock",
+    "asyncio.Condition",
+}
+
+#: Method calls on an attribute that mutate the underlying container.
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "remove", "pop", "popleft",
+    "popitem", "clear", "update", "setdefault", "add", "discard", "sort",
+}
+
+_EXEMPT_METHODS = {"__init__", "__del__", "__post_init__"}
+
+
+class LockDisciplineRule(Rule):
+    id = "TPU002"
+    name = "lock-discipline"
+    description = (
+        "instance attribute accessed under a class's lock in one method and "
+        "without it in another"
+    )
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(ctx, node))
+        return findings
+
+    # -- per-class analysis ---------------------------------------------------
+
+    def _check_class(self, ctx: FileContext, cls: ast.ClassDef) -> List[Finding]:
+        locks = self._lock_attrs(ctx, cls)
+        if not locks:
+            return []
+        # (attr, is_write, is_locked, method_name, node) for every
+        # ``self.X`` access whose nearest enclosing class is this one.
+        accesses = self._collect_accesses(ctx, cls, locks)
+        guarded: Set[str] = {a for a, _, locked, _, _ in accesses if locked}
+        guarded -= locks
+        # An attribute never written after construction cannot race — only
+        # attrs with at least one post-__init__ write stay in the set.
+        mutated = {
+            a for a, is_write, _, method, _ in accesses
+            if is_write and method not in _EXEMPT_METHODS
+        }
+        guarded &= mutated
+        if not guarded:
+            return []
+        findings = []
+        for attr, is_write, locked, method, node in accesses:
+            if locked or attr not in guarded:
+                continue
+            if method in _EXEMPT_METHODS:
+                continue
+            verb = "written" if is_write else "read"
+            lock_names = ", ".join(sorted("self." + lk for lk in locks))
+            findings.append(
+                Finding(
+                    self.id,
+                    ctx.path,
+                    node.lineno,
+                    node.col_offset,
+                    f"`self.{attr}` is guarded by {lock_names} elsewhere in "
+                    f"`{cls.name}` but {verb} here without holding it",
+                )
+            )
+        return findings
+
+    def _lock_attrs(self, ctx: FileContext, cls: ast.ClassDef) -> Set[str]:
+        locks: Set[str] = set()
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            has_lock_call = any(
+                isinstance(sub, ast.Call)
+                and ctx.canonical_call_name(sub.func) in _LOCK_FACTORIES
+                for sub in ast.walk(node.value)
+            )
+            if not has_lock_call:
+                continue
+            for target in node.targets:
+                if (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    locks.add(target.attr)
+        return locks
+
+    def _collect_accesses(
+        self, ctx: FileContext, cls: ast.ClassDef, locks: Set[str]
+    ) -> List[Tuple[str, bool, bool, str, ast.AST]]:
+        out: List[Tuple[str, bool, bool, str, ast.AST]] = []
+        lock_withs = self._lock_with_nodes(ctx, cls, locks)
+        for node in ast.walk(cls):
+            if not (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            ):
+                continue
+            if node.attr in locks:
+                continue
+            if ctx.enclosing_class(node) is not cls:
+                continue  # belongs to a nested class
+            method = self._method_name(ctx, cls, node)
+            if method is None:
+                continue  # class-body (not instance) access
+            locked = self._under_lock(ctx, node, lock_withs)
+            out.append((node.attr, self._is_write(ctx, node), locked, method, node))
+        return out
+
+    def _lock_with_nodes(self, ctx, cls, locks) -> Set[ast.AST]:
+        withs: Set[ast.AST] = set()
+        for node in ast.walk(cls):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            for item in node.items:
+                expr = item.context_expr
+                if (
+                    isinstance(expr, ast.Attribute)
+                    and isinstance(expr.value, ast.Name)
+                    and expr.value.id == "self"
+                    and expr.attr in locks
+                ):
+                    withs.add(node)
+                    break
+        return withs
+
+    def _method_name(self, ctx, cls, node):
+        cur = ctx.parents.get(node)
+        func = None
+        while cur is not None and cur is not cls:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                func = cur  # keep walking: the OUTERMOST def is the method
+            cur = ctx.parents.get(cur)
+        return func.name if func is not None else None
+
+    @staticmethod
+    def _under_lock(ctx, node, lock_withs) -> bool:
+        cur = ctx.parents.get(node)
+        while cur is not None:
+            if cur in lock_withs:
+                return True
+            cur = ctx.parents.get(cur)
+        return False
+
+    @staticmethod
+    def _is_write(ctx, node) -> bool:
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            return True
+        parent = ctx.parents.get(node)
+        if isinstance(parent, ast.Subscript) and parent.value is node:
+            if isinstance(parent.ctx, (ast.Store, ast.Del)):
+                return True
+        if isinstance(parent, ast.Attribute) and parent.value is node:
+            grand = ctx.parents.get(parent)
+            if (
+                isinstance(grand, ast.Call)
+                and grand.func is parent
+                and parent.attr in _MUTATORS
+            ):
+                return True
+        if isinstance(parent, ast.AugAssign) and parent.target is node:
+            return True
+        return False

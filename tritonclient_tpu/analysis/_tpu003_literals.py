@@ -1,0 +1,155 @@
+"""TPU003: protocol-literal conformance.
+
+``tritonclient_tpu/protocol/_literals.py`` is the single source of truth
+for KServe v2 endpoint paths and drift-prone JSON/parameter keys. Under the
+protocol front-ends (any path containing ``/http/``, ``/grpc/``, or
+``/server/``), this rule flags:
+
+* any ``v2``-prefixed path string (including f-string templates and
+  ``^v2``-anchored regex literals) spelled out instead of imported — the
+  historical HTTP/gRPC drift vector;
+* any literal equal to an enforced canonical key (``shared_memory_region``
+  and friends) instead of the ``KEY_*`` constant;
+* near-misses: strings that *look like* a datatype (``FP8``, ``INT33``) or
+  sit one edit away from a canonical key — wire drift that would otherwise
+  fail only at integration time.
+
+Docstrings are exempt (prose, not wire traffic); ``_literals.py`` itself is
+exempt (it is the definition site).
+"""
+
+import ast
+import re
+from typing import List, Optional
+
+from tritonclient_tpu.analysis._engine import FileContext, Finding, Rule
+from tritonclient_tpu.protocol import _literals as lit
+
+_SCOPE_PARTS = ("/http/", "/grpc/", "/server/")
+_EXEMPT_SUFFIXES = ("/_literals.py",)
+
+_ENFORCED_KEYS = {
+    lit.KEY_SHM_REGION,
+    lit.KEY_SHM_OFFSET,
+    lit.KEY_SHM_BYTE_SIZE,
+    lit.KEY_BINARY_DATA,
+    lit.KEY_BINARY_DATA_SIZE,
+    lit.KEY_BINARY_DATA_OUTPUT,
+    lit.KEY_CLASSIFICATION,
+    lit.KEY_SEQUENCE_ID,
+    lit.KEY_SEQUENCE_START,
+    lit.KEY_SEQUENCE_END,
+    lit.KEY_EMPTY_FINAL_RESPONSE,
+    lit.KEY_FINAL_RESPONSE,
+    lit.KEY_UNLOAD_DEPENDENTS,
+}
+
+_DATATYPE_SHAPE_RE = re.compile(r"^(U?INT|FP|BF)[0-9]+$")
+
+
+def _edit_distance_at_most_one(a: str, b: str) -> bool:
+    if a == b:
+        return False
+    la, lb = len(a), len(b)
+    if abs(la - lb) > 1:
+        return False
+    if la == lb:
+        return sum(x != y for x, y in zip(a, b)) == 1
+    if la > lb:
+        a, b, la, lb = b, a, lb, la
+    # one insertion turns a into b
+    i = j = edits = 0
+    while i < la and j < lb:
+        if a[i] == b[j]:
+            i += 1
+            j += 1
+        else:
+            edits += 1
+            if edits > 1:
+                return False
+            j += 1
+    return True
+
+
+class ProtocolLiteralRule(Rule):
+    id = "TPU003"
+    name = "protocol-literal"
+    description = (
+        "wire literal under http/, grpc/, or server/ duplicating or "
+        "near-missing the canonical set in protocol/_literals.py"
+    )
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        path = "/" + ctx.path.lstrip("/")
+        if not any(part in path for part in _SCOPE_PARTS):
+            return []
+        if path.endswith(_EXEMPT_SUFFIXES):
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            value: Optional[str] = None
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                if ctx.is_docstring(node):
+                    continue
+                if self._inside_fstring(ctx, node):
+                    continue  # judged as part of the whole JoinedStr
+                value = node.value
+            elif isinstance(node, ast.JoinedStr):
+                value = self._template(node)
+            if value is None:
+                continue
+            msg = self._judge(value)
+            if msg is not None:
+                findings.append(
+                    Finding(self.id, ctx.path, node.lineno, node.col_offset, msg)
+                )
+        return findings
+
+    @staticmethod
+    def _inside_fstring(ctx, node) -> bool:
+        cur = ctx.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.JoinedStr):
+                return True
+            if isinstance(cur, ast.stmt):
+                return False
+            cur = ctx.parents.get(cur)
+        return False
+
+    @staticmethod
+    def _template(node: ast.JoinedStr) -> str:
+        parts = []
+        for piece in node.values:
+            if isinstance(piece, ast.Constant) and isinstance(piece.value, str):
+                parts.append(piece.value)
+            else:
+                parts.append("{}")
+        return "".join(parts)
+
+    def _judge(self, value: str) -> Optional[str]:
+        if value.startswith("v2/") or value in ("v2", "^v2") or value.startswith(
+            ("v2?", "^v2/")
+        ):
+            return (
+                f"endpoint literal {value!r} spelled outside "
+                "protocol/_literals.py; import or build it from "
+                "tritonclient_tpu.protocol._literals"
+            )
+        if value in _ENFORCED_KEYS:
+            return (
+                f"wire key {value!r} duplicates a canonical literal; import "
+                "the KEY_* constant from tritonclient_tpu.protocol._literals"
+            )
+        if _DATATYPE_SHAPE_RE.match(value) and value not in lit.DATATYPES:
+            return (
+                f"{value!r} looks like a datatype string but is not in "
+                "protocol/_literals.DATATYPES — wire drift?"
+            )
+        if len(value) >= 10:
+            for key in _ENFORCED_KEYS:
+                if len(key) >= 10 and _edit_distance_at_most_one(value, key):
+                    return (
+                        f"{value!r} is one edit away from canonical wire key "
+                        f"{key!r} — wire drift?"
+                    )
+        return None

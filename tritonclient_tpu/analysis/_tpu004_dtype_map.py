@@ -1,0 +1,213 @@
+"""TPU004: numpy<->Triton datatype tables must be mutually inverse and total.
+
+Static leg (runs on whatever files are linted): extract the datatype tables
+``_NP_TO_TRITON`` (dict values + later ``table[...] = "DT"`` augmentations)
+and ``_TRITON_DTYPE_SIZES`` (dict keys) from their definition sites and
+cross-check them against the canonical ``DATATYPES`` registry (taken from a
+linted ``_literals.py`` when present, else from the installed
+``tritonclient_tpu.protocol._literals``): every mapped name must be
+canonical, and the size table must cover exactly the fixed-size set.
+
+Runtime leg (only when the linted file IS the real
+``tritonclient_tpu/utils/__init__.py``): import the tables and verify
+``np_to_triton_dtype(triton_to_np_dtype(dt)) == dt`` for every fixed-size
+datatype and that ``triton_dtype_size`` matches the numpy itemsize —
+mutual inversion the AST cannot see through the dict comprehension.
+"""
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tritonclient_tpu.analysis._engine import FileContext, Finding, Rule
+
+_NP_TABLE = "_NP_TO_TRITON"
+_SIZE_TABLE = "_TRITON_DTYPE_SIZES"
+_CANONICAL = "DATATYPES"
+
+
+class DtypeMapRule(Rule):
+    id = "TPU004"
+    name = "dtype-map"
+    description = (
+        "numpy<->Triton datatype tables inconsistent with the canonical "
+        "DATATYPES registry or not mutually inverse"
+    )
+
+    def check_project(self, ctxs: Sequence[FileContext]) -> List[Finding]:
+        findings: List[Finding] = []
+        canonical = self._find_canonical(ctxs)
+        for ctx in ctxs:
+            np_values = self._np_to_triton_values(ctx)
+            size_keys = self._size_table_keys(ctx)
+            if np_values is None and size_keys is None:
+                continue
+            fixed = canonical - {"BYTES"}
+            if np_values is not None:
+                values, line = np_values
+                for extra in sorted(values - canonical):
+                    findings.append(
+                        Finding(
+                            self.id, ctx.path, line, 0,
+                            f"{_NP_TABLE} maps to {extra!r}, which is not in "
+                            "the canonical DATATYPES registry",
+                        )
+                    )
+                for missing in sorted(fixed - values):
+                    findings.append(
+                        Finding(
+                            self.id, ctx.path, line, 0,
+                            f"{_NP_TABLE} has no numpy mapping for canonical "
+                            f"datatype {missing!r} (table not total)",
+                        )
+                    )
+            if size_keys is not None:
+                keys, line = size_keys
+                for extra in sorted(keys - fixed):
+                    findings.append(
+                        Finding(
+                            self.id, ctx.path, line, 0,
+                            f"{_SIZE_TABLE} sizes unknown datatype {extra!r}",
+                        )
+                    )
+                for missing in sorted(fixed - keys):
+                    findings.append(
+                        Finding(
+                            self.id, ctx.path, line, 0,
+                            f"{_SIZE_TABLE} missing fixed-size datatype "
+                            f"{missing!r} (table not total)",
+                        )
+                    )
+            if ctx.path.endswith("tritonclient_tpu/utils/__init__.py"):
+                findings.extend(self._runtime_check(ctx, canonical))
+        return findings
+
+    # -- static extraction ----------------------------------------------------
+
+    def _find_canonical(self, ctxs) -> Set[str]:
+        for ctx in ctxs:
+            if not ctx.path.endswith("_literals.py"):
+                continue
+            found = self._module_assign(ctx, _CANONICAL)
+            if found is not None:
+                values = self._string_elements(found[0])
+                if values:
+                    return values
+        from tritonclient_tpu.protocol import _literals
+
+        return set(_literals.DATATYPES)
+
+    @staticmethod
+    def _module_assign(ctx, name) -> Optional[Tuple[ast.AST, int]]:
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    if isinstance(target, ast.Name) and target.id == name:
+                        return node.value, node.lineno
+        return None
+
+    @staticmethod
+    def _string_elements(node: ast.AST) -> Set[str]:
+        """Constant strings in a set/list/tuple/frozenset(...) literal."""
+        if isinstance(node, ast.Call) and node.args:
+            node = node.args[0]
+        out: Set[str] = set()
+        if isinstance(node, (ast.Set, ast.List, ast.Tuple)):
+            for el in node.elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    out.add(el.value)
+                elif isinstance(el, ast.Name):
+                    # DT_* constant references: resolve textually (DT_FP32
+                    # -> FP32) — the _literals idiom.
+                    if el.id.startswith("DT_"):
+                        out.add(el.id[3:])
+        return out
+
+    def _np_to_triton_values(self, ctx) -> Optional[Tuple[Set[str], int]]:
+        found = self._module_assign(ctx, _NP_TABLE)
+        if found is None or not isinstance(found[0], ast.Dict):
+            return None
+        node, line = found
+        values = {
+            v.value
+            for v in node.values
+            if isinstance(v, ast.Constant) and isinstance(v.value, str)
+        }
+        # Conditional augmentations: `_NP_TO_TRITON[dtype] = "BF16"`.
+        for sub in ast.walk(ctx.tree):
+            if (
+                isinstance(sub, ast.Assign)
+                and len(sub.targets) == 1
+                and isinstance(sub.targets[0], ast.Subscript)
+                and isinstance(sub.targets[0].value, ast.Name)
+                and sub.targets[0].value.id == _NP_TABLE
+                and isinstance(sub.value, ast.Constant)
+                and isinstance(sub.value.value, str)
+            ):
+                values.add(sub.value.value)
+        return values, line
+
+    def _size_table_keys(self, ctx) -> Optional[Tuple[Set[str], int]]:
+        found = self._module_assign(ctx, _SIZE_TABLE)
+        if found is None or not isinstance(found[0], ast.Dict):
+            return None
+        node, line = found
+        keys = {
+            k.value
+            for k in node.keys
+            if isinstance(k, ast.Constant) and isinstance(k.value, str)
+        }
+        return keys, line
+
+    # -- runtime inversion check ----------------------------------------------
+
+    def _runtime_check(self, ctx, canonical: Set[str]) -> List[Finding]:
+        findings: List[Finding] = []
+        try:
+            import numpy as np
+
+            from tritonclient_tpu import utils as u
+        except Exception as e:  # pragma: no cover - import environment issue
+            return [
+                Finding(
+                    self.id, ctx.path, 1, 0,
+                    f"unable to import utils for runtime dtype check: {e}",
+                )
+            ]
+        for dt in sorted(canonical - {"BYTES"}):
+            np_dtype = u.triton_to_np_dtype(dt)
+            if np_dtype is None:
+                findings.append(
+                    Finding(
+                        self.id, ctx.path, 1, 0,
+                        f"triton_to_np_dtype({dt!r}) is None (not total)",
+                    )
+                )
+                continue
+            back = u.np_to_triton_dtype(np_dtype)
+            if back != dt:
+                findings.append(
+                    Finding(
+                        self.id, ctx.path, 1, 0,
+                        f"dtype maps not mutually inverse: {dt!r} -> "
+                        f"{np_dtype!r} -> {back!r}",
+                    )
+                )
+            size = u.triton_dtype_size(dt)
+            itemsize = np.dtype(np_dtype).itemsize
+            if size != itemsize:
+                findings.append(
+                    Finding(
+                        self.id, ctx.path, 1, 0,
+                        f"triton_dtype_size({dt!r}) == {size} but numpy "
+                        f"itemsize is {itemsize}",
+                    )
+                )
+        if u.triton_to_np_dtype("BYTES") is None:
+            findings.append(
+                Finding(
+                    self.id, ctx.path, 1, 0,
+                    "triton_to_np_dtype('BYTES') is None (BYTES must map to "
+                    "np.object_)",
+                )
+            )
+        return findings

@@ -1,0 +1,201 @@
+"""TPU005: resources acquired without guaranteed release.
+
+Flags ``name = <acquiring call>`` where the acquired handle (file, mmap,
+socket, HTTP connection, shm region, temp file) is a function local that
+
+* is never used as a context manager (``with`` item, including
+  ``contextlib.closing``),
+* has no release call (``.close()`` etc., or ``os.close(fd)``) inside a
+  ``finally`` block or ``except`` handler, and
+* never escapes the function (returned/yielded, stored into an attribute,
+  subscript, or container, or passed to another call — ownership transfer).
+
+A release on the straight-line path only (``conn.close()`` not in a
+``finally``) still flags: the exception path leaks. That is precisely the
+bug class named by the rule — shm/file/trace handles must release on *all*
+paths.
+"""
+
+import ast
+from typing import List, Optional, Set
+
+from tritonclient_tpu.analysis._engine import FileContext, Finding, Rule
+
+_ACQUIRERS = {
+    "open",
+    "io.open",
+    "os.open",
+    "os.fdopen",
+    "os.dup",
+    "mmap.mmap",
+    "gzip.open",
+    "bz2.open",
+    "lzma.open",
+    "socket.socket",
+    "socket.create_connection",
+    "http.client.HTTPConnection",
+    "http.client.HTTPSConnection",
+    "tempfile.TemporaryFile",
+    "tempfile.NamedTemporaryFile",
+    "tempfile.mkstemp",
+    "logging.FileHandler",
+}
+
+_RELEASE_METHODS = {"close", "shutdown", "release", "terminate", "unlink"}
+_RELEASE_CALLS = {"os.close"}
+
+#: Calls that USE a handle without taking ownership of it — passing a
+#: handle here is not an escape, so the function still owes the release.
+_NON_OWNING_CALLS = {
+    "os.read",
+    "os.write",
+    "os.lseek",
+    "os.fstat",
+    "os.fsync",
+    "os.ftruncate",
+    "os.isatty",
+    "print",
+    "len",
+    "repr",
+    "str",
+}
+
+
+class ResourceLeakRule(Rule):
+    id = "TPU005"
+    name = "resource-leak"
+    description = (
+        "resource handle acquired without with/finally release on all paths"
+    )
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._check_function(ctx, node))
+        return findings
+
+    def _check_function(self, ctx, func) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            if ctx.enclosing_function(node) is not func:
+                continue  # nested functions get their own pass
+            target = node.targets[0]
+            if not isinstance(target, ast.Name):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            name = ctx.canonical_call_name(node.value.func)
+            if name not in _ACQUIRERS:
+                continue
+            verdict = self._audit(ctx, func, node, target.id)
+            if verdict is not None:
+                findings.append(
+                    Finding(
+                        self.id,
+                        ctx.path,
+                        node.lineno,
+                        node.col_offset,
+                        f"`{target.id}` acquired via `{name}` {verdict}",
+                    )
+                )
+        return findings
+
+    def _audit(self, ctx, func, assign, var: str) -> Optional[str]:
+        """None when the handle is safely managed, else the complaint."""
+        released_in_cleanup = False
+        released_anywhere = False
+        cleanup_nodes = self._cleanup_nodes(func)
+        for node in ast.walk(func):
+            if getattr(node, "lineno", assign.lineno) < assign.lineno:
+                continue
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                if any(
+                    self._mentions(item.context_expr, var)
+                    for item in node.items
+                ):
+                    return None  # context-managed
+            elif isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+                if node.value is not None and self._escapes(node.value, var):
+                    return None  # ownership leaves the function
+            elif isinstance(node, ast.Assign) and node is not assign:
+                if self._escapes(node.value, var) and any(
+                    isinstance(t, (ast.Attribute, ast.Subscript, ast.Tuple))
+                    for t in node.targets
+                ):
+                    return None  # stored beyond the local scope
+            elif isinstance(node, ast.Call) and node is not assign.value:
+                cname = ctx.canonical_call_name(node.func)
+                is_release = (
+                    cname in _RELEASE_CALLS
+                    and any(
+                        isinstance(a, ast.Name) and a.id == var
+                        for a in node.args
+                    )
+                ) or (
+                    isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == var
+                    and node.func.attr in _RELEASE_METHODS
+                )
+                if is_release:
+                    released_anywhere = True
+                    if node in cleanup_nodes:
+                        released_in_cleanup = True
+                    continue
+                if cname in _NON_OWNING_CALLS:
+                    continue  # uses the handle, keeps ownership with us
+                args = list(node.args) + [kw.value for kw in node.keywords]
+                if any(self._escapes(a, var) for a in args):
+                    return None  # handed to another owner
+            elif isinstance(node, (ast.Dict, ast.List, ast.Set)):
+                if self._mentions(node, var):
+                    return None  # placed in a container that may outlive us
+        if released_in_cleanup:
+            return None
+        if released_anywhere:
+            return (
+                "is released only on the straight-line path; move the "
+                "release into a finally block or use `with`"
+            )
+        return "is never released; use `with`, or release it in a finally block"
+
+    @staticmethod
+    def _cleanup_nodes(func) -> Set[ast.AST]:
+        """Every node lexically inside a finally block or except handler."""
+        out: Set[ast.AST] = set()
+        for node in ast.walk(func):
+            stmts = []
+            if isinstance(node, ast.Try) and node.finalbody:
+                stmts.extend(node.finalbody)
+            if isinstance(node, ast.ExceptHandler):
+                stmts.extend(node.body)
+            for stmt in stmts:
+                out.update(ast.walk(stmt))
+        return out
+
+    @staticmethod
+    def _mentions(node: ast.AST, var: str) -> bool:
+        return any(
+            isinstance(sub, ast.Name) and sub.id == var
+            for sub in ast.walk(node)
+        )
+
+    @classmethod
+    def _escapes(cls, node: ast.AST, var: str) -> bool:
+        """True when ``var`` itself flows through ``node`` — as the bare
+        name, inside a container, or as a call argument. ``var.method()``
+        does NOT escape (the handle is only the receiver)."""
+        parents = {}
+        for parent in ast.walk(node):
+            for child in ast.iter_child_nodes(parent):
+                parents[child] = parent
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and sub.id == var:
+                parent = parents.get(sub)
+                if isinstance(parent, ast.Attribute) and parent.value is sub:
+                    continue  # receiver of var.attr — not the handle itself
+                return True
+        return False

@@ -188,7 +188,8 @@ class GenAIPerf:
             ]
             for t in threads:
                 t.start()
-            time.sleep(self.warmup_s)
+            # Sync warmup window by design (worker-thread context).
+            time.sleep(self.warmup_s)  # tpulint: disable=TPU001
             # Discard warmup samples (first-compile, stream setup). The
             # send-time cut also drops each worker's straddling request —
             # its latency would include pre-window time.

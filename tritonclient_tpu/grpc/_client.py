@@ -24,6 +24,10 @@ from tritonclient_tpu.grpc._utils import (
     raise_error_grpc,
 )
 from tritonclient_tpu.protocol import GRPCInferenceServiceStub, pb
+from tritonclient_tpu.protocol._literals import (
+    KEY_EMPTY_FINAL_RESPONSE,
+    KEY_UNLOAD_DEPENDENTS,
+)
 from tritonclient_tpu.utils import raise_error
 
 # INT32_MAX parity with the reference (grpc/_client.py:50-55).
@@ -266,7 +270,7 @@ class InferenceServerClient(InferenceServerClientBase):
     ):
         try:
             request = pb.RepositoryModelUnloadRequest(model_name=model_name)
-            request.parameters["unload_dependents"].bool_param = unload_dependents
+            request.parameters[KEY_UNLOAD_DEPENDENTS].bool_param = unload_dependents
             self._client_stub.RepositoryModelUnload(
                 request, metadata=self._get_metadata(headers), timeout=client_timeout
             )
@@ -698,7 +702,7 @@ class InferenceServerClient(InferenceServerClientBase):
                 parameters=parameters,
             )
             if enable_empty_final_response:
-                request.parameters["triton_enable_empty_final_response"].bool_param = True
+                request.parameters[KEY_EMPTY_FINAL_RESPONSE].bool_param = True
         self._stream._enqueue_request(request)
         self._log("enqueued request to stream...")
 

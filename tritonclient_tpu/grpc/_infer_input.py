@@ -9,6 +9,11 @@ from typing import List
 
 import numpy as np
 
+from tritonclient_tpu.protocol._literals import (
+    KEY_SHM_BYTE_SIZE,
+    KEY_SHM_OFFSET,
+    KEY_SHM_REGION,
+)
 from tritonclient_tpu.protocol import pb
 from tritonclient_tpu.utils import (
     np_to_triton_dtype,
@@ -71,9 +76,9 @@ class InferInput:
                 f"expected [{', '.join(str(s) for s in self._input.shape)}]"
             )
 
-        self._input.parameters.pop("shared_memory_region", None)
-        self._input.parameters.pop("shared_memory_byte_size", None)
-        self._input.parameters.pop("shared_memory_offset", None)
+        self._input.parameters.pop(KEY_SHM_REGION, None)
+        self._input.parameters.pop(KEY_SHM_BYTE_SIZE, None)
+        self._input.parameters.pop(KEY_SHM_OFFSET, None)
 
         if self._input.datatype == "BYTES":
             serialized = serialize_byte_tensor(input_tensor)
@@ -93,10 +98,10 @@ class InferInput:
         """
         self._input.ClearField("contents")
         self._raw_content = None
-        self._input.parameters["shared_memory_region"].string_param = region_name
-        self._input.parameters["shared_memory_byte_size"].int64_param = byte_size
+        self._input.parameters[KEY_SHM_REGION].string_param = region_name
+        self._input.parameters[KEY_SHM_BYTE_SIZE].int64_param = byte_size
         if offset != 0:
-            self._input.parameters["shared_memory_offset"].int64_param = offset
+            self._input.parameters[KEY_SHM_OFFSET].int64_param = offset
         return self
 
     def _get_tensor(self) -> pb.ModelInferRequest.InferInputTensor:

@@ -11,6 +11,9 @@ import numpy as np
 
 from google.protobuf import json_format
 
+from tritonclient_tpu.protocol._literals import (
+    KEY_SHM_REGION,
+)
 from tritonclient_tpu.protocol import pb
 from tritonclient_tpu.utils import (
     deserialize_bf16_tensor,
@@ -34,7 +37,7 @@ class InferResult:
         if i is None:
             return None
         output = self._result.outputs[i]
-        if "shared_memory_region" in output.parameters:
+        if KEY_SHM_REGION in output.parameters:
             # Tensor bytes live in the registered region, not the response;
             # the caller reads them via shared_memory.get_contents_as_numpy.
             return None

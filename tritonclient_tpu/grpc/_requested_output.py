@@ -3,6 +3,12 @@
 Reference parity: tritonclient/grpc/_requested_output.py:33-99.
 """
 
+from tritonclient_tpu.protocol._literals import (
+    KEY_CLASSIFICATION,
+    KEY_SHM_BYTE_SIZE,
+    KEY_SHM_OFFSET,
+    KEY_SHM_REGION,
+)
 from tritonclient_tpu.protocol import pb
 
 
@@ -13,27 +19,27 @@ class InferRequestedOutput:
         self._output = pb.ModelInferRequest.InferRequestedOutputTensor()
         self._output.name = name
         if class_count != 0:
-            self._output.parameters["classification"].int64_param = class_count
+            self._output.parameters[KEY_CLASSIFICATION].int64_param = class_count
 
     def name(self) -> str:
         return self._output.name
 
     def set_shared_memory(self, region_name: str, byte_size: int, offset: int = 0):
         """Route this output into a registered shared-memory region."""
-        if "classification" in self._output.parameters:
+        if KEY_CLASSIFICATION in self._output.parameters:
             raise ValueError(
                 "shared memory can't be set on a classification output"
             )
-        self._output.parameters["shared_memory_region"].string_param = region_name
-        self._output.parameters["shared_memory_byte_size"].int64_param = byte_size
+        self._output.parameters[KEY_SHM_REGION].string_param = region_name
+        self._output.parameters[KEY_SHM_BYTE_SIZE].int64_param = byte_size
         if offset != 0:
-            self._output.parameters["shared_memory_offset"].int64_param = offset
+            self._output.parameters[KEY_SHM_OFFSET].int64_param = offset
         return self
 
     def unset_shared_memory(self):
-        self._output.parameters.pop("shared_memory_region", None)
-        self._output.parameters.pop("shared_memory_byte_size", None)
-        self._output.parameters.pop("shared_memory_offset", None)
+        self._output.parameters.pop(KEY_SHM_REGION, None)
+        self._output.parameters.pop(KEY_SHM_BYTE_SIZE, None)
+        self._output.parameters.pop(KEY_SHM_OFFSET, None)
         return self
 
     def _get_tensor(self) -> pb.ModelInferRequest.InferRequestedOutputTensor:

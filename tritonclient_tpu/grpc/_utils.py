@@ -9,9 +9,15 @@ from typing import Optional
 import grpc
 
 from tritonclient_tpu.protocol import pb
+from tritonclient_tpu.protocol._literals import (
+    KEY_SEQUENCE_END,
+    KEY_SEQUENCE_ID,
+    KEY_SEQUENCE_START,
+    RESERVED_REQUEST_PARAMS,
+)
 from tritonclient_tpu.utils import InferenceServerException
 
-_RESERVED_PARAMS = ("sequence_id", "sequence_start", "sequence_end", "priority", "binary_data_output")
+_RESERVED_PARAMS = RESERVED_REQUEST_PARAMS
 
 
 def get_error_grpc(rpc_error: grpc.RpcError) -> InferenceServerException:
@@ -68,11 +74,11 @@ def _get_inference_request(
         request.id = request_id
     if sequence_id:
         if isinstance(sequence_id, str):
-            request.parameters["sequence_id"].string_param = sequence_id
+            request.parameters[KEY_SEQUENCE_ID].string_param = sequence_id
         else:
-            request.parameters["sequence_id"].int64_param = sequence_id
-        request.parameters["sequence_start"].bool_param = sequence_start
-        request.parameters["sequence_end"].bool_param = sequence_end
+            request.parameters[KEY_SEQUENCE_ID].int64_param = sequence_id
+        request.parameters[KEY_SEQUENCE_START].bool_param = sequence_start
+        request.parameters[KEY_SEQUENCE_END].bool_param = sequence_end
     if priority:
         request.parameters["priority"].uint64_param = priority
     if timeout:

@@ -28,6 +28,13 @@ from tritonclient_tpu.grpc._utils import (
     raise_error_grpc,
 )
 from tritonclient_tpu.protocol import GRPCInferenceServiceStub, pb
+from tritonclient_tpu.protocol._literals import (
+    KEY_EMPTY_FINAL_RESPONSE,
+    KEY_SEQUENCE_END,
+    KEY_SEQUENCE_ID,
+    KEY_SEQUENCE_START,
+    KEY_UNLOAD_DEPENDENTS,
+)
 from tritonclient_tpu.utils import InferenceServerException, raise_error
 
 
@@ -191,7 +198,7 @@ class InferenceServerClient(InferenceServerClientBase):
     async def unload_model(self, model_name, headers=None, unload_dependents=False, client_timeout=None):
         try:
             request = pb.RepositoryModelUnloadRequest(model_name=model_name)
-            request.parameters["unload_dependents"].bool_param = unload_dependents
+            request.parameters[KEY_UNLOAD_DEPENDENTS].bool_param = unload_dependents
             await self._client_stub.RepositoryModelUnload(
                 request, metadata=self._get_metadata(headers), timeout=client_timeout
             )
@@ -408,16 +415,16 @@ class InferenceServerClient(InferenceServerClientBase):
                     model_version=request_kwargs.get("model_version", ""),
                     request_id=request_kwargs.get("request_id", ""),
                     outputs=request_kwargs.get("outputs"),
-                    sequence_id=request_kwargs.get("sequence_id", 0),
-                    sequence_start=request_kwargs.get("sequence_start", False),
-                    sequence_end=request_kwargs.get("sequence_end", False),
+                    sequence_id=request_kwargs.get(KEY_SEQUENCE_ID, 0),
+                    sequence_start=request_kwargs.get(KEY_SEQUENCE_START, False),
+                    sequence_end=request_kwargs.get(KEY_SEQUENCE_END, False),
                     priority=request_kwargs.get("priority", 0),
                     timeout=request_kwargs.get("timeout"),
                     parameters=request_kwargs.get("parameters"),
                 )
                 if enable_final:
                     request.parameters[
-                        "triton_enable_empty_final_response"
+                        KEY_EMPTY_FINAL_RESPONSE
                     ].bool_param = True
                 yield request
 

@@ -21,6 +21,23 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, Optional
 from urllib.parse import urlparse
 
+from tritonclient_tpu.protocol._literals import (
+    EP_HEALTH_LIVE,
+    EP_HEALTH_READY,
+    EP_LOGGING,
+    EP_REPOSITORY_INDEX,
+    EP_SERVER_METADATA,
+    KEY_UNLOAD_DEPENDENTS,
+    model_config_path,
+    model_infer_path,
+    model_path,
+    model_ready_path,
+    model_stats_path,
+    repository_load_path,
+    repository_unload_path,
+    shm_admin_path,
+    trace_setting_path,
+)
 from tritonclient_tpu._client import InferenceServerClientBase
 from tritonclient_tpu._request import Request
 from tritonclient_tpu.http._infer_result import InferResult
@@ -271,47 +288,44 @@ class InferenceServerClient(InferenceServerClientBase):
     # -- health --------------------------------------------------------------
 
     def is_server_live(self, headers=None, query_params=None) -> bool:
-        status, _, _ = self._get("v2/health/live", headers, query_params)
+        status, _, _ = self._get(EP_HEALTH_LIVE, headers, query_params)
         return status == 200
 
     def is_server_ready(self, headers=None, query_params=None) -> bool:
-        status, _, _ = self._get("v2/health/ready", headers, query_params)
+        status, _, _ = self._get(EP_HEALTH_READY, headers, query_params)
         return status == 200
 
     def is_model_ready(self, model_name, model_version="", headers=None, query_params=None) -> bool:
-        path = f"v2/models/{model_name}"
-        if model_version:
-            path += f"/versions/{model_version}"
-        status, _, _ = self._get(path + "/ready", headers, query_params)
+        status, _, _ = self._get(
+            model_ready_path(model_name, model_version), headers, query_params
+        )
         return status == 200
 
     # -- metadata / config ---------------------------------------------------
 
     def get_server_metadata(self, headers=None, query_params=None) -> dict:
-        status, _, body = self._get("v2", headers, query_params)
+        status, _, body = self._get(EP_SERVER_METADATA, headers, query_params)
         _raise_if_error(status, body)
         return json.loads(body)
 
     def get_model_metadata(self, model_name, model_version="", headers=None, query_params=None) -> dict:
-        path = f"v2/models/{model_name}"
-        if model_version:
-            path += f"/versions/{model_version}"
-        status, _, body = self._get(path, headers, query_params)
+        status, _, body = self._get(
+            model_path(model_name, model_version), headers, query_params
+        )
         _raise_if_error(status, body)
         return json.loads(body)
 
     def get_model_config(self, model_name, model_version="", headers=None, query_params=None) -> dict:
-        path = f"v2/models/{model_name}"
-        if model_version:
-            path += f"/versions/{model_version}"
-        status, _, body = self._get(path + "/config", headers, query_params)
+        status, _, body = self._get(
+            model_config_path(model_name, model_version), headers, query_params
+        )
         _raise_if_error(status, body)
         return json.loads(body)
 
     # -- repository ----------------------------------------------------------
 
     def get_model_repository_index(self, headers=None, query_params=None) -> list:
-        status, _, body = self._post("v2/repository/index", b"{}", headers, query_params)
+        status, _, body = self._post(EP_REPOSITORY_INDEX, b"{}", headers, query_params)
         _raise_if_error(status, body)
         return json.loads(body)
 
@@ -328,7 +342,7 @@ class InferenceServerClient(InferenceServerClientBase):
                     parameters[path] = b64.b64encode(content).decode()
             payload["parameters"] = parameters
         status, _, body = self._post(
-            f"v2/repository/models/{model_name}/load",
+            repository_load_path(model_name),
             json.dumps(payload).encode(),
             headers,
             query_params,
@@ -338,9 +352,9 @@ class InferenceServerClient(InferenceServerClientBase):
             print(f"Loaded model '{model_name}'")
 
     def unload_model(self, model_name, headers=None, query_params=None, unload_dependents=False):
-        payload = {"parameters": {"unload_dependents": unload_dependents}}
+        payload = {"parameters": {KEY_UNLOAD_DEPENDENTS: unload_dependents}}
         status, _, body = self._post(
-            f"v2/repository/models/{model_name}/unload",
+            repository_unload_path(model_name),
             json.dumps(payload).encode(),
             headers,
             query_params,
@@ -352,13 +366,7 @@ class InferenceServerClient(InferenceServerClientBase):
     # -- statistics ----------------------------------------------------------
 
     def get_inference_statistics(self, model_name="", model_version="", headers=None, query_params=None) -> dict:
-        if model_name:
-            path = f"v2/models/{model_name}"
-            if model_version:
-                path += f"/versions/{model_version}"
-            path += "/stats"
-        else:
-            path = "v2/models/stats"
+        path = model_stats_path(model_name, model_version)
         status, _, body = self._get(path, headers, query_params)
         _raise_if_error(status, body)
         return json.loads(body)
@@ -366,7 +374,7 @@ class InferenceServerClient(InferenceServerClientBase):
     # -- trace / log settings ------------------------------------------------
 
     def update_trace_settings(self, model_name="", settings=None, headers=None, query_params=None) -> dict:
-        path = f"v2/models/{model_name}/trace/setting" if model_name else "v2/trace/setting"
+        path = trace_setting_path(model_name)
         status, _, body = self._post(
             path, json.dumps(settings or {}).encode(), headers, query_params
         )
@@ -374,37 +382,35 @@ class InferenceServerClient(InferenceServerClientBase):
         return json.loads(body)
 
     def get_trace_settings(self, model_name="", headers=None, query_params=None) -> dict:
-        path = f"v2/models/{model_name}/trace/setting" if model_name else "v2/trace/setting"
+        path = trace_setting_path(model_name)
         status, _, body = self._get(path, headers, query_params)
         _raise_if_error(status, body)
         return json.loads(body)
 
     def update_log_settings(self, settings: dict, headers=None, query_params=None) -> dict:
         status, _, body = self._post(
-            "v2/logging", json.dumps(settings or {}).encode(), headers, query_params
+            EP_LOGGING, json.dumps(settings or {}).encode(), headers, query_params
         )
         _raise_if_error(status, body)
         return json.loads(body)
 
     def get_log_settings(self, headers=None, query_params=None) -> dict:
-        status, _, body = self._get("v2/logging", headers, query_params)
+        status, _, body = self._get(EP_LOGGING, headers, query_params)
         _raise_if_error(status, body)
         return json.loads(body)
 
     # -- shared memory admin -------------------------------------------------
 
     def get_system_shared_memory_status(self, region_name="", headers=None, query_params=None) -> list:
-        path = "v2/systemsharedmemory"
-        if region_name:
-            path += f"/region/{region_name}"
-        status, _, body = self._get(path + "/status", headers, query_params)
+        path = shm_admin_path("system", "status", region_name)
+        status, _, body = self._get(path, headers, query_params)
         _raise_if_error(status, body)
         return json.loads(body)
 
     def register_system_shared_memory(self, name, key, byte_size, offset=0, headers=None, query_params=None):
         payload = {"key": key, "offset": offset, "byte_size": byte_size}
         status, _, body = self._post(
-            f"v2/systemsharedmemory/region/{name}/register",
+            shm_admin_path("system", "register", name),
             json.dumps(payload).encode(),
             headers,
             query_params,
@@ -414,19 +420,13 @@ class InferenceServerClient(InferenceServerClientBase):
             print(f"Registered system shared memory with name '{name}'")
 
     def unregister_system_shared_memory(self, name="", headers=None, query_params=None):
-        path = (
-            f"v2/systemsharedmemory/region/{name}/unregister"
-            if name
-            else "v2/systemsharedmemory/unregister"
-        )
+        path = shm_admin_path("system", "unregister", name)
         status, _, body = self._post(path, b"", headers, query_params)
         _raise_if_error(status, body)
 
     def get_cuda_shared_memory_status(self, region_name="", headers=None, query_params=None) -> list:
-        path = "v2/cudasharedmemory"
-        if region_name:
-            path += f"/region/{region_name}"
-        status, _, body = self._get(path + "/status", headers, query_params)
+        path = shm_admin_path("cuda", "status", region_name)
+        status, _, body = self._get(path, headers, query_params)
         _raise_if_error(status, body)
         return json.loads(body)
 
@@ -439,7 +439,7 @@ class InferenceServerClient(InferenceServerClientBase):
             "byte_size": byte_size,
         }
         status, _, body = self._post(
-            f"v2/cudasharedmemory/region/{name}/register",
+            shm_admin_path("cuda", "register", name),
             json.dumps(payload).encode(),
             headers,
             query_params,
@@ -447,20 +447,14 @@ class InferenceServerClient(InferenceServerClientBase):
         _raise_if_error(status, body)
 
     def unregister_cuda_shared_memory(self, name="", headers=None, query_params=None):
-        path = (
-            f"v2/cudasharedmemory/region/{name}/unregister"
-            if name
-            else "v2/cudasharedmemory/unregister"
-        )
+        path = shm_admin_path("cuda", "unregister", name)
         status, _, body = self._post(path, b"", headers, query_params)
         _raise_if_error(status, body)
 
     def get_tpu_shared_memory_status(self, region_name="", headers=None, query_params=None) -> list:
         """Status of registered TPU device-buffer regions."""
-        path = "v2/tpusharedmemory"
-        if region_name:
-            path += f"/region/{region_name}"
-        status, _, body = self._get(path + "/status", headers, query_params)
+        path = shm_admin_path("tpu", "status", region_name)
+        status, _, body = self._get(path, headers, query_params)
         _raise_if_error(status, body)
         return json.loads(body)
 
@@ -475,7 +469,7 @@ class InferenceServerClient(InferenceServerClientBase):
             "byte_size": byte_size,
         }
         status, _, body = self._post(
-            f"v2/tpusharedmemory/region/{name}/register",
+            shm_admin_path("tpu", "register", name),
             json.dumps(payload).encode(),
             headers,
             query_params,
@@ -483,11 +477,7 @@ class InferenceServerClient(InferenceServerClientBase):
         _raise_if_error(status, body)
 
     def unregister_tpu_shared_memory(self, name="", headers=None, query_params=None):
-        path = (
-            f"v2/tpusharedmemory/region/{name}/unregister"
-            if name
-            else "v2/tpusharedmemory/unregister"
-        )
+        path = shm_admin_path("tpu", "unregister", name)
         status, _, body = self._post(path, b"", headers, query_params)
         _raise_if_error(status, body)
 
@@ -568,10 +558,7 @@ class InferenceServerClient(InferenceServerClientBase):
         if json_size is not None:
             headers["Inference-Header-Content-Length"] = str(json_size)
 
-        path = f"v2/models/{model_name}"
-        if model_version:
-            path += f"/versions/{model_version}"
-        path += "/infer"
+        path = model_infer_path(model_name, model_version)
         return path, request_body, headers
 
     def infer(

@@ -9,6 +9,12 @@ from typing import List
 
 import numpy as np
 
+from tritonclient_tpu.protocol._literals import (
+    KEY_BINARY_DATA_SIZE,
+    KEY_SHM_BYTE_SIZE,
+    KEY_SHM_OFFSET,
+    KEY_SHM_REGION,
+)
 from tritonclient_tpu.utils import (
     np_to_triton_dtype,
     raise_error,
@@ -61,14 +67,14 @@ class InferInput:
                 f"expected [{', '.join(str(s) for s in self._shape)}]"
             )
 
-        self._parameters.pop("shared_memory_region", None)
-        self._parameters.pop("shared_memory_byte_size", None)
-        self._parameters.pop("shared_memory_offset", None)
+        self._parameters.pop(KEY_SHM_REGION, None)
+        self._parameters.pop(KEY_SHM_BYTE_SIZE, None)
+        self._parameters.pop(KEY_SHM_OFFSET, None)
 
         if not binary_data:
             if self._datatype == "BF16":
                 raise_error("BF16 inputs must use binary_data=True (no JSON encoding)")
-            self._parameters.pop("binary_data_size", None)
+            self._parameters.pop(KEY_BINARY_DATA_SIZE, None)
             self._raw_data = None
             if self._datatype == "BYTES":
                 self._data = []
@@ -96,18 +102,18 @@ class InferInput:
                 self._raw_data = serialized.item() if serialized.size > 0 else b""
             else:
                 self._raw_data = np.ascontiguousarray(input_tensor).tobytes()
-            self._parameters["binary_data_size"] = len(self._raw_data)
+            self._parameters[KEY_BINARY_DATA_SIZE] = len(self._raw_data)
         return self
 
     def set_shared_memory(self, region_name: str, byte_size: int, offset: int = 0):
         """Point this input at a registered shared-memory region."""
         self._data = None
         self._raw_data = None
-        self._parameters.pop("binary_data_size", None)
-        self._parameters["shared_memory_region"] = region_name
-        self._parameters["shared_memory_byte_size"] = byte_size
+        self._parameters.pop(KEY_BINARY_DATA_SIZE, None)
+        self._parameters[KEY_SHM_REGION] = region_name
+        self._parameters[KEY_SHM_BYTE_SIZE] = byte_size
         if offset != 0:
-            self._parameters["shared_memory_offset"] = offset
+            self._parameters[KEY_SHM_OFFSET] = offset
         return self
 
     def _get_tensor(self) -> dict:

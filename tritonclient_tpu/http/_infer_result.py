@@ -9,6 +9,11 @@ import gzip
 import json
 import zlib
 from typing import List, Optional
+from tritonclient_tpu.protocol._literals import (
+    KEY_BINARY_DATA_SIZE,
+    KEY_SHM_BYTE_SIZE,
+    KEY_SHM_REGION,
+)
 
 import numpy as np
 
@@ -40,8 +45,8 @@ class InferResult:
         offset = 0
         for output in self._result.get("outputs", []):
             params = output.get("parameters", {})
-            if "binary_data_size" in params:
-                size = int(params["binary_data_size"])
+            if KEY_BINARY_DATA_SIZE in params:
+                size = int(params[KEY_BINARY_DATA_SIZE])
                 self._output_name_to_buffer_map[output["name"]] = (offset, size)
                 offset += size
 

@@ -3,34 +3,42 @@
 Reference parity: tritonclient/http/_requested_output.py.
 """
 
+from tritonclient_tpu.protocol._literals import (
+    KEY_BINARY_DATA,
+    KEY_CLASSIFICATION,
+    KEY_SHM_BYTE_SIZE,
+    KEY_SHM_OFFSET,
+    KEY_SHM_REGION,
+)
+
 
 class InferRequestedOutput:
     def __init__(self, name: str, binary_data: bool = True, class_count: int = 0):
         self._name = name
         self._parameters = {}
         if class_count != 0:
-            self._parameters["classification"] = class_count
+            self._parameters[KEY_CLASSIFICATION] = class_count
         self._binary = binary_data
-        self._parameters["binary_data"] = binary_data
+        self._parameters[KEY_BINARY_DATA] = binary_data
 
     def name(self) -> str:
         return self._name
 
     def set_shared_memory(self, region_name: str, byte_size: int, offset: int = 0):
-        if "classification" in self._parameters:
+        if KEY_CLASSIFICATION in self._parameters:
             raise ValueError("shared memory can't be set on a classification output")
-        self._parameters.pop("binary_data", None)
-        self._parameters["shared_memory_region"] = region_name
-        self._parameters["shared_memory_byte_size"] = byte_size
+        self._parameters.pop(KEY_BINARY_DATA, None)
+        self._parameters[KEY_SHM_REGION] = region_name
+        self._parameters[KEY_SHM_BYTE_SIZE] = byte_size
         if offset != 0:
-            self._parameters["shared_memory_offset"] = offset
+            self._parameters[KEY_SHM_OFFSET] = offset
         return self
 
     def unset_shared_memory(self):
-        self._parameters.pop("shared_memory_region", None)
-        self._parameters.pop("shared_memory_byte_size", None)
-        self._parameters.pop("shared_memory_offset", None)
-        self._parameters["binary_data"] = self._binary
+        self._parameters.pop(KEY_SHM_REGION, None)
+        self._parameters.pop(KEY_SHM_BYTE_SIZE, None)
+        self._parameters.pop(KEY_SHM_OFFSET, None)
+        self._parameters[KEY_BINARY_DATA] = self._binary
         return self
 
     def _get_tensor(self) -> dict:

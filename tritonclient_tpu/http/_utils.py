@@ -10,6 +10,13 @@ from typing import List, Optional, Tuple
 from urllib.parse import quote_plus
 
 from tritonclient_tpu.utils import InferenceServerException, raise_error
+from tritonclient_tpu.protocol._literals import (
+    KEY_BINARY_DATA_OUTPUT,
+    KEY_SEQUENCE_END,
+    KEY_SEQUENCE_ID,
+    KEY_SEQUENCE_START,
+    RESERVED_REQUEST_PARAMS,
+)
 
 # Upload buffer granularity for chunked request bodies — reference parity
 # with the C++ client's 16 MiB curl buffers (http_client.cc:2172-2175).
@@ -97,9 +104,9 @@ def _get_inference_request_chunks(
     if request_id:
         infer_request["id"] = request_id
     if sequence_id:
-        parameters["sequence_id"] = sequence_id
-        parameters["sequence_start"] = sequence_start
-        parameters["sequence_end"] = sequence_end
+        parameters[KEY_SEQUENCE_ID] = sequence_id
+        parameters[KEY_SEQUENCE_START] = sequence_start
+        parameters[KEY_SEQUENCE_END] = sequence_end
     if priority:
         parameters["priority"] = priority
     if timeout is not None:
@@ -109,10 +116,10 @@ def _get_inference_request_chunks(
     if outputs:
         infer_request["outputs"] = [o._get_tensor() for o in outputs]
     else:
-        parameters["binary_data_output"] = True
+        parameters[KEY_BINARY_DATA_OUTPUT] = True
 
     for key, value in (custom_parameters or {}).items():
-        if key in ("sequence_id", "sequence_start", "sequence_end", "priority", "binary_data_output"):
+        if key in RESERVED_REQUEST_PARAMS:
             raise_error(
                 f"Parameter {key} is a reserved parameter and cannot be specified."
             )

@@ -14,6 +14,23 @@ from typing import Optional
 
 import aiohttp
 
+from tritonclient_tpu.protocol._literals import (
+    EP_HEALTH_LIVE,
+    EP_HEALTH_READY,
+    EP_LOGGING,
+    EP_REPOSITORY_INDEX,
+    EP_SERVER_METADATA,
+    KEY_UNLOAD_DEPENDENTS,
+    model_config_path,
+    model_infer_path,
+    model_path,
+    model_ready_path,
+    model_stats_path,
+    repository_load_path,
+    repository_unload_path,
+    shm_admin_path,
+    trace_setting_path,
+)
 from tritonclient_tpu._client import InferenceServerClientBase
 from tritonclient_tpu._request import Request
 from tritonclient_tpu.http._infer_input import InferInput  # noqa: F401
@@ -98,18 +115,17 @@ class InferenceServerClient(InferenceServerClientBase):
     # -- health --------------------------------------------------------------
 
     async def is_server_live(self, headers=None, query_params=None) -> bool:
-        status, _, _ = await self._get("v2/health/live", headers, query_params)
+        status, _, _ = await self._get(EP_HEALTH_LIVE, headers, query_params)
         return status == 200
 
     async def is_server_ready(self, headers=None, query_params=None) -> bool:
-        status, _, _ = await self._get("v2/health/ready", headers, query_params)
+        status, _, _ = await self._get(EP_HEALTH_READY, headers, query_params)
         return status == 200
 
     async def is_model_ready(self, model_name, model_version="", headers=None, query_params=None) -> bool:
-        path = f"v2/models/{model_name}"
-        if model_version:
-            path += f"/versions/{model_version}"
-        status, _, _ = await self._get(path + "/ready", headers, query_params)
+        status, _, _ = await self._get(
+            model_ready_path(model_name, model_version), headers, query_params
+        )
         return status == 200
 
     # -- metadata / admin ----------------------------------------------------
@@ -129,22 +145,20 @@ class InferenceServerClient(InferenceServerClientBase):
         return json.loads(body) if body else None
 
     async def get_server_metadata(self, headers=None, query_params=None) -> dict:
-        return await self._get_json("v2", headers, query_params)
+        return await self._get_json(EP_SERVER_METADATA, headers, query_params)
 
     async def get_model_metadata(self, model_name, model_version="", headers=None, query_params=None) -> dict:
-        path = f"v2/models/{model_name}"
-        if model_version:
-            path += f"/versions/{model_version}"
-        return await self._get_json(path, headers, query_params)
+        return await self._get_json(
+            model_path(model_name, model_version), headers, query_params
+        )
 
     async def get_model_config(self, model_name, model_version="", headers=None, query_params=None) -> dict:
-        path = f"v2/models/{model_name}"
-        if model_version:
-            path += f"/versions/{model_version}"
-        return await self._get_json(path + "/config", headers, query_params)
+        return await self._get_json(
+            model_config_path(model_name, model_version), headers, query_params
+        )
 
     async def get_model_repository_index(self, headers=None, query_params=None) -> list:
-        return await self._post_json("v2/repository/index", {}, headers, query_params)
+        return await self._post_json(EP_REPOSITORY_INDEX, {}, headers, query_params)
 
     async def load_model(self, model_name, headers=None, query_params=None, config=None, files=None):
         payload = {}
@@ -157,74 +171,60 @@ class InferenceServerClient(InferenceServerClientBase):
                     parameters[path] = base64.b64encode(content).decode()
             payload["parameters"] = parameters
         await self._post_json(
-            f"v2/repository/models/{model_name}/load", payload, headers, query_params
+            repository_load_path(model_name), payload, headers, query_params
         )
 
     async def unload_model(self, model_name, headers=None, query_params=None, unload_dependents=False):
         await self._post_json(
-            f"v2/repository/models/{model_name}/unload",
-            {"parameters": {"unload_dependents": unload_dependents}},
+            repository_unload_path(model_name),
+            {"parameters": {KEY_UNLOAD_DEPENDENTS: unload_dependents}},
             headers,
             query_params,
         )
 
     async def get_inference_statistics(self, model_name="", model_version="", headers=None, query_params=None) -> dict:
-        if model_name:
-            path = f"v2/models/{model_name}"
-            if model_version:
-                path += f"/versions/{model_version}"
-            path += "/stats"
-        else:
-            path = "v2/models/stats"
+        path = model_stats_path(model_name, model_version)
         return await self._get_json(path, headers, query_params)
 
     async def update_trace_settings(self, model_name="", settings=None, headers=None, query_params=None) -> dict:
-        path = f"v2/models/{model_name}/trace/setting" if model_name else "v2/trace/setting"
+        path = trace_setting_path(model_name)
         return await self._post_json(path, settings or {}, headers, query_params)
 
     async def get_trace_settings(self, model_name="", headers=None, query_params=None) -> dict:
-        path = f"v2/models/{model_name}/trace/setting" if model_name else "v2/trace/setting"
+        path = trace_setting_path(model_name)
         return await self._get_json(path, headers, query_params)
 
     async def update_log_settings(self, settings, headers=None, query_params=None) -> dict:
-        return await self._post_json("v2/logging", settings or {}, headers, query_params)
+        return await self._post_json(EP_LOGGING, settings or {}, headers, query_params)
 
     async def get_log_settings(self, headers=None, query_params=None) -> dict:
-        return await self._get_json("v2/logging", headers, query_params)
+        return await self._get_json(EP_LOGGING, headers, query_params)
 
     # -- shared memory admin -------------------------------------------------
 
     async def get_system_shared_memory_status(self, region_name="", headers=None, query_params=None) -> list:
-        path = "v2/systemsharedmemory"
-        if region_name:
-            path += f"/region/{region_name}"
-        return await self._get_json(path + "/status", headers, query_params)
+        path = shm_admin_path("system", "status", region_name)
+        return await self._get_json(path, headers, query_params)
 
     async def register_system_shared_memory(self, name, key, byte_size, offset=0, headers=None, query_params=None):
         await self._post_json(
-            f"v2/systemsharedmemory/region/{name}/register",
+            shm_admin_path("system", "register", name),
             {"key": key, "offset": offset, "byte_size": byte_size},
             headers,
             query_params,
         )
 
     async def unregister_system_shared_memory(self, name="", headers=None, query_params=None):
-        path = (
-            f"v2/systemsharedmemory/region/{name}/unregister"
-            if name
-            else "v2/systemsharedmemory/unregister"
-        )
+        path = shm_admin_path("system", "unregister", name)
         await self._post_json(path, {}, headers, query_params)
 
     async def get_tpu_shared_memory_status(self, region_name="", headers=None, query_params=None) -> list:
-        path = "v2/tpusharedmemory"
-        if region_name:
-            path += f"/region/{region_name}"
-        return await self._get_json(path + "/status", headers, query_params)
+        path = shm_admin_path("tpu", "status", region_name)
+        return await self._get_json(path, headers, query_params)
 
     async def register_tpu_shared_memory(self, name, raw_handle, device_id, byte_size, headers=None, query_params=None):
         await self._post_json(
-            f"v2/tpusharedmemory/region/{name}/register",
+            shm_admin_path("tpu", "register", name),
             {
                 "raw_handle": {"b64": base64.b64encode(raw_handle).decode()},
                 "device_id": device_id,
@@ -235,11 +235,7 @@ class InferenceServerClient(InferenceServerClientBase):
         )
 
     async def unregister_tpu_shared_memory(self, name="", headers=None, query_params=None):
-        path = (
-            f"v2/tpusharedmemory/region/{name}/unregister"
-            if name
-            else "v2/tpusharedmemory/unregister"
-        )
+        path = shm_admin_path("tpu", "unregister", name)
         await self._post_json(path, {}, headers, query_params)
 
     # -- inference -----------------------------------------------------------
@@ -299,10 +295,7 @@ class InferenceServerClient(InferenceServerClientBase):
         if timers is not None:
             timers.capture("send_end")
 
-        path = f"v2/models/{model_name}"
-        if model_version:
-            path += f"/versions/{model_version}"
-        path += "/infer"
+        path = model_infer_path(model_name, model_version)
         status, resp_headers, body = await self._post(
             path, request_body, all_headers, query_params
         )

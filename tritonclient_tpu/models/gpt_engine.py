@@ -399,10 +399,12 @@ class GenerationEngine:
     def shutdown(self, timeout: float = 10.0):
         """Stop the engine loop (in-flight step finishes; queued and
         active requests receive their terminator)."""
-        self._stopping = True
         with self._cv:
+            # Stop flag and thread handle read/written under the cv: the
+            # engine loop must observe the flag no later than the wakeup.
+            self._stopping = True
+            t = self._thread
             self._cv.notify_all()
-        t = self._thread
         if t is not None and t.is_alive():
             t.join(timeout=timeout)
         self._dist.drain_and_stop(timeout=timeout)
@@ -637,13 +639,16 @@ class GenerationEngine:
         # the very next loop top regardless of in-flight readbacks, which
         # is what bounds TTFT under load (VERDICT r4 #4).
         while True:
-            if self._stopping:
+            # Lock-free polls of monotonic signal flags: the loop re-checks
+            # every iteration, so the worst race is one extra step.
+            if self._stopping:  # tpulint: disable=TPU002
                 self._dist.drain_and_stop()
                 self._process_frees()
                 self._drain_terminated()
                 return
-            if self._broken is not None:
-                raise self._broken
+            broken = self._broken  # tpulint: disable=TPU002
+            if broken is not None:
+                raise broken
             self._process_frees()
             self._release_cancelled()
             self._admit_into_free_slots()
@@ -667,7 +672,8 @@ class GenerationEngine:
             # a step-readback wait.
             got_ticket = self._dist.try_ticket(timeout=0.005)
             while not got_ticket:
-                if self._stopping or self._broken is not None:
+                # Same lock-free signal poll as the loop top.
+                if self._stopping or self._broken is not None:  # tpulint: disable=TPU002
                     break
                 self._process_frees()
                 self._release_cancelled()

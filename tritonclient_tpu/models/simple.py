@@ -206,5 +206,7 @@ class SlowIdentityModel(Model):
         import time as _time
 
         delay_ms = int((parameters or {}).get("delay_ms", 300))
-        _time.sleep(delay_ms / 1000.0)
+        # Deliberate server-side delay; blocking=True routes this model
+        # through the executor so the sleep never lands on an event loop.
+        _time.sleep(delay_ms / 1000.0)  # tpulint: disable=TPU001
         return {"OUTPUT": np.asarray(inputs["INPUT"], dtype=np.int32)}

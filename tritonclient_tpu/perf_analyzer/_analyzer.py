@@ -146,12 +146,13 @@ class _StreamMux:
         return stream is not None and getattr(stream, "_active", True)
 
     def close(self):
-        if self._started:
+        with self._lock:
+            started, self._started = self._started, False
+        if started:
             try:
                 self.client.stop_stream()
             except Exception:
                 pass
-            self._started = False
         try:
             self.client.close()
         except Exception:
@@ -856,8 +857,10 @@ class MeasurementSession:
         window_start = time.perf_counter() + warmup_s
         for t in threads:
             t.start()
-        # Discard warmup-period results by timestamping the cut.
-        time.sleep(warmup_s)
+        # Discard warmup-period results by timestamping the cut. The warmup
+        # window is deliberately a sync sleep: measurement sessions run on
+        # worker threads, never on an event loop.
+        time.sleep(warmup_s)  # tpulint: disable=TPU001
         for w in self.workers:
             w.latencies.clear()
             w.send_ns.clear()
@@ -1254,7 +1257,8 @@ class PerfAnalyzer:
             thread = threading.Thread(target=worker.run, args=(end,), daemon=True)
             window_start = time.perf_counter() + self.warmup_s
             thread.start()
-            time.sleep(self.warmup_s)
+            # Sync warmup window by design (worker-thread context).
+            time.sleep(self.warmup_s)  # tpulint: disable=TPU001
             with worker._record_lock:
                 worker.latencies.clear()
                 worker.send_ns.clear()

@@ -1,0 +1,222 @@
+"""Canonical KServe v2 wire literals — the single source of truth.
+
+Every endpoint path template, drift-prone JSON/parameter key, and datatype
+string the protocol front-ends speak lives here exactly once. The reference
+Triton client ecosystem historically leaked bugs through wire-literal drift
+between the HTTP and gRPC planes (a key spelled two ways, an endpoint
+diverging between client and server); this module plus the tpulint rules
+make that drift mechanical to catch:
+
+  * TPU003 flags any ``v2``-prefixed path literal or enforced key literal
+    spelled out under ``http/``, ``grpc/``, or ``server/`` instead of
+    imported from here;
+  * TPU004 cross-checks the numpy<->Triton dtype tables in
+    ``tritonclient_tpu.utils`` against ``DATATYPES`` for totality and
+    mutual inversion.
+
+Keep this module dependency-free (stdlib ``re`` only): both protocol
+front-ends and the analysis package import it.
+"""
+
+import re
+
+# --------------------------------------------------------------------------- #
+# datatype registry                                                           #
+# --------------------------------------------------------------------------- #
+
+#: Every datatype string the v2 protocol can put in a tensor's ``datatype``
+#: field. ``BYTES`` is the only variable-size member; the fixed-size set is
+#: ``DATATYPES - {DT_BYTES}`` and must match ``_TRITON_DTYPE_SIZES`` in
+#: ``tritonclient_tpu.utils`` exactly (enforced by TPU004).
+DT_BOOL = "BOOL"
+DT_UINT8 = "UINT8"
+DT_UINT16 = "UINT16"
+DT_UINT32 = "UINT32"
+DT_UINT64 = "UINT64"
+DT_INT8 = "INT8"
+DT_INT16 = "INT16"
+DT_INT32 = "INT32"
+DT_INT64 = "INT64"
+DT_FP16 = "FP16"
+DT_FP32 = "FP32"
+DT_FP64 = "FP64"
+DT_BF16 = "BF16"
+DT_BYTES = "BYTES"
+
+DATATYPES = frozenset(
+    {
+        DT_BOOL,
+        DT_UINT8,
+        DT_UINT16,
+        DT_UINT32,
+        DT_UINT64,
+        DT_INT8,
+        DT_INT16,
+        DT_INT32,
+        DT_INT64,
+        DT_FP16,
+        DT_FP32,
+        DT_FP64,
+        DT_BF16,
+        DT_BYTES,
+    }
+)
+
+# --------------------------------------------------------------------------- #
+# JSON body / request-parameter keys                                          #
+# --------------------------------------------------------------------------- #
+
+# Shared-memory tensor routing (identical key spelling on the HTTP JSON
+# parameters object and the gRPC InferParameter map — the pair of planes
+# that historically drifted).
+KEY_SHM_REGION = "shared_memory_region"
+KEY_SHM_OFFSET = "shared_memory_offset"
+KEY_SHM_BYTE_SIZE = "shared_memory_byte_size"
+
+# HTTP binary-tensor-data extension.
+KEY_BINARY_DATA = "binary_data"
+KEY_BINARY_DATA_SIZE = "binary_data_size"
+KEY_BINARY_DATA_OUTPUT = "binary_data_output"
+
+# Classification extension.
+KEY_CLASSIFICATION = "classification"
+
+# Sequence extension.
+KEY_SEQUENCE_ID = "sequence_id"
+KEY_SEQUENCE_START = "sequence_start"
+KEY_SEQUENCE_END = "sequence_end"
+
+# Decoupled-streaming markers (gRPC).
+KEY_EMPTY_FINAL_RESPONSE = "triton_enable_empty_final_response"
+KEY_FINAL_RESPONSE = "triton_final_response"
+
+# Repository control.
+KEY_UNLOAD_DEPENDENTS = "unload_dependents"
+
+#: Request parameters the clients reserve for dedicated kwargs; user-supplied
+#: ``parameters`` dicts may not name these (reference:
+#: tritonclient/http/_utils.py:114-117 and grpc/_utils.py equivalent).
+RESERVED_REQUEST_PARAMS = (
+    KEY_SEQUENCE_ID,
+    KEY_SEQUENCE_START,
+    KEY_SEQUENCE_END,
+    "priority",
+    KEY_BINARY_DATA_OUTPUT,
+)
+
+# --------------------------------------------------------------------------- #
+# server capability vocabulary                                                #
+# --------------------------------------------------------------------------- #
+
+#: Extension names reported in ``v2`` server metadata. Wire-visible protocol
+#: vocabulary: language clients switch on these strings.
+SERVER_EXTENSIONS = (
+    KEY_CLASSIFICATION,
+    "sequence",
+    "model_repository",
+    "model_configuration",
+    "system_shared_memory",
+    "cuda_shared_memory",
+    "tpu_shared_memory",
+    "binary_tensor_data",
+    "parameters",
+    "statistics",
+    "trace",
+    "logging",
+)
+
+# --------------------------------------------------------------------------- #
+# endpoint paths                                                              #
+# --------------------------------------------------------------------------- #
+
+EP_SERVER_METADATA = "v2"
+EP_HEALTH_LIVE = "v2/health/live"
+EP_HEALTH_READY = "v2/health/ready"
+EP_REPOSITORY_INDEX = "v2/repository/index"
+EP_LOGGING = "v2/logging"
+EP_TRACE_SETTING = "v2/trace/setting"
+#: Prometheus exposition (Triton serves this on a dedicated port; the
+#: in-process server shares its one HTTP port).
+EP_METRICS = "metrics"
+
+#: Maps the URL path segment of a shared-memory admin endpoint to the
+#: registry kind the core understands.
+SHM_URL_KINDS = {
+    "systemsharedmemory": "system",
+    "cudasharedmemory": "cuda",
+    "tpusharedmemory": "tpu",
+}
+
+
+def model_path(name: str, version: str = "") -> str:
+    """``v2/models/{name}[/versions/{version}]`` — model metadata GET."""
+    if version:
+        return f"v2/models/{name}/versions/{version}"
+    return f"v2/models/{name}"
+
+
+def model_ready_path(name: str, version: str = "") -> str:
+    return model_path(name, version) + "/ready"
+
+
+def model_config_path(name: str, version: str = "") -> str:
+    return model_path(name, version) + "/config"
+
+
+def model_infer_path(name: str, version: str = "") -> str:
+    return model_path(name, version) + "/infer"
+
+
+def model_stats_path(name: str = "", version: str = "") -> str:
+    """Per-model statistics, or the all-models aggregate when ``name`` is
+    empty (``v2/models/stats``)."""
+    if not name:
+        return "v2/models/stats"
+    return model_path(name, version) + "/stats"
+
+
+def trace_setting_path(model_name: str = "") -> str:
+    """Per-model trace settings, or the global endpoint when unnamed."""
+    if model_name:
+        return f"v2/models/{model_name}/trace/setting"
+    return EP_TRACE_SETTING
+
+
+def repository_load_path(name: str) -> str:
+    return f"v2/repository/models/{name}/load"
+
+
+def repository_unload_path(name: str) -> str:
+    return f"v2/repository/models/{name}/unload"
+
+
+def shm_admin_path(plane: str, action: str, region: str = "") -> str:
+    """Shared-memory admin endpoint for one plane.
+
+    ``plane`` is ``system`` | ``cuda`` | ``tpu``; ``action`` is ``status`` |
+    ``register`` | ``unregister``. ``region`` is required for ``register``
+    and optional for the other two (empty = all regions).
+    """
+    base = f"v2/{plane}sharedmemory"
+    if region:
+        return f"{base}/region/{region}/{action}"
+    return f"{base}/{action}"
+
+
+# --------------------------------------------------------------------------- #
+# server-side route patterns                                                  #
+# --------------------------------------------------------------------------- #
+
+#: The HTTP front-end's dispatch table, kept beside the client-side path
+#: builders so the two cannot drift apart.
+MODEL_ROUTE_RE = re.compile(
+    r"^v2/models/(?P<model>[^/]+)(?:/versions/(?P<version>[^/]+))?"
+    r"(?:/(?P<action>ready|config|stats|infer|trace/setting))?$"
+)
+REPOSITORY_ROUTE_RE = re.compile(
+    r"^v2/repository/models/(?P<model>[^/]+)/(?P<action>load|unload)$"
+)
+SHM_ROUTE_RE = re.compile(
+    r"^v2/(?P<kind>systemsharedmemory|cudasharedmemory|tpusharedmemory)"
+    r"(?:/region/(?P<region>[^/]+))?/(?P<action>status|register|unregister)$"
+)

@@ -22,6 +22,7 @@ from typing import Dict, Iterator, List, Optional, Union
 import numpy as np
 
 from tritonclient_tpu._tracing import TraceCollector, configure_logging
+from tritonclient_tpu.protocol._literals import SERVER_EXTENSIONS
 from tritonclient_tpu.utils import (
     deserialize_bytes_tensor,
     num_elements,
@@ -32,20 +33,6 @@ from tritonclient_tpu.utils import (
 
 SERVER_NAME = "triton-tpu"
 SERVER_VERSION = "2.0.0-tpu"
-SERVER_EXTENSIONS = [
-    "classification",
-    "sequence",
-    "model_repository",
-    "model_configuration",
-    "system_shared_memory",
-    "cuda_shared_memory",
-    "tpu_shared_memory",
-    "binary_tensor_data",
-    "parameters",
-    "statistics",
-    "trace",
-    "logging",
-]
 
 
 class CoreError(Exception):
@@ -165,7 +152,7 @@ class SystemShmRegistry:
 
     def __contains__(self, name: str) -> bool:
         # GIL-atomic dict membership; safe without the lock on the hot path.
-        return name in self._regions
+        return name in self._regions  # tpulint: disable=TPU002
 
     def unregister(self, name: Optional[str]):
         with self._lock:
@@ -250,7 +237,7 @@ class TpuShmRegistry:
 
     def __contains__(self, name: str) -> bool:
         # GIL-atomic dict membership; safe without the lock on the hot path.
-        return name in self._regions
+        return name in self._regions  # tpulint: disable=TPU002
 
     def unregister(self, name: Optional[str]):
         with self._lock:
@@ -691,7 +678,7 @@ class _DynamicBatcher:
 
     # -- dispatcher thread ----------------------------------------------------
 
-    def _take_batch(self):
+    def _take_batch(self):  # tpulint: disable=TPU002 - caller holds self._cv
         """Under the lock: form one batch for the head-of-line signature.
 
         Returns the batch, or None when a gate wants to keep waiting
@@ -877,9 +864,10 @@ class InferenceCore:
     # -- repository ----------------------------------------------------------
 
     def add_model(self, model, loaded: bool = True):
-        self._repository[model.name] = model
-        self._loaded[model.name] = loaded
-        self._stats.setdefault(model.name, _ModelStats())
+        with self._lock:
+            self._repository[model.name] = model
+            self._loaded[model.name] = loaded
+            self._stats.setdefault(model.name, _ModelStats())
         if (
             self._dynamic_batching
             and getattr(model, "dynamic_batching", False)
@@ -900,13 +888,16 @@ class InferenceCore:
                     os.environ.get("TPU_SERVER_BATCH_DELAY_US"), default_us,
                 )
                 delay_us = int(default_us)
-            self._batchers[model.name] = _DynamicBatcher(self, delay_us)
+            with self._lock:
+                self._batchers[model.name] = _DynamicBatcher(self, delay_us)
 
     def _get_model(self, name: str, version: str = ""):
-        model = self._repository.get(name)
+        with self._lock:
+            model = self._repository.get(name)
+            loaded = self._loaded.get(name, False)
         if model is None:
             raise CoreError(f"Request for unknown model: '{name}'", 404)
-        if not self._loaded.get(name, False):
+        if not loaded:
             raise CoreError(
                 f"Request for unknown model: '{name}' is not ready", 400
             )
@@ -924,10 +915,12 @@ class InferenceCore:
         return True
 
     def is_model_ready(self, name: str, version: str = "") -> bool:
-        model = self._repository.get(name)
+        with self._lock:
+            model = self._repository.get(name)
+            loaded = self._loaded.get(name, False)
         if model is None:
             raise CoreError(f"Request for unknown model: '{name}'", 400)
-        if not self._loaded.get(name, False):
+        if not loaded:
             return False
         if version:
             # Per-version readiness: file-override models expose the version
@@ -951,8 +944,11 @@ class InferenceCore:
 
     def repository_index(self, ready: bool = False) -> List[dict]:
         out = []
-        for name, model in sorted(self._repository.items()):
-            is_ready = self._loaded.get(name, False)
+        with self._lock:
+            items = sorted(self._repository.items())
+            loaded = dict(self._loaded)
+        for name, model in items:
+            is_ready = loaded.get(name, False)
             if ready and not is_ready:
                 continue
             out.append(
@@ -993,42 +989,48 @@ class InferenceCore:
                 raise CoreError(
                     f"failed to load '{name}': invalid config override", 400
                 )
-            original = self._repository.get(name)
-            if original is not None and name not in self._overridden:
-                if isinstance(original, _FileOverrideModel):
-                    pass  # re-override: nothing repository-owned to preserve
-                else:
-                    self._overridden[name] = original
-            self._repository[name] = _FileOverrideModel(name, override, files)
-            self._loaded[name] = True
-            self._stats.setdefault(name, _ModelStats())
+            override_model = _FileOverrideModel(name, override, files)
+            with self._lock:
+                original = self._repository.get(name)
+                if original is not None and name not in self._overridden:
+                    if isinstance(original, _FileOverrideModel):
+                        pass  # re-override: nothing repository-owned to keep
+                    else:
+                        self._overridden[name] = original
+                self._repository[name] = override_model
+                self._loaded[name] = True
+                self._stats.setdefault(name, _ModelStats())
             return
 
         # Plain / config-only load: revert any file override first (Triton
         # polls the repository directory again on such loads).
-        if name in self._overridden:
-            self._repository[name] = self._overridden.pop(name)
-        model = self._repository.get(name)
-        if model is None or isinstance(model, _FileOverrideModel):
-            raise CoreError(f"failed to load '{name}', no such model", 400)
-        if config_override:
-            try:
-                override = json.loads(config_override)
-            except (TypeError, ValueError):
-                raise CoreError(f"failed to load '{name}': invalid config override", 400)
-            model._config_override = override
-        else:
-            # A plain reload reverts to the model's own config (Triton
-            # semantics: no config parameter means repository config).
-            model._config_override = {}
-        self._loaded[name] = True
+        with self._lock:
+            if name in self._overridden:
+                self._repository[name] = self._overridden.pop(name)
+            model = self._repository.get(name)
+            if model is None or isinstance(model, _FileOverrideModel):
+                raise CoreError(f"failed to load '{name}', no such model", 400)
+            if config_override:
+                try:
+                    override = json.loads(config_override)
+                except (TypeError, ValueError):
+                    raise CoreError(
+                        f"failed to load '{name}': invalid config override", 400
+                    )
+                model._config_override = override
+            else:
+                # A plain reload reverts to the model's own config (Triton
+                # semantics: no config parameter means repository config).
+                model._config_override = {}
+            self._loaded[name] = True
         if hasattr(model, "warmup"):
             model.warmup()
 
     def unload_model(self, name: str, parameters: Optional[dict] = None):
-        if name not in self._repository:
-            raise CoreError(f"failed to unload '{name}', no such model", 400)
-        self._loaded[name] = False
+        with self._lock:
+            if name not in self._repository:
+                raise CoreError(f"failed to unload '{name}', no such model", 400)
+            self._loaded[name] = False
 
     def prometheus_metrics(self) -> str:
         """Triton-compatible Prometheus exposition (the server repo's
@@ -1147,12 +1149,16 @@ class InferenceCore:
     def model_statistics(self, name: str = "", version: str = "") -> List[dict]:
         if name:
             model = self._get_model(name, version)
-            return [self._stats[name].as_dict(name, model.version)]
-        return [
-            self._stats[n].as_dict(n, m.version)
-            for n, m in sorted(self._repository.items())
-            if self._loaded.get(n, False)
-        ]
+            with self._lock:
+                stats = self._stats[name]
+            return [stats.as_dict(name, model.version)]
+        with self._lock:
+            rows = [
+                (n, m.version, self._stats[n])
+                for n, m in sorted(self._repository.items())
+                if self._loaded.get(n, False)
+            ]
+        return [stats.as_dict(n, version) for n, version, stats in rows]
 
     # -- trace / log settings ------------------------------------------------
 
@@ -1168,31 +1174,33 @@ class InferenceCore:
                 else [str(value)]
             )
 
-        if model_name == "":
-            current = self._trace_settings[""]
-            for key, value in (settings or {}).items():
-                # Clearing a global setting restores the server default.
-                current[key] = (
-                    list(_DEFAULT_TRACE_SETTINGS[key])
-                    if value is None
-                    else norm(value)
-                )
-        else:
-            overrides = self._trace_settings.setdefault(model_name, {})
-            for key, value in (settings or {}).items():
-                if value is None:
-                    # Triton semantics: clearing a model override makes the
-                    # model TRACK the global setting again (later global
-                    # updates apply), not snapshot its current value.
-                    overrides.pop(key, None)
-                else:
-                    overrides[key] = norm(value)
+        with self._lock:
+            if model_name == "":
+                current = self._trace_settings[""]
+                for key, value in (settings or {}).items():
+                    # Clearing a global setting restores the server default.
+                    current[key] = (
+                        list(_DEFAULT_TRACE_SETTINGS[key])
+                        if value is None
+                        else norm(value)
+                    )
+            else:
+                overrides = self._trace_settings.setdefault(model_name, {})
+                for key, value in (settings or {}).items():
+                    if value is None:
+                        # Triton semantics: clearing a model override makes
+                        # the model TRACK the global setting again (later
+                        # global updates apply), not snapshot its value.
+                        overrides.pop(key, None)
+                    else:
+                        overrides[key] = norm(value)
         return self.get_trace_settings(model_name)
 
     def get_trace_settings(self, model_name: str = "") -> dict:
-        merged = dict(self._trace_settings[""])
-        if model_name:
-            merged.update(self._trace_settings.get(model_name, {}))
+        with self._lock:
+            merged = dict(self._trace_settings[""])
+            if model_name:
+                merged.update(self._trace_settings.get(model_name, {}))
         return merged
 
     def start_trace(
@@ -1208,7 +1216,10 @@ class InferenceCore:
         Called by the protocol front-ends at ingress, before parse cost is
         known — hence the fast OFF path.
         """
-        ts = self._trace_settings
+        # Lock-free fast path (runs per request, before parse cost is
+        # known): a GIL-atomic read of an always-present dict. The worst
+        # race is one request sampled against just-cleared settings.
+        ts = self._trace_settings  # tpulint: disable=TPU002
         if len(ts) == 1 and ts[""]["trace_level"] == ["OFF"]:
             return None  # hot path: tracing never enabled anywhere
         return self.trace_collector.sample(
@@ -1278,16 +1289,16 @@ class InferenceCore:
         self, request: CoreRequest
     ) -> Union[CoreResponse, Iterator[CoreResponse]]:
         model = self._get_model(request.model_name, request.model_version)
-        stats = self._stats[request.model_name]
+        with self._lock:
+            stats = self._stats[request.model_name]
+            batcher = self._batchers.get(request.model_name)
+            stats.pending += 1
         if self._log_verbose >= 1:
             self._log.debug(
                 "infer model=%s version=%s id=%s inputs=%d",
                 request.model_name, request.model_version or "latest",
                 request.id, len(request.inputs),
             )
-        batcher = self._batchers.get(request.model_name)
-        with self._lock:
-            stats.pending += 1
         try:
             # dynamic_batching re-checked on the CURRENT model: a file-override
             # load shadows the opted-in model under the same name, and the
@@ -1312,8 +1323,9 @@ class InferenceCore:
         rate while a response thread finalizes in stream order.
         """
         model = self._get_model(request.model_name, request.model_version)
-        stats = self._stats[request.model_name]
-        batcher = self._batchers.get(request.model_name)
+        with self._lock:
+            stats = self._stats[request.model_name]
+            batcher = self._batchers.get(request.model_name)
         if batcher is not None and getattr(model, "dynamic_batching", False):
             cap = self._effective_max_batch(model)
             if batcher.eligible(request, cap):

@@ -15,6 +15,14 @@ import grpc
 import numpy as np
 
 from tritonclient_tpu.protocol import make_service_handler, pb
+from tritonclient_tpu.protocol._literals import (
+    KEY_CLASSIFICATION,
+    KEY_EMPTY_FINAL_RESPONSE,
+    KEY_FINAL_RESPONSE,
+    KEY_SHM_BYTE_SIZE,
+    KEY_SHM_OFFSET,
+    KEY_SHM_REGION,
+)
 from tritonclient_tpu.server._core import (
     CoreError,
     CoreRequest,
@@ -104,10 +112,10 @@ def request_to_core(request: pb.ModelInferRequest, core: InferenceCore) -> CoreR
             shape=list(tensor.shape),
         )
         params = {k: _param_value(v) for k, v in tensor.parameters.items()}
-        if "shared_memory_region" in params:
-            ct.shm_region = params["shared_memory_region"]
-            ct.shm_offset = int(params.get("shared_memory_offset", 0))
-            ct.shm_byte_size = int(params.get("shared_memory_byte_size", 0))
+        if KEY_SHM_REGION in params:
+            ct.shm_region = params[KEY_SHM_REGION]
+            ct.shm_offset = int(params.get(KEY_SHM_OFFSET, 0))
+            ct.shm_byte_size = int(params.get(KEY_SHM_BYTE_SIZE, 0))
             ct.shm_kind = core.find_shm_kind(ct.shm_region)
         elif use_raw:
             # Triton rejects mixing the two content planes (the reference's
@@ -131,12 +139,12 @@ def request_to_core(request: pb.ModelInferRequest, core: InferenceCore) -> CoreR
         params = {k: _param_value(v) for k, v in out.parameters.items()}
         co = CoreRequestedOutput(
             name=out.name,
-            class_count=int(params.get("classification", 0)),
+            class_count=int(params.get(KEY_CLASSIFICATION, 0)),
         )
-        if "shared_memory_region" in params:
-            co.shm_region = params["shared_memory_region"]
-            co.shm_offset = int(params.get("shared_memory_offset", 0))
-            co.shm_byte_size = int(params.get("shared_memory_byte_size", 0))
+        if KEY_SHM_REGION in params:
+            co.shm_region = params[KEY_SHM_REGION]
+            co.shm_offset = int(params.get(KEY_SHM_OFFSET, 0))
+            co.shm_byte_size = int(params.get(KEY_SHM_BYTE_SIZE, 0))
             co.shm_kind = core.find_shm_kind(co.shm_region)
         creq.outputs.append(co)
     return creq
@@ -186,9 +194,9 @@ def core_to_response(cresp: CoreResponse) -> pb.ModelInferResponse:
         t.datatype = out.datatype
         t.shape.extend(out.shape)
         if out.shm_region is not None:
-            t.parameters["shared_memory_region"].string_param = out.shm_region
-            t.parameters["shared_memory_offset"].int64_param = out.shm_offset
-            t.parameters["shared_memory_byte_size"].int64_param = out.shm_byte_size
+            t.parameters[KEY_SHM_REGION].string_param = out.shm_region
+            t.parameters[KEY_SHM_OFFSET].int64_param = out.shm_offset
+            t.parameters[KEY_SHM_BYTE_SIZE].int64_param = out.shm_byte_size
             resp.raw_output_contents.append(b"")
         else:
             resp.raw_output_contents.append(
@@ -775,7 +783,7 @@ def _guard_stream(gen, request_id: str):
 
 
 def _want_final(request: pb.ModelInferRequest) -> bool:
-    p = request.parameters.get("triton_enable_empty_final_response")
+    p = request.parameters.get(KEY_EMPTY_FINAL_RESPONSE)
     if p is not None and p.WhichOneof("parameter_choice"):
         return bool(_param_value(p))
     return False
@@ -788,19 +796,19 @@ def _stream_responses(request, cresp, want_final):
     if isinstance(cresp, CoreResponse):
         resp = core_to_response(cresp)
         if want_final:
-            resp.parameters["triton_final_response"].bool_param = True
+            resp.parameters[KEY_FINAL_RESPONSE].bool_param = True
         yield pb.ModelStreamInferResponse(infer_response=resp)
     else:
         for item in cresp:
             resp = core_to_response(item)
             if want_final:
-                resp.parameters["triton_final_response"].bool_param = False
+                resp.parameters[KEY_FINAL_RESPONSE].bool_param = False
             yield pb.ModelStreamInferResponse(infer_response=resp)
         if want_final:
             final = pb.ModelInferResponse(
                 model_name=request.model_name, id=request.id
             )
-            final.parameters["triton_final_response"].bool_param = True
+            final.parameters[KEY_FINAL_RESPONSE].bool_param = True
             yield pb.ModelStreamInferResponse(infer_response=final)
 
 

@@ -10,7 +10,6 @@ infer with the JSON + appended-binary framing governed by the
 import base64
 import gzip
 import json
-import re
 import socket
 import threading
 import time
@@ -20,6 +19,26 @@ from typing import List, Optional
 
 import numpy as np
 
+from tritonclient_tpu.protocol._literals import (
+    EP_HEALTH_LIVE,
+    EP_HEALTH_READY,
+    EP_LOGGING,
+    EP_METRICS,
+    EP_REPOSITORY_INDEX,
+    EP_SERVER_METADATA,
+    EP_TRACE_SETTING,
+    KEY_BINARY_DATA,
+    KEY_BINARY_DATA_OUTPUT,
+    KEY_BINARY_DATA_SIZE,
+    KEY_CLASSIFICATION,
+    KEY_SHM_BYTE_SIZE,
+    KEY_SHM_OFFSET,
+    KEY_SHM_REGION,
+    MODEL_ROUTE_RE,
+    REPOSITORY_ROUTE_RE,
+    SHM_ROUTE_RE,
+    SHM_URL_KINDS,
+)
 from tritonclient_tpu.server._core import (
     CoreError,
     CoreRequest,
@@ -28,8 +47,6 @@ from tritonclient_tpu.server._core import (
     InferenceCore,
 )
 from tritonclient_tpu.utils import triton_to_np_dtype
-
-_SHM_KINDS = {"systemsharedmemory": "system", "cudasharedmemory": "cuda", "tpusharedmemory": "tpu"}
 
 
 def _json_default(obj):
@@ -147,7 +164,7 @@ class _Handler(BaseHTTPRequestHandler):
         parts = path.split("/")
         core = self.core
 
-        if path == "metrics" and method == "GET":
+        if path == EP_METRICS and method == "GET":
             # Triton serves Prometheus metrics on a dedicated port; the
             # in-process server exposes the same nv_inference_* family on
             # its one HTTP port. GET-only (Triton parity); anything else
@@ -158,25 +175,21 @@ class _Handler(BaseHTTPRequestHandler):
                 content_type="text/plain; version=0.0.4; charset=utf-8",
             )
 
-        if parts[0] != "v2":
+        if parts[0] != EP_SERVER_METADATA:
             self._send_json({"error": "not found"}, 404)
             self._read_body()
             return
 
         # v2/health/live, v2/health/ready
-        if path == "v2/health/live":
+        if path == EP_HEALTH_LIVE:
             return self._send(200 if core.is_server_live() else 400, b"")
-        if path == "v2/health/ready":
+        if path == EP_HEALTH_READY:
             return self._send(200 if core.is_server_ready() else 400, b"")
-        if path == "v2":
+        if path == EP_SERVER_METADATA:
             return self._send_json(core.server_metadata())
 
         # v2/models/{m}[/versions/{v}]/...
-        m = re.match(
-            r"^v2/models/(?P<model>[^/]+)(?:/versions/(?P<version>[^/]+))?"
-            r"(?:/(?P<action>ready|config|stats|infer|trace/setting))?$",
-            path,
-        )
+        m = MODEL_ROUTE_RE.match(path)
         if m:
             model, version = m.group("model"), m.group("version") or ""
             action = m.group("action")
@@ -196,19 +209,19 @@ class _Handler(BaseHTTPRequestHandler):
             if action == "trace/setting":
                 return self._trace_setting(model_name=model, method=method)
 
-        if path == "v2/trace/setting":
+        if path == EP_TRACE_SETTING:
             return self._trace_setting(model_name="", method=method)
-        if path == "v2/logging":
+        if path == EP_LOGGING:
             return self._logging(method)
 
-        if path == "v2/repository/index":
+        if path == EP_REPOSITORY_INDEX:
             body = self._read_body()
             ready = False
             if body:
                 ready = bool(json.loads(body).get("ready", False))
             return self._send_json(core.repository_index(ready))
 
-        m = re.match(r"^v2/repository/models/(?P<model>[^/]+)/(?P<action>load|unload)$", path)
+        m = REPOSITORY_ROUTE_RE.match(path)
         if m:
             body = self._read_body()
             params = json.loads(body).get("parameters", {}) if body else {}
@@ -224,11 +237,7 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_json(None, 200)
 
         # shared memory admin
-        m = re.match(
-            r"^v2/(?P<kind>systemsharedmemory|cudasharedmemory|tpusharedmemory)"
-            r"(?:/region/(?P<region>[^/]+))?/(?P<action>status|register|unregister)$",
-            path,
-        )
+        m = SHM_ROUTE_RE.match(path)
         if m:
             return self._shm(m.group("kind"), m.group("region"), m.group("action"))
 
@@ -253,7 +262,7 @@ class _Handler(BaseHTTPRequestHandler):
         return self._send_json(self.core.update_log_settings(settings))
 
     def _shm(self, kind_path: str, region: Optional[str], action: str):
-        kind = _SHM_KINDS[kind_path]
+        kind = SHM_URL_KINDS[kind_path]
         registry = self.core.shm_registry(kind)
         if action == "status":
             self._read_body()
@@ -326,13 +335,13 @@ class _Handler(BaseHTTPRequestHandler):
             params = js.get("parameters", {})
             name, datatype, shape = js["name"], js["datatype"], list(js["shape"])
             tensor = CoreTensor(name=name, datatype=datatype, shape=shape)
-            if "shared_memory_region" in params:
-                tensor.shm_region = params["shared_memory_region"]
-                tensor.shm_offset = int(params.get("shared_memory_offset", 0))
-                tensor.shm_byte_size = int(params.get("shared_memory_byte_size", 0))
+            if KEY_SHM_REGION in params:
+                tensor.shm_region = params[KEY_SHM_REGION]
+                tensor.shm_offset = int(params.get(KEY_SHM_OFFSET, 0))
+                tensor.shm_byte_size = int(params.get(KEY_SHM_BYTE_SIZE, 0))
                 tensor.shm_kind = self.core.find_shm_kind(tensor.shm_region)
-            elif "binary_data_size" in params:
-                size = int(params["binary_data_size"])
+            elif KEY_BINARY_DATA_SIZE in params:
+                size = int(params[KEY_BINARY_DATA_SIZE])
                 raw = binary_blob[offset : offset + size]
                 offset += size
                 tensor.data = InferenceCore._decode_raw(datatype, shape, raw)
@@ -340,18 +349,18 @@ class _Handler(BaseHTTPRequestHandler):
                 tensor.data = _json_data_to_array(datatype, shape, js.get("data"))
             request.inputs.append(tensor)
 
-        binary_default = bool(request.parameters.pop("binary_data_output", False))
+        binary_default = bool(request.parameters.pop(KEY_BINARY_DATA_OUTPUT, False))
         for js in header.get("outputs", []):
             params = js.get("parameters", {})
             out = CoreRequestedOutput(
                 name=js["name"],
-                binary=bool(params.get("binary_data", binary_default)),
-                class_count=int(params.get("classification", 0)),
+                binary=bool(params.get(KEY_BINARY_DATA, binary_default)),
+                class_count=int(params.get(KEY_CLASSIFICATION, 0)),
             )
-            if "shared_memory_region" in params:
-                out.shm_region = params["shared_memory_region"]
-                out.shm_offset = int(params.get("shared_memory_offset", 0))
-                out.shm_byte_size = int(params.get("shared_memory_byte_size", 0))
+            if KEY_SHM_REGION in params:
+                out.shm_region = params[KEY_SHM_REGION]
+                out.shm_offset = int(params.get(KEY_SHM_OFFSET, 0))
+                out.shm_byte_size = int(params.get(KEY_SHM_BYTE_SIZE, 0))
                 out.shm_kind = self.core.find_shm_kind(out.shm_region)
             request.outputs.append(out)
 
@@ -394,13 +403,13 @@ class _Handler(BaseHTTPRequestHandler):
             }
             if out.shm_region is not None:
                 entry["parameters"] = {
-                    "shared_memory_region": out.shm_region,
-                    "shared_memory_offset": out.shm_offset,
-                    "shared_memory_byte_size": out.shm_byte_size,
+                    KEY_SHM_REGION: out.shm_region,
+                    KEY_SHM_OFFSET: out.shm_offset,
+                    KEY_SHM_BYTE_SIZE: out.shm_byte_size,
                 }
             elif requested_binary.get(out.name, binary_default):
                 raw = InferenceCore._encode_raw(out.datatype, out.data)
-                entry["parameters"] = {"binary_data_size": len(raw)}
+                entry["parameters"] = {KEY_BINARY_DATA_SIZE: len(raw)}
                 blobs.append(raw)
             else:
                 entry["data"] = _array_to_json_data(out.datatype, out.data)

@@ -282,7 +282,8 @@ class TransferCoalescer:
                     # fallback warm copies are themselves guarded: on a
                     # broken runtime they raise the SAME error, which
                     # must not escape either.
-                    self.stats["errors"] += 1
+                    with self._cv:
+                        self.stats["errors"] += 1
                     for item in batch:
                         try:
                             item[2].copy_to_host_async()
@@ -298,7 +299,8 @@ class TransferCoalescer:
             ).append(item)
         for (_, shp), items in groups.items():
             if len(items) == 1:
-                self.stats["singles"] += 1
+                with self._cv:
+                    self.stats["singles"] += 1
                 try:
                     items[0][2].copy_to_host_async()
                 except AttributeError:
@@ -314,25 +316,30 @@ class TransferCoalescer:
             except Exception:
                 # Defensive: bundling is an optimization — on any failure
                 # the originals stay parked and get their own warm copies.
-                self.stats["errors"] += 1
+                with self._cv:
+                    self.stats["errors"] += 1
                 for it in items:
                     try:
                         it[2].copy_to_host_async()
                     except AttributeError:
                         pass
                 continue
-            self.stats["bundles"] += 1
-            self.stats["bundled_members"] += k
             n = math.prod(shp)
             sb = SharedBatch(bundle)
+            cas_ok = cas_miss = 0
             for i, (region, offset, arr, _) in enumerate(items):
                 view = BatchRowView(
                     sb, i * n, (i + 1) * n, shape=shp
                 )
                 if region._replace_parked(offset, arr, view):
-                    self.stats["cas_ok"] += 1
+                    cas_ok += 1
                 else:
-                    self.stats["cas_miss"] += 1
+                    cas_miss += 1
+            with self._cv:
+                self.stats["bundles"] += 1
+                self.stats["bundled_members"] += k
+                self.stats["cas_ok"] += cas_ok
+                self.stats["cas_miss"] += cas_miss
 
     def _bundle(self, *arrs):
         if self._bundle_fn is None:
@@ -417,11 +424,12 @@ class TpuSharedMemoryRegion:
                 f"{self.byte_size} for region '{self.triton_shm_name}'"
             )
 
-    def _drop_overlapping(self, offset: int, nbytes: int):
+    def _drop_overlapping(self, offset, nbytes):  # tpulint: disable=TPU002
         """Evict parked arrays overlapping [offset, offset+nbytes).
 
         Partially-overlapped arrays are flushed to the byte mirror first so
-        their non-overlapped bytes stay readable.
+        their non-overlapped bytes stay readable. The caller holds
+        ``self._lock`` (hence the tpulint suppression above).
         """
         for off in list(self._parked):
             arr = self._parked[off]
@@ -876,8 +884,8 @@ def allocated_shared_memory_regions() -> List[str]:
 
 
 def destroy_shared_memory_region(shm_handle: TpuSharedMemoryRegion):
-    shm_handle._destroyed = True
     with shm_handle._lock:
+        shm_handle._destroyed = True
         shm_handle._parked.clear()
         shm_handle._mirror = bytearray(0)
     with _registry_lock:
