@@ -14,7 +14,7 @@ Checks (exit 1 with one line per violation):
     (backslash, quote, and newline must be escaped)
   * histogram families: ``le`` bucket bounds strictly ascending, cumulative
     bucket values non-decreasing, a ``+Inf`` bucket present, ``_count``
-    equal to the ``+Inf`` bucket, and ``_sum`` present
+    equal to the ``+Inf`` bucket, and ``_sum`` present and >= 0
 """
 
 import re
@@ -168,6 +168,11 @@ def check_exposition(text: str) -> List[str]:
                 prev = value
             if entry["sum"] is None:
                 errors.append(f"{family}{label_desc}: missing _sum")
+            elif entry["sum"] < 0:
+                errors.append(
+                    f"{family}{label_desc}: _sum {entry['sum']} < 0 "
+                    "(durations cannot be negative)"
+                )
             if entry["count"] is None:
                 errors.append(f"{family}{label_desc}: missing _count")
             elif bounds[-1] == float("inf") and entry["count"] != buckets[-1][1]:

@@ -1,0 +1,232 @@
+#!/usr/bin/env python
+"""Per-span latency breakdown for a trace file written by the server.
+
+Loads any of the three ``trace_mode`` exporter formats — ``triton``
+(Triton-shaped JSON array), ``otlp`` (OTLP/JSON), or ``perfetto``
+(Chrome trace-event JSON, including perf_analyzer ``--trace-out`` merged
+files) — normalizes them to one span list, and prints:
+
+* per-span-name latency percentiles (count, p50/p95/p99/max, in us);
+* the N slowest traces (root-span duration), with their span stack.
+
+Usage::
+
+    python scripts/trace_report.py TRACE_FILE [--slowest N] [--json]
+    python scripts/trace_report.py --self-check
+
+``--self-check`` synthesizes a trace, round-trips it through every
+exporter and this loader, and exits non-zero on any disagreement — the CI
+smoke test for the whole exporter/loader pipeline.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from typing import Dict, List
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from tritonclient_tpu import _otel  # noqa: E402
+
+
+def _percentile(sorted_values: List[int], pct: float) -> int:
+    if not sorted_values:
+        return 0
+    import math
+
+    idx = min(
+        len(sorted_values) - 1,
+        math.ceil(pct / 100.0 * len(sorted_values)) - 1,
+    )
+    return sorted_values[max(idx, 0)]
+
+
+def breakdown(spans: List[dict]) -> List[dict]:
+    """Per-span-name duration stats, slowest-p99 first."""
+    by_name: Dict[str, List[int]] = {}
+    for span in spans:
+        by_name.setdefault(span["name"], []).append(span["duration_ns"])
+    rows = []
+    for name, durations in by_name.items():
+        durations.sort()
+        rows.append({
+            "span": name,
+            "count": len(durations),
+            "p50_us": _percentile(durations, 50) // 1000,
+            "p95_us": _percentile(durations, 95) // 1000,
+            "p99_us": _percentile(durations, 99) // 1000,
+            "max_us": durations[-1] // 1000,
+        })
+    rows.sort(key=lambda r: r["p99_us"], reverse=True)
+    return rows
+
+
+def slowest_traces(spans: List[dict], n: int) -> List[dict]:
+    """Traces ranked by root-span duration (falling back to the trace's
+    span envelope when no parentless span was captured)."""
+    by_trace: Dict[str, List[dict]] = {}
+    for span in spans:
+        by_trace.setdefault(span["trace_id"], []).append(span)
+    ranked = []
+    for trace_id, members in by_trace.items():
+        ids = {m["span_id"] for m in members}
+        roots = [m for m in members if m["parent_span_id"] not in ids]
+        duration = (
+            max(m["duration_ns"] for m in roots)
+            if roots
+            else max(m["end_ns"] for m in members)
+            - min(m["start_ns"] for m in members)
+        )
+        attrs: Dict[str, str] = {}
+        for m in members:  # client spans carry no model/request id
+            for key, value in (m.get("attributes") or {}).items():
+                attrs.setdefault(key, value)
+        ranked.append({
+            "trace_id": trace_id,
+            "duration_us": duration // 1000,
+            "spans": {
+                m["name"]: m["duration_ns"] // 1000
+                for m in sorted(members, key=lambda m: m["start_ns"])
+            },
+            "model": attrs.get("model", attrs.get("model.name", "")),
+            "request_id": attrs.get("request_id", attrs.get("request.id", "")),
+        })
+    ranked.sort(key=lambda t: t["duration_us"], reverse=True)
+    return ranked[:n]
+
+
+def report(spans: List[dict], slowest: int, as_json: bool) -> str:
+    rows = breakdown(spans)
+    worst = slowest_traces(spans, slowest)
+    if as_json:
+        return json.dumps({"breakdown": rows, "slowest": worst}, indent=2)
+    n_traces = len({s["trace_id"] for s in spans})
+    lines = [f"{len(spans)} spans, {n_traces} traces"]
+    lines.append(
+        f"{'span':<18} {'count':>6} {'p50_us':>8} {'p95_us':>8} "
+        f"{'p99_us':>8} {'max_us':>8}"
+    )
+    for r in rows:
+        lines.append(
+            f"{r['span']:<18} {r['count']:>6} {r['p50_us']:>8} "
+            f"{r['p95_us']:>8} {r['p99_us']:>8} {r['max_us']:>8}"
+        )
+    if worst:
+        lines.append("")
+        lines.append(f"slowest {len(worst)} trace(s):")
+        for t in worst:
+            label = t["model"] or "?"
+            if t["request_id"]:
+                label += f" id={t['request_id']}"
+            stack = ", ".join(
+                f"{name}={us}us" for name, us in t["spans"].items()
+            )
+            lines.append(
+                f"  {t['trace_id'][:16]}… {t['duration_us']} us "
+                f"[{label}] {stack}"
+            )
+    return "\n".join(lines)
+
+
+def self_check() -> int:
+    """Round-trip a synthetic trace through every exporter and the loader."""
+    base = 1_000_000_000
+    timestamps = {
+        "REQUEST_RECV": base,
+        "QUEUE_START": base + 100_000,
+        "COMPUTE_INPUT": base + 400_000,
+        "COMPUTE_INFER": base + 500_000,
+        "COMPUTE_OUTPUT": base + 2_400_000,
+        "RESPONSE_SEND": base + 2_600_000,
+    }
+    trace_id, parent = _otel.new_trace_id(), _otel.new_span_id()
+    record = _otel.TraceRecord(
+        seq_id=1, model_name="selfcheck", model_version="1",
+        request_id="sc-1", trace_id=trace_id, parent_span_id=parent,
+        spans=_otel.build_span_tree(
+            trace_id, parent, timestamps, {"batch.id": 7}
+        ),
+        timestamps=timestamps,
+    )
+    expected = {
+        ("request-handler", 2_600_000),
+        ("batch-queue-wait", 300_000),
+        ("compute", 2_000_000),
+        ("response-marshal", 200_000),
+    }
+    failures = 0
+    with tempfile.TemporaryDirectory() as tmp:
+        for mode in _otel.TRACE_MODES:
+            path = os.path.join(tmp, f"trace.{mode}.json")
+            with open(path, "w") as f:
+                f.write(_otel.render_trace_file(mode, [record], epoch_ns=0))
+            json.load(open(path))  # every exporter's output is valid JSON
+            spans = _otel.load_trace_file(path)
+            got = {(s["name"], s["duration_ns"]) for s in spans}
+            if got != expected:
+                print(f"self-check [{mode}]: spans {got} != {expected}",
+                      file=sys.stderr)
+                failures += 1
+                continue
+            ids = {s["trace_id"] for s in spans}
+            if ids != {trace_id}:
+                print(f"self-check [{mode}]: trace id not preserved: {ids}",
+                      file=sys.stderr)
+                failures += 1
+                continue
+            handlers = [s for s in spans if s["name"] == "request-handler"]
+            if mode != "triton" and handlers[0]["parent_span_id"] != parent:
+                # (The triton loader re-derives the tree, so only the
+                # span-native formats must preserve the inbound parent.)
+                print(f"self-check [{mode}]: parent span id lost",
+                      file=sys.stderr)
+                failures += 1
+                continue
+            report(spans, slowest=1, as_json=False)  # must not raise
+            print(f"self-check [{mode}]: ok")
+    if failures:
+        print(f"self-check: {failures} failure(s)", file=sys.stderr)
+        return 1
+    print("self-check: all exporters round-trip")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="trace_report",
+        description="Per-span latency breakdown for server trace files",
+    )
+    parser.add_argument("trace_file", nargs="?",
+                        help="trace file in any trace_mode format")
+    parser.add_argument("--slowest", type=int, default=5, metavar="N",
+                        help="how many slowest traces to list (default 5)")
+    parser.add_argument("--json", dest="as_json", action="store_true",
+                        help="emit the report as JSON")
+    parser.add_argument("--self-check", action="store_true",
+                        help="round-trip every exporter format and exit")
+    args = parser.parse_args(argv)
+    if args.self_check:
+        return self_check()
+    if not args.trace_file:
+        parser.error("a trace file is required (or --self-check)")
+    try:
+        spans = _otel.load_trace_file(args.trace_file)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"unable to load {args.trace_file}: {e}", file=sys.stderr)
+        return 1
+    if not spans:
+        print(f"{args.trace_file}: no spans", file=sys.stderr)
+        return 1
+    try:
+        print(report(spans, args.slowest, args.as_json))
+    except BrokenPipeError:  # e.g. piped into head
+        return 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,6 +1,7 @@
-"""Observability plane: request tracing, latency histograms, queue-depth
-gauge, structured logging, exposition validity, and the perf_analyzer
-server-stats report."""
+"""Observability plane: request tracing (W3C traceparent propagation, span
+trees, pluggable exporters), latency histograms, queue-depth gauges,
+structured logging, exposition validity, and the perf_analyzer
+server-stats / --trace-out reports."""
 
 import importlib.util
 import json
@@ -13,6 +14,7 @@ import pytest
 
 import tritonclient_tpu.grpc as grpcclient
 import tritonclient_tpu.http as httpclient
+from tritonclient_tpu import _otel
 from tritonclient_tpu.perf_analyzer import PerfAnalyzer
 from tritonclient_tpu.perf_analyzer._stats import RequestTimers
 from tritonclient_tpu.server import InferenceServer
@@ -27,15 +29,23 @@ SPAN_ORDER = [
 ]
 
 
-def _load_checker():
+def _load_script(name: str, module: str):
     path = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "scripts", "check_metrics_exposition.py",
+        "scripts", name,
     )
-    spec = importlib.util.spec_from_file_location("check_metrics", path)
+    spec = importlib.util.spec_from_file_location(module, path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def _load_checker():
+    return _load_script("check_metrics_exposition.py", "check_metrics")
+
+
+def _load_trace_report():
+    return _load_script("trace_report.py", "trace_report")
 
 
 @pytest.fixture()
@@ -104,7 +114,7 @@ def test_trace_lifecycle_all_spans_ordered(server, tmp_path):
     inf = stats["model_stats"][0]["inference_stats"]
     reported_ns = int(inf["success"]["ns"])
 
-    records = json.load(open(trace_file))
+    records = _read_trace(trace_file, 5)
     assert len(records) == 5
     assert {r["request_id"] for r in records} == {
         "http-0", "http-1", "http-2", "grpc-0", "grpc-1"
@@ -138,7 +148,7 @@ def test_trace_rate_and_count(server, tmp_path):
     })
     for i in range(6):
         client.infer("simple", _http_inputs(i))
-    assert len(json.load(open(trace_file))) == 3  # every 2nd request
+    assert len(_read_trace(trace_file, 3)) == 3  # every 2nd request
 
     # trace_count bounds the budget; resetting it opens a new budget.
     count_file = str(tmp_path / "counted.json")
@@ -149,7 +159,7 @@ def test_trace_rate_and_count(server, tmp_path):
     })
     for i in range(5):
         client.infer("simple", _http_inputs(i))
-    assert len(json.load(open(count_file))) == 2
+    assert len(_read_trace(count_file, 2)) == 2
     client.close()
 
 
@@ -189,6 +199,296 @@ def test_trace_override_clear_via_clients(server):
     assert got["settings"]["trace_rate"]["value"] == ["43"]
     gclient.close()
     hclient.close()
+
+
+# --------------------------------------------------------------------------- #
+# distributed tracing: traceparent, span tree, exporters                      #
+# --------------------------------------------------------------------------- #
+
+
+def _enable_tracing(client, trace_file, mode="triton"):
+    client.update_trace_settings("", {
+        "trace_level": ["TIMESTAMPS"],
+        "trace_rate": ["1"],
+        "trace_file": [trace_file],
+        "log_frequency": ["1"],
+        "trace_mode": [mode],
+    })
+
+
+def _mint():
+    return _otel.new_trace_id(), _otel.new_span_id()
+
+
+def _read_trace(path, n_records=1, timeout_s=10.0):
+    """Poll for a trace file holding >= n_records records/spans.
+
+    The RESPONSE_SEND stamp (and the flush it triggers) happens after the
+    response bytes are on the wire, so the client can observe its reply
+    before the server finishes writing the trace file.
+    """
+    import time as _time
+
+    deadline = _time.monotonic() + timeout_s
+    last = None
+    while _time.monotonic() < deadline:
+        try:
+            doc = json.load(open(path))
+            count = (
+                len(doc) if isinstance(doc, list)
+                else len(doc.get("traceEvents") or [])
+                or sum(
+                    len(ss.get("spans", []))
+                    for rs in doc.get("resourceSpans", [])
+                    for ss in rs.get("scopeSpans", [])
+                )
+            )
+            if count >= n_records:
+                return doc
+            last = doc
+        except (OSError, ValueError):
+            pass
+        _time.sleep(0.02)
+    raise AssertionError(f"trace file {path} incomplete: {last}")
+
+
+def test_traceparent_survives_http_grpc_and_both_aio_paths(server, tmp_path):
+    """A client-initiated traceparent reaches server span records over all
+    four request paths — same trace id, client span id as the server
+    record's parent — whether passed via headers= or the traceparent
+    kwarg."""
+    import asyncio
+
+    import tritonclient_tpu.grpc.aio as agrpc
+    import tritonclient_tpu.http.aio as ahttp
+
+    trace_file = str(tmp_path / "w3c.json")
+    admin = httpclient.InferenceServerClient(server.http_address)
+    _enable_tracing(admin, trace_file)
+
+    sent = {}
+
+    def expect(rid):
+        tid, sid = _mint()
+        sent[rid] = (tid, sid)
+        return _otel.format_traceparent(tid, sid)
+
+    admin.infer(
+        "simple", _http_inputs(), request_id="http-hdr",
+        headers={"traceparent": expect("http-hdr")},
+    )
+    admin.infer(
+        "simple", _http_inputs(), request_id="http-kw",
+        traceparent=expect("http-kw"),
+    )
+    gclient = grpcclient.InferenceServerClient(server.grpc_address)
+    gclient.infer(
+        "simple", _grpc_inputs(), request_id="grpc-hdr",
+        headers={"traceparent": expect("grpc-hdr")},
+    )
+    gclient.infer(
+        "simple", _grpc_inputs(), request_id="grpc-kw",
+        traceparent=expect("grpc-kw"),
+    )
+    gclient.close()
+
+    async def aio_requests():
+        async with ahttp.InferenceServerClient(server.http_address) as c:
+            await c.infer(
+                "simple", _http_inputs(), request_id="ahttp",
+                headers={"traceparent": expect("ahttp")},
+            )
+        async with agrpc.InferenceServerClient(server.grpc_address) as c:
+            await c.infer(
+                "simple", _grpc_inputs(), request_id="agrpc",
+                headers={"traceparent": expect("agrpc")},
+            )
+
+    asyncio.run(aio_requests())
+    records = {r["request_id"]: r for r in _read_trace(trace_file, 6)}
+    assert set(records) == set(sent)
+    for rid, (tid, sid) in sent.items():
+        assert records[rid]["trace_id"] == tid, rid
+        assert records[rid]["parent_span_id"] == sid, rid
+    admin.close()
+
+
+def test_malformed_traceparent_restarts_trace(server, tmp_path):
+    """Unparseable/forbidden traceparent values must not fail the request;
+    the server restarts the trace with a fresh id (W3C requirement)."""
+    trace_file = str(tmp_path / "bad.json")
+    client = httpclient.InferenceServerClient(server.http_address)
+    _enable_tracing(client, trace_file)
+    bad_values = [
+        "garbage",
+        "00-" + "0" * 32 + "-" + "1" * 16 + "-01",  # all-zero trace id
+        "00-" + "a" * 32 + "-" + "0" * 16 + "-01",  # all-zero span id
+        "ff-" + "a" * 32 + "-" + "1" * 16 + "-01",  # forbidden version
+        "00-short-1111111111111111-01",
+    ]
+    for i, value in enumerate(bad_values):
+        result = client.infer(
+            "simple", _http_inputs(i), request_id=f"bad-{i}",
+            headers={"traceparent": value},
+        )
+        assert result is not None  # no 500; the request succeeded
+    records = {
+        r["request_id"]: r
+        for r in _read_trace(trace_file, len(bad_values))
+    }
+    assert set(records) == {f"bad-{i}" for i in range(len(bad_values))}
+    for record in records.values():
+        assert re.fullmatch(r"[0-9a-f]{32}", record["trace_id"])
+        assert record["trace_id"] != "a" * 32
+        assert record["parent_span_id"] == ""
+    client.close()
+
+
+def test_span_tree_parentage_and_batch_attribute(server, tmp_path):
+    """The otlp exporter emits the documented tree: batch-queue-wait /
+    compute / response-marshal as children of request-handler, which is
+    itself a child of the propagated client span; batched requests carry
+    the batch id on the spans batching shapes."""
+    trace_file = str(tmp_path / "tree.json")
+    client = httpclient.InferenceServerClient(server.http_address)
+    _enable_tracing(client, trace_file, mode="otlp")
+    tid, sid = _mint()
+    client.infer(
+        "simple", _http_inputs(), request_id="tree",
+        traceparent=_otel.format_traceparent(tid, sid),
+    )
+    doc = _read_trace(trace_file, 4)  # one record = four spans
+    spans = doc["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    by_name = {s["name"]: s for s in spans}
+    handler = by_name["request-handler"]
+    assert handler["traceId"] == tid
+    assert handler["parentSpanId"] == sid
+    for child in ("batch-queue-wait", "compute", "response-marshal"):
+        assert by_name[child]["parentSpanId"] == handler["spanId"], child
+        assert by_name[child]["traceId"] == tid
+        start = int(by_name[child]["startTimeUnixNano"])
+        end = int(by_name[child]["endTimeUnixNano"])
+        assert (int(handler["startTimeUnixNano"]) <= start
+                <= end <= int(handler["endTimeUnixNano"]))
+    compute_attrs = {
+        a["key"] for a in by_name["compute"]["attributes"]
+    }
+    assert "compute.infer_start_ns" in compute_attrs
+
+    # Batched execution (gRPC streaming rides the dynamic batcher): the
+    # queue-wait span carries the batch id attribute.
+    analyzer = PerfAnalyzer(
+        server.grpc_address, "simple", batch_size=2, streaming=True,
+        measurement_interval_s=0.4, warmup_s=0.1,
+    )
+    analyzer.measure(2)
+    client.update_trace_settings("", {"trace_level": ["OFF"]})
+    server.core.trace_collector.flush()
+    doc = json.load(open(trace_file))
+    spans = doc["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    batch_ids = [
+        a["value"] for s in spans if s["name"] == "batch-queue-wait"
+        for a in s.get("attributes", []) if a["key"] == "batch.id"
+    ]
+    assert batch_ids, "no batch.id attribute on any queue-wait span"
+    client.close()
+
+
+def test_each_exporter_round_trips_through_trace_report(server, tmp_path):
+    """Every trace_mode writes a file scripts/trace_report.py can load to
+    the same per-span breakdown; the perfetto output is valid trace-event
+    JSON."""
+    report = _load_trace_report()
+    client = httpclient.InferenceServerClient(server.http_address)
+    breakdowns = {}
+    for mode in ("triton", "otlp", "perfetto"):
+        trace_file = str(tmp_path / f"rt.{mode}.json")
+        _enable_tracing(client, trace_file, mode=mode)
+        client.infer("simple", _http_inputs(), request_id=f"rt-{mode}")
+        doc = _read_trace(trace_file)  # valid JSON for every mode
+        if mode == "perfetto":
+            assert isinstance(doc.get("traceEvents"), list)
+            assert all(e["ph"] == "X" for e in doc["traceEvents"])
+        spans = _otel.load_trace_file(trace_file)
+        rows = report.breakdown(spans)
+        assert rows, mode
+        breakdowns[mode] = {r["span"] for r in rows}
+        worst = report.slowest_traces(spans, 3)
+        assert worst and worst[0]["duration_us"] >= 0
+        # The CLI path end-to-end (prints the table, exit 0).
+        assert report.main([trace_file, "--slowest", "2"]) == 0
+    assert (
+        breakdowns["triton"] == breakdowns["otlp"] == breakdowns["perfetto"]
+    ), breakdowns
+    assert report.self_check() == 0
+    client.close()
+
+
+def test_trace_collector_atomic_write_and_buffer_cap(tmp_path):
+    """Trace files are staged via <file>.tmp + os.replace, and the
+    collector keeps at most max_buffered finished records per file."""
+    from tritonclient_tpu._tracing import TraceCollector
+
+    trace_file = str(tmp_path / "capped.json")
+    collector = TraceCollector(max_buffered=5)
+    settings = {
+        "trace_level": ["TIMESTAMPS"],
+        "trace_rate": ["1"],
+        "trace_file": [trace_file],
+        "log_frequency": ["1"],
+        "trace_mode": ["triton"],
+    }
+    for i in range(12):
+        ctx = collector.sample("m", settings, request_id=f"r{i}")
+        ctx.record("REQUEST_RECV", 1000 * i)
+        ctx.record("RESPONSE_SEND", 1000 * i + 500)
+        ctx.finish()
+    records = json.load(open(trace_file))
+    assert len(records) == 5  # oldest dropped at the cap
+    assert [r["request_id"] for r in records] == [
+        f"r{i}" for i in range(7, 12)
+    ]
+    assert collector.records(trace_file) == records
+    assert not os.path.exists(trace_file + ".tmp")  # replace, not append
+    collector.flush()
+    assert not os.path.exists(trace_file + ".tmp")
+
+
+def test_perf_analyzer_trace_out_merges_client_and_server_spans(
+    server, tmp_path
+):
+    """--trace-out writes one Perfetto file per window where server
+    request-handler spans nest under the client-send roots (same trace id,
+    client span as parent) and trace_report can load it."""
+    out = str(tmp_path / "merged.json")
+    analyzer = PerfAnalyzer(
+        server.grpc_address, "simple", batch_size=2,
+        measurement_interval_s=0.4, warmup_s=0.1, trace_out=out,
+    )
+    summary = analyzer.measure(2).summary()
+    assert summary["errors"] == 0 and summary["count"] > 0
+    doc = json.load(open(out))
+    events = doc["traceEvents"]
+    assert events and all(e["ph"] == "X" for e in events)
+    client_roots = {
+        e["args"]["span_id"]: e["args"]["trace_id"]
+        for e in events if e["name"] == "client-send"
+    }
+    handlers = [e for e in events if e["name"] == "request-handler"]
+    assert client_roots and handlers
+    joined = [
+        e for e in handlers
+        if client_roots.get(e["args"]["parent_span_id"])
+        == e["args"]["trace_id"]
+    ]
+    assert joined, "no server span nested under a client root span"
+    report = _load_trace_report()
+    spans = _otel.load_trace_file(out)
+    names = {r["span"] for r in report.breakdown(spans)}
+    assert {"client-send", "transport", "request-handler"} <= names
+    # Second window lands in a suffixed sibling file.
+    analyzer.measure(1)
+    assert os.path.exists(str(tmp_path / "merged.1.json"))
 
 
 # --------------------------------------------------------------------------- #
@@ -258,6 +558,29 @@ def test_queue_depth_gauge_returns_to_zero_when_idle(server):
     client.close()
 
 
+def test_batcher_queue_depth_gauge(server):
+    """nv_inference_queue_depth reports the dynamic batcher's current
+    queue length per loaded model (0 when idle / for unbatched models),
+    and honors the readiness filter like the other families."""
+    client = httpclient.InferenceServerClient(server.http_address)
+    client.infer("simple", _http_inputs())
+    text = _scrape(server)
+    assert "# TYPE nv_inference_queue_depth gauge" in text
+    depths = re.findall(r"nv_inference_queue_depth\{[^}]*\} (\d+)", text)
+    assert depths, "queue-depth gauge missing"
+    assert all(int(d) == 0 for d in depths), depths  # idle server
+    assert re.search(
+        r'nv_inference_queue_depth\{model="simple",version="1"\} \d+', text
+    )
+    client.unload_model("simple")
+    text = _scrape(server)
+    assert not re.search(
+        r'nv_inference_queue_depth\{model="simple",', text
+    )
+    client.load_model("simple")
+    client.close()
+
+
 def test_metrics_exclude_unloaded_models(server):
     """prometheus_metrics() honors readiness the way model_statistics()
     does: unloading a model removes its rows from the scrape."""
@@ -320,6 +643,13 @@ def test_exposition_checker_catches_violations():
         'h_bucket{le="1"} 5\nh_bucket{le="+Inf"} 5\nh_sum 9\nh_count 7\n'
     )
     assert any("+Inf bucket" in e for e in checker.check_exposition(bad))
+    # Negative _sum (durations cannot be negative).
+    bad = (
+        "# HELP h help\n# TYPE h histogram\n"
+        'h_bucket{le="1"} 5\nh_bucket{le="+Inf"} 5\nh_sum -3\nh_count 5\n'
+    )
+    assert any("_sum" in e and "< 0" in e
+               for e in checker.check_exposition(bad))
     # Valid document passes.
     good = (
         "# HELP h help\n# TYPE h histogram\n"
@@ -410,7 +740,7 @@ def test_request_id_header_lands_in_trace(server, tmp_path):
         "simple", _http_inputs(),
         headers={"triton-request-id": "from-header"},
     )
-    records = json.load(open(trace_file))
+    records = _read_trace(trace_file)
     assert records[-1]["request_id"] == "from-header"
     client.close()
 

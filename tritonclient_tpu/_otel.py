@@ -1,0 +1,548 @@
+"""W3C Trace Context + span tree + pluggable trace exporters.
+
+PR 1's tracing recorded six flat timestamps per sampled request; the client
+and server were separate worlds joined only by an opaque request id. This
+module makes the trace plane *distributed*:
+
+* a minimal W3C Trace Context implementation — ``traceparent`` header
+  generate/parse/inject/extract (https://www.w3.org/TR/trace-context/) —
+  so a client-initiated trace id survives HTTP headers and gRPC metadata
+  into server records;
+* a parent/child ``Span`` model (client-send, transport, request-handler,
+  batch-queue-wait, compute, response-marshal) that replaces the flat
+  timestamp record as the internal trace representation, built from the
+  same monotonic-ns event stream the front-ends/batcher/core already stamp;
+* pluggable exporters selected by the ``trace_mode`` trace setting:
+  ``triton`` (the Triton-shaped JSON array PR 1 emitted, kept for
+  compatibility), ``otlp`` (OTLP/JSON spans a collector file-receiver or
+  any OpenTelemetry tooling can ingest; ``opentelemetry`` is accepted as
+  an alias), and ``perfetto`` (Chrome trace-event JSON that loads directly
+  in Perfetto / chrome://tracing).
+
+All span boundaries are ``time.monotonic_ns()`` values — the clock shared
+with the statistics plane — and are shifted onto the unix epoch only at
+export time via a per-process offset, so spans recorded by a co-located
+client and server land on one consistent timeline.
+"""
+
+import json
+import os
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+# Span names, client side first, then the server-side tree under
+# request-handler. One fixed vocabulary so exporters, the report CLI, and
+# tests agree on spelling.
+SPAN_CLIENT_SEND = "client-send"
+SPAN_TRANSPORT = "transport"
+SPAN_REQUEST_HANDLER = "request-handler"
+SPAN_QUEUE_WAIT = "batch-queue-wait"
+SPAN_COMPUTE = "compute"
+SPAN_RESPONSE_MARSHAL = "response-marshal"
+
+# Canonical order of the Triton-shaped timestamp names (PR 1 contract; the
+# triton exporter and the report CLI's triton loader both rely on it).
+TIMESTAMP_ORDER = (
+    "REQUEST_RECV",
+    "QUEUE_START",
+    "COMPUTE_INPUT",
+    "COMPUTE_INFER",
+    "COMPUTE_OUTPUT",
+    "RESPONSE_SEND",
+)
+
+TRACE_MODES = ("triton", "otlp", "perfetto")
+
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+)
+
+
+def new_trace_id() -> str:
+    """A random 128-bit trace id as 32 lowercase hex chars (never all-zero,
+    which the W3C spec reserves as invalid)."""
+    while True:
+        tid = os.urandom(16).hex()
+        if tid != "0" * 32:
+            return tid
+
+
+def new_span_id() -> str:
+    """A random 64-bit span id as 16 lowercase hex chars (never all-zero)."""
+    while True:
+        sid = os.urandom(8).hex()
+        if sid != "0" * 16:
+            return sid
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[Tuple[str, str, int]]:
+    """Parse a ``traceparent`` header into (trace_id, parent_span_id, flags).
+
+    Returns None for anything malformed — per the W3C spec a receiver that
+    cannot parse the header MUST restart the trace rather than fail the
+    request, so callers treat None as "no inbound context".
+    """
+    if not header or not isinstance(header, str):
+        return None
+    m = _TRACEPARENT_RE.match(header.strip())
+    if m is None:
+        return None
+    version, trace_id, span_id, flags = m.groups()
+    if version == "ff":  # forbidden version value
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return trace_id, span_id, int(flags, 16)
+
+
+def format_traceparent(trace_id: str, span_id: str, sampled: bool = True) -> str:
+    """Render version-00 ``traceparent`` for injection into a header or
+    gRPC metadata."""
+    return f"00-{trace_id}-{span_id}-{'01' if sampled else '00'}"
+
+
+def epoch_offset_ns() -> int:
+    """ns to add to a ``time.monotonic_ns()`` stamp to place it on the unix
+    epoch. Captured per process; co-located processes agree to wall-clock
+    precision, which is what a merged client+server timeline needs."""
+    return time.time_ns() - time.monotonic_ns()
+
+
+@dataclass
+class Span:
+    """One node of a trace: a named interval with W3C identity.
+
+    ``start_ns``/``end_ns`` are monotonic-ns; exporters shift them to unix
+    time. ``parent_span_id`` empty means root (no inbound traceparent).
+    """
+
+    name: str
+    trace_id: str
+    span_id: str
+    parent_span_id: str
+    start_ns: int
+    end_ns: int
+    attributes: Dict[str, object] = field(default_factory=dict)
+
+    @property
+    def duration_ns(self) -> int:
+        return max(self.end_ns - self.start_ns, 0)
+
+
+@dataclass
+class TraceRecord:
+    """One finished trace: identity + span tree + the raw timestamp events.
+
+    This is the collector's internal representation (the flat six-timestamp
+    dict of PR 1 survives only as the ``timestamps`` field, kept so the
+    ``triton`` exporter can emit the exact compatibility shape).
+    """
+
+    seq_id: int
+    model_name: str
+    model_version: str
+    request_id: str
+    trace_id: str
+    parent_span_id: str
+    spans: List[Span] = field(default_factory=list)
+    timestamps: Dict[str, int] = field(default_factory=dict)
+    # Request-level span attributes (e.g. the dynamic batcher's batch id);
+    # build_span_tree puts them on the queue-wait/compute spans, and the
+    # triton exporter carries them so its loader can rebuild the same tree.
+    attributes: Dict[str, object] = field(default_factory=dict)
+    tensors: Optional[List[dict]] = None
+
+
+def build_span_tree(
+    trace_id: str,
+    parent_span_id: str,
+    timestamps: Dict[str, int],
+    attributes: Optional[Dict[str, object]] = None,
+) -> List[Span]:
+    """Assemble the server-side span tree from the recorded event stream.
+
+    request-handler covers the whole request (REQUEST_RECV..RESPONSE_SEND,
+    falling back to the observed extremes for partial/error traces); its
+    children are batch-queue-wait (QUEUE_START..COMPUTE_INPUT), compute
+    (COMPUTE_INPUT..COMPUTE_OUTPUT, with the COMPUTE_INFER boundary kept as
+    an attribute), and response-marshal (COMPUTE_OUTPUT..RESPONSE_SEND).
+    ``attributes`` (e.g. the dynamic batcher's batch id) land on the
+    queue-wait and compute spans — the two intervals batching shapes.
+    """
+    ts = timestamps
+    values = list(ts.values())
+    if not values:
+        return []
+    recv = ts.get("REQUEST_RECV", min(values))
+    send = ts.get("RESPONSE_SEND", max(values))
+    handler = Span(
+        SPAN_REQUEST_HANDLER, trace_id, new_span_id(), parent_span_id,
+        recv, send,
+    )
+    spans = [handler]
+    attributes = dict(attributes or {})
+    if "QUEUE_START" in ts and "COMPUTE_INPUT" in ts:
+        spans.append(
+            Span(SPAN_QUEUE_WAIT, trace_id, new_span_id(), handler.span_id,
+                 ts["QUEUE_START"], ts["COMPUTE_INPUT"], dict(attributes))
+        )
+    if "COMPUTE_INPUT" in ts and "COMPUTE_OUTPUT" in ts:
+        attrs = dict(attributes)
+        if "COMPUTE_INFER" in ts:
+            # The input-resolve/model-dispatch boundary inside the compute
+            # span; kept as an attribute rather than a sub-span so the tree
+            # stays the documented three children.
+            attrs["compute.infer_start_ns"] = ts["COMPUTE_INFER"]
+        spans.append(
+            Span(SPAN_COMPUTE, trace_id, new_span_id(), handler.span_id,
+                 ts["COMPUTE_INPUT"], ts["COMPUTE_OUTPUT"], attrs)
+        )
+    if "COMPUTE_OUTPUT" in ts and "RESPONSE_SEND" in ts:
+        spans.append(
+            Span(SPAN_RESPONSE_MARSHAL, trace_id, new_span_id(),
+                 handler.span_id, ts["COMPUTE_OUTPUT"], ts["RESPONSE_SEND"])
+        )
+    return spans
+
+
+# --------------------------------------------------------------------------- #
+# exporters                                                                   #
+# --------------------------------------------------------------------------- #
+
+
+def normalize_trace_mode(mode: str) -> str:
+    """Collapse aliases / unknown values onto the supported exporter set."""
+    mode = (mode or "").strip().lower()
+    if mode == "opentelemetry":
+        return "otlp"
+    return mode if mode in TRACE_MODES else "triton"
+
+
+def triton_record(record: TraceRecord) -> dict:
+    """The PR-1-compatible Triton-shaped record, plus the W3C identity as
+    extra keys (``trace_id``/``parent_span_id``) so files remain joinable
+    with client-side spans without breaking existing readers."""
+    out = {
+        "id": record.seq_id,
+        "model_name": record.model_name,
+        "model_version": record.model_version or "1",
+        "request_id": record.request_id,
+        "trace_id": record.trace_id,
+        "parent_span_id": record.parent_span_id,
+        "timestamps": [
+            {"name": name, "ns": record.timestamps[name]}
+            for name in TIMESTAMP_ORDER
+            if name in record.timestamps
+        ]
+        + [
+            {"name": name, "ns": ns}
+            for name, ns in record.timestamps.items()
+            if name not in TIMESTAMP_ORDER
+        ],
+    }
+    if record.attributes:
+        out["attributes"] = dict(record.attributes)
+    if record.tensors is not None:
+        out["tensors"] = record.tensors
+    return out
+
+
+def render_triton(records: List[TraceRecord], epoch_ns: int = 0) -> str:
+    return json.dumps([triton_record(r) for r in records])
+
+
+def _otlp_attr_value(value) -> dict:
+    if isinstance(value, bool):
+        return {"boolValue": value}
+    if isinstance(value, int):
+        return {"intValue": str(value)}
+    if isinstance(value, float):
+        return {"doubleValue": value}
+    return {"stringValue": str(value)}
+
+
+def spans_to_otlp(spans: List[Span], epoch_ns: int,
+                  extra_attrs: Optional[Dict[str, object]] = None) -> List[dict]:
+    out = []
+    for span in spans:
+        attrs = dict(extra_attrs or {})
+        attrs.update(span.attributes)
+        out.append({
+            "traceId": span.trace_id,
+            "spanId": span.span_id,
+            "parentSpanId": span.parent_span_id,
+            "name": span.name,
+            "kind": 2,  # SPAN_KIND_SERVER
+            "startTimeUnixNano": str(span.start_ns + epoch_ns),
+            "endTimeUnixNano": str(span.end_ns + epoch_ns),
+            "attributes": [
+                {"key": k, "value": _otlp_attr_value(v)}
+                for k, v in attrs.items()
+            ],
+        })
+    return out
+
+
+def render_otlp(records: List[TraceRecord], epoch_ns: int) -> str:
+    """OTLP/JSON (the ExportTraceServiceRequest JSON encoding): one
+    resourceSpans entry, one scope, all spans flattened under it."""
+    spans = []
+    for record in records:
+        spans.extend(spans_to_otlp(record.spans, epoch_ns, {
+            "model.name": record.model_name,
+            "model.version": record.model_version or "1",
+            "request.id": record.request_id,
+        }))
+    doc = {
+        "resourceSpans": [{
+            "resource": {
+                "attributes": [{
+                    "key": "service.name",
+                    "value": {"stringValue": "triton-tpu"},
+                }],
+            },
+            "scopeSpans": [{
+                "scope": {"name": "tritonclient_tpu"},
+                "spans": spans,
+            }],
+        }],
+    }
+    return json.dumps(doc)
+
+
+def spans_to_perfetto(spans: List[Span], epoch_ns: int, pid: int,
+                      tid: int, cat: str,
+                      extra_args: Optional[Dict[str, object]] = None) -> List[dict]:
+    """Chrome trace-event complete events ('X'): ts/dur in microseconds."""
+    events = []
+    for span in spans:
+        args = {
+            "trace_id": span.trace_id,
+            "span_id": span.span_id,
+            "parent_span_id": span.parent_span_id,
+        }
+        args.update(extra_args or {})
+        args.update({k: str(v) for k, v in span.attributes.items()})
+        events.append({
+            "name": span.name,
+            "cat": cat,
+            "ph": "X",
+            "ts": (span.start_ns + epoch_ns) / 1000.0,
+            "dur": span.duration_ns / 1000.0,
+            "pid": pid,
+            "tid": tid,
+            "args": args,
+        })
+    return events
+
+
+def render_perfetto(records: List[TraceRecord], epoch_ns: int) -> str:
+    pid = os.getpid()
+    events = []
+    for record in records:
+        events.extend(spans_to_perfetto(
+            record.spans, epoch_ns, pid,
+            # One track per trace keeps a request's span tree visually
+            # stacked in the Perfetto UI.
+            tid=record.seq_id, cat="server",
+            extra_args={
+                "model": record.model_name,
+                "request_id": record.request_id,
+            },
+        ))
+    return json.dumps({"displayTimeUnit": "ns", "traceEvents": events})
+
+
+def render_merged_perfetto(client_spans: List[Span],
+                           server_spans: List[dict],
+                           epoch_ns: int) -> str:
+    """One Perfetto file for a client+server window (perf_analyzer
+    ``--trace-out``).
+
+    ``client_spans`` are live Span objects from a ClientSpanCollector;
+    ``server_spans`` are ``load_spans``-shaped dicts read back from the
+    server's trace file. Spans sharing a trace id land on one track (tid)
+    so a request's client-send / transport / request-handler / queue /
+    compute stack reads top-to-bottom in the Perfetto UI; category
+    separates the two processes' contributions.
+    """
+    pid = os.getpid()
+    tids: Dict[str, int] = {}
+
+    def tid_of(trace_id: str) -> int:
+        return tids.setdefault(trace_id, len(tids) + 1)
+
+    events = []
+    for span in client_spans:
+        events.extend(spans_to_perfetto(
+            [span], epoch_ns, pid, tid_of(span.trace_id), cat="client",
+        ))
+    for s in server_spans:
+        args = {
+            "trace_id": s.get("trace_id", ""),
+            "span_id": s.get("span_id", ""),
+            "parent_span_id": s.get("parent_span_id", ""),
+        }
+        args.update({
+            k: str(v) for k, v in (s.get("attributes") or {}).items()
+        })
+        events.append({
+            "name": s.get("name", ""),
+            "cat": "server",
+            "ph": "X",
+            "ts": (int(s.get("start_ns", 0)) + epoch_ns) / 1000.0,
+            "dur": max(int(s.get("duration_ns", 0)), 0) / 1000.0,
+            "pid": pid,
+            "tid": tid_of(s.get("trace_id", "")),
+            "args": args,
+        })
+    return json.dumps({"displayTimeUnit": "ns", "traceEvents": events})
+
+
+_RENDERERS = {
+    "triton": render_triton,
+    "otlp": render_otlp,
+    "perfetto": render_perfetto,
+}
+
+
+def render_trace_file(mode: str, records: List[TraceRecord],
+                      epoch_ns: int) -> str:
+    return _RENDERERS[normalize_trace_mode(mode)](records, epoch_ns)
+
+
+# --------------------------------------------------------------------------- #
+# loaders (trace_report.py + tests round-trip through these)                  #
+# --------------------------------------------------------------------------- #
+
+
+def detect_trace_format(doc) -> str:
+    if isinstance(doc, list):
+        return "triton"
+    if isinstance(doc, dict) and "resourceSpans" in doc:
+        return "otlp"
+    if isinstance(doc, dict) and "traceEvents" in doc:
+        return "perfetto"
+    raise ValueError("unrecognized trace file format")
+
+
+def load_spans(doc) -> List[dict]:
+    """Normalize any exporter's output to flat span dicts:
+    {name, trace_id, span_id, parent_span_id, start_ns, end_ns,
+    duration_ns, attributes}. Triton-shaped records are re-derived through
+    build_span_tree so all three formats report identical breakdowns."""
+    fmt = detect_trace_format(doc)
+    spans: List[dict] = []
+    if fmt == "triton":
+        for record in doc:
+            ts = {t["name"]: int(t["ns"]) for t in record.get("timestamps", [])}
+            trace_id = record.get("trace_id") or new_trace_id()
+            for span in build_span_tree(
+                trace_id, record.get("parent_span_id", ""), ts,
+                record.get("attributes"),
+            ):
+                attrs = {
+                    "model": record.get("model_name", ""),
+                    "request_id": record.get("request_id", ""),
+                }
+                attrs.update(span.attributes)
+                spans.append({
+                    "name": span.name,
+                    "trace_id": span.trace_id,
+                    "span_id": span.span_id,
+                    "parent_span_id": span.parent_span_id,
+                    "start_ns": span.start_ns,
+                    "end_ns": span.end_ns,
+                    "duration_ns": span.duration_ns,
+                    "attributes": attrs,
+                })
+    elif fmt == "otlp":
+        for rs in doc.get("resourceSpans", []):
+            for ss in rs.get("scopeSpans", []):
+                for s in ss.get("spans", []):
+                    start = int(s.get("startTimeUnixNano", 0))
+                    end = int(s.get("endTimeUnixNano", 0))
+                    spans.append({
+                        "name": s.get("name", ""),
+                        "trace_id": s.get("traceId", ""),
+                        "span_id": s.get("spanId", ""),
+                        "parent_span_id": s.get("parentSpanId", ""),
+                        "start_ns": start,
+                        "end_ns": end,
+                        "duration_ns": max(end - start, 0),
+                        "attributes": {
+                            a["key"]: next(iter(a["value"].values()))
+                            for a in s.get("attributes", [])
+                        },
+                    })
+    else:  # perfetto
+        for e in doc.get("traceEvents", []):
+            if e.get("ph") != "X":
+                continue
+            start = int(float(e.get("ts", 0)) * 1000)
+            dur = int(float(e.get("dur", 0)) * 1000)
+            args = dict(e.get("args", {}))
+            spans.append({
+                "name": e.get("name", ""),
+                "trace_id": args.get("trace_id", ""),
+                "span_id": args.get("span_id", ""),
+                "parent_span_id": args.get("parent_span_id", ""),
+                "start_ns": start,
+                "end_ns": start + dur,
+                "duration_ns": dur,
+                "attributes": args,
+            })
+    return spans
+
+
+def load_trace_file(path: str) -> List[dict]:
+    with open(path) as f:
+        return load_spans(json.load(f))
+
+
+# --------------------------------------------------------------------------- #
+# client-side spans (perf_analyzer --trace-out)                               #
+# --------------------------------------------------------------------------- #
+
+
+class ClientSpanCollector:
+    """Thread-safe sink for client-side request spans.
+
+    ``begin()`` mints a new trace with a ``client-send`` root span and
+    returns the ``traceparent`` to inject plus an opaque handle;
+    ``finish(handle, timers)`` closes the root span from a RequestTimers
+    and adds the ``transport`` child (send_end..recv_start — wire plus
+    server time as seen from the client). The server's request-handler
+    span, extracted from the propagated traceparent, nests inside it.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._spans: List[Span] = []
+
+    def begin(self) -> Tuple[str, Tuple[str, str]]:
+        trace_id, span_id = new_trace_id(), new_span_id()
+        return format_traceparent(trace_id, span_id), (trace_id, span_id)
+
+    def finish(self, handle: Tuple[str, str], timers) -> None:
+        trace_id, span_id = handle
+        root = Span(
+            SPAN_CLIENT_SEND, trace_id, span_id, "",
+            timers.request_start, timers.request_end,
+        )
+        spans = [root]
+        if timers.send_end and timers.recv_start:
+            spans.append(Span(
+                SPAN_TRANSPORT, trace_id, new_span_id(), span_id,
+                timers.send_end, timers.recv_start,
+            ))
+        with self._lock:
+            self._spans.extend(spans)
+
+    def drain(self) -> List[Span]:
+        with self._lock:
+            spans, self._spans = self._spans, []
+        return spans

@@ -9,68 +9,101 @@ Triton-shaped span timestamps for each sampled request
     REQUEST_RECV -> QUEUE_START -> COMPUTE_INPUT -> COMPUTE_INFER
         -> COMPUTE_OUTPUT -> RESPONSE_SEND
 
-and flushes Triton-compatible JSON trace records to ``trace_file`` every
-``log_frequency`` records. ``configure_logging`` turns the stored
-``v2/logging`` settings into an actual structured logger instead of dead
-state.
+and flushes trace records to ``trace_file`` every ``log_frequency`` records.
+
+Since the distributed-tracing pass the internal representation of a
+finished trace is no longer the flat six-timestamp dict but an
+``_otel.TraceRecord``: W3C trace identity (``traceparent`` extracted at
+the protocol front-end, or a freshly minted trace id) plus a parent/child
+span tree (request-handler > batch-queue-wait / compute /
+response-marshal) derived from the same timestamp stream. The on-disk
+format is selected by the ``trace_mode`` setting:
+
+* ``triton`` (default) — the PR-1-compatible Triton-shaped JSON array,
+  now carrying ``trace_id``/``parent_span_id`` alongside the timestamps;
+* ``otlp`` (alias ``opentelemetry``) — OTLP/JSON spans;
+* ``perfetto`` — Chrome trace-event JSON that loads in Perfetto.
+
+Trace-file writes are atomic (``<file>.tmp`` + ``os.replace``) so readers
+never observe a torn file, and the collector buffers at most
+``max_buffered`` finished records per trace file (default
+``DEFAULT_MAX_BUFFERED``) so a hot server cannot grow the buffer
+unboundedly between flushes.
+
+``configure_logging`` turns the stored ``v2/logging`` settings into an
+actual structured logger instead of dead state.
 
 All timestamps are ``time.monotonic_ns()`` — the same clock the statistics
 plane uses, so trace spans and ``get_inference_statistics`` durations are
-directly comparable.
+directly comparable; exporters shift them onto the unix epoch.
 """
 
-import json
 import logging
+import os
 import threading
 import time
 from typing import Dict, List, Optional
 
-# Canonical span-timestamp order for one traced request. The protocol
-# front-end records the first and last; the core records the middle four.
-SPAN_ORDER = (
-    "REQUEST_RECV",
-    "QUEUE_START",
-    "COMPUTE_INPUT",
-    "COMPUTE_INFER",
-    "COMPUTE_OUTPUT",
-    "RESPONSE_SEND",
+from tritonclient_tpu import _otel
+from tritonclient_tpu._otel import (
+    TraceRecord,
+    build_span_tree,
+    new_trace_id,
+    parse_traceparent,
 )
 
-# Keep at most this many finished records per trace file in memory (the
-# file is rewritten as a full JSON array on flush, so the cap bounds both
-# memory and rewrite cost for long-running servers).
-_MAX_RECORDS_PER_FILE = 100_000
+# Canonical span-timestamp order for one traced request. The protocol
+# front-end records the first and last; the core records the middle four.
+SPAN_ORDER = _otel.TIMESTAMP_ORDER
+
+# Default per-trace-file cap on buffered finished records (the file is
+# rewritten as a full document on flush, so the cap bounds both memory and
+# rewrite cost for long-running servers). Override per collector with
+# ``TraceCollector(max_buffered=N)``; oldest records are dropped first.
+DEFAULT_MAX_BUFFERED = 100_000
 
 
 class TraceContext:
-    """One sampled request's trace: a dict of span-name -> monotonic ns.
+    """One sampled request's trace: W3C identity + span-name -> monotonic ns.
+
+    ``trace_id``/``parent_span_id`` come from the inbound ``traceparent``
+    when the client sent one (malformed headers restart the trace per the
+    W3C spec), otherwise a fresh 128-bit id with no parent.
 
     ``record`` is first-write-wins so the batched and unbatched execution
     paths can both name the same span without clobbering (e.g. QUEUE_START
     is stamped by the dynamic batcher at enqueue when the request rides it,
-    and by the direct path otherwise).
+    and by the direct path otherwise). ``set_attribute`` adds span
+    attributes (e.g. the dynamic batcher's batch id) that land on the
+    queue-wait and compute spans of the exported tree.
     """
 
     __slots__ = (
+        "seq_id",
         "trace_id",
+        "parent_span_id",
         "model_name",
         "model_version",
         "request_id",
         "timestamps",
+        "attributes",
         "level",
         "tensors",
         "_collector",
     )
 
-    def __init__(self, collector, trace_id, model_name, model_version,
-                 request_id, level):
+    def __init__(self, collector, seq_id, model_name, model_version,
+                 request_id, level, trace_id, parent_span_id):
         self._collector = collector
+        self.seq_id = seq_id
         self.trace_id = trace_id
+        self.parent_span_id = parent_span_id
         self.model_name = model_name
         self.model_version = model_version
         self.request_id = request_id
         self.level = tuple(level)
         self.timestamps: Dict[str, int] = {}
+        self.attributes: Dict[str, object] = {}
         self.tensors: Optional[List[dict]] = None
 
     def record(self, name: str, ns: Optional[int] = None):
@@ -78,6 +111,9 @@ class TraceContext:
             self.timestamps[name] = (
                 time.monotonic_ns() if ns is None else int(ns)
             )
+
+    def set_attribute(self, key: str, value):
+        self.attributes[key] = value
 
     @property
     def wants_tensors(self) -> bool:
@@ -98,8 +134,8 @@ class TraceContext:
 
 
 class TraceCollector:
-    """Samples requests per the stored trace settings and flushes
-    Triton-shaped JSON records.
+    """Samples requests per the stored trace settings and flushes trace
+    records through the ``trace_mode``-selected exporter.
 
     One collector per ``InferenceCore``; both protocol front-ends and the
     execution paths share it. Settings are passed per ``sample`` call (the
@@ -108,18 +144,25 @@ class TraceCollector:
     the per-model remaining budget for ``trace_count``.
     """
 
-    def __init__(self):
+    def __init__(self, max_buffered: int = DEFAULT_MAX_BUFFERED):
         self._lock = threading.Lock()
         self._next_id = 0
+        self.max_buffered = max(int(max_buffered), 1)
         self._rate_counters: Dict[str, int] = {}
         self._remaining: Dict[str, int] = {}
         self._count_origin: Dict[str, str] = {}
-        # trace_file -> list of finished record dicts (rewritten on flush).
-        self._records: Dict[str, List[dict]] = {}
+        # trace_file -> list of finished TraceRecords (rewritten on flush).
+        self._records: Dict[str, List[TraceRecord]] = {}
         self._unflushed: Dict[str, int] = {}
-        # trace_id -> (trace_file, log_frequency) captured at sample time:
+        # trace_file -> exporter mode in force for that file (the last
+        # sampled request's trace_mode wins; files are single-format).
+        self._modes: Dict[str, str] = {}
+        # seq_id -> (trace_file, log_frequency) captured at sample time:
         # the settings in force when a trace STARTS govern where it lands.
         self._policies: Dict[int, tuple] = {}
+        # monotonic->epoch shift applied at export time, captured once so
+        # every flush of one process lands on one consistent timeline.
+        self._epoch_ns = _otel.epoch_offset_ns()
 
     # -- sampling -------------------------------------------------------------
 
@@ -130,13 +173,16 @@ class TraceCollector:
         request_id: str = "",
         model_version: str = "",
         recv_ns: Optional[int] = None,
+        traceparent: Optional[str] = None,
     ) -> Optional[TraceContext]:
         """Decide whether this request is traced; return its context or None.
 
         Triton semantics: ``trace_rate`` N samples one request in every N;
         ``trace_count`` is a remaining budget decremented per sampled trace
         (-1 = unlimited, 0 = exhausted) that resets whenever the setting is
-        rewritten.
+        rewritten. A parseable inbound ``traceparent`` continues the
+        caller's trace (same trace id, caller's span as parent); anything
+        malformed restarts the trace per the W3C spec instead of failing.
         """
         level = settings.get("trace_level") or ["OFF"]
         if "OFF" in level or not (
@@ -167,17 +213,27 @@ class TraceCollector:
             if remaining > 0:
                 self._remaining[model_name] = remaining - 1
             self._next_id += 1
-            trace_id = self._next_id
+            seq_id = self._next_id
+        inbound = parse_traceparent(traceparent)
+        if inbound is not None:
+            trace_id, parent_span_id, _flags = inbound
+        else:
+            trace_id, parent_span_id = new_trace_id(), ""
         ctx = TraceContext(
-            self, trace_id, model_name, model_version, request_id, level
+            self, seq_id, model_name, model_version, request_id, level,
+            trace_id, parent_span_id,
         )
         ctx_file = (settings.get("trace_file") or [""])[0]
+        mode = _otel.normalize_trace_mode(
+            (settings.get("trace_mode") or ["triton"])[0]
+        )
         try:
             freq = int((settings.get("log_frequency") or ["0"])[0])
         except (ValueError, TypeError):
             freq = 0
         with self._lock:
-            self._policies[ctx.trace_id] = (ctx_file, freq)
+            self._policies[ctx.seq_id] = (ctx_file, freq)
+            self._modes[ctx_file] = mode
         if recv_ns is not None:
             ctx.record("REQUEST_RECV", recv_ns)
         return ctx
@@ -185,33 +241,30 @@ class TraceCollector:
     # -- record assembly / flushing -------------------------------------------
 
     def submit(self, ctx: TraceContext):
-        record = {
-            "id": ctx.trace_id,
-            "model_name": ctx.model_name,
-            "model_version": ctx.model_version or "1",
-            "request_id": ctx.request_id,
-            "timestamps": [
-                {"name": name, "ns": ctx.timestamps[name]}
-                for name in SPAN_ORDER
-                if name in ctx.timestamps
-            ]
-            + [
-                {"name": name, "ns": ns}
-                for name, ns in ctx.timestamps.items()
-                if name not in SPAN_ORDER
-            ],
-        }
-        if ctx.tensors is not None:
-            record["tensors"] = ctx.tensors
-        flush_file = None
+        record = TraceRecord(
+            seq_id=ctx.seq_id,
+            model_name=ctx.model_name,
+            model_version=ctx.model_version,
+            request_id=ctx.request_id,
+            trace_id=ctx.trace_id,
+            parent_span_id=ctx.parent_span_id,
+            spans=build_span_tree(
+                ctx.trace_id, ctx.parent_span_id, ctx.timestamps,
+                ctx.attributes,
+            ),
+            timestamps=dict(ctx.timestamps),
+            attributes=dict(ctx.attributes),
+            tensors=ctx.tensors,
+        )
+        flush = None
         with self._lock:
             trace_file, freq = self._policies.pop(
-                ctx.trace_id, ("", 0)
+                ctx.seq_id, ("", 0)
             )
             records = self._records.setdefault(trace_file, [])
             records.append(record)
-            if len(records) > _MAX_RECORDS_PER_FILE:
-                del records[: len(records) - _MAX_RECORDS_PER_FILE]
+            if len(records) > self.max_buffered:
+                del records[: len(records) - self.max_buffered]
             pending = self._unflushed.get(trace_file, 0) + 1
             # log_frequency N flushes every N records; 0 (Triton: "write at
             # trace end") flushes per record here — the in-process server
@@ -219,15 +272,25 @@ class TraceCollector:
             # tests and perf tooling read.
             if trace_file and pending >= max(freq, 1):
                 self._unflushed[trace_file] = 0
-                flush_file = trace_file
-                snapshot = list(records)
+                flush = (
+                    trace_file,
+                    self._modes.get(trace_file, "triton"),
+                    list(records),
+                )
             else:
                 self._unflushed[trace_file] = pending
-        if flush_file:
-            self._write(flush_file, snapshot)
+        if flush:
+            self._write(*flush, epoch_ns=self._epoch_ns)
 
     def records(self, trace_file: str = "") -> List[dict]:
-        """Finished records for a trace file ('' = the in-memory sink)."""
+        """Finished records for a trace file ('' = the in-memory sink), in
+        the Triton-shaped dict form regardless of the file's exporter."""
+        with self._lock:
+            records = list(self._records.get(trace_file, []))
+        return [_otel.triton_record(r) for r in records]
+
+    def trace_records(self, trace_file: str = "") -> List[TraceRecord]:
+        """Finished TraceRecords (span tree + identity) for a trace file."""
         with self._lock:
             return list(self._records.get(trace_file, []))
 
@@ -235,20 +298,26 @@ class TraceCollector:
         """Force every file sink to disk (e.g. at server stop)."""
         with self._lock:
             todo = [
-                (f, list(r)) for f, r in self._records.items() if f
+                (f, self._modes.get(f, "triton"), list(r))
+                for f, r in self._records.items() if f
             ]
-            for f, _ in todo:
+            for f, _, _ in todo:
                 self._unflushed[f] = 0
-        for trace_file, snapshot in todo:
-            self._write(trace_file, snapshot)
+        for trace_file, mode, snapshot in todo:
+            self._write(trace_file, mode, snapshot, epoch_ns=self._epoch_ns)
 
     @staticmethod
-    def _write(trace_file: str, records: List[dict]):
-        # Full-array rewrite keeps the file valid Triton-style JSON at every
-        # flush (readers never see a half-appended record).
+    def _write(trace_file: str, mode: str, records: List[TraceRecord],
+               epoch_ns: int):
+        # Full-document rewrite through the mode's exporter, staged to a
+        # sibling tmp file and os.replace'd so readers never observe a
+        # torn or half-appended document.
         try:
-            with open(trace_file, "w") as f:
-                json.dump(records, f)
+            payload = _otel.render_trace_file(mode, records, epoch_ns)
+            tmp = trace_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(payload)
+            os.replace(tmp, trace_file)
         except OSError:
             logging.getLogger("tritonclient_tpu.server").warning(
                 "unable to write trace file %s", trace_file

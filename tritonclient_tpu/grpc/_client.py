@@ -473,6 +473,7 @@ class InferenceServerClient(InferenceServerClientBase):
         compression_algorithm=None,
         parameters=None,
         timers=None,
+        traceparent=None,
     ) -> InferResult:
         """Synchronous inference (reference: grpc/_client.py:1445-1572).
 
@@ -482,7 +483,10 @@ class InferenceServerClient(InferenceServerClientBase):
         the returned result as ``result.timers``. A non-empty
         ``request_id`` is also propagated as ``triton-request-id``
         metadata so server-side trace records can be joined to client
-        timing.
+        timing. ``traceparent``: optional W3C Trace Context value sent as
+        ``traceparent`` invocation metadata (an explicit
+        ``headers={"traceparent": ...}`` entry wins) so server span
+        records continue the caller's trace.
         """
         if timers is not None:
             timers.capture("request_start")
@@ -504,6 +508,12 @@ class InferenceServerClient(InferenceServerClientBase):
         if request_id:
             metadata = tuple(metadata or ()) + (
                 ("triton-request-id", request_id),
+            )
+        if traceparent and not any(
+            k == "traceparent" for k, _ in metadata or ()
+        ):
+            metadata = tuple(metadata or ()) + (
+                ("traceparent", traceparent),
             )
         if timers is not None:
             timers.capture("send_end")

@@ -344,11 +344,13 @@ class InferenceServerClient(InferenceServerClientBase):
         compression_algorithm=None,
         parameters=None,
         timers=None,
+        traceparent=None,
     ) -> InferResult:
         """``timers``: optional RequestTimers stamped around marshal /
         RPC / result wrap, attached to the result as ``result.timers``;
-        ``request_id`` also rides as triton-request-id metadata (same
-        contract as the sync client)."""
+        ``request_id`` also rides as triton-request-id metadata and
+        ``traceparent`` as W3C trace-context metadata (same contract as
+        the sync client)."""
         if timers is not None:
             timers.capture("request_start")
             timers.capture("send_start")
@@ -369,6 +371,12 @@ class InferenceServerClient(InferenceServerClientBase):
         if request_id:
             metadata = tuple(metadata or ()) + (
                 ("triton-request-id", request_id),
+            )
+        if traceparent and not any(
+            k == "traceparent" for k, _ in metadata or ()
+        ):
+            metadata = tuple(metadata or ()) + (
+                ("traceparent", traceparent),
             )
         if timers is not None:
             timers.capture("send_end")

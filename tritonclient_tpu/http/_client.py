@@ -579,6 +579,7 @@ class InferenceServerClient(InferenceServerClientBase):
         response_compression_algorithm=None,
         parameters=None,
         timers=None,
+        traceparent=None,
     ) -> InferResult:
         """Synchronous inference (reference: http/_client.py:1331-1484).
 
@@ -588,6 +589,10 @@ class InferenceServerClient(InferenceServerClientBase):
         to the returned result as ``result.timers``. A non-empty
         ``request_id`` is also propagated as the ``triton-request-id``
         header so server-side trace records can be joined to client timing.
+        ``traceparent``: optional W3C Trace Context header value injected
+        as the ``traceparent`` header (an explicit
+        ``headers={"traceparent": ...}`` wins) so server span records
+        continue the caller's trace.
         """
         if timers is not None:
             timers.capture("request_start")
@@ -602,6 +607,8 @@ class InferenceServerClient(InferenceServerClientBase):
         all_headers.update(extra_headers)
         if request_id:
             all_headers.setdefault("triton-request-id", request_id)
+        if traceparent:
+            all_headers.setdefault("traceparent", traceparent)
         if timers is not None:
             timers.capture("send_end")
         status, resp_headers, body = self._post(path, request_body, all_headers, query_params)

@@ -258,11 +258,13 @@ class InferenceServerClient(InferenceServerClientBase):
         response_compression_algorithm=None,
         parameters=None,
         timers=None,
+        traceparent=None,
     ) -> InferResult:
         """``timers``: optional RequestTimers stamped around marshal /
         POST / result wrap, attached to the result as ``result.timers``;
-        ``request_id`` also rides as the triton-request-id header (same
-        contract as the sync client)."""
+        ``request_id`` also rides as the triton-request-id header and
+        ``traceparent`` as the W3C trace-context header (same contract as
+        the sync client)."""
         if timers is not None:
             timers.capture("request_start")
             timers.capture("send_start")
@@ -292,6 +294,8 @@ class InferenceServerClient(InferenceServerClientBase):
             all_headers["Inference-Header-Content-Length"] = str(json_size)
         if request_id:
             all_headers.setdefault("triton-request-id", request_id)
+        if traceparent:
+            all_headers.setdefault("traceparent", traceparent)
         if timers is not None:
             timers.capture("send_end")
 

@@ -77,6 +77,15 @@ def main(argv=None):
         help="with --native-driver and -i grpc: the HTTP endpoint used for "
              "model metadata",
     )
+    parser.add_argument(
+        "--trace-out", default=None, metavar="PATH",
+        help="write one merged client+server Perfetto trace file per "
+             "sweep window (first window PATH, later windows PATH.N); "
+             "starts a client root span per request and, for non-streaming "
+             "requests, injects its W3C traceparent so server spans nest "
+             "under it. Needs a co-located server; inspect with "
+             "scripts/trace_report.py or ui.perfetto.dev",
+    )
     parser.add_argument("-f", "--filename", help="write per-level CSV here")
     parser.add_argument("--json", dest="json_out", action="store_true",
                         help="print JSON summaries instead of a table")
@@ -103,6 +112,9 @@ def main(argv=None):
 
     start, end, step = args.concurrency_range
     if args.native_driver:
+        if args.trace_out:
+            parser.error("--trace-out is not supported with "
+                         "--native-driver (client spans live in-process)")
         if args.shared_memory != "none":
             parser.error("--native-driver supports wire mode only "
                          "(--shared-memory=none)")
@@ -144,6 +156,7 @@ def main(argv=None):
             read_outputs=args.read_outputs,
             device_id=args.device_id,
             shm_mesh=shm_mesh,
+            trace_out=args.trace_out,
             verbose=args.verbose,
         )
         results = analyzer.sweep(start, end, step)

@@ -19,6 +19,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from tritonclient_tpu import _otel
 from tritonclient_tpu.perf_analyzer._stats import (
     SERVER_STAT_KEYS,
     InferStat,
@@ -443,6 +444,18 @@ class _Worker:
         else:
             self._run_sync(end_time)
 
+    def _span_begin(self):
+        """(traceparent, handle) for one request's client root span, or
+        (None, None) when --trace-out is off."""
+        spans = self.analyzer.client_spans
+        if spans is None:
+            return None, None
+        return spans.begin()
+
+    def _span_finish(self, handle, timers):
+        if handle is not None:
+            self.analyzer.client_spans.finish(handle, timers)
+
     def _run_sync(self, end_time: float):
         a = self.analyzer
         i = 0
@@ -452,12 +465,13 @@ class _Worker:
             i += 1
             timers = RequestTimers()
             timers.capture("request_start")
+            tp, span = self._span_begin()
             try:
                 timers.capture("send_start")
                 inputs = self._build_inputs(payloads)
                 timers.capture("send_end")
                 result = self._client.infer(
-                    a.model_name, inputs, outputs=outputs
+                    a.model_name, inputs, outputs=outputs, traceparent=tp
                 )
                 timers.capture("recv_start")
                 if a.read_outputs:
@@ -467,6 +481,7 @@ class _Worker:
                 self.errors += 1
                 continue
             timers.capture("request_end")
+            self._span_finish(span, timers)
             self.stat.update(timers)
             self.latencies.append(timers.total_ns)
             self.send_ns.append(timers.send_ns)
@@ -507,6 +522,10 @@ class _Worker:
             i += 1
             timers = RequestTimers()
             timers.capture("request_start")
+            # Client spans only (no traceparent injection): stream
+            # requests share the stream's call-level metadata, so
+            # server-side spans correlate per stream, not per request.
+            _tp, span = self._span_begin()
             try:
                 timers.capture("send_start")
                 if prepared is not None:
@@ -550,6 +569,7 @@ class _Worker:
                 self.errors += 1
                 continue
             timers.capture("request_end")
+            self._span_finish(span, timers)
             self.stat.update(timers)
             self.latencies.append(timers.total_ns)
             self.send_ns.append(timers.send_ns)
@@ -1008,6 +1028,7 @@ class PerfAnalyzer:
         shared_stream: bool = True,
         write_once: bool = False,
         collect_server_stats: bool = True,
+        trace_out: Optional[str] = None,
         verbose: bool = False,
     ):
         if protocol not in ("grpc", "http"):
@@ -1056,6 +1077,20 @@ class PerfAnalyzer:
         # latency (reference perf_analyzer composes its report the same
         # way). Two extra RPCs per window; disable for adversarial servers.
         self.collect_server_stats = collect_server_stats
+        # --trace-out: every request in the closed-loop paths starts a
+        # client root span (sync requests also inject its traceparent so
+        # server spans nest under it); each measurement window merges the
+        # client spans with the server's trace records into one Perfetto
+        # file. Requires a co-located server (the analyzer reads the
+        # server's trace file from the local filesystem).
+        if trace_out and async_window:
+            raise ValueError("--trace-out is not supported in async "
+                             "window mode")
+        self.trace_out = trace_out
+        self.client_spans = (
+            _otel.ClientSpanCollector() if trace_out else None
+        )
+        self._trace_windows = 0
         self.verbose = verbose
         self.run_id = int(time.time() * 1000) % 100000
 
@@ -1246,8 +1281,80 @@ class PerfAnalyzer:
     def measure(self, concurrency: int) -> MeasurementWindow:
         if self.async_window:
             return self._measure_window(concurrency)
-        with self.session(concurrency) as session:
-            return session.measure()
+        self._trace_window_begin()
+        try:
+            with self.session(concurrency) as session:
+                return session.measure()
+        finally:
+            self._trace_window_end()
+
+    # -- --trace-out window plumbing ------------------------------------------
+
+    @property
+    def _server_trace_file(self) -> str:
+        return self.trace_out + ".server.json"
+
+    def _trace_out_path(self) -> str:
+        """One Perfetto file per sweep window: the first window writes the
+        given path; later windows suffix ``.N`` before the extension."""
+        if self._trace_windows == 0:
+            return self.trace_out
+        base, ext = os.path.splitext(self.trace_out)
+        return f"{base}.{self._trace_windows}{ext or '.json'}"
+
+    def _trace_settings(self, settings: dict) -> bool:
+        try:
+            client = self.make_client()
+        except Exception:
+            return False
+        try:
+            client.update_trace_settings("", settings)
+            return True
+        except Exception:
+            return False
+        finally:
+            self.close_client(client)
+
+    def _trace_window_begin(self):
+        if self.trace_out is None:
+            return
+        # Server-side capture for the window: trace every request into a
+        # triton-format sidecar file this process reads back at window end.
+        self._trace_settings({
+            "trace_level": ["TIMESTAMPS"],
+            "trace_rate": ["1"],
+            "trace_count": ["-1"],
+            "trace_mode": ["triton"],
+            "trace_file": [self._server_trace_file],
+            "log_frequency": ["20"],
+        })
+
+    def _trace_window_end(self):
+        if self.trace_out is None:
+            return
+        self._trace_settings({"trace_level": ["OFF"]})
+        server_spans: List[dict] = []
+        try:
+            with open(self._server_trace_file) as f:
+                import json as _json
+
+                server_spans = _otel.load_spans(_json.load(f))
+        except (OSError, ValueError):
+            pass  # remote server / no traced request: client spans only
+        client_spans = self.client_spans.drain()
+        path = self._trace_out_path()
+        self._trace_windows += 1
+        payload = _otel.render_merged_perfetto(
+            client_spans, server_spans, _otel.epoch_offset_ns()
+        )
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+        if self.verbose:
+            print(f"trace window written: {path} "
+                  f"({len(client_spans)} client + {len(server_spans)} "
+                  "server spans)")
 
     def _measure_window(self, concurrency: int) -> MeasurementWindow:
         worker = _WindowWorker(self, concurrency)

@@ -594,6 +594,14 @@ class _DynamicBatcher:
         self._model = None
         self._stats = None
         self._cap = 0
+        # Monotone batch id, stamped onto traced members' queue-wait and
+        # compute spans so a trace viewer can group batchmates.
+        self._batch_seq = 0
+
+    def qsize(self) -> int:
+        """Current queue length (the nv_inference_queue_depth gauge)."""
+        with self._cv:
+            return len(self._queue)
 
     def eligible(self, request: CoreRequest, cap: int) -> bool:
         # Sequence/priority parameters, BYTES tensors, rank-0 or empty
@@ -786,6 +794,8 @@ class _DynamicBatcher:
                     self._cv.wait(timeout=0.005)
                     continue
                 self._dispatching += 1
+                self._batch_seq += 1
+                batch_id = self._batch_seq
                 model, stats = self._model, self._stats
                 if self._queue:
                     # The spread rule may leave backlog for siblings:
@@ -798,6 +808,15 @@ class _DynamicBatcher:
                 with self.core._lock:
                     for s in batch:
                         stats.queue_ns += t_exec - s.t_enqueue
+                for s in batch:
+                    if s.request.trace is not None:
+                        # Batch identity on the spans batching shapes: the
+                        # span-tree builder copies these onto the
+                        # queue-wait and compute child spans.
+                        s.request.trace.set_attribute("batch.id", batch_id)
+                        s.request.trace.set_attribute(
+                            "batch.size", len(batch)
+                        )
                 try:
                     results = self.core._infer_batch(
                         model, [s.request for s in batch], stats
@@ -1072,6 +1091,7 @@ class InferenceCore:
                 if name in self._repository and self._loaded.get(name, False)
             ]
             proto_counts = sorted(self._protocol_requests.items())
+            batchers = dict(self._batchers)
         def esc(v: str) -> str:
             # Prometheus exposition label escaping: backslash, quote, LF.
             return (str(v).replace("\\", "\\\\").replace('"', '\\"')
@@ -1123,6 +1143,23 @@ class InferenceCore:
             lines.append(
                 f'{metric}{{model="{esc(name)}",version="{esc(version)}"}} '
                 f"{stats.pending}"
+            )
+        # Batcher queue-depth gauge: requests sitting in the dynamic
+        # batcher's queue right now (models without a batcher report 0 —
+        # their requests never queue). Taken AFTER the row snapshot so the
+        # readiness filter matches the other families.
+        metric = "nv_inference_queue_depth"
+        lines.append(
+            f"# HELP {metric} Number of inference requests currently in "
+            "the dynamic batching queue per model"
+        )
+        lines.append(f"# TYPE {metric} gauge")
+        for name, version, stats in rows:
+            batcher = batchers.get(name)
+            depth = batcher.qsize() if batcher is not None else 0
+            lines.append(
+                f'{metric}{{model="{esc(name)}",version="{esc(version)}"}} '
+                f"{depth}"
             )
         # Shared-memory registration gauges (system + tpu planes).
         metric = "nv_shared_memory_region_count"
@@ -1209,12 +1246,15 @@ class InferenceCore:
         model_version: str = "",
         request_id: str = "",
         recv_ns: Optional[int] = None,
+        traceparent: Optional[str] = None,
     ):
         """Sample one request against the effective trace settings.
 
         Returns a TraceContext (attach it to the CoreRequest) or None.
         Called by the protocol front-ends at ingress, before parse cost is
-        known — hence the fast OFF path.
+        known — hence the fast OFF path. ``traceparent`` is the inbound
+        W3C header/metadata value (or None); a parseable value continues
+        the client's trace, anything else restarts it.
         """
         # Lock-free fast path (runs per request, before parse cost is
         # known): a GIL-atomic read of an always-present dict. The worst
@@ -1228,6 +1268,7 @@ class InferenceCore:
             request_id=request_id,
             model_version=model_version,
             recv_ns=recv_ns,
+            traceparent=traceparent,
         )
 
     def record_protocol_request(self, protocol: str):

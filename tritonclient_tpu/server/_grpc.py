@@ -61,9 +61,9 @@ def _stream_error(msg: str, request_id: str = "") -> pb.ModelStreamInferResponse
     return resp
 
 
-def _metadata_request_id(context) -> str:
-    """The triton-request-id invocation-metadata header, when the transport
-    exposes metadata (the aio shim context does not)."""
+def _metadata_value(context, key: str) -> str:
+    """One invocation-metadata value, when the transport exposes metadata
+    (the aio shim context does not)."""
     md = getattr(context, "invocation_metadata", None)
     if md is None:
         return ""
@@ -71,10 +71,14 @@ def _metadata_request_id(context) -> str:
         pairs = md()
     except Exception:
         return ""
-    for key, value in pairs or ():
-        if key == "triton-request-id":
+    for k, value in pairs or ():
+        if k == key:
             return value
     return ""
+
+
+def _metadata_request_id(context) -> str:
+    return _metadata_value(context, "triton-request-id")
 
 
 def _finish_trace(creq):
@@ -472,6 +476,7 @@ class _Servicer:
                 request.model_name, request.model_version,
                 request.id or _metadata_request_id(context),
                 recv_ns=t_recv,
+                traceparent=_metadata_value(context, "traceparent"),
             )
             resp = _finalize_unary(self.core.infer(creq))
             _finish_trace(creq)
@@ -480,8 +485,13 @@ class _Servicer:
             _finish_trace(creq)
             context.abort(_status_for(e), str(e))
 
-    def _process_stream_request(self, request, cached_reqs, cached_resps):
+    def _process_stream_request(self, request, cached_reqs, cached_resps,
+                                traceparent: str = ""):
         """One stream request → message list or lazy message generator.
+
+        ``traceparent`` is the STREAM's inbound W3C context (gRPC metadata
+        is per-call, not per-message): every traced request on the stream
+        becomes a child of the caller's span under one shared trace id.
 
         Per-stream hot-path caches. Load generators (and the reference's
         C++ client, grpc_client.cc:1419 submessage reuse) send the SAME
@@ -504,7 +514,7 @@ class _Servicer:
             # CoreRequest object, so a stale trace must never survive.
             creq.trace = self.core.start_trace(
                 request.model_name, request.model_version, request.id,
-                recv_ns=t_recv,
+                recv_ns=t_recv, traceparent=traceparent or None,
             )
             cresp = self.core.infer(creq)
             _finish_trace(creq)
@@ -618,6 +628,9 @@ class _Servicer:
 
         cached_reqs = {}
         cached_resps = {}
+        # Stream-level W3C context: read once (metadata is per-call); every
+        # traced request on this stream joins the caller's trace.
+        stream_tp = _metadata_value(context, "traceparent")
         pending = _queue.Queue(maxsize=64)  # backpressure bound
         stop = threading.Event()
 
@@ -648,7 +661,7 @@ class _Servicer:
                 # feeder-side parse).
                 future = self._stream_pool.submit(
                     self._process_stream_request,
-                    request, cached_reqs, cached_resps,
+                    request, cached_reqs, cached_resps, stream_tp,
                 )
                 return future, future.exception
             try:
@@ -663,7 +676,7 @@ class _Servicer:
                 )
             creq.trace = self.core.start_trace(
                 request.model_name, request.model_version, request.id,
-                recv_ns=t_recv,
+                recv_ns=t_recv, traceparent=stream_tp or None,
             )
             try:
                 fin = self.core.infer_submit(creq)
@@ -706,7 +719,7 @@ class _Servicer:
                             barrier()  # drain batcher + pool pipeline
                         inflight = []
                         item = self._process_stream_request(
-                            request, cached_reqs, cached_resps
+                            request, cached_reqs, cached_resps, stream_tp
                         )
                     else:
                         item, barrier = submit_one(request)
@@ -900,6 +913,7 @@ class _AioServicer:
                 request.model_name, request.model_version,
                 request.id or _metadata_request_id(context),
                 recv_ns=t_recv,
+                traceparent=_metadata_value(context, "traceparent"),
             )
             resp = _finalize_unary(await self._infer(creq))
             _finish_trace(creq)
@@ -916,6 +930,7 @@ class _AioServicer:
         # the cached-parse/cached-response fast path.
         cached_reqs: dict = {}
         cached_resps: dict = {}
+        stream_tp = _metadata_value(context, "traceparent")
         loop = asyncio.get_running_loop()
         async for request in request_iterator:
             self.core.record_protocol_request("grpc")
@@ -955,7 +970,7 @@ class _AioServicer:
                 def drain(req):
                     try:
                         msgs = self._sync._process_stream_request(
-                            req, cached_reqs, cached_resps
+                            req, cached_reqs, cached_resps, stream_tp
                         )
                         for msg in msgs:
                             if not _put(msg):
@@ -982,7 +997,7 @@ class _AioServicer:
             # un-materialized), so this is one thread hop fewer than the
             # sync feeder/pool/yielder pipeline.
             msgs = self._sync._process_stream_request(
-                request, cached_reqs, cached_resps
+                request, cached_reqs, cached_resps, stream_tp
             )
             for msg in msgs:
                 yield msg  # _guard_stream converts generator errors

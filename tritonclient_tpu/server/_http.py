@@ -327,6 +327,7 @@ class _Handler(BaseHTTPRequestHandler):
             model, version,
             request.id or self.headers.get("triton-request-id", ""),
             recv_ns=t_recv,
+            traceparent=self.headers.get("traceparent"),
         )
         request.trace = trace
 
