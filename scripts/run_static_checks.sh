@@ -1,11 +1,16 @@
 #!/usr/bin/env bash
 # Single static-analysis entry point shared by CI and tier-1.
 #
-#   scripts/run_static_checks.sh [paths...]
+#   scripts/run_static_checks.sh [--write-baseline] [paths...]
 #
 # Chains, in order:
-#   1. tpulint        — project-specific AST checks (TPU001..TPU005); see
-#                       `python scripts/tpulint.py --list-rules`
+#   1. tpulint        — project-specific checks (TPU001..TPU008); see
+#                       `python scripts/tpulint.py --list-rules`. Runs over
+#                       tritonclient_tpu/ + scripts/ + tests/ against the
+#                       committed baseline (scripts/tpulint_baseline.json):
+#                       pre-existing findings there stay recorded, only NEW
+#                       findings fail. `--write-baseline` regenerates it
+#                       after deliberate changes.
 #   2. ruff           — generic Python lint, config in pyproject.toml
 #                       (skipped with a notice when ruff is not installed)
 #   3. mypy           — type check, config in pyproject.toml
@@ -25,9 +30,28 @@ REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 cd "${REPO_ROOT}"
 
 PYTHON="${PYTHON:-python}"
+BASELINE_FILE="scripts/tpulint_baseline.json"
+
+WRITE_BASELINE=0
+if [ "${1:-}" = "--write-baseline" ]; then
+    WRITE_BASELINE=1
+    shift
+fi
+
 PATHS=("$@")
 if [ "${#PATHS[@]}" -eq 0 ]; then
-    PATHS=(tritonclient_tpu)
+    # tpulint covers the support code too; ruff/mypy stay scoped to the
+    # package (their pyproject configs are tuned for it).
+    TPULINT_PATHS=(tritonclient_tpu scripts tests)
+    TOOL_PATHS=(tritonclient_tpu)
+else
+    TPULINT_PATHS=("${PATHS[@]}")
+    TOOL_PATHS=("${PATHS[@]}")
+fi
+
+if [ "${WRITE_BASELINE}" -eq 1 ]; then
+    exec "${PYTHON}" scripts/tpulint.py --write-baseline "${BASELINE_FILE}" \
+        "${TPULINT_PATHS[@]}"
 fi
 
 failures=0
@@ -45,20 +69,25 @@ run_check() {
 }
 
 # 1. tpulint — always available (lives in this repo, stdlib-only).
-run_check "tpulint" "${PYTHON}" scripts/tpulint.py "${PATHS[@]}"
+TPULINT_ARGS=()
+if [ -f "${BASELINE_FILE}" ]; then
+    TPULINT_ARGS+=(--baseline "${BASELINE_FILE}")
+fi
+run_check "tpulint" "${PYTHON}" scripts/tpulint.py \
+    "${TPULINT_ARGS[@]+"${TPULINT_ARGS[@]}"}" "${TPULINT_PATHS[@]}"
 
 # 2. ruff — optional.
 if "${PYTHON}" -m ruff --version >/dev/null 2>&1; then
-    run_check "ruff" "${PYTHON}" -m ruff check "${PATHS[@]}"
+    run_check "ruff" "${PYTHON}" -m ruff check "${TOOL_PATHS[@]}"
 elif command -v ruff >/dev/null 2>&1; then
-    run_check "ruff" ruff check "${PATHS[@]}"
+    run_check "ruff" ruff check "${TOOL_PATHS[@]}"
 else
     echo "==> ruff: not installed, skipping"
 fi
 
 # 3. mypy — optional.
 if "${PYTHON}" -m mypy --version >/dev/null 2>&1; then
-    run_check "mypy" "${PYTHON}" -m mypy "${PATHS[@]}"
+    run_check "mypy" "${PYTHON}" -m mypy "${TOOL_PATHS[@]}"
 else
     echo "==> mypy: not installed, skipping"
 fi

@@ -273,7 +273,7 @@ class TestTpuShm:
         region = tpushm.create_shared_memory_region("tdead", 64, 0)
         tpushm.destroy_shared_memory_region(region)
         with pytest.raises(tpushm.TpuSharedMemoryException, match="destroyed"):
-            region.read_bytes(0, 8)
+            region.read_bytes(0, 8)  # tpulint: disable=TPU006 - asserts the error
 
     def test_raw_handle_resolution(self):
         region = tpushm.create_shared_memory_region("tregh", 128, 0)

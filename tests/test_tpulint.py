@@ -12,7 +12,12 @@ import textwrap
 
 import pytest
 
-from tritonclient_tpu.analysis import main, render_json, run_analysis
+from tritonclient_tpu.analysis import (
+    main,
+    render_json,
+    render_sarif,
+    run_analysis,
+)
 
 
 def lint(tmp_path, source, name="fixture.py", subdir="", select=None):
@@ -22,6 +27,17 @@ def lint(tmp_path, source, name="fixture.py", subdir="", select=None):
     path.write_text(textwrap.dedent(source))
     findings, files = run_analysis([str(path)], select=select)
     assert files == 1
+    return findings
+
+
+def lint_tree(tmp_path, files, select=None):
+    """Multi-file fixture for the project-sensitive rules."""
+    for rel, source in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(source))
+    findings, n = run_analysis([str(tmp_path)], select=select)
+    assert n == len(files)
     return findings
 
 
@@ -103,6 +119,74 @@ class TestAsyncBlocking:
 
             def warmup():
                 time.sleep(0.5)  # tpulint: disable=TPU001
+            """,
+            select={"TPU001"},
+        )
+        assert findings == []
+
+    def test_fires_inside_async_with_and_async_for(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import time
+
+            async def h(cm, it):
+                async with cm:
+                    time.sleep(1)
+                async for _ in it:
+                    time.sleep(2)
+            """,
+            select={"TPU001"},
+        )
+        assert rules_of(findings) == ["TPU001", "TPU001"]
+        assert all("event loop" in f.message for f in findings)
+
+    def test_fires_in_nested_async_def(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import time
+
+            def outer():
+                async def inner():
+                    time.sleep(1)
+                return inner
+            """,
+            select={"TPU001"},
+        )
+        assert rules_of(findings) == ["TPU001"]
+        assert "async def" in findings[0].message
+
+    def test_fires_on_partial_bound_blocking_call(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import functools
+            import socket
+            import time
+
+            async def h():
+                nap = functools.partial(time.sleep, 1)
+                nap()
+                functools.partial(socket.create_connection, ("h", 80))()
+            """,
+            select={"TPU001"},
+        )
+        assert rules_of(findings) == ["TPU001", "TPU001"]
+        assert all("functools.partial" in f.message for f in findings)
+
+    def test_partial_handed_to_executor_is_clean(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import asyncio
+            import functools
+            import time
+
+            async def h(loop):
+                await loop.run_in_executor(
+                    None, functools.partial(time.sleep, 1)
+                )
             """,
             select={"TPU001"},
         )
@@ -408,6 +492,431 @@ class TestResourceLeak:
 
 
 # --------------------------------------------------------------------------- #
+# TPU006 shm-lifecycle                                                        #
+# --------------------------------------------------------------------------- #
+
+
+class TestShmLifecycle:
+    def test_fires_on_leaked_handle(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import tritonclient_tpu.utils.shared_memory as shm
+
+            def f():
+                h = shm.create_shared_memory_region("r", "/r", 64)
+                shm.set_shared_memory_region(h, [1])
+            """,
+            select={"TPU006"},
+        )
+        assert rules_of(findings) == ["TPU006"]
+        assert "never destroyed" in findings[0].message
+
+    def test_fires_on_exception_path_leak(self, tmp_path):
+        # destroy exists, but the raise path skips it: flow-sensitivity.
+        findings = lint(
+            tmp_path,
+            """
+            import tritonclient_tpu.utils.shared_memory as shm
+
+            def f(bad):
+                h = shm.create_shared_memory_region("r", "/r", 64)
+                if bad:
+                    raise ValueError("nope")
+                shm.destroy_shared_memory_region(h)
+            """,
+            select={"TPU006"},
+        )
+        assert rules_of(findings) == ["TPU006"]
+        assert "path exiting at line" in findings[0].message
+
+    def test_fires_on_use_after_unregister(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import tritonclient_tpu.utils.shared_memory as shm
+
+            def f(client):
+                h = shm.create_shared_memory_region("r", "/r", 64)
+                client.register_system_shared_memory("r", "/r", 64)
+                client.unregister_system_shared_memory("r")
+                out = shm.get_contents_as_numpy(h, "FP32", [4])
+                shm.destroy_shared_memory_region(h)
+                return out
+            """,
+            select={"TPU006"},
+        )
+        assert rules_of(findings) == ["TPU006"]
+        assert "unregistered" in findings[0].message
+
+    def test_fires_on_use_after_destroy_and_double_register(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import tritonclient_tpu.utils.shared_memory as shm
+
+            def use_after_destroy():
+                h = shm.create_shared_memory_region("r", "/r", 64)
+                shm.destroy_shared_memory_region(h)
+                shm.set_shared_memory_region(h, [1])
+
+            def double_register(client):
+                client.register_system_shared_memory("r", "/r", 64)
+                client.register_system_shared_memory("r", "/r", 64)
+            """,
+            select={"TPU006"},
+        )
+        messages = " ".join(f.message for f in findings)
+        assert "after destroy_shared_memory_region" in messages
+        assert "registered twice" in messages
+
+    def test_clean_on_try_finally_and_escapes(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import tritonclient_tpu.utils.shared_memory as shm
+
+            def full_protocol(client):
+                a, b = (
+                    shm.create_shared_memory_region("a", "/a", 8),
+                    shm.create_shared_memory_region("b", "/b", 8),
+                )
+                try:
+                    client.register_system_shared_memory("a", "/a", 8)
+                    shm.set_shared_memory_region(a, [1])
+                finally:
+                    client.unregister_system_shared_memory()
+                    for h in (a, b):
+                        shm.destroy_shared_memory_region(h)
+
+            def escapes(self):
+                kept = shm.create_shared_memory_region("k", "/k", 8)
+                self.region = kept  # ownership leaves the frame
+                made = shm.create_shared_memory_region("m", "/m", 8)
+                return made
+            """,
+            select={"TPU006"},
+        )
+        assert findings == []
+
+    def test_suppressed(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import tritonclient_tpu.utils.shared_memory as shm
+
+            def leak():
+                h = shm.create_shared_memory_region("r", "/r", 64)  # tpulint: disable=TPU006
+                h.write_bytes(0, b"x")
+            """,
+            select={"TPU006"},
+        )
+        assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# TPU007 lock-order                                                           #
+# --------------------------------------------------------------------------- #
+
+_DEADLOCK_MODULE = """
+    import threading
+
+    LOCK_A = threading.Lock()
+    LOCK_B = threading.Lock()
+
+    def one():
+        with LOCK_A:
+            with LOCK_B:
+                pass
+
+    def two():
+        with LOCK_B:
+            with LOCK_A:%s
+                pass
+"""
+
+
+class TestLockOrder:
+    def test_fires_on_nested_with_cycle(self, tmp_path):
+        findings = lint(
+            tmp_path, _DEADLOCK_MODULE % "", select={"TPU007"}
+        )
+        assert rules_of(findings) == ["TPU007", "TPU007"]
+        # Both acquisition sites are cited, with the held-since location.
+        assert all("held since" in f.message for f in findings)
+        assert {f.line for f in findings} == {9, 14}
+
+    def test_fires_on_cycle_through_method_calls(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import threading
+
+            class A:
+                def __init__(self, b: "B"):
+                    self._lock = threading.Lock()
+                    self.b = b
+
+                def doit(self):
+                    with self._lock:
+                        self.b.poke()
+
+                def poke(self):
+                    with self._lock:
+                        pass
+
+            class B:
+                def __init__(self, a: "A"):
+                    self._lock = threading.Lock()
+                    self.a = a
+
+                def poke(self):
+                    with self._lock:
+                        pass
+
+                def doit(self):
+                    with self._lock:
+                        self.a.poke()
+            """,
+            select={"TPU007"},
+        )
+        assert rules_of(findings) == ["TPU007", "TPU007"]
+        assert all("A._lock" in f.message and "B._lock" in f.message
+                   for f in findings)
+
+    def test_clean_on_consistent_order(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import threading
+
+            LOCK_A = threading.Lock()
+            LOCK_B = threading.Lock()
+
+            def one():
+                with LOCK_A:
+                    with LOCK_B:
+                        pass
+
+            def two():
+                with LOCK_A:
+                    with LOCK_B:
+                        pass
+            """,
+            select={"TPU007"},
+        )
+        assert findings == []
+
+    def test_self_reacquire_via_call_fires_for_plain_lock(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import threading
+
+            class R:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}
+
+                def status(self):
+                    with self._lock:
+                        return dict(self._items)
+
+                def snapshot(self):
+                    with self._lock:
+                        return self.status()
+            """,
+            select={"TPU007"},
+        )
+        assert rules_of(findings) == ["TPU007"]
+        assert "R._lock -> R._lock" in findings[0].message
+
+    def test_suppressed(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            _DEADLOCK_MODULE % "  # tpulint: disable=TPU007",
+            select={"TPU007"},
+        )
+        # Only the suppressed inner-with site is silenced; the other leg
+        # of the cycle still reports.
+        assert rules_of(findings) == ["TPU007"]
+
+
+# --------------------------------------------------------------------------- #
+# TPU008 protocol-drift                                                       #
+# --------------------------------------------------------------------------- #
+
+_DRIFT_CLIENT = """
+    from tritonclient_tpu.protocol._literals import (
+        KEY_BINARY_DATA_SIZE,
+        KEY_SHM_BYTE_SIZE,
+        KEY_SHM_OFFSET,
+        KEY_SHM_REGION,
+    )
+
+    def build(params):
+        params[KEY_SHM_REGION] = "r"
+        params[KEY_SHM_OFFSET] = 0
+        params[KEY_SHM_BYTE_SIZE] = 8
+        params[KEY_BINARY_DATA_SIZE] = 8
+"""
+
+_DRIFT_SERVER_FULL = """
+    from tritonclient_tpu.protocol._literals import (
+        KEY_BINARY_DATA_SIZE,
+        KEY_SHM_BYTE_SIZE,
+        KEY_SHM_OFFSET,
+        KEY_SHM_REGION,
+    )
+
+    def parse(params):
+        return (
+            params.get(KEY_SHM_REGION),
+            params.get(KEY_SHM_OFFSET),
+            params.get(KEY_SHM_BYTE_SIZE),
+            params.get(KEY_BINARY_DATA_SIZE),
+        )
+"""
+
+_DRIFT_SERVER_NO_BINARY = """
+    from tritonclient_tpu.protocol._literals import (
+        KEY_SHM_BYTE_SIZE,
+        KEY_SHM_OFFSET,
+        KEY_SHM_REGION,
+    )
+
+    def parse(params):
+        return (
+            params.get(KEY_SHM_REGION),
+            params.get(KEY_SHM_OFFSET),
+            params.get(KEY_SHM_BYTE_SIZE),
+        )
+"""
+
+
+class TestProtocolDrift:
+    def test_fires_on_client_key_server_never_parses(self, tmp_path):
+        findings = lint_tree(
+            tmp_path,
+            {
+                "pkg/http/_infer_input.py": _DRIFT_CLIENT,
+                "pkg/server/_http.py": _DRIFT_SERVER_NO_BINARY,
+            },
+            select={"TPU008"},
+        )
+        assert rules_of(findings) == ["TPU008"]
+        assert "binary_data_size" in findings[0].message
+        assert "never parsed" in findings[0].message
+        assert findings[0].path.endswith("_infer_input.py")
+
+    def test_fires_on_server_key_client_never_builds(self, tmp_path):
+        findings = lint_tree(
+            tmp_path,
+            {
+                "pkg/http/_infer_input.py": """
+                    from tritonclient_tpu.protocol._literals import (
+                        KEY_SHM_BYTE_SIZE,
+                        KEY_SHM_OFFSET,
+                        KEY_SHM_REGION,
+                    )
+
+                    def build(params):
+                        params[KEY_SHM_REGION] = "r"
+                        params[KEY_SHM_OFFSET] = 0
+                        params[KEY_SHM_BYTE_SIZE] = 8
+                """,
+                "pkg/server/_http.py": _DRIFT_SERVER_FULL,
+            },
+            select={"TPU008"},
+        )
+        assert rules_of(findings) == ["TPU008"]
+        assert "never built" in findings[0].message
+        assert findings[0].path.endswith("_http.py")
+
+    def test_fires_on_incomplete_shm_trio(self, tmp_path):
+        findings = lint_tree(
+            tmp_path,
+            {
+                "pkg/grpc/_infer_input.py": """
+                    from tritonclient_tpu.protocol._literals import KEY_SHM_REGION
+
+                    def build(params):
+                        params[KEY_SHM_REGION] = "r"
+                """,
+                "pkg/server/_grpc.py": """
+                    from tritonclient_tpu.protocol._literals import KEY_SHM_REGION
+
+                    def parse(params):
+                        return params.get(KEY_SHM_REGION)
+                """,
+            },
+            select={"TPU008"},
+        )
+        assert len(findings) == 2  # one per side
+        assert all("incomplete shared-memory key trio" in f.message
+                   for f in findings)
+
+    def test_clean_on_symmetric_planes(self, tmp_path):
+        findings = lint_tree(
+            tmp_path,
+            {
+                "pkg/http/_infer_input.py": _DRIFT_CLIENT,
+                "pkg/server/_http.py": _DRIFT_SERVER_FULL,
+            },
+            select={"TPU008"},
+        )
+        assert findings == []
+
+    def test_passthrough_params_and_literal_usage(self, tmp_path):
+        # Request-level parameters (sequence_id & co) are forwarded
+        # wholesale by the front-ends: client-only usage is fine. A raw
+        # string literal still counts as usage for symmetry purposes.
+        findings = lint_tree(
+            tmp_path,
+            {
+                "pkg/http/_utils.py": """
+                    from tritonclient_tpu.protocol._literals import (
+                        KEY_SEQUENCE_ID,
+                    )
+
+                    def build(params):
+                        params[KEY_SEQUENCE_ID] = 7
+                        params["classification"] = 3
+                """,
+                "pkg/server/_http.py": """
+                    from tritonclient_tpu.protocol._literals import (
+                        KEY_CLASSIFICATION,
+                    )
+
+                    def parse(params):
+                        return params.get(KEY_CLASSIFICATION)
+                """,
+            },
+            select={"TPU008"},
+        )
+        assert findings == []
+
+    def test_suppressed(self, tmp_path):
+        findings = lint_tree(
+            tmp_path,
+            {
+                "pkg/http/_infer_input.py": _DRIFT_CLIENT.replace(
+                    'params[KEY_SHM_REGION] = "r"',
+                    'params[KEY_SHM_REGION] = "r"  '
+                    "# tpulint: disable=TPU008",
+                ).replace(
+                    "params[KEY_BINARY_DATA_SIZE] = 8",
+                    "params[KEY_BINARY_DATA_SIZE] = 8  "
+                    "# tpulint: disable=TPU008",
+                ),
+                "pkg/server/_http.py": _DRIFT_SERVER_NO_BINARY,
+            },
+            select={"TPU008"},
+        )
+        assert findings == []
+
+
+# --------------------------------------------------------------------------- #
 # engine / reporters / CLI                                                    #
 # --------------------------------------------------------------------------- #
 
@@ -462,8 +971,172 @@ class TestEngine:
     def test_list_rules(self, capsys):
         assert main(["--list-rules"]) == 0
         out = capsys.readouterr().out
-        for rule_id in ("TPU001", "TPU002", "TPU003", "TPU004", "TPU005"):
+        for rule_id in (
+            "TPU001", "TPU002", "TPU003", "TPU004",
+            "TPU005", "TPU006", "TPU007", "TPU008",
+        ):
             assert rule_id in out
+
+
+# --------------------------------------------------------------------------- #
+# SARIF reporter                                                              #
+# --------------------------------------------------------------------------- #
+
+
+class TestSarif:
+    def test_sarif_2_1_0_shape(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import time
+
+            async def h():
+                time.sleep(1)
+            """,
+            select={"TPU001"},
+        )
+        doc = json.loads(render_sarif(findings, 1))
+        assert doc["version"] == "2.1.0"
+        assert "sarif-schema-2.1.0" in doc["$schema"]
+        (run,) = doc["runs"]
+        driver = run["tool"]["driver"]
+        assert driver["name"] == "tpulint"
+        rule_ids = {r["id"] for r in driver["rules"]}
+        assert {"TPU001", "TPU006", "TPU007", "TPU008"} <= rule_ids
+        (result,) = run["results"]
+        assert result["ruleId"] == "TPU001"
+        assert result["level"] == "warning"
+        assert result["message"]["text"]
+        loc = result["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("fixture.py")
+        assert loc["region"]["startLine"] == 5
+        assert loc["region"]["startColumn"] >= 1
+        assert "tpulint/v1" in result["partialFingerprints"]
+
+    def test_sarif_empty_run_is_valid(self):
+        doc = json.loads(render_sarif([], 42))
+        assert doc["version"] == "2.1.0"
+        assert doc["runs"][0]["results"] == []
+
+    def test_cli_format_sarif(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import time\n\nasync def h():\n    time.sleep(1)\n")
+        assert main([str(bad), "--select", "TPU001", "--format", "sarif"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["version"] == "2.1.0"
+        assert len(doc["runs"][0]["results"]) == 1
+
+
+# --------------------------------------------------------------------------- #
+# baseline mode                                                               #
+# --------------------------------------------------------------------------- #
+
+
+_BASELINE_VIOLATION = "import time\n\nasync def h():\n    time.sleep(1)\n"
+
+
+class TestBaseline:
+    def test_round_trip_suppresses_recorded_findings(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(_BASELINE_VIOLATION)
+        base = tmp_path / "base.json"
+        assert main([str(bad), "--select", "TPU001",
+                     "--write-baseline", str(base)]) == 0
+        payload = json.loads(base.read_text())
+        assert payload["format"] == "tpulint-baseline"
+        assert sum(payload["findings"].values()) == 1
+        capsys.readouterr()
+        # Same findings, baseline applied: exit 0, nothing reported.
+        assert main([str(bad), "--select", "TPU001",
+                     "--baseline", str(base)]) == 0
+        assert "TPU001" not in capsys.readouterr().out
+
+    def test_new_finding_fails_against_baseline(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(_BASELINE_VIOLATION)
+        base = tmp_path / "base.json"
+        assert main([str(bad), "--select", "TPU001",
+                     "--write-baseline", str(base)]) == 0
+        # A second violation in the same file exceeds the recorded count.
+        bad.write_text(
+            _BASELINE_VIOLATION + "\nasync def g():\n    time.sleep(2)\n"
+        )
+        capsys.readouterr()
+        assert main([str(bad), "--select", "TPU001",
+                     "--baseline", str(base)]) == 1
+        assert "TPU001" in capsys.readouterr().out
+
+    def test_removed_finding_round_trips_out_of_the_baseline(
+        self, tmp_path, capsys
+    ):
+        bad = tmp_path / "bad.py"
+        bad.write_text(_BASELINE_VIOLATION)
+        base = tmp_path / "base.json"
+        assert main([str(bad), "--select", "TPU001",
+                     "--write-baseline", str(base)]) == 0
+        # Fix the violation, regenerate: the entry disappears.
+        bad.write_text("x = 1\n")
+        assert main([str(bad), "--select", "TPU001",
+                     "--write-baseline", str(base)]) == 0
+        assert json.loads(base.read_text())["findings"] == {}
+        capsys.readouterr()
+        assert main([str(bad), "--select", "TPU001",
+                     "--baseline", str(base)]) == 0
+
+    def test_malformed_baseline_is_an_error(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text("x = 1\n")
+        base = tmp_path / "base.json"
+        base.write_text('{"not": "a baseline"}')
+        assert main([str(bad), "--baseline", str(base)]) == 2
+
+
+# --------------------------------------------------------------------------- #
+# --fix autofix                                                               #
+# --------------------------------------------------------------------------- #
+
+
+class TestFix:
+    def test_fix_rewrites_async_sleep_and_literals(self, tmp_path, capsys):
+        aio = tmp_path / "aio.py"
+        aio.write_text("import time\n\nasync def h():\n    time.sleep(1)\n")
+        server = tmp_path / "server"
+        server.mkdir()
+        ep = server / "ep.py"
+        ep.write_text(
+            "def live(client):\n"
+            '    return client.get("v2/health/live")\n'
+            "\n"
+            "def build(params):\n"
+            '    params["shared_memory_region"] = "r0"\n'
+        )
+        assert main([str(tmp_path), "--fix"]) == 0
+        fixed_aio = aio.read_text()
+        assert "await asyncio.sleep(1)" in fixed_aio
+        assert "import asyncio" in fixed_aio
+        fixed_ep = ep.read_text()
+        assert "EP_HEALTH_LIVE" in fixed_ep
+        assert "KEY_SHM_REGION" in fixed_ep
+        assert "from tritonclient_tpu.protocol._literals import" in fixed_ep
+        assert '"v2/health/live"' not in fixed_ep
+        # The fixed tree re-lints clean.
+        findings, _ = run_analysis([str(tmp_path)])
+        assert findings == []
+
+    def test_fix_is_idempotent(self, tmp_path, capsys):
+        aio = tmp_path / "aio.py"
+        aio.write_text("import time\n\nasync def h():\n    time.sleep(1)\n")
+        assert main([str(tmp_path), "--fix"]) == 0
+        first = aio.read_text()
+        assert main([str(tmp_path), "--fix"]) == 0
+        assert aio.read_text() == first
+
+    def test_fix_leaves_non_mechanical_findings(self, tmp_path, capsys):
+        # Sync-code time.sleep is diagnosed but not auto-fixed.
+        mod = tmp_path / "warm.py"
+        mod.write_text("import time\n\ndef warm():\n    time.sleep(1)\n")
+        assert main([str(tmp_path), "--fix", "--select", "TPU001"]) == 1
+        assert "time.sleep(1)" in mod.read_text()
 
 
 # --------------------------------------------------------------------------- #
@@ -480,4 +1153,17 @@ def test_tpulint_runs_clean_on_the_repo():
     package_dir = os.path.dirname(tritonclient_tpu.__file__)
     findings, files_checked = run_analysis([package_dir])
     assert files_checked > 50
+    assert findings == [], "\n".join(f.text() for f in findings)
+
+
+def test_flow_sensitive_rules_run_clean_on_the_repo():
+    """The acceptance gate for the flow/project-sensitive layer: TPU006,
+    TPU007, and TPU008 exit 0 over the package after the lifecycle and
+    drift fixes."""
+    import tritonclient_tpu
+
+    package_dir = os.path.dirname(tritonclient_tpu.__file__)
+    findings, _ = run_analysis(
+        [package_dir], select={"TPU006", "TPU007", "TPU008"}
+    )
     assert findings == [], "\n".join(f.text() for f in findings)

@@ -1,16 +1,18 @@
 """tpulint — project-specific static analysis for the TPU serving stack.
 
-Five AST-based check families tuned to the bug classes this codebase's
-surfaces actually grow (two protocol front-ends, sync+aio clients, a
-threaded server core, a DLPack/shm registry):
+Eight check families tuned to the bug classes this codebase's surfaces
+actually grow (two protocol front-ends, sync+aio clients, a threaded
+server core, a DLPack/shm registry). TPU001–TPU005 are AST-local;
+TPU006–TPU008 are flow- and project-sensitive:
 
 =======  =================  ====================================================
 rule     name               catches
 =======  =================  ====================================================
 TPU001   async-blocking     ``time.sleep`` / sync socket / file I/O / sync
-                            gRPC inside ``async def`` bodies (and
-                            ``time.sleep`` anywhere — one refactor from
-                            stalling an in-process event loop)
+                            gRPC inside ``async def`` bodies (including
+                            ``async with``/``async for`` and blocking calls
+                            bound through ``functools.partial``), and
+                            ``time.sleep`` anywhere
 TPU002   lock-discipline    instance attributes guarded by a class's lock in
                             one method and touched lock-free in another
 TPU003   protocol-literal   KServe v2 endpoint paths / wire keys spelled out
@@ -20,13 +22,31 @@ TPU004   dtype-map          numpy<->Triton datatype tables not mutually
                             inverse or not total vs protocol/_literals
 TPU005   resource-leak      shm/file/socket/trace handles acquired without
                             ``with``/``finally`` release on all paths
+TPU006   shm-lifecycle      flow-sensitive state machine over shm handles
+                            (create → register → set/read → unregister →
+                            destroy): use-after-unregister/destroy,
+                            double-register, leak paths incl. exception edges
+TPU007   lock-order         cycles in the project-wide lock-acquisition
+                            graph (with-nesting + calls under a lock) —
+                            potential deadlocks, both sites cited
+TPU008   protocol-drift     wire keys built by a plane's client but not
+                            parsed by its server front-end (or vice versa);
+                            incomplete shared-memory key trios
 =======  =================  ====================================================
 
 Suppress a deliberate violation with ``# tpulint: disable=TPU001`` (comma
 list allowed) on the offending line, or on a ``def``/``class`` line to
 cover the whole body; ``# tpulint: disable-file=TPU003`` anywhere in a file
-covers the file. Run ``python -m tritonclient_tpu.analysis <paths>``
-(exit 1 on findings; ``--format json`` for machine-readable output).
+covers the file. Project-wide rules (TPU004/007/008) honor the same
+syntax at the line their finding points to.
+
+Run ``python -m tritonclient_tpu.analysis <paths>`` (exit 1 on findings).
+``--format json|sarif`` selects machine-readable output (SARIF 2.1.0 for
+GitHub code scanning), ``--baseline FILE`` fails only on findings absent
+from a recorded baseline, ``--write-baseline FILE`` records one, and
+``--fix`` applies the mechanical rewrites (TPU003 literal → constant,
+TPU001 ``time.sleep`` → ``await asyncio.sleep`` on async paths) and
+re-lints.
 """
 
 from tritonclient_tpu.analysis._engine import (  # noqa: F401
@@ -35,6 +55,7 @@ from tritonclient_tpu.analysis._engine import (  # noqa: F401
     Rule,
     default_rules,
     render_json,
+    render_sarif,
     render_text,
     run_analysis,
 )
@@ -46,6 +67,7 @@ __all__ = [
     "default_rules",
     "main",
     "render_json",
+    "render_sarif",
     "render_text",
     "run_analysis",
 ]
@@ -54,6 +76,7 @@ __all__ = [
 def main(argv=None) -> int:
     """CLI entry point (``python -m tritonclient_tpu.analysis``)."""
     import argparse
+    import sys
 
     parser = argparse.ArgumentParser(
         prog="tpulint",
@@ -64,7 +87,7 @@ def main(argv=None) -> int:
         help="files or directories to lint (default: tritonclient_tpu)",
     )
     parser.add_argument(
-        "--format", choices=("text", "json"), default="text",
+        "--format", choices=("text", "json", "sarif"), default="text",
         help="report format (default: text)",
     )
     parser.add_argument(
@@ -74,6 +97,19 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--list-rules", action="store_true",
         help="print the rule table and exit",
+    )
+    parser.add_argument(
+        "--baseline", metavar="FILE", default=None,
+        help="fail only on findings absent from this baseline file",
+    )
+    parser.add_argument(
+        "--write-baseline", metavar="FILE", default=None,
+        help="record current findings as the baseline and exit 0",
+    )
+    parser.add_argument(
+        "--fix", action="store_true",
+        help="apply mechanical fixes (TPU001 async sleep, TPU003 literal "
+        "rewrites), then re-lint and report what remains",
     )
     args = parser.parse_args(argv)
 
@@ -87,6 +123,49 @@ def main(argv=None) -> int:
         or None
     )
     findings, files_checked = run_analysis(args.paths, select=select)
-    render = render_json if args.format == "json" else render_text
+
+    if args.fix:
+        from tritonclient_tpu.analysis._fix import apply_fixes
+
+        applied = apply_fixes(findings)
+        for path, count in sorted(applied.items()):
+            noun = "fix" if count == 1 else "fixes"
+            print(f"tpulint: applied {count} {noun} in {path}", file=sys.stderr)
+        findings, files_checked = run_analysis(args.paths, select=select)
+
+    if args.write_baseline:
+        from tritonclient_tpu.analysis._baseline import write_baseline
+
+        write_baseline(args.write_baseline, findings)
+        print(
+            f"tpulint: wrote baseline with {len(findings)} findings to "
+            f"{args.write_baseline}",
+            file=sys.stderr,
+        )
+        return 0
+
+    suppressed = 0
+    if args.baseline:
+        from tritonclient_tpu.analysis._baseline import (
+            apply_baseline,
+            load_baseline,
+        )
+
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"tpulint: cannot load baseline: {e}", file=sys.stderr)
+            return 2
+        findings, suppressed = apply_baseline(findings, baseline)
+
+    render = {
+        "json": render_json,
+        "sarif": render_sarif,
+    }.get(args.format, render_text)
     print(render(findings, files_checked))
+    if suppressed and args.format == "text":
+        print(
+            f"tpulint: {suppressed} baselined finding(s) suppressed",
+            file=sys.stderr,
+        )
     return 1 if findings else 0

@@ -1,0 +1,73 @@
+"""Baseline support: fail only on findings absent from a recorded set.
+
+The baseline is how the lint scope grows without a flag day: widening
+``discover_files`` to ``scripts/`` and ``tests/`` surfaced pre-existing
+findings that are real but not this change's to fix — they get recorded
+once (``--write-baseline``) and CI then fails only on *new* findings
+(``--baseline``).
+
+Matching is by fingerprint (``rule::path::message``), deliberately
+line-number free: editing an unrelated part of a file must not resurrect
+its baselined findings. The baseline stores a count per fingerprint, so
+introducing a *second* instance of an already-baselined violation in the
+same file with the same message still fails. Fixing a baselined finding
+leaves a stale entry; ``--write-baseline`` regenerates the file (the
+round-trip tests assert add/remove behavior both ways).
+"""
+
+import json
+from typing import Dict, List, Sequence, Tuple
+
+from tritonclient_tpu.analysis._engine import Finding
+
+_FORMAT = "tpulint-baseline"
+_VERSION = 1
+
+
+def write_baseline(path: str, findings: Sequence[Finding]):
+    counts: Dict[str, int] = {}
+    for f in findings:
+        fp = f.fingerprint()
+        counts[fp] = counts.get(fp, 0) + 1
+    doc = {
+        "format": _FORMAT,
+        "version": _VERSION,
+        "findings": {fp: counts[fp] for fp in sorted(counts)},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if doc.get("format") != _FORMAT:
+        raise ValueError(f"{path} is not a tpulint baseline file")
+    counts = doc.get("findings", {})
+    if not all(
+        isinstance(k, str) and isinstance(v, int) for k, v in counts.items()
+    ):
+        raise ValueError(f"{path}: malformed baseline findings map")
+    return dict(counts)
+
+
+def apply_baseline(
+    findings: Sequence[Finding], baseline: Dict[str, int]
+) -> Tuple[List[Finding], int]:
+    """Split findings into (new, suppressed_count).
+
+    The first N findings matching a fingerprint with baseline count N are
+    suppressed; any beyond that are new.
+    """
+    budget = dict(baseline)
+    fresh: List[Finding] = []
+    suppressed = 0
+    for f in findings:
+        fp = f.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            suppressed += 1
+        else:
+            fresh.append(f)
+    return fresh, suppressed

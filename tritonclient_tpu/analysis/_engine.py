@@ -45,6 +45,11 @@ class Finding:
             "message": self.message,
         }
 
+    def fingerprint(self) -> str:
+        """Line-number-independent identity for baseline matching: a
+        finding survives unrelated edits shifting it up or down."""
+        return f"{self.rule}::{self.path}::{self.message}"
+
 
 class FileContext:
     """One parsed source file plus the derived maps rules need."""
@@ -194,6 +199,9 @@ def default_rules() -> List[Rule]:
     from tritonclient_tpu.analysis._tpu003_literals import ProtocolLiteralRule
     from tritonclient_tpu.analysis._tpu004_dtype_map import DtypeMapRule
     from tritonclient_tpu.analysis._tpu005_resource_leak import ResourceLeakRule
+    from tritonclient_tpu.analysis._tpu006_shm_lifecycle import ShmLifecycleRule
+    from tritonclient_tpu.analysis._tpu007_lock_order import LockOrderRule
+    from tritonclient_tpu.analysis._tpu008_protocol_drift import ProtocolDriftRule
 
     return [
         AsyncBlockingRule(),
@@ -201,6 +209,9 @@ def default_rules() -> List[Rule]:
         ProtocolLiteralRule(),
         DtypeMapRule(),
         ResourceLeakRule(),
+        ShmLifecycleRule(),
+        LockOrderRule(),
+        ProtocolDriftRule(),
     ]
 
 
@@ -272,3 +283,76 @@ def render_json(findings: Sequence[Finding], files_checked: int) -> str:
         },
         indent=2,
     )
+
+
+_SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def render_sarif(findings: Sequence[Finding], files_checked: int) -> str:
+    """SARIF 2.1.0 — the format GitHub code scanning ingests to annotate
+    PRs. One run, one driver (tpulint), one result per finding."""
+    rules_meta = [
+        {
+            "id": rule.id,
+            "name": rule.name,
+            "shortDescription": {"text": rule.description},
+        }
+        for rule in default_rules()
+    ]
+    known = {r["id"] for r in rules_meta}
+    # PARSE (and any future synthetic rule ids) still need a rule entry:
+    # SARIF results must reference a declared rule.
+    for extra in sorted({f.rule for f in findings} - known):
+        rules_meta.append(
+            {
+                "id": extra,
+                "name": extra.lower(),
+                "shortDescription": {"text": "file could not be analyzed"},
+            }
+        )
+    results = [
+        {
+            "ruleId": f.rule,
+            "level": "error" if f.rule == "PARSE" else "warning",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path,
+                            "uriBaseId": "SRCROOT",
+                        },
+                        "region": {
+                            "startLine": max(f.line, 1),
+                            "startColumn": f.col + 1,
+                        },
+                    }
+                }
+            ],
+            "partialFingerprints": {"tpulint/v1": f.fingerprint()},
+        }
+        for f in findings
+    ]
+    doc = {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "tpulint",
+                        "informationUri": (
+                            "https://github.com/triton-inference-server/client"
+                        ),
+                        "rules": rules_meta,
+                    }
+                },
+                "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+                "results": results,
+            }
+        ],
+    }
+    return json.dumps(doc, indent=2)

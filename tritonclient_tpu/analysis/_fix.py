@@ -1,0 +1,212 @@
+"""Autofixes for the mechanical rules (``--fix``).
+
+Only rewrites with exactly one correct spelling are automated:
+
+* **TPU001** — ``time.sleep(x)`` as a statement inside an ``async def``
+  becomes ``await asyncio.sleep(x)`` (adding ``import asyncio`` when
+  missing). The sync-code ``time.sleep`` leg is NOT auto-fixed: whether a
+  sync sleep should become async, move to an executor, or carry a
+  suppression is a design decision.
+* **TPU003** — a string literal exactly equal to a canonical ``KEY_*`` /
+  ``EP_*`` value is replaced by the constant name, with a
+  ``from tritonclient_tpu.protocol._literals import ...`` line added for
+  names the file does not already import. Near-misses and f-string
+  templates are diagnosed only — their correct replacement is not
+  mechanical.
+
+Fixes are applied bottom-up so source positions stay valid, and the
+caller re-lints afterwards; running ``--fix`` twice must change nothing
+(idempotency is asserted in tests/test_tpulint.py).
+"""
+
+import ast
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tritonclient_tpu.analysis._engine import FileContext, Finding
+
+#: (start_line, start_col, end_line, end_col, replacement) — 1-based lines.
+_Edit = Tuple[int, int, int, int, str]
+
+_LITERALS_MODULE = "tritonclient_tpu.protocol._literals"
+
+
+def _literal_constants() -> Dict[str, str]:
+    """value -> constant name for every KEY_* / EP_* string constant."""
+    from tritonclient_tpu.protocol import _literals
+
+    out: Dict[str, str] = {}
+    for name, value in vars(_literals).items():
+        if isinstance(value, str) and (
+            name.startswith("KEY_") or name.startswith("EP_")
+        ):
+            out.setdefault(value, name)
+    return out
+
+
+def apply_fixes(findings: Sequence[Finding]) -> Dict[str, int]:
+    """Rewrite files in place; returns {path: edits applied}."""
+    by_path: Dict[str, List[Finding]] = {}
+    for f in findings:
+        if f.rule in ("TPU001", "TPU003"):
+            by_path.setdefault(f.path, []).append(f)
+    applied: Dict[str, int] = {}
+    for path, file_findings in sorted(by_path.items()):
+        count = _fix_file(path, file_findings)
+        if count:
+            applied[path] = count
+    return applied
+
+
+def _fix_file(path: str, findings: Sequence[Finding]) -> int:
+    try:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    except OSError:
+        return 0
+    try:
+        ctx = FileContext(path, source)
+    except SyntaxError:
+        return 0
+    edits: List[_Edit] = []
+    needed_imports: List[str] = []
+    need_asyncio = False
+    for finding in findings:
+        if finding.rule == "TPU001":
+            edit = _fix_sleep(ctx, finding)
+            if edit is not None:
+                edits.append(edit)
+                need_asyncio = True
+        elif finding.rule == "TPU003":
+            fixed = _fix_literal(ctx, finding)
+            if fixed is not None:
+                edit, const_name = fixed
+                edits.append(edit)
+                needed_imports.append(const_name)
+    if not edits:
+        return 0
+    lines = source.splitlines()
+    for line1, col1, line2, col2, text in sorted(edits, reverse=True):
+        i, j = line1 - 1, line2 - 1
+        lines[i : j + 1] = [lines[i][:col1] + text + lines[j][col2:]]
+    _insert_imports(ctx, lines, needed_imports, need_asyncio)
+    new_source = "\n".join(lines)
+    if source.endswith("\n") and not new_source.endswith("\n"):
+        new_source += "\n"
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(new_source)
+    return len(edits)
+
+
+# -- TPU001: time.sleep -> await asyncio.sleep ------------------------------
+
+
+def _fix_sleep(ctx: FileContext, finding: Finding) -> Optional[_Edit]:
+    call = _call_at(ctx, finding.line, finding.col)
+    if call is None or ctx.canonical_call_name(call.func) != "time.sleep":
+        return None
+    # Statement position only, and only on an async path: `await` is
+    # invalid elsewhere, and the sync-leg fix is a design decision.
+    parent = ctx.parents.get(call)
+    if not isinstance(parent, ast.Expr) or parent.value is not call:
+        return None
+    enclosing = ctx.enclosing_function(call)
+    in_async = False
+    while enclosing is not None:
+        if isinstance(enclosing, ast.AsyncFunctionDef):
+            in_async = True
+            break
+        if isinstance(enclosing, ast.FunctionDef):
+            break  # sync frame between the call and any async def
+        enclosing = ctx.enclosing_function(enclosing)
+    if not in_async:
+        return None
+    func = call.func
+    return (
+        call.lineno,
+        call.col_offset,
+        func.end_lineno,
+        func.end_col_offset,
+        "await asyncio.sleep",
+    )
+
+
+def _call_at(ctx: FileContext, line: int, col: int) -> Optional[ast.Call]:
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Call)
+            and node.lineno == line
+            and node.col_offset == col
+        ):
+            return node
+    return None
+
+
+# -- TPU003: canonical-literal rewrite --------------------------------------
+
+
+def _fix_literal(
+    ctx: FileContext, finding: Finding
+) -> Optional[Tuple[_Edit, str]]:
+    constants = _literal_constants()
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and node.lineno == finding.line
+            and node.col_offset == finding.col
+        ):
+            name = constants.get(node.value)
+            if name is None:
+                return None  # template/near-miss: not mechanical
+            edit = (
+                node.lineno,
+                node.col_offset,
+                node.end_lineno,
+                node.end_col_offset,
+                name,
+            )
+            return edit, name
+    return None
+
+
+# -- import maintenance -----------------------------------------------------
+
+
+def _insert_imports(
+    ctx: FileContext, lines: List[str], const_names: List[str], need_asyncio: bool
+):
+    already = set(ctx.aliases)
+    missing = sorted(
+        {n for n in const_names if n not in already}
+    )
+    add_asyncio = need_asyncio and "asyncio" not in already
+    if not missing and not add_asyncio:
+        return
+    insert_at = _import_insert_index(ctx)
+    new_lines = []
+    if add_asyncio:
+        new_lines.append("import asyncio")
+    if missing:
+        new_lines.append(
+            f"from {_LITERALS_MODULE} import {', '.join(missing)}"
+        )
+    lines[insert_at:insert_at] = new_lines
+
+
+def _import_insert_index(ctx: FileContext) -> int:
+    """0-based line index after the last top-level import (or the module
+    docstring, or 0)."""
+    last = 0
+    for node in ctx.tree.body:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            last = node.end_lineno or node.lineno
+        elif (
+            isinstance(node, ast.Expr)
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+            and last == 0
+        ):
+            last = node.end_lineno or node.lineno
+        else:
+            break
+    return last

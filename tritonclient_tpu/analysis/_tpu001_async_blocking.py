@@ -5,7 +5,11 @@ Two legs:
 * Inside an ``async def`` body (stopping at nested sync ``def``s, which run
   on executor threads): calls that block the event loop — ``time.sleep``,
   sync socket / ``http.client`` / ``urllib`` / ``subprocess`` work, file
-  I/O via ``open``, and sync gRPC channel construction.
+  I/O via ``open``, and sync gRPC channel construction. ``async with`` /
+  ``async for`` bodies and nested ``async def``s are async context like any
+  other; a blocking call *bound* through ``functools.partial`` and invoked
+  on the async path flags at the invocation (handing the partial to an
+  executor is fine — it is never called on the loop there).
 * Anywhere: ``time.sleep``. An in-process serving stack runs event loops in
   the same interpreter, so a sleep in sync code is one refactor away from
   stalling an aio transport; deliberately-sync call sites (perf_analyzer
@@ -14,7 +18,7 @@ Two legs:
 """
 
 import ast
-from typing import List
+from typing import Dict, List
 
 from tritonclient_tpu.analysis._engine import FileContext, Finding, Rule
 
@@ -51,24 +55,72 @@ class AsyncBlockingRule(Rule):
 
     def check_file(self, ctx: FileContext) -> List[Finding]:
         findings: List[Finding] = []
-        self._visit(ctx, ctx.tree, in_async=False, findings=findings)
+        self._visit(ctx, ctx.tree, in_async=False, findings=findings,
+                    partials={})
         return findings
 
-    def _visit(self, ctx, node, in_async, findings):
+    def _visit(self, ctx, node, in_async, findings, partials):
         for child in ast.iter_child_nodes(node):
             if isinstance(child, ast.AsyncFunctionDef):
-                self._visit(ctx, child, True, findings)
+                self._visit(ctx, child, True, findings, dict(partials))
             elif isinstance(child, ast.FunctionDef):
                 # Sync defs nested in async functions run off-loop
                 # (executors, callbacks): the async context does not extend
                 # into them.
-                self._visit(ctx, child, False, findings)
+                self._visit(ctx, child, False, findings, dict(partials))
             else:
+                if isinstance(child, ast.Assign):
+                    self._track_partial(ctx, child, partials)
                 if isinstance(child, ast.Call):
-                    self._check_call(ctx, child, in_async, findings)
-                self._visit(ctx, child, in_async, findings)
+                    self._check_call(ctx, child, in_async, findings, partials)
+                self._visit(ctx, child, in_async, findings, partials)
 
-    def _check_call(self, ctx, call, in_async, findings):
+    def _track_partial(self, ctx, assign: ast.Assign, partials: Dict[str, str]):
+        """``name = functools.partial(<blocking>, ...)`` binds the blocking
+        call under a new name; record it so invocations flag."""
+        bound = self._partial_target(ctx, assign.value)
+        for tgt in assign.targets:
+            if isinstance(tgt, ast.Name):
+                if bound is not None:
+                    partials[tgt.id] = bound
+                else:
+                    partials.pop(tgt.id, None)
+
+    def _partial_target(self, ctx, value) -> "str | None":
+        if not isinstance(value, ast.Call):
+            return None
+        name = ctx.canonical_call_name(value.func)
+        if name not in ("functools.partial", "partial") or not value.args:
+            return None
+        inner = ctx.canonical_call_name(value.args[0])
+        if inner is None:
+            return None
+        if (
+            inner == "time.sleep"
+            or inner in _BLOCKING_EXACT
+            or inner.startswith(_BLOCKING_PREFIXES)
+        ):
+            return inner
+        return None
+
+    def _check_call(self, ctx, call, in_async, findings, partials):
+        # Direct invocation of a partial binding a blocking call, or an
+        # immediately-invoked `functools.partial(blocking, ...)()`.
+        bound = None
+        if isinstance(call.func, ast.Name) and call.func.id in partials:
+            bound = partials[call.func.id]
+        elif isinstance(call.func, ast.Call):
+            bound = self._partial_target(ctx, call.func)
+        if bound is not None and in_async:
+            findings.append(
+                Finding(
+                    self.id, ctx.path, call.lineno, call.col_offset,
+                    f"call invokes a functools.partial binding blocking "
+                    f"`{bound}` inside an async def; route it through an "
+                    "executor or an aio equivalent",
+                )
+            )
+            return
         name = ctx.canonical_call_name(call.func)
         if name is None:
             return

@@ -1,0 +1,589 @@
+"""TPU006: shared-memory handle lifecycle (flow-sensitive).
+
+The zero-copy plane's correctness rests on a register/set/unregister
+protocol the AST-local rules cannot model: a use-after-unregister on a
+PjRt/DLPack-backed region is silent corruption the CPU tests never catch.
+This rule runs a small abstract interpreter over every function body,
+tracking handles returned by ``create_shared_memory_region`` /
+``create_sharded_memory_region`` through assignments, tuple unpacking,
+``with`` blocks, and for-loops over handle tuples, plus the registration
+state of region *names* passed to ``register_*_shared_memory`` /
+``unregister_*_shared_memory``.
+
+States are path-merged (may-analysis) at ``if``/``else``, loop, and
+``try`` joins; every statement inside a ``try`` body contributes an
+exception edge into its handlers and ``finally``, and ``return`` /
+``raise`` are treated as function exits, so a cleanup that only runs on
+the straight-line path still flags the exception path.
+
+Findings:
+
+* **use-after-destroy** — any handle operation (set/read/get_raw_handle/
+  method call) on a path where ``destroy_shared_memory_region`` already
+  ran;
+* **use-after-unregister** — a handle operation after its linked region
+  name was unregistered (and not re-registered) on some path;
+* **double-register** — a region name registered again on a path where it
+  is still registered;
+* **destroy-while-registered** — ``destroy_shared_memory_region`` on a
+  handle whose region name is still registered with the server on every
+  incoming path (unregister first: the server keeps a dangling mapping);
+* **leak** — a path (fall-through, ``return``, or uncaught ``raise``)
+  exits the function with a created handle neither destroyed nor escaped
+  (returned, yielded, stored beyond the frame, or passed to a non-shm
+  call — ownership transfer).
+
+Deliberate violations carry ``# tpulint: disable=TPU006`` (on the create
+line for leaks, on the use line otherwise).
+"""
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tritonclient_tpu.analysis._engine import FileContext, Finding, Rule
+
+_CREATE_FNS = {
+    "create_shared_memory_region",
+    "create_sharded_memory_region",
+}
+_DESTROY_FNS = {"destroy_shared_memory_region"}
+#: Module-level functions that operate on a handle without taking ownership.
+_USE_FNS = {
+    "set_shared_memory_region",
+    "set_shared_memory_region_from_dlpack",
+    "get_contents_as_numpy",
+    "as_shared_memory_tensor",
+    "get_raw_handle",
+}
+_REGISTER_METHODS = {
+    "register_system_shared_memory",
+    "register_cuda_shared_memory",
+    "register_tpu_shared_memory",
+}
+_UNREGISTER_METHODS = {
+    "unregister_system_shared_memory",
+    "unregister_cuda_shared_memory",
+    "unregister_tpu_shared_memory",
+}
+
+# Handle states.
+_CREATED = "created"
+_DESTROYED = "destroyed"
+# Name states.
+_REGISTERED = "registered"
+_UNREGISTERED = "unregistered"
+
+
+class _Env:
+    """One abstract machine state: variable bindings + per-handle and
+    per-region-name state sets (sets = may-information after joins)."""
+
+    __slots__ = ("vars", "hstate", "nstate")
+
+    def __init__(self):
+        self.vars: Dict[str, int] = {}          # local name -> handle id
+        self.hstate: Dict[int, Set[str]] = {}   # handle id -> state set
+        self.nstate: Dict[str, Set[str]] = {}   # region-name key -> state set
+
+    def copy(self) -> "_Env":
+        env = _Env()
+        env.vars = dict(self.vars)
+        env.hstate = {k: set(v) for k, v in self.hstate.items()}
+        env.nstate = {k: set(v) for k, v in self.nstate.items()}
+        return env
+
+    def join(self, other: Optional["_Env"]):
+        if other is None:
+            return
+        for var, hid in other.vars.items():
+            self.vars.setdefault(var, hid)
+        for hid, states in other.hstate.items():
+            self.hstate.setdefault(hid, set()).update(states)
+        for name, states in other.nstate.items():
+            self.nstate.setdefault(name, set()).update(states)
+
+
+class _Handle:
+    __slots__ = ("hid", "var", "site", "name_key", "escaped", "leak_reported")
+
+    def __init__(self, hid, var, site, name_key):
+        self.hid = hid
+        self.var = var
+        self.site = site          # the create-call AST node
+        self.name_key = name_key  # region-name key ('' when unknown)
+        self.escaped = False
+        self.leak_reported = False
+
+
+class ShmLifecycleRule(Rule):
+    id = "TPU006"
+    name = "shm-lifecycle"
+    description = (
+        "shared-memory handle state machine: use-after-unregister/destroy, "
+        "double-register, and paths leaking a created region"
+    )
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _FunctionAnalysis(self, ctx, node, findings).run()
+        return findings
+
+
+class _FunctionAnalysis:
+    def __init__(self, rule, ctx, func, findings):
+        self.rule = rule
+        self.ctx = ctx
+        self.func = func
+        self.findings = findings
+        self.handles: Dict[int, _Handle] = {}
+        self._next_hid = 0
+        # Findings deduped per (kind, handle-or-name, line).
+        self._seen: Set[Tuple] = set()
+
+    # -- entry ---------------------------------------------------------------
+
+    def run(self):
+        env = _Env()
+        out = self._exec_block(self.func.body, env, raise_sink=None)
+        if out is not None:
+            self._check_exit(out)
+
+    # -- reporting -----------------------------------------------------------
+
+    def _report(self, kind, key, node, message):
+        dedup = (kind, node.lineno)
+        if dedup in self._seen:
+            return
+        self._seen.add(dedup)
+        self.findings.append(
+            Finding(
+                self.rule.id, self.ctx.path, node.lineno, node.col_offset,
+                message,
+            )
+        )
+
+    def _check_exit(self, env: _Env, at: Optional[ast.AST] = None):
+        """A path leaves the function: live created handles leak."""
+        for hid, states in env.hstate.items():
+            handle = self.handles.get(hid)
+            if handle is None or handle.escaped or handle.leak_reported:
+                continue
+            if _CREATED in states:
+                handle.leak_reported = True
+                where = (
+                    f"a path exiting at line {at.lineno}" if at is not None
+                    else "a fall-through path"
+                )
+                self._report(
+                    "leak", hid, handle.site,
+                    f"shared-memory handle `{handle.var}` created here is "
+                    f"never destroyed on {where}; call "
+                    "destroy_shared_memory_region in a finally block",
+                )
+
+    # -- statement execution -------------------------------------------------
+
+    def _exec_block(self, stmts, env: _Env, raise_sink) -> Optional[_Env]:
+        """Execute statements; returns the fall-through env or None when
+        every path returned/raised. ``raise_sink`` (a list of envs) absorbs
+        exception edges when inside a try body."""
+        cur: Optional[_Env] = env
+        for stmt in stmts:
+            if cur is None:
+                break
+            cur = self._exec_stmt(stmt, cur, raise_sink)
+            if cur is not None and raise_sink is not None:
+                # Any statement may raise: snapshot the post-state as a
+                # handler-entry possibility (exception edge).
+                raise_sink.append(cur.copy())
+        return cur
+
+    def _exec_stmt(self, stmt, env: _Env, raise_sink) -> Optional[_Env]:
+        if isinstance(stmt, ast.Assign):
+            self._do_assign(stmt, env)
+            return env
+        if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            if stmt.value is not None:
+                self._scan_expr(stmt.value, env)
+            return env
+        if isinstance(stmt, ast.Expr):
+            self._scan_expr(stmt.value, env)
+            return env
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._mark_escapes(stmt.value, env)
+                self._scan_expr(stmt.value, env)
+            self._check_exit(env, at=stmt)
+            return None
+        if isinstance(stmt, ast.Raise):
+            if raise_sink is not None:
+                raise_sink.append(env.copy())
+            else:
+                self._check_exit(env, at=stmt)
+            return None
+        if isinstance(stmt, ast.If):
+            return self._do_if(stmt, env, raise_sink)
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            return self._do_for(stmt, env, raise_sink)
+        if isinstance(stmt, ast.While):
+            self._scan_expr(stmt.test, env)
+            body_out = self._exec_block(stmt.body, env.copy(), raise_sink)
+            env.join(body_out)
+            orelse_out = self._exec_block(stmt.orelse, env.copy(), raise_sink)
+            out = env
+            out.join(orelse_out)
+            return out
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._do_with(stmt, env, raise_sink)
+        if isinstance(stmt, ast.Try):
+            return self._do_try(stmt, env, raise_sink)
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return env  # nested scopes analyzed independently
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            return env  # loop approximation: treated as fall-through
+        # Default: scan contained expressions for events.
+        for sub in ast.iter_child_nodes(stmt):
+            if isinstance(sub, ast.expr):
+                self._scan_expr(sub, env)
+        return env
+
+    def _do_if(self, stmt, env, raise_sink):
+        self._scan_expr(stmt.test, env)
+        guard = self._none_guard_var(stmt.test)
+        if guard is not None and not stmt.orelse and guard in env.vars:
+            # `if h is not None: <cleanup>` — the else path is the
+            # handle-never-created world, so don't fork: forking would
+            # report the guarded cleanup as a leak path.
+            return self._exec_block(stmt.body, env, raise_sink)
+        body_out = self._exec_block(stmt.body, env.copy(), raise_sink)
+        else_out = self._exec_block(stmt.orelse, env.copy(), raise_sink)
+        if body_out is None:
+            return else_out
+        body_out.join(else_out)
+        return body_out
+
+    @staticmethod
+    def _none_guard_var(test) -> Optional[str]:
+        if isinstance(test, ast.Name):
+            return test.id
+        if (
+            isinstance(test, ast.Compare)
+            and isinstance(test.left, ast.Name)
+            and len(test.ops) == 1
+            and isinstance(test.ops[0], (ast.IsNot, ast.NotEq))
+            and len(test.comparators) == 1
+            and isinstance(test.comparators[0], ast.Constant)
+            and test.comparators[0].value is None
+        ):
+            return test.left.id
+        return None
+
+    def _do_for(self, stmt, env, raise_sink):
+        self._scan_expr(stmt.iter, env)
+        # `for h in (a, b, c):` over tracked handles: run the body once per
+        # element with the target bound — the teardown-loop idiom.
+        if (
+            isinstance(stmt.target, ast.Name)
+            and isinstance(stmt.iter, (ast.Tuple, ast.List))
+            and any(
+                isinstance(el, ast.Name) and el.id in env.vars
+                for el in stmt.iter.elts
+            )
+        ):
+            cur = env
+            for el in stmt.iter.elts:
+                if cur is None:
+                    break
+                if isinstance(el, ast.Name) and el.id in cur.vars:
+                    cur.vars[stmt.target.id] = cur.vars[el.id]
+                else:
+                    cur.vars.pop(stmt.target.id, None)
+                cur = self._exec_block(stmt.body, cur, raise_sink)
+            if cur is not None:
+                cur.vars.pop(stmt.target.id, None)
+            return cur
+        body_out = self._exec_block(stmt.body, env.copy(), raise_sink)
+        env.join(body_out)
+        orelse_out = self._exec_block(stmt.orelse, env.copy(), raise_sink)
+        env.join(orelse_out)
+        return env
+
+    def _do_with(self, stmt, env, raise_sink):
+        owned = []
+        for item in stmt.items:
+            expr = item.context_expr
+            created = None
+            if isinstance(expr, ast.Call):
+                kind = self._classify_call(expr)
+                if kind == "create" and isinstance(
+                    item.optional_vars, ast.Name
+                ):
+                    created = self._track_create(
+                        expr, item.optional_vars.id, env
+                    )
+                    for arg in expr.args:
+                        self._scan_expr(arg, env)
+                else:
+                    self._scan_expr(expr, env)
+            else:
+                self._scan_expr(expr, env)
+            if created is not None:
+                owned.append(created)
+        out = self._exec_block(stmt.body, env, raise_sink)
+        if out is not None:
+            for hid in owned:
+                # `with create(...) as h:` — the context manager owns the
+                # cleanup at block exit.
+                out.hstate[hid] = {_DESTROYED}
+        return out
+
+    def _do_try(self, stmt, env, raise_sink):
+        raised: List[_Env] = [env.copy()]
+        body_out = self._exec_block(stmt.body, env, raised)
+        handler_outs = []
+        caught = bool(stmt.handlers)
+        for handler in stmt.handlers:
+            h_in = _Env()
+            for snap in raised:
+                h_in.join(snap)
+            handler_outs.append(
+                self._exec_block(handler.body, h_in, raise_sink)
+            )
+        merged: Optional[_Env] = None
+        for candidate in [body_out] + handler_outs:
+            if candidate is None:
+                continue
+            if merged is None:
+                merged = candidate
+            else:
+                merged.join(candidate)
+        if stmt.orelse and body_out is not None:
+            merged_orelse = self._exec_block(
+                stmt.orelse, body_out.copy(), raise_sink
+            )
+            if merged is None:
+                merged = merged_orelse
+            elif merged_orelse is not None:
+                merged.join(merged_orelse)
+        if stmt.finalbody:
+            # The finally runs on the fall-through paths AND on the
+            # exceptional path that propagates past this try (no handler,
+            # or the handler re-raised): execute it over the join so a
+            # finally-cleanup counts for every path.
+            fin_in = merged if merged is not None else _Env()
+            if not caught:
+                for snap in raised:
+                    fin_in.join(snap)
+            merged = self._exec_block(stmt.finalbody, fin_in, raise_sink)
+            if merged is not None and not caught and raise_sink is None:
+                # Exception continues propagating after the finally: that
+                # is a function exit for leak purposes.
+                self._check_exit(merged, at=stmt)
+        elif not caught and raise_sink is not None:
+            for snap in raised:
+                raise_sink.append(snap)
+        return merged
+
+    # -- assignments and expressions -----------------------------------------
+
+    def _do_assign(self, stmt: ast.Assign, env: _Env):
+        value = stmt.value
+        targets = stmt.targets
+        # Tuple unpacking of parallel creates: a, b = create(...), create(...)
+        if (
+            len(targets) == 1
+            and isinstance(targets[0], ast.Tuple)
+            and isinstance(value, ast.Tuple)
+            and len(targets[0].elts) == len(value.elts)
+        ):
+            for tgt, val in zip(targets[0].elts, value.elts):
+                self._assign_one(tgt, val, env)
+            return
+        for tgt in targets:
+            self._assign_one(tgt, value, env)
+
+    def _assign_one(self, target, value, env: _Env):
+        if isinstance(value, ast.Call) and self._classify_call(value) == "create":
+            if isinstance(target, ast.Name):
+                self._track_create(value, target.id, env)
+                return
+            # Created straight into an attribute/subscript: ownership
+            # lives beyond this frame — untracked by design.
+            return
+        if isinstance(value, ast.Name) and value.id in env.vars:
+            hid = env.vars[value.id]
+            if isinstance(target, ast.Name):
+                env.vars[target.id] = hid  # alias
+                return
+            # Handle stored into an attribute/subscript/container: escapes.
+            self._escape(hid, env)
+            return
+        self._scan_expr(value, env)
+        if isinstance(target, ast.Name):
+            # Rebinding a tracked variable to something else drops the
+            # alias (the handle may live on via other aliases).
+            env.vars.pop(target.id, None)
+
+    def _track_create(self, call: ast.Call, var: str, env: _Env) -> int:
+        hid = self._next_hid
+        self._next_hid += 1
+        name_key = ""
+        if call.args:
+            name_key = self._name_key(call.args[0])
+        self.handles[hid] = _Handle(hid, var, call, name_key)
+        env.vars[var] = hid
+        env.hstate[hid] = {_CREATED}
+        if name_key:
+            env.nstate.setdefault(name_key, set())
+        return hid
+
+    @staticmethod
+    def _name_key(node) -> str:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        try:
+            return ast.dump(node)
+        except Exception:  # pragma: no cover - dump never fails on exprs
+            return ""
+
+    # -- expression scanning (events) ----------------------------------------
+
+    def _scan_expr(self, node, env: _Env):
+        # ast.walk reaches every nested Call exactly once; _handle_call
+        # therefore never recurses into arguments itself.
+        for call in [n for n in ast.walk(node) if isinstance(n, ast.Call)]:
+            self._handle_call(call, env)
+
+    def _handle_call(self, call: ast.Call, env: _Env):
+        kind = self._classify_call(call)
+        if kind == "destroy":
+            hid = self._arg_handle(call, env)
+            if hid is not None:
+                states = env.hstate.get(hid, set())
+                handle = self.handles[hid]
+                if _DESTROYED in states:
+                    self._report(
+                        "double-destroy", hid, call,
+                        f"handle `{handle.var}` may already be destroyed on "
+                        "a path reaching this destroy_shared_memory_region",
+                    )
+                if handle.name_key:
+                    nstates = env.nstate.get(handle.name_key, set())
+                    if nstates == {_REGISTERED}:
+                        self._report(
+                            "destroy-registered", hid, call,
+                            f"handle `{handle.var}` is destroyed while its "
+                            "region is still registered with the server; "
+                            "unregister it first",
+                        )
+                env.hstate[hid] = {_DESTROYED}
+            return
+        if kind == "use":
+            hid = self._arg_handle(call, env)
+            if hid is not None:
+                self._check_use(hid, call, env)
+            return
+        if kind == "register":
+            name_key = self._name_key(call.args[0]) if call.args else ""
+            if name_key:
+                states = env.nstate.get(name_key)
+                if states == {_REGISTERED}:
+                    self._report(
+                        "double-register", name_key, call,
+                        f"region {self._name_desc(call.args[0])} is "
+                        "registered twice without an intervening unregister",
+                    )
+                env.nstate[name_key] = {_REGISTERED}
+            return
+        if kind == "unregister":
+            if call.args and not (
+                isinstance(call.args[0], ast.Constant)
+                and call.args[0].value == ""
+            ):
+                name_key = self._name_key(call.args[0])
+                if name_key:
+                    env.nstate[name_key] = {_UNREGISTERED}
+            else:
+                # unregister-all
+                for name_key in env.nstate:
+                    env.nstate[name_key] = {_UNREGISTERED}
+            return
+        # Method call on a tracked handle variable: a use.
+        func = call.func
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id in env.vars
+        ):
+            self._check_use(env.vars[func.value.id], call, env)
+            for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                self._mark_escapes(arg, env)
+            return
+        # Any other call: tracked handles passed as arguments escape
+        # (ownership transfer: cleanup helpers, ExitStack, containers).
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            self._mark_escapes(arg, env)
+
+    def _check_use(self, hid: int, call: ast.Call, env: _Env):
+        handle = self.handles[hid]
+        states = env.hstate.get(hid, set())
+        if _DESTROYED in states:
+            self._report(
+                "use-after-destroy", hid, call,
+                f"handle `{handle.var}` may be used after "
+                "destroy_shared_memory_region on a path reaching this call",
+            )
+        if handle.name_key:
+            nstates = env.nstate.get(handle.name_key, set())
+            if _UNREGISTERED in nstates and _REGISTERED not in nstates:
+                self._report(
+                    "use-after-unregister", hid, call,
+                    f"handle `{handle.var}` is used after its region was "
+                    "unregistered from the server; re-register it or move "
+                    "the use before the unregister",
+                )
+
+    def _arg_handle(self, call: ast.Call, env: _Env) -> Optional[int]:
+        for arg in call.args[:1]:
+            if isinstance(arg, ast.Name) and arg.id in env.vars:
+                return env.vars[arg.id]
+        return None
+
+    @staticmethod
+    def _name_desc(node) -> str:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return repr(node.value)
+        return "named by this expression"
+
+    def _mark_escapes(self, node, env: _Env):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and sub.id in env.vars:
+                self._escape(env.vars[sub.id], env)
+
+    def _escape(self, hid: int, env: _Env):
+        self.handles[hid].escaped = True
+
+    # -- call classification ---------------------------------------------------
+
+    def _classify_call(self, call: ast.Call) -> Optional[str]:
+        name = self.ctx.canonical_call_name(call.func)
+        tail = None
+        if name is not None:
+            tail = name.rsplit(".", 1)[-1]
+        elif isinstance(call.func, ast.Attribute):
+            tail = call.func.attr
+        if tail is None:
+            return None
+        if tail in _CREATE_FNS:
+            return "create"
+        if tail in _DESTROY_FNS:
+            return "destroy"
+        if tail in _USE_FNS:
+            return "use"
+        if tail in _REGISTER_METHODS:
+            return "register"
+        if tail in _UNREGISTER_METHODS:
+            return "unregister"
+        return None

@@ -1,0 +1,518 @@
+"""TPU007: lock-order deadlock detection (project-wide).
+
+Builds the lock-acquisition graph across every linted file and reports any
+cycle as a potential deadlock, citing both acquisition sites. Nodes are
+lock *declarations*:
+
+* instance locks — ``self.X = threading.Lock()/RLock()/Condition()`` (or
+  the asyncio equivalents) inside a class, identified as ``Class.X``;
+* module locks — ``NAME = threading.Lock()`` at module scope, identified
+  as ``module:NAME``.
+
+Edges mean "B can be acquired while A is held" and come from two sources:
+
+* lexical nesting — a ``with <B>:`` inside a ``with <A>:`` block;
+* calls under a lock — a call made while holding A to a function or
+  method whose *transitive* acquisitions (computed by fixpoint over the
+  project call graph) include B. Call targets resolve through ``self``
+  method calls, instance attributes with known constructor types
+  (``self.x = D(...)``), annotated parameters (``def f(h: D)``), locally
+  constructed objects (``x = D(...)``), and imported module functions.
+
+Because node identity is the declaration (not the instance), an edge
+``A -> A`` is also reported when A is a non-reentrant ``threading.Lock``:
+re-acquiring the same declaration either self-deadlocks (same instance)
+or is an ordering hazard between sibling instances.
+
+Suppress a deliberate ordering (e.g. a leaf lock provably never taken
+first) with ``# tpulint: disable=TPU007`` on the inner ``with`` line.
+"""
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tritonclient_tpu.analysis._engine import FileContext, Finding, Rule
+
+_LOCK_FACTORIES = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+    "asyncio.Lock": "Lock",
+    "asyncio.Condition": "Condition",
+}
+
+
+class _LockNode:
+    __slots__ = ("key", "kind", "path", "line")
+
+    def __init__(self, key, kind, path, line):
+        self.key = key    # "Class.attr" or "module:NAME"
+        self.kind = kind  # factory kind: Lock | RLock | Condition
+        self.path = path
+        self.line = line
+
+
+class _Site:
+    """One acquisition: which lock, where, inside which function."""
+
+    __slots__ = ("lock", "path", "line", "col")
+
+    def __init__(self, lock, path, line, col):
+        self.lock = lock
+        self.path = path
+        self.line = line
+        self.col = col
+
+
+class LockOrderRule(Rule):
+    id = "TPU007"
+    name = "lock-order"
+    description = (
+        "cycle in the project-wide lock-acquisition graph (with-nesting "
+        "plus calls made while holding a lock) — potential deadlock"
+    )
+
+    def check_project(self, ctxs: Sequence[FileContext]) -> List[Finding]:
+        graph = _LockGraph(ctxs)
+        graph.build()
+        return graph.report()
+
+
+class _LockGraph:
+    def __init__(self, ctxs):
+        self.ctxs = list(ctxs)
+        self.locks: Dict[str, _LockNode] = {}
+        # class name -> {attr -> lock key}
+        self.class_locks: Dict[str, Dict[str, str]] = {}
+        # class name -> {attr -> class name} (instance attribute types)
+        self.attr_types: Dict[str, Dict[str, str]] = {}
+        self.known_classes: Set[str] = set()
+        # function key ("Class.meth" | "module:fn") -> direct lock keys
+        self.direct: Dict[str, Set[str]] = {}
+        # function key -> list of (callee key, held lock keys, call node, ctx)
+        self.calls: Dict[str, List[Tuple[str, Tuple[str, ...], ast.Call, FileContext]]] = {}
+        # edges: (a, b) -> (outer site, inner site, via text)
+        self.edges: Dict[Tuple[str, str], Tuple[_Site, _Site, str]] = {}
+
+    # -- helpers ---------------------------------------------------------------
+
+    @staticmethod
+    def _modkey(ctx: FileContext) -> str:
+        stem = os.path.basename(ctx.path)
+        if stem == "__init__.py":
+            stem = os.path.basename(os.path.dirname(ctx.path)) or stem
+        return stem[:-3] if stem.endswith(".py") else stem
+
+    def _lock_factory_kind(self, ctx, value) -> Optional[str]:
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Call):
+                name = ctx.canonical_call_name(sub.func)
+                if name in _LOCK_FACTORIES:
+                    return _LOCK_FACTORIES[name]
+        return None
+
+    # -- pass 1: declarations --------------------------------------------------
+
+    def build(self):
+        for ctx in self.ctxs:
+            self._collect_declarations(ctx)
+        for ctx in self.ctxs:
+            self._collect_functions(ctx)
+        self._propagate()
+        self._edges_from_calls()
+
+    def _collect_declarations(self, ctx):
+        modkey = self._modkey(ctx)
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign):
+                kind = self._lock_factory_kind(ctx, node.value)
+                if kind:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            key = f"{modkey}:{tgt.id}"
+                            self.locks[key] = _LockNode(
+                                key, kind, ctx.path, node.lineno
+                            )
+        for cls in [n for n in ast.walk(ctx.tree) if isinstance(n, ast.ClassDef)]:
+            self.known_classes.add(cls.name)
+            locks = self.class_locks.setdefault(cls.name, {})
+            types = self.attr_types.setdefault(cls.name, {})
+            # `self.x = <annotated param>` gives x the parameter's type.
+            for meth in cls.body:
+                if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                ptypes = self._param_types(meth)
+                for node in ast.walk(meth):
+                    if (
+                        isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id in ptypes
+                    ):
+                        for tgt in node.targets:
+                            if (
+                                isinstance(tgt, ast.Attribute)
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id == "self"
+                            ):
+                                types[tgt.attr] = ptypes[node.value.id]
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.Assign):
+                    continue
+                kind = self._lock_factory_kind(ctx, node.value)
+                ctor = self._ctor_class(ctx, node.value)
+                for tgt in node.targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        if kind:
+                            key = f"{cls.name}.{tgt.attr}"
+                            locks[tgt.attr] = key
+                            self.locks[key] = _LockNode(
+                                key, kind, ctx.path, node.lineno
+                            )
+                        elif ctor:
+                            types[tgt.attr] = ctor
+                    elif isinstance(tgt, ast.Subscript):
+                        # self._batchers[name] = _DynamicBatcher(...) —
+                        # values of the container share the ctor type; keyed
+                        # under the container attr for x.attr[...] lookups.
+                        base = tgt.value
+                        if (
+                            ctor
+                            and isinstance(base, ast.Attribute)
+                            and isinstance(base.value, ast.Name)
+                            and base.value.id == "self"
+                        ):
+                            types[base.attr] = ctor
+
+    def _ctor_class(self, ctx, value) -> Optional[str]:
+        """Class name when ``value`` constructs a project class."""
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Call):
+                name = ctx.canonical_call_name(sub.func)
+                if name is None:
+                    continue
+                tail = name.rsplit(".", 1)[-1]
+                if tail and tail[0].isupper():
+                    return tail
+        return None
+
+    # -- pass 2: per-function acquisitions and calls ---------------------------
+
+    def _collect_functions(self, ctx):
+        # known_classes must include every project class before type
+        # resolution, so this runs as a second pass.
+        modkey = self._modkey(ctx)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            cls = ctx.enclosing_class(node)
+            if cls is not None and ctx.enclosing_function(node) is not None:
+                continue  # nested def: analyzed as part of context anyway
+            if cls is not None:
+                fkey = f"{cls.name}.{node.name}"
+            else:
+                fkey = f"{modkey}:{node.name}"
+            self.direct.setdefault(fkey, set())
+            self.calls.setdefault(fkey, [])
+            var_types = self._param_types(node)
+            self._walk_body(
+                ctx, node, node.body, cls, fkey, var_types, held=[]
+            )
+
+    def _param_types(self, func) -> Dict[str, str]:
+        out = {}
+        args = list(func.args.posonlyargs) + list(func.args.args) + list(
+            func.args.kwonlyargs
+        )
+        for arg in args:
+            ann = arg.annotation
+            if isinstance(ann, ast.Name):
+                name = ann.id
+            elif isinstance(ann, ast.Attribute):
+                name = ann.attr
+            elif isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+                name = ann.value.rsplit(".", 1)[-1]
+            else:
+                continue
+            # No known-class filter: unknown types resolve to nothing later,
+            # and filtering here would be declaration-order dependent.
+            out[arg.arg] = name
+        return out
+
+    def _walk_body(self, ctx, func, stmts, cls, fkey, var_types, held):
+        for stmt in stmts:
+            self._walk_stmt(ctx, func, stmt, cls, fkey, var_types, held)
+
+    def _walk_stmt(self, ctx, func, stmt, cls, fkey, var_types, held):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Nested function: its body runs later (callback/executor);
+            # locks held HERE are not held THERE.
+            self._walk_body(
+                ctx, func, stmt.body, cls, fkey, dict(var_types), held=[]
+            )
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return
+        if isinstance(stmt, ast.Assign):
+            ctor = self._ctor_class(ctx, stmt.value)
+            if ctor:
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        var_types[tgt.id] = ctor
+            self._scan_calls(ctx, stmt, cls, fkey, var_types, held)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            acquired: List[_Site] = []
+            for item in stmt.items:
+                lock = self._resolve_lock_expr(
+                    ctx, item.context_expr, cls, var_types
+                )
+                if lock is not None:
+                    site = _Site(
+                        lock, ctx.path,
+                        item.context_expr.lineno, item.context_expr.col_offset,
+                    )
+                    self.direct[fkey].add(lock)
+                    for outer in held:
+                        self._add_edge(outer, site, via="nested with")
+                    acquired.append(site)
+                else:
+                    self._scan_expr_calls(
+                        ctx, item.context_expr, cls, fkey, var_types, held
+                    )
+            self._walk_body(
+                ctx, func, stmt.body, cls, fkey, var_types, held + acquired
+            )
+            return
+        if isinstance(stmt, ast.If):
+            # isinstance() narrowing: inside `if isinstance(x, T):` the
+            # branch body sees x as a T, which resolves method calls in
+            # type-dispatch helpers.
+            narrowed = self._isinstance_narrow(ctx, stmt.test)
+            self._scan_calls(ctx, stmt, cls, fkey, var_types, held)
+            body_types = dict(var_types)
+            if narrowed:
+                body_types.update(narrowed)
+            self._walk_body(ctx, func, stmt.body, cls, fkey, body_types, held)
+            self._walk_body(ctx, func, stmt.orelse, cls, fkey, var_types, held)
+            return
+        # Generic statement: scan expressions for calls, recurse into
+        # compound bodies with the same held stack.
+        self._scan_calls(ctx, stmt, cls, fkey, var_types, held)
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if sub:
+                self._walk_body(ctx, func, sub, cls, fkey, var_types, held)
+        for handler in getattr(stmt, "handlers", []) or []:
+            self._walk_body(
+                ctx, func, handler.body, cls, fkey, var_types, held
+            )
+
+    def _isinstance_narrow(self, ctx, test) -> Dict[str, str]:
+        if (
+            isinstance(test, ast.Call)
+            and isinstance(test.func, ast.Name)
+            and test.func.id == "isinstance"
+            and len(test.args) == 2
+            and isinstance(test.args[0], ast.Name)
+        ):
+            type_arg = test.args[1]
+            if isinstance(type_arg, ast.Name):
+                return {test.args[0].id: type_arg.id}
+            if isinstance(type_arg, ast.Attribute):
+                return {test.args[0].id: type_arg.attr}
+        return {}
+
+    def _scan_calls(self, ctx, stmt, cls, fkey, var_types, held):
+        for field, value in ast.iter_fields(stmt):
+            if field in ("body", "orelse", "finalbody", "handlers"):
+                continue
+            nodes = value if isinstance(value, list) else [value]
+            for node in nodes:
+                if isinstance(node, ast.AST):
+                    self._scan_expr_calls(ctx, node, cls, fkey, var_types, held)
+
+    def _scan_expr_calls(self, ctx, expr, cls, fkey, var_types, held):
+        for call in [n for n in ast.walk(expr) if isinstance(n, ast.Call)]:
+            callee = self._resolve_callee(ctx, call, cls, var_types)
+            if callee is not None:
+                self.calls[fkey].append((callee, tuple(held), call, ctx))
+
+    # -- resolution ------------------------------------------------------------
+
+    def _resolve_lock_expr(self, ctx, expr, cls, var_types) -> Optional[str]:
+        # self.X
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+        ):
+            base, attr = expr.value.id, expr.attr
+            if base == "self" and cls is not None:
+                key = self.class_locks.get(cls.name, {}).get(attr)
+                if key:
+                    return key
+            # typed variable / parameter: var.X
+            vtype = var_types.get(base)
+            if vtype:
+                return self.class_locks.get(vtype, {}).get(attr)
+            return None
+        # self.attr.X — attribute of a typed instance attribute
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Attribute)
+            and isinstance(expr.value.value, ast.Name)
+            and expr.value.value.id == "self"
+            and cls is not None
+        ):
+            vtype = self.attr_types.get(cls.name, {}).get(expr.value.attr)
+            if vtype:
+                return self.class_locks.get(vtype, {}).get(expr.attr)
+            return None
+        # bare NAME — module lock (this module or imported)
+        if isinstance(expr, ast.Name):
+            key = f"{self._modkey(ctx)}:{expr.id}"
+            if key in self.locks:
+                return key
+            target = ctx.aliases.get(expr.id)
+            if target:
+                mod, _, name = target.rpartition(".")
+                modstem = mod.rsplit(".", 1)[-1] if mod else ""
+                key = f"{modstem}:{name}"
+                if key in self.locks:
+                    return key
+        return None
+
+    def _resolve_callee(self, ctx, call, cls, var_types) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+            base, meth = func.value.id, func.attr
+            if base == "self" and cls is not None:
+                # Unconditional: methods not yet collected resolve to a key
+                # with no transitive locks, which is harmless.
+                return f"{cls.name}.{meth}"
+            vtype = var_types.get(base)
+            if vtype:
+                return f"{vtype}.{meth}"
+            # module.function through an import alias
+            target = ctx.aliases.get(base)
+            if target:
+                modstem = target.rsplit(".", 1)[-1]
+                return f"{modstem}:{meth}"
+            return None
+        if isinstance(func, ast.Attribute):
+            # self.attr.m() / obj.sub.m()
+            inner = func.value
+            if (
+                isinstance(inner, ast.Attribute)
+                and isinstance(inner.value, ast.Name)
+                and inner.value.id == "self"
+                and cls is not None
+            ):
+                vtype = self.attr_types.get(cls.name, {}).get(inner.attr)
+                if vtype:
+                    return f"{vtype}.{func.attr}"
+            return None
+        if isinstance(func, ast.Name):
+            target = ctx.aliases.get(func.id)
+            if target:
+                mod, _, name = target.rpartition(".")
+                modstem = mod.rsplit(".", 1)[-1] if mod else ""
+                return f"{modstem}:{name}" if modstem else None
+            if func.id in self.known_classes:
+                return f"{func.id}.__init__"
+            return f"{self._modkey(ctx)}:{func.id}"
+        return None
+
+    # -- fixpoint + edges ------------------------------------------------------
+
+    def _propagate(self):
+        """trans[f] = locks f may acquire, directly or via its callees."""
+        self.trans: Dict[str, Set[str]] = {
+            f: set(locks) for f, locks in self.direct.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for fkey, calls in self.calls.items():
+                mine = self.trans.setdefault(fkey, set())
+                for callee, _, _, _ in calls:
+                    extra = self.trans.get(callee)
+                    if extra and not extra <= mine:
+                        mine |= extra
+                        changed = True
+
+    def _edges_from_calls(self):
+        for fkey, calls in self.calls.items():
+            for callee, held, call, ctx in calls:
+                if not held:
+                    continue
+                inner_locks = self.trans.get(callee) or ()
+                for b in inner_locks:
+                    site = _Site(b, ctx.path, call.lineno, call.col_offset)
+                    for a in held:
+                        self._add_edge(a, site, via=f"call to {callee}")
+
+    def _add_edge(self, outer: _Site, inner: _Site, via: str):
+        a, b = outer.lock, inner.lock
+        if a == b and self.locks.get(a) and self.locks[a].kind != "Lock":
+            return  # re-entrant (RLock/Condition): same-node re-entry is fine
+        self.edges.setdefault((a, b), (outer, inner, via))
+
+    # -- cycle reporting -------------------------------------------------------
+
+    def report(self) -> List[Finding]:
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, set()).add(b)
+        findings = []
+        reported: Set[frozenset] = set()
+        for (a, b) in sorted(self.edges):
+            if a == b:
+                cycle = [a, a]
+            else:
+                path = self._find_path(adj, b, a)
+                if path is None:
+                    continue
+                cycle = [a] + path
+            key = frozenset(cycle)
+            if key in reported:
+                continue
+            reported.add(key)
+            findings.extend(self._cycle_findings(cycle))
+        return findings
+
+    def _find_path(self, adj, src, dst) -> Optional[List[str]]:
+        seen = {src}
+        stack = [(src, [src])]
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in sorted(adj.get(node, ())):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def _cycle_findings(self, cycle: List[str]) -> List[Finding]:
+        """One finding per acquisition site participating in the cycle."""
+        findings = []
+        order = " -> ".join(cycle)
+        for a, b in zip(cycle, cycle[1:]):
+            outer, inner, via = self.edges[(a, b)]
+            findings.append(
+                Finding(
+                    LockOrderRule.id,
+                    inner.path,
+                    inner.line,
+                    inner.col,
+                    f"lock-order cycle {order}: `{b}` is acquired here "
+                    f"({via}) while `{a}` is held "
+                    f"(held since {outer.path}:{outer.line})",
+                )
+            )
+        return findings

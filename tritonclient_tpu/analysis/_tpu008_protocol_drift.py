@@ -1,0 +1,241 @@
+"""TPU008: client/server protocol-drift conformance (project-wide).
+
+The KServe v2 wire vocabulary lives in ``protocol/_literals.py``; the
+*usage* of that vocabulary is split across four surfaces per transport
+plane: the sync and aio clients build tensor/parameter dicts (HTTP JSON)
+or proto maps (gRPC), and the matching server front-end parses them. A
+key added on one side without the other is exactly the drift that used to
+surface only as a runtime 400.
+
+This rule diffs actual key usage per plane:
+
+* **plane symmetry** — for every *tensor-scope* canonical key (the keys
+  that change how tensor bytes are routed or encoded: the shared-memory
+  trio, the binary-data family, ``classification``), the set referenced
+  by a plane's client modules must equal the set referenced by that
+  plane's server front-end. Request-level parameter keys
+  (``RESERVED_REQUEST_PARAMS``, repository controls, stream markers) are
+  exempt: the front-ends forward them wholesale into
+  ``CoreRequest.parameters``.
+* **trio requiredness** — a side of a plane that references
+  ``shared_memory_region`` must also reference
+  ``shared_memory_byte_size`` and ``shared_memory_offset``: parsing the
+  region name while ignoring its offset misreads every nonzero-offset
+  tensor.
+
+References are counted from ``KEY_*`` names and ``.KEY_*`` attributes
+used *outside* import statements (an unused import is not conformance),
+plus raw string literals equal to a canonical key value (drift through a
+respelled literal still counts as usage — TPU003 flags the respelling
+itself). The canonical set is parsed from a linted ``_literals.py`` when
+present, else imported.
+
+Findings are reported on the file that HAS the key, naming the side that
+lacks it — the fix is either to parse the key on the missing side or to
+remove it from the producing side.
+"""
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tritonclient_tpu.analysis._engine import FileContext, Finding, Rule
+
+#: Keys whose values ride CoreRequest.parameters wholesale: the server
+#: never names them, so no server-side reference is owed. Kept in sync
+#: with RESERVED_REQUEST_PARAMS plus repository/stream controls.
+_PASSTHROUGH_KEYS = {
+    "sequence_id",
+    "sequence_start",
+    "sequence_end",
+    "priority",
+    "timeout",
+    "unload_dependents",
+    # gRPC decoupled-stream markers: request-side read by the stream
+    # servicer, response-side surfaced to user callbacks generically.
+    "triton_enable_empty_final_response",
+    "triton_final_response",
+}
+
+_SHM_TRIO = (
+    "shared_memory_region",
+    "shared_memory_byte_size",
+    "shared_memory_offset",
+)
+
+
+class _Side:
+    """Key usage of one (plane, side): key -> first (path, line) seen."""
+
+    def __init__(self, label: str):
+        self.label = label
+        self.uses: Dict[str, Tuple[str, int]] = {}
+        self.files: Set[str] = set()
+
+    def add(self, key: str, path: str, line: int):
+        self.uses.setdefault(key, (path, line))
+        self.files.add(path)
+
+
+class ProtocolDriftRule(Rule):
+    id = "TPU008"
+    name = "protocol-drift"
+    description = (
+        "wire key built by a plane's client but not parsed by its server "
+        "front-end (or vice versa), or an incomplete shared-memory key trio"
+    )
+
+    def check_project(self, ctxs: Sequence[FileContext]) -> List[Finding]:
+        canonical = self._canonical_keys(ctxs)
+        if not canonical:
+            return []
+        sides: Dict[str, _Side] = {
+            "http-client": _Side("HTTP client"),
+            "http-server": _Side("HTTP server front-end"),
+            "grpc-client": _Side("gRPC client"),
+            "grpc-server": _Side("gRPC server front-end"),
+        }
+        for ctx in ctxs:
+            side = self._side_of(ctx.path)
+            if side is None:
+                continue
+            for key, line in self._key_references(ctx, canonical):
+                sides[side].add(key, ctx.path, line)
+
+        findings: List[Finding] = []
+        tensor_keys = canonical - _PASSTHROUGH_KEYS
+        for plane in ("http", "grpc"):
+            client = sides[f"{plane}-client"]
+            server = sides[f"{plane}-server"]
+            if not client.files or not server.files:
+                continue  # plane not present in the linted set
+            cset = set(client.uses) & tensor_keys
+            sset = set(server.uses) & tensor_keys
+            for key in sorted(cset - sset):
+                path, line = client.uses[key]
+                findings.append(
+                    Finding(
+                        self.id, path, line, 0,
+                        f"wire key '{key}' is built by the {client.label} "
+                        f"but never parsed by the {server.label} "
+                        f"({plane} plane) — protocol drift",
+                    )
+                )
+            for key in sorted(sset - cset):
+                path, line = server.uses[key]
+                findings.append(
+                    Finding(
+                        self.id, path, line, 0,
+                        f"wire key '{key}' is parsed by the {server.label} "
+                        f"but never built by the {client.label} "
+                        f"({plane} plane) — protocol drift",
+                    )
+                )
+            for side in (client, server):
+                present = [k for k in _SHM_TRIO if k in side.uses]
+                missing = [
+                    k for k in _SHM_TRIO
+                    if k in canonical and k not in side.uses
+                ]
+                if present and missing:
+                    path, line = side.uses[present[0]]
+                    findings.append(
+                        Finding(
+                            self.id, path, line, 0,
+                            f"the {side.label} ({plane} plane) references "
+                            f"'{present[0]}' but not "
+                            f"{', '.join(repr(k) for k in missing)} — "
+                            "incomplete shared-memory key trio "
+                            "(nonzero offsets/sizes would be ignored)",
+                        )
+                    )
+        return findings
+
+    # -- canonical vocabulary --------------------------------------------------
+
+    def _canonical_keys(self, ctxs) -> Set[str]:
+        for ctx in ctxs:
+            if not ctx.path.endswith("_literals.py"):
+                continue
+            keys = {
+                node.value.value
+                for node in ctx.tree.body
+                if isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+                and any(
+                    isinstance(t, ast.Name) and t.id.startswith("KEY_")
+                    for t in node.targets
+                )
+            }
+            if keys:
+                return keys
+        try:
+            from tritonclient_tpu.protocol import _literals
+        except ImportError:  # pragma: no cover - package always importable
+            return set()
+        return {
+            value
+            for name, value in vars(_literals).items()
+            if name.startswith("KEY_") and isinstance(value, str)
+        }
+
+    # -- scope classification --------------------------------------------------
+
+    @staticmethod
+    def _side_of(path: str) -> Optional[str]:
+        p = "/" + path.lstrip("/")
+        if p.endswith("_literals.py"):
+            return None  # the definition site
+        if "/server/" in p:
+            name = p.rsplit("/", 1)[-1]
+            if name == "_http.py":
+                return "http-server"
+            if name == "_grpc.py":
+                return "grpc-server"
+            return None
+        if "/http/" in p:
+            return "http-client"
+        if "/grpc/" in p:
+            return "grpc-client"
+        return None
+
+    # -- reference collection --------------------------------------------------
+
+    def _key_references(self, ctx, canonical: Set[str]):
+        """Yield (canonical key, line) for every non-import usage."""
+        import_lines: Set[int] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                for line in range(
+                    node.lineno, (node.end_lineno or node.lineno) + 1
+                ):
+                    import_lines.add(line)
+        # KEY_* constant -> value, resolved through this file's imports
+        # (the canonical spelling) or the literal module's convention.
+        try:
+            from tritonclient_tpu.protocol import _literals
+            key_values = {
+                name: value
+                for name, value in vars(_literals).items()
+                if name.startswith("KEY_") and isinstance(value, str)
+            }
+        except ImportError:  # pragma: no cover
+            key_values = {}
+        for node in ast.walk(ctx.tree):
+            if getattr(node, "lineno", None) in import_lines:
+                continue
+            if isinstance(node, ast.Name) and node.id.startswith("KEY_"):
+                value = key_values.get(node.id)
+                if value in canonical:
+                    yield value, node.lineno
+            elif isinstance(node, ast.Attribute) and node.attr.startswith("KEY_"):
+                value = key_values.get(node.attr)
+                if value in canonical:
+                    yield value, node.lineno
+            elif (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and node.value in canonical
+                and not ctx.is_docstring(node)
+            ):
+                yield node.value, node.lineno

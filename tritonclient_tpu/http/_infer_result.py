@@ -11,7 +11,6 @@ import zlib
 from typing import List, Optional
 from tritonclient_tpu.protocol._literals import (
     KEY_BINARY_DATA_SIZE,
-    KEY_SHM_BYTE_SIZE,
     KEY_SHM_REGION,
 )
 
@@ -71,6 +70,11 @@ class InferResult:
     def as_numpy(self, name: str, bf16_native: bool = False) -> Optional[np.ndarray]:
         output = self._get_output(name)
         if output is None:
+            return None
+        if KEY_SHM_REGION in output.get("parameters", {}):
+            # Tensor bytes live in the registered region, not the response;
+            # the caller reads them via shared_memory.get_contents_as_numpy
+            # (same contract as the gRPC InferResult).
             return None
         datatype = output["datatype"]
         shape = list(output["shape"])

@@ -74,6 +74,14 @@ def serve_sharded_bert_roundtrip(mesh, seq_len: int = 64,
                 out_region, "FP32", (b, cfg.d_model), 0
             )
         finally:
+            if client is not None:
+                # Unregister before destroy: tearing down a region the
+                # server still maps would leave a dangling registry entry
+                # (TPU006 destroy-while-registered).
+                try:
+                    client.unregister_tpu_shared_memory()
+                except Exception:
+                    pass  # server may already be down; destroy regardless
             for region in (in_region, out_region):
                 if region is not None:
                     tpushm.destroy_shared_memory_region(region)

@@ -134,13 +134,23 @@ class SystemShmRegistry:
                 f"Unable to open shared memory region: '{name}' ({e})", 400
             )
         try:
-            mm = mmap.mmap(fd, 0)
-        finally:
-            os.close(fd)
+            try:
+                mm = mmap.mmap(fd, 0)
+            finally:
+                os.close(fd)
+        except (OSError, ValueError) as e:
+            # mmap of an empty/truncated object: a protocol error, not a
+            # server fault — and never a leaked fd (closed above).
+            raise CoreError(
+                f"Unable to map shared memory region: '{name}' ({e})", 400
+            )
         with self._lock:
-            if name in self._regions:
-                old = self._regions.pop(name)
-                old["mmap"].close()
+            # Insert the new mapping BEFORE closing a replaced one: if the
+            # old close raises (BufferError while a reader still holds an
+            # exported buffer), the registry must not end up holding
+            # neither mapping — that was an error-path leak of `mm` (TPU006
+            # register/replace discipline).
+            old = self._regions.get(name)
             self._regions[name] = {
                 "name": name,
                 "key": key,
@@ -149,6 +159,12 @@ class SystemShmRegistry:
                 "mmap": mm,
             }
             self.generation += 1
+        if old is not None:
+            try:
+                old["mmap"].close()
+            except BufferError:
+                pass  # exported buffers keep the old mapping alive; the
+                # view is dropped from the registry either way
 
     def __contains__(self, name: str) -> bool:
         # GIL-atomic dict membership; safe without the lock on the hot path.
@@ -160,7 +176,15 @@ class SystemShmRegistry:
             for n in names:
                 region = self._regions.pop(n, None)
                 if region is not None:
-                    region["mmap"].close()
+                    try:
+                        region["mmap"].close()
+                    except BufferError:
+                        # A reader still holds an exported buffer
+                        # (np.frombuffer over the mapping). The mapping
+                        # closes when the last view dies; aborting the
+                        # loop here used to strand every remaining region
+                        # registered with the generation un-bumped.
+                        pass
             self.generation += 1
 
     def status(self, name: Optional[str] = None) -> List[dict]:

@@ -214,7 +214,12 @@ def mapped_shared_memory_regions() -> List[str]:
 
 def destroy_shared_memory_region(shm_handle: SharedMemoryRegion):
     """Unmap and unlink the region."""
-    _mapped_regions.pop(shm_handle.triton_shm_name, None)
     handle, shm_handle._c_handle = shm_handle._c_handle, None
     if handle is not None:
+        # Destroy BEFORE dropping the registry entry: a failed native
+        # unmap/unlink must leave the region listed (it still exists in
+        # /dev/shm), not silently forgotten — the error-path leak TPU006
+        # polices. The handle swap above stays first so a second destroy
+        # of the same handle is a no-op rather than a double-free.
         _check(_get_lib().TpuShmRegionDestroy(handle))
+    _mapped_regions.pop(shm_handle.triton_shm_name, None)

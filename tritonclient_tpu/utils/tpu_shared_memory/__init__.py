@@ -884,9 +884,13 @@ def allocated_shared_memory_regions() -> List[str]:
 
 
 def destroy_shared_memory_region(shm_handle: TpuSharedMemoryRegion):
+    # Drop the registry entry FIRST: a co-located server resolving raw
+    # handles must never find a region that is mid-teardown. The two lock
+    # scopes stay disjoint (never nested) so the project lock-order graph
+    # (tpulint TPU007) keeps registry and region locks unordered.
+    with _registry_lock:
+        _registry.pop(shm_handle.uuid, None)
     with shm_handle._lock:
         shm_handle._destroyed = True
         shm_handle._parked.clear()
         shm_handle._mirror = bytearray(0)
-    with _registry_lock:
-        _registry.pop(shm_handle.uuid, None)
