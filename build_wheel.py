@@ -24,14 +24,31 @@ REPO = pathlib.Path(__file__).resolve().parent
 
 
 def build_native(build_dir: pathlib.Path) -> None:
-    """cmake-build the native tree; libtpushm.so lands in _lib by cmake rule."""
-    gen = ["-G", "Ninja"] if shutil.which("ninja") else []
-    subprocess.run(
-        ["cmake", "-S", str(REPO / "native"), "-B", str(build_dir), *gen],
-        check=True,
-    )
-    subprocess.run(["cmake", "--build", str(build_dir)], check=True)
+    """Build libtpushm.so into _lib on demand.
+
+    The artifact is never committed (gitignored; loaded/built lazily at
+    first use by ``tritonclient_tpu._lib.load_tpushm``): the wheel build
+    produces it here — cmake when available (the full native tree,
+    matching CI), else the same direct g++ fallback first-use builds use.
+    """
     built = REPO / "tritonclient_tpu" / "_lib" / "libtpushm.so"
+    if shutil.which("cmake"):
+        gen = ["-G", "Ninja"] if shutil.which("ninja") else []
+        subprocess.run(
+            ["cmake", "-S", str(REPO / "native"), "-B", str(build_dir), *gen],
+            check=True,
+        )
+        subprocess.run(["cmake", "--build", str(build_dir)], check=True)
+    else:
+        from tritonclient_tpu._lib import _try_build
+
+        if _try_build() is None:
+            raise SystemExit(
+                "native build failed: neither cmake nor a working g++ "
+                "toolchain is available (pass --no-native for a pure-"
+                "python wheel; the library then builds at first use on "
+                "the target machine)"
+            )
     if not built.exists():
         raise SystemExit(f"native build did not produce {built}")
 
@@ -101,7 +118,8 @@ def main(argv=None) -> int:
     parser.add_argument("--dest-dir", default="dist")
     parser.add_argument(
         "--no-native", action="store_true",
-        help="skip the cmake build (use the committed libtpushm.so)",
+        help="skip the native build (ship a pure-python wheel; libtpushm "
+             ".so is built on demand at first use — it is never committed)",
     )
     parser.add_argument(
         "--linux", action="store_true",

@@ -1,7 +1,13 @@
 #!/usr/bin/env bash
 # Single static-analysis entry point shared by CI and tier-1.
 #
-#   scripts/run_static_checks.sh [--write-baseline] [paths...]
+#   scripts/run_static_checks.sh [--write-baseline] [--sanitize] [paths...]
+#
+# --sanitize closes the static/dynamic loop: after the static checks it
+# runs the tpusan-instrumented tier-1 subset (TPUSAN=1, the runtime
+# sanitizer witnessing TPU001/TPU006/TPU007 under execution — see the
+# README "Runtime sanitizers" subsection), writes the runtime report,
+# and diffs it against the static picture with scripts/tpusan_report.py.
 #
 # Chains, in order:
 #   1. tpulint        — project-specific checks (TPU001..TPU008); see
@@ -33,10 +39,14 @@ PYTHON="${PYTHON:-python}"
 BASELINE_FILE="scripts/tpulint_baseline.json"
 
 WRITE_BASELINE=0
-if [ "${1:-}" = "--write-baseline" ]; then
-    WRITE_BASELINE=1
-    shift
-fi
+SANITIZE=0
+while :; do
+    case "${1:-}" in
+        --write-baseline) WRITE_BASELINE=1; shift ;;
+        --sanitize) SANITIZE=1; shift ;;
+        *) break ;;
+    esac
+done
 
 PATHS=("$@")
 if [ "${#PATHS[@]}" -eq 0 ]; then
@@ -102,6 +112,22 @@ from tritonclient_tpu.server._core import InferenceCore
 print(InferenceCore(default_models()).prometheus_metrics())
 ' | '${PYTHON}' scripts/check_metrics_exposition.py
 "
+
+# 5. tpusan (opt-in): tier-1 subset under the runtime sanitizer, then the
+#    static-vs-dynamic diff. Zero findings is the gate — the conftest
+#    plugin fails the pytest session itself on any surviving finding.
+if [ "${SANITIZE}" -eq 1 ]; then
+    TPUSAN_OUT="${TPUSAN_REPORT:-/tmp/tpusan_report.json}"
+    run_check "tpusan-tier1" env JAX_PLATFORMS=cpu TPUSAN=1 \
+        TPUSAN_REPORT="${TPUSAN_OUT}" \
+        "${PYTHON}" -m pytest -q -m 'not slow' -p no:cacheprovider \
+        tests/test_tpusan.py tests/test_shared_memory.py \
+        tests/test_server.py tests/test_grpc_client.py \
+        tests/test_http_client.py tests/test_aio_clients.py \
+        tests/test_aio_stress.py tests/test_batcher_stress.py
+    run_check "tpusan-report" "${PYTHON}" scripts/tpusan_report.py \
+        --dynamic "${TPUSAN_OUT}" --fail-on-witnessed
+fi
 
 if [ "${failures}" -ne 0 ]; then
     echo "static checks: ${failures} check(s) failed"

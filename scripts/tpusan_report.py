@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Diff tpulint's static picture against a tpusan runtime report.
+
+Closing the static/dynamic loop needs an answer to three questions per
+paired rule (TPU001 async-blocking, TPU006 shm-lifecycle, TPU007
+lock-order):
+
+* **witnessed** — statically flagged AND observed at runtime: the static
+  finding is real and the suite exercises it (these should be zero on a
+  fixed tree; anything here is an unfixed true positive).
+* **unexercised** — statically flagged, never observed: either a
+  suppressed/baselined deliberate violation, or a COVERAGE GAP — the
+  suite never drives that path (deliberate test sleeps land here).
+* **unpredicted** — observed at runtime with no static counterpart in
+  the same file: a RULE GAP. File each as a new lint fixture (the seeded
+  violations in tests/test_tpusan.py are the canonical examples: runtime
+  constructions the AST rules cannot see).
+
+Usage:
+    python scripts/tpusan_report.py --dynamic tpusan.json [paths...]
+    python scripts/tpusan_report.py --dynamic tpusan.sarif --rules TPU006
+
+``--dynamic`` takes the file ``TPUSAN_REPORT`` wrote (JSON or SARIF);
+static findings come from running tpulint in-process over ``paths``
+(default: tritonclient_tpu scripts tests) WITHOUT baseline filtering —
+the diff wants the complete static picture. Matching is by (rule, file):
+line-level matching would break whenever an unrelated edit shifts code,
+exactly what the fingerprint machinery avoids.
+
+Exit status: 0 always unless ``--fail-on-witnessed`` is given and a
+witnessed pair exists (the CI lane's gate: a statically-known violation
+the suite can reproduce must not survive).
+"""
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+DEFAULT_RULES = ("TPU001", "TPU006", "TPU007")
+
+
+def load_dynamic(path: str):
+    if path.endswith(".sarif"):
+        from tritonclient_tpu.analysis._sarif import load_sarif_findings
+
+        return load_sarif_findings(path)
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    return list(doc.get("findings", []))
+
+
+def run_static(paths, rules):
+    from tritonclient_tpu.analysis import run_analysis
+
+    findings, _ = run_analysis(paths, select=set(rules))
+    return [
+        {"rule": f.rule, "path": f.path, "line": f.line, "message": f.message}
+        for f in findings
+    ]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "paths", nargs="*", default=["tritonclient_tpu", "scripts", "tests"],
+        help="paths for the static run (default: the tpulint scope)",
+    )
+    parser.add_argument(
+        "--dynamic", required=True, metavar="FILE",
+        help="tpusan report (JSON or SARIF) from a TPUSAN=1 suite run",
+    )
+    parser.add_argument(
+        "--rules", default=",".join(DEFAULT_RULES),
+        help="comma-separated rule ids to diff (default: the paired trio)",
+    )
+    parser.add_argument(
+        "--fail-on-witnessed", action="store_true",
+        help="exit 1 if any static finding was witnessed at runtime",
+    )
+    args = parser.parse_args(argv)
+    rules = {r.strip().upper() for r in args.rules.split(",") if r.strip()}
+
+    try:
+        dynamic = [
+            f for f in load_dynamic(args.dynamic) if f.get("rule") in rules
+        ]
+    except (OSError, ValueError) as e:
+        print(f"tpusan_report: cannot load dynamic report: {e}",
+              file=sys.stderr)
+        return 2
+    static = run_static(args.paths, rules)
+
+    dyn_by_key = defaultdict(list)
+    for f in dynamic:
+        dyn_by_key[(f["rule"], f["path"])].append(f)
+
+    witnessed, unexercised = [], []
+    matched_keys = set()
+    for f in static:
+        key = (f["rule"], f["path"])
+        if dyn_by_key.get(key):
+            witnessed.append((f, dyn_by_key[key]))
+            matched_keys.add(key)
+        else:
+            unexercised.append(f)
+    unpredicted = [
+        f for key, fs in sorted(dyn_by_key.items())
+        if key not in matched_keys for f in fs
+    ]
+
+    def show(f):
+        return f"  {f['path']}:{f.get('line', 1)}: {f['rule']} {f['message']}"
+
+    print(f"tpusan_report: rules={','.join(sorted(rules))} "
+          f"static={len(static)} dynamic={len(dynamic)}")
+    print(f"\nwitnessed (static finding observed at runtime): "
+          f"{len(witnessed)}")
+    for f, dyn in witnessed:
+        print(show(f))
+        for d in dyn:
+            print(f"    runtime: {d['message']}")
+    print(f"\nunexercised (static finding never observed — coverage gap "
+          f"or deliberate/baselined): {len(unexercised)}")
+    for f in unexercised:
+        print(show(f))
+    print(f"\nunpredicted (runtime finding with no static counterpart — "
+          f"rule gap, file as a lint fixture): {len(unpredicted)}")
+    for f in unpredicted:
+        print(show(f))
+
+    if args.fail_on_witnessed and witnessed:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

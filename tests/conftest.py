@@ -1,4 +1,5 @@
-"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh, and
+wire the tpusan runtime sanitizer into the suite.
 
 Multi-chip hardware is not available in CI; sharding correctness is validated
 on 8 virtual CPU devices (the driver separately dry-runs the multi-chip path
@@ -8,9 +9,24 @@ Note: on axon-tunnel TPU images, sitecustomize registers the axon PJRT plugin
 and overrides the ``jax_platforms`` config, so the JAX_PLATFORMS env var alone
 is NOT enough — the config must be updated after import, before first backend
 use.
+
+tpusan (``tritonclient_tpu/sanitize``) integration:
+
+* ``TPUSAN=1`` (or ``strict``) enables the sanitizer for the whole
+  session — the CI tpusan lane runs the tier-1 subset this way — and the
+  session FAILS if any runtime finding (including leaked shm handles at
+  session end) survives; ``TPUSAN_REPORT=<path>`` additionally writes the
+  findings (SARIF for ``.sarif`` paths, JSON otherwise) for
+  ``scripts/tpusan_report.py``.
+* The stress tier (``test_*_stress.py``) always runs under the sanitizer:
+  an autouse fixture enables it per-test and fails the test on any new
+  finding, so races only reachable under load are witnessed even in
+  plain tier-1 runs.
 """
 
 import os
+
+import pytest
 
 # Must be set before the backend initializes.
 flags = os.environ.get("XLA_FLAGS", "")
@@ -23,3 +39,65 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+from tritonclient_tpu import sanitize  # noqa: E402
+
+_TPUSAN_ENV = os.environ.get("TPUSAN", "").strip().lower() not in (
+    "", "0", "false", "off",
+)
+if _TPUSAN_ENV:
+    # Enable BEFORE any test module imports the server/shm/engine code so
+    # every named lock is constructed instrumented (jax is imported above,
+    # so the device_put patch lands too).
+    sanitize.enable()
+
+
+@pytest.fixture(autouse=True)
+def _tpusan_stress_tier(request):
+    """Auto-load the sanitizer for the stress tier.
+
+    Stress tests are where lock-order and lifecycle races actually get
+    exercised; they run witnessed even without ``TPUSAN=1``, and fail on
+    any finding seeded by their own execution. Findings are isolated with
+    ``sanitize.capture`` so a session-wide ``TPUSAN=1`` report is not
+    double-counted.
+    """
+    fspath = str(getattr(request.node, "path", None) or request.node.fspath)
+    if "stress" not in os.path.basename(fspath):
+        yield
+        return
+    sanitize.enable()
+    try:
+        with sanitize.capture() as cap:
+            yield
+    finally:
+        sanitize.disable()
+    if cap.findings:
+        lines = "\n".join(f.text() for f in cap.findings)
+        pytest.fail(
+            f"tpusan: {len(cap.findings)} runtime sanitizer finding(s) "
+            f"during stress test:\n{lines}"
+        )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """TPUSAN sessions fail on surviving findings and write the report."""
+    if not _TPUSAN_ENV:
+        return
+    sanitize.check_leaks()
+    report = os.environ.get("TPUSAN_REPORT", "")
+    if report:
+        sanitize.write_report(report)
+    found = sanitize.findings()
+    if found:
+        rep = session.config.pluginmanager.get_plugin("terminalreporter")
+        lines = [f.text() for f in found]
+        if rep is not None:
+            rep.write_line("")
+            for line in lines:
+                rep.write_line(f"tpusan: {line}", red=True)
+            rep.write_line(
+                f"tpusan: {len(found)} runtime sanitizer finding(s) — "
+                "failing the session", red=True,
+            )
+        session.exitstatus = 1

@@ -175,3 +175,33 @@ def test_regime_switch_serializes_under_rate(monkeypatch):
     assert inf_spr == 6
     assert execs_spr >= 3, execs_spr  # spread into smaller takes
     assert el_spr < 6 * 0.030 * 0.9, f"no overlap: {el_spr:.3f}s"
+
+
+def test_hot_signature_cannot_evict_another_rate_window():
+    """Per-signature arrival windows (ADVICE r5 #2): a hot shape flooding
+    the batcher must not evict another signature's rate history — with the
+    old shared deque(maxlen=512), 600 hot arrivals erased the cold
+    signature's record and flipped its serialize/hold regime."""
+    core = InferenceCore([_StressModel()])
+    batcher = core._batchers["stress"]
+    sig_hot = (("X", "INT32", (4,)),)
+    sig_cold = (("X", "INT32", (5,)),)
+    now = time.monotonic()
+    with batcher._cv:
+        batcher._note_arrival(sig_cold, now)
+        for _ in range(600):
+            batcher._note_arrival(sig_hot, now)
+        # The cold signature's window survives the hot flood...
+        assert batcher._recent(sig_cold, now) == 1
+        # ...and the hot window is bounded per-signature, not shared.
+        assert batcher._recent(sig_hot, now) == 128
+
+
+def test_one_off_signatures_do_not_grow_arrival_windows_unboundedly():
+    core = InferenceCore([_StressModel()])
+    batcher = core._batchers["stress"]
+    now = time.monotonic()
+    with batcher._cv:
+        for i in range(200):
+            batcher._note_arrival((("X", "INT32", (i,)),), now)
+        assert len(batcher._arrivals) <= 65

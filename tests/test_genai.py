@@ -613,3 +613,38 @@ class TestAioBlockingStream:
             "cancelled stream did not mark the engine request cancelled"
         )
         c.close()
+
+
+def test_warm_admission_requires_an_idle_engine():
+    """ADVICE r5 #1: warm_admission rewrites live slot state; with a
+    request in flight it must raise instead of silently corrupting the
+    generation, and it must work again once the engine drains."""
+    import time as _time
+
+    from tritonclient_tpu.models.gpt_engine import GenerationEngine
+
+    cfg = gpt.gpt_tiny(max_len=64)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    engine = GenerationEngine(cfg, params, max_slots=2)
+    try:
+        engine.warm_admission()  # idle engine: allowed
+        req = engine.submit(np.array([[1, 2, 3]], np.int32), 30)
+        assert req.out.get(timeout=120) is not None  # slot occupied
+        with pytest.raises(RuntimeError, match="idle engine"):
+            engine.warm_admission()
+        req.cancelled = True
+        while req.out.get(timeout=120) is not None:
+            pass
+        # The freed slot is applied at the engine's next loop top; the
+        # guard must flip back to allowed once it lands.
+        deadline = _time.time() + 30
+        while True:
+            try:
+                engine.warm_admission()
+                break
+            except RuntimeError:
+                if _time.time() > deadline:
+                    raise
+                _time.sleep(0.05)  # tpulint: disable=TPU001 - poll loop
+    finally:
+        engine.shutdown()

@@ -672,3 +672,40 @@ class TestBatchQueueDelay:
         assert stats["inference_stats"]["queue"]["ns"] > 0
         for r in results:
             assert r.outputs
+
+
+class TestSerialStreamBarrier:
+    """ADVICE r5 #3: the serial-stream barrier memoizes fin() so a wedged
+    batch pays its bounded wait exactly once — the yielder replays the
+    cached outcome instead of re-waiting from scratch."""
+
+    def test_memoize_once_replays_result_without_recalling(self):
+        from tritonclient_tpu.server._grpc import _memoize_once
+
+        calls = []
+
+        def fin():
+            calls.append(1)
+            return "response"
+
+        f = _memoize_once(fin)
+        assert f() == "response"
+        assert f() == "response"
+        assert calls == [1], "fin must run exactly once"
+
+    def test_memoize_once_replays_exception_without_rewaiting(self):
+        from tritonclient_tpu.server._core import CoreError
+        from tritonclient_tpu.server._grpc import _memoize_once
+
+        calls = []
+
+        def fin():
+            calls.append(1)
+            raise CoreError("dynamic batch wait timed out", 500)
+
+        f = _memoize_once(fin)
+        with pytest.raises(CoreError, match="timed out"):
+            f()  # the barrier pays the (bounded) wait here
+        with pytest.raises(CoreError, match="timed out"):
+            f()  # the yielder replays instantly
+        assert calls == [1], "a wedged batch must not be re-waited"

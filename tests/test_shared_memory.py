@@ -562,3 +562,73 @@ class TestTransferCoalescer:
         finally:
             tpushm.destroy_shared_memory_region(r1)
             tpushm.destroy_shared_memory_region(r2)
+
+
+def test_as_array_reupload_runs_outside_the_region_lock(monkeypatch):
+    """ADVICE r5 #5: re-uploading a released SharedBatch member must not
+    hold the region lock across jax.device_put (it would serialize every
+    concurrent reader/writer for the upload's duration); the uploaded
+    array is re-parked through the _replace_parked CAS."""
+    import jax
+    import jax.numpy as jnp
+
+    region = tpushm.create_shared_memory_region("cas_upload", 64, 0)
+    try:
+        data = np.arange(8, dtype=np.int32)
+        sb = tpushm.SharedBatch(jnp.asarray(data))
+        view = tpushm.BatchRowView(sb, 0, 8)
+        region.set_array(view, 0)
+        sb.materialize()  # base released: device_slice now returns numpy
+
+        seen = {}
+        orig_put = jax.device_put
+
+        def probe(x, device=None):
+            seen["locked_during_upload"] = region._lock.locked()
+            return orig_put(x, device)
+
+        monkeypatch.setattr(jax, "device_put", probe)
+        out = region.as_array("INT32", [8], 0)
+        assert seen, "release fallback must re-upload through device_put"
+        assert seen["locked_during_upload"] is False
+        assert isinstance(out, jax.Array)
+        np.testing.assert_array_equal(np.asarray(out), data)
+        # CAS re-park: repeat device readers pay the upload once.
+        assert region._parked[0] is out
+        assert region.as_array("INT32", [8], 0) is out
+    finally:
+        tpushm.destroy_shared_memory_region(region)
+
+
+def test_as_array_reupload_cas_defers_to_racing_writer(monkeypatch):
+    """If a writer replaces the parked entry while the (unlocked) upload
+    is in flight, the writer wins: the upload is returned but not parked."""
+    import jax
+    import jax.numpy as jnp
+
+    region = tpushm.create_shared_memory_region("cas_race", 64, 0)
+    try:
+        data = np.arange(8, dtype=np.int32)
+        sb = tpushm.SharedBatch(jnp.asarray(data))
+        view = tpushm.BatchRowView(sb, 0, 8)
+        region.set_array(view, 0)
+        sb.materialize()
+
+        fresh = np.full(8, 9, np.int32)
+        orig_put = jax.device_put
+
+        def racing_put(x, device=None):
+            # A writer lands between the locked lookup and the upload.
+            monkeypatch.setattr(jax, "device_put", orig_put)
+            region.set_array(jnp.asarray(fresh), 0)
+            return orig_put(x, device)
+
+        monkeypatch.setattr(jax, "device_put", racing_put)
+        out = region.as_array("INT32", [8], 0)
+        np.testing.assert_array_equal(np.asarray(out), data)
+        # The racing writer's park survives the CAS.
+        np.testing.assert_array_equal(
+            np.asarray(region._parked[0]), fresh
+        )
+    finally:
+        tpushm.destroy_shared_memory_region(region)

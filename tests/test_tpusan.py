@@ -1,0 +1,380 @@
+"""tpusan runtime sanitizer: seeded violations per witness + clean runs.
+
+Each witness gets at least one deliberate violation proving runtime
+detection with the expected ``rule::path::message`` SARIF fingerprint
+(round-tripped through the tpulint ``--baseline`` machinery), plus a
+clean-lifecycle run asserting zero findings. The deliberate
+``time.sleep`` calls are the runtime *seeds* the static rule also sees —
+suppressed here exactly like the other deliberate test sleeps.
+"""
+
+import asyncio
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tritonclient_tpu import sanitize
+from tritonclient_tpu.analysis._baseline import (
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+from tritonclient_tpu.sanitize import TpusanError
+
+
+@pytest.fixture
+def tpusan():
+    """Sanitizer active in report mode; findings isolated and restored."""
+    prior_mode = sanitize.mode()
+    sanitize.enable(mode="report")
+    try:
+        with sanitize.capture() as cap:
+            yield cap
+    finally:
+        sanitize.disable()
+        if sanitize.enabled():
+            sanitize.enable(mode=prior_mode)
+            sanitize.disable()
+
+
+@pytest.fixture
+def _strict():
+    """Sanitizer active in strict mode; the session's mode is restored
+    afterwards (a TPUSAN=1 session must not be left strict)."""
+    prior_mode = sanitize.mode()
+    sanitize.enable(mode="strict")
+    try:
+        yield
+    finally:
+        sanitize.disable()
+        if sanitize.enabled():
+            sanitize.enable(mode=prior_mode)
+            sanitize.disable()
+
+
+# --------------------------------------------------------------------------- #
+# lock-order witness (TPU007)                                                 #
+# --------------------------------------------------------------------------- #
+
+
+class TestLockOrderWitness:
+    def test_seeded_lock_cycle_is_caught(self, tpusan):
+        a = sanitize.named_lock("seed.A")
+        b = sanitize.named_lock("seed.B")
+        ev_a, ev_b = threading.Event(), threading.Event()
+
+        def first():
+            with a:
+                ev_a.set()
+                ev_b.wait(2)
+                if b.acquire(timeout=0.2):  # A -> B
+                    b.release()
+
+        def second():
+            ev_a.wait(2)
+            with b:
+                if a.acquire(timeout=0.2):  # B -> A: closes the cycle
+                    a.release()
+                ev_b.set()
+
+        t1 = threading.Thread(target=first)
+        t2 = threading.Thread(target=second)
+        t1.start(); t2.start(); t1.join(); t2.join()
+
+        cyc = [f for f in tpusan.findings if "lock-order cycle" in f.message]
+        assert len(cyc) == 1
+        assert "'seed.A'" in cyc[0].message and "'seed.B'" in cyc[0].message
+        assert cyc[0].rule == "TPU007"
+        assert cyc[0].path == "tests/test_tpusan.py"
+        # Both acquisition stacks recorded for the diagnosis.
+        rec = [r for r in tpusan.records
+               if "lock-order cycle" in r["message"]][0]
+        assert len(rec["stacks"]) >= 2
+
+    def test_seeded_held_while_blocking_is_caught(self, tpusan):
+        lock = sanitize.named_lock("seed.H")
+        with lock:
+            time.sleep(0.01)  # tpulint: disable=TPU001 - seeded violation
+        msgs = [f.message for f in tpusan.findings if f.rule == "TPU007"]
+        assert any(
+            "lock 'seed.H' held across blocking call `time.sleep`" == m
+            for m in msgs
+        )
+
+    def test_self_deadlock_preempted_in_strict_mode(self, _strict):
+        with sanitize.capture():
+            lock = sanitize.named_lock("seed.self")
+            lock.acquire()
+            try:
+                with pytest.raises(TpusanError, match="self-deadlock"):
+                    lock.acquire()  # would hang forever unsanitized
+            finally:
+                lock.release()
+
+    def test_sibling_instances_of_one_declaration_are_not_a_cycle(
+        self, tpusan
+    ):
+        r1 = sanitize.named_lock("seed.region._lock")
+        r2 = sanitize.named_lock("seed.region._lock")
+        with r1:
+            with r2:
+                pass
+        assert tpusan.findings == []
+
+
+# --------------------------------------------------------------------------- #
+# shm lifecycle witness (TPU006)                                              #
+# --------------------------------------------------------------------------- #
+
+
+def _tpu_region(name, nbytes=64):
+    import tritonclient_tpu.utils.tpu_shared_memory as tpushm
+
+    return tpushm, tpushm.create_shared_memory_region(name, nbytes, 0)
+
+
+class TestShmLifecycleWitness:
+    def test_use_after_unregister_is_caught(self, tpusan):
+        from tritonclient_tpu.server._core import TpuShmRegistry
+
+        tpushm, region = _tpu_region("san_uau")
+        reg = TpuShmRegistry()
+        reg.register("san_uau", tpushm.get_raw_handle(region), 0, 64)
+        reg.unregister("san_uau")
+        tpushm.set_shared_memory_region(
+            region, [np.arange(4, dtype=np.int32)]
+        )
+        tpushm.destroy_shared_memory_region(region)
+        msgs = [f.message for f in tpusan.findings if f.rule == "TPU006"]
+        assert (
+            "tpu shared-memory region 'san_uau' used (set) after "
+            "unregister" in msgs
+        )
+
+    def test_double_register_and_destroy_while_registered(self, tpusan):
+        from tritonclient_tpu.server._core import TpuShmRegistry
+
+        tpushm, region = _tpu_region("san_dbl")
+        reg = TpuShmRegistry()
+        handle = tpushm.get_raw_handle(region)
+        reg.register("san_dbl", handle, 0, 64)
+        reg.register("san_dbl", handle, 0, 64)  # replace without unregister
+        tpushm.destroy_shared_memory_region(region)  # still registered
+        msgs = [f.message for f in tpusan.findings if f.rule == "TPU006"]
+        assert any("registered twice" in m for m in msgs)
+        assert any("destroyed while still registered" in m for m in msgs)
+
+    def test_leaked_handle_reported_by_check_leaks(self, tpusan):
+        tpushm, region = _tpu_region("san_leak")
+        sanitize.check_leaks()
+        msgs = [f.message for f in tpusan.findings if f.rule == "TPU006"]
+        assert any(
+            "'san_leak' was never destroyed (leaked handle" in m
+            for m in msgs
+        )
+        tpushm.destroy_shared_memory_region(region)  # clean up for real
+
+    def test_clean_lifecycle_has_zero_findings(self, tpusan):
+        from tritonclient_tpu.server._core import TpuShmRegistry
+
+        tpushm, region = _tpu_region("san_ok")
+        reg = TpuShmRegistry()
+        reg.register("san_ok", tpushm.get_raw_handle(region), 0, 64)
+        tpushm.set_shared_memory_region(
+            region, [np.arange(8, dtype=np.int32)]
+        )
+        np.testing.assert_array_equal(
+            tpushm.get_contents_as_numpy(region, "INT32", [8]),
+            np.arange(8, dtype=np.int32),
+        )
+        reg.unregister("san_ok")
+        tpushm.destroy_shared_memory_region(region)
+        sanitize.check_leaks()
+        assert [f.text() for f in tpusan.findings] == []
+
+    def test_failed_register_does_not_advance_the_state_machine(
+        self, tpusan
+    ):
+        from tritonclient_tpu.server._core import CoreError, TpuShmRegistry
+
+        reg = TpuShmRegistry()
+        with pytest.raises(CoreError):
+            reg.register("san_bad", b"not-a-handle", 0, 64)
+        sanitize.check_leaks()
+        assert tpusan.findings == []
+
+
+# --------------------------------------------------------------------------- #
+# event-loop watchdog (TPU001)                                                #
+# --------------------------------------------------------------------------- #
+
+
+class TestEventLoopWatchdog:
+    def test_blocking_sleep_in_coroutine_is_caught(self, tpusan):
+        async def bad():
+            time.sleep(0.01)  # tpulint: disable=TPU001 - seeded violation
+
+        asyncio.run(bad())
+        msgs = [f.message for f in tpusan.findings if f.rule == "TPU001"]
+        assert any("blocking call `time.sleep`" in m for m in msgs)
+
+    def test_slow_callback_is_caught(self, tpusan, monkeypatch):
+        monkeypatch.setenv("TPUSAN_SLOW_CALLBACK_S", "0.05")
+
+        async def main():
+            loop = asyncio.get_running_loop()
+            loop.call_soon(_slow_cb)
+            await asyncio.sleep(0.2)
+
+        def _slow_cb():
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 0.1:
+                pass  # burn the loop without tripping the sleep witness
+
+        asyncio.run(main())
+        msgs = [f.message for f in tpusan.findings if f.rule == "TPU001"]
+        assert any(
+            "event-loop callback" in m and "_slow_cb" in m for m in msgs
+        )
+
+    def test_sleep_off_loop_is_clean(self, tpusan):
+        time.sleep(0.01)  # tpulint: disable=TPU001 - plain thread: legal
+        assert [f for f in tpusan.findings if f.rule == "TPU001"] == []
+
+
+# --------------------------------------------------------------------------- #
+# reporting: fingerprints, SARIF, baseline round-trip, strict mode            #
+# --------------------------------------------------------------------------- #
+
+
+class TestReporting:
+    def test_fingerprint_round_trips_through_baseline_machinery(
+        self, tpusan, tmp_path
+    ):
+        lock = sanitize.named_lock("seed.base")
+        with lock:
+            time.sleep(0.005)  # tpulint: disable=TPU001 - seeded violation
+        finding = [f for f in tpusan.findings if f.rule == "TPU007"][0]
+        assert finding.fingerprint() == (
+            "TPU007::tests/test_tpusan.py::lock 'seed.base' held across "
+            "blocking call `time.sleep`"
+        )
+        baseline = tmp_path / "baseline.json"
+        write_baseline(str(baseline), [finding])
+        fresh, suppressed = apply_baseline(
+            [finding], load_baseline(str(baseline))
+        )
+        assert fresh == [] and suppressed == 1
+
+    def test_sarif_output_matches_tpulint_shape(self, tpusan, tmp_path):
+        async def bad():
+            time.sleep(0.005)  # tpulint: disable=TPU001 - seeded violation
+
+        asyncio.run(bad())
+        out = tmp_path / "tpusan.sarif"
+        # Write BEFORE capture-exit removes the seeded findings.
+        sanitize.write_report(str(out))
+        doc = json.loads(out.read_text())
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        assert run["tool"]["driver"]["name"] == "tpusan"
+        rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert {"TPU001", "TPU006", "TPU007"} <= rule_ids
+        results = run["results"]
+        assert results, "seeded finding must serialize"
+        fp = results[0]["partialFingerprints"]["tpulint/v1"]
+        rule, path, message = fp.split("::", 2)
+        assert rule == results[0]["ruleId"]
+        assert path == "tests/test_tpusan.py"
+        assert message == results[0]["message"]["text"]
+
+    def test_json_report_includes_stacks(self, tpusan, tmp_path):
+        lock = sanitize.named_lock("seed.json")
+        with lock:
+            time.sleep(0.005)  # tpulint: disable=TPU001 - seeded violation
+        out = tmp_path / "tpusan.json"
+        sanitize.write_report(str(out))
+        doc = json.loads(out.read_text())
+        assert doc["tool"] == "tpusan"
+        assert doc["findings"][0]["stacks"]
+
+    def test_strict_mode_raises_at_the_violation_site(self, _strict):
+        with sanitize.capture():
+            lock = sanitize.named_lock("seed.strict")
+            with pytest.raises(TpusanError, match="held across"):
+                with lock:
+                    time.sleep(0.005)  # tpulint: disable=TPU001 - seeded
+
+    def test_named_lock_is_plain_when_inactive(self):
+        if sanitize.enabled():
+            pytest.skip("session runs under TPUSAN: factories instrument")
+        assert type(sanitize.named_lock("x")) is type(threading.Lock())
+        assert isinstance(
+            sanitize.named_condition("x"), threading.Condition
+        )
+
+    def test_findings_deduplicate_by_fingerprint(self, tpusan):
+        lock = sanitize.named_lock("seed.dedupe")
+        for _ in range(3):
+            with lock:
+                time.sleep(0.002)  # tpulint: disable=TPU001 - seeded
+        assert len([f for f in tpusan.findings if f.rule == "TPU007"]) == 1
+
+
+# --------------------------------------------------------------------------- #
+# clean end-to-end serving run under the sanitizer                            #
+# --------------------------------------------------------------------------- #
+
+
+def test_served_shm_round_trip_is_clean_under_tpusan(tpusan):
+    """Full fixed-tree path: create + register + batched infer + read +
+    unregister + destroy through the real server core — zero findings."""
+    import tritonclient_tpu.utils.tpu_shared_memory as tpushm
+    from tritonclient_tpu.server import default_models
+    from tritonclient_tpu.server._core import (
+        CoreRequest,
+        CoreRequestedOutput,
+        CoreTensor,
+        InferenceCore,
+    )
+
+    core = InferenceCore(default_models())
+    x = np.arange(16, dtype=np.int32).reshape(1, 16)
+    in_region = tpushm.create_shared_memory_region("san_in", 2 * x.nbytes, 0)
+    out_region = tpushm.create_shared_memory_region("san_out", x.nbytes, 0)
+    try:
+        core.tpu_shm.register(
+            "san_in", tpushm.get_raw_handle(in_region), 0, 2 * x.nbytes
+        )
+        core.tpu_shm.register(
+            "san_out", tpushm.get_raw_handle(out_region), 0, x.nbytes
+        )
+        tpushm.set_shared_memory_region(in_region, [x, x])
+        request = CoreRequest(
+            model_name="simple",
+            inputs=[
+                CoreTensor("INPUT0", "INT32", [1, 16], shm_kind="tpu",
+                           shm_region="san_in", shm_offset=0,
+                           shm_byte_size=x.nbytes),
+                CoreTensor("INPUT1", "INT32", [1, 16], shm_kind="tpu",
+                           shm_region="san_in", shm_offset=x.nbytes,
+                           shm_byte_size=x.nbytes),
+            ],
+            outputs=[
+                CoreRequestedOutput("OUTPUT0", shm_kind="tpu",
+                                    shm_region="san_out", shm_offset=0,
+                                    shm_byte_size=x.nbytes),
+            ],
+        )
+        core.infer(request)
+        got = tpushm.get_contents_as_numpy(out_region, "INT32", [1, 16])
+        np.testing.assert_array_equal(got, 2 * x)
+    finally:
+        core.tpu_shm.unregister(None)
+        tpushm.destroy_shared_memory_region(in_region)
+        tpushm.destroy_shared_memory_region(out_region)
+    sanitize.check_leaks()
+    assert [f.text() for f in tpusan.findings] == []

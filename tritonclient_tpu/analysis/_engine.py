@@ -285,15 +285,12 @@ def render_json(findings: Sequence[Finding], files_checked: int) -> str:
     )
 
 
-_SARIF_SCHEMA = (
-    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
-    "Schemata/sarif-schema-2.1.0.json"
-)
-
-
 def render_sarif(findings: Sequence[Finding], files_checked: int) -> str:
-    """SARIF 2.1.0 — the format GitHub code scanning ingests to annotate
-    PRs. One run, one driver (tpulint), one result per finding."""
+    """SARIF 2.1.0 for the static tier; the document shape lives in
+    ``analysis/_sarif.py``, shared with the tpusan runtime tier so both
+    outputs merge in code scanning and baselines."""
+    from tritonclient_tpu.analysis._sarif import render_sarif as _render
+
     rules_meta = [
         {
             "id": rule.id,
@@ -302,57 +299,4 @@ def render_sarif(findings: Sequence[Finding], files_checked: int) -> str:
         }
         for rule in default_rules()
     ]
-    known = {r["id"] for r in rules_meta}
-    # PARSE (and any future synthetic rule ids) still need a rule entry:
-    # SARIF results must reference a declared rule.
-    for extra in sorted({f.rule for f in findings} - known):
-        rules_meta.append(
-            {
-                "id": extra,
-                "name": extra.lower(),
-                "shortDescription": {"text": "file could not be analyzed"},
-            }
-        )
-    results = [
-        {
-            "ruleId": f.rule,
-            "level": "error" if f.rule == "PARSE" else "warning",
-            "message": {"text": f.message},
-            "locations": [
-                {
-                    "physicalLocation": {
-                        "artifactLocation": {
-                            "uri": f.path,
-                            "uriBaseId": "SRCROOT",
-                        },
-                        "region": {
-                            "startLine": max(f.line, 1),
-                            "startColumn": f.col + 1,
-                        },
-                    }
-                }
-            ],
-            "partialFingerprints": {"tpulint/v1": f.fingerprint()},
-        }
-        for f in findings
-    ]
-    doc = {
-        "$schema": _SARIF_SCHEMA,
-        "version": "2.1.0",
-        "runs": [
-            {
-                "tool": {
-                    "driver": {
-                        "name": "tpulint",
-                        "informationUri": (
-                            "https://github.com/triton-inference-server/client"
-                        ),
-                        "rules": rules_meta,
-                    }
-                },
-                "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
-                "results": results,
-            }
-        ],
-    }
-    return json.dumps(doc, indent=2)
+    return _render(findings, rules_meta, tool_name="tpulint")

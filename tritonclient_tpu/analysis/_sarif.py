@@ -1,0 +1,122 @@
+"""Shared SARIF 2.1.0 rendering for the static and runtime analysis tiers.
+
+tpulint (static, ``analysis/_engine.py``) and tpusan (runtime,
+``tritonclient_tpu/sanitize``) report through the same ``Finding`` shape
+and the same ``rule::path::message`` fingerprint, so their SARIF outputs
+merge in GitHub code scanning and their findings round-trip through one
+``--baseline`` file. This module owns the SARIF document shape exactly
+once; each tool supplies its driver name and rule metadata.
+"""
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+#: partialFingerprints key shared by both tools: code scanning treats a
+#: static finding and its runtime witness of the same violation as one
+#: result stream instead of duplicating annotations.
+FINGERPRINT_KEY = "tpulint/v1"
+
+_INFO_URI = "https://github.com/triton-inference-server/client"
+
+
+def render_sarif(
+    findings: Sequence,
+    rules_meta: List[Dict],
+    tool_name: str = "tpulint",
+    level_for: Optional[Dict[str, str]] = None,
+) -> str:
+    """SARIF 2.1.0 — the format GitHub code scanning ingests to annotate
+    PRs. One run, one driver (``tool_name``), one result per finding.
+
+    ``findings`` are ``Finding``-shaped objects (rule/path/line/col/
+    message/fingerprint()); ``rules_meta`` the driver's declared rules;
+    ``level_for`` optional per-rule severity overrides (default
+    ``warning``, ``PARSE`` always ``error``).
+    """
+    rules_meta = list(rules_meta)
+    known = {r["id"] for r in rules_meta}
+    # PARSE (and any future synthetic rule ids) still need a rule entry:
+    # SARIF results must reference a declared rule.
+    for extra in sorted({f.rule for f in findings} - known):
+        rules_meta.append(
+            {
+                "id": extra,
+                "name": extra.lower(),
+                "shortDescription": {"text": "file could not be analyzed"},
+            }
+        )
+    levels = dict(level_for or {})
+    results = [
+        {
+            "ruleId": f.rule,
+            "level": (
+                "error" if f.rule == "PARSE" else levels.get(f.rule, "warning")
+            ),
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path,
+                            "uriBaseId": "SRCROOT",
+                        },
+                        "region": {
+                            "startLine": max(f.line, 1),
+                            "startColumn": f.col + 1,
+                        },
+                    }
+                }
+            ],
+            "partialFingerprints": {FINGERPRINT_KEY: f.fingerprint()},
+        }
+        for f in findings
+    ]
+    doc = {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": tool_name,
+                        "informationUri": _INFO_URI,
+                        "rules": rules_meta,
+                    }
+                },
+                "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+                "results": results,
+            }
+        ],
+    }
+    return json.dumps(doc, indent=2)
+
+
+def load_sarif_findings(path: str) -> List[dict]:
+    """Flatten a SARIF file back to finding dicts (rule/path/line/message/
+    fingerprint) — the inverse used by ``scripts/tpusan_report.py`` to diff
+    a runtime run against the static picture."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    out: List[dict] = []
+    for run in doc.get("runs", []):
+        for res in run.get("results", []):
+            loc = (res.get("locations") or [{}])[0].get(
+                "physicalLocation", {}
+            )
+            out.append(
+                {
+                    "rule": res.get("ruleId", ""),
+                    "path": loc.get("artifactLocation", {}).get("uri", ""),
+                    "line": loc.get("region", {}).get("startLine", 1),
+                    "message": res.get("message", {}).get("text", ""),
+                    "fingerprint": res.get("partialFingerprints", {}).get(
+                        FINGERPRINT_KEY, ""
+                    ),
+                }
+            )
+    return out

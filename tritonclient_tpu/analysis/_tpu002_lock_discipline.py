@@ -24,6 +24,11 @@ _LOCK_FACTORIES = {
     "threading.Condition",
     "asyncio.Lock",
     "asyncio.Condition",
+    # tpusan named-lock adoption (sanitize.named_lock("Class._lock")):
+    # instrumented at runtime, but the same lock to this rule.
+    "tritonclient_tpu.sanitize.named_lock",
+    "tritonclient_tpu.sanitize.named_rlock",
+    "tritonclient_tpu.sanitize.named_condition",
 }
 
 #: Method calls on an attribute that mutate the underlying container.

@@ -40,6 +40,12 @@ _LOCK_FACTORIES = {
     "threading.Condition": "Condition",
     "asyncio.Lock": "Lock",
     "asyncio.Condition": "Condition",
+    # tpusan named-lock adoption: the runtime witness instruments these,
+    # and this rule keeps them in the static graph — the pairing that
+    # lets scripts/tpusan_report.py diff the two tiers.
+    "tritonclient_tpu.sanitize.named_lock": "Lock",
+    "tritonclient_tpu.sanitize.named_rlock": "RLock",
+    "tritonclient_tpu.sanitize.named_condition": "Condition",
 }
 
 

@@ -11,6 +11,7 @@ import grpc
 
 from google.protobuf import json_format
 
+from tritonclient_tpu import sanitize
 from tritonclient_tpu._client import InferenceServerClientBase
 from tritonclient_tpu._request import Request
 from tritonclient_tpu.grpc._client import (
@@ -86,6 +87,9 @@ class InferenceServerClient(InferenceServerClientBase):
             self._channel = grpc.aio.insecure_channel(url, options=channel_opt)
         self._client_stub = GRPCInferenceServiceStub(self._channel)
         self._verbose = verbose
+        # tpusan: opt the owning loop into event-loop-blocking accounting
+        # (no-op unless the sanitizer is active).
+        sanitize.note_event_loop()
 
     async def __aenter__(self):
         return self

@@ -14,6 +14,7 @@ from typing import Optional
 
 import aiohttp
 
+from tritonclient_tpu import sanitize
 from tritonclient_tpu.protocol._literals import (
     EP_HEALTH_LIVE,
     EP_HEALTH_READY,
@@ -69,6 +70,9 @@ class InferenceServerClient(InferenceServerClientBase):
             timeout=aiohttp.ClientTimeout(total=conn_timeout),
             auto_decompress=False,
         )
+        # tpusan: opt the owning loop into event-loop-blocking accounting
+        # (no-op unless the sanitizer is active).
+        sanitize.note_event_loop()
 
     async def __aenter__(self):
         return self

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from tritonclient_tpu import sanitize
 from tritonclient_tpu.models._base import Model, TensorSpec
 from tritonclient_tpu.models.gpt import (
     GptConfig,
@@ -237,7 +238,7 @@ class _Distributor:
             t.join(timeout=timeout)
         self._thread = None
 
-    def _run(self):
+    def _run(self):  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
         while True:
             # Priority lane first: pending first-token deliveries beat
             # everything already queued. Prefill items never hold a
@@ -368,7 +369,9 @@ class GenerationEngine:
                 )
         self._slot_req: List[Optional[_Request]] = [None] * max_slots
         self._admit: "queue.Queue" = queue.Queue()
-        self._cv = threading.Condition()
+        # Named for the tpusan lock-order witness (plain Condition when
+        # the sanitizer is inactive).
+        self._cv = sanitize.named_condition("GenerationEngine._cv")
         self._thread: Optional[threading.Thread] = None
         self._stopping = False
         self._broken: Optional[BaseException] = None
@@ -411,7 +414,7 @@ class GenerationEngine:
         self._process_frees()
         self._drain_terminated()
 
-    def _drain_terminated(self):
+    def _drain_terminated(self):  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
         """Terminate every queued/active request (no thread will serve
         them): admission-queue waiters too, not just slot occupants."""
         while True:
@@ -469,7 +472,7 @@ class GenerationEngine:
             b *= 2
         return min(b, self.cfg.max_len)
 
-    def _release_cancelled(self):
+    def _release_cancelled(self):  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
         """A consumer that went away (stream closed) marks its request
         cancelled; its slot frees at the next loop top instead of
         generating dead tokens until max_new. Termination itself is
@@ -482,7 +485,7 @@ class GenerationEngine:
                 self._temps = self._temps.at[slot].set(0.0)
                 self._dist.submit_cancel(req)
 
-    def _process_frees(self):
+    def _process_frees(self):  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
         """Apply slot-completions reported by the delivery thread.
 
         Only the engine loop mutates slot state; the distributor just
@@ -499,7 +502,7 @@ class GenerationEngine:
                 # goes back to the cheap argmax branch of the step.
                 self._temps = self._temps.at[slot].set(0.0)
 
-    def _admit_into_free_slots(self):
+    def _admit_into_free_slots(self):  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
         admitted = []  # (slot, req, first_token_array, prompt_len)
         for slot in range(self.max_slots):
             if self._slot_req[slot] is not None:
@@ -575,34 +578,58 @@ class GenerationEngine:
         """Pre-execute the vectorized admission ops for every burst size
         (each k compiles its own scatter/concat shapes on first use —
         multi-second stalls on remote-compile links that must not land
-        inside a serving window). Safe on an idle engine: free slots'
-        state is rewritten with its current values."""
+        inside a serving window). Only safe on an idle engine: the loop
+        rewrites slot state with zeros, which would silently corrupt any
+        in-flight generation — so idleness is now enforced under the cv
+        instead of being a docstring contract (ADVICE r5 #1).
+
+        The whole rewrite runs UNDER ``self._cv``: an actively-serving
+        engine (occupied slots or queued admissions) raises, and holding
+        the cv for the duration excludes concurrent ``submit()``s — an
+        alive-but-idle engine thread is then harmless, since its loop
+        only mutates slot state in response to admissions, frees, or
+        cancels, none of which can arrive while the cv is held. (The
+        idle loop itself blocks on this cv, so it cannot even re-check.)
+        """
         import jax
 
-        for k in range(1, self.max_slots + 1):
-            # Mirror the admission path's exact op shapes: host-array
-            # scatters for the request fields, device-concat for tokens.
-            slots = jnp.array(list(range(k)), jnp.int32)
-            firsts = jnp.concatenate(
-                [self._tokens[s : s + 1] for s in range(k)]
-            )
-            self._tokens = self._tokens.at[slots].set(firsts)
-            self._pos = self._pos.at[slots].set(
-                jnp.array([0] * k, jnp.int32)
-            )
-            self._seeds = self._seeds.at[slots].set(
-                jnp.array([0] * k, jnp.int32)
-            )
-            self._steps = self._steps.at[slots].set(1)
-            self._temps = self._temps.at[slots].set(
-                jnp.array([0.0] * k, jnp.float32)
-            )
-            self._topks = self._topks.at[slots].set(
-                jnp.array([0] * k, jnp.int32)
-            )
-        jax.block_until_ready(self._tokens)
+        with self._cv:
+            if self._stopping or self._broken is not None:
+                raise RuntimeError(
+                    "warm_admission on a stopped or broken engine"
+                )
+            busy = [s for s, r in enumerate(self._slot_req) if r is not None]
+            if busy or not self._admit.empty():
+                raise RuntimeError(
+                    "warm_admission requires an idle engine: all slots "
+                    "free and an empty admission queue (busy slots: "
+                    f"{busy}, queued admissions: {self._admit.qsize()})"
+                )
+            for k in range(1, self.max_slots + 1):
+                # Mirror the admission path's exact op shapes: host-array
+                # scatters for the request fields, device-concat for
+                # tokens.
+                slots = jnp.array(list(range(k)), jnp.int32)
+                firsts = jnp.concatenate(
+                    [self._tokens[s : s + 1] for s in range(k)]
+                )
+                self._tokens = self._tokens.at[slots].set(firsts)
+                self._pos = self._pos.at[slots].set(
+                    jnp.array([0] * k, jnp.int32)
+                )
+                self._seeds = self._seeds.at[slots].set(
+                    jnp.array([0] * k, jnp.int32)
+                )
+                self._steps = self._steps.at[slots].set(1)
+                self._temps = self._temps.at[slots].set(
+                    jnp.array([0.0] * k, jnp.float32)
+                )
+                self._topks = self._topks.at[slots].set(
+                    jnp.array([0] * k, jnp.int32)
+                )
+            jax.block_until_ready(self._tokens)
 
-    def _run(self):
+    def _run(self):  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
         try:
             self._run_loop()
         except BaseException as e:  # noqa: BLE001 — engine must not die silently
@@ -629,7 +656,7 @@ class GenerationEngine:
                     req.out.put(e)
                     self._slot_req[slot] = None
 
-    def _run_loop(self):
+    def _run_loop(self):  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
         # Software pipeline with DECOUPLED delivery: steps and admissions'
         # prefills dispatch with DEVICE tokens; the delivery thread drains
         # readbacks FIFO behind them (at most max_inflight dispatches

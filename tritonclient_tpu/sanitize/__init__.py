@@ -1,0 +1,431 @@
+"""tpusan — the runtime sanitizer tier that witnesses tpulint's invariants.
+
+tpulint (``tritonclient_tpu/analysis``) proves lock-order, shm-lifecycle,
+and async-blocking discipline *statically*; tpusan closes the loop by
+watching the same invariants under real execution. Three witnesses, each
+paired with a static rule:
+
+=======  ====================  ===============================================
+pairs    witness               catches at runtime
+=======  ====================  ===============================================
+TPU007   lock-order            cycles in the live per-thread lock-acquisition
+                               graph over the project's *named* locks, and a
+                               named lock held across a blocking call
+                               (``time.sleep``, ``mmap.mmap``,
+                               ``socket.create_connection``,
+                               ``jax.device_put``); both stacks recorded
+TPU006   shm-lifecycle         the create/register/set/read/unregister/destroy
+                               state machine driven by real calls through both
+                               shm packages and the server registries:
+                               use-after-unregister/destroy, double-register,
+                               destroy-while-registered, handles leaked at
+                               process exit
+TPU001   async-blocking        ``time.sleep``/``socket.create_connection`` on
+                               a thread with a running event loop, and
+                               event-loop callbacks exceeding the
+                               slow-callback threshold
+=======  ====================  ===============================================
+
+Activation: ``TPUSAN=1`` in the environment (the test suite's
+``conftest.py`` then enables it for the whole session and fails the run
+on findings), or programmatic ``sanitize.enable()``. ``TPUSAN=strict``
+(or ``TPUSAN_MODE=strict``) raises :class:`TpusanError` at the violation
+site; the default ``report`` mode records findings and lets execution
+continue. ``TPUSAN_REPORT=<path>`` writes the findings at process exit —
+``.sarif`` extension selects SARIF 2.1.0, anything else JSON.
+
+Findings reuse tpulint's ``Finding`` shape and ``rule::path::message``
+fingerprints, so runtime findings round-trip through the same
+``--baseline`` machinery and merge with the static SARIF upload in code
+scanning. ``scripts/tpusan_report.py`` diffs a runtime report against the
+static picture (witnessed / never-exercised / unpredicted).
+
+Zero overhead when inactive: the ``named_lock``/``named_rlock``/
+``named_condition`` factories return plain ``threading`` primitives
+unless the sanitizer is active at construction time, and no syscalls are
+patched until ``enable()``.
+"""
+
+import atexit
+import json
+import os
+import threading
+import traceback
+from typing import Dict, List, Optional
+
+from tritonclient_tpu.analysis._engine import Finding
+
+__all__ = [
+    "TpusanError",
+    "capture",
+    "check_leaks",
+    "disable",
+    "enable",
+    "enabled",
+    "findings",
+    "mode",
+    "named_condition",
+    "named_lock",
+    "named_rlock",
+    "note_event_loop",
+    "report_finding",
+    "reset",
+    "write_report",
+]
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SAN_DIR = os.path.dirname(os.path.abspath(__file__))
+
+#: Witness rule metadata for the SARIF driver block. Same ids as the
+#: static rules they pair with — that identity is what lets the two
+#: report streams merge.
+RULES_META = [
+    {
+        "id": "TPU001",
+        "name": "async-blocking",
+        "shortDescription": {
+            "text": "blocking call or slow callback witnessed on a running "
+            "event-loop thread"
+        },
+    },
+    {
+        "id": "TPU006",
+        "name": "shm-lifecycle",
+        "shortDescription": {
+            "text": "shared-memory lifecycle violation witnessed at runtime"
+        },
+    },
+    {
+        "id": "TPU007",
+        "name": "lock-order",
+        "shortDescription": {
+            "text": "lock-order cycle or lock-held-across-blocking-call "
+            "witnessed at runtime"
+        },
+    },
+]
+
+
+class TpusanError(AssertionError):
+    """Raised at the violation site in strict mode (``TPUSAN=strict``)."""
+
+
+class _State:
+    def __init__(self):
+        self.active = False
+        self.mode = "report"
+        self.depth = 0  # enable() nesting
+        self.lock = threading.Lock()
+        self.records: List[dict] = []  # finding dicts incl. stacks
+        self.fingerprints: set = set()  # dedupe: one record per fingerprint
+        self.env_session = False  # activated by TPUSAN env (atexit reports)
+        self.atexit_registered = False
+
+
+_STATE = _State()
+
+
+def _env_flag() -> Optional[str]:
+    raw = os.environ.get("TPUSAN", "").strip().lower()
+    if raw in ("", "0", "false", "off"):
+        return None
+    return raw
+
+
+def enabled() -> bool:
+    return _STATE.active
+
+
+def mode() -> str:
+    return _STATE.mode
+
+
+def strict() -> bool:
+    return _STATE.active and _STATE.mode == "strict"
+
+
+def enable(mode: Optional[str] = None):
+    """Activate the witnesses (idempotent; nests with :func:`disable`).
+
+    ``mode``: ``"report"`` (record, keep running) or ``"strict"`` (raise
+    :class:`TpusanError` at the violation). Defaults to ``TPUSAN_MODE``,
+    then ``TPUSAN=strict``, then ``report``.
+    """
+    from tritonclient_tpu.sanitize import _aio, _blocking, _shm
+
+    with _STATE.lock:
+        _STATE.depth += 1
+        if mode is None:
+            mode = os.environ.get("TPUSAN_MODE", "").strip().lower() or (
+                "strict" if _env_flag() == "strict" else "report"
+            )
+        if mode not in ("report", "strict"):
+            raise ValueError(f"unknown tpusan mode: {mode!r}")
+        _STATE.mode = mode
+        already = _STATE.active
+        _STATE.active = True
+        if not _STATE.atexit_registered:
+            _STATE.atexit_registered = True
+            atexit.register(_atexit_report)
+    if not already:
+        _blocking.install()
+        _shm.install()
+        _aio.install()
+
+
+def disable():
+    """Deactivate and unpatch once every :func:`enable` is balanced."""
+    from tritonclient_tpu.sanitize import _aio, _blocking, _shm
+
+    with _STATE.lock:
+        _STATE.depth = max(0, _STATE.depth - 1)
+        if _STATE.depth:
+            return
+        _STATE.active = False
+    _aio.uninstall()
+    _shm.uninstall()
+    _blocking.uninstall()
+
+
+def reset():
+    """Drop recorded findings and witness state (locks graph, shm states)."""
+    from tritonclient_tpu.sanitize import _locks, _shm
+
+    with _STATE.lock:
+        _STATE.records.clear()
+        _STATE.fingerprints.clear()
+    _locks.reset()
+    _shm.reset()
+
+
+def _project_site(skip_sanitize: bool = True):
+    """(repo-relative path, line, stack text) of the violation site: the
+    innermost frame outside this package (and outside stdlib internals),
+    so fingerprints point at project code the way tpulint's do."""
+    stack = traceback.extract_stack()
+    chosen = None
+    for frame in reversed(stack):
+        fn = os.path.abspath(frame.filename)
+        if skip_sanitize and fn.startswith(_SAN_DIR):
+            continue
+        if fn.startswith(_REPO_ROOT + os.sep):
+            chosen = frame
+            break
+    if chosen is None:  # violation entirely outside the repo: last frame
+        for frame in reversed(stack):
+            if not os.path.abspath(frame.filename).startswith(_SAN_DIR):
+                chosen = frame
+                break
+    path = os.path.abspath(chosen.filename) if chosen else "<unknown>"
+    if path.startswith(_REPO_ROOT + os.sep):
+        path = os.path.relpath(path, _REPO_ROOT)
+    text = "".join(traceback.format_list(stack[-12:]))
+    return path.replace(os.sep, "/"), (chosen.lineno or 1) if chosen else 1, text
+
+
+def report_finding(
+    rule: str,
+    message: str,
+    path: Optional[str] = None,
+    line: Optional[int] = None,
+    stacks: Optional[List[str]] = None,
+):
+    """Record one runtime finding (and raise in strict mode).
+
+    ``path``/``line`` default to the innermost project frame of the
+    current stack. ``message`` must be deterministic (no durations,
+    addresses, thread ids): the ``rule::path::message`` fingerprint is
+    the baseline/code-scanning identity.
+    """
+    if not _STATE.active:
+        return
+    site_path, site_line, site_stack = _project_site()
+    if path is None:
+        path = site_path
+    if line is None:
+        line = site_line
+    record = {
+        "rule": rule,
+        "path": path,
+        "line": int(line),
+        "col": 0,
+        "message": message,
+        "stacks": list(stacks or []) + [site_stack],
+    }
+    fp = f"{rule}::{path}::{message}"
+    record["fingerprint"] = fp
+    with _STATE.lock:
+        if fp not in _STATE.fingerprints:
+            _STATE.fingerprints.add(fp)
+            _STATE.records.append(record)
+    if _STATE.mode == "strict":
+        raise TpusanError(f"tpusan: {rule} {path}:{line}: {message}")
+
+
+def findings() -> List[Finding]:
+    """Recorded findings as tpulint ``Finding`` objects (fingerprint-
+    compatible with the baseline machinery)."""
+    with _STATE.lock:
+        records = list(_STATE.records)
+    return [
+        Finding(r["rule"], r["path"], r["line"], r["col"], r["message"])
+        for r in records
+    ]
+
+
+def records() -> List[dict]:
+    """Raw finding records including captured stacks."""
+    with _STATE.lock:
+        return [dict(r) for r in _STATE.records]
+
+
+class capture:
+    """Context manager isolating findings seeded inside the block.
+
+    Seeded-violation tests run under a session-wide sanitizer; without
+    isolation their deliberate findings would fail the session's
+    zero-finding gate. ``.findings``/``.records`` are live inside the
+    block; on exit the block's findings are removed from the global
+    store (and stay readable on the capture object).
+    """
+
+    def __init__(self):
+        self._taken: Optional[List[dict]] = None
+        self._base = 0
+
+    def __enter__(self):
+        with _STATE.lock:
+            self._base = len(_STATE.records)
+        return self
+
+    @property
+    def records(self) -> List[dict]:
+        if self._taken is not None:
+            return [dict(r) for r in self._taken]
+        with _STATE.lock:
+            return [dict(r) for r in _STATE.records[self._base:]]
+
+    @property
+    def findings(self) -> List[Finding]:
+        return [
+            Finding(r["rule"], r["path"], r["line"], r["col"], r["message"])
+            for r in self.records
+        ]
+
+    def __exit__(self, exc_type, exc, tb):
+        with _STATE.lock:
+            self._taken = _STATE.records[self._base:]
+            del _STATE.records[self._base:]
+            for r in self._taken:
+                _STATE.fingerprints.discard(r["fingerprint"])
+        return False
+
+
+def check_leaks():
+    """Report handles created but never destroyed (TPU006 leak arm).
+
+    Called at process exit for env-activated sessions and by the pytest
+    plugin at session finish; callable any time (e.g. after a test that
+    owns its regions' full lifecycle).
+    """
+    from tritonclient_tpu.sanitize import _shm
+
+    _shm.report_leaks()
+
+
+def write_report(path: str):
+    """Write recorded findings: SARIF 2.1.0 for ``.sarif`` paths, JSON
+    (with stacks) otherwise."""
+    if path.endswith(".sarif"):
+        from tritonclient_tpu.analysis._sarif import render_sarif
+
+        doc = render_sarif(findings(), RULES_META, tool_name="tpusan")
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(doc)
+        return
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"tool": "tpusan", "findings": records()}, f, indent=2)
+        f.write("\n")
+
+
+def render_text() -> str:
+    found = findings()
+    lines = [f.text() for f in found]
+    noun = "finding" if len(found) == 1 else "findings"
+    lines.append(f"tpusan: {len(found)} {noun}")
+    return "\n".join(lines)
+
+
+def _atexit_report():
+    if not _STATE.active:
+        return
+    try:
+        check_leaks()
+    except TpusanError:
+        pass  # strict-mode leak at exit: still reported below
+    except Exception:
+        pass
+    out = os.environ.get("TPUSAN_REPORT", "")
+    if out:
+        try:
+            write_report(out)
+        except OSError:
+            pass
+
+
+# --------------------------------------------------------------------------- #
+# named-lock factories (adoption points in server/_core, shm, gpt_engine)     #
+# --------------------------------------------------------------------------- #
+
+
+def named_lock(name: str):
+    """A ``threading.Lock`` known to the lock-order witness by ``name``.
+
+    Returns a plain lock when the sanitizer is inactive at construction
+    (zero overhead on the hot path); an instrumented wrapper otherwise.
+    tpulint's TPU002/TPU007 recognize this factory as a lock constructor,
+    so adoption does not shrink the static graph.
+    """
+    lock = threading.Lock()
+    if not _STATE.active:
+        return lock
+    from tritonclient_tpu.sanitize._locks import TrackedLock
+
+    return TrackedLock(name, lock, reentrant=False)
+
+
+def named_rlock(name: str):
+    """``threading.RLock`` variant of :func:`named_lock`."""
+    lock = threading.RLock()
+    if not _STATE.active:
+        return lock
+    from tritonclient_tpu.sanitize._locks import TrackedLock
+
+    return TrackedLock(name, lock, reentrant=True)
+
+
+def named_condition(name: str):
+    """``threading.Condition`` known to the lock-order witness by ``name``."""
+    cond = threading.Condition()
+    if not _STATE.active:
+        return cond
+    from tritonclient_tpu.sanitize._locks import TrackedCondition
+
+    return TrackedCondition(name, cond)
+
+
+def note_event_loop():
+    """Opt the calling thread's running loop into watchdog accounting.
+
+    The aio clients call this at construction; it is a no-op when the
+    sanitizer is inactive. The ``Handle._run`` patch already times every
+    loop, so this only lowers the slow-callback threshold source of truth
+    onto loops the project actually owns.
+    """
+    if not _STATE.active:
+        return
+    from tritonclient_tpu.sanitize import _aio
+
+    _aio.note_event_loop()

@@ -1,0 +1,103 @@
+"""Event-loop blocking watchdog (pairs with tpulint TPU001).
+
+Two arms:
+
+* blocking-call: the patched syscalls (``_blocking.py``) call
+  :func:`note_blocking`; a ``time.sleep`` or synchronous connect on a
+  thread that currently runs an asyncio event loop is exactly the bug
+  TPU001 proves statically for ``async def`` bodies — witnessed here for
+  every path that actually executes, including ones the AST cannot see
+  (callbacks, dynamically dispatched handlers).
+* slow-callback: ``asyncio.events.Handle._run`` is wrapped to time each
+  callback. One exceeding the threshold (``TPUSAN_SLOW_CALLBACK_S``,
+  default 1.0 s — generous enough that first-use XLA compiles on a CPU
+  test loop do not trip it; tighten in dedicated runs) is reported with
+  a deterministic message (callback qualname, no duration) so the
+  fingerprint is stable across runs.
+"""
+
+import asyncio
+import functools
+import os
+
+_ORIG_HANDLE_RUN = None
+
+
+def _threshold() -> float:
+    try:
+        return float(os.environ.get("TPUSAN_SLOW_CALLBACK_S", "1.0"))
+    except ValueError:
+        return 1.0
+
+
+def note_event_loop():
+    """Accounting hook for project-owned loops (aio clients call this);
+    the Handle patch is global, so this is currently informational."""
+
+
+def note_blocking(callname: str):
+    from tritonclient_tpu import sanitize
+
+    if asyncio._get_running_loop() is None:
+        return
+    sanitize.report_finding(
+        "TPU001",
+        f"blocking call `{callname}` witnessed on a running event-loop "
+        "thread; use the aio equivalent or an executor",
+    )
+
+
+def _callback_name(handle) -> str:
+    cb = getattr(handle, "_callback", None)
+    if isinstance(cb, functools.partial):
+        cb = cb.func
+    inner = getattr(cb, "__wrapped__", None)
+    if inner is not None:
+        cb = inner
+    for attr in ("__qualname__", "__name__"):
+        name = getattr(cb, attr, None)
+        if name:
+            return name
+    return type(cb).__name__ if cb is not None else "callback"
+
+
+def install():
+    global _ORIG_HANDLE_RUN
+    if _ORIG_HANDLE_RUN is not None:
+        return
+    import time as _time
+
+    from tritonclient_tpu import sanitize
+
+    orig = asyncio.events.Handle._run
+    _ORIG_HANDLE_RUN = orig
+
+    def _run(self):
+        t0 = _time.monotonic()
+        try:
+            return orig(self)
+        finally:
+            if (
+                sanitize.enabled()
+                and _time.monotonic() - t0 > _threshold()
+            ):
+                try:
+                    name = _callback_name(self)
+                except Exception:
+                    name = "callback"
+                try:
+                    sanitize.report_finding(
+                        "TPU001",
+                        f"event-loop callback `{name}` blocked the loop "
+                        "past the slow-callback threshold",
+                    )
+                except sanitize.TpusanError:
+                    raise
+    asyncio.events.Handle._run = _run
+
+
+def uninstall():
+    global _ORIG_HANDLE_RUN
+    if _ORIG_HANDLE_RUN is not None:
+        asyncio.events.Handle._run = _ORIG_HANDLE_RUN
+        _ORIG_HANDLE_RUN = None

@@ -1,0 +1,91 @@
+"""Blocking-syscall patches shared by the lock and event-loop witnesses.
+
+Installed by ``sanitize.enable()`` and removed by ``disable()``; each
+wrapper forwards to the original after notifying:
+
+* ``_locks.note_blocking`` — a *named* lock held across the call is the
+  held-while-blocking arm of the TPU007 witness;
+* ``_aio.note_blocking`` — ``time.sleep``/``socket.create_connection``
+  on a thread with a running event loop is the TPU001 witness (the
+  device/mmap calls are *not* reported there: the aio server deliberately
+  enqueues device work from the loop thread — dispatch-enqueue is
+  non-blocking by design and policed by the slow-callback watchdog
+  instead).
+
+``jax.device_put`` is only patched when jax is already imported at
+enable time (the test conftest imports jax first); a missing jax is a
+skipped patch, never an import.
+"""
+
+import mmap
+import socket
+import sys
+import time
+
+_PATCHED = {}
+
+#: blocking calls the TPU001 (event-loop) witness reports; the TPU007
+#: held-while-blocking arm reports every patched call.
+LOOP_BLOCKING = {"time.sleep", "socket.create_connection"}
+
+
+def _notify(callname: str):
+    from tritonclient_tpu import sanitize
+    from tritonclient_tpu.sanitize import _aio, _locks
+
+    if not sanitize.enabled():
+        return
+    _locks.note_blocking(callname)
+    if callname in LOOP_BLOCKING:
+        _aio.note_blocking(callname)
+
+
+def install():
+    if _PATCHED:
+        return
+
+    orig_sleep = time.sleep
+
+    def sleep(secs):
+        _notify("time.sleep")
+        return orig_sleep(secs)
+
+    _PATCHED["time.sleep"] = (time, "sleep", orig_sleep)
+    time.sleep = sleep
+
+    orig_mmap = mmap.mmap
+
+    def mmap_ctor(*args, **kwargs):
+        _notify("mmap.mmap")
+        return orig_mmap(*args, **kwargs)
+
+    _PATCHED["mmap.mmap"] = (mmap, "mmap", orig_mmap)
+    mmap.mmap = mmap_ctor
+
+    orig_conn = socket.create_connection
+
+    def create_connection(*args, **kwargs):
+        _notify("socket.create_connection")
+        return orig_conn(*args, **kwargs)
+
+    _PATCHED["socket.create_connection"] = (
+        socket, "create_connection", orig_conn,
+    )
+    socket.create_connection = create_connection
+
+    jax = sys.modules.get("jax")
+    if jax is not None and hasattr(jax, "device_put"):
+        orig_put = jax.device_put
+
+        def device_put(*args, **kwargs):
+            _notify("jax.device_put")
+            return orig_put(*args, **kwargs)
+
+        _PATCHED["jax.device_put"] = (jax, "device_put", orig_put)
+        jax.device_put = device_put
+
+
+def uninstall():
+    for mod, attr, orig in _PATCHED.values():
+        setattr(mod, attr, orig)
+    _PATCHED.clear()

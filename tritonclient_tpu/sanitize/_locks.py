@@ -1,0 +1,272 @@
+"""Lock-order witness (pairs with tpulint TPU007).
+
+Instruments the project's *named* ``threading.Lock``/``RLock``/
+``Condition`` instances (created through ``sanitize.named_lock`` and
+friends). Per thread, the witness keeps the ordered list of currently
+held locks with the stack captured at each acquire; every nested acquire
+adds name-level edges to a process-global acquisition graph. A new edge
+closing a cycle is reported with both acquisition stacks — the runtime
+counterpart of TPU007's static with-nesting/calls-under-lock graph.
+
+Two further arms:
+
+* same-instance re-acquire of a non-reentrant lock is reported *before*
+  the acquire blocks (in strict mode that turns a guaranteed deadlock
+  into a diagnosable exception);
+* a named lock held across a known blocking call (``time.sleep``,
+  ``mmap.mmap``, ``socket.create_connection``, ``jax.device_put`` — see
+  ``_blocking.py``) is reported as held-while-blocking.
+
+Name-level identity mirrors the static rule's declaration-level nodes:
+sibling instances of the same declaration share a node, but a same-name
+edge is only recorded when it is literally the same object (two distinct
+regions locking in sequence is not a cycle).
+"""
+
+import threading
+import traceback
+from typing import Dict, List, Set, Tuple
+
+_tls = threading.local()
+
+_GRAPH_LOCK = threading.Lock()
+#: name -> set of names acquired while holding it
+_EDGES: Dict[str, Set[str]] = {}
+#: (a, b) -> (stack holding a, stack acquiring b) for the first sighting
+_EDGE_SITES: Dict[Tuple[str, str], Tuple[str, str]] = {}
+_REPORTED_CYCLES: Set[Tuple[str, ...]] = set()
+
+
+def reset():
+    with _GRAPH_LOCK:
+        _EDGES.clear()
+        _EDGE_SITES.clear()
+        _REPORTED_CYCLES.clear()
+
+
+def _held() -> List:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+class _Held:
+    __slots__ = ("obj", "name", "stack", "count")
+
+    def __init__(self, obj, name, stack):
+        self.obj = obj
+        self.name = name
+        self.stack = stack
+        self.count = 1
+
+
+def held_lock_names() -> List[str]:
+    """Names of tracked locks the calling thread currently holds."""
+    return [h.name for h in _held()]
+
+
+def note_blocking(callname: str):
+    """Called by the patched blocking syscalls: report every tracked lock
+    held by this thread across the call."""
+    from tritonclient_tpu import sanitize
+
+    for h in _held():
+        sanitize.report_finding(
+            "TPU007",
+            f"lock '{h.name}' held across blocking call `{callname}`",
+            stacks=[h.stack],
+        )
+
+
+def _find_path(graph: Dict[str, Set[str]], src: str, dst: str):
+    """Shortest edge path src -> ... -> dst, or None."""
+    if src == dst:
+        return [src]
+    seen = {src}
+    frontier = [[src]]
+    while frontier:
+        nxt = []
+        for path in frontier:
+            for peer in sorted(graph.get(path[-1], ())):
+                if peer == dst:
+                    return path + [peer]
+                if peer not in seen:
+                    seen.add(peer)
+                    nxt.append(path + [peer])
+        frontier = nxt
+    return None
+
+
+def _before_acquire(lock):
+    """Record edges held-locks -> lock; report cycles and self-deadlock.
+
+    Runs before the underlying acquire so a strict-mode report can
+    preempt a guaranteed same-thread deadlock.
+    """
+    from tritonclient_tpu import sanitize
+
+    if not sanitize.enabled():
+        return None
+    held = _held()
+    for h in held:
+        if h.obj is lock._inner or h.obj is lock:
+            if lock._reentrant:
+                return None  # RLock/Condition re-entry: no new edge
+            sanitize.report_finding(
+                "TPU007",
+                f"non-reentrant lock '{lock._name}' re-acquired by the "
+                "holding thread (guaranteed self-deadlock)",
+                stacks=[h.stack],
+            )
+            return None
+    stack = "".join(traceback.format_stack(limit=12))
+    new_cycles = []
+    with _GRAPH_LOCK:
+        for h in held:
+            if h.name == lock._name:
+                continue  # sibling instances of one declaration: no edge
+            edges = _EDGES.setdefault(h.name, set())
+            if lock._name in edges:
+                continue
+            # Adding h.name -> lock._name: a pre-existing path the other
+            # way means the project acquires these declarations in both
+            # orders — the deadlock condition TPU007 proves statically.
+            back = _find_path(_EDGES, lock._name, h.name)
+            edges.add(lock._name)
+            _EDGE_SITES[(h.name, lock._name)] = (h.stack, stack)
+            if back is not None:
+                cycle = back + [lock._name]
+                key = tuple(sorted(set(cycle)))
+                if key not in _REPORTED_CYCLES:
+                    _REPORTED_CYCLES.add(key)
+                    new_cycles.append((cycle, h.stack, stack))
+    for cycle, held_stack, acq_stack in new_cycles:
+        sanitize.report_finding(
+            "TPU007",
+            "lock-order cycle witnessed at runtime: "
+            + " -> ".join(f"'{n}'" for n in cycle),
+            stacks=[held_stack, acq_stack],
+        )
+    return stack
+
+
+def _after_acquire(lock, stack):
+    held = _held()
+    for h in held:
+        if h.obj is lock._inner:
+            h.count += 1
+            return
+    held.append(
+        _Held(
+            lock._inner,
+            lock._name,
+            stack or "".join(traceback.format_stack(limit=12)),
+        )
+    )
+
+
+def _after_release(lock):
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i].obj is lock._inner:
+            held[i].count -= 1
+            if held[i].count <= 0:
+                del held[i]
+            return
+
+
+class TrackedLock:
+    """Witness wrapper around a ``threading.Lock``/``RLock``."""
+
+    _is_tpusan_tracked = True
+
+    def __init__(self, name: str, inner, reentrant: bool):
+        self._name = name
+        self._inner = inner
+        self._reentrant = reentrant
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        stack = _before_acquire(self)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            _after_acquire(self, stack)
+        return got
+
+    def release(self):
+        self._inner.release()
+        _after_release(self)
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"TrackedLock({self._name!r}, {self._inner!r})"
+
+
+class TrackedCondition:
+    """Witness wrapper around a ``threading.Condition``.
+
+    ``wait`` drops the held entry for its duration (the underlying
+    condition releases the lock while waiting) and restores it on wakeup.
+    """
+
+    _is_tpusan_tracked = True
+    _reentrant = True  # Condition's default lock is an RLock
+
+    def __init__(self, name: str, inner: threading.Condition):
+        self._name = name
+        self._cond = inner
+        # TrackedLock-shaped view over the condition's underlying lock so
+        # the shared acquire/release bookkeeping applies unchanged.
+        self._inner = inner._lock  # the RLock inside the Condition
+
+    def acquire(self, *args):
+        stack = _before_acquire(self)
+        got = self._cond.acquire(*args)
+        if got:
+            _after_acquire(self, stack)
+        return got
+
+    def release(self):
+        self._cond.release()
+        _after_release(self)
+
+    def wait(self, timeout=None):
+        _after_release(self)
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            _after_acquire(self, None)
+
+    def wait_for(self, predicate, timeout=None):
+        _after_release(self)
+        try:
+            return self._cond.wait_for(predicate, timeout)
+        finally:
+            _after_acquire(self, None)
+
+    def notify(self, n: int = 1):
+        self._cond.notify(n)
+
+    def notify_all(self):
+        self._cond.notify_all()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"TrackedCondition({self._name!r})"

@@ -1,0 +1,250 @@
+"""Shared-memory lifecycle witness (pairs with tpulint TPU006).
+
+Drives the ``create -> register -> set/read -> unregister -> destroy``
+state machine on *real* calls: the module-level APIs of both
+``utils/shared_memory`` (system plane) and ``utils/tpu_shared_memory``
+(device plane) are wrapped at enable time, and the server-side
+registries (``server/_core.SystemShmRegistry``/``TpuShmRegistry``)
+report register/unregister at their single choke points. State is keyed
+by ``(kind, region name)`` — the same identity the protocol uses.
+
+Violations (strict mode raises, report mode records):
+
+* use-after-unregister — ``set_*``/``get_contents``/``as_*`` on a region
+  whose registration was dropped (the parked-PjRt-buffer corruption
+  hazard on the zero-copy plane);
+* use-after-destroy — any use after ``destroy_shared_memory_region``;
+* double-register — registering a name that is already registered
+  without an intervening unregister;
+* destroy-while-registered — destroying a region the server still maps;
+* leaked handles — regions created but never destroyed, reported by
+  :func:`report_leaks` (process exit / pytest session finish).
+
+Events only fire while the sanitizer is active; the wrappers forward to
+the originals first where failure must not change state (a register that
+raises never marks the region registered).
+"""
+
+import threading
+from typing import Dict, Optional, Tuple
+
+_LOCK = threading.Lock()
+#: (kind, name) -> "created" | "registered" | "unregistered" | "destroyed"
+_STATES: Dict[Tuple[str, str], str] = {}
+_PATCHED = []
+
+
+def reset():
+    with _LOCK:
+        _STATES.clear()
+
+
+def _report(message: str):
+    from tritonclient_tpu import sanitize
+
+    sanitize.report_finding("TPU006", message)
+
+
+def _set_state(kind: str, name: str, state: str):
+    with _LOCK:
+        _STATES[(kind, name)] = state
+
+
+def _get_state(kind: str, name: str) -> Optional[str]:
+    with _LOCK:
+        return _STATES.get((kind, name))
+
+
+def on_create(kind: str, name: str):
+    # Re-creating a name after destroy is the normal reuse pattern;
+    # leak detection happens at exit, not here.
+    _set_state(kind, name, "created")
+
+
+def on_register(kind: str, name: str):
+    if _get_state(kind, name) == "registered":
+        _report(
+            f"{kind} shared-memory region '{name}' registered twice "
+            "without an intervening unregister"
+        )
+        return
+    _set_state(kind, name, "registered")
+
+
+def on_unregister(kind: str, name: Optional[str]):
+    with _LOCK:
+        if name:
+            keys = [(kind, name)] if (kind, name) in _STATES else []
+        else:  # unregister-all for this plane
+            keys = [k for k, s in _STATES.items()
+                    if k[0] == kind and s == "registered"]
+        for key in keys:
+            if _STATES[key] == "registered":
+                _STATES[key] = "unregistered"
+
+
+def on_use(kind: str, name: str, what: str):
+    state = _get_state(kind, name)
+    if state == "unregistered":
+        _report(
+            f"{kind} shared-memory region '{name}' used ({what}) after "
+            "unregister"
+        )
+    elif state == "destroyed":
+        _report(
+            f"{kind} shared-memory region '{name}' used ({what}) after "
+            "destroy"
+        )
+
+
+def on_destroy(kind: str, name: str):
+    if _get_state(kind, name) == "registered":
+        _report(
+            f"{kind} shared-memory region '{name}' destroyed while still "
+            "registered with the server"
+        )
+    _set_state(kind, name, "destroyed")
+
+
+def report_leaks():
+    with _LOCK:
+        leaked = sorted(
+            key for key, state in _STATES.items() if state != "destroyed"
+        )
+    for kind, name in leaked:
+        _report(
+            f"{kind} shared-memory region '{name}' was never destroyed "
+            "(leaked handle at exit)"
+        )
+
+
+# --------------------------------------------------------------------------- #
+# patch points                                                                #
+# --------------------------------------------------------------------------- #
+
+
+def _active() -> bool:
+    from tritonclient_tpu import sanitize
+
+    return sanitize.enabled()
+
+
+def _wrap_module_fn(mod, attr, event):
+    """Patch ``mod.attr`` so a successful call emits ``event(result,
+    *args)``; the original result passes through untouched."""
+    orig = getattr(mod, attr)
+
+    def wrapper(*args, **kwargs):
+        out = orig(*args, **kwargs)
+        if _active():
+            event(out, *args, **kwargs)  # strict-mode TpusanError surfaces
+        return out
+
+    _PATCHED.append((mod, attr, orig))
+    setattr(mod, attr, wrapper)
+
+
+def _region_name(handle) -> str:
+    return getattr(handle, "triton_shm_name", str(handle))
+
+
+def install():
+    if _PATCHED:
+        return
+    import tritonclient_tpu.utils.tpu_shared_memory as tpushm
+
+    _wrap_module_fn(
+        tpushm, "create_shared_memory_region",
+        lambda out, *a, **k: on_create("tpu", _region_name(out)),
+    )
+    _wrap_module_fn(
+        tpushm, "create_sharded_memory_region",
+        lambda out, *a, **k: on_create("tpu", _region_name(out)),
+    )
+    for fn, what in (
+        ("set_shared_memory_region", "set"),
+        ("set_shared_memory_region_from_dlpack", "set"),
+        ("get_contents_as_numpy", "read"),
+        ("as_shared_memory_tensor", "read"),
+    ):
+        _wrap_module_fn(
+            tpushm, fn,
+            lambda out, h, *a, _w=what, **k: on_use(
+                "tpu", _region_name(h), _w
+            ),
+        )
+    _wrap_module_fn(
+        tpushm, "destroy_shared_memory_region",
+        lambda out, h, *a, **k: on_destroy("tpu", _region_name(h)),
+    )
+
+    try:
+        import tritonclient_tpu.utils.shared_memory as sysshm
+    except Exception:  # pragma: no cover - native lib genuinely absent
+        sysshm = None
+    if sysshm is not None:
+        _wrap_module_fn(
+            sysshm, "create_shared_memory_region",
+            lambda out, *a, **k: on_create("system", _region_name(out)),
+        )
+        for fn, what in (
+            ("set_shared_memory_region", "set"),
+            ("set_shared_memory_region_from_dlpack", "set"),
+            ("get_contents_as_numpy", "read"),
+        ):
+            _wrap_module_fn(
+                sysshm, fn,
+                lambda out, h, *a, _w=what, **k: on_use(
+                    "system", _region_name(h), _w
+                ),
+            )
+        _wrap_module_fn(
+            sysshm, "destroy_shared_memory_region",
+            lambda out, h, *a, **k: on_destroy("system", _region_name(h)),
+        )
+
+    from tritonclient_tpu.server import _core
+
+    def _registry_events(cls, kind):
+        orig_register = cls.register
+        orig_unregister = cls.unregister
+
+        def register(self, name, *args, **kwargs):
+            if not _active():
+                return orig_register(self, name, *args, **kwargs)
+            # Checked BEFORE the call: the server's register is a replace
+            # (the old mapping is dropped silently), so double-register
+            # must be witnessed at the protocol level. A register that
+            # then FAILS rolls the state machine back — a rejected handle
+            # never advances the region's lifecycle.
+            prev = _get_state(kind, name)
+            on_register(kind, name)
+            try:
+                return orig_register(self, name, *args, **kwargs)
+            except BaseException:
+                with _LOCK:
+                    if prev is None:
+                        _STATES.pop((kind, name), None)
+                    else:
+                        _STATES[(kind, name)] = prev
+                raise
+
+        def unregister(self, name, *args, **kwargs):
+            out = orig_unregister(self, name, *args, **kwargs)
+            if _active():
+                on_unregister(kind, name)
+            return out
+
+        _PATCHED.append((cls, "register", orig_register))
+        _PATCHED.append((cls, "unregister", orig_unregister))
+        cls.register = register
+        cls.unregister = unregister
+
+    _registry_events(_core.SystemShmRegistry, "system")
+    _registry_events(_core.TpuShmRegistry, "tpu")
+
+
+def uninstall():
+    for obj, attr, orig in _PATCHED:
+        setattr(obj, attr, orig)
+    _PATCHED.clear()

@@ -21,6 +21,7 @@ from typing import Dict, Iterator, List, Optional, Union
 
 import numpy as np
 
+from tritonclient_tpu import sanitize
 from tritonclient_tpu._tracing import TraceCollector, configure_logging
 from tritonclient_tpu.protocol._literals import SERVER_EXTENSIONS
 from tritonclient_tpu.utils import (
@@ -120,7 +121,9 @@ class SystemShmRegistry:
 
     def __init__(self):
         self._regions: Dict[str, dict] = {}
-        self._lock = threading.Lock()
+        # Named for the tpusan lock-order witness (plain threading.Lock
+        # when the sanitizer is inactive).
+        self._lock = sanitize.named_lock("SystemShmRegistry._lock")
         # Bumped on every (un)register: lets per-stream request-parse caches
         # (server/_grpc.py) invalidate when a region's identity could change.
         self.generation = 0
@@ -235,7 +238,7 @@ class TpuShmRegistry:
 
     def __init__(self):
         self._regions: Dict[str, dict] = {}
-        self._lock = threading.Lock()
+        self._lock = sanitize.named_lock("TpuShmRegistry._lock")
         # Same cache-invalidation contract as SystemShmRegistry.generation.
         self.generation = 0
 
@@ -567,19 +570,23 @@ class _DynamicBatcher:
 
     def __init__(self, core, max_queue_delay_us: int = 0):
         self.core = core
-        self._cv = threading.Condition()
+        self._cv = sanitize.named_condition("_DynamicBatcher._cv")
         self._queue: List[_BatchSlot] = []
         # Triton's dynamic_batching.max_queue_delay_microseconds: the
         # dispatcher holds a forming batch open up to this long (or until
         # the row cap) before dispatching — but only under rate pressure
         # (see _run). 0 = natural batching only.
         self.max_queue_delay_us = int(max_queue_delay_us)
-        # (timestamp, signature) of recent arrivals for the rate half of
-        # the pressure gate. Bounded deque + stale popleft keeps appends
-        # O(1); beyond the cap the rate is trivially "pressured" anyway.
+        # PER-SIGNATURE arrival windows for the rate half of the pressure
+        # gate: one shared deque let a hot shape evict another signature's
+        # rate history and flip its serialize/hold regime (ADVICE r5 #2).
+        # Each signature keeps its own bounded deque of timestamps —
+        # appends stay O(1), and beyond a window's cap that signature's
+        # rate is trivially "pressured" anyway.
         import collections
 
-        self._arrivals = collections.deque(maxlen=512)
+        self._arrival_deque = collections.deque  # bound per signature
+        self._arrivals: Dict[tuple, "collections.deque"] = {}
         # Arrivals the rate gate must promise within one delay window
         # before the dispatcher holds (rate * delay >= this).
         try:
@@ -664,11 +671,9 @@ class _DynamicBatcher:
             self._model, self._stats, self._cap = model, stats, cap
             self._queue.append(slot)
             # Arrival bookkeeping feeds both the hold gate and the
-            # serialize/spread regime switch — always on.
-            now = time.monotonic()
-            self._arrivals.append((now, signature))
-            while self._arrivals and now - self._arrivals[0][0] > 0.1:
-                self._arrivals.popleft()
+            # serialize/spread regime switch — always on. Per-signature
+            # windows: one shape's burst cannot evict another's history.
+            self._note_arrival(signature, time.monotonic())
             self._threads = [t for t in self._threads if t.is_alive()]
             if len(self._threads) < self._n_dispatchers:
                 t = threading.Thread(
@@ -679,6 +684,27 @@ class _DynamicBatcher:
                 t.start()
             self._cv.notify_all()
         return slot
+
+    def _note_arrival(self, signature, now: float):  # tpulint: disable=TPU002 - caller holds self._cv
+        """Record one arrival in the signature's own rate window."""
+        window = self._arrivals.get(signature)
+        if window is None:
+            if len(self._arrivals) > 64:
+                # Bound churn from one-off shapes (same policy as the
+                # _serialized regime map).
+                self._arrivals.clear()
+            window = self._arrivals[signature] = self._arrival_deque(
+                maxlen=128
+            )
+        window.append(now)
+        while window and now - window[0] > 0.1:
+            window.popleft()
+
+    def _recent(self, signature, now: float) -> int:  # tpulint: disable=TPU002 - caller holds self._cv
+        """Arrivals of ``signature`` in the last 100 ms."""
+        return sum(
+            1 for t in self._arrivals.get(signature, ()) if now - t < 0.1
+        )
 
     def wait(self, slot: _BatchSlot, model) -> CoreResponse:
         extensions = 0
@@ -746,9 +772,7 @@ class _DynamicBatcher:
         # where fixed per-dispatch CPU (~1 ms) becomes a ~third of a
         # core, env-tunable for bigger hosts.
         now = time.monotonic()
-        recent = sum(
-            1 for t, sg in self._arrivals if sg == signature and now - t < 0.1
-        )
+        recent = self._recent(signature, now)
         # Hysteresis: a workload sitting AT the threshold would flap
         # between regimes (each flap pays the worse policy's cost);
         # enter serialize at the threshold, leave only when the rate
@@ -882,7 +906,7 @@ class InferenceCore:
         # name -> the repository model shadowed by a file-override load
         # (restored on the next plain/config-only load, Triton semantics).
         self._overridden: Dict[str, object] = {}
-        self._lock = threading.Lock()
+        self._lock = sanitize.named_lock("InferenceCore._lock")
         self.system_shm = SystemShmRegistry()
         self.tpu_shm = TpuShmRegistry()
         # Trace settings: the "" entry is the complete global dict; model

@@ -692,15 +692,21 @@ class _Servicer:
                     None,
                 )
             if fin is not None:
-                def fin_traced(f=fin, c=creq):
+                fin_once = _memoize_once(fin)
+
+                def fin_traced(f=fin_once, c=creq):
                     try:
                         return f()
                     finally:
                         _finish_trace(c)  # idempotent across barrier+yielder
 
                 def barrier(f=fin_traced):
+                    # Memoized: a wedged batch's timeout is paid ONCE here;
+                    # the yielder replays the cached outcome instantly and
+                    # surfaces the 500 at the intended ~300s bound instead
+                    # of re-waiting from scratch (ADVICE r5 #3).
                     try:
-                        f()  # wait() is idempotent; yielder re-calls it
+                        f()
                     except Exception:
                         pass  # the yielder reports the error in order
                 return ("deferred", request, fin_traced), barrier
@@ -767,6 +773,32 @@ class _Servicer:
                 yield from msgs
         finally:
             stop.set()
+
+
+def _memoize_once(fn):
+    """Call ``fn`` at most once; later calls replay the cached result or
+    re-raise the cached exception.
+
+    The serial-stream barrier and the response yielder both finalize the
+    same slot; without memoization an exception outcome (e.g. the
+    batcher's bounded wait timing out on a wedged batch) was swallowed by
+    the barrier and the yielder re-entered the full wait from scratch —
+    roughly doubling the intended bound before the client saw the 500.
+    """
+    state: list = []
+
+    def call():
+        if not state:
+            try:
+                state.append(("ok", fn()))
+            except BaseException as e:
+                state.append(("err", e))
+        kind, value = state[0]
+        if kind == "err":
+            raise value
+        return value
+
+    return call
 
 
 def _finalize_unary(cresp) -> pb.ModelInferResponse:

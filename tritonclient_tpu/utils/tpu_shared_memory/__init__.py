@@ -34,6 +34,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from tritonclient_tpu import sanitize
 from tritonclient_tpu.utils import np_to_triton_dtype, triton_to_np_dtype
 
 
@@ -42,7 +43,8 @@ class TpuSharedMemoryException(Exception):
 
 
 _registry: Dict[str, "TpuSharedMemoryRegion"] = {}
-_registry_lock = threading.Lock()
+# Named for the tpusan lock-order witness (plain lock when inactive).
+_registry_lock = sanitize.named_lock("tpu_shared_memory:_registry_lock")
 
 
 def _jax():
@@ -406,7 +408,7 @@ class TpuSharedMemoryRegion:
         self.device_id = int(device_id)
         self.device = devices[device_id]
         self.uuid = _uuid_mod.uuid4().hex
-        self._lock = threading.Lock()
+        self._lock = sanitize.named_lock("TpuSharedMemoryRegion._lock")
         self._parked: Dict[int, object] = {}  # offset -> jax.Array
         self._mirror = bytearray(self.byte_size)
         self._destroyed = False
@@ -496,26 +498,37 @@ class TpuSharedMemoryRegion:
         np_dtype = _np_dtype_for(datatype)
         nbytes = math.prod(shape) * np_dtype.itemsize
         self._check_range(offset, nbytes)
+        released_view = None
         with self._lock:
             parked = self._parked.get(offset)
             if parked is not None and _nbytes(parked) == nbytes:
                 if isinstance(parked, BatchRowView):
                     if parked.dtype == np_dtype and parked.shape == shape:
+                        # device_slice falls back to host numpy once the
+                        # shared base has been released (host copy landed)
+                        # — see its docstring; the re-upload for device
+                        # readers happens below, OUTSIDE the lock.
                         out = parked.device_slice()
                         if isinstance(out, np.ndarray) and not prefer_host:
-                            # Base already released to host (SharedBatch):
-                            # honor the jax.Array contract by re-uploading
-                            # — and re-park the uploaded array (same
-                            # offset/byte range) so repeat device readers
-                            # pay the upload once, as pre-release.
-                            out = jax.device_put(out, self.device)
-                            self._parked[offset] = out
-                        return out
-                    # Reinterpretation: gather through the mirror below.
+                            released_view = parked
+                        else:
+                            return out
+                    # else: reinterpretation gathers through the mirror.
                 elif parked.dtype == np_dtype and parked.shape == shape:
                     return parked
                 else:
                     return parked.view(np_dtype).reshape(shape)
+        if released_view is not None:
+            # Base already released to host (SharedBatch): honor the
+            # jax.Array contract by re-uploading — WITHOUT holding the
+            # region lock across the upload (~ms on tunneled links, and
+            # it would serialize every concurrent reader/writer — ADVICE
+            # r5 #5). Re-park through the CAS so repeat device readers
+            # pay the upload once; a racing writer that replaced the
+            # entry meanwhile wins and the upload is returned unparked.
+            arr = jax.device_put(out, self.device)
+            self._replace_parked(offset, released_view, arr)
+            return arr
         host = np.frombuffer(
             self.read_bytes(offset, nbytes), dtype=np_dtype
         ).reshape(shape)
@@ -644,7 +657,7 @@ class TpuShardedMemoryRegion(TpuSharedMemoryRegion):
         self.device = devices[0]
         self.device_id = int(self.device.id)
         self.uuid = _uuid_mod.uuid4().hex
-        self._lock = threading.Lock()
+        self._lock = sanitize.named_lock("TpuShardedMemoryRegion._lock")
         self._parked: Dict[int, object] = {}
         self._mirror = bytearray(self.byte_size)
         self._destroyed = False
