@@ -15,6 +15,11 @@ Checks (exit 1 with one line per violation):
   * histogram families: ``le`` bucket bounds strictly ascending, cumulative
     bucket values non-decreasing, a ``+Inf`` bucket present, ``_count``
     equal to the ``+Inf`` bucket, and ``_sum`` present and >= 0
+  * summary families (the sketch-backed ``*_quantiles`` rows): every
+    ``quantile`` label in [0, 1], values monotone non-decreasing in the
+    quantile, ``_sum``/``_count`` present and >= 0
+  * counter samples non-negative; gauges reporting ages (``*_age_us``)
+    non-negative (a negative age means a broken clock, not a quiet queue)
 """
 
 import re
@@ -121,6 +126,90 @@ def check_exposition(text: str) -> List[str]:
             errors.append(f"metric family {family} has no # TYPE")
 
     for family, ftype in types.items():
+        if ftype == "counter":
+            for labels, value, name, lineno in samples.get(family, []):
+                if value < 0:
+                    errors.append(
+                        f"line {lineno}: counter {name} value {value} < 0"
+                    )
+            continue
+        if ftype == "gauge":
+            if family.endswith("_age_us"):
+                for labels, value, name, lineno in samples.get(family, []):
+                    if value < 0:
+                        errors.append(
+                            f"line {lineno}: age gauge {name} value "
+                            f"{value} < 0"
+                        )
+            continue
+        if ftype == "summary":
+            # Group per label set (minus 'quantile'); quantile rows must be
+            # valid quantiles and monotone non-decreasing in q, _sum/_count
+            # present and non-negative.
+            series: Dict[tuple, dict] = {}
+            for labels, value, name, lineno in samples.get(family, []):
+                key = tuple(sorted(
+                    (k, v) for k, v in labels.items() if k != "quantile"
+                ))
+                entry = series.setdefault(
+                    key, {"quantiles": [], "sum": None, "count": None}
+                )
+                if name == family:
+                    if "quantile" not in labels:
+                        errors.append(
+                            f"line {lineno}: summary sample without "
+                            "'quantile' label"
+                        )
+                        continue
+                    try:
+                        q = float(labels["quantile"])
+                    except ValueError:
+                        errors.append(
+                            f"line {lineno}: non-numeric quantile "
+                            f"{labels['quantile']!r}"
+                        )
+                        continue
+                    if not 0.0 <= q <= 1.0:
+                        errors.append(
+                            f"line {lineno}: quantile {q} outside [0, 1]"
+                        )
+                    entry["quantiles"].append((q, value, lineno))
+                elif name == family + "_sum":
+                    entry["sum"] = value
+                elif name == family + "_count":
+                    entry["count"] = value
+            for key, entry in series.items():
+                label_desc = "{%s}" % ",".join(
+                    f'{k}="{v}"' for k, v in key
+                )
+                prev = None
+                for q, value, lineno in sorted(entry["quantiles"]):
+                    if value < 0:
+                        errors.append(
+                            f"line {lineno}: {family}{label_desc} "
+                            f'quantile="{q}" value {value} < 0'
+                        )
+                    if prev is not None and value < prev:
+                        errors.append(
+                            f"line {lineno}: {family}{label_desc} "
+                            f'quantile="{q}" value {value} < previous '
+                            f"{prev} (quantiles must be non-decreasing "
+                            "in q)"
+                        )
+                    prev = value
+                if entry["sum"] is None:
+                    errors.append(f"{family}{label_desc}: missing _sum")
+                elif entry["sum"] < 0:
+                    errors.append(
+                        f"{family}{label_desc}: _sum {entry['sum']} < 0"
+                    )
+                if entry["count"] is None:
+                    errors.append(f"{family}{label_desc}: missing _count")
+                elif entry["count"] < 0:
+                    errors.append(
+                        f"{family}{label_desc}: _count {entry['count']} < 0"
+                    )
+            continue
         if ftype != "histogram":
             continue
         # Group this family's series per label set (minus 'le').

@@ -1,0 +1,552 @@
+#!/usr/bin/env python
+"""What makes p99 p99: per-stage tail attribution from a flight-recorder
+dump or trace files.
+
+``trace_report.py`` summarizes *sampled* traces; this report answers the
+tail question the admission-control work (ROADMAP item 1) needs evidence
+for: which stage's time separates the slowest requests from typical
+ones, and does the batcher backlog predict it. It consumes
+
+* a flight-recorder dump (``GET v2/debug/flight_recorder`` /
+  ``client.get_flight_recorder()`` saved to a file) — the primary input:
+  tail-retained records with stage clocks and batcher context; or
+* any ``trace_mode`` trace file (triton / otlp / perfetto, including
+  perf_analyzer ``--trace-out`` merged files) — stages are re-derived
+  from the span tree.
+
+and reports:
+
+* **per-stage share** of request time for requests at/above the tail
+  quantile (default p95) vs at/below the head quantile (default p50),
+  plus each stage's share of the tail *excess* (mean tail minus mean
+  head) — the excess column names the dominant stage;
+* **backlog correlation**: Pearson r between
+  ``batcher.backlog_at_admission`` and request duration, with mean
+  backlog in the tail vs head groups;
+* **per-signature breakdown** (``batcher.signature``, falling back to
+  the model name): count, p50/p99, tail share, mean backlog.
+
+Usage::
+
+    python scripts/tail_report.py DUMP_OR_TRACE_FILE [--json]
+        [--tail-q 0.95] [--head-q 0.5] [--slowest N]
+    python scripts/tail_report.py --self-check
+
+``--self-check`` synthesizes a dump with a known dominant stage and a
+seeded backlog/duration relationship, runs the full pipeline, and exits
+non-zero unless the report recovers both — the CI smoke test for the
+attribution path.
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+from typing import Dict, List, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from tritonclient_tpu import _otel  # noqa: E402
+from tritonclient_tpu._tracing import STAGE_ORDER, stage_clocks  # noqa: E402
+
+# Span-name -> stage-name mapping for trace-file inputs (the span tree
+# has no ingress/batch-formation resolution; those stages exist only in
+# flight-recorder dumps, which carry the raw stage clocks).
+_SPAN_STAGES = {
+    _otel.SPAN_QUEUE_WAIT: "queue-wait",
+    _otel.SPAN_COMPUTE: "compute",
+    _otel.SPAN_RESPONSE_MARSHAL: "response-marshal",
+}
+
+
+def _percentile(sorted_values, pct: float):
+    if not sorted_values:
+        return 0
+    idx = min(
+        len(sorted_values) - 1,
+        math.ceil(pct / 100.0 * len(sorted_values)) - 1,
+    )
+    return sorted_values[max(idx, 0)]
+
+
+# --------------------------------------------------------------------------- #
+# loading                                                                     #
+# --------------------------------------------------------------------------- #
+
+
+def _record_from_flight(rec: dict) -> Optional[dict]:
+    stages = rec.get("stages_us")
+    if stages is None:
+        ts = rec.get("timestamps") or {}
+        stages = {k: v // 1000 for k, v in stage_clocks(ts).items()}
+    duration = rec.get("duration_us")
+    if duration is None:
+        duration = sum(stages.values())
+    attrs = rec.get("attributes") or {}
+    return {
+        "duration_us": int(duration),
+        "stages_us": {k: int(v) for k, v in stages.items()},
+        "model": rec.get("model_name", ""),
+        "request_id": rec.get("request_id", ""),
+        "status": rec.get("status", "ok"),
+        "signature": attrs.get(
+            "batcher.signature", rec.get("model_name", "") or "?"
+        ),
+        "backlog": attrs.get("batcher.backlog_at_admission"),
+        "batch_size": attrs.get("batch.size"),
+        "attributes": attrs,
+    }
+
+
+def _records_from_spans(spans: List[dict]) -> List[dict]:
+    by_trace: Dict[str, List[dict]] = {}
+    for span in spans:
+        by_trace.setdefault(span["trace_id"], []).append(span)
+    records = []
+    for members in by_trace.values():
+        handler = next(
+            (m for m in members if m["name"] == _otel.SPAN_REQUEST_HANDLER),
+            None,
+        )
+        if handler is None:
+            continue
+        stages: Dict[str, int] = {}
+        attrs: Dict[str, object] = {}
+        for m in members:
+            stage = _SPAN_STAGES.get(m["name"])
+            if stage is not None:
+                stages[stage] = m["duration_ns"] // 1000
+            for key, value in (m.get("attributes") or {}).items():
+                attrs.setdefault(key, value)
+        records.append({
+            "duration_us": handler["duration_ns"] // 1000,
+            "stages_us": stages,
+            "model": attrs.get("model", attrs.get("model.name", "")),
+            "request_id": attrs.get(
+                "request_id", attrs.get("request.id", "")
+            ),
+            "status": attrs.get("flight.status", "ok"),
+            "signature": attrs.get(
+                "batcher.signature",
+                attrs.get("model", attrs.get("model.name", "")) or "?",
+            ),
+            "backlog": attrs.get("batcher.backlog_at_admission"),
+            "batch_size": attrs.get("batch.size"),
+            "attributes": attrs,
+        })
+    return records
+
+
+def load_records(path: str) -> List[dict]:
+    """Normalize a flight dump or any trace-mode file to analysis records:
+    {duration_us, stages_us, model, signature, backlog, status, ...}."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and doc.get("kind") == "flight_recorder":
+        out = [_record_from_flight(r) for r in doc.get("records", [])]
+        return [r for r in out if r is not None]
+    return _records_from_spans(_otel.load_spans(doc))
+
+
+# --------------------------------------------------------------------------- #
+# analysis                                                                    #
+# --------------------------------------------------------------------------- #
+
+
+def _stage_names(records: List[dict]) -> List[str]:
+    seen = {s for r in records for s in r["stages_us"]}
+    ordered = [s for s in STAGE_ORDER if s in seen]
+    return ordered + sorted(seen - set(ordered))
+
+
+def _group_stats(records: List[dict], stages: List[str]) -> dict:
+    total = sum(r["duration_us"] for r in records)
+    mean = total / len(records) if records else 0.0
+    sums = {
+        s: sum(r["stages_us"].get(s, 0) for r in records) for s in stages
+    }
+    staged = sum(sums.values())
+    return {
+        "count": len(records),
+        "mean_us": round(mean, 1),
+        "stage_mean_us": {
+            s: round(sums[s] / len(records), 1) if records else 0.0
+            for s in stages
+        },
+        # Share of the *staged* time (the clocks partition the request,
+        # but partial records may miss stages; normalizing by the staged
+        # sum keeps the shares summing to 1).
+        "stage_share": {
+            s: round(sums[s] / staged, 4) if staged else 0.0
+            for s in stages
+        },
+    }
+
+
+def _pearson(xs: List[float], ys: List[float]) -> Optional[float]:
+    n = len(xs)
+    if n < 3:
+        return None
+    mx, my = sum(xs) / n, sum(ys) / n
+    cov = sum((x - mx) * (y - my) for x, y in zip(xs, ys))
+    vx = sum((x - mx) ** 2 for x in xs)
+    vy = sum((y - my) ** 2 for y in ys)
+    if vx <= 0 or vy <= 0:
+        return None
+    return cov / math.sqrt(vx * vy)
+
+
+def analyze(records: List[dict], tail_q: float = 0.95,
+            head_q: float = 0.50) -> dict:
+    """The attribution document: tail vs head stage shares, the dominant
+    stage of the tail excess, backlog correlation, per-signature rows."""
+    if not records:
+        raise ValueError("no records to analyze")
+    stages = _stage_names(records)
+    durations = sorted(r["duration_us"] for r in records)
+    tail_cut = _percentile(durations, tail_q * 100)
+    head_cut = _percentile(durations, head_q * 100)
+    tail = [r for r in records if r["duration_us"] >= tail_cut]
+    head = [r for r in records if r["duration_us"] <= head_cut]
+    tail_stats = _group_stats(tail, stages)
+    head_stats = _group_stats(head, stages)
+
+    # The tail *excess*: how much more of each stage a tail request pays
+    # than a head request. Its largest positive component is the answer
+    # to "what makes p99 p99".
+    excess = {
+        s: max(
+            tail_stats["stage_mean_us"][s] - head_stats["stage_mean_us"][s],
+            0.0,
+        )
+        for s in stages
+    }
+    excess_total = sum(excess.values())
+    excess_share = {
+        s: round(v / excess_total, 4) if excess_total else 0.0
+        for s, v in excess.items()
+    }
+    dominant = (
+        max(excess_share, key=lambda s: excess_share[s])
+        if excess_total else None
+    )
+
+    # Backlog-depth correlation over every record that carries the
+    # admission stamp.
+    stamped = [r for r in records if r["backlog"] is not None]
+    corr = _pearson(
+        [float(r["backlog"]) for r in stamped],
+        [float(r["duration_us"]) for r in stamped],
+    )
+
+    def mean_backlog(group):
+        vals = [float(r["backlog"]) for r in group if r["backlog"] is not None]
+        return round(sum(vals) / len(vals), 2) if vals else None
+
+    # Per-signature rows: the router/admission work consumes these.
+    by_sig: Dict[str, List[dict]] = {}
+    for r in records:
+        by_sig.setdefault(str(r["signature"]), []).append(r)
+    tail_ids = {id(r) for r in tail}
+    signatures = []
+    for sig, members in sorted(by_sig.items(),
+                               key=lambda kv: -len(kv[1])):
+        ds = sorted(m["duration_us"] for m in members)
+        signatures.append({
+            "signature": sig,
+            "model": members[0]["model"],
+            "count": len(members),
+            "p50_us": _percentile(ds, 50),
+            "p99_us": _percentile(ds, 99),
+            "tail_count": sum(1 for m in members if id(m) in tail_ids),
+            "mean_backlog": mean_backlog(members),
+        })
+
+    return {
+        "records": len(records),
+        "statuses": {
+            status: sum(1 for r in records if r["status"] == status)
+            for status in sorted({r["status"] for r in records})
+        },
+        "tail_q": tail_q,
+        "head_q": head_q,
+        "tail_cut_us": tail_cut,
+        "head_cut_us": head_cut,
+        "tail": tail_stats,
+        "head": head_stats,
+        "excess_us": {s: round(v, 1) for s, v in excess.items()},
+        "excess_share": excess_share,
+        "dominant_stage": dominant,
+        "backlog": {
+            "stamped": len(stamped),
+            "pearson_r": round(corr, 4) if corr is not None else None,
+            "tail_mean": mean_backlog(tail),
+            "head_mean": mean_backlog(head),
+        },
+        "signatures": signatures,
+    }
+
+
+# --------------------------------------------------------------------------- #
+# rendering                                                                   #
+# --------------------------------------------------------------------------- #
+
+
+def render(result: dict, slowest: List[dict]) -> str:
+    lines = [
+        f"{result['records']} records "
+        f"({', '.join(f'{k}={v}' for k, v in result['statuses'].items())}); "
+        f"tail >= p{result['tail_q'] * 100:g} ({result['tail_cut_us']} us), "
+        f"head <= p{result['head_q'] * 100:g} ({result['head_cut_us']} us)"
+    ]
+    stages = list(result["excess_share"])
+    lines.append("")
+    lines.append(
+        f"{'stage':<18} {'tail_mean':>10} {'head_mean':>10} "
+        f"{'tail_share':>10} {'excess_share':>13}"
+    )
+    for s in stages:
+        lines.append(
+            f"{s:<18} {result['tail']['stage_mean_us'][s]:>10} "
+            f"{result['head']['stage_mean_us'][s]:>10} "
+            f"{result['tail']['stage_share'][s]:>10.1%} "
+            f"{result['excess_share'][s]:>13.1%}"
+        )
+    dom = result["dominant_stage"]
+    lines.append("")
+    lines.append(
+        f"dominant tail stage: {dom or '(no excess — tail == head)'}"
+    )
+    b = result["backlog"]
+    if b["stamped"]:
+        r_txt = "n/a" if b["pearson_r"] is None else f"{b['pearson_r']:+.3f}"
+        lines.append(
+            f"backlog at admission: pearson r={r_txt} over {b['stamped']} "
+            f"stamped records; tail mean={b['tail_mean']} "
+            f"head mean={b['head_mean']}"
+        )
+    else:
+        lines.append("backlog at admission: no stamped records")
+    lines.append("")
+    lines.append(
+        f"{'signature':<44} {'count':>6} {'p50_us':>8} {'p99_us':>9} "
+        f"{'tail':>5} {'backlog':>8}"
+    )
+    for row in result["signatures"][:10]:
+        sig = row["signature"]
+        if len(sig) > 43:
+            sig = sig[:40] + "..."
+        lines.append(
+            f"{sig:<44} {row['count']:>6} {row['p50_us']:>8} "
+            f"{row['p99_us']:>9} {row['tail_count']:>5} "
+            f"{row['mean_backlog'] if row['mean_backlog'] is not None else '-':>8}"
+        )
+    if slowest:
+        lines.append("")
+        lines.append(f"slowest {len(slowest)} record(s):")
+        for r in slowest:
+            stack = ", ".join(
+                f"{k}={v}us" for k, v in r["stages_us"].items()
+            )
+            label = r["model"] or "?"
+            if r["request_id"]:
+                label += f" id={r['request_id']}"
+            lines.append(
+                f"  {r['duration_us']} us [{label}] ({r['status']}) {stack}"
+            )
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------- #
+# self-check                                                                  #
+# --------------------------------------------------------------------------- #
+
+
+def _synthetic_dump(n: int = 400, slow: int = 20) -> dict:
+    """A dump whose tail is queue-wait-dominated by construction and whose
+    backlog rises with duration — the known answer the self-check asserts.
+    Deterministic (no RNG): the check must not flake."""
+    records = []
+    base = 1_000_000_000
+    for i in range(n):
+        is_slow = i < slow
+        queue_us = 60_000 + 2_000 * i if is_slow else 200 + (i % 50)
+        compute_us = 2_000 + (i % 100)
+        recv = base + i * 10_000_000
+        ts = {
+            "REQUEST_RECV": recv,
+            "QUEUE_START": recv + 50_000,
+            "BATCH_FORM": recv + 50_000 + queue_us * 1000,
+            "COMPUTE_INPUT": recv + 55_000 + queue_us * 1000,
+            "COMPUTE_INFER": recv + 100_000 + queue_us * 1000,
+            "COMPUTE_OUTPUT": recv + 100_000 + (queue_us + compute_us) * 1000,
+            "RESPONSE_SEND": recv + 200_000 + (queue_us + compute_us) * 1000,
+        }
+        duration_ns = ts["RESPONSE_SEND"] - ts["REQUEST_RECV"]
+        records.append({
+            "seq": i,
+            "model_name": "synthetic",
+            "model_version": "1",
+            "request_id": f"r{i}",
+            "trace_id": "",
+            "parent_span_id": "",
+            "duration_us": duration_ns // 1000,
+            "status": "ok",
+            "error": None,
+            "stages_us": {
+                k: v // 1000 for k, v in stage_clocks(ts).items()
+            },
+            "timestamps": ts,
+            "attributes": {
+                # Backlog tracks queue time: the correlation the report
+                # must recover.
+                "batcher.backlog_at_admission": queue_us // 2_000,
+                "batcher.signature": (
+                    "('INPUT', 'INT32', (16,))" if i % 3 else
+                    "('INPUT', 'FP32', (16,))"
+                ),
+                "batch.size": 4,
+            },
+            "wall_time_s": 0.0,
+        })
+    return {
+        "kind": "flight_recorder",
+        "config": {"slowest_k": slow, "window_s": 10.0, "windows": 6,
+                   "max_errors": 256, "enabled": True},
+        "counters": {"offered": n, "retained_slow": slow, "errors": 0,
+                     "deadline_misses": 0},
+        "records": records,
+    }
+
+
+def self_check() -> int:
+    import tempfile
+
+    failures = 0
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "flight.json")
+        with open(path, "w") as f:
+            json.dump(_synthetic_dump(), f)
+        records = load_records(path)
+        if len(records) != 400:
+            print(f"self-check: loaded {len(records)} records != 400",
+                  file=sys.stderr)
+            failures += 1
+        result = analyze(records)
+        if result["dominant_stage"] != "queue-wait":
+            print(
+                "self-check: dominant stage "
+                f"{result['dominant_stage']!r} != 'queue-wait' "
+                f"(excess_share={result['excess_share']})",
+                file=sys.stderr,
+            )
+            failures += 1
+        if result["excess_share"].get("queue-wait", 0) < 0.9:
+            print(
+                "self-check: queue-wait excess share "
+                f"{result['excess_share']} < 0.9",
+                file=sys.stderr,
+            )
+            failures += 1
+        r = result["backlog"]["pearson_r"]
+        if r is None or r < 0.8:
+            print(f"self-check: backlog correlation {r} < 0.8",
+                  file=sys.stderr)
+            failures += 1
+        if len(result["signatures"]) != 2:
+            print(
+                f"self-check: {len(result['signatures'])} signatures != 2",
+                file=sys.stderr,
+            )
+            failures += 1
+        render(result, records[:3])  # must not raise
+        # The trace-file path must agree on the dominant stage: export the
+        # same timeline through the triton exporter and re-analyze.
+        trace_path = os.path.join(tmp, "trace.json")
+        trace_doc = []
+        for rec in _synthetic_dump()["records"]:
+            trace_doc.append({
+                "id": rec["seq"],
+                "model_name": rec["model_name"],
+                "model_version": "1",
+                "request_id": rec["request_id"],
+                "trace_id": _otel.new_trace_id(),
+                "parent_span_id": "",
+                "timestamps": [
+                    {"name": k, "ns": v}
+                    for k, v in rec["timestamps"].items()
+                    if k in _otel.TIMESTAMP_ORDER
+                ],
+                "attributes": rec["attributes"],
+            })
+        with open(trace_path, "w") as f:
+            json.dump(trace_doc, f)
+        t_result = analyze(load_records(trace_path))
+        if t_result["dominant_stage"] != "queue-wait":
+            print(
+                "self-check [trace path]: dominant stage "
+                f"{t_result['dominant_stage']!r} != 'queue-wait'",
+                file=sys.stderr,
+            )
+            failures += 1
+    if failures:
+        print(f"self-check: {failures} failure(s)", file=sys.stderr)
+        return 1
+    print("self-check: attribution recovers the seeded dominant stage, "
+          "backlog correlation, and signature split")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tail_report",
+        description="Per-stage tail attribution from a flight-recorder "
+        "dump or trace file",
+    )
+    parser.add_argument("dump_file", nargs="?",
+                        help="flight-recorder dump or trace_mode file")
+    parser.add_argument("--tail-q", type=float, default=0.95,
+                        help="tail quantile cut (default 0.95)")
+    parser.add_argument("--head-q", type=float, default=0.5,
+                        help="head quantile cut (default 0.5)")
+    parser.add_argument("--slowest", type=int, default=5, metavar="N",
+                        help="how many slowest records to list (default 5)")
+    parser.add_argument("--json", dest="as_json", action="store_true",
+                        help="emit the report as JSON")
+    parser.add_argument("--self-check", action="store_true",
+                        help="run the synthetic-dump round trip and exit")
+    args = parser.parse_args(argv)
+    if args.self_check:
+        return self_check()
+    if not args.dump_file:
+        parser.error("a dump/trace file is required (or --self-check)")
+    try:
+        records = load_records(args.dump_file)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"unable to load {args.dump_file}: {e}", file=sys.stderr)
+        return 1
+    if not records:
+        print(f"{args.dump_file}: no records", file=sys.stderr)
+        return 1
+    result = analyze(records, tail_q=args.tail_q, head_q=args.head_q)
+    slowest = sorted(
+        records, key=lambda r: r["duration_us"], reverse=True
+    )[:args.slowest]
+    try:
+        if args.as_json:
+            print(json.dumps(
+                {"analysis": result, "slowest": slowest}, indent=2,
+                default=str,
+            ))
+        else:
+            print(render(result, slowest))
+    except BrokenPipeError:
+        return 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -656,6 +656,76 @@ def test_exposition_checker_catches_violations():
         'h_bucket{le="1"} 5\nh_bucket{le="+Inf"} 6\nh_sum 9\nh_count 6\n'
     )
     assert checker.check_exposition(good) == []
+    # Summary quantile rows must be monotone non-decreasing in q.
+    bad = (
+        "# HELP s help\n# TYPE s summary\n"
+        's{quantile="0.5"} 9\ns{quantile="0.9"} 5\ns_sum 14\ns_count 2\n'
+    )
+    assert any("non-decreasing" in e for e in checker.check_exposition(bad))
+    # Quantile labels outside [0, 1] are invalid.
+    bad = (
+        "# HELP s help\n# TYPE s summary\n"
+        's{quantile="1.5"} 5\ns_sum 5\ns_count 1\n'
+    )
+    assert any("outside" in e for e in checker.check_exposition(bad))
+    # Summaries need _sum/_count like histograms do.
+    bad = '# HELP s help\n# TYPE s summary\ns{quantile="0.5"} 5\n'
+    errs = checker.check_exposition(bad)
+    assert any("missing _sum" in e for e in errs)
+    assert any("missing _count" in e for e in errs)
+    # Counters can never be negative.
+    bad = "# HELP c help\n# TYPE c counter\nc -1\n"
+    assert any("counter" in e and "< 0" in e
+               for e in checker.check_exposition(bad))
+    # Age gauges can never be negative (a negative age is a clock bug).
+    bad = (
+        "# HELP nv_q_age_us help\n# TYPE nv_q_age_us gauge\n"
+        "nv_q_age_us -7\n"
+    )
+    assert any("age gauge" in e for e in checker.check_exposition(bad))
+    # A valid summary passes.
+    good = (
+        "# HELP s help\n# TYPE s summary\n"
+        's{quantile="0.5"} 5\ns{quantile="0.99"} 11\ns_sum 16\ns_count 2\n'
+    )
+    assert checker.check_exposition(good) == []
+
+
+def test_sketch_quantile_deadline_and_age_families_exposed(server):
+    """/metrics carries the tail-first families: sketch-backed summary
+    quantiles per stage, the deadline counter, and the backlog-age gauge —
+    and the full exposition (old + new families) still validates."""
+    client = httpclient.InferenceServerClient(server.http_address)
+    for i in range(6):
+        client.infer("simple", _http_inputs(i))
+    text = _scrape(server)
+    for family in (
+        "nv_inference_request_duration_us_quantiles",
+        "nv_inference_queue_duration_us_quantiles",
+        "nv_inference_compute_input_duration_us_quantiles",
+        "nv_inference_compute_infer_duration_us_quantiles",
+        "nv_inference_compute_output_duration_us_quantiles",
+    ):
+        assert f"# TYPE {family} summary" in text, family
+    rows = re.findall(
+        r'nv_inference_request_duration_us_quantiles\{model="simple",'
+        r'version="1",quantile="([0-9.]+)"\} ([0-9.]+)', text)
+    assert [q for q, _ in rows] == ["0.5", "0.9", "0.99", "0.999"]
+    values = [float(v) for _, v in rows]
+    assert values == sorted(values)
+    count = int(re.search(
+        r'nv_inference_request_duration_us_quantiles_count\{model="simple",'
+        r'version="1"\} (\d+)', text).group(1))
+    assert count == 6
+    assert re.search(
+        r'nv_inference_deadline_exceeded_total\{model="simple",'
+        r'version="1"\} 0', text)
+    assert re.search(
+        r'nv_inference_oldest_request_age_us\{model="simple",'
+        r'version="1"\} \d+', text)
+    checker = _load_checker()
+    assert checker.check_exposition(text) == []
+    client.close()
 
 
 # --------------------------------------------------------------------------- #

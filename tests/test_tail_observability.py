@@ -1,0 +1,612 @@
+"""Tail-first observability: quantile sketches, the flight recorder,
+stage clocks, deadline observation, and scripts/tail_report.py.
+
+The plane under test answers the question the head-sampled trace
+collector cannot: WHY was a tail request slow. Coverage follows the
+acceptance criteria: sketch accuracy (<=2% relative error on >=100k
+samples, exact merge, serialize round-trip), flight-recorder retention
+under a seeded overload (slowest-K kept, fast requests evicted, buffer
+bounded, backlog stamped on every retained request), stage-clock
+monotonicity, deadline-miss routing, and the tail_report attribution of
+a queue-dominated overload to queue-wait.
+"""
+
+import importlib.util
+import json
+import math
+import os
+import random
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import tritonclient_tpu.grpc as grpcclient
+import tritonclient_tpu.http as httpclient
+from tritonclient_tpu import _otel
+from tritonclient_tpu._sketch import LatencySketch
+from tritonclient_tpu._tracing import (
+    FlightRecorder,
+    TraceContext,
+    stage_clocks,
+)
+from tritonclient_tpu.models._base import Model, TensorSpec
+from tritonclient_tpu.server import InferenceServer
+from tritonclient_tpu.server._core import InferenceCore
+
+# Timeline order of every stamp a request can carry (BATCH_FORM only on
+# the batched path).
+_CLOCK_ORDER = [
+    "REQUEST_RECV", "QUEUE_START", "BATCH_FORM", "COMPUTE_INPUT",
+    "COMPUTE_INFER", "COMPUTE_OUTPUT", "RESPONSE_SEND",
+]
+
+
+def _load_script(name: str, module: str):
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", name,
+    )
+    spec = importlib.util.spec_from_file_location(module, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --------------------------------------------------------------------------- #
+# quantile sketch                                                             #
+# --------------------------------------------------------------------------- #
+
+
+def _exact_quantile(sorted_vals, q):
+    rank = max(int(math.ceil(q * len(sorted_vals))), 1)
+    return sorted_vals[rank - 1]
+
+
+def test_sketch_accuracy_within_2pct_on_100k_samples():
+    rng = random.Random(20260804)
+    # Lognormal body + a heavy tail mixture: the shape a serving latency
+    # distribution actually has (and the one fixed buckets smear).
+    values = [rng.lognormvariate(5.0, 1.2) for _ in range(100_000)]
+    values += [rng.lognormvariate(9.0, 0.5) for _ in range(2_000)]
+    sketch = LatencySketch()
+    sketch.extend(values)
+    exact = sorted(values)
+    for q in (0.5, 0.9, 0.95, 0.99, 0.999):
+        got = sketch.quantile(q)
+        want = _exact_quantile(exact, q)
+        assert abs(got - want) / want <= 0.02, (q, got, want)
+    assert sketch.count == len(values)
+    assert abs(sketch.sum - sum(values)) / sum(values) < 1e-9
+
+
+def test_sketch_merge_is_exact_and_associative():
+    rng = random.Random(7)
+    values = [rng.expovariate(1 / 500.0) for _ in range(30_000)]
+    whole = LatencySketch()
+    whole.extend(values)
+    parts = [LatencySketch() for _ in range(3)]
+    for i, v in enumerate(values):
+        parts[i % 3].insert(v)
+    ab_c = LatencySketch.merged([parts[0], parts[1], parts[2]])
+    c_ab = LatencySketch.merged([parts[2], parts[0], parts[1]])
+    for m in (ab_c, c_ab):
+        # Bucket-wise merge is exact: same buckets/counts as sketching the
+        # concatenated sample (sum differs only by float addition order).
+        assert m.to_dict()["buckets"] == whole.to_dict()["buckets"]
+        assert m.count == whole.count
+        assert m.quantile(0.99) == whole.quantile(0.99)
+    # Merging mismatched geometries must be refused, not silently wrong.
+    with pytest.raises(ValueError):
+        LatencySketch(alpha=0.02).merge(LatencySketch(alpha=0.01))
+
+
+def test_sketch_serialize_round_trip_and_zero_handling():
+    sketch = LatencySketch()
+    sketch.extend([0.0, 0.0, 5.0, 50.0, 500.0, -1.0])
+    restored = LatencySketch.from_json(sketch.to_json())
+    assert restored.to_dict() == sketch.to_dict()
+    assert restored.quantile(0.25) == 0.0  # zero/negative -> zero bucket
+    assert restored.quantile(0.99) == pytest.approx(500.0, rel=0.02)
+    empty = LatencySketch.from_dict(LatencySketch().to_dict())
+    assert empty.count == 0 and empty.quantile(0.99) == 0.0
+
+
+def test_sketch_memory_bounded_by_collapse():
+    sketch = LatencySketch(max_buckets=64)
+    for i in range(10_000):
+        sketch.insert(1.0001 ** i * (1 + (i % 97)))
+    assert len(sketch.to_dict()["buckets"]) <= 64
+    # The tail keeps full resolution (collapse folds the LOW end).
+    assert sketch.quantile(0.999) > sketch.quantile(0.5)
+
+
+# --------------------------------------------------------------------------- #
+# flight recorder (unit level, deterministic)                                 #
+# --------------------------------------------------------------------------- #
+
+
+def _ctx(recorder, model, dur_us, rid, error=None, deadline_us=0,
+         backlog=None):
+    ctx = TraceContext(None, 0, model, "1", rid, (), "", "")
+    base = 1_000_000_000
+    ctx.record("REQUEST_RECV", base)
+    ctx.record("QUEUE_START", base + 10_000)
+    ctx.record("RESPONSE_SEND", base + dur_us * 1000)
+    if backlog is not None:
+        ctx.set_attribute("batcher.backlog_at_admission", backlog)
+    if error:
+        ctx.note_error(error)
+    if deadline_us:
+        ctx.deadline_ns = deadline_us * 1000
+        ctx.set_attribute("deadline_budget_us", deadline_us)
+    ctx._flight = recorder
+    ctx.finish()
+    return ctx
+
+
+def test_flight_recorder_keeps_slowest_k_and_evicts_fast():
+    recorder = FlightRecorder(slowest_k=4, window_s=1000.0, windows=2)
+    # 100 offers with distinct durations; only the top 4 may survive.
+    order = list(range(100))
+    random.Random(3).shuffle(order)
+    for i in order:
+        _ctx(recorder, "m", 1000 + i * 10, f"r{i}")
+    records = recorder.records()
+    assert len(records) == 4  # buffer bounded at K
+    assert [r.request_id for r in records] == ["r99", "r98", "r97", "r96"]
+    dump = recorder.dump()
+    assert dump["counters"]["offered"] == 100
+    assert len(dump["records"]) == 4
+    assert dump["records"][0]["duration_us"] == 1000 + 99 * 10
+
+
+def test_flight_recorder_retains_every_error_and_deadline_miss():
+    misses = []
+    recorder = FlightRecorder(
+        slowest_k=2, window_s=1000.0, max_errors=8,
+        on_deadline_miss=misses.append,
+    )
+    for i in range(4):
+        _ctx(recorder, "m", 50_000, f"ok{i}")  # slow but fine
+    _ctx(recorder, "m", 10, "err", error="boom")  # FAST error: still kept
+    _ctx(recorder, "m", 2000, "late", deadline_us=1000)  # budget blown
+    _ctx(recorder, "m", 500, "fine", deadline_us=1000)  # inside budget
+    by_id = {r.request_id: r for r in recorder.records()}
+    assert "err" in by_id and by_id["err"].status == "error"
+    assert by_id["err"].error == "boom"
+    assert "late" in by_id and by_id["late"].status == "deadline_miss"
+    assert by_id["late"].attributes["deadline_exceeded"] is True
+    assert by_id["fine"].status == "ok" if "fine" in by_id else True
+    assert misses == ["m"]  # the counter callback fired exactly once
+    dump = recorder.dump()
+    assert dump["counters"]["errors"] == 1
+    assert dump["counters"]["deadline_misses"] == 1
+
+
+def test_flight_recorder_window_rotation_drops_oldest():
+    recorder = FlightRecorder(slowest_k=8, window_s=0.1, windows=2)
+    _ctx(recorder, "m", 9_000_000, "ancient")  # would win any heap
+    time.sleep(0.12)  # tpulint: disable=TPU001
+    _ctx(recorder, "m", 100, "mid")
+    time.sleep(0.12)  # tpulint: disable=TPU001
+    _ctx(recorder, "m", 200, "new")
+    ids = {r.request_id for r in recorder.records()}
+    # Three windows touched, two retained: the oldest window (and its
+    # slowest-ever record) is gone; recency beats magnitude across windows.
+    assert "ancient" not in ids
+    assert {"mid", "new"} <= ids
+
+
+def test_flight_recorder_disabled_is_inert(monkeypatch):
+    monkeypatch.setenv("TPU_FLIGHT_RECORDER", "0")
+    recorder = FlightRecorder()
+    assert not recorder.enabled
+    _ctx(recorder, "m", 1000, "r")
+    assert recorder.records() == []
+    assert recorder.dump()["counters"]["offered"] == 0
+
+
+def test_stage_clocks_partition_and_clamp():
+    base = 10 ** 9
+    ts = {
+        "REQUEST_RECV": base,
+        "QUEUE_START": base + 1_000,
+        "BATCH_FORM": base + 11_000,
+        "COMPUTE_INPUT": base + 12_000,
+        "COMPUTE_INFER": base + 15_000,
+        "COMPUTE_OUTPUT": base + 95_000,
+        "RESPONSE_SEND": base + 100_000,
+    }
+    clocks = stage_clocks(ts)
+    assert clocks == {
+        "ingress": 1_000,
+        "queue-wait": 10_000,
+        "batch-formation": 4_000,
+        "compute": 80_000,
+        "response-marshal": 5_000,
+    }
+    # The stages partition the request exactly.
+    assert sum(clocks.values()) == ts["RESPONSE_SEND"] - ts["REQUEST_RECV"]
+    # Direct path: no BATCH_FORM, queue-wait closes at COMPUTE_INPUT.
+    direct = dict(ts)
+    del direct["BATCH_FORM"]
+    direct["COMPUTE_INPUT"] = direct["QUEUE_START"]
+    clocks = stage_clocks(direct)
+    assert clocks["queue-wait"] == 0
+    # Partial record: absent stages omitted, never negative.
+    partial = {"REQUEST_RECV": base, "RESPONSE_SEND": base - 5}
+    assert stage_clocks(partial) == {}
+
+
+# --------------------------------------------------------------------------- #
+# seeded overload through the full serving stack                              #
+# --------------------------------------------------------------------------- #
+
+
+class _SlowBatchModel(Model):
+    """Dynamic-batched identity with a fixed per-execution cost: driving
+    it past capacity makes queue-wait the dominant tail stage by
+    construction."""
+
+    name = "slow_batch"
+    dynamic_batching = True
+    max_batch_size = 8
+    blocking = True
+
+    def __init__(self, delay_s=0.02):
+        super().__init__()
+        self.delay_s = delay_s
+        self.inputs = [TensorSpec("INPUT", "INT32", [-1, 4])]
+        self.outputs = [TensorSpec("OUTPUT", "INT32", [-1, 4])]
+
+    def infer(self, inputs, parameters=None):
+        time.sleep(self.delay_s)  # tpulint: disable=TPU001
+        return {"OUTPUT": np.asarray(inputs["INPUT"], dtype=np.int32)}
+
+
+@pytest.fixture()
+def overload_server():
+    with InferenceServer(models=[_SlowBatchModel()]) as server:
+        yield server
+
+
+def _drive_overload(server, n_threads=24, per_thread=4):
+    errors = []
+
+    def worker(wid):
+        client = httpclient.InferenceServerClient(server.http_address)
+        try:
+            for i in range(per_thread):
+                inp = httpclient.InferInput("INPUT", [1, 4], "INT32")
+                inp.set_data_from_numpy(
+                    np.full((1, 4), wid * 100 + i, np.int32)
+                )
+                client.infer("slow_batch", [inp],
+                             request_id=f"w{wid}-{i}")
+        except Exception as e:  # surfaced below; must not hang the join
+            errors.append(e)
+        finally:
+            client.close()
+
+    threads = [
+        threading.Thread(target=worker, args=(w,)) for w in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+
+
+def test_seeded_overload_flight_recorder_and_tail_report(
+    overload_server, tmp_path
+):
+    """The acceptance path: batcher driven past capacity -> the flight
+    recorder holds the slowest-K requests with full stage timelines and
+    backlog-depth-at-admission stamped on every retained request, and
+    tail_report attributes the tail to queue-wait."""
+    server = overload_server
+    recorder = server.core.flight_recorder
+    _drive_overload(server)
+    dump = recorder.dump()
+    k = recorder.slowest_k
+    total = 24 * 4
+    assert dump["counters"]["offered"] == total
+    okay = [r for r in dump["records"] if r["status"] == "ok"]
+    assert 0 < len(okay) <= k  # bounded retention
+    assert dump["counters"]["retained_slow"] <= k
+    durations = [r["duration_us"] for r in dump["records"]]
+    assert durations == sorted(durations, reverse=True)  # slowest first
+    for rec in okay:
+        ts = rec["timestamps"]
+        # Full span timeline: every batched stamp present and ordered.
+        present = [n for n in _CLOCK_ORDER if n in ts]
+        assert {"REQUEST_RECV", "QUEUE_START", "BATCH_FORM",
+                "COMPUTE_INFER", "COMPUTE_OUTPUT",
+                "RESPONSE_SEND"} <= set(present)
+        stamps = [ts[n] for n in present]
+        assert stamps == sorted(stamps), present
+        # Stage clocks partition the request (integer-division slack only).
+        stages = rec["stages_us"]
+        assert all(v >= 0 for v in stages.values())
+        assert abs(sum(stages.values()) - rec["duration_us"]) <= 5
+        # Batcher context stamped on every retained request.
+        attrs = rec["attributes"]
+        assert "batcher.backlog_at_admission" in attrs
+        assert attrs["batcher.backlog_at_admission"] >= 0
+        assert attrs["batch.size"] >= 1
+        assert attrs["batcher.regime"] in ("serialize", "spread")
+        assert "batcher.signature" in attrs
+    # Under a 24-deep closed loop on an 8-wide 20ms model, the tail IS
+    # queue-wait; the report must say so.
+    tail_report = _load_script("tail_report.py", "tail_report_overload")
+    dump_path = str(tmp_path / "flight.json")
+    with open(dump_path, "w") as f:
+        json.dump(dump, f)
+    records = tail_report.load_records(dump_path)
+    result = tail_report.analyze(records)
+    assert result["dominant_stage"] == "queue-wait", result["excess_share"]
+    assert result["backlog"]["stamped"] == len(records)
+    assert tail_report.main([dump_path, "--slowest", "3"]) == 0
+
+    # The perfetto export of the same records loads as spans.
+    spans = _otel.load_spans(
+        json.loads(recorder.render_perfetto())
+    )
+    assert spans and {"request-handler"} <= {s["name"] for s in spans}
+
+
+def test_overload_metrics_quantiles_and_age_gauge(overload_server):
+    """During/after overload the new families are present, consistent, and
+    the whole exposition still validates."""
+    server = overload_server
+    # Scrape DURING load from a side thread so the age gauge can be seen
+    # non-zero while the queue is deep.
+    ages = []
+
+    def scraper():
+        for _ in range(30):
+            text = urllib.request.urlopen(
+                f"http://{server.http_address}/metrics"
+            ).read().decode()
+            m = re.search(
+                r'nv_inference_oldest_request_age_us\{model="slow_batch",'
+                r'version="1"\} (\d+)', text)
+            if m:
+                ages.append(int(m.group(1)))
+            time.sleep(0.01)  # tpulint: disable=TPU001
+
+    t = threading.Thread(target=scraper)
+    t.start()
+    _drive_overload(server, n_threads=16, per_thread=3)
+    t.join(timeout=30)
+    assert ages and all(a >= 0 for a in ages)
+    assert max(ages) > 0  # a deep queue has a measurably old head
+    text = urllib.request.urlopen(
+        f"http://{server.http_address}/metrics"
+    ).read().decode()
+    checker = _load_script("check_metrics_exposition.py", "cm_overload")
+    assert checker.check_exposition(text) == []
+    # Quantile rows exist for the request and queue families and are
+    # monotone in q.
+    for family in ("nv_inference_request_duration_us_quantiles",
+                   "nv_inference_queue_duration_us_quantiles"):
+        rows = re.findall(
+            family + r'\{model="slow_batch",version="1",'
+            r'quantile="([0-9.]+)"\} ([0-9.]+)', text)
+        assert len(rows) == 4, family
+        values = [float(v) for _, v in sorted(rows, key=lambda r: float(r[0]))]
+        assert values == sorted(values), (family, rows)
+    # Idle again: the age gauge returns to zero.
+    time.sleep(0.3)  # tpulint: disable=TPU001
+    text = urllib.request.urlopen(
+        f"http://{server.http_address}/metrics"
+    ).read().decode()
+    m = re.search(
+        r'nv_inference_oldest_request_age_us\{model="slow_batch",'
+        r'version="1"\} (\d+)', text)
+    assert m and int(m.group(1)) == 0
+
+
+# --------------------------------------------------------------------------- #
+# /metrics quantile accuracy vs exact                                         #
+# --------------------------------------------------------------------------- #
+
+
+def test_metrics_quantiles_agree_with_exact_within_2pct():
+    from tritonclient_tpu.models.simple import SimpleModel
+
+    core = InferenceCore(models=[SimpleModel()])
+    stats = core._stats["simple"]
+    rng = random.Random(99)
+    durations_us = [rng.lognormvariate(7.0, 1.0) for _ in range(20_000)]
+    with core._lock:
+        for us in durations_us:
+            stats.sketches["request"].insert(us)
+    text = core.prometheus_metrics()
+    rows = dict(re.findall(
+        r'nv_inference_request_duration_us_quantiles\{model="simple",'
+        r'version="1",quantile="([0-9.]+)"\} ([0-9.]+)', text))
+    assert set(rows) == {"0.5", "0.9", "0.99", "0.999"}
+    exact = sorted(durations_us)
+    for q_label, value in rows.items():
+        want = _exact_quantile(exact, float(q_label))
+        assert abs(float(value) - want) / want <= 0.02, (q_label, value, want)
+    count = re.search(
+        r'nv_inference_request_duration_us_quantiles_count\{model="simple",'
+        r'version="1"\} (\d+)', text)
+    assert int(count.group(1)) == len(durations_us)
+
+
+# --------------------------------------------------------------------------- #
+# deadlines (KServe timeout parameter) across both front-ends                 #
+# --------------------------------------------------------------------------- #
+
+
+def _slow_input(mod):
+    inp = mod.InferInput("INPUT", [1, 16], "INT32")
+    inp.set_data_from_numpy(np.zeros((1, 16), np.int32))
+    return inp
+
+
+@pytest.fixture()
+def server():
+    with InferenceServer() as s:
+        yield s
+
+
+def test_timeout_parameter_observed_http_and_grpc(server):
+    """The KServe `timeout` request parameter is parsed (not decorative):
+    both front-ends stamp deadline_budget_us/deadline_exceeded, bump the
+    counter, and the flight recorder retains every miss."""
+    hc = httpclient.InferenceServerClient(server.http_address)
+    gc = grpcclient.InferenceServerClient(server.grpc_address)
+    # 300 ms model against a 1 ms budget -> guaranteed miss, one per plane.
+    hc.infer("slow_identity", [_slow_input(httpclient)],
+             request_id="http-miss", timeout=1000)
+    gc.infer("slow_identity", [_slow_input(grpcclient)],
+             request_id="grpc-miss", timeout=1000)
+    # A roomy budget must NOT count as a miss.
+    hc.infer("slow_identity", [_slow_input(httpclient)],
+             request_id="http-fine", timeout=60_000_000)
+    dump = hc.get_flight_recorder()
+    misses = {r["request_id"]: r for r in dump["records"]
+              if r["status"] == "deadline_miss"}
+    assert set(misses) == {"http-miss", "grpc-miss"}
+    for rec in misses.values():
+        assert rec["attributes"]["deadline_budget_us"] == 1000
+        assert rec["attributes"]["deadline_exceeded"] is True
+    assert dump["counters"]["deadline_misses"] == 2
+    text = urllib.request.urlopen(
+        f"http://{server.http_address}/metrics"
+    ).read().decode()
+    m = re.search(
+        r'nv_inference_deadline_exceeded_total\{model="slow_identity",'
+        r'version="1"\} (\d+)', text)
+    assert m and int(m.group(1)) == 2
+    # Observation only: the requests themselves still succeeded, and a
+    # deadline-carrying request must still be batcher-eligible (the
+    # parameter is popped before eligibility).
+    from tritonclient_tpu.server._core import CoreRequest, CoreTensor
+
+    req = CoreRequest(model_name="simple", deadline_us=5000, inputs=[
+        CoreTensor("INPUT0", "INT32", [1, 16],
+                   data=np.zeros((1, 16), np.int32)),
+    ])
+    batcher = server.core._batchers["simple"]
+    assert batcher.eligible(req, 64)
+    gc.close()
+    hc.close()
+
+
+def test_grpc_flight_recorder_rpc_and_perfetto(server):
+    hc = httpclient.InferenceServerClient(server.http_address)
+    gc = grpcclient.InferenceServerClient(server.grpc_address)
+    inp = []
+    for name in ("INPUT0", "INPUT1"):
+        x = grpcclient.InferInput(name, [2, 16], "INT32")
+        x.set_data_from_numpy(np.arange(32, dtype=np.int32).reshape(2, 16))
+        inp.append(x)
+    gc.infer("simple", inp, request_id="rpc-dump")
+    dump = gc.get_flight_recorder()
+    assert dump["kind"] == "flight_recorder"
+    assert any(r["request_id"] == "rpc-dump" for r in dump["records"])
+    # Same records over HTTP (one recorder behind both front-ends).
+    hdump = hc.get_flight_recorder()
+    assert hdump["counters"]["offered"] == dump["counters"]["offered"]
+    perf = gc.get_flight_recorder(format="perfetto")
+    assert perf.get("traceEvents")
+    spans = _otel.load_spans(perf)
+    assert any(s["name"] == "request-handler" for s in spans)
+    gc.close()
+    hc.close()
+
+
+def test_errors_routed_to_flight_recorder(server):
+    """A failed request is retained with status=error even when fast."""
+    hc = httpclient.InferenceServerClient(server.http_address)
+    from tritonclient_tpu.utils import InferenceServerException
+
+    with pytest.raises(InferenceServerException):
+        hc.infer("nonexistent_model", [_slow_input(httpclient)],
+                 request_id="bad-model")
+    bad0 = httpclient.InferInput("INPUT0", [2, 16], "INT32")
+    bad0.set_data_from_numpy(np.zeros((2, 16), np.int32))
+    bad1 = httpclient.InferInput("INPUT1", [3, 16], "INT32")
+    bad1.set_data_from_numpy(np.zeros((3, 16), np.int32))
+    with pytest.raises(InferenceServerException):
+        hc.infer("simple", [bad0, bad1], request_id="bad-dims")
+    dump = hc.get_flight_recorder()
+    errors = {r["request_id"]: r for r in dump["records"]
+              if r["status"] == "error"}
+    assert "bad-dims" in errors
+    assert errors["bad-dims"]["error"]
+    hc.close()
+
+
+def test_tail_report_self_check_and_trace_file_input(server, tmp_path):
+    tail_report = _load_script("tail_report.py", "tail_report_sc")
+    assert tail_report.self_check() == 0
+    # Trace-file input path: enable tracing, run traffic, feed the trace
+    # file (not a flight dump) to the report.
+    trace_file = str(tmp_path / "trace.json")
+    hc = httpclient.InferenceServerClient(server.http_address)
+    hc.update_trace_settings("", {
+        "trace_level": ["TIMESTAMPS"], "trace_rate": ["1"],
+        "trace_file": [trace_file], "log_frequency": ["1"],
+    })
+    for i in range(6):
+        inp = []
+        for name in ("INPUT0", "INPUT1"):
+            x = httpclient.InferInput(name, [2, 16], "INT32")
+            x.set_data_from_numpy(
+                np.arange(32, dtype=np.int32).reshape(2, 16) + i
+            )
+            inp.append(x)
+        hc.infer("simple", inp, request_id=f"t{i}")
+    hc.update_trace_settings("", {"trace_level": ["OFF"]})
+    server.core.trace_collector.flush()
+    records = tail_report.load_records(trace_file)
+    assert len(records) == 6
+    result = tail_report.analyze(records)
+    assert result["dominant_stage"] in (
+        "queue-wait", "compute", "response-marshal", None,
+    )
+    assert tail_report.main([trace_file, "--json"]) == 0
+    hc.close()
+
+
+# --------------------------------------------------------------------------- #
+# perf_analyzer pooled sketches                                               #
+# --------------------------------------------------------------------------- #
+
+
+def test_perf_analyzer_pooled_quantiles_from_merged_sketches(server):
+    from tritonclient_tpu.perf_analyzer import PerfAnalyzer
+    from tritonclient_tpu.perf_analyzer._stats import (
+        pooled_latency_quantiles,
+    )
+
+    analyzer = PerfAnalyzer(
+        server.grpc_address, "simple", batch_size=2,
+        measurement_interval_s=0.4, warmup_s=0.1,
+    )
+    with analyzer.session(2) as session:
+        w1 = session.measure(interval_s=0.3)
+        w2 = session.measure(interval_s=0.3)
+        pooled = session.pooled_quantiles()
+    assert pooled["count"] == len(w1.latencies_ns) + len(w2.latencies_ns)
+    # Session accumulation == explicit window merge.
+    explicit = pooled_latency_quantiles([w1, w2])
+    assert pooled["latency_p99_us"] == explicit["latency_p99_us"]
+    # Merged p99 within sketch tolerance of the exact pooled p99.
+    exact = sorted(w1.latencies_ns + w2.latencies_ns)
+    want = _exact_quantile(exact, 0.99) / 1000.0
+    assert abs(pooled["latency_p99_us"] - want) / want <= 0.025
+    assert pooled["latency_p50_us"] <= pooled["latency_p99_us"] <= (
+        pooled["latency_p999_us"]
+    )

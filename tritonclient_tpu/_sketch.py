@@ -1,0 +1,215 @@
+"""Mergeable relative-error quantile sketch (DDSketch-style).
+
+The tail-observability plane needs quantiles that (a) cost bounded
+memory on the serving hot path, (b) merge exactly across windows, runs,
+and processes — pooled p99 must come from pooled *data*, not a
+min-over-runs of per-run p99s — and (c) carry a worst-case accuracy
+guarantee so a `/metrics` quantile row is evidence, not an estimate of
+unknown quality. Fixed-bucket histograms fail (a→accuracy): the tail
+lands in one wide bucket and p99 smears by the bucket width.
+
+``LatencySketch`` is the standard relative-error design (DDSketch,
+arxiv 1908.10693): values map to geometric buckets ``gamma^i`` with
+``gamma = (1+alpha)/(1-alpha)``; any reported quantile is within
+``alpha`` relative error of the exact sample quantile (default
+``alpha=0.01`` — well inside the 2% budget the metrics contract
+promises). Merging is bucket-wise counter addition, so merge is exact,
+associative, and commutative: merging per-window sketches equals
+sketching the concatenated samples.
+
+Memory is bounded by ``max_buckets``: on overflow the lowest buckets
+collapse into the floor bucket (tail accuracy is the point; the extreme
+low end degrades first, and only after ~4096 distinct geometric buckets
+≈ 35 decades of range at the default alpha).
+
+Values are arbitrary non-negative floats (latencies in any unit);
+negatives are clamped to the zero bucket rather than rejected so a
+jittery caller cannot crash the metrics path.
+"""
+
+import json
+import math
+from typing import Dict, Iterable, List, Optional
+
+DEFAULT_ALPHA = 0.01
+DEFAULT_MAX_BUCKETS = 4096
+
+
+class LatencySketch:
+    """DDSketch-style quantile sketch: bounded memory, ``alpha`` relative
+    error, exact merge, JSON-serializable."""
+
+    __slots__ = ("alpha", "gamma", "_log_gamma", "max_buckets",
+                 "_buckets", "zero_count", "count", "sum", "min", "max")
+
+    def __init__(self, alpha: float = DEFAULT_ALPHA,
+                 max_buckets: int = DEFAULT_MAX_BUCKETS):
+        if not 0.0 < alpha < 1.0:
+            raise ValueError(f"alpha must be in (0, 1), got {alpha}")
+        self.alpha = float(alpha)
+        self.gamma = (1.0 + alpha) / (1.0 - alpha)
+        self._log_gamma = math.log(self.gamma)
+        self.max_buckets = max(int(max_buckets), 16)
+        self._buckets: Dict[int, int] = {}
+        self.zero_count = 0
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    # -- building -------------------------------------------------------------
+
+    def _key(self, value: float) -> int:
+        return math.ceil(math.log(value) / self._log_gamma)
+
+    def _bucket_value(self, key: int) -> float:
+        # Midpoint of (gamma^(i-1), gamma^i] in the relative sense: within
+        # alpha of every value the bucket covers.
+        return 2.0 * self.gamma ** key / (self.gamma + 1.0)
+
+    def insert(self, value: float, count: int = 1):
+        if count <= 0:
+            return
+        value = float(value)
+        self.count += count
+        self.sum += value * count
+        if value < self.min:
+            self.min = value
+        if value > self.max:
+            self.max = value
+        # Sub-resolution values (including zero and clamped negatives) land
+        # in the dedicated zero bucket; alpha relative error of ~0 is ~0.
+        if value <= 0.0 or value < 1e-12:
+            self.zero_count += count
+            return
+        key = self._key(value)
+        self._buckets[key] = self._buckets.get(key, 0) + count
+        if len(self._buckets) > self.max_buckets:
+            self._collapse()
+
+    def _collapse(self):
+        """Fold the lowest buckets into one floor bucket until within the
+        cap. Tail (high) buckets keep full resolution."""
+        keys = sorted(self._buckets)
+        while len(keys) > self.max_buckets:
+            lowest, second = keys[0], keys[1]
+            self._buckets[second] = (
+                self._buckets.get(second, 0) + self._buckets.pop(lowest)
+            )
+            keys = keys[1:]
+
+    def extend(self, values: Iterable[float]):
+        for v in values:
+            self.insert(v)
+
+    # -- querying -------------------------------------------------------------
+
+    def quantile(self, q: float) -> float:
+        """Sample quantile at ``q`` in [0, 1], within ``alpha`` relative
+        error of the exact nearest-rank quantile. 0.0 on an empty sketch."""
+        if self.count == 0:
+            return 0.0
+        q = min(max(q, 0.0), 1.0)
+        rank = max(int(math.ceil(q * self.count)), 1)
+        if rank <= self.zero_count:
+            return 0.0
+        seen = self.zero_count
+        for key in sorted(self._buckets):
+            seen += self._buckets[key]
+            if seen >= rank:
+                return self._bucket_value(key)
+        return self._bucket_value(max(self._buckets))  # numeric safety net
+
+    def quantiles(self, qs: Iterable[float]) -> List[float]:
+        return [self.quantile(q) for q in qs]
+
+    @property
+    def avg(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+    # -- merging --------------------------------------------------------------
+
+    def merge(self, other: "LatencySketch") -> "LatencySketch":
+        """Fold ``other`` into this sketch (bucket-wise addition; exact).
+
+        Requires matching ``alpha`` — merging incompatible geometries would
+        silently corrupt the accuracy guarantee.
+        """
+        if abs(other.alpha - self.alpha) > 1e-12:
+            raise ValueError(
+                f"cannot merge sketches with alpha {other.alpha} != "
+                f"{self.alpha}"
+            )
+        if other.count == 0:
+            return self
+        for key, n in other._buckets.items():
+            self._buckets[key] = self._buckets.get(key, 0) + n
+        self.zero_count += other.zero_count
+        self.count += other.count
+        self.sum += other.sum
+        self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
+        if len(self._buckets) > self.max_buckets:
+            self._collapse()
+        return self
+
+    @classmethod
+    def merged(cls, sketches: Iterable["LatencySketch"],
+               alpha: Optional[float] = None) -> "LatencySketch":
+        out = None
+        for s in sketches:
+            if out is None:
+                out = cls(alpha=alpha if alpha is not None else s.alpha,
+                          max_buckets=s.max_buckets)
+            out.merge(s)
+        return out if out is not None else cls(
+            alpha=alpha if alpha is not None else DEFAULT_ALPHA
+        )
+
+    # -- serialization --------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {
+            "alpha": self.alpha,
+            "zero": self.zero_count,
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min if self.count else None,
+            "max": self.max if self.count else None,
+            # JSON objects require string keys; parse back with int().
+            "buckets": {str(k): v for k, v in self._buckets.items()},
+        }
+
+    @classmethod
+    def from_dict(cls, doc: dict,
+                  max_buckets: int = DEFAULT_MAX_BUCKETS) -> "LatencySketch":
+        sketch = cls(alpha=float(doc.get("alpha", DEFAULT_ALPHA)),
+                     max_buckets=max_buckets)
+        sketch.zero_count = int(doc.get("zero", 0))
+        sketch.count = int(doc.get("count", 0))
+        sketch.sum = float(doc.get("sum", 0.0))
+        sketch.min = (
+            float(doc["min"]) if doc.get("min") is not None else math.inf
+        )
+        sketch.max = (
+            float(doc["max"]) if doc.get("max") is not None else -math.inf
+        )
+        sketch._buckets = {
+            int(k): int(v) for k, v in (doc.get("buckets") or {}).items()
+        }
+        if len(sketch._buckets) > sketch.max_buckets:
+            sketch._collapse()
+        return sketch
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+    @classmethod
+    def from_json(cls, payload: str) -> "LatencySketch":
+        return cls.from_dict(json.loads(payload))
+
+    def __repr__(self):
+        return (
+            f"LatencySketch(alpha={self.alpha}, count={self.count}, "
+            f"p50={self.quantile(0.5):.1f}, p99={self.quantile(0.99):.1f})"
+        )

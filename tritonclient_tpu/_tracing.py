@@ -38,10 +38,13 @@ plane uses, so trace spans and ``get_inference_statistics`` durations are
 directly comparable; exporters shift them onto the unix epoch.
 """
 
+import heapq
 import logging
 import os
 import threading
 import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from tritonclient_tpu import _otel
@@ -55,12 +58,66 @@ from tritonclient_tpu._otel import (
 # Canonical span-timestamp order for one traced request. The protocol
 # front-end records the first and last; the core records the middle four.
 SPAN_ORDER = _otel.TIMESTAMP_ORDER
+_CANONICAL_TIMESTAMPS = frozenset(_otel.TIMESTAMP_ORDER)
 
 # Default per-trace-file cap on buffered finished records (the file is
 # rewritten as a full document on flush, so the cap bounds both memory and
 # rewrite cost for long-running servers). Override per collector with
 # ``TraceCollector(max_buffered=N)``; oldest records are dropped first.
 DEFAULT_MAX_BUFFERED = 100_000
+
+# Stage-clock vocabulary: one fixed spelling shared by the flight
+# recorder's dump, scripts/tail_report.py, and the tests. Each stage is a
+# contiguous interval of the request timeline; together (plus "ingress")
+# they partition REQUEST_RECV..RESPONSE_SEND.
+STAGE_INGRESS = "ingress"
+STAGE_QUEUE_WAIT = "queue-wait"
+STAGE_BATCH_FORMATION = "batch-formation"
+STAGE_COMPUTE = "compute"
+STAGE_RESPONSE_MARSHAL = "response-marshal"
+STAGE_ORDER = (
+    STAGE_INGRESS,
+    STAGE_QUEUE_WAIT,
+    STAGE_BATCH_FORMATION,
+    STAGE_COMPUTE,
+    STAGE_RESPONSE_MARSHAL,
+)
+
+
+def stage_clocks(timestamps: Dict[str, int]) -> Dict[str, int]:
+    """Per-stage durations (ns) from one request's event stream.
+
+    Boundaries, in timeline order:
+
+    * ``ingress``            REQUEST_RECV -> QUEUE_START (wire parse)
+    * ``queue-wait``         QUEUE_START -> BATCH_FORM (pure queue delay;
+      BATCH_FORM is stamped when a dispatcher takes the batch — for the
+      direct/unbatched path it is absent and COMPUTE_INPUT closes the
+      stage at zero width)
+    * ``batch-formation``    BATCH_FORM -> COMPUTE_INFER (stats stamping,
+      input resolve, concat/pad up to the model dispatch)
+    * ``compute``            COMPUTE_INFER -> COMPUTE_OUTPUT
+    * ``response-marshal``   COMPUTE_OUTPUT -> RESPONSE_SEND
+
+    Stages whose endpoints were never stamped (partial/error traces) are
+    omitted; durations are clamped non-negative so a torn record cannot
+    produce negative shares downstream.
+    """
+    ts = timestamps
+    bf = ts.get("BATCH_FORM", ts.get("COMPUTE_INPUT"))
+    edges = (
+        (STAGE_INGRESS, ts.get("REQUEST_RECV"), ts.get("QUEUE_START")),
+        (STAGE_QUEUE_WAIT, ts.get("QUEUE_START"), bf),
+        (STAGE_BATCH_FORMATION, bf, ts.get("COMPUTE_INFER")),
+        (STAGE_COMPUTE, ts.get("COMPUTE_INFER"), ts.get("COMPUTE_OUTPUT")),
+        (STAGE_RESPONSE_MARSHAL, ts.get("COMPUTE_OUTPUT"),
+         ts.get("RESPONSE_SEND")),
+    )
+    return {
+        name: max(end - start, 0)
+        for name, start, end in edges
+        if start is not None and end is not None
+    }
 
 
 class TraceContext:
@@ -76,6 +133,13 @@ class TraceContext:
     and by the direct path otherwise). ``set_attribute`` adds span
     attributes (e.g. the dynamic batcher's batch id) that land on the
     queue-wait and compute spans of the exported tree.
+
+    A context may be *flight-only* (``collector=None``): the request was
+    not head-sampled, but the flight recorder still wants its stage clocks
+    in case it turns out to be one of the slowest in the window, an error,
+    or a deadline miss. Non-canonical stage boundaries (e.g. BATCH_FORM)
+    land in ``marks`` rather than ``timestamps`` so the on-disk trace-file
+    shape is unchanged for sampled traces.
     """
 
     __slots__ = (
@@ -86,15 +150,20 @@ class TraceContext:
         "model_version",
         "request_id",
         "timestamps",
+        "marks",
         "attributes",
         "level",
         "tensors",
+        "deadline_ns",
+        "error",
         "_collector",
+        "_flight",
     )
 
     def __init__(self, collector, seq_id, model_name, model_version,
                  request_id, level, trace_id, parent_span_id):
         self._collector = collector
+        self._flight = None
         self.seq_id = seq_id
         self.trace_id = trace_id
         self.parent_span_id = parent_span_id
@@ -103,17 +172,30 @@ class TraceContext:
         self.request_id = request_id
         self.level = tuple(level)
         self.timestamps: Dict[str, int] = {}
+        self.marks: Dict[str, int] = {}
         self.attributes: Dict[str, object] = {}
         self.tensors: Optional[List[dict]] = None
+        self.deadline_ns = 0
+        self.error: Optional[str] = None
 
     def record(self, name: str, ns: Optional[int] = None):
-        if name not in self.timestamps:
-            self.timestamps[name] = (
-                time.monotonic_ns() if ns is None else int(ns)
-            )
+        # Non-canonical boundaries (BATCH_FORM and future stage clocks)
+        # stay out of the exported timestamp stream: sampled trace files
+        # keep the documented six-name shape.
+        target = (
+            self.timestamps if name in _CANONICAL_TIMESTAMPS else self.marks
+        )
+        if name not in target:
+            target[name] = time.monotonic_ns() if ns is None else int(ns)
 
     def set_attribute(self, key: str, value):
         self.attributes[key] = value
+
+    def note_error(self, message: str):
+        """Mark this request failed (first error wins); the flight recorder
+        retains every errored request regardless of its latency."""
+        if self.error is None:
+            self.error = str(message)
 
     @property
     def wants_tensors(self) -> bool:
@@ -125,10 +207,13 @@ class TraceContext:
         self.tensors = tensors
 
     def finish(self):
-        """Submit this trace to its collector. Idempotent — the stream
-        pipeline's ordering barrier and its yielder may both reach the
-        finalize step."""
+        """Submit this trace to its collector and offer it to the flight
+        recorder. Idempotent — the stream pipeline's ordering barrier and
+        its yielder may both reach the finalize step."""
         collector, self._collector = self._collector, None
+        flight, self._flight = self._flight, None
+        if flight is not None:
+            flight.offer(self)
         if collector is not None:
             collector.submit(self)
 
@@ -322,6 +407,270 @@ class TraceCollector:
             logging.getLogger("tritonclient_tpu.server").warning(
                 "unable to write trace file %s", trace_file
             )
+
+
+# --------------------------------------------------------------------------- #
+# flight recorder (tail-based retention)                                      #
+# --------------------------------------------------------------------------- #
+
+
+@dataclass
+class FlightRecord:
+    """One retained request: identity + event stream + batcher context.
+
+    ``timestamps`` merges the canonical span stream with the stage marks
+    (BATCH_FORM), so ``stage_clocks`` applies directly. ``trace_id`` is
+    empty unless the request was also head-sampled; the Perfetto export
+    mints one lazily.
+    """
+
+    seq: int
+    model_name: str
+    model_version: str
+    request_id: str
+    trace_id: str
+    parent_span_id: str
+    duration_ns: int
+    status: str  # "ok" | "error" | "deadline_miss"
+    error: Optional[str] = None
+    timestamps: Dict[str, int] = field(default_factory=dict)
+    attributes: Dict[str, object] = field(default_factory=dict)
+    wall_time_s: float = 0.0
+
+    def as_dict(self) -> dict:
+        stages = stage_clocks(self.timestamps)
+        return {
+            "seq": self.seq,
+            "model_name": self.model_name,
+            "model_version": self.model_version or "1",
+            "request_id": self.request_id,
+            "trace_id": self.trace_id,
+            "parent_span_id": self.parent_span_id,
+            "duration_us": self.duration_ns // 1000,
+            "status": self.status,
+            "error": self.error,
+            "stages_us": {k: v // 1000 for k, v in stages.items()},
+            "timestamps": dict(self.timestamps),
+            "attributes": dict(self.attributes),
+            "wall_time_s": self.wall_time_s,
+        }
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class FlightRecorder:
+    """Always-on bounded retention of the requests that explain the tail.
+
+    The inverse of the collector's head sampling (``trace_rate`` keeps 1
+    in N *arrivals* — exactly wrong for tails, where the interesting
+    requests are the rare slow ones): every finished request is *offered*,
+    and the recorder keeps
+
+    * the slowest ``slowest_k`` requests per ``window_s`` sliding window,
+      for the last ``windows`` windows (a min-heap per window: an offer
+      beats the window's current floor or is dropped in O(1)/O(log k));
+    * every error and every deadline miss, in a separate bounded ring.
+
+    The per-request cost when a request is NOT retained — the hot-path
+    case — is one lock, one subtraction, and one heap-floor compare.
+
+    Env knobs: ``TPU_FLIGHT_RECORDER=0`` disables, ``TPU_FLIGHT_SLOWEST_K``
+    (default 32), ``TPU_FLIGHT_WINDOW_S`` (default 10),
+    ``TPU_FLIGHT_WINDOWS`` (default 6), ``TPU_FLIGHT_ERRORS`` (default
+    256).
+    """
+
+    def __init__(self, slowest_k: Optional[int] = None,
+                 window_s: Optional[float] = None,
+                 windows: Optional[int] = None,
+                 max_errors: Optional[int] = None,
+                 on_deadline_miss=None):
+        self.enabled = os.environ.get("TPU_FLIGHT_RECORDER", "1") != "0"
+        self.slowest_k = max(
+            slowest_k if slowest_k is not None
+            else _env_int("TPU_FLIGHT_SLOWEST_K", 32), 1)
+        self.window_s = max(
+            window_s if window_s is not None
+            else _env_float("TPU_FLIGHT_WINDOW_S", 10.0), 0.001)
+        self.windows = max(
+            windows if windows is not None
+            else _env_int("TPU_FLIGHT_WINDOWS", 6), 1)
+        self.max_errors = max(
+            max_errors if max_errors is not None
+            else _env_int("TPU_FLIGHT_ERRORS", 256), 1)
+        # Called OUTSIDE the recorder lock with the model name on every
+        # deadline miss (the core bumps its per-model counter there).
+        self.on_deadline_miss = on_deadline_miss
+        self._lock = threading.Lock()
+        self._seq = 0
+        # window id -> min-heap of (duration_ns, seq, FlightRecord)
+        self._slow: "OrderedDict[int, list]" = OrderedDict()
+        self._errors: deque = deque(maxlen=self.max_errors)
+        self.offered = 0
+        self.retained = 0
+        self.error_count = 0
+        self.deadline_miss_count = 0
+        self._epoch_ns = _otel.epoch_offset_ns()
+
+    # -- ingest ---------------------------------------------------------------
+
+    def offer(self, ctx: "TraceContext") -> Optional[str]:
+        """Offer one finished request; returns its status, or None when
+        the recorder is off / the context carries no timeline."""
+        if not self.enabled:
+            return None
+        # Hot path: duration from the canonical stamps directly — the
+        # merged timestamp dict is built only for records that are kept.
+        ts = ctx.timestamps
+        if not ts and not ctx.marks:
+            return None
+        end = ts.get("RESPONSE_SEND")
+        start = ts.get("REQUEST_RECV")
+        if end is None or start is None:
+            merged = dict(ts)
+            merged.update(ctx.marks)
+            values = merged.values()
+            end = merged.get("RESPONSE_SEND", max(values))
+            start = merged.get("REQUEST_RECV", min(values))
+        duration = max(end - start, 0)
+        deadline_missed = 0 < ctx.deadline_ns < duration
+        if deadline_missed:
+            ctx.attributes["deadline_exceeded"] = True
+        status = (
+            "error" if ctx.error is not None
+            else "deadline_miss" if deadline_missed
+            else "ok"
+        )
+        with self._lock:
+            self.offered += 1
+            self._seq += 1
+            seq = self._seq
+            if status == "ok":
+                wid = int(time.monotonic() / self.window_s)
+                heap = self._slow.get(wid)
+                if heap is None:
+                    heap = self._slow[wid] = []
+                    while len(self._slow) > self.windows:
+                        self._slow.popitem(last=False)
+                if len(heap) < self.slowest_k:
+                    record = self._record(ctx, seq, duration, status)
+                    heapq.heappush(heap, (duration, seq, record))
+                    self.retained += 1
+                elif duration > heap[0][0]:
+                    record = self._record(ctx, seq, duration, status)
+                    heapq.heapreplace(heap, (duration, seq, record))
+            else:
+                record = self._record(ctx, seq, duration, status)
+                self._errors.append(record)
+                if status == "error":
+                    self.error_count += 1
+                else:
+                    self.deadline_miss_count += 1
+        if deadline_missed and self.on_deadline_miss is not None:
+            self.on_deadline_miss(ctx.model_name)
+        return status
+
+    def _record(self, ctx, seq, duration, status) -> FlightRecord:
+        ts = dict(ctx.timestamps)
+        ts.update(ctx.marks)
+        return FlightRecord(
+            seq=seq,
+            model_name=ctx.model_name,
+            model_version=ctx.model_version,
+            request_id=ctx.request_id,
+            trace_id=ctx.trace_id,
+            parent_span_id=ctx.parent_span_id,
+            duration_ns=duration,
+            status=status,
+            error=ctx.error,
+            timestamps=ts,
+            attributes=dict(ctx.attributes),
+            wall_time_s=time.time(),
+        )
+
+    # -- dump -----------------------------------------------------------------
+
+    def records(self) -> List[FlightRecord]:
+        """Every retained record, slowest first (errors/deadline misses
+        ranked by their own duration among them)."""
+        with self._lock:
+            out = [rec for heap in self._slow.values()
+                   for _, _, rec in heap]
+            out.extend(self._errors)
+        out.sort(key=lambda r: r.duration_ns, reverse=True)
+        return out
+
+    def dump(self) -> dict:
+        """The ``v2/debug/flight_recorder`` document: config + counters +
+        retained records (stage clocks pre-computed per record)."""
+        records = self.records()
+        with self._lock:
+            counters = {
+                "offered": self.offered,
+                "retained_slow": self.retained,
+                "errors": self.error_count,
+                "deadline_misses": self.deadline_miss_count,
+            }
+        return {
+            "kind": "flight_recorder",
+            "config": {
+                "slowest_k": self.slowest_k,
+                "window_s": self.window_s,
+                "windows": self.windows,
+                "max_errors": self.max_errors,
+                "enabled": self.enabled,
+            },
+            "counters": counters,
+            "records": [r.as_dict() for r in records],
+        }
+
+    def to_trace_records(self) -> List[TraceRecord]:
+        """Retained records as span-tree TraceRecords (Perfetto export
+        path). Records that were never head-sampled get a trace id minted
+        here; BATCH_FORM and the batcher context ride as attributes."""
+        out = []
+        for rec in self.records():
+            trace_id = rec.trace_id or new_trace_id()
+            attrs = dict(rec.attributes)
+            attrs["flight.status"] = rec.status
+            if rec.error:
+                attrs["flight.error"] = rec.error
+            if "BATCH_FORM" in rec.timestamps:
+                attrs["batch_form_ns"] = rec.timestamps["BATCH_FORM"]
+            out.append(TraceRecord(
+                seq_id=rec.seq,
+                model_name=rec.model_name,
+                model_version=rec.model_version,
+                request_id=rec.request_id,
+                trace_id=trace_id,
+                parent_span_id=rec.parent_span_id,
+                spans=build_span_tree(
+                    trace_id, rec.parent_span_id, rec.timestamps, attrs,
+                ),
+                timestamps=dict(rec.timestamps),
+                attributes=attrs,
+            ))
+        return out
+
+    def render_perfetto(self) -> str:
+        return _otel.render_perfetto(self.to_trace_records(), self._epoch_ns)
+
+    def clear(self):
+        with self._lock:
+            self._slow.clear()
+            self._errors.clear()
 
 
 # --------------------------------------------------------------------------- #

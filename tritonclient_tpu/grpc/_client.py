@@ -7,6 +7,7 @@ repository control, statistics, trace/log settings, shared-memory admin
 infer, async_infer with cancellable CallContext, and bidirectional streaming.
 """
 
+import json
 from typing import Any, Dict, List, Optional
 
 import grpc
@@ -334,6 +335,26 @@ class InferenceServerClient(InferenceServerClientBase):
                 request, metadata=self._get_metadata(headers), timeout=client_timeout
             )
             return self._return(response, as_json)
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    def get_flight_recorder(self, format=None, headers=None,
+                            client_timeout=None) -> dict:
+        """Dump the server's tail-based flight recorder (slowest-K span
+        trees per window plus every error/deadline miss). ``format=
+        "perfetto"`` returns Chrome trace-event JSON instead of the
+        structured dump."""
+        from tritonclient_tpu.protocol._service import RawJsonMessage
+
+        try:
+            request = RawJsonMessage(
+                json.dumps({"format": format}).encode() if format else b""
+            )
+            response = self._client_stub.FlightRecorder(
+                request, metadata=self._get_metadata(headers),
+                timeout=client_timeout,
+            )
+            return json.loads(response.payload)
         except grpc.RpcError as rpc_error:
             raise_error_grpc(rpc_error)
 

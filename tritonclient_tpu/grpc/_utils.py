@@ -13,6 +13,7 @@ from tritonclient_tpu.protocol._literals import (
     KEY_SEQUENCE_END,
     KEY_SEQUENCE_ID,
     KEY_SEQUENCE_START,
+    KEY_TIMEOUT,
     RESERVED_REQUEST_PARAMS,
 )
 from tritonclient_tpu.utils import InferenceServerException
@@ -82,7 +83,7 @@ def _get_inference_request(
     if priority:
         request.parameters["priority"].uint64_param = priority
     if timeout:
-        request.parameters["timeout"].int64_param = timeout
+        request.parameters[KEY_TIMEOUT].int64_param = timeout
 
     for infer_input in infer_inputs:
         request.inputs.extend([infer_input._get_tensor()])

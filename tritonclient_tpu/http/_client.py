@@ -22,6 +22,7 @@ from typing import Dict, Optional
 from urllib.parse import urlparse
 
 from tritonclient_tpu.protocol._literals import (
+    EP_FLIGHT_RECORDER,
     EP_HEALTH_LIVE,
     EP_HEALTH_READY,
     EP_LOGGING,
@@ -396,6 +397,21 @@ class InferenceServerClient(InferenceServerClientBase):
 
     def get_log_settings(self, headers=None, query_params=None) -> dict:
         status, _, body = self._get(EP_LOGGING, headers, query_params)
+        _raise_if_error(status, body)
+        return json.loads(body)
+
+    def get_flight_recorder(self, format=None, headers=None,
+                            query_params=None) -> dict:
+        """Dump the server's tail-based flight recorder (slowest-K span
+        trees per window plus every error/deadline miss). ``format=
+        "perfetto"`` returns Chrome trace-event JSON instead of the
+        structured dump."""
+        params = dict(query_params or {})
+        if format:
+            params["format"] = format
+        status, _, body = self._get(
+            EP_FLIGHT_RECORDER, headers, params or None
+        )
         _raise_if_error(status, body)
         return json.loads(body)
 

@@ -15,6 +15,7 @@ from tritonclient_tpu.protocol._literals import (
     KEY_SEQUENCE_END,
     KEY_SEQUENCE_ID,
     KEY_SEQUENCE_START,
+    KEY_TIMEOUT,
     RESERVED_REQUEST_PARAMS,
 )
 
@@ -110,7 +111,7 @@ def _get_inference_request_chunks(
     if priority:
         parameters["priority"] = priority
     if timeout is not None:
-        parameters["timeout"] = timeout
+        parameters[KEY_TIMEOUT] = timeout
 
     infer_request["inputs"] = [i._get_tensor() for i in inputs]
     if outputs:

@@ -20,6 +20,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from tritonclient_tpu import _otel
+from tritonclient_tpu._sketch import LatencySketch
 from tritonclient_tpu.perf_analyzer._stats import (
     SERVER_STAT_KEYS,
     InferStat,
@@ -851,6 +852,10 @@ class MeasurementSession:
             for w in range(concurrency)
         ]
         self._started = []
+        # Merged across every window this session measures: pooled tail
+        # quantiles come from the pooled distribution (see
+        # _stats.pooled_latency_quantiles), not from per-window p99s.
+        self.pooled_sketch = LatencySketch()
 
     def __enter__(self):
         try:
@@ -915,7 +920,19 @@ class MeasurementSession:
             window.stat.cumulative_receive_time_ns += (
                 w.stat.cumulative_receive_time_ns
             )
+        self.pooled_sketch.merge(window.latency_sketch())
         return window
+
+    def pooled_quantiles(self, quantiles=(0.5, 0.9, 0.95, 0.99, 0.999)):
+        """Latency quantiles (us) over every window measured so far, from
+        the merged sketch."""
+        out = {"count": self.pooled_sketch.count}
+        for q in quantiles:
+            label = f"p{q * 100:g}".replace(".", "")
+            out[f"latency_{label}_us"] = round(
+                self.pooled_sketch.quantile(q), 1
+            )
+        return out
 
     def close(self):
         for w in self._started:

@@ -8,7 +8,9 @@ reported the way perf_analyzer users expect.
 
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, Iterable, List, Optional
+
+from tritonclient_tpu._sketch import LatencySketch
 
 
 @dataclass
@@ -100,6 +102,17 @@ class MeasurementWindow:
     def throughput(self) -> float:
         return len(self.latencies_ns) / self.duration_s if self.duration_s else 0.0
 
+    def latency_sketch(self) -> LatencySketch:
+        """This window's latencies (microseconds) as a mergeable quantile
+        sketch: pooled quantiles across windows/runs come from MERGED
+        sketches — the pooled p99 is computed over the pooled sample
+        within 2% relative error, not min/median-of-window-p99s (which
+        systematically understates the tail)."""
+        sketch = LatencySketch()
+        for ns in self.latencies_ns:
+            sketch.insert(ns / 1000.0)
+        return sketch
+
     def summary(self, percentiles=(50, 90, 95, 99)) -> Dict:
         lat = sorted(self.latencies_ns)
         avg = sum(lat) / len(lat) if lat else 0
@@ -147,3 +160,22 @@ class MeasurementWindow:
                         "compute_output"):
                 out[f"server_{key}_us"] = int(s.get(f"{key}_ns", 0) / n / 1000)
         return out
+
+
+def pooled_latency_quantiles(
+    windows: Iterable[MeasurementWindow],
+    quantiles=(0.5, 0.9, 0.95, 0.99, 0.999),
+) -> Dict[str, float]:
+    """Quantiles of the MERGED latency sketches of several windows.
+
+    Returns ``{"count": n, "latency_p50_us": ..., ...}`` keyed like
+    ``summary()``'s percentile fields (plus p999). This is the pooled-tail
+    estimator: every window's full distribution contributes, so one
+    quiet window cannot mask another's tail.
+    """
+    merged = LatencySketch.merged(w.latency_sketch() for w in windows)
+    out: Dict[str, float] = {"count": merged.count}
+    for q in quantiles:
+        label = f"p{q * 100:g}".replace(".", "")
+        out[f"latency_{label}_us"] = round(merged.quantile(q), 1)
+    return out

@@ -93,6 +93,11 @@ KEY_FINAL_RESPONSE = "triton_final_response"
 # Repository control.
 KEY_UNLOAD_DEPENDENTS = "unload_dependents"
 
+#: KServe request-level timeout budget in microseconds (the reference
+#: clients' ``infer(..., timeout=...)`` kwarg rides the wire under this
+#: parameter name). The server parses it into ``CoreRequest.deadline_us``.
+KEY_TIMEOUT = "timeout"
+
 #: Request parameters the clients reserve for dedicated kwargs; user-supplied
 #: ``parameters`` dicts may not name these (reference:
 #: tritonclient/http/_utils.py:114-117 and grpc/_utils.py equivalent).
@@ -135,6 +140,10 @@ EP_HEALTH_READY = "v2/health/ready"
 EP_REPOSITORY_INDEX = "v2/repository/index"
 EP_LOGGING = "v2/logging"
 EP_TRACE_SETTING = "v2/trace/setting"
+#: Flight-recorder dump (tail-based retention): slowest-K span trees per
+#: sliding window plus every error/deadline miss. ``?format=perfetto``
+#: renders the retained records as Chrome trace-event JSON.
+EP_FLIGHT_RECORDER = "v2/debug/flight_recorder"
 #: Prometheus exposition (Triton serves this on a dedicated port; the
 #: in-process server shares its one HTTP port).
 EP_METRICS = "metrics"

@@ -12,6 +12,33 @@ from tritonclient_tpu.protocol import kserve_pb2 as pb
 
 FULL_SERVICE_NAME = "inference.GRPCInferenceService"
 
+
+class RawJsonMessage:
+    """Duck-typed protobuf stand-in carrying opaque JSON bytes.
+
+    The debug/diagnostic RPCs (flight recorder dump) move a JSON document
+    whose schema evolves with the observability plane; freezing it into
+    the compiled kserve descriptor would couple a debug surface to a
+    protobuf regeneration. Both the hand-written stub and the handler
+    factory only need ``SerializeToString``/``FromString``, so the wire
+    payload IS the JSON bytes.
+    """
+
+    __slots__ = ("payload",)
+
+    def __init__(self, payload=b""):
+        self.payload = (
+            payload if isinstance(payload, bytes) else str(payload).encode()
+        )
+
+    def SerializeToString(self) -> bytes:
+        return self.payload
+
+    @classmethod
+    def FromString(cls, data: bytes) -> "RawJsonMessage":
+        return cls(data)
+
+
 # name -> (kind, request type, response type); kind in {"unary", "stream"}
 RPC_METHODS = {
     "ServerLive": ("unary", pb.ServerLiveRequest, pb.ServerLiveResponse),
@@ -89,6 +116,9 @@ RPC_METHODS = {
     ),
     "TraceSetting": ("unary", pb.TraceSettingRequest, pb.TraceSettingResponse),
     "LogSettings": ("unary", pb.LogSettingsRequest, pb.LogSettingsResponse),
+    # Debug surface (raw JSON payloads; see RawJsonMessage above): the
+    # gRPC analog of the HTTP v2/debug/flight_recorder endpoint.
+    "FlightRecorder": ("unary", RawJsonMessage, RawJsonMessage),
 }
 
 

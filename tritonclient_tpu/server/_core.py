@@ -22,7 +22,13 @@ from typing import Dict, Iterator, List, Optional, Union
 import numpy as np
 
 from tritonclient_tpu import sanitize
-from tritonclient_tpu._tracing import TraceCollector, configure_logging
+from tritonclient_tpu._sketch import LatencySketch
+from tritonclient_tpu._tracing import (
+    FlightRecorder,
+    TraceCollector,
+    TraceContext,
+    configure_logging,
+)
 from tritonclient_tpu.protocol._literals import SERVER_EXTENSIONS
 from tritonclient_tpu.utils import (
     deserialize_bytes_tensor,
@@ -77,6 +83,12 @@ class CoreRequest:
     parameters: dict = field(default_factory=dict)
     inputs: List[CoreTensor] = field(default_factory=list)
     outputs: List[CoreRequestedOutput] = field(default_factory=list)
+    # Parsed KServe `timeout` request parameter (microseconds; 0 = none).
+    # Held OUT of `parameters` so carrying a deadline does not disqualify
+    # the request from dynamic batching; currently observation-only
+    # (deadline_exceeded stamping + counter + flight-recorder routing) —
+    # shedding/cancellation is ROADMAP item 1's PR.
+    deadline_us: int = 0
     # Per-request TraceContext (tritonclient_tpu._tracing), attached by the
     # protocol front-end when the request is sampled; the execution paths
     # stamp the QUEUE_START/COMPUTE_* spans onto it. Excluded from equality
@@ -364,6 +376,18 @@ _DURATION_BUCKETS_US = (
 )
 
 
+# Stage-latency sketch keys: "request" is end-to-end (success AND fail,
+# matching the duration histogram); the rest mirror the cumulative
+# nv_inference_*_duration_us counters with full distributions. One fixed
+# tuple so /metrics rendering and tests agree on the family set.
+_SKETCH_STAGES = (
+    "request", "queue", "compute_input", "compute_infer", "compute_output",
+)
+
+# Quantiles exposed per sketch-backed /metrics summary family.
+_METRIC_QUANTILES = (0.5, 0.9, 0.99, 0.999)
+
+
 class _ModelStats:
     def __init__(self):
         self.inference_count = 0
@@ -379,21 +403,38 @@ class _ModelStats:
         self.compute_input_ns = 0
         self.compute_infer_ns = 0
         self.compute_output_ns = 0
+        # Requests whose KServe `timeout` budget elapsed before the
+        # response went out (observation only — the request still ran).
+        self.deadline_exceeded_count = 0
         # Per-bucket (non-cumulative) request-duration counts; the +Inf
         # bucket is the trailing slot. Every success AND failure observes
         # exactly once, so +Inf cumulative == success_count + fail_count.
         self.duration_buckets = [0] * (len(_DURATION_BUCKETS_US) + 1)
+        # Mergeable relative-error quantile sketches (microseconds) per
+        # stage: the histogram's fixed buckets smear the tail, these do
+        # not (<= 2% relative error at any quantile). Mutated only under
+        # the core lock, same as every other counter here.
+        self.sketches = {name: LatencySketch() for name in _SKETCH_STAGES}
         # Requests admitted (infer()/infer_submit()) but not yet answered:
         # the queue-depth gauge. Returns to 0 when the server is idle.
         self.pending = 0
 
     def observe_duration(self, duration_ns: int):
         us = duration_ns // 1000
+        self.sketches["request"].insert(us)
         for i, edge in enumerate(_DURATION_BUCKETS_US):
             if us <= edge:
                 self.duration_buckets[i] += 1
                 return
         self.duration_buckets[-1] += 1
+
+    def observe_stages(self, input_ns: int, infer_ns: int, output_ns: int,
+                       n: int = 1):
+        """Per-request compute-stage samples (success path, microseconds);
+        the queue stage is observed by the dynamic batcher at dispatch."""
+        self.sketches["compute_input"].insert(input_ns // 1000, n)
+        self.sketches["compute_infer"].insert(infer_ns // 1000, n)
+        self.sketches["compute_output"].insert(output_ns // 1000, n)
 
     def as_dict(self, name: str, version: str) -> dict:
         return {
@@ -622,6 +663,10 @@ class _DynamicBatcher:
         # so the hysteresis must be too — a shared flag would let a hot
         # signature drag an unrelated one into the wrong regime.
         self._serialized: Dict[tuple, bool] = {}
+        # repr(signature) cached per signature: the flight recorder wants
+        # it stamped on every request, and rebuilding the string costs
+        # more than the rest of the admission bookkeeping combined.
+        self._sig_labels: Dict[tuple, str] = {}
         self._model = None
         self._stats = None
         self._cap = 0
@@ -633,6 +678,20 @@ class _DynamicBatcher:
         """Current queue length (the nv_inference_queue_depth gauge)."""
         with self._cv:
             return len(self._queue)
+
+    def oldest_age_us(self) -> int:
+        """Age of the oldest queued request in microseconds (the
+        nv_inference_oldest_request_age_us gauge; 0 when the queue is
+        empty). Depth alone cannot distinguish a deep-but-moving queue
+        from a stalled one — age can."""
+        with self._cv:
+            if not self._queue:
+                return 0
+            # Appends at the tail, removals anywhere: index 0 is always
+            # the oldest surviving arrival.
+            return max(
+                (time.monotonic_ns() - self._queue[0].t_enqueue) // 1000, 0
+            )
 
     def eligible(self, request: CoreRequest, cap: int) -> bool:
         # Sequence/priority parameters, BYTES tensors, rank-0 or empty
@@ -664,11 +723,30 @@ class _DynamicBatcher:
         )
         slot = _BatchSlot(request, signature,
                           int(request.inputs[0].shape[0]))
-        if request.trace is not None:
-            request.trace.record("QUEUE_START", slot.t_enqueue)
+        trace = request.trace
+        if trace is not None:
+            trace.record("QUEUE_START", slot.t_enqueue)
         with self._cv:
             # Per-model batcher: model/stats/cap are stable across calls.
             self._model, self._stats, self._cap = model, stats, cap
+            if trace is not None:
+                # Batcher context at ADMISSION: what the queue looked like
+                # when this request joined it — the flight recorder's
+                # backlog-correlation signal (tail_report consumes these).
+                trace.set_attribute(
+                    "batcher.backlog_at_admission", len(self._queue)
+                )
+                trace.set_attribute(
+                    "batcher.oldest_age_us",
+                    max((slot.t_enqueue - self._queue[0].t_enqueue) // 1000,
+                        0) if self._queue else 0,
+                )
+                label = self._sig_labels.get(signature)
+                if label is None:
+                    if len(self._sig_labels) > 64:
+                        self._sig_labels.clear()  # one-off shape churn
+                    label = self._sig_labels[signature] = repr(signature)
+                trace.set_attribute("batcher.signature", label)
             self._queue.append(slot)
             # Arrival bookkeeping feeds both the hold gate and the
             # serialize/spread regime switch — always on. Per-signature
@@ -845,6 +923,13 @@ class _DynamicBatcher:
                 self._batch_seq += 1
                 batch_id = self._batch_seq
                 model, stats = self._model, self._stats
+                # The hold/regime decision in force when this batch formed
+                # (per-signature hysteresis state, read under the cv).
+                regime = (
+                    "serialize"
+                    if self._serialized.get(batch[0].signature)
+                    else "spread"
+                )
                 if self._queue:
                     # The spread rule may leave backlog for siblings:
                     # wake them to take it concurrently.
@@ -853,17 +938,29 @@ class _DynamicBatcher:
                 # Triton queue-duration semantics: time a request waited
                 # between batcher enqueue and batch execution start.
                 t_exec = time.monotonic_ns()
+                oldest_wait_us = (
+                    t_exec - min(s.t_enqueue for s in batch)
+                ) // 1000
                 with self.core._lock:
                     for s in batch:
                         stats.queue_ns += t_exec - s.t_enqueue
-                for s in batch:
+                        stats.sketches["queue"].insert(
+                            (t_exec - s.t_enqueue) // 1000
+                        )
+                for i, s in enumerate(batch):
                     if s.request.trace is not None:
                         # Batch identity on the spans batching shapes: the
                         # span-tree builder copies these onto the
-                        # queue-wait and compute child spans.
-                        s.request.trace.set_attribute("batch.id", batch_id)
-                        s.request.trace.set_attribute(
-                            "batch.size", len(batch)
+                        # queue-wait and compute child spans. BATCH_FORM is
+                        # the queue-wait/batch-formation stage boundary.
+                        trace = s.request.trace
+                        trace.record("BATCH_FORM", t_exec)
+                        trace.set_attribute("batch.id", batch_id)
+                        trace.set_attribute("batch.size", len(batch))
+                        trace.set_attribute("batch.slot", i)
+                        trace.set_attribute("batcher.regime", regime)
+                        trace.set_attribute(
+                            "batch.oldest_wait_us", oldest_wait_us
                         )
                 try:
                     results = self.core._infer_batch(
@@ -915,6 +1012,12 @@ class InferenceCore:
         # semantics — get_trace_settings merges at read time).
         self._trace_settings: Dict[str, dict] = {"": dict(_DEFAULT_TRACE_SETTINGS)}
         self.trace_collector = TraceCollector()
+        # Tail-based retention, the inverse of the collector's head
+        # sampling: always on (TPU_FLIGHT_RECORDER=0 disables), dumped via
+        # v2/debug/flight_recorder on both front-ends.
+        self.flight_recorder = FlightRecorder(
+            on_deadline_miss=self._record_deadline_miss
+        )
         self._log_settings = dict(_DEFAULT_LOG_SETTINGS)
         self._log = logging.getLogger("tritonclient_tpu.server")
         self._log_verbose = 0
@@ -1128,6 +1231,27 @@ class InferenceCore:
             ("nv_inference_compute_output_duration_us",
              "Cumulative compute output duration in microseconds",
              lambda s: s.compute_output_ns // 1000),
+            ("nv_inference_deadline_exceeded_total",
+             "Number of inference requests that exceeded their KServe "
+             "timeout budget",
+             lambda s: s.deadline_exceeded_count),
+        )
+        quantile_families = (
+            ("request", "nv_inference_request_duration_us_quantiles",
+             "Request duration quantiles in microseconds (DDSketch, "
+             "<=2% relative error)"),
+            ("queue", "nv_inference_queue_duration_us_quantiles",
+             "Queue duration quantiles in microseconds (DDSketch, "
+             "<=2% relative error)"),
+            ("compute_input", "nv_inference_compute_input_duration_us_quantiles",
+             "Compute input duration quantiles in microseconds (DDSketch, "
+             "<=2% relative error)"),
+            ("compute_infer", "nv_inference_compute_infer_duration_us_quantiles",
+             "Compute infer duration quantiles in microseconds (DDSketch, "
+             "<=2% relative error)"),
+            ("compute_output", "nv_inference_compute_output_duration_us_quantiles",
+             "Compute output duration quantiles in microseconds (DDSketch, "
+             "<=2% relative error)"),
         )
         with self._lock:
             # Same readiness filter as model_statistics(): unloaded models
@@ -1140,6 +1264,17 @@ class InferenceCore:
             ]
             proto_counts = sorted(self._protocol_requests.items())
             batchers = dict(self._batchers)
+            # Quantiles resolved UNDER the lock: sketch reads iterate the
+            # bucket dict, and every insert happens under this same lock.
+            sketch_rows = {
+                (name, stage): (
+                    stats.sketches[stage].quantiles(_METRIC_QUANTILES),
+                    stats.sketches[stage].count,
+                    stats.sketches[stage].sum,
+                )
+                for name, _version, stats in rows
+                for stage in _SKETCH_STAGES
+            }
         def esc(v: str) -> str:
             # Prometheus exposition label escaping: backslash, quote, LF.
             return (str(v).replace("\\", "\\\\").replace('"', '\\"')
@@ -1180,6 +1315,25 @@ class InferenceCore:
                 f"{(stats.success_ns + stats.fail_ns) // 1000}"
             )
             lines.append(f"{metric}_count{{{labels}}} {cumulative}")
+        # Sketch-backed quantile families (Prometheus summary type): the
+        # histogram above smears the tail into fixed buckets; these report
+        # p50/p90/p99/p999 within <=2% relative error from the mergeable
+        # DDSketch each stage maintains. Quantile rows appear once the
+        # stage has samples; _sum/_count always.
+        for stage, metric, help_text in quantile_families:
+            lines.append(f"# HELP {metric} {help_text}")
+            lines.append(f"# TYPE {metric} summary")
+            for name, version, stats in rows:
+                labels = f'model="{esc(name)}",version="{esc(version)}"'
+                values, count, total = sketch_rows[(name, stage)]
+                if count:
+                    for q, value in zip(_METRIC_QUANTILES, values):
+                        lines.append(
+                            f'{metric}{{{labels},quantile="{q}"}} '
+                            f"{value:.3f}"
+                        )
+                lines.append(f"{metric}_sum{{{labels}}} {total:.3f}")
+                lines.append(f"{metric}_count{{{labels}}} {count}")
         # Queue-depth gauge: requests admitted but not yet answered.
         metric = "nv_inference_pending_request_count"
         lines.append(
@@ -1208,6 +1362,22 @@ class InferenceCore:
             lines.append(
                 f'{metric}{{model="{esc(name)}",version="{esc(version)}"}} '
                 f"{depth}"
+            )
+        # Backlog-age gauge: age of the oldest queued request. Depth alone
+        # cannot distinguish a deep-but-moving queue from a stalled one;
+        # a high age at modest depth IS the stall signature.
+        metric = "nv_inference_oldest_request_age_us"
+        lines.append(
+            f"# HELP {metric} Age in microseconds of the oldest request "
+            "in the dynamic batching queue per model"
+        )
+        lines.append(f"# TYPE {metric} gauge")
+        for name, version, stats in rows:
+            batcher = batchers.get(name)
+            age = batcher.oldest_age_us() if batcher is not None else 0
+            lines.append(
+                f'{metric}{{model="{esc(name)}",version="{esc(version)}"}} '
+                f"{age}"
             )
         # Shared-memory registration gauges (system + tpu planes).
         metric = "nv_shared_memory_region_count"
@@ -1295,29 +1465,65 @@ class InferenceCore:
         request_id: str = "",
         recv_ns: Optional[int] = None,
         traceparent: Optional[str] = None,
+        deadline_us: int = 0,
     ):
-        """Sample one request against the effective trace settings.
+        """Sample one request against the effective trace settings, and
+        arm the flight recorder for it.
 
         Returns a TraceContext (attach it to the CoreRequest) or None.
         Called by the protocol front-ends at ingress, before parse cost is
         known — hence the fast OFF path. ``traceparent`` is the inbound
         W3C header/metadata value (or None); a parseable value continues
         the client's trace, anything else restarts it.
+
+        Head sampling decides only whether the request lands in the
+        *trace collector*; the flight recorder sees every request, so
+        unsampled requests get a lightweight flight-only context (no
+        collector, no W3C identity unless one arrives later). With the
+        recorder disabled AND tracing off this still returns None — the
+        zero-overhead path.
+
+        ``deadline_us`` is the parsed KServe ``timeout`` request
+        parameter: stamped as the ``deadline_budget_us`` span attribute;
+        the flight recorder marks ``deadline_exceeded`` and bumps the
+        nv_inference_deadline_exceeded_total counter when the response
+        takes longer (observation only — no shedding here).
         """
         # Lock-free fast path (runs per request, before parse cost is
         # known): a GIL-atomic read of an always-present dict. The worst
         # race is one request sampled against just-cleared settings.
         ts = self._trace_settings  # tpulint: disable=TPU002
-        if len(ts) == 1 and ts[""]["trace_level"] == ["OFF"]:
-            return None  # hot path: tracing never enabled anywhere
-        return self.trace_collector.sample(
-            model_name,
-            self.get_trace_settings(model_name),
-            request_id=request_id,
-            model_version=model_version,
-            recv_ns=recv_ns,
-            traceparent=traceparent,
-        )
+        ctx = None
+        if not (len(ts) == 1 and ts[""]["trace_level"] == ["OFF"]):
+            ctx = self.trace_collector.sample(
+                model_name,
+                self.get_trace_settings(model_name),
+                request_id=request_id,
+                model_version=model_version,
+                recv_ns=recv_ns,
+                traceparent=traceparent,
+            )
+        flight = self.flight_recorder
+        if ctx is None:
+            if not flight.enabled:
+                return None
+            ctx = TraceContext(
+                None, 0, model_name, model_version, request_id, (), "", "",
+            )
+            if recv_ns is not None:
+                ctx.record("REQUEST_RECV", recv_ns)
+        if flight.enabled:
+            ctx._flight = flight
+        if deadline_us:
+            ctx.deadline_ns = int(deadline_us) * 1000
+            ctx.set_attribute("deadline_budget_us", int(deadline_us))
+        return ctx
+
+    def _record_deadline_miss(self, model_name: str):
+        with self._lock:
+            stats = self._stats.get(model_name)
+            if stats is not None:
+                stats.deadline_exceeded_count += 1
 
     def record_protocol_request(self, protocol: str):
         with self._lock:
@@ -1490,6 +1696,9 @@ class InferenceCore:
             stats.compute_infer_ns += t_infer - t_input
             stats.compute_output_ns += t_end - t_infer
             stats.observe_duration(t_end - t_start)
+            stats.observe_stages(
+                t_input - t_start, t_infer - t_input, t_end - t_infer
+            )
         return response
 
     def _record_failure(self, stats, t_start):
@@ -1704,6 +1913,9 @@ class InferenceCore:
             stats.compute_output_ns += (t_end - t_infer) * ok
             for _ in range(ok):
                 stats.observe_duration(t_end - t_start)
+            stats.observe_stages(
+                t_input - t_start, t_infer - t_input, t_end - t_infer, ok
+            )
         return results
 
     def _decoupled_responses(self, model, request, result_iter, stats, t_start):

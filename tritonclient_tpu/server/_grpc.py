@@ -6,6 +6,7 @@ bidirectional ModelStreamInfer with decoupled-model fan-out and the
 contract the reference's streaming clients rely on (grpc/_client.py:1921-1923).
 """
 
+import json
 import threading
 import time
 from concurrent import futures
@@ -22,7 +23,9 @@ from tritonclient_tpu.protocol._literals import (
     KEY_SHM_BYTE_SIZE,
     KEY_SHM_OFFSET,
     KEY_SHM_REGION,
+    KEY_TIMEOUT,
 )
+from tritonclient_tpu.protocol._service import RawJsonMessage
 from tritonclient_tpu.server._core import (
     CoreError,
     CoreRequest,
@@ -81,12 +84,15 @@ def _metadata_request_id(context) -> str:
     return _metadata_value(context, "triton-request-id")
 
 
-def _finish_trace(creq):
+def _finish_trace(creq, error: Optional[str] = None):
     """Close a request's trace at protocol egress (response built/handed to
     gRPC for serialization). Safe on None and idempotent — the stream
-    pipeline's ordering barrier may reach the finalize step first."""
+    pipeline's ordering barrier may reach the finalize step first.
+    ``error`` marks the request failed so the flight recorder retains it."""
     trace = getattr(creq, "trace", None) if creq is not None else None
     if trace is not None:
+        if error is not None:
+            trace.note_error(error)
         trace.record("RESPONSE_SEND")
         trace.finish()
 
@@ -106,6 +112,15 @@ def request_to_core(request: pb.ModelInferRequest, core: InferenceCore) -> CoreR
         id=request.id,
         parameters={k: _param_value(v) for k, v in request.parameters.items()},
     )
+    # KServe `timeout` (microseconds) parses into a deadline budget; popped
+    # from the passthrough parameters so a deadline-carrying request stays
+    # eligible for dynamic batching.
+    timeout = creq.parameters.pop(KEY_TIMEOUT, None)
+    if timeout is not None:
+        try:
+            creq.deadline_us = max(int(timeout), 0)
+        except (TypeError, ValueError):
+            creq.deadline_us = 0
     raw = list(request.raw_input_contents)
     use_raw = len(raw) > 0
     raw_index = 0  # raw entries exist only for non-shared-memory inputs
@@ -477,13 +492,32 @@ class _Servicer:
                 request.id or _metadata_request_id(context),
                 recv_ns=t_recv,
                 traceparent=_metadata_value(context, "traceparent"),
+                deadline_us=creq.deadline_us,
             )
             resp = _finalize_unary(self.core.infer(creq))
             _finish_trace(creq)
             return resp
         except CoreError as e:
-            _finish_trace(creq)
+            _finish_trace(creq, str(e))
             context.abort(_status_for(e), str(e))
+
+    def FlightRecorder(self, request, context):
+        """Dump the tail-based flight recorder (raw-JSON debug RPC; the
+        gRPC analog of GET v2/debug/flight_recorder). The optional request
+        payload is a JSON object; ``{"format": "perfetto"}`` renders the
+        retained span trees as Chrome trace-event JSON."""
+        options = {}
+        payload = getattr(request, "payload", b"")
+        if payload:
+            try:
+                options = json.loads(payload)
+            except ValueError:
+                options = {}
+        if isinstance(options, dict) and options.get("format") == "perfetto":
+            body = self.core.flight_recorder.render_perfetto()
+        else:
+            body = json.dumps(self.core.flight_recorder.dump())
+        return RawJsonMessage(body.encode())
 
     def _process_stream_request(self, request, cached_reqs, cached_resps,
                                 traceparent: str = ""):
@@ -515,16 +549,17 @@ class _Servicer:
             creq.trace = self.core.start_trace(
                 request.model_name, request.model_version, request.id,
                 recv_ns=t_recv, traceparent=traceparent or None,
+                deadline_us=creq.deadline_us,
             )
             cresp = self.core.infer(creq)
             _finish_trace(creq)
             return self._respond_stream(request, cresp, cached_resps)
         except CoreError as e:
-            _finish_trace(creq)
+            _finish_trace(creq, str(e))
             return [_stream_error(str(e), request.id)]
         except Exception as e:  # mirror _infer_one's model-error wrapping:
             # a bug must fail THIS request, not tear down the stream.
-            _finish_trace(creq)
+            _finish_trace(creq, f"inference failed: {e}")
             return [_stream_error(f"inference failed: {e}", request.id)]
 
     def _parse_cached(self, request, cached_reqs):
@@ -601,10 +636,10 @@ class _Servicer:
             _finish_trace(creq)
             return self._respond_stream(request, cresp, cached_resps)
         except CoreError as e:
-            _finish_trace(creq)
+            _finish_trace(creq, str(e))
             return [_stream_error(str(e), request.id)]
         except Exception as e:
-            _finish_trace(creq)
+            _finish_trace(creq, f"inference failed: {e}")
             return [_stream_error(f"inference failed: {e}", request.id)]
 
     def _needs_serial(self, request) -> bool:
@@ -677,15 +712,18 @@ class _Servicer:
             creq.trace = self.core.start_trace(
                 request.model_name, request.model_version, request.id,
                 recv_ns=t_recv, traceparent=stream_tp or None,
+                deadline_us=creq.deadline_us,
             )
             try:
                 fin = self.core.infer_submit(creq)
             except CoreError as e:
+                _finish_trace(creq, str(e))
                 return ("error", _stream_error(str(e), request.id)), None
             except Exception as e:
                 # Any bug must fail THIS request, never the stream: an
                 # escape here would hit the feeder's teardown handler
                 # and silently end the whole stream.
+                _finish_trace(creq, f"inference failed: {e}")
                 return (
                     ("error",
                      _stream_error(f"inference failed: {e}", request.id)),
@@ -909,6 +947,7 @@ class _AioServicer:
             "CudaSharedMemoryRegister", "CudaSharedMemoryUnregister",
             "TpuSharedMemoryStatus", "TpuSharedMemoryRegister",
             "TpuSharedMemoryUnregister", "TraceSetting", "LogSettings",
+            "FlightRecorder",
         ):
             setattr(self, name, self._wrap_unary(getattr(self._sync, name)))
 
@@ -946,12 +985,13 @@ class _AioServicer:
                 request.id or _metadata_request_id(context),
                 recv_ns=t_recv,
                 traceparent=_metadata_value(context, "traceparent"),
+                deadline_us=creq.deadline_us,
             )
             resp = _finalize_unary(await self._infer(creq))
             _finish_trace(creq)
             return resp
         except CoreError as e:
-            _finish_trace(creq)
+            _finish_trace(creq, str(e))
             await context.abort(_status_for(e), str(e))
 
     async def ModelStreamInfer(self, request_iterator, context):

@@ -20,6 +20,7 @@ from typing import List, Optional
 import numpy as np
 
 from tritonclient_tpu.protocol._literals import (
+    EP_FLIGHT_RECORDER,
     EP_HEALTH_LIVE,
     EP_HEALTH_READY,
     EP_LOGGING,
@@ -27,6 +28,7 @@ from tritonclient_tpu.protocol._literals import (
     EP_REPOSITORY_INDEX,
     EP_SERVER_METADATA,
     EP_TRACE_SETTING,
+    KEY_TIMEOUT,
     KEY_BINARY_DATA,
     KEY_BINARY_DATA_OUTPUT,
     KEY_BINARY_DATA_SIZE,
@@ -213,6 +215,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._trace_setting(model_name="", method=method)
         if path == EP_LOGGING:
             return self._logging(method)
+        if path == EP_FLIGHT_RECORDER:
+            return self._flight_recorder()
 
         if path == EP_REPOSITORY_INDEX:
             body = self._read_body()
@@ -260,6 +264,21 @@ class _Handler(BaseHTTPRequestHandler):
         body = self._read_body()
         settings = json.loads(body) if body else {}
         return self._send_json(self.core.update_log_settings(settings))
+
+    def _flight_recorder(self):
+        """Dump the tail-based flight recorder (GET or POST; the optional
+        ``format=perfetto`` query renders the retained span trees as
+        Chrome trace-event JSON for ui.perfetto.dev)."""
+        self._read_body()
+        query = self.path.split("?", 1)[1] if "?" in self.path else ""
+        fmt = ""
+        for pair in query.split("&"):
+            if pair.startswith("format="):
+                fmt = pair[len("format="):]
+        recorder = self.core.flight_recorder
+        if fmt == "perfetto":
+            return self._send(200, recorder.render_perfetto().encode())
+        return self._send_json(recorder.dump())
 
     def _shm(self, kind_path: str, region: Optional[str], action: str):
         kind = SHM_URL_KINDS[kind_path]
@@ -321,6 +340,15 @@ class _Handler(BaseHTTPRequestHandler):
             id=header.get("id", ""),
             parameters=dict(header.get("parameters", {})),
         )
+        # The KServe `timeout` parameter (microseconds) becomes a parsed
+        # deadline budget instead of an opaque passthrough — popped so a
+        # deadline does not disqualify the request from dynamic batching.
+        timeout = request.parameters.pop(KEY_TIMEOUT, None)
+        if timeout is not None:
+            try:
+                request.deadline_us = max(int(timeout), 0)
+            except (TypeError, ValueError):
+                request.deadline_us = 0
         # Request-id propagation: the body id wins; the triton-request-id
         # header lets clients tag trace records without touching the body.
         trace = core.start_trace(
@@ -328,6 +356,7 @@ class _Handler(BaseHTTPRequestHandler):
             request.id or self.headers.get("triton-request-id", ""),
             recv_ns=t_recv,
             traceparent=self.headers.get("traceparent"),
+            deadline_us=request.deadline_us,
         )
         request.trace = trace
 
@@ -367,9 +396,11 @@ class _Handler(BaseHTTPRequestHandler):
 
         try:
             response = self.core.infer(request)
-        except BaseException:
+        except BaseException as e:
             if trace is not None:
-                # Failed requests still produce a (partial) trace record.
+                # Failed requests still produce a (partial) trace record,
+                # and the flight recorder retains every error.
+                trace.note_error(str(e))
                 trace.record("RESPONSE_SEND")
                 trace.finish()
             raise
