@@ -20,11 +20,27 @@ Checks (exit 1 with one line per violation):
     quantile, ``_sum``/``_count`` present and >= 0
   * counter samples non-negative; gauges reporting ages (``*_age_us``)
     non-negative (a negative age means a broken clock, not a quiet queue)
+  * the ``nv_inference_shed_total`` family: every sample carries exactly
+    the {model, version, reason} label set with ``reason`` drawn from the
+    canonical shed vocabulary, and all three reasons are present per
+    (model, version) series so reason sums are well-defined
 """
 
+import os
 import re
 import sys
 from typing import Dict, List, Tuple
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+try:
+    from tritonclient_tpu.protocol._literals import SHED_REASONS
+except ImportError:  # standalone copy of the script: keep it usable
+    SHED_REASONS = ("admission", "expired", "cancelled")
+
+_SHED_FAMILY = "nv_inference_shed_total"
 
 _VALID_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
 _METRIC_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
@@ -132,6 +148,38 @@ def check_exposition(text: str) -> List[str]:
                     errors.append(
                         f"line {lineno}: counter {name} value {value} < 0"
                     )
+            if family == _SHED_FAMILY:
+                # Shed-counter contract: fixed {model, version, reason}
+                # label set, canonical reasons only, and every reason row
+                # present per series (so reasons provably sum to the
+                # observed sheds).
+                series_reasons: Dict[tuple, set] = {}
+                for labels, value, name, lineno in samples.get(family, []):
+                    if set(labels) != {"model", "version", "reason"}:
+                        errors.append(
+                            f"line {lineno}: {family} label set "
+                            f"{sorted(labels)} != "
+                            "['model', 'reason', 'version']"
+                        )
+                        continue
+                    if labels["reason"] not in SHED_REASONS:
+                        errors.append(
+                            f"line {lineno}: {family} reason "
+                            f"{labels['reason']!r} not in "
+                            f"{list(SHED_REASONS)}"
+                        )
+                        continue
+                    series_reasons.setdefault(
+                        (labels["model"], labels["version"]), set()
+                    ).add(labels["reason"])
+                for (model, version), reasons in series_reasons.items():
+                    missing = [r for r in SHED_REASONS if r not in reasons]
+                    if missing:
+                        errors.append(
+                            f'{family}{{model="{model}",'
+                            f'version="{version}"}}: missing reason '
+                            f"rows {missing}"
+                        )
             continue
         if ftype == "gauge":
             if family.endswith("_age_us"):

@@ -92,6 +92,7 @@ def _record_from_flight(rec: dict) -> Optional[dict]:
         "model": rec.get("model_name", ""),
         "request_id": rec.get("request_id", ""),
         "status": rec.get("status", "ok"),
+        "shed_reason": attrs.get("shed.reason"),
         "signature": attrs.get(
             "batcher.signature", rec.get("model_name", "") or "?"
         ),
@@ -129,6 +130,7 @@ def _records_from_spans(spans: List[dict]) -> List[dict]:
                 "request_id", attrs.get("request.id", "")
             ),
             "status": attrs.get("flight.status", "ok"),
+            "shed_reason": attrs.get("shed.reason"),
             "signature": attrs.get(
                 "batcher.signature",
                 attrs.get("model", attrs.get("model.name", "")) or "?",
@@ -202,9 +204,19 @@ def _pearson(xs: List[float], ys: List[float]) -> Optional[float]:
 def analyze(records: List[dict], tail_q: float = 0.95,
             head_q: float = 0.50) -> dict:
     """The attribution document: tail vs head stage shares, the dominant
-    stage of the tail excess, backlog correlation, per-signature rows."""
+    stage of the tail excess, backlog correlation, per-signature rows,
+    and the shed-vs-served split.
+
+    Shed requests (``shed.reason`` stamped by the batcher: admission /
+    expired / cancelled) are summarized separately and EXCLUDED from the
+    stage attribution — a sub-millisecond 504 carries no stage timeline
+    and would dilute the head group the tail is compared against.
+    """
     if not records:
         raise ValueError("no records to analyze")
+    all_records = records
+    sheds = [r for r in records if r.get("shed_reason")]
+    records = [r for r in records if not r.get("shed_reason")] or records
     stages = _stage_names(records)
     durations = sorted(r["duration_us"] for r in records)
     tail_cut = _percentile(durations, tail_q * 100)
@@ -265,11 +277,25 @@ def analyze(records: List[dict], tail_q: float = 0.95,
             "mean_backlog": mean_backlog(members),
         })
 
+    shed_lat = sorted(r["duration_us"] for r in sheds)
     return {
-        "records": len(records),
+        "records": len(all_records),
         "statuses": {
-            status: sum(1 for r in records if r["status"] == status)
-            for status in sorted({r["status"] for r in records})
+            status: sum(1 for r in all_records if r["status"] == status)
+            for status in sorted({r["status"] for r in all_records})
+        },
+        # Shed-vs-served: how much of the offered tail was answered with
+        # a fast 504 instead of being served late.
+        "sheds": {
+            "count": len(sheds),
+            "served": len(all_records) - len(sheds),
+            "by_reason": {
+                reason: sum(
+                    1 for r in sheds if r["shed_reason"] == reason
+                )
+                for reason in sorted({r["shed_reason"] for r in sheds})
+            },
+            "shed_p99_us": _percentile(shed_lat, 99),
         },
         "tail_q": tail_q,
         "head_q": head_q,
@@ -320,6 +346,16 @@ def render(result: dict, slowest: List[dict]) -> str:
     lines.append(
         f"dominant tail stage: {dom or '(no excess — tail == head)'}"
     )
+    sheds = result.get("sheds") or {}
+    if sheds.get("count"):
+        reasons = ", ".join(
+            f"{k}={v}" for k, v in sheds["by_reason"].items()
+        )
+        lines.append(
+            f"shed vs served: {sheds['count']} shed ({reasons}, "
+            f"p99 {sheds['shed_p99_us']} us) / {sheds['served']} served "
+            "— stage attribution above covers served requests only"
+        )
     b = result["backlog"]
     if b["stamped"]:
         r_txt = "n/a" if b["pearson_r"] is None else f"{b['pearson_r']:+.3f}"

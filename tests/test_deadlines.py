@@ -1,0 +1,572 @@
+"""Deadline-aware scheduling: EDF queueing, admission control, expiry
+sweeps, end-to-end cancellation, and the shed observability plane.
+
+Coverage follows the acceptance criteria: a seeded overload in which
+every past-deadline request receives a fast 504 (< 5 ms p99 end to end)
+while in-deadline traffic holds its no-overload p99 within 1.3x and the
+``nv_inference_shed_total`` reasons sum to the observed sheds; a
+cancelled gRPC stream / HTTP disconnect freeing its batch slot with the
+engine observing ``cancel_event`` within one decode step; plus the
+client satellites (aio HTTP per-request timeout, gRPC per-call deadline
+mirror, perf_analyzer ``--request-timeout-us`` shed reporting) and the
+checker/report extensions.
+"""
+
+import importlib.util
+import json
+import os
+import re
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import tritonclient_tpu.grpc as grpcclient
+import tritonclient_tpu.http as httpclient
+from tritonclient_tpu.models._base import Model, TensorSpec
+from tritonclient_tpu.protocol._literals import (
+    SHED_REASON_ADMISSION,
+    SHED_REASON_CANCELLED,
+    SHED_REASON_EXPIRED,
+    SHED_REASONS,
+    STATUS_CANCELLED,
+    STATUS_SHED,
+)
+from tritonclient_tpu.server import InferenceServer
+from tritonclient_tpu.server._core import (
+    CoreError,
+    CoreRequest,
+    CoreTensor,
+    InferenceCore,
+)
+from tritonclient_tpu.utils import InferenceServerException
+
+
+def _load_script(name: str, module: str):
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", name,
+    )
+    spec = importlib.util.spec_from_file_location(module, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _percentile(sorted_vals, pct):
+    import math
+
+    idx = min(len(sorted_vals) - 1,
+              math.ceil(pct / 100.0 * len(sorted_vals)) - 1)
+    return sorted_vals[max(idx, 0)]
+
+
+class _ShedModel(Model):
+    """Dynamic-batched identity with a fixed per-execution cost: the
+    controllable service time every deadline scenario here seeds
+    against."""
+
+    name = "shed_probe"
+    dynamic_batching = True
+    max_batch_size = 8
+    blocking = True
+
+    def __init__(self, delay_s=0.02, cap=8):
+        super().__init__()
+        self.delay_s = delay_s
+        self.max_batch_size = cap
+        self.inputs = [TensorSpec("INPUT", "INT32", [-1, 4])]
+        self.outputs = [TensorSpec("OUTPUT", "INT32", [-1, 4])]
+
+    def infer(self, inputs, parameters=None):
+        time.sleep(self.delay_s)  # tpulint: disable=TPU001
+        return {"OUTPUT": np.asarray(inputs["INPUT"], dtype=np.int32)}
+
+
+def _req(model="shed_probe", rows=1, deadline_us=0, cancel_event=None):
+    r = CoreRequest(model_name=model, deadline_us=deadline_us, inputs=[
+        CoreTensor("INPUT", "INT32", [rows, 4],
+                   data=np.zeros((rows, 4), np.int32)),
+    ])
+    r.cancel_event = cancel_event
+    return r
+
+
+# --------------------------------------------------------------------------- #
+# batcher-level scheduling semantics (deterministic, no wire)                 #
+# --------------------------------------------------------------------------- #
+
+
+class TestBatcherDeadlines:
+    def _core(self, delay_s=0.02, cap=8, dispatchers=1):
+        core = InferenceCore(models=[_ShedModel(delay_s, cap)])
+        core._batchers["shed_probe"]._n_dispatchers = dispatchers
+        return core
+
+    def test_admission_shed_is_a_fast_504(self):
+        core = self._core(delay_s=0.05)
+        batcher = core._batchers["shed_probe"]
+        core.infer(_req())  # one served batch warms the service EWMA
+        # The EWMA lands in the dispatcher's finally block, which may run
+        # just after the waiter wakes — wait for the evidence.
+        deadline = time.time() + 5
+        while not batcher._service_ewma_us and time.time() < deadline:
+            time.sleep(0.001)  # tpulint: disable=TPU001
+        assert batcher._service_ewma_us  # evidence exists
+        t0 = time.perf_counter()
+        with pytest.raises(CoreError) as exc:
+            core.infer(_req(deadline_us=1000))
+        elapsed = time.perf_counter() - t0
+        assert exc.value.status == STATUS_SHED
+        assert "shed at admission" in str(exc.value)
+        # The whole point: a guaranteed miss costs a dict lookup and an
+        # exception, not the queue.
+        assert elapsed < 0.05
+        assert core._stats["shed_probe"].shed_counts[
+            SHED_REASON_ADMISSION] == 1
+        # No admission evidence -> admit (conservative): a COLD core must
+        # never shed at ADMISSION, even for an impossible budget — such a
+        # request is admitted and either served (a miss, observed) or
+        # swept later as expired.
+        cold = self._core(delay_s=0.001)
+        try:
+            cold.infer(_req(deadline_us=1))
+        except CoreError as e:
+            assert "expired" in str(e)
+        assert cold._stats["shed_probe"].shed_counts[
+            SHED_REASON_ADMISSION] == 0
+
+    def test_expired_in_queue_swept_with_504(self):
+        core = self._core(delay_s=0.05)
+        batcher = core._batchers["shed_probe"]
+        t = threading.Thread(target=lambda: core.infer(_req()))
+        t.start()
+        deadline = time.time() + 5
+        while batcher._dispatching == 0 and time.time() < deadline:
+            time.sleep(0.001)  # tpulint: disable=TPU001
+        # Cold EWMA -> admitted; the 50 ms in-flight batch outlives the
+        # 8 ms budget, so the next take sweeps it out.
+        with pytest.raises(CoreError) as exc:
+            core.infer(_req(deadline_us=8000))
+        t.join()
+        assert exc.value.status == STATUS_SHED
+        assert "expired" in str(exc.value)
+        assert core._stats["shed_probe"].shed_counts[
+            SHED_REASON_EXPIRED] == 1
+
+    def test_cancelled_while_queued_sheds_with_cancel_status(self):
+        core = self._core(delay_s=0.05)
+        batcher = core._batchers["shed_probe"]
+        t = threading.Thread(target=lambda: core.infer(_req()))
+        t.start()
+        deadline = time.time() + 5
+        while batcher._dispatching == 0 and time.time() < deadline:
+            time.sleep(0.001)  # tpulint: disable=TPU001
+        ev = threading.Event()
+        result = {}
+
+        def go():
+            try:
+                core.infer(_req(cancel_event=ev))
+                result["served"] = True
+            except CoreError as e:
+                result["error"] = e
+
+        t2 = threading.Thread(target=go)
+        t2.start()
+        time.sleep(0.005)  # tpulint: disable=TPU001
+        ev.set()
+        t2.join()
+        t.join()
+        assert result.get("error") is not None, result
+        assert result["error"].status == STATUS_CANCELLED
+        assert core._stats["shed_probe"].shed_counts[
+            SHED_REASON_CANCELLED] == 1
+
+    def test_edf_orders_deadline_traffic_ahead_of_fifo_backlog(self):
+        """Full-cap no-deadline batches queued ahead; a later deadline
+        request must overtake them (and no-deadline order stays FIFO)."""
+        core = self._core(delay_s=0.03, cap=4, dispatchers=1)
+        order = []
+
+        def run(tag, **kwargs):
+            core.infer(_req(rows=4, **kwargs))
+            order.append(tag)
+
+        threads = [threading.Thread(target=run, args=(f"bulk{i}",))
+                   for i in range(3)]
+        batcher = core._batchers["shed_probe"]
+        threads[0].start()
+        deadline = time.time() + 5
+        while batcher._dispatching == 0 and time.time() < deadline:
+            time.sleep(0.001)  # tpulint: disable=TPU001
+        threads[1].start()
+        threads[2].start()
+        while batcher.qsize() < 2 and time.time() < deadline:
+            time.sleep(0.001)  # tpulint: disable=TPU001
+        td = threading.Thread(target=run, args=("deadline",),
+                              kwargs={"deadline_us": 10_000_000})
+        td.start()
+        for t in threads + [td]:
+            t.join(timeout=30)
+        # bulk0 was in flight; the deadline request must beat the rest of
+        # the FIFO backlog, which itself stays in order.
+        assert order.index("deadline") <= 1, order
+        assert order.index("bulk1") < order.index("bulk2"), order
+
+    def test_no_deadline_traffic_keeps_fifo_head(self):
+        """With no deadline queued, _take_batch's head is queue[0] — the
+        default path is byte-identical FIFO."""
+        core = self._core()
+        batcher = core._batchers["shed_probe"]
+        from tritonclient_tpu.server._core import _BatchSlot
+
+        s1 = _BatchSlot(_req(rows=4), (("INPUT", "INT32", (4,)),), 4)
+        s2 = _BatchSlot(_req(rows=4), (("INPUT", "INT32", (4,)),), 4)
+        with batcher._cv:
+            batcher._cap = 8
+            batcher._queue.extend([s1, s2])
+            batch = batcher._take_batch()
+        assert batch[0] is s1
+        assert batcher._deadline_queued == 0
+
+
+# --------------------------------------------------------------------------- #
+# the seeded overload acceptance test (full stack, gRPC)                      #
+# --------------------------------------------------------------------------- #
+
+
+def _shed_counts(http_address, model="shed_probe"):
+    text = urllib.request.urlopen(
+        f"http://{http_address}/metrics").read().decode()
+    counts = {}
+    for reason in SHED_REASONS:
+        m = re.search(
+            rf'nv_inference_shed_total{{model="{model}",version="1",'
+            rf'reason="{reason}"}} (\d+)', text)
+        counts[reason] = int(m.group(1)) if m else None
+    return counts, text
+
+
+def test_seeded_overload_sheds_fast_and_holds_in_deadline_p99(tmp_path):
+    """The acceptance scenario: arrival > service with a deep no-deadline
+    backlog. Every past-deadline probe 504s in < 5 ms p99; in-deadline
+    traffic holds within 1.3x of its no-overload p99 (EDF jumps the
+    backlog); the shed counter's reasons sum to the observed sheds."""
+    with InferenceServer(models=[_ShedModel(0.03, 8)]) as server:
+
+        def run_class(n_threads, per_thread, timeout_us, lat, sheds, errs,
+                      stagger=0.0):
+            def worker():
+                client = grpcclient.InferenceServerClient(
+                    server.grpc_address)
+                client.is_server_ready()  # channel setup off the clock
+                try:
+                    for i in range(per_thread):
+                        inp = grpcclient.InferInput("INPUT", [1, 4], "INT32")
+                        inp.set_data_from_numpy(
+                            np.full((1, 4), i, np.int32))
+                        t0 = time.perf_counter()
+                        try:
+                            client.infer("shed_probe", [inp],
+                                         timeout=timeout_us,
+                                         client_timeout=60.0)
+                            lat.append(time.perf_counter() - t0)
+                        except InferenceServerException as e:
+                            if ("DEADLINE_EXCEEDED" in str(e.status())
+                                    or "deadline" in str(e)
+                                    or "shed" in str(e)):
+                                sheds.append(time.perf_counter() - t0)
+                            else:
+                                errs.append(str(e))
+                finally:
+                    client.close()
+
+            threads = [threading.Thread(target=worker)
+                       for _ in range(n_threads)]
+            for t in threads:
+                t.start()
+                if stagger:
+                    time.sleep(stagger)  # tpulint: disable=TPU001
+            return threads
+
+        errs = []
+        # Phase A: deadline traffic at capacity — 8 fg threads fill the
+        # 8-wide batches, and a light bulk load keeps the batcher in its
+        # busy regime (also warms the admission EWMA).
+        base_lat, base_shed = [], []
+        warm_lat, warm_shed = [], []
+        warm = run_class(4, 16, None, warm_lat, warm_shed, errs)
+        base = run_class(8, 16, 10_000_000, base_lat, base_shed, errs)
+        for t in warm + base:
+            t.join(timeout=120)
+        # Phase B: the same deadline traffic + a deep no-deadline backlog
+        # + past-deadline probes.
+        bulk_lat, bulk_shed = [], []
+        fg_lat, fg_shed = [], []
+        probe_lat, probe_shed = [], []
+        bulk = run_class(12, 16, None, bulk_lat, bulk_shed, errs)
+        time.sleep(0.25)  # tpulint: disable=TPU001 — backlog stands up
+        fg = run_class(8, 16, 10_000_000, fg_lat, fg_shed, errs)
+        probes = run_class(1, 100, 2000, probe_lat, probe_shed, errs)
+        for t in probes + fg + bulk:
+            t.join(timeout=300)
+        assert not errs, errs[:3]
+
+        # Under TPUSAN the sanitizer's ~2.7x overhead is part of every
+        # latency; the structural assertions stay strict, the absolute
+        # bounds scale.
+        from tritonclient_tpu import sanitize
+
+        overhead = 3.0 if sanitize.enabled() else 1.0
+        # Every past-deadline probe was shed, none served late.
+        assert len(probe_shed) == 100, (len(probe_shed), len(probe_lat))
+        shed_p99_s = _percentile(sorted(probe_shed), 99)
+        assert shed_p99_s < 0.005 * overhead, (
+            f"shed p99 {shed_p99_s * 1e3:.2f} ms"
+        )
+        # In-deadline traffic holds its no-overload p99 within 1.3x.
+        base_p99 = _percentile(sorted(base_lat), 99)
+        fg_p99 = _percentile(sorted(fg_lat), 99)
+        assert fg_p99 <= 1.3 * base_p99, (fg_p99, base_p99)
+        assert not fg_shed and not base_shed, (len(fg_shed),
+                                               len(base_shed))
+
+        # The counter family: reasons sum to the observed sheds, and the
+        # whole exposition (incl. the new family) still validates.
+        counts, text = _shed_counts(server.http_address)
+        assert None not in counts.values(), counts
+        assert sum(counts.values()) == len(probe_shed) + len(bulk_shed)
+        assert counts[SHED_REASON_ADMISSION] >= 1
+        checker = _load_script("check_metrics_exposition.py", "cm_shed")
+        assert checker.check_exposition(text) == []
+
+        # Flight recorder: sheds retained as errors with shed.reason
+        # stamped; tail_report splits shed vs served.
+        dump = server.core.flight_recorder.dump()
+        shed_recs = [r for r in dump["records"]
+                     if r["attributes"].get("shed.reason")]
+        assert shed_recs
+        assert {r["attributes"]["shed.reason"] for r in shed_recs} <= set(
+            SHED_REASONS)
+        tail_report = _load_script("tail_report.py", "tail_report_shed")
+        dump_path = str(tmp_path / "flight.json")
+        with open(dump_path, "w") as f:
+            json.dump(dump, f)
+        result = tail_report.analyze(tail_report.load_records(dump_path))
+        assert result["sheds"]["count"] == len(shed_recs)
+        assert result["sheds"]["served"] > 0
+        rendered = tail_report.render(result, [])
+        assert "shed vs served" in rendered
+
+
+# --------------------------------------------------------------------------- #
+# cancellation propagation (acceptance)                                       #
+# --------------------------------------------------------------------------- #
+
+
+def test_grpc_stream_cancel_frees_engine_slot_within_one_step():
+    """A cancelled gRPC stream's generation frees its engine slot: the
+    engine polls cancel_event between decode steps."""
+    from tritonclient_tpu.models.gpt_engine import GptEngineModel
+
+    model = GptEngineModel(max_slots=2)
+    with InferenceServer(models=[model], http=False) as server:
+        client = grpcclient.InferenceServerClient(server.grpc_address)
+        tokens = []
+        got_token = threading.Event()
+
+        def on_response(result, error):
+            if result is not None:
+                tokens.append(result)
+                got_token.set()
+
+        client.start_stream(callback=on_response)
+        inp = grpcclient.InferInput("INPUT_IDS", [1, 8], "INT32")
+        inp.set_data_from_numpy(np.zeros((1, 8), np.int32))
+        mt = grpcclient.InferInput("MAX_TOKENS", [1], "INT32")
+        mt.set_data_from_numpy(np.array([4000], np.int32))
+        client.async_stream_infer("gpt_engine", [inp, mt])
+        assert got_token.wait(timeout=120)  # generation underway
+        assert any(r is not None for r in model.engine._slot_req)
+        client.stop_stream(cancel_requests=True)
+        client.close()
+        # The engine must observe the cancel between decode steps and
+        # free the slot long before the 4000-token generation would end.
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if all(r is None for r in model.engine._slot_req):
+                break
+            time.sleep(0.05)  # tpulint: disable=TPU001
+        assert all(r is None for r in model.engine._slot_req), (
+            model.engine._slot_req
+        )
+
+
+def test_http_async_infer_cancel_sheds_queued_request():
+    """InferAsyncRequest.cancel() travels to the server: the closed
+    connection arms cancel_event and the batcher sheds the queued slot
+    (reason=cancelled) instead of serving a reader that is gone."""
+    with InferenceServer(models=[_ShedModel(0.2, 8)]) as server:
+        batcher = server.core._batchers["shed_probe"]
+        batcher._n_dispatchers = 1  # one in-flight batch; the rest queue
+        client = httpclient.InferenceServerClient(
+            server.http_address, concurrency=4)
+
+        def make_input(value):
+            inp = httpclient.InferInput("INPUT", [1, 4], "INT32")
+            inp.set_data_from_numpy(np.full((1, 4), value, np.int32))
+            return [inp]
+
+        first = client.async_infer("shed_probe", make_input(0))
+        deadline = time.time() + 5
+        while batcher._dispatching == 0 and time.time() < deadline:
+            time.sleep(0.005)  # tpulint: disable=TPU001
+        victim = client.async_infer("shed_probe", make_input(1))
+        while batcher.qsize() == 0 and time.time() < deadline:
+            time.sleep(0.005)  # tpulint: disable=TPU001
+        assert victim.cancel()
+        with pytest.raises(InferenceServerException):
+            victim.get_result(timeout=30)
+        first.get_result(timeout=30)  # the in-flight batch is unharmed
+        # The server answered the cancelled slot with a shed, and the
+        # queue drained without executing it.
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            counts, _ = _shed_counts(server.http_address)
+            if counts[SHED_REASON_CANCELLED]:
+                break
+            time.sleep(0.05)  # tpulint: disable=TPU001
+        assert counts[SHED_REASON_CANCELLED] >= 1, counts
+        assert batcher.qsize() == 0
+        client.close()
+
+
+# --------------------------------------------------------------------------- #
+# client satellites                                                           #
+# --------------------------------------------------------------------------- #
+
+
+def test_aio_http_timeout_bounds_a_dead_server():
+    """A server that accepts and never answers can no longer hang the aio
+    client past its own stated deadline."""
+    import asyncio
+
+    accepted = []
+    with socket.socket() as listener:
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(4)
+        port = listener.getsockname()[1]
+
+        def accept_and_hang():
+            try:
+                conn, _ = listener.accept()
+                accepted.append(conn)  # hold it open, never respond
+            except OSError:
+                pass
+
+        t = threading.Thread(target=accept_and_hang, daemon=True)
+        t.start()
+        import tritonclient_tpu.http.aio as aiohttpclient
+
+        async def run():
+            client = aiohttpclient.InferenceServerClient(f"127.0.0.1:{port}")
+            try:
+                inp = httpclient.InferInput("INPUT", [1, 4], "INT32")
+                inp.set_data_from_numpy(np.zeros((1, 4), np.int32))
+                t0 = time.perf_counter()
+                with pytest.raises(InferenceServerException,
+                                   match="timed out"):
+                    await client.infer("anything", [inp], timeout=300_000)
+                return time.perf_counter() - t0
+            finally:
+                await client.close()
+
+        elapsed = asyncio.run(run())
+        # Bounded by the 0.3 s budget, not the 60 s session default.
+        assert elapsed < 5.0
+        for conn in accepted:
+            conn.close()
+
+
+def test_grpc_client_timeout_mirrors_kserve_budget(monkeypatch):
+    """With no explicit client_timeout the sync gRPC client bounds the
+    call at the KServe budget (and a healthy server's shed or the
+    client's own deadline both spell DEADLINE_EXCEEDED)."""
+    with InferenceServer(models=None, http=False) as server:
+        client = grpcclient.InferenceServerClient(server.grpc_address)
+        inp = grpcclient.InferInput("INPUT", [1, 16], "INT32")
+        inp.set_data_from_numpy(np.zeros((1, 16), np.int32))
+        t0 = time.perf_counter()
+        with pytest.raises(InferenceServerException) as exc:
+            # slow_identity takes 300 ms; a 50 ms budget must cut the
+            # call far earlier.
+            client.infer("slow_identity", [inp], timeout=50_000)
+        elapsed = time.perf_counter() - t0
+        assert "DEADLINE_EXCEEDED" in str(exc.value.status())
+        assert elapsed < 0.25, elapsed
+        client.close()
+
+
+def test_perf_analyzer_request_timeout_reports_shed_rate():
+    from tritonclient_tpu.perf_analyzer import PerfAnalyzer
+
+    with InferenceServer(models=[_ShedModel(0.02, 8)]) as server:
+        analyzer = PerfAnalyzer(
+            server.grpc_address, "shed_probe", batch_size=1,
+            measurement_interval_s=1.0, warmup_s=0.3,
+            request_timeout_us=1500,
+        )
+        window = analyzer.measure(8)
+        summary = window.summary()
+        # After the warmup serves a batch, the EWMA is warm and every
+        # 1.5 ms-budget request sheds at admission.
+        assert summary["sheds"] > 0
+        assert 0.0 < summary["shed_rate"] <= 1.0
+        assert summary["errors"] == 0
+        assert window.sheds == summary["sheds"]
+    with pytest.raises(ValueError):
+        PerfAnalyzer("localhost:1", "m", async_window=True,
+                     request_timeout_us=10)
+
+
+# --------------------------------------------------------------------------- #
+# checker violation cases (satellite)                                         #
+# --------------------------------------------------------------------------- #
+
+
+def test_metrics_checker_validates_shed_family():
+    checker = _load_script("check_metrics_exposition.py", "cm_shed_v")
+    good = (
+        "# HELP nv_inference_shed_total x\n"
+        "# TYPE nv_inference_shed_total counter\n"
+        'nv_inference_shed_total{model="m",version="1",reason="admission"} 2\n'
+        'nv_inference_shed_total{model="m",version="1",reason="expired"} 0\n'
+        'nv_inference_shed_total{model="m",version="1",reason="cancelled"} 1\n'
+    )
+    assert checker.check_exposition(good) == []
+    bad = (
+        "# HELP nv_inference_shed_total x\n"
+        "# TYPE nv_inference_shed_total counter\n"
+        'nv_inference_shed_total{model="m",version="1",reason="because"} 2\n'
+        'nv_inference_shed_total{model="m",version="1"} 1\n'
+        'nv_inference_shed_total{model="n",version="1",reason="expired"} -3\n'
+    )
+    errors = checker.check_exposition(bad)
+    assert any("not in" in e for e in errors)          # unknown reason
+    assert any("label set" in e for e in errors)       # missing reason label
+    assert any("< 0" in e for e in errors)             # negative counter
+    assert any("missing reason rows" in e for e in errors)  # partial series
+
+
+def test_live_exposition_with_sheds_validates():
+    core = InferenceCore(models=[_ShedModel(0.01, 8)])
+    stats = core._stats["shed_probe"]
+    with core._lock:
+        stats.shed_counts[SHED_REASON_ADMISSION] = 5
+        stats.shed_counts[SHED_REASON_EXPIRED] = 2
+    checker = _load_script("check_metrics_exposition.py", "cm_shed_live")
+    assert checker.check_exposition(core.prometheus_metrics()) == []

@@ -915,6 +915,58 @@ class TestProtocolDrift:
         )
         assert findings == []
 
+    def test_fires_on_raw_shed_status_literal(self, tmp_path):
+        """The shed status spelled as a raw 504/499 int in a protocol-
+        plane file is drift; the STATUS_* constants are clean."""
+        findings = lint_tree(
+            tmp_path,
+            {
+                "pkg/server/_core.py": """
+                    class CoreError(Exception):
+                        def __init__(self, msg, status=400):
+                            self.status = status
+
+                    def shed(msg):
+                        raise CoreError(msg, 504)
+
+                    def cancelled(msg):
+                        raise CoreError(msg, 499)
+                """,
+            },
+            select={"TPU008"},
+        )
+        assert rules_of(findings) == ["TPU008", "TPU008"]
+        assert "STATUS_SHED" in findings[0].message
+        assert "STATUS_CANCELLED" in findings[1].message
+
+    def test_clean_on_shed_status_constants(self, tmp_path):
+        findings = lint_tree(
+            tmp_path,
+            {
+                "pkg/server/_core.py": """
+                    from tritonclient_tpu.protocol._literals import (
+                        STATUS_CANCELLED,
+                        STATUS_SHED,
+                    )
+
+                    class CoreError(Exception):
+                        def __init__(self, msg, status=400):
+                            self.status = status
+
+                    def shed(msg):
+                        raise CoreError(msg, STATUS_SHED)
+
+                    def cancelled(msg):
+                        raise CoreError(msg, STATUS_CANCELLED)
+                """,
+                # Outside the protocol planes a raw 504 is not this
+                # rule's business (HTTP status tables, tests, ...).
+                "pkg/other/tool.py": "RETRYABLE = {503, 504}\n",
+            },
+            select={"TPU008"},
+        )
+        assert findings == []
+
 
 # --------------------------------------------------------------------------- #
 # engine / reporters / CLI                                                    #

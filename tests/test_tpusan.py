@@ -124,6 +124,111 @@ class TestLockOrderWitness:
                 pass
         assert tpusan.findings == []
 
+    def test_seeded_cv_stats_lock_cycle_is_caught(self, tpusan):
+        """The pair the deadline sweep must never nest: a batcher-style
+        condition variable against a stats-style lock. Seeded surrogates
+        prove the witness catches exactly this shape, so the EDF/shed
+        code path (which touches both) cannot silently reintroduce it."""
+        cv = sanitize.named_condition("seed.batcher._cv")
+        stats = sanitize.named_lock("seed.core._lock")
+        ev_a, ev_b = threading.Event(), threading.Event()
+
+        def sweeps_under_cv():
+            with cv:
+                ev_a.set()
+                ev_b.wait(2)
+                if stats.acquire(timeout=0.2):  # cv -> stats
+                    stats.release()
+
+        def metrics_under_stats():
+            ev_a.wait(2)
+            with stats:
+                if cv.acquire(True, 0.2):  # stats -> cv: the cycle
+                    cv.release()
+                ev_b.set()
+
+        t1 = threading.Thread(target=sweeps_under_cv)
+        t2 = threading.Thread(target=metrics_under_stats)
+        t1.start(); t2.start(); t1.join(); t2.join()
+        cyc = [f for f in tpusan.findings if "lock-order cycle" in f.message]
+        assert len(cyc) == 1
+        assert "'seed.batcher._cv'" in cyc[0].message
+        assert "'seed.core._lock'" in cyc[0].message
+
+    def test_deadline_shed_paths_keep_cv_and_stats_lock_acyclic(
+        self, tpusan
+    ):
+        """Admission shed, expiry sweep, and cancel sweep through a
+        SANITIZED core (its _DynamicBatcher._cv and InferenceCore._lock
+        are adopted named primitives): the witness must see no cycle —
+        shed accounting happens outside the cv by design."""
+        import numpy as np
+
+        from tritonclient_tpu.models._base import Model, TensorSpec
+        from tritonclient_tpu.server._core import (
+            CoreError,
+            CoreRequest,
+            CoreTensor,
+            InferenceCore,
+        )
+
+        class _M(Model):
+            name = "sanshed"
+            dynamic_batching = True
+            max_batch_size = 8
+            blocking = True
+
+            def __init__(self):
+                super().__init__()
+                self.inputs = [TensorSpec("INPUT", "INT32", [-1, 4])]
+                self.outputs = [TensorSpec("OUTPUT", "INT32", [-1, 4])]
+
+            def infer(self, inputs, parameters=None):
+                time.sleep(0.03)  # tpulint: disable=TPU001 - seeded load
+                return {
+                    "OUTPUT": np.asarray(inputs["INPUT"], dtype=np.int32)
+                }
+
+        def req(deadline_us=0, cancel_event=None):
+            r = CoreRequest(model_name="sanshed", deadline_us=deadline_us,
+                            inputs=[CoreTensor(
+                                "INPUT", "INT32", [1, 4],
+                                data=np.zeros((1, 4), np.int32))])
+            r.cancel_event = cancel_event
+            return r
+
+        core = InferenceCore(models=[_M()])
+        batcher = core._batchers["sanshed"]
+        batcher._n_dispatchers = 1
+        core.infer(req())  # warm the admission EWMA
+        deadline = time.time() + 5
+        while not batcher._service_ewma_us and time.time() < deadline:
+            time.sleep(0.001)  # tpulint: disable=TPU001
+        with pytest.raises(CoreError):
+            core.infer(req(deadline_us=500))  # admission shed
+        t = threading.Thread(target=lambda: core.infer(req()))
+        t.start()
+        deadline = time.time() + 5
+        while batcher._dispatching == 0 and time.time() < deadline:
+            time.sleep(0.001)  # tpulint: disable=TPU001
+        ev = threading.Event()
+        outcomes = []
+
+        def cancelled():
+            try:
+                core.infer(req(cancel_event=ev))
+                outcomes.append("served")
+            except CoreError:
+                outcomes.append("shed")
+
+        t2 = threading.Thread(target=cancelled)
+        t2.start()
+        ev.set()
+        t2.join(); t.join()
+        core.prometheus_metrics()  # stats lock + batcher cv, sequentially
+        cyc = [f for f in tpusan.findings if "lock-order cycle" in f.message]
+        assert cyc == [], [f.message for f in cyc]
+
 
 # --------------------------------------------------------------------------- #
 # shm lifecycle witness (TPU006)                                              #

@@ -9,6 +9,14 @@ surface only as a runtime 400.
 
 This rule diffs actual key usage per plane:
 
+* **shed-status conformance** — the deadline-aware scheduling statuses
+  (HTTP 504 for shed, 499 for client-cancelled) live in
+  ``protocol/_literals.py`` as ``STATUS_SHED``/``STATUS_CANCELLED``; a
+  protocol-plane file (client packages, server front-ends, the core)
+  spelling either as a raw integer literal is the same drift vector as a
+  respelled key — client and server must name the shed status through
+  one constant.
+
 * **plane symmetry** — for every *tensor-scope* canonical key (the keys
   that change how tensor bytes are routed or encoded: the shared-memory
   trio, the binary-data family, ``classification``), the set referenced
@@ -61,6 +69,10 @@ _SHM_TRIO = (
     "shared_memory_byte_size",
     "shared_memory_offset",
 )
+
+#: Shed-status values whose raw spelling in a protocol-plane file is
+#: drift (use STATUS_SHED / STATUS_CANCELLED from protocol/_literals).
+_SHED_STATUS_NAMES = {504: "STATUS_SHED", 499: "STATUS_CANCELLED"}
 
 
 class _Side:
@@ -146,6 +158,45 @@ class ProtocolDriftRule(Rule):
                             f"{', '.join(repr(k) for k in missing)} — "
                             "incomplete shared-memory key trio "
                             "(nonzero offsets/sizes would be ignored)",
+                        )
+                    )
+        findings.extend(self._shed_status_findings(ctxs))
+        return findings
+
+    # -- shed-status conformance -----------------------------------------------
+
+    @staticmethod
+    def _in_protocol_plane(path: str) -> bool:
+        # Same path-segment classification as _side_of, plus the server
+        # core (which raises the shed CoreErrors the front-ends map).
+        p = "/" + path.lstrip("/")
+        if p.endswith("_literals.py"):
+            return False  # the definition site
+        return any(seg in p for seg in ("/http/", "/grpc/", "/server/"))
+
+    def _shed_status_findings(self, ctxs) -> List[Finding]:
+        """Raw 504/499 integer literals in protocol-plane files: the shed
+        status spelled outside protocol/_literals is drift waiting to
+        happen — a client matching 504 while the server starts answering
+        a respelled code is exactly the bug class TPU008 exists for."""
+        findings: List[Finding] = []
+        for ctx in ctxs:
+            if not self._in_protocol_plane(ctx.path):
+                continue
+            for node in ast.walk(ctx.tree):
+                if (
+                    isinstance(node, ast.Constant)
+                    and type(node.value) is int
+                    and node.value in _SHED_STATUS_NAMES
+                ):
+                    name = _SHED_STATUS_NAMES[node.value]
+                    findings.append(
+                        Finding(
+                            self.id, ctx.path, node.lineno, node.col_offset,
+                            f"shed status {node.value} spelled as a raw "
+                            f"literal; import {name} from "
+                            "protocol/_literals so client and server "
+                            "cannot drift on the shed status",
                         )
                     )
         return findings

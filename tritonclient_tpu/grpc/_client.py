@@ -508,7 +508,15 @@ class InferenceServerClient(InferenceServerClientBase):
         ``traceparent`` invocation metadata (an explicit
         ``headers={"traceparent": ...}`` entry wins) so server span
         records continue the caller's trace.
+
+        A KServe ``timeout`` budget with no explicit ``client_timeout``
+        also becomes the gRPC per-call deadline: a dead server cannot
+        hang the client past the request's own stated deadline, and a
+        healthy server sheds with DEADLINE_EXCEEDED well before the
+        client-side bound fires.
         """
+        if client_timeout is None and timeout:
+            client_timeout = timeout / 1e6
         if timers is not None:
             timers.capture("request_start")
             timers.capture("send_start")
@@ -577,8 +585,13 @@ class InferenceServerClient(InferenceServerClientBase):
         """Fire-and-callback inference; returns a cancellable CallContext.
 
         callback(result, error) runs on a grpc worker thread
-        (reference: grpc/_client.py:1574-1741).
+        (reference: grpc/_client.py:1574-1741). A KServe ``timeout`` with
+        no explicit ``client_timeout`` also bounds the call client-side
+        (same contract as ``infer``).
         """
+        if client_timeout is None and timeout:
+            client_timeout = timeout / 1e6
+
         def wrapped_callback(future):
             error = None
             result = None

@@ -354,7 +354,11 @@ class InferenceServerClient(InferenceServerClientBase):
         RPC / result wrap, attached to the result as ``result.timers``;
         ``request_id`` also rides as triton-request-id metadata and
         ``traceparent`` as W3C trace-context metadata (same contract as
-        the sync client)."""
+        the sync client). A KServe ``timeout`` budget with no explicit
+        ``client_timeout`` also becomes the gRPC per-call deadline (same
+        contract as the sync client)."""
+        if client_timeout is None and timeout:
+            client_timeout = timeout / 1e6
         if timers is not None:
             timers.capture("request_start")
             timers.capture("send_start")

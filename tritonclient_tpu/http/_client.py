@@ -51,12 +51,70 @@ from tritonclient_tpu.http._utils import (
 from tritonclient_tpu.utils import InferenceServerException, raise_error
 
 
+class _CancelToken:
+    """Cancellation handle shared between an InferAsyncRequest and its
+    in-flight request thread.
+
+    HTTP has no cancel verb; closing the connection IS the wire's
+    cancellation signal — the server's disconnect watcher arms the
+    request's ``cancel_event`` and the batcher sheds the queued work
+    (``nv_inference_shed_total{reason="cancelled"}``). ``cancel()``
+    therefore closes whatever connection the request currently holds; a
+    cancel that lands before the connection is acquired poisons the token
+    so the request aborts at attach time instead.
+    """
+
+    __slots__ = ("_lock", "_conn", "cancelled")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._conn = None
+        self.cancelled = False
+
+    @staticmethod
+    def _kill(conn):
+        # shutdown() BEFORE close(): the request thread's in-flight
+        # getresponse holds a makefile io-ref, so close() alone defers
+        # the real close (no FIN ever reaches the server). shutdown()
+        # sends the FIN immediately — the server's disconnect watcher
+        # sees EOF and the blocked response read wakes with an error.
+        sock = getattr(conn, "sock", None)
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def attach(self, conn):
+        with self._lock:
+            self._conn = conn
+            if self.cancelled:
+                self._kill(conn)
+
+    def detach(self):
+        with self._lock:
+            self._conn = None
+
+    def cancel(self):
+        with self._lock:
+            self.cancelled = True
+            conn = self._conn
+        if conn is not None:
+            self._kill(conn)
+
+
 class InferAsyncRequest:
     """Handle for an in-flight async_infer (reference: http/_client.py:46-99)."""
 
-    def __init__(self, future: Future, verbose: bool = False):
+    def __init__(self, future: Future, verbose: bool = False,
+                 cancel_token: Optional[_CancelToken] = None):
         self._future = future
         self._verbose = verbose
+        self._cancel_token = cancel_token
 
     def get_result(self, block: bool = True, timeout: Optional[float] = None) -> InferResult:
         """Wait for and return the InferResult (raises on server error)."""
@@ -70,7 +128,18 @@ class InferAsyncRequest:
             ) from None
 
     def cancel(self) -> bool:
-        return self._future.cancel()
+        """Cancel the request. Not-yet-started requests are dropped from
+        the pool; an IN-FLIGHT request has its connection closed, which
+        the server observes as a client disconnect and sheds the queued
+        work — the cancellation actually travels to the server."""
+        if self._future.cancel():
+            return True
+        if self._future.done():
+            return False
+        if self._cancel_token is not None:
+            self._cancel_token.cancel()
+            return True
+        return False
 
 
 class _ConnectionPool:
@@ -225,6 +294,7 @@ class InferenceServerClient(InferenceServerClientBase):
         body: Optional[bytes] = None,
         headers: Optional[Dict[str, str]] = None,
         query_params: Optional[dict] = None,
+        cancel_token: Optional[_CancelToken] = None,
     ):
         headers = dict(headers) if headers else {}
         for key in headers:
@@ -254,6 +324,8 @@ class InferenceServerClient(InferenceServerClientBase):
                 conn, reused = self._pool.acquire()
             except OSError as e:
                 raise InferenceServerException(msg=str(e)) from None
+            if cancel_token is not None:
+                cancel_token.attach(conn)
             try:
                 conn.request(method, uri, body=body, headers=headers)
                 response = conn.getresponse()
@@ -266,6 +338,12 @@ class InferenceServerClient(InferenceServerClientBase):
                 raise InferenceServerException(msg="timed out") from None
             except (http.client.HTTPException, OSError) as e:
                 self._pool.discard(conn)
+                if cancel_token is not None and cancel_token.cancelled:
+                    # The failure IS the cancellation (the token closed
+                    # this connection); never retry cancelled work.
+                    raise InferenceServerException(
+                        msg="Locally cancelled by application!"
+                    ) from None
                 # Retry once, and only when the failed connection was a reused
                 # keep-alive one (likely closed while idle). A failure on a
                 # fresh connection is a real error — and infer is not
@@ -275,6 +353,8 @@ class InferenceServerClient(InferenceServerClientBase):
                     retried = True
                     continue
                 raise InferenceServerException(msg=str(e)) from None
+        if cancel_token is not None:
+            cancel_token.detach()
         self._pool.release(conn)
         if self._verbose:
             print(response.status, response.headers)
@@ -283,8 +363,11 @@ class InferenceServerClient(InferenceServerClientBase):
     def _get(self, path, headers=None, query_params=None):
         return self._request("GET", path, headers=headers, query_params=query_params)
 
-    def _post(self, path, body=b"", headers=None, query_params=None):
-        return self._request("POST", path, body=body, headers=headers, query_params=query_params)
+    def _post(self, path, body=b"", headers=None, query_params=None,
+              cancel_token=None):
+        return self._request("POST", path, body=body, headers=headers,
+                             query_params=query_params,
+                             cancel_token=cancel_token)
 
     # -- health --------------------------------------------------------------
 
@@ -596,6 +679,7 @@ class InferenceServerClient(InferenceServerClientBase):
         parameters=None,
         timers=None,
         traceparent=None,
+        cancel_token=None,
     ) -> InferResult:
         """Synchronous inference (reference: http/_client.py:1331-1484).
 
@@ -627,7 +711,10 @@ class InferenceServerClient(InferenceServerClientBase):
             all_headers.setdefault("traceparent", traceparent)
         if timers is not None:
             timers.capture("send_end")
-        status, resp_headers, body = self._post(path, request_body, all_headers, query_params)
+        status, resp_headers, body = self._post(
+            path, request_body, all_headers, query_params,
+            cancel_token=cancel_token,
+        )
         _raise_if_error(status, body)
         if timers is not None:
             timers.capture("recv_start")
@@ -662,7 +749,11 @@ class InferenceServerClient(InferenceServerClientBase):
         parameters=None,
     ) -> InferAsyncRequest:
         """Submit inference on the bounded pool; returns an InferAsyncRequest
-        whose get_result() blocks (reference: http/_client.py:1486-1659)."""
+        whose get_result() blocks (reference: http/_client.py:1486-1659).
+        ``.cancel()`` on the handle travels to the server: an in-flight
+        request's connection is closed, which the server's disconnect
+        watcher converts into a shed of the queued work."""
+        cancel_token = _CancelToken()
         future = self._executor.submit(
             self.infer,
             model_name,
@@ -680,5 +771,8 @@ class InferenceServerClient(InferenceServerClientBase):
             request_compression_algorithm,
             response_compression_algorithm,
             parameters,
+            None,  # timers
+            None,  # traceparent
+            cancel_token,
         )
-        return InferAsyncRequest(future, self._verbose)
+        return InferAsyncRequest(future, self._verbose, cancel_token)

@@ -6,6 +6,7 @@ InferResult intact, TCPConnector connection limit = ``conn_limit``). HTTP has
 no streaming in the v2 protocol.
 """
 
+import asyncio
 import base64
 import gzip
 import json
@@ -98,12 +99,19 @@ class InferenceServerClient(InferenceServerClientBase):
         async with self._session.get(url, headers=self._prep_headers(headers)) as resp:
             return resp.status, resp.headers, await resp.read()
 
-    async def _post(self, path, body=b"", headers=None, query_params=None):
+    async def _post(self, path, body=b"", headers=None, query_params=None,
+                    timeout_s: Optional[float] = None):
         url = f"{self._url}/{path}{_get_query_string(query_params)}"
         if self._verbose:
             print("POST", url)
+        kwargs = {}
+        if timeout_s is not None:
+            # Per-request override of the session-wide conn_timeout (the
+            # KServe budget as a REAL client deadline, not just a server
+            # annotation).
+            kwargs["timeout"] = aiohttp.ClientTimeout(total=timeout_s)
         async with self._session.post(
-            url, data=body, headers=self._prep_headers(headers)
+            url, data=body, headers=self._prep_headers(headers), **kwargs
         ) as resp:
             return resp.status, resp.headers, await resp.read()
 
@@ -268,7 +276,13 @@ class InferenceServerClient(InferenceServerClientBase):
         POST / result wrap, attached to the result as ``result.timers``;
         ``request_id`` also rides as the triton-request-id header and
         ``traceparent`` as the W3C trace-context header (same contract as
-        the sync client)."""
+        the sync client).
+
+        ``timeout`` (KServe budget, microseconds) is honored as a REAL
+        aiohttp per-request deadline, not just a server-side parameter: a
+        dead or wedged server can no longer hang this client past its own
+        stated deadline (the healthy path sheds server-side with a fast
+        504 well before the client bound fires)."""
         if timers is not None:
             timers.capture("request_start")
             timers.capture("send_start")
@@ -304,9 +318,16 @@ class InferenceServerClient(InferenceServerClientBase):
             timers.capture("send_end")
 
         path = model_infer_path(model_name, model_version)
-        status, resp_headers, body = await self._post(
-            path, request_body, all_headers, query_params
-        )
+        try:
+            status, resp_headers, body = await self._post(
+                path, request_body, all_headers, query_params,
+                timeout_s=(timeout / 1e6) if timeout else None,
+            )
+        except asyncio.TimeoutError:
+            raise InferenceServerException(
+                msg=f"inference request timed out after its {timeout} us "
+                "deadline (client-side bound)"
+            ) from None
         _raise_if_error(status, body)
         if timers is not None:
             timers.capture("recv_start")

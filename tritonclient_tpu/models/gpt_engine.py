@@ -145,10 +145,11 @@ def _prefill_into_slot(params: Dict, k_cache, v_cache, padded_prompt,
 
 class _Request:
     __slots__ = ("prompt", "max_new", "out", "remaining", "temperature",
-                 "top_k", "seed", "cancelled")
+                 "top_k", "seed", "cancelled", "cancel_event")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
-                 temperature: float = 0.0, top_k: int = 0, seed: int = 0):
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                 cancel_event=None):
         self.prompt = prompt
         self.max_new = max_new
         self.remaining = max_new
@@ -156,7 +157,18 @@ class _Request:
         self.top_k = int(top_k)
         self.seed = int(seed)
         self.cancelled = False  # set by the consumer; engine frees the slot
+        # Transport-armed cancellation (threading.Event or None): the
+        # engine loop polls it between decode steps — a client that
+        # disconnects frees its slot within one step even if the response
+        # generator is parked in a queue.get.
+        self.cancel_event = cancel_event
         self.out: "queue.Queue" = queue.Queue()
+
+    @property
+    def abandoned(self) -> bool:
+        return self.cancelled or (
+            self.cancel_event is not None and self.cancel_event.is_set()
+        )
 
 
 class _Distributor:
@@ -431,10 +443,11 @@ class GenerationEngine:
 
     def submit(self, prompt: np.ndarray, max_new: int,
                temperature: float = 0.0, top_k: int = 0,
-               seed: int = 0) -> "_Request":
+               seed: int = 0, cancel_event=None) -> "_Request":
         """Queue a generation; returns the _Request whose ``.out`` queue
-        yields np [1] per token, then None. Setting ``.cancelled`` frees
-        the slot at the engine's next loop top. Greedy by default;
+        yields np [1] per token, then None. Setting ``.cancelled`` (or
+        arming ``cancel_event``) frees the slot at the engine's next loop
+        top — i.e. within one decode step. Greedy by default;
         temperature/top_k/seed follow the shared sampling key schedule
         (gpt.sampling_key)."""
         if prompt.shape[1] >= self.cfg.max_len:
@@ -447,7 +460,8 @@ class GenerationEngine:
         # 31-bit canonical form (matches sampling_key) so the int32 slot
         # vectors hold any int64 wire seed without overflow.
         req = _Request(prompt.astype(np.int32), max_new, temperature,
-                       top_k, int(seed) & 0x7FFFFFFF)
+                       top_k, int(seed) & 0x7FFFFFFF,
+                       cancel_event=cancel_event)
         with self._cv:
             if self._stopping:
                 raise RuntimeError("generation engine is shut down")
@@ -478,9 +492,12 @@ class GenerationEngine:
         generating dead tokens until max_new. Termination itself is
         routed through the delivery queue (submit_cancel) so the
         request's remaining/out are only ever touched by the delivery
-        thread, in pipeline order."""
+        thread, in pipeline order. ``cancel_event`` (armed by the
+        protocol front-end on disconnect/stream cancel) is polled here —
+        between decode steps — so an abandoned generation frees its slot
+        even when its response generator never runs again."""
         for slot, req in enumerate(self._slot_req):
-            if req is not None and req.cancelled:
+            if req is not None and req.abandoned:
                 self._slot_req[slot] = None
                 self._temps = self._temps.at[slot].set(0.0)
                 self._dist.submit_cancel(req)
@@ -511,7 +528,7 @@ class GenerationEngine:
                 req = self._admit.get_nowait()
             except queue.Empty:
                 break
-            if req.cancelled:
+            if req.abandoned:
                 req.out.put(None)
                 continue
             l = req.prompt.shape[1]
@@ -747,6 +764,9 @@ class GptEngineModel(Model):
     platform = "jax"
     decoupled = True
     blocking = True
+    # The core injects the request's cancel_event (PARAM_CANCEL_EVENT in
+    # the parameters copy) so the engine can poll it between decode steps.
+    accepts_cancel_event = True
 
     def __init__(self, cfg: Optional[GptConfig] = None, seed: int = 0,
                  max_slots: int = 8, mesh=None):
@@ -797,6 +817,10 @@ class GptEngineModel(Model):
         if "MAX_TOKENS" in inputs:
             max_new = int(np.asarray(inputs["MAX_TOKENS"]).flatten()[0])
         temperature, top_k, gen_seed = sampling_inputs(inputs)
+        from tritonclient_tpu.protocol._literals import PARAM_CANCEL_EVENT
+
+        cancel_event = (parameters or {}).get(PARAM_CANCEL_EVENT)
+
         def gen():
             # Admission happens on FIRST consumption (not at infer()):
             # a transport that abandons the response generator before
@@ -807,7 +831,8 @@ class GptEngineModel(Model):
             # instead of generating dead tokens to max_new (advisor r3).
             req = self.engine.submit(prompt, max_new,
                                      temperature=temperature,
-                                     top_k=top_k, seed=gen_seed)
+                                     top_k=top_k, seed=gen_seed,
+                                     cancel_event=cancel_event)
             try:
                 while True:
                     token = req.out.get(timeout=300)

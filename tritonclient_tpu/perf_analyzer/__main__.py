@@ -59,6 +59,13 @@ def main(argv=None):
     )
     parser.add_argument("--read-outputs", action="store_true",
                         help="include output deserialization in the loop")
+    parser.add_argument(
+        "--request-timeout-us", type=int, default=0, metavar="US",
+        help="attach a KServe `timeout` budget (microseconds) to every "
+             "request so the sweep exercises the server's deadline path "
+             "(EDF + admission control); shed responses are reported per "
+             "window as a shed rate next to the queue/compute split",
+    )
     parser.add_argument("--device-id", type=int, default=0)
     parser.add_argument(
         "--shm-mesh-devices", type=int, default=0, metavar="N",
@@ -115,6 +122,10 @@ def main(argv=None):
         if args.trace_out:
             parser.error("--trace-out is not supported with "
                          "--native-driver (client spans live in-process)")
+        if args.request_timeout_us:
+            parser.error("--request-timeout-us is not supported with "
+                         "--native-driver (the native loop does not "
+                         "attach request parameters)")
         if args.shared_memory != "none":
             parser.error("--native-driver supports wire mode only "
                          "(--shared-memory=none)")
@@ -157,6 +168,7 @@ def main(argv=None):
             device_id=args.device_id,
             shm_mesh=shm_mesh,
             trace_out=args.trace_out,
+            request_timeout_us=args.request_timeout_us,
             verbose=args.verbose,
         )
         results = analyzer.sweep(start, end, step)
@@ -179,6 +191,10 @@ def main(argv=None):
                 f"p90: {r['latency_p90_us']}, p95: {r['latency_p95_us']}, "
                 f"p99: {r['latency_p99_us']} usec"
                 + (f", errors: {r['errors']}" if r["errors"] else "")
+                + (
+                    f", sheds: {r['sheds']} (rate {r['shed_rate']})"
+                    if r.get("sheds") else ""
+                )
             )
             if "send_p50_us" in r:
                 print(

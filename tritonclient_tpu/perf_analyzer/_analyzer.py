@@ -26,6 +26,7 @@ from tritonclient_tpu.perf_analyzer._stats import (
     InferStat,
     MeasurementWindow,
     RequestTimers,
+    is_shed_error,
 )
 from tritonclient_tpu.utils import (
     serialize_byte_tensor,
@@ -182,6 +183,7 @@ class _Worker:
         self.send_ns: List[int] = []
         self.recv_ns: List[int] = []
         self.errors = 0
+        self.sheds = 0  # deadline sheds (--request-timeout-us), not errors
         self._stop = threading.Event()
         self._client = None
         self._done = None  # streaming response queue (lives across windows)
@@ -461,6 +463,7 @@ class _Worker:
         a = self.analyzer
         i = 0
         outputs = self._build_outputs()
+        timeout_us = a.request_timeout_us or None
         while time.perf_counter() < end_time and not self._stop.is_set():
             payloads = self.payload_sets[i % _RANDOM_POOL]
             i += 1
@@ -472,14 +475,18 @@ class _Worker:
                 inputs = self._build_inputs(payloads)
                 timers.capture("send_end")
                 result = self._client.infer(
-                    a.model_name, inputs, outputs=outputs, traceparent=tp
+                    a.model_name, inputs, outputs=outputs, traceparent=tp,
+                    timeout=timeout_us,
                 )
                 timers.capture("recv_start")
                 if a.read_outputs:
                     self._consume_outputs(result)
                 timers.capture("recv_end")
-            except Exception:
-                self.errors += 1
+            except Exception as e:
+                if is_shed_error(e):
+                    self.sheds += 1
+                else:
+                    self.errors += 1
                 continue
             timers.capture("request_end")
             self._span_finish(span, timers)
@@ -509,13 +516,14 @@ class _Worker:
         done = self._done
         outputs = self._build_outputs()
         rid = f"w{self.wid}"
+        timeout_us = a.request_timeout_us or None
         prepared = None
         if self._static_inputs is not None:
             # Proto built once; only the region contents change per request
             # (C++ submessage-reuse parity, grpc_client.cc:1419).
             prepared = self._client.prepare_request(
                 a.model_name, self._static_inputs, outputs=outputs,
-                request_id=rid,
+                request_id=rid, timeout=timeout_us,
             )
         i = 0
         while time.perf_counter() < end_time and not self._stop.is_set():
@@ -550,24 +558,31 @@ class _Worker:
                             rid,
                             lambda: self._client.async_stream_infer(
                                 a.model_name, inputs, outputs=outputs,
-                                request_id=rid,
+                                request_id=rid, timeout=timeout_us,
                             ),
                         )
                     else:
                         self._client.async_stream_infer(
-                            a.model_name, inputs, outputs=outputs
+                            a.model_name, inputs, outputs=outputs,
+                            timeout=timeout_us,
                         )
                 timers.capture("recv_start")
                 result, error = done.get(timeout=120)
                 if error is not None:
                     timers.capture("recv_end")
-                    self.errors += 1
+                    if is_shed_error(error):
+                        self.sheds += 1
+                    else:
+                        self.errors += 1
                     continue
                 if a.read_outputs:
                     self._consume_outputs(result)
                 timers.capture("recv_end")
-            except Exception:
-                self.errors += 1
+            except Exception as e:
+                if is_shed_error(e):
+                    self.sheds += 1
+                else:
+                    self.errors += 1
                 continue
             timers.capture("request_end")
             self._span_finish(span, timers)
@@ -892,6 +907,7 @@ class MeasurementSession:
             w.recv_ns.clear()
             w.stat = InferStat()
             w.errors = 0
+            w.sheds = 0
         # Server-side statistics snapshot at the warmup cut; the post-join
         # snapshot closes the window and the delta becomes the server
         # queue/compute breakdown in summary().
@@ -912,6 +928,7 @@ class MeasurementSession:
             window.send_ns.extend(w.send_ns)
             window.recv_ns.extend(w.recv_ns)
             window.errors += w.errors
+            window.sheds += w.sheds
             window.stat.completed_request_count += w.stat.completed_request_count
             window.stat.cumulative_total_request_time_ns += (
                 w.stat.cumulative_total_request_time_ns
@@ -1046,10 +1063,16 @@ class PerfAnalyzer:
         write_once: bool = False,
         collect_server_stats: bool = True,
         trace_out: Optional[str] = None,
+        request_timeout_us: int = 0,
         verbose: bool = False,
     ):
         if protocol not in ("grpc", "http"):
             raise ValueError("protocol must be grpc or http")
+        if request_timeout_us and async_window:
+            raise ValueError(
+                "--request-timeout-us is supported in the closed-loop "
+                "modes only (not --async window mode)"
+            )
         if streaming and protocol != "grpc":
             raise ValueError("--streaming requires grpc")
         if async_window and protocol != "grpc":
@@ -1073,6 +1096,11 @@ class PerfAnalyzer:
         # server answers with parked metadata, not materialized tensors).
         self.shared_stream = shared_stream
         self.mux_shard = int(os.environ.get("PA_MUX_SHARD", "16"))
+        # KServe `timeout` (microseconds) attached to every request so a
+        # concurrency sweep exercises the server's deadline path: shed
+        # responses (fast 504 / DEADLINE_EXCEEDED) are counted per window
+        # as `sheds`/`shed_rate`, apart from errors.
+        self.request_timeout_us = int(request_timeout_us)
         self.read_outputs = read_outputs
         # Reference perf_analyzer semantics for --shared-memory: input
         # buffers are written into the region ONCE at setup and every

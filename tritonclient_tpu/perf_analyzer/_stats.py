@@ -56,6 +56,27 @@ class InferStat:
         self.cumulative_receive_time_ns += timers.recv_ns
 
 
+def is_shed_error(error) -> bool:
+    """Is this client-side error a deadline shed (server 504 / gRPC
+    DEADLINE_EXCEEDED / the batcher's shed message on a stream)?
+
+    Sheds are the deadline path WORKING — the sweep reports them as a
+    rate next to the queue/compute split, not as generic errors.
+    """
+    status = getattr(error, "status", None)
+    if callable(status):
+        try:
+            status = status()
+        except Exception:
+            status = None
+    if status is not None:
+        s = str(status)
+        if "504" in s or "DEADLINE_EXCEEDED" in s:
+            return True
+    msg = str(error)
+    return "shed" in msg or "deadline" in msg
+
+
 def percentile(sorted_values: List[int], pct: float) -> int:
     """Nearest-rank percentile: value at ceil(p/100 * n)."""
     if not sorted_values:
@@ -89,6 +110,10 @@ class MeasurementWindow:
     duration_s: float
     latencies_ns: List[int] = field(default_factory=list)
     errors: int = 0
+    # Requests answered with a deadline shed (fast 504 / DEADLINE_EXCEEDED)
+    # — counted apart from errors: under --request-timeout-us a shed is
+    # the deadline path doing its job, not a failure of the sweep.
+    sheds: int = 0
     stat: InferStat = field(default_factory=InferStat)
     # Per-request send/receive samples (for percentile reporting, not just
     # the cumulative means InferStat carries).
@@ -118,10 +143,16 @@ class MeasurementWindow:
         avg = sum(lat) / len(lat) if lat else 0
         send = sorted(self.send_ns)
         recv = sorted(self.recv_ns)
+        attempted = len(lat) + self.errors + self.sheds
         out = {
             "concurrency": self.concurrency,
             "count": len(lat),
             "errors": self.errors,
+            # Shed rate per window: sheds / everything offered this
+            # window — the deadline-path signal next to the server
+            # queue/compute split below.
+            "sheds": self.sheds,
+            "shed_rate": round(self.sheds / attempted, 4) if attempted else 0.0,
             "throughput_infer_per_sec": round(self.throughput, 2),
             "latency_avg_us": int(avg / 1000),
             **{

@@ -98,6 +98,40 @@ KEY_UNLOAD_DEPENDENTS = "unload_dependents"
 #: parameter name). The server parses it into ``CoreRequest.deadline_us``.
 KEY_TIMEOUT = "timeout"
 
+# --------------------------------------------------------------------------- #
+# load-shed vocabulary (deadline-aware scheduling)                             #
+# --------------------------------------------------------------------------- #
+
+#: HTTP status of a request shed by deadline-aware scheduling — rejected at
+#: admission (remaining budget provably smaller than the service estimate)
+#: or swept out of the queue after its deadline expired. The gRPC plane
+#: maps it to ``DEADLINE_EXCEEDED``. Spelled here exactly once so client
+#: and server cannot drift on the shed status (enforced by TPU008).
+STATUS_SHED = 504
+
+#: HTTP status of a request removed from the queue because its client went
+#: away (disconnect / stream cancel). The gRPC plane maps it to
+#: ``CANCELLED``.
+STATUS_CANCELLED = 499
+
+#: ``reason`` label values of the ``nv_inference_shed_total`` counter and
+#: the flight recorder's ``shed.reason`` attribute.
+SHED_REASON_ADMISSION = "admission"
+SHED_REASON_EXPIRED = "expired"
+SHED_REASON_CANCELLED = "cancelled"
+SHED_REASONS = (
+    SHED_REASON_ADMISSION,
+    SHED_REASON_EXPIRED,
+    SHED_REASON_CANCELLED,
+)
+
+#: Server-internal parameter key carrying a request's ``cancel_event``
+#: into engine-backed models (gpt/tp engines poll it between decode
+#: steps). Never on the wire: the front-ends strip/never accept it, and
+#: the core injects it only for models declaring
+#: ``accepts_cancel_event = True``.
+PARAM_CANCEL_EVENT = "_tpu_cancel_event"
+
 #: Request parameters the clients reserve for dedicated kwargs; user-supplied
 #: ``parameters`` dicts may not name these (reference:
 #: tritonclient/http/_utils.py:114-117 and grpc/_utils.py equivalent).

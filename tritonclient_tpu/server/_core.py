@@ -29,7 +29,16 @@ from tritonclient_tpu._tracing import (
     TraceContext,
     configure_logging,
 )
-from tritonclient_tpu.protocol._literals import SERVER_EXTENSIONS
+from tritonclient_tpu.protocol._literals import (
+    PARAM_CANCEL_EVENT,
+    SERVER_EXTENSIONS,
+    SHED_REASON_ADMISSION,
+    SHED_REASON_CANCELLED,
+    SHED_REASON_EXPIRED,
+    SHED_REASONS,
+    STATUS_CANCELLED,
+    STATUS_SHED,
+)
 from tritonclient_tpu.utils import (
     deserialize_bytes_tensor,
     num_elements,
@@ -85,10 +94,18 @@ class CoreRequest:
     outputs: List[CoreRequestedOutput] = field(default_factory=list)
     # Parsed KServe `timeout` request parameter (microseconds; 0 = none).
     # Held OUT of `parameters` so carrying a deadline does not disqualify
-    # the request from dynamic batching; currently observation-only
-    # (deadline_exceeded stamping + counter + flight-recorder routing) —
-    # shedding/cancellation is ROADMAP item 1's PR.
+    # the request from dynamic batching. A SCHEDULING input: the dynamic
+    # batcher orders deadline traffic earliest-deadline-first, rejects
+    # requests whose budget cannot cover the service estimate with a fast
+    # 504 at admission, and sweeps expired requests out of the queue.
     deadline_us: int = 0
+    # Per-request cancellation signal (a threading.Event), armed by the
+    # protocol front-ends on client disconnect / RPC termination. The
+    # batcher sheds queued requests whose event is set, and engine-backed
+    # models (``accepts_cancel_event``) poll it between decode steps so
+    # abandoned work stops consuming slots. Excluded from equality so the
+    # gRPC stream's cached-parse comparison is unaffected.
+    cancel_event: Optional[object] = field(default=None, compare=False)
     # Per-request TraceContext (tritonclient_tpu._tracing), attached by the
     # protocol front-end when the request is sampled; the execution paths
     # stamp the QUEUE_START/COMPUTE_* spans onto it. Excluded from equality
@@ -406,6 +423,11 @@ class _ModelStats:
         # Requests whose KServe `timeout` budget elapsed before the
         # response went out (observation only — the request still ran).
         self.deadline_exceeded_count = 0
+        # Requests the batcher shed instead of serving, by reason:
+        # admission (budget provably smaller than the service estimate),
+        # expired (deadline elapsed while queued), cancelled (client went
+        # away while queued). The nv_inference_shed_total counter family.
+        self.shed_counts = {reason: 0 for reason in SHED_REASONS}
         # Per-bucket (non-cumulative) request-duration counts; the +Inf
         # bucket is the trailing slot. Every success AND failure observes
         # exactly once, so +Inf cumulative == success_count + fail_count.
@@ -568,7 +590,7 @@ class _FileOverrideModel:
 
 class _BatchSlot:
     __slots__ = ("request", "signature", "rows", "response", "error",
-                 "done", "event", "t_enqueue")
+                 "done", "event", "t_enqueue", "deadline_ns")
 
     def __init__(self, request, signature, rows):
         self.request = request
@@ -583,6 +605,9 @@ class _BatchSlot:
         # on a small-core host).
         self.event = threading.Event()
         self.t_enqueue = time.monotonic_ns()
+        # Absolute deadline (monotonic ns; 0 = no deadline): the EDF sort
+        # key, and the expiry bound the dispatcher sweeps against.
+        self.deadline_ns = 0
 
 
 class _DynamicBatcher:
@@ -667,6 +692,16 @@ class _DynamicBatcher:
         # it stamped on every request, and rebuilding the string costs
         # more than the rest of the admission bookkeeping combined.
         self._sig_labels: Dict[tuple, str] = {}
+        # Per-signature EWMA of recent batch service times (microseconds,
+        # enqueue-to-completion of one dispatched batch): the admission
+        # gate's service estimate. Updated by the dispatcher under _cv
+        # after each batch completes — deliberately NOT under the core
+        # stats lock, so the admission path never nests _cv with it.
+        self._service_ewma_us: Dict[tuple, float] = {}
+        # Queued slots carrying a deadline: lets the EDF head selection
+        # and the expiry half of the sweep short-circuit to pure FIFO
+        # when no deadline traffic is queued (the default path).
+        self._deadline_queued = 0
         self._model = None
         self._stats = None
         self._cap = 0
@@ -723,9 +758,12 @@ class _DynamicBatcher:
         )
         slot = _BatchSlot(request, signature,
                           int(request.inputs[0].shape[0]))
+        if request.deadline_us:
+            slot.deadline_ns = slot.t_enqueue + request.deadline_us * 1000
         trace = request.trace
         if trace is not None:
             trace.record("QUEUE_START", slot.t_enqueue)
+        est_us = None
         with self._cv:
             # Per-model batcher: model/stats/cap are stable across calls.
             self._model, self._stats, self._cap = model, stats, cap
@@ -747,20 +785,46 @@ class _DynamicBatcher:
                         self._sig_labels.clear()  # one-off shape churn
                     label = self._sig_labels[signature] = repr(signature)
                 trace.set_attribute("batcher.signature", label)
-            self._queue.append(slot)
+            if slot.deadline_ns:
+                # Admission control: reject NOW when the deadline budget is
+                # provably smaller than a conservative (under-)estimate of
+                # time-to-response — a fast 504 instead of a guaranteed
+                # queue-then-miss. Conservative on purpose: with no service
+                # evidence yet (cold EWMA) the request is admitted.
+                est_us = self._estimate_service_us(
+                    signature, slot.deadline_ns, cap
+                )
+                if est_us is not None and est_us <= request.deadline_us:
+                    est_us = None  # budget covers the estimate: admit
             # Arrival bookkeeping feeds both the hold gate and the
             # serialize/spread regime switch — always on. Per-signature
             # windows: one shape's burst cannot evict another's history.
             self._note_arrival(signature, time.monotonic())
-            self._threads = [t for t in self._threads if t.is_alive()]
-            if len(self._threads) < self._n_dispatchers:
-                t = threading.Thread(
-                    target=self._run, daemon=True,
-                    name=f"tpu-batcher-{model.name}",
-                )
-                self._threads.append(t)
-                t.start()
-            self._cv.notify_all()
+            if est_us is None:
+                if slot.deadline_ns:
+                    self._deadline_queued += 1
+                self._queue.append(slot)
+                self._threads = [t for t in self._threads if t.is_alive()]
+                if len(self._threads) < self._n_dispatchers:
+                    t = threading.Thread(
+                        target=self._run, daemon=True,
+                        name=f"tpu-batcher-{model.name}",
+                    )
+                    self._threads.append(t)
+                    t.start()
+                self._cv.notify_all()
+        if est_us is not None:
+            # Shed accounting + the raise happen OUTSIDE the cv: the stats
+            # lock must never nest under the batcher cv (tpusan's lock-
+            # order witness watches exactly this pair).
+            self._record_shed(stats, SHED_REASON_ADMISSION, trace)
+            raise CoreError(
+                f"request to model '{request.model_name}' shed at "
+                f"admission: deadline budget {request.deadline_us} us "
+                f"cannot cover the estimated queue+service time of "
+                f"{est_us} us",
+                STATUS_SHED,
+            )
         return slot
 
     def _note_arrival(self, signature, now: float):  # tpulint: disable=TPU002 - caller holds self._cv
@@ -784,6 +848,94 @@ class _DynamicBatcher:
             1 for t in self._arrivals.get(signature, ()) if now - t < 0.1
         )
 
+    # -- deadline-aware scheduling --------------------------------------------
+
+    def _estimate_service_us(self, signature, deadline_ns, cap):  # tpulint: disable=TPU002 - caller holds self._cv
+        """Conservative time-to-response estimate for a deadline request.
+
+        Under EDF only earlier-deadline work runs ahead of this request,
+        so the estimate is (same-signature earlier-deadline batches ahead
+        + the request's own batch) x the signature's service EWMA. Every
+        term UNDER-estimates (floor division, same-signature only, queue
+        work only) so admission control sheds only provable misses.
+        Returns None when there is no service evidence yet (cold EWMA).
+        """
+        ewma = self._service_ewma_us.get(signature)
+        if ewma is None or cap <= 0:
+            return None
+        ahead = sum(
+            s.rows for s in self._queue
+            if s.deadline_ns and s.deadline_ns <= deadline_ns
+            and s.signature == signature
+        )
+        return int((ahead // cap + 1) * ewma)
+
+    def _record_shed(self, stats, reason: str, trace):
+        """Shed bookkeeping (NO locks held by the caller): counter bump
+        under the core lock, reason stamped on the flight record."""
+        if trace is not None:
+            trace.set_attribute("shed.reason", reason)
+        with self.core._lock:
+            stats.shed_counts[reason] += 1
+
+    def _sweep_shed(self):  # tpulint: disable=TPU002 - caller holds self._cv
+        """Remove expired/cancelled slots from the queue.
+
+        Returns [(slot, reason)] for the caller to finalize OUTSIDE the
+        cv (_finalize_shed). An expired deadline is answered here in
+        queue-removal time — the 504 costs the waiter a wakeup, not the
+        tail of the backlog ahead of it.
+        """
+        shed = []
+        now_ns = time.monotonic_ns() if self._deadline_queued else 0
+        for s in self._queue:
+            ev = s.request.cancel_event
+            if ev is not None and ev.is_set():
+                shed.append((s, SHED_REASON_CANCELLED))
+            elif s.deadline_ns and now_ns > s.deadline_ns:
+                shed.append((s, SHED_REASON_EXPIRED))
+        for s, _reason in shed:
+            self._remove_slot(s)
+        return shed
+
+    def _remove_slot(self, slot):  # tpulint: disable=TPU002 - caller holds self._cv
+        """Queue removal that keeps the deadline count honest."""
+        self._queue.remove(slot)
+        if slot.deadline_ns:
+            self._deadline_queued -= 1
+
+    def _finalize_shed(self, shed):
+        """Answer swept slots (caller must NOT hold the cv): stats under
+        the core lock, then per-slot error + waiter wakeup."""
+        # Stable per-model reference; GIL-atomic read (same contract as
+        # the dispatcher's model/stats snapshot).
+        stats = self._stats  # tpulint: disable=TPU002
+        with self.core._lock:
+            for _slot, reason in shed:
+                stats.shed_counts[reason] += 1
+        now_ns = time.monotonic_ns()
+        for slot, reason in shed:
+            request = slot.request
+            trace = request.trace
+            if trace is not None:
+                trace.set_attribute("shed.reason", reason)
+            waited_us = max((now_ns - slot.t_enqueue) // 1000, 0)
+            if reason == SHED_REASON_CANCELLED:
+                slot.error = CoreError(
+                    f"request to model '{request.model_name}' cancelled "
+                    f"by the client after {waited_us} us in queue",
+                    STATUS_CANCELLED,
+                )
+            else:
+                slot.error = CoreError(
+                    f"request to model '{request.model_name}' shed: "
+                    f"deadline budget {request.deadline_us} us expired "
+                    f"after {waited_us} us in queue",
+                    STATUS_SHED,
+                )
+            slot.done = True
+            slot.event.set()
+
     def wait(self, slot: _BatchSlot, model) -> CoreResponse:
         extensions = 0
         while not slot.event.wait(timeout=60.0):
@@ -795,7 +947,7 @@ class _DynamicBatcher:
             with self._cv:
                 still_queued = slot in self._queue
                 if still_queued:
-                    self._queue.remove(slot)
+                    self._remove_slot(slot)
             if not still_queued and extensions < 4:
                 extensions += 1
                 continue
@@ -817,12 +969,41 @@ class _DynamicBatcher:
     def _take_batch(self):  # tpulint: disable=TPU002 - caller holds self._cv
         """Under the lock: form one batch for the head-of-line signature.
 
+        Head selection is earliest-deadline-first among deadline-carrying
+        slots; with no deadline traffic queued the head is queue[0] — the
+        no-deadline default path stays byte-identical FIFO. Batch mates
+        (same signature, FIFO order) ride along regardless of deadline.
+
         Returns the batch, or None when a gate wants to keep waiting
         (caller re-checks after a cv wait)."""
         head = self._queue[0]
+        if self._deadline_queued:
+            best_ns = 0
+            for s in self._queue:
+                if s.deadline_ns and (best_ns == 0
+                                      or s.deadline_ns < best_ns):
+                    head, best_ns = s, s.deadline_ns
         signature = head.signature
         cap = self._cap
-        mates = [s for s in self._queue if s.signature == signature]
+        # Head first so a cap-full batch can never cut the EDF head. The
+        # remaining mates fill EDF-first too: deadline slots in deadline
+        # order, then no-deadline FIFO — otherwise a deep no-deadline
+        # backlog fills every batch and deadline traffic drains one head
+        # per dispatch instead of a batch per dispatch.
+        if self._deadline_queued:
+            others = [
+                s for s in self._queue
+                if s is not head and s.signature == signature
+            ]
+            mates = [head] + sorted(
+                (s for s in others if s.deadline_ns),
+                key=lambda s: s.deadline_ns,
+            ) + [s for s in others if not s.deadline_ns]
+        else:
+            mates = [head] + [
+                s for s in self._queue
+                if s is not head and s.signature == signature
+            ]
         rows = 0
         batch = []
         for s in mates:
@@ -877,8 +1058,10 @@ class _DynamicBatcher:
         # rate of THIS signature promises >= rate_factor more arrivals
         # within one delay window (measured over the last 100 ms) and the
         # row cap is not yet reached. Light load never pays the hold.
+        # Deadline heads are never held: batch-formation latency spends
+        # the one budget EDF exists to protect.
         delay_s = self.max_queue_delay_us / 1e6
-        if delay_s > 0 and rows < cap:
+        if delay_s > 0 and rows < cap and not head.deadline_ns:
             rate_pressured = recent >= max(
                 2, int(self._rate_factor * 0.1 / delay_s)
             )
@@ -888,7 +1071,7 @@ class _DynamicBatcher:
             if rate_pressured and head_age < delay_s:
                 return None
         for s in batch:
-            self._queue.remove(s)
+            self._remove_slot(s)
         return batch
 
     @staticmethod
@@ -898,6 +1081,7 @@ class _DynamicBatcher:
 
     def _run(self):
         while True:
+            batch = None
             with self._cv:
                 while not self._queue:
                     got = self._cv.wait(timeout=5.0)
@@ -912,28 +1096,41 @@ class _DynamicBatcher:
                         except ValueError:
                             pass
                         return
-                batch = self._take_batch()
-                if batch is None:
+                # Deadline sweep at take time: expired and cancelled slots
+                # leave the queue NOW and are answered below, OUTSIDE the
+                # cv — a blown deadline costs its waiter one wakeup, not
+                # the backlog ahead of it.
+                shed = self._sweep_shed()
+                if self._queue:
+                    batch = self._take_batch()
+                if batch is None and not shed:
                     # Gate open (hold window / overlap minimum): wait for
                     # arrivals, an age-out, or an in-flight dispatch to
                     # finish (its completion notifies).
                     self._cv.wait(timeout=0.005)
                     continue
-                self._dispatching += 1
-                self._batch_seq += 1
-                batch_id = self._batch_seq
-                model, stats = self._model, self._stats
-                # The hold/regime decision in force when this batch formed
-                # (per-signature hysteresis state, read under the cv).
-                regime = (
-                    "serialize"
-                    if self._serialized.get(batch[0].signature)
-                    else "spread"
-                )
+                if batch is not None:
+                    self._dispatching += 1
+                    self._batch_seq += 1
+                    batch_id = self._batch_seq
+                    model, stats = self._model, self._stats
+                    # The hold/regime decision in force when this batch
+                    # formed (per-signature hysteresis state, read under
+                    # the cv).
+                    regime = (
+                        "serialize"
+                        if self._serialized.get(batch[0].signature)
+                        else "spread"
+                    )
                 if self._queue:
                     # The spread rule may leave backlog for siblings:
                     # wake them to take it concurrently.
                     self._cv.notify_all()
+            if shed:
+                self._finalize_shed(shed)
+            if batch is None:
+                continue
+            t_exec = 0
             try:
                 # Triton queue-duration semantics: time a request waited
                 # between batcher enqueue and batch execution start.
@@ -987,6 +1184,23 @@ class _DynamicBatcher:
             finally:
                 with self._cv:
                     self._dispatching -= 1
+                    if t_exec:
+                        # Per-signature EWMA of batch service time (the
+                        # admission gate's evidence), updated under the cv
+                        # it is read under. Includes failed batches — a
+                        # wedged model should make admission MORE
+                        # pessimistic, not blind.
+                        service_us = (time.monotonic_ns() - t_exec) // 1000
+                        sig = batch[0].signature
+                        prior = self._service_ewma_us.get(sig)
+                        if prior is None:
+                            if len(self._service_ewma_us) > 64:
+                                self._service_ewma_us.clear()  # shape churn
+                            self._service_ewma_us[sig] = float(service_us)
+                        else:
+                            self._service_ewma_us[sig] = (
+                                0.75 * prior + 0.25 * service_us
+                            )
                     self._cv.notify_all()
 
 
@@ -1288,6 +1502,22 @@ class InferenceCore:
                 lines.append(
                     f'{metric}{{model="{esc(name)}",version="{esc(version)}"}} '
                     f"{getter(stats)}"
+                )
+        # Shed counters: requests answered with a fast 504/cancel instead
+        # of being served, by reason. All three reason rows always render
+        # (zeros included) so scrapers see a stable label set and the
+        # reasons provably sum to the observed sheds.
+        metric = "nv_inference_shed_total"
+        lines.append(
+            f"# HELP {metric} Number of inference requests shed by "
+            "deadline-aware scheduling instead of served, by reason"
+        )
+        lines.append(f"# TYPE {metric} counter")
+        for name, version, stats in rows:
+            for reason in SHED_REASONS:
+                lines.append(
+                    f'{metric}{{model="{esc(name)}",version="{esc(version)}"'
+                    f',reason="{reason}"}} {stats.shed_counts[reason]}'
                 )
         # Request-duration histogram (per-request latency distribution; the
         # cumulative sum Triton reports as a counter is this family's _sum).
@@ -1667,8 +1897,17 @@ class InferenceCore:
                     for t in request.inputs
                 ])
 
+        params = dict(request.parameters)
+        if request.cancel_event is not None and getattr(
+            model, "accepts_cancel_event", False
+        ):
+            # Engine-backed models poll this between decode steps so a
+            # departed client's generation frees its slot mid-stream.
+            # Injected into the COPY only, and only for models that opt
+            # in — request.parameters stays wire-shaped.
+            params[PARAM_CANCEL_EVENT] = request.cancel_event
         try:
-            result = model.infer(inputs, dict(request.parameters))
+            result = model.infer(inputs, params)
         except CoreError:
             self._record_failure(stats, t_start)
             raise

@@ -24,6 +24,8 @@ from tritonclient_tpu.protocol._literals import (
     KEY_SHM_OFFSET,
     KEY_SHM_REGION,
     KEY_TIMEOUT,
+    STATUS_CANCELLED,
+    STATUS_SHED,
 )
 from tritonclient_tpu.protocol._service import RawJsonMessage
 from tritonclient_tpu.server._core import (
@@ -102,7 +104,30 @@ def _status_for(e: CoreError) -> grpc.StatusCode:
         404: grpc.StatusCode.NOT_FOUND,
         400: grpc.StatusCode.INVALID_ARGUMENT,
         500: grpc.StatusCode.INTERNAL,
+        # Deadline-aware scheduling: shed (admission reject / expired in
+        # queue) and client-cancelled sheds map onto the canonical gRPC
+        # codes so both planes spell the shed status identically.
+        STATUS_SHED: grpc.StatusCode.DEADLINE_EXCEEDED,
+        STATUS_CANCELLED: grpc.StatusCode.CANCELLED,
     }.get(e.status, grpc.StatusCode.UNKNOWN)
+
+
+def _arm_cancel(context, creq) -> None:
+    """Arm a per-request cancel event on RPC termination.
+
+    ``context.add_callback`` fires when the RPC ends — including client
+    cancellation, the case that matters: a set event makes the batcher
+    shed the queued slot and engine models free theirs. Firing on normal
+    completion is harmless (the request is already answered). Transports
+    without callbacks (the aio shim) simply skip arming.
+    """
+    creq.cancel_event = threading.Event()
+    add_cb = getattr(context, "add_callback", None)
+    if add_cb is not None:
+        try:
+            add_cb(creq.cancel_event.set)
+        except Exception:
+            pass  # already-terminated RPC: nothing left to cancel
 
 
 def request_to_core(request: pb.ModelInferRequest, core: InferenceCore) -> CoreRequest:
@@ -487,6 +512,7 @@ class _Servicer:
         creq = None
         try:
             creq = request_to_core(request, self.core)
+            _arm_cancel(context, creq)
             creq.trace = self.core.start_trace(
                 request.model_name, request.model_version,
                 request.id or _metadata_request_id(context),
@@ -520,12 +546,16 @@ class _Servicer:
         return RawJsonMessage(body.encode())
 
     def _process_stream_request(self, request, cached_reqs, cached_resps,
-                                traceparent: str = ""):
+                                traceparent: str = "",
+                                cancel_event=None):
         """One stream request → message list or lazy message generator.
 
         ``traceparent`` is the STREAM's inbound W3C context (gRPC metadata
         is per-call, not per-message): every traced request on the stream
         becomes a child of the caller's span under one shared trace id.
+        ``cancel_event`` is the stream's termination event — armed when
+        the client cancels or the stream tears down, so in-flight work
+        sheds instead of finishing for nobody.
 
         Per-stream hot-path caches. Load generators (and the reference's
         C++ client, grpc_client.cc:1419 submessage reuse) send the SAME
@@ -545,7 +575,9 @@ class _Servicer:
         try:
             creq = self._parse_cached(request, cached_reqs)
             # Always (re)assigned — the cached-parse fast path reuses the
-            # CoreRequest object, so a stale trace must never survive.
+            # CoreRequest object, so a stale trace (or a previous stream's
+            # cancel event) must never survive.
+            creq.cancel_event = cancel_event
             creq.trace = self.core.start_trace(
                 request.model_name, request.model_version, request.id,
                 recv_ns=t_recv, traceparent=traceparent or None,
@@ -668,6 +700,19 @@ class _Servicer:
         stream_tp = _metadata_value(context, "traceparent")
         pending = _queue.Queue(maxsize=64)  # backpressure bound
         stop = threading.Event()
+        # Stream-level cancellation: gRPC cancellation is per-call, so one
+        # event covers every in-flight request on this stream. Armed by
+        # the RPC-termination callback (client cancel / disconnect) and by
+        # the yielder's teardown — queued batcher slots shed
+        # (reason=cancelled) and engine slots free instead of serving a
+        # closed stream.
+        stream_cancel = threading.Event()
+        add_cb = getattr(context, "add_callback", None)
+        if add_cb is not None:
+            try:
+                add_cb(stream_cancel.set)
+            except Exception:
+                pass
 
         def safe_put(item) -> bool:
             while not stop.is_set():
@@ -697,6 +742,7 @@ class _Servicer:
                 future = self._stream_pool.submit(
                     self._process_stream_request,
                     request, cached_reqs, cached_resps, stream_tp,
+                    stream_cancel,
                 )
                 return future, future.exception
             try:
@@ -709,6 +755,7 @@ class _Servicer:
                      _stream_error(f"inference failed: {e}", request.id)),
                     None,
                 )
+            creq.cancel_event = stream_cancel
             creq.trace = self.core.start_trace(
                 request.model_name, request.model_version, request.id,
                 recv_ns=t_recv, traceparent=stream_tp or None,
@@ -763,7 +810,8 @@ class _Servicer:
                             barrier()  # drain batcher + pool pipeline
                         inflight = []
                         item = self._process_stream_request(
-                            request, cached_reqs, cached_resps, stream_tp
+                            request, cached_reqs, cached_resps, stream_tp,
+                            stream_cancel,
                         )
                     else:
                         item, barrier = submit_one(request)
@@ -811,6 +859,9 @@ class _Servicer:
                 yield from msgs
         finally:
             stop.set()
+            # Stream over (cancelled or drained): any work still queued
+            # or generating belongs to nobody.
+            stream_cancel.set()
 
 
 def _memoize_once(fn):
@@ -893,6 +944,18 @@ def _stream_responses(request, cresp, want_final):
             )
             final.parameters[KEY_FINAL_RESPONSE].bool_param = True
             yield pb.ModelStreamInferResponse(infer_response=final)
+
+
+def _aio_arm_cancel(context, event) -> None:
+    """aio analog of _arm_cancel: fire the event on RPC completion (the
+    cancellation case is the one that matters; post-response firing is
+    inert)."""
+    add_cb = getattr(context, "add_done_callback", None)
+    if add_cb is not None:
+        try:
+            add_cb(lambda _ctx: event.set())
+        except Exception:
+            pass
 
 
 class _AioAbort(Exception):
@@ -980,6 +1043,8 @@ class _AioServicer:
         creq = None
         try:
             creq = request_to_core(request, self.core)
+            creq.cancel_event = threading.Event()
+            _aio_arm_cancel(context, creq.cancel_event)
             creq.trace = self.core.start_trace(
                 request.model_name, request.model_version,
                 request.id or _metadata_request_id(context),
@@ -1003,76 +1068,90 @@ class _AioServicer:
         cached_reqs: dict = {}
         cached_resps: dict = {}
         stream_tp = _metadata_value(context, "traceparent")
+        # Stream-level cancellation (see the sync servicer): one event per
+        # stream, armed on RPC completion and on generator teardown — the
+        # teardown path is what fires when the client cancels mid-stream
+        # (CancelledError lands at the yield below).
+        stream_cancel = threading.Event()
+        _aio_arm_cancel(context, stream_cancel)
         loop = asyncio.get_running_loop()
-        async for request in request_iterator:
-            self.core.record_protocol_request("grpc")
-            if self._is_blocking(request.model_name):
-                # Blocking decoupled models (gpt, gpt_engine) generate
-                # tokens with real waits (queue.get, device round-trips).
-                # Drain the generator in the executor and feed the loop
-                # through an asyncio.Queue — consuming it inline would
-                # stall every RPC on this transport for the whole
-                # generation (advisor r3).
-                q: "asyncio.Queue" = asyncio.Queue(maxsize=8)
-                _DONE = object()
-                dead = threading.Event()  # consumer gone; drain must bail
+        try:
+            async for request in request_iterator:
+                self.core.record_protocol_request("grpc")
+                if self._is_blocking(request.model_name):
+                    # Blocking decoupled models (gpt, gpt_engine) generate
+                    # tokens with real waits (queue.get, device
+                    # round-trips). Drain the generator in the executor
+                    # and feed the loop through an asyncio.Queue —
+                    # consuming it inline would stall every RPC on this
+                    # transport for the whole generation (advisor r3).
+                    q: "asyncio.Queue" = asyncio.Queue(maxsize=8)
+                    _DONE = object()
+                    dead = threading.Event()  # consumer gone; bail out
 
-                def _put(item) -> bool:
-                    try:
-                        fut = asyncio.run_coroutine_threadsafe(
-                            q.put(item), loop
-                        )
-                    except RuntimeError:  # loop closed
-                        return False
-                    while True:
+                    def _put(item) -> bool:
                         try:
-                            fut.result(timeout=1.0)
-                            return True
-                        except futures.TimeoutError:
-                            if dead.is_set() or loop.is_closed():
-                                try:
-                                    fut.cancel()
-                                except Exception:
-                                    pass  # cancel-callback may race a
-                                    # closed loop at server shutdown
-                                return False
-                        except Exception:
+                            fut = asyncio.run_coroutine_threadsafe(
+                                q.put(item), loop
+                            )
+                        except RuntimeError:  # loop closed
                             return False
+                        while True:
+                            try:
+                                fut.result(timeout=1.0)
+                                return True
+                            except futures.TimeoutError:
+                                if dead.is_set() or loop.is_closed():
+                                    try:
+                                        fut.cancel()
+                                    except Exception:
+                                        pass  # cancel-callback may race a
+                                        # closed loop at server shutdown
+                                    return False
+                            except Exception:
+                                return False
 
-                def drain(req):
+                    def drain(req):
+                        try:
+                            msgs = self._sync._process_stream_request(
+                                req, cached_reqs, cached_resps, stream_tp,
+                                stream_cancel,
+                            )
+                            for msg in msgs:
+                                if not _put(msg):
+                                    return  # closes msgs -> model cancels
+                        except Exception as e:
+                            _put(_stream_error(
+                                f"inference failed: {e}", req.id
+                            ))
+                        finally:
+                            _put(_DONE)
+
+                    self._executor.submit(drain, request)
                     try:
-                        msgs = self._sync._process_stream_request(
-                            req, cached_reqs, cached_resps, stream_tp
-                        )
-                        for msg in msgs:
-                            if not _put(msg):
-                                return  # closes msgs -> model sees cancel
-                    except Exception as e:
-                        _put(_stream_error(
-                            f"inference failed: {e}", req.id
-                        ))
+                        while True:
+                            item = await q.get()
+                            if item is _DONE:
+                                break
+                            yield item
                     finally:
-                        _put(_DONE)
-
-                self._executor.submit(drain, request)
-                try:
-                    while True:
-                        item = await q.get()
-                        if item is _DONE:
-                            break
-                        yield item
-                finally:
-                    dead.set()
-                continue
-            # Non-blocking models: process inline on the loop. Handling is
-            # enqueue-only (core.infer dispatches async, shm outputs park
-            # un-materialized), so this is one thread hop fewer than the
-            # sync feeder/pool/yielder pipeline.
-            msgs = self._sync._process_stream_request(
-                request, cached_reqs, cached_resps, stream_tp
-            )
-            for msg in msgs:
-                yield msg  # _guard_stream converts generator errors
+                        dead.set()
+                    continue
+                # Non-blocking models: process inline on the loop.
+                # Handling is enqueue-only (core.infer dispatches async,
+                # shm outputs park un-materialized), so this is one thread
+                # hop fewer than the sync feeder/pool/yielder pipeline.
+                msgs = self._sync._process_stream_request(
+                    request, cached_reqs, cached_resps, stream_tp,
+                    stream_cancel,
+                )
+                for msg in msgs:
+                    yield msg  # _guard_stream converts generator errors
+        finally:
+            # Stream over (drained or client-cancelled — CancelledError
+            # lands at the yields above): arm the event so queued batcher
+            # slots shed and engine slots free.
+            stream_cancel.set()
 
     def close(self):
         self._executor.shutdown(wait=False)

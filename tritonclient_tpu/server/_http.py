@@ -88,6 +88,108 @@ def _json_data_to_array(datatype: str, shape: List[int], data) -> np.ndarray:
     return np.asarray(flat).astype(np_dtype).reshape(shape)
 
 
+class _DisconnectWatcher:
+    """Sets a request's ``cancel_event`` when its client socket dies.
+
+    A closed client connection is the HTTP plane's cancellation signal: a
+    waiting socket becomes readable with EOF (or errors) the moment the
+    peer disconnects, while a healthy keep-alive client waiting for its
+    response stays quiet. One daemon thread selects over every in-flight
+    request's socket; on EOF/error it arms the request's cancel event so
+    the dynamic batcher sheds the queued work (reason=cancelled) and
+    engine-backed models free their slots instead of generating for a
+    reader that is gone.
+
+    Readable-with-data (a pipelined next request) is NOT a disconnect —
+    that socket just stops being watched. TLS sockets cannot be peeked
+    (SSLSocket.recv rejects flags); they also drop out of watching rather
+    than risk consuming response-path bytes.
+    """
+
+    _POLL_S = 0.05
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._watched = {}  # token -> (socket, event)
+        self._next = 0
+        self._thread = None
+        self._closed = False
+
+    def watch(self, sock, event) -> int:
+        with self._lock:
+            if self._closed:
+                return 0
+            self._next += 1
+            token = self._next
+            self._watched[token] = (sock, event)
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True,
+                    name="http-disconnect-watcher",
+                )
+                self._thread.start()
+        return token
+
+    def unwatch(self, token: int):
+        with self._lock:
+            self._watched.pop(token, None)
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            self._watched.clear()
+
+    def _run(self):
+        import select
+
+        while True:
+            with self._lock:
+                if self._closed or not self._watched:
+                    # Park; the next watch() restarts the thread.
+                    self._thread = None
+                    return
+                items = list(self._watched.items())
+            socks = [s for _, (s, _e) in items]
+            try:
+                readable, _, errored = select.select(
+                    socks, [], socks, self._POLL_S
+                )
+            except (OSError, ValueError):
+                # A socket closed under us mid-select: drop dead entries.
+                with self._lock:
+                    for token, (s, _e) in list(self._watched.items()):
+                        try:
+                            dead = s.fileno() < 0
+                        except Exception:
+                            dead = True
+                        if dead:
+                            self._watched.pop(token, None)
+                continue
+            hot = set(map(id, readable)) | set(map(id, errored))
+            if not hot:
+                continue
+            for token, (s, event) in items:
+                if id(s) not in hot:
+                    continue
+                try:
+                    data = s.recv(1, socket.MSG_PEEK)
+                except (ValueError, TypeError):
+                    # SSLSocket: flags unsupported — cannot peek safely;
+                    # stop watching instead of guessing.
+                    self.unwatch(token)
+                    continue
+                except OSError:
+                    data = b""  # reset/aborted: the client is gone
+                if data:
+                    # Pipelined bytes from a live client: not a
+                    # disconnect, and no longer watchable (it would read
+                    # as hot every pass).
+                    self.unwatch(token)
+                    continue
+                event.set()
+                self.unwatch(token)
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "triton-tpu-http"
@@ -138,7 +240,13 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _send_error_json(self, e: Exception):
         status = e.status if isinstance(e, CoreError) else 500
-        self._send(status, json.dumps({"error": str(e)}).encode())
+        try:
+            self._send(status, json.dumps({"error": str(e)}).encode())
+        except (BrokenPipeError, ConnectionResetError):
+            # The client is gone — the normal case for a CANCELLED shed
+            # (the disconnect IS what shed the request); nobody is left
+            # to read the error body.
+            pass
 
     # -- routing -------------------------------------------------------------
 
@@ -394,6 +502,15 @@ class _Handler(BaseHTTPRequestHandler):
                 out.shm_kind = self.core.find_shm_kind(out.shm_region)
             request.outputs.append(out)
 
+        # Cancellation propagation: a client that disconnects mid-request
+        # arms this event; the batcher sheds the queued slot and engine
+        # models free theirs instead of serving a reader that is gone.
+        request.cancel_event = threading.Event()
+        watcher = getattr(self.server, "cancel_watcher", None)
+        token = (
+            watcher.watch(self.connection, request.cancel_event)
+            if watcher is not None else 0
+        )
         try:
             response = self.core.infer(request)
         except BaseException as e:
@@ -404,6 +521,11 @@ class _Handler(BaseHTTPRequestHandler):
                 trace.record("RESPONSE_SEND")
                 trace.finish()
             raise
+        finally:
+            # Unwatch BEFORE the response bytes go out: once this handler
+            # writes, the next keep-alive request would read as "hot".
+            if token:
+                watcher.unwatch(token)
         if not isinstance(response, (list, tuple)) and not hasattr(response, "outputs"):
             # Decoupled over HTTP: drain the generator; only single-response
             # decoupled interactions are representable (matching Triton).
@@ -501,6 +623,9 @@ class HTTPFrontend:
         self._server.core = core
         self._server.verbose = verbose
         self._server.daemon_threads = True
+        # Client-disconnect -> cancel_event propagation for in-flight
+        # requests (the HTTP plane's cancellation signal).
+        self._server.cancel_watcher = _DisconnectWatcher()
         # Disable Nagle for latency.
         self._server.socket.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         if ssl_certfile:
@@ -525,6 +650,7 @@ class HTTPFrontend:
         return self
 
     def stop(self):
+        self._server.cancel_watcher.close()
         self._server.shutdown()
         self._server.server_close()
         if self._thread:
