@@ -24,6 +24,12 @@ Checks (exit 1 with one line per violation):
     the {model, version, reason} label set with ``reason`` drawn from the
     canonical shed vocabulary, and all three reasons are present per
     (model, version) series so reason sums are well-defined
+  * the fleet-router families: ``nv_fleet_tenant_quota_rejections_total``
+    carries exactly {tenant, reason} with canonical quota reasons and
+    every reason row present per tenant;
+    ``nv_fleet_replica_up`` is a per-replica gauge valued 0/1;
+    ``nv_fleet_replica_outstanding`` / ``nv_fleet_replica_queue_depth``
+    carry a replica label and are non-negative
 """
 
 import os
@@ -36,11 +42,23 @@ if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
 try:
-    from tritonclient_tpu.protocol._literals import SHED_REASONS
+    from tritonclient_tpu.protocol._literals import (
+        QUOTA_REASONS,
+        SHED_REASONS,
+    )
 except ImportError:  # standalone copy of the script: keep it usable
     SHED_REASONS = ("admission", "expired", "cancelled")
+    QUOTA_REASONS = ("rate", "concurrency", "pressure")
 
 _SHED_FAMILY = "nv_inference_shed_total"
+# Fleet-router families (served by the router's own /metrics): same
+# stable-label-set discipline as the shed counter.
+_QUOTA_FAMILY = "nv_fleet_tenant_quota_rejections_total"
+_REPLICA_UP_FAMILY = "nv_fleet_replica_up"
+_REPLICA_GAUGE_FAMILIES = (
+    "nv_fleet_replica_outstanding",
+    "nv_fleet_replica_queue_depth",
+)
 
 _VALID_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
 _METRIC_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
@@ -180,6 +198,35 @@ def check_exposition(text: str) -> List[str]:
                             f'version="{version}"}}: missing reason '
                             f"rows {missing}"
                         )
+            if family == _QUOTA_FAMILY:
+                # Quota-rejection contract: fixed {tenant, reason} label
+                # set, canonical reasons, every reason row present per
+                # tenant (so per-tenant rejection sums are well-defined).
+                tenant_reasons: Dict[str, set] = {}
+                for labels, value, name, lineno in samples.get(family, []):
+                    if set(labels) != {"tenant", "reason"}:
+                        errors.append(
+                            f"line {lineno}: {family} label set "
+                            f"{sorted(labels)} != ['reason', 'tenant']"
+                        )
+                        continue
+                    if labels["reason"] not in QUOTA_REASONS:
+                        errors.append(
+                            f"line {lineno}: {family} reason "
+                            f"{labels['reason']!r} not in "
+                            f"{list(QUOTA_REASONS)}"
+                        )
+                        continue
+                    tenant_reasons.setdefault(
+                        labels["tenant"], set()
+                    ).add(labels["reason"])
+                for tenant, reasons in tenant_reasons.items():
+                    missing = [r for r in QUOTA_REASONS if r not in reasons]
+                    if missing:
+                        errors.append(
+                            f'{family}{{tenant="{tenant}"}}: missing '
+                            f"reason rows {missing}"
+                        )
             continue
         if ftype == "gauge":
             if family.endswith("_age_us"):
@@ -188,6 +235,31 @@ def check_exposition(text: str) -> List[str]:
                         errors.append(
                             f"line {lineno}: age gauge {name} value "
                             f"{value} < 0"
+                        )
+            if family == _REPLICA_UP_FAMILY:
+                # Membership gauge: one {replica} label, value 0 or 1.
+                for labels, value, name, lineno in samples.get(family, []):
+                    if set(labels) != {"replica"}:
+                        errors.append(
+                            f"line {lineno}: {family} label set "
+                            f"{sorted(labels)} != ['replica']"
+                        )
+                    if value not in (0.0, 1.0):
+                        errors.append(
+                            f"line {lineno}: {family} value {value} "
+                            "not in {0, 1}"
+                        )
+            if family in _REPLICA_GAUGE_FAMILIES:
+                for labels, value, name, lineno in samples.get(family, []):
+                    if "replica" not in labels:
+                        errors.append(
+                            f"line {lineno}: {family} sample without a "
+                            "'replica' label"
+                        )
+                    if value < 0:
+                        errors.append(
+                            f"line {lineno}: {family} value {value} < 0 "
+                            "(outstanding/depth cannot be negative)"
                         )
             continue
         if ftype == "summary":

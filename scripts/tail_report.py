@@ -93,6 +93,7 @@ def _record_from_flight(rec: dict) -> Optional[dict]:
         "request_id": rec.get("request_id", ""),
         "status": rec.get("status", "ok"),
         "shed_reason": attrs.get("shed.reason"),
+        "tenant": attrs.get("tenant"),
         "signature": attrs.get(
             "batcher.signature", rec.get("model_name", "") or "?"
         ),
@@ -131,6 +132,7 @@ def _records_from_spans(spans: List[dict]) -> List[dict]:
             ),
             "status": attrs.get("flight.status", "ok"),
             "shed_reason": attrs.get("shed.reason"),
+            "tenant": attrs.get("tenant"),
             "signature": attrs.get(
                 "batcher.signature",
                 attrs.get("model", attrs.get("model.name", "")) or "?",
@@ -277,6 +279,31 @@ def analyze(records: List[dict], tail_q: float = 0.95,
             "mean_backlog": mean_backlog(members),
         })
 
+    # Per-tenant rows (records carrying the fleet tenant stamp): a
+    # fairness regression attributes to a TENANT, not just a signature —
+    # served latency split per tenant, sheds counted beside it.
+    by_tenant: Dict[str, List[dict]] = {}
+    for r in all_records:
+        if r.get("tenant"):
+            by_tenant.setdefault(str(r["tenant"]), []).append(r)
+    tenants = []
+    for tenant, members in sorted(by_tenant.items(),
+                                  key=lambda kv: -len(kv[1])):
+        served = [m for m in members if not m.get("shed_reason")]
+        ds = sorted(m["duration_us"] for m in served)
+        tenants.append({
+            "tenant": tenant,
+            "count": len(members),
+            "served": len(served),
+            "shed": len(members) - len(served),
+            "p50_us": _percentile(ds, 50),
+            "p99_us": _percentile(ds, 99),
+            "tail_count": sum(
+                1 for m in served if id(m) in tail_ids
+            ),
+            "mean_backlog": mean_backlog(served),
+        })
+
     shed_lat = sorted(r["duration_us"] for r in sheds)
     return {
         "records": len(all_records),
@@ -313,6 +340,7 @@ def analyze(records: List[dict], tail_q: float = 0.95,
             "head_mean": mean_backlog(head),
         },
         "signatures": signatures,
+        "tenants": tenants,
     }
 
 
@@ -380,6 +408,21 @@ def render(result: dict, slowest: List[dict]) -> str:
             f"{row['p99_us']:>9} {row['tail_count']:>5} "
             f"{row['mean_backlog'] if row['mean_backlog'] is not None else '-':>8}"
         )
+    if result.get("tenants"):
+        lines.append("")
+        lines.append(
+            f"{'tenant':<24} {'count':>6} {'served':>7} {'shed':>5} "
+            f"{'p50_us':>8} {'p99_us':>9} {'tail':>5}"
+        )
+        for row in result["tenants"][:10]:
+            tenant = row["tenant"]
+            if len(tenant) > 23:
+                tenant = tenant[:20] + "..."
+            lines.append(
+                f"{tenant:<24} {row['count']:>6} {row['served']:>7} "
+                f"{row['shed']:>5} {row['p50_us']:>8} {row['p99_us']:>9} "
+                f"{row['tail_count']:>5}"
+            )
     if slowest:
         lines.append("")
         lines.append(f"slowest {len(slowest)} record(s):")

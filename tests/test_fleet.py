@@ -1,0 +1,790 @@
+"""Fleet tier tests: policies, admission, and the router end to end.
+
+The integration tier runs 2 in-process replicas + the router over real
+loopback sockets (the CI fleet smoke lane) — the same topology
+``scripts/fleet_bench.py`` launches as separate processes. Everything
+here must stay green under ``TPUSAN=1`` (router locks are
+sanitizer-adopted named locks).
+"""
+
+import json
+import sys
+import threading
+import time
+
+import grpc
+import numpy as np
+import pytest
+import requests
+
+from tritonclient_tpu.fleet import (
+    AdmissionController,
+    FleetError,
+    FleetRouter,
+    FleetServer,
+    Replica,
+    ReplicaSet,
+    ReplicaState,
+    TenantQuota,
+    affinity_select,
+    make_policy,
+)
+from tritonclient_tpu.fleet.serve import FleetDeviceModel
+from tritonclient_tpu.perf_analyzer._stats import (
+    is_quota_error,
+    is_shed_error,
+)
+from tritonclient_tpu.protocol import GRPCInferenceServiceStub, pb
+from tritonclient_tpu.protocol._literals import (
+    HEADER_TENANT_ID,
+    QUOTA_REASONS,
+    STATUS_OVER_QUOTA,
+)
+from tritonclient_tpu.server import InferenceServer
+
+sys.path.insert(0, "scripts")
+from check_metrics_exposition import check_exposition  # noqa: E402
+from tail_report import _record_from_flight, analyze  # noqa: E402
+
+SERVICE_MS = 5
+
+
+def _fake_replicas(n):
+    out = []
+    for i in range(n):
+        r = Replica(f"r{i}", f"127.0.0.1:{9000 + i}")
+        r.state = ReplicaState.READY
+        out.append(r)
+    return out
+
+
+def _infer_body(value=0):
+    return {
+        "inputs": [{
+            "name": "INPUT", "datatype": "INT32", "shape": [1, 16],
+            "data": [value + i for i in range(16)],
+        }]
+    }
+
+
+def _eventually(predicate, timeout_s=3.0, poll_s=0.02):
+    """Poll until ``predicate()`` is truthy. Trace/flight records are
+    submitted AFTER the response bytes hit the socket (RESPONSE_SEND
+    closes the timeline), so a client that just got its response may
+    observe the record a tick later."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(poll_s)  # tpulint: disable=TPU001 (sync test poll)
+    return predicate()
+
+
+def _grpc_request(model="fleet_device"):
+    req = pb.ModelInferRequest(model_name=model)
+    t = req.inputs.add()
+    t.name, t.datatype = "INPUT", "INT32"
+    t.shape.extend([1, 16])
+    req.raw_input_contents.append(np.arange(16, dtype=np.int32).tobytes())
+    return req
+
+
+# --------------------------------------------------------------------------- #
+# unit: policies                                                              #
+# --------------------------------------------------------------------------- #
+
+
+class TestPolicies:
+    def test_least_outstanding_picks_min(self):
+        replicas = _fake_replicas(3)
+        replicas[0].outstanding = 2
+        replicas[1].outstanding = 0
+        replicas[2].outstanding = 5
+        assert make_policy("least-outstanding").select(replicas).name == "r1"
+
+    def test_least_outstanding_idle_rotates(self):
+        # Sequential (idle) traffic must spread, not pile onto the
+        # name-first replica: lifetime request count breaks the tie.
+        replicas = _fake_replicas(2)
+        policy = make_policy("least-outstanding")
+        picks = []
+        for _ in range(4):
+            choice = policy.select(replicas)
+            choice.requests_total += 1
+            picks.append(choice.name)
+        assert set(picks) == {"r0", "r1"}
+
+    def test_p2c_prefers_less_loaded(self):
+        replicas = _fake_replicas(2)
+        replicas[0].outstanding = 10
+        policy = make_policy("p2c")
+        assert all(
+            policy.select(replicas).name == "r1" for _ in range(8)
+        )
+
+    def test_round_robin_rotates(self):
+        replicas = _fake_replicas(3)
+        policy = make_policy("round-robin")
+        assert [policy.select(replicas).name for _ in range(6)] == [
+            "r0", "r1", "r2", "r0", "r1", "r2",
+        ]
+
+    def test_affinity_stable_and_spread(self):
+        replicas = _fake_replicas(4)
+        # Same key -> same replica, every time.
+        first = affinity_select(replicas, "tenant-a")
+        assert all(
+            affinity_select(replicas, "tenant-a") is first
+            for _ in range(8)
+        )
+        # Many keys spread over more than one replica.
+        chosen = {affinity_select(replicas, f"k{i}").name
+                  for i in range(64)}
+        assert len(chosen) > 1
+        # Losing an unrelated replica keeps the mapping for keys that
+        # did not live on it (rendezvous property).
+        keys = [f"k{i}" for i in range(64)]
+        before = {k: affinity_select(replicas, k).name for k in keys}
+        survivors = replicas[:3]
+        lost = replicas[3].name
+        for k in keys:
+            if before[k] != lost:
+                assert affinity_select(survivors, k).name == before[k]
+        assert affinity_select(replicas, "") is None
+
+    def test_unknown_policy(self):
+        with pytest.raises(ValueError, match="unknown balancing policy"):
+            make_policy("nope")
+
+
+# --------------------------------------------------------------------------- #
+# unit: admission                                                             #
+# --------------------------------------------------------------------------- #
+
+
+class TestAdmission:
+    def test_token_bucket_rate_and_refill(self):
+        clock = [0.0]
+        ctl = AdmissionController(
+            {"t": TenantQuota(rate=1, burst=2)}, clock=lambda: clock[0]
+        )
+        assert ctl.admit("t") is None
+        assert ctl.admit("t") is None
+        assert ctl.admit("t") == "rate"
+        clock[0] += 1.0  # one token refilled
+        assert ctl.admit("t") is None
+        assert ctl.admit("t") == "rate"
+        counts = ctl.rejection_counts()["t"]
+        assert counts == {"rate": 2, "concurrency": 0, "pressure": 0}
+
+    def test_concurrency_cap_and_release(self):
+        ctl = AdmissionController(
+            {"t": TenantQuota(rate=0, max_outstanding=2)}
+        )
+        assert ctl.admit("t") is None
+        assert ctl.admit("t") is None
+        assert ctl.admit("t") == "concurrency"
+        ctl.release("t")
+        assert ctl.admit("t") is None
+
+    def test_pressure_sheds_low_priority_only(self):
+        ctl = AdmissionController({
+            "low": TenantQuota(rate=0, priority="low"),
+            "norm": TenantQuota(rate=0, priority="normal"),
+        })
+        assert ctl.admit("low", under_pressure=True) == "pressure"
+        assert ctl.admit("norm", under_pressure=True) is None
+        assert ctl.admit("low", under_pressure=False) is None
+
+    def test_default_tenant_fallback(self):
+        ctl = AdmissionController(
+            {"default": TenantQuota(rate=0.001, burst=1)}
+        )
+        # No tenant header -> the shared "default" bucket.
+        assert ctl.admit("") is None
+        assert ctl.admit("") == "rate"
+        # Unknown tenants inherit the default QUOTA but fill their own
+        # bucket: one hostile stranger cannot starve every other one.
+        assert ctl.admit("anyone") is None
+        assert ctl.admit("anyone") == "rate"
+
+    def test_no_quota_is_open_admission(self):
+        ctl = AdmissionController()
+        assert all(ctl.admit("t") is None for _ in range(50))
+
+    def test_quota_parse(self):
+        q = TenantQuota.parse("10:20:low:4")
+        assert (q.rate, q.burst, q.priority, q.max_outstanding) == (
+            10.0, 20.0, "low", 4,
+        )
+        assert TenantQuota.parse("5").burst == 5.0
+        with pytest.raises(ValueError):
+            TenantQuota(priority="urgent")
+
+    def test_error_classifiers(self):
+        class _E(Exception):
+            status = STATUS_OVER_QUOTA
+
+        assert is_quota_error(_E("tenant 'b' over quota (rate)"))
+        assert is_quota_error(
+            RuntimeError("tenant 'b' over quota (concurrency)")
+        )
+        assert not is_quota_error(RuntimeError("shed: deadline"))
+        assert not is_shed_error(_E("tenant over quota"))
+
+
+# --------------------------------------------------------------------------- #
+# unit: metrics checker fleet families                                        #
+# --------------------------------------------------------------------------- #
+
+
+class TestFleetExpositionChecker:
+    HEAD = (
+        "# HELP nv_fleet_replica_up up\n# TYPE nv_fleet_replica_up gauge\n"
+        "# HELP nv_fleet_replica_outstanding o\n"
+        "# TYPE nv_fleet_replica_outstanding gauge\n"
+        "# HELP nv_fleet_tenant_quota_rejections_total r\n"
+        "# TYPE nv_fleet_tenant_quota_rejections_total counter\n"
+    )
+
+    def _good_rows(self):
+        rows = [
+            'nv_fleet_replica_up{replica="r0"} 1',
+            'nv_fleet_replica_outstanding{replica="r0"} 3',
+        ]
+        for reason in QUOTA_REASONS:
+            rows.append(
+                'nv_fleet_tenant_quota_rejections_total'
+                f'{{tenant="a",reason="{reason}"}} 0'
+            )
+        return rows
+
+    def test_good_document_passes(self):
+        text = self.HEAD + "\n".join(self._good_rows()) + "\n"
+        assert check_exposition(text) == []
+
+    def test_up_value_must_be_binary(self):
+        rows = self._good_rows()
+        rows[0] = 'nv_fleet_replica_up{replica="r0"} 2'
+        errors = check_exposition(self.HEAD + "\n".join(rows) + "\n")
+        assert any("not in {0, 1}" in e for e in errors)
+
+    def test_up_label_set_enforced(self):
+        rows = self._good_rows()
+        rows[0] = 'nv_fleet_replica_up{model="r0"} 1'
+        errors = check_exposition(self.HEAD + "\n".join(rows) + "\n")
+        assert any("!= ['replica']" in e for e in errors)
+
+    def test_outstanding_non_negative(self):
+        rows = self._good_rows()
+        rows[1] = 'nv_fleet_replica_outstanding{replica="r0"} -1'
+        errors = check_exposition(self.HEAD + "\n".join(rows) + "\n")
+        assert any("< 0" in e for e in errors)
+
+    def test_quota_reason_vocabulary(self):
+        rows = self._good_rows()
+        rows.append(
+            'nv_fleet_tenant_quota_rejections_total'
+            '{tenant="a",reason="vibes"} 1'
+        )
+        errors = check_exposition(self.HEAD + "\n".join(rows) + "\n")
+        assert any("'vibes'" in e for e in errors)
+
+    def test_quota_missing_reason_row(self):
+        rows = self._good_rows()[:-1]  # drop one canonical reason row
+        errors = check_exposition(self.HEAD + "\n".join(rows) + "\n")
+        assert any("missing reason rows" in e for e in errors)
+
+    def test_quota_label_set(self):
+        rows = self._good_rows()
+        rows.append(
+            'nv_fleet_tenant_quota_rejections_total'
+            '{tenant="a",reason="rate",extra="x"} 1'
+        )
+        errors = check_exposition(self.HEAD + "\n".join(rows) + "\n")
+        assert any("label set" in e for e in errors)
+
+
+# --------------------------------------------------------------------------- #
+# integration: 2 in-process replicas behind the router                        #
+# --------------------------------------------------------------------------- #
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    replicas = [
+        InferenceServer(
+            models=[FleetDeviceModel(service_ms=SERVICE_MS)]
+        ).start()
+        for _ in range(2)
+    ]
+    replica_set = ReplicaSet(probe_interval_s=0.1, eject_after=3,
+                             backoff_base_s=0.2)
+    router = FleetRouter(replicas=replica_set)
+    for i, r in enumerate(replicas):
+        router.add_replica(f"r{i}", r.http_address, r.grpc_address)
+    replica_set.probe_once()
+    server = FleetServer(router)
+    server.start()
+    yield replicas, replica_set, router, server
+    server.stop()
+    for r in replicas:
+        r.stop()
+
+
+@pytest.fixture()
+def base(fleet):
+    return f"http://{fleet[3].http_address}"
+
+
+@pytest.fixture(scope="module")
+def stub(fleet):
+    channel = grpc.insecure_channel(fleet[3].grpc_address)
+    yield GRPCInferenceServiceStub(channel)
+    channel.close()
+
+
+def _count(replica, model="fleet_device"):
+    return replica.core._stats[model].inference_count
+
+
+class TestRouterHTTP:
+    def test_health_and_status(self, fleet, base):
+        assert requests.get(base + "/v2/health/live").status_code == 200
+        ready = requests.get(base + "/v2/health/ready")
+        assert ready.status_code == 200
+        assert ready.json()["routable_replicas"] == 2
+        status = requests.get(base + "/v2/fleet/status").json()
+        assert status["kind"] == "fleet_status"
+        assert [r["state"] for r in status["replicas"]] == [
+            "ready", "ready",
+        ]
+
+    def test_metadata_proxied(self, base):
+        md = requests.get(base + "/v2/models/fleet_device").json()
+        assert md["inputs"][0]["name"] == "INPUT"
+
+    def test_unary_spread_and_correctness(self, fleet, base):
+        replicas = fleet[0]
+        before = [_count(r) for r in replicas]
+        for i in range(8):
+            resp = requests.post(
+                base + "/v2/models/fleet_device/infer",
+                json=_infer_body(i),
+            )
+            assert resp.status_code == 200
+            assert resp.json()["outputs"][0]["data"] == [
+                i + j for j in range(16)
+            ]
+        gained = [_count(r) - b for r, b in zip(replicas, before)]
+        assert sum(gained) == 8
+        assert all(g > 0 for g in gained), gained
+
+    def test_quota_429_fast_and_counted(self, fleet, base):
+        router = fleet[2]
+        router.admission.set_quota(
+            "qt-http", TenantQuota(rate=0.001, burst=2)
+        )
+        codes, reject_ms = [], []
+        for _ in range(6):
+            t0 = time.monotonic()
+            resp = requests.post(
+                base + "/v2/models/fleet_device/infer",
+                json=_infer_body(),
+                headers={HEADER_TENANT_ID: "qt-http"},
+            )
+            codes.append(resp.status_code)
+            if resp.status_code == STATUS_OVER_QUOTA:
+                reject_ms.append((time.monotonic() - t0) * 1000)
+                assert "over quota" in resp.json()["error"]
+        assert codes[:2] == [200, 200]
+        assert codes[2:] == [STATUS_OVER_QUOTA] * 4
+        # Fast 429: answered at admission, before any replica I/O (the
+        # served requests above take >= SERVICE_MS each).
+        assert max(reject_ms) < 50
+        metrics = requests.get(base + "/metrics").text
+        assert (
+            'nv_fleet_tenant_quota_rejections_total{tenant="qt-http"'
+            ',reason="rate"} 4' in metrics
+        )
+
+    def test_router_metrics_pass_checker(self, base):
+        assert check_exposition(requests.get(base + "/metrics").text) == []
+
+    def test_fan_out_trace_settings(self, fleet, base):
+        replicas = fleet[0]
+        resp = requests.post(
+            base + "/v2/trace/setting", json={"trace_rate": "7"}
+        )
+        assert resp.status_code == 200
+        for r in replicas:
+            assert r.core.get_trace_settings()["trace_rate"] == ["7"]
+        requests.post(base + "/v2/trace/setting",
+                      json={"trace_rate": None})
+
+    def test_deadline_forwarded_to_replica(self, fleet, base):
+        replicas = fleet[0]
+        before = sum(
+            r.core.flight_recorder.deadline_miss_count for r in replicas
+        )
+        body = _infer_body()
+        # 1 ms budget against a 5 ms service time: the replica must see
+        # the deadline (miss observed server-side) for it to have
+        # crossed the router.
+        body["parameters"] = {"timeout": 1000}
+        resp = requests.post(
+            base + "/v2/models/fleet_device/infer", json=body
+        )
+        assert resp.status_code == 200
+        assert _eventually(lambda: sum(
+            r.core.flight_recorder.deadline_miss_count for r in replicas
+        ) == before + 1)
+
+    def test_traceparent_spans_router_to_replica(self, fleet, base):
+        replicas = fleet[0]
+        for r in replicas:
+            r.core.update_trace_settings("", {
+                "trace_level": ["TIMESTAMPS"], "trace_rate": ["1"],
+            })
+        trace_id = "ab" * 16
+        traceparent = f"00-{trace_id}-{'cd' * 8}-01"
+        try:
+            resp = requests.post(
+                base + "/v2/models/fleet_device/infer",
+                json=_infer_body(),
+                headers={"traceparent": traceparent},
+            )
+            assert resp.status_code == 200
+            assert _eventually(lambda: any(
+                rec.trace_id == trace_id
+                for r in replicas
+                for rec in r.core.trace_collector.trace_records()
+            ))
+        finally:
+            for r in replicas:
+                r.core.update_trace_settings(
+                    "", {"trace_level": ["OFF"]}
+                )
+
+    def test_tenant_stamped_through_router(self, fleet, base):
+        replicas = fleet[0]
+        for _ in range(3):
+            resp = requests.post(
+                base + "/v2/models/fleet_device/infer",
+                json=_infer_body(),
+                headers={HEADER_TENANT_ID: "flight-tenant"},
+            )
+            assert resp.status_code == 200
+        assert _eventually(lambda: sum(
+            1
+            for r in replicas
+            for rec in r.core.flight_recorder.dump()["records"]
+            if rec["attributes"].get("tenant") == "flight-tenant"
+        ) >= 3)
+        records = [
+            rec
+            for r in replicas
+            for rec in r.core.flight_recorder.dump()["records"]
+        ]
+        # tail_report attributes the tenant, not just the signature.
+        result = analyze([_record_from_flight(r) for r in records])
+        tenants = {row["tenant"]: row for row in result["tenants"]}
+        assert tenants["flight-tenant"]["served"] >= 3
+
+    def test_flight_recorder_proxied(self, base):
+        dump = requests.get(
+            base + "/v2/debug/flight_recorder"
+        ).json()
+        assert dump["kind"] == "flight_recorder"
+
+
+class TestRouterGRPC:
+    def test_unary_roundtrip(self, stub):
+        resp = stub.ModelInfer(_grpc_request())
+        out = np.frombuffer(resp.raw_output_contents[0], np.int32)
+        np.testing.assert_array_equal(out, np.arange(16, dtype=np.int32))
+
+    def test_server_ready_local(self, stub):
+        assert stub.ServerLive(pb.ServerLiveRequest()).live
+        assert stub.ServerReady(pb.ServerReadyRequest()).ready
+
+    def test_error_propagation(self, stub):
+        with pytest.raises(grpc.RpcError) as exc:
+            stub.ModelInfer(_grpc_request(model="no_such_model"))
+        assert exc.value.code() == grpc.StatusCode.NOT_FOUND
+
+    def test_quota_resource_exhausted(self, fleet, stub):
+        fleet[2].admission.set_quota(
+            "qt-grpc", TenantQuota(rate=0.001, burst=1)
+        )
+        metadata = ((HEADER_TENANT_ID, "qt-grpc"),)
+        stub.ModelInfer(_grpc_request(), metadata=metadata)
+        with pytest.raises(grpc.RpcError) as exc:
+            stub.ModelInfer(_grpc_request(), metadata=metadata)
+        assert exc.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        assert "over quota" in exc.value.details()
+
+    def test_stream_sticky_and_ordered(self, fleet, stub):
+        replicas = fleet[0]
+        before = [_count(r) for r in replicas]
+        responses = list(stub.ModelStreamInfer(
+            iter([_grpc_request() for _ in range(3)]),
+            metadata=(("stream-affinity-key", "sticky-1"),),
+        ))
+        assert len(responses) == 3
+        assert all(
+            m.infer_response.model_name == "fleet_device"
+            for m in responses
+        )
+        gained = [_count(r) - b for r, b in zip(replicas, before)]
+        # Sticky: the whole stream landed on ONE replica.
+        assert sorted(gained) == [0, 3]
+
+    def test_stream_affinity_is_stable(self, fleet, stub):
+        replicas = fleet[0]
+        landings = []
+        for _ in range(2):
+            before = [_count(r) for r in replicas]
+            list(stub.ModelStreamInfer(
+                iter([_grpc_request()]),
+                metadata=(("stream-affinity-key", "sticky-2"),),
+            ))
+            gained = [_count(r) - b for r, b in zip(replicas, before)]
+            landings.append(gained.index(1))
+        assert landings[0] == landings[1]
+
+    def test_metadata_forwarded_tenant(self, fleet, stub):
+        replicas = fleet[0]
+        stub.ModelInfer(
+            _grpc_request(),
+            metadata=((HEADER_TENANT_ID, "grpc-tenant"),),
+        )
+        assert _eventually(lambda: any(
+            rec["attributes"].get("tenant") == "grpc-tenant"
+            for r in replicas
+            for rec in r.core.flight_recorder.dump()["records"]
+        ))
+
+
+# --------------------------------------------------------------------------- #
+# integration: membership, eject, rolling restart                              #
+# --------------------------------------------------------------------------- #
+
+
+class TestMembership:
+    def test_dead_replica_ejected_and_survivor_serves(self):
+        alive = InferenceServer(
+            models=[FleetDeviceModel(service_ms=SERVICE_MS)]
+        ).start()
+        try:
+            replica_set = ReplicaSet(probe_interval_s=0.05,
+                                     eject_after=2, backoff_base_s=0.2,
+                                     probe_timeout_s=0.5)
+            router = FleetRouter(replicas=replica_set)
+            router.add_replica("alive", alive.http_address,
+                               alive.grpc_address)
+            router.add_replica("dead", "127.0.0.1:1")  # nothing listens
+            for _ in range(3):
+                replica_set.probe_once()
+            assert replica_set.get("dead").state == ReplicaState.EJECTED
+            assert replica_set.get("alive").state == ReplicaState.READY
+            server = FleetServer(router, grpc=False)
+            server.start()
+            try:
+                base = f"http://{server.http_address}"
+                for _ in range(3):
+                    assert requests.post(
+                        base + "/v2/models/fleet_device/infer",
+                        json=_infer_body(),
+                    ).status_code == 200
+                metrics = requests.get(base + "/metrics").text
+                assert 'nv_fleet_replica_up{replica="dead"} 0' in metrics
+                assert 'nv_fleet_replica_up{replica="alive"} 1' in metrics
+                assert check_exposition(metrics) == []
+            finally:
+                server.stop()
+        finally:
+            alive.stop()
+
+    def test_no_ready_replicas_is_503(self):
+        replica_set = ReplicaSet(probe_interval_s=10)
+        router = FleetRouter(replicas=replica_set)
+        router.add_replica("r0", "127.0.0.1:1")
+        with pytest.raises(FleetError) as exc:
+            router.begin("")
+        assert exc.value.status == 503
+
+    def test_rolling_restart_drain_under_load(self):
+        """The acceptance scenario: drain a replica under live load with
+        ZERO failed in-flight requests, traffic rebalanced to the
+        survivor, and the replica rejoining after readiness."""
+        replicas = [
+            InferenceServer(
+                models=[FleetDeviceModel(service_ms=SERVICE_MS)]
+            ).start()
+            for _ in range(2)
+        ]
+        replica_set = ReplicaSet(probe_interval_s=0.05)
+        router = FleetRouter(replicas=replica_set)
+        for i, r in enumerate(replicas):
+            router.add_replica(f"r{i}", r.http_address, r.grpc_address)
+        replica_set.probe_once()
+        replica_set.start()
+        server = FleetServer(router, grpc=False)
+        server.start()
+        base = f"http://{server.http_address}"
+        stop = threading.Event()
+        failures, served = [], [0]
+        lock = threading.Lock()
+
+        def worker():
+            session = requests.Session()
+            while not stop.is_set():
+                try:
+                    resp = session.post(
+                        base + "/v2/models/fleet_device/infer",
+                        json=_infer_body(), timeout=10,
+                    )
+                    with lock:
+                        if resp.status_code == 200:
+                            served[0] += 1
+                        else:
+                            failures.append(resp.status_code)
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        failures.append(repr(e))
+
+        threads = [threading.Thread(target=worker) for _ in range(3)]
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(0.4)  # tpulint: disable=TPU001 (live-load window)
+            detail = router.drain_replica("r0", wait_s=10)
+            assert detail["draining"] is True
+            assert replica_set.get("r0").state == ReplicaState.DRAINED
+            assert not replicas[0].core.is_server_ready()
+            # Traffic continues on the survivor alone.
+            r0_settled = _count(replicas[0])
+            before_r1 = _count(replicas[1])
+            time.sleep(0.4)  # tpulint: disable=TPU001
+            assert _count(replicas[0]) == r0_settled
+            assert _count(replicas[1]) > before_r1
+            # Rejoin after readiness: undrain, then both serve again.
+            router.undrain_replica("r0")
+            assert replica_set.get("r0").state == ReplicaState.READY
+            assert replicas[0].core.is_server_ready()
+            rejoin_before = _count(replicas[0])
+            time.sleep(0.4)  # tpulint: disable=TPU001
+            assert _count(replicas[0]) > rejoin_before
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            server.stop()
+            replica_set.stop()
+            for r in replicas:
+                r.stop()
+        assert failures == []  # ZERO failed requests across the restart
+        assert served[0] > 0
+
+    def test_drain_endpoint_on_replica(self):
+        replica = InferenceServer(
+            models=[FleetDeviceModel(service_ms=SERVICE_MS)], grpc=False
+        ).start()
+        try:
+            base = f"http://{replica.http_address}"
+            assert requests.get(
+                base + "/v2/health/ready"
+            ).json() == {"ready": True, "draining": False, "in_flight": 0}
+            detail = requests.post(
+                base + "/v2/fleet/drain", json={"drain": True}
+            ).json()
+            assert detail["draining"] is True
+            assert requests.get(
+                base + "/v2/health/ready"
+            ).status_code == 400
+            detail = requests.post(
+                base + "/v2/fleet/drain", json={"drain": False}
+            ).json()
+            assert detail["ready"] is True
+            assert requests.get(
+                base + "/v2/health/ready"
+            ).status_code == 200
+        finally:
+            replica.stop()
+
+    def test_grpc_drain_rpc_on_replica(self):
+        replica = InferenceServer(
+            models=[FleetDeviceModel(service_ms=SERVICE_MS)], http=False
+        ).start()
+        channel = grpc.insecure_channel(replica.grpc_address)
+        try:
+            stub = GRPCInferenceServiceStub(channel)
+            from tritonclient_tpu.protocol._service import RawJsonMessage
+
+            detail = json.loads(stub.Drain(
+                RawJsonMessage(json.dumps({"drain": True}).encode())
+            ).payload)
+            assert detail["draining"] is True
+            assert not stub.ServerReady(pb.ServerReadyRequest()).ready
+            detail = json.loads(stub.Drain(
+                RawJsonMessage(json.dumps({"drain": False}).encode())
+            ).payload)
+            assert detail["ready"] is True
+        finally:
+            channel.close()
+            replica.stop()
+
+
+# --------------------------------------------------------------------------- #
+# perf_analyzer tenant injection through the fleet                            #
+# --------------------------------------------------------------------------- #
+
+
+class TestPerfAnalyzerTenants:
+    def test_tenant_mix_drives_quotas_and_fairness_rows(self, fleet):
+        from tritonclient_tpu.perf_analyzer import PerfAnalyzer
+
+        fleet[2].admission.set_quota(
+            "pa-hostile", TenantQuota(rate=10, burst=3)
+        )
+        analyzer = PerfAnalyzer(
+            url=fleet[3].grpc_address, model_name="fleet_device",
+            protocol="grpc", collect_server_stats=False,
+            tenant_mix={"pa-good": 1, "pa-hostile": 1},
+            measurement_interval_s=1.0, warmup_s=0.1,
+        )
+        with analyzer.session(4) as session:
+            window = session.measure()
+        summary = window.summary()
+        assert summary["quota_rejections"] > 0
+        assert summary["errors"] == 0
+        assert 0 < summary["quota_rejection_rate"] < 1
+        assert summary["reject_p99_us"] < 50_000
+        tenants = window.tenant_summary()
+        assert set(tenants) == {"pa-good", "pa-hostile"}
+        assert tenants["pa-good"]["count"] > tenants["pa-hostile"]["count"]
+
+    def test_tenant_cycle_weights(self, fleet):
+        from tritonclient_tpu.perf_analyzer import PerfAnalyzer
+
+        analyzer = PerfAnalyzer(
+            url=fleet[3].grpc_address, model_name="fleet_device",
+            protocol="grpc", collect_server_stats=False,
+            tenant_mix={"a": 5, "b": 1},
+        )
+        assert analyzer.tenant_cycle.count("a") == 5
+        assert analyzer.tenant_cycle.count("b") == 1
+        with pytest.raises(ValueError, match="not both"):
+            PerfAnalyzer(
+                url=fleet[3].grpc_address, model_name="fleet_device",
+                protocol="grpc", collect_server_stats=False,
+                tenant_id="a", tenant_mix={"b": 1},
+            )
+        with pytest.raises(ValueError, match="stream-scoped"):
+            PerfAnalyzer(
+                url=fleet[3].grpc_address, model_name="fleet_device",
+                protocol="grpc", collect_server_stats=False,
+                tenant_id="a", streaming=True,
+            )

@@ -967,6 +967,60 @@ class TestProtocolDrift:
         )
         assert findings == []
 
+    def test_fires_on_raw_quota_vocabulary(self, tmp_path):
+        """The fleet vocabulary — raw 429 and a raw tenant-header
+        string — in a protocol-plane file (fleet/ included) is the same
+        drift vector as a respelled shed status."""
+        findings = lint_tree(
+            tmp_path,
+            {
+                "pkg/fleet/_router.py": """
+                    class FleetError(Exception):
+                        def __init__(self, msg, status=500):
+                            self.status = status
+
+                    def reject(msg):
+                        raise FleetError(msg, 429)
+
+                    def tenant_of(headers):
+                        return headers.get("tenant-id", "")
+                """,
+            },
+            select={"TPU008"},
+        )
+        assert rules_of(findings) == ["TPU008", "TPU008"]
+        assert "STATUS_OVER_QUOTA" in findings[0].message
+        assert "HEADER_TENANT_ID" in findings[1].message
+
+    def test_clean_on_quota_constants(self, tmp_path):
+        findings = lint_tree(
+            tmp_path,
+            {
+                "pkg/fleet/_router.py": """
+                    from tritonclient_tpu.protocol._literals import (
+                        HEADER_TENANT_ID,
+                        STATUS_OVER_QUOTA,
+                    )
+
+                    class FleetError(Exception):
+                        def __init__(self, msg, status=500):
+                            self.status = status
+
+                    def reject(msg):
+                        raise FleetError(msg, STATUS_OVER_QUOTA)
+
+                    def tenant_of(headers):
+                        return headers.get(HEADER_TENANT_ID, "")
+                """,
+                # Outside the protocol planes the tenant header is free
+                # to appear (bench drivers, docs tooling).
+                "pkg/tools/driver.py":
+                    'HEADERS = {"tenant-id": "gold"}\n',
+            },
+            select={"TPU008"},
+        )
+        assert findings == []
+
 
 # --------------------------------------------------------------------------- #
 # engine / reporters / CLI                                                    #

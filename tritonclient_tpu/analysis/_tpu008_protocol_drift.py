@@ -9,13 +9,13 @@ surface only as a runtime 400.
 
 This rule diffs actual key usage per plane:
 
-* **shed-status conformance** — the deadline-aware scheduling statuses
-  (HTTP 504 for shed, 499 for client-cancelled) live in
-  ``protocol/_literals.py`` as ``STATUS_SHED``/``STATUS_CANCELLED``; a
-  protocol-plane file (client packages, server front-ends, the core)
-  spelling either as a raw integer literal is the same drift vector as a
-  respelled key — client and server must name the shed status through
-  one constant.
+* **shed/quota-status conformance** — the deadline-aware scheduling
+  statuses (HTTP 504 for shed, 499 for client-cancelled) and the fleet
+  router's over-quota status (429) live in ``protocol/_literals.py`` as
+  ``STATUS_SHED``/``STATUS_CANCELLED``/``STATUS_OVER_QUOTA``, and the
+  tenant header as ``HEADER_TENANT_ID``; a protocol-plane file (client
+  packages, server front-ends, the core, the fleet router) spelling any
+  of them raw is the same drift vector as a respelled key.
 
 * **plane symmetry** — for every *tensor-scope* canonical key (the keys
   that change how tensor bytes are routed or encoded: the shared-memory
@@ -70,9 +70,19 @@ _SHM_TRIO = (
     "shared_memory_offset",
 )
 
-#: Shed-status values whose raw spelling in a protocol-plane file is
-#: drift (use STATUS_SHED / STATUS_CANCELLED from protocol/_literals).
-_SHED_STATUS_NAMES = {504: "STATUS_SHED", 499: "STATUS_CANCELLED"}
+#: Status values whose raw spelling in a protocol-plane file is drift
+#: (use STATUS_SHED / STATUS_CANCELLED / STATUS_OVER_QUOTA from
+#: protocol/_literals).
+_SHED_STATUS_NAMES = {
+    504: "STATUS_SHED",
+    499: "STATUS_CANCELLED",
+    429: "STATUS_OVER_QUOTA",
+}
+
+#: Header/metadata keys whose raw spelling in a protocol-plane file is
+#: drift: a router admitting one spelling while the replica stamps
+#: another silently un-attributes every record.
+_HEADER_LITERAL_NAMES = {"tenant-id": "HEADER_TENANT_ID"}
 
 
 class _Side:
@@ -168,25 +178,32 @@ class ProtocolDriftRule(Rule):
     @staticmethod
     def _in_protocol_plane(path: str) -> bool:
         # Same path-segment classification as _side_of, plus the server
-        # core (which raises the shed CoreErrors the front-ends map).
+        # core (which raises the shed CoreErrors the front-ends map) and
+        # the fleet router (which answers 429s and reads the tenant
+        # header on both planes).
         p = "/" + path.lstrip("/")
         if p.endswith("_literals.py"):
             return False  # the definition site
-        return any(seg in p for seg in ("/http/", "/grpc/", "/server/"))
+        return any(
+            seg in p for seg in ("/http/", "/grpc/", "/server/", "/fleet/")
+        )
 
     def _shed_status_findings(self, ctxs) -> List[Finding]:
-        """Raw 504/499 integer literals in protocol-plane files: the shed
-        status spelled outside protocol/_literals is drift waiting to
-        happen — a client matching 504 while the server starts answering
-        a respelled code is exactly the bug class TPU008 exists for."""
+        """Raw 504/499/429 integer literals — and raw tenant-header
+        strings — in protocol-plane files: the shed/quota vocabulary
+        spelled outside protocol/_literals is drift waiting to happen —
+        a client matching 504 while the server starts answering a
+        respelled code (or a router admitting header X while the replica
+        stamps header Y) is exactly the bug class TPU008 exists for."""
         findings: List[Finding] = []
         for ctx in ctxs:
             if not self._in_protocol_plane(ctx.path):
                 continue
             for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Constant):
+                    continue
                 if (
-                    isinstance(node, ast.Constant)
-                    and type(node.value) is int
+                    type(node.value) is int
                     and node.value in _SHED_STATUS_NAMES
                 ):
                     name = _SHED_STATUS_NAMES[node.value]
@@ -197,6 +214,21 @@ class ProtocolDriftRule(Rule):
                             f"literal; import {name} from "
                             "protocol/_literals so client and server "
                             "cannot drift on the shed status",
+                        )
+                    )
+                elif (
+                    isinstance(node.value, str)
+                    and node.value in _HEADER_LITERAL_NAMES
+                    and not ctx.is_docstring(node)
+                ):
+                    name = _HEADER_LITERAL_NAMES[node.value]
+                    findings.append(
+                        Finding(
+                            self.id, ctx.path, node.lineno, node.col_offset,
+                            f"tenant header {node.value!r} spelled as a "
+                            f"raw literal; import {name} from "
+                            "protocol/_literals so router and replica "
+                            "cannot drift on tenant attribution",
                         )
                     )
         return findings

@@ -1,0 +1,102 @@
+"""Fleet tier: a multi-tenant router over N ``server/_core`` replicas.
+
+Every serving capability before this package lived inside a single
+server process; serving heavy traffic from millions of users needs the
+shared-nothing scale-out mode the shared-facility Triton deployments run
+(arxiv 2312.06838): many tenants, one fleet, fairness enforced at
+admission from ``/metrics`` + perf_analyzer signals. This package is
+that tier — a thin router process speaking the same KServe v2 HTTP and
+gRPC surfaces as the replicas:
+
+* **membership** (``_replica``): replicas join by address; a health
+  prober drives state from ``v2/health/ready`` (readiness detail:
+  draining + in-flight) and ``/metrics`` scrapes (queue depth, oldest
+  request age), with backoff-and-eject for unhealthy replicas and
+  graceful drain for rolling restarts;
+* **balancing** (``_policy``): least-outstanding (default),
+  power-of-two-choices, and round-robin behind one interface, plus
+  rendezvous-hash stream affinity for sticky streams;
+* **admission** (``_admission``): per-tenant token-bucket quotas,
+  concurrency caps, and priority classes keyed by the ``tenant-id``
+  header — over-quota requests answered with a fast 429 /
+  RESOURCE_EXHAUSTED before any replica I/O;
+* **front-ends** (``_http`` / ``_grpc``): the router's own KServe v2
+  surfaces. Inference traffic is balanced (HTTP: byte-level reverse
+  proxy over pooled keep-alive connections; gRPC: raw-bytes passthrough
+  — request protos are never deserialized in the router), admin traffic
+  (shm registration, repository control, trace/log settings) fans out to
+  every ready replica, and ``tenant-id`` / ``traceparent`` / deadline
+  parameters forward untouched so traces and deadlines span
+  router→replica.
+
+``serve.py`` is the replica process entry (one device / mesh partition
+per replica); ``__main__.py`` is the router CLI; ``scripts/fleet_bench.py``
+is the perf gate recording ``FLEET_r01.json``.
+"""
+
+from tritonclient_tpu.fleet._admission import (  # noqa: F401
+    AdmissionController,
+    TenantQuota,
+)
+from tritonclient_tpu.fleet._grpc import RouterGRPCFrontend  # noqa: F401
+from tritonclient_tpu.fleet._http import RouterHTTPFrontend  # noqa: F401
+from tritonclient_tpu.fleet._policy import (  # noqa: F401
+    POLICIES,
+    affinity_select,
+    make_policy,
+)
+from tritonclient_tpu.fleet._replica import (  # noqa: F401
+    Replica,
+    ReplicaSet,
+    ReplicaState,
+)
+from tritonclient_tpu.fleet._router import (  # noqa: F401
+    FleetError,
+    FleetRouter,
+)
+
+
+class FleetServer:
+    """A router hosted behind HTTP and/or gRPC on loopback — the fleet
+    analog of ``server.InferenceServer`` (hermetic fixture + process
+    entry). Ports default to 0 (ephemeral)."""
+
+    def __init__(self, router: FleetRouter, http: bool = True,
+                 grpc: bool = True, host: str = "127.0.0.1",
+                 http_port: int = 0, grpc_port: int = 0):
+        self.router = router
+        self._http = (
+            RouterHTTPFrontend(router, host, http_port) if http else None
+        )
+        self._grpc = (
+            RouterGRPCFrontend(router, host, grpc_port) if grpc else None
+        )
+
+    @property
+    def http_address(self):
+        return self._http.address if self._http else None
+
+    @property
+    def grpc_address(self):
+        return self._grpc.address if self._grpc else None
+
+    def start(self):
+        self.router.start()
+        if self._http:
+            self._http.start()
+        if self._grpc:
+            self._grpc.start()
+        return self
+
+    def stop(self):
+        if self._http:
+            self._http.stop()
+        if self._grpc:
+            self._grpc.stop()
+        self.router.stop()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

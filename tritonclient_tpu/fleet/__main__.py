@@ -1,0 +1,128 @@
+"""Fleet router CLI: ``python -m tritonclient_tpu.fleet``.
+
+Typical two-replica bring-up (each replica launched via
+``python -m tritonclient_tpu.fleet.serve --address-file rN.json``)::
+
+    python -m tritonclient_tpu.fleet \
+        --replica-address-file r0.json --replica-address-file r1.json \
+        --policy least-outstanding --quota hostile=50:100:low \
+        --address-file router.json
+
+Replicas can also be named inline: ``--replica name=HTTP_ADDR[,GRPC_ADDR]``.
+The router probes the fleet once before publishing its own address file,
+so a launcher that waits for the file sees a routable fleet.
+"""
+
+import argparse
+import json
+import signal
+import sys
+import threading
+
+from tritonclient_tpu.fleet import FleetRouter, FleetServer, ReplicaSet
+from tritonclient_tpu.fleet._admission import TenantQuota
+from tritonclient_tpu.fleet._policy import policy_names
+from tritonclient_tpu.fleet.serve import write_address_file
+
+
+def _parse_replica(spec: str):
+    name, _, addrs = spec.partition("=")
+    if not addrs:
+        raise argparse.ArgumentTypeError(
+            "--replica takes name=HTTP_ADDR[,GRPC_ADDR]"
+        )
+    http_addr, _, grpc_addr = addrs.partition(",")
+    return name, http_addr, grpc_addr
+
+
+def _parse_quota(spec: str):
+    tenant, _, quota = spec.partition("=")
+    if not quota:
+        raise argparse.ArgumentTypeError(
+            "--quota takes TENANT=rate[:burst[:priority[:max_outstanding]]]"
+        )
+    try:
+        return tenant, TenantQuota.parse(quota)
+    except (ValueError, IndexError) as e:
+        raise argparse.ArgumentTypeError(f"bad quota spec {spec!r}: {e}")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tritonclient_tpu.fleet",
+        description="Multi-tenant KServe v2 router over N replicas",
+    )
+    parser.add_argument(
+        "--replica", action="append", type=_parse_replica, default=[],
+        metavar="NAME=HTTP[,GRPC]",
+    )
+    parser.add_argument(
+        "--replica-address-file", action="append", default=[],
+        metavar="PATH", help="a fleet.serve --address-file to join",
+    )
+    parser.add_argument("--policy", choices=policy_names(),
+                        default="least-outstanding")
+    parser.add_argument(
+        "--quota", action="append", type=_parse_quota, default=[],
+        metavar="TENANT=RATE[:BURST[:PRIORITY[:MAX_OUT]]]",
+        help="per-tenant token-bucket quota; tenant 'default' covers "
+        "requests without a tenant-id header",
+    )
+    parser.add_argument("--pressure-queue-depth", type=int, default=32)
+    parser.add_argument("--probe-interval", type=float, default=1.0,
+                        metavar="SECONDS")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--http-port", type=int, default=0)
+    parser.add_argument("--grpc-port", type=int, default=0)
+    parser.add_argument("--address-file", default="")
+    args = parser.parse_args(argv)
+
+    replicas = list(args.replica)
+    for path in args.replica_address_file:
+        with open(path) as f:
+            doc = json.load(f)
+        replicas.append((doc["name"], doc["http"], doc.get("grpc") or ""))
+    if not replicas:
+        parser.error("at least one --replica / --replica-address-file")
+
+    replica_set = ReplicaSet(probe_interval_s=args.probe_interval)
+    router = FleetRouter(
+        replicas=replica_set,
+        policy=args.policy,
+        quotas=dict(args.quota),
+        pressure_queue_depth=args.pressure_queue_depth,
+    )
+    for name, http_addr, grpc_addr in replicas:
+        router.add_replica(name, http_addr, grpc_addr)
+    replica_set.probe_once()  # routable before the address file appears
+
+    server = FleetServer(
+        router, host=args.host,
+        http_port=args.http_port, grpc_port=args.grpc_port,
+    )
+    server.start()
+    doc = {
+        "name": "router",
+        "http": server.http_address,
+        "grpc": server.grpc_address,
+        "policy": args.policy,
+        "replicas": [name for name, _h, _g in replicas],
+    }
+    if args.address_file:
+        write_address_file(args.address_file, doc)
+    print(json.dumps(doc), flush=True)
+
+    stop = threading.Event()
+
+    def _terminate(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _terminate)
+    signal.signal(signal.SIGINT, _terminate)
+    stop.wait()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,218 @@
+"""Per-tenant admission at the fleet router.
+
+Quotas are enforced BEFORE any replica I/O — the whole point of a fast
+429 is that a hostile tenant's over-quota traffic costs the fleet one
+token-bucket check, not a queue slot on a replica. Three rejection
+reasons, spelled once in ``protocol/_literals.QUOTA_REASONS``:
+
+* ``rate`` — the tenant's token bucket is empty (sustained rate above
+  its refill rate, burst above its capacity);
+* ``concurrency`` — the tenant already has ``max_outstanding`` requests
+  in flight through the router;
+* ``pressure`` — the fleet is under pressure (every ready replica's
+  scraped queue depth at/above the threshold) and the tenant's priority
+  class is ``low``: best-effort traffic sheds first so paying tenants
+  keep their latency.
+
+The controller is transport-neutral: both router front-ends call
+``admit``/``release`` with the ``tenant-id`` header value. Unknown
+tenants fall to the ``default`` quota (unlimited unless configured).
+"""
+
+import time
+from typing import Dict, Optional, Tuple
+
+from tritonclient_tpu import sanitize
+from tritonclient_tpu.protocol._literals import (
+    QUOTA_REASON_CONCURRENCY,
+    QUOTA_REASON_PRESSURE,
+    QUOTA_REASON_RATE,
+    QUOTA_REASONS,
+)
+
+#: Priority classes, highest first. ``low`` is the only class shed under
+#: fleet pressure; the ordering exists so configs read as a vocabulary.
+PRIORITY_CLASSES = ("high", "normal", "low")
+
+#: The quota key unknown tenants (and requests without a tenant-id
+#: header) resolve to.
+DEFAULT_TENANT = "default"
+
+
+class TenantQuota:
+    """One tenant's admission contract.
+
+    ``rate`` tokens/second refill into a bucket of ``burst`` capacity
+    (rate <= 0 means unlimited rate). ``max_outstanding`` caps in-flight
+    requests through the router (0 = uncapped). ``priority`` is the
+    pressure-shed class.
+    """
+
+    __slots__ = ("rate", "burst", "max_outstanding", "priority")
+
+    def __init__(self, rate: float = 0.0, burst: float = 0.0,
+                 max_outstanding: int = 0, priority: str = "normal"):
+        if priority not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"priority {priority!r} not in {PRIORITY_CLASSES}"
+            )
+        self.rate = float(rate)
+        self.burst = float(burst) if burst else max(float(rate), 1.0)
+        self.max_outstanding = int(max_outstanding)
+        self.priority = priority
+
+    @property
+    def unlimited_rate(self) -> bool:
+        return self.rate <= 0
+
+    def as_dict(self) -> dict:
+        return {
+            "rate": self.rate,
+            "burst": self.burst,
+            "max_outstanding": self.max_outstanding,
+            "priority": self.priority,
+        }
+
+    @classmethod
+    def parse(cls, spec: str) -> "TenantQuota":
+        """``rate[:burst[:priority[:max_outstanding]]]`` — the CLI shape
+        (``--quota tenant=10:20:low``)."""
+        parts = spec.split(":")
+        rate = float(parts[0])
+        burst = float(parts[1]) if len(parts) > 1 and parts[1] else 0.0
+        priority = parts[2] if len(parts) > 2 and parts[2] else "normal"
+        max_outstanding = int(parts[3]) if len(parts) > 3 and parts[3] else 0
+        return cls(rate, burst, max_outstanding, priority)
+
+
+def _check(tenant, quota, now, under_pressure, cost, outstanding,
+           buckets):
+    """The admission decision over CALLER-LOCKED state (``outstanding``
+    and ``buckets`` belong to the controller's lock; this function never
+    touches the controller so the lock discipline stays visible at the
+    one call site)."""
+    if quota is None:
+        return None  # no quota configured anywhere: open admission
+    if under_pressure and quota.priority == "low":
+        return QUOTA_REASON_PRESSURE
+    if quota.max_outstanding and (
+        outstanding.get(tenant, 0) >= quota.max_outstanding
+    ):
+        return QUOTA_REASON_CONCURRENCY
+    if quota.unlimited_rate:
+        return None
+    bucket = buckets.get(tenant)
+    if bucket is None:
+        bucket = buckets[tenant] = [quota.burst, now]
+    tokens, last = bucket
+    tokens = min(quota.burst, tokens + (now - last) * quota.rate)
+    if tokens < cost:
+        bucket[0], bucket[1] = tokens, now
+        return QUOTA_REASON_RATE
+    bucket[0], bucket[1] = tokens - cost, now
+    return None
+
+
+class AdmissionController:
+    """Token buckets + concurrency caps + pressure shed, one lock.
+
+    The hot path (``admit``) does one monotonic read and O(1) arithmetic
+    under the named lock — never I/O, never a nested lock — so a flood
+    of over-quota traffic is answered at memory speed. Rejection
+    counters key ``(tenant, reason)`` and feed the router's
+    ``nv_fleet_tenant_quota_rejections_total`` family.
+    """
+
+    def __init__(self, quotas: Optional[Dict[str, TenantQuota]] = None,
+                 clock=time.monotonic):
+        self._quotas = dict(quotas or {})
+        self._clock = clock
+        # tenant -> [tokens, last_refill_s]; created lazily per tenant.
+        self._buckets: Dict[str, list] = {}
+        self._outstanding: Dict[str, int] = {}
+        self._rejections: Dict[Tuple[str, str], int] = {}
+        self._admitted: Dict[str, int] = {}
+        self._lock = sanitize.named_lock("fleet.AdmissionController._lock")
+
+    # -- config ---------------------------------------------------------------
+
+    def set_quota(self, tenant: str, quota: TenantQuota):
+        with self._lock:
+            self._quotas[tenant] = quota
+            self._buckets.pop(tenant, None)  # restart from the new burst
+
+    def quota_for(self, tenant: str) -> Optional[TenantQuota]:
+        with self._lock:
+            return self._quotas.get(tenant) or self._quotas.get(
+                DEFAULT_TENANT
+            )
+
+    # -- admission ------------------------------------------------------------
+
+    def admit(self, tenant: str, under_pressure: bool = False,
+              cost: float = 1.0) -> Optional[str]:
+        """Admit one request for ``tenant``; returns None (admitted, the
+        caller MUST pair with ``release``) or the rejection reason."""
+        tenant = tenant or DEFAULT_TENANT
+        now = self._clock()
+        with self._lock:
+            quota = self._quotas.get(tenant) or self._quotas.get(
+                DEFAULT_TENANT
+            )
+            reason = _check(
+                tenant, quota, now, under_pressure, cost,
+                self._outstanding, self._buckets,
+            )
+            if reason is None:
+                self._outstanding[tenant] = (
+                    self._outstanding.get(tenant, 0) + 1
+                )
+                self._admitted[tenant] = self._admitted.get(tenant, 0) + 1
+            else:
+                self._rejections[(tenant, reason)] = (
+                    self._rejections.get((tenant, reason), 0) + 1
+                )
+                # Seen-tenant registration: the metrics family renders
+                # every canonical reason row per tenant it has seen.
+                self._admitted.setdefault(tenant, 0)
+        return reason
+
+    def release(self, tenant: str):
+        """The completion half of a successful ``admit``."""
+        tenant = tenant or DEFAULT_TENANT
+        with self._lock:
+            count = self._outstanding.get(tenant, 0)
+            if count > 0:
+                self._outstanding[tenant] = count - 1
+
+    # -- introspection --------------------------------------------------------
+
+    def rejection_counts(self) -> Dict[str, Dict[str, int]]:
+        """{tenant: {reason: count}} with EVERY canonical reason present
+        per seen tenant (zeros included) — the stable-label-set contract
+        the metrics checker enforces for the quota family."""
+        with self._lock:
+            tenants = set(self._admitted) | {t for t, _ in self._rejections}
+            return {
+                tenant: {
+                    reason: self._rejections.get((tenant, reason), 0)
+                    for reason in QUOTA_REASONS
+                }
+                for tenant in sorted(tenants)
+            }
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "quotas": {
+                    t: q.as_dict() for t, q in sorted(self._quotas.items())
+                },
+                "outstanding": {
+                    t: n for t, n in sorted(self._outstanding.items()) if n
+                },
+                "admitted": dict(sorted(self._admitted.items())),
+                "rejections": {
+                    f"{t}:{r}": n
+                    for (t, r), n in sorted(self._rejections.items())
+                },
+            }

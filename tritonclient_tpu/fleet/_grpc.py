@@ -1,0 +1,348 @@
+"""gRPC front-end of the fleet router: raw-bytes passthrough.
+
+The router never deserializes ``ModelInferRequest`` protos — admission
+needs only the ``tenant-id`` invocation metadata and balancing needs
+only the method — so forwarded messages cross the router as opaque
+bytes (identity serializers on both the inbound handler and the
+outbound multicallable). That keeps the router's per-request cost to a
+metadata walk plus one channel write, and guarantees deadline
+parameters and trace context inside the proto forward bit-exact.
+
+Sticky streams: a ``ModelStreamInfer`` stream leases one replica at
+open (rendezvous-hashed when the client sends a
+``stream-affinity-key``/tenant, policy-balanced otherwise) and pipes
+messages both ways until either side closes; the stream holds one
+outstanding-lease for its lifetime.
+
+Fleet-level surfaces (``ServerLive``/``ServerReady``) answer locally
+with typed protos; shared-nothing admin RPCs (shm registration,
+repository control, trace/log settings) fan out to every ready replica.
+"""
+
+from concurrent import futures
+from typing import Dict, Optional, Tuple
+
+import grpc
+
+from tritonclient_tpu import sanitize
+from tritonclient_tpu.fleet._router import FleetError, FleetRouter
+from tritonclient_tpu.protocol import pb
+from tritonclient_tpu.protocol._literals import (
+    HEADER_TENANT_ID,
+    STATUS_OVER_QUOTA,
+)
+from tritonclient_tpu.protocol._service import FULL_SERVICE_NAME, RPC_METHODS
+
+_MAX_MESSAGE_LENGTH = 2**31 - 1
+
+#: Invocation-metadata key selecting the replica a stream sticks to
+#: (rendezvous-hashed); absent, the stream falls back to the tenant id,
+#: then to the balancing policy.
+HEADER_STREAM_AFFINITY = "stream-affinity-key"
+
+#: Metadata keys forwarded router -> replica (same allowlist as the HTTP
+#: proxy): tenant accounting, W3C trace context, request-id tagging.
+_FORWARD_METADATA_KEYS = (
+    HEADER_TENANT_ID,
+    "traceparent",
+    "triton-request-id",
+)
+
+#: RPCs whose effect is per-replica state every ready replica needs.
+_FAN_OUT_METHODS = frozenset({
+    "SystemSharedMemoryRegister",
+    "SystemSharedMemoryUnregister",
+    "TpuSharedMemoryRegister",
+    "TpuSharedMemoryUnregister",
+    "RepositoryModelLoad",
+    "RepositoryModelUnload",
+    "TraceSetting",
+    "LogSettings",
+})
+
+
+def _ident(payload: bytes) -> bytes:
+    return payload
+
+
+def _code_for(e: FleetError) -> grpc.StatusCode:
+    if e.status == STATUS_OVER_QUOTA:
+        return grpc.StatusCode.RESOURCE_EXHAUSTED
+    if e.status in (502, 503):
+        return grpc.StatusCode.UNAVAILABLE
+    return grpc.StatusCode.UNKNOWN
+
+
+def _call_metadata(context) -> Dict[str, str]:
+    try:
+        pairs = context.invocation_metadata()
+    except Exception:
+        return {}
+    return {k: v for k, v in pairs or ()}
+
+
+def _forward_metadata(meta: Dict[str, str]) -> Tuple:
+    return tuple(
+        (k, meta[k]) for k in _FORWARD_METADATA_KEYS if k in meta
+    )
+
+
+#: time_remaining() values above this are "no deadline" (gRPC reports
+#: INT64_MAX seconds; forwarding it overflows the outbound deadline
+#: arithmetic into an already-expired deadline).
+_NO_DEADLINE_S = 3600.0 * 24 * 365
+
+
+def _deadline(context) -> Optional[float]:
+    remaining = context.time_remaining()
+    if remaining is None or remaining <= 0 or remaining > _NO_DEADLINE_S:
+        return None
+    return remaining
+
+
+class _ReplicaChannels:
+    """One lazily opened channel per replica address, with per-method
+    raw-bytes multicallables cached beside it."""
+
+    def __init__(self):
+        self._lock = sanitize.named_lock("fleet._ReplicaChannels._lock")
+        self._channels: Dict[str, tuple] = {}
+
+    def _entry(self, address: str):
+        with self._lock:
+            entry = self._channels.get(address)
+        if entry is not None:
+            return entry
+        channel = grpc.insecure_channel(
+            address,
+            options=[
+                ("grpc.max_send_message_length", _MAX_MESSAGE_LENGTH),
+                ("grpc.max_receive_message_length", _MAX_MESSAGE_LENGTH),
+            ],
+        )
+        with self._lock:
+            # A racing opener wins; close the loser outside the lock.
+            entry = self._channels.get(address)
+            if entry is None:
+                entry = (channel, {})
+                self._channels[address] = entry
+                channel = None
+        if channel is not None:
+            channel.close()
+        return entry
+
+    def unary(self, address: str, method: str):
+        channel, calls = self._entry(address)
+        call = calls.get(method)
+        if call is None:
+            call = calls[method] = channel.unary_unary(
+                f"/{FULL_SERVICE_NAME}/{method}",
+                request_serializer=_ident,
+                response_deserializer=_ident,
+            )
+        return call
+
+    def stream(self, address: str, method: str):
+        channel, calls = self._entry(address)
+        key = ("stream", method)
+        call = calls.get(key)
+        if call is None:
+            call = calls[key] = channel.stream_stream(
+                f"/{FULL_SERVICE_NAME}/{method}",
+                request_serializer=_ident,
+                response_deserializer=_ident,
+            )
+        return call
+
+    def close(self):
+        with self._lock:
+            channels = [c for c, _ in self._channels.values()]
+            self._channels.clear()
+        for channel in channels:
+            channel.close()
+
+
+def make_router_handler(router: FleetRouter,
+                        channels: _ReplicaChannels) -> grpc.GenericRpcHandler:
+    """The router's GRPCInferenceService: typed local health, raw-bytes
+    forwarding for everything else."""
+
+    def server_live(request, context):
+        return pb.ServerLiveResponse(live=True)
+
+    def server_ready(request, context):
+        return pb.ServerReadyResponse(ready=router.ready())
+
+    def drain(request, context):
+        context.abort(
+            grpc.StatusCode.UNIMPLEMENTED,
+            "drain a NAMED replica through the router's HTTP admin "
+            "surface (POST v2/fleet/replicas/{name}/drain); the gRPC "
+            "Drain RPC is a replica-level control",
+        )
+
+    def model_infer(request: bytes, context):
+        meta = _call_metadata(context)
+        tenant = meta.get(HEADER_TENANT_ID, "")
+        try:
+            lease = router.begin(tenant)
+        except FleetError as e:
+            context.abort(_code_for(e), str(e))
+        fwd = _forward_metadata(meta)
+        try:
+            reply = channels.unary(
+                lease.replica.grpc_address, "ModelInfer"
+            )(request, metadata=fwd, timeout=_deadline(context))
+        except grpc.RpcError as e:
+            code = e.code()
+            lease.release(failed=True)
+            if code == grpc.StatusCode.UNAVAILABLE:
+                # Transport-level failure: the request never reached a
+                # handler, so one retry on a different replica is safe
+                # (fresh admission charge, like the HTTP proxy).
+                try:
+                    retry = router.begin(
+                        tenant, exclude=(lease.replica.name,)
+                    )
+                except FleetError as fe:
+                    context.abort(_code_for(fe), str(fe))
+                try:
+                    reply = channels.unary(
+                        retry.replica.grpc_address, "ModelInfer"
+                    )(request, metadata=fwd, timeout=_deadline(context))
+                except grpc.RpcError as re:
+                    retry.release(failed=True)
+                    context.abort(re.code(), re.details())
+                retry.release()
+                return reply
+            context.abort(code, e.details())
+        lease.release()
+        return reply
+
+    def model_stream_infer(request_iterator, context):
+        meta = _call_metadata(context)
+        tenant = meta.get(HEADER_TENANT_ID, "")
+        affinity = meta.get(HEADER_STREAM_AFFINITY, "") or tenant
+        try:
+            lease = router.begin(tenant, affinity_key=affinity)
+        except FleetError as e:
+            context.abort(_code_for(e), str(e))
+        fwd = _forward_metadata(meta)
+        call = channels.stream(
+            lease.replica.grpc_address, "ModelStreamInfer"
+        )(request_iterator, metadata=fwd, timeout=_deadline(context))
+        # Client cancellation tears down the downstream stream too, so
+        # the replica's stream-cancel event fires and queued work sheds.
+        context.add_callback(call.cancel)
+        try:
+            for message in call:
+                yield message
+        except grpc.RpcError as e:
+            lease.release(failed=True)
+            context.abort(e.code(), e.details())
+        finally:
+            lease.release()
+
+    def forward(name: str):
+        fan_out = name in _FAN_OUT_METHODS
+
+        def handler(request: bytes, context, _name=name,
+                    _fan_out=fan_out):
+            meta = _call_metadata(context)
+            fwd = _forward_metadata(meta)
+            timeout = _deadline(context)
+            try:
+                if not _fan_out:
+                    replica = router.pick_any()
+                    return channels.unary(
+                        replica.grpc_address, _name
+                    )(request, metadata=fwd, timeout=timeout)
+                replicas = router.replica_set.routable()
+                if not replicas:
+                    raise FleetError("no ready replicas in the fleet", 503)
+                reply = b""
+                for replica in replicas:
+                    reply = channels.unary(
+                        replica.grpc_address, _name
+                    )(request, metadata=fwd, timeout=timeout)
+                return reply
+            except FleetError as e:
+                context.abort(_code_for(e), str(e))
+            except grpc.RpcError as e:
+                context.abort(e.code(), e.details())
+
+        return handler
+
+    handlers = {
+        "ServerLive": grpc.unary_unary_rpc_method_handler(
+            server_live,
+            request_deserializer=pb.ServerLiveRequest.FromString,
+            response_serializer=pb.ServerLiveResponse.SerializeToString,
+        ),
+        "ServerReady": grpc.unary_unary_rpc_method_handler(
+            server_ready,
+            request_deserializer=pb.ServerReadyRequest.FromString,
+            response_serializer=pb.ServerReadyResponse.SerializeToString,
+        ),
+        "Drain": grpc.unary_unary_rpc_method_handler(
+            drain,
+            request_deserializer=_ident,
+            response_serializer=_ident,
+        ),
+        "ModelInfer": grpc.unary_unary_rpc_method_handler(
+            model_infer,
+            request_deserializer=_ident,
+            response_serializer=_ident,
+        ),
+        "ModelStreamInfer": grpc.stream_stream_rpc_method_handler(
+            model_stream_infer,
+            request_deserializer=_ident,
+            response_serializer=_ident,
+        ),
+    }
+    for name, (kind, _req, _resp) in RPC_METHODS.items():
+        if name in handlers or kind != "unary":
+            continue
+        handlers[name] = grpc.unary_unary_rpc_method_handler(
+            forward(name),
+            request_deserializer=_ident,
+            response_serializer=_ident,
+        )
+    return grpc.method_handlers_generic_handler(
+        FULL_SERVICE_NAME, handlers
+    )
+
+
+class RouterGRPCFrontend:
+    """gRPC front-end hosting a FleetRouter (thread-pool transport; each
+    long-lived proxied stream pins one pool thread)."""
+
+    def __init__(self, router: FleetRouter, host: str = "127.0.0.1",
+                 port: int = 0, max_workers: int = 80):
+        self._host = host
+        self._channels = _ReplicaChannels()
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(
+                max_workers=max_workers, thread_name_prefix="fleet-grpc"
+            ),
+            options=[
+                ("grpc.max_send_message_length", _MAX_MESSAGE_LENGTH),
+                ("grpc.max_receive_message_length", _MAX_MESSAGE_LENGTH),
+            ],
+        )
+        self._server.add_generic_rpc_handlers(
+            [make_router_handler(router, self._channels)]
+        )
+        self._port = self._server.add_insecure_port(f"{host}:{port}")
+
+    @property
+    def address(self) -> str:
+        return f"{self._host}:{self._port}"
+
+    def start(self):
+        self._server.start()
+        return self
+
+    def stop(self, grace: Optional[float] = 0.5):
+        self._server.stop(grace)
+        self._channels.close()

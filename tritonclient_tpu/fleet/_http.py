@@ -1,0 +1,368 @@
+"""HTTP front-end of the fleet router.
+
+Speaks the same KServe v2 REST surface as the replicas so existing
+clients point at the router unchanged. The hot path is a byte-level
+reverse proxy: the request body is never JSON-parsed in the router —
+admission needs only the ``tenant-id`` header, balancing needs only the
+route — so the router's per-request Python cost stays a small fraction
+of a replica's parse+compute cost (the aggregate-throughput condition).
+
+Routing table:
+
+* ``/metrics``, health, ``v2/fleet/*`` — answered by the ROUTER
+  (fleet-level metrics/health/admin);
+* ``v2/models/{m}[/versions/{v}]/infer`` POST — admission + balance +
+  proxy to the leased replica (tenant-id / traceparent / deadline
+  parameters forward untouched);
+* shared-memory admin, repository load/unload, trace/log settings —
+  fanned out to EVERY ready replica (shared-nothing replicas each need
+  the registration);
+* everything else — proxied to one ready replica.
+
+Connections to replicas are pooled keep-alive ``http.client``
+connections; a transport failure mid-proxy retries once on a different
+replica when the request never reached processing.
+"""
+
+import json
+import socket
+import threading
+from http.client import HTTPConnection
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from tritonclient_tpu import sanitize
+from tritonclient_tpu.fleet._replica import Replica
+from tritonclient_tpu.fleet._router import FleetError, FleetRouter
+from tritonclient_tpu.protocol._literals import (
+    EP_FLEET_STATUS,
+    EP_HEALTH_LIVE,
+    EP_HEALTH_READY,
+    EP_LOGGING,
+    EP_METRICS,
+    EP_TRACE_SETTING,
+    FLEET_REPLICA_ROUTE_RE,
+    HEADER_TENANT_ID,
+    MODEL_ROUTE_RE,
+    REPOSITORY_ROUTE_RE,
+    SHM_ROUTE_RE,
+)
+
+#: Request headers the proxy forwards verbatim (everything else is
+#: hop-by-hop or recomputed). Lowercase.
+_FORWARD_REQUEST_HEADERS = (
+    "content-type",
+    "content-encoding",
+    "accept-encoding",
+    "inference-header-content-length",
+    HEADER_TENANT_ID,
+    "traceparent",
+    "triton-request-id",
+)
+
+#: Response headers relayed back to the caller.
+_FORWARD_RESPONSE_HEADERS = (
+    "content-type",
+    "content-encoding",
+    "inference-header-content-length",
+)
+
+
+class _ConnPool:
+    """Keep-alive connections to replicas, pooled per address. The pool
+    lock guards the free lists only — never the sockets: a connection is
+    checked out, used outside the lock, and returned (or dropped) after.
+    """
+
+    def __init__(self, timeout_s: float = 30.0, per_address: int = 32):
+        self._timeout_s = timeout_s
+        self._per_address = per_address
+        self._free: Dict[str, List[HTTPConnection]] = {}
+        self._lock = sanitize.named_lock("fleet._ConnPool._lock")
+
+    def get(self, address: str) -> HTTPConnection:
+        with self._lock:
+            free = self._free.get(address)
+            if free:
+                return free.pop()
+        host, _, port = address.partition(":")
+        return HTTPConnection(host, int(port or 80),
+                              timeout=self._timeout_s)
+
+    def put(self, address: str, conn: HTTPConnection):
+        with self._lock:
+            free = self._free.setdefault(address, [])
+            if len(free) < self._per_address:
+                free.append(conn)
+                return
+        conn.close()
+
+    def close(self):
+        with self._lock:
+            conns = [c for free in self._free.values() for c in free]
+            self._free.clear()
+        for conn in conns:
+            conn.close()
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "triton-tpu-fleet"
+
+    def log_message(self, fmt, *args):
+        if getattr(self.server, "verbose", False):
+            super().log_message(fmt, *args)
+
+    @property
+    def router(self) -> FleetRouter:
+        return self.server.router
+
+    @property
+    def pool(self) -> _ConnPool:
+        return self.server.pool
+
+    # -- plumbing -------------------------------------------------------------
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(length) if length else b""
+
+    def _send(self, status: int, body: bytes,
+              content_type: str = "application/json",
+              extra: Optional[dict] = None):
+        try:
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra or {}).items():
+                self.send_header(k, str(v))
+            self.end_headers()
+            if body:
+                self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # caller disconnected; nothing left to tell them
+
+    def _send_json(self, obj, status: int = 200):
+        body = json.dumps(obj).encode() if obj is not None else b""
+        self._send(status, body)
+
+    def _send_fleet_error(self, e: FleetError):
+        self._send_json({"error": str(e)}, e.status)
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def _dispatch(self, method: str):
+        try:
+            self._route(method)
+        except FleetError as e:
+            self._send_fleet_error(e)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:  # noqa: BLE001 — a bug fails the request
+            self._send_json({"error": f"router error: {e}"}, 500)
+
+    # -- proxy ----------------------------------------------------------------
+
+    def _forward_headers(self, body: bytes) -> dict:
+        headers = {}
+        for name in _FORWARD_REQUEST_HEADERS:
+            value = self.headers.get(name)
+            if value is not None:
+                headers[name] = value
+        headers["Content-Length"] = str(len(body))
+        return headers
+
+    def _exchange(self, address: str, method: str, body: bytes,
+                  headers: dict) -> Tuple[int, dict, bytes]:
+        """One proxied exchange over a pooled connection. Transport
+        failures close the connection and re-raise (the caller decides
+        whether a retry is safe)."""
+        conn = self.pool.get(address)
+        try:
+            conn.request(method, self.path, body=body, headers=headers)
+            resp = conn.getresponse()
+            payload = resp.read()
+            relay = {
+                k: resp.headers[k]
+                for k in _FORWARD_RESPONSE_HEADERS
+                if resp.headers.get(k) is not None
+            }
+            status = resp.status
+        except (OSError, socket.timeout):
+            conn.close()
+            raise
+        self.pool.put(address, conn)
+        return status, relay, payload
+
+    def _relay(self, status: int, relay_headers: dict, payload: bytes):
+        ctype = relay_headers.pop("content-type", "application/json")
+        self._send(status, payload, content_type=ctype,
+                   extra=relay_headers)
+
+    def _proxy_one(self, replica: Replica, method: str, body: bytes):
+        status, relay, payload = self._exchange(
+            replica.http_address, method, body, self._forward_headers(body)
+        )
+        self._relay(status, relay, payload)
+        return status
+
+    # -- routes ---------------------------------------------------------------
+
+    def _route(self, method: str):
+        path = self.path.split("?", 1)[0].strip("/")
+        router = self.router
+
+        # Router-local surfaces first (no body expected on the GETs, but
+        # drain/undrain POSTs carry options — read lazily per branch).
+        if path == EP_METRICS and method == "GET":
+            return self._send(
+                200, router.prometheus_metrics().encode(),
+                content_type="text/plain; version=0.0.4; charset=utf-8",
+            )
+        if path == EP_HEALTH_LIVE:
+            self._read_body()
+            return self._send(200, b"")
+        if path == EP_HEALTH_READY:
+            self._read_body()
+            ready = router.ready()
+            routable = len(router.replica_set.routable())
+            return self._send_json(
+                {"ready": ready, "routable_replicas": routable},
+                200 if ready else 400,
+            )
+        if path == EP_FLEET_STATUS:
+            self._read_body()
+            return self._send_json(router.status())
+        m = FLEET_REPLICA_ROUTE_RE.match(path)
+        if m and method == "POST":
+            body = self._read_body()
+            options = json.loads(body) if body else {}
+            name = m.group("replica")
+            try:
+                if m.group("action") == "drain":
+                    detail = router.drain_replica(
+                        name, wait_s=float(options.get("wait_s", 30.0))
+                    )
+                else:
+                    detail = router.undrain_replica(name)
+            except KeyError as e:
+                return self._send_json({"error": str(e)}, 404)
+            except TimeoutError as e:
+                # Admin-operation timeout (drain did not settle), NOT the
+                # request-shed status — a plain 500 keeps the shed
+                # vocabulary unambiguous.
+                return self._send_json({"error": str(e)}, 500)
+            return self._send_json(detail)
+
+        body = self._read_body()
+
+        # Inference: admission + balance + proxy (the hot path).
+        m = MODEL_ROUTE_RE.match(path)
+        if m and m.group("action") == "infer" and method == "POST":
+            return self._infer(body)
+
+        # Shared-nothing admin state: every ready replica needs it.
+        if SHM_ROUTE_RE.match(path) or REPOSITORY_ROUTE_RE.match(path) or (
+            method == "POST" and (
+                path == EP_LOGGING
+                or path == EP_TRACE_SETTING
+                or (m and m.group("action") == "trace/setting")
+            )
+        ):
+            if (
+                SHM_ROUTE_RE.match(path)
+                and SHM_ROUTE_RE.match(path).group("action") == "status"
+            ):
+                return self._proxy_one(router.pick_any(), method, body)
+            return self._fan_out(method, body)
+
+        # Everything else (metadata, config, stats, flight recorder,
+        # readiness of a model, repository index): any ready replica.
+        self._proxy_one(router.pick_any(), method, body)
+
+    def _fan_out(self, method: str, body: bytes):
+        """Forward to EVERY ready replica; first failure wins the reply
+        (the caller must see that the fleet is not uniformly configured),
+        else the last response is relayed."""
+        replicas = self.router.replica_set.routable()
+        if not replicas:
+            raise FleetError("no ready replicas in the fleet", 503)
+        last = None
+        for replica in replicas:
+            status, relay, payload = self._exchange(
+                replica.http_address, method, body,
+                self._forward_headers(body),
+            )
+            if status >= 400:
+                return self._relay(status, relay, payload)
+            last = (status, relay, payload)
+        return self._relay(*last)
+
+    def _infer(self, body: bytes):
+        tenant = self.headers.get(HEADER_TENANT_ID, "")
+        router = self.router
+        lease = router.begin(tenant)  # FleetError 429/503 -> _dispatch
+        try:
+            status = self._proxy_one(lease.replica, "POST", body)
+        except (OSError, socket.timeout):
+            # The replica died under us before answering. Release the
+            # failed lease and retry ONCE on a different replica — a
+            # fresh admission charge, deliberately conservative (a
+            # retry is a second unit of offered load).
+            lease.release(failed=True)
+            retry = router.begin(tenant, exclude=(lease.replica.name,))
+            try:
+                status = self._proxy_one(retry.replica, "POST", body)
+            except (OSError, socket.timeout) as e:
+                retry.release(failed=True)
+                raise FleetError(
+                    f"replica {retry.replica.name} unreachable: {e}", 502
+                )
+            retry.release(failed=status >= 500)
+            return
+        lease.release(failed=status >= 500)
+
+
+class _RouterHTTPServer(ThreadingHTTPServer):
+    # Same accept-burst headroom as the replica front-end.
+    request_queue_size = 128
+
+
+class RouterHTTPFrontend:
+    """Threaded HTTP server hosting a FleetRouter."""
+
+    def __init__(self, router: FleetRouter, host: str = "127.0.0.1",
+                 port: int = 0, verbose: bool = False):
+        self._server = _RouterHTTPServer((host, port), _RouterHandler)
+        self._server.router = router
+        self._server.pool = _ConnPool()
+        self._server.verbose = verbose
+        self._server.daemon_threads = True
+        self._server.socket.setsockopt(
+            socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+        )
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"{host}:{port}"
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="fleet-http-frontend",
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+        self._server.pool.close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,124 @@
+"""Load-balancing policies behind one interface.
+
+A policy chooses one replica out of the routable candidates for a unary
+request or a new stream. All policies are cheap (O(candidates)) and
+stateless apart from deterministic counters — the *signal* (per-replica
+outstanding requests, scraped queue depth) lives on the ``Replica``
+records the router passes in, so policies compose with any membership
+source.
+
+Stream affinity is deliberately NOT a policy subclass: stickiness is a
+keyed transform (``affinity_select``) layered over whichever policy
+handles keyless traffic, so "tenant X's streams land on one replica"
+and "everything else balances least-outstanding" coexist.
+"""
+
+import hashlib
+import random
+from typing import List, Optional, Sequence
+
+
+class Policy:
+    """One replica out of ``candidates`` (never empty; router guarantees)."""
+
+    name = "policy"
+
+    def select(self, candidates: Sequence):
+        raise NotImplementedError
+
+
+class LeastOutstanding(Policy):
+    """The replica with the fewest router-tracked outstanding requests,
+    breaking ties on the scraped queue depth, then on lifetime request
+    count (so an idle fleet rotates instead of piling sequential traffic
+    onto the name-first replica). The default: outstanding count is the
+    router's freshest local signal — scrapes lag by a probe interval,
+    but the lease counter is exact."""
+
+    name = "least-outstanding"
+
+    def select(self, candidates: Sequence):
+        return min(
+            candidates,
+            key=lambda r: (
+                r.outstanding, r.queue_depth, r.requests_total, r.name,
+            ),
+        )
+
+
+class PowerOfTwoChoices(Policy):
+    """Sample two distinct replicas, keep the less loaded one.
+
+    The classic load/communication trade: with stale load signals,
+    full-scan least-loaded herds onto whichever replica last scraped
+    empty; two random choices cut the herd while staying within a
+    constant factor of optimal imbalance. Deterministically seeded so
+    tests replay.
+    """
+
+    name = "p2c"
+
+    def __init__(self, seed: int = 0x5EED):
+        self._rng = random.Random(seed)
+
+    def select(self, candidates: Sequence):
+        if len(candidates) == 1:
+            return candidates[0]
+        a, b = self._rng.sample(list(candidates), 2)
+        return min((a, b), key=lambda r: (r.outstanding, r.queue_depth))
+
+
+class RoundRobin(Policy):
+    """Strict rotation over the candidate list (sorted by name so the
+    rotation is stable under membership churn)."""
+
+    name = "round-robin"
+
+    def __init__(self):
+        self._next = 0
+
+    def select(self, candidates: Sequence):
+        ordered = sorted(candidates, key=lambda r: r.name)
+        choice = ordered[self._next % len(ordered)]
+        self._next += 1
+        return choice
+
+
+POLICIES = {
+    LeastOutstanding.name: LeastOutstanding,
+    PowerOfTwoChoices.name: PowerOfTwoChoices,
+    RoundRobin.name: RoundRobin,
+}
+
+
+def make_policy(name: str) -> Policy:
+    try:
+        return POLICIES[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown balancing policy '{name}' (have: "
+            f"{', '.join(sorted(POLICIES))})"
+        ) from None
+
+
+def affinity_select(candidates: Sequence, key: str) -> Optional[object]:
+    """Rendezvous (highest-random-weight) hash of ``key`` over the
+    candidates: every router instance maps the same key to the same
+    replica, and losing a replica remaps ONLY the keys that lived on it
+    (no mod-N reshuffle). Returns None for an empty key so the caller
+    falls through to its keyless policy."""
+    if not key or not candidates:
+        return None
+    best: Optional[object] = None
+    best_weight = b""
+    for replica in candidates:
+        weight = hashlib.blake2b(
+            f"{key}\x00{replica.name}".encode(), digest_size=8
+        ).digest()
+        if best is None or weight > best_weight:
+            best, best_weight = replica, weight
+    return best
+
+
+def policy_names() -> List[str]:
+    return sorted(POLICIES)

@@ -1,0 +1,369 @@
+"""Replica membership for the fleet router.
+
+Replicas are separate ``server/_core`` processes (one device / mesh
+partition each) known by address. A prober thread drives their state
+from the signals the observability plane already exposes:
+
+* ``GET v2/health/ready`` — the readiness verdict plus the readiness
+  detail document (``draining``, ``in_flight``) PR 8 added for exactly
+  this consumer;
+* ``GET /metrics`` — ``nv_inference_queue_depth`` (summed over models)
+  and ``nv_inference_oldest_request_age_us`` (max), the
+  backlog-vs-stall discriminator pair.
+
+State machine::
+
+    JOINING --probe ok--> READY --failures>=eject_after--> EJECTED
+       ^                    |                                 |
+       |                 drain()                       backoff elapses,
+       |                    v                           probe ok -> READY
+       +--undrain()--- DRAINING --in_flight==0--> DRAINED
+
+Probe I/O always runs OUTSIDE the set lock (the lock guards membership
+and counters only, never the network), so a hung replica cannot wedge
+routing for the healthy ones.
+"""
+
+import json
+import re
+import threading
+import time
+from http.client import HTTPConnection
+from typing import Dict, List, Optional
+
+from tritonclient_tpu import sanitize
+from tritonclient_tpu.protocol._literals import (
+    EP_FLEET_DRAIN,
+    EP_HEALTH_READY,
+    EP_METRICS,
+)
+
+
+class ReplicaState:
+    JOINING = "joining"
+    READY = "ready"
+    DRAINING = "draining"
+    DRAINED = "drained"
+    EJECTED = "ejected"
+
+
+_QUEUE_DEPTH_RE = re.compile(
+    r"^nv_inference_queue_depth(?:\{[^}]*\})? ([0-9.eE+-]+)", re.M
+)
+_OLDEST_AGE_RE = re.compile(
+    r"^nv_inference_oldest_request_age_us(?:\{[^}]*\})? ([0-9.eE+-]+)", re.M
+)
+
+
+class Replica:
+    """One replica's identity + live signals (owned by a ReplicaSet;
+    counters mutate only under the set lock)."""
+
+    def __init__(self, name: str, http_address: str,
+                 grpc_address: str = ""):
+        self.name = name
+        self.http_address = http_address
+        self.grpc_address = grpc_address
+        self.state = ReplicaState.JOINING
+        # Router-local signal: requests leased to this replica right now.
+        self.outstanding = 0
+        # Scraped signals (lag by one probe interval).
+        self.queue_depth = 0
+        self.oldest_age_us = 0
+        self.in_flight = 0  # replica-reported, from the readiness detail
+        self.consecutive_failures = 0
+        self.ejections = 0
+        self.backoff_until_s = 0.0
+        self.requests_total = 0
+        self.failures_total = 0
+        self.last_error = ""
+
+    @property
+    def routable(self) -> bool:
+        return self.state == ReplicaState.READY
+
+    def as_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "http_address": self.http_address,
+            "grpc_address": self.grpc_address,
+            "state": self.state,
+            "outstanding": self.outstanding,
+            "queue_depth": self.queue_depth,
+            "oldest_age_us": self.oldest_age_us,
+            "in_flight": self.in_flight,
+            "consecutive_failures": self.consecutive_failures,
+            "requests_total": self.requests_total,
+            "failures_total": self.failures_total,
+            "last_error": self.last_error,
+        }
+
+
+def http_call(address: str, method: str, path: str,
+              body: Optional[bytes] = None, timeout_s: float = 5.0,
+              headers: Optional[dict] = None):
+    """One short-lived HTTP exchange with a replica (probe / drain
+    control). Returns (status, body bytes); raises OSError-family on
+    transport failure. Deliberately connection-per-call: probes are low
+    rate, and a pooled connection to a dying replica is exactly the
+    stale resource a prober must not trust."""
+    host, _, port = address.partition(":")
+    conn = HTTPConnection(host, int(port or 80), timeout=timeout_s)
+    try:
+        conn.request(method, "/" + path.lstrip("/"), body=body,
+                     headers=headers or {})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+class ReplicaSet:
+    """Membership + health-driven state for a set of replicas."""
+
+    def __init__(self, probe_interval_s: float = 1.0,
+                 eject_after: int = 3, backoff_base_s: float = 0.5,
+                 backoff_max_s: float = 30.0,
+                 probe_timeout_s: float = 2.0,
+                 clock=time.monotonic):
+        self.probe_interval_s = float(probe_interval_s)
+        self.eject_after = int(eject_after)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self._clock = clock
+        self._replicas: Dict[str, Replica] = {}
+        self._lock = sanitize.named_lock("fleet.ReplicaSet._lock")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- membership -----------------------------------------------------------
+
+    def add(self, name: str, http_address: str,
+            grpc_address: str = "") -> Replica:
+        replica = Replica(name, http_address, grpc_address)
+        with self._lock:
+            if name in self._replicas:
+                raise ValueError(f"replica '{name}' already registered")
+            self._replicas[name] = replica
+        return replica
+
+    def remove(self, name: str):
+        with self._lock:
+            self._replicas.pop(name, None)
+
+    def get(self, name: str) -> Optional[Replica]:
+        with self._lock:
+            return self._replicas.get(name)
+
+    def replicas(self) -> List[Replica]:
+        with self._lock:
+            return sorted(self._replicas.values(), key=lambda r: r.name)
+
+    def routable(self) -> List[Replica]:
+        with self._lock:
+            return sorted(
+                (r for r in self._replicas.values() if r.routable),
+                key=lambda r: r.name,
+            )
+
+    # -- lease counters -------------------------------------------------------
+
+    def acquire(self, replica: Replica):
+        with self._lock:
+            replica.outstanding += 1
+            replica.requests_total += 1
+
+    def release(self, replica: Replica, failed: bool = False):
+        with self._lock:
+            if replica.outstanding > 0:
+                replica.outstanding -= 1
+            if failed:
+                replica.failures_total += 1
+
+    # -- probing --------------------------------------------------------------
+
+    def probe_once(self):
+        """Probe every replica once (I/O outside the lock), then apply
+        the observations. Callable directly for deterministic tests; the
+        background prober loops it."""
+        now = self._clock()
+        with self._lock:
+            targets = [
+                r for r in self._replicas.values()
+                if not (
+                    r.state == ReplicaState.EJECTED
+                    and now < r.backoff_until_s
+                ) and r.state != ReplicaState.DRAINED
+            ]
+        for replica in targets:
+            observation = self._probe(replica)
+            self._apply(replica, observation)
+
+    def _probe(self, replica: Replica) -> dict:
+        try:
+            status, body = http_call(
+                replica.http_address, "GET", EP_HEALTH_READY,
+                timeout_s=self.probe_timeout_s,
+            )
+            detail = {}
+            if body:
+                try:
+                    detail = json.loads(body)
+                except ValueError:
+                    detail = {}
+            observation = {
+                "ok": True,
+                "ready": status == 200,
+                "draining": bool(detail.get("draining", False)),
+                "in_flight": int(detail.get("in_flight", 0) or 0),
+            }
+        except (OSError, ValueError) as e:
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        # Metrics scrape rides the same probe tick; a scrape hiccup is
+        # not a health failure (readiness already answered).
+        try:
+            _, metrics = http_call(
+                replica.http_address, "GET", EP_METRICS,
+                timeout_s=self.probe_timeout_s,
+            )
+            text = metrics.decode("utf-8", errors="replace")
+            observation["queue_depth"] = int(sum(
+                float(v) for v in _QUEUE_DEPTH_RE.findall(text)
+            ))
+            ages = [float(v) for v in _OLDEST_AGE_RE.findall(text)]
+            observation["oldest_age_us"] = int(max(ages)) if ages else 0
+        except (OSError, ValueError):
+            pass
+        return observation
+
+    def _apply(self, replica: Replica, obs: dict):
+        now = self._clock()
+        with self._lock:
+            if not obs["ok"]:
+                replica.consecutive_failures += 1
+                replica.last_error = obs.get("error", "")
+                if replica.state in (
+                    ReplicaState.READY, ReplicaState.JOINING,
+                ) and replica.consecutive_failures >= self.eject_after:
+                    replica.state = ReplicaState.EJECTED
+                    replica.ejections += 1
+                    replica.backoff_until_s = now + min(
+                        self.backoff_base_s * (2 ** (replica.ejections - 1)),
+                        self.backoff_max_s,
+                    )
+                elif replica.state == ReplicaState.EJECTED:
+                    # Failed the post-backoff retry: back off further.
+                    replica.ejections += 1
+                    replica.backoff_until_s = now + min(
+                        self.backoff_base_s * (2 ** (replica.ejections - 1)),
+                        self.backoff_max_s,
+                    )
+                return
+            replica.consecutive_failures = 0
+            replica.last_error = ""
+            replica.in_flight = obs.get("in_flight", replica.in_flight)
+            if "queue_depth" in obs:
+                replica.queue_depth = obs["queue_depth"]
+            if "oldest_age_us" in obs:
+                replica.oldest_age_us = obs["oldest_age_us"]
+            if replica.state == ReplicaState.DRAINING:
+                if replica.in_flight == 0 and replica.outstanding == 0:
+                    replica.state = ReplicaState.DRAINED
+                return
+            if obs["draining"]:
+                # Drained out-of-band (operator hit the replica's drain
+                # endpoint directly): stop routing, track settlement.
+                replica.state = ReplicaState.DRAINING
+            elif obs["ready"]:
+                replica.state = ReplicaState.READY
+                replica.ejections = 0
+            else:
+                # Alive but declining traffic: not routable, not a fault.
+                replica.state = ReplicaState.JOINING
+
+    # -- drain ----------------------------------------------------------------
+
+    def drain(self, name: str, wait_s: float = 30.0,
+              poll_s: float = 0.05) -> dict:
+        """Gracefully drain one replica: stop routing to it, flip its
+        readiness (so any OTHER balancer stops too), then wait for every
+        in-flight request — router-leased and replica-reported — to
+        finish. Returns the replica's final detail document."""
+        with self._lock:
+            replica = self._replicas.get(name)
+            if replica is None:
+                raise KeyError(f"unknown replica '{name}'")
+            replica.state = ReplicaState.DRAINING
+        status, body = http_call(
+            replica.http_address, "POST", EP_FLEET_DRAIN,
+            body=json.dumps({"drain": True}).encode(),
+            timeout_s=self.probe_timeout_s,
+        )
+        detail = json.loads(body) if body else {}
+        deadline = self._clock() + wait_s
+        while self._clock() < deadline:
+            with self._lock:
+                outstanding = replica.outstanding
+                replica.in_flight = int(detail.get("in_flight", 0) or 0)
+                settled = outstanding == 0 and replica.in_flight == 0
+                if settled:
+                    replica.state = ReplicaState.DRAINED
+            if settled:
+                return detail
+            # Deliberately-sync settle poll: drain runs on admin/prober
+            # threads, never on an event loop.
+            time.sleep(poll_s)  # tpulint: disable=TPU001
+            _, body = http_call(
+                replica.http_address, "GET", EP_HEALTH_READY,
+                timeout_s=self.probe_timeout_s,
+            )
+            detail = json.loads(body) if body else {}
+        raise TimeoutError(
+            f"replica '{name}' did not settle within {wait_s}s "
+            f"(outstanding={replica.outstanding}, "
+            f"in_flight={detail.get('in_flight')})"
+        )
+
+    def undrain(self, name: str) -> dict:
+        """Re-admit a drained replica: clear its drain flag, then let the
+        normal probe path flip it READY once it reports ready (the
+        immediate probe below makes that synchronous when healthy)."""
+        with self._lock:
+            replica = self._replicas.get(name)
+            if replica is None:
+                raise KeyError(f"unknown replica '{name}'")
+            replica.state = ReplicaState.JOINING
+        _, body = http_call(
+            replica.http_address, "POST", EP_FLEET_DRAIN,
+            body=json.dumps({"drain": False}).encode(),
+            timeout_s=self.probe_timeout_s,
+        )
+        self._apply(replica, self._probe(replica))
+        return json.loads(body) if body else {}
+
+    # -- prober lifecycle -----------------------------------------------------
+
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="fleet-health-prober"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.probe_once()
+            except Exception:  # a probe bug must not kill membership
+                pass
+            self._stop.wait(self.probe_interval_s)

@@ -1,0 +1,227 @@
+"""The router core: membership + policy + admission behind one object.
+
+Both router front-ends (``_http``, ``_grpc``) drive inference traffic
+through the same three steps — admit the tenant, lease a replica, release
+the lease on completion — so quota accounting, outstanding counts, and
+the ``/metrics`` families cannot diverge between transports.
+"""
+
+from typing import Dict, Optional, Union
+
+from tritonclient_tpu import sanitize
+from tritonclient_tpu.fleet._admission import AdmissionController, TenantQuota
+from tritonclient_tpu.fleet._policy import Policy, affinity_select, make_policy
+from tritonclient_tpu.fleet._replica import Replica, ReplicaSet
+from tritonclient_tpu.protocol._literals import (
+    QUOTA_REASONS,
+    STATUS_OVER_QUOTA,
+)
+
+ROUTER_NAME = "triton-tpu-fleet"
+
+
+class FleetError(Exception):
+    """Router-side error with an HTTP-ish status hint (the fleet analog
+    of ``CoreError``). ``reason`` carries the quota-rejection reason for
+    429s so front-ends can label without string-parsing."""
+
+    def __init__(self, msg: str, status: int = 500,
+                 reason: Optional[str] = None):
+        super().__init__(msg)
+        self.status = status
+        self.reason = reason
+
+
+class _Lease:
+    """One admitted, routed request: pairs an admission slot with a
+    replica's outstanding count. ``release`` is idempotent so error
+    paths can release defensively."""
+
+    __slots__ = ("_router", "replica", "tenant", "_done")
+
+    def __init__(self, router: "FleetRouter", replica: Replica,
+                 tenant: str):
+        self._router = router
+        self.replica = replica
+        self.tenant = tenant
+        self._done = False
+
+    def release(self, failed: bool = False):
+        if self._done:
+            return
+        self._done = True
+        self._router._set.release(self.replica, failed=failed)
+        self._router.admission.release(self.tenant)
+
+
+class FleetRouter:
+    """Route unary requests and sticky streams across N replicas."""
+
+    def __init__(self, replicas: Optional[ReplicaSet] = None,
+                 policy: Union[str, Policy] = "least-outstanding",
+                 quotas: Optional[Dict[str, TenantQuota]] = None,
+                 admission: Optional[AdmissionController] = None,
+                 pressure_queue_depth: int = 32):
+        self._set = replicas if replicas is not None else ReplicaSet()
+        self.policy = (
+            policy if isinstance(policy, Policy) else make_policy(policy)
+        )
+        self.admission = admission or AdmissionController(quotas)
+        # Fleet-pressure threshold: with EVERY routable replica's scraped
+        # queue depth at/above this, low-priority tenants shed at
+        # admission (reason=pressure).
+        self.pressure_queue_depth = int(pressure_queue_depth)
+        # Policy selection is not thread-safe by contract (round-robin
+        # counters, p2c RNG); one small named lock serializes it.
+        self._policy_lock = sanitize.named_lock(
+            "fleet.FleetRouter._policy_lock"
+        )
+
+    # -- membership passthrough ----------------------------------------------
+
+    @property
+    def replica_set(self) -> ReplicaSet:
+        return self._set
+
+    def add_replica(self, name: str, http_address: str,
+                    grpc_address: str = "") -> Replica:
+        return self._set.add(name, http_address, grpc_address)
+
+    def drain_replica(self, name: str, wait_s: float = 30.0) -> dict:
+        return self._set.drain(name, wait_s=wait_s)
+
+    def undrain_replica(self, name: str) -> dict:
+        return self._set.undrain(name)
+
+    def start(self):
+        self._set.start()
+        return self
+
+    def stop(self):
+        self._set.stop()
+
+    # -- routing --------------------------------------------------------------
+
+    def ready(self) -> bool:
+        return bool(self._set.routable())
+
+    def under_pressure(self) -> bool:
+        routable = self._set.routable()
+        return bool(routable) and all(
+            r.queue_depth >= self.pressure_queue_depth for r in routable
+        )
+
+    def begin(self, tenant: str = "", affinity_key: str = "",
+              exclude=()) -> _Lease:
+        """Admit + lease for one request/stream; raises FleetError 429
+        (over quota) or 503 (no routable replicas). The caller MUST
+        ``release()`` the lease when the forwarded work completes.
+        ``exclude`` names replicas a retry must avoid (the one that just
+        failed)."""
+        reason = self.admission.admit(
+            tenant, under_pressure=self.under_pressure()
+        )
+        if reason is not None:
+            raise FleetError(
+                f"tenant '{tenant or 'default'}' over quota ({reason})",
+                STATUS_OVER_QUOTA, reason=reason,
+            )
+        candidates = [
+            r for r in self._set.routable() if r.name not in exclude
+        ]
+        if not candidates:
+            self.admission.release(tenant)
+            raise FleetError("no ready replicas in the fleet", 503)
+        replica = affinity_select(candidates, affinity_key)
+        if replica is None:
+            with self._policy_lock:
+                replica = self.policy.select(candidates)
+        self._set.acquire(replica)
+        return _Lease(self, replica, tenant)
+
+    def pick_any(self) -> Replica:
+        """A ready replica for non-inference traffic (metadata, stats,
+        flight-recorder dumps): least-outstanding without admission."""
+        candidates = self._set.routable()
+        if not candidates:
+            raise FleetError("no ready replicas in the fleet", 503)
+        return min(candidates, key=lambda r: (r.outstanding, r.name))
+
+    # -- introspection --------------------------------------------------------
+
+    def status(self) -> dict:
+        return {
+            "kind": "fleet_status",
+            "name": ROUTER_NAME,
+            "policy": self.policy.name,
+            "ready": self.ready(),
+            "under_pressure": self.under_pressure(),
+            "replicas": [r.as_dict() for r in self._set.replicas()],
+            "admission": self.admission.status(),
+        }
+
+    def prometheus_metrics(self) -> str:
+        """The router's own exposition: fleet membership, per-replica
+        outstanding, and per-tenant quota rejections. Same exposition
+        discipline as the replicas' /metrics (validated by
+        scripts/check_metrics_exposition.py): stable label sets, every
+        canonical reason row rendered per seen tenant."""
+        def esc(v: str) -> str:
+            return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                    .replace("\n", "\\n"))
+
+        replicas = self._set.replicas()
+        lines = []
+        metric = "nv_fleet_replica_up"
+        lines.append(
+            f"# HELP {metric} Whether the fleet router considers a "
+            "replica routable (1 = ready)"
+        )
+        lines.append(f"# TYPE {metric} gauge")
+        for r in replicas:
+            lines.append(
+                f'{metric}{{replica="{esc(r.name)}"}} '
+                f"{1 if r.routable else 0}"
+            )
+        metric = "nv_fleet_replica_outstanding"
+        lines.append(
+            f"# HELP {metric} Requests currently leased to a replica by "
+            "the router (streams count one for their lifetime)"
+        )
+        lines.append(f"# TYPE {metric} gauge")
+        for r in replicas:
+            lines.append(
+                f'{metric}{{replica="{esc(r.name)}"}} {r.outstanding}'
+            )
+        metric = "nv_fleet_replica_queue_depth"
+        lines.append(
+            f"# HELP {metric} Last scraped dynamic-batcher queue depth "
+            "per replica (summed over models)"
+        )
+        lines.append(f"# TYPE {metric} gauge")
+        for r in replicas:
+            lines.append(
+                f'{metric}{{replica="{esc(r.name)}"}} {r.queue_depth}'
+            )
+        metric = "nv_fleet_requests_total"
+        lines.append(
+            f"# HELP {metric} Requests routed to a replica by the router"
+        )
+        lines.append(f"# TYPE {metric} counter")
+        for r in replicas:
+            lines.append(
+                f'{metric}{{replica="{esc(r.name)}"}} {r.requests_total}'
+            )
+        metric = "nv_fleet_tenant_quota_rejections_total"
+        lines.append(
+            f"# HELP {metric} Requests rejected at per-tenant admission, "
+            "by reason"
+        )
+        lines.append(f"# TYPE {metric} counter")
+        for tenant, reasons in self.admission.rejection_counts().items():
+            for reason in QUOTA_REASONS:
+                lines.append(
+                    f'{metric}{{tenant="{esc(tenant)}"'
+                    f',reason="{reason}"}} {reasons[reason]}'
+                )
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,139 @@
+"""Replica process entry: one ``server/_core`` process per device.
+
+The fleet tier is shared-nothing — each replica is its own process
+owning one device / mesh partition. This module is what the bench, the
+smoke tests, and operators launch per replica::
+
+    python -m tritonclient_tpu.fleet.serve --address-file /tmp/r0.json \
+        --model-set fleet --service-ms 25
+
+Ports default to 0 (ephemeral); the bound addresses are published
+atomically to ``--address-file`` as ``{"name", "http", "grpc", "pid"}``
+so launchers never race the bind.
+
+``FleetDeviceModel`` is the fleet bench's replica-capacity stand-in: an
+identity model whose execution serializes on a single device slot (one
+batch at a time, ``service_ms`` per execution) — the way a real
+accelerator serializes launches — without burning host CPU. On a
+CPU-only bench host that is what makes per-replica capacity additive
+across replica PROCESSES, so the 2-replica aggregate-throughput gate
+measures routing, not GIL contention inside one interpreter. The
+``--model-set fleet`` set is deliberately jax-free: replica cold-start
+is a process spawn plus imports, no backend init.
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+
+from tritonclient_tpu.models._base import Model, TensorSpec
+
+
+class FleetDeviceModel(Model):
+    """Identity INT32 [-1,16] whose executions serialize on one device
+    slot for ``service_ms`` each — a replica-capacity model, not a
+    compute model."""
+
+    name = "fleet_device"
+    platform = "fleet"
+    # Real waits in infer(): must never run inline on an event loop.
+    blocking = True
+
+    def __init__(self, service_ms: float = 25.0):
+        super().__init__()
+        self.service_ms = float(service_ms)
+        self.inputs = [TensorSpec("INPUT", "INT32", [-1, 16])]
+        self.outputs = [TensorSpec("OUTPUT", "INT32", [-1, 16])]
+        # One execution slot, like one accelerator: a semaphore (not a
+        # lock) because the holder BLOCKS in it by design — this is the
+        # modeled device time, not a critical section over shared state.
+        self._slot = threading.BoundedSemaphore(1)
+
+    def infer(self, inputs, parameters=None):
+        with self._slot:
+            # Modeled device execution time (deliberate; see class doc).
+            time.sleep(self.service_ms / 1000.0)  # tpulint: disable=TPU001
+        return {"OUTPUT": np.asarray(inputs["INPUT"], dtype=np.int32)}
+
+
+def build_models(model_set: str, service_ms: float):
+    if model_set == "fleet":
+        return [FleetDeviceModel(service_ms)]
+    from tritonclient_tpu.server import default_models
+
+    models = default_models()
+    if model_set == "all":
+        models.append(FleetDeviceModel(service_ms))
+    return models
+
+
+def write_address_file(path: str, doc: dict):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="fleet.serve",
+        description="Run one fleet replica (an InferenceCore behind "
+        "HTTP + gRPC) as its own process",
+    )
+    parser.add_argument("--name", default=f"replica-{os.getpid()}")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--http-port", type=int, default=0)
+    parser.add_argument("--grpc-port", type=int, default=0)
+    parser.add_argument(
+        "--model-set", choices=["fleet", "default", "all"], default="fleet",
+        help="'fleet' = the jax-free capacity model only (fast start); "
+        "'default' = the reference model matrix; 'all' = both",
+    )
+    parser.add_argument(
+        "--service-ms", type=float,
+        default=float(os.environ.get("FLEET_SERVICE_MS", "25")),
+        help="modeled device time per fleet_device execution",
+    )
+    parser.add_argument(
+        "--address-file", default="",
+        help="publish bound addresses here as JSON (atomic)",
+    )
+    args = parser.parse_args(argv)
+
+    from tritonclient_tpu.server import InferenceServer
+
+    server = InferenceServer(
+        models=build_models(args.model_set, args.service_ms),
+        host=args.host, http_port=args.http_port, grpc_port=args.grpc_port,
+    )
+    server.start()
+    doc = {
+        "name": args.name,
+        "http": server.http_address,
+        "grpc": server.grpc_address,
+        "pid": os.getpid(),
+    }
+    if args.address_file:
+        write_address_file(args.address_file, doc)
+    print(json.dumps(doc), flush=True)
+
+    stop = threading.Event()
+
+    def _terminate(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _terminate)
+    signal.signal(signal.SIGINT, _terminate)
+    stop.wait()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

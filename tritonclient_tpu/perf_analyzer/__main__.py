@@ -66,6 +66,19 @@ def main(argv=None):
              "(EDF + admission control); shed responses are reported per "
              "window as a shed rate next to the queue/compute split",
     )
+    parser.add_argument(
+        "--tenant-id", default="", metavar="TENANT",
+        help="inject this tenant-id header on every request (HTTP header "
+             "/ gRPC metadata) so the sweep drives a fleet router's "
+             "per-tenant admission; 429s are reported per window as a "
+             "quota-rejection rate, apart from errors and sheds",
+    )
+    parser.add_argument(
+        "--tenant-mix", default="", metavar="a:5,b:1",
+        help="weighted multi-tenant load: requests cycle through the "
+             "named tenants in weight proportion (the hostile-mix "
+             "instrument for fleet_bench)",
+    )
     parser.add_argument("--device-id", type=int, default=0)
     parser.add_argument(
         "--shm-mesh-devices", type=int, default=0, metavar="N",
@@ -99,6 +112,16 @@ def main(argv=None):
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
 
+    tenant_mix = {}
+    for part in filter(None, args.tenant_mix.split(",")):
+        tenant, _, weight = part.partition(":")
+        try:
+            tenant_mix[tenant] = int(weight) if weight else 1
+        except ValueError:
+            parser.error(f"--tenant-mix weight {weight!r} is not an int")
+    if args.tenant_id and tenant_mix:
+        parser.error("--tenant-id and --tenant-mix are mutually exclusive")
+
     shm_mesh = None
     if args.shm_mesh_devices:
         if args.shm_mesh_devices < 1:
@@ -126,6 +149,10 @@ def main(argv=None):
             parser.error("--request-timeout-us is not supported with "
                          "--native-driver (the native loop does not "
                          "attach request parameters)")
+        if args.tenant_id or tenant_mix:
+            parser.error("--tenant-id/--tenant-mix are not supported with "
+                         "--native-driver (the native loop does not "
+                         "attach headers)")
         if args.shared_memory != "none":
             parser.error("--native-driver supports wire mode only "
                          "(--shared-memory=none)")
@@ -169,6 +196,13 @@ def main(argv=None):
             shm_mesh=shm_mesh,
             trace_out=args.trace_out,
             request_timeout_us=args.request_timeout_us,
+            tenant_id=args.tenant_id,
+            tenant_mix=tenant_mix or None,
+            # Tenant injection on streams is stream-scoped: each worker
+            # must own its stream for the mix to hold (see PerfAnalyzer).
+            shared_stream=not (
+                args.streaming and (args.tenant_id or tenant_mix)
+            ),
             verbose=args.verbose,
         )
         results = analyzer.sweep(start, end, step)
@@ -194,6 +228,16 @@ def main(argv=None):
                 + (
                     f", sheds: {r['sheds']} (rate {r['shed_rate']})"
                     if r.get("sheds") else ""
+                )
+                + (
+                    f", quota rejections: {r['quota_rejections']} "
+                    f"(rate {r['quota_rejection_rate']}"
+                    + (
+                        f", reject p99 {r['reject_p99_us']} usec"
+                        if "reject_p99_us" in r else ""
+                    )
+                    + ")"
+                    if r.get("quota_rejections") else ""
                 )
             )
             if "send_p50_us" in r:

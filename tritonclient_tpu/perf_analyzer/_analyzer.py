@@ -26,8 +26,10 @@ from tritonclient_tpu.perf_analyzer._stats import (
     InferStat,
     MeasurementWindow,
     RequestTimers,
+    is_quota_error,
     is_shed_error,
 )
+from tritonclient_tpu.protocol._literals import HEADER_TENANT_ID
 from tritonclient_tpu.utils import (
     serialize_byte_tensor,
     triton_to_np_dtype,
@@ -184,6 +186,9 @@ class _Worker:
         self.recv_ns: List[int] = []
         self.errors = 0
         self.sheds = 0  # deadline sheds (--request-timeout-us), not errors
+        self.quota_rejections = 0  # fleet-router 429s, not errors either
+        self.reject_latencies: List[int] = []
+        self.tenant_latencies: Dict[str, List[int]] = {}
         self._stop = threading.Event()
         self._client = None
         self._done = None  # streaming response queue (lives across windows)
@@ -459,6 +464,40 @@ class _Worker:
         if handle is not None:
             self.analyzer.client_spans.finish(handle, timers)
 
+    def _classify_failure(self, error, timers: RequestTimers):
+        """Route one failed request into quota-rejection / shed / error
+        counters (quota first: a 429 is neither a shed nor a failure)."""
+        if is_quota_error(error):
+            self.quota_rejections += 1
+            # The 429's own latency IS the signal: fleet_bench gates on
+            # rejects answering in single-digit milliseconds.
+            self.reject_latencies.append(
+                time.monotonic_ns() - timers.request_start
+            )
+        elif is_shed_error(error):
+            self.sheds += 1
+        else:
+            self.errors += 1
+
+    def _tenant_for(self, i: int) -> str:
+        """This worker's tenant for its i-th request: the weighted cycle
+        offset by worker id so every worker walks the same mix but out of
+        phase (a:5,b:1 stays 5:1 at every concurrency)."""
+        cycle = self.analyzer.tenant_cycle
+        if not cycle:
+            return ""
+        return cycle[(self.wid + i) % len(cycle)]
+
+    def _record_success(self, tenant: str, timers: RequestTimers):
+        self.stat.update(timers)
+        self.latencies.append(timers.total_ns)
+        self.send_ns.append(timers.send_ns)
+        self.recv_ns.append(timers.recv_ns)
+        if tenant:
+            self.tenant_latencies.setdefault(tenant, []).append(
+                timers.total_ns
+            )
+
     def _run_sync(self, end_time: float):
         a = self.analyzer
         i = 0
@@ -466,6 +505,8 @@ class _Worker:
         timeout_us = a.request_timeout_us or None
         while time.perf_counter() < end_time and not self._stop.is_set():
             payloads = self.payload_sets[i % _RANDOM_POOL]
+            tenant = self._tenant_for(i)
+            headers = {HEADER_TENANT_ID: tenant} if tenant else None
             i += 1
             timers = RequestTimers()
             timers.capture("request_start")
@@ -476,27 +517,28 @@ class _Worker:
                 timers.capture("send_end")
                 result = self._client.infer(
                     a.model_name, inputs, outputs=outputs, traceparent=tp,
-                    timeout=timeout_us,
+                    timeout=timeout_us, headers=headers,
                 )
                 timers.capture("recv_start")
                 if a.read_outputs:
                     self._consume_outputs(result)
                 timers.capture("recv_end")
             except Exception as e:
-                if is_shed_error(e):
-                    self.sheds += 1
-                else:
-                    self.errors += 1
+                self._classify_failure(e, timers)
                 continue
             timers.capture("request_end")
             self._span_finish(span, timers)
-            self.stat.update(timers)
-            self.latencies.append(timers.total_ns)
-            self.send_ns.append(timers.send_ns)
-            self.recv_ns.append(timers.recv_ns)
+            self._record_success(tenant, timers)
 
     def _ensure_stream(self):
-        """Start the long-lived bidi stream once; survives across windows."""
+        """Start the long-lived bidi stream once; survives across windows.
+
+        Tenant injection on streams is stream-scoped (gRPC metadata is
+        per-call): a worker's whole stream belongs to its cycle tenant,
+        so a weighted mix allocates WORKERS to tenants — which requires
+        per-worker streams (the analyzer rejects tenant + shared-stream
+        mux at construction).
+        """
         import queue
 
         if self._done is None:
@@ -505,8 +547,10 @@ class _Worker:
                 self.mux.ensure_stream()
             else:
                 self._done = queue.Queue()
+                tenant = self._tenant_for(0)
                 self._client.start_stream(
-                    callback=lambda result, error: self._done.put((result, error))
+                    callback=lambda result, error: self._done.put((result, error)),
+                    headers={HEADER_TENANT_ID: tenant} if tenant else None,
                 )
 
     def _run_streaming(self, end_time: float):
@@ -570,26 +614,17 @@ class _Worker:
                 result, error = done.get(timeout=120)
                 if error is not None:
                     timers.capture("recv_end")
-                    if is_shed_error(error):
-                        self.sheds += 1
-                    else:
-                        self.errors += 1
+                    self._classify_failure(error, timers)
                     continue
                 if a.read_outputs:
                     self._consume_outputs(result)
                 timers.capture("recv_end")
             except Exception as e:
-                if is_shed_error(e):
-                    self.sheds += 1
-                else:
-                    self.errors += 1
+                self._classify_failure(e, timers)
                 continue
             timers.capture("request_end")
             self._span_finish(span, timers)
-            self.stat.update(timers)
-            self.latencies.append(timers.total_ns)
-            self.send_ns.append(timers.send_ns)
-            self.recv_ns.append(timers.recv_ns)
+            self._record_success(self._tenant_for(0), timers)
 
 
 class _WindowWorker:
@@ -908,6 +943,9 @@ class MeasurementSession:
             w.stat = InferStat()
             w.errors = 0
             w.sheds = 0
+            w.quota_rejections = 0
+            w.reject_latencies.clear()
+            w.tenant_latencies.clear()
         # Server-side statistics snapshot at the warmup cut; the post-join
         # snapshot closes the window and the delta becomes the server
         # queue/compute breakdown in summary().
@@ -929,6 +967,12 @@ class MeasurementSession:
             window.recv_ns.extend(w.recv_ns)
             window.errors += w.errors
             window.sheds += w.sheds
+            window.quota_rejections += w.quota_rejections
+            window.reject_latencies_ns.extend(w.reject_latencies)
+            for tenant, samples in w.tenant_latencies.items():
+                window.tenant_latencies_ns.setdefault(tenant, []).extend(
+                    samples
+                )
             window.stat.completed_request_count += w.stat.completed_request_count
             window.stat.cumulative_total_request_time_ns += (
                 w.stat.cumulative_total_request_time_ns
@@ -1064,6 +1108,8 @@ class PerfAnalyzer:
         collect_server_stats: bool = True,
         trace_out: Optional[str] = None,
         request_timeout_us: int = 0,
+        tenant_id: str = "",
+        tenant_mix: Optional[Dict[str, int]] = None,
         verbose: bool = False,
     ):
         if protocol not in ("grpc", "http"):
@@ -1101,6 +1147,31 @@ class PerfAnalyzer:
         # responses (fast 504 / DEADLINE_EXCEEDED) are counted per window
         # as `sheds`/`shed_rate`, apart from errors.
         self.request_timeout_us = int(request_timeout_us)
+        # Tenant injection (--tenant-id / --tenant-mix "a:5,b:1"): each
+        # request carries the tenant-id header so a sweep can drive a
+        # fleet router's per-tenant admission. The cycle expands weights
+        # (a,a,a,a,a,b) and workers walk it offset by worker id, so the
+        # offered mix holds at every concurrency; 429s are counted per
+        # window as quota_rejections, apart from errors AND sheds.
+        if tenant_id and tenant_mix:
+            raise ValueError("pass tenant_id or tenant_mix, not both")
+        self.tenant_cycle: List[str] = []
+        if tenant_id:
+            self.tenant_cycle = [tenant_id]
+        elif tenant_mix:
+            for tenant in sorted(tenant_mix):
+                weight = int(tenant_mix[tenant])
+                if weight < 1:
+                    raise ValueError(
+                        f"tenant_mix weight for '{tenant}' must be >= 1"
+                    )
+                self.tenant_cycle.extend([tenant] * weight)
+        if self.tenant_cycle and streaming and shared_stream:
+            raise ValueError(
+                "tenant injection on streams is stream-scoped (gRPC "
+                "metadata is per-call): use shared_stream=False so each "
+                "worker owns a stream, or drop --streaming"
+            )
         self.read_outputs = read_outputs
         # Reference perf_analyzer semantics for --shared-memory: input
         # buffers are written into the region ONCE at setup and every

@@ -56,6 +56,27 @@ class InferStat:
         self.cumulative_receive_time_ns += timers.recv_ns
 
 
+def is_quota_error(error) -> bool:
+    """Is this client-side error a fleet-router quota rejection (HTTP
+    429 / gRPC RESOURCE_EXHAUSTED / the router's over-quota message)?
+
+    Like sheds, quota rejections are the admission path WORKING — under
+    a hostile ``--tenant-mix`` they are reported per window as a rate,
+    classified apart from both failures and deadline sheds.
+    """
+    status = getattr(error, "status", None)
+    if callable(status):
+        try:
+            status = status()
+        except Exception:
+            status = None
+    if status is not None:
+        s = str(status)
+        if "429" in s or "RESOURCE_EXHAUSTED" in s:
+            return True
+    return "over quota" in str(error)
+
+
 def is_shed_error(error) -> bool:
     """Is this client-side error a deadline shed (server 504 / gRPC
     DEADLINE_EXCEEDED / the batcher's shed message on a stream)?
@@ -114,6 +135,17 @@ class MeasurementWindow:
     # — counted apart from errors: under --request-timeout-us a shed is
     # the deadline path doing its job, not a failure of the sweep.
     sheds: int = 0
+    # Requests rejected at fleet-router admission (fast 429 /
+    # RESOURCE_EXHAUSTED) — the third class, apart from both errors and
+    # sheds: under --tenant-mix a rejection is quota enforcement working.
+    quota_rejections: int = 0
+    # Client-observed latency of each 429 (the "fast" in fast 429 is a
+    # gate: fleet_bench asserts reject p99 < 5 ms).
+    reject_latencies_ns: List[int] = field(default_factory=list)
+    # Per-tenant latency samples (populated when tenants are injected):
+    # the fairness instrument — the in-quota tenant's p99 under a
+    # hostile mix is read from here.
+    tenant_latencies_ns: Dict[str, List[int]] = field(default_factory=dict)
     stat: InferStat = field(default_factory=InferStat)
     # Per-request send/receive samples (for percentile reporting, not just
     # the cumulative means InferStat carries).
@@ -143,7 +175,9 @@ class MeasurementWindow:
         avg = sum(lat) / len(lat) if lat else 0
         send = sorted(self.send_ns)
         recv = sorted(self.recv_ns)
-        attempted = len(lat) + self.errors + self.sheds
+        attempted = (
+            len(lat) + self.errors + self.sheds + self.quota_rejections
+        )
         out = {
             "concurrency": self.concurrency,
             "count": len(lat),
@@ -153,6 +187,12 @@ class MeasurementWindow:
             # queue/compute split below.
             "sheds": self.sheds,
             "shed_rate": round(self.sheds / attempted, 4) if attempted else 0.0,
+            # Quota-rejection rate per window: the admission-path signal
+            # beside the shed rate (429s are not failures).
+            "quota_rejections": self.quota_rejections,
+            "quota_rejection_rate": round(
+                self.quota_rejections / attempted, 4
+            ) if attempted else 0.0,
             "throughput_infer_per_sec": round(self.throughput, 2),
             "latency_avg_us": int(avg / 1000),
             **{
@@ -178,6 +218,10 @@ class MeasurementWindow:
                 for p in percentiles
             },
         }
+        if self.reject_latencies_ns:
+            rl = sorted(self.reject_latencies_ns)
+            out["reject_p50_us"] = int(percentile(rl, 50) / 1000)
+            out["reject_p99_us"] = int(percentile(rl, 99) / 1000)
         if self.server_stats is not None:
             s = self.server_stats
             # Per-request server-side averages over the window's delta: the
@@ -190,6 +234,22 @@ class MeasurementWindow:
             for key in ("queue", "compute_input", "compute_infer",
                         "compute_output"):
                 out[f"server_{key}_us"] = int(s.get(f"{key}_ns", 0) / n / 1000)
+        return out
+
+    def tenant_summary(self, percentiles=(50, 90, 99)) -> Dict[str, Dict]:
+        """Per-tenant latency rows for this window (empty unless tenants
+        were injected). Keys mirror ``summary()``'s percentile fields so
+        fairness gates read both the same way."""
+        out: Dict[str, Dict] = {}
+        for tenant, samples in sorted(self.tenant_latencies_ns.items()):
+            s = sorted(samples)
+            out[tenant] = {
+                "count": len(s),
+                **{
+                    f"latency_p{p}_us": int(percentile(s, p) / 1000)
+                    for p in percentiles
+                },
+            }
         return out
 
 

@@ -125,6 +125,36 @@ SHED_REASONS = (
     SHED_REASON_CANCELLED,
 )
 
+# --------------------------------------------------------------------------- #
+# multi-tenant fleet vocabulary                                               #
+# --------------------------------------------------------------------------- #
+
+#: HTTP header / gRPC invocation-metadata key naming the tenant a request
+#: belongs to. The fleet router keys token-bucket quotas and priority
+#: classes on it; the replicas stamp it onto ``CoreRequest.tenant`` and
+#: the flight recorder so fairness regressions attribute to a tenant.
+#: Spelled here exactly once (enforced by TPU008): a router admitting
+#: header X while the replica stamps header Y silently un-attributes
+#: every record.
+HEADER_TENANT_ID = "tenant-id"
+
+#: HTTP status of a request rejected at the fleet router's per-tenant
+#: admission (token-bucket exhausted, concurrency cap, or priority
+#: pressure-shed). The gRPC plane maps it to ``RESOURCE_EXHAUSTED``.
+#: Like STATUS_SHED it is answered *fast* — before any replica I/O.
+STATUS_OVER_QUOTA = 429
+
+#: ``reason`` label values of the router's
+#: ``nv_fleet_tenant_quota_rejections_total`` counter.
+QUOTA_REASON_RATE = "rate"
+QUOTA_REASON_CONCURRENCY = "concurrency"
+QUOTA_REASON_PRESSURE = "pressure"
+QUOTA_REASONS = (
+    QUOTA_REASON_RATE,
+    QUOTA_REASON_CONCURRENCY,
+    QUOTA_REASON_PRESSURE,
+)
+
 #: Server-internal parameter key carrying a request's ``cancel_event``
 #: into engine-backed models (gpt/tp engines poll it between decode
 #: steps). Never on the wire: the front-ends strip/never accept it, and
@@ -178,6 +208,16 @@ EP_TRACE_SETTING = "v2/trace/setting"
 #: sliding window plus every error/deadline miss. ``?format=perfetto``
 #: renders the retained records as Chrome trace-event JSON.
 EP_FLIGHT_RECORDER = "v2/debug/flight_recorder"
+#: Replica drain control (fleet tier): POST ``{"drain": true|false}``;
+#: draining flips ``v2/health/ready`` to 400 (stop new admissions) while
+#: in-flight requests finish. The response — and GETs of
+#: ``v2/health/ready`` — carry the readiness-detail document
+#: ``{"ready", "draining", "in_flight"}`` the router polls to know when
+#: a drain has settled.
+EP_FLEET_DRAIN = "v2/fleet/drain"
+#: Router-side fleet status document (replica states, outstanding counts,
+#: admission counters). Served by the ROUTER, not the replicas.
+EP_FLEET_STATUS = "v2/fleet/status"
 #: Prometheus exposition (Triton serves this on a dedicated port; the
 #: in-process server shares its one HTTP port).
 EP_METRICS = "metrics"
@@ -262,4 +302,8 @@ REPOSITORY_ROUTE_RE = re.compile(
 SHM_ROUTE_RE = re.compile(
     r"^v2/(?P<kind>systemsharedmemory|cudasharedmemory|tpusharedmemory)"
     r"(?:/region/(?P<region>[^/]+))?/(?P<action>status|register|unregister)$"
+)
+#: Router-side replica admin: drain / undrain one replica by name.
+FLEET_REPLICA_ROUTE_RE = re.compile(
+    r"^v2/fleet/replicas/(?P<replica>[^/]+)/(?P<action>drain|undrain)$"
 )

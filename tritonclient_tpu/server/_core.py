@@ -99,6 +99,13 @@ class CoreRequest:
     # requests whose budget cannot cover the service estimate with a fast
     # 504 at admission, and sweeps expired requests out of the queue.
     deadline_us: int = 0
+    # Tenant this request belongs to (the ``tenant-id`` header / gRPC
+    # metadata value, empty when the caller sent none). Stamped by the
+    # protocol front-ends so per-tenant accounting — flight-recorder
+    # attribution, tail_report fairness rows — survives into the core
+    # without re-parsing transport metadata. Excluded from equality so
+    # the gRPC stream's cached-parse comparison is unaffected.
+    tenant: str = field(default="", compare=False)
     # Per-request cancellation signal (a threading.Event), armed by the
     # protocol front-ends on client disconnect / RPC termination. The
     # batcher sheds queued requests whose event is set, and engine-backed
@@ -1242,6 +1249,12 @@ class InferenceCore:
         self._dynamic_batching = (
             os.environ.get("TPU_SERVER_DYNAMIC_BATCH", "1") != "0"
         )
+        # Fleet drain state: while draining, v2/health/ready reports 400
+        # (the router — or any health-driven balancer — stops admitting)
+        # but in-flight requests keep executing to completion. Guarded by
+        # self._lock; readiness_detail() is what the router polls to know
+        # the drain has settled (in_flight == 0).
+        self._draining = False
         for model in models or []:
             self.add_model(model)
 
@@ -1296,7 +1309,34 @@ class InferenceCore:
         return True
 
     def is_server_ready(self) -> bool:
-        return True
+        # A draining server is alive but not READY: health-driven routers
+        # stop admitting while in-flight work finishes (rolling restart).
+        with self._lock:
+            return not self._draining
+
+    # -- fleet drain ---------------------------------------------------------
+
+    def set_draining(self, draining: bool) -> dict:
+        """Enter/leave drain mode; returns the readiness detail after the
+        change. Draining only flips the readiness signal — requests
+        already admitted (and any that race the flip) execute normally,
+        which is what makes a drain graceful."""
+        with self._lock:
+            self._draining = bool(draining)
+        return self.readiness_detail()
+
+    def readiness_detail(self) -> dict:
+        """The readiness-detail document served beside ``v2/health/ready``
+        and by the drain endpoints: whether this replica admits new work,
+        whether it is draining, and how many requests are still in
+        flight (admitted, not yet answered — the drain-settled signal)."""
+        with self._lock:
+            in_flight = sum(s.pending for s in self._stats.values())
+            return {
+                "ready": not self._draining,
+                "draining": self._draining,
+                "in_flight": int(in_flight),
+            }
 
     def is_model_ready(self, name: str, version: str = "") -> bool:
         with self._lock:
@@ -1696,6 +1736,7 @@ class InferenceCore:
         recv_ns: Optional[int] = None,
         traceparent: Optional[str] = None,
         deadline_us: int = 0,
+        tenant: str = "",
     ):
         """Sample one request against the effective trace settings, and
         arm the flight recorder for it.
@@ -1747,6 +1788,10 @@ class InferenceCore:
         if deadline_us:
             ctx.deadline_ns = int(deadline_us) * 1000
             ctx.set_attribute("deadline_budget_us", int(deadline_us))
+        if tenant:
+            # Tenant attribution rides every retained record: tail_report's
+            # per-tenant fairness rows key on this attribute.
+            ctx.set_attribute("tenant", tenant)
         return ctx
 
     def _record_deadline_miss(self, model_name: str):

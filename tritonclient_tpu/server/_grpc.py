@@ -17,6 +17,7 @@ import numpy as np
 
 from tritonclient_tpu.protocol import make_service_handler, pb
 from tritonclient_tpu.protocol._literals import (
+    HEADER_TENANT_ID,
     KEY_CLASSIFICATION,
     KEY_EMPTY_FINAL_RESPONSE,
     KEY_FINAL_RESPONSE,
@@ -25,6 +26,7 @@ from tritonclient_tpu.protocol._literals import (
     KEY_SHM_REGION,
     KEY_TIMEOUT,
     STATUS_CANCELLED,
+    STATUS_OVER_QUOTA,
     STATUS_SHED,
 )
 from tritonclient_tpu.protocol._service import RawJsonMessage
@@ -66,24 +68,49 @@ def _stream_error(msg: str, request_id: str = "") -> pb.ModelStreamInferResponse
     return resp
 
 
-def _metadata_value(context, key: str) -> str:
-    """One invocation-metadata value, when the transport exposes metadata
-    (the aio shim context does not)."""
+class _CallMeta:
+    """The call-level invocation metadata the server actually consumes
+    (W3C trace context, tenant identity, request-id tag), extracted in
+    ONE pass at call/stream open.
+
+    gRPC metadata is per-call, not per-message: on a bidi stream every
+    cached-parse fast path used to re-walk the metadata pairs per key per
+    request, so tenant accounting would have paid a per-message metadata
+    scan. Hoisting the extraction to stream open makes the per-message
+    cost a plain attribute read.
+    """
+
+    __slots__ = ("traceparent", "tenant", "request_id")
+
+    def __init__(self, traceparent: str = "", tenant: str = "",
+                 request_id: str = ""):
+        self.traceparent = traceparent
+        self.tenant = tenant
+        self.request_id = request_id
+
+
+_EMPTY_META = _CallMeta()
+
+
+def _inbound_metadata(context) -> _CallMeta:
+    """Extract the consumed metadata keys in one pass (transports without
+    metadata — the aio shim context — yield the empty struct)."""
     md = getattr(context, "invocation_metadata", None)
     if md is None:
-        return ""
+        return _EMPTY_META
     try:
         pairs = md()
     except Exception:
-        return ""
+        return _EMPTY_META
+    meta = _CallMeta()
     for k, value in pairs or ():
-        if k == key:
-            return value
-    return ""
-
-
-def _metadata_request_id(context) -> str:
-    return _metadata_value(context, "triton-request-id")
+        if k == "traceparent":
+            meta.traceparent = value
+        elif k == HEADER_TENANT_ID:
+            meta.tenant = value
+        elif k == "triton-request-id":
+            meta.request_id = value
+    return meta
 
 
 def _finish_trace(creq, error: Optional[str] = None):
@@ -109,6 +136,9 @@ def _status_for(e: CoreError) -> grpc.StatusCode:
         # codes so both planes spell the shed status identically.
         STATUS_SHED: grpc.StatusCode.DEADLINE_EXCEEDED,
         STATUS_CANCELLED: grpc.StatusCode.CANCELLED,
+        # Fleet-router quota rejections: both planes spell over-quota
+        # through one status pair (429 / RESOURCE_EXHAUSTED).
+        STATUS_OVER_QUOTA: grpc.StatusCode.RESOURCE_EXHAUSTED,
     }.get(e.status, grpc.StatusCode.UNKNOWN)
 
 
@@ -511,14 +541,17 @@ class _Servicer:
         self.core.record_protocol_request("grpc")
         creq = None
         try:
+            meta = _inbound_metadata(context)
             creq = request_to_core(request, self.core)
+            creq.tenant = meta.tenant
             _arm_cancel(context, creq)
             creq.trace = self.core.start_trace(
                 request.model_name, request.model_version,
-                request.id or _metadata_request_id(context),
+                request.id or meta.request_id,
                 recv_ns=t_recv,
-                traceparent=_metadata_value(context, "traceparent"),
+                traceparent=meta.traceparent,
                 deadline_us=creq.deadline_us,
+                tenant=meta.tenant,
             )
             resp = _finalize_unary(self.core.infer(creq))
             _finish_trace(creq)
@@ -545,14 +578,34 @@ class _Servicer:
             body = json.dumps(self.core.flight_recorder.dump())
         return RawJsonMessage(body.encode())
 
+    def Drain(self, request, context):
+        """Fleet drain control (raw-JSON RPC; the gRPC analog of POST
+        v2/fleet/drain). Payload ``{"drain": true|false}``; empty or
+        malformed payloads mean drain. Returns the readiness detail the
+        router polls for drain settlement."""
+        drain = True
+        payload = getattr(request, "payload", b"")
+        if payload:
+            try:
+                doc = json.loads(payload)
+            except ValueError:
+                doc = None
+            if isinstance(doc, dict):
+                drain = bool(doc.get("drain", True))
+        return RawJsonMessage(
+            json.dumps(self.core.set_draining(drain)).encode()
+        )
+
     def _process_stream_request(self, request, cached_reqs, cached_resps,
-                                traceparent: str = "",
+                                meta: _CallMeta = _EMPTY_META,
                                 cancel_event=None):
         """One stream request → message list or lazy message generator.
 
-        ``traceparent`` is the STREAM's inbound W3C context (gRPC metadata
-        is per-call, not per-message): every traced request on the stream
-        becomes a child of the caller's span under one shared trace id.
+        ``meta`` is the STREAM's inbound call metadata, extracted once at
+        stream open (gRPC metadata is per-call, not per-message): every
+        traced request on the stream becomes a child of the caller's span
+        under one shared trace id, and the tenant stamp is a plain
+        attribute read rather than a per-message metadata walk.
         ``cancel_event`` is the stream's termination event — armed when
         the client cancels or the stream tears down, so in-flight work
         sheds instead of finishing for nobody.
@@ -575,13 +628,15 @@ class _Servicer:
         try:
             creq = self._parse_cached(request, cached_reqs)
             # Always (re)assigned — the cached-parse fast path reuses the
-            # CoreRequest object, so a stale trace (or a previous stream's
-            # cancel event) must never survive.
+            # CoreRequest object, so a stale trace, tenant, or a previous
+            # stream's cancel event must never survive.
             creq.cancel_event = cancel_event
+            creq.tenant = meta.tenant
             creq.trace = self.core.start_trace(
                 request.model_name, request.model_version, request.id,
-                recv_ns=t_recv, traceparent=traceparent or None,
+                recv_ns=t_recv, traceparent=meta.traceparent or None,
                 deadline_us=creq.deadline_us,
+                tenant=meta.tenant,
             )
             cresp = self.core.infer(creq)
             _finish_trace(creq)
@@ -695,9 +750,11 @@ class _Servicer:
 
         cached_reqs = {}
         cached_resps = {}
-        # Stream-level W3C context: read once (metadata is per-call); every
-        # traced request on this stream joins the caller's trace.
-        stream_tp = _metadata_value(context, "traceparent")
+        # Stream-level call metadata (W3C context + tenant): extracted in
+        # one pass at stream open (metadata is per-call); every traced
+        # request on this stream joins the caller's trace, and the tenant
+        # stamp costs one attribute read per message.
+        stream_meta = _inbound_metadata(context)
         pending = _queue.Queue(maxsize=64)  # backpressure bound
         stop = threading.Event()
         # Stream-level cancellation: gRPC cancellation is per-call, so one
@@ -741,7 +798,7 @@ class _Servicer:
                 # feeder-side parse).
                 future = self._stream_pool.submit(
                     self._process_stream_request,
-                    request, cached_reqs, cached_resps, stream_tp,
+                    request, cached_reqs, cached_resps, stream_meta,
                     stream_cancel,
                 )
                 return future, future.exception
@@ -756,10 +813,12 @@ class _Servicer:
                     None,
                 )
             creq.cancel_event = stream_cancel
+            creq.tenant = stream_meta.tenant
             creq.trace = self.core.start_trace(
                 request.model_name, request.model_version, request.id,
-                recv_ns=t_recv, traceparent=stream_tp or None,
+                recv_ns=t_recv, traceparent=stream_meta.traceparent or None,
                 deadline_us=creq.deadline_us,
+                tenant=stream_meta.tenant,
             )
             try:
                 fin = self.core.infer_submit(creq)
@@ -810,7 +869,7 @@ class _Servicer:
                             barrier()  # drain batcher + pool pipeline
                         inflight = []
                         item = self._process_stream_request(
-                            request, cached_reqs, cached_resps, stream_tp,
+                            request, cached_reqs, cached_resps, stream_meta,
                             stream_cancel,
                         )
                     else:
@@ -1010,7 +1069,7 @@ class _AioServicer:
             "CudaSharedMemoryRegister", "CudaSharedMemoryUnregister",
             "TpuSharedMemoryStatus", "TpuSharedMemoryRegister",
             "TpuSharedMemoryUnregister", "TraceSetting", "LogSettings",
-            "FlightRecorder",
+            "FlightRecorder", "Drain",
         ):
             setattr(self, name, self._wrap_unary(getattr(self._sync, name)))
 
@@ -1042,15 +1101,18 @@ class _AioServicer:
         self.core.record_protocol_request("grpc")
         creq = None
         try:
+            meta = _inbound_metadata(context)
             creq = request_to_core(request, self.core)
+            creq.tenant = meta.tenant
             creq.cancel_event = threading.Event()
             _aio_arm_cancel(context, creq.cancel_event)
             creq.trace = self.core.start_trace(
                 request.model_name, request.model_version,
-                request.id or _metadata_request_id(context),
+                request.id or meta.request_id,
                 recv_ns=t_recv,
-                traceparent=_metadata_value(context, "traceparent"),
+                traceparent=meta.traceparent,
                 deadline_us=creq.deadline_us,
+                tenant=meta.tenant,
             )
             resp = _finalize_unary(await self._infer(creq))
             _finish_trace(creq)
@@ -1067,7 +1129,7 @@ class _AioServicer:
         # the cached-parse/cached-response fast path.
         cached_reqs: dict = {}
         cached_resps: dict = {}
-        stream_tp = _metadata_value(context, "traceparent")
+        stream_meta = _inbound_metadata(context)
         # Stream-level cancellation (see the sync servicer): one event per
         # stream, armed on RPC completion and on generator teardown — the
         # teardown path is what fires when the client cancels mid-stream
@@ -1114,7 +1176,7 @@ class _AioServicer:
                     def drain(req):
                         try:
                             msgs = self._sync._process_stream_request(
-                                req, cached_reqs, cached_resps, stream_tp,
+                                req, cached_reqs, cached_resps, stream_meta,
                                 stream_cancel,
                             )
                             for msg in msgs:
@@ -1142,7 +1204,7 @@ class _AioServicer:
                 # shm outputs park un-materialized), so this is one thread
                 # hop fewer than the sync feeder/pool/yielder pipeline.
                 msgs = self._sync._process_stream_request(
-                    request, cached_reqs, cached_resps, stream_tp,
+                    request, cached_reqs, cached_resps, stream_meta,
                     stream_cancel,
                 )
                 for msg in msgs:

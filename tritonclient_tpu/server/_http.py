@@ -20,6 +20,7 @@ from typing import List, Optional
 import numpy as np
 
 from tritonclient_tpu.protocol._literals import (
+    EP_FLEET_DRAIN,
     EP_FLIGHT_RECORDER,
     EP_HEALTH_LIVE,
     EP_HEALTH_READY,
@@ -28,6 +29,7 @@ from tritonclient_tpu.protocol._literals import (
     EP_REPOSITORY_INDEX,
     EP_SERVER_METADATA,
     EP_TRACE_SETTING,
+    HEADER_TENANT_ID,
     KEY_TIMEOUT,
     KEY_BINARY_DATA,
     KEY_BINARY_DATA_OUTPUT,
@@ -294,7 +296,15 @@ class _Handler(BaseHTTPRequestHandler):
         if path == EP_HEALTH_LIVE:
             return self._send(200 if core.is_server_live() else 400, b"")
         if path == EP_HEALTH_READY:
-            return self._send(200 if core.is_server_ready() else 400, b"")
+            # Status carries the readiness verdict (client parity); the
+            # body carries the readiness DETAIL the fleet router's health
+            # prober consumes: {"ready", "draining", "in_flight"}.
+            detail = core.readiness_detail()
+            return self._send_json(detail, 200 if detail["ready"] else 400)
+        if path == EP_FLEET_DRAIN and method == "POST":
+            body = self._read_body()
+            drain = bool(json.loads(body).get("drain", True)) if body else True
+            return self._send_json(core.set_draining(drain))
         if path == EP_SERVER_METADATA:
             return self._send_json(core.server_metadata())
 
@@ -457,6 +467,10 @@ class _Handler(BaseHTTPRequestHandler):
                 request.deadline_us = max(int(timeout), 0)
             except (TypeError, ValueError):
                 request.deadline_us = 0
+        # Tenant attribution: the fleet router forwards the tenant-id
+        # header; stamping it here (and on the trace) keys per-tenant
+        # accounting all the way into the flight recorder.
+        request.tenant = self.headers.get(HEADER_TENANT_ID, "")
         # Request-id propagation: the body id wins; the triton-request-id
         # header lets clients tag trace records without touching the body.
         trace = core.start_trace(
@@ -465,6 +479,7 @@ class _Handler(BaseHTTPRequestHandler):
             recv_ns=t_recv,
             traceparent=self.headers.get("traceparent"),
             deadline_us=request.deadline_us,
+            tenant=request.tenant,
         )
         request.trace = trace
 
