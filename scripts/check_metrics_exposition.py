@@ -43,12 +43,16 @@ if _REPO_ROOT not in sys.path:
 
 try:
     from tritonclient_tpu.protocol._literals import (
+        HEDGE_OUTCOMES,
         QUOTA_REASONS,
+        RETRY_REASONS,
         SHED_REASONS,
     )
 except ImportError:  # standalone copy of the script: keep it usable
     SHED_REASONS = ("admission", "expired", "cancelled")
     QUOTA_REASONS = ("rate", "concurrency", "pressure")
+    RETRY_REASONS = ("connect", "send", "status", "idempotent")
+    HEDGE_OUTCOMES = ("primary", "hedge", "failed")
 
 _SHED_FAMILY = "nv_inference_shed_total"
 # Fleet-router families (served by the router's own /metrics): same
@@ -59,6 +63,12 @@ _REPLICA_GAUGE_FAMILIES = (
     "nv_fleet_replica_outstanding",
     "nv_fleet_replica_queue_depth",
 )
+# Resilience families (PR 9): canonical-vocabulary counters with every
+# row always rendered, plus the breaker-state gauge's 3-value encoding.
+_RETRY_FAMILY = "nv_client_retries_total"
+_HEDGE_FAMILY = "nv_fleet_hedges_total"
+_RESTARTS_FAMILY = "nv_fleet_replica_restarts_total"
+_BREAKER_FAMILY = "nv_client_breaker_state"
 
 _VALID_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
 _METRIC_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
@@ -227,6 +237,43 @@ def check_exposition(text: str) -> List[str]:
                             f'{family}{{tenant="{tenant}"}}: missing '
                             f"reason rows {missing}"
                         )
+            if family in (_RETRY_FAMILY, _HEDGE_FAMILY):
+                # Canonical-vocabulary counters: one label, canonical
+                # values only, EVERY canonical row rendered (zeros
+                # included) so rates are always well-defined.
+                label, vocab = (
+                    ("reason", RETRY_REASONS)
+                    if family == _RETRY_FAMILY
+                    else ("outcome", HEDGE_OUTCOMES)
+                )
+                seen = set()
+                for labels, value, name, lineno in samples.get(family, []):
+                    if set(labels) != {label}:
+                        errors.append(
+                            f"line {lineno}: {family} label set "
+                            f"{sorted(labels)} != ['{label}']"
+                        )
+                        continue
+                    if labels[label] not in vocab:
+                        errors.append(
+                            f"line {lineno}: {family} {label} "
+                            f"{labels[label]!r} not in {list(vocab)}"
+                        )
+                        continue
+                    seen.add(labels[label])
+                if samples.get(family):
+                    missing = [v for v in vocab if v not in seen]
+                    if missing:
+                        errors.append(
+                            f"{family}: missing {label} rows {missing}"
+                        )
+            if family == _RESTARTS_FAMILY:
+                for labels, value, name, lineno in samples.get(family, []):
+                    if set(labels) != {"replica"}:
+                        errors.append(
+                            f"line {lineno}: {family} label set "
+                            f"{sorted(labels)} != ['replica']"
+                        )
             continue
         if ftype == "gauge":
             if family.endswith("_age_us"):
@@ -248,6 +295,20 @@ def check_exposition(text: str) -> List[str]:
                         errors.append(
                             f"line {lineno}: {family} value {value} "
                             "not in {0, 1}"
+                        )
+            if family == _BREAKER_FAMILY:
+                # Breaker-state gauge: one {endpoint} label, value in
+                # the 3-state encoding (0=closed, 1=half_open, 2=open).
+                for labels, value, name, lineno in samples.get(family, []):
+                    if set(labels) != {"endpoint"}:
+                        errors.append(
+                            f"line {lineno}: {family} label set "
+                            f"{sorted(labels)} != ['endpoint']"
+                        )
+                    if value not in (0.0, 1.0, 2.0):
+                        errors.append(
+                            f"line {lineno}: {family} value {value} "
+                            "not in {0, 1, 2}"
                         )
             if family in _REPLICA_GAUGE_FAMILIES:
                 for labels, value, name, lineno in samples.get(family, []):

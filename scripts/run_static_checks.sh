@@ -121,7 +121,7 @@ if [ "${SANITIZE}" -eq 1 ]; then
     run_check "tpusan-tier1" env JAX_PLATFORMS=cpu TPUSAN=1 \
         TPUSAN_REPORT="${TPUSAN_OUT}" \
         "${PYTHON}" -m pytest -q -m 'not slow' -p no:cacheprovider \
-        tests/test_tpusan.py tests/test_fleet.py tests/test_deadlines.py tests/test_shared_memory.py \
+        tests/test_tpusan.py tests/test_fleet.py tests/test_chaos.py tests/test_deadlines.py tests/test_shared_memory.py \
         tests/test_server.py tests/test_grpc_client.py \
         tests/test_http_client.py tests/test_aio_clients.py \
         tests/test_aio_stress.py tests/test_batcher_stress.py

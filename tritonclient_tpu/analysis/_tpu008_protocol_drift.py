@@ -81,8 +81,16 @@ _SHED_STATUS_NAMES = {
 
 #: Header/metadata keys whose raw spelling in a protocol-plane file is
 #: drift: a router admitting one spelling while the replica stamps
-#: another silently un-attributes every record.
-_HEADER_LITERAL_NAMES = {"tenant-id": "HEADER_TENANT_ID"}
+#: another silently un-attributes every record — and a proxy honoring
+#: one idempotency-key spelling while a client stamps another silently
+#: disables every replay.
+_HEADER_LITERAL_NAMES = {
+    "tenant-id": "HEADER_TENANT_ID",
+    "idempotency-key": "HEADER_IDEMPOTENCY_KEY",
+    "retry-attempt": "HEADER_RETRY_ATTEMPT",
+    "hedge-attempt": "HEADER_HEDGE_ATTEMPT",
+    "retry-after": "HEADER_RETRY_AFTER",
+}
 
 
 class _Side:
@@ -225,7 +233,7 @@ class ProtocolDriftRule(Rule):
                     findings.append(
                         Finding(
                             self.id, ctx.path, node.lineno, node.col_offset,
-                            f"tenant header {node.value!r} spelled as a "
+                            f"protocol header {node.value!r} spelled as a "
                             f"raw literal; import {name} from "
                             "protocol/_literals so router and replica "
                             "cannot drift on tenant attribution",

@@ -1,0 +1,442 @@
+"""tpuchaos — deterministic, seeded fault injection at named choke points.
+
+The third tier of the lint→witness ladder: **tpulint** proves the
+invariants statically, **tpusan** witnesses them under execution, and
+**tpuchaos** witnesses them under *injected failure* — the only way to
+prove the resilience layer (retries, breakers, failover, crash
+recovery) actually holds the availability the fleet tier promises.
+
+Activation mirrors tpusan: ``TPUCHAOS=<seed>:<plan>`` in the
+environment (parsed at first import), or programmatic
+:func:`enable`/:func:`session`. **Zero overhead when off**: the choke
+points call :func:`fire`, whose first instruction is a module-flag
+check, and :func:`operation` returns a shared no-op context manager.
+
+Choke points are *named sites* instrumented in the protocol clients,
+the fleet router, and the shm paths (each spells its site once as a
+module constant and calls ``chaos.fire(SITE)``):
+
+=============================  ==============================================
+site                           where it fires
+=============================  ==============================================
+``http.connect``               client HTTP connection establishment
+``http.send``                  client HTTP request write (headers+body)
+``http.response``              client HTTP response read (post-send)
+``grpc.call``                  client gRPC unary invocation
+``fleet.exchange.connect``     router→replica connection checkout
+``fleet.exchange.send``        router→replica proxied request write
+``fleet.exchange.response``    router→replica proxied response read
+``shm.register``               shared-memory region create/register (mmap)
+=============================  ==============================================
+
+Faults (see ``_plan.FAULTS``) raise the exception the real failure
+would (``ConnectionRefusedError``, ``ConnectionResetError`` for
+RST/mid-response FIN, ``BrokenPipeError`` for partial writes,
+``socket.timeout``, gRPC ``UNAVAILABLE``, ``OSError(ENOMEM)``), inject
+latency, or — via :class:`~tritonclient_tpu.chaos._controller.
+ChaosController` — SIGKILL/SIGSTOP replica subprocesses.
+
+Every injection is recorded ``{seq, site, fault, rule, op, survived}``;
+wrapping a logical operation in ``with chaos.operation("infer")`` marks
+its injections **survived** when the operation completes without
+raising — that is the report's "N faults injected, M survived"
+arithmetic tests and the CI chaos lane assert on.
+:func:`write_report` renders JSON (or SARIF for ``.sarif`` paths,
+merging with the tpulint/tpusan code-scanning streams).
+"""
+
+import errno
+import json
+import os
+import socket
+import threading
+import time
+from typing import List, Optional
+
+from tritonclient_tpu import sanitize
+from tritonclient_tpu.chaos._plan import (  # noqa: F401
+    FAULT_ENOMEM,
+    FAULT_LATENCY,
+    FAULT_PARTIAL,
+    FAULT_REFUSED,
+    FAULT_RESET,
+    FAULT_SIGKILL,
+    FAULT_SIGSTOP,
+    FAULT_TIMEOUT,
+    FAULT_UNAVAILABLE,
+    FAULTS,
+    Plan,
+    PlanError,
+    Rule,
+    parse_plan,
+)
+
+__all__ = [
+    "ChaosInjection",
+    "Plan",
+    "PlanError",
+    "active",
+    "disable",
+    "enable",
+    "fire",
+    "injections",
+    "operation",
+    "session",
+    "summary",
+    "write_report",
+]
+
+#: Canonical site names (spelled once here; choke points import them).
+SITE_HTTP_CONNECT = "http.connect"
+SITE_HTTP_SEND = "http.send"
+SITE_HTTP_RESPONSE = "http.response"
+SITE_GRPC_CALL = "grpc.call"
+SITE_FLEET_CONNECT = "fleet.exchange.connect"
+SITE_FLEET_SEND = "fleet.exchange.send"
+SITE_FLEET_RESPONSE = "fleet.exchange.response"
+SITE_SHM_REGISTER = "shm.register"
+
+
+class ChaosInjection(Exception):
+    """Mixin marker carried by every chaos-raised exception so reports
+    and tests can tell an injected fault from an organic one."""
+
+
+class ChaosConnectionRefused(ChaosInjection, ConnectionRefusedError):
+    pass
+
+
+class ChaosConnectionReset(ChaosInjection, ConnectionResetError):
+    pass
+
+
+class ChaosBrokenPipe(ChaosInjection, BrokenPipeError):
+    pass
+
+
+class ChaosTimeout(ChaosInjection, socket.timeout):
+    pass
+
+
+class ChaosOSError(ChaosInjection, OSError):
+    pass
+
+
+class _State:
+    def __init__(self):
+        self.active = False
+        self.plan: Optional[Plan] = None
+        self.started_at = 0.0
+        self.lock = sanitize.named_lock("chaos._State.lock")
+        self.records: List[dict] = []
+        self.seq = 0
+        self.tls = threading.local()  # per-thread operation stack
+
+
+_STATE = _State()
+
+
+def active() -> bool:
+    return _STATE.active
+
+
+def enable(seed: int = 0, plan: str = ""):
+    """Activate injection with a seeded plan (idempotent re-arm: a
+    second enable replaces the plan and resets counters/records)."""
+    with _STATE.lock:
+        _STATE.plan = plan if isinstance(plan, Plan) else Plan(plan, seed)
+        _STATE.plan.reseed()
+        _STATE.records = []
+        _STATE.seq = 0
+        _STATE.started_at = time.monotonic()
+        _STATE.active = True
+
+
+def disable():
+    with _STATE.lock:
+        _STATE.active = False
+        _STATE.plan = None
+
+
+class session:
+    """``with chaos.session(seed, plan):`` — enable for a block, always
+    disable after (test-friendly)."""
+
+    def __init__(self, seed: int = 0, plan: str = ""):
+        self._seed = seed
+        self._plan = plan
+
+    def __enter__(self):
+        enable(self._seed, self._plan)
+        return self
+
+    def __exit__(self, *exc):
+        disable()
+        return False
+
+
+# -- operations (survival tracking) ----------------------------------------- #
+
+
+class _NoOp:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoOp()
+
+
+class _Operation:
+    """One logical client operation; injections fired on this thread
+    while it is open belong to it. Exiting cleanly marks them survived."""
+
+    __slots__ = ("name", "injection_seqs")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.injection_seqs: List[int] = []
+
+    def __enter__(self):
+        stack = getattr(_STATE.tls, "ops", None)
+        if stack is None:
+            stack = _STATE.tls.ops = []
+        stack.append(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        stack = getattr(_STATE.tls, "ops", [])
+        if stack and stack[-1] is self:
+            stack.pop()
+        if exc_type is None and self.injection_seqs:
+            with _STATE.lock:
+                seqs = set(self.injection_seqs)
+                for record in _STATE.records:
+                    if record["seq"] in seqs:
+                        record["survived"] = True
+        return False
+
+
+def operation(name: str):
+    """Scope one logical operation (an infer, a proxied exchange) for
+    survived-fault accounting. No-op (shared object) when chaos is off."""
+    if not _STATE.active:
+        return _NOOP
+    return _Operation(name)
+
+
+# -- the choke-point hook ---------------------------------------------------- #
+
+
+def _enact(rule: Rule):
+    if rule.fault == FAULT_LATENCY:
+        # Deliberate injected latency (the fault itself); chaos tests
+        # never run this on an event loop thread.
+        time.sleep(rule.ms / 1000.0)  # tpulint: disable=TPU001
+        return
+    if rule.fault == FAULT_REFUSED:
+        raise ChaosConnectionRefused(
+            errno.ECONNREFUSED, f"tpuchaos[{rule.site}]: injected connection refused"
+        )
+    if rule.fault == FAULT_RESET:
+        raise ChaosConnectionReset(
+            errno.ECONNRESET, f"tpuchaos[{rule.site}]: injected connection reset"
+        )
+    if rule.fault == FAULT_PARTIAL:
+        raise ChaosBrokenPipe(
+            errno.EPIPE, f"tpuchaos[{rule.site}]: injected partial write"
+        )
+    if rule.fault == FAULT_TIMEOUT:
+        raise ChaosTimeout(f"tpuchaos[{rule.site}]: injected timeout")
+    if rule.fault == FAULT_ENOMEM:
+        raise ChaosOSError(
+            errno.ENOMEM, f"tpuchaos[{rule.site}]: injected mmap failure"
+        )
+    if rule.fault == FAULT_UNAVAILABLE:
+        raise _grpc_unavailable(rule.site)
+    # sigkill/sigstop rules are controller-enacted; firing one at an
+    # in-process site is a plan mistake — surface it loudly.
+    raise PlanError(
+        f"fault '{rule.fault}' at in-process site '{rule.site}' "
+        "is controller-enacted (sigkill/sigstop name a replica site)"
+    )
+
+
+def _grpc_unavailable(site: str):
+    import grpc
+
+    class _ChaosRpcError(ChaosInjection, grpc.RpcError):
+        """Duck-types the surface the clients read (code/details)."""
+
+        def code(self):
+            return grpc.StatusCode.UNAVAILABLE
+
+        def details(self):
+            return f"tpuchaos[{site}]: injected channel breakage"
+
+        def __str__(self):
+            return self.details()
+
+    return _ChaosRpcError()
+
+
+def fire(site: str):
+    """The choke-point hook: decide per matching rule, record, enact.
+
+    When off this is one attribute load + branch. When a fault fires it
+    raises (or sleeps, for latency) — the instrumented code treats the
+    raise exactly like the organic failure it models.
+    """
+    if not _STATE.active:
+        return
+    with _STATE.lock:
+        plan = _STATE.plan
+        if plan is None:
+            return
+        elapsed = time.monotonic() - _STATE.started_at
+        fired: Optional[Rule] = None
+        for rule in plan.for_site(site):
+            if rule.decide(elapsed) and fired is None:
+                fired = rule
+        if fired is None:
+            return
+        _STATE.seq += 1
+        record = {
+            "seq": _STATE.seq,
+            "site": site,
+            "fault": fired.fault,
+            "rule": fired.spec(),
+            "op": None,
+            "survived": False,
+        }
+        _STATE.records.append(record)
+    ops = getattr(_STATE.tls, "ops", None)
+    if ops:
+        record["op"] = ops[-1].name
+        ops[-1].injection_seqs.append(record["seq"])
+    _enact(fired)
+
+
+def note_injection(site: str, fault: str, detail: str = ""):
+    """Record an injection enacted OUTSIDE a choke point (the controller
+    SIGKILLing a replica). Survival is the scenario's to assert."""
+    if not _STATE.active:
+        return None
+    with _STATE.lock:
+        _STATE.seq += 1
+        record = {
+            "seq": _STATE.seq,
+            "site": site,
+            "fault": fault,
+            "rule": detail or f"{site}={fault}",
+            "op": None,
+            "survived": False,
+        }
+        _STATE.records.append(record)
+    return record["seq"]
+
+
+def mark_survived(seq: int):
+    with _STATE.lock:
+        for record in _STATE.records:
+            if record["seq"] == seq:
+                record["survived"] = True
+                return
+
+
+# -- reporting --------------------------------------------------------------- #
+
+
+def injections() -> List[dict]:
+    with _STATE.lock:
+        return [dict(r) for r in _STATE.records]
+
+
+def summary() -> dict:
+    with _STATE.lock:
+        records = list(_STATE.records)
+        plan = _STATE.plan
+    survived = sum(1 for r in records if r["survived"])
+    by_site: dict = {}
+    for r in records:
+        site = by_site.setdefault(
+            r["site"], {"injected": 0, "survived": 0}
+        )
+        site["injected"] += 1
+        site["survived"] += 1 if r["survived"] else 0
+    return {
+        "tool": "tpuchaos",
+        "seed": plan.seed_value if plan else None,
+        "plan": plan.text if plan else "",
+        "injected": len(records),
+        "survived": survived,
+        "by_site": by_site,
+    }
+
+
+def write_report(path: str):
+    """Chaos report: SARIF 2.1.0 for ``.sarif`` paths (one result per
+    distinct site+fault, merged alongside tpulint/tpusan in code
+    scanning), JSON (full per-injection records) otherwise."""
+    if path.endswith(".sarif"):
+        from tritonclient_tpu.analysis._engine import Finding
+        from tritonclient_tpu.analysis._sarif import render_sarif
+
+        seen = {}
+        for r in injections():
+            key = (r["site"], r["fault"])
+            seen.setdefault(key, 0)
+            seen[key] += 1
+        findings = [
+            Finding(
+                "TPUCHAOS", site, 1, 0,
+                f"injected fault '{fault}' x{count}",
+            )
+            for (site, fault), count in sorted(seen.items())
+        ]
+        meta = [{
+            "id": "TPUCHAOS",
+            "name": "fault-injection",
+            "shortDescription": {"text": "deterministic injected fault"},
+        }]
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(render_sarif(findings, meta, tool_name="tpuchaos"))
+        return
+    doc = summary()
+    doc["faults"] = injections()
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+
+
+# -- env activation (mirrors tpusan) ----------------------------------------- #
+
+
+def _maybe_enable_from_env():
+    raw = os.environ.get("TPUCHAOS", "").strip()
+    if not raw or raw in ("0", "false", "off"):
+        return
+    seed_text, _, plan_text = raw.partition(":")
+    try:
+        seed = int(seed_text)
+    except ValueError:
+        seed, plan_text = 0, raw
+    enable(seed, plan_text)
+
+
+def env_seed(default: int = 42) -> int:
+    """The seed named by ``TPUCHAOS`` (for scenarios that honor the CI
+    lane's fixed seed), or ``default``."""
+    raw = os.environ.get("TPUCHAOS", "").strip()
+    seed_text = raw.partition(":")[0]
+    try:
+        return int(seed_text)
+    except ValueError:
+        return default
+
+
+_maybe_enable_from_env()

@@ -1,0 +1,219 @@
+"""ChaosController: process-level faults against real replica processes.
+
+In-process choke points can fake transport failures, but a crashed
+replica is not a fake — SIGKILL drops every in-flight request, resets
+every connection, and erases all admin state (shm registrations,
+repository loads, trace settings). The controller owns the replica
+subprocesses (``python -m tritonclient_tpu.fleet.serve``) so chaos
+scenarios can kill, wedge (SIGSTOP), resume, and **restart** them —
+restart re-binds the SAME ports, which is what lets a router identify
+the rejoined process as the replica it ejected and replay its journaled
+admin state.
+
+Usage::
+
+    with ChaosController() as ctl:
+        r0 = ctl.spawn("r0", service_ms=5)
+        r1 = ctl.spawn("r1", service_ms=5)
+        ... route traffic ...
+        ctl.sigkill("r0")          # crash mid-flight (recorded as an injection)
+        ... assert failover ...
+        ctl.restart("r0")          # same ports; router replays admin state
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+from tritonclient_tpu import chaos, sanitize
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+class ReplicaProcess:
+    """One controller-owned replica subprocess and its respawn recipe."""
+
+    __slots__ = ("name", "proc", "http_address", "grpc_address",
+                 "service_ms", "model_set", "kills", "stops")
+
+    def __init__(self, name: str, proc, http_address: str,
+                 grpc_address: str, service_ms: float, model_set: str):
+        self.name = name
+        self.proc = proc
+        self.http_address = http_address
+        self.grpc_address = grpc_address
+        self.service_ms = service_ms
+        self.model_set = model_set
+        self.kills = 0
+        self.stops = 0
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+
+class ChaosController:
+    """Spawn/kill/wedge/restart replica processes deterministically."""
+
+    def __init__(self, spawn_timeout_s: float = 60.0,
+                 env: Optional[dict] = None):
+        self.spawn_timeout_s = float(spawn_timeout_s)
+        self._env = dict(env) if env else dict(os.environ)
+        # Replica processes must not inherit an ambient chaos plan: the
+        # faults under test are the CONTROLLER's to inject.
+        self._env.pop("TPUCHAOS", None)
+        self._env.setdefault("JAX_PLATFORMS", "cpu")
+        self._replicas: Dict[str, ReplicaProcess] = {}
+        self._lock = sanitize.named_lock("chaos.ChaosController._lock")
+        self._tmp = tempfile.mkdtemp(prefix="tpuchaos_")
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate_all()
+        return False
+
+    def replicas(self) -> List[ReplicaProcess]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def get(self, name: str) -> ReplicaProcess:
+        with self._lock:
+            return self._replicas[name]
+
+    # -- spawn / respawn ------------------------------------------------------
+
+    def _launch(self, name: str, service_ms: float, model_set: str,
+                http_port: int = 0, grpc_port: int = 0) -> ReplicaProcess:
+        address_file = os.path.join(self._tmp, f"{name}.json")
+        if os.path.exists(address_file):
+            os.unlink(address_file)
+        cmd = [
+            sys.executable, "-m", "tritonclient_tpu.fleet.serve",
+            "--name", name,
+            "--model-set", model_set,
+            "--service-ms", str(service_ms),
+            "--http-port", str(http_port),
+            "--grpc-port", str(grpc_port),
+            "--address-file", address_file,
+        ]
+        proc = subprocess.Popen(
+            cmd, cwd=_REPO_ROOT, env=self._env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+        )
+        deadline = time.monotonic() + self.spawn_timeout_s
+        doc = None
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"replica '{name}' exited rc={proc.returncode} "
+                    "before publishing its addresses"
+                )
+            if os.path.exists(address_file):
+                with open(address_file) as f:
+                    doc = json.load(f)
+                break
+            # Sync spawn poll (controller threads only, never a loop).
+            time.sleep(0.02)  # tpulint: disable=TPU001
+        if doc is None:
+            proc.kill()
+            raise TimeoutError(f"replica '{name}' did not publish addresses")
+        return ReplicaProcess(
+            name, proc, doc["http"], doc["grpc"], service_ms, model_set
+        )
+
+    def spawn(self, name: str, service_ms: float = 5.0,
+              model_set: str = "fleet") -> ReplicaProcess:
+        replica = self._launch(name, service_ms, model_set)
+        with self._lock:
+            self._replicas[name] = replica
+        return replica
+
+    def restart(self, name: str,
+                wait_ready_s: float = 30.0) -> ReplicaProcess:
+        """Respawn a dead replica on the SAME ports it held before (so
+        membership identifies it as the ejected replica rejoining)."""
+        old = self.get(name)
+        if old.alive():
+            raise RuntimeError(f"replica '{name}' is still alive")
+        old.proc.wait()
+        http_port = int(old.http_address.rsplit(":", 1)[1])
+        grpc_port = int(old.grpc_address.rsplit(":", 1)[1])
+        fresh = self._launch(
+            name, old.service_ms, old.model_set,
+            http_port=http_port, grpc_port=grpc_port,
+        )
+        fresh.kills, fresh.stops = old.kills, old.stops
+        with self._lock:
+            self._replicas[name] = fresh
+        self.wait_ready(name, timeout_s=wait_ready_s)
+        return fresh
+
+    def wait_ready(self, name: str, timeout_s: float = 30.0):
+        from tritonclient_tpu.fleet._replica import http_call
+        from tritonclient_tpu.protocol._literals import EP_HEALTH_READY
+
+        replica = self.get(name)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                status, _ = http_call(
+                    replica.http_address, "GET", EP_HEALTH_READY,
+                    timeout_s=2.0,
+                )
+                if status == 200:
+                    return
+            except OSError:
+                pass
+            time.sleep(0.05)  # tpulint: disable=TPU001 (sync readiness poll)
+        raise TimeoutError(f"replica '{name}' not ready in {timeout_s}s")
+
+    # -- faults ---------------------------------------------------------------
+
+    def sigkill(self, name: str):
+        """SIGKILL the replica (recorded as a chaos injection at site
+        ``replica.<name>``)."""
+        replica = self.get(name)
+        replica.kills += 1
+        chaos.note_injection(f"replica.{name}", chaos.FAULT_SIGKILL)
+        replica.proc.send_signal(signal.SIGKILL)
+        replica.proc.wait(timeout=10)
+
+    def sigstop(self, name: str):
+        """Wedge the replica (alive but not scheduling — the slow/hung
+        failure mode health probes must distinguish from dead)."""
+        replica = self.get(name)
+        replica.stops += 1
+        chaos.note_injection(f"replica.{name}", chaos.FAULT_SIGSTOP)
+        replica.proc.send_signal(signal.SIGSTOP)
+
+    def sigcont(self, name: str):
+        self.get(name).proc.send_signal(signal.SIGCONT)
+
+    def terminate_all(self):
+        with self._lock:
+            replicas = list(self._replicas.values())
+            self._replicas.clear()
+        for replica in replicas:
+            if replica.alive():
+                replica.proc.send_signal(signal.SIGCONT)  # unwedge first
+                replica.proc.terminate()
+        for replica in replicas:
+            try:
+                replica.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                replica.proc.kill()
+                replica.proc.wait(timeout=10)

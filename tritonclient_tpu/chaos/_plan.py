@@ -1,0 +1,213 @@
+"""The tpuchaos schedule DSL: which fault fires at which site, when.
+
+A plan is a ``;``-separated list of rules::
+
+    http.response=reset@nth=3; http.connect=refused@p=0.05;
+    fleet.exchange.response=latency@ms=40@every=7@until=2.5
+
+Each rule is ``<site>=<fault>`` followed by ``@key=value`` triggers:
+
+=========  =================================================================
+key        meaning
+=========  =================================================================
+``p``      fire with this probability per call (seeded RNG — deterministic)
+``nth``    fire on exactly the Nth call to the site (1-based)
+``every``  fire on every Nth call (1-based phase: call N, 2N, ...)
+``after``  only fire at/after this many seconds since enable()
+``until``  only fire strictly before this many seconds since enable()
+``ms``     fault parameter: injected latency in milliseconds
+``max``    stop after this many injections from this rule
+=========  =================================================================
+
+With no ``p``/``nth``/``every`` trigger the rule fires on EVERY call in
+its time window. Fault names are validated here (:data:`FAULTS`) so a
+typo fails at parse time, not silently never-fires. Site names are free
+identifiers — the choke points in clients/router/shm spell theirs as
+module constants; :func:`tritonclient_tpu.chaos.fire` matches by exact
+site, with a rule site of ``*`` matching every choke point.
+
+Determinism: every probabilistic decision draws from a per-rule
+``random.Random`` seeded from ``(plan seed, rule index)``, and counters
+are per-rule — the same seed + plan + call sequence injects the same
+faults, which is what lets CI assert byte-identical chaos reports
+across runs.
+"""
+
+import random
+from typing import List, Optional
+
+#: Fault kinds the injector can enact. Process-level faults
+#: (``sigkill``/``sigstop``) are enacted by the ChaosController against
+#: replica subprocesses it owns; everything else is enacted in-process
+#: at a choke point.
+FAULT_REFUSED = "refused"        # ConnectionRefusedError at connect
+FAULT_RESET = "reset"            # ConnectionResetError (peer RST / mid-response FIN)
+FAULT_PARTIAL = "partial"        # BrokenPipeError after a partial write
+FAULT_TIMEOUT = "timeout"        # socket.timeout (slow/partial I/O bound hit)
+FAULT_LATENCY = "latency"        # sleep ``ms`` then continue (no error)
+FAULT_UNAVAILABLE = "unavailable"  # gRPC UNAVAILABLE (channel/stream breakage)
+FAULT_ENOMEM = "enomem"          # OSError(ENOMEM) — shm mmap/register failure
+FAULT_SIGKILL = "sigkill"        # controller: SIGKILL the target replica
+FAULT_SIGSTOP = "sigstop"        # controller: SIGSTOP (wedge) the target replica
+
+FAULTS = frozenset({
+    FAULT_REFUSED,
+    FAULT_RESET,
+    FAULT_PARTIAL,
+    FAULT_TIMEOUT,
+    FAULT_LATENCY,
+    FAULT_UNAVAILABLE,
+    FAULT_ENOMEM,
+    FAULT_SIGKILL,
+    FAULT_SIGSTOP,
+})
+
+
+class PlanError(ValueError):
+    """A plan string that does not parse (bad fault, bad trigger key)."""
+
+
+class Rule:
+    """One parsed plan rule plus its runtime trigger state."""
+
+    __slots__ = (
+        "site", "fault", "p", "nth", "every", "after_s", "until_s",
+        "ms", "max_count", "index", "_rng", "calls", "injections",
+    )
+
+    def __init__(self, site: str, fault: str, index: int = 0,
+                 p: Optional[float] = None, nth: Optional[int] = None,
+                 every: Optional[int] = None,
+                 after_s: Optional[float] = None,
+                 until_s: Optional[float] = None,
+                 ms: float = 0.0, max_count: Optional[int] = None):
+        if fault not in FAULTS:
+            raise PlanError(
+                f"unknown fault '{fault}' (have: {', '.join(sorted(FAULTS))})"
+            )
+        self.site = site
+        self.fault = fault
+        self.index = index
+        self.p = p
+        self.nth = nth
+        self.every = every
+        self.after_s = after_s
+        self.until_s = until_s
+        self.ms = ms
+        self.max_count = max_count
+        self._rng: Optional[random.Random] = None
+        self.calls = 0
+        self.injections = 0
+
+    def seed(self, plan_seed: int):
+        """(Re)seed this rule's RNG and reset counters — called by
+        ``Plan.seed`` at enable time so a plan object can be reused."""
+        self._rng = random.Random((plan_seed << 8) ^ self.index)
+        self.calls = 0
+        self.injections = 0
+
+    def matches(self, site: str) -> bool:
+        return self.site == "*" or self.site == site
+
+    def decide(self, elapsed_s: float) -> bool:
+        """One call at a matching site: count it, and say whether this
+        rule fires. Counters advance even outside the time window so
+        ``nth``/``every`` stay call-indexed, not window-indexed."""
+        self.calls += 1
+        if self.max_count is not None and self.injections >= self.max_count:
+            return False
+        if self.after_s is not None and elapsed_s < self.after_s:
+            return False
+        if self.until_s is not None and elapsed_s >= self.until_s:
+            return False
+        if self.nth is not None:
+            fire = self.calls == self.nth
+        elif self.every is not None:
+            fire = self.calls % self.every == 0
+        elif self.p is not None:
+            if self._rng is None:
+                self.seed(0)
+            fire = self._rng.random() < self.p
+        else:
+            fire = True
+        if fire:
+            self.injections += 1
+        return fire
+
+    def spec(self) -> str:
+        parts = [f"{self.site}={self.fault}"]
+        for key, value in (
+            ("p", self.p), ("nth", self.nth), ("every", self.every),
+            ("after", self.after_s), ("until", self.until_s),
+            ("ms", self.ms or None), ("max", self.max_count),
+        ):
+            if value is not None:
+                parts.append(f"{key}={value:g}" if isinstance(value, float)
+                             else f"{key}={value}")
+        return "@".join(parts)
+
+
+_INT_KEYS = {"nth", "every", "max"}
+_FLOAT_KEYS = {"p", "after", "until", "ms"}
+
+
+def parse_plan(text: str) -> List[Rule]:
+    """Parse a plan string into rules (empty string = no rules)."""
+    rules: List[Rule] = []
+    for chunk in (text or "").split(";"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        head, *mods = chunk.split("@")
+        site, sep, fault = head.partition("=")
+        if not sep or not site.strip() or not fault.strip():
+            raise PlanError(f"rule '{chunk}' is not '<site>=<fault>[@k=v]'")
+        kwargs = {}
+        for mod in mods:
+            key, sep, value = mod.partition("=")
+            key = key.strip()
+            if not sep:
+                raise PlanError(f"trigger '{mod}' is not 'key=value'")
+            try:
+                if key in _INT_KEYS:
+                    parsed = int(value)
+                elif key in _FLOAT_KEYS:
+                    parsed = float(value)
+                else:
+                    raise PlanError(
+                        f"unknown trigger key '{key}' in '{chunk}'"
+                    )
+            except ValueError:
+                raise PlanError(
+                    f"trigger '{mod}': value does not parse"
+                ) from None
+            kwargs[{"after": "after_s", "until": "until_s",
+                    "max": "max_count"}.get(key, key)] = parsed
+        rules.append(Rule(site.strip(), fault.strip(),
+                          index=len(rules), **kwargs))
+    return rules
+
+
+class Plan:
+    """A parsed plan: rules + the seed that makes it deterministic."""
+
+    def __init__(self, text: str = "", seed: int = 0):
+        self.text = text or ""
+        self.seed_value = int(seed)
+        self.rules = parse_plan(self.text)
+        self.reseed()
+
+    def reseed(self):
+        for rule in self.rules:
+            rule.seed(self.seed_value)
+
+    def for_site(self, site: str) -> List[Rule]:
+        return [r for r in self.rules if r.matches(site)]
+
+    def process_rules(self) -> List[Rule]:
+        """Rules enacted by the ChaosController (sigkill/sigstop) rather
+        than an in-process choke point; their site names the replica."""
+        return [
+            r for r in self.rules
+            if r.fault in (FAULT_SIGKILL, FAULT_SIGSTOP)
+        ]

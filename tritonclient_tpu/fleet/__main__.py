@@ -69,6 +69,24 @@ def main(argv=None) -> int:
         "requests without a tenant-id header",
     )
     parser.add_argument("--pressure-queue-depth", type=int, default=32)
+    parser.add_argument(
+        "--retry-attempts", type=int, default=3, metavar="N",
+        help="failover RetryPolicy attempts per proxied infer (connect/"
+        "send-phase failures always fail over; post-send only with an "
+        "idempotency-key header)",
+    )
+    parser.add_argument(
+        "--hedge-us", type=int, default=0, metavar="US",
+        help="hedge idempotent unary infers onto a second replica after "
+        "US microseconds without a response (0 = off); loser cancelled",
+    )
+    parser.add_argument(
+        "--breaker-failures", type=int, default=3, metavar="N",
+        help="consecutive proxy failures that open a replica's circuit "
+        "breaker (excluded from routing until the cooldown probe)",
+    )
+    parser.add_argument("--breaker-reset", type=float, default=2.0,
+                        metavar="SECONDS")
     parser.add_argument("--probe-interval", type=float, default=1.0,
                         metavar="SECONDS")
     parser.add_argument("--host", default="127.0.0.1")
@@ -85,12 +103,18 @@ def main(argv=None) -> int:
     if not replicas:
         parser.error("at least one --replica / --replica-address-file")
 
+    from tritonclient_tpu.resilience import RetryPolicy
+
     replica_set = ReplicaSet(probe_interval_s=args.probe_interval)
     router = FleetRouter(
         replicas=replica_set,
         policy=args.policy,
         quotas=dict(args.quota),
         pressure_queue_depth=args.pressure_queue_depth,
+        retry_policy=RetryPolicy(max_attempts=max(args.retry_attempts, 1)),
+        breaker_failure_threshold=args.breaker_failures,
+        breaker_reset_s=args.breaker_reset,
+        hedge_us=args.hedge_us or None,
     )
     for name, http_addr, grpc_addr in replicas:
         router.add_replica(name, http_addr, grpc_addr)

@@ -19,15 +19,20 @@ with typed protos; shared-nothing admin RPCs (shm registration,
 repository control, trace/log settings) fan out to every ready replica.
 """
 
+import collections
+import queue as queue_module
+import threading
 from concurrent import futures
 from typing import Dict, Optional, Tuple
 
 import grpc
 
-from tritonclient_tpu import sanitize
+from tritonclient_tpu import chaos, sanitize
 from tritonclient_tpu.fleet._router import FleetError, FleetRouter
+from tritonclient_tpu.grpc._client import classify_rpc_error
 from tritonclient_tpu.protocol import pb
 from tritonclient_tpu.protocol._literals import (
+    HEADER_IDEMPOTENCY_KEY,
     HEADER_TENANT_ID,
     STATUS_OVER_QUOTA,
 )
@@ -182,66 +187,158 @@ def make_router_handler(router: FleetRouter,
         )
 
     def model_infer(request: bytes, context):
+        """Unary inference: admission + balance + policy-driven
+        failover (same RetryPolicy instance as the HTTP proxy, so the
+        retry budget and counters are router-global). UNAVAILABLE with
+        a connect-phase detail is provably pre-execution; any other
+        failure fails over only when the caller sent an idempotency
+        key."""
         meta = _call_metadata(context)
         tenant = meta.get(HEADER_TENANT_ID, "")
-        try:
-            lease = router.begin(tenant)
-        except FleetError as e:
-            context.abort(_code_for(e), str(e))
+        idempotent = HEADER_IDEMPOTENCY_KEY in meta
         fwd = _forward_metadata(meta)
-        try:
-            reply = channels.unary(
-                lease.replica.grpc_address, "ModelInfer"
-            )(request, metadata=fwd, timeout=_deadline(context))
-        except grpc.RpcError as e:
-            code = e.code()
-            lease.release(failed=True)
-            if code == grpc.StatusCode.UNAVAILABLE:
-                # Transport-level failure: the request never reached a
-                # handler, so one retry on a different replica is safe
-                # (fresh admission charge, like the HTTP proxy).
+        policy = router.retry_policy
+        attempt = 0
+        exclude = []
+        with chaos.operation("fleet.grpc.infer"):
+            while True:
                 try:
-                    retry = router.begin(
-                        tenant, exclude=(lease.replica.name,)
-                    )
-                except FleetError as fe:
-                    context.abort(_code_for(fe), str(fe))
+                    lease = router.begin(tenant, exclude=tuple(exclude))
+                except FleetError as e:
+                    context.abort(_code_for(e), str(e))
                 try:
+                    chaos.fire(chaos.SITE_GRPC_CALL)
                     reply = channels.unary(
-                        retry.replica.grpc_address, "ModelInfer"
+                        lease.replica.grpc_address, "ModelInfer"
                     )(request, metadata=fwd, timeout=_deadline(context))
-                except grpc.RpcError as re:
-                    retry.release(failed=True)
-                    context.abort(re.code(), re.details())
-                retry.release()
+                except grpc.RpcError as e:
+                    lease.release(failed=True)
+                    router.note_replica_result(lease.replica, ok=False)
+                    if policy.should_retry(
+                        attempt,
+                        classify_rpc_error(policy, e,
+                                           idempotent=idempotent),
+                    ):
+                        exclude.append(lease.replica.name)
+                        policy.sleep(attempt)
+                        attempt += 1
+                        continue
+                    context.abort(e.code(), e.details())
+                router.note_replica_result(lease.replica, ok=True)
+                policy.note_success()
+                lease.release()
                 return reply
-            context.abort(code, e.details())
-        lease.release()
-        return reply
 
     def model_stream_infer(request_iterator, context):
+        """Sticky stream with crash resume.
+
+        The stream leases one replica at open (rendezvous affinity). If
+        that replica dies mid-stream, the stream RE-ESTABLISHES on a
+        surviving replica: the rendezvous hash remaps the affinity key
+        over the survivors, and piping continues. Requests that were
+        sent but unanswered at the break are replayed on the new
+        replica when the stream's metadata carries an idempotency key
+        (the server answers a stream's requests in order, so the
+        unanswered set is an exact FIFO suffix); without the key they
+        are dropped and only future requests flow — resumption either
+        way, double-execution never without the caller's opt-in.
+        """
         meta = _call_metadata(context)
         tenant = meta.get(HEADER_TENANT_ID, "")
         affinity = meta.get(HEADER_STREAM_AFFINITY, "") or tenant
-        try:
-            lease = router.begin(tenant, affinity_key=affinity)
-        except FleetError as e:
-            context.abort(_code_for(e), str(e))
+        idempotent = HEADER_IDEMPOTENCY_KEY in meta
         fwd = _forward_metadata(meta)
-        call = channels.stream(
-            lease.replica.grpc_address, "ModelStreamInfer"
-        )(request_iterator, metadata=fwd, timeout=_deadline(context))
-        # Client cancellation tears down the downstream stream too, so
-        # the replica's stream-cancel event fires and queued work sheds.
-        context.add_callback(call.cancel)
-        try:
-            for message in call:
-                yield message
-        except grpc.RpcError as e:
-            lease.release(failed=True)
-            context.abort(e.code(), e.details())
-        finally:
-            lease.release()
+        policy = router.retry_policy
+
+        # One pump thread owns the inbound iterator for the stream's
+        # whole life (across downstream incarnations).
+        inbound: "queue_module.Queue" = queue_module.Queue()
+        closed = object()
+
+        def pump():
+            try:
+                for message in request_iterator:
+                    inbound.put(message)
+            except Exception:  # noqa: BLE001 — client went away
+                pass
+            finally:
+                inbound.put(closed)
+
+        threading.Thread(
+            target=pump, daemon=True, name="fleet-stream-pump"
+        ).start()
+
+        # FIFO of messages sent downstream but not yet answered — the
+        # replay set for an idempotent resume (the server answers a
+        # stream's requests in order, so this is an exact suffix).
+        unanswered = collections.deque()
+        replay = []
+        attempt = 0
+        exclude = []
+        while True:
+            try:
+                lease = router.begin(tenant, affinity_key=affinity,
+                                     exclude=tuple(exclude))
+            except FleetError as e:
+                context.abort(_code_for(e), str(e))
+            stop = threading.Event()
+
+            def feed(replay_now=tuple(replay), stop=stop):
+                # Replays and fresh messages are tracked uniformly:
+                # append to ``unanswered`` BEFORE yield, so a message
+                # that reaches a dying call counts as unanswered, never
+                # lost.
+                for message in replay_now:
+                    unanswered.append(message)
+                    yield message
+                while not stop.is_set():
+                    try:
+                        message = inbound.get(timeout=0.05)
+                    except queue_module.Empty:
+                        continue
+                    if message is closed:
+                        # Future incarnations must see EOF too.
+                        inbound.put(closed)
+                        return
+                    unanswered.append(message)
+                    yield message
+
+            call = channels.stream(
+                lease.replica.grpc_address, "ModelStreamInfer"
+            )(feed(), metadata=fwd, timeout=_deadline(context))
+            # Client cancellation tears down the downstream stream too,
+            # so the replica's stream-cancel event fires and queued work
+            # sheds.
+            context.add_callback(call.cancel)
+            try:
+                for message in call:
+                    if unanswered:
+                        unanswered.popleft()
+                    yield message
+                lease.release()
+                return
+            except grpc.RpcError as e:
+                stop.set()
+                lease.release(failed=True)
+                router.note_replica_result(lease.replica, ok=False)
+                # Resumption itself is always safe (it sends nothing by
+                # itself), so eligibility is judged as-if idempotent;
+                # whether the unanswered suffix is REPLAYED is gated on
+                # the caller's actual opt-in below.
+                reason = classify_rpc_error(policy, e, idempotent=True)
+                if reason is not None and policy.should_retry(
+                    attempt, reason
+                ):
+                    exclude.append(lease.replica.name)
+                    replay = list(unanswered) if idempotent else []
+                    unanswered.clear()
+                    policy.sleep(attempt)
+                    attempt += 1
+                    continue
+                context.abort(e.code(), e.details())
+            finally:
+                stop.set()
+                lease.release()
 
     def forward(name: str):
         fan_out = name in _FAN_OUT_METHODS

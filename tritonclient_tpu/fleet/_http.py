@@ -25,15 +25,21 @@ replica when the request never reached processing.
 """
 
 import json
+import queue
 import socket
 import threading
 from http.client import HTTPConnection
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
-from tritonclient_tpu import sanitize
+from tritonclient_tpu import chaos, sanitize
 from tritonclient_tpu.fleet._replica import Replica
 from tritonclient_tpu.fleet._router import FleetError, FleetRouter
+from tritonclient_tpu.resilience import (
+    PHASE_CONNECT,
+    PHASE_RESPONSE,
+    PHASE_SEND,
+)
 from tritonclient_tpu.protocol._literals import (
     EP_FLEET_STATUS,
     EP_HEALTH_LIVE,
@@ -42,7 +48,13 @@ from tritonclient_tpu.protocol._literals import (
     EP_METRICS,
     EP_TRACE_SETTING,
     FLEET_REPLICA_ROUTE_RE,
+    HEADER_HEDGE_ATTEMPT,
+    HEADER_IDEMPOTENCY_KEY,
+    HEADER_RETRY_ATTEMPT,
     HEADER_TENANT_ID,
+    HEDGE_OUTCOME_FAILED,
+    HEDGE_OUTCOME_HEDGE,
+    HEDGE_OUTCOME_PRIMARY,
     MODEL_ROUTE_RE,
     REPOSITORY_ROUTE_RE,
     SHM_ROUTE_RE,
@@ -56,9 +68,22 @@ _FORWARD_REQUEST_HEADERS = (
     "accept-encoding",
     "inference-header-content-length",
     HEADER_TENANT_ID,
+    HEADER_IDEMPOTENCY_KEY,
     "traceparent",
     "triton-request-id",
 )
+
+
+class _ExchangeError(Exception):
+    """One failed proxied exchange, tagged with the request phase it
+    failed in — the input to RetryPolicy.classify (connect/send are
+    provably pre-execution; response means the replica may have
+    executed the request)."""
+
+    def __init__(self, phase: str, cause: BaseException):
+        super().__init__(f"{phase}: {cause}")
+        self.phase = phase
+        self.cause = cause
 
 #: Response headers relayed back to the caller.
 _FORWARD_RESPONSE_HEADERS = (
@@ -96,6 +121,16 @@ class _ConnPool:
                 free.append(conn)
                 return
         conn.close()
+
+    def invalidate(self, address: str):
+        """Drop every pooled connection to one address. Called when a
+        replica rejoins after a crash: a keep-alive connection opened to
+        the DEAD incarnation must never carry traffic to what is now a
+        different process (or, in-process, a zombie handler thread)."""
+        with self._lock:
+            conns = self._free.pop(address, [])
+        for conn in conns:
+            conn.close()
 
     def close(self):
         with self._lock:
@@ -160,6 +195,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._route(method)
         except FleetError as e:
             self._send_fleet_error(e)
+        except _ExchangeError as e:
+            # A proxied non-inference exchange failed (inference paths
+            # handle their own failover before this).
+            self._send_json({"error": f"replica unreachable: {e}"}, 502)
         except (BrokenPipeError, ConnectionResetError):
             pass
         except Exception as e:  # noqa: BLE001 — a bug fails the request
@@ -177,13 +216,32 @@ class _RouterHandler(BaseHTTPRequestHandler):
         return headers
 
     def _exchange(self, address: str, method: str, body: bytes,
-                  headers: dict) -> Tuple[int, dict, bytes]:
+                  headers: dict,
+                  conn_box: Optional[dict] = None
+                  ) -> Tuple[int, dict, bytes]:
         """One proxied exchange over a pooled connection. Transport
-        failures close the connection and re-raise (the caller decides
-        whether a retry is safe)."""
-        conn = self.pool.get(address)
+        failures close the connection and raise :class:`_ExchangeError`
+        tagged with the phase (connect / send / response) so the caller
+        can decide whether a replay is provably safe. ``conn_box``, when
+        given, exposes the live connection under ``conn_box["conn"]`` so
+        a hedging caller can cancel this exchange by shutting the socket
+        down (the replica's disconnect watcher then sheds the work)."""
+        phase = PHASE_CONNECT
+        conn = None
         try:
+            chaos.fire(chaos.SITE_FLEET_CONNECT)
+            conn = self.pool.get(address)
+            if conn.sock is None:
+                conn.connect()
+            if conn_box is not None:
+                conn_box["conn"] = conn
+            phase = PHASE_SEND
+            chaos.fire(chaos.SITE_FLEET_SEND)
             conn.request(method, self.path, body=body, headers=headers)
+            # Request fully written: a failure past this point is no
+            # longer provably pre-execution.
+            phase = PHASE_RESPONSE
+            chaos.fire(chaos.SITE_FLEET_RESPONSE)
             resp = conn.getresponse()
             payload = resp.read()
             relay = {
@@ -192,9 +250,12 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 if resp.headers.get(k) is not None
             }
             status = resp.status
-        except (OSError, socket.timeout):
-            conn.close()
-            raise
+        except (OSError, socket.timeout) as e:
+            if conn is not None:
+                conn.close()
+            raise _ExchangeError(phase, e) from e
+        if conn_box is not None:
+            conn_box["conn"] = None
         self.pool.put(address, conn)
         return status, relay, payload
 
@@ -287,44 +348,200 @@ class _RouterHandler(BaseHTTPRequestHandler):
     def _fan_out(self, method: str, body: bytes):
         """Forward to EVERY ready replica; first failure wins the reply
         (the caller must see that the fleet is not uniformly configured),
-        else the last response is relayed."""
+        else the last response is relayed. Uniformly applied operations
+        are journaled so a replica rejoining after a crash gets them
+        replayed before it is routable again."""
         replicas = self.router.replica_set.routable()
         if not replicas:
             raise FleetError("no ready replicas in the fleet", 503)
+        headers = self._forward_headers(body)
         last = None
         for replica in replicas:
             status, relay, payload = self._exchange(
-                replica.http_address, method, body,
-                self._forward_headers(body),
+                replica.http_address, method, body, headers,
             )
             if status >= 400:
                 return self._relay(status, relay, payload)
             last = (status, relay, payload)
+        self.router.record_admin(
+            method, self.path.split("?", 1)[0], body, headers
+        )
         return self._relay(*last)
 
     def _infer(self, body: bytes):
+        """Inference proxy: admission + balance + policy-driven
+        failover.
+
+        The PR-8 behavior here was an UNCONDITIONAL "one safe retry on
+        transport failure" — which can re-send a non-idempotent infer
+        whose first attempt may have executed (the failure could be a
+        mid-response FIN *after* the replica ran the model). Replays now
+        go through the router's RetryPolicy: connect/send-phase failures
+        (provably pre-execution) fail over to a different replica;
+        post-send failures fail over ONLY when the request carries an
+        idempotency key. Idempotent requests are additionally eligible
+        for hedging (``hedge_us``).
+        """
         tenant = self.headers.get(HEADER_TENANT_ID, "")
+        idempotent = self.headers.get(HEADER_IDEMPOTENCY_KEY) is not None
         router = self.router
-        lease = router.begin(tenant)  # FleetError 429/503 -> _dispatch
-        try:
-            status = self._proxy_one(lease.replica, "POST", body)
-        except (OSError, socket.timeout):
-            # The replica died under us before answering. Release the
-            # failed lease and retry ONCE on a different replica — a
-            # fresh admission charge, deliberately conservative (a
-            # retry is a second unit of offered load).
-            lease.release(failed=True)
-            retry = router.begin(tenant, exclude=(lease.replica.name,))
+        if router.hedge_enabled(idempotent):
+            return self._infer_hedged(body, tenant)
+        policy = router.retry_policy
+        attempt = 0
+        exclude: List[str] = []
+        with chaos.operation("fleet.infer"):
+            while True:
+                lease = router.begin(tenant, exclude=tuple(exclude))
+                headers = self._forward_headers(body)
+                if attempt:
+                    headers[HEADER_RETRY_ATTEMPT] = str(attempt)
+                try:
+                    status, relay, payload = self._exchange(
+                        lease.replica.http_address, "POST", body, headers
+                    )
+                except _ExchangeError as failure:
+                    lease.release(failed=True)
+                    router.note_replica_result(lease.replica, ok=False)
+                    reason = policy.classify(
+                        failure.phase, idempotent=idempotent
+                    )
+                    if policy.should_retry(attempt, reason):
+                        exclude.append(lease.replica.name)
+                        policy.sleep(attempt)
+                        attempt += 1
+                        continue
+                    raise FleetError(
+                        f"replica {lease.replica.name} unreachable "
+                        f"({failure.phase} phase): {failure.cause}", 502
+                    )
+                router.note_replica_result(lease.replica, ok=status < 500)
+                if status < 500:
+                    policy.note_success()
+                lease.release(failed=status >= 500)
+                return self._relay(status, relay, payload)
+
+    def _infer_hedged(self, body: bytes, tenant: str):
+        """Hedged unary inference: launch the primary, and when it has
+        not answered within ``hedge_us`` (or failed outright), launch a
+        second attempt on a different replica. First success wins; the
+        loser's connection is shut down so the replica's disconnect
+        watcher sheds its queued work (PR-7 cancellation).
+
+        Chaos accounting note: attempts run on worker threads, so
+        injections here are not attributed to a thread-local
+        ``chaos.operation`` — a hedged request's fault tolerance is read
+        from ``nv_fleet_hedges_total`` outcomes instead."""
+        router = self.router
+        results: "queue.Queue" = queue.Queue()
+
+        def run(tag: str, lease, headers: dict, box: dict):
             try:
-                status = self._proxy_one(retry.replica, "POST", body)
-            except (OSError, socket.timeout) as e:
-                retry.release(failed=True)
-                raise FleetError(
-                    f"replica {retry.replica.name} unreachable: {e}", 502
+                out = self._exchange(
+                    lease.replica.http_address, "POST", body, headers,
+                    conn_box=box,
                 )
-            retry.release(failed=status >= 500)
-            return
-        lease.release(failed=status >= 500)
+                results.put((tag, lease, box, out, None))
+            except _ExchangeError as failure:
+                results.put((tag, lease, box, None, failure))
+
+        def launch(tag: str, exclude=()):
+            lease = router.begin(tenant, exclude=exclude)
+            headers = self._forward_headers(body)
+            if tag != "primary":
+                headers[HEADER_HEDGE_ATTEMPT] = "1"
+            box: dict = {"conn": None}
+            thread = threading.Thread(
+                target=run, args=(tag, lease, headers, box),
+                daemon=True, name=f"fleet-hedge-{tag}",
+            )
+            thread.start()
+            return lease, box
+
+        def cancel(box: dict):
+            conn = box.get("conn")
+            if conn is None:
+                return
+            sock = getattr(conn, "sock", None)
+            if sock is not None:
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+        boxes: Dict[str, dict] = {}
+        winner = None
+        failures = []
+
+        def handle(item):
+            nonlocal winner
+            tag, lease, box, out, failure = item
+            cancelled = box.get("cancelled", False)
+            if failure is not None or out[0] >= 500:
+                # A cancel-induced failure is the router's own doing —
+                # neither a lease failure nor breaker evidence.
+                lease.release(failed=not cancelled)
+                if not cancelled:
+                    router.note_replica_result(lease.replica, ok=False)
+                failures.append((tag, out, failure))
+                return
+            router.note_replica_result(lease.replica, ok=True)
+            lease.release()
+            if winner is None:
+                winner = (tag, out)
+            # else: both answered before the cancel landed; the slower
+            # response is simply dropped.
+
+        primary_lease, primary_box = launch("primary")
+        boxes["primary"] = primary_box
+        remaining = 1
+        try:
+            first = results.get(timeout=router.hedge_us / 1e6)
+        except queue.Empty:
+            first = None
+        hedged = False
+        if first is not None:
+            remaining -= 1
+        if first is None or first[4] is not None or first[3][0] >= 500:
+            # Primary slow (hedge) or already failed (failover): second
+            # attempt on a different replica — a fresh admission charge.
+            try:
+                _, hedge_box = launch(
+                    "hedge", exclude=(primary_lease.replica.name,)
+                )
+                boxes["hedge"] = hedge_box
+                hedged = True
+                remaining += 1
+            except FleetError:
+                pass  # nowhere to hedge; ride the primary alone
+        if first is not None:
+            handle(first)
+        while remaining:
+            if winner is not None:
+                # Cancel the still-running loser: the socket shutdown
+                # makes its replica's disconnect watcher shed the work.
+                for tag, box in boxes.items():
+                    if tag != winner[0] and not box.get("cancelled"):
+                        box["cancelled"] = True
+                        cancel(box)
+            handle(results.get())
+            remaining -= 1
+        if hedged:
+            if winner is None:
+                router.note_hedge(HEDGE_OUTCOME_FAILED)
+            else:
+                router.note_hedge(
+                    HEDGE_OUTCOME_PRIMARY if winner[0] == "primary"
+                    else HEDGE_OUTCOME_HEDGE
+                )
+        if winner is not None:
+            return self._relay(*winner[1])
+        tag, out, failure = failures[-1]
+        if out is not None:
+            return self._relay(*out)
+        raise FleetError(
+            f"all hedged attempts failed: {failure.cause}", 502
+        )
 
 
 class _RouterHTTPServer(ThreadingHTTPServer):
@@ -340,6 +557,14 @@ class RouterHTTPFrontend:
         self._server = _RouterHTTPServer((host, port), _RouterHandler)
         self._server.router = router
         self._server.pool = _ConnPool()
+        # A rejoined (crash-restarted) replica is a NEW process on the
+        # old address: pooled keep-alive connections to the dead
+        # incarnation must be dropped before traffic resumes.
+        router.add_rejoin_listener(
+            lambda replica: self._server.pool.invalidate(
+                replica.http_address
+            )
+        )
         self._server.verbose = verbose
         self._server.daemon_threads = True
         self._server.socket.setsockopt(

@@ -77,6 +77,14 @@ class Replica:
         self.requests_total = 0
         self.failures_total = 0
         self.last_error = ""
+        # Crash-recovery bookkeeping: a probe failure marks the replica
+        # as needing admin-state replay (a restarted process has empty
+        # shm/repository/trace state even though it reports READY); the
+        # ReplicaSet's on_rejoin hook must succeed before the replica
+        # becomes routable again. ``restarts`` counts completed rejoins
+        # (the nv_fleet_replica_restarts_total family).
+        self.needs_replay = False
+        self.restarts = 0
 
     @property
     def routable(self) -> bool:
@@ -95,6 +103,8 @@ class Replica:
             "consecutive_failures": self.consecutive_failures,
             "requests_total": self.requests_total,
             "failures_total": self.failures_total,
+            "restarts": self.restarts,
+            "needs_replay": self.needs_replay,
             "last_error": self.last_error,
         }
 
@@ -133,6 +143,12 @@ class ReplicaSet:
         self.probe_timeout_s = float(probe_timeout_s)
         self._clock = clock
         self._replicas: Dict[str, Replica] = {}
+        # Crash-recovery hook: ``on_rejoin(replica) -> bool`` is called
+        # (no locks held — it does network I/O) when a replica that
+        # previously failed probes reports ready again; the replica only
+        # becomes routable when the hook returns True. The FleetRouter
+        # installs its admin-state replay here.
+        self.on_rejoin = None
         self._lock = sanitize.named_lock("fleet.ReplicaSet._lock")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -239,10 +255,17 @@ class ReplicaSet:
 
     def _apply(self, replica: Replica, obs: dict):
         now = self._clock()
+        rejoin_hook = None
         with self._lock:
             if not obs["ok"]:
                 replica.consecutive_failures += 1
                 replica.last_error = obs.get("error", "")
+                # A transport-failed probe means the process may have
+                # crashed (and restarted empty): whatever comes back on
+                # this address must have admin state replayed before it
+                # is routable again.
+                if replica.state != ReplicaState.DRAINED:
+                    replica.needs_replay = True
                 if replica.state in (
                     ReplicaState.READY, ReplicaState.JOINING,
                 ) and replica.consecutive_failures >= self.eject_after:
@@ -276,11 +299,36 @@ class ReplicaSet:
                 # endpoint directly): stop routing, track settlement.
                 replica.state = ReplicaState.DRAINING
             elif obs["ready"]:
-                replica.state = ReplicaState.READY
-                replica.ejections = 0
+                if replica.needs_replay and self.on_rejoin is not None:
+                    # Rejoin after a crash: replay admin state OUTSIDE
+                    # the lock before the replica becomes routable.
+                    rejoin_hook = self.on_rejoin
+                else:
+                    if replica.needs_replay:
+                        replica.needs_replay = False
+                        replica.restarts += 1
+                    replica.state = ReplicaState.READY
+                    replica.ejections = 0
             else:
                 # Alive but declining traffic: not routable, not a fault.
                 replica.state = ReplicaState.JOINING
+        if rejoin_hook is not None:
+            try:
+                replayed = bool(rejoin_hook(replica))
+            except Exception:  # a replay bug must not kill the prober
+                replayed = False
+            with self._lock:
+                if replayed:
+                    replica.needs_replay = False
+                    replica.restarts += 1
+                    replica.state = ReplicaState.READY
+                    replica.ejections = 0
+                elif replica.state not in (
+                    ReplicaState.DRAINING, ReplicaState.DRAINED,
+                ):
+                    # Not servable yet: stay out of routing; the next
+                    # probe retries the replay.
+                    replica.state = ReplicaState.JOINING
 
     # -- drain ----------------------------------------------------------------
 

@@ -6,14 +6,18 @@ the lease on completion — so quota accounting, outstanding counts, and
 the ``/metrics`` families cannot diverge between transports.
 """
 
-from typing import Dict, Optional, Union
+from typing import Dict, List, Optional, Tuple, Union
 
 from tritonclient_tpu import sanitize
 from tritonclient_tpu.fleet._admission import AdmissionController, TenantQuota
 from tritonclient_tpu.fleet._policy import Policy, affinity_select, make_policy
-from tritonclient_tpu.fleet._replica import Replica, ReplicaSet
+from tritonclient_tpu.fleet._replica import Replica, ReplicaSet, http_call
+from tritonclient_tpu.resilience import CircuitBreaker, RetryPolicy
 from tritonclient_tpu.protocol._literals import (
+    BREAKER_STATE_VALUES,  # noqa: F401 — re-exported for front-ends
+    HEDGE_OUTCOMES,
     QUOTA_REASONS,
+    RETRY_REASONS,
     STATUS_OVER_QUOTA,
 )
 
@@ -61,7 +65,12 @@ class FleetRouter:
                  policy: Union[str, Policy] = "least-outstanding",
                  quotas: Optional[Dict[str, TenantQuota]] = None,
                  admission: Optional[AdmissionController] = None,
-                 pressure_queue_depth: int = 32):
+                 pressure_queue_depth: int = 32,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 breaker_failure_threshold: int = 3,
+                 breaker_reset_s: float = 2.0,
+                 hedge_us: Optional[int] = None,
+                 hedge_all: bool = False):
         self._set = replicas if replicas is not None else ReplicaSet()
         self.policy = (
             policy if isinstance(policy, Policy) else make_policy(policy)
@@ -71,11 +80,46 @@ class FleetRouter:
         # queue depth at/above this, low-priority tenants shed at
         # admission (reason=pressure).
         self.pressure_queue_depth = int(pressure_queue_depth)
+        # Failover policy shared by both front-ends: connect/send-phase
+        # proxy failures replay on a different replica; post-send
+        # failures replay only with an idempotency key (the PR-8
+        # unconditional "one safe retry" could double-execute).
+        self.retry_policy = retry_policy if retry_policy is not None else (
+            RetryPolicy(max_attempts=3, base_delay_s=0.02, max_delay_s=0.5)
+        )
+        # Per-replica circuit breakers: a replica that keeps failing
+        # proxied exchanges is excluded from candidate selection for
+        # ``breaker_reset_s`` even while the (slower) health prober still
+        # calls it READY; the next request after cooldown is the probe.
+        self.breaker_failure_threshold = int(breaker_failure_threshold)
+        self.breaker_reset_s = float(breaker_reset_s)
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        # Hedged unary inference: after ``hedge_us`` with no primary
+        # response, a second attempt goes to a different replica and the
+        # loser is cancelled. Hedging doubles execution on the slow
+        # path, so it is gated on the idempotency key unless
+        # ``hedge_all`` opts every request in.
+        self.hedge_us = int(hedge_us) if hedge_us else None
+        self.hedge_all = bool(hedge_all)
+        self._hedge_counts = {outcome: 0 for outcome in HEDGE_OUTCOMES}
+        # Journaled admin state: every successfully fanned-out admin
+        # operation (shm registration, repository load/unload, trace/log
+        # settings) in arrival order, replayed to a replica that rejoins
+        # after a crash so it is servable, not merely READY.
+        self._journal: List[Tuple[str, str, bytes, dict]] = []
+        # Rejoin listeners: front-ends register cleanup here (e.g. the
+        # HTTP proxy invalidates pooled keep-alive connections to the
+        # dead incarnation) — run BEFORE the admin-state replay.
+        self._rejoin_listeners: List = []
+        self._resilience_lock = sanitize.named_lock(
+            "fleet.FleetRouter._resilience_lock"
+        )
         # Policy selection is not thread-safe by contract (round-robin
         # counters, p2c RNG); one small named lock serializes it.
         self._policy_lock = sanitize.named_lock(
             "fleet.FleetRouter._policy_lock"
         )
+        self._set.on_rejoin = self._replay_admin_state
 
     # -- membership passthrough ----------------------------------------------
 
@@ -127,7 +171,9 @@ class FleetRouter:
                 STATUS_OVER_QUOTA, reason=reason,
             )
         candidates = [
-            r for r in self._set.routable() if r.name not in exclude
+            r for r in self._set.routable()
+            if r.name not in exclude
+            and not self.breaker_for(r.name).blocked()
         ]
         if not candidates:
             self.admission.release(tenant)
@@ -138,6 +184,93 @@ class FleetRouter:
                 replica = self.policy.select(candidates)
         self._set.acquire(replica)
         return _Lease(self, replica, tenant)
+
+    # -- resilience -----------------------------------------------------------
+
+    def breaker_for(self, replica_name: str) -> CircuitBreaker:
+        with self._resilience_lock:
+            breaker = self._breakers.get(replica_name)
+            if breaker is None:
+                breaker = self._breakers[replica_name] = CircuitBreaker(
+                    endpoint=replica_name,
+                    failure_threshold=self.breaker_failure_threshold,
+                    reset_timeout_s=self.breaker_reset_s,
+                )
+            return breaker
+
+    def breakers(self) -> Dict[str, CircuitBreaker]:
+        with self._resilience_lock:
+            return dict(self._breakers)
+
+    def note_replica_result(self, replica: Replica, ok: bool):
+        """Feed one proxied exchange's outcome into the replica's
+        breaker (both front-ends call this on every attempt)."""
+        breaker = self.breaker_for(replica.name)
+        if ok:
+            breaker.on_success()
+        else:
+            breaker.on_failure()
+
+    def note_hedge(self, outcome: str):
+        with self._resilience_lock:
+            self._hedge_counts[outcome] = (
+                self._hedge_counts.get(outcome, 0) + 1
+            )
+
+    def hedge_counts(self) -> Dict[str, int]:
+        with self._resilience_lock:
+            return dict(self._hedge_counts)
+
+    def hedge_enabled(self, idempotent: bool) -> bool:
+        return self.hedge_us is not None and (idempotent or self.hedge_all)
+
+    # -- journaled admin state ------------------------------------------------
+
+    def record_admin(self, method: str, path: str, body: bytes,
+                     headers: Optional[dict] = None):
+        """Journal one successfully fanned-out admin operation for
+        replay to rejoining replicas. An unregister/unload does not
+        erase its register/load entry — the journal is an ordered log,
+        so replay converges to the same end state either way."""
+        with self._resilience_lock:
+            self._journal.append(
+                (method, path, bytes(body or b""), dict(headers or {}))
+            )
+
+    def admin_journal(self) -> List[Tuple[str, str, bytes, dict]]:
+        with self._resilience_lock:
+            return list(self._journal)
+
+    def add_rejoin_listener(self, listener):
+        """``listener(replica)`` runs when a crashed replica rejoins,
+        before its admin state is replayed (connection-pool hygiene)."""
+        with self._resilience_lock:
+            self._rejoin_listeners.append(listener)
+
+    def _replay_admin_state(self, replica: Replica) -> bool:
+        """Replay the journal to a rejoining replica (the ReplicaSet's
+        ``on_rejoin`` hook, called with no locks held, BEFORE the
+        replica becomes routable). Returns False — leaving the replica
+        unroutable until the next probe retries — if any entry fails to
+        apply."""
+        with self._resilience_lock:
+            listeners = list(self._rejoin_listeners)
+        for listener in listeners:
+            try:
+                listener(replica)
+            except Exception:  # noqa: BLE001 — hygiene must not block rejoin
+                pass
+        for method, path, body, headers in self.admin_journal():
+            try:
+                status, _ = http_call(
+                    replica.http_address, method, path, body=body,
+                    headers=headers, timeout_s=self._set.probe_timeout_s,
+                )
+            except OSError:
+                return False
+            if status >= 400:
+                return False
+        return True
 
     def pick_any(self) -> Replica:
         """A ready replica for non-inference traffic (metadata, stats,
@@ -211,6 +344,51 @@ class FleetRouter:
         for r in replicas:
             lines.append(
                 f'{metric}{{replica="{esc(r.name)}"}} {r.requests_total}'
+            )
+        metric = "nv_fleet_replica_restarts_total"
+        lines.append(
+            f"# HELP {metric} Times a replica rejoined after a crash "
+            "and had the router's journaled admin state replayed"
+        )
+        lines.append(f"# TYPE {metric} counter")
+        for r in replicas:
+            lines.append(
+                f'{metric}{{replica="{esc(r.name)}"}} {r.restarts}'
+            )
+        metric = "nv_client_breaker_state"
+        lines.append(
+            f"# HELP {metric} Circuit-breaker state per replica "
+            "endpoint (0=closed, 1=half_open, 2=open)"
+        )
+        lines.append(f"# TYPE {metric} gauge")
+        for r in replicas:
+            lines.append(
+                f'{metric}{{endpoint="{esc(r.name)}"}} '
+                f"{self.breaker_for(r.name).state_value()}"
+            )
+        metric = "nv_client_retries_total"
+        lines.append(
+            f"# HELP {metric} Replays authorized by the router's "
+            "RetryPolicy, by canonical reason"
+        )
+        lines.append(f"# TYPE {metric} counter")
+        retry_counts = self.retry_policy.snapshot()
+        for reason in RETRY_REASONS:
+            lines.append(
+                f'{metric}{{reason="{reason}"}} '
+                f"{retry_counts.get(reason, 0)}"
+            )
+        metric = "nv_fleet_hedges_total"
+        lines.append(
+            f"# HELP {metric} Hedged unary requests by outcome "
+            "(primary/hedge = who won, failed = both attempts failed)"
+        )
+        lines.append(f"# TYPE {metric} counter")
+        hedges = self.hedge_counts()
+        for outcome in HEDGE_OUTCOMES:
+            lines.append(
+                f'{metric}{{outcome="{outcome}"}} '
+                f"{hedges.get(outcome, 0)}"
             )
         metric = "nv_fleet_tenant_quota_rejections_total"
         lines.append(

@@ -24,8 +24,15 @@ from tritonclient_tpu.grpc._utils import (
     grpc_compression_type,
     raise_error_grpc,
 )
+from tritonclient_tpu import chaos
+from tritonclient_tpu.resilience import (
+    PHASE_CONNECT,
+    CircuitBreaker,
+    RetryPolicy,
+)
 from tritonclient_tpu.protocol import GRPCInferenceServiceStub, pb
 from tritonclient_tpu.protocol._literals import (
+    HEADER_IDEMPOTENCY_KEY,
     KEY_EMPTY_FINAL_RESPONSE,
     KEY_UNLOAD_DEPENDENTS,
 )
@@ -33,6 +40,58 @@ from tritonclient_tpu.utils import raise_error
 
 # INT32_MAX parity with the reference (grpc/_client.py:50-55).
 MAX_GRPC_MESSAGE_SIZE = 2**31 - 1
+
+#: Reconnect-backoff defaults. gRPC's own defaults (1 s initial, up to
+#: ~2 min max, DNS re-resolution on top) leave a dropped channel dark
+#: for tens of seconds after the endpoint is back — the "20 s reconnect"
+#: failure mode. A serving client should probe again within a bounded
+#: couple of seconds; callers can widen these for WAN links.
+DEFAULT_INITIAL_RECONNECT_BACKOFF_MS = 250
+DEFAULT_MAX_RECONNECT_BACKOFF_MS = 2000
+
+
+def reconnect_channel_args(initial_reconnect_backoff_ms: int,
+                           max_reconnect_backoff_ms: int):
+    """The channel-arg triple bounding reconnect backoff (min pinned to
+    the initial value so the first retry is not delayed further)."""
+    return [
+        ("grpc.initial_reconnect_backoff_ms",
+         int(initial_reconnect_backoff_ms)),
+        ("grpc.min_reconnect_backoff_ms",
+         int(initial_reconnect_backoff_ms)),
+        ("grpc.max_reconnect_backoff_ms", int(max_reconnect_backoff_ms)),
+    ]
+
+
+def classify_rpc_error(policy: RetryPolicy, rpc_error,
+                       idempotent: bool = False) -> Optional[str]:
+    """Retry reason for one failed RPC, or None.
+
+    UNAVAILABLE with a connect-phase detail (refused / DNS / channel
+    establishment) is provably pre-execution; any other UNAVAILABLE may
+    have executed mid-call and needs the idempotency key;
+    RESOURCE_EXHAUSTED is the wire's 429 (answered without executing).
+    """
+    try:
+        code = rpc_error.code()
+        details = rpc_error.details() or ""
+    except Exception:
+        return None
+    if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+        from tritonclient_tpu.protocol._literals import STATUS_OVER_QUOTA
+
+        return policy.classify(PHASE_CONNECT, status=STATUS_OVER_QUOTA)
+    if code != grpc.StatusCode.UNAVAILABLE:
+        return None
+    lowered = details.lower()
+    if (
+        "connect" in lowered or "refused" in lowered
+        or "dns" in lowered or "channel breakage" in lowered
+    ):
+        return policy.classify(PHASE_CONNECT)
+    from tritonclient_tpu.resilience import PHASE_RESPONSE
+
+    return policy.classify(PHASE_RESPONSE, idempotent=idempotent)
 
 
 class KeepAliveOptions:
@@ -79,7 +138,19 @@ class InferenceServerClient(InferenceServerClientBase):
         creds: Optional[grpc.ChannelCredentials] = None,
         keepalive_options: Optional[KeepAliveOptions] = None,
         channel_args: Optional[List] = None,
+        initial_reconnect_backoff_ms: int = DEFAULT_INITIAL_RECONNECT_BACKOFF_MS,
+        max_reconnect_backoff_ms: int = DEFAULT_MAX_RECONNECT_BACKOFF_MS,
+        retry_policy: Optional[RetryPolicy] = None,
+        circuit_breaker: Optional[CircuitBreaker] = None,
     ):
+        """``initial_reconnect_backoff_ms``/``max_reconnect_backoff_ms``
+        bound how long a dropped channel stays dark before reconnecting
+        (gRPC's own defaults leave it down for tens of seconds); the
+        keepalive timeout rides ``keepalive_options``. ``retry_policy``:
+        opt-in replay of UNAVAILABLE unary calls (transport-level: the
+        request never reached a handler) and RESOURCE_EXHAUSTED, with
+        jittered backoff under the policy budget. ``circuit_breaker``:
+        opt-in fail-fast while the endpoint is open."""
         super().__init__()
         if keepalive_options is None:
             keepalive_options = KeepAliveOptions()
@@ -100,6 +171,9 @@ class InferenceServerClient(InferenceServerClientBase):
                     "grpc.http2.max_pings_without_data",
                     keepalive_options.http2_max_pings_without_data,
                 ),
+                *reconnect_channel_args(
+                    initial_reconnect_backoff_ms, max_reconnect_backoff_ms
+                ),
             ]
 
         if creds is not None:
@@ -117,6 +191,8 @@ class InferenceServerClient(InferenceServerClientBase):
         self._client_stub = GRPCInferenceServiceStub(self._channel)
         self._verbose = verbose
         self._stream: Optional[_InferStream] = None
+        self._retry_policy = retry_policy
+        self._breaker = circuit_breaker
 
     @staticmethod
     def _read_file(path: Optional[str]) -> Optional[bytes]:
@@ -495,8 +571,14 @@ class InferenceServerClient(InferenceServerClientBase):
         parameters=None,
         timers=None,
         traceparent=None,
+        idempotency_key=None,
     ) -> InferResult:
         """Synchronous inference (reference: grpc/_client.py:1445-1572).
+
+        ``idempotency_key``: optional caller-chosen token sent as
+        ``idempotency-key`` metadata; its presence authorizes this
+        client's RetryPolicy (and retrying proxies) to replay the call
+        after a failure that is not provably pre-execution.
 
         ``timers``: optional ``perf_analyzer._stats.RequestTimers`` — when
         given, the client stamps the request-phase timestamps into it
@@ -544,25 +626,58 @@ class InferenceServerClient(InferenceServerClientBase):
             metadata = tuple(metadata or ()) + (
                 ("traceparent", traceparent),
             )
+        if idempotency_key and not any(
+            k == HEADER_IDEMPOTENCY_KEY for k, _ in metadata or ()
+        ):
+            metadata = tuple(metadata or ()) + (
+                (HEADER_IDEMPOTENCY_KEY, idempotency_key),
+            )
         if timers is not None:
             timers.capture("send_end")
-        try:
-            response = self._client_stub.ModelInfer(
-                request,
-                metadata=metadata,
-                timeout=client_timeout,
-                compression=grpc_compression_type(compression_algorithm),
-            )
-            if timers is not None:
-                timers.capture("recv_start")
-            result = InferResult(response)
-            if timers is not None:
-                timers.capture("recv_end")
-                timers.capture("request_end")
-                result.timers = timers
-            return result
-        except grpc.RpcError as rpc_error:
-            raise_error_grpc(rpc_error)
+        policy = self._retry_policy
+        idempotent = any(
+            k == HEADER_IDEMPOTENCY_KEY for k, _ in metadata or ()
+        )
+        attempt = 0
+        with chaos.operation("grpc.ModelInfer"):
+            while True:
+                if self._breaker is not None:
+                    self._breaker.check()
+                try:
+                    chaos.fire(chaos.SITE_GRPC_CALL)
+                    response = self._client_stub.ModelInfer(
+                        request,
+                        metadata=metadata,
+                        timeout=client_timeout,
+                        compression=grpc_compression_type(
+                            compression_algorithm
+                        ),
+                    )
+                    break
+                except grpc.RpcError as rpc_error:
+                    if self._breaker is not None:
+                        self._breaker.on_failure()
+                    if policy is not None and policy.should_retry(
+                        attempt,
+                        classify_rpc_error(policy, rpc_error,
+                                           idempotent=idempotent),
+                    ):
+                        policy.sleep(attempt)
+                        attempt += 1
+                        continue
+                    raise_error_grpc(rpc_error)
+        if self._breaker is not None:
+            self._breaker.on_success()
+        if policy is not None:
+            policy.note_success()
+        if timers is not None:
+            timers.capture("recv_start")
+        result = InferResult(response)
+        if timers is not None:
+            timers.capture("recv_end")
+            timers.capture("request_end")
+            result.timers = timers
+        return result
 
     def async_infer(
         self,

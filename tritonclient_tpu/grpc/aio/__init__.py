@@ -5,6 +5,7 @@ the sync client reusing the same request builders and InferResult, plus
 ``stream_infer`` returning an async response iterator with ``.cancel()``.
 """
 
+import asyncio
 from typing import AsyncIterator, Dict, Optional
 
 import grpc
@@ -15,10 +16,15 @@ from tritonclient_tpu import sanitize
 from tritonclient_tpu._client import InferenceServerClientBase
 from tritonclient_tpu._request import Request
 from tritonclient_tpu.grpc._client import (
+    DEFAULT_INITIAL_RECONNECT_BACKOFF_MS,
+    DEFAULT_MAX_RECONNECT_BACKOFF_MS,
     MAX_GRPC_MESSAGE_SIZE,
     KeepAliveOptions,
     InferenceServerClient as _SyncClient,
+    classify_rpc_error,
+    reconnect_channel_args,
 )
+from tritonclient_tpu.resilience import CircuitBreaker, RetryPolicy
 from tritonclient_tpu.grpc._infer_input import InferInput  # noqa: F401
 from tritonclient_tpu.grpc._infer_result import InferResult
 from tritonclient_tpu.grpc._requested_output import InferRequestedOutput  # noqa: F401
@@ -53,7 +59,14 @@ class InferenceServerClient(InferenceServerClientBase):
         creds: Optional[grpc.ChannelCredentials] = None,
         keepalive_options: Optional[KeepAliveOptions] = None,
         channel_args=None,
+        initial_reconnect_backoff_ms: int = DEFAULT_INITIAL_RECONNECT_BACKOFF_MS,
+        max_reconnect_backoff_ms: int = DEFAULT_MAX_RECONNECT_BACKOFF_MS,
+        retry_policy: Optional[RetryPolicy] = None,
+        circuit_breaker: Optional[CircuitBreaker] = None,
     ):
+        """Reconnect-backoff bounds and ``retry_policy``/
+        ``circuit_breaker`` carry the same contract as the sync gRPC
+        client (retries use ``asyncio.sleep`` backoff)."""
         super().__init__()
         if keepalive_options is None:
             keepalive_options = KeepAliveOptions()
@@ -73,6 +86,9 @@ class InferenceServerClient(InferenceServerClientBase):
                     "grpc.http2.max_pings_without_data",
                     keepalive_options.http2_max_pings_without_data,
                 ),
+                *reconnect_channel_args(
+                    initial_reconnect_backoff_ms, max_reconnect_backoff_ms
+                ),
             ]
         if creds is not None:
             self._channel = grpc.aio.secure_channel(url, creds, options=channel_opt)
@@ -87,6 +103,8 @@ class InferenceServerClient(InferenceServerClientBase):
             self._channel = grpc.aio.insecure_channel(url, options=channel_opt)
         self._client_stub = GRPCInferenceServiceStub(self._channel)
         self._verbose = verbose
+        self._retry_policy = retry_policy
+        self._breaker = circuit_breaker
         # tpusan: opt the owning loop into event-loop-blocking accounting
         # (no-op unless the sanitizer is active).
         sanitize.note_event_loop()
@@ -349,6 +367,7 @@ class InferenceServerClient(InferenceServerClientBase):
         parameters=None,
         timers=None,
         traceparent=None,
+        idempotency_key=None,
     ) -> InferResult:
         """``timers``: optional RequestTimers stamped around marshal /
         RPC / result wrap, attached to the result as ``result.timers``;
@@ -386,25 +405,58 @@ class InferenceServerClient(InferenceServerClientBase):
             metadata = tuple(metadata or ()) + (
                 ("traceparent", traceparent),
             )
+        from tritonclient_tpu.protocol._literals import (
+            HEADER_IDEMPOTENCY_KEY,
+        )
+
+        if idempotency_key and not any(
+            k == HEADER_IDEMPOTENCY_KEY for k, _ in metadata or ()
+        ):
+            metadata = tuple(metadata or ()) + (
+                (HEADER_IDEMPOTENCY_KEY, idempotency_key),
+            )
         if timers is not None:
             timers.capture("send_end")
-        try:
-            response = await self._client_stub.ModelInfer(
-                request,
-                metadata=metadata,
-                timeout=client_timeout,
-                compression=grpc_compression_type(compression_algorithm),
-            )
-            if timers is not None:
-                timers.capture("recv_start")
-            result = InferResult(response)
-            if timers is not None:
-                timers.capture("recv_end")
-                timers.capture("request_end")
-                result.timers = timers
-            return result
-        except grpc.RpcError as rpc_error:
-            raise_error_grpc(rpc_error)
+        policy = self._retry_policy
+        idempotent = any(
+            k == HEADER_IDEMPOTENCY_KEY for k, _ in metadata or ()
+        )
+        attempt = 0
+        while True:
+            if self._breaker is not None:
+                self._breaker.check()
+            try:
+                response = await self._client_stub.ModelInfer(
+                    request,
+                    metadata=metadata,
+                    timeout=client_timeout,
+                    compression=grpc_compression_type(compression_algorithm),
+                )
+                break
+            except grpc.RpcError as rpc_error:
+                if self._breaker is not None:
+                    self._breaker.on_failure()
+                if policy is not None and policy.should_retry(
+                    attempt,
+                    classify_rpc_error(policy, rpc_error,
+                                       idempotent=idempotent),
+                ):
+                    await asyncio.sleep(policy.backoff_s(attempt))
+                    attempt += 1
+                    continue
+                raise_error_grpc(rpc_error)
+        if self._breaker is not None:
+            self._breaker.on_success()
+        if policy is not None:
+            policy.note_success()
+        if timers is not None:
+            timers.capture("recv_start")
+        result = InferResult(response)
+        if timers is not None:
+            timers.capture("recv_end")
+            timers.capture("request_end")
+            result.timers = timers
+        return result
 
     def stream_infer(
         self,

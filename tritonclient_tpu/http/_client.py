@@ -21,9 +21,21 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, Optional
 from urllib.parse import urlparse
 
+from tritonclient_tpu import chaos
+from tritonclient_tpu.resilience import (
+    PHASE_CONNECT,
+    PHASE_RESPONSE,
+    PHASE_SEND,
+    CircuitBreaker,
+    RetryPolicy,
+    parse_retry_after,
+)
 from tritonclient_tpu.protocol._literals import (
     EP_FLIGHT_RECORDER,
     EP_HEALTH_LIVE,
+    HEADER_IDEMPOTENCY_KEY,
+    HEADER_RETRY_AFTER,
+    HEADER_RETRY_ATTEMPT,
     EP_HEALTH_READY,
     EP_LOGGING,
     EP_REPOSITORY_INDEX,
@@ -227,7 +239,19 @@ class InferenceServerClient(InferenceServerClientBase):
         ssl_options: Optional[dict] = None,
         ssl_context_factory=None,
         insecure: bool = False,
+        retry_policy: Optional[RetryPolicy] = None,
+        circuit_breaker: Optional[CircuitBreaker] = None,
     ):
+        """``retry_policy``: opt-in :class:`~tritonclient_tpu.resilience.
+        RetryPolicy` — connect/send-phase transport failures and
+        retryable statuses (429/503, ``Retry-After`` honored) are
+        replayed with jittered backoff under the policy's budget;
+        post-send failures are replayed ONLY when the request carries an
+        idempotency key (``infer(..., idempotency_key=...)``). ``None``
+        (default) keeps the legacy behavior: a single replay only when a
+        reused keep-alive connection failed. ``circuit_breaker``: opt-in
+        per-endpoint breaker — while open, requests fail fast with
+        ``BreakerOpenError`` instead of touching the server."""
         super().__init__()
         if url.startswith("http://") or url.startswith("https://"):
             raise_error("url should not include the scheme")
@@ -265,6 +289,8 @@ class InferenceServerClient(InferenceServerClientBase):
             ssl_context,
         )
         self._executor = ThreadPoolExecutor(max_workers=max(concurrency, 1))
+        self._retry_policy = retry_policy
+        self._breaker = circuit_breaker
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -318,44 +344,102 @@ class InferenceServerClient(InferenceServerClientBase):
         if self._verbose:
             print(f"{method} {uri}, headers {headers}")
 
+        policy = self._retry_policy
+        idempotent = any(
+            k.lower() == HEADER_IDEMPOTENCY_KEY for k in headers
+        )
         retried = False
-        while True:
-            try:
-                conn, reused = self._pool.acquire()
-            except OSError as e:
-                raise InferenceServerException(msg=str(e)) from None
-            if cancel_token is not None:
-                cancel_token.attach(conn)
-            try:
-                conn.request(method, uri, body=body, headers=headers)
-                response = conn.getresponse()
-                payload = response.read()
-                break
-            except TimeoutError:
-                # A timed-out request must NOT be retried (infer is not
-                # idempotent and the retry would double the effective timeout).
-                self._pool.discard(conn)
-                raise InferenceServerException(msg="timed out") from None
-            except (http.client.HTTPException, OSError) as e:
-                self._pool.discard(conn)
-                if cancel_token is not None and cancel_token.cancelled:
-                    # The failure IS the cancellation (the token closed
-                    # this connection); never retry cancelled work.
-                    raise InferenceServerException(
-                        msg="Locally cancelled by application!"
-                    ) from None
-                # Retry once, and only when the failed connection was a reused
-                # keep-alive one (likely closed while idle). A failure on a
-                # fresh connection is a real error — and infer is not
-                # idempotent, so resending after the server may have executed
-                # the request risks double execution.
-                if reused and not retried:
-                    retried = True
+        attempt = 0
+        with chaos.operation(f"http.{method} {path}"):
+            while True:
+                if self._breaker is not None:
+                    self._breaker.check()
+                if attempt and policy is not None:
+                    headers[HEADER_RETRY_ATTEMPT] = str(attempt)
+                phase = PHASE_CONNECT
+                conn = None
+                reused = False
+                try:
+                    chaos.fire(chaos.SITE_HTTP_CONNECT)
+                    conn, reused = self._pool.acquire()
+                    if cancel_token is not None:
+                        cancel_token.attach(conn)
+                    phase = PHASE_SEND
+                    chaos.fire(chaos.SITE_HTTP_SEND)
+                    conn.request(method, uri, body=body, headers=headers)
+                    # Request fully written: from here a failure is
+                    # post-send — the server MAY have executed it.
+                    phase = PHASE_RESPONSE
+                    chaos.fire(chaos.SITE_HTTP_RESPONSE)
+                    response = conn.getresponse()
+                    payload = response.read()
+                except TimeoutError:
+                    # A timed-out request must NOT be retried (infer is not
+                    # idempotent and the retry would double the effective
+                    # timeout).
+                    if conn is not None:
+                        self._pool.discard(conn)
+                    if self._breaker is not None:
+                        self._breaker.on_failure()
+                    raise InferenceServerException(msg="timed out") from None
+                except (http.client.HTTPException, OSError) as e:
+                    if conn is not None:
+                        self._pool.discard(conn)
+                    if self._breaker is not None:
+                        self._breaker.on_failure()
+                    if cancel_token is not None and cancel_token.cancelled:
+                        # The failure IS the cancellation (the token closed
+                        # this connection); never retry cancelled work.
+                        raise InferenceServerException(
+                            msg="Locally cancelled by application!"
+                        ) from None
+                    # Legacy allowance, both modes: one replay when a REUSED
+                    # keep-alive connection failed (closed while idle — the
+                    # request almost certainly never reached the server).
+                    if reused and not retried:
+                        retried = True
+                        attempt += 1
+                        continue
+                    if policy is not None:
+                        # Policy-driven replay: pre-execution phases always
+                        # eligible; post-send only with an idempotency key.
+                        reason = policy.classify(
+                            phase, idempotent=idempotent
+                        )
+                        if policy.should_retry(attempt, reason):
+                            policy.sleep(attempt)
+                            attempt += 1
+                            continue
+                    raise InferenceServerException(msg=str(e)) from None
+                # Response in hand. Retryable statuses (429/503) replay
+                # under the policy, honoring the server's Retry-After.
+                if (
+                    policy is not None
+                    and response.status in policy.retryable_statuses
+                    and policy.should_retry(
+                        attempt,
+                        policy.classify(phase, status=response.status),
+                    )
+                ):
+                    if cancel_token is not None:
+                        cancel_token.detach()
+                    self._pool.release(conn)
+                    policy.sleep(
+                        attempt,
+                        parse_retry_after(
+                            response.headers.get(HEADER_RETRY_AFTER)
+                        ),
+                    )
+                    attempt += 1
                     continue
-                raise InferenceServerException(msg=str(e)) from None
+                break
         if cancel_token is not None:
             cancel_token.detach()
         self._pool.release(conn)
+        if self._breaker is not None:
+            self._breaker.on_success()
+        if policy is not None:
+            policy.note_success()
         if self._verbose:
             print(response.status, response.headers)
         return response.status, response.headers, payload
@@ -680,8 +764,15 @@ class InferenceServerClient(InferenceServerClientBase):
         timers=None,
         traceparent=None,
         cancel_token=None,
+        idempotency_key=None,
     ) -> InferResult:
         """Synchronous inference (reference: http/_client.py:1331-1484).
+
+        ``idempotency_key``: optional caller-chosen token sent as the
+        ``idempotency-key`` header. Its presence asserts the request may
+        safely execute more than once, which authorizes this client's
+        RetryPolicy (and any retrying proxy such as the fleet router) to
+        replay it after a post-send failure and to hedge it.
 
         ``timers``: optional ``perf_analyzer._stats.RequestTimers`` — when
         given, the client stamps the six request-phase timestamps into it
@@ -709,6 +800,8 @@ class InferenceServerClient(InferenceServerClientBase):
             all_headers.setdefault("triton-request-id", request_id)
         if traceparent:
             all_headers.setdefault("traceparent", traceparent)
+        if idempotency_key:
+            all_headers.setdefault(HEADER_IDEMPOTENCY_KEY, idempotency_key)
         if timers is not None:
             timers.capture("send_end")
         status, resp_headers, body = self._post(

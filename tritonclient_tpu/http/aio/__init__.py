@@ -16,8 +16,18 @@ from typing import Optional
 import aiohttp
 
 from tritonclient_tpu import sanitize
+from tritonclient_tpu.resilience import (
+    PHASE_CONNECT,
+    PHASE_RESPONSE,
+    CircuitBreaker,
+    RetryPolicy,
+    parse_retry_after,
+)
 from tritonclient_tpu.protocol._literals import (
     EP_HEALTH_LIVE,
+    HEADER_IDEMPOTENCY_KEY,
+    HEADER_RETRY_AFTER,
+    HEADER_RETRY_ATTEMPT,
     EP_HEALTH_READY,
     EP_LOGGING,
     EP_REPOSITORY_INDEX,
@@ -57,7 +67,15 @@ class InferenceServerClient(InferenceServerClientBase):
         conn_timeout: float = 60.0,
         ssl: bool = False,
         ssl_context=None,
+        retry_policy: Optional[RetryPolicy] = None,
+        circuit_breaker: Optional[CircuitBreaker] = None,
     ):
+        """``retry_policy``/``circuit_breaker``: same opt-in resilience
+        contract as the sync client — connect-phase failures and
+        retryable statuses (429/503, ``Retry-After`` honored) replay
+        with ``asyncio.sleep`` backoff; a post-connect failure replays
+        ONLY when the request carries ``idempotency_key``. Applied on
+        the ``infer`` hot path."""
         super().__init__()
         if url.startswith("http://") or url.startswith("https://"):
             raise_error("url should not include the scheme")
@@ -71,6 +89,8 @@ class InferenceServerClient(InferenceServerClientBase):
             timeout=aiohttp.ClientTimeout(total=conn_timeout),
             auto_decompress=False,
         )
+        self._retry_policy = retry_policy
+        self._breaker = circuit_breaker
         # tpusan: opt the owning loop into event-loop-blocking accounting
         # (no-op unless the sanitizer is active).
         sanitize.note_event_loop()
@@ -271,6 +291,7 @@ class InferenceServerClient(InferenceServerClientBase):
         parameters=None,
         timers=None,
         traceparent=None,
+        idempotency_key=None,
     ) -> InferResult:
         """``timers``: optional RequestTimers stamped around marshal /
         POST / result wrap, attached to the result as ``result.timers``;
@@ -314,20 +335,79 @@ class InferenceServerClient(InferenceServerClientBase):
             all_headers.setdefault("triton-request-id", request_id)
         if traceparent:
             all_headers.setdefault("traceparent", traceparent)
+        if idempotency_key:
+            all_headers.setdefault(HEADER_IDEMPOTENCY_KEY, idempotency_key)
         if timers is not None:
             timers.capture("send_end")
 
         path = model_infer_path(model_name, model_version)
-        try:
-            status, resp_headers, body = await self._post(
-                path, request_body, all_headers, query_params,
-                timeout_s=(timeout / 1e6) if timeout else None,
-            )
-        except asyncio.TimeoutError:
-            raise InferenceServerException(
-                msg=f"inference request timed out after its {timeout} us "
-                "deadline (client-side bound)"
-            ) from None
+        policy = self._retry_policy
+        idempotent = any(
+            k.lower() == HEADER_IDEMPOTENCY_KEY for k in all_headers
+        )
+        attempt = 0
+        while True:
+            if self._breaker is not None:
+                self._breaker.check()
+            if attempt and policy is not None:
+                all_headers[HEADER_RETRY_ATTEMPT] = str(attempt)
+            try:
+                status, resp_headers, body = await self._post(
+                    path, request_body, all_headers, query_params,
+                    timeout_s=(timeout / 1e6) if timeout else None,
+                )
+            except asyncio.TimeoutError:
+                # The request's own deadline: never replayed (a retry
+                # would double the effective timeout).
+                if self._breaker is not None:
+                    self._breaker.on_failure()
+                raise InferenceServerException(
+                    msg=f"inference request timed out after its {timeout} "
+                    "us deadline (client-side bound)"
+                ) from None
+            except aiohttp.ClientConnectorError as e:
+                if self._breaker is not None:
+                    self._breaker.on_failure()
+                if policy is not None and policy.should_retry(
+                    attempt, policy.classify(PHASE_CONNECT)
+                ):
+                    await asyncio.sleep(policy.backoff_s(attempt))
+                    attempt += 1
+                    continue
+                raise
+            except aiohttp.ClientError as e:  # noqa: F841 — post-connect
+                if self._breaker is not None:
+                    self._breaker.on_failure()
+                # aiohttp does not split send from response read; the
+                # request may have executed, so only an idempotency key
+                # authorizes a replay.
+                if policy is not None and policy.should_retry(
+                    attempt,
+                    policy.classify(PHASE_RESPONSE, idempotent=idempotent),
+                ):
+                    await asyncio.sleep(policy.backoff_s(attempt))
+                    attempt += 1
+                    continue
+                raise
+            if (
+                policy is not None
+                and status in policy.retryable_statuses
+                and policy.should_retry(
+                    attempt,
+                    policy.classify(PHASE_RESPONSE, status=status),
+                )
+            ):
+                await asyncio.sleep(policy.backoff_s(
+                    attempt,
+                    parse_retry_after(resp_headers.get(HEADER_RETRY_AFTER)),
+                ))
+                attempt += 1
+                continue
+            break
+        if self._breaker is not None:
+            self._breaker.on_success()
+        if policy is not None:
+            policy.note_success()
         _raise_if_error(status, body)
         if timers is not None:
             timers.capture("recv_start")

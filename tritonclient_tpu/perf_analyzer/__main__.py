@@ -79,6 +79,30 @@ def main(argv=None):
              "named tenants in weight proportion (the hostile-mix "
              "instrument for fleet_bench)",
     )
+    parser.add_argument(
+        "--retry-attempts", type=int, default=0, metavar="N",
+        help="arm a shared RetryPolicy (N total attempts, full-jitter "
+             "backoff, global retry budget) plus a per-endpoint circuit "
+             "breaker on every client; replays are reported per window "
+             "as `retries` and fast breaker rejections as "
+             "`breaker_open`, apart from errors/sheds/quota rejections",
+    )
+    parser.add_argument(
+        "--hedge-us", type=int, default=0, metavar="US",
+        help="client-side hedged requests (HTTP closed-loop driver "
+             "only): duplicate a request that has not answered within "
+             "US microseconds, first response wins, loser cancelled; "
+             "wins by the duplicate are reported per window as "
+             "`hedge_wins`",
+    )
+    parser.add_argument(
+        "--chaos", default="", metavar="PLAN",
+        help="run the sweep under seeded fault injection (tpuchaos "
+             "schedule DSL, e.g. 'http.connect=refused@p=0.01'); pair "
+             "with --retry-attempts to measure resilience, and "
+             "--chaos-seed for determinism",
+    )
+    parser.add_argument("--chaos-seed", type=int, default=0, metavar="N")
     parser.add_argument("--device-id", type=int, default=0)
     parser.add_argument(
         "--shm-mesh-devices", type=int, default=0, metavar="N",
@@ -159,6 +183,10 @@ def main(argv=None):
         if args.read_outputs:
             parser.error("--native-driver does not support --read-outputs "
                          "(the native loop never deserializes outputs)")
+        if args.retry_attempts or args.hedge_us or args.chaos:
+            parser.error("--retry-attempts/--hedge-us/--chaos are not "
+                         "supported with --native-driver (the native "
+                         "loop bypasses the Python resilience layer)")
         if args.protocol == "grpc" and not args.http_url:
             parser.error("--native-driver with -i grpc needs --http-url "
                          "(the driver fetches model metadata over HTTP)")
@@ -198,6 +226,10 @@ def main(argv=None):
             request_timeout_us=args.request_timeout_us,
             tenant_id=args.tenant_id,
             tenant_mix=tenant_mix or None,
+            retry_attempts=args.retry_attempts,
+            hedge_us=args.hedge_us,
+            chaos_plan=args.chaos,
+            chaos_seed=args.chaos_seed,
             # Tenant injection on streams is stream-scoped: each worker
             # must own its stream for the mix to hold (see PerfAnalyzer).
             shared_stream=not (
@@ -238,6 +270,18 @@ def main(argv=None):
                     )
                     + ")"
                     if r.get("quota_rejections") else ""
+                )
+                + (
+                    f", retries: {r['retries']}"
+                    if r.get("retries") else ""
+                )
+                + (
+                    f", breaker_open: {r['breaker_open']}"
+                    if r.get("breaker_open") else ""
+                )
+                + (
+                    f", hedge_wins: {r['hedge_wins']}"
+                    if r.get("hedge_wins") else ""
                 )
             )
             if "send_p50_us" in r:

@@ -19,17 +19,23 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from tritonclient_tpu import _otel
+from tritonclient_tpu import _otel, chaos
 from tritonclient_tpu._sketch import LatencySketch
 from tritonclient_tpu.perf_analyzer._stats import (
     SERVER_STAT_KEYS,
     InferStat,
     MeasurementWindow,
     RequestTimers,
+    is_breaker_error,
     is_quota_error,
     is_shed_error,
 )
-from tritonclient_tpu.protocol._literals import HEADER_TENANT_ID
+from tritonclient_tpu.protocol._literals import (
+    HEADER_HEDGE_ATTEMPT,
+    HEADER_IDEMPOTENCY_KEY,
+    HEADER_TENANT_ID,
+)
+from tritonclient_tpu.resilience import CircuitBreaker, RetryPolicy
 from tritonclient_tpu.utils import (
     serialize_byte_tensor,
     triton_to_np_dtype,
@@ -187,6 +193,9 @@ class _Worker:
         self.errors = 0
         self.sheds = 0  # deadline sheds (--request-timeout-us), not errors
         self.quota_rejections = 0  # fleet-router 429s, not errors either
+        self.breaker_open = 0  # fast breaker rejections, not errors either
+        self.hedge_wins = 0  # hedged requests the duplicate won
+        self._hedge_seq = 0
         self.reject_latencies: List[int] = []
         self.tenant_latencies: Dict[str, List[int]] = {}
         self._stop = threading.Event()
@@ -465,9 +474,12 @@ class _Worker:
             self.analyzer.client_spans.finish(handle, timers)
 
     def _classify_failure(self, error, timers: RequestTimers):
-        """Route one failed request into quota-rejection / shed / error
-        counters (quota first: a 429 is neither a shed nor a failure)."""
-        if is_quota_error(error):
+        """Route one failed request into breaker / quota-rejection /
+        shed / error counters (quota first: a 429 is neither a shed nor
+        a failure; a fast breaker rejection never touched the wire)."""
+        if is_breaker_error(error):
+            self.breaker_open += 1
+        elif is_quota_error(error):
             self.quota_rejections += 1
             # The 429's own latency IS the signal: fleet_bench gates on
             # rejects answering in single-digit milliseconds.
@@ -515,10 +527,16 @@ class _Worker:
                 timers.capture("send_start")
                 inputs = self._build_inputs(payloads)
                 timers.capture("send_end")
-                result = self._client.infer(
-                    a.model_name, inputs, outputs=outputs, traceparent=tp,
-                    timeout=timeout_us, headers=headers,
-                )
+                if a.hedge_us:
+                    result = self._infer_hedged(
+                        inputs, outputs, timeout_us, headers
+                    )
+                else:
+                    result = self._client.infer(
+                        a.model_name, inputs, outputs=outputs,
+                        traceparent=tp, timeout=timeout_us,
+                        headers=headers,
+                    )
                 timers.capture("recv_start")
                 if a.read_outputs:
                     self._consume_outputs(result)
@@ -529,6 +547,45 @@ class _Worker:
             timers.capture("request_end")
             self._span_finish(span, timers)
             self._record_success(tenant, timers)
+
+    def _infer_hedged(self, inputs, outputs, timeout_us, headers):
+        """Client-side hedged request (``--hedge-us``, HTTP driver):
+        launch the request, and when it has not completed within the
+        threshold launch an identical duplicate; first completion wins
+        and the loser is cancelled (its connection closes, so the
+        server sheds the queued work). Hedged requests always carry an
+        idempotency key — a hedge IS a deliberate double-execution."""
+        import concurrent.futures as fut
+
+        a = self.analyzer
+        self._hedge_seq += 1
+        hdrs = dict(headers or {})
+        hdrs.setdefault(
+            HEADER_IDEMPOTENCY_KEY, f"pa-{self.wid}-{self._hedge_seq}"
+        )
+        primary = self._client.async_infer(
+            a.model_name, inputs, outputs=outputs, timeout=timeout_us,
+            headers=hdrs,
+        )
+        done, _ = fut.wait([primary._future], timeout=a.hedge_us / 1e6)
+        if done:
+            return primary.get_result()
+        hedge_hdrs = dict(hdrs)
+        hedge_hdrs[HEADER_HEDGE_ATTEMPT] = "1"
+        hedge = self._client.async_infer(
+            a.model_name, inputs, outputs=outputs, timeout=timeout_us,
+            headers=hedge_hdrs,
+        )
+        done, _ = fut.wait(
+            [primary._future, hedge._future],
+            return_when=fut.FIRST_COMPLETED, timeout=120,
+        )
+        if primary._future in done:
+            hedge.cancel()
+            return primary.get_result()
+        self.hedge_wins += 1
+        primary.cancel()
+        return hedge.get_result()
 
     def _ensure_stream(self):
         """Start the long-lived bidi stream once; survives across windows.
@@ -944,12 +1001,19 @@ class MeasurementSession:
             w.errors = 0
             w.sheds = 0
             w.quota_rejections = 0
+            w.breaker_open = 0
+            w.hedge_wins = 0
             w.reject_latencies.clear()
             w.tenant_latencies.clear()
         # Server-side statistics snapshot at the warmup cut; the post-join
         # snapshot closes the window and the delta becomes the server
-        # queue/compute breakdown in summary().
+        # queue/compute breakdown in summary(). The retry-policy counter
+        # snapshot rides the same cut (per-window retries delta).
         before = a._server_stats_snapshot()
+        retries_before = (
+            a.retry_policy.snapshot()["total"]
+            if a.retry_policy is not None else 0
+        )
         for t in threads:
             t.join()
         duration = time.perf_counter() - window_start
@@ -968,6 +1032,8 @@ class MeasurementSession:
             window.errors += w.errors
             window.sheds += w.sheds
             window.quota_rejections += w.quota_rejections
+            window.breaker_open += w.breaker_open
+            window.hedge_wins += w.hedge_wins
             window.reject_latencies_ns.extend(w.reject_latencies)
             for tenant, samples in w.tenant_latencies.items():
                 window.tenant_latencies_ns.setdefault(tenant, []).extend(
@@ -980,6 +1046,10 @@ class MeasurementSession:
             window.stat.cumulative_send_time_ns += w.stat.cumulative_send_time_ns
             window.stat.cumulative_receive_time_ns += (
                 w.stat.cumulative_receive_time_ns
+            )
+        if a.retry_policy is not None:
+            window.retries = (
+                a.retry_policy.snapshot()["total"] - retries_before
             )
         self.pooled_sketch.merge(window.latency_sketch())
         return window
@@ -1110,10 +1180,19 @@ class PerfAnalyzer:
         request_timeout_us: int = 0,
         tenant_id: str = "",
         tenant_mix: Optional[Dict[str, int]] = None,
+        retry_attempts: int = 0,
+        hedge_us: int = 0,
+        chaos_plan: str = "",
+        chaos_seed: int = 0,
         verbose: bool = False,
     ):
         if protocol not in ("grpc", "http"):
             raise ValueError("protocol must be grpc or http")
+        if hedge_us and (protocol != "http" or streaming or async_window):
+            raise ValueError(
+                "--hedge-us is supported on the closed-loop HTTP driver "
+                "only (hedging a stream has no first-response-wins)"
+            )
         if request_timeout_us and async_window:
             raise ValueError(
                 "--request-timeout-us is supported in the closed-loop "
@@ -1172,6 +1251,26 @@ class PerfAnalyzer:
                 "metadata is per-call): use shared_stream=False so each "
                 "worker owns a stream, or drop --streaming"
             )
+        # Resilience instrumentation (PR 9): a SHARED RetryPolicy across
+        # every worker (global retry budget — the measured sweep cannot
+        # retry-storm the target) and one breaker for the single target
+        # endpoint; per-window deltas surface as the retries /
+        # breaker_open / hedge_wins columns. ``chaos_plan`` arms the
+        # seeded fault injector for the whole sweep (--chaos PLAN).
+        self.retry_attempts = int(retry_attempts)
+        self.hedge_us = int(hedge_us)
+        self.retry_policy = (
+            RetryPolicy(max_attempts=self.retry_attempts)
+            if self.retry_attempts > 1 else None
+        )
+        self.breaker = (
+            CircuitBreaker(url, failure_threshold=5, reset_timeout_s=1.0)
+            if self.retry_policy is not None else None
+        )
+        self.chaos_plan = chaos_plan
+        self.chaos_seed = int(chaos_seed)
+        if chaos_plan:
+            chaos.enable(self.chaos_seed, chaos_plan)
         self.read_outputs = read_outputs
         # Reference perf_analyzer semantics for --shared-memory: input
         # buffers are written into the region ONCE at setup and every
@@ -1315,9 +1414,13 @@ class PerfAnalyzer:
             )
 
     def make_client(self):
+        kwargs = {}
+        if self.retry_policy is not None:
+            kwargs["retry_policy"] = self.retry_policy
+            kwargs["circuit_breaker"] = self.breaker
         if self.protocol == "grpc":
-            return self._client_cls(self.url)
-        return self._client_cls(self.url, concurrency=4)
+            return self._client_cls(self.url, **kwargs)
+        return self._client_cls(self.url, concurrency=4, **kwargs)
 
     def make_tpu_region(self, name: str, byte_size: int):
         """A tpu shm region: single-device, or mesh-sharded when shm_mesh

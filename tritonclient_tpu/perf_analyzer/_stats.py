@@ -11,6 +11,9 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional
 
 from tritonclient_tpu._sketch import LatencySketch
+from tritonclient_tpu.resilience import is_breaker_error  # noqa: F401
+# (re-exported beside is_shed_error/is_quota_error: the three
+# not-a-failure classifiers perf_analyzer windows route errors through)
 
 
 @dataclass
@@ -146,6 +149,15 @@ class MeasurementWindow:
     # the fairness instrument — the in-quota tenant's p99 under a
     # hostile mix is read from here.
     tenant_latencies_ns: Dict[str, List[int]] = field(default_factory=dict)
+    # Resilience columns (PR 9), classified apart from errors, sheds,
+    # AND quota rejections: retries = replays the shared RetryPolicy
+    # authorized this window (the request itself still lands in exactly
+    # one of success/error); breaker_open = requests failed FAST by an
+    # open circuit breaker (no I/O happened); hedge_wins = hedged
+    # requests whose duplicate finished first.
+    retries: int = 0
+    breaker_open: int = 0
+    hedge_wins: int = 0
     stat: InferStat = field(default_factory=InferStat)
     # Per-request send/receive samples (for percentile reporting, not just
     # the cumulative means InferStat carries).
@@ -177,11 +189,17 @@ class MeasurementWindow:
         recv = sorted(self.recv_ns)
         attempted = (
             len(lat) + self.errors + self.sheds + self.quota_rejections
+            + self.breaker_open
         )
         out = {
             "concurrency": self.concurrency,
             "count": len(lat),
             "errors": self.errors,
+            # Resilience columns: replays, fast breaker rejections, and
+            # hedge wins — none of which are failures.
+            "retries": self.retries,
+            "breaker_open": self.breaker_open,
+            "hedge_wins": self.hedge_wins,
             # Shed rate per window: sheds / everything offered this
             # window — the deadline-path signal next to the server
             # queue/compute split below.

@@ -155,6 +155,75 @@ QUOTA_REASONS = (
     QUOTA_REASON_PRESSURE,
 )
 
+# --------------------------------------------------------------------------- #
+# resilience vocabulary (retries, hedging, circuit breakers)                  #
+# --------------------------------------------------------------------------- #
+
+#: HTTP header / gRPC invocation-metadata key carrying a caller-chosen
+#: idempotency key. Its PRESENCE is the contract: the caller asserts the
+#: request may be executed more than once, which is what authorizes a
+#: client/proxy to replay it after a failure that is NOT provably
+#: pre-execution (e.g. a mid-response FIN) and to hedge it onto a second
+#: replica. Spelled here exactly once (enforced by TPU008): a retrying
+#: proxy honoring key X while a client stamps key Y silently disables
+#: every replay.
+HEADER_IDEMPOTENCY_KEY = "idempotency-key"
+
+#: Header stamped on replayed attempts (value = attempt ordinal, "1" on
+#: the first retry) so replicas and traces can tell a replay from fresh
+#: offered load.
+HEADER_RETRY_ATTEMPT = "retry-attempt"
+
+#: Header stamped on the hedge duplicate of a hedged request (value =
+#: "1") so the loser's shed shows up attributably in server metrics.
+HEADER_HEDGE_ATTEMPT = "hedge-attempt"
+
+#: Standard HTTP backpressure header honored by RetryPolicy: a 429/503
+#: carrying ``Retry-After: <seconds>`` overrides the computed backoff.
+HEADER_RETRY_AFTER = "retry-after"
+
+#: Response statuses that are retryable WITHOUT an idempotency key: the
+#: server answered without executing the request (quota rejection /
+#: no-capacity), so a replay cannot double-execute.
+RETRYABLE_STATUSES = (STATUS_OVER_QUOTA, 503)
+
+#: ``reason`` label values of ``nv_client_retries_total`` (and the
+#: RetryPolicy counter keys): why a replay was authorized.
+RETRY_REASON_CONNECT = "connect"        # connect-phase transport failure
+RETRY_REASON_SEND = "send"              # send-phase transport failure
+RETRY_REASON_STATUS = "status"          # retryable status (429/503)
+RETRY_REASON_IDEMPOTENT = "idempotent"  # post-send failure + idempotency key
+RETRY_REASONS = (
+    RETRY_REASON_CONNECT,
+    RETRY_REASON_SEND,
+    RETRY_REASON_STATUS,
+    RETRY_REASON_IDEMPOTENT,
+)
+
+#: Circuit-breaker states and their ``nv_client_breaker_state`` gauge
+#: encoding (closed=0, half_open=1, open=2 — higher is less available).
+BREAKER_CLOSED = "closed"
+BREAKER_HALF_OPEN = "half_open"
+BREAKER_OPEN = "open"
+BREAKER_STATES = (BREAKER_CLOSED, BREAKER_HALF_OPEN, BREAKER_OPEN)
+BREAKER_STATE_VALUES = {
+    BREAKER_CLOSED: 0,
+    BREAKER_HALF_OPEN: 1,
+    BREAKER_OPEN: 2,
+}
+
+#: ``outcome`` label values of ``nv_fleet_hedges_total``: who won a
+#: hedged request (``primary`` = hedge fired but the primary still won,
+#: ``hedge`` = the hedge won, ``failed`` = both attempts failed).
+HEDGE_OUTCOME_PRIMARY = "primary"
+HEDGE_OUTCOME_HEDGE = "hedge"
+HEDGE_OUTCOME_FAILED = "failed"
+HEDGE_OUTCOMES = (
+    HEDGE_OUTCOME_PRIMARY,
+    HEDGE_OUTCOME_HEDGE,
+    HEDGE_OUTCOME_FAILED,
+)
+
 #: Server-internal parameter key carrying a request's ``cancel_event``
 #: into engine-backed models (gpt/tp engines poll it between decode
 #: steps). Never on the wire: the front-ends strip/never accept it, and

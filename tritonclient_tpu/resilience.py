@@ -1,0 +1,339 @@
+"""Shared client/fleet resilience primitives: retries, budgets, breakers.
+
+Every retrying surface in the project — the four protocol clients
+(http/grpc × sync/aio), the fleet router's failover, perf_analyzer's
+sweep drivers — consumes the same three primitives so replay semantics
+cannot drift between transports:
+
+* :class:`RetryPolicy` — exponential backoff with **full jitter**
+  (AWS-style: ``delay = uniform(0, min(cap, base * mult**attempt))``),
+  a shared :class:`RetryBudget` so a fleet-wide incident cannot turn
+  into a retry storm, ``Retry-After``/429/503 awareness, and the
+  safety rule this repo's proxies enforce: a request that **may have
+  executed** (failure after the request was fully sent) is never
+  replayed unless the caller attached an idempotency key
+  (``HEADER_IDEMPOTENCY_KEY``). Connect/send-phase failures are
+  provably pre-execution and always eligible.
+* :class:`RetryBudget` — token bucket refilled by successes: each retry
+  spends one token, each success refills ``refill_ratio`` tokens. When
+  the budget is dry the ORIGINAL error surfaces (no silent masking).
+* :class:`CircuitBreaker` — per-endpoint closed → open → half-open
+  state machine: ``failure_threshold`` consecutive failures open it,
+  ``reset_timeout_s`` later one half-open probe is allowed through;
+  the probe's outcome closes or re-opens it. While open, callers fail
+  fast (``BreakerOpenError``) without touching the endpoint.
+
+All mutable state is guarded by ``sanitize.named_lock`` locks so the
+tpusan lock-order witness covers the resilience layer, and every
+random draw goes through an injectable ``random.Random`` so chaos
+tests replay deterministically from a seed.
+"""
+
+import random
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from tritonclient_tpu import sanitize
+from tritonclient_tpu.protocol._literals import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    BREAKER_STATE_VALUES,
+    RETRY_REASON_CONNECT,
+    RETRY_REASON_IDEMPOTENT,
+    RETRY_REASON_SEND,
+    RETRY_REASON_STATUS,
+    RETRY_REASONS,
+    RETRYABLE_STATUSES,
+)
+from tritonclient_tpu.utils import InferenceServerException
+
+#: Request phases a transport failure is classified into. ``connect``
+#: and ``send`` are provably pre-execution (the server never received a
+#: complete request, so it cannot have executed it); ``response`` means
+#: the request was fully sent and MAY have executed.
+PHASE_CONNECT = "connect"
+PHASE_SEND = "send"
+PHASE_RESPONSE = "response"
+PHASES = (PHASE_CONNECT, PHASE_SEND, PHASE_RESPONSE)
+
+
+class BreakerOpenError(InferenceServerException):
+    """Raised (fast, no I/O) when a circuit breaker is open."""
+
+    def __init__(self, endpoint: str = ""):
+        super().__init__(
+            msg=f"circuit breaker open for endpoint '{endpoint}'",
+            status="503",
+        )
+        self.endpoint = endpoint
+
+
+class RetryBudget:
+    """Success-refilled token bucket bounding retries across a client.
+
+    Starts full. Each retry spends one token; each SUCCESS refills
+    ``refill_ratio`` of a token (capped at ``capacity``). Under a full
+    outage the budget drains after ~``capacity`` retries and the
+    original errors surface immediately — the anti-retry-storm valve.
+    """
+
+    def __init__(self, capacity: float = 10.0, refill_ratio: float = 0.1):
+        self.capacity = float(capacity)
+        self.refill_ratio = float(refill_ratio)
+        self._tokens = float(capacity)
+        self._lock = sanitize.named_lock("resilience.RetryBudget._lock")
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+    def try_spend(self) -> bool:
+        """Take one retry token; False when the budget is dry."""
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    def note_success(self):
+        with self._lock:
+            self._tokens = min(self.capacity,
+                               self._tokens + self.refill_ratio)
+
+
+class RetryPolicy:
+    """Replay decision + backoff schedule, shared across call sites.
+
+    The policy is stateless per request apart from its counters and
+    budget, so ONE instance can (and should) be shared by every worker
+    of a client/router — that is what makes the retry budget global.
+
+    ``classify`` is the safety core: it maps (phase, status,
+    idempotent) to a canonical retry reason or ``None`` (not
+    retryable). ``should_retry`` layers attempt count + budget on top.
+    """
+
+    def __init__(
+        self,
+        max_attempts: int = 3,
+        base_delay_s: float = 0.05,
+        max_delay_s: float = 2.0,
+        multiplier: float = 2.0,
+        budget: Optional[RetryBudget] = None,
+        retryable_statuses=RETRYABLE_STATUSES,
+        rng: Optional[random.Random] = None,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = int(max_attempts)
+        self.base_delay_s = float(base_delay_s)
+        self.max_delay_s = float(max_delay_s)
+        self.multiplier = float(multiplier)
+        self.budget = budget if budget is not None else RetryBudget()
+        self.retryable_statuses = tuple(retryable_statuses)
+        self._rng = rng if rng is not None else random.Random()
+        self._sleep = sleep
+        self._lock = sanitize.named_lock("resilience.RetryPolicy._lock")
+        self._counts: Dict[str, int] = {r: 0 for r in RETRY_REASONS}
+        self._exhausted = 0
+
+    # -- classification -------------------------------------------------------
+
+    def classify(self, phase: str, status: Optional[int] = None,
+                 idempotent: bool = False) -> Optional[str]:
+        """Canonical retry reason for one failed attempt, or None.
+
+        * retryable status (429/503): the server answered without
+          executing — always replayable;
+        * connect/send-phase transport failure: provably pre-execution
+          — always replayable;
+        * response-phase transport failure: the request may have
+          executed — replayable ONLY with an idempotency key.
+        """
+        if status is not None and status in self.retryable_statuses:
+            return RETRY_REASON_STATUS
+        if phase == PHASE_CONNECT:
+            return RETRY_REASON_CONNECT
+        if phase == PHASE_SEND:
+            return RETRY_REASON_SEND
+        if phase == PHASE_RESPONSE and idempotent:
+            return RETRY_REASON_IDEMPOTENT
+        return None
+
+    def should_retry(self, attempt: int, reason: Optional[str]) -> bool:
+        """May attempt ``attempt`` (0-based, already failed) be retried
+        for ``reason``? Consumes a budget token on yes; counts the
+        exhaustion on a budget-denied replay (the original error then
+        surfaces)."""
+        if reason is None or attempt + 1 >= self.max_attempts:
+            return False
+        if not self.budget.try_spend():
+            with self._lock:
+                self._exhausted += 1
+            return False
+        with self._lock:
+            self._counts[reason] = self._counts.get(reason, 0) + 1
+        return True
+
+    # -- backoff --------------------------------------------------------------
+
+    def backoff_s(self, attempt: int,
+                  retry_after_s: Optional[float] = None) -> float:
+        """Full-jitter delay before retrying after ``attempt`` (0-based).
+        An explicit server ``Retry-After`` wins (capped at the policy
+        max)."""
+        if retry_after_s is not None:
+            return max(0.0, min(float(retry_after_s), self.max_delay_s))
+        cap = min(self.max_delay_s,
+                  self.base_delay_s * (self.multiplier ** attempt))
+        return self._rng.uniform(0.0, cap)
+
+    def sleep(self, attempt: int, retry_after_s: Optional[float] = None):
+        delay = self.backoff_s(attempt, retry_after_s)
+        if delay > 0:
+            self._sleep(delay)
+        return delay
+
+    def note_success(self):
+        self.budget.note_success()
+
+    # -- introspection --------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, int]:
+        """Counter snapshot: per-reason retries + ``exhausted`` (replays
+        denied by the drained budget) + ``total``."""
+        with self._lock:
+            out = dict(self._counts)
+            out["exhausted"] = self._exhausted
+        out["total"] = sum(out[r] for r in RETRY_REASONS)
+        return out
+
+
+class CircuitBreaker:
+    """Per-endpoint closed → open → half-open breaker.
+
+    ``allow()`` is the gate: True means the caller may attempt I/O
+    (and MUST then report ``on_success``/``on_failure``); False means
+    fail fast. While open, ``allow()`` flips to half-open after
+    ``reset_timeout_s`` and admits exactly ONE probe; the probe's
+    outcome closes (success) or re-opens (failure) the breaker.
+    """
+
+    def __init__(self, endpoint: str = "", failure_threshold: int = 5,
+                 reset_timeout_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.endpoint = endpoint
+        self.failure_threshold = int(failure_threshold)
+        self.reset_timeout_s = float(reset_timeout_s)
+        self._clock = clock
+        self._lock = sanitize.named_lock("resilience.CircuitBreaker._lock")
+        self._state = BREAKER_CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+        self._opens = 0
+        self._fast_failures = 0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            if (
+                self._state == BREAKER_OPEN
+                and self._clock() - self._opened_at >= self.reset_timeout_s
+            ):
+                return BREAKER_HALF_OPEN
+            return self._state
+
+    def state_value(self) -> int:
+        """Gauge encoding for ``nv_client_breaker_state``."""
+        return BREAKER_STATE_VALUES[self.state]
+
+    def blocked(self) -> bool:
+        """Non-mutating routing filter: True while OPEN inside the
+        cooldown (half-open is NOT blocked — the next request through is
+        the probe). Unlike ``allow`` this neither admits a probe nor
+        counts a fast failure, so balancers can filter candidates with
+        it without consuming breaker state."""
+        with self._lock:
+            return (
+                self._state == BREAKER_OPEN
+                and self._clock() - self._opened_at < self.reset_timeout_s
+            )
+
+    def allow(self) -> bool:
+        with self._lock:
+            if self._state == BREAKER_CLOSED:
+                return True
+            now = self._clock()
+            if self._state == BREAKER_OPEN:
+                if now - self._opened_at >= self.reset_timeout_s:
+                    self._state = BREAKER_HALF_OPEN
+                    self._probe_in_flight = True
+                    return True
+                self._fast_failures += 1
+                return False
+            # half-open: one probe at a time
+            if self._probe_in_flight:
+                self._fast_failures += 1
+                return False
+            self._probe_in_flight = True
+            return True
+
+    def on_success(self):
+        with self._lock:
+            self._state = BREAKER_CLOSED
+            self._consecutive_failures = 0
+            self._probe_in_flight = False
+
+    def on_failure(self):
+        with self._lock:
+            self._consecutive_failures += 1
+            if self._state == BREAKER_HALF_OPEN or (
+                self._state == BREAKER_CLOSED
+                and self._consecutive_failures >= self.failure_threshold
+            ):
+                if self._state != BREAKER_OPEN:
+                    self._opens += 1
+                self._state = BREAKER_OPEN
+                self._opened_at = self._clock()
+                self._probe_in_flight = False
+
+    def check(self):
+        """``allow()`` or raise :class:`BreakerOpenError` (fast path for
+        clients that prefer an exception to a bool)."""
+        if not self.allow():
+            raise BreakerOpenError(self.endpoint)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "state": self._state,
+                "opens": self._opens,
+                "fast_failures": self._fast_failures,
+                "consecutive_failures": self._consecutive_failures,
+            }
+
+
+def is_breaker_error(error) -> bool:
+    """Is this client-side error a fast circuit-breaker rejection (no
+    I/O happened)? perf_analyzer classifies these apart from errors the
+    way sheds and quota rejections are."""
+    return isinstance(error, BreakerOpenError) or (
+        "circuit breaker open" in str(error)
+    )
+
+
+def parse_retry_after(value) -> Optional[float]:
+    """``Retry-After`` seconds from a header value (delta-seconds form
+    only; HTTP-date values are ignored)."""
+    if value is None:
+        return None
+    try:
+        seconds = float(value)
+    except (TypeError, ValueError):
+        return None
+    return seconds if seconds >= 0 else None

@@ -26,17 +26,31 @@ raises never marks the region registered).
 """
 
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional, Set, Tuple
 
 _LOCK = threading.Lock()
 #: (kind, name) -> "created" | "registered" | "unregistered" | "destroyed"
 _STATES: Dict[Tuple[str, str], str] = {}
+#: (kind, name) created through the CLIENT-side module APIs — the only
+#: keys the exit-time leak check may blame (a server registry name with
+#: no client handle, e.g. an alias registration, is not a leakable
+#: handle).
+_CREATED: Set[Tuple[str, str]] = set()
+#: (kind, name) -> registry-instance ids currently holding a server-side
+#: registration. The fleet tier runs N replica registries in ONE test
+#: process, each legitimately registering the same region name (the
+#: router fans admin state out to every replica) — double-register is a
+#: violation per REGISTRY, not per process, and a region is
+#: "unregistered" only when no registry holds it.
+_SERVER_REGS: Dict[Tuple[str, str], Set[int]] = {}
 _PATCHED = []
 
 
 def reset():
     with _LOCK:
         _STATES.clear()
+        _CREATED.clear()
+        _SERVER_REGS.clear()
 
 
 def _report(message: str):
@@ -58,20 +72,31 @@ def _get_state(kind: str, name: str) -> Optional[str]:
 def on_create(kind: str, name: str):
     # Re-creating a name after destroy is the normal reuse pattern;
     # leak detection happens at exit, not here.
-    _set_state(kind, name, "created")
+    with _LOCK:
+        _STATES[(kind, name)] = "created"
+        _CREATED.add((kind, name))
 
 
-def on_register(kind: str, name: str):
-    if _get_state(kind, name) == "registered":
+def on_register(kind: str, name: str, registry=None):
+    """``registry`` identifies the server-side registry instance (None
+    for registrations observed without one — treated as a single
+    anonymous registry)."""
+    rid = id(registry) if registry is not None else 0
+    with _LOCK:
+        regs = _SERVER_REGS.setdefault((kind, name), set())
+        duplicate = rid in regs
+        if not duplicate:
+            regs.add(rid)
+            _STATES[(kind, name)] = "registered"
+    if duplicate:
         _report(
             f"{kind} shared-memory region '{name}' registered twice "
             "without an intervening unregister"
         )
-        return
-    _set_state(kind, name, "registered")
 
 
-def on_unregister(kind: str, name: Optional[str]):
+def on_unregister(kind: str, name: Optional[str], registry=None):
+    rid = id(registry) if registry is not None else 0
     with _LOCK:
         if name:
             keys = [(kind, name)] if (kind, name) in _STATES else []
@@ -79,6 +104,11 @@ def on_unregister(kind: str, name: Optional[str]):
             keys = [k for k, s in _STATES.items()
                     if k[0] == kind and s == "registered"]
         for key in keys:
+            regs = _SERVER_REGS.get(key)
+            if regs is not None:
+                regs.discard(rid)
+                if regs:
+                    continue  # still registered on another replica
             if _STATES[key] == "registered":
                 _STATES[key] = "unregistered"
 
@@ -103,13 +133,35 @@ def on_destroy(kind: str, name: str):
             f"{kind} shared-memory region '{name}' destroyed while still "
             "registered with the server"
         )
-    _set_state(kind, name, "destroyed")
+    with _LOCK:
+        _SERVER_REGS.pop((kind, name), None)
+        _STATES[(kind, name)] = "destroyed"
+
+
+def on_registry_dropped(registry):
+    """Forget a dead registry's registrations.
+
+    A stopped/crashed server no longer maps anything: fleet crash
+    drills stop an ``InferenceServer`` and start a fresh one on the
+    same ports, and the dead instance's registrations must not pin
+    regions "registered" forever (``InferenceServer.stop`` reports its
+    core's registries here). No-op when the sanitizer is off."""
+    if not _active():
+        return
+    rid = id(registry)
+    with _LOCK:
+        for key, regs in _SERVER_REGS.items():
+            if rid in regs:
+                regs.discard(rid)
+                if not regs and _STATES.get(key) == "registered":
+                    _STATES[key] = "unregistered"
 
 
 def report_leaks():
     with _LOCK:
         leaked = sorted(
-            key for key, state in _STATES.items() if state != "destroyed"
+            key for key, state in _STATES.items()
+            if state != "destroyed" and key in _CREATED
         )
     for kind, name in leaked:
         _report(
@@ -214,25 +266,30 @@ def install():
                 return orig_register(self, name, *args, **kwargs)
             # Checked BEFORE the call: the server's register is a replace
             # (the old mapping is dropped silently), so double-register
-            # must be witnessed at the protocol level. A register that
-            # then FAILS rolls the state machine back — a rejected handle
+            # must be witnessed at the protocol level — per REGISTRY
+            # instance (N fleet replicas in one process each legitimately
+            # hold the fanned-out registration). A register that then
+            # FAILS rolls this registry's mark back — a rejected handle
             # never advances the region's lifecycle.
             prev = _get_state(kind, name)
-            on_register(kind, name)
+            on_register(kind, name, registry=self)
             try:
                 return orig_register(self, name, *args, **kwargs)
             except BaseException:
+                on_unregister(kind, name, registry=self)
                 with _LOCK:
-                    if prev is None:
-                        _STATES.pop((kind, name), None)
-                    else:
-                        _STATES[(kind, name)] = prev
+                    if not _SERVER_REGS.get((kind, name)):
+                        if prev is None and (kind, name) not in _CREATED:
+                            _STATES.pop((kind, name), None)
+                            _SERVER_REGS.pop((kind, name), None)
+                        elif prev is not None:
+                            _STATES[(kind, name)] = prev
                 raise
 
         def unregister(self, name, *args, **kwargs):
             out = orig_unregister(self, name, *args, **kwargs)
             if _active():
-                on_unregister(kind, name)
+                on_unregister(kind, name, registry=self)
             return out
 
         _PATCHED.append((cls, "register", orig_register))

@@ -104,6 +104,15 @@ class InferenceServer:
             self._http.stop()
         if self._grpc:
             self._grpc.stop()
+        # A stopped server no longer maps shared-memory regions: tell
+        # the tpusan shm witness its registries are dead (no-op when the
+        # sanitizer is off). Fleet crash drills stop a replica and boot
+        # a fresh one on the same ports; without this, the dead
+        # instance's registrations pin regions "registered" forever.
+        from tritonclient_tpu.sanitize import _shm as _shm_witness
+
+        _shm_witness.on_registry_dropped(self.core.system_shm)
+        _shm_witness.on_registry_dropped(self.core.tpu_shm)
 
     def __enter__(self):
         return self.start()
