@@ -30,6 +30,11 @@ Checks (exit 1 with one line per violation):
     ``nv_fleet_replica_up`` is a per-replica gauge valued 0/1;
     ``nv_fleet_replica_outstanding`` / ``nv_fleet_replica_queue_depth``
     carry a replica label and are non-negative
+  * the stepscope families: ``nv_engine_step_duration_us_quantiles``
+    quantile rows carry exactly {model, phase, stage, quantile} with
+    ``stage``/``phase`` drawn from the canonical stepscope vocabularies
+    (and the shared summary checks — quantile monotonicity, _sum/_count);
+    ``nv_engine_collectives_total`` carries exactly {model, op}
 """
 
 import os
@@ -54,6 +59,12 @@ except ImportError:  # standalone copy of the script: keep it usable
     RETRY_REASONS = ("connect", "send", "status", "idempotent")
     HEDGE_OUTCOMES = ("primary", "hedge", "failed")
 
+try:
+    from tritonclient_tpu._stepscope import STEP_PHASES, STEP_STAGES
+except ImportError:  # standalone copy of the script: keep it usable
+    STEP_STAGES = ("dispatch", "device", "other")
+    STEP_PHASES = ("prefill", "decode", "compute")
+
 _SHED_FAMILY = "nv_inference_shed_total"
 # Fleet-router families (served by the router's own /metrics): same
 # stable-label-set discipline as the shed counter.
@@ -69,6 +80,10 @@ _RETRY_FAMILY = "nv_client_retries_total"
 _HEDGE_FAMILY = "nv_fleet_hedges_total"
 _RESTARTS_FAMILY = "nv_fleet_replica_restarts_total"
 _BREAKER_FAMILY = "nv_client_breaker_state"
+# Stepscope families (engine step profiling): fixed label sets with
+# canonical stage/phase vocabularies so dashboards can group blindly.
+_STEP_FAMILY = "nv_engine_step_duration_us_quantiles"
+_COLLECTIVES_FAMILY = "nv_engine_collectives_total"
 
 _VALID_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
 _METRIC_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
@@ -274,6 +289,16 @@ def check_exposition(text: str) -> List[str]:
                             f"line {lineno}: {family} label set "
                             f"{sorted(labels)} != ['replica']"
                         )
+            if family == _COLLECTIVES_FAMILY:
+                # Stepscope collectives: fixed {model, op} label set (the
+                # op value is open vocabulary — psum/ppermute/all_to_all
+                # today, whatever the parallel plane adds tomorrow).
+                for labels, value, name, lineno in samples.get(family, []):
+                    if set(labels) != {"model", "op"}:
+                        errors.append(
+                            f"line {lineno}: {family} label set "
+                            f"{sorted(labels)} != ['model', 'op']"
+                        )
             continue
         if ftype == "gauge":
             if family.endswith("_age_us"):
@@ -324,6 +349,33 @@ def check_exposition(text: str) -> List[str]:
                         )
             continue
         if ftype == "summary":
+            if family == _STEP_FAMILY:
+                # Stepscope step-duration summary: quantile rows carry
+                # exactly {model, phase, stage, quantile}; _sum/_count
+                # rows drop the quantile label; stage and phase come from
+                # the canonical stepscope vocabularies.
+                for labels, value, name, lineno in samples.get(family, []):
+                    want = {"model", "phase", "stage"}
+                    if name == family:
+                        want = want | {"quantile"}
+                    if set(labels) != want:
+                        errors.append(
+                            f"line {lineno}: {family} label set "
+                            f"{sorted(labels)} != {sorted(want)}"
+                        )
+                        continue
+                    if labels["stage"] not in STEP_STAGES:
+                        errors.append(
+                            f"line {lineno}: {family} stage "
+                            f"{labels['stage']!r} not in "
+                            f"{list(STEP_STAGES)}"
+                        )
+                    if labels["phase"] not in STEP_PHASES:
+                        errors.append(
+                            f"line {lineno}: {family} phase "
+                            f"{labels['phase']!r} not in "
+                            f"{list(STEP_PHASES)}"
+                        )
             # Group per label set (minus 'quantile'); quantile rows must be
             # valid quantiles and monotone non-decreasing in q, _sum/_count
             # present and non-negative.

@@ -1,0 +1,529 @@
+#!/usr/bin/env python
+"""Dominant-stage verdict for engine steps: dispatch-, device-, or
+collective-bound.
+
+``tail_report.py`` attributes *request* tails across serving stages;
+this report goes one level down, into the engine step records stepscope
+(``TPU_STEPSCOPE=1``) collects: host-dispatch time vs device time vs the
+clamped remainder, plus collectives charged per step. It consumes
+
+* a stepscope dump (``tritonclient_tpu._stepscope.dump()`` saved to a
+  file) — the primary input: the recent-step ring with full breakdowns;
+* a flight-recorder dump (``GET v2/debug/flight_recorder``) — retained
+  records carry the slowest step's breakdown as ``step.slowest.*``
+  attributes;
+* a Perfetto trace file whose thread-scoped stepscope tracks carry the
+  per-step args (``--trace-out`` / flight Perfetto export);
+* a MULTICHIP bench record (``MULTICHIP_rNN.json``) whose tail carries
+  the ``[tp-engine-stepscope]`` breakdown line.
+
+and reports, per model: per-phase step p50/p99, the mean per-step stage
+split, collectives per step, and the verdict —
+
+* **dispatch-bound** — host time (dispatch + other) dominates: the
+  device waits on python/trace/dispatch; batch more or trim host work;
+* **device-bound** — device time dominates and steps issue no
+  collectives: compute is the wall; scale or shrink the model;
+* **collective-bound** — device time dominates and steps carry
+  collectives: the tp all-reduces are inside that device time, so
+  overlap (Triton-distributed-style) is the lever.
+
+Usage::
+
+    python scripts/step_report.py DUMP_FILE [--json]
+    python scripts/step_report.py DUMP_A --compare DUMP_B   # tp=1 vs tp=2
+    python scripts/step_report.py --self-check
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from tritonclient_tpu import _otel, _stepscope  # noqa: E402
+
+STAGES = _stepscope.STEP_STAGES
+
+VERDICT_DISPATCH = "dispatch-bound"
+VERDICT_DEVICE = "device-bound"
+VERDICT_COLLECTIVE = "collective-bound"
+
+_BENCH_TAG = "dryrun_multichip[tp-engine-stepscope]:"
+
+
+def _percentile(sorted_values: List[int], q: float) -> int:
+    if not sorted_values:
+        return 0
+    idx = min(int(q * len(sorted_values)), len(sorted_values) - 1)
+    return sorted_values[idx]
+
+
+def _coll_count(collectives) -> int:
+    """Total op count from a record's collectives field (dict of
+    op -> {count, bytes}, or already an int)."""
+    if isinstance(collectives, dict):
+        total = 0
+        for v in collectives.values():
+            total += int(v.get("count", 0)) if isinstance(v, dict) else int(v)
+        return total
+    try:
+        return int(collectives or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
+def _records_from_flight(doc: dict) -> List[dict]:
+    """One pseudo-record per retained flight record that carries the
+    slowest-step stamp (deduped: the same slowest step is stamped onto
+    many records)."""
+    seen = set()
+    out = []
+    for rec in doc.get("records", []):
+        attrs = rec.get("attributes") or {}
+        if "step.slowest.total_us" not in attrs:
+            continue
+        key = (rec.get("model_name", ""), attrs.get("step.slowest.phase"),
+               attrs.get("step.slowest.index"))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append({
+            "model": rec.get("model_name", ""),
+            "phase": attrs.get("step.slowest.phase", "decode"),
+            "step_index": int(attrs.get("step.slowest.index", 0)),
+            "batch_size": int(attrs.get("step.slowest.batch_size", 0)),
+            "dispatch_us": int(attrs.get("step.slowest.dispatch_us", 0)),
+            "device_us": int(attrs.get("step.slowest.device_us", 0)),
+            "other_us": int(attrs.get("step.slowest.other_us", 0)),
+            "total_us": int(attrs.get("step.slowest.total_us", 0)),
+            "collectives": int(attrs.get("step.slowest.collectives", 0)),
+        })
+    return out
+
+
+def _records_from_spans(spans: List[dict]) -> List[dict]:
+    """Step records from a trace file's stepscope thread tracks (events
+    whose args carry the per-step breakdown)."""
+    out = []
+    for s in spans:
+        attrs = s.get("attributes") or {}
+        if "dispatch_us" not in attrs or "phase" not in attrs:
+            continue
+        dispatch = int(attrs.get("dispatch_us", 0))
+        device = int(attrs.get("device_us", 0))
+        other = int(attrs.get("other_us", 0))
+        out.append({
+            "model": attrs.get("model", ""),
+            "phase": attrs.get("phase", "decode"),
+            "step_index": int(attrs.get("step_index", 0)),
+            "batch_size": int(attrs.get("batch_size", 0)),
+            "dispatch_us": dispatch,
+            "device_us": device,
+            "other_us": other,
+            "total_us": int(s.get("duration_ns", 0)) // 1000
+            or dispatch + device + other,
+            "collectives": int(attrs.get("collectives", 0)),
+        })
+    return out
+
+
+def load_records(doc) -> List[dict]:
+    """Normalize any supported input document to flat step-record dicts:
+    {model, phase, step_index, batch_size, dispatch_us, device_us,
+    other_us, total_us, collectives:int}."""
+    if isinstance(doc, dict) and doc.get("kind") == "stepscope":
+        out = []
+        for r in doc.get("records", []):
+            r = dict(r)
+            r["collectives"] = _coll_count(r.get("collectives"))
+            out.append(r)
+        return out
+    if isinstance(doc, dict) and doc.get("kind") == "flight_recorder":
+        return _records_from_flight(doc)
+    return _records_from_spans(_otel.load_spans(doc))
+
+
+def load_file(path: str) -> List[dict]:
+    with open(path) as f:
+        return load_records(json.load(f))
+
+
+def _verdict(dispatch_us: float, device_us: float, other_us: float,
+             coll_per_step: float) -> str:
+    """The decision rule: host time (dispatch + the clamped remainder)
+    vs device time; device-dominant steps that issue collectives are
+    collective-bound (the all-reduce wait is inside device time — there
+    is no separate collective clock)."""
+    if dispatch_us + other_us >= device_us:
+        return VERDICT_DISPATCH
+    if coll_per_step > 0:
+        return VERDICT_COLLECTIVE
+    return VERDICT_DEVICE
+
+
+def analyze(records: List[dict]) -> dict:
+    """Per-model verdict + per-phase quantiles and stage means."""
+    by_model: Dict[str, List[dict]] = {}
+    for r in records:
+        by_model.setdefault(r.get("model", ""), []).append(r)
+    models = {}
+    for model, recs in sorted(by_model.items()):
+        phases = {}
+        for phase in sorted({r.get("phase", "") for r in recs}):
+            ph = [r for r in recs if r.get("phase", "") == phase]
+            totals = sorted(int(r.get("total_us", 0)) for r in ph)
+            n = len(ph)
+            phases[phase] = {
+                "n": n,
+                "p50_us": _percentile(totals, 0.50),
+                "p99_us": _percentile(totals, 0.99),
+                "mean_us": {
+                    stage: sum(int(r.get(f"{stage}_us", 0)) for r in ph) // n
+                    for stage in STAGES
+                },
+                "collectives_per_step": round(
+                    sum(_coll_count(r.get("collectives")) for r in ph) / n, 2
+                ),
+                "mean_batch": round(
+                    sum(int(r.get("batch_size", 0)) for r in ph) / n, 2
+                ),
+            }
+        n = len(recs)
+        means = {
+            stage: sum(int(r.get(f"{stage}_us", 0)) for r in recs) / n
+            for stage in STAGES
+        }
+        coll = sum(_coll_count(r.get("collectives")) for r in recs) / n
+        models[model] = {
+            "n": n,
+            "mean_us": {k: round(v, 1) for k, v in means.items()},
+            "collectives_per_step": round(coll, 2),
+            "verdict": _verdict(means["dispatch"], means["device"],
+                                means["other"], coll),
+            "phases": phases,
+        }
+    return {"models": models}
+
+
+def render(analysis: dict) -> str:
+    lines = []
+    for model, m in analysis["models"].items():
+        mu = m["mean_us"]
+        total = max(sum(mu.values()), 1)
+        shares = " ".join(
+            f"{stage}={mu[stage]}us({100 * mu[stage] / total:.0f}%)"
+            for stage in STAGES
+        )
+        lines.append(
+            f"{model}: {m['n']} steps, {shares}, "
+            f"coll/step={m['collectives_per_step']} -> "
+            f"verdict: {m['verdict']}"
+        )
+        lines.append(
+            f"  {'phase':<10} {'n':>6} {'p50_us':>8} {'p99_us':>8} "
+            f"{'dispatch':>9} {'device':>8} {'other':>7} {'coll':>6} "
+            f"{'batch':>6}"
+        )
+        for phase, ph in m["phases"].items():
+            pm = ph["mean_us"]
+            lines.append(
+                f"  {phase:<10} {ph['n']:>6} {ph['p50_us']:>8} "
+                f"{ph['p99_us']:>8} {pm['dispatch']:>9} {pm['device']:>8} "
+                f"{pm['other']:>7} {ph['collectives_per_step']:>6} "
+                f"{ph['mean_batch']:>6}"
+            )
+    return "\n".join(lines)
+
+
+def compare(a: dict, b: dict, label_a: str = "A",
+            label_b: str = "B") -> str:
+    """tp=1 vs tp=2 mode: line up the two runs' per-phase quantiles and
+    verdicts, with B/A slowdown ratios per shared phase."""
+    lines = [f"-- {label_a} --", render(a), f"-- {label_b} --", render(b),
+             "-- comparison --"]
+    models_a, models_b = a["models"], b["models"]
+    for model_b, mb in models_b.items():
+        # Pair by exact model name first, else by position (tp runs may
+        # serve the same config under a different scope name).
+        ma = models_a.get(model_b)
+        model_a = model_b
+        if ma is None and len(models_a) == 1:
+            model_a, ma = next(iter(models_a.items()))
+        if ma is None:
+            continue
+        lines.append(
+            f"{label_a}[{model_a}]: {ma['verdict']} vs "
+            f"{label_b}[{model_b}]: {mb['verdict']}"
+        )
+        for phase, phb in mb["phases"].items():
+            pha = ma["phases"].get(phase)
+            if pha is None or not pha["p50_us"]:
+                continue
+            r50 = phb["p50_us"] / max(pha["p50_us"], 1)
+            r99 = phb["p99_us"] / max(pha["p99_us"], 1)
+            lines.append(
+                f"  {phase}: p50 {pha['p50_us']} -> {phb['p50_us']} us "
+                f"({r50:.2f}x), p99 {pha['p99_us']} -> {phb['p99_us']} us "
+                f"({r99:.2f}x), coll/step "
+                f"{pha['collectives_per_step']} -> "
+                f"{phb['collectives_per_step']}"
+            )
+    return "\n".join(lines)
+
+
+# -- MULTICHIP bench tail --------------------------------------------------- #
+
+
+def bench_tail_summary(doc: dict) -> Optional[dict]:
+    """Extract the ``[tp-engine-stepscope]`` breakdown a MULTICHIP bench
+    record carries in its tail (written by __graft_entry__)."""
+    tail = doc.get("tail")
+    if not isinstance(tail, str):
+        return None
+    for line in tail.splitlines():
+        line = line.strip()
+        if line.startswith(_BENCH_TAG):
+            try:
+                return json.loads(line[len(_BENCH_TAG):].strip())
+            except json.JSONDecodeError:
+                return None
+    return None
+
+
+def render_bench(summary: dict) -> str:
+    tp = summary.get("tp", "?")
+    lines = [f"MULTICHIP stepscope breakdown (tp={tp} vs tp=1):"]
+    for key, label in (("tp", f"tp={tp}"), ("tp1", "tp=1")):
+        row = summary.get(f"{key}_decode") or {}
+        verdict = summary.get(f"{key}_verdict", "?")
+        if row:
+            lines.append(
+                f"  {label}: decode p50={row.get('p50_us')}us "
+                f"p99={row.get('p99_us')}us "
+                f"dispatch={row.get('dispatch_us')}us "
+                f"device={row.get('device_us')}us "
+                f"other={row.get('other_us')}us "
+                f"coll/step={row.get('collectives_per_step')} -> "
+                f"verdict: {verdict}"
+            )
+        else:
+            lines.append(f"  {label}: verdict: {verdict}")
+    return "\n".join(lines)
+
+
+# -- self-check ------------------------------------------------------------- #
+
+
+def _synthetic_dump(dispatch_us: int, device_us: int, other_us: int,
+                    coll_per_step: int, model: str = "gpt_engine",
+                    n: int = 24) -> dict:
+    """Deterministic stepscope-kind dump (no RNG: a fixed per-step jitter
+    pattern keeps quantiles meaningful and reproducible)."""
+    records = []
+    for i in range(n):
+        jitter = (i * 7) % 5  # 0..4 us, fixed pattern
+        d, dev, o = dispatch_us + jitter, device_us + jitter, other_us
+        records.append({
+            "model": model,
+            "phase": "decode" if i % 4 else "prefill",
+            "step_index": i,
+            "batch_size": 4,
+            "start_ns": 1_000_000 + i * 1_000_000,
+            "dispatch_us": d,
+            "device_us": dev,
+            "other_us": o,
+            "total_us": d + dev + o,
+            "collectives": (
+                {"psum": {"count": coll_per_step, "bytes": 0}}
+                if coll_per_step else {}
+            ),
+            "thread_ident": 42,
+            "thread_name": "gpt-engine",
+        })
+    return {"kind": "stepscope", "mode": "counters", "records": records}
+
+
+def self_check() -> int:
+    """Three synthetic dumps with known dominant stages must recover
+    their verdicts through load/analyze/render, via the stepscope loader
+    AND the Perfetto track round-trip; the flight-dump loader must
+    recover the slowest-step stamp."""
+    failures = 0
+    cases = [
+        ("dispatch-heavy", _synthetic_dump(900, 80, 40, 0),
+         VERDICT_DISPATCH),
+        ("device-heavy", _synthetic_dump(60, 900, 20, 0), VERDICT_DEVICE),
+        ("collective-heavy", _synthetic_dump(60, 900, 20, 16),
+         VERDICT_COLLECTIVE),
+    ]
+    for label, dump, want in cases:
+        analysis = analyze(load_records(dump))
+        got = analysis["models"]["gpt_engine"]["verdict"]
+        if got != want:
+            print(f"self-check [{label}]: verdict {got} != {want}",
+                  file=sys.stderr)
+            failures += 1
+            continue
+        rendered = render(analysis)
+        if want not in rendered or "decode" not in rendered:
+            print(f"self-check [{label}]: render missing verdict/phase",
+                  file=sys.stderr)
+            failures += 1
+            continue
+        print(f"self-check [{label}]: ok ({got})")
+    # Perfetto round-trip: stepscope events -> loader -> same verdict.
+    dump = cases[2][1]
+    events = []
+    for r in dump["records"]:
+        events.append({
+            "name": f"{r['model']}/{r['phase']}[{r['step_index']}]",
+            "cat": "stepscope", "ph": "X",
+            "ts": r["start_ns"] / 1000.0, "dur": r["total_us"],
+            "pid": 7, "tid": r["thread_ident"],
+            "args": {
+                "model": r["model"], "phase": r["phase"],
+                "step_index": str(r["step_index"]),
+                "batch_size": str(r["batch_size"]),
+                "dispatch_us": str(r["dispatch_us"]),
+                "device_us": str(r["device_us"]),
+                "other_us": str(r["other_us"]),
+                "collectives": str(_coll_count(r["collectives"])),
+            },
+        })
+    perfetto_doc = {"displayTimeUnit": "ns", "traceEvents": events}
+    analysis = analyze(load_records(perfetto_doc))
+    got = analysis["models"]["gpt_engine"]["verdict"]
+    if got != VERDICT_COLLECTIVE:
+        print(f"self-check [perfetto]: verdict {got} != "
+              f"{VERDICT_COLLECTIVE}", file=sys.stderr)
+        failures += 1
+    else:
+        print("self-check [perfetto]: ok")
+    # Flight-dump loader: the slowest-step stamp round-trips.
+    flight = {
+        "kind": "flight_recorder",
+        "records": [{
+            "model_name": "gpt_engine",
+            "attributes": {
+                "step.slowest.phase": "decode",
+                "step.slowest.index": 9,
+                "step.slowest.batch_size": 4,
+                "step.slowest.total_us": 1500,
+                "step.slowest.dispatch_us": 1200,
+                "step.slowest.device_us": 250,
+                "step.slowest.other_us": 50,
+                "step.slowest.collectives": 0,
+            },
+        }],
+    }
+    analysis = analyze(load_records(flight))
+    got = analysis["models"]["gpt_engine"]["verdict"]
+    if got != VERDICT_DISPATCH:
+        print(f"self-check [flight]: verdict {got} != {VERDICT_DISPATCH}",
+              file=sys.stderr)
+        failures += 1
+    else:
+        print("self-check [flight]: ok")
+    # Compare mode renders ratios for shared phases.
+    a = analyze(load_records(_synthetic_dump(60, 200, 20, 0)))
+    b = analyze(load_records(_synthetic_dump(60, 700, 20, 16)))
+    text = compare(a, b, "tp=1", "tp=2")
+    if "decode: p50" not in text or VERDICT_COLLECTIVE not in text:
+        print("self-check [compare]: comparison incomplete",
+              file=sys.stderr)
+        failures += 1
+    else:
+        print("self-check [compare]: ok")
+    # Bench-tail extraction.
+    tail_doc = {"tail": (
+        "dryrun_multichip[tp-engine-genai]: ...\n"
+        + _BENCH_TAG + ' {"tp": 2, "tp_verdict": "collective-bound", '
+        '"tp1_verdict": "dispatch-bound", "tp_decode": {"p50_us": 90, '
+        '"p99_us": 120, "dispatch_us": 20, "device_us": 60, '
+        '"other_us": 10, "collectives_per_step": 4.0}, "tp1_decode": '
+        '{"p50_us": 30, "p99_us": 40, "dispatch_us": 20, '
+        '"device_us": 8, "other_us": 2, "collectives_per_step": 0.0}}\n'
+    )}
+    summary = bench_tail_summary(tail_doc)
+    if not summary or "collective-bound" not in render_bench(summary):
+        print("self-check [bench-tail]: extraction failed",
+              file=sys.stderr)
+        failures += 1
+    else:
+        print("self-check [bench-tail]: ok")
+    if failures:
+        print(f"self-check: {failures} failure(s)", file=sys.stderr)
+        return 1
+    print("self-check: verdicts recovered through every loader")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="step_report",
+        description="Dominant-stage verdict for engine step records",
+    )
+    parser.add_argument("dump_file", nargs="?",
+                        help="stepscope dump, flight dump, trace file, "
+                        "or MULTICHIP bench record")
+    parser.add_argument("--compare", metavar="DUMP_B",
+                        help="second dump (e.g. tp=2) to line up against "
+                        "dump_file (e.g. tp=1)")
+    parser.add_argument("--json", dest="as_json", action="store_true",
+                        help="emit the analysis as JSON")
+    parser.add_argument("--self-check", action="store_true",
+                        help="run the synthetic verdict checks and exit")
+    args = parser.parse_args(argv)
+    if args.self_check:
+        return self_check()
+    if not args.dump_file:
+        parser.error("a dump file is required (or --self-check)")
+    try:
+        with open(args.dump_file) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"unable to load {args.dump_file}: {e}", file=sys.stderr)
+        return 1
+    bench = bench_tail_summary(doc) if isinstance(doc, dict) else None
+    if bench is not None:
+        print(json.dumps(bench, indent=2) if args.as_json
+              else render_bench(bench))
+        return 0
+    try:
+        records = load_records(doc)
+    except ValueError as e:
+        print(f"unable to parse {args.dump_file}: {e}", file=sys.stderr)
+        return 1
+    if not records:
+        print(f"{args.dump_file}: no step records (is TPU_STEPSCOPE on?)",
+              file=sys.stderr)
+        return 1
+    analysis = analyze(records)
+    if args.compare:
+        try:
+            other = load_file(args.compare)
+        except (OSError, ValueError) as e:
+            print(f"unable to load {args.compare}: {e}", file=sys.stderr)
+            return 1
+        if not other:
+            print(f"{args.compare}: no step records", file=sys.stderr)
+            return 1
+        print(compare(analysis, analyze(other),
+                      os.path.basename(args.dump_file),
+                      os.path.basename(args.compare)))
+        return 0
+    try:
+        print(json.dumps(analysis, indent=2) if args.as_json
+              else render(analysis))
+    except BrokenPipeError:
+        return 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -93,6 +93,7 @@ def _record_from_flight(rec: dict) -> Optional[dict]:
         "request_id": rec.get("request_id", ""),
         "status": rec.get("status", "ok"),
         "shed_reason": attrs.get("shed.reason"),
+        "steps_completed": attrs.get("steps_completed"),
         "tenant": attrs.get("tenant"),
         "signature": attrs.get(
             "batcher.signature", rec.get("model_name", "") or "?"
@@ -132,6 +133,7 @@ def _records_from_spans(spans: List[dict]) -> List[dict]:
             ),
             "status": attrs.get("flight.status", "ok"),
             "shed_reason": attrs.get("shed.reason"),
+            "steps_completed": attrs.get("steps_completed"),
             "tenant": attrs.get("tenant"),
             "signature": attrs.get(
                 "batcher.signature",
@@ -305,6 +307,13 @@ def analyze(records: List[dict], tail_q: float = 0.95,
         })
 
     shed_lat = sorted(r["duration_us"] for r in sheds)
+    # Where in the decode loop cancelled requests died (steps_completed
+    # stamped at shed/cancel finalization; engine models count delivered
+    # tokens, batcher models stamp 0).
+    shed_steps = sorted(
+        int(r["steps_completed"]) for r in sheds
+        if r.get("steps_completed") is not None
+    )
     return {
         "records": len(all_records),
         "statuses": {
@@ -323,6 +332,11 @@ def analyze(records: List[dict], tail_q: float = 0.95,
                 for reason in sorted({r["shed_reason"] for r in sheds})
             },
             "shed_p99_us": _percentile(shed_lat, 99),
+            "steps_completed": {
+                "stamped": len(shed_steps),
+                "p50": _percentile(shed_steps, 50),
+                "max": shed_steps[-1] if shed_steps else 0,
+            },
         },
         "tail_q": tail_q,
         "head_q": head_q,
@@ -384,6 +398,13 @@ def render(result: dict, slowest: List[dict]) -> str:
             f"p99 {sheds['shed_p99_us']} us) / {sheds['served']} served "
             "— stage attribution above covers served requests only"
         )
+        steps = sheds.get("steps_completed") or {}
+        if steps.get("stamped"):
+            lines.append(
+                f"  died in the decode loop: {steps['stamped']} stamped, "
+                f"steps completed p50={steps['p50']} max={steps['max']} "
+                "(0 = shed before the first token)"
+            )
     b = result["backlog"]
     if b["stamped"]:
         r_txt = "n/a" if b["pearson_r"] is None else f"{b['pearson_r']:+.3f}"
@@ -570,6 +591,30 @@ def self_check() -> int:
                 f"{t_result['dominant_stage']!r} != 'queue-wait'",
                 file=sys.stderr,
             )
+            failures += 1
+        # Shed rows carry steps_completed (stamped at shed/cancel
+        # finalization): the report must surface where in the decode loop
+        # cancelled requests died.
+        shed_doc = _synthetic_dump(n=40, slow=4)
+        for i, steps in enumerate([0, 2, 5, 9]):
+            rec = shed_doc["records"][i]
+            rec["status"] = "cancel"
+            rec["attributes"]["shed.reason"] = (
+                "cancelled" if steps else "admission"
+            )
+            rec["attributes"]["steps_completed"] = steps
+        shed_path = os.path.join(tmp, "shed.json")
+        with open(shed_path, "w") as f:
+            json.dump(shed_doc, f)
+        s_result = analyze(load_records(shed_path))
+        got_steps = s_result["sheds"].get("steps_completed") or {}
+        if got_steps != {"stamped": 4, "p50": 2, "max": 9}:
+            print(f"self-check [shed steps]: {got_steps} != "
+                  "{'stamped': 4, 'p50': 2, 'max': 9}", file=sys.stderr)
+            failures += 1
+        elif "died in the decode loop" not in render(s_result, []):
+            print("self-check [shed steps]: steps_completed line missing "
+                  "from render", file=sys.stderr)
             failures += 1
     if failures:
         print(f"self-check: {failures} failure(s)", file=sys.stderr)

@@ -49,7 +49,9 @@ def breakdown(spans: List[dict]) -> List[dict]:
     """Per-span-name duration stats, slowest-p99 first."""
     by_name: Dict[str, List[int]] = {}
     for span in spans:
-        by_name.setdefault(span["name"], []).append(span["duration_ns"])
+        by_name.setdefault(span.get("name", ""), []).append(
+            int(span.get("duration_ns", 0))
+        )
     rows = []
     for name, durations in by_name.items():
         durations.sort()
@@ -70,16 +72,21 @@ def slowest_traces(spans: List[dict], n: int) -> List[dict]:
     span envelope when no parentless span was captured)."""
     by_trace: Dict[str, List[dict]] = {}
     for span in spans:
-        by_trace.setdefault(span["trace_id"], []).append(span)
+        by_trace.setdefault(span.get("trace_id", ""), []).append(span)
     ranked = []
     for trace_id, members in by_trace.items():
-        ids = {m["span_id"] for m in members}
-        roots = [m for m in members if m["parent_span_id"] not in ids]
+        # Defensive .get() throughout: thread-scoped tracks (stepscope
+        # engine steps, foreign tool output) are legal input — their
+        # events carry no span/parent ids, and a missing key must read
+        # as "orphan", not crash the parent lookup.
+        ids = {m.get("span_id", "") for m in members} - {""}
+        roots = [m for m in members
+                 if m.get("parent_span_id", "") not in ids]
         duration = (
-            max(m["duration_ns"] for m in roots)
+            max(int(m.get("duration_ns", 0)) for m in roots)
             if roots
-            else max(m["end_ns"] for m in members)
-            - min(m["start_ns"] for m in members)
+            else max(int(m.get("end_ns", 0)) for m in members)
+            - min(int(m.get("start_ns", 0)) for m in members)
         )
         attrs: Dict[str, str] = {}
         for m in members:  # client spans carry no model/request id
@@ -89,8 +96,9 @@ def slowest_traces(spans: List[dict], n: int) -> List[dict]:
             "trace_id": trace_id,
             "duration_us": duration // 1000,
             "spans": {
-                m["name"]: m["duration_ns"] // 1000
-                for m in sorted(members, key=lambda m: m["start_ns"])
+                m.get("name", ""): int(m.get("duration_ns", 0)) // 1000
+                for m in sorted(members,
+                                key=lambda m: int(m.get("start_ns", 0)))
             },
             "model": attrs.get("model", attrs.get("model.name", "")),
             "request_id": attrs.get("request_id", attrs.get("request.id", "")),
@@ -104,7 +112,7 @@ def report(spans: List[dict], slowest: int, as_json: bool) -> str:
     worst = slowest_traces(spans, slowest)
     if as_json:
         return json.dumps({"breakdown": rows, "slowest": worst}, indent=2)
-    n_traces = len({s["trace_id"] for s in spans})
+    n_traces = len({s.get("trace_id", "") for s in spans})
     lines = [f"{len(spans)} spans, {n_traces} traces"]
     lines.append(
         f"{'span':<18} {'count':>6} {'p50_us':>8} {'p95_us':>8} "
@@ -188,10 +196,67 @@ def self_check() -> int:
                 continue
             report(spans, slowest=1, as_json=False)  # must not raise
             print(f"self-check [{mode}]: ok")
+    failures += _self_check_orphan_tracks()
     if failures:
         print(f"self-check: {failures} failure(s)", file=sys.stderr)
         return 1
     print("self-check: all exporters round-trip")
+    return 0
+
+
+def _self_check_orphan_tracks() -> int:
+    """Perfetto files may carry thread-scoped tracks with no request
+    parent (stepscope engine-step tracks; foreign tool output). They must
+    load with per-track identity — not collapse into one '' trace — and
+    the report must render them without a parent lookup crash."""
+    doc = {
+        "displayTimeUnit": "ns",
+        "traceEvents": [
+            # Metadata events are not spans and must be skipped.
+            {"name": "thread_name", "ph": "M", "pid": 7, "tid": 42,
+             "args": {"name": "stepscope:gpt-engine"}},
+            {"name": "gpt_engine/decode[0]", "cat": "stepscope",
+             "ph": "X", "ts": 1000.0, "dur": 250.0, "pid": 7, "tid": 42,
+             "args": {"phase": "decode", "dispatch_us": "80"}},
+            {"name": "gpt_engine/decode[1]", "cat": "stepscope",
+             "ph": "X", "ts": 1300.0, "dur": 200.0, "pid": 7, "tid": 42,
+             "args": {"phase": "decode"}},
+            # A second thread's track, and one event with no args at all.
+            {"name": "gpt_engine/prefill[0]", "cat": "stepscope",
+             "ph": "X", "ts": 900.0, "dur": 400.0, "pid": 7, "tid": 43,
+             "args": {}},
+            {"name": "bare", "ph": "X", "ts": 2000.0, "dur": 10.0,
+             "pid": 7, "tid": 44},
+            # A request-level span in the same file keeps its identity.
+            {"name": "request-handler", "cat": "server", "ph": "X",
+             "ts": 500.0, "dur": 3000.0, "pid": 7, "tid": 1,
+             "args": {"trace_id": "t-req", "span_id": "s1",
+                      "parent_span_id": ""}},
+        ],
+    }
+    try:
+        spans = _otel.load_spans(doc)
+        got_traces = {s["trace_id"] for s in spans}
+        want = {"track-7-42", "track-7-43", "track-7-44", "t-req"}
+        if got_traces != want:
+            print(f"self-check [orphan]: trace grouping {got_traces} != "
+                  f"{want}", file=sys.stderr)
+            return 1
+        rendered = report(spans, slowest=10, as_json=False)
+        if "gpt_engine/decode[0]" not in rendered:
+            print("self-check [orphan]: orphan span missing from report",
+                  file=sys.stderr)
+            return 1
+        ranked = slowest_traces(spans, 10)
+        if len(ranked) != 4:
+            print(f"self-check [orphan]: expected 4 traces, got "
+                  f"{len(ranked)}", file=sys.stderr)
+            return 1
+    except Exception as e:  # the crash this case exists to prevent
+        print(f"self-check [orphan]: raised {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 1
+    print("self-check [orphan-tracks]: ok")
     return 0
 
 

@@ -1,0 +1,437 @@
+"""stepscope: the engine-step profiling plane — per-step dispatch /
+device / other attribution, collective counting, and its three sinks
+(/metrics summary families, flight-recorder slowest-step stamps, Perfetto
+thread tracks) plus the ``step_report.py`` verdict on top.
+
+Deterministic: engines run greedy decoding on the virtual CPU mesh with
+seeded params, and the synthetic-record tests use fixed timings.
+"""
+
+import importlib.util
+import json
+import os
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from tritonclient_tpu import _otel, _stepscope
+from tritonclient_tpu.models import gpt
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+def _load_script(name: str, module: str):
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", name,
+    )
+    spec = importlib.util.spec_from_file_location(module, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _stepscope_clean():
+    """Every test starts and ends with stepscope off and empty, whatever
+    the ambient TPU_STEPSCOPE was."""
+    prev = _stepscope.mode()
+    _stepscope.configure(_stepscope.MODE_OFF)
+    _stepscope.reset()
+    yield
+    _stepscope.configure(prev)
+    _stepscope.reset()
+
+
+def _drain(engine, prompts, max_new):
+    """Submit all prompts concurrently and collect each stream."""
+    results = [None] * len(prompts)
+
+    def consume(i):
+        q = engine.submit(prompts[i], max_new).out
+        toks = []
+        while True:
+            t = q.get(timeout=120)
+            if t is None:
+                break
+            if isinstance(t, BaseException):
+                raise t
+            toks.append(int(t[0]))
+        results[i] = toks
+
+    threads = [threading.Thread(target=consume, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results
+
+
+_PROMPTS_C4 = [
+    np.array([[1, 5, 9, 2]], np.int32),
+    np.array([[2, 4, 6]], np.int32),
+    np.array([[9, 8, 7]], np.int32),
+    np.array([[42]], np.int32),
+]
+
+
+# --------------------------------------------------------------------------- #
+# mode plumbing                                                               #
+# --------------------------------------------------------------------------- #
+
+
+def test_env_mode_parsing(monkeypatch):
+    for raw, want in [
+        ("", _stepscope.MODE_OFF), ("0", _stepscope.MODE_OFF),
+        ("off", _stepscope.MODE_OFF), ("false", _stepscope.MODE_OFF),
+        ("no", _stepscope.MODE_OFF), ("1", _stepscope.MODE_COUNTERS),
+        ("on", _stepscope.MODE_COUNTERS),
+        ("sync", _stepscope.MODE_SYNC), ("SYNC", _stepscope.MODE_SYNC),
+    ]:
+        monkeypatch.setenv("TPU_STEPSCOPE", raw)
+        assert _stepscope._env_mode() == want, raw
+
+
+def test_off_mode_is_inert():
+    assert not _stepscope.enabled()
+    assert _stepscope.step_begin("m", _stepscope.PHASE_DECODE, 0) is None
+    _stepscope.step_dispatched(None)  # must not raise
+    _stepscope.step_end(None)
+    _stepscope.note_collective("psum")  # no active step, scope off
+    assert _stepscope.flight_attributes("m") == {}
+    assert _stepscope.perfetto_events(0) == []
+    step_rows, coll_rows = _stepscope.metrics_snapshot((0.5,))
+    assert step_rows == [] and coll_rows == []
+
+
+def test_expected_tp_collectives():
+    assert _stepscope.expected_tp_collectives(2, 1) == {}
+    assert _stepscope.expected_tp_collectives(2, 2) == {"psum": 4}
+    assert _stepscope.expected_tp_collectives(8, 4) == {"psum": 16}
+
+
+# --------------------------------------------------------------------------- #
+# engine at c4: records partition the compute span                            #
+# --------------------------------------------------------------------------- #
+
+
+def test_engine_c4_records_partition_compute_span():
+    """Four concurrent generations through the engine: every step record's
+    stages partition its span (dispatch + device + other == total, all
+    clamped non-negative), decode and prefill both appear, and occupancy
+    never exceeds the slot count."""
+    from tritonclient_tpu.models.gpt_engine import GenerationEngine
+
+    _stepscope.configure(_stepscope.MODE_COUNTERS)
+    _stepscope.reset()
+    cfg = gpt.gpt_tiny(max_len=32)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    engine = GenerationEngine(cfg, params, max_slots=4)
+    try:
+        results = _drain(engine, _PROMPTS_C4, 6)
+    finally:
+        engine.shutdown()
+    assert all(len(r) == 6 for r in results)
+
+    doc = _stepscope.dump()
+    assert doc["kind"] == "stepscope"
+    records = doc["records"]
+    phases = {r["phase"] for r in records}
+    assert _stepscope.PHASE_PREFILL in phases
+    assert _stepscope.PHASE_DECODE in phases
+    for r in records:
+        assert r["dispatch_us"] >= 0
+        assert r["device_us"] >= 0
+        assert r["other_us"] >= 0
+        # Counters mode: device is the clamped remainder, so the stages
+        # partition the step span exactly (up to the ns->us floor).
+        assert (
+            abs(r["dispatch_us"] + r["device_us"] + r["other_us"]
+                - r["total_us"]) <= 2
+        )
+        assert 0 <= r["batch_size"] <= r["slots"] == 4
+    decode = [r for r in records if r["phase"] == _stepscope.PHASE_DECODE]
+    # Step indices are the engine loop's own sequence: strictly increasing.
+    idx = [r["step_index"] for r in decode]
+    assert idx == sorted(idx) and len(set(idx)) == len(idx)
+    # tp=1 engine: no collectives charged.
+    assert all(r["collectives"] == {} for r in decode)
+
+
+def test_sync_mode_measures_device_stage():
+    """sync mode brackets block_until_ready: the device stage is a real
+    measurement and the three stages still partition the span."""
+    from tritonclient_tpu.models.gpt_engine import GenerationEngine
+
+    _stepscope.configure(_stepscope.MODE_SYNC)
+    _stepscope.reset()
+    cfg = gpt.gpt_tiny(max_len=16)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    engine = GenerationEngine(cfg, params, max_slots=2)
+    try:
+        _drain(engine, _PROMPTS_C4[:2], 4)
+    finally:
+        engine.shutdown()
+    records = _stepscope.dump()["records"]
+    assert records
+    for r in records:
+        assert r["dispatch_us"] >= 0
+        assert r["device_us"] >= 0
+        assert r["other_us"] >= 0
+        assert r["dispatch_us"] + r["device_us"] + r["other_us"] \
+            <= r["total_us"] + 2
+
+
+def test_tp_engine_collectives_match_expected_per_step():
+    """tp=2 engine: GSPMD's implicit all-reduces (one per row-sharded
+    matmul — wo and w_out, so 2 per layer) are charged per step via
+    ``expected_tp_collectives``; every decode step must carry exactly
+    that count."""
+    from tritonclient_tpu.models.gpt_engine import GenerationEngine
+    from tritonclient_tpu.parallel import build_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    _stepscope.configure(_stepscope.MODE_COUNTERS)
+    _stepscope.reset()
+    cfg = gpt.gpt_tiny(max_len=32)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    mesh = build_mesh({"dp": 1, "tp": 2}, jax.devices()[:2])
+    engine = GenerationEngine(cfg, params, max_slots=2, mesh=mesh)
+    try:
+        _drain(engine, _PROMPTS_C4[:2], 4)
+    finally:
+        engine.shutdown()
+    doc = _stepscope.dump()
+    decode = [r for r in doc["records"]
+              if r["phase"] == _stepscope.PHASE_DECODE]
+    assert decode
+    want = _stepscope.expected_tp_collectives(cfg.n_layers, 2)
+    assert want == {"psum": 2 * cfg.n_layers}
+    for r in decode:
+        assert r["collectives"]["psum"]["count"] == want["psum"]
+    # The aggregate counter matches steps * per-step count.
+    _, coll_rows = _stepscope.metrics_snapshot((0.5,))
+    psum_total = sum(c for _, op, c in coll_rows if op == "psum")
+    n_steps = len([r for r in doc["records"]
+                   if r["collectives"].get("psum")])
+    assert psum_total == n_steps * want["psum"]
+
+
+def test_note_collective_charges_active_step():
+    """Explicit call-site notes (ppermute/all_to_all in parallel/) land on
+    the thread's active step with byte accounting."""
+    _stepscope.configure(_stepscope.MODE_COUNTERS)
+    _stepscope.reset()
+    rec = _stepscope.step_begin("m", _stepscope.PHASE_DECODE, 0)
+    _stepscope.step_dispatched(rec)
+    _stepscope.note_collective("ppermute", nbytes=1024)
+    _stepscope.note_collective("ppermute", nbytes=1024)
+    _stepscope.note_collective("all_to_all", nbytes=64)
+    _stepscope.step_end(rec)
+    d = rec.as_dict()
+    assert d["collectives"]["ppermute"] == {"count": 2, "bytes": 2048}
+    assert d["collectives"]["all_to_all"] == {"count": 1, "bytes": 64}
+
+
+# --------------------------------------------------------------------------- #
+# sinks: /metrics, flight recorder, Perfetto                                  #
+# --------------------------------------------------------------------------- #
+
+
+def test_metrics_snapshot_and_exposition():
+    """The summary/counter families built from a live snapshot pass the
+    exposition checker, including the stepscope label-set rules."""
+    from tritonclient_tpu.server import InferenceServer
+
+    _stepscope.configure(_stepscope.MODE_COUNTERS)
+    _stepscope.reset()
+    rec = _stepscope.step_begin("gpt", _stepscope.PHASE_DECODE, 0,
+                                batch_size=2, slots=4)
+    _stepscope.step_dispatched(rec)
+    _stepscope.note_collective("psum", count=4)
+    _stepscope.step_end(rec)
+
+    import urllib.request
+
+    with InferenceServer() as server:
+        text = urllib.request.urlopen(
+            f"http://{server.http_address}/metrics", timeout=10
+        ).read().decode()
+    assert _stepscope.STEP_METRIC in text
+    assert _stepscope.COLLECTIVES_METRIC in text
+    assert 'stage="dispatch"' in text
+    assert 'op="psum"' in text
+    checker = _load_script("check_metrics_exposition.py", "cm_stepscope")
+    assert checker.check_exposition(text) == []
+
+
+def test_exposition_checker_catches_stepscope_violations():
+    checker = _load_script("check_metrics_exposition.py", "cm_stepscope_v")
+    fam = _stepscope.STEP_METRIC
+    head = (f"# HELP {fam} step stage durations\n"
+            f"# TYPE {fam} summary\n")
+    # Wrong label set on a quantile row.
+    bad = head + (f'{fam}{{model="m",stage="dispatch",quantile="0.5"}} 1\n'
+                  f'{fam}_sum{{model="m",stage="dispatch",phase="decode"}} 1\n'
+                  f'{fam}_count{{model="m",stage="dispatch",phase="decode"}} 1\n')
+    assert any("label set" in e for e in checker.check_exposition(bad))
+    # Non-canonical stage value.
+    bad = head + (
+        f'{fam}{{model="m",phase="decode",stage="gpu",quantile="0.5"}} 1\n'
+        f'{fam}_sum{{model="m",phase="decode",stage="gpu"}} 1\n'
+        f'{fam}_count{{model="m",phase="decode",stage="gpu"}} 1\n'
+    )
+    assert any("stage" in e for e in checker.check_exposition(bad))
+    # Non-canonical phase value.
+    bad = head + (
+        f'{fam}{{model="m",phase="warmup",stage="device",quantile="0.5"}} 1\n'
+        f'{fam}_sum{{model="m",phase="warmup",stage="device"}} 1\n'
+        f'{fam}_count{{model="m",phase="warmup",stage="device"}} 1\n'
+    )
+    assert any("phase" in e for e in checker.check_exposition(bad))
+    # Quantile rows must stay monotone (shared summary rule still applies).
+    bad = head + (
+        f'{fam}{{model="m",phase="decode",stage="device",quantile="0.5"}} 9\n'
+        f'{fam}{{model="m",phase="decode",stage="device",quantile="0.99"}} 1\n'
+        f'{fam}_sum{{model="m",phase="decode",stage="device"}} 10\n'
+        f'{fam}_count{{model="m",phase="decode",stage="device"}} 2\n'
+    )
+    assert any("non-decreasing" in e for e in checker.check_exposition(bad))
+    # Collectives counter: wrong label set.
+    cfam = _stepscope.COLLECTIVES_METRIC
+    bad = (f"# HELP {cfam} collectives\n# TYPE {cfam} counter\n"
+           f'{cfam}{{model="m"}} 3\n')
+    assert any("label set" in e for e in checker.check_exposition(bad))
+
+
+def test_flight_attributes_stamp_slowest_step():
+    _stepscope.configure(_stepscope.MODE_COUNTERS)
+    _stepscope.reset()
+    for i, pause in enumerate([0, 1, 0]):
+        rec = _stepscope.step_begin("gpt", _stepscope.PHASE_DECODE, i,
+                                    batch_size=3, slots=4)
+        _stepscope.step_dispatched(rec)
+        if pause:  # make step 1 the slowest deterministically
+            import time
+            time.sleep(0.02)  # tpulint: disable=TPU001 - sync test, no loop
+        _stepscope.step_end(rec)
+    attrs = _stepscope.flight_attributes("gpt")
+    assert attrs["step.slowest.index"] == 1
+    assert attrs["step.slowest.phase"] == _stepscope.PHASE_DECODE
+    assert attrs["step.slowest.batch_size"] == 3
+    assert attrs["step.slowest.total_us"] >= 20_000
+    assert _stepscope.flight_attributes("other-model") == {}
+
+
+def test_perfetto_events_load_as_orphan_tracks():
+    """The Perfetto sink's thread-scoped events survive the loader (minted
+    track ids), reach trace_report without a parent-lookup crash, and
+    step_report recovers the records from them."""
+    _stepscope.configure(_stepscope.MODE_COUNTERS)
+    _stepscope.reset()
+    for i in range(3):
+        rec = _stepscope.step_begin("gpt", _stepscope.PHASE_DECODE, i,
+                                    batch_size=1, slots=2)
+        _stepscope.step_dispatched(rec)
+        _stepscope.step_end(rec)
+    events = _stepscope.perfetto_events(epoch_ns=0)
+    names = {e["name"] for e in events if e.get("ph") == "X"}
+    assert "gpt/decode[0]" in names
+    assert any(e.get("ph") == "M" for e in events)  # thread_name metadata
+    doc = {"displayTimeUnit": "ns", "traceEvents": events}
+    spans = _otel.load_spans(doc)
+    assert len([s for s in spans if s["name"].startswith("gpt/")]) == 3
+    assert all(s["trace_id"].startswith("track-") for s in spans)
+    trace_report = _load_script("trace_report.py", "trace_report_scope")
+    rendered = trace_report.report(spans, slowest=5, as_json=False)
+    assert "gpt/decode[0]" in rendered
+    step_report = _load_script("step_report.py", "step_report_perfetto")
+    recs = step_report.load_records(doc)
+    assert len(recs) == 3
+
+
+# --------------------------------------------------------------------------- #
+# step_report verdicts                                                        #
+# --------------------------------------------------------------------------- #
+
+
+def test_step_report_verdict_from_engine_dump():
+    """End to end: drive the engine at c4, dump, and the report renders a
+    dominant-stage verdict for the engine's scope."""
+    from tritonclient_tpu.models.gpt_engine import GenerationEngine
+
+    _stepscope.configure(_stepscope.MODE_COUNTERS)
+    _stepscope.reset()
+    cfg = gpt.gpt_tiny(max_len=32)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    engine = GenerationEngine(cfg, params, max_slots=4)
+    try:
+        _drain(engine, _PROMPTS_C4, 6)
+    finally:
+        engine.shutdown()
+    doc = _stepscope.dump()
+    step_report = _load_script("step_report.py", "step_report_e2e")
+    analysis = step_report.analyze(step_report.load_records(doc))
+    model = analysis["models"]["gpt_engine"]
+    assert model["verdict"] in (
+        step_report.VERDICT_DISPATCH, step_report.VERDICT_DEVICE,
+        step_report.VERDICT_COLLECTIVE,
+    )
+    rendered = step_report.render(analysis)
+    assert "verdict:" in rendered and "decode" in rendered
+
+
+def test_step_report_self_check_passes(capsys):
+    step_report = _load_script("step_report.py", "step_report_sc")
+    assert step_report.self_check() == 0
+    assert "every loader" in capsys.readouterr().out
+
+
+def test_step_report_cli_on_dump_file(tmp_path):
+    _stepscope.configure(_stepscope.MODE_COUNTERS)
+    _stepscope.reset()
+    rec = _stepscope.step_begin("gpt", _stepscope.PHASE_DECODE, 0)
+    _stepscope.step_dispatched(rec)
+    _stepscope.step_end(rec)
+    path = tmp_path / "scope.json"
+    path.write_text(json.dumps(_stepscope.dump()))
+    step_report = _load_script("step_report.py", "step_report_cli")
+    assert step_report.main([str(path)]) == 0
+    assert step_report.main([str(path), "--json"]) == 0
+    assert step_report.main([str(path), "--compare", str(path)]) == 0
+
+
+# --------------------------------------------------------------------------- #
+# steps_completed on cancel                                                   #
+# --------------------------------------------------------------------------- #
+
+
+def test_cancel_event_carries_steps_completed():
+    """The delivery thread mirrors the per-request token count onto the
+    cancel_event, so shed/cancel finalization can stamp where in the
+    decode loop the request died."""
+    from tritonclient_tpu.models.gpt_engine import GenerationEngine
+
+    cfg = gpt.gpt_tiny(max_len=64)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    engine = GenerationEngine(cfg, params, max_slots=2)
+    ev = threading.Event()
+    try:
+        q = engine.submit(_PROMPTS_C4[0], 40, cancel_event=ev).out
+        got = 0
+        while got < 5:
+            t = q.get(timeout=120)
+            assert t is not None
+            got += 1
+        ev.set()
+        while q.get(timeout=120) is not None:
+            got += 1
+    finally:
+        engine.shutdown()
+    steps = getattr(ev, "steps_completed", None)
+    assert steps is not None and steps >= 5
+    assert steps == got

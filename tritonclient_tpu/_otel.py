@@ -339,7 +339,11 @@ def spans_to_perfetto(spans: List[Span], epoch_ns: int, pid: int,
     return events
 
 
-def render_perfetto(records: List[TraceRecord], epoch_ns: int) -> str:
+def render_perfetto(records: List[TraceRecord], epoch_ns: int,
+                    extra_events: Optional[List[dict]] = None) -> str:
+    """``extra_events`` are pre-built Chrome trace events appended
+    verbatim — thread-scoped tracks (e.g. stepscope engine steps) that
+    have no request span to parent under."""
     pid = os.getpid()
     events = []
     for record in records:
@@ -353,12 +357,15 @@ def render_perfetto(records: List[TraceRecord], epoch_ns: int) -> str:
                 "request_id": record.request_id,
             },
         ))
+    if extra_events:
+        events.extend(extra_events)
     return json.dumps({"displayTimeUnit": "ns", "traceEvents": events})
 
 
 def render_merged_perfetto(client_spans: List[Span],
                            server_spans: List[dict],
-                           epoch_ns: int) -> str:
+                           epoch_ns: int,
+                           extra_events: Optional[List[dict]] = None) -> str:
     """One Perfetto file for a client+server window (perf_analyzer
     ``--trace-out``).
 
@@ -399,6 +406,8 @@ def render_merged_perfetto(client_spans: List[Span],
             "tid": tid_of(s.get("trace_id", "")),
             "args": args,
         })
+    if extra_events:
+        events.extend(extra_events)
     return json.dumps({"displayTimeUnit": "ns", "traceEvents": events})
 
 
@@ -485,9 +494,16 @@ def load_spans(doc) -> List[dict]:
             start = int(float(e.get("ts", 0)) * 1000)
             dur = int(float(e.get("dur", 0)) * 1000)
             args = dict(e.get("args", {}))
+            trace_id = args.get("trace_id", "")
+            if not trace_id:
+                # Thread-scoped track with no request parent (stepscope
+                # engine steps, foreign tool output): keep per-track
+                # identity so orphan events group by their track instead
+                # of every trackless event collapsing into one "" trace.
+                trace_id = f"track-{e.get('pid', 0)}-{e.get('tid', 0)}"
             spans.append({
                 "name": e.get("name", ""),
-                "trace_id": args.get("trace_id", ""),
+                "trace_id": trace_id,
                 "span_id": args.get("span_id", ""),
                 "parent_span_id": args.get("parent_span_id", ""),
                 "start_ns": start,

@@ -1,0 +1,448 @@
+"""stepscope: per-step engine profiling plane.
+
+The observability stack stops at ``compute``: a request span says how long
+the model ran, not where an engine *step* spent its time. This module is
+the missing layer — a low-overhead step clock the decode/prefill loops in
+``models/gpt_engine.py`` and the dynamic batcher's compute phase bracket
+around each device dispatch. Every step yields a record carrying:
+
+- step index, phase (``prefill`` / ``decode`` / ``compute``), batch size
+  and slot occupancy;
+- ``dispatch_us``: host time from step begin to dispatch return (trace +
+  XLA dispatch of the jitted call);
+- ``device_us``: device time. In ``sync`` mode this is a bracketed
+  ``jax.block_until_ready`` measurement (true device wait); in the default
+  counters mode it is the wall-clock remainder of the step — a lower
+  bound that never perturbs the host/device overlap being measured;
+- ``other_us``: the clamped remainder (host bookkeeping, delivery
+  hand-off);
+- collective count/bytes, accumulated by ``note_collective`` at the
+  ``parallel/`` call sites through a thread-local step context, or charged
+  as an expected per-step count for GSPMD-implicit all-reduces
+  (``expected_tp_collectives``).
+
+Records land in three existing sinks rather than a new one: ``/metrics``
+(``nv_engine_step_duration_us_quantiles`` + ``nv_engine_collectives_total``,
+via ``metrics_snapshot``), the flight recorder (``flight_attributes``
+stamps the slowest step's breakdown onto retained records), and the
+Perfetto exporters (``perfetto_events`` emits one thread-scoped track per
+engine thread — orphan tracks with no request parent, which the loaders
+accept). ``scripts/step_report.py`` turns a ``dump()`` into a
+dispatch-bound / device-bound / collective-bound verdict.
+
+Activation: ``TPU_STEPSCOPE=1`` (cheap counters), ``TPU_STEPSCOPE=sync``
+(adds ``block_until_ready`` bracketing). Off by default; the off path is
+one module-global read per step. All locks go through
+``sanitize.named_lock`` so the runtime sanitizer sees them.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from tritonclient_tpu import sanitize
+from tritonclient_tpu._sketch import LatencySketch
+
+# -- modes ------------------------------------------------------------------ #
+
+MODE_OFF = "off"
+MODE_COUNTERS = "counters"
+MODE_SYNC = "sync"
+MODES = (MODE_OFF, MODE_COUNTERS, MODE_SYNC)
+
+# -- canonical vocabularies (mirrored by check_metrics_exposition.py) ------- #
+
+STAGE_DISPATCH = "dispatch"
+STAGE_DEVICE = "device"
+STAGE_OTHER = "other"
+STEP_STAGES = (STAGE_DISPATCH, STAGE_DEVICE, STAGE_OTHER)
+
+PHASE_PREFILL = "prefill"
+PHASE_DECODE = "decode"
+PHASE_COMPUTE = "compute"
+STEP_PHASES = (PHASE_PREFILL, PHASE_DECODE, PHASE_COMPUTE)
+
+STEP_METRIC = "nv_engine_step_duration_us_quantiles"
+COLLECTIVES_METRIC = "nv_engine_collectives_total"
+
+# Bounded recent-step ring so dumps and Perfetto tracks stay small no
+# matter how long the engine runs.
+_DEFAULT_RING = 256
+
+
+def _env_mode() -> str:
+    raw = os.environ.get("TPU_STEPSCOPE", "").strip().lower()
+    if raw in ("", "0", "off", "false", "no"):
+        return MODE_OFF
+    if raw == MODE_SYNC:
+        return MODE_SYNC
+    return MODE_COUNTERS
+
+
+_mode = _env_mode()
+
+
+class StepRecord:
+    """One engine step. Mutated only by the stepping thread until
+    ``step_end`` hands it to the aggregator."""
+
+    __slots__ = (
+        "model", "phase", "step_index", "batch_size", "slots",
+        "t_begin", "t_dispatch", "t_end",
+        "dispatch_us", "device_us", "other_us", "total_us",
+        "collectives", "thread_ident", "thread_name",
+    )
+
+    def __init__(self, model: str, phase: str, step_index: int,
+                 batch_size: int, slots: int):
+        self.model = model
+        self.phase = phase
+        self.step_index = step_index
+        self.batch_size = batch_size
+        self.slots = slots
+        self.t_begin = time.monotonic_ns()
+        self.t_dispatch = 0
+        self.t_end = 0
+        self.dispatch_us = 0
+        self.device_us = 0
+        self.other_us = 0
+        self.total_us = 0
+        # op -> [count, bytes]
+        self.collectives: Dict[str, List[int]] = {}
+        thread = threading.current_thread()
+        self.thread_ident = thread.ident or 0
+        self.thread_name = thread.name
+
+    def collective_count(self) -> int:
+        return sum(c for c, _ in self.collectives.values())
+
+    def as_dict(self) -> dict:
+        return {
+            "model": self.model,
+            "phase": self.phase,
+            "step_index": self.step_index,
+            "batch_size": self.batch_size,
+            "slots": self.slots,
+            "start_ns": self.t_begin,
+            "dispatch_us": self.dispatch_us,
+            "device_us": self.device_us,
+            "other_us": self.other_us,
+            "total_us": self.total_us,
+            "collectives": {
+                op: {"count": c, "bytes": b}
+                for op, (c, b) in sorted(self.collectives.items())
+            },
+            "thread_ident": self.thread_ident,
+            "thread_name": self.thread_name,
+        }
+
+
+# Thread-local active step: ``note_collective`` at a parallel/ call site
+# (which runs at JAX trace time, inside the dispatch bracket of the step
+# that triggers compilation) charges the step that is live on this thread.
+_tls = threading.local()
+
+
+class _Aggregator:
+    """Process-wide sink for finished step records. One named lock; every
+    read (metrics scrape, dump, flight stamp) resolves under it."""
+
+    def __init__(self):
+        self._lock = sanitize.named_lock("stepscope._lock")
+        self.reset()
+
+    def reset(self):
+        with self._lock:
+            # (model, phase, stage) -> LatencySketch (microseconds)
+            self.sketches: Dict[Tuple[str, str, str], LatencySketch] = {}
+            # (model, phase) -> finished-step count
+            self.step_counts: Dict[Tuple[str, str], int] = {}
+            # (model, op) -> [count, bytes]
+            self.collectives: Dict[Tuple[str, str], List[int]] = {}
+            # model -> slowest finished step (as_dict)
+            self.slowest: Dict[str, dict] = {}
+            try:
+                ring = int(os.environ.get("TPU_STEPSCOPE_RING",
+                                          str(_DEFAULT_RING)))
+            except ValueError:
+                ring = _DEFAULT_RING
+            self.ring: deque = deque(maxlen=max(ring, 1))
+
+    def absorb(self, rec: StepRecord):
+        stages = ((STAGE_DISPATCH, rec.dispatch_us),
+                  (STAGE_DEVICE, rec.device_us),
+                  (STAGE_OTHER, rec.other_us))
+        with self._lock:
+            for stage, us in stages:
+                key = (rec.model, rec.phase, stage)
+                sketch = self.sketches.get(key)
+                if sketch is None:
+                    sketch = self.sketches[key] = LatencySketch()
+                sketch.insert(us)
+            ck = (rec.model, rec.phase)
+            self.step_counts[ck] = self.step_counts.get(ck, 0) + 1
+            for op, (count, nbytes) in rec.collectives.items():
+                cell = self.collectives.setdefault((rec.model, op), [0, 0])
+                cell[0] += count
+                cell[1] += nbytes
+            worst = self.slowest.get(rec.model)
+            if worst is None or rec.total_us > worst["total_us"]:
+                self.slowest[rec.model] = rec.as_dict()
+            self.ring.append(rec.as_dict())
+
+
+_aggregator = _Aggregator()
+
+
+# -- mode control ----------------------------------------------------------- #
+
+
+def mode() -> str:
+    return _mode
+
+
+def enabled() -> bool:
+    return _mode != MODE_OFF
+
+
+def configure(new_mode: Optional[str] = None) -> str:
+    """Set the mode explicitly (tests / benches), or re-read the
+    environment when called with None. Returns the active mode."""
+    global _mode
+    if new_mode is None:
+        _mode = _env_mode()
+    elif new_mode in MODES:
+        _mode = new_mode
+    else:
+        raise ValueError(f"unknown stepscope mode: {new_mode!r}")
+    return _mode
+
+
+def reset():
+    """Drop all aggregated state (tests / bench phase boundaries)."""
+    _aggregator.reset()
+    _tls.active = None
+
+
+# -- step clock ------------------------------------------------------------- #
+
+
+def step_begin(model: str, phase: str, step_index: int,
+               batch_size: int = 0, slots: int = 0) -> Optional[StepRecord]:
+    """Open a step. Returns None when stepscope is off — callers pass the
+    handle straight through, so the off path is one global read."""
+    if _mode == MODE_OFF:
+        return None
+    rec = StepRecord(model, phase, step_index, batch_size, slots)
+    _tls.active = rec
+    return rec
+
+
+def step_dispatched(rec: Optional[StepRecord]):
+    """Mark dispatch return: host trace+dispatch of the jitted call is
+    everything between ``step_begin`` and here."""
+    if rec is not None:
+        rec.t_dispatch = time.monotonic_ns()
+
+
+def step_end(rec: Optional[StepRecord], outputs=None):
+    """Close the step and hand it to the aggregator.
+
+    In ``sync`` mode, ``outputs`` (any pytree of device arrays) is waited
+    on with a timed ``jax.block_until_ready`` — the bracketed wait is the
+    device time. In counters mode outputs are ignored and device time is
+    the wall-clock remainder after dispatch (a lower bound: whatever the
+    host did not spend dispatching overlapped the device).
+    """
+    if rec is None:
+        return
+    _tls.active = None
+    if rec.t_dispatch == 0:
+        rec.t_dispatch = time.monotonic_ns()
+    device_ns = -1
+    if _mode == MODE_SYNC and outputs is not None:
+        t0 = time.monotonic_ns()
+        try:
+            import jax
+
+            jax.block_until_ready(outputs)
+            device_ns = time.monotonic_ns() - t0
+        except Exception:
+            device_ns = -1
+    rec.t_end = time.monotonic_ns()
+    total_ns = max(rec.t_end - rec.t_begin, 0)
+    dispatch_ns = min(max(rec.t_dispatch - rec.t_begin, 0), total_ns)
+    if device_ns >= 0:
+        device_ns = min(device_ns, total_ns - dispatch_ns)
+        other_ns = max(total_ns - dispatch_ns - device_ns, 0)
+    else:
+        # Counters mode: the post-dispatch remainder lower-bounds device
+        # time (any host work in it overlapped the device anyway).
+        device_ns = max(total_ns - dispatch_ns, 0)
+        other_ns = 0
+    rec.total_us = total_ns // 1000
+    rec.dispatch_us = dispatch_ns // 1000
+    rec.device_us = device_ns // 1000
+    rec.other_us = other_ns // 1000
+    _aggregator.absorb(rec)
+
+
+def note_collective(op: str, count: int = 1, nbytes: int = 0):
+    """Charge a collective to the step live on this thread (no-op when
+    stepscope is off or no step is open). Called from the ``parallel/``
+    call sites at JAX trace time."""
+    if _mode == MODE_OFF:
+        return
+    rec = getattr(_tls, "active", None)
+    if rec is None:
+        return
+    cell = rec.collectives.setdefault(op, [0, 0])
+    cell[0] += count
+    cell[1] += nbytes
+
+
+def charge_collectives(rec: Optional[StepRecord], ops: Dict[str, int],
+                       nbytes: int = 0):
+    """Charge an expected per-step collective count (GSPMD-implicit
+    all-reduces never hit a python call site — the engine charges the
+    count the sharding provably forces)."""
+    if rec is None:
+        return
+    for op, count in ops.items():
+        cell = rec.collectives.setdefault(op, [0, 0])
+        cell[0] += count
+        cell[1] += nbytes
+
+
+def expected_tp_collectives(n_layers: int, tp: int) -> Dict[str, int]:
+    """Per-decode-step collective count the gpt PARTITION_RULES force
+    under tensor parallelism: wo and w_out are row-sharded on 'tp', so
+    GSPMD inserts one all-reduce after the attention projection and one
+    after the FFN output — 2 psums per layer. tp=1 shards nothing."""
+    if tp <= 1:
+        return {}
+    return {"psum": 2 * n_layers}
+
+
+# -- sinks ------------------------------------------------------------------ #
+
+
+def metrics_snapshot(quantiles: Tuple[float, ...]):
+    """Resolve the step sketches for a /metrics scrape.
+
+    Returns ``(step_rows, collective_rows)`` where step_rows is a list of
+    ``(model, phase, stage, [q values], count, sum)`` — quantiles resolved
+    under the aggregator lock, mirroring InferenceCore's sketch_rows —
+    and collective_rows is ``(model, op, count)``.
+    """
+    agg = _aggregator
+    with agg._lock:
+        step_rows = [
+            (model, phase, stage,
+             sketch.quantiles(quantiles), sketch.count, sketch.sum)
+            for (model, phase, stage), sketch in sorted(agg.sketches.items())
+        ]
+        collective_rows = [
+            (model, op, cell[0])
+            for (model, op), cell in sorted(agg.collectives.items())
+        ]
+    return step_rows, collective_rows
+
+
+def flight_attributes(model: str) -> Dict[str, object]:
+    """Slowest-step breakdown for the given model, as span attributes the
+    flight recorder stamps onto retained records. Empty when stepscope is
+    off or no step finished yet."""
+    if _mode == MODE_OFF:
+        return {}
+    with _aggregator._lock:
+        worst = _aggregator.slowest.get(model)
+        if worst is None:
+            return {}
+        return {
+            "step.slowest.phase": worst["phase"],
+            "step.slowest.index": worst["step_index"],
+            "step.slowest.batch_size": worst["batch_size"],
+            "step.slowest.total_us": worst["total_us"],
+            "step.slowest.dispatch_us": worst["dispatch_us"],
+            "step.slowest.device_us": worst["device_us"],
+            "step.slowest.other_us": worst["other_us"],
+            "step.slowest.collectives": sum(
+                c["count"] for c in worst["collectives"].values()
+            ),
+        }
+
+
+def perfetto_events(epoch_ns: int) -> List[dict]:
+    """Chrome trace events for the recent-step ring: one thread-scoped
+    track per engine thread (ph='M' thread_name metadata + 'X' complete
+    events). The events carry no trace/span ids — they are orphan tracks
+    the loaders keep per-track, merging under the request spans in the
+    Perfetto UI by time."""
+    pid = os.getpid()
+    with _aggregator._lock:
+        records = list(_aggregator.ring)
+    events: List[dict] = []
+    named_tids = set()
+    for r in records:
+        tid = r["thread_ident"] or 1
+        if tid not in named_tids:
+            named_tids.add(tid)
+            events.append({
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": f"stepscope:{r['thread_name']}"},
+            })
+        events.append({
+            "name": f"{r['model']}/{r['phase']}[{r['step_index']}]",
+            "cat": "stepscope",
+            "ph": "X",
+            "ts": (r["start_ns"] + epoch_ns) / 1000.0,
+            "dur": r["total_us"],
+            "pid": pid,
+            "tid": tid,
+            "args": {
+                "model": r["model"],
+                "phase": r["phase"],
+                "step_index": str(r["step_index"]),
+                "batch_size": str(r["batch_size"]),
+                "dispatch_us": str(r["dispatch_us"]),
+                "device_us": str(r["device_us"]),
+                "other_us": str(r["other_us"]),
+                "collectives": str(sum(
+                    c["count"] for c in r["collectives"].values()
+                )),
+            },
+        })
+    return events
+
+
+def dump() -> dict:
+    """Self-describing document ``scripts/step_report.py`` loads: the
+    recent-step ring plus aggregate totals."""
+    agg = _aggregator
+    with agg._lock:
+        records = list(agg.ring)
+        step_counts = {
+            f"{model}|{phase}": count
+            for (model, phase), count in sorted(agg.step_counts.items())
+        }
+        collectives = {
+            f"{model}|{op}": {"count": cell[0], "bytes": cell[1]}
+            for (model, op), cell in sorted(agg.collectives.items())
+        }
+        slowest = dict(agg.slowest)
+    return {
+        "kind": "stepscope",
+        "mode": _mode,
+        "records": records,
+        "step_counts": step_counts,
+        "collectives": collectives,
+        "slowest": slowest,
+    }

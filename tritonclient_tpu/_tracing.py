@@ -47,7 +47,7 @@ from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from tritonclient_tpu import _otel
+from tritonclient_tpu import _otel, _stepscope
 from tritonclient_tpu._otel import (
     TraceRecord,
     build_span_tree,
@@ -585,6 +585,12 @@ class FlightRecorder:
     def _record(self, ctx, seq, duration, status) -> FlightRecord:
         ts = dict(ctx.timestamps)
         ts.update(ctx.marks)
+        attributes = dict(ctx.attributes)
+        # stepscope: retained records carry the slowest engine step's
+        # breakdown seen so far for this model — the step-level context a
+        # tail request's wall time alone cannot show. No-op (empty dict)
+        # when TPU_STEPSCOPE is off.
+        attributes.update(_stepscope.flight_attributes(ctx.model_name))
         return FlightRecord(
             seq=seq,
             model_name=ctx.model_name,
@@ -596,7 +602,7 @@ class FlightRecorder:
             status=status,
             error=ctx.error,
             timestamps=ts,
-            attributes=dict(ctx.attributes),
+            attributes=attributes,
             wall_time_s=time.time(),
         )
 
@@ -665,7 +671,13 @@ class FlightRecorder:
         return out
 
     def render_perfetto(self) -> str:
-        return _otel.render_perfetto(self.to_trace_records(), self._epoch_ns)
+        # stepscope rides along as one thread-scoped track per engine
+        # thread (orphan events: no trace/span ids) so the Perfetto view
+        # shows engine steps under the request spans by time.
+        extra = (_stepscope.perfetto_events(self._epoch_ns)
+                 if _stepscope.enabled() else None)
+        return _otel.render_perfetto(self.to_trace_records(),
+                                     self._epoch_ns, extra_events=extra)
 
     def clear(self):
         with self._lock:

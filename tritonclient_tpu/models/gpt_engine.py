@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from tritonclient_tpu import sanitize
+from tritonclient_tpu import _stepscope, sanitize
 from tritonclient_tpu.models._base import Model, TensorSpec
 from tritonclient_tpu.models.gpt import (
     GptConfig,
@@ -145,7 +145,8 @@ def _prefill_into_slot(params: Dict, k_cache, v_cache, padded_prompt,
 
 class _Request:
     __slots__ = ("prompt", "max_new", "out", "remaining", "temperature",
-                 "top_k", "seed", "cancelled", "cancel_event")
+                 "top_k", "seed", "cancelled", "cancel_event",
+                 "steps_completed")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0,
@@ -153,6 +154,11 @@ class _Request:
         self.prompt = prompt
         self.max_new = max_new
         self.remaining = max_new
+        # Tokens delivered so far (delivery-thread-owned, like remaining).
+        # Mirrored onto the cancel_event so shed/cancel finalization in the
+        # core can stamp WHERE in the decode loop the request died — a
+        # cancelled request's flight record otherwise shows only wall time.
+        self.steps_completed = 0
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.seed = int(seed)
@@ -311,6 +317,15 @@ class _Distributor:
                 continue  # surplus step of an already-finished request
             req.out.put(nxt_np[idx : idx + 1].copy())
             req.remaining -= 1
+            req.steps_completed += 1
+            if req.cancel_event is not None:
+                # Event objects double as the steps_completed side channel
+                # back to the core's cancel finalization (the engine never
+                # sees the request's TraceContext).
+                try:
+                    req.cancel_event.steps_completed = req.steps_completed
+                except AttributeError:
+                    pass
             if req.remaining == 0:
                 req.out.put(None)
                 self.free_q.put((slot, req))
@@ -322,7 +337,7 @@ class GenerationEngine:
     """The continuous-batching scheduler around the slot bank."""
 
     def __init__(self, cfg: GptConfig, params: Dict, max_slots: int = 8,
-                 mesh=None):
+                 mesh=None, scope_name: str = "gpt_engine"):
         """``mesh``: run the engine tensor-parallel — params laid out by
         the Megatron rules (models/gpt.PARTITION_RULES) and the slot-bank
         KV caches sharded on the heads axis over 'tp', so continuous
@@ -393,6 +408,16 @@ class GenerationEngine:
             self,
             max_inflight=int(os.environ.get("TPU_ENGINE_MAX_INFLIGHT", "3")),
         )
+        # stepscope identity: records carry the serving model's name, and
+        # tp engines charge the per-step all-reduce count the gpt
+        # PARTITION_RULES provably force (GSPMD inserts them implicitly —
+        # there is no python call site to count at).
+        self._scope_name = scope_name
+        tp = int(dict(mesh.shape).get("tp", 1)) if mesh is not None else 1
+        self._expected_collectives = _stepscope.expected_tp_collectives(
+            cfg.n_layers, tp
+        )
+        self._prefill_seq = 0
         self._step = jax.jit(
             functools.partial(_decode_step_slots, cfg=cfg),
             donate_argnums=(1, 2),
@@ -538,15 +563,23 @@ class GenerationEngine:
             # No dispatch ticket for prefills: admissions are bounded by
             # the slot count, and blocking a NEW request's prefill on a
             # step-readback ticket is the TTFT-under-load term.
+            scope = _stepscope.step_begin(
+                self._scope_name, _stepscope.PHASE_PREFILL,
+                self._prefill_seq, batch_size=1, slots=self.max_slots,
+            )
+            self._prefill_seq += 1
             first, self._k, self._v = self._prefill(
                 self.params, self._k, self._v, jnp.asarray(padded),
                 jnp.int32(l), jnp.int32(slot), jnp.int32(req.seed),
                 jnp.float32(req.temperature), jnp.int32(req.top_k),
             )
+            _stepscope.step_dispatched(scope)
+            _stepscope.charge_collectives(scope, self._expected_collectives)
             try:
                 first.copy_to_host_async()
             except AttributeError:
                 pass
+            _stepscope.step_end(scope, outputs=first)
             self._slot_req[slot] = req
             admitted.append((slot, req, first, l))
         if not admitted:
@@ -682,6 +715,7 @@ class GenerationEngine:
         # on a host copy — an arriving request's prefill dispatches at
         # the very next loop top regardless of in-flight readbacks, which
         # is what bounds TTFT under load (VERDICT r4 #4).
+        step_seq = 0  # host-side decode-step index (stepscope records)
         while True:
             # Lock-free polls of monotonic signal flags: the loop re-checks
             # every iteration, so the worst race is one extra step.
@@ -735,10 +769,17 @@ class GenerationEngine:
             if not active:
                 self._dist.release_ticket()
                 continue
+            scope = _stepscope.step_begin(
+                self._scope_name, _stepscope.PHASE_DECODE, step_seq,
+                batch_size=len(active), slots=self.max_slots,
+            )
+            step_seq += 1
             nxt, self._k, self._v = self._step(
                 self.params, self._k, self._v, self._tokens, self._pos,
                 self._seeds, self._steps, self._temps, self._topks,
             )
+            _stepscope.step_dispatched(scope)
+            _stepscope.charge_collectives(scope, self._expected_collectives)
             try:
                 nxt.copy_to_host_async()
             except AttributeError:
@@ -750,6 +791,10 @@ class GenerationEngine:
                 nxt, [(s, s, self._slot_req[s]) for s in active
                       if self._slot_req[s] is not None]
             )
+            # sync mode blocks on the step output here (true device time,
+            # at the cost of the host/device overlap); counters mode only
+            # stamps the clock.
+            _stepscope.step_end(scope, outputs=nxt)
 
 
 class GptEngineModel(Model):
@@ -796,7 +841,8 @@ class GptEngineModel(Model):
         # mesh: tensor-parallel engine (KV slot bank sharded; pre-sharded
         # params pass through shard_tree as a no-op).
         self.engine = GenerationEngine(self.cfg, params,
-                                       max_slots=max_slots, mesh=mesh)
+                                       max_slots=max_slots, mesh=mesh,
+                                       scope_name=self.name)
 
     def infer(self, inputs, parameters=None) -> Iterator[dict]:
         prompt = np.asarray(inputs["INPUT_IDS"], dtype=np.int32)

@@ -20,6 +20,19 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tritonclient_tpu import _stepscope
+
+
+def _noted_ppermute(x, axis_name, perm):
+    """lax.ppermute + a stepscope collective note. The note fires at JAX
+    trace time (once per compiled call site, on the thread whose step
+    triggered compilation) — cheap attribution, not an execution count."""
+    _stepscope.note_collective(
+        "ppermute", nbytes=int(x.size) * x.dtype.itemsize
+    )
+    return lax.ppermute(x, axis_name, perm)
+
+
 _NEG_BIG = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
@@ -86,8 +99,8 @@ def _ring_body_flash(q, k, v, *, axis_name: str, axis_size: int,
         o_acc = (o_acc * w_acc[..., None]
                  + o_j.astype(jnp.float32) * w_j[..., None]) / denom[..., None]
         lse_acc = m + jnp.log(denom)
-        k_next = lax.ppermute(k_cur, axis_name, perm)
-        v_next = lax.ppermute(v_cur, axis_name, perm)
+        k_next = _noted_ppermute(k_cur, axis_name, perm)
+        v_next = _noted_ppermute(v_cur, axis_name, perm)
         return (o_acc, lse_acc, k_next, v_next), None
 
     o0 = jnp.zeros((b, lc, h, d), jnp.float32)
@@ -126,8 +139,8 @@ def _ring_body(q, k, v, *, axis_name: str, axis_size: int, causal: bool,
         o_new = o * corr[..., None] + jnp.einsum(
             "bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32)
         )
-        k_next = lax.ppermute(k_cur, axis_name, perm)
-        v_next = lax.ppermute(v_cur, axis_name, perm)
+        k_next = _noted_ppermute(k_cur, axis_name, perm)
+        v_next = _noted_ppermute(v_cur, axis_name, perm)
         return (o_new, m_new, l_new, k_next, v_next), None
 
     o0 = jnp.zeros((b, h, lq, d), jnp.float32)

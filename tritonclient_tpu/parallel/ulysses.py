@@ -21,6 +21,7 @@ import jax
 from jax import lax
 from jax.sharding import Mesh
 
+from tritonclient_tpu import _stepscope
 from tritonclient_tpu.ops.attention import dot_product_attention
 from tritonclient_tpu.parallel.ring_attention import sequence_shard_map
 
@@ -69,6 +70,11 @@ def ulysses_attention(
     def body(q_loc, k_loc, v_loc):
         # [B, L/sp, H, D] -> [B, L, H/sp, D]: scatter heads, gather sequence.
         def to_heads(x):
+            # stepscope collective note: fires at trace time, charging
+            # the step that triggered compilation.
+            _stepscope.note_collective(
+                "all_to_all", nbytes=int(x.size) * x.dtype.itemsize
+            )
             return lax.all_to_all(
                 x, sp_axis, split_axis=2, concat_axis=1, tiled=True
             )
@@ -76,6 +82,9 @@ def ulysses_attention(
         qh, kh, vh = to_heads(q_loc), to_heads(k_loc), to_heads(v_loc)
         out = attn(qh, kh, vh)
         # [B, L, H/sp, D] -> [B, L/sp, H, D]: gather heads, scatter sequence.
+        _stepscope.note_collective(
+            "all_to_all", nbytes=int(out.size) * out.dtype.itemsize
+        )
         return lax.all_to_all(
             out, sp_axis, split_axis=1, concat_axis=2, tiled=True
         )

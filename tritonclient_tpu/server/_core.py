@@ -21,7 +21,7 @@ from typing import Dict, Iterator, List, Optional, Union
 
 import numpy as np
 
-from tritonclient_tpu import sanitize
+from tritonclient_tpu import _stepscope, sanitize
 from tritonclient_tpu._sketch import LatencySketch
 from tritonclient_tpu._tracing import (
     FlightRecorder,
@@ -926,6 +926,12 @@ class _DynamicBatcher:
             trace = request.trace
             if trace is not None:
                 trace.set_attribute("shed.reason", reason)
+                # Where in the decode loop the request died: engines
+                # mirror tokens-delivered onto the cancel event (see
+                # gpt_engine._Distributor). Batcher-queued requests never
+                # started a decode loop, so the attribute defaults to 0.
+                trace.set_attribute("steps_completed", int(getattr(
+                    request.cancel_event, "steps_completed", 0) or 0))
             waited_us = max((now_ns - slot.t_enqueue) // 1000, 0)
             if reason == SHED_REASON_CANCELLED:
                 slot.error = CoreError(
@@ -1604,6 +1610,41 @@ class InferenceCore:
                         )
                 lines.append(f"{metric}_sum{{{labels}}} {total:.3f}")
                 lines.append(f"{metric}_count{{{labels}}} {count}")
+        # stepscope families: per-step stage breakdown + collective
+        # counters for the engines (TPU_STEPSCOPE). Quantiles resolve
+        # under the stepscope aggregator's own lock, mirroring
+        # sketch_rows above; headers always render so scrapers see a
+        # stable family set, rows appear once steps have been recorded.
+        step_rows, collective_rows = _stepscope.metrics_snapshot(
+            _METRIC_QUANTILES
+        )
+        metric = _stepscope.STEP_METRIC
+        lines.append(
+            f"# HELP {metric} Engine step duration quantiles in "
+            "microseconds by phase and stage (DDSketch, stepscope)"
+        )
+        lines.append(f"# TYPE {metric} summary")
+        for sname, phase, stage, values, count, total in step_rows:
+            labels = (f'model="{esc(sname)}",phase="{phase}"'
+                      f',stage="{stage}"')
+            if count:
+                for q, value in zip(_METRIC_QUANTILES, values):
+                    lines.append(
+                        f'{metric}{{{labels},quantile="{q}"}} {value:.3f}'
+                    )
+            lines.append(f"{metric}_sum{{{labels}}} {total:.3f}")
+            lines.append(f"{metric}_count{{{labels}}} {count}")
+        metric = _stepscope.COLLECTIVES_METRIC
+        lines.append(
+            f"# HELP {metric} Number of collective operations issued by "
+            "engine steps, by op (stepscope; GSPMD-implicit all-reduces "
+            "are charged at their expected per-step count)"
+        )
+        lines.append(f"# TYPE {metric} counter")
+        for sname, op, ccount in collective_rows:
+            lines.append(
+                f'{metric}{{model="{esc(sname)}",op="{esc(op)}"}} {ccount}'
+            )
         # Queue-depth gauge: requests admitted but not yet answered.
         metric = "nv_inference_pending_request_count"
         lines.append(
@@ -2086,7 +2127,16 @@ class InferenceCore:
                         ]
                     cat[name] = jnp.concatenate(parts, axis=0)
             t_input = time.monotonic_ns()
+            # stepscope: the batcher's compute phase is one "step" — the
+            # whole-batch dispatch. batch_size is the concatenated row
+            # count (padding included: that is what the device runs).
+            scope = _stepscope.step_begin(
+                model.name, _stepscope.PHASE_COMPUTE,
+                stats.execution_count,  # tpulint: disable=TPU002 - informational index; worst race is a reused index
+                batch_size=bucket, slots=len(live),
+            )
             result = model.infer(cat, {})
+            _stepscope.step_dispatched(scope)
             if not isinstance(result, dict):
                 result = dict(result)
             for name, array in result.items():
@@ -2159,6 +2209,7 @@ class InferenceCore:
                     results[idx] = e
                     self._record_failure(stats, t_start)
             t_end = time.monotonic_ns()
+            _stepscope.step_end(scope, outputs=result)
             for idx in live:
                 trace = requests[idx].trace
                 if trace is not None:
@@ -2218,6 +2269,18 @@ class InferenceCore:
                 # cancellation, responses generated so far — instead of
                 # silently omitting the request (ADVICE r4). Triton's
                 # inference_stats carries the same "cancel" bucket.
+                trace = request.trace
+                if trace is not None:
+                    # Cancel finalization stamps WHERE the generation died:
+                    # engines mirror delivered-step counts onto the cancel
+                    # event; the yielded-response count is the fallback.
+                    trace.set_attribute("shed.reason", SHED_REASON_CANCELLED)
+                    steps = getattr(
+                        request.cancel_event, "steps_completed", None)
+                    trace.set_attribute(
+                        "steps_completed",
+                        count if steps is None else int(steps),
+                    )
                 with self._lock:
                     stats.inference_count += 1
                     stats.execution_count += count
