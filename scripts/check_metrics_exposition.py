@@ -35,6 +35,13 @@ Checks (exit 1 with one line per violation):
     ``stage``/``phase`` drawn from the canonical stepscope vocabularies
     (and the shared summary checks — quantile monotonicity, _sum/_count);
     ``nv_engine_collectives_total`` carries exactly {model, op}
+  * the paged-KV families: ``nv_engine_kv_blocks_used`` /
+    ``nv_engine_kv_blocks_total`` carry exactly {model}, are
+    non-negative, and used <= total per model;
+    ``nv_engine_prefix_cache_events_total`` carries exactly
+    {model, event} with ``event`` drawn from the canonical prefix-cache
+    vocabulary and every event row present per model (so hit rates are
+    computable from any single scrape)
 """
 
 import os
@@ -63,7 +70,12 @@ try:
     from tritonclient_tpu._stepscope import STEP_PHASES, STEP_STAGES
 except ImportError:  # standalone copy of the script: keep it usable
     STEP_STAGES = ("dispatch", "device", "other")
-    STEP_PHASES = ("prefill", "decode", "compute")
+    STEP_PHASES = ("prefill", "prefill_chunk", "decode", "compute")
+
+try:
+    from tritonclient_tpu.protocol._literals import PREFIX_EVENTS
+except ImportError:  # standalone copy of the script: keep it usable
+    PREFIX_EVENTS = ("hit", "miss", "evict")
 
 _SHED_FAMILY = "nv_inference_shed_total"
 # Fleet-router families (served by the router's own /metrics): same
@@ -84,6 +96,10 @@ _BREAKER_FAMILY = "nv_client_breaker_state"
 # canonical stage/phase vocabularies so dashboards can group blindly.
 _STEP_FAMILY = "nv_engine_step_duration_us_quantiles"
 _COLLECTIVES_FAMILY = "nv_engine_collectives_total"
+# Paged-KV families (block pool occupancy + prefix-cache events).
+_KV_USED_FAMILY = "nv_engine_kv_blocks_used"
+_KV_TOTAL_FAMILY = "nv_engine_kv_blocks_total"
+_PREFIX_FAMILY = "nv_engine_prefix_cache_events_total"
 
 _VALID_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
 _METRIC_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
@@ -289,6 +305,36 @@ def check_exposition(text: str) -> List[str]:
                             f"line {lineno}: {family} label set "
                             f"{sorted(labels)} != ['replica']"
                         )
+            if family == _PREFIX_FAMILY:
+                # Prefix-cache event contract: fixed {model, event} label
+                # set, canonical events only, every event row present per
+                # model (hit rate = hit / (hit + miss) must be computable
+                # from one scrape without guessing at absent-as-zero).
+                model_events: Dict[str, set] = {}
+                for labels, value, name, lineno in samples.get(family, []):
+                    if set(labels) != {"model", "event"}:
+                        errors.append(
+                            f"line {lineno}: {family} label set "
+                            f"{sorted(labels)} != ['event', 'model']"
+                        )
+                        continue
+                    if labels["event"] not in PREFIX_EVENTS:
+                        errors.append(
+                            f"line {lineno}: {family} event "
+                            f"{labels['event']!r} not in "
+                            f"{list(PREFIX_EVENTS)}"
+                        )
+                        continue
+                    model_events.setdefault(
+                        labels["model"], set()
+                    ).add(labels["event"])
+                for model, events in model_events.items():
+                    missing = [e for e in PREFIX_EVENTS if e not in events]
+                    if missing:
+                        errors.append(
+                            f'{family}{{model="{model}"}}: missing event '
+                            f"rows {missing}"
+                        )
             if family == _COLLECTIVES_FAMILY:
                 # Stepscope collectives: fixed {model, op} label set (the
                 # op value is open vocabulary — psum/ppermute/all_to_all
@@ -346,6 +392,19 @@ def check_exposition(text: str) -> List[str]:
                         errors.append(
                             f"line {lineno}: {family} value {value} < 0 "
                             "(outstanding/depth cannot be negative)"
+                        )
+            if family in (_KV_USED_FAMILY, _KV_TOTAL_FAMILY):
+                # Pool-occupancy gauges: exactly {model}, non-negative.
+                for labels, value, name, lineno in samples.get(family, []):
+                    if set(labels) != {"model"}:
+                        errors.append(
+                            f"line {lineno}: {family} label set "
+                            f"{sorted(labels)} != ['model']"
+                        )
+                    if value < 0:
+                        errors.append(
+                            f"line {lineno}: {family} value {value} < 0 "
+                            "(block counts cannot be negative)"
                         )
             continue
         if ftype == "summary":
@@ -502,6 +561,20 @@ def check_exposition(text: str) -> List[str]:
                     f"{family}{label_desc}: _count {entry['count']} != "
                     f"+Inf bucket {buckets[-1][1]}"
                 )
+    # Cross-family paged-KV invariant: a model can never reference more
+    # blocks than its pool holds (used > total means broken accounting,
+    # e.g. a leaked refcount, not heavy load).
+    totals = {
+        labels.get("model"): value
+        for labels, value, _name, _lineno in samples.get(_KV_TOTAL_FAMILY, [])
+    }
+    for labels, value, name, lineno in samples.get(_KV_USED_FAMILY, []):
+        model = labels.get("model")
+        if model in totals and value > totals[model]:
+            errors.append(
+                f"line {lineno}: {_KV_USED_FAMILY}{{model=\"{model}\"}} "
+                f"{value} > {_KV_TOTAL_FAMILY} {totals[model]}"
+            )
     return errors
 
 

@@ -328,9 +328,14 @@ def _synthetic_dump(dispatch_us: int, device_us: int, other_us: int,
     for i in range(n):
         jitter = (i * 7) % 5  # 0..4 us, fixed pattern
         d, dev, o = dispatch_us + jitter, device_us + jitter, other_us
+        # Phase pattern mirrors the paged engine's real mix: mostly
+        # decode, with chunked-prefill records interleaved (plus one
+        # legacy whole-prompt prefill so both spellings stay covered).
         records.append({
             "model": model,
-            "phase": "decode" if i % 4 else "prefill",
+            "phase": ("prefill" if i == 0
+                      else "prefill_chunk" if i % 4 == 0
+                      else "decode"),
             "step_index": i,
             "batch_size": 4,
             "start_ns": 1_000_000 + i * 1_000_000,
@@ -370,7 +375,8 @@ def self_check() -> int:
             failures += 1
             continue
         rendered = render(analysis)
-        if want not in rendered or "decode" not in rendered:
+        if (want not in rendered or "decode" not in rendered
+                or "prefill_chunk" not in rendered):
             print(f"self-check [{label}]: render missing verdict/phase",
                   file=sys.stderr)
             failures += 1

@@ -404,6 +404,25 @@ def test_grpc_stream_cancel_frees_engine_slot_within_one_step():
         assert all(r is None for r in model.engine._slot_req), (
             model.engine._slot_req
         )
+        # Paged KV: the cancelled request's blocks must be back in the
+        # pool the moment its slot freed (block-granular reclamation) —
+        # only the scratch page stays referenced...
+        engine = model.engine
+        # (evictable prefix-cache pages are refcount-0, so used counts
+        # exactly the scratch page once the cancel reclaimed the rest)
+        assert engine._pool.used_count == 1
+        # ...and they are immediately REUSABLE: a fresh full-length
+        # request needs the same reservation the cancelled one held, so
+        # admission succeeding proves the pages actually came back.
+        req = engine.submit(np.zeros((1, 8), np.int32), 4)
+        got = []
+        while True:
+            t = req.out.get(timeout=120)
+            if t is None:
+                break
+            assert not isinstance(t, BaseException), t
+            got.append(t)
+        assert len(got) == 4
 
 
 def test_http_async_infer_cancel_sheds_queued_request():

@@ -140,7 +140,7 @@ def test_engine_c4_records_partition_compute_span():
     assert doc["kind"] == "stepscope"
     records = doc["records"]
     phases = {r["phase"] for r in records}
-    assert _stepscope.PHASE_PREFILL in phases
+    assert _stepscope.PHASE_PREFILL_CHUNK in phases
     assert _stepscope.PHASE_DECODE in phases
     for r in records:
         assert r["dispatch_us"] >= 0

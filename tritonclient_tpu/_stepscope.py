@@ -62,9 +62,14 @@ STAGE_OTHER = "other"
 STEP_STAGES = (STAGE_DISPATCH, STAGE_DEVICE, STAGE_OTHER)
 
 PHASE_PREFILL = "prefill"
+#: One fixed-size chunk of a paged-KV chunked prefill: prompts stream
+#: into blocks interleaved with decode steps, so a long prompt is many
+#: prefill_chunk records instead of one monolithic prefill record.
+PHASE_PREFILL_CHUNK = "prefill_chunk"
 PHASE_DECODE = "decode"
 PHASE_COMPUTE = "compute"
-STEP_PHASES = (PHASE_PREFILL, PHASE_DECODE, PHASE_COMPUTE)
+STEP_PHASES = (PHASE_PREFILL, PHASE_PREFILL_CHUNK, PHASE_DECODE,
+               PHASE_COMPUTE)
 
 STEP_METRIC = "nv_engine_step_duration_us_quantiles"
 COLLECTIVES_METRIC = "nv_engine_collectives_total"

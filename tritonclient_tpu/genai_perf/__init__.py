@@ -25,6 +25,38 @@ import numpy as np
 from tritonclient_tpu.perf_analyzer._stats import percentile
 
 
+def parse_prompt_len_dist(spec: str, input_tokens: int):
+    """Parse ``--prompt-len-dist`` into an expanded weighted cycle.
+
+    ``"short:8,long:1"`` -> 8 short entries + 1 long entry (the cycle a
+    worker walks with its own offset, so the realized mix matches the
+    weights without coordination — same trick as ``--tenant-mix``).
+    Bucket names are either the presets ``short`` (= ``input_tokens``) /
+    ``long`` (= 4x ``input_tokens``) or literal token counts ("32:8").
+    Returns [(label, length)] with one entry per unit of weight.
+    """
+    presets = {"short": input_tokens, "long": 4 * input_tokens}
+    cycle = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, weight = part.partition(":")
+        name = name.strip()
+        if name in presets:
+            label, length = name, presets[name]
+        else:
+            length = int(name)
+            label = str(length)
+        w = int(weight) if weight else 1
+        if length < 1 or w < 1:
+            raise ValueError(f"bad prompt-len-dist entry {part!r}")
+        cycle.extend([(label, length)] * w)
+    if not cycle:
+        raise ValueError(f"empty prompt-len-dist {spec!r}")
+    return cycle
+
+
 def _pctls(values_ns: List[int]) -> Dict[str, float]:
     if not values_ns:
         return {"avg_ms": 0.0, "p50_ms": 0.0, "p90_ms": 0.0, "p99_ms": 0.0}
@@ -53,12 +85,25 @@ class _Worker:
         # (set to the window start at the warmup boundary).
         self._window_start_ns = 0
         self._stop = threading.Event()
+        # TTFT per prompt-length bucket (mixed-length runs: the pooled
+        # quantiles hide that long prompts pay prefill for everyone).
+        self.ttft_by_bucket: Dict[str, List[int]] = {}
         rng = np.random.default_rng(4321 + wid)
-        self.prompts = [
-            rng.integers(0, analyzer.vocab_size,
-                         (1, analyzer.input_tokens)).astype(np.int32)
-            for _ in range(8)
-        ]
+        # One pool of 8 prompts per distinct bucket; a shared prefix (the
+        # prefix-cache workload) replaces the prompt head IDENTICALLY
+        # across workers, the tail stays per-worker random.
+        self.prompts: Dict[str, List[np.ndarray]] = {}
+        for label, length in dict(analyzer.len_cycle).items():
+            pool = []
+            for _ in range(8):
+                p = rng.integers(0, analyzer.vocab_size,
+                                 (1, length)).astype(np.int32)
+                pre = analyzer.shared_prefix
+                if pre is not None:
+                    n = min(pre.shape[1], length - 1)
+                    p[0, :n] = pre[0, :n]
+                pool.append(p)
+            self.prompts[label] = pool
 
     def setup(self):
         from tritonclient_tpu.grpc import InferenceServerClient, InferInput
@@ -82,9 +127,16 @@ class _Worker:
 
     def run(self, end_time: float):
         a = self.a
+        cycle = a.len_cycle
         i = 0
         while time.perf_counter() < end_time and not self._stop.is_set():
-            prompt = self.prompts[i % len(self.prompts)]
+            # Worker-offset walk of the weighted cycle: the realized mix
+            # converges on the weights without cross-worker coordination
+            # (and without every worker sending the same bucket in
+            # lock-step).
+            label, _length = cycle[(self.wid + i) % len(cycle)]
+            pool = self.prompts[label]
+            prompt = pool[i % len(pool)]
             i += 1
             inp = self._InferInput(
                 "INPUT_IDS", list(prompt.shape), "INT32"
@@ -127,6 +179,9 @@ class _Worker:
                     if t_send >= self._window_start_ns:
                         if t_prev is None:
                             self.ttft_ns.append(t_recv - t_send)
+                            self.ttft_by_bucket.setdefault(
+                                label, []
+                            ).append(t_recv - t_send)
                         else:
                             self.itl_ns.append(t_recv - t_prev)
                     t_prev = t_recv
@@ -165,6 +220,8 @@ class GenAIPerf:
         measurement_interval_s: float = 10.0,
         warmup_s: float = 2.0,
         verbose: bool = False,
+        prompt_len_dist: Optional[str] = None,
+        shared_prefix_tokens: int = 0,
     ):
         self.url = url
         self.model_name = model_name
@@ -174,6 +231,26 @@ class GenAIPerf:
         self.measurement_interval_s = measurement_interval_s
         self.warmup_s = warmup_s
         self.verbose = verbose
+        # Mixed prompt lengths ("short:8,long:1") — weighted cycle walked
+        # with a per-worker offset; summaries gain per-bucket TTFT rows.
+        self.prompt_len_dist = prompt_len_dist
+        if prompt_len_dist:
+            self.len_cycle = parse_prompt_len_dist(
+                prompt_len_dist, input_tokens
+            )
+        else:
+            self.len_cycle = [("default", input_tokens)]
+        # Shared-prefix workload (prefix caching): the first N prompt
+        # tokens are IDENTICAL across all workers and requests —
+        # deterministic, not derived from any worker's pool.
+        self.shared_prefix_tokens = int(shared_prefix_tokens)
+        if self.shared_prefix_tokens > 0:
+            rng = np.random.default_rng(1234)
+            self.shared_prefix = rng.integers(
+                0, vocab_size, (1, self.shared_prefix_tokens)
+            ).astype(np.int32)
+        else:
+            self.shared_prefix = None
 
     def measure(self, concurrency: int) -> Dict:
         workers = [_Worker(self, w) for w in range(concurrency)]
@@ -203,6 +280,7 @@ class GenAIPerf:
                 w.ttft_ns.clear()
                 w.itl_ns.clear()
                 w.latency_ns.clear()
+                w.ttft_by_bucket.clear()
                 w.tokens = 0
                 w.requests = 0
             window_start = time.perf_counter()
@@ -218,7 +296,7 @@ class GenAIPerf:
         tokens = sum(w.tokens for w in workers)
         requests = sum(w.requests for w in workers)
         errors = sum(w.errors for w in workers)
-        return {
+        summary = {
             "concurrency": concurrency,
             "requests": requests,
             "errors": errors,
@@ -230,6 +308,18 @@ class GenAIPerf:
             "inter_token_latency": _pctls(itl),
             "request_latency": _pctls(lat),
         }
+        if self.prompt_len_dist or self.shared_prefix is not None:
+            lengths = dict(self.len_cycle)
+            by_bucket = {}
+            for label, length in lengths.items():
+                vals = [v for w in workers
+                        for v in w.ttft_by_bucket.get(label, [])]
+                row = _pctls(vals)
+                row["n"] = len(vals)
+                row["prompt_tokens"] = length
+                by_bucket[label] = row
+            summary["ttft_by_prompt_len"] = by_bucket
+        return summary
 
     def sweep(self, start: int, end: int, step: int = 1) -> List[Dict]:
         results = []

@@ -20,6 +20,15 @@ def main(argv=None):
                         help="start:end[:step] closed-loop stream workers")
     parser.add_argument("--input-tokens", type=int, default=32)
     parser.add_argument("--output-tokens", type=int, default=16)
+    parser.add_argument(
+        "--prompt-len-dist", default=None,
+        help="weighted prompt-length mix, e.g. 'short:8,long:1' "
+             "(short=input-tokens, long=4x) or literal lengths '32:8,128:1'; "
+             "adds per-bucket TTFT rows to each window summary")
+    parser.add_argument(
+        "--shared-prefix-tokens", type=int, default=0,
+        help="make the first N prompt tokens identical across all "
+             "requests (prefix-cache workload)")
     parser.add_argument("--vocab-size", type=int, default=32000)
     parser.add_argument("--measurement-interval", type=float, default=8000.0,
                         help="per-level window, milliseconds")
@@ -44,6 +53,8 @@ def main(argv=None):
         measurement_interval_s=args.measurement_interval / 1000.0,
         warmup_s=args.warmup_interval / 1000.0,
         verbose=args.verbose,
+        prompt_len_dist=args.prompt_len_dist,
+        shared_prefix_tokens=args.shared_prefix_tokens,
     )
     results = analyzer.sweep(start, end, step)
     if args.json:
@@ -64,6 +75,12 @@ def main(argv=None):
             f"{r['inter_token_latency']['p99_ms']:>7.1f}m "
             f"{r['errors']:>4}"
         )
+        for label, row in sorted(r.get("ttft_by_prompt_len", {}).items()):
+            print(
+                f"       ttft[{label}] ({row['prompt_tokens']} tok, "
+                f"n={row['n']}): p50 {row['p50_ms']:.1f}m "
+                f"p99 {row['p99_ms']:.1f}m"
+            )
     return 0
 
 

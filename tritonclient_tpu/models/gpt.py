@@ -197,7 +197,8 @@ def prefill(params: Dict, tokens: jax.Array, cfg: GptConfig,
     return logits, (k_cache, v_cache)
 
 
-def _decode_layer(h, lp, kc, vc, cfg: GptConfig, write_kv, mask):
+def _decode_layer(h, lp, kc, vc, cfg: GptConfig, write_kv, mask,
+                  read_kv=None):
     """Single-token decoder layer, shared by the per-request decode path
     (`decode_step`) and the continuous-batching slot bank
     (models/gpt_engine.py) — one source of truth for the LN/QKV/masked-
@@ -206,8 +207,12 @@ def _decode_layer(h, lp, kc, vc, cfg: GptConfig, write_kv, mask):
 
     h [N, d]; kc/vc [N, L, H, Dh]; ``write_kv(kc, vc, k, v)`` inserts the
     [N, H, Dh] projections; ``mask`` broadcasts against [N, H, L] scores.
-    Decode is bandwidth-bound on the cache read — the MXU-free regime
-    where a flash kernel buys nothing — so a masked einsum is the kernel.
+    ``read_kv(kc, vc)`` (optional) maps the written cache to the [N, L, H,
+    Dh] attention operands — the paged engine passes the block-table
+    gather here ([n_blocks, bs, H, Dh] pool -> per-row views) while the
+    contiguous paths read the cache directly. Decode is bandwidth-bound
+    on the cache read — the MXU-free regime where a flash kernel buys
+    nothing — so a masked einsum is the kernel.
     """
     n = h.shape[0]
     a = _layer_norm(h, lp["ln1_scale"], lp["ln1_bias"], cfg.layer_norm_eps)
@@ -216,14 +221,15 @@ def _decode_layer(h, lp, kc, vc, cfg: GptConfig, write_kv, mask):
     hd = (n, cfg.n_heads, cfg.head_dim)
     q = q.reshape(hd)
     kc, vc = write_kv(kc, vc, k.reshape(hd), v.reshape(hd))
+    ka, va = (kc, vc) if read_kv is None else read_kv(kc, vc)
     s = jnp.einsum(
         "nhd,nlhd->nhl",
         q.astype(jnp.float32) / np.sqrt(cfg.head_dim),
-        kc.astype(jnp.float32),
+        ka.astype(jnp.float32),
     )
     s = jnp.where(mask, s, jnp.finfo(jnp.float32).min)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("nhl,nlhd->nhd", p, vc.astype(jnp.float32))
+    out = jnp.einsum("nhl,nlhd->nhd", p, va.astype(jnp.float32))
     out = out.reshape(n, cfg.d_model).astype(h.dtype)
     h = h + (out @ lp["wo"] + lp["bo"])
     m = _layer_norm(h, lp["ln2_scale"], lp["ln2_bias"], cfg.layer_norm_eps)

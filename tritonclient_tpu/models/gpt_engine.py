@@ -8,17 +8,40 @@ request advances one token per step, requests join at token boundaries
 leave when finished, and a freed slot is immediately refilled from the
 admission queue.
 
+KV memory is PAGED (vLLM block tables / Ragged Paged Attention geometry):
+a fixed pool of ``[n_layers, n_blocks, block_size, H, Dh]`` pages plus a
+per-slot block table ``[S, max_len // block_size]``. A request reserves
+``ceil((prompt + max_new) / block_size)`` pages at admission (deadlock-
+free: decode never allocates mid-flight) and returns them the moment it
+finishes, sheds, or cancels — memory is block-granular, not
+slot-lifetime-granular, so a long-context straggler no longer pins
+``max_len`` KV for every cohabitant.
+
 TPU-first mechanics:
-  * static shapes everywhere: the slot bank (caches [n_layers, S,
-    max_len, H, Dh], tokens [S], pos [S]) never changes shape, so the
-    step compiles exactly once; inactive slots compute masked garbage —
-    the classic TPU trade of a little wasted FLOP for zero recompiles;
-  * per-slot cache writes are batched scatters (`.at[arange(S), pos]`),
-    per-slot causal masking is `arange(max_len) <= pos[:, None]`;
-  * prompts prefill into their slot through a power-of-two-bucketed
-    padded forward (O(log) compiled prefill shapes), writing K/V straight
-    into the bank with `dynamic_update_slice` at a traced slot index;
-  * caches are donated through both jits — the bank lives in HBM
+  * static shapes everywhere: the pool, the block tables, and the slot
+    vectors never change shape, so the decode step compiles exactly
+    once; block-table indices are TRACED operands — paging costs a
+    gather, never a recompile;
+  * per-slot cache writes are batched scatters into pages
+    (``.at[dest_block, offset]``); the attention read gathers
+    ``pool[block_table]`` back to the dense ``[S, max_len, H, Dh]``
+    geometry, so the masked-einsum decode math is IDENTICAL to the old
+    contiguous bank (token-for-token, tested);
+  * block 0 is the reserved SCRATCH page: idle and still-prefilling
+    slots keep an all-zeros block-table row, routing their garbage
+    decode writes there — in a paged layout a stray write into a
+    reallocated page would corrupt another request's KV, which the old
+    contiguous bank never had to worry about;
+  * prompts stream into their pages through a fixed-size CHUNKED
+    prefill interleaved with decode steps (one compiled chunk shape
+    replaces the power-of-two bucket family), so a long prompt no
+    longer stalls the decode loop for everyone else;
+  * completed FULL prompt pages register in a hash-keyed prefix cache
+    (tritonclient_tpu._kvcache): a shared system prompt resolves to
+    block-table entries instead of recompute — shared pages are always
+    full, so decode never writes into them and no copy-on-write is
+    needed;
+  * caches are donated through both jits — the pool lives in HBM
     in-place for the server's lifetime;
   * one host readback per STEP ([S] int32) serves every active stream —
     token egress cost is amortized across the batch.
@@ -37,26 +60,37 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from tritonclient_tpu import _stepscope, sanitize
+from tritonclient_tpu import _kvcache, _stepscope, sanitize
 from tritonclient_tpu.models._base import Model, TensorSpec
 from tritonclient_tpu.models.gpt import (
     GptConfig,
     _decode_layer,
-    _embed,
     _head,
-    _layer_fn,
     gpt_small,
     init_params,
     sample_token,
     sampling_inputs,
     sampling_key,
 )
-from tritonclient_tpu.ops.attention import dot_product_attention
+from tritonclient_tpu.protocol._literals import (
+    PREFIX_EVENT_HIT,
+    PREFIX_EVENT_MISS,
+)
 
 
-def _slot_cache(cfg: GptConfig, slots: int):
-    shape = (cfg.n_layers, slots, cfg.max_len, cfg.n_heads, cfg.head_dim)
+def _block_pool_arrays(cfg: GptConfig, n_blocks: int, block_size: int):
+    shape = (cfg.n_layers, n_blocks, block_size, cfg.n_heads, cfg.head_dim)
     return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+
+
+def _pow2_bucket(n: int, cap: int) -> int:
+    """Smallest power of two ≥ n, capped — the shape-bucketing rule for
+    both the chunk-prefill lane count and its context extent (compile
+    count stays logarithmic in max_slots × max_blocks)."""
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, cap)
 
 
 def _sample_slots(logits, seeds, steps, temps, topks):
@@ -70,32 +104,52 @@ def _sample_slots(logits, seeds, steps, temps, topks):
     return jax.vmap(one)(logits, seeds, steps, temps, topks)
 
 
-def _decode_step_slots(params: Dict, k_cache, v_cache, tokens, pos,
-                       seeds, steps, temps, topks, cfg: GptConfig):
-    """One step for the whole slot bank.
+def _decode_step_paged(params: Dict, k_pool, v_pool, btabs, tokens, pos,
+                       seeds, steps, temps, topks, cfg: GptConfig,
+                       block_size: int):
+    """One step for the whole slot bank against the paged pool.
 
-    tokens/pos/seeds/steps/topks [S] int32, temps [S] f32 →
-    (next sampled tokens [S] int32, caches). Sampling happens on device —
-    logits never leave the chip. Every slot advances; inactive slots
-    produce garbage the scheduler ignores.
+    ``btabs`` [S, max_blocks] int32 maps each slot's logical block index
+    to a pool page (0 = the scratch page). tokens/pos/seeds/steps/topks
+    [S] int32, temps [S] f32 → (next sampled tokens [S] int32, pools).
+    Sampling happens on device — logits never leave the chip. Every slot
+    advances; idle slots carry an all-scratch table, so their garbage
+    K/V lands on the scratch page instead of a page some OTHER request
+    now owns. The gather ``pool[btabs]`` reconstructs the dense
+    [S, max_len, H, Dh] view, making the attention math bit-identical to
+    the old contiguous bank.
     """
     s_count = tokens.shape[0]
+    max_blocks = btabs.shape[1]
+    l_eff = max_blocks * block_size
     x = params["embed"]["tok"][tokens] + params["embed"]["pos"][pos]  # [S, d]
     slot_ids = jnp.arange(s_count)
-    mask = (jnp.arange(cfg.max_len)[None, :] <= pos[:, None])[:, None, :]
+    # Surplus pipeline steps can push pos past the reserved region; the
+    # clamp keeps the (dropped-anyway) write inside the slot's own row.
+    blk = jnp.minimum(pos // block_size, max_blocks - 1)
+    off = pos % block_size
+    dest = btabs[slot_ids, blk]                              # [S] page ids
+    mask = (jnp.arange(l_eff)[None, :] <= pos[:, None])[:, None, :]
 
     def write_kv(kc, vc, k, v):
-        # Per-slot positions: a batched scatter along the length axis.
-        kc = kc.at[slot_ids, pos].set(k.astype(kc.dtype))
-        vc = vc.at[slot_ids, pos].set(v.astype(vc.dtype))
+        # Per-slot pages: a batched scatter at (page, offset).
+        kc = kc.at[dest, off].set(k.astype(kc.dtype))
+        vc = vc.at[dest, off].set(v.astype(vc.dtype))
         return kc, vc
 
-    def layer(h, xs):
-        lp, kc, vc = xs                       # kc/vc [S, max_len, H, Dh]
-        return _decode_layer(h, lp, kc, vc, cfg, write_kv, mask)
+    def read_kv(kc, vc):
+        # [n_blocks, bs, H, Dh] -> [S, max_blocks, bs, H, Dh] -> dense.
+        ka = kc[btabs].reshape(s_count, l_eff, cfg.n_heads, cfg.head_dim)
+        va = vc[btabs].reshape(s_count, l_eff, cfg.n_heads, cfg.head_dim)
+        return ka, va
 
-    x, (k_cache, v_cache) = lax.scan(
-        layer, x, (params["layers"], k_cache, v_cache)
+    def layer(h, xs):
+        lp, kc, vc = xs                   # kc/vc [n_blocks, bs, H, Dh]
+        return _decode_layer(h, lp, kc, vc, cfg, write_kv, mask,
+                             read_kv=read_kv)
+
+    x, (k_pool, v_pool) = lax.scan(
+        layer, x, (params["layers"], k_pool, v_pool)
     )
     logits = _head(params, x, cfg)
     # Greedy-only banks (the default) skip the sampler's full-vocab sort.
@@ -104,43 +158,82 @@ def _decode_step_slots(params: Dict, k_cache, v_cache, tokens, pos,
         lambda: _sample_slots(logits, seeds, steps, temps, topks),
         lambda: jnp.argmax(logits, axis=-1).astype(jnp.int32),
     )
-    return nxt, k_cache, v_cache
+    return nxt, k_pool, v_pool
 
 
-def _prefill_into_slot(params: Dict, k_cache, v_cache, padded_prompt,
-                       true_len, slot, seed, temperature, top_k,
-                       cfg: GptConfig):
-    """Causal pass over a padded prompt, K/V written into slot `slot`.
+def _prefill_chunk_paged(params: Dict, k_pool, v_pool, chunks, btabs,
+                         starts, n_valids, seeds, temps, topks,
+                         cfg: GptConfig, block_size: int):
+    """One fixed-size prompt chunk for K prefilling slots in a SINGLE
+    dispatch, K/V written into the pages of ``btabs`` [K, n_ctx] int32.
 
-    padded_prompt [1, bucket]; true_len/slot/seed/temperature/top_k
-    traced scalars. Causality makes rows [0, true_len) independent of the
-    pad tail, and rows beyond the current position stay masked until
-    overwritten by decode steps. Returns (first token [1] int32 — sampled
-    with the request's settings at step 0 — and the caches).
+    chunks [K, C] int32 (each lane zero-padded past its ``n_valids``);
+    ``starts`` [K] is the absolute position of each lane's chunk[0] (a
+    prefix-cache hit starts past its shared pages). Batching across
+    slots is the TTFT-under-churn term: batched decode steps finish
+    batchmates together, their clients resubmit together, and K serial
+    chunk dispatches at one loop top would put k×chunk-time in front of
+    every admission in the burst. Rows attend the pages' already-written
+    positions AND each other causally via the position mask — all rows
+    are written first, then the gather reads them back, so intra-chunk
+    causality falls out of ``position <= my position``. Pad rows (and
+    pad lanes) route their writes to the scratch page; lanes gather only
+    their own table, so cross-lane isolation is structural, not masked.
+    ``n_ctx`` (the traced table width) is the caller-bucketed context
+    extent — the mask admits no key past a lane's last valid position,
+    so truncating the table to the prompt seen so far is lossless.
+    Returns (first tokens [K] int32 — sampled with each request's
+    settings at step 0, meaningful only on a lane's FINAL chunk — and
+    the pools).
     """
-    atn = functools.partial(dot_product_attention, causal=True)
-    x, (ks, vs) = lax.scan(
-        functools.partial(_layer_fn, cfg=cfg, atn=atn),
-        _embed(params, padded_prompt), params["layers"],
+    kk, c = chunks.shape
+    n_ctx = btabs.shape[1]
+    l_eff = n_ctx * block_size
+    rows = jnp.arange(c, dtype=jnp.int32)
+    positions = starts[:, None] + rows[None, :]                # [K, C]
+    safe_pos = jnp.minimum(positions, cfg.max_len - 1)
+    x = (params["embed"]["tok"][chunks]
+         + params["embed"]["pos"][safe_pos]).reshape(kk * c, cfg.d_model)
+    valid = rows[None, :] < n_valids[:, None]                  # [K, C]
+    blk = jnp.minimum(safe_pos // block_size, n_ctx - 1)
+    dest = jnp.where(valid, jnp.take_along_axis(btabs, blk, axis=1),
+                     0).reshape(kk * c)           # pad rows -> scratch
+    off = (safe_pos % block_size).reshape(kk * c)
+    mask = (jnp.arange(l_eff)[None, None, :]
+            <= positions[:, :, None]).reshape(kk * c, 1, l_eff)
+
+    def write_kv(kc, vc, k, v):
+        kc = kc.at[dest, off].set(k.astype(kc.dtype))
+        vc = vc.at[dest, off].set(v.astype(vc.dtype))
+        return kc, vc
+
+    def read_kv(kc, vc):
+        hd = (l_eff, cfg.n_heads, cfg.head_dim)
+        full = (kk, c) + hd
+        ka = jnp.broadcast_to(kc[btabs].reshape((kk,) + hd)[:, None], full)
+        va = jnp.broadcast_to(vc[btabs].reshape((kk,) + hd)[:, None], full)
+        return ka.reshape((kk * c,) + hd), va.reshape((kk * c,) + hd)
+
+    def layer(h, xs):
+        lp, kc, vc = xs
+        return _decode_layer(h, lp, kc, vc, cfg, write_kv, mask,
+                             read_kv=read_kv)
+
+    x, (k_pool, v_pool) = lax.scan(
+        layer, x, (params["layers"], k_pool, v_pool)
     )
-    last = lax.dynamic_slice(
-        x, (0, true_len - 1, 0), (1, 1, cfg.d_model)
-    )
-    logits = _head(params, last, cfg)[:, 0]                    # [1, vocab]
-    # ks/vs: [n_layers, 1, bucket, H, Dh] -> slot rows [0, bucket).
-    k_cache = lax.dynamic_update_slice(
-        k_cache, ks.astype(k_cache.dtype), (0, slot, 0, 0, 0)
-    )
-    v_cache = lax.dynamic_update_slice(
-        v_cache, vs.astype(v_cache.dtype), (0, slot, 0, 0, 0)
-    )
-    first = lax.cond(
-        temperature > 0,
-        lambda: sample_token(logits, sampling_key(seed, 0), temperature,
-                             top_k),
+    last = jnp.take_along_axis(
+        x.reshape(kk, c, cfg.d_model),
+        (n_valids - 1).astype(jnp.int32)[:, None, None], axis=1,
+    )[:, 0]                                                    # [K, d]
+    logits = _head(params, last, cfg)                          # [K, vocab]
+    firsts = lax.cond(
+        jnp.any(temps > 0),
+        lambda: _sample_slots(logits, seeds, jnp.zeros_like(seeds),
+                              temps, topks),
         lambda: jnp.argmax(logits, axis=-1).astype(jnp.int32),
     )
-    return first, k_cache, v_cache
+    return firsts, k_pool, v_pool
 
 
 class _Request:
@@ -175,6 +268,31 @@ class _Request:
         return self.cancelled or (
             self.cancel_event is not None and self.cancel_event.is_set()
         )
+
+
+class _PrefillState:
+    """A slot whose prompt is still streaming into its pages.
+
+    ``blocks`` is the FULL reservation (prefix-cache shares first, then
+    fresh pages for the rest of the prompt and the whole decode budget);
+    ``next`` is the next prompt index to feed (starts past the shared
+    pages); ``hashes`` are the cumulative block hashes of the matchable
+    full prompt blocks — entries past ``n_hit`` register in the prefix
+    cache when the prefill completes.
+    """
+
+    __slots__ = ("req", "prompt_len", "blocks", "n_hit", "hashes",
+                 "next", "first")
+
+    def __init__(self, req: "_Request", prompt_len: int,
+                 blocks: List[int], n_hit: int, hashes: List[int]):
+        self.req = req
+        self.prompt_len = prompt_len
+        self.blocks = blocks
+        self.n_hit = n_hit
+        self.hashes = hashes
+        self.next = 0
+        self.first = None
 
 
 class _Distributor:
@@ -334,19 +452,41 @@ class _Distributor:
 
 
 class GenerationEngine:
-    """The continuous-batching scheduler around the slot bank."""
+    """The continuous-batching scheduler around the paged block pool."""
 
     def __init__(self, cfg: GptConfig, params: Dict, max_slots: int = 8,
-                 mesh=None, scope_name: str = "gpt_engine"):
+                 mesh=None, scope_name: str = "gpt_engine",
+                 block_size: int = 16, n_blocks: Optional[int] = None,
+                 prefill_chunk: int = 32):
         """``mesh``: run the engine tensor-parallel — params laid out by
-        the Megatron rules (models/gpt.PARTITION_RULES) and the slot-bank
-        KV caches sharded on the heads axis over 'tp', so continuous
+        the Megatron rules (models/gpt.PARTITION_RULES) and the paged
+        KV pool sharded on the heads axis over 'tp', so continuous
         batching scales past one chip's HBM/FLOPs. Greedy decoding stays
         token-identical to the single-device path (GSPMD inserts the
-        all-reduces through prefill, the batched decode step, and the
-        logits head; tested)."""
+        all-reduces through prefill chunks, the batched decode step, and
+        the logits head; tested).
+
+        ``block_size`` must divide ``cfg.max_len`` — the gathered view
+        then has exactly the contiguous bank's [S, max_len] geometry, so
+        paging is a memory-layout change, never a numerics change.
+        ``n_blocks`` defaults to full per-slot capacity plus the scratch
+        page (1 + max_slots * max_len/block_size): identical admission
+        behavior to the old slot bank unless the caller sizes the pool
+        smaller. ``prefill_chunk`` is the single compiled prefill shape.
+        """
         self.cfg = cfg
         self.mesh = mesh
+        if cfg.max_len % block_size:
+            raise ValueError(
+                f"block_size {block_size} must divide max_len "
+                f"{cfg.max_len} (the gathered view must reconstruct the "
+                "dense cache geometry exactly)"
+            )
+        self.block_size = block_size
+        self._max_blocks = cfg.max_len // block_size   # per-slot table width
+        if n_blocks is None:
+            n_blocks = 1 + max_slots * self._max_blocks
+        self.prefill_chunk = max(1, min(int(prefill_chunk), cfg.max_len))
         if mesh is not None:
             from tritonclient_tpu.models.gpt import PARTITION_RULES
             from tritonclient_tpu.parallel.sharding import (
@@ -355,7 +495,7 @@ class GenerationEngine:
             )
 
             params = shard_tree(mesh, params, PARTITION_RULES)
-            # Cache layout [n_layers, S, max_len, H, Dh]: heads on tp.
+            # Pool layout [n_layers, n_blocks, bs, H, Dh]: heads on tp.
             # named_sharding drops absent/size-1 axes, so a tp-less mesh
             # degrades to replication like shard_tree does for params.
             self._cache_sharding = named_sharding(
@@ -368,15 +508,26 @@ class GenerationEngine:
         self.params = params
         self.max_slots = max_slots
         if self._cache_sharding is not None:
-            # Allocate the bank directly sharded: staging the full
-            # unsharded [L, S, max_len, H, Dh] zeros on one device first
-            # would OOM exactly the configs the mesh exists for.
+            # Allocate the pool directly sharded: staging the full
+            # unsharded [L, n_blocks, bs, H, Dh] zeros on one device
+            # first would OOM exactly the configs the mesh exists for.
             self._k, self._v = jax.jit(
-                lambda: _slot_cache(cfg, max_slots),
+                lambda: _block_pool_arrays(cfg, n_blocks, block_size),
                 out_shardings=(self._cache_sharding, self._cache_sharding),
             )()
         else:
-            self._k, self._v = _slot_cache(cfg, max_slots)
+            self._k, self._v = _block_pool_arrays(cfg, n_blocks, block_size)
+        # Host-side allocation state. The first alloc deterministically
+        # returns page 0 — pinned forever as the SCRATCH page that idle
+        # and still-prefilling slots write into.
+        self._pool = _kvcache.BlockPool(n_blocks, block_size)
+        self._scratch = self._pool.try_alloc()
+        assert self._scratch == 0
+        self._prefix = _kvcache.PrefixCache(self._pool)
+        self._slot_blocks: List[List[int]] = [[] for _ in range(max_slots)]
+        self._prefilling: Dict[int, _PrefillState] = {}
+        self._pending: Optional[_Request] = None  # head-of-line, blocked on pages
+        self._btabs = jnp.zeros((max_slots, self._max_blocks), jnp.int32)
         self._tokens = jnp.zeros((max_slots,), jnp.int32)
         self._pos = jnp.zeros((max_slots,), jnp.int32)
         # Per-slot sampling state (request settings + the (seed, step)
@@ -388,10 +539,10 @@ class GenerationEngine:
         if self._vec_sharding is not None:
             # Slot-state vectors replicate over the mesh so every jit sees
             # one device set (params/caches are mesh-committed).
-            self._tokens, self._pos, self._seeds, self._steps, \
-                self._temps, self._topks = jax.device_put(
-                    (self._tokens, self._pos, self._seeds, self._steps,
-                     self._temps, self._topks),
+            self._btabs, self._tokens, self._pos, self._seeds, \
+                self._steps, self._temps, self._topks = jax.device_put(
+                    (self._btabs, self._tokens, self._pos, self._seeds,
+                     self._steps, self._temps, self._topks),
                     self._vec_sharding,
                 )
         self._slot_req: List[Optional[_Request]] = [None] * max_slots
@@ -419,21 +570,38 @@ class GenerationEngine:
         )
         self._prefill_seq = 0
         self._step = jax.jit(
-            functools.partial(_decode_step_slots, cfg=cfg),
+            functools.partial(_decode_step_paged, cfg=cfg,
+                              block_size=block_size),
             donate_argnums=(1, 2),
         )
-        self._prefill = jax.jit(
-            functools.partial(_prefill_into_slot, cfg=cfg),
+        self._prefill_chunk_fn = jax.jit(
+            functools.partial(_prefill_chunk_paged, cfg=cfg,
+                              block_size=block_size),
             donate_argnums=(1, 2),
         )
+        # /metrics registry: weakly bound so a dropped engine vanishes
+        # from the exposition instead of being pinned by it.
+        import weakref
+
+        ref = weakref.ref(self)
+
+        def _kv_snapshot():
+            e = ref()
+            if e is None:
+                raise RuntimeError("engine gone")
+            return {
+                "used": e._pool.used_count,
+                "total": e._pool.n_blocks,
+                "events": e._prefix.snapshot_events(),
+            }
+
+        _kvcache.register(scope_name, self, _kv_snapshot)
         # The daemon loop must not be frozen mid-XLA-call at interpreter
         # exit (the runtime aborts on an unraisable C++ exception); stop
         # and join it from atexit. Weakref so the hook never extends the
         # engine's lifetime.
         import atexit
-        import weakref
 
-        ref = weakref.ref(self)
         atexit.register(lambda: (lambda e: e and e.shutdown())(ref()))
 
     def shutdown(self, timeout: float = 10.0):
@@ -450,10 +618,14 @@ class GenerationEngine:
         self._dist.drain_and_stop(timeout=timeout)
         self._process_frees()
         self._drain_terminated()
+        _kvcache.unregister(self._scope_name, self)
 
     def _drain_terminated(self):  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
         """Terminate every queued/active request (no thread will serve
         them): admission-queue waiters too, not just slot occupants."""
+        if self._pending is not None:
+            self._pending.out.put(None)
+            self._pending = None
         while True:
             try:
                 self._admit.get_nowait().out.put(None)
@@ -462,6 +634,8 @@ class GenerationEngine:
         for slot, req in enumerate(self._slot_req):
             if req is not None:
                 req.out.put(None)
+                self._prefilling.pop(slot, None)
+                self._free_slot_blocks(slot, device_reset=False)
                 self._slot_req[slot] = None
 
     # -- client side ---------------------------------------------------------
@@ -471,10 +645,10 @@ class GenerationEngine:
                seed: int = 0, cancel_event=None) -> "_Request":
         """Queue a generation; returns the _Request whose ``.out`` queue
         yields np [1] per token, then None. Setting ``.cancelled`` (or
-        arming ``cancel_event``) frees the slot at the engine's next loop
-        top — i.e. within one decode step. Greedy by default;
-        temperature/top_k/seed follow the shared sampling key schedule
-        (gpt.sampling_key)."""
+        arming ``cancel_event``) frees the slot — and returns its KV
+        pages to the pool — at the engine's next loop top, i.e. within
+        one decode step. Greedy by default; temperature/top_k/seed follow
+        the shared sampling key schedule (gpt.sampling_key)."""
         if prompt.shape[1] >= self.cfg.max_len:
             raise ValueError(
                 f"prompt length {prompt.shape[1]} must be < max_len "
@@ -503,35 +677,124 @@ class GenerationEngine:
             self._cv.notify_all()
         return req
 
-    # -- engine loop ---------------------------------------------------------
+    # -- block accounting ----------------------------------------------------
 
-    def _bucket(self, length: int) -> int:
-        b = 8
-        while b < length:
-            b *= 2
-        return min(b, self.cfg.max_len)
+    def _free_slot_blocks(self, slot: int, device_reset: bool = True):  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
+        """Return a slot's pages (block-granular, immediately reusable).
+
+        Registered pages park on the prefix cache's evictable LRU (their
+        KV stays warm); unregistered ones go straight to the free list.
+        ``device_reset`` re-points the slot's block-table row at the
+        scratch page so in-flight/surplus decode writes for this slot
+        can no longer land in pages a NEW request may get — the paged
+        equivalent of the contiguous bank's harmless garbage writes.
+        (False only on shutdown/broken paths where no further dispatch
+        will happen and the device may be unusable.)
+        """
+        for bid in self._slot_blocks[slot]:
+            self._prefix.release_block(bid)
+        self._slot_blocks[slot] = []
+        if device_reset:
+            self._btabs = self._btabs.at[slot].set(
+                jnp.zeros((self._max_blocks,), jnp.int32)
+            )
+            self._pos = self._pos.at[slot].set(0)
+
+    def _alloc_block(self) -> Optional[int]:
+        """A free page, evicting the LRU zero-ref cached page if needed."""
+        bid = self._pool.try_alloc()
+        if bid is None:
+            bid = self._prefix.evict_lru()
+        return bid
+
+    def _reserve(self, req: "_Request"):
+        """Try to reserve the request's FULL page budget
+        (ceil((prompt + max_new) / block_size)) — hit pages from the
+        prefix cache, the rest fresh. All-at-admission reservation keeps
+        decode allocation-free, hence deadlock-free; failure rolls back
+        and the request waits at the head of the line. Returns a
+        _PrefillState, None (pool exhausted — retry on free), or an
+        exception (request can NEVER fit this pool)."""
+        bs = self.block_size
+        l = req.prompt.shape[1]
+        n_total = min(-(-(l + req.max_new) // bs), self._max_blocks)
+        if n_total > self._pool.n_blocks - 1:
+            return RuntimeError(
+                f"request needs {n_total} KV pages but the pool holds "
+                f"{self._pool.n_blocks - 1} (block_size {bs}); size the "
+                "pool for at least one full-length request"
+            )
+        # Matchable prefix: full prompt blocks only, and always leave at
+        # least the last prompt token to compute (its logits produce the
+        # first output token).
+        prompt_row = req.prompt[0]
+        hashes: List[int] = []
+        h = 0
+        for i in range((l - 1) // bs):
+            h = _kvcache.block_hash(h, prompt_row[i * bs:(i + 1) * bs])
+            hashes.append(h)
+        blocks: List[int] = []
+        n_hit = 0
+        for hk in hashes:
+            bid = self._prefix.match(hk)
+            if bid is None:
+                break
+            blocks.append(bid)
+            n_hit += 1
+        ok = True
+        for _ in range(n_total - n_hit):
+            bid = self._alloc_block()
+            if bid is None:
+                ok = False
+                break
+            blocks.append(bid)
+        if not ok:
+            for bid in blocks:
+                self._prefix.release_block(bid)
+            return None
+        # Events count once per COMMITTED admission (never per blocked
+        # retry): every matchable block is either a hit or a miss.
+        if n_hit:
+            self._prefix.count(PREFIX_EVENT_HIT, n_hit)
+        if len(hashes) - n_hit:
+            self._prefix.count(PREFIX_EVENT_MISS, len(hashes) - n_hit)
+        st = _PrefillState(req, l, blocks, n_hit, hashes)
+        st.next = n_hit * bs
+        return st
+
+    # -- engine loop ---------------------------------------------------------
 
     def _release_cancelled(self):  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
         """A consumer that went away (stream closed) marks its request
-        cancelled; its slot frees at the next loop top instead of
-        generating dead tokens until max_new. Termination itself is
-        routed through the delivery queue (submit_cancel) so the
-        request's remaining/out are only ever touched by the delivery
+        cancelled; its slot AND its KV pages free at the next loop top
+        instead of generating dead tokens until max_new. Termination
+        itself is routed through the delivery queue (submit_cancel) so
+        the request's remaining/out are only ever touched by the delivery
         thread, in pipeline order. ``cancel_event`` (armed by the
         protocol front-end on disconnect/stream cancel) is polled here —
         between decode steps — so an abandoned generation frees its slot
         even when its response generator never runs again."""
         for slot, req in enumerate(self._slot_req):
             if req is not None and req.abandoned:
-                self._slot_req[slot] = None
+                # Pages back BEFORE the slot reads empty: anything polling
+                # _slot_req for completion (tests, warm_admission callers)
+                # must find the pool already reconciled.
+                self._prefilling.pop(slot, None)
+                self._free_slot_blocks(slot)
                 self._temps = self._temps.at[slot].set(0.0)
+                self._slot_req[slot] = None
                 self._dist.submit_cancel(req)
+        if self._pending is not None and self._pending.abandoned:
+            self._pending.out.put(None)
+            self._pending = None
 
     def _process_frees(self):  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
         """Apply slot-completions reported by the delivery thread.
 
         Only the engine loop mutates slot state; the distributor just
         queues (slot, req) here when a request's final token went out.
+        Pages return to the pool HERE — block-granular, the moment the
+        request finishes, not when the slot's longest cohabitant does.
         """
         while True:
             try:
@@ -539,80 +802,174 @@ class GenerationEngine:
             except queue.Empty:
                 return
             if self._slot_req[slot] is req:
-                self._slot_req[slot] = None
-                # Reset the slot's temperature so an all-greedy bank
-                # goes back to the cheap argmax branch of the step.
+                # Pages back BEFORE the slot reads empty (same ordering
+                # as _release_cancelled: pollers of _slot_req must find
+                # the pool already reconciled). The temperature reset
+                # sends an all-greedy bank back down the cheap argmax
+                # branch of the step.
+                self._free_slot_blocks(slot)
                 self._temps = self._temps.at[slot].set(0.0)
+                self._slot_req[slot] = None
 
-    def _admit_into_free_slots(self):  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
-        admitted = []  # (slot, req, first_token_array, prompt_len)
+    def _admit_requests(self):  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
+        """Claim free slots for queued requests: reserve pages (admission
+        gates on FREE PAGES now, not just free slots) and queue the
+        chunked prefill. No compute happens here — chunks dispatch from
+        _advance_prefills, interleaved with decode steps."""
         for slot in range(self.max_slots):
             if self._slot_req[slot] is not None:
                 continue
-            try:
-                req = self._admit.get_nowait()
-            except queue.Empty:
-                break
+            req = self._pending
+            self._pending = None
+            if req is None:
+                try:
+                    req = self._admit.get_nowait()
+                except queue.Empty:
+                    return
             if req.abandoned:
                 req.out.put(None)
                 continue
-            l = req.prompt.shape[1]
-            bucket = self._bucket(l)
-            padded = np.zeros((1, bucket), np.int32)
-            padded[:, :l] = req.prompt
-            # No dispatch ticket for prefills: admissions are bounded by
-            # the slot count, and blocking a NEW request's prefill on a
-            # step-readback ticket is the TTFT-under-load term.
-            scope = _stepscope.step_begin(
-                self._scope_name, _stepscope.PHASE_PREFILL,
-                self._prefill_seq, batch_size=1, slots=self.max_slots,
+            st = self._reserve(req)
+            if isinstance(st, BaseException):
+                req.out.put(st)
+                continue
+            if st is None:
+                # Pool exhausted: hold the head of the line (FIFO — no
+                # starvation by smaller latecomers) and retry when a
+                # completion returns pages.
+                self._pending = req
+                return
+            self._slot_req[slot] = req
+            self._slot_blocks[slot] = st.blocks
+            self._prefilling[slot] = st
+
+    def _advance_prefills(self):  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
+        """Dispatch ONE prefill chunk for every still-prefilling slot —
+        all slots in a SINGLE batched dispatch — then admit completed
+        ones into the decode bank in a single vectorized burst. One
+        chunk per slot per loop top is the interleave: decode steps run
+        between chunks, so a long prompt streams in without stalling
+        anyone's ITL. Batching the chunks across slots is the
+        TTFT-under-churn term: batched steps finish batchmates together,
+        their clients resubmit together, and K serial chunk dispatches
+        would put k×chunk-time in front of every admission in the burst
+        (measured: the serial form put the c8 TTFT p99 at ~4× c1's on
+        the CPU reference host; batched, the burst costs ~one chunk).
+        """
+        if not self._prefilling:
+            return
+        active = sorted(self._prefilling)
+        c = self.prefill_chunk
+        n_real = len(active)
+        # Lane count bucketed to a power of two (≤ max_slots buckets
+        # total): pad lanes carry an all-scratch table, n_valid=1, and
+        # temp 0, so their writes land on the scratch page and their
+        # greedy "first token" is discarded.
+        kk = _pow2_bucket(n_real, self.max_slots)
+        chunks = np.zeros((kk, c), np.int32)
+        starts = np.zeros((kk,), np.int32)
+        n_valids = np.ones((kk,), np.int32)
+        seeds = np.zeros((kk,), np.int32)
+        temps = np.zeros((kk,), np.float32)
+        topks = np.zeros((kk,), np.int32)
+        # Context extent: a chunk's valid rows only index blocks below
+        # ceil((start + n_valid) / bs), and the causal mask admits no
+        # key past the last valid position — so the table (and with it
+        # the gather + attention-key extent inside the kernel, which
+        # derives everything from btabs.shape) truncates losslessly to
+        # the longest prompt-so-far in the batch. Bucketed to a power
+        # of two: one compiled shape per (lane, context) bucket instead
+        # of every chunk paying a max_len-wide gather, which on the
+        # contiguous-workload gate cost more per 32-token chunk than a
+        # whole batched decode step.
+        needed = 1
+        lanes = []  # (slot, st, start, n_valid)
+        for slot in active:
+            st = self._prefilling[slot]
+            start = st.next
+            n_valid = min(c, st.prompt_len - start)
+            lanes.append((slot, st, start, n_valid))
+            needed = max(
+                needed, -(-(start + n_valid) // self.block_size)
             )
-            self._prefill_seq += 1
-            first, self._k, self._v = self._prefill(
-                self.params, self._k, self._v, jnp.asarray(padded),
-                jnp.int32(l), jnp.int32(slot), jnp.int32(req.seed),
-                jnp.float32(req.temperature), jnp.int32(req.top_k),
-            )
-            _stepscope.step_dispatched(scope)
-            _stepscope.charge_collectives(scope, self._expected_collectives)
+        n_ctx = _pow2_bucket(needed, self._max_blocks)
+        btab_rows = np.zeros((kk, n_ctx), np.int32)
+        for i, (slot, st, start, n_valid) in enumerate(lanes):
+            chunks[i, :n_valid] = st.req.prompt[0, start:start + n_valid]
+            starts[i] = start
+            n_valids[i] = n_valid
+            seeds[i] = st.req.seed
+            temps[i] = st.req.temperature
+            topks[i] = st.req.top_k
+            k_ctx = min(len(st.blocks), n_ctx)
+            btab_rows[i, :k_ctx] = st.blocks[:k_ctx]
+        # No dispatch ticket for prefill chunks: admissions are bounded
+        # by the slot count, and blocking a NEW request's prefill on a
+        # step-readback ticket is the TTFT-under-load term.
+        scope = _stepscope.step_begin(
+            self._scope_name, _stepscope.PHASE_PREFILL_CHUNK,
+            self._prefill_seq, batch_size=n_real, slots=self.max_slots,
+        )
+        self._prefill_seq += 1
+        firsts_dev, self._k, self._v = self._prefill_chunk_fn(
+            self.params, self._k, self._v, jnp.asarray(chunks),
+            jnp.asarray(btab_rows), jnp.asarray(starts),
+            jnp.asarray(n_valids), jnp.asarray(seeds),
+            jnp.asarray(temps), jnp.asarray(topks),
+        )
+        _stepscope.step_dispatched(scope)
+        _stepscope.charge_collectives(scope, self._expected_collectives)
+        done = []  # (slot, state)
+        for i, (slot, st, start, n_valid) in enumerate(lanes):
+            st.next = start + n_valid
+            if st.next >= st.prompt_len:
+                st.first = firsts_dev[i : i + 1]
+                done.append((slot, st))
+        if done:
             try:
-                first.copy_to_host_async()
+                firsts_dev.copy_to_host_async()
             except AttributeError:
                 pass
-            _stepscope.step_end(scope, outputs=first)
-            self._slot_req[slot] = req
-            admitted.append((slot, req, first, l))
-        if not admitted:
+        _stepscope.step_end(scope, outputs=firsts_dev)
+        if not done:
             return
         # Slot-state updates are device-op ENQUEUES (several per slot):
         # a synchronized churn burst (batched steps finish batchmates
-        # together, their clients resubmit together) admits many slots
-        # at one loop top, and per-slot scalar writes would pay
-        # 6 x k enqueues on the burst tail — the TTFT p99 term on
+        # together, their clients resubmit together) completes many
+        # prefills at one loop top, and per-slot scalar writes would pay
+        # 7 x k enqueues on the burst tail — the TTFT p99 term on
         # remote-dispatch links. One vectorized write per state vector
         # (k=1 included: one code path, one warmable shape family), and
         # ONE batched first-token delivery — k separate prio deliveries
         # would re-pay the fixed per-readback cost k times on the
         # delivery thread. Admission never blocks on a readback; order
         # per request is preserved (the prio entry precedes any step
-        # including these slots).
-        firsts = jnp.concatenate([f for _, _, f, _ in admitted])
-        slots = jnp.array([s for s, _, _, _ in admitted], jnp.int32)
+        # including these slots). Setting the DEVICE block-table row
+        # here — only after the last chunk — is what routes the slot's
+        # decode writes from the scratch page onto its real pages.
+        for slot, st in done:
+            del self._prefilling[slot]
+            for i in range(st.n_hit, len(st.hashes)):
+                self._prefix.register(st.hashes[i], st.blocks[i])
+        firsts = jnp.concatenate([st.first for _, st in done])
+        slots = jnp.array([s for s, _ in done], jnp.int32)
+        rows = np.zeros((len(done), self._max_blocks), np.int32)
+        for i, (_, st) in enumerate(done):
+            rows[i, :len(st.blocks)] = st.blocks
+        self._btabs = self._btabs.at[slots].set(jnp.asarray(rows))
         self._tokens = self._tokens.at[slots].set(firsts)
         self._pos = self._pos.at[slots].set(
-            jnp.array([l for _, _, _, l in admitted], jnp.int32)
+            jnp.array([st.prompt_len for _, st in done], jnp.int32)
         )
         self._seeds = self._seeds.at[slots].set(
-            jnp.array([r.seed for _, r, _, _ in admitted], jnp.int32)
+            jnp.array([st.req.seed for _, st in done], jnp.int32)
         )
         self._steps = self._steps.at[slots].set(1)
         self._temps = self._temps.at[slots].set(
-            jnp.array(
-                [r.temperature for _, r, _, _ in admitted], jnp.float32
-            )
+            jnp.array([st.req.temperature for _, st in done], jnp.float32)
         )
         self._topks = self._topks.at[slots].set(
-            jnp.array([r.top_k for _, r, _, _ in admitted], jnp.int32)
+            jnp.array([st.req.top_k for _, st in done], jnp.int32)
         )
         try:
             firsts.copy_to_host_async()
@@ -620,7 +977,7 @@ class GenerationEngine:
             pass
         self._dist.submit(
             firsts,
-            [(i, slot, req) for i, (slot, req, _, _) in enumerate(admitted)],
+            [(i, slot, st.req) for i, (slot, st) in enumerate(done)],
             first_token=True,
         )
 
@@ -649,7 +1006,7 @@ class GenerationEngine:
                     "warm_admission on a stopped or broken engine"
                 )
             busy = [s for s, r in enumerate(self._slot_req) if r is not None]
-            if busy or not self._admit.empty():
+            if busy or not self._admit.empty() or self._pending is not None:
                 raise RuntimeError(
                     "warm_admission requires an idle engine: all slots "
                     "free and an empty admission queue (busy slots: "
@@ -657,11 +1014,14 @@ class GenerationEngine:
                 )
             for k in range(1, self.max_slots + 1):
                 # Mirror the admission path's exact op shapes: host-array
-                # scatters for the request fields, device-concat for
-                # tokens.
+                # scatters for the request fields and block-table rows,
+                # device-concat for tokens.
                 slots = jnp.array(list(range(k)), jnp.int32)
                 firsts = jnp.concatenate(
                     [self._tokens[s : s + 1] for s in range(k)]
+                )
+                self._btabs = self._btabs.at[slots].set(
+                    jnp.asarray(np.zeros((k, self._max_blocks), np.int32))
                 )
                 self._tokens = self._tokens.at[slots].set(firsts)
                 self._pos = self._pos.at[slots].set(
@@ -677,13 +1037,65 @@ class GenerationEngine:
                 self._topks = self._topks.at[slots].set(
                     jnp.array([0] * k, jnp.int32)
                 )
+            # Admission leaves _steps at 1 for warmed rows; the real
+            # admission path writes every vector, so the warm state is
+            # rewritten before any request decodes against it.
+            self._steps = self._steps.at[
+                jnp.arange(self.max_slots)
+            ].set(0)
             jax.block_until_ready(self._tokens)
+
+    def warm_prefill(self, ctx_blocks=(1,)):
+        """Compile the chunk-prefill shape family — every power-of-two
+        lane bucket × the power-of-two context buckets covering
+        ``ctx_blocks`` (block counts, e.g. ceil(prompt_len/block_size)
+        for each prompt length a serving window will carry) — so no
+        multi-second XLA compile lands inside a measured window when a
+        synchronized churn burst first produces that batch shape. Warm
+        lanes carry all-scratch tables, so every write routes to the
+        scratch page and no pool pages are touched. Same idle-only
+        contract as ``warm_admission`` (the chunk fn donates the pools,
+        so it must not race the engine loop's own dispatches)."""
+        import jax
+
+        with self._cv:
+            if self._stopping or self._broken is not None:
+                raise RuntimeError(
+                    "warm_prefill on a stopped or broken engine"
+                )
+            busy = [s for s, r in enumerate(self._slot_req) if r is not None]
+            if busy or not self._admit.empty() or self._pending is not None:
+                raise RuntimeError(
+                    "warm_prefill requires an idle engine: all slots "
+                    "free and an empty admission queue (busy slots: "
+                    f"{busy}, queued admissions: {self._admit.qsize()})"
+                )
+            c = self.prefill_chunk
+            buckets = sorted(
+                {_pow2_bucket(max(1, int(b)), self._max_blocks)
+                 for b in ctx_blocks}
+            )
+            kk = 1
+            while True:
+                for n_ctx in buckets:
+                    z = jnp.zeros((kk,), jnp.int32)
+                    _, self._k, self._v = self._prefill_chunk_fn(
+                        self.params, self._k, self._v,
+                        jnp.zeros((kk, c), jnp.int32),
+                        jnp.zeros((kk, n_ctx), jnp.int32),
+                        z, jnp.ones((kk,), jnp.int32), z,
+                        jnp.zeros((kk,), jnp.float32), z,
+                    )
+                if kk >= self.max_slots:
+                    break
+                kk = min(kk * 2, self.max_slots)
+            jax.block_until_ready(self._k)
 
     def _run(self):  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
         try:
             self._run_loop()
         except BaseException as e:  # noqa: BLE001 — engine must not die silently
-            # The jits donate the cache bank: after a failed dispatch the
+            # The jits donate the cache pool: after a failed dispatch the
             # engine cannot be restarted against possibly-deleted buffers.
             # Mark broken (submit() refuses), surface the error to every
             # waiting consumer (their generators re-raise it), and stop.
@@ -696,6 +1108,9 @@ class GenerationEngine:
                 self._dist.drain_and_stop(timeout=5.0)
             except Exception:
                 pass
+            if self._pending is not None:
+                self._pending.out.put(e)
+                self._pending = None
             while True:
                 try:
                     self._admit.get_nowait().out.put(e)
@@ -705,16 +1120,20 @@ class GenerationEngine:
                 if req is not None:
                     req.out.put(e)
                     self._slot_req[slot] = None
+                    self._prefilling.pop(slot, None)
+                    # Host bookkeeping only: the device is suspect.
+                    self._free_slot_blocks(slot, device_reset=False)
 
     def _run_loop(self):  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
         # Software pipeline with DECOUPLED delivery: steps and admissions'
-        # prefills dispatch with DEVICE tokens; the delivery thread drains
-        # readbacks FIFO behind them (at most max_inflight dispatches
-        # ahead). Scheduling depends on token COUNTS, never values, so
-        # delivery may lag compute. The engine loop itself never blocks
-        # on a host copy — an arriving request's prefill dispatches at
-        # the very next loop top regardless of in-flight readbacks, which
-        # is what bounds TTFT under load (VERDICT r4 #4).
+        # prefill chunks dispatch with DEVICE tokens; the delivery thread
+        # drains readbacks FIFO behind them (at most max_inflight
+        # dispatches ahead). Scheduling depends on token COUNTS, never
+        # values, so delivery may lag compute. The engine loop itself
+        # never blocks on a host copy — an arriving request's first
+        # prefill chunk dispatches at the very next loop top regardless
+        # of in-flight readbacks, which is what bounds TTFT under load
+        # (VERDICT r4 #4).
         step_seq = 0  # host-side decode-step index (stepscope records)
         while True:
             # Lock-free polls of monotonic signal flags: the loop re-checks
@@ -729,15 +1148,20 @@ class GenerationEngine:
                 raise broken
             self._process_frees()
             self._release_cancelled()
-            self._admit_into_free_slots()
+            self._admit_requests()
+            self._advance_prefills()
             active = [s for s, r in enumerate(self._slot_req)
-                      if r is not None]
+                      if r is not None and s not in self._prefilling]
             if not active:
+                if self._prefilling:
+                    continue  # keep streaming chunks in
                 with self._cv:
-                    if self._admit.empty() and self._dist.free_q.empty():
+                    if (self._admit.empty() and self._dist.free_q.empty()
+                            and self._pending is None):
                         got = self._cv.wait(timeout=5.0)
                         if (not got and self._admit.empty()
-                                and self._dist.free_q.empty()):
+                                and self._dist.free_q.empty()
+                                and self._pending is None):
                             # Idle: park the engine; submit() restarts it.
                             # (The delivery thread parks itself on its
                             # queue; in-flight readbacks still complete.)
@@ -745,9 +1169,9 @@ class GenerationEngine:
                             return
                 continue
             # Wait for a step ticket WITHOUT starving admissions: a new
-            # request's prefill is ticket-exempt and must dispatch while
-            # the step pipeline is full, or TTFT under load degrades to
-            # a step-readback wait.
+            # request's prefill chunks are ticket-exempt and must dispatch
+            # while the step pipeline is full, or TTFT under load degrades
+            # to a step-readback wait.
             got_ticket = self._dist.try_ticket(timeout=0.005)
             while not got_ticket:
                 # Same lock-free signal poll as the loop top.
@@ -755,17 +1179,18 @@ class GenerationEngine:
                     break
                 self._process_frees()
                 self._release_cancelled()
-                self._admit_into_free_slots()
+                self._admit_requests()
+                self._advance_prefills()
                 got_ticket = self._dist.try_ticket(timeout=0.005)
             if not got_ticket:
                 continue  # stopping/broken handled at loop top
-            # Recompute: slots admitted during the ticket wait join this
-            # very step (their prefill already wrote KV + token state) —
-            # and every occupant may have finished/cancelled during the
-            # wait, in which case the ticket goes back unspent instead
-            # of dispatching a whole-bank step over garbage.
+            # Recompute: slots whose prefill completed during the ticket
+            # wait join this very step (their pages + token state are
+            # live) — and every occupant may have finished/cancelled
+            # during the wait, in which case the ticket goes back unspent
+            # instead of dispatching a whole-bank step over garbage.
             active = [s for s, r in enumerate(self._slot_req)
-                      if r is not None]
+                      if r is not None and s not in self._prefilling]
             if not active:
                 self._dist.release_ticket()
                 continue
@@ -775,8 +1200,9 @@ class GenerationEngine:
             )
             step_seq += 1
             nxt, self._k, self._v = self._step(
-                self.params, self._k, self._v, self._tokens, self._pos,
-                self._seeds, self._steps, self._temps, self._topks,
+                self.params, self._k, self._v, self._btabs, self._tokens,
+                self._pos, self._seeds, self._steps, self._temps,
+                self._topks,
             )
             _stepscope.step_dispatched(scope)
             _stepscope.charge_collectives(scope, self._expected_collectives)
@@ -802,7 +1228,8 @@ class GptEngineModel(Model):
 
     Same wire contract as GptModel (INPUT_IDS [1, L], optional MAX_TOKENS,
     one OUTPUT_IDS response per token) — but concurrent requests share
-    batched decode steps instead of running private generation loops.
+    batched decode steps instead of running private generation loops,
+    over a paged KV block pool with chunked prefill and prefix caching.
     """
 
     name = "gpt_engine"
@@ -814,7 +1241,8 @@ class GptEngineModel(Model):
     accepts_cancel_event = True
 
     def __init__(self, cfg: Optional[GptConfig] = None, seed: int = 0,
-                 max_slots: int = 8, mesh=None):
+                 max_slots: int = 8, mesh=None, block_size: int = 16,
+                 n_blocks: Optional[int] = None, prefill_chunk: int = 32):
         super().__init__()
         self.cfg = cfg or gpt_small()
         self.inputs = [
@@ -838,11 +1266,14 @@ class GptEngineModel(Model):
             )
         else:
             params = init_params(key, self.cfg)
-        # mesh: tensor-parallel engine (KV slot bank sharded; pre-sharded
+        # mesh: tensor-parallel engine (KV block pool sharded; pre-sharded
         # params pass through shard_tree as a no-op).
         self.engine = GenerationEngine(self.cfg, params,
                                        max_slots=max_slots, mesh=mesh,
-                                       scope_name=self.name)
+                                       scope_name=self.name,
+                                       block_size=block_size,
+                                       n_blocks=n_blocks,
+                                       prefill_chunk=prefill_chunk)
 
     def infer(self, inputs, parameters=None) -> Iterator[dict]:
         prompt = np.asarray(inputs["INPUT_IDS"], dtype=np.int32)

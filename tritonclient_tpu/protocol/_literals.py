@@ -224,6 +224,27 @@ HEDGE_OUTCOMES = (
     HEDGE_OUTCOME_FAILED,
 )
 
+# --------------------------------------------------------------------------- #
+# paged KV cache vocabulary (prefix caching)                                  #
+# --------------------------------------------------------------------------- #
+
+#: ``event`` label values of the ``nv_engine_prefix_cache_events_total``
+#: counter: the gpt engine's block-pool prefix cache resolving a full
+#: prompt block by cumulative token hash (``hit``), computing it fresh
+#: (``miss``), or reclaiming an LRU zero-ref cached block to satisfy an
+#: allocation (``evict``). Spelled here exactly once (enforced by
+#: TPU008): dashboards alert on these strings, and an engine counting
+#: event X while the exposition renders event Y silently zeroes the
+#: hit-rate panel.
+PREFIX_EVENT_HIT = "hit"
+PREFIX_EVENT_MISS = "miss"
+PREFIX_EVENT_EVICT = "evict"
+PREFIX_EVENTS = (
+    PREFIX_EVENT_HIT,
+    PREFIX_EVENT_MISS,
+    PREFIX_EVENT_EVICT,
+)
+
 #: Server-internal parameter key carrying a request's ``cancel_event``
 #: into engine-backed models (gpt/tp engines poll it between decode
 #: steps). Never on the wire: the front-ends strip/never accept it, and

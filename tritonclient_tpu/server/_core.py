@@ -21,7 +21,7 @@ from typing import Dict, Iterator, List, Optional, Union
 
 import numpy as np
 
-from tritonclient_tpu import _stepscope, sanitize
+from tritonclient_tpu import _kvcache, _stepscope, sanitize
 from tritonclient_tpu._sketch import LatencySketch
 from tritonclient_tpu._tracing import (
     FlightRecorder,
@@ -31,6 +31,7 @@ from tritonclient_tpu._tracing import (
 )
 from tritonclient_tpu.protocol._literals import (
     PARAM_CANCEL_EVENT,
+    PREFIX_EVENTS,
     SERVER_EXTENSIONS,
     SHED_REASON_ADMISSION,
     SHED_REASON_CANCELLED,
@@ -1645,6 +1646,47 @@ class InferenceCore:
             lines.append(
                 f'{metric}{{model="{esc(sname)}",op="{esc(op)}"}} {ccount}'
             )
+        # Paged-KV families (tritonclient_tpu._kvcache registry): pool
+        # occupancy gauges plus the prefix-cache event counter for every
+        # live engine. Headers always render (stable family set for
+        # scrapers); rows appear per registered engine, and every
+        # canonical event renders per model (zeros included) so hit rate
+        # is computable from any single scrape.
+        kv_rows = _kvcache.metrics_snapshot()
+        metric = _kvcache.KV_BLOCKS_USED_METRIC
+        lines.append(
+            f"# HELP {metric} Number of KV cache blocks currently "
+            "referenced by live requests (scratch block included)"
+        )
+        lines.append(f"# TYPE {metric} gauge")
+        for sname, snap in kv_rows:
+            lines.append(
+                f'{metric}{{model="{esc(sname)}"}} {snap["used"]}'
+            )
+        metric = _kvcache.KV_BLOCKS_TOTAL_METRIC
+        lines.append(
+            f"# HELP {metric} Total number of KV cache blocks in the "
+            "engine's block pool"
+        )
+        lines.append(f"# TYPE {metric} gauge")
+        for sname, snap in kv_rows:
+            lines.append(
+                f'{metric}{{model="{esc(sname)}"}} {snap["total"]}'
+            )
+        metric = _kvcache.PREFIX_EVENTS_METRIC
+        lines.append(
+            f"# HELP {metric} Number of prefix-cache block events at "
+            "admission, by event (hit = block reused from cache, miss = "
+            "block prefilled fresh, evict = cached block reclaimed)"
+        )
+        lines.append(f"# TYPE {metric} counter")
+        for sname, snap in kv_rows:
+            events = snap.get("events", {})
+            for event in PREFIX_EVENTS:
+                lines.append(
+                    f'{metric}{{model="{esc(sname)}",event="{event}"}} '
+                    f"{events.get(event, 0)}"
+                )
         # Queue-depth gauge: requests admitted but not yet answered.
         metric = "nv_inference_pending_request_count"
         lines.append(
