@@ -35,6 +35,11 @@ Checks (exit 1 with one line per violation):
     ``stage``/``phase`` drawn from the canonical stepscope vocabularies
     (and the shared summary checks — quantile monotonicity, _sum/_count);
     ``nv_engine_collectives_total`` carries exactly {model, op}
+  * the overlap families: ``nv_engine_collective_overlap_us_total``
+    carries exactly {model, kind} with ``kind`` drawn from the canonical
+    overlap vocabulary and both kind rows present per model (so the
+    overlap ratio is computable from one scrape);
+    ``nv_engine_inflight_steps`` carries exactly {model}, non-negative
   * the paged-KV families: ``nv_engine_kv_blocks_used`` /
     ``nv_engine_kv_blocks_total`` carry exactly {model}, are
     non-negative, and used <= total per model;
@@ -77,6 +82,11 @@ try:
 except ImportError:  # standalone copy of the script: keep it usable
     PREFIX_EVENTS = ("hit", "miss", "evict")
 
+try:
+    from tritonclient_tpu.protocol._literals import OVERLAP_KINDS
+except ImportError:  # standalone copy of the script: keep it usable
+    OVERLAP_KINDS = ("exposed", "hidden")
+
 _SHED_FAMILY = "nv_inference_shed_total"
 # Fleet-router families (served by the router's own /metrics): same
 # stable-label-set discipline as the shed counter.
@@ -100,6 +110,10 @@ _COLLECTIVES_FAMILY = "nv_engine_collectives_total"
 _KV_USED_FAMILY = "nv_engine_kv_blocks_used"
 _KV_TOTAL_FAMILY = "nv_engine_kv_blocks_total"
 _PREFIX_FAMILY = "nv_engine_prefix_cache_events_total"
+# Overlap plane (PR 13): exposed-vs-hidden collective time counter with
+# the canonical kind vocabulary, plus the pipelined-dispatch depth gauge.
+_OVERLAP_FAMILY = "nv_engine_collective_overlap_us_total"
+_INFLIGHT_FAMILY = "nv_engine_inflight_steps"
 
 _VALID_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
 _METRIC_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
@@ -335,6 +349,37 @@ def check_exposition(text: str) -> List[str]:
                             f'{family}{{model="{model}"}}: missing event '
                             f"rows {missing}"
                         )
+            if family == _OVERLAP_FAMILY:
+                # Overlap contract: fixed {model, kind} label set,
+                # canonical kinds only, and BOTH kinds present per model
+                # (the overlap ratio hidden / (hidden + exposed) must be
+                # computable from one scrape without absent-as-zero
+                # guessing).
+                model_kinds: Dict[str, set] = {}
+                for labels, value, name, lineno in samples.get(family, []):
+                    if set(labels) != {"model", "kind"}:
+                        errors.append(
+                            f"line {lineno}: {family} label set "
+                            f"{sorted(labels)} != ['kind', 'model']"
+                        )
+                        continue
+                    if labels["kind"] not in OVERLAP_KINDS:
+                        errors.append(
+                            f"line {lineno}: {family} kind "
+                            f"{labels['kind']!r} not in "
+                            f"{list(OVERLAP_KINDS)}"
+                        )
+                        continue
+                    model_kinds.setdefault(
+                        labels["model"], set()
+                    ).add(labels["kind"])
+                for model, kinds in model_kinds.items():
+                    missing = [k for k in OVERLAP_KINDS if k not in kinds]
+                    if missing:
+                        errors.append(
+                            f'{family}{{model="{model}"}}: missing kind '
+                            f"rows {missing}"
+                        )
             if family == _COLLECTIVES_FAMILY:
                 # Stepscope collectives: fixed {model, op} label set (the
                 # op value is open vocabulary — psum/ppermute/all_to_all
@@ -392,6 +437,21 @@ def check_exposition(text: str) -> List[str]:
                         errors.append(
                             f"line {lineno}: {family} value {value} < 0 "
                             "(outstanding/depth cannot be negative)"
+                        )
+            if family == _INFLIGHT_FAMILY:
+                # Dispatch-depth gauge: exactly {model}, non-negative (a
+                # negative depth means the submit/deliver accounting
+                # leaked, not an idle engine).
+                for labels, value, name, lineno in samples.get(family, []):
+                    if set(labels) != {"model"}:
+                        errors.append(
+                            f"line {lineno}: {family} label set "
+                            f"{sorted(labels)} != ['model']"
+                        )
+                    if value < 0:
+                        errors.append(
+                            f"line {lineno}: {family} value {value} < 0 "
+                            "(in-flight depth cannot be negative)"
                         )
             if family in (_KV_USED_FAMILY, _KV_TOTAL_FAMILY):
                 # Pool-occupancy gauges: exactly {model}, non-negative.

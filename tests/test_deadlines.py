@@ -370,7 +370,10 @@ def test_seeded_overload_sheds_fast_and_holds_in_deadline_p99(tmp_path):
 
 def test_grpc_stream_cancel_frees_engine_slot_within_one_step():
     """A cancelled gRPC stream's generation frees its engine slot: the
-    engine polls cancel_event between decode steps."""
+    engine polls cancel_event between decode dispatches. With pipelined
+    fused dispatch (PR 13) tokens already in flight may still deliver,
+    but never more than the in-flight window (max_inflight x fuse
+    micro-steps), and the slot frees long before max_new."""
     from tritonclient_tpu.models.gpt_engine import GptEngineModel
 
     model = GptEngineModel(max_slots=2)
@@ -392,6 +395,7 @@ def test_grpc_stream_cancel_frees_engine_slot_within_one_step():
         client.async_stream_infer("gpt_engine", [inp, mt])
         assert got_token.wait(timeout=120)  # generation underway
         assert any(r is not None for r in model.engine._slot_req)
+        n_at_cancel = len(tokens)
         client.stop_stream(cancel_requests=True)
         client.close()
         # The engine must observe the cancel between decode steps and
@@ -404,10 +408,17 @@ def test_grpc_stream_cancel_frees_engine_slot_within_one_step():
         assert all(r is None for r in model.engine._slot_req), (
             model.engine._slot_req
         )
+        # In-flight window bound: pipelining may deliver dispatches that
+        # raced the cancel, but never an unbounded tail past it.
+        engine = model.engine
+        window = (engine._dist.max_inflight + 1) * engine._fuse_steps
+        assert len(tokens) <= n_at_cancel + window, (
+            f"{len(tokens) - n_at_cancel} tokens after cancel, "
+            f"window {window}"
+        )
         # Paged KV: the cancelled request's blocks must be back in the
         # pool the moment its slot freed (block-granular reclamation) —
         # only the scratch page stays referenced...
-        engine = model.engine
         # (evictable prefix-cache pages are refcount-0, so used counts
         # exactly the scratch page once the cancel reclaimed the rest)
         assert engine._pool.used_count == 1

@@ -373,6 +373,83 @@ class TestKvExpositionViolations:
         assert any("< 0" in e for e in errors)
 
 
+class TestOverlapExpositionViolations:
+    """The overlap/in-flight exposition contract (PR 13), checked the same
+    way as the paged-KV families: synthetic documents through the real
+    checker, one mutation per violation class."""
+
+    HEAD = (
+        "# HELP nv_engine_collective_overlap_us_total x\n"
+        "# TYPE nv_engine_collective_overlap_us_total counter\n"
+        "# HELP nv_engine_inflight_steps x\n"
+        "# TYPE nv_engine_inflight_steps gauge\n"
+    )
+
+    def _good_rows(self):
+        rows = [
+            f'nv_engine_collective_overlap_us_total{{model="gpt_engine"'
+            f',kind="{k}"}} 0'
+            for k in ("exposed", "hidden")
+        ]
+        rows.append('nv_engine_inflight_steps{model="gpt_engine"} 2')
+        return rows
+
+    def test_good_document_passes(self):
+        assert check_exposition(
+            self.HEAD + "\n".join(self._good_rows()) + "\n"
+        ) == []
+
+    def test_noncanonical_kind(self):
+        rows = self._good_rows()
+        rows[0] = ('nv_engine_collective_overlap_us_total'
+                   '{model="gpt_engine",kind="mystery"} 0')
+        errors = check_exposition(self.HEAD + "\n".join(rows) + "\n")
+        assert any("mystery" in e for e in errors)
+
+    def test_missing_kind_row(self):
+        rows = [r for r in self._good_rows() if 'kind="hidden"' not in r]
+        errors = check_exposition(self.HEAD + "\n".join(rows) + "\n")
+        assert any("missing kind rows" in e for e in errors)
+
+    def test_overlap_label_set(self):
+        rows = self._good_rows()
+        rows.append('nv_engine_collective_overlap_us_total'
+                    '{model="m",kind="exposed",op="psum"} 0')
+        errors = check_exposition(self.HEAD + "\n".join(rows) + "\n")
+        assert any("label set" in e for e in errors)
+
+    def test_inflight_label_set(self):
+        rows = self._good_rows()
+        rows.append('nv_engine_inflight_steps{model="m",version="1"} 0')
+        errors = check_exposition(self.HEAD + "\n".join(rows) + "\n")
+        assert any("label set" in e for e in errors)
+
+    def test_negative_inflight(self):
+        rows = self._good_rows()
+        rows[-1] = 'nv_engine_inflight_steps{model="gpt_engine"} -1'
+        errors = check_exposition(self.HEAD + "\n".join(rows) + "\n")
+        assert any("in-flight depth" in e for e in errors)
+
+    def test_live_snapshot_renders_both_kinds(self):
+        """overlap_snapshot() feeds /metrics: once a model has overlap
+        charges, both kinds and the in-flight gauge must come back."""
+        from tritonclient_tpu import _stepscope
+
+        prev = _stepscope._mode
+        _stepscope.configure("counters")
+        _stepscope._aggregator.reset()
+        try:
+            _stepscope._aggregator.overlap[("m", "exposed")] = 5
+            _stepscope.inflight_update("m", 1)
+            overlap_rows, inflight_rows = _stepscope.overlap_snapshot()
+            assert (("m", "exposed", 5) in overlap_rows
+                    and ("m", "hidden", 0) in overlap_rows)
+            assert ("m", 1) in inflight_rows
+        finally:
+            _stepscope._aggregator.reset()
+            _stepscope.configure(prev)
+
+
 # --------------------------------------------------------------------------- #
 # tpusan lanes ride the existing markers: these tests use only the engine's  #
 # public surface, so both sanitizer lanes pick them up via tests/ discovery. #

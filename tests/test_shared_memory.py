@@ -369,6 +369,75 @@ class TestShardedTpuShm:
         finally:
             tpushm.destroy_shared_memory_region(region)
 
+    def test_sharded_parallel_upload_matches_staged(self, mesh, monkeypatch):
+        # The per-slice upload path (pool on) and the staged single
+        # device_put (kill-switch) must produce byte-identical contents
+        # and the same shard layout.
+        data = np.arange(16 * 64, dtype=np.int32).reshape(16, 64)
+        monkeypatch.setenv("TPU_SHM_PARALLEL_UPLOAD", "0")
+        r0 = tpushm.create_sharded_memory_region("sp_off", data.nbytes, mesh)
+        try:
+            r0.set_array(data)
+            staged = np.asarray(r0.as_array("INT32", [16, 64]))
+        finally:
+            tpushm.destroy_shared_memory_region(r0)
+        monkeypatch.setenv("TPU_SHM_PARALLEL_UPLOAD", "1")
+        monkeypatch.setenv("TPU_SHM_UPLOAD_WORKERS", "4")
+        r1 = tpushm.create_sharded_memory_region("sp_on", data.nbytes, mesh)
+        try:
+            r1.set_array(data)
+            arr = r1.as_array("INT32", [16, 64])
+            assert len(arr.sharding.device_set) == 8
+            np.testing.assert_array_equal(np.asarray(arr), staged)
+            np.testing.assert_array_equal(staged, data)
+        finally:
+            tpushm.destroy_shared_memory_region(r1)
+
+    def test_sharded_put_one_shard_per_device_slice(self, mesh):
+        # _sharded_put assembles the array from per-device single-device
+        # uploads: every addressable shard must hold exactly the host
+        # slice the sharding maps to its device.
+        region = tpushm.create_sharded_memory_region(
+            "sp_slices", 16 * 64 * 4, mesh
+        )
+        try:
+            host = np.arange(16 * 64, dtype=np.int32).reshape(16, 64)
+            arr = region._sharded_put(host)
+            idx_map = region.sharding.addressable_devices_indices_map(
+                host.shape
+            )
+            assert len(arr.addressable_shards) == 8
+            for shard in arr.addressable_shards:
+                np.testing.assert_array_equal(
+                    np.asarray(shard.data), host[idx_map[shard.device]]
+                )
+        finally:
+            tpushm.destroy_shared_memory_region(region)
+
+    def test_sharded_repark_cas(self, mesh):
+        # as_array uploads outside the region lock and parks through the
+        # _replace_parked CAS: a stale witness loses (racing writer wins),
+        # a live witness swaps.
+        region = tpushm.create_sharded_memory_region("sp_cas", 1024, mesh)
+        try:
+            data = np.arange(256, dtype=np.int32)
+            region.set_array(data)
+            parked = region.as_array("INT32", [256])
+            # Wrong witness: the parked entry must survive untouched.
+            assert not region._replace_parked(0, object(), None,
+                                              drop_nbytes=1024)
+            assert region.as_array("INT32", [256]) is parked
+            # Reinterpreting dtype goes through the host mirror and
+            # reparks via the CAS against the live entry — and wins.
+            as_f32 = region.as_array("FP32", [256])
+            assert as_f32.dtype == np.float32
+            np.testing.assert_array_equal(
+                np.asarray(as_f32).view(np.int32), data
+            )
+            assert region.as_array("FP32", [256]) is as_f32
+        finally:
+            tpushm.destroy_shared_memory_region(region)
+
     def test_sharded_handle_token(self, mesh):
         import base64, json as js
 

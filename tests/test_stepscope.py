@@ -186,10 +186,12 @@ def test_sync_mode_measures_device_stage():
 
 
 def test_tp_engine_collectives_match_expected_per_step():
-    """tp=2 engine: GSPMD's implicit all-reduces (one per row-sharded
-    matmul — wo and w_out, so 2 per layer) are charged per step via
-    ``expected_tp_collectives``; every decode step must carry exactly
-    that count."""
+    """tp=2 engine: the forced all-reduces (one per row-sharded matmul —
+    wo and w_out, so 2 per layer, times the overlap chunk count now that
+    the projections issue one psum per output chunk) are charged per
+    dispatch via ``expected_tp_collectives``; every decode record must
+    carry exactly that count times its fused micro-step count, plus the
+    calibrated exposed/hidden time split."""
     from tritonclient_tpu.models.gpt_engine import GenerationEngine
     from tritonclient_tpu.parallel import build_mesh
 
@@ -209,16 +211,32 @@ def test_tp_engine_collectives_match_expected_per_step():
     decode = [r for r in doc["records"]
               if r["phase"] == _stepscope.PHASE_DECODE]
     assert decode
-    want = _stepscope.expected_tp_collectives(cfg.n_layers, 2)
-    assert want == {"psum": 2 * cfg.n_layers}
+    want = _stepscope.expected_tp_collectives(
+        cfg.n_layers, 2, engine._overlap_chunks
+    )
+    assert want == {"psum": 2 * cfg.n_layers * engine._overlap_chunks}
+    hid_n, exp_n = engine._overlap_split
+    assert exp_n == 2 * cfg.n_layers
+    assert hid_n == 2 * cfg.n_layers * (engine._overlap_chunks - 1)
     for r in decode:
-        assert r["collectives"]["psum"]["count"] == want["psum"]
-    # The aggregate counter matches steps * per-step count.
+        assert r["collectives"]["psum"]["count"] \
+            == want["psum"] * r["micro_steps"]
+        # Charged overlap time scales with the same structural counts.
+        if engine._coll_us:
+            assert r["coll_exposed_us"] \
+                == int(exp_n * r["micro_steps"] * engine._coll_us)
+            assert r["coll_hidden_us"] \
+                == int(hid_n * r["micro_steps"] * engine._coll_us)
+    # The aggregate counter matches micro-steps * per-step count.
     _, coll_rows = _stepscope.metrics_snapshot((0.5,))
     psum_total = sum(c for _, op, c in coll_rows if op == "psum")
-    n_steps = len([r for r in doc["records"]
-                   if r["collectives"].get("psum")])
-    assert psum_total == n_steps * want["psum"]
+    n_micro = sum(r["micro_steps"] for r in doc["records"]
+                  if r["collectives"].get("psum"))
+    assert psum_total == n_micro * want["psum"]
+    # The overlap sink carries both kinds for the model.
+    overlap_rows, _ = _stepscope.overlap_snapshot()
+    kinds = {k for m, k, _ in overlap_rows if m == "gpt_engine"}
+    assert kinds == set(_stepscope.OVERLAP_KINDS)
 
 
 def test_note_collective_charges_active_step():

@@ -198,7 +198,7 @@ def prefill(params: Dict, tokens: jax.Array, cfg: GptConfig,
 
 
 def _decode_layer(h, lp, kc, vc, cfg: GptConfig, write_kv, mask,
-                  read_kv=None):
+                  read_kv=None, proj_fn=None):
     """Single-token decoder layer, shared by the per-request decode path
     (`decode_step`) and the continuous-batching slot bank
     (models/gpt_engine.py) — one source of truth for the LN/QKV/masked-
@@ -213,7 +213,15 @@ def _decode_layer(h, lp, kc, vc, cfg: GptConfig, write_kv, mask,
     contiguous paths read the cache directly. Decode is bandwidth-bound
     on the cache read — the MXU-free regime where a flash kernel buys
     nothing — so a masked einsum is the kernel.
+
+    ``proj_fn(x, w, b)`` (optional) computes the two row-parallel
+    projections (attention output ``wo``, FFN down ``w_out``); the tp
+    engine passes ``parallel.overlap.make_row_parallel_proj`` so each
+    projection's all-reduce chunks under the next chunk's matmul. Default
+    is the plain matmul (identical math, GSPMD inserts the psums).
     """
+    if proj_fn is None:
+        proj_fn = lambda x, w, b: x @ w + b  # noqa: E731
     n = h.shape[0]
     a = _layer_norm(h, lp["ln1_scale"], lp["ln1_bias"], cfg.layer_norm_eps)
     qkv = a @ lp["wqkv"] + lp["bqkv"]
@@ -231,10 +239,10 @@ def _decode_layer(h, lp, kc, vc, cfg: GptConfig, write_kv, mask,
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("nhl,nlhd->nhd", p, va.astype(jnp.float32))
     out = out.reshape(n, cfg.d_model).astype(h.dtype)
-    h = h + (out @ lp["wo"] + lp["bo"])
+    h = h + proj_fn(out, lp["wo"], lp["bo"])
     m = _layer_norm(h, lp["ln2_scale"], lp["ln2_bias"], cfg.layer_norm_eps)
-    h = h + (jax.nn.gelu(m @ lp["w_in"] + lp["b_in"]) @ lp["w_out"]
-             + lp["b_out"])
+    h = h + proj_fn(jax.nn.gelu(m @ lp["w_in"] + lp["b_in"]),
+                    lp["w_out"], lp["b_out"])
     return h, (kc, vc)
 
 

@@ -106,7 +106,7 @@ def _sample_slots(logits, seeds, steps, temps, topks):
 
 def _decode_step_paged(params: Dict, k_pool, v_pool, btabs, tokens, pos,
                        seeds, steps, temps, topks, cfg: GptConfig,
-                       block_size: int):
+                       block_size: int, proj_fn=None):
     """One step for the whole slot bank against the paged pool.
 
     ``btabs`` [S, max_blocks] int32 maps each slot's logical block index
@@ -146,7 +146,7 @@ def _decode_step_paged(params: Dict, k_pool, v_pool, btabs, tokens, pos,
     def layer(h, xs):
         lp, kc, vc = xs                   # kc/vc [n_blocks, bs, H, Dh]
         return _decode_layer(h, lp, kc, vc, cfg, write_kv, mask,
-                             read_kv=read_kv)
+                             read_kv=read_kv, proj_fn=proj_fn)
 
     x, (k_pool, v_pool) = lax.scan(
         layer, x, (params["layers"], k_pool, v_pool)
@@ -161,9 +161,45 @@ def _decode_step_paged(params: Dict, k_pool, v_pool, btabs, tokens, pos,
     return nxt, k_pool, v_pool
 
 
+def _decode_multi_step_paged(params: Dict, k_pool, v_pool, btabs, tokens,
+                             pos, seeds, steps, temps, topks,
+                             cfg: GptConfig, block_size: int, n_steps: int,
+                             proj_fn=None):
+    """``n_steps`` decode micro-steps in ONE dispatch: a ``lax.scan`` over
+    the exact single-step body, returning the ``[n_steps, S]`` token
+    block plus the advanced carry.
+
+    This is the fused form of the dispatch pipeline: one host dispatch
+    and ONE readback amortize over ``n_steps`` tokens, so per-step host
+    work (trace-cache lookup, argument donation, executable launch, the
+    delivery hand-off) leaves the step critical path — the term that
+    dominates tp scaling on dispatch-bound hosts. Because the scan body
+    IS ``_decode_step_paged``, token streams are identical to ``n_steps``
+    lockstep dispatches (same HLO per micro-step, same sampling key
+    schedule); pool donation stays safe because the whole fused window is
+    one XLA program. The scheduler only fuses when every active request
+    still needs ≥ ``n_steps`` tokens, no slot is prefilling, and the
+    admission queue is empty — surplus beyond a request's budget is
+    bounded and dropped by the delivery pairs like any pipeline surplus.
+    """
+
+    def one(carry, _):
+        tokens, pos, steps, k_pool, v_pool = carry
+        nxt, k_pool, v_pool = _decode_step_paged(
+            params, k_pool, v_pool, btabs, tokens, pos, seeds, steps,
+            temps, topks, cfg, block_size, proj_fn=proj_fn,
+        )
+        return (nxt, pos + 1, steps + 1, k_pool, v_pool), nxt
+
+    (tokens, pos, steps, k_pool, v_pool), toks = lax.scan(
+        one, (tokens, pos, steps, k_pool, v_pool), None, length=n_steps
+    )
+    return toks, tokens, pos, steps, k_pool, v_pool
+
+
 def _prefill_chunk_paged(params: Dict, k_pool, v_pool, chunks, btabs,
                          starts, n_valids, seeds, temps, topks,
-                         cfg: GptConfig, block_size: int):
+                         cfg: GptConfig, block_size: int, proj_fn=None):
     """One fixed-size prompt chunk for K prefilling slots in a SINGLE
     dispatch, K/V written into the pages of ``btabs`` [K, n_ctx] int32.
 
@@ -217,7 +253,7 @@ def _prefill_chunk_paged(params: Dict, k_pool, v_pool, chunks, btabs,
     def layer(h, xs):
         lp, kc, vc = xs
         return _decode_layer(h, lp, kc, vc, cfg, write_kv, mask,
-                             read_kv=read_kv)
+                             read_kv=read_kv, proj_fn=proj_fn)
 
     x, (k_pool, v_pool) = lax.scan(
         layer, x, (params["layers"], k_pool, v_pool)
@@ -312,7 +348,8 @@ class _Distributor:
     single-threaded.
     """
 
-    __slots__ = ("q", "prio_q", "free_q", "_sem", "_thread", "_engine")
+    __slots__ = ("q", "prio_q", "free_q", "max_inflight", "_sem", "_thread",
+                 "_engine")
 
     def __init__(self, engine: "GenerationEngine", max_inflight: int = 3):
         self.q: "queue.Queue" = queue.Queue()
@@ -323,6 +360,7 @@ class _Distributor:
         # readbacks (~a readback RTT each on remote links).
         self.prio_q: "queue.Queue" = queue.Queue()
         self.free_q: "queue.Queue" = queue.Queue()
+        self.max_inflight = max_inflight
         self._sem = threading.Semaphore(max_inflight)
         self._thread: Optional[threading.Thread] = None
         self._engine = engine
@@ -418,6 +456,9 @@ class _Distributor:
             finally:
                 if ticketed:
                     self._sem.release()
+                    _stepscope.inflight_update(
+                        self._engine._scope_name, -1
+                    )
 
     def _deliver(self, nxt_dev, pairs):
         """Deliver one dispatch's tokens (one readback serves them all).
@@ -428,27 +469,37 @@ class _Distributor:
         is delivered, and a completed request's surplus step (computed
         while its final token was still in flight) must be dropped, not
         delivered to the slot's new occupant.
+
+        A fused dispatch hands over ``[n_steps, S]`` (one row per
+        micro-step); rows deliver in step order, so per-request token
+        order is exactly the lockstep pipeline's, and a request whose
+        budget runs out mid-block simply drops the surplus rows.
         """
         nxt_np = np.asarray(nxt_dev)
-        for idx, slot, req in pairs:
-            if req.remaining <= 0:
-                continue  # surplus step of an already-finished request
-            req.out.put(nxt_np[idx : idx + 1].copy())
-            req.remaining -= 1
-            req.steps_completed += 1
-            if req.cancel_event is not None:
-                # Event objects double as the steps_completed side channel
-                # back to the core's cancel finalization (the engine never
-                # sees the request's TraceContext).
-                try:
-                    req.cancel_event.steps_completed = req.steps_completed
-                except AttributeError:
-                    pass
-            if req.remaining == 0:
-                req.out.put(None)
-                self.free_q.put((slot, req))
-                with self._engine._cv:
-                    self._engine._cv.notify_all()
+        rows = nxt_np if nxt_np.ndim == 2 else nxt_np[None]
+        for t in range(rows.shape[0]):
+            row = rows[t]
+            for idx, slot, req in pairs:
+                if req.remaining <= 0:
+                    continue  # surplus step of an already-finished request
+                req.out.put(row[idx : idx + 1].copy())
+                req.remaining -= 1
+                req.steps_completed += 1
+                if req.cancel_event is not None:
+                    # Event objects double as the steps_completed side
+                    # channel back to the core's cancel finalization (the
+                    # engine never sees the request's TraceContext).
+                    try:
+                        req.cancel_event.steps_completed = (
+                            req.steps_completed
+                        )
+                    except AttributeError:
+                        pass
+                if req.remaining == 0:
+                    req.out.put(None)
+                    self.free_q.put((slot, req))
+                    with self._engine._cv:
+                        self._engine._cv.notify_all()
 
 
 class GenerationEngine:
@@ -565,18 +616,52 @@ class GenerationEngine:
         # there is no python call site to count at).
         self._scope_name = scope_name
         tp = int(dict(mesh.shape).get("tp", 1)) if mesh is not None else 1
+        # Compute/collective overlap: under tp the row-parallel
+        # projections run as chunked matmul+psum pairs (parallel/overlap)
+        # so each chunk's all-reduce executes under the next chunk's
+        # matmul; only the trailing chunk is exposed. TPU_ENGINE_OVERLAP=0
+        # restores the plain GSPMD projections.
+        from tritonclient_tpu.parallel import overlap as _overlap
+
+        self._overlap_chunks = 1
+        self._proj_fn = None
+        if (mesh is not None and tp > 1
+                and _overlap.overlap_enabled_from_env()):
+            chunks = _overlap.pick_chunks(
+                cfg.d_model, tp, _overlap.overlap_chunks_from_env()
+            )
+            if chunks > 1:
+                self._overlap_chunks = chunks
+                self._proj_fn = _overlap.make_row_parallel_proj(
+                    mesh, "tp", chunks, note=False
+                )
         self._expected_collectives = _stepscope.expected_tp_collectives(
-            cfg.n_layers, tp
+            cfg.n_layers, tp, self._overlap_chunks
         )
+        self._overlap_split = _stepscope.expected_overlap_split(
+            cfg.n_layers, tp, self._overlap_chunks
+        )
+        self._coll_us: Optional[float] = None  # lazy calibration
         self._prefill_seq = 0
         self._step = jax.jit(
             functools.partial(_decode_step_paged, cfg=cfg,
-                              block_size=block_size),
+                              block_size=block_size,
+                              proj_fn=self._proj_fn),
             donate_argnums=(1, 2),
         )
+        # Fused pipelined dispatch: TPU_ENGINE_FUSE_STEPS=k scans k decode
+        # micro-steps into one dispatch + one readback when the bank is
+        # saturated (no prefills, empty admission queue, every active
+        # request still owes ≥ k tokens). Compiled lazily per bucketed k.
+        self._fuse_steps = max(
+            int(os.environ.get("TPU_ENGINE_FUSE_STEPS", "4")), 1
+        )
+        self._multi_step: Dict[int, object] = {}
+        self._dispatched = [0] * max_slots  # decode tokens dispatched/slot
         self._prefill_chunk_fn = jax.jit(
             functools.partial(_prefill_chunk_paged, cfg=cfg,
-                              block_size=block_size),
+                              block_size=block_size,
+                              proj_fn=self._proj_fn),
             donate_argnums=(1, 2),
         )
         # /metrics registry: weakly bound so a dropped engine vanishes
@@ -764,6 +849,71 @@ class GenerationEngine:
 
     # -- engine loop ---------------------------------------------------------
 
+    def _multi_step_fn(self, n_steps: int):
+        """The jitted fused decode for one bucketed micro-step count
+        (compiled on first use; the bucket set is the powers of two up to
+        TPU_ENGINE_FUSE_STEPS, so the shape family stays tiny)."""
+        fn = self._multi_step.get(n_steps)
+        if fn is None:
+            fn = self._multi_step[n_steps] = jax.jit(
+                functools.partial(_decode_multi_step_paged, cfg=self.cfg,
+                                  block_size=self.block_size,
+                                  n_steps=n_steps,
+                                  proj_fn=self._proj_fn),
+                donate_argnums=(1, 2),
+            )
+        return fn
+
+    def _choose_fuse(self, active: List[int]) -> int:  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
+        """Micro-steps for the next dispatch. Fusing trades scheduler
+        granularity for dispatch amortization, so it only engages when
+        nothing is waiting on the scheduler: no prefilling slot, an empty
+        admission queue, no head-of-line request — and never past the
+        smallest remaining token budget in the bank (bucketed to a power
+        of two to bound the compile family). Cancels/deadlines are still
+        polled between dispatches, so the cancel window is bounded by
+        max_inflight × fuse micro-steps."""
+        fuse = self._fuse_steps
+        if fuse <= 1:
+            return 1
+        if (self._prefilling or self._pending is not None
+                or not self._admit.empty()):
+            return 1
+        left = fuse
+        for s in active:
+            req = self._slot_req[s]
+            if req is None:
+                return 1
+            left = min(left, req.max_new - self._dispatched[s])
+        if left <= 1:
+            return 1
+        return 1 << (min(left, fuse).bit_length() - 1)
+
+    def _collective_us(self) -> float:
+        """Per-launch all-reduce cost (µs) of the projection psum payload
+        on the live mesh, calibrated once and cached. Multiplied by the
+        structural counts of expected_overlap_split to charge each decode
+        record's exposed/hidden collective time — GSPMD/shard_map
+        collectives have no host-visible timestamps, so structural counts
+        × a same-mesh same-payload calibration is the honest attribution
+        (methodology in PERF.md)."""
+        us = self._coll_us
+        if us is None:
+            if self.mesh is None:
+                us = 0.0
+            else:
+                from tritonclient_tpu.parallel.overlap import (
+                    calibrate_collective_us,
+                )
+
+                shape = (self.max_slots,
+                         max(self.cfg.d_model
+                             // max(self._overlap_chunks, 1), 1))
+                us = calibrate_collective_us(self.mesh, shape,
+                                             self.cfg.dtype)
+            self._coll_us = us
+        return us
+
     def _release_cancelled(self):  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
         """A consumer that went away (stream closed) marks its request
         cancelled; its slot AND its KV pages free at the next loop top
@@ -949,6 +1099,9 @@ class GenerationEngine:
         # decode writes from the scratch page onto its real pages.
         for slot, st in done:
             del self._prefilling[slot]
+            # First token counts against the budget: decode dispatches
+            # owe max_new - 1 more (the fuse chooser reads this).
+            self._dispatched[slot] = 1
             for i in range(st.n_hit, len(st.hashes)):
                 self._prefix.register(st.hashes[i], st.blocks[i])
         firsts = jnp.concatenate([st.first for _, st in done])
@@ -1194,33 +1347,63 @@ class GenerationEngine:
             if not active:
                 self._dist.release_ticket()
                 continue
+            fuse = self._choose_fuse(active)
             scope = _stepscope.step_begin(
                 self._scope_name, _stepscope.PHASE_DECODE, step_seq,
                 batch_size=len(active), slots=self.max_slots,
             )
-            step_seq += 1
-            nxt, self._k, self._v = self._step(
-                self.params, self._k, self._v, self._btabs, self._tokens,
-                self._pos, self._seeds, self._steps, self._temps,
-                self._topks,
-            )
+            if scope is not None:
+                scope.micro_steps = fuse
+            step_seq += fuse
+            if fuse == 1:
+                toks, self._k, self._v = self._step(
+                    self.params, self._k, self._v, self._btabs,
+                    self._tokens, self._pos, self._seeds, self._steps,
+                    self._temps, self._topks,
+                )
+                self._tokens = toks
+                self._pos = self._pos + 1
+                self._steps = self._steps + 1
+            else:
+                # Fused window: one dispatch, [fuse, S] tokens, carry
+                # advanced on device (no per-step host enqueues).
+                (toks, self._tokens, self._pos, self._steps,
+                 self._k, self._v) = self._multi_step_fn(fuse)(
+                    self.params, self._k, self._v, self._btabs,
+                    self._tokens, self._pos, self._seeds, self._steps,
+                    self._temps, self._topks,
+                )
             _stepscope.step_dispatched(scope)
-            _stepscope.charge_collectives(scope, self._expected_collectives)
+            if scope is not None:
+                ops = self._expected_collectives if fuse == 1 else {
+                    op: c * fuse
+                    for op, c in self._expected_collectives.items()
+                }
+                hid_n, exp_n = self._overlap_split
+                if hid_n or exp_n:
+                    us = self._collective_us()
+                    _stepscope.charge_collectives(
+                        scope, ops,
+                        exposed_us=int(exp_n * fuse * us),
+                        hidden_us=int(hid_n * fuse * us),
+                    )
+                else:
+                    _stepscope.charge_collectives(scope, ops)
             try:
-                nxt.copy_to_host_async()
+                toks.copy_to_host_async()
             except AttributeError:
                 pass
-            self._tokens = nxt
-            self._pos = self._pos + 1
-            self._steps = self._steps + 1
+            for s in active:
+                self._dispatched[s] += fuse
             self._dist.submit(
-                nxt, [(s, s, self._slot_req[s]) for s in active
-                      if self._slot_req[s] is not None]
+                toks, [(s, s, self._slot_req[s]) for s in active
+                       if self._slot_req[s] is not None]
             )
+            _stepscope.inflight_update(self._scope_name, 1)
             # sync mode blocks on the step output here (true device time,
             # at the cost of the host/device overlap); counters mode only
             # stamps the clock.
-            _stepscope.step_end(scope, outputs=nxt)
+            _stepscope.step_end(scope, outputs=toks)
 
 
 class GptEngineModel(Model):

@@ -245,6 +245,19 @@ PREFIX_EVENTS = (
     PREFIX_EVENT_EVICT,
 )
 
+#: ``kind`` label vocabulary of ``nv_engine_collective_overlap_us_total``:
+#: collective time sitting on the engine step's critical path
+#: (``exposed``) vs hidden under the next chunk's matmul by the
+#: ``parallel/overlap.py`` chunked projections (``hidden``). Spelled here
+#: exactly once; ``_stepscope`` and ``check_metrics_exposition.py``
+#: mirror it with an import-or-fallback.
+OVERLAP_KIND_EXPOSED = "exposed"
+OVERLAP_KIND_HIDDEN = "hidden"
+OVERLAP_KINDS = (
+    OVERLAP_KIND_EXPOSED,
+    OVERLAP_KIND_HIDDEN,
+)
+
 #: Server-internal parameter key carrying a request's ``cancel_event``
 #: into engine-backed models (gpt/tp engines poll it between decode
 #: steps). Never on the wire: the front-ends strip/never accept it, and

@@ -53,6 +53,46 @@ def _jax():
     return jax
 
 
+# -- sharded upload pool ----------------------------------------------------- #
+# Mesh-sharded regions upload one slice per addressable device instead of
+# staging the whole buffer through one jax.device_put; the bounded pool
+# lets slice transfers proceed concurrently, so a region set scales with
+# the slowest slice rather than the sum. Sized by TPU_SHM_UPLOAD_WORKERS
+# (default: cpu count, capped) — on a single-core host the pool degrades
+# to the sequential per-slice loop, which still beats the staged path
+# (no full-buffer relayout on the host side).
+
+_upload_pool = None
+_upload_pool_lock = sanitize.named_lock("tpu_shared_memory:_upload_pool_lock")
+
+
+def _upload_workers() -> int:
+    raw = os.environ.get("TPU_SHM_UPLOAD_WORKERS", "").strip()
+    if raw:
+        try:
+            return max(int(raw), 1)
+        except ValueError:
+            pass
+    return max(min(os.cpu_count() or 1, 8), 1)
+
+
+def _get_upload_pool(workers: int):
+    global _upload_pool
+    with _upload_pool_lock:
+        if _upload_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _upload_pool = ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="tpu-shm-upload"
+            )
+        return _upload_pool
+
+
+def _parallel_upload_enabled() -> bool:
+    raw = os.environ.get("TPU_SHM_PARALLEL_UPLOAD", "").strip().lower()
+    return raw not in ("0", "off", "false", "no")
+
+
 def _np_dtype_for(datatype: str) -> np.dtype:
     if datatype == "BF16":
         import jax.numpy as jnp
@@ -540,13 +580,19 @@ class TpuSharedMemoryRegion:
             self._parked[offset] = arr
         return arr
 
-    def _replace_parked(self, offset: int, old, new):
+    def _replace_parked(self, offset: int, old, new, drop_nbytes=None):
         """CAS a parked entry (transfer coalescer: original -> bundle view).
 
         Only swaps when ``old`` is still the live entry — a racing writer
-        or reader-side repark wins and the bundle view is dropped."""
+        or reader-side repark wins and the bundle view is dropped.
+        ``drop_nbytes`` additionally evicts entries overlapping
+        ``[offset, offset + drop_nbytes)`` on a successful swap — the
+        fresh-park variant used when the upload happened outside the lock
+        against a possibly-absent prior entry."""
         with self._lock:
             if self._parked.get(offset) is old:
+                if drop_nbytes is not None:
+                    self._drop_overlapping(offset, drop_nbytes)
                 self._parked[offset] = new
                 return True
         return False
@@ -662,13 +708,58 @@ class TpuShardedMemoryRegion(TpuSharedMemoryRegion):
         self._mirror = bytearray(self.byte_size)
         self._destroyed = False
 
+    def _sharded_put(self, host: np.ndarray):
+        """Upload a host array one per-device slice at a time instead of
+        staging the full buffer through a single ``jax.device_put``.
+
+        The sharding's ``addressable_devices_indices_map`` names each
+        device's slice of the host array; slices transfer through the
+        bounded module pool concurrently (sequentially on a 1-worker
+        host — still cheaper than the staged path, which relayouts the
+        whole buffer host-side first) and reassemble zero-copy with
+        ``make_array_from_single_device_arrays``. Any geometry the slice
+        path cannot express (uneven shards, opaque dtypes) falls back to
+        the staged upload, which is always correct.
+        """
+        jax = _jax()
+        if not _parallel_upload_enabled():
+            return jax.device_put(host, self.sharding)
+        try:
+            idx_map = self.sharding.addressable_devices_indices_map(
+                host.shape
+            )
+            items = list(idx_map.items())
+            if len(items) <= 1:
+                return jax.device_put(host, self.sharding)
+            workers = min(_upload_workers(), len(items))
+            if workers > 1:
+                pool = _get_upload_pool(workers)
+                futs = [pool.submit(jax.device_put, host[idx], dev)
+                        for dev, idx in items]
+                shards = [f.result() for f in futs]
+            else:
+                shards = [jax.device_put(host[idx], dev)
+                          for dev, idx in items]
+            return jax.make_array_from_single_device_arrays(
+                host.shape, self.sharding, shards
+            )
+        except Exception:
+            return jax.device_put(host, self.sharding)
+
     def set_array(self, array, offset: int = 0, block: bool = True):
-        """Park an array sharded over the mesh (host or device producer)."""
+        """Park an array sharded over the mesh (host or device producer).
+
+        Host producers take the parallel per-slice upload path
+        (``_sharded_put``); device producers with a foreign layout go
+        through the resharding ``device_put`` (XLA moves device bytes
+        directly)."""
         if isinstance(array, BatchRowView):
             return self._park_view(array, offset)
         jax = _jax()
         if isinstance(array, jax.Array) and array.sharding == self.sharding:
             arr = array  # already laid out — parking is pure bookkeeping
+        elif isinstance(array, np.ndarray):
+            arr = self._sharded_put(array)
         else:
             arr = jax.device_put(array, self.sharding)
         if block:
@@ -681,8 +772,15 @@ class TpuShardedMemoryRegion(TpuSharedMemoryRegion):
 
     def as_array(self, datatype: str, shape: Sequence[int], offset: int = 0,
                  prefer_host: bool = False):
-        """A sharded jax.Array view of the region contents at ``offset``."""
-        jax = _jax()
+        """A sharded jax.Array view of the region contents at ``offset``.
+
+        Mirror-staged bytes re-upload per-device via ``_sharded_put``
+        OUTSIDE the region lock (the upload is the slow part, and holding
+        the lock across it would serialize every concurrent reader/writer
+        — same ADVICE r5 #5 discipline as the single-device plane), then
+        park through the ``_replace_parked`` CAS: a writer that raced the
+        upload wins and the fresh array is returned unparked.
+        """
         shape = tuple(int(s) for s in shape)
         np_dtype = _np_dtype_for(datatype)
         nbytes = math.prod(shape) * np_dtype.itemsize
@@ -694,15 +792,14 @@ class TpuShardedMemoryRegion(TpuSharedMemoryRegion):
                     return parked
                 # A dtype/shape reinterpretation cannot stay sharded in
                 # general; gather through the host mirror below instead.
+            stale = parked
         host = np.frombuffer(
             self.read_bytes(offset, nbytes), dtype=np_dtype
         ).reshape(shape)
         if prefer_host:
             return host
-        arr = jax.device_put(host, self.sharding)
-        with self._lock:
-            self._drop_overlapping(offset, nbytes)
-            self._parked[offset] = arr
+        arr = self._sharded_put(host)
+        self._replace_parked(offset, stale, arr, drop_nbytes=nbytes)
         return arr
 
     def __repr__(self):
