@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""CI gate: the tpulint baseline may only shrink, never grow.
+
+The baseline (scripts/tpulint_baseline.json) exists so pre-existing
+findings don't block unrelated work — but that makes it the one place a
+new violation could silently hide: regenerate the file with the new
+finding in it and CI goes green. This check closes that hole by
+comparing the working-tree baseline against the one committed on a base
+ref: every fingerprint must already exist there with a count no smaller
+than the current one. Resolved findings (entries removed or counts
+lowered) pass; new fingerprints or raised counts fail with the offending
+entries listed.
+
+Usage:
+    python scripts/check_baseline_shrink.py [--base REF]
+
+``--base`` defaults to ``origin/main``, falling back to ``HEAD`` when
+the ref does not resolve (shallow clones, first push). A base ref with
+no baseline file passes trivially — there is nothing to grow from.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_PATH = "scripts/tpulint_baseline.json"
+
+
+def _git_show(ref: str, path: str):
+    try:
+        out = subprocess.run(
+            ["git", "show", f"{ref}:{path}"],
+            capture_output=True, text=True, check=True, cwd=_REPO_ROOT,
+        ).stdout
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    return out
+
+
+def _counts(doc_text: str):
+    doc = json.loads(doc_text)
+    if doc.get("format") != "tpulint-baseline":
+        raise ValueError("not a tpulint baseline file")
+    return {str(k): int(v) for k, v in doc.get("findings", {}).items()}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--base", default="origin/main",
+        help="git ref holding the reference baseline (default: origin/main, "
+        "falling back to HEAD if it does not resolve)",
+    )
+    args = parser.parse_args(argv)
+
+    base_text = _git_show(args.base, BASELINE_PATH)
+    base_ref = args.base
+    if base_text is None and args.base != "HEAD":
+        base_text = _git_show("HEAD", BASELINE_PATH)
+        base_ref = "HEAD"
+    if base_text is None:
+        print(f"baseline-shrink: no baseline at {base_ref}; nothing to "
+              "compare, passing")
+        return 0
+
+    current_path = os.path.join(_REPO_ROOT, BASELINE_PATH)
+    if not os.path.exists(current_path):
+        print("baseline-shrink: baseline removed entirely — OK (maximal "
+              "shrink)")
+        return 0
+    with open(current_path, encoding="utf-8") as f:
+        current_text = f.read()
+
+    try:
+        base = _counts(base_text)
+        current = _counts(current_text)
+    except (ValueError, KeyError) as e:
+        print(f"baseline-shrink: malformed baseline: {e}", file=sys.stderr)
+        return 2
+
+    grown = []
+    for fp, count in sorted(current.items()):
+        if fp not in base:
+            grown.append(f"  NEW   {fp} (count {count})")
+        elif count > base[fp]:
+            grown.append(f"  GREW  {fp} ({base[fp]} -> {count})")
+    if grown:
+        print(f"baseline-shrink: baseline grew vs {base_ref} — fix the "
+              "findings instead of re-baselining them:", file=sys.stderr)
+        for line in grown:
+            print(line, file=sys.stderr)
+        return 1
+
+    resolved = len(base) - len(current)
+    print(f"baseline-shrink: OK vs {base_ref} "
+          f"({len(current)} entries, {max(resolved, 0)} resolved)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -186,7 +186,7 @@ def main():
                   f"p50~{p50s}ms p99max~{p99s}ms avg_batch~{avg_b} "
                   f"cpu/req {median(cpu_ms[name]):.2f}ms")
         if co is not None:
-            print("coalescer:", co.stats)
+            print("coalescer:", co.stats_snapshot())
     finally:
         for s in sessions:
             try:

@@ -1,7 +1,11 @@
 #!/usr/bin/env bash
 # Single static-analysis entry point shared by CI and tier-1.
 #
-#   scripts/run_static_checks.sh [--write-baseline] [--sanitize] [paths...]
+#   scripts/run_static_checks.sh [--write-baseline] [--sanitize] [--changed] [paths...]
+#
+# --changed is the pre-commit fast path: tpulint lints only git-touched
+# files against the cached whole-program call graph (<2 s warm), and the
+# other checks are skipped.
 #
 # --sanitize closes the static/dynamic loop: after the static checks it
 # runs the tpusan-instrumented tier-1 subset (TPUSAN=1, the runtime
@@ -10,7 +14,9 @@
 # and diffs it against the static picture with scripts/tpusan_report.py.
 #
 # Chains, in order:
-#   1. tpulint        — project-specific checks (TPU001..TPU008); see
+#   1. tpulint        — project-specific checks (TPU001..TPU010, incl. the
+#                       interprocedural TPU009 guarded-by race detection and
+#                       TPU010 JAX hot-path hazards); see
 #                       `python scripts/tpulint.py --list-rules`. Runs over
 #                       tritonclient_tpu/ + scripts/ + tests/ against the
 #                       committed baseline (scripts/tpulint_baseline.json):
@@ -40,10 +46,12 @@ BASELINE_FILE="scripts/tpulint_baseline.json"
 
 WRITE_BASELINE=0
 SANITIZE=0
+CHANGED=0
 while :; do
     case "${1:-}" in
         --write-baseline) WRITE_BASELINE=1; shift ;;
         --sanitize) SANITIZE=1; shift ;;
+        --changed) CHANGED=1; shift ;;
         *) break ;;
     esac
 done
@@ -83,8 +91,18 @@ TPULINT_ARGS=()
 if [ -f "${BASELINE_FILE}" ]; then
     TPULINT_ARGS+=(--baseline "${BASELINE_FILE}")
 fi
+if [ "${CHANGED}" -eq 1 ]; then
+    # Pre-commit fast path: changed files only, cached call graph, and
+    # nothing else — the full chain runs in CI.
+    exec "${PYTHON}" scripts/tpulint.py --changed \
+        "${TPULINT_ARGS[@]+"${TPULINT_ARGS[@]}"}" "${TPULINT_PATHS[@]}"
+fi
 run_check "tpulint" "${PYTHON}" scripts/tpulint.py \
     "${TPULINT_ARGS[@]+"${TPULINT_ARGS[@]}"}" "${TPULINT_PATHS[@]}"
+
+# 1b. Baseline may only shrink: new findings must be fixed, not recorded.
+run_check "tpulint-baseline-shrink" "${PYTHON}" \
+    scripts/check_baseline_shrink.py
 
 # 2. ruff — optional.
 if "${PYTHON}" -m ruff --version >/dev/null 2>&1; then

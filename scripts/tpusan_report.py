@@ -3,7 +3,9 @@
 
 Closing the static/dynamic loop needs an answer to three questions per
 paired rule (TPU001 async-blocking, TPU006 shm-lifecycle, TPU007
-lock-order):
+lock-order, TPU009 guarded-by — the Eraser lockset witness; TPU010 is
+diffed too, static-only, so its hot-path findings appear in the
+unexercised column rather than vanishing from the report):
 
 * **witnessed** — statically flagged AND observed at runtime: the static
   finding is real and the suite exercises it (these should be zero on a
@@ -42,7 +44,7 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
-DEFAULT_RULES = ("TPU001", "TPU006", "TPU007")
+DEFAULT_RULES = ("TPU001", "TPU006", "TPU007", "TPU009", "TPU010")
 
 
 def load_dynamic(path: str):
@@ -65,37 +67,13 @@ def run_static(paths, rules):
     ]
 
 
-def main(argv=None) -> int:
-    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    parser.add_argument(
-        "paths", nargs="*", default=["tritonclient_tpu", "scripts", "tests"],
-        help="paths for the static run (default: the tpulint scope)",
-    )
-    parser.add_argument(
-        "--dynamic", required=True, metavar="FILE",
-        help="tpusan report (JSON or SARIF) from a TPUSAN=1 suite run",
-    )
-    parser.add_argument(
-        "--rules", default=",".join(DEFAULT_RULES),
-        help="comma-separated rule ids to diff (default: the paired trio)",
-    )
-    parser.add_argument(
-        "--fail-on-witnessed", action="store_true",
-        help="exit 1 if any static finding was witnessed at runtime",
-    )
-    args = parser.parse_args(argv)
-    rules = {r.strip().upper() for r in args.rules.split(",") if r.strip()}
+def classify(static, dynamic):
+    """Split into (witnessed, unexercised, unpredicted) by (rule, file).
 
-    try:
-        dynamic = [
-            f for f in load_dynamic(args.dynamic) if f.get("rule") in rules
-        ]
-    except (OSError, ValueError) as e:
-        print(f"tpusan_report: cannot load dynamic report: {e}",
-              file=sys.stderr)
-        return 2
-    static = run_static(args.paths, rules)
-
+    witnessed: [(static_finding, [runtime records])]; unexercised:
+    static-only; unpredicted: runtime-only. Line-level matching is
+    deliberately avoided — see the module docstring.
+    """
     dyn_by_key = defaultdict(list)
     for f in dynamic:
         dyn_by_key[(f["rule"], f["path"])].append(f)
@@ -113,6 +91,89 @@ def main(argv=None) -> int:
         f for key, fs in sorted(dyn_by_key.items())
         if key not in matched_keys for f in fs
     ]
+    return witnessed, unexercised, unpredicted
+
+
+def self_check() -> int:
+    """Synthetic records with a known classification through all three
+    columns — the TPU009 pair mirrors what a real run produces: the
+    static guarded-by finding in a file plus the runtime empty-lockset
+    record from the same file."""
+    static = [
+        {"rule": "TPU009", "path": "pkg/a.py", "line": 10,
+         "message": "unguarded write to `self.count` (inferred guard "
+         "'A._lock')"},
+        {"rule": "TPU010", "path": "pkg/b.py", "line": 20,
+         "message": "device->host sync in hot path"},
+    ]
+    dynamic = [
+        {"rule": "TPU009", "path": "pkg/a.py", "line": 12,
+         "message": "unsynchronized shared access witnessed on "
+         "`A.count`: no common lock held across threads"},
+        {"rule": "TPU007", "path": "pkg/c.py", "line": 30,
+         "message": "lock-order cycle witnessed at runtime"},
+    ]
+    witnessed, unexercised, unpredicted = classify(static, dynamic)
+    failures = 0
+    if [f["path"] for f, _ in witnessed] != ["pkg/a.py"]:
+        print("self-check: TPU009 pair not classified as witnessed",
+              file=sys.stderr)
+        failures += 1
+    if [f["path"] for f in unexercised] != ["pkg/b.py"]:
+        print("self-check: static-only TPU010 not classified as "
+              "unexercised", file=sys.stderr)
+        failures += 1
+    if [f["path"] for f in unpredicted] != ["pkg/c.py"]:
+        print("self-check: dynamic-only TPU007 not classified as "
+              "unpredicted", file=sys.stderr)
+        failures += 1
+    if failures:
+        print(f"self-check: {failures} failure(s)", file=sys.stderr)
+        return 1
+    print("self-check: witnessed/unexercised/unpredicted columns recover "
+          "the seeded classification")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "paths", nargs="*", default=["tritonclient_tpu", "scripts", "tests"],
+        help="paths for the static run (default: the tpulint scope)",
+    )
+    parser.add_argument(
+        "--dynamic", metavar="FILE",
+        help="tpusan report (JSON or SARIF) from a TPUSAN=1 suite run",
+    )
+    parser.add_argument(
+        "--rules", default=",".join(DEFAULT_RULES),
+        help="comma-separated rule ids to diff (default: the paired set)",
+    )
+    parser.add_argument(
+        "--fail-on-witnessed", action="store_true",
+        help="exit 1 if any static finding was witnessed at runtime",
+    )
+    parser.add_argument(
+        "--self-check", action="store_true",
+        help="classify synthetic records with a known answer and exit",
+    )
+    args = parser.parse_args(argv)
+    if args.self_check:
+        return self_check()
+    if not args.dynamic:
+        parser.error("--dynamic is required (or --self-check)")
+    rules = {r.strip().upper() for r in args.rules.split(",") if r.strip()}
+
+    try:
+        dynamic = [
+            f for f in load_dynamic(args.dynamic) if f.get("rule") in rules
+        ]
+    except (OSError, ValueError) as e:
+        print(f"tpusan_report: cannot load dynamic report: {e}",
+              file=sys.stderr)
+        return 2
+    static = run_static(args.paths, rules)
+    witnessed, unexercised, unpredicted = classify(static, dynamic)
 
     def show(f):
         return f"  {f['path']}:{f.get('line', 1)}: {f['rule']} {f['message']}"

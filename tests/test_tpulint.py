@@ -1273,3 +1273,377 @@ def test_flow_sensitive_rules_run_clean_on_the_repo():
         [package_dir], select={"TPU006", "TPU007", "TPU008"}
     )
     assert findings == [], "\n".join(f.text() for f in findings)
+
+
+# --------------------------------------------------------------------------- #
+# TPU009 guarded-by (interprocedural lockset)                                 #
+# --------------------------------------------------------------------------- #
+
+
+GUARDED_BY_FIXTURE = """
+    import threading
+
+
+    class Gauge:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.value = 0
+            threading.Thread(target=self._run).start()
+
+        def _run(self):
+            with self._lock:
+                self.value += 1
+
+        def bump(self):
+            with self._lock:
+                self.value += 1
+
+        def scrape(self):
+            return self.value
+"""
+
+
+class TestGuardedBy:
+    def test_fires_on_read_outside_inferred_guard(self, tmp_path):
+        findings = lint(tmp_path, GUARDED_BY_FIXTURE, select={"TPU009"})
+        assert rules_of(findings) == ["TPU009"]
+        msg = findings[0].message
+        assert "read of `Gauge.value`" in msg
+        assert "`Gauge._lock`" in msg
+        assert "held at 2/2 writes" in msg
+        assert "witness:" in msg
+
+    def test_consistently_guarded_is_clean(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            GUARDED_BY_FIXTURE.replace(
+                "def scrape(self):\n            return self.value",
+                "def scrape(self):\n            with self._lock:\n"
+                "                return self.value",
+            ),
+            select={"TPU009"},
+        )
+        assert findings == []
+
+    def test_majority_vote_flags_the_minority_write(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import threading
+
+
+            class Gauge:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.value = 0
+                    threading.Thread(target=self._run).start()
+
+                def _run(self):
+                    with self._lock:
+                        self.value += 1
+
+                def bump(self):
+                    with self._lock:
+                        self.value += 1
+
+                def sneak(self):
+                    self.value += 1
+            """,
+            select={"TPU009"},
+        )
+        assert rules_of(findings) == ["TPU009"]
+        assert "write to `Gauge.value`" in findings[0].message
+        assert "held at 2/3 writes" in findings[0].message
+
+    def test_interprocedural_caller_held_lock_counts(self, tmp_path):
+        """A private helper whose every call site holds the lock gets
+        entry-lockset credit — the 'caller holds the lock' shape that a
+        purely lexical checker would flag."""
+        findings = lint(
+            tmp_path,
+            """
+            import threading
+
+
+            class Gauge:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.value = 0
+                    threading.Thread(target=self._run).start()
+
+                def _run(self):
+                    with self._lock:
+                        self._apply()
+
+                def bump(self):
+                    with self._lock:
+                        self._apply()
+
+                def _apply(self):
+                    self.value += 1
+            """,
+            select={"TPU009"},
+        )
+        assert findings == []
+
+    def test_no_thread_escape_is_clean(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            class Gauge:
+                def __init__(self):
+                    self.value = 0
+
+                def bump(self):
+                    self.value += 1
+
+                def scrape(self):
+                    return self.value
+            """,
+            select={"TPU009"},
+        )
+        assert findings == []
+
+    def test_def_line_suppression_covers_the_access(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            GUARDED_BY_FIXTURE.replace(
+                "def scrape(self):",
+                "def scrape(self):  # tpulint: disable=TPU009",
+            ),
+            select={"TPU009"},
+        )
+        assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# TPU010 jax-hot-path hazards                                                 #
+# --------------------------------------------------------------------------- #
+
+
+HOT_SYNC_FIXTURE = """
+    import jax.numpy as jnp
+    import numpy as np
+
+
+    # tpulint: hot-path
+    def decode_loop(n):
+        token = jnp.zeros((1,), jnp.int32)
+        out = None
+        for _ in range(n):
+            token = jnp.tanh(token)
+            out = np.asarray(token)
+        return out
+"""
+
+
+class TestJaxHotPath:
+    def test_fires_on_sync_in_hot_loop(self, tmp_path):
+        findings = lint(tmp_path, HOT_SYNC_FIXTURE, select={"TPU010"})
+        assert rules_of(findings) == ["TPU010"]
+        msg = findings[0].message
+        assert "device->host sync" in msg
+        assert "inside a loop" in msg
+
+    def test_cold_path_is_clean(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            HOT_SYNC_FIXTURE.replace("# tpulint: hot-path", ""),
+            select={"TPU010"},
+        )
+        assert findings == []
+
+    def test_hotness_propagates_through_the_call_graph(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+
+            def _materialize(token: jax.Array):
+                return np.asarray(token)
+
+
+            # tpulint: hot-path
+            def decode_loop(n):
+                token = jnp.zeros((1,), jnp.int32)
+                return _materialize(token)
+            """,
+            select={"TPU010"},
+        )
+        assert rules_of(findings) == ["TPU010"]
+        assert "hot via `fixture:decode_loop`" in findings[0].message
+
+    def test_fires_on_retrace_signature_drift(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import jax
+
+
+            def impl(x, k):
+                return x
+
+
+            # tpulint: hot-path
+            def sweep(xs):
+                fn = jax.jit(impl, static_argnums=(1,))
+                for i in range(len(xs)):
+                    fn(xs[i], i)
+            """,
+            select={"TPU010"},
+        )
+        msgs = [f.message for f in findings]
+        assert any("retrace trigger" in m and "static" in m for m in msgs)
+
+    def test_memoized_builder_is_not_a_retrace_trigger(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import functools
+
+            import jax
+
+
+            @functools.lru_cache(maxsize=4)
+            def build(cfg):
+                return jax.jit(lambda x: x)
+
+
+            _CACHE = {}
+
+
+            def cached(cfg):
+                if cfg not in _CACHE:
+                    _CACHE[cfg] = jax.jit(lambda x: x)
+                return _CACHE[cfg]
+
+
+            # tpulint: hot-path
+            def decode_loop(cfg, x):
+                return build(cfg)(x) + cached(cfg)(x)
+            """,
+            select={"TPU010"},
+        )
+        assert findings == []
+
+    def test_fires_on_unguarded_jit_in_hot_body(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import jax
+
+
+            # tpulint: hot-path
+            def step(x):
+                fn = jax.jit(lambda y: y)
+                return fn(x)
+            """,
+            select={"TPU010"},
+        )
+        assert rules_of(findings) == ["TPU010"]
+        assert "retraces on every call" in findings[0].message
+
+    def test_inline_suppression_documents_the_designed_readback(
+            self, tmp_path):
+        findings = lint(
+            tmp_path,
+            HOT_SYNC_FIXTURE.replace(
+                "out = np.asarray(token)",
+                "out = np.asarray(token)  # tpulint: disable=TPU010",
+            ),
+            select={"TPU010"},
+        )
+        assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# --changed + call-graph cache (the pre-commit path)                          #
+# --------------------------------------------------------------------------- #
+
+
+class TestChangedAndCache:
+    def _git(self, repo, *argv):
+        import subprocess
+
+        subprocess.run(
+            ["git", "-c", "user.email=t@example.com", "-c", "user.name=t",
+             *argv],
+            cwd=repo, check=True, capture_output=True,
+        )
+
+    def test_changed_lints_only_touched_files(self, tmp_path, monkeypatch,
+                                              capsys):
+        import textwrap
+
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "clean.py").write_text("X = 1\n")
+        # A committed violation: --changed must NOT see it.
+        (pkg / "old.py").write_text(textwrap.dedent(
+            """
+            import time
+
+            async def handler():
+                time.sleep(1)
+            """
+        ))
+        self._git(tmp_path, "init", "-q")
+        self._git(tmp_path, "add", ".")
+        self._git(tmp_path, "commit", "-q", "-m", "seed")
+        monkeypatch.chdir(tmp_path)
+
+        rc = main(["--changed", "--select", "TPU001", "pkg"])
+        assert rc == 0
+        assert "no changed files" in capsys.readouterr().out
+
+        # A new violation in the working tree IS seen.
+        (pkg / "fresh.py").write_text(textwrap.dedent(
+            """
+            import time
+
+            async def go():
+                time.sleep(1)
+            """
+        ))
+        rc = main(["--changed", "--select", "TPU001", "pkg"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "fresh.py" in out
+        assert "old.py" not in out
+
+    def test_callgraph_cache_round_trips(self, tmp_path, monkeypatch,
+                                         capsys):
+        import textwrap
+
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(textwrap.dedent(GUARDED_BY_FIXTURE))
+        monkeypatch.chdir(tmp_path)
+        cache = tmp_path / "cache" / "callgraph.json"
+
+        rc1 = main(["--select", "TPU009", "--callgraph-cache", str(cache),
+                    "pkg"])
+        out1 = capsys.readouterr().out
+        assert rc1 == 1 and cache.exists()
+
+        rc2 = main(["--select", "TPU009", "--callgraph-cache", str(cache),
+                    "pkg"])
+        out2 = capsys.readouterr().out
+        assert rc2 == 1
+        assert out1 == out2  # cached summaries reproduce the findings
+
+
+def test_interprocedural_rules_run_clean_on_the_repo():
+    """The acceptance gate for the call-graph layer: TPU009 and TPU010
+    exit 0 over the package after the race/hazard fixes and documented
+    suppressions."""
+    import tritonclient_tpu
+
+    package_dir = os.path.dirname(tritonclient_tpu.__file__)
+    findings, _ = run_analysis(
+        [package_dir], select={"TPU009", "TPU010"}
+    )
+    assert findings == [], "\n".join(f.text() for f in findings)

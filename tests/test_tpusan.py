@@ -483,3 +483,140 @@ def test_served_shm_round_trip_is_clean_under_tpusan(tpusan):
         tpushm.destroy_shared_memory_region(out_region)
     sanitize.check_leaks()
     assert [f.text() for f in tpusan.findings] == []
+
+
+# --------------------------------------------------------------------------- #
+# lockset witness (TPU009)                                                    #
+# --------------------------------------------------------------------------- #
+
+
+class TestLocksetWitness:
+    """Runtime side of the TPU009 guarded-by rule: Eraser refinement over
+    the named locks at explicit ``note_field_access`` sites."""
+
+    class _Shared:
+        pass
+
+    def test_seeded_unguarded_counter_is_caught(self, tpusan):
+        """The pre-fix fleet bug, reconstructed: one thread mutates a
+        counter under the set lock, another touches it lock-free — the
+        candidate lockset empties and the witness reports the race the
+        static pass also flags on such code."""
+        lock = sanitize.named_lock("seed.set_lock")
+        obj = self._Shared()
+
+        with lock:
+            sanitize.note_field_access(obj, "outstanding")
+
+        def scraper():
+            sanitize.note_field_access(obj, "outstanding", write=False)
+
+        t = threading.Thread(target=scraper)
+        t.start(); t.join()
+
+        races = [f for f in tpusan.findings if f.rule == "TPU009"]
+        assert len(races) == 1
+        assert "`_Shared.outstanding`" in races[0].message
+        assert "empty lockset" in races[0].message
+        assert races[0].path == "tests/test_tpusan.py"
+        rec = [r for r in tpusan.records if r["rule"] == "TPU009"][0]
+        assert len(rec["stacks"]) >= 2  # first access + racing access
+
+    def test_consistently_guarded_counter_is_clean(self, tpusan):
+        lock = sanitize.named_lock("seed.guarded_lock")
+        obj = self._Shared()
+
+        with lock:
+            sanitize.note_field_access(obj, "count")
+
+        def worker():
+            with lock:
+                sanitize.note_field_access(obj, "count")
+
+        t = threading.Thread(target=worker)
+        t.start(); t.join()
+        assert [f for f in tpusan.findings if f.rule == "TPU009"] == []
+
+    def test_read_read_sharing_is_benign(self, tpusan):
+        """≥2 threads but no write after the exclusive phase: an empty
+        lockset alone is not a race."""
+        obj = self._Shared()
+        sanitize.note_field_access(obj, "config", write=False)
+
+        def reader():
+            sanitize.note_field_access(obj, "config", write=False)
+
+        t = threading.Thread(target=reader)
+        t.start(); t.join()
+        assert [f for f in tpusan.findings if f.rule == "TPU009"] == []
+
+    def test_single_thread_init_writes_do_not_poison(self, tpusan):
+        """Lock-free construction-time writes are the canonical benign
+        publication: only the lockset at the *latest* exclusive access
+        carries into the shared phase."""
+        lock = sanitize.named_lock("seed.pub_lock")
+        obj = self._Shared()
+        sanitize.note_field_access(obj, "state")  # init, no lock
+        with lock:
+            sanitize.note_field_access(obj, "state")  # publication point
+
+        def worker():
+            with lock:
+                sanitize.note_field_access(obj, "state")
+
+        t = threading.Thread(target=worker)
+        t.start(); t.join()
+        assert [f for f in tpusan.findings if f.rule == "TPU009"] == []
+
+    def test_static_finding_is_confirmed_dynamically(self, tpusan, tmp_path):
+        """End-to-end static/dynamic agreement: the same seeded pattern
+        fires TPU009 in tpulint AND in the runtime witness, and the
+        report classifier pairs them as witnessed."""
+        import textwrap
+
+        from tritonclient_tpu.analysis import run_analysis
+
+        fixture = tmp_path / "seeded_race.py"
+        fixture.write_text(textwrap.dedent(
+            """
+            import threading
+
+
+            class Gauge:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.value = 0
+                    threading.Thread(target=self._run).start()
+
+                def _run(self):
+                    with self._lock:
+                        self.value += 1
+
+                def bump(self):
+                    with self._lock:
+                        self.value += 1
+
+                def scrape(self):
+                    return self.value
+            """
+        ))
+        static, _ = run_analysis([str(fixture)], select={"TPU009"})
+        assert len(static) == 1
+        assert "`Gauge.value`" in static[0].message
+        assert "`Gauge._lock`" in static[0].message
+
+        # Execute the same discipline violation under the witness.
+        lock = sanitize.named_lock("Gauge._lock")
+        gauge = self._Shared()
+        with lock:
+            sanitize.note_field_access(gauge, "value", label="Gauge.value")
+
+        def scrape():
+            sanitize.note_field_access(
+                gauge, "value", write=False, label="Gauge.value")
+
+        t = threading.Thread(target=scrape)
+        t.start(); t.join()
+        dynamic = [f for f in tpusan.findings if f.rule == "TPU009"]
+        assert len(dynamic) == 1
+        assert "`Gauge.value`" in dynamic[0].message

@@ -258,6 +258,10 @@ def enabled() -> bool:
     return _mode != MODE_OFF
 
 
+# Benign mode publication: a single str rebind (GIL-atomic) set at
+# process/test setup; engine threads that race it record under the old
+# mode for at most one step.
+# tpulint: disable=TPU009 - benign single-rebind mode publication
 def configure(new_mode: Optional[str] = None) -> str:
     """Set the mode explicitly (tests / benches), or re-read the
     environment when called with None. Returns the active mode."""
@@ -318,7 +322,9 @@ def step_end(rec: Optional[StepRecord], outputs=None):
         try:
             import jax
 
-            jax.block_until_ready(outputs)
+            # MODE_SYNC is the opt-in measurement mode: this barrier IS
+            # the device-time probe (off by default; see mode()).
+            jax.block_until_ready(outputs)  # tpulint: disable=TPU010
             device_ns = time.monotonic_ns() - t0
         except Exception:
             device_ns = -1

@@ -1,9 +1,10 @@
 """tpulint — project-specific static analysis for the TPU serving stack.
 
-Eight check families tuned to the bug classes this codebase's surfaces
+Ten check families tuned to the bug classes this codebase's surfaces
 actually grow (two protocol front-ends, sync+aio clients, a threaded
 server core, a DLPack/shm registry). TPU001–TPU005 are AST-local;
-TPU006–TPU008 are flow- and project-sensitive:
+TPU006–TPU008 are flow- and project-sensitive; TPU009–TPU010 are
+interprocedural over the whole-program call graph (``_callgraph.py``):
 
 =======  =================  ====================================================
 rule     name               catches
@@ -32,13 +33,29 @@ TPU007   lock-order         cycles in the project-wide lock-acquisition
 TPU008   protocol-drift     wire keys built by a plane's client but not
                             parsed by its server front-end (or vice versa);
                             incomplete shared-memory key trios
+TPU009   guarded-by         Eraser-style static lockset race detection:
+                            thread entry points are discovered
+                            (``threading.Thread``, executor submit/map,
+                            ``run_in_executor``), each attribute escaping
+                            to ≥2 threads gets its guard inferred by
+                            majority vote over lock-held writes, and
+                            accesses outside that guard are reported with
+                            the inferred guard + witness path
+TPU010   jax-hot-path       device→host syncs (``np.asarray``/``float``/
+                            ``.item()``/bool-branching on device arrays,
+                            ``block_until_ready``) and retrace triggers
+                            (jit built per call, static-arg drift) on any
+                            function reachable from a ``# tpulint:
+                            hot-path`` annotated root
 =======  =================  ====================================================
 
 Suppress a deliberate violation with ``# tpulint: disable=TPU001`` (comma
 list allowed) on the offending line, or on a ``def``/``class`` line to
 cover the whole body; ``# tpulint: disable-file=TPU003`` anywhere in a file
-covers the file. Project-wide rules (TPU004/007/008) honor the same
-syntax at the line their finding points to.
+covers the file. Project-wide rules (TPU004/007–010) honor the same
+syntax at the line their finding points to. Mark a hot root with
+``# tpulint: hot-path`` on (or immediately above) its ``def`` line —
+TPU010 treats everything call-graph-reachable from it as hot.
 
 Run ``python -m tritonclient_tpu.analysis <paths>`` (exit 1 on findings).
 ``--format json|sarif`` selects machine-readable output (SARIF 2.1.0 for
@@ -46,7 +63,8 @@ GitHub code scanning), ``--baseline FILE`` fails only on findings absent
 from a recorded baseline, ``--write-baseline FILE`` records one, and
 ``--fix`` applies the mechanical rewrites (TPU003 literal → constant,
 TPU001 ``time.sleep`` → ``await asyncio.sleep`` on async paths) and
-re-lints.
+re-lints. ``--changed`` lints only git-touched files against the cached
+whole-program call graph (``--callgraph-cache``) — the pre-commit path.
 """
 
 from tritonclient_tpu.analysis._engine import (  # noqa: F401
@@ -71,6 +89,36 @@ __all__ = [
     "render_text",
     "run_analysis",
 ]
+
+
+def _git_changed_files(paths):
+    """Python files under ``paths`` that git reports as modified vs HEAD
+    (staged or not) or untracked. Empty list when nothing changed or git
+    is unavailable (the caller then lints nothing, succeeding fast)."""
+    import os
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD", "--"],
+            capture_output=True, text=True, check=True,
+        ).stdout
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            capture_output=True, text=True, check=True,
+        ).stdout
+    except (OSError, subprocess.CalledProcessError):
+        return []
+    roots = [os.path.normpath(p) for p in paths]
+    changed = []
+    for line in (out + untracked).splitlines():
+        f = line.strip()
+        if not f.endswith(".py") or not os.path.exists(f):
+            continue
+        norm = os.path.normpath(f)
+        if any(norm == r or norm.startswith(r + os.sep) for r in roots):
+            changed.append(f)
+    return sorted(set(changed))
 
 
 def main(argv=None) -> int:
@@ -111,6 +159,19 @@ def main(argv=None) -> int:
         help="apply mechanical fixes (TPU001 async sleep, TPU003 literal "
         "rewrites), then re-lint and report what remains",
     )
+    parser.add_argument(
+        "--changed", action="store_true",
+        help="lint only files git reports as touched (working tree vs "
+        "HEAD, plus untracked), restricted to the given paths; the "
+        "interprocedural rules still see the whole project through the "
+        "call-graph scope + cache, so this is the <2 s pre-commit path",
+    )
+    parser.add_argument(
+        "--callgraph-cache", metavar="FILE", default=None,
+        help="persist per-file call-graph summaries here (implied by "
+        "--changed: .tpulint_cache/callgraph.json); unchanged files are "
+        "loaded instead of re-summarized",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -122,16 +183,37 @@ def main(argv=None) -> int:
         {r.strip().upper() for r in args.select.split(",") if r.strip()}
         or None
     )
-    findings, files_checked = run_analysis(args.paths, select=select)
 
-    if args.fix:
-        from tritonclient_tpu.analysis._fix import apply_fixes
+    from tritonclient_tpu.analysis import _callgraph
 
-        applied = apply_fixes(findings)
-        for path, count in sorted(applied.items()):
-            noun = "fix" if count == 1 else "fixes"
-            print(f"tpulint: applied {count} {noun} in {path}", file=sys.stderr)
-        findings, files_checked = run_analysis(args.paths, select=select)
+    cache = args.callgraph_cache
+    lint_paths = list(args.paths)
+    scope = None
+    if args.changed:
+        cache = cache or ".tpulint_cache/callgraph.json"
+        # The whole-program substrate still covers the full lint scope —
+        # a changed callee must be judged against its unchanged callers.
+        scope = lint_paths
+        lint_paths = _git_changed_files(lint_paths)
+        if not lint_paths:
+            print("tpulint: 0 findings in 0 files (no changed files)")
+            return 0
+    prev = dict(_callgraph._CONFIG)
+    _callgraph.configure(cache_path=cache, scope=scope)
+    try:
+        findings, files_checked = run_analysis(lint_paths, select=select)
+
+        if args.fix:
+            from tritonclient_tpu.analysis._fix import apply_fixes
+
+            applied = apply_fixes(findings)
+            for path, count in sorted(applied.items()):
+                noun = "fix" if count == 1 else "fixes"
+                print(f"tpulint: applied {count} {noun} in {path}",
+                      file=sys.stderr)
+            findings, files_checked = run_analysis(lint_paths, select=select)
+    finally:
+        _callgraph.configure(**prev)
 
     if args.write_baseline:
         from tritonclient_tpu.analysis._baseline import write_baseline

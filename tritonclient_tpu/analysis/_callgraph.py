@@ -1,0 +1,1475 @@
+"""Whole-program call-graph + thread-escape substrate for tpulint v3.
+
+TPU009 (guarded-by race detection) and TPU010 (JAX hot-path hazards) are
+interprocedural: both need to know who calls whom, which functions run on
+which threads, and which locks are held *at entry* to a function — facts
+no single ``FileContext`` carries. This module builds that substrate once
+per lint run and shares it between the two rules:
+
+* **Per-file summaries** (``summarize_file``) — declarations (classes,
+  lock attributes, instance-attribute types, jitted attributes, mutable
+  module globals) plus per-function facts: resolved call edges with the
+  lexically-held lockset at each call site, ``self.*``/typed-receiver
+  attribute accesses (read/write, held locks), thread spawn sites
+  (``threading.Thread(target=...)``, executor ``submit``/``map``,
+  ``run_in_executor``, ``threading.Timer``), JAX hazard candidates
+  (device→host syncs, ``block_until_ready``, jit-in-body, jit static-arg
+  drift), and the ``# tpulint: hot-path`` annotation.
+* **Graph assembly** (``CallGraph``) — thread roots from spawn targets,
+  per-root reachability, "which threads can run this function" sets
+  (``main`` plus one identity per spawn target), and a decreasing
+  fixpoint for held-at-entry locksets:
+  ``entry(f) = ∩ over call sites (held(site) ∪ entry(caller))``, with
+  public functions and spawn targets pinned to the empty set (anyone may
+  call them lock-free). An access's *effective* lockset is its lexical
+  locks ∪ ``entry`` of its function — the interprocedural step that keeps
+  ``fleet/_policy.py``-style "caller holds the router lock" helpers from
+  being false positives.
+* **A sha1-keyed JSON cache** — summaries are serializable; the cache
+  stores per-file declarations and function facts keyed by source sha1,
+  with function facts additionally guarded by a digest over the *merged*
+  project declarations (cross-file resolution inputs). ``--changed``
+  re-summarizes only edited files and rebuilds the graph from cache,
+  keeping the pre-commit path under two seconds.
+
+Summaries are best-effort static facts, deliberately conservative in the
+same places TPU007 is: dynamic call targets that cannot be resolved drop
+out of the graph (no edge) rather than guessing.
+"""
+
+import ast
+import hashlib
+import json
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tritonclient_tpu.analysis._engine import (
+    FileContext,
+    discover_files,
+)
+
+#: Lock factories (mirrors TPU007): values are the declaration kind.
+_LOCK_FACTORIES = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+    "asyncio.Lock": "Lock",
+    "asyncio.Condition": "Condition",
+    "tritonclient_tpu.sanitize.named_lock": "Lock",
+    "tritonclient_tpu.sanitize.named_rlock": "RLock",
+    "tritonclient_tpu.sanitize.named_condition": "Condition",
+}
+
+#: Constructors whose instances synchronize internally — attributes of
+#: these types never need a guarding lock and are exempt from TPU009.
+_SELF_SYNC_FACTORIES = {
+    "queue.Queue",
+    "queue.SimpleQueue",
+    "queue.LifoQueue",
+    "queue.PriorityQueue",
+    "threading.Event",
+    "threading.Semaphore",
+    "threading.BoundedSemaphore",
+    "threading.Barrier",
+    "threading.local",
+    "concurrent.futures.ThreadPoolExecutor",
+    "concurrent.futures.ProcessPoolExecutor",
+}
+
+#: Container-mutating method names (write through a method call) —
+#: mirrors TPU002's convention.
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "remove", "pop",
+    "popleft", "popitem", "clear", "update", "setdefault", "add",
+    "discard", "sort",
+}
+
+#: Methods whose writes are construction/teardown, not shared-state races.
+_INIT_METHODS = {"__init__", "__post_init__", "__del__", "__enter__"}
+
+#: jax.Array attribute reads that touch metadata only — never force a
+#: device→host transfer (shape/dtype introspection is host-side).
+_DEVICE_METADATA_ATTRS = {
+    "shape", "dtype", "ndim", "size", "nbytes", "sharding", "at",
+    "weak_type", "itemsize",
+}
+
+#: Call prefixes whose results live on device (taint sources).
+_DEVICE_CALL_PREFIXES = ("jax.", "jax.numpy.", "jax.lax.", "jax.random.")
+
+#: Host-coercion callables that force a device→host sync on jax.Array
+#: arguments.
+_HOST_COERCERS = {"numpy.asarray", "numpy.array", "float", "int", "bool"}
+
+#: Device-array methods that force a sync.
+_SYNC_METHODS = {"item", "tolist", "__array__"}
+
+_HOT_RE = re.compile(r"#\s*tpulint:\s*hot-path\b")
+
+CACHE_VERSION = 4
+
+
+def modkey_for(path: str) -> str:
+    """File stem used in function/lock keys (``__init__.py`` maps to its
+    package directory name) — identical to TPU007's convention."""
+    stem = os.path.basename(path)
+    if stem == "__init__.py":
+        stem = os.path.basename(os.path.dirname(path)) or stem
+    return stem[:-3] if stem.endswith(".py") else stem
+
+
+# ---------------------------------------------------------------------------
+# summary records (JSON-native: plain dicts/lists, light wrapper classes)
+# ---------------------------------------------------------------------------
+
+
+class Access:
+    """One read/write of a shared attribute.
+
+    ``owner`` is a class name or a module key (module globals); ``locks``
+    is the lexically-held lockset at the access site.
+    """
+
+    __slots__ = ("owner", "attr", "write", "locks", "line", "col", "in_init")
+
+    def __init__(self, owner, attr, write, locks, line, col, in_init):
+        self.owner = owner
+        self.attr = attr
+        self.write = write
+        self.locks = tuple(locks)
+        self.line = line
+        self.col = col
+        self.in_init = in_init
+
+    def to_json(self):
+        return [self.owner, self.attr, int(self.write), list(self.locks),
+                self.line, self.col, int(self.in_init)]
+
+    @classmethod
+    def from_json(cls, row):
+        return cls(row[0], row[1], bool(row[2]), row[3], row[4], row[5],
+                   bool(row[6]))
+
+
+class Hazard:
+    """One JAX hazard candidate (classified by TPU010 if the function is
+    hot-reachable). ``kind`` ∈ host-sync | bool-sync | block-sync |
+    jit-in-body | static-drift; ``guarded`` marks cache-miss-guarded jit
+    construction (``if key not in cache: jit(...)``) which is benign."""
+
+    __slots__ = ("kind", "detail", "line", "col", "in_loop", "guarded")
+
+    def __init__(self, kind, detail, line, col, in_loop, guarded=False):
+        self.kind = kind
+        self.detail = detail
+        self.line = line
+        self.col = col
+        self.in_loop = in_loop
+        self.guarded = guarded
+
+    def to_json(self):
+        return [self.kind, self.detail, self.line, self.col,
+                int(self.in_loop), int(self.guarded)]
+
+    @classmethod
+    def from_json(cls, row):
+        return cls(row[0], row[1], row[2], row[3], bool(row[4]), bool(row[5]))
+
+
+class FunctionSummary:
+    __slots__ = ("key", "path", "line", "cls", "name", "public", "hot",
+                 "is_spawn_site", "calls", "accesses", "spawns", "hazards")
+
+    def __init__(self, key, path, line, cls_name, name, public, hot):
+        self.key = key
+        self.path = path
+        self.line = line
+        self.cls = cls_name
+        self.name = name
+        self.public = public
+        self.hot = hot
+        # [(callee_key, (held locks...), line)]
+        self.calls: List[Tuple[str, Tuple[str, ...], int]] = []
+        self.accesses: List[Access] = []
+        # [(target_key or None, kind, line)]
+        self.spawns: List[Tuple[Optional[str], str, int]] = []
+        self.hazards: List[Hazard] = []
+
+    def to_json(self):
+        return {
+            "key": self.key, "path": self.path, "line": self.line,
+            "cls": self.cls, "name": self.name,
+            "public": int(self.public), "hot": int(self.hot),
+            "calls": [[c, list(h), ln] for c, h, ln in self.calls],
+            "accesses": [a.to_json() for a in self.accesses],
+            "spawns": [[t, k, ln] for t, k, ln in self.spawns],
+            "hazards": [h.to_json() for h in self.hazards],
+        }
+
+    @classmethod
+    def from_json(cls, d):
+        fn = cls(d["key"], d["path"], d["line"], d["cls"], d["name"],
+                 bool(d["public"]), bool(d["hot"]))
+        fn.calls = [(c, tuple(h), ln) for c, h, ln in d["calls"]]
+        fn.accesses = [Access.from_json(r) for r in d["accesses"]]
+        fn.spawns = [(t, k, ln) for t, k, ln in d["spawns"]]
+        fn.hazards = [Hazard.from_json(r) for r in d["hazards"]]
+        return fn
+
+
+# ---------------------------------------------------------------------------
+# pass 1: declarations (file-local, cacheable by source sha alone)
+# ---------------------------------------------------------------------------
+
+
+def extract_decls(ctx: FileContext) -> dict:
+    """Declaration facts other files' summaries may depend on."""
+    modkey = modkey_for(ctx.path)
+    decls = {
+        "modkey": modkey,
+        "classes": [],
+        "class_locks": {},    # cls -> {attr: lock key}
+        "lock_kinds": {},     # lock key -> Lock|RLock|Condition
+        "attr_types": {},     # cls -> {attr: class name}
+        "attr_elem_types": {},  # cls -> {attr: element class of container}
+        "class_methods": {},  # cls -> [method names]
+        "exempt_attrs": {},   # cls -> [attr] (self-synchronizing types)
+        "jit_attrs": {},      # cls -> {attr: has_static_args}
+        "return_types": {},   # fn key -> [class name, is_element_of_list]
+        "module_globals": [],  # mutable module-level names
+    }
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign):
+            kind = _lock_factory_kind(ctx, node.value)
+            mutable = _is_mutable_literal(ctx, node.value)
+            for tgt in node.targets:
+                if not isinstance(tgt, ast.Name):
+                    continue
+                if kind:
+                    decls["lock_kinds"][f"{modkey}:{tgt.id}"] = kind
+                elif mutable:
+                    decls["module_globals"].append(tgt.id)
+    for cls in [n for n in ast.walk(ctx.tree) if isinstance(n, ast.ClassDef)]:
+        decls["classes"].append(cls.name)
+        locks = decls["class_locks"].setdefault(cls.name, {})
+        types = decls["attr_types"].setdefault(cls.name, {})
+        elem_types = decls["attr_elem_types"].setdefault(cls.name, {})
+        exempt = decls["exempt_attrs"].setdefault(cls.name, [])
+        jits = decls["jit_attrs"].setdefault(cls.name, {})
+        methods = decls["class_methods"].setdefault(cls.name, [])
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            methods.append(meth.name)
+            ret = _annotation_class(meth.returns)
+            if ret:
+                decls["return_types"][f"{cls.name}.{meth.name}"] = list(ret)
+            ptypes = _param_types(meth)
+            for node in ast.walk(meth):
+                if (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id in ptypes):
+                    for tgt in node.targets:
+                        if _is_self_attr(tgt):
+                            types[tgt.attr] = ptypes[node.value.id]
+        for node in ast.walk(cls):
+            if isinstance(node, ast.AnnAssign) and _is_self_attr(
+                    node.target):
+                got = _annotation_class(node.annotation)
+                if got:
+                    if got[1]:
+                        elem_types[node.target.attr] = got[0]
+                    else:
+                        types[node.target.attr] = got[0]
+                continue
+            if not isinstance(node, ast.Assign):
+                continue
+            kind = _lock_factory_kind(ctx, node.value)
+            selfsync = _call_name_in(ctx, node.value, _SELF_SYNC_FACTORIES)
+            jit = _jit_factory(ctx, node.value)
+            ctor = _ctor_class(ctx, node.value)
+            for tgt in node.targets:
+                if _is_self_attr(tgt):
+                    if kind:
+                        key = f"{cls.name}.{tgt.attr}"
+                        locks[tgt.attr] = key
+                        decls["lock_kinds"][key] = kind
+                    elif selfsync:
+                        exempt.append(tgt.attr)
+                    elif jit is not None:
+                        jits[tgt.attr] = jit
+                    elif ctor:
+                        types[tgt.attr] = ctor
+                elif isinstance(tgt, ast.Subscript) and ctor:
+                    base = tgt.value
+                    if _is_self_attr(base):
+                        types[base.attr] = ctor
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Global):
+                    for name in sub.names:
+                        if name not in decls["module_globals"]:
+                            decls["module_globals"].append(name)
+            if not isinstance(_parent_class(ctx, node), ast.ClassDef):
+                ret = _annotation_class(node.returns)
+                if ret:
+                    decls["return_types"][f"{modkey}:{node.name}"] = list(ret)
+    return decls
+
+
+def _parent_class(ctx, node):
+    cur = ctx.parents.get(node)
+    while cur is not None and not isinstance(cur, ast.ClassDef):
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return None
+        cur = ctx.parents.get(cur)
+    return cur
+
+
+def _is_self_attr(node) -> bool:
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self")
+
+
+def _lock_factory_kind(ctx, value) -> Optional[str]:
+    for sub in ast.walk(value):
+        if isinstance(sub, ast.Call):
+            name = ctx.canonical_call_name(sub.func)
+            if name in _LOCK_FACTORIES:
+                return _LOCK_FACTORIES[name]
+    return None
+
+
+def _call_name_in(ctx, value, names: Set[str]) -> bool:
+    for sub in ast.walk(value):
+        if isinstance(sub, ast.Call):
+            if ctx.canonical_call_name(sub.func) in names:
+                return True
+    return False
+
+
+def _jit_factory(ctx, value) -> Optional[bool]:
+    """True/False (= has static args) when ``value`` builds a jitted
+    callable (``jax.jit(...)``, possibly through functools.partial)."""
+    for sub in ast.walk(value):
+        if isinstance(sub, ast.Call):
+            name = ctx.canonical_call_name(sub.func)
+            if name in ("jax.jit", "jax.pmap"):
+                static = any(
+                    kw.arg in ("static_argnums", "static_argnames")
+                    for kw in sub.keywords if kw.arg
+                )
+                return static
+    return None
+
+
+def _ctor_class(ctx, value) -> Optional[str]:
+    for sub in ast.walk(value):
+        if isinstance(sub, ast.Call):
+            name = ctx.canonical_call_name(sub.func)
+            if name is None:
+                continue
+            tail = name.rsplit(".", 1)[-1]
+            if tail and tail[0].isupper():
+                return tail
+    return None
+
+
+def _is_mutable_literal(ctx, value) -> bool:
+    if isinstance(value, (ast.Dict, ast.List, ast.Set)):
+        return True
+    if isinstance(value, ast.Call):
+        name = ctx.canonical_call_name(value.func)
+        return name in (
+            "dict", "list", "set", "collections.OrderedDict",
+            "collections.defaultdict", "collections.deque",
+        )
+    return False
+
+
+def _param_types(func) -> Dict[str, str]:
+    out = {}
+    args = (list(func.args.posonlyargs) + list(func.args.args)
+            + list(func.args.kwonlyargs))
+    for arg in args:
+        got = _annotation_class(arg.annotation)
+        if got:
+            out[arg.arg] = got[0]
+    return out
+
+
+def _annotation_class(ann) -> Optional[Tuple[str, bool]]:
+    """(class name, is_list_element) from an annotation node."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Subscript):
+        # List[Replica] / Optional[Replica] / Sequence["Replica"] /
+        # Dict[str, Replica] (the *value* type is what iteration over
+        # ``.values()`` yields, the overwhelmingly common access shape).
+        base = ann.value
+        container = base.attr if isinstance(base, ast.Attribute) else (
+            base.id if isinstance(base, ast.Name) else "")
+        inner = ann.slice
+        if container in ("Dict", "dict", "Mapping", "MutableMapping",
+                         "DefaultDict", "OrderedDict") and isinstance(
+                inner, ast.Tuple) and len(inner.elts) == 2:
+            got = _annotation_class(inner.elts[1])
+            return (got[0], True) if got else None
+        got = _annotation_class(inner)
+        if got:
+            is_list = container in ("List", "list", "Sequence", "Iterable",
+                                    "Tuple", "tuple", "Iterator")
+            return (got[0], is_list or got[1])
+        return None
+    if isinstance(ann, ast.Name):
+        name = ann.id
+    elif isinstance(ann, ast.Attribute):
+        name = ann.attr
+    elif isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        name = ann.value.rsplit(".", 1)[-1].rstrip("]")
+    else:
+        return None
+    if name and name[0].isupper():
+        return (name, False)
+    return None
+
+
+def _is_device_annotation(ann) -> bool:
+    """Parameter annotated as a device array (jax.Array / jnp.ndarray)."""
+    if isinstance(ann, ast.Attribute) and ann.attr in ("Array", "ndarray"):
+        return True
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value.rsplit(".", 1)[-1] in ("Array", "ndarray")
+    return False
+
+
+# ---------------------------------------------------------------------------
+# pass 2: per-function facts (needs merged declarations)
+# ---------------------------------------------------------------------------
+
+
+class _Decls:
+    """Merged project declarations, indexed for resolution."""
+
+    def __init__(self, per_file: Dict[str, dict]):
+        self.known_classes: Set[str] = set()
+        self.class_locks: Dict[str, Dict[str, str]] = {}
+        self.lock_kinds: Dict[str, str] = {}
+        self.attr_types: Dict[str, Dict[str, str]] = {}
+        self.attr_elem_types: Dict[str, Dict[str, str]] = {}
+        self.class_methods: Dict[str, Set[str]] = {}
+        self.exempt_attrs: Dict[str, Set[str]] = {}
+        self.jit_attrs: Dict[str, Dict[str, bool]] = {}
+        self.return_types: Dict[str, Tuple[str, bool]] = {}
+        self.module_globals: Dict[str, Set[str]] = {}
+        for decls in per_file.values():
+            self.known_classes.update(decls["classes"])
+            for cls, locks in decls["class_locks"].items():
+                self.class_locks.setdefault(cls, {}).update(locks)
+            self.lock_kinds.update(decls["lock_kinds"])
+            for cls, types in decls["attr_types"].items():
+                self.attr_types.setdefault(cls, {}).update(types)
+            for cls, types in decls.get("attr_elem_types", {}).items():
+                self.attr_elem_types.setdefault(cls, {}).update(types)
+            for cls, meths in decls.get("class_methods", {}).items():
+                self.class_methods.setdefault(cls, set()).update(meths)
+            for cls, attrs in decls["exempt_attrs"].items():
+                self.exempt_attrs.setdefault(cls, set()).update(attrs)
+            for cls, jits in decls["jit_attrs"].items():
+                self.jit_attrs.setdefault(cls, {}).update(jits)
+            for key, val in decls["return_types"].items():
+                self.return_types[key] = (val[0], bool(val[1]))
+            self.module_globals[decls["modkey"]] = set(
+                decls["module_globals"])
+
+    def digest(self, per_file: Dict[str, dict]) -> str:
+        blob = json.dumps(per_file, sort_keys=True).encode()
+        return hashlib.sha1(blob).hexdigest()
+
+
+class _FnWalker:
+    """Statement walker for one top-level function: tracks held locks,
+    device-array taint, loop variables, and cache-guard depth; emits a
+    FunctionSummary per function (nested defs get their own, keyed
+    ``<parent>.<locals>.<name>``, with an empty held stack — their bodies
+    run later, on whatever thread invokes them)."""
+
+    def __init__(self, ctx: FileContext, decls: _Decls, modkey: str,
+                 hot_lines: Set[int]):
+        self.ctx = ctx
+        self.decls = decls
+        self.modkey = modkey
+        self.hot_lines = hot_lines
+        self.out: List[FunctionSummary] = []
+
+    # -- entry ---------------------------------------------------------------
+
+    def walk_function(self, node, cls_name: Optional[str], key: str,
+                      nested_in: Optional[str] = None):
+        public = not node.name.startswith("_") and nested_in is None
+        fn = FunctionSummary(
+            key, self.ctx.path, node.lineno, cls_name, node.name, public,
+            self._is_hot(node),
+        )
+        self.out.append(fn)
+        state = {
+            "fn": fn,
+            "cls": cls_name,
+            "held": [],
+            "var_types": _param_types(node),
+            "list_elem": {},     # var -> element class (list-typed vars)
+            "tainted": {a.arg for a in (
+                list(node.args.posonlyargs) + list(node.args.args)
+                + list(node.args.kwonlyargs))
+                if _is_device_annotation(a.annotation)},
+            "local_jits": {},    # name -> has_static_args
+            "local_defs": {},    # name -> nested function key
+            "loop_vars": set(),
+            "loop_depth": 0,
+            # A memoization decorator (functools.lru_cache / cache) makes
+            # the whole body a build-once region: jit construction inside
+            # it compiles once per distinct argument, not per call.
+            "guard_depth": 1 if _is_memoized(node) else 0,
+            "in_init": node.name in _INIT_METHODS,
+        }
+        # Objects constructed in this function are thread-local until
+        # published; accesses through them are not shared-state accesses.
+        state["fresh_vars"] = set()
+        # Scope handling for module globals: a name assigned locally
+        # without a `global` declaration shadows the module global — its
+        # accesses are local, not shared state.
+        declared_global = {
+            n for g in ast.walk(node) if isinstance(g, ast.Global)
+            for n in g.names
+        }
+        state["shadowed"] = {
+            n.id for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)
+        } - declared_global
+        # Pre-scan for sibling nested defs so forward refs (spawn before
+        # def, as in `Thread(target=loop)` above `def loop():`) resolve.
+        for stmt in ast.walk(node):
+            if (isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and stmt is not node):
+                state["local_defs"][stmt.name] = (
+                    f"{key}.<locals>.{stmt.name}")
+        self._walk_body(node.body, state)
+
+    def _is_hot(self, node) -> bool:
+        first = min([node.lineno] + [d.lineno for d in node.decorator_list])
+        return bool(self.hot_lines & {first - 1, first, node.lineno})
+
+    # -- statements ----------------------------------------------------------
+
+    def _walk_body(self, stmts, state):
+        for stmt in stmts:
+            self._walk_stmt(stmt, state)
+
+    def _walk_stmt(self, stmt, state):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            nested_key = state["local_defs"].get(
+                stmt.name, f"{state['fn'].key}.<locals>.{stmt.name}")
+            self.walk_function(stmt, state["cls"], nested_key,
+                               nested_in=state["fn"].key)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            acquired = []
+            for item in stmt.items:
+                lock = self._resolve_lock_expr(item.context_expr, state)
+                if lock is not None:
+                    acquired.append(lock)
+                else:
+                    self._scan_expr(item.context_expr, state)
+            state["held"].extend(acquired)
+            self._walk_body(stmt.body, state)
+            if acquired:
+                del state["held"][-len(acquired):]
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._scan_expr(stmt.iter, state)
+            elem = self._iter_element_class(stmt.iter, state)
+            if elem and isinstance(stmt.target, ast.Name):
+                state["var_types"][stmt.target.id] = elem
+            if self._expr_tainted(stmt.iter, state) and isinstance(
+                    stmt.target, ast.Name):
+                state["tainted"].add(stmt.target.id)
+            new_loop_vars = {
+                n.id for n in ast.walk(stmt.target)
+                if isinstance(n, ast.Name)
+            }
+            state["loop_vars"] |= new_loop_vars
+            state["loop_depth"] += 1
+            self._walk_body(stmt.body, state)
+            self._walk_body(stmt.orelse, state)
+            state["loop_depth"] -= 1
+            return
+        if isinstance(stmt, ast.While):
+            self._branch_sync_check(stmt.test, state)
+            self._scan_expr(stmt.test, state)
+            state["loop_depth"] += 1
+            self._walk_body(stmt.body, state)
+            self._walk_body(stmt.orelse, state)
+            state["loop_depth"] -= 1
+            return
+        if isinstance(stmt, ast.If):
+            self._branch_sync_check(stmt.test, state)
+            self._scan_expr(stmt.test, state)
+            guard = _is_cache_guard(stmt.test)
+            narrowed = self._isinstance_narrow(stmt.test)
+            saved = dict(state["var_types"])
+            state["var_types"].update(narrowed)
+            if guard:
+                state["guard_depth"] += 1
+            self._walk_body(stmt.body, state)
+            if guard:
+                state["guard_depth"] -= 1
+            state["var_types"] = saved
+            self._walk_body(stmt.orelse, state)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            self._handle_assign(stmt, state)
+            return
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            self._scan_expr(stmt.value, state)
+            return
+        # Generic compound/simple statement.
+        for field, value in ast.iter_fields(stmt):
+            if field in ("body", "orelse", "finalbody", "handlers"):
+                continue
+            nodes = value if isinstance(value, list) else [value]
+            for node in nodes:
+                if isinstance(node, ast.AST):
+                    self._scan_expr(node, state)
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if sub:
+                self._walk_body(sub, state)
+        for handler in getattr(stmt, "handlers", []) or []:
+            self._walk_body(handler.body, state)
+
+    def _handle_assign(self, stmt, state):
+        value = stmt.value
+        if value is not None:
+            self._scan_expr(value, state)
+        targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                   else [stmt.target])
+        tainted = value is not None and self._expr_tainted(value, state)
+        ctor = _ctor_class(self.ctx, value) if value is not None else None
+        ret = self._call_return_class(value, state) if value is not None \
+            else None
+        jit = _jit_factory(self.ctx, value) if value is not None else None
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                if tainted:
+                    state["tainted"].add(tgt.id)
+                else:
+                    state["tainted"].discard(tgt.id)
+                if jit is not None:
+                    state["local_jits"][tgt.id] = jit
+                if ret is not None:
+                    cls, is_list = ret
+                    state["fresh_vars"].discard(tgt.id)
+                    if is_list:
+                        state["list_elem"][tgt.id] = cls
+                        state["var_types"].pop(tgt.id, None)
+                    else:
+                        state["var_types"][tgt.id] = cls
+                elif ctor:
+                    state["var_types"][tgt.id] = ctor
+                    if isinstance(value, ast.Call) and _ctor_class(
+                            self.ctx, value) == ctor:
+                        state["fresh_vars"].add(tgt.id)
+            elif isinstance(tgt, ast.Tuple) and tainted:
+                for el in tgt.elts:
+                    if isinstance(el, ast.Name):
+                        state["tainted"].add(el.id)
+            # Attribute/subscript stores are accesses, picked up below.
+            self._scan_expr(tgt, state)
+
+    # -- expression scanning --------------------------------------------------
+
+    def _scan_expr(self, expr, state):
+        """Record calls, spawns, attribute accesses, and JAX hazards in
+        one expression tree."""
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.ListComp, ast.SetComp,
+                                 ast.GeneratorExp, ast.DictComp)):
+                # Bind generator targets before their uses are visited
+                # (ast.walk is breadth-first, so the comprehension node
+                # precedes its children) — `r._snapshot_locked() for r
+                # in sorted(self._replicas.values())` resolves r.
+                for gen in node.generators:
+                    elem = self._iter_element_class(gen.iter, state)
+                    if elem and isinstance(gen.target, ast.Name):
+                        state["var_types"][gen.target.id] = elem
+            elif isinstance(node, ast.Call):
+                self._handle_call(node, state)
+            elif isinstance(node, ast.Attribute):
+                self._maybe_access(node, state)
+            elif isinstance(node, ast.Name):
+                self._maybe_global_access(node, state)
+
+    def _handle_call(self, call, state):
+        fn = state["fn"]
+        name = self.ctx.canonical_call_name(call.func)
+        # Thread spawn sites.
+        spawn = self._spawn_target(call, name, state)
+        if spawn is not None:
+            fn.spawns.append(spawn + (call.lineno,))
+        # Call edge.
+        callee = self._resolve_callee(call, state)
+        if callee is not None:
+            fn.calls.append((callee, tuple(state["held"]), call.lineno))
+        # JAX hazards.
+        self._call_hazards(call, name, state)
+
+    def _spawn_target(self, call, name, state) -> Optional[Tuple[Optional[str], str]]:
+        def resolve(arg):
+            # functools.partial(self._run, ...) unwraps to its first arg.
+            if isinstance(arg, ast.Call):
+                inner = self.ctx.canonical_call_name(arg.func)
+                if inner == "functools.partial" and arg.args:
+                    return resolve(arg.args[0])
+                return None
+            return self._callable_key(arg, state)
+
+        if name in ("threading.Thread", "threading.Timer"):
+            kind = name.split(".")[-1]
+            for kw in call.keywords:
+                if kw.arg in ("target", "function"):
+                    return (resolve(kw.value), kind)
+            if name == "threading.Timer" and len(call.args) >= 2:
+                return (resolve(call.args[1]), kind)
+            if call.args:
+                return (resolve(call.args[0]), kind)
+            return None
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            if func.attr == "submit" and call.args:
+                return (resolve(call.args[0]), "submit")
+            if func.attr == "run_in_executor" and len(call.args) >= 2:
+                return (resolve(call.args[1]), "run_in_executor")
+            if func.attr == "map" and call.args:
+                recv = func.value
+                recv_name = recv.id if isinstance(recv, ast.Name) else (
+                    recv.attr if isinstance(recv, ast.Attribute) else "")
+                if "executor" in recv_name.lower() or "pool" in \
+                        recv_name.lower():
+                    return (resolve(call.args[0]), "map")
+        return None
+
+    def _callable_key(self, node, state) -> Optional[str]:
+        """Function key for a callable reference (not a call)."""
+        if isinstance(node, ast.Attribute) and isinstance(
+                node.value, ast.Name):
+            base, attr = node.value.id, node.attr
+            if base == "self" and state["cls"]:
+                return f"{state['cls']}.{attr}"
+            vtype = state["var_types"].get(base)
+            if vtype:
+                return f"{vtype}.{attr}"
+            return None
+        if isinstance(node, ast.Name):
+            if node.id in state["local_defs"]:
+                return state["local_defs"][node.id]
+            target = self.ctx.aliases.get(node.id)
+            if target:
+                mod, _, tail = target.rpartition(".")
+                modstem = mod.rsplit(".", 1)[-1] if mod else ""
+                return f"{modstem}:{tail}" if modstem else None
+            return f"{self.modkey}:{node.id}"
+        return None
+
+    def _resolve_callee(self, call, state) -> Optional[str]:
+        func = call.func
+        cls, var_types = state["cls"], state["var_types"]
+        if isinstance(func, ast.Attribute) and isinstance(
+                func.value, ast.Name):
+            base, meth = func.value.id, func.attr
+            if base == "self" and cls:
+                return f"{cls}.{meth}"
+            vtype = var_types.get(base)
+            if vtype:
+                return f"{vtype}.{meth}"
+            target = self.ctx.aliases.get(base)
+            if target:
+                modstem = target.rsplit(".", 1)[-1]
+                return f"{modstem}:{meth}"
+            return None
+        if isinstance(func, ast.Attribute):
+            inner = func.value
+            if _is_self_attr(inner) and cls:
+                vtype = self.decls.attr_types.get(cls, {}).get(inner.attr)
+                if vtype:
+                    return f"{vtype}.{func.attr}"
+            return None
+        if isinstance(func, ast.Name):
+            if func.id in state["local_defs"]:
+                return state["local_defs"][func.id]
+            target = self.ctx.aliases.get(func.id)
+            if target:
+                mod, _, name = target.rpartition(".")
+                modstem = mod.rsplit(".", 1)[-1] if mod else ""
+                return f"{modstem}:{name}" if modstem else None
+            if func.id in self.decls.known_classes:
+                return f"{func.id}.__init__"
+            return f"{self.modkey}:{func.id}"
+        return None
+
+    def _call_return_class(self, value, state) -> Optional[Tuple[str, bool]]:
+        if not isinstance(value, ast.Call):
+            return None
+        callee = self._resolve_callee(value, state)
+        if callee is None:
+            return None
+        return self.decls.return_types.get(callee)
+
+    def _iter_element_class(self, it, state) -> Optional[str]:
+        if isinstance(it, ast.Name):
+            return state["list_elem"].get(it.id)
+        if isinstance(it, ast.Call):
+            func = it.func
+            # Order/shape-preserving builtins pass the element through.
+            if isinstance(func, ast.Name) and func.id in (
+                    "sorted", "list", "tuple", "reversed", "iter",
+                    "set") and it.args:
+                return self._iter_element_class(it.args[0], state)
+            # dict-of-T iteration: self._replicas.values() where the
+            # attr is annotated Dict[str, T].
+            if isinstance(func, ast.Attribute) and func.attr == "values":
+                recv = func.value
+                if _is_self_attr(recv) and state["cls"]:
+                    return self.decls.attr_elem_types.get(
+                        state["cls"], {}).get(recv.attr)
+            ret = self._call_return_class(it, state)
+            if ret and ret[1]:
+                return ret[0]
+        if _is_self_attr(it) and state["cls"]:
+            return self.decls.attr_elem_types.get(
+                state["cls"], {}).get(it.attr)
+        return None
+
+    # -- attribute accesses ---------------------------------------------------
+
+    def _maybe_access(self, node: ast.Attribute, state):
+        owner = None
+        if isinstance(node.value, ast.Name):
+            base = node.value.id
+            if base == "self" and state["cls"]:
+                owner = state["cls"]
+            elif base in state["fresh_vars"]:
+                return  # locally constructed: thread-local until published
+            else:
+                owner = state["var_types"].get(base)
+        elif _is_self_attr(node.value) and state["cls"]:
+            owner = self.decls.attr_types.get(
+                state["cls"], {}).get(node.value.attr)
+        if owner is None:
+            return
+        attr = node.attr
+        if attr in self.decls.class_locks.get(owner, {}):
+            return  # lock attributes are the guards, not the guarded
+        if attr in self.decls.exempt_attrs.get(owner, ()):
+            return
+        if attr in self.decls.jit_attrs.get(owner, {}):
+            return  # compiled-callable handles: written once, then called
+        if attr in self.decls.class_methods.get(owner, ()):
+            return  # bound-method references (Thread targets, callbacks)
+        # A plain method call on self/typed receiver is not a state access.
+        parent = self.ctx.parents.get(node)
+        if isinstance(parent, ast.Call) and parent.func is node:
+            return
+        write = self._is_write(node, state)
+        fn = state["fn"]
+        fn.accesses.append(Access(
+            owner, attr, write, tuple(state["held"]),
+            node.lineno, node.col_offset,
+            state["in_init"] and owner == state["cls"],
+        ))
+
+    def _is_write(self, node: ast.Attribute, state) -> bool:
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            return True
+        parent = self.ctx.parents.get(node)
+        if isinstance(parent, ast.AugAssign) and parent.target is node:
+            return True
+        # self.X[k] = v / self.X[k] += v — subscript store through X.
+        if isinstance(parent, ast.Subscript) and parent.value is node:
+            if isinstance(parent.ctx, (ast.Store, ast.Del)):
+                return True
+            grand = self.ctx.parents.get(parent)
+            if isinstance(grand, ast.AugAssign) and grand.target is parent:
+                return True
+        # self.X.append(v) — container mutator.
+        if (isinstance(parent, ast.Attribute) and parent.value is node
+                and parent.attr in _MUTATORS):
+            grand = self.ctx.parents.get(parent)
+            if isinstance(grand, ast.Call) and grand.func is parent:
+                return True
+        return False
+
+    def _maybe_global_access(self, node: ast.Name, state):
+        if node.id not in self.decls.module_globals.get(self.modkey, ()):
+            return
+        if node.id in state["shadowed"]:
+            return
+        write = isinstance(node.ctx, (ast.Store, ast.Del))
+        if not write:
+            parent = self.ctx.parents.get(node)
+            if isinstance(parent, ast.AugAssign) and parent.target is node:
+                write = True
+            elif (isinstance(parent, ast.Subscript)
+                  and parent.value is node
+                  and isinstance(parent.ctx, (ast.Store, ast.Del))):
+                write = True
+            elif (isinstance(parent, ast.Attribute)
+                  and parent.value is node and parent.attr in _MUTATORS):
+                grand = self.ctx.parents.get(parent)
+                write = isinstance(grand, ast.Call) and grand.func is parent
+        state["fn"].accesses.append(Access(
+            self.modkey, node.id, write, tuple(state["held"]),
+            node.lineno, node.col_offset, False,
+        ))
+
+    # -- lock resolution (mirrors TPU007) ------------------------------------
+
+    def _resolve_lock_expr(self, expr, state) -> Optional[str]:
+        cls, var_types = state["cls"], state["var_types"]
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name):
+            base, attr = expr.value.id, expr.attr
+            if base == "self" and cls:
+                key = self.decls.class_locks.get(cls, {}).get(attr)
+                if key:
+                    return key
+            vtype = var_types.get(base)
+            if vtype:
+                return self.decls.class_locks.get(vtype, {}).get(attr)
+            return None
+        if (isinstance(expr, ast.Attribute) and _is_self_attr(expr.value)
+                and cls):
+            vtype = self.decls.attr_types.get(cls, {}).get(expr.value.attr)
+            if vtype:
+                return self.decls.class_locks.get(vtype, {}).get(expr.attr)
+            return None
+        if isinstance(expr, ast.Name):
+            key = f"{self.modkey}:{expr.id}"
+            if key in self.decls.lock_kinds:
+                return key
+            target = self.ctx.aliases.get(expr.id)
+            if target:
+                mod, _, name = target.rpartition(".")
+                modstem = mod.rsplit(".", 1)[-1] if mod else ""
+                key = f"{modstem}:{name}"
+                if key in self.decls.lock_kinds:
+                    return key
+        return None
+
+    def _isinstance_narrow(self, test) -> Dict[str, str]:
+        if (isinstance(test, ast.Call)
+                and isinstance(test.func, ast.Name)
+                and test.func.id == "isinstance"
+                and len(test.args) == 2
+                and isinstance(test.args[0], ast.Name)):
+            type_arg = test.args[1]
+            if isinstance(type_arg, ast.Name):
+                return {test.args[0].id: type_arg.id}
+            if isinstance(type_arg, ast.Attribute):
+                return {test.args[0].id: type_arg.attr}
+        return {}
+
+    # -- JAX hazards ----------------------------------------------------------
+
+    def _expr_tainted(self, expr, state) -> bool:
+        """Does this expression (transitively) hold a device array?"""
+        if isinstance(expr, ast.Name):
+            return expr.id in state["tainted"]
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _DEVICE_METADATA_ATTRS:
+                return False  # metadata access never forces a transfer
+            return self._expr_tainted(expr.value, state)
+        if isinstance(expr, ast.Subscript):
+            return self._expr_tainted(expr.value, state)
+        if isinstance(expr, ast.BinOp):
+            return (self._expr_tainted(expr.left, state)
+                    or self._expr_tainted(expr.right, state))
+        if isinstance(expr, ast.UnaryOp):
+            return self._expr_tainted(expr.operand, state)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(self._expr_tainted(e, state) for e in expr.elts)
+        if isinstance(expr, ast.IfExp):
+            return (self._expr_tainted(expr.body, state)
+                    or self._expr_tainted(expr.orelse, state))
+        if isinstance(expr, ast.Call):
+            name = self.ctx.canonical_call_name(expr.func)
+            if name and name.startswith(_DEVICE_CALL_PREFIXES):
+                return True
+            func = expr.func
+            if isinstance(func, ast.Attribute):
+                # self._step(...) where _step = jax.jit(...)
+                if (_is_self_attr(func) and state["cls"]
+                        and func.attr in self.decls.jit_attrs.get(
+                            state["cls"], {})):
+                    return True
+                # tainted.method(...) stays on device (sync methods are
+                # sinks, handled in _call_hazards).
+                if func.attr not in _SYNC_METHODS and self._expr_tainted(
+                        func.value, state):
+                    return True
+            if isinstance(func, ast.Name) and func.id in state["local_jits"]:
+                return True
+        return False
+
+    def _call_hazards(self, call, name, state):
+        fn = state["fn"]
+        in_loop = state["loop_depth"] > 0
+        src = _expr_text(call.args[0]) if call.args else ""
+        if name in _HOST_COERCERS and any(
+                self._expr_tainted(a, state) for a in call.args):
+            fn.hazards.append(Hazard(
+                "host-sync",
+                f"`{name.split('.')[-1] if '.' in name else name}({src})` "
+                f"forces a device->host transfer",
+                call.lineno, call.col_offset, in_loop))
+            return
+        if name == "jax.device_get" and call.args:
+            fn.hazards.append(Hazard(
+                "host-sync", f"`jax.device_get({src})` blocks on the device",
+                call.lineno, call.col_offset, in_loop))
+            return
+        if name == "jax.block_until_ready" or (
+                isinstance(call.func, ast.Attribute)
+                and call.func.attr == "block_until_ready"):
+            fn.hazards.append(Hazard(
+                "block-sync", "`block_until_ready` blocks host dispatch",
+                call.lineno, call.col_offset, in_loop))
+            return
+        if isinstance(call.func, ast.Attribute) and call.func.attr in \
+                _SYNC_METHODS:
+            if self._expr_tainted(call.func.value, state):
+                fn.hazards.append(Hazard(
+                    "host-sync",
+                    f"`.{call.func.attr}()` on a device array forces a "
+                    f"device->host transfer",
+                    call.lineno, call.col_offset, in_loop))
+            return
+        if name in ("jax.jit", "jax.pmap"):
+            fn.hazards.append(Hazard(
+                "jit-in-body",
+                "`jax.jit` constructed inside a function body — a fresh "
+                "callable retraces on every call",
+                call.lineno, call.col_offset, in_loop,
+                guarded=state["guard_depth"] > 0))
+            return
+        # static-arg drift: jitted-with-static-args callable invoked with a
+        # loop variable — every distinct value recompiles.
+        static = None
+        func = call.func
+        if isinstance(func, ast.Name):
+            static = state["local_jits"].get(func.id)
+        elif _is_self_attr(func) and state["cls"]:
+            static = self.decls.jit_attrs.get(state["cls"], {}).get(func.attr)
+        if static and in_loop:
+            drifting = [
+                _expr_text(a) for a in call.args
+                if isinstance(a, ast.Name) and a.id in state["loop_vars"]
+            ]
+            if drifting:
+                fn.hazards.append(Hazard(
+                    "static-drift",
+                    f"jitted callable with static args invoked with "
+                    f"loop-varying `{drifting[0]}` — retraces per value",
+                    call.lineno, call.col_offset, True))
+
+    def _branch_sync_check(self, test, state):
+        # `if x is None:` / `x is y` are identity checks — no transfer.
+        if isinstance(test, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+            return
+        if self._expr_tainted(test, state):
+            state["fn"].hazards.append(Hazard(
+                "bool-sync",
+                f"branching on device value `{_expr_text(test)}` forces a "
+                f"device->host sync",
+                test.lineno, test.col_offset, state["loop_depth"] > 0))
+
+
+def _is_cache_guard(test) -> bool:
+    """``if key not in cache:`` / ``if x is None:`` — the memoized-build
+    idiom; jit construction under it compiles once, not per call."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Compare):
+            for op in node.ops:
+                if isinstance(op, (ast.In, ast.NotIn, ast.Is, ast.IsNot)):
+                    return True
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            return True
+    return False
+
+
+_MEMO_DECORATORS = {"lru_cache", "cache", "cached_property"}
+
+
+def _is_memoized(node) -> bool:
+    """``@functools.lru_cache`` / ``@cache`` on the def — the function is
+    a build-once factory, so jit construction in its body is guarded."""
+    for dec in node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = target.attr if isinstance(target, ast.Attribute) else (
+            target.id if isinstance(target, ast.Name) else "")
+        if name in _MEMO_DECORATORS:
+            return True
+    return False
+
+
+def _expr_text(node) -> str:
+    try:
+        text = ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse failure
+        return "<expr>"
+    return text if len(text) <= 40 else text[:37] + "..."
+
+
+def summarize_file(ctx: FileContext, decls: _Decls) -> List[FunctionSummary]:
+    """Function summaries for one file against merged declarations."""
+    modkey = modkey_for(ctx.path)
+    hot_lines = {
+        i + 1 for i, line in enumerate(ctx.source.splitlines())
+        if _HOT_RE.search(line)
+    }
+    walker = _FnWalker(ctx, decls, modkey, hot_lines)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if ctx.enclosing_function(node) is not None:
+            continue  # nested defs are walked from their parent
+        cls = ctx.enclosing_class(node)
+        if cls is not None:
+            key = f"{cls.name}.{node.name}"
+            walker.walk_function(node, cls.name, key)
+        else:
+            walker.walk_function(node, None, f"{modkey}:{node.name}")
+    return walker.out
+
+
+# ---------------------------------------------------------------------------
+# graph assembly
+# ---------------------------------------------------------------------------
+
+MAIN = "main"
+
+
+class CallGraph:
+    """Whole-program view rules query: functions, thread identities,
+    held-at-entry locksets, hot-path reachability."""
+
+    def __init__(self, functions: Dict[str, FunctionSummary], decls: _Decls):
+        self.functions = functions
+        self.decls = decls
+        # callee -> [(caller key, frozenset(held))]
+        self.callers: Dict[str, List[Tuple[str, frozenset]]] = {}
+        # spawn target key -> (spawner key, kind)
+        self.roots: Dict[str, Tuple[str, str]] = {}
+        for fn in functions.values():
+            for callee, held, _line in fn.calls:
+                if callee in functions:
+                    self.callers.setdefault(callee, []).append(
+                        (fn.key, frozenset(held)))
+            for target, kind, _line in fn.spawns:
+                if target is not None and target in functions:
+                    self.roots.setdefault(target, (fn.key, kind))
+        self._thread_sets = self._compute_thread_sets()
+        self._entry = self._compute_entry_locksets()
+        self._hot = self._compute_hot()
+
+    # -- reachability / threads ---------------------------------------------
+
+    def _forward_reach(self, seeds: Set[str]) -> Set[str]:
+        seen = set(seeds)
+        stack = list(seeds)
+        while stack:
+            fn = self.functions.get(stack.pop())
+            if fn is None:
+                continue
+            for callee, _held, _line in fn.calls:
+                if callee in self.functions and callee not in seen:
+                    seen.add(callee)
+                    stack.append(callee)
+        return seen
+
+    def _compute_thread_sets(self) -> Dict[str, Set[str]]:
+        """Function key -> set of thread identities that may run it
+        (spawn-target keys, plus ``main`` for public entry points and
+        their transitive callees)."""
+        sets: Dict[str, Set[str]] = {k: set() for k in self.functions}
+        for root in self.roots:
+            for key in self._forward_reach({root}):
+                sets[key].add(root)
+        main_seeds = {
+            key for key, fn in self.functions.items()
+            if key not in self.roots and (
+                fn.public or key not in self.callers)
+        }
+        for key in self._forward_reach(main_seeds):
+            sets[key].add(MAIN)
+        return sets
+
+    def thread_set(self, key: str) -> Set[str]:
+        return self._thread_sets.get(key, {MAIN})
+
+    # -- held-at-entry fixpoint ----------------------------------------------
+
+    def _compute_entry_locksets(self) -> Dict[str, frozenset]:
+        """Decreasing fixpoint from ⊤: entry(f) is the lockset provably
+        held at every entry to f. Public functions and spawn targets pin
+        to ∅ (they may be entered lock-free)."""
+        TOP = None
+        entry: Dict[str, Optional[frozenset]] = {}
+        for key, fn in self.functions.items():
+            if fn.public or key in self.roots or key not in self.callers:
+                entry[key] = frozenset()
+            else:
+                entry[key] = TOP
+        changed = True
+        while changed:
+            changed = False
+            for key, fn in self.functions.items():
+                if entry[key] == frozenset():
+                    continue
+                contribs = []
+                for caller, held in self.callers.get(key, ()):
+                    up = entry.get(caller)
+                    if up is TOP:
+                        continue  # unresolved caller: skip this round
+                    contribs.append(held | up)
+                if not contribs:
+                    continue
+                # Inputs only shrink round over round (held sets are
+                # fixed, caller entries decrease), so recomputing the
+                # intersection from scratch is monotone and terminates.
+                new = frozenset.intersection(*contribs)
+                if entry[key] is TOP or new != entry[key]:
+                    entry[key] = new
+                    changed = True
+        return {k: (v if v is not TOP else frozenset())
+                for k, v in entry.items()}
+
+    def entry_lockset(self, key: str) -> frozenset:
+        return self._entry.get(key, frozenset())
+
+    def effective_locks(self, fn_key: str, access: Access) -> frozenset:
+        return frozenset(access.locks) | self.entry_lockset(fn_key)
+
+    # -- hot paths ------------------------------------------------------------
+
+    def _compute_hot(self) -> Dict[str, str]:
+        """Function key -> the ``# tpulint: hot-path`` root it is
+        reachable from (itself, when annotated directly)."""
+        hot: Dict[str, str] = {}
+        roots = sorted(k for k, fn in self.functions.items() if fn.hot)
+        for root in roots:
+            for key in self._forward_reach({root}):
+                hot.setdefault(key, root)
+        return hot
+
+    def hot_root(self, key: str) -> Optional[str]:
+        return self._hot.get(key)
+
+    def self_spawning_classes(self) -> Set[str]:
+        """Classes that start a thread on one of their own methods (or a
+        closure inside one). For these, the spawned thread and the
+        object's other callers provably share the *same instance* —
+        the object-identity fact a static Eraser otherwise lacks."""
+        owners: Set[str] = set()
+        for target in self.roots:
+            head = target.split(".", 1)[0]
+            if ":" not in head:
+                owners.add(head)
+        return owners
+
+    # -- witnesses ------------------------------------------------------------
+
+    def witness_path(self, key: str, context: str) -> List[str]:
+        """Shortest call path from a thread context's entry to ``key``
+        (function keys only — line-free, so messages stay
+        fingerprint-stable across unrelated edits)."""
+        if context == MAIN:
+            seeds = {
+                k for k, fn in self.functions.items()
+                if k not in self.roots and (fn.public or k not in
+                                            self.callers)
+            }
+        else:
+            seeds = {context}
+        prev: Dict[str, Optional[str]] = {s: None for s in seeds}
+        queue = sorted(seeds)
+        while queue:
+            cur = queue.pop(0)
+            if cur == key:
+                path = []
+                node: Optional[str] = cur
+                while node is not None:
+                    path.append(node)
+                    node = prev[node]
+                return list(reversed(path))
+            fn = self.functions.get(cur)
+            if fn is None:
+                continue
+            for callee in sorted({c for c, _h, _l in fn.calls}):
+                if callee in self.functions and callee not in prev:
+                    prev[callee] = cur
+                    queue.append(callee)
+        return [key]
+
+    def describe_context(self, context: str) -> str:
+        if context == MAIN:
+            return "main"
+        spawner, kind = self.roots.get(context, ("?", "thread"))
+        return f"{context} ({kind} started by {spawner})"
+
+
+# ---------------------------------------------------------------------------
+# build + cache
+# ---------------------------------------------------------------------------
+
+_CONFIG = {"cache_path": None, "scope": None}
+_MEMO: Dict[tuple, CallGraph] = {}
+
+
+def configure(cache_path: Optional[str] = None,
+              scope: Optional[Sequence[str]] = None) -> None:
+    """Set the cache file and the project scope (paths the graph should
+    cover even when only a subset is being linted). Called by the CLI;
+    tests leave it unset and the graph covers exactly the linted files."""
+    _CONFIG["cache_path"] = cache_path
+    _CONFIG["scope"] = list(scope) if scope else None
+    _MEMO.clear()
+
+
+def get_callgraph(ctxs: Sequence[FileContext]) -> CallGraph:
+    """Build (or reuse) the whole-program call graph for this run.
+
+    Files in ``ctxs`` contribute their already-parsed trees; when a
+    project scope is configured, files outside the linted set are loaded
+    from the summary cache (sha1 match) or parsed from disk.
+    """
+    by_path = {ctx.path: ctx for ctx in ctxs}
+    if _CONFIG["scope"]:
+        paths = [p.replace(os.sep, "/")
+                 for p in discover_files(_CONFIG["scope"])]
+        for p in by_path:
+            if p not in paths:
+                paths.append(p)
+    else:
+        paths = sorted(by_path)
+
+    sources: Dict[str, str] = {}
+    shas: Dict[str, str] = {}
+    for path in paths:
+        ctx = by_path.get(path)
+        if ctx is not None:
+            source = ctx.source
+        else:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+            except OSError:
+                continue
+        sources[path] = source
+        shas[path] = hashlib.sha1(source.encode()).hexdigest()
+
+    memo_key = tuple(sorted(shas.items()))
+    got = _MEMO.get(memo_key)
+    if got is not None:
+        return got
+
+    cache = _load_cache(_CONFIG["cache_path"])
+    cached_files = cache.get("files", {})
+
+    # Pass 1: declarations (cache hit on per-file sha alone).
+    decls_per_file: Dict[str, dict] = {}
+    parsed: Dict[str, FileContext] = {}
+    for path in sources:
+        entry = cached_files.get(path)
+        if entry is not None and entry.get("sha1") == shas[path]:
+            decls_per_file[path] = entry["decls"]
+            continue
+        ctx = by_path.get(path) or _try_parse(path, sources[path])
+        if ctx is None:
+            continue
+        parsed[path] = ctx
+        decls_per_file[path] = extract_decls(ctx)
+    decls = _Decls(decls_per_file)
+    digest = decls.digest(decls_per_file)
+
+    # Pass 2: function summaries (cache hit needs sha + decls digest).
+    functions: Dict[str, FunctionSummary] = {}
+    new_entries: Dict[str, dict] = {}
+    for path in sources:
+        if path not in decls_per_file:
+            continue
+        entry = cached_files.get(path)
+        if (path not in parsed and entry is not None
+                and entry.get("sha1") == shas[path]
+                and cache.get("decls_digest") == digest):
+            fns = [FunctionSummary.from_json(d) for d in entry["functions"]]
+        else:
+            ctx = parsed.get(path) or by_path.get(path) or _try_parse(
+                path, sources[path])
+            if ctx is None:
+                continue
+            fns = summarize_file(ctx, decls)
+        new_entries[path] = {
+            "sha1": shas[path],
+            "decls": decls_per_file[path],
+            "functions": [fn.to_json() for fn in fns],
+        }
+        for fn in fns:
+            functions[fn.key] = fn
+
+    graph = CallGraph(functions, decls)
+    _MEMO.clear()
+    _MEMO[memo_key] = graph
+    _save_cache(_CONFIG["cache_path"], digest, new_entries)
+    return graph
+
+
+def _try_parse(path: str, source: str) -> Optional[FileContext]:
+    try:
+        return FileContext(path, source)
+    except SyntaxError:
+        return None
+
+
+def _load_cache(path: Optional[str]) -> dict:
+    if not path:
+        return {}
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if data.get("version") != CACHE_VERSION:
+        return {}
+    return data
+
+
+def _save_cache(path: Optional[str], digest: str,
+                files: Dict[str, dict]) -> None:
+    if not path:
+        return
+    payload = {"version": CACHE_VERSION, "decls_digest": digest,
+               "files": files}
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+    except OSError:  # pragma: no cover - cache is best-effort
+        pass

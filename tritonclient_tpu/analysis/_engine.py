@@ -202,6 +202,8 @@ def default_rules() -> List[Rule]:
     from tritonclient_tpu.analysis._tpu006_shm_lifecycle import ShmLifecycleRule
     from tritonclient_tpu.analysis._tpu007_lock_order import LockOrderRule
     from tritonclient_tpu.analysis._tpu008_protocol_drift import ProtocolDriftRule
+    from tritonclient_tpu.analysis._tpu009_guarded_by import GuardedByRule
+    from tritonclient_tpu.analysis._tpu010_jax_hazard import JaxHazardRule
 
     return [
         AsyncBlockingRule(),
@@ -212,6 +214,8 @@ def default_rules() -> List[Rule]:
         ShmLifecycleRule(),
         LockOrderRule(),
         ProtocolDriftRule(),
+        GuardedByRule(),
+        JaxHazardRule(),
     ]
 
 

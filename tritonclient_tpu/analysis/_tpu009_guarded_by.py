@@ -1,0 +1,186 @@
+"""TPU009: guarded-by race detection (Eraser-style static lockset).
+
+For every ``self.*`` / module-global mutable attribute the call-graph
+substrate (``_callgraph.py``) can see, this rule asks the two questions
+TPU002's single-class heuristic cannot:
+
+1. **Does the attribute escape to ≥ 2 threads?** Thread identities come
+   from spawn sites (``threading.Thread(target=...)``, executor
+   ``submit``/``map``, ``run_in_executor``, ``threading.Timer``) plus an
+   implicit ``main`` identity for public entry points. An attribute
+   escapes when the union of identities over all its access sites has at
+   least two members and at least one access is a post-``__init__``
+   write. Single-thread attributes — however lock-free — are not races.
+
+2. **Which lock guards it?** The guard is inferred by majority vote over
+   the *effective* locksets of the post-init writes (lexically held
+   locks ∪ locks provably held at entry to the writing function, the
+   interprocedural step that keeps "caller holds the lock" helpers
+   clean). Writers define the discipline; reads then get checked against
+   it, which is exactly the shape of the real bug class this rule exists
+   for — counters mutated under a lock but scraped lock-free by a
+   metrics thread.
+
+Findings:
+
+* a majority guard exists → every access (read or write) whose
+  effective lockset misses the guard is reported, with the inferred
+  guard, the vote, the thread identities, and a line-free witness call
+  path (stable fingerprints for baselines);
+* no lock is ever held → the attribute is reported once, at its first
+  post-init write;
+* locks appear but none wins the majority → reported once as
+  inconsistently guarded.
+
+Three precision policies keep a *static* Eraser honest about object
+identity (the thing only the runtime tier can truly see):
+
+* accesses through locally-constructed objects are thread-local
+  (``req = CoreRequest(...); req.inputs = ...`` is not sharing);
+* the lock-free cases ("no lock ever held" / "no consistent guard")
+  only report classes that spawn a thread on one of their *own* methods
+  — there the spawned thread and other callers provably share the same
+  instance; per-request value objects whose methods merely *run* on
+  several threads do not qualify (module globals always qualify: they
+  are one instance by construction);
+* findings in test files are dropped — tests poke quiesced internals
+  by design, and the tpusan runtime witness covers them under
+  ``TPUSAN=1``.
+
+Deliberate single-mutator designs (e.g. the gpt engine's "engine loop is
+the sole mutator of slot state") suppress with ``# tpulint:
+disable=TPU009`` on the ``def`` line, same as TPU002. The tpusan runtime
+tier mirrors this rule: ``sanitize.note_field_access`` tracks the same
+per-attribute locksets under ``TPUSAN=1`` and ``scripts/tpusan_report.py``
+diffs the two.
+"""
+
+from typing import Dict, List, Sequence, Set, Tuple
+
+from tritonclient_tpu.analysis import _callgraph
+from tritonclient_tpu.analysis._engine import FileContext, Finding, Rule
+
+
+class GuardedByRule(Rule):
+    id = "TPU009"
+    name = "guarded-by"
+    description = (
+        "attribute shared across threads accessed outside its inferred "
+        "guarding lock (Eraser-style interprocedural lockset analysis)"
+    )
+
+    def check_project(self, ctxs: Sequence[FileContext]) -> List[Finding]:
+        if not ctxs:
+            return []
+        graph = _callgraph.get_callgraph(ctxs)
+        linted = {
+            ctx.path for ctx in ctxs if not _is_test_path(ctx.path)
+        }
+        findings: List[Finding] = []
+        for (owner, attr), accesses in sorted(
+                _group_accesses(graph).items()):
+            findings.extend(
+                _check_attr(graph, owner, attr, accesses, linted))
+        return findings
+
+
+def _is_test_path(path: str) -> bool:
+    parts = path.split("/")
+    return "tests" in parts or parts[-1].startswith("test_")
+
+
+def _group_accesses(graph) -> Dict[Tuple[str, str],
+                                   List[Tuple[str, "_callgraph.Access"]]]:
+    groups: Dict[Tuple[str, str], List] = {}
+    for key, fn in graph.functions.items():
+        for access in fn.accesses:
+            groups.setdefault((access.owner, access.attr), []).append(
+                (key, access))
+    return groups
+
+
+def _check_attr(graph, owner, attr, accesses, linted) -> List[Finding]:
+    post_init_writes = [
+        (key, a) for key, a in accesses if a.write and not a.in_init
+    ]
+    if not post_init_writes:
+        return []
+    threads: Set[str] = set()
+    for key, _a in accesses:
+        threads |= graph.thread_set(key)
+    if len(threads) < 2:
+        return []  # never escapes: single-thread state
+    contexts = ", ".join(sorted(
+        graph.describe_context(t) for t in threads))
+
+    # Majority vote over post-init write locksets.
+    votes: Dict[str, int] = {}
+    for key, a in post_init_writes:
+        for lock in graph.effective_locks(key, a):
+            votes[lock] = votes.get(lock, 0) + 1
+    total = len(post_init_writes)
+    guard = None
+    if votes:
+        # Highest vote count wins; ties break lexicographically for
+        # deterministic output.
+        best = sorted(votes.items(), key=lambda kv: (-kv[1], kv[0]))[0]
+        if best[1] * 2 > total:
+            guard = best[0]
+
+    label = f"{owner}.{attr}"
+    findings: List[Finding] = []
+    if guard is not None:
+        held = votes[guard]
+        for key, a in sorted(
+                accesses, key=lambda ka: (ka[1].line, ka[1].col)):
+            if a.in_init or guard in graph.effective_locks(key, a):
+                continue
+            fn = graph.functions[key]
+            if fn.path not in linted:
+                continue
+            kind = "write to" if a.write else "read of"
+            context = _a_context(graph, key)
+            witness = " -> ".join(graph.witness_path(key, context))
+            findings.append(Finding(
+                GuardedByRule.id, fn.path, a.line, a.col,
+                f"{kind} `{label}` outside its guarding lock `{guard}` "
+                f"(held at {held}/{total} writes; shared by: {contexts}; "
+                f"witness: {witness})",
+            ))
+        return findings
+
+    # No majority guard. Without a lock as evidence of intentional
+    # sharing, require provable same-instance sharing: the owner class
+    # spawns a thread on its own method (or the owner is a module
+    # global — one instance by construction). Per-request value objects
+    # whose methods merely run on several threads drop out here.
+    is_module_global = owner not in graph.decls.known_classes
+    if not is_module_global and owner not in \
+            graph.self_spawning_classes():
+        return []
+    # One finding per attribute at the first post-init write, so an
+    # unguarded attr is one actionable item rather than one per touch.
+    key, a = min(post_init_writes,
+                 key=lambda ka: (ka[1].line, ka[1].col))
+    fn = graph.functions[key]
+    if fn.path not in linted:
+        return []
+    if not votes:
+        msg = (f"`{label}` is written with no lock ever held, but is "
+               f"shared by: {contexts}")
+    else:
+        seen = ", ".join(f"`{k}`" for k in sorted(votes))
+        msg = (f"`{label}` has no consistent guard (locks seen at some "
+               f"writes: {seen}), but is shared by: {contexts}")
+    context = _a_context(graph, key)
+    witness = " -> ".join(graph.witness_path(key, context))
+    return [Finding(GuardedByRule.id, fn.path, a.line, a.col,
+                    f"{msg}; witness: {witness}")]
+
+
+def _a_context(graph, key) -> str:
+    """A deterministic thread identity for the witness path (prefer a
+    spawned thread over main — it reads better in the message)."""
+    ts = sorted(graph.thread_set(key))
+    non_main = [t for t in ts if t != _callgraph.MAIN]
+    return non_main[0] if non_main else _callgraph.MAIN

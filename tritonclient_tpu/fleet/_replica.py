@@ -86,16 +86,17 @@ class Replica:
         self.needs_replay = False
         self.restarts = 0
 
-    @property
-    def routable(self) -> bool:
-        return self.state == ReplicaState.READY
-
-    def as_dict(self) -> dict:
+    def _snapshot_locked(self) -> dict:
+        """Point-in-time copy of the live signals. Caller MUST hold the
+        owning ReplicaSet's lock — reach this through
+        ``ReplicaSet.snapshot()``, never directly from a status/metrics
+        path (the prober thread mutates these counters concurrently)."""
         return {
             "name": self.name,
             "http_address": self.http_address,
             "grpc_address": self.grpc_address,
             "state": self.state,
+            "routable": self.state == ReplicaState.READY,
             "outstanding": self.outstanding,
             "queue_depth": self.queue_depth,
             "oldest_age_us": self.oldest_age_us,
@@ -179,19 +180,46 @@ class ReplicaSet:
     def routable(self) -> List[Replica]:
         with self._lock:
             return sorted(
-                (r for r in self._replicas.values() if r.routable),
+                (
+                    r for r in self._replicas.values()
+                    if r.state == ReplicaState.READY
+                ),
                 key=lambda r: r.name,
             )
+
+    def snapshot(self) -> List[dict]:
+        """Consistent copies of every replica's counters, taken under
+        the set lock — the sanctioned read path for status endpoints and
+        /metrics exposition (TPU009: the prober mutates the same fields
+        under this lock)."""
+        with self._lock:
+            return [
+                r._snapshot_locked()
+                for r in sorted(
+                    self._replicas.values(), key=lambda r: r.name
+                )
+            ]
+
+    def set_on_rejoin(self, hook):
+        """Install the crash-recovery replay hook under the set lock
+        (the prober reads it under the same lock)."""
+        with self._lock:
+            self.on_rejoin = hook
 
     # -- lease counters -------------------------------------------------------
 
     def acquire(self, replica: Replica):
         with self._lock:
+            # TPU009 lockset witness: router threads and the prober both
+            # touch these counters; the witness proves the set lock is
+            # held on every access (no-op unless TPUSAN is active).
+            sanitize.note_field_access(replica, "outstanding")
             replica.outstanding += 1
             replica.requests_total += 1
 
     def release(self, replica: Replica, failed: bool = False):
         with self._lock:
+            sanitize.note_field_access(replica, "outstanding")
             if replica.outstanding > 0:
                 replica.outstanding -= 1
             if failed:

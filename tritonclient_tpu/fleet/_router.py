@@ -119,7 +119,7 @@ class FleetRouter:
         self._policy_lock = sanitize.named_lock(
             "fleet.FleetRouter._policy_lock"
         )
-        self._set.on_rejoin = self._replay_admin_state
+        self._set.set_on_rejoin(self._replay_admin_state)
 
     # -- membership passthrough ----------------------------------------------
 
@@ -150,9 +150,10 @@ class FleetRouter:
         return bool(self._set.routable())
 
     def under_pressure(self) -> bool:
-        routable = self._set.routable()
+        routable = [s for s in self._set.snapshot() if s["routable"]]
         return bool(routable) and all(
-            r.queue_depth >= self.pressure_queue_depth for r in routable
+            s["queue_depth"] >= self.pressure_queue_depth
+            for s in routable
         )
 
     def begin(self, tenant: str = "", affinity_key: str = "",
@@ -289,7 +290,7 @@ class FleetRouter:
             "policy": self.policy.name,
             "ready": self.ready(),
             "under_pressure": self.under_pressure(),
-            "replicas": [r.as_dict() for r in self._set.replicas()],
+            "replicas": self._set.snapshot(),
             "admission": self.admission.status(),
         }
 
@@ -303,7 +304,11 @@ class FleetRouter:
             return (str(v).replace("\\", "\\\\").replace('"', '\\"')
                     .replace("\n", "\\n"))
 
-        replicas = self._set.replicas()
+        # One locked snapshot for the whole exposition: the prober
+        # mutates these counters under the set lock (TPU009), and a
+        # scrape that reads half-updated state would pair a new
+        # queue_depth with an old restarts count.
+        replicas = self._set.snapshot()
         lines = []
         metric = "nv_fleet_replica_up"
         lines.append(
@@ -313,8 +318,8 @@ class FleetRouter:
         lines.append(f"# TYPE {metric} gauge")
         for r in replicas:
             lines.append(
-                f'{metric}{{replica="{esc(r.name)}"}} '
-                f"{1 if r.routable else 0}"
+                f'{metric}{{replica="{esc(r["name"])}"}} '
+                f"{1 if r['routable'] else 0}"
             )
         metric = "nv_fleet_replica_outstanding"
         lines.append(
@@ -324,7 +329,8 @@ class FleetRouter:
         lines.append(f"# TYPE {metric} gauge")
         for r in replicas:
             lines.append(
-                f'{metric}{{replica="{esc(r.name)}"}} {r.outstanding}'
+                f'{metric}{{replica="{esc(r["name"])}"}} '
+                f"{r['outstanding']}"
             )
         metric = "nv_fleet_replica_queue_depth"
         lines.append(
@@ -334,7 +340,8 @@ class FleetRouter:
         lines.append(f"# TYPE {metric} gauge")
         for r in replicas:
             lines.append(
-                f'{metric}{{replica="{esc(r.name)}"}} {r.queue_depth}'
+                f'{metric}{{replica="{esc(r["name"])}"}} '
+                f"{r['queue_depth']}"
             )
         metric = "nv_fleet_requests_total"
         lines.append(
@@ -343,7 +350,8 @@ class FleetRouter:
         lines.append(f"# TYPE {metric} counter")
         for r in replicas:
             lines.append(
-                f'{metric}{{replica="{esc(r.name)}"}} {r.requests_total}'
+                f'{metric}{{replica="{esc(r["name"])}"}} '
+                f"{r['requests_total']}"
             )
         metric = "nv_fleet_replica_restarts_total"
         lines.append(
@@ -353,7 +361,8 @@ class FleetRouter:
         lines.append(f"# TYPE {metric} counter")
         for r in replicas:
             lines.append(
-                f'{metric}{{replica="{esc(r.name)}"}} {r.restarts}'
+                f'{metric}{{replica="{esc(r["name"])}"}} '
+                f"{r['restarts']}"
             )
         metric = "nv_client_breaker_state"
         lines.append(
@@ -363,8 +372,8 @@ class FleetRouter:
         lines.append(f"# TYPE {metric} gauge")
         for r in replicas:
             lines.append(
-                f'{metric}{{endpoint="{esc(r.name)}"}} '
-                f"{self.breaker_for(r.name).state_value()}"
+                f'{metric}{{endpoint="{esc(r["name"])}"}} '
+                f"{self.breaker_for(r['name']).state_value()}"
             )
         metric = "nv_client_retries_total"
         lines.append(

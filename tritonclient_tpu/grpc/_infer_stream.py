@@ -31,7 +31,9 @@ class _InferStream:
 
     def init_handler(self, response_iterator):
         """Attach the grpc call object and spawn the reader thread."""
-        self._response_iterator = response_iterator
+        # Safe publication: written before the reader thread that
+        # consumes it is started.
+        self._response_iterator = response_iterator  # tpulint: disable=TPU009
         self._handler = threading.Thread(target=self._process_response, daemon=True)
         self._handler.start()
 
@@ -79,7 +81,9 @@ class _InferStream:
                     self._callback(result=result, error=None)
         except grpc.RpcError as rpc_error:
             # Stream died: mark inactive and surface the error once.
-            self._active = False
+            # Benign single-transition flag (True->False, GIL-atomic);
+            # close() re-checks under its own join.
+            self._active = False  # tpulint: disable=TPU009
             if rpc_error.code() == grpc.StatusCode.CANCELLED:
                 error = get_cancelled_error()
             else:

@@ -279,10 +279,23 @@ def decode_step(params: Dict, k_cache, v_cache, token: jax.Array,
     return _head(params, x, cfg), k_cache, v_cache
 
 
+@functools.lru_cache(maxsize=8)
 def make_decode_fn(cfg: GptConfig):
-    """Jit-compiled decode step with donated caches."""
+    """Jit-compiled decode step with donated caches.
+
+    Memoized per config: a fresh ``jax.jit`` object carries a fresh trace
+    cache, so rebuilding it per request would retrace every request
+    (TPU010). One shared callable serves every caller with that config.
+    """
     step = functools.partial(decode_step, cfg=cfg)
     return jax.jit(step, donate_argnums=(1, 2))
+
+
+@functools.lru_cache(maxsize=8)
+def _prefill_fn(cfg: GptConfig):
+    """Memoized prefill jit — same retrace argument as ``make_decode_fn``
+    for the ``generate_tokens`` fallback path (TPU010)."""
+    return jax.jit(functools.partial(prefill, cfg=cfg))
 
 
 def sample_token(logits: jax.Array, key: jax.Array, temperature,
@@ -321,6 +334,7 @@ def sampling_key(seed, step) -> jax.Array:
     return jax.random.fold_in(jax.random.PRNGKey(seed), step)
 
 
+# tpulint: hot-path
 def generate_tokens(
     params: Dict,
     prompt: np.ndarray,
@@ -337,13 +351,15 @@ def generate_tokens(
 
     Greedy by default; ``temperature``/``top_k``/``seed`` select sampled
     decoding on the shared (seed, step) key schedule (``sampling_key``).
-    Each yield materializes one [B] int32 token on the host (that token is
-    about to go out on the wire anyway); the next step's dispatch overlaps
-    the consumer's handling of the previous token.
+    Each yield materializes one [B] int32 token on the host (that token
+    is about to go out on the wire anyway) — but only AFTER the next
+    step's dispatch is in flight, so the device computes step i+1 while
+    the host blocks on step i's readback and the consumer handles the
+    token (TPU010: a sync ordered before the next dispatch would idle
+    the device for the whole host round-trip every step). The cost is
+    one speculative dispatch when the consumer closes the stream early.
     """
-    prefill_fn = prefill_fn or jax.jit(
-        functools.partial(prefill, cfg=cfg)
-    )
+    prefill_fn = prefill_fn or _prefill_fn(cfg)
     decode_fn = decode_fn or make_decode_fn(cfg)
     select = _select_fn()
     prompt = jnp.asarray(prompt, jnp.int32)
@@ -365,14 +381,21 @@ def generate_tokens(
     logits, (k_cache, v_cache) = prefill_fn(params, prompt)
     token = pick(logits, 0)
     for i in range(max_new):
-        out = np.asarray(token)
+        if i + 1 < max_new:
+            # Dispatch step i+1 BEFORE materializing token i: the jitted
+            # decode launches asynchronously, overlapping device compute
+            # with the readback below and the consumer's handling.
+            logits, k_cache, v_cache = decode_fn(
+                params, k_cache, v_cache, token, jnp.int32(l + i)
+            )
+            next_token = pick(logits, i + 1)
+        else:
+            next_token = None
+        # The single designed readback per step: this token goes out on
+        # the wire now, and step i+1 is already running on-device.
+        out = np.asarray(token)  # tpulint: disable=TPU010
         yield out
-        if i + 1 == max_new:
-            break
-        logits, k_cache, v_cache = decode_fn(
-            params, k_cache, v_cache, token, jnp.int32(l + i)
-        )
-        token = pick(logits, i + 1)
+        token = next_token
 
 
 @functools.lru_cache(maxsize=1)

@@ -412,7 +412,8 @@ class _Distributor:
             t.join(timeout=timeout)
         self._thread = None
 
-    def _run(self):  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
+    # tpulint: hot-path
+    def _run(self):  # tpulint: disable=TPU002,TPU009 - engine-loop thread is the sole mutator of slot state
         while True:
             # Priority lane first: pending first-token deliveries beat
             # everything already queued. Prefill items never hold a
@@ -705,7 +706,7 @@ class GenerationEngine:
         self._drain_terminated()
         _kvcache.unregister(self._scope_name, self)
 
-    def _drain_terminated(self):  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
+    def _drain_terminated(self):  # tpulint: disable=TPU002,TPU009 - engine-loop thread is the sole mutator of slot state
         """Terminate every queued/active request (no thread will serve
         them): admission-queue waiters too, not just slot occupants."""
         if self._pending is not None:
@@ -764,7 +765,7 @@ class GenerationEngine:
 
     # -- block accounting ----------------------------------------------------
 
-    def _free_slot_blocks(self, slot: int, device_reset: bool = True):  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
+    def _free_slot_blocks(self, slot: int, device_reset: bool = True):  # tpulint: disable=TPU002,TPU009 - engine-loop thread is the sole mutator of slot state
         """Return a slot's pages (block-granular, immediately reusable).
 
         Registered pages park on the prefix cache's evictable LRU (their
@@ -849,7 +850,7 @@ class GenerationEngine:
 
     # -- engine loop ---------------------------------------------------------
 
-    def _multi_step_fn(self, n_steps: int):
+    def _multi_step_fn(self, n_steps: int):  # tpulint: disable=TPU009 - engine-loop-only jit cache (sole mutator)
         """The jitted fused decode for one bucketed micro-step count
         (compiled on first use; the bucket set is the powers of two up to
         TPU_ENGINE_FUSE_STEPS, so the shape family stays tiny)."""
@@ -864,7 +865,7 @@ class GenerationEngine:
             )
         return fn
 
-    def _choose_fuse(self, active: List[int]) -> int:  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
+    def _choose_fuse(self, active: List[int]) -> int:  # tpulint: disable=TPU002,TPU009 - engine-loop thread is the sole mutator of slot state
         """Micro-steps for the next dispatch. Fusing trades scheduler
         granularity for dispatch amortization, so it only engages when
         nothing is waiting on the scheduler: no prefilling slot, an empty
@@ -889,7 +890,7 @@ class GenerationEngine:
             return 1
         return 1 << (min(left, fuse).bit_length() - 1)
 
-    def _collective_us(self) -> float:
+    def _collective_us(self) -> float:  # tpulint: disable=TPU009 - engine-loop-only calibration cache (sole mutator)
         """Per-launch all-reduce cost (µs) of the projection psum payload
         on the live mesh, calibrated once and cached. Multiplied by the
         structural counts of expected_overlap_split to charge each decode
@@ -914,7 +915,7 @@ class GenerationEngine:
             self._coll_us = us
         return us
 
-    def _release_cancelled(self):  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
+    def _release_cancelled(self):  # tpulint: disable=TPU002,TPU009 - engine-loop thread is the sole mutator of slot state
         """A consumer that went away (stream closed) marks its request
         cancelled; its slot AND its KV pages free at the next loop top
         instead of generating dead tokens until max_new. Termination
@@ -938,7 +939,7 @@ class GenerationEngine:
             self._pending.out.put(None)
             self._pending = None
 
-    def _process_frees(self):  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
+    def _process_frees(self):  # tpulint: disable=TPU002,TPU009 - engine-loop thread is the sole mutator of slot state
         """Apply slot-completions reported by the delivery thread.
 
         Only the engine loop mutates slot state; the distributor just
@@ -961,7 +962,7 @@ class GenerationEngine:
                 self._temps = self._temps.at[slot].set(0.0)
                 self._slot_req[slot] = None
 
-    def _admit_requests(self):  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
+    def _admit_requests(self):  # tpulint: disable=TPU002,TPU009 - engine-loop thread is the sole mutator of slot state
         """Claim free slots for queued requests: reserve pages (admission
         gates on FREE PAGES now, not just free slots) and queue the
         chunked prefill. No compute happens here — chunks dispatch from
@@ -993,7 +994,7 @@ class GenerationEngine:
             self._slot_blocks[slot] = st.blocks
             self._prefilling[slot] = st
 
-    def _advance_prefills(self):  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
+    def _advance_prefills(self):  # tpulint: disable=TPU002,TPU009 - engine-loop thread is the sole mutator of slot state
         """Dispatch ONE prefill chunk for every still-prefilling slot —
         all slots in a SINGLE batched dispatch — then admit completed
         ones into the decode bank in a single vectorized burst. One
@@ -1244,7 +1245,7 @@ class GenerationEngine:
                 kk = min(kk * 2, self.max_slots)
             jax.block_until_ready(self._k)
 
-    def _run(self):  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
+    def _run(self):  # tpulint: disable=TPU002,TPU009 - engine-loop thread is the sole mutator of slot state
         try:
             self._run_loop()
         except BaseException as e:  # noqa: BLE001 — engine must not die silently
@@ -1277,7 +1278,8 @@ class GenerationEngine:
                     # Host bookkeeping only: the device is suspect.
                     self._free_slot_blocks(slot, device_reset=False)
 
-    def _run_loop(self):  # tpulint: disable=TPU002 - engine-loop thread is the sole mutator of slot state
+    # tpulint: hot-path
+    def _run_loop(self):  # tpulint: disable=TPU002,TPU009 - engine-loop thread is the sole mutator of slot state
         # Software pipeline with DECOUPLED delivery: steps and admissions'
         # prefill chunks dispatch with DEVICE tokens; the delivery thread
         # drains readbacks FIFO behind them (at most max_inflight
@@ -1291,12 +1293,12 @@ class GenerationEngine:
         while True:
             # Lock-free polls of monotonic signal flags: the loop re-checks
             # every iteration, so the worst race is one extra step.
-            if self._stopping:  # tpulint: disable=TPU002
+            if self._stopping:  # tpulint: disable=TPU002,TPU009 - single-transition stop/broken flags polled lock-free by the loop
                 self._dist.drain_and_stop()
                 self._process_frees()
                 self._drain_terminated()
                 return
-            broken = self._broken  # tpulint: disable=TPU002
+            broken = self._broken  # tpulint: disable=TPU002,TPU009 - single-transition stop/broken flags polled lock-free by the loop
             if broken is not None:
                 raise broken
             self._process_frees()
@@ -1328,7 +1330,7 @@ class GenerationEngine:
             got_ticket = self._dist.try_ticket(timeout=0.005)
             while not got_ticket:
                 # Same lock-free signal poll as the loop top.
-                if self._stopping or self._broken is not None:  # tpulint: disable=TPU002
+                if self._stopping or self._broken is not None:  # tpulint: disable=TPU002,TPU009 - single-transition stop/broken flags polled lock-free by the loop
                     break
                 self._process_frees()
                 self._release_cancelled()

@@ -70,6 +70,7 @@ def pick_chunks(d_out: int, tp: int, chunks: int) -> int:
     return max(chunks, 1)
 
 
+# tpulint: hot-path
 def row_parallel_proj(x, w, b, *, mesh: Mesh, axis: str = "tp",
                       chunks: int = 2, note: bool = True):
     """``x @ w + b`` with ``w`` row-sharded on ``axis``, issued as
@@ -124,6 +125,9 @@ def make_row_parallel_proj(mesh: Mesh, axis: str = "tp", chunks: int = 2,
     return proj
 
 
+# Run-once calibration (the engine caches the result): the jit build is
+# per-mesh by design and the block_until_ready calls ARE the measurement.
+# tpulint: disable=TPU010
 def calibrate_collective_us(mesh: Mesh, shape, dtype=jnp.float32,
                             axis: str = "tp", reps: int = 20) -> float:
     """Median wall µs of one all-reduce of ``shape``/``dtype`` over the

@@ -716,6 +716,9 @@ class _WindowWorker:
             for _ in range(max(_RANDOM_POOL, slots))
         ]
 
+    # Safe publication: setup() completes before run() submits the
+    # finish callbacks that read these fields.
+    # tpulint: disable=TPU009 - written before the reader tasks start
     def setup(self):
         a = self.analyzer
         if a.shared_memory != "tpu" or not a.output_sizes:

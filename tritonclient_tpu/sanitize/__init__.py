@@ -24,6 +24,11 @@ TPU001   async-blocking        ``time.sleep``/``socket.create_connection`` on
                                a thread with a running event loop, and
                                event-loop callbacks exceeding the
                                slow-callback threshold
+TPU009   lockset (races)       empty candidate lockset on a field touched by
+                               ≥2 threads with a write — Eraser refinement
+                               over the named locks at explicit
+                               ``note_field_access`` adoption sites
+                               (``_races.py``)
 =======  ====================  ===============================================
 
 Activation: ``TPUSAN=1`` in the environment (the test suite's
@@ -68,6 +73,7 @@ __all__ = [
     "named_lock",
     "named_rlock",
     "note_event_loop",
+    "note_field_access",
     "report_finding",
     "reset",
     "write_report",
@@ -103,6 +109,14 @@ RULES_META = [
         "shortDescription": {
             "text": "lock-order cycle or lock-held-across-blocking-call "
             "witnessed at runtime"
+        },
+    },
+    {
+        "id": "TPU009",
+        "name": "guarded-by",
+        "shortDescription": {
+            "text": "empty lockset witnessed on a cross-thread field "
+            "access (Eraser refinement over the named locks)"
         },
     },
 ]
@@ -190,13 +204,15 @@ def disable():
 
 
 def reset():
-    """Drop recorded findings and witness state (locks graph, shm states)."""
-    from tritonclient_tpu.sanitize import _locks, _shm
+    """Drop recorded findings and witness state (locks graph, shm states,
+    field locksets)."""
+    from tritonclient_tpu.sanitize import _locks, _races, _shm
 
     with _STATE.lock:
         _STATE.records.clear()
         _STATE.fingerprints.clear()
     _locks.reset()
+    _races.reset()
     _shm.reset()
 
 
@@ -414,6 +430,21 @@ def named_condition(name: str):
     from tritonclient_tpu.sanitize._locks import TrackedCondition
 
     return TrackedCondition(name, cond)
+
+
+def note_field_access(owner, field: str, write: bool = True,
+                      label: Optional[str] = None):
+    """TPU009 lockset witness: record one access to ``owner.field``.
+
+    Eraser refinement over the named locks — see ``_races.py``. No-op
+    (one predicate check) while the sanitizer is inactive, so hot-path
+    adoption sites cost nothing in production.
+    """
+    if not _STATE.active:
+        return
+    from tritonclient_tpu.sanitize import _races
+
+    _races.note_field_access(owner, field, write=write, label=label)
 
 
 def note_event_loop():

@@ -1,0 +1,106 @@
+"""Runtime lockset witness (pairs with tpulint TPU009).
+
+The Eraser algorithm over the project's *named* locks: every call to
+:func:`note_field_access` intersects the field's candidate lockset with
+the set of tracked locks the calling thread holds (``_locks.
+held_lock_names``). A field whose candidate set goes empty after it has
+been touched by ≥2 threads with at least one write has no lock that was
+held on every access — the dynamic counterpart of the static rule's
+majority-vote guard inference, and the arbiter for its benign-publication
+false positives: a field the static pass flags but the witness never
+reports under a racing workload was published safely.
+
+State machine per field (Eraser's refinement schedule):
+
+* **exclusive** — one thread has touched the field; the candidate set
+  tracks the *latest* access's held locks (init-time accesses before the
+  sharing thread exists must not poison the set);
+* **shared** — ≥2 threads, reads only: candidate set refines by
+  intersection but an empty set is not reported (read-read is benign);
+* **shared-modified** — ≥2 threads with a write: an empty candidate set
+  is a witnessed race, reported once per field with the access stacks.
+
+Instrumentation is explicit — product code calls ``sanitize.
+note_field_access(owner, "field", write=...)`` at the shared-state access
+it wants witnessed (zero overhead when the sanitizer is inactive: one
+predicate check). Identity is per *instance* (``id(owner)``) so two
+independent objects never alias; labels are ``ClassName.field`` so the
+finding fingerprint stays deterministic across runs.
+"""
+
+import threading
+import traceback
+from typing import Dict, Optional, Set, Tuple
+
+_STATE_LOCK = threading.Lock()
+_FIELDS: Dict[Tuple[int, str], "_FieldState"] = {}
+
+
+class _FieldState:
+    __slots__ = ("label", "threads", "lockset", "written", "reported",
+                 "first_stack")
+
+    def __init__(self, label: str, tid: int, held: Set[str], stack: str,
+                 written: bool):
+        self.label = label
+        self.threads = {tid}
+        self.lockset: Set[str] = set(held)
+        self.written = written
+        self.reported = False
+        self.first_stack = stack
+
+
+def reset():
+    with _STATE_LOCK:
+        _FIELDS.clear()
+
+
+def note_field_access(owner, field: str, write: bool = True,
+                      label: Optional[str] = None):
+    """Record one access to ``owner.field`` by the calling thread.
+
+    ``owner`` is the instance (or any hashable stand-in — a module name
+    string works for module globals); ``label`` overrides the reported
+    ``ClassName.field`` name. No-op while the sanitizer is inactive.
+    """
+    from tritonclient_tpu import sanitize
+    from tritonclient_tpu.sanitize._locks import held_lock_names
+
+    if not sanitize.enabled():
+        return
+    if label is None:
+        owner_name = owner if isinstance(owner, str) else type(owner).__name__
+        label = f"{owner_name}.{field}"
+    held = set(held_lock_names())
+    tid = threading.get_ident()
+    stack = "".join(traceback.format_stack(limit=8))
+    racy = None
+    with _STATE_LOCK:
+        st = _FIELDS.get((id(owner), field))
+        if st is None:
+            _FIELDS[(id(owner), field)] = _FieldState(
+                label, tid, held, stack, write)
+            return
+        if tid in st.threads and len(st.threads) == 1:
+            # Still exclusive: track the latest lockset rather than
+            # intersecting — single-thread init writes without the lock
+            # are the canonical benign publication.
+            st.lockset = held
+            st.written = st.written or write
+            st.first_stack = stack
+            return
+        st.threads.add(tid)
+        st.lockset &= held
+        st.written = st.written or write
+        if st.written and not st.lockset and not st.reported:
+            st.reported = True
+            racy = (st.label, st.first_stack)
+    if racy is not None:
+        label, first_stack = racy
+        sanitize.report_finding(
+            "TPU009",
+            f"unsynchronized shared access witnessed on `{label}`: no "
+            "common lock held across threads (empty lockset after a "
+            "cross-thread write)",
+            stacks=[first_stack, stack],
+        )

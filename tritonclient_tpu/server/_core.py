@@ -917,7 +917,7 @@ class _DynamicBatcher:
         the core lock, then per-slot error + waiter wakeup."""
         # Stable per-model reference; GIL-atomic read (same contract as
         # the dispatcher's model/stats snapshot).
-        stats = self._stats  # tpulint: disable=TPU002
+        stats = self._stats  # tpulint: disable=TPU002,TPU009
         with self.core._lock:
             for _slot, reason in shed:
                 stats.shed_counts[reason] += 1
@@ -1093,6 +1093,7 @@ class _DynamicBatcher:
         # t_enqueue is monotonic_ns (shared with the stats clock).
         return slot.t_enqueue / 1e9
 
+    # tpulint: hot-path
     def _run(self):
         while True:
             batch = None
@@ -1311,6 +1312,14 @@ class InferenceCore:
                 f"Request for unknown model version: '{name}' version {version}", 400
             )
         return model
+
+    def peek_model(self, name: str):
+        """Locked best-effort repository lookup (no readiness check) for
+        the front-ends' routing predicates — the stream serial barrier
+        and the aio blocking-model offload race load/unload, which
+        mutate the repository under the core lock (TPU009)."""
+        with self._lock:
+            return self._repository.get(name)
 
     def is_server_live(self) -> bool:
         return True
@@ -1871,7 +1880,7 @@ class InferenceCore:
         # Lock-free fast path (runs per request, before parse cost is
         # known): a GIL-atomic read of an always-present dict. The worst
         # race is one request sampled against just-cleared settings.
-        ts = self._trace_settings  # tpulint: disable=TPU002
+        ts = self._trace_settings  # tpulint: disable=TPU002,TPU009
         ctx = None
         if not (len(ts) == 1 and ts[""]["trace_level"] == ["OFF"]):
             ctx = self.trace_collector.sample(

@@ -734,7 +734,7 @@ class _Servicer:
         respond in order — run it inline behind a pipeline barrier."""
         if request.parameters:
             return True
-        model = self.core._repository.get(request.model_name)
+        model = self.core.peek_model(request.model_name)
         return bool(model is not None and getattr(model, "stateful", False))
 
     def ModelStreamInfer(self, request_iterator, context):
@@ -1084,7 +1084,7 @@ class _AioServicer:
         return handler
 
     def _is_blocking(self, model_name: str) -> bool:
-        model = self.core._repository.get(model_name)
+        model = self.core.peek_model(model_name)
         return bool(getattr(model, "blocking", False))
 
     async def _infer(self, creq):

@@ -168,6 +168,11 @@ class BatchRowView:
 
     __slots__ = ("_sb", "start", "stop", "_shape", "_tail", "_dtype")
 
+    # SharedBatch.array/host have one benign transition (array->None
+    # after host publishes, both under the lock in materialize);
+    # lock-free readers seeing the old array still read valid device
+    # data, readers seeing None take the locked host path.
+    # tpulint: disable=TPU009 - benign array->None publication
     def __init__(self, base, start: int, stop: int, lock=None, shape=None):
         self._sb = (
             base if isinstance(base, SharedBatch) else SharedBatch(base, lock)
@@ -206,6 +211,9 @@ class BatchRowView:
             out = out.astype(dtype, copy=False)
         return out
 
+    # Same benign array->None publication as __init__; a stale device
+    # base is still valid, None falls back to the locked materialize.
+    # tpulint: disable=TPU009 - benign array->None publication
     def device_slice(self):
         """Lazy device-side slice for device consumers (no host hop).
 
@@ -221,6 +229,9 @@ class BatchRowView:
             out = out.reshape(self._shape)
         return out
 
+    # Advisory warm-copy hint; racing the array->None release just
+    # skips a prefetch that is no longer needed.
+    # tpulint: disable=TPU009 - benign array->None publication
     def copy_to_host_async(self):
         try:
             base = self._sb.array
@@ -270,6 +281,12 @@ class TransferCoalescer:
             "bundles": 0, "bundled_members": 0, "singles": 0,
             "cas_ok": 0, "cas_miss": 0, "overflow": 0, "errors": 0,
         }
+
+    def stats_snapshot(self) -> dict:
+        """Copy of the effectiveness counters taken under the worker cv
+        (TPU009: the flush thread mutates them under the same cv)."""
+        with self._cv:
+            return dict(self.stats)
 
     def submit(self, region: "TpuSharedMemoryRegion", offset: int, arr):
         with self._cv:
@@ -492,6 +509,7 @@ class TpuSharedMemoryRegion:
             self._drop_overlapping(offset, an)
             self._parked[offset] = view
 
+    # tpulint: hot-path
     def set_array(self, array, offset: int = 0, block: bool = True):
         """Park a device array at ``offset`` (the zero-copy set path).
 
@@ -510,13 +528,17 @@ class TpuSharedMemoryRegion:
         else:
             arr = jax.device_put(array, self.device)
         if block:
-            jax.block_until_ready(arr)
+            # The designed region-set commit barrier (client default);
+            # the server's hot output path passes block=False and never
+            # reaches this.
+            jax.block_until_ready(arr)  # tpulint: disable=TPU010
         an = _nbytes(arr)
         self._check_range(offset, an)
         with self._lock:
             self._drop_overlapping(offset, an)
             self._parked[offset] = arr
 
+    # tpulint: hot-path
     def as_array(self, datatype: str, shape: Sequence[int], offset: int = 0,
                  prefer_host: bool = False):
         """A jax.Array view of the region contents at ``offset``.
