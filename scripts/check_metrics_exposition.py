@@ -47,6 +47,17 @@ Checks (exit 1 with one line per violation):
     {model, event} with ``event`` drawn from the canonical prefix-cache
     vocabulary and every event row present per model (so hit rates are
     computable from any single scrape)
+  * the fleetscope families (PR 16): ``nv_fleet_scrape_age_s`` carries
+    exactly {replica} and is non-negative;
+    ``nv_fleet_scrape_failures_total`` carries exactly {replica};
+    ``nv_fleet_slo_burn_rate`` carries exactly {model, tenant, window}
+    with ``window`` drawn from the canonical SLO window vocabulary and
+    a non-negative value; ``nv_fleet_slo_budget_remaining`` carries
+    exactly {model, tenant} with a value in [0, 1];
+    ``nv_fleet_cohort_requests_total`` carries exactly {cohort} with
+    the cohort label in canonical (lowercase slug) form;
+    ``nv_engine_kv_bytes_touched_total`` carries exactly
+    {model, phase} with ``phase`` from the stepscope vocabulary
 """
 
 import os
@@ -87,6 +98,15 @@ try:
 except ImportError:  # standalone copy of the script: keep it usable
     OVERLAP_KINDS = ("exposed", "hidden")
 
+try:
+    from tritonclient_tpu.protocol._literals import (
+        COHORT_LABEL_RE,
+        SLO_WINDOWS,
+    )
+except ImportError:  # standalone copy of the script: keep it usable
+    SLO_WINDOWS = ("fast", "slow")
+    COHORT_LABEL_RE = re.compile(r"^[a-z0-9][a-z0-9_-]*$")
+
 _SHED_FAMILY = "nv_inference_shed_total"
 # Fleet-router families (served by the router's own /metrics): same
 # stable-label-set discipline as the shed counter.
@@ -114,6 +134,15 @@ _PREFIX_FAMILY = "nv_engine_prefix_cache_events_total"
 # the canonical kind vocabulary, plus the pipelined-dispatch depth gauge.
 _OVERLAP_FAMILY = "nv_engine_collective_overlap_us_total"
 _INFLIGHT_FAMILY = "nv_engine_inflight_steps"
+# Fleetscope families (PR 16): scrape-health gauges/counters on the
+# router plus the SLO plane (burn rates, budget, cohort attribution)
+# and the engine's per-phase KV traffic counter.
+_SCRAPE_AGE_FAMILY = "nv_fleet_scrape_age_s"
+_SCRAPE_FAILURES_FAMILY = "nv_fleet_scrape_failures_total"
+_BURN_FAMILY = "nv_fleet_slo_burn_rate"
+_BUDGET_FAMILY = "nv_fleet_slo_budget_remaining"
+_COHORT_FAMILY = "nv_fleet_cohort_requests_total"
+_KV_BYTES_FAMILY = "nv_engine_kv_bytes_touched_total"
 
 _VALID_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
 _METRIC_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
@@ -380,6 +409,47 @@ def check_exposition(text: str) -> List[str]:
                             f'{family}{{model="{model}"}}: missing kind '
                             f"rows {missing}"
                         )
+            if family == _SCRAPE_FAILURES_FAMILY:
+                for labels, value, name, lineno in samples.get(family, []):
+                    if set(labels) != {"replica"}:
+                        errors.append(
+                            f"line {lineno}: {family} label set "
+                            f"{sorted(labels)} != ['replica']"
+                        )
+            if family == _COHORT_FAMILY:
+                # Cohort attribution: exactly {cohort} with the label in
+                # canonical (lowercase slug) form — uncanonicalized
+                # cohort names would split one cohort's series in two.
+                for labels, value, name, lineno in samples.get(family, []):
+                    if set(labels) != {"cohort"}:
+                        errors.append(
+                            f"line {lineno}: {family} label set "
+                            f"{sorted(labels)} != ['cohort']"
+                        )
+                        continue
+                    if not COHORT_LABEL_RE.match(labels["cohort"]):
+                        errors.append(
+                            f"line {lineno}: {family} cohort "
+                            f"{labels['cohort']!r} is not a canonical "
+                            "lowercase slug"
+                        )
+            if family == _KV_BYTES_FAMILY:
+                # KV traffic counter: exactly {model, phase} with phase
+                # from the stepscope vocabulary (value non-negativity is
+                # the generic counter check above).
+                for labels, value, name, lineno in samples.get(family, []):
+                    if set(labels) != {"model", "phase"}:
+                        errors.append(
+                            f"line {lineno}: {family} label set "
+                            f"{sorted(labels)} != ['model', 'phase']"
+                        )
+                        continue
+                    if labels["phase"] not in STEP_PHASES:
+                        errors.append(
+                            f"line {lineno}: {family} phase "
+                            f"{labels['phase']!r} not in "
+                            f"{list(STEP_PHASES)}"
+                        )
             if family == _COLLECTIVES_FAMILY:
                 # Stepscope collectives: fixed {model, op} label set (the
                 # op value is open vocabulary — psum/ppermute/all_to_all
@@ -452,6 +522,57 @@ def check_exposition(text: str) -> List[str]:
                         errors.append(
                             f"line {lineno}: {family} value {value} < 0 "
                             "(in-flight depth cannot be negative)"
+                        )
+            if family == _SCRAPE_AGE_FAMILY:
+                # Staleness gauge: exactly {replica}, non-negative (a
+                # negative age means a broken clock, not a fresh scrape).
+                for labels, value, name, lineno in samples.get(family, []):
+                    if set(labels) != {"replica"}:
+                        errors.append(
+                            f"line {lineno}: {family} label set "
+                            f"{sorted(labels)} != ['replica']"
+                        )
+                    if value < 0:
+                        errors.append(
+                            f"line {lineno}: {family} value {value} < 0 "
+                            "(scrape age cannot be negative)"
+                        )
+            if family == _BURN_FAMILY:
+                # Burn-rate gauge: exactly {model, tenant, window} with
+                # the window drawn from the canonical SLO vocabulary,
+                # non-negative (burn is a rate of budget consumption).
+                for labels, value, name, lineno in samples.get(family, []):
+                    if set(labels) != {"model", "tenant", "window"}:
+                        errors.append(
+                            f"line {lineno}: {family} label set "
+                            f"{sorted(labels)} != "
+                            "['model', 'tenant', 'window']"
+                        )
+                        continue
+                    if labels["window"] not in SLO_WINDOWS:
+                        errors.append(
+                            f"line {lineno}: {family} window "
+                            f"{labels['window']!r} not in "
+                            f"{list(SLO_WINDOWS)}"
+                        )
+                    if value < 0:
+                        errors.append(
+                            f"line {lineno}: {family} value {value} < 0 "
+                            "(burn rate cannot be negative)"
+                        )
+            if family == _BUDGET_FAMILY:
+                # Budget gauge: exactly {model, tenant} (slow-window
+                # rows only, so no window label), value a fraction.
+                for labels, value, name, lineno in samples.get(family, []):
+                    if set(labels) != {"model", "tenant"}:
+                        errors.append(
+                            f"line {lineno}: {family} label set "
+                            f"{sorted(labels)} != ['model', 'tenant']"
+                        )
+                    if not 0.0 <= value <= 1.0:
+                        errors.append(
+                            f"line {lineno}: {family} value {value} "
+                            "outside [0, 1]"
                         )
             if family in (_KV_USED_FAMILY, _KV_TOTAL_FAMILY):
                 # Pool-occupancy gauges: exactly {model}, non-negative.

@@ -1,0 +1,511 @@
+#!/usr/bin/env python
+"""Fleet SLO report: burn rates, per-replica divergence, and cohort
+verdicts from a fleetscope dump.
+
+The router retains per-replica time series, exact merged DDSketches,
+SLO burn windows, and cohort comparisons (``GET
+v2/fleet/debug/fleetscope``). This report renders that document into
+the operator's questions:
+
+* **per-replica divergence** — which replica's counter rates strayed
+  furthest from the fleet mean (plus scrape health: age, failures,
+  counter resets);
+* **fleet quantiles** — per-model/per-stage p50/p99/p999 from the
+  exact bucket-wise sketch merges;
+* **SLO burn** — per-objective fast/slow burn rates and remaining
+  error budget;
+* **cohort verdicts** — baseline-vs-cohort comparison outcomes
+  (``regressed`` / ``clean`` / ``insufficient-data``) with the p99 and
+  error-rate evidence;
+* optionally, a merged fleet flight dump (``GET
+  v2/fleet/debug/flight_recorder``) for per-replica record
+  attribution (deeper stage analysis belongs to ``tail_report.py``).
+
+Usage::
+
+    python scripts/fleet_report.py FLEETSCOPE_DUMP [--flight DUMP]
+        [--json]
+    python scripts/fleet_report.py --self-check
+
+``--self-check`` drives a real :class:`FleetScope` on a fake clock
+through a scripted scenario (one divergent replica, one regressed
+canary cohort, one burning objective), dumps it, and exits non-zero
+unless the report recovers every seeded answer — deterministic, no
+sockets, no RNG.
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+# --------------------------------------------------------------------------- #
+# loading                                                                     #
+# --------------------------------------------------------------------------- #
+
+
+def load_dump(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or doc.get("kind") != "fleetscope":
+        raise ValueError(
+            f"{path}: not a fleetscope dump "
+            f"(kind={doc.get('kind') if isinstance(doc, dict) else '?'})"
+        )
+    return doc
+
+
+def load_flight(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or doc.get("kind") != (
+        "fleet_flight_recorder"
+    ):
+        raise ValueError(f"{path}: not a merged fleet flight dump")
+    return doc
+
+
+# --------------------------------------------------------------------------- #
+# analysis                                                                    #
+# --------------------------------------------------------------------------- #
+
+
+def _mean_rates(samples: List[dict]) -> Dict[str, float]:
+    """Mean per-second rate per counter series over one replica's ring."""
+    sums: Dict[str, float] = {}
+    counts: Dict[str, int] = {}
+    for sample in samples:
+        for series, rate in (sample.get("rates") or {}).items():
+            sums[series] = sums.get(series, 0.0) + float(rate)
+            counts[series] = counts.get(series, 0) + 1
+    return {s: sums[s] / counts[s] for s in sums}
+
+
+def _divergence(per_replica: Dict[str, Dict[str, float]]) -> Dict[str, dict]:
+    """Max relative divergence of each replica's mean rates from the
+    fleet mean, over series observed on at least two replicas (a series
+    only one replica exports is a difference in workload, not a
+    divergence within it)."""
+    series_values: Dict[str, List[float]] = {}
+    for rates in per_replica.values():
+        for series, value in rates.items():
+            series_values.setdefault(series, []).append(value)
+    fleet_mean = {
+        s: sum(vs) / len(vs)
+        for s, vs in series_values.items()
+        if len(vs) >= 2 and sum(vs) > 0
+    }
+    out: Dict[str, dict] = {}
+    for replica, rates in per_replica.items():
+        worst, worst_series = 0.0, None
+        for series, mean in fleet_mean.items():
+            if series not in rates:
+                continue
+            rel = abs(rates[series] - mean) / mean
+            if rel > worst:
+                worst, worst_series = rel, series
+        out[replica] = {
+            "divergence": round(worst, 4),
+            "series": worst_series,
+        }
+    return out
+
+
+def analyze(doc: dict, flight: Optional[dict] = None) -> dict:
+    """The report document: per-replica health + divergence rows, the
+    merged-sketch quantile rows, per-objective burn rows (fast and slow
+    folded into one row), and the cohort verdicts."""
+    health = doc.get("scrape_health") or {}
+    timeseries = doc.get("timeseries") or {}
+    mean_rates = {
+        replica: _mean_rates(samples)
+        for replica, samples in timeseries.items()
+    }
+    divergence = _divergence(mean_rates)
+    replicas = []
+    for replica in sorted(set(health) | set(timeseries)):
+        h = health.get(replica) or {}
+        d = divergence.get(replica) or {}
+        replicas.append({
+            "replica": replica,
+            "samples": h.get("samples_retained", len(
+                timeseries.get(replica) or ()
+            )),
+            "scrape_age_s": h.get("scrape_age_s"),
+            "scrape_failures": h.get("scrape_failures", 0),
+            "counter_resets": h.get("counter_resets", 0),
+            "divergence": d.get("divergence", 0.0),
+            "divergent_series": d.get("series"),
+        })
+
+    sketches = [
+        {
+            "model": row.get("model", "?"),
+            "stage": row.get("stage", "?"),
+            "count": row.get("count", 0),
+            "p50_us": round((row.get("quantiles") or {}).get("0.5", 0.0), 1),
+            "p99_us": round((row.get("quantiles") or {}).get("0.99", 0.0), 1),
+            "p999_us": round(
+                (row.get("quantiles") or {}).get("0.999", 0.0), 1
+            ),
+        }
+        for row in doc.get("merged_sketches") or []
+    ]
+
+    # Fold the per-window burn rows into one row per objective: the
+    # fast/slow pair is how multi-window alerting reads them.
+    slo = doc.get("slo") or {}
+    by_objective: Dict[tuple, dict] = {}
+    for row in slo.get("burn") or []:
+        key = (row.get("model", ""), row.get("tenant", ""))
+        entry = by_objective.setdefault(key, {
+            "model": key[0], "tenant": key[1],
+            "fast_burn": 0.0, "slow_burn": 0.0,
+            "budget_remaining": 1.0, "total": 0, "bad": 0,
+        })
+        if row.get("window") == "fast":
+            entry["fast_burn"] = round(float(row.get("burn_rate", 0.0)), 3)
+        else:
+            entry["slow_burn"] = round(float(row.get("burn_rate", 0.0)), 3)
+            entry["budget_remaining"] = round(
+                float(row.get("budget_remaining", 1.0)), 4
+            )
+            entry["total"] = int(row.get("total", 0))
+            entry["bad"] = int(row.get("bad", 0))
+    burn = [by_objective[k] for k in sorted(by_objective)]
+
+    cohorts = doc.get("cohorts") or {}
+    verdicts = [
+        {
+            "cohort": v.get("cohort", "?"),
+            "verdict": v.get("verdict", "?"),
+            "reason": v.get("reason", ""),
+            "replicas": v.get("replicas") or [],
+            "windows": (
+                f"{v.get('windows_regressed', 0)}"
+                f"/{v.get('windows_compared', 0)}"
+            ),
+            "p99_us": round(float(v.get("p99_us", 0.0)), 1),
+            "baseline_p99_us": round(
+                float(v.get("baseline_p99_us", 0.0)), 1
+            ),
+            "error_rate": round(float(v.get("error_rate", 0.0)), 4),
+            "baseline_error_rate": round(
+                float(v.get("baseline_error_rate", 0.0)), 4
+            ),
+            "samples": v.get("samples", 0),
+        }
+        for v in cohorts.get("verdicts") or []
+    ]
+
+    result = {
+        "config": doc.get("config") or {},
+        "replicas": replicas,
+        "sketches": sketches,
+        "objectives": slo.get("objectives") or [],
+        "burn": burn,
+        "assignments": cohorts.get("assignments") or {},
+        "cohort_requests": cohorts.get("requests") or {},
+        "verdicts": verdicts,
+    }
+    if flight is not None:
+        counts: Dict[str, int] = {}
+        for rec in flight.get("records") or []:
+            replica = str(rec.get("replica", "?"))
+            counts[replica] = counts.get(replica, 0) + 1
+        result["flight"] = {
+            "replicas": flight.get("replicas") or [],
+            "unreachable": flight.get("unreachable") or {},
+            "records": counts,
+            "counters": flight.get("counters") or {},
+        }
+    return result
+
+
+# --------------------------------------------------------------------------- #
+# rendering                                                                   #
+# --------------------------------------------------------------------------- #
+
+
+def render(result: dict) -> str:
+    config = result.get("config") or {}
+    lines = [
+        f"fleetscope: bucket {config.get('bucket_s', '?')}s x "
+        f"{config.get('windows', '?')} windows, stale after "
+        f"{config.get('stale_after_s', '?')}s"
+    ]
+    lines.append("")
+    lines.append(
+        f"{'replica':<16} {'samples':>7} {'age_s':>7} {'fail':>5} "
+        f"{'resets':>6} {'diverge':>8}  divergent series"
+    )
+    for row in result["replicas"]:
+        age = row["scrape_age_s"]
+        age_txt = f"{age:.1f}" if age is not None else "never"
+        lines.append(
+            f"{row['replica']:<16} {row['samples']:>7} {age_txt:>7} "
+            f"{row['scrape_failures']:>5} {row['counter_resets']:>6} "
+            f"{row['divergence']:>8.1%}  {row['divergent_series'] or '-'}"
+        )
+    if result["sketches"]:
+        lines.append("")
+        lines.append(
+            f"{'model':<20} {'stage':<14} {'count':>7} {'p50_us':>9} "
+            f"{'p99_us':>9} {'p999_us':>9}"
+        )
+        for row in result["sketches"]:
+            lines.append(
+                f"{row['model']:<20} {row['stage']:<14} "
+                f"{row['count']:>7} {row['p50_us']:>9} {row['p99_us']:>9} "
+                f"{row['p999_us']:>9}"
+            )
+    lines.append("")
+    if result["burn"]:
+        lines.append(
+            f"{'objective':<28} {'fast_burn':>9} {'slow_burn':>9} "
+            f"{'budget':>7} {'bad/total':>12}"
+        )
+        for row in result["burn"]:
+            name = row["model"] + (
+                f"/{row['tenant']}" if row["tenant"] else ""
+            )
+            if len(name) > 27:
+                name = name[:24] + "..."
+            lines.append(
+                f"{name:<28} {row['fast_burn']:>9} {row['slow_burn']:>9} "
+                f"{row['budget_remaining']:>7.1%} "
+                f"{row['bad']:>5}/{row['total']}"
+            )
+    else:
+        lines.append("no SLO objectives declared")
+    lines.append("")
+    if result["verdicts"]:
+        lines.append(
+            f"{'cohort':<16} {'verdict':<18} {'win':>5} {'p99_us':>9} "
+            f"{'base_p99':>9} {'err':>7} {'base_err':>8}  reason"
+        )
+        for row in result["verdicts"]:
+            lines.append(
+                f"{row['cohort']:<16} {row['verdict']:<18} "
+                f"{row['windows']:>5} {row['p99_us']:>9} "
+                f"{row['baseline_p99_us']:>9} {row['error_rate']:>7.1%} "
+                f"{row['baseline_error_rate']:>8.1%}  {row['reason']}"
+            )
+    else:
+        lines.append("no non-baseline cohorts")
+    if result.get("cohort_requests"):
+        lines.append(
+            "requests by cohort: " + ", ".join(
+                f"{cohort}={count}" for cohort, count in sorted(
+                    result["cohort_requests"].items()
+                )
+            )
+        )
+    flight = result.get("flight")
+    if flight is not None:
+        lines.append("")
+        recs = ", ".join(
+            f"{replica}={count}"
+            for replica, count in sorted(flight["records"].items())
+        )
+        lines.append(
+            f"merged flight dump: {sum(flight['records'].values())} "
+            f"records ({recs or 'none'})"
+        )
+        for replica, error in sorted(flight["unreachable"].items()):
+            lines.append(f"  unreachable: {replica}: {error}")
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------- #
+# self-check                                                                  #
+# --------------------------------------------------------------------------- #
+
+
+def _exposition(requests: int, queue_depth: float) -> str:
+    """Minimal replica exposition the scrape plane retains."""
+    return (
+        "# TYPE nv_inference_request_success counter\n"
+        f'nv_inference_request_success{{model="m",version="1"}} '
+        f"{requests}\n"
+        "# TYPE nv_engine_queue_depth gauge\n"
+        f'nv_engine_queue_depth{{model="m"}} {queue_depth}\n'
+    )
+
+
+def self_check() -> int:
+    from tritonclient_tpu._sketch import LatencySketch
+    from tritonclient_tpu.fleet._fleetscope import FleetScope
+    from tritonclient_tpu.fleet._slo import CohortDetector
+
+    failures = 0
+    clock = [1000.0]
+    scope = FleetScope(
+        clock=lambda: clock[0], bucket_s=1.0, windows=120,
+        stale_after_s=30.0,
+        cohorts=CohortDetector(min_samples=5, confirm_windows=3),
+    )
+    scope.set_objective({
+        "model": "m", "latency_target_us": 10_000, "error_budget": 0.1,
+    })
+    scope.assign_cohort("r2", "canary")
+
+    # 6 scrape ticks: r2's request counter advances 3x faster than the
+    # baseline pair — the seeded divergence answer.
+    sketch = LatencySketch()
+    for value in (5_000, 6_000, 7_000):
+        sketch.insert(value)
+    sketches_doc = {
+        "kind": "sketches",
+        "models": {"m": {"request": sketch.to_dict()}},
+    }
+    for tick in range(6):
+        for replica, slope in (("r0", 10), ("r1", 10), ("r2", 30)):
+            scope.observe_scrape(
+                replica, ok=True,
+                metrics_text=_exposition(tick * slope, 2.0),
+                sketches_doc=sketches_doc,
+            )
+        clock[0] += 1.0
+
+    # 4 buckets of routed requests: canary (r2) at 25 ms, baseline at
+    # 5 ms, vs the 10 ms objective — r2's requests all burn budget and
+    # its cohort regresses for 3+ consecutive windows.
+    for _bucket in range(4):
+        for _ in range(8):
+            scope.record_request("m", "", 5_000, True, "r0")
+            scope.record_request("m", "", 5_000, True, "r1")
+            scope.record_request("m", "", 25_000, True, "r2")
+        clock[0] += 1.0
+
+    result = analyze(scope.dump(["r0", "r1", "r2"]))
+
+    worst = max(result["replicas"], key=lambda r: r["divergence"])
+    if worst["replica"] != "r2" or worst["divergence"] < 0.5:
+        print(
+            f"self-check: divergence picked {worst['replica']} "
+            f"({worst['divergence']}), expected r2",
+            file=sys.stderr,
+        )
+        failures += 1
+    sketch_rows = {
+        (r["model"], r["stage"]): r for r in result["sketches"]
+    }
+    merged = sketch_rows.get(("m", "request"))
+    if merged is None or merged["count"] != 9:
+        print(f"self-check: merged sketch rows {sketch_rows} missing "
+              "('m', 'request') with count 9 (3 obs x 3 replicas)",
+              file=sys.stderr)
+        failures += 1
+    burn = {(row["model"], row["tenant"]): row for row in result["burn"]}
+    row = burn.get(("m", ""))
+    # 1/3 of requests are bad vs a 0.1 budget: slow burn 10/3.
+    if row is None or not 3.0 < row["slow_burn"] < 3.7:
+        print(f"self-check: burn row {row} (expected slow_burn ~3.33)",
+              file=sys.stderr)
+        failures += 1
+    if row is not None and not 0.0 <= row["budget_remaining"] <= 1.0:
+        print(f"self-check: budget_remaining {row['budget_remaining']} "
+              "outside [0, 1]", file=sys.stderr)
+        failures += 1
+    verdicts = {v["cohort"]: v for v in result["verdicts"]}
+    canary = verdicts.get("canary")
+    if canary is None or canary["verdict"] != "regressed":
+        print(f"self-check: canary verdict {canary} != regressed",
+              file=sys.stderr)
+        failures += 1
+    text = render(result)
+    for needle in ("canary", "regressed", "r2", "fast_burn"):
+        if needle not in text:
+            print(f"self-check: render missing {needle!r}",
+                  file=sys.stderr)
+            failures += 1
+
+    # A stale replica must flip its cohort to insufficient-data: jump
+    # the clock past stale_after_s without new scrapes.
+    clock[0] += 60.0
+    stale_result = analyze(scope.dump(["r0", "r1", "r2"]))
+    canary = {
+        v["cohort"]: v for v in stale_result["verdicts"]
+    }.get("canary")
+    if canary is None or canary["verdict"] != "insufficient-data":
+        print(f"self-check [stale]: canary verdict {canary} != "
+              "insufficient-data", file=sys.stderr)
+        failures += 1
+
+    # Flight attribution: counts per replica stamp survive the render.
+    flight = {
+        "kind": "fleet_flight_recorder",
+        "replicas": ["r0", "r2"],
+        "unreachable": {"r1": "HTTP 503"},
+        "counters": {"offered": 3},
+        "records": [
+            {"replica": "r0", "duration_us": 1},
+            {"replica": "r2", "duration_us": 2},
+            {"replica": "router", "duration_us": 3},
+        ],
+    }
+    f_result = analyze(scope.dump(["r0", "r1", "r2"]), flight=flight)
+    if f_result["flight"]["records"] != {"r0": 1, "r2": 1, "router": 1}:
+        print(f"self-check [flight]: {f_result['flight']['records']}",
+              file=sys.stderr)
+        failures += 1
+    elif "unreachable: r1" not in render(f_result):
+        print("self-check [flight]: unreachable line missing",
+              file=sys.stderr)
+        failures += 1
+
+    if failures:
+        print(f"self-check: {failures} failure(s)", file=sys.stderr)
+        return 1
+    print("self-check: report recovers the divergent replica, the "
+          "burning objective, and the cohort verdicts")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="fleet_report",
+        description="Fleet SLO report from a fleetscope dump",
+    )
+    parser.add_argument("dump_file", nargs="?",
+                        help="fleetscope dump "
+                        "(GET v2/fleet/debug/fleetscope)")
+    parser.add_argument("--flight", metavar="FILE",
+                        help="merged fleet flight dump "
+                        "(GET v2/fleet/debug/flight_recorder)")
+    parser.add_argument("--json", dest="as_json", action="store_true",
+                        help="emit the report as JSON")
+    parser.add_argument("--self-check", action="store_true",
+                        help="run the scripted-scenario round trip and "
+                        "exit")
+    args = parser.parse_args(argv)
+    if args.self_check:
+        return self_check()
+    if not args.dump_file:
+        parser.error("a fleetscope dump is required (or --self-check)")
+    try:
+        doc = load_dump(args.dump_file)
+        flight = load_flight(args.flight) if args.flight else None
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"unable to load: {e}", file=sys.stderr)
+        return 1
+    result = analyze(doc, flight=flight)
+    try:
+        if args.as_json:
+            print(json.dumps(result, indent=2, default=str))
+        else:
+            print(render(result))
+    except BrokenPipeError:
+        return 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -198,6 +198,11 @@ def analyze(records: List[dict]) -> dict:
                 "mean_batch": round(
                     sum(int(r.get("batch_size", 0)) for r in ph) / n, 2
                 ),
+                # Paged-KV traffic (PR 16): bytes the gathered view
+                # touched per step; absent on pre-kv dumps.
+                "kv_bytes_per_step": round(
+                    sum(int(r.get("kv_bytes", 0)) for r in ph) / n
+                ),
             }
         n = len(recs)
         means = {
@@ -254,15 +259,16 @@ def render(analysis: dict) -> str:
         lines.append(
             f"  {'phase':<10} {'n':>6} {'p50_us':>8} {'p99_us':>8} "
             f"{'dispatch':>9} {'device':>8} {'other':>7} {'coll':>6} "
-            f"{'batch':>6}"
+            f"{'batch':>6} {'kv_MB':>8}"
         )
         for phase, ph in m["phases"].items():
             pm = ph["mean_us"]
+            kv_mb = ph.get("kv_bytes_per_step", 0) / 1e6
             lines.append(
                 f"  {phase:<10} {ph['n']:>6} {ph['p50_us']:>8} "
                 f"{ph['p99_us']:>8} {pm['dispatch']:>9} {pm['device']:>8} "
                 f"{pm['other']:>7} {ph['collectives_per_step']:>6} "
-                f"{ph['mean_batch']:>6}"
+                f"{ph['mean_batch']:>6} {kv_mb:>8.2f}"
             )
     return "\n".join(lines)
 
@@ -397,6 +403,10 @@ def _synthetic_dump(dispatch_us: int, device_us: int, other_us: int,
             "coll_hidden_us": hidden_us if phase == "decode" else 0,
             "thread_ident": 42,
             "thread_name": "gpt-engine",
+            # KV traffic scales with fused depth on decode, is a single
+            # chunk's worth on prefill — mirrors the engine's charging.
+            "kv_bytes": (4_000_000 * micro_steps if phase == "decode"
+                         else 1_000_000),
         })
     return {"kind": "stepscope", "mode": "counters", "records": records}
 
@@ -499,6 +509,19 @@ def self_check() -> int:
         failures += 1
     else:
         print("self-check [overlap]: ok")
+    # KV traffic column: per-phase bytes-touched means must survive the
+    # loader and surface in the rendered table (decode fused 4x deep
+    # charges 16 MB/step vs 1 MB/step on prefill chunks).
+    if (decode.get("kv_bytes_per_step") != 16_000_000
+            or m["phases"]["prefill_chunk"]["kv_bytes_per_step"]
+            != 1_000_000
+            or "kv_MB" not in render(analysis)
+            or "16.00" not in render(analysis)):
+        print("self-check [kv-bytes]: kv_bytes column lost",
+              file=sys.stderr)
+        failures += 1
+    else:
+        print("self-check [kv-bytes]: ok")
     # Compare mode renders ratios for shared phases, with the overlap
     # column when either side charged exposed time.
     a = analyze(load_records(_synthetic_dump(60, 200, 20, 0)))

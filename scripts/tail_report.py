@@ -10,6 +10,10 @@ ones, and does the batcher backlog predict it. It consumes
 * a flight-recorder dump (``GET v2/debug/flight_recorder`` /
   ``client.get_flight_recorder()`` saved to a file) — the primary input:
   tail-retained records with stage clocks and batcher context; or
+* a merged *fleet* flight dump (``GET v2/fleet/debug/flight_recorder``
+  on the router) — the same records replica-stamped and interleaved
+  with the router's proxy spans, reported with per-replica attribution;
+  or
 * any ``trace_mode`` trace file (triton / otlp / perfetto, including
   perf_analyzer ``--trace-out`` merged files) — stages are re-derived
   from the span tree.
@@ -100,6 +104,9 @@ def _record_from_flight(rec: dict) -> Optional[dict]:
         ),
         "backlog": attrs.get("batcher.backlog_at_admission"),
         "batch_size": attrs.get("batch.size"),
+        # Fleet dumps stamp every record with the replica it came from
+        # ("router" for the proxy half); single-node dumps leave it out.
+        "replica": rec.get("replica"),
         "attributes": attrs,
     }
 
@@ -151,7 +158,9 @@ def load_records(path: str) -> List[dict]:
     {duration_us, stages_us, model, signature, backlog, status, ...}."""
     with open(path) as f:
         doc = json.load(f)
-    if isinstance(doc, dict) and doc.get("kind") == "flight_recorder":
+    if isinstance(doc, dict) and doc.get("kind") in (
+        "flight_recorder", "fleet_flight_recorder"
+    ):
         out = [_record_from_flight(r) for r in doc.get("records", [])]
         return [r for r in out if r is not None]
     return _records_from_spans(_otel.load_spans(doc))
@@ -306,6 +315,27 @@ def analyze(records: List[dict], tail_q: float = 0.95,
             "mean_backlog": mean_backlog(served),
         })
 
+    # Per-replica rows (fleet dumps stamp each record with its source):
+    # a divergent replica shows up as an outsized tail_count or error
+    # count relative to its share of traffic.
+    by_replica: Dict[str, List[dict]] = {}
+    for r in all_records:
+        if r.get("replica"):
+            by_replica.setdefault(str(r["replica"]), []).append(r)
+    replica_rows = []
+    for replica, members in sorted(by_replica.items(),
+                                   key=lambda kv: -len(kv[1])):
+        served = [m for m in members if not m.get("shed_reason")]
+        ds = sorted(m["duration_us"] for m in served)
+        replica_rows.append({
+            "replica": replica,
+            "count": len(members),
+            "errors": sum(1 for m in members if m["status"] != "ok"),
+            "p50_us": _percentile(ds, 50),
+            "p99_us": _percentile(ds, 99),
+            "tail_count": sum(1 for m in served if id(m) in tail_ids),
+        })
+
     shed_lat = sorted(r["duration_us"] for r in sheds)
     # Where in the decode loop cancelled requests died (steps_completed
     # stamped at shed/cancel finalization; engine models count delivered
@@ -355,6 +385,7 @@ def analyze(records: List[dict], tail_q: float = 0.95,
         },
         "signatures": signatures,
         "tenants": tenants,
+        "replicas": replica_rows,
     }
 
 
@@ -442,6 +473,21 @@ def render(result: dict, slowest: List[dict]) -> str:
             lines.append(
                 f"{tenant:<24} {row['count']:>6} {row['served']:>7} "
                 f"{row['shed']:>5} {row['p50_us']:>8} {row['p99_us']:>9} "
+                f"{row['tail_count']:>5}"
+            )
+    if result.get("replicas"):
+        lines.append("")
+        lines.append(
+            f"{'replica':<24} {'count':>6} {'errors':>7} "
+            f"{'p50_us':>8} {'p99_us':>9} {'tail':>5}"
+        )
+        for row in result["replicas"][:10]:
+            replica = row["replica"]
+            if len(replica) > 23:
+                replica = replica[:20] + "..."
+            lines.append(
+                f"{replica:<24} {row['count']:>6} {row['errors']:>7} "
+                f"{row['p50_us']:>8} {row['p99_us']:>9} "
                 f"{row['tail_count']:>5}"
             )
     if slowest:
@@ -615,6 +661,37 @@ def self_check() -> int:
         elif "died in the decode loop" not in render(s_result, []):
             print("self-check [shed steps]: steps_completed line missing "
                   "from render", file=sys.stderr)
+            failures += 1
+        # Fleet dumps: replica-stamped records (plus the router's proxy
+        # spans) must load and produce per-replica attribution rows.
+        fleet_doc = _synthetic_dump(n=60, slow=6)
+        fleet_doc["kind"] = "fleet_flight_recorder"
+        fleet_doc["replicas"] = ["r0", "r1"]
+        fleet_doc["unreachable"] = {}
+        for i, rec in enumerate(fleet_doc["records"]):
+            rec["replica"] = "r0" if i % 2 else "r1"
+        fleet_doc["records"].append({
+            "seq": 10_000,
+            "model_name": "synthetic",
+            "duration_us": 70_000,
+            "status": "ok",
+            "stages_us": {"proxy": 70_000},
+            "timestamps": {},
+            "attributes": {"tenant": "acme", "fleet.replica": "r0"},
+            "replica": "router",
+        })
+        fleet_path = os.path.join(tmp, "fleet.json")
+        with open(fleet_path, "w") as f:
+            json.dump(fleet_doc, f)
+        f_result = analyze(load_records(fleet_path))
+        got = {row["replica"]: row["count"] for row in f_result["replicas"]}
+        if got != {"r0": 30, "r1": 30, "router": 1}:
+            print(f"self-check [fleet dump]: replica rows {got} != "
+                  "{'r0': 30, 'r1': 30, 'router': 1}", file=sys.stderr)
+            failures += 1
+        elif "router" not in render(f_result, []):
+            print("self-check [fleet dump]: replica table missing from "
+                  "render", file=sys.stderr)
             failures += 1
     if failures:
         print(f"self-check: {failures} failure(s)", file=sys.stderr)

@@ -1,0 +1,657 @@
+"""Fleetscope tier tests: the fleet-wide SLO plane.
+
+Unit tier exercises the pure pieces (exposition parsing, delta rates,
+exact sketch merges, burn math, cohort verdicts, journal replay) on
+fake clocks. The integration tier runs 3 in-process replicas behind a
+router and proves the acceptance drills: the regression drill (TPUCHAOS
+latency on one cohort -> ``regressed`` for it, ``clean`` for the
+control), the journal restart drill, and the merged-sketch exactness
+bound. Everything here must stay green under ``TPUSAN=1``.
+"""
+
+import json
+import sys
+import time
+
+import pytest
+import requests
+
+from tritonclient_tpu import chaos
+from tritonclient_tpu._sketch import LatencySketch
+from tritonclient_tpu.fleet import FleetRouter, FleetServer, ReplicaSet
+from tritonclient_tpu.fleet._fleetscope import (
+    FleetScope,
+    parse_exposition,
+)
+from tritonclient_tpu.fleet._slo import (
+    CohortDetector,
+    SloObjective,
+    exact_quantile,
+    merged_p99_matches_pooled,
+)
+from tritonclient_tpu.fleet.serve import FleetDeviceModel
+from tritonclient_tpu.protocol._literals import (
+    COHORT_BASELINE,
+    COHORT_CLEAN,
+    COHORT_INSUFFICIENT,
+    COHORT_REGRESSED,
+    EP_FLEET_COHORTS,
+    EP_FLEET_FLEETSCOPE,
+    EP_FLEET_FLIGHT_RECORDER,
+    EP_FLEET_SLO,
+    SLO_WINDOW_FAST,
+    SLO_WINDOW_SLOW,
+)
+from tritonclient_tpu.server import InferenceServer
+
+sys.path.insert(0, "scripts")
+from check_metrics_exposition import check_exposition  # noqa: E402
+import fleet_report  # noqa: E402
+import tail_report  # noqa: E402
+
+SERVICE_MS = 8
+
+
+def _infer_body(value=0):
+    return {
+        "inputs": [{
+            "name": "INPUT", "datatype": "INT32", "shape": [1, 16],
+            "data": [value + i for i in range(16)],
+        }]
+    }
+
+
+def _scope(bucket_s=1.0, windows=120, stale_after_s=30.0,
+           min_samples=3, confirm_windows=3, t0=1000.0):
+    """FleetScope on a settable fake clock: (scope, clock-list)."""
+    clock = [t0]
+    scope = FleetScope(
+        clock=lambda: clock[0], bucket_s=bucket_s, windows=windows,
+        stale_after_s=stale_after_s,
+        cohorts=CohortDetector(min_samples=min_samples,
+                               confirm_windows=confirm_windows),
+    )
+    return scope, clock
+
+
+def _counter_text(value, name="nv_x_total"):
+    return (
+        f"# TYPE {name} counter\n"
+        f'{name}{{model="m"}} {value}\n'
+        "# TYPE nv_g gauge\n"
+        "nv_g 7\n"
+    )
+
+
+# --------------------------------------------------------------------------- #
+# unit: scrape plane                                                          #
+# --------------------------------------------------------------------------- #
+
+
+class TestParseExposition:
+    def test_counters_and_gauges_split(self):
+        counters, gauges = parse_exposition(
+            "# TYPE a counter\n"
+            'a{x="1"} 5\n'
+            "# TYPE b gauge\n"
+            "b 2.5\n"
+            "# TYPE c summary\n"
+            'c{quantile="0.5"} 9\n'
+            "untyped_series 1\n"
+        )
+        assert counters == {'a{x="1"}': 5.0}
+        assert gauges == {"b": 2.5}
+
+    def test_garbage_lines_ignored(self):
+        counters, gauges = parse_exposition(
+            "# HELP a whatever\nnot a sample !!\n# TYPE a counter\na nan?\n"
+        )
+        assert counters == {} and gauges == {}
+
+
+class TestScrapeSeries:
+    def test_rates_are_deltas_per_second(self):
+        scope, clock = _scope()
+        scope.observe_scrape("r0", ok=True,
+                             metrics_text=_counter_text(10))
+        clock[0] += 2.0
+        scope.observe_scrape("r0", ok=True,
+                             metrics_text=_counter_text(40))
+        ring = scope.timeseries()["r0"]
+        assert ring[-1]["rates"]['nv_x_total{model="m"}'] == 15.0
+        assert ring[-1]["gauges"]["nv_g"] == 7.0
+
+    def test_counter_reset_treated_as_restart(self):
+        scope, clock = _scope()
+        scope.observe_scrape("r0", ok=True,
+                             metrics_text=_counter_text(100))
+        clock[0] += 1.0
+        scope.observe_scrape("r0", ok=True,
+                             metrics_text=_counter_text(5))
+        ring = scope.timeseries()["r0"]
+        # Monotonicity break: the delta since restart is the new value,
+        # never a huge negative rate.
+        assert ring[-1]["rates"]['nv_x_total{model="m"}'] == 5.0
+        assert scope.scrape_health()["r0"]["counter_resets"] == 1
+
+    def test_ring_bounded_by_windows(self):
+        scope, clock = _scope(windows=5)
+        for i in range(12):
+            scope.observe_scrape("r0", ok=True,
+                                 metrics_text=_counter_text(i))
+            clock[0] += 1.0
+        assert len(scope.timeseries()["r0"]) == 5
+
+    def test_failures_and_staleness(self):
+        scope, clock = _scope(stale_after_s=10.0)
+        scope.observe_scrape("r0", ok=False)
+        assert scope.scrape_health()["r0"]["scrape_failures"] == 1
+        assert scope.stale_replicas(["r0", "never-seen"]) == [
+            "r0", "never-seen",
+        ]
+        scope.observe_scrape("r0", ok=True,
+                             metrics_text=_counter_text(1))
+        assert scope.stale_replicas(["r0"]) == []
+        clock[0] += 11.0
+        assert scope.stale_replicas(["r0"]) == ["r0"]
+
+
+class TestMergedSketches:
+    def test_merge_is_exact_and_within_bound(self):
+        # The acceptance bound: merging per-replica sketches must equal
+        # sketching the pooled samples, and both sit within 2% of the
+        # exact sample p99.
+        samples = {
+            "r0": [1000.0 + 37 * (i % 97) for i in range(400)],
+            "r1": [1500.0 + 53 * (i % 89) for i in range(300)],
+            "r2": [800.0 + 11 * (i % 71) for i in range(500)],
+        }
+        merged_p99, pooled_p99 = merged_p99_matches_pooled(samples)
+        assert merged_p99 == pooled_p99
+        truth = exact_quantile(
+            [v for vs in samples.values() for v in vs], 0.99
+        )
+        assert abs(merged_p99 - truth) / truth <= 0.02
+
+    def test_fleet_rows_from_scrapes(self):
+        scope, clock = _scope()
+        for replica, base in (("r0", 1000), ("r1", 2000)):
+            sketch = LatencySketch()
+            sketch.extend([base + i for i in range(50)])
+            scope.observe_scrape(
+                replica, ok=True, metrics_text=_counter_text(1),
+                sketches_doc={
+                    "kind": "sketches",
+                    "models": {"m": {"request": sketch.to_dict()}},
+                },
+            )
+        rows = scope.merged_sketch_rows()
+        assert [(r["model"], r["stage"], r["count"]) for r in rows] == [
+            ("m", "request", 100),
+        ]
+        assert rows[0]["quantiles"]["0.99"] > 1000
+
+
+# --------------------------------------------------------------------------- #
+# unit: SLO engine                                                            #
+# --------------------------------------------------------------------------- #
+
+
+class TestSloEngine:
+    def test_objective_validation(self):
+        with pytest.raises(ValueError):
+            SloObjective(model="")
+        with pytest.raises(ValueError):
+            SloObjective(model="m", error_budget=0.0)
+        with pytest.raises(ValueError):
+            SloObjective(model="m", error_budget=1.5)
+        with pytest.raises(ValueError):
+            SloObjective(model="m", latency_target_us=0)
+
+    def test_burn_math(self):
+        scope, clock = _scope()
+        scope.set_objective({
+            "model": "m", "latency_target_us": 10_000,
+            "error_budget": 0.1,
+        })
+        # 100 requests, 10 bad (5 errors + 5 over-target): bad fraction
+        # 0.1 against a 0.1 budget = burn exactly 1.0.
+        for i in range(100):
+            ok = i >= 5
+            duration = 50_000 if 5 <= i < 10 else 1_000
+            scope.record_request("m", "", duration, ok, "r0")
+        rows = {row["window"]: row for row in scope.burn_rows()}
+        assert rows[SLO_WINDOW_FAST]["total"] == 100
+        assert rows[SLO_WINDOW_FAST]["bad"] == 10
+        assert rows[SLO_WINDOW_FAST]["burn_rate"] == pytest.approx(1.0)
+        assert rows[SLO_WINDOW_SLOW]["budget_remaining"] == (
+            pytest.approx(0.0)
+        )
+
+    def test_no_samples_is_quiet(self):
+        scope, _clock = _scope()
+        scope.set_objective({"model": "m", "error_budget": 0.1})
+        rows = scope.burn_rows()
+        assert all(row["burn_rate"] == 0.0 for row in rows)
+        assert all(row["budget_remaining"] == 1.0 for row in rows)
+
+    def test_set_remove_objectives(self):
+        scope, _clock = _scope()
+        doc = scope.set_objective({"model": "m", "tenant": "acme"})
+        assert doc["model"] == "m" and doc["tenant"] == "acme"
+        assert scope.objective_docs() == [doc]
+        assert scope.remove_objective("m", "acme") is True
+        assert scope.remove_objective("m", "acme") is False
+        assert scope.objective_docs() == []
+
+
+# --------------------------------------------------------------------------- #
+# unit: cohort detector                                                       #
+# --------------------------------------------------------------------------- #
+
+
+def _pump_bucket(scope, clock, canary_us, baseline_us, n=6, ok=True,
+                 scrape=("r0", "r2")):
+    """One bucket of requests for canary (r2) and baseline (r0), with
+    fresh scrapes for ``scrape`` members (verdicts gate on scrape
+    staleness, so an unscraped replica is always insufficient-data)."""
+    for replica in scrape:
+        scope.observe_scrape(replica, ok=True, metrics_text="")
+    for _ in range(n):
+        scope.record_request("m", "", baseline_us, True, "r0")
+        scope.record_request("m", "", canary_us, ok, "r2")
+    clock[0] += scope.bucket_s
+
+
+class TestCohorts:
+    def test_labels_canonicalized(self):
+        scope, _clock = _scope()
+        assert scope.assign_cohort("r2", "  Canary ") == {
+            "replica": "r2", "cohort": "canary",
+        }
+        assert scope.assign_cohort("r2", "") == {
+            "replica": "r2", "cohort": COHORT_BASELINE,
+        }
+        with pytest.raises(ValueError):
+            scope.assign_cohort("r2", "not a slug!")
+        with pytest.raises(ValueError):
+            scope.assign_cohort("", "canary")
+
+    def test_k_window_confirmation(self):
+        scope, clock = _scope()
+        scope.assign_cohort("r2", "canary")
+        # Two regressed buckets: not yet enough observed windows.
+        _pump_bucket(scope, clock, 50_000, 5_000)
+        _pump_bucket(scope, clock, 50_000, 5_000)
+        (verdict,) = scope.verdicts(["r0", "r2"])
+        assert verdict["verdict"] == COHORT_INSUFFICIENT
+        # Third consecutive regressed bucket confirms.
+        _pump_bucket(scope, clock, 50_000, 5_000)
+        (verdict,) = scope.verdicts(["r0", "r2"])
+        assert verdict["verdict"] == COHORT_REGRESSED
+        assert verdict["windows_regressed"] == 3
+        # One recovered bucket breaks the consecutive run.
+        _pump_bucket(scope, clock, 5_000, 5_000)
+        (verdict,) = scope.verdicts(["r0", "r2"])
+        assert verdict["verdict"] == COHORT_CLEAN
+
+    def test_error_rate_delta_regresses(self):
+        scope, clock = _scope()
+        scope.assign_cohort("r2", "canary")
+        for _ in range(3):
+            # Same latency, but the canary errors 50% of the time vs a
+            # clean baseline: the error-rate arm must trip.
+            for replica in ("r0", "r2"):
+                scope.observe_scrape(replica, ok=True, metrics_text="")
+            for i in range(6):
+                scope.record_request("m", "", 5_000, True, "r0")
+                scope.record_request("m", "", 5_000, i % 2 == 0, "r2")
+            clock[0] += scope.bucket_s
+        (verdict,) = scope.verdicts(["r0", "r2"])
+        assert verdict["verdict"] == COHORT_REGRESSED
+        assert verdict["error_rate"] == pytest.approx(0.5)
+
+    def test_min_sample_gate(self):
+        scope, clock = _scope(min_samples=5)
+        scope.assign_cohort("r2", "canary")
+        for _ in range(3):
+            _pump_bucket(scope, clock, 50_000, 5_000, n=3)
+        (verdict,) = scope.verdicts(["r0", "r2"])
+        assert verdict["verdict"] == COHORT_INSUFFICIENT
+        assert "samples" in verdict["reason"]
+
+    def test_stale_member_forces_insufficient(self):
+        scope, clock = _scope(stale_after_s=2.0)
+        scope.assign_cohort("r2", "canary")
+        scope.observe_scrape("r2", ok=True,
+                             metrics_text=_counter_text(1))
+        for _ in range(3):
+            _pump_bucket(scope, clock, 50_000, 5_000, scrape=("r0",))
+        # The pump advanced the clock past stale_after_s with no fresh
+        # scrape for r2: its cohort may not be judged.
+        (verdict,) = scope.verdicts(["r0", "r2"])
+        assert verdict["verdict"] == COHORT_INSUFFICIENT
+        assert "stale" in verdict["reason"]
+
+
+# --------------------------------------------------------------------------- #
+# unit: journal replay (the restart drill)                                    #
+# --------------------------------------------------------------------------- #
+
+
+class TestJournalReplay:
+    def _record(self, router, path, doc):
+        router.record_admin("POST", path, json.dumps(doc).encode(), {})
+
+    def test_slo_and_cohorts_survive_restart(self, tmp_path):
+        journal = str(tmp_path / "admin.journal")
+        router = FleetRouter(journal_path=journal)
+        objective = router.fleetscope.set_objective({
+            "model": "m", "latency_target_us": 25_000,
+            "error_budget": 0.05,
+        })
+        self._record(router, EP_FLEET_SLO, objective)
+        router.fleetscope.assign_cohort("r1", "canary")
+        self._record(router, EP_FLEET_COHORTS,
+                     {"replica": "r1", "cohort": "canary"})
+        router.fleetscope.assign_cohort("r2", "control")
+        self._record(router, "v2/fleet/replicas/r2/cohort",
+                     {"cohort": "control"})
+
+        # "Restart": a new router over the same journal file.
+        reborn = FleetRouter(journal_path=journal)
+        assert reborn.fleetscope.objective_docs() == [objective]
+        assert reborn.fleetscope.cohort_assignments() == {
+            "r1": "canary", "r2": "control",
+        }
+
+    def test_removal_survives_restart(self, tmp_path):
+        journal = str(tmp_path / "admin.journal")
+        router = FleetRouter(journal_path=journal)
+        doc = router.fleetscope.set_objective({"model": "m"})
+        self._record(router, EP_FLEET_SLO, doc)
+        router.fleetscope.remove_objective("m", "")
+        self._record(router, EP_FLEET_SLO, {"model": "m", "remove": True})
+        reborn = FleetRouter(journal_path=journal)
+        assert reborn.fleetscope.objective_docs() == []
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        journal = str(tmp_path / "admin.journal")
+        router = FleetRouter(journal_path=journal)
+        self._record(router, EP_FLEET_SLO,
+                     router.fleetscope.set_objective({"model": "m"}))
+        with open(journal, "a", encoding="utf-8") as fh:
+            fh.write('{"method": "POST", "pa')  # crash mid-write
+        reborn = FleetRouter(journal_path=journal)
+        assert [o["model"] for o in reborn.fleetscope.objective_docs()] \
+            == ["m"]
+
+    def test_fleet_entries_not_replayed_to_replicas(self, tmp_path):
+        # v2/fleet/* entries are router-local: replaying them to a
+        # rejoining replica would 404 and block the rejoin forever.
+        journal = str(tmp_path / "admin.journal")
+        router = FleetRouter(journal_path=journal)
+        self._record(router, EP_FLEET_SLO,
+                     router.fleetscope.set_objective({"model": "m"}))
+        reborn = FleetRouter(journal_path=journal)
+        replica = reborn.add_replica("r0", "127.0.0.1:1")  # unreachable
+        # Would raise on any HTTP fan-out; fleet-only journals make none.
+        reborn._replay_admin_state(replica)
+
+
+# --------------------------------------------------------------------------- #
+# unit: exposition checker on the new families                                #
+# --------------------------------------------------------------------------- #
+
+
+class TestCheckerFleetscopeFamilies:
+    def _family(self, name, kind, rows):
+        lines = [f"# HELP {name} x", f"# TYPE {name} {kind}"]
+        lines += rows
+        return "\n".join(lines) + "\n"
+
+    def test_valid_families_pass(self):
+        text = (
+            self._family("nv_fleet_scrape_age_s", "gauge",
+                         ['nv_fleet_scrape_age_s{replica="r0"} 0.25'])
+            + self._family(
+                "nv_fleet_slo_burn_rate", "gauge",
+                ['nv_fleet_slo_burn_rate{model="m",tenant="",'
+                 'window="fast"} 2.5'])
+            + self._family(
+                "nv_fleet_slo_budget_remaining", "gauge",
+                ['nv_fleet_slo_budget_remaining{model="m",tenant=""} '
+                 "0.75"])
+            + self._family(
+                "nv_fleet_cohort_requests_total", "counter",
+                ['nv_fleet_cohort_requests_total{cohort="baseline"} 9'])
+            + self._family(
+                "nv_engine_kv_bytes_touched_total", "counter",
+                ['nv_engine_kv_bytes_touched_total{model="m",'
+                 'phase="decode"} 4096'])
+        )
+        assert check_exposition(text) == []
+
+    def test_negative_scrape_age_flagged(self):
+        text = self._family("nv_fleet_scrape_age_s", "gauge",
+                            ['nv_fleet_scrape_age_s{replica="r0"} -1'])
+        assert any("scrape age" in e for e in check_exposition(text))
+
+    def test_unknown_burn_window_flagged(self):
+        text = self._family(
+            "nv_fleet_slo_burn_rate", "gauge",
+            ['nv_fleet_slo_burn_rate{model="m",tenant="",window="1h"} 1'])
+        assert any("window '1h'" in e for e in check_exposition(text))
+
+    def test_budget_out_of_range_flagged(self):
+        text = self._family(
+            "nv_fleet_slo_budget_remaining", "gauge",
+            ['nv_fleet_slo_budget_remaining{model="m",tenant=""} 1.2'])
+        assert any("outside [0, 1]" in e for e in check_exposition(text))
+
+    def test_uncanonical_cohort_flagged(self):
+        text = self._family(
+            "nv_fleet_cohort_requests_total", "counter",
+            ['nv_fleet_cohort_requests_total{cohort="Canary A"} 1'])
+        assert any("lowercase slug" in e for e in check_exposition(text))
+
+    def test_unknown_kv_phase_flagged(self):
+        text = self._family(
+            "nv_engine_kv_bytes_touched_total", "counter",
+            ['nv_engine_kv_bytes_touched_total{model="m",'
+             'phase="warmup"} 1'])
+        assert any("phase 'warmup'" in e for e in check_exposition(text))
+
+
+# --------------------------------------------------------------------------- #
+# integration: 3 replicas, the SLO plane end to end                           #
+# --------------------------------------------------------------------------- #
+
+
+@pytest.fixture(scope="module")
+def slo_fleet():
+    replicas = [
+        InferenceServer(
+            models=[FleetDeviceModel(service_ms=SERVICE_MS)], grpc=False
+        ).start()
+        for _ in range(3)
+    ]
+    replica_set = ReplicaSet(probe_interval_s=0.1, eject_after=3,
+                             backoff_base_s=0.2)
+    fleetscope = FleetScope(
+        bucket_s=1.0, windows=120, stale_after_s=30.0,
+        cohorts=CohortDetector(min_samples=3, confirm_windows=3),
+    )
+    router = FleetRouter(replicas=replica_set, fleetscope=fleetscope)
+    for i, r in enumerate(replicas):
+        router.add_replica(f"r{i}", r.http_address)
+    replica_set.probe_once()
+    server = FleetServer(router, grpc=False)
+    server.start()
+    yield replicas, replica_set, router, server
+    server.stop()
+    for r in replicas:
+        r.stop()
+
+
+@pytest.fixture()
+def slo_base(slo_fleet):
+    return f"http://{slo_fleet[3].http_address}"
+
+
+def _next_bucket(scope):
+    """Sleep to just past the next bucket boundary so one batch of
+    requests lands entirely inside one bucket."""
+    now = time.monotonic()
+    edge = (int(now / scope.bucket_s) + 1) * scope.bucket_s
+    time.sleep(edge - now + 0.05)  # tpulint: disable=TPU001 (test pacing)
+
+
+class TestFleetscopeIntegration:
+    def test_admin_and_dump_endpoints(self, slo_fleet, slo_base):
+        router = slo_fleet[2]
+        resp = requests.post(slo_base + "/" + EP_FLEET_SLO, json={
+            "model": "fleet_device", "latency_target_us": 1_000_000,
+            "error_budget": 0.1,
+        })
+        assert resp.status_code == 200
+        assert resp.json()["model"] == "fleet_device"
+        assert requests.post(slo_base + "/" + EP_FLEET_SLO, json={
+            "model": "", "error_budget": 5,
+        }).status_code == 400
+        assert requests.post(slo_base + "/" + EP_FLEET_COHORTS, json={
+            "replica": "r1", "cohort": "not a slug!",
+        }).status_code == 400
+
+        for i in range(6):
+            assert requests.post(
+                slo_base + "/v2/models/fleet_device/infer",
+                json=_infer_body(i),
+            ).status_code == 200
+        # Two probe ticks so rates (deltas) exist, sketches are pulled.
+        slo_fleet[1].probe_once()
+        time.sleep(0.05)  # tpulint: disable=TPU001 (distinct scrape t)
+        slo_fleet[1].probe_once()
+
+        dump = requests.get(
+            slo_base + "/" + EP_FLEET_FLEETSCOPE
+        ).json()
+        assert dump["kind"] == "fleetscope"
+        assert sorted(dump["scrape_health"]) == ["r0", "r1", "r2"]
+        assert all(
+            h["samples_retained"] >= 1
+            for h in dump["scrape_health"].values()
+        )
+        assert any(
+            row["model"] == "fleet_device"
+            for row in dump["merged_sketches"]
+        )
+        slo_doc = requests.get(slo_base + "/" + EP_FLEET_SLO).json()
+        assert slo_doc["kind"] == "fleet_slo"
+        assert [o["model"] for o in slo_doc["objectives"]] == [
+            "fleet_device",
+        ]
+        # The report loads the dump end to end.
+        result = fleet_report.analyze(dump)
+        assert [r["replica"] for r in result["replicas"]] == [
+            "r0", "r1", "r2",
+        ]
+        assert fleet_report.render(result)
+
+    def test_router_exposition_passes_checker(self, slo_fleet, slo_base):
+        requests.post(slo_base + "/" + EP_FLEET_SLO, json={
+            "model": "fleet_device", "error_budget": 0.1,
+        })
+        for i in range(4):
+            requests.post(
+                slo_base + "/v2/models/fleet_device/infer",
+                json=_infer_body(i),
+            )
+        text = requests.get(slo_base + "/metrics").text
+        assert check_exposition(text) == []
+        for family in ("nv_fleet_scrape_age_s",
+                       "nv_fleet_scrape_failures_total",
+                       "nv_fleet_slo_burn_rate",
+                       "nv_fleet_slo_budget_remaining",
+                       "nv_fleet_cohort_requests_total"):
+            assert family in text
+
+    def test_replica_exposition_has_kv_bytes_family(self, slo_fleet):
+        replica = slo_fleet[0][0]
+        text = requests.get(
+            f"http://{replica.http_address}/metrics"
+        ).text
+        assert check_exposition(text) == []
+        assert "nv_engine_kv_bytes_touched_total" in text
+
+    def test_merged_flight_dump_round_trip(self, slo_fleet, slo_base,
+                                           tmp_path):
+        for i in range(9):
+            requests.post(
+                slo_base + "/v2/models/fleet_device/infer",
+                json=_infer_body(i),
+                headers={"traceparent":
+                         f"00-{i:032x}-{i:016x}-01"},
+            )
+        dump = requests.get(
+            slo_base + "/" + EP_FLEET_FLIGHT_RECORDER
+        ).json()
+        assert dump["kind"] == "fleet_flight_recorder"
+        assert dump["replicas"] == ["r0", "r1", "r2"]
+        stamps = {r["replica"] for r in dump["records"]}
+        assert "router" in stamps
+        assert stamps & {"r0", "r1", "r2"}
+
+        # The merged dump feeds BOTH reports: tail_report attributes
+        # per replica, fleet_report counts the merge.
+        path = tmp_path / "fleet_flight.json"
+        path.write_text(json.dumps(dump))
+        records = tail_report.load_records(str(path))
+        analysis = tail_report.analyze(records)
+        assert {row["replica"] for row in analysis["replicas"]} == stamps
+        assert "replica" in tail_report.render(analysis, [])
+        fdoc = requests.get(slo_base + "/" + EP_FLEET_FLEETSCOPE).json()
+        result = fleet_report.analyze(fdoc, flight=dump)
+        assert sum(result["flight"]["records"].values()) == len(
+            dump["records"]
+        )
+
+    def test_chaos_cohort_regression_drill(self, slo_fleet, slo_base):
+        """The acceptance drill: inject latency into one cohort's
+        replica via TPUCHAOS; its cohort must report ``regressed`` and
+        the untouched control cohort ``clean`` — zero false positives.
+        Deterministic: the latency rule fires on every r2 exchange."""
+        router = slo_fleet[2]
+        scope = router.fleetscope
+        assert requests.post(
+            slo_base + "/v2/fleet/replicas/r2/cohort",
+            json={"cohort": "canary"},
+        ).status_code == 200
+        assert requests.post(
+            slo_base + "/" + EP_FLEET_COHORTS,
+            json={"replica": "r1", "cohort": "control"},
+        ).status_code == 200
+
+        site = chaos.SITE_FLEET_REPLICA_PREFIX + "r2"
+        with chaos.session(1337, f"{site}=latency@ms=60"):
+            for _bucket in range(3):
+                _next_bucket(scope)
+                for i in range(18):
+                    assert requests.post(
+                        slo_base + "/v2/models/fleet_device/infer",
+                        json=_infer_body(i),
+                    ).status_code == 200
+
+        doc = requests.get(slo_base + "/" + EP_FLEET_COHORTS).json()
+        assert doc["kind"] == "fleet_cohorts"
+        verdicts = {v["cohort"]: v for v in doc["verdicts"]}
+        canary = verdicts["canary"]
+        assert canary["verdict"] == COHORT_REGRESSED, canary
+        assert canary["p99_us"] > 1.5 * canary["baseline_p99_us"]
+        control = verdicts["control"]
+        assert control["verdict"] == COHORT_CLEAN, control
+        assert doc["requests"]["canary"] >= 9
+        # The fleet report renders the drill's outcome.
+        dump = requests.get(slo_base + "/" + EP_FLEET_FLEETSCOPE).json()
+        text = fleet_report.render(fleet_report.analyze(dump))
+        assert "regressed" in text and "canary" in text

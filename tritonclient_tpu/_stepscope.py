@@ -88,6 +88,7 @@ STEP_METRIC = "nv_engine_step_duration_us_quantiles"
 COLLECTIVES_METRIC = "nv_engine_collectives_total"
 OVERLAP_METRIC = "nv_engine_collective_overlap_us_total"
 INFLIGHT_METRIC = "nv_engine_inflight_steps"
+KV_BYTES_METRIC = "nv_engine_kv_bytes_touched_total"
 
 # The exposed/hidden vocabulary is spelled once in protocol/_literals (the
 # wire-literal module); the fallback keeps stepscope importable standalone.
@@ -125,7 +126,7 @@ class StepRecord:
         "t_begin", "t_dispatch", "t_end",
         "dispatch_us", "device_us", "other_us", "total_us",
         "micro_steps", "coll_exposed_us", "coll_hidden_us",
-        "collectives", "thread_ident", "thread_name",
+        "collectives", "kv_bytes", "thread_ident", "thread_name",
     )
 
     def __init__(self, model: str, phase: str, step_index: int,
@@ -150,6 +151,10 @@ class StepRecord:
         self.coll_hidden_us = 0
         # op -> [count, bytes]
         self.collectives: Dict[str, List[int]] = {}
+        # Paged-KV bytes this step touched (blocks gathered x block
+        # bytes from the block-table extent); the engine sets it on the
+        # thread-owned record before step_end.
+        self.kv_bytes = 0
         thread = threading.current_thread()
         self.thread_ident = thread.ident or 0
         self.thread_name = thread.name
@@ -176,6 +181,7 @@ class StepRecord:
                 op: {"count": c, "bytes": b}
                 for op, (c, b) in sorted(self.collectives.items())
             },
+            "kv_bytes": self.kv_bytes,
             "thread_ident": self.thread_ident,
             "thread_name": self.thread_name,
         }
@@ -203,6 +209,8 @@ class _Aggregator:
             self.step_counts: Dict[Tuple[str, str], int] = {}
             # (model, op) -> [count, bytes]
             self.collectives: Dict[Tuple[str, str], List[int]] = {}
+            # (model, phase) -> cumulative paged-KV bytes touched
+            self.kv_bytes: Dict[Tuple[str, str], int] = {}
             # (model, kind) -> cumulative µs; kind in OVERLAP_KINDS
             self.overlap: Dict[Tuple[str, str], int] = {}
             # model -> decode dispatches currently in flight
@@ -233,6 +241,10 @@ class _Aggregator:
                 cell = self.collectives.setdefault((rec.model, op), [0, 0])
                 cell[0] += count
                 cell[1] += nbytes
+            if rec.kv_bytes:
+                self.kv_bytes[ck] = (
+                    self.kv_bytes.get(ck, 0) + rec.kv_bytes
+                )
             if rec.coll_exposed_us or rec.coll_hidden_us:
                 for kind, us in ((OVERLAP_KIND_EXPOSED, rec.coll_exposed_us),
                                  (OVERLAP_KIND_HIDDEN, rec.coll_hidden_us)):
@@ -467,6 +479,17 @@ def metrics_snapshot(quantiles: Tuple[float, ...]):
     return step_rows, collective_rows
 
 
+def kv_bytes_snapshot() -> List[Tuple[str, str, int]]:
+    """``(model, phase, cumulative bytes)`` rows for the
+    nv_engine_kv_bytes_touched_total exposition family."""
+    agg = _aggregator
+    with agg._lock:
+        return [
+            (model, phase, total)
+            for (model, phase), total in sorted(agg.kv_bytes.items())
+        ]
+
+
 def flight_attributes(model: str) -> Dict[str, object]:
     """Slowest-step breakdown for the given model, as span attributes the
     flight recorder stamps onto retained records. Empty when stepscope is
@@ -556,6 +579,10 @@ def dump() -> dict:
             f"{model}|{kind}": us
             for (model, kind), us in sorted(agg.overlap.items())
         }
+        kv_bytes = {
+            f"{model}|{phase}": total
+            for (model, phase), total in sorted(agg.kv_bytes.items())
+        }
         inflight = dict(sorted(agg.inflight.items()))
         slowest = dict(agg.slowest)
     return {
@@ -565,6 +592,7 @@ def dump() -> dict:
         "step_counts": step_counts,
         "collectives": collectives,
         "overlap": overlap,
+        "kv_bytes": kv_bytes,
         "inflight": inflight,
         "slowest": slowest,
     }

@@ -94,6 +94,11 @@ SITE_GRPC_CALL = "grpc.call"
 SITE_FLEET_CONNECT = "fleet.exchange.connect"
 SITE_FLEET_SEND = "fleet.exchange.send"
 SITE_FLEET_RESPONSE = "fleet.exchange.response"
+#: Per-replica proxy site: the full name is this prefix + the replica
+#: name (``fleet.exchange.replica.r2``), so a plan can fault ONE
+#: replica's traffic — the cohort-drill lever (inject latency into the
+#: canary cohort only, leave the baseline clean).
+SITE_FLEET_REPLICA_PREFIX = "fleet.exchange.replica."
 SITE_SHM_REGISTER = "shm.register"
 
 

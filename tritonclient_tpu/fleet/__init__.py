@@ -38,6 +38,10 @@ from tritonclient_tpu.fleet._admission import (  # noqa: F401
     AdmissionController,
     TenantQuota,
 )
+from tritonclient_tpu.fleet._fleetscope import (  # noqa: F401
+    FleetScope,
+    parse_exposition,
+)
 from tritonclient_tpu.fleet._grpc import RouterGRPCFrontend  # noqa: F401
 from tritonclient_tpu.fleet._http import RouterHTTPFrontend  # noqa: F401
 from tritonclient_tpu.fleet._policy import (  # noqa: F401
@@ -53,6 +57,11 @@ from tritonclient_tpu.fleet._replica import (  # noqa: F401
 from tritonclient_tpu.fleet._router import (  # noqa: F401
     FleetError,
     FleetRouter,
+)
+from tritonclient_tpu.fleet._slo import (  # noqa: F401
+    CohortDetector,
+    SloObjective,
+    SloRegistry,
 )
 
 

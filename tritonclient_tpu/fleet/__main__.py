@@ -87,6 +87,18 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--breaker-reset", type=float, default=2.0,
                         metavar="SECONDS")
+    parser.add_argument(
+        "--slo-config", default="", metavar="PATH",
+        help="JSON file declaring SLO objectives: a list of {model, "
+        "tenant, latency_target_us, error_budget} documents (the same "
+        "schema POST v2/fleet/slo takes)",
+    )
+    parser.add_argument(
+        "--journal-file", default="", metavar="PATH",
+        help="persist the admin journal (shm/repository admin, SLO "
+        "objectives, cohort assignments) as JSON lines; reloaded on "
+        "router restart",
+    )
     parser.add_argument("--probe-interval", type=float, default=1.0,
                         metavar="SECONDS")
     parser.add_argument("--host", default="127.0.0.1")
@@ -115,7 +127,13 @@ def main(argv=None) -> int:
         breaker_failure_threshold=args.breaker_failures,
         breaker_reset_s=args.breaker_reset,
         hedge_us=args.hedge_us or None,
+        journal_path=args.journal_file or None,
     )
+    if args.slo_config:
+        with open(args.slo_config) as f:
+            objectives = json.load(f)
+        for doc in objectives:
+            router.fleetscope.set_objective(doc)
     for name, http_addr, grpc_addr in replicas:
         router.add_replica(name, http_addr, grpc_addr)
     replica_set.probe_once()  # routable before the address file appears

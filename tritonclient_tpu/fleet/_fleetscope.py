@@ -1,0 +1,468 @@
+"""fleetscope: fleet-wide time-series retention + merged observability.
+
+Until now every observability plane stopped at one replica's boundary:
+the router scraped ``/metrics`` but kept only the LATEST sample, and
+flight records died inside each replica. fleetscope is the fleet-level
+substrate the autoscaler (ROADMAP 4) and canary auto-rollback
+(ROADMAP 5) consume:
+
+* **time-series retention** — per-replica ring buffers (bounded by
+  ``TPU_FLEETSCOPE_WINDOWS``) of parsed counter *deltas* (rates,
+  monotonicity-checked so a replica restart resets cleanly instead of
+  producing a huge negative rate) and gauge samples, riding the
+  prober's existing scrape tick;
+* **exact sketch merges** — each scrape also fetches the replica's raw
+  DDSketch state (``GET v2/debug/sketches``); fleet-wide
+  per-model/per-stage p50/p99/p999 come from bucket-wise
+  :meth:`~tritonclient_tpu._sketch.LatencySketch.merge` (exact — never
+  an approximation over resolved quantiles);
+* **request plane** — the router's proxy path reports every routed
+  request (:meth:`FleetScope.record_request`), feeding the SLO burn
+  windows, the cohort detector's per-cohort sketches, and a bounded
+  proxy-side flight ring (the router half of the merged timeline);
+* **merged flight dump** — :meth:`merged_flight_dump` fans out to every
+  READY replica's PR-6 dump endpoint, stamps each record with the
+  replica name, and merges the router's proxy records keyed by
+  traceparent, so ONE dump shows the full router→replica timeline.
+
+Locking: one named lock guards all retained state; scrape/flight I/O
+always happens OUTSIDE it (the prober calls
+:meth:`observe_scrape` with already-fetched text, and the flight
+fan-out collects replica dumps before taking the lock).
+"""
+
+import re
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from tritonclient_tpu import sanitize
+from tritonclient_tpu._sketch import LatencySketch
+from tritonclient_tpu.fleet._replica import http_call
+from tritonclient_tpu.fleet._slo import (
+    CohortDetector,
+    SloRegistry,
+    max_windows,
+    window_s,
+)
+from tritonclient_tpu.protocol._literals import (
+    EP_FLIGHT_RECORDER,
+)
+
+#: A replica whose last successful scrape (or routed request) is older
+#: than this is "stale": its samples are withheld from cohort verdicts
+#: (``insufficient-data``) instead of silently trusted.
+DEFAULT_STALE_AFTER_S = 30.0
+
+#: Proxy-side flight ring bound (router half of the merged timeline).
+_DEFAULT_FLIGHT_RING = 512
+
+_SERIES_RE = re.compile(
+    r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{[^}]*\})?\s+([0-9.eE+-]+|NaN)\s*$"
+)
+_TYPE_RE = re.compile(r"^# TYPE\s+(\S+)\s+(\S+)\s*$")
+
+
+def parse_exposition(text: str) -> Tuple[Dict[str, float], Dict[str, float]]:
+    """Split one Prometheus exposition into ``(counters, gauges)`` maps
+    of full series id (``name{labels}``) -> value. Summary/untyped
+    families are ignored — rates only make sense on counters and
+    point-in-time values on gauges."""
+    types: Dict[str, str] = {}
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    for line in text.splitlines():
+        m = _TYPE_RE.match(line)
+        if m:
+            types[m.group(1)] = m.group(2)
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SERIES_RE.match(line)
+        if m is None:
+            continue
+        name, labels, raw = m.group(1), m.group(2) or "", m.group(3)
+        try:
+            value = float(raw)
+        except ValueError:
+            continue
+        kind = types.get(name)
+        if kind == "counter":
+            counters[name + labels] = value
+        elif kind == "gauge":
+            gauges[name + labels] = value
+    return counters, gauges
+
+
+class _ReplicaSeries:
+    """One replica's retained scrape history (owned by FleetScope;
+    mutated only under its lock)."""
+
+    __slots__ = ("last_counters", "last_t", "last_scrape_t",
+                 "scrape_failures", "resets", "ring", "sketches",
+                 "last_restarts")
+
+    def __init__(self, limit: int):
+        self.last_counters: Dict[str, float] = {}
+        self.last_t: Optional[float] = None
+        self.last_scrape_t: Optional[float] = None
+        self.scrape_failures = 0
+        # Counter resets observed (value decreased — the replica
+        # restarted between scrapes); cross-checked against the
+        # router's nv_fleet_replica_restarts_total in dumps.
+        self.resets = 0
+        self.last_restarts = 0
+        # ring of {"t", "rates": {series: per-second rate},
+        #          "gauges": {series: value}}
+        self.ring: deque = deque(maxlen=limit)
+        # model -> stage -> latest raw sketch doc from the replica
+        self.sketches: Dict[str, Dict[str, dict]] = {}
+
+
+class FleetScope:
+    """Fleet-wide SLO plane state: scrape time series, merged sketches,
+    SLO burn windows, cohort detection, and the proxy flight ring."""
+
+    def __init__(self, clock=time.monotonic,
+                 bucket_s: Optional[float] = None,
+                 windows: Optional[int] = None,
+                 stale_after_s: float = DEFAULT_STALE_AFTER_S,
+                 slo: Optional[SloRegistry] = None,
+                 cohorts: Optional[CohortDetector] = None,
+                 flight_ring: int = _DEFAULT_FLIGHT_RING):
+        self._clock = clock
+        self.bucket_s = float(bucket_s) if bucket_s else window_s()
+        self.windows = int(windows) if windows else max_windows()
+        self.stale_after_s = float(stale_after_s)
+        self.slo = slo if slo is not None else SloRegistry()
+        self.cohorts = cohorts if cohorts is not None else CohortDetector()
+        self._series: Dict[str, _ReplicaSeries] = {}
+        self._flight: deque = deque(maxlen=max(int(flight_ring), 16))
+        self._flight_seq = 0
+        self._requests_by_cohort: Dict[str, int] = {}
+        self._lock = sanitize.named_lock("fleet.FleetScope._lock")
+
+    # -- clock ----------------------------------------------------------------
+
+    def bucket_index(self, now: Optional[float] = None) -> int:
+        now = self._clock() if now is None else now
+        return int(now / self.bucket_s)
+
+    # -- scrape plane (prober-driven) -----------------------------------------
+
+    def observe_scrape(self, replica: str, ok: bool,
+                       metrics_text: str = "",
+                       sketches_doc: Optional[dict] = None,
+                       restarts: int = 0,
+                       now: Optional[float] = None):
+        """Absorb one prober tick for ``replica``. ``ok=False`` counts a
+        scrape failure (staleness accrues until the next success).
+        Parsing happens outside the lock — only the ring mutation and
+        delta bookkeeping are locked."""
+        now = self._clock() if now is None else now
+        if not ok:
+            with self._lock:
+                series = self._series.get(replica)
+                if series is None:
+                    series = self._series[replica] = _ReplicaSeries(
+                        self.windows
+                    )
+                series.scrape_failures += 1
+            return
+        counters, gauges = parse_exposition(metrics_text or "")
+        with self._lock:
+            series = self._series.get(replica)
+            if series is None:
+                series = self._series[replica] = _ReplicaSeries(
+                    self.windows
+                )
+            rates: Dict[str, float] = {}
+            dt = (now - series.last_t) if series.last_t is not None else 0.0
+            restarted = restarts > series.last_restarts
+            for key, value in counters.items():
+                prev = series.last_counters.get(key)
+                if prev is None or dt <= 0:
+                    continue
+                delta = value - prev
+                if delta < 0:
+                    # Monotonicity break: the replica restarted and its
+                    # counters reset to zero — the delta since restart
+                    # is the new value (Prometheus reset semantics).
+                    series.resets += 1
+                    delta = value
+                rates[key] = delta / dt
+            _ = restarted  # cross-check surface: dumps expose both
+            series.last_counters = counters
+            series.last_restarts = max(restarts, series.last_restarts)
+            series.last_t = now
+            series.last_scrape_t = now
+            series.ring.append({
+                "t": now,
+                "bucket": self.bucket_index(now),
+                "rates": rates,
+                "gauges": gauges,
+            })
+            if sketches_doc and isinstance(sketches_doc, dict):
+                models = sketches_doc.get("models")
+                if isinstance(models, dict):
+                    series.sketches = models
+
+    # -- request plane (router-driven) ----------------------------------------
+
+    def record_request(self, model: str, tenant: str, duration_us: int,
+                       ok: bool, replica: str, trace_id: str = "",
+                       now: Optional[float] = None,
+                       wall_time_s: Optional[float] = None):
+        """One routed request's outcome, observed at the router: feeds
+        the SLO burn windows, the cohort sketches, and the proxy-side
+        flight ring (the router half of the merged timeline)."""
+        now = self._clock() if now is None else now
+        index = self.bucket_index(now)
+        with self._lock:
+            self.slo.record(model, tenant, duration_us, ok, index,
+                            self.windows)
+            self.cohorts.record(replica, duration_us, ok, index,
+                                self.windows)
+            cohort = self.cohorts.cohort_of(replica)
+            self._requests_by_cohort[cohort] = (
+                self._requests_by_cohort.get(cohort, 0) + 1
+            )
+            self._flight_seq += 1
+            self._flight.append({
+                "seq": self._flight_seq,
+                "model_name": model,
+                "model_version": "",
+                "request_id": "",
+                "trace_id": trace_id or "",
+                "parent_span_id": "",
+                "duration_us": int(duration_us),
+                "status": "ok" if ok else "error",
+                "error": "" if ok else "proxied request failed",
+                "stages_us": {"proxy": int(duration_us)},
+                "timestamps": {},
+                "attributes": {"tenant": tenant, "fleet.replica": replica},
+                "wall_time_s": (
+                    time.time() if wall_time_s is None else wall_time_s
+                ),
+                "replica": "router",
+            })
+
+    # -- staleness ------------------------------------------------------------
+
+    def stale_replicas(self, replicas: List[str],
+                       now: Optional[float] = None) -> List[str]:
+        """Replicas whose last successful scrape is missing or older
+        than ``stale_after_s`` — their cohorts answer
+        ``insufficient-data`` rather than judging on old samples."""
+        now = self._clock() if now is None else now
+        stale = []
+        with self._lock:
+            for name in replicas:
+                series = self._series.get(name)
+                if (series is None or series.last_scrape_t is None
+                        or now - series.last_scrape_t
+                        > self.stale_after_s):
+                    stale.append(name)
+        return stale
+
+    def scrape_health(self) -> Dict[str, dict]:
+        """Per-replica scrape bookkeeping for dumps/status."""
+        now = self._clock()
+        with self._lock:
+            return {
+                name: {
+                    "scrape_age_s": (
+                        now - series.last_scrape_t
+                        if series.last_scrape_t is not None else None
+                    ),
+                    "scrape_failures": series.scrape_failures,
+                    "counter_resets": series.resets,
+                    "samples_retained": len(series.ring),
+                }
+                for name, series in sorted(self._series.items())
+            }
+
+    # -- merged sketches ------------------------------------------------------
+
+    def merged_sketch_rows(
+        self, quantiles: Tuple[float, ...] = (0.5, 0.99, 0.999)
+    ) -> List[dict]:
+        """Fleet-wide per-model/per-stage quantiles from EXACT
+        bucket-wise merges of the replicas' raw DDSketch state."""
+        with self._lock:
+            pending: Dict[Tuple[str, str], List[dict]] = {}
+            for series in self._series.values():
+                for model, stages in series.sketches.items():
+                    for stage, doc in stages.items():
+                        pending.setdefault((model, stage), []).append(doc)
+        rows = []
+        for (model, stage), docs in sorted(pending.items()):
+            merged = LatencySketch.merged(
+                [LatencySketch.from_dict(d) for d in docs]
+            )
+            rows.append({
+                "model": model,
+                "stage": stage,
+                "count": merged.count,
+                "quantiles": {
+                    str(q): merged.quantile(q) for q in quantiles
+                },
+            })
+        return rows
+
+    # -- SLO / cohorts --------------------------------------------------------
+
+    def set_objective(self, doc: dict) -> dict:
+        """Declare (or replace) one SLO objective from its admin/config
+        document. Returns the canonical form."""
+        from tritonclient_tpu.fleet._slo import SloObjective
+
+        objective = SloObjective.from_dict(doc)
+        with self._lock:
+            self.slo.set_objective(objective)
+        return objective.to_dict()
+
+    def remove_objective(self, model: str, tenant: str = "") -> bool:
+        with self._lock:
+            return self.slo.remove_objective(model, tenant)
+
+    def objective_docs(self) -> List[dict]:
+        with self._lock:
+            return [o.to_dict() for o in self.slo.objectives()]
+
+    def assign_cohort(self, replica: str, cohort: str) -> dict:
+        with self._lock:
+            self.cohorts.assign(replica, cohort)
+            return {"replica": replica,
+                    "cohort": self.cohorts.cohort_of(replica)}
+
+    def cohort_assignments(self) -> Dict[str, str]:
+        with self._lock:
+            return self.cohorts.assignments()
+
+    def burn_rows(self, now: Optional[float] = None) -> List[dict]:
+        index = self.bucket_index(now)
+        with self._lock:
+            return self.slo.burn_rows(index)
+
+    def verdicts(self, replicas: List[str],
+                 now: Optional[float] = None) -> List[dict]:
+        now = self._clock() if now is None else now
+        stale = self.stale_replicas(replicas, now=now)
+        index = self.bucket_index(now)
+        with self._lock:
+            return self.cohorts.verdicts(index, replicas, stale=stale)
+
+    def cohort_request_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._requests_by_cohort)
+
+    # -- flight merge ---------------------------------------------------------
+
+    def proxy_flight_records(self) -> List[dict]:
+        with self._lock:
+            return [dict(r) for r in self._flight]
+
+    def merged_flight_dump(self, targets: List[Tuple[str, str]],
+                           timeout_s: float = 2.0) -> dict:
+        """Fan out to every (name, http_address) target's flight
+        recorder dump, stamp records with the replica name, and merge
+        with the router's proxy records keyed by traceparent. I/O runs
+        with NO fleetscope lock held."""
+        import json as _json
+
+        per_replica: Dict[str, dict] = {}
+        errors: Dict[str, str] = {}
+        for name, address in targets:
+            try:
+                status, body = http_call(
+                    address, "GET", EP_FLIGHT_RECORDER,
+                    timeout_s=timeout_s,
+                )
+                if status != 200:
+                    errors[name] = f"HTTP {status}"
+                    continue
+                per_replica[name] = _json.loads(body)
+            except (OSError, ValueError) as e:
+                errors[name] = f"{type(e).__name__}: {e}"
+        records: List[dict] = []
+        counters = {"offered": 0, "retained_slow": 0, "errors": 0,
+                    "deadline_misses": 0}
+        for name, doc in sorted(per_replica.items()):
+            for key_from, key_to in (("offered", "offered"),
+                                     ("retained_slow", "retained_slow"),
+                                     ("errors", "errors"),
+                                     ("deadline_misses",
+                                      "deadline_misses")):
+                counters[key_to] += int(
+                    (doc.get("counters") or {}).get(key_from, 0) or 0
+                )
+            for rec in doc.get("records", ()):
+                stamped = dict(rec)
+                stamped["replica"] = name
+                records.append(stamped)
+        records.extend(self.proxy_flight_records())
+        # Merge keyed by traceparent: records sharing a trace_id sort
+        # together (router proxy span first by wall time), the rest
+        # interleave chronologically.
+        by_trace: Dict[str, int] = {}
+        for rec in records:
+            trace = rec.get("trace_id") or ""
+            if trace and trace not in by_trace:
+                by_trace[trace] = len(by_trace)
+
+        def sort_key(rec):
+            trace = rec.get("trace_id") or ""
+            wall = float(rec.get("wall_time_s") or 0.0)
+            if trace in by_trace:
+                return (0, by_trace[trace], wall)
+            return (1, 0, wall)
+
+        records.sort(key=sort_key)
+        return {
+            "kind": "fleet_flight_recorder",
+            "replicas": sorted(per_replica),
+            "unreachable": errors,
+            "counters": counters,
+            "records": records,
+        }
+
+    # -- dump -----------------------------------------------------------------
+
+    def timeseries(self) -> Dict[str, List[dict]]:
+        with self._lock:
+            return {
+                name: [dict(sample) for sample in series.ring]
+                for name, series in sorted(self._series.items())
+            }
+
+    def dump(self, replicas: Optional[List[str]] = None) -> dict:
+        """Self-describing document ``scripts/fleet_report.py`` loads."""
+        replicas = list(replicas or [])
+        now = self._clock()
+        doc = {
+            "kind": "fleetscope",
+            "config": {
+                "bucket_s": self.bucket_s,
+                "windows": self.windows,
+                "stale_after_s": self.stale_after_s,
+            },
+            "scrape_health": self.scrape_health(),
+            "timeseries": self.timeseries(),
+            "merged_sketches": self.merged_sketch_rows(),
+            "slo": {
+                "objectives": self.objective_docs(),
+                "burn": self.burn_rows(now=now),
+            },
+            "cohorts": {
+                "assignments": self.cohort_assignments(),
+                "requests": self.cohort_request_counts(),
+                "verdicts": self.verdicts(replicas, now=now),
+            },
+        }
+        return doc
+
+
+# The observer protocol the ReplicaSet prober drives: anything with
+# ``observe_scrape`` works; FleetScope is the shipped implementation.
+Observer = FleetScope

@@ -186,6 +186,15 @@ def make_router_handler(router: FleetRouter,
             "Drain RPC is a replica-level control",
         )
 
+    def fleet_flight_recorder(request: bytes, context):
+        # Answered LOCALLY: the trailing RPC_METHODS loop would
+        # otherwise forward this to one replica, which cannot merge
+        # the fleet. The fan-out to replica dump endpoints happens
+        # inside merged_flight_dump (HTTP, outside any router lock).
+        import json as _json
+
+        return _json.dumps(router.merged_flight_dump()).encode()
+
     def model_infer(request: bytes, context):
         """Unary inference: admission + balance + policy-driven
         failover (same RetryPolicy instance as the HTTP proxy, so the
@@ -383,6 +392,11 @@ def make_router_handler(router: FleetRouter,
         ),
         "Drain": grpc.unary_unary_rpc_method_handler(
             drain,
+            request_deserializer=_ident,
+            response_serializer=_ident,
+        ),
+        "FleetFlightRecorder": grpc.unary_unary_rpc_method_handler(
+            fleet_flight_recorder,
             request_deserializer=_ident,
             response_serializer=_ident,
         ),

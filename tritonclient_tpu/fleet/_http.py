@@ -28,6 +28,7 @@ import json
 import queue
 import socket
 import threading
+import time
 from http.client import HTTPConnection
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
@@ -41,6 +42,10 @@ from tritonclient_tpu.resilience import (
     PHASE_SEND,
 )
 from tritonclient_tpu.protocol._literals import (
+    EP_FLEET_COHORTS,
+    EP_FLEET_FLEETSCOPE,
+    EP_FLEET_FLIGHT_RECORDER,
+    EP_FLEET_SLO,
     EP_FLEET_STATUS,
     EP_HEALTH_LIVE,
     EP_HEALTH_READY,
@@ -298,11 +303,73 @@ class _RouterHandler(BaseHTTPRequestHandler):
         if path == EP_FLEET_STATUS:
             self._read_body()
             return self._send_json(router.status())
+        if path == EP_FLEET_FLIGHT_RECORDER and method == "GET":
+            self._read_body()
+            return self._send_json(router.merged_flight_dump())
+        if path == EP_FLEET_FLEETSCOPE and method == "GET":
+            self._read_body()
+            names = [r["name"] for r in router.replica_set.snapshot()]
+            return self._send_json(router.fleetscope.dump(names))
+        if path == EP_FLEET_SLO:
+            body = self._read_body()
+            if method == "POST":
+                doc = json.loads(body) if body else {}
+                try:
+                    if doc.get("remove"):
+                        result = {
+                            "removed": router.fleetscope.remove_objective(
+                                doc.get("model", ""),
+                                doc.get("tenant", "") or "",
+                            ),
+                            "model": doc.get("model", ""),
+                            "tenant": doc.get("tenant", "") or "",
+                        }
+                    else:
+                        result = router.fleetscope.set_objective(doc)
+                except (ValueError, TypeError) as e:
+                    return self._send_json({"error": str(e)}, 400)
+                # Journaled (router-local: never replayed to replicas)
+                # so objectives survive a router restart.
+                router.record_admin(method, path, body, {})
+                return self._send_json(result)
+            return self._send_json({
+                "kind": "fleet_slo",
+                "objectives": router.fleetscope.objective_docs(),
+                "burn": router.fleetscope.burn_rows(),
+            })
+        if path == EP_FLEET_COHORTS:
+            body = self._read_body()
+            if method == "POST":
+                doc = json.loads(body) if body else {}
+                try:
+                    result = router.fleetscope.assign_cohort(
+                        doc.get("replica", ""), doc.get("cohort", "")
+                    )
+                except ValueError as e:
+                    return self._send_json({"error": str(e)}, 400)
+                router.record_admin(method, path, body, {})
+                return self._send_json(result)
+            names = [r["name"] for r in router.replica_set.snapshot()]
+            return self._send_json({
+                "kind": "fleet_cohorts",
+                "assignments": router.fleetscope.cohort_assignments(),
+                "requests": router.fleetscope.cohort_request_counts(),
+                "verdicts": router.fleetscope.verdicts(names),
+            })
         m = FLEET_REPLICA_ROUTE_RE.match(path)
         if m and method == "POST":
             body = self._read_body()
             options = json.loads(body) if body else {}
             name = m.group("replica")
+            if m.group("action") == "cohort":
+                try:
+                    detail = router.fleetscope.assign_cohort(
+                        name, options.get("cohort", "")
+                    )
+                except ValueError as e:
+                    return self._send_json({"error": str(e)}, 400)
+                router.record_admin(method, path, body, {})
+                return self._send_json(detail)
             try:
                 if m.group("action") == "drain":
                     detail = router.drain_replica(
@@ -324,7 +391,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
         # Inference: admission + balance + proxy (the hot path).
         m = MODEL_ROUTE_RE.match(path)
         if m and m.group("action") == "infer" and method == "POST":
-            return self._infer(body)
+            return self._infer(body, m.group("model"))
 
         # Shared-nothing admin state: every ready replica needs it.
         if SHM_ROUTE_RE.match(path) or REPOSITORY_ROUTE_RE.match(path) or (
@@ -368,7 +435,13 @@ class _RouterHandler(BaseHTTPRequestHandler):
         )
         return self._relay(*last)
 
-    def _infer(self, body: bytes):
+    def _trace_id(self) -> str:
+        """The trace-id field of an incoming traceparent header (the
+        merged flight dump's correlation key), or ""."""
+        parts = self.headers.get("traceparent", "").split("-")
+        return parts[1] if len(parts) >= 3 else ""
+
+    def _infer(self, body: bytes, model: str = ""):
         """Inference proxy: admission + balance + policy-driven
         failover.
 
@@ -385,8 +458,9 @@ class _RouterHandler(BaseHTTPRequestHandler):
         tenant = self.headers.get(HEADER_TENANT_ID, "")
         idempotent = self.headers.get(HEADER_IDEMPOTENCY_KEY) is not None
         router = self.router
+        trace_id = self._trace_id()
         if router.hedge_enabled(idempotent):
-            return self._infer_hedged(body, tenant)
+            return self._infer_hedged(body, tenant, model, trace_id)
         policy = router.retry_policy
         attempt = 0
         exclude: List[str] = []
@@ -396,11 +470,28 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 headers = self._forward_headers(body)
                 if attempt:
                     headers[HEADER_RETRY_ATTEMPT] = str(attempt)
+                started = time.monotonic()
                 try:
+                    # Per-replica chaos site: faulting ONE replica's
+                    # proxied traffic is how the cohort drill injects a
+                    # regression into the canary cohort only.
+                    chaos.fire(
+                        chaos.SITE_FLEET_REPLICA_PREFIX
+                        + lease.replica.name
+                    )
                     status, relay, payload = self._exchange(
                         lease.replica.http_address, "POST", body, headers
                     )
-                except _ExchangeError as failure:
+                except (_ExchangeError, OSError) as failure:
+                    if not isinstance(failure, _ExchangeError):
+                        # An injected per-replica fault fires before the
+                        # connect — provably pre-execution.
+                        failure = _ExchangeError(PHASE_CONNECT, failure)
+                    router.fleetscope.record_request(
+                        model, tenant,
+                        int((time.monotonic() - started) * 1e6),
+                        False, lease.replica.name, trace_id=trace_id,
+                    )
                     lease.release(failed=True)
                     router.note_replica_result(lease.replica, ok=False)
                     reason = policy.classify(
@@ -415,13 +506,19 @@ class _RouterHandler(BaseHTTPRequestHandler):
                         f"replica {lease.replica.name} unreachable "
                         f"({failure.phase} phase): {failure.cause}", 502
                     )
+                router.fleetscope.record_request(
+                    model, tenant,
+                    int((time.monotonic() - started) * 1e6),
+                    status < 500, lease.replica.name, trace_id=trace_id,
+                )
                 router.note_replica_result(lease.replica, ok=status < 500)
                 if status < 500:
                     policy.note_success()
                 lease.release(failed=status >= 500)
                 return self._relay(status, relay, payload)
 
-    def _infer_hedged(self, body: bytes, tenant: str):
+    def _infer_hedged(self, body: bytes, tenant: str, model: str = "",
+                      trace_id: str = ""):
         """Hedged unary inference: launch the primary, and when it has
         not answered within ``hedge_us`` (or failed outright), launch a
         second attempt on a different replica. First success wins; the
@@ -436,13 +533,29 @@ class _RouterHandler(BaseHTTPRequestHandler):
         results: "queue.Queue" = queue.Queue()
 
         def run(tag: str, lease, headers: dict, box: dict):
+            started = time.monotonic()
             try:
+                chaos.fire(
+                    chaos.SITE_FLEET_REPLICA_PREFIX + lease.replica.name
+                )
                 out = self._exchange(
                     lease.replica.http_address, "POST", body, headers,
                     conn_box=box,
                 )
+                router.fleetscope.record_request(
+                    model, tenant,
+                    int((time.monotonic() - started) * 1e6),
+                    out[0] < 500, lease.replica.name, trace_id=trace_id,
+                )
                 results.put((tag, lease, box, out, None))
-            except _ExchangeError as failure:
+            except (_ExchangeError, OSError) as failure:
+                if not isinstance(failure, _ExchangeError):
+                    failure = _ExchangeError(PHASE_CONNECT, failure)
+                router.fleetscope.record_request(
+                    model, tenant,
+                    int((time.monotonic() - started) * 1e6),
+                    False, lease.replica.name, trace_id=trace_id,
+                )
                 results.put((tag, lease, box, None, failure))
 
         def launch(tag: str, exclude=()):

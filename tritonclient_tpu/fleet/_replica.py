@@ -33,6 +33,7 @@ from typing import Dict, List, Optional
 
 from tritonclient_tpu import sanitize
 from tritonclient_tpu.protocol._literals import (
+    EP_DEBUG_SKETCHES,
     EP_FLEET_DRAIN,
     EP_HEALTH_READY,
     EP_METRICS,
@@ -85,13 +86,29 @@ class Replica:
         # (the nv_fleet_replica_restarts_total family).
         self.needs_replay = False
         self.restarts = 0
+        # Scrape-staleness bookkeeping (satellite of the fleetscope
+        # plane): when the last metrics scrape SUCCEEDED, and how many
+        # probe ticks failed to produce one. A replica whose scrapes
+        # are stale must not silently feed old samples into fleet
+        # aggregation — the exposition makes the age visible
+        # (nv_fleet_scrape_age_s) and fleetscope gates verdicts on it.
+        self.last_scrape_s: Optional[float] = None
+        self.scrape_failures = 0
+        self.registered_s: Optional[float] = None
 
-    def _snapshot_locked(self) -> dict:
+    def _snapshot_locked(self, now: float = 0.0) -> dict:
         """Point-in-time copy of the live signals. Caller MUST hold the
         owning ReplicaSet's lock — reach this through
         ``ReplicaSet.snapshot()``, never directly from a status/metrics
         path (the prober thread mutates these counters concurrently)."""
+        reference = (
+            self.last_scrape_s if self.last_scrape_s is not None
+            else self.registered_s
+        )
+        scrape_age = max(now - reference, 0.0) if reference else 0.0
         return {
+            "scrape_age_s": scrape_age,
+            "scrape_failures": self.scrape_failures,
             "name": self.name,
             "http_address": self.http_address,
             "grpc_address": self.grpc_address,
@@ -150,6 +167,9 @@ class ReplicaSet:
         # becomes routable when the hook returns True. The FleetRouter
         # installs its admin-state replay here.
         self.on_rejoin = None
+        # Fleetscope scrape observer (``set_observer``): fed every
+        # probe tick's scraped metrics/sketches outside the set lock.
+        self.observer = None
         self._lock = sanitize.named_lock("fleet.ReplicaSet._lock")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -159,6 +179,7 @@ class ReplicaSet:
     def add(self, name: str, http_address: str,
             grpc_address: str = "") -> Replica:
         replica = Replica(name, http_address, grpc_address)
+        replica.registered_s = self._clock()
         with self._lock:
             if name in self._replicas:
                 raise ValueError(f"replica '{name}' already registered")
@@ -192,9 +213,10 @@ class ReplicaSet:
         the set lock — the sanctioned read path for status endpoints and
         /metrics exposition (TPU009: the prober mutates the same fields
         under this lock)."""
+        now = self._clock()
         with self._lock:
             return [
-                r._snapshot_locked()
+                r._snapshot_locked(now)
                 for r in sorted(
                     self._replicas.values(), key=lambda r: r.name
                 )
@@ -205,6 +227,15 @@ class ReplicaSet:
         (the prober reads it under the same lock)."""
         with self._lock:
             self.on_rejoin = hook
+
+    def set_observer(self, observer):
+        """Install the fleetscope scrape observer under the set lock.
+        ``observer.observe_scrape(name, ok, metrics_text, sketches_doc,
+        restarts, now)`` is invoked OUTSIDE the lock after every probe
+        tick (same discipline as the rejoin hook: observers may do
+        their own locking, never ours)."""
+        with self._lock:
+            self.observer = observer
 
     # -- lease counters -------------------------------------------------------
 
@@ -272,6 +303,7 @@ class ReplicaSet:
                 timeout_s=self.probe_timeout_s,
             )
             text = metrics.decode("utf-8", errors="replace")
+            observation["metrics_text"] = text
             observation["queue_depth"] = int(sum(
                 float(v) for v in _QUEUE_DEPTH_RE.findall(text)
             ))
@@ -279,84 +311,132 @@ class ReplicaSet:
             observation["oldest_age_us"] = int(max(ages)) if ages else 0
         except (OSError, ValueError):
             pass
+        # Raw sketch fetch (fleetscope only): merged fleet quantiles
+        # need the replica's DDSketch state, not resolved quantiles.
+        with self._lock:
+            want_sketches = self.observer is not None
+        if want_sketches and "metrics_text" in observation:
+            try:
+                status, body = http_call(
+                    replica.http_address, "GET", EP_DEBUG_SKETCHES,
+                    timeout_s=self.probe_timeout_s,
+                )
+                if status == 200 and body:
+                    observation["sketches"] = json.loads(body)
+            except (OSError, ValueError):
+                pass
         return observation
 
     def _apply(self, replica: Replica, obs: dict):
         now = self._clock()
         rejoin_hook = None
-        with self._lock:
-            if not obs["ok"]:
-                replica.consecutive_failures += 1
-                replica.last_error = obs.get("error", "")
-                # A transport-failed probe means the process may have
-                # crashed (and restarted empty): whatever comes back on
-                # this address must have admin state replayed before it
-                # is routable again.
-                if replica.state != ReplicaState.DRAINED:
-                    replica.needs_replay = True
-                if replica.state in (
-                    ReplicaState.READY, ReplicaState.JOINING,
-                ) and replica.consecutive_failures >= self.eject_after:
-                    replica.state = ReplicaState.EJECTED
-                    replica.ejections += 1
-                    replica.backoff_until_s = now + min(
-                        self.backoff_base_s * (2 ** (replica.ejections - 1)),
-                        self.backoff_max_s,
-                    )
-                elif replica.state == ReplicaState.EJECTED:
-                    # Failed the post-backoff retry: back off further.
-                    replica.ejections += 1
-                    replica.backoff_until_s = now + min(
-                        self.backoff_base_s * (2 ** (replica.ejections - 1)),
-                        self.backoff_max_s,
-                    )
-                return
-            replica.consecutive_failures = 0
-            replica.last_error = ""
-            replica.in_flight = obs.get("in_flight", replica.in_flight)
-            if "queue_depth" in obs:
-                replica.queue_depth = obs["queue_depth"]
-            if "oldest_age_us" in obs:
-                replica.oldest_age_us = obs["oldest_age_us"]
-            if replica.state == ReplicaState.DRAINING:
-                if replica.in_flight == 0 and replica.outstanding == 0:
-                    replica.state = ReplicaState.DRAINED
-                return
-            if obs["draining"]:
-                # Drained out-of-band (operator hit the replica's drain
-                # endpoint directly): stop routing, track settlement.
-                replica.state = ReplicaState.DRAINING
-            elif obs["ready"]:
-                if replica.needs_replay and self.on_rejoin is not None:
-                    # Rejoin after a crash: replay admin state OUTSIDE
-                    # the lock before the replica becomes routable.
-                    rejoin_hook = self.on_rejoin
+        observer = None
+        restarts_now = 0
+        try:
+            with self._lock:
+                observer = self.observer
+                scraped = "metrics_text" in obs
+                if scraped:
+                    replica.last_scrape_s = now
                 else:
-                    if replica.needs_replay:
+                    # No metrics text this tick (transport failure or a
+                    # scrape hiccup on a healthy probe): staleness
+                    # accrues and the failure is counted.
+                    replica.scrape_failures += 1
+                restarts_now = replica.restarts
+                if not obs["ok"]:
+                    replica.consecutive_failures += 1
+                    replica.last_error = obs.get("error", "")
+                    # A transport-failed probe means the process may have
+                    # crashed (and restarted empty): whatever comes back on
+                    # this address must have admin state replayed before it
+                    # is routable again.
+                    if replica.state != ReplicaState.DRAINED:
+                        replica.needs_replay = True
+                    if replica.state in (
+                        ReplicaState.READY, ReplicaState.JOINING,
+                    ) and replica.consecutive_failures >= self.eject_after:
+                        replica.state = ReplicaState.EJECTED
+                        replica.ejections += 1
+                        replica.backoff_until_s = now + min(
+                            self.backoff_base_s
+                            * (2 ** (replica.ejections - 1)),
+                            self.backoff_max_s,
+                        )
+                    elif replica.state == ReplicaState.EJECTED:
+                        # Failed the post-backoff retry: back off further.
+                        replica.ejections += 1
+                        replica.backoff_until_s = now + min(
+                            self.backoff_base_s
+                            * (2 ** (replica.ejections - 1)),
+                            self.backoff_max_s,
+                        )
+                    return
+                replica.consecutive_failures = 0
+                replica.last_error = ""
+                replica.in_flight = obs.get("in_flight", replica.in_flight)
+                if "queue_depth" in obs:
+                    replica.queue_depth = obs["queue_depth"]
+                if "oldest_age_us" in obs:
+                    replica.oldest_age_us = obs["oldest_age_us"]
+                if replica.state == ReplicaState.DRAINING:
+                    if replica.in_flight == 0 and replica.outstanding == 0:
+                        replica.state = ReplicaState.DRAINED
+                    return
+                if obs["draining"]:
+                    # Drained out-of-band (operator hit the replica's
+                    # drain endpoint directly): stop routing, track
+                    # settlement.
+                    replica.state = ReplicaState.DRAINING
+                elif obs["ready"]:
+                    if replica.needs_replay and self.on_rejoin is not None:
+                        # Rejoin after a crash: replay admin state OUTSIDE
+                        # the lock before the replica becomes routable.
+                        rejoin_hook = self.on_rejoin
+                    else:
+                        if replica.needs_replay:
+                            replica.needs_replay = False
+                            replica.restarts += 1
+                        replica.state = ReplicaState.READY
+                        replica.ejections = 0
+                else:
+                    # Alive but declining traffic: not routable, not a
+                    # fault.
+                    replica.state = ReplicaState.JOINING
+            if rejoin_hook is not None:
+                try:
+                    replayed = bool(rejoin_hook(replica))
+                except Exception:  # a replay bug must not kill the prober
+                    replayed = False
+                with self._lock:
+                    if replayed:
                         replica.needs_replay = False
                         replica.restarts += 1
-                    replica.state = ReplicaState.READY
-                    replica.ejections = 0
-            else:
-                # Alive but declining traffic: not routable, not a fault.
-                replica.state = ReplicaState.JOINING
-        if rejoin_hook is not None:
-            try:
-                replayed = bool(rejoin_hook(replica))
-            except Exception:  # a replay bug must not kill the prober
-                replayed = False
-            with self._lock:
-                if replayed:
-                    replica.needs_replay = False
-                    replica.restarts += 1
-                    replica.state = ReplicaState.READY
-                    replica.ejections = 0
-                elif replica.state not in (
-                    ReplicaState.DRAINING, ReplicaState.DRAINED,
-                ):
-                    # Not servable yet: stay out of routing; the next
-                    # probe retries the replay.
-                    replica.state = ReplicaState.JOINING
+                        replica.state = ReplicaState.READY
+                        replica.ejections = 0
+                    elif replica.state not in (
+                        ReplicaState.DRAINING, ReplicaState.DRAINED,
+                    ):
+                        # Not servable yet: stay out of routing; the next
+                        # probe retries the replay.
+                        replica.state = ReplicaState.JOINING
+        finally:
+            # Fleetscope notification, OUTSIDE the set lock on every
+            # path (the early returns above exit the with-block first):
+            # observers take their own lock and must never nest inside
+            # ours.
+            if observer is not None:
+                try:
+                    observer.observe_scrape(
+                        replica.name,
+                        ok="metrics_text" in obs,
+                        metrics_text=obs.get("metrics_text", ""),
+                        sketches_doc=obs.get("sketches"),
+                        restarts=restarts_now,
+                        now=now,
+                    )
+                except Exception:  # an observer bug must not kill probing
+                    pass
 
     # -- drain ----------------------------------------------------------------
 

@@ -6,18 +6,25 @@ the lease on completion — so quota accounting, outstanding counts, and
 the ``/metrics`` families cannot diverge between transports.
 """
 
+import base64
+import json
 from typing import Dict, List, Optional, Tuple, Union
 
 from tritonclient_tpu import sanitize
 from tritonclient_tpu.fleet._admission import AdmissionController, TenantQuota
+from tritonclient_tpu.fleet._fleetscope import FleetScope
 from tritonclient_tpu.fleet._policy import Policy, affinity_select, make_policy
 from tritonclient_tpu.fleet._replica import Replica, ReplicaSet, http_call
 from tritonclient_tpu.resilience import CircuitBreaker, RetryPolicy
 from tritonclient_tpu.protocol._literals import (
     BREAKER_STATE_VALUES,  # noqa: F401 — re-exported for front-ends
+    EP_FLEET_COHORTS,
+    EP_FLEET_SLO,
+    FLEET_REPLICA_ROUTE_RE,
     HEDGE_OUTCOMES,
     QUOTA_REASONS,
     RETRY_REASONS,
+    SLO_WINDOW_SLOW,
     STATUS_OVER_QUOTA,
 )
 
@@ -70,7 +77,9 @@ class FleetRouter:
                  breaker_failure_threshold: int = 3,
                  breaker_reset_s: float = 2.0,
                  hedge_us: Optional[int] = None,
-                 hedge_all: bool = False):
+                 hedge_all: bool = False,
+                 fleetscope: Optional[FleetScope] = None,
+                 journal_path: Optional[str] = None):
         self._set = replicas if replicas is not None else ReplicaSet()
         self.policy = (
             policy if isinstance(policy, Policy) else make_policy(policy)
@@ -119,7 +128,21 @@ class FleetRouter:
         self._policy_lock = sanitize.named_lock(
             "fleet.FleetRouter._policy_lock"
         )
+        # The fleet-wide SLO plane: scrape time series + merged sketches
+        # (fed by the prober via the observer hook below), burn windows
+        # and cohort detection (fed by the front-ends' record_request
+        # calls), and the proxy-side flight ring.
+        self.fleetscope = (
+            fleetscope if fleetscope is not None else FleetScope()
+        )
+        # Optional journal persistence: every record_admin entry appends
+        # one JSON line here, and a restarting router reloads the file —
+        # SLO objectives and cohort assignments survive the restart.
+        self._journal_path = journal_path
+        if journal_path:
+            self._load_journal(journal_path)
         self._set.set_on_rejoin(self._replay_admin_state)
+        self._set.set_observer(self.fleetscope)
 
     # -- membership passthrough ----------------------------------------------
 
@@ -232,11 +255,96 @@ class FleetRouter:
         """Journal one successfully fanned-out admin operation for
         replay to rejoining replicas. An unregister/unload does not
         erase its register/load entry — the journal is an ordered log,
-        so replay converges to the same end state either way."""
+        so replay converges to the same end state either way. Router-
+        local ``v2/fleet/*`` entries (SLO objectives, cohort
+        assignments) ride the same log but are applied locally on
+        reload, never replayed to replicas."""
+        entry = (method, path, bytes(body or b""), dict(headers or {}))
         with self._resilience_lock:
-            self._journal.append(
-                (method, path, bytes(body or b""), dict(headers or {}))
-            )
+            self._journal.append(entry)
+            if self._journal_path:
+                line = json.dumps({
+                    "method": entry[0],
+                    "path": entry[1],
+                    "body": base64.b64encode(entry[2]).decode("ascii"),
+                    "headers": entry[3],
+                })
+                try:
+                    with open(self._journal_path, "a",
+                              encoding="utf-8") as fh:
+                        fh.write(line + "\n")
+                except OSError:
+                    # Persistence is best-effort: a full disk must not
+                    # fail the admin operation that already fanned out.
+                    pass
+
+    def _load_journal(self, path: str):
+        """Reload persisted admin entries at construction: the
+        in-memory journal is rebuilt for replica replay, and
+        router-local ``v2/fleet/*`` entries are applied to fleetscope
+        so SLO/cohort state survives a router restart."""
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                lines = fh.readlines()
+        except OSError:
+            return
+        for raw in lines:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                doc = json.loads(raw)
+                entry = (
+                    str(doc["method"]),
+                    str(doc["path"]),
+                    base64.b64decode(doc.get("body", "") or ""),
+                    dict(doc.get("headers") or {}),
+                )
+            except (ValueError, KeyError, TypeError):
+                continue  # a torn tail line must not block startup
+            with self._resilience_lock:
+                self._journal.append(entry)
+            self._apply_fleet_entry(entry)
+
+    def _apply_fleet_entry(self, entry: Tuple[str, str, bytes, dict]):
+        """Apply one journaled router-local fleet-admin entry to
+        fleetscope state (journal reload path)."""
+        _method, path, body, _headers = entry
+        if not path.startswith("v2/fleet/"):
+            return
+        try:
+            doc = json.loads(body.decode("utf-8") or "{}")
+        except (ValueError, UnicodeDecodeError):
+            return
+        if not isinstance(doc, dict):
+            return
+        if path == EP_FLEET_SLO:
+            try:
+                if doc.get("remove"):
+                    self.fleetscope.remove_objective(
+                        doc.get("model", ""), doc.get("tenant", "")
+                    )
+                else:
+                    self.fleetscope.set_objective(doc)
+            except (ValueError, TypeError):
+                pass
+            return
+        if path == EP_FLEET_COHORTS:
+            try:
+                self.fleetscope.assign_cohort(
+                    doc.get("replica", ""), doc.get("cohort", "")
+                )
+            except ValueError:
+                pass
+            return
+        m = FLEET_REPLICA_ROUTE_RE.match(path)
+        if m is not None and m.group("action") == "cohort":
+            try:
+                self.fleetscope.assign_cohort(
+                    m.group("replica"), doc.get("cohort", "")
+                )
+            except ValueError:
+                pass
 
     def admin_journal(self) -> List[Tuple[str, str, bytes, dict]]:
         with self._resilience_lock:
@@ -262,6 +370,11 @@ class FleetRouter:
             except Exception:  # noqa: BLE001 — hygiene must not block rejoin
                 pass
         for method, path, body, headers in self.admin_journal():
+            if path.startswith("v2/fleet/"):
+                # Router-local entries (SLO objectives, cohort
+                # assignments): a replica would answer 404 and block its
+                # own rejoin forever.
+                continue
             try:
                 status, _ = http_call(
                     replica.http_address, method, path, body=body,
@@ -272,6 +385,15 @@ class FleetRouter:
             if status >= 400:
                 return False
         return True
+
+    def merged_flight_dump(self) -> dict:
+        """The fleet-wide flight-recorder dump: fan out to every READY
+        replica's dump endpoint and merge with the router's own
+        proxy-side records (see FleetScope.merged_flight_dump)."""
+        targets = [
+            (r.name, r.http_address) for r in self._set.routable()
+        ]
+        return self.fleetscope.merged_flight_dump(targets)
 
     def pick_any(self) -> Replica:
         """A ready replica for non-inference traffic (metadata, stats,
@@ -364,6 +486,28 @@ class FleetRouter:
                 f'{metric}{{replica="{esc(r["name"])}"}} '
                 f"{r['restarts']}"
             )
+        metric = "nv_fleet_scrape_age_s"
+        lines.append(
+            f"# HELP {metric} Seconds since the router last successfully "
+            "scraped a replica's /metrics (staleness signal)"
+        )
+        lines.append(f"# TYPE {metric} gauge")
+        for r in replicas:
+            lines.append(
+                f'{metric}{{replica="{esc(r["name"])}"}} '
+                f"{r['scrape_age_s']:.6f}"
+            )
+        metric = "nv_fleet_scrape_failures_total"
+        lines.append(
+            f"# HELP {metric} Prober ticks that did not yield a metrics "
+            "scrape for a replica"
+        )
+        lines.append(f"# TYPE {metric} counter")
+        for r in replicas:
+            lines.append(
+                f'{metric}{{replica="{esc(r["name"])}"}} '
+                f"{r['scrape_failures']}"
+            )
         metric = "nv_client_breaker_state"
         lines.append(
             f"# HELP {metric} Circuit-breaker state per replica "
@@ -411,4 +555,45 @@ class FleetRouter:
                     f'{metric}{{tenant="{esc(tenant)}"'
                     f',reason="{reason}"}} {reasons[reason]}'
                 )
+        burn_rows = self.fleetscope.burn_rows()
+        metric = "nv_fleet_slo_burn_rate"
+        lines.append(
+            f"# HELP {metric} Error-budget burn rate per SLO objective "
+            "and window (1.0 = consuming budget exactly at the allowed "
+            "rate)"
+        )
+        lines.append(f"# TYPE {metric} gauge")
+        for row in burn_rows:
+            lines.append(
+                f'{metric}{{model="{esc(row["model"])}"'
+                f',tenant="{esc(row["tenant"])}"'
+                f',window="{row["window"]}"}} '
+                f"{row['burn_rate']:.6f}"
+            )
+        metric = "nv_fleet_slo_budget_remaining"
+        lines.append(
+            f"# HELP {metric} Fraction of the error budget left over "
+            "the slow window, per SLO objective (in [0, 1])"
+        )
+        lines.append(f"# TYPE {metric} gauge")
+        for row in burn_rows:
+            if row["window"] != SLO_WINDOW_SLOW:
+                continue
+            lines.append(
+                f'{metric}{{model="{esc(row["model"])}"'
+                f',tenant="{esc(row["tenant"])}"}} '
+                f"{row['budget_remaining']:.6f}"
+            )
+        metric = "nv_fleet_cohort_requests_total"
+        lines.append(
+            f"# HELP {metric} Requests routed per replica cohort "
+            "(baseline vs canary attribution)"
+        )
+        lines.append(f"# TYPE {metric} counter")
+        for cohort, count in sorted(
+            self.fleetscope.cohort_request_counts().items()
+        ):
+            lines.append(
+                f'{metric}{{cohort="{esc(cohort)}"}} {count}'
+            )
         return "\n".join(lines) + "\n"

@@ -1,0 +1,464 @@
+"""SLO engine + cohort-delta detector for the fleet router.
+
+Two consumers drove this design (ROADMAP items 4 and 5): the autoscaler
+needs *trend* signals (burn rates over scraped time series) and canary
+auto-rollback needs a *verdict* (did the canary cohort regress vs the
+baseline). Both live here, fed by the router's own per-request
+observations (``FleetScope.record_request``), so the signal covers the
+full router→replica path the clients actually experience.
+
+**SLO engine** (:class:`SloRegistry`): declarative per-model/per-tenant
+objectives — a latency target plus an error budget — evaluated into
+multi-window burn rates. An event is *bad* when it errored OR exceeded
+the latency target; ``burn = bad_fraction / error_budget`` (burn 1.0 =
+exactly consuming budget; the classic page thresholds are fast>14.4,
+slow>6). The fast window is one bucket, the slow window
+``SLOW_WINDOW_BUCKETS`` buckets; bucket width comes from
+``TPU_FLEETSCOPE_WINDOW_S`` (default 60 s) so tests compress an
+"hour" into fractions of a second without touching the math.
+
+**Cohort-delta detector** (:class:`CohortDetector`): replicas are
+partitioned into labeled cohorts (default ``baseline``); per bucket the
+detector keeps each cohort's request count, bad count, and an exact
+:class:`~tritonclient_tpu._sketch.LatencySketch` of durations. A cohort
+regresses when ``confirm_windows`` CONSECUTIVE buckets each show its
+p99 above ``p99_ratio`` × baseline p99 or its error rate above
+baseline + ``error_rate_delta`` — with a minimum-sample gate per bucket
+and a stale-scrape gate per replica, both of which answer
+``insufficient-data`` rather than guessing.
+
+Pure data structures: no I/O, no threads. Locking is the caller's
+(:class:`~tritonclient_tpu.fleet._fleetscope.FleetScope` wraps every
+entry point in one named lock).
+"""
+
+import math
+import os
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from tritonclient_tpu._sketch import LatencySketch
+from tritonclient_tpu.protocol._literals import (
+    COHORT_BASELINE,
+    COHORT_CLEAN,
+    COHORT_INSUFFICIENT,
+    COHORT_LABEL_RE,
+    COHORT_REGRESSED,
+    SLO_WINDOW_FAST,
+    SLO_WINDOW_SLOW,
+    SLO_WINDOWS,
+)
+
+#: Bucket width in seconds (the "1 minute" of multi-window burn-rate
+#: alerting). Tests shrink it so an hour-equivalent slow window closes
+#: in milliseconds.
+DEFAULT_WINDOW_S = 60.0
+
+#: Slow window span in buckets (the "1 hour" = 60 x fast).
+SLOW_WINDOW_BUCKETS = 60
+
+#: Ring bound: how many closed buckets each series retains.
+DEFAULT_WINDOWS = 120
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def window_s() -> float:
+    return _env_float("TPU_FLEETSCOPE_WINDOW_S", DEFAULT_WINDOW_S)
+
+
+def max_windows() -> int:
+    return max(_env_int("TPU_FLEETSCOPE_WINDOWS", DEFAULT_WINDOWS),
+               SLOW_WINDOW_BUCKETS + 1)
+
+
+class SloObjective:
+    """One declarative objective: requests for (model, tenant) should
+    answer OK within ``latency_target_us``, with at most
+    ``error_budget`` of them allowed to miss. ``tenant`` empty = all
+    tenants of the model."""
+
+    __slots__ = ("model", "tenant", "latency_target_us", "error_budget")
+
+    def __init__(self, model: str, tenant: str = "",
+                 latency_target_us: int = 1_000_000,
+                 error_budget: float = 0.01):
+        if not model:
+            raise ValueError("SLO objective requires a model")
+        if not 0.0 < float(error_budget) <= 1.0:
+            raise ValueError(
+                f"error_budget must be in (0, 1], got {error_budget}"
+            )
+        if int(latency_target_us) <= 0:
+            raise ValueError("latency_target_us must be positive")
+        self.model = model
+        self.tenant = tenant or ""
+        self.latency_target_us = int(latency_target_us)
+        self.error_budget = float(error_budget)
+
+    def to_dict(self) -> dict:
+        return {
+            "model": self.model,
+            "tenant": self.tenant,
+            "latency_target_us": self.latency_target_us,
+            "error_budget": self.error_budget,
+        }
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "SloObjective":
+        return cls(
+            model=doc.get("model", ""),
+            tenant=doc.get("tenant", "") or "",
+            latency_target_us=int(doc.get("latency_target_us",
+                                          1_000_000)),
+            error_budget=float(doc.get("error_budget", 0.01)),
+        )
+
+
+class _BucketSeries:
+    """Bounded map of bucket index -> [total, bad]."""
+
+    __slots__ = ("buckets", "limit")
+
+    def __init__(self, limit: int):
+        self.buckets: "OrderedDict[int, List[int]]" = OrderedDict()
+        self.limit = limit
+
+    def add(self, index: int, bad: bool):
+        cell = self.buckets.get(index)
+        if cell is None:
+            cell = self.buckets[index] = [0, 0]
+            while len(self.buckets) > self.limit:
+                self.buckets.popitem(last=False)
+        cell[0] += 1
+        if bad:
+            cell[1] += 1
+
+    def window_counts(self, end_index: int, span: int) -> Tuple[int, int]:
+        """(total, bad) over bucket indices in (end_index - span,
+        end_index]."""
+        total = bad = 0
+        for index, (t, b) in self.buckets.items():
+            if end_index - span < index <= end_index:
+                total += t
+                bad += b
+        return total, bad
+
+
+class SloRegistry:
+    """Objectives + windowed good/bad accounting + burn-rate math.
+
+    ``record`` is called once per routed request with the request's
+    wall duration and outcome; the registry buckets it against every
+    matching objective ((model, tenant) exact match first, then the
+    model-wide ``tenant=""`` objective).
+    """
+
+    def __init__(self):
+        self._objectives: "OrderedDict[Tuple[str, str], SloObjective]" = (
+            OrderedDict()
+        )
+        # (model, tenant of the OBJECTIVE) -> series
+        self._series: Dict[Tuple[str, str], _BucketSeries] = {}
+
+    # -- objectives -----------------------------------------------------------
+
+    def set_objective(self, objective: SloObjective):
+        self._objectives[(objective.model, objective.tenant)] = objective
+
+    def remove_objective(self, model: str, tenant: str = "") -> bool:
+        return self._objectives.pop((model, tenant or ""), None) is not None
+
+    def objectives(self) -> List[SloObjective]:
+        return list(self._objectives.values())
+
+    def _matching(self, model: str,
+                  tenant: str) -> List[SloObjective]:
+        out = []
+        exact = self._objectives.get((model, tenant))
+        if exact is not None:
+            out.append(exact)
+        if tenant:
+            model_wide = self._objectives.get((model, ""))
+            if model_wide is not None:
+                out.append(model_wide)
+        return out
+
+    # -- accounting -----------------------------------------------------------
+
+    def record(self, model: str, tenant: str, duration_us: int,
+               ok: bool, bucket_index: int, limit: int):
+        for objective in self._matching(model, tenant):
+            bad = (not ok) or duration_us > objective.latency_target_us
+            key = (objective.model, objective.tenant)
+            series = self._series.get(key)
+            if series is None:
+                series = self._series[key] = _BucketSeries(limit)
+            series.add(bucket_index, bad)
+
+    # -- evaluation -----------------------------------------------------------
+
+    def burn_rows(self, bucket_index: int) -> List[dict]:
+        """One row per (objective, window): burn rate and remaining
+        budget. Rendered into ``nv_fleet_slo_burn_rate`` /
+        ``nv_fleet_slo_budget_remaining``."""
+        rows = []
+        spans = {SLO_WINDOW_FAST: 1, SLO_WINDOW_SLOW: SLOW_WINDOW_BUCKETS}
+        for (model, tenant), objective in self._objectives.items():
+            series = self._series.get((model, tenant))
+            for window in SLO_WINDOWS:
+                total = bad = 0
+                if series is not None:
+                    total, bad = series.window_counts(
+                        bucket_index, spans[window]
+                    )
+                bad_fraction = (bad / total) if total else 0.0
+                burn = bad_fraction / objective.error_budget
+                if total:
+                    remaining = 1.0 - bad / (
+                        total * objective.error_budget
+                    )
+                    remaining = min(max(remaining, 0.0), 1.0)
+                else:
+                    remaining = 1.0
+                rows.append({
+                    "model": model,
+                    "tenant": tenant,
+                    "window": window,
+                    "total": total,
+                    "bad": bad,
+                    "burn_rate": burn,
+                    "budget_remaining": remaining,
+                })
+        return rows
+
+
+class _CohortBucket:
+    __slots__ = ("total", "bad", "sketch")
+
+    def __init__(self):
+        self.total = 0
+        self.bad = 0
+        self.sketch = LatencySketch()
+
+
+class CohortDetector:
+    """Baseline-vs-cohort regression detection over exact sketch merges.
+
+    ``min_samples`` gates each compared bucket; ``confirm_windows``
+    consecutive regressed buckets confirm a verdict (one bad window is
+    noise, K in a row is a regression — the serving-comparison
+    methodology of arxiv 2605.25645 applied to merged DDSketches).
+    """
+
+    def __init__(self, min_samples: int = 20, confirm_windows: int = 3,
+                 p99_ratio: float = 1.5, error_rate_delta: float = 0.05):
+        self.min_samples = int(min_samples)
+        self.confirm_windows = max(int(confirm_windows), 1)
+        self.p99_ratio = float(p99_ratio)
+        self.error_rate_delta = float(error_rate_delta)
+        self._assignments: Dict[str, str] = {}
+        # cohort -> bucket index -> _CohortBucket (bounded)
+        self._buckets: Dict[str, "OrderedDict[int, _CohortBucket]"] = {}
+
+    # -- assignment -----------------------------------------------------------
+
+    def assign(self, replica: str, cohort: str):
+        cohort = (cohort or COHORT_BASELINE).strip().lower()
+        if not replica:
+            raise ValueError("cohort assignment requires a replica name")
+        if not COHORT_LABEL_RE.match(cohort):
+            raise ValueError(
+                f"cohort label {cohort!r} is not canonical "
+                "(lowercase slug: [a-z0-9][a-z0-9_-]*)"
+            )
+        self._assignments[replica] = cohort
+
+    def cohort_of(self, replica: str) -> str:
+        return self._assignments.get(replica, COHORT_BASELINE)
+
+    def assignments(self) -> Dict[str, str]:
+        return dict(self._assignments)
+
+    def members(self, cohort: str, replicas: List[str]) -> List[str]:
+        return [r for r in replicas if self.cohort_of(r) == cohort]
+
+    # -- accounting -----------------------------------------------------------
+
+    def record(self, replica: str, duration_us: int, ok: bool,
+               bucket_index: int, limit: int):
+        cohort = self.cohort_of(replica)
+        series = self._buckets.get(cohort)
+        if series is None:
+            series = self._buckets[cohort] = OrderedDict()
+        bucket = series.get(bucket_index)
+        if bucket is None:
+            bucket = series[bucket_index] = _CohortBucket()
+            while len(series) > limit:
+                series.popitem(last=False)
+        bucket.total += 1
+        if not ok:
+            bucket.bad += 1
+        bucket.sketch.insert(max(duration_us, 0))
+
+    # -- evaluation -----------------------------------------------------------
+
+    def _window_indices(self, bucket_index: int) -> List[int]:
+        """The ``confirm_windows`` most recent bucket indices with any
+        data in any cohort, newest last, capped at ``bucket_index``."""
+        seen = set()
+        for series in self._buckets.values():
+            for index in series:
+                if index <= bucket_index:
+                    seen.add(index)
+        return sorted(seen)[-self.confirm_windows:]
+
+    def verdicts(self, bucket_index: int, replicas: List[str],
+                 stale: Optional[List[str]] = None) -> List[dict]:
+        """One verdict document per non-baseline cohort, compared
+        against ``COHORT_BASELINE`` over the K most recent populated
+        buckets. ``stale`` names replicas whose last scrape/observation
+        is too old to trust — any stale member forces
+        ``insufficient-data`` for its cohort."""
+        stale_set = set(stale or ())
+        cohorts = sorted(
+            {self.cohort_of(r) for r in replicas}
+            | set(self._buckets)
+        )
+        indices = self._window_indices(bucket_index)
+        baseline = self._buckets.get(COHORT_BASELINE, OrderedDict())
+        out = []
+        for cohort in cohorts:
+            if cohort == COHORT_BASELINE:
+                continue
+            members = self.members(cohort, replicas)
+            doc = {
+                "cohort": cohort,
+                "baseline": COHORT_BASELINE,
+                "replicas": members,
+                "windows_compared": 0,
+                "windows_regressed": 0,
+                "p99_us": 0.0,
+                "baseline_p99_us": 0.0,
+                "error_rate": 0.0,
+                "baseline_error_rate": 0.0,
+                "samples": 0,
+                "baseline_samples": 0,
+            }
+            stale_members = sorted(stale_set & set(members))
+            if stale_members:
+                doc["verdict"] = COHORT_INSUFFICIENT
+                doc["reason"] = (
+                    "stale scrape: " + ", ".join(stale_members)
+                )
+                out.append(doc)
+                continue
+            series = self._buckets.get(cohort, OrderedDict())
+            if len(indices) < self.confirm_windows:
+                doc["verdict"] = COHORT_INSUFFICIENT
+                doc["reason"] = (
+                    f"{len(indices)}/{self.confirm_windows} windows "
+                    "observed"
+                )
+                out.append(doc)
+                continue
+            regressed_all = True
+            insufficient = None
+            merged = LatencySketch()
+            merged_base = LatencySketch()
+            total = bad = base_total = base_bad = 0
+            for index in indices:
+                mine = series.get(index)
+                theirs = baseline.get(index)
+                n_mine = mine.total if mine else 0
+                n_theirs = theirs.total if theirs else 0
+                if (n_mine < self.min_samples
+                        or n_theirs < self.min_samples):
+                    insufficient = (
+                        f"window {index}: {n_mine}/{n_theirs} samples "
+                        f"(need {self.min_samples} each)"
+                    )
+                    break
+                merged.merge(mine.sketch)
+                merged_base.merge(theirs.sketch)
+                total += mine.total
+                bad += mine.bad
+                base_total += theirs.total
+                base_bad += theirs.bad
+                p99 = mine.sketch.quantile(0.99)
+                base_p99 = theirs.sketch.quantile(0.99)
+                err = mine.bad / mine.total
+                base_err = theirs.bad / theirs.total
+                latency_regressed = (
+                    base_p99 > 0.0 and p99 > self.p99_ratio * base_p99
+                )
+                errors_regressed = (
+                    err > base_err + self.error_rate_delta
+                )
+                doc["windows_compared"] += 1
+                if latency_regressed or errors_regressed:
+                    doc["windows_regressed"] += 1
+                else:
+                    regressed_all = False
+            if insufficient is not None:
+                doc["verdict"] = COHORT_INSUFFICIENT
+                doc["reason"] = insufficient
+                out.append(doc)
+                continue
+            doc["samples"] = total
+            doc["baseline_samples"] = base_total
+            doc["p99_us"] = merged.quantile(0.99)
+            doc["baseline_p99_us"] = merged_base.quantile(0.99)
+            doc["error_rate"] = (bad / total) if total else 0.0
+            doc["baseline_error_rate"] = (
+                (base_bad / base_total) if base_total else 0.0
+            )
+            doc["verdict"] = (
+                COHORT_REGRESSED
+                if regressed_all and doc["windows_compared"]
+                == self.confirm_windows
+                else COHORT_CLEAN
+            )
+            out.append(doc)
+        return out
+
+
+def merged_p99_matches_pooled(samples_by_replica: Dict[str, List[float]],
+                              alpha: float = 0.01) -> Tuple[float, float]:
+    """Drill helper: (merged-sketch p99, pooled-sample sketch p99) for
+    the exactness acceptance check — merging per-replica sketches must
+    equal sketching the pooled samples (bucket-wise merge is exact), and
+    both sit within the sketch's relative-error bound of the true
+    sample quantile."""
+    per_replica = []
+    for values in samples_by_replica.values():
+        sketch = LatencySketch(alpha=alpha)
+        sketch.extend(values)
+        per_replica.append(sketch)
+    merged = LatencySketch.merged(per_replica, alpha=alpha)
+    pooled = LatencySketch(alpha=alpha)
+    for values in samples_by_replica.values():
+        pooled.extend(values)
+    return merged.quantile(0.99), pooled.quantile(0.99)
+
+
+def exact_quantile(values: List[float], q: float) -> float:
+    """Nearest-rank sample quantile (the reference the sketch's 2%
+    bound is stated against)."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    rank = max(int(math.ceil(q * len(ordered))), 1)
+    return ordered[rank - 1]

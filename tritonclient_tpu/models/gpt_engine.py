@@ -536,6 +536,16 @@ class GenerationEngine:
             )
         self.block_size = block_size
         self._max_blocks = cfg.max_len // block_size   # per-slot table width
+        # Bytes one block-table entry makes a step touch, across every
+        # layer's K and V page (the stepscope kv_bytes accounting unit).
+        try:
+            itemsize = np.dtype(cfg.dtype).itemsize
+        except TypeError:
+            itemsize = 2  # bf16-family default
+        self._block_kv_bytes = (
+            cfg.n_layers * 2 * block_size * cfg.n_heads * cfg.head_dim
+            * itemsize
+        )
         if n_blocks is None:
             n_blocks = 1 + max_slots * self._max_blocks
         self.prefill_chunk = max(1, min(int(prefill_chunk), cfg.max_len))
@@ -1061,6 +1071,10 @@ class GenerationEngine:
             self._scope_name, _stepscope.PHASE_PREFILL_CHUNK,
             self._prefill_seq, batch_size=n_real, slots=self.max_slots,
         )
+        if scope is not None:
+            # The gathered view reads the bucketed block-table extent
+            # for every lane, hit pages or not (shape-bucketed gather).
+            scope.kv_bytes = kk * n_ctx * self._block_kv_bytes
         self._prefill_seq += 1
         firsts_dev, self._k, self._v = self._prefill_chunk_fn(
             self.params, self._k, self._v, jnp.asarray(chunks),
@@ -1356,6 +1370,12 @@ class GenerationEngine:
             )
             if scope is not None:
                 scope.micro_steps = fuse
+                # Whole-bank decode: every micro-step gathers the full
+                # [max_slots, max_blocks] table extent.
+                scope.kv_bytes = (
+                    fuse * self.max_slots * self._max_blocks
+                    * self._block_kv_bytes
+                )
             step_seq += fuse
             if fuse == 1:
                 toks, self._k, self._v = self._step(

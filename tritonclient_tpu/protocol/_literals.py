@@ -156,6 +156,37 @@ QUOTA_REASONS = (
 )
 
 # --------------------------------------------------------------------------- #
+# fleet SLO plane vocabulary (fleetscope)                                     #
+# --------------------------------------------------------------------------- #
+
+#: ``window`` label values of ``nv_fleet_slo_burn_rate``: the fast
+#: (1-minute-equivalent) and slow (1-hour-equivalent) burn-rate windows
+#: of multi-window SLO alerting. Spelled here exactly once (enforced by
+#: TPU008): alert rules match on these strings, and an engine burning
+#: window X while the exposition renders window Y silently disarms the
+#: page.
+SLO_WINDOW_FAST = "fast"
+SLO_WINDOW_SLOW = "slow"
+SLO_WINDOWS = (SLO_WINDOW_FAST, SLO_WINDOW_SLOW)
+
+#: Cohort-delta detector verdicts (``v2/fleet/cohorts`` documents and
+#: the ``verdict`` field fleet_report.py renders). ``insufficient-data``
+#: covers both too-few samples and stale-scraped replicas — an honest
+#: "cannot judge", never silently ``clean``.
+COHORT_REGRESSED = "regressed"
+COHORT_CLEAN = "clean"
+COHORT_INSUFFICIENT = "insufficient-data"
+COHORT_VERDICTS = (COHORT_REGRESSED, COHORT_CLEAN, COHORT_INSUFFICIENT)
+
+#: Default cohort every replica belongs to until assigned otherwise.
+COHORT_BASELINE = "baseline"
+
+#: Canonical cohort label shape: lowercase slug, so the ``cohort``
+#: metric label and the admin/journal spelling cannot drift by case or
+#: whitespace. Enforced at assignment AND by the exposition checker.
+COHORT_LABEL_RE = re.compile(r"^[a-z0-9][a-z0-9_-]*$")
+
+# --------------------------------------------------------------------------- #
 # resilience vocabulary (retries, hedging, circuit breakers)                  #
 # --------------------------------------------------------------------------- #
 
@@ -311,6 +342,11 @@ EP_TRACE_SETTING = "v2/trace/setting"
 #: sliding window plus every error/deadline miss. ``?format=perfetto``
 #: renders the retained records as Chrome trace-event JSON.
 EP_FLIGHT_RECORDER = "v2/debug/flight_recorder"
+#: Raw per-model/per-stage DDSketch state (replica-side): the fleet
+#: router's prober fetches these each scrape tick so fleetscope can
+#: merge quantiles EXACTLY (bucket-wise) instead of pooling resolved
+#: quantile rows (which cannot be merged).
+EP_DEBUG_SKETCHES = "v2/debug/sketches"
 #: Replica drain control (fleet tier): POST ``{"drain": true|false}``;
 #: draining flips ``v2/health/ready`` to 400 (stop new admissions) while
 #: in-flight requests finish. The response — and GETs of
@@ -321,6 +357,23 @@ EP_FLEET_DRAIN = "v2/fleet/drain"
 #: Router-side fleet status document (replica states, outstanding counts,
 #: admission counters). Served by the ROUTER, not the replicas.
 EP_FLEET_STATUS = "v2/fleet/status"
+#: Merged fleet flight-recorder dump (router-side): fans out to every
+#: READY replica's EP_FLIGHT_RECORDER, stamps each record with the
+#: replica name, and merges in the router's own proxy-side records
+#: keyed by traceparent — one dump, the full router→replica timeline.
+EP_FLEET_FLIGHT_RECORDER = "v2/fleet/debug/flight_recorder"
+#: SLO objective admin (router-side): GET lists objectives + burn
+#: state; POST ``{"model", "tenant", "latency_target_us",
+#: "error_budget"}`` declares one (journaled, survives restarts).
+EP_FLEET_SLO = "v2/fleet/slo"
+#: Cohort-delta detector (router-side): GET returns per-cohort verdict
+#: documents; POST ``{"replica": ..., "cohort": ...}`` assigns a
+#: replica to a labeled cohort (journaled, survives restarts).
+EP_FLEET_COHORTS = "v2/fleet/cohorts"
+#: Full fleetscope dump (router-side): the self-describing document
+#: ``scripts/fleet_report.py`` loads — scrape health, retained time
+#: series, merged sketch quantiles, SLO burn state, cohort verdicts.
+EP_FLEET_FLEETSCOPE = "v2/fleet/debug/fleetscope"
 #: Prometheus exposition (Triton serves this on a dedicated port; the
 #: in-process server shares its one HTTP port).
 EP_METRICS = "metrics"
@@ -408,5 +461,5 @@ SHM_ROUTE_RE = re.compile(
 )
 #: Router-side replica admin: drain / undrain one replica by name.
 FLEET_REPLICA_ROUTE_RE = re.compile(
-    r"^v2/fleet/replicas/(?P<replica>[^/]+)/(?P<action>drain|undrain)$"
+    r"^v2/fleet/replicas/(?P<replica>[^/]+)/(?P<action>drain|undrain|cohort)$"
 )

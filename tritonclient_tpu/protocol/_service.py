@@ -123,6 +123,12 @@ RPC_METHODS = {
     # request payload is ``{"drain": true|false}`` (empty = status only);
     # the response is the readiness-detail document.
     "Drain": ("unary", RawJsonMessage, RawJsonMessage),
+    # Merged fleet flight-recorder dump: the gRPC analog of the
+    # router's GET v2/fleet/debug/flight_recorder. Router-only —
+    # replica servicers don't implement it (make_service_handler skips
+    # missing methods), and the router answers it LOCALLY (never
+    # forwarded: a replica can't merge the fleet).
+    "FleetFlightRecorder": ("unary", RawJsonMessage, RawJsonMessage),
 }
 
 

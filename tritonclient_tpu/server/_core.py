@@ -1680,6 +1680,18 @@ class InferenceCore:
         lines.append(f"# TYPE {metric} gauge")
         for sname, depth in inflight_rows:
             lines.append(f'{metric}{{model="{esc(sname)}"}} {depth}')
+        metric = _stepscope.KV_BYTES_METRIC
+        lines.append(
+            f"# HELP {metric} Paged-KV bytes engine steps touched "
+            "(blocks gathered x block bytes over the block-table "
+            "extent), by phase (stepscope)"
+        )
+        lines.append(f"# TYPE {metric} counter")
+        for sname, phase, total in _stepscope.kv_bytes_snapshot():
+            lines.append(
+                f'{metric}{{model="{esc(sname)}",phase="{phase}"}} '
+                f"{total}"
+            )
         # Paged-KV families (tritonclient_tpu._kvcache registry): pool
         # occupancy gauges plus the prefix-cache event counter for every
         # live engine. Headers always render (stable family set for
@@ -1801,6 +1813,26 @@ class InferenceCore:
                 if self._loaded.get(n, False)
             ]
         return [stats.as_dict(n, version) for n, version, stats in rows]
+
+    def sketches_dump(self) -> dict:
+        """Raw per-model/per-stage DDSketch state (GET
+        v2/debug/sketches): the fleet router scrapes this and merges the
+        buckets bucket-wise into fleet-wide quantiles — exact, unlike
+        any recombination of already-resolved quantiles. Loaded models
+        only, same readiness filter as the /metrics exposition."""
+        with self._lock:
+            return {
+                "kind": "sketches",
+                "models": {
+                    name: {
+                        stage: stats.sketches[stage].to_dict()
+                        for stage in _SKETCH_STAGES
+                    }
+                    for name, stats in sorted(self._stats.items())
+                    if name in self._repository
+                    and self._loaded.get(name, False)
+                },
+            }
 
     # -- trace / log settings ------------------------------------------------
 
