@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Single static-analysis entry point shared by CI and tier-1.
 #
-#   scripts/run_static_checks.sh [--write-baseline] [--sanitize] [--changed] [paths...]
+#   scripts/run_static_checks.sh [--write-baseline] [--sanitize] [--modelcheck] [--changed] [paths...]
 #
 # --changed is the pre-commit fast path: tpulint lints only git-touched
 # files against the cached whole-program call graph (<2 s warm), and the
@@ -12,6 +12,11 @@
 # sanitizer witnessing TPU001/TPU006/TPU007 under execution — see the
 # README "Runtime sanitizers" subsection), writes the runtime report,
 # and diffs it against the static picture with scripts/tpusan_report.py.
+#
+# --modelcheck runs tpumc (scripts/tpumc.py): the four scheduling-core
+# harness models explored under the bounded-preemption schedule
+# enumerator, each capped at 60 s wall clock. Deterministic (seeded DFS)
+# — any finding prints a replay trace and fails the check.
 #
 # Chains, in order:
 #   1. tpulint        — project-specific checks (TPU001..TPU010, incl. the
@@ -46,11 +51,13 @@ BASELINE_FILE="scripts/tpulint_baseline.json"
 
 WRITE_BASELINE=0
 SANITIZE=0
+MODELCHECK=0
 CHANGED=0
 while :; do
     case "${1:-}" in
         --write-baseline) WRITE_BASELINE=1; shift ;;
         --sanitize) SANITIZE=1; shift ;;
+        --modelcheck) MODELCHECK=1; shift ;;
         --changed) CHANGED=1; shift ;;
         *) break ;;
     esac
@@ -145,6 +152,16 @@ if [ "${SANITIZE}" -eq 1 ]; then
         tests/test_aio_stress.py tests/test_batcher_stress.py
     run_check "tpusan-report" "${PYTHON}" scripts/tpusan_report.py \
         --dynamic "${TPUSAN_OUT}" --fail-on-witnessed
+fi
+
+# 6. tpumc (opt-in): schedule-space model checking of the four
+#    scheduling cores. Seeded + bounded, so the run is deterministic;
+#    each harness gets at most 60 s of wall clock. Findings embed replay
+#    traces (re-run with `scripts/tpumc.py --replay <trace.json>`).
+if [ "${MODELCHECK}" -eq 1 ]; then
+    TPUMC_OUT="${TPUMC_REPORT:-/tmp/tpumc_report.json}"
+    run_check "tpumc" env JAX_PLATFORMS=cpu "${PYTHON}" scripts/tpumc.py \
+        --seed 0 --deadline-s 60 --json "${TPUMC_OUT}"
 fi
 
 if [ "${failures}" -ne 0 ]; then

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""tpumc launcher: explore, or byte-identically replay, harness models.
+
+Exploration mode runs the named harnesses (default: the four scheduling
+cores — ``batcher``, ``gpt_engine``, ``kvcache``, ``fleet_admission``)
+under the bounded-preemption explorer and prints one summary line per
+harness; any finding prints with its replay trace and fails the run.
+Demo harnesses (``demo_lost_wakeup``, ``demo_deadlock``) carry seeded
+bugs and are excluded from the default set — run them by name to watch
+the checker work.
+
+Replay mode (``--replay trace.json``) re-executes one recorded schedule
+— the ``trace`` object embedded in every finding — and prints the
+findings it reproduces. Replaying a finding's trace reproduces that
+finding's record byte-for-byte; that is the debugging contract.
+
+Usage:
+    python scripts/tpumc.py                       # the four cores
+    python scripts/tpumc.py demo_lost_wakeup      # watch a seeded bug
+    python scripts/tpumc.py --list
+    python scripts/tpumc.py --sarif tpumc.sarif --json tpumc.json
+    python scripts/tpumc.py --replay trace.json
+
+Exit status: 1 if any explored harness produced findings (or a replay
+reproduced none), else 0. A harness whose subsystem is unavailable in
+this interpreter (e.g. ``gpt_engine`` without jax) is skipped with a
+notice, not failed — the container CI targets has the full toolchain.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from tritonclient_tpu import mc  # noqa: E402
+
+
+def _print_findings(findings):
+    for rec in findings:
+        print(f"  {rec['path']}:{rec['line']}: {rec['rule']} "
+              f"{rec['message']}")
+        print(f"    replay: {json.dumps(rec['trace'], sort_keys=True)}")
+
+
+def _explore(args) -> int:
+    names = args.harness or list(mc.DEFAULT_HARNESSES)
+    unknown = [n for n in names if n not in mc.HARNESSES]
+    if unknown:
+        print(f"tpumc: unknown harness(es): {', '.join(unknown)} "
+              f"(--list shows all)", file=sys.stderr)
+        return 2
+    results = []
+    failed = 0
+    for name in names:
+        budget = args.max_schedules or mc.SCHEDULE_BUDGETS.get(name, 1000)
+        try:
+            result = mc.run_harness(
+                name,
+                preemption_budget=args.preemption_budget,
+                max_schedules=budget,
+                deadline_s=args.deadline_s,
+                seed=args.seed,
+                prune=args.prune,
+            )
+        except mc.HarnessUnavailable as e:
+            print(f"tpumc: {name}: SKIPPED ({e})")
+            continue
+        results.append(result)
+        status = "complete" if result.complete else "capped"
+        print(f"tpumc: {name}: {result.schedules} schedules ({status}), "
+              f"{len(result.findings)} finding(s), "
+              f"{result.elapsed_s:.1f}s, "
+              f"pruned {result.pruned_independent} independent / "
+              f"{result.pruned_budget} over-budget branches")
+        if result.findings:
+            failed += 1
+            _print_findings(result.findings)
+    if args.json_out:
+        with open(args.json_out, "w", encoding="utf-8") as f:
+            json.dump([r.as_dict() for r in results], f, indent=2,
+                      sort_keys=True)
+            f.write("\n")
+    if args.sarif_out:
+        merged = mc.ExploreResult("all", args.seed, args.preemption_budget)
+        for r in results:
+            for rec in r.findings:
+                merged.add_finding(rec)
+        with open(args.sarif_out, "w", encoding="utf-8") as f:
+            f.write(merged.sarif())
+    if failed:
+        print(f"tpumc: {failed} harness(es) with findings")
+        return 1
+    return 0
+
+
+def _replay(args) -> int:
+    with open(args.replay, encoding="utf-8") as f:
+        doc = json.load(f)
+    # Accept a bare trace, a finding record, or a findings list.
+    if isinstance(doc, list):
+        doc = doc[0]
+    trace = doc.get("trace", doc)
+    name = trace["harness"]
+    if name not in mc.HARNESSES:
+        print(f"tpumc: trace names unknown harness {name!r}",
+              file=sys.stderr)
+        return 2
+    explorer = mc.Explorer(
+        mc.HARNESSES[name], name=name,
+        preemption_budget=trace.get("preemption_budget", 2),
+        seed=trace.get("seed", 0),
+    )
+    result = explorer.replay(trace)
+    print(f"tpumc: replayed {name} schedule "
+          f"({len(trace['decisions'])} decisions): "
+          f"{len(result.findings)} finding(s)")
+    _print_findings(result.findings)
+    return 0 if result.findings else 1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("harness", nargs="*",
+                        help="harness names (default: the four cores)")
+    parser.add_argument("--list", action="store_true",
+                        help="list available harnesses and exit")
+    parser.add_argument("--replay", metavar="TRACE",
+                        help="replay a recorded trace (JSON file: a "
+                        "trace object or a finding embedding one)")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--preemption-budget", type=int, default=2)
+    parser.add_argument("--max-schedules", type=int, default=0,
+                        help="override the per-harness schedule budget")
+    parser.add_argument("--deadline-s", type=float, default=60.0,
+                        help="wall-clock cap per harness (default 60)")
+    parser.add_argument("--prune", choices=("dpor", "naive"),
+                        default="dpor",
+                        help="'naive' disables DPOR pruning (PERF A/B)")
+    parser.add_argument("--json", dest="json_out", metavar="FILE",
+                        help="write per-harness results as JSON")
+    parser.add_argument("--sarif", dest="sarif_out", metavar="FILE",
+                        help="write merged findings as SARIF 2.1.0")
+    args = parser.parse_args(argv)
+    if args.list:
+        for name in sorted(mc.HARNESSES):
+            tag = "" if name in mc.DEFAULT_HARNESSES else "  (demo)"
+            print(f"{name}{tag}")
+        return 0
+    if args.replay:
+        return _replay(args)
+    return _explore(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,9 +3,11 @@
 
 Closing the static/dynamic loop needs an answer to three questions per
 paired rule (TPU001 async-blocking, TPU006 shm-lifecycle, TPU007
-lock-order, TPU009 guarded-by — the Eraser lockset witness; TPU010 is
-diffed too, static-only, so its hot-path findings appear in the
-unexercised column rather than vanishing from the report):
+lock-order, TPU009 guarded-by — the Eraser lockset witness, TPU011
+condvar discipline — witnessed by the tpumc schedule explorer rather
+than the passive sanitizer; TPU010 is diffed too, static-only, so its
+hot-path findings appear in the unexercised column rather than
+vanishing from the report):
 
 * **witnessed** — statically flagged AND observed at runtime: the static
   finding is real and the suite exercises it (these should be zero on a
@@ -22,8 +24,11 @@ Usage:
     python scripts/tpusan_report.py --dynamic tpusan.json [paths...]
     python scripts/tpusan_report.py --dynamic tpusan.sarif --rules TPU006
 
-``--dynamic`` takes the file ``TPUSAN_REPORT`` wrote (JSON or SARIF);
-static findings come from running tpulint in-process over ``paths``
+``--dynamic`` takes the file ``TPUSAN_REPORT`` wrote (JSON or SARIF) or
+a tpumc report (``scripts/tpumc.py --json``/``--sarif`` — a list of
+per-harness results whose findings then witness TPU007/TPU009/TPU011);
+pass it repeatedly to merge sanitizer and model-checker evidence.
+Static findings come from running tpulint in-process over ``paths``
 (default: tritonclient_tpu scripts tests) WITHOUT baseline filtering —
 the diff wants the complete static picture. Matching is by (rule, file):
 line-level matching would break whenever an unrelated edit shifts code,
@@ -44,7 +49,8 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
-DEFAULT_RULES = ("TPU001", "TPU006", "TPU007", "TPU009", "TPU010")
+DEFAULT_RULES = ("TPU001", "TPU006", "TPU007", "TPU009", "TPU010",
+                 "TPU011")
 
 
 def load_dynamic(path: str):
@@ -54,6 +60,9 @@ def load_dynamic(path: str):
         return load_sarif_findings(path)
     with open(path, encoding="utf-8") as f:
         doc = json.load(f)
+    if isinstance(doc, list):
+        # tpumc --json: a list of per-harness ExploreResult dicts.
+        return [f for r in doc for f in r.get("findings", [])]
     return list(doc.get("findings", []))
 
 
@@ -142,8 +151,9 @@ def main(argv=None) -> int:
         help="paths for the static run (default: the tpulint scope)",
     )
     parser.add_argument(
-        "--dynamic", metavar="FILE",
-        help="tpusan report (JSON or SARIF) from a TPUSAN=1 suite run",
+        "--dynamic", metavar="FILE", action="append",
+        help="runtime report: tpusan (TPUSAN=1 suite run) or tpumc "
+        "(scripts/tpumc.py --json/--sarif); repeat to merge evidence",
     )
     parser.add_argument(
         "--rules", default=",".join(DEFAULT_RULES),
@@ -166,7 +176,8 @@ def main(argv=None) -> int:
 
     try:
         dynamic = [
-            f for f in load_dynamic(args.dynamic) if f.get("rule") in rules
+            f for path in args.dynamic for f in load_dynamic(path)
+            if f.get("rule") in rules
         ]
     except (OSError, ValueError) as e:
         print(f"tpusan_report: cannot load dynamic report: {e}",

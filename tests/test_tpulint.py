@@ -1644,6 +1644,311 @@ def test_interprocedural_rules_run_clean_on_the_repo():
 
     package_dir = os.path.dirname(tritonclient_tpu.__file__)
     findings, _ = run_analysis(
-        [package_dir], select={"TPU009", "TPU010"}
+        [package_dir], select={"TPU009", "TPU010", "TPU011"}
     )
     assert findings == [], "\n".join(f.text() for f in findings)
+
+
+# --------------------------------------------------------------------------- #
+# TPU011 condvar discipline                                                   #
+# --------------------------------------------------------------------------- #
+
+
+CONDVAR_FIXTURE = """
+    import threading
+
+
+    class Box:
+        def __init__(self):
+            self._cv = threading.Condition()
+            self.ready = False
+
+        def consume(self):
+            with self._cv:
+                if not self.ready:
+                    self._cv.wait()
+
+        def produce(self):
+            with self._cv:
+                self.ready = True
+                self._cv.notify_all()
+"""
+
+
+class TestCondvarDiscipline:
+    def test_fires_on_wait_without_loop(self, tmp_path):
+        findings = lint(tmp_path, CONDVAR_FIXTURE, select={"TPU011"})
+        assert rules_of(findings) == ["TPU011"]
+        msg = findings[0].message
+        assert "not inside a predicate re-check loop" in msg
+        assert "Box._cv" in msg
+
+    def test_clean_when_wait_loops_on_the_predicate(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            CONDVAR_FIXTURE.replace(
+                "if not self.ready:", "while not self.ready:"
+            ),
+            select={"TPU011"},
+        )
+        assert findings == []
+
+    def test_wait_for_is_exempt_from_the_loop_arm(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            CONDVAR_FIXTURE.replace(
+                "if not self.ready:\n                    self._cv.wait()",
+                "self._cv.wait_for(lambda: self.ready)",
+            ),
+            select={"TPU011"},
+        )
+        assert findings == []
+
+    def test_lost_wakeup_shape_fires_both_arms(self, tmp_path):
+        """The canonical lost wakeup: predicate written and notified
+        outside the cv's lock. Both the notify-without-lock arm and the
+        predicate-outside-lock arm (anchored at the wait) fire."""
+        findings = lint(
+            tmp_path,
+            """
+            import threading
+
+
+            class Box:
+                def __init__(self):
+                    self._cv = threading.Condition()
+                    self.ready = False
+
+                def consume(self):
+                    with self._cv:
+                        while not self.ready:
+                            self._cv.wait()
+
+                def produce(self):
+                    self.ready = True
+                    self._cv.notify_all()
+            """,
+            select={"TPU011"},
+        )
+        messages = sorted(f.message for f in findings)
+        assert len(messages) == 2, messages
+        assert any("without holding `Box._cv`" in m for m in messages)
+        assert any(
+            "test-then-sleep across that update" in m for m in messages
+        )
+        assert any("`Box.produce`" in m for m in messages)
+
+    def test_timed_wait_result_ignored_fires(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            CONDVAR_FIXTURE.replace(
+                "if not self.ready:\n                    self._cv.wait()",
+                "self._cv.wait(timeout=0.5)",
+            ),
+            select={"TPU011"},
+        )
+        assert rules_of(findings) == ["TPU011"]
+        assert "is ignored" in findings[0].message
+
+    def test_timed_wait_in_predicate_loop_is_exempt(self, tmp_path):
+        """``while not self.ready: cv.wait(timeout=...)`` — the loop
+        re-check subsumes the result; flagging it would punish correct
+        code (the TransferCoalescer/heartbeat shape)."""
+        findings = lint(
+            tmp_path,
+            CONDVAR_FIXTURE.replace(
+                "if not self.ready:\n                    self._cv.wait()",
+                "while not self.ready:\n"
+                "                    self._cv.wait(timeout=0.5)",
+            ),
+            select={"TPU011"},
+        )
+        assert findings == []
+
+    def test_timed_wait_with_result_used_is_clean(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            CONDVAR_FIXTURE.replace(
+                "if not self.ready:\n                    self._cv.wait()",
+                "got = self._cv.wait(timeout=0.5)\n"
+                "                if not got:\n"
+                "                    raise TimeoutError",
+            ),
+            select={"TPU011"},
+        )
+        assert findings == []
+
+    def test_notify_with_no_predicate_write_fires(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import threading
+
+
+            class Box:
+                def __init__(self):
+                    self._cv = threading.Condition()
+
+                def kick(self):
+                    with self._cv:
+                        self._cv.notify_all()
+            """,
+            select={"TPU011"},
+        )
+        assert rules_of(findings) == ["TPU011"]
+        assert "no predicate write" in findings[0].message
+
+    def test_notify_helper_split_is_clean(self, tmp_path):
+        """``self._mutate(); self._notify()`` — the write lives in the
+        caller, the notify in a helper whose every call site holds the
+        lock: both the no-write arm (caller subtree counts) and the
+        notify-without-lock arm (entry-lockset credit) stay quiet."""
+        findings = lint(
+            tmp_path,
+            """
+            import threading
+
+
+            class Box:
+                def __init__(self):
+                    self._cv = threading.Condition()
+                    self.ready = False
+
+                def consume(self):
+                    with self._cv:
+                        while not self.ready:
+                            self._cv.wait()
+
+                def produce(self):
+                    with self._cv:
+                        self.ready = True
+                        self._notify()
+
+                def _notify(self):
+                    self._cv.notify_all()
+            """,
+            select={"TPU011"},
+        )
+        assert findings == []
+
+    def test_queue_signal_counts_as_predicate_write(self, tmp_path):
+        """A notify whose function publishes work through a queue is
+        conveying real state: the put() is the predicate write."""
+        findings = lint(
+            tmp_path,
+            """
+            import queue
+            import threading
+
+
+            class Feeder:
+                def __init__(self):
+                    self._cv = threading.Condition()
+                    self._work = queue.Queue()
+
+                def submit(self, item):
+                    self._work.put(item)
+                    with self._cv:
+                        self._cv.notify_all()
+            """,
+            select={"TPU011"},
+        )
+        assert findings == []
+
+    def test_event_wait_is_not_a_cv_site(self, tmp_path):
+        """``threading.Event.wait`` shares the method name but not the
+        contract (no lock, no predicate): the rule must not touch it —
+        the server core's ``slot.event.wait`` loop is this shape."""
+        findings = lint(
+            tmp_path,
+            """
+            import threading
+
+
+            class Box:
+                def __init__(self):
+                    self._evt = threading.Event()
+
+                def consume(self):
+                    self._evt.wait()
+
+                def produce(self):
+                    self._evt.set()
+            """,
+            select={"TPU011"},
+        )
+        assert findings == []
+
+    def test_suppressed(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            CONDVAR_FIXTURE.replace(
+                "self._cv.wait()",
+                "self._cv.wait()  # tpulint: disable=TPU011",
+            ),
+            select={"TPU011"},
+        )
+        assert findings == []
+
+    def test_test_files_are_exempt(self, tmp_path):
+        findings = lint(
+            tmp_path, CONDVAR_FIXTURE, name="test_box.py",
+            select={"TPU011"},
+        )
+        assert findings == []
+
+
+class TestBaselineShrinkCoversTPU011:
+    """scripts/check_baseline_shrink.py is fingerprint-generic; this
+    pins that TPU011 fingerprints ride the same shrink-only gate."""
+
+    def _load_script(self):
+        import importlib.util
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "check_baseline_shrink",
+            os.path.join(repo, "scripts", "check_baseline_shrink.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def _seed_repo(self, tmp_path, entries):
+        import subprocess
+
+        (tmp_path / "scripts").mkdir()
+        (tmp_path / "scripts" / "tpulint_baseline.json").write_text(
+            json.dumps(
+                {"format": "tpulint-baseline", "findings": entries}
+            )
+        )
+        for argv in (["init", "-q"], ["add", "."],
+                     ["-c", "user.email=t@example.com", "-c", "user.name=t",
+                      "commit", "-q", "-m", "seed"]):
+            subprocess.run(["git", *argv], cwd=tmp_path, check=True,
+                           capture_output=True)
+
+    def test_new_tpu011_fingerprint_fails_the_gate(self, tmp_path,
+                                                   monkeypatch, capsys):
+        mod = self._load_script()
+        fp = "TPU011::pkg/a.py::result of timed wait ignored"
+        self._seed_repo(tmp_path, {fp: 1})
+        monkeypatch.setattr(mod, "_REPO_ROOT", str(tmp_path))
+        assert mod.main(["--base", "HEAD"]) == 0
+        # Growing the count or adding a fingerprint must fail.
+        (tmp_path / "scripts" / "tpulint_baseline.json").write_text(
+            json.dumps({
+                "format": "tpulint-baseline",
+                "findings": {fp: 2,
+                             "TPU011::pkg/b.py::notify without lock": 1},
+            })
+        )
+        assert mod.main(["--base", "HEAD"]) == 1
+        err = capsys.readouterr().err
+        assert "GREW" in err and "NEW" in err
+        # Shrinking back below the committed counts passes.
+        (tmp_path / "scripts" / "tpulint_baseline.json").write_text(
+            json.dumps({"format": "tpulint-baseline", "findings": {}})
+        )
+        assert mod.main(["--base", "HEAD"]) == 0

@@ -1,0 +1,247 @@
+"""Tests for tpumc, the schedule-space model checker.
+
+The contract under test, in order of importance:
+
+1. **Detection** — the seeded demo harnesses' bugs (a lost wakeup, an
+   AB-BA deadlock) are found deterministically within the default
+   preemption budget.
+2. **Replay** — every finding's embedded trace, replayed through a
+   fresh :class:`Explorer`, reproduces that finding's record
+   byte-for-byte (JSON-identical). This is the debugging contract:
+   a tpumc finding is never a flake you cannot get back.
+3. **Real code** — the four scheduling-core harnesses drive the actual
+   batcher/gpt-engine/kvcache/fleet code under bounded exploration and
+   hold their invariants on every schedule (bounded here to keep tier-1
+   fast; CI's tpumc lane runs the full budgets).
+4. **Regression** — the ReplicaSet lease-counter race fixed in the
+   guarded-by PR stays fixed: the real ``acquire``/``release``/
+   ``snapshot`` paths explore clean, and re-introducing the lock-free
+   read (a fixture copy of the pre-fix shape) is caught as TPU009.
+"""
+
+import json
+
+import pytest
+
+from tritonclient_tpu import mc, sanitize
+
+
+def record_json(rec) -> str:
+    return json.dumps(rec, indent=2, sort_keys=True)
+
+
+def replay_of(name, rec):
+    trace = rec["trace"]
+    explorer = mc.Explorer(
+        mc.HARNESSES[name], name=name,
+        preemption_budget=trace["preemption_budget"],
+        seed=trace["seed"],
+    )
+    return explorer.replay(trace)
+
+
+def assert_replays_byte_identically(name, rec):
+    replayed = replay_of(name, rec)
+    got = [record_json(r) for r in replayed.findings]
+    assert record_json(rec) in got, (
+        f"replaying the trace did not reproduce the finding:\n"
+        f"want {record_json(rec)}\ngot {got}"
+    )
+
+
+# --------------------------------------------------------------------------- #
+# seeded demos: detection + byte-identical replay                             #
+# --------------------------------------------------------------------------- #
+
+
+class TestSeededBugs:
+    def test_lost_wakeup_is_found(self):
+        result = mc.run_harness("demo_lost_wakeup", max_schedules=200)
+        rules = {r["rule"] for r in result.findings}
+        assert "TPU011" in rules, mc.findings_json(result)
+        rec = next(r for r in result.findings if r["rule"] == "TPU011")
+        assert "lost wakeup" in rec["message"]
+        assert "consumer" in rec["message"]
+        assert rec["path"].endswith("mc/_harnesses.py")
+        # The flag race feeding the lost wakeup is witnessed too.
+        assert "TPU009" in rules
+
+    def test_lost_wakeup_trace_replays_byte_identically(self):
+        result = mc.run_harness("demo_lost_wakeup", max_schedules=200)
+        assert result.findings
+        for rec in result.findings:
+            assert_replays_byte_identically("demo_lost_wakeup", rec)
+
+    def test_deadlock_is_found_and_replays(self):
+        result = mc.run_harness("demo_deadlock", max_schedules=200)
+        rules = [r["rule"] for r in result.findings]
+        assert rules == ["TPU007"], mc.findings_json(result)
+        rec = result.findings[0]
+        assert "demo.lock_a" in rec["message"]
+        assert "demo.lock_b" in rec["message"]
+        assert_replays_byte_identically("demo_deadlock", rec)
+
+    def test_exploration_is_deterministic(self):
+        a = mc.run_harness("demo_lost_wakeup", max_schedules=200)
+        b = mc.run_harness("demo_lost_wakeup", max_schedules=200)
+        assert mc.findings_json(a) == mc.findings_json(b)
+        assert a.schedules == b.schedules
+
+    def test_trace_carries_the_replay_door(self):
+        result = mc.run_harness("demo_deadlock", max_schedules=200)
+        trace = result.findings[0]["trace"]
+        assert trace["harness"] == "demo_deadlock"
+        assert trace["seed"] == 0
+        assert trace["preemption_budget"] == 2
+        assert all(isinstance(d, int) for d in trace["decisions"])
+
+    def test_budget_zero_misses_the_deadlock(self):
+        """The AB-BA interleaving needs one preemption; with a zero
+        budget the checker cannot reach it — the CHESS-style knob is
+        real, not decorative."""
+        result = mc.run_harness("demo_deadlock", preemption_budget=0,
+                                max_schedules=200)
+        assert result.findings == []
+        assert result.pruned_budget > 0
+
+    def test_dpor_and_naive_agree_on_findings(self):
+        """Pruning must drop only redundant schedules: the naive
+        explorer (every branch) and the DPOR-lite explorer reach the
+        same set of finding fingerprints, DPOR in fewer schedules."""
+        dpor = mc.run_harness("demo_lost_wakeup", max_schedules=500)
+        naive = mc.run_harness("demo_lost_wakeup", max_schedules=500,
+                               prune="naive")
+        fp = lambda res: sorted(r["fingerprint"] for r in res.findings)
+        assert fp(dpor) == fp(naive)
+        assert dpor.schedules <= naive.schedules
+
+
+# --------------------------------------------------------------------------- #
+# the four scheduling cores: real code, invariants hold                       #
+# --------------------------------------------------------------------------- #
+
+
+# Bounded below CI's budgets so tier-1 stays fast; every explored
+# schedule still checks the full invariant set.
+_TIER1_BUDGETS = {
+    "batcher": 300,
+    "gpt_engine": 100,
+    "kvcache": 300,
+    "fleet_admission": 300,
+}
+
+
+class TestCoreHarnesses:
+    @pytest.mark.parametrize("name", sorted(mc.DEFAULT_HARNESSES))
+    def test_harness_explores_clean(self, name):
+        try:
+            result = mc.run_harness(
+                name, max_schedules=_TIER1_BUDGETS[name], deadline_s=60.0
+            )
+        except mc.HarnessUnavailable as e:
+            pytest.skip(str(e))
+        assert result.findings == [], mc.findings_json(result)
+        assert result.schedules >= 20  # the model actually branched
+
+    def test_kvcache_full_budget_completes(self):
+        """At its CI budget the kvcache harness exhausts its schedule
+        space — the invariant claim is exhaustive, not sampled."""
+        result = mc.run_harness(
+            "kvcache", max_schedules=mc.SCHEDULE_BUDGETS["kvcache"]
+        )
+        assert result.complete
+        assert result.findings == [], mc.findings_json(result)
+
+
+# --------------------------------------------------------------------------- #
+# ReplicaSet lease-counter regression (the guarded-by PR's race)              #
+# --------------------------------------------------------------------------- #
+
+
+def _replica_model(broken: bool) -> mc.Model:
+    """Router + scraper over the REAL ReplicaSet lease paths. With
+    ``broken=True`` the scraper is a fixture copy of the pre-fix
+    ``snapshot()`` shape: reading ``outstanding`` without the set lock."""
+    from tritonclient_tpu.fleet._replica import ReplicaSet
+
+    m = mc.Model("replica-snapshot")
+    rs = ReplicaSet(clock=lambda: 100.0)
+    replica = rs.add("r0", "http://r0:8000")
+
+    def router():
+        for _ in range(2):
+            rs.acquire(replica)
+            rs.release(replica)
+
+    def scraper():
+        if broken:
+            # Pre-fix shape: lock-free counter read (regression seed).
+            sanitize.note_field_access(replica, "outstanding",
+                                       write=False)
+            _ = replica.outstanding
+        else:
+            snap = rs.snapshot()
+            assert len(snap) == 1 and "outstanding" in snap[0]
+
+    m.thread("router", router)
+    m.thread("scraper", scraper)
+    m.invariant("leases drained", lambda: replica.outstanding == 0)
+    return m
+
+
+class TestReplicaSnapshotRegression:
+    def test_fixed_snapshot_explores_clean(self):
+        explorer = mc.Explorer(lambda: _replica_model(False),
+                               name="replica_snapshot",
+                               max_schedules=400)
+        result = explorer.explore()
+        assert result.findings == [], mc.findings_json(result)
+        assert result.complete
+
+    def test_lock_free_read_fixture_is_caught(self):
+        explorer = mc.Explorer(lambda: _replica_model(True),
+                               name="replica_snapshot_broken",
+                               max_schedules=400)
+        result = explorer.explore()
+        rules = {r["rule"] for r in result.findings}
+        assert "TPU009" in rules, mc.findings_json(result)
+        rec = next(r for r in result.findings if r["rule"] == "TPU009")
+        assert "outstanding" in rec["message"]
+        # And the witness replays like any other finding.
+        replayed = mc.Explorer(lambda: _replica_model(True),
+                               name="replica_snapshot_broken",
+                               ).replay(rec["trace"])
+        got = [record_json(r) for r in replayed.findings]
+        assert record_json(rec) in got
+
+
+# --------------------------------------------------------------------------- #
+# result plumbing                                                             #
+# --------------------------------------------------------------------------- #
+
+
+class TestResultPlumbing:
+    def test_sarif_shares_the_analysis_machinery(self):
+        result = mc.run_harness("demo_deadlock", max_schedules=200)
+        doc = json.loads(result.sarif())
+        driver = doc["runs"][0]["tool"]["driver"]
+        assert driver["name"] == "tpumc"
+        rule_ids = {r["id"] for r in driver["rules"]}
+        assert "TPU007" in rule_ids
+        results = doc["runs"][0]["results"]
+        assert len(results) == 1
+        assert results[0]["ruleId"] == "TPU007"
+        assert results[0]["partialFingerprints"]
+
+    def test_as_dict_shape(self):
+        result = mc.run_harness("demo_deadlock", max_schedules=200)
+        d = result.as_dict()
+        assert d["tool"] == "tpumc"
+        assert d["harness"] == "demo_deadlock"
+        assert d["schedules"] == result.schedules
+        assert d["complete"] is True
+        assert d["findings"] and d["findings"][0]["trace"]["decisions"]
+
+    def test_unknown_harness_raises(self):
+        with pytest.raises(KeyError):
+            mc.run_harness("nope")

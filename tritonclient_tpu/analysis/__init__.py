@@ -1,9 +1,9 @@
 """tpulint — project-specific static analysis for the TPU serving stack.
 
-Ten check families tuned to the bug classes this codebase's surfaces
+Eleven check families tuned to the bug classes this codebase's surfaces
 actually grow (two protocol front-ends, sync+aio clients, a threaded
 server core, a DLPack/shm registry). TPU001–TPU005 are AST-local;
-TPU006–TPU008 are flow- and project-sensitive; TPU009–TPU010 are
+TPU006–TPU008 are flow- and project-sensitive; TPU009–TPU011 are
 interprocedural over the whole-program call graph (``_callgraph.py``):
 
 =======  =================  ====================================================
@@ -47,12 +47,20 @@ TPU010   jax-hot-path       device→host syncs (``np.asarray``/``float``/
                             (jit built per call, static-arg drift) on any
                             function reachable from a ``# tpulint:
                             hot-path`` annotated root
+TPU011   condvar-           condition-variable discipline over declared
+         discipline         ``named_condition`` locks: untimed wait outside
+                            a predicate re-check loop, timed-wait result
+                            ignored, notify without the cv's lock or with
+                            no predicate write in its call subtree, and
+                            wait predicates mutated outside the cv (the
+                            lost-wakeup shape ``tpumc`` witnesses
+                            dynamically)
 =======  =================  ====================================================
 
 Suppress a deliberate violation with ``# tpulint: disable=TPU001`` (comma
 list allowed) on the offending line, or on a ``def``/``class`` line to
 cover the whole body; ``# tpulint: disable-file=TPU003`` anywhere in a file
-covers the file. Project-wide rules (TPU004/007–010) honor the same
+covers the file. Project-wide rules (TPU004/007–011) honor the same
 syntax at the line their finding points to. Mark a hot root with
 ``# tpulint: hot-path`` on (or immediately above) its ``def`` line —
 TPU010 treats everything call-graph-reachable from it as hot.

@@ -107,7 +107,15 @@ _SYNC_METHODS = {"item", "tolist", "__array__"}
 
 _HOT_RE = re.compile(r"#\s*tpulint:\s*hot-path\b")
 
-CACHE_VERSION = 4
+#: Condition-variable methods recorded as cv sites (TPU011 substrate).
+_CV_METHODS = {"wait", "wait_for", "notify", "notify_all"}
+
+#: Methods on self-synchronizing objects that carry a wakeup-visible
+#: state change (queue put, event set/clear, semaphore release) — they
+#: count as predicate writes for the notify-discipline check.
+_SIGNAL_METHODS = {"put", "put_nowait", "set", "clear", "release"}
+
+CACHE_VERSION = 5  # v5: cv sites + signal calls in function summaries
 
 
 def modkey_for(path: str) -> str:
@@ -177,9 +185,46 @@ class Hazard:
         return cls(row[0], row[1], row[2], row[3], bool(row[4]), bool(row[5]))
 
 
+class CvSite:
+    """One condition-variable operation (TPU011's substrate).
+
+    ``cv`` is the resolved lock key of a declared Condition; ``kind`` ∈
+    wait | wait_for | notify | notify_all. ``preds`` are the ``self.*``
+    attribute names the site's predicate mentions (the enclosing
+    ``while``/``if`` test for a wait, the lambda body for a wait_for).
+    ``locks`` is the lexically-held lockset at the site.
+    """
+
+    __slots__ = ("kind", "cv", "line", "col", "timed", "in_loop",
+                 "result_used", "preds", "locks")
+
+    def __init__(self, kind, cv, line, col, timed, in_loop, result_used,
+                 preds, locks):
+        self.kind = kind
+        self.cv = cv
+        self.line = line
+        self.col = col
+        self.timed = timed
+        self.in_loop = in_loop
+        self.result_used = result_used
+        self.preds = tuple(preds)
+        self.locks = tuple(locks)
+
+    def to_json(self):
+        return [self.kind, self.cv, self.line, self.col, int(self.timed),
+                int(self.in_loop), int(self.result_used),
+                list(self.preds), list(self.locks)]
+
+    @classmethod
+    def from_json(cls, row):
+        return cls(row[0], row[1], row[2], row[3], bool(row[4]),
+                   bool(row[5]), bool(row[6]), row[7], row[8])
+
+
 class FunctionSummary:
     __slots__ = ("key", "path", "line", "cls", "name", "public", "hot",
-                 "is_spawn_site", "calls", "accesses", "spawns", "hazards")
+                 "is_spawn_site", "calls", "accesses", "spawns", "hazards",
+                 "cvsites", "signals")
 
     def __init__(self, key, path, line, cls_name, name, public, hot):
         self.key = key
@@ -195,6 +240,10 @@ class FunctionSummary:
         # [(target_key or None, kind, line)]
         self.spawns: List[Tuple[Optional[str], str, int]] = []
         self.hazards: List[Hazard] = []
+        self.cvsites: List[CvSite] = []
+        # [(attr, method, line)] — _SIGNAL_METHODS calls on attributes
+        # (queue put / event set): wakeup-visible state changes.
+        self.signals: List[Tuple[str, str, int]] = []
 
     def to_json(self):
         return {
@@ -205,6 +254,8 @@ class FunctionSummary:
             "accesses": [a.to_json() for a in self.accesses],
             "spawns": [[t, k, ln] for t, k, ln in self.spawns],
             "hazards": [h.to_json() for h in self.hazards],
+            "cvsites": [s.to_json() for s in self.cvsites],
+            "signals": [[a, m, ln] for a, m, ln in self.signals],
         }
 
     @classmethod
@@ -215,6 +266,8 @@ class FunctionSummary:
         fn.accesses = [Access.from_json(r) for r in d["accesses"]]
         fn.spawns = [(t, k, ln) for t, k, ln in d["spawns"]]
         fn.hazards = [Hazard.from_json(r) for r in d["hazards"]]
+        fn.cvsites = [CvSite.from_json(r) for r in d.get("cvsites", [])]
+        fn.signals = [(a, m, ln) for a, m, ln in d.get("signals", [])]
         return fn
 
 
@@ -725,8 +778,95 @@ class _FnWalker:
         callee = self._resolve_callee(call, state)
         if callee is not None:
             fn.calls.append((callee, tuple(state["held"]), call.lineno))
+        # Condition-variable sites and wakeup signals (TPU011).
+        self._maybe_cvsite(call, state)
         # JAX hazards.
         self._call_hazards(call, name, state)
+
+    # -- condition-variable sites (TPU011 substrate) --------------------------
+
+    def _maybe_cvsite(self, call, state):
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            return
+        fn = state["fn"]
+        # Wakeup-visible state changes: queue.put / event.set through
+        # any receiver. These count as predicate writes for notify
+        # checks, so broader recognition only makes TPU011 quieter.
+        if func.attr in _SIGNAL_METHODS:
+            recv = func.value
+            if isinstance(recv, ast.Attribute):
+                fn.signals.append((recv.attr, func.attr, call.lineno))
+            elif isinstance(recv, ast.Name) and recv.id != "self":
+                fn.signals.append((recv.id, func.attr, call.lineno))
+        if func.attr not in _CV_METHODS:
+            return
+        # Only sites whose receiver resolves to a *declared Condition*
+        # are cv sites — `slot.event.wait()` (an Event) stays out.
+        cv = self._resolve_lock_expr(func.value, state)
+        if cv is None or self.decls.lock_kinds.get(cv) != "Condition":
+            return
+        kind = func.attr
+        timed = self._cv_timed(call, kind)
+        result_used = not isinstance(
+            self.ctx.parents.get(call), ast.Expr)
+        preds = self._cv_preds(call, kind, state)
+        fn.cvsites.append(CvSite(
+            kind, cv, call.lineno, call.col_offset, timed,
+            state["loop_depth"] > 0, result_used, preds,
+            tuple(state["held"]),
+        ))
+
+    @staticmethod
+    def _cv_timed(call, kind) -> bool:
+        # wait(timeout) — positional 0; wait_for(pred, timeout) — pos 1.
+        pos = 0 if kind == "wait" else 1
+        timeout = None
+        if len(call.args) > pos:
+            timeout = call.args[pos]
+        for kw in call.keywords:
+            if kw.arg == "timeout":
+                timeout = kw.value
+        if timeout is None:
+            return False
+        return not (isinstance(timeout, ast.Constant)
+                    and timeout.value is None)
+
+    def _cv_preds(self, call, kind, state) -> Tuple[str, ...]:
+        """``self.*`` attribute names the wait's predicate reads: the
+        enclosing ``while``/``if`` test for a wait, the predicate
+        callable for a wait_for."""
+        preds = set()
+
+        def collect(tree):
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Attribute) and _is_self_attr(node):
+                    cls = state["cls"]
+                    if cls and node.attr in self.decls.class_locks.get(
+                            cls, {}):
+                        continue
+                    preds.add(node.attr)
+
+        if kind in ("wait_for",) and (call.args or call.keywords):
+            pred_arg = call.args[0] if call.args else None
+            for kw in call.keywords:
+                if kw.arg == "predicate":
+                    pred_arg = kw.value
+            if pred_arg is not None:
+                collect(pred_arg)
+        if kind in ("wait", "wait_for"):
+            node = call
+            while node is not None:
+                parent = self.ctx.parents.get(node)
+                if isinstance(parent,
+                              (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    break
+                if isinstance(parent, ast.While) or (
+                        isinstance(parent, ast.If)
+                        and node in parent.body):
+                    collect(parent.test)
+                node = parent
+        return tuple(sorted(preds))
 
     def _spawn_target(self, call, name, state) -> Optional[Tuple[Optional[str], str]]:
         def resolve(arg):

@@ -204,6 +204,7 @@ def default_rules() -> List[Rule]:
     from tritonclient_tpu.analysis._tpu008_protocol_drift import ProtocolDriftRule
     from tritonclient_tpu.analysis._tpu009_guarded_by import GuardedByRule
     from tritonclient_tpu.analysis._tpu010_jax_hazard import JaxHazardRule
+    from tritonclient_tpu.analysis._tpu011_condvar import CondvarDisciplineRule
 
     return [
         AsyncBlockingRule(),
@@ -216,6 +217,7 @@ def default_rules() -> List[Rule]:
         ProtocolDriftRule(),
         GuardedByRule(),
         JaxHazardRule(),
+        CondvarDisciplineRule(),
     ]
 
 

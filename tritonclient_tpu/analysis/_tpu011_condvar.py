@@ -1,0 +1,206 @@
+"""TPU011: condition-variable discipline.
+
+Condition variables have a four-part contract that Python enforces no
+part of: waits must re-check their predicate in a loop (wakeups can be
+stolen or spurious), the predicate must only change under the cv's
+lock (or the waiter can test-then-sleep right across the update — the
+lost-wakeup window), notify must be issued with the lock held, and a
+*timed* wait's return value must be consulted (a ``False`` return means
+the predicate may still be false). The model checker (``tpumc``)
+witnesses the lost-wakeup schedule dynamically; this rule finds the
+shapes statically, interprocedurally, from the same call-graph
+substrate TPU009 uses (``_callgraph.py`` records every
+``wait``/``wait_for``/``notify``/``notify_all`` on a *declared
+Condition* as a :class:`~tritonclient_tpu.analysis._callgraph.CvSite`;
+method calls on Events/queues are not cv sites).
+
+Five arms, all keyed to declared ``named_condition`` locks:
+
+* **wait-no-loop** — an untimed ``wait()`` whose call site is not
+  inside a loop. ``wait_for`` is exempt (it loops internally); timed
+  waits are handled by the next arm instead.
+* **timeout-ignored** — a timed ``wait``/``wait_for`` used as a bare
+  expression statement: the ``False``-on-timeout result is dropped, so
+  timeout and wakeup become indistinguishable. Exempt when the wait
+  sits inside a loop whose test re-reads a ``self.*`` predicate — the
+  loop re-check subsumes the result, which is then redundant by
+  construction (``while not self._pending: cv.wait(timeout=...)``).
+* **notify-without-lock** — ``notify``/``notify_all`` whose effective
+  lockset (lexically held ∪ provably-held-at-entry, the TPU009
+  fixpoint) does not include the cv. Python raises at runtime, but
+  only on the paths that execute.
+* **predicate-outside-lock** — the lost-wakeup shape. The predicate
+  attributes of each wait (the enclosing ``while``/``if`` test, or the
+  ``wait_for`` callable) are collected; any post-``__init__`` write to
+  one of them anywhere in the program whose effective lockset misses
+  the cv is the write a waiter can sleep across. Self-synchronizing
+  attributes (queues, events) are exempt — their signal is the
+  operation itself.
+* **notify-no-write** — a notify whose enclosing function, its
+  transitive callees, *and every direct caller's subtree* perform no
+  attribute write and no wakeup-visible signal (``put``/``set``/…):
+  the wakeup conveys no state change, so every correctly-looping
+  waiter re-checks an unchanged predicate. Callers count so the
+  ``self._mutate(); self._notify()`` helper split stays clean.
+  Deliberately conservative; any write anywhere suppresses it.
+
+Findings in test files are dropped (tests drive quiesced internals;
+the tpumc harnesses are the dynamic witness there). Deliberate
+violations — e.g. a timed wait used purely as a bounded park where the
+loop re-derives all state — suppress with ``# tpulint:
+disable=TPU011`` on the line or ``def``, with a comment saying why.
+"""
+
+from typing import Dict, List, Sequence, Set, Tuple
+
+from tritonclient_tpu.analysis import _callgraph
+from tritonclient_tpu.analysis._engine import FileContext, Finding, Rule
+
+
+class CondvarDisciplineRule(Rule):
+    id = "TPU011"
+    name = "condvar-discipline"
+    description = (
+        "condition-variable discipline: wait without predicate loop, "
+        "ignored timeout result, notify without lock or without a "
+        "predicate write, predicate mutated outside the cv's lock"
+    )
+
+    def check_project(self, ctxs: Sequence[FileContext]) -> List[Finding]:
+        if not ctxs:
+            return []
+        graph = _callgraph.get_callgraph(ctxs)
+        linted = {
+            ctx.path for ctx in ctxs if not _is_test_path(ctx.path)
+        }
+        findings: List[Finding] = []
+        for key in sorted(graph.functions):
+            fn = graph.functions[key]
+            if fn.path not in linted:
+                continue
+            for site in fn.cvsites:
+                findings.extend(_check_site(graph, key, fn, site, linted))
+        return findings
+
+
+def _is_test_path(path: str) -> bool:
+    parts = path.split("/")
+    return "tests" in parts or parts[-1].startswith("test_")
+
+
+def _site_locks(graph, key: str, site) -> frozenset:
+    return frozenset(site.locks) | graph.entry_lockset(key)
+
+
+def _check_site(graph, key, fn, site, linted) -> List[Finding]:
+    if site.kind in ("wait", "wait_for"):
+        out = []
+        if (site.kind == "wait" and not site.timed
+                and not site.in_loop):
+            out.append(Finding(
+                CondvarDisciplineRule.id, fn.path, site.line, site.col,
+                f"`{site.cv}.wait()` in `{key}` is not inside a "
+                f"predicate re-check loop: a stolen or spurious wakeup "
+                f"proceeds with the condition still false; use `while "
+                f"not <pred>: wait()` or `wait_for(<pred>)`",
+            ))
+        if (site.timed and not site.result_used
+                and not (site.in_loop and site.preds)):
+            out.append(Finding(
+                CondvarDisciplineRule.id, fn.path, site.line, site.col,
+                f"result of timed `{site.cv}.{site.kind}(timeout=...)` "
+                f"in `{key}` is ignored: a False return means the "
+                f"timeout fired with the predicate still false — check "
+                f"the result or re-test the predicate before acting",
+            ))
+        out.extend(_check_predicate_writes(graph, key, site, linted))
+        return out
+    # notify / notify_all
+    out = []
+    held = _site_locks(graph, key, site)
+    if site.cv not in held:
+        shown = ", ".join(f"`{l}`" for l in sorted(held)) or "none"
+        out.append(Finding(
+            CondvarDisciplineRule.id, fn.path, site.line, site.col,
+            f"`{site.cv}.{site.kind}()` in `{key}` without holding "
+            f"`{site.cv}` (effective locks: {shown}): notify requires "
+            f"the cv's lock, and the unlocked window loses wakeups",
+        ))
+    if not _subtree_writes(graph, key) and not any(
+            _subtree_writes(graph, caller)
+            for caller, _held in graph.callers.get(key, ())):
+        out.append(Finding(
+            CondvarDisciplineRule.id, fn.path, site.line, site.col,
+            f"`{site.cv}.{site.kind}()` in `{key}` with no predicate "
+            f"write in the function or its callees: the wakeup conveys "
+            f"no state change, so waiters re-check an unchanged "
+            f"predicate",
+        ))
+    return out
+
+
+def _check_predicate_writes(graph, key, site, linted) -> List[Finding]:
+    """The lost-wakeup arm: a write to a wait's predicate attribute
+    anywhere in the program without the cv held is the update a waiter
+    can test-then-sleep across."""
+    fn = graph.functions[key]
+    cls = fn.cls
+    if not cls or not site.preds:
+        return []
+    findings = []
+    for attr in site.preds:
+        bad: Set[str] = set()
+        for wkey, wfn in graph.functions.items():
+            for a in wfn.accesses:
+                if (a.owner != cls or a.attr != attr or not a.write
+                        or a.in_init):
+                    continue
+                if site.cv not in graph.effective_locks(wkey, a):
+                    bad.add(wkey)
+        if not bad:
+            continue
+        writers = ", ".join(f"`{w}`" for w in sorted(bad))
+        findings.append(Finding(
+            CondvarDisciplineRule.id, fn.path, site.line, site.col,
+            f"wait predicate `{cls}.{attr}` (awaited on `{site.cv}` in "
+            f"`{key}`) is written without `{site.cv}` held in {writers}"
+            f": the waiter can test-then-sleep across that update and "
+            f"miss its wakeup",
+        ))
+    return findings
+
+
+_SUBTREE_CACHE_ATTR = "_tpu011_subtree_writes"
+
+
+def _subtree_writes(graph, key: str) -> bool:
+    """Does ``key`` or any transitive callee perform a post-init
+    attribute write or a wakeup-visible signal (queue put, event set)?
+    Memoized on the graph: the call subtree is the same for every
+    notify site in a function."""
+    cache: Dict[str, bool] = getattr(graph, _SUBTREE_CACHE_ATTR, None)
+    if cache is None:
+        cache = {}
+        setattr(graph, _SUBTREE_CACHE_ATTR, cache)
+    if key in cache:
+        return cache[key]
+    seen: Set[str] = set()
+    stack = [key]
+    result = False
+    while stack:
+        k = stack.pop()
+        if k in seen:
+            continue
+        seen.add(k)
+        fn = graph.functions.get(k)
+        if fn is None:
+            continue
+        if fn.signals or any(
+                a.write and not a.in_init for a in fn.accesses):
+            result = True
+            break
+        for callee, _held, _line in fn.calls:
+            if callee in graph.functions:
+                stack.append(callee)
+    cache[key] = result
+    return result

@@ -1,0 +1,68 @@
+"""tpumc: deterministic schedule-space model checking.
+
+The lint→witness ladder (tpulint → tpusan → tpuchaos) catches
+concurrency bugs on schedules that *happen to occur*; tpumc is the rung
+that *enumerates* schedules. It reuses the sanitizer's
+``named_lock``/``named_rlock``/``named_condition`` factories as
+schedule-control points: while a :class:`~tritonclient_tpu.mc._sched.
+SchedulerController` is installed, those factories return virtual,
+controller-owned primitives, a cooperative scheduler serializes the
+model's threads, and the :class:`Explorer` enumerates interleavings
+under a CHESS-style bounded-preemption budget (default 2) with
+sleep-set/DPOR-lite pruning keyed on lock/field-access footprints.
+
+Detected per schedule: deadlock (TPU007), lost wakeup (TPU011),
+empty-lockset races over adopted ``note_field_access`` sites (TPU009),
+harness-invariant violations (TPUMC1), and thread exceptions (TPUMC2).
+Every finding embeds a replayable trace — ``{harness, seed,
+preemption_budget, decisions}`` — that reproduces the schedule (and the
+finding JSON) byte-identically, and findings ride the shared
+``analysis/_sarif.py`` machinery into code scanning.
+
+Harness models for the four scheduling cores live in
+:mod:`tritonclient_tpu.mc._harnesses` (registry: :data:`HARNESSES`);
+``scripts/tpumc.py`` is the CLI, ``run_static_checks.sh --modelcheck``
+the CI entry point.
+
+Worked example::
+
+    from tritonclient_tpu import mc
+
+    result = mc.run_harness("demo_lost_wakeup")
+    trace = result.findings[0]["trace"]         # {seed, decisions, ...}
+    replayed = mc.Explorer(
+        mc.HARNESSES["demo_lost_wakeup"], name="demo_lost_wakeup"
+    ).replay(trace)
+    assert mc.findings_json(replayed) == mc.findings_json(result)
+"""
+
+from tritonclient_tpu.mc._explore import (
+    ExploreResult,
+    Explorer,
+    Model,
+    RULES_META,
+    findings_json,
+)
+from tritonclient_tpu.mc._harnesses import (
+    DEFAULT_HARNESSES,
+    HARNESSES,
+    SCHEDULE_BUDGETS,
+    HarnessUnavailable,
+    run_harness,
+)
+from tritonclient_tpu.mc._sched import McError, SchedulerController
+
+__all__ = [
+    "DEFAULT_HARNESSES",
+    "ExploreResult",
+    "Explorer",
+    "HARNESSES",
+    "HarnessUnavailable",
+    "McError",
+    "Model",
+    "RULES_META",
+    "SCHEDULE_BUDGETS",
+    "SchedulerController",
+    "findings_json",
+    "run_harness",
+]

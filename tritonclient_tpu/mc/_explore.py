@@ -1,0 +1,438 @@
+"""Schedule-space exploration: CHESS-style bounded preemption + DPOR-lite.
+
+One *schedule* is a full serialized execution of a harness model,
+identified by its decision list (the tid chosen at every schedule
+point). The explorer runs depth-first over decision prefixes: each
+completed run proposes branches — at every step, every *other* enabled
+thread — and a branch survives only if
+
+* taking it keeps the path's preemption count within the budget
+  (a switch away from a still-enabled thread is a preemption; switches
+  forced by blocking are free — the CHESS insight that most bugs hide
+  within very few preemptions), and
+* it is a *backtrack point*: some operation executed later in the run
+  by another thread is dependent on the operation executed at that step
+  (same lock, or same field with at least one write) and that thread
+  was enabled there — DPOR-lite pruning: when no future operation
+  conflicts, the orders commute, so the swapped schedule is equivalent
+  to one already explored. (Branching on the future *executed*
+  conflict, not the alternative's currently-pending op, is what lets a
+  notify that happens three ops into another thread's future pull that
+  thread's whole critical section ahead of a wait.)
+
+Detection per run:
+
+* deadlock (TPU007) — no thread enabled, no timeout can fire, and some
+  thread is blocked on a lock;
+* lost wakeup (TPU011) — every stuck thread sits in an untimed cv wait
+  no reachable notify can release;
+* invariant violation (TPUMC1) and thread exception (TPUMC2) — checked
+  after clean completion / surfaced from the thread body;
+* empty-lockset race (TPU009) — the Eraser intersection over adopted
+  ``note_field_access`` sites, evaluated on completed schedules.
+
+Every finding embeds a replayable trace: ``{harness, seed,
+preemption_budget, decisions}``. Replaying forces the full decision
+list, so the failing schedule — and the finding records derived from it
+— reproduce byte-identically.
+"""
+
+import json
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tritonclient_tpu import sanitize
+from tritonclient_tpu.mc._sched import (
+    McError,
+    SchedulerController,
+    _dependent,
+)
+
+#: SARIF driver metadata. TPU007/TPU009/TPU011 reuse the static rules'
+#: ids (same merge contract as tpusan); TPUMC1/TPUMC2 are model-checker
+#: native.
+RULES_META = [
+    {
+        "id": "TPU007",
+        "name": "lock-order",
+        "shortDescription": {
+            "text": "deadlock reached by schedule-space exploration"
+        },
+    },
+    {
+        "id": "TPU009",
+        "name": "guarded-by",
+        "shortDescription": {
+            "text": "empty lockset on a cross-thread field access reached "
+            "by schedule-space exploration"
+        },
+    },
+    {
+        "id": "TPU011",
+        "name": "condvar-discipline",
+        "shortDescription": {
+            "text": "lost wakeup: a cv wait no reachable notify can "
+            "release"
+        },
+    },
+    {
+        "id": "TPUMC1",
+        "name": "mc-invariant",
+        "shortDescription": {
+            "text": "harness invariant violated on an explored schedule"
+        },
+    },
+    {
+        "id": "TPUMC2",
+        "name": "mc-exception",
+        "shortDescription": {
+            "text": "unhandled exception in a model thread on an "
+            "explored schedule"
+        },
+    },
+]
+
+
+class Model:
+    """One harness: named test threads over real code + end-state
+    invariants. Built fresh for every schedule (the builder runs with
+    the controller installed, so every ``sanitize.named_*`` primitive
+    the code under test constructs is schedule-controlled)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.threads: List[Tuple[str, Callable[[], None]]] = []
+        self.invariants: List[Tuple[str, Callable[[], object]]] = []
+
+    def thread(self, name: str, fn: Callable[[], None]):
+        self.threads.append((name, fn))
+
+    def invariant(self, desc: str, fn: Callable[[], object]):
+        """``fn`` runs after a schedule completes cleanly; a False
+        return or any exception (AssertionError included) is a TPUMC1
+        finding on that schedule."""
+        self.invariants.append((desc, fn))
+
+
+class _Record:
+    """One explored step: what was chosen, what else was possible."""
+
+    __slots__ = ("chosen", "enabled", "footprints", "preemptive")
+
+    def __init__(self, chosen, enabled, footprints, preemptive):
+        self.chosen = chosen
+        self.enabled = enabled          # sorted tids
+        self.footprints = footprints    # tid -> footprint tuple
+        self.preemptive = preemptive    # this step switched off a runnable thread
+
+
+class _RunOutcome:
+    __slots__ = ("schedule", "trace", "findings", "steps")
+
+    def __init__(self, schedule, trace, findings):
+        self.schedule = schedule
+        self.trace = trace
+        self.findings = findings
+        self.steps = len(schedule)
+
+
+class ExploreResult:
+    """Aggregate over every explored schedule of one harness."""
+
+    def __init__(self, harness: str, seed: int, preemption_budget: int):
+        self.harness = harness
+        self.seed = seed
+        self.preemption_budget = preemption_budget
+        self.findings: List[dict] = []
+        self._fingerprints = set()
+        self.schedules = 0
+        self.infeasible = 0
+        self.decision_points = 0
+        self.pruned_independent = 0
+        self.pruned_budget = 0
+        self.elapsed_s = 0.0
+        self.complete = False  # frontier exhausted within limits
+
+    def add_finding(self, record: dict):
+        if record["fingerprint"] not in self._fingerprints:
+            self._fingerprints.add(record["fingerprint"])
+            self.findings.append(record)
+
+    def as_dict(self) -> dict:
+        return {
+            "tool": "tpumc",
+            "harness": self.harness,
+            "seed": self.seed,
+            "preemption_budget": self.preemption_budget,
+            "schedules": self.schedules,
+            "infeasible": self.infeasible,
+            "decision_points": self.decision_points,
+            "pruned_independent": self.pruned_independent,
+            "pruned_budget": self.pruned_budget,
+            "elapsed_s": round(self.elapsed_s, 3),
+            "complete": self.complete,
+            "findings": self.findings,
+        }
+
+    def sarif(self) -> str:
+        from tritonclient_tpu.analysis._engine import Finding
+        from tritonclient_tpu.analysis._sarif import render_sarif
+
+        found = [
+            Finding(r["rule"], r["path"], r["line"], r["col"], r["message"])
+            for r in self.findings
+        ]
+        return render_sarif(found, RULES_META, tool_name="tpumc")
+
+
+class Explorer:
+    """Enumerate one harness model's schedule space.
+
+    ``build`` returns a fresh :class:`Model` per call. ``seed`` is
+    recorded into every trace (and seeds nothing today — exploration is
+    deterministic DFS — but traces carry it so a future randomized
+    strategy replays through the same door).
+    """
+
+    def __init__(self, build: Callable[[], Model], name: Optional[str] = None,
+                 preemption_budget: int = 2, max_schedules: int = 2000,
+                 max_steps: int = 2000, deadline_s: Optional[float] = None,
+                 seed: int = 0, prune: str = "dpor"):
+        self._build = build
+        self.name = name or getattr(build, "__name__", "model")
+        self.preemption_budget = preemption_budget
+        self.max_schedules = max_schedules
+        self.max_steps = max_steps
+        self.deadline_s = deadline_s
+        self.seed = seed
+        if prune not in ("dpor", "naive"):
+            raise ValueError(f"unknown pruning mode {prune!r}")
+        self.prune = prune  # "naive" keeps independent branches (PERF A/B)
+
+    # -- single schedule ------------------------------------------------------ #
+
+    def _trace_dict(self, schedule: List[int]) -> dict:
+        return {
+            "harness": self.name,
+            "seed": self.seed,
+            "preemption_budget": self.preemption_budget,
+            "decisions": list(schedule),
+        }
+
+    def _finding(self, rule: str, path: str, line: int, message: str,
+                 schedule: List[int]) -> dict:
+        return {
+            "rule": rule,
+            "path": path,
+            "line": int(line),
+            "col": 0,
+            "message": message,
+            "fingerprint": f"{rule}::{path}::{message}",
+            "harness": self.name,
+            "trace": self._trace_dict(schedule),
+        }
+
+    def _stuck_findings(self, ctl: SchedulerController,
+                        schedule: List[int]) -> List[dict]:
+        stuck = [t for t in ctl.live() if t.pending is not None]
+        if not stuck:
+            return []
+        stuck.sort(key=lambda t: t.tid)
+        all_waiting = all(
+            t.pending.kind == "wait_wake" and t.pending.timeout is None
+            and not t.wakeable
+            for t in stuck
+        )
+        parts = [
+            f"thread '{t.name}' {t.pending.describe()} at "
+            f"{t.pending.path}:{t.pending.line}"
+            for t in stuck
+        ]
+        lead = stuck[0].pending
+        if all_waiting:
+            message = (
+                "lost wakeup: no reachable notify can release "
+                + "; ".join(parts)
+            )
+            rule = "TPU011"
+        else:
+            message = "schedule-space deadlock: " + "; ".join(parts)
+            rule = "TPU007"
+        return [self._finding(rule, lead.path, lead.line, message, schedule)]
+
+    def _execute(self, forced: List[int]) -> Optional[_RunOutcome]:
+        ctl = SchedulerController()
+        prev = sanitize.set_schedule_controller(ctl)
+        try:
+            model = self._build()
+            ctl.start(model.threads)
+            trace: List[_Record] = []
+            schedule: List[int] = []
+            findings: List[dict] = []
+            step = 0
+            while ctl.live():
+                enabled = sorted(ctl.enabled_tids())
+                if not enabled:
+                    if ctl.fire_timeout():
+                        continue
+                    findings = self._stuck_findings(ctl, schedule)
+                    break
+                if step < len(forced):
+                    choice = forced[step]
+                    if choice not in enabled:
+                        return None  # infeasible divergence
+                else:
+                    prev_tid = schedule[-1] if schedule else None
+                    choice = prev_tid if prev_tid in enabled else enabled[0]
+                by_tid = {t.tid: t for t in ctl.threads}
+                footprints = {
+                    tid: by_tid[tid].pending.footprint() for tid in enabled
+                }
+                preemptive = bool(
+                    schedule and choice != schedule[-1]
+                    and schedule[-1] in enabled
+                )
+                trace.append(_Record(choice, enabled, footprints, preemptive))
+                schedule.append(choice)
+                ctl.step(choice)
+                step += 1
+                if step > self.max_steps:
+                    raise McError(
+                        f"harness '{self.name}' exceeded {self.max_steps} "
+                        "schedule points in one run — unbounded loop in a "
+                        "model thread?"
+                    )
+            if not findings:
+                for ts in ctl.threads:
+                    if ts.exc is not None:
+                        op_site = f"thread '{ts.name}'"
+                        findings.append(self._finding(
+                            "TPUMC2", f"mc/{self.name}", 1,
+                            f"unhandled {type(ts.exc).__name__} in "
+                            f"{op_site}: {ts.exc}",
+                            schedule,
+                        ))
+            if not findings:
+                for label, writer, other in ctl.race_candidates():
+                    findings.append(self._finding(
+                        "TPU009", writer.path, writer.line,
+                        f"empty lockset on field '{label}': written at "
+                        f"{writer.path}:{writer.line} and accessed at "
+                        f"{other.path}:{other.line} by another thread "
+                        "with no common lock on any explored schedule "
+                        "point",
+                        schedule,
+                    ))
+                for desc, fn in model.invariants:
+                    try:
+                        ok = fn()
+                    except BaseException as e:  # noqa: BLE001 — finding
+                        findings.append(self._finding(
+                            "TPUMC1", f"mc/{self.name}", 1,
+                            f"invariant '{desc}' raised "
+                            f"{type(e).__name__}: {e}",
+                            schedule,
+                        ))
+                        continue
+                    if ok is False:
+                        findings.append(self._finding(
+                            "TPUMC1", f"mc/{self.name}", 1,
+                            f"invariant '{desc}' violated",
+                            schedule,
+                        ))
+            return _RunOutcome(schedule, trace, findings)
+        finally:
+            ctl.abort()
+            sanitize.set_schedule_controller(prev)
+
+    # -- exploration ---------------------------------------------------------- #
+
+    def _preemptions_with_branch(self, trace: List[_Record], i: int,
+                                 alt: int) -> int:
+        count = sum(1 for rec in trace[:i] if rec.preemptive)
+        if i > 0 and alt != trace[i - 1].chosen \
+                and trace[i - 1].chosen in trace[i].enabled:
+            count += 1
+        return count
+
+    def explore(self) -> ExploreResult:
+        result = ExploreResult(self.name, self.seed, self.preemption_budget)
+        t0 = time.monotonic()
+        frontier: List[List[int]] = [[]]
+        seen = {()}
+        truncated = False
+        while frontier:
+            if result.schedules >= self.max_schedules:
+                truncated = True
+                break
+            if self.deadline_s is not None \
+                    and time.monotonic() - t0 > self.deadline_s:
+                truncated = True
+                break
+            prefix = frontier.pop()
+            outcome = self._execute(prefix)
+            result.schedules += 1
+            if outcome is None:
+                result.infeasible += 1
+                continue
+            for record in outcome.findings:
+                result.add_finding(record)
+            trace = outcome.trace
+            for i in range(len(trace) - 1, len(prefix) - 1, -1):
+                rec = trace[i]
+                others = [a for a in rec.enabled if a != rec.chosen]
+                result.decision_points += len(others)
+                if self.prune == "dpor":
+                    # Backtrack set: threads whose later *executed* op
+                    # conflicts with the op executed here. Everything
+                    # else commutes past step i.
+                    alts = set()
+                    chosen_fp = rec.footprints[rec.chosen]
+                    for j in range(i + 1, len(trace)):
+                        later = trace[j]
+                        if later.chosen == rec.chosen \
+                                or later.chosen not in rec.enabled:
+                            continue
+                        if _dependent(later.footprints[later.chosen],
+                                      chosen_fp):
+                            alts.add(later.chosen)
+                    result.pruned_independent += len(others) - len(alts)
+                else:
+                    alts = others
+                for alt in sorted(alts):
+                    if self._preemptions_with_branch(
+                        trace, i, alt
+                    ) > self.preemption_budget:
+                        result.pruned_budget += 1
+                        continue
+                    branch = tuple(outcome.schedule[:i]) + (alt,)
+                    if branch not in seen:
+                        seen.add(branch)
+                        frontier.append(list(branch))
+        result.elapsed_s = time.monotonic() - t0
+        result.complete = not frontier and not truncated
+        return result
+
+    def replay(self, trace: dict) -> ExploreResult:
+        """Re-run one recorded schedule. The decision list pins every
+        choice, so the run — and any finding records it produces —
+        reproduce byte-identically."""
+        result = ExploreResult(self.name, trace.get("seed", self.seed),
+                               trace.get("preemption_budget",
+                                         self.preemption_budget))
+        t0 = time.monotonic()
+        outcome = self._execute(list(trace["decisions"]))
+        result.schedules = 1
+        if outcome is None:
+            result.infeasible = 1
+        else:
+            for record in outcome.findings:
+                result.add_finding(record)
+        result.elapsed_s = time.monotonic() - t0
+        result.complete = True
+        return result
+
+
+def findings_json(result: ExploreResult) -> str:
+    """Canonical JSON for byte-identical replay comparison."""
+    return json.dumps(result.findings, indent=2, sort_keys=True)

@@ -1,0 +1,602 @@
+"""Cooperative scheduler: the schedule-control half of tpumc.
+
+The sanitizer's ``named_lock``/``named_rlock``/``named_condition``
+factories are the repo's concurrency instrumentation points; while a
+:class:`SchedulerController` is installed (``sanitize.set_schedule_
+controller``), those factories hand back *schedule-controlled*
+primitives instead of ``threading`` ones. Every visible operation —
+lock acquire/release, cv wait/notify, an adopted ``note_field_access``
+site — becomes a schedule point: the executing thread publishes the
+operation it is about to perform and parks; the controller (driven by
+``_explore.Explorer``) decides which thread runs next. Exactly one test
+thread executes at any instant, so lock/condition state can be *virtual*
+(owned by the controller, no real ``threading`` primitives under test):
+enabledness, blocking, and wakeups are controller decisions, which is
+what makes every interleaving reachable and every run replayable from a
+decision list.
+
+Threads park on per-thread gate events; the real GIL never interleaves
+two test threads between schedule points. Code constructed or inspected
+*outside* a registered test thread (model construction before the run,
+invariant checks after it) uses the same primitives through a
+single-threaded immediate path.
+"""
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Tuple
+
+# tpulint: disable-file=TPU009 - controller state is serialized by
+# construction: exactly one thread runs between go/ready Event handoffs,
+# so no two accesses to the bookkeeping dicts ever overlap.
+
+_MC_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO_ROOT = os.path.dirname(os.path.dirname(_MC_DIR))
+_SAN_DIR = os.path.join(os.path.dirname(_MC_DIR), "sanitize")
+
+#: Wall-clock bound on one thread's progress between two schedule
+#: points. Tripping it means the code under test blocked on something
+#: the controller does not manage (a real lock, a blocking queue get) —
+#: a harness bug, surfaced as :class:`McError`, never silently hung.
+STUCK_LIMIT_S = 30.0
+
+
+class McError(RuntimeError):
+    """Harness/controller protocol violation (not a model-checking
+    finding): an uncontrolled thread touched a controlled primitive
+    mid-run, or a thread blocked outside the controller's knowledge."""
+
+
+class McAborted(BaseException):
+    """Raised inside test threads to unwind them at teardown.
+
+    Derives from ``BaseException`` so ``except Exception`` blocks in the
+    code under test cannot swallow the unwind.
+    """
+
+
+def _call_site() -> Tuple[str, int]:
+    """(repo-relative path, line) of the innermost frame outside the mc
+    and sanitize packages — the project-code site an operation report
+    should point at (mirrors ``sanitize._project_site``, but cheap: no
+    stack formatting, just a frame walk)."""
+    f = sys._getframe(1)
+    fallback = None
+    while f is not None:
+        fn = f.f_code.co_filename
+        # _harnesses.py is model code, not framework code: the demo
+        # harnesses' seeded bugs live there and findings should point
+        # at them.
+        if fn.endswith("_harnesses.py") or not (
+                fn.startswith(_MC_DIR) or fn.startswith(_SAN_DIR)):
+            if fallback is None:
+                fallback = f
+            if fn.startswith(_REPO_ROOT + os.sep):
+                path = os.path.relpath(fn, _REPO_ROOT).replace(os.sep, "/")
+                return path, f.f_lineno
+        f = f.f_back
+    if fallback is not None:
+        return fallback.f_code.co_filename, fallback.f_lineno
+    return "<unknown>", 1
+
+
+class Op:
+    """One pending visible operation, published at a schedule point."""
+
+    __slots__ = ("kind", "lock", "timeout", "n", "owner_id", "field",
+                 "write", "label", "path", "line")
+
+    def __init__(self, kind: str, lock=None, timeout=None, n: int = 0,
+                 owner_id: int = 0, field: str = "", write: bool = False,
+                 label: str = ""):
+        self.kind = kind
+        self.lock = lock
+        self.timeout = timeout
+        self.n = n
+        self.owner_id = owner_id
+        self.field = field
+        self.write = write
+        self.label = label
+        self.path, self.line = _call_site()
+
+    def footprint(self):
+        """Hashable resource token set for the DPOR-lite dependence
+        check. Lock-shaped ops key on the lock *instance*; field ops on
+        (owner, field, write). A thread's "start" op conflicts with
+        everything: where a thread begins relative to the others is
+        always a real scheduling choice."""
+        if self.kind == "start":
+            return (("*", 0),)
+        if self.lock is not None:
+            return (("L", id(self.lock)),)
+        if self.field:
+            return (("F", self.owner_id, self.field, self.write),)
+        return ()
+
+    def describe(self) -> str:
+        name = self.lock._name if self.lock is not None else None
+        if self.kind in ("acquire", "acquire_timed", "try_acquire"):
+            return f"acquiring lock '{name}'"
+        if self.kind == "release":
+            return f"releasing lock '{name}'"
+        if self.kind == "wait_sleep":
+            return f"entering wait on '{name}'"
+        if self.kind == "wait_wake":
+            how = "untimed" if self.timeout is None else "timed"
+            return f"in {how} cv wait on '{name}'"
+        if self.kind == "notify":
+            return f"notifying '{name}'"
+        if self.kind == "field":
+            return f"accessing field '{self.label}'"
+        return self.kind
+
+
+def _dependent(fp_a, fp_b) -> bool:
+    """Two operations conflict when they touch the same lock instance,
+    or the same (owner, field) with at least one write. Everything else
+    commutes — the sleep-set/DPOR-lite pruning ground."""
+    for a in fp_a:
+        for b in fp_b:
+            if a[0] == "*" or b[0] == "*":
+                return True
+            if a[0] == "L" and b[0] == "L" and a[1] == b[1]:
+                return True
+            if (a[0] == "F" and b[0] == "F" and a[1:3] == b[1:3]
+                    and (a[3] or b[3])):
+                return True
+    return False
+
+
+class McLock:
+    """Virtual schedule-controlled Lock/RLock (ownership lives on the
+    controller's thread states, never a real ``threading`` primitive)."""
+
+    _is_tpumc_controlled = True
+
+    def __init__(self, ctl: "SchedulerController", name: str,
+                 reentrant: bool):
+        self._ctl = ctl
+        self._name = name
+        self._reentrant = reentrant
+        self.owner: Optional[int] = None  # tid, or -1 for the immediate path
+        self.count = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if not blocking:
+            return self._ctl.sched_point(Op("try_acquire", lock=self))
+        if timeout is not None and timeout > 0:
+            return self._ctl.sched_point(
+                Op("acquire_timed", lock=self, timeout=timeout)
+            )
+        self._ctl.sched_point(Op("acquire", lock=self))
+        return True
+
+    def release(self):
+        self._ctl.sched_point(Op("release", lock=self))
+
+    def locked(self) -> bool:
+        return self.owner is not None
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"McLock({self._name!r})"
+
+
+class McCondition:
+    """Virtual schedule-controlled Condition over an :class:`McLock`.
+
+    ``wait`` is two schedule points: the always-enabled sleep step
+    (release the lock, join the waiter queue) and the wake step (enabled
+    once notified — or once the controller fires the timeout — and the
+    lock is free again). The gap between them contains no user code.
+    """
+
+    _is_tpumc_controlled = True
+    _reentrant = True
+
+    def __init__(self, ctl: "SchedulerController", name: str):
+        self._ctl = ctl
+        self._name = name
+        self._lock = McLock(ctl, name, reentrant=True)
+        self.waiters: List[int] = []  # tids, FIFO
+
+    @property
+    def owner(self):
+        return self._lock.owner
+
+    def acquire(self, *args):
+        return self._lock.acquire(*args)
+
+    def release(self):
+        self._lock.release()
+
+    def __enter__(self):
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._lock.release()
+        return False
+
+    def _require_owner(self, verb: str):
+        ts = self._ctl.current()
+        tid = ts.tid if ts is not None else -1
+        if self._lock.owner != tid:
+            raise RuntimeError(f"cannot {verb} on un-acquired lock")
+
+    def wait(self, timeout=None):
+        self._require_owner("wait")
+        self._ctl.sched_point(Op("wait_sleep", lock=self, timeout=timeout))
+        return self._ctl.sched_point(
+            Op("wait_wake", lock=self, timeout=timeout)
+        )
+
+    def wait_for(self, predicate, timeout=None):
+        result = predicate()
+        while not result:
+            got = self.wait(timeout)
+            result = predicate()
+            if not got:
+                break
+        return result
+
+    def notify(self, n: int = 1):
+        self._require_owner("notify")
+        self._ctl.sched_point(Op("notify", lock=self, n=n))
+
+    def notify_all(self):
+        self.notify(n=1 << 30)
+
+    def __repr__(self):
+        return f"McCondition({self._name!r})"
+
+
+class _TState:
+    """One controlled thread: gate events + virtual blocking state."""
+
+    __slots__ = ("tid", "name", "fn", "thread", "go", "ready", "pending",
+                 "status", "exc", "op_result", "wakeable", "timeout_fired",
+                 "saved_count", "held")
+
+    def __init__(self, tid: int, name: str, fn):
+        self.tid = tid
+        self.name = name
+        self.fn = fn
+        self.thread: Optional[threading.Thread] = None
+        self.go = threading.Event()
+        self.ready = threading.Event()
+        self.pending: Optional[Op] = None
+        self.status = "new"  # new | parked | done
+        self.exc: Optional[BaseException] = None
+        self.op_result = None
+        self.wakeable = False      # notified (or timeout fired) in a cv wait
+        self.timeout_fired = False
+        self.saved_count = 0       # lock recursion restored after the wait
+        self.held: List[McLock] = []  # acquisition order (diagnostics/races)
+
+
+class _FieldAccess:
+    __slots__ = ("tid", "write", "locks", "path", "line")
+
+    def __init__(self, tid, write, locks, path, line):
+        self.tid = tid
+        self.write = write
+        self.locks = locks  # frozenset of held lock names
+        self.path = path
+        self.line = line
+
+
+class SchedulerController:
+    """Virtual lock/cv state + the park/grant protocol for one run."""
+
+    def __init__(self):
+        self.threads: List[_TState] = []
+        self._by_ident: Dict[int, _TState] = {}
+        self._aborting = False
+        self._started = False
+        #: (owner_id, field) -> (label, [_FieldAccess]) — the Eraser-lite
+        #: table the race check intersects locksets over.
+        self.accesses: Dict[Tuple[int, str], Tuple[str, List[_FieldAccess]]] = {}
+
+    # -- factory surface consumed by sanitize ------------------------------- #
+
+    def make_lock(self, name: str, reentrant: bool) -> McLock:
+        return McLock(self, name, reentrant)
+
+    def make_condition(self, name: str) -> McCondition:
+        return McCondition(self, name)
+
+    def field_access(self, owner, field: str, write: bool = True,
+                     label: Optional[str] = None):
+        ts = self.current()
+        if ts is None:
+            return  # setup/check phase: single-threaded, not a race site
+        self.sched_point(Op(
+            "field", owner_id=id(owner), field=field, write=write,
+            label=label or f"{type(owner).__name__}.{field}",
+        ))
+
+    # -- thread protocol ----------------------------------------------------- #
+
+    def current(self) -> Optional[_TState]:
+        return self._by_ident.get(threading.get_ident())
+
+    def sched_point(self, op: Op):
+        ts = self.current()
+        if ts is None:
+            return self._immediate(op)
+        if self._aborting:
+            raise McAborted()
+        ts.pending = op
+        ts.ready.set()
+        ts.go.wait()
+        ts.go.clear()
+        if self._aborting:
+            raise McAborted()
+        return ts.op_result
+
+    def _immediate(self, op: Op):
+        """Single-threaded execution for unregistered threads (model
+        construction before the run, invariant checks after it)."""
+        if self._started and any(t.status != "done" for t in self.threads):
+            raise McError(
+                "an uncontrolled thread reached a controlled primitive "
+                "mid-run — harness models must prevent the code under "
+                "test from spawning its own threads"
+            )
+        lock = op.lock
+        if op.kind in ("acquire", "acquire_timed", "try_acquire"):
+            base = lock._lock if isinstance(lock, McCondition) else lock
+            if base.owner not in (None, -1):
+                raise McError(
+                    f"lock '{base._name}' still held by a finished test "
+                    "thread at invariant time (lock leak)"
+                )
+            if base.owner == -1 and not base._reentrant:
+                raise McError(
+                    f"non-reentrant lock '{base._name}' re-acquired on "
+                    "the immediate path"
+                )
+            base.owner = -1
+            base.count += 1
+            return True
+        if op.kind == "release":
+            base = lock._lock if isinstance(lock, McCondition) else lock
+            base.count -= 1
+            if base.count <= 0:
+                base.owner, base.count = None, 0
+            return None
+        if op.kind == "notify":
+            for tid in list(op.lock.waiters[:op.n]):
+                self._threads_by_tid()[tid].wakeable = True
+                op.lock.waiters.remove(tid)
+            return None
+        if op.kind in ("wait_sleep", "wait_wake"):
+            raise McError("cv wait outside a controlled test thread")
+        return None  # field/start: nothing to do single-threaded
+
+    def _main(self, ts: _TState):
+        self._by_ident[threading.get_ident()] = ts
+        try:
+            self.sched_point(Op("start"))
+            ts.fn()
+        except McAborted:
+            pass
+        except BaseException as e:  # noqa: BLE001 — becomes a finding
+            ts.exc = e
+        finally:
+            ts.status = "done"
+            ts.pending = None
+            ts.ready.set()
+
+    def start(self, thread_fns: List[Tuple[str, object]]):
+        """Spawn and park every test thread (each stops at its "start"
+        schedule point before ``fn`` runs). Spawn order assigns tids —
+        the stable identity decision lists are written in."""
+        for name, fn in thread_fns:
+            ts = _TState(len(self.threads), name, fn)
+            self.threads.append(ts)
+            ts.thread = threading.Thread(
+                target=self._main, args=(ts,), daemon=True,
+                name=f"tpumc-{name}",
+            )
+            ts.thread.start()
+            if not ts.ready.wait(timeout=STUCK_LIMIT_S):
+                raise McError(f"test thread '{name}' never parked")
+            ts.ready.clear()
+            ts.status = "parked"
+        self._started = True
+
+    def _threads_by_tid(self):
+        return {t.tid: t for t in self.threads}
+
+    # -- scheduling queries --------------------------------------------------- #
+
+    def live(self) -> List[_TState]:
+        return [t for t in self.threads if t.status != "done"]
+
+    def is_enabled(self, ts: _TState) -> bool:
+        op = ts.pending
+        if op is None or ts.status == "done":
+            return False
+        if op.kind in ("start", "release", "notify", "field", "wait_sleep",
+                       "try_acquire"):
+            return True
+        lock = op.lock._lock if isinstance(op.lock, McCondition) else op.lock
+        if op.kind == "acquire":
+            return lock.owner is None or (
+                lock.owner == ts.tid and lock._reentrant
+            )
+        if op.kind == "acquire_timed":
+            return lock.owner is None or lock.owner == ts.tid \
+                and lock._reentrant or ts.timeout_fired
+        if op.kind == "wait_wake":
+            return (ts.wakeable or ts.timeout_fired) and lock.owner is None
+        raise McError(f"unknown op kind {op.kind!r}")
+
+    def enabled_tids(self) -> List[int]:
+        return [t.tid for t in self.live() if self.is_enabled(t)]
+
+    def fire_timeout(self) -> bool:
+        """Model the earliest pending timeout firing: called only when no
+        thread is enabled, so timed waits behave as 'the timeout fires
+        once nothing else can make progress' — the fair schedule for
+        real-code harnesses whose every wait carries a timeout."""
+        eligible = []
+        for ts in self.live():
+            op = ts.pending
+            if op is None or ts.timeout_fired:
+                continue
+            if op.kind == "wait_wake" and not ts.wakeable \
+                    and op.timeout is not None:
+                eligible.append((op.timeout, ts.tid, ts))
+            elif op.kind == "acquire_timed":
+                lock = op.lock
+                if lock.owner is not None and lock.owner != ts.tid:
+                    eligible.append((op.timeout, ts.tid, ts))
+        if not eligible:
+            return False
+        eligible.sort(key=lambda e: (e[0], e[1]))
+        ts = eligible[0][2]
+        ts.timeout_fired = True
+        if ts.pending.kind == "wait_wake":
+            cv = ts.pending.lock
+            if ts.tid in cv.waiters:
+                cv.waiters.remove(ts.tid)
+        return True
+
+    # -- stepping ------------------------------------------------------------- #
+
+    def step(self, tid: int):
+        """Apply ``tid``'s pending op to the virtual state, let the
+        thread run to its next schedule point, and re-park it."""
+        ts = self._threads_by_tid()[tid]
+        if not self.is_enabled(ts):
+            raise McError(f"stepping disabled thread {ts.name!r}")
+        self._apply(ts)
+        ts.pending = None
+        ts.go.set()
+        if not ts.ready.wait(timeout=STUCK_LIMIT_S):
+            self.abort()
+            raise McError(
+                f"test thread '{ts.name}' blocked outside the controller "
+                "(uncontrolled primitive?) — model-checked code must only "
+                "block through sanitize.named_* primitives"
+            )
+        ts.ready.clear()
+
+    def _apply(self, ts: _TState):
+        op = ts.pending
+        kind = op.kind
+        if kind in ("start", "field"):
+            if kind == "field":
+                key = (op.owner_id, op.field)
+                label, entries = self.accesses.setdefault(
+                    key, (op.label, [])
+                )
+                entries.append(_FieldAccess(
+                    ts.tid, op.write,
+                    frozenset(l._name for l in ts.held),
+                    op.path, op.line,
+                ))
+            return
+        cv = op.lock if isinstance(op.lock, McCondition) else None
+        lock = cv._lock if cv is not None else op.lock
+        if kind == "acquire":
+            lock.owner = ts.tid
+            lock.count += 1
+            if lock.count == 1:
+                ts.held.append(lock)
+            ts.op_result = True
+        elif kind == "try_acquire":
+            if lock.owner is None:
+                lock.owner = ts.tid
+                lock.count = 1
+                ts.held.append(lock)
+                ts.op_result = True
+            else:
+                ts.op_result = False
+        elif kind == "acquire_timed":
+            if lock.owner is None or (lock.owner == ts.tid
+                                      and lock._reentrant):
+                lock.owner = ts.tid
+                lock.count += 1
+                if lock.count == 1:
+                    ts.held.append(lock)
+                ts.op_result = True
+            else:
+                ts.timeout_fired = False
+                ts.op_result = False
+        elif kind == "release":
+            if lock.owner != ts.tid:
+                raise McError(
+                    f"thread '{ts.name}' released lock '{lock._name}' it "
+                    "does not hold"
+                )
+            lock.count -= 1
+            if lock.count == 0:
+                lock.owner = None
+                ts.held.remove(lock)
+        elif kind == "wait_sleep":
+            ts.saved_count = lock.count
+            lock.owner, lock.count = None, 0
+            ts.held.remove(lock)
+            ts.wakeable = False
+            ts.timeout_fired = False
+            cv.waiters.append(ts.tid)
+        elif kind == "wait_wake":
+            lock.owner = ts.tid
+            lock.count = ts.saved_count
+            ts.held.append(lock)
+            ts.op_result = not ts.timeout_fired
+            ts.wakeable = False
+            ts.timeout_fired = False
+        elif kind == "notify":
+            by_tid = self._threads_by_tid()
+            for tid in list(cv.waiters[:op.n]):
+                by_tid[tid].wakeable = True
+                cv.waiters.remove(tid)
+        else:
+            raise McError(f"unknown op kind {kind!r}")
+
+    # -- teardown ------------------------------------------------------------- #
+
+    def abort(self):
+        self._aborting = True
+        for ts in self.threads:
+            ts.go.set()
+        for ts in self.threads:
+            if ts.thread is not None:
+                ts.thread.join(timeout=5.0)
+
+    # -- post-run analysis ---------------------------------------------------- #
+
+    def race_candidates(self):
+        """[(label, write_access, other_access)] for fields touched by
+        >= 2 threads with >= 1 write and an EMPTY intersected lockset —
+        the Eraser check over a fully explored schedule (pairs the
+        static TPU009 rule and tpusan's runtime lockset witness)."""
+        out = []
+        for (_oid, _field), (label, entries) in sorted(
+            self.accesses.items(), key=lambda kv: kv[1][0]
+        ):
+            tids = {e.tid for e in entries}
+            if len(tids) < 2 or not any(e.write for e in entries):
+                continue
+            lockset = None
+            for e in entries:
+                lockset = e.locks if lockset is None else lockset & e.locks
+            if lockset:
+                continue
+            writer = next(e for e in entries if e.write)
+            other = next(e for e in entries if e.tid != writer.tid)
+            out.append((label, writer, other))
+        return out

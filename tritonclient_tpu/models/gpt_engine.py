@@ -1293,7 +1293,7 @@ class GenerationEngine:
                     self._free_slot_blocks(slot, device_reset=False)
 
     # tpulint: hot-path
-    def _run_loop(self):  # tpulint: disable=TPU002,TPU009 - engine-loop thread is the sole mutator of slot state
+    def _run_loop(self):  # tpulint: disable=TPU002,TPU009,TPU011 - engine loop is the sole mutator of slot state AND the sole _cv waiter: it cannot sleep across its own updates
         # Software pipeline with DECOUPLED delivery: steps and admissions'
         # prefill chunks dispatch with DEVICE tokens; the delivery thread
         # drains readbacks FIFO behind them (at most max_inflight

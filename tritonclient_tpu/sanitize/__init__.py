@@ -76,6 +76,8 @@ __all__ = [
     "note_field_access",
     "report_finding",
     "reset",
+    "schedule_controller",
+    "set_schedule_controller",
     "write_report",
 ]
 
@@ -395,6 +397,37 @@ def _atexit_report():
 # named-lock factories (adoption points in server/_core, shm, gpt_engine)     #
 # --------------------------------------------------------------------------- #
 
+#: When set (by ``tritonclient_tpu.mc``), the factories below hand lock
+#: construction to the model checker's cooperative scheduler instead of
+#: ``threading`` — the sanitizer's instrumentation points double as
+#: tpumc's schedule-control points. Thread-confined by convention: only
+#: the checker's driver thread flips it, around a fully serialized run.
+_SCHED_CONTROLLER = None
+
+
+def set_schedule_controller(controller):
+    """Install (or with ``None`` remove) a tpumc schedule controller.
+
+    While installed, :func:`named_lock`/:func:`named_rlock`/
+    :func:`named_condition` return the controller's schedule-controlled
+    primitives and :func:`note_field_access` also feeds the controller,
+    so code constructed inside a model-checking run is steered through
+    every interleaving the explorer enumerates. Returns the previously
+    installed controller so callers can restore it.
+    """
+    global _SCHED_CONTROLLER
+    previous = _SCHED_CONTROLLER
+    # Install/remove happen only in the explorer's single-threaded
+    # phases (before model threads start, after they are parked or
+    # aborted), so the bare write never overlaps a reader.
+    _SCHED_CONTROLLER = controller  # tpulint: disable=TPU009
+    return previous
+
+
+def schedule_controller():
+    """The installed tpumc schedule controller, or ``None``."""
+    return _SCHED_CONTROLLER
+
 
 def named_lock(name: str):
     """A ``threading.Lock`` known to the lock-order witness by ``name``.
@@ -404,6 +437,8 @@ def named_lock(name: str):
     tpulint's TPU002/TPU007 recognize this factory as a lock constructor,
     so adoption does not shrink the static graph.
     """
+    if _SCHED_CONTROLLER is not None:
+        return _SCHED_CONTROLLER.make_lock(name, reentrant=False)
     lock = threading.Lock()
     if not _STATE.active:
         return lock
@@ -414,6 +449,8 @@ def named_lock(name: str):
 
 def named_rlock(name: str):
     """``threading.RLock`` variant of :func:`named_lock`."""
+    if _SCHED_CONTROLLER is not None:
+        return _SCHED_CONTROLLER.make_lock(name, reentrant=True)
     lock = threading.RLock()
     if not _STATE.active:
         return lock
@@ -424,6 +461,8 @@ def named_rlock(name: str):
 
 def named_condition(name: str):
     """``threading.Condition`` known to the lock-order witness by ``name``."""
+    if _SCHED_CONTROLLER is not None:
+        return _SCHED_CONTROLLER.make_condition(name)
     cond = threading.Condition()
     if not _STATE.active:
         return cond
@@ -440,6 +479,8 @@ def note_field_access(owner, field: str, write: bool = True,
     (one predicate check) while the sanitizer is inactive, so hot-path
     adoption sites cost nothing in production.
     """
+    if _SCHED_CONTROLLER is not None:
+        _SCHED_CONTROLLER.field_access(owner, field, write=write, label=label)
     if not _STATE.active:
         return
     from tritonclient_tpu.sanitize import _races

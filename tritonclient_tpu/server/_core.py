@@ -965,6 +965,11 @@ class _DynamicBatcher:
             if not still_queued and extensions < 4:
                 extensions += 1
                 continue
+            if slot.done:
+                # Completed in the window between the wait() timeout
+                # and this check: deliver the result, not a spurious
+                # 500 for work that finished.
+                break
             raise CoreError(
                 f"dynamic batch wait timed out for model "
                 f"'{model.name}'",
@@ -1121,8 +1126,11 @@ class _DynamicBatcher:
                 if batch is None and not shed:
                     # Gate open (hold window / overlap minimum): wait for
                     # arrivals, an age-out, or an in-flight dispatch to
-                    # finish (its completion notifies).
-                    self._cv.wait(timeout=0.005)
+                    # finish (its completion notifies). Bounded park, not
+                    # a predicate wait — the loop re-derives sweep/take
+                    # state from scratch every pass, so timeout-vs-wakeup
+                    # carries no information.
+                    self._cv.wait(timeout=0.005)  # tpulint: disable=TPU011
                     continue
                 if batch is not None:
                     self._dispatching += 1
