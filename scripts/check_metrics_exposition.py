@@ -58,6 +58,15 @@ Checks (exit 1 with one line per violation):
     the cohort label in canonical (lowercase slug) form;
     ``nv_engine_kv_bytes_touched_total`` carries exactly
     {model, phase} with ``phase`` from the stepscope vocabulary
+  * the memscope families (PR 18): ``nv_device_memory_bytes`` carries
+    exactly {model, pool, kind} with ``pool``/``kind`` drawn from the
+    canonical memscope vocabularies and non-negative values, with
+    live <= peak per (model, pool);
+    ``nv_device_memory_events_total`` carries exactly
+    {model, pool, event} with canonical events and EVERY event row
+    rendered per (model, pool) cell (zeros included);
+    ``nv_device_memory_headroom_bytes`` carries exactly {model} and is
+    non-negative
 """
 
 import os
@@ -107,6 +116,17 @@ except ImportError:  # standalone copy of the script: keep it usable
     SLO_WINDOWS = ("fast", "slow")
     COHORT_LABEL_RE = re.compile(r"^[a-z0-9][a-z0-9_-]*$")
 
+try:
+    from tritonclient_tpu.protocol._literals import (
+        MEM_EVENTS,
+        MEM_KINDS,
+        MEM_POOLS,
+    )
+except ImportError:  # standalone copy of the script: keep it usable
+    MEM_POOLS = ("kv", "params", "shm", "scratch")
+    MEM_KINDS = ("live", "peak", "reserved")
+    MEM_EVENTS = ("alloc", "free", "park", "evict")
+
 _SHED_FAMILY = "nv_inference_shed_total"
 # Fleet-router families (served by the router's own /metrics): same
 # stable-label-set discipline as the shed counter.
@@ -143,6 +163,11 @@ _BURN_FAMILY = "nv_fleet_slo_burn_rate"
 _BUDGET_FAMILY = "nv_fleet_slo_budget_remaining"
 _COHORT_FAMILY = "nv_fleet_cohort_requests_total"
 _KV_BYTES_FAMILY = "nv_engine_kv_bytes_touched_total"
+# Memscope families (PR 18): the device-memory ledger's byte gauges,
+# event counters, and the admission headroom gauge.
+_MEM_BYTES_FAMILY = "nv_device_memory_bytes"
+_MEM_EVENTS_FAMILY = "nv_device_memory_events_total"
+_MEM_HEADROOM_FAMILY = "nv_device_memory_headroom_bytes"
 
 _VALID_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
 _METRIC_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
@@ -450,6 +475,43 @@ def check_exposition(text: str) -> List[str]:
                             f"{labels['phase']!r} not in "
                             f"{list(STEP_PHASES)}"
                         )
+            if family == _MEM_EVENTS_FAMILY:
+                # Memscope event contract: fixed {model, pool, event}
+                # label set, canonical pools/events only, and EVERY
+                # canonical event row present per (model, pool) cell so
+                # churn rates never need absent-as-zero guessing.
+                cell_events: Dict[tuple, set] = {}
+                for labels, value, name, lineno in samples.get(family, []):
+                    if set(labels) != {"model", "pool", "event"}:
+                        errors.append(
+                            f"line {lineno}: {family} label set "
+                            f"{sorted(labels)} != "
+                            "['event', 'model', 'pool']"
+                        )
+                        continue
+                    if labels["pool"] not in MEM_POOLS:
+                        errors.append(
+                            f"line {lineno}: {family} pool "
+                            f"{labels['pool']!r} not in {list(MEM_POOLS)}"
+                        )
+                        continue
+                    if labels["event"] not in MEM_EVENTS:
+                        errors.append(
+                            f"line {lineno}: {family} event "
+                            f"{labels['event']!r} not in "
+                            f"{list(MEM_EVENTS)}"
+                        )
+                        continue
+                    cell_events.setdefault(
+                        (labels["model"], labels["pool"]), set()
+                    ).add(labels["event"])
+                for (model, pool), events in cell_events.items():
+                    missing = [e for e in MEM_EVENTS if e not in events]
+                    if missing:
+                        errors.append(
+                            f'{family}{{model="{model}",pool="{pool}"}}: '
+                            f"missing event rows {missing}"
+                        )
             if family == _COLLECTIVES_FAMILY:
                 # Stepscope collectives: fixed {model, op} label set (the
                 # op value is open vocabulary — psum/ppermute/all_to_all
@@ -573,6 +635,48 @@ def check_exposition(text: str) -> List[str]:
                         errors.append(
                             f"line {lineno}: {family} value {value} "
                             "outside [0, 1]"
+                        )
+            if family == _MEM_BYTES_FAMILY:
+                # Memscope byte gauge: fixed {model, pool, kind} label
+                # set, canonical pools/kinds, non-negative (live <= peak
+                # is the cross-family check at the bottom).
+                for labels, value, name, lineno in samples.get(family, []):
+                    if set(labels) != {"model", "pool", "kind"}:
+                        errors.append(
+                            f"line {lineno}: {family} label set "
+                            f"{sorted(labels)} != "
+                            "['kind', 'model', 'pool']"
+                        )
+                        continue
+                    if labels["pool"] not in MEM_POOLS:
+                        errors.append(
+                            f"line {lineno}: {family} pool "
+                            f"{labels['pool']!r} not in {list(MEM_POOLS)}"
+                        )
+                    if labels["kind"] not in MEM_KINDS:
+                        errors.append(
+                            f"line {lineno}: {family} kind "
+                            f"{labels['kind']!r} not in {list(MEM_KINDS)}"
+                        )
+                    if value < 0:
+                        errors.append(
+                            f"line {lineno}: {family} value {value} < 0 "
+                            "(resident bytes cannot be negative)"
+                        )
+            if family == _MEM_HEADROOM_FAMILY:
+                # Headroom gauge: exactly {model}, non-negative (the
+                # ledger clamps at zero; a negative value means the
+                # capacity bookkeeping broke).
+                for labels, value, name, lineno in samples.get(family, []):
+                    if set(labels) != {"model"}:
+                        errors.append(
+                            f"line {lineno}: {family} label set "
+                            f"{sorted(labels)} != ['model']"
+                        )
+                    if value < 0:
+                        errors.append(
+                            f"line {lineno}: {family} value {value} < 0 "
+                            "(headroom cannot be negative)"
                         )
             if family in (_KV_USED_FAMILY, _KV_TOTAL_FAMILY):
                 # Pool-occupancy gauges: exactly {model}, non-negative.
@@ -756,6 +860,25 @@ def check_exposition(text: str) -> List[str]:
                 f"line {lineno}: {_KV_USED_FAMILY}{{model=\"{model}\"}} "
                 f"{value} > {_KV_TOTAL_FAMILY} {totals[model]}"
             )
+    # Cross-kind memscope invariant: live can never exceed peak for a
+    # (model, pool) cell — peak is by definition the high-water of live,
+    # so a violation means the ledger's peak tracking broke.
+    mem_kind: Dict[tuple, Dict[str, Tuple[float, int]]] = {}
+    for labels, value, _name, lineno in samples.get(_MEM_BYTES_FAMILY, []):
+        if {"model", "pool", "kind"} <= set(labels):
+            mem_kind.setdefault(
+                (labels["model"], labels["pool"]), {}
+            )[labels["kind"]] = (value, lineno)
+    for (model, pool), kinds in mem_kind.items():
+        if "live" in kinds and "peak" in kinds:
+            live, lineno = kinds["live"]
+            peak, _ = kinds["peak"]
+            if live > peak:
+                errors.append(
+                    f"line {lineno}: {_MEM_BYTES_FAMILY}"
+                    f'{{model="{model}",pool="{pool}"}} live {live} > '
+                    f"peak {peak}"
+                )
     return errors
 
 

@@ -204,10 +204,29 @@ def analyze(doc: dict, flight: Optional[dict] = None) -> dict:
         for v in cohorts.get("verdicts") or []
     ]
 
+    # Device-memory headroom merge: per-replica rows plus the fleet
+    # minimum per model (the placement-relevant number).
+    memory = (doc.get("memory") or {}).get("headroom") or {}
+    headroom = {
+        "replicas": [
+            {
+                "replica": row.get("replica", "?"),
+                "model": row.get("model", "?"),
+                "headroom_bytes": int(row.get("headroom_bytes", 0)),
+            }
+            for row in memory.get("replicas") or []
+        ],
+        "fleet_min": {
+            model: int(value)
+            for model, value in (memory.get("fleet_min") or {}).items()
+        },
+    }
+
     result = {
         "config": doc.get("config") or {},
         "replicas": replicas,
         "sketches": sketches,
+        "headroom": headroom,
         "objectives": slo.get("objectives") or [],
         "burn": burn,
         "assignments": cohorts.get("assignments") or {},
@@ -265,6 +284,20 @@ def render(result: dict) -> str:
                 f"{row['count']:>7} {row['p50_us']:>9} {row['p99_us']:>9} "
                 f"{row['p999_us']:>9}"
             )
+    headroom = result.get("headroom") or {}
+    if headroom.get("replicas"):
+        lines.append("")
+        lines.append(
+            f"{'model':<20} {'replica':<16} {'headroom_bytes':>15}"
+        )
+        for row in sorted(headroom["replicas"],
+                          key=lambda r: (r["model"], r["replica"])):
+            lines.append(
+                f"{row['model']:<20} {row['replica']:<16} "
+                f"{row['headroom_bytes']:>15}"
+            )
+        for model, value in sorted(headroom["fleet_min"].items()):
+            lines.append(f"{model:<20} {'fleet-min':<16} {value:>15}")
     lines.append("")
     if result["burn"]:
         lines.append(
@@ -328,7 +361,8 @@ def render(result: dict) -> str:
 # --------------------------------------------------------------------------- #
 
 
-def _exposition(requests: int, queue_depth: float) -> str:
+def _exposition(requests: int, queue_depth: float,
+                headroom: int = 0) -> str:
     """Minimal replica exposition the scrape plane retains."""
     return (
         "# TYPE nv_inference_request_success counter\n"
@@ -336,6 +370,8 @@ def _exposition(requests: int, queue_depth: float) -> str:
         f"{requests}\n"
         "# TYPE nv_engine_queue_depth gauge\n"
         f'nv_engine_queue_depth{{model="m"}} {queue_depth}\n'
+        "# TYPE nv_device_memory_headroom_bytes gauge\n"
+        f'nv_device_memory_headroom_bytes{{model="m"}} {headroom}\n'
     )
 
 
@@ -366,10 +402,12 @@ def self_check() -> int:
         "models": {"m": {"request": sketch.to_dict()}},
     }
     for tick in range(6):
-        for replica, slope in (("r0", 10), ("r1", 10), ("r2", 30)):
+        for replica, slope, headroom in (("r0", 10, 800), ("r1", 10, 500),
+                                         ("r2", 30, 300)):
             scope.observe_scrape(
                 replica, ok=True,
-                metrics_text=_exposition(tick * slope, 2.0),
+                metrics_text=_exposition(tick * slope, 2.0,
+                                         headroom=headroom),
                 sketches_doc=sketches_doc,
             )
         clock[0] += 1.0
@@ -420,8 +458,25 @@ def self_check() -> int:
         print(f"self-check: canary verdict {canary} != regressed",
               file=sys.stderr)
         failures += 1
+    # Headroom merge: per-replica rows survive, fleet minimum is the
+    # tightest replica's gauge (r2 at 300).
+    headroom_rows = {
+        (r["model"], r["replica"]): r["headroom_bytes"]
+        for r in result["headroom"]["replicas"]
+    }
+    expected = {("m", "r0"): 800, ("m", "r1"): 500, ("m", "r2"): 300}
+    if headroom_rows != expected:
+        print(f"self-check [headroom]: rows {headroom_rows} != "
+              f"{expected}", file=sys.stderr)
+        failures += 1
+    if result["headroom"]["fleet_min"] != {"m": 300}:
+        print(f"self-check [headroom]: fleet_min "
+              f"{result['headroom']['fleet_min']} != {{'m': 300}}",
+              file=sys.stderr)
+        failures += 1
     text = render(result)
-    for needle in ("canary", "regressed", "r2", "fast_burn"):
+    for needle in ("canary", "regressed", "r2", "fast_burn",
+                   "headroom_bytes", "fleet-min"):
         if needle not in text:
             print(f"self-check: render missing {needle!r}",
                   file=sys.stderr)

@@ -78,7 +78,7 @@ def main():
 
     import jax
 
-    from tritonclient_tpu import _stepscope
+    from tritonclient_tpu import _memscope, _stepscope
     from tritonclient_tpu.genai_perf import GenAIPerf
     from tritonclient_tpu.models.gpt import GptModel
     from tritonclient_tpu.models.gpt_engine import GptEngineModel
@@ -183,6 +183,11 @@ def main():
                 "ttft_ms": summary["time_to_first_token"],
                 "itl_ms": summary["inter_token_latency"],
             }
+            if _memscope.enabled():
+                # Peak KV/device bytes at this concurrency so memory
+                # growth across the sweep is visible next to throughput.
+                result["engine"][f"c{c}"].update(
+                    _memscope.peaks("gpt_engine"))
             print(f"gpt_engine c{c}: {summary['requests']} req, "
                   f"{summary['output_token_throughput_per_sec']} tok/s, "
                   f"ttft p99 "

@@ -1,0 +1,570 @@
+#!/usr/bin/env python
+"""Where did the bytes go: device-memory report from a memscope dump.
+
+``tail_report.py`` answers where the *time* went; this report answers
+the capacity questions the admission-control work needs evidence for:
+who held the pool at its peak, how much headroom is grantable right
+now (and how much of it needs an eviction first), whether prefix
+sharing is earning its complexity, and whether any request leaked
+ledger bytes. It consumes
+
+* a memscope dump (``GET v2/debug/memscope`` on the HTTP front-end, or
+  the ``Memscope`` raw-JSON RPC on gRPC) saved to a file, or fetched
+  live with ``--live HOST:PORT``;
+* optionally a flight-recorder dump (``--flight``) — retained records
+  carry ``mem.*`` pool snapshots and shed records carry
+  ``kv_pages_held``, so the slowest/shed requests get memory columns;
+* optionally a fleetscope dump (``--fleet``) — per-replica headroom
+  rows and the fleet minimum.
+
+and reports:
+
+* **pool table** — live/peak/reserved/parked/capacity per (model,
+  pool), with the headroom gauge where capacity is declared;
+* **occupancy timeline** — live bytes replayed from the monotonic
+  event ring, bucketed into a fixed-width bar per pool;
+* **peak attribution** — the request (owner) holding the most bytes at
+  the moment each pool peaked, reconciled against its recorded
+  reservation (``pages x unit_bytes``, where pages came from the
+  engine's ``ceil((prompt+max_new)/block_size)`` formula);
+* **verdicts** — fragmentation (how much of the headroom needs an
+  eviction before it is grantable), reservation waste (capacity the
+  run never touched), prefix-sharing win (reserved bytes above live);
+* **leak table** — owners that finished with nonzero ledger bytes
+  (the TPU012 reconciliation failures).
+
+Usage::
+
+    python scripts/mem_report.py DUMP_FILE [--flight FILE]
+        [--fleet FILE] [--json]
+    python scripts/mem_report.py --live HOST:PORT [--protocol http|grpc]
+    python scripts/mem_report.py --self-check
+
+``--self-check`` drives the real in-process ledger through a scripted
+scenario (two clean owners, one seeded leak, one parked page) and
+exits non-zero unless the report recovers the peak owner, the leak,
+and the headroom split — deterministic, no sockets, no RNG.
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from tritonclient_tpu.protocol._literals import (  # noqa: E402
+    EP_DEBUG_MEMSCOPE,
+)
+
+_BAR_WIDTH = 40
+_BAR_CHARS = " .:-=+*#%@"
+
+
+# --------------------------------------------------------------------------- #
+# loading                                                                     #
+# --------------------------------------------------------------------------- #
+
+
+def load_dump(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or doc.get("kind") != "memscope":
+        raise ValueError(
+            f"{path}: not a memscope dump "
+            f"(kind={doc.get('kind') if isinstance(doc, dict) else '?'})"
+        )
+    return doc
+
+
+def fetch_live(address: str, protocol: str = "http") -> dict:
+    """Fetch the live ledger from a running server, via either
+    front-end (GET v2/debug/memscope or the Memscope raw-JSON RPC)."""
+    if protocol == "grpc":
+        import grpc
+
+        from tritonclient_tpu.protocol._service import (
+            GRPCInferenceServiceStub,
+            RawJsonMessage,
+        )
+
+        channel = grpc.insecure_channel(address)
+        try:
+            stub = GRPCInferenceServiceStub(channel)
+            resp = stub.Memscope(RawJsonMessage(b"{}"))
+            doc = json.loads(resp.payload.decode() or "{}")
+        finally:
+            channel.close()
+    else:
+        from tritonclient_tpu.fleet._replica import http_call
+
+        status, body = http_call(address, "GET", EP_DEBUG_MEMSCOPE)
+        if status != 200:
+            raise ValueError(f"{address}: HTTP {status} fetching memscope")
+        doc = json.loads(body)
+    if not isinstance(doc, dict) or doc.get("kind") != "memscope":
+        raise ValueError(f"{address}: response is not a memscope dump")
+    return doc
+
+
+def load_flight(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "records" not in doc:
+        raise ValueError(f"{path}: not a flight-recorder dump")
+    return doc
+
+
+def load_fleet(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or doc.get("kind") != "fleetscope":
+        raise ValueError(f"{path}: not a fleetscope dump")
+    return doc
+
+
+# --------------------------------------------------------------------------- #
+# analysis                                                                    #
+# --------------------------------------------------------------------------- #
+
+
+def _timeline(events: List[dict], scope: str, pool: str,
+              peak: int, width: int = _BAR_WIDTH) -> str:
+    """Live bytes replayed from the event ring, bucketed to a
+    fixed-width bar: each column is the max live seen in its seq
+    range, scaled against the pool's peak."""
+    series = [e for e in events
+              if e.get("scope") == scope and e.get("pool") == pool]
+    if not series or peak <= 0:
+        return ""
+    buckets = [0] * width
+    n = len(series)
+    for i, e in enumerate(series):
+        col = min(width - 1, i * width // n)
+        buckets[col] = max(buckets[col], int(e.get("live", 0)))
+    top = len(_BAR_CHARS) - 1
+    return "".join(
+        _BAR_CHARS[min(top, (b * top + peak - 1) // peak if b else 0)]
+        for b in buckets
+    )
+
+
+def _peak_attribution(cell: dict) -> Optional[dict]:
+    """The owner holding the most bytes when the pool peaked, with the
+    reservation-formula reconciliation: the engine records the pages it
+    reserved (ceil((prompt+max_new)/block_size)); those pages times the
+    pool's grant unit must explain the owner's bytes."""
+    po = cell.get("peak_owner")
+    if not po:
+        return None
+    unit = int(cell.get("unit_bytes") or 0)
+    meta = po.get("meta") or {}
+    out = {
+        "owner": po.get("owner", "?"),
+        "bytes": int(po.get("bytes", 0)),
+        "pages": (int(po.get("bytes", 0)) // unit) if unit else None,
+        "prompt_len": meta.get("prompt_len"),
+        "max_new": meta.get("max_new"),
+        "reserved_pages": meta.get("pages"),
+        "reconciles": None,
+    }
+    if unit and meta.get("pages") is not None:
+        # The owner's bytes may be a prefix-shared subset of the full
+        # reservation, but never more than pages x unit.
+        expected = int(meta["pages"]) * unit
+        out["reconciles"] = (
+            0 < out["bytes"] <= expected and out["bytes"] % unit == 0
+        )
+    return out
+
+
+def _verdicts(cell: dict) -> List[str]:
+    """Plain-language capacity verdicts for one pool cell."""
+    out = []
+    live = int(cell.get("live_bytes", 0))
+    peak = int(cell.get("peak_bytes", 0))
+    reserved = int(cell.get("reserved_bytes", 0))
+    parked = int(cell.get("parked_bytes", 0))
+    capacity = int(cell.get("capacity_bytes", 0) or 0)
+    if capacity:
+        free = max(0, capacity - live)
+        grantable = free + parked
+        if grantable and parked:
+            pct = 100.0 * parked / grantable
+            out.append(
+                f"fragmentation: {pct:.0f}% of the {grantable} grantable "
+                f"bytes are parked cache pages (need eviction first)"
+            )
+        never_used = capacity - peak
+        if never_used > 0:
+            out.append(
+                f"reservation waste: {never_used} of {capacity} capacity "
+                f"bytes were never resident at peak "
+                f"({100.0 * never_used / capacity:.0f}% idle)"
+            )
+        elif peak >= capacity:
+            out.append("pool saturated: peak reached capacity")
+    if reserved > live:
+        out.append(
+            f"prefix sharing win: {reserved - live} reserved bytes above "
+            f"live (shared pages counted once per holder)"
+        )
+    return out
+
+
+def analyze(doc: dict, flight: Optional[dict] = None,
+            fleet: Optional[dict] = None) -> dict:
+    events = doc.get("events") or []
+    pools = []
+    leaks = []
+    for cell in doc.get("pools") or []:
+        scope = cell.get("scope", "?")
+        pool = cell.get("pool", "?")
+        peak = int(cell.get("peak_bytes", 0))
+        pools.append({
+            "scope": scope,
+            "pool": pool,
+            "live_bytes": int(cell.get("live_bytes", 0)),
+            "peak_bytes": peak,
+            "reserved_bytes": int(cell.get("reserved_bytes", 0)),
+            "parked_bytes": int(cell.get("parked_bytes", 0)),
+            "capacity_bytes": int(cell.get("capacity_bytes", 0) or 0),
+            "headroom_bytes": cell.get("headroom_bytes"),
+            "events": dict(cell.get("events") or {}),
+            "live_owners": len(cell.get("owners") or {}),
+            "timeline": _timeline(events, scope, pool, peak),
+            "peak_attribution": _peak_attribution(cell),
+            "verdicts": _verdicts(cell),
+        })
+        for leak in cell.get("leaks") or []:
+            leaks.append({
+                "scope": scope,
+                "pool": pool,
+                "owner": leak.get("owner", "?"),
+                "bytes": int(leak.get("bytes", 0)),
+                "meta": leak.get("meta") or {},
+            })
+    result = {
+        "enabled": bool(doc.get("enabled", True)),
+        "pools": pools,
+        "leaks": leaks,
+        "ring_events": len(events),
+    }
+    if flight is not None:
+        rows = []
+        for rec in flight.get("records") or []:
+            attrs = rec.get("attributes") or {}
+            mem = {k: v for k, v in attrs.items() if k.startswith("mem.")}
+            pages = attrs.get("kv_pages_held")
+            if not mem and pages is None:
+                continue
+            rows.append({
+                "model": rec.get("model_name", ""),
+                "request_id": rec.get("request_id", ""),
+                "status": rec.get("status", "ok"),
+                "duration_us": int(rec.get("duration_ns", 0)) // 1000,
+                "shed_reason": attrs.get("shed.reason"),
+                "kv_pages_held": pages,
+                "mem": mem,
+            })
+        rows.sort(key=lambda r: r["duration_us"], reverse=True)
+        result["flight"] = rows
+    if fleet is not None:
+        result["fleet_headroom"] = (
+            (fleet.get("memory") or {}).get("headroom") or {}
+        )
+    return result
+
+
+# --------------------------------------------------------------------------- #
+# rendering                                                                   #
+# --------------------------------------------------------------------------- #
+
+
+def render(result: dict) -> str:
+    lines = []
+    if not result.get("enabled", True):
+        lines.append("memscope was DISABLED when this dump was taken "
+                     "(TPU_MEMSCOPE=0) — values below are stale or empty")
+    lines.append(
+        f"{'model':<18} {'pool':<8} {'live':>12} {'peak':>12} "
+        f"{'reserved':>12} {'parked':>10} {'headroom':>12}"
+    )
+    for row in result["pools"]:
+        headroom = row["headroom_bytes"]
+        lines.append(
+            f"{row['scope']:<18} {row['pool']:<8} {row['live_bytes']:>12} "
+            f"{row['peak_bytes']:>12} {row['reserved_bytes']:>12} "
+            f"{row['parked_bytes']:>10} "
+            f"{headroom if headroom is not None else '-':>12}"
+        )
+    for row in result["pools"]:
+        if row["timeline"]:
+            lines.append("")
+            lines.append(
+                f"{row['scope']}/{row['pool']} occupancy "
+                f"(peak {row['peak_bytes']} bytes):"
+            )
+            lines.append(f"  |{row['timeline']}|")
+        pa = row["peak_attribution"]
+        if pa is not None:
+            formula = ""
+            if pa["prompt_len"] is not None and pa["max_new"] is not None:
+                formula = (
+                    f" ceil(({pa['prompt_len']}+{pa['max_new']})/bs) -> "
+                    f"{pa['reserved_pages']} pages"
+                )
+            check = {True: "reconciles", False: "MISMATCH",
+                     None: "unchecked"}[pa["reconciles"]]
+            lines.append(
+                f"  at peak: {pa['owner']} held {pa['bytes']} bytes"
+                + (f" ({pa['pages']} pages)" if pa["pages"] is not None
+                   else "")
+                + f";{formula} [{check}]"
+            )
+        for verdict in row["verdicts"]:
+            lines.append(f"  verdict: {verdict}")
+    lines.append("")
+    if result["leaks"]:
+        lines.append(
+            f"{'LEAKED owner':<28} {'model':<18} {'pool':<8} {'bytes':>12}"
+        )
+        for leak in result["leaks"]:
+            lines.append(
+                f"{leak['owner']:<28} {leak['scope']:<18} "
+                f"{leak['pool']:<8} {leak['bytes']:>12}"
+            )
+    else:
+        lines.append("no ledger leaks: every finished owner reconciled "
+                     "to zero")
+    flight = result.get("flight")
+    if flight is not None:
+        lines.append("")
+        lines.append(
+            f"{'flight record':<28} {'status':<10} {'dur_us':>9} "
+            f"{'kv_pages':>8} {'kv_live':>12} {'kv_peak':>12}"
+        )
+        for row in flight[:20]:
+            name = row["request_id"] or row["model"] or "?"
+            if row["shed_reason"]:
+                name += f" [{row['shed_reason']}]"
+            mem = row["mem"]
+            lines.append(
+                f"{name[:28]:<28} {row['status']:<10} "
+                f"{row['duration_us']:>9} "
+                f"{row['kv_pages_held'] if row['kv_pages_held'] is not None else '-':>8} "
+                f"{mem.get('mem.kv_live_bytes', '-'):>12} "
+                f"{mem.get('mem.kv_peak_bytes', '-'):>12}"
+            )
+    fleet = result.get("fleet_headroom")
+    if fleet:
+        lines.append("")
+        lines.append(f"{'fleet headroom':<20} {'replica':<16} {'bytes':>15}")
+        for row in fleet.get("replicas") or []:
+            lines.append(
+                f"{row.get('model', '?'):<20} {row.get('replica', '?'):<16} "
+                f"{int(row.get('headroom_bytes', 0)):>15}"
+            )
+        for model, value in sorted((fleet.get("fleet_min") or {}).items()):
+            lines.append(f"{model:<20} {'fleet-min':<16} {int(value):>15}")
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------- #
+# self-check                                                                  #
+# --------------------------------------------------------------------------- #
+
+
+def self_check() -> int:
+    from tritonclient_tpu import _memscope
+
+    failures = 0
+    _memscope.configure(on=True)
+    _memscope.reset()
+    unit = 100
+    _memscope.set_capacity("m", _memscope.MEM_POOL_KV, 10 * unit,
+                           unit=unit)
+    _memscope.set_static("m", _memscope.MEM_POOL_SCRATCH, "slot_state", 64)
+
+    # Owner A: 2 pages, clean lifecycle, one page parked on release.
+    _memscope.owner_begin("m", _memscope.MEM_POOL_KV, "m.r1",
+                          prompt_len=170, max_new=30, pages=2)
+    _memscope.push_owner("m.r1")
+    _memscope.kv_page_alloc("m", unit)
+    _memscope.kv_page_alloc("m", unit)
+    _memscope.pop_owner()
+
+    # Owner B: 4 pages — the peak holder.
+    _memscope.owner_begin("m", _memscope.MEM_POOL_KV, "m.r2",
+                          prompt_len=350, max_new=50, pages=4)
+    _memscope.push_owner("m.r2")
+    for _ in range(4):
+        _memscope.kv_page_alloc("m", unit)
+    _memscope.pop_owner()
+
+    # A finishes: one page parks (prefix cache), one frees. Clean.
+    _memscope.push_owner("m.r1")
+    _memscope.kv_page_park("m", unit)
+    _memscope.kv_page_free("m", unit)
+    _memscope.pop_owner()
+    residue = _memscope.owner_finish("m", _memscope.MEM_POOL_KV, "m.r1")
+    if residue:
+        print(f"self-check: clean owner m.r1 left residue {residue}",
+              file=sys.stderr)
+        failures += 1
+
+    # B finishes but one page's free is masked (the seeded leak: pool
+    # freed the page, the ledger never discharged the owner).
+    _memscope.push_owner("m.r2")
+    for _ in range(3):
+        _memscope.kv_page_free("m", unit)
+    _memscope.pop_owner()
+    _memscope.push_owner("")
+    _memscope.kv_page_free("m", unit)  # masked: owner stays charged
+    _memscope.pop_owner()
+    residue = _memscope.owner_finish("m", _memscope.MEM_POOL_KV, "m.r2")
+    if residue != unit:
+        print(f"self-check: seeded leak residue {residue} != {unit}",
+              file=sys.stderr)
+        failures += 1
+
+    result = analyze(_memscope.dump())
+    _memscope.reset()
+
+    by_pool = {(p["scope"], p["pool"]): p for p in result["pools"]}
+    kv = by_pool.get(("m", _memscope.MEM_POOL_KV))
+    if kv is None:
+        print("self-check: kv pool row missing", file=sys.stderr)
+        return 1
+    # Peak was 6 pages resident; everything freed but one parked page.
+    if kv["peak_bytes"] != 6 * unit or kv["live_bytes"] != unit:
+        print(f"self-check: kv peak/live {kv['peak_bytes']}/"
+              f"{kv['live_bytes']} != {6 * unit}/{unit}", file=sys.stderr)
+        failures += 1
+    if kv["parked_bytes"] != unit:
+        print(f"self-check: parked {kv['parked_bytes']} != {unit}",
+              file=sys.stderr)
+        failures += 1
+    # Headroom: capacity - live + parked = 1000 - 100 + 100.
+    if kv["headroom_bytes"] != 10 * unit:
+        print(f"self-check: headroom {kv['headroom_bytes']} != "
+              f"{10 * unit}", file=sys.stderr)
+        failures += 1
+    pa = kv["peak_attribution"]
+    if pa is None or pa["owner"] != "m.r2" or pa["bytes"] != 4 * unit:
+        print(f"self-check: peak attribution {pa} (expected m.r2 with "
+              f"{4 * unit} bytes)", file=sys.stderr)
+        failures += 1
+    elif pa["reconciles"] is not True or pa["reserved_pages"] != 4:
+        print(f"self-check: peak reconciliation {pa}", file=sys.stderr)
+        failures += 1
+    leaks = {(x["scope"], x["pool"], x["owner"]): x["bytes"]
+             for x in result["leaks"]}
+    if leaks != {("m", _memscope.MEM_POOL_KV, "m.r2"): unit}:
+        print(f"self-check: leak table {leaks} (expected m.r2 with "
+              f"{unit} bytes)", file=sys.stderr)
+        failures += 1
+    if kv["timeline"] == "":
+        print("self-check: empty occupancy timeline", file=sys.stderr)
+        failures += 1
+    if not any("fragmentation" in v for v in kv["verdicts"]):
+        print(f"self-check: no fragmentation verdict in {kv['verdicts']}",
+              file=sys.stderr)
+        failures += 1
+    scratch = by_pool.get(("m", _memscope.MEM_POOL_SCRATCH))
+    if scratch is None or scratch["live_bytes"] != 64:
+        print(f"self-check: scratch row {scratch}", file=sys.stderr)
+        failures += 1
+
+    text = render(result)
+    for needle in ("m.r2", "LEAKED owner", "fragmentation",
+                   "occupancy", "reconciles"):
+        if needle not in text:
+            print(f"self-check: render missing {needle!r}",
+                  file=sys.stderr)
+            failures += 1
+
+    # Flight integration: shed rows surface their memory column.
+    flight = {
+        "kind": "flight_recorder",
+        "records": [
+            {"model_name": "m", "request_id": "q7", "status": "error",
+             "duration_ns": 5_000_000,
+             "attributes": {"shed.reason": "cancelled",
+                            "kv_pages_held": 3,
+                            "mem.kv_live_bytes": 600,
+                            "mem.kv_peak_bytes": 600}},
+            {"model_name": "m", "request_id": "q8", "status": "ok",
+             "duration_ns": 1_000_000, "attributes": {}},
+        ],
+    }
+    f_result = analyze(_memscope.dump(), flight=flight)
+    rows = f_result.get("flight") or []
+    if len(rows) != 1 or rows[0]["kv_pages_held"] != 3:
+        print(f"self-check [flight]: rows {rows}", file=sys.stderr)
+        failures += 1
+    elif "q7 [cancelled]" not in render(f_result):
+        print("self-check [flight]: shed row missing from render",
+              file=sys.stderr)
+        failures += 1
+
+    if failures:
+        print(f"self-check: {failures} failure(s)", file=sys.stderr)
+        return 1
+    print("self-check: report recovers the peak owner, the seeded "
+          "leak, and the headroom split")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="mem_report",
+        description="Device-memory report from a memscope dump",
+    )
+    parser.add_argument("dump_file", nargs="?",
+                        help="memscope dump (GET v2/debug/memscope)")
+    parser.add_argument("--live", metavar="HOST:PORT",
+                        help="fetch the dump from a running server")
+    parser.add_argument("--protocol", choices=("http", "grpc"),
+                        default="http",
+                        help="front-end for --live (default http)")
+    parser.add_argument("--flight", metavar="FILE",
+                        help="flight-recorder dump for per-request "
+                        "memory columns")
+    parser.add_argument("--fleet", metavar="FILE",
+                        help="fleetscope dump for fleet headroom rows")
+    parser.add_argument("--json", dest="as_json", action="store_true",
+                        help="emit the report as JSON")
+    parser.add_argument("--self-check", action="store_true",
+                        help="run the scripted-scenario round trip and "
+                        "exit")
+    args = parser.parse_args(argv)
+    if args.self_check:
+        return self_check()
+    if not args.dump_file and not args.live:
+        parser.error("a memscope dump is required "
+                     "(file, --live, or --self-check)")
+    try:
+        doc = (fetch_live(args.live, args.protocol) if args.live
+               else load_dump(args.dump_file))
+        flight = load_flight(args.flight) if args.flight else None
+        fleet = load_fleet(args.fleet) if args.fleet else None
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"unable to load: {e}", file=sys.stderr)
+        return 1
+    result = analyze(doc, flight=flight, fleet=fleet)
+    try:
+        if args.as_json:
+            print(json.dumps(result, indent=2, default=str))
+        else:
+            print(render(result))
+    except BrokenPipeError:
+        return 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -98,6 +98,7 @@ def _record_from_flight(rec: dict) -> Optional[dict]:
         "status": rec.get("status", "ok"),
         "shed_reason": attrs.get("shed.reason"),
         "steps_completed": attrs.get("steps_completed"),
+        "kv_pages_held": attrs.get("kv_pages_held"),
         "tenant": attrs.get("tenant"),
         "signature": attrs.get(
             "batcher.signature", rec.get("model_name", "") or "?"
@@ -141,6 +142,7 @@ def _records_from_spans(spans: List[dict]) -> List[dict]:
             "status": attrs.get("flight.status", "ok"),
             "shed_reason": attrs.get("shed.reason"),
             "steps_completed": attrs.get("steps_completed"),
+            "kv_pages_held": attrs.get("kv_pages_held"),
             "tenant": attrs.get("tenant"),
             "signature": attrs.get(
                 "batcher.signature",
@@ -344,6 +346,14 @@ def analyze(records: List[dict], tail_q: float = 0.95,
         int(r["steps_completed"]) for r in sheds
         if r.get("steps_completed") is not None
     )
+    # KV pages the shed requests were holding when they died
+    # (kv_pages_held stamped beside steps_completed): a nonzero p50
+    # means cancellations are releasing real pool memory — the memory
+    # column of the shed analysis.
+    shed_pages = sorted(
+        int(r["kv_pages_held"]) for r in sheds
+        if r.get("kv_pages_held") is not None
+    )
     return {
         "records": len(all_records),
         "statuses": {
@@ -366,6 +376,11 @@ def analyze(records: List[dict], tail_q: float = 0.95,
                 "stamped": len(shed_steps),
                 "p50": _percentile(shed_steps, 50),
                 "max": shed_steps[-1] if shed_steps else 0,
+            },
+            "kv_pages_held": {
+                "stamped": len(shed_pages),
+                "p50": _percentile(shed_pages, 50),
+                "max": shed_pages[-1] if shed_pages else 0,
             },
         },
         "tail_q": tail_q,
@@ -435,6 +450,13 @@ def render(result: dict, slowest: List[dict]) -> str:
                 f"  died in the decode loop: {steps['stamped']} stamped, "
                 f"steps completed p50={steps['p50']} max={steps['max']} "
                 "(0 = shed before the first token)"
+            )
+        pages = sheds.get("kv_pages_held") or {}
+        if pages.get("stamped"):
+            lines.append(
+                f"  memory held at death: {pages['stamped']} stamped, "
+                f"kv pages p50={pages['p50']} max={pages['max']} "
+                "(0 = never reserved pool pages)"
             )
     b = result["backlog"]
     if b["stamped"]:
@@ -642,13 +664,16 @@ def self_check() -> int:
         # finalization): the report must surface where in the decode loop
         # cancelled requests died.
         shed_doc = _synthetic_dump(n=40, slow=4)
-        for i, steps in enumerate([0, 2, 5, 9]):
+        for i, (steps, pages) in enumerate(
+            zip([0, 2, 5, 9], [0, 1, 3, 7])
+        ):
             rec = shed_doc["records"][i]
             rec["status"] = "cancel"
             rec["attributes"]["shed.reason"] = (
                 "cancelled" if steps else "admission"
             )
             rec["attributes"]["steps_completed"] = steps
+            rec["attributes"]["kv_pages_held"] = pages
         shed_path = os.path.join(tmp, "shed.json")
         with open(shed_path, "w") as f:
             json.dump(shed_doc, f)
@@ -660,6 +685,15 @@ def self_check() -> int:
             failures += 1
         elif "died in the decode loop" not in render(s_result, []):
             print("self-check [shed steps]: steps_completed line missing "
+                  "from render", file=sys.stderr)
+            failures += 1
+        got_pages = s_result["sheds"].get("kv_pages_held") or {}
+        if got_pages != {"stamped": 4, "p50": 1, "max": 7}:
+            print(f"self-check [shed pages]: {got_pages} != "
+                  "{'stamped': 4, 'p50': 1, 'max': 7}", file=sys.stderr)
+            failures += 1
+        elif "memory held at death" not in render(s_result, []):
+            print("self-check [shed pages]: kv_pages_held line missing "
                   "from render", file=sys.stderr)
             failures += 1
         # Fleet dumps: replica-stamped records (plus the router's proxy

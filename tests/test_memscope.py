@@ -1,0 +1,500 @@
+"""memscope tests: the device-memory ledger reconciles to zero under
+seeded churn, seeded leaks surface as TPU012 findings with both stacks,
+the three /metrics families survive the extended exposition checker (live
+server and synthetic violation documents), headroom merges across
+replicas, and the kvcache registry prunes dead engines."""
+
+import gc
+import queue
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from tritonclient_tpu import _kvcache, _memscope, sanitize
+from tritonclient_tpu.fleet._fleetscope import FleetScope
+from tritonclient_tpu.models import gpt
+from tritonclient_tpu.models.gpt_engine import GenerationEngine
+
+import sys
+
+sys.path.insert(0, "scripts")
+from check_metrics_exposition import check_exposition  # noqa: E402
+
+
+def _collect(req):
+    toks = []
+    while True:
+        t = req.out.get(timeout=120)
+        if t is None:
+            return toks
+        if isinstance(t, BaseException):
+            raise t
+        toks.append(int(t[0]))
+
+
+def _wait_idle(engine, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if all(r is None for r in engine._slot_req):
+            return
+        time.sleep(0.02)  # tpulint: disable=TPU001
+    raise AssertionError(f"engine not idle: {engine._slot_req}")
+
+
+def _scope_pools(scope):
+    """{pool: cell-dict} for one scope from the live ledger dump."""
+    return {p["pool"]: p for p in _memscope.dump()["pools"]
+            if p["scope"] == scope}
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = gpt.gpt_tiny(max_len=64)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture
+def tpusan():
+    """Sanitizer active in report mode; findings isolated and restored
+    (the test_tpusan fixture shape — seeded TPU012 findings must not
+    leak into a session-wide TPUSAN=1 report)."""
+    prior_mode = sanitize.mode()
+    sanitize.enable(mode="report")
+    try:
+        with sanitize.capture() as cap:
+            yield cap
+    finally:
+        sanitize.disable()
+        if sanitize.enabled():
+            sanitize.enable(mode=prior_mode)
+            sanitize.disable()
+
+
+# --------------------------------------------------------------------------- #
+# reconciliation: churn ends at exactly zero live bytes                        #
+# --------------------------------------------------------------------------- #
+
+
+def test_seeded_churn_reconciles_ledger_to_zero(tiny):
+    """Sixty requests over a tiny pool with prefix sharing, eviction
+    pressure, and mid-flight cancels (the PR-11 churn pattern): when the
+    dust settles, the ledger must attribute ZERO bytes to any request
+    owner — and after shutdown every pool of the scope holds zero live
+    and zero parked bytes. The TPU012 witness runs continuously (session
+    sanitizer), so any owner finishing with residue fails here."""
+    cfg, params = tiny
+    scope = "memscope_churn"
+    with sanitize.capture() as cap:
+        engine = GenerationEngine(cfg, params, max_slots=4, n_blocks=9,
+                                  prefill_chunk=8, scope_name=scope)
+        try:
+            rng = np.random.default_rng(42)
+            base = [rng.integers(0, cfg.vocab_size, (1, l)).astype(np.int32)
+                    for l in (17, 20, 33, 18, 16, 19)]
+            live = []
+            for i in range(60):
+                p = base[int(rng.integers(len(base)))]
+                if rng.random() < 0.3:  # unique tail: force fresh pages
+                    p = p.copy()
+                    p[0, -1] = int(rng.integers(cfg.vocab_size))
+                req = engine.submit(p, int(rng.integers(1, 8)))
+                live.append((req, rng.random() < 0.2))
+                while len(live) >= 4:
+                    r, cancel = live.pop(0)
+                    if cancel:
+                        try:
+                            r.out.get(timeout=120)
+                        except queue.Empty:
+                            pass
+                        r.cancelled = True
+                        with engine._cv:
+                            engine._cv.notify_all()
+                    else:
+                        _collect(r)
+            for r, _ in live:
+                r.cancelled = True
+                with engine._cv:
+                    engine._cv.notify_all()
+            _wait_idle(engine)
+            kv = _scope_pools(scope)[_memscope.MEM_POOL_KV]
+            # Quiescent: nothing attributed to any request; resident =
+            # the scratch page plus parked (prefix-cached) pages.
+            assert kv["owners"] == {}
+            assert kv["reserved_bytes"] == 0
+            assert kv["leaks"] == []
+            assert kv["live_bytes"] == (engine._pool.used_count
+                                        * kv["unit_bytes"]
+                                        + kv["parked_bytes"])
+        finally:
+            engine.shutdown()
+        pools = _scope_pools(scope)
+        for pool, cell in pools.items():
+            assert cell["live_bytes"] == 0, (pool, cell)
+            assert cell["parked_bytes"] == 0, (pool, cell)
+            assert cell["owners"] == {}, (pool, cell)
+        # Headroom row retired with the pool's capacity.
+        assert pools[_memscope.MEM_POOL_KV]["capacity_bytes"] == 0
+    assert [r for r in cap.records if r["rule"] == "TPU012"] == []
+
+
+def test_peak_attribution_reconciles_with_page_formula(tiny):
+    """The peak-holding owner recorded at high-water must carry the
+    admission formula's page count — ceil((prompt + max_new) / bs) —
+    and its byte charge must be exactly pages * block_kv_bytes."""
+    cfg, params = tiny
+    scope = "memscope_peak"
+    engine = GenerationEngine(cfg, params, max_slots=2,
+                              prefill_chunk=8, scope_name=scope)
+    try:
+        rng = np.random.default_rng(7)
+        prompt = rng.integers(0, cfg.vocab_size, (1, 17)).astype(np.int32)
+        _collect(engine.submit(prompt, 6))
+        kv = _scope_pools(scope)[_memscope.MEM_POOL_KV]
+        po = kv["peak_owner"]
+        assert po is not None and po["owner"].startswith(scope + ".r")
+        pages = -(-(17 + 6) // engine.block_size)  # ceil = 2 for bs=16
+        assert po["meta"]["pages"] == pages
+        assert po["meta"]["prompt_len"] == 17
+        assert po["meta"]["max_new"] == 6
+        assert po["bytes"] == pages * kv["unit_bytes"]
+    finally:
+        engine.shutdown()
+
+
+# --------------------------------------------------------------------------- #
+# seeded leak -> TPU012 with both stacks                                      #
+# --------------------------------------------------------------------------- #
+
+
+def test_seeded_leak_reports_tpu012_with_both_stacks(tpusan):
+    """A page released OUTSIDE its owner bracket (the seeded-leak shape:
+    the free is owner-masked, so the owner's charge never discharges)
+    must surface as a TPU012 finding carrying both the allocation-site
+    stack and the leak-site stack."""
+    scope, pool = "leaky", _memscope.MEM_POOL_KV
+    _memscope.owner_begin(scope, pool, "leaky.r1",
+                          prompt_len=10, max_new=6, pages=2)
+    _memscope.push_owner("leaky.r1")
+    try:
+        _memscope.kv_page_alloc(scope, 256)
+        _memscope.kv_page_alloc(scope, 256)
+    finally:
+        _memscope.pop_owner()
+    # One page comes back owner-masked: the ledger's pool-side live
+    # drops but the owner keeps its charge — the leak.
+    _memscope.push_owner("")
+    try:
+        _memscope.kv_page_free(scope, 256)
+        _memscope.kv_page_free(scope, 256)
+    finally:
+        _memscope.pop_owner()
+    residue = _memscope.owner_finish(scope, pool, "leaky.r1")
+    assert residue == 512
+    records = [r for r in tpusan.records if r["rule"] == "TPU012"]
+    assert len(records) == 1
+    msg = records[0]["message"]
+    assert "leaky.r1" in msg and "512" in msg
+    # Both stacks: the owner_begin allocation site plus the
+    # owner_finish leak site.
+    stacks = records[0]["stacks"]
+    assert len(stacks) == 2
+    assert "owner_begin" in stacks[0]
+    assert stacks[1]  # leak-site stack auto-captured
+    # The leak stays queryable in the ledger for mem_report.
+    kv = _scope_pools(scope)[pool]
+    assert kv["leaks"] == [{"owner": "leaky.r1", "bytes": 512,
+                            "meta": {"prompt_len": 10, "max_new": 6,
+                                     "pages": 2}}]
+
+
+def test_owner_discard_leaves_no_residue_or_finding(tpusan):
+    """A rolled-back reservation (pool exhausted) discards without a
+    reconciliation check: no finding, no leak row, no owner row."""
+    scope, pool = "rollback", _memscope.MEM_POOL_KV
+    _memscope.owner_begin(scope, pool, "rollback.r1", pages=1)
+    _memscope.push_owner("rollback.r1")
+    try:
+        _memscope.kv_page_alloc(scope, 128)
+        _memscope.kv_page_free(scope, 128)
+    finally:
+        _memscope.pop_owner()
+    _memscope.owner_discard(scope, pool, "rollback.r1")
+    kv = _scope_pools(scope)[pool]
+    assert kv["owners"] == {} and kv["leaks"] == []
+    assert [r for r in tpusan.records if r["rule"] == "TPU012"] == []
+
+
+# --------------------------------------------------------------------------- #
+# /metrics: live server through the extended checker                          #
+# --------------------------------------------------------------------------- #
+
+
+def test_live_exposition_renders_memscope_families(tiny):
+    from tritonclient_tpu.models.gpt_engine import GptEngineModel
+    from tritonclient_tpu.server import InferenceServer
+
+    cfg, _params = tiny
+    model = GptEngineModel(cfg=cfg, max_slots=2, prefill_chunk=8)
+    with InferenceServer(models=[model], http=False) as server:
+        rng = np.random.default_rng(31)
+        prompt = rng.integers(0, cfg.vocab_size, (1, 40)).astype(np.int32)
+        _collect(model.engine.submit(prompt, 4))
+        text = server.core.prometheus_metrics()
+    assert check_exposition(text) == []
+    for pool, kind in (("kv", "live"), ("kv", "peak"), ("params", "live"),
+                       ("scratch", "live")):
+        assert (f'nv_device_memory_bytes{{model="gpt_engine"'
+                f',pool="{pool}",kind="{kind}"}}') in text
+    for event in ("alloc", "free", "park", "evict"):
+        assert (f'nv_device_memory_events_total{{model="gpt_engine"'
+                f',pool="kv",event="{event}"}}') in text
+    assert 'nv_device_memory_headroom_bytes{model="gpt_engine"}' in text
+    assert 'nv_inference_headroom_near_miss_total{model="gpt_engine"' in text
+
+
+def test_headroom_near_miss_counts_oversized_request(tiny):
+    """A request whose page estimate exceeds current KV headroom bumps
+    the near-miss counter (observation only: admission is unchanged, the
+    request still runs into the engine's own can-never-fit error)."""
+    from tritonclient_tpu.models.gpt_engine import GptEngineModel
+    from tritonclient_tpu.server import InferenceServer
+    from tritonclient_tpu.server._core import CoreRequest, CoreTensor
+
+    cfg, _params = tiny
+    # Pool of 3 pages: scratch + 2 grantable. A 33-token prompt needs
+    # ceil((33 + 16) / 16) = 4 pages > headroom.
+    model = GptEngineModel(cfg=cfg, max_slots=2, n_blocks=3,
+                           prefill_chunk=8)
+    with InferenceServer(models=[model], http=False) as server:
+        prompt = np.zeros((1, 33), np.int32)
+        req = CoreRequest(
+            model_name="gpt_engine",
+            inputs=[CoreTensor("INPUT_IDS", "INT32", [1, 33], data=prompt)],
+        )
+        with pytest.raises(Exception):
+            for _ in server.core.infer(req):
+                pass
+        text = server.core.prometheus_metrics()
+    line = [l for l in text.splitlines()
+            if l.startswith('nv_inference_headroom_near_miss_total'
+                            '{model="gpt_engine"')][0]
+    assert int(line.rsplit(" ", 1)[1]) >= 1
+
+
+# --------------------------------------------------------------------------- #
+# /metrics: synthetic violation documents through the checker                 #
+# --------------------------------------------------------------------------- #
+
+
+class TestMemscopeExpositionViolations:
+    HEAD = (
+        "# HELP nv_device_memory_bytes x\n"
+        "# TYPE nv_device_memory_bytes gauge\n"
+        "# HELP nv_device_memory_events_total x\n"
+        "# TYPE nv_device_memory_events_total counter\n"
+        "# HELP nv_device_memory_headroom_bytes x\n"
+        "# TYPE nv_device_memory_headroom_bytes gauge\n"
+    )
+
+    def _good_rows(self):
+        rows = [
+            f'nv_device_memory_bytes{{model="m",pool="kv",kind="{k}"}} {v}'
+            for k, v in (("live", 300), ("peak", 600), ("reserved", 200))
+        ]
+        rows += [
+            f'nv_device_memory_events_total{{model="m",pool="kv"'
+            f',event="{e}"}} 0'
+            for e in ("alloc", "free", "park", "evict")
+        ]
+        rows.append('nv_device_memory_headroom_bytes{model="m"} 700')
+        return rows
+
+    def test_good_document_passes(self):
+        assert check_exposition(
+            self.HEAD + "\n".join(self._good_rows()) + "\n"
+        ) == []
+
+    def test_bytes_label_set(self):
+        rows = self._good_rows()
+        rows.append('nv_device_memory_bytes{model="m",pool="kv"} 0')
+        errors = check_exposition(self.HEAD + "\n".join(rows) + "\n")
+        assert any("label set" in e for e in errors)
+
+    def test_noncanonical_pool(self):
+        rows = self._good_rows()
+        rows[0] = ('nv_device_memory_bytes'
+                   '{model="m",pool="vram",kind="live"} 0')
+        errors = check_exposition(self.HEAD + "\n".join(rows) + "\n")
+        assert any("vram" in e for e in errors)
+
+    def test_noncanonical_kind(self):
+        rows = self._good_rows()
+        rows[0] = ('nv_device_memory_bytes'
+                   '{model="m",pool="kv",kind="resident"} 0')
+        errors = check_exposition(self.HEAD + "\n".join(rows) + "\n")
+        assert any("resident" in e for e in errors)
+
+    def test_noncanonical_event(self):
+        rows = self._good_rows()
+        rows[3] = ('nv_device_memory_events_total'
+                   '{model="m",pool="kv",event="gift"} 0')
+        errors = check_exposition(self.HEAD + "\n".join(rows) + "\n")
+        assert any("gift" in e for e in errors)
+
+    def test_missing_event_row(self):
+        rows = [r for r in self._good_rows() if 'event="park"' not in r]
+        errors = check_exposition(self.HEAD + "\n".join(rows) + "\n")
+        assert any("missing event rows" in e for e in errors)
+
+    def test_live_exceeds_peak(self):
+        rows = self._good_rows()
+        rows[0] = ('nv_device_memory_bytes'
+                   '{model="m",pool="kv",kind="live"} 900')
+        errors = check_exposition(self.HEAD + "\n".join(rows) + "\n")
+        assert any("live 900" in e and "peak 600" in e for e in errors)
+
+    def test_negative_headroom(self):
+        rows = self._good_rows()
+        rows[-1] = 'nv_device_memory_headroom_bytes{model="m"} -5'
+        errors = check_exposition(self.HEAD + "\n".join(rows) + "\n")
+        assert any("headroom cannot be negative" in e for e in errors)
+
+    def test_negative_bytes(self):
+        rows = self._good_rows()
+        rows[0] = ('nv_device_memory_bytes'
+                   '{model="m",pool="kv",kind="live"} -1')
+        errors = check_exposition(self.HEAD + "\n".join(rows) + "\n")
+        assert any("resident bytes cannot be negative" in e for e in errors)
+
+
+# --------------------------------------------------------------------------- #
+# flight-recorder attributes + shm statics                                    #
+# --------------------------------------------------------------------------- #
+
+
+def test_flight_attributes_snapshot_kv_state():
+    scope = "flight_attr"
+    _memscope.set_capacity(scope, _memscope.MEM_POOL_KV, 1000, unit=100)
+    _memscope.owner_begin(scope, _memscope.MEM_POOL_KV, "flight_attr.r1")
+    _memscope.push_owner("flight_attr.r1")
+    try:
+        _memscope.kv_page_alloc(scope, 100)
+    finally:
+        _memscope.pop_owner()
+    attrs = _memscope.flight_attributes(scope)
+    assert attrs["mem.kv_live_bytes"] == 100
+    assert attrs["mem.kv_reserved_bytes"] == 100
+    assert attrs["mem.kv_headroom_bytes"] == 900
+    # Clean up: discharge and verify reconciliation holds.
+    _memscope.push_owner("flight_attr.r1")
+    try:
+        _memscope.kv_page_free(scope, 100)
+    finally:
+        _memscope.pop_owner()
+    assert _memscope.owner_finish(
+        scope, _memscope.MEM_POOL_KV, "flight_attr.r1") == 0
+
+
+def test_client_shm_static_registers_and_clears():
+    """create/destroy of a system shm region populates and retires a
+    keyed static row in the client scope's shm pool."""
+    shared_memory = pytest.importorskip(
+        "tritonclient_tpu.utils.shared_memory")
+    handle = shared_memory.create_shared_memory_region(
+        "memscope_region", "/memscope_region", 4096)
+    try:
+        shm = _scope_pools(_memscope.SCOPE_CLIENT)[_memscope.MEM_POOL_SHM]
+        entry = shm["static"]["sys:memscope_region"]
+        assert entry["bytes"] == 4096
+    finally:
+        shared_memory.destroy_shared_memory_region(handle)
+    shm = _scope_pools(_memscope.SCOPE_CLIENT)[_memscope.MEM_POOL_SHM]
+    assert "sys:memscope_region" not in shm["static"]
+
+
+# --------------------------------------------------------------------------- #
+# fleetscope: headroom merged across replicas                                 #
+# --------------------------------------------------------------------------- #
+
+
+def _headroom_text(value, model="m"):
+    return (
+        "# TYPE nv_device_memory_headroom_bytes gauge\n"
+        f'nv_device_memory_headroom_bytes{{model="{model}"}} {value}\n'
+    )
+
+
+def test_fleet_headroom_merge_two_replicas():
+    clock = [1000.0]
+    scope = FleetScope(clock=lambda: clock[0])
+    scope.observe_scrape("r0", ok=True, metrics_text=_headroom_text(800))
+    scope.observe_scrape("r1", ok=True, metrics_text=_headroom_text(500))
+    merged = scope.headroom_rows()
+    assert merged["replicas"] == [
+        {"replica": "r0", "model": "m", "headroom_bytes": 800.0},
+        {"replica": "r1", "model": "m", "headroom_bytes": 500.0},
+    ]
+    assert merged["fleet_min"] == {"m": 500.0}
+    # A later, tighter sample replaces the replica's row (latest wins).
+    clock[0] += 2.0
+    scope.observe_scrape("r0", ok=True, metrics_text=_headroom_text(200))
+    merged = scope.headroom_rows()
+    assert merged["fleet_min"] == {"m": 200.0}
+    assert merged["replicas"][0]["headroom_bytes"] == 200.0
+    # And the merged view rides dump() for fleet_report.
+    assert scope.dump()["memory"]["headroom"]["fleet_min"] == {"m": 200.0}
+
+
+# --------------------------------------------------------------------------- #
+# kvcache registry: dead engines vanish from /metrics                         #
+# --------------------------------------------------------------------------- #
+
+
+def test_registry_prunes_dead_engines_without_unregister():
+    """An engine dropped WITHOUT shutdown (test churn, crashed loader)
+    must vanish from the snapshot at render time instead of lingering as
+    a stale row pinned by the registry."""
+
+    class _Owner:
+        pass
+
+    owner = _Owner()
+    _kvcache.register("memscope_ghost", owner,
+                      lambda: {"used": 1, "total": 4, "events": {}})
+    names = [n for n, _ in _kvcache.metrics_snapshot()]
+    assert "memscope_ghost" in names
+    del owner
+    gc.collect()
+    names = [n for n, _ in _kvcache.metrics_snapshot()]
+    assert "memscope_ghost" not in names
+    # And the registry itself no longer holds the dead entry.
+    with _kvcache._registry_lock:
+        assert "memscope_ghost" not in _kvcache._registry
+
+
+# --------------------------------------------------------------------------- #
+# off switch: hooks are inert when disabled                                   #
+# --------------------------------------------------------------------------- #
+
+
+def test_disabled_ledger_records_nothing():
+    _memscope.configure(on=False)
+    try:
+        assert not _memscope.enabled()
+        _memscope.kv_page_alloc("off_scope", 100)
+        _memscope.owner_begin("off_scope", _memscope.MEM_POOL_KV, "r1")
+        assert _memscope.owner_finish(
+            "off_scope", _memscope.MEM_POOL_KV, "r1") == 0
+        assert _memscope.headroom("off_scope") is None
+        assert _memscope.metrics_rows() == {
+            "bytes": [], "events": [], "headroom": []}
+        assert _memscope.peaks("off_scope") == {
+            "peak_kv_bytes": 0, "peak_device_bytes": 0}
+        assert _memscope.flight_attributes("off_scope") == {}
+    finally:
+        _memscope.configure(on=True)
+    assert "off_scope" not in {p["scope"]
+                               for p in _memscope.dump()["pools"]}

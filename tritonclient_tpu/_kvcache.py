@@ -27,13 +27,19 @@ A module-level registry lets ``server/_core.prometheus_metrics`` render
 the ``nv_engine_prefix_cache_events_total{model,event}`` counter without
 importing the (heavy) model zoo: engines register a snapshot callable
 here at construction. This module is dependency-free (no jax/numpy).
+
+Both structures additionally report page grants/frees/parks/evictions
+into the memscope byte ledger (``tritonclient_tpu._memscope``) once an
+engine attaches its identity via :func:`attach_memscope` — every hook
+is branch-only until then (and branch-only inside memscope when the
+ledger is off).
 """
 
 import weakref
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
-from tritonclient_tpu import sanitize
+from tritonclient_tpu import _memscope, sanitize
 from tritonclient_tpu.protocol._literals import (
     PREFIX_EVENT_EVICT,
     PREFIX_EVENT_HIT,
@@ -91,6 +97,9 @@ class BlockPool:
         # scratch page deterministically gets block 0).
         self._free: List[int] = list(range(n_blocks - 1, -1, -1))
         self._ref: List[int] = [0] * n_blocks
+        # (scope, block_bytes) once attach_memscope binds this pool to a
+        # ledger row; None keeps every hook branch-only.
+        self._ms: Optional[Tuple[str, int]] = None
 
     # -- allocation ---------------------------------------------------------
 
@@ -101,6 +110,8 @@ class BlockPool:
                 return None
             bid = self._free.pop()
             self._ref[bid] = 1
+            if self._ms is not None:
+                _memscope.kv_page_alloc(self._ms[0], self._ms[1])
             return bid
 
     def ref(self, bid: int) -> None:
@@ -131,6 +142,8 @@ class BlockPool:
                     f"{self._ref[bid]} (must be 0)"
                 )
             self._free.append(bid)
+            if self._ms is not None:
+                _memscope.kv_page_free(self._ms[0], self._ms[1])
 
     # -- introspection ------------------------------------------------------
 
@@ -169,6 +182,7 @@ class PrefixCache:
         # hash -> bid for blocks with refcount 0 (LRU order: oldest first).
         self._evictable: "OrderedDict[int, int]" = OrderedDict()
         self.events: Dict[str, int] = {e: 0 for e in PREFIX_EVENTS}
+        self._ms: Optional[Tuple[str, int]] = None
 
     def match(self, hash_key: int) -> Optional[int]:
         """Look up one cumulative block hash; refs and returns the block
@@ -183,9 +197,13 @@ class PrefixCache:
             bid = self._by_hash.get(hash_key)
             if bid is None:
                 return None
-            if hash_key in self._evictable:
+            unparked = hash_key in self._evictable
+            if unparked:
                 del self._evictable[hash_key]
             self._pool.ref(bid)
+            if self._ms is not None:
+                _memscope.kv_page_grant_shared(
+                    self._ms[0], self._ms[1], unparked)
             return bid
 
     def count(self, event: str, n: int = 1) -> None:
@@ -211,11 +229,17 @@ class PrefixCache:
         to the pool's free list."""
         with self._lock:
             if not self._pool.unref(bid):
+                # Still shared: residency unchanged, but THIS holder's
+                # reservation is discharged.
+                if self._ms is not None:
+                    _memscope.kv_page_drop_shared(self._ms[0], self._ms[1])
                 return
             h = self._hash_of.get(bid)
             if h is not None:
                 self._evictable[h] = bid
                 self._evictable.move_to_end(h)
+                if self._ms is not None:
+                    _memscope.kv_page_park(self._ms[0], self._ms[1])
             else:
                 self._pool.release(bid)
 
@@ -230,7 +254,19 @@ class PrefixCache:
             del self._by_hash[h]
             del self._hash_of[bid]
             self.events[PREFIX_EVENT_EVICT] += 1
-            self._pool.release(bid)
+            if self._ms is not None:
+                _memscope.kv_page_evict(self._ms[0], self._ms[1])
+                # The reclaimed page's pool round-trip must not be
+                # billed to the requester's attribution bracket: the
+                # free returns a CACHE page, not one of theirs (the
+                # re-alloc below is theirs, and stays billed).
+                _memscope.push_owner("")
+                try:
+                    self._pool.release(bid)
+                finally:
+                    _memscope.pop_owner()
+            else:
+                self._pool.release(bid)
             got = self._pool.try_alloc()
             # The free list pops lowest-id first; the block just released
             # is not guaranteed to be the one handed back — any free
@@ -245,6 +281,21 @@ class PrefixCache:
     def snapshot_events(self) -> Dict[str, int]:
         with self._lock:
             return dict(self.events)
+
+
+def attach_memscope(pool: BlockPool, prefix: Optional[PrefixCache],
+                    scope: str, block_bytes: int) -> None:
+    """Bind a pool (and its prefix cache) to a memscope ledger row:
+    subsequent page grants/frees/parks/evictions report into the
+    ``(scope, "kv")`` cell at ``block_bytes`` per page, and the pool's
+    capacity is declared so the headroom gauge has a denominator."""
+    key = (scope, int(block_bytes))
+    pool._ms = key
+    if prefix is not None:
+        prefix._ms = key
+    _memscope.set_capacity(scope, _memscope.MEM_POOL_KV,
+                           pool.n_blocks * int(block_bytes),
+                           unit=int(block_bytes))
 
 
 # -- /metrics registry ------------------------------------------------------
@@ -276,6 +327,13 @@ def metrics_snapshot() -> List[Tuple[str, Dict]]:
     sorted by name for stable exposition order."""
     out = []
     with _registry_lock:
+        # Prune dead refs at render time: a dropped engine must VANISH
+        # from the exposition, not linger as a stale zero row (and the
+        # registry must not grow unboundedly under test-driven engine
+        # churn).
+        for name in [n for n, (ref, _) in _registry.items()
+                     if ref() is None]:
+            del _registry[name]
         for name in sorted(_registry):
             ref, snap = _registry[name]
             if ref() is None:

@@ -1,0 +1,594 @@
+"""memscope: the device-memory observability plane.
+
+Every observability plane so far answers "where did the *time* go"
+(tracing, stepscope, fleetscope); this module answers "where did the
+*bytes* go". It is a per-(model, pool) accelerator-memory ledger that
+every byte-holding subsystem reports into:
+
+- the paged KV block pools (``_kvcache.BlockPool`` page grants/frees,
+  prefix-cache parked bytes, the reservation-vs-used split);
+- model load/unload (tp-sharded param bytes per device, computed from
+  the actual ``jax.Array`` shardings by :func:`params_device_bytes`);
+- the shared-memory planes (registered device-buffer bytes per region,
+  system and TPU registries plus the client-side packages);
+- engine scratch / slot-state buffers.
+
+State per (scope, pool) cell — ``scope`` plays the ``model`` label role
+(model/engine name for kv/params/scratch pools; ``"server"`` /
+``"client"`` for the shm registries):
+
+- ``live``: bytes resident right now (prefix-cache parked pages
+  included — they occupy HBM until evicted);
+- ``peak``: high-water mark of ``live``, with the owner holding the
+  most bytes at the moment the peak was set (peak attribution);
+- ``reserved``: sum of per-request reservations
+  (``ceil((prompt+max_new)/block_size)`` pages each). Shared prefix
+  pages count once per holder, so ``reserved > live`` measures the
+  prefix-sharing win — the reservation-vs-used split;
+- ``parked``: zero-ref prefix-cache bytes (reclaimable headroom);
+- a monotonic alloc/free/park/evict event ring (bounded deque) every
+  dump and ``scripts/mem_report.py`` replay occupancy timelines from.
+
+**Reconciliation invariant.** Per-request bytes are charged to an
+*owner* token: the engine brackets its page grants/frees with
+:func:`push_owner`/:func:`pop_owner` (thread-local — page events inside
+the bracket are attributed automatically), and calls
+:func:`owner_finish` when the request's pages are back. An owner whose
+ledger bytes are not exactly zero at finish is a leak: recorded in the
+cell's leak table and — under ``TPUSAN=1`` — reported as a sanitize
+finding (rule TPU012, the fourth witness alongside locks/shm/loop)
+carrying both the allocation-site stack captured at
+:func:`owner_begin` and the leak-site stack.
+
+Surfaces: ``/metrics`` families ``nv_device_memory_bytes{model,pool,
+kind}``, ``nv_device_memory_events_total{model,pool,event}`` and
+``nv_device_memory_headroom_bytes{model}`` (via ``metrics_rows``);
+flight-recorder attributes (``flight_attributes``); the
+``v2/debug/memscope`` dump on both front-ends; and a headroom signal
+the batcher's admission path reads (observation-only:
+``would_exceed_headroom`` stamps + the near-miss counter).
+
+Activation: on by default; ``TPU_MEMSCOPE=0`` disables, leaving every
+hook branch-only. All locks go through ``sanitize.named_lock`` so the
+runtime sanitizer sees them.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from tritonclient_tpu import sanitize
+
+# The pool/kind/event vocabularies are spelled once in protocol/_literals
+# (the wire-literal module); the fallback keeps memscope importable
+# standalone.
+try:  # pragma: no cover - import plumbing
+    from tritonclient_tpu.protocol._literals import (
+        MEM_EVENT_ALLOC, MEM_EVENT_EVICT, MEM_EVENT_FREE, MEM_EVENT_PARK,
+        MEM_EVENTS, MEM_KIND_LIVE, MEM_KIND_PEAK, MEM_KIND_RESERVED,
+        MEM_KINDS, MEM_POOL_KV, MEM_POOL_PARAMS, MEM_POOL_SCRATCH,
+        MEM_POOL_SHM, MEM_POOLS)
+except Exception:  # pragma: no cover
+    MEM_POOL_KV, MEM_POOL_PARAMS = "kv", "params"
+    MEM_POOL_SHM, MEM_POOL_SCRATCH = "shm", "scratch"
+    MEM_POOLS = (MEM_POOL_KV, MEM_POOL_PARAMS, MEM_POOL_SHM,
+                 MEM_POOL_SCRATCH)
+    MEM_KIND_LIVE, MEM_KIND_PEAK, MEM_KIND_RESERVED = (
+        "live", "peak", "reserved")
+    MEM_KINDS = (MEM_KIND_LIVE, MEM_KIND_PEAK, MEM_KIND_RESERVED)
+    MEM_EVENT_ALLOC, MEM_EVENT_FREE = "alloc", "free"
+    MEM_EVENT_PARK, MEM_EVENT_EVICT = "park", "evict"
+    MEM_EVENTS = (MEM_EVENT_ALLOC, MEM_EVENT_FREE, MEM_EVENT_PARK,
+                  MEM_EVENT_EVICT)
+
+MEM_BYTES_METRIC = "nv_device_memory_bytes"
+MEM_EVENTS_METRIC = "nv_device_memory_events_total"
+MEM_HEADROOM_METRIC = "nv_device_memory_headroom_bytes"
+
+#: Scope labels of the shared-memory registries (server-side) and the
+#: client-side shm packages — the two non-model scopes.
+SCOPE_SERVER = "server"
+SCOPE_CLIENT = "client"
+
+_DEFAULT_RING = 4096
+
+
+def _env_on() -> bool:
+    raw = os.environ.get("TPU_MEMSCOPE", "").strip().lower()
+    return raw not in ("0", "off", "false", "no")
+
+
+_on = _env_on()
+
+
+def enabled() -> bool:
+    return _on
+
+
+# tpulint: disable=TPU009 - benign single-rebind mode publication
+def configure(on: Optional[bool] = None, ring: Optional[int] = None):
+    """Flip the ledger on/off and/or resize the event ring (testing and
+    benchmarking knob; the env default is read once at import)."""
+    global _on
+    if on is not None:
+        _on = bool(on)
+    if ring is not None:
+        _LEDGER.resize_ring(int(ring))
+
+
+# -- owner context ---------------------------------------------------------- #
+
+_tls = threading.local()
+
+
+def push_owner(owner: str):
+    """Enter an owner-attribution bracket: page events fired on this
+    thread are charged to ``owner`` until :func:`pop_owner`. Pushing
+    ``""`` masks an outer bracket (eviction's internal page free must
+    not be billed to the reserving request)."""
+    stack = getattr(_tls, "owners", None)
+    if stack is None:
+        stack = _tls.owners = []
+    stack.append(owner)
+
+
+def pop_owner():
+    stack = getattr(_tls, "owners", None)
+    if stack:
+        stack.pop()
+
+
+def _current_owner() -> str:
+    stack = getattr(_tls, "owners", None)
+    return stack[-1] if stack else ""
+
+
+# -- ledger ----------------------------------------------------------------- #
+
+
+class _PoolCell:
+    __slots__ = ("live", "peak", "capacity", "unit", "parked", "events",
+                 "owners", "owner_meta", "static", "peak_owner", "leaks")
+
+    def __init__(self):
+        self.live = 0
+        self.peak = 0
+        self.capacity = 0   # 0 = unknown/unbounded (no headroom row)
+        self.unit = 0       # grant granularity (KV block bytes)
+        self.parked = 0
+        self.events = {e: 0 for e in MEM_EVENTS}
+        self.owners: Dict[str, int] = {}
+        self.owner_meta: Dict[str, dict] = {}
+        self.static: Dict[str, dict] = {}
+        self.peak_owner: Optional[dict] = None
+        self.leaks: List[dict] = []
+
+    @property
+    def reserved(self) -> int:
+        return sum(self.owners.values())
+
+
+class _Ledger:
+    def __init__(self):
+        self._lock = sanitize.named_lock("memscope._lock")
+        self._cells: Dict[Tuple[str, str], _PoolCell] = {}
+        self._ring: deque = deque(maxlen=_DEFAULT_RING)
+        self._seq = 0
+
+    def resize_ring(self, n: int):
+        with self._lock:
+            self._ring = deque(self._ring, maxlen=max(16, n))
+
+    def reset(self):
+        with self._lock:
+            self._cells.clear()
+            self._ring = deque(maxlen=self._ring.maxlen)
+            self._seq = 0
+
+    def cell(self, scope: str, pool: str) -> _PoolCell:  # tpulint: disable=TPU002 - caller holds self._lock
+        c = self._cells.get((scope, pool))
+        if c is None:
+            c = self._cells[(scope, pool)] = _PoolCell()
+        return c
+
+    def record(self, scope: str, pool: str, event: str, nbytes: int,
+               owner: Optional[str], live_delta: int, parked_delta: int):
+        """One ledger mutation: event counter + ring entry + live/peak/
+        parked updates + owner attribution (alloc charges, free/park
+        discharge, evict is owner-neutral)."""
+        with self._lock:
+            c = self.cell(scope, pool)
+            c.events[event] = c.events.get(event, 0) + 1
+            c.live += live_delta
+            c.parked += parked_delta
+            if owner:
+                if event == MEM_EVENT_ALLOC:
+                    c.owners[owner] = c.owners.get(owner, 0) + nbytes
+                elif event in (MEM_EVENT_FREE, MEM_EVENT_PARK):
+                    held = c.owners.get(owner, 0) - nbytes
+                    if held > 0:
+                        c.owners[owner] = held
+                    else:
+                        # Fully discharged (clamped at zero: an extra
+                        # free is a pool-side event, never a negative
+                        # hold) — drop the entry so rolled-back
+                        # reservations leave no residue rows.
+                        c.owners.pop(owner, None)
+            if c.live > c.peak:
+                c.peak = c.live
+                if c.owners:
+                    top = max(c.owners, key=lambda o: c.owners[o])
+                    c.peak_owner = {
+                        "owner": top,
+                        "bytes": c.owners[top],
+                        "meta": dict(c.owner_meta.get(top, {})),
+                    }
+            self._seq += 1
+            self._ring.append({
+                "seq": self._seq,
+                "t_us": int(time.time() * 1e6),
+                "scope": scope,
+                "pool": pool,
+                "event": event,
+                "bytes": int(nbytes),
+                "owner": owner or "",
+                "live": c.live,
+                "parked": c.parked,
+                "reserved": c.reserved,
+            })
+
+
+_LEDGER = _Ledger()
+
+
+# -- generic event API ------------------------------------------------------ #
+
+_LIVE_DELTA = {MEM_EVENT_ALLOC: 1, MEM_EVENT_FREE: -1,
+               MEM_EVENT_PARK: 0, MEM_EVENT_EVICT: 0}
+
+
+def record_event(scope: str, pool: str, event: str, nbytes: int,
+                 owner: Optional[str] = None, live_delta: Optional[int] = None,
+                 parked_delta: int = 0):
+    """Report one alloc/free/park/evict of ``nbytes`` into the ledger.
+
+    ``owner`` defaults to the thread-local attribution bracket;
+    ``live_delta`` defaults to ``+nbytes`` for alloc, ``-nbytes`` for
+    free, ``0`` for park/evict (pass it explicitly for grants that do
+    not change residency, e.g. a shared prefix-page hit)."""
+    if not _on:
+        return
+    if owner is None:
+        owner = _current_owner()
+    if live_delta is None:
+        live_delta = _LIVE_DELTA[event] * nbytes
+    _LEDGER.record(scope, pool, event, int(nbytes), owner,
+                   int(live_delta), int(parked_delta))
+
+
+# -- KV page hooks (called from _kvcache under the engine loop) ------------- #
+
+def kv_page_alloc(scope: str, nbytes: int):
+    """Fresh page granted from the free list: live grows."""
+    record_event(scope, MEM_POOL_KV, MEM_EVENT_ALLOC, nbytes)
+
+
+def kv_page_free(scope: str, nbytes: int):
+    """Page returned to the free list: live shrinks."""
+    record_event(scope, MEM_POOL_KV, MEM_EVENT_FREE, nbytes)
+
+
+def kv_page_grant_shared(scope: str, nbytes: int, unparked: bool):
+    """Prefix-cache hit: the page is granted to another holder without
+    changing residency; if it was parked on the evictable LRU it is now
+    referenced again."""
+    record_event(scope, MEM_POOL_KV, MEM_EVENT_ALLOC, nbytes, live_delta=0,
+                 parked_delta=-nbytes if unparked else 0)
+
+
+def kv_page_drop_shared(scope: str, nbytes: int):
+    """One holder of a still-shared page dropped its hold: residency
+    unchanged, the holder's reservation discharged."""
+    record_event(scope, MEM_POOL_KV, MEM_EVENT_FREE, nbytes, live_delta=0)
+
+
+def kv_page_park(scope: str, nbytes: int):
+    """Zero-ref registered page parked evictable: still resident, now
+    reclaimable headroom."""
+    record_event(scope, MEM_POOL_KV, MEM_EVENT_PARK, nbytes,
+                 parked_delta=nbytes)
+
+
+def kv_page_evict(scope: str, nbytes: int):
+    """Parked page reclaimed to satisfy an allocation (its free/re-alloc
+    fire separately, owner-masked for the free)."""
+    record_event(scope, MEM_POOL_KV, MEM_EVENT_EVICT, nbytes, owner="",
+                 parked_delta=-nbytes)
+
+
+# -- owner (per-request) reconciliation ------------------------------------- #
+
+def owner_begin(scope: str, pool: str, owner: str, **meta):
+    """Declare a request-owner before its grants: records attribution
+    metadata (prompt_len / max_new / pages) and — when the sanitizer is
+    active — the allocation-site stack the leak finding will carry."""
+    if not _on:
+        return
+    with _LEDGER._lock:
+        _LEDGER.cell(scope, pool).owner_meta[owner] = dict(meta)
+    if sanitize.enabled():
+        from tritonclient_tpu.sanitize import _mem
+        _mem.note_alloc((scope, pool, owner))
+
+
+def owner_finish(scope: str, pool: str, owner: str) -> int:
+    """The request finished / shed / cancelled and its pages are back:
+    reconcile. Returns the residue (0 when clean); nonzero residue is a
+    leak — recorded in the cell's leak table and reported through the
+    TPU012 sanitize witness with both stacks."""
+    if not _on:
+        return 0
+    with _LEDGER._lock:
+        c = _LEDGER.cell(scope, pool)
+        residue = c.owners.pop(owner, 0)
+        meta = c.owner_meta.pop(owner, {})
+        if residue:
+            c.leaks.append(
+                {"owner": owner, "bytes": int(residue), "meta": meta})
+    from tritonclient_tpu.sanitize import _mem
+    if residue:
+        _mem.report_leak(scope, pool, owner, residue)
+    _mem.drop_alloc((scope, pool, owner))
+    return residue
+
+
+def owner_discard(scope: str, pool: str, owner: str):
+    """A reservation that never committed (pool exhausted, rollback, or
+    can-never-fit): forget the owner's metadata and stack without a
+    reconciliation check — its grants already rolled back event-wise."""
+    if not _on:
+        return
+    with _LEDGER._lock:
+        c = _LEDGER.cell(scope, pool)
+        c.owners.pop(owner, None)
+        c.owner_meta.pop(owner, None)
+    from tritonclient_tpu.sanitize import _mem
+    _mem.drop_alloc((scope, pool, owner))
+
+
+def pool_close(scope: str, pool: str):
+    """Engine shutdown: the pool's device arrays leave the serving set —
+    free every resident byte (scratch page and parked cache pages
+    included) and retire the headroom row."""
+    if not _on:
+        return
+    with _LEDGER._lock:
+        c = _LEDGER._cells.get((scope, pool))
+        if c is None:
+            return
+        live, parked = c.live, c.parked
+        c.capacity = 0
+    if live or parked:
+        record_event(scope, pool, MEM_EVENT_FREE, live, owner="",
+                     live_delta=-live, parked_delta=-parked)
+
+
+# -- capacity / static pools ------------------------------------------------ #
+
+def set_capacity(scope: str, pool: str, capacity: int, unit: int = 0):
+    """Declare a pool's byte capacity (and grant granularity): the
+    denominator of the headroom gauge."""
+    if not _on:
+        return
+    with _LEDGER._lock:
+        c = _LEDGER.cell(scope, pool)
+        c.capacity = int(capacity)
+        if unit:
+            c.unit = int(unit)
+
+
+def set_static(scope: str, pool: str, key: str, nbytes: int,
+               detail: Optional[dict] = None):
+    """Set a keyed static population (a shm region, a model's params, an
+    engine's slot-state buffers) to ``nbytes``, emitting the alloc/free
+    delta event. Idempotent per key: re-registration replaces."""
+    if not _on:
+        return
+    with _LEDGER._lock:
+        c = _LEDGER.cell(scope, pool)
+        old = c.static.get(key, {}).get("bytes", 0)
+    delta = int(nbytes) - old
+    if delta > 0:
+        record_event(scope, pool, MEM_EVENT_ALLOC, delta, owner="")
+    elif delta < 0:
+        record_event(scope, pool, MEM_EVENT_FREE, -delta, owner="")
+    with _LEDGER._lock:
+        c = _LEDGER.cell(scope, pool)
+        entry = {"bytes": int(nbytes)}
+        if detail:
+            entry.update(detail)
+        if nbytes:
+            c.static[key] = entry
+        else:
+            c.static.pop(key, None)
+
+
+def clear_static(scope: str, pool: str, key: str):
+    set_static(scope, pool, key, 0)
+
+
+def drop_scope(scope: str, pools: Tuple[str, ...] = (MEM_POOL_PARAMS,
+                                                     MEM_POOL_SCRATCH)):
+    """Model unload: free every static population of ``scope``'s params/
+    scratch pools (events fire, rows go to zero)."""
+    if not _on:
+        return
+    with _LEDGER._lock:
+        keys = [(p, k) for p in pools
+                for k in _LEDGER._cells.get((scope, p), _PoolCell()).static]
+    for pool, key in keys:
+        clear_static(scope, pool, key)
+
+
+# -- snapshots -------------------------------------------------------------- #
+
+def headroom(scope: str) -> Optional[int]:
+    """Reclaimable KV bytes for ``scope``: free pool bytes plus parked
+    (evictable) bytes — the largest reservation grantable right now.
+    None when the scope has no capacity-declared KV pool."""
+    if not _on:
+        return None
+    with _LEDGER._lock:
+        c = _LEDGER._cells.get((scope, MEM_POOL_KV))
+        if c is None or not c.capacity:
+            return None
+        return max(0, c.capacity - c.live + c.parked)
+
+
+def metrics_rows() -> Dict[str, list]:
+    """Rows for the three /metrics families: ``bytes`` [(scope, pool,
+    kind, value)], ``events`` [(scope, pool, event, count)] — every
+    event of the canonical vocabulary rendered per cell — and
+    ``headroom`` [(scope, value)]."""
+    out: Dict[str, list] = {"bytes": [], "events": [], "headroom": []}
+    if not _on:
+        return out
+    with _LEDGER._lock:
+        for (scope, pool), c in sorted(_LEDGER._cells.items()):
+            out["bytes"].append((scope, pool, MEM_KIND_LIVE, c.live))
+            out["bytes"].append((scope, pool, MEM_KIND_PEAK, c.peak))
+            out["bytes"].append((scope, pool, MEM_KIND_RESERVED, c.reserved))
+            for e in MEM_EVENTS:
+                out["events"].append((scope, pool, e, c.events.get(e, 0)))
+            if pool == MEM_POOL_KV and c.capacity:
+                out["headroom"].append(
+                    (scope, max(0, c.capacity - c.live + c.parked)))
+    return out
+
+
+def peaks(scope: str) -> Dict[str, int]:
+    """Bench hook: ``peak_kv_bytes`` (the scope's KV pool peak) and
+    ``peak_device_bytes`` (sum of the scope's pool peaks)."""
+    if not _on:
+        return {"peak_kv_bytes": 0, "peak_device_bytes": 0}
+    with _LEDGER._lock:
+        kv = 0
+        total = 0
+        for (s, pool), c in _LEDGER._cells.items():
+            if s != scope:
+                continue
+            total += c.peak
+            if pool == MEM_POOL_KV:
+                kv = c.peak
+        return {"peak_kv_bytes": kv, "peak_device_bytes": total}
+
+
+def flight_attributes(scope: str) -> Dict[str, Any]:
+    """Memory attributes merged onto retained flight records: where the
+    scope's KV pool stands (live/peak/reserved) and who held the most at
+    the peak."""
+    if not _on:
+        return {}
+    with _LEDGER._lock:
+        c = _LEDGER._cells.get((scope, MEM_POOL_KV))
+        if c is None:
+            return {}
+        attrs: Dict[str, Any] = {
+            "mem.kv_live_bytes": c.live,
+            "mem.kv_peak_bytes": c.peak,
+            "mem.kv_reserved_bytes": c.reserved,
+        }
+        if c.capacity:
+            attrs["mem.kv_headroom_bytes"] = max(
+                0, c.capacity - c.live + c.parked)
+        if c.peak_owner:
+            attrs["mem.kv_peak_owner"] = c.peak_owner["owner"]
+            attrs["mem.kv_peak_owner_bytes"] = c.peak_owner["bytes"]
+        return attrs
+
+
+def dump() -> dict:
+    """The self-describing document ``scripts/mem_report.py`` loads."""
+    pools = []
+    with _LEDGER._lock:
+        for (scope, pool), c in sorted(_LEDGER._cells.items()):
+            pools.append({
+                "scope": scope,
+                "pool": pool,
+                "live_bytes": c.live,
+                "peak_bytes": c.peak,
+                "reserved_bytes": c.reserved,
+                "parked_bytes": c.parked,
+                "capacity_bytes": c.capacity,
+                "unit_bytes": c.unit,
+                "events": dict(c.events),
+                "owners": dict(c.owners),
+                "owner_meta": {k: dict(v) for k, v in c.owner_meta.items()},
+                "static": {k: dict(v) for k, v in c.static.items()},
+                "peak_owner": dict(c.peak_owner) if c.peak_owner else None,
+                "leaks": [dict(x) for x in c.leaks],
+                "headroom_bytes": (
+                    max(0, c.capacity - c.live + c.parked)
+                    if (pool == MEM_POOL_KV and c.capacity) else None),
+            })
+        ring = [dict(e) for e in _LEDGER._ring]
+    return {
+        "kind": "memscope",
+        "enabled": _on,
+        "pools": pools,
+        "events": ring,
+    }
+
+
+def reset():
+    """Testing hook: drop every cell and the event ring."""
+    _LEDGER.reset()
+
+
+# -- params sizing ---------------------------------------------------------- #
+
+def params_device_bytes(params) -> Dict[str, int]:
+    """Per-device resident bytes of a parameter pytree, from the actual
+    ``jax.Array`` shardings: each leaf contributes its addressable
+    shards' bytes to the device that holds them (a tp-sharded leaf
+    splits; a replicated leaf charges every device its full size).
+    Non-jax leaves (host numpy) charge a ``"host"`` key."""
+    try:
+        import jax
+        import numpy as np
+    except Exception:  # pragma: no cover - jax is a baked-in dep
+        return {}
+    per: Dict[str, int] = {}
+    for leaf in jax.tree_util.tree_leaves(params):
+        if isinstance(leaf, jax.Array):
+            try:
+                shards = leaf.addressable_shards
+            except Exception:
+                per["host"] = per.get("host", 0) + int(leaf.nbytes)
+                continue
+            for sh in shards:
+                key = f"d{sh.device.id}"
+                per[key] = per.get(key, 0) + int(sh.data.nbytes)
+        elif isinstance(leaf, np.ndarray):
+            per["host"] = per.get("host", 0) + int(leaf.nbytes)
+    return per
+
+
+def register_params(scope: str, params, detail: Optional[dict] = None):
+    """Report a model's parameter bytes: pool live = the max per-device
+    resident bytes (the HBM-planning number), with the full per-device
+    map in the dump."""
+    if not _on:
+        return
+    per = params_device_bytes(params)
+    device_max = max(
+        [v for k, v in per.items() if k != "host"] or [per.get("host", 0)]
+    ) if per else 0
+    info = {"per_device": per}
+    if detail:
+        info.update(detail)
+    set_static(scope, MEM_POOL_PARAMS, "params", device_max, info)

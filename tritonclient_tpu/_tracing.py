@@ -47,7 +47,7 @@ from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from tritonclient_tpu import _otel, _stepscope
+from tritonclient_tpu import _memscope, _otel, _stepscope
 from tritonclient_tpu._otel import (
     TraceRecord,
     build_span_tree,
@@ -591,6 +591,11 @@ class FlightRecorder:
         # tail request's wall time alone cannot show. No-op (empty dict)
         # when TPU_STEPSCOPE is off.
         attributes.update(_stepscope.flight_attributes(ctx.model_name))
+        # memscope: pages-held / bytes-at-peak snapshot for the model's
+        # device-memory pools at record time — shows whether a slow or
+        # shed request coincided with memory pressure. No-op (empty dict)
+        # when TPU_MEMSCOPE is off.
+        attributes.update(_memscope.flight_attributes(ctx.model_name))
         return FlightRecord(
             seq=seq,
             model_name=ctx.model_name,

@@ -36,7 +36,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
-from tritonclient_tpu import sanitize
+from tritonclient_tpu import _memscope, sanitize
 from tritonclient_tpu._sketch import LatencySketch
 from tritonclient_tpu.fleet._replica import http_call
 from tritonclient_tpu.fleet._slo import (
@@ -310,6 +310,42 @@ class FleetScope:
             })
         return rows
 
+    # -- merged device-memory headroom ----------------------------------------
+
+    _HEADROOM_SERIES_RE = re.compile(
+        r"^" + _memscope.MEM_HEADROOM_METRIC + r"\{model=\"([^\"]*)\"\}$"
+    )
+
+    def headroom_rows(self) -> dict:
+        """Fleet-level merge of the ``nv_device_memory_headroom_bytes``
+        gauge: each replica's LATEST retained sample (the gauge rides the
+        scrape ring like every other gauge, so history stays queryable
+        from ``timeseries()``), plus the fleet-wide minimum per model —
+        the number an admission-aware router actually cares about (the
+        fleet can place a request only where the tightest replica that
+        must host it still has room)."""
+        rows: List[dict] = []
+        fleet_min: Dict[str, float] = {}
+        with self._lock:
+            for name, series in sorted(self._series.items()):
+                if not series.ring:
+                    continue
+                gauges = series.ring[-1].get("gauges", {})
+                for key, value in sorted(gauges.items()):
+                    m = self._HEADROOM_SERIES_RE.match(key)
+                    if m is None:
+                        continue
+                    model = m.group(1)
+                    rows.append({
+                        "replica": name,
+                        "model": model,
+                        "headroom_bytes": value,
+                    })
+                    if (model not in fleet_min
+                            or value < fleet_min[model]):
+                        fleet_min[model] = value
+        return {"replicas": rows, "fleet_min": fleet_min}
+
     # -- SLO / cohorts --------------------------------------------------------
 
     def set_objective(self, doc: dict) -> dict:
@@ -450,6 +486,7 @@ class FleetScope:
             "scrape_health": self.scrape_health(),
             "timeseries": self.timeseries(),
             "merged_sketches": self.merged_sketch_rows(),
+            "memory": {"headroom": self.headroom_rows()},
             "slo": {
                 "objectives": self.objective_docs(),
                 "burn": self.burn_rows(now=now),

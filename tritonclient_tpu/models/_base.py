@@ -104,3 +104,19 @@ class Model:
 
     def warmup(self) -> None:
         """Trigger jit compilation ahead of serving (optional)."""
+
+    # -- device-memory observability (memscope) ------------------------------
+
+    def estimate_request_bytes(
+        self, input_shapes: Dict[str, List[int]]
+    ) -> Optional[int]:
+        """Estimated device bytes THIS request will hold while it runs,
+        from its input shapes alone (no tensor data is resolved).
+
+        The core compares the estimate against the model's memscope
+        headroom at admission — observation-only: admitted requests are
+        stamped ``would_exceed_headroom`` and the near-miss counter
+        increments, nothing is rejected. Return None when the model has
+        no device-memory cost model (the default).
+        """
+        return None

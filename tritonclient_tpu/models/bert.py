@@ -320,6 +320,12 @@ class BertBaseModel(Model):
             return pooled_output(params, seq).astype(jnp.float32)
 
         self._fwd = fwd
+        # Parameter bytes on the device-memory ledger (per-device, from
+        # the actual shardings — registered AFTER the mesh layout so a
+        # tp/fsdp split reports split bytes).
+        from tritonclient_tpu import _memscope
+
+        _memscope.register_params(self.name, self._params)
 
     def infer(self, inputs, parameters=None):
         x = inputs["INPUT_IDS"]

@@ -510,6 +510,11 @@ class GptModel(Model):
             prefill, cfg=self.cfg, attention_fn=attention_fn
         ))
         self._decode = make_decode_fn(self.cfg)
+        # Parameter bytes on the device-memory ledger (per-device, from
+        # the actual shardings).
+        from tritonclient_tpu import _memscope
+
+        _memscope.register_params(self.name, self._params)
 
     def infer(self, inputs, parameters=None) -> Iterator[dict]:
         prompt = np.asarray(inputs["INPUT_IDS"], dtype=np.int32)

@@ -60,7 +60,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from tritonclient_tpu import _kvcache, _stepscope, sanitize
+from tritonclient_tpu import _kvcache, _memscope, _stepscope, sanitize
 from tritonclient_tpu.models._base import Model, TensorSpec
 from tritonclient_tpu.models.gpt import (
     GptConfig,
@@ -275,7 +275,7 @@ def _prefill_chunk_paged(params: Dict, k_pool, v_pool, chunks, btabs,
 class _Request:
     __slots__ = ("prompt", "max_new", "out", "remaining", "temperature",
                  "top_k", "seed", "cancelled", "cancel_event",
-                 "steps_completed")
+                 "steps_completed", "mem_owner", "kv_pages_held")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0,
@@ -288,6 +288,12 @@ class _Request:
         # core can stamp WHERE in the decode loop the request died — a
         # cancelled request's flight record otherwise shows only wall time.
         self.steps_completed = 0
+        # Memscope attribution token (assigned at submit) and the page
+        # reservation granted at admission. Mirrored onto the
+        # cancel_event like steps_completed, so shed/cancel finalization
+        # can stamp died-holding-N-pages onto the flight record.
+        self.mem_owner = ""
+        self.kv_pages_held = 0
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.seed = int(seed)
@@ -568,6 +574,10 @@ class GenerationEngine:
             self._cache_sharding = None
             self._vec_sharding = None
         self.params = params
+        # Parameter bytes on the ledger: per-device resident bytes from
+        # the ACTUAL jax.Array shardings (a tp mesh splits a leaf across
+        # devices; replication charges every device its full size).
+        _memscope.register_params(scope_name, params)
         self.max_slots = max_slots
         if self._cache_sharding is not None:
             # Allocate the pool directly sharded: staging the full
@@ -583,9 +593,13 @@ class GenerationEngine:
         # returns page 0 — pinned forever as the SCRATCH page that idle
         # and still-prefilling slots write into.
         self._pool = _kvcache.BlockPool(n_blocks, block_size)
+        self._prefix = _kvcache.PrefixCache(self._pool)
+        # Ledger identity BEFORE the scratch alloc: the pinned scratch
+        # page is resident from birth and belongs on the ledger.
+        _kvcache.attach_memscope(self._pool, self._prefix, scope_name,
+                                 self._block_kv_bytes)
         self._scratch = self._pool.try_alloc()
         assert self._scratch == 0
-        self._prefix = _kvcache.PrefixCache(self._pool)
         self._slot_blocks: List[List[int]] = [[] for _ in range(max_slots)]
         self._prefilling: Dict[int, _PrefillState] = {}
         self._pending: Optional[_Request] = None  # head-of-line, blocked on pages
@@ -607,7 +621,17 @@ class GenerationEngine:
                      self._steps, self._temps, self._topks),
                     self._vec_sharding,
                 )
+        # Slot-state scratch buffers on the ledger (the KV pool arrays
+        # themselves are the kv pool's declared capacity).
+        _memscope.set_static(
+            scope_name, _memscope.MEM_POOL_SCRATCH, "slot_state",
+            int(sum(int(a.nbytes) for a in (
+                self._btabs, self._tokens, self._pos, self._seeds,
+                self._steps, self._temps, self._topks))),
+            {"buffers": "btabs/tokens/pos/seeds/steps/temps/topks"},
+        )
         self._slot_req: List[Optional[_Request]] = [None] * max_slots
+        self._req_seq = 0  # memscope owner tokens (guarded by _cv)
         self._admit: "queue.Queue" = queue.Queue()
         # Named for the tpusan lock-order witness (plain Condition when
         # the sanitizer is inactive).
@@ -715,6 +739,12 @@ class GenerationEngine:
         self._process_frees()
         self._drain_terminated()
         _kvcache.unregister(self._scope_name, self)
+        # Ledger closure: the pool's device arrays leave the serving set
+        # — every resident byte (scratch + parked cache pages) frees and
+        # the headroom row retires. Idempotent (live already 0 on a
+        # second shutdown).
+        _memscope.pool_close(self._scope_name, _memscope.MEM_POOL_KV)
+        _memscope.drop_scope(self._scope_name)
 
     def _drain_terminated(self):  # tpulint: disable=TPU002,TPU009 - engine-loop thread is the sole mutator of slot state
         """Terminate every queued/active request (no thread will serve
@@ -764,6 +794,8 @@ class GenerationEngine:
                 raise RuntimeError(
                     f"generation engine failed: {self._broken}"
                 )
+            self._req_seq += 1
+            req.mem_owner = f"{self._scope_name}.r{self._req_seq}"
             self._admit.put(req)
             if self._thread is None or not self._thread.is_alive():
                 self._thread = threading.Thread(
@@ -787,9 +819,23 @@ class GenerationEngine:
         (False only on shutdown/broken paths where no further dispatch
         will happen and the device may be unusable.)
         """
-        for bid in self._slot_blocks[slot]:
-            self._prefix.release_block(bid)
+        req = self._slot_req[slot]
+        owner = req.mem_owner if req is not None else ""
+        if owner:
+            _memscope.push_owner(owner)
+        try:
+            for bid in self._slot_blocks[slot]:
+                self._prefix.release_block(bid)
+        finally:
+            if owner:
+                _memscope.pop_owner()
         self._slot_blocks[slot] = []
+        if owner:
+            # Reconciliation point: the request's pages are back, so its
+            # ledger bytes must be exactly zero — nonzero residue is a
+            # leak (TPU012 finding under the sanitizer).
+            _memscope.owner_finish(self._scope_name,
+                                   _memscope.MEM_POOL_KV, owner)
         if device_reset:
             self._btabs = self._btabs.at[slot].set(
                 jnp.zeros((self._max_blocks,), jnp.int32)
@@ -831,22 +877,41 @@ class GenerationEngine:
             hashes.append(h)
         blocks: List[int] = []
         n_hit = 0
-        for hk in hashes:
-            bid = self._prefix.match(hk)
-            if bid is None:
-                break
-            blocks.append(bid)
-            n_hit += 1
-        ok = True
-        for _ in range(n_total - n_hit):
-            bid = self._alloc_block()
-            if bid is None:
-                ok = False
-                break
-            blocks.append(bid)
+        # Memscope attribution bracket: every page granted (fresh or
+        # shared hit) inside it is charged to this request's owner
+        # token; a rollback discharges symmetrically.
+        owner = req.mem_owner
+        if owner:
+            _memscope.owner_begin(
+                self._scope_name, _memscope.MEM_POOL_KV, owner,
+                prompt_len=int(l), max_new=int(req.max_new),
+                pages=int(n_total),
+            )
+            _memscope.push_owner(owner)
+        try:
+            for hk in hashes:
+                bid = self._prefix.match(hk)
+                if bid is None:
+                    break
+                blocks.append(bid)
+                n_hit += 1
+            ok = True
+            for _ in range(n_total - n_hit):
+                bid = self._alloc_block()
+                if bid is None:
+                    ok = False
+                    break
+                blocks.append(bid)
+            if not ok:
+                for bid in blocks:
+                    self._prefix.release_block(bid)
+        finally:
+            if owner:
+                _memscope.pop_owner()
         if not ok:
-            for bid in blocks:
-                self._prefix.release_block(bid)
+            if owner:
+                _memscope.owner_discard(self._scope_name,
+                                        _memscope.MEM_POOL_KV, owner)
             return None
         # Events count once per COMMITTED admission (never per blocked
         # retry): every matchable block is either a hit or a miss.
@@ -854,6 +919,17 @@ class GenerationEngine:
             self._prefix.count(PREFIX_EVENT_HIT, n_hit)
         if len(hashes) - n_hit:
             self._prefix.count(PREFIX_EVENT_MISS, len(hashes) - n_hit)
+        req.kv_pages_held = n_total
+        if req.cancel_event is not None:
+            # Pages-held side channel to the core's shed/cancel
+            # finalization, exactly like steps_completed in _deliver.
+            try:
+                req.cancel_event.kv_pages_held = n_total
+                req.cancel_event.kv_bytes_held = (
+                    n_total * self._block_kv_bytes
+                )
+            except AttributeError:
+                pass
         st = _PrefillState(req, l, blocks, n_hit, hashes)
         st.next = n_hit * bs
         return st
@@ -1479,6 +1555,20 @@ class GptEngineModel(Model):
                                        block_size=block_size,
                                        n_blocks=n_blocks,
                                        prefill_chunk=prefill_chunk)
+
+    def estimate_request_bytes(self, input_shapes):
+        """KV page reservation this request will hold: the engine's
+        admission formula ``ceil((prompt + max_new) / block_size)``
+        pages at block_kv_bytes each (max_new estimated at infer's
+        default of 16 — MAX_TOKENS data is not resolved at stamp time).
+        """
+        shape = input_shapes.get("INPUT_IDS")
+        if not shape:
+            return None
+        length = int(shape[-1])
+        e = self.engine
+        n = min(-(-(length + 16) // e.block_size), e._max_blocks)
+        return int(n * e._block_kv_bytes)
 
     def infer(self, inputs, parameters=None) -> Iterator[dict]:
         prompt = np.asarray(inputs["INPUT_IDS"], dtype=np.int32)

@@ -132,6 +132,11 @@ class ResNet50Model(Model):
         self.outputs = [TensorSpec("OUTPUT", "FP32", [-1, num_classes])]
         self.labels = labels or [f"class_{i}" for i in range(num_classes)]
         self._params = init_params(jax.random.PRNGKey(seed))
+        # Parameter bytes on the device-memory ledger (per-device, from
+        # the actual shardings).
+        from tritonclient_tpu import _memscope
+
+        _memscope.register_params(self.name, self._params)
 
         @jax.jit
         def fwd(params, images):

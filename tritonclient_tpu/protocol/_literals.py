@@ -289,6 +289,63 @@ OVERLAP_KINDS = (
     OVERLAP_KIND_HIDDEN,
 )
 
+# --------------------------------------------------------------------------- #
+# device-memory vocabulary (memscope)                                         #
+# --------------------------------------------------------------------------- #
+
+#: ``pool`` label values of ``nv_device_memory_bytes`` /
+#: ``nv_device_memory_events_total``: which device-resident byte
+#: population a ledger row accounts. ``kv`` = paged KV block pools,
+#: ``params`` = model parameters (per-device bytes from the actual
+#: jax.Array shardings), ``shm`` = registered shared-memory regions
+#: (system + TPU device buffers), ``scratch`` = engine slot-state /
+#: scratch buffers. Spelled here exactly once (enforced by TPU008):
+#: dashboards and the exposition checker match on these strings, and a
+#: ledger reporting pool X while the exposition renders pool Y silently
+#: zeroes the occupancy panel.
+MEM_POOL_KV = "kv"
+MEM_POOL_PARAMS = "params"
+MEM_POOL_SHM = "shm"
+MEM_POOL_SCRATCH = "scratch"
+MEM_POOLS = (
+    MEM_POOL_KV,
+    MEM_POOL_PARAMS,
+    MEM_POOL_SHM,
+    MEM_POOL_SCRATCH,
+)
+
+#: ``kind`` label values of ``nv_device_memory_bytes``: ``live`` =
+#: bytes resident right now (parked prefix-cache pages included —
+#: they occupy HBM), ``peak`` = high-water mark of live since reset,
+#: ``reserved`` = sum of per-request reservations
+#: (``ceil((prompt+max_new)/block_size)`` pages each; shared prefix
+#: pages count once per holder, so ``reserved`` above ``live`` is the
+#: sharing win, not an error).
+MEM_KIND_LIVE = "live"
+MEM_KIND_PEAK = "peak"
+MEM_KIND_RESERVED = "reserved"
+MEM_KINDS = (
+    MEM_KIND_LIVE,
+    MEM_KIND_PEAK,
+    MEM_KIND_RESERVED,
+)
+
+#: ``event`` label values of ``nv_device_memory_events_total``:
+#: ``alloc`` = bytes granted (fresh page, cache-hit grant, region
+#: registration, params load), ``free`` = bytes returned, ``park`` =
+#: zero-ref prefix-cache pages parked evictable (still live), ``evict``
+#: = parked pages reclaimed to satisfy an allocation.
+MEM_EVENT_ALLOC = "alloc"
+MEM_EVENT_FREE = "free"
+MEM_EVENT_PARK = "park"
+MEM_EVENT_EVICT = "evict"
+MEM_EVENTS = (
+    MEM_EVENT_ALLOC,
+    MEM_EVENT_FREE,
+    MEM_EVENT_PARK,
+    MEM_EVENT_EVICT,
+)
+
 #: Server-internal parameter key carrying a request's ``cancel_event``
 #: into engine-backed models (gpt/tp engines poll it between decode
 #: steps). Never on the wire: the front-ends strip/never accept it, and
@@ -342,6 +399,11 @@ EP_TRACE_SETTING = "v2/trace/setting"
 #: sliding window plus every error/deadline miss. ``?format=perfetto``
 #: renders the retained records as Chrome trace-event JSON.
 EP_FLIGHT_RECORDER = "v2/debug/flight_recorder"
+#: Device-memory ledger dump (memscope): the self-describing document
+#: ``scripts/mem_report.py`` loads — per-(model, pool) live/peak/
+#: reserved bytes, the alloc/free event ring, per-owner residue, and
+#: headroom. Served by both front-ends.
+EP_DEBUG_MEMSCOPE = "v2/debug/memscope"
 #: Raw per-model/per-stage DDSketch state (replica-side): the fleet
 #: router's prober fetches these each scrape tick so fleetscope can
 #: merge quantiles EXACTLY (bucket-wise) instead of pooling resolved

@@ -119,6 +119,8 @@ RPC_METHODS = {
     # Debug surface (raw JSON payloads; see RawJsonMessage above): the
     # gRPC analog of the HTTP v2/debug/flight_recorder endpoint.
     "FlightRecorder": ("unary", RawJsonMessage, RawJsonMessage),
+    # Device-memory ledger dump: the gRPC analog of GET v2/debug/memscope.
+    "Memscope": ("unary", RawJsonMessage, RawJsonMessage),
     # Fleet drain control: the gRPC analog of POST v2/fleet/drain. The
     # request payload is ``{"drain": true|false}`` (empty = status only);
     # the response is the readiness-detail document.

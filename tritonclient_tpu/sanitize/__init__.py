@@ -2,7 +2,7 @@
 
 tpulint (``tritonclient_tpu/analysis``) proves lock-order, shm-lifecycle,
 and async-blocking discipline *statically*; tpusan closes the loop by
-watching the same invariants under real execution. Three witnesses, each
+watching the same invariants under real execution. Four witnesses, most
 paired with a static rule:
 
 =======  ====================  ===============================================
@@ -29,6 +29,13 @@ TPU009   lockset (races)       empty candidate lockset on a field touched by
                                over the named locks at explicit
                                ``note_field_access`` adoption sites
                                (``_races.py``)
+TPU012   mem-reconcile         the memscope ledger's reconciliation
+                               invariant: a finished/shed/cancelled
+                               request whose per-owner device-memory
+                               bytes did not return to zero — the
+                               finding carries the allocation-site AND
+                               leak-site stacks (``_mem.py``; dynamic-
+                               only, no static pair)
 =======  ====================  ===============================================
 
 Activation: ``TPUSAN=1`` in the environment (the test suite's
@@ -121,6 +128,14 @@ RULES_META = [
             "access (Eraser refinement over the named locks)"
         },
     },
+    {
+        "id": "TPU012",
+        "name": "mem-reconcile",
+        "shortDescription": {
+            "text": "device-memory ledger leak: a finished/shed/"
+            "cancelled request's memscope bytes did not return to zero"
+        },
+    },
 ]
 
 
@@ -169,7 +184,7 @@ def enable(mode: Optional[str] = None):
     :class:`TpusanError` at the violation). Defaults to ``TPUSAN_MODE``,
     then ``TPUSAN=strict``, then ``report``.
     """
-    from tritonclient_tpu.sanitize import _aio, _blocking, _shm
+    from tritonclient_tpu.sanitize import _aio, _blocking, _mem, _shm
 
     with _STATE.lock:
         _STATE.depth += 1
@@ -189,11 +204,12 @@ def enable(mode: Optional[str] = None):
         _blocking.install()
         _shm.install()
         _aio.install()
+        _mem.install()
 
 
 def disable():
     """Deactivate and unpatch once every :func:`enable` is balanced."""
-    from tritonclient_tpu.sanitize import _aio, _blocking, _shm
+    from tritonclient_tpu.sanitize import _aio, _blocking, _mem, _shm
 
     with _STATE.lock:
         _STATE.depth = max(0, _STATE.depth - 1)
@@ -203,12 +219,13 @@ def disable():
     _aio.uninstall()
     _shm.uninstall()
     _blocking.uninstall()
+    _mem.uninstall()
 
 
 def reset():
     """Drop recorded findings and witness state (locks graph, shm states,
     field locksets)."""
-    from tritonclient_tpu.sanitize import _locks, _races, _shm
+    from tritonclient_tpu.sanitize import _locks, _mem, _races, _shm
 
     with _STATE.lock:
         _STATE.records.clear()
@@ -216,6 +233,7 @@ def reset():
     _locks.reset()
     _races.reset()
     _shm.reset()
+    _mem.reset()
 
 
 def _project_site(skip_sanitize: bool = True):

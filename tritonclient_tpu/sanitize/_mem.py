@@ -1,0 +1,89 @@
+"""Device-memory reconciliation witness (rule TPU012).
+
+The fourth runtime witness, alongside locks (``_locks``/``_races``),
+shared memory (``_shm``) and the event loop (``_blocking``/``_aio``):
+it pairs with the memscope ledger (``tritonclient_tpu._memscope``)
+rather than a static lint — the reconciliation invariant ("after any
+request finishes, sheds, or cancels, the ledger's live bytes for that
+request return to zero") is only checkable on *real* allocation
+traffic.
+
+Protocol:
+
+* ``_memscope.owner_begin`` calls :func:`note_alloc` — the allocation
+  site stack is captured here, keyed by ``(scope, pool, owner)``;
+* ``_memscope.owner_finish`` calls :func:`report_leak` when the owner's
+  ledger bytes are nonzero — the finding carries BOTH the allocation
+  stack and the leak-site stack (``report_finding`` appends the current
+  site automatically);
+* :func:`drop_alloc` forgets a cleanly-reconciled owner's stack.
+
+Events only fire while the sanitizer is active; the stack table is
+bounded by in-flight owners (every terminal path drops its key).
+"""
+
+import threading
+import traceback
+from typing import Dict, Tuple
+
+_LOCK = threading.Lock()
+#: (scope, pool, owner) -> allocation-site stack text.
+_ALLOC_STACKS: Dict[Tuple[str, str, str], str] = {}
+_installed = False
+
+
+def _active() -> bool:
+    from tritonclient_tpu import sanitize
+
+    return sanitize.enabled() and _installed
+
+
+# tpulint: disable=TPU009 - benign single-rebind mode publication
+def install():
+    global _installed
+    _installed = True
+
+
+def uninstall():
+    global _installed
+    _installed = False
+
+
+def reset():
+    with _LOCK:
+        _ALLOC_STACKS.clear()
+
+
+def note_alloc(key: Tuple[str, str, str]):
+    """Record the allocation site of an owner's reservation."""
+    if not _active():
+        return
+    stack = "".join(traceback.format_list(traceback.extract_stack()[-12:]))
+    with _LOCK:
+        _ALLOC_STACKS[key] = stack
+
+
+def drop_alloc(key: Tuple[str, str, str]):
+    with _LOCK:
+        _ALLOC_STACKS.pop(key, None)
+
+
+def report_leak(scope: str, pool: str, owner: str, nbytes: int):
+    """An owner finished with nonzero ledger bytes: a page left the pool
+    without leaving the ledger (or vice versa). The message is
+    deterministic per (scope, pool, owner) so the fingerprint is stable
+    across runs."""
+    if not _active():
+        return
+    from tritonclient_tpu import sanitize
+
+    with _LOCK:
+        alloc_stack = _ALLOC_STACKS.get((scope, pool, owner))
+    stacks = [alloc_stack] if alloc_stack else None
+    sanitize.report_finding(
+        "TPU012",
+        f"device-memory ledger leak: owner '{owner}' finished holding "
+        f"{int(nbytes)} bytes in pool {scope}/{pool} (allocation and "
+        "leak-site stacks attached)",
+        stacks=stacks,
+    )

@@ -21,7 +21,7 @@ from typing import Dict, Iterator, List, Optional, Union
 
 import numpy as np
 
-from tritonclient_tpu import _kvcache, _stepscope, sanitize
+from tritonclient_tpu import _kvcache, _memscope, _stepscope, sanitize
 from tritonclient_tpu._sketch import LatencySketch
 from tritonclient_tpu._tracing import (
     FlightRecorder,
@@ -199,6 +199,12 @@ class SystemShmRegistry:
                 "mmap": mm,
             }
             self.generation += 1
+        # Registered region bytes on the device-memory ledger (server scope,
+        # shm pool). "sys:" keys the host-mapped plane apart from "tpu:".
+        _memscope.set_static(
+            _memscope.SCOPE_SERVER, _memscope.MEM_POOL_SHM, "sys:" + name,
+            int(byte_size), {"key": key},
+        )
         if old is not None:
             try:
                 old["mmap"].close()
@@ -211,11 +217,13 @@ class SystemShmRegistry:
         return name in self._regions  # tpulint: disable=TPU002
 
     def unregister(self, name: Optional[str]):
+        removed = []
         with self._lock:
             names = [name] if name else list(self._regions)
             for n in names:
                 region = self._regions.pop(n, None)
                 if region is not None:
+                    removed.append(n)
                     try:
                         region["mmap"].close()
                     except BufferError:
@@ -226,6 +234,10 @@ class SystemShmRegistry:
                         # registered with the generation un-bumped.
                         pass
             self.generation += 1
+        for n in removed:
+            _memscope.clear_static(
+                _memscope.SCOPE_SERVER, _memscope.MEM_POOL_SHM, "sys:" + n
+            )
 
     def status(self, name: Optional[str] = None) -> List[dict]:
         with self._lock:
@@ -298,6 +310,12 @@ class TpuShmRegistry:
                 "region": region,
             }
             self.generation += 1
+        # Registered DEVICE-buffer bytes on the ledger: this is the pool the
+        # memscope shm family actually measures on hardware.
+        _memscope.set_static(
+            _memscope.SCOPE_SERVER, _memscope.MEM_POOL_SHM, "tpu:" + name,
+            int(byte_size), {"device_id": int(device_id)},
+        )
 
     def __contains__(self, name: str) -> bool:
         # GIL-atomic dict membership; safe without the lock on the hot path.
@@ -306,10 +324,15 @@ class TpuShmRegistry:
     def unregister(self, name: Optional[str]):
         with self._lock:
             if name:
-                self._regions.pop(name, None)
+                removed = [name] if self._regions.pop(name, None) else []
             else:
+                removed = list(self._regions)
                 self._regions.clear()
             self.generation += 1
+        for n in removed:
+            _memscope.clear_static(
+                _memscope.SCOPE_SERVER, _memscope.MEM_POOL_SHM, "tpu:" + n
+            )
 
     def status(self, name: Optional[str] = None) -> List[dict]:
         with self._lock:
@@ -448,6 +471,11 @@ class _ModelStats:
         # Requests admitted (infer()/infer_submit()) but not yet answered:
         # the queue-depth gauge. Returns to 0 when the server is idle.
         self.pending = 0
+        # Requests admitted whose estimated device bytes exceeded the
+        # model's memscope headroom at that instant. Observation only —
+        # nothing is rejected — the nv_inference_headroom_near_miss_total
+        # counter family (see _stamp_headroom).
+        self.headroom_near_miss = 0
 
     def observe_duration(self, duration_ns: int):
         us = duration_ns // 1000
@@ -933,6 +961,14 @@ class _DynamicBatcher:
                 # started a decode loop, so the attribute defaults to 0.
                 trace.set_attribute("steps_completed", int(getattr(
                     request.cancel_event, "steps_completed", 0) or 0))
+                # KV pages the request was holding when it died: engines
+                # mirror the committed reservation onto the cancel event
+                # (gpt_engine._reserve). Queued-never-started requests
+                # held nothing, so the attributes default to 0.
+                trace.set_attribute("kv_pages_held", int(getattr(
+                    request.cancel_event, "kv_pages_held", 0) or 0))
+                trace.set_attribute("kv_bytes_held", int(getattr(
+                    request.cancel_event, "kv_bytes_held", 0) or 0))
             waited_us = max((now_ns - slot.t_enqueue) // 1000, 0)
             if reason == SHED_REASON_CANCELLED:
                 slot.error = CoreError(
@@ -1479,6 +1515,9 @@ class InferenceCore:
             if name not in self._repository:
                 raise CoreError(f"failed to unload '{name}', no such model", 400)
             self._loaded[name] = False
+        # Retire the model's param/scratch ledger rows; the KV pool closes
+        # itself via engine.shutdown() when the engine is torn down.
+        _memscope.drop_scope(name)
 
     def prometheus_metrics(self) -> str:
         """Triton-compatible Prometheus exposition (the server repo's
@@ -1786,6 +1825,62 @@ class InferenceCore:
                 f'{metric}{{model="{esc(name)}",version="{esc(version)}"}} '
                 f"{age}"
             )
+        # Device-memory ledger families (tritonclient_tpu._memscope): live
+        # vs peak vs reserved bytes per (model, pool), the alloc/free/park/
+        # evict event counters, and the admission headroom gauge. Headers
+        # always render (stable family set); rows appear per ledger cell,
+        # and every canonical event renders per cell (zeros included) so
+        # churn rates are computable from any single scrape.
+        mem_rows = _memscope.metrics_rows()
+        metric = _memscope.MEM_BYTES_METRIC
+        lines.append(
+            f"# HELP {metric} Accelerator memory bytes on the device-"
+            "memory ledger, by pool and kind (live = resident now, peak "
+            "= high-water of live, reserved = sum of per-request "
+            "reservations; reserved > live measures prefix sharing)"
+        )
+        lines.append(f"# TYPE {metric} gauge")
+        for sname, pool, kind, value in mem_rows["bytes"]:
+            lines.append(
+                f'{metric}{{model="{esc(sname)}",pool="{pool}"'
+                f',kind="{kind}"}} {value}'
+            )
+        metric = _memscope.MEM_EVENTS_METRIC
+        lines.append(
+            f"# HELP {metric} Number of device-memory ledger events, by "
+            "pool and event (alloc/free move live bytes, park/evict move "
+            "prefix-cache parked bytes)"
+        )
+        lines.append(f"# TYPE {metric} counter")
+        for sname, pool, event, count in mem_rows["events"]:
+            lines.append(
+                f'{metric}{{model="{esc(sname)}",pool="{pool}"'
+                f',event="{event}"}} {count}'
+            )
+        metric = _memscope.MEM_HEADROOM_METRIC
+        lines.append(
+            f"# HELP {metric} Device memory bytes grantable to a new "
+            "request before the model's KV pool is exhausted (parked "
+            "prefix-cache bytes count as grantable)"
+        )
+        lines.append(f"# TYPE {metric} gauge")
+        for sname, value in mem_rows["headroom"]:
+            lines.append(f'{metric}{{model="{esc(sname)}"}} {value}')
+        # Admission near-miss counter: requests whose shape-derived byte
+        # estimate exceeded the headroom gauge at admission (observation
+        # only; see _stamp_headroom).
+        metric = "nv_inference_headroom_near_miss_total"
+        lines.append(
+            f"# HELP {metric} Number of admitted inference requests "
+            "whose estimated device bytes exceeded the model's memory "
+            "headroom at admission (observation only, nothing rejected)"
+        )
+        lines.append(f"# TYPE {metric} counter")
+        for name, version, stats in rows:
+            lines.append(
+                f'{metric}{{model="{esc(name)}",version="{esc(version)}"}} '
+                f"{stats.headroom_near_miss}"
+            )
         # Shared-memory registration gauges (system + tpu planes).
         metric = "nv_shared_memory_region_count"
         lines.append(
@@ -1841,6 +1936,13 @@ class InferenceCore:
                     and self._loaded.get(name, False)
                 },
             }
+
+    def memscope_dump(self) -> dict:
+        """Raw device-memory ledger state (GET v2/debug/memscope):
+        per-(scope, pool) cells with live/peak/reserved/parked bytes,
+        per-owner reservations, static entries, recorded leaks, and the
+        monotonic alloc/free event ring. mem_report.py consumes this."""
+        return _memscope.dump()
 
     # -- trace / log settings ------------------------------------------------
 
@@ -2012,6 +2114,38 @@ class InferenceCore:
         return int(override.get("max_batch_size",
                                 getattr(model, "max_batch_size", 0)))
 
+    def _stamp_headroom(self, model, request: CoreRequest, stats):
+        """Observation-only headroom check at admission.
+
+        Asks the model to cost the request from its input SHAPES (no data
+        is resolved) and compares against the memscope headroom gauge for
+        the model's KV pool. Admitted requests whose estimate exceeds the
+        headroom are stamped ``would_exceed_headroom`` on their trace and
+        counted in nv_inference_headroom_near_miss_total — this PR ships
+        the signal, not an admission policy.
+        """
+        if not _memscope.enabled():
+            return
+        try:
+            estimate = model.estimate_request_bytes(
+                {t.name: list(t.shape) for t in request.inputs}
+            )
+        except Exception:  # a cost model must never fail a request
+            return
+        if estimate is None:
+            return
+        headroom = _memscope.headroom(model.name)
+        if headroom is None:
+            return
+        trace = request.trace
+        if trace is not None:
+            trace.set_attribute("mem.estimated_bytes", int(estimate))
+        if estimate > headroom:
+            if trace is not None:
+                trace.set_attribute("would_exceed_headroom", True)
+            with self._lock:
+                stats.headroom_near_miss += 1
+
     def infer(
         self, request: CoreRequest
     ) -> Union[CoreResponse, Iterator[CoreResponse]]:
@@ -2020,6 +2154,7 @@ class InferenceCore:
             stats = self._stats[request.model_name]
             batcher = self._batchers.get(request.model_name)
             stats.pending += 1
+        self._stamp_headroom(model, request, stats)
         if self._log_verbose >= 1:
             self._log.debug(
                 "infer model=%s version=%s id=%s inputs=%d",
@@ -2056,6 +2191,9 @@ class InferenceCore:
         if batcher is not None and getattr(model, "dynamic_batching", False):
             cap = self._effective_max_batch(model)
             if batcher.eligible(request, cap):
+                # Fallback (return None) re-enters infer(), which stamps —
+                # so stamp only the path that terminates here.
+                self._stamp_headroom(model, request, stats)
                 slot = batcher.submit(model, request, stats, cap)
                 with self._lock:
                     stats.pending += 1
@@ -2397,6 +2535,12 @@ class InferenceCore:
                         "steps_completed",
                         count if steps is None else int(steps),
                     )
+                    # Pages held at death (mirrored by gpt_engine._reserve)
+                    # so tail_report's shed rows carry a memory column.
+                    trace.set_attribute("kv_pages_held", int(getattr(
+                        request.cancel_event, "kv_pages_held", 0) or 0))
+                    trace.set_attribute("kv_bytes_held", int(getattr(
+                        request.cancel_event, "kv_bytes_held", 0) or 0))
                 with self._lock:
                     stats.inference_count += 1
                     stats.execution_count += count

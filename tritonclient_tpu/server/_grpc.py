@@ -578,6 +578,11 @@ class _Servicer:
             body = json.dumps(self.core.flight_recorder.dump())
         return RawJsonMessage(body.encode())
 
+    def Memscope(self, request, context):
+        """Dump the device-memory ledger (raw-JSON debug RPC; the gRPC
+        analog of GET v2/debug/memscope). mem_report.py consumes this."""
+        return RawJsonMessage(json.dumps(self.core.memscope_dump()).encode())
+
     def Drain(self, request, context):
         """Fleet drain control (raw-JSON RPC; the gRPC analog of POST
         v2/fleet/drain). Payload ``{"drain": true|false}``; empty or
@@ -1069,7 +1074,7 @@ class _AioServicer:
             "CudaSharedMemoryRegister", "CudaSharedMemoryUnregister",
             "TpuSharedMemoryStatus", "TpuSharedMemoryRegister",
             "TpuSharedMemoryUnregister", "TraceSetting", "LogSettings",
-            "FlightRecorder", "Drain",
+            "FlightRecorder", "Memscope", "Drain",
         ):
             setattr(self, name, self._wrap_unary(getattr(self._sync, name)))
 

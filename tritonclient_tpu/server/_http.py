@@ -20,6 +20,7 @@ from typing import List, Optional
 import numpy as np
 
 from tritonclient_tpu.protocol._literals import (
+    EP_DEBUG_MEMSCOPE,
     EP_DEBUG_SKETCHES,
     EP_FLEET_DRAIN,
     EP_FLIGHT_RECORDER,
@@ -339,6 +340,9 @@ class _Handler(BaseHTTPRequestHandler):
         if path == EP_DEBUG_SKETCHES:
             self._read_body()
             return self._send_json(core.sketches_dump())
+        if path == EP_DEBUG_MEMSCOPE:
+            self._read_body()
+            return self._send_json(core.memscope_dump())
 
         if path == EP_REPOSITORY_INDEX:
             body = self._read_body()

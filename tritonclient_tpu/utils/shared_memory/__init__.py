@@ -129,6 +129,13 @@ def create_shared_memory_region(
     _check(code)
     region = SharedMemoryRegion(triton_shm_name, shm_key, byte_size, handle)
     _mapped_regions[triton_shm_name] = shm_key
+    # Mapped bytes on the device-memory ledger (client scope, shm pool).
+    from tritonclient_tpu import _memscope
+
+    _memscope.set_static(
+        _memscope.SCOPE_CLIENT, _memscope.MEM_POOL_SHM,
+        "sys:" + triton_shm_name, int(byte_size), {"key": shm_key},
+    )
     return region
 
 
@@ -223,3 +230,9 @@ def destroy_shared_memory_region(shm_handle: SharedMemoryRegion):
         # of the same handle is a no-op rather than a double-free.
         _check(_get_lib().TpuShmRegionDestroy(handle))
     _mapped_regions.pop(shm_handle.triton_shm_name, None)
+    from tritonclient_tpu import _memscope
+
+    _memscope.clear_static(
+        _memscope.SCOPE_CLIENT, _memscope.MEM_POOL_SHM,
+        "sys:" + shm_handle.triton_shm_name,
+    )

@@ -843,6 +843,14 @@ def create_shared_memory_region(
     region = TpuSharedMemoryRegion(triton_shm_name, byte_size, device_id)
     with _registry_lock:
         _registry[region.uuid] = region
+    # Device-buffer bytes on the memory ledger (client scope, shm pool).
+    # Keyed by uuid — region NAMES may repeat across re-creates.
+    from tritonclient_tpu import _memscope
+
+    _memscope.set_static(
+        _memscope.SCOPE_CLIENT, _memscope.MEM_POOL_SHM, "tpu:" + region.uuid,
+        int(byte_size), {"name": triton_shm_name, "device_id": int(device_id)},
+    )
     return region
 
 
@@ -861,6 +869,13 @@ def create_sharded_memory_region(
     )
     with _registry_lock:
         _registry[region.uuid] = region
+    from tritonclient_tpu import _memscope
+
+    _memscope.set_static(
+        _memscope.SCOPE_CLIENT, _memscope.MEM_POOL_SHM, "tpu:" + region.uuid,
+        int(byte_size),
+        {"name": triton_shm_name, "devices": len(region.devices)},
+    )
     return region
 
 
@@ -1026,3 +1041,9 @@ def destroy_shared_memory_region(shm_handle: TpuSharedMemoryRegion):
         shm_handle._destroyed = True
         shm_handle._parked.clear()
         shm_handle._mirror = bytearray(0)
+    from tritonclient_tpu import _memscope
+
+    _memscope.clear_static(
+        _memscope.SCOPE_CLIENT, _memscope.MEM_POOL_SHM,
+        "tpu:" + shm_handle.uuid,
+    )
