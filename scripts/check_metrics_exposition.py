@@ -24,6 +24,13 @@ Checks (exit 1 with one line per violation):
     the {model, version, reason} label set with ``reason`` drawn from the
     canonical shed vocabulary, and all three reasons are present per
     (model, version) series so reason sums are well-defined
+  * the ``nv_inference_invalid_request_total`` family (PR 19): exactly
+    {model, version, reason} with ``reason`` drawn from the canonical
+    invalid-request vocabulary (``protocol._literals.INVALID_REASONS``)
+    and EVERY reason row rendered per (model, version) series (zeros
+    included) — rejection-rate dashboards must never guess
+    absent-as-zero, and a non-canonical reason means a front-end
+    bypassed ``protocol/_validate``
   * the fleet-router families: ``nv_fleet_tenant_quota_rejections_total``
     carries exactly {tenant, reason} with canonical quota reasons and
     every reason row present per tenant;
@@ -81,6 +88,7 @@ if _REPO_ROOT not in sys.path:
 try:
     from tritonclient_tpu.protocol._literals import (
         HEDGE_OUTCOMES,
+        INVALID_REASONS,
         QUOTA_REASONS,
         RETRY_REASONS,
         SHED_REASONS,
@@ -90,6 +98,8 @@ except ImportError:  # standalone copy of the script: keep it usable
     QUOTA_REASONS = ("rate", "concurrency", "pressure")
     RETRY_REASONS = ("connect", "send", "status", "idempotent")
     HEDGE_OUTCOMES = ("primary", "hedge", "failed")
+    INVALID_REASONS = ("malformed", "invalid_shape", "invalid_dtype",
+                       "data_mismatch", "shm_bounds", "too_large")
 
 try:
     from tritonclient_tpu._stepscope import STEP_PHASES, STEP_STAGES
@@ -128,6 +138,10 @@ except ImportError:  # standalone copy of the script: keep it usable
     MEM_EVENTS = ("alloc", "free", "park", "evict")
 
 _SHED_FAMILY = "nv_inference_shed_total"
+# Invalid-request counter (PR 19): boundary-validation rejections with
+# the same stable-label-set discipline as the shed counter — canonical
+# reasons only, every reason row rendered per (model, version).
+_INVALID_FAMILY = "nv_inference_invalid_request_total"
 # Fleet-router families (served by the router's own /metrics): same
 # stable-label-set discipline as the shed counter.
 _QUOTA_FAMILY = "nv_fleet_tenant_quota_rejections_total"
@@ -301,6 +315,43 @@ def check_exposition(text: str) -> List[str]:
                     ).add(labels["reason"])
                 for (model, version), reasons in series_reasons.items():
                     missing = [r for r in SHED_REASONS if r not in reasons]
+                    if missing:
+                        errors.append(
+                            f'{family}{{model="{model}",'
+                            f'version="{version}"}}: missing reason '
+                            f"rows {missing}"
+                        )
+            if family == _INVALID_FAMILY:
+                # Invalid-request contract: fixed {model, version, reason}
+                # label set, reasons drawn from the canonical
+                # INVALID_REASONS vocabulary (a stray reason means a
+                # front-end invented its own classification instead of
+                # going through protocol/_validate), and every reason row
+                # present per series so rejection sums never need
+                # absent-as-zero guessing.
+                series_reasons: Dict[tuple, set] = {}
+                for labels, value, name, lineno in samples.get(family, []):
+                    if set(labels) != {"model", "version", "reason"}:
+                        errors.append(
+                            f"line {lineno}: {family} label set "
+                            f"{sorted(labels)} != "
+                            "['model', 'reason', 'version']"
+                        )
+                        continue
+                    if labels["reason"] not in INVALID_REASONS:
+                        errors.append(
+                            f"line {lineno}: {family} reason "
+                            f"{labels['reason']!r} not in "
+                            f"{list(INVALID_REASONS)}"
+                        )
+                        continue
+                    series_reasons.setdefault(
+                        (labels["model"], labels["version"]), set()
+                    ).add(labels["reason"])
+                for (model, version), reasons in series_reasons.items():
+                    missing = [
+                        r for r in INVALID_REASONS if r not in reasons
+                    ]
                     if missing:
                         errors.append(
                             f'{family}{{model="{model}",'

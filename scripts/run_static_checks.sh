@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Single static-analysis entry point shared by CI and tier-1.
 #
-#   scripts/run_static_checks.sh [--write-baseline] [--sanitize] [--modelcheck] [--changed] [paths...]
+#   scripts/run_static_checks.sh [--write-baseline] [--sanitize] [--modelcheck] [--fuzz] [--changed] [paths...]
 #
 # --changed is the pre-commit fast path: tpulint lints only git-touched
 # files against the cached whole-program call graph (<2 s warm), and the
@@ -17,6 +17,12 @@
 # harness models explored under the bounded-preemption schedule
 # enumerator, each capped at 60 s wall clock. Deterministic (seeded DFS)
 # — any finding prints a replay trace and fails the check.
+#
+# --fuzz runs tpufuzz (scripts/tpufuzz.py): the seeded protocol fuzzer
+# drives 500 mutated KServe v2 requests per plane (committed corpus,
+# fixed seed) at a live in-process server under TPUSAN=1, asserting
+# no-500/no-hang/no-leak, then re-runs and byte-compares the two
+# reports — any nondeterminism or contract violation fails the check.
 #
 # Chains, in order:
 #   1. tpulint        — project-specific checks (TPU001..TPU010, incl. the
@@ -52,12 +58,14 @@ BASELINE_FILE="scripts/tpulint_baseline.json"
 WRITE_BASELINE=0
 SANITIZE=0
 MODELCHECK=0
+FUZZ=0
 CHANGED=0
 while :; do
     case "${1:-}" in
         --write-baseline) WRITE_BASELINE=1; shift ;;
         --sanitize) SANITIZE=1; shift ;;
         --modelcheck) MODELCHECK=1; shift ;;
+        --fuzz) FUZZ=1; shift ;;
         --changed) CHANGED=1; shift ;;
         *) break ;;
     esac
@@ -162,6 +170,29 @@ if [ "${MODELCHECK}" -eq 1 ]; then
     TPUMC_OUT="${TPUMC_REPORT:-/tmp/tpumc_report.json}"
     run_check "tpumc" env JAX_PLATFORMS=cpu "${PYTHON}" scripts/tpumc.py \
         --seed 0 --deadline-s 60 --json "${TPUMC_OUT}"
+fi
+
+# 7. tpufuzz (opt-in): seeded deterministic protocol fuzzing of both
+#    planes under the runtime sanitizer, twice, with a byte-diff of the
+#    two reports. The fixed seed + committed corpus make the stream
+#    reproducible: any failure prints the case id, which replays with
+#    the same scripts/tpufuzz.py invocation.
+if [ "${FUZZ}" -eq 1 ]; then
+    FUZZ_SEED="${TPUFUZZ_SEED:-20260807}"
+    FUZZ_N="${TPUFUZZ_REQUESTS:-500}"
+    FUZZ_OUT="${TPUFUZZ_REPORT:-/tmp/tpufuzz_report.json}"
+    run_check "tpufuzz-self-check" env JAX_PLATFORMS=cpu \
+        "${PYTHON}" scripts/tpufuzz.py --self-check
+    run_check "tpufuzz" env JAX_PLATFORMS=cpu TPUSAN=1 \
+        "${PYTHON}" scripts/tpufuzz.py --seed "${FUZZ_SEED}" \
+        --requests "${FUZZ_N}" --json "${FUZZ_OUT}" \
+        --sarif "${FUZZ_OUT%.json}.sarif"
+    run_check "tpufuzz-determinism" bash -c "
+        env JAX_PLATFORMS=cpu TPUSAN=1 '${PYTHON}' scripts/tpufuzz.py \
+            --seed '${FUZZ_SEED}' --requests '${FUZZ_N}' \
+            --json '${FUZZ_OUT}.second' >/dev/null \
+        && cmp '${FUZZ_OUT}' '${FUZZ_OUT}.second'
+    "
 fi
 
 if [ "${failures}" -ne 0 ]; then

@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""tpufuzz: seeded deterministic protocol fuzzer for the request plane.
+
+Drives structure-aware mutations of committed KServe v2 corpus seeds at
+a live in-process server over HTTP and gRPC, asserting the
+no-500/no-hang/no-leak contract, and emits a byte-deterministic JSON
+report plus TPU013 SARIF for ``scripts/tpusan_report.py``.
+
+    python scripts/tpufuzz.py --seed 20260807 --requests 500 \
+        --json out/fuzz.json --sarif out/fuzz.sarif
+
+``--self-check`` runs the offline determinism harness (no server, no
+sockets): same-seed stream equality, different-seed divergence,
+per-mutation encodability on both planes, and a SARIF round-trip.
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def _dump(report) -> str:
+    return json.dumps(report, sort_keys=True, indent=2) + "\n"
+
+
+def _self_check() -> int:
+    """Offline determinism harness; returns a process exit code."""
+    import random
+
+    from tritonclient_tpu import fuzz
+    from tritonclient_tpu.analysis._sarif import load_sarif_findings
+    from tritonclient_tpu.fuzz import _run
+
+    failures = []
+    seeds = fuzz.load_corpus()
+    if len(seeds) < 3:
+        failures.append(f"corpus has {len(seeds)} seeds, expected >= 3")
+
+    def stream(seed, n=120):
+        rng = random.Random(seed)
+        return fuzz.generate_specs(
+            seeds, rng, n, ("http", "grpc"),
+            expressible=fuzz.expressible)
+
+    a, b = stream(7), stream(7)
+    if json.dumps(a, sort_keys=True) != json.dumps(b, sort_keys=True):
+        failures.append("same seed produced different mutation streams")
+    c = stream(8)
+    if json.dumps(a, sort_keys=True) == json.dumps(c, sort_keys=True):
+        failures.append("different seeds produced identical streams")
+
+    # Every catalog mutation must be JSON-serializable and must stay
+    # expressible on at least one plane for at least one seed.
+    rng = random.Random(11)
+    for name, (planes, fn) in sorted(fuzz.CATALOG.items()):
+        hit = 0
+        for seed_doc in seeds:
+            for _ in range(8):
+                spec = fn(seed_doc, rng)
+                if spec is None:
+                    continue
+                spec["id"] = "case-check"
+                spec["planes"] = [
+                    p for p in planes if fuzz.expressible(spec, p)]
+                try:
+                    json.dumps(spec, sort_keys=True)
+                except (TypeError, ValueError):
+                    failures.append(
+                        f"mutation {name} produced a non-JSON spec")
+                    break
+                if "http" in spec["planes"]:
+                    try:
+                        _run._http_payload(spec)
+                    except Exception as e:  # pragma: no cover - harness
+                        failures.append(
+                            f"mutation {name} not HTTP-encodable: {e}")
+                        break
+                hit += len(spec["planes"])
+        if hit == 0:
+            failures.append(
+                f"mutation {name} never expressible on any plane")
+
+    # SARIF round-trip: a synthetic failure must survive render+load
+    # with its fingerprint intact.
+    fake = {
+        "failures": [{
+            "case": "case-00000", "plane": "http", "seed": "simple-int32",
+            "mutation": "shape_huge", "outcome": "http-500",
+            "detail": "HTTP 500 (server error)",
+        }],
+    }
+    sarif_text = fuzz.render_sarif(fake)
+    path = os.path.join("/tmp", "tpufuzz_selfcheck.sarif")
+    with open(path, "w") as f:
+        f.write(sarif_text)
+    loaded = load_sarif_findings(path)
+    os.unlink(path)
+    if (len(loaded) != 1 or loaded[0]["rule"] != "TPU013"
+            or loaded[0]["path"] != "tritonclient_tpu/server/_http.py"):
+        failures.append(f"SARIF round-trip mismatch: {loaded}")
+
+    for msg in failures:
+        print(f"tpufuzz --self-check: FAIL: {msg}")
+    if not failures:
+        print(f"tpufuzz --self-check: OK "
+              f"({len(fuzz.CATALOG)} mutations, {len(seeds)} seeds)")
+    return 1 if failures else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tpufuzz", description=__doc__)
+    ap.add_argument("--seed", type=int, default=20260807)
+    ap.add_argument("--requests", type=int, default=500,
+                    help="cases to execute per plane")
+    ap.add_argument("--plane", choices=("http", "grpc", "both"),
+                    default="both")
+    ap.add_argument("--corpus", default=None,
+                    help="seed directory (default: committed corpus)")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="write the deterministic report here")
+    ap.add_argument("--sarif", default=None,
+                    help="write failures as TPU013 SARIF here")
+    ap.add_argument("--self-check", action="store_true",
+                    help="offline determinism harness (no server)")
+    args = ap.parse_args(argv)
+
+    if args.self_check:
+        return _self_check()
+
+    from tritonclient_tpu import fuzz
+
+    planes = ("http", "grpc") if args.plane == "both" else (args.plane,)
+    report = fuzz.run_fuzz(args.seed, args.requests, planes=planes,
+                           corpus_dir=args.corpus)
+    text = _dump(report)
+    if args.json_out:
+        os.makedirs(os.path.dirname(args.json_out) or ".", exist_ok=True)
+        with open(args.json_out, "w") as f:
+            f.write(text)
+    if args.sarif:
+        os.makedirs(os.path.dirname(args.sarif) or ".", exist_ok=True)
+        with open(args.sarif, "w") as f:
+            f.write(fuzz.render_sarif(report))
+
+    digest = hashlib.sha256(text.encode()).hexdigest()
+    executed = ", ".join(
+        f"{p}={n}" for p, n in sorted(report["executed"].items()))
+    print(f"tpufuzz: seed={report['seed']} executed [{executed}] "
+          f"failures={len(report['failures'])} report-sha256={digest[:16]}")
+    for f in report["failures"][:20]:
+        print(f"  {f['case']}:{f['plane']} [{f['mutation']}] {f['detail']}")
+    if len(report["failures"]) > 20:
+        print(f"  ... and {len(report['failures']) - 20} more")
+    return 1 if report["failures"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

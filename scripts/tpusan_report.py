@@ -50,7 +50,7 @@ if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
 DEFAULT_RULES = ("TPU001", "TPU006", "TPU007", "TPU009", "TPU010",
-                 "TPU011")
+                 "TPU011", "TPU013")
 
 
 def load_dynamic(path: str):

@@ -923,7 +923,7 @@ class TestProtocolDrift:
             {
                 "pkg/server/_core.py": """
                     class CoreError(Exception):
-                        def __init__(self, msg, status=400):
+                        def __init__(self, msg, status=500):
                             self.status = status
 
                     def shed(msg):
@@ -946,11 +946,12 @@ class TestProtocolDrift:
                 "pkg/server/_core.py": """
                     from tritonclient_tpu.protocol._literals import (
                         STATUS_CANCELLED,
+                        STATUS_INVALID,
                         STATUS_SHED,
                     )
 
                     class CoreError(Exception):
-                        def __init__(self, msg, status=400):
+                        def __init__(self, msg, status=STATUS_INVALID):
                             self.status = status
 
                     def shed(msg):
@@ -1952,3 +1953,208 @@ class TestBaselineShrinkCoversTPU011:
             json.dumps({"format": "tpulint-baseline", "findings": {}})
         )
         assert mod.main(["--base", "HEAD"]) == 0
+
+
+# --------------------------------------------------------------------------- #
+# TPU013 untrusted-sink (interprocedural taint)                               #
+# --------------------------------------------------------------------------- #
+
+
+class TestUntrustedSink:
+    """Wire-derived values reaching allocation/indexing sinks.
+
+    Taint sources only exist in protocol-boundary files, so fixtures
+    live at ``server/_http.py`` inside the temp tree.
+    """
+
+    def test_fires_on_local_wire_to_alloc_flow(self, tmp_path):
+        findings = lint_tree(tmp_path, {
+            "server/_http.py": """
+                import json
+                import numpy as np
+
+                class Handler:
+                    def infer(self):
+                        js = json.loads(self.rfile.read(10))
+                        return np.zeros(js["shape"])
+            """,
+        }, select=["TPU013"])
+        assert rules_of(findings) == ["TPU013"]
+        assert "alloc-size" in findings[0].message
+        assert "validate_" in findings[0].message
+
+    def test_fires_on_interprocedural_flow(self, tmp_path):
+        findings = lint_tree(tmp_path, {
+            "server/_http.py": """
+                import json
+
+                def _reserve(count):
+                    return bytearray(count)
+
+                class Handler:
+                    def infer(self):
+                        js = json.loads(self.rfile.read(10))
+                        return _reserve(js["count"])
+            """,
+        }, select=["TPU013"])
+        assert set(rules_of(findings)) == {"TPU013"}
+        assert any("_reserve" in f.message for f in findings)
+        # At least one finding spells the source->sink call path.
+        assert any("->" in f.message for f in findings)
+
+    def test_clean_when_sanitized(self, tmp_path):
+        findings = lint_tree(tmp_path, {
+            "server/_http.py": """
+                import json
+                import numpy as np
+
+                from tritonclient_tpu.protocol._validate import validate_shape
+
+                class Handler:
+                    def infer(self):
+                        js = json.loads(self.rfile.read(10))
+                        shape = validate_shape(js["shape"])
+                        return np.zeros(shape)
+            """,
+        }, select=["TPU013"])
+        assert findings == []
+
+    def test_clean_on_guard_bailout(self, tmp_path):
+        findings = lint_tree(tmp_path, {
+            "server/_http.py": """
+                import json
+                import numpy as np
+
+                class Handler:
+                    def infer(self):
+                        js = json.loads(self.rfile.read(10))
+                        n = js["count"]
+                        if n < 0 or n > 1024:
+                            raise ValueError("count out of range")
+                        return np.zeros(n)
+            """,
+        }, select=["TPU013"])
+        assert findings == []
+
+    def test_non_boundary_file_has_no_sources(self, tmp_path):
+        findings = lint_tree(tmp_path, {
+            "engine/_batcher.py": """
+                import json
+                import numpy as np
+
+                class Handler:
+                    def infer(self):
+                        js = json.loads(self.rfile.read(10))
+                        return np.zeros(js["shape"])
+            """,
+        }, select=["TPU013"])
+        assert findings == []
+
+    def test_suppression_comment(self, tmp_path):
+        findings = lint_tree(tmp_path, {
+            "server/_http.py": """
+                import json
+                import numpy as np
+
+                class Handler:
+                    def infer(self):
+                        js = json.loads(self.rfile.read(10))
+                        return np.zeros(js["shape"])  # tpulint: disable=TPU013 -- bounded upstream
+            """,
+        }, select=["TPU013"])
+        assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# TPU014 validation drift                                                     #
+# --------------------------------------------------------------------------- #
+
+
+class TestValidationDrift:
+    def test_fires_when_one_plane_skips_a_validator(self, tmp_path):
+        findings = lint_tree(tmp_path, {
+            "server/_http.py": """
+                from tritonclient_tpu.protocol._validate import validate_shape
+
+                def parse(js):
+                    return validate_shape(js["shape"])
+            """,
+            "server/_grpc.py": """
+                def parse(request, tensor):
+                    return list(tensor.shape)
+            """,
+        }, select=["TPU014"])
+        assert rules_of(findings) == ["TPU014"]
+        assert "shape" in findings[0].message
+        assert findings[0].path.endswith("server/_grpc.py")
+
+    def test_clean_when_both_planes_validate(self, tmp_path):
+        findings = lint_tree(tmp_path, {
+            "server/_http.py": """
+                from tritonclient_tpu.protocol._validate import validate_shape
+
+                def parse(js):
+                    return validate_shape(js["shape"])
+            """,
+            "server/_grpc.py": """
+                from tritonclient_tpu.protocol._validate import validate_shape
+
+                def parse(request, tensor):
+                    return validate_shape(list(tensor.shape))
+            """,
+        }, select=["TPU014"])
+        assert findings == []
+
+    def test_clean_when_neither_plane_references_field(self, tmp_path):
+        findings = lint_tree(tmp_path, {
+            "server/_http.py": """
+                def parse(js):
+                    return js["id"]
+            """,
+            "server/_grpc.py": """
+                def parse(request):
+                    return request.id
+            """,
+        }, select=["TPU014"])
+        assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# TPU008 validation-status / invalid-reason literal arms                      #
+# --------------------------------------------------------------------------- #
+
+
+class TestValidationLiteralDrift:
+    def test_fires_on_raw_400_literal(self, tmp_path):
+        findings = lint_tree(tmp_path, {
+            "server/_http.py": """
+                def reject(handler):
+                    handler.send_response(400)
+            """,
+        }, select=["TPU008"])
+        assert "TPU008" in rules_of(findings)
+        assert any("STATUS_INVALID" in f.message for f in findings)
+
+    def test_fires_on_raw_reason_string(self, tmp_path):
+        findings = lint_tree(tmp_path, {
+            "server/_http.py": """
+                def classify(e):
+                    return "invalid_shape"
+            """,
+        }, select=["TPU008"])
+        assert any("INVALID_REASON_SHAPE" in f.message for f in findings)
+
+    def test_clean_on_constants(self, tmp_path):
+        findings = lint_tree(tmp_path, {
+            "server/_http.py": """
+                from tritonclient_tpu.protocol._literals import (
+                    INVALID_REASON_SHAPE,
+                    STATUS_INVALID,
+                )
+
+                def reject(handler):
+                    handler.send_response(STATUS_INVALID)
+                    return INVALID_REASON_SHAPE
+            """,
+        }, select=["TPU008"])
+        assert findings == []

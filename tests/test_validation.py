@@ -1,0 +1,424 @@
+"""Boundary-validation matrix for the untrusted request plane (PR 19).
+
+Every malformed-input class the tpufuzz mutation catalog covers gets a
+deterministic regression case here: the server must answer with a typed
+rejection (HTTP 4xx with a JSON error body / a mapped gRPC status),
+keep serving afterward, use the same message vocabulary on both planes
+(they share ``protocol/_validate``), and account the rejection on
+``nv_inference_invalid_request_total`` with a canonical reason.
+
+The seeded fuzzer (scripts/tpufuzz.py) explores the space; this file
+pins the exact cases it once found as bugs — the list-wrapped JSON body
+that used to 500, the truncated BYTES frame and non-numeric
+classification that used to surface as gRPC UNKNOWN.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import grpc
+import numpy as np
+import pytest
+import requests
+
+from tritonclient_tpu.protocol import GRPCInferenceServiceStub, pb
+from tritonclient_tpu.server import InferenceServer
+
+_SCRIPTS = os.path.join(os.path.dirname(__file__), "..", "scripts")
+
+
+def _load_script(name, modname):
+    spec = importlib.util.spec_from_file_location(
+        modname, os.path.join(_SCRIPTS, name))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def server():
+    with InferenceServer(max_request_bytes=1 << 20) as s:
+        yield s
+
+
+@pytest.fixture(scope="module")
+def base(server):
+    return f"http://{server.http_address}"
+
+
+@pytest.fixture(scope="module")
+def stub(server):
+    channel = grpc.insecure_channel(server.grpc_address)
+    yield GRPCInferenceServiceStub(channel)
+    channel.close()
+
+
+def _good_request():
+    return {
+        "inputs": [
+            {"name": "INPUT0", "datatype": "INT32", "shape": [1, 16],
+             "data": list(range(16))},
+            {"name": "INPUT1", "datatype": "INT32", "shape": [1, 16],
+             "data": [1] * 16},
+        ],
+    }
+
+
+def _infer(base, body, **kw):
+    return requests.post(base + "/v2/models/simple/infer", **dict(kw, **(
+        {"json": body} if isinstance(body, dict) else {"data": body})))
+
+
+def _assert_typed_4xx(r):
+    assert 400 <= r.status_code < 500, r.text
+    doc = r.json()
+    assert isinstance(doc.get("error"), str) and doc["error"]
+    return doc["error"]
+
+
+def _grpc_request(model="simple", shape=(1, 16), datatype="INT32",
+                  data=True):
+    req = pb.ModelInferRequest(model_name=model)
+    for name in ("INPUT0", "INPUT1"):
+        t = req.inputs.add()
+        t.name = name
+        t.datatype = datatype
+        t.shape.extend(shape)
+        if data:
+            t.contents.int_contents.extend([1] * 16)
+    return req
+
+
+def _grpc_error(stub, req):
+    with pytest.raises(grpc.RpcError) as exc:
+        stub.ModelInfer(req, timeout=30)
+    return exc.value
+
+
+class TestHTTPBoundary:
+    def test_list_wrapped_body_is_typed_400(self, base):
+        # Regression: used to 500 with "'list' object has no attribute
+        # 'get'" before the top-level-object check.
+        r = _infer(base, json.dumps([_good_request()]).encode(),
+                   headers={"Content-Type": "application/json"})
+        msg = _assert_typed_4xx(r)
+        assert "JSON object" in msg
+
+    def test_non_dict_input_entry_is_typed_400(self, base):
+        r = _infer(base, {"inputs": ["INPUT0"]})
+        msg = _assert_typed_4xx(r)
+        assert "JSON object" in msg
+
+    def test_negative_shape_dim(self, base):
+        body = _good_request()
+        body["inputs"][0]["shape"] = [1, -16]
+        assert "shape" in _assert_typed_4xx(_infer(base, body))
+
+    def test_shape_rank_bomb(self, base):
+        body = _good_request()
+        body["inputs"][0]["shape"] = [1] * 64
+        _assert_typed_4xx(_infer(base, body))
+
+    def test_shape_product_overflow(self, base):
+        body = _good_request()
+        body["inputs"][0]["shape"] = [2 ** 31, 2 ** 31]
+        _assert_typed_4xx(_infer(base, body))
+
+    def test_non_integer_shape_dim(self, base):
+        body = _good_request()
+        body["inputs"][0]["shape"] = [1, 1.5]
+        _assert_typed_4xx(_infer(base, body))
+
+    def test_unknown_dtype(self, base):
+        body = _good_request()
+        body["inputs"][0]["datatype"] = "FP128"
+        assert "FP128" in _assert_typed_4xx(_infer(base, body))
+
+    def test_data_length_mismatch(self, base):
+        body = _good_request()
+        body["inputs"][0]["data"] = [0] * 8  # shape says 16
+        _assert_typed_4xx(_infer(base, body))
+
+    def test_truncated_binary_frame(self, base):
+        header = {
+            "inputs": [
+                {"name": "INPUT0", "datatype": "INT32", "shape": [1, 16],
+                 "parameters": {"binary_data_size": 64}},
+                {"name": "INPUT1", "datatype": "INT32", "shape": [1, 16],
+                 "data": [1] * 16},
+            ],
+        }
+        hj = json.dumps(header).encode()
+        body = hj + b"\xab" * 16  # claims 64, sends 16
+        r = _infer(
+            base, body,
+            headers={"Inference-Header-Content-Length": str(len(hj))})
+        assert "truncated" in _assert_typed_4xx(r)
+
+    def test_header_length_lie(self, base):
+        body = json.dumps(_good_request()).encode()
+        r = _infer(
+            base, body,
+            headers={"Inference-Header-Content-Length":
+                     str(len(body) + 100)})
+        _assert_typed_4xx(r)
+
+    def test_negative_binary_data_size(self, base):
+        body = _good_request()
+        body["inputs"][0].pop("data")
+        body["inputs"][0]["parameters"] = {"binary_data_size": -1}
+        _assert_typed_4xx(_infer(base, body))
+
+    def test_negative_shm_offset(self, base):
+        body = _good_request()
+        body["inputs"][0].pop("data")
+        body["inputs"][0]["parameters"] = {
+            "shared_memory_region": "r", "shared_memory_offset": -8,
+            "shared_memory_byte_size": 64,
+        }
+        _assert_typed_4xx(_infer(base, body))
+
+    def test_unregistered_shm_region(self, base):
+        body = _good_request()
+        body["inputs"][0].pop("data")
+        body["inputs"][0]["parameters"] = {
+            "shared_memory_region": "never_registered",
+            "shared_memory_offset": 0, "shared_memory_byte_size": 64,
+        }
+        _assert_typed_4xx(_infer(base, body))
+
+    def test_shm_register_window_past_region_end(self, base):
+        r = requests.post(
+            base + "/v2/systemsharedmemory/region/bogus/register",
+            json={"key": "/nope", "offset": 2 ** 62, "byte_size": 2 ** 62})
+        _assert_typed_4xx(r)
+
+    def test_classification_on_bytes_output(self, base):
+        # Regression: top-k over a BYTES output used to raise TypeError
+        # ("bad operand type for unary -") instead of a typed rejection.
+        body = {
+            "inputs": [
+                {"name": "INPUT0", "datatype": "BYTES", "shape": [1, 16],
+                 "data": [str(i) for i in range(16)]},
+                {"name": "INPUT1", "datatype": "BYTES", "shape": [1, 16],
+                 "data": ["1"] * 16},
+            ],
+            "outputs": [
+                {"name": "OUTPUT0",
+                 "parameters": {"classification": 3}},
+            ],
+        }
+        r = requests.post(
+            base + "/v2/models/simple_string/infer", json=body)
+        assert "classification" in _assert_typed_4xx(r)
+
+    def test_content_length_over_cap_is_413(self, base):
+        r = _infer(base, b"x" * ((1 << 20) + 4096),
+                   headers={"Content-Type": "application/json"})
+        assert r.status_code == 413
+        assert "error" in r.json()
+
+    def test_server_still_serving(self, base):
+        r = _infer(base, _good_request())
+        assert r.status_code == 200
+
+
+class TestGRPCBoundary:
+    def test_negative_shape_dim(self, stub):
+        e = _grpc_error(stub, _grpc_request(shape=(1, -16)))
+        assert e.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert "shape" in e.details()
+
+    def test_unknown_dtype(self, stub):
+        req = _grpc_request(data=False)
+        for t in req.inputs:
+            t.datatype = "FP128"
+        e = _grpc_error(stub, req)
+        assert e.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert "FP128" in e.details()
+
+    def test_truncated_bytes_raw_frame(self, stub):
+        # Regression: used to surface as UNKNOWN ("Exception calling
+        # application") out of deserialize_bytes_tensor.
+        req = pb.ModelInferRequest(model_name="simple_string")
+        t = req.inputs.add()
+        t.name = "INPUT0"
+        t.datatype = "BYTES"
+        t.shape.extend([1, 16])
+        t2 = req.inputs.add()
+        t2.name = "INPUT1"
+        t2.datatype = "BYTES"
+        t2.shape.extend([1, 16])
+        t2.contents.bytes_contents.extend(b"1" for _ in range(16))
+        req.raw_input_contents.append(b"\xab" * 27)
+        e = _grpc_error(stub, req)
+        assert e.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    def test_classification_on_bytes_output(self, stub):
+        req = pb.ModelInferRequest(model_name="simple_string")
+        for name in ("INPUT0", "INPUT1"):
+            t = req.inputs.add()
+            t.name = name
+            t.datatype = "BYTES"
+            t.shape.extend([1, 16])
+            t.contents.bytes_contents.extend(
+                str(i).encode() for i in range(16))
+        o = req.outputs.add()
+        o.name = "OUTPUT0"
+        o.parameters["classification"].int64_param = 2 ** 40
+        e = _grpc_error(stub, req)
+        assert e.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert "classification" in e.details()
+
+    def test_shm_register_bad_window(self, stub):
+        req = pb.SystemSharedMemoryRegisterRequest(
+            name="bogus", key="/nope", offset=2 ** 62, byte_size=2 ** 62)
+        with pytest.raises(grpc.RpcError) as exc:
+            stub.SystemSharedMemoryRegister(req, timeout=30)
+        assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    def test_server_still_serving(self, stub):
+        resp = stub.ModelInfer(_grpc_request(), timeout=30)
+        assert resp.model_name == "simple"
+
+
+class TestCrossPlaneParity:
+    """The planes share protocol/_validate, so the same malformed value
+    must produce the same message text on both."""
+
+    def test_shape_message_parity(self, base, stub):
+        body = _good_request()
+        body["inputs"][0]["shape"] = [1, -16]
+        http_msg = _infer(base, body).json()["error"]
+        grpc_msg = _grpc_error(stub, _grpc_request(shape=(1, -16))).details()
+        assert http_msg == grpc_msg
+
+    def test_dtype_message_parity(self, base, stub):
+        body = _good_request()
+        for t in body["inputs"]:
+            t["datatype"] = "FP128"
+        http_msg = _infer(base, body).json()["error"]
+        req = _grpc_request(data=False)
+        for t in req.inputs:
+            t.datatype = "FP128"
+        grpc_msg = _grpc_error(stub, req).details()
+        assert http_msg == grpc_msg
+
+
+class TestInvalidRequestMetric:
+    def test_rejections_are_counted_with_canonical_reason(self, base):
+        def scrape():
+            text = requests.get(base + "/metrics").text
+            out = {}
+            for line in text.splitlines():
+                if line.startswith("nv_inference_invalid_request_total{"):
+                    labels, value = line.rsplit(" ", 1)
+                    if 'model="simple"' in labels:
+                        reason = labels.split('reason="')[1].split('"')[0]
+                        out[reason] = float(value)
+            return out
+
+        before = scrape()
+        body = _good_request()
+        body["inputs"][0]["shape"] = [1, -16]
+        _infer(base, body)
+        body = _good_request()
+        body["inputs"][0]["datatype"] = "FP128"
+        _infer(base, body)
+        after = scrape()
+        assert after["invalid_shape"] >= before["invalid_shape"] + 1
+        assert after["invalid_dtype"] >= before["invalid_dtype"] + 1
+
+    def test_exposition_contract_holds_live(self, base):
+        cme = _load_script("check_metrics_exposition.py", "cme_validation")
+        text = requests.get(base + "/metrics").text
+        assert cme.check_exposition(text) == []
+        assert "nv_inference_invalid_request_total" in text
+
+
+class TestExpositionViolationCases:
+    """The checker must actually reject a drifting metric, not just
+    accept the healthy one."""
+
+    def _checker(self):
+        return _load_script(
+            "check_metrics_exposition.py", "cme_violations")
+
+    def _family(self, rows):
+        head = (
+            "# HELP nv_inference_invalid_request_total rejected\n"
+            "# TYPE nv_inference_invalid_request_total counter\n"
+        )
+        return head + "\n".join(rows) + "\n"
+
+    def _all_rows(self, **overrides):
+        reasons = ["malformed", "invalid_shape", "invalid_dtype",
+                   "data_mismatch", "shm_bounds", "too_large"]
+        return [
+            'nv_inference_invalid_request_total{model="m",version="1",'
+            f'reason="{r}"}} {overrides.get(r, 0)}'
+            for r in reasons
+        ]
+
+    def test_healthy_family_passes(self):
+        assert self._checker().check_exposition(
+            self._family(self._all_rows())) == []
+
+    def test_non_canonical_reason_rejected(self):
+        rows = self._all_rows()
+        rows.append(
+            'nv_inference_invalid_request_total{model="m",version="1",'
+            'reason="weird"} 1')
+        errors = self._checker().check_exposition(self._family(rows))
+        assert any("'weird'" in e for e in errors)
+
+    def test_missing_reason_row_rejected(self):
+        rows = self._all_rows()[:-1]  # drop too_large
+        errors = self._checker().check_exposition(self._family(rows))
+        assert any("missing reason rows" in e and "too_large" in e
+                   for e in errors)
+
+    def test_wrong_label_set_rejected(self):
+        rows = self._all_rows()
+        rows.append(
+            'nv_inference_invalid_request_total{model="m",'
+            'reason="malformed"} 1')
+        errors = self._checker().check_exposition(self._family(rows))
+        assert any("label set" in e for e in errors)
+
+
+class TestFuzzDeterminism:
+    def test_same_seed_same_stream(self):
+        import random
+
+        from tritonclient_tpu import fuzz
+
+        seeds = fuzz.load_corpus()
+
+        def stream(seed):
+            return fuzz.generate_specs(
+                seeds, random.Random(seed), 40, ("http", "grpc"),
+                expressible=fuzz.expressible)
+
+        assert (json.dumps(stream(3), sort_keys=True)
+                == json.dumps(stream(3), sort_keys=True))
+        assert (json.dumps(stream(3), sort_keys=True)
+                != json.dumps(stream(4), sort_keys=True))
+
+    def test_self_check_passes(self):
+        tf = _load_script("tpufuzz.py", "tpufuzz_script")
+        assert tf.main(["--self-check"]) == 0
+
+    def test_live_fuzz_small_run_clean_and_deterministic(self, capsys):
+        from tritonclient_tpu import fuzz
+
+        a = fuzz.run_fuzz(1234, 25, planes=("http", "grpc"))
+        b = fuzz.run_fuzz(1234, 25, planes=("http", "grpc"))
+        assert a == b
+        assert a["failures"] == []
+        assert a["executed"] == {"grpc": 25, "http": 25}
+        # The SARIF stream carries the failures as TPU013 results.
+        doc = json.loads(fuzz.render_sarif(a))
+        assert doc["runs"][0]["tool"]["driver"]["name"] == "tpufuzz"

@@ -1,10 +1,11 @@
 """tpulint — project-specific static analysis for the TPU serving stack.
 
-Eleven check families tuned to the bug classes this codebase's surfaces
-actually grow (two protocol front-ends, sync+aio clients, a threaded
-server core, a DLPack/shm registry). TPU001–TPU005 are AST-local;
-TPU006–TPU008 are flow- and project-sensitive; TPU009–TPU011 are
-interprocedural over the whole-program call graph (``_callgraph.py``):
+Thirteen check families tuned to the bug classes this codebase's
+surfaces actually grow (two protocol front-ends, sync+aio clients, a
+threaded server core, a DLPack/shm registry). TPU001–TPU005 are
+AST-local; TPU006–TPU008 and TPU014 are flow- and project-sensitive;
+TPU009–TPU011 and TPU013 are interprocedural over the whole-program
+call graph (``_callgraph.py``):
 
 =======  =================  ====================================================
 rule     name               catches
@@ -55,12 +56,24 @@ TPU011   condvar-           condition-variable discipline over declared
                             wait predicates mutated outside the cv (the
                             lost-wakeup shape ``tpumc`` witnesses
                             dynamically)
+TPU013   untrusted-sink     interprocedural taint: request-derived values
+                            (HTTP body/header parses, gRPC request fields,
+                            fleet proxy pass-throughs) reaching allocation
+                            sizes, ``reshape``, buffer slice bounds,
+                            ``range()`` loop bounds, or shm/page-reservation
+                            math without passing a ``protocol/_validate``
+                            sanitizer — reported with the full source→sink
+                            call path (``tpufuzz`` is the dynamic witness)
+TPU014   validation-drift   a request field validated on one protocol plane
+                            (HTTP/gRPC server front-end) but referenced
+                            unvalidated on the other, or validated only in
+                            a client library while the server trusts it
 =======  =================  ====================================================
 
 Suppress a deliberate violation with ``# tpulint: disable=TPU001`` (comma
 list allowed) on the offending line, or on a ``def``/``class`` line to
 cover the whole body; ``# tpulint: disable-file=TPU003`` anywhere in a file
-covers the file. Project-wide rules (TPU004/007–011) honor the same
+covers the file. Project-wide rules (TPU004/007–011/013/014) honor the same
 syntax at the line their finding points to. Mark a hot root with
 ``# tpulint: hot-path`` on (or immediately above) its ``def`` line —
 TPU010 treats everything call-graph-reachable from it as hot.

@@ -44,6 +44,7 @@ import os
 import re
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+from tritonclient_tpu.analysis import _taint
 from tritonclient_tpu.analysis._engine import (
     FileContext,
     discover_files,
@@ -115,7 +116,7 @@ _CV_METHODS = {"wait", "wait_for", "notify", "notify_all"}
 #: count as predicate writes for the notify-discipline check.
 _SIGNAL_METHODS = {"put", "put_nowait", "set", "clear", "release"}
 
-CACHE_VERSION = 5  # v5: cv sites + signal calls in function summaries
+CACHE_VERSION = 6  # v6: per-function taint facts (TPU013)
 
 
 def modkey_for(path: str) -> str:
@@ -224,7 +225,7 @@ class CvSite:
 class FunctionSummary:
     __slots__ = ("key", "path", "line", "cls", "name", "public", "hot",
                  "is_spawn_site", "calls", "accesses", "spawns", "hazards",
-                 "cvsites", "signals")
+                 "cvsites", "signals", "taint")
 
     def __init__(self, key, path, line, cls_name, name, public, hot):
         self.key = key
@@ -244,6 +245,9 @@ class FunctionSummary:
         # [(attr, method, line)] — _SIGNAL_METHODS calls on attributes
         # (queue put / event set): wakeup-visible state changes.
         self.signals: List[Tuple[str, str, int]] = []
+        # Per-function taint facts (TPU013); None when the function has
+        # no parameters, sinks, or forwarded taint worth recording.
+        self.taint = None
 
     def to_json(self):
         return {
@@ -256,6 +260,7 @@ class FunctionSummary:
             "hazards": [h.to_json() for h in self.hazards],
             "cvsites": [s.to_json() for s in self.cvsites],
             "signals": [[a, m, ln] for a, m, ln in self.signals],
+            "taint": self.taint.to_json() if self.taint else None,
         }
 
     @classmethod
@@ -268,6 +273,9 @@ class FunctionSummary:
         fn.hazards = [Hazard.from_json(r) for r in d["hazards"]]
         fn.cvsites = [CvSite.from_json(r) for r in d.get("cvsites", [])]
         fn.signals = [(a, m, ln) for a, m, ln in d.get("signals", [])]
+        raw_taint = d.get("taint")
+        if raw_taint:
+            fn.taint = _taint.FunctionTaint.from_json(raw_taint)
         return fn
 
 
@@ -1294,6 +1302,12 @@ def summarize_file(ctx: FileContext, decls: _Decls) -> List[FunctionSummary]:
             walker.walk_function(node, cls.name, key)
         else:
             walker.walk_function(node, None, f"{modkey}:{node.name}")
+    taints = _taint.extract_file_taint(ctx, modkey)
+    for fn in walker.out:
+        rec = taints.get(fn.key)
+        if rec is not None and (rec.params or rec.flows or rec.param_sinks
+                                or rec.param_calls or rec.wire_calls):
+            fn.taint = rec
     return walker.out
 
 

@@ -205,6 +205,10 @@ def default_rules() -> List[Rule]:
     from tritonclient_tpu.analysis._tpu009_guarded_by import GuardedByRule
     from tritonclient_tpu.analysis._tpu010_jax_hazard import JaxHazardRule
     from tritonclient_tpu.analysis._tpu011_condvar import CondvarDisciplineRule
+    from tritonclient_tpu.analysis._tpu013_taint import UntrustedSinkRule
+    from tritonclient_tpu.analysis._tpu014_validation_drift import (
+        ValidationDriftRule,
+    )
 
     return [
         AsyncBlockingRule(),
@@ -218,6 +222,8 @@ def default_rules() -> List[Rule]:
         GuardedByRule(),
         JaxHazardRule(),
         CondvarDisciplineRule(),
+        UntrustedSinkRule(),
+        ValidationDriftRule(),
     ]
 
 

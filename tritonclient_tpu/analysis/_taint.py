@@ -1,0 +1,553 @@
+"""Per-function taint facts for the TPU013 untrusted-sink rule.
+
+This module is the intraprocedural half of tpuflow: for every function it
+records (a) where wire data enters — protocol-boundary parse sites — and
+(b) how values flow from there (or from the function's own parameters)
+into *sinks*: allocation sizes, ``reshape`` arguments, slice bounds on
+buffers, ``range()`` loop bounds, shm window arithmetic, and
+reserve/alloc-named calls. The interprocedural stitching — propagating
+"this parameter reaches a sink" backwards along the call graph and
+reconstructing full source→sink call paths — lives in
+``_tpu013_taint.py``, on top of the cached call-graph substrate
+(``_callgraph.py`` attaches a :class:`FunctionTaint` to every
+``FunctionSummary`` and bumps its ``CACHE_VERSION`` for it).
+
+Taint discipline:
+
+* **Sources** exist only in the protocol-boundary files
+  (``server/_http.py``, ``server/_grpc.py``, ``fleet/_http.py``):
+  ``json.loads``, ``self.headers``, ``self._read_body()`` /
+  ``rfile.read``, and — on the gRPC plane — parameters named
+  ``request``/``tensor`` (protobuf messages deserialized from the wire).
+* **Sanitizers** clear taint: the ``protocol/_validate.py``
+  ``validate_*`` helpers, boolean-producing builtins (``len``,
+  ``isinstance``, comparisons), ``min``/``max`` against an untainted
+  bound, and an ``if <compare on the value>: raise/return`` guard.
+* Everything else **propagates**: arithmetic, subscripts, attribute
+  reads, container literals, and calls (a call with a tainted argument
+  or receiver returns tainted — parsing helpers transform wire data,
+  they don't launder it).
+
+Known imprecision (deliberate, documented): taint does not follow
+object-attribute stores (``obj.f = tainted; use(obj.f)``) — the fuzzer
+(``scripts/tpufuzz.py``) is the dynamic complement for those flows.
+"""
+
+import ast
+from typing import Dict, List, Optional, Set, Union
+
+#: Origin token for wire-derived values (alongside parameter names).
+WIRE = "<wire>"
+
+#: Path suffixes of the untrusted request plane — the only files where
+#: wire-taint sources are seeded.
+BOUNDARY_SUFFIXES = (
+    "server/_http.py",
+    "server/_grpc.py",
+    "fleet/_http.py",
+)
+
+#: gRPC-plane parameters holding protobuf messages deserialized straight
+#: off the wire (seeded as sources in boundary files only).
+_WIRE_PARAM_NAMES = {"request", "tensor"}
+
+#: Calls whose result is never attacker-controlled regardless of args.
+_CLEAN_CALLS = {
+    "len", "bool", "isinstance", "issubclass", "hasattr", "callable",
+    "id", "hash", "type",
+}
+
+#: numpy-style constructors whose FIRST positional argument is an
+#: allocation size/shape.
+_ALLOC_CTORS = {"zeros", "empty", "ones", "full", "bytearray"}
+
+
+def is_boundary_path(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return any(p.endswith(s) for s in BOUNDARY_SUFFIXES)
+
+
+class FunctionTaint:
+    """Serializable taint facts for one function."""
+
+    __slots__ = ("params", "flows", "param_sinks", "param_calls",
+                 "wire_calls")
+
+    def __init__(self):
+        # Parameter names as seen by CALLERS: ``self``/``cls`` dropped
+        # for bound methods, so positional slot i maps to params[i].
+        self.params: List[str] = []
+        # Local wire→sink flows: [kind, detail, line, col, src_text]
+        self.flows: List[list] = []
+        # {param: [[kind, detail, line, col], ...]} — sinks a parameter
+        # reaches inside this function without a sanitizer.
+        self.param_sinks: Dict[str, List[list]] = {}
+        # {param: [[callee_key, slot, line], ...]} — parameter forwarded
+        # into a resolvable call (slot: int position or kwarg name).
+        self.param_calls: Dict[str, List[list]] = {}
+        # Wire data forwarded into a resolvable call:
+        # [callee_key, slot, line, col, src_text]
+        self.wire_calls: List[list] = []
+
+    def to_json(self):
+        return {
+            "params": self.params,
+            "flows": self.flows,
+            "param_sinks": self.param_sinks,
+            "param_calls": self.param_calls,
+            "wire_calls": self.wire_calls,
+        }
+
+    @classmethod
+    def from_json(cls, d):
+        t = cls()
+        t.params = list(d.get("params", []))
+        t.flows = [list(r) for r in d.get("flows", [])]
+        t.param_sinks = {
+            p: [list(r) for r in rows]
+            for p, rows in d.get("param_sinks", {}).items()
+        }
+        t.param_calls = {
+            p: [list(r) for r in rows]
+            for p, rows in d.get("param_calls", {}).items()
+        }
+        t.wire_calls = [list(r) for r in d.get("wire_calls", [])]
+        return t
+
+    def slot_param(self, slot: Union[int, str]) -> Optional[str]:
+        """Callee parameter name for a caller argument slot."""
+        if isinstance(slot, str):
+            return slot if slot in self.params else None
+        if 0 <= slot < len(self.params):
+            return self.params[slot]
+        return None
+
+
+def _expr_text(node: ast.AST, limit: int = 48) -> str:
+    try:
+        text = ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on 3.9+
+        text = type(node).__name__
+    return text if len(text) <= limit else text[: limit - 3] + "..."
+
+
+class _TaintWalker:
+    """Single-pass, flow-sensitive walk of one function body."""
+
+    def __init__(self, ctx, modkey: str, cls: Optional[str],
+                 node, boundary: bool, rule_id: str):
+        self.ctx = ctx
+        self.modkey = modkey
+        self.cls = cls
+        self.node = node
+        self.boundary = boundary
+        self.rule_id = rule_id
+        self.out = FunctionTaint()
+        self.env: Dict[str, Set[str]] = {}
+        self._seen_calls: Set[int] = set()
+
+    # -- entry ---------------------------------------------------------
+
+    def run(self) -> FunctionTaint:
+        args = self.node.args
+        names = [a.arg for a in (
+            args.posonlyargs + args.args
+        )]
+        is_method = self.cls is not None and not any(
+            isinstance(d, ast.Name) and d.id == "staticmethod"
+            for d in self.node.decorator_list
+        )
+        if is_method and names and names[0] in ("self", "cls"):
+            names = names[1:]
+        for a in args.kwonlyargs:
+            if a.arg not in names:
+                names.append(a.arg)
+        self.out.params = names
+        for a in names:
+            self.env[a] = {a}
+        if self.boundary:
+            for p in list(self.env):
+                if p in _WIRE_PARAM_NAMES:
+                    self.env[p] = {p, WIRE}
+        for stmt in self.node.body:
+            self._stmt(stmt)
+        return self.out
+
+    # -- origins of an expression --------------------------------------
+
+    def _origins(self, node) -> Set[str]:
+        if node is None or isinstance(node, (ast.Constant, ast.Lambda)):
+            return set()
+        if isinstance(node, ast.Name):
+            return set(self.env.get(node.id, ()))
+        if isinstance(node, ast.Attribute):
+            if self._is_headers(node):
+                return {WIRE} if self.boundary else set()
+            return self._origins(node.value)
+        if isinstance(node, ast.Subscript):
+            return self._origins(node.value)
+        if isinstance(node, ast.Await):
+            return self._origins(node.value)
+        if isinstance(node, ast.Starred):
+            return self._origins(node.value)
+        if isinstance(node, ast.BinOp):
+            return self._origins(node.left) | self._origins(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._origins(node.operand)
+        if isinstance(node, ast.BoolOp):
+            out: Set[str] = set()
+            for v in node.values:
+                out |= self._origins(v)
+            return out
+        if isinstance(node, ast.IfExp):
+            return self._origins(node.body) | self._origins(node.orelse)
+        if isinstance(node, ast.Compare):
+            return set()  # booleans are clean
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            out = set()
+            for e in node.elts:
+                out |= self._origins(e)
+            return out
+        if isinstance(node, ast.Dict):
+            out = set()
+            for v in node.values:
+                out |= self._origins(v)
+            return out
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            out = set()
+            for gen in node.generators:
+                out |= self._origins(gen.iter)
+            return out
+        if isinstance(node, ast.JoinedStr):
+            out = set()
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue):
+                    out |= self._origins(v.value)
+            return out
+        if isinstance(node, ast.Call):
+            return self._call_origins(node)
+        return set()
+
+    def _call_origins(self, call: ast.Call) -> Set[str]:
+        name = self._call_name(call) or ""
+        last = name.rsplit(".", 1)[-1]
+        if self._is_wire_source(call, name, last):
+            return {WIRE} if self.boundary else set()
+        if last.startswith("validate_"):
+            return set()  # the sanitizer contract (protocol/_validate.py)
+        if last in _CLEAN_CALLS:
+            return set()
+        arg_origins: Set[str] = set()
+        for a in call.args:
+            arg_origins |= self._origins(a)
+        for kw in call.keywords:
+            arg_origins |= self._origins(kw.value)
+        if last in ("min", "max"):
+            # A min/max against at least one untainted bound caps the
+            # value — recognized range-check sanitizer.
+            operands = list(call.args) + [k.value for k in call.keywords]
+            if len(operands) >= 2 and any(
+                not self._origins(o) for o in operands
+            ):
+                return set()
+            return arg_origins
+        recv = set()
+        if isinstance(call.func, ast.Attribute):
+            recv = self._origins(call.func.value)
+        return arg_origins | recv
+
+    def _is_headers(self, node: ast.Attribute) -> bool:
+        return (node.attr == "headers"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self")
+
+    def _is_wire_source(self, call: ast.Call, name: str, last: str) -> bool:
+        if name in ("json.loads", "json.load"):
+            return True
+        if last == "_read_body":
+            return True
+        if last in ("read", "recv") and isinstance(call.func, ast.Attribute):
+            return "rfile" in _expr_text(call.func.value)
+        return False
+
+    # -- sinks ---------------------------------------------------------
+
+    def _record(self, origins: Set[str], kind: str, detail: str, node):
+        if not origins:
+            return
+        if self.ctx.is_suppressed(self.rule_id, node.lineno):
+            return
+        row = [kind, detail, node.lineno, node.col_offset]
+        if WIRE in origins:
+            self.out.flows.append(row + [detail])
+        for p in origins - {WIRE}:
+            self.out.param_sinks.setdefault(p, []).append(list(row))
+
+    def _check_sinks(self, node):
+        if isinstance(node, ast.Subscript):
+            sl = node.slice
+            if isinstance(sl, ast.Slice):
+                origins = (self._origins(sl.lower) | self._origins(sl.upper)
+                           | self._origins(sl.step))
+                self._record(origins, "slice-bound",
+                             f"{_expr_text(node.value)}[...]", node)
+            return
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+            # b"\0" * n / [0] * n — sequence repetition sized by taint.
+            for seq, n in ((node.left, node.right), (node.right, node.left)):
+                if isinstance(seq, (ast.List, ast.Tuple)) or (
+                    isinstance(seq, ast.Constant)
+                    and isinstance(seq.value, (bytes, str))
+                ):
+                    self._record(self._origins(n), "alloc-size",
+                                 f"{_expr_text(seq)} * {_expr_text(n)}", node)
+            return
+        if not isinstance(node, ast.Call):
+            return
+        name = self._call_name(node) or ""
+        last = name.rsplit(".", 1)[-1]
+        if last == "range":
+            origins = set()
+            for a in node.args:
+                origins |= self._origins(a)
+            self._record(origins, "loop-bound", "range(...)", node)
+        elif last in _ALLOC_CTORS:
+            if node.args:
+                self._record(self._origins(node.args[0]), "alloc-size",
+                             f"{name}(...)", node)
+            for kw in node.keywords:
+                if kw.arg in ("shape", "count"):
+                    self._record(self._origins(kw.value), "alloc-size",
+                                 f"{name}({kw.arg}=...)", node)
+        elif last == "frombuffer":
+            for i, a in enumerate(node.args):
+                if i in (2, 3):  # count, offset
+                    self._record(self._origins(a), "alloc-size",
+                                 f"{name}(...)", node)
+            for kw in node.keywords:
+                if kw.arg in ("count", "offset"):
+                    self._record(self._origins(kw.value), "alloc-size",
+                                 f"{name}({kw.arg}=...)", node)
+        elif last == "reshape":
+            origins = set()
+            for a in node.args:
+                origins |= self._origins(a)
+            for kw in node.keywords:
+                origins |= self._origins(kw.value)
+            self._record(origins, "reshape", f"{_expr_text(node.func)}(...)",
+                         node)
+        elif last in ("read", "recv") and isinstance(node.func, ast.Attribute):
+            origins = set()
+            for a in node.args:
+                origins |= self._origins(a)
+            self._record(origins, "alloc-size", f".{last}(...)", node)
+        elif "reserve" in last or "alloc" in last:
+            origins = set()
+            for a in node.args:
+                origins |= self._origins(a)
+            for kw in node.keywords:
+                origins |= self._origins(kw.value)
+            self._record(origins, "reserve-count", f"{name}(...)", node)
+
+    # -- calls: forward taint into resolvable callees ------------------
+
+    def _call_name(self, call: ast.Call) -> Optional[str]:
+        return self.ctx.canonical_call_name(call.func)
+
+    def _callee_key(self, call: ast.Call) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            target = self.ctx.aliases.get(func.id)
+            if target and "." in target:
+                mod, _, name = target.rpartition(".")
+                if name[:1].isupper():
+                    return f"{name}.__init__"
+                return f"{mod.rpartition('.')[2]}:{name}"
+            if func.id[:1].isupper():
+                return f"{func.id}.__init__"
+            return f"{self.modkey}:{func.id}"
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            if isinstance(base, ast.Name):
+                if base.id == "self" and self.cls:
+                    return f"{self.cls}.{func.attr}"
+                if base.id[:1].isupper():
+                    return f"{base.id}.{func.attr}"
+                target = self.ctx.aliases.get(base.id)
+                if target:
+                    return f"{target.rpartition('.')[2]}:{func.attr}"
+        return None
+
+    def _record_call_args(self, call: ast.Call):
+        if id(call) in self._seen_calls:
+            return
+        self._seen_calls.add(id(call))
+        name = self._call_name(call) or ""
+        last = name.rsplit(".", 1)[-1]
+        if last.startswith("validate_") or last in _CLEAN_CALLS:
+            return
+        callee = self._callee_key(call)
+        if callee is None:
+            return
+        if self.ctx.is_suppressed(self.rule_id, call.lineno):
+            return
+        slots = [(i, a) for i, a in enumerate(call.args)]
+        slots += [(kw.arg, kw.value) for kw in call.keywords
+                  if kw.arg is not None]
+        for slot, arg in slots:
+            origins = self._origins(arg)
+            if not origins:
+                continue
+            if WIRE in origins:
+                self.out.wire_calls.append(
+                    [callee, slot, call.lineno, call.col_offset,
+                     _expr_text(arg)])
+            for p in origins - {WIRE}:
+                self.out.param_calls.setdefault(p, []).append(
+                    [callee, slot, call.lineno])
+
+    # -- statements ----------------------------------------------------
+
+    def _scan(self, expr):
+        """Sink + call-forwarding checks over every node of an expr."""
+        if expr is None:
+            return
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.Call, ast.Subscript, ast.BinOp)):
+                self._check_sinks(node)
+            if isinstance(node, ast.Call):
+                self._record_call_args(node)
+
+    def _assign_target(self, target, origins: Set[str]):
+        if isinstance(target, ast.Name):
+            self.env[target.id] = set(origins)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._assign_target(e, origins)
+        elif isinstance(target, ast.Starred):
+            self._assign_target(target.value, origins)
+        elif isinstance(target, ast.Subscript):
+            # Store through a tainted slice bound is a sink too.
+            self._check_sinks(target)
+
+    def _is_bailout(self, stmt) -> bool:
+        """A guard body that aborts the flow: raise/return/continue, or
+        a call to a raising helper (``raise_error``, ``context.abort``)."""
+        if isinstance(stmt, (ast.Raise, ast.Return, ast.Continue)):
+            return True
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            name = self._call_name(stmt.value) or ""
+            last = name.rsplit(".", 1)[-1]
+            return last.startswith("raise") or last == "abort"
+        return False
+
+    def _guard_cleans(self, test) -> Set[str]:
+        """Names range-checked by an ``if <compare>: raise/return`` guard."""
+        names: Set[str] = set()
+        for node in ast.walk(test):
+            if isinstance(node, ast.Name) and node.id in self.env:
+                if self.env[node.id]:
+                    names.add(node.id)
+        return names
+
+    def _stmt(self, stmt):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs get their own walk
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            value = stmt.value
+            self._scan(value)
+            origins = self._origins(value)
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    self._assign_target(t, origins)
+            elif isinstance(stmt, ast.AugAssign):
+                if isinstance(stmt.target, ast.Name):
+                    self.env[stmt.target.id] = (
+                        set(self.env.get(stmt.target.id, ())) | origins)
+                else:
+                    self._scan(stmt.target)
+            else:
+                if stmt.target is not None:
+                    self._assign_target(stmt.target, origins)
+            return
+        if isinstance(stmt, ast.If):
+            self._scan(stmt.test)
+            for s in stmt.body:
+                self._stmt(s)
+            for s in stmt.orelse:
+                self._stmt(s)
+            # ``if <compare on v>: raise/return`` — recognized range
+            # check: v is considered validated afterwards.
+            if stmt.body and all(
+                self._is_bailout(s) for s in stmt.body
+            ) and isinstance(stmt.test, (ast.Compare, ast.BoolOp)):
+                for name in self._guard_cleans(stmt.test):
+                    self.env[name] = set()
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._scan(stmt.iter)
+            self._assign_target(stmt.target, self._origins(stmt.iter))
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.While):
+            self._scan(stmt.test)
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._scan(item.context_expr)
+                if item.optional_vars is not None:
+                    self._assign_target(item.optional_vars,
+                                        self._origins(item.context_expr))
+            for s in stmt.body:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Try):
+            for s in stmt.body:
+                self._stmt(s)
+            for h in stmt.handlers:
+                for s in h.body:
+                    self._stmt(s)
+            for s in stmt.orelse + stmt.finalbody:
+                self._stmt(s)
+            return
+        if isinstance(stmt, (ast.Return, ast.Expr, ast.Raise, ast.Assert,
+                             ast.Delete)):
+            for child in ast.iter_child_nodes(stmt):
+                self._scan(child)
+            return
+        # pass / break / continue / global / import — nothing to do.
+
+
+def extract_file_taint(ctx, modkey: str,
+                       rule_id: str = "TPU013") -> Dict[str, FunctionTaint]:
+    """Taint facts for every function in a file, keyed like
+    ``summarize_file`` keys its :class:`FunctionSummary` rows."""
+    out: Dict[str, FunctionTaint] = {}
+    boundary = is_boundary_path(ctx.path)
+
+    def walk(node, cls: Optional[str], key: str):
+        out[key] = _TaintWalker(ctx, modkey, cls, node, boundary,
+                                rule_id).run()
+        for child in ast.walk(node):
+            if child is node:
+                continue
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if ctx.enclosing_function(child) is node:
+                    walk(child, cls, f"{key}.<locals>.{child.name}")
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if ctx.enclosing_function(node) is not None:
+            continue
+        cls = ctx.enclosing_class(node)
+        if cls is not None:
+            walk(node, cls.name, f"{cls.name}.{node.name}")
+        else:
+            walk(node, None, f"{modkey}:{node.name}")
+    return out

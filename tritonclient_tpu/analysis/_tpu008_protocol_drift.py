@@ -79,6 +79,28 @@ _SHED_STATUS_NAMES = {
     429: "STATUS_OVER_QUOTA",
 }
 
+#: Validation statuses (the untrusted-request vocabulary): a raw 400 or
+#: 413 in a protocol-plane file is the same drift vector — the two
+#: planes must answer malformed input with the SAME status, so it gets
+#: one spelling, in protocol/_literals.
+_VALIDATION_STATUS_NAMES = {
+    400: "STATUS_INVALID",
+    413: "STATUS_TOO_LARGE",
+}
+
+#: Canonical invalid-request reasons (the label vocabulary of
+#: nv_inference_invalid_request_total and the flight record's
+#: ``invalid.reason``). A raw respelling mints a metric row no dashboard
+#: aggregates and no alert matches.
+_INVALID_REASON_NAMES = {
+    "malformed": "INVALID_REASON_MALFORMED",
+    "invalid_shape": "INVALID_REASON_SHAPE",
+    "invalid_dtype": "INVALID_REASON_DTYPE",
+    "data_mismatch": "INVALID_REASON_DATA_MISMATCH",
+    "shm_bounds": "INVALID_REASON_SHM_BOUNDS",
+    "too_large": "INVALID_REASON_TOO_LARGE",
+}
+
 #: Header/metadata keys whose raw spelling in a protocol-plane file is
 #: drift: a router admitting one spelling while the replica stamps
 #: another silently un-attributes every record — and a proxy honoring
@@ -222,6 +244,35 @@ class ProtocolDriftRule(Rule):
                             f"literal; import {name} from "
                             "protocol/_literals so client and server "
                             "cannot drift on the shed status",
+                        )
+                    )
+                elif (
+                    type(node.value) is int
+                    and node.value in _VALIDATION_STATUS_NAMES
+                ):
+                    name = _VALIDATION_STATUS_NAMES[node.value]
+                    findings.append(
+                        Finding(
+                            self.id, ctx.path, node.lineno, node.col_offset,
+                            f"validation status {node.value} spelled as a "
+                            f"raw literal; import {name} from "
+                            "protocol/_literals so the planes cannot "
+                            "drift on how malformed input is answered",
+                        )
+                    )
+                elif (
+                    isinstance(node.value, str)
+                    and node.value in _INVALID_REASON_NAMES
+                    and not ctx.is_docstring(node)
+                ):
+                    name = _INVALID_REASON_NAMES[node.value]
+                    findings.append(
+                        Finding(
+                            self.id, ctx.path, node.lineno, node.col_offset,
+                            f"invalid-request reason {node.value!r} spelled "
+                            f"as a raw literal; import {name} from "
+                            "protocol/_literals so the metric's reason "
+                            "vocabulary stays canonical",
                         )
                     )
                 elif (

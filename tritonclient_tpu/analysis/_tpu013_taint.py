@@ -1,0 +1,139 @@
+"""TPU013: untrusted request data reaching a dangerous sink.
+
+Every byte of the KServe v2 surface is attacker-controlled, and the
+values parsed out of it — shapes, byte sizes, shm offsets, binary frame
+lengths — feed allocation sizes, ``np.reshape``, buffer slice bounds,
+``range()`` loop bounds, and reserve/alloc page math. The contract is
+that every such value is laundered through ``protocol/_validate.py``
+(``validate_*``) before it reaches any of those sinks; this rule finds
+the flows that skip the laundering, interprocedurally, on the same
+cached call-graph substrate TPU009/TPU011 use.
+
+Two halves:
+
+* ``_taint.py`` records, per function, where wire data enters (sources
+  exist only in the protocol-boundary files: ``server/_http.py``,
+  ``server/_grpc.py``, ``fleet/_http.py``), which sinks each
+  *parameter* reaches unsanitized, and which callee parameters each
+  value is forwarded into. Those facts ride inside the cached
+  :class:`~tritonclient_tpu.analysis._callgraph.FunctionSummary`.
+* This rule runs the interprocedural fixpoint: a parameter is
+  *sinking* if it reaches a sink locally or is forwarded (unsanitized)
+  into a sinking parameter of a callee. A finding is a wire source
+  whose value reaches a sink — locally, or through a chain of calls —
+  and the message carries the full source→sink call path so the fix
+  site is obvious.
+
+Sanitizers recognized: ``validate_*`` calls (the ``protocol/_validate``
+helpers), ``min``/``max`` against an untainted bound, boolean-producing
+builtins, and ``if <compare on the value>: raise/return`` range guards.
+
+Deliberate trusts (e.g. a length-prefixed parse over a buffer already
+capped by ``max_request_bytes``) suppress at the SINK line with
+``# tpulint: disable=TPU013`` and a comment saying why — suppression is
+honored during fact extraction, so the whole transitive flow drops.
+"""
+
+from typing import Dict, List, Sequence, Tuple, Union
+
+from tritonclient_tpu.analysis import _callgraph
+from tritonclient_tpu.analysis._engine import FileContext, Finding, Rule
+
+Slot = Union[int, str]
+
+
+class UntrustedSinkRule(Rule):
+    id = "TPU013"
+    name = "untrusted-sink"
+    description = (
+        "request-derived value reaches an allocation size, reshape, "
+        "slice bound, loop bound, or shm/page-reservation sink without "
+        "passing a protocol/_validate.py sanitizer"
+    )
+
+    def check_project(self, ctxs: Sequence[FileContext]) -> List[Finding]:
+        if not ctxs:
+            return []
+        graph = _callgraph.get_callgraph(ctxs)
+        taints = {
+            key: fn.taint for key, fn in graph.functions.items()
+            if fn.taint is not None
+        }
+        sinking = _sinking_params(taints)
+        linted = {ctx.path for ctx in ctxs if not _is_test_path(ctx.path)}
+        findings: List[Finding] = []
+        seen = set()
+
+        def emit(fn, line, col, message):
+            dedup = (fn.path, line, message)
+            if dedup in seen:
+                return
+            seen.add(dedup)
+            findings.append(Finding(self.id, fn.path, line, col, message))
+
+        for key in sorted(taints):
+            fn = graph.functions[key]
+            if fn.path not in linted:
+                continue
+            rec = taints[key]
+            for kind, detail, line, col, src in rec.flows:
+                emit(fn, line, col,
+                     f"request-derived value reaches {kind} sink "
+                     f"`{detail}` in `{key}` without passing a "
+                     f"validate_* sanitizer")
+            for callee, slot, line, col, src in rec.wire_calls:
+                hit = _lookup(sinking, taints, callee, slot)
+                if hit is None:
+                    continue
+                kind, detail, chain = hit
+                path = " -> ".join([key] + chain)
+                emit(fn, line, col,
+                     f"request-derived value `{src}` flows into "
+                     f"`{callee}` and reaches {kind} sink `{detail}` "
+                     f"via {path} without passing a validate_* "
+                     f"sanitizer")
+        return findings
+
+
+def _is_test_path(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return "tests" in parts or parts[-1].startswith("test_")
+
+
+def _lookup(sinking, taints, callee: str, slot: Slot):
+    """(kind, detail, call chain) if this callee arg slot reaches a sink."""
+    rec = taints.get(callee)
+    if rec is None:
+        return None
+    param = rec.slot_param(slot)
+    if param is None:
+        return None
+    return sinking.get((callee, param))
+
+
+def _sinking_params(
+    taints,
+) -> Dict[Tuple[str, str], Tuple[str, str, List[str]]]:
+    """Fixpoint: (function key, param) -> (sink kind, sink detail,
+    call chain from that function down to the sink's function)."""
+    sinking: Dict[Tuple[str, str], Tuple[str, str, List[str]]] = {}
+    for key, rec in taints.items():
+        for param, sinks in rec.param_sinks.items():
+            kind, detail = sinks[0][0], sinks[0][1]
+            sinking[(key, param)] = (kind, detail, [key])
+    changed = True
+    while changed:
+        changed = False
+        for key, rec in taints.items():
+            for param, calls in rec.param_calls.items():
+                if (key, param) in sinking:
+                    continue
+                for callee, slot, _line in calls:
+                    hit = _lookup(sinking, taints, callee, slot)
+                    if hit is None:
+                        continue
+                    kind, detail, chain = hit
+                    sinking[(key, param)] = (kind, detail, [key] + chain)
+                    changed = True
+                    break
+    return sinking

@@ -1,0 +1,227 @@
+"""TPU014: validation drift between protocol planes.
+
+The HTTP and gRPC front-ends parse the same KServe v2 surface, so the
+set of request fields each plane validates must match — a field range-
+checked on one plane but trusted on the other is an open door that the
+"validated" plane's tests will never catch. This rule diffs the
+per-field sanitizer sets of the two server planes the way TPU008 diffs
+protocol literals:
+
+* **plane drift** — a field validated on one server plane
+  (``server/_http.py`` / ``server/_grpc.py``) and *referenced* on the
+  other, but never validated there. The finding lands on the trusting
+  plane's reference line.
+* **client-only validation** — a field validated in a client library
+  (``http/``, ``grpc/``) that a server plane references but neither
+  server plane validates: the server is trusting clients to police
+  their own input.
+
+"Validated" means a ``validate_*`` call from ``protocol/_validate.py``
+whose target field is known — either statically
+(``validate_shape``→shape) or from the field-name literal passed to
+``validate_int``. "Referenced" means the plane touches the wire key:
+the ``KEY_*`` literal constant, a matching string literal, or a
+matching attribute read. Content-Length is special-cased: the gRPC
+equivalent of the HTTP body cap is ``grpc.max_receive_message_length``,
+so a plane referencing that option counts as validating
+``content_length``.
+
+Deliberate asymmetries suppress with ``# tpulint: disable=TPU014`` on
+the reference line, with a comment saying which plane covers the field
+and how.
+"""
+
+import ast
+from typing import Dict, List, Optional, Sequence
+
+from tritonclient_tpu.analysis._engine import FileContext, Finding, Rule
+
+#: validator name -> canonical field(s) it launders.
+_VALIDATOR_FIELDS = {
+    "validate_shape": ("shape",),
+    "validate_dtype": ("datatype",),
+    "validate_shm_window": ("shared_memory_offset",
+                            "shared_memory_byte_size"),
+    "validate_content_length": ("content_length",),
+    "validate_data_length": ("data_length",),
+}
+
+#: Wire-key constant name -> canonical field.
+_KEY_FIELDS = {
+    "KEY_SHM_OFFSET": "shared_memory_offset",
+    "KEY_SHM_BYTE_SIZE": "shared_memory_byte_size",
+    "KEY_BINARY_DATA_SIZE": "binary_data_size",
+    "KEY_CLASSIFICATION": "classification",
+}
+
+#: Attribute / string-literal spellings -> canonical field.
+_NAME_FIELDS = {
+    "shape": "shape",
+    "datatype": "datatype",
+    "shm_offset": "shared_memory_offset",
+    "shared_memory_offset": "shared_memory_offset",
+    "shm_byte_size": "shared_memory_byte_size",
+    "shared_memory_byte_size": "shared_memory_byte_size",
+    "binary_data_size": "binary_data_size",
+    "classification": "classification",
+    "device_id": "device_id",
+    "content_length": "content_length",
+}
+
+_HTTP_SUFFIX = "server/_http.py"
+_GRPC_SUFFIX = "server/_grpc.py"
+_CLIENT_SEGMENTS = ("/http/", "/grpc/")
+
+
+def _norm(name: str) -> str:
+    return name.strip().lower().replace("-", "_")
+
+
+class _PlaneFacts:
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.validated: Dict[str, int] = {}   # field -> first line
+        self.referenced: Dict[str, int] = {}  # field -> first line
+        self._walk(ctx.tree)
+
+    def _note(self, table: Dict[str, int], field: str, line: int):
+        table.setdefault(field, line)
+
+    def _walk(self, tree):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                self._call(node)
+            elif isinstance(node, ast.Name):
+                field = _KEY_FIELDS.get(node.id)
+                if field:
+                    self._note(self.referenced, field, node.lineno)
+            elif isinstance(node, ast.Attribute):
+                field = _NAME_FIELDS.get(node.attr)
+                if field:
+                    self._note(self.referenced, field, node.lineno)
+            elif isinstance(node, ast.Constant) and isinstance(node.value,
+                                                               str):
+                if self.ctx.is_docstring(node):
+                    continue
+                if node.value == "grpc.max_receive_message_length":
+                    self._note(self.validated, "content_length", node.lineno)
+                    continue
+                field = _NAME_FIELDS.get(_norm(node.value))
+                if field:
+                    self._note(self.referenced, field, node.lineno)
+
+    def _call(self, call: ast.Call):
+        func = call.func
+        name = (func.attr if isinstance(func, ast.Attribute)
+                else func.id if isinstance(func, ast.Name) else "")
+        if not name.startswith("validate_"):
+            return
+        for field in _VALIDATOR_FIELDS.get(name, ()):
+            self._note(self.validated, field, call.lineno)
+        if name == "validate_int":
+            field_arg = None
+            if len(call.args) >= 2:
+                field_arg = call.args[1]
+            else:
+                for kw in call.keywords:
+                    if kw.arg == "field":
+                        field_arg = kw.value
+            if isinstance(field_arg, ast.Constant) and isinstance(
+                field_arg.value, str
+            ):
+                self._note(self.validated, _norm(field_arg.value),
+                           call.lineno)
+            elif isinstance(field_arg, ast.Name):
+                # The field name is a KEY_* wire-key constant (the
+                # TPU003-clean spelling).
+                field = _KEY_FIELDS.get(field_arg.id)
+                if field:
+                    self._note(self.validated, field, call.lineno)
+
+
+class ValidationDriftRule(Rule):
+    id = "TPU014"
+    name = "validation-drift"
+    description = (
+        "request field validated on one protocol plane but referenced "
+        "unvalidated on the other, or validated only client-side"
+    )
+
+    def check_project(self, ctxs: Sequence[FileContext]) -> List[Finding]:
+        http = _find_plane(ctxs, _HTTP_SUFFIX)
+        grpc = _find_plane(ctxs, _GRPC_SUFFIX)
+        findings: List[Finding] = []
+        if http is not None and grpc is not None:
+            findings += self._diff(http, "HTTP", grpc, "gRPC")
+            findings += self._diff(grpc, "gRPC", http, "HTTP")
+        # Client-side-only validation: a client library validates a
+        # field the server planes reference but never validate.
+        servers = [p for p in (http, grpc) if p is not None]
+        if servers:
+            findings += self._client_only(ctxs, servers)
+        return findings
+
+    def _diff(self, src: _PlaneFacts, src_name: str,
+              dst: _PlaneFacts, dst_name: str) -> List[Finding]:
+        out: List[Finding] = []
+        for field, src_line in sorted(src.validated.items()):
+            if field in dst.validated or field not in dst.referenced:
+                continue
+            line = dst.referenced[field]
+            if dst.ctx.is_suppressed(self.id, line):
+                continue
+            out.append(Finding(
+                self.id, dst.ctx.path, line, 0,
+                f"field '{field}' is validated on the {src_name} plane "
+                f"but the {dst_name} plane references it without a "
+                f"validate_* call: the planes have drifted — route both "
+                f"through protocol/_validate.py",
+            ))
+        return out
+
+    def _client_only(self, ctxs: Sequence[FileContext],
+                     servers: List[_PlaneFacts]) -> List[Finding]:
+        client_validated: Dict[str, str] = {}  # field -> client path
+        for ctx in ctxs:
+            path = "/" + ctx.path.replace("\\", "/").lstrip("/")
+            if not any(seg in path for seg in _CLIENT_SEGMENTS):
+                continue
+            if "/server/" in path or _is_test_path(ctx.path):
+                continue
+            facts = _PlaneFacts(ctx)
+            for field in facts.validated:
+                client_validated.setdefault(field, ctx.path)
+        out: List[Finding] = []
+        server_validated = set()
+        for plane in servers:
+            server_validated |= set(plane.validated)
+        for field, client_path in sorted(client_validated.items()):
+            if field in server_validated:
+                continue
+            for plane in servers:
+                if field not in plane.referenced:
+                    continue
+                line = plane.referenced[field]
+                if plane.ctx.is_suppressed(self.id, line):
+                    continue
+                out.append(Finding(
+                    self.id, plane.ctx.path, line, 0,
+                    f"field '{field}' is validated only in the client "
+                    f"({client_path}); the server references it without "
+                    f"a validate_* call and must not trust clients to "
+                    f"police their own input",
+                ))
+        return out
+
+
+def _find_plane(ctxs: Sequence[FileContext],
+                suffix: str) -> Optional[_PlaneFacts]:
+    for ctx in ctxs:
+        if ctx.path.replace("\\", "/").endswith(suffix):
+            return _PlaneFacts(ctx)
+    return None
+
+
+def _is_test_path(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return "tests" in parts or parts[-1].startswith("test_")

@@ -61,8 +61,15 @@ from tritonclient_tpu.protocol._literals import (
     HEDGE_OUTCOME_HEDGE,
     HEDGE_OUTCOME_PRIMARY,
     MODEL_ROUTE_RE,
+    MAX_REQUEST_BYTES_DEFAULT,
     REPOSITORY_ROUTE_RE,
     SHM_ROUTE_RE,
+    STATUS_INVALID,
+    STATUS_TOO_LARGE,
+)
+from tritonclient_tpu.protocol._validate import (
+    ValidationError,
+    validate_content_length,
 )
 
 #: Request headers the proxy forwards verbatim (everything else is
@@ -164,7 +171,14 @@ class _RouterHandler(BaseHTTPRequestHandler):
     # -- plumbing -------------------------------------------------------------
 
     def _read_body(self) -> bytes:
-        length = int(self.headers.get("Content-Length", 0))
+        # The fleet proxy reads the whole body before forwarding, so the
+        # declared length must be capped BEFORE it sizes a read — same
+        # 413 contract as the replica front-end.
+        cap = getattr(self.server, "max_request_bytes",
+                      MAX_REQUEST_BYTES_DEFAULT)
+        length = validate_content_length(
+            self.headers.get("Content-Length", 0), cap
+        )
         return self.rfile.read(length) if length else b""
 
     def _send(self, status: int, body: bytes,
@@ -200,6 +214,12 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._route(method)
         except FleetError as e:
             self._send_fleet_error(e)
+        except ValidationError as e:
+            if e.status == STATUS_TOO_LARGE:
+                # The over-cap body was never read; drop the connection so
+                # it cannot be parsed as the next keep-alive request.
+                self.close_connection = True
+            self._send_json({"error": str(e)}, e.status)
         except _ExchangeError as e:
             # A proxied non-inference exchange failed (inference paths
             # handle their own failover before this).
@@ -298,7 +318,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
             routable = len(router.replica_set.routable())
             return self._send_json(
                 {"ready": ready, "routable_replicas": routable},
-                200 if ready else 400,
+                200 if ready else STATUS_INVALID,
             )
         if path == EP_FLEET_STATUS:
             self._read_body()
@@ -327,7 +347,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     else:
                         result = router.fleetscope.set_objective(doc)
                 except (ValueError, TypeError) as e:
-                    return self._send_json({"error": str(e)}, 400)
+                    return self._send_json({"error": str(e)}, STATUS_INVALID)
                 # Journaled (router-local: never replayed to replicas)
                 # so objectives survive a router restart.
                 router.record_admin(method, path, body, {})
@@ -346,7 +366,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
                         doc.get("replica", ""), doc.get("cohort", "")
                     )
                 except ValueError as e:
-                    return self._send_json({"error": str(e)}, 400)
+                    return self._send_json({"error": str(e)}, STATUS_INVALID)
                 router.record_admin(method, path, body, {})
                 return self._send_json(result)
             names = [r["name"] for r in router.replica_set.snapshot()]
@@ -367,7 +387,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
                         name, options.get("cohort", "")
                     )
                 except ValueError as e:
-                    return self._send_json({"error": str(e)}, 400)
+                    return self._send_json({"error": str(e)}, STATUS_INVALID)
                 router.record_admin(method, path, body, {})
                 return self._send_json(detail)
             try:
@@ -427,7 +447,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
             status, relay, payload = self._exchange(
                 replica.http_address, method, body, headers,
             )
-            if status >= 400:
+            if status >= STATUS_INVALID:
                 return self._relay(status, relay, payload)
             last = (status, relay, payload)
         self.router.record_admin(
@@ -666,9 +686,11 @@ class RouterHTTPFrontend:
     """Threaded HTTP server hosting a FleetRouter."""
 
     def __init__(self, router: FleetRouter, host: str = "127.0.0.1",
-                 port: int = 0, verbose: bool = False):
+                 port: int = 0, verbose: bool = False,
+                 max_request_bytes: int = MAX_REQUEST_BYTES_DEFAULT):
         self._server = _RouterHTTPServer((host, port), _RouterHandler)
         self._server.router = router
+        self._server.max_request_bytes = max_request_bytes
         self._server.pool = _ConnPool()
         # A rejoined (crash-restarted) replica is a NEW process on the
         # old address: pooled keep-alive connections to the dead

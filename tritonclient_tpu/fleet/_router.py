@@ -25,6 +25,7 @@ from tritonclient_tpu.protocol._literals import (
     QUOTA_REASONS,
     RETRY_REASONS,
     SLO_WINDOW_SLOW,
+    STATUS_INVALID,
     STATUS_OVER_QUOTA,
 )
 
@@ -382,7 +383,7 @@ class FleetRouter:
                 )
             except OSError:
                 return False
-            if status >= 400:
+            if status >= STATUS_INVALID:
                 return False
         return True
 

@@ -1,0 +1,45 @@
+"""tpufuzz: seeded, deterministic, structure-aware protocol fuzzing for
+the untrusted request plane.
+
+tpufuzz is the dynamic half of the TPU013 story. The static taint rule
+(``tritonclient_tpu/analysis/_tpu013_taint.py``) proves that
+request-derived integers cannot reach allocation/indexing sinks without
+a ``validate_*`` sanitizer; tpufuzz *witnesses* the same boundary from
+outside by mutating well-formed KServe v2 requests (committed corpus
+seeds under ``corpus/``) and asserting the server's contract on both
+planes:
+
+* no 5xx / no unclassified gRPC status for malformed input — every
+  rejection must be a typed 4xx with a JSON error body (HTTP) or a
+  mapped status such as ``INVALID_ARGUMENT`` (gRPC);
+* no hang — each case is bounded by a client-side deadline, and a
+  final well-formed probe per plane proves the server still serves;
+* no leak — the run executes under ``sanitize`` report mode and folds
+  any sanitizer findings (including ``check_leaks``) into its failures.
+
+Everything is deterministic: the only entropy is a seeded
+``random.Random``, corpus and mutation catalogs iterate in sorted
+order, and the report contains no timestamps, ports, or addresses.
+Same seed + same corpus -> byte-identical report and SARIF, which is
+what lets CI diff two consecutive runs and fail on any drift.
+
+Entry point: ``scripts/tpufuzz.py`` (see ``--self-check`` for the
+offline determinism harness). Failures render as SARIF rule TPU013 so
+``scripts/tpusan_report.py`` can classify them against the static
+findings stream.
+"""
+
+from tritonclient_tpu.fuzz._mutate import (  # noqa: F401
+    CATALOG,
+    FUZZ_MAX_REQUEST_BYTES,
+    generate_specs,
+    load_corpus,
+)
+from tritonclient_tpu.fuzz._run import (  # noqa: F401
+    Inexpressible,
+    build_grpc_request,
+    expressible,
+    render_sarif,
+    report_findings,
+    run_fuzz,
+)

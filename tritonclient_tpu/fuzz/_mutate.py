@@ -1,0 +1,333 @@
+"""Structure-aware, seeded mutation of KServe v2 inference requests.
+
+Every mutation is a pure function ``(seed_request, rng) -> spec``: the
+spec is a plain JSON-serializable dict that fully describes one fuzz
+case — the (possibly broken) inference-header JSON, optional binary
+tails, optional raw-body override, header lies, or an shm-register
+payload. Plane encoders in ``_run.py`` turn a spec into an actual HTTP
+request or protobuf message; a spec the gRPC plane cannot express
+(e.g. a dict where the proto wants an int64) is skipped there, and the
+skip itself is deterministic because it depends only on the spec.
+
+Determinism contract: the ONLY entropy source is the ``random.Random``
+the caller seeds. No wall clock, no os.urandom, no dict-order
+dependence (catalog and corpus iterate sorted). Same seed + same corpus
+=> byte-identical spec stream, which is what lets CI diff two
+consecutive runs.
+"""
+
+import copy
+import json
+import os
+from typing import Callable, Dict, List, Tuple
+
+#: Body cap the fuzz server is configured with; the content-length-bomb
+#: and oversized-message mutations size themselves against it.
+FUZZ_MAX_REQUEST_BYTES = 1 << 20
+
+_CORPUS_DIR = os.path.join(os.path.dirname(__file__), "corpus")
+
+
+def load_corpus(corpus_dir: str = _CORPUS_DIR) -> List[dict]:
+    """Committed seed requests, sorted by file name for determinism."""
+    seeds = []
+    for fname in sorted(os.listdir(corpus_dir)):
+        if not fname.endswith(".json"):
+            continue
+        with open(os.path.join(corpus_dir, fname), "r",
+                  encoding="utf-8") as f:
+            seed = json.load(f)
+        seed.setdefault("name", fname[:-5])
+        seeds.append(seed)
+    return seeds
+
+
+def _base_spec(seed: dict, mutation: str) -> dict:
+    return {
+        "seed": seed["name"],
+        "mutation": mutation,
+        "model": seed["model"],
+        "endpoint": "infer",
+        "js": {
+            "inputs": copy.deepcopy(seed.get("inputs", [])),
+            "outputs": copy.deepcopy(seed.get("outputs", [])),
+        },
+        "binary": None,        # {input_name: {"claim": .., "blob_hex": ..}}
+        "raw_body": None,      # hex-encoded body override (HTTP only)
+        "content_length": None,  # Content-Length lie (HTTP only)
+        "header_len": None,    # Inference-Header-Content-Length override
+        "shm": None,           # shm-register payload
+    }
+
+
+def _pick_input(spec: dict, rng) -> dict:
+    inputs = spec["js"]["inputs"]
+    return inputs[rng.randrange(len(inputs))]
+
+
+# -- the catalog -----------------------------------------------------------
+
+
+def m_baseline_valid(seed, rng):
+    """Unmutated seed: must succeed — catches over-rejection drift."""
+    return _base_spec(seed, "baseline_valid")
+
+
+def m_missing_inputs(seed, rng):
+    spec = _base_spec(seed, "missing_inputs")
+    if rng.random() < 0.5:
+        spec["js"]["inputs"] = []
+    else:
+        spec["js"]["inputs"] = spec["js"]["inputs"][:1]
+    return spec
+
+
+def m_drop_required(seed, rng):
+    spec = _base_spec(seed, "drop_required")
+    t = _pick_input(spec, rng)
+    t.pop(rng.choice(["name", "datatype", "shape"]), None)
+    return spec
+
+
+def m_type_confusion(seed, rng):
+    spec = _base_spec(seed, "type_confusion")
+    t = _pick_input(spec, rng)
+    field = rng.choice(["shape", "datatype", "data"])
+    t[field] = rng.choice(["16", 16, None, {"x": 1}, [[1, 2]], True])
+    return spec
+
+
+def m_shape_negative(seed, rng):
+    spec = _base_spec(seed, "shape_negative")
+    t = _pick_input(spec, rng)
+    shape = list(t.get("shape", [1]))
+    shape[rng.randrange(len(shape))] = rng.choice([-1, -(2 ** 31), -(2 ** 62)])
+    t["shape"] = shape
+    return spec
+
+
+def m_shape_huge(seed, rng):
+    spec = _base_spec(seed, "shape_huge")
+    t = _pick_input(spec, rng)
+    if rng.random() < 0.5:
+        shape = list(t.get("shape", [1]))
+        shape[rng.randrange(len(shape))] = rng.choice(
+            [2 ** 31, 2 ** 40, 2 ** 62])
+        t["shape"] = shape
+    else:
+        t["shape"] = [65536, 65536]  # product bomb, small spelling
+    return spec
+
+
+def m_shape_rank_bomb(seed, rng):
+    spec = _base_spec(seed, "shape_rank_bomb")
+    t = _pick_input(spec, rng)
+    t["shape"] = [1] * rng.choice([33, 100, 1000])
+    return spec
+
+
+def m_shape_bad_dims(seed, rng):
+    spec = _base_spec(seed, "shape_bad_dims")
+    t = _pick_input(spec, rng)
+    shape = list(t.get("shape", [1]))
+    shape[rng.randrange(len(shape))] = rng.choice([1.5, True, "4", None])
+    t["shape"] = shape
+    return spec
+
+
+def m_data_mismatch(seed, rng):
+    spec = _base_spec(seed, "data_mismatch")
+    t = _pick_input(spec, rng)
+    data = list(t.get("data", [])) or [0]
+    if rng.random() < 0.5:
+        data = data[: max(1, len(data) // 2)]
+    else:
+        data = data + data
+    t["data"] = data
+    t.pop("parameters", None)  # force the dense-JSON path
+    return spec
+
+
+def m_dtype_unknown(seed, rng):
+    spec = _base_spec(seed, "dtype_unknown")
+    t = _pick_input(spec, rng)
+    t["datatype"] = rng.choice(["FP128", "int32", "", "X" * 64, "BYTES2"])
+    return spec
+
+
+def m_binary_truncated(seed, rng):
+    spec = _base_spec(seed, "binary_truncated")
+    t = _pick_input(spec, rng)
+    t.pop("data", None)
+    t.pop("parameters", None)
+    claim = 64
+    short = rng.randrange(0, claim)  # strictly fewer bytes than claimed
+    t["parameters"] = {"binary_data_size": claim}
+    spec["binary"] = {t["name"]: {"claim": claim,
+                                  "blob_hex": ("ab" * short)}}
+    return spec
+
+
+def m_binary_size_lie(seed, rng):
+    spec = _base_spec(seed, "binary_size_lie")
+    t = _pick_input(spec, rng)
+    t.pop("data", None)
+    t.pop("parameters", None)
+    claim = rng.choice([-1, -(2 ** 40), 2 ** 40, "sixty-four", None])
+    t["parameters"] = {"binary_data_size": claim}
+    spec["binary"] = {t["name"]: {"claim": 0, "blob_hex": "ab" * 64}}
+    return spec
+
+
+def m_header_len_abuse(seed, rng):
+    spec = _base_spec(seed, "header_len_abuse")
+    spec["header_len"] = rng.choice([-1, 10 ** 9, "NaN", 2 ** 62, ""])
+    return spec
+
+
+def m_junk_json(seed, rng):
+    spec = _base_spec(seed, "junk_json")
+    payload = json.dumps(spec["js"]).encode()
+    choice = rng.randrange(4)
+    if choice == 0:
+        body = payload[: rng.randrange(1, len(payload))]  # truncated JSON
+    elif choice == 1:
+        body = b"\xff\xfe{" + payload[:32]
+    elif choice == 2:
+        body = b""
+    else:
+        body = b"[" + payload + b"]"  # a list where a dict is expected
+    spec["raw_body"] = body.hex()
+    return spec
+
+
+def m_content_length_bomb(seed, rng):
+    spec = _base_spec(seed, "content_length_bomb")
+    spec["content_length"] = FUZZ_MAX_REQUEST_BYTES + rng.choice(
+        [1, 4096, 2 ** 31, 2 ** 62])
+    spec["raw_body"] = b"".hex()  # the cap must reject BEFORE any read
+    return spec
+
+
+def m_oversized_message(seed, rng):
+    spec = _base_spec(seed, "oversized_message")
+    t = _pick_input(spec, rng)
+    t.pop("data", None)
+    t.pop("parameters", None)
+    nbytes = FUZZ_MAX_REQUEST_BYTES + 65536
+    t["parameters"] = {"binary_data_size": nbytes}
+    # Deterministic filler, sized just over the plane's body cap.
+    spec["binary"] = {t["name"]: {"claim": nbytes, "blob_hex": None,
+                                  "blob_fill": nbytes}}
+    return spec
+
+
+def m_shm_param_abuse(seed, rng):
+    spec = _base_spec(seed, "shm_param_abuse")
+    t = _pick_input(spec, rng)
+    t.pop("data", None)
+    t["parameters"] = {
+        "shared_memory_region": rng.choice(["fuzz_region", "nope", ""]),
+        "shared_memory_offset": rng.choice([-1, -(2 ** 40), 0, 2 ** 62]),
+        "shared_memory_byte_size": rng.choice([-1, 2 ** 62, 64, "big"]),
+    }
+    return spec
+
+
+def m_shm_register_abuse(seed, rng):
+    spec = _base_spec(seed, "shm_register_abuse")
+    spec["endpoint"] = "shm_register"
+    spec["shm"] = {
+        "name": rng.choice(["fuzz_reg", "", "a" * 512]),
+        "key": "/tpufuzz_no_such_key",
+        "offset": rng.choice([-1, -(2 ** 40), 0, 2 ** 62]),
+        "byte_size": rng.choice([-1, 2 ** 62, 4096]),
+    }
+    return spec
+
+
+def m_classification_abuse(seed, rng):
+    spec = _base_spec(seed, "classification_abuse")
+    outs = spec["js"]["outputs"] or [{"name": "OUTPUT0"}]
+    out = outs[rng.randrange(len(outs))]
+    out["parameters"] = {
+        "classification": rng.choice([-1, 2 ** 40, "many", 1.5, None])
+    }
+    spec["js"]["outputs"] = outs
+    return spec
+
+
+def m_mixed_contents(seed, rng):
+    """gRPC-only shape: contents AND raw_input_contents both set."""
+    spec = _base_spec(seed, "mixed_contents")
+    t = _pick_input(spec, rng)
+    blob = "cd" * 64
+    spec["binary"] = {t["name"]: {"claim": 64, "blob_hex": blob}}
+    # keep t["data"] so the encoder also fills typed contents
+    return spec
+
+
+def m_id_unicode(seed, rng):
+    spec = _base_spec(seed, "id_unicode")
+    spec["js"]["id"] = rng.choice(["\U0001d518" * 256, "\x00\x01", "i" * 4096])
+    return spec
+
+
+#: name -> (planes, mutator). Sorted iteration keeps the stream stable.
+CATALOG: Dict[str, Tuple[Tuple[str, ...], Callable]] = {
+    "baseline_valid": (("http", "grpc"), m_baseline_valid),
+    "missing_inputs": (("http", "grpc"), m_missing_inputs),
+    "drop_required": (("http", "grpc"), m_drop_required),
+    "type_confusion": (("http", "grpc"), m_type_confusion),
+    "shape_negative": (("http", "grpc"), m_shape_negative),
+    "shape_huge": (("http", "grpc"), m_shape_huge),
+    "shape_rank_bomb": (("http", "grpc"), m_shape_rank_bomb),
+    "shape_bad_dims": (("http", "grpc"), m_shape_bad_dims),
+    "data_mismatch": (("http", "grpc"), m_data_mismatch),
+    "dtype_unknown": (("http", "grpc"), m_dtype_unknown),
+    "binary_truncated": (("http", "grpc"), m_binary_truncated),
+    "binary_size_lie": (("http",), m_binary_size_lie),
+    "header_len_abuse": (("http",), m_header_len_abuse),
+    "junk_json": (("http",), m_junk_json),
+    "content_length_bomb": (("http",), m_content_length_bomb),
+    "oversized_message": (("http", "grpc"), m_oversized_message),
+    "shm_param_abuse": (("http", "grpc"), m_shm_param_abuse),
+    "shm_register_abuse": (("http", "grpc"), m_shm_register_abuse),
+    "classification_abuse": (("http", "grpc"), m_classification_abuse),
+    "mixed_contents": (("grpc",), m_mixed_contents),
+    "id_unicode": (("http", "grpc"), m_id_unicode),
+}
+
+
+def generate_specs(seeds: List[dict], rng, count_per_plane: int,
+                   planes: Tuple[str, ...],
+                   expressible: Callable = None) -> List[dict]:
+    """A deterministic spec stream with at least ``count_per_plane``
+    cases expressible on each requested plane.
+
+    ``expressible(spec, plane)`` narrows the catalog's plane tags to
+    what the plane encoder can actually build (e.g. a dict where the
+    proto wants an int64 is HTTP-only); it must be a pure function of
+    the spec so the stream stays deterministic.
+    """
+    names = sorted(CATALOG)
+    specs: List[dict] = []
+    counts = {p: 0 for p in planes}
+    i = 0
+    while any(counts[p] < count_per_plane for p in planes):
+        seed = seeds[i % len(seeds)]
+        name = names[rng.randrange(len(names))]
+        mut_planes, fn = CATALOG[name]
+        spec = fn(seed, rng)
+        spec["id"] = f"case-{i:05d}"
+        spec["planes"] = [
+            p for p in planes
+            if p in mut_planes
+            and counts[p] < count_per_plane
+            and (expressible is None or expressible(spec, p))
+        ]
+        specs.append(spec)
+        for p in spec["planes"]:
+            counts[p] += 1
+        i += 1
+    return specs

@@ -1,0 +1,425 @@
+"""tpufuzz runner: drive mutated KServe v2 requests at a live in-process
+server on both protocol planes and assert the no-500 / no-hang / no-leak
+contract.
+
+The runner is the dynamic witness for TPU013: every failure is emitted
+as a ``TPU013`` SARIF result attributed to the plane's front-end file,
+so ``scripts/tpusan_report.py --rules TPU013`` can diff the fuzzer's
+evidence against the static taint picture (witnessed / unexercised /
+unpredicted) exactly the way tpusan runtime findings diff against the
+other paired rules.
+
+Determinism: the report contains no timestamps, addresses, or ports —
+only seed, counts, sorted histograms, failures, and a digest over every
+``case-id:plane:outcome`` triple. Two runs with the same seed and
+corpus must produce byte-identical report and SARIF files; CI enforces
+exactly that.
+"""
+
+import hashlib
+import http.client
+import json
+import socket
+from typing import Dict, List, Optional, Tuple
+
+from tritonclient_tpu.analysis._engine import Finding
+from tritonclient_tpu.fuzz import _mutate
+
+#: SARIF rule metadata for tpufuzz results (same id as the static taint
+#: rule — that identity is what lets the report streams merge).
+RULES_META = [
+    {
+        "id": "TPU013",
+        "name": "untrusted-sink",
+        "shortDescription": {
+            "text": "malformed request produced a server error, hang, or "
+            "leak instead of a typed validation rejection"
+        },
+    },
+]
+
+_PLANE_FILES = {
+    "http": "tritonclient_tpu/server/_http.py",
+    "grpc": "tritonclient_tpu/server/_grpc.py",
+}
+
+#: gRPC status codes a validation rejection may legitimately map to.
+_GRPC_ALLOWED = {
+    "INVALID_ARGUMENT", "NOT_FOUND", "RESOURCE_EXHAUSTED",
+    "UNIMPLEMENTED", "FAILED_PRECONDITION", "OUT_OF_RANGE",
+}
+
+_INT64_MIN, _INT64_MAX = -(2 ** 63), 2 ** 63 - 1
+_HTTP_TIMEOUT = 30.0
+_GRPC_TIMEOUT = 30.0
+
+
+class Inexpressible(Exception):
+    """The spec cannot be encoded on this plane (deterministic skip)."""
+
+
+# -- gRPC encoding ---------------------------------------------------------
+
+
+def _require(cond, why: str):
+    if not cond:
+        raise Inexpressible(why)
+
+
+def _is_int(v) -> bool:
+    return isinstance(v, int) and not isinstance(v, bool)
+
+
+def _set_param(params, key, value):
+    if isinstance(value, bool):
+        params[key].bool_param = value
+    elif _is_int(value):
+        _require(_INT64_MIN <= value <= _INT64_MAX, "int64 range")
+        params[key].int64_param = value
+    elif isinstance(value, str):
+        params[key].string_param = value
+    elif isinstance(value, float):
+        params[key].double_param = value
+    else:
+        raise Inexpressible(f"param type {type(value).__name__}")
+
+
+def _blob_bytes(entry: dict) -> bytes:
+    if entry.get("blob_hex") is not None:
+        return bytes.fromhex(entry["blob_hex"])
+    return b"\xab" * int(entry["blob_fill"])
+
+
+def build_grpc_request(spec: dict, pb):
+    """Spec -> protobuf message(s); raises :class:`Inexpressible` when
+    the typed proto surface cannot carry the mutation."""
+    if spec["endpoint"] == "shm_register":
+        shm = spec["shm"]
+        # offset/byte_size are uint64 on the wire: negative or huge
+        # values simply cannot be encoded on this plane.
+        _require(_is_int(shm["offset"]), "offset type")
+        _require(_is_int(shm["byte_size"]), "byte_size type")
+        _require(0 <= shm["offset"] < 2 ** 64, "offset range")
+        _require(0 <= shm["byte_size"] < 2 ** 64, "byte_size range")
+        return pb.SystemSharedMemoryRegisterRequest(
+            name=shm["name"], key=shm["key"], offset=shm["offset"],
+            byte_size=shm["byte_size"],
+        )
+    js = spec["js"]
+    req = pb.ModelInferRequest(model_name=spec["model"])
+    rid = js.get("id")
+    if rid is not None:
+        _require(isinstance(rid, str), "id type")
+        req.id = rid
+    binary = spec.get("binary") or {}
+    for t in js.get("inputs", []):
+        _require(isinstance(t, dict), "input shape")
+        tensor = req.inputs.add()
+        name = t.get("name")
+        _require(isinstance(name, str), "input name")
+        tensor.name = name
+        dt = t.get("datatype", "")
+        _require(isinstance(dt, str), "datatype type")
+        tensor.datatype = dt
+        shape = t.get("shape", [])
+        _require(isinstance(shape, list), "shape type")
+        for d in shape:
+            _require(_is_int(d), "shape dim type")
+            _require(_INT64_MIN <= d <= _INT64_MAX, "shape dim range")
+            tensor.shape.append(d)
+        for key, value in sorted((t.get("parameters") or {}).items()):
+            if key == "binary_data_size":
+                continue  # HTTP framing; gRPC carries raw_input_contents
+            _set_param(tensor.parameters, key, value)
+        data = t.get("data")
+        if data is not None and name not in binary:
+            _require(isinstance(data, list), "data type")
+            if all(isinstance(v, str) for v in data):
+                tensor.contents.bytes_contents.extend(
+                    v.encode() for v in data)
+            elif all(_is_int(v) for v in data):
+                _require(
+                    all(-(2 ** 31) <= v < 2 ** 31 for v in data),
+                    "int32 range")
+                tensor.contents.int_contents.extend(data)
+            else:
+                raise Inexpressible("mixed data elements")
+    for name in sorted(binary):
+        req.raw_input_contents.append(_blob_bytes(binary[name]))
+    for o in js.get("outputs", []):
+        _require(isinstance(o, dict), "output shape")
+        out = req.outputs.add()
+        oname = o.get("name")
+        _require(isinstance(oname, str), "output name")
+        out.name = oname
+        for key, value in sorted((o.get("parameters") or {}).items()):
+            _set_param(out.parameters, key, value)
+    return req
+
+
+def expressible(spec: dict, plane: str) -> bool:
+    """Pure plane-expressibility test used during spec generation."""
+    if plane != "grpc":
+        return True
+    from tritonclient_tpu.protocol import pb
+
+    try:
+        build_grpc_request(spec, pb)
+    except Inexpressible:
+        return False
+    return True
+
+
+# -- HTTP encoding ---------------------------------------------------------
+
+
+def _http_payload(spec: dict) -> Tuple[str, Dict[str, str], bytes]:
+    """(path, headers, body) for one spec."""
+    if spec["endpoint"] == "shm_register":
+        shm = spec["shm"]
+        path = f"/v2/systemsharedmemory/region/{shm['name']}/register"
+        body = json.dumps({
+            "key": shm["key"], "offset": shm["offset"],
+            "byte_size": shm["byte_size"],
+        }).encode()
+        return path, {}, body
+    path = f"/v2/models/{spec['model']}/infer"
+    headers: Dict[str, str] = {}
+    if spec.get("raw_body") is not None:
+        return path, headers, bytes.fromhex(spec["raw_body"])
+    header_bytes = json.dumps(spec["js"]).encode()
+    body = header_bytes
+    binary = spec.get("binary") or {}
+    if binary:
+        for name in sorted(binary):
+            body += _blob_bytes(binary[name])
+        headers["Inference-Header-Content-Length"] = str(len(header_bytes))
+    if spec.get("header_len") is not None:
+        headers["Inference-Header-Content-Length"] = str(spec["header_len"])
+    return path, headers, body
+
+
+def http_case(spec: dict, host: str, port: int) -> Tuple[str, Optional[str]]:
+    """Run one spec over HTTP -> (outcome label, failure description)."""
+    path, headers, body = _http_payload(spec)
+    conn = http.client.HTTPConnection(host, port, timeout=_HTTP_TIMEOUT)
+    try:
+        conn.putrequest("POST", path)
+        conn.putheader("Content-Type", "application/json")
+        length = spec.get("content_length")
+        conn.putheader(
+            "Content-Length", str(length if length is not None else len(body))
+        )
+        for k, v in headers.items():
+            conn.putheader(k, v)
+        conn.endheaders()
+        if body:
+            try:
+                conn.send(body)
+            except (BrokenPipeError, ConnectionResetError):
+                # The server may reject oversized bodies before reading
+                # them fully; the 413 is already buffered on the socket.
+                pass
+        resp = conn.getresponse()
+        payload = resp.read()
+        status = resp.status
+    except socket.timeout:
+        return "hang", "no response within the client timeout"
+    except (ConnectionError, http.client.HTTPException) as e:
+        return "conn-error", f"connection failed: {type(e).__name__}"
+    finally:
+        conn.close()
+    if status >= 500:
+        return f"http-{status}", (
+            f"HTTP {status} (server error) for mutation "
+            f"'{spec['mutation']}' — malformed input must be a typed 4xx")
+    if 400 <= status < 500:
+        try:
+            doc = json.loads(payload.decode("utf-8", "replace"))
+            if not isinstance(doc.get("error"), str):
+                raise ValueError
+        except (ValueError, AttributeError):
+            return f"http-{status}", (
+                f"HTTP {status} without a JSON error body for mutation "
+                f"'{spec['mutation']}' — rejections must be typed")
+        return f"http-{status}", None
+    return f"http-{status}", None
+
+
+# -- gRPC execution --------------------------------------------------------
+
+
+def grpc_case(spec: dict, channel) -> Tuple[str, Optional[str]]:
+    import grpc
+
+    from tritonclient_tpu.protocol import GRPCInferenceServiceStub, pb
+
+    try:
+        req = build_grpc_request(spec, pb)
+    except Inexpressible as e:
+        return "skip", f"inexpressible: {e}"
+    stub = GRPCInferenceServiceStub(channel)
+    call = (stub.SystemSharedMemoryRegister
+            if spec["endpoint"] == "shm_register" else stub.ModelInfer)
+    try:
+        call(req, timeout=_GRPC_TIMEOUT)
+        return "grpc-OK", None
+    except grpc.RpcError as e:
+        code = e.code().name
+        if code in _GRPC_ALLOWED:
+            return f"grpc-{code}", None
+        if code == "DEADLINE_EXCEEDED":
+            return f"grpc-{code}", (
+                f"no response within the client deadline for mutation "
+                f"'{spec['mutation']}' — hang")
+        return f"grpc-{code}", (
+            f"gRPC {code} for mutation '{spec['mutation']}' — malformed "
+            f"input must be INVALID_ARGUMENT/RESOURCE_EXHAUSTED")
+
+
+# -- the run ---------------------------------------------------------------
+
+
+def run_fuzz(seed: int, requests_per_plane: int,
+             planes: Tuple[str, ...] = ("http", "grpc"),
+             corpus_dir: Optional[str] = None) -> dict:
+    """Boot an in-process server, fuzz every requested plane, return the
+    deterministic report dict."""
+    import random
+
+    from tritonclient_tpu import sanitize
+    from tritonclient_tpu.server import InferenceServer
+
+    seeds = _mutate.load_corpus(corpus_dir or _mutate._CORPUS_DIR)
+    rng = random.Random(seed)
+    specs = _mutate.generate_specs(
+        seeds, rng, requests_per_plane, planes, expressible=expressible)
+
+    failures: List[dict] = []
+    outcome_lines: List[str] = []
+    histogram: Dict[str, int] = {}
+    status_counts: Dict[str, int] = {}
+    executed = {p: 0 for p in planes}
+
+    sanitize.enable("report")
+    sanitize.reset()
+    try:
+        server = InferenceServer(
+            http="http" in planes,
+            grpc="grpc" in planes,
+            max_request_bytes=_mutate.FUZZ_MAX_REQUEST_BYTES,
+        )
+        server.start()
+        try:
+            grpc_channel = None
+            if "grpc" in planes:
+                import grpc as _grpc_mod
+
+                grpc_channel = _grpc_mod.insecure_channel(server.grpc_address)
+            host, port = None, None
+            if "http" in planes:
+                addr = server.http_address
+                host, port = addr.rsplit(":", 1)
+                port = int(port)
+            for spec in specs:
+                for plane in spec["planes"]:
+                    if plane == "http":
+                        outcome, problem = http_case(spec, host, port)
+                    else:
+                        outcome, problem = grpc_case(spec, grpc_channel)
+                    if outcome == "skip":
+                        continue
+                    executed[plane] += 1
+                    histogram[spec["mutation"]] = (
+                        histogram.get(spec["mutation"], 0) + 1)
+                    status_counts[outcome] = status_counts.get(outcome, 0) + 1
+                    outcome_lines.append(f"{spec['id']}:{plane}:{outcome}")
+                    ok_states = ("http-200", "grpc-OK")
+                    seed_doc = next(
+                        s for s in seeds if s["name"] == spec["seed"])
+                    if (problem is None
+                            and spec["mutation"] == "baseline_valid"
+                            and seed_doc.get("expect_ok")
+                            and outcome not in ok_states):
+                        problem = (
+                            f"well-formed baseline request rejected with "
+                            f"{outcome} — over-rejection")
+                    if problem is not None:
+                        failures.append({
+                            "case": spec["id"], "plane": plane,
+                            "seed": spec["seed"],
+                            "mutation": spec["mutation"],
+                            "outcome": outcome, "detail": problem,
+                        })
+            # Still-serving probe: the server must answer a well-formed
+            # request after absorbing the whole corpus.
+            for plane in planes:
+                probe = _mutate.m_baseline_valid(seeds[0], rng)
+                probe["id"] = f"probe-{plane}"
+                probe["planes"] = [plane]
+                if plane == "http":
+                    outcome, problem = http_case(probe, host, port)
+                    alive = outcome == "http-200"
+                else:
+                    outcome, problem = grpc_case(probe, grpc_channel)
+                    alive = outcome == "grpc-OK"
+                outcome_lines.append(f"probe:{plane}:{outcome}")
+                if not alive:
+                    failures.append({
+                        "case": "probe", "plane": plane,
+                        "seed": seeds[0]["name"],
+                        "mutation": "baseline_valid", "outcome": outcome,
+                        "detail": "server no longer serving well-formed "
+                                  "requests after the fuzz run",
+                    })
+            if grpc_channel is not None:
+                grpc_channel.close()
+        finally:
+            server.stop()
+        sanitize.check_leaks()
+        san_findings = sanitize.findings()
+    finally:
+        sanitize.disable()
+    for f in san_findings:
+        failures.append({
+            "case": "tpusan", "plane": "-", "seed": "-",
+            "mutation": f.rule, "outcome": "sanitizer",
+            "detail": f"{f.rule} {f.path}:{f.line}: {f.message}",
+        })
+
+    failures.sort(key=lambda f: (f["case"], f["plane"], f["detail"]))
+    digest = hashlib.sha256(
+        "\n".join(outcome_lines).encode()).hexdigest()
+    return {
+        "tool": "tpufuzz",
+        "seed": seed,
+        "requests_per_plane": requests_per_plane,
+        "planes": sorted(planes),
+        "corpus": [s["name"] for s in seeds],
+        "executed": {p: executed[p] for p in sorted(executed)},
+        "mutations": {k: histogram[k] for k in sorted(histogram)},
+        "outcomes": {k: status_counts[k] for k in sorted(status_counts)},
+        "cases_digest": digest,
+        "failures": failures,
+    }
+
+
+def report_findings(report: dict) -> List[Finding]:
+    """Failures as TPU013 findings attributed to the plane front-end."""
+    out = []
+    for f in report["failures"]:
+        path = _PLANE_FILES.get(f["plane"], "tritonclient_tpu/server")
+        if f["plane"] == "-":  # sanitizer finding: keep its own path
+            path = f["detail"].split(" ", 2)[1].rsplit(":", 2)[0]
+        out.append(Finding(
+            "TPU013", path, 1, 0,
+            f"tpufuzz[{f['seed']}:{f['mutation']}:{f['case']}]: "
+            f"{f['detail']}"))
+    return out
+
+
+def render_sarif(report: dict) -> str:
+    from tritonclient_tpu.analysis._sarif import render_sarif as _render
+
+    return _render(report_findings(report), RULES_META,
+                   tool_name="tpufuzz", level_for={"TPU013": "error"})

@@ -17,6 +17,7 @@ from tritonclient_tpu.protocol._literals import (
     KEY_SEQUENCE_START,
     KEY_TIMEOUT,
     RESERVED_REQUEST_PARAMS,
+    STATUS_INVALID,
 )
 
 # Upload buffer granularity for chunked request bodies — reference parity
@@ -26,7 +27,7 @@ MAX_UPLOAD_CHUNK_BYTES = 16 * 1024 * 1024
 
 def _get_error(status: int, body: bytes) -> Optional[InferenceServerException]:
     """Build an exception from a non-2xx response (JSON or plain-text body)."""
-    if status >= 400:
+    if status >= STATUS_INVALID:
         try:
             msg = json.loads(body.decode("utf-8", errors="replace")).get("error", "")
         except (ValueError, AttributeError):

@@ -126,6 +126,52 @@ SHED_REASONS = (
 )
 
 # --------------------------------------------------------------------------- #
+# input-validation vocabulary (untrusted request plane)                       #
+# --------------------------------------------------------------------------- #
+
+#: HTTP status of a request rejected by boundary validation
+#: (``protocol/_validate.py``): malformed JSON, a shape/dtype/byte-size
+#: the wire grammar forbids, or shm window arithmetic that cannot fit the
+#: registered region. The gRPC plane maps it to ``INVALID_ARGUMENT``.
+#: Spelled here exactly once so the two planes cannot drift on what
+#: "invalid" means (enforced by TPU008).
+STATUS_INVALID = 400
+
+#: HTTP status of a request whose body exceeds the front-end's
+#: ``max_request_bytes`` cap — rejected BEFORE the body is read, so an
+#: attacker-controlled Content-Length can never size an allocation. The
+#: gRPC plane enforces the same cap via ``grpc.max_receive_message_length``
+#: and answers ``RESOURCE_EXHAUSTED``.
+STATUS_TOO_LARGE = 413
+
+#: Default request-body cap (bytes) for both front-ends. Generous enough
+#: for any sane tensor payload over the wire plane (bulk data belongs in
+#: shared memory), small enough that a forged Content-Length cannot stage
+#: an allocation bomb.
+MAX_REQUEST_BYTES_DEFAULT = 64 * 1024 * 1024
+
+#: ``reason`` label values of the ``nv_inference_invalid_request_total``
+#: counter and the flight recorder's ``invalid.reason`` attribute. All
+#: rows always render (zeros included) so scrapers see a stable label
+#: set. Spelled here exactly once (enforced by TPU008): a front-end
+#: stamping reason X while the metric renders reason Y silently
+#: un-attributes every rejection.
+INVALID_REASON_MALFORMED = "malformed"        # unparseable body / frame
+INVALID_REASON_SHAPE = "invalid_shape"        # dim type/range/product cap
+INVALID_REASON_DTYPE = "invalid_dtype"        # unknown Triton datatype
+INVALID_REASON_DATA_MISMATCH = "data_mismatch"  # shape product vs payload
+INVALID_REASON_SHM_BOUNDS = "shm_bounds"      # offset/byte_size vs region
+INVALID_REASON_TOO_LARGE = "too_large"        # body over max_request_bytes
+INVALID_REASONS = (
+    INVALID_REASON_MALFORMED,
+    INVALID_REASON_SHAPE,
+    INVALID_REASON_DTYPE,
+    INVALID_REASON_DATA_MISMATCH,
+    INVALID_REASON_SHM_BOUNDS,
+    INVALID_REASON_TOO_LARGE,
+)
+
+# --------------------------------------------------------------------------- #
 # multi-tenant fleet vocabulary                                               #
 # --------------------------------------------------------------------------- #
 

@@ -1,0 +1,209 @@
+"""Boundary validation for the untrusted request plane.
+
+Every byte of the KServe v2 surface arrives from an untrusted client, yet
+the values parsed out of it — shapes, byte sizes, shm offsets, binary
+frame lengths — feed allocation sizes, ``np.reshape``, mmap window
+arithmetic, and KV page-reservation math. This module is the single
+place those values are laundered from *wire data* into *trusted ints*:
+both protocol front-ends (``server/_http.py``, ``server/_grpc.py``) and
+the core call through here, so malformed input becomes a typed
+``ValidationError`` (HTTP 400/413, gRPC INVALID_ARGUMENT /
+RESOURCE_EXHAUSTED) with an identical message vocabulary on both planes
+— never a stack trace, never an attacker-sized allocation.
+
+These helpers are also the sanitizer set the TPU013 untrusted-sink taint
+rule recognizes: a request-derived value that flows through a
+``validate_*`` call is clean; one that reaches a sink without doing so
+is a finding. Keep the functions total (raise or return, no silent
+clamping) so that contract stays honest.
+"""
+
+import math
+
+from tritonclient_tpu.protocol._literals import (
+    DATATYPES,
+    INVALID_REASON_DATA_MISMATCH,
+    INVALID_REASON_DTYPE,
+    INVALID_REASON_MALFORMED,
+    INVALID_REASON_SHAPE,
+    INVALID_REASON_SHM_BOUNDS,
+    INVALID_REASON_TOO_LARGE,
+    MAX_REQUEST_BYTES_DEFAULT,
+    STATUS_INVALID,
+    STATUS_TOO_LARGE,
+)
+
+#: Rank cap for wire shapes (numpy's own MAXDIMS is 32; nothing the
+#: serving stack hosts is remotely close).
+MAX_SHAPE_RANK = 32
+
+#: Element-count cap for wire shapes: the product of dims a request may
+#: claim. 2**31 elements of the smallest dtype is already a 2 GiB
+#: allocation — far beyond the wire plane (bulk data belongs in shared
+#: memory) and small enough that the product arithmetic itself cannot
+#: overflow into a negative or wrapped allocation size downstream.
+MAX_SHAPE_ELEMENTS = 1 << 31
+
+
+class ValidationError(ValueError):
+    """A request failed boundary validation.
+
+    Carries the HTTP-ish ``status`` (``STATUS_INVALID`` or
+    ``STATUS_TOO_LARGE``) and the canonical ``reason`` — one of
+    ``INVALID_REASONS`` — that the front-ends stamp onto the
+    ``nv_inference_invalid_request_total`` counter and the flight
+    record's ``invalid.reason`` attribute.
+    """
+
+    def __init__(self, msg: str, status: int = STATUS_INVALID,
+                 reason: str = INVALID_REASON_MALFORMED):
+        super().__init__(msg)
+        self.status = status
+        self.reason = reason
+
+
+def validate_int(value, field: str, minimum=None, maximum=None,
+                 reason: str = INVALID_REASON_MALFORMED) -> int:
+    """A wire value that must be an integer (optionally range-bounded).
+
+    Accepts int and integral strings (HTTP headers and JSON params
+    arrive as either); rejects bool, float, None, and anything else —
+    ``int(True)`` and ``int(3.7)`` silently coercing was exactly the
+    laundering this module exists to stop.
+    """
+    if isinstance(value, bool) or not isinstance(value, (int, str)):
+        raise ValidationError(
+            f"invalid value for '{field}': expected an integer, got "
+            f"{type(value).__name__}", STATUS_INVALID, reason)
+    if isinstance(value, str):
+        try:
+            value = int(value, 10)
+        except ValueError:
+            raise ValidationError(
+                f"invalid value for '{field}': '{value}' is not an integer",
+                STATUS_INVALID, reason)
+    if minimum is not None and value < minimum:
+        raise ValidationError(
+            f"invalid value for '{field}': {value} is below the minimum "
+            f"{minimum}", STATUS_INVALID, reason)
+    if maximum is not None and value > maximum:
+        raise ValidationError(
+            f"invalid value for '{field}': {value} exceeds the maximum "
+            f"{maximum}", STATUS_INVALID, reason)
+    return value
+
+
+def validate_shape(shape, field: str = "shape",
+                   max_elements: int = MAX_SHAPE_ELEMENTS) -> list:
+    """A wire tensor shape: a sequence of non-negative ints whose rank
+    and element product are capped.
+
+    The product cap is the allocation-bomb guard: downstream the product
+    multiplies into dtype sizes, dense-array allocations, and the paged
+    engine's page-reservation count, so it must be bounded BEFORE any of
+    that arithmetic runs.
+    """
+    if isinstance(shape, (str, bytes)) or not hasattr(shape, "__iter__"):
+        raise ValidationError(
+            f"invalid '{field}': expected a list of dims, got "
+            f"{type(shape).__name__}", STATUS_INVALID, INVALID_REASON_SHAPE)
+    dims = list(shape)
+    if len(dims) > MAX_SHAPE_RANK:
+        raise ValidationError(
+            f"invalid '{field}': rank {len(dims)} exceeds the maximum "
+            f"{MAX_SHAPE_RANK}", STATUS_INVALID, INVALID_REASON_SHAPE)
+    out = []
+    for d in dims:
+        if isinstance(d, bool) or not isinstance(d, int):
+            raise ValidationError(
+                f"invalid '{field}': dim {d!r} is not an integer",
+                STATUS_INVALID, INVALID_REASON_SHAPE)
+        if d < 0:
+            raise ValidationError(
+                f"invalid '{field}': dim {d} is negative",
+                STATUS_INVALID, INVALID_REASON_SHAPE)
+        out.append(int(d))
+    if math.prod(out) > max_elements:
+        raise ValidationError(
+            f"invalid '{field}': {math.prod(out)} elements exceeds the "
+            f"maximum {max_elements}", STATUS_INVALID, INVALID_REASON_SHAPE)
+    return out
+
+
+def validate_dtype(datatype, field: str = "datatype") -> str:
+    """A wire datatype string: a member of the protocol's DATATYPES."""
+    if not isinstance(datatype, str) or datatype not in DATATYPES:
+        raise ValidationError(
+            f"invalid '{field}': unsupported datatype {datatype!r}",
+            STATUS_INVALID, INVALID_REASON_DTYPE)
+    return datatype
+
+
+def validate_data_length(datatype: str, shape, actual: int,
+                         what: str = "input") -> int:
+    """Cross-check a payload length against its declared dtype × shape.
+
+    ``actual`` is the element count for BYTES tensors (variable-size
+    elements) and the byte length for every fixed-size dtype — the same
+    convention ``InferenceCore._decode_raw`` uses. Returns the expected
+    value so callers can slice exactly that much.
+    """
+    from tritonclient_tpu.utils import num_elements, triton_dtype_size
+
+    if datatype == "BYTES":
+        expected = num_elements(shape)
+        if actual != expected:
+            raise ValidationError(
+                f"unexpected number of string elements {actual} for {what} "
+                f"(expected {expected})",
+                STATUS_INVALID, INVALID_REASON_DATA_MISMATCH)
+        return expected
+    size = triton_dtype_size(datatype)
+    if size is None:
+        raise ValidationError(
+            f"invalid 'datatype': unsupported datatype {datatype!r}",
+            STATUS_INVALID, INVALID_REASON_DTYPE)
+    expected = num_elements(shape) * size
+    if actual != expected:
+        raise ValidationError(
+            f"unexpected total byte size {actual} for {what} "
+            f"(expected {expected})",
+            STATUS_INVALID, INVALID_REASON_DATA_MISMATCH)
+    return expected
+
+
+def validate_shm_window(offset, byte_size, region_size=None,
+                        region: str = "") -> tuple:
+    """A client-requested shared-memory window: non-negative offset and
+    byte_size that, when a registered region size is known, must fit
+    inside it. The negative-offset case is the classic read-anywhere
+    primitive — ``base + offset`` arithmetic with a negative offset
+    walks backwards out of the mapping.
+    """
+    where = f" for shared memory region '{region}'" if region else ""
+    offset = validate_int(offset, "shared_memory_offset", minimum=0,
+                          reason=INVALID_REASON_SHM_BOUNDS)
+    byte_size = validate_int(byte_size, "shared_memory_byte_size", minimum=0,
+                             reason=INVALID_REASON_SHM_BOUNDS)
+    if region_size is not None and offset + byte_size > region_size:
+        raise ValidationError(
+            f"invalid offset + byte size{where}: {offset} + {byte_size} "
+            f"exceeds the {region_size}-byte region",
+            STATUS_INVALID, INVALID_REASON_SHM_BOUNDS)
+    return offset, byte_size
+
+
+def validate_content_length(length,
+                            max_request_bytes: int = MAX_REQUEST_BYTES_DEFAULT
+                            ) -> int:
+    """The request body length a client claims, capped BEFORE the body is
+    read — the one validator that answers ``STATUS_TOO_LARGE`` (413 /
+    RESOURCE_EXHAUSTED) instead of 400, because the request may be
+    perfectly well-formed and simply over the configured cap."""
+    length = validate_int(length or 0, "Content-Length", minimum=0)
+    if max_request_bytes and length > max_request_bytes:
+        raise ValidationError(
+            f"request body of {length} bytes exceeds the configured "
+            f"maximum of {max_request_bytes} bytes",
+            STATUS_TOO_LARGE, INVALID_REASON_TOO_LARGE)
+    return length

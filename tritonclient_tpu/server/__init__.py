@@ -65,12 +65,18 @@ class InferenceServer:
         verbose: bool = False,
         ssl_certfile: Optional[str] = None,
         ssl_keyfile: Optional[str] = None,
+        max_request_bytes: Optional[int] = None,
     ):
+        from tritonclient_tpu.protocol._literals import MAX_REQUEST_BYTES_DEFAULT
+
+        if max_request_bytes is None:
+            max_request_bytes = MAX_REQUEST_BYTES_DEFAULT
         self.core = InferenceCore(models if models is not None else default_models())
         self._http = (
             HTTPFrontend(
                 self.core, host, http_port, verbose=verbose,
                 ssl_certfile=ssl_certfile, ssl_keyfile=ssl_keyfile,
+                max_request_bytes=max_request_bytes,
             )
             if http
             else None
@@ -79,6 +85,7 @@ class InferenceServer:
             GRPCFrontend(
                 self.core, host, grpc_port,
                 ssl_certfile=ssl_certfile, ssl_keyfile=ssl_keyfile,
+                max_request_bytes=max_request_bytes,
             )
             if grpc
             else None

@@ -30,6 +30,9 @@ from tritonclient_tpu._tracing import (
     configure_logging,
 )
 from tritonclient_tpu.protocol._literals import (
+    INVALID_REASON_DATA_MISMATCH,
+    INVALID_REASON_MALFORMED,
+    INVALID_REASONS,
     PARAM_CANCEL_EVENT,
     PREFIX_EVENTS,
     SERVER_EXTENSIONS,
@@ -38,9 +41,17 @@ from tritonclient_tpu.protocol._literals import (
     SHED_REASON_EXPIRED,
     SHED_REASONS,
     STATUS_CANCELLED,
+    STATUS_INVALID,
     STATUS_SHED,
 )
+from tritonclient_tpu.protocol._validate import (
+    ValidationError,
+    validate_data_length,
+    validate_dtype,
+    validate_shm_window,
+)
 from tritonclient_tpu.utils import (
+    InferenceServerException,
     deserialize_bytes_tensor,
     num_elements,
     serialize_byte_tensor,
@@ -53,11 +64,26 @@ SERVER_VERSION = "2.0.0-tpu"
 
 
 class CoreError(Exception):
-    """Server-side error with an HTTP-ish status code hint."""
+    """Server-side error with an HTTP-ish status code hint.
 
-    def __init__(self, msg: str, status: int = 400):
+    ``reason`` is set (to one of ``INVALID_REASONS``) when the error came
+    out of boundary validation of an untrusted request value: the
+    front-ends stamp it on ``nv_inference_invalid_request_total`` and the
+    flight record's ``invalid.reason`` attribute. Empty for server-side
+    errors that are not the client's fault.
+    """
+
+    def __init__(self, msg: str, status: int = STATUS_INVALID,
+                 reason: str = ""):
         super().__init__(msg)
         self.status = status
+        self.reason = reason
+
+
+def invalid_to_core_error(e: ValidationError) -> CoreError:
+    """Re-raise boundary validation as the core's uniform error type,
+    preserving the status and the canonical invalid reason."""
+    return CoreError(str(e), e.status, reason=e.reason)
 
 
 @dataclass
@@ -171,7 +197,7 @@ class SystemShmRegistry:
             fd = os.open(path, os.O_RDWR)
         except OSError as e:
             raise CoreError(
-                f"Unable to open shared memory region: '{name}' ({e})", 400
+                f"Unable to open shared memory region: '{name}' ({e})", STATUS_INVALID
             )
         try:
             try:
@@ -182,8 +208,18 @@ class SystemShmRegistry:
             # mmap of an empty/truncated object: a protocol error, not a
             # server fault — and never a leaked fd (closed above).
             raise CoreError(
-                f"Unable to map shared memory region: '{name}' ({e})", 400
+                f"Unable to map shared memory region: '{name}' ({e})", STATUS_INVALID
             )
+        try:
+            # The registered window is client-supplied wire data: it must
+            # be non-negative and fit the mapping, or every later read
+            # would do attacker-controlled ``base + offset`` arithmetic.
+            offset, byte_size = validate_shm_window(
+                offset, byte_size, len(mm), name
+            )
+        except ValidationError as e:
+            mm.close()
+            raise invalid_to_core_error(e)
         with self._lock:
             # Insert the new mapping BEFORE closing a replaced one: if the
             # old close raises (BufferError while a reader still holds an
@@ -254,23 +290,47 @@ class SystemShmRegistry:
         with self._lock:
             region = self._regions.get(name)
         if region is None:
-            raise CoreError(f"Unable to find shared memory region: '{name}'", 400)
+            raise CoreError(f"Unable to find shared memory region: '{name}'", STATUS_INVALID)
+        try:
+            # Request-supplied window: negative offsets walk backwards out
+            # of the mapping through the ``base + offset`` arithmetic, and
+            # over-sized windows read bytes the client never registered.
+            offset, nbytes = validate_shm_window(
+                offset, nbytes, self._window_cap(region), name
+            )
+        except ValidationError as e:
+            raise invalid_to_core_error(e)
         base = region["offset"] + offset
         if base + nbytes > len(region["mmap"]):
             raise CoreError(
-                f"Invalid offset + byte size for shared memory region: '{name}'", 400
+                f"Invalid offset + byte size for shared memory region: '{name}'", STATUS_INVALID
             )
         return bytes(region["mmap"][base : base + nbytes])
+
+    @staticmethod
+    def _window_cap(region) -> int:
+        """Largest request window the registered region allows: the
+        registered byte_size, or (for a 0-sized registration) whatever of
+        the mapping lies past the registered base offset."""
+        return region["byte_size"] or (
+            len(region["mmap"]) - region["offset"]
+        )
 
     def write(self, name: str, offset: int, data: bytes):
         with self._lock:
             region = self._regions.get(name)
         if region is None:
-            raise CoreError(f"Unable to find shared memory region: '{name}'", 400)
+            raise CoreError(f"Unable to find shared memory region: '{name}'", STATUS_INVALID)
+        try:
+            offset, _ = validate_shm_window(
+                offset, len(data), self._window_cap(region), name
+            )
+        except ValidationError as e:
+            raise invalid_to_core_error(e)
         base = region["offset"] + offset
         if base + len(data) > len(region["mmap"]):
             raise CoreError(
-                f"Shared memory region '{name}' is too small for output", 400
+                f"Shared memory region '{name}' is too small for output", STATUS_INVALID
             )
         region["mmap"][base : base + len(data)] = data
 
@@ -295,13 +355,17 @@ class TpuShmRegistry:
         try:
             from tritonclient_tpu.utils import tpu_shared_memory as tpushm
         except ImportError as e:  # pragma: no cover
-            raise CoreError(f"TPU shared memory support unavailable: {e}", 400)
+            raise CoreError(f"TPU shared memory support unavailable: {e}", STATUS_INVALID)
 
         region = tpushm._resolve_raw_handle(raw_handle)
         if region is None:
             raise CoreError(
-                f"Unable to resolve TPU shared memory handle for region: '{name}'", 400
+                f"Unable to resolve TPU shared memory handle for region: '{name}'", STATUS_INVALID
             )
+        try:
+            _, byte_size = validate_shm_window(0, byte_size, None, name)
+        except ValidationError as e:
+            raise invalid_to_core_error(e)
         with self._lock:
             self._regions[name] = {
                 "name": name,
@@ -348,14 +412,28 @@ class TpuShmRegistry:
         with self._lock:
             entry = self._regions.get(name)
         if entry is None:
-            raise CoreError(f"Unable to find shared memory region: '{name}'", 400)
+            raise CoreError(f"Unable to find shared memory region: '{name}'", STATUS_INVALID)
         return entry["region"]
 
+    def _checked_window(self, name: str, offset: int, nbytes: int):
+        with self._lock:
+            entry = self._regions.get(name)
+        if entry is None:
+            raise CoreError(f"Unable to find shared memory region: '{name}'", STATUS_INVALID)
+        try:
+            return entry["region"], validate_shm_window(
+                offset, nbytes, entry["byte_size"] or None, name
+            )
+        except ValidationError as e:
+            raise invalid_to_core_error(e)
+
     def read(self, name: str, offset: int, nbytes: int) -> bytes:
-        return self.get_region(name).read_bytes(offset, nbytes)
+        region, (offset, nbytes) = self._checked_window(name, offset, nbytes)
+        return region.read_bytes(offset, nbytes)
 
     def write(self, name: str, offset: int, data: bytes):
-        self.get_region(name).write_bytes(offset, data)
+        region, (offset, _) = self._checked_window(name, offset, len(data))
+        region.write_bytes(offset, data)
 
     def read_array(self, name: str, datatype: str, shape: List[int],
                    offset: int, prefer_host: bool = False):
@@ -459,6 +537,11 @@ class _ModelStats:
         # expired (deadline elapsed while queued), cancelled (client went
         # away while queued). The nv_inference_shed_total counter family.
         self.shed_counts = {reason: 0 for reason in SHED_REASONS}
+        # Requests rejected by boundary validation before any execution,
+        # by canonical reason (protocol/_literals.INVALID_REASONS). The
+        # nv_inference_invalid_request_total counter family; the same
+        # reason rides the flight record as ``invalid.reason``.
+        self.invalid_counts = {reason: 0 for reason in INVALID_REASONS}
         # Per-bucket (non-cumulative) request-duration counts; the +Inf
         # bucket is the trailing slot. Every success AND failure observes
         # exactly once, so +Inf cumulative == success_count + fail_count.
@@ -572,7 +655,7 @@ class _FileOverrideModel:
                     raise CoreError(
                         f"failed to load '{name}': invalid base64 file "
                         f"content for '{path}'",
-                        400,
+                        STATUS_INVALID,
                     )
             self.files[path] = bytes(content)
         # Numeric latest-version semantics: ['2', '10'] must pick '10'
@@ -615,7 +698,7 @@ class _FileOverrideModel:
         raise CoreError(
             f"model '{self.name}' was loaded with a file override; the JAX "
             "backend cannot execute foreign model binaries",
-            400,
+            STATUS_INVALID,
         )
 
 
@@ -1348,12 +1431,12 @@ class InferenceCore:
             raise CoreError(f"Request for unknown model: '{name}'", 404)
         if not loaded:
             raise CoreError(
-                f"Request for unknown model: '{name}' is not ready", 400
+                f"Request for unknown model: '{name}' is not ready", STATUS_INVALID
             )
         versions = getattr(model, "versions", None) or [model.version]
         if version and str(version) not in [str(v) for v in versions]:
             raise CoreError(
-                f"Request for unknown model version: '{name}' version {version}", 400
+                f"Request for unknown model version: '{name}' version {version}", STATUS_INVALID
             )
         return model
 
@@ -1403,7 +1486,7 @@ class InferenceCore:
             model = self._repository.get(name)
             loaded = self._loaded.get(name, False)
         if model is None:
-            raise CoreError(f"Request for unknown model: '{name}'", 400)
+            raise CoreError(f"Request for unknown model: '{name}'", STATUS_INVALID)
         if not loaded:
             return False
         if version:
@@ -1465,13 +1548,13 @@ class InferenceCore:
                 raise CoreError(
                     f"failed to load '{name}', file override requires a "
                     "config override parameter",
-                    400,
+                    STATUS_INVALID,
                 )
             try:
                 override = json.loads(config_override)
             except (TypeError, ValueError):
                 raise CoreError(
-                    f"failed to load '{name}': invalid config override", 400
+                    f"failed to load '{name}': invalid config override", STATUS_INVALID
                 )
             override_model = _FileOverrideModel(name, override, files)
             with self._lock:
@@ -1493,13 +1576,13 @@ class InferenceCore:
                 self._repository[name] = self._overridden.pop(name)
             model = self._repository.get(name)
             if model is None or isinstance(model, _FileOverrideModel):
-                raise CoreError(f"failed to load '{name}', no such model", 400)
+                raise CoreError(f"failed to load '{name}', no such model", STATUS_INVALID)
             if config_override:
                 try:
                     override = json.loads(config_override)
                 except (TypeError, ValueError):
                     raise CoreError(
-                        f"failed to load '{name}': invalid config override", 400
+                        f"failed to load '{name}': invalid config override", STATUS_INVALID
                     )
                 model._config_override = override
             else:
@@ -1513,7 +1596,7 @@ class InferenceCore:
     def unload_model(self, name: str, parameters: Optional[dict] = None):
         with self._lock:
             if name not in self._repository:
-                raise CoreError(f"failed to unload '{name}', no such model", 400)
+                raise CoreError(f"failed to unload '{name}', no such model", STATUS_INVALID)
             self._loaded[name] = False
         # Retire the model's param/scratch ledger rows; the KV pool closes
         # itself via engine.shutdown() when the engine is torn down.
@@ -1621,6 +1704,22 @@ class InferenceCore:
                 lines.append(
                     f'{metric}{{model="{esc(name)}",version="{esc(version)}"'
                     f',reason="{reason}"}} {stats.shed_counts[reason]}'
+                )
+        # Invalid-request counters: boundary-validation rejections by
+        # canonical reason. Like the shed family, every reason row always
+        # renders (zeros included) so scrapers see a stable label set and
+        # the reasons provably sum to the observed rejections.
+        metric = "nv_inference_invalid_request_total"
+        lines.append(
+            f"# HELP {metric} Number of inference requests rejected by "
+            "boundary validation before execution, by reason"
+        )
+        lines.append(f"# TYPE {metric} counter")
+        for name, version, stats in rows:
+            for reason in INVALID_REASONS:
+                lines.append(
+                    f'{metric}{{model="{esc(name)}",version="{esc(version)}"'
+                    f',reason="{reason}"}} {stats.invalid_counts[reason]}'
                 )
         # Request-duration histogram (per-request latency distribution; the
         # cumulative sum Triton reports as a counter is this family's _sum).
@@ -1949,7 +2048,7 @@ class InferenceCore:
     def update_trace_settings(self, model_name: str = "", settings: Optional[dict] = None) -> dict:
         for key in settings or {}:
             if key not in _DEFAULT_TRACE_SETTINGS:
-                raise CoreError(f"Unknown trace setting: '{key}'", 400)
+                raise CoreError(f"Unknown trace setting: '{key}'", STATUS_INVALID)
 
         def norm(value):
             return (
@@ -2065,10 +2164,29 @@ class InferenceCore:
                 self._protocol_requests.get(protocol, 0) + 1
             )
 
+    def record_invalid_request(self, model_name: str, reason: str,
+                               trace=None):
+        """Count one boundary-validation rejection and stamp its reason.
+
+        Called by the protocol front-ends when a request dies with a
+        CoreError carrying an invalid ``reason`` (it never reached
+        execution). Unknown models and unknown reasons fold into the
+        canonical vocabulary instead of growing label cardinality — a
+        fuzzer-supplied model name must not mint a new metric row.
+        """
+        if reason not in INVALID_REASONS:
+            reason = INVALID_REASON_MALFORMED
+        if trace is not None:
+            trace.set_attribute("invalid.reason", reason)
+        with self._lock:
+            stats = self._stats.get(model_name)
+            if stats is not None:
+                stats.invalid_counts[reason] += 1
+
     def update_log_settings(self, settings: Optional[dict] = None) -> dict:
         for key, value in (settings or {}).items():
             if key not in self._log_settings:
-                raise CoreError(f"Unknown log setting: '{key}'", 400)
+                raise CoreError(f"Unknown log setting: '{key}'", STATUS_INVALID)
             if value is not None:
                 self._log_settings[key] = value
         # Apply, not just store: the settings drive a real structured
@@ -2091,7 +2209,7 @@ class InferenceCore:
             return self.system_shm
         if kind == "tpu":
             return self.tpu_shm
-        raise CoreError(f"Unsupported shared memory kind: '{kind}'", 400)
+        raise CoreError(f"Unsupported shared memory kind: '{kind}'", STATUS_INVALID)
 
     def find_shm_kind(self, region: str) -> str:
         """Which registry holds a region name (system first, then tpu).
@@ -2301,14 +2419,14 @@ class InferenceCore:
                 raise CoreError(
                     f"expected {len(model.inputs)} inputs but got "
                     f"{len(inputs)} inputs for model '{model.name}'",
-                    400,
+                    STATUS_INVALID,
                 )
         for name in inputs:
             if declared and name not in declared:
                 raise CoreError(
                     f"unexpected inference input '{name}' for model "
                     f"'{model.name}'",
-                    400,
+                    STATUS_INVALID,
                 )
 
     def _infer_batch(self, model, requests: List[CoreRequest], stats):
@@ -2588,32 +2706,33 @@ class InferenceCore:
             )
             return self._decode_raw(tensor.datatype, tensor.shape, raw)
         if tensor.data is None:
-            raise CoreError(f"no data provided for input '{tensor.name}'", 400)
+            raise CoreError(f"no data provided for input '{tensor.name}'", STATUS_INVALID)
         return tensor.data
 
     @staticmethod
     def _decode_raw(datatype: str, shape: List[int], raw: bytes) -> np.ndarray:
-        if datatype == "BYTES":
-            arr = deserialize_bytes_tensor(raw)
-            expected = num_elements(shape)
-            if arr.size != expected:
-                raise CoreError(
-                    f"unexpected number of string elements {arr.size} for input "
-                    f"(expected {expected})",
-                    400,
-                )
-            return arr.reshape(shape)
-        np_dtype = triton_to_np_dtype(datatype)
-        if np_dtype is None:
-            raise CoreError(f"unsupported datatype '{datatype}'", 400)
-        expected = num_elements(shape) * triton_dtype_size(datatype)
-        if len(raw) != expected:
-            raise CoreError(
-                f"unexpected total byte size {len(raw)} for input "
-                f"(expected {expected})",
-                400,
-            )
-        return np.frombuffer(raw, dtype=np_dtype).reshape(shape)
+        # Boundary validation (protocol/_validate): dtype membership and
+        # the payload-length/shape cross-check run BEFORE the reshape, so
+        # a wire-supplied shape can never size the array — both planes
+        # decode through here and share one message vocabulary.
+        try:
+            if datatype == "BYTES":
+                try:
+                    arr = deserialize_bytes_tensor(raw)
+                except InferenceServerException as e:
+                    # Truncated or lying length prefixes inside the frame
+                    # are the client's fault, not a server error.
+                    raise ValidationError(
+                        str(e), reason=INVALID_REASON_DATA_MISMATCH)
+                validate_data_length(datatype, shape, arr.size)
+                return arr.reshape(shape)
+            validate_dtype(datatype)
+            validate_data_length(datatype, shape, len(raw))
+        except ValidationError as e:
+            raise invalid_to_core_error(e)
+        return np.frombuffer(raw, dtype=triton_to_np_dtype(datatype)).reshape(
+            shape
+        )
 
     def _build_response(self, model, request: CoreRequest, result: dict) -> CoreResponse:
         requested = {r.name: r for r in request.outputs}
@@ -2624,7 +2743,7 @@ class InferenceCore:
             if name not in result:
                 raise CoreError(
                     f"unexpected inference output '{name}' for model '{model.name}'",
-                    400,
+                    STATUS_INVALID,
                 )
             array = result[name]
             req = requested.get(name)
@@ -2659,7 +2778,7 @@ class InferenceCore:
                         raise CoreError(
                             f"shared memory region '{req.shm_region}' is too small "
                             f"for output '{name}' ({nbytes} > {req.shm_byte_size})",
-                            400,
+                            STATUS_INVALID,
                         )
                     registry.write(req.shm_region, req.shm_offset, raw)
                 outputs.append(
@@ -2705,6 +2824,14 @@ class InferenceCore:
         image_client.py postprocesses (image_client.py:60-217).
         """
         array = np.asarray(array)
+        if array.dtype.kind not in "iuf":
+            raise CoreError(
+                "classification requested on a non-numeric output "
+                f"(dtype kind '{array.dtype.kind}'); top-k ranking is "
+                "only defined for numeric tensors",
+                STATUS_INVALID,
+                reason=INVALID_REASON_DATA_MISMATCH,
+            )
         if array.ndim == 1:
             array = array[None, :]
         lead_shape = array.shape[:-1]

@@ -18,6 +18,7 @@ import numpy as np
 from tritonclient_tpu.protocol import make_service_handler, pb
 from tritonclient_tpu.protocol._literals import (
     HEADER_TENANT_ID,
+    INVALID_REASON_DATA_MISMATCH,
     KEY_CLASSIFICATION,
     KEY_EMPTY_FINAL_RESPONSE,
     KEY_FINAL_RESPONSE,
@@ -25,11 +26,21 @@ from tritonclient_tpu.protocol._literals import (
     KEY_SHM_OFFSET,
     KEY_SHM_REGION,
     KEY_TIMEOUT,
+    MAX_REQUEST_BYTES_DEFAULT,
     STATUS_CANCELLED,
+    STATUS_INVALID,
     STATUS_OVER_QUOTA,
     STATUS_SHED,
+    STATUS_TOO_LARGE,
 )
 from tritonclient_tpu.protocol._service import RawJsonMessage
+from tritonclient_tpu.protocol._validate import (
+    ValidationError,
+    validate_dtype,
+    validate_int,
+    validate_shape,
+    validate_shm_window,
+)
 from tritonclient_tpu.server._core import (
     CoreError,
     CoreRequest,
@@ -37,6 +48,7 @@ from tritonclient_tpu.server._core import (
     CoreResponse,
     CoreTensor,
     InferenceCore,
+    invalid_to_core_error,
 )
 _MAX_MESSAGE_LENGTH = 2**31 - 1  # INT32_MAX parity (grpc/_client.py:50-55)
 
@@ -126,11 +138,41 @@ def _finish_trace(creq, error: Optional[str] = None):
         trace.finish()
 
 
+def _record_invalid(core: InferenceCore, request, creq, e: CoreError,
+                    t_recv: int) -> None:
+    """Count a boundary-validation rejection on
+    ``nv_inference_invalid_request_total{model,reason}`` and make sure a
+    flight record exists to carry the ``invalid.reason`` stamp — parse
+    failures die before ``start_trace`` runs, so one is opened here."""
+    if not getattr(e, "reason", ""):
+        return  # shed/quota/model errors, not boundary rejections
+    trace = getattr(creq, "trace", None) if creq is not None else None
+    if trace is None:
+        # Parse failures die before start_trace runs: open a record so
+        # the rejection is visible to the flight recorder, and close it
+        # here (the caller's _finish_trace only closes traces hung on a
+        # parsed CoreRequest).
+        trace = core.start_trace(
+            request.model_name, request.model_version, request.id,
+            recv_ns=t_recv,
+        )
+        core.record_invalid_request(request.model_name, e.reason, trace)
+        trace.note_error(str(e))
+        trace.record("RESPONSE_SEND")
+        trace.finish()
+        return
+    core.record_invalid_request(request.model_name, e.reason, trace)
+
+
 def _status_for(e: CoreError) -> grpc.StatusCode:
     return {
         404: grpc.StatusCode.NOT_FOUND,
-        400: grpc.StatusCode.INVALID_ARGUMENT,
+        STATUS_INVALID: grpc.StatusCode.INVALID_ARGUMENT,
         500: grpc.StatusCode.INTERNAL,
+        # Over-the-cap request bodies: HTTP answers 413; the gRPC plane
+        # spells the same rejection RESOURCE_EXHAUSTED (matching what the
+        # transport itself returns when max_receive_message_length trips).
+        STATUS_TOO_LARGE: grpc.StatusCode.RESOURCE_EXHAUSTED,
         # Deadline-aware scheduling: shed (admission reject / expired in
         # queue) and client-cancelled sheds map onto the canonical gRPC
         # codes so both planes spell the shed status identically.
@@ -161,6 +203,21 @@ def _arm_cancel(context, creq) -> None:
 
 
 def request_to_core(request: pb.ModelInferRequest, core: InferenceCore) -> CoreRequest:
+    """Parse a wire ModelInferRequest into a CoreRequest.
+
+    Every size, shape, and shm window the client declares is laundered
+    through ``protocol._validate`` here, at the boundary — the same
+    sanitizer set, with the same message vocabulary, as the HTTP plane's
+    ``_parse_infer``. Boundary failures surface as typed CoreErrors
+    (INVALID_ARGUMENT), never a reshape stack trace.
+    """
+    try:
+        return _request_to_core(request, core)
+    except ValidationError as e:
+        raise invalid_to_core_error(e)
+
+
+def _request_to_core(request: pb.ModelInferRequest, core: InferenceCore) -> CoreRequest:
     creq = CoreRequest(
         model_name=request.model_name,
         model_version=request.model_version,
@@ -180,16 +237,16 @@ def request_to_core(request: pb.ModelInferRequest, core: InferenceCore) -> CoreR
     use_raw = len(raw) > 0
     raw_index = 0  # raw entries exist only for non-shared-memory inputs
     for tensor in request.inputs:
-        ct = CoreTensor(
-            name=tensor.name,
-            datatype=tensor.datatype,
-            shape=list(tensor.shape),
-        )
+        dt = validate_dtype(tensor.datatype)
+        shape = validate_shape(list(tensor.shape))
+        ct = CoreTensor(name=tensor.name, datatype=dt, shape=shape)
         params = {k: _param_value(v) for k, v in tensor.parameters.items()}
         if KEY_SHM_REGION in params:
             ct.shm_region = params[KEY_SHM_REGION]
-            ct.shm_offset = int(params.get(KEY_SHM_OFFSET, 0))
-            ct.shm_byte_size = int(params.get(KEY_SHM_BYTE_SIZE, 0))
+            ct.shm_offset, ct.shm_byte_size = validate_shm_window(
+                params.get(KEY_SHM_OFFSET, 0),
+                params.get(KEY_SHM_BYTE_SIZE, 0),
+            )
             ct.shm_kind = core.find_shm_kind(ct.shm_region)
         elif use_raw:
             # Triton rejects mixing the two content planes (the reference's
@@ -199,12 +256,10 @@ def request_to_core(request: pb.ModelInferRequest, core: InferenceCore) -> CoreR
                     "contents field must not be specified when using "
                     f"raw_input_contents for '{tensor.name}' for model "
                     f"'{request.model_name}'",
-                    400,
+                    STATUS_INVALID,
                 )
             if raw_index < len(raw):
-                ct.data = InferenceCore._decode_raw(
-                    ct.datatype, ct.shape, raw[raw_index]
-                )
+                ct.data = InferenceCore._decode_raw(dt, shape, raw[raw_index])
                 raw_index += 1
         else:
             ct.data = _contents_to_array(tensor)
@@ -213,45 +268,61 @@ def request_to_core(request: pb.ModelInferRequest, core: InferenceCore) -> CoreR
         params = {k: _param_value(v) for k, v in out.parameters.items()}
         co = CoreRequestedOutput(
             name=out.name,
-            class_count=int(params.get(KEY_CLASSIFICATION, 0)),
+            class_count=validate_int(
+                params.get(KEY_CLASSIFICATION, 0), KEY_CLASSIFICATION,
+                minimum=0,
+            ),
         )
         if KEY_SHM_REGION in params:
             co.shm_region = params[KEY_SHM_REGION]
-            co.shm_offset = int(params.get(KEY_SHM_OFFSET, 0))
-            co.shm_byte_size = int(params.get(KEY_SHM_BYTE_SIZE, 0))
+            co.shm_offset, co.shm_byte_size = validate_shm_window(
+                params.get(KEY_SHM_OFFSET, 0),
+                params.get(KEY_SHM_BYTE_SIZE, 0),
+            )
             co.shm_kind = core.find_shm_kind(co.shm_region)
         creq.outputs.append(co)
     return creq
 
 
 def _contents_to_array(tensor: pb.ModelInferRequest.InferInputTensor) -> np.ndarray:
-    """Decode the typed `contents` fields (non-raw path)."""
+    """Decode the typed `contents` fields (non-raw path).
+
+    The element count is cross-checked against the declared shape BEFORE
+    ``reshape`` runs — a mismatched wire payload is a typed 400, not a
+    numpy stack trace turned 500.
+    """
+    from tritonclient_tpu.utils import num_elements, triton_to_np_dtype
+
     c = tensor.contents
-    dt = tensor.datatype
-    shape = list(tensor.shape)
+    dt = validate_dtype(tensor.datatype)
+    shape = validate_shape(list(tensor.shape))
     if dt == "BOOL":
-        return np.array(c.bool_contents, dtype=np.bool_).reshape(shape)
-    if dt in ("INT8", "INT16", "INT32"):
-        from tritonclient_tpu.utils import triton_to_np_dtype
-
-        return np.array(c.int_contents, dtype=triton_to_np_dtype(dt)).reshape(shape)
-    if dt == "INT64":
-        return np.array(c.int64_contents, dtype=np.int64).reshape(shape)
-    if dt in ("UINT8", "UINT16", "UINT32"):
-        from tritonclient_tpu.utils import triton_to_np_dtype
-
-        return np.array(c.uint_contents, dtype=triton_to_np_dtype(dt)).reshape(shape)
-    if dt == "UINT64":
-        return np.array(c.uint64_contents, dtype=np.uint64).reshape(shape)
-    if dt in ("FP32", "FP16", "BF16"):
-        from tritonclient_tpu.utils import triton_to_np_dtype
-
-        return np.array(c.fp32_contents, dtype=np.float32).astype(triton_to_np_dtype(dt)).reshape(shape)
-    if dt == "FP64":
-        return np.array(c.fp64_contents, dtype=np.float64).reshape(shape)
-    if dt == "BYTES":
-        return np.array(list(c.bytes_contents), dtype=np.object_).reshape(shape)
-    raise CoreError(f"unsupported datatype '{dt}'", 400)
+        values, np_dtype = c.bool_contents, np.bool_
+    elif dt in ("INT8", "INT16", "INT32"):
+        values, np_dtype = c.int_contents, triton_to_np_dtype(dt)
+    elif dt == "INT64":
+        values, np_dtype = c.int64_contents, np.int64
+    elif dt in ("UINT8", "UINT16", "UINT32"):
+        values, np_dtype = c.uint_contents, triton_to_np_dtype(dt)
+    elif dt == "UINT64":
+        values, np_dtype = c.uint64_contents, np.uint64
+    elif dt in ("FP32", "FP16", "BF16"):
+        values, np_dtype = c.fp32_contents, np.float32
+    elif dt == "FP64":
+        values, np_dtype = c.fp64_contents, np.float64
+    else:  # BYTES (validate_dtype bounds the alternatives)
+        values, np_dtype = list(c.bytes_contents), np.object_
+    expected = num_elements(shape)
+    if len(values) != expected:
+        raise ValidationError(
+            f"unexpected number of elements {len(values)} for input "
+            f"'{tensor.name}' (expected {expected})",
+            STATUS_INVALID, INVALID_REASON_DATA_MISMATCH,
+        )
+    arr = np.array(values, dtype=np_dtype).reshape(shape)
+    if dt in ("FP16", "BF16"):
+        arr = arr.astype(triton_to_np_dtype(dt))
+    return arr
 
 
 def core_to_response(cresp: CoreResponse) -> pb.ModelInferResponse:
@@ -440,9 +511,15 @@ class _Servicer:
 
     def SystemSharedMemoryRegister(self, request, context):
         try:
-            self.core.system_shm.register(
-                request.name, request.key, request.offset, request.byte_size
+            offset, byte_size = validate_shm_window(
+                request.offset, request.byte_size, region=request.name
             )
+            self.core.system_shm.register(
+                request.name, request.key, offset, byte_size
+            )
+        except ValidationError as e:
+            e = invalid_to_core_error(e)
+            context.abort(_status_for(e), str(e))
         except CoreError as e:
             context.abort(_status_for(e), str(e))
         return pb.SystemSharedMemoryRegisterResponse()
@@ -489,9 +566,16 @@ class _Servicer:
 
     def TpuSharedMemoryRegister(self, request, context):
         try:
+            device_id = validate_int(request.device_id, "device_id", minimum=0)
+            byte_size = validate_shm_window(
+                0, request.byte_size, region=request.name
+            )[1]
             self.core.tpu_shm.register(
-                request.name, request.raw_handle, request.device_id, request.byte_size
+                request.name, request.raw_handle, device_id, byte_size
             )
+        except ValidationError as e:
+            e = invalid_to_core_error(e)
+            context.abort(_status_for(e), str(e))
         except CoreError as e:
             context.abort(_status_for(e), str(e))
         return pb.TpuSharedMemoryRegisterResponse()
@@ -557,6 +641,7 @@ class _Servicer:
             _finish_trace(creq)
             return resp
         except CoreError as e:
+            _record_invalid(self.core, request, creq, e, t_recv)
             _finish_trace(creq, str(e))
             context.abort(_status_for(e), str(e))
 
@@ -647,6 +732,7 @@ class _Servicer:
             _finish_trace(creq)
             return self._respond_stream(request, cresp, cached_resps)
         except CoreError as e:
+            _record_invalid(self.core, request, creq, e, t_recv)
             _finish_trace(creq, str(e))
             return [_stream_error(str(e), request.id)]
         except Exception as e:  # mirror _infer_one's model-error wrapping:
@@ -728,6 +814,9 @@ class _Servicer:
             _finish_trace(creq)
             return self._respond_stream(request, cresp, cached_resps)
         except CoreError as e:
+            # The feeder already parsed (and traced) this request, so the
+            # ingress timestamp lives on its trace; no fresh one is opened.
+            _record_invalid(self.core, request, creq, e, time.monotonic_ns())
             _finish_trace(creq, str(e))
             return [_stream_error(str(e), request.id)]
         except Exception as e:
@@ -962,7 +1051,7 @@ def _finalize_unary(cresp) -> pb.ModelInferResponse:
             raise CoreError(
                 "ModelInfer on a decoupled model must produce exactly "
                 f"one response (got {len(responses)}); use ModelStreamInfer",
-                400,
+                STATUS_INVALID,
             )
         cresp = responses[0]
     return core_to_response(cresp)
@@ -1123,6 +1212,7 @@ class _AioServicer:
             _finish_trace(creq)
             return resp
         except CoreError as e:
+            _record_invalid(self.core, request, creq, e, t_recv)
             _finish_trace(creq, str(e))
             await context.abort(_status_for(e), str(e))
 
@@ -1244,7 +1334,16 @@ class GRPCFrontend:
         aio: Optional[bool] = None,
         ssl_certfile: Optional[str] = None,
         ssl_keyfile: Optional[str] = None,
+        max_request_bytes: int = MAX_REQUEST_BYTES_DEFAULT,
     ):
+        # The gRPC spelling of the HTTP plane's 413: the transport itself
+        # rejects over-cap messages with RESOURCE_EXHAUSTED before any
+        # handler allocates for them. 0 disables the cap (INT32_MAX
+        # parity with the reference client, grpc/_client.py:50-55).
+        receive_cap = (
+            min(max_request_bytes, _MAX_MESSAGE_LENGTH)
+            if max_request_bytes else _MAX_MESSAGE_LENGTH
+        )
         if aio is None:
             # Thread-pool frontend by default: at high stream counts the
             # single aio loop trades head-of-line latency for thread cost
@@ -1283,7 +1382,7 @@ class GRPCFrontend:
                 futures.ThreadPoolExecutor(max_workers=max_workers),
                 options=[
                     ("grpc.max_send_message_length", _MAX_MESSAGE_LENGTH),
-                    ("grpc.max_receive_message_length", _MAX_MESSAGE_LENGTH),
+                    ("grpc.max_receive_message_length", receive_cap),
                 ],
             )
             self._server.add_generic_rpc_handlers(
@@ -1306,7 +1405,7 @@ class GRPCFrontend:
             server = grpc.aio.server(
                 options=[
                     ("grpc.max_send_message_length", _MAX_MESSAGE_LENGTH),
-                    ("grpc.max_receive_message_length", _MAX_MESSAGE_LENGTH),
+                    ("grpc.max_receive_message_length", receive_cap),
                 ]
             )
             server.add_generic_rpc_handlers(

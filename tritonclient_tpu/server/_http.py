@@ -32,6 +32,9 @@ from tritonclient_tpu.protocol._literals import (
     EP_SERVER_METADATA,
     EP_TRACE_SETTING,
     HEADER_TENANT_ID,
+    INVALID_REASON_DATA_MISMATCH,
+    INVALID_REASON_MALFORMED,
+    INVALID_REASON_TOO_LARGE,
     KEY_TIMEOUT,
     KEY_BINARY_DATA,
     KEY_BINARY_DATA_OUTPUT,
@@ -40,10 +43,21 @@ from tritonclient_tpu.protocol._literals import (
     KEY_SHM_BYTE_SIZE,
     KEY_SHM_OFFSET,
     KEY_SHM_REGION,
+    MAX_REQUEST_BYTES_DEFAULT,
     MODEL_ROUTE_RE,
     REPOSITORY_ROUTE_RE,
     SHM_ROUTE_RE,
     SHM_URL_KINDS,
+    STATUS_INVALID,
+    STATUS_TOO_LARGE,
+)
+from tritonclient_tpu.protocol._validate import (
+    ValidationError,
+    validate_content_length,
+    validate_dtype,
+    validate_int,
+    validate_shape,
+    validate_shm_window,
 )
 from tritonclient_tpu.server._core import (
     CoreError,
@@ -51,6 +65,7 @@ from tritonclient_tpu.server._core import (
     CoreRequestedOutput,
     CoreTensor,
     InferenceCore,
+    invalid_to_core_error,
 )
 from tritonclient_tpu.utils import triton_to_np_dtype
 
@@ -210,14 +225,44 @@ class _Handler(BaseHTTPRequestHandler):
     # -- plumbing ------------------------------------------------------------
 
     def _read_body(self) -> bytes:
-        length = int(self.headers.get("Content-Length", 0))
+        # The declared length is attacker-controlled: cap it BEFORE the
+        # read so a forged Content-Length can never size an allocation
+        # (ValidationError -> 413, and _dispatch closes the connection
+        # since the unread body would poison the next keep-alive parse).
+        cap = getattr(self.server, "max_request_bytes",
+                      MAX_REQUEST_BYTES_DEFAULT)
+        length = validate_content_length(
+            self.headers.get("Content-Length", 0), cap
+        )
         body = self.rfile.read(length) if length else b""
         encoding = self.headers.get("Content-Encoding", "")
         if encoding == "gzip":
-            body = gzip.decompress(body)
+            body = self._bounded_decompress(body, zlib.MAX_WBITS | 16, cap)
         elif encoding == "deflate":
-            body = zlib.decompress(body)
+            body = self._bounded_decompress(body, zlib.MAX_WBITS, cap)
         return body
+
+    @staticmethod
+    def _bounded_decompress(data: bytes, wbits: int, cap: int) -> bytes:
+        """Decompress a request body without trusting its ratio: a tiny
+        gzip member can inflate ~1000x, so the cap applies to the
+        INFLATED size and garbage frames become a typed 400, not a
+        stack trace."""
+        try:
+            d = zlib.decompressobj(wbits)
+            out = d.decompress(data, cap + 1 if cap else 0)
+        except zlib.error as e:
+            raise ValidationError(
+                f"failed to decompress request body: {e}",
+                STATUS_INVALID, INVALID_REASON_MALFORMED,
+            )
+        if cap and (len(out) > cap or d.unconsumed_tail):
+            raise ValidationError(
+                f"decompressed request body exceeds the configured "
+                f"maximum of {cap} bytes",
+                STATUS_TOO_LARGE, INVALID_REASON_TOO_LARGE,
+            )
+        return out
 
     def _send(self, status: int, body: bytes, content_type="application/json", extra=None):
         accept = self.headers.get("Accept-Encoding", "")
@@ -264,12 +309,25 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             self._route(method)
         except CoreError as e:
+            if e.status == STATUS_TOO_LARGE:
+                # The over-cap body was never read; it would be parsed as
+                # the next keep-alive request. Drop the connection.
+                self.close_connection = True
             self._send_error_json(e)
+        except ValidationError as e:
+            # Boundary validation outside the infer path (shm admin,
+            # repository control): typed client error, never a 500.
+            if e.status == STATUS_TOO_LARGE:
+                self.close_connection = True
+            self._send_error_json(invalid_to_core_error(e))
         except (BrokenPipeError, ConnectionResetError):
             pass
         except (json.JSONDecodeError, KeyError, ValueError, TypeError) as e:
             # Malformed request bodies are client errors, not server faults.
-            self._send_error_json(CoreError(f"failed to parse request: {e}", 400))
+            self._send_error_json(CoreError(
+                f"failed to parse request: {e}", STATUS_INVALID,
+                INVALID_REASON_MALFORMED,
+            ))
         except Exception as e:  # noqa: BLE001
             self._send_error_json(e)
 
@@ -296,13 +354,13 @@ class _Handler(BaseHTTPRequestHandler):
 
         # v2/health/live, v2/health/ready
         if path == EP_HEALTH_LIVE:
-            return self._send(200 if core.is_server_live() else 400, b"")
+            return self._send(200 if core.is_server_live() else STATUS_INVALID, b"")
         if path == EP_HEALTH_READY:
             # Status carries the readiness verdict (client parity); the
             # body carries the readiness DETAIL the fleet router's health
             # prober consumes: {"ready", "draining", "in_flight"}.
             detail = core.readiness_detail()
-            return self._send_json(detail, 200 if detail["ready"] else 400)
+            return self._send_json(detail, 200 if detail["ready"] else STATUS_INVALID)
         if path == EP_FLEET_DRAIN and method == "POST":
             body = self._read_body()
             drain = bool(json.loads(body).get("drain", True)) if body else True
@@ -317,7 +375,7 @@ class _Handler(BaseHTTPRequestHandler):
             action = m.group("action")
             if action == "ready":
                 ready = core.is_model_ready(model, version)
-                return self._send(200 if ready else 400, b"")
+                return self._send(200 if ready else STATUS_INVALID, b"")
             if action is None and method == "GET":
                 return self._send_json(core.model_metadata(model, version))
             if action == "config":
@@ -417,25 +475,26 @@ class _Handler(BaseHTTPRequestHandler):
                     f"Unable to find system shared memory region: '{region}'"
                     if kind == "system"
                     else f"Unable to find {kind} shared memory region: '{region}'",
-                    400,
+                    STATUS_INVALID,
                 )
             return self._send_json(regions)
         if action == "register":
             body = json.loads(self._read_body() or b"{}")
             if kind == "system":
-                registry.register(
-                    region,
-                    body.get("key", ""),
-                    int(body.get("offset", 0)),
-                    int(body.get("byte_size", 0)),
+                offset, byte_size = validate_shm_window(
+                    body.get("offset", 0), body.get("byte_size", 0),
+                    region=region,
                 )
+                registry.register(region, body.get("key", ""), offset, byte_size)
             else:
                 raw = base64.b64decode(body.get("raw_handle", {}).get("b64", ""))
                 registry.register(
                     region,
                     raw,
-                    int(body.get("device_id", 0)),
-                    int(body.get("byte_size", 0)),
+                    validate_int(body.get("device_id", 0), "device_id", minimum=0),
+                    validate_shm_window(
+                        0, body.get("byte_size", 0), region=region
+                    )[1],
                 )
             return self._send_json(None, 200)
         if action == "unregister":
@@ -443,87 +502,154 @@ class _Handler(BaseHTTPRequestHandler):
             registry.unregister(region)
             return self._send_json(None, 200)
 
+    def _parse_infer(self, model: str, version: str, t_recv: int):
+        """Parse and validate one infer request off the wire.
+
+        Every value that later feeds an allocation, a reshape, a slice
+        bound, or shm window arithmetic is laundered through
+        ``protocol._validate`` here, at the boundary. Failures become
+        typed CoreErrors, counted on
+        ``nv_inference_invalid_request_total{model,reason}`` and stamped
+        as ``invalid.reason`` on a finished flight record — never a 500.
+        """
+        core = self.core
+        trace = None
+        try:
+            body = self._read_body()
+            header_len = self.headers.get("Inference-Header-Content-Length")
+            if header_len is not None:
+                json_size = validate_int(
+                    header_len, "Inference-Header-Content-Length",
+                    minimum=0, maximum=len(body),
+                )
+                header = json.loads(body[:json_size])
+                binary_blob = body[json_size:]
+            else:
+                header = json.loads(body)
+                binary_blob = b""
+            if not isinstance(header, dict):
+                raise ValidationError(
+                    "inference request body must be a JSON object, not "
+                    + type(header).__name__
+                )
+
+            request = CoreRequest(
+                model_name=model,
+                model_version=version,
+                id=header.get("id", ""),
+                parameters=dict(header.get("parameters", {})),
+            )
+            # The KServe `timeout` parameter (microseconds) becomes a parsed
+            # deadline budget instead of an opaque passthrough — popped so a
+            # deadline does not disqualify the request from dynamic batching.
+            timeout = request.parameters.pop(KEY_TIMEOUT, None)
+            if timeout is not None:
+                try:
+                    request.deadline_us = max(int(timeout), 0)
+                except (TypeError, ValueError):
+                    request.deadline_us = 0
+            # Tenant attribution: the fleet router forwards the tenant-id
+            # header; stamping it here (and on the trace) keys per-tenant
+            # accounting all the way into the flight recorder.
+            request.tenant = self.headers.get(HEADER_TENANT_ID, "")
+            # Request-id propagation: the body id wins; the triton-request-id
+            # header lets clients tag trace records without touching the body.
+            trace = core.start_trace(
+                model, version,
+                request.id or self.headers.get("triton-request-id", ""),
+                recv_ns=t_recv,
+                traceparent=self.headers.get("traceparent"),
+                deadline_us=request.deadline_us,
+                tenant=request.tenant,
+            )
+            request.trace = trace
+
+            offset = 0
+            for js in header.get("inputs", []):
+                if not isinstance(js, dict):
+                    raise ValidationError(
+                        "each entry in 'inputs' must be a JSON object")
+                params = js.get("parameters", {})
+                name = js["name"]
+                datatype = validate_dtype(js["datatype"])
+                shape = validate_shape(js["shape"])
+                tensor = CoreTensor(name=name, datatype=datatype, shape=shape)
+                if KEY_SHM_REGION in params:
+                    tensor.shm_region = params[KEY_SHM_REGION]
+                    tensor.shm_offset, tensor.shm_byte_size = validate_shm_window(
+                        params.get(KEY_SHM_OFFSET, 0),
+                        params.get(KEY_SHM_BYTE_SIZE, 0),
+                    )
+                    tensor.shm_kind = core.find_shm_kind(tensor.shm_region)
+                elif KEY_BINARY_DATA_SIZE in params:
+                    size = validate_int(
+                        params[KEY_BINARY_DATA_SIZE], KEY_BINARY_DATA_SIZE,
+                        minimum=0,
+                    )
+                    if offset + size > len(binary_blob):
+                        raise ValidationError(
+                            f"binary frame truncated: input '{name}' claims "
+                            f"{size} bytes but only "
+                            f"{len(binary_blob) - offset} remain",
+                            STATUS_INVALID, INVALID_REASON_DATA_MISMATCH,
+                        )
+                    raw = binary_blob[offset : offset + size]
+                    offset += size
+                    tensor.data = InferenceCore._decode_raw(datatype, shape, raw)
+                else:
+                    tensor.data = _json_data_to_array(datatype, shape, js.get("data"))
+                request.inputs.append(tensor)
+
+            binary_default = bool(request.parameters.pop(KEY_BINARY_DATA_OUTPUT, False))
+            for js in header.get("outputs", []):
+                if not isinstance(js, dict):
+                    raise ValidationError(
+                        "each entry in 'outputs' must be a JSON object")
+                params = js.get("parameters", {})
+                out = CoreRequestedOutput(
+                    name=js["name"],
+                    binary=bool(params.get(KEY_BINARY_DATA, binary_default)),
+                    class_count=validate_int(
+                        params.get(KEY_CLASSIFICATION, 0), KEY_CLASSIFICATION,
+                        minimum=0,
+                    ),
+                )
+                if KEY_SHM_REGION in params:
+                    out.shm_region = params[KEY_SHM_REGION]
+                    out.shm_offset, out.shm_byte_size = validate_shm_window(
+                        params.get(KEY_SHM_OFFSET, 0),
+                        params.get(KEY_SHM_BYTE_SIZE, 0),
+                    )
+                    out.shm_kind = core.find_shm_kind(out.shm_region)
+                request.outputs.append(out)
+            return request, binary_default
+        except (ValidationError, CoreError, json.JSONDecodeError,
+                KeyError, ValueError, TypeError, AttributeError) as e:
+            if isinstance(e, ValidationError):
+                e = invalid_to_core_error(e)
+            elif not isinstance(e, CoreError):
+                e = CoreError(
+                    f"failed to parse request: {e}", STATUS_INVALID,
+                    INVALID_REASON_MALFORMED,
+                )
+            if e.reason:
+                if trace is None:
+                    trace = core.start_trace(model, version, "", recv_ns=t_recv)
+                core.record_invalid_request(model, e.reason, trace)
+            if trace is not None:
+                trace.note_error(str(e))
+                trace.record("RESPONSE_SEND")
+                trace.finish()
+            raise e
+
     def _infer(self, model: str, version: str):
         # Protocol-ingress timestamp: captured before the body is read so a
         # trace's REQUEST_RECV covers wire parse time, matching Triton's
         # HTTP_RECV span placement.
         t_recv = time.monotonic_ns()
-        core = self.core
-        core.record_protocol_request("http")
-        body = self._read_body()
-        header_len = self.headers.get("Inference-Header-Content-Length")
-        if header_len is not None:
-            json_size = int(header_len)
-            header = json.loads(body[:json_size])
-            binary_blob = body[json_size:]
-        else:
-            header = json.loads(body)
-            binary_blob = b""
-
-        request = CoreRequest(
-            model_name=model,
-            model_version=version,
-            id=header.get("id", ""),
-            parameters=dict(header.get("parameters", {})),
-        )
-        # The KServe `timeout` parameter (microseconds) becomes a parsed
-        # deadline budget instead of an opaque passthrough — popped so a
-        # deadline does not disqualify the request from dynamic batching.
-        timeout = request.parameters.pop(KEY_TIMEOUT, None)
-        if timeout is not None:
-            try:
-                request.deadline_us = max(int(timeout), 0)
-            except (TypeError, ValueError):
-                request.deadline_us = 0
-        # Tenant attribution: the fleet router forwards the tenant-id
-        # header; stamping it here (and on the trace) keys per-tenant
-        # accounting all the way into the flight recorder.
-        request.tenant = self.headers.get(HEADER_TENANT_ID, "")
-        # Request-id propagation: the body id wins; the triton-request-id
-        # header lets clients tag trace records without touching the body.
-        trace = core.start_trace(
-            model, version,
-            request.id or self.headers.get("triton-request-id", ""),
-            recv_ns=t_recv,
-            traceparent=self.headers.get("traceparent"),
-            deadline_us=request.deadline_us,
-            tenant=request.tenant,
-        )
-        request.trace = trace
-
-        offset = 0
-        for js in header.get("inputs", []):
-            params = js.get("parameters", {})
-            name, datatype, shape = js["name"], js["datatype"], list(js["shape"])
-            tensor = CoreTensor(name=name, datatype=datatype, shape=shape)
-            if KEY_SHM_REGION in params:
-                tensor.shm_region = params[KEY_SHM_REGION]
-                tensor.shm_offset = int(params.get(KEY_SHM_OFFSET, 0))
-                tensor.shm_byte_size = int(params.get(KEY_SHM_BYTE_SIZE, 0))
-                tensor.shm_kind = self.core.find_shm_kind(tensor.shm_region)
-            elif KEY_BINARY_DATA_SIZE in params:
-                size = int(params[KEY_BINARY_DATA_SIZE])
-                raw = binary_blob[offset : offset + size]
-                offset += size
-                tensor.data = InferenceCore._decode_raw(datatype, shape, raw)
-            else:
-                tensor.data = _json_data_to_array(datatype, shape, js.get("data"))
-            request.inputs.append(tensor)
-
-        binary_default = bool(request.parameters.pop(KEY_BINARY_DATA_OUTPUT, False))
-        for js in header.get("outputs", []):
-            params = js.get("parameters", {})
-            out = CoreRequestedOutput(
-                name=js["name"],
-                binary=bool(params.get(KEY_BINARY_DATA, binary_default)),
-                class_count=int(params.get(KEY_CLASSIFICATION, 0)),
-            )
-            if KEY_SHM_REGION in params:
-                out.shm_region = params[KEY_SHM_REGION]
-                out.shm_offset = int(params.get(KEY_SHM_OFFSET, 0))
-                out.shm_byte_size = int(params.get(KEY_SHM_BYTE_SIZE, 0))
-                out.shm_kind = self.core.find_shm_kind(out.shm_region)
-            request.outputs.append(out)
+        self.core.record_protocol_request("http")
+        request, binary_default = self._parse_infer(model, version, t_recv)
+        trace = request.trace
 
         # Cancellation propagation: a client that disconnects mid-request
         # arms this event; the batcher sheds the queued slot and engine
@@ -557,7 +683,7 @@ class _Handler(BaseHTTPRequestHandler):
                 raise CoreError(
                     "HTTP does not support decoupled models returning "
                     f"{len(responses)} responses",
-                    400,
+                    STATUS_INVALID,
                 )
             response = responses[0]
 
@@ -641,10 +767,14 @@ class HTTPFrontend:
 
     def __init__(self, core: InferenceCore, host: str = "127.0.0.1", port: int = 0,
                  verbose=False, ssl_certfile: Optional[str] = None,
-                 ssl_keyfile: Optional[str] = None):
+                 ssl_keyfile: Optional[str] = None,
+                 max_request_bytes: int = MAX_REQUEST_BYTES_DEFAULT):
         self._server = _TlsCapableHTTPServer((host, port), _Handler)
         self._server.core = core
         self._server.verbose = verbose
+        # Request-body cap enforced by _read_body (413 over the cap); 0
+        # disables the cap.
+        self._server.max_request_bytes = max_request_bytes
         self._server.daemon_threads = True
         # Client-disconnect -> cancel_event propagation for in-flight
         # requests (the HTTP plane's cancellation signal).
