@@ -65,6 +65,13 @@ Checks (exit 1 with one line per violation):
     the cohort label in canonical (lowercase slug) form;
     ``nv_engine_kv_bytes_touched_total`` carries exactly
     {model, phase} with ``phase`` from the stepscope vocabulary
+  * the compile-plane families (PR 20): ``nv_engine_compile_cache_entries``
+    carries exactly {model, callable} with a value >= 1 (a row exists
+    only once a dispatch signature was recorded);
+    ``nv_engine_retrace_total`` carries exactly {model, callable}; and
+    per (model, callable) series retraces <= entries - 1 (every retrace
+    is a distinct signature beyond the first, so a counter exceeding
+    that means double-counted compiles)
   * the memscope families (PR 18): ``nv_device_memory_bytes`` carries
     exactly {model, pool, kind} with ``pool``/``kind`` drawn from the
     canonical memscope vocabularies and non-negative values, with
@@ -182,6 +189,11 @@ _KV_BYTES_FAMILY = "nv_engine_kv_bytes_touched_total"
 _MEM_BYTES_FAMILY = "nv_device_memory_bytes"
 _MEM_EVENTS_FAMILY = "nv_device_memory_events_total"
 _MEM_HEADROOM_FAMILY = "nv_device_memory_headroom_bytes"
+# Compile-plane families (PR 20): distinct dispatch signatures per
+# jitted callable (compile cache entries) and retrace events beyond the
+# first compile — the runtime face of TPU017 bucket discipline.
+_COMPILE_FAMILY = "nv_engine_compile_cache_entries"
+_RETRACE_FAMILY = "nv_engine_retrace_total"
 
 _VALID_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
 _METRIC_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
@@ -563,6 +575,17 @@ def check_exposition(text: str) -> List[str]:
                             f'{family}{{model="{model}",pool="{pool}"}}: '
                             f"missing event rows {missing}"
                         )
+            if family == _RETRACE_FAMILY:
+                # Retrace counter: exactly {model, callable} (value
+                # non-negativity is the generic counter check above; the
+                # retraces-vs-entries bound is the cross-family check at
+                # the bottom).
+                for labels, value, name, lineno in samples.get(family, []):
+                    if set(labels) != {"model", "callable"}:
+                        errors.append(
+                            f"line {lineno}: {family} label set "
+                            f"{sorted(labels)} != ['callable', 'model']"
+                        )
             if family == _COLLECTIVES_FAMILY:
                 # Stepscope collectives: fixed {model, op} label set (the
                 # op value is open vocabulary — psum/ppermute/all_to_all
@@ -728,6 +751,22 @@ def check_exposition(text: str) -> List[str]:
                         errors.append(
                             f"line {lineno}: {family} value {value} < 0 "
                             "(headroom cannot be negative)"
+                        )
+            if family == _COMPILE_FAMILY:
+                # Compile-cache gauge: exactly {model, callable}, value
+                # >= 1 (a series renders only once a dispatch signature
+                # was recorded, and the first dispatch is an entry).
+                for labels, value, name, lineno in samples.get(family, []):
+                    if set(labels) != {"model", "callable"}:
+                        errors.append(
+                            f"line {lineno}: {family} label set "
+                            f"{sorted(labels)} != ['callable', 'model']"
+                        )
+                        continue
+                    if value < 1:
+                        errors.append(
+                            f"line {lineno}: {family} value {value} < 1 "
+                            "(a rendered series has at least one entry)"
                         )
             if family in (_KV_USED_FAMILY, _KV_TOTAL_FAMILY):
                 # Pool-occupancy gauges: exactly {model}, non-negative.
@@ -910,6 +949,22 @@ def check_exposition(text: str) -> List[str]:
             errors.append(
                 f"line {lineno}: {_KV_USED_FAMILY}{{model=\"{model}\"}} "
                 f"{value} > {_KV_TOTAL_FAMILY} {totals[model]}"
+            )
+    # Cross-family compile-plane invariant: every retrace is a distinct
+    # dispatch signature seen after the first, so per (model, callable)
+    # series retraces can never exceed entries - 1 (a violation means
+    # the watcher double-counted compiles or the gauge went stale).
+    entries_by_series = {
+        (labels.get("model"), labels.get("callable")): value
+        for labels, value, _name, _lineno in samples.get(_COMPILE_FAMILY, [])
+    }
+    for labels, value, name, lineno in samples.get(_RETRACE_FAMILY, []):
+        key = (labels.get("model"), labels.get("callable"))
+        if key in entries_by_series and value > entries_by_series[key] - 1:
+            errors.append(
+                f'line {lineno}: {_RETRACE_FAMILY}{{model="{key[0]}",'
+                f'callable="{key[1]}"}} {value} > '
+                f"{_COMPILE_FAMILY} - 1 ({entries_by_series[key] - 1})"
             )
     # Cross-kind memscope invariant: live can never exceed peak for a
     # (model, pool) cell — peak is by definition the high-water of live,

@@ -9,9 +9,11 @@
 #
 # --sanitize closes the static/dynamic loop: after the static checks it
 # runs the tpusan-instrumented tier-1 subset (TPUSAN=1, the runtime
-# sanitizer witnessing TPU001/TPU006/TPU007 under execution — see the
-# README "Runtime sanitizers" subsection), writes the runtime report,
-# and diffs it against the static picture with scripts/tpusan_report.py.
+# sanitizer witnessing TPU001/TPU006/TPU007/TPU009 plus the JAX
+# compute-plane witnesses for TPU015/TPU016/TPU017 — donation poisoner,
+# transfer guard, compile-cache watcher; see the README "Runtime
+# sanitizers" subsection), writes the runtime report, and diffs it
+# against the static picture with scripts/tpusan_report.py.
 #
 # --modelcheck runs tpumc (scripts/tpumc.py): the four scheduling-core
 # harness models explored under the bounded-preemption schedule
@@ -25,9 +27,12 @@
 # reports — any nondeterminism or contract violation fails the check.
 #
 # Chains, in order:
-#   1. tpulint        — project-specific checks (TPU001..TPU010, incl. the
-#                       interprocedural TPU009 guarded-by race detection and
-#                       TPU010 JAX hot-path hazards); see
+#   1. tpulint        — project-specific checks (TPU001..TPU017, incl. the
+#                       interprocedural TPU009 guarded-by race detection,
+#                       TPU010 JAX hot-path hazards, TPU013 untrusted-sink
+#                       taint, and the tpushape compute-plane rules
+#                       TPU015 donation / TPU016 sharding-drift /
+#                       TPU017 bucket discipline); see
 #                       `python scripts/tpulint.py --list-rules`. Runs over
 #                       tritonclient_tpu/ + scripts/ + tests/ against the
 #                       committed baseline (scripts/tpulint_baseline.json):
@@ -157,7 +162,8 @@ if [ "${SANITIZE}" -eq 1 ]; then
         tests/test_tpusan.py tests/test_fleet.py tests/test_chaos.py tests/test_deadlines.py tests/test_shared_memory.py \
         tests/test_server.py tests/test_grpc_client.py \
         tests/test_http_client.py tests/test_aio_clients.py \
-        tests/test_aio_stress.py tests/test_batcher_stress.py
+        tests/test_aio_stress.py tests/test_batcher_stress.py \
+        tests/test_gpt_engine.py
     run_check "tpusan-report" "${PYTHON}" scripts/tpusan_report.py \
         --dynamic "${TPUSAN_OUT}" --fail-on-witnessed
 fi

@@ -148,6 +148,23 @@ def load_records(doc) -> List[dict]:
     return _records_from_spans(_otel.load_spans(doc))
 
 
+def load_compiles(doc) -> Dict[str, Dict[str, dict]]:
+    """Compile-plane totals from a stepscope dump: model -> callable ->
+    {entries, retraces}. Only stepscope dumps carry the plane (flight
+    dumps and traces have no compile stream); pre-compile-plane dumps
+    simply have no key and report an empty map."""
+    if not (isinstance(doc, dict) and doc.get("kind") == "stepscope"):
+        return {}
+    out: Dict[str, Dict[str, dict]] = {}
+    for key, cell in (doc.get("compiles") or {}).items():
+        model, _, fn = key.partition("|")
+        out.setdefault(model, {})[fn] = {
+            "entries": int(cell.get("entries", 0)),
+            "retraces": int(cell.get("retraces", 0)),
+        }
+    return out
+
+
 def load_file(path: str) -> List[dict]:
     with open(path) as f:
         return load_records(json.load(f))
@@ -166,8 +183,11 @@ def _verdict(dispatch_us: float, device_us: float, other_us: float,
     return VERDICT_DEVICE
 
 
-def analyze(records: List[dict]) -> dict:
-    """Per-model verdict + per-phase quantiles and stage means."""
+def analyze(records: List[dict],
+            compiles: Optional[Dict[str, Dict[str, dict]]] = None) -> dict:
+    """Per-model verdict + per-phase quantiles and stage means; when the
+    dump carries the compile plane, each model also gets its per-callable
+    cache-entry/retrace totals."""
     by_model: Dict[str, List[dict]] = {}
     for r in records:
         by_model.setdefault(r.get("model", ""), []).append(r)
@@ -229,6 +249,8 @@ def analyze(records: List[dict]) -> dict:
             "verdict": _verdict(means["dispatch"], means["device"],
                                 means["other"], coll),
             "phases": phases,
+            "compiles": dict(sorted(((compiles or {}).get(model)
+                                     or {}).items())),
         }
     return {"models": models}
 
@@ -256,6 +278,15 @@ def render(analysis: dict) -> str:
                 f"hidden under compute), "
                 f"micro-steps/dispatch={m.get('micro_steps', 1)}"
             )
+        # Compile plane: distinct cache entries and retraces per jitted
+        # callable. Retraces growing with step count (rather than
+        # plateauing at the bucket-family size) is the TPU017 signal.
+        if m.get("compiles"):
+            cells = ", ".join(
+                f"{fn}={cell['entries']}({cell['retraces']} retraces)"
+                for fn, cell in m["compiles"].items()
+            )
+            lines.append(f"  compiles: {cells}")
         lines.append(
             f"  {'phase':<10} {'n':>6} {'p50_us':>8} {'p99_us':>8} "
             f"{'dispatch':>9} {'device':>8} {'other':>7} {'coll':>6} "
@@ -408,7 +439,15 @@ def _synthetic_dump(dispatch_us: int, device_us: int, other_us: int,
             "kv_bytes": (4_000_000 * micro_steps if phase == "decode"
                          else 1_000_000),
         })
-    return {"kind": "stepscope", "mode": "counters", "records": records}
+    return {
+        "kind": "stepscope", "mode": "counters", "records": records,
+        # Compile plane: the well-bucketed shape — a handful of entries,
+        # retraces = entries - 1 (each new bucket paid one compile).
+        "compiles": {
+            f"{model}|decode_step": {"entries": 2, "retraces": 1},
+            f"{model}|prefill_chunk": {"entries": 3, "retraces": 2},
+        },
+    }
 
 
 def self_check() -> int:
@@ -522,6 +561,20 @@ def self_check() -> int:
         failures += 1
     else:
         print("self-check [kv-bytes]: ok")
+    # Compile plane: the dump's per-callable entry/retrace totals must
+    # survive load_compiles/analyze and surface in the rendered report.
+    dump = _synthetic_dump(60, 700, 20, 0)
+    analysis = analyze(load_records(dump), load_compiles(dump))
+    m = analysis["models"]["gpt_engine"]
+    rendered = render(analysis)
+    if (m["compiles"].get("decode_step") != {"entries": 2, "retraces": 1}
+            or "compiles:" not in rendered
+            or "prefill_chunk=3(2 retraces)" not in rendered):
+        print("self-check [compiles]: compile plane lost",
+              file=sys.stderr)
+        failures += 1
+    else:
+        print("self-check [compiles]: ok")
     # Compare mode renders ratios for shared phases, with the overlap
     # column when either side charged exposed time.
     a = analyze(load_records(_synthetic_dump(60, 200, 20, 0)))
@@ -604,17 +657,19 @@ def main(argv=None) -> int:
         print(f"{args.dump_file}: no step records (is TPU_STEPSCOPE on?)",
               file=sys.stderr)
         return 1
-    analysis = analyze(records)
+    analysis = analyze(records, load_compiles(doc))
     if args.compare:
         try:
-            other = load_file(args.compare)
+            with open(args.compare) as f:
+                other_doc = json.load(f)
+            other = load_records(other_doc)
         except (OSError, ValueError) as e:
             print(f"unable to load {args.compare}: {e}", file=sys.stderr)
             return 1
         if not other:
             print(f"{args.compare}: no step records", file=sys.stderr)
             return 1
-        print(compare(analysis, analyze(other),
+        print(compare(analysis, analyze(other, load_compiles(other_doc)),
                       os.path.basename(args.dump_file),
                       os.path.basename(args.compare)))
         return 0

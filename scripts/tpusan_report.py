@@ -5,9 +5,11 @@ Closing the static/dynamic loop needs an answer to three questions per
 paired rule (TPU001 async-blocking, TPU006 shm-lifecycle, TPU007
 lock-order, TPU009 guarded-by — the Eraser lockset witness, TPU011
 condvar discipline — witnessed by the tpumc schedule explorer rather
-than the passive sanitizer; TPU010 is diffed too, static-only, so its
-hot-path findings appear in the unexercised column rather than
-vanishing from the report):
+than the passive sanitizer; TPU015 donation discipline, TPU016 sharding
+drift, and TPU017 bucket discipline — witnessed by the ``sanitize/_jax``
+donation poisoner, transfer guard, and compile-cache watcher; TPU010 is
+diffed too, static-only, so its hot-path findings appear in the
+unexercised column rather than vanishing from the report):
 
 * **witnessed** — statically flagged AND observed at runtime: the static
   finding is real and the suite exercises it (these should be zero on a
@@ -50,7 +52,7 @@ if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
 DEFAULT_RULES = ("TPU001", "TPU006", "TPU007", "TPU009", "TPU010",
-                 "TPU011", "TPU013")
+                 "TPU011", "TPU013", "TPU015", "TPU016", "TPU017")
 
 
 def load_dynamic(path: str):

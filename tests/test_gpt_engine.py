@@ -100,6 +100,40 @@ def test_paged_sampled_decode_matches_reference(tiny):
         engine.shutdown()
 
 
+def test_donating_slot_clock_advance_keeps_token_identity(tiny):
+    """Regression for the donation-discipline fix (TPU015): the unfused
+    decode branch advances pos/steps through a jit donating both
+    operands, and the loop rebinds the results over the donated names.
+    Running a full generation with the tpusan donation poisoner wrapped
+    around that jit must report zero read-after-donate findings — and
+    the token stream must still match the contiguous reference exactly
+    (the CPU backend ignores donation, so any drift would be a logic
+    bug, not a backend artifact)."""
+    from tritonclient_tpu import sanitize
+    from tritonclient_tpu.sanitize import _jax as sj
+
+    cfg, params = tiny
+    engine = GenerationEngine(cfg, params, max_slots=2, prefill_chunk=8)
+    try:
+        engine._advance = sj.donating(
+            engine._advance, donate_argnums=(0, 1),
+            label="_advance_slot_clocks")
+        rng = np.random.default_rng(29)
+        prompt = rng.integers(0, cfg.vocab_size, (1, 13)).astype(np.int32)
+        ref = _reference(params, prompt, 12, cfg)
+        sanitize.enable(mode="report")
+        try:
+            with sanitize.capture() as cap:
+                got = _collect(engine.submit(prompt, 12))
+                stale = [f for f in cap.findings if f.rule == "TPU015"]
+        finally:
+            sanitize.disable()
+        assert stale == []
+        assert got == ref
+    finally:
+        engine.shutdown()
+
+
 # --------------------------------------------------------------------------- #
 # prefix caching                                                              #
 # --------------------------------------------------------------------------- #
@@ -445,6 +479,86 @@ class TestOverlapExpositionViolations:
             assert (("m", "exposed", 5) in overlap_rows
                     and ("m", "hidden", 0) in overlap_rows)
             assert ("m", 1) in inflight_rows
+        finally:
+            _stepscope._aggregator.reset()
+            _stepscope.configure(prev)
+
+
+class TestCompileExpositionViolations:
+    """The compile-plane exposition contract (PR 20): distinct-lowering
+    gauge + retrace counter per jitted callable, one mutation per
+    violation class through the real checker."""
+
+    HEAD = (
+        "# HELP nv_engine_compile_cache_entries x\n"
+        "# TYPE nv_engine_compile_cache_entries gauge\n"
+        "# HELP nv_engine_retrace_total x\n"
+        "# TYPE nv_engine_retrace_total counter\n"
+    )
+
+    def _good_rows(self):
+        return [
+            'nv_engine_compile_cache_entries'
+            '{model="gpt_engine",callable="decode_step"} 1',
+            'nv_engine_compile_cache_entries'
+            '{model="gpt_engine",callable="prefill_chunk"} 3',
+            'nv_engine_retrace_total'
+            '{model="gpt_engine",callable="decode_step"} 0',
+            'nv_engine_retrace_total'
+            '{model="gpt_engine",callable="prefill_chunk"} 2',
+        ]
+
+    def test_good_document_passes(self):
+        assert check_exposition(
+            self.HEAD + "\n".join(self._good_rows()) + "\n"
+        ) == []
+
+    def test_entries_label_set(self):
+        rows = self._good_rows()
+        rows.append('nv_engine_compile_cache_entries{model="m"} 1')
+        errors = check_exposition(self.HEAD + "\n".join(rows) + "\n")
+        assert any("label set" in e for e in errors)
+
+    def test_retrace_label_set(self):
+        rows = self._good_rows()
+        rows.append(
+            'nv_engine_retrace_total'
+            '{model="m",callable="f",version="1"} 0')
+        errors = check_exposition(self.HEAD + "\n".join(rows) + "\n")
+        assert any("label set" in e for e in errors)
+
+    def test_rendered_series_with_zero_entries(self):
+        rows = self._good_rows()
+        rows[0] = ('nv_engine_compile_cache_entries'
+                   '{model="gpt_engine",callable="decode_step"} 0')
+        errors = check_exposition(self.HEAD + "\n".join(rows) + "\n")
+        assert any("at least one entry" in e for e in errors)
+
+    def test_retraces_exceed_entries_minus_one(self):
+        """Every retrace is an entry beyond the first, so per series
+        retraces > entries - 1 means the two streams desynced."""
+        rows = self._good_rows()
+        rows[2] = ('nv_engine_retrace_total'
+                   '{model="gpt_engine",callable="decode_step"} 1')
+        errors = check_exposition(self.HEAD + "\n".join(rows) + "\n")
+        assert any("nv_engine_retrace_total" in e and "- 1" in e
+                   for e in errors)
+
+    def test_live_snapshot_counts_distinct_keys_only(self):
+        """note_compile() feeds /metrics: re-dispatching a seen
+        signature is free, each new one past the first is a retrace."""
+        from tritonclient_tpu import _stepscope
+
+        prev = _stepscope._mode
+        _stepscope.configure("counters")
+        _stepscope._aggregator.reset()
+        try:
+            for key in ("4x1x64", "4x2x64", "4x1x64", "4x4x64"):
+                _stepscope.note_compile("m", "prefill_chunk", key)
+            _stepscope.note_compile("m", "decode_step", "bank:2x8:fuse:1")
+            rows = _stepscope.compile_snapshot()
+            assert ("m", "prefill_chunk", 3, 2) in rows
+            assert ("m", "decode_step", 1, 0) in rows
         finally:
             _stepscope._aggregator.reset()
             _stepscope.configure(prev)

@@ -2158,3 +2158,475 @@ class TestValidationLiteralDrift:
             """,
         }, select=["TPU008"])
         assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# TPU015 donation discipline (tpushape)                                       #
+# --------------------------------------------------------------------------- #
+
+
+DONATION_READ_FIXTURE = """
+    import jax
+
+    step = jax.jit(lambda s: s + 1, donate_argnums=(0,))
+
+
+    def bad(state):
+        new = step(state)
+        return state.sum() + new
+"""
+
+
+class TestDonationDiscipline:
+    def test_fires_on_read_after_donate(self, tmp_path):
+        findings = lint(tmp_path, DONATION_READ_FIXTURE, select={"TPU015"})
+        assert rules_of(findings) == ["TPU015"]
+        msg = findings[0].message
+        assert "read after being donated" in msg
+        assert "`state`" in msg and "`step`" in msg
+
+    def test_clean_when_result_rebinds_the_donated_name(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import jax
+
+            step = jax.jit(lambda s: s + 1, donate_argnums=(0,))
+
+
+            def good(state):
+                state = step(state)
+                return state.sum()
+            """,
+            select={"TPU015"},
+        )
+        assert findings == []
+
+    def test_donate_argnames_and_branch_paths_fire(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import jax
+
+            step = jax.jit(lambda carry, x: carry + x,
+                           donate_argnames=("carry",))
+
+
+            def bad(carry, x, flag):
+                out = step(carry=carry, x=x)
+                if flag:
+                    return carry
+                return out
+            """,
+            select={"TPU015"},
+        )
+        assert rules_of(findings) == ["TPU015"]
+
+    def test_fires_on_undonated_hot_loop_rebuild(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import jax
+            import jax.numpy as jnp
+
+
+            class Engine:
+                def __init__(self):
+                    self._step = jax.jit(lambda p, k: (p, k),
+                                         donate_argnums=(1,))
+                    self._pos = jnp.zeros((4,), jnp.int32)
+
+                # tpulint: hot-path
+                def run(self):
+                    while True:
+                        self._pos = self._pos + 1
+            """,
+            select={"TPU015"},
+        )
+        assert rules_of(findings) == ["TPU015"]
+        msg = findings[0].message
+        assert "rebuilt every step" in msg and "never donated" in msg
+
+    def test_scatter_update_is_not_a_rebuild(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import jax
+            import jax.numpy as jnp
+
+
+            class Engine:
+                def __init__(self):
+                    self._step = jax.jit(lambda p: p)
+                    self._tokens = jnp.zeros((4,), jnp.int32)
+
+                # tpulint: hot-path
+                def run(self, tok):
+                    while True:
+                        self._tokens = self._tokens.at[0].set(tok)
+            """,
+            select={"TPU015"},
+        )
+        assert findings == []
+
+    def test_suppressed(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import jax
+
+            step = jax.jit(lambda s: s + 1, donate_argnums=(0,))
+
+
+            def bad(state):
+                new = step(state)
+                return state.sum() + new  # tpulint: disable=TPU015 -- checkpoint readback
+            """,
+            select={"TPU015"},
+        )
+        assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# TPU016 sharding drift (tpushape)                                            #
+# --------------------------------------------------------------------------- #
+
+
+class TestShardingDrift:
+    def test_fires_on_local_producer_consumer_mismatch(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+
+            def drift(mesh, pool):
+                pool = jax.device_put(pool, P(None, "tp"))
+                f = shard_map(lambda x: x, mesh=mesh,
+                              in_specs=(P("tp", None),),
+                              out_specs=P(None, None))
+                return f(pool)
+            """,
+            select={"TPU016"},
+        )
+        assert rules_of(findings) == ["TPU016"]
+        msg = findings[0].message
+        assert "P(None,tp)" in msg and "P(tp)" in msg
+        assert "implicit reshard" in msg
+
+    def test_fires_through_a_helper_with_the_call_path(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+
+            def helper_consume(mesh, arr):
+                f = shard_map(lambda x: x, mesh=mesh,
+                              in_specs=(P("tp", None),),
+                              out_specs=P(None, None))
+                return f(arr)
+
+
+            def drift_via_helper(mesh, pool):
+                pool = jax.device_put(pool, P(None, "tp"))
+                return helper_consume(mesh, pool)
+            """,
+            select={"TPU016"},
+        )
+        assert rules_of(findings) == ["TPU016"]
+        assert "drift_via_helper -> " in findings[0].message
+        assert "helper_consume" in findings[0].message
+
+    def test_clean_when_specs_agree(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+
+            def aligned(mesh, pool):
+                pool = jax.device_put(pool, P("tp", None))
+                f = shard_map(lambda x: x, mesh=mesh,
+                              in_specs=(P("tp", None),),
+                              out_specs=P(None, None))
+                return f(pool)
+            """,
+            select={"TPU016"},
+        )
+        assert findings == []
+
+    def test_trailing_replicated_axes_compare_equal(self, tmp_path):
+        # P(None) and P() are both fully replicated: no drift.
+        findings = lint(
+            tmp_path,
+            """
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+
+            def replicated(mesh, bias):
+                bias = jax.device_put(bias, P(None))
+                f = shard_map(lambda b: b, mesh=mesh, in_specs=(P(),),
+                              out_specs=P(None))
+                return f(bias)
+            """,
+            select={"TPU016"},
+        )
+        assert findings == []
+
+    def test_suppressed(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+
+            def drift(mesh, pool):
+                pool = jax.device_put(pool, P(None, "tp"))
+                f = shard_map(lambda x: x, mesh=mesh,
+                              in_specs=(P("tp", None),),
+                              out_specs=P(None, None))
+                return f(pool)  # tpulint: disable=TPU016 -- one-shot relayout
+            """,
+            select={"TPU016"},
+        )
+        assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# TPU017 bucket discipline (tpushape)                                         #
+# --------------------------------------------------------------------------- #
+
+
+class TestBucketDiscipline:
+    def test_fires_on_unbucketed_len_to_traced_shape(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import jax
+            import jax.numpy as jnp
+
+            step = jax.jit(lambda p, t: t)
+
+
+            def bad(params, batch):
+                n = len(batch)
+                toks = jnp.zeros((n, 8), jnp.int32)
+                return step(params, toks)
+            """,
+            select={"TPU017"},
+        )
+        assert rules_of(findings) == ["TPU017"]
+        msg = findings[0].message
+        assert "`toks`" in msg and "bucketing" in msg
+        assert "one XLA compile per distinct size" in msg
+
+    def test_fires_through_a_helper_with_the_call_path(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import jax
+            import jax.numpy as jnp
+
+            step = jax.jit(lambda p, t: t)
+
+
+            def dim_user(params, m):
+                return step(params, jnp.zeros((m, 8), jnp.int32))
+
+
+            def bad_via_helper(params, batch):
+                return dim_user(params, len(batch))
+            """,
+            select={"TPU017"},
+        )
+        assert rules_of(findings) == ["TPU017"]
+        assert "bad_via_helper -> " in findings[0].message
+
+    def test_clean_when_bucketed(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import jax
+            import jax.numpy as jnp
+
+            step = jax.jit(lambda p, t: t)
+
+
+            def _pow2_bucket(n, cap):
+                b = 1
+                while b < n:
+                    b *= 2
+                return min(b, cap)
+
+
+            def good(params, batch):
+                k = _pow2_bucket(len(batch), 64)
+                toks = jnp.zeros((k, 8), jnp.int32)
+                return step(params, toks)
+            """,
+            select={"TPU017"},
+        )
+        assert findings == []
+
+    def test_min_cap_against_static_bound_sanitizes(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import jax
+            import jax.numpy as jnp
+
+            step = jax.jit(lambda p, t: t)
+
+            MAX_SLOTS = 64
+
+
+            def capped(params, batch):
+                k = min(len(batch), MAX_SLOTS)
+                return step(params, jnp.zeros((k, 8), jnp.int32))
+            """,
+            select={"TPU017"},
+        )
+        assert findings == []
+
+    def test_suppressed(self, tmp_path):
+        findings = lint(
+            tmp_path,
+            """
+            import jax
+            import jax.numpy as jnp
+
+            step = jax.jit(lambda p, t: t)
+
+
+            def offline(params, batch):
+                n = len(batch)
+                toks = jnp.zeros((n, 8), jnp.int32)
+                return step(params, toks)  # tpulint: disable=TPU017 -- one-shot offline tool
+            """,
+            select={"TPU017"},
+        )
+        assert findings == []
+
+
+def test_shape_rules_run_clean_on_the_repo():
+    """The acceptance gate for the tpushape layer: TPU015/TPU016/TPU017
+    exit 0 over the package and scripts after the gpt_engine donation fix
+    (true positives are fixed, not baselined)."""
+    import tritonclient_tpu
+
+    package_dir = os.path.dirname(tritonclient_tpu.__file__)
+    scripts_dir = os.path.join(os.path.dirname(package_dir), "scripts")
+    findings, _ = run_analysis(
+        [package_dir, scripts_dir], select={"TPU015", "TPU016", "TPU017"}
+    )
+    assert findings == [], "\n".join(f.text() for f in findings)
+
+
+class TestShapeCacheAndExplain:
+    def test_callgraph_cache_v7_round_trips_shape_facts(self, tmp_path,
+                                                        monkeypatch, capsys):
+        """Shape facts must survive the v7 cache: a second run loading
+        summaries from disk reproduces the TPU015 finding byte-for-byte,
+        and the cache document says version 7."""
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(textwrap.dedent(DONATION_READ_FIXTURE))
+        monkeypatch.chdir(tmp_path)
+        cache = tmp_path / "cache" / "callgraph.json"
+
+        rc1 = main(["--select", "TPU015", "--callgraph-cache", str(cache),
+                    "pkg"])
+        out1 = capsys.readouterr().out
+        assert rc1 == 1 and cache.exists()
+        doc = json.loads(cache.read_text())
+        assert doc["version"] == 7
+        assert any(
+            fn.get("shapes") for rec in doc["files"].values()
+            for fn in rec["functions"]
+        )
+
+        rc2 = main(["--select", "TPU015", "--callgraph-cache", str(cache),
+                    "pkg"])
+        out2 = capsys.readouterr().out
+        assert rc2 == 1
+        assert out1 == out2  # cached shape facts reproduce the findings
+
+    def test_stale_cache_version_migrates(self, tmp_path, monkeypatch,
+                                          capsys):
+        """A v6 (pre-shapes) cache is discarded, not trusted: the run
+        re-summarizes and still finds the donation read."""
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(textwrap.dedent(DONATION_READ_FIXTURE))
+        monkeypatch.chdir(tmp_path)
+        cache = tmp_path / "cache" / "callgraph.json"
+        cache.parent.mkdir()
+        cache.write_text(json.dumps({"version": 6, "files": {}}))
+
+        rc = main(["--select", "TPU015", "--callgraph-cache", str(cache),
+                   "pkg"])
+        out = capsys.readouterr().out
+        assert rc == 1 and "TPU015" in out
+        assert json.loads(cache.read_text())["version"] == 7
+
+    def test_every_rule_has_an_explanation(self):
+        from tritonclient_tpu.analysis import default_rules, explain_rule
+
+        for rule in default_rules():
+            doc = explain_rule(rule.id)
+            assert doc and doc.startswith(f"{rule.id}  {rule.name}:")
+            # Header plus a real body: the worked example / fix guidance
+            # from the rule module's documentation.
+            header, _, body = doc.partition("\n\n")
+            assert len(body.strip()) > 200, rule.id
+
+    def test_explain_cli_prints_guidance_and_rejects_unknown(self, capsys):
+        rc = main(["--explain", "TPU017"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "bucket" in out and "Fix:" in out
+
+        rc = main(["--explain", "TPU999"])
+        err = capsys.readouterr().err
+        assert rc == 2 and "unknown rule" in err
+
+
+def test_baseline_shrink_covers_shape_rule_fingerprints(
+    tmp_path, monkeypatch, capsys
+):
+    """The shrink-only gate is fingerprint-generic; pin that TPU015/016/
+    017 fingerprints ride it like every earlier rule family."""
+    helper = TestBaselineShrinkCoversTPU011()
+    mod = helper._load_script()
+    fps = {
+        "TPU015::pkg/a.py::`state` is read after being donated": 1,
+        "TPU016::pkg/b.py::sharding drift P(None,tp) vs P(tp)": 1,
+        "TPU017::pkg/c.py::unbucketed magnitude shapes traced operand": 1,
+    }
+    helper._seed_repo(tmp_path, fps)
+    monkeypatch.setattr(mod, "_REPO_ROOT", str(tmp_path))
+    assert mod.main(["--base", "HEAD"]) == 0
+    grown = dict(fps)
+    grown["TPU016::pkg/new.py::fresh drift"] = 1
+    (tmp_path / "scripts" / "tpulint_baseline.json").write_text(
+        json.dumps({"format": "tpulint-baseline", "findings": grown})
+    )
+    assert mod.main(["--base", "HEAD"]) == 1
+    assert "NEW" in capsys.readouterr().err
+    # Resolving one of the seeded findings shrinks and passes.
+    shrunk = {k: v for k, v in fps.items() if not k.startswith("TPU015")}
+    (tmp_path / "scripts" / "tpulint_baseline.json").write_text(
+        json.dumps({"format": "tpulint-baseline", "findings": shrunk})
+    )
+    assert mod.main(["--base", "HEAD"]) == 0

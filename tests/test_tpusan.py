@@ -620,3 +620,275 @@ class TestLocksetWitness:
         dynamic = [f for f in tpusan.findings if f.rule == "TPU009"]
         assert len(dynamic) == 1
         assert "`Gauge.value`" in dynamic[0].message
+
+
+# --------------------------------------------------------------------------- #
+# JAX compute-plane witnesses (TPU015 / TPU016 / TPU017)                      #
+# --------------------------------------------------------------------------- #
+
+
+class TestDonationWitness:
+    def test_seeded_read_after_donate_is_caught(self, tpusan):
+        from tritonclient_tpu.sanitize import _jax as sj
+
+        step = sj.donating(
+            lambda s: s + 1, donate_argnums=(0,), label="decode_step")
+        state = np.ones((4,), np.float32)
+        step(state)   # donates `state`
+        step(state)   # read-after-donate: garbage on a real TPU
+        hits = [f for f in tpusan.findings if f.rule == "TPU015"]
+        assert len(hits) == 1
+        msg = hits[0].message
+        assert "read-after-donate" in msg and "`decode_step`" in msg
+        assert "garbage" in msg
+        # Donation-site AND read-site stacks attached.
+        rec = [r for r in tpusan.records if r["rule"] == "TPU015"][0]
+        assert len(rec["stacks"]) >= 2
+
+    def test_explicit_read_site_is_caught(self, tpusan):
+        from tritonclient_tpu.sanitize import _jax as sj
+
+        step = sj.donating(lambda s: s * 2, donate_argnums=(0,), label="step")
+        state = np.zeros((2,), np.float32)
+        step(state)
+        assert sj.check_read(state, where="kv readback") is True
+        hits = [f for f in tpusan.findings if f.rule == "TPU015"]
+        assert len(hits) == 1
+        assert "at kv readback" in hits[0].message
+
+    def test_rebind_discipline_is_clean(self, tpusan):
+        """The correct pattern — rebinding the result over the donated
+        name — never re-reads a poisoned buffer."""
+        from tritonclient_tpu.sanitize import _jax as sj
+
+        step = sj.donating(lambda s: s + 1, donate_argnums=(0,), label="step")
+        state = np.zeros((4,), np.float32)
+        for _ in range(3):
+            state = step(state)
+        assert [f for f in tpusan.findings if f.rule == "TPU015"] == []
+
+    def test_strict_mode_raises(self, _strict):
+        from tritonclient_tpu.sanitize import _jax as sj
+
+        step = sj.donating(lambda s: s, donate_argnums=(0,), label="step")
+        state = np.ones((2,), np.float32)
+        step(state)
+        with pytest.raises(TpusanError, match="TPU015"):
+            step(state)
+
+
+class TestTransferWitness:
+    def test_seeded_host_operand_trips_the_guard(self, tpusan):
+        jax = pytest.importorskip("jax")
+        from tritonclient_tpu.sanitize import _jax as sj
+
+        f = sj.check_transfers(jax.jit(lambda x: x * 2), label="decode_step")
+        out = f(np.ones((4,), np.float32))  # host->device under the guard
+        # Report mode retried unguarded: execution continued correctly.
+        assert np.asarray(out).tolist() == [2.0] * 4
+        hits = [x for x in tpusan.findings if x.rule == "TPU016"]
+        assert len(hits) == 1
+        msg = hits[0].message
+        assert "implicit device transfer" in msg and "`decode_step`" in msg
+
+    def test_device_resident_operands_are_clean(self, tpusan):
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+        from tritonclient_tpu.sanitize import _jax as sj
+
+        f = sj.check_transfers(jax.jit(lambda x: x * 2), label="decode_step")
+        out = f(jnp.ones((4,), jnp.float32))
+        assert np.asarray(out).tolist() == [2.0] * 4
+        assert [x for x in tpusan.findings if x.rule == "TPU016"] == []
+
+
+class TestCompileCacheWatcher:
+    def test_seeded_budget_overflow_is_caught(self, tpusan):
+        from tritonclient_tpu.sanitize import _jax as sj
+
+        sj.declare_bucket_budget("prefill_chunk", 2)
+        for n in (1, 2, 3, 4):
+            sj.note_lowering("prefill_chunk", f"({n}, 8):int32", model="m")
+        hits = [f for f in tpusan.findings if f.rule == "TPU017"]
+        # One finding per label, at the first overflow.
+        assert len(hits) == 1
+        msg = hits[0].message
+        assert "compile-cache overflow" in msg
+        assert "`prefill_chunk`" in msg
+        assert "3 distinct" in msg and "budget of 2" in msg
+
+    def test_watched_wrapper_records_operand_signatures(self, tpusan):
+        from tritonclient_tpu.sanitize import _jax as sj
+
+        sj.declare_bucket_budget("step", 1)
+        step = sj.watched(lambda t: t, label="step")
+        step(np.zeros((1, 8), np.int32))
+        assert [f for f in tpusan.findings if f.rule == "TPU017"] == []
+        step(np.zeros((2, 8), np.int32))  # second distinct lowering
+        hits = [f for f in tpusan.findings if f.rule == "TPU017"]
+        assert len(hits) == 1
+
+    def test_bucketed_family_within_budget_is_clean(self, tpusan):
+        from tritonclient_tpu.sanitize import _jax as sj
+
+        sj.declare_bucket_budget("decode", 4)
+        step = sj.watched(lambda t: t, label="decode")
+        for n in (1, 2, 4, 2, 1, 4):  # pow2 family, re-dispatches free
+            step(np.zeros((n,), np.float32))
+        assert [f for f in tpusan.findings if f.rule == "TPU017"] == []
+
+    def test_feeds_the_stepscope_compile_plane(self, tpusan, monkeypatch):
+        from tritonclient_tpu import _stepscope
+        from tritonclient_tpu.sanitize import _jax as sj
+
+        monkeypatch.setattr(_stepscope, "_mode", _stepscope.MODE_COUNTERS)
+        _stepscope.reset()
+        for key in ("(1, 8):int32", "(2, 8):int32", "(4, 8):int32"):
+            sj.note_lowering("prefill_chunk", key, model="gpt")
+        rows = _stepscope.compile_snapshot()
+        assert ("gpt", "prefill_chunk", 3, 2) in rows
+        _stepscope.reset()
+
+
+class TestWitnessedClassification:
+    """End-to-end static/dynamic agreement per compute-plane rule: the
+    seeded file fires the tpushape rule in tpulint, executing the same
+    file's violation under the witness fires the runtime rule *from a
+    frame in that file*, and ``tpusan_report.classify`` pairs the two
+    as witnessed. The seed lives in a scratch dir inside the repo so
+    the static path (as linted) and the dynamic path (the innermost
+    project frame) are the same repo-relative string."""
+
+    @pytest.fixture
+    def seed_dir(self, monkeypatch):
+        import shutil
+        import tempfile
+
+        from tritonclient_tpu.sanitize import _REPO_ROOT
+
+        monkeypatch.chdir(_REPO_ROOT)
+        d = tempfile.mkdtemp(prefix="tpusan_seed_", dir=_REPO_ROOT)
+        try:
+            yield d
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+
+    @staticmethod
+    def _seed(seed_dir, name, source):
+        """Write a seed module and return (repo-relative path, module)."""
+        import importlib.util
+        import os
+        import textwrap
+
+        from tritonclient_tpu.sanitize import _REPO_ROOT
+
+        path = os.path.join(seed_dir, name)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(textwrap.dedent(source))
+        spec = importlib.util.spec_from_file_location(
+            f"tpusan_seed_{name[:-3]}", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        rel = os.path.relpath(path, _REPO_ROOT).replace(os.sep, "/")
+        return rel, mod
+
+    @staticmethod
+    def _classified(rule, rel, static, records):
+        import sys
+
+        sys.path.insert(0, "scripts")
+        try:
+            import tpusan_report
+        finally:
+            sys.path.pop(0)
+        dynamic = [r for r in records if r["rule"] == rule]
+        witnessed, unexercised, unpredicted = tpusan_report.classify(
+            [{"rule": f.rule, "path": f.path, "line": f.line,
+              "message": f.message} for f in static],
+            dynamic,
+        )
+        assert unexercised == [] and unpredicted == []
+        assert [(f["rule"], f["path"]) for f, _ in witnessed] == [(rule, rel)]
+        return witnessed
+
+    def test_tpu015_donation_pair_is_witnessed(self, tpusan, seed_dir):
+        from tritonclient_tpu.analysis import run_analysis
+        from tritonclient_tpu.sanitize import _jax as sj
+
+        rel, mod = self._seed(seed_dir, "seeded_donate.py", """
+            import jax
+
+            step = jax.jit(lambda state: state + 1, donate_argnums=(0,))
+
+
+            def bad(state):
+                new = step(state)
+                return new + state.sum()
+            """)
+        static, _ = run_analysis([rel], select={"TPU015"})
+        assert [f.rule for f in static] == ["TPU015"]
+        assert f"read after being donated" in static[0].message
+
+        mod.step = sj.donating(mod.step, donate_argnums=(0,), label="step")
+        state = np.ones((2,), np.float32)
+        mod.bad(state)  # poisons `state`
+        mod.bad(state)  # the read the static rule predicted
+        self._classified("TPU015", rel, static, tpusan.records)
+
+    def test_tpu016_sharding_pair_is_witnessed(self, tpusan, seed_dir):
+        jax = pytest.importorskip("jax")
+        from tritonclient_tpu.analysis import run_analysis
+        from tritonclient_tpu.sanitize import _jax as sj
+
+        rel, mod = self._seed(seed_dir, "seeded_drift.py", """
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+
+            def drift(mesh, pool):
+                pool = jax.device_put(pool, P(None, "tp"))
+                f = shard_map(lambda x: x, mesh=mesh,
+                              in_specs=(P("tp", None),),
+                              out_specs=P(None, None))
+                return f(pool)
+
+
+            def roundtrip(step, batch):
+                return step(batch)
+            """)
+        static, _ = run_analysis([rel], select={"TPU016"})
+        assert [f.rule for f in static] == ["TPU016"]
+        assert "implicit reshard" in static[0].message
+
+        step = sj.check_transfers(jax.jit(lambda x: x * 2), label="drift")
+        mod.roundtrip(step, np.ones((4,), np.float32))
+        self._classified("TPU016", rel, static, tpusan.records)
+
+    def test_tpu017_bucket_pair_is_witnessed(self, tpusan, seed_dir):
+        from tritonclient_tpu.analysis import run_analysis
+        from tritonclient_tpu.sanitize import _jax as sj
+
+        rel, mod = self._seed(seed_dir, "seeded_bucket.py", """
+            import jax
+            import jax.numpy as jnp
+
+            step = jax.jit(lambda p, t: t)
+
+
+            def bad(params, batch):
+                n = len(batch)
+                toks = jnp.zeros((n, 8), jnp.int32)
+                return step(params, toks)
+            """)
+        static, _ = run_analysis([rel], select={"TPU017"})
+        assert [f.rule for f in static] == ["TPU017"]
+        assert "one XLA compile per distinct size" in static[0].message
+
+        # Label unique to this seed: the watcher reports once per label
+        # per sanitizer session, mirroring the real compile cache.
+        sj.declare_bucket_budget("seeded_bucket.step", 1)
+        mod.step = sj.watched(mod.step, label="seeded_bucket.step")
+        for size in (1, 2, 3):
+            mod.bad(None, [0] * size)
+        self._classified("TPU017", rel, static, tpusan.records)

@@ -89,6 +89,8 @@ COLLECTIVES_METRIC = "nv_engine_collectives_total"
 OVERLAP_METRIC = "nv_engine_collective_overlap_us_total"
 INFLIGHT_METRIC = "nv_engine_inflight_steps"
 KV_BYTES_METRIC = "nv_engine_kv_bytes_touched_total"
+COMPILE_CACHE_METRIC = "nv_engine_compile_cache_entries"
+RETRACE_METRIC = "nv_engine_retrace_total"
 
 # The exposed/hidden vocabulary is spelled once in protocol/_literals (the
 # wire-literal module); the fallback keeps stepscope importable standalone.
@@ -215,6 +217,12 @@ class _Aggregator:
             self.overlap: Dict[Tuple[str, str], int] = {}
             # model -> decode dispatches currently in flight
             self.inflight: Dict[str, int] = {}
+            # (model, callable) -> distinct dispatch-signature keys; the
+            # set size is the compile-cache-entries gauge.
+            self.compile_keys: Dict[Tuple[str, str], set] = {}
+            # (model, callable) -> new-signature events beyond the first
+            # (each one paid a fresh XLA trace+compile).
+            self.retraces: Dict[Tuple[str, str], int] = {}
             # model -> slowest finished step (as_dict)
             self.slowest: Dict[str, dict] = {}
             try:
@@ -423,6 +431,41 @@ def expected_overlap_split(n_layers: int, tp: int,
     return (per_step * (chunks - 1), per_step)
 
 
+def note_compile(model: str, fn: str, key: str):
+    """Record one dispatch signature of a jitted callable.
+
+    The engine computes ``key`` from the traced-operand shapes/dtypes of
+    the dispatch (the same identity XLA's compile cache uses), so a key
+    not seen before means this dispatch paid a fresh trace+compile. The
+    distinct-key count is the ``nv_engine_compile_cache_entries`` gauge;
+    new keys beyond the first increment ``nv_engine_retrace_total``.
+    The tpusan compile-cache watcher (``sanitize/_jax.py``) feeds the
+    same plane and additionally enforces declared bucket budgets
+    (TPU017). No-op when stepscope is off (one global read)."""
+    if _mode == MODE_OFF:
+        return
+    agg = _aggregator
+    with agg._lock:
+        keys = agg.compile_keys.setdefault((model, fn), set())
+        if key in keys:
+            return
+        keys.add(key)
+        if len(keys) > 1:
+            ck = (model, fn)
+            agg.retraces[ck] = agg.retraces.get(ck, 0) + 1
+
+
+def compile_snapshot() -> List[Tuple[str, str, int, int]]:
+    """``(model, callable, cache entries, retraces)`` rows for the
+    nv_engine_compile_cache_entries / nv_engine_retrace_total families."""
+    agg = _aggregator
+    with agg._lock:
+        return [
+            (model, fn, len(keys), agg.retraces.get((model, fn), 0))
+            for (model, fn), keys in sorted(agg.compile_keys.items())
+        ]
+
+
 def inflight_update(model: str, delta: int):
     """Track the pipelined-dispatch window: the engine calls ``+1`` when a
     decode dispatch is submitted and ``-1`` when its delivery drains.
@@ -585,6 +628,13 @@ def dump() -> dict:
         }
         inflight = dict(sorted(agg.inflight.items()))
         slowest = dict(agg.slowest)
+        compiles = {
+            f"{model}|{fn}": {
+                "entries": len(keys),
+                "retraces": agg.retraces.get((model, fn), 0),
+            }
+            for (model, fn), keys in sorted(agg.compile_keys.items())
+        }
     return {
         "kind": "stepscope",
         "mode": _mode,
@@ -595,4 +645,5 @@ def dump() -> dict:
         "kv_bytes": kv_bytes,
         "inflight": inflight,
         "slowest": slowest,
+        "compiles": compiles,
     }

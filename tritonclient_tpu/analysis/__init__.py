@@ -1,11 +1,13 @@
 """tpulint — project-specific static analysis for the TPU serving stack.
 
-Thirteen check families tuned to the bug classes this codebase's
+Sixteen check families tuned to the bug classes this codebase's
 surfaces actually grow (two protocol front-ends, sync+aio clients, a
-threaded server core, a DLPack/shm registry). TPU001–TPU005 are
-AST-local; TPU006–TPU008 and TPU014 are flow- and project-sensitive;
-TPU009–TPU011 and TPU013 are interprocedural over the whole-program
-call graph (``_callgraph.py``):
+threaded server core, a DLPack/shm registry, a JAX compute plane).
+TPU001–TPU005 are AST-local; TPU006–TPU008 and TPU014 are flow- and
+project-sensitive; TPU009–TPU011, TPU013, and TPU015–TPU017 are
+interprocedural over the whole-program call graph (``_callgraph.py``
+— the latter three over its tpushape abstract-value layer,
+``_shapes.py``):
 
 =======  =================  ====================================================
 rule     name               catches
@@ -68,6 +70,23 @@ TPU014   validation-drift   a request field validated on one protocol plane
                             (HTTP/gRPC server front-end) but referenced
                             unvalidated on the other, or validated only in
                             a client library while the server trusts it
+TPU015   donation-          a buffer passed through ``donate_argnums``/
+         discipline         ``donate_argnames`` read again on any path
+                            (garbage on real TPUs — the CPU backend
+                            ignores donation, so tests stay green), plus
+                            the inverse advisory: a hot-loop operand
+                            rebuilt every step but never donated
+TPU016   sharding-drift     an array placed under one ``NamedSharding``
+                            flowing into a shard_map/jit boundary whose
+                            in-spec differs — an implicit reshard
+                            (all-to-all or host round-trip) per call,
+                            reported with the producer→consumer path
+TPU017   bucket-discipline  a per-request magnitude (``len``/``.shape``)
+                            shaping a traced operand of a jitted callable
+                            without passing a pow2/chunk bucketing
+                            function — one XLA compile per distinct size
+                            (the tpusan compile-cache watcher is the
+                            runtime witness)
 =======  =================  ====================================================
 
 Suppress a deliberate violation with ``# tpulint: disable=TPU001`` (comma
@@ -77,6 +96,7 @@ covers the file. Project-wide rules (TPU004/007–011/013/014) honor the same
 syntax at the line their finding points to. Mark a hot root with
 ``# tpulint: hot-path`` on (or immediately above) its ``def`` line —
 TPU010 treats everything call-graph-reachable from it as hot.
+``--explain RULE`` prints a rule's worked example and fix guidance.
 
 Run ``python -m tritonclient_tpu.analysis <paths>`` (exit 1 on findings).
 ``--format json|sarif`` selects machine-readable output (SARIF 2.1.0 for
@@ -104,12 +124,30 @@ __all__ = [
     "Finding",
     "Rule",
     "default_rules",
+    "explain_rule",
     "main",
     "render_json",
     "render_sarif",
     "render_text",
     "run_analysis",
 ]
+
+
+def explain_rule(rule_id):
+    """The worked example + fix guidance for a rule: the docstring of
+    the module defining it, headed by the one-line description. Returns
+    None for an unknown rule id/name (``--explain`` exits 2 on that)."""
+    import importlib
+
+    want = rule_id.strip()
+    for rule in default_rules():
+        if rule.id != want.upper() and rule.name != want.lower():
+            continue
+        module = importlib.import_module(type(rule).__module__)
+        doc = (module.__doc__ or "").strip()
+        header = f"{rule.id}  {rule.name}: {rule.description}"
+        return f"{header}\n\n{doc}" if doc else header
+    return None
 
 
 def _git_changed_files(paths):
@@ -168,6 +206,11 @@ def main(argv=None) -> int:
         help="print the rule table and exit",
     )
     parser.add_argument(
+        "--explain", metavar="RULE", default=None,
+        help="print RULE's worked example and fix guidance (from its "
+        "rule-module documentation) and exit",
+    )
+    parser.add_argument(
         "--baseline", metavar="FILE", default=None,
         help="fail only on findings absent from this baseline file",
     )
@@ -198,6 +241,20 @@ def main(argv=None) -> int:
     if args.list_rules:
         for rule in default_rules():
             print(f"{rule.id}  {rule.name}: {rule.description}")
+        return 0
+
+    if args.explain:
+        doc = explain_rule(args.explain)
+        if doc is None:
+            print(
+                f"tpulint: unknown rule {args.explain!r} (see --list-rules)",
+                file=sys.stderr,
+            )
+            return 2
+        try:
+            print(doc)
+        except BrokenPipeError:
+            pass
         return 0
 
     select = (

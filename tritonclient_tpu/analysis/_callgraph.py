@@ -44,7 +44,7 @@ import os
 import re
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-from tritonclient_tpu.analysis import _taint
+from tritonclient_tpu.analysis import _shapes, _taint
 from tritonclient_tpu.analysis._engine import (
     FileContext,
     discover_files,
@@ -116,7 +116,7 @@ _CV_METHODS = {"wait", "wait_for", "notify", "notify_all"}
 #: count as predicate writes for the notify-discipline check.
 _SIGNAL_METHODS = {"put", "put_nowait", "set", "clear", "release"}
 
-CACHE_VERSION = 6  # v6: per-function taint facts (TPU013)
+CACHE_VERSION = 7  # v7: per-function shape/sharding/donation facts (TPU015-TPU017)
 
 
 def modkey_for(path: str) -> str:
@@ -225,7 +225,7 @@ class CvSite:
 class FunctionSummary:
     __slots__ = ("key", "path", "line", "cls", "name", "public", "hot",
                  "is_spawn_site", "calls", "accesses", "spawns", "hazards",
-                 "cvsites", "signals", "taint")
+                 "cvsites", "signals", "taint", "shapes")
 
     def __init__(self, key, path, line, cls_name, name, public, hot):
         self.key = key
@@ -248,6 +248,9 @@ class FunctionSummary:
         # Per-function taint facts (TPU013); None when the function has
         # no parameters, sinks, or forwarded taint worth recording.
         self.taint = None
+        # Per-function shape/sharding/donation facts (TPU015-TPU017);
+        # None when the function has nothing worth recording.
+        self.shapes = None
 
     def to_json(self):
         return {
@@ -261,6 +264,7 @@ class FunctionSummary:
             "cvsites": [s.to_json() for s in self.cvsites],
             "signals": [[a, m, ln] for a, m, ln in self.signals],
             "taint": self.taint.to_json() if self.taint else None,
+            "shapes": self.shapes.to_json() if self.shapes else None,
         }
 
     @classmethod
@@ -276,6 +280,9 @@ class FunctionSummary:
         raw_taint = d.get("taint")
         if raw_taint:
             fn.taint = _taint.FunctionTaint.from_json(raw_taint)
+        raw_shapes = d.get("shapes")
+        if raw_shapes:
+            fn.shapes = _shapes.FunctionShapes.from_json(raw_shapes)
         return fn
 
 
@@ -1303,11 +1310,15 @@ def summarize_file(ctx: FileContext, decls: _Decls) -> List[FunctionSummary]:
         else:
             walker.walk_function(node, None, f"{modkey}:{node.name}")
     taints = _taint.extract_file_taint(ctx, modkey)
+    shapes = _shapes.extract_file_shapes(ctx, modkey)
     for fn in walker.out:
         rec = taints.get(fn.key)
         if rec is not None and (rec.params or rec.flows or rec.param_sinks
                                 or rec.param_calls or rec.wire_calls):
             fn.taint = rec
+        srec = shapes.get(fn.key)
+        if srec is not None and not srec.empty():
+            fn.shapes = srec
     return walker.out
 
 

@@ -209,6 +209,13 @@ def default_rules() -> List[Rule]:
     from tritonclient_tpu.analysis._tpu014_validation_drift import (
         ValidationDriftRule,
     )
+    from tritonclient_tpu.analysis._tpu015_donation import (
+        DonationDisciplineRule,
+    )
+    from tritonclient_tpu.analysis._tpu016_sharding_drift import (
+        ShardingDriftRule,
+    )
+    from tritonclient_tpu.analysis._tpu017_bucket import BucketDisciplineRule
 
     return [
         AsyncBlockingRule(),
@@ -224,6 +231,9 @@ def default_rules() -> List[Rule]:
         CondvarDisciplineRule(),
         UntrustedSinkRule(),
         ValidationDriftRule(),
+        DonationDisciplineRule(),
+        ShardingDriftRule(),
+        BucketDisciplineRule(),
     ]
 
 

@@ -1,0 +1,982 @@
+"""tpushape: per-function abstract shape/sharding/donation facts.
+
+This module is the intraprocedural half of the JAX compute-plane rules
+(TPU015/TPU016/TPU017): for every function it abstractly interprets the
+jnp/lax/shard_map expressions it can see and records a serializable
+:class:`FunctionShapes` fact sheet. The abstract value lattice tracks,
+per local name / ``self`` attribute:
+
+* **donation state** — which jitted callables donate which argument
+  slots (``donate_argnums``/``donate_argnames``), which buffers were
+  passed through a donated slot and not rebound from the call result
+  (poisoned), and which are read afterwards (TPU015 arm A); plus the
+  inverse fact for the advisory arm: ``self.X = <arithmetic on
+  self.X>`` whole-array rebuilds inside a syntactic loop, and the set
+  of names this function ever donates (TPU015 arm B exoneration).
+* **mesh/sharding spec** — placements from ``jax.device_put(x, S)``
+  where ``S`` is a ``named_sharding``/``NamedSharding`` value, and
+  consumption specs from ``shard_map``/``_partial_shard_map``
+  ``in_specs`` and ``jax.jit(..., in_shardings=...)``. A value placed
+  under one spec flowing into a consumer whose in-spec differs is the
+  TPU016 drift fact.
+* **symbolic shape dynamism** — per-request magnitudes (``len(...)``,
+  ``x.shape[i]``) flowing into a *traced dimension* (slice bound,
+  allocation dim, ``reshape``/``pad`` argument) of a value passed to a
+  jitted callable without a recognized bucketing sanitizer
+  (``*bucket*``/``*pow2*``/``*round_up*``/``*pad_to*``/``*chunk*``,
+  or ``min``/``max`` against an untainted bound) — the TPU017
+  compile-cache-explosion fact.
+
+The interprocedural stitching — propagating "this parameter is consumed
+under spec S" / "this parameter becomes a traced dim" backwards along
+the call graph and reconstructing producer→consumer paths — lives in
+the rule modules (``_tpu015_donation.py``, ``_tpu016_sharding_drift.py``,
+``_tpu017_bucket.py``), on top of the cached call-graph substrate
+(``_callgraph.py`` attaches a :class:`FunctionShapes` to every
+``FunctionSummary`` and bumps ``CACHE_VERSION`` to 7 for it).
+
+Known imprecision (deliberate, documented): dynamic-shaped *arrays* are
+not tracked across function boundaries (only dynamic magnitudes are);
+sharding specs are compared structurally by canonical text with a
+single implicit mesh; and donation poisoning is path-insensitive inside
+``try``/``except``. The runtime complement is ``sanitize/_jax.py``.
+"""
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple, Union
+
+#: Origin token: a per-request dynamic magnitude (len / .shape read).
+DYN = "<dyn>"
+#: Origin token: an array whose traced shape is dynamic.
+DSHAPE = "<dshape>"
+#: Origin prefix: dynamic-shaped array whose dim came from parameter p.
+_DSHAPE_PARAM = "<dshape:"
+
+#: Recognized bucketing sanitizers (matched against the last dotted
+#: segment of the callee name, lowercase).
+_BUCKET_RE = re.compile(r"bucket|pow2|round_up|pad_to|chunk|align")
+
+#: Shape-producing constructors whose first argument (or ``shape=``) is
+#: a dimension tuple.
+_ALLOC_CTORS = {"zeros", "ones", "empty", "full", "arange", "iota"}
+
+#: Calls whose result is never a dynamic magnitude.
+_CLEAN_CALLS = {
+    "bool", "isinstance", "issubclass", "hasattr", "callable", "id",
+    "hash", "type", "sorted", "enumerate",
+}
+
+#: shard_map spellings (last dotted segment).
+_SHARD_MAP_NAMES = {"shard_map", "_partial_shard_map"}
+
+#: Spec factories (last dotted segment).
+_SPEC_FACTORIES = {"named_sharding", "NamedSharding"}
+
+Slot = Union[int, str]
+
+
+class FunctionShapes:
+    """Serializable shape/sharding/donation facts for one function."""
+
+    __slots__ = (
+        "params", "donate_reads", "rebuilds", "donated_names",
+        "device_attrs", "spec_flows", "spec_sinks", "spec_calls",
+        "placed_calls", "dyn_flows", "dyn_sinks", "dyn_calls",
+        "dyn_arg_calls",
+    )
+
+    def __init__(self):
+        # Parameter names as seen by CALLERS (``self``/``cls`` dropped).
+        self.params: List[str] = []
+        # TPU015 arm A, locally complete: a buffer read after donation.
+        # [name, callee, donate_line, line, col]
+        self.donate_reads: List[list] = []
+        # TPU015 arm B candidates: whole-array arithmetic rebuild of a
+        # ``self`` attribute inside a syntactic loop. [attr, src, line, col]
+        self.rebuilds: List[list] = []
+        # Names this function passes through a donated slot (arm B
+        # exoneration: a donated buffer is recycled, not rebuilt).
+        self.donated_names: List[str] = []
+        # Device-array attributes of the enclosing class (file-local
+        # pre-scan; empty for module-level functions).
+        self.device_attrs: List[str] = []
+        # TPU016, locally complete: placed value consumed under a
+        # different spec. [src, prod_spec, cons_spec, detail, line, col]
+        self.spec_flows: List[list] = []
+        # {param: [[cons_spec, detail, line, col]]} — parameter consumed
+        # under spec S by a shard_map/jit boundary in this function.
+        self.spec_sinks: Dict[str, List[list]] = {}
+        # {param: [[callee_key, slot, line]]} — parameter forwarded.
+        self.spec_calls: Dict[str, List[list]] = {}
+        # Placed value forwarded into a resolvable call:
+        # [callee_key, slot, prod_spec, line, col, src]
+        self.placed_calls: List[list] = []
+        # TPU017, locally complete: dynamic-shaped operand reaches a
+        # jitted callable. [detail, line, col, src]
+        self.dyn_flows: List[list] = []
+        # {param: [[detail, line, col]]} — param used as a traced dim of
+        # an operand passed to a jitted callable in this function.
+        self.dyn_sinks: Dict[str, List[list]] = {}
+        # {param: [[callee_key, slot, line]]} — param forwarded as a
+        # plain magnitude into a resolvable call.
+        self.dyn_calls: Dict[str, List[list]] = {}
+        # Dynamic magnitude forwarded into a resolvable call:
+        # [callee_key, slot, line, col, src]
+        self.dyn_arg_calls: List[list] = []
+
+    def empty(self) -> bool:
+        return not (
+            self.donate_reads or self.rebuilds or self.donated_names
+            or self.spec_flows or self.spec_sinks or self.spec_calls
+            or self.placed_calls or self.dyn_flows or self.dyn_sinks
+            or self.dyn_calls or self.dyn_arg_calls
+        )
+
+    def to_json(self):
+        return {
+            "params": self.params,
+            "donate_reads": self.donate_reads,
+            "rebuilds": self.rebuilds,
+            "donated_names": self.donated_names,
+            "device_attrs": self.device_attrs,
+            "spec_flows": self.spec_flows,
+            "spec_sinks": self.spec_sinks,
+            "spec_calls": self.spec_calls,
+            "placed_calls": self.placed_calls,
+            "dyn_flows": self.dyn_flows,
+            "dyn_sinks": self.dyn_sinks,
+            "dyn_calls": self.dyn_calls,
+            "dyn_arg_calls": self.dyn_arg_calls,
+        }
+
+    @classmethod
+    def from_json(cls, d):
+        s = cls()
+        s.params = list(d.get("params", []))
+        s.donate_reads = [list(r) for r in d.get("donate_reads", [])]
+        s.rebuilds = [list(r) for r in d.get("rebuilds", [])]
+        s.donated_names = list(d.get("donated_names", []))
+        s.device_attrs = list(d.get("device_attrs", []))
+        s.spec_flows = [list(r) for r in d.get("spec_flows", [])]
+        s.spec_sinks = {
+            p: [list(r) for r in rows]
+            for p, rows in d.get("spec_sinks", {}).items()
+        }
+        s.spec_calls = {
+            p: [list(r) for r in rows]
+            for p, rows in d.get("spec_calls", {}).items()
+        }
+        s.placed_calls = [list(r) for r in d.get("placed_calls", [])]
+        s.dyn_flows = [list(r) for r in d.get("dyn_flows", [])]
+        s.dyn_sinks = {
+            p: [list(r) for r in rows]
+            for p, rows in d.get("dyn_sinks", {}).items()
+        }
+        s.dyn_calls = {
+            p: [list(r) for r in rows]
+            for p, rows in d.get("dyn_calls", {}).items()
+        }
+        s.dyn_arg_calls = [list(r) for r in d.get("dyn_arg_calls", [])]
+        return s
+
+    def slot_param(self, slot: Slot) -> Optional[str]:
+        """Callee parameter name for a caller argument slot."""
+        if isinstance(slot, str):
+            return slot if slot in self.params else None
+        if 0 <= slot < len(self.params):
+            return self.params[slot]
+        return None
+
+
+def _expr_text(node: ast.AST, limit: int = 48) -> str:
+    try:
+        text = ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on 3.9+
+        text = type(node).__name__
+    return text if len(text) <= limit else text[: limit - 3] + "..."
+
+
+def _target_name(node) -> Optional[str]:
+    """Textual key for a plain Name or ``self.X`` attribute target."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return f"self.{node.attr}"
+    return None
+
+
+def canonical_spec(ctx, call: ast.Call) -> Optional[str]:
+    """Canonical text of a partition spec expression.
+
+    ``P(None, 'tp')`` -> ``"None,tp"``; ``named_sharding(mesh)`` and
+    ``P(None, None)`` -> ``""`` (replicated — trailing ``None`` axes are
+    dropped so the two spellings compare equal). Non-constant axis args
+    render as ``$name`` so only structurally identical dynamic specs
+    compare equal.
+    """
+    name = ctx.canonical_call_name(call.func) or ""
+    last = name.rsplit(".", 1)[-1]
+    args = list(call.args)
+    if last in _SPEC_FACTORIES:
+        if last == "NamedSharding" and len(args) >= 2:
+            inner = args[1]
+            if isinstance(inner, ast.Call):
+                return canonical_spec(ctx, inner)
+            return None
+        args = args[1:]  # drop the mesh argument
+    elif last not in ("P", "PartitionSpec"):
+        return None
+    parts = []
+    for a in args:
+        if isinstance(a, ast.Constant):
+            parts.append("None" if a.value is None else str(a.value))
+        elif isinstance(a, ast.Name):
+            parts.append(f"${a.id}")
+        elif isinstance(a, ast.Tuple):
+            parts.append("+".join(_expr_text(e) for e in a.elts))
+        else:
+            parts.append(f"${_expr_text(a)}")
+    while parts and parts[-1] == "None":
+        parts.pop()
+    return ",".join(parts)
+
+
+def _spec_of_expr(ctx, node, specs: Dict[str, str]) -> Optional[str]:
+    """Spec of an expression: a spec variable, or an inline factory."""
+    key = _target_name(node)
+    if key is not None:
+        return specs.get(key)
+    if isinstance(node, ast.Call):
+        return canonical_spec(ctx, node)
+    return None
+
+
+def _donated_slots(call: ast.Call) -> Optional[List[Slot]]:
+    """Donated slots of a ``jax.jit(...)`` call, or None when absent."""
+    slots: List[Slot] = []
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            vals = (kw.value.elts if isinstance(kw.value, ast.Tuple)
+                    else [kw.value])
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    slots.append(v.value)
+        elif kw.arg == "donate_argnames":
+            vals = (kw.value.elts if isinstance(kw.value, ast.Tuple)
+                    else [kw.value])
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    slots.append(v.value)
+    return slots or None
+
+
+def _jit_call(ctx, value) -> Optional[ast.Call]:
+    """``value`` itself as a ``jax.jit``/``jax.pmap`` factory call.
+
+    Only the direct form counts: ``jax.jit(...)()`` (immediately
+    invoked) produces arrays, not a callable, and must not be
+    recognized here.
+    """
+    if isinstance(value, ast.Call):
+        name = ctx.canonical_call_name(value.func)
+        if name in ("jax.jit", "jax.pmap"):
+            return value
+    return None
+
+
+def _shard_map_call(ctx, value) -> Optional[ast.Call]:
+    """The ``shard_map``/``_partial_shard_map`` call in ``value``."""
+    for sub in ast.walk(value):
+        if isinstance(sub, ast.Call):
+            name = ctx.canonical_call_name(sub.func) or ""
+            if name.rsplit(".", 1)[-1] in _SHARD_MAP_NAMES:
+                return sub
+    return None
+
+
+def _consumer_specs(ctx, call: ast.Call) -> Optional[List[Optional[str]]]:
+    """Canonical ``in_specs`` of a shard_map factory call (positional
+    arg 2 for ``_partial_shard_map(f, mesh, in_specs, ...)`` or the
+    ``in_specs=`` keyword), or ``in_shardings`` of a jit."""
+    spec_node = None
+    for kw in call.keywords:
+        if kw.arg in ("in_specs", "in_shardings"):
+            spec_node = kw.value
+            break
+    if spec_node is None and len(call.args) >= 3:
+        name = ctx.canonical_call_name(call.func) or ""
+        if name.rsplit(".", 1)[-1] in _SHARD_MAP_NAMES:
+            spec_node = call.args[2]
+    if spec_node is None:
+        return None
+    elts = (spec_node.elts if isinstance(spec_node, (ast.Tuple, ast.List))
+            else [spec_node])
+    out: List[Optional[str]] = []
+    for e in elts:
+        out.append(_spec_of_expr(ctx, e, {}) if isinstance(e, ast.Call)
+                   else None)
+    return out
+
+
+def _is_device_value(ctx, value) -> bool:
+    """True when ``value`` contains a jax call (device-array producer)."""
+    for sub in ast.walk(value):
+        if isinstance(sub, ast.Call):
+            name = ctx.canonical_call_name(sub.func) or ""
+            if name.startswith("jax."):
+                return True
+    return False
+
+
+class _ClassFacts:
+    """File-local class-level facts: donation/spec/jit attributes
+    declared anywhere in a class body (``self.X = jax.jit(...)``)."""
+
+    __slots__ = ("donating", "specs", "consumers", "jitted", "device_attrs")
+
+    def __init__(self):
+        self.donating: Dict[str, List[Slot]] = {}   # "self.X" -> slots
+        self.specs: Dict[str, str] = {}             # "self.X" -> spec
+        self.consumers: Dict[str, List] = {}        # "self.X" -> in_specs
+        self.jitted: Set[str] = set()               # "self.X"
+        self.device_attrs: Set[str] = set()         # bare attr names
+
+
+def _scan_module(ctx) -> _ClassFacts:
+    """Module-level factory assignments (``step = jax.jit(f, ...)``),
+    visible to every function in the file."""
+    facts = _ClassFacts()
+    for node in ctx.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        jit = _jit_call(ctx, node.value)
+        smap = _shard_map_call(ctx, node.value)
+        for tgt in node.targets:
+            if not isinstance(tgt, ast.Name):
+                continue
+            if jit is not None:
+                facts.jitted.add(tgt.id)
+                slots = _donated_slots(jit)
+                if slots:
+                    facts.donating[tgt.id] = slots
+                cons = _consumer_specs(ctx, jit)
+                if cons:
+                    facts.consumers[tgt.id] = cons
+            elif smap is not None:
+                cons = _consumer_specs(ctx, smap)
+                if cons:
+                    facts.consumers[tgt.id] = cons
+            elif isinstance(node.value, ast.Call):
+                spec = canonical_spec(ctx, node.value)
+                if spec is not None:
+                    facts.specs[tgt.id] = spec
+    return facts
+
+
+def _scan_class(ctx, cls: ast.ClassDef) -> _ClassFacts:
+    facts = _ClassFacts()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        jit = _jit_call(ctx, value)
+        smap = _shard_map_call(ctx, value)
+        spec = (canonical_spec(ctx, value)
+                if isinstance(value, ast.Call) else None)
+        device = _is_device_value(ctx, value)
+        for tgt in node.targets:
+            targets = (tgt.elts if isinstance(tgt, (ast.Tuple, ast.List))
+                       else [tgt])
+            for t in targets:
+                key = _target_name(t)
+                if key is None or not key.startswith("self."):
+                    continue
+                if jit is not None:
+                    facts.jitted.add(key)
+                    slots = _donated_slots(jit)
+                    if slots:
+                        facts.donating[key] = slots
+                    cons = _consumer_specs(ctx, jit)
+                    if cons:
+                        facts.consumers[key] = cons
+                elif smap is not None:
+                    cons = _consumer_specs(ctx, smap)
+                    if cons:
+                        facts.consumers[key] = cons
+                elif spec is not None:
+                    facts.specs[key] = spec
+                if device and jit is None:
+                    facts.device_attrs.add(key.split(".", 1)[1])
+    return facts
+
+
+class _ShapesWalker:
+    """Single-pass, flow-sensitive walk of one function body."""
+
+    def __init__(self, ctx, modkey: str, cls: Optional[str], node,
+                 cls_facts: Optional[_ClassFacts],
+                 mod_facts: Optional[_ClassFacts] = None):
+        self.ctx = ctx
+        self.modkey = modkey
+        self.cls = cls
+        self.node = node
+        self.out = FunctionShapes()
+        # Dynamic-magnitude origins per name (param names / DYN / DSHAPE).
+        self.dyn: Dict[str, Set[str]] = {}
+        # Sharding state.
+        self.specs: Dict[str, str] = {}
+        self.placed: Dict[str, str] = {}
+        self.consumers: Dict[str, List] = {}
+        # Donation state.
+        self.donating: Dict[str, List[Slot]] = {}
+        self.jitted: Set[str] = set()
+        self.poisoned: Dict[str, Tuple[str, int]] = {}
+        self._loop_depth = 0
+        self._seen_calls: Set[int] = set()
+        self._read_seen: Set[Tuple[str, int]] = set()
+        for facts in (mod_facts, cls_facts):
+            if facts is None:
+                continue
+            self.donating.update(facts.donating)
+            self.specs.update(facts.specs)
+            self.consumers.update(facts.consumers)
+            self.jitted.update(facts.jitted)
+        if cls_facts is not None:
+            self.out.device_attrs = sorted(cls_facts.device_attrs)
+
+    # -- entry ---------------------------------------------------------
+
+    def run(self) -> FunctionShapes:
+        args = self.node.args
+        names = [a.arg for a in (args.posonlyargs + args.args)]
+        is_method = self.cls is not None and not any(
+            isinstance(d, ast.Name) and d.id == "staticmethod"
+            for d in self.node.decorator_list
+        )
+        if is_method and names and names[0] in ("self", "cls"):
+            names = names[1:]
+        for a in args.kwonlyargs:
+            if a.arg not in names:
+                names.append(a.arg)
+        self.out.params = names
+        for a in names:
+            self.dyn[a] = {a}
+        for stmt in self.node.body:
+            self._stmt(stmt)
+        return self.out
+
+    # -- dynamic-magnitude origins -------------------------------------
+
+    def _dyn_origins(self, node) -> Set[str]:
+        if node is None or isinstance(node, (ast.Constant, ast.Lambda)):
+            return set()
+        if isinstance(node, ast.Name):
+            return set(self.dyn.get(node.id, ()))
+        if isinstance(node, ast.Attribute):
+            return set()  # attribute magnitudes are not per-request
+        if isinstance(node, ast.Subscript):
+            return self._subscript_origins(node)
+        if isinstance(node, ast.Starred):
+            return self._dyn_origins(node.value)
+        if isinstance(node, ast.BinOp):
+            return self._dyn_origins(node.left) | self._dyn_origins(
+                node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._dyn_origins(node.operand)
+        if isinstance(node, ast.BoolOp):
+            out: Set[str] = set()
+            for v in node.values:
+                out |= self._dyn_origins(v)
+            return out
+        if isinstance(node, ast.IfExp):
+            return self._dyn_origins(node.body) | self._dyn_origins(
+                node.orelse)
+        if isinstance(node, ast.Compare):
+            return set()
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            out = set()
+            for e in node.elts:
+                out |= self._dyn_origins(e)
+            return out
+        if isinstance(node, ast.Call):
+            return self._dyn_call_origins(node)
+        return set()
+
+    def _subscript_origins(self, node: ast.Subscript) -> Set[str]:
+        base = node.value
+        # ``x.shape[i]`` — a traced-operand magnitude: per-request.
+        if isinstance(base, ast.Attribute) and base.attr == "shape":
+            return {DYN}
+        out = self._dyn_origins(base)
+        sl = node.slice
+        if isinstance(sl, ast.Slice):
+            bound = (self._dyn_origins(sl.lower)
+                     | self._dyn_origins(sl.upper)
+                     | self._dyn_origins(sl.step))
+            out |= self._dim_origins(bound)
+        return out
+
+    def _dim_origins(self, magnitudes: Set[str]) -> Set[str]:
+        """Origins of a value whose traced SHAPE depends on the given
+        magnitude origins: DYN becomes DSHAPE, params become markers."""
+        out: Set[str] = set()
+        if DYN in magnitudes or DSHAPE in magnitudes:
+            out.add(DSHAPE)
+        for m in magnitudes:
+            if m in self.out.params:
+                out.add(f"{_DSHAPE_PARAM}{m}>")
+            elif m.startswith(_DSHAPE_PARAM):
+                out.add(m)
+        return out
+
+    def _dyn_call_origins(self, call: ast.Call) -> Set[str]:
+        name = self.ctx.canonical_call_name(call.func) or ""
+        last = name.rsplit(".", 1)[-1]
+        if last == "len":
+            return {DYN}
+        if _BUCKET_RE.search(last.lower()):
+            return set()  # recognized bucketing sanitizer
+        if last in _CLEAN_CALLS:
+            return set()
+        operands = list(call.args) + [k.value for k in call.keywords]
+        arg_origins: Set[str] = set()
+        for a in operands:
+            arg_origins |= self._dyn_origins(a)
+        if last in ("min", "max"):
+            if len(operands) >= 2 and any(
+                not self._dyn_origins(o) for o in operands
+            ):
+                return set()  # capped against an untainted bound
+            return arg_origins
+        if last in _ALLOC_CTORS:
+            dims = self._dyn_origins(call.args[0]) if call.args else set()
+            for kw in call.keywords:
+                if kw.arg == "shape":
+                    dims |= self._dyn_origins(kw.value)
+            return self._dim_origins(dims)
+        if last in ("reshape", "broadcast_to", "pad", "resize"):
+            return self._dim_origins(arg_origins)
+        return arg_origins
+
+    # -- callable-name resolution (shared with _taint) ------------------
+
+    def _func_key(self, call: ast.Call) -> Optional[str]:
+        """Textual key of the called name (``f`` / ``self.f``) when the
+        target is a locally-tracked callable."""
+        return _target_name(call.func)
+
+    def _callee_key(self, call: ast.Call) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            target = self.ctx.aliases.get(func.id)
+            if target and "." in target:
+                mod, _, name = target.rpartition(".")
+                if name[:1].isupper():
+                    return f"{name}.__init__"
+                return f"{mod.rpartition('.')[2]}:{name}"
+            if func.id[:1].isupper():
+                return f"{func.id}.__init__"
+            return f"{self.modkey}:{func.id}"
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            if isinstance(base, ast.Name):
+                if base.id == "self" and self.cls:
+                    return f"{self.cls}.{func.attr}"
+                if base.id[:1].isupper():
+                    return f"{base.id}.{func.attr}"
+                target = self.ctx.aliases.get(base.id)
+                if target:
+                    return f"{target.rpartition('.')[2]}:{func.attr}"
+        return None
+
+    # -- per-call handling ----------------------------------------------
+
+    def _handle_call(self, call: ast.Call):
+        if id(call) in self._seen_calls:
+            return
+        self._seen_calls.add(id(call))
+        fkey = self._func_key(call)
+        # TPU016: direct call of a shard_map factory result —
+        # ``_partial_shard_map(body, mesh, in_specs, ...)(x, w, b)``.
+        cons = None
+        if fkey is not None and fkey in self.consumers:
+            cons = self.consumers[fkey]
+        elif isinstance(call.func, ast.Call):
+            inner = call.func
+            name = self.ctx.canonical_call_name(inner.func) or ""
+            if name.rsplit(".", 1)[-1] in _SHARD_MAP_NAMES:
+                cons = _consumer_specs(self.ctx, inner)
+            else:
+                jit = _jit_call(self.ctx, inner)
+                if jit is not None:
+                    cons = _consumer_specs(self.ctx, jit)
+        if cons:
+            self._check_consumer(call, fkey or _expr_text(call.func), cons)
+        # TPU017: dynamic-shaped operand reaching a jitted callable.
+        if fkey is not None and fkey in self.jitted:
+            self._check_jit_operands(call, fkey)
+        # Forwarding facts into resolvable project callees.
+        self._record_forwarding(call)
+
+    def _check_consumer(self, call: ast.Call, label: str, cons) -> None:
+        for i, arg in enumerate(call.args):
+            if i >= len(cons) or cons[i] is None:
+                continue
+            want = cons[i]
+            key = _target_name(arg)
+            if key is None:
+                continue
+            if not self.ctx.is_suppressed("TPU016", call.lineno):
+                have = self.placed.get(key)
+                if have is not None and have != want:
+                    self.out.spec_flows.append([
+                        _expr_text(arg), have, want,
+                        f"{label} in_specs[{i}]", call.lineno,
+                        call.col_offset,
+                    ])
+                elif have is None and key in self.out.params:
+                    self.out.spec_sinks.setdefault(key, []).append(
+                        [want, f"{label} in_specs[{i}]", call.lineno,
+                         call.col_offset])
+
+    def _check_jit_operands(self, call: ast.Call, label: str) -> None:
+        if self.ctx.is_suppressed("TPU017", call.lineno):
+            return
+        operands = [(i, a) for i, a in enumerate(call.args)]
+        operands += [(kw.arg, kw.value) for kw in call.keywords
+                     if kw.arg is not None]
+        for slot, arg in operands:
+            origins = self._dyn_origins(arg)
+            if DSHAPE in origins:
+                self.out.dyn_flows.append([
+                    f"traced operand of `{label}`", call.lineno,
+                    call.col_offset, _expr_text(arg)])
+            for o in origins:
+                if o.startswith(_DSHAPE_PARAM):
+                    p = o[len(_DSHAPE_PARAM):-1]
+                    self.out.dyn_sinks.setdefault(p, []).append(
+                        [f"traced operand of `{label}`", call.lineno,
+                         call.col_offset])
+
+    def _record_forwarding(self, call: ast.Call):
+        callee = self._callee_key(call)
+        if callee is None:
+            return
+        name = self.ctx.canonical_call_name(call.func) or ""
+        last = name.rsplit(".", 1)[-1]
+        if _BUCKET_RE.search(last.lower()) or last in _CLEAN_CALLS:
+            return
+        slots = [(i, a) for i, a in enumerate(call.args)]
+        slots += [(kw.arg, kw.value) for kw in call.keywords
+                  if kw.arg is not None]
+        for slot, arg in slots:
+            key = _target_name(arg)
+            # TPU016 forwarding: placed values and bare parameters.
+            if key is not None and not self.ctx.is_suppressed(
+                    "TPU016", call.lineno):
+                spec = self.placed.get(key)
+                if spec is not None:
+                    self.out.placed_calls.append(
+                        [callee, slot, spec, call.lineno, call.col_offset,
+                         _expr_text(arg)])
+                elif key in self.out.params:
+                    self.out.spec_calls.setdefault(key, []).append(
+                        [callee, slot, call.lineno])
+            # TPU017 forwarding: dynamic magnitudes and bare parameters.
+            if self.ctx.is_suppressed("TPU017", call.lineno):
+                continue
+            origins = self._dyn_origins(arg)
+            if DYN in origins:
+                self.out.dyn_arg_calls.append(
+                    [callee, slot, call.lineno, call.col_offset,
+                     _expr_text(arg)])
+            for p in origins:
+                if p in self.out.params:
+                    self.out.dyn_calls.setdefault(p, []).append(
+                        [callee, slot, call.lineno])
+
+    # -- donation (TPU015 arm A) ----------------------------------------
+
+    def _check_poisoned_reads(self, expr):
+        if expr is None or not self.poisoned:
+            return
+        for node in ast.walk(expr):
+            key = None
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                key = node.id
+            elif (isinstance(node, ast.Attribute)
+                    and isinstance(node.ctx, ast.Load)):
+                key = _target_name(node)
+            if key is None or key not in self.poisoned:
+                continue
+            callee, donate_line = self.poisoned.pop(key)
+            dedup = (key, node.lineno)
+            if dedup in self._read_seen:
+                continue
+            self._read_seen.add(dedup)
+            if not self.ctx.is_suppressed("TPU015", node.lineno):
+                self.out.donate_reads.append(
+                    [key, callee, donate_line, node.lineno,
+                     node.col_offset])
+
+    def _donation_candidates(self, expr) -> List[Tuple[str, str, int]]:
+        """(buffer name, callee label, line) for args passed through a
+        donated slot of any call inside ``expr``."""
+        out: List[Tuple[str, str, int]] = []
+        if expr is None:
+            return out
+        for call in ast.walk(expr):
+            if not isinstance(call, ast.Call):
+                continue
+            fkey = self._func_key(call)
+            if fkey is None or fkey not in self.donating:
+                continue
+            if self.ctx.is_suppressed("TPU015", call.lineno):
+                continue
+            for slot in self.donating[fkey]:
+                arg = None
+                if isinstance(slot, int) and slot < len(call.args):
+                    arg = call.args[slot]
+                elif isinstance(slot, str):
+                    for kw in call.keywords:
+                        if kw.arg == slot:
+                            arg = kw.value
+                key = _target_name(arg) if arg is not None else None
+                if key is not None:
+                    out.append((key, fkey, call.lineno))
+                    if key not in self.out.donated_names:
+                        self.out.donated_names.append(key)
+        return out
+
+    # -- assignments / factory recognition ------------------------------
+
+    def _bind(self, key: str, value):
+        """Track factory assignments: jit/donation/spec/shard_map/
+        device_put placements and dynamic-magnitude origins."""
+        jit = _jit_call(self.ctx, value)
+        if jit is not None:
+            self.jitted.add(key)
+            slots = _donated_slots(jit)
+            if slots:
+                self.donating[key] = slots
+            cons = _consumer_specs(self.ctx, jit)
+            if cons:
+                self.consumers[key] = cons
+            return
+        smap = _shard_map_call(self.ctx, value)
+        if smap is not None:
+            cons = _consumer_specs(self.ctx, smap)
+            if cons:
+                self.consumers[key] = cons
+            return
+        if isinstance(value, ast.Call):
+            spec = canonical_spec(self.ctx, value)
+            if spec is not None:
+                self.specs[key] = spec
+                return
+            name = self.ctx.canonical_call_name(value.func) or ""
+            if name.rsplit(".", 1)[-1] == "device_put" and len(
+                    value.args) >= 2:
+                spec = _spec_of_expr(self.ctx, value.args[1], self.specs)
+                if spec is not None:
+                    self.placed[key] = spec
+                    return
+        self.dyn[key] = self._dyn_origins(value)
+
+    def _assign_targets(self, targets, value):
+        flat: List = []
+
+        def _flatten(t):
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    _flatten(e)
+            elif isinstance(t, ast.Starred):
+                _flatten(t.value)
+            else:
+                flat.append(t)
+
+        for t in targets:
+            _flatten(t)
+        rebound: Set[str] = set()
+        for t in flat:
+            key = _target_name(t)
+            if key is None:
+                continue
+            rebound.add(key)
+            self.poisoned.pop(key, None)
+            self.placed.pop(key, None)
+            if len(flat) == 1 and value is not None:
+                self._bind(key, value)
+            else:
+                self.dyn[key] = (self._dyn_origins(value)
+                                 if value is not None else set())
+        # device_put over a tuple re-places every rebound name.
+        if value is not None and isinstance(value, ast.Call):
+            name = self.ctx.canonical_call_name(value.func) or ""
+            if (name.rsplit(".", 1)[-1] == "device_put"
+                    and len(value.args) >= 2):
+                spec = _spec_of_expr(self.ctx, value.args[1], self.specs)
+                if spec is not None:
+                    for key in rebound:
+                        self.placed[key] = spec
+        return rebound
+
+    def _check_rebuild(self, stmt: ast.Assign):
+        """TPU015 arm B candidate: ``self.X = <binop on self.X>`` inside
+        a loop — a whole-array rebuild allocating a fresh buffer per
+        iteration (scatter updates via ``.at[].set()`` are exempt)."""
+        if self._loop_depth == 0 or len(stmt.targets) != 1:
+            return
+        key = _target_name(stmt.targets[0])
+        if key is None or not key.startswith("self."):
+            return
+        if not isinstance(stmt.value, ast.BinOp):
+            return
+        attr = key.split(".", 1)[1]
+        if attr not in set(self.out.device_attrs):
+            return
+        reads_self = any(
+            _target_name(n) == key
+            for n in ast.walk(stmt.value)
+            if isinstance(n, ast.Attribute)
+        )
+        if not reads_self:
+            return
+        if self.ctx.is_suppressed("TPU015", stmt.lineno):
+            return
+        row = [attr, _expr_text(stmt), stmt.lineno, stmt.col_offset]
+        if row not in self.out.rebuilds:
+            self.out.rebuilds.append(row)
+
+    # -- statements -----------------------------------------------------
+
+    def _scan(self, expr):
+        if expr is None:
+            return
+        self._check_poisoned_reads(expr)
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._handle_call(node)
+
+    def _stmt(self, stmt):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs get their own walk
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            value = stmt.value
+            self._scan(value)
+            candidates = self._donation_candidates(value)
+            if isinstance(stmt, ast.Assign):
+                self._check_rebuild(stmt)
+                rebound = self._assign_targets(stmt.targets, value)
+            elif isinstance(stmt, ast.AugAssign):
+                self._check_poisoned_reads(stmt.target)
+                key = _target_name(stmt.target)
+                rebound = set()
+                if key is not None:
+                    self.dyn[key] = (set(self.dyn.get(key, ()))
+                                     | self._dyn_origins(value))
+            else:
+                rebound = (self._assign_targets([stmt.target], value)
+                           if stmt.target is not None else set())
+            for key, callee, line in candidates:
+                if key not in rebound:
+                    self.poisoned[key] = (callee, line)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._scan(stmt.value)
+            for key, callee, line in self._donation_candidates(stmt.value):
+                self.poisoned[key] = (callee, line)
+            return
+        if isinstance(stmt, ast.If):
+            self._scan(stmt.test)
+            before = dict(self.poisoned)
+            for s in stmt.body:
+                self._stmt(s)
+            after_body = self.poisoned
+            self.poisoned = dict(before)
+            for s in stmt.orelse:
+                self._stmt(s)
+            self.poisoned.update(after_body)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._scan(stmt.iter)
+                self._assign_targets([stmt.target], None)
+            else:
+                self._scan(stmt.test)
+            self._loop_depth += 1
+            # Two passes: a donation at the loop tail poisons reads at
+            # the next iteration's head (dedup keeps findings single).
+            for _ in range(2):
+                for s in stmt.body:
+                    self._stmt(s)
+            self._loop_depth -= 1
+            for s in stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._scan(item.context_expr)
+                if item.optional_vars is not None:
+                    self._assign_targets([item.optional_vars], None)
+            for s in stmt.body:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Try):
+            for s in stmt.body:
+                self._stmt(s)
+            for h in stmt.handlers:
+                for s in h.body:
+                    self._stmt(s)
+            for s in stmt.orelse + stmt.finalbody:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                key = _target_name(t)
+                if key is not None:
+                    self.poisoned.pop(key, None)
+            return
+        if isinstance(stmt, (ast.Return, ast.Raise, ast.Assert)):
+            for child in ast.iter_child_nodes(stmt):
+                self._scan(child)
+            return
+        # pass / break / continue / global / import — nothing to do.
+
+
+def extract_file_shapes(ctx, modkey: str) -> Dict[str, FunctionShapes]:
+    """Shape facts for every function in a file, keyed like
+    ``summarize_file`` keys its ``FunctionSummary`` rows."""
+    out: Dict[str, FunctionShapes] = {}
+    mod_facts = _scan_module(ctx)
+    class_facts: Dict[str, _ClassFacts] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ClassDef):
+            class_facts[node.name] = _scan_class(ctx, node)
+
+    def walk(node, cls: Optional[str], key: str):
+        facts = class_facts.get(cls) if cls else None
+        out[key] = _ShapesWalker(ctx, modkey, cls, node, facts,
+                                 mod_facts).run()
+        for child in ast.walk(node):
+            if child is node:
+                continue
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if ctx.enclosing_function(child) is node:
+                    walk(child, cls, f"{key}.<locals>.{child.name}")
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if ctx.enclosing_function(node) is not None:
+            continue
+        cls = ctx.enclosing_class(node)
+        if cls is not None:
+            walk(node, cls.name, f"{cls.name}.{node.name}")
+        else:
+            walk(node, None, f"{modkey}:{node.name}")
+    return out

@@ -1,0 +1,122 @@
+"""TPU015: donation discipline on the JAX compute plane.
+
+``jax.jit(..., donate_argnums=...)`` hands the argument's device buffer
+to XLA for reuse: on a real TPU the donated input is *invalidated* the
+moment the call dispatches, and any later read of the stale reference
+returns garbage (or raises, at best). The CPU backend IGNORES donation,
+so tier-1 tests can never catch a read-after-donate — it is exactly the
+class of bug that only burns once the code reaches hardware, which is
+why the static rule exists. The facts come from tpushape
+(``_shapes.py``), attached to every cached
+:class:`~tritonclient_tpu.analysis._callgraph.FunctionSummary`.
+
+Two arms:
+
+* **Arm A (read-after-donate, error).** A buffer passed through a
+  donated slot and NOT rebound from the call's own result is poisoned;
+  a later read on any path is a finding. Rebinding from the result is
+  the sanctioned pattern and stays clean.
+
+* **Arm B (undonated hot-loop rebuild, advisory).** A device-array
+  attribute rebuilt by whole-array arithmetic every iteration of a
+  hot-path loop (``self.X = self.X + 1``) without ever being donated
+  allocates a fresh HBM buffer per step and leaves the old one to the
+  allocator. Scatter updates (``.at[].set()``) are exempt — they are
+  already in-place under jit.
+
+Example (arm A)::
+
+    step = jax.jit(update, donate_argnums=(0,))
+    new = step(state)       # state's buffer is donated
+    loss = state.sum()      # BUG: read of an invalidated buffer
+
+Fix: rebind the donated operand from the result
+(``state = step(state)``), or drop the donation if the old value is
+still needed.
+
+Example (arm B)::
+
+    while serving:                      # tpulint: hot-path root
+        self._pos = self._pos + 1       # fresh buffer every step
+
+Fix: route the update through a jitted helper that donates the dead
+operand so XLA reuses the buffer in place::
+
+    self._advance = jax.jit(lambda p: p + 1, donate_argnums=(0,))
+    ...
+    self._pos = self._advance(self._pos)
+
+Suppress a deliberate read of a donated buffer (e.g. CPU-only code
+paths) at the read line with ``# tpulint: disable=TPU015`` and a
+comment saying why.
+"""
+
+from typing import List, Sequence
+
+from tritonclient_tpu.analysis import _callgraph
+from tritonclient_tpu.analysis._engine import FileContext, Finding, Rule
+
+
+class DonationDisciplineRule(Rule):
+    id = "TPU015"
+    name = "donation-discipline"
+    description = (
+        "buffer read after being passed through a donated jit argument "
+        "(invalid on TPU), or hot-loop device buffer rebuilt every step "
+        "but never donated"
+    )
+
+    def check_project(self, ctxs: Sequence[FileContext]) -> List[Finding]:
+        if not ctxs:
+            return []
+        graph = _callgraph.get_callgraph(ctxs)
+        linted = {ctx.path for ctx in ctxs if not _is_test_path(ctx.path)}
+        findings: List[Finding] = []
+
+        # Arm B exoneration is class-wide: a buffer donated by ANY
+        # method of the class is recycled, not leaked.
+        donated_by_cls = {}
+        for fn in graph.functions.values():
+            if fn.shapes is None or fn.cls is None:
+                continue
+            donated_by_cls.setdefault(fn.cls, set()).update(
+                fn.shapes.donated_names)
+
+        for key in sorted(graph.functions):
+            fn = graph.functions[key]
+            rec = fn.shapes
+            if rec is None or fn.path not in linted:
+                continue
+            for name, callee, donate_line, line, col in rec.donate_reads:
+                findings.append(Finding(
+                    self.id, fn.path, line, col,
+                    f"`{name}` is read after being donated to `{callee}` "
+                    f"in `{key}`: donated buffers are invalidated on TPU "
+                    f"(the CPU backend ignores donation, so tests cannot "
+                    f"catch this) — rebind the call result or drop the "
+                    f"donation",
+                ))
+            if not rec.rebuilds:
+                continue
+            root = graph.hot_root(key)
+            if root is None:
+                continue
+            donated = donated_by_cls.get(fn.cls, set())
+            for attr, src, line, col in rec.rebuilds:
+                if f"self.{attr}" in donated:
+                    continue
+                via = "" if root == key else f", hot via `{root}`"
+                findings.append(Finding(
+                    self.id, fn.path, line, col,
+                    f"hot-loop operand `self.{attr}` is rebuilt every "
+                    f"step (`{src}`) in `{key}`{via} but never donated: "
+                    f"route the update through a jitted helper with "
+                    f"donate_argnums so the dead buffer is recycled "
+                    f"in place",
+                ))
+        return findings
+
+
+def _is_test_path(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return "tests" in parts or parts[-1].startswith("test_")

@@ -1,0 +1,149 @@
+"""TPU016: sharding drift between a producer and a consuming boundary.
+
+An array committed to the mesh under one ``NamedSharding`` that flows
+into a ``shard_map``/``jax.jit`` boundary whose in-spec differs forces
+XLA to insert an implicit reshard — a device-to-device all-to-all (or,
+degenerately, a host round-trip) on every call, silently, with no
+Python site to profile. The drift is statically decidable whenever both
+the producer spec (``jax.device_put(x, named_sharding(mesh, ...))``)
+and the consumer spec (``in_specs=``/``in_shardings=``) are visible,
+and the rule reports the exact producer→consumer call path the same way
+TPU013 reports taint flows.
+
+Specs compare by canonical axis text with trailing replicated axes
+dropped, so ``P(None, 'tp')`` vs ``named_sharding(mesh, None, 'tp')``
+match and ``P(None)`` vs ``P()`` (both fully replicated) match; only a
+provable axis disagreement fires.
+
+Example::
+
+    pool_spec = named_sharding(mesh, None, "tp")     # heads on tp
+    pool = jax.device_put(pool, pool_spec)
+    f = shard_map(body, mesh=mesh,
+                  in_specs=(P("tp", None),),          # rows on tp!
+                  out_specs=P(None, None))
+    f(pool)        # implicit all-to-all reshard on every call
+
+Fix: make the producer and consumer agree — either place the array
+under the consumer's spec at allocation time, or change the boundary's
+``in_specs`` to match the resident layout (and reshard once, outside
+the hot path, if a layout change is genuinely needed). Suppress a
+deliberate reshard at the call line with
+``# tpulint: disable=TPU016`` and a comment saying why.
+
+The interprocedural half: a parameter consumed under spec S inside a
+callee propagates backwards (like TPU013's sinking params), so a placed
+array forwarded through helpers into a mismatched boundary is still
+caught, with the full call chain in the message.
+"""
+
+from typing import Dict, List, Sequence, Tuple, Union
+
+from tritonclient_tpu.analysis import _callgraph
+from tritonclient_tpu.analysis._engine import FileContext, Finding, Rule
+
+Slot = Union[int, str]
+
+
+def _fmt(spec: str) -> str:
+    return f"P({spec})" if spec else "replicated"
+
+
+class ShardingDriftRule(Rule):
+    id = "TPU016"
+    name = "sharding-drift"
+    description = (
+        "array placed under one NamedSharding flows into a "
+        "shard_map/jit boundary whose in-spec differs, forcing an "
+        "implicit reshard on every call"
+    )
+
+    def check_project(self, ctxs: Sequence[FileContext]) -> List[Finding]:
+        if not ctxs:
+            return []
+        graph = _callgraph.get_callgraph(ctxs)
+        shapes = {
+            key: fn.shapes for key, fn in graph.functions.items()
+            if fn.shapes is not None
+        }
+        consuming = _consuming_params(shapes)
+        linted = {ctx.path for ctx in ctxs if not _is_test_path(ctx.path)}
+        findings: List[Finding] = []
+        seen = set()
+
+        def emit(fn, line, col, message):
+            dedup = (fn.path, line, message)
+            if dedup in seen:
+                return
+            seen.add(dedup)
+            findings.append(Finding(self.id, fn.path, line, col, message))
+
+        for key in sorted(shapes):
+            fn = graph.functions[key]
+            if fn.path not in linted:
+                continue
+            rec = shapes[key]
+            for src, have, want, detail, line, col in rec.spec_flows:
+                emit(fn, line, col,
+                     f"`{src}` is placed under {_fmt(have)} but consumed "
+                     f"by {detail} expecting {_fmt(want)} in `{key}`: "
+                     f"every call pays an implicit reshard — align the "
+                     f"placement with the boundary spec")
+            for callee, slot, have, line, col, src in rec.placed_calls:
+                hit = _lookup(consuming, shapes, callee, slot)
+                if hit is None:
+                    continue
+                want, detail, chain = hit
+                if want == have:
+                    continue
+                path = " -> ".join([key] + chain)
+                emit(fn, line, col,
+                     f"`{src}` is placed under {_fmt(have)} but flows "
+                     f"into `{callee}` and is consumed by {detail} "
+                     f"expecting {_fmt(want)} via {path}: every call "
+                     f"pays an implicit reshard — align the placement "
+                     f"with the boundary spec")
+        return findings
+
+
+def _is_test_path(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return "tests" in parts or parts[-1].startswith("test_")
+
+
+def _lookup(consuming, shapes, callee: str, slot: Slot):
+    rec = shapes.get(callee)
+    if rec is None:
+        return None
+    param = rec.slot_param(slot)
+    if param is None:
+        return None
+    return consuming.get((callee, param))
+
+
+def _consuming_params(
+    shapes,
+) -> Dict[Tuple[str, str], Tuple[str, str, List[str]]]:
+    """Fixpoint: (function key, param) -> (consumer spec, boundary
+    detail, call chain down to the consuming function)."""
+    consuming: Dict[Tuple[str, str], Tuple[str, str, List[str]]] = {}
+    for key, rec in shapes.items():
+        for param, sinks in rec.spec_sinks.items():
+            spec, detail = sinks[0][0], sinks[0][1]
+            consuming[(key, param)] = (spec, detail, [key])
+    changed = True
+    while changed:
+        changed = False
+        for key, rec in shapes.items():
+            for param, calls in rec.spec_calls.items():
+                if (key, param) in consuming:
+                    continue
+                for callee, slot, _line in calls:
+                    hit = _lookup(consuming, shapes, callee, slot)
+                    if hit is None:
+                        continue
+                    spec, detail, chain = hit
+                    consuming[(key, param)] = (spec, detail, [key] + chain)
+                    changed = True
+                    break
+    return consuming

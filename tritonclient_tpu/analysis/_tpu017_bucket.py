@@ -1,0 +1,144 @@
+"""TPU017: bucket discipline for traced-operand shapes.
+
+A jitted callable compiles once per distinct *traced shape*. When an
+operand's shape derives from a per-request value — prompt length, batch
+size, block count (``len(...)``, ``x.shape[i]``) — the shape family is
+unbounded and the compile cache explodes: every new length pays a full
+XLA compile (seconds to minutes on real TPUs, plus unbounded device
+memory for the cached executables). This generalizes TPU010's retrace
+arm from "jit built inside a loop" to "statically provable unbounded
+shape family reaching a compiled callable".
+
+The discipline: every dynamic magnitude must pass a recognized
+*bucketing* function before it shapes a traced operand — anything whose
+name says so (``*bucket*``, ``*pow2*``, ``*round_up*``, ``*pad_to*``,
+``*chunk*``, ``*align*``, e.g. the engine's ``_pow2_bucket``) or a
+``min``/``max`` cap against an untainted bound. Bucketing collapses the
+family to O(log n) compiled shapes.
+
+Example::
+
+    n = len(batch)                      # per-request magnitude
+    toks = jnp.zeros((n, width))        # traced shape now unbounded
+    out = self._step(params, toks)      # BUG: one compile per batch size
+
+Fix: bucket the magnitude first, pad to the bucket, and mask the tail::
+
+    k = _pow2_bucket(len(batch), cap)   # O(log n) shape family
+    toks = jnp.zeros((k, width))
+    out = self._step(params, toks)
+
+Suppress a deliberately unbounded shape (e.g. a one-shot offline tool)
+at the call line with ``# tpulint: disable=TPU017`` and a comment
+saying why. The runtime complement is the tpusan compile-cache watcher
+(``sanitize/_jax.py``): declare a bucket budget per callable and the
+witness reports when distinct lowerings exceed it.
+
+The interprocedural half: a parameter used as a traced dimension inside
+a callee propagates backwards (like TPU013's sinking params), so
+``dispatch(len(reqs))`` → ``def dispatch(n): f(jnp.zeros((n,)))`` is
+caught with the full call chain in the message.
+"""
+
+from typing import Dict, List, Sequence, Tuple, Union
+
+from tritonclient_tpu.analysis import _callgraph
+from tritonclient_tpu.analysis._engine import FileContext, Finding, Rule
+
+Slot = Union[int, str]
+
+
+class BucketDisciplineRule(Rule):
+    id = "TPU017"
+    name = "bucket-discipline"
+    description = (
+        "per-request magnitude (len/shape read) shapes a traced operand "
+        "of a jitted callable without passing a pow2/chunk bucketing "
+        "function — statically provable compile-cache explosion"
+    )
+
+    def check_project(self, ctxs: Sequence[FileContext]) -> List[Finding]:
+        if not ctxs:
+            return []
+        graph = _callgraph.get_callgraph(ctxs)
+        shapes = {
+            key: fn.shapes for key, fn in graph.functions.items()
+            if fn.shapes is not None
+        }
+        sinking = _sinking_params(shapes)
+        linted = {ctx.path for ctx in ctxs if not _is_test_path(ctx.path)}
+        findings: List[Finding] = []
+        seen = set()
+
+        def emit(fn, line, col, message):
+            dedup = (fn.path, line, message)
+            if dedup in seen:
+                return
+            seen.add(dedup)
+            findings.append(Finding(self.id, fn.path, line, col, message))
+
+        for key in sorted(shapes):
+            fn = graph.functions[key]
+            if fn.path not in linted:
+                continue
+            rec = shapes[key]
+            for detail, line, col, src in rec.dyn_flows:
+                emit(fn, line, col,
+                     f"per-request magnitude shapes {detail} (`{src}`) "
+                     f"in `{key}` without passing a bucketing function: "
+                     f"unbounded shape family — one XLA compile per "
+                     f"distinct size")
+            for callee, slot, line, col, src in rec.dyn_arg_calls:
+                hit = _lookup(sinking, shapes, callee, slot)
+                if hit is None:
+                    continue
+                detail, chain = hit
+                path = " -> ".join([key] + chain)
+                emit(fn, line, col,
+                     f"per-request magnitude `{src}` flows into "
+                     f"`{callee}` and shapes {detail} via {path} "
+                     f"without passing a bucketing function: unbounded "
+                     f"shape family — one XLA compile per distinct size")
+        return findings
+
+
+def _is_test_path(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return "tests" in parts or parts[-1].startswith("test_")
+
+
+def _lookup(sinking, shapes, callee: str, slot: Slot):
+    rec = shapes.get(callee)
+    if rec is None:
+        return None
+    param = rec.slot_param(slot)
+    if param is None:
+        return None
+    return sinking.get((callee, param))
+
+
+def _sinking_params(
+    shapes,
+) -> Dict[Tuple[str, str], Tuple[str, List[str]]]:
+    """Fixpoint: (function key, param) -> (traced-dim detail, call
+    chain down to the function owning the jit call)."""
+    sinking: Dict[Tuple[str, str], Tuple[str, List[str]]] = {}
+    for key, rec in shapes.items():
+        for param, sinks in rec.dyn_sinks.items():
+            sinking[(key, param)] = (sinks[0][0], [key])
+    changed = True
+    while changed:
+        changed = False
+        for key, rec in shapes.items():
+            for param, calls in rec.dyn_calls.items():
+                if (key, param) in sinking:
+                    continue
+                for callee, slot, _line in calls:
+                    hit = _lookup(sinking, shapes, callee, slot)
+                    if hit is None:
+                        continue
+                    detail, chain = hit
+                    sinking[(key, param)] = (detail, [key] + chain)
+                    changed = True
+                    break
+    return sinking

@@ -93,6 +93,18 @@ def _pow2_bucket(n: int, cap: int) -> int:
     return min(b, cap)
 
 
+def _advance_slot_clocks(pos, steps):
+    """Whole-bank slot-clock advance for the unfused decode branch.
+
+    Jitted with ``donate_argnums=(0, 1)``: both inputs are dead the
+    moment the step dispatches, so on TPU the buffers are recycled in
+    place instead of allocating two fresh device vectors every step
+    (TPU015 donation discipline). The CPU backend ignores donation, so
+    token streams are unchanged on the test tier.
+    """
+    return pos + 1, steps + 1
+
+
 def _sample_slots(logits, seeds, steps, temps, topks):
     """Per-slot sampling on the shared (seed, step) key schedule —
     vmapped so every slot keeps its own request's settings and key
@@ -684,6 +696,9 @@ class GenerationEngine:
                               proj_fn=self._proj_fn),
             donate_argnums=(1, 2),
         )
+        # Unfused-branch slot clocks advance through a donating jit so
+        # the dead pos/steps buffers are reused in place on TPU.
+        self._advance = jax.jit(_advance_slot_clocks, donate_argnums=(0, 1))
         # Fused pipelined dispatch: TPU_ENGINE_FUSE_STEPS=k scans k decode
         # micro-steps into one dispatch + one readback when the bank is
         # saturated (no prefills, empty admission queue, every active
@@ -1152,6 +1167,12 @@ class GenerationEngine:
             # for every lane, hit pages or not (shape-bucketed gather).
             scope.kv_bytes = kk * n_ctx * self._block_kv_bytes
         self._prefill_seq += 1
+        # One compile-cache entry per (lane, context) bucket: the key is
+        # the traced-shape identity XLA uses, so the retrace counter and
+        # the tpusan bucket-budget watcher see exactly what XLA compiles.
+        _stepscope.note_compile(
+            self._scope_name, "prefill_chunk", f"{kk}x{c}x{n_ctx}"
+        )
         firsts_dev, self._k, self._v = self._prefill_chunk_fn(
             self.params, self._k, self._v, jnp.asarray(chunks),
             jnp.asarray(btab_rows), jnp.asarray(starts),
@@ -1453,6 +1474,13 @@ class GenerationEngine:
                     * self._block_kv_bytes
                 )
             step_seq += fuse
+            # Whole-bank decode traces one shape per fuse width: the
+            # unfused branch is a single cache entry, the fused branch
+            # one per distinct window (bounded by the fuse policy).
+            _stepscope.note_compile(
+                self._scope_name, "decode_step",
+                f"bank:{self.max_slots}x{self._max_blocks}:fuse:{fuse}",
+            )
             if fuse == 1:
                 toks, self._k, self._v = self._step(
                     self.params, self._k, self._v, self._btabs,
@@ -1460,8 +1488,9 @@ class GenerationEngine:
                     self._temps, self._topks,
                 )
                 self._tokens = toks
-                self._pos = self._pos + 1
-                self._steps = self._steps + 1
+                self._pos, self._steps = self._advance(
+                    self._pos, self._steps
+                )
             else:
                 # Fused window: one dispatch, [fuse, S] tokens, carry
                 # advanced on device (no per-step host enqueues).

@@ -2,7 +2,7 @@
 
 tpulint (``tritonclient_tpu/analysis``) proves lock-order, shm-lifecycle,
 and async-blocking discipline *statically*; tpusan closes the loop by
-watching the same invariants under real execution. Four witnesses, most
+watching the same invariants under real execution. The witnesses, most
 paired with a static rule:
 
 =======  ====================  ===============================================
@@ -36,6 +36,23 @@ TPU012   mem-reconcile         the memscope ledger's reconciliation
                                finding carries the allocation-site AND
                                leak-site stacks (``_mem.py``; dynamic-
                                only, no static pair)
+TPU015   donation poisoner     a buffer donated to a jitted callable
+                               (``donate_argnums``) read after the
+                               dispatch — garbage on real TPUs while the
+                               CPU tier runs green; the finding carries
+                               the donation-site AND read-site stacks
+                               (``_jax.py``)
+TPU016   transfer witness      an implicit device transfer under
+                               ``jax.transfer_guard("disallow")`` — the
+                               degenerate sharding-drift reshard, a host
+                               round-trip per call (``_jax.py``)
+TPU017   compile-cache watcher distinct lowerings of a watched callable
+                               exceeding its declared bucket budget — an
+                               unbucketed per-request magnitude shaping
+                               traced operands; also feeds the
+                               nv_engine_compile_cache_entries /
+                               nv_engine_retrace_total metrics plane
+                               (``_jax.py``)
 =======  ====================  ===============================================
 
 Activation: ``TPUSAN=1`` in the environment (the test suite's
@@ -136,6 +153,30 @@ RULES_META = [
             "cancelled request's memscope bytes did not return to zero"
         },
     },
+    {
+        "id": "TPU015",
+        "name": "donation-discipline",
+        "shortDescription": {
+            "text": "read-after-donate witnessed: a buffer donated to a "
+            "jitted callable was touched again (garbage on real TPUs)"
+        },
+    },
+    {
+        "id": "TPU016",
+        "name": "sharding-drift",
+        "shortDescription": {
+            "text": "implicit device transfer witnessed under "
+            "jax.transfer_guard: placement disagrees with the boundary"
+        },
+    },
+    {
+        "id": "TPU017",
+        "name": "bucket-discipline",
+        "shortDescription": {
+            "text": "compile-cache overflow witnessed: distinct lowerings "
+            "exceeded the callable's declared bucket budget"
+        },
+    },
 ]
 
 
@@ -184,7 +225,7 @@ def enable(mode: Optional[str] = None):
     :class:`TpusanError` at the violation). Defaults to ``TPUSAN_MODE``,
     then ``TPUSAN=strict``, then ``report``.
     """
-    from tritonclient_tpu.sanitize import _aio, _blocking, _mem, _shm
+    from tritonclient_tpu.sanitize import _aio, _blocking, _jax, _mem, _shm
 
     with _STATE.lock:
         _STATE.depth += 1
@@ -205,11 +246,12 @@ def enable(mode: Optional[str] = None):
         _shm.install()
         _aio.install()
         _mem.install()
+        _jax.install()
 
 
 def disable():
     """Deactivate and unpatch once every :func:`enable` is balanced."""
-    from tritonclient_tpu.sanitize import _aio, _blocking, _mem, _shm
+    from tritonclient_tpu.sanitize import _aio, _blocking, _jax, _mem, _shm
 
     with _STATE.lock:
         _STATE.depth = max(0, _STATE.depth - 1)
@@ -220,12 +262,13 @@ def disable():
     _shm.uninstall()
     _blocking.uninstall()
     _mem.uninstall()
+    _jax.uninstall()
 
 
 def reset():
     """Drop recorded findings and witness state (locks graph, shm states,
     field locksets)."""
-    from tritonclient_tpu.sanitize import _locks, _mem, _races, _shm
+    from tritonclient_tpu.sanitize import _jax, _locks, _mem, _races, _shm
 
     with _STATE.lock:
         _STATE.records.clear()
@@ -234,6 +277,7 @@ def reset():
     _races.reset()
     _shm.reset()
     _mem.reset()
+    _jax.reset()
 
 
 def _project_site(skip_sanitize: bool = True):

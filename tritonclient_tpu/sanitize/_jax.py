@@ -1,0 +1,291 @@
+"""JAX compute-plane witnesses (rules TPU015 / TPU016 / TPU017).
+
+The runtime complement of tpushape (``analysis/_shapes.py``): the static
+rules prove what they can from the AST; these witnesses catch what only
+real dispatch traffic shows — and classify the static findings as
+witnessed/unexercised via ``scripts/tpusan_report.py``.
+
+Three witnesses:
+
+* **Donation poisoner** (TPU015). :func:`donating` wraps a callable that
+  was jitted with ``donate_argnums``: after each call the operands at the
+  donated slots are *poisoned* (identity-tracked with the donation-site
+  stack); passing a poisoned array back into any wrapped callable — or
+  touching it through :func:`check_read` — reports a read-after-donate
+  with BOTH stacks (donation site + read site). This matters because the
+  CPU backend *ignores* donation: tier-1 tests run green while the same
+  read returns garbage on a real TPU.
+
+* **Transfer witness** (TPU016). :func:`check_transfers` wraps a call in
+  ``jax.transfer_guard("disallow")``; an implicit device transfer inside
+  (the degenerate form of a sharding-drift reshard: a host round-trip)
+  reports TPU016 and, in report mode, re-runs the call unguarded so the
+  program keeps going.
+
+* **Compile-cache watcher** (TPU017). :func:`declare_bucket_budget` sets
+  the number of distinct lowerings a callable is *allowed* (the bucket
+  family size, e.g. ``log2(cap)`` for a pow2 bucketer);
+  :func:`note_lowering` records each dispatch signature, feeds the
+  stepscope compile plane (``nv_engine_compile_cache_entries`` /
+  ``nv_engine_retrace_total``), and reports TPU017 once distinct
+  signatures exceed the declared budget — the runtime proof of an
+  unbucketed shape family.
+
+Events only fire while the sanitizer is active; all tables are bounded
+(poison table by live arrays via weakrefs, lowering table by the real
+compile cache it mirrors).
+"""
+
+import threading
+import traceback
+import weakref
+from typing import Dict, Optional, Tuple
+
+_LOCK = threading.Lock()
+#: id(array) -> (label, donation-site stack). Entries evaporate with the
+#: array via weakref callbacks, so id reuse cannot mis-poison.
+_POISONED: Dict[int, Tuple[str, str]] = {}
+#: Keep the weakrefs alive until their referents die.
+_POISON_REFS: Dict[int, object] = {}
+#: callable label -> declared max distinct lowerings.
+_BUDGETS: Dict[str, int] = {}
+#: callable label -> set of distinct dispatch-signature keys.
+_LOWERINGS: Dict[str, set] = {}
+#: labels whose budget overflow was already reported (one finding each).
+_OVERFLOWED: set = set()
+_installed = False
+
+
+def _active() -> bool:
+    from tritonclient_tpu import sanitize
+
+    return sanitize.enabled() and _installed
+
+
+# tpulint: disable=TPU009 - benign single-rebind mode publication
+def install():
+    global _installed
+    _installed = True
+
+
+def uninstall():
+    global _installed
+    _installed = False
+
+
+def reset():
+    with _LOCK:
+        _POISONED.clear()
+        _POISON_REFS.clear()
+        _BUDGETS.clear()
+        _LOWERINGS.clear()
+        _OVERFLOWED.clear()
+
+
+def _stack() -> str:
+    return "".join(traceback.format_list(traceback.extract_stack()[-12:]))
+
+
+# -- donation poisoner (TPU015) --------------------------------------------- #
+
+
+def _poison(obj, label: str):
+    key = id(obj)
+    stack = _stack()
+
+    def _expire(_ref, _key=key):
+        with _LOCK:
+            _POISONED.pop(_key, None)
+            _POISON_REFS.pop(_key, None)
+
+    try:
+        ref = weakref.ref(obj, _expire)
+    except TypeError:  # not weakref-able: don't track (id reuse hazard)
+        return
+    with _LOCK:
+        _POISONED[key] = (label, stack)
+        _POISON_REFS[key] = ref
+
+
+def _unpoison(obj):
+    with _LOCK:
+        _POISONED.pop(id(obj), None)
+        _POISON_REFS.pop(id(obj), None)
+
+
+def check_read(obj, where: str = ""):
+    """Report TPU015 if ``obj`` was donated earlier (both stacks attached).
+
+    The wrapped callables call this on every operand; engine code can
+    also call it directly at an explicit read site. Returns True when a
+    read-after-donate was reported."""
+    if not _active():
+        return False
+    with _LOCK:
+        hit = _POISONED.get(id(obj))
+    if hit is None:
+        return False
+    label, donate_stack = hit
+    from tritonclient_tpu import sanitize
+
+    suffix = f" at {where}" if where else ""
+    sanitize.report_finding(
+        "TPU015",
+        f"read-after-donate: a buffer donated to `{label}` was read"
+        f"{suffix} — on TPU the donated buffer is invalidated by the "
+        "dispatch, so this read returns garbage (donation and read-site "
+        "stacks attached)",
+        stacks=[donate_stack],
+    )
+    return True
+
+
+def donating(fn, donate_argnums=(), label: Optional[str] = None):
+    """Wrap a donating callable with the read-after-donate poisoner.
+
+    ``donate_argnums`` must mirror the ``jax.jit(..., donate_argnums=)``
+    the callable was built with. Every call first checks all operands
+    against the poison table (a poisoned operand is a read-after-donate),
+    then runs ``fn``, then poisons the operands at the donated slots.
+    Rebinding the result over the donated name — the correct discipline —
+    naturally retires the poisoned object."""
+    name = label or getattr(fn, "__name__", repr(fn))
+    slots = tuple(int(i) for i in donate_argnums)
+
+    def wrapper(*args, **kwargs):
+        if not _active():
+            return fn(*args, **kwargs)
+        for i, arg in enumerate(args):
+            check_read(arg, where=f"argument {i} of `{name}`")
+        result = fn(*args, **kwargs)
+        for i in slots:
+            if i < len(args):
+                _poison(args[i], name)
+        return result
+
+    wrapper.__name__ = f"tpusan_donating[{name}]"
+    wrapper.__wrapped__ = fn
+    return wrapper
+
+
+# -- transfer witness (TPU016) ---------------------------------------------- #
+
+
+def check_transfers(fn, label: Optional[str] = None):
+    """Wrap ``fn`` in ``jax.transfer_guard("disallow")``.
+
+    An implicit device transfer inside the call — the degenerate
+    sharding-drift reshard, a silent host round-trip on every step —
+    reports TPU016; in report mode the call is then retried unguarded so
+    execution continues (strict mode raises at the report)."""
+    name = label or getattr(fn, "__name__", repr(fn))
+
+    def wrapper(*args, **kwargs):
+        if not _active():
+            return fn(*args, **kwargs)
+        try:
+            import jax
+
+            guard = jax.transfer_guard("disallow")
+        except Exception:  # jax absent or too old: witness degrades to off
+            return fn(*args, **kwargs)
+        try:
+            with guard:
+                return fn(*args, **kwargs)
+        except Exception as exc:
+            if "transfer" not in str(exc).lower():
+                raise
+            from tritonclient_tpu import sanitize
+
+            sanitize.report_finding(
+                "TPU016",
+                f"implicit device transfer witnessed inside `{name}`: an "
+                "operand's placement disagrees with the boundary it "
+                "crosses, forcing a silent host round-trip on every call "
+                "— align the producer sharding with the consumer spec",
+            )
+            return fn(*args, **kwargs)
+
+    wrapper.__name__ = f"tpusan_transfers[{name}]"
+    wrapper.__wrapped__ = fn
+    return wrapper
+
+
+# -- compile-cache watcher (TPU017) ----------------------------------------- #
+
+
+def declare_bucket_budget(label: str, budget: int):
+    """Declare how many distinct lowerings ``label`` is allowed.
+
+    The budget is the size of the callable's intended shape family — a
+    pow2 bucketer with cap C yields ``log2(C)+1`` shapes. Exceeding it at
+    runtime proves an unbucketed per-request magnitude reached the
+    traced operands (the dynamic face of static rule TPU017)."""
+    with _LOCK:
+        _BUDGETS[label] = int(budget)
+
+
+def signature_key(*operands) -> str:
+    """The dispatch-signature key XLA's compile cache would use: the
+    (shape, dtype) tuple of every array operand, ``repr`` for scalars."""
+    parts = []
+    for op in operands:
+        shape = getattr(op, "shape", None)
+        dtype = getattr(op, "dtype", None)
+        if shape is not None:
+            parts.append(f"{tuple(shape)}:{dtype}")
+        else:
+            parts.append(repr(op))
+    return ";".join(parts)
+
+
+def note_lowering(label: str, key: str, model: str = "engine"):
+    """Record one dispatch signature for ``label``.
+
+    Feeds the stepscope compile plane unconditionally-of-budget (the
+    metrics family exists even for well-bucketed callables); reports
+    TPU017 once — with the offending signature count — when distinct
+    signatures exceed the declared bucket budget."""
+    if not _active():
+        return
+    from tritonclient_tpu import _stepscope
+
+    _stepscope.note_compile(model, label, key)
+    with _LOCK:
+        keys = _LOWERINGS.setdefault(label, set())
+        keys.add(key)
+        budget = _BUDGETS.get(label)
+        overflow = (
+            budget is not None
+            and len(keys) > budget
+            and label not in _OVERFLOWED
+        )
+        if overflow:
+            _OVERFLOWED.add(label)
+            count = len(keys)
+    if not overflow:
+        return
+    from tritonclient_tpu import sanitize
+
+    sanitize.report_finding(
+        "TPU017",
+        f"compile-cache overflow: `{label}` reached {count} distinct "
+        f"lowerings against a declared bucket budget of {budget} — a "
+        "per-request magnitude is shaping its traced operands without "
+        "bucketing (one XLA compile per distinct size)",
+    )
+
+
+def watched(fn, label: Optional[str] = None, model: str = "engine"):
+    """Wrap a jitted callable with the compile-cache watcher: every call
+    records its operand signature via :func:`note_lowering`."""
+    name = label or getattr(fn, "__name__", repr(fn))
+
+    def wrapper(*args, **kwargs):
+        if _active():
+            note_lowering(name, signature_key(*args), model=model)
+        return fn(*args, **kwargs)
+
+    wrapper.__name__ = f"tpusan_watched[{name}]"
+    wrapper.__wrapped__ = fn
+    return wrapper

@@ -1838,6 +1838,36 @@ class InferenceCore:
                 f'{metric}{{model="{esc(sname)}",phase="{phase}"}} '
                 f"{total}"
             )
+        # Compile plane: distinct dispatch signatures (= XLA compile
+        # cache entries) per jitted callable, and how many arrived after
+        # the first (each one paid a fresh trace+compile). A growing
+        # retrace counter in steady state is the TPU017 bucket-
+        # discipline signal; the tpusan compile-cache watcher turns the
+        # same stream into findings against declared budgets.
+        compile_rows = _stepscope.compile_snapshot()
+        metric = _stepscope.COMPILE_CACHE_METRIC
+        lines.append(
+            f"# HELP {metric} Distinct dispatch signatures recorded per "
+            "jitted engine callable (compile cache entries, stepscope)"
+        )
+        lines.append(f"# TYPE {metric} gauge")
+        for sname, cname, entries, _retraces in compile_rows:
+            lines.append(
+                f'{metric}{{model="{esc(sname)}",callable="{esc(cname)}"}} '
+                f"{entries}"
+            )
+        metric = _stepscope.RETRACE_METRIC
+        lines.append(
+            f"# HELP {metric} Dispatch signatures first seen after a "
+            "callable's initial compile — each paid a fresh XLA "
+            "trace+compile (stepscope)"
+        )
+        lines.append(f"# TYPE {metric} counter")
+        for sname, cname, _entries, retraces in compile_rows:
+            lines.append(
+                f'{metric}{{model="{esc(sname)}",callable="{esc(cname)}"}} '
+                f"{retraces}"
+            )
         # Paged-KV families (tritonclient_tpu._kvcache registry): pool
         # occupancy gauges plus the prefix-cache event counter for every
         # live engine. Headers always render (stable family set for
